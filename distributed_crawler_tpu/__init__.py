@@ -1,0 +1,31 @@
+"""distributed_crawler_tpu — a TPU-native distributed crawler + inference framework.
+
+A ground-up rebuild of the capabilities of researchaccelerator-hub/distributed-crawler
+(a Go/Dapr distributed social-media crawler; see SURVEY.md) re-designed TPU-first:
+
+- crawl engine, random-walk/snowball/random sampling, tandem crawler/validator
+  pipeline, orchestrator/worker fan-out, pluggable state backends (Python, with a
+  C++ native client boundary where the reference used TDLib/C++);
+- an in-tree TPU inference stage (JAX/Flax/pjit over a device mesh): multilingual
+  embedding (E5 family), content classification (XLM-R family) and ASR (Whisper
+  family), fed by a record-batching message bus.
+
+Package layout:
+  datamodel/   canonical Post/ChannelData schema + null-validation
+  config/      crawler + distributed config, precedence chain
+  state/       state-management interface, local/SQL providers, media cache
+  bus/         typed message envelopes, record-batch codec, in-memory + gRPC bus
+  clients/     TDLib-class client boundary, pools, rate limiters, YouTube client
+  crawl/       crawl engine (runner, walkback, tandem, validator)
+  crawlers/    platform crawler registry (telegram, youtube)
+  orchestrator/, worker/   distributed coordination
+  models/      Flax model families (E5, XLM-R, Whisper)
+  ops/         Pallas TPU kernels
+  parallel/    mesh/sharding/ring-attention (ICI-first collectives)
+  inference/   TPU inference worker (tokenize -> bucket -> pjit step)
+  modes/       execution modes (standalone, layerless, jobs, distributed)
+  chunk/       file-combining pipeline
+  utils/       logging, metrics, time parsing, file janitor
+"""
+
+__version__ = "0.1.0"

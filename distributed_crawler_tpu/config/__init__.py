@@ -1,0 +1,32 @@
+"""Configuration layer: crawler config, rate limits, distributed config, precedence.
+
+Parity with the reference's `common/utils.go` (CrawlerConfig + rate limits),
+`common/sampling_validation.go`, `config/distributed.go`, and the cobra/viper
+precedence chain in `main.go:185-520`.
+"""
+
+from .crawler import (
+    PLATFORM_TELEGRAM,
+    PLATFORM_YOUTUBE,
+    CrawlerConfig,
+    TelegramRateLimitConfig,
+    generate_crawl_id,
+    read_urls_from_file,
+)
+from .distributed import BusConfig, DistributedConfig
+from .precedence import ConfigResolver
+from .sampling import SamplingValidationInput, validate_sampling_method
+
+__all__ = [
+    "CrawlerConfig",
+    "TelegramRateLimitConfig",
+    "generate_crawl_id",
+    "read_urls_from_file",
+    "PLATFORM_TELEGRAM",
+    "PLATFORM_YOUTUBE",
+    "DistributedConfig",
+    "BusConfig",
+    "ConfigResolver",
+    "SamplingValidationInput",
+    "validate_sampling_method",
+]

@@ -1,0 +1,141 @@
+"""Crawler configuration and shared helpers.
+
+Parity with the reference's `common/utils.go`:
+- `TelegramRateLimitConfig` + defaults (`common/utils.go:19-46`)
+- `CrawlerConfig` (~45 fields, `common/utils.go:49-99`), extended with the
+  TPU-build's inference settings (the north-star `worker/tpu` stage)
+- crawl-ID generation (`common/utils.go:103-111`)
+- URL file reading (`common/utils.go:167-187`)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from datetime import datetime, timezone
+from typing import List, Optional
+
+PLATFORM_TELEGRAM = "telegram"
+PLATFORM_YOUTUBE = "youtube"
+
+
+@dataclass
+class TelegramRateLimitConfig:
+    """Per-connection Telegram API rate limits (`common/utils.go:19-46`).
+
+    Rates are calls/minute; jitter adds random delay after each rate-limited
+    call to reduce fingerprinting.  GetMessage is handled *reactively*: a token
+    is only consumed when the call misses the client's local cache and hits the
+    server (cache hits are free).
+    """
+
+    get_chat_history_rate: float = 30.0
+    search_public_chat_rate: float = 6.0
+    get_supergroup_info_rate: float = 20.0
+    get_chat_history_jitter_ms: int = 500
+    search_public_chat_jitter_ms: int = 1500
+    get_supergroup_info_jitter_ms: int = 800
+    get_message_server_hit_rate: float = 60.0
+    get_message_server_hit_jitter_ms: int = 300
+
+
+@dataclass
+class InferenceConfig:
+    """TPU inference stage settings (new in this build; north star BASELINE.json).
+
+    Controls the `inference/` worker: which models run over crawled posts, how
+    batches are formed, and how the device mesh is laid out.
+    """
+
+    enabled: bool = False
+    embed_model: str = "e5-small"  # models/registry.py key
+    classify_model: str = "xlmr-base-classifier"
+    asr_model: str = "whisper-small"
+    batch_size: int = 256
+    max_seq_len: int = 512
+    bucket_sizes: List[int] = field(default_factory=lambda: [64, 128, 256, 512])
+    batch_deadline_ms: int = 50  # flush a partial batch after this long
+    mesh_shape: Optional[List[int]] = None  # None -> all devices on one data axis
+    mesh_axes: List[str] = field(default_factory=lambda: ["data"])
+    dtype: str = "bfloat16"
+
+
+@dataclass
+class CrawlerConfig:
+    """Main crawl configuration (`common/utils.go:49-99`)."""
+
+    # Runtime / orchestration
+    distributed_mode: bool = False  # reference: DaprMode
+    runtime_port: int = 0  # reference: DaprPort
+    concurrency: int = 1
+    timeout: int = 30
+    user_agent: str = "Mozilla/5.0 dct-crawler/1.0"
+    output_format: str = "jsonl"
+    storage_root: str = "/tmp/crawls"
+
+    # Telegram client databases (connection pooling)
+    tdlib_database_url: str = ""
+    tdlib_database_urls: List[str] = field(default_factory=list)
+    tdlib_verbosity: int = 1
+
+    # Date windows / sampling
+    min_post_date: Optional[datetime] = None
+    post_recency: Optional[datetime] = None
+    date_between_min: Optional[datetime] = None
+    date_between_max: Optional[datetime] = None
+    sample_size: int = 0
+
+    job_mode: bool = False  # reference: DaprJobMode
+    min_users: int = 0
+    crawl_id: str = ""
+    crawl_label: str = ""
+    max_comments: int = -1
+    max_posts: int = -1
+    max_depth: int = 0
+    max_pages: int = 108000  # reference default, main.go:776
+    skip_media_download: bool = False
+    platform: str = PLATFORM_TELEGRAM
+    youtube_api_key: str = ""
+    sampling_method: str = "channel"  # channel | random | snowball | random-walk
+    seed_size: int = 0
+    walkback_rate: int = 0
+    min_channel_videos: int = 0
+
+    # File combining (chunker)
+    combine_files: bool = False
+    combine_temp_dir: str = ""
+    combine_watch_dir: str = ""
+    combine_write_dir: str = ""
+    combine_trigger_size: int = 170 * 1024 * 1024  # 170 MiB, main.go:800
+    combine_hard_cap: int = 200 * 1024 * 1024  # 200 MiB, main.go:801
+
+    # Null handling
+    null_config: str = ""  # user JSON overriding default rules
+
+    exit_on_complete: bool = False
+    max_crawl_duration_s: float = 0.0  # 0 = unlimited
+
+    rate_limit: TelegramRateLimitConfig = field(default_factory=TelegramRateLimitConfig)
+
+    # Validator / tandem-crawl mode (`common/utils.go:92-98`)
+    tandem_crawl: bool = False
+    validate_only: bool = False
+    validator_request_rate: float = 6.0  # HTTP calls/min (crawl/validator.go:58)
+    validator_request_jitter_ms: int = 200
+    validator_claim_batch_size: int = 10
+    validator_timeout_s: float = 0.0  # 0 = disabled
+
+    # TPU inference stage (new)
+    inference: InferenceConfig = field(default_factory=InferenceConfig)
+
+
+def generate_crawl_id(now: Optional[datetime] = None) -> str:
+    """Timestamp-format crawl ID, "YYYYMMDDHHMMSS" (`common/utils.go:103-111`)."""
+    now = now or datetime.now(timezone.utc)
+    return now.strftime("%Y%m%d%H%M%S")
+
+
+def read_urls_from_file(filename: str) -> List[str]:
+    """One URL per line; skip blanks and '#' comments (`common/utils.go:167-187`)."""
+    with open(filename, "r", encoding="utf-8") as f:
+        lines = f.read().split("\n")
+    return [ln.strip() for ln in lines if ln.strip() and not ln.strip().startswith("#")]

@@ -1,0 +1,102 @@
+"""Distributed-coordination configuration.
+
+Parity with the reference's `config/distributed.go:10-170`
+(DistributedConfig + DaprDistributedConfig + defaults + validation).  The
+"Dapr" sub-config becomes `BusConfig`: this build's message bus is in-tree
+(bus/ package, record-batching codec over gRPC/DCN) rather than a sidecar,
+but topic layout, TTL, priority, and timeout semantics are preserved.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class BusConfig:
+    """Message-bus settings (`config/distributed.go:35-51`)."""
+
+    pubsub_component: str = "pubsub"
+    work_queue_topic: str = "crawl-work-queue"
+    results_topic: str = "crawl-results"
+    worker_status_topic: str = "worker-status"
+    orchestrator_topic: str = "orchestrator-commands"
+    # New in the TPU build: the record-batch stream feeding the inference worker
+    # and the enriched-result stream coming back.
+    inference_batch_topic: str = "tpu-inference-batches"
+    inference_results_topic: str = "tpu-inference-results"
+    state_store: str = "statestore"
+    message_ttl_s: float = 3600.0
+    message_priority: int = 5
+    grpc_target: str = "127.0.0.1:50551"  # DCN transport endpoint
+    max_frame_bytes: int = 201 * 1024 * 1024  # daprstate.go:108-110 parity
+
+
+VALID_MODES = ("", "standalone", "distributed-standalone", "orchestrator", "worker",
+               "tpu-worker", "job")
+
+
+@dataclass
+class DistributedConfig:
+    """Distributed crawling configuration (`config/distributed.go:10-79`)."""
+
+    mode: str = ""  # auto-detect from CLI flags when empty
+    worker_id: str = ""
+
+    max_workers_per_node: int = 4
+    work_queue_size: int = 1000
+    result_buffer_size: int = 1000
+    heartbeat_interval_s: float = 30.0
+    work_timeout_s: float = 600.0
+    retry_attempts: int = 3
+    retry_delay_s: float = 5.0
+
+    work_distribution_interval_s: float = 5.0
+    health_check_interval_s: float = 60.0
+    worker_timeout_s: float = 180.0
+    max_concurrent_work: int = 100
+
+    bus: BusConfig = field(default_factory=BusConfig)
+
+    def validate(self) -> None:
+        """`config/distributed.go:82-145`."""
+        if self.mode not in VALID_MODES:
+            raise ValueError(
+                f"invalid mode '{self.mode}', must be one of: {', '.join(m for m in VALID_MODES if m)}"
+            )
+        if self.mode == "worker" and not self.worker_id:
+            raise ValueError("worker mode requires worker_id to be specified")
+        if self.max_workers_per_node < 1:
+            raise ValueError("max_workers_per_node must be at least 1")
+        if self.work_queue_size < 1:
+            raise ValueError("work_queue_size must be at least 1")
+        if self.result_buffer_size < 1:
+            raise ValueError("result_buffer_size must be at least 1")
+        if self.retry_attempts < 0:
+            raise ValueError("retry_attempts cannot be negative")
+        if self.max_concurrent_work < 1:
+            raise ValueError("max_concurrent_work must be at least 1")
+        if self.heartbeat_interval_s <= 0:
+            raise ValueError("heartbeat_interval must be positive")
+        if self.work_timeout_s <= 0:
+            raise ValueError("work_timeout must be positive")
+        if self.worker_timeout_s <= 0:
+            raise ValueError("worker_timeout must be positive")
+        if not self.bus.pubsub_component:
+            raise ValueError("bus.pubsub_component cannot be empty")
+        if not self.bus.state_store:
+            raise ValueError("bus.state_store cannot be empty")
+
+    @property
+    def is_distributed_mode(self) -> bool:
+        return self.mode in ("orchestrator", "worker", "tpu-worker")
+
+    def topic_names(self):
+        return [
+            self.bus.work_queue_topic,
+            self.bus.results_topic,
+            self.bus.worker_status_topic,
+            self.bus.orchestrator_topic,
+            self.bus.inference_batch_topic,
+            self.bus.inference_results_topic,
+        ]

@@ -1,0 +1,129 @@
+"""Four-level config precedence: CLI flags > env > YAML file > defaults.
+
+Parity with the reference's cobra/viper wiring (`main.go:185-520`):
+- env vars are prefixed ``CRAWLER_`` with dots/dashes mapped to underscores
+  (`main.go:245-248`)
+- YAML config file searched in ., ~/.crawler, /etc/crawler (`main.go:232-243`)
+- job mode adds a fifth layer: per-job JSON payload overrides the CLI base
+  config (handled in modes/jobs.py, parity `dapr/job.go:305-362`).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable, Mapping, Optional
+
+import yaml
+
+ENV_PREFIX = "CRAWLER_"
+CONFIG_FILENAMES = ("config.yaml", "config.yml")
+CONFIG_SEARCH_PATHS = (".", os.path.expanduser("~/.crawler"), "/etc/crawler")
+
+
+def _flatten(d: Mapping[str, Any], prefix: str = "") -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in d.items():
+        key = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, Mapping):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+def env_key(key: str) -> str:
+    """'crawler.max-pages' -> 'CRAWLER_CRAWLER_MAX_PAGES'-style mapping.
+
+    Matching viper semantics: the full dotted key, dots and dashes replaced by
+    underscores, uppercased, prefixed (`main.go:245-248`).
+    """
+    return ENV_PREFIX + key.replace(".", "_").replace("-", "_").upper()
+
+
+class ConfigResolver:
+    """Resolves dotted config keys through the precedence chain."""
+
+    def __init__(
+        self,
+        flags: Optional[Mapping[str, Any]] = None,
+        env: Optional[Mapping[str, str]] = None,
+        config_file: Optional[str] = None,
+        defaults: Optional[Mapping[str, Any]] = None,
+        search_paths: Iterable[str] = CONFIG_SEARCH_PATHS,
+    ):
+        self._flags = dict(flags or {})
+        self._flag_set = {k for k, v in self._flags.items() if v is not None}
+        self._env = env if env is not None else os.environ
+        self._defaults = _flatten(defaults or {})
+        self._file_values: Dict[str, Any] = {}
+        if config_file and not os.path.exists(config_file):
+            # An explicitly named config file must exist (viper semantics,
+            # main.go:252-258: only search-path misses are tolerated).
+            raise FileNotFoundError(f"config file not found: {config_file}")
+        path = config_file or self._find_config_file(search_paths)
+        if path and os.path.exists(path):
+            with open(path, "r", encoding="utf-8") as f:
+                loaded = yaml.safe_load(f) or {}
+            if not isinstance(loaded, dict):
+                raise ValueError(f"config file {path} must contain a mapping")
+            self._file_values = _flatten(loaded)
+            self.config_file_used = path
+        else:
+            self.config_file_used = None
+
+    @staticmethod
+    def _find_config_file(search_paths: Iterable[str]) -> Optional[str]:
+        for d in search_paths:
+            for name in CONFIG_FILENAMES:
+                p = os.path.join(d, name)
+                if os.path.exists(p):
+                    return p
+        return None
+
+    def get(self, key: str, default: Any = None) -> Any:
+        # 1. explicitly-set CLI flag
+        if key in self._flag_set:
+            return self._flags[key]
+        # 2. environment
+        ek = env_key(key)
+        if ek in self._env:
+            return self._env[ek]
+        # 3. config file
+        if key in self._file_values:
+            return self._file_values[key]
+        # 4. declared defaults, then caller default
+        if key in self._defaults:
+            return self._defaults[key]
+        return default
+
+    def get_str(self, key: str, default: str = "") -> str:
+        v = self.get(key, default)
+        return "" if v is None else str(v)
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        v = self.get(key, default)
+        if v is None or v == "":
+            return default
+        return int(v)
+
+    def get_float(self, key: str, default: float = 0.0) -> float:
+        v = self.get(key, default)
+        if v is None or v == "":
+            return default
+        return float(v)
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self.get(key, default)
+        if isinstance(v, bool):
+            return v
+        if v is None or v == "":
+            return default
+        return str(v).strip().lower() in ("1", "true", "yes", "on")
+
+    def get_list(self, key: str, default: Optional[list] = None) -> list:
+        v = self.get(key, None)
+        if v is None or v == "":
+            return list(default or [])
+        if isinstance(v, (list, tuple)):
+            return list(v)
+        return [s.strip() for s in str(v).split(",") if s.strip()]

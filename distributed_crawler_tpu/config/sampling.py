@@ -1,0 +1,63 @@
+"""Platform × sampling-method validity matrix.
+
+Parity with `common/sampling_validation.go:19-66`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+VALID_METHODS = {
+    "telegram": ["channel", "snowball", "random-walk"],
+    "youtube": ["channel", "random", "snowball"],
+}
+
+MAX_CRAWL_ID_LEN = 32
+
+
+@dataclass
+class SamplingValidationInput:
+    platform: str = ""
+    sampling_method: str = ""
+    url_list: List[str] = field(default_factory=list)
+    url_file: str = ""
+    url_file_url: str = ""
+    mode: str = ""
+    seed_size: int = 0
+    crawl_id: str = ""
+
+
+def validate_sampling_method(inp: SamplingValidationInput) -> None:
+    """Raise ValueError if the combination is invalid (`sampling_validation.go:19-66`)."""
+    supported = VALID_METHODS.get(inp.platform)
+    if supported is None:
+        raise ValueError(f"unsupported platform: {inp.platform}")
+    if inp.sampling_method not in supported:
+        raise ValueError(
+            f"sampling method '{inp.sampling_method}' is not supported for platform "
+            f"'{inp.platform}'. Supported methods: {supported}"
+        )
+
+    has_url_source = bool(inp.url_list) or bool(inp.url_file) or bool(inp.url_file_url)
+
+    if inp.sampling_method == "random-walk":
+        # Exactly one of (URL sources / seed size) must be provided.
+        if has_url_source == (inp.seed_size > 0):
+            raise ValueError(
+                "must provide either seed urls or seed size in random-walk crawl, "
+                "not both or neither"
+            )
+        if len(inp.crawl_id) > MAX_CRAWL_ID_LEN:
+            raise ValueError("crawl IDs cannot exceed 32 characters")
+        return
+
+    if inp.sampling_method == "random":
+        return  # YouTube random sampling needs no URLs
+
+    # channel / snowball: URLs required unless job mode supplies them per-job.
+    if not has_url_source and inp.mode != "job":
+        raise ValueError(
+            f"{inp.sampling_method} sampling requires URLs to be provided. "
+            "Use --urls or --url-file to specify them"
+        )

@@ -1,0 +1,52 @@
+"""Canonical data model: the leaf layer every other layer depends on.
+
+Capability parity with the reference's `model/` package
+(`/root/reference/model/data.go:9-149`) and `null_handler/`
+(`/root/reference/null_handler/main.go`), re-expressed as Python dataclasses
+with JSON-stable field names.
+"""
+
+from .post import (
+    ChannelData,
+    Comment,
+    EngagementData,
+    InnerLink,
+    MediaData,
+    NullLogEvent,
+    OCRData,
+    PerformanceScores,
+    Post,
+)
+from .validation import (
+    Behavior,
+    FieldRule,
+    NullValidator,
+    ValidationConfig,
+    ValidationResult,
+    default_configs,
+    load_config_from_json,
+    merge_configs,
+)
+from .youtube import YouTubeChannel, YouTubeVideo
+
+__all__ = [
+    "Post",
+    "Comment",
+    "ChannelData",
+    "EngagementData",
+    "OCRData",
+    "PerformanceScores",
+    "InnerLink",
+    "MediaData",
+    "NullLogEvent",
+    "Behavior",
+    "FieldRule",
+    "ValidationConfig",
+    "ValidationResult",
+    "NullValidator",
+    "default_configs",
+    "merge_configs",
+    "load_config_from_json",
+    "YouTubeChannel",
+    "YouTubeVideo",
+]

@@ -1,0 +1,45 @@
+"""YouTube-specific data models.
+
+Parity with the reference's `model/youtube/types.go:10-36`
+(`YouTubeChannel`, `YouTubeVideo`).  The client protocol lives in
+`clients/youtube.py`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Dict, List, Optional
+
+
+@dataclass
+class YouTubeChannel:
+    """A YouTube channel (`model/youtube/types.go:10-20`)."""
+
+    id: str = ""
+    title: str = ""
+    description: str = ""
+    thumbnails: Dict[str, str] = field(default_factory=dict)
+    subscriber_count: int = 0
+    view_count: int = 0
+    video_count: int = 0
+    country: str = ""
+    published_at: Optional[datetime] = None
+
+
+@dataclass
+class YouTubeVideo:
+    """A YouTube video (`model/youtube/types.go:23-36`)."""
+
+    id: str = ""
+    channel_id: str = ""
+    title: str = ""
+    description: str = ""
+    published_at: Optional[datetime] = None
+    view_count: int = 0
+    like_count: int = 0
+    comment_count: int = 0
+    duration: str = ""
+    thumbnails: Dict[str, str] = field(default_factory=dict)
+    tags: List[str] = field(default_factory=list)
+    language: str = ""

@@ -1,0 +1,5 @@
+"""Shared utilities: structured logging, time parsing, metrics, file janitor."""
+
+from .timeparse import parse_date_between, parse_duration, parse_time_ago
+
+__all__ = ["parse_time_ago", "parse_date_between", "parse_duration"]

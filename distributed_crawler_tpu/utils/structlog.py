@@ -1,0 +1,73 @@
+"""Structured logging: zerolog-equivalent tagged JSON log lines.
+
+Parity with the reference's zerolog usage (`main.go:56,186-200`): level from
+config, console or JSON writer, Unix timestamps, and greppable ``log_tag``
+domain streams (``rw_pool``, ``rw_channel``, ``rw_lookup_stats``, ...).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from typing import Any, Dict, Optional
+
+_RESERVED = set(logging.LogRecord("", 0, "", 0, "", (), None).__dict__) | {"message", "asctime"}
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line, zerolog-style: level, ts (unix), message, extras."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out: Dict[str, Any] = {
+            "level": record.levelname.lower(),
+            "ts": int(time.time()),
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        for k, v in record.__dict__.items():
+            if k not in _RESERVED and not k.startswith("_"):
+                out[k] = v
+        if record.exc_info and record.exc_info[0] is not None:
+            out["error"] = self.formatException(record.exc_info)
+        return json.dumps(out, ensure_ascii=False, default=str)
+
+
+class ConsoleFormatter(logging.Formatter):
+    """Human console writer with inline key=value extras."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        extras = " ".join(
+            f"{k}={v}" for k, v in record.__dict__.items()
+            if k not in _RESERVED and not k.startswith("_")
+        )
+        base = f"{self.formatTime(record, '%H:%M:%S')} {record.levelname:<5} {record.name}: {record.getMessage()}"
+        return f"{base} {extras}" if extras else base
+
+
+def setup_logging(level: str = "info", json_output: bool = False,
+                  stream=None) -> logging.Logger:
+    """Configure the 'dct' logger tree; returns the root 'dct' logger."""
+    logger = logging.getLogger("dct")
+    logger.setLevel(getattr(logging, level.upper(), logging.INFO))
+    logger.handlers.clear()
+    handler = logging.StreamHandler(stream or sys.stderr)
+    handler.setFormatter(JsonFormatter() if json_output else ConsoleFormatter())
+    logger.addHandler(handler)
+    logger.propagate = False
+    return logger
+
+
+def tagged(logger: logging.Logger, log_tag: str, **fields) -> "logging.LoggerAdapter":
+    """A LoggerAdapter that stamps every record with a log_tag domain stream."""
+    merged = {"log_tag": log_tag, **fields}
+
+    class _Adapter(logging.LoggerAdapter):
+        def process(self, msg, kwargs):
+            extra = dict(merged)
+            extra.update(kwargs.get("extra") or {})
+            kwargs["extra"] = extra
+            return msg, kwargs
+
+    return _Adapter(logger, merged)

@@ -1,0 +1,16 @@
+"""Test harness: force an 8-device virtual CPU mesh before jax initializes.
+
+Mirrors the reference's hermetic test strategy (SURVEY.md §4): no real
+Telegram/YouTube/bus/DB — and, new for the TPU build, no real TPU: multi-chip
+code paths run against a virtual 8-device CPU backend so sharding logic is
+exercised in CI.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test session.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,204 @@
+"""Config layer tests: precedence chain, sampling matrix, time parsing,
+distributed config validation (reference analogs: main_test.go,
+common/validation_test.go)."""
+
+from datetime import datetime, timezone
+
+import pytest
+
+from distributed_crawler_tpu.config import (
+    ConfigResolver,
+    CrawlerConfig,
+    DistributedConfig,
+    SamplingValidationInput,
+    TelegramRateLimitConfig,
+    generate_crawl_id,
+    read_urls_from_file,
+    validate_sampling_method,
+)
+from distributed_crawler_tpu.utils import parse_date_between, parse_duration, parse_time_ago
+
+
+class TestRateLimitDefaults:
+    def test_defaults_match_reference(self):
+        # common/utils.go:35-46
+        rl = TelegramRateLimitConfig()
+        assert rl.get_chat_history_rate == 30
+        assert rl.search_public_chat_rate == 6
+        assert rl.get_supergroup_info_rate == 20
+        assert rl.get_message_server_hit_rate == 60
+        assert rl.get_chat_history_jitter_ms == 500
+        assert rl.search_public_chat_jitter_ms == 1500
+
+
+class TestCrawlerConfig:
+    def test_defaults(self):
+        cfg = CrawlerConfig()
+        assert cfg.max_pages == 108000  # main.go:776
+        assert cfg.combine_trigger_size == 170 * 1024 * 1024
+        assert cfg.combine_hard_cap == 200 * 1024 * 1024
+        assert cfg.validator_claim_batch_size == 10
+        assert cfg.inference.batch_size == 256
+
+    def test_crawl_id_format(self):
+        cid = generate_crawl_id(datetime(2026, 7, 29, 1, 2, 3, tzinfo=timezone.utc))
+        assert cid == "20260729010203"
+        assert len(cid) == 14
+
+
+class TestReadURLs:
+    def test_skips_comments_and_blanks(self, tmp_path):
+        f = tmp_path / "urls.txt"
+        f.write_text("https://t.me/a\n\n# comment\n  https://t.me/b  \n")
+        assert read_urls_from_file(str(f)) == ["https://t.me/a", "https://t.me/b"]
+
+
+class TestSamplingValidation:
+    def _inp(self, **kw):
+        base = dict(platform="telegram", sampling_method="channel",
+                    url_list=["https://t.me/x"])
+        base.update(kw)
+        return SamplingValidationInput(**base)
+
+    def test_valid_matrix(self):
+        for platform, method in [("telegram", "channel"), ("telegram", "snowball"),
+                                 ("youtube", "channel"), ("youtube", "snowball")]:
+            validate_sampling_method(self._inp(platform=platform, sampling_method=method))
+
+    def test_youtube_random_needs_no_urls(self):
+        validate_sampling_method(self._inp(platform="youtube", sampling_method="random",
+                                           url_list=[]))
+
+    def test_telegram_random_unsupported(self):
+        with pytest.raises(ValueError, match="not supported"):
+            validate_sampling_method(self._inp(sampling_method="random"))
+
+    def test_youtube_random_walk_unsupported(self):
+        with pytest.raises(ValueError, match="not supported"):
+            validate_sampling_method(self._inp(platform="youtube",
+                                               sampling_method="random-walk"))
+
+    def test_unknown_platform(self):
+        with pytest.raises(ValueError, match="unsupported platform"):
+            validate_sampling_method(self._inp(platform="tiktok"))
+
+    def test_random_walk_exactly_one_seed_source(self):
+        validate_sampling_method(self._inp(sampling_method="random-walk", seed_size=0))
+        validate_sampling_method(self._inp(sampling_method="random-walk",
+                                           url_list=[], seed_size=5))
+        with pytest.raises(ValueError, match="not both or neither"):
+            validate_sampling_method(self._inp(sampling_method="random-walk", seed_size=5))
+        with pytest.raises(ValueError, match="not both or neither"):
+            validate_sampling_method(self._inp(sampling_method="random-walk",
+                                               url_list=[], seed_size=0))
+
+    def test_random_walk_crawl_id_length(self):
+        with pytest.raises(ValueError, match="32 characters"):
+            validate_sampling_method(self._inp(sampling_method="random-walk",
+                                               crawl_id="x" * 33))
+
+    def test_channel_requires_urls_except_job_mode(self):
+        with pytest.raises(ValueError, match="requires URLs"):
+            validate_sampling_method(self._inp(url_list=[]))
+        validate_sampling_method(self._inp(url_list=[], mode="job"))
+
+
+class TestPrecedence:
+    def test_flag_beats_env_beats_file_beats_default(self, tmp_path, monkeypatch):
+        cfg_file = tmp_path / "config.yaml"
+        cfg_file.write_text("crawler:\n  concurrency: 3\n  storage: /from/file\n")
+        env = {"CRAWLER_CRAWLER_CONCURRENCY": "7"}
+        r = ConfigResolver(flags={"crawler.concurrency": 9}, env=env,
+                           config_file=str(cfg_file),
+                           defaults={"crawler": {"concurrency": 1, "maxpages": 108000}})
+        assert r.get_int("crawler.concurrency") == 9
+        r2 = ConfigResolver(flags={}, env=env, config_file=str(cfg_file),
+                            defaults={"crawler": {"concurrency": 1}})
+        assert r2.get_int("crawler.concurrency") == 7
+        r3 = ConfigResolver(flags={}, env={}, config_file=str(cfg_file),
+                            defaults={"crawler": {"concurrency": 1}})
+        assert r3.get_int("crawler.concurrency") == 3
+        assert r3.get_str("crawler.storage") == "/from/file"
+        assert r3.get_int("crawler.maxpages", 108000) == 108000
+
+    def test_missing_explicit_config_file_raises(self):
+        with pytest.raises(FileNotFoundError):
+            ConfigResolver(config_file="/no/such/config.yaml")
+
+    def test_unset_flag_falls_through(self):
+        r = ConfigResolver(flags={"a.b": None}, env={}, search_paths=(),
+                           defaults={"a": {"b": 5}})
+        assert r.get_int("a.b") == 5
+
+    def test_bool_and_list_coercion(self):
+        r = ConfigResolver(flags={}, env={"CRAWLER_X_FLAG": "true",
+                                          "CRAWLER_X_URLS": "a, b,c"},
+                           search_paths=())
+        assert r.get_bool("x.flag") is True
+        assert r.get_list("x.urls") == ["a", "b", "c"]
+
+
+class TestTimeParse:
+    def test_time_ago_units(self):
+        now = datetime(2026, 7, 29, 12, 0, 0, tzinfo=timezone.utc)
+        assert parse_time_ago("6h", now) == datetime(2026, 7, 29, 6, 0, tzinfo=timezone.utc)
+        assert parse_time_ago("30d", now) == datetime(2026, 6, 29, 12, 0, tzinfo=timezone.utc)
+        assert parse_time_ago("2w", now) == datetime(2026, 7, 15, 12, 0, tzinfo=timezone.utc)
+        assert parse_time_ago("1m", now) == datetime(2026, 6, 29, 12, 0, tzinfo=timezone.utc)
+        assert parse_time_ago("1y", now) == datetime(2025, 7, 29, 12, 0, tzinfo=timezone.utc)
+        assert parse_time_ago("") is None
+
+    def test_time_ago_invalid(self):
+        with pytest.raises(ValueError):
+            parse_time_ago("abc")
+        with pytest.raises(ValueError):
+            parse_time_ago("10x")
+
+    def test_date_between(self):
+        lo, hi = parse_date_between("2025-01-01,2025-06-30")
+        assert lo == datetime(2025, 1, 1, tzinfo=timezone.utc)
+        assert hi == datetime(2025, 6, 30, tzinfo=timezone.utc)
+        with pytest.raises(ValueError, match="before max"):
+            parse_date_between("2025-06-30,2025-01-01")
+        with pytest.raises(ValueError, match="format"):
+            parse_date_between("2025-01-01")
+
+    def test_duration(self):
+        assert parse_duration("2h45m") == 2 * 3600 + 45 * 60
+        assert parse_duration("90s") == 90
+        assert parse_duration("500ms") == 0.5
+        with pytest.raises(ValueError):
+            parse_duration("nope")
+
+
+class TestDistributedConfig:
+    def test_defaults_match_reference(self):
+        # config/distributed.go:54-79
+        c = DistributedConfig()
+        assert c.heartbeat_interval_s == 30
+        assert c.work_timeout_s == 600
+        assert c.worker_timeout_s == 180
+        assert c.retry_attempts == 3
+        assert c.work_distribution_interval_s == 5
+        assert c.bus.work_queue_topic == "crawl-work-queue"
+        assert c.bus.results_topic == "crawl-results"
+        assert c.bus.worker_status_topic == "worker-status"
+        assert c.bus.orchestrator_topic == "orchestrator-commands"
+        c.validate()
+
+    def test_worker_mode_requires_id(self):
+        c = DistributedConfig(mode="worker")
+        with pytest.raises(ValueError, match="worker_id"):
+            c.validate()
+        c.worker_id = "w1"
+        c.validate()
+
+    def test_invalid_mode(self):
+        with pytest.raises(ValueError, match="invalid mode"):
+            DistributedConfig(mode="bogus").validate()
+
+    def test_numeric_validation(self):
+        with pytest.raises(ValueError):
+            DistributedConfig(max_workers_per_node=0).validate()
+        with pytest.raises(ValueError):
+            DistributedConfig(heartbeat_interval_s=0).validate()

@@ -1,0 +1,178 @@
+"""Data-model golden-JSON tests (reference test analog: model round-trips +
+null_handler behavior, SURVEY.md §4)."""
+
+import json
+from datetime import datetime, timezone
+
+from distributed_crawler_tpu.datamodel import (
+    Behavior,
+    ChannelData,
+    Comment,
+    EngagementData,
+    FieldRule,
+    NullValidator,
+    Post,
+    default_configs,
+    load_config_from_json,
+    merge_configs,
+)
+from distributed_crawler_tpu.datamodel.post import ZERO_TIME_STR, format_time, parse_time
+
+EXPECTED_POST_FIELDS = [
+    "post_link", "channel_id", "post_uid", "url", "published_at", "created_at",
+    "language_code", "engagement", "view_count", "like_count", "share_count",
+    "comment_count", "crawl_label", "list_ids", "channel_name", "search_terms",
+    "search_term_ids", "project_ids", "exercise_ids", "label_data",
+    "labels_metadata", "project_labeled_post_ids", "labeler_ids", "all_labels",
+    "label_ids", "is_ad", "transcript_text", "image_text", "video_length",
+    "is_verified", "channel_data", "platform_name", "shared_id", "quoted_id",
+    "replied_id", "ai_label", "root_post_id", "engagement_steps_count",
+    "ocr_data", "performance_scores", "has_embed_media", "description",
+    "repost_channel_data", "post_type", "inner_link", "post_title", "media_data",
+    "is_reply", "ad_fields", "likes_count", "shares_count", "comments_count",
+    "views_count", "searchable_text", "all_text", "contrast_agent_project_ids",
+    "agent_ids", "segment_ids", "thumb_url", "media_url", "comments",
+    "reactions", "outlinks", "capture_time", "handle",
+]
+
+
+def make_post(**kw) -> Post:
+    base = dict(
+        post_link="https://t.me/somechannel/42",
+        channel_id="somechannel",
+        post_uid="42",
+        url="https://t.me/somechannel/42",
+        published_at=datetime(2026, 1, 2, 3, 4, 5, tzinfo=timezone.utc),
+        platform_name="telegram",
+        channel_data=ChannelData(
+            channel_id="somechannel",
+            channel_name="Some Channel",
+            channel_url="https://t.me/somechannel",
+        ),
+        description="hello world",
+    )
+    base.update(kw)
+    return Post(**base)
+
+
+class TestPostSchema:
+    def test_exact_wire_fields(self):
+        # Field-for-field parity with model/data.go:9-75 (65 top-level JSON keys).
+        d = make_post().to_dict()
+        assert list(d.keys()) == EXPECTED_POST_FIELDS
+
+    def test_json_roundtrip(self):
+        p = make_post(
+            comments=[Comment(text="hi", reactions={"👍": 3}, view_count=5)],
+            reactions={"❤": 2},
+            outlinks=["other_channel"],
+            video_length=120,
+            is_verified=True,
+            capture_time=datetime(2026, 2, 2, tzinfo=timezone.utc),
+        )
+        p2 = Post.from_json(p.to_json())
+        assert p2 == p
+
+    def test_zero_time_serialization(self):
+        d = make_post(created_at=None).to_dict()
+        assert d["created_at"] == ZERO_TIME_STR
+        assert parse_time(ZERO_TIME_STR) is None
+        assert format_time(None) == ZERO_TIME_STR
+
+    def test_nanosecond_timestamps_parse(self):
+        # Go RFC3339Nano emits >6 fractional digits; must not be dropped.
+        dt = parse_time("2026-01-02T03:04:05.123456789Z")
+        assert dt is not None and dt.microsecond == 123456
+
+    def test_from_dict_tolerates_missing_keys(self):
+        p = Post.from_dict({"post_link": "x"})
+        assert p.post_link == "x"
+        assert p.comments == [] and p.reactions == {}
+
+    def test_text_for_inference_priority(self):
+        p = make_post(all_text="A", searchable_text="S", description="D")
+        assert p.text_for_inference() == "A"
+        p = make_post(all_text="", searchable_text="S")
+        assert p.text_for_inference() == "S"
+        p = make_post(description="D")
+        assert p.text_for_inference() == "D"
+
+
+class TestNullValidator:
+    def test_valid_post_passes(self):
+        v = NullValidator("telegram")
+        res = v.validate_post(make_post())
+        assert res.valid
+        assert res.errors == []
+        # Platform-unavailable fields are tracked, not errors.
+        assert "language_code" in res.unavailable_used
+
+    def test_missing_critical_fails(self):
+        v = NullValidator("telegram")
+        res = v.validate_post(make_post(post_uid=""))
+        assert not res.valid
+        assert "post_uid" in res.errors
+
+    def test_missing_critical_channel_field_fails(self):
+        v = NullValidator("youtube")
+        res = v.validate_channel_data(ChannelData(channel_name="n", channel_url="u"))
+        assert not res.valid
+        assert "channel_data.channel_id" in res.errors
+
+    def test_warnings_for_log_fields(self):
+        v = NullValidator("youtube")
+        res = v.validate_post(make_post(platform_name="youtube", description=""))
+        assert "description" in res.warnings
+
+    def test_null_log_events_emitted(self):
+        v = NullValidator("telegram")
+        res = v.validate_post(make_post())
+        assert res.null_log_events
+        ev = {e.field_name: e for e in res.null_log_events}
+        assert ev["language_code"].is_platform_limit is True
+        assert ev["language_code"].strategy_used == "unavailable"
+
+    def test_user_config_merge_overrides(self):
+        # null_handler/main.go:257-291: user rules override defaults.
+        cfg = merge_configs("youtube", {
+            "description": FieldRule(Behavior.CRITICAL, "Description is now critical!")})
+        v = NullValidator("youtube", config=cfg)
+        res = v.validate_post(make_post(platform_name="youtube", description=""))
+        assert not res.valid and "description" in res.errors
+
+    def test_load_config_from_json(self):
+        user_json = json.dumps({
+            "platform": "youtube",
+            "rules": {"channel_data.channel_description": {
+                "behavior": "critical", "message": "now critical"}},
+        })
+        cfg = load_config_from_json(user_json, "youtube")
+        assert cfg.rules["channel_data.channel_description"].behavior is Behavior.CRITICAL
+        # untouched defaults survive the merge
+        assert cfg.rules["post_link"].behavior is Behavior.CRITICAL
+
+    def test_unknown_platform_raises(self):
+        try:
+            merge_configs("myspace", None)
+            assert False, "expected ValueError"
+        except ValueError:
+            pass
+
+    def test_rule_tables_cover_both_platforms(self):
+        cfgs = default_configs()
+        for platform in ("telegram", "youtube"):
+            rules = cfgs[platform].rules
+            # Core critical set per null_handler/main.go:70-254.
+            for path in ("post_link", "channel_id", "post_uid", "url",
+                         "published_at", "platform_name",
+                         "channel_data.channel_id", "channel_data.channel_url"):
+                assert rules[path].behavior is Behavior.CRITICAL, (platform, path)
+            assert len(rules) > 60
+
+    def test_engagement_data_zero_fields_warn(self):
+        v = NullValidator("telegram")
+        res = v.validate_channel_data(ChannelData(
+            channel_id="c", channel_name="n", channel_url="u",
+            channel_engagement_data=EngagementData()))
+        assert res.valid
+        assert "channel_data.channel_engagement_data.follower_count" in res.warnings
