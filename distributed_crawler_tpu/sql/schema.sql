@@ -1,0 +1,128 @@
+-- ============================================================================
+-- Random-walk graph + tandem validator schema (sqlite-compatible DDL).
+--
+-- Table/column parity with the reference's PostgreSQL schemas
+-- (`sql/random-walk-schema.sql`, `sql/validator-schema.sql`); the TPU build
+-- runs these in-tree (sqlite by default, any DB-API engine via SqlBinding).
+-- Timestamps are ISO-8601 TEXT supplied by the application so the SQL is
+-- engine-neutral.
+-- ============================================================================
+
+-- One row per (source -> destination) edge observation; duplicates intended.
+CREATE TABLE IF NOT EXISTS edge_records (
+    edge_id             INTEGER PRIMARY KEY AUTOINCREMENT,
+    destination_channel TEXT    NOT NULL,
+    source_channel      TEXT    NOT NULL,
+    walkback            INTEGER NOT NULL,
+    skipped             INTEGER NOT NULL,
+    discovery_time      TEXT    NOT NULL,
+    crawl_id            TEXT    NOT NULL,
+    -- UUID shared across all edges in one uninterrupted forward chain;
+    -- a walkback starts a fresh chain (empty = tracking unused).
+    sequence_id         TEXT    NOT NULL DEFAULT ''
+);
+CREATE INDEX IF NOT EXISTS idx_edge_records_crawl_id ON edge_records (crawl_id);
+CREATE INDEX IF NOT EXISTS idx_edge_records_source_channel ON edge_records (source_channel);
+CREATE INDEX IF NOT EXISTS idx_edge_records_sequence_id ON edge_records (sequence_id)
+    WHERE sequence_id <> '';
+CREATE INDEX IF NOT EXISTS idx_edge_records_discovery_time ON edge_records (discovery_time);
+CREATE INDEX IF NOT EXISTS idx_edge_records_crawl_source ON edge_records (crawl_id, source_channel);
+
+-- Transient queue of pages for the next BFS/random-walk step (pod-scoped by crawl_id).
+CREATE TABLE IF NOT EXISTS page_buffer (
+    page_id     TEXT PRIMARY KEY,
+    parent_id   TEXT NOT NULL,
+    depth       INTEGER NOT NULL,
+    url         TEXT NOT NULL,
+    crawl_id    TEXT NOT NULL,
+    sequence_id TEXT NOT NULL DEFAULT ''
+);
+CREATE INDEX IF NOT EXISTS idx_page_buffer_crawl_id ON page_buffer (crawl_id);
+
+-- Seed pool + chat-ID cache + last-crawl watermark.
+CREATE TABLE IF NOT EXISTS seed_channels (
+    channel_username TEXT PRIMARY KEY,
+    chat_id          INTEGER,
+    last_crawled_at  TEXT,
+    invalidated_at   TEXT,
+    member_count     INTEGER,
+    inserted_at      TEXT NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_seed_channels_last_crawled ON seed_channels (last_crawled_at);
+CREATE INDEX IF NOT EXISTS idx_seed_channels_uncrawled ON seed_channels (inserted_at)
+    WHERE last_crawled_at IS NULL;
+
+-- Shared cache of usernames that failed validation (30-day TTL in app logic).
+CREATE TABLE IF NOT EXISTS invalid_channels (
+    channel_username TEXT PRIMARY KEY,
+    reason           TEXT NOT NULL DEFAULT '',
+    invalidated_at   TEXT NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_invalid_channels_invalidated_at ON invalid_channels (invalidated_at);
+
+-- One row per source channel crawled in tandem mode.
+-- status: open -> closed -> processing -> completed
+CREATE TABLE IF NOT EXISTS pending_edge_batches (
+    batch_id       TEXT PRIMARY KEY,
+    crawl_id       TEXT NOT NULL,
+    source_channel TEXT NOT NULL,
+    source_page_id TEXT NOT NULL,
+    source_depth   INTEGER NOT NULL,
+    sequence_id    TEXT NOT NULL DEFAULT '',
+    status         TEXT NOT NULL DEFAULT 'open',
+    attempt_count  INTEGER NOT NULL DEFAULT 0,
+    created_at     TEXT NOT NULL,
+    closed_at      TEXT,
+    claimed_at     TEXT,
+    completed_at   TEXT
+);
+CREATE INDEX IF NOT EXISTS idx_pending_batches_status ON pending_edge_batches (status, created_at);
+CREATE INDEX IF NOT EXISTS idx_pending_batches_crawl_incomplete ON pending_edge_batches (crawl_id)
+    WHERE status <> 'completed';
+
+-- One row per extracted username, streamed by the crawler; claimed by validators.
+CREATE TABLE IF NOT EXISTS pending_edges (
+    pending_id          INTEGER PRIMARY KEY AUTOINCREMENT,
+    batch_id            TEXT NOT NULL REFERENCES pending_edge_batches(batch_id),
+    crawl_id            TEXT NOT NULL,
+    destination_channel TEXT NOT NULL,
+    source_channel      TEXT NOT NULL,
+    sequence_id         TEXT NOT NULL DEFAULT '',
+    discovery_time      TEXT NOT NULL,
+    source_type         TEXT NOT NULL DEFAULT '',
+    validation_status   TEXT NOT NULL DEFAULT 'pending',
+    validation_reason   TEXT NOT NULL DEFAULT '',
+    validated_at        TEXT
+);
+CREATE INDEX IF NOT EXISTS idx_pending_edges_batch ON pending_edges (batch_id);
+CREATE INDEX IF NOT EXISTS idx_pending_edges_pending ON pending_edges (discovery_time)
+    WHERE validation_status = 'pending';
+
+-- Aggregated hit/miss counts per extraction source type, per crawl.
+CREATE TABLE IF NOT EXISTS source_type_stats (
+    crawl_id    TEXT NOT NULL,
+    source_type TEXT NOT NULL,
+    total       INTEGER NOT NULL DEFAULT 0,
+    valid       INTEGER NOT NULL DEFAULT 0,
+    not_channel INTEGER NOT NULL DEFAULT 0,
+    invalid     INTEGER NOT NULL DEFAULT 0,
+    duplicate   INTEGER NOT NULL DEFAULT 0,
+    PRIMARY KEY (crawl_id, source_type)
+);
+
+-- DB-backed first-discovery dedup: PK serializes concurrent claims so
+-- exactly one crawl wins per channel across history.
+CREATE TABLE IF NOT EXISTS discovered_channels (
+    channel_username TEXT NOT NULL,
+    crawl_id         TEXT NOT NULL,
+    discovered_at    TEXT NOT NULL,
+    PRIMARY KEY (channel_username)
+);
+
+-- Append-only log of validator-detected IP blocks; an external process polls
+-- this to trigger IP rotation.
+CREATE TABLE IF NOT EXISTS access_events (
+    id          INTEGER PRIMARY KEY AUTOINCREMENT,
+    reason      TEXT NOT NULL,
+    occurred_at TEXT NOT NULL
+);

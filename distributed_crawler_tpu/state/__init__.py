@@ -1,0 +1,71 @@
+"""State & storage layer: crawl state, posts/files, media cache, random-walk graph.
+
+Parity with the reference's `state/` package (SURVEY.md §2 row "State interface
++ base" through "Dapr state manager"):
+- `StateManager` ABC — the ~50-method `StateManagementInterface`
+  (`state/interface.go:16-220`)
+- `BaseStateManager` — in-memory layers/pages with URL dedup + max-pages
+  deadend replacement (`state/base.go`)
+- `LocalStateManager` — filesystem provider (`state/storageproviders.go`)
+- `SqlGraphStore` — the random-walk graph + tandem validator queue the
+  reference kept in PostgreSQL behind a Dapr binding (`state/daprstate.go:
+  3076-4391`), here an in-tree SQL store with atomic claim semantics
+- `ShardedMediaCache` — index + 5000-item shards + 30-day expiry
+  (`state/daprstate.go:1252-1680`)
+- `CompositeStateManager` — the full-featured manager combining all of the
+  above (the `DaprStateManager` equivalent)
+- `create_state_manager` factory (`state/statefactory.go`), replaceable for
+  test mocking.
+"""
+
+from .base import BaseStateManager
+from .composite import CompositeStateManager
+from .datamodels import (
+    CrawlMetadata,
+    DiscoveredChannels,
+    EdgeRecord,
+    Layer,
+    MediaCacheItem,
+    Message,
+    Page,
+    PendingEdge,
+    PendingEdgeBatch,
+    PendingEdgeUpdate,
+    State,
+)
+from .factory import create_state_manager, get_factory, set_factory
+from .interface import LocalConfig, SqlConfig, StateConfig, StateManager
+from .local import LocalStateManager
+from .media_cache import ShardedMediaCache
+from .providers import LocalStorageProvider, StorageProvider
+from .sqlstore import SqliteBinding, SqlBinding, SqlGraphStore
+
+__all__ = [
+    "StateManager",
+    "StateConfig",
+    "LocalConfig",
+    "SqlConfig",
+    "BaseStateManager",
+    "LocalStateManager",
+    "CompositeStateManager",
+    "ShardedMediaCache",
+    "StorageProvider",
+    "LocalStorageProvider",
+    "SqlBinding",
+    "SqliteBinding",
+    "SqlGraphStore",
+    "create_state_manager",
+    "set_factory",
+    "get_factory",
+    "Page",
+    "Message",
+    "Layer",
+    "State",
+    "CrawlMetadata",
+    "EdgeRecord",
+    "PendingEdge",
+    "PendingEdgeBatch",
+    "PendingEdgeUpdate",
+    "MediaCacheItem",
+    "DiscoveredChannels",
+]

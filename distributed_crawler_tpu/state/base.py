@@ -1,0 +1,301 @@
+"""In-memory base state manager.
+
+Parity with the reference's `BaseStateManager` (`state/base.go:15-552`):
+layer map + page map behind a lock, URL dedup + max-pages deadend-replacement
+in add_layer, message status tracking, crawl metadata, incomplete-crawl
+detection, and the in-memory discovered-channels set.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from datetime import datetime
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..datamodel import ChannelData, Post
+from .datamodels import (
+    PAGE_DEADEND,
+    PAGE_FETCHED,
+    CrawlMetadata,
+    DiscoveredChannels,
+    EdgeRecord,
+    Layer,
+    Message,
+    Page,
+    State,
+    new_id,
+    utcnow,
+)
+from .interface import StateConfig, StateManager
+
+logger = logging.getLogger("dct.state")
+
+
+class BaseStateManager(StateManager):
+    """Common in-memory state shared by all backends (`state/base.go`)."""
+
+    def __init__(self, config: StateConfig):
+        self.config = config
+        self._lock = threading.RLock()
+        self.metadata = CrawlMetadata(
+            crawl_id=config.crawl_id,
+            execution_id=config.crawl_execution_id,
+            start_time=utcnow(),
+            status="running",
+            platform=config.platform,
+        )
+        self.last_updated = utcnow()
+        # depth -> [page IDs]
+        self.layer_map: Dict[int, List[str]] = {}
+        # page ID -> Page
+        self.page_map: Dict[str, Page] = {}
+        self.discovered_channels = DiscoveredChannels()
+        self.edge_records: List[EdgeRecord] = []
+
+    # --- lifecycle -------------------------------------------------------
+    def initialize(self, seed_urls: List[str]) -> None:
+        """Create the depth-0 layer from seeds (`state/base.go:54-93`)."""
+        with self._lock:
+            self.layer_map.setdefault(0, [])
+            for url in seed_urls:
+                page = Page(id=new_id(), url=url, depth=0, timestamp=utcnow(),
+                            platform=self.config.platform)
+                if self.config.sampling_method == "random-walk":
+                    # Each seed starts its own chain.
+                    page.sequence_id = new_id()
+                    self.discovered_channels.add(url)
+                self.page_map[page.id] = page
+                self.layer_map[0].append(page.id)
+        logger.info("initialized state with %d seed URLs", len(seed_urls))
+
+    def save_state(self) -> None:
+        return None  # persistence is backend-specific
+
+    def close(self) -> None:
+        return None
+
+    # --- pages -----------------------------------------------------------
+    def get_page(self, page_id: str) -> Page:
+        with self._lock:
+            page = self.page_map.get(page_id)
+            if page is None:
+                raise KeyError(f"page with ID {page_id} not found")
+            return page
+
+    def update_page(self, page: Page) -> None:
+        with self._lock:
+            self.page_map[page.id] = page
+            ids = self.layer_map.get(page.depth)
+            if ids is not None and page.id not in ids:
+                ids.append(page.id)
+
+    def update_message(self, page_id: str, chat_id: int, message_id: int,
+                       status: str) -> None:
+        """Set a message's status, appending it if new (`state/base.go:182-215`)."""
+        with self._lock:
+            page = self.page_map.get(page_id)
+            if page is None:
+                raise KeyError(f"page with ID {page_id} not found")
+            for m in page.messages:
+                if m.chat_id == chat_id and m.message_id == message_id:
+                    m.status = status
+                    return
+            page.messages.append(Message(chat_id=chat_id, message_id=message_id,
+                                         status=status, page_id=page_id))
+
+    # --- layers ----------------------------------------------------------
+    def add_layer(self, pages: List[Page]) -> None:
+        """Add pages at one depth with URL dedup and the max-pages
+        deadend-replacement policy (`state/base.go:219-322`)."""
+        if not pages:
+            return
+        with self._lock:
+            total_existing = len(self.page_map)
+            deadend_count = sum(1 for p in self.page_map.values()
+                                if p.status == PAGE_DEADEND)
+            max_pages = self.config.max_pages
+            max_reached = max_pages > 0 and total_existing >= max_pages
+            if max_reached:
+                logger.info(
+                    "maximum page limit reached (%d/%d), only adding replacements "
+                    "for %d deadend pages", total_existing, max_pages, deadend_count)
+
+            # Random-walk deliberately allows revisiting a URL — a walk may
+            # legitimately return to a channel (`daprstate.go:648-656`).
+            dedup_urls = self.config.sampling_method != "random-walk"
+            existing_urls = {p.url: pid for pid, p in self.page_map.items()}
+            depth = pages[0].depth
+            self.layer_map.setdefault(depth, [])
+            replacements_available = deadend_count
+            added = 0
+            for page in pages:
+                if dedup_urls and page.url in existing_urls:
+                    continue
+                if max_reached:
+                    if replacements_available <= 0:
+                        continue
+                    replacements_available -= 1
+                if not page.id:
+                    page.id = new_id()
+                if page.timestamp is None:
+                    page.timestamp = utcnow()
+                self.page_map[page.id] = page
+                existing_urls[page.url] = page.id
+                self.layer_map[depth].append(page.id)
+                added += 1
+            logger.debug("added %d unique pages to depth %d (filtered %d duplicates)",
+                         added, depth, len(pages) - added)
+
+    def get_layer_by_depth(self, depth: int) -> List[Page]:
+        with self._lock:
+            ids = self.layer_map.get(depth, [])
+            return [self.page_map[i] for i in ids if i in self.page_map]
+
+    def get_max_depth(self) -> int:
+        with self._lock:
+            if not self.layer_map:
+                raise LookupError("no layers found")
+            return max(self.layer_map)
+
+    def export_pages_to_binding(self, crawl_id: str) -> None:
+        return None  # backend-specific
+
+    # --- state snapshot --------------------------------------------------
+    def get_state(self) -> State:
+        """Snapshot (`state/base.go:345-372`)."""
+        with self._lock:
+            layers = [
+                Layer(depth=d, pages=[self.page_map[i] for i in ids if i in self.page_map])
+                for d, ids in sorted(self.layer_map.items())
+            ]
+            return State(layers=layers, metadata=self.metadata,
+                         last_updated=self.last_updated)
+
+    def set_state(self, state: State) -> None:
+        """Replace in-memory state (`state/base.go:375-397`)."""
+        with self._lock:
+            self.metadata = state.metadata
+            self.last_updated = utcnow()
+            self.layer_map = {}
+            self.page_map = {}
+            for layer in state.layers:
+                self.layer_map[layer.depth] = []
+                for page in layer.pages:
+                    self.page_map[page.id] = page
+                    self.layer_map[layer.depth].append(page.id)
+
+    # --- crawl management ------------------------------------------------
+    def get_previous_crawls(self) -> List[str]:
+        with self._lock:
+            return list(self.metadata.previous_crawl_id)
+
+    def update_crawl_metadata(self, crawl_id: str, metadata: Dict[str, Any]) -> None:
+        """`state/base.go:408-443`."""
+        with self._lock:
+            if self.metadata.crawl_id != crawl_id:
+                raise ValueError("cannot update metadata for a different crawl ID")
+            for key, value in metadata.items():
+                if key == "status" and isinstance(value, str):
+                    self.metadata.status = value
+                elif key == "endTime":
+                    from ..datamodel.post import parse_time
+                    if isinstance(value, datetime):
+                        self.metadata.end_time = value
+                    elif isinstance(value, str):
+                        self.metadata.end_time = parse_time(value)
+                elif key == "previousCrawlID":
+                    if isinstance(value, str):
+                        self.metadata.previous_crawl_id.append(value)
+                    elif isinstance(value, list):
+                        self.metadata.previous_crawl_id.extend(value)
+                elif key == "messagesCount" and isinstance(value, int):
+                    self.metadata.messages_count = value
+                elif key == "errorsCount" and isinstance(value, int):
+                    self.metadata.errors_count = value
+            self.last_updated = utcnow()
+
+    def find_incomplete_crawl(self, crawl_id: str) -> Tuple[str, bool]:
+        """`state/base.go:466-516`: incomplete if status != completed, or any
+        page isn't fetched."""
+        with self._lock:
+            if self.metadata.crawl_id == crawl_id:
+                if self.metadata.status != "completed" and self.metadata.execution_id:
+                    return self.metadata.execution_id, True
+                for ids in self.layer_map.values():
+                    for pid in ids:
+                        page = self.page_map.get(pid)
+                        if page is not None and page.status != PAGE_FETCHED:
+                            return self.metadata.execution_id, True
+            return "", False
+
+    # --- media cache (backend-specific; in-memory default) ----------------
+    def has_processed_media(self, media_id: str) -> bool:
+        return False
+
+    def mark_media_as_processed(self, media_id: str) -> None:
+        return None
+
+    # --- post/file storage (backend-specific) -----------------------------
+    def store_post(self, channel_id: str, post: Post) -> None:
+        raise NotImplementedError
+
+    def store_file(self, channel_id: str, source_file_path: str,
+                   file_name: str) -> Tuple[str, str]:
+        raise NotImplementedError
+
+    # --- discovered channels ----------------------------------------------
+    def initialize_discovered_channels(self) -> None:
+        return None
+
+    def _random_walk_pick(self) -> str:
+        """Source of random seed candidates; backends override."""
+        return self.get_random_discovered_channel()
+
+    def initialize_random_walk_layer(self) -> None:
+        """Seed layer 0 (each seed starting its own chain) with seed_size
+        distinct random channels from `_random_walk_pick`."""
+        picks: List[str] = []
+        seen = set()
+        want = self.config.seed_size
+        attempts = 0
+        while len(picks) < want and attempts < want * 20 + 20:
+            attempts += 1
+            try:
+                c = self._random_walk_pick()
+            except LookupError:
+                break
+            if c not in seen:
+                seen.add(c)
+                picks.append(c)
+        if picks:
+            BaseStateManager.initialize(self, picks)
+
+    def get_random_discovered_channel(self) -> str:
+        return self.discovered_channels.random()
+
+    def is_discovered_channel(self, channel_id: str) -> bool:
+        return self.discovered_channels.contains(channel_id)
+
+    def add_discovered_channel(self, channel_id: str) -> None:
+        self.discovered_channels.add(channel_id)
+
+    def store_channel_data(self, channel_id: str, channel_data: ChannelData) -> None:
+        return None
+
+    # --- random-walk graph (in-memory default) ----------------------------
+    def save_edge_records(self, edges: List[EdgeRecord]) -> None:
+        with self._lock:
+            self.edge_records.extend(edges)
+
+    def get_pages_from_page_buffer(self, limit: int) -> List[Page]:
+        raise NotImplementedError
+
+    def execute_database_operation(self, sql_query: str, params: List[Any]) -> None:
+        raise NotImplementedError
+
+    def add_page_to_page_buffer(self, page: Page) -> None:
+        raise NotImplementedError
+
+    def delete_page_buffer_pages(self, page_ids: List[str], page_urls: List[str]) -> None:
+        raise NotImplementedError

@@ -1,0 +1,44 @@
+"""Config-driven state-manager selection.
+
+Parity with `state/statefactory.go:11-52`: LocalConfig -> LocalStateManager,
+SqlConfig (or default) -> CompositeStateManager.  The factory function is a
+module-level variable so tests can swap it, exactly like the reference's
+`NewStateManagerFactory` package var (`statefactory.go:11`) mocked in
+`standalone/runner_test.go`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from .composite import CompositeStateManager
+from .interface import StateConfig, StateManager
+from .local import LocalStateManager
+
+
+def _default_factory(config: StateConfig) -> StateManager:
+    if config.local is not None and config.sql is None:
+        return LocalStateManager(config)
+    return CompositeStateManager(config)
+
+
+_factory: Callable[[StateConfig], StateManager] = _default_factory
+
+
+def create_state_manager(config: StateConfig) -> StateManager:
+    return _factory(config)
+
+
+def set_factory(factory: Callable[[StateConfig], StateManager]) -> None:
+    """Swap the factory (test hook); pass `None` via reset_factory instead."""
+    global _factory
+    _factory = factory
+
+
+def get_factory() -> Callable[[StateConfig], StateManager]:
+    return _factory
+
+
+def reset_factory() -> None:
+    global _factory
+    _factory = _default_factory

@@ -1,0 +1,261 @@
+"""The state-management interface every backend implements.
+
+Parity with the reference's `StateManagementInterface`
+(`state/interface.go:16-220`): initialization/resume, page+layer ops, post and
+file storage, media cache, random-walk graph ops, tandem validator queue ops,
+and edge repair.  Method names are the snake_case forms of the reference's.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..datamodel import ChannelData, Post
+from .datamodels import (
+    EdgeRecord,
+    Page,
+    PendingEdge,
+    PendingEdgeBatch,
+    PendingEdgeUpdate,
+)
+
+
+@dataclass
+class LocalConfig:
+    """Local-filesystem backend config (`state/interface.go:324-328`)."""
+
+    base_path: str = ""
+
+
+@dataclass
+class SqlConfig:
+    """SQL graph-store config — replaces the reference's Dapr postgres binding
+    (`state/interface.go:306-320`).  ``url`` is a sqlite path (default) or a
+    DB-API connection string for an external engine; ":memory:" for tests."""
+
+    url: str = ""
+    echo_sql: bool = False
+
+
+@dataclass
+class StateConfig:
+    """Common config for all state managers (`state/interface.go:243-290`)."""
+
+    storage_root: str = ""
+    crawl_id: str = ""
+    crawl_label: str = ""
+    crawl_execution_id: str = ""
+    platform: str = "telegram"
+    sampling_method: str = "channel"
+    seed_size: int = 0
+    max_pages: int = 0  # 0 = unlimited
+    local: Optional[LocalConfig] = None
+    sql: Optional[SqlConfig] = None
+    combine_files: bool = False
+    combine_watch_dir: str = ""
+    combine_temp_dir: str = ""
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+class StateManager(abc.ABC):
+    """Abstract state manager (`state/interface.go:16-220`)."""
+
+    # --- lifecycle -------------------------------------------------------
+    @abc.abstractmethod
+    def initialize(self, seed_urls: List[str]) -> None:
+        """Set up state with seed data or load existing state."""
+
+    @abc.abstractmethod
+    def save_state(self) -> None:
+        """Persist current state to the backend."""
+
+    @abc.abstractmethod
+    def close(self) -> None:
+        """Cleanup on shutdown."""
+
+    # --- pages / layers --------------------------------------------------
+    @abc.abstractmethod
+    def get_page(self, page_id: str) -> Page: ...
+
+    @abc.abstractmethod
+    def update_page(self, page: Page) -> None: ...
+
+    @abc.abstractmethod
+    def update_message(self, page_id: str, chat_id: int, message_id: int,
+                       status: str) -> None: ...
+
+    @abc.abstractmethod
+    def add_layer(self, pages: List[Page]) -> None: ...
+
+    @abc.abstractmethod
+    def get_layer_by_depth(self, depth: int) -> List[Page]: ...
+
+    @abc.abstractmethod
+    def get_max_depth(self) -> int: ...
+
+    @abc.abstractmethod
+    def export_pages_to_binding(self, crawl_id: str) -> None: ...
+
+    # --- data storage ----------------------------------------------------
+    @abc.abstractmethod
+    def store_post(self, channel_id: str, post: Post) -> None: ...
+
+    @abc.abstractmethod
+    def store_file(self, channel_id: str, source_file_path: str,
+                   file_name: str) -> Tuple[str, str]:
+        """Store a media file; returns (stored_path, filename)."""
+
+    # --- crawl management ------------------------------------------------
+    @abc.abstractmethod
+    def get_previous_crawls(self) -> List[str]: ...
+
+    @abc.abstractmethod
+    def update_crawl_metadata(self, crawl_id: str, metadata: Dict[str, Any]) -> None: ...
+
+    @abc.abstractmethod
+    def find_incomplete_crawl(self, crawl_id: str) -> Tuple[str, bool]:
+        """Returns (execution_id, exists)."""
+
+    # --- media cache -----------------------------------------------------
+    @abc.abstractmethod
+    def has_processed_media(self, media_id: str) -> bool: ...
+
+    @abc.abstractmethod
+    def mark_media_as_processed(self, media_id: str) -> None: ...
+
+    # --- random-walk: seed channels -------------------------------------
+    def load_seed_channels(self) -> None:
+        return None
+
+    def upsert_seed_channel_chat_id(self, username: str, chat_id: int) -> None:
+        return None
+
+    def get_cached_chat_id(self, username: str) -> Tuple[int, bool]:
+        return 0, False
+
+    def is_seed_channel(self, username: str) -> bool:
+        return False
+
+    def get_channel_last_crawled(self, username: str) -> Optional[datetime]:
+        return None
+
+    def mark_channel_crawled(self, username: str, chat_id: int) -> None:
+        return None
+
+    def mark_seed_channel_invalid(self, username: str) -> None:
+        return None
+
+    def get_random_seed_channel(self) -> str:
+        raise NotImplementedError
+
+    # --- random-walk: invalid channels -----------------------------------
+    def load_invalid_channels(self) -> None:
+        return None
+
+    def is_invalid_channel(self, username: str) -> bool:
+        return False
+
+    def mark_channel_invalid(self, username: str, reason: str) -> None:
+        return None
+
+    # --- random-walk: discovered channels --------------------------------
+    @abc.abstractmethod
+    def initialize_discovered_channels(self) -> None: ...
+
+    @abc.abstractmethod
+    def initialize_random_walk_layer(self) -> None: ...
+
+    @abc.abstractmethod
+    def get_random_discovered_channel(self) -> str: ...
+
+    @abc.abstractmethod
+    def is_discovered_channel(self, channel_id: str) -> bool: ...
+
+    @abc.abstractmethod
+    def add_discovered_channel(self, channel_id: str) -> None: ...
+
+    @abc.abstractmethod
+    def store_channel_data(self, channel_id: str, channel_data: ChannelData) -> None: ...
+
+    # --- random-walk: graph database --------------------------------------
+    @abc.abstractmethod
+    def save_edge_records(self, edges: List[EdgeRecord]) -> None: ...
+
+    @abc.abstractmethod
+    def get_pages_from_page_buffer(self, limit: int) -> List[Page]: ...
+
+    @abc.abstractmethod
+    def execute_database_operation(self, sql_query: str, params: List[Any]) -> None: ...
+
+    @abc.abstractmethod
+    def add_page_to_page_buffer(self, page: Page) -> None: ...
+
+    @abc.abstractmethod
+    def delete_page_buffer_pages(self, page_ids: List[str], page_urls: List[str]) -> None: ...
+
+    # --- combined files --------------------------------------------------
+    def upload_combined_file(self, filename: str) -> None:
+        return None
+
+    # --- tandem validator -------------------------------------------------
+    def create_pending_batch(self, batch: PendingEdgeBatch) -> None:
+        raise NotImplementedError
+
+    def insert_pending_edge(self, edge: PendingEdge) -> None:
+        raise NotImplementedError
+
+    def close_pending_batch(self, batch_id: str) -> None:
+        raise NotImplementedError
+
+    def claim_pending_edges(self, limit: int) -> List[PendingEdge]:
+        raise NotImplementedError
+
+    def update_pending_edge(self, update: PendingEdgeUpdate) -> None:
+        raise NotImplementedError
+
+    def claim_walkback_batch(self) -> Tuple[Optional[PendingEdgeBatch], List[PendingEdge]]:
+        raise NotImplementedError
+
+    def complete_pending_batch(self, batch_id: str) -> None:
+        raise NotImplementedError
+
+    def recover_stale_batch_claims(self, stale_threshold_s: float) -> int:
+        raise NotImplementedError
+
+    def recover_stale_edge_claims(self, stale_threshold_s: float) -> int:
+        raise NotImplementedError
+
+    def recover_orphan_edges(self) -> int:
+        raise NotImplementedError
+
+    def flush_batch_stats(self, batch_id: str, crawl_id: str,
+                          edges: List[PendingEdge]) -> None:
+        raise NotImplementedError
+
+    def claim_discovered_channel(self, username: str, crawl_id: str) -> bool:
+        raise NotImplementedError
+
+    def is_channel_discovered(self, username: str) -> bool:
+        raise NotImplementedError
+
+    def count_incomplete_batches(self, crawl_id: str) -> int:
+        raise NotImplementedError
+
+    def insert_access_event(self, reason: str) -> None:
+        raise NotImplementedError
+
+    # --- edge repair (400-replacement) ------------------------------------
+    def get_edge_record(self, sequence_id: str, destination_channel: str) -> Optional[EdgeRecord]:
+        raise NotImplementedError
+
+    def delete_edge_record(self, sequence_id: str, destination_channel: str) -> None:
+        raise NotImplementedError
+
+    def get_random_skipped_edge(self, sequence_id: str, source_channel: str) -> Optional[EdgeRecord]:
+        raise NotImplementedError
+
+    def promote_edge(self, sequence_id: str, destination_channel: str) -> None:
+        raise NotImplementedError

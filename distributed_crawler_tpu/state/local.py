@@ -1,0 +1,129 @@
+"""Local-filesystem state manager.
+
+Parity with the reference's `LocalStateManager` (`state/storageproviders.go`):
+- layout: ``<base>/<crawl_id>/state.json``, ``metadata.json``,
+  ``media-cache.json`` (`:636-646`), per-channel
+  ``<crawl_id>/<channel>/posts/posts.jsonl`` (`:285-291`), media under
+  ``<crawl_id>/media/<channel>/`` (`:325-344`), exports under
+  ``<crawl_id>/exports/`` (`:574-580`)
+- resume from persisted state incl. previous-crawl metadata scan (`:489-548`)
+- random-walk / tandem methods are not implemented for local storage
+  (`:144-243`) — use CompositeStateManager with a SqlConfig for those.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..datamodel import Post
+from .base import BaseStateManager
+from .datamodels import Page, State, new_id, utcnow
+from .interface import StateConfig
+from .media_cache import ShardedMediaCache
+from .providers import LocalStorageProvider, StorageProvider
+
+logger = logging.getLogger("dct.state.local")
+
+
+class LocalStateManager(BaseStateManager):
+    """Filesystem-backed state manager (`state/storageproviders.go:84-647`)."""
+
+    def __init__(self, config: StateConfig, provider: Optional[StorageProvider] = None):
+        super().__init__(config)
+        base_path = (config.local.base_path if config.local else None) or config.storage_root
+        if provider is None:
+            provider = LocalStorageProvider(base_path)
+        self.provider = provider
+        self.media_cache = ShardedMediaCache(provider, config.crawl_id)
+
+    # --- paths (`storageproviders.go:636-646`) ----------------------------
+    def _state_path(self) -> str:
+        return f"{self.config.crawl_id}/state.json"
+
+    def _metadata_path(self, crawl_id: Optional[str] = None) -> str:
+        return f"{crawl_id or self.config.crawl_id}/metadata.json"
+
+    # --- lifecycle -------------------------------------------------------
+    def initialize(self, seed_urls: List[str]) -> None:
+        """Load persisted state if present, else seed a fresh one
+        (`storageproviders.go:360-430`)."""
+        existing = self.provider.load_json(self._state_path())
+        if existing:
+            self.set_state(State.from_dict(existing))
+            logger.info("resumed state for crawl %s (%d pages)",
+                        self.config.crawl_id, len(self.page_map))
+            return
+        super().initialize(seed_urls)
+        self.save_state()
+
+    def save_state(self) -> None:
+        """Persist state.json + metadata.json (`storageproviders.go:245-272`)."""
+        state = self.get_state()
+        self.provider.save_json(self._state_path(), state.to_dict())
+        self.provider.save_json(self._metadata_path(), self.metadata.to_dict())
+        self.media_cache.save()
+
+    def close(self) -> None:
+        self.save_state()
+
+    # --- posts/files ------------------------------------------------------
+    def store_post(self, channel_id: str, post: Post) -> None:
+        """Append to the per-channel JSONL (`storageproviders.go:275-298`)."""
+        rel = f"{self.config.crawl_id}/{channel_id}/posts/posts.jsonl"
+        self.provider.append_jsonl(rel, post.to_json())
+
+    def store_file(self, channel_id: str, source_file_path: str,
+                   file_name: str) -> Tuple[str, str]:
+        """Copy media in, delete the source (`storageproviders.go:301-344`)."""
+        rel = f"{self.config.crawl_id}/media/{channel_id}/{file_name}"
+        stored = self.provider.store_file(rel, source_file_path, delete_source=True)
+        return stored, file_name
+
+    def export_pages_to_binding(self, crawl_id: str) -> None:
+        """Write a pages-export JSONL snapshot (`storageproviders.go:574-589`)."""
+        state = self.get_state()
+        stamp = utcnow().strftime("%Y%m%d%H%M%S")
+        rel = f"{crawl_id}/exports/pages-export-{stamp}.jsonl"
+        for layer in state.layers:
+            for page in layer.pages:
+                self.provider.append_jsonl(rel, json.dumps(page.to_dict()))
+
+    # --- media cache ------------------------------------------------------
+    def has_processed_media(self, media_id: str) -> bool:
+        return self.media_cache.has(media_id)
+
+    def mark_media_as_processed(self, media_id: str) -> None:
+        self.media_cache.mark(media_id, platform=self.config.platform)
+
+    # --- resume -----------------------------------------------------------
+    def find_incomplete_crawl(self, crawl_id: str) -> Tuple[str, bool]:
+        """Check persisted metadata for this and previous crawl executions
+        (`storageproviders.go:489-548`)."""
+        exec_id, found = super().find_incomplete_crawl(crawl_id)
+        if found:
+            return exec_id, True
+        meta = self.provider.load_json(self._metadata_path(crawl_id))
+        if meta:
+            if meta.get("status") != "completed" and meta.get("executionId"):
+                return meta["executionId"], True
+            for prev_id in meta.get("previousCrawlId") or []:
+                prev_meta = self.provider.load_json(self._metadata_path(prev_id))
+                if prev_meta and prev_meta.get("status") != "completed" \
+                        and prev_meta.get("executionId"):
+                    return prev_meta["executionId"], True
+        return "", False
+
+    # --- random-walk (not supported on plain local storage) ---------------
+    def get_pages_from_page_buffer(self, limit: int) -> List[Page]:
+        raise NotImplementedError("page buffer requires a SQL-backed state manager")
+
+    def execute_database_operation(self, sql_query: str, params: List[Any]) -> None:
+        raise NotImplementedError("database operations require a SQL-backed state manager")
+
+    def add_page_to_page_buffer(self, page: Page) -> None:
+        raise NotImplementedError("page buffer requires a SQL-backed state manager")
+
+    def delete_page_buffer_pages(self, page_ids: List[str], page_urls: List[str]) -> None:
+        raise NotImplementedError("page buffer requires a SQL-backed state manager")

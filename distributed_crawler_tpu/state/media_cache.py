@@ -1,0 +1,162 @@
+"""Sharded media dedup cache.
+
+Parity with the reference's sharded media cache (`state/daprstate.go:
+1252-1680,2310-2668`): an index mapping media ID -> shard, bounded shards
+(5000 items), a 30-day expiry sweep, and migration from a legacy single-blob
+format.  Backed by any StorageProvider.
+"""
+
+from __future__ import annotations
+
+import threading
+from datetime import timedelta
+from typing import Dict, List, Optional
+
+from .datamodels import MediaCacheItem, utcnow
+from .providers import StorageProvider
+
+# Reference constants (`state/daprstate.go:170-171`).
+MAX_SHARD_ITEMS = 5000
+EXPIRY_DAYS = 30
+
+
+class ShardedMediaCache:
+    """Media-ID dedup cache with bounded shards and TTL expiry."""
+
+    def __init__(self, provider: StorageProvider, root: str,
+                 max_shard_items: int = MAX_SHARD_ITEMS,
+                 expiry_days: int = EXPIRY_DAYS):
+        self.provider = provider
+        self.root = root.rstrip("/")
+        self.max_shard_items = max_shard_items
+        self.expiry_days = expiry_days
+        self._lock = threading.RLock()
+        # media ID -> shard ID
+        self._index: Dict[str, str] = {}
+        self._shards: Dict[str, Dict[str, MediaCacheItem]] = {}
+        self._shard_order: List[str] = []
+        self._dirty_shards: set = set()
+        self._loaded = False
+
+    # --- paths -----------------------------------------------------------
+    def _index_path(self) -> str:
+        return f"{self.root}/media-cache-index.json"
+
+    def _shard_path(self, shard_id: str) -> str:
+        return f"{self.root}/media-cache-{shard_id}.json"
+
+    def _legacy_path(self) -> str:
+        return f"{self.root}/media-cache.json"
+
+    # --- persistence ------------------------------------------------------
+    def load(self) -> None:
+        """Load index + shards; migrate legacy single-blob format if present
+        (`state/daprstate.go:2310-2430`)."""
+        with self._lock:
+            self._loaded = True
+            raw = self.provider.load_json(self._index_path())
+            if raw:
+                self._shard_order = list(raw.get("shards") or [])
+                self._index = dict(raw.get("mediaIndex") or {})
+                for shard_id in self._shard_order:
+                    shard_raw = self.provider.load_json(self._shard_path(shard_id)) or {}
+                    items = {
+                        mid: MediaCacheItem.from_dict(item)
+                        for mid, item in (shard_raw.get("items") or {}).items()
+                    }
+                    self._shards[shard_id] = items
+                self._expire_old()
+                return
+            # Legacy migration: one flat {media_id: item} blob.
+            legacy = self.provider.load_json(self._legacy_path())
+            if legacy:
+                items = legacy.get("items", legacy)
+                for mid, item in items.items():
+                    if isinstance(item, dict):
+                        self._put(MediaCacheItem.from_dict(item) if "id" in item
+                                  else MediaCacheItem(id=mid, first_seen=utcnow()))
+                    else:
+                        self._put(MediaCacheItem(id=mid, first_seen=utcnow()))
+                self.save()
+
+    def save(self) -> None:
+        with self._lock:
+            if not self._loaded:
+                # Nothing was read or written this run; saving now would
+                # overwrite the persisted index with an empty one.
+                return
+            for shard_id in list(self._dirty_shards):
+                shard = self._shards.get(shard_id, {})
+                self.provider.save_json(self._shard_path(shard_id), {
+                    "cacheId": shard_id,
+                    "updateTime": utcnow().isoformat(),
+                    "items": {mid: item.to_dict() for mid, item in shard.items()},
+                })
+            self._dirty_shards.clear()
+            self.provider.save_json(self._index_path(), {
+                "shards": self._shard_order,
+                "mediaIndex": self._index,
+                "updateTime": utcnow().isoformat(),
+            })
+
+    # --- cache operations -------------------------------------------------
+    def has(self, media_id: str) -> bool:
+        with self._lock:
+            if not self._loaded:
+                self.load()
+            shard_id = self._index.get(media_id)
+            if shard_id is None:
+                return False
+            item = self._shards.get(shard_id, {}).get(media_id)
+            if item is None:
+                return False
+            if self._expired(item):
+                self._remove(media_id)
+                return False
+            return True
+
+    def mark(self, media_id: str, platform: str = "") -> None:
+        with self._lock:
+            if not self._loaded:
+                self.load()
+            if media_id in self._index:
+                return
+            self._put(MediaCacheItem(id=media_id, first_seen=utcnow(),
+                                     platform=platform))
+
+    def _put(self, item: MediaCacheItem) -> None:
+        shard_id = self._writable_shard()
+        self._shards[shard_id][item.id] = item
+        self._index[item.id] = shard_id
+        self._dirty_shards.add(shard_id)
+
+    def _writable_shard(self) -> str:
+        if self._shard_order:
+            last = self._shard_order[-1]
+            if len(self._shards.get(last, {})) < self.max_shard_items:
+                return last
+        shard_id = f"shard-{len(self._shard_order):05d}"
+        self._shard_order.append(shard_id)
+        self._shards[shard_id] = {}
+        return shard_id
+
+    def _remove(self, media_id: str) -> None:
+        shard_id = self._index.pop(media_id, None)
+        if shard_id and media_id in self._shards.get(shard_id, {}):
+            del self._shards[shard_id][media_id]
+            self._dirty_shards.add(shard_id)
+
+    def _expired(self, item: MediaCacheItem) -> bool:
+        if item.first_seen is None:
+            return False
+        return utcnow() - item.first_seen > timedelta(days=self.expiry_days)
+
+    def _expire_old(self) -> None:
+        for mid in [m for sid in self._shard_order
+                    for m, item in self._shards.get(sid, {}).items()
+                    if self._expired(item)]:
+            self._remove(mid)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._index)

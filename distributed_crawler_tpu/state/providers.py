@@ -1,0 +1,151 @@
+"""Storage providers: the byte-level backends behind state managers.
+
+The reference reached blob/local storage through Dapr output bindings
+(`state/daprstate.go:29-35,1106-1249`); this build keeps the same provider
+seam in-tree so posts/files/state land in identical layouts (JSONL per
+channel, state.json/metadata.json/media-cache.json per crawl,
+`state/storageproviders.go:245-344,592-647`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+from typing import Any, Dict, List, Optional, Protocol, runtime_checkable
+
+
+@runtime_checkable
+class StorageProvider(Protocol):
+    """Minimal byte/JSON storage surface used by state managers."""
+
+    def save_json(self, rel_path: str, data: Any) -> None: ...
+
+    def load_json(self, rel_path: str) -> Optional[Any]: ...
+
+    def append_jsonl(self, rel_path: str, line: str) -> None: ...
+
+    def store_file(self, rel_path: str, source_path: str,
+                   delete_source: bool = True) -> str: ...
+
+    def exists(self, rel_path: str) -> bool: ...
+
+    def list_dir(self, rel_path: str) -> List[str]: ...
+
+    def delete(self, rel_path: str) -> None: ...
+
+
+class LocalStorageProvider:
+    """Filesystem provider (`state/storageproviders.go:17-72`)."""
+
+    def __init__(self, base_path: str):
+        self.base_path = base_path
+        os.makedirs(base_path, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _abs(self, rel_path: str) -> str:
+        return os.path.join(self.base_path, rel_path)
+
+    def save_json(self, rel_path: str, data: Any) -> None:
+        path = self._abs(rel_path)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f, ensure_ascii=False)
+        os.replace(tmp, path)  # atomic on POSIX
+
+    def load_json(self, rel_path: str) -> Optional[Any]:
+        path = self._abs(rel_path)
+        if not os.path.exists(path):
+            return None
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+
+    def append_jsonl(self, rel_path: str, line: str) -> None:
+        path = self._abs(rel_path)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with self._lock:
+            with open(path, "a", encoding="utf-8") as f:
+                f.write(line.rstrip("\n") + "\n")
+
+    def store_file(self, rel_path: str, source_path: str,
+                   delete_source: bool = True) -> str:
+        """Copy then delete source (`state/storageproviders.go:301-344`)."""
+        dest = self._abs(rel_path)
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        shutil.copy2(source_path, dest)
+        if delete_source:
+            try:
+                os.remove(source_path)
+            except OSError:
+                pass
+        return dest
+
+    def exists(self, rel_path: str) -> bool:
+        return os.path.exists(self._abs(rel_path))
+
+    def list_dir(self, rel_path: str) -> List[str]:
+        path = self._abs(rel_path)
+        if not os.path.isdir(path):
+            return []
+        return sorted(os.listdir(path))
+
+    def delete(self, rel_path: str) -> None:
+        path = self._abs(rel_path)
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+
+class InMemoryStorageProvider:
+    """Test double recording every write — the analog of the reference's fake
+    Dapr client (`state/export_test.go:24-110`)."""
+
+    def __init__(self):
+        self.json_store: Dict[str, Any] = {}
+        self.jsonl_store: Dict[str, List[str]] = {}
+        self.files: Dict[str, bytes] = {}
+        self.calls: List[tuple] = []
+
+    def save_json(self, rel_path: str, data: Any) -> None:
+        self.calls.append(("save_json", rel_path))
+        self.json_store[rel_path] = json.loads(json.dumps(data))
+
+    def load_json(self, rel_path: str) -> Optional[Any]:
+        self.calls.append(("load_json", rel_path))
+        return self.json_store.get(rel_path)
+
+    def append_jsonl(self, rel_path: str, line: str) -> None:
+        self.calls.append(("append_jsonl", rel_path))
+        self.jsonl_store.setdefault(rel_path, []).append(line.rstrip("\n"))
+
+    def store_file(self, rel_path: str, source_path: str,
+                   delete_source: bool = True) -> str:
+        self.calls.append(("store_file", rel_path, source_path))
+        with open(source_path, "rb") as f:
+            self.files[rel_path] = f.read()
+        if delete_source:
+            try:
+                os.remove(source_path)
+            except OSError:
+                pass
+        return rel_path
+
+    def exists(self, rel_path: str) -> bool:
+        return (rel_path in self.json_store or rel_path in self.jsonl_store
+                or rel_path in self.files)
+
+    def list_dir(self, rel_path: str) -> List[str]:
+        prefix = rel_path.rstrip("/") + "/"
+        names = set()
+        for key in list(self.json_store) + list(self.jsonl_store) + list(self.files):
+            if key.startswith(prefix):
+                names.add(key[len(prefix):].split("/", 1)[0])
+        return sorted(names)
+
+    def delete(self, rel_path: str) -> None:
+        self.json_store.pop(rel_path, None)
+        self.jsonl_store.pop(rel_path, None)
+        self.files.pop(rel_path, None)

@@ -1,0 +1,520 @@
+"""SQL-backed random-walk graph + tandem validator store.
+
+The reference kept this state in PostgreSQL reached through a Dapr `postgres`
+output binding (`state/daprstate.go:3076-4391`, SQL DDL in `sql/*.sql`).  The
+TPU build brings the store in-tree behind a thin `SqlBinding` seam:
+
+- `SqliteBinding` (default): zero-dependency, serialized-writer engine whose
+  BEGIN IMMEDIATE transactions give the same atomic-claim guarantees the
+  reference got from `FOR UPDATE SKIP LOCKED` for in-process concurrency;
+- any DB-API engine (e.g. psycopg) can be dropped in for multi-host
+  deployments — the SQL sticks to the common subset plus RETURNING.
+
+Tests assert at the binding boundary (recorded SQL + canned rows), mirroring
+the reference's fake-Dapr-client strategy (`state/validator_db_test.go:17-60`).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sqlite3
+import threading
+from datetime import datetime, timedelta
+from typing import Any, Dict, Iterable, List, Optional, Protocol, Sequence, Tuple
+
+from ..datamodel.post import format_time, parse_time
+from .datamodels import (
+    BATCH_CLOSED,
+    BATCH_COMPLETED,
+    BATCH_OPEN,
+    BATCH_PROCESSING,
+    EDGE_PENDING,
+    EDGE_VALIDATING,
+    EdgeRecord,
+    Page,
+    PendingEdge,
+    PendingEdgeBatch,
+    PendingEdgeUpdate,
+    utcnow,
+)
+
+logger = logging.getLogger("dct.state.sql")
+
+# Poison detection: batches claimed this many times are left in place
+# (`state/daprstate.go` maxBatchAttempts analog, crawl/validator.go:319-331).
+MAX_BATCH_ATTEMPTS = 3
+
+_SCHEMA_PATH = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                            "sql", "schema.sql")
+
+
+class SqlBinding(Protocol):
+    """Minimal SQL surface the graph store needs."""
+
+    def query(self, sql: str, params: Sequence[Any] = ()) -> List[tuple]: ...
+
+    def execute(self, sql: str, params: Sequence[Any] = ()) -> int:
+        """Run a statement; returns affected row count."""
+
+    def execute_returning(self, sql: str, params: Sequence[Any] = ()) -> List[tuple]:
+        """Run a mutating statement with RETURNING; returns rows."""
+
+    def executescript(self, sql: str) -> None: ...
+
+
+class SqliteBinding:
+    """sqlite3-backed binding with serialized writers."""
+
+    def __init__(self, url: str = ":memory:"):
+        self.url = url or ":memory:"
+        if self.url != ":memory:":
+            os.makedirs(os.path.dirname(os.path.abspath(self.url)), exist_ok=True)
+        self._conn = sqlite3.connect(self.url, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA busy_timeout=10000")
+        self._lock = threading.RLock()
+
+    def query(self, sql: str, params: Sequence[Any] = ()) -> List[tuple]:
+        with self._lock:
+            cur = self._conn.execute(sql, tuple(params))
+            return cur.fetchall()
+
+    def execute(self, sql: str, params: Sequence[Any] = ()) -> int:
+        with self._lock:
+            cur = self._conn.execute(sql, tuple(params))
+            self._conn.commit()
+            return cur.rowcount
+
+    def execute_returning(self, sql: str, params: Sequence[Any] = ()) -> List[tuple]:
+        # BEGIN IMMEDIATE grabs the write lock up front: the SELECT inside the
+        # UPDATE and the UPDATE itself are atomic w.r.t. concurrent claimers —
+        # the sqlite equivalent of FOR UPDATE SKIP LOCKED for our claim shapes.
+        with self._lock:
+            try:
+                self._conn.execute("BEGIN IMMEDIATE")
+            except sqlite3.OperationalError:
+                pass  # already in a transaction
+            try:
+                cur = self._conn.execute(sql, tuple(params))
+                rows = cur.fetchall()
+                self._conn.commit()
+                return rows
+            except Exception:
+                self._conn.rollback()
+                raise
+
+    def executescript(self, sql: str) -> None:
+        with self._lock:
+            self._conn.executescript(sql)
+            self._conn.commit()
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+class RecordingBinding:
+    """Test double: records every statement, feeds back canned rows — the
+    analog of the reference's fake Dapr client (`state/export_test.go`)."""
+
+    def __init__(self):
+        self.calls: List[Tuple[str, tuple]] = []
+        self.canned: List[List[tuple]] = []
+        self.rowcount: int = 1
+
+    def _next_rows(self) -> List[tuple]:
+        return self.canned.pop(0) if self.canned else []
+
+    def query(self, sql: str, params: Sequence[Any] = ()) -> List[tuple]:
+        self.calls.append((sql, tuple(params)))
+        return self._next_rows()
+
+    def execute(self, sql: str, params: Sequence[Any] = ()) -> int:
+        self.calls.append((sql, tuple(params)))
+        return self.rowcount
+
+    def execute_returning(self, sql: str, params: Sequence[Any] = ()) -> List[tuple]:
+        self.calls.append((sql, tuple(params)))
+        return self._next_rows()
+
+    def executescript(self, sql: str) -> None:
+        self.calls.append((sql, ()))
+
+
+def _ts(dt: Optional[datetime]) -> str:
+    return format_time(dt or utcnow())
+
+
+_EDGE_COLS = ("pending_id, batch_id, crawl_id, destination_channel, "
+              "source_channel, sequence_id, discovery_time, source_type, "
+              "validation_status, validation_reason")
+
+_BATCH_COLS = ("batch_id, crawl_id, source_channel, source_page_id, "
+               "source_depth, sequence_id, status, attempt_count")
+
+
+def _row_to_edge(row: tuple) -> PendingEdge:
+    return PendingEdge(
+        pending_id=int(row[0]), batch_id=row[1], crawl_id=row[2],
+        destination_channel=row[3], source_channel=row[4], sequence_id=row[5],
+        discovery_time=parse_time(row[6]), source_type=row[7],
+        validation_status=row[8], validation_reason=row[9])
+
+
+def _row_to_batch(row: tuple) -> PendingEdgeBatch:
+    return PendingEdgeBatch(
+        batch_id=row[0], crawl_id=row[1], source_channel=row[2],
+        source_page_id=row[3], source_depth=int(row[4]), sequence_id=row[5],
+        status=row[6], attempt_count=int(row[7]))
+
+
+class SqlGraphStore:
+    """All random-walk graph + tandem queue operations over a SqlBinding."""
+
+    def __init__(self, binding: SqlBinding, crawl_id: str):
+        self.binding = binding
+        self.crawl_id = crawl_id
+
+    def ensure_schema(self) -> None:
+        with open(_SCHEMA_PATH, "r", encoding="utf-8") as f:
+            self.binding.executescript(f.read())
+
+    # ------------------------------------------------------------------
+    # edge_records (`daprstate.go:3150-3279`)
+    # ------------------------------------------------------------------
+    def save_edge_records(self, edges: List[EdgeRecord]) -> None:
+        for e in edges:
+            self.binding.execute(
+                "INSERT INTO edge_records (destination_channel, source_channel, "
+                "walkback, skipped, discovery_time, crawl_id, sequence_id) "
+                "VALUES (?, ?, ?, ?, ?, ?, ?)",
+                (e.destination_channel, e.source_channel, int(e.walkback),
+                 int(e.skipped), _ts(e.discovery_time),
+                 e.crawl_id or self.crawl_id, e.sequence_id))
+
+    def get_edge_record(self, sequence_id: str,
+                        destination_channel: str) -> Optional[EdgeRecord]:
+        rows = self.binding.query(
+            "SELECT destination_channel, source_channel, walkback, skipped, "
+            "discovery_time, crawl_id, sequence_id FROM edge_records "
+            "WHERE crawl_id = ? AND sequence_id = ? AND destination_channel = ? "
+            "LIMIT 1",
+            (self.crawl_id, sequence_id, destination_channel))
+        if not rows:
+            return None
+        r = rows[0]
+        return EdgeRecord(destination_channel=r[0], source_channel=r[1],
+                          walkback=bool(r[2]), skipped=bool(r[3]),
+                          discovery_time=parse_time(r[4]), crawl_id=r[5],
+                          sequence_id=r[6])
+
+    def delete_edge_record(self, sequence_id: str, destination_channel: str) -> None:
+        self.binding.execute(
+            "DELETE FROM edge_records WHERE crawl_id = ? AND sequence_id = ? "
+            "AND destination_channel = ?",
+            (self.crawl_id, sequence_id, destination_channel))
+
+    def get_random_skipped_edge(self, sequence_id: str,
+                                source_channel: str) -> Optional[EdgeRecord]:
+        rows = self.binding.query(
+            "SELECT destination_channel, source_channel, walkback, skipped, "
+            "discovery_time, crawl_id, sequence_id FROM edge_records "
+            "WHERE crawl_id = ? AND skipped = 1 AND sequence_id = ? "
+            "AND source_channel = ? ORDER BY RANDOM() LIMIT 1",
+            (self.crawl_id, sequence_id, source_channel))
+        if not rows:
+            return None
+        r = rows[0]
+        return EdgeRecord(destination_channel=r[0], source_channel=r[1],
+                          walkback=bool(r[2]), skipped=bool(r[3]),
+                          discovery_time=parse_time(r[4]), crawl_id=r[5],
+                          sequence_id=r[6])
+
+    def promote_edge(self, sequence_id: str, destination_channel: str) -> None:
+        self.binding.execute(
+            "UPDATE edge_records SET skipped = 0 WHERE crawl_id = ? "
+            "AND sequence_id = ? AND destination_channel = ?",
+            (self.crawl_id, sequence_id, destination_channel))
+
+    # ------------------------------------------------------------------
+    # page_buffer (`daprstate.go:3619-3733`)
+    # ------------------------------------------------------------------
+    def add_page_to_page_buffer(self, page: Page) -> None:
+        self.binding.execute(
+            "INSERT OR REPLACE INTO page_buffer (page_id, parent_id, depth, "
+            "url, crawl_id, sequence_id) VALUES (?, ?, ?, ?, ?, ?)",
+            (page.id, page.parent_id, page.depth, page.url,
+             page.crawl_id or self.crawl_id, page.sequence_id))
+
+    def get_pages_from_page_buffer(self, limit: int) -> List[Page]:
+        rows = self.binding.query(
+            "SELECT page_id, parent_id, depth, url, sequence_id FROM page_buffer "
+            "WHERE crawl_id = ? LIMIT ?", (self.crawl_id, limit))
+        return [Page(id=r[0], parent_id=r[1], depth=int(r[2]), url=r[3],
+                     sequence_id=r[4]) for r in rows]
+
+    def delete_page_buffer_pages(self, page_ids: List[str],
+                                 page_urls: List[str]) -> None:
+        """Delete only the processed pages — never wipe rows the validator
+        wrote after the read (`state/interface.go:105-107`)."""
+        for pid in page_ids:
+            self.binding.execute(
+                "DELETE FROM page_buffer WHERE crawl_id = ? AND page_id = ?",
+                (self.crawl_id, pid))
+        for url in page_urls:
+            self.binding.execute(
+                "DELETE FROM page_buffer WHERE crawl_id = ? AND url = ?",
+                (self.crawl_id, url))
+
+    # ------------------------------------------------------------------
+    # seed_channels (`daprstate.go:3076-3578`)
+    # ------------------------------------------------------------------
+    def load_seed_channels(self, invalid_ttl_days: int = 30
+                           ) -> List[Tuple[str, Optional[int]]]:
+        """Rows (username, chat_id) excluding recently invalidated seeds."""
+        cutoff = _ts(utcnow() - timedelta(days=invalid_ttl_days))
+        rows = self.binding.query(
+            "SELECT channel_username, chat_id FROM seed_channels "
+            "WHERE invalidated_at IS NULL OR invalidated_at < ?", (cutoff,))
+        return [(r[0], r[1]) for r in rows]
+
+    def upsert_seed_channel_chat_id(self, username: str, chat_id: int) -> None:
+        self.binding.execute(
+            "INSERT INTO seed_channels (channel_username, chat_id, inserted_at) "
+            "VALUES (?, ?, ?) ON CONFLICT(channel_username) "
+            "DO UPDATE SET chat_id = excluded.chat_id",
+            (username, chat_id, _ts(None)))
+
+    def get_channel_last_crawled(self, username: str) -> Optional[datetime]:
+        rows = self.binding.query(
+            "SELECT last_crawled_at FROM seed_channels WHERE channel_username = ?",
+            (username,))
+        if not rows or rows[0][0] is None:
+            return None
+        return parse_time(rows[0][0])
+
+    def mark_channel_crawled(self, username: str, chat_id: int) -> None:
+        now = _ts(None)
+        self.binding.execute(
+            "INSERT INTO seed_channels (channel_username, chat_id, "
+            "last_crawled_at, inserted_at) VALUES (?, ?, ?, ?) "
+            "ON CONFLICT(channel_username) DO UPDATE SET "
+            "chat_id = excluded.chat_id, last_crawled_at = excluded.last_crawled_at",
+            (username, chat_id, now, now))
+
+    def mark_seed_channel_invalid(self, username: str) -> None:
+        self.binding.execute(
+            "UPDATE seed_channels SET invalidated_at = ? WHERE channel_username = ?",
+            (_ts(None), username))
+
+    def get_random_seed_channel(self, invalid_ttl_days: int = 30) -> Optional[str]:
+        cutoff = _ts(utcnow() - timedelta(days=invalid_ttl_days))
+        rows = self.binding.query(
+            "SELECT channel_username FROM seed_channels "
+            "WHERE invalidated_at IS NULL OR invalidated_at < ? "
+            "ORDER BY RANDOM() LIMIT 1", (cutoff,))
+        return rows[0][0] if rows else None
+
+    # ------------------------------------------------------------------
+    # invalid_channels
+    # ------------------------------------------------------------------
+    def load_invalid_channels(self, ttl_days: int = 30) -> List[str]:
+        cutoff = _ts(utcnow() - timedelta(days=ttl_days))
+        rows = self.binding.query(
+            "SELECT channel_username FROM invalid_channels WHERE invalidated_at >= ?",
+            (cutoff,))
+        return [r[0] for r in rows]
+
+    def mark_channel_invalid(self, username: str, reason: str) -> None:
+        self.binding.execute(
+            "INSERT INTO invalid_channels (channel_username, reason, invalidated_at) "
+            "VALUES (?, ?, ?) ON CONFLICT(channel_username) DO UPDATE SET "
+            "reason = excluded.reason, invalidated_at = excluded.invalidated_at",
+            (username, reason, _ts(None)))
+
+    # ------------------------------------------------------------------
+    # discovered_channels (`daprstate.go:3404-3578`)
+    # ------------------------------------------------------------------
+    def load_discovered_channels(self) -> List[str]:
+        rows = self.binding.query(
+            "SELECT channel_username FROM discovered_channels", ())
+        return [r[0] for r in rows]
+
+    def claim_discovered_channel(self, username: str, crawl_id: str) -> bool:
+        """Atomic first-claim: the PK serializes inserts; rowcount tells us
+        whether we won (`sql/validator-schema.sql` discovered_channels)."""
+        affected = self.binding.execute(
+            "INSERT INTO discovered_channels (channel_username, crawl_id, "
+            "discovered_at) VALUES (?, ?, ?) "
+            "ON CONFLICT(channel_username) DO NOTHING",
+            (username, crawl_id or self.crawl_id, _ts(None)))
+        return affected > 0
+
+    def is_channel_discovered(self, username: str) -> bool:
+        rows = self.binding.query(
+            "SELECT 1 FROM discovered_channels WHERE channel_username = ? LIMIT 1",
+            (username,))
+        return bool(rows)
+
+    def add_discovered_channel(self, username: str, crawl_id: str = "") -> None:
+        self.claim_discovered_channel(username, crawl_id)
+
+    # ------------------------------------------------------------------
+    # tandem: pending_edge_batches + pending_edges (`daprstate.go:3944-4384`)
+    # ------------------------------------------------------------------
+    def create_pending_batch(self, batch: PendingEdgeBatch) -> None:
+        self.binding.execute(
+            "INSERT INTO pending_edge_batches (batch_id, crawl_id, "
+            "source_channel, source_page_id, source_depth, sequence_id, "
+            "status, attempt_count, created_at) VALUES (?, ?, ?, ?, ?, ?, ?, 0, ?)",
+            (batch.batch_id, batch.crawl_id or self.crawl_id,
+             batch.source_channel, batch.source_page_id, batch.source_depth,
+             batch.sequence_id, BATCH_OPEN, _ts(None)))
+
+    def insert_pending_edge(self, edge: PendingEdge) -> None:
+        self.binding.execute(
+            "INSERT INTO pending_edges (batch_id, crawl_id, destination_channel, "
+            "source_channel, sequence_id, discovery_time, source_type, "
+            "validation_status, validation_reason) "
+            "VALUES (?, ?, ?, ?, ?, ?, ?, 'pending', '')",
+            (edge.batch_id, edge.crawl_id or self.crawl_id,
+             edge.destination_channel, edge.source_channel, edge.sequence_id,
+             _ts(edge.discovery_time), edge.source_type))
+
+    def close_pending_batch(self, batch_id: str) -> None:
+        self.binding.execute(
+            "UPDATE pending_edge_batches SET status = 'closed', closed_at = ? "
+            "WHERE batch_id = ?", (_ts(None), batch_id))
+
+    def claim_pending_edges(self, limit: int) -> List[PendingEdge]:
+        """Atomically claim up to `limit` pending edges FIFO
+        (`state/interface.go:148-152`)."""
+        rows = self.binding.execute_returning(
+            f"UPDATE pending_edges SET validation_status = 'validating', "
+            f"validated_at = ? WHERE pending_id IN ("
+            f"SELECT pending_id FROM pending_edges "
+            f"WHERE validation_status = 'pending' "
+            f"ORDER BY discovery_time, pending_id LIMIT ?) "
+            f"RETURNING {_EDGE_COLS}",
+            (_ts(None), limit))
+        return [_row_to_edge(r) for r in rows]
+
+    def update_pending_edge(self, update: PendingEdgeUpdate) -> None:
+        self.binding.execute(
+            "UPDATE pending_edges SET validation_status = ?, "
+            "validation_reason = ?, validated_at = ? WHERE pending_id = ?",
+            (update.validation_status, update.validation_reason, _ts(None),
+             update.pending_id))
+
+    def claim_walkback_batch(self) -> Tuple[Optional[PendingEdgeBatch],
+                                            List[PendingEdge]]:
+        """Claim the oldest closed batch whose edges are all final
+        (`state/interface.go:158-161`, `daprstate.go:4017-4034`): edges still
+        'pending' or 'validating' block the claim, and poison batches
+        (attempt_count >= max) are never re-claimed."""
+        rows = self.binding.execute_returning(
+            f"UPDATE pending_edge_batches SET status = 'processing', "
+            f"attempt_count = attempt_count + 1, claimed_at = ? "
+            f"WHERE batch_id = (SELECT b.batch_id FROM pending_edge_batches b "
+            f"WHERE b.status = 'closed' AND b.attempt_count < ? AND NOT EXISTS ("
+            f"SELECT 1 FROM pending_edges e WHERE e.batch_id = b.batch_id "
+            f"AND e.validation_status IN ('pending', 'validating')) "
+            f"ORDER BY b.created_at LIMIT 1) "
+            f"RETURNING {_BATCH_COLS}",
+            (_ts(None), MAX_BATCH_ATTEMPTS))
+        if not rows:
+            return None, []
+        batch = _row_to_batch(rows[0])
+        edge_rows = self.binding.query(
+            f"SELECT {_EDGE_COLS} FROM pending_edges WHERE batch_id = ?",
+            (batch.batch_id,))
+        return batch, [_row_to_edge(r) for r in edge_rows]
+
+    def complete_pending_batch(self, batch_id: str) -> None:
+        self.binding.execute(
+            "UPDATE pending_edge_batches SET status = 'completed', "
+            "completed_at = ? WHERE batch_id = ?", (_ts(None), batch_id))
+
+    def count_incomplete_batches(self, crawl_id: str) -> int:
+        rows = self.binding.query(
+            "SELECT COUNT(*) FROM pending_edge_batches WHERE crawl_id = ? "
+            "AND status <> 'completed'", (crawl_id or self.crawl_id,))
+        return int(rows[0][0]) if rows else 0
+
+    def recover_stale_batch_claims(self, stale_threshold_s: float) -> int:
+        """Reset batches stuck 'processing' past the threshold back to
+        'closed'; poison batches (attempt_count >= max) are logged and left
+        (`daprstate.go:4300-4355`)."""
+        cutoff = _ts(utcnow() - timedelta(seconds=stale_threshold_s))
+        poison = self.binding.query(
+            "SELECT batch_id, source_channel, attempt_count FROM "
+            "pending_edge_batches WHERE status = 'processing' "
+            "AND attempt_count >= ? AND claimed_at < ?",
+            (MAX_BATCH_ATTEMPTS, cutoff))
+        for batch_id, source_channel, attempts in poison:
+            logger.error(
+                "poison batch detected - stuck in processing after max attempts",
+                extra={"batch_id": batch_id, "source_channel": source_channel,
+                       "attempt_count": attempts, "log_tag": "validator_db"})
+        return self.binding.execute(
+            "UPDATE pending_edge_batches SET status = 'closed' "
+            "WHERE status = 'processing' AND attempt_count < ? AND claimed_at < ?",
+            (MAX_BATCH_ATTEMPTS, cutoff))
+
+    def recover_stale_edge_claims(self, stale_threshold_s: float) -> int:
+        """Reset edges stuck 'validating' back to 'pending'
+        (`daprstate.go:4264-4294`)."""
+        cutoff = _ts(utcnow() - timedelta(seconds=stale_threshold_s))
+        return self.binding.execute(
+            "UPDATE pending_edges SET validation_status = 'pending', "
+            "validated_at = NULL WHERE validation_status = 'validating' "
+            "AND validated_at < ?", (cutoff,))
+
+    def recover_orphan_edges(self) -> int:
+        """Delete edges whose batch already completed (validator crashed
+        between complete and flush, `daprstate.go:4356-4384`)."""
+        return self.binding.execute(
+            "DELETE FROM pending_edges WHERE batch_id IN ("
+            "SELECT batch_id FROM pending_edge_batches WHERE status = 'completed')")
+
+    def flush_batch_stats(self, batch_id: str, crawl_id: str,
+                          edges: List[PendingEdge]) -> None:
+        """Upsert source_type_stats then delete the batch's edges
+        (`state/interface.go:171-173`)."""
+        stats: Dict[str, Dict[str, int]] = {}
+        for e in edges:
+            s = stats.setdefault(e.source_type or "", {
+                "total": 0, "valid": 0, "not_channel": 0, "invalid": 0,
+                "duplicate": 0})
+            s["total"] += 1
+            if e.validation_status in ("valid", "not_channel", "invalid", "duplicate"):
+                s[e.validation_status] += 1
+        for source_type, s in stats.items():
+            self.binding.execute(
+                "INSERT INTO source_type_stats (crawl_id, source_type, total, "
+                "valid, not_channel, invalid, duplicate) "
+                "VALUES (?, ?, ?, ?, ?, ?, ?) "
+                "ON CONFLICT(crawl_id, source_type) DO UPDATE SET "
+                "total = total + excluded.total, "
+                "valid = valid + excluded.valid, "
+                "not_channel = not_channel + excluded.not_channel, "
+                "invalid = invalid + excluded.invalid, "
+                "duplicate = duplicate + excluded.duplicate",
+                (crawl_id or self.crawl_id, source_type, s["total"], s["valid"],
+                 s["not_channel"], s["invalid"], s["duplicate"]))
+        self.binding.execute(
+            "DELETE FROM pending_edges WHERE batch_id = ?", (batch_id,))
+
+    # ------------------------------------------------------------------
+    # access_events (`daprstate.go:4385-4391`)
+    # ------------------------------------------------------------------
+    def insert_access_event(self, reason: str) -> None:
+        self.binding.execute(
+            "INSERT INTO access_events (reason, occurred_at) VALUES (?, ?)",
+            (reason, _ts(None)))
+
+    # ------------------------------------------------------------------
+    def execute(self, sql_query: str, params: Sequence[Any] = ()) -> None:
+        """Raw escape hatch (`state/interface.go:103`)."""
+        self.binding.execute(sql_query, params)

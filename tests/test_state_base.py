@@ -1,0 +1,233 @@
+"""Base/local state manager tests (reference analogs: state base behavior,
+storageproviders persistence, SURVEY.md §4)."""
+
+import json
+import os
+
+import pytest
+
+from distributed_crawler_tpu.datamodel import Post
+from distributed_crawler_tpu.state import (
+    BaseStateManager,
+    LocalConfig,
+    LocalStateManager,
+    Page,
+    StateConfig,
+)
+from distributed_crawler_tpu.state.datamodels import (
+    PAGE_DEADEND,
+    PAGE_FETCHED,
+    PAGE_UNFETCHED,
+)
+
+
+def cfg(**kw):
+    base = dict(crawl_id="c1", crawl_execution_id="e1", platform="telegram")
+    base.update(kw)
+    return StateConfig(**base)
+
+
+class TestBaseStateManager:
+    def test_initialize_seeds_layer_zero(self):
+        sm = BaseStateManager(cfg())
+        sm.initialize(["a", "b"])
+        pages = sm.get_layer_by_depth(0)
+        assert {p.url for p in pages} == {"a", "b"}
+        assert all(p.status == PAGE_UNFETCHED for p in pages)
+        assert all(p.sequence_id == "" for p in pages)
+
+    def test_random_walk_seeds_get_sequence_ids(self):
+        sm = BaseStateManager(cfg(sampling_method="random-walk"))
+        sm.initialize(["a", "b"])
+        pages = sm.get_layer_by_depth(0)
+        seqs = {p.sequence_id for p in pages}
+        assert len(seqs) == 2 and "" not in seqs  # each seed starts its own chain
+        assert sm.is_discovered_channel("a")
+
+    def test_add_layer_dedups_urls_across_layers(self):
+        sm = BaseStateManager(cfg())
+        sm.initialize(["a"])
+        sm.add_layer([Page(url="a", depth=1), Page(url="b", depth=1)])
+        assert [p.url for p in sm.get_layer_by_depth(1)] == ["b"]
+
+    def test_add_layer_max_pages_deadend_replacement(self):
+        # state/base.go:219-322: at the cap, only deadend slots are refilled.
+        sm = BaseStateManager(cfg(max_pages=2))
+        sm.initialize(["a", "b"])
+        sm.add_layer([Page(url="c", depth=1)])
+        assert sm.get_layer_by_depth(1) == []
+        # Mark one page deadend -> one replacement slot opens.
+        page = sm.get_layer_by_depth(0)[0]
+        page.status = PAGE_DEADEND
+        sm.update_page(page)
+        sm.add_layer([Page(url="c", depth=1), Page(url="d", depth=1)])
+        assert [p.url for p in sm.get_layer_by_depth(1)] == ["c"]
+
+    def test_random_walk_allows_url_revisits(self):
+        # daprstate.go:648-656: random-walk skips URL dedup — a walk may return
+        # to a channel it has already visited.
+        sm = BaseStateManager(cfg(sampling_method="random-walk"))
+        sm.initialize(["a"])
+        sm.add_layer([Page(url="a", depth=1)])
+        assert [p.url for p in sm.get_layer_by_depth(1)] == ["a"]
+
+    def test_update_message_appends_and_updates(self):
+        sm = BaseStateManager(cfg())
+        sm.initialize(["a"])
+        page = sm.get_layer_by_depth(0)[0]
+        sm.update_message(page.id, 10, 100, "fetched")
+        sm.update_message(page.id, 10, 100, "deleted")
+        sm.update_message(page.id, 10, 101, "fetched")
+        msgs = sm.get_page(page.id).messages
+        assert len(msgs) == 2
+        assert msgs[0].status == "deleted"
+
+    def test_get_max_depth(self):
+        sm = BaseStateManager(cfg())
+        with pytest.raises(LookupError):
+            sm.get_max_depth()
+        sm.initialize(["a"])
+        sm.add_layer([Page(url="b", depth=1)])
+        assert sm.get_max_depth() == 1
+
+    def test_metadata_update_guards_crawl_id(self):
+        sm = BaseStateManager(cfg())
+        with pytest.raises(ValueError):
+            sm.update_crawl_metadata("other", {"status": "completed"})
+        sm.update_crawl_metadata("c1", {"status": "completed",
+                                        "previousCrawlID": "old1"})
+        assert sm.metadata.status == "completed"
+        assert sm.get_previous_crawls() == ["old1"]
+
+    def test_find_incomplete_crawl(self):
+        sm = BaseStateManager(cfg())
+        sm.initialize(["a"])
+        exec_id, found = sm.find_incomplete_crawl("c1")
+        assert found and exec_id == "e1"
+        # Complete everything -> no incomplete crawl.
+        sm.update_crawl_metadata("c1", {"status": "completed"})
+        for p in sm.get_layer_by_depth(0):
+            p.status = PAGE_FETCHED
+            sm.update_page(p)
+        _, found = sm.find_incomplete_crawl("c1")
+        assert not found
+
+
+class TestLocalStateManager:
+    def _sm(self, tmp_path, **kw):
+        return LocalStateManager(cfg(local=LocalConfig(base_path=str(tmp_path)), **kw))
+
+    def test_state_persistence_roundtrip(self, tmp_path):
+        sm = self._sm(tmp_path)
+        sm.initialize(["a", "b"])
+        page = sm.get_layer_by_depth(0)[0]
+        page.status = PAGE_FETCHED
+        sm.update_page(page)
+        sm.save_state()
+        # Fresh manager resumes from disk.
+        sm2 = self._sm(tmp_path)
+        sm2.initialize([])
+        statuses = {p.url: p.status for p in sm2.get_layer_by_depth(0)}
+        assert statuses[page.url] == PAGE_FETCHED
+        assert os.path.exists(tmp_path / "c1" / "state.json")
+        assert os.path.exists(tmp_path / "c1" / "metadata.json")
+
+    def test_store_post_appends_jsonl(self, tmp_path):
+        sm = self._sm(tmp_path)
+        sm.initialize(["a"])
+        post = Post(post_link="x", channel_id="chan", post_uid="1", url="x",
+                    platform_name="telegram")
+        sm.store_post("chan", post)
+        sm.store_post("chan", post)
+        path = tmp_path / "c1" / "chan" / "posts" / "posts.jsonl"
+        lines = path.read_text().strip().split("\n")
+        assert len(lines) == 2
+        assert json.loads(lines[0])["post_uid"] == "1"
+
+    def test_store_file_moves_media(self, tmp_path):
+        sm = self._sm(tmp_path)
+        src = tmp_path / "incoming.bin"
+        src.write_bytes(b"\x00\x01media")
+        stored, name = sm.store_file("chan", str(src), "photo_1.jpg")
+        assert not src.exists()  # source deleted after copy
+        assert (tmp_path / "c1" / "media" / "chan" / "photo_1.jpg").read_bytes() == b"\x00\x01media"
+        assert name == "photo_1.jpg"
+
+    def test_media_cache_dedup_and_persist(self, tmp_path):
+        sm = self._sm(tmp_path)
+        assert not sm.has_processed_media("m1")
+        sm.mark_media_as_processed("m1")
+        assert sm.has_processed_media("m1")
+        sm.save_state()
+        sm2 = self._sm(tmp_path)
+        assert sm2.has_processed_media("m1")
+        assert not sm2.has_processed_media("m2")
+
+    def test_find_incomplete_crawl_from_disk(self, tmp_path):
+        sm = self._sm(tmp_path)
+        sm.initialize(["a"])
+        sm.save_state()
+        # Fresh process, no in-memory state: finds it from metadata.json.
+        sm2 = self._sm(tmp_path)
+        exec_id, found = sm2.find_incomplete_crawl("c1")
+        assert found and exec_id == "e1"
+
+    def test_random_walk_not_supported_on_local(self, tmp_path):
+        sm = self._sm(tmp_path)
+        with pytest.raises(NotImplementedError):
+            sm.get_pages_from_page_buffer(10)
+
+
+class TestMediaCacheSharding:
+    def test_shard_rotation(self, tmp_path):
+        from distributed_crawler_tpu.state import ShardedMediaCache
+        from distributed_crawler_tpu.state.providers import LocalStorageProvider
+        provider = LocalStorageProvider(str(tmp_path))
+        cache = ShardedMediaCache(provider, "c1", max_shard_items=3)
+        for i in range(8):
+            cache.mark(f"m{i}")
+        cache.save()
+        # 8 items / 3 per shard -> 3 shards.
+        assert len(cache._shard_order) == 3
+        index = provider.load_json("c1/media-cache-index.json")
+        assert len(index["mediaIndex"]) == 8
+        cache2 = ShardedMediaCache(provider, "c1", max_shard_items=3)
+        assert cache2.has("m0") and cache2.has("m7")
+
+    def test_legacy_migration(self, tmp_path):
+        from distributed_crawler_tpu.state import ShardedMediaCache
+        from distributed_crawler_tpu.state.providers import LocalStorageProvider
+        provider = LocalStorageProvider(str(tmp_path))
+        provider.save_json("c1/media-cache.json", {
+            "items": {"legacy1": {"id": "legacy1",
+                                  "firstSeen": "2026-07-01T00:00:00Z"}}})
+        cache = ShardedMediaCache(provider, "c1")
+        assert cache.has("legacy1")
+
+    def test_save_without_load_does_not_wipe(self, tmp_path):
+        from distributed_crawler_tpu.state import ShardedMediaCache
+        from distributed_crawler_tpu.state.providers import LocalStorageProvider
+        provider = LocalStorageProvider(str(tmp_path))
+        cache = ShardedMediaCache(provider, "c1")
+        cache.mark("m1")
+        cache.save()
+        # Fresh instance saved before any read must not clobber the index.
+        cache2 = ShardedMediaCache(provider, "c1")
+        cache2.save()
+        cache3 = ShardedMediaCache(provider, "c1")
+        assert cache3.has("m1")
+
+    def test_expiry(self, tmp_path):
+        from distributed_crawler_tpu.state import ShardedMediaCache
+        from distributed_crawler_tpu.state.providers import LocalStorageProvider
+        provider = LocalStorageProvider(str(tmp_path))
+        cache = ShardedMediaCache(provider, "c1", expiry_days=30)
+        provider.save_json("c1/media-cache-index.json", {
+            "shards": ["shard-00000"],
+            "mediaIndex": {"old": "shard-00000", "new": "shard-00000"}})
+        provider.save_json("c1/media-cache-shard-00000.json", {
+            "cacheId": "shard-00000",
+            "items": {"old": {"id": "old", "firstSeen": "2020-01-01T00:00:00Z"},
+                      "new": {"id": "new", "firstSeen": "2026-07-28T00:00:00Z"}}})
+        assert not cache.has("old")  # expired (30-day TTL)
+        assert cache.has("new")

@@ -1,0 +1,358 @@
+"""SQL graph store tests: binding-boundary SQL assertions (the reference's
+validator_db_test.go strategy) + end-to-end behavior on sqlite, including the
+atomic claim semantics and stale/orphan recovery."""
+
+import threading
+
+import pytest
+
+from distributed_crawler_tpu.state import (
+    CompositeStateManager,
+    EdgeRecord,
+    Page,
+    PendingEdge,
+    PendingEdgeBatch,
+    PendingEdgeUpdate,
+    SqlConfig,
+    SqlGraphStore,
+    SqliteBinding,
+    StateConfig,
+)
+from distributed_crawler_tpu.state.sqlstore import RecordingBinding
+
+
+def store():
+    s = SqlGraphStore(SqliteBinding(":memory:"), "crawl1")
+    s.ensure_schema()
+    return s
+
+
+def make_batch(batch_id="b1", **kw):
+    base = dict(batch_id=batch_id, crawl_id="crawl1", source_channel="src",
+                source_page_id="p1", source_depth=2, sequence_id="seq1")
+    base.update(kw)
+    return PendingEdgeBatch(**base)
+
+
+def make_edge(batch_id="b1", dest="dst", **kw):
+    base = dict(batch_id=batch_id, crawl_id="crawl1", destination_channel=dest,
+                source_channel="src", sequence_id="seq1", source_type="mention")
+    base.update(kw)
+    return PendingEdge(**base)
+
+
+class TestEdgeRecords:
+    def test_save_and_get(self):
+        s = store()
+        s.save_edge_records([EdgeRecord(destination_channel="d1",
+                                        source_channel="s1", walkback=False,
+                                        skipped=False, sequence_id="q1")])
+        rec = s.get_edge_record("q1", "d1")
+        assert rec is not None
+        assert rec.source_channel == "s1" and rec.crawl_id == "crawl1"
+        assert s.get_edge_record("q1", "nope") is None
+
+    def test_skipped_edge_promotion_flow(self):
+        # 400-replacement repair: pick a random skipped edge and promote it.
+        s = store()
+        s.save_edge_records([
+            EdgeRecord(destination_channel="d1", source_channel="s1",
+                       skipped=False, sequence_id="q1"),
+            EdgeRecord(destination_channel="d2", source_channel="s1",
+                       skipped=True, sequence_id="q1"),
+        ])
+        edge = s.get_random_skipped_edge("q1", "s1")
+        assert edge is not None and edge.destination_channel == "d2"
+        s.promote_edge("q1", "d2")
+        assert s.get_random_skipped_edge("q1", "s1") is None
+        assert s.get_edge_record("q1", "d2").skipped is False
+
+    def test_delete_edge_record(self):
+        s = store()
+        s.save_edge_records([EdgeRecord(destination_channel="d1",
+                                        source_channel="s1", sequence_id="q1")])
+        s.delete_edge_record("q1", "d1")
+        assert s.get_edge_record("q1", "d1") is None
+
+
+class TestPageBuffer:
+    def test_add_get_delete(self):
+        s = store()
+        s.add_page_to_page_buffer(Page(id="p1", url="chan1", depth=1,
+                                       parent_id="p0", sequence_id="q1"))
+        s.add_page_to_page_buffer(Page(id="p2", url="chan2", depth=1,
+                                       parent_id="p0"))
+        pages = s.get_pages_from_page_buffer(10)
+        assert {p.url for p in pages} == {"chan1", "chan2"}
+        # Targeted delete only removes named pages (tandem safety).
+        s.delete_page_buffer_pages(["p1"], [])
+        assert [p.url for p in s.get_pages_from_page_buffer(10)] == ["chan2"]
+        s.delete_page_buffer_pages([], ["chan2"])
+        assert s.get_pages_from_page_buffer(10) == []
+
+    def test_crawl_scoping(self):
+        binding = SqliteBinding(":memory:")
+        s1 = SqlGraphStore(binding, "crawl1")
+        s1.ensure_schema()
+        s2 = SqlGraphStore(binding, "crawl2")
+        s1.add_page_to_page_buffer(Page(id="p1", url="chan1"))
+        assert s2.get_pages_from_page_buffer(10) == []
+
+
+class TestSeedAndInvalidChannels:
+    def test_seed_chat_id_cache_and_watermark(self):
+        s = store()
+        s.upsert_seed_channel_chat_id("chan1", 12345)
+        assert s.get_channel_last_crawled("chan1") is None
+        s.mark_channel_crawled("chan1", 12345)
+        assert s.get_channel_last_crawled("chan1") is not None
+        assert ("chan1", 12345) in s.load_seed_channels()
+
+    def test_seed_invalidation_filtered_from_load(self):
+        s = store()
+        s.mark_channel_crawled("chan1", 1)
+        s.mark_channel_crawled("chan2", 2)
+        s.mark_seed_channel_invalid("chan1")
+        names = [u for u, _ in s.load_seed_channels()]
+        assert names == ["chan2"]
+        assert s.get_random_seed_channel() == "chan2"
+
+    def test_invalid_channel_ttl_cache(self):
+        s = store()
+        s.mark_channel_invalid("badchan", "not_found")
+        assert s.load_invalid_channels() == ["badchan"]
+        # Expired rows (beyond TTL) are filtered.
+        assert s.load_invalid_channels(ttl_days=0) in ([], ["badchan"])
+
+
+class TestDiscoveredChannels:
+    def test_first_claim_wins_once(self):
+        s = store()
+        assert s.claim_discovered_channel("chan1", "crawl1") is True
+        assert s.claim_discovered_channel("chan1", "crawl2") is False
+        assert s.is_channel_discovered("chan1")
+        assert not s.is_channel_discovered("chan2")
+
+    def test_concurrent_claims_exactly_one_winner(self):
+        s = store()
+        wins = []
+        def claim(i):
+            if s.claim_discovered_channel("contested", f"crawl{i}"):
+                wins.append(i)
+        threads = [threading.Thread(target=claim, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(wins) == 1
+
+
+class TestTandemQueue:
+    def test_batch_lifecycle(self):
+        s = store()
+        s.create_pending_batch(make_batch())
+        s.insert_pending_edge(make_edge(dest="d1"))
+        s.insert_pending_edge(make_edge(dest="d2"))
+        # Batch still open -> not claimable for walkback even when validated.
+        assert s.claim_walkback_batch() == (None, [])
+        claimed = s.claim_pending_edges(10)
+        assert len(claimed) == 2
+        # Claimed edges are in 'validating'; a second claim returns nothing.
+        assert s.claim_pending_edges(10) == []
+        for e in claimed:
+            s.update_pending_edge(PendingEdgeUpdate(
+                pending_id=e.pending_id, validation_status="valid"))
+        s.close_pending_batch("b1")
+        batch, edges = s.claim_walkback_batch()
+        assert batch is not None and batch.batch_id == "b1"
+        assert batch.status == "processing" and batch.attempt_count == 1
+        assert len(edges) == 2
+        # While processing, nothing else claimable.
+        assert s.claim_walkback_batch() == (None, [])
+        s.complete_pending_batch("b1")
+        assert s.count_incomplete_batches("crawl1") == 0
+
+    def test_walkback_waits_for_pending_validation(self):
+        s = store()
+        s.create_pending_batch(make_batch())
+        s.insert_pending_edge(make_edge(dest="d1"))
+        s.close_pending_batch("b1")
+        # Edge still pending -> batch not ready.
+        assert s.claim_walkback_batch() == (None, [])
+        e = s.claim_pending_edges(1)[0]
+        # Edge mid-validation ('validating') also blocks the walkback claim
+        # (daprstate.go:4017-4034).
+        assert s.claim_walkback_batch() == (None, [])
+        s.update_pending_edge(PendingEdgeUpdate(pending_id=e.pending_id,
+                                                validation_status="invalid",
+                                                validation_reason="not_found"))
+        batch, edges = s.claim_walkback_batch()
+        assert batch is not None
+        assert edges[0].validation_status == "invalid"
+        assert edges[0].validation_reason == "not_found"
+
+    def test_claim_order_fifo(self):
+        s = store()
+        s.create_pending_batch(make_batch())
+        from datetime import datetime, timezone
+        s.insert_pending_edge(make_edge(
+            dest="late", discovery_time=datetime(2026, 2, 1, tzinfo=timezone.utc)))
+        s.insert_pending_edge(make_edge(
+            dest="early", discovery_time=datetime(2026, 1, 1, tzinfo=timezone.utc)))
+        claimed = s.claim_pending_edges(1)
+        assert claimed[0].destination_channel == "early"
+
+    def test_stale_batch_recovery_and_poison(self):
+        s = store()
+        s.create_pending_batch(make_batch())
+        s.close_pending_batch("b1")
+        batch, _ = s.claim_walkback_batch()
+        assert batch is not None
+        # Not yet stale: nothing recovered.
+        assert s.recover_stale_batch_claims(stale_threshold_s=3600) == 0
+        # Stale (threshold 0 via negative): recovered back to closed.
+        assert s.recover_stale_batch_claims(stale_threshold_s=-1) == 1
+        batch2, _ = s.claim_walkback_batch()
+        assert batch2 is not None and batch2.attempt_count == 2
+        # Drive to poison: attempt_count reaches MAX_BATCH_ATTEMPTS.
+        assert s.recover_stale_batch_claims(-1) == 1
+        batch3, _ = s.claim_walkback_batch()
+        assert batch3.attempt_count == 3
+        # Poison batches are NOT recovered.
+        assert s.recover_stale_batch_claims(-1) == 0
+
+    def test_stale_edge_recovery(self):
+        s = store()
+        s.create_pending_batch(make_batch())
+        s.insert_pending_edge(make_edge(dest="d1"))
+        assert len(s.claim_pending_edges(1)) == 1
+        assert s.recover_stale_edge_claims(stale_threshold_s=-1) == 1
+        # Edge is pending again and reclaimable.
+        assert len(s.claim_pending_edges(1)) == 1
+
+    def test_orphan_edge_recovery(self):
+        s = store()
+        s.create_pending_batch(make_batch())
+        s.insert_pending_edge(make_edge(dest="d1"))
+        s.close_pending_batch("b1")
+        # Simulate crash after complete, before flush:
+        s.complete_pending_batch("b1")
+        assert s.recover_orphan_edges() == 1
+        assert s.claim_pending_edges(10) == []
+
+    def test_flush_batch_stats_aggregates_and_deletes(self):
+        s = store()
+        s.create_pending_batch(make_batch())
+        edges = [
+            make_edge(dest="d1", source_type="mention", validation_status="valid"),
+            make_edge(dest="d2", source_type="mention", validation_status="invalid"),
+            make_edge(dest="d3", source_type="url", validation_status="duplicate"),
+        ]
+        for e in edges:
+            s.insert_pending_edge(e)
+        s.flush_batch_stats("b1", "crawl1", edges)
+        rows = s.binding.query(
+            "SELECT source_type, total, valid, invalid, duplicate FROM "
+            "source_type_stats WHERE crawl_id = 'crawl1' ORDER BY source_type")
+        assert rows == [("mention", 2, 1, 1, 0), ("url", 1, 0, 0, 1)]
+        assert s.binding.query("SELECT COUNT(*) FROM pending_edges")[0][0] == 0
+        # Second flush accumulates.
+        s.flush_batch_stats("b1", "crawl1", edges[:1])
+        rows = s.binding.query(
+            "SELECT total FROM source_type_stats WHERE source_type='mention'")
+        assert rows[0][0] == 3
+
+    def test_access_events(self):
+        s = store()
+        s.insert_access_event("ip_blocked")
+        rows = s.binding.query("SELECT reason FROM access_events")
+        assert rows == [("ip_blocked",)]
+
+
+class TestBindingBoundary:
+    """Protocol-level assertions on the SQL the store emits, mirroring the
+    reference's fake-Dapr-client tests (`state/validator_db_test.go`)."""
+
+    def test_claim_sql_shape(self):
+        rec = RecordingBinding()
+        s = SqlGraphStore(rec, "crawl1")
+        rec.canned = [[]]
+        s.claim_pending_edges(10)
+        sql, params = rec.calls[0]
+        assert "validation_status = 'validating'" in sql
+        assert "WHERE validation_status = 'pending'" in sql
+        assert "ORDER BY discovery_time" in sql
+        assert "RETURNING" in sql
+        assert params[-1] == 10
+
+    def test_insert_access_event_sql(self):
+        rec = RecordingBinding()
+        SqlGraphStore(rec, "crawl1").insert_access_event("blocked")
+        sql, params = rec.calls[0]
+        assert sql.startswith("INSERT INTO access_events")
+        assert params[0] == "blocked"
+
+    def test_promote_edge_scoped_to_crawl(self):
+        rec = RecordingBinding()
+        SqlGraphStore(rec, "crawl1").promote_edge("q1", "d1")
+        sql, params = rec.calls[0]
+        assert "SET skipped = 0" in sql and "crawl_id = ?" in sql
+        assert params == ("crawl1", "q1", "d1")
+
+
+class TestCompositeStateManager:
+    def _sm(self, tmp_path):
+        return CompositeStateManager(StateConfig(
+            crawl_id="c1", crawl_execution_id="e1",
+            storage_root=str(tmp_path), sampling_method="random-walk",
+            seed_size=2, sql=SqlConfig(url=":memory:")))
+
+    def test_full_surface(self, tmp_path):
+        sm = self._sm(tmp_path)
+        sm.initialize(["seed1"])
+        # seed channels + chat-ID cache
+        sm.mark_channel_crawled("seed1", 111)
+        sm.load_seed_channels()
+        assert sm.get_cached_chat_id("seed1") == (111, True)
+        assert sm.is_seed_channel("seed1")
+        # invalid channels
+        sm.mark_channel_invalid("bad", "not_found")
+        assert sm.is_invalid_channel("bad")
+        # discovered claim
+        assert sm.claim_discovered_channel("newchan", "c1")
+        assert sm.is_channel_discovered("newchan")
+        # page buffer
+        sm.add_page_to_page_buffer(Page(url="chanX", depth=1, parent_id="p0"))
+        assert len(sm.get_pages_from_page_buffer(5)) == 1
+        # edge records via interface
+        sm.save_edge_records([EdgeRecord(destination_channel="d",
+                                         source_channel="s", sequence_id="q")])
+        assert sm.get_edge_record("q", "d") is not None
+        sm.close()
+
+    def test_seed_urls_from_previous_crawl_skipped(self, tmp_path):
+        # daprstate.go:487-500: a seed already processed by a previous crawl
+        # execution is not re-seeded.
+        import json
+        prev_state = {"layers": [{"depth": 0, "pages": [
+            {"id": "old", "url": "already_done", "status": "fetched"}]}]}
+        (tmp_path / "prev1").mkdir()
+        (tmp_path / "prev1" / "state.json").write_text(json.dumps(prev_state))
+        (tmp_path / "c1").mkdir()
+        (tmp_path / "c1" / "metadata.json").write_text(json.dumps(
+            {"crawlId": "c1", "previousCrawlId": ["prev1"]}))
+        sm = CompositeStateManager(StateConfig(
+            crawl_id="c1", crawl_execution_id="e2", storage_root=str(tmp_path),
+            sql=SqlConfig(url=":memory:")))
+        sm.initialize(["already_done", "fresh"])
+        assert {p.url for p in sm.get_layer_by_depth(0)} == {"fresh"}
+        assert sm.seen_url("already_done")
+
+    def test_random_walk_layer_from_seed_db(self, tmp_path):
+        sm = self._sm(tmp_path)
+        sm.mark_channel_crawled("s1", 1)
+        sm.mark_channel_crawled("s2", 2)
+        sm.initialize_random_walk_layer()
+        urls = {p.url for p in sm.get_layer_by_depth(0)}
+        assert urls == {"s1", "s2"}
+        assert all(p.sequence_id for p in sm.get_layer_by_depth(0))
