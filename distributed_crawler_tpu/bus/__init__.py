@@ -1,0 +1,67 @@
+"""Message bus: typed envelopes, record-batch codec, in-memory + gRPC transports.
+
+The reference's communication fabric was the Dapr sidecar (pubsub over Redis
+Streams, SURVEY.md §2.4); this build brings the bus in-tree:
+
+- `messages`: typed envelopes with validation, topics, priorities, trace IDs
+  (`distributed/messages.go:11-333`)
+- `codec`: the record-batching codec the north star adds — fixed-size batches
+  of Post records, length-prefixed zstd/zlib frames, for streaming crawl
+  output to the TPU inference worker over gRPC/DCN
+- `inmemory`: broker-free bus with the reference's at-least-once semantics
+  (decode error -> drop, handler error -> retry; `distributed/pubsub.go:149-254`)
+- `grpc_bus`: DCN transport — a generic gRPC publish/subscribe service
+
+On-slice tensor communication is NOT this bus's job: that rides XLA
+collectives over ICI (see `parallel/`).
+"""
+
+from .codec import RecordBatch, decode_frames, encode_frame
+from .inmemory import InMemoryBus
+from .messages import (
+    PRIORITY_HIGH,
+    PRIORITY_LOW,
+    PRIORITY_MEDIUM,
+    TOPIC_INFERENCE_BATCHES,
+    TOPIC_INFERENCE_RESULTS,
+    TOPIC_ORCHESTRATOR,
+    TOPIC_RESULTS,
+    TOPIC_WORK_QUEUE,
+    TOPIC_WORKER_STATUS,
+    ControlMessage,
+    DiscoveredPage,
+    ResultMessage,
+    StatusMessage,
+    WorkItem,
+    WorkItemConfig,
+    WorkQueueMessage,
+    WorkResult,
+    new_trace_id,
+    pubsub_topics,
+)
+
+__all__ = [
+    "WorkItem",
+    "WorkItemConfig",
+    "WorkQueueMessage",
+    "WorkResult",
+    "ResultMessage",
+    "DiscoveredPage",
+    "StatusMessage",
+    "ControlMessage",
+    "new_trace_id",
+    "pubsub_topics",
+    "RecordBatch",
+    "encode_frame",
+    "decode_frames",
+    "InMemoryBus",
+    "PRIORITY_HIGH",
+    "PRIORITY_MEDIUM",
+    "PRIORITY_LOW",
+    "TOPIC_WORK_QUEUE",
+    "TOPIC_RESULTS",
+    "TOPIC_WORKER_STATUS",
+    "TOPIC_ORCHESTRATOR",
+    "TOPIC_INFERENCE_BATCHES",
+    "TOPIC_INFERENCE_RESULTS",
+]

@@ -1,0 +1,221 @@
+"""Record-batching codec: the wire format feeding the TPU inference worker.
+
+This is the north star's extension of the reference's message layer
+(BASELINE.json: "`distributed/messages.go` gains a record-batching codec"):
+crawled posts are accumulated into fixed-size batches, serialized as
+length-prefixed compressed frames, and streamed over gRPC/DCN to the TPU
+worker.  Design goals:
+
+- batches sized for the device (default 256 records) so host-side batching,
+  not the wire, sets the padding bucket;
+- zstd compression (zlib fallback) — crawl text compresses ~5-10x, which
+  matters on DCN, not ICI;
+- frame = 4-byte big-endian length + compressed JSON payload, so a byte
+  stream can be incrementally decoded (`decode_frames`).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+try:
+    import zstandard as _zstd
+
+    _ZSTD_C = _zstd.ZstdCompressor(level=3)
+    _ZSTD_D = _zstd.ZstdDecompressor()
+except Exception:  # pragma: no cover - zstd is present in the target image
+    _zstd = None
+
+from ..datamodel import Post
+from ..datamodel.post import format_time, parse_time
+from ..state.datamodels import new_id, utcnow
+
+CODEC_VERSION = 1
+COMPRESSION_ZSTD = "zstd"
+COMPRESSION_ZLIB = "zlib"
+COMPRESSION_NONE = "none"
+
+_MAGIC = b"DCTB"  # frame magic for sanity checking
+_HEADER = struct.Struct(">4sBB I")  # magic, version, compression, length
+
+
+def _compress(data: bytes, method: str) -> bytes:
+    if method == COMPRESSION_ZSTD:
+        if _zstd is None:
+            # Never mislabel: a frame stamped zstd must BE zstd.
+            raise ValueError("zstd compression requested but zstandard unavailable")
+        return _ZSTD_C.compress(data)
+    if method == COMPRESSION_ZLIB:
+        return zlib.compress(data, 6)
+    return data
+
+
+def _decompress(data: bytes, method: str) -> bytes:
+    if method == COMPRESSION_ZSTD:
+        if _zstd is None:
+            raise ValueError("zstd frame received but zstandard unavailable")
+        return _ZSTD_D.decompress(data)
+    if method == COMPRESSION_ZLIB:
+        return zlib.decompress(data)
+    return data
+
+
+_COMP_IDS = {COMPRESSION_NONE: 0, COMPRESSION_ZLIB: 1, COMPRESSION_ZSTD: 2}
+_COMP_NAMES = {v: k for k, v in _COMP_IDS.items()}
+
+
+def default_compression() -> str:
+    return COMPRESSION_ZSTD if _zstd is not None else COMPRESSION_ZLIB
+
+
+@dataclass
+class RecordBatch:
+    """A batch of Post records bound for (or back from) the TPU worker.
+
+    `results` carries the inference outputs on the return path: one dict per
+    record (embedding, label scores, transcript, ...).
+    """
+
+    batch_id: str = ""
+    crawl_id: str = ""
+    source_topic: str = ""
+    created_at: Optional[datetime] = None
+    trace_id: str = ""
+    records: List[Dict[str, Any]] = field(default_factory=list)
+    results: List[Dict[str, Any]] = field(default_factory=list)
+
+    @classmethod
+    def from_posts(cls, posts: List[Post], crawl_id: str = "",
+                   trace_id: str = "") -> "RecordBatch":
+        return cls(batch_id=new_id(), crawl_id=crawl_id, created_at=utcnow(),
+                   trace_id=trace_id,
+                   records=[p.to_dict() for p in posts])
+
+    def posts(self) -> List[Post]:
+        return [Post.from_dict(r) for r in self.records]
+
+    def texts(self) -> List[str]:
+        """The text each record contributes to embed+classify."""
+        return [Post.from_dict(r).text_for_inference() for r in self.records]
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "batch_id": self.batch_id,
+            "crawl_id": self.crawl_id,
+            "source_topic": self.source_topic,
+            "created_at": format_time(self.created_at),
+            "trace_id": self.trace_id,
+            "records": self.records,
+            "results": self.results,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "RecordBatch":
+        return cls(
+            batch_id=d.get("batch_id", "") or "",
+            crawl_id=d.get("crawl_id", "") or "",
+            source_topic=d.get("source_topic", "") or "",
+            created_at=parse_time(d.get("created_at")),
+            trace_id=d.get("trace_id", "") or "",
+            records=list(d.get("records") or []),
+            results=list(d.get("results") or []),
+        )
+
+    def to_bytes(self, compression: Optional[str] = None) -> bytes:
+        return encode_frame(self.to_dict(), compression)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "RecordBatch":
+        payload, rest = decode_frame(data)
+        if rest:
+            raise ValueError(f"{len(rest)} trailing bytes after frame")
+        return cls.from_dict(payload)
+
+
+def encode_frame(payload: Dict[str, Any], compression: Optional[str] = None) -> bytes:
+    """Serialize one payload as a length-prefixed compressed frame."""
+    method = compression or default_compression()
+    if method not in _COMP_IDS:
+        raise ValueError(f"unknown compression: {method}")
+    raw = json.dumps(payload, ensure_ascii=False,
+                     separators=(",", ":")).encode("utf-8")
+    body = _compress(raw, method)
+    return _HEADER.pack(_MAGIC, CODEC_VERSION, _COMP_IDS[method], len(body)) + body
+
+
+def decode_frame(data: bytes) -> Tuple[Dict[str, Any], bytes]:
+    """Decode one frame; returns (payload, remaining_bytes)."""
+    if len(data) < _HEADER.size:
+        raise ValueError("truncated frame header")
+    magic, version, comp_id, length = _HEADER.unpack_from(data)
+    if magic != _MAGIC:
+        raise ValueError("bad frame magic")
+    if version != CODEC_VERSION:
+        raise ValueError(f"unsupported codec version: {version}")
+    if comp_id not in _COMP_NAMES:
+        raise ValueError(f"unknown compression id: {comp_id}")
+    end = _HEADER.size + length
+    if len(data) < end:
+        raise ValueError("truncated frame body")
+    raw = _decompress(data[_HEADER.size:end], _COMP_NAMES[comp_id])
+    return json.loads(raw.decode("utf-8")), data[end:]
+
+
+def decode_frames(data: bytes) -> Iterator[Dict[str, Any]]:
+    """Incrementally decode a concatenated stream of frames."""
+    while data:
+        payload, data = decode_frame(data)
+        yield payload
+
+
+class BatchAccumulator:
+    """Accumulates posts into fixed-size RecordBatches with a deadline.
+
+    The host-side half of keeping the TPU fed from a bursty crawl stream
+    (SURVEY.md §7 hard part (c)): emit when `batch_size` is reached, or when
+    `deadline_s` has elapsed since the first queued record (whichever first).
+    """
+
+    def __init__(self, batch_size: int = 256, deadline_s: float = 0.05,
+                 crawl_id: str = ""):
+        self.batch_size = batch_size
+        self.deadline_s = deadline_s
+        self.crawl_id = crawl_id
+        self._pending: List[Post] = []
+        self._first_at: Optional[float] = None
+
+    def add(self, post: Post, now: float) -> Optional[RecordBatch]:
+        """Queue a post; returns a full batch if one is ready."""
+        if self._first_at is None:
+            self._first_at = now
+        self._pending.append(post)
+        if len(self._pending) >= self.batch_size:
+            return self._emit()
+        return None
+
+    def poll(self, now: float) -> Optional[RecordBatch]:
+        """Returns a partial batch if the deadline has passed."""
+        if self._pending and self._first_at is not None \
+                and now - self._first_at >= self.deadline_s:
+            return self._emit()
+        return None
+
+    def flush(self) -> Optional[RecordBatch]:
+        return self._emit() if self._pending else None
+
+    def _emit(self) -> RecordBatch:
+        batch = RecordBatch.from_posts(self._pending, crawl_id=self.crawl_id)
+        self._pending = []
+        self._first_at = None
+        return batch
+
+    def __len__(self) -> int:
+        return len(self._pending)

@@ -1,0 +1,152 @@
+"""gRPC transport: the DCN leg of the message bus.
+
+The reference talked to its sidecar over gRPC with 201 MB frames
+(`state/daprstate.go:104-133`); here the bus itself is the service.  Uses
+gRPC generic handlers with raw-bytes (de)serializers — no protoc codegen —
+carrying the same JSON payloads as InMemoryBus plus codec frames for record
+batches.  Two RPCs:
+
+- Publish (unary): topic + payload -> ack
+- StreamBatches (server-streaming pull): workers pull record-batch frames for
+  a topic, giving backpressure-aware feeding of the TPU worker.
+
+Tensor traffic never rides this bus: on-slice collectives are XLA/ICI
+(`parallel/`).  This is coordination + record streaming only.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+from concurrent import futures
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import grpc
+
+logger = logging.getLogger("dct.bus.grpc")
+
+SERVICE_NAME = "dct.bus.Bus"
+MAX_FRAME_BYTES = 201 * 1024 * 1024  # parity: daprstate.go:108-110
+
+_TOPIC_SEP = b"\x00"
+
+
+def _encode_envelope(topic: str, payload: bytes) -> bytes:
+    return topic.encode("utf-8") + _TOPIC_SEP + payload
+
+
+def _decode_envelope(data: bytes) -> tuple:
+    topic, _, payload = data.partition(_TOPIC_SEP)
+    return topic.decode("utf-8"), payload
+
+
+def _identity(b: bytes) -> bytes:
+    return b
+
+
+class GrpcBusServer:
+    """Hosts topics; local subscribers receive published payloads, and remote
+    pullers stream queued record batches."""
+
+    def __init__(self, address: str = "127.0.0.1:50551", max_workers: int = 8):
+        self.address = address
+        self._handlers: Dict[str, list] = {}
+        self._pull_queues: Dict[str, "queue.Queue[bytes]"] = {}
+        self._lock = threading.RLock()
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=[("grpc.max_receive_message_length", MAX_FRAME_BYTES),
+                     ("grpc.max_send_message_length", MAX_FRAME_BYTES)])
+        handlers = {
+            "Publish": grpc.unary_unary_rpc_method_handler(
+                self._publish_rpc, request_deserializer=_identity,
+                response_serializer=_identity),
+            "Pull": grpc.unary_stream_rpc_method_handler(
+                self._pull_rpc, request_deserializer=_identity,
+                response_serializer=_identity),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),))
+        self.bound_port = self._server.add_insecure_port(address)
+
+    # --- service ----------------------------------------------------------
+    def _publish_rpc(self, request: bytes, context) -> bytes:
+        topic, payload = _decode_envelope(request)
+        with self._lock:
+            handlers = list(self._handlers.get(topic, []))
+            q = self._pull_queues.get(topic)
+        if q is not None:
+            q.put(payload)
+        for handler in handlers:
+            try:
+                handler(json.loads(payload.decode("utf-8")))
+            except (ValueError, UnicodeDecodeError):
+                logger.error("dropping undecodable message on %s", topic)
+            except Exception as e:
+                logger.warning("handler error on %s: %s", topic, e)
+        return b"ok"
+
+    def _pull_rpc(self, request: bytes, context) -> Iterator[bytes]:
+        topic = request.decode("utf-8")
+        with self._lock:
+            q = self._pull_queues.setdefault(topic, queue.Queue())
+        while context.is_active():
+            try:
+                yield q.get(timeout=0.25)
+            except queue.Empty:
+                continue
+
+    # --- local wiring -----------------------------------------------------
+    def subscribe(self, topic: str, handler: Callable[[Dict[str, Any]], None]) -> None:
+        with self._lock:
+            self._handlers.setdefault(topic, []).append(handler)
+
+    def enable_pull(self, topic: str) -> None:
+        with self._lock:
+            self._pull_queues.setdefault(topic, queue.Queue())
+
+    def start(self) -> None:
+        self._server.start()
+        logger.info("bus server listening on %s", self.address)
+
+    def close(self, grace: float = 0.5) -> None:
+        self._server.stop(grace)
+
+
+class GrpcBusClient:
+    """Publishes payloads / pulls record-batch frames from a GrpcBusServer."""
+
+    def __init__(self, target: str = "127.0.0.1:50551"):
+        self.target = target
+        self._channel = grpc.insecure_channel(
+            target,
+            options=[("grpc.max_receive_message_length", MAX_FRAME_BYTES),
+                     ("grpc.max_send_message_length", MAX_FRAME_BYTES)])
+        self._publish = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/Publish", request_serializer=_identity,
+            response_deserializer=_identity)
+        self._pull = self._channel.unary_stream(
+            f"/{SERVICE_NAME}/Pull", request_serializer=_identity,
+            response_deserializer=_identity)
+
+    def publish(self, topic: str, payload: Any) -> None:
+        if isinstance(payload, bytes):
+            data = payload
+        else:
+            if hasattr(payload, "to_dict"):
+                payload = payload.to_dict()
+            data = json.dumps(payload, ensure_ascii=False).encode("utf-8")
+        self._publish(_encode_envelope(topic, data))
+
+    def publish_frame(self, topic: str, frame: bytes) -> None:
+        """Publish an already-encoded codec frame (record batches)."""
+        self._publish(_encode_envelope(topic, frame))
+
+    def pull(self, topic: str) -> Iterator[bytes]:
+        """Server-streaming pull of raw payloads for a topic."""
+        return self._pull(topic.encode("utf-8"))
+
+    def close(self) -> None:
+        self._channel.close()

@@ -1,0 +1,152 @@
+"""In-memory message bus with at-least-once delivery semantics.
+
+Parity with the reference's pubsub contract (`distributed/pubsub.go:149-254`):
+- a payload that fails to decode is dropped (no retry — it will never parse);
+- a handler that raises is retried up to `max_redeliveries` times (the Dapr
+  "retry" status), then the message is dropped to the dead-letter list;
+- handlers per topic, registered before or after start.
+
+Used exactly like the reference's in-memory integration pubsub
+(`distributed/integration_test.go:109-180`) in tests, and as the standalone
+single-process bus in production modes.  Cross-host transport is
+`bus/grpc_bus.py`.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("dct.bus")
+
+Handler = Callable[[Dict[str, Any]], None]
+
+
+class InMemoryBus:
+    """Topic-based pubsub with retry-on-handler-error."""
+
+    def __init__(self, max_redeliveries: int = 3, retry_delay_s: float = 0.0,
+                 sync: bool = True):
+        """sync=True delivers inline on publish (deterministic for tests and
+        single-process modes); sync=False uses a background dispatch thread."""
+        self.max_redeliveries = max_redeliveries
+        self.retry_delay_s = retry_delay_s
+        self.sync = sync
+        self._handlers: Dict[str, List[Handler]] = {}
+        self._lock = threading.RLock()
+        self._queue: "queue.Queue[Tuple[str, bytes]]" = queue.Queue()
+        self._dead_letters: List[Tuple[str, Dict[str, Any], str]] = []
+        self._published_count: Dict[str, int] = {}
+        self._delivered_count: Dict[str, int] = {}
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+    # --- wiring -----------------------------------------------------------
+    def subscribe(self, topic: str, handler: Handler) -> None:
+        with self._lock:
+            self._handlers.setdefault(topic, []).append(handler)
+
+    def start(self) -> None:
+        """Start async dispatch (no-op in sync mode)."""
+        if self.sync or self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._dispatch_loop,
+                                        name="dct-bus", daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        # At-least-once: deliver anything still queued before shutting down.
+        while True:
+            try:
+                topic, data = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            self._deliver(topic, data)
+
+    # --- publish ----------------------------------------------------------
+    def publish(self, topic: str, payload: Any) -> None:
+        """Publish a dict (JSON-serialized) or raw bytes to a topic."""
+        if isinstance(payload, bytes):
+            data = payload
+        else:
+            if hasattr(payload, "to_dict"):
+                payload = payload.to_dict()
+            data = json.dumps(payload, ensure_ascii=False).encode("utf-8")
+        with self._lock:
+            self._published_count[topic] = self._published_count.get(topic, 0) + 1
+        if self.sync:
+            self._deliver(topic, data)
+        else:
+            self._queue.put((topic, data))
+
+    # --- delivery ---------------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        while self._running:
+            try:
+                topic, data = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            self._deliver(topic, data)
+
+    def _deliver(self, topic: str, data: bytes) -> None:
+        try:
+            payload = json.loads(data.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError) as e:
+            # Undecodable payloads are not retried (`pubsub.go:157-165`).
+            logger.error("dropping undecodable message on %s: %s", topic, e)
+            return
+        with self._lock:
+            handlers = list(self._handlers.get(topic, []))
+        for handler in handlers:
+            delivered = False
+            last_err = ""
+            for attempt in range(self.max_redeliveries + 1):
+                try:
+                    handler(payload)
+                    delivered = True
+                    break
+                except Exception as e:  # handler error -> retry (`pubsub.go:166-171`)
+                    last_err = str(e)
+                    logger.warning("handler error on %s (attempt %d/%d): %s",
+                                   topic, attempt + 1,
+                                   self.max_redeliveries + 1, e)
+                    if self.retry_delay_s > 0:
+                        time.sleep(self.retry_delay_s)
+            with self._lock:
+                if delivered:
+                    self._delivered_count[topic] = \
+                        self._delivered_count.get(topic, 0) + 1
+                else:
+                    self._dead_letters.append((topic, payload, last_err))
+
+    # --- introspection (tests + metrics) ----------------------------------
+    @property
+    def dead_letters(self) -> List[Tuple[str, Dict[str, Any], str]]:
+        with self._lock:
+            return list(self._dead_letters)
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {
+                "published": dict(self._published_count),
+                "delivered": dict(self._delivered_count),
+                "dead_lettered": {"total": len(self._dead_letters)},
+            }
+
+    def drain(self, timeout_s: float = 2.0) -> bool:
+        """Wait for the async queue to empty (tests)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._queue.empty():
+                return True
+            time.sleep(0.005)
+        return self._queue.empty()

@@ -1,0 +1,516 @@
+"""Typed message envelopes for distributed coordination.
+
+Parity with the reference's `distributed/messages.go`: message/status/priority
+constants (`:11-50`), topics (`:53-58`), WorkQueueMessage/WorkItem(+Config)
+(`:61-108`), ResultMessage/WorkResult/DiscoveredPage (`:111-140`),
+StatusMessage (`:143-156`), ControlMessage (`:159-166`), constructors with
+trace-ID generation (`:179-241`), and `Validate()` on every type (`:255-333`).
+"""
+
+from __future__ import annotations
+
+import secrets
+import string
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Any, Dict, List, Optional
+
+from ..datamodel.post import format_time, parse_time
+from ..state.datamodels import utcnow
+
+# --- message types (`messages.go:11-29`) -----------------------------------
+MSG_WORK_ITEM = "work_item"
+MSG_POISON_PILL = "poison_pill"
+MSG_WORK_RESULT = "work_result"
+MSG_DISCOVERED_PAGES = "discovered_pages"
+MSG_HEARTBEAT = "heartbeat"
+MSG_WORKER_STARTED = "worker_started"
+MSG_WORKER_STOPPING = "worker_stopping"
+MSG_PAUSE = "pause"
+MSG_RESUME = "resume"
+MSG_STOP = "stop"
+# New in the TPU build: record batches for the inference worker.
+MSG_RECORD_BATCH = "record_batch"
+MSG_INFERENCE_RESULT = "inference_result"
+
+# --- status values (`messages.go:32-43`) -----------------------------------
+STATUS_SUCCESS = "success"
+STATUS_ERROR = "error"
+STATUS_PARTIAL = "partial"
+STATUS_RETRY = "retry"
+
+WORKER_ACTIVE = "active"
+WORKER_IDLE = "idle"
+WORKER_BUSY = "busy"
+WORKER_ERROR = "error"
+WORKER_OFFLINE = "offline"
+
+# --- priorities (`messages.go:46-50`) --------------------------------------
+PRIORITY_HIGH = 1
+PRIORITY_MEDIUM = 3
+PRIORITY_LOW = 5
+
+# --- topics (`messages.go:53-58` + TPU extensions) -------------------------
+TOPIC_WORK_QUEUE = "crawl-work-queue"
+TOPIC_RESULTS = "crawl-results"
+TOPIC_WORKER_STATUS = "worker-status"
+TOPIC_ORCHESTRATOR = "orchestrator-commands"
+TOPIC_INFERENCE_BATCHES = "tpu-inference-batches"
+TOPIC_INFERENCE_RESULTS = "tpu-inference-results"
+
+VALID_PLATFORMS = ("telegram", "youtube")
+
+_ALPHANUM = string.ascii_letters + string.digits
+
+
+def _rand(n: int) -> str:
+    return "".join(secrets.choice(_ALPHANUM) for _ in range(n))
+
+
+def new_trace_id() -> str:
+    """`messages.go:239-241`."""
+    return "trace_" + utcnow().strftime("%Y%m%d%H%M%S") + "_" + _rand(8)
+
+
+def new_work_item_id() -> str:
+    """`messages.go:233-236`."""
+    return "work_" + utcnow().strftime("%Y%m%d%H%M%S") + "_" + _rand(6)
+
+
+def pubsub_topics() -> List[str]:
+    """`messages.go:169-176` + TPU topics."""
+    return [TOPIC_WORK_QUEUE, TOPIC_RESULTS, TOPIC_WORKER_STATUS,
+            TOPIC_ORCHESTRATOR, TOPIC_INFERENCE_BATCHES, TOPIC_INFERENCE_RESULTS]
+
+
+def _opt_time(value: Any) -> Optional[str]:
+    return format_time(value) if value is not None else None
+
+
+@dataclass
+class WorkItemConfig:
+    """Crawl config carried inside a work item (`messages.go:89-108`)."""
+
+    storage_root: str = ""
+    concurrency: int = 1
+    timeout: int = 30
+    min_post_date: Optional[datetime] = None
+    post_recency: Optional[datetime] = None
+    date_between_min: Optional[datetime] = None
+    date_between_max: Optional[datetime] = None
+    sample_size: int = 0
+    max_comments: int = -1
+    max_posts: int = -1
+    max_depth: int = 0
+    max_pages: int = 0
+    min_users: int = 0
+    crawl_label: str = ""
+    skip_media_download: bool = False
+    youtube_api_key: str = ""
+    sampling_method: str = ""
+    min_channel_videos: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "storage_root": self.storage_root,
+            "concurrency": self.concurrency,
+            "timeout": self.timeout,
+            "min_post_date": _opt_time(self.min_post_date),
+            "post_recency": _opt_time(self.post_recency),
+            "date_between_min": _opt_time(self.date_between_min),
+            "date_between_max": _opt_time(self.date_between_max),
+            "sample_size": self.sample_size,
+            "max_comments": self.max_comments,
+            "max_posts": self.max_posts,
+            "max_depth": self.max_depth,
+            "max_pages": self.max_pages,
+            "min_users": self.min_users,
+            "crawl_label": self.crawl_label,
+            "skip_media_download": self.skip_media_download,
+            "youtube_api_key": self.youtube_api_key,
+            "sampling_method": self.sampling_method,
+            "min_channel_videos": self.min_channel_videos,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "WorkItemConfig":
+        return cls(
+            storage_root=d.get("storage_root", "") or "",
+            concurrency=int(d.get("concurrency") or 1),
+            timeout=int(d.get("timeout") or 30),
+            min_post_date=parse_time(d.get("min_post_date")),
+            post_recency=parse_time(d.get("post_recency")),
+            date_between_min=parse_time(d.get("date_between_min")),
+            date_between_max=parse_time(d.get("date_between_max")),
+            sample_size=int(d.get("sample_size") or 0),
+            max_comments=int(d.get("max_comments") if d.get("max_comments") is not None else -1),
+            max_posts=int(d.get("max_posts") if d.get("max_posts") is not None else -1),
+            max_depth=int(d.get("max_depth") or 0),
+            max_pages=int(d.get("max_pages") or 0),
+            min_users=int(d.get("min_users") or 0),
+            crawl_label=d.get("crawl_label", "") or "",
+            skip_media_download=bool(d.get("skip_media_download") or False),
+            youtube_api_key=d.get("youtube_api_key", "") or "",
+            sampling_method=d.get("sampling_method", "") or "",
+            min_channel_videos=int(d.get("min_channel_videos") or 0),
+        )
+
+
+@dataclass
+class WorkItem:
+    """A single crawl task (`messages.go:71-86`)."""
+
+    id: str = ""
+    url: str = ""
+    depth: int = 0
+    crawl_id: str = ""
+    platform: str = ""
+    config: WorkItemConfig = field(default_factory=WorkItemConfig)
+    parent_id: str = ""
+    retry_count: int = 0
+    assigned_to: str = ""
+    created_at: Optional[datetime] = None
+    assigned_at: Optional[datetime] = None
+    deadline: Optional[datetime] = None
+    metadata: Dict[str, Any] = field(default_factory=dict)
+    trace_id: str = ""
+
+    @classmethod
+    def new(cls, url: str, depth: int, parent_id: str, crawl_id: str,
+            platform: str, config: WorkItemConfig) -> "WorkItem":
+        """`messages.go:179-192`."""
+        return cls(id=new_work_item_id(), url=url, depth=depth,
+                   parent_id=parent_id, crawl_id=crawl_id, platform=platform,
+                   config=config, created_at=utcnow(), trace_id=new_trace_id())
+
+    def validate(self) -> None:
+        """`messages.go:255-269`."""
+        if not self.id:
+            raise ValueError("work item ID cannot be empty")
+        if not self.url:
+            raise ValueError("work item URL cannot be empty")
+        if not self.platform:
+            raise ValueError("work item platform cannot be empty")
+        if self.platform not in VALID_PLATFORMS:
+            raise ValueError(f"unsupported platform: {self.platform}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "id": self.id,
+            "url": self.url,
+            "depth": self.depth,
+            "crawl_id": self.crawl_id,
+            "platform": self.platform,
+            "config": self.config.to_dict(),
+            "parent_id": self.parent_id,
+            "retry_count": self.retry_count,
+            "assigned_to": self.assigned_to,
+            "created_at": _opt_time(self.created_at),
+            "assigned_at": _opt_time(self.assigned_at),
+            "deadline": _opt_time(self.deadline),
+            "metadata": self.metadata,
+            "trace_id": self.trace_id,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "WorkItem":
+        return cls(
+            id=d.get("id", "") or "",
+            url=d.get("url", "") or "",
+            depth=int(d.get("depth") or 0),
+            crawl_id=d.get("crawl_id", "") or "",
+            platform=d.get("platform", "") or "",
+            config=WorkItemConfig.from_dict(d.get("config") or {}),
+            parent_id=d.get("parent_id", "") or "",
+            retry_count=int(d.get("retry_count") or 0),
+            assigned_to=d.get("assigned_to", "") or "",
+            created_at=parse_time(d.get("created_at")),
+            assigned_at=parse_time(d.get("assigned_at")),
+            deadline=parse_time(d.get("deadline")),
+            metadata=dict(d.get("metadata") or {}),
+            trace_id=d.get("trace_id", "") or "",
+        )
+
+
+@dataclass
+class WorkQueueMessage:
+    """Work-queue envelope (`messages.go:61-68`)."""
+
+    message_type: str = MSG_WORK_ITEM
+    work_item: WorkItem = field(default_factory=WorkItem)
+    priority: int = PRIORITY_MEDIUM
+    timestamp: Optional[datetime] = None
+    ttl_seconds: int = 3600
+    trace_id: str = ""
+
+    @classmethod
+    def new(cls, item: WorkItem, priority: int = PRIORITY_MEDIUM,
+            ttl_seconds: int = 3600) -> "WorkQueueMessage":
+        """`messages.go:195-204`."""
+        return cls(message_type=MSG_WORK_ITEM, work_item=item,
+                   priority=priority, timestamp=utcnow(),
+                   ttl_seconds=ttl_seconds, trace_id=new_trace_id())
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "message_type": self.message_type,
+            "work_item": self.work_item.to_dict(),
+            "priority": self.priority,
+            "timestamp": _opt_time(self.timestamp),
+            "ttl_seconds": self.ttl_seconds,
+            "trace_id": self.trace_id,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "WorkQueueMessage":
+        return cls(
+            message_type=d.get("message_type", MSG_WORK_ITEM),
+            work_item=WorkItem.from_dict(d.get("work_item") or {}),
+            priority=int(d.get("priority") or PRIORITY_MEDIUM),
+            timestamp=parse_time(d.get("timestamp")),
+            ttl_seconds=int(d.get("ttl_seconds") or 3600),
+            trace_id=d.get("trace_id", "") or "",
+        )
+
+    def expired(self, now: Optional[datetime] = None) -> bool:
+        if self.timestamp is None or self.ttl_seconds <= 0:
+            return False
+        now = now or utcnow()
+        return (now - self.timestamp).total_seconds() > self.ttl_seconds
+
+
+@dataclass
+class DiscoveredPage:
+    """A newly discovered page (`messages.go:135-140`)."""
+
+    url: str = ""
+    parent_id: str = ""
+    depth: int = 0
+    platform: str = ""
+
+    def validate(self) -> None:
+        """`messages.go:289-300`."""
+        if not self.url:
+            raise ValueError("discovered page URL cannot be empty")
+        if not self.platform:
+            raise ValueError("discovered page platform cannot be empty")
+        if self.depth < 0:
+            raise ValueError("discovered page depth cannot be negative")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"url": self.url, "parent_id": self.parent_id,
+                "depth": self.depth, "platform": self.platform}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "DiscoveredPage":
+        return cls(url=d.get("url", "") or "", parent_id=d.get("parent_id", "") or "",
+                   depth=int(d.get("depth") or 0), platform=d.get("platform", "") or "")
+
+
+@dataclass
+class WorkResult:
+    """Result of a completed work item (`messages.go:120-132`)."""
+
+    work_item_id: str = ""
+    worker_id: str = ""
+    status: str = STATUS_SUCCESS
+    processed_url: str = ""
+    message_count: int = 0
+    discovered_pages: List[DiscoveredPage] = field(default_factory=list)
+    error: str = ""
+    processing_time_s: float = 0.0
+    metadata: Dict[str, Any] = field(default_factory=dict)
+    completed_at: Optional[datetime] = None
+    retry_recommended: bool = False
+
+    def validate(self) -> None:
+        """`messages.go:272-286`."""
+        if not self.work_item_id:
+            raise ValueError("work result WorkItemID cannot be empty")
+        if not self.worker_id:
+            raise ValueError("work result WorkerID cannot be empty")
+        if self.status not in (STATUS_SUCCESS, STATUS_ERROR, STATUS_PARTIAL,
+                               STATUS_RETRY):
+            raise ValueError(f"invalid status: {self.status}")
+        if self.status == STATUS_ERROR and not self.error:
+            raise ValueError("error status requires error message")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "work_item_id": self.work_item_id,
+            "worker_id": self.worker_id,
+            "status": self.status,
+            "processed_url": self.processed_url,
+            "message_count": self.message_count,
+            "discovered_pages": [p.to_dict() for p in self.discovered_pages],
+            "error": self.error,
+            "processing_time": self.processing_time_s,
+            "metadata": self.metadata,
+            "completed_at": _opt_time(self.completed_at),
+            "retry_recommended": self.retry_recommended,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "WorkResult":
+        return cls(
+            work_item_id=d.get("work_item_id", "") or "",
+            worker_id=d.get("worker_id", "") or "",
+            status=d.get("status", STATUS_SUCCESS) or STATUS_SUCCESS,
+            processed_url=d.get("processed_url", "") or "",
+            message_count=int(d.get("message_count") or 0),
+            discovered_pages=[DiscoveredPage.from_dict(p)
+                              for p in (d.get("discovered_pages") or [])],
+            error=d.get("error", "") or "",
+            processing_time_s=float(d.get("processing_time") or 0.0),
+            metadata=dict(d.get("metadata") or {}),
+            completed_at=parse_time(d.get("completed_at")),
+            retry_recommended=bool(d.get("retry_recommended") or False),
+        )
+
+
+@dataclass
+class ResultMessage:
+    """Results envelope (`messages.go:111-117`)."""
+
+    message_type: str = MSG_WORK_RESULT
+    work_result: WorkResult = field(default_factory=WorkResult)
+    discovered_pages: List[DiscoveredPage] = field(default_factory=list)
+    timestamp: Optional[datetime] = None
+    trace_id: str = ""
+
+    @classmethod
+    def new(cls, result: WorkResult,
+            discovered_pages: Optional[List[DiscoveredPage]] = None) -> "ResultMessage":
+        """`messages.go:222-230`."""
+        return cls(message_type=MSG_WORK_RESULT, work_result=result,
+                   discovered_pages=list(discovered_pages or []),
+                   timestamp=utcnow(), trace_id=new_trace_id())
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "message_type": self.message_type,
+            "work_result": self.work_result.to_dict(),
+            "discovered_pages": [p.to_dict() for p in self.discovered_pages],
+            "timestamp": _opt_time(self.timestamp),
+            "trace_id": self.trace_id,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ResultMessage":
+        return cls(
+            message_type=d.get("message_type", MSG_WORK_RESULT),
+            work_result=WorkResult.from_dict(d.get("work_result") or {}),
+            discovered_pages=[DiscoveredPage.from_dict(p)
+                              for p in (d.get("discovered_pages") or [])],
+            timestamp=parse_time(d.get("timestamp")),
+            trace_id=d.get("trace_id", "") or "",
+        )
+
+
+@dataclass
+class StatusMessage:
+    """Worker heartbeat/status (`messages.go:143-156`)."""
+
+    message_type: str = MSG_HEARTBEAT
+    worker_id: str = ""
+    status: str = WORKER_IDLE
+    current_work: Optional[str] = None
+    queue_length: int = 0
+    resource_usage: Dict[str, Any] = field(default_factory=dict)
+    tasks_processed: int = 0
+    tasks_success: int = 0
+    tasks_error: int = 0
+    timestamp: Optional[datetime] = None
+    uptime_s: float = 0.0
+    trace_id: str = ""
+
+    @classmethod
+    def new(cls, worker_id: str, message_type: str, status: str,
+            tasks_processed: int = 0, tasks_success: int = 0,
+            tasks_error: int = 0, uptime_s: float = 0.0) -> "StatusMessage":
+        """`messages.go:207-219`."""
+        return cls(message_type=message_type, worker_id=worker_id, status=status,
+                   tasks_processed=tasks_processed, tasks_success=tasks_success,
+                   tasks_error=tasks_error, timestamp=utcnow(),
+                   uptime_s=uptime_s, trace_id=new_trace_id())
+
+    def validate(self) -> None:
+        """`messages.go:303-333`."""
+        if not self.worker_id:
+            raise ValueError("status message WorkerID cannot be empty")
+        if self.message_type not in (MSG_HEARTBEAT, MSG_WORKER_STARTED,
+                                     MSG_WORKER_STOPPING):
+            raise ValueError(f"invalid message type: {self.message_type}")
+        if self.status not in (WORKER_ACTIVE, WORKER_IDLE, WORKER_BUSY,
+                               WORKER_ERROR, WORKER_OFFLINE):
+            raise ValueError(f"invalid status: {self.status}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "message_type": self.message_type,
+            "worker_id": self.worker_id,
+            "status": self.status,
+            "current_work": self.current_work,
+            "queue_length": self.queue_length,
+            "resource_usage": self.resource_usage,
+            "tasks_processed": self.tasks_processed,
+            "tasks_success": self.tasks_success,
+            "tasks_error": self.tasks_error,
+            "timestamp": _opt_time(self.timestamp),
+            "uptime": self.uptime_s,
+            "trace_id": self.trace_id,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "StatusMessage":
+        return cls(
+            message_type=d.get("message_type", MSG_HEARTBEAT),
+            worker_id=d.get("worker_id", "") or "",
+            status=d.get("status", WORKER_IDLE) or WORKER_IDLE,
+            current_work=d.get("current_work"),
+            queue_length=int(d.get("queue_length") or 0),
+            resource_usage=dict(d.get("resource_usage") or {}),
+            tasks_processed=int(d.get("tasks_processed") or 0),
+            tasks_success=int(d.get("tasks_success") or 0),
+            tasks_error=int(d.get("tasks_error") or 0),
+            timestamp=parse_time(d.get("timestamp")),
+            uptime_s=float(d.get("uptime") or 0.0),
+            trace_id=d.get("trace_id", "") or "",
+        )
+
+
+@dataclass
+class ControlMessage:
+    """Control command (`messages.go:159-166`)."""
+
+    message_type: str = MSG_PAUSE
+    command: str = ""
+    target_id: str = ""  # specific worker ID or "all"
+    parameters: Dict[str, Any] = field(default_factory=dict)
+    timestamp: Optional[datetime] = None
+    trace_id: str = ""
+
+    def validate(self) -> None:
+        if self.message_type not in (MSG_PAUSE, MSG_RESUME, MSG_STOP):
+            raise ValueError(f"invalid control message type: {self.message_type}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "message_type": self.message_type,
+            "command": self.command,
+            "target_id": self.target_id,
+            "parameters": self.parameters,
+            "timestamp": _opt_time(self.timestamp),
+            "trace_id": self.trace_id,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ControlMessage":
+        return cls(
+            message_type=d.get("message_type", MSG_PAUSE),
+            command=d.get("command", "") or "",
+            target_id=d.get("target_id", "") or "",
+            parameters=dict(d.get("parameters") or {}),
+            timestamp=parse_time(d.get("timestamp")),
+            trace_id=d.get("trace_id", "") or "",
+        )

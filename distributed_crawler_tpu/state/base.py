@@ -8,6 +8,7 @@ detection, and the in-memory discovered-channels set.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import threading
 from datetime import datetime
@@ -163,13 +164,24 @@ class BaseStateManager(StateManager):
 
     # --- state snapshot --------------------------------------------------
     def get_state(self) -> State:
-        """Snapshot (`state/base.go:345-372`)."""
+        """Consistent snapshot with copied pages (`state/base.go:345-372` —
+        Go returns value copies; we must copy explicitly so serialization
+        outside the lock can't observe torn in-place mutations)."""
         with self._lock:
+            def copy_page(p: Page) -> Page:
+                return dataclasses.replace(
+                    p, messages=[dataclasses.replace(m) for m in p.messages])
+
             layers = [
-                Layer(depth=d, pages=[self.page_map[i] for i in ids if i in self.page_map])
+                Layer(depth=d, pages=[copy_page(self.page_map[i])
+                                      for i in ids if i in self.page_map])
                 for d, ids in sorted(self.layer_map.items())
             ]
-            return State(layers=layers, metadata=self.metadata,
+            return State(layers=layers,
+                         metadata=dataclasses.replace(
+                             self.metadata,
+                             previous_crawl_id=list(self.metadata.previous_crawl_id),
+                             target_channels=list(self.metadata.target_channels)),
                          last_updated=self.last_updated)
 
     def set_state(self, state: State) -> None:

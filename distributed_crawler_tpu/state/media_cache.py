@@ -119,8 +119,14 @@ class ShardedMediaCache:
         with self._lock:
             if not self._loaded:
                 self.load()
-            if media_id in self._index:
-                return
+            shard_id = self._index.get(media_id)
+            if shard_id is not None:
+                item = self._shards.get(shard_id, {}).get(media_id)
+                if item is not None and not self._expired(item):
+                    return
+                # Expired (or dangling) entry: remove so the re-mark refreshes
+                # first_seen instead of silently no-oping.
+                self._remove(media_id)
             self._put(MediaCacheItem(id=media_id, first_seen=utcnow(),
                                      platform=platform))
 

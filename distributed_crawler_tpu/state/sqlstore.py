@@ -57,6 +57,9 @@ class SqlBinding(Protocol):
     def execute(self, sql: str, params: Sequence[Any] = ()) -> int:
         """Run a statement; returns affected row count."""
 
+    def executemany(self, sql: str, seq_params: Sequence[Sequence[Any]]) -> int:
+        """Run one statement for many parameter rows in a single transaction."""
+
     def execute_returning(self, sql: str, params: Sequence[Any] = ()) -> List[tuple]:
         """Run a mutating statement with RETURNING; returns rows."""
 
@@ -83,6 +86,12 @@ class SqliteBinding:
     def execute(self, sql: str, params: Sequence[Any] = ()) -> int:
         with self._lock:
             cur = self._conn.execute(sql, tuple(params))
+            self._conn.commit()
+            return cur.rowcount
+
+    def executemany(self, sql: str, seq_params: Sequence[Sequence[Any]]) -> int:
+        with self._lock:
+            cur = self._conn.executemany(sql, [tuple(p) for p in seq_params])
             self._conn.commit()
             return cur.rowcount
 
@@ -134,6 +143,11 @@ class RecordingBinding:
         self.calls.append((sql, tuple(params)))
         return self.rowcount
 
+    def executemany(self, sql: str, seq_params: Sequence[Sequence[Any]]) -> int:
+        for p in seq_params:
+            self.calls.append((sql, tuple(p)))
+        return self.rowcount * len(list(seq_params))
+
     def execute_returning(self, sql: str, params: Sequence[Any] = ()) -> List[tuple]:
         self.calls.append((sql, tuple(params)))
         return self._next_rows()
@@ -149,6 +163,16 @@ def _ts(dt: Optional[datetime]) -> str:
 _EDGE_COLS = ("pending_id, batch_id, crawl_id, destination_channel, "
               "source_channel, sequence_id, discovery_time, source_type, "
               "validation_status, validation_reason")
+
+_EDGE_RECORD_COLS = ("destination_channel, source_channel, walkback, skipped, "
+                     "discovery_time, crawl_id, sequence_id")
+
+
+def _row_to_edge_record(row: tuple) -> EdgeRecord:
+    return EdgeRecord(destination_channel=row[0], source_channel=row[1],
+                      walkback=bool(row[2]), skipped=bool(row[3]),
+                      discovery_time=parse_time(row[4]), crawl_id=row[5],
+                      sequence_id=row[6])
 
 _BATCH_COLS = ("batch_id, crawl_id, source_channel, source_page_id, "
                "source_depth, sequence_id, status, attempt_count")
@@ -184,30 +208,24 @@ class SqlGraphStore:
     # edge_records (`daprstate.go:3150-3279`)
     # ------------------------------------------------------------------
     def save_edge_records(self, edges: List[EdgeRecord]) -> None:
-        for e in edges:
-            self.binding.execute(
-                "INSERT INTO edge_records (destination_channel, source_channel, "
-                "walkback, skipped, discovery_time, crawl_id, sequence_id) "
-                "VALUES (?, ?, ?, ?, ?, ?, ?)",
-                (e.destination_channel, e.source_channel, int(e.walkback),
-                 int(e.skipped), _ts(e.discovery_time),
-                 e.crawl_id or self.crawl_id, e.sequence_id))
+        if not edges:
+            return
+        self.binding.executemany(
+            "INSERT INTO edge_records (destination_channel, source_channel, "
+            "walkback, skipped, discovery_time, crawl_id, sequence_id) "
+            "VALUES (?, ?, ?, ?, ?, ?, ?)",
+            [(e.destination_channel, e.source_channel, int(e.walkback),
+              int(e.skipped), _ts(e.discovery_time),
+              e.crawl_id or self.crawl_id, e.sequence_id) for e in edges])
 
     def get_edge_record(self, sequence_id: str,
                         destination_channel: str) -> Optional[EdgeRecord]:
         rows = self.binding.query(
-            "SELECT destination_channel, source_channel, walkback, skipped, "
-            "discovery_time, crawl_id, sequence_id FROM edge_records "
+            f"SELECT {_EDGE_RECORD_COLS} FROM edge_records "
             "WHERE crawl_id = ? AND sequence_id = ? AND destination_channel = ? "
             "LIMIT 1",
             (self.crawl_id, sequence_id, destination_channel))
-        if not rows:
-            return None
-        r = rows[0]
-        return EdgeRecord(destination_channel=r[0], source_channel=r[1],
-                          walkback=bool(r[2]), skipped=bool(r[3]),
-                          discovery_time=parse_time(r[4]), crawl_id=r[5],
-                          sequence_id=r[6])
+        return _row_to_edge_record(rows[0]) if rows else None
 
     def delete_edge_record(self, sequence_id: str, destination_channel: str) -> None:
         self.binding.execute(
@@ -218,18 +236,11 @@ class SqlGraphStore:
     def get_random_skipped_edge(self, sequence_id: str,
                                 source_channel: str) -> Optional[EdgeRecord]:
         rows = self.binding.query(
-            "SELECT destination_channel, source_channel, walkback, skipped, "
-            "discovery_time, crawl_id, sequence_id FROM edge_records "
+            f"SELECT {_EDGE_RECORD_COLS} FROM edge_records "
             "WHERE crawl_id = ? AND skipped = 1 AND sequence_id = ? "
             "AND source_channel = ? ORDER BY RANDOM() LIMIT 1",
             (self.crawl_id, sequence_id, source_channel))
-        if not rows:
-            return None
-        r = rows[0]
-        return EdgeRecord(destination_channel=r[0], source_channel=r[1],
-                          walkback=bool(r[2]), skipped=bool(r[3]),
-                          discovery_time=parse_time(r[4]), crawl_id=r[5],
-                          sequence_id=r[6])
+        return _row_to_edge_record(rows[0]) if rows else None
 
     def promote_edge(self, sequence_id: str, destination_channel: str) -> None:
         self.binding.execute(
@@ -258,14 +269,14 @@ class SqlGraphStore:
                                  page_urls: List[str]) -> None:
         """Delete only the processed pages — never wipe rows the validator
         wrote after the read (`state/interface.go:105-107`)."""
-        for pid in page_ids:
-            self.binding.execute(
+        if page_ids:
+            self.binding.executemany(
                 "DELETE FROM page_buffer WHERE crawl_id = ? AND page_id = ?",
-                (self.crawl_id, pid))
-        for url in page_urls:
-            self.binding.execute(
+                [(self.crawl_id, pid) for pid in page_ids])
+        if page_urls:
+            self.binding.executemany(
                 "DELETE FROM page_buffer WHERE crawl_id = ? AND url = ?",
-                (self.crawl_id, url))
+                [(self.crawl_id, url) for url in page_urls])
 
     # ------------------------------------------------------------------
     # seed_channels (`daprstate.go:3076-3578`)

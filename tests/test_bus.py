@@ -1,0 +1,263 @@
+"""Bus tests: envelope validation, record-batch codec round-trips, in-memory
+at-least-once semantics, gRPC transport round trip (reference analogs:
+distributed message validation + integration_test.go)."""
+
+import json
+
+import pytest
+
+from distributed_crawler_tpu.bus import (
+    ControlMessage,
+    DiscoveredPage,
+    InMemoryBus,
+    RecordBatch,
+    ResultMessage,
+    StatusMessage,
+    WorkItem,
+    WorkItemConfig,
+    WorkQueueMessage,
+    WorkResult,
+    decode_frames,
+    encode_frame,
+    pubsub_topics,
+)
+from distributed_crawler_tpu.bus.codec import (
+    COMPRESSION_NONE,
+    COMPRESSION_ZLIB,
+    BatchAccumulator,
+    decode_frame,
+)
+from distributed_crawler_tpu.datamodel import Post
+
+
+class TestMessageValidation:
+    def test_work_item_constructor_and_roundtrip(self):
+        item = WorkItem.new("https://t.me/x", 2, "p1", "c1", "telegram",
+                            WorkItemConfig(storage_root="/tmp/s"))
+        item.validate()
+        assert item.id.startswith("work_")
+        assert item.trace_id.startswith("trace_")
+        item2 = WorkItem.from_dict(json.loads(json.dumps(item.to_dict())))
+        assert item2 == item
+
+    def test_work_item_validation_errors(self):
+        item = WorkItem.new("u", 0, "", "c", "telegram", WorkItemConfig())
+        item.platform = "tiktok"
+        with pytest.raises(ValueError, match="unsupported platform"):
+            item.validate()
+        item.platform = ""
+        with pytest.raises(ValueError, match="platform cannot be empty"):
+            item.validate()
+        item = WorkItem(id="", url="u", platform="telegram")
+        with pytest.raises(ValueError, match="ID cannot be empty"):
+            item.validate()
+
+    def test_work_result_validation(self):
+        r = WorkResult(work_item_id="w", worker_id="k", status="error")
+        with pytest.raises(ValueError, match="requires error message"):
+            r.validate()
+        r.error = "boom"
+        r.validate()
+        r.status = "nonsense"
+        with pytest.raises(ValueError, match="invalid status"):
+            r.validate()
+
+    def test_discovered_page_validation(self):
+        with pytest.raises(ValueError, match="URL"):
+            DiscoveredPage(platform="telegram").validate()
+        with pytest.raises(ValueError, match="depth"):
+            DiscoveredPage(url="u", platform="telegram", depth=-1).validate()
+        DiscoveredPage(url="u", platform="telegram", depth=1).validate()
+
+    def test_status_message_validation(self):
+        s = StatusMessage.new("w1", "heartbeat", "busy", 5, 4, 1, 60.0)
+        s.validate()
+        s.message_type = "bogus"
+        with pytest.raises(ValueError, match="invalid message type"):
+            s.validate()
+        s = StatusMessage.new("w1", "heartbeat", "bogus")
+        with pytest.raises(ValueError, match="invalid status"):
+            s.validate()
+
+    def test_queue_message_ttl(self):
+        from datetime import timedelta
+        from distributed_crawler_tpu.state.datamodels import utcnow
+        msg = WorkQueueMessage.new(
+            WorkItem.new("u", 0, "", "c", "telegram", WorkItemConfig()),
+            ttl_seconds=10)
+        assert not msg.expired()
+        assert msg.expired(now=utcnow() + timedelta(seconds=11))
+
+    def test_result_message_roundtrip(self):
+        result = WorkResult(work_item_id="w", worker_id="k", status="success",
+                            message_count=7,
+                            discovered_pages=[DiscoveredPage(url="a", depth=1,
+                                                             platform="telegram")])
+        msg = ResultMessage.new(result, result.discovered_pages)
+        msg2 = ResultMessage.from_dict(json.loads(json.dumps(msg.to_dict())))
+        assert msg2.work_result.message_count == 7
+        assert msg2.discovered_pages[0].url == "a"
+
+    def test_topics(self):
+        topics = pubsub_topics()
+        assert "crawl-work-queue" in topics
+        assert "tpu-inference-batches" in topics
+
+
+def make_posts(n):
+    return [Post(post_link=f"l{i}", channel_id="c", post_uid=str(i),
+                 url=f"l{i}", platform_name="telegram",
+                 description=f"текст сообщения номер {i} " * 10)
+            for i in range(n)]
+
+
+class TestRecordBatchCodec:
+    def test_roundtrip_zstd(self):
+        batch = RecordBatch.from_posts(make_posts(16), crawl_id="c1")
+        data = batch.to_bytes()
+        batch2 = RecordBatch.from_bytes(data)
+        assert batch2.batch_id == batch.batch_id
+        assert len(batch2) == 16
+        assert batch2.posts()[3].post_uid == "3"
+
+    def test_compression_shrinks(self):
+        batch = RecordBatch.from_posts(make_posts(64))
+        raw = len(batch.to_bytes(COMPRESSION_NONE))
+        compressed = len(batch.to_bytes())
+        assert compressed < raw / 3  # repetitive crawl text compresses hard
+
+    def test_stream_of_frames(self):
+        frames = b"".join(
+            RecordBatch.from_posts(make_posts(2), crawl_id=f"c{i}").to_bytes(
+                COMPRESSION_ZLIB)
+            for i in range(3))
+        decoded = [RecordBatch.from_dict(d) for d in decode_frames(frames)]
+        assert [b.crawl_id for b in decoded] == ["c0", "c1", "c2"]
+
+    def test_corrupt_frames_rejected(self):
+        good = encode_frame({"x": 1})
+        with pytest.raises(ValueError, match="magic"):
+            decode_frame(b"XXXX" + good[4:])
+        with pytest.raises(ValueError, match="truncated"):
+            decode_frame(good[:-2])
+        with pytest.raises(ValueError, match="trailing"):
+            RecordBatch.from_bytes(good + b"junk")
+
+    def test_texts_extraction(self):
+        batch = RecordBatch.from_posts([
+            Post(post_uid="1", all_text="A"),
+            Post(post_uid="2", description="D")])
+        assert batch.texts() == ["A", "D"]
+
+
+class TestBatchAccumulator:
+    def test_emits_on_size(self):
+        acc = BatchAccumulator(batch_size=3, deadline_s=10.0)
+        posts = make_posts(7)
+        batches = [b for i, p in enumerate(posts)
+                   if (b := acc.add(p, now=float(i))) is not None]
+        assert [len(b) for b in batches] == [3, 3]
+        assert len(acc) == 1
+        tail = acc.flush()
+        assert tail is not None and len(tail) == 1
+
+    def test_emits_on_deadline(self):
+        acc = BatchAccumulator(batch_size=100, deadline_s=0.5)
+        acc.add(make_posts(1)[0], now=0.0)
+        assert acc.poll(now=0.4) is None
+        batch = acc.poll(now=0.6)
+        assert batch is not None and len(batch) == 1
+        assert acc.poll(now=1.0) is None  # nothing pending
+
+
+class TestInMemoryBus:
+    def test_pubsub_roundtrip(self):
+        bus = InMemoryBus()
+        got = []
+        bus.subscribe("t1", got.append)
+        bus.publish("t1", {"a": 1})
+        bus.publish("t2", {"b": 2})  # different topic, not delivered to t1
+        assert got == [{"a": 1}]
+
+    def test_handler_error_retries_then_dead_letters(self):
+        bus = InMemoryBus(max_redeliveries=2)
+        attempts = []
+        def flaky(msg):
+            attempts.append(1)
+            raise RuntimeError("boom")
+        bus.subscribe("t", flaky)
+        bus.publish("t", {"x": 1})
+        assert len(attempts) == 3  # 1 + 2 retries
+        assert len(bus.dead_letters) == 1
+        topic, payload, err = bus.dead_letters[0]
+        assert topic == "t" and payload == {"x": 1} and "boom" in err
+
+    def test_handler_recovers_mid_retry(self):
+        bus = InMemoryBus(max_redeliveries=3)
+        state = {"n": 0}
+        def eventually(msg):
+            state["n"] += 1
+            if state["n"] < 3:
+                raise RuntimeError("not yet")
+        bus.subscribe("t", eventually)
+        bus.publish("t", {})
+        assert state["n"] == 3
+        assert bus.dead_letters == []
+
+    def test_undecodable_payload_dropped_no_retry(self):
+        bus = InMemoryBus()
+        calls = []
+        bus.subscribe("t", calls.append)
+        bus.publish("t", b"\xff\xfenot json")
+        assert calls == []
+        assert bus.dead_letters == []  # dropped, not dead-lettered
+
+    def test_async_mode(self):
+        bus = InMemoryBus(sync=False)
+        bus.start()
+        got = []
+        bus.subscribe("t", got.append)
+        for i in range(20):
+            bus.publish("t", {"i": i})
+        assert bus.drain()
+        bus.close()
+        import time
+        deadline = time.monotonic() + 2
+        while len(got) < 20 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(got) == 20
+
+    def test_typed_message_publish(self):
+        bus = InMemoryBus()
+        got = []
+        bus.subscribe("worker-status", got.append)
+        bus.publish("worker-status",
+                    StatusMessage.new("w1", "heartbeat", "idle"))
+        assert got[0]["worker_id"] == "w1"
+        parsed = StatusMessage.from_dict(got[0])
+        parsed.validate()
+
+
+class TestGrpcBus:
+    def test_publish_and_pull_roundtrip(self):
+        from distributed_crawler_tpu.bus.grpc_bus import GrpcBusClient, GrpcBusServer
+        server = GrpcBusServer(address="127.0.0.1:0")
+        received = []
+        server.subscribe("worker-status", received.append)
+        server.enable_pull("tpu-inference-batches")
+        server.start()
+        try:
+            client = GrpcBusClient(target=f"127.0.0.1:{server.bound_port}")
+            client.publish("worker-status", {"worker_id": "w1"})
+            assert received == [{"worker_id": "w1"}]
+            # Record-batch frame via pull stream.
+            batch = RecordBatch.from_posts(make_posts(4), crawl_id="c1")
+            client.publish_frame("tpu-inference-batches", batch.to_bytes())
+            stream = client.pull("tpu-inference-batches")
+            frame = next(iter(stream))
+            got = RecordBatch.from_bytes(frame)
+            assert got.crawl_id == "c1" and len(got) == 4
+            stream.cancel()
+            client.close()
+        finally:
+            server.close()
