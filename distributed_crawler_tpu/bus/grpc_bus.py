@@ -79,13 +79,18 @@ class GrpcBusServer:
             q = self._pull_queues.get(topic)
         if q is not None:
             q.put(payload)
-        for handler in handlers:
+        if handlers:
             try:
-                handler(json.loads(payload.decode("utf-8")))
+                decoded = json.loads(payload.decode("utf-8"))
             except (ValueError, UnicodeDecodeError):
+                # Undecodable payloads are dropped, never retried.
                 logger.error("dropping undecodable message on %s", topic)
-            except Exception as e:
-                logger.warning("handler error on %s: %s", topic, e)
+                return b"ok"
+            for handler in handlers:
+                try:
+                    handler(decoded)
+                except Exception as e:
+                    logger.warning("handler error on %s: %s", topic, e)
         return b"ok"
 
     def _pull_rpc(self, request: bytes, context) -> Iterator[bytes]:
@@ -94,9 +99,16 @@ class GrpcBusServer:
             q = self._pull_queues.setdefault(topic, queue.Queue())
         while context.is_active():
             try:
-                yield q.get(timeout=0.25)
+                item = q.get(timeout=0.25)
             except queue.Empty:
                 continue
+            try:
+                yield item
+            except BaseException:
+                # Stream cancelled between pop and consume: requeue so the
+                # batch isn't lost (at-least-once for pulled frames).
+                q.put(item)
+                raise
 
     # --- local wiring -----------------------------------------------------
     def subscribe(self, topic: str, handler: Callable[[Dict[str, Any]], None]) -> None:

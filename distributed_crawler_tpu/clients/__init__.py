@@ -1,0 +1,72 @@
+"""Platform clients: the Telegram client boundary, pools, limiters, validators,
+and the YouTube Data client.
+
+Parity with the reference's layer 5 (SURVEY.md §1): `crawler.TDLibClient`
+(16 methods, `crawler/crawler.go:109-126`), the per-method rate limiter
+(`telegramhelper/rate_limiter.go`), the connection pool
+(`telegramhelper/connection_pool.go`), the account-free t.me HTTP validator
+(`telegramhelper/channelvalidator.go` + `username_filter.go`), and the YouTube
+Data API client (`client/youtube_client.go`).
+
+The real MTProto transport is the C++ native client in `native/` (the
+reference's TDLib analog), loaded via ctypes in `clients/native.py`; `sim.py`
+is the in-process network simulation used by tests and available as an
+explicit backend.
+"""
+
+from .errors import FloodWaitError, TelegramError, parse_flood_wait_seconds
+from .http_validator import (
+    BLOCKED,
+    TRANSIENT,
+    ChannelValidationResult,
+    ValidationHTTPError,
+    ValidatorRateLimiter,
+    parse_channel_html,
+    validate_channel_http,
+)
+from .pool import ConnectionPool, PooledConnection
+from .rate_limiter import (
+    Clock,
+    FakeClock,
+    RateLimitedTelegramClient,
+    SystemClock,
+    TokenBucket,
+    detect_cache_or_server,
+)
+from .sim import SimChannel, SimNetwork, SimTelegramClient
+from .telegram import (
+    TelegramClient,
+    TLChat,
+    TLFile,
+    TLMessage,
+    TLMessageLink,
+    TLMessages,
+    TLMessageThreadInfo,
+    TLSupergroup,
+    TLSupergroupFullInfo,
+    TLUser,
+)
+from .username_filter import UsernameFilterResult, filter_username
+from .youtube import (
+    FakeYouTubeTransport,
+    YouTubeClient,
+    YouTubeDataClient,
+    generate_random_prefix,
+)
+
+__all__ = [
+    "TelegramClient", "TelegramError", "FloodWaitError",
+    "parse_flood_wait_seconds",
+    "TLMessage", "TLMessages", "TLChat", "TLSupergroup",
+    "TLSupergroupFullInfo", "TLUser", "TLFile", "TLMessageLink",
+    "TLMessageThreadInfo",
+    "TokenBucket", "RateLimitedTelegramClient", "detect_cache_or_server",
+    "Clock", "SystemClock", "FakeClock",
+    "ConnectionPool", "PooledConnection",
+    "SimTelegramClient", "SimNetwork", "SimChannel",
+    "filter_username", "UsernameFilterResult",
+    "validate_channel_http", "parse_channel_html", "ChannelValidationResult",
+    "ValidationHTTPError", "ValidatorRateLimiter", "TRANSIENT", "BLOCKED",
+    "YouTubeClient", "YouTubeDataClient", "FakeYouTubeTransport",
+    "generate_random_prefix",
+]

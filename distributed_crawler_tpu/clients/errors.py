@@ -1,0 +1,69 @@
+"""Telegram client error taxonomy.
+
+Parity with the reference's error handling (`crawl/runner.go:32-113`):
+FLOOD_WAIT parsing for both TDLib ("FLOOD_WAIT_N") and HTTP-429
+("retry after N") formats, 400 detection, and the retire threshold.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Tuple
+
+# FLOOD_WAITs at or above this many seconds permanently retire the connection
+# (`crawl/runner.go:49`).
+FLOOD_WAIT_RETIRE_THRESHOLD_S = 300
+
+
+class TelegramError(Exception):
+    """An error returned by the Telegram client boundary."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(f"[{code}] {message}")
+        self.code = code
+        self.message = message
+
+
+class FloodWaitError(TelegramError):
+    """A 429 FLOOD_WAIT with a retry-after duration."""
+
+    def __init__(self, retry_after_s: int):
+        super().__init__(429, f"FLOOD_WAIT_{retry_after_s}")
+        self.retry_after_s = retry_after_s
+
+
+_FLOOD_RE = re.compile(r"FLOOD_WAIT_(\d+)")
+_RETRY_RE = re.compile(r"retry after (\d+)")
+
+
+def parse_flood_wait_seconds(err: Optional[BaseException]) -> Tuple[int, bool]:
+    """Returns (seconds, is_flood_wait) (`crawl/runner.go:55-97`).
+
+    (0, True) means a FLOOD_WAIT whose duration couldn't be parsed — treat as
+    a short ban (skip, don't retire).
+    """
+    if err is None:
+        return 0, False
+    if isinstance(err, FloodWaitError):
+        return err.retry_after_s, True
+    s = str(err)
+    if "FLOOD_WAIT_" in s:
+        m = _FLOOD_RE.search(s)
+        return (int(m.group(1)), True) if m else (0, True)
+    if "retry after " in s:
+        m = _RETRY_RE.search(s)
+        return (int(m.group(1)), True) if m else (0, True)
+    return 0, False
+
+
+def is_telegram_400(err: Optional[BaseException]) -> bool:
+    """Permanently-invalid channel detection (`crawl/runner.go:104-113`)."""
+    if err is None:
+        return False
+    if isinstance(err, TelegramError) and err.code == 400:
+        return True
+    s = str(err)
+    return ("[400]" in s
+            or "400 USERNAME_NOT_OCCUPIED" in s
+            or "400 USERNAME_INVALID" in s
+            or "no messages found in the chat" in s)

@@ -1,0 +1,180 @@
+"""Account-free Telegram channel validation by scraping https://t.me/<user>.
+
+Parity with `telegramhelper/channelvalidator.go` + `validator_rate_limiter.go`:
+- title/robots-meta parsing rules (`channelvalidator.go:130-192`)
+- transient-vs-blocked error taxonomy (`:27-47`)
+- rotating Chromium UA pool (`:18-23`)
+- token-bucket + jitter request limiter (`validator_rate_limiter.go:23-55`)
+
+Transport note: the reference used uTLS to present a Chrome JA3 fingerprint
+(`utlstransport.go`).  Python's ssl stack can't reshape its ClientHello; the
+fingerprint-matched transport belongs to the C++ native layer (`native/`).
+The `transport` parameter here accepts any callable
+``(url, headers) -> (status_code, body_bytes)`` so production can route
+through the native transport and tests use fixtures.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple
+
+from .rate_limiter import Clock, SystemClock, TokenBucket
+
+logger = logging.getLogger("dct.clients.validator")
+
+# Chromium-only UA pool — mixing engines would mismatch the TLS fingerprint
+# (`channelvalidator.go:18-23`).
+BROWSER_USER_AGENTS = [
+    "Mozilla/5.0 (Windows NT 10.0; Win64; x64) AppleWebKit/537.36 (KHTML, like Gecko) Chrome/124.0.0.0 Safari/537.36",
+    "Mozilla/5.0 (Macintosh; Intel Mac OS X 10_15_7) AppleWebKit/537.36 (KHTML, like Gecko) Chrome/124.0.0.0 Safari/537.36",
+    "Mozilla/5.0 (X11; Linux x86_64) AppleWebKit/537.36 (KHTML, like Gecko) Chrome/124.0.0.0 Safari/537.36",
+    "Mozilla/5.0 (Windows NT 10.0; Win64; x64) AppleWebKit/537.36 (KHTML, like Gecko) Chrome/124.0.0.0 Safari/537.36 Edg/124.0.0.0",
+]
+
+MAX_READ_BYTES = 64 * 1024  # signals live in <head> (`channelvalidator.go:107`)
+
+# Error kinds (`channelvalidator.go:27-40`).
+TRANSIENT = "transient"  # retry the edge later
+BLOCKED = "blocked"  # IP-level block / soft block: pause validation
+
+
+class ValidationHTTPError(Exception):
+    def __init__(self, kind: str, message: str):
+        super().__init__(message)
+        self.kind = kind
+
+
+@dataclass
+class ChannelValidationResult:
+    """`channelvalidator.go:50-54`."""
+
+    status: str = ""  # valid | not_channel | invalid
+    reason: str = ""  # "" | not_supergroup | not_found
+
+
+Transport = Callable[[str, dict], Tuple[int, bytes]]
+
+
+def urllib_transport(url: str, headers: dict) -> Tuple[int, bytes]:
+    """Default stdlib transport (no fingerprint shaping — see module note)."""
+    req = urllib.request.Request(url, headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, resp.read(MAX_READ_BYTES)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(MAX_READ_BYTES) if e.fp else b""
+
+
+def _extract_title(html: str) -> str:
+    """First <title> content (`channelvalidator.go:160-174`)."""
+    lower = html.lower()
+    start = lower.find("<title>")
+    if start == -1:
+        return ""
+    start += len("<title>")
+    end = lower.find("</title>", start)
+    if end == -1:
+        return ""
+    return html[start:end].strip()
+
+
+def _has_robots_noindex(html: str) -> bool:
+    """`channelvalidator.go:177-192`."""
+    lower = html.lower()
+    idx = lower.find('name="robots"')
+    if idx == -1:
+        return False
+    tag_start = lower.rfind("<", 0, idx)
+    tag_end = lower.find(">", idx)
+    if tag_start == -1 or tag_end == -1:
+        return False
+    return "noindex" in lower[tag_start:tag_end + 1]
+
+
+def parse_channel_html(html: str) -> ChannelValidationResult:
+    """Parsing rules derived from saved t.me responses
+    (`channelvalidator.go:130-158`):
+
+    - title contains "Telegram: View @"        -> valid channel/supergroup
+    - title contains "Telegram: Contact @":
+        robots noindex -> username not occupied (invalid/not_found)
+        otherwise      -> user/bot/basic group (not_channel/not_supergroup)
+    - title "Telegram Messenger" (reserved-path redirect) -> invalid/not_found
+
+    Raises ValueError on unrecognised titles (caller treats as soft-block).
+    """
+    title = _extract_title(html)
+    if "Telegram: View @" in title:
+        return ChannelValidationResult(status="valid")
+    if "Telegram: Contact @" in title:
+        if _has_robots_noindex(html):
+            return ChannelValidationResult(status="invalid", reason="not_found")
+        return ChannelValidationResult(status="not_channel", reason="not_supergroup")
+    if title == "Telegram Messenger":
+        return ChannelValidationResult(status="invalid", reason="not_found")
+    raise ValueError(f"unrecognised title pattern: {title!r}")
+
+
+def validate_channel_http(username: str,
+                          transport: Transport = urllib_transport,
+                          rng: Optional[random.Random] = None
+                          ) -> ChannelValidationResult:
+    """Fetch https://t.me/<username> and classify (`channelvalidator.go:64-127`)."""
+    rng = rng or random
+    url = f"https://t.me/{username}"
+    headers = {
+        "User-Agent": rng.choice(BROWSER_USER_AGENTS),
+        "Accept": "text/html,application/xhtml+xml,application/xml;q=0.9,"
+                  "image/webp,*/*;q=0.8",
+        "Accept-Language": "en-US,en;q=0.9",
+        "Upgrade-Insecure-Requests": "1",
+        "Sec-Fetch-Dest": "document",
+        "Sec-Fetch-Mode": "navigate",
+        "Sec-Fetch-Site": "none",
+    }
+    try:
+        status_code, body = transport(url, headers)
+    except Exception as e:
+        raise ValidationHTTPError(
+            TRANSIENT, f"HTTP request failed for {username}: {e}") from e
+
+    if status_code != 200:
+        # 5xx transient; 403/429/other 4xx treated as block (`:95-105`).
+        kind = TRANSIENT if status_code >= 500 else BLOCKED
+        raise ValidationHTTPError(
+            kind, f"unexpected status {status_code} for {username}")
+
+    html = body[:MAX_READ_BYTES].decode("utf-8", errors="replace")
+    try:
+        return parse_channel_html(html)
+    except ValueError as e:
+        # Unrecognised 200 response: soft-block, not definitive invalid.
+        logger.warning("unrecognised HTML response",
+                       extra={"channel": username})
+        raise ValidationHTTPError(
+            BLOCKED, f"failed to parse response for {username}: {e}") from e
+
+
+class ValidatorRateLimiter:
+    """Token-bucket + jitter limiter for validator HTTP requests
+    (`validator_rate_limiter.go:23-55`)."""
+
+    def __init__(self, requests_per_minute: float = 6.0, jitter_ms: int = 200,
+                 clock: Optional[Clock] = None,
+                 rng: Optional[random.Random] = None):
+        self.clock = clock or SystemClock()
+        self._bucket = TokenBucket(requests_per_minute, self.clock)
+        self.jitter_ms = jitter_ms
+        self._rng = rng or random.Random()
+
+    def wait(self) -> float:
+        waited = self._bucket.wait()
+        jitter = (self._rng.randint(0, self.jitter_ms) / 1000.0
+                  if self.jitter_ms > 0 else 0.0)
+        self.clock.sleep(jitter)
+        return waited + jitter

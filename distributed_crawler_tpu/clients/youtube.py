@@ -1,0 +1,366 @@
+"""YouTube Data API v3 client.
+
+Parity with the reference's `client/youtube_client.go` (1931 LoC):
+- channel info (`:195`), paged video listing via the uploads playlist
+  (`:319-878`), batched video lookup with a stats cache (`:1077-1112,
+  1899-1912`);
+- random sampling via 5-char lowercase prefix generation + batch verification
+  ("Dialing for Videos", McGrady et al. 2023; `:886-910,1109-...`,
+  `model/youtube/types.go:58-60`);
+- snowball discovery via channel IDs extracted from video descriptions
+  (`:1547,1856`);
+- API-key transport seam (`:59-75`) — injectable here, so tests run against
+  `FakeYouTubeTransport` and production supplies an HTTP transport.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import re
+import threading
+from datetime import datetime
+from typing import Any, Callable, Dict, List, Optional, Protocol, Tuple
+
+from ..datamodel.post import parse_time
+from ..datamodel.youtube import YouTubeChannel, YouTubeVideo
+
+logger = logging.getLogger("dct.clients.youtube")
+
+# transport(endpoint, params) -> parsed JSON dict.  Endpoints mirror the Data
+# API: "channels", "playlistItems", "videos", "search".
+YouTubeTransport = Callable[[str, Dict[str, Any]], Dict[str, Any]]
+
+PREFIX_LEN = 5
+MAX_RANDOM_ATTEMPTS = 50  # youtube_client.go:1137
+VIDEO_BATCH = 50  # API max ids per videos.list call
+SNOWBALL_MIN_VIDEOS = 10  # channels with > 10 videos (types.go:62)
+
+_CHANNEL_ID_RE = re.compile(r"(UC[A-Za-z0-9_-]{22})")
+
+
+class YouTubeQuotaError(Exception):
+    """API quota exhausted."""
+
+
+class YouTubeClient(Protocol):
+    """`model/youtube/types.go:39-64`."""
+
+    def connect(self) -> None: ...
+
+    def disconnect(self) -> None: ...
+
+    def get_channel_info(self, channel_id: str) -> YouTubeChannel: ...
+
+    def get_videos(self, channel_id: str, from_time: Optional[datetime],
+                   to_time: Optional[datetime], limit: int) -> List[YouTubeVideo]: ...
+
+    def get_videos_from_channel(self, channel_id: str,
+                                from_time: Optional[datetime],
+                                to_time: Optional[datetime],
+                                limit: int) -> List[YouTubeVideo]: ...
+
+    def get_videos_by_ids(self, video_ids: List[str]) -> List[YouTubeVideo]: ...
+
+    def get_random_videos(self, from_time: Optional[datetime],
+                          to_time: Optional[datetime],
+                          limit: int) -> List[YouTubeVideo]: ...
+
+    def get_snowball_videos(self, seed_channel_ids: List[str],
+                            from_time: Optional[datetime],
+                            to_time: Optional[datetime],
+                            limit: int) -> List[YouTubeVideo]: ...
+
+
+def generate_random_prefix(rng: random.Random, length: int = PREFIX_LEN) -> str:
+    """5-char lowercase alphabetic prefix query (`youtube_client.go:886-910`).
+
+    Only a-z: YouTube search is case-insensitive for letters, so one query
+    covers all 2^5 case permutations; digits would corrupt the coverage term.
+    The search token is "watch?v=<prefix>" — the indexer splits video URLs on
+    '-', so IDs shaped <PREFIX>-xxxxx are returned for the query.
+    """
+    letters = "abcdefghijklmnopqrstuvwxyz"
+    return "watch?v=" + "".join(rng.choice(letters) for _ in range(length))
+
+
+def _parse_video(item: Dict[str, Any]) -> YouTubeVideo:
+    snippet = item.get("snippet") or {}
+    stats = item.get("statistics") or {}
+    content = item.get("contentDetails") or {}
+    return YouTubeVideo(
+        id=item.get("id", ""),
+        channel_id=snippet.get("channelId", ""),
+        title=snippet.get("title", ""),
+        description=snippet.get("description", ""),
+        published_at=parse_time(snippet.get("publishedAt")),
+        view_count=int(stats.get("viewCount") or 0),
+        like_count=int(stats.get("likeCount") or 0),
+        comment_count=int(stats.get("commentCount") or 0),
+        duration=content.get("duration", ""),
+        thumbnails={k: v.get("url", "") for k, v in
+                    (snippet.get("thumbnails") or {}).items()},
+        tags=list(snippet.get("tags") or []),
+        language=snippet.get("defaultAudioLanguage")
+        or snippet.get("defaultLanguage") or "",
+    )
+
+
+class YouTubeDataClient:
+    """Data API client over an injectable transport."""
+
+    def __init__(self, api_key: str, transport: YouTubeTransport,
+                 rng: Optional[random.Random] = None):
+        self.api_key = api_key
+        self.transport = transport
+        self._rng = rng or random.Random()
+        self._rng_lock = threading.Lock()
+        self._connected = False
+        # video-stats cache (`youtube_client.go:1899-1912`)
+        self._video_cache: Dict[str, YouTubeVideo] = {}
+        self._cache_lock = threading.Lock()
+
+    # --- lifecycle --------------------------------------------------------
+    def connect(self) -> None:
+        if not self.api_key:
+            raise ValueError("YouTube API key is required")
+        self._connected = True
+
+    def disconnect(self) -> None:
+        self._connected = False
+
+    def _call(self, endpoint: str, params: Dict[str, Any]) -> Dict[str, Any]:
+        if not self._connected:
+            raise RuntimeError("client not connected")
+        params = dict(params)
+        params["key"] = self.api_key
+        return self.transport(endpoint, params)
+
+    # --- channels ---------------------------------------------------------
+    def get_channel_info(self, channel_id: str) -> YouTubeChannel:
+        """`youtube_client.go:195`."""
+        resp = self._call("channels", {
+            "part": "snippet,statistics,contentDetails", "id": channel_id})
+        items = resp.get("items") or []
+        if not items:
+            raise LookupError(f"channel not found: {channel_id}")
+        item = items[0]
+        snippet = item.get("snippet") or {}
+        stats = item.get("statistics") or {}
+        return YouTubeChannel(
+            id=item.get("id", channel_id),
+            title=snippet.get("title", ""),
+            description=snippet.get("description", ""),
+            thumbnails={k: v.get("url", "") for k, v in
+                        (snippet.get("thumbnails") or {}).items()},
+            subscriber_count=int(stats.get("subscriberCount") or 0),
+            view_count=int(stats.get("viewCount") or 0),
+            video_count=int(stats.get("videoCount") or 0),
+            country=snippet.get("country", ""),
+            published_at=parse_time(snippet.get("publishedAt")),
+        )
+
+    # --- videos -----------------------------------------------------------
+    def get_videos_from_channel(self, channel_id: str,
+                                from_time: Optional[datetime] = None,
+                                to_time: Optional[datetime] = None,
+                                limit: int = 50) -> List[YouTubeVideo]:
+        """Paged uploads-playlist walk (`youtube_client.go:319-878`)."""
+        uploads = "UU" + channel_id[2:] if channel_id.startswith("UC") else channel_id
+        video_ids: List[str] = []
+        page_token = ""
+        # limit <= 0 means "all uploads": walk every page.
+        while limit <= 0 or len(video_ids) < limit * 2:
+            params = {"part": "contentDetails", "playlistId": uploads,
+                      "maxResults": 50}
+            if page_token:
+                params["pageToken"] = page_token
+            resp = self._call("playlistItems", params)
+            for item in resp.get("items") or []:
+                vid = (item.get("contentDetails") or {}).get("videoId", "")
+                if vid:
+                    video_ids.append(vid)
+            page_token = resp.get("nextPageToken", "")
+            if not page_token:
+                break
+        videos = self.get_videos_by_ids(video_ids)
+        videos = [v for v in videos if _in_window(v, from_time, to_time)]
+        # Sort on epoch floats: avoids naive/aware datetime comparison when a
+        # video lacks publishedAt.
+        videos.sort(key=lambda v: v.published_at.timestamp()
+                    if v.published_at else float("-inf"), reverse=True)
+        return videos[:limit] if limit > 0 else videos
+
+    # Alias per the reference's duplicated surface (types.go:50-53).
+    def get_videos(self, channel_id: str, from_time: Optional[datetime] = None,
+                   to_time: Optional[datetime] = None,
+                   limit: int = 50) -> List[YouTubeVideo]:
+        return self.get_videos_from_channel(channel_id, from_time, to_time, limit)
+
+    def get_videos_by_ids(self, video_ids: List[str]) -> List[YouTubeVideo]:
+        """Batched lookup with stats cache (`youtube_client.go:1077-1112`)."""
+        out: List[YouTubeVideo] = []
+        missing: List[str] = []
+        with self._cache_lock:
+            for vid in video_ids:
+                cached = self._video_cache.get(vid)
+                if cached is not None:
+                    out.append(cached)
+                else:
+                    missing.append(vid)
+        for i in range(0, len(missing), VIDEO_BATCH):
+            chunk = missing[i:i + VIDEO_BATCH]
+            resp = self._call("videos", {
+                "part": "snippet,statistics,contentDetails",
+                "id": ",".join(chunk)})
+            for item in resp.get("items") or []:
+                video = _parse_video(item)
+                with self._cache_lock:
+                    self._video_cache[video.id] = video
+                out.append(video)
+        return out
+
+    # --- random sampling ---------------------------------------------------
+    def get_random_videos(self, from_time: Optional[datetime] = None,
+                          to_time: Optional[datetime] = None,
+                          limit: int = 10) -> List[YouTubeVideo]:
+        """Prefix random sampling (`youtube_client.go:1109-1260`): search for
+        "watch?v=<prefix>", keep only IDs whose first 5 chars match the prefix
+        case-insensitively with '-' at index 5 (true random hits), then verify
+        via batched videos.list."""
+        collected: Dict[str, YouTubeVideo] = {}
+        seen_prefixes = set()
+        for _ in range(MAX_RANDOM_ATTEMPTS):
+            if len(collected) >= limit:
+                break
+            with self._rng_lock:
+                query = generate_random_prefix(self._rng)
+            prefix = query[len("watch?v="):]
+            if prefix in seen_prefixes:
+                continue
+            seen_prefixes.add(prefix)
+            resp = self._call("search", {"part": "id", "q": query,
+                                         "type": "video", "maxResults": 50})
+            candidate_ids = []
+            for item in resp.get("items") or []:
+                vid = item.get("id", {}).get("videoId", "") \
+                    if isinstance(item.get("id"), dict) else item.get("id", "")
+                # Valid random hits: prefix matches (case-insensitive) and
+                # '-' at position 5 (`youtube_client.go:894-897,1230`).
+                if len(vid) == 11 and vid[:5].lower() == prefix and vid[5] == "-":
+                    candidate_ids.append(vid)
+            for video in self.get_videos_by_ids(candidate_ids):
+                if _in_window(video, from_time, to_time):
+                    collected[video.id] = video
+        return list(collected.values())[:limit]
+
+    # --- snowball ----------------------------------------------------------
+    def get_snowball_videos(self, seed_channel_ids: List[str],
+                            from_time: Optional[datetime] = None,
+                            to_time: Optional[datetime] = None,
+                            limit: int = 50) -> List[YouTubeVideo]:
+        """Seed expansion via channel IDs found in video descriptions
+        (`youtube_client.go:1547,1856`); only channels with more than
+        SNOWBALL_MIN_VIDEOS videos are expanded (`types.go:62`)."""
+        queue = list(seed_channel_ids)
+        visited = set()
+        out: List[YouTubeVideo] = []
+        while queue and len(out) < limit:
+            channel_id = queue.pop(0)
+            if channel_id in visited:
+                continue
+            visited.add(channel_id)
+            try:
+                info = self.get_channel_info(channel_id)
+            except LookupError:
+                continue
+            if info.video_count <= SNOWBALL_MIN_VIDEOS and \
+                    channel_id not in seed_channel_ids:
+                continue
+            videos = self.get_videos_from_channel(channel_id, from_time,
+                                                  to_time,
+                                                  limit - len(out))
+            out.extend(videos)
+            for v in videos:
+                for found in _CHANNEL_ID_RE.findall(v.description):
+                    if found not in visited:
+                        queue.append(found)
+        return out[:limit]
+
+
+def _in_window(video: YouTubeVideo, from_time: Optional[datetime],
+               to_time: Optional[datetime]) -> bool:
+    if video.published_at is None:
+        return True
+    if from_time is not None and video.published_at < from_time:
+        return False
+    if to_time is not None and video.published_at > to_time:
+        return False
+    return True
+
+
+class FakeYouTubeTransport:
+    """In-memory Data API backend for tests (the reference mocks at the same
+    seam, `client/youtube_client_test.go`)."""
+
+    def __init__(self):
+        self.channels: Dict[str, Dict[str, Any]] = {}
+        self.videos: Dict[str, Dict[str, Any]] = {}
+        self.calls: List[Tuple[str, Dict[str, Any]]] = []
+        self.quota_used = 0
+
+    def add_channel(self, channel_id: str, title: str = "", video_count: int = 0,
+                    subscriber_count: int = 0, description: str = "",
+                    country: str = "") -> None:
+        self.channels[channel_id] = {
+            "id": channel_id,
+            "snippet": {"title": title or channel_id, "description": description,
+                        "publishedAt": "2020-01-01T00:00:00Z",
+                        "country": country, "thumbnails": {}},
+            "statistics": {"subscriberCount": str(subscriber_count),
+                           "viewCount": "0", "videoCount": str(video_count)},
+        }
+
+    def add_video(self, video_id: str, channel_id: str, title: str = "",
+                  description: str = "", published_at: str = "2025-01-01T00:00:00Z",
+                  view_count: int = 0, like_count: int = 0,
+                  comment_count: int = 0, duration: str = "PT1M",
+                  tags: Optional[List[str]] = None) -> None:
+        self.videos[video_id] = {
+            "id": video_id,
+            "snippet": {"channelId": channel_id, "title": title or video_id,
+                        "description": description, "publishedAt": published_at,
+                        "thumbnails": {"default": {"url": f"https://i.ytimg/{video_id}.jpg"}},
+                        "tags": tags or []},
+            "statistics": {"viewCount": str(view_count),
+                           "likeCount": str(like_count),
+                           "commentCount": str(comment_count)},
+            "contentDetails": {"duration": duration},
+        }
+        self.channels.setdefault(channel_id, None)
+        if self.channels[channel_id] is None:
+            self.add_channel(channel_id)
+
+    def __call__(self, endpoint: str, params: Dict[str, Any]) -> Dict[str, Any]:
+        self.calls.append((endpoint, params))
+        self.quota_used += 100 if endpoint == "search" else 1
+        if endpoint == "channels":
+            item = self.channels.get(params.get("id", ""))
+            return {"items": [item] if item else []}
+        if endpoint == "playlistItems":
+            playlist = params.get("playlistId", "")
+            channel_id = "UC" + playlist[2:] if playlist.startswith("UU") else playlist
+            items = [{"contentDetails": {"videoId": vid}}
+                     for vid, v in self.videos.items()
+                     if v["snippet"]["channelId"] == channel_id]
+            return {"items": items[:int(params.get("maxResults", 50))]}
+        if endpoint == "videos":
+            ids = params.get("id", "").split(",")
+            return {"items": [self.videos[v] for v in ids if v in self.videos]}
+        if endpoint == "search":
+            q = params.get("q", "")
+            prefix = q[len("watch?v="):] if q.startswith("watch?v=") else q
+            items = [{"id": {"videoId": vid}} for vid in self.videos
+                     if vid[:len(prefix)].lower() == prefix.lower()]
+            return {"items": items[:int(params.get("maxResults", 50))]}
+        raise ValueError(f"unknown endpoint: {endpoint}")

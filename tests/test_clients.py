@@ -1,0 +1,507 @@
+"""Client-layer tests: error taxonomy, rate limiter timing (with a fake
+clock), connection pool retire/recreate, sim client, username filter matrix,
+t.me HTML validator against fixtures, YouTube sampling methods.
+
+Reference analogs: rate_limiter_test.go (inter-call spacing),
+connection_pool_test.go, channelvalidator_test.go (HTML fixtures),
+username filter tests, youtube client tests.
+"""
+
+import os
+import random
+
+import pytest
+
+from distributed_crawler_tpu.clients import (
+    BLOCKED,
+    TRANSIENT,
+    ConnectionPool,
+    FakeClock,
+    FakeYouTubeTransport,
+    FloodWaitError,
+    RateLimitedTelegramClient,
+    SimNetwork,
+    SimTelegramClient,
+    TelegramError,
+    TokenBucket,
+    ValidationHTTPError,
+    ValidatorRateLimiter,
+    YouTubeDataClient,
+    filter_username,
+    generate_random_prefix,
+    parse_channel_html,
+    parse_flood_wait_seconds,
+    validate_channel_http,
+)
+from distributed_crawler_tpu.clients.errors import is_telegram_400
+from distributed_crawler_tpu.clients.pool import PoolEmptyError
+from distributed_crawler_tpu.clients.telegram import TLMessage
+from distributed_crawler_tpu.config import TelegramRateLimitConfig
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "telegram-html")
+
+
+def fixture(name):
+    with open(os.path.join(FIXTURES, name), encoding="utf-8") as f:
+        return f.read()
+
+
+class TestErrors:
+    def test_flood_wait_tdlib_format(self):
+        secs, is_fw = parse_flood_wait_seconds(Exception("[429] FLOOD_WAIT_72560"))
+        assert (secs, is_fw) == (72560, True)
+
+    def test_flood_wait_http_format(self):
+        secs, is_fw = parse_flood_wait_seconds(
+            Exception("429 Too Many Requests: retry after 120"))
+        assert (secs, is_fw) == (120, True)
+
+    def test_flood_wait_unparseable_is_short_ban(self):
+        secs, is_fw = parse_flood_wait_seconds(Exception("FLOOD_WAIT_"))
+        assert (secs, is_fw) == (0, True)
+
+    def test_not_flood_wait(self):
+        assert parse_flood_wait_seconds(Exception("connection reset")) == (0, False)
+        assert parse_flood_wait_seconds(None) == (0, False)
+
+    def test_flood_wait_error_type(self):
+        e = FloodWaitError(400)
+        assert parse_flood_wait_seconds(e) == (400, True)
+        assert e.code == 429
+
+    def test_telegram_400_detection(self):
+        assert is_telegram_400(TelegramError(400, "USERNAME_INVALID"))
+        assert is_telegram_400(Exception("[400] CHANNEL_INVALID"))
+        assert is_telegram_400(Exception("400 USERNAME_NOT_OCCUPIED"))
+        assert is_telegram_400(Exception("no messages found in the chat"))
+        assert not is_telegram_400(Exception("[500] internal"))
+        assert not is_telegram_400(None)
+
+
+def make_network(n_msgs=5):
+    net = SimNetwork()
+    msgs = [TLMessage(content={"@type": "messageText", "text": f"msg {i}"},
+                      date=1700000000 + i) for i in range(n_msgs)]
+    net.add_channel("mychannel", messages=msgs, member_count=5000)
+    return net
+
+
+class TestTokenBucket:
+    def test_spacing(self):
+        clock = FakeClock()
+        bucket = TokenBucket(60.0, clock)  # 1/sec
+        assert bucket.wait() == 0.0  # first token free
+        waited = bucket.wait()
+        assert waited == pytest.approx(1.0)
+
+    def test_unlimited(self):
+        clock = FakeClock()
+        bucket = TokenBucket(0, clock)
+        for _ in range(100):
+            assert bucket.wait() == 0.0
+        assert clock.now == 0.0
+
+
+class TestRateLimitedClient:
+    def _limited(self, net=None, cfg=None):
+        clock = FakeClock()
+        net = net or make_network()
+        raw = SimTelegramClient(net, clock=clock)
+        cfg = cfg or TelegramRateLimitConfig(
+            get_chat_history_jitter_ms=0, search_public_chat_jitter_ms=0,
+            get_supergroup_info_jitter_ms=0, get_message_server_hit_jitter_ms=0)
+        limited = RateLimitedTelegramClient(raw, cfg, clock=clock,
+                                            rng=random.Random(0))
+        return limited, raw, clock, net
+
+    def test_chat_history_inter_call_spacing(self):
+        # 30 cpm -> 2s between calls (rate_limiter_test.go analog).
+        limited, raw, clock, net = self._limited()
+        chat_id = net.channels["mychannel"].chat_id
+        t0 = clock.now
+        limited.get_chat_history(chat_id)
+        t1 = clock.now
+        limited.get_chat_history(chat_id)
+        t2 = clock.now
+        assert t2 - t1 >= 2.0 - (t1 - t0)
+
+    def test_search_public_chat_rate(self):
+        limited, raw, clock, net = self._limited()
+        limited.search_public_chat("mychannel")
+        before = clock.now
+        limited.search_public_chat("mychannel")
+        assert clock.now - before >= 9.9  # 6 cpm -> 10s spacing
+
+    def test_reactive_get_message_cache_hits_free(self):
+        limited, raw, clock, net = self._limited()
+        chat_id = net.channels["mychannel"].chat_id
+        msg_id = net.channels["mychannel"].messages[0].id
+        # First call: server hit (20ms latency) -> consumes a token.
+        limited.get_message(chat_id, msg_id)
+        t_after_first = clock.now
+        # Second call: local cache (1ms) -> no token, no throttle.
+        limited.get_message(chat_id, msg_id)
+        elapsed = clock.now - t_after_first
+        assert elapsed < 0.01
+
+    def test_reactive_get_message_server_hits_throttled(self):
+        limited, raw, clock, net = self._limited()
+        chat_id = net.channels["mychannel"].chat_id
+        ids = [m.id for m in net.channels["mychannel"].messages]
+        # Distinct messages: every call is a server hit; 60 cpm -> 1s apart.
+        limited.get_message(chat_id, ids[0])
+        t1 = clock.now
+        limited.get_message(chat_id, ids[1])
+        # Second server hit pays the reactive throttle delay (~1s).
+        assert clock.now - t1 >= 0.9
+
+    def test_passthrough_methods_not_limited(self):
+        limited, raw, clock, net = self._limited()
+        chat_id = net.channels["mychannel"].chat_id
+        t0 = clock.now
+        for _ in range(10):
+            limited.get_chat(chat_id)
+        # Only sim cache latency, no limiter waits.
+        assert clock.now - t0 < 0.2
+
+    def test_error_still_counts_server_hit(self):
+        limited, raw, clock, net = self._limited()
+        chat_id = net.channels["mychannel"].chat_id
+        with pytest.raises(TelegramError):
+            limited.get_message(chat_id, 999999999)  # not found, server hit
+        # Error propagates after throttling bookkeeping.
+
+
+class TestConnectionPool:
+    def _pool(self, n=2, net=None):
+        net = net or make_network()
+        cfg = TelegramRateLimitConfig()
+        pool = ConnectionPool(
+            factory=lambda cid: SimTelegramClient(net, conn_id=cid),
+            database_urls=[f"https://db/{i}.tar.gz" for i in range(n)],
+            rate_limit=cfg)
+        pool.initialize()
+        return pool, net
+
+    def test_acquire_release_reuse(self):
+        pool, net = self._pool(2)
+        c1 = pool.acquire(timeout_s=1)
+        c2 = pool.acquire(timeout_s=1)
+        assert c1.conn_id != c2.conn_id
+        pool.release(c1)
+        c3 = pool.acquire(timeout_s=1)
+        assert c3.conn_id == c1.conn_id
+        assert c3.uses == 2  # reused without re-login
+
+    def test_clients_wrapped_in_rate_limiter(self):
+        pool, _ = self._pool(1)
+        conn = pool.acquire(timeout_s=1)
+        assert isinstance(conn.client, RateLimitedTelegramClient)
+
+    def test_retire_until_empty(self):
+        pool, _ = self._pool(2)
+        pool.retire("conn_0", "flood_wait_72560")
+        assert not pool.empty()
+        pool.retire("conn_1", "flood_wait_90000")
+        assert pool.empty()
+        with pytest.raises(PoolEmptyError):
+            pool.acquire(timeout_s=0.1)
+        stats = pool.stats()
+        assert stats["retired"] == 2 and stats["live"] == 0
+
+    def test_retired_connection_not_returned(self):
+        pool, _ = self._pool(2)
+        c1 = pool.acquire(timeout_s=1)
+        pool.release(c1)
+        pool.retire(c1.conn_id)
+        c = pool.acquire(timeout_s=1)
+        assert c.conn_id != c1.conn_id
+
+    def test_recreate_after_error(self):
+        pool, net = self._pool(1)
+        conn = pool.acquire(timeout_s=1)
+        conn.client.close()
+        fresh = pool.recreate(conn)
+        assert fresh.conn_id == conn.conn_id
+        assert fresh.errors == 1
+        chat_id = net.channels["mychannel"].chat_id
+        fresh.client.get_chat(chat_id)  # fresh client works, owned by caller
+        pool.release(fresh)
+        got = pool.acquire(timeout_s=1)
+        assert got is fresh
+
+    def test_recreate_caller_owns_fresh_connection(self):
+        # recreate() must not also enqueue the id — otherwise two acquirers
+        # could share one client.
+        pool, _ = self._pool(1)
+        conn = pool.acquire(timeout_s=1)
+        fresh = pool.recreate(conn)
+        with pytest.raises(TimeoutError):
+            pool.acquire(timeout_s=0.1)  # fresh is owned by the caller
+        pool.release(fresh)
+        again = pool.acquire(timeout_s=1)
+        assert again is fresh
+
+    def test_release_of_stale_handle_ignored(self):
+        pool, _ = self._pool(1)
+        conn = pool.acquire(timeout_s=1)
+        fresh = pool.recreate(conn)
+        pool.release(conn)  # stale object: must be a no-op
+        with pytest.raises(TimeoutError):
+            pool.acquire(timeout_s=0.1)
+        pool.release(fresh)
+        assert pool.acquire(timeout_s=1) is fresh
+
+    def test_for_testing_constructor(self):
+        net = make_network()
+        pool = ConnectionPool.for_testing(
+            {"a": SimTelegramClient(net, "a"), "b": SimTelegramClient(net, "b")})
+        assert pool.stats()["total"] == 2
+        conn = pool.acquire(timeout_s=1)
+        assert conn.conn_id in ("a", "b")
+
+
+class TestSimClient:
+    def test_chat_history_pagination_newest_first(self):
+        net = make_network(n_msgs=7)
+        client = SimTelegramClient(net)
+        chat_id = net.channels["mychannel"].chat_id
+        page1 = client.get_chat_history(chat_id, from_message_id=0, limit=3)
+        assert len(page1.messages) == 3
+        assert page1.messages[0].id > page1.messages[-1].id
+        page2 = client.get_chat_history(
+            chat_id, from_message_id=page1.messages[-1].id, limit=100)
+        assert len(page2.messages) == 4
+        assert page2.messages[0].id < page1.messages[-1].id
+
+    def test_flood_wait_injection(self):
+        net = make_network()
+        net.inject_flood_wait("SearchPublicChat", 400, count=1)
+        client = SimTelegramClient(net)
+        with pytest.raises(FloodWaitError) as ei:
+            client.search_public_chat("mychannel")
+        assert ei.value.retry_after_s == 400
+        # Fault consumed; next call succeeds.
+        chat = client.search_public_chat("mychannel")
+        assert chat.id == net.channels["mychannel"].chat_id
+
+    def test_file_download_and_delete(self):
+        net = make_network()
+        net.add_file("remote123", b"JPEGDATA")
+        client = SimTelegramClient(net)
+        f = client.get_remote_file("remote123")
+        f = client.download_file(f.id)
+        assert f.downloaded and os.path.exists(f.local_path)
+        with open(f.local_path, "rb") as fh:
+            assert fh.read() == b"JPEGDATA"
+        client.delete_file(f.id)
+        assert not os.path.exists(f.local_path)
+
+    def test_unknown_username_raises_400(self):
+        net = make_network()
+        client = SimTelegramClient(net)
+        with pytest.raises(TelegramError) as ei:
+            client.search_public_chat("doesnotexist")
+        assert ei.value.code == 400
+
+
+class TestUsernameFilter:
+    @pytest.mark.parametrize("username,reason", [
+        ("abcd", "too_short"),
+        ("a" * 33, "too_long"),
+        ("1channel", "invalid_start_char"),
+        ("_underscore", "invalid_start_char"),
+        ("trailing_", "ends_with_underscore"),
+        ("has space", "invalid_char"),
+        ("кириллица", "invalid_start_char"),
+        ("somebot", "bot_suffix"),
+        ("some_bot", "bot_suffix"),
+        ("SomeBot", "bot_suffix"),
+    ])
+    def test_rejections(self, username, reason):
+        res = filter_username(username)
+        assert not res.valid and res.reason == reason
+
+    @pytest.mark.parametrize("username", [
+        "valid_channel", "NewsRoom24", "abcde", "x1234", "tech_news_daily"])
+    def test_accepted(self, username):
+        assert filter_username(username).valid
+
+
+class TestChannelHTMLParsing:
+    def test_valid_channel_fixture(self):
+        res = parse_channel_html(fixture("valid-channel.html"))
+        assert res.status == "valid" and res.reason == ""
+
+    def test_not_supergroup_fixture(self):
+        res = parse_channel_html(fixture("not-a-supergroup.html"))
+        assert res.status == "not_channel" and res.reason == "not_supergroup"
+
+    def test_username_not_occupied_fixture(self):
+        res = parse_channel_html(fixture("username-not-occupied.html"))
+        assert res.status == "invalid" and res.reason == "not_found"
+
+    def test_reserved_path_fixture(self):
+        res = parse_channel_html(fixture("invalid-channel.html"))
+        assert res.status == "invalid" and res.reason == "not_found"
+
+    def test_unrecognised_title_raises(self):
+        with pytest.raises(ValueError, match="unrecognised title"):
+            parse_channel_html("<html><head><title>Weird</title></head></html>")
+
+
+class TestValidateChannelHTTP:
+    def _transport(self, status, body):
+        def t(url, headers):
+            self.last_headers = headers
+            return status, body
+        return t
+
+    def test_ok_flow_sets_chromium_ua(self):
+        res = validate_channel_http(
+            "examplechannel",
+            transport=self._transport(200, fixture("valid-channel.html").encode()))
+        assert res.status == "valid"
+        assert "Chrome" in self.last_headers["User-Agent"]
+
+    def test_5xx_is_transient(self):
+        with pytest.raises(ValidationHTTPError) as ei:
+            validate_channel_http("x", transport=self._transport(503, b""))
+        assert ei.value.kind == TRANSIENT
+
+    def test_4xx_is_blocked(self):
+        for code in (403, 429, 404):
+            with pytest.raises(ValidationHTTPError) as ei:
+                validate_channel_http("x", transport=self._transport(code, b""))
+            assert ei.value.kind == BLOCKED
+
+    def test_unparseable_200_is_blocked(self):
+        with pytest.raises(ValidationHTTPError) as ei:
+            validate_channel_http("x", transport=self._transport(200, b"<html></html>"))
+        assert ei.value.kind == BLOCKED
+
+    def test_connection_error_is_transient(self):
+        def boom(url, headers):
+            raise OSError("connection reset")
+        with pytest.raises(ValidationHTTPError) as ei:
+            validate_channel_http("x", transport=boom)
+        assert ei.value.kind == TRANSIENT
+
+    def test_validator_rate_limiter_spacing(self):
+        clock = FakeClock()
+        lim = ValidatorRateLimiter(requests_per_minute=6, jitter_ms=0, clock=clock)
+        lim.wait()
+        t0 = clock.now
+        lim.wait()
+        assert clock.now - t0 >= 10.0
+
+
+class TestYouTubeClient:
+    def _client(self):
+        transport = FakeYouTubeTransport()
+        transport.add_channel("UCabc000000000000000000", "Chan A",
+                              video_count=20, subscriber_count=1000)
+        for i in range(5):
+            transport.add_video(f"vidA{i:07d}", "UCabc000000000000000000",
+                                title=f"video {i}",
+                                published_at=f"2025-0{i+1}-01T00:00:00Z")
+        client = YouTubeDataClient("test-key", transport, rng=random.Random(7))
+        client.connect()
+        return client, transport
+
+    def test_requires_api_key(self):
+        client = YouTubeDataClient("", FakeYouTubeTransport())
+        with pytest.raises(ValueError):
+            client.connect()
+
+    def test_channel_info(self):
+        client, _ = self._client()
+        info = client.get_channel_info("UCabc000000000000000000")
+        assert info.title == "Chan A"
+        assert info.video_count == 20
+
+    def test_channel_not_found(self):
+        client, _ = self._client()
+        with pytest.raises(LookupError):
+            client.get_channel_info("UCmissing00000000000000")
+
+    def test_videos_from_channel_with_window(self):
+        from datetime import datetime, timezone
+        client, _ = self._client()
+        videos = client.get_videos_from_channel(
+            "UCabc000000000000000000",
+            from_time=datetime(2025, 2, 1, tzinfo=timezone.utc),
+            to_time=datetime(2025, 4, 30, tzinfo=timezone.utc), limit=10)
+        assert {v.title for v in videos} == {"video 1", "video 2", "video 3"}
+        # Newest first.
+        assert videos[0].published_at > videos[-1].published_at
+
+    def test_video_without_published_at_sorts_last(self):
+        client, transport = self._client()
+        transport.add_video("vidA0000009", "UCabc000000000000000000",
+                            title="undated", published_at="")
+        videos = client.get_videos_from_channel("UCabc000000000000000000",
+                                                limit=10)
+        assert videos[-1].title == "undated"  # no tz-compare crash
+
+    def test_limit_zero_fetches_all_pages(self):
+        client, transport = self._client()
+        videos = client.get_videos_from_channel("UCabc000000000000000000",
+                                                limit=0)
+        assert len(videos) == 5
+
+    def test_videos_by_ids_uses_cache(self):
+        client, transport = self._client()
+        client.get_videos_by_ids(["vidA0000000", "vidA0000001"])
+        calls_before = len([c for c in transport.calls if c[0] == "videos"])
+        client.get_videos_by_ids(["vidA0000000", "vidA0000001"])
+        calls_after = len([c for c in transport.calls if c[0] == "videos"])
+        assert calls_after == calls_before  # fully served from cache
+
+    def test_random_prefix_shape(self):
+        rng = random.Random(1)
+        p = generate_random_prefix(rng)
+        assert p.startswith("watch?v=") and len(p) == len("watch?v=") + 5
+        assert p[len("watch?v="):].isalpha() and p[len("watch?v="):].islower()
+
+    def test_random_sampling_verifies_prefix_and_hyphen(self):
+        transport = FakeYouTubeTransport()
+        rng = random.Random(7)
+        prefix = generate_random_prefix(random.Random(7))[len("watch?v="):]
+        # True random-hit shape: PREFIX-xxxxx (hyphen at index 5).
+        transport.add_video(prefix + "-12345", "UCx", view_count=10)
+        # Prefix matches but no hyphen -> must be filtered out.
+        transport.add_video(prefix + "z12345"[:6], "UCx")
+        client = YouTubeDataClient("k", transport, rng=rng)
+        client.connect()
+        videos = client.get_random_videos(limit=1)
+        assert [v.id for v in videos] == [prefix + "-12345"]
+
+    def test_snowball_expands_via_descriptions(self):
+        transport = FakeYouTubeTransport()
+        seed = "UC" + "s" * 22
+        found = "UC" + "f" * 22
+        transport.add_channel(seed, "Seed", video_count=15)
+        transport.add_channel(found, "Found", video_count=15)
+        transport.add_video("vidseed0001", seed,
+                            description=f"check out https://youtube.com/channel/{found}")
+        transport.add_video("vidfound001", found, title="from found channel")
+        client = YouTubeDataClient("k", transport, rng=random.Random(0))
+        client.connect()
+        videos = client.get_snowball_videos([seed], limit=10)
+        titles = {v.title for v in videos}
+        assert "from found channel" in titles
+
+    def test_snowball_skips_small_channels(self):
+        transport = FakeYouTubeTransport()
+        seed = "UC" + "s" * 22
+        small = "UC" + "m" * 22
+        transport.add_channel(seed, "Seed", video_count=15)
+        transport.add_channel(small, "Small", video_count=3)  # <= 10 videos
+        transport.add_video("vidseed0001", seed,
+                            description=f"https://youtube.com/channel/{small}")
+        transport.add_video("vidsmall001", small, title="small channel video")
+        client = YouTubeDataClient("k", transport, rng=random.Random(0))
+        client.connect()
+        videos = client.get_snowball_videos([seed], limit=10)
+        assert "small channel video" not in {v.title for v in videos}
