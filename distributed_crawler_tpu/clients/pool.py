@@ -136,7 +136,13 @@ class ConnectionPool:
         with self._lock:
             conn.errors += 1
             database_url = conn.database_url
-        raw = self.factory(conn.conn_id)
+        try:
+            raw = self.factory(conn.conn_id)
+        except Exception:
+            # The old client is closed and unusable: retire the slot so the
+            # pool doesn't count a phantom live connection forever.
+            self.retire(conn.conn_id, "recreate_failed")
+            raise
         wrapped = RateLimitedTelegramClient(raw, self.rate_limit, clock=self.clock)
         with self._lock:
             fresh = PooledConnection(conn_id=conn.conn_id, client=wrapped,

@@ -42,10 +42,9 @@ class SimChannel:
     is_channel: bool = True
     is_supergroup: bool = True
     messages: List[TLMessage] = field(default_factory=list)
-
-    @property
-    def supergroup_id(self) -> int:
-        return self.chat_id % 1_000_000_000
+    # Assigned by SimNetwork; always nonzero so it never collides with the
+    # TLChat "no supergroup" default of 0.
+    supergroup_id: int = 0
 
 
 class SimNetwork:
@@ -63,6 +62,7 @@ class SimNetwork:
         # method -> list of pending injected errors (popped per call)
         self._faults: Dict[str, List[BaseException]] = {}
         self._next_chat_id = 1_000_000_000_000
+        self._next_supergroup_id = 1
 
     # --- topology ---------------------------------------------------------
     def add_channel(self, username: str, messages: Optional[List[TLMessage]] = None,
@@ -70,8 +70,11 @@ class SimNetwork:
         with self._lock:
             chat_id = kw.pop("chat_id", None) or self._next_chat_id
             self._next_chat_id += 1
+            supergroup_id = kw.pop("supergroup_id", None) or self._next_supergroup_id
+            self._next_supergroup_id += 1
             ch = SimChannel(username=username.lower(), chat_id=chat_id,
-                            title=kw.pop("title", username), **kw)
+                            title=kw.pop("title", username),
+                            supergroup_id=supergroup_id, **kw)
             for i, m in enumerate(messages or []):
                 m.chat_id = chat_id
                 if not m.id:

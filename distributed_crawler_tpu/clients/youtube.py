@@ -39,10 +39,6 @@ SNOWBALL_MIN_VIDEOS = 10  # channels with > 10 videos (types.go:62)
 _CHANNEL_ID_RE = re.compile(r"(UC[A-Za-z0-9_-]{22})")
 
 
-class YouTubeQuotaError(Exception):
-    """API quota exhausted."""
-
-
 class YouTubeClient(Protocol):
     """`model/youtube/types.go:39-64`."""
 
@@ -167,24 +163,29 @@ class YouTubeDataClient:
                                 limit: int = 50) -> List[YouTubeVideo]:
         """Paged uploads-playlist walk (`youtube_client.go:319-878`)."""
         uploads = "UU" + channel_id[2:] if channel_id.startswith("UC") else channel_id
-        video_ids: List[str] = []
+        videos: List[YouTubeVideo] = []
         page_token = ""
-        # limit <= 0 means "all uploads": walk every page.
-        while limit <= 0 or len(video_ids) < limit * 2:
+        # Filter by window per page and keep paginating until `limit` in-window
+        # videos are found or the playlist ends (reference behavior:
+        # youtube_client.go GetVideosFromChannel filters inside the page loop).
+        # limit <= 0 means "all uploads".
+        while True:
             params = {"part": "contentDetails", "playlistId": uploads,
                       "maxResults": 50}
             if page_token:
                 params["pageToken"] = page_token
             resp = self._call("playlistItems", params)
-            for item in resp.get("items") or []:
-                vid = (item.get("contentDetails") or {}).get("videoId", "")
-                if vid:
-                    video_ids.append(vid)
+            page_ids = [vid for item in resp.get("items") or []
+                        if (vid := (item.get("contentDetails") or {})
+                            .get("videoId", ""))]
+            for video in self.get_videos_by_ids(page_ids):
+                if _in_window(video, from_time, to_time):
+                    videos.append(video)
+            if 0 < limit <= len(videos):
+                break
             page_token = resp.get("nextPageToken", "")
             if not page_token:
                 break
-        videos = self.get_videos_by_ids(video_ids)
-        videos = [v for v in videos if _in_window(v, from_time, to_time)]
         # Sort on epoch floats: avoids naive/aware datetime comparison when a
         # video lacks publishedAt.
         videos.sort(key=lambda v: v.published_at.timestamp()
@@ -337,8 +338,7 @@ class FakeYouTubeTransport:
                            "commentCount": str(comment_count)},
             "contentDetails": {"duration": duration},
         }
-        self.channels.setdefault(channel_id, None)
-        if self.channels[channel_id] is None:
+        if channel_id not in self.channels:
             self.add_channel(channel_id)
 
     def __call__(self, endpoint: str, params: Dict[str, Any]) -> Dict[str, Any]:

@@ -1,0 +1,50 @@
+"""The crawl engine: channel pipeline, random-walk, tandem, 400-replacement.
+
+Parity with the reference's `crawl/` package (SURVEY.md §2 "Crawl engine
+core"): `run_for_channel(_with_pool)` (`crawl/runner.go:506,563`),
+`process_all_messages` (`:1110-1550`), walkback decisions (`:1471-1539`),
+tandem pending-edge batching (`:1252-1306`), 400-replacement (`:152-284`),
+message dedup/resample (`:1572-1697`), FLOOD_WAIT policy, and the global
+connection-pool facade (`:287-484`).  The tandem validator loop lives in
+`crawl/validator.py`.
+"""
+
+from .errors import (
+    FloodWaitRetireError,
+    TDLib400Error,
+    WalkbackExhaustedError,
+)
+from .replacement import handle_400_replacement
+from .runner import (
+    add_new_messages,
+    get_connection_from_pool,
+    init_connection_pool,
+    pick_walkback_channel,
+    process_all_messages,
+    resample_marker,
+    run_for_channel,
+    run_for_channel_with_pool,
+    set_run_for_channel_fn,
+    shutdown_connection_pool,
+)
+from .validator import BlockedState, RunValidationLoop, ValidatorConfig
+
+__all__ = [
+    "run_for_channel",
+    "run_for_channel_with_pool",
+    "process_all_messages",
+    "add_new_messages",
+    "resample_marker",
+    "pick_walkback_channel",
+    "init_connection_pool",
+    "get_connection_from_pool",
+    "shutdown_connection_pool",
+    "set_run_for_channel_fn",
+    "handle_400_replacement",
+    "WalkbackExhaustedError",
+    "FloodWaitRetireError",
+    "TDLib400Error",
+    "RunValidationLoop",
+    "ValidatorConfig",
+    "BlockedState",
+]

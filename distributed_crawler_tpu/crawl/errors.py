@@ -1,0 +1,43 @@
+"""Sentinel errors of the crawl engine (`crawl/runner.go:32-49`).
+
+Each has a distinct recovery policy in the drivers (SURVEY.md §5.3):
+- WalkbackExhaustedError -> leave the page in place
+- FloodWaitRetireError   -> retire the connection; empty pool aborts the crawl
+- TDLib400Error          -> 400-replacement (delete page, pick replacement edge)
+"""
+
+from __future__ import annotations
+
+from ..clients.errors import (  # re-exported for engine callers
+    FLOOD_WAIT_RETIRE_THRESHOLD_S,
+    is_telegram_400,
+    parse_flood_wait_seconds,
+)
+
+
+class WalkbackExhaustedError(Exception):
+    """No suitable walkback channel after max attempts (`runner.go:32`)."""
+
+
+class FloodWaitRetireError(Exception):
+    """FLOOD_WAIT beyond the retire threshold: client permanently retired
+    (`runner.go:38`)."""
+
+    def __init__(self, retry_after_s: int = 0):
+        super().__init__(
+            f"FLOOD_WAIT {retry_after_s}s exceeds retire threshold: client retired")
+        self.retry_after_s = retry_after_s
+
+
+class TDLib400Error(Exception):
+    """Channel permanently invalid/inaccessible (`runner.go:44`)."""
+
+
+__all__ = [
+    "WalkbackExhaustedError",
+    "FloodWaitRetireError",
+    "TDLib400Error",
+    "parse_flood_wait_seconds",
+    "is_telegram_400",
+    "FLOOD_WAIT_RETIRE_THRESHOLD_S",
+]

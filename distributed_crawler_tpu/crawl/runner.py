@@ -1,0 +1,571 @@
+"""The heart of the crawl engine.
+
+Parity with `crawl/runner.go` (1840 LoC):
+- global connection-pool facade (`:287-484`)
+- `run_for_channel_with_pool` with retire-on-floodwait (`:506-544`)
+- `run_for_channel` channel pipeline: cached chat-ID fast path, incremental
+  window, channel-data validation, activity/member gates (`:563-660`)
+- `process_all_messages`: per-message loop with failure containment, outlink
+  discovery, random-walk edge logic, tandem pending-edge batching, walkback
+  decision with WalkbackRate (`:1110-1550`)
+- message dedup (`add_new_messages`) / `resample_marker` (`:1572-1697`)
+- per-message parse with recovery (`:1720-1809`)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import threading
+from typing import Callable, Dict, List, Optional, Protocol, Set, Tuple
+
+from ..clients.errors import FLOOD_WAIT_RETIRE_THRESHOLD_S
+from ..clients.pool import ConnectionPool, PooledConnection, PoolEmptyError
+from ..clients.telegram import TelegramClient, TLMessage
+from ..clients.username_filter import filter_username
+from ..config.crawler import CrawlerConfig
+from ..datamodel import ChannelData, EngagementData, NullValidator
+from ..state.datamodels import (
+    BATCH_OPEN,
+    EdgeRecord,
+    Message,
+    Page,
+    PendingEdge,
+    PendingEdgeBatch,
+    new_id,
+    utcnow,
+)
+from ..telegram.parsing import extract_channel_links_with_source, parse_message
+from .channelinfo import ChannelInfo, get_channel_info, is_channel_active_within_period
+from .errors import (
+    FloodWaitRetireError,
+    TDLib400Error,
+    WalkbackExhaustedError,
+    is_telegram_400,
+    parse_flood_wait_seconds,
+)
+
+logger = logging.getLogger("dct.crawl")
+
+MAX_WALKBACK_ATTEMPTS = 10  # `crawl/runner.go:118`
+
+# ---------------------------------------------------------------------------
+# Global connection pool facade (`crawl/runner.go:287-484`)
+# ---------------------------------------------------------------------------
+
+_pool: Optional[ConnectionPool] = None
+_pool_lock = threading.Lock()
+
+
+def init_connection_pool(pool: ConnectionPool) -> None:
+    """Install the process-wide pool (created once, `runner.go:306`)."""
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            _pool = pool
+
+
+def get_connection_from_pool(timeout_s: float = 30.0) -> PooledConnection:
+    with _pool_lock:
+        pool = _pool
+    if pool is None:
+        raise PoolEmptyError("connection pool not initialized")
+    return pool.acquire(timeout_s=timeout_s)
+
+
+def release_connection_to_pool(conn: PooledConnection) -> None:
+    with _pool_lock:
+        pool = _pool
+    if pool is not None:
+        pool.release(conn)
+
+
+def retire_connection_from_pool(conn_id: str, reason: str = "") -> None:
+    with _pool_lock:
+        pool = _pool
+    if pool is not None:
+        pool.retire(conn_id, reason)
+
+
+def pool_is_empty() -> bool:
+    with _pool_lock:
+        pool = _pool
+    return pool is None or pool.empty()
+
+
+def shutdown_connection_pool() -> None:
+    global _pool
+    with _pool_lock:
+        if _pool is not None:
+            _pool.close_all()
+            _pool = None
+
+
+# ---------------------------------------------------------------------------
+# Walkback channel selection (`crawl/runner.go:115-140`)
+# ---------------------------------------------------------------------------
+
+def pick_walkback_channel(sm, source_url: str,
+                          exclude: Optional[Dict[str, bool]] = None,
+                          rng: Optional[random.Random] = None) -> str:
+    """Random discovered channel != source and not excluded; raises
+    WalkbackExhaustedError after MAX_WALKBACK_ATTEMPTS."""
+    exclude = exclude or {}
+    for attempt in range(MAX_WALKBACK_ATTEMPTS):
+        try:
+            url = sm.get_random_discovered_channel()
+        except LookupError as e:
+            raise WalkbackExhaustedError(
+                f"no discovered channels to walk back to from {source_url}") from e
+        if url == source_url or exclude.get(url):
+            continue
+        logger.info("selected walkback channel", extra={
+            "log_tag": "rw_channel", "walkback_url": url,
+            "source_channel": source_url})
+        return url
+    raise WalkbackExhaustedError(f"channel {source_url}: walkback attempts exhausted")
+
+
+# ---------------------------------------------------------------------------
+# Message bookkeeping (`crawl/runner.go:1572-1697`)
+# ---------------------------------------------------------------------------
+
+def add_new_messages(discovered: List[Message], owner: Page) -> List[Message]:
+    """Existing messages + the discovered ones that are genuinely new
+    (`runner.go:1648-1697`)."""
+    existing = {(m.chat_id, m.message_id) for m in owner.messages}
+    new = [m for m in discovered if (m.chat_id, m.message_id) not in existing]
+    return owner.messages + new
+
+
+def resample_marker(messages: List[Message],
+                    discovered: List[Message]) -> List[Message]:
+    """Mark non-fetched messages 'resample' if still present, 'deleted' if
+    gone; never touch 'fetched' (`runner.go:1572-1635`)."""
+    discovered_keys = {(m.chat_id, m.message_id) for m in discovered}
+    for m in messages:
+        if m.status == "fetched":
+            continue
+        if (m.chat_id, m.message_id) in discovered_keys:
+            m.status = "resample"
+        else:
+            m.status = "deleted"
+    return messages
+
+
+# ---------------------------------------------------------------------------
+# Message processor seam (tests override; `crawl/runner.go:1720-1809`)
+# ---------------------------------------------------------------------------
+
+class MessageProcessor(Protocol):
+    def process_message(self, client: TelegramClient, message: TLMessage,
+                        message_id: int, chat_id: int, info: ChannelInfo,
+                        crawl_id: str, channel_username: str, sm,
+                        cfg: CrawlerConfig) -> List[str]:
+        """Returns the message's outlinks."""
+
+
+class DefaultMessageProcessor:
+    """Parses + stores the message; contains per-message failures."""
+
+    def process_message(self, client, message, message_id, chat_id, info,
+                        crawl_id, channel_username, sm, cfg) -> List[str]:
+        try:
+            post = parse_message(crawl_id, message, info.chat_details,
+                                 info.supergroup, info.supergroup_info,
+                                 info.message_count, info.total_views,
+                                 channel_username, client, sm, cfg)
+        except Exception as e:
+            raise RuntimeError(
+                f"failed to parse message {message_id}: {e}") from e
+        validator = _null_validator(cfg)
+        result = validator.validate_post(post)
+        if result.valid and cfg.sampling_method != "random-walk":
+            # random-walk stores channel data only, not posts (`runner.go:627`).
+            sm.store_post(channel_username, post)
+        return post.outlinks
+
+
+def _null_validator(cfg: CrawlerConfig) -> NullValidator:
+    validator = getattr(cfg, "_null_validator_cache", None)
+    if validator is None:
+        if cfg.null_config:
+            validator = NullValidator.from_json(cfg.null_config, "telegram")
+        else:
+            validator = NullValidator("telegram")
+        object.__setattr__(cfg, "_null_validator_cache", validator)
+    return validator
+
+
+# ---------------------------------------------------------------------------
+# The channel pipeline (`crawl/runner.go:563-660`)
+# ---------------------------------------------------------------------------
+
+def run_for_channel(client: TelegramClient, page: Page, storage_prefix: str,
+                    sm, cfg: CrawlerConfig,
+                    processor: Optional[MessageProcessor] = None,
+                    rng: Optional[random.Random] = None) -> List[Page]:
+    """Process one channel end to end; returns discovered pages (BFS modes)."""
+    cfg = dataclasses.replace(cfg)  # never mutate the caller's config
+
+    cached_chat_id = 0
+    if cfg.sampling_method == "random-walk":
+        chat_id, ok = sm.get_cached_chat_id(page.url)
+        if ok:
+            cached_chat_id = chat_id
+        # Incremental window: only newer than the last crawl (`:575-580`).
+        last_crawled = sm.get_channel_last_crawled(page.url)
+        if last_crawled is not None and (
+                cfg.min_post_date is None or last_crawled > cfg.min_post_date):
+            logger.info("channel previously crawled, fetching only new messages",
+                        extra={"log_tag": "rw_channel", "channel": page.url})
+            cfg.min_post_date = last_crawled
+
+    info, messages = get_channel_info(client, page, cached_chat_id, cfg)
+
+    channel_data = ChannelData(
+        channel_id=str(info.chat.id),
+        channel_name=info.chat.title,
+        channel_profile_image=info.chat.photo_remote_id,
+        channel_engagement_data=EngagementData(
+            follower_count=info.member_count,
+            post_count=info.message_count,
+            views_count=info.total_views,
+        ),
+        channel_url_external=f"https://t.me/{page.url}",
+        channel_url=f"https://t.me/{page.url}",
+    )
+    validation = _null_validator(cfg).validate_channel_data(channel_data)
+    if not validation.valid:
+        raise ValueError(
+            f"channel {page.url} is missing critical fields: {validation.errors}")
+
+    if cfg.sampling_method == "random-walk":
+        sm.store_channel_data(page.url, channel_data)
+
+    try:
+        active = is_channel_active_within_period(client, info.chat_details.id,
+                                                 cfg.post_recency)
+    except Exception as e:
+        if isinstance(e, TDLib400Error) or is_telegram_400(e):
+            raise TDLib400Error(str(e)) from e
+        raise
+
+    too_small = (cfg.sampling_method != "random-walk" and cfg.min_users > 0
+                 and info.member_count < cfg.min_users)
+    if not active or info.message_count == 0 or too_small:
+        logger.info("channel inactive/small, marking deadend",
+                    extra={"channel": page.url})
+        page.status = "deadend"
+        sm.update_page(page)
+        sm.save_state()
+        return []
+
+    discovered = process_all_messages(client, info, messages, cfg.crawl_id,
+                                      page.url, sm, page, cfg,
+                                      processor=processor, rng=rng)
+
+    if cfg.sampling_method == "random-walk":
+        sm.mark_channel_crawled(page.url, info.chat.id)
+    return discovered
+
+
+# run_for_channel seam for tests (`crawl/runner.go:294`).
+_run_for_channel_fn: Callable = run_for_channel
+
+
+def set_run_for_channel_fn(fn: Optional[Callable]) -> None:
+    global _run_for_channel_fn
+    _run_for_channel_fn = fn if fn is not None else run_for_channel
+
+
+def run_for_channel_with_pool(page: Page, storage_prefix: str, sm,
+                              cfg: CrawlerConfig,
+                              processor: Optional[MessageProcessor] = None
+                              ) -> List[Page]:
+    """Pool-managed channel run: retire the connection on
+    FloodWaitRetireError, release otherwise (`crawl/runner.go:506-544`)."""
+    conn = get_connection_from_pool()
+    page.connection_id = conn.conn_id
+    logger.info("started connection", extra={
+        "log_tag": "rw_pool", "connection_id": conn.conn_id,
+        "channel": page.url})
+    retire = False
+    try:
+        return _run_for_channel_fn(conn.client, page, storage_prefix, sm, cfg,
+                                   processor=processor)
+    except FloodWaitRetireError as e:
+        retire = True
+        raise
+    finally:
+        if retire:
+            retire_connection_from_pool(conn.conn_id, "flood_wait_retire")
+        else:
+            release_connection_to_pool(conn)
+
+
+# ---------------------------------------------------------------------------
+# The hottest loop (`crawl/runner.go:1110-1550`)
+# ---------------------------------------------------------------------------
+
+def process_all_messages(client: TelegramClient, info: ChannelInfo,
+                         messages: List[TLMessage], crawl_id: str,
+                         channel_username: str, sm, owner: Page,
+                         cfg: CrawlerConfig,
+                         processor: Optional[MessageProcessor] = None,
+                         rng: Optional[random.Random] = None,
+                         sleep=None) -> List[Page]:
+    """Per-message processing + outlink discovery + random-walk edge logic."""
+    import time as _time
+    sleep = sleep or _time.sleep
+    rng = rng or random.Random()
+    processor = processor or DefaultMessageProcessor()
+
+    discovered_channels: List[Page] = []
+    discovered_edges: List[EdgeRecord] = []
+    new_channels: Dict[str, bool] = {}
+    lookup_stats = _LookupStats()
+
+    # Tandem batch, created lazily on the first valid edge (`:1252-1306`).
+    tandem_batch: Optional[PendingEdgeBatch] = None
+    seen_in_batch: Set[str] = set()
+
+    discovered_messages = [
+        Message(chat_id=m.chat_id, message_id=m.id, status="unfetched",
+                page_id=owner.id)
+        for m in messages
+    ]
+    owner.messages = add_new_messages(discovered_messages, owner)
+    owner.messages = resample_marker(owner.messages, discovered_messages)
+    sm.update_page(owner)
+
+    by_id = {m.id: m for m in messages}
+    fetched = deleted = processed = failed = 0
+
+    for message in list(owner.messages):
+        if message.status in ("fetched", "deleted"):
+            continue
+        disc = by_id.get(message.message_id)
+        if disc is None:
+            sm.update_message(owner.id, message.chat_id, message.message_id,
+                              "deleted")
+            deleted += 1
+            continue
+        processed += 1
+        try:
+            outlinks = processor.process_message(
+                client, disc, message.message_id, message.chat_id, info,
+                crawl_id, channel_username, sm, cfg)
+        except FloodWaitRetireError:
+            raise
+        except Exception as e:
+            logger.error("error processing message", extra={
+                "message_id": message.message_id, "error": str(e)})
+            sm.update_message(owner.id, message.chat_id, message.message_id,
+                              "failed")
+            failed += 1
+            continue
+        sm.update_message(owner.id, message.chat_id, message.message_id,
+                          "fetched")
+        fetched += 1
+        if not outlinks:
+            continue
+
+        # Source-type attribution for lookup stats (random-walk only).
+        msg_source_map: Dict[str, str] = {}
+        if cfg.sampling_method == "random-walk":
+            for link in extract_channel_links_with_source(disc):
+                msg_source_map[link.name] = link.source_type
+
+        for o in outlinks:
+            if o == owner.url:
+                continue  # self-reference
+            if cfg.sampling_method != "random-walk":
+                discovered_channels.append(Page(
+                    url=o, status="unfetched", parent_id=owner.id,
+                    id=new_id(), depth=owner.depth + 1))
+                continue
+
+            # --- random-walk path ---
+            if sm.is_invalid_channel(o):
+                continue
+
+            if cfg.tandem_crawl:
+                # Tandem: stream edges to pending_edges; no SearchPublicChat,
+                # no walkback decision here (`:1252-1306`).
+                src_type = msg_source_map.get(o, "unknown")
+                if not filter_username(o).valid:
+                    continue
+                if o in seen_in_batch:
+                    continue
+                seen_in_batch.add(o)
+                if tandem_batch is None:
+                    tandem_batch = PendingEdgeBatch(
+                        batch_id=new_id(), crawl_id=cfg.crawl_id,
+                        source_channel=owner.url, source_page_id=owner.id,
+                        source_depth=owner.depth,
+                        sequence_id=owner.sequence_id, status=BATCH_OPEN)
+                    sm.create_pending_batch(tandem_batch)
+                    logger.info("created pending batch", extra={
+                        "log_tag": "rw_channel",
+                        "batch_id": tandem_batch.batch_id,
+                        "source_channel": owner.url})
+                try:
+                    sm.insert_pending_edge(PendingEdge(
+                        batch_id=tandem_batch.batch_id, crawl_id=cfg.crawl_id,
+                        destination_channel=o, source_channel=owner.url,
+                        sequence_id=owner.sequence_id,
+                        discovery_time=utcnow(), source_type=src_type))
+                except Exception as e:
+                    logger.error("failed to insert pending edge",
+                                 extra={"channel": o, "error": str(e)})
+                continue
+
+            # Standard random-walk: validate via SearchPublicChat.
+            if sm.is_discovered_channel(o):
+                continue
+            _, is_seed = sm.get_cached_chat_id(o)
+            if is_seed:
+                # Seed channel: mark discovered, no edge (`:1316-1321`).
+                sm.add_discovered_channel(o)
+                continue
+            src_type = msg_source_map.get(o, "unknown")
+            chat = None
+            while True:
+                try:
+                    chat = client.search_public_chat(o)
+                    break
+                except Exception as search_err:
+                    secs, is_flood = parse_flood_wait_seconds(search_err)
+                    if is_flood:
+                        if secs >= FLOOD_WAIT_RETIRE_THRESHOLD_S:
+                            raise FloodWaitRetireError(secs) from search_err
+                        logger.warning("FLOOD_WAIT on SearchPublicChat, "
+                                       "sleeping and retrying", extra={
+                                           "retry_after_secs": secs,
+                                           "channel": o})
+                        sleep(secs)
+                        continue
+                    lookup_stats.record(src_type, False)
+                    sm.mark_channel_invalid(o, "not_found")
+                    chat = None
+                    break
+            if chat is None:
+                continue
+            if chat.type != "supergroup":
+                lookup_stats.record(src_type, False)
+                sm.mark_channel_invalid(o, "not_supergroup")
+                continue
+            lookup_stats.record(src_type, True)
+            sm.add_discovered_channel(o)
+            new_channels[o] = True
+            sm.upsert_seed_channel_chat_id(o, chat.id)
+
+    if cfg.sampling_method == "random-walk" and lookup_stats.total > 0:
+        lookup_stats.log(owner.url, "final")
+
+    logger.info("message processing summary", extra={
+        "messages_processed": processed, "messages_fetched": fetched,
+        "messages_deleted": deleted, "messages_failed": failed,
+        "discovered_channels": len(seen_in_batch) if cfg.tandem_crawl
+        else len(discovered_channels),
+        "page_url": owner.url})
+
+    # --- next-page selection (`:1413-1540`) -------------------------------
+    if cfg.sampling_method == "random-walk":
+        if cfg.tandem_crawl:
+            _finish_tandem(sm, owner, tandem_batch, rng)
+        else:
+            _walkback_decision(sm, owner, new_channels, discovered_edges,
+                               cfg, rng)
+
+    owner.status = "fetched"
+    sm.update_page(owner)
+    return discovered_channels
+
+
+def _finish_tandem(sm, owner: Page, tandem_batch: Optional[PendingEdgeBatch],
+                   rng: random.Random) -> None:
+    """Close the batch (validator owns walkback) or forced walkback when no
+    edges were found (`crawl/runner.go:1413-1456`)."""
+    if tandem_batch is not None:
+        sm.close_pending_batch(tandem_batch.batch_id)
+        logger.info("batch closed, validator will handle walkback", extra={
+            "log_tag": "rw_channel", "batch_id": tandem_batch.batch_id})
+        return
+    walkback_url = pick_walkback_channel(sm, owner.url, rng=rng)
+    page = Page(id=new_id(), parent_id=owner.id, depth=owner.depth + 1,
+                url=walkback_url, sequence_id=new_id(), status="unfetched")
+    edge = EdgeRecord(destination_channel=walkback_url,
+                      source_channel=owner.url, walkback=True, skipped=False,
+                      discovery_time=utcnow(), sequence_id=owner.sequence_id)
+    sm.add_page_to_page_buffer(page)
+    sm.save_edge_records([edge])
+
+
+def _walkback_decision(sm, owner: Page, new_channels: Dict[str, bool],
+                       discovered_edges: List[EdgeRecord], cfg: CrawlerConfig,
+                       rng: random.Random) -> None:
+    """Walk forward to a random new channel or back to a random discovered
+    one, writing primary + skipped edges (`crawl/runner.go:1471-1539`)."""
+    page = Page(status="unfetched", parent_id=owner.id, id=new_id(),
+                depth=owner.depth + 1)
+    primary = EdgeRecord(discovery_time=utcnow(), source_channel=owner.url,
+                         skipped=False)
+
+    walkback = not new_channels
+    rnd = rng.randint(1, 100) if new_channels else -1
+    logger.info("walkback decision data", extra={
+        "log_tag": "rw_channel", "walkback_rate": cfg.walkback_rate,
+        "random_num": rnd, "walkback": walkback,
+        "new_channels": len(new_channels), "source_channel": owner.url})
+
+    if walkback or cfg.walkback_rate >= rnd:
+        primary.walkback = True
+        walkback_url = pick_walkback_channel(sm, owner.url, new_channels,
+                                             rng=rng)
+        page.url = walkback_url
+        primary.sequence_id = owner.sequence_id  # edge belongs to this chain
+        page.sequence_id = new_id()  # next crawl starts a new chain
+    else:
+        primary.walkback = False
+        choices = sorted(new_channels)
+        page.url = choices[rng.randrange(len(choices))]
+        del new_channels[page.url]  # remainder becomes skipped edges
+        primary.sequence_id = owner.sequence_id
+        page.sequence_id = owner.sequence_id
+
+    primary.destination_channel = page.url
+    discovered_edges.append(primary)
+    sm.add_page_to_page_buffer(page)
+
+    for channel in new_channels:
+        discovered_edges.append(EdgeRecord(
+            destination_channel=channel, discovery_time=utcnow(),
+            skipped=True, source_channel=owner.url, walkback=False,
+            sequence_id=owner.sequence_id))
+    sm.save_edge_records(discovered_edges)
+
+
+class _LookupStats:
+    """SearchPublicChat hit/miss stats by source type
+    (`crawl/runner.go:1040-1079`)."""
+
+    def __init__(self):
+        self.total = 0
+        self.by_type: Dict[str, List[int]] = {}
+
+    def record(self, source_type: str, hit: bool) -> None:
+        self.total += 1
+        entry = self.by_type.setdefault(source_type, [0, 0])
+        entry[0 if hit else 1] += 1
+        if self.total % 100 == 0:
+            self.log("", "periodic")
+
+    def log(self, channel: str, kind: str) -> None:
+        logger.info("lookup stats", extra={
+            "log_tag": "rw_lookup_stats", "channel": channel, "kind": kind,
+            "total": self.total,
+            "by_type": {k: {"hits": v[0], "misses": v[1]}
+                        for k, v in self.by_type.items()}})

@@ -1,0 +1,113 @@
+"""Paged history fetching with date windows and sampling.
+
+Parity with `telegramhelper/telegramutils.go`:
+- `fetch_channel_messages_with_sampling`: 100-message pages walked newest to
+  oldest with min/max date windows, early termination, stall detection, and
+  Fisher-Yates sampling (`:25-157`)
+- member counts (`:159-310`) and comment-thread fetching (`:311`).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from datetime import datetime
+from typing import List, Optional
+
+from ..clients.telegram import TelegramClient, TLMessage
+from ..state.datamodels import Page
+
+logger = logging.getLogger("dct.telegram.fetch")
+
+PAGE_SIZE = 100  # messages per history page (`telegramutils.go:49`)
+
+
+def fetch_channel_messages_with_sampling(
+        client: TelegramClient, chat_id: int, page: Page,
+        min_post_date: Optional[datetime] = None,
+        max_post_date: Optional[datetime] = None,
+        max_posts: int = -1, sample_size: int = 0,
+        rng: Optional[random.Random] = None) -> List[TLMessage]:
+    """`telegramutils.go:25-157`."""
+    all_messages: List[TLMessage] = []
+    from_message_id = 0
+    oldest_message_id = 0
+    first_batch = True
+    min_unix = int(min_post_date.timestamp()) if min_post_date else None
+    max_unix = int(max_post_date.timestamp()) if max_post_date else None
+
+    while True:
+        history = client.get_chat_history(chat_id,
+                                          from_message_id=from_message_id,
+                                          limit=PAGE_SIZE)
+        if not history.messages:
+            break
+        if first_batch:
+            public_msg_id = history.messages[0].id // 1048576
+            logger.info("estimated post count for channel", extra={
+                "channel": page.url, "total_posts": public_msg_id})
+            first_batch = False
+
+        reached_old = False
+        for msg in history.messages:
+            if min_unix is not None and msg.date < min_unix:
+                reached_old = True
+                break
+            if max_unix is not None and msg.date > max_unix:
+                continue  # newer than the window: skip, keep walking older
+            all_messages.append(msg)
+            if 0 <= max_posts == len(all_messages):
+                reached_old = True
+                break
+        if reached_old:
+            break
+
+        last_message_id = history.messages[-1].id
+        if last_message_id == oldest_message_id:
+            break  # stalled: same oldest message as the previous page
+        oldest_message_id = last_message_id
+        from_message_id = last_message_id
+
+    logger.debug("fetched %d messages for %s", len(all_messages), page.url)
+
+    # Fisher-Yates sample when requested (`telegramutils.go:124-154`).
+    if 0 < sample_size < len(all_messages):
+        rng = rng or random.Random()
+        sampled = list(all_messages)
+        rng.shuffle(sampled)
+        sampled = sampled[:sample_size]
+        logger.info("random sampling applied", extra={
+            "channel": page.url, "original": len(all_messages),
+            "sampled": len(sampled)})
+        return sampled
+    return all_messages
+
+
+def get_channel_member_count(client: TelegramClient, username: str) -> int:
+    """Member count via chat -> supergroup full info
+    (`telegramutils.go:159-310`)."""
+    chat = client.search_public_chat(username)
+    if chat.supergroup_id:
+        try:
+            info = client.get_supergroup_full_info(chat.supergroup_id)
+            if info.member_count:
+                return info.member_count
+        except Exception:
+            pass
+        sg = client.get_supergroup(chat.supergroup_id)
+        return sg.member_count
+    return 0
+
+
+def get_message_comments(client: TelegramClient, chat_id: int, message_id: int,
+                         max_comments: int = 100) -> List[TLMessage]:
+    """Comment thread of a post (`telegramutils.go:311`)."""
+    try:
+        thread = client.get_message_thread_history(
+            chat_id, message_id,
+            limit=max_comments if max_comments > 0 else 100)
+        return thread.messages
+    except Exception as e:
+        logger.debug("no comment thread", extra={
+            "chat_id": chat_id, "message_id": message_id, "error": str(e)})
+        return []

@@ -1,0 +1,557 @@
+"""Crawl-engine tests: parsing, fetch windows, dedup/resample, the channel
+pipeline, random-walk walkback + tandem batching, FLOOD_WAIT policy, pool
+facade, 400-replacement.
+
+Reference analogs: crawl/channel_info_test.go, fetch_messages_test.go,
+message_processing_test.go, runner_flood_wait_test.go, runner_400_test.go,
+runner_tandem_test.go.
+"""
+
+import random
+
+import pytest
+
+from distributed_crawler_tpu.clients import SimNetwork, SimTelegramClient
+from distributed_crawler_tpu.clients.telegram import TLMessage
+from distributed_crawler_tpu.config import CrawlerConfig
+from distributed_crawler_tpu.crawl import (
+    FloodWaitRetireError,
+    TDLib400Error,
+    WalkbackExhaustedError,
+    add_new_messages,
+    handle_400_replacement,
+    pick_walkback_channel,
+    resample_marker,
+    run_for_channel,
+)
+from distributed_crawler_tpu.crawl.runner import (
+    DefaultMessageProcessor,
+    process_all_messages,
+)
+from distributed_crawler_tpu.state import (
+    CompositeStateManager,
+    Page,
+    SqlConfig,
+    StateConfig,
+)
+from distributed_crawler_tpu.state.datamodels import EdgeRecord, Message
+from distributed_crawler_tpu.telegram import (
+    build_telegram_link,
+    extract_channel_links_with_source,
+    fetch_channel_messages_with_sampling,
+    parse_message,
+    utf16_slice,
+)
+
+
+def text_msg(text, entities=None, **kw):
+    content = {"@type": "messageText",
+               "text": {"text": text, "entities": entities or []}}
+    return TLMessage(content=content, **kw)
+
+
+def make_sm(tmp_path, sampling="channel", crawl_id="c1"):
+    return CompositeStateManager(StateConfig(
+        crawl_id=crawl_id, crawl_execution_id="e1",
+        storage_root=str(tmp_path), sampling_method=sampling,
+        sql=SqlConfig(url=":memory:")))
+
+
+def make_cfg(**kw):
+    base = dict(crawl_id="c1", skip_media_download=True)
+    base.update(kw)
+    return CrawlerConfig(**base)
+
+
+class TestParsing:
+    def test_utf16_slice_with_surrogates(self):
+        # Emoji occupies 2 UTF-16 units; offsets after it shift.
+        s = "😀 @chan_one rest"
+        assert utf16_slice(s, 3, 9) == "@chan_one"
+
+    def test_extract_links_source_priority(self):
+        text = "see @mention_chan and t.me/plain_chan"
+        entities = [
+            {"type": {"@type": "textEntityTypeMention"}, "offset": 4,
+             "length": 13},
+            {"type": {"@type": "textEntityTypeTextUrl",
+                      "url": "https://t.me/hyperlink_chan"}, "offset": 0,
+             "length": 3},
+        ]
+        links = {l.name: l.source_type
+                 for l in extract_channel_links_with_source(text_msg(text, entities))}
+        assert links["mention_chan"] == "mention"
+        assert links["hyperlink_chan"] == "text_url"
+        assert links["plain_chan"] == "plaintext"
+
+    def test_reserved_tme_paths_ignored(self):
+        msg = text_msg("join t.me/joinchat/abcdef and t.me/realchan")
+        names = [l.name for l in extract_channel_links_with_source(msg)]
+        assert "joinchat" not in names
+        assert "realchan" in names
+
+    def test_public_link_uses_shifted_id(self):
+        assert build_telegram_link("chan", 5 * 1048576) == "https://t.me/chan/5"
+
+    def test_parse_message_end_to_end(self, tmp_path):
+        net = SimNetwork()
+        msg = text_msg("hello @other_chan", view_count=100, forward_count=5,
+                       reply_count=2, reactions={"👍": 9}, date=1700000000)
+        ch = net.add_channel("mychan", messages=[msg], member_count=777)
+        client = SimTelegramClient(net)
+        chat = client.search_public_chat("mychan")
+        sg = client.get_supergroup(chat.supergroup_id)
+        sgi = client.get_supergroup_full_info(chat.supergroup_id)
+        sm = make_sm(tmp_path)
+        post = parse_message("c1", msg, chat, sg, sgi, 50, 1000, "mychan",
+                             client, sm, make_cfg())
+        assert post.platform_name == "telegram"
+        assert post.view_count == 100 and post.shares_count == 5
+        assert post.engagement == 107
+        assert post.outlinks == ["other_chan"]
+        assert post.reactions == {"👍": 9}
+        assert post.channel_data.channel_engagement_data.follower_count == 777
+        assert post.post_link.startswith("https://t.me/mychan/")
+        assert post.post_uid == f"{chat.id}_{msg.id}"
+
+    def test_parse_message_media_and_cap(self, tmp_path):
+        net = SimNetwork()
+        net.add_file("small_file", b"x" * 100)
+        msg = TLMessage(content={"@type": "messageVideo",
+                                 "caption": {"text": "vid"},
+                                 "video": {"remote_id": "small_file"}},
+                        date=1700000000)
+        ch = net.add_channel("mychan", messages=[msg])
+        client = SimTelegramClient(net)
+        chat = client.search_public_chat("mychan")
+        sm = make_sm(tmp_path)
+        cfg = make_cfg(skip_media_download=False)
+        post = parse_message("c1", msg, chat, None, None, 1, 0, "mychan",
+                             client, sm, cfg)
+        assert post.media_data.document_name
+        assert sm.has_processed_media("small_file")
+        # Second parse: dedup — media not re-stored.
+        post2 = parse_message("c1", msg, chat, None, None, 1, 0, "mychan",
+                              client, sm, cfg)
+        assert post2.media_data.document_name == ""
+
+    def test_parse_message_comments(self, tmp_path):
+        net = SimNetwork()
+        msg = text_msg("post with comments", reply_count=2, date=1700000000)
+        ch = net.add_channel("mychan", messages=[msg])
+        net.add_comments(ch.chat_id, msg.id, [
+            text_msg("first!", sender_username="fan1"),
+            text_msg("second", sender_username="fan2")])
+        client = SimTelegramClient(net)
+        chat = client.search_public_chat("mychan")
+        sm = make_sm(tmp_path)
+        post = parse_message("c1", msg, chat, None, None, 1, 0, "mychan",
+                             client, sm, make_cfg(max_comments=10))
+        assert [c.handle for c in post.comments] == ["fan1", "fan2"]
+
+
+class TestFetch:
+    def _client(self, dates):
+        net = SimNetwork()
+        msgs = [text_msg(f"m{i}", date=d) for i, d in enumerate(dates)]
+        ch = net.add_channel("chan", messages=msgs)
+        return SimTelegramClient(net), ch
+
+    def test_min_date_cutoff(self):
+        from datetime import datetime, timezone
+        client, ch = self._client([1000, 2000, 3000, 4000])
+        msgs = fetch_channel_messages_with_sampling(
+            client, ch.chat_id, Page(url="chan"),
+            min_post_date=datetime.fromtimestamp(2500, tz=timezone.utc))
+        assert sorted(m.date for m in msgs) == [3000, 4000]
+
+    def test_max_posts_truncates(self):
+        client, ch = self._client(list(range(1000, 1500)))
+        msgs = fetch_channel_messages_with_sampling(
+            client, ch.chat_id, Page(url="chan"), max_posts=7)
+        assert len(msgs) == 7
+
+    def test_sampling_applied(self):
+        client, ch = self._client(list(range(1000, 1300)))
+        msgs = fetch_channel_messages_with_sampling(
+            client, ch.chat_id, Page(url="chan"), sample_size=10,
+            rng=random.Random(0))
+        assert len(msgs) == 10
+
+    def test_date_between_window(self):
+        from datetime import datetime, timezone
+        client, ch = self._client([1000, 2000, 3000, 4000, 5000])
+        msgs = fetch_channel_messages_with_sampling(
+            client, ch.chat_id, Page(url="chan"),
+            min_post_date=datetime.fromtimestamp(1500, tz=timezone.utc),
+            max_post_date=datetime.fromtimestamp(4500, tz=timezone.utc))
+        assert sorted(m.date for m in msgs) == [2000, 3000, 4000]
+
+
+class TestMessageBookkeeping:
+    def test_add_new_messages_dedups(self):
+        owner = Page(id="p1", messages=[Message(chat_id=1, message_id=10,
+                                                status="fetched")])
+        merged = add_new_messages([Message(chat_id=1, message_id=10),
+                                   Message(chat_id=1, message_id=20)], owner)
+        assert len(merged) == 2
+
+    def test_resample_marker_rules(self):
+        msgs = [Message(chat_id=1, message_id=1, status="fetched"),
+                Message(chat_id=1, message_id=2, status="unfetched"),
+                Message(chat_id=1, message_id=3, status="failed")]
+        discovered = [Message(chat_id=1, message_id=1),
+                      Message(chat_id=1, message_id=2)]
+        out = resample_marker(msgs, discovered)
+        assert out[0].status == "fetched"  # never touched
+        assert out[1].status == "resample"  # still exists
+        assert out[2].status == "deleted"  # gone from latest fetch
+
+
+def build_channel_network(outlink_targets=("target_one", "target_two")):
+    """A source channel whose messages mention other channels that also exist."""
+    net = SimNetwork()
+    mentions = " ".join(f"@{t}" for t in outlink_targets)
+    msgs = [text_msg(f"post {i} {mentions}", date=1700000000 + i,
+                     view_count=10) for i in range(3)]
+    src = net.add_channel("source_chan", messages=msgs, member_count=1000)
+    for t in outlink_targets:
+        net.add_channel(t, messages=[text_msg("hi", date=1700000005)],
+                        member_count=500)
+    return net, src
+
+
+class TestRunForChannelBFS:
+    def test_happy_path_stores_posts_and_discovers(self, tmp_path):
+        net, src = build_channel_network()
+        client = SimTelegramClient(net)
+        sm = make_sm(tmp_path)
+        page = Page(id="p1", url="source_chan", depth=0)
+        discovered = run_for_channel(client, page, "", sm, make_cfg())
+        urls = {p.url for p in discovered}
+        assert urls == {"target_one", "target_two"}
+        assert all(p.depth == 1 for p in discovered)
+        assert page.status == "fetched"
+        # Posts landed in per-channel JSONL.
+        jsonl = tmp_path / "c1" / "source_chan" / "posts" / "posts.jsonl"
+        assert jsonl.exists()
+        assert len(jsonl.read_text().strip().split("\n")) == 3
+
+    def test_min_users_gate_marks_deadend(self, tmp_path):
+        net, src = build_channel_network()
+        client = SimTelegramClient(net)
+        sm = make_sm(tmp_path)
+        page = Page(id="p1", url="source_chan", depth=0)
+        out = run_for_channel(client, page, "", sm,
+                              make_cfg(min_users=999999))
+        assert out == []
+        assert page.status == "deadend"
+
+    def test_post_recency_gate(self, tmp_path):
+        from datetime import datetime, timezone
+        net, src = build_channel_network()
+        client = SimTelegramClient(net)
+        sm = make_sm(tmp_path)
+        page = Page(id="p1", url="source_chan", depth=0)
+        out = run_for_channel(client, page, "", sm, make_cfg(
+            post_recency=datetime(2030, 1, 1, tzinfo=timezone.utc)))
+        assert out == [] and page.status == "deadend"
+
+    def test_unknown_channel_raises_400(self, tmp_path):
+        net = SimNetwork()
+        client = SimTelegramClient(net)
+        sm = make_sm(tmp_path)
+        with pytest.raises(TDLib400Error):
+            run_for_channel(client, Page(id="p1", url="ghost_chan"), "", sm,
+                            make_cfg())
+
+    def test_failed_message_marked_and_others_continue(self, tmp_path):
+        net, src = build_channel_network()
+        client = SimTelegramClient(net)
+        sm = make_sm(tmp_path)
+        page = Page(id="p1", url="source_chan", depth=0)
+
+        class FlakyProcessor(DefaultMessageProcessor):
+            count = 0
+            def process_message(self, *a, **kw):
+                FlakyProcessor.count += 1
+                if FlakyProcessor.count == 2:
+                    raise RuntimeError("boom on message 2")
+                return super().process_message(*a, **kw)
+
+        run_for_channel(client, page, "", sm, make_cfg(),
+                        processor=FlakyProcessor())
+        statuses = sorted(m.status for m in sm.get_page("p1").messages)
+        assert statuses.count("failed") == 1
+        assert statuses.count("fetched") == 2
+
+
+class TestRandomWalk:
+    def _run(self, tmp_path, walkback_rate, seed=3, targets=("target_one",
+                                                             "target_two"),
+             pre_discovered=("earlier_chan",)):
+        net, src = build_channel_network(targets)
+        client = SimTelegramClient(net)
+        sm = make_sm(tmp_path, sampling="random-walk")
+        for ch in pre_discovered:
+            sm.add_discovered_channel(ch)
+        sm.initialize(["source_chan"])
+        page = sm.get_layer_by_depth(0)[0]
+        cfg = make_cfg(sampling_method="random-walk",
+                       walkback_rate=walkback_rate)
+        run_for_channel(client, page, "", sm, cfg, rng=random.Random(seed))
+        return sm, page
+
+    def test_forward_walk_writes_primary_and_skipped_edges(self, tmp_path):
+        sm, page = self._run(tmp_path, walkback_rate=0)
+        pages = sm.get_pages_from_page_buffer(10)
+        assert len(pages) == 1
+        nxt = pages[0]
+        assert nxt.url in ("target_one", "target_two")
+        assert nxt.sequence_id == page.sequence_id  # forward keeps the chain
+        primary = sm.get_edge_record(page.sequence_id, nxt.url)
+        assert primary is not None and not primary.walkback and not primary.skipped
+        other = ({"target_one", "target_two"} - {nxt.url}).pop()
+        skipped = sm.get_edge_record(page.sequence_id, other)
+        assert skipped is not None and skipped.skipped
+
+    def test_walkback_rate_100_walks_back(self, tmp_path):
+        sm, page = self._run(tmp_path, walkback_rate=100)
+        pages = sm.get_pages_from_page_buffer(10)
+        assert len(pages) == 1
+        nxt = pages[0]
+        # Walkback goes to a discovered channel, new chain for the page.
+        assert nxt.sequence_id != page.sequence_id
+        edge = sm.get_edge_record(page.sequence_id, nxt.url)
+        assert edge is not None and edge.walkback
+
+    def test_discovered_channels_cached_as_seeds(self, tmp_path):
+        sm, page = self._run(tmp_path, walkback_rate=0)
+        # SearchPublicChat result cached for future runs.
+        chat_id, ok = sm.get_cached_chat_id("target_one")
+        assert ok and chat_id > 0
+        assert sm.is_discovered_channel("target_one")
+
+    def test_channel_marked_crawled_with_incremental_window(self, tmp_path):
+        sm, page = self._run(tmp_path, walkback_rate=0)
+        assert sm.get_channel_last_crawled("source_chan") is not None
+
+    def test_invalid_outlinks_marked(self, tmp_path):
+        # target mentioned but does not exist in the network -> not_found.
+        net, src = build_channel_network(outlink_targets=("ghost_channel",))
+        del net.channels["ghost_channel"]
+        client = SimTelegramClient(net)
+        sm = make_sm(tmp_path, sampling="random-walk")
+        sm.initialize(["source_chan"])
+        page = sm.get_layer_by_depth(0)[0]
+        cfg = make_cfg(sampling_method="random-walk", walkback_rate=0)
+        # Only outlink is invalid -> no new channels -> forced walkback, but
+        # the only discovered channel is the source itself -> exhausted.
+        with pytest.raises(WalkbackExhaustedError):
+            run_for_channel(client, page, "", sm, cfg, rng=random.Random(0))
+        assert sm.is_invalid_channel("ghost_channel")
+
+    def test_short_floodwait_sleeps_and_retries(self, tmp_path):
+        net, src = build_channel_network(outlink_targets=("target_one",))
+        client = SimTelegramClient(net)
+        sm = make_sm(tmp_path, sampling="random-walk")
+        sm.initialize(["source_chan"])
+        page = sm.get_layer_by_depth(0)[0]
+        sleeps = []
+        info_msgs = None
+        from distributed_crawler_tpu.crawl.channelinfo import get_channel_info
+        cfg = make_cfg(sampling_method="random-walk", walkback_rate=0)
+        info, msgs = get_channel_info(client, page, 0, cfg)
+        net.inject_flood_wait("SearchPublicChat", 5, count=1)
+        process_all_messages(client, info, msgs, "c1", "source_chan", sm,
+                             page, cfg, rng=random.Random(1),
+                             sleep=sleeps.append)
+        assert sleeps == [5]  # slept the FLOOD_WAIT then retried
+        assert sm.is_discovered_channel("target_one")
+
+    def test_long_floodwait_raises_retire(self, tmp_path):
+        net, src = build_channel_network(outlink_targets=("target_one",))
+        client = SimTelegramClient(net)
+        sm = make_sm(tmp_path, sampling="random-walk")
+        sm.initialize(["source_chan"])
+        page = sm.get_layer_by_depth(0)[0]
+        from distributed_crawler_tpu.crawl.channelinfo import get_channel_info
+        cfg = make_cfg(sampling_method="random-walk", walkback_rate=0)
+        info, msgs = get_channel_info(client, page, 0, cfg)
+        # SearchPublicChat for the outlink flood-waits beyond threshold.
+        net.inject_flood_wait("SearchPublicChat", 72560, count=1)
+        with pytest.raises(FloodWaitRetireError):
+            process_all_messages(client, info, msgs, "c1", "source_chan", sm,
+                                 page, cfg, rng=random.Random(1))
+
+
+class TestTandem:
+    def _run(self, tmp_path, targets=("target_one", "target_two")):
+        net, src = build_channel_network(targets)
+        client = SimTelegramClient(net)
+        sm = make_sm(tmp_path, sampling="random-walk")
+        sm.initialize(["source_chan"])
+        page = sm.get_layer_by_depth(0)[0]
+        cfg = make_cfg(sampling_method="random-walk", tandem_crawl=True,
+                       walkback_rate=0)
+        run_for_channel(client, page, "", sm, cfg, rng=random.Random(3))
+        return sm, page, client
+
+    def test_edges_streamed_and_batch_closed(self, tmp_path):
+        sm, page, client = self._run(tmp_path)
+        # No SearchPublicChat for outlinks in tandem mode.
+        searches = [c for c in client.calls if c[0] == "SearchPublicChat"
+                    and c[1][0] != "source_chan"]
+        assert searches == []
+        # Batch closed with both edges pending validation.
+        edges = sm.claim_pending_edges(10)
+        assert {e.destination_channel for e in edges} == {"target_one",
+                                                          "target_two"}
+        assert all(e.sequence_id == page.sequence_id for e in edges)
+        assert sm.count_incomplete_batches("c1") == 1
+        # Page buffer untouched: the validator owns the next page.
+        assert sm.get_pages_from_page_buffer(10) == []
+
+    def test_bot_usernames_prefiltered(self, tmp_path):
+        sm, page, client = self._run(tmp_path,
+                                     targets=("real_channel", "spam_bot"))
+        edges = sm.claim_pending_edges(10)
+        assert {e.destination_channel for e in edges} == {"real_channel"}
+
+    def test_no_edges_forces_walkback(self, tmp_path):
+        net = SimNetwork()
+        msgs = [text_msg("no mentions here", date=1700000000)]
+        net.add_channel("source_chan", messages=msgs, member_count=100)
+        net.add_channel("other_chan", messages=[text_msg("x", date=1)])
+        client = SimTelegramClient(net)
+        sm = make_sm(tmp_path, sampling="random-walk")
+        sm.initialize(["source_chan", "other_chan"])
+        page = [p for p in sm.get_layer_by_depth(0)
+                if p.url == "source_chan"][0]
+        cfg = make_cfg(sampling_method="random-walk", tandem_crawl=True)
+        run_for_channel(client, page, "", sm, cfg, rng=random.Random(0))
+        pages = sm.get_pages_from_page_buffer(10)
+        assert len(pages) == 1 and pages[0].url == "other_chan"
+        edge = sm.get_edge_record(page.sequence_id, "other_chan")
+        assert edge is not None and edge.walkback
+
+
+class TestPoolFacade:
+    def test_retire_on_floodwait_release_otherwise(self, tmp_path):
+        from distributed_crawler_tpu.clients import ConnectionPool
+        from distributed_crawler_tpu.crawl import (
+            init_connection_pool,
+            run_for_channel_with_pool,
+            set_run_for_channel_fn,
+            shutdown_connection_pool,
+        )
+        net, _ = build_channel_network()
+        pool = ConnectionPool(factory=lambda cid: SimTelegramClient(net, cid),
+                              database_urls=["a", "b"])
+        pool.initialize()
+        shutdown_connection_pool()
+        init_connection_pool(pool)
+        sm = make_sm(tmp_path)
+
+        calls = []
+        def fail_with_floodwait(client, page, prefix, sm_, cfg, processor=None):
+            calls.append(page.connection_id)
+            raise FloodWaitRetireError(90000)
+        set_run_for_channel_fn(fail_with_floodwait)
+        try:
+            with pytest.raises(FloodWaitRetireError):
+                run_for_channel_with_pool(Page(id="p", url="source_chan"),
+                                          "", sm, make_cfg())
+            assert pool.stats()["retired"] == 1
+            # Normal failure: released, not retired.
+            def fail_normal(client, page, prefix, sm_, cfg, processor=None):
+                raise RuntimeError("plain error")
+            set_run_for_channel_fn(fail_normal)
+            with pytest.raises(RuntimeError):
+                run_for_channel_with_pool(Page(id="p2", url="source_chan"),
+                                          "", sm, make_cfg())
+            assert pool.stats()["retired"] == 1  # unchanged
+            conn = pool.acquire(timeout_s=1)  # still acquirable
+            pool.release(conn)
+        finally:
+            set_run_for_channel_fn(None)
+            shutdown_connection_pool()
+
+
+class TestWalkbackPicker:
+    def test_excludes_source_and_excluded(self, tmp_path):
+        sm = make_sm(tmp_path, sampling="random-walk")
+        for ch in ("a_chan", "b_chan", "c_chan"):
+            sm.add_discovered_channel(ch)
+        picked = set()
+        for i in range(20):
+            try:
+                picked.add(pick_walkback_channel(sm, "a_chan",
+                                                 {"b_chan": True},
+                                                 rng=random.Random(i)))
+            except WalkbackExhaustedError:
+                pass  # possible with 10 bounded random draws — reference parity
+        assert picked == {"c_chan"}
+
+    def test_exhaustion(self, tmp_path):
+        sm = make_sm(tmp_path, sampling="random-walk")
+        sm.add_discovered_channel("only_chan")
+        with pytest.raises(WalkbackExhaustedError):
+            pick_walkback_channel(sm, "only_chan", rng=random.Random(0))
+
+
+class Test400Replacement:
+    def _sm(self, tmp_path):
+        sm = make_sm(tmp_path, sampling="random-walk")
+        for ch in ("src_chan", "dead_chan", "alt_chan", "walk_chan"):
+            sm.add_discovered_channel(ch)
+        return sm
+
+    def test_forward_edge_promotes_skipped_sibling(self, tmp_path):
+        sm = self._sm(tmp_path)
+        sm.save_edge_records([
+            EdgeRecord(destination_channel="dead_chan", source_channel="src_chan",
+                       skipped=False, sequence_id="q1"),
+            EdgeRecord(destination_channel="alt_chan", source_channel="src_chan",
+                       skipped=True, sequence_id="q1"),
+        ])
+        page = Page(id="pdead", url="dead_chan", sequence_id="q1", depth=3,
+                    parent_id="pp")
+        handle_400_replacement(sm, page, make_cfg(sampling_method="random-walk"),
+                               rng=random.Random(0))
+        assert sm.is_invalid_channel("dead_chan")
+        assert sm.get_edge_record("q1", "dead_chan") is None  # edge deleted
+        pages = sm.get_pages_from_page_buffer(10)
+        assert [p.url for p in pages] == ["alt_chan"]
+        assert pages[0].sequence_id == "q1" and pages[0].depth == 3
+        promoted = sm.get_edge_record("q1", "alt_chan")
+        assert promoted is not None and not promoted.skipped
+
+    def test_walkback_edge_walks_back_again(self, tmp_path):
+        sm = self._sm(tmp_path)
+        sm.save_edge_records([
+            EdgeRecord(destination_channel="dead_chan", source_channel="src_chan",
+                       walkback=True, skipped=False, sequence_id="q1")])
+        page = Page(id="pdead", url="dead_chan", sequence_id="q1", depth=2)
+        handle_400_replacement(sm, page, make_cfg(sampling_method="random-walk"),
+                               rng=random.Random(0))
+        pages = sm.get_pages_from_page_buffer(10)
+        assert len(pages) == 1
+        nxt = pages[0]
+        assert nxt.url not in ("dead_chan",)
+        assert nxt.sequence_id != "q1"  # new chain
+        edge = sm.get_edge_record("q1", nxt.url)
+        assert edge is not None and edge.walkback
+
+    def test_no_edge_seed_channel_replaced_from_seed_pool(self, tmp_path):
+        sm = self._sm(tmp_path)
+        sm.mark_channel_crawled("dead_chan", 1)
+        sm.mark_channel_crawled("fresh_seed", 2)
+        sm.load_seed_channels()
+        page = Page(id="pdead", url="dead_chan", sequence_id="q9", depth=0)
+        handle_400_replacement(sm, page, make_cfg(sampling_method="random-walk"),
+                               rng=random.Random(0))
+        pages = sm.get_pages_from_page_buffer(10)
+        assert len(pages) == 1
+        # dead_chan was invalidated in seed_channels, so only fresh_seed remains.
+        assert pages[0].url == "fresh_seed"

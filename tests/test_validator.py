@@ -1,0 +1,305 @@
+"""Tandem validator tests: single-edge outcomes, blocked-state machine,
+walkback batch processing, crash-safe ordering (reference analog:
+crawl/validator_test.go)."""
+
+import random
+
+import pytest
+
+from distributed_crawler_tpu.clients import FakeClock, ValidatorRateLimiter
+from distributed_crawler_tpu.clients.http_validator import (
+    BLOCKED,
+    TRANSIENT,
+    ChannelValidationResult,
+    ValidationHTTPError,
+)
+from distributed_crawler_tpu.config import CrawlerConfig
+from distributed_crawler_tpu.crawl.validator import (
+    OUTCOME_BLOCKED,
+    OUTCOME_DEFINITIVE,
+    OUTCOME_TRANSIENT,
+    BlockedState,
+    ValidatorConfig,
+    edge_validation_step,
+    process_walkback_batch,
+    validate_single_edge,
+    walkback_step,
+)
+from distributed_crawler_tpu.state import (
+    CompositeStateManager,
+    PendingEdge,
+    PendingEdgeBatch,
+    SqlConfig,
+    StateConfig,
+)
+
+
+def make_sm(tmp_path):
+    sm = CompositeStateManager(StateConfig(
+        crawl_id="c1", crawl_execution_id="e1", storage_root=str(tmp_path),
+        sampling_method="random-walk", sql=SqlConfig(url=":memory:")))
+    return sm
+
+
+def make_limiter():
+    return ValidatorRateLimiter(requests_per_minute=0, jitter_ms=0,
+                                clock=FakeClock())
+
+
+def cfg(**kw):
+    base = dict(crawl_id="c1", validator_claim_batch_size=10)
+    base.update(kw)
+    return CrawlerConfig(**base)
+
+
+def edge(dest="dst_chan", pending_id=1, **kw):
+    base = dict(pending_id=pending_id, batch_id="b1", crawl_id="c1",
+                destination_channel=dest, source_channel="src_chan",
+                sequence_id="q1", source_type="mention")
+    base.update(kw)
+    return PendingEdge(**base)
+
+
+def validator_returning(status, reason=""):
+    return lambda username: ChannelValidationResult(status=status, reason=reason)
+
+
+def validator_raising(kind):
+    def fn(username):
+        raise ValidationHTTPError(kind, "nope")
+    return fn
+
+
+class TestValidateSingleEdge:
+    def test_cached_invalid_skips_http(self, tmp_path):
+        sm = make_sm(tmp_path)
+        sm.mark_channel_invalid("dst_chan", "not_found")
+        calls = []
+        update, kind = validate_single_edge(
+            sm, cfg(), make_limiter(), edge(),
+            lambda u: calls.append(u))
+        assert calls == []  # no HTTP
+        assert update.validation_status == "invalid"
+        assert update.validation_reason == "cached_invalid"
+        assert kind == OUTCOME_DEFINITIVE
+
+    def test_already_discovered_is_duplicate(self, tmp_path):
+        sm = make_sm(tmp_path)
+        sm.claim_discovered_channel("dst_chan", "earlier_crawl")
+        update, kind = validate_single_edge(
+            sm, cfg(), make_limiter(), edge(), validator_returning("valid"))
+        assert update.validation_status == "duplicate"
+        assert kind == OUTCOME_DEFINITIVE
+
+    def test_valid_claims_first_discovery(self, tmp_path):
+        sm = make_sm(tmp_path)
+        update, kind = validate_single_edge(
+            sm, cfg(), make_limiter(), edge(), validator_returning("valid"))
+        assert update.validation_status == "valid"
+        assert sm.is_channel_discovered("dst_chan")
+        # Cached for future SearchPublicChat skips.
+        assert sm.graph.load_seed_channels()
+
+    def test_valid_but_claim_lost_is_duplicate(self, tmp_path):
+        sm = make_sm(tmp_path)
+        # Another validator won the race already.
+        sm.graph.claim_discovered_channel("dst_chan", "other")
+        # in-memory discovered set is empty, DB says discovered.
+        update, _ = validate_single_edge(
+            sm, cfg(), make_limiter(), edge(), validator_returning("valid"))
+        assert update.validation_status == "duplicate"
+
+    def test_not_channel_marks_invalid(self, tmp_path):
+        sm = make_sm(tmp_path)
+        update, kind = validate_single_edge(
+            sm, cfg(), make_limiter(), edge(),
+            validator_returning("not_channel", "not_supergroup"))
+        assert update.validation_status == "not_channel"
+        assert update.validation_reason == "not_supergroup"
+        assert sm.is_invalid_channel("dst_chan")
+
+    def test_blocked_leaves_pending(self, tmp_path):
+        sm = make_sm(tmp_path)
+        update, kind = validate_single_edge(
+            sm, cfg(), make_limiter(), edge(), validator_raising(BLOCKED))
+        assert update.validation_status == "pending"
+        assert kind == OUTCOME_BLOCKED
+        assert not sm.is_invalid_channel("dst_chan")  # never invalidated
+
+    def test_transient_leaves_pending(self, tmp_path):
+        sm = make_sm(tmp_path)
+        update, kind = validate_single_edge(
+            sm, cfg(), make_limiter(), edge(), validator_raising(TRANSIENT))
+        assert update.validation_status == "pending"
+        assert kind == OUTCOME_TRANSIENT
+
+
+class TestBlockedStateMachine:
+    def _seed_edges(self, sm, n):
+        sm.create_pending_batch(PendingEdgeBatch(
+            batch_id="b1", crawl_id="c1", source_channel="src_chan",
+            source_page_id="p1", source_depth=0, sequence_id="q1"))
+        for i in range(n):
+            sm.insert_pending_edge(edge(dest=f"chan_{i:02d}", pending_id=0))
+
+    def test_enters_blocked_after_threshold_and_emits_access_event(self, tmp_path):
+        sm = make_sm(tmp_path)
+        self._seed_edges(sm, 6)
+        blocked = BlockedState()
+        vcfg = ValidatorConfig(blocked_threshold=5)
+        clock = FakeClock(start=100.0)
+        edge_validation_step(sm, cfg(), vcfg, make_limiter(), blocked,
+                             validator_raising(BLOCKED), clock.time)
+        assert blocked.active
+        assert blocked.consecutive_count >= 5
+        events = sm.graph.binding.query("SELECT reason FROM access_events")
+        assert events == [("ip_blocked",)]
+        # Blocked edges go straight back to 'pending': immediately reclaimable.
+        assert len(sm.claim_pending_edges(100)) == 6
+
+    def test_probe_resumes_validation(self, tmp_path):
+        sm = make_sm(tmp_path)
+        blocked = BlockedState(active=True, consecutive_count=5,
+                               last_probe_at=0.0)
+        vcfg = ValidatorConfig(probe_interval_s=300)
+        clock = FakeClock(start=1000.0)
+        probes = []
+        def probe_ok(username):
+            probes.append(username)
+            return ChannelValidationResult(status="valid")
+        # First call probes immediately (last_probe_at sentinel 0).
+        edge_validation_step(sm, cfg(), vcfg, make_limiter(), blocked,
+                             probe_ok, clock.time)
+        assert probes == ["telegram"]  # canary channel
+        assert not blocked.active and blocked.consecutive_count == 0
+
+    def test_probe_failure_stays_blocked_until_interval(self, tmp_path):
+        sm = make_sm(tmp_path)
+        blocked = BlockedState(active=True, consecutive_count=5,
+                               last_probe_at=0.0)
+        vcfg = ValidatorConfig(probe_interval_s=300)
+        clock = FakeClock(start=1000.0)
+        probes = []
+        def probe_fail(username):
+            probes.append(clock.time())
+            raise ValidationHTTPError(BLOCKED, "still blocked")
+        edge_validation_step(sm, cfg(), vcfg, make_limiter(), blocked,
+                             probe_fail, clock.time)
+        assert blocked.active and len(probes) == 1
+        # Within the probe interval: no new probe.
+        clock.advance(100)
+        edge_validation_step(sm, cfg(), vcfg, make_limiter(), blocked,
+                             probe_fail, clock.time)
+        assert len(probes) == 1
+        # After the interval: probes again.
+        clock.advance(250)
+        edge_validation_step(sm, cfg(), vcfg, make_limiter(), blocked,
+                             probe_fail, clock.time)
+        assert len(probes) == 2
+
+    def test_transient_decrements_definitive_resets(self, tmp_path):
+        sm = make_sm(tmp_path)
+        self._seed_edges(sm, 3)
+        blocked = BlockedState(consecutive_count=3)
+        vcfg = ValidatorConfig(blocked_threshold=99)
+        clock = FakeClock()
+        outcomes = iter([validator_raising(TRANSIENT),
+                         validator_returning("valid"),
+                         validator_raising(BLOCKED)])
+        def dispatch(username, _it=[0]):
+            fns = [validator_raising(TRANSIENT), validator_returning("valid"),
+                   validator_raising(BLOCKED)]
+            fn = fns[min(_it[0], 2)]
+            _it[0] += 1
+            return fn(username)
+        edge_validation_step(sm, cfg(), vcfg, make_limiter(), blocked,
+                             dispatch, clock.time)
+        # transient: 3->2; definitive: ->0; blocked: ->1
+        assert blocked.consecutive_count == 1
+
+
+class TestWalkbackProcessing:
+    def _prepare_batch(self, sm, statuses):
+        sm.create_pending_batch(PendingEdgeBatch(
+            batch_id="b1", crawl_id="c1", source_channel="src_chan",
+            source_page_id="pp", source_depth=2, sequence_id="q1"))
+        for i, status in enumerate(statuses):
+            sm.insert_pending_edge(edge(dest=f"chan_{i:02d}", pending_id=0))
+        claimed = sm.claim_pending_edges(100)
+        from distributed_crawler_tpu.state import PendingEdgeUpdate
+        for e, status in zip(claimed, statuses):
+            sm.update_pending_edge(PendingEdgeUpdate(
+                pending_id=e.pending_id, validation_status=status))
+        sm.close_pending_batch("b1")
+
+    def test_forward_choice_with_skipped_edges(self, tmp_path):
+        sm = make_sm(tmp_path)
+        self._prepare_batch(sm, ["valid", "valid", "invalid"])
+        assert walkback_step(sm, cfg(walkback_rate=0), rng=random.Random(1))
+        pages = sm.get_pages_from_page_buffer(10)
+        assert len(pages) == 1
+        nxt = pages[0]
+        assert nxt.url.startswith("chan_0")
+        assert nxt.depth == 3 and nxt.parent_id == "pp"
+        assert nxt.sequence_id == "q1"  # forward keeps the chain
+        # Primary + one skipped edge for the other valid channel.
+        primary = sm.get_edge_record("q1", nxt.url)
+        assert primary is not None and not primary.skipped
+        other_valid = {"chan_00", "chan_01"} - {nxt.url}
+        skipped = sm.get_edge_record("q1", other_valid.pop())
+        assert skipped is not None and skipped.skipped
+        # Batch completed, stats flushed, edges deleted.
+        assert sm.count_incomplete_batches("c1") == 0
+        rows = sm.graph.binding.query(
+            "SELECT total, valid, invalid FROM source_type_stats "
+            "WHERE source_type='mention'")
+        assert rows == [(3, 2, 1)]
+        assert sm.claim_pending_edges(10) == []
+
+    def test_all_invalid_forces_walkback(self, tmp_path):
+        sm = make_sm(tmp_path)
+        sm.add_discovered_channel("older_chan")
+        self._prepare_batch(sm, ["invalid", "not_channel"])
+        assert walkback_step(sm, cfg(walkback_rate=0), rng=random.Random(0))
+        pages = sm.get_pages_from_page_buffer(10)
+        assert [p.url for p in pages] == ["older_chan"]
+        assert pages[0].sequence_id != "q1"  # walkback starts a new chain
+        edge_rec = sm.get_edge_record("q1", "older_chan")
+        assert edge_rec is not None and edge_rec.walkback
+
+    def test_page_carries_batch_crawl_id(self, tmp_path):
+        sm = make_sm(tmp_path)
+        # Batch from a DIFFERENT crawl than the validator's own.
+        sm.create_pending_batch(PendingEdgeBatch(
+            batch_id="bx", crawl_id="other_crawl", source_channel="s",
+            source_page_id="pp", source_depth=0, sequence_id="q2"))
+        sm.insert_pending_edge(edge(dest="somewhere_chan", pending_id=0,
+                                    batch_id="bx", crawl_id="other_crawl"))
+        claimed = sm.claim_pending_edges(10)
+        from distributed_crawler_tpu.state import PendingEdgeUpdate
+        sm.update_pending_edge(PendingEdgeUpdate(
+            pending_id=claimed[0].pending_id, validation_status="valid"))
+        sm.close_pending_batch("bx")
+        assert walkback_step(sm, cfg(walkback_rate=0), rng=random.Random(0))
+        rows = sm.graph.binding.query(
+            "SELECT crawl_id, url FROM page_buffer")
+        assert rows == [("other_crawl", "somewhere_chan")]
+
+    def test_no_ready_batch_returns_false(self, tmp_path):
+        sm = make_sm(tmp_path)
+        assert not walkback_step(sm, cfg())
+
+    def test_crash_between_complete_and_flush_leaves_orphans_only(self, tmp_path):
+        sm = make_sm(tmp_path)
+        self._prepare_batch(sm, ["valid"])
+
+        real_flush = sm.flush_batch_stats
+        def crashing_flush(*a, **kw):
+            raise RuntimeError("crash before flush")
+        sm.flush_batch_stats = crashing_flush
+        # Must not raise: complete already happened; flush failure is logged.
+        assert walkback_step(sm, cfg(walkback_rate=0), rng=random.Random(0))
+        sm.flush_batch_stats = real_flush
+        # Batch completed; leftover edges are orphans swept at startup.
+        assert sm.count_incomplete_batches("c1") == 0
+        assert sm.recover_orphan_edges() == 1
