@@ -1,0 +1,91 @@
+"""Benchmark: fused embed+classify throughput (posts/sec) on real hardware.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The metric is the BASELINE.md north star — posts/sec through the fused
+multilingual-E5-small-class encoder (embed + classify in a single encoder
+pass, batch=256, seq=128, bf16).  ``vs_baseline`` is measured against the
+reference's de-facto crawl ceiling of 3 000 msgs/min/connection = 50
+posts/sec (BASELINE.md "Implied crawl ceiling"): the reference can only
+*fetch* at 50/s/conn, so every multiple here is headroom the TPU stage has
+over the crawl side it serves.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+# Reference ceiling: 3000 msgs/min/connection (BASELINE.md) -> 50 posts/sec.
+REFERENCE_POSTS_PER_SEC = 50.0
+
+BATCH = 256
+SEQ = 128
+# Two-point fit: total(N) = overhead + N * t_iter, so t_iter comes from the
+# difference and the RPC/readback overhead cancels.  Iterations are chained
+# through a data dependency (next ids derived from the previous output) and
+# closed with a host readback — plain block_until_ready can return early
+# through remote-execution relays, which would overstate throughput ~100x.
+N_SHORT = 5
+N_LONG = 25
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from dataclasses import replace
+
+    from distributed_crawler_tpu.models import E5_SMALL
+    from distributed_crawler_tpu.models.encoder import EmbedderClassifier
+
+    cfg = replace(E5_SMALL, n_labels=8)
+    model = EmbedderClassifier(cfg)
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(BATCH, SEQ)),
+                      jnp.int32)
+    mask = jnp.ones((BATCH, SEQ), jnp.bool_)
+    params = model.init(jax.random.PRNGKey(0), ids, mask)
+
+    n_dev = len(jax.devices())
+    if n_dev > 1:
+        from distributed_crawler_tpu.parallel import (
+            best_mesh_config, make_mesh, shard_batch, shard_params,
+        )
+
+        mesh = make_mesh(best_mesh_config(n_dev))
+        params = shard_params(params, mesh)
+        placed = shard_batch({"ids": ids, "mask": mask}, mesh)
+        ids, mask = placed["ids"], placed["mask"]
+
+    @jax.jit
+    def chained(p, ids, mask, n):
+        def body(_, ids):
+            emb, _logits = model.apply(p, ids, mask)
+            delta = (emb[:, :1] * 1000).astype(jnp.int32) % cfg.vocab_size
+            return (ids + delta) % cfg.vocab_size
+        return jax.lax.fori_loop(0, n, body, ids)
+
+    float(chained(params, ids, mask, 1).sum())  # warmup + compile
+
+    def timed(n: int) -> float:
+        t0 = time.perf_counter()
+        float(chained(params, ids, mask, n).sum())
+        return time.perf_counter() - t0
+
+    t_short = min(timed(N_SHORT) for _ in range(3))
+    t_long = min(timed(N_LONG) for _ in range(3))
+    t_iter = (t_long - t_short) / (N_LONG - N_SHORT)
+    posts_per_sec = BATCH / t_iter
+    print(json.dumps({
+        "metric": "embed_classify_posts_per_sec",
+        "value": round(posts_per_sec, 1),
+        "unit": "posts/sec",
+        "vs_baseline": round(posts_per_sec / REFERENCE_POSTS_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

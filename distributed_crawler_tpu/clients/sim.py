@@ -68,10 +68,19 @@ class SimNetwork:
     def add_channel(self, username: str, messages: Optional[List[TLMessage]] = None,
                     **kw) -> SimChannel:
         with self._lock:
-            chat_id = kw.pop("chat_id", None) or self._next_chat_id
-            self._next_chat_id += 1
-            supergroup_id = kw.pop("supergroup_id", None) or self._next_supergroup_id
-            self._next_supergroup_id += 1
+            chat_id = kw.pop("chat_id", None)
+            if chat_id is None:
+                while self._next_chat_id in self.by_chat_id:
+                    self._next_chat_id += 1
+                chat_id = self._next_chat_id
+                self._next_chat_id += 1
+            supergroup_id = kw.pop("supergroup_id", None)
+            if supergroup_id is None:
+                used = {c.supergroup_id for c in self.channels.values()}
+                while self._next_supergroup_id in used:
+                    self._next_supergroup_id += 1
+                supergroup_id = self._next_supergroup_id
+                self._next_supergroup_id += 1
             ch = SimChannel(username=username.lower(), chat_id=chat_id,
                             title=kw.pop("title", username),
                             supergroup_id=supergroup_id, **kw)

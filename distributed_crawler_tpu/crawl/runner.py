@@ -337,21 +337,20 @@ def process_all_messages(client: TelegramClient, info: ChannelInfo,
         for m in messages
     ]
     owner.messages = add_new_messages(discovered_messages, owner)
+    pre_deleted = sum(1 for m in owner.messages if m.status == "deleted")
     owner.messages = resample_marker(owner.messages, discovered_messages)
+    deleted = sum(1 for m in owner.messages if m.status == "deleted") - pre_deleted
     sm.update_page(owner)
 
     by_id = {m.id: m for m in messages}
-    fetched = deleted = processed = failed = 0
+    fetched = processed = failed = 0
 
     for message in list(owner.messages):
         if message.status in ("fetched", "deleted"):
             continue
-        disc = by_id.get(message.message_id)
-        if disc is None:
-            sm.update_message(owner.id, message.chat_id, message.message_id,
-                              "deleted")
-            deleted += 1
-            continue
+        # Every surviving message is in the discovered set: resample_marker
+        # just deleted the rest, and add_new_messages only adds discovered.
+        disc = by_id[message.message_id]
         processed += 1
         try:
             outlinks = processor.process_message(

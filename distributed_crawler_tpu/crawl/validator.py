@@ -115,11 +115,15 @@ def validate_single_edge(sm, cfg: CrawlerConfig,
         "source_type": edge.source_type})
 
     if result.status == "valid":
-        claimed = False
         try:
             claimed = sm.claim_discovered_channel(channel, edge.crawl_id)
         except Exception as e:
+            # Transient store failure: leave the edge pending for re-claim
+            # rather than finalizing a valid channel as a duplicate.
             logger.warning("claim_discovered_channel failed: %s", e)
+            return PendingEdgeUpdate(pending_id=edge.pending_id,
+                                     validation_status="pending"), \
+                OUTCOME_TRANSIENT
         if not claimed:
             return PendingEdgeUpdate(pending_id=edge.pending_id,
                                      validation_status="duplicate"), \

@@ -1,0 +1,35 @@
+"""Model families for the TPU inference stage (BASELINE.md configs 2-5).
+
+All models are Flax modules with bf16 compute and f32 params, named so the
+sharding rules in `parallel.sharding` match their parameter paths:
+
+- :mod:`encoder` — BERT/XLM-R-family text encoder: multilingual-E5
+  (small/base/large) embedders and XLM-R classifiers, optional MoE MLP for
+  expert parallelism.
+- :mod:`whisper` — Whisper-small encoder-decoder ASR for Telegram voice/video
+  media (BASELINE config #4).
+- :mod:`train` — training/fine-tune step (optax) used by the multi-chip
+  dry-run and classifier fine-tuning.
+"""
+
+from .encoder import (
+    Classifier,
+    Embedder,
+    EncoderConfig,
+    E5_SMALL,
+    E5_BASE,
+    E5_LARGE,
+    XLMR_BASE,
+    TINY_TEST,
+)
+
+__all__ = [
+    "EncoderConfig",
+    "Embedder",
+    "Classifier",
+    "E5_SMALL",
+    "E5_BASE",
+    "E5_LARGE",
+    "XLMR_BASE",
+    "TINY_TEST",
+]

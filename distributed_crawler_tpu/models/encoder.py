@@ -1,0 +1,240 @@
+"""Transformer text encoder: the E5/XLM-R family, TPU-first.
+
+Architecture is the standard BERT/RoBERTa encoder (the reference crawls text;
+BASELINE.md grafts multilingual-E5 embedding + XLM-R classification onto the
+crawl stream).  TPU-first choices:
+
+- bf16 activations / f32 params: matmuls hit the MXU at full rate, layernorm
+  and softmax accumulate in f32;
+- post-LN like BERT, but residual adds in f32 to keep 24-layer (E5-large)
+  numerics stable in bf16;
+- attention via `ops.mha`: XLA-fused below 1k tokens, Pallas flash above;
+- no dynamic shapes anywhere — padding masks, not ragged lengths;
+- optional mixture-of-experts MLP (top-1 switch routing) whose expert dim the
+  sharding rules place on the tp axis (expert parallelism);
+- parameter names (q/k/v/attn_out/mlp_up/mlp_down/embed) are the contract
+  with `parallel.sharding.ENCODER_PARAM_RULES`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import mha
+
+
+@dataclass(frozen=True)
+class EncoderConfig:
+    vocab_size: int = 250002          # XLM-R sentencepiece vocab
+    hidden: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 512
+    n_labels: int = 2                 # classifier head width
+    n_experts: int = 0                # 0 = dense MLP; >0 = switch MoE
+    dropout: float = 0.0              # inference-first; training may override
+    layer_norm_eps: float = 1e-5
+    dtype: str = "bfloat16"           # activation dtype
+    attention: str = "auto"           # auto | xla | flash
+    remat: bool = False               # jax.checkpoint each layer (training)
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.n_heads
+
+    @property
+    def adtype(self):
+        return jnp.dtype(self.dtype)
+
+    def validate(self) -> None:
+        if self.hidden % self.n_heads != 0:
+            raise ValueError(
+                f"hidden {self.hidden} not divisible by heads {self.n_heads}")
+
+
+# Published configs (sizes match the HF checkpoints these mirror).
+E5_SMALL = EncoderConfig(vocab_size=250037, hidden=384, n_layers=12,
+                         n_heads=12, mlp_dim=1536)
+E5_BASE = EncoderConfig(vocab_size=250037, hidden=768, n_layers=12,
+                        n_heads=12, mlp_dim=3072)
+E5_LARGE = EncoderConfig(vocab_size=250037, hidden=1024, n_layers=24,
+                         n_heads=16, mlp_dim=4096)
+XLMR_BASE = EncoderConfig(vocab_size=250002, hidden=768, n_layers=12,
+                          n_heads=12, mlp_dim=3072)
+# Tiny config for tests: runs on the 8-device CPU mesh in milliseconds.
+TINY_TEST = EncoderConfig(vocab_size=1024, hidden=64, n_layers=2, n_heads=4,
+                          mlp_dim=128, max_len=128, dtype="float32")
+
+
+class SelfAttention(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.cfg
+        b, l, _ = x.shape
+        dense = lambda name, feats: nn.Dense(
+            feats, dtype=cfg.adtype, param_dtype=jnp.float32, name=name)
+        q = dense("q", cfg.hidden)(x).reshape(b, l, cfg.n_heads, cfg.head_dim)
+        k = dense("k", cfg.hidden)(x).reshape(b, l, cfg.n_heads, cfg.head_dim)
+        v = dense("v", cfg.hidden)(x).reshape(b, l, cfg.n_heads, cfg.head_dim)
+        use_flash = {"auto": None, "xla": False, "flash": True}[cfg.attention]
+        o = mha(q, k, v, kv_mask=mask, use_flash=use_flash)
+        o = o.reshape(b, l, cfg.hidden)
+        return dense("attn_out", cfg.hidden)(o)
+
+
+class DenseMLP(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.Dense(cfg.mlp_dim, dtype=cfg.adtype, param_dtype=jnp.float32,
+                     name="mlp_up")(x)
+        h = nn.gelu(h, approximate=True)
+        return nn.Dense(cfg.hidden, dtype=cfg.adtype, param_dtype=jnp.float32,
+                        name="mlp_down")(h)
+
+
+class SwitchMoE(nn.Module):
+    """Top-1 switch MLP. Dispatch is dense one-hot einsum — exact, static
+    shapes, and XLA shards the expert dim over tp per the param rules; at
+    inference scale that beats gather/scatter routing on TPU."""
+
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        e, h, m = cfg.n_experts, cfg.hidden, cfg.mlp_dim
+        gate = nn.Dense(e, dtype=jnp.float32, param_dtype=jnp.float32,
+                        name="router")(x.astype(jnp.float32))
+        probs = jax.nn.softmax(gate, axis=-1)           # [B, L, E]
+        top = jnp.argmax(probs, axis=-1)                # [B, L]
+        onehot = jax.nn.one_hot(top, e, dtype=cfg.adtype)
+        w_up = self.param("experts_up/kernel", nn.initializers.lecun_normal(),
+                          (e, h, m), jnp.float32)
+        w_dn = self.param("experts_down/kernel", nn.initializers.lecun_normal(),
+                          (e, m, h), jnp.float32)
+        hid = jnp.einsum("blh,ehm->blem", x, w_up.astype(cfg.adtype))
+        hid = nn.gelu(hid, approximate=True)
+        out = jnp.einsum("blem,emh->bleh", hid, w_dn.astype(cfg.adtype))
+        out = jnp.einsum("bleh,ble->blh", out, onehot)
+        # Scale by the (f32) router prob of the chosen expert so the router
+        # receives gradient during fine-tuning.
+        chosen = jnp.sum(probs * jax.nn.one_hot(top, e), axis=-1)
+        return out * chosen[..., None].astype(cfg.adtype)
+
+
+class EncoderLayer(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.cfg
+        ln = lambda name: nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+            param_dtype=jnp.float32, name=name)
+        a = SelfAttention(cfg, name="attn")(x, mask)
+        x = ln("ln_attn")(x.astype(jnp.float32)
+                          + a.astype(jnp.float32)).astype(cfg.adtype)
+        mlp = (SwitchMoE(cfg, name="moe") if cfg.n_experts
+               else DenseMLP(cfg, name="mlp"))
+        m = mlp(x)
+        x = ln("ln_mlp")(x.astype(jnp.float32)
+                         + m.astype(jnp.float32)).astype(cfg.adtype)
+        return x
+
+
+class Encoder(nn.Module):
+    """ids [B, L] int32, mask [B, L] bool -> hidden [B, L, H] (cfg dtype)."""
+
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, ids, mask):
+        cfg = self.cfg
+        cfg.validate()
+        emb = self.param("embed_tokens", nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.hidden), jnp.float32)
+        pos = self.param("embed_positions", nn.initializers.normal(0.02),
+                         (cfg.max_len, cfg.hidden), jnp.float32)
+        l = ids.shape[1]
+        x = emb[ids] + pos[:l][None, :, :]
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         param_dtype=jnp.float32, name="ln_embed")(x)
+        x = x.astype(cfg.adtype)
+        layer_cls = EncoderLayer
+        if cfg.remat:
+            layer_cls = nn.remat(EncoderLayer, static_argnums=())
+        for i in range(cfg.n_layers):
+            x = layer_cls(cfg, name=f"layers_{i}")(x, mask)
+        return x
+
+
+def mean_pool(hidden: jax.Array, mask: jax.Array) -> jax.Array:
+    """Masked mean over seq (E5 pooling), f32 accumulation."""
+    m = mask[..., None].astype(jnp.float32)
+    summed = jnp.sum(hidden.astype(jnp.float32) * m, axis=1)
+    count = jnp.maximum(jnp.sum(m, axis=1), 1.0)
+    return summed / count
+
+
+class Embedder(nn.Module):
+    """E5-style sentence embedder: encoder -> masked mean -> L2 normalize.
+    Returns f32 [B, H] unit vectors."""
+
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, ids, mask):
+        hidden = Encoder(self.cfg, name="encoder")(ids, mask)
+        pooled = mean_pool(hidden, mask)
+        norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+        return pooled / jnp.maximum(norm, 1e-12)
+
+
+class Classifier(nn.Module):
+    """XLM-R-style classifier: encoder -> first-token pool -> tanh dense ->
+    logits f32 [B, n_labels]."""
+
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, ids, mask):
+        cfg = self.cfg
+        hidden = Encoder(cfg, name="encoder")(ids, mask)
+        cls = hidden[:, 0, :].astype(jnp.float32)
+        pooled = jnp.tanh(nn.Dense(cfg.hidden, dtype=jnp.float32,
+                                   param_dtype=jnp.float32,
+                                   name="pooler")(cls))
+        return nn.Dense(cfg.n_labels, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="head")(pooled)
+
+
+class EmbedderClassifier(nn.Module):
+    """Fused single-pass embed+classify — the BASELINE headline op runs one
+    encoder, not two, when both outputs are wanted on the same text."""
+
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, ids, mask):
+        cfg = self.cfg
+        hidden = Encoder(cfg, name="encoder")(ids, mask)
+        pooled = mean_pool(hidden, mask)
+        emb = pooled / jnp.maximum(
+            jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12)
+        cls = hidden[:, 0, :].astype(jnp.float32)
+        p = jnp.tanh(nn.Dense(cfg.hidden, dtype=jnp.float32,
+                              param_dtype=jnp.float32, name="pooler")(cls))
+        logits = nn.Dense(cfg.n_labels, dtype=jnp.float32,
+                          param_dtype=jnp.float32, name="head")(p)
+        return emb, logits

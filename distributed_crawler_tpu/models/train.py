@@ -1,0 +1,81 @@
+"""Training step: classifier fine-tuning on the crawl stream.
+
+The reference has no training at all — this is the ⟨NEW⟩ surface (SURVEY.md
+§7.6) that makes the TPU build a framework rather than a port.  Everything is
+a pure function over (params, opt_state, batch) jitted once over the mesh:
+data parallelism over dp, tensor/expert over tp, sequence over sp, with XLA
+inserting the gradient all-reduces (no hand-written psum — the sharded params
+make XLA emit reduce-scatter/all-gather as needed).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .encoder import Classifier, EncoderConfig
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    learning_rate: float = 2e-5
+    weight_decay: float = 0.01
+    max_grad_norm: float = 1.0
+    warmup_steps: int = 100
+    label_smoothing: float = 0.0
+
+
+def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
+    schedule = optax.linear_schedule(0.0, tc.learning_rate, tc.warmup_steps)
+    return optax.chain(
+        optax.clip_by_global_norm(tc.max_grad_norm),
+        optax.adamw(schedule, weight_decay=tc.weight_decay),
+    )
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array,
+                  smoothing: float = 0.0) -> jax.Array:
+    n = logits.shape[-1]
+    onehot = jax.nn.one_hot(labels, n)
+    if smoothing:
+        onehot = onehot * (1.0 - smoothing) + smoothing / n
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+
+
+def make_train_step(cfg: EncoderConfig, tc: TrainConfig = TrainConfig()
+                    ) -> Tuple[Callable, Callable, optax.GradientTransformation]:
+    """Returns (init_fn, step_fn, optimizer).
+
+    init_fn(rng, ids, mask) -> (params, opt_state)
+    step_fn(params, opt_state, ids, mask, labels) -> (params, opt_state, metrics)
+
+    step_fn is pure and jit-ready; callers jit it with the mesh shardings
+    from `parallel.sharding` (see __graft_entry__.dryrun_multichip).
+    """
+    model = Classifier(cfg)
+    optimizer = make_optimizer(tc)
+
+    def init_fn(rng, ids, mask):
+        params = model.init(rng, ids, mask)["params"]
+        return params, optimizer.init(params)
+
+    def loss_fn(params, ids, mask, labels):
+        logits = model.apply({"params": params}, ids, mask)
+        loss = cross_entropy(logits, labels, tc.label_smoothing)
+        acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        return loss, acc
+
+    def step_fn(params, opt_state, ids, mask, labels):
+        (loss, acc), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, ids, mask, labels)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"loss": loss, "accuracy": acc}
+
+    return init_fn, step_fn, optimizer

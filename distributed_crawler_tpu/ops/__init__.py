@@ -1,0 +1,21 @@
+"""Compute ops for the TPU inference path.
+
+XLA-first: every op has a plain jax.numpy implementation that XLA fuses and
+tiles onto the MXU; Pallas kernels are provided only where hand control over
+VMEM tiling wins (flash attention at long sequence length) and are selected
+at trace time by backend + shape heuristics, never required for correctness —
+the CPU test mesh always runs the XLA path.
+"""
+
+from .attention import attend, flash_attention, mha
+from .padding import BucketSpec, bucket_for, pad_to_bucket, pack_batch
+
+__all__ = [
+    "attend",
+    "mha",
+    "flash_attention",
+    "BucketSpec",
+    "bucket_for",
+    "pad_to_bucket",
+    "pack_batch",
+]

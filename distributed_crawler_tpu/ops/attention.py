@@ -1,0 +1,117 @@
+"""Attention ops: fused XLA path + a Pallas flash kernel for long sequences.
+
+Layout convention throughout the framework: [batch, seq, heads, head_dim]
+("BLHD") for q/k/v, [batch, seq] boolean padding masks (True = real token).
+Scores/softmax accumulate in float32 whatever the input dtype; outputs match
+the input dtype (bf16 on TPU so the matmuls hit the MXU at full rate).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+# Pallas is worth it only past this sequence length; below it XLA's fused
+# attention is already VMEM-resident and the kernel adds nothing.
+FLASH_MIN_SEQ = 1024
+_FLASH_BLOCK_Q = 256
+
+
+def attend(q: jax.Array, k: jax.Array, v: jax.Array,
+           kv_mask: Optional[jax.Array] = None,
+           scale: Optional[float] = None) -> jax.Array:
+    """Reference bidirectional attention, BLHD in/out. XLA fuses this into
+    two MXU matmuls + a VPU softmax; it is the default for encoder lengths."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def _flash_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, *, scale):
+    """One (batch*head, q-block) program: q block vs the full kv sequence.
+
+    Block over q only: scores are [block_q, L] f32 in VMEM (1 MB at L=2k),
+    small enough that blocking kv as well would only add loop overhead; truly
+    long sequences go through ring attention over sp instead.
+    """
+    q = q_ref[0].astype(jnp.float32)   # [block_q, D]
+    k = k_ref[0].astype(jnp.float32)   # [L, D]
+    v = v_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    mask = mask_ref[:]  # [1, L] bool, broadcasts over q rows
+    s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    o_ref[0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "block_q", "interpret"))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    kv_mask: Optional[jax.Array] = None,
+                    scale: Optional[float] = None,
+                    block_q: int = _FLASH_BLOCK_Q,
+                    interpret: bool = False) -> jax.Array:
+    """Pallas flash attention, BLHD in/out, grid (batch*heads, q-blocks)."""
+    from jax.experimental import pallas as pl
+
+    b, l, h, d = q.shape
+    scale = scale if scale is not None else d ** -0.5
+    if kv_mask is None:
+        kv_mask = jnp.ones((b, l), dtype=bool)
+    block_q = min(block_q, l)
+    if l % block_q != 0:
+        raise ValueError(f"seq len {l} not divisible by block_q {block_q}")
+
+    # BLHD -> (B*H, L, D) so the grid is flat over batch*heads.
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, l, d)
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    grid = (b * h, l // block_q)
+
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, scale=scale),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, l), lambda i, j: (i // h, 0)),       # mask [B, L]
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),  # q
+            pl.BlockSpec((1, l, d), lambda i, j: (i, 0, 0)),        # k
+            pl.BlockSpec((1, l, d), lambda i, j: (i, 0, 0)),        # v
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, l, d), q.dtype),
+        interpret=interpret,
+    )(kv_mask, qb, kb, vb)
+    return out.reshape(b, h, l, d).transpose(0, 2, 1, 3)
+
+
+def mha(q: jax.Array, k: jax.Array, v: jax.Array,
+        kv_mask: Optional[jax.Array] = None,
+        scale: Optional[float] = None,
+        use_flash: Optional[bool] = None) -> jax.Array:
+    """Dispatch: Pallas flash on TPU past FLASH_MIN_SEQ, XLA otherwise."""
+    if use_flash is None:
+        use_flash = (q.shape[1] >= FLASH_MIN_SEQ
+                     and jax.default_backend() == "tpu")
+    if use_flash:
+        return flash_attention(q, k, v, kv_mask, scale)
+    return attend(q, k, v, kv_mask, scale)

@@ -1,0 +1,90 @@
+"""Bucketed padding: turn ragged crawl text into fixed-shape device batches.
+
+XLA compiles one program per distinct input shape, so the feed must quantize
+sequence lengths into a small set of buckets — each bucket compiles once
+(20-40 s cold) and is cached thereafter.  This is the TPU analog of the
+reference's fixed 100-message history pages (`telegramutils.go:49`): a fixed
+unit of work that keeps the pipeline's shapes static.
+
+Buckets default to powers of two from 32 to 512; MXU tiling wants the last
+dim >= 128 only for the hidden dims, but sequence lengths that are multiples
+of 8 (f32) / 16 (bf16) sublanes avoid relayout, hence the power-of-two grid.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+DEFAULT_BUCKETS = (32, 64, 128, 256, 512)
+
+
+@dataclass(frozen=True)
+class BucketSpec:
+    lengths: Tuple[int, ...] = DEFAULT_BUCKETS
+
+    def __post_init__(self):
+        if not self.lengths:
+            raise ValueError("at least one bucket length required")
+        if list(self.lengths) != sorted(set(self.lengths)):
+            raise ValueError(f"bucket lengths must be strictly increasing: {self.lengths}")
+
+    @property
+    def max_len(self) -> int:
+        return self.lengths[-1]
+
+
+def bucket_for(length: int, spec: BucketSpec = BucketSpec()) -> int:
+    """Smallest bucket that fits ``length``; over-long inputs truncate to max."""
+    for b in spec.lengths:
+        if length <= b:
+            return b
+    return spec.max_len
+
+
+def pad_to_bucket(ids: Sequence[int], bucket: int,
+                  pad_id: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """One sequence -> (ids[bucket] int32, mask[bucket] bool)."""
+    arr = np.full(bucket, pad_id, dtype=np.int32)
+    mask = np.zeros(bucket, dtype=bool)
+    n = min(len(ids), bucket)
+    arr[:n] = np.asarray(ids[:n], dtype=np.int32)
+    mask[:n] = True
+    return arr, mask
+
+
+def pack_batch(sequences: Sequence[Sequence[int]],
+               spec: BucketSpec = BucketSpec(),
+               pad_id: int = 0,
+               batch_pad_to: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Many sequences -> one (ids [B, L], mask [B, L]) pair.
+
+    The bucket is chosen by the longest sequence in the batch; if
+    ``batch_pad_to`` > 0 the batch dim is padded up with all-padding rows so
+    the batch shape is static too (partial final batches reuse the compiled
+    program instead of triggering a recompile).
+    """
+    if not sequences:
+        raise ValueError("pack_batch requires at least one sequence")
+    bucket = bucket_for(max(len(s) for s in sequences), spec)
+    rows = [pad_to_bucket(s, bucket, pad_id) for s in sequences]
+    ids = np.stack([r[0] for r in rows])
+    mask = np.stack([r[1] for r in rows])
+    if batch_pad_to and len(sequences) < batch_pad_to:
+        pad_rows = batch_pad_to - len(sequences)
+        ids = np.concatenate(
+            [ids, np.full((pad_rows, bucket), pad_id, dtype=np.int32)])
+        mask = np.concatenate([mask, np.zeros((pad_rows, bucket), dtype=bool)])
+    return ids, mask
+
+
+def group_by_bucket(sequences: Sequence[Sequence[int]],
+                    spec: BucketSpec = BucketSpec()) -> Dict[int, List[int]]:
+    """Indices of ``sequences`` grouped by their bucket — lets the feed batch
+    same-bucket records together to minimize padding waste."""
+    groups: Dict[int, List[int]] = {}
+    for i, s in enumerate(sequences):
+        groups.setdefault(bucket_for(len(s), spec), []).append(i)
+    return groups

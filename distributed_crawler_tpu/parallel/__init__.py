@@ -1,0 +1,38 @@
+"""Device-mesh parallelism for the TPU inference/training stack.
+
+The reference crawler's parallelism is task-level (SURVEY.md §2.3 — goroutine
+pools, Dapr pubsub fan-out); it has no tensor parallelism.  The TPU-native
+build introduces the missing dimension: SPMD over a `jax.sharding.Mesh` with
+named axes
+
+    dp — data parallel (batch dim; the analog of the reference's worker pool)
+    sp — sequence parallel (long-context ring attention over ICI)
+    tp — tensor parallel (weight sharding; XLA inserts the collectives)
+
+plus expert parallelism (`ep`, aliased onto `tp`) for MoE layers.  Everything
+here is mesh-shape agnostic: tests run on a virtual 8-device CPU mesh
+(tests/conftest.py) and the same code paths compile for v5e slices.
+"""
+
+from .mesh import MeshConfig, make_mesh, best_mesh_config, local_mesh
+from .sharding import (
+    batch_sharding,
+    named_sharding,
+    param_specs,
+    shard_batch,
+    shard_params,
+)
+from .ring import ring_attention
+
+__all__ = [
+    "MeshConfig",
+    "make_mesh",
+    "best_mesh_config",
+    "local_mesh",
+    "named_sharding",
+    "batch_sharding",
+    "param_specs",
+    "shard_batch",
+    "shard_params",
+    "ring_attention",
+]

@@ -1,0 +1,98 @@
+"""Mesh construction: map physical devices onto named parallelism axes.
+
+Analog in the reference: the semaphore-bounded worker pools that decide "how
+many pages in flight" (`dapr/standalone.go:432,507-620`).  Here the same
+decision — how much hardware each kind of parallelism gets — is made once, up
+front, as a mesh shape, and XLA lays collectives over ICI accordingly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+AXIS_DP = "dp"
+AXIS_SP = "sp"
+AXIS_TP = "tp"
+
+MESH_AXES = (AXIS_DP, AXIS_SP, AXIS_TP)
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Shape of the device mesh over the (dp, sp, tp) axes.
+
+    ``dp * sp * tp`` must equal the number of devices handed to
+    :func:`make_mesh`.  A dimension of 1 disables that axis (no collectives
+    are emitted for size-1 axes, so a pure data-parallel config costs nothing
+    extra).
+    """
+
+    dp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.sp * self.tp
+
+    def validate(self) -> None:
+        for name, v in (("dp", self.dp), ("sp", self.sp), ("tp", self.tp)):
+            if v < 1:
+                raise ValueError(f"mesh axis {name} must be >= 1, got {v}")
+
+    def axis_names(self) -> Sequence[str]:
+        return MESH_AXES
+
+
+def best_mesh_config(n_devices: int, *, tp: int = 1, sp: int = 1) -> MeshConfig:
+    """Pick a mesh shape: fix tp/sp as requested, give the rest to dp.
+
+    Data parallelism is the default sink for devices because inference over a
+    crawl stream is embarrassingly batch-parallel (the TPU analog of the
+    reference's page-level worker pool).
+    """
+    if n_devices < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+    if n_devices % (tp * sp) != 0:
+        raise ValueError(
+            f"n_devices={n_devices} not divisible by tp*sp={tp * sp}"
+        )
+    cfg = MeshConfig(dp=n_devices // (tp * sp), sp=sp, tp=tp)
+    cfg.validate()
+    return cfg
+
+
+def make_mesh(config: Optional[MeshConfig] = None,
+              devices: Optional[List] = None):
+    """Build a `jax.sharding.Mesh` with axes (dp, sp, tp).
+
+    ``devices`` defaults to `jax.devices()`; the device list is reshaped in
+    order, which on TPU slices keeps tp (the innermost axis, most
+    communication-heavy) on physically adjacent chips so its collectives ride
+    the shortest ICI hops.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if config is None:
+        config = best_mesh_config(len(devices))
+    config.validate()
+    if config.n_devices != len(devices):
+        raise ValueError(
+            f"mesh config needs {config.n_devices} devices, have {len(devices)}"
+        )
+    grid = np.asarray(devices, dtype=object).reshape(
+        config.dp, config.sp, config.tp)
+    return Mesh(grid, MESH_AXES)
+
+
+def local_mesh():
+    """Single-device mesh (all axes size 1) — the standalone-mode analog."""
+    import jax
+
+    return make_mesh(MeshConfig(1, 1, 1), devices=jax.devices()[:1])

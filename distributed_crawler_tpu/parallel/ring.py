@@ -1,0 +1,124 @@
+"""Ring attention: sequence-parallel attention over the `sp` mesh axis.
+
+The reference has no long-context machinery (SURVEY.md §5.7) — its closest
+analog is paged streaming of unbounded chat history in fixed windows
+(`telegramhelper/telegramutils.go:42-118`).  Here the same idea is applied to
+the sequence dimension on-device: each sp shard holds a block of queries and
+rotates key/value blocks around the ring with `lax.ppermute` (one ICI hop per
+step), combining partial attention with an online softmax so the full
+[L, L] score matrix never materializes.
+
+Two entry points:
+  - :func:`ring_attention` — collective form, call inside `shard_map` with the
+    sp axis bound.
+  - :func:`make_ring_attention` — wraps it in `shard_map` over a given mesh and
+    returns a jittable [B, L, H, D] -> [B, L, H, D] function.
+
+All softmax accumulation is float32 regardless of input dtype (bf16-safe).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .mesh import AXIS_DP, AXIS_SP, AXIS_TP
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, kv_mask, scale):
+    """Scores + running-softmax stats for one (q-block, kv-block) pair.
+
+    q: [B, Lq, H, D]; k, v: [B, Lk, H, D]; kv_mask: [B, Lk] bool or None.
+    Returns (o, m, l): unnormalized output [B, Lq, H, D], row max [B, H, Lq],
+    row sum [B, H, Lq] — all float32.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :], s, _NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    if kv_mask is not None:
+        p = jnp.where(kv_mask[:, None, None, :], p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
+    return o, m, l
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   kv_mask: Optional[jax.Array] = None,
+                   axis_name: str = AXIS_SP,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Bidirectional ring attention; call inside shard_map with ``axis_name``.
+
+    Shapes are per-shard: q/k/v [B, L_local, H, D], kv_mask [B, L_local].
+    The kv block (and its mask) rotates around the ring; the online-softmax
+    carry (o, m, l) stays local.  ``axis_size`` steps, one ppermute each.
+    """
+    axis_size = jax.lax.axis_size(axis_name)
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+
+    o, m, l = _block_attend(q, k, v, kv_mask, scale)
+
+    def rotate(x):
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        return jax.lax.ppermute(x, axis_name, perm)
+
+    for _ in range(axis_size - 1):
+        k = rotate(k)
+        v = rotate(v)
+        if kv_mask is not None:
+            kv_mask = rotate(kv_mask)
+        o2, m2, l2 = _block_attend(q, k, v, kv_mask, scale)
+        m_new = jnp.maximum(m, m2)
+        a1 = jnp.exp(m - m_new)
+        a2 = jnp.exp(m2 - m_new)
+        o = o * _bhq_to_bqh1(a1) + o2 * _bhq_to_bqh1(a2)
+        l = l * a1 + l2 * a2
+        m = m_new
+
+    # Fully-masked rows (all-padding queries) have l == 0; emit zeros.
+    denom = jnp.maximum(l, 1e-30)
+    out = o / _bhq_to_bqh1(denom)
+    return out.astype(q.dtype)
+
+
+def _bhq_to_bqh1(x: jax.Array) -> jax.Array:
+    """[B, H, Lq] -> [B, Lq, H, 1] broadcastable against [B, Lq, H, D]."""
+    return jnp.transpose(x, (0, 2, 1))[..., None]
+
+
+def make_ring_attention(mesh, scale: Optional[float] = None):
+    """shard_map-wrapped ring attention over ``mesh``'s sp axis.
+
+    Returns f(q, k, v, kv_mask) on global shapes [B, L, H, D] / [B, L] with
+    batch over dp and sequence over sp; heads stay tp-sharded if the caller
+    sharded them (head dim spec is None -> inherited replication; attention
+    is head-wise independent so tp sharding of H composes transparently via
+    an outer jit).
+    """
+    try:
+        from jax import shard_map
+        _check_kw = {"check_vma": False}
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+        _check_kw = {"check_rep": False}
+
+    qkv_spec = P(AXIS_DP, AXIS_SP, AXIS_TP, None)
+    mask_spec = P(AXIS_DP, AXIS_SP)
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+             out_specs=qkv_spec, **_check_kw)
+    def _ring(q, k, v, kv_mask):
+        return ring_attention(q, k, v, kv_mask, axis_name=AXIS_SP, scale=scale)
+
+    return _ring

@@ -1,0 +1,134 @@
+"""Sharding rules: map parameter/activation pytrees onto the mesh.
+
+Design follows the scaling-book recipe: annotate shardings on the pytree,
+`jax.jit` the step, and let XLA insert the collectives.  No hand-written
+all-reduces on the forward path — the only explicit collectives in this
+package live in :mod:`.ring` (sequence-parallel attention), where XLA cannot
+infer the ring schedule.
+
+Tensor-parallel layout (Megatron-style, one all-reduce per block):
+  - attention q/k/v projections: column-sharded over heads  -> tp
+  - attention output projection: row-sharded                -> tp on input dim
+  - MLP up projection: column-sharded                       -> tp
+  - MLP down projection: row-sharded                        -> tp on input dim
+  - embeddings / layernorms / biases of row-sharded layers: replicated
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import AXIS_DP, AXIS_SP, AXIS_TP
+
+# Ordered (path-regex, spec) rules. First match wins. Paths are
+# '/'-joined pytree key paths, e.g. 'encoder/layers_3/attn/q/kernel'.
+ParamRule = Tuple[str, P]
+
+# Column-parallel: output dim sharded. Row-parallel: input dim sharded.
+ENCODER_PARAM_RULES: List[ParamRule] = [
+    (r".*/(q|k|v)/kernel$", P(None, AXIS_TP)),
+    (r".*/(q|k|v)/bias$", P(AXIS_TP)),
+    (r".*/attn_out/kernel$", P(AXIS_TP, None)),
+    (r".*/attn_out/bias$", P()),
+    (r".*/mlp_up/kernel$", P(None, AXIS_TP)),
+    (r".*/mlp_up/bias$", P(AXIS_TP)),
+    (r".*/mlp_down/kernel$", P(AXIS_TP, None)),
+    (r".*/mlp_down/bias$", P()),
+    # MoE experts: expert dim sharded over tp (expert parallelism rides the
+    # same axis; a dedicated 'ep' axis would be overkill at inference scale).
+    (r".*/experts_up/kernel$", P(AXIS_TP, None, None)),
+    (r".*/experts_down/kernel$", P(AXIS_TP, None, None)),
+    (r".*/embed.*", P()),
+    (r".*", P()),  # default: replicate (layernorms, heads, scalars)
+]
+
+
+def path_str(key_path: Sequence[Any]) -> str:
+    parts = []
+    for k in key_path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def spec_for_path(path: str, rules: Sequence[ParamRule]) -> P:
+    for pattern, spec in rules:
+        if re.match(pattern, path):
+            return spec
+    return P()
+
+
+def _prune_spec(spec: P, leaf, mesh: Mesh) -> P:
+    """Drop sharding on axes the leaf cannot be divided over, and on specs
+    whose rank exceeds the leaf's (biases matched by kernel-shaped rules)."""
+    ndim = getattr(leaf, "ndim", 0)
+    entries = list(spec)
+    if len(entries) > ndim:
+        entries = entries[:ndim]
+    shape = getattr(leaf, "shape", ())
+    pruned = []
+    for dim, ax in enumerate(entries):
+        if ax is None:
+            pruned.append(None)
+            continue
+        size = mesh.shape.get(ax, 1)
+        if dim < len(shape) and shape[dim] % size == 0:
+            pruned.append(ax)
+        else:
+            pruned.append(None)
+    return P(*pruned)
+
+
+def param_specs(params: Any, rules: Sequence[ParamRule] = ENCODER_PARAM_RULES,
+                mesh: Optional[Mesh] = None) -> Any:
+    """PartitionSpec pytree matching ``params`` by path-regex rules."""
+
+    def leaf_spec(key_path, leaf):
+        spec = spec_for_path(path_str(key_path), rules)
+        if mesh is not None:
+            spec = _prune_spec(spec, leaf, mesh)
+        return spec
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, params)
+
+
+def named_sharding(mesh: Mesh, *axes) -> NamedSharding:
+    return NamedSharding(mesh, P(*axes))
+
+
+def shard_params(params: Any, mesh: Mesh,
+                 rules: Sequence[ParamRule] = ENCODER_PARAM_RULES) -> Any:
+    """Place a parameter pytree onto the mesh per the sharding rules."""
+    specs = param_specs(params, rules, mesh=mesh)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+
+
+def batch_spec(seq_sharded: bool = True) -> P:
+    """Token batches: [batch, seq] — batch over dp, optionally seq over sp."""
+    return P(AXIS_DP, AXIS_SP if seq_sharded else None)
+
+
+def batch_sharding(mesh: Mesh, seq_sharded: bool = True) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec(seq_sharded))
+
+
+def shard_batch(batch: Any, mesh: Mesh, seq_sharded: bool = True) -> Any:
+    """Place [batch, seq]-leading arrays onto the mesh (dp, sp)."""
+    sharding = batch_sharding(mesh, seq_sharded)
+    rep = NamedSharding(mesh, P(AXIS_DP))
+
+    def place(x):
+        if getattr(x, "ndim", 0) >= 2 and x.shape[1] % mesh.shape[AXIS_SP] == 0:
+            return jax.device_put(x, sharding)
+        return jax.device_put(x, rep)
+
+    return jax.tree_util.tree_map(place, batch)

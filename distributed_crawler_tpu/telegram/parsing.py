@@ -110,23 +110,32 @@ def _links_from_formatted_text(ft: Dict[str, Any],
         if name and name not in source_map:
             source_map[name] = source
 
-    for entity in ft.get("entities") or []:
-        etype = (entity.get("type") or {}).get("@type", "")
-        if etype == "textEntityTypeTextUrl":
-            url = (entity.get("type") or {}).get("url", "")
-            m = _TME_RE.search(url)
-            if m:
-                add_if_new(_clean_username(m.group(1)), SOURCE_TEXT_URL)
-        elif etype == "textEntityTypeMention":
-            mention = utf16_slice(text, int(entity.get("offset", 0)),
+    # Reliability order among entity types: mention > text_url > url.  The
+    # pass order (not in-message order) decides attribution, so a username
+    # seen both as a bare URL and an @mention is credited to the mention.
+    _RELIABILITY = ("textEntityTypeMention", "textEntityTypeTextUrl",
+                    "textEntityTypeUrl")
+    entities = ft.get("entities") or []
+    for wanted in _RELIABILITY:
+        for entity in entities:
+            etype = (entity.get("type") or {}).get("@type", "")
+            if etype != wanted:
+                continue
+            if etype == "textEntityTypeTextUrl":
+                url = (entity.get("type") or {}).get("url", "")
+                m = _TME_RE.search(url)
+                if m:
+                    add_if_new(_clean_username(m.group(1)), SOURCE_TEXT_URL)
+            elif etype == "textEntityTypeMention":
+                mention = utf16_slice(text, int(entity.get("offset", 0)),
+                                      int(entity.get("length", 0)))
+                add_if_new(_clean_username(mention), SOURCE_MENTION)
+            else:  # textEntityTypeUrl
+                url = utf16_slice(text, int(entity.get("offset", 0)),
                                   int(entity.get("length", 0)))
-            add_if_new(_clean_username(mention), SOURCE_MENTION)
-        elif etype == "textEntityTypeUrl":
-            url = utf16_slice(text, int(entity.get("offset", 0)),
-                              int(entity.get("length", 0)))
-            m = _TME_RE.search(url)
-            if m:
-                add_if_new(_clean_username(m.group(1)), SOURCE_URL)
+                m = _TME_RE.search(url)
+                if m:
+                    add_if_new(_clean_username(m.group(1)), SOURCE_URL)
 
     # Plain-text scan, least reliable.
     for m in _TME_RE.finditer(text):

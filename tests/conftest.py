@@ -8,9 +8,18 @@ exercised in CI.
 
 import os
 
-# Must be set before jax is imported anywhere in the test session.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Overrides (not setdefault): the host environment may preset JAX_PLATFORMS
+# to a real accelerator tunnel — and a sitecustomize may have imported jax
+# already, freezing the env-var snapshot — so force the config directly too.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+try:
+    import jax  # noqa: E402  (after env setup on purpose)
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # jax-less env: non-TPU tests still collect and run
+    pass

@@ -1,0 +1,138 @@
+"""Model tests: embedder/classifier/MoE/train step on the tiny config."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from dataclasses import replace
+
+from distributed_crawler_tpu.models import (
+    Classifier,
+    Embedder,
+    EncoderConfig,
+    TINY_TEST,
+)
+from distributed_crawler_tpu.models.encoder import EmbedderClassifier, mean_pool
+from distributed_crawler_tpu.models.train import (
+    TrainConfig,
+    cross_entropy,
+    make_train_step,
+)
+
+
+def _batch(b=4, l=16, vocab=1024, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = jnp.asarray(rng.integers(0, vocab, size=(b, l)), jnp.int32)
+    mask = np.ones((b, l), dtype=bool)
+    mask[0, l // 2:] = False
+    return ids, jnp.asarray(mask)
+
+
+class TestEmbedder:
+    def test_unit_norm_output(self):
+        ids, mask = _batch()
+        model = Embedder(TINY_TEST)
+        params = model.init(jax.random.PRNGKey(0), ids, mask)
+        emb = model.apply(params, ids, mask)
+        assert emb.shape == (4, TINY_TEST.hidden)
+        np.testing.assert_allclose(np.linalg.norm(np.asarray(emb), axis=-1),
+                                   1.0, atol=1e-5)
+
+    def test_padding_invariant(self):
+        """Embedding must not depend on token values behind the mask."""
+        ids, mask = _batch()
+        model = Embedder(TINY_TEST)
+        params = model.init(jax.random.PRNGKey(0), ids, mask)
+        ids2 = ids.at[0, 8:].set(7)
+        e1 = model.apply(params, ids, mask)
+        e2 = model.apply(params, ids2, mask)
+        np.testing.assert_allclose(np.asarray(e1), np.asarray(e2), atol=1e-5)
+
+    def test_jit_stable(self):
+        ids, mask = _batch()
+        model = Embedder(TINY_TEST)
+        params = model.init(jax.random.PRNGKey(0), ids, mask)
+        f = jax.jit(lambda p, i, m: model.apply(p, i, m))
+        np.testing.assert_allclose(np.asarray(f(params, ids, mask)),
+                                   np.asarray(model.apply(params, ids, mask)),
+                                   atol=1e-5)
+
+
+class TestClassifier:
+    def test_logits_shape(self):
+        ids, mask = _batch()
+        cfg = replace(TINY_TEST, n_labels=3)
+        model = Classifier(cfg)
+        params = model.init(jax.random.PRNGKey(0), ids, mask)
+        logits = model.apply(params, ids, mask)
+        assert logits.shape == (4, 3)
+        assert logits.dtype == jnp.float32
+
+    def test_fused_embed_classify(self):
+        ids, mask = _batch()
+        model = EmbedderClassifier(replace(TINY_TEST, n_labels=5))
+        params = model.init(jax.random.PRNGKey(0), ids, mask)
+        emb, logits = model.apply(params, ids, mask)
+        assert emb.shape == (4, TINY_TEST.hidden)
+        assert logits.shape == (4, 5)
+
+
+class TestMoE:
+    def test_moe_forward(self):
+        ids, mask = _batch()
+        cfg = replace(TINY_TEST, n_experts=4)
+        model = Embedder(cfg)
+        params = model.init(jax.random.PRNGKey(0), ids, mask)
+        emb = model.apply(params, ids, mask)
+        assert emb.shape == (4, cfg.hidden)
+        assert np.isfinite(np.asarray(emb)).all()
+
+    def test_moe_params_have_expert_dim(self):
+        ids, mask = _batch()
+        cfg = replace(TINY_TEST, n_experts=4)
+        model = Embedder(cfg)
+        params = model.init(jax.random.PRNGKey(0), ids, mask)
+        layer = params["params"]["encoder"]["layers_0"]["moe"]
+        assert layer["experts_up/kernel"].shape == (4, cfg.hidden, cfg.mlp_dim)
+
+
+class TestConfig:
+    def test_indivisible_heads_raises(self):
+        cfg = replace(TINY_TEST, hidden=65)
+        ids, mask = _batch()
+        with pytest.raises(ValueError):
+            Embedder(cfg).init(jax.random.PRNGKey(0), ids, mask)
+
+
+class TestTrainStep:
+    def test_loss_decreases(self):
+        cfg = replace(TINY_TEST, n_labels=2)
+        init_fn, step_fn, _ = make_train_step(
+            cfg, TrainConfig(learning_rate=1e-3, warmup_steps=1))
+        ids, mask = _batch(b=8)
+        labels = jnp.asarray([0, 1] * 4, jnp.int32)
+        params, opt_state = init_fn(jax.random.PRNGKey(0), ids, mask)
+        step = jax.jit(step_fn)
+        first = None
+        for _ in range(5):
+            params, opt_state, metrics = step(params, opt_state, ids, mask, labels)
+            if first is None:
+                first = float(metrics["loss"])
+        assert float(metrics["loss"]) < first
+
+    def test_cross_entropy_smoothing(self):
+        logits = jnp.asarray([[10.0, -10.0]])
+        labels = jnp.asarray([0])
+        plain = cross_entropy(logits, labels)
+        smooth = cross_entropy(logits, labels, smoothing=0.1)
+        assert float(smooth) > float(plain)
+
+    def test_remat_parity(self):
+        cfg = replace(TINY_TEST, remat=True)
+        ids, mask = _batch()
+        model = Embedder(cfg)
+        params = model.init(jax.random.PRNGKey(0), ids, mask)
+        plain = Embedder(replace(cfg, remat=False)).apply(params, ids, mask)
+        remat = model.apply(params, ids, mask)
+        np.testing.assert_allclose(np.asarray(plain), np.asarray(remat),
+                                   atol=1e-6)

@@ -1,0 +1,114 @@
+"""Attention + padding op tests (flash kernel runs in Pallas interpret mode)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_crawler_tpu.ops import (
+    BucketSpec,
+    attend,
+    bucket_for,
+    flash_attention,
+    mha,
+    pack_batch,
+    pad_to_bucket,
+)
+from distributed_crawler_tpu.ops.padding import group_by_bucket
+
+
+def _inputs(b=2, l=64, h=2, d=16, seed=1):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(b, l, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, l, h, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, l, h, d)), jnp.float32)
+    mask = np.ones((b, l), dtype=bool)
+    mask[0, l // 2:] = False
+    return q, k, v, jnp.asarray(mask)
+
+
+class TestAttention:
+    def test_attend_shape_dtype(self):
+        q, k, v, mask = _inputs()
+        out = attend(q, k, v, mask)
+        assert out.shape == q.shape and out.dtype == q.dtype
+
+    def test_masked_keys_ignored(self):
+        q, k, v, mask = _inputs()
+        # Perturb masked-out keys/values: output must not change.
+        k2 = k.at[0, 40:].set(99.0)
+        v2 = v.at[0, 40:].set(-99.0)
+        np.testing.assert_allclose(np.asarray(attend(q, k, v, mask)),
+                                   np.asarray(attend(q, k2, v2, mask)),
+                                   atol=1e-6)
+
+    def test_flash_matches_reference(self):
+        q, k, v, mask = _inputs()
+        ref = attend(q, k, v, mask)
+        out = flash_attention(q, k, v, mask, block_q=32, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_flash_no_mask(self):
+        q, k, v, _ = _inputs()
+        ref = attend(q, k, v)
+        out = flash_attention(q, k, v, block_q=32, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_flash_indivisible_block_raises(self):
+        q, k, v, mask = _inputs(l=48)
+        with pytest.raises(ValueError):
+            flash_attention(q, k, v, mask, block_q=32, interpret=True)
+
+    def test_mha_dispatches_xla_on_cpu(self):
+        q, k, v, mask = _inputs()
+        out = mha(q, k, v, mask)  # auto: CPU backend -> XLA path
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(attend(q, k, v, mask)),
+                                   atol=1e-6)
+
+
+class TestPadding:
+    def test_bucket_for(self):
+        spec = BucketSpec((32, 64, 128))
+        assert bucket_for(1, spec) == 32
+        assert bucket_for(32, spec) == 32
+        assert bucket_for(33, spec) == 64
+        assert bucket_for(999, spec) == 128  # over-long truncates to max
+
+    def test_bucket_spec_validation(self):
+        with pytest.raises(ValueError):
+            BucketSpec((64, 32))
+        with pytest.raises(ValueError):
+            BucketSpec(())
+
+    def test_pad_to_bucket(self):
+        ids, mask = pad_to_bucket([5, 6, 7], 8)
+        assert ids.tolist() == [5, 6, 7, 0, 0, 0, 0, 0]
+        assert mask.tolist() == [True] * 3 + [False] * 5
+
+    def test_pad_truncates(self):
+        ids, mask = pad_to_bucket(list(range(10)), 4)
+        assert ids.tolist() == [0, 1, 2, 3]
+        assert mask.all()
+
+    def test_pack_batch_shapes(self):
+        ids, mask = pack_batch([[1, 2], [3, 4, 5, 6, 7]],
+                               BucketSpec((4, 8)))
+        assert ids.shape == (2, 8)
+        assert mask.sum() == 7
+
+    def test_pack_batch_pads_batch_dim(self):
+        ids, mask = pack_batch([[1, 2]], BucketSpec((4,)), batch_pad_to=4)
+        assert ids.shape == (4, 4)
+        assert mask[1:].sum() == 0
+
+    def test_pack_empty_raises(self):
+        with pytest.raises(ValueError):
+            pack_batch([])
+
+    def test_group_by_bucket(self):
+        groups = group_by_bucket([[1] * 3, [1] * 60, [1] * 5],
+                                 BucketSpec((32, 64)))
+        assert groups[32] == [0, 2]
+        assert groups[64] == [1]

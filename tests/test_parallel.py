@@ -1,0 +1,142 @@
+"""Mesh/sharding/ring-attention tests on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_crawler_tpu.ops.attention import attend
+from distributed_crawler_tpu.parallel import (
+    MeshConfig, best_mesh_config, make_mesh, param_specs, shard_batch,
+    shard_params,
+)
+from distributed_crawler_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
+from distributed_crawler_tpu.parallel.ring import make_ring_attention, ring_attention
+from distributed_crawler_tpu.parallel.sharding import spec_for_path, ENCODER_PARAM_RULES
+
+
+class TestMeshConfig:
+    def test_best_config_defaults_to_dp(self):
+        cfg = best_mesh_config(8)
+        assert (cfg.dp, cfg.sp, cfg.tp) == (8, 1, 1)
+
+    def test_best_config_with_tp_sp(self):
+        cfg = best_mesh_config(8, tp=2, sp=2)
+        assert (cfg.dp, cfg.sp, cfg.tp) == (2, 2, 2)
+        assert cfg.n_devices == 8
+
+    def test_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            best_mesh_config(8, tp=3)
+
+    def test_bad_axis_raises(self):
+        with pytest.raises(ValueError):
+            MeshConfig(dp=0).validate()
+
+    def test_make_mesh_8_devices(self):
+        mesh = make_mesh(best_mesh_config(8, tp=2, sp=2))
+        assert mesh.shape == {"dp": 2, "sp": 2, "tp": 2}
+
+    def test_make_mesh_wrong_count(self):
+        with pytest.raises(ValueError):
+            make_mesh(MeshConfig(dp=3))
+
+
+class TestShardingRules:
+    def test_qkv_kernel_tp_sharded(self):
+        assert spec_for_path("encoder/layers_0/attn/q/kernel",
+                             ENCODER_PARAM_RULES) == P(None, AXIS_TP)
+
+    def test_attn_out_row_sharded(self):
+        assert spec_for_path("encoder/layers_3/attn/attn_out/kernel",
+                             ENCODER_PARAM_RULES) == P(AXIS_TP, None)
+
+    def test_layernorm_replicated(self):
+        assert spec_for_path("encoder/layers_0/ln_attn/scale",
+                             ENCODER_PARAM_RULES) == P()
+
+    def test_embed_replicated(self):
+        assert spec_for_path("encoder/embed_tokens",
+                             ENCODER_PARAM_RULES) == P()
+
+    def test_moe_expert_sharded(self):
+        assert spec_for_path("encoder/layers_0/moe/experts_up/kernel",
+                             ENCODER_PARAM_RULES) == P(AXIS_TP, None, None)
+
+    def test_shard_params_places_on_mesh(self):
+        mesh = make_mesh(best_mesh_config(8, tp=2))
+        params = {
+            "layers_0": {
+                "attn": {"q": {"kernel": jnp.ones((16, 16)),
+                               "bias": jnp.ones((16,))}},
+                "mlp": {"mlp_up": {"kernel": jnp.ones((16, 32))}},
+                "ln_attn": {"scale": jnp.ones((16,))},
+            }
+        }
+        sharded = shard_params(params, mesh)
+        q = sharded["layers_0"]["attn"]["q"]["kernel"]
+        spec = q.sharding.spec
+        assert spec == P(None, AXIS_TP)
+        ln = sharded["layers_0"]["ln_attn"]["scale"]
+        assert ln.sharding.spec == P()
+
+    def test_prune_indivisible_falls_back_to_replicated(self):
+        mesh = make_mesh(best_mesh_config(8, tp=2))
+        params = {"attn": {"q": {"kernel": jnp.ones((16, 15))}}}  # 15 % 2 != 0
+        sharded = shard_params(params, mesh)
+        assert sharded["attn"]["q"]["kernel"].sharding.spec == P(None, None)
+
+    def test_shard_batch(self):
+        mesh = make_mesh(best_mesh_config(8, tp=2, sp=2))
+        ids = jnp.zeros((8, 64), jnp.int32)
+        out = shard_batch({"ids": ids}, mesh)
+        assert out["ids"].sharding.spec == P(AXIS_DP, AXIS_SP)
+
+
+class TestRingAttention:
+    def _inputs(self, b=4, l=32, h=4, d=8, seed=0):
+        rng = np.random.default_rng(seed)
+        q = jnp.asarray(rng.normal(size=(b, l, h, d)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(b, l, h, d)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(b, l, h, d)), jnp.float32)
+        # Padding tail per row, never fully masked.
+        mask = np.ones((b, l), dtype=bool)
+        for i in range(b):
+            mask[i, l - rng.integers(0, l // 2):] = False
+        return q, k, v, jnp.asarray(mask)
+
+    def test_matches_reference_full_mask(self):
+        mesh = make_mesh(best_mesh_config(8, sp=2, tp=2))
+        q, k, v, _ = self._inputs()
+        mask = jnp.ones(q.shape[:2], dtype=bool)
+        ring = make_ring_attention(mesh)
+        out = ring(q, k, v, mask)
+        ref = attend(q, k, v, mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_matches_reference_padded(self):
+        mesh = make_mesh(best_mesh_config(8, sp=4, tp=1))
+        q, k, v, mask = self._inputs()
+        ring = make_ring_attention(mesh)
+        out = ring(q, k, v, mask)
+        ref = attend(q, k, v, mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_sp1_degenerates_to_reference(self):
+        mesh = make_mesh(best_mesh_config(8, sp=1))
+        q, k, v, mask = self._inputs(b=8)
+        ring = make_ring_attention(mesh)
+        out = ring(q, k, v, mask)
+        ref = attend(q, k, v, mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_jit_compiles_under_mesh(self):
+        mesh = make_mesh(best_mesh_config(8, sp=2))
+        q, k, v, mask = self._inputs()
+        ring = jax.jit(make_ring_attention(mesh))
+        out = ring(q, k, v, mask)
+        assert out.shape == q.shape
