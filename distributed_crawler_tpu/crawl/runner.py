@@ -337,9 +337,11 @@ def process_all_messages(client: TelegramClient, info: ChannelInfo,
         for m in messages
     ]
     owner.messages = add_new_messages(discovered_messages, owner)
-    pre_deleted = sum(1 for m in owner.messages if m.status == "deleted")
+    pre_deleted = {(m.chat_id, m.message_id) for m in owner.messages
+                   if m.status == "deleted"}
     owner.messages = resample_marker(owner.messages, discovered_messages)
-    deleted = sum(1 for m in owner.messages if m.status == "deleted") - pre_deleted
+    deleted = sum(1 for m in owner.messages if m.status == "deleted"
+                  and (m.chat_id, m.message_id) not in pre_deleted)
     sm.update_page(owner)
 
     by_id = {m.id: m for m in messages}

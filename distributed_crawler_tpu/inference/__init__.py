@@ -1,0 +1,28 @@
+"""TPU inference worker: the ⟨NEW⟩ stage grafted onto the crawl pipeline.
+
+SURVEY.md §7.6: a JAX/Flax service consuming record batches off the bus —
+tokenize → pad to buckets → jit'd embed (multilingual-E5) + classify (XLM-R)
+on a device mesh — writing results back via the state providers.  The module
+split mirrors the data path:
+
+- :mod:`tokenizer` — host-side text → ids (hashing tokenizer by default;
+  any callable with the same signature plugs in).
+- :mod:`engine` — device half: bucketed compile cache, mesh sharding,
+  fused embed+classify step.
+- :mod:`worker` — service half: bus subscription, double-buffered feed,
+  result writeback, heartbeats, metrics.
+- :mod:`checkpoint` — orbax param save/restore.
+"""
+
+from .tokenizer import HashingTokenizer, Tokenizer
+from .engine import EngineConfig, InferenceEngine
+from .worker import TPUWorker, TPUWorkerConfig
+
+__all__ = [
+    "Tokenizer",
+    "HashingTokenizer",
+    "EngineConfig",
+    "InferenceEngine",
+    "TPUWorker",
+    "TPUWorkerConfig",
+]

@@ -1,0 +1,48 @@
+"""Model checkpoint save/restore (orbax).
+
+The crawl side's checkpoint/resume lives in the state layer (SURVEY.md §5.4);
+this is the model-side counterpart: params (and optionally optimizer state)
+persisted per step so a fine-tune or a long inference deployment resumes
+exactly.  Orbax handles sharded arrays natively, so a checkpoint written from
+an 8-chip mesh restores onto any other mesh shape.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+
+def save_params(path: str, params: Any, force: bool = True) -> None:
+    """Write a param pytree checkpoint to ``path`` (a directory)."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), params, force=force)
+
+
+def load_params(path: str, like: Optional[Any] = None) -> Any:
+    """Restore a param pytree; ``like`` (an abstract or concrete pytree)
+    drives dtype/sharding of the restored arrays when given."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        if like is not None:
+            return ckptr.restore(os.path.abspath(path), like)
+        return ckptr.restore(os.path.abspath(path))
+
+
+def latest_step_dir(root: str) -> Optional[str]:
+    """Newest step_N subdirectory under a checkpoint root, or None."""
+    if not os.path.isdir(root):
+        return None
+    steps = []
+    for name in os.listdir(root):
+        if name.startswith("step_"):
+            try:
+                steps.append((int(name.split("_", 1)[1]), name))
+            except ValueError:
+                continue
+    if not steps:
+        return None
+    return os.path.join(root, max(steps)[1])

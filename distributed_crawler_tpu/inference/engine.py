@@ -1,0 +1,173 @@
+"""Inference engine: the device half of the TPU worker.
+
+Owns the model, its (possibly mesh-sharded) params, and a per-bucket compile
+cache: every (bucket, batch_size) pair compiles exactly once and is reused —
+the host side quantizes ragged crawl text into those shapes (`ops.padding`),
+so XLA never sees a dynamic dimension.  The flagship op is the fused
+embed+classify pass (one encoder traversal for both outputs).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, replace
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..models.encoder import (
+    E5_BASE,
+    E5_LARGE,
+    E5_SMALL,
+    EmbedderClassifier,
+    EncoderConfig,
+    TINY_TEST,
+    XLMR_BASE,
+)
+from ..ops.padding import BucketSpec, bucket_for, pack_batch
+from ..utils.metrics import REGISTRY, MetricsRegistry
+from .tokenizer import HashingTokenizer, Tokenizer
+
+MODEL_REGISTRY: Dict[str, EncoderConfig] = {
+    "e5_small": E5_SMALL,
+    "e5_base": E5_BASE,
+    "e5_large": E5_LARGE,
+    "xlmr_base": XLMR_BASE,
+    "tiny": TINY_TEST,
+}
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    model: str = "e5_small"
+    n_labels: int = 8
+    batch_size: int = 256
+    buckets: tuple = (32, 64, 128, 256, 512)
+    seed: int = 0
+
+    def encoder_config(self) -> EncoderConfig:
+        try:
+            base = MODEL_REGISTRY[self.model]
+        except KeyError:
+            raise ValueError(
+                f"unknown model {self.model!r}; "
+                f"one of {sorted(MODEL_REGISTRY)}") from None
+        return replace(base, n_labels=self.n_labels)
+
+
+class InferenceEngine:
+    """Tokenize → bucket → jit'd fused embed+classify → host results.
+
+    ``mesh`` is optional: None runs single-device (standalone mode's analog);
+    with a mesh, params and batches are sharded per `parallel.sharding` and
+    the same jitted step scales data-parallel over dp (SURVEY.md §2.3.1).
+    """
+
+    def __init__(self, cfg: EngineConfig,
+                 mesh=None,
+                 params: Optional[Any] = None,
+                 tokenizer: Optional[Tokenizer] = None,
+                 registry: MetricsRegistry = REGISTRY):
+        import jax
+
+        self.cfg = cfg
+        self.ecfg = cfg.encoder_config()
+        self.mesh = mesh
+        self.model = EmbedderClassifier(self.ecfg)
+        self.tokenizer = tokenizer or HashingTokenizer(self.ecfg.vocab_size)
+        self.bucket_spec = BucketSpec(
+            tuple(b for b in cfg.buckets if b <= self.ecfg.max_len))
+        self._steps: Dict[int, Any] = {}  # bucket -> jitted fn
+        self.m_latency = registry.histogram(
+            "tpu_inference_batch_seconds", "device batch latency")
+        self.m_posts = registry.counter(
+            "tpu_inference_posts_total", "posts through embed+classify")
+        self.m_padding = registry.counter(
+            "tpu_inference_pad_slots_total", "wasted pad slots")
+
+        if params is None:
+            import jax.numpy as jnp
+
+            probe = max(32, self.bucket_spec.lengths[0])
+            ids = jnp.zeros((1, probe), jnp.int32)
+            mask = jnp.ones((1, probe), jnp.bool_)
+            params = self.model.init(jax.random.PRNGKey(cfg.seed), ids, mask)
+        if mesh is not None:
+            from ..parallel.sharding import shard_params
+
+            params = shard_params(params, mesh)
+        self.params = params
+
+    # -- device step -------------------------------------------------------
+    def _step(self, bucket: int):
+        import jax
+
+        fn = self._steps.get(bucket)
+        if fn is None:
+            fn = jax.jit(lambda p, i, m: self.model.apply(p, i, m))
+            self._steps[bucket] = fn
+        return fn
+
+    def _place(self, ids: np.ndarray, mask: np.ndarray):
+        import jax.numpy as jnp
+
+        ids_j, mask_j = jnp.asarray(ids), jnp.asarray(mask)
+        if self.mesh is not None:
+            from ..parallel.sharding import shard_batch
+
+            placed = shard_batch({"ids": ids_j, "mask": mask_j}, self.mesh)
+            return placed["ids"], placed["mask"]
+        return ids_j, mask_j
+
+    # -- public API --------------------------------------------------------
+    def run_tokenized(self, token_lists: Sequence[List[int]]
+                      ) -> List[Dict[str, Any]]:
+        """Embed+classify pre-tokenized sequences; results in input order."""
+        results: List[Optional[Dict[str, Any]]] = [None] * len(token_lists)
+        groups: Dict[int, List[int]] = {}
+        for i, toks in enumerate(token_lists):
+            groups.setdefault(
+                bucket_for(len(toks), self.bucket_spec), []).append(i)
+
+        bs = self.cfg.batch_size
+        for bucket, indices in sorted(groups.items()):
+            for start in range(0, len(indices), bs):
+                chunk = indices[start:start + bs]
+                ids, mask = pack_batch([token_lists[i] for i in chunk],
+                                       BucketSpec((bucket,)), batch_pad_to=bs)
+                t0 = time.perf_counter()
+                emb, logits = self._step(bucket)(
+                    self.params, *self._place(ids, mask))
+                emb_np = np.asarray(emb)         # device->host sync
+                logits_np = np.asarray(logits)
+                self.m_latency.observe(time.perf_counter() - t0)
+                self.m_posts.inc(len(chunk))
+                self.m_padding.inc(bs - len(chunk))
+                scores = _softmax_np(logits_np)
+                for row, i in enumerate(chunk):
+                    results[i] = {
+                        "embedding": emb_np[row].tolist(),
+                        "label": int(np.argmax(logits_np[row])),
+                        "scores": scores[row].tolist(),
+                    }
+        return results  # type: ignore[return-value]
+
+    def run(self, texts: Sequence[str]) -> List[Dict[str, Any]]:
+        return self.run_tokenized(self.tokenizer.encode_batch(texts))
+
+    def embed(self, texts: Sequence[str]) -> np.ndarray:
+        out = self.run(texts)
+        return np.asarray([r["embedding"] for r in out], dtype=np.float32)
+
+    def warmup(self, buckets: Optional[Sequence[int]] = None) -> None:
+        """Pre-compile the (bucket, batch) programs before serving."""
+        for b in buckets or self.bucket_spec.lengths:
+            self.run_tokenized([[1, 2, 3]] * min(2, self.cfg.batch_size)
+                               if b == self.bucket_spec.lengths[0]
+                               else [[1] * (b - 1)])
+
+
+def _softmax_np(logits: np.ndarray) -> np.ndarray:
+    z = logits - logits.max(axis=-1, keepdims=True)
+    e = np.exp(z)
+    return e / e.sum(axis=-1, keepdims=True)

@@ -1,0 +1,101 @@
+"""Host-side tokenization feeding the device queue.
+
+The reference's analog is the 10-goroutine video→Post conversion pool
+(`crawler/youtube/youtube_crawler.go:353-427`) — host preprocessing in front
+of the sink.  Tokenization here is deliberately pluggable: the default
+:class:`HashingTokenizer` is dependency-free and deterministic (stable FNV-1a
+over word pieces), so the whole pipeline runs hermetically; a SentencePiece/HF
+vocab drops in behind the same protocol when checkpoints with a real vocab
+are loaded (`from_pretrained_dir`).
+"""
+
+from __future__ import annotations
+
+import re
+import unicodedata
+from typing import List, Protocol, Sequence
+
+PAD_ID = 0
+CLS_ID = 1
+SEP_ID = 2
+UNK_ID = 3
+_RESERVED = 4
+
+_WORD_RE = re.compile(r"\w+|[^\w\s]", re.UNICODE)
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+
+    def encode(self, text: str) -> List[int]: ...
+
+    def encode_batch(self, texts: Sequence[str]) -> List[List[int]]: ...
+
+
+def _fnv1a(data: bytes) -> int:
+    h = 0xCBF29CE484222325
+    for b in data:
+        h ^= b
+        h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+class HashingTokenizer:
+    """Deterministic hashing tokenizer: NFKC-lowercase words + sub-word
+    fallback for long tokens, mapped into [RESERVED, vocab) by FNV-1a.
+
+    Not a linguistic vocab — a stable, collision-spread id assignment that
+    exercises the exact device path (shapes, buckets, gather widths) the real
+    sentencepiece vocab will, with zero model-asset dependencies.
+    """
+
+    def __init__(self, vocab_size: int, max_word_len: int = 12):
+        if vocab_size <= _RESERVED:
+            raise ValueError(f"vocab_size must exceed {_RESERVED}")
+        self.vocab_size = vocab_size
+        self.max_word_len = max_word_len
+
+    def _hash(self, piece: str) -> int:
+        h = _fnv1a(piece.encode("utf-8"))
+        return _RESERVED + h % (self.vocab_size - _RESERVED)
+
+    def encode(self, text: str) -> List[int]:
+        text = unicodedata.normalize("NFKC", text or "").lower()
+        ids = [CLS_ID]
+        for word in _WORD_RE.findall(text):
+            if len(word) <= self.max_word_len:
+                ids.append(self._hash(word))
+            else:
+                # Long tokens (URLs, hashes) split into fixed-width pieces so
+                # near-identical long strings don't collide to one id.
+                for i in range(0, len(word), self.max_word_len):
+                    ids.append(self._hash(word[i:i + self.max_word_len]))
+        ids.append(SEP_ID)
+        return ids
+
+    def encode_batch(self, texts: Sequence[str]) -> List[List[int]]:
+        return [self.encode(t) for t in texts]
+
+
+def from_pretrained_dir(path: str):
+    """Load a real tokenizer from a local directory (no network).
+
+    Gated import: `transformers` is present in the image but model assets may
+    not be; callers fall back to HashingTokenizer when this raises.
+    """
+    from transformers import AutoTokenizer  # local import by design
+
+    tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+
+    class _HFWrapper:
+        vocab_size = int(tok.vocab_size)
+
+        @staticmethod
+        def encode(text: str) -> List[int]:
+            return tok.encode(text, truncation=False)
+
+        @staticmethod
+        def encode_batch(texts: Sequence[str]) -> List[List[int]]:
+            return [tok.encode(t, truncation=False) for t in texts]
+
+    return _HFWrapper()

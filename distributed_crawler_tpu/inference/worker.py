@@ -1,0 +1,191 @@
+"""TPU worker service: record batches in, embeddings+labels out.
+
+The service half of SURVEY.md §7.6, shaped like the crawl worker
+(`worker/worker.go:96-252`): subscribe to the inference topic, heartbeat on
+the status topic every 30 s, process with busy/idle transitions — but the
+unit of work is a RecordBatch and "processing" is a jitted device step.
+
+Double buffering: the bus handler thread only decodes and enqueues; the feed
+thread packs the next batch on host while the device runs the current one
+(jax's async dispatch overlaps the two), so a bursty crawl stream keeps the
+chip busy without the handler ever blocking on the device.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from ..bus.codec import RecordBatch
+from ..bus.messages import (
+    MSG_HEARTBEAT,
+    TOPIC_INFERENCE_BATCHES,
+    TOPIC_INFERENCE_RESULTS,
+    TOPIC_WORKER_STATUS,
+    StatusMessage,
+    WORKER_BUSY,
+    WORKER_IDLE,
+)
+from ..utils.metrics import REGISTRY, MetricsRegistry, serve_metrics
+from .engine import InferenceEngine
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class TPUWorkerConfig:
+    worker_id: str = "tpu-worker-0"
+    heartbeat_s: float = 30.0
+    queue_capacity: int = 64          # decoded batches awaiting the device
+    metrics_port: int = 0             # 0 = don't serve; >0 = HTTP port
+    storage_prefix: str = "inference"
+    write_embeddings: bool = True     # False: labels/scores only (smaller JSONL)
+
+
+class TPUWorker:
+    """Consume RecordBatches from the bus, run the engine, write results.
+
+    ``provider`` is any `state.providers.StorageProvider`; results land as
+    JSONL under `{storage_prefix}/{crawl_id}/results.jsonl` — the same sink
+    family the crawler writes posts to, per the north star.
+    """
+
+    def __init__(self, bus, engine: InferenceEngine,
+                 provider=None,
+                 cfg: TPUWorkerConfig = TPUWorkerConfig(),
+                 registry: MetricsRegistry = REGISTRY):
+        self.bus = bus
+        self.engine = engine
+        self.provider = provider
+        self.cfg = cfg
+        self._queue: "queue.Queue[RecordBatch]" = queue.Queue(cfg.queue_capacity)
+        self._stop = threading.Event()
+        self._threads: list = []
+        self._started_at = 0.0
+        self._processed = 0
+        self._errors = 0
+        self._metrics_server = None
+        self.m_queue_depth = registry.gauge(
+            "tpu_worker_queue_depth", "decoded batches awaiting device")
+        self.m_batches = registry.counter(
+            "tpu_worker_batches_total", "record batches processed")
+        self.m_batch_age = registry.histogram(
+            "tpu_worker_batch_age_seconds",
+            "bus transit + queue wait per batch")
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._started_at = time.monotonic()
+        self.bus.subscribe(TOPIC_INFERENCE_BATCHES, self._handle_payload)
+        for target, name in ((self._feed_loop, "tpu-feed"),
+                             (self._heartbeat_loop, "tpu-heartbeat")):
+            t = threading.Thread(target=target, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+        if self.cfg.metrics_port:
+            self._metrics_server = serve_metrics(self.cfg.metrics_port)
+        logger.info("tpu worker started", extra={
+            "worker_id": self.cfg.worker_id,
+            "model": self.engine.cfg.model})
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=timeout_s)
+        if self._metrics_server is not None:
+            self._metrics_server.shutdown()
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Block until the queue is empty (tests / graceful shutdown)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._queue.empty():
+                return True
+            time.sleep(0.01)
+        return False
+
+    # -- bus handler (never blocks on the device) --------------------------
+    def _handle_payload(self, payload: Dict[str, Any]) -> None:
+        batch = RecordBatch.from_dict(payload)
+        if not batch.records:
+            return
+        # Raising into the bus (queue full) triggers redelivery — the bus's
+        # retry semantics are the backpressure path, as in the reference's
+        # handler-error-means-retry contract (`pubsub.go:157-171`).
+        self._queue.put(batch, timeout=5.0)
+        self.m_queue_depth.set(self._queue.qsize())
+
+    # -- feed loop ---------------------------------------------------------
+    def _feed_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                batch = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            self.m_queue_depth.set(self._queue.qsize())
+            try:
+                self._process(batch)
+                self._processed += 1
+            except Exception as e:
+                self._errors += 1
+                logger.exception("batch %s failed: %s", batch.batch_id, e)
+
+    def _process(self, batch: RecordBatch) -> None:
+        if batch.created_at is not None:
+            from ..state.datamodels import utcnow
+
+            age = (utcnow() - batch.created_at).total_seconds()
+            if age >= 0:
+                self.m_batch_age.observe(age)
+        results = self.engine.run(batch.texts())
+        if not self.cfg.write_embeddings:
+            results = [{k: v for k, v in r.items() if k != "embedding"}
+                       for r in results]
+        batch.results = results
+        self.m_batches.inc()
+        self.bus.publish(TOPIC_INFERENCE_RESULTS, batch.to_dict())
+        if self.provider is not None:
+            self._writeback(batch)
+
+    def _writeback(self, batch: RecordBatch) -> None:
+        rel = f"{self.cfg.storage_prefix}/{batch.crawl_id or 'adhoc'}/results.jsonl"
+        for record, result in zip(batch.records, batch.results):
+            line = json.dumps({
+                "post_uid": record.get("post_uid", ""),
+                "channel_name": record.get("channel_name", ""),
+                "batch_id": batch.batch_id,
+                "trace_id": batch.trace_id,
+                **result,
+            }, ensure_ascii=False)
+            self.provider.append_jsonl(rel, line)
+
+    # -- heartbeats --------------------------------------------------------
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            status = WORKER_BUSY if not self._queue.empty() else WORKER_IDLE
+            msg = StatusMessage.new(
+                self.cfg.worker_id, MSG_HEARTBEAT, status,
+                tasks_processed=self._processed,
+                tasks_success=self._processed - self._errors,
+                tasks_error=self._errors,
+                uptime_s=time.monotonic() - self._started_at)
+            msg.queue_length = self._queue.qsize()
+            try:
+                self.bus.publish(TOPIC_WORKER_STATUS, msg.to_dict())
+            except Exception as e:  # bus outage must not kill the worker
+                logger.warning("heartbeat publish failed: %s", e)
+            self._stop.wait(self.cfg.heartbeat_s)
+
+    def status(self) -> Dict[str, Any]:
+        return {
+            "worker_id": self.cfg.worker_id,
+            "queue_depth": self._queue.qsize(),
+            "processed": self._processed,
+            "errors": self._errors,
+            "uptime_s": time.monotonic() - self._started_at,
+        }

@@ -6,10 +6,11 @@ sharding rules in `parallel.sharding` match their parameter paths:
 - :mod:`encoder` — BERT/XLM-R-family text encoder: multilingual-E5
   (small/base/large) embedders and XLM-R classifiers, optional MoE MLP for
   expert parallelism.
-- :mod:`whisper` — Whisper-small encoder-decoder ASR for Telegram voice/video
-  media (BASELINE config #4).
 - :mod:`train` — training/fine-tune step (optax) used by the multi-chip
   dry-run and classifier fine-tuning.
+
+Whisper-small ASR for Telegram voice/video media (BASELINE config #4) is the
+next family on the roadmap and will land as :mod:`whisper`.
 """
 
 from .encoder import (

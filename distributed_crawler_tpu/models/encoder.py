@@ -187,6 +187,27 @@ def mean_pool(hidden: jax.Array, mask: jax.Array) -> jax.Array:
     return summed / count
 
 
+def l2_normalize(x: jax.Array, eps: float = 1e-12) -> jax.Array:
+    return x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), eps)
+
+
+class ClassificationHead(nn.Module):
+    """XLM-R-style head: first-token state -> tanh dense -> logits (f32).
+    Shared by Classifier and EmbedderClassifier so the fused benchmark model
+    cannot drift from the standalone one."""
+
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, cls_state):
+        cfg = self.cfg
+        pooled = jnp.tanh(nn.Dense(cfg.hidden, dtype=jnp.float32,
+                                   param_dtype=jnp.float32,
+                                   name="pooler")(cls_state.astype(jnp.float32)))
+        return nn.Dense(cfg.n_labels, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="head")(pooled)
+
+
 class Embedder(nn.Module):
     """E5-style sentence embedder: encoder -> masked mean -> L2 normalize.
     Returns f32 [B, H] unit vectors."""
@@ -196,27 +217,18 @@ class Embedder(nn.Module):
     @nn.compact
     def __call__(self, ids, mask):
         hidden = Encoder(self.cfg, name="encoder")(ids, mask)
-        pooled = mean_pool(hidden, mask)
-        norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
-        return pooled / jnp.maximum(norm, 1e-12)
+        return l2_normalize(mean_pool(hidden, mask))
 
 
 class Classifier(nn.Module):
-    """XLM-R-style classifier: encoder -> first-token pool -> tanh dense ->
-    logits f32 [B, n_labels]."""
+    """XLM-R-style classifier: encoder -> head -> logits f32 [B, n_labels]."""
 
     cfg: EncoderConfig
 
     @nn.compact
     def __call__(self, ids, mask):
-        cfg = self.cfg
-        hidden = Encoder(cfg, name="encoder")(ids, mask)
-        cls = hidden[:, 0, :].astype(jnp.float32)
-        pooled = jnp.tanh(nn.Dense(cfg.hidden, dtype=jnp.float32,
-                                   param_dtype=jnp.float32,
-                                   name="pooler")(cls))
-        return nn.Dense(cfg.n_labels, dtype=jnp.float32,
-                        param_dtype=jnp.float32, name="head")(pooled)
+        hidden = Encoder(self.cfg, name="encoder")(ids, mask)
+        return ClassificationHead(self.cfg, name="cls_head")(hidden[:, 0, :])
 
 
 class EmbedderClassifier(nn.Module):
@@ -227,14 +239,7 @@ class EmbedderClassifier(nn.Module):
 
     @nn.compact
     def __call__(self, ids, mask):
-        cfg = self.cfg
-        hidden = Encoder(cfg, name="encoder")(ids, mask)
-        pooled = mean_pool(hidden, mask)
-        emb = pooled / jnp.maximum(
-            jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12)
-        cls = hidden[:, 0, :].astype(jnp.float32)
-        p = jnp.tanh(nn.Dense(cfg.hidden, dtype=jnp.float32,
-                              param_dtype=jnp.float32, name="pooler")(cls))
-        logits = nn.Dense(cfg.n_labels, dtype=jnp.float32,
-                          param_dtype=jnp.float32, name="head")(p)
+        hidden = Encoder(self.cfg, name="encoder")(ids, mask)
+        emb = l2_normalize(mean_pool(hidden, mask))
+        logits = ClassificationHead(self.cfg, name="cls_head")(hidden[:, 0, :])
         return emb, logits

@@ -33,7 +33,14 @@ def attend(q: jax.Array, k: jax.Array, v: jax.Array,
                    preferred_element_type=jnp.float32) * scale
     if kv_mask is not None:
         s = jnp.where(kv_mask[:, None, None, :], s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
+    # Explicit masked softmax (not jax.nn.softmax): fully-masked rows must
+    # yield zeros, matching the flash kernel and ring attention, instead of
+    # the uniform average softmax would produce from all-equal -inf scores.
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    if kv_mask is not None:
+        p = jnp.where(kv_mask[:, None, None, :], p, 0.0)
+    p = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
     out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
     return out.astype(q.dtype)

@@ -122,13 +122,22 @@ def batch_sharding(mesh: Mesh, seq_sharded: bool = True) -> NamedSharding:
 
 
 def shard_batch(batch: Any, mesh: Mesh, seq_sharded: bool = True) -> Any:
-    """Place [batch, seq]-leading arrays onto the mesh (dp, sp)."""
+    """Place [batch, seq]-leading arrays onto the mesh (dp over batch, sp
+    over seq).  Leaves that don't divide evenly fall back a level at a time:
+    (dp, sp) -> (dp,) -> fully replicated."""
     sharding = batch_sharding(mesh, seq_sharded)
-    rep = NamedSharding(mesh, P(AXIS_DP))
+    dp_only = NamedSharding(mesh, P(AXIS_DP))
+    replicated = NamedSharding(mesh, P())
 
     def place(x):
-        if getattr(x, "ndim", 0) >= 2 and x.shape[1] % mesh.shape[AXIS_SP] == 0:
+        ndim = getattr(x, "ndim", 0)
+        shape = getattr(x, "shape", ())
+        dp, sp = mesh.shape[AXIS_DP], mesh.shape[AXIS_SP]
+        if (ndim >= 2 and shape[0] % dp == 0
+                and (not seq_sharded or shape[1] % sp == 0)):
             return jax.device_put(x, sharding)
-        return jax.device_put(x, rep)
+        if ndim >= 1 and shape[0] % dp == 0:
+            return jax.device_put(x, dp_only)
+        return jax.device_put(x, replicated)
 
     return jax.tree_util.tree_map(place, batch)

@@ -1,0 +1,209 @@
+"""First-class metrics: counters, gauges, latency histograms, text endpoint.
+
+The reference has no metrics endpoint — its health server returns Hello World
+(`dapr/standalone.go:31-33,115-122`) and throughput is greppable log lines.
+SURVEY.md §5.5 calls out the gap; the BASELINE north-star metrics
+(posts/sec/chip, p50 batch latency) are first-class here: a tiny in-process
+registry with Prometheus-style text exposition, no external deps.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+# Latency buckets in seconds: 1 ms .. 60 s, roughly log-spaced.
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = ""):
+        self.name, self.help = name, help_
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def expose(self) -> str:
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} counter\n"
+                f"{self.name} {self._value}\n")
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str = ""):
+        self.name, self.help = name, help_
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = value
+
+    def add(self, amount: float) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def expose(self) -> str:
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} gauge\n"
+                f"{self.name} {self._value}\n")
+
+
+class Histogram:
+    """Bucketed histogram with exact quantiles over a bounded sample window."""
+
+    def __init__(self, name: str, help_: str = "",
+                 buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+                 window: int = 4096):
+        self.name, self.help = name, help_
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._n = 0
+        self._window: List[float] = []
+        self._window_cap = window
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            i = bisect.bisect_left(self.buckets, value)
+            self._counts[i] += 1
+            self._sum += value
+            self._n += 1
+            self._window.append(value)
+            if len(self._window) > self._window_cap:
+                # Drop the oldest half to amortize the trim.
+                self._window = self._window[self._window_cap // 2:]
+
+    def quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            if not self._window:
+                return None
+            s = sorted(self._window)
+            idx = min(len(s) - 1, max(0, int(q * (len(s) - 1))))
+            return s[idx]
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def expose(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        cum = 0
+        for bound, c in zip(self.buckets, self._counts):
+            cum += c
+            lines.append(f'{self.name}_bucket{{le="{bound}"}} {cum}')
+        cum += self._counts[-1]
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{self.name}_sum {self._sum}")
+        lines.append(f"{self.name}_count {self._n}")
+        return "\n".join(lines) + "\n"
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get_or_make(name, lambda: Counter(name, help_), Counter)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get_or_make(name, lambda: Gauge(name, help_), Gauge)
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_make(
+            name, lambda: Histogram(name, help_, buckets), Histogram)
+
+    def _get_or_make(self, name, factory, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = factory()
+            elif not isinstance(m, cls):
+                raise ValueError(f"metric {name} already registered as "
+                                 f"{type(m).__name__}")
+            return m
+
+    def expose(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return "".join(m.expose() for m in metrics)
+
+
+REGISTRY = MetricsRegistry()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    registry: MetricsRegistry = REGISTRY
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        if self.path.rstrip("/") in ("", "/health", "/healthz"):
+            body = b"ok\n"
+            ctype = "text/plain"
+        elif self.path.startswith("/metrics"):
+            body = self.registry.expose().encode("utf-8")
+            ctype = "text/plain; version=0.0.4"
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # silence request logging
+        pass
+
+
+def serve_metrics(port: int, registry: MetricsRegistry = REGISTRY
+                  ) -> ThreadingHTTPServer:
+    """Start the /metrics + /healthz endpoint on a daemon thread.
+    Returns the server (call .shutdown() to stop). Port 0 picks a free port
+    (server.server_address[1])."""
+    handler = type("Handler", (_Handler,), {"registry": registry})
+    server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True,
+                         name="metrics-http")
+    t.start()
+    return server
+
+
+@dataclass
+class Timer:
+    """Context manager observing elapsed seconds into a histogram."""
+
+    histogram: Histogram
+    _start: float = field(default=0.0, init=False)
+
+    def __enter__(self):
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.histogram.observe(time.perf_counter() - self._start)
+        return False

@@ -21,5 +21,12 @@ try:
     import jax  # noqa: E402  (after env setup on purpose)
 
     jax.config.update("jax_platforms", "cpu")
+    try:
+        # XLA_FLAGS may have been frozen by a pre-import; this config is
+        # honored any time before CPU backend initialization (and agrees
+        # with the flag when both are set).
+        jax.config.update("jax_num_cpu_devices", 8)
+    except Exception:
+        pass  # backend already initialized (flag took effect) or old jax
 except ImportError:  # jax-less env: non-TPU tests still collect and run
     pass

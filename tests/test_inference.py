@@ -1,0 +1,254 @@
+"""Inference stack tests: tokenizer, engine, worker service, metrics."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_crawler_tpu.bus.codec import RecordBatch
+from distributed_crawler_tpu.bus.inmemory import InMemoryBus
+from distributed_crawler_tpu.bus.messages import (
+    TOPIC_INFERENCE_BATCHES,
+    TOPIC_INFERENCE_RESULTS,
+    TOPIC_WORKER_STATUS,
+)
+from distributed_crawler_tpu.datamodel import Post
+from distributed_crawler_tpu.inference import (
+    EngineConfig,
+    HashingTokenizer,
+    InferenceEngine,
+    TPUWorker,
+    TPUWorkerConfig,
+)
+from distributed_crawler_tpu.inference.tokenizer import CLS_ID, SEP_ID
+from distributed_crawler_tpu.state.providers import InMemoryStorageProvider
+from distributed_crawler_tpu.utils.metrics import (
+    MetricsRegistry,
+    serve_metrics,
+)
+
+
+class TestHashingTokenizer:
+    def test_deterministic(self):
+        tok = HashingTokenizer(1000)
+        assert tok.encode("Hello World") == tok.encode("hello  world")
+
+    def test_cls_sep_framing(self):
+        ids = HashingTokenizer(1000).encode("abc")
+        assert ids[0] == CLS_ID and ids[-1] == SEP_ID
+
+    def test_ids_in_range(self):
+        ids = HashingTokenizer(100).encode("the quick brown fox jumps")
+        assert all(0 <= i < 100 for i in ids)
+
+    def test_long_token_split(self):
+        tok = HashingTokenizer(10_000, max_word_len=4)
+        a = tok.encode("abcdefgh")
+        b = tok.encode("abcdzzzz")
+        assert a[1] == b[1]          # shared 4-char prefix piece
+        assert a[2] != b[2]          # differing second piece
+
+    def test_unicode_normalized(self):
+        tok = HashingTokenizer(1000)
+        assert tok.encode("Ｃａｆé") == tok.encode("café")  # NFKC fold
+
+    def test_tiny_vocab_rejected(self):
+        with pytest.raises(ValueError):
+            HashingTokenizer(3)
+
+
+def _engine(registry=None, **kw):
+    cfg = EngineConfig(model="tiny", n_labels=3, batch_size=4,
+                       buckets=(16, 32), **kw)
+    return InferenceEngine(cfg, registry=registry or MetricsRegistry())
+
+
+class TestInferenceEngine:
+    def test_run_returns_per_text_results(self):
+        eng = _engine()
+        out = eng.run(["hello world", "a much longer piece of text " * 3,
+                       "third"])
+        assert len(out) == 3
+        for r in out:
+            assert len(r["embedding"]) == 64
+            assert 0 <= r["label"] < 3
+            np.testing.assert_allclose(sum(r["scores"]), 1.0, atol=1e-5)
+
+    def test_results_in_input_order(self):
+        eng = _engine()
+        texts = ["short", "x " * 25, "short again"]  # mixed buckets
+        out1 = eng.run(texts)
+        out2 = eng.run(list(texts))
+        for a, b in zip(out1, out2):
+            np.testing.assert_allclose(a["embedding"], b["embedding"],
+                                       atol=1e-6)
+
+    def test_embedding_unit_norm(self):
+        eng = _engine()
+        emb = eng.embed(["some text", "other text"])
+        np.testing.assert_allclose(np.linalg.norm(emb, axis=-1), 1.0,
+                                   atol=1e-5)
+
+    def test_oversize_batch_chunks(self):
+        eng = _engine()  # batch_size=4
+        out = eng.run([f"text {i}" for i in range(11)])
+        assert len(out) == 11
+
+    def test_metrics_recorded(self):
+        reg = MetricsRegistry()
+        eng = _engine(registry=reg)
+        eng.run(["a", "b"])
+        assert eng.m_posts.value == 2
+        assert eng.m_latency.count >= 1
+
+    def test_unknown_model_raises(self):
+        with pytest.raises(ValueError):
+            InferenceEngine(EngineConfig(model="nope"),
+                            registry=MetricsRegistry())
+
+    def test_mesh_sharded_run(self):
+        from distributed_crawler_tpu.parallel import best_mesh_config, make_mesh
+
+        mesh = make_mesh(best_mesh_config(8, tp=2))
+        cfg = EngineConfig(model="tiny", n_labels=3, batch_size=8,
+                           buckets=(16,))
+        eng = InferenceEngine(cfg, mesh=mesh, registry=MetricsRegistry())
+        out = eng.run(["hello"] * 5)
+        assert len(out) == 5
+
+
+def _posts(n):
+    return [Post(post_uid=f"p{i}", channel_name="chan",
+                 description=f"message text {i}") for i in range(n)]
+
+
+class TestTPUWorker:
+    def _make(self, provider=None):
+        bus = InMemoryBus()
+        eng = _engine()
+        worker = TPUWorker(bus, eng, provider=provider,
+                           cfg=TPUWorkerConfig(worker_id="w1",
+                                               heartbeat_s=0.05),
+                           registry=MetricsRegistry())
+        return bus, worker
+
+    def test_processes_batch_and_publishes_results(self):
+        bus, worker = self._make()
+        got = []
+        bus.subscribe(TOPIC_INFERENCE_RESULTS, got.append)
+        bus.start()
+        worker.start()
+        batch = RecordBatch.from_posts(_posts(3), crawl_id="c1")
+        bus.publish(TOPIC_INFERENCE_BATCHES, batch.to_dict())
+        deadline = time.monotonic() + 10
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.01)
+        worker.stop()
+        bus.close()
+        assert got, "no results published"
+        rb = RecordBatch.from_dict(got[0])
+        assert len(rb.results) == 3
+        assert rb.results[0]["label"] in (0, 1, 2)
+
+    def test_writeback_jsonl(self):
+        provider = InMemoryStorageProvider()
+        bus, worker = self._make(provider=provider)
+        bus.start()
+        worker.start()
+        batch = RecordBatch.from_posts(_posts(2), crawl_id="c9")
+        bus.publish(TOPIC_INFERENCE_BATCHES, batch.to_dict())
+        deadline = time.monotonic() + 10
+        while worker.status()["processed"] == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        worker.stop()
+        bus.close()
+        rel = "inference/c9/results.jsonl"
+        assert provider.exists(rel)
+        lines = [json.loads(l) for l in provider.jsonl_store[rel]]
+        assert len(lines) == 2
+        assert lines[0]["post_uid"] == "p0"
+        assert "embedding" in lines[0] and "label" in lines[0]
+
+    def test_heartbeats_published(self):
+        bus, worker = self._make()
+        beats = []
+        bus.subscribe(TOPIC_WORKER_STATUS, beats.append)
+        bus.start()
+        worker.start()
+        deadline = time.monotonic() + 5
+        while len(beats) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        worker.stop()
+        bus.close()
+        assert len(beats) >= 2
+        assert beats[0]["worker_id"] == "w1"
+
+    def test_empty_batch_ignored(self):
+        bus, worker = self._make()
+        bus.start()
+        worker.start()
+        bus.publish(TOPIC_INFERENCE_BATCHES, RecordBatch().to_dict())
+        time.sleep(0.2)
+        assert worker.status()["processed"] == 0
+        worker.stop()
+        bus.close()
+
+
+class TestMetricsEndpoint:
+    def test_serve_and_scrape(self):
+        reg = MetricsRegistry()
+        reg.counter("test_total", "help").inc(5)
+        h = reg.histogram("lat_seconds", "help")
+        h.observe(0.02)
+        server = serve_metrics(0, reg)
+        port = server.server_address[1]
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+            assert "test_total 5.0" in body
+            assert 'lat_seconds_bucket{le="+Inf"} 1' in body
+            health = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5).read()
+            assert health == b"ok\n"
+        finally:
+            server.shutdown()
+
+    def test_quantiles(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("q_seconds", "")
+        for v in [0.01] * 50 + [0.1] * 50:
+            h.observe(v)
+        assert h.quantile(0.25) == pytest.approx(0.01)
+        assert h.quantile(0.9) == pytest.approx(0.1)
+
+    def test_type_conflict_raises(self):
+        reg = MetricsRegistry()
+        reg.counter("x", "")
+        with pytest.raises(ValueError):
+            reg.gauge("x", "")
+
+
+class TestCheckpoint:
+    def test_roundtrip(self, tmp_path):
+        import jax.numpy as jnp
+
+        from distributed_crawler_tpu.inference.checkpoint import (
+            latest_step_dir,
+            load_params,
+            save_params,
+        )
+
+        params = {"a": jnp.arange(4.0), "b": {"c": jnp.ones((2, 2))}}
+        path = str(tmp_path / "ck" / "step_3")
+        save_params(path, params)
+        restored = load_params(path, like=params)
+        np.testing.assert_allclose(np.asarray(restored["b"]["c"]), 1.0)
+        assert latest_step_dir(str(tmp_path / "ck")) == path
+
+    def test_latest_step_dir_empty(self, tmp_path):
+        from distributed_crawler_tpu.inference.checkpoint import latest_step_dir
+
+        assert latest_step_dir(str(tmp_path / "missing")) is None
