@@ -1,0 +1,53 @@
+"""Platform crawler registry (reference `crawler/` + `crawler/common/`).
+
+`CrawlerFactory` + `register_all_crawlers` mirror the reference's
+`DefaultCrawlerFactory` (`crawler/crawler.go:79-106`) and
+`RegisterAllCrawlers` (`crawler/common/registrar.go:11-25`).
+"""
+
+from .base import (
+    PLATFORM_TELEGRAM,
+    PLATFORM_YOUTUBE,
+    Crawler,
+    CrawlerFactory,
+    CrawlJob,
+    CrawlResult,
+    CrawlRunner,
+    CrawlTarget,
+)
+from .telegram import TelegramCrawler, register_telegram_crawler
+from .youtube import (
+    YouTubeCrawler,
+    apply_sampling,
+    extract_urls,
+    parse_iso8601_duration,
+    register_youtube_crawler,
+    sanitize_filename,
+)
+
+
+def register_all_crawlers(factory: CrawlerFactory) -> None:
+    """`crawler/common/registrar.go:11-25`."""
+    register_telegram_crawler(factory)
+    register_youtube_crawler(factory)
+
+
+__all__ = [
+    "PLATFORM_TELEGRAM",
+    "PLATFORM_YOUTUBE",
+    "Crawler",
+    "CrawlerFactory",
+    "CrawlJob",
+    "CrawlResult",
+    "CrawlRunner",
+    "CrawlTarget",
+    "TelegramCrawler",
+    "YouTubeCrawler",
+    "apply_sampling",
+    "extract_urls",
+    "parse_iso8601_duration",
+    "register_all_crawlers",
+    "register_telegram_crawler",
+    "register_youtube_crawler",
+    "sanitize_filename",
+]

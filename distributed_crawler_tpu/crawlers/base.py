@@ -1,0 +1,165 @@
+"""Platform-agnostic crawler abstraction: interface, registry, runner.
+
+Parity with the reference's `crawler/crawler.go:15-106` (PlatformType,
+CrawlTarget/CrawlJob/CrawlResult, the `Crawler` interface, and the
+registry-based `DefaultCrawlerFactory`) and `crawler/common/runner.go:15-156`
+(the generic `CrawlRunner` that validates, fetches, and stores).
+"""
+
+from __future__ import annotations
+
+import abc
+import logging
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Any, Callable, Dict, List, Optional
+
+from ..datamodel import ChannelData, NullValidator, Post
+
+logger = logging.getLogger("dct.crawlers")
+
+PLATFORM_TELEGRAM = "telegram"
+PLATFORM_YOUTUBE = "youtube"
+
+
+@dataclass
+class CrawlTarget:
+    """A specific source to crawl (`crawler/crawler.go:25-29`)."""
+
+    id: str = ""
+    type: str = PLATFORM_TELEGRAM
+    metadata: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class CrawlResult:
+    """Unified crawl results (`crawler/crawler.go:32-35`)."""
+
+    posts: List[Post] = field(default_factory=list)
+    errors: List[str] = field(default_factory=list)
+
+
+@dataclass
+class CrawlJob:
+    """A job to crawl one target (`crawler/crawler.go:38-46`)."""
+
+    target: CrawlTarget = field(default_factory=CrawlTarget)
+    from_time: Optional[datetime] = None
+    to_time: Optional[datetime] = None
+    limit: int = 0
+    sample_size: int = 0  # 0 = no post-level sampling
+    samples_remaining: int = 0
+    null_validator: Optional[NullValidator] = None
+
+
+class Crawler(abc.ABC):
+    """The interface every platform crawler implements
+    (`crawler/crawler.go:49-67`)."""
+
+    @abc.abstractmethod
+    def initialize(self, config: Dict[str, Any]) -> None:
+        """Set up the crawler with necessary configuration."""
+
+    @abc.abstractmethod
+    def validate_target(self, target: CrawlTarget) -> None:
+        """Raise ValueError if the target is not valid for this crawler."""
+
+    @abc.abstractmethod
+    def get_channel_info(self, target: CrawlTarget) -> ChannelData:
+        """Retrieve information about a channel."""
+
+    @abc.abstractmethod
+    def fetch_messages(self, job: CrawlJob) -> CrawlResult:
+        """Retrieve messages/posts from the target."""
+
+    @abc.abstractmethod
+    def get_platform_type(self) -> str: ...
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+
+class CrawlerFactory:
+    """Registry-based factory (`crawler/crawler.go:70-106`)."""
+
+    def __init__(self):
+        self._creators: Dict[str, Callable[[], Crawler]] = {}
+
+    def register_crawler(self, platform_type: str,
+                         creator: Callable[[], Crawler]) -> None:
+        if platform_type in self._creators:
+            raise ValueError(
+                f"crawler for platform {platform_type} already registered")
+        self._creators[platform_type] = creator
+
+    def get_crawler(self, platform_type: str) -> Crawler:
+        creator = self._creators.get(platform_type)
+        if creator is None:
+            raise ValueError(
+                f"no crawler registered for platform {platform_type}")
+        return creator()
+
+    def registered_platforms(self) -> List[str]:
+        return sorted(self._creators)
+
+
+class CrawlRunner:
+    """Generic job runner: get-or-init crawler, validate, fetch, store
+    (`crawler/common/runner.go:15-156`)."""
+
+    def __init__(self, factory: CrawlerFactory, state_manager,
+                 base_config: Optional[Dict[str, Any]] = None):
+        self.factory = factory
+        self.sm = state_manager
+        self.base_config = dict(base_config or {})
+        self._crawlers: Dict[str, Crawler] = {}
+
+    def _get_crawler(self, platform_type: str) -> Crawler:
+        c = self._crawlers.get(platform_type)
+        if c is not None:
+            return c
+        c = self.factory.get_crawler(platform_type)
+        config = {"state_manager": self.sm, **self.base_config}
+        c.initialize(config)
+        self._crawlers[platform_type] = c
+        return c
+
+    def execute_job(self, job: CrawlJob) -> CrawlResult:
+        c = self._get_crawler(job.target.type)
+        c.validate_target(job.target)
+        result = c.fetch_messages(job)
+        # The YouTube crawler stores as it converts; store here only for
+        # crawlers that don't (store_post must be idempotent either way —
+        # parity `runner.go:54-63` which always re-saves).
+        for post in result.posts:
+            if not getattr(c, "stores_posts_itself", False):
+                try:
+                    self.sm.store_post(post.channel_id, post)
+                except Exception as e:
+                    logger.error("failed to save post", extra={
+                        "post_uid": post.post_uid, "error": str(e)})
+        return result
+
+    def execute_batch_jobs(self, jobs: List[CrawlJob]) -> List[CrawlResult]:
+        results: List[CrawlResult] = []
+        for job in jobs:
+            try:
+                results.append(self.execute_job(job))
+            except Exception as e:
+                logger.error("job failed", extra={
+                    "platform": job.target.type, "target_id": job.target.id,
+                    "error": str(e)})
+                results.append(CrawlResult(posts=[], errors=[str(e)]))
+        return results
+
+    def get_channel_info(self, target: CrawlTarget) -> ChannelData:
+        return self._get_crawler(target.type).get_channel_info(target)
+
+    def close(self) -> None:
+        for platform, c in self._crawlers.items():
+            try:
+                c.close()
+            except Exception as e:
+                logger.error("error closing crawler", extra={
+                    "platform": platform, "error": str(e)})
+        self._crawlers.clear()

@@ -1,0 +1,133 @@
+"""Telegram crawler: the `Crawler` interface over the native client boundary.
+
+Parity with the reference's `crawler/telegram/telegram_crawler.go` (~330 LoC):
+initialize from a config map holding the client + state manager (`:31-62`),
+target validation (`:65-76`), channel info via the client (`:78-116`), and
+message fetching that delegates to the engine's fetch + parse pipeline
+(`:118-161`).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict
+
+from ..clients.telegram import TelegramClient
+from ..config.crawler import CrawlerConfig
+from ..crawl.channelinfo import get_channel_info as engine_channel_info
+from ..datamodel import ChannelData, EngagementData
+from ..state.datamodels import Page, new_id
+from ..telegram.fetch import fetch_channel_messages_with_sampling
+from ..telegram.parsing import parse_message
+from .base import (
+    PLATFORM_TELEGRAM,
+    Crawler,
+    CrawlerFactory,
+    CrawlJob,
+    CrawlResult,
+    CrawlTarget,
+)
+
+logger = logging.getLogger("dct.crawlers.telegram")
+
+
+class TelegramCrawler(Crawler):
+    """`crawler.Crawler` impl delegating to the Telegram client boundary
+    (`crawler/telegram/telegram_crawler.go:17-28`)."""
+
+    def __init__(self):
+        self.client: TelegramClient = None  # type: ignore[assignment]
+        self.sm = None
+        self.cfg: CrawlerConfig = CrawlerConfig()
+        self.initialized = False
+
+    def initialize(self, config: Dict[str, Any]) -> None:
+        """`telegram_crawler.go:31-62`."""
+        if self.initialized:
+            return
+        client = config.get("client")
+        if client is None:
+            raise ValueError("client not provided in config")
+        self.client = client
+        self.sm = config.get("state_manager")
+        cfg = config.get("crawler_config")
+        if cfg is not None:
+            self.cfg = cfg
+        self.initialized = True
+
+    def validate_target(self, target: CrawlTarget) -> None:
+        """`telegram_crawler.go:65-76`."""
+        if target.type != PLATFORM_TELEGRAM:
+            raise ValueError(
+                f"invalid target type: {target.type}, expected: telegram")
+        if not target.id:
+            raise ValueError("target ID cannot be empty")
+
+    def get_platform_type(self) -> str:
+        return PLATFORM_TELEGRAM
+
+    def close(self) -> None:
+        if self.client is not None:
+            self.client.close()
+
+    def get_channel_info(self, target: CrawlTarget) -> ChannelData:
+        """`telegram_crawler.go:78-116`."""
+        self.validate_target(target)
+        if not self.initialized:
+            raise RuntimeError("crawler not initialized")
+        page = Page(id=new_id(), url=target.id)
+        info, _ = engine_channel_info(self.client, page, 0, self.cfg)
+        return ChannelData(
+            channel_id=str(info.chat.id),
+            channel_name=info.chat.title,
+            channel_description=(info.supergroup_info.description
+                                 if info.supergroup_info else ""),
+            channel_engagement_data=EngagementData(
+                follower_count=info.member_count,
+                post_count=info.message_count,
+                views_count=info.total_views,
+            ),
+            channel_url=f"https://t.me/{target.id}",
+            channel_url_external=f"https://t.me/{target.id}",
+        )
+
+    def fetch_messages(self, job: CrawlJob) -> CrawlResult:
+        """Fetch + parse into Posts (`telegram_crawler.go:118-161`)."""
+        self.validate_target(job.target)
+        if not self.initialized:
+            raise RuntimeError("crawler not initialized")
+
+        page = Page(id=new_id(), url=job.target.id)
+        info, messages = engine_channel_info(self.client, page, 0, self.cfg)
+        if job.from_time or job.to_time or job.limit:
+            messages = fetch_channel_messages_with_sampling(
+                self.client, info.chat_details.id, page,
+                min_post_date=job.from_time, max_post_date=job.to_time,
+                max_posts=job.limit or -1,
+                sample_size=job.sample_size)
+
+        posts = []
+        errors = []
+        for m in messages:
+            try:
+                post = parse_message(
+                    self.cfg.crawl_id, m, info.chat_details, info.supergroup,
+                    info.supergroup_info, info.message_count, info.total_views,
+                    job.target.id, self.client, self.sm, self.cfg)
+            except Exception as e:
+                logger.error("failed to convert message to post", extra={
+                    "message_id": m.id, "error": str(e)})
+                errors.append(str(e))
+                continue
+            if job.null_validator is not None:
+                result = job.null_validator.validate_post(post)
+                if not result.valid:
+                    logger.error("missing critical fields in telegram post",
+                                 extra={"errors": result.errors})
+            posts.append(post)
+        return CrawlResult(posts=posts, errors=errors)
+
+
+def register_telegram_crawler(factory: CrawlerFactory) -> None:
+    """`crawler/telegram/registers.go:8`."""
+    factory.register_crawler(PLATFORM_TELEGRAM, TelegramCrawler)

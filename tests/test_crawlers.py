@@ -1,0 +1,259 @@
+"""Platform crawler registry tests.
+
+Reference analogs: crawler/youtube/youtube_crawler_test.go,
+crawler/youtube/panic_test.go, crawler/youtube/concurrent_test.go, and the
+factory wiring in crawler/common/registrar.go.
+"""
+
+import random
+from datetime import datetime, timezone
+
+import pytest
+
+from distributed_crawler_tpu.clients import SimNetwork, SimTelegramClient
+from distributed_crawler_tpu.clients.youtube import (
+    FakeYouTubeTransport,
+    YouTubeDataClient,
+)
+from distributed_crawler_tpu.config import CrawlerConfig
+from distributed_crawler_tpu.crawlers import (
+    PLATFORM_TELEGRAM,
+    PLATFORM_YOUTUBE,
+    CrawlerFactory,
+    CrawlJob,
+    CrawlRunner,
+    CrawlTarget,
+    TelegramCrawler,
+    YouTubeCrawler,
+    apply_sampling,
+    extract_urls,
+    parse_iso8601_duration,
+    register_all_crawlers,
+    sanitize_filename,
+)
+from distributed_crawler_tpu.datamodel import NullValidator, Post
+from distributed_crawler_tpu.datamodel.youtube import YouTubeVideo
+from distributed_crawler_tpu.state import (
+    CompositeStateManager,
+    SqlConfig,
+    StateConfig,
+)
+
+
+def make_sm(tmp_path):
+    return CompositeStateManager(StateConfig(
+        crawl_id="c1", crawl_execution_id="e1", storage_root=str(tmp_path),
+        sql=SqlConfig(url=":memory:")))
+
+
+def make_yt_client():
+    transport = FakeYouTubeTransport()
+    transport.add_channel("UC_one", title="Channel One", video_count=10,
+                          subscriber_count=1000)
+    for i in range(5):
+        transport.add_video(f"vid{i}", "UC_one", title=f"Video {i}",
+                            description=f"Desc {i} https://example.com/{i}",
+                            view_count=100 * (i + 1), like_count=10 * (i + 1),
+                            comment_count=i, duration="PT3M20S")
+    client = YouTubeDataClient("key", transport)
+    client.connect()
+    return client
+
+
+class TestFactory:
+    def test_register_and_create(self):
+        factory = CrawlerFactory()
+        register_all_crawlers(factory)
+        assert isinstance(factory.get_crawler(PLATFORM_TELEGRAM),
+                          TelegramCrawler)
+        assert isinstance(factory.get_crawler(PLATFORM_YOUTUBE),
+                          YouTubeCrawler)
+
+    def test_duplicate_registration_rejected(self):
+        factory = CrawlerFactory()
+        register_all_crawlers(factory)
+        with pytest.raises(ValueError, match="already registered"):
+            factory.register_crawler(PLATFORM_YOUTUBE, YouTubeCrawler)
+
+    def test_unknown_platform(self):
+        with pytest.raises(ValueError, match="no crawler registered"):
+            CrawlerFactory().get_crawler("myspace")
+
+
+class TestHelpers:
+    def test_iso8601_duration(self):
+        assert parse_iso8601_duration("PT3M20S") == 200
+        assert parse_iso8601_duration("PT1H2M3S") == 3723
+        assert parse_iso8601_duration("P1DT1S") == 86401
+        with pytest.raises(ValueError):
+            parse_iso8601_duration("3 minutes")
+
+    def test_extract_urls_trims_and_dedups(self):
+        urls = extract_urls(
+            "see https://a.example/x, and (https://b.example/y)! "
+            "again https://a.example/x")
+        assert sorted(urls) == ["https://a.example/x", "https://b.example/y"]
+
+    def test_sanitize_filename(self):
+        assert sanitize_filename("a b/c:d") == "a_b_c_d"
+        assert len(sanitize_filename("x" * 100)) == 50
+
+    def test_apply_sampling(self):
+        posts = [Post(post_uid=str(i)) for i in range(20)]
+        sampled = apply_sampling(posts, 5, rng=random.Random(0))
+        assert len(sampled) == 5
+        assert len({p.post_uid for p in sampled}) == 5
+        # No-ops when sample >= population or disabled.
+        assert apply_sampling(posts, 0) is posts
+        assert apply_sampling(posts, 50) is posts
+
+
+class TestYouTubeCrawler:
+    def _crawler(self, tmp_path, sampling="channel", **extra):
+        c = YouTubeCrawler()
+        c.initialize({"client": make_yt_client(),
+                      "state_manager": make_sm(tmp_path),
+                      "sampling_method": sampling, **extra})
+        return c
+
+    def test_requires_client(self):
+        with pytest.raises(ValueError, match="client"):
+            YouTubeCrawler().initialize({})
+
+    def test_validate_target(self, tmp_path):
+        c = self._crawler(tmp_path)
+        with pytest.raises(ValueError, match="invalid target type"):
+            c.validate_target(CrawlTarget(id="UC_one", type="telegram"))
+        with pytest.raises(ValueError, match="empty"):
+            c.validate_target(CrawlTarget(id="", type="youtube"))
+
+    def test_get_channel_info(self, tmp_path):
+        c = self._crawler(tmp_path)
+        data = c.get_channel_info(CrawlTarget(id="UC_one", type="youtube"))
+        assert data.channel_name == "Channel One"
+        assert data.channel_engagement_data.follower_count == 1000
+        assert data.channel_url == "https://www.youtube.com/channel/UC_one"
+
+    def test_username_channel_url(self, tmp_path):
+        c = self._crawler(tmp_path)
+        c.client.transport.add_channel("@handle", title="H")
+        data = c.get_channel_info(CrawlTarget(id="@handle", type="youtube"))
+        assert data.channel_url == "https://www.youtube.com/@handle"
+
+    def test_channel_crawl_converts_and_stores(self, tmp_path):
+        c = self._crawler(tmp_path)
+        job = CrawlJob(target=CrawlTarget(id="UC_one", type="youtube"),
+                       null_validator=NullValidator("youtube"))
+        result = c.fetch_messages(job)
+        assert len(result.posts) == 5
+        post = next(p for p in result.posts if p.post_uid == "vid0")
+        assert post.platform_name == "youtube"
+        assert post.video_length == 200
+        assert post.url == "https://www.youtube.com/watch?v=vid0"
+        assert post.channel_data.channel_name == "Channel One"
+        assert post.outlinks == ["https://example.com/0"]
+        assert post.reactions == {"like": 10}
+        # engagement = likes + comments + views/100
+        assert post.engagement == 10 + 0 + 1
+
+    def test_post_level_sampling(self, tmp_path):
+        c = self._crawler(tmp_path)
+        job = CrawlJob(target=CrawlTarget(id="UC_one", type="youtube"),
+                       sample_size=2)
+        assert len(c.fetch_messages(job).posts) == 2
+
+    def test_unknown_sampling_method(self, tmp_path):
+        c = self._crawler(tmp_path, sampling="astrology")
+        with pytest.raises(ValueError, match="unknown sampling method"):
+            c.fetch_messages(CrawlJob(
+                target=CrawlTarget(id="UC_one", type="youtube")))
+
+    def test_snowball_requires_seeds(self, tmp_path):
+        c = self._crawler(tmp_path, sampling="snowball")
+        with pytest.raises(ValueError, match="no seed channels"):
+            c.fetch_messages(CrawlJob(
+                target=CrawlTarget(id="", type="youtube")))
+
+    def test_snowball_prepends_target(self, tmp_path):
+        c = self._crawler(tmp_path, sampling="snowball")
+        job = CrawlJob(target=CrawlTarget(id="UC_one", type="youtube"),
+                       limit=10)
+        result = c.fetch_messages(job)
+        assert len(result.posts) > 0
+
+    def test_duration_p0d_is_null(self, tmp_path):
+        c = self._crawler(tmp_path)
+        video = YouTubeVideo(id="v", channel_id="UC_one", title="t",
+                             duration="P0D",
+                             published_at=datetime.now(timezone.utc))
+        assert c.convert_video_to_post(video).video_length is None
+
+    def test_fallback_channel_data(self, tmp_path):
+        c = self._crawler(tmp_path)
+        video = YouTubeVideo(id="v", channel_id="UC_unknown", title="t",
+                             view_count=500, like_count=5,
+                             published_at=datetime.now(timezone.utc))
+        post = c.convert_video_to_post(video)
+        assert post.channel_data.channel_name == "UC_unknown"
+        assert post.channel_data.channel_engagement_data.views_count == 500
+
+
+class TestTelegramCrawler:
+    def _crawler(self, tmp_path):
+        net = SimNetwork()
+        from tests.test_crawl_engine import text_msg
+        net.add_channel("mychan", messages=[
+            text_msg("hello world", date=1700000000, view_count=10),
+            text_msg("see t.me/other", date=1700000100, view_count=20),
+        ], member_count=500)
+        c = TelegramCrawler()
+        c.initialize({"client": SimTelegramClient(net),
+                      "state_manager": make_sm(tmp_path),
+                      "crawler_config": CrawlerConfig(
+                          crawl_id="c1", skip_media_download=True)})
+        return c
+
+    def test_get_channel_info(self, tmp_path):
+        c = self._crawler(tmp_path)
+        data = c.get_channel_info(CrawlTarget(id="mychan", type="telegram"))
+        assert data.channel_engagement_data.follower_count == 500
+        assert data.channel_url == "https://t.me/mychan"
+
+    def test_fetch_messages(self, tmp_path):
+        c = self._crawler(tmp_path)
+        result = c.fetch_messages(CrawlJob(
+            target=CrawlTarget(id="mychan", type="telegram"),
+            null_validator=NullValidator("telegram")))
+        assert len(result.posts) == 2
+        assert all(p.platform_name == "telegram" for p in result.posts)
+
+    def test_validate_target(self, tmp_path):
+        c = self._crawler(tmp_path)
+        with pytest.raises(ValueError, match="expected: telegram"):
+            c.validate_target(CrawlTarget(id="x", type="youtube"))
+
+
+class TestCrawlRunner:
+    def test_execute_batch_with_failure_isolation(self, tmp_path):
+        factory = CrawlerFactory()
+        register_all_crawlers(factory)
+        sm = make_sm(tmp_path)
+        runner = CrawlRunner(factory, sm, base_config={
+            "client": make_yt_client(), "sampling_method": "channel"})
+        jobs = [
+            CrawlJob(target=CrawlTarget(id="UC_one", type="youtube")),
+            CrawlJob(target=CrawlTarget(id="", type="youtube")),  # invalid
+        ]
+        results = runner.execute_batch_jobs(jobs)
+        assert len(results[0].posts) == 5
+        assert results[1].errors  # failed job isolated, not raised
+        runner.close()
+
+    def test_runner_caches_crawler_instances(self, tmp_path):
+        factory = CrawlerFactory()
+        register_all_crawlers(factory)
+        runner = CrawlRunner(factory, make_sm(tmp_path), base_config={
+            "client": make_yt_client()})
+        a = runner._get_crawler(PLATFORM_YOUTUBE)
+        b = runner._get_crawler(PLATFORM_YOUTUBE)
+        assert a is b
