@@ -114,6 +114,10 @@ class YouTubeDataClient:
         self._connected = False
         # video-stats cache (`youtube_client.go:1899-1912`)
         self._video_cache: Dict[str, YouTubeVideo] = {}
+        # full-channel cache: conversion does a lookup per video, so each
+        # distinct channel must cost one channels.list call, not N
+        # (`youtube_crawler.go:548` "improved cache")
+        self._channel_cache: Dict[str, YouTubeChannel] = {}
         self._cache_lock = threading.Lock()
 
     # --- lifecycle --------------------------------------------------------
@@ -134,7 +138,11 @@ class YouTubeDataClient:
 
     # --- channels ---------------------------------------------------------
     def get_channel_info(self, channel_id: str) -> YouTubeChannel:
-        """`youtube_client.go:195`."""
+        """`youtube_client.go:195`; cached per channel ID."""
+        with self._cache_lock:
+            cached = self._channel_cache.get(channel_id)
+        if cached is not None:
+            return cached
         resp = self._call("channels", {
             "part": "snippet,statistics,contentDetails", "id": channel_id})
         items = resp.get("items") or []
@@ -143,7 +151,7 @@ class YouTubeDataClient:
         item = items[0]
         snippet = item.get("snippet") or {}
         stats = item.get("statistics") or {}
-        return YouTubeChannel(
+        channel = YouTubeChannel(
             id=item.get("id", channel_id),
             title=snippet.get("title", ""),
             description=snippet.get("description", ""),
@@ -155,6 +163,9 @@ class YouTubeDataClient:
             country=snippet.get("country", ""),
             published_at=parse_time(snippet.get("publishedAt")),
         )
+        with self._cache_lock:
+            self._channel_cache[channel_id] = channel
+        return channel
 
     # --- videos -----------------------------------------------------------
     def get_videos_from_channel(self, channel_id: str,
@@ -262,7 +273,10 @@ class YouTubeDataClient:
                             limit: int = 50) -> List[YouTubeVideo]:
         """Seed expansion via channel IDs found in video descriptions
         (`youtube_client.go:1547,1856`); only channels with more than
-        SNOWBALL_MIN_VIDEOS videos are expanded (`types.go:62`)."""
+        SNOWBALL_MIN_VIDEOS videos are expanded (`types.go:62`).
+        limit <= 0 means unlimited, matching get_videos_from_channel."""
+        if limit <= 0:
+            limit = 10 ** 9
         queue = list(seed_channel_ids)
         visited = set()
         out: List[YouTubeVideo] = []

@@ -9,6 +9,7 @@ message fetching that delegates to the engine's fetch + parse pipeline
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 from typing import Any, Dict
 
@@ -17,7 +18,6 @@ from ..config.crawler import CrawlerConfig
 from ..crawl.channelinfo import get_channel_info as engine_channel_info
 from ..datamodel import ChannelData, EngagementData
 from ..state.datamodels import Page, new_id
-from ..telegram.fetch import fetch_channel_messages_with_sampling
 from ..telegram.parsing import parse_message
 from .base import (
     PLATFORM_TELEGRAM,
@@ -92,19 +92,26 @@ class TelegramCrawler(Crawler):
         )
 
     def fetch_messages(self, job: CrawlJob) -> CrawlResult:
-        """Fetch + parse into Posts (`telegram_crawler.go:118-161`)."""
+        """Fetch + parse into Posts (`telegram_crawler.go:118-161`).
+
+        The job window/limit/sample are layered onto the crawler config so
+        the channel history is paged exactly once."""
         self.validate_target(job.target)
         if not self.initialized:
             raise RuntimeError("crawler not initialized")
 
+        cfg = dataclasses.replace(self.cfg)
+        if job.from_time is not None:
+            cfg.min_post_date = job.from_time
+        if job.to_time is not None:
+            cfg.date_between_max = job.to_time
+        if job.limit:
+            cfg.max_posts = job.limit
+        if job.sample_size:
+            cfg.sample_size = job.sample_size
+
         page = Page(id=new_id(), url=job.target.id)
-        info, messages = engine_channel_info(self.client, page, 0, self.cfg)
-        if job.from_time or job.to_time or job.limit:
-            messages = fetch_channel_messages_with_sampling(
-                self.client, info.chat_details.id, page,
-                min_post_date=job.from_time, max_post_date=job.to_time,
-                max_posts=job.limit or -1,
-                sample_size=job.sample_size)
+        info, messages = engine_channel_info(self.client, page, 0, cfg)
 
         posts = []
         errors = []

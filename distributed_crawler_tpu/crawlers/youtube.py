@@ -175,8 +175,10 @@ class YouTubeCrawler(Crawler):
 
     # -- the crawl ---------------------------------------------------------
     def fetch_messages(self, job: CrawlJob) -> CrawlResult:
-        """Sampling switch + parallel conversion; failures are contained and
-        returned as an error result (`youtube_crawler.go:245-443`)."""
+        """Sampling switch + parallel conversion (`youtube_crawler.go:245-443`).
+
+        Fetch-level failures raise (the runner's batch path isolates them);
+        per-video conversion failures are contained into `result.errors`."""
         try:
             return self._fetch_messages(job)
         except Exception as e:  # panic-recovery parity (`:247-262`)
@@ -194,8 +196,10 @@ class YouTubeCrawler(Crawler):
             videos = self.client.get_videos_from_channel(
                 job.target.id, job.from_time, job.to_time, job.limit)
         elif self.sampling_method == SAMPLING_RANDOM:
-            # Rough cap so all prefix matches get processed (`:303`).
-            sample_target = min(50, job.samples_remaining)
+            # Rough cap so all prefix matches get processed (`:303`);
+            # samples_remaining unset -> one full batch, not silently zero.
+            sample_target = (min(50, job.samples_remaining)
+                             if job.samples_remaining > 0 else 50)
             videos = self.client.get_random_videos(
                 job.from_time, job.to_time, sample_target)
         elif self.sampling_method == SAMPLING_SNOWBALL:
@@ -216,10 +220,18 @@ class YouTubeCrawler(Crawler):
                 v.channel_id) >= self.min_channel_videos]
 
         posts: List[Post] = []
+        errors: List[str] = []
         lock = threading.Lock()
 
         def convert_and_store(video: YouTubeVideo) -> None:
-            post = self.convert_video_to_post(video)
+            try:
+                post = self.convert_video_to_post(video)
+            except Exception as e:  # contain per-video failures
+                logger.error("failed to convert video", extra={
+                    "video_id": video.id, "error": str(e)})
+                with lock:
+                    errors.append(f"{video.id}: {e}")
+                return
             if job.null_validator is not None:
                 result = job.null_validator.validate_post(post)
                 if not result.valid:
@@ -240,7 +252,7 @@ class YouTubeCrawler(Crawler):
 
         if job.sample_size > 0:
             posts = apply_sampling(posts, job.sample_size)
-        return CrawlResult(posts=posts, errors=[])
+        return CrawlResult(posts=posts, errors=errors)
 
     def _channel_video_count(self, channel_id: str) -> int:
         try:

@@ -1,0 +1,5 @@
+"""Central coordinator for distributed crawls (reference `orchestrator/`)."""
+
+from .orchestrator import Orchestrator, OrchestratorConfig, WorkerInfo
+
+__all__ = ["Orchestrator", "OrchestratorConfig", "WorkerInfo"]

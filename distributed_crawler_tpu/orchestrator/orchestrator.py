@@ -1,0 +1,425 @@
+"""The orchestrator: BFS work distribution, result fan-in, worker health.
+
+Parity with the reference's `orchestrator/orchestrator.go` (633 LoC):
+- work distributor ticking every 5 s over the current BFS depth (`:160-277`)
+- work-item creation from `state.Page` (`:280-303`)
+- result handling -> page status update + new-layer creation (`:315-416`)
+- worker registry built from status messages (`:419-449`)
+- health monitor: 5-min last-seen timeout -> offline -> republish that
+  worker's items at high priority with retry counts (`:472-559`)
+- progress logging + `get_status` snapshot (`:562-633`)
+
+Tick methods (`distribute_work`, `check_worker_health`, `log_progress`) are
+public and side-effect-complete so tests drive them deterministically without
+timers; `start()` wires the same methods to background threads.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Any, Dict, List, Optional
+
+from ..bus.messages import (
+    MSG_WORK_ITEM,
+    PRIORITY_HIGH,
+    PRIORITY_MEDIUM,
+    STATUS_SUCCESS,
+    TOPIC_RESULTS,
+    TOPIC_WORK_QUEUE,
+    TOPIC_WORKER_STATUS,
+    WORKER_ACTIVE,
+    WORKER_BUSY,
+    WORKER_IDLE,
+    WORKER_OFFLINE,
+    ResultMessage,
+    StatusMessage,
+    WorkItem,
+    WorkItemConfig,
+    WorkQueueMessage,
+    WorkResult,
+)
+from ..config.crawler import CrawlerConfig
+from ..state.datamodels import (
+    PAGE_ERROR,
+    PAGE_FETCHED,
+    PAGE_PROCESSING,
+    PAGE_UNFETCHED,
+    Page,
+    utcnow,
+)
+
+logger = logging.getLogger("dct.orchestrator")
+
+
+@dataclass
+class OrchestratorConfig:
+    """Timing knobs (`orchestrator.go:163,477,498` + config/distributed.go)."""
+
+    distribute_interval_s: float = 5.0
+    health_interval_s: float = 30.0
+    worker_timeout_s: float = 300.0  # 5 min (`orchestrator.go:498`)
+    max_retries: int = 3
+    work_ttl_s: int = 3600
+
+
+@dataclass
+class WorkerInfo:
+    """Tracked per-worker state (`orchestrator.go:46-56`)."""
+
+    id: str = ""
+    status: str = WORKER_IDLE
+    last_seen: Optional[datetime] = None
+    current_work: Optional[str] = None
+    tasks_total: int = 0
+    tasks_success: int = 0
+    tasks_error: int = 0
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+
+class Orchestrator:
+    """Central coordinator (`orchestrator.go:26-106`)."""
+
+    def __init__(self, crawl_id: str, config: CrawlerConfig, bus, sm,
+                 ocfg: Optional[OrchestratorConfig] = None,
+                 clock=time.monotonic):
+        self.crawl_id = crawl_id
+        self.config = config
+        self.bus = bus
+        self.sm = sm
+        self.ocfg = ocfg or OrchestratorConfig()
+        self.clock = clock
+
+        self.workers: Dict[str, WorkerInfo] = {}
+        self.active_work: Dict[str, WorkItem] = {}
+        self.completed_work: Dict[str, WorkResult] = {}
+        self.current_depth = 0
+        self.total_work_items = 0
+        self.completed_items = 0
+        self.error_items = 0
+        self.discovered_pages = 0
+        self.crawl_completed = False
+        self._retry_counts: Dict[str, int] = {}  # page id -> retries
+
+        self._mu = threading.RLock()
+        self._running = False
+        self._threads: List[threading.Thread] = []
+        self._started_at = 0.0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, seed_urls: List[str], background: bool = True) -> None:
+        """`orchestrator.go:106-137`."""
+        with self._mu:
+            if self._running:
+                raise RuntimeError("orchestrator is already running")
+            self._running = True
+        self._started_at = self.clock()
+        self.sm.initialize(seed_urls)
+        self.bus.subscribe(TOPIC_RESULTS, self.handle_result_payload)
+        self.bus.subscribe(TOPIC_WORKER_STATUS, self.handle_status_payload)
+        if background:
+            for target, interval, name in (
+                    (self.distribute_work, self.ocfg.distribute_interval_s,
+                     "orch-distribute"),
+                    (self._health_tick, self.ocfg.health_interval_s,
+                     "orch-health")):
+                t = threading.Thread(target=self._loop,
+                                     args=(target, interval), daemon=True,
+                                     name=name)
+                t.start()
+                self._threads.append(t)
+        logger.info("orchestrator started", extra={
+            "crawl_id": self.crawl_id, "seed_count": len(seed_urls)})
+
+    def stop(self) -> None:
+        with self._mu:
+            self._running = False
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads.clear()
+        self.sm.close()
+        logger.info("orchestrator stopped", extra={"crawl_id": self.crawl_id})
+
+    @property
+    def is_running(self) -> bool:
+        with self._mu:
+            return self._running
+
+    def _loop(self, tick, interval_s: float) -> None:
+        while self.is_running:
+            time.sleep(min(interval_s, 0.05))
+            deadline = self.clock() + interval_s
+            # Coarse sleep in small slices so stop() is responsive.
+            while self.is_running and self.clock() < deadline:
+                time.sleep(0.05)
+            if not self.is_running:
+                return
+            try:
+                tick()
+            except Exception as e:
+                logger.error("orchestrator tick error: %s", e)
+
+    def _health_tick(self) -> None:
+        self.check_worker_health()
+        self.log_progress()
+
+    # -- work distribution (`orchestrator.go:182-277`) ---------------------
+    def distribute_work(self) -> int:
+        """One distribution pass; returns the number of items published.
+
+        The reference only advanced depth on an *empty* layer
+        (`orchestrator.go:189-210`), which stalls once a layer is fully
+        fetched; here a layer with no pending and no in-flight pages also
+        advances."""
+        pages = self.sm.get_layer_by_depth(self.current_depth)
+        pending = [p for p in pages
+                   if p.status == PAGE_UNFETCHED
+                   or (p.status == PAGE_ERROR and self._should_retry(p))]
+        in_flight = any(p.status == PAGE_PROCESSING for p in pages)
+
+        if not pending:
+            if in_flight:
+                return 0  # wait for results at this depth
+            max_depth = self.sm.get_max_depth()
+            if self.current_depth < max_depth:
+                self.current_depth += 1
+                logger.info("moving to next depth",
+                            extra={"new_depth": self.current_depth})
+                return 0
+            with self._mu:
+                active = len(self.active_work)
+            if active == 0 and not self.crawl_completed:
+                self._mark_crawl_completed()
+            return 0
+        published = 0
+        for page in pending:
+            item = self.create_work_item(page)
+            with self._mu:
+                self.active_work[item.id] = item
+                self.total_work_items += 1
+            page.status = PAGE_PROCESSING
+            page.timestamp = utcnow()
+            try:
+                self.sm.update_page(page)
+            except Exception as e:
+                logger.error("failed to update page status", extra={
+                    "page_url": page.url, "error": str(e)})
+            try:
+                self.bus.publish(TOPIC_WORK_QUEUE,
+                                 WorkQueueMessage.new(item, PRIORITY_MEDIUM,
+                                                      self.ocfg.work_ttl_s))
+                published += 1
+            except Exception as e:
+                # Revert on publish failure (`orchestrator.go:255-268`).
+                logger.error("failed to publish work item", extra={
+                    "work_item_id": item.id, "error": str(e)})
+                page.status = PAGE_UNFETCHED
+                self.sm.update_page(page)
+                with self._mu:
+                    self.active_work.pop(item.id, None)
+                    self.total_work_items -= 1
+        return published
+
+    def create_work_item(self, page: Page) -> WorkItem:
+        """`orchestrator.go:280-303`."""
+        c = self.config
+        cfg = WorkItemConfig(
+            storage_root=c.storage_root, concurrency=c.concurrency,
+            timeout=c.timeout, min_post_date=c.min_post_date,
+            post_recency=c.post_recency, date_between_min=c.date_between_min,
+            date_between_max=c.date_between_max, sample_size=c.sample_size,
+            max_comments=c.max_comments, max_posts=c.max_posts,
+            max_depth=c.max_depth, max_pages=c.max_pages,
+            min_users=c.min_users, crawl_label=c.crawl_label,
+            skip_media_download=c.skip_media_download,
+            youtube_api_key=c.youtube_api_key,
+            sampling_method=c.sampling_method,
+            min_channel_videos=c.min_channel_videos)
+        return WorkItem.new(page.url, page.depth, page.id, self.crawl_id,
+                            c.platform, cfg)
+
+    def _should_retry(self, page: Page) -> bool:
+        """`orchestrator.go:306-312`, with real per-page retry tracking."""
+        return self._retry_counts.get(page.id, 0) < self.ocfg.max_retries
+
+    # -- result handling (`orchestrator.go:315-416`) -----------------------
+    def handle_result_payload(self, payload: Dict[str, Any]) -> None:
+        self.handle_result(ResultMessage.from_dict(payload))
+
+    def handle_result(self, message: ResultMessage) -> None:
+        result = message.work_result
+        with self._mu:
+            item = self.active_work.pop(result.work_item_id, None)
+            if item is not None:
+                self.completed_work[result.work_item_id] = result
+                if result.status == STATUS_SUCCESS:
+                    self.completed_items += 1
+                else:
+                    self.error_items += 1
+        if item is None:
+            logger.warning("result for unknown work item", extra={
+                "work_item_id": result.work_item_id})
+            return
+
+        for page in self.sm.get_layer_by_depth(item.depth):
+            if page.url != item.url:
+                continue
+            if result.status == STATUS_SUCCESS:
+                page.status = PAGE_FETCHED
+                self._retry_counts.pop(page.id, None)
+            else:
+                page.status = PAGE_ERROR
+                page.error = result.error
+                self._retry_counts[page.id] = \
+                    self._retry_counts.get(page.id, 0) + 1
+            page.timestamp = result.completed_at or utcnow()
+            try:
+                self.sm.update_page(page)
+            except Exception as e:
+                logger.error("failed to update page after result", extra={
+                    "url": page.url, "error": str(e)})
+            break
+
+        discovered = message.discovered_pages or result.discovered_pages
+        if discovered:
+            try:
+                self._process_discovered(discovered, item.depth)
+                with self._mu:
+                    self.discovered_pages += len(discovered)
+            except Exception as e:
+                logger.error("failed to process discovered pages",
+                             extra={"error": str(e)})
+
+    def _process_discovered(self, discovered, current_depth: int) -> None:
+        """`orchestrator.go:386-416`."""
+        from ..state.datamodels import new_id
+        pages = [Page(id=new_id(), url=dp.url, depth=current_depth + 1,
+                      status=PAGE_UNFETCHED, timestamp=utcnow(),
+                      parent_id=dp.parent_id)
+                 for dp in discovered]
+        self.sm.add_layer(pages)
+        logger.info("added discovered pages as new layer", extra={
+            "count": len(pages), "new_depth": current_depth + 1})
+
+    # -- worker registry (`orchestrator.go:419-449`) -----------------------
+    def handle_status_payload(self, payload: Dict[str, Any]) -> None:
+        self.handle_status(StatusMessage.from_dict(payload))
+
+    def handle_status(self, message: StatusMessage) -> None:
+        with self._mu:
+            worker = self.workers.get(message.worker_id)
+            if worker is None:
+                worker = WorkerInfo(id=message.worker_id)
+                self.workers[message.worker_id] = worker
+            worker.status = message.status
+            worker.last_seen = message.timestamp or utcnow()
+            worker.tasks_total = message.tasks_processed
+            worker.tasks_success = message.tasks_success
+            worker.tasks_error = message.tasks_error
+            if message.current_work is not None:
+                worker.current_work = message.current_work
+
+    # -- health monitoring (`orchestrator.go:472-559`) ---------------------
+    def check_worker_health(self, now: Optional[datetime] = None) -> List[str]:
+        """Mark silent workers offline and reassign their work; returns the
+        failed worker IDs."""
+        now = now or utcnow()
+        failed: List[str] = []
+        with self._mu:
+            for worker_id, worker in self.workers.items():
+                if worker.status == WORKER_OFFLINE or worker.last_seen is None:
+                    continue
+                silence = (now - worker.last_seen).total_seconds()
+                if silence > self.ocfg.worker_timeout_s:
+                    logger.warning("worker appears to have failed", extra={
+                        "worker_id": worker_id,
+                        "last_seen": str(worker.last_seen)})
+                    worker.status = WORKER_OFFLINE
+                    failed.append(worker_id)
+        if failed:
+            self.reassign_work_from_failed_workers(failed)
+        return failed
+
+    def reassign_work_from_failed_workers(self, failed: List[str]) -> int:
+        """`orchestrator.go:520-559`."""
+        reassigned = 0
+        with self._mu:
+            items = [i for i in self.active_work.values()
+                     if i.assigned_to in failed]
+        for item in items:
+            item.assigned_to = ""
+            item.retry_count += 1
+            item.created_at = utcnow()
+            try:
+                self.bus.publish(TOPIC_WORK_QUEUE,
+                                 WorkQueueMessage.new(item, PRIORITY_HIGH,
+                                                      self.ocfg.work_ttl_s))
+                reassigned += 1
+                logger.info("reassigned work item from failed worker", extra={
+                    "work_item_id": item.id, "retry_count": item.retry_count})
+            except Exception as e:
+                logger.error("failed to reassign work item", extra={
+                    "work_item_id": item.id, "error": str(e)})
+        return reassigned
+
+    # -- progress / status (`orchestrator.go:562-633`) ---------------------
+    def _mark_crawl_completed(self) -> None:
+        self.crawl_completed = True
+        metadata = {
+            "status": "completed",
+            "end_time": utcnow().isoformat(),
+            "total_work_items": self.total_work_items,
+            "completed_items": self.completed_items,
+            "error_items": self.error_items,
+            "discovered_pages": self.discovered_pages,
+            "max_depth_reached": self.current_depth,
+            "duration_s": self.clock() - self._started_at,
+        }
+        try:
+            self.sm.update_crawl_metadata(self.crawl_id, metadata)
+        except Exception as e:
+            logger.error("failed to update crawl completion metadata",
+                         extra={"error": str(e)})
+        logger.info("crawl marked as completed", extra={"stats": metadata})
+
+    def log_progress(self) -> None:
+        with self._mu:
+            active_workers = sum(
+                1 for w in self.workers.values()
+                if w.status in (WORKER_ACTIVE, WORKER_BUSY, WORKER_IDLE))
+            logger.info("crawl progress status", extra={
+                "current_depth": self.current_depth,
+                "active_work": len(self.active_work),
+                "completed_work": self.completed_items,
+                "error_work": self.error_items,
+                "total_work": self.total_work_items,
+                "total_workers": len(self.workers),
+                "active_workers": active_workers,
+                "discovered_pages": self.discovered_pages,
+                "uptime_s": self.clock() - self._started_at})
+
+    def get_status(self) -> Dict[str, Any]:
+        """`orchestrator.go:596-633`."""
+        with self._mu:
+            return {
+                "crawl_id": self.crawl_id,
+                "is_running": self._running,
+                "platform": self.config.platform,
+                "current_depth": self.current_depth,
+                "worker_count": len(self.workers),
+                "workers": {k: vars(v).copy()
+                            for k, v in self.workers.items()},
+                "work_stats": {
+                    "active_work": len(self.active_work),
+                    "completed_work": len(self.completed_work),
+                    "total_work": self.total_work_items,
+                    "completed_items": self.completed_items,
+                    "error_items": self.error_items,
+                    "discovered_pages": self.discovered_pages,
+                },
+                "uptime_s": self.clock() - self._started_at,
+                "crawl_completed": self.crawl_completed,
+            }

@@ -1,0 +1,9 @@
+"""Distributed crawl worker (reference `worker/`).
+
+The TPU inference worker lives in `inference/worker.py`; this package is the
+crawl-side work consumer.
+"""
+
+from .worker import CrawlWorker, WorkerConfig, should_retry_error
+
+__all__ = ["CrawlWorker", "WorkerConfig", "should_retry_error"]

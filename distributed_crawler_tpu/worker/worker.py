@@ -1,0 +1,308 @@
+"""The crawl worker: consume work items, crawl, report results + heartbeats.
+
+Parity with the reference's `worker/worker.go` (477 LoC):
+- subscribe to the work queue, per-item processing with busy/idle status
+  transitions (`:164-231`)
+- 30 s heartbeat sender (`:234-252`)
+- platform dispatch: telegram -> pool-backed crawl engine, youtube -> the
+  platform crawler registry (the reference left youtube unimplemented,
+  `:403-408`; this build wires it through `crawlers.YouTubeCrawler`)
+- retryable-vs-permanent error classification by substring (`:436-456`)
+- WorkItemConfig -> CrawlerConfig conversion (`:411-433`)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from ..bus.messages import (
+    MSG_HEARTBEAT,
+    MSG_WORK_ITEM,
+    MSG_WORKER_STARTED,
+    MSG_WORKER_STOPPING,
+    STATUS_ERROR,
+    STATUS_SUCCESS,
+    TOPIC_RESULTS,
+    TOPIC_WORK_QUEUE,
+    TOPIC_WORKER_STATUS,
+    WORKER_ACTIVE,
+    WORKER_BUSY,
+    WORKER_IDLE,
+    WORKER_OFFLINE,
+    DiscoveredPage,
+    ResultMessage,
+    StatusMessage,
+    WorkItem,
+    WorkItemConfig,
+    WorkQueueMessage,
+    WorkResult,
+)
+from ..config.crawler import CrawlerConfig
+from ..crawl import runner as crawl_runner
+from ..state.datamodels import PAGE_PROCESSING, Page, new_id, utcnow
+
+logger = logging.getLogger("dct.worker")
+
+# Error-classification substrings (`worker/worker.go:436-456`).
+_PERMANENT_MARKERS = ("not found", "access denied", "forbidden")
+_RETRYABLE_MARKERS = ("connection", "timeout", "temporary")
+
+
+def should_retry_error(err: Exception) -> bool:
+    """`worker/worker.go:436-456`: permanent markers win, then retryable,
+    default retry."""
+    s = str(err).lower()
+    if any(m in s for m in _PERMANENT_MARKERS):
+        return False
+    if any(m in s for m in _RETRYABLE_MARKERS):
+        return True
+    return True
+
+
+def work_item_config_to_crawler_config(config: WorkItemConfig,
+                                       platform: str) -> CrawlerConfig:
+    """`worker/worker.go:411-433`."""
+    return CrawlerConfig(
+        storage_root=config.storage_root, concurrency=config.concurrency,
+        timeout=config.timeout, platform=platform,
+        min_post_date=config.min_post_date, post_recency=config.post_recency,
+        date_between_min=config.date_between_min,
+        date_between_max=config.date_between_max,
+        sample_size=config.sample_size, max_comments=config.max_comments,
+        max_posts=config.max_posts, max_depth=config.max_depth,
+        max_pages=config.max_pages, min_users=config.min_users,
+        crawl_label=config.crawl_label,
+        skip_media_download=config.skip_media_download,
+        youtube_api_key=config.youtube_api_key,
+        sampling_method=config.sampling_method or "channel",
+        min_channel_videos=config.min_channel_videos)
+
+
+@dataclass
+class WorkerConfig:
+    worker_id: str = ""
+    heartbeat_s: float = 30.0  # `worker.go:237`
+
+
+class CrawlWorker:
+    """Work consumer (`worker/worker.go:28-96`)."""
+
+    def __init__(self, worker_id: str, config: CrawlerConfig, bus, sm,
+                 wcfg: Optional[WorkerConfig] = None,
+                 youtube_crawler=None):
+        if not worker_id:
+            raise ValueError("worker ID cannot be empty")
+        self.id = worker_id
+        self.config = config
+        self.bus = bus
+        self.sm = sm
+        self.wcfg = wcfg or WorkerConfig(worker_id=worker_id)
+        self.youtube_crawler = youtube_crawler
+
+        self.tasks_processed = 0
+        self.tasks_success = 0
+        self.tasks_error = 0
+        self.current_work: Optional[WorkItem] = None
+        self._mu = threading.RLock()
+        self._running = False
+        self._threads: List[threading.Thread] = []
+        self._started_at = time.monotonic()
+
+    # -- lifecycle (`worker.go:96-160`) ------------------------------------
+    def start(self, background: bool = True) -> None:
+        with self._mu:
+            if self._running:
+                raise RuntimeError("worker is already running")
+            self._running = True
+        self._started_at = time.monotonic()
+        self.bus.subscribe(TOPIC_WORK_QUEUE, self.handle_work_payload)
+        if background:
+            t = threading.Thread(target=self._heartbeat_loop, daemon=True,
+                                 name=f"worker-heartbeat-{self.id}")
+            t.start()
+            self._threads.append(t)
+        self.send_status_update(MSG_WORKER_STARTED, WORKER_ACTIVE)
+        logger.info("worker started", extra={"worker_id": self.id})
+
+    def stop(self) -> None:
+        with self._mu:
+            self._running = False
+        self.send_status_update(MSG_WORKER_STOPPING, WORKER_OFFLINE)
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads.clear()
+        self.sm.close()
+        logger.info("worker stopped", extra={"worker_id": self.id})
+
+    @property
+    def is_running(self) -> bool:
+        with self._mu:
+            return self._running
+
+    # -- heartbeats (`worker.go:234-252`) ----------------------------------
+    def _heartbeat_loop(self) -> None:
+        while self.is_running:
+            deadline = time.monotonic() + self.wcfg.heartbeat_s
+            while self.is_running and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if not self.is_running:
+                return
+            self.send_status_update(MSG_HEARTBEAT, self.determine_status())
+
+    def determine_status(self) -> str:
+        if not self.is_running:
+            return WORKER_OFFLINE
+        with self._mu:
+            return WORKER_BUSY if self.current_work is not None else WORKER_IDLE
+
+    def send_status_update(self, message_type: str, status: str) -> None:
+        """`worker.go:255-295`."""
+        with self._mu:
+            current = self.current_work.id if self.current_work else None
+        msg = StatusMessage.new(
+            self.id, message_type, status,
+            tasks_processed=self.tasks_processed,
+            tasks_success=self.tasks_success, tasks_error=self.tasks_error,
+            uptime_s=time.monotonic() - self._started_at)
+        msg.current_work = current
+        try:
+            self.bus.publish(TOPIC_WORKER_STATUS, msg)
+        except Exception as e:
+            logger.error("failed to send status update", extra={
+                "message_type": message_type, "error": str(e)})
+
+    # -- work handling (`worker.go:164-231`) -------------------------------
+    def handle_work_payload(self, payload: Dict[str, Any]) -> None:
+        self.handle_work_message(WorkQueueMessage.from_dict(payload))
+
+    def handle_work_message(self, message: WorkQueueMessage) -> None:
+        if message.message_type != MSG_WORK_ITEM:
+            logger.debug("ignoring non-work message",
+                         extra={"message_type": message.message_type})
+            return
+        if message.expired():
+            logger.warning("dropping expired work item", extra={
+                "work_item_id": message.work_item.id})
+            return
+        item = message.work_item
+        with self._mu:
+            self.current_work = item
+        start = time.monotonic()
+        self.send_status_update(MSG_HEARTBEAT, WORKER_BUSY)
+        try:
+            result = self.process_work_item(item)
+            with self._mu:
+                if result.status == STATUS_SUCCESS:
+                    self.tasks_success += 1
+                else:
+                    self.tasks_error += 1
+                self.tasks_processed += 1
+        finally:
+            with self._mu:
+                self.current_work = None
+        try:
+            self.bus.publish(TOPIC_RESULTS,
+                             ResultMessage.new(result,
+                                               result.discovered_pages))
+        except Exception as e:
+            logger.error("failed to publish result", extra={
+                "work_item_id": item.id, "error": str(e)})
+            raise
+        self.send_status_update(MSG_HEARTBEAT, WORKER_IDLE)
+        logger.info("work item processed and result sent", extra={
+            "work_item_id": item.id, "status": result.status,
+            "processing_time_s": time.monotonic() - start})
+
+    # -- processing (`worker.go:302-408`) ----------------------------------
+    def process_work_item(self, item: WorkItem) -> WorkResult:
+        start = time.monotonic()
+        page = Page(id=item.parent_id or new_id(), url=item.url,
+                    depth=item.depth, status=PAGE_PROCESSING,
+                    timestamp=utcnow(), parent_id=item.parent_id)
+        discovered: List[Page] = []
+        message_count = 0
+        error: Optional[Exception] = None
+        try:
+            if item.platform == "telegram":
+                discovered = self._process_telegram(page, item)
+            elif item.platform == "youtube":
+                discovered = self._process_youtube(page, item)
+            else:
+                raise ValueError(f"unsupported platform: {item.platform}")
+            message_count = sum(1 for m in page.messages
+                                if m.status == "fetched")
+        except Exception as e:
+            error = e
+            logger.error("failed to process work item", extra={
+                "work_item_id": item.id, "error": str(e)})
+
+        result = WorkResult(
+            work_item_id=item.id, worker_id=self.id, processed_url=item.url,
+            message_count=message_count,
+            processing_time_s=time.monotonic() - start,
+            completed_at=utcnow(),
+            metadata={"platform": item.platform, "depth": item.depth})
+        if error is not None:
+            result.status = STATUS_ERROR
+            result.error = str(error)
+            result.retry_recommended = should_retry_error(error)
+        else:
+            result.status = STATUS_SUCCESS
+            result.discovered_pages = [
+                DiscoveredPage(url=p.url, parent_id=p.parent_id,
+                               depth=p.depth, platform=item.platform)
+                for p in discovered]
+        return result
+
+    def _process_telegram(self, page: Page, item: WorkItem) -> List[Page]:
+        """`worker.go:384-401`: pool-backed crawl engine run."""
+        cfg = work_item_config_to_crawler_config(item.config, "telegram")
+        cfg.crawl_id = item.crawl_id or self.config.crawl_id
+        return crawl_runner.run_for_channel_with_pool(
+            page, item.config.storage_root, self.sm, cfg)
+
+    def _process_youtube(self, page: Page, item: WorkItem) -> List[Page]:
+        """YouTube in distributed mode — implemented here via the crawler
+        registry (the reference returned 'not yet implemented',
+        `worker.go:403-408`)."""
+        if self.youtube_crawler is None:
+            raise ValueError(
+                "YouTube processing requires a youtube_crawler instance")
+        from ..crawlers.base import CrawlJob, CrawlTarget
+        cfg = item.config
+        job = CrawlJob(
+            target=CrawlTarget(id=item.url, type="youtube"),
+            from_time=cfg.min_post_date or cfg.date_between_min,
+            to_time=cfg.date_between_max,
+            limit=cfg.max_posts if cfg.max_posts > 0 else 0,
+            sample_size=cfg.sample_size)
+        result = self.youtube_crawler.fetch_messages(job)
+        page.messages = []
+        discovered: List[Page] = []
+        seen = {item.url}
+        for post in result.posts:
+            for link in post.outlinks:
+                if link not in seen:
+                    seen.add(link)
+                    discovered.append(Page(
+                        id=new_id(), url=link, depth=page.depth + 1,
+                        parent_id=page.id))
+        return discovered
+
+    # -- status (`worker.go:459-477`) --------------------------------------
+    def get_status(self) -> Dict[str, Any]:
+        with self._mu:
+            return {
+                "worker_id": self.id,
+                "is_running": self._running,
+                "platform": self.config.platform,
+                "tasks_processed": self.tasks_processed,
+                "tasks_success": self.tasks_success,
+                "tasks_error": self.tasks_error,
+                "uptime_seconds": time.monotonic() - self._started_at,
+            }

@@ -181,6 +181,32 @@ class TestYouTubeCrawler:
         result = c.fetch_messages(job)
         assert len(result.posts) > 0
 
+    def test_snowball_zero_limit_means_unlimited(self, tmp_path):
+        c = self._crawler(tmp_path, sampling="snowball")
+        job = CrawlJob(target=CrawlTarget(id="UC_one", type="youtube"))
+        assert len(c.fetch_messages(job).posts) == 5
+
+    def test_random_defaults_to_full_batch(self, tmp_path):
+        # samples_remaining unset must not silently request zero videos.
+        c = self._crawler(tmp_path, sampling="random")
+        requested = []
+        original = c.client.get_random_videos
+        c.client.get_random_videos = (
+            lambda f, t, limit: (requested.append(limit), original(f, t, limit))[1])
+        c.fetch_messages(CrawlJob(target=CrawlTarget(id="", type="youtube")))
+        assert requested == [50]
+        # An explicit samples_remaining still caps the batch.
+        c.fetch_messages(CrawlJob(target=CrawlTarget(id="", type="youtube"),
+                                  samples_remaining=7))
+        assert requested[-1] == 7
+
+    def test_channel_info_cached_per_channel(self, tmp_path):
+        c = self._crawler(tmp_path)
+        c.fetch_messages(CrawlJob(
+            target=CrawlTarget(id="UC_one", type="youtube")))
+        calls = [e for e, _ in c.client.transport.calls if e == "channels"]
+        assert len(calls) == 1  # 5 videos, one channels.list lookup
+
     def test_duration_p0d_is_null(self, tmp_path):
         c = self._crawler(tmp_path)
         video = YouTubeVideo(id="v", channel_id="UC_one", title="t",

@@ -1,0 +1,334 @@
+"""Orchestrator/worker coordination tests.
+
+Reference analogs: orchestrator/orchestrator_test.go, worker/worker_test.go,
+and the full work-item -> result -> discovered-pages round trip of
+distributed/integration_test.go (627 LoC) — run here over the in-memory bus
+with the simulated Telegram network, no broker and no real network.
+"""
+
+from datetime import timedelta
+
+import pytest
+
+from distributed_crawler_tpu.bus import InMemoryBus
+from distributed_crawler_tpu.bus.messages import (
+    MSG_HEARTBEAT,
+    MSG_WORKER_STARTED,
+    PRIORITY_HIGH,
+    STATUS_ERROR,
+    STATUS_SUCCESS,
+    TOPIC_WORK_QUEUE,
+    WORKER_BUSY,
+    WORKER_IDLE,
+    WORKER_OFFLINE,
+    ResultMessage,
+    StatusMessage,
+    WorkItem,
+    WorkItemConfig,
+    WorkQueueMessage,
+    WorkResult,
+)
+from distributed_crawler_tpu.clients import SimNetwork, SimTelegramClient
+from distributed_crawler_tpu.clients.pool import ConnectionPool
+from distributed_crawler_tpu.config import CrawlerConfig
+from distributed_crawler_tpu.crawl import runner as crawl_runner
+from distributed_crawler_tpu.orchestrator import Orchestrator, OrchestratorConfig
+from distributed_crawler_tpu.state import (
+    CompositeStateManager,
+    SqlConfig,
+    StateConfig,
+)
+from distributed_crawler_tpu.state.datamodels import utcnow
+from distributed_crawler_tpu.worker import (
+    CrawlWorker,
+    WorkerConfig,
+    should_retry_error,
+)
+from distributed_crawler_tpu.worker.worker import (
+    work_item_config_to_crawler_config,
+)
+from tests.test_crawl_engine import text_msg
+
+
+def make_sm(tmp_path, crawl_id="c1", sub=""):
+    return CompositeStateManager(StateConfig(
+        crawl_id=crawl_id, crawl_execution_id="e1",
+        storage_root=str(tmp_path / (sub or "s")),
+        sql=SqlConfig(url=":memory:")))
+
+
+def make_cfg(**kw):
+    base = dict(crawl_id="c1", platform="telegram", skip_media_download=True,
+                sampling_method="channel")
+    base.update(kw)
+    return CrawlerConfig(**base)
+
+
+@pytest.fixture
+def telegram_net():
+    net = SimNetwork()
+    net.add_channel("chana", messages=[
+        text_msg("hello t.me/chanb", date=1700000000, view_count=5),
+    ], member_count=100)
+    net.add_channel("chanb", messages=[
+        text_msg("plain message", date=1700000100, view_count=3),
+    ], member_count=200)
+    yield net
+    crawl_runner.shutdown_connection_pool()
+
+
+def install_pool(net, n=1):
+    crawl_runner.shutdown_connection_pool()
+    clients = {f"conn{i}": SimTelegramClient(net, conn_id=f"conn{i}")
+               for i in range(n)}
+    crawl_runner.init_connection_pool(ConnectionPool.for_testing(clients))
+
+
+class TestErrorClassification:
+    def test_permanent_markers(self):
+        assert not should_retry_error(ValueError("channel not found"))
+        assert not should_retry_error(ValueError("ACCESS DENIED"))
+        assert not should_retry_error(ValueError("403 Forbidden"))
+
+    def test_retryable_markers_and_default(self):
+        assert should_retry_error(ValueError("connection reset"))
+        assert should_retry_error(ValueError("request timeout"))
+        assert should_retry_error(ValueError("some unknown error"))
+
+
+class TestConfigConversion:
+    def test_round_trip_fields(self):
+        wic = WorkItemConfig(storage_root="/tmp/x", concurrency=4,
+                             sample_size=9, max_posts=50, crawl_label="lbl",
+                             skip_media_download=True,
+                             sampling_method="snowball")
+        cfg = work_item_config_to_crawler_config(wic, "youtube")
+        assert cfg.platform == "youtube"
+        assert cfg.storage_root == "/tmp/x"
+        assert cfg.concurrency == 4
+        assert cfg.sample_size == 9
+        assert cfg.max_posts == 50
+        assert cfg.crawl_label == "lbl"
+        assert cfg.skip_media_download
+        assert cfg.sampling_method == "snowball"
+
+    def test_empty_sampling_method_defaults_to_channel(self):
+        cfg = work_item_config_to_crawler_config(WorkItemConfig(), "telegram")
+        assert cfg.sampling_method == "channel"
+
+
+class TestOrchestrator:
+    def test_distributes_unfetched_pages(self, tmp_path):
+        bus = InMemoryBus()
+        published = []
+        bus.subscribe(TOPIC_WORK_QUEUE, published.append)
+        orch = Orchestrator("c1", make_cfg(), bus, make_sm(tmp_path))
+        orch.start(["chana", "chanb"], background=False)
+        assert orch.distribute_work() == 2
+        assert len(published) == 2
+        urls = {p["work_item"]["url"] for p in published}
+        assert urls == {"chana", "chanb"}
+        # Pages are now processing: nothing further to distribute.
+        assert orch.distribute_work() == 0
+        status = orch.get_status()
+        assert status["work_stats"]["active_work"] == 2
+
+    def test_result_updates_page_and_creates_layer(self, tmp_path):
+        bus = InMemoryBus()
+        orch = Orchestrator("c1", make_cfg(), bus, make_sm(tmp_path))
+        orch.start(["chana"], background=False)
+        orch.distribute_work()
+        item = next(iter(orch.active_work.values()))
+        result = WorkResult(
+            work_item_id=item.id, worker_id="w1", status=STATUS_SUCCESS,
+            processed_url=item.url, message_count=3, completed_at=utcnow())
+        from distributed_crawler_tpu.bus.messages import DiscoveredPage
+        orch.handle_result(ResultMessage.new(
+            result, [DiscoveredPage(url="chanb", parent_id=item.parent_id,
+                                    depth=1, platform="telegram")]))
+        assert not orch.active_work
+        assert orch.completed_items == 1
+        page = orch.sm.get_layer_by_depth(0)[0]
+        assert page.status == "fetched"
+        next_layer = orch.sm.get_layer_by_depth(1)
+        assert [p.url for p in next_layer] == ["chanb"]
+
+    def test_error_result_marks_page_error(self, tmp_path):
+        bus = InMemoryBus()
+        orch = Orchestrator("c1", make_cfg(), bus, make_sm(tmp_path))
+        orch.start(["chana"], background=False)
+        orch.distribute_work()
+        item = next(iter(orch.active_work.values()))
+        orch.handle_result(ResultMessage.new(WorkResult(
+            work_item_id=item.id, worker_id="w1", status=STATUS_ERROR,
+            error="boom", processed_url=item.url, completed_at=utcnow())))
+        page = orch.sm.get_layer_by_depth(0)[0]
+        assert page.status == "error" and page.error == "boom"
+        assert orch.error_items == 1
+        # Error pages are retried (with fresh work items) until max_retries.
+        assert orch.distribute_work() == 1
+
+    def test_retry_exhaustion(self, tmp_path):
+        bus = InMemoryBus()
+        orch = Orchestrator("c1", make_cfg(), bus, make_sm(tmp_path),
+                            OrchestratorConfig(max_retries=2))
+        orch.start(["chana"], background=False)
+        for _ in range(3):
+            if orch.distribute_work() == 0:
+                break
+            item = next(iter(orch.active_work.values()))
+            orch.handle_result(ResultMessage.new(WorkResult(
+                work_item_id=item.id, worker_id="w1", status=STATUS_ERROR,
+                error="boom", processed_url=item.url, completed_at=utcnow())))
+        # After 2 retries the page is abandoned.
+        assert orch.distribute_work() == 0
+
+    def test_worker_registry_from_status(self, tmp_path):
+        orch = Orchestrator("c1", make_cfg(), InMemoryBus(),
+                            make_sm(tmp_path))
+        orch.handle_status(StatusMessage.new(
+            "w1", MSG_WORKER_STARTED, WORKER_IDLE, tasks_processed=5,
+            tasks_success=4, tasks_error=1))
+        assert orch.workers["w1"].status == WORKER_IDLE
+        assert orch.workers["w1"].tasks_total == 5
+
+    def test_health_monitor_reassigns_work(self, tmp_path):
+        bus = InMemoryBus()
+        republished = []
+        bus.subscribe(TOPIC_WORK_QUEUE, republished.append)
+        orch = Orchestrator("c1", make_cfg(), bus, make_sm(tmp_path))
+        orch.start(["chana"], background=False)
+        orch.distribute_work()
+        republished.clear()
+        item = next(iter(orch.active_work.values()))
+        item.assigned_to = "w1"
+        # Worker w1 heartbeats, then goes silent for > timeout.
+        old = utcnow() - timedelta(minutes=10)
+        msg = StatusMessage.new("w1", MSG_HEARTBEAT, WORKER_BUSY)
+        msg.timestamp = old
+        orch.handle_status(msg)
+        failed = orch.check_worker_health()
+        assert failed == ["w1"]
+        assert orch.workers["w1"].status == WORKER_OFFLINE
+        assert len(republished) == 1
+        assert republished[0]["priority"] == PRIORITY_HIGH
+        assert republished[0]["work_item"]["retry_count"] == 1
+        # Second sweep: already offline, not re-reassigned.
+        assert orch.check_worker_health() == []
+
+    def test_completion_when_layers_exhausted(self, tmp_path):
+        bus = InMemoryBus()
+        orch = Orchestrator("c1", make_cfg(), bus, make_sm(tmp_path))
+        orch.start(["chana"], background=False)
+        orch.distribute_work()
+        item = next(iter(orch.active_work.values()))
+        orch.handle_result(ResultMessage.new(WorkResult(
+            work_item_id=item.id, worker_id="w1", status=STATUS_SUCCESS,
+            processed_url=item.url, completed_at=utcnow())))
+        # Walk depths past the end; completion fires once active work drains.
+        for _ in range(4):
+            orch.distribute_work()
+        assert orch.crawl_completed
+
+
+class TestWorker:
+    def test_processes_telegram_work_item(self, tmp_path, telegram_net):
+        install_pool(telegram_net)
+        bus = InMemoryBus()
+        results = []
+        bus.subscribe("crawl-results", results.append)
+        worker = CrawlWorker("w1", make_cfg(), bus, make_sm(tmp_path))
+        worker.start(background=False)
+        item = WorkItem.new("chana", 0, "p0", "c1", "telegram",
+                            WorkItemConfig(storage_root=str(tmp_path)))
+        worker.handle_work_message(WorkQueueMessage.new(item))
+        assert len(results) == 1
+        wr = WorkResult.from_dict(results[0]["work_result"])
+        assert wr.status == STATUS_SUCCESS
+        assert wr.message_count == 1
+        discovered = results[0]["discovered_pages"]
+        assert [d["url"] for d in discovered] == ["chanb"]
+        assert worker.tasks_success == 1
+
+    def test_error_result_on_unknown_channel(self, tmp_path, telegram_net):
+        install_pool(telegram_net)
+        bus = InMemoryBus(max_redeliveries=0)
+        results = []
+        bus.subscribe("crawl-results", results.append)
+        worker = CrawlWorker("w1", make_cfg(), bus, make_sm(tmp_path))
+        worker.start(background=False)
+        item = WorkItem.new("nochan", 0, "p0", "c1", "telegram",
+                            WorkItemConfig(storage_root=str(tmp_path)))
+        worker.handle_work_message(WorkQueueMessage.new(item))
+        wr = WorkResult.from_dict(results[0]["work_result"])
+        assert wr.status == STATUS_ERROR
+        assert worker.tasks_error == 1
+
+    def test_ignores_non_work_and_expired_messages(self, tmp_path,
+                                                   telegram_net):
+        install_pool(telegram_net)
+        bus = InMemoryBus()
+        results = []
+        bus.subscribe("crawl-results", results.append)
+        worker = CrawlWorker("w1", make_cfg(), bus, make_sm(tmp_path))
+        worker.start(background=False)
+        msg = WorkQueueMessage.new(WorkItem.new(
+            "chana", 0, "p0", "c1", "telegram", WorkItemConfig()))
+        msg.message_type = "poison_pill"
+        worker.handle_work_message(msg)
+        expired = WorkQueueMessage.new(WorkItem.new(
+            "chana", 0, "p0", "c1", "telegram", WorkItemConfig()))
+        expired.timestamp = utcnow() - timedelta(hours=2)
+        worker.handle_work_message(expired)
+        assert results == []
+
+    def test_status_transitions_on_bus(self, tmp_path, telegram_net):
+        install_pool(telegram_net)
+        bus = InMemoryBus()
+        statuses = []
+        bus.subscribe("worker-status", statuses.append)
+        worker = CrawlWorker("w1", make_cfg(), bus, make_sm(tmp_path))
+        worker.start(background=False)
+        assert statuses[0]["message_type"] == MSG_WORKER_STARTED
+        item = WorkItem.new("chana", 0, "p0", "c1", "telegram",
+                            WorkItemConfig(storage_root=str(tmp_path)))
+        worker.handle_work_message(WorkQueueMessage.new(item))
+        seq = [s["status"] for s in statuses]
+        assert WORKER_BUSY in seq and seq[-1] == WORKER_IDLE
+
+    def test_empty_worker_id_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            CrawlWorker("", make_cfg(), InMemoryBus(), make_sm(tmp_path))
+
+
+class TestRoundTrip:
+    """Full orchestrator <-> worker integration over one bus
+    (`distributed/integration_test.go:109-180`)."""
+
+    def test_bfs_crawl_completes(self, tmp_path, telegram_net):
+        install_pool(telegram_net)
+        bus = InMemoryBus()  # sync: publish delivers inline
+        orch_sm = make_sm(tmp_path, sub="orch")
+        worker_sm = make_sm(tmp_path, sub="wrk")
+        cfg = make_cfg(max_depth=3)
+        orch = Orchestrator("c1", cfg, bus, orch_sm)
+        worker = CrawlWorker("w1", cfg, bus, worker_sm)
+        orch.start(["chana"], background=False)
+        worker.start(background=False)
+
+        # Tick the distributor until the crawl completes: each tick publishes
+        # work; the sync bus runs the worker inline, which publishes results
+        # back into the orchestrator before distribute_work returns.
+        for _ in range(12):
+            orch.distribute_work()
+            if orch.crawl_completed:
+                break
+        assert orch.crawl_completed
+        assert orch.completed_items == 2  # chana + discovered chanb
+        assert orch.error_items == 0
+        # chanb was discovered at depth 1 via chana's outlink.
+        assert [p.url for p in orch_sm.get_layer_by_depth(1)] == ["chanb"]
+        assert all(p.status == "fetched"
+                   for p in orch_sm.get_layer_by_depth(0))
+        # Worker registry saw heartbeats from w1.
+        assert "w1" in orch.workers
