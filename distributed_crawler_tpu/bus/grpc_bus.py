@@ -115,6 +115,17 @@ class GrpcBusServer:
         with self._lock:
             self._handlers.setdefault(topic, []).append(handler)
 
+    def publish(self, topic: str, payload: Any) -> None:
+        """Local publish: same fan-out as a remote Publish RPC, so the host
+        process (e.g. the orchestrator) can use the server as its bus."""
+        if isinstance(payload, bytes):
+            data = payload
+        else:
+            if hasattr(payload, "to_dict"):
+                payload = payload.to_dict()
+            data = json.dumps(payload, ensure_ascii=False).encode("utf-8")
+        self._publish_rpc(_encode_envelope(topic, data), None)
+
     def enable_pull(self, topic: str) -> None:
         with self._lock:
             self._pull_queues.setdefault(topic, queue.Queue())
@@ -162,3 +173,80 @@ class GrpcBusClient:
 
     def close(self) -> None:
         self._channel.close()
+
+
+class RemoteBus:
+    """InMemoryBus-shaped facade over a GrpcBusClient for worker processes.
+
+    `publish` is a Publish RPC to the host; `subscribe` starts a puller
+    thread streaming the topic's queue and dispatching to local handlers
+    (competing consumers: multiple workers pulling one topic split the
+    stream — exactly the work-queue semantics of the reference's pubsub,
+    `distributed/pubsub.go:149-254`).  Handler errors are retried
+    `max_redeliveries` times, then dropped.
+    """
+
+    def __init__(self, target: str = "127.0.0.1:50551",
+                 max_redeliveries: int = 3):
+        self._client = GrpcBusClient(target)
+        self.max_redeliveries = max_redeliveries
+        self._handlers: Dict[str, list] = {}
+        self._threads: Dict[str, threading.Thread] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+
+    def publish(self, topic: str, payload: Any) -> None:
+        self._client.publish(topic, payload)
+
+    def subscribe(self, topic: str,
+                  handler: Callable[[Dict[str, Any]], None]) -> None:
+        with self._lock:
+            self._handlers.setdefault(topic, []).append(handler)
+            if topic in self._threads:
+                return
+            t = threading.Thread(target=self._pull_loop, args=(topic,),
+                                 daemon=True, name=f"dct-bus-pull-{topic}")
+            self._threads[topic] = t
+            t.start()
+
+    def _pull_loop(self, topic: str) -> None:
+        while not self._stop.is_set():
+            try:
+                for frame in self._client.pull(topic):
+                    if self._stop.is_set():
+                        return
+                    self._dispatch(topic, frame)
+            except grpc.RpcError as e:
+                if self._stop.is_set():
+                    return
+                logger.warning("pull stream for %s dropped (%s); "
+                               "reconnecting", topic, e.code()
+                               if hasattr(e, "code") else e)
+                self._stop.wait(1.0)
+
+    def _dispatch(self, topic: str, frame: bytes) -> None:
+        try:
+            payload = json.loads(frame.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            logger.error("dropping undecodable message on %s", topic)
+            return
+        with self._lock:
+            handlers = list(self._handlers.get(topic, []))
+        for handler in handlers:
+            for attempt in range(self.max_redeliveries + 1):
+                try:
+                    handler(payload)
+                    break
+                except Exception as e:
+                    logger.warning("handler error on %s (attempt %d/%d): %s",
+                                   topic, attempt + 1,
+                                   self.max_redeliveries + 1, e)
+
+    def start(self) -> None:
+        return None  # threads start on subscribe
+
+    def close(self) -> None:
+        self._stop.set()
+        self._client.close()
+        for t in self._threads.values():
+            t.join(timeout=2.0)

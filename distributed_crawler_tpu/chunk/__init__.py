@@ -1,0 +1,5 @@
+"""File-combining pipeline (reference `chunk/main.go`)."""
+
+from .chunker import Chunker, FileEntry, ProcessedMap
+
+__all__ = ["Chunker", "FileEntry", "ProcessedMap"]

@@ -1,0 +1,312 @@
+"""File-combining pipeline to cut blob-storage operation counts.
+
+Parity with the reference's `chunk/main.go` (680 LoC):
+- multi-stage pipeline: recovery scanner, directory watcher, batcher,
+  consumer (`:105-150`); the reference's fsnotify watcher + event processor
+  pair becomes one polling scanner thread here (no inotify dependency, same
+  at-least-once semantics since the recovery scanner re-lists the dir anyway)
+- batch by trigger size (170 MiB) with hard cap (200 MiB) + flush timeout
+  (`:84-103,292-347`)
+- double-buffered seen-map with upload-gated rotation so a file can't be
+  evicted from both maps before it was uploaded (`processedMap`, `:46-70,
+  433-482`)
+- combine -> upload via `sm.upload_combined_file` with one 30 s retry ->
+  delete sources (`:349-421,510-530`)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+logger = logging.getLogger("dct.chunk")
+
+DEFAULT_TRIGGER_SIZE = 170 * 1024 * 1024  # MiB (`main.go:800` flag default)
+DEFAULT_HARD_CAP = 200 * 1024 * 1024
+DEFAULT_BATCH_TIMEOUT_S = 300.0  # 5 min (`chunk/main.go:95`)
+ROTATE_THRESHOLD = 100_000  # entries before map rotation (`main.go:477-482`)
+UPLOAD_RETRY_DELAY_S = 30.0
+
+
+@dataclass
+class FileEntry:
+    path: str
+    size: int
+
+
+class ProcessedMap:
+    """Double-buffered dedup set: rotation drops the oldest generation so
+    memory stays bounded; `seen` consults both (`chunk/main.go:63-70`)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.current: set = set()
+        self.previous: set = set()
+
+    def seen(self, path: str) -> bool:
+        with self._lock:
+            return path in self.current or path in self.previous
+
+    def mark(self, path: str) -> None:
+        with self._lock:
+            self.current.add(path)
+
+    def rotate(self) -> None:
+        with self._lock:
+            self.previous = self.current
+            self.current = set()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self.current) + len(self.previous)
+
+
+class Chunker:
+    """Watch a directory of JSONL shards, combine them into ~trigger-size
+    files, upload, delete sources."""
+
+    def __init__(self, sm, temp_dir: str, watch_dir: str, combine_dir: str,
+                 trigger_size: int = DEFAULT_TRIGGER_SIZE,
+                 hard_cap: int = DEFAULT_HARD_CAP,
+                 batch_timeout_s: float = DEFAULT_BATCH_TIMEOUT_S,
+                 scan_interval_s: float = 1.0,
+                 recovery_interval_s: float = 60.0):
+        self.sm = sm
+        self.temp_dir = temp_dir
+        self.watch_dir = watch_dir
+        self.combine_dir = combine_dir
+        self.trigger_size = trigger_size
+        self.hard_cap = hard_cap
+        self.batch_timeout_s = batch_timeout_s
+        self.scan_interval_s = scan_interval_s
+        self.recovery_interval_s = recovery_interval_s
+
+        self._file_q: "queue.Queue[Optional[FileEntry]]" = queue.Queue(10000)
+        self._jobs_q: "queue.Queue[Optional[List[FileEntry]]]" = \
+            queue.Queue(100)
+        self.processed = ProcessedMap()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self.total_upload_size = 0
+        self.posts_uploaded = 0
+        # Rotation guards (`chunk/main.go:48-51`): second rotation gated on a
+        # successful upload since the first.
+        self._last_rotation: float = 0.0
+        self._last_upload: float = 0.0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        for d in (self.watch_dir, self.combine_dir, self.temp_dir):
+            os.makedirs(d, exist_ok=True)
+        for target, name in ((self._watch_loop, "chunk-watch"),
+                             (self._recovery_loop, "chunk-recovery"),
+                             (self._batch_loop, "chunk-batch"),
+                             (self._consume_loop, "chunk-consume")):
+            t = threading.Thread(target=target, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+        logger.info("chunker started", extra={
+            "trigger_mb": self.trigger_size // (1024 * 1024),
+            "hardcap_mb": self.hard_cap // (1024 * 1024)})
+
+    def shutdown(self, timeout_s: float = 30.0) -> None:
+        """Graceful drain: stop watching, flush the partial batch, finish
+        uploads (`chunk/main.go:160-167`)."""
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        self._file_q.put(None)  # sentinel flushes batcher
+        deadline = time.monotonic() + timeout_s
+        for t in self._threads:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+        self._threads.clear()
+
+    # -- stage 1+2: polling watcher (fsnotify + event processor) -----------
+    def _scan_once(self) -> int:
+        found = 0
+        try:
+            names = os.listdir(self.watch_dir)
+        except OSError as e:
+            logger.error("watch dir scan failed: %s", e)
+            return 0
+        for name in names:
+            if not name.endswith(".jsonl"):
+                continue
+            path = os.path.join(self.watch_dir, name)
+            if self.processed.seen(path):
+                continue
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            self.processed.mark(path)
+            if len(self.processed) >= ROTATE_THRESHOLD and \
+                    self._may_rotate():
+                self.processed.rotate()
+                self._last_rotation = time.monotonic()
+            self._file_q.put(FileEntry(path=path, size=size))
+            found += 1
+        return found
+
+    def _may_rotate(self) -> bool:
+        """`chunk/main.go:477-482`: second rotation requires an upload since
+        the first, so unuploaded entries can't be forgotten twice."""
+        return self._last_rotation == 0.0 or \
+            self._last_upload > self._last_rotation
+
+    def _watch_loop(self) -> None:
+        while not self._stop.is_set():
+            self._scan_once()
+            self._stop.wait(self.scan_interval_s)
+
+    # -- recovery scanner (`chunk/main.go:238-290,542-658`) ----------------
+    def _recovery_loop(self) -> None:
+        while not self._stop.is_set():
+            self.recover_combine_dir()
+            self._stop.wait(self.recovery_interval_s)
+
+    def recover_combine_dir(self) -> None:
+        """Re-upload combined files stranded by a crash before upload."""
+        try:
+            names = os.listdir(self.combine_dir)
+        except OSError:
+            return
+        for name in names:
+            if not name.startswith("combined_"):
+                continue
+            path = os.path.join(self.combine_dir, name)
+            try:
+                self.sm.upload_combined_file(path)
+                os.remove(path)
+                logger.info("recovered stranded combined file",
+                            extra={"path": path})
+            except Exception as e:
+                logger.warning("failed to recover combined file %s: %s",
+                               path, e)
+
+    # -- stage 3: batcher (`chunk/main.go:292-347`) ------------------------
+    def _batch_loop(self) -> None:
+        files: List[FileEntry] = []
+        size = 0
+        last_flush = time.monotonic()
+
+        def flush():
+            nonlocal files, size, last_flush
+            if files:
+                self.total_upload_size += size
+                self.posts_uploaded += len(files)
+                self._jobs_q.put(list(files))
+                files = []
+                size = 0
+            last_flush = time.monotonic()
+
+        while True:
+            try:
+                entry = self._file_q.get(timeout=0.25)
+            except queue.Empty:
+                if files and time.monotonic() - last_flush >= \
+                        self.batch_timeout_s:
+                    logger.info("batch timeout flush",
+                                extra={"log_tag": "chunk_pb"})
+                    flush()
+                if self._stop.is_set():
+                    flush()
+                    self._jobs_q.put(None)
+                    return
+                continue
+            if entry is None:  # shutdown sentinel
+                flush()
+                self._jobs_q.put(None)
+                return
+            if entry.size > self.hard_cap:
+                # Undeliverable: delete (`main.go:316-322`).
+                logger.warning("file exceeds hard cap, deleting", extra={
+                    "file": entry.path, "bytes": entry.size,
+                    "log_tag": "chunk_pb"})
+                try:
+                    os.remove(entry.path)
+                except OSError as e:
+                    logger.error("failed to remove oversize file: %s", e)
+                continue
+            if size > 0 and size + entry.size > self.hard_cap:
+                logger.info("hard cap forced flush",
+                            extra={"log_tag": "chunk_pb"})
+                flush()
+            files.append(entry)
+            size += entry.size
+            if size >= self.trigger_size:
+                flush()
+
+    # -- stage 4: consumer (`chunk/main.go:349-421`) -----------------------
+    def _consume_loop(self) -> None:
+        while True:
+            batch = self._jobs_q.get()
+            if batch is None:
+                logger.info("all batches uploaded",
+                            extra={"log_tag": "chunk_cb"})
+                return
+            try:
+                combined = self.combine_files(batch)
+            except Exception as e:
+                logger.error("failed to combine batch, files not deleted: %s",
+                             e, extra={"log_tag": "chunk_cb"})
+                continue
+            try:
+                self.sm.upload_combined_file(combined)
+            except Exception as e:
+                logger.error("failed to upload combined file, retrying "
+                             "in %ss: %s", UPLOAD_RETRY_DELAY_S, e)
+                if self._stop.wait(UPLOAD_RETRY_DELAY_S):
+                    # Shutting down: leave the combined file for the
+                    # recovery scanner of the next run.
+                    continue
+                try:
+                    self.sm.upload_combined_file(combined)
+                except Exception as e2:
+                    logger.error("retry failed to upload combined file: %s",
+                                 e2)
+                    continue
+            self._last_upload = time.monotonic()
+            self._cleanup_after_upload(batch, combined)
+
+    def combine_files(self, batch: List[FileEntry]) -> str:
+        """`chunk/main.go:386-421`."""
+        out_path = os.path.join(self.combine_dir,
+                                f"combined_{time.time_ns()}.jsonl")
+        with open(out_path, "wb") as out:
+            for entry in batch:
+                try:
+                    current = os.path.getsize(entry.path)
+                    if current != entry.size:
+                        logger.error("file size changed before combining",
+                                     extra={"file": entry.path,
+                                            "initial": entry.size,
+                                            "current": current})
+                except OSError:
+                    pass
+                with open(entry.path, "rb") as f:
+                    while True:
+                        chunk = f.read(1 << 20)
+                        if not chunk:
+                            break
+                        out.write(chunk)
+        return out_path
+
+    def _cleanup_after_upload(self, batch: List[FileEntry],
+                              combined: str) -> None:
+        """`chunk/main.go:510-530`."""
+        for entry in batch:
+            try:
+                os.remove(entry.path)
+            except OSError as e:
+                logger.warning("failed to delete source %s: %s",
+                               entry.path, e)
+        try:
+            os.remove(combined)
+        except OSError as e:
+            logger.warning("failed to delete combined %s: %s", combined, e)

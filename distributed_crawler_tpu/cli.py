@@ -1,0 +1,417 @@
+"""The CLI entry point: flag parsing, precedence chain, mode dispatch.
+
+Parity with the reference's `main.go` (869 LoC):
+- the full flag surface (`main.go:751-854`) via argparse, with the same
+  four-level precedence (flags > CRAWLER_* env > YAML config > defaults)
+  through `config.precedence.ConfigResolver`
+- time-ago / date-between / max-crawl-duration parsing
+  (`main.go:91-142,432-471` -> `utils/timeparse`)
+- sampling-method validation matrix (`main.go` PersistentPreRunE)
+- mode dispatch (`main.go:586-628`): standalone | launch (the four-way
+  router) | orchestrator | worker | job | tpu-worker | version
+- the reference's pprof server on :6060 (`main.go:60-80`) becomes the
+  first-class metrics endpoint (`utils/metrics.serve_metrics`)
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+from typing import List, Optional
+
+from .config.crawler import (
+    CrawlerConfig,
+    generate_crawl_id,
+    read_urls_from_file,
+)
+from .config.precedence import ConfigResolver
+from .config.sampling import SamplingValidationInput, validate_sampling_method
+from .utils.structlog import setup_logging
+from .utils.timeparse import parse_date_between, parse_duration, parse_time_ago
+
+logger = logging.getLogger("dct.cli")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    """The flag surface (`main.go:751-854`).  Defaults are None so the
+    precedence resolver can tell "explicitly set" from "default"."""
+    p = argparse.ArgumentParser(
+        prog="dct",
+        description="distributed_crawler_tpu — TPU-native distributed "
+                    "social-media crawler + inference framework")
+    a = p.add_argument
+    a("--config", default=None, help="config file (default: ./config.yaml)")
+    a("--log-level", default=None, help="trace|debug|info|warn|error")
+    a("--log-json", action="store_const", const=True, default=None)
+    a("--mode", default=None,
+      help="standalone | launch | orchestrator | worker | job | tpu-worker")
+    a("--worker-id", default=None, help="worker identifier (worker modes)")
+    a("--concurrency", type=int, default=None)
+    a("--timeout", type=int, default=None, help="HTTP timeout seconds")
+    a("--user-agent", default=None)
+    a("--output", default=None, help="output format")
+    a("--storage-root", default=None)
+    a("--min-post-date", default=None, help="YYYY-MM-DD")
+    a("--time-ago", default=None, help="e.g. 30d, 6h, 2w, 1m, 1y")
+    a("--max-crawl-duration", default=None, help="e.g. 48h, 24h30m")
+    a("--date-between", default=None, help="YYYY-MM-DD,YYYY-MM-DD")
+    a("--sample-size", type=int, default=None)
+    a("--tdlib-database-url", default=None)
+    a("--tdlib-database-urls", default=None, help="comma-separated")
+    a("--tdlib-verbosity", type=int, default=None)
+    a("--min-users", type=int, default=None)
+    a("--crawl-id", default=None)
+    a("--crawl-label", default=None)
+    a("--max-comments", type=int, default=None)
+    a("--max-depth", type=int, default=None)
+    a("--max-posts", type=int, default=None)
+    a("--max-pages", type=int, default=None)
+    a("--skip-media", action="store_const", const=True, default=None)
+    a("--youtube-api-key", default=None)
+    a("--platform", default=None, help="telegram | youtube")
+    a("--sampling", default=None,
+      help="channel | random | random-walk | snowball")
+    a("--seed-size", type=int, default=None)
+    a("--walkback-rate", type=int, default=None)
+    a("--min-channel-videos", type=int, default=None)
+    a("--null-config", default=None)
+    a("--exit-on-complete", action="store_const", const=True, default=None)
+    # Validator / tandem
+    a("--tandem-crawl", action="store_const", const=True, default=None)
+    a("--validate-only", action="store_const", const=True, default=None)
+    a("--validator-request-rate", type=float, default=None)
+    a("--validator-request-jitter-ms", type=int, default=None)
+    a("--validator-claim-batch-size", type=int, default=None)
+    a("--validator-timeout", default=None, help="e.g. 30m")
+    # Combine files (chunker)
+    a("--combine-files", action="store_const", const=True, default=None)
+    a("--combine-watch-dir", default=None)
+    a("--combine-temp-dir", default=None)
+    a("--combine-write-dir", default=None)
+    a("--combine-trigger-size", type=int, default=None, help="MiB")
+    a("--combine-hard-cap", type=int, default=None, help="MiB")
+    # Inputs
+    a("--urls", default=None, help="comma-separated URLs to crawl")
+    a("--url-file", default=None, help="file with one URL per line")
+    # Distributed bus (the DCN leg; orchestrator hosts, workers connect)
+    a("--bus-address", default=None,
+      help="gRPC bus address, e.g. 127.0.0.1:50551 (orchestrator binds it, "
+           "workers dial it; empty = in-process bus)")
+    # Observability (pprof-analog)
+    a("--metrics-port", type=int, default=None,
+      help="serve /metrics + /healthz on this port (0 = off)")
+    # TPU inference stage
+    a("--infer", action="store_const", const=True, default=None,
+      help="enable the TPU inference stage")
+    a("--infer-model", default=None, help="model registry key")
+    a("--infer-batch-size", type=int, default=None)
+    a("--version", action="store_true")
+    return p
+
+
+# flag dest -> dotted config key (the viper BindPFlag table,
+# `main.go:813-854`)
+_KEY_MAP = {
+    "log_level": "logging.level",
+    "log_json": "logging.json",
+    "mode": "distributed.mode",
+    "worker_id": "distributed.worker_id",
+    "concurrency": "crawler.concurrency",
+    "timeout": "crawler.timeout",
+    "user_agent": "crawler.useragent",
+    "output": "output.format",
+    "storage_root": "storage.root",
+    "min_post_date": "crawler.minpostdate",
+    "time_ago": "crawler.timeago",
+    "max_crawl_duration": "crawler.maxcrawlduration",
+    "date_between": "crawler.datebetween",
+    "sample_size": "crawler.samplesize",
+    "tdlib_database_url": "tdlib.database_url",
+    "tdlib_database_urls": "tdlib.database_urls",
+    "tdlib_verbosity": "tdlib.verbosity",
+    "min_users": "crawler.minusers",
+    "crawl_id": "crawler.crawlid",
+    "crawl_label": "crawler.crawllabel",
+    "max_comments": "crawler.maxcomments",
+    "max_depth": "crawler.maxdepth",
+    "max_posts": "crawler.maxposts",
+    "max_pages": "crawler.maxpages",
+    "skip_media": "crawler.skipmedia",
+    "youtube_api_key": "youtube.api_key",
+    "platform": "crawler.platform",
+    "sampling": "crawler.sampling",
+    "seed_size": "crawler.seedsize",
+    "walkback_rate": "crawler.walkback_rate",
+    "min_channel_videos": "crawler.min_channel_videos",
+    "null_config": "crawler.null_config",
+    "exit_on_complete": "crawler.exit_on_complete",
+    "tandem_crawl": "crawler.tandem_crawl",
+    "validate_only": "crawler.validate_only",
+    "validator_request_rate": "crawler.validator_request_rate",
+    "validator_request_jitter_ms": "crawler.validator_request_jitter_ms",
+    "validator_claim_batch_size": "crawler.validator_claim_batch_size",
+    "validator_timeout": "crawler.validator_timeout",
+    "combine_files": "crawler.combine_files",
+    "combine_watch_dir": "crawler.combine_watch_dir",
+    "combine_temp_dir": "crawler.combine_temp_dir",
+    "combine_write_dir": "crawler.combine_write_dir",
+    "combine_trigger_size": "crawler.combine_trigger_size",
+    "combine_hard_cap": "crawler.combine_hard_cap",
+    "urls": "crawler.urls",
+    "url_file": "crawler.url_file",
+    "bus_address": "distributed.bus_address",
+    "metrics_port": "observability.metrics_port",
+    "infer": "inference.enabled",
+    "infer_model": "inference.model",
+    "infer_batch_size": "inference.batch_size",
+}
+
+
+def resolve_config(args: argparse.Namespace,
+                   env=None) -> "tuple[CrawlerConfig, ConfigResolver]":
+    """Apply the four-level precedence chain and build CrawlerConfig
+    (`main.go:185-520`)."""
+    flags = {key: getattr(args, dest) for dest, key in _KEY_MAP.items()}
+    r = ConfigResolver(flags=flags, env=env, config_file=args.config)
+
+    cfg = CrawlerConfig()
+    cfg.concurrency = r.get_int("crawler.concurrency", 1)
+    cfg.timeout = r.get_int("crawler.timeout", 30)
+    cfg.user_agent = r.get_str("crawler.useragent", cfg.user_agent)
+    cfg.output_format = r.get_str("output.format", "jsonl")
+    cfg.storage_root = r.get_str("storage.root", "/tmp/crawl")
+    cfg.sample_size = r.get_int("crawler.samplesize", 0)
+    cfg.tdlib_database_url = r.get_str("tdlib.database_url")
+    cfg.tdlib_database_urls = r.get_list("tdlib.database_urls")
+    cfg.tdlib_verbosity = r.get_int("tdlib.verbosity", 1)
+    cfg.min_users = r.get_int("crawler.minusers", 100)
+    cfg.crawl_id = r.get_str("crawler.crawlid") or generate_crawl_id()
+    cfg.crawl_label = r.get_str("crawler.crawllabel")
+    cfg.max_comments = r.get_int("crawler.maxcomments", -1)
+    cfg.max_depth = r.get_int("crawler.maxdepth", -1)
+    cfg.max_posts = r.get_int("crawler.maxposts", -1)
+    cfg.max_pages = r.get_int("crawler.maxpages", 108000)
+    cfg.skip_media_download = r.get_bool("crawler.skipmedia", False)
+    cfg.youtube_api_key = r.get_str("youtube.api_key")
+    cfg.platform = r.get_str("crawler.platform", "telegram")
+    cfg.sampling_method = r.get_str("crawler.sampling", "channel")
+    cfg.seed_size = r.get_int("crawler.seedsize", 0)
+    cfg.walkback_rate = r.get_int("crawler.walkback_rate", 15)
+    cfg.min_channel_videos = r.get_int("crawler.min_channel_videos", 10)
+    cfg.null_config = r.get_str("crawler.null_config", "")
+    if cfg.null_config == "{}":
+        cfg.null_config = ""
+    cfg.exit_on_complete = r.get_bool("crawler.exit_on_complete", False)
+    cfg.tandem_crawl = r.get_bool("crawler.tandem_crawl", False)
+    cfg.validate_only = r.get_bool("crawler.validate_only", False)
+    cfg.validator_request_rate = r.get_float(
+        "crawler.validator_request_rate", 6.0)
+    cfg.validator_request_jitter_ms = r.get_int(
+        "crawler.validator_request_jitter_ms", 200)
+    cfg.validator_claim_batch_size = r.get_int(
+        "crawler.validator_claim_batch_size", 10)
+    cfg.combine_files = r.get_bool("crawler.combine_files", False)
+    cfg.combine_watch_dir = r.get_str("crawler.combine_watch_dir",
+                                      "/tmp/watch-files")
+    cfg.combine_temp_dir = r.get_str("crawler.combine_temp_dir",
+                                     "/tmp/temp-files")
+    cfg.combine_write_dir = r.get_str("crawler.combine_write_dir",
+                                      "/tmp/combine-write")
+    cfg.combine_trigger_size = r.get_int("crawler.combine_trigger_size",
+                                         170) * 1024 * 1024
+    cfg.combine_hard_cap = r.get_int("crawler.combine_hard_cap",
+                                     200) * 1024 * 1024
+    cfg.inference.enabled = r.get_bool("inference.enabled", False)
+    model = r.get_str("inference.model")
+    if model:
+        cfg.inference.embed_model = model
+    batch = r.get_int("inference.batch_size", 0)
+    if batch:
+        cfg.inference.batch_size = batch
+
+    # Date windows (`main.go:432-471`): date-between wins over time-ago wins
+    # over min-post-date.
+    date_between = r.get_str("crawler.datebetween")
+    time_ago = r.get_str("crawler.timeago")
+    min_post_date = r.get_str("crawler.minpostdate")
+    if date_between:
+        cfg.date_between_min, cfg.date_between_max = \
+            parse_date_between(date_between)
+    elif time_ago:
+        cfg.post_recency = parse_time_ago(time_ago)
+    elif min_post_date:
+        from datetime import datetime, timezone
+        cfg.min_post_date = datetime.strptime(
+            min_post_date, "%Y-%m-%d").replace(tzinfo=timezone.utc)
+
+    duration = r.get_str("crawler.maxcrawlduration")
+    if duration:
+        cfg.max_crawl_duration_s = parse_duration(duration)
+    vtimeout = r.get_str("crawler.validator_timeout")
+    if vtimeout:
+        cfg.validator_timeout_s = parse_duration(vtimeout)
+
+    # Sampling-method validity matrix (`main.go` PersistentPreRunE ->
+    # common/sampling_validation.go). Validate-only pods need no URLs.
+    if not cfg.validate_only:
+        validate_sampling_method(SamplingValidationInput(
+            platform=cfg.platform, sampling_method=cfg.sampling_method,
+            url_list=r.get_list("crawler.urls"),
+            url_file=r.get_str("crawler.url_file"),
+            mode=r.get_str("distributed.mode", ""),
+            seed_size=cfg.seed_size, crawl_id=cfg.crawl_id))
+    return cfg, r
+
+
+def collect_urls(r: ConfigResolver) -> List[str]:
+    """--urls + --url-file (`main.go:522-585`)."""
+    urls = list(r.get_list("crawler.urls"))
+    url_file = r.get_str("crawler.url_file")
+    if url_file:
+        urls.extend(read_urls_from_file(url_file))
+    return urls
+
+
+def main(argv: Optional[List[str]] = None, env=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.version:
+        print("distributed_crawler_tpu v0.1.0")
+        return 0
+    try:
+        cfg, r = resolve_config(args, env=env)
+    except (ValueError, FileNotFoundError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    setup_logging(r.get_str("logging.level", "info"),
+                  json_output=r.get_bool("logging.json", False))
+
+    metrics_port = r.get_int("observability.metrics_port", 0)
+    if metrics_port:
+        from .utils.metrics import serve_metrics
+        serve_metrics(metrics_port)
+
+    mode = r.get_str("distributed.mode", "")
+    urls = collect_urls(r)
+    logger.info("starting", extra={"mode": mode or "standalone",
+                                   "platform": cfg.platform,
+                                   "url_count": len(urls)})
+    try:
+        if mode in ("", "standalone"):
+            from .modes.standalone import start_standalone_mode
+            start_standalone_mode(urls, cfg)
+        elif mode == "launch":  # the reference's dapr-standalone router
+            from .modes.runner import launch
+            launch(urls, cfg)
+        elif mode == "orchestrator":
+            _run_orchestrator(urls, cfg, r)
+        elif mode == "worker":
+            _run_worker(cfg, r)
+        elif mode == "job":  # the reference's dapr-job scheduled mode
+            _run_job_service(cfg)
+        elif mode == "tpu-worker":
+            _run_tpu_worker(cfg, r)
+        else:
+            print(f"error: unknown execution mode: {mode}", file=sys.stderr)
+            return 2
+    except KeyboardInterrupt:
+        logger.info("interrupted, shutting down")
+        return 130
+    return 0
+
+
+def _make_bus(r: ConfigResolver, serve: bool = False):
+    """Bus selection: --bus-address set -> gRPC DCN transport (orchestrator
+    hosts a GrpcBusServer with the work queue pull-enabled; workers dial a
+    RemoteBus with competing-consumer pull).  Unset -> in-process bus."""
+    address = r.get_str("distributed.bus_address") if r else ""
+    if not address:
+        from .bus.inmemory import InMemoryBus
+        bus = InMemoryBus(sync=False)
+        bus.start()
+        return bus
+    if serve:
+        from .bus.grpc_bus import GrpcBusServer
+        from .bus.messages import TOPIC_WORK_QUEUE
+        server = GrpcBusServer(address)
+        server.enable_pull(TOPIC_WORK_QUEUE)
+        server.start()
+        return server
+    from .bus.grpc_bus import RemoteBus
+    return RemoteBus(address)
+
+
+def _run_orchestrator(urls: List[str], cfg: CrawlerConfig,
+                      r: ConfigResolver) -> None:
+    """`main.go:647-706`."""
+    from .modes.common import create_state_manager
+    from .orchestrator import Orchestrator
+    bus = _make_bus(r, serve=True)
+    sm = create_state_manager(cfg, cfg.crawl_id)
+    orch = Orchestrator(cfg.crawl_id, cfg, bus, sm)
+    orch.start(urls)
+    try:
+        import time as _time
+        while orch.is_running and not orch.crawl_completed:
+            _time.sleep(1.0)
+    finally:
+        orch.stop()
+        bus.close()
+
+
+def _run_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
+    """`main.go:708-750`."""
+    worker_id = r.get_str("distributed.worker_id")
+    if not worker_id:
+        raise ValueError("worker mode requires --worker-id")
+    from .modes.common import create_state_manager
+    from .worker import CrawlWorker
+    bus = _make_bus(r)
+    sm = create_state_manager(cfg, cfg.crawl_id)
+    worker = CrawlWorker(worker_id, cfg, bus, sm)
+    worker.start()
+    try:
+        import time as _time
+        while worker.is_running:
+            _time.sleep(1.0)
+    finally:
+        worker.stop()
+        bus.close()
+
+
+def _run_job_service(cfg: CrawlerConfig) -> None:
+    """`main.go:602` -> dapr.StartDaprMode."""
+    from .modes.jobs import JobScheduler, JobService
+    service = JobService(cfg)
+    scheduler = JobScheduler(service)
+    scheduler.start()
+    try:
+        import time as _time
+        while True:
+            _time.sleep(1.0)
+    finally:
+        scheduler.stop()
+
+
+def _run_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
+    """The new TPU inference worker mode (SURVEY.md §7.6)."""
+    from .inference.engine import EngineConfig, InferenceEngine
+    from .inference.worker import TPUWorker, TPUWorkerConfig
+    bus = _make_bus(r)
+    engine = InferenceEngine(EngineConfig(
+        model=cfg.inference.embed_model.replace("-", "_"),
+        batch_size=cfg.inference.batch_size,
+        buckets=tuple(cfg.inference.bucket_sizes)))
+    worker = TPUWorker(bus, engine, cfg=TPUWorkerConfig(
+        metrics_port=r.get_int("observability.metrics_port", 0)))
+    worker.start()
+    try:
+        import time as _time
+        while True:
+            _time.sleep(1.0)
+    finally:
+        worker.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,30 @@ SNOWBALL_MIN_VIDEOS = 10  # channels with > 10 videos (types.go:62)
 
 _CHANNEL_ID_RE = re.compile(r"(UC[A-Za-z0-9_-]{22})")
 
+DATA_API_BASE = "https://www.googleapis.com/youtube/v3"
+
+
+class HttpYouTubeTransport:
+    """Production transport: urllib against the Data API v3
+    (`client/youtube_client.go:59-75` used an API-key http.RoundTripper).
+    Tests and offline runs inject `FakeYouTubeTransport` instead."""
+
+    def __init__(self, base_url: str = DATA_API_BASE, timeout_s: float = 30.0):
+        self.base_url = base_url
+        self.timeout_s = timeout_s
+
+    def __call__(self, endpoint: str, params: Dict[str, Any]) -> Dict[str, Any]:
+        import json as _json
+        import urllib.parse
+        import urllib.request
+        url = (f"{self.base_url}/{endpoint}?"
+               + urllib.parse.urlencode(params, doseq=True))
+        req = urllib.request.Request(url, headers={
+            "Accept": "application/json",
+            "User-Agent": "dct-crawler/1.0"})
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return _json.loads(resp.read().decode("utf-8"))
+
 
 class YouTubeClient(Protocol):
     """`model/youtube/types.go:39-64`."""

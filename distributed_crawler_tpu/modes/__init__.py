@@ -1,0 +1,65 @@
+"""Execution modes (reference `standalone/`, `dapr/standalone.go`).
+
+- `runner.launch` — the four-way mode router (validate-only, youtube-random,
+  random-walk layerless, layered)
+- `standalone` — sequential single-process walk
+- `layers` — parallel layer drivers + YouTube worker rotation pool
+- `layerless` — the random-walk page-buffer driver
+- `validate` — the tandem validator pod
+- `youtube_random` — YouTube random prefix-sampling driver
+- `jobs` — scheduled-crawl service (reference `dapr/job.go`)
+"""
+
+from .common import (
+    calculate_date_filters,
+    create_state_manager,
+    determine_crawl_id,
+    normalize_seed_urls,
+)
+from .layerless import ValidatorCircuitBreakerError, run_random_walk_layerless
+from .layers import (
+    YtWorker,
+    YtWorkerPool,
+    process_layer_in_parallel,
+    process_layers_iteratively,
+)
+from .jobs import (
+    JobData,
+    JobScheduler,
+    JobService,
+    extract_base_job_type,
+    merge_config_with_job_data,
+)
+from .runner import launch, seed_random_walk
+from .standalone import run_sequential_layers, start_standalone_mode
+from .validate import prepare_validator_state, run_validate_only
+from .youtube_random import (
+    initialize_youtube_crawler_components,
+    run_random_youtube_sample,
+)
+
+__all__ = [
+    "JobData",
+    "JobScheduler",
+    "JobService",
+    "ValidatorCircuitBreakerError",
+    "extract_base_job_type",
+    "merge_config_with_job_data",
+    "YtWorker",
+    "YtWorkerPool",
+    "calculate_date_filters",
+    "create_state_manager",
+    "determine_crawl_id",
+    "initialize_youtube_crawler_components",
+    "launch",
+    "normalize_seed_urls",
+    "prepare_validator_state",
+    "process_layer_in_parallel",
+    "process_layers_iteratively",
+    "run_random_walk_layerless",
+    "run_random_youtube_sample",
+    "run_sequential_layers",
+    "run_validate_only",
+    "seed_random_walk",
+    "start_standalone_mode",
+]

@@ -1,0 +1,95 @@
+"""Shared mode helpers: state-manager creation, resume, seed normalization.
+
+Parity with the reference's `dapr/standalone.go:690-770` (CreateStateManager,
+DetermineCrawlID), seed normalization (`:322-330`), and CalculateDateFilters
+(`:1092-1117`).
+"""
+
+from __future__ import annotations
+
+import logging
+from datetime import datetime
+from typing import List, Optional, Tuple
+
+from ..config.crawler import CrawlerConfig, generate_crawl_id
+from ..state.datamodels import utcnow
+from ..state.factory import create_state_manager as factory_create
+from ..state.interface import LocalConfig, SqlConfig, StateConfig, StateManager
+
+logger = logging.getLogger("dct.modes")
+
+
+def state_config_from_crawler_config(cfg: CrawlerConfig,
+                                     crawl_exec_id: str = "") -> StateConfig:
+    """`dapr/standalone.go:690-735`."""
+    return StateConfig(
+        storage_root=cfg.storage_root,
+        crawl_id=cfg.crawl_id,
+        crawl_label=cfg.crawl_label,
+        crawl_execution_id=crawl_exec_id,
+        platform=cfg.platform,
+        sampling_method=cfg.sampling_method,
+        seed_size=cfg.seed_size,
+        max_pages=cfg.max_pages if crawl_exec_id else 0,
+        local=LocalConfig(base_path=cfg.storage_root),
+        sql=SqlConfig(url=cfg.storage_root + "/graph.sqlite"
+                      if cfg.storage_root else ":memory:"),
+        combine_files=cfg.combine_files,
+        combine_watch_dir=cfg.combine_watch_dir,
+        combine_temp_dir=cfg.combine_temp_dir,
+    )
+
+
+def create_state_manager(cfg: CrawlerConfig,
+                         crawl_exec_id: str = "") -> StateManager:
+    return factory_create(state_config_from_crawler_config(cfg, crawl_exec_id))
+
+
+def determine_crawl_id(temp_sm: Optional[StateManager],
+                       cfg: CrawlerConfig) -> Tuple[str, bool]:
+    """Resume an incomplete execution or start a new one
+    (`dapr/standalone.go:737-770`); returns (exec_id, is_resuming_same)."""
+    crawl_exec_id = ""
+    if temp_sm is not None:
+        try:
+            existing, exists = temp_sm.find_incomplete_crawl(cfg.crawl_id)
+        except Exception as e:
+            logger.warning("error checking for existing crawls, "
+                           "starting fresh: %s", e)
+            existing, exists = "", False
+        if exists and existing:
+            crawl_exec_id = existing
+            logger.info("resuming existing crawl", extra={
+                "crawl_id": cfg.crawl_id, "execution_id": crawl_exec_id})
+        try:
+            temp_sm.close()
+        except Exception:
+            pass
+    is_resuming = bool(crawl_exec_id)
+    if not crawl_exec_id:
+        crawl_exec_id = generate_crawl_id()
+        logger.info("starting new crawl execution",
+                    extra={"execution_id": crawl_exec_id})
+    return crawl_exec_id, is_resuming
+
+
+def normalize_seed_urls(urls: List[str]) -> List[str]:
+    """Strip t.me prefixes/@, lowercase (`dapr/standalone.go:324-330`)."""
+    out = []
+    for u in urls:
+        for prefix in ("https://t.me/", "http://t.me/", "t.me/", "@"):
+            if u.startswith(prefix):
+                u = u[len(prefix):]
+        out.append(u.lower())
+    return out
+
+
+def calculate_date_filters(cfg: CrawlerConfig
+                           ) -> Tuple[Optional[datetime], Optional[datetime]]:
+    """date-between > post-recency > min-post-date
+    (`dapr/standalone.go:1092-1117`)."""
+    if cfg.date_between_min is not None and cfg.date_between_max is not None:
+        return cfg.date_between_min, cfg.date_between_max
+    if cfg.post_recency is not None:
+        return cfg.post_recency, utcnow()
+    return cfg.min_post_date, utcnow()

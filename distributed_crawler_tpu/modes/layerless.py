@@ -1,0 +1,194 @@
+"""The layerless random-walk driver.
+
+Parity with the reference's `RunRandomWalkLayerless`
+(`dapr/standalone.go:792-946`): pages live exclusively in the page_buffer;
+workers pop pages, crawl them (the engine writes the next hop back into the
+buffer), and delete them on success.  Per-error-class routing:
+
+- WalkbackExhaustedError -> leave the page in the buffer for restart
+- FloodWaitRetireError   -> leave page; abort the crawl if the pool emptied
+- TDLib400Error          -> 400-replacement, then delete the page
+- other errors           -> log and delete the page
+
+Tandem completion: buffer empty + no in-flight workers + no incomplete
+batches => done; a validator circuit breaker aborts when the validator makes
+no progress within `validator_timeout_s` (`:836-867`).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from ..config.crawler import CrawlerConfig
+from ..crawl import runner as crawl_runner
+from ..crawl.errors import (
+    FloodWaitRetireError,
+    TDLib400Error,
+    WalkbackExhaustedError,
+)
+from ..crawl.replacement import handle_400_replacement
+from ..state.datamodels import Page
+
+logger = logging.getLogger("dct.modes.layerless")
+
+# Poll sleep between page-buffer polls; module-level so tests shrink it
+# (`dapr/standalone.go:771-773`).
+LAYERLESS_POLL_INTERVAL_S = 5.0
+BUSY_WAIT_S = 0.5
+
+
+class ValidatorCircuitBreakerError(RuntimeError):
+    """Raised when the validator makes no progress within the timeout."""
+
+
+def run_random_walk_layerless(sm, cfg: CrawlerConfig,
+                              poll_interval_s: Optional[float] = None,
+                              clock=time.monotonic, sleep=time.sleep) -> None:
+    """`dapr/standalone.go:792-946`."""
+    poll = (LAYERLESS_POLL_INTERVAL_S if poll_interval_s is None
+            else poll_interval_s)
+    crawl_start = clock()
+    should_stop = threading.Event()
+    max_workers = max(1, cfg.concurrency)
+
+    sem = threading.Semaphore(max_workers)
+    in_flight: dict = {}
+    in_flight_lock = threading.Lock()
+    threads: list = []
+    validator_wait_since: Optional[float] = None
+
+    def in_flight_count() -> int:
+        with in_flight_lock:
+            return len(in_flight)
+
+    def worker(page: Page) -> None:
+        try:
+            try:
+                crawl_runner.run_for_channel_with_pool(
+                    page, cfg.storage_root, sm, cfg)
+            except WalkbackExhaustedError as e:
+                # Leave page in buffer — re-processed on restart.
+                logger.error("walkback exhausted, page left in buffer",
+                             extra={"url": page.url, "error": str(e)})
+            except FloodWaitRetireError:
+                logger.warning("connection retired due to FLOOD_WAIT, "
+                               "page left in buffer", extra={"url": page.url})
+                if crawl_runner.pool_is_empty():
+                    logger.error("all connections retired due to FLOOD_WAIT, "
+                                 "aborting crawl")
+                    should_stop.set()
+            except TDLib400Error as e:
+                logger.error("TDLib 400, finding replacement edge", extra={
+                    "url": page.url, "error": str(e)})
+                try:
+                    handle_400_replacement(sm, page, cfg)
+                except Exception as repl_err:
+                    logger.error("failed to find 400 replacement", extra={
+                        "url": page.url, "error": str(repl_err)})
+                _delete(page)
+            except Exception as e:
+                logger.error("error processing channel", extra={
+                    "url": page.url, "error": str(e)})
+                _delete(page)
+            else:
+                _delete(page)
+            if cfg.max_crawl_duration_s > 0 and \
+                    clock() - crawl_start >= cfg.max_crawl_duration_s:
+                should_stop.set()
+        finally:
+            with in_flight_lock:
+                in_flight.pop(page.id, None)
+            sem.release()
+
+    def _delete(page: Page) -> None:
+        try:
+            sm.delete_page_buffer_pages([page.id], [page.url])
+        except Exception as e:
+            logger.error("failed to delete page from buffer", extra={
+                "url": page.url, "error": str(e)})
+
+    while not should_stop.is_set():
+        if cfg.max_crawl_duration_s > 0 and \
+                clock() - crawl_start >= cfg.max_crawl_duration_s:
+            logger.info("max crawl duration reached, stopping")
+            break
+
+        # Don't poll the DB while all worker slots are occupied.
+        if in_flight_count() >= max_workers:
+            sleep(BUSY_WAIT_S)
+            continue
+
+        try:
+            pages = sm.get_pages_from_page_buffer(max_workers)
+        except Exception as e:
+            logger.error("failed to get pages from page buffer: %s", e)
+            sleep(poll)
+            continue
+
+        if not pages:
+            if cfg.tandem_crawl:
+                if in_flight_count() == 0:
+                    try:
+                        pending = sm.count_incomplete_batches(cfg.crawl_id)
+                    except Exception as e:
+                        logger.warning("tandem: could not check incomplete "
+                                       "batches: %s", e)
+                        validator_wait_since = None
+                        sleep(poll)
+                        continue
+                    if pending == 0:
+                        logger.info("tandem: buffer empty and no pending "
+                                    "batches, crawl complete")
+                        break
+                    if validator_wait_since is None:
+                        validator_wait_since = clock()
+                    if cfg.validator_timeout_s > 0 and \
+                            clock() - validator_wait_since >= \
+                            cfg.validator_timeout_s:
+                        _join(threads)
+                        raise ValidatorCircuitBreakerError(
+                            f"no progress from validator after "
+                            f"{clock() - validator_wait_since:.0f}s "
+                            f"({pending} incomplete batches) — validator pod "
+                            f"may have crashed")
+                    logger.info("tandem: buffer empty, waiting for validator",
+                                extra={"incomplete_batches": pending})
+                else:
+                    validator_wait_since = None
+            else:
+                if in_flight_count() == 0:
+                    logger.info("buffer empty and no workers in flight, "
+                                "random walk complete")
+                    break
+            sleep(poll)
+            continue
+
+        validator_wait_since = None
+        dispatched = 0
+        for page in pages:
+            with in_flight_lock:
+                if page.id in in_flight:
+                    continue
+                in_flight[page.id] = True
+            sem.acquire()  # back-pressure against max_workers
+            t = threading.Thread(target=worker, args=(page,), daemon=True,
+                                 name=f"dct-rw-{page.url[:24]}")
+            t.start()
+            threads.append(t)
+            dispatched += 1
+        # Prune finished threads so a long walk doesn't retain one Thread
+        # object per page ever crawled.
+        threads = [t for t in threads if t.is_alive()]
+        if dispatched == 0:
+            sleep(BUSY_WAIT_S)
+
+    _join(threads)
+
+
+def _join(threads, timeout_s: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    for t in threads:
+        t.join(timeout=max(0.0, deadline - time.monotonic()))

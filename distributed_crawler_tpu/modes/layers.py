@@ -1,0 +1,256 @@
+"""Layered crawl drivers: parallel layer processing + iterative depth walk.
+
+Parity with the reference's `dapr/standalone.go`:
+- `process_layer_in_parallel` (`:417-689`): semaphore-bounded workers over a
+  layer's pages, per-page failure containment, duplicate-URL skip, fetched/
+  error skip on resume, next-layer construction with dedup.
+- `process_layers_iteratively` (`:948-1022`): depth loop to max depth.
+- YouTube worker pool with usage-based rotation (~50±10 channels) for memory
+  control (`ytWorker`, `:1245-1272`, rotation `:543-577`).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor, wait
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+from ..config.crawler import CrawlerConfig
+from ..crawl import runner as crawl_runner
+from ..crawlers.base import Crawler, CrawlJob, CrawlTarget
+from ..state.datamodels import (
+    PAGE_ERROR,
+    PAGE_FETCHED,
+    PAGE_UNFETCHED,
+    Layer,
+    Page,
+    new_id,
+    utcnow,
+)
+from .common import calculate_date_filters
+
+logger = logging.getLogger("dct.modes.layers")
+
+YT_WORKER_RETIRE_BASE = 50  # `dapr/standalone.go:1260`
+YT_WORKER_RETIRE_JITTER = 10
+
+
+@dataclass
+class YtWorker:
+    """A YouTube crawler instance with a usage-based lifetime
+    (`dapr/standalone.go:1245-1272`)."""
+
+    crawler: Crawler
+    usage: int = 0
+    retire_at: int = YT_WORKER_RETIRE_BASE
+
+
+class YtWorkerPool:
+    """Fixed pool of YouTube crawlers, each rotated after ~50±10 channels to
+    bound client memory (`dapr/standalone.go:543-577`)."""
+
+    def __init__(self, factory: Callable[[], Crawler], size: int,
+                 rng: Optional[random.Random] = None):
+        self._factory = factory
+        self._rng = rng or random.Random()
+        self._pool: "list[YtWorker]" = []
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        for _ in range(size):
+            self._pool.append(self._fresh())
+
+    def _fresh(self) -> YtWorker:
+        return YtWorker(crawler=self._factory(),
+                        retire_at=YT_WORKER_RETIRE_BASE
+                        + self._rng.randint(-YT_WORKER_RETIRE_JITTER,
+                                            YT_WORKER_RETIRE_JITTER))
+
+    def acquire(self) -> YtWorker:
+        with self._cond:
+            while not self._pool:
+                self._cond.wait()
+            return self._pool.pop()
+
+    def release(self, worker: YtWorker) -> None:
+        worker.usage += 1
+        if worker.usage >= worker.retire_at:
+            logger.info("youtube crawler retirement triggered", extra={
+                "log_tag": "FOCUS", "channels_crawled": worker.usage})
+            # Create the replacement BEFORE closing the old crawler: if the
+            # factory fails, the still-working old crawler stays in service
+            # (counter reset retries rotation later) instead of a closed one
+            # poisoning the pool slot.
+            try:
+                fresh = self._fresh()
+            except Exception as e:
+                logger.error("failed to rotate youtube crawler, keeping "
+                             "current one: %s", e)
+                worker.usage = 0
+            else:
+                try:
+                    worker.crawler.close()
+                except Exception as e:
+                    logger.warning("error closing retired yt crawler: %s", e)
+                worker = fresh
+        with self._cond:
+            self._pool.append(worker)
+            self._cond.notify()
+
+    def close(self) -> None:
+        with self._lock:
+            for w in self._pool:
+                try:
+                    w.crawler.close()
+                except Exception:
+                    pass
+            self._pool.clear()
+
+
+def fetch_youtube_page(crawler: Crawler, cfg: CrawlerConfig,
+                       page: Page) -> List[Page]:
+    """One YouTube channel fetch; returns discovered pages (none — YouTube
+    discovery is snowball-internal; `dapr/standalone.go:1119-1159`)."""
+    from_time, to_time = calculate_date_filters(cfg)
+    job = CrawlJob(
+        target=CrawlTarget(id=page.url, type="youtube"),
+        from_time=from_time, to_time=to_time,
+        limit=cfg.max_posts if cfg.max_posts > 0 else 0,
+        sample_size=cfg.sample_size)
+    crawler.fetch_messages(job)
+    return []
+
+
+def process_layer_in_parallel(layer: Layer, max_workers: int, sm,
+                              cfg: CrawlerConfig,
+                              should_stop: Optional[threading.Event] = None,
+                              yt_pool: Optional[YtWorkerPool] = None,
+                              is_resuming_same_execution: bool = True) -> int:
+    """Process a layer's pages with bounded concurrency; returns the number
+    of pages processed (`dapr/standalone.go:417-689`)."""
+    max_workers = max(1, max_workers)
+    discovered_all: List[Page] = []
+    mu = threading.Lock()
+    unique: set = set()
+    processed = 0
+
+    def work(page: Page) -> None:
+        try:
+            page.timestamp = utcnow()
+            if cfg.platform == "youtube":
+                if yt_pool is None:
+                    raise ValueError(
+                        "youtube layer processing needs a YtWorkerPool")
+                worker = yt_pool.acquire()
+                try:
+                    discovered = fetch_youtube_page(worker.crawler, cfg, page)
+                finally:
+                    yt_pool.release(worker)
+            else:
+                discovered = crawl_runner.run_for_channel_with_pool(
+                    page, cfg.storage_root, sm, cfg)
+        except Exception as e:
+            logger.error("error processing item", extra={
+                "url": page.url, "error": str(e)})
+            page.status = PAGE_ERROR
+            page.error = str(e)
+            _safe_update(sm, page)
+            return
+        page.status = PAGE_FETCHED
+        _safe_update(sm, page)
+        if discovered:
+            with mu:
+                discovered_all.extend(discovered)
+
+    futures = []
+    with ThreadPoolExecutor(max_workers=max_workers,
+                            thread_name_prefix="dct-layer") as pool:
+        for page in layer.pages:
+            if page.url in unique:
+                continue
+            unique.add(page.url)
+            if page.status in (PAGE_FETCHED, PAGE_ERROR) \
+                    and is_resuming_same_execution:
+                logger.debug("skipping %s page on same-execution resume: %s",
+                             page.status, page.url)
+                continue
+            if should_stop is not None and should_stop.is_set():
+                logger.info("max crawl duration reached, skipping remaining "
+                            "channels in layer", extra={"url": page.url})
+                break
+            processed += 1
+            futures.append(pool.submit(work, page))
+        wait(futures)
+
+    # Build the next layer from discoveries, deduped (`:645-688`).
+    if discovered_all:
+        seen: set = set()
+        new_pages = []
+        for ch in discovered_all:
+            if ch.url in seen:
+                continue
+            seen.add(ch.url)
+            new_pages.append(Page(
+                id=new_id(), url=ch.url, depth=layer.depth + 1,
+                status=PAGE_UNFETCHED, timestamp=utcnow(),
+                parent_id=ch.parent_id))
+        try:
+            sm.add_layer(new_pages)
+            sm.save_state()
+            logger.info("added new channels to be processed",
+                        extra={"count": len(new_pages)})
+        except Exception as e:
+            logger.error("failed to add discovered channels as new layer: %s",
+                         e)
+    return processed
+
+
+def _safe_update(sm, page: Page) -> None:
+    try:
+        sm.update_page(page)
+        sm.save_state()
+    except Exception as e:
+        logger.error("failed to persist page status", extra={
+            "url": page.url, "error": str(e)})
+
+
+def process_layers_iteratively(sm, cfg: CrawlerConfig,
+                               is_resuming_same_execution: bool = True,
+                               yt_pool: Optional[YtWorkerPool] = None,
+                               clock=time.monotonic) -> int:
+    """Depth loop over layers until max depth (`dapr/standalone.go:948-1022`);
+    returns total pages processed."""
+    depth = 0
+    total = 0
+    start = clock()
+    should_stop = threading.Event()
+    while True:
+        max_depth = sm.get_max_depth()
+        if depth > max_depth:
+            logger.info("processed all layers up to maximum depth %d",
+                        max_depth)
+            break
+        if cfg.max_depth >= 0 and cfg.max_depth and depth > cfg.max_depth:
+            logger.info("processed all layers up to max configured depth %d",
+                        cfg.max_depth)
+            break
+        pages = sm.get_layer_by_depth(depth)
+        if not pages:
+            depth += 1
+            continue
+        if cfg.max_crawl_duration_s > 0 and \
+                clock() - start >= cfg.max_crawl_duration_s:
+            should_stop.set()
+            logger.info("max crawl duration reached")
+            break
+        logger.info("processing layer", extra={
+            "depth": depth, "pages": len(pages)})
+        total += process_layer_in_parallel(
+            Layer(depth=depth, pages=pages), cfg.concurrency, sm, cfg,
+            should_stop=should_stop, yt_pool=yt_pool,
+            is_resuming_same_execution=is_resuming_same_execution)
+        depth += 1
+    return total

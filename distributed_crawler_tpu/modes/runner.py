@@ -1,0 +1,148 @@
+"""The mode router: validate-only / youtube-random / random-walk / layered.
+
+Parity with the reference's `dapr.launch` (`dapr/standalone.go:236-414`):
+resume detection, optional chunker, four-way mode dispatch, random-walk
+initialization (seed normalization, cache loads, page-buffer seeding), and
+completion metadata + page export at the end.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from ..config.crawler import CrawlerConfig
+from ..state.datamodels import PAGE_UNFETCHED, Page, new_id, utcnow
+from .common import create_state_manager, determine_crawl_id, normalize_seed_urls
+from .layerless import run_random_walk_layerless
+from .layers import YtWorkerPool, process_layers_iteratively
+from .validate import run_validate_only
+from .youtube_random import run_random_youtube_sample
+
+logger = logging.getLogger("dct.modes.runner")
+
+
+def make_yt_pool(sm, cfg: CrawlerConfig, yt_transport=None) -> YtWorkerPool:
+    """Rotation pool whose factory builds connected registry crawlers
+    (`dapr/standalone.go:446-451`)."""
+    from .youtube_random import initialize_youtube_crawler_components
+
+    def factory():
+        crawler, _ = initialize_youtube_crawler_components(
+            sm, cfg, transport=yt_transport)
+        return crawler
+
+    return YtWorkerPool(factory, size=max(1, cfg.concurrency))
+
+
+def seed_random_walk(sm, seed_urls: List[str]) -> None:
+    """Random-walk init: cache loads + fresh-start page-buffer seeding
+    (`dapr/standalone.go:323-373`)."""
+    seed_urls = normalize_seed_urls(seed_urls)
+    sm.initialize([])  # DB setup without creating a layer for the seeds
+    try:
+        sm.load_seed_channels()
+    except Exception as e:
+        logger.warning("random-walk-init: failed to load seed channels "
+                       "(continuing): %s", e)
+    try:
+        sm.load_invalid_channels()
+    except Exception as e:
+        logger.warning("random-walk-init: failed to load invalid channels "
+                       "(continuing): %s", e)
+    sm.initialize_discovered_channels()
+
+    existing = sm.get_pages_from_page_buffer(1)
+    if existing:
+        logger.info("random-walk-init: resuming from existing page buffer",
+                    extra={"count": len(existing)})
+        return
+    if seed_urls:
+        logger.info("random-walk-init: seeding page buffer from URL list",
+                    extra={"count": len(seed_urls)})
+        for url in seed_urls:
+            try:
+                sm.add_page_to_page_buffer(Page(
+                    id=new_id(), url=url, depth=0, status=PAGE_UNFETCHED,
+                    timestamp=utcnow(), sequence_id=new_id()))
+            except Exception as e:
+                logger.error("random-walk-init: failed to seed URL", extra={
+                    "url": url, "error": str(e)})
+    else:
+        sm.initialize_random_walk_layer()
+
+
+def launch(seed_urls: List[str], cfg: CrawlerConfig, sm=None,
+           chunker=None, yt_pool: Optional[YtWorkerPool] = None,
+           yt_transport=None, validate_fn=None,
+           layerless_poll_s: Optional[float] = None) -> None:
+    """`dapr/standalone.go:236-414`.
+
+    Injection seams (all optional, used by tests and embedding callers):
+    `sm` (prebuilt state manager), `chunker` (started/stopped around the
+    crawl), `yt_pool`/`yt_transport` (YouTube client wiring), `validate_fn`
+    (validator HTTP seam)."""
+    owns_sm = sm is None
+    if owns_sm:
+        temp_sm = create_state_manager(cfg)
+        crawl_exec_id, is_resuming = determine_crawl_id(temp_sm, cfg)
+        sm = create_state_manager(cfg, crawl_exec_id)
+    else:
+        crawl_exec_id, is_resuming = cfg.crawl_id, False
+
+    if chunker is None and cfg.combine_files:
+        from ..chunk import Chunker
+        chunker = Chunker(sm, cfg.combine_temp_dir, cfg.combine_watch_dir,
+                          cfg.combine_write_dir,
+                          trigger_size=cfg.combine_trigger_size,
+                          hard_cap=cfg.combine_hard_cap)
+
+    if chunker is not None:
+        chunker.start()
+    try:
+        if cfg.validate_only:
+            sm.initialize([])
+            run_validate_only(sm, cfg, validate_fn=validate_fn)
+            return
+
+        if cfg.sampling_method == "random" and cfg.platform == "youtube":
+            sm.initialize([])
+            run_random_youtube_sample(sm, cfg, transport=yt_transport)
+        elif cfg.sampling_method == "random-walk" \
+                and cfg.platform == "telegram":
+            seed_random_walk(sm, seed_urls)
+            run_random_walk_layerless(sm, cfg,
+                                      poll_interval_s=layerless_poll_s)
+        else:
+            sm.initialize(seed_urls)
+            owns_yt_pool = False
+            if cfg.platform == "youtube" and yt_pool is None:
+                yt_pool = make_yt_pool(sm, cfg, yt_transport)
+                owns_yt_pool = True
+            try:
+                process_layers_iteratively(sm, cfg, is_resuming,
+                                           yt_pool=yt_pool)
+            finally:
+                if owns_yt_pool:
+                    yt_pool.close()
+
+        logger.info("saving final state before marking crawl as completed")
+        sm.save_state()
+        sm.update_crawl_metadata(cfg.crawl_id, {
+            "status": "completed",
+            "endTime": utcnow().isoformat(),
+            "previousCrawlID": crawl_exec_id,
+        })
+        try:
+            sm.export_pages_to_binding(cfg.crawl_id)
+        except Exception as e:
+            logger.error("error exporting pages to binding: %s", e)
+        logger.info("all items processed successfully")
+    finally:
+        if chunker is not None:
+            chunker.shutdown()
+        if owns_sm:
+            try:
+                sm.close()
+            except Exception:
+                pass

@@ -1,0 +1,122 @@
+"""Standalone mode: single-process, deliberately sequential layer walk.
+
+Parity with the reference's `standalone/runner.go` (912 LoC): resume
+detection (`:252-293`), sequential per-page processing with panic containment
+and a state save after every page (`:594-873`), completion metadata
+(`:884-909`).  The parallel variants live in `modes/layers.py`.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional
+
+from ..config.crawler import CrawlerConfig
+from ..crawl import runner as crawl_runner
+from ..state.datamodels import (
+    PAGE_ERROR,
+    PAGE_FETCHED,
+    utcnow,
+)
+from .common import create_state_manager, determine_crawl_id
+from .layers import YtWorkerPool, fetch_youtube_page
+
+logger = logging.getLogger("dct.modes.standalone")
+
+
+def run_sequential_layers(sm, cfg: CrawlerConfig,
+                          is_resuming_same_execution: bool,
+                          yt_pool: Optional[YtWorkerPool] = None,
+                          clock=time.monotonic) -> int:
+    """Sequential depth walk (`standalone/runner.go:594-873`); returns pages
+    processed."""
+    depth = 0
+    total = 0
+    start = clock()
+    max_depth_cfg = cfg.max_depth if cfg.max_depth > 0 else 2 ** 31
+    while depth <= max_depth_cfg:
+        layer = sm.get_layer_by_depth(depth)
+        if not layer:
+            logger.info("no pages found at depth %d, crawl complete", depth)
+            break
+        logger.info("processing layer", extra={
+            "depth": depth, "pages": len(layer)})
+        for page in layer:
+            if page.status == PAGE_FETCHED and is_resuming_same_execution:
+                logger.debug("skipping already fetched page during same "
+                             "execution resume: %s", page.url)
+                continue
+            if cfg.max_crawl_duration_s > 0 and \
+                    clock() - start >= cfg.max_crawl_duration_s:
+                logger.info("max crawl duration reached")
+                return total
+            total += 1
+            # Self-contained per-page processing (`runner.go:697-711`).
+            try:
+                page.timestamp = utcnow()
+                if cfg.platform == "youtube":
+                    if yt_pool is None:
+                        raise ValueError(
+                            "youtube processing needs a YtWorkerPool")
+                    worker = yt_pool.acquire()
+                    try:
+                        fetch_youtube_page(worker.crawler, cfg, page)
+                    finally:
+                        yt_pool.release(worker)
+                else:
+                    crawl_runner.run_for_channel_with_pool(
+                        page, cfg.storage_root, sm, cfg)
+            except Exception as e:
+                logger.error("recovered from failure while processing item",
+                             extra={"url": page.url, "error": str(e)})
+                page.status = PAGE_ERROR
+                page.error = str(e)
+            else:
+                page.status = PAGE_FETCHED
+            # Persist after EVERY page (`runner.go:716-720,855`).
+            try:
+                sm.update_page(page)
+                sm.save_state()
+            except Exception as e:
+                logger.error("failed to save state after page", extra={
+                    "url": page.url, "error": str(e)})
+        depth += 1
+    return total
+
+
+def start_standalone_mode(seed_urls: List[str], cfg: CrawlerConfig,
+                          sm=None, yt_pool: Optional[YtWorkerPool] = None,
+                          yt_transport=None) -> int:
+    """`standalone/runner.go:37,206-319`: resume-or-new execution, init,
+    sequential walk, completion metadata."""
+    temp_sm = sm or create_state_manager(cfg)
+    if sm is None:
+        crawl_exec_id, is_resuming = determine_crawl_id(temp_sm, cfg)
+        sm = create_state_manager(cfg, crawl_exec_id)
+    else:
+        crawl_exec_id, is_resuming = cfg.crawl_id, False
+    sm.initialize(seed_urls)
+
+    owns_yt_pool = False
+    if cfg.platform == "youtube" and yt_pool is None:
+        from .runner import make_yt_pool
+        yt_pool = make_yt_pool(sm, cfg, yt_transport)
+        owns_yt_pool = True
+    try:
+        processed = run_sequential_layers(sm, cfg, is_resuming,
+                                          yt_pool=yt_pool)
+    finally:
+        if owns_yt_pool:
+            yt_pool.close()
+
+    sm.update_crawl_metadata(cfg.crawl_id, {
+        "status": "completed",
+        "endTime": utcnow().isoformat(),
+        "previousCrawlID": crawl_exec_id,
+        "pages_processed": processed,
+    })
+    sm.close()
+    logger.info("standalone crawl completed", extra={
+        "pages_processed": processed})
+    return processed

@@ -1,0 +1,68 @@
+"""Validator-only mode: cache warmup, stale/orphan recovery, validation loop.
+
+Parity with the reference's validate-only branch
+(`dapr/standalone.go:276-314`): load seed/invalid/discovered caches, recover
+edges and batches stuck in intermediate states from prior crashes (10-min
+staleness), then run the tandem validation loop.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..config.crawler import CrawlerConfig
+from ..crawl.validator import RunValidationLoop, ValidatorConfig
+
+logger = logging.getLogger("dct.modes.validate")
+
+STALE_THRESHOLD_S = 600.0  # 10 min (`dapr/standalone.go:289`)
+
+
+def prepare_validator_state(sm) -> None:
+    """Cache warmup + crash recovery (`dapr/standalone.go:279-306`)."""
+    try:
+        sm.load_seed_channels()
+    except Exception as e:
+        logger.warning("validator-mode: failed to load seed channels "
+                       "(continuing): %s", e)
+    try:
+        sm.load_invalid_channels()
+    except Exception as e:
+        logger.warning("validator-mode: failed to load invalid channels "
+                       "(continuing): %s", e)
+    sm.initialize_discovered_channels()
+
+    for name, fn in (
+            ("stale edge claims",
+             lambda: sm.recover_stale_edge_claims(STALE_THRESHOLD_S)),
+            ("stale batch claims",
+             lambda: sm.recover_stale_batch_claims(STALE_THRESHOLD_S)),
+            ("orphan edges", sm.recover_orphan_edges)):
+        try:
+            n = fn()
+            if n:
+                logger.info("validator-mode: recovered %d %s", n, name)
+        except Exception as e:
+            logger.warning("validator-mode: failed to recover %s: %s",
+                           name, e)
+
+
+def run_validate_only(sm, cfg: CrawlerConfig,
+                      vcfg: Optional[ValidatorConfig] = None,
+                      validate_fn=None,
+                      loop: Optional[RunValidationLoop] = None,
+                      block: bool = True) -> RunValidationLoop:
+    """`dapr/standalone.go:276-314`; returns the running loop (caller stops
+    it when block=False)."""
+    prepare_validator_state(sm)
+    loop = loop or RunValidationLoop(sm, cfg, vcfg=vcfg,
+                                     validate_fn=validate_fn)
+    loop.start()
+    if block:
+        try:
+            loop.stop_event.wait()
+        finally:
+            loop.stop()
+            sm.close()
+    return loop

@@ -1,0 +1,115 @@
+"""YouTube random-sampling driver.
+
+Parity with the reference's `RunRandomYoutubeSample`
+(`dapr/standalone.go:1175-1243`): loop up to SampleSize*100+100 iterations,
+3x exponential-backoff retry per fetch, decrement samples_remaining by the
+posts returned, stop at <= 0; and `InitializeYoutubeCrawlerComponents`
+(`:1024-1074`) building the client + registry crawler pair.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Optional, Tuple
+
+from ..clients.youtube import YouTubeDataClient, YouTubeTransport
+from ..config.crawler import CrawlerConfig
+from ..crawlers import CrawlerFactory, register_all_crawlers
+from ..crawlers.base import Crawler, CrawlJob, CrawlTarget
+from ..datamodel import NullValidator
+from .common import calculate_date_filters
+
+logger = logging.getLogger("dct.modes.youtube_random")
+
+MAX_FETCH_ATTEMPTS = 3  # `dapr/standalone.go:1205`
+
+
+def initialize_youtube_crawler_components(
+        sm, cfg: CrawlerConfig,
+        transport: Optional[YouTubeTransport] = None
+        ) -> Tuple[Crawler, YouTubeDataClient]:
+    """Build a connected client + initialized registry crawler
+    (`dapr/standalone.go:1024-1074`).  `transport` is the HTTP seam; tests
+    pass the in-tree fake."""
+    if not cfg.youtube_api_key:
+        logger.error("YouTube API key is empty - provide --youtube-api-key")
+    if transport is None:
+        from ..clients.youtube import HttpYouTubeTransport
+        transport = HttpYouTubeTransport()
+    client = YouTubeDataClient(cfg.youtube_api_key, transport)
+    client.connect()
+    factory = CrawlerFactory()
+    register_all_crawlers(factory)
+    crawler = factory.get_crawler("youtube")
+    crawler.initialize({
+        "client": client,
+        "state_manager": sm,
+        "sampling_method": cfg.sampling_method,
+        "crawl_label": cfg.crawl_label,
+        "min_channel_videos": cfg.min_channel_videos,
+    })
+    return crawler, client
+
+
+def run_random_youtube_sample(sm, cfg: CrawlerConfig,
+                              crawler: Optional[Crawler] = None,
+                              transport: Optional[YouTubeTransport] = None,
+                              sleep=time.sleep) -> int:
+    """`dapr/standalone.go:1175-1243`; returns total posts sampled."""
+    if cfg.sample_size <= 0:
+        logger.warning("YouTube random sampling requires sample_size > 0; "
+                       "nothing to do")
+        return 0
+
+    client = None
+    if crawler is None:
+        crawler, client = initialize_youtube_crawler_components(
+            sm, cfg, transport)
+
+    from_time, to_time = calculate_date_filters(cfg)
+    job = CrawlJob(
+        target=CrawlTarget(id=cfg.crawl_id, type="youtube"),
+        from_time=from_time, to_time=to_time,
+        limit=cfg.max_posts if cfg.max_posts > 0 else 0,
+        sample_size=cfg.sample_size,
+        samples_remaining=cfg.sample_size,
+        null_validator=NullValidator("youtube"))
+
+    total = 0
+    max_iter = cfg.sample_size * 100 + 100
+    try:
+        for it in range(max_iter):
+            result = None
+            backoff = 1.0
+            err: Optional[Exception] = None
+            for attempt in range(MAX_FETCH_ATTEMPTS):
+                try:
+                    result = crawler.fetch_messages(job)
+                    err = None
+                    break
+                except Exception as e:
+                    err = e
+                    logger.warning("fetch_messages failed, retrying", extra={
+                        "attempt": attempt + 1, "error": str(e)})
+                    sleep(backoff)
+                    backoff *= 2
+            if err is not None or result is None:
+                logger.error("failed to fetch messages after retries: %s", err)
+                break
+            n = len(result.posts)
+            total += n
+            job.samples_remaining -= n
+            logger.info("YouTube random sampling progress", extra={
+                "new_videos_processed": n,
+                "samples_left": job.samples_remaining})
+            if job.samples_remaining <= 0:
+                logger.info("finished fetching random samples")
+                break
+            if it == max_iter - 1:
+                logger.warning("hit max iterations without reaching sample "
+                               "target", extra={"max_iterations": max_iter})
+    finally:
+        if client is not None:
+            client.disconnect()
+    return total

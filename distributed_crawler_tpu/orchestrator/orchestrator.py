@@ -150,7 +150,6 @@ class Orchestrator:
 
     def _loop(self, tick, interval_s: float) -> None:
         while self.is_running:
-            time.sleep(min(interval_s, 0.05))
             deadline = self.clock() + interval_s
             # Coarse sleep in small slices so stop() is responsive.
             while self.is_running and self.clock() < deadline:
@@ -174,6 +173,15 @@ class Orchestrator:
         (`orchestrator.go:189-210`), which stalls once a layer is fully
         fetched; here a layer with no pending and no in-flight pages also
         advances."""
+        if self.config.max_depth > 0 and \
+                self.current_depth > self.config.max_depth:
+            with self._mu:
+                active = len(self.active_work)
+            if active == 0 and not self.crawl_completed:
+                logger.info("configured max depth reached",
+                            extra={"max_depth": self.config.max_depth})
+                self._mark_crawl_completed()
+            return 0
         pages = self.sm.get_layer_by_depth(self.current_depth)
         pending = [p for p in pages
                    if p.status == PAGE_UNFETCHED
@@ -217,7 +225,11 @@ class Orchestrator:
                 logger.error("failed to publish work item", extra={
                     "work_item_id": item.id, "error": str(e)})
                 page.status = PAGE_UNFETCHED
-                self.sm.update_page(page)
+                try:
+                    self.sm.update_page(page)
+                except Exception as revert_err:
+                    logger.error("failed to revert page status", extra={
+                        "page_url": page.url, "error": str(revert_err)})
                 with self._mu:
                     self.active_work.pop(item.id, None)
                     self.total_work_items -= 1
@@ -273,8 +285,13 @@ class Orchestrator:
             else:
                 page.status = PAGE_ERROR
                 page.error = result.error
-                self._retry_counts[page.id] = \
-                    self._retry_counts.get(page.id, 0) + 1
+                if result.retry_recommended:
+                    self._retry_counts[page.id] = \
+                        self._retry_counts.get(page.id, 0) + 1
+                else:
+                    # Worker classified the failure as permanent
+                    # (`worker.go:436-456`): exhaust the retry budget.
+                    self._retry_counts[page.id] = self.ocfg.max_retries
             page.timestamp = result.completed_at or utcnow()
             try:
                 self.sm.update_page(page)
@@ -321,6 +338,13 @@ class Orchestrator:
             worker.tasks_error = message.tasks_error
             if message.current_work is not None:
                 worker.current_work = message.current_work
+                # Record the claim so failed-worker reassignment knows which
+                # items this worker held (the busy heartbeat carries the
+                # item id, `worker.go:255-263`).
+                item = self.active_work.get(message.current_work)
+                if item is not None:
+                    item.assigned_to = message.worker_id
+                    item.assigned_at = worker.last_seen
 
     # -- health monitoring (`orchestrator.go:472-559`) ---------------------
     def check_worker_health(self, now: Optional[datetime] = None) -> List[str]:

@@ -37,6 +37,7 @@ class LocalStateManager(BaseStateManager):
             provider = LocalStorageProvider(base_path)
         self.provider = provider
         self.media_cache = ShardedMediaCache(provider, config.crawl_id)
+        self._initialized = False
 
     # --- paths (`storageproviders.go:636-646`) ----------------------------
     def _state_path(self) -> str:
@@ -48,9 +49,12 @@ class LocalStateManager(BaseStateManager):
     # --- lifecycle -------------------------------------------------------
     def initialize(self, seed_urls: List[str]) -> None:
         """Load persisted state if present, else seed a fresh one
-        (`storageproviders.go:360-430`)."""
+        (`storageproviders.go:360-430`).  A snapshot with no layers is not a
+        resumable crawl — seed fresh instead (an empty state.json can be left
+        behind by a temporary resume-probe manager)."""
+        self._initialized = True
         existing = self.provider.load_json(self._state_path())
-        if existing:
+        if existing and existing.get("layers"):
             self.set_state(State.from_dict(existing))
             logger.info("resumed state for crawl %s (%d pages)",
                         self.config.crawl_id, len(self.page_map))
@@ -66,7 +70,10 @@ class LocalStateManager(BaseStateManager):
         self.media_cache.save()
 
     def close(self) -> None:
-        self.save_state()
+        # A manager that never initialized (e.g. the temporary resume probe
+        # in determine_crawl_id) must not overwrite state on close.
+        if self._initialized:
+            self.save_state()
 
     # --- posts/files ------------------------------------------------------
     def store_post(self, channel_id: str, post: Post) -> None:

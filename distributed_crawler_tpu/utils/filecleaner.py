@@ -1,0 +1,115 @@
+"""Background janitor deleting aged client media files.
+
+Parity with the reference's `telegramhelper/filecleaner.go` (240 LoC): scan
+`conn_*` connection directories under a base dir, delete files older than a
+threshold from the configured subpaths (default media caches), on an
+interval; started in job mode (`dapr/job.go:616-632`).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import threading
+import time
+from typing import List, Optional
+
+logger = logging.getLogger("dct.filecleaner")
+
+DEFAULT_SUBPATHS = [".tdlib/files/videos"]  # `filecleaner.go:33`
+CONN_FOLDER_RE = re.compile(r"^conn_\d+")
+
+
+class FileCleaner:
+    """`filecleaner.go:30-240`."""
+
+    def __init__(self, base_dir: str,
+                 target_subpaths: Optional[List[str]] = None,
+                 cleanup_interval_minutes: float = 30.0,
+                 file_age_threshold_minutes: float = 60.0):
+        self.base_dir = base_dir
+        self.target_subpaths = list(target_subpaths or DEFAULT_SUBPATHS)
+        self.cleanup_interval_s = cleanup_interval_minutes * 60.0
+        self.file_age_threshold_s = file_age_threshold_minutes * 60.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self.files_removed = 0
+
+    def start(self) -> None:
+        with self._lock:
+            if self._thread is not None:
+                raise RuntimeError("file cleaner is already running")
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="dct-filecleaner")
+            self._thread.start()
+        logger.info("file cleaner started", extra={
+            "base_dir": self.base_dir,
+            "path_patterns": [os.path.join("conn_*", p)
+                              for p in self.target_subpaths],
+            "file_age_threshold_min": self.file_age_threshold_s / 60.0})
+
+    def stop(self) -> None:
+        with self._lock:
+            if self._thread is None:
+                return
+            self._stop.set()
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        logger.info("file cleaner stopped")
+
+    def _loop(self) -> None:
+        # Run immediately on start, then on the interval (`:95-110`).
+        self.clean_old_files()
+        while not self._stop.wait(self.cleanup_interval_s):
+            self.clean_old_files()
+
+    def clean_old_files(self, now: Optional[float] = None) -> int:
+        """One sweep; returns files removed (`filecleaner.go:113-240`)."""
+        now = now if now is not None else time.time()
+        cutoff = now - self.file_age_threshold_s
+        removed = 0
+        if not os.path.isdir(self.base_dir):
+            return 0
+        try:
+            entries = os.listdir(self.base_dir)
+        except OSError as e:
+            logger.warning("cannot list base dir %s: %s", self.base_dir, e)
+            return 0
+        for entry in entries:
+            if not CONN_FOLDER_RE.match(entry):
+                continue
+            for sub in self.target_subpaths:
+                target = os.path.join(self.base_dir, entry, sub)
+                if not os.path.isdir(target):
+                    continue
+                removed += self._clean_dir(target, cutoff)
+        if removed:
+            logger.info("file cleanup complete",
+                        extra={"files_removed": removed})
+        self.files_removed += removed
+        return removed
+
+    def _clean_dir(self, directory: str, cutoff: float) -> int:
+        removed = 0
+        try:
+            names = os.listdir(directory)
+        except OSError:
+            return 0
+        for name in names:
+            path = os.path.join(directory, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            if not os.path.isfile(path):
+                continue
+            if st.st_mtime < cutoff:
+                try:
+                    os.remove(path)
+                    removed += 1
+                except OSError as e:
+                    logger.warning("failed to remove %s: %s", path, e)
+        return removed

@@ -50,18 +50,12 @@ logger = logging.getLogger("dct.worker")
 
 # Error-classification substrings (`worker/worker.go:436-456`).
 _PERMANENT_MARKERS = ("not found", "access denied", "forbidden")
-_RETRYABLE_MARKERS = ("connection", "timeout", "temporary")
 
 
 def should_retry_error(err: Exception) -> bool:
-    """`worker/worker.go:436-456`: permanent markers win, then retryable,
-    default retry."""
-    s = str(err).lower()
-    if any(m in s for m in _PERMANENT_MARKERS):
-        return False
-    if any(m in s for m in _RETRYABLE_MARKERS):
-        return True
-    return True
+    """`worker/worker.go:436-456`: permanent markers win; everything else
+    (connection/timeout/unknown) defaults to retry."""
+    return not any(m in str(err).lower() for m in _PERMANENT_MARKERS)
 
 
 def work_item_config_to_crawler_config(config: WorkItemConfig,
@@ -196,12 +190,6 @@ class CrawlWorker:
         self.send_status_update(MSG_HEARTBEAT, WORKER_BUSY)
         try:
             result = self.process_work_item(item)
-            with self._mu:
-                if result.status == STATUS_SUCCESS:
-                    self.tasks_success += 1
-                else:
-                    self.tasks_error += 1
-                self.tasks_processed += 1
         finally:
             with self._mu:
                 self.current_work = None
@@ -210,9 +198,19 @@ class CrawlWorker:
                              ResultMessage.new(result,
                                                result.discovered_pages))
         except Exception as e:
+            # Re-raise so the bus redelivers the work item (the reference
+            # returns the error for pubsub retry, `worker.go:210-214`).
             logger.error("failed to publish result", extra={
                 "work_item_id": item.id, "error": str(e)})
             raise
+        # Counters move only after a successful publish so a redelivered
+        # item doesn't double-count.
+        with self._mu:
+            if result.status == STATUS_SUCCESS:
+                self.tasks_success += 1
+            else:
+                self.tasks_error += 1
+            self.tasks_processed += 1
         self.send_status_update(MSG_HEARTBEAT, WORKER_IDLE)
         logger.info("work item processed and result sent", extra={
             "work_item_id": item.id, "status": result.status,
@@ -226,16 +224,18 @@ class CrawlWorker:
                     timestamp=utcnow(), parent_id=item.parent_id)
         discovered: List[Page] = []
         message_count = 0
+        item_errors: List[str] = []
         error: Optional[Exception] = None
         try:
             if item.platform == "telegram":
                 discovered = self._process_telegram(page, item)
+                message_count = sum(1 for m in page.messages
+                                    if m.status == "fetched")
             elif item.platform == "youtube":
-                discovered = self._process_youtube(page, item)
+                discovered, message_count, item_errors = \
+                    self._process_youtube(page, item)
             else:
                 raise ValueError(f"unsupported platform: {item.platform}")
-            message_count = sum(1 for m in page.messages
-                                if m.status == "fetched")
         except Exception as e:
             error = e
             logger.error("failed to process work item", extra={
@@ -247,6 +247,8 @@ class CrawlWorker:
             processing_time_s=time.monotonic() - start,
             completed_at=utcnow(),
             metadata={"platform": item.platform, "depth": item.depth})
+        if item_errors:
+            result.metadata["item_errors"] = item_errors
         if error is not None:
             result.status = STATUS_ERROR
             result.error = str(error)
@@ -266,10 +268,11 @@ class CrawlWorker:
         return crawl_runner.run_for_channel_with_pool(
             page, item.config.storage_root, self.sm, cfg)
 
-    def _process_youtube(self, page: Page, item: WorkItem) -> List[Page]:
+    def _process_youtube(self, page: Page, item: WorkItem
+                         ) -> "tuple[List[Page], int, List[str]]":
         """YouTube in distributed mode — implemented here via the crawler
         registry (the reference returned 'not yet implemented',
-        `worker.go:403-408`)."""
+        `worker.go:403-408`).  Returns (discovered, post_count, errors)."""
         if self.youtube_crawler is None:
             raise ValueError(
                 "YouTube processing requires a youtube_crawler instance")
@@ -282,7 +285,6 @@ class CrawlWorker:
             limit=cfg.max_posts if cfg.max_posts > 0 else 0,
             sample_size=cfg.sample_size)
         result = self.youtube_crawler.fetch_messages(job)
-        page.messages = []
         discovered: List[Page] = []
         seen = {item.url}
         for post in result.posts:
@@ -292,7 +294,7 @@ class CrawlWorker:
                     discovered.append(Page(
                         id=new_id(), url=link, depth=page.depth + 1,
                         parent_id=page.id))
-        return discovered
+        return discovered, len(result.posts), list(result.errors)
 
     # -- status (`worker.go:459-477`) --------------------------------------
     def get_status(self) -> Dict[str, Any]:

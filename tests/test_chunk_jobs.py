@@ -1,0 +1,315 @@
+"""Chunker, file-cleaner, and job-service tests.
+
+Reference analogs: chunk/main_test.go (691 LoC — rotation/overflow/batching),
+telegramhelper/filecleaner tests, and dapr/job.go merge/routing logic.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from distributed_crawler_tpu.chunk import Chunker, FileEntry, ProcessedMap
+from distributed_crawler_tpu.config import CrawlerConfig
+from distributed_crawler_tpu.modes.jobs import (
+    JobData,
+    JobScheduler,
+    JobService,
+    extract_base_job_type,
+    merge_config_with_job_data,
+)
+from distributed_crawler_tpu.utils.filecleaner import FileCleaner
+
+
+class RecordingSM:
+    def __init__(self, fail_times=0):
+        self.uploaded = []
+        self.fail_times = fail_times
+
+    def upload_combined_file(self, path):
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise RuntimeError("upload backend down")
+        # Record content so we can check batch composition after deletion.
+        with open(path, "rb") as f:
+            self.uploaded.append((os.path.basename(path), f.read()))
+
+
+def make_chunker(tmp_path, sm=None, **kw):
+    defaults = dict(trigger_size=100, hard_cap=200, batch_timeout_s=0.2,
+                    scan_interval_s=0.02, recovery_interval_s=3600)
+    defaults.update(kw)
+    return Chunker(sm or RecordingSM(),
+                   str(tmp_path / "tmp"), str(tmp_path / "watch"),
+                   str(tmp_path / "combine"), **defaults)
+
+
+def write_shard(tmp_path, name, content):
+    p = tmp_path / "watch" / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_bytes(content)
+    return str(p)
+
+
+class TestProcessedMap:
+    def test_double_buffer_rotation(self):
+        m = ProcessedMap()
+        m.mark("a")
+        m.rotate()
+        assert m.seen("a")  # still in previous
+        m.mark("b")
+        m.rotate()
+        assert not m.seen("a")  # evicted after two rotations
+        assert m.seen("b")
+
+
+class TestChunker:
+    def test_combines_uploads_and_deletes(self, tmp_path):
+        sm = RecordingSM()
+        c = make_chunker(tmp_path, sm)
+        p1 = write_shard(tmp_path, "a.jsonl", b'{"x":1}\n' * 8)  # 64 B
+        p2 = write_shard(tmp_path, "b.jsonl", b'{"y":2}\n' * 8)  # 64 B -> 128
+        c.start()
+        deadline = time.monotonic() + 5
+        while not sm.uploaded and time.monotonic() < deadline:
+            time.sleep(0.05)
+        c.shutdown()
+        assert sm.uploaded, "expected at least one combined upload"
+        name, content = sm.uploaded[0]
+        assert name.startswith("combined_")
+        assert content.count(b"\n") == 16  # both files combined
+        assert not os.path.exists(p1) and not os.path.exists(p2)
+        # Combined file cleaned up after upload.
+        assert os.listdir(tmp_path / "combine") == []
+
+    def test_oversize_file_deleted_not_uploaded(self, tmp_path):
+        sm = RecordingSM()
+        c = make_chunker(tmp_path, sm)
+        big = write_shard(tmp_path, "big.jsonl", b"z" * 500)  # > hard cap 200
+        c.start()
+        deadline = time.monotonic() + 3
+        while os.path.exists(big) and time.monotonic() < deadline:
+            time.sleep(0.05)
+        c.shutdown()
+        assert not os.path.exists(big)
+        assert all(b"z" * 500 not in content for _, content in sm.uploaded)
+
+    def test_timeout_flushes_partial_batch(self, tmp_path):
+        sm = RecordingSM()
+        c = make_chunker(tmp_path, sm, trigger_size=10_000)
+        write_shard(tmp_path, "small.jsonl", b'{"s":1}\n')
+        c.start()
+        deadline = time.monotonic() + 5
+        while not sm.uploaded and time.monotonic() < deadline:
+            time.sleep(0.05)
+        c.shutdown()
+        assert sm.uploaded  # flushed by 0.2 s timeout, not trigger size
+
+    def test_upload_retry_then_success(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(
+            "distributed_crawler_tpu.chunk.chunker.UPLOAD_RETRY_DELAY_S",
+            0.05)
+        sm = RecordingSM(fail_times=1)
+        c = make_chunker(tmp_path, sm)
+        write_shard(tmp_path, "r.jsonl", b"x" * 150)  # >= trigger
+        c.start()
+        deadline = time.monotonic() + 5
+        while not sm.uploaded and time.monotonic() < deadline:
+            time.sleep(0.05)
+        c.shutdown()
+        assert sm.uploaded
+
+    def test_recovery_reuploads_stranded_combined_files(self, tmp_path):
+        sm = RecordingSM()
+        c = make_chunker(tmp_path, sm)
+        os.makedirs(tmp_path / "combine", exist_ok=True)
+        stranded = tmp_path / "combine" / "combined_123.jsonl"
+        stranded.write_bytes(b"stranded\n")
+        c.recover_combine_dir()
+        assert sm.uploaded[0][0] == "combined_123.jsonl"
+        assert not stranded.exists()
+
+
+class TestFileCleaner:
+    def test_removes_only_old_files_in_conn_dirs(self, tmp_path):
+        base = tmp_path / "store"
+        old_dir = base / "conn_123" / ".tdlib" / "files" / "videos"
+        old_dir.mkdir(parents=True)
+        old_file = old_dir / "old.mp4"
+        old_file.write_bytes(b"v")
+        os.utime(old_file, (time.time() - 7200, time.time() - 7200))
+        new_file = old_dir / "new.mp4"
+        new_file.write_bytes(b"v")
+        outside = base / "not_conn" / ".tdlib" / "files" / "videos"
+        outside.mkdir(parents=True)
+        outside_file = outside / "old.mp4"
+        outside_file.write_bytes(b"v")
+        os.utime(outside_file, (time.time() - 7200, time.time() - 7200))
+
+        fc = FileCleaner(str(base), file_age_threshold_minutes=60)
+        removed = fc.clean_old_files()
+        assert removed == 1
+        assert not old_file.exists()
+        assert new_file.exists()
+        assert outside_file.exists()  # only conn_* dirs are swept
+
+    def test_start_stop_idempotence(self, tmp_path):
+        fc = FileCleaner(str(tmp_path), cleanup_interval_minutes=1000)
+        fc.start()
+        with pytest.raises(RuntimeError):
+            fc.start()
+        fc.stop()
+        fc.stop()  # no-op
+
+
+class TestJobData:
+    def test_json_round_trip(self):
+        job = JobData(job_name="youtube-crawl-99", task="crawl",
+                      urls=["UC_a"], platform="youtube", max_posts=10,
+                      sample_size=5)
+        again = JobData.from_dict(json.loads(json.dumps(job.to_dict())))
+        assert again == job
+
+    def test_extract_base_job_type(self):
+        assert extract_base_job_type("youtube-crawl-1234") == "youtube-crawl"
+        assert extract_base_job_type("telegram-crawl") == "telegram-crawl"
+        assert extract_base_job_type("maintenance-job-x") == "maintenance-job"
+        assert extract_base_job_type("mystery") == "mystery"
+
+    def test_merge_job_overrides_cli(self):
+        base = CrawlerConfig(concurrency=2, max_depth=3, platform="telegram",
+                             crawl_id="cli-id")
+        merged = merge_config_with_job_data(base, JobData(
+            concurrency=8, platform="youtube", sample_size=100))
+        assert merged.concurrency == 8
+        assert merged.platform == "youtube"
+        assert merged.sample_size == 100
+        assert merged.max_depth == 3  # unset in job -> CLI wins
+        assert merged.crawl_id == "cli-id"
+        assert base.concurrency == 2  # base untouched
+
+
+class FakeCleaner:
+    instances = []
+
+    def __init__(self, base_dir, *a, **kw):
+        self.base_dir = base_dir
+        self.started = False
+        self.stopped = False
+        self.cleaned = 0
+        FakeCleaner.instances.append(self)
+
+    def start(self):
+        self.started = True
+
+    def stop(self):
+        self.stopped = True
+
+    def clean_old_files(self):
+        self.cleaned += 1
+        return 0
+
+
+class TestJobService:
+    def _service(self, launches):
+        FakeCleaner.instances = []
+        return JobService(
+            CrawlerConfig(platform="", storage_root="/tmp/js"),
+            launch_fn=lambda urls, cfg: launches.append((urls, cfg)),
+            file_cleaner_factory=FakeCleaner)
+
+    def test_platform_autodetect_from_job_type(self):
+        launches = []
+        svc = self._service(launches)
+        svc.handle_job("youtube-crawl-777", JobData(
+            job_name="youtube-crawl-777", urls=["UC_a"]).to_dict())
+        urls, cfg = launches[0]
+        assert urls == ["UC_a"]
+        assert cfg.platform == "youtube"
+        assert cfg.crawl_id  # generated
+        assert not FakeCleaner.instances  # no cleaner for youtube
+
+    def test_telegram_job_starts_file_cleaner(self):
+        launches = []
+        svc = self._service(launches)
+        svc.handle_job("telegram-crawl", JobData(
+            job_name="telegram-crawl", urls=["chan"]).to_dict())
+        assert launches[0][1].platform == "telegram"
+        cleaner = FakeCleaner.instances[0]
+        assert cleaner.started and cleaner.stopped
+
+    def test_storage_root_env_override(self, monkeypatch):
+        monkeypatch.setenv("STORAGE_ROOT", "/data/override")
+        launches = []
+        svc = self._service(launches)
+        svc.handle_job("scheduled-crawl", JobData(
+            job_name="scheduled-crawl", urls=["x"]).to_dict())
+        assert launches[0][1].storage_root == "/data/override"
+
+    def test_fallback_crawl_by_task_description(self):
+        launches = []
+        svc = self._service(launches)
+        svc.handle_job("mystery-job", JobData(
+            job_name="mystery-job", task="nightly Crawl of channels",
+            platform="telegram").to_dict())
+        assert launches  # routed to crawl despite unknown type
+
+    def test_maintenance_and_generic(self):
+        launches = []
+        svc = self._service(launches)
+        svc.handle_job("maintenance-job", JobData(task="cleanup").to_dict())
+        assert FakeCleaner.instances[0].cleaned == 1
+        svc.handle_job("other", JobData(task="report").to_dict())
+        assert not launches
+        with pytest.raises(ValueError):
+            svc.handle_job("maintenance-job", JobData(task="").to_dict())
+
+    def test_bad_payload_rejected(self):
+        svc = self._service([])
+        with pytest.raises(ValueError, match="unmarshal"):
+            svc.handle_job("telegram-crawl", b"{not json")
+
+
+class TestJobScheduler:
+    def test_due_dispatch_and_delete(self):
+        launches = []
+        svc = JobService(CrawlerConfig(platform="telegram"),
+                         launch_fn=lambda urls, cfg: launches.append(urls),
+                         file_cleaner_factory=FakeCleaner)
+        now = [1000.0]
+        sched = JobScheduler(svc, clock=lambda: now[0])
+        sched.schedule_job("telegram-crawl-1", 10.0,
+                           JobData(job_name="telegram-crawl-1",
+                                   urls=["a"]).to_dict())
+        sched.schedule_job("telegram-crawl-2", 50.0,
+                           JobData(job_name="telegram-crawl-2",
+                                   urls=["b"]).to_dict())
+        assert sched.run_due_jobs() == 0  # nothing due yet
+        now[0] = 1011.0
+        assert sched.run_due_jobs() == 1
+        assert launches == [["a"]]
+        assert sched.get_job("telegram-crawl-1") is None
+        # Delete the second before it fires.
+        assert sched.delete_job("telegram-crawl-2")
+        now[0] = 1100.0
+        assert sched.run_due_jobs() == 0
+        assert sched.get_job("telegram-crawl-2") is None
+
+    def test_background_dispatch(self):
+        fired = []
+        svc = JobService(CrawlerConfig(platform="telegram"),
+                         launch_fn=lambda urls, cfg: fired.append(urls),
+                         file_cleaner_factory=FakeCleaner)
+        sched = JobScheduler(svc)
+        sched.start()
+        try:
+            sched.schedule_job("telegram-crawl-x", 0.05,
+                               JobData(job_name="telegram-crawl-x",
+                                       urls=["now"]).to_dict())
+            deadline = time.monotonic() + 3
+            while not fired and time.monotonic() < deadline:
+                time.sleep(0.02)
+        finally:
+            sched.stop()
+        assert fired == [["now"]]

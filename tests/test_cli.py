@@ -1,0 +1,156 @@
+"""CLI tests: flag precedence, date parsing, validation, mode dispatch.
+
+Reference analogs: main_test.go (sampling validation matrix, time parsing)
+and the viper precedence wiring of main.go:185-520.
+"""
+
+import pytest
+
+from distributed_crawler_tpu.cli import (
+    build_parser,
+    collect_urls,
+    main,
+    resolve_config,
+)
+
+
+def parse(argv):
+    return build_parser().parse_args(argv)
+
+
+def resolve(argv, env=None):
+    return resolve_config(parse(argv), env=env or {})
+
+
+class TestPrecedence:
+    def test_flag_beats_env(self):
+        cfg, _ = resolve(["--concurrency", "7", "--urls", "a"],
+                         env={"CRAWLER_CRAWLER_CONCURRENCY": "3"})
+        assert cfg.concurrency == 7
+
+    def test_env_beats_default(self):
+        cfg, _ = resolve(["--urls", "a"],
+                         env={"CRAWLER_CRAWLER_CONCURRENCY": "3"})
+        assert cfg.concurrency == 3
+
+    def test_config_file(self, tmp_path):
+        f = tmp_path / "config.yaml"
+        f.write_text("crawler:\n  maxposts: 42\n  platform: telegram\n")
+        cfg, _ = resolve(["--config", str(f), "--urls", "a"])
+        assert cfg.max_posts == 42
+
+    def test_missing_explicit_config_file_errors(self):
+        with pytest.raises(FileNotFoundError):
+            resolve(["--config", "/nonexistent/config.yaml", "--urls", "a"])
+
+    def test_defaults(self):
+        cfg, _ = resolve(["--urls", "a"])
+        assert cfg.max_pages == 108000
+        assert cfg.min_users == 100
+        assert cfg.walkback_rate == 15
+        assert cfg.platform == "telegram"
+        assert cfg.sampling_method == "channel"
+        assert cfg.combine_trigger_size == 170 * 1024 * 1024
+
+
+class TestDateWindows:
+    def test_date_between(self):
+        cfg, _ = resolve(["--date-between", "2025-01-01,2025-02-01",
+                          "--urls", "a"])
+        assert cfg.date_between_min.year == 2025
+        assert cfg.date_between_max.month == 2
+
+    def test_time_ago(self):
+        cfg, _ = resolve(["--time-ago", "30d", "--urls", "a"])
+        assert cfg.post_recency is not None
+
+    def test_min_post_date(self):
+        cfg, _ = resolve(["--min-post-date", "2024-06-15", "--urls", "a"])
+        assert cfg.min_post_date.day == 15
+
+    def test_date_between_wins(self):
+        cfg, _ = resolve(["--date-between", "2025-01-01,2025-02-01",
+                          "--time-ago", "7d", "--urls", "a"])
+        assert cfg.date_between_min is not None
+        assert cfg.post_recency is None
+
+    def test_max_crawl_duration(self):
+        cfg, _ = resolve(["--max-crawl-duration", "1h30m", "--urls", "a"])
+        assert cfg.max_crawl_duration_s == 5400.0
+
+
+class TestValidation:
+    def test_invalid_platform_sampling_combo(self):
+        with pytest.raises(ValueError, match="not supported"):
+            resolve(["--platform", "youtube", "--sampling", "random-walk",
+                     "--urls", "a"])
+
+    def test_random_walk_needs_seeds_xor_seed_size(self):
+        with pytest.raises(ValueError, match="seed"):
+            resolve(["--sampling", "random-walk"])
+        cfg, _ = resolve(["--sampling", "random-walk", "--seed-size", "5"])
+        assert cfg.seed_size == 5
+
+    def test_channel_requires_urls(self):
+        with pytest.raises(ValueError):
+            resolve([])
+
+    def test_validate_only_needs_no_urls(self):
+        cfg, _ = resolve(["--validate-only", "--sampling", "random-walk"])
+        assert cfg.validate_only
+
+    def test_job_mode_defers_urls(self):
+        cfg, _ = resolve(["--mode", "job"])
+        assert cfg is not None
+
+
+class TestUrls:
+    def test_urls_flag_and_file(self, tmp_path):
+        f = tmp_path / "urls.txt"
+        f.write_text("one\n# comment\n\ntwo\n")
+        _, r = resolve(["--urls", "zero", "--url-file", str(f)])
+        assert collect_urls(r) == ["zero", "one", "two"]
+
+
+class TestMain:
+    def test_version(self, capsys):
+        assert main(["--version"]) == 0
+        assert "distributed_crawler_tpu" in capsys.readouterr().out
+
+    def test_unknown_mode(self, capsys):
+        rc = main(["--mode", "quantum", "--urls", "a"], env={})
+        assert rc == 2
+        assert "unknown execution mode" in capsys.readouterr().err
+
+    def test_validation_error_exit_code(self, capsys):
+        rc = main(["--platform", "youtube", "--sampling", "random-walk",
+                   "--urls", "a"], env={})
+        assert rc == 2
+
+    def test_standalone_run_with_stubbed_engine(self, tmp_path, monkeypatch):
+        """Full CLI -> standalone mode -> stubbed channel run."""
+        from distributed_crawler_tpu.clients import (
+            SimNetwork,
+            SimTelegramClient,
+        )
+        from distributed_crawler_tpu.clients.pool import ConnectionPool
+        from distributed_crawler_tpu.crawl import runner as crawl_runner
+        from distributed_crawler_tpu.crawl.runner import set_run_for_channel_fn
+
+        crawl_runner.shutdown_connection_pool()
+        net = SimNetwork()
+        crawl_runner.init_connection_pool(ConnectionPool.for_testing(
+            {"c0": SimTelegramClient(net, conn_id="c0")}))
+        calls = []
+        set_run_for_channel_fn(
+            lambda client, page, prefix, sm, cfg, processor=None, rng=None:
+            calls.append(page.url) or [])
+        try:
+            rc = main(["--urls", "chanx", "--storage-root",
+                       str(tmp_path / "s"), "--skip-media",
+                       "--log-level", "error"], env={})
+            assert rc == 0
+            assert calls == ["chanx"]
+        finally:
+            crawl_runner.shutdown_connection_pool()
+            set_run_for_channel_fn(None)

@@ -1,0 +1,407 @@
+"""Execution-mode tests: router, layered drivers, layerless random walk,
+validator mode, YouTube random sampling, resume helpers.
+
+Reference analogs: standalone/runner_test.go (1162 LoC), the driver logic of
+dapr/standalone.go exercised here through the injection seams (stubbed
+run_for_channel, fake YouTube transport, in-memory state).
+"""
+
+import threading
+import time
+from datetime import datetime, timezone
+
+import pytest
+
+from distributed_crawler_tpu.clients import SimNetwork, SimTelegramClient
+from distributed_crawler_tpu.clients.pool import ConnectionPool
+from distributed_crawler_tpu.clients.youtube import FakeYouTubeTransport
+from distributed_crawler_tpu.config import CrawlerConfig
+from distributed_crawler_tpu.crawl import runner as crawl_runner
+from distributed_crawler_tpu.crawl.errors import (
+    FloodWaitRetireError,
+    TDLib400Error,
+    WalkbackExhaustedError,
+)
+from distributed_crawler_tpu.crawl.runner import set_run_for_channel_fn
+from distributed_crawler_tpu.modes import (
+    ValidatorCircuitBreakerError,
+    YtWorkerPool,
+    calculate_date_filters,
+    determine_crawl_id,
+    launch,
+    normalize_seed_urls,
+    process_layer_in_parallel,
+    process_layers_iteratively,
+    run_random_walk_layerless,
+    run_random_youtube_sample,
+    run_sequential_layers,
+    seed_random_walk,
+)
+from distributed_crawler_tpu.state import (
+    CompositeStateManager,
+    Page,
+    SqlConfig,
+    StateConfig,
+)
+from distributed_crawler_tpu.state.datamodels import Layer, new_id
+from tests.test_crawl_engine import text_msg
+
+
+def make_sm(tmp_path, crawl_id="c1", sampling="channel", sub="s"):
+    return CompositeStateManager(StateConfig(
+        crawl_id=crawl_id, crawl_execution_id="e1",
+        storage_root=str(tmp_path / sub), sampling_method=sampling,
+        sql=SqlConfig(url=":memory:")))
+
+
+def make_cfg(**kw):
+    base = dict(crawl_id="c1", platform="telegram", skip_media_download=True,
+                sampling_method="channel", concurrency=2)
+    base.update(kw)
+    return CrawlerConfig(**base)
+
+
+@pytest.fixture
+def stub_pool():
+    """A pool of dummy clients so the facade hands out connections."""
+    crawl_runner.shutdown_connection_pool()
+    net = SimNetwork()
+    clients = {f"conn{i}": SimTelegramClient(net, conn_id=f"conn{i}")
+               for i in range(3)}
+    crawl_runner.init_connection_pool(ConnectionPool.for_testing(clients))
+    yield net
+    crawl_runner.shutdown_connection_pool()
+    set_run_for_channel_fn(None)
+
+
+class TestHelpers:
+    def test_normalize_seed_urls(self):
+        assert normalize_seed_urls([
+            "https://t.me/Alpha", "http://t.me/BETA", "t.me/gamma",
+            "@Delta", "plain"]) == [
+            "alpha", "beta", "gamma", "delta", "plain"]
+
+    def test_date_filters_precedence(self):
+        a = datetime(2025, 1, 1, tzinfo=timezone.utc)
+        b = datetime(2025, 6, 1, tzinfo=timezone.utc)
+        c = datetime(2025, 3, 1, tzinfo=timezone.utc)
+        cfg = make_cfg(date_between_min=a, date_between_max=b, post_recency=c)
+        assert calculate_date_filters(cfg) == (a, b)
+        cfg = make_cfg(post_recency=c)
+        lo, hi = calculate_date_filters(cfg)
+        assert lo == c and hi is not None
+        cfg = make_cfg(min_post_date=a)
+        lo, hi = calculate_date_filters(cfg)
+        assert lo == a and hi is not None
+
+    def test_determine_crawl_id_resume(self):
+        class TempSM:
+            def find_incomplete_crawl(self, crawl_id):
+                return "prev-exec", True
+
+            def close(self):
+                pass
+
+        exec_id, resuming = determine_crawl_id(TempSM(), make_cfg())
+        assert exec_id == "prev-exec" and resuming
+
+    def test_determine_crawl_id_fresh(self):
+        class TempSM:
+            def find_incomplete_crawl(self, crawl_id):
+                return "", False
+
+            def close(self):
+                pass
+
+        exec_id, resuming = determine_crawl_id(TempSM(), make_cfg())
+        assert exec_id and not resuming
+
+
+class TestLayerDrivers:
+    def _seed(self, sm, urls, depth=0):
+        sm.initialize([])
+        sm.add_layer([Page(id=new_id(), url=u, depth=depth) for u in urls])
+
+    def test_parallel_layer_processes_and_builds_next(self, tmp_path,
+                                                      stub_pool):
+        sm = make_sm(tmp_path)
+        self._seed(sm, ["a", "b"])
+
+        def fake_run(client, page, prefix, sm_, cfg, processor=None,
+                     rng=None):
+            if page.url == "a":
+                return [Page(id=new_id(), url="c", depth=page.depth + 1,
+                             parent_id=page.id)]
+            return []
+
+        set_run_for_channel_fn(fake_run)
+        layer = Layer(depth=0, pages=sm.get_layer_by_depth(0))
+        n = process_layer_in_parallel(layer, 2, sm, make_cfg())
+        assert n == 2
+        assert all(p.status == "fetched" for p in sm.get_layer_by_depth(0))
+        assert [p.url for p in sm.get_layer_by_depth(1)] == ["c"]
+
+    def test_parallel_layer_contains_failures(self, tmp_path, stub_pool):
+        sm = make_sm(tmp_path)
+        self._seed(sm, ["ok", "boom"])
+
+        def fake_run(client, page, prefix, sm_, cfg, processor=None,
+                     rng=None):
+            if page.url == "boom":
+                raise RuntimeError("kaput")
+            return []
+
+        set_run_for_channel_fn(fake_run)
+        layer = Layer(depth=0, pages=sm.get_layer_by_depth(0))
+        process_layer_in_parallel(layer, 2, sm, make_cfg())
+        by_url = {p.url: p for p in sm.get_layer_by_depth(0)}
+        assert by_url["ok"].status == "fetched"
+        assert by_url["boom"].status == "error"
+        assert "kaput" in by_url["boom"].error
+
+    def test_iterative_walk_to_max_depth(self, tmp_path, stub_pool):
+        sm = make_sm(tmp_path)
+        self._seed(sm, ["a"])
+        calls = []
+
+        def fake_run(client, page, prefix, sm_, cfg, processor=None,
+                     rng=None):
+            calls.append(page.url)
+            if page.depth < 2:
+                return [Page(id=new_id(), url=page.url + "x",
+                             depth=page.depth + 1, parent_id=page.id)]
+            return []
+
+        set_run_for_channel_fn(fake_run)
+        total = process_layers_iteratively(sm, make_cfg(), True)
+        assert calls == ["a", "ax", "axx"]
+        assert total == 3
+
+    def test_sequential_walk_skips_fetched_on_resume(self, tmp_path,
+                                                     stub_pool):
+        sm = make_sm(tmp_path)
+        self._seed(sm, ["a", "b"])
+        pages = sm.get_layer_by_depth(0)
+        pages[0].status = "fetched"
+        sm.update_page(pages[0])
+        calls = []
+
+        def fake_run(client, page, prefix, sm_, cfg, processor=None,
+                     rng=None):
+            calls.append(page.url)
+            return []
+
+        set_run_for_channel_fn(fake_run)
+        n = run_sequential_layers(sm, make_cfg(), True)
+        assert calls == ["b"]
+        assert n == 1
+
+    def test_duplicate_urls_in_layer_skipped(self, tmp_path, stub_pool):
+        sm = make_sm(tmp_path)
+        sm.initialize([])
+        calls = []
+
+        def fake_run(client, page, prefix, sm_, cfg, processor=None,
+                     rng=None):
+            calls.append(page.url)
+            return []
+
+        set_run_for_channel_fn(fake_run)
+        layer = Layer(depth=0, pages=[
+            Page(id=new_id(), url="dup", depth=0),
+            Page(id=new_id(), url="dup", depth=0)])
+        process_layer_in_parallel(layer, 2, sm, make_cfg())
+        assert calls == ["dup"]
+
+
+class TestLayerless:
+    def test_walk_until_buffer_empty(self, tmp_path, stub_pool):
+        sm = make_sm(tmp_path, sampling="random-walk")
+        sm.initialize([])
+        chain = {"a": "b", "b": "c"}
+
+        def fake_run(client, page, prefix, sm_, cfg, processor=None,
+                     rng=None):
+            nxt = chain.get(page.url)
+            if nxt:
+                sm_.add_page_to_page_buffer(Page(
+                    id=new_id(), url=nxt, depth=page.depth + 1,
+                    sequence_id=new_id()))
+            return []
+
+        set_run_for_channel_fn(fake_run)
+        sm.add_page_to_page_buffer(Page(id=new_id(), url="a", depth=0,
+                                        sequence_id=new_id()))
+        cfg = make_cfg(sampling_method="random-walk", concurrency=2)
+        run_random_walk_layerless(sm, cfg, poll_interval_s=0.01)
+        assert sm.get_pages_from_page_buffer(10) == []
+
+    def test_400_replacement_and_delete(self, tmp_path, stub_pool):
+        sm = make_sm(tmp_path, sampling="random-walk")
+        sm.initialize([])
+        sm.initialize_discovered_channels()
+        sm.add_discovered_channel("fallback")
+        replaced = []
+
+        def fake_run(client, page, prefix, sm_, cfg, processor=None,
+                     rng=None):
+            if page.url == "bad":
+                raise TDLib400Error("USERNAME_NOT_OCCUPIED")
+            replaced.append(page.url)
+            return []
+
+        set_run_for_channel_fn(fake_run)
+        sm.add_page_to_page_buffer(Page(id=new_id(), url="bad", depth=0,
+                                        sequence_id=new_id()))
+        cfg = make_cfg(sampling_method="random-walk", concurrency=1)
+        run_random_walk_layerless(sm, cfg, poll_interval_s=0.01)
+        # 400 page replaced by a walkback to the discovered channel, which
+        # then got processed and drained.
+        assert replaced == ["fallback"]
+        assert sm.is_invalid_channel("bad")
+
+    def test_floodwait_retire_empties_pool_aborts(self, tmp_path):
+        crawl_runner.shutdown_connection_pool()
+        net = SimNetwork()
+        crawl_runner.init_connection_pool(ConnectionPool.for_testing(
+            {"c0": SimTelegramClient(net, conn_id="c0")}))
+        try:
+            sm = make_sm(tmp_path, sampling="random-walk")
+            sm.initialize([])
+
+            def fake_run(client, page, prefix, sm_, cfg, processor=None,
+                         rng=None):
+                raise FloodWaitRetireError(400)
+
+            set_run_for_channel_fn(fake_run)
+            sm.add_page_to_page_buffer(Page(id=new_id(), url="x", depth=0,
+                                            sequence_id=new_id()))
+            cfg = make_cfg(sampling_method="random-walk", concurrency=1)
+            run_random_walk_layerless(sm, cfg, poll_interval_s=0.01)
+            # Page left in buffer for a future restart.
+            assert [p.url for p in sm.get_pages_from_page_buffer(10)] == ["x"]
+        finally:
+            crawl_runner.shutdown_connection_pool()
+            set_run_for_channel_fn(None)
+
+    def test_tandem_circuit_breaker(self, tmp_path, stub_pool):
+        sm = make_sm(tmp_path, sampling="random-walk")
+        sm.initialize([])
+
+        class StuckSM:
+            """Empty buffer but forever-incomplete batches."""
+
+            def __getattr__(self, name):
+                return getattr(sm, name)
+
+            def get_pages_from_page_buffer(self, limit):
+                return []
+
+            def count_incomplete_batches(self, crawl_id):
+                return 3
+
+        cfg = make_cfg(sampling_method="random-walk", tandem_crawl=True,
+                       validator_timeout_s=0.05)
+        with pytest.raises(ValidatorCircuitBreakerError):
+            run_random_walk_layerless(StuckSM(), cfg, poll_interval_s=0.01)
+
+    def test_tandem_completes_when_no_batches(self, tmp_path, stub_pool):
+        sm = make_sm(tmp_path, sampling="random-walk")
+        sm.initialize([])
+        cfg = make_cfg(sampling_method="random-walk", tandem_crawl=True)
+        # Empty buffer + zero incomplete batches -> immediate completion.
+        run_random_walk_layerless(sm, cfg, poll_interval_s=0.01)
+
+
+class TestYtPool:
+    def test_rotation_after_retirement(self):
+        created = []
+
+        class FakeCrawler:
+            def __init__(self):
+                created.append(self)
+                self.closed = False
+
+            def close(self):
+                self.closed = True
+
+        import random as _random
+        pool = YtWorkerPool(FakeCrawler, size=1, rng=_random.Random(0))
+        first = created[0]
+        w = pool.acquire()
+        w.usage = w.retire_at - 1  # next release triggers rotation
+        pool.release(w)
+        assert first.closed
+        assert len(created) == 2
+        pool.close()
+
+
+class TestYoutubeRandom:
+    def test_sampling_until_target(self, tmp_path):
+        from distributed_crawler_tpu.datamodel import Post
+
+        class FakeCrawler:
+            """Two posts per fetch; first call fails to exercise the retry."""
+
+            def __init__(self):
+                self.calls = 0
+
+            def fetch_messages(self, job):
+                from distributed_crawler_tpu.crawlers.base import CrawlResult
+                self.calls += 1
+                if self.calls == 1:
+                    raise RuntimeError("quota hiccup")
+                return CrawlResult(posts=[
+                    Post(post_uid=f"p{self.calls}-{i}") for i in range(2)])
+
+        sm = make_sm(tmp_path)
+        sm.initialize([])
+        cfg = make_cfg(platform="youtube", sampling_method="random",
+                       sample_size=5, youtube_api_key="k")
+        crawler = FakeCrawler()
+        total = run_random_youtube_sample(sm, cfg, crawler=crawler,
+                                          sleep=lambda s: None)
+        # 3 successful fetches x 2 posts >= 5 target; retry absorbed the
+        # first failure.
+        assert total == 6
+        assert crawler.calls == 4
+
+    def test_zero_sample_size_noop(self, tmp_path):
+        sm = make_sm(tmp_path)
+        total = run_random_youtube_sample(
+            sm, make_cfg(platform="youtube", sample_size=0),
+            transport=FakeYouTubeTransport())
+        assert total == 0
+
+
+class TestLaunchRouter:
+    def test_layered_telegram_end_to_end(self, tmp_path):
+        """Full launch() through the REAL crawl engine over the sim network."""
+        crawl_runner.shutdown_connection_pool()
+        net = SimNetwork()
+        net.add_channel("alpha", messages=[
+            text_msg("see t.me/beta", date=1700000000, view_count=4)],
+            member_count=60)
+        net.add_channel("beta", messages=[
+            text_msg("the end", date=1700000050, view_count=2)],
+            member_count=70)
+        crawl_runner.init_connection_pool(ConnectionPool.for_testing(
+            {"c0": SimTelegramClient(net, conn_id="c0")}))
+        try:
+            sm = make_sm(tmp_path)
+            launch(["alpha"], make_cfg(concurrency=1), sm=sm)
+            assert all(p.status == "fetched"
+                       for p in sm.get_layer_by_depth(0))
+            assert [p.url for p in sm.get_layer_by_depth(1)] == ["beta"]
+        finally:
+            crawl_runner.shutdown_connection_pool()
+
+    def test_random_walk_seeding(self, tmp_path, stub_pool):
+        sm = make_sm(tmp_path, sampling="random-walk")
+        seed_random_walk(sm, ["alpha", "beta"])
+        urls = {p.url for p in sm.get_pages_from_page_buffer(10)}
+        assert urls == {"alpha", "beta"}
+        # Re-seeding on resume leaves the buffer untouched.
+        seed_random_walk(sm, ["gamma"])
+        urls = {p.url for p in sm.get_pages_from_page_buffer(10)}
+        assert urls == {"alpha", "beta"}

@@ -6,6 +6,7 @@ distributed/integration_test.go (627 LoC) — run here over the in-memory bus
 with the simulated Telegram network, no broker and no real network.
 """
 
+import time
 from datetime import timedelta
 
 import pytest
@@ -161,12 +162,26 @@ class TestOrchestrator:
         item = next(iter(orch.active_work.values()))
         orch.handle_result(ResultMessage.new(WorkResult(
             work_item_id=item.id, worker_id="w1", status=STATUS_ERROR,
-            error="boom", processed_url=item.url, completed_at=utcnow())))
+            error="boom", processed_url=item.url, completed_at=utcnow(),
+            retry_recommended=True)))
         page = orch.sm.get_layer_by_depth(0)[0]
         assert page.status == "error" and page.error == "boom"
         assert orch.error_items == 1
         # Error pages are retried (with fresh work items) until max_retries.
         assert orch.distribute_work() == 1
+
+    def test_permanent_error_not_retried(self, tmp_path):
+        bus = InMemoryBus()
+        orch = Orchestrator("c1", make_cfg(), bus, make_sm(tmp_path))
+        orch.start(["chana"], background=False)
+        orch.distribute_work()
+        item = next(iter(orch.active_work.values()))
+        orch.handle_result(ResultMessage.new(WorkResult(
+            work_item_id=item.id, worker_id="w1", status=STATUS_ERROR,
+            error="channel not found", processed_url=item.url,
+            completed_at=utcnow(), retry_recommended=False)))
+        # Permanent failure exhausts the retry budget immediately.
+        assert orch.distribute_work() == 0
 
     def test_retry_exhaustion(self, tmp_path):
         bus = InMemoryBus()
@@ -179,7 +194,8 @@ class TestOrchestrator:
             item = next(iter(orch.active_work.values()))
             orch.handle_result(ResultMessage.new(WorkResult(
                 work_item_id=item.id, worker_id="w1", status=STATUS_ERROR,
-                error="boom", processed_url=item.url, completed_at=utcnow())))
+                error="boom", processed_url=item.url, completed_at=utcnow(),
+                retry_recommended=True)))
         # After 2 retries the page is abandoned.
         assert orch.distribute_work() == 0
 
@@ -201,12 +217,13 @@ class TestOrchestrator:
         orch.distribute_work()
         republished.clear()
         item = next(iter(orch.active_work.values()))
-        item.assigned_to = "w1"
-        # Worker w1 heartbeats, then goes silent for > timeout.
+        # Worker w1 claims the item via a busy heartbeat, then goes silent.
         old = utcnow() - timedelta(minutes=10)
         msg = StatusMessage.new("w1", MSG_HEARTBEAT, WORKER_BUSY)
+        msg.current_work = item.id
         msg.timestamp = old
         orch.handle_status(msg)
+        assert item.assigned_to == "w1"  # claim recorded from heartbeat
         failed = orch.check_worker_health()
         assert failed == ["w1"]
         assert orch.workers["w1"].status == WORKER_OFFLINE
@@ -215,6 +232,15 @@ class TestOrchestrator:
         assert republished[0]["work_item"]["retry_count"] == 1
         # Second sweep: already offline, not re-reassigned.
         assert orch.check_worker_health() == []
+
+    def test_max_depth_caps_distribution(self, tmp_path):
+        bus = InMemoryBus()
+        orch = Orchestrator("c1", make_cfg(max_depth=1), bus,
+                            make_sm(tmp_path))
+        orch.start(["chana"], background=False)
+        orch.current_depth = 2  # pretend discovery went deeper
+        assert orch.distribute_work() == 0
+        assert orch.crawl_completed
 
     def test_completion_when_layers_exhausted(self, tmp_path):
         bus = InMemoryBus()
@@ -299,6 +325,71 @@ class TestWorker:
     def test_empty_worker_id_rejected(self, tmp_path):
         with pytest.raises(ValueError):
             CrawlWorker("", make_cfg(), InMemoryBus(), make_sm(tmp_path))
+
+    def test_youtube_work_item_counts_posts(self, tmp_path):
+        from distributed_crawler_tpu.crawlers.base import CrawlResult
+        from distributed_crawler_tpu.datamodel import Post
+
+        class FakeYtCrawler:
+            def fetch_messages(self, job):
+                return CrawlResult(
+                    posts=[Post(post_uid="a",
+                                outlinks=["https://x.example/1"]),
+                           Post(post_uid="b")],
+                    errors=["v3: bad duration"])
+
+        bus = InMemoryBus()
+        results = []
+        bus.subscribe("crawl-results", results.append)
+        worker = CrawlWorker("w1", make_cfg(platform="youtube"), bus,
+                             make_sm(tmp_path),
+                             youtube_crawler=FakeYtCrawler())
+        worker.start(background=False)
+        item = WorkItem.new("UC_chan", 0, "p0", "c1", "youtube",
+                            WorkItemConfig())
+        worker.handle_work_message(WorkQueueMessage.new(item))
+        wr = WorkResult.from_dict(results[0]["work_result"])
+        assert wr.status == STATUS_SUCCESS
+        assert wr.message_count == 2
+        assert wr.metadata["item_errors"] == ["v3: bad duration"]
+        assert [d["url"] for d in results[0]["discovered_pages"]] \
+            == ["https://x.example/1"]
+
+
+class TestGrpcRoundTrip:
+    """Orchestrator hosting a GrpcBusServer; worker on a RemoteBus —
+    the real DCN transport, two logical processes in one test."""
+
+    def test_bfs_crawl_over_grpc(self, tmp_path, telegram_net):
+        pytest.importorskip("grpc")
+        from distributed_crawler_tpu.bus.grpc_bus import (
+            GrpcBusServer,
+            RemoteBus,
+        )
+        from distributed_crawler_tpu.bus.messages import TOPIC_WORK_QUEUE
+
+        install_pool(telegram_net)
+        server = GrpcBusServer("127.0.0.1:0")
+        address = f"127.0.0.1:{server.bound_port}"
+        server.enable_pull(TOPIC_WORK_QUEUE)
+        server.start()
+        remote = RemoteBus(address)
+        cfg = make_cfg()
+        orch = Orchestrator("c1", cfg, server, make_sm(tmp_path, sub="orch"))
+        worker = CrawlWorker("w1", cfg, remote, make_sm(tmp_path, sub="wrk"))
+        try:
+            orch.start(["chana"], background=False)
+            worker.start(background=False)
+            deadline = time.monotonic() + 20
+            while not orch.crawl_completed and time.monotonic() < deadline:
+                orch.distribute_work()
+                time.sleep(0.1)
+            assert orch.crawl_completed
+            assert orch.completed_items == 2
+            assert "w1" in orch.workers
+        finally:
+            remote.close()
+            server.close()
 
 
 class TestRoundTrip:
