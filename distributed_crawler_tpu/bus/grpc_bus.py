@@ -25,6 +25,8 @@ from typing import Any, Callable, Dict, Iterator, Optional
 
 import grpc
 
+from .payload import serialize_payload
+
 logger = logging.getLogger("dct.bus.grpc")
 
 SERVICE_NAME = "dct.bus.Bus"
@@ -118,13 +120,8 @@ class GrpcBusServer:
     def publish(self, topic: str, payload: Any) -> None:
         """Local publish: same fan-out as a remote Publish RPC, so the host
         process (e.g. the orchestrator) can use the server as its bus."""
-        if isinstance(payload, bytes):
-            data = payload
-        else:
-            if hasattr(payload, "to_dict"):
-                payload = payload.to_dict()
-            data = json.dumps(payload, ensure_ascii=False).encode("utf-8")
-        self._publish_rpc(_encode_envelope(topic, data), None)
+        self._publish_rpc(_encode_envelope(topic, serialize_payload(payload)),
+                          None)
 
     def enable_pull(self, topic: str) -> None:
         with self._lock:
@@ -155,13 +152,7 @@ class GrpcBusClient:
             response_deserializer=_identity)
 
     def publish(self, topic: str, payload: Any) -> None:
-        if isinstance(payload, bytes):
-            data = payload
-        else:
-            if hasattr(payload, "to_dict"):
-                payload = payload.to_dict()
-            data = json.dumps(payload, ensure_ascii=False).encode("utf-8")
-        self._publish(_encode_envelope(topic, data))
+        self._publish(_encode_envelope(topic, serialize_payload(payload)))
 
     def publish_frame(self, topic: str, frame: bytes) -> None:
         """Publish an already-encoded codec frame (record batches)."""

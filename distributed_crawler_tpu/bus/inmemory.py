@@ -21,6 +21,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from .payload import serialize_payload
+
 logger = logging.getLogger("dct.bus")
 
 Handler = Callable[[Dict[str, Any]], None]
@@ -75,12 +77,7 @@ class InMemoryBus:
     # --- publish ----------------------------------------------------------
     def publish(self, topic: str, payload: Any) -> None:
         """Publish a dict (JSON-serialized) or raw bytes to a topic."""
-        if isinstance(payload, bytes):
-            data = payload
-        else:
-            if hasattr(payload, "to_dict"):
-                payload = payload.to_dict()
-            data = json.dumps(payload, ensure_ascii=False).encode("utf-8")
+        data = serialize_payload(payload)
         with self._lock:
             self._published_count[topic] = self._published_count.get(topic, 0) + 1
         if self.sync:

@@ -1,0 +1,17 @@
+"""Shared envelope serialization for every bus transport."""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+
+def serialize_payload(payload: Any) -> bytes:
+    """bytes pass through; objects with to_dict() are unwrapped; everything
+    else is UTF-8 JSON — the one encoding rule for InMemoryBus, the gRPC
+    server's local publish, and the gRPC client."""
+    if isinstance(payload, bytes):
+        return payload
+    if hasattr(payload, "to_dict"):
+        payload = payload.to_dict()
+    return json.dumps(payload, ensure_ascii=False).encode("utf-8")

@@ -237,6 +237,11 @@ class Chunker:
                 logger.info("hard cap forced flush",
                             extra={"log_tag": "chunk_pb"})
                 flush()
+            if not files:
+                # Timeout counts from when the batch STARTED, not from the
+                # previous flush — else the first file after an idle gap
+                # longer than the timeout flushes alone immediately.
+                last_flush = time.monotonic()
             files.append(entry)
             size += entry.size
             if size >= self.trigger_size:

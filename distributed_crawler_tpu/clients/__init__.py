@@ -24,6 +24,11 @@ from .http_validator import (
     parse_channel_html,
     validate_channel_http,
 )
+from .native import (
+    NativeTelegramClient,
+    find_library as find_native_library,
+    native_client_factory,
+)
 from .pool import ConnectionPool, PooledConnection
 from .rate_limiter import (
     Clock,
@@ -55,6 +60,7 @@ from .youtube import (
 )
 
 __all__ = [
+    "NativeTelegramClient", "native_client_factory", "find_native_library",
     "TelegramClient", "TelegramError", "FloodWaitError",
     "parse_flood_wait_seconds",
     "TLMessage", "TLMessages", "TLChat", "TLSupergroup",

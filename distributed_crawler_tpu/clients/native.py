@@ -1,0 +1,353 @@
+"""ctypes binding to the in-tree C++ client (the native TDLib-class boundary).
+
+The reference reached TDLib through cgo (`Dockerfile:28`,
+`go.mod: zelenin/go-tdlib`); this build binds `native/libdct_client.so`
+through ctypes over the same td_json_client-style ABI:
+
+    create(config_json) / send(request_json) / receive(timeout) /
+    execute(request_json) / destroy
+
+Requests carry ``@type`` + ``@extra`` correlation ids; the binding offers a
+synchronous call helper that sends and waits for the matching response,
+converting ``{"@type": "error"}`` into the crawl engine's error taxonomy
+(`clients/errors.py`): code 429 + "retry after N" -> FloodWaitError, other
+4xx -> TelegramError(400) which `crawl.errors.is_telegram_400` recognizes.
+
+`NativeTelegramClient` implements the full 16-method `TelegramClient`
+protocol (`crawler/crawler.go:109-126`), so the pool, rate limiter and crawl
+engine run unchanged over the native core.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import itertools
+import json
+import os
+import subprocess
+import threading
+from typing import Any, Dict, Optional
+
+from .errors import FloodWaitError, TelegramError
+from .telegram import (
+    TLBasicGroupFullInfo,
+    TLChat,
+    TLFile,
+    TLMessage,
+    TLMessageLink,
+    TLMessages,
+    TLMessageThreadInfo,
+    TLSupergroup,
+    TLSupergroupFullInfo,
+    TLUser,
+)
+
+DEFAULT_LIB_BASENAME = "libdct_client.so"
+_REPO_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native")
+
+_lib_lock = threading.Lock()
+_lib_cache: Dict[str, ctypes.CDLL] = {}
+
+
+def find_library(path: Optional[str] = None) -> str:
+    """Locate (building if necessary) the native client library."""
+    candidates = [path] if path else []
+    candidates += [
+        os.environ.get("DCT_NATIVE_LIB", ""),
+        os.path.join(_REPO_NATIVE_DIR, DEFAULT_LIB_BASENAME),
+    ]
+    for c in candidates:
+        if c and os.path.exists(c):
+            return c
+    # Build in-tree if the sources are present and a compiler exists.
+    makefile = os.path.join(_REPO_NATIVE_DIR, "Makefile")
+    if os.path.exists(makefile):
+        subprocess.run(["make", "-C", _REPO_NATIVE_DIR], check=True,
+                       capture_output=True)
+        built = os.path.join(_REPO_NATIVE_DIR, DEFAULT_LIB_BASENAME)
+        if os.path.exists(built):
+            return built
+    raise FileNotFoundError(
+        f"native client library not found (searched {candidates}); "
+        f"build it with `make -C native`")
+
+
+def load_library(path: Optional[str] = None) -> ctypes.CDLL:
+    resolved = find_library(path)
+    with _lib_lock:
+        lib = _lib_cache.get(resolved)
+        if lib is not None:
+            return lib
+        lib = ctypes.CDLL(resolved)
+        lib.dct_client_create.restype = ctypes.c_void_p
+        lib.dct_client_create.argtypes = [ctypes.c_char_p]
+        lib.dct_client_send.restype = None
+        lib.dct_client_send.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.dct_client_receive.restype = ctypes.c_char_p
+        lib.dct_client_receive.argtypes = [ctypes.c_void_p, ctypes.c_double]
+        lib.dct_client_execute.restype = ctypes.c_char_p
+        lib.dct_client_execute.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.dct_client_destroy.restype = None
+        lib.dct_client_destroy.argtypes = [ctypes.c_void_p]
+        _lib_cache[resolved] = lib
+        return lib
+
+
+class NativeClientError(TelegramError):
+    pass
+
+
+def _raise_for_error(resp: Dict[str, Any]) -> None:
+    if resp.get("@type") != "error":
+        return
+    code = int(resp.get("code") or 0)
+    message = str(resp.get("message") or "")
+    if code == 429 and "retry after" in message.lower():
+        try:
+            secs = int(message.lower().rsplit("retry after", 1)[1].strip())
+        except (ValueError, IndexError):
+            secs = 0
+        raise FloodWaitError(secs)
+    raise TelegramError(code, message)
+
+
+class NativeTelegramClient:
+    """The 16-method client over the C++ core."""
+
+    def __init__(self, seed_db: str = "", seed_json: str = "",
+                 lib_path: Optional[str] = None,
+                 receive_timeout_s: float = 10.0, conn_id: str = "native0"):
+        self._lib = load_library(lib_path)
+        self.conn_id = conn_id
+        self.receive_timeout_s = receive_timeout_s
+        config: Dict[str, Any] = {}
+        if seed_json:
+            config["seed_json"] = seed_json
+        elif seed_db:
+            config["seed_db"] = seed_db
+        self._handle = self._lib.dct_client_create(
+            json.dumps(config).encode("utf-8"))
+        if not self._handle:
+            raise NativeClientError(500, "failed to create native client")
+        self._extra = itertools.count(1)
+        self._mu = threading.Lock()
+        self._pending: Dict[str, Dict[str, Any]] = {}
+        self._closed = False
+        self.wait_ready()
+
+    # -- plumbing ----------------------------------------------------------
+    def wait_ready(self, timeout_s: float = 10.0) -> None:
+        """Drain updates until authorizationStateReady (the TDLib auth
+        terminal state the reference waits for,
+        `telegramhelper/client.go:319-377`)."""
+        resp = self._receive(timeout_s)
+        while resp is not None:
+            if resp.get("@type") == "updateAuthorizationState" and \
+                    resp.get("authorization_state", {}).get("@type") == \
+                    "authorizationStateReady":
+                return
+            resp = self._receive(timeout_s)
+        raise NativeClientError(500, "native client never became ready")
+
+    def _receive(self, timeout_s: float) -> Optional[Dict[str, Any]]:
+        raw = self._lib.dct_client_receive(self._handle,
+                                           ctypes.c_double(timeout_s))
+        if raw is None:
+            return None
+        return json.loads(raw.decode("utf-8"))
+
+    def _call(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """Send + wait for the correlated response (serialized per client,
+        like the reference's one-outstanding-call-per-connection usage)."""
+        extra = f"req{next(self._extra)}"
+        request = dict(request)
+        request["@extra"] = extra
+        with self._mu:
+            if self._closed:
+                raise NativeClientError(500, "client is closed")
+            self._lib.dct_client_send(self._handle,
+                                      json.dumps(request).encode("utf-8"))
+            deadline_attempts = max(1, int(self.receive_timeout_s / 0.5))
+            for _ in range(deadline_attempts):
+                resp = self._pending.pop(extra, None)
+                if resp is None:
+                    got = self._receive(0.5)
+                    if got is None:
+                        continue
+                    if got.get("@extra") != extra:
+                        key = got.get("@extra")
+                        if key is not None:
+                            self._pending[key] = got
+                        continue  # an update or an older response
+                    resp = got
+                _raise_for_error(resp)
+                return resp
+        raise NativeClientError(500, "timed out waiting for native response")
+
+    # -- the 16 methods ----------------------------------------------------
+    def get_message(self, chat_id: int, message_id: int) -> TLMessage:
+        r = self._call({"@type": "getMessage", "chat_id": chat_id,
+                        "message_id": message_id})
+        return self._message(r)
+
+    def get_message_link(self, chat_id: int, message_id: int) -> TLMessageLink:
+        r = self._call({"@type": "getMessageLink", "chat_id": chat_id,
+                        "message_id": message_id})
+        return TLMessageLink(link=r.get("link", ""),
+                             is_public=bool(r.get("is_public", True)))
+
+    def get_message_thread_history(self, chat_id: int, message_id: int,
+                                   from_message_id: int = 0,
+                                   limit: int = 100) -> TLMessages:
+        r = self._call({"@type": "getMessageThreadHistory",
+                        "chat_id": chat_id, "message_id": message_id,
+                        "from_message_id": from_message_id, "limit": limit})
+        return self._messages(r)
+
+    def get_message_thread(self, chat_id: int,
+                           message_id: int) -> TLMessageThreadInfo:
+        r = self._call({"@type": "getMessageThread", "chat_id": chat_id,
+                        "message_id": message_id})
+        return TLMessageThreadInfo(
+            chat_id=int(r.get("chat_id", 0)),
+            message_thread_id=int(r.get("message_thread_id", 0)),
+            reply_count=int(r.get("reply_count", 0)))
+
+    def get_remote_file(self, remote_file_id: str) -> TLFile:
+        r = self._call({"@type": "getRemoteFile",
+                        "remote_file_id": remote_file_id})
+        return self._file(r)
+
+    def download_file(self, file_id: int) -> TLFile:
+        r = self._call({"@type": "downloadFile", "file_id": file_id})
+        return self._file(r)
+
+    def get_chat_history(self, chat_id: int, from_message_id: int = 0,
+                         offset: int = 0, limit: int = 100) -> TLMessages:
+        r = self._call({"@type": "getChatHistory", "chat_id": chat_id,
+                        "from_message_id": from_message_id,
+                        "offset": offset, "limit": limit})
+        return self._messages(r)
+
+    def search_public_chat(self, username: str) -> TLChat:
+        r = self._call({"@type": "searchPublicChat", "username": username})
+        return self._chat(r)
+
+    def get_chat(self, chat_id: int) -> TLChat:
+        r = self._call({"@type": "getChat", "chat_id": chat_id})
+        return self._chat(r)
+
+    def get_supergroup(self, supergroup_id: int) -> TLSupergroup:
+        r = self._call({"@type": "getSupergroup",
+                        "supergroup_id": supergroup_id})
+        return TLSupergroup(
+            id=int(r.get("id", 0)), username=r.get("username", ""),
+            member_count=int(r.get("member_count", 0)),
+            is_channel=bool(r.get("is_channel", True)),
+            date=int(r.get("date", 0)),
+            is_verified=bool(r.get("is_verified", False)))
+
+    def get_supergroup_full_info(self,
+                                 supergroup_id: int) -> TLSupergroupFullInfo:
+        r = self._call({"@type": "getSupergroupFullInfo",
+                        "supergroup_id": supergroup_id})
+        return TLSupergroupFullInfo(
+            description=r.get("description", ""),
+            member_count=int(r.get("member_count", 0)),
+            photo_remote_id=r.get("photo_remote_id", ""))
+
+    def close(self) -> None:
+        with self._mu:
+            if self._closed:
+                return
+            self._closed = True
+        handle, self._handle = self._handle, None
+        if handle:
+            self._lib.dct_client_destroy(handle)
+
+    def get_me(self) -> TLUser:
+        r = self._call({"@type": "getMe"})
+        return self._user(r)
+
+    def get_basic_group_full_info(self,
+                                  basic_group_id: int) -> TLBasicGroupFullInfo:
+        r = self._call({"@type": "getBasicGroupFullInfo",
+                        "basic_group_id": basic_group_id})
+        return TLBasicGroupFullInfo(
+            description=r.get("description", ""),
+            members_count=int(r.get("members_count", 0)))
+
+    def get_user(self, user_id: int) -> TLUser:
+        r = self._call({"@type": "getUser", "user_id": user_id})
+        return self._user(r)
+
+    def delete_file(self, file_id: int) -> None:
+        self._call({"@type": "deleteFile", "file_id": file_id})
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- converters --------------------------------------------------------
+    @staticmethod
+    def _message(r: Dict[str, Any]) -> TLMessage:
+        return TLMessage(
+            id=int(r.get("id", 0)), chat_id=int(r.get("chat_id", 0)),
+            date=int(r.get("date", 0)), content=r.get("content") or {},
+            view_count=int(r.get("view_count", 0)),
+            forward_count=int(r.get("forward_count", 0)),
+            reply_count=int(r.get("reply_count", 0)),
+            reactions={k: int(v) for k, v in
+                       (r.get("reactions") or {}).items()},
+            message_thread_id=int(r.get("message_thread_id", 0)),
+            reply_to_message_id=int(r.get("reply_to_message_id", 0)),
+            sender_id=int(r.get("sender_id", 0)),
+            sender_username=r.get("sender_username", ""),
+            is_channel_post=bool(r.get("is_channel_post", False)))
+
+    @classmethod
+    def _messages(cls, r: Dict[str, Any]) -> TLMessages:
+        return TLMessages(
+            total_count=int(r.get("total_count", 0)),
+            messages=[cls._message(m) for m in r.get("messages") or []])
+
+    @staticmethod
+    def _chat(r: Dict[str, Any]) -> TLChat:
+        return TLChat(
+            id=int(r.get("id", 0)), title=r.get("title", ""),
+            type=r.get("type", "supergroup"),
+            supergroup_id=int(r.get("supergroup_id", 0)),
+            basic_group_id=int(r.get("basic_group_id", 0)),
+            photo_remote_id=r.get("photo_remote_id", ""))
+
+    @staticmethod
+    def _file(r: Dict[str, Any]) -> TLFile:
+        return TLFile(
+            id=int(r.get("id", 0)), remote_id=r.get("remote_id", ""),
+            local_path=r.get("local_path", ""),
+            size=int(r.get("size", 0)),
+            downloaded=bool(r.get("downloaded", False)))
+
+    @staticmethod
+    def _user(r: Dict[str, Any]) -> TLUser:
+        return TLUser(
+            id=int(r.get("id", 0)), username=r.get("username", ""),
+            first_name=r.get("first_name", ""),
+            last_name=r.get("last_name", ""))
+
+
+def native_client_factory(seed_db: str = "", seed_json: str = "",
+                          lib_path: Optional[str] = None):
+    """Pool-compatible factory: returns a callable producing fresh
+    authenticated clients (`telegramhelper/connection_pool.go:97-149`
+    preloaded each conn from a DB URL; here each client loads the seed DB)."""
+    def make(conn_id: str) -> NativeTelegramClient:
+        return NativeTelegramClient(
+            seed_db=seed_db, seed_json=seed_json, lib_path=lib_path,
+            conn_id=conn_id)
+
+    return make

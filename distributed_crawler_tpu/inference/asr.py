@@ -1,0 +1,109 @@
+"""ASR pipeline: media files -> Whisper transcripts (BASELINE config #4).
+
+The reference crawls Telegram voice/video media to local files
+(`telegramhelper/tdutils.go:226-358`); this stage transcribes them with the
+Whisper family.  Host side: WAV decode (PCM16, stdlib `wave` — media
+transcoding to 16 kHz mono WAV is an upstream concern), fixed 30 s windows;
+device side: one jitted `transcribe_features` call per batch, padded to a
+static batch size so there is exactly one compiled program.
+
+Transcripts come back as token-id arrays; `detokenize` is a pluggable hook
+(a sentencepiece/BPE vocab is deployment data, not framework code — wire the
+real Whisper vocab in production, identity-join in tests).
+"""
+
+from __future__ import annotations
+
+import logging
+import wave
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger("dct.inference.asr")
+
+
+def read_wav_mono_16k(path: str) -> np.ndarray:
+    """PCM16 WAV -> float32 waveform in [-1, 1].  Raises on sample rates
+    other than 16 kHz (resampling belongs to the media pipeline)."""
+    with wave.open(path, "rb") as w:
+        rate = w.getframerate()
+        if rate != 16_000:
+            raise ValueError(f"{path}: expected 16 kHz audio, got {rate}")
+        n = w.getnframes()
+        raw = w.readframes(n)
+        audio = np.frombuffer(raw, dtype=np.int16).astype(np.float32)
+        channels = w.getnchannels()
+    if channels > 1:
+        audio = audio.reshape(-1, channels).mean(axis=1)
+    return audio / 32768.0
+
+
+@dataclass
+class ASRResult:
+    path: str
+    tokens: List[int] = field(default_factory=list)
+    text: str = ""
+
+
+class ASRPipeline:
+    """Batch transcriber over a Whisper model."""
+
+    def __init__(self, model, params, batch_size: int = 8,
+                 max_len: Optional[int] = None,
+                 detokenize: Optional[Callable[[Sequence[int]], str]] = None):
+        import jax
+
+        from ..models.whisper import transcribe_features
+
+        self.model = model
+        self.params = params
+        self.batch_size = batch_size
+        self.max_len = max_len or model.cfg.n_text_ctx
+        self.detokenize = detokenize
+        self._transcribe = jax.jit(
+            lambda p, audio: transcribe_features(model, p, audio,
+                                                 max_len=self.max_len))
+
+    def strip_special(self, tokens: Sequence[int]) -> List[int]:
+        cfg = self.model.cfg
+        special = {cfg.sot_token, cfg.eot_token, cfg.no_timestamps_token,
+                   cfg.transcribe_token}
+        return [int(t) for t in tokens if int(t) not in special]
+
+    def transcribe_audio(self, audio_batch: np.ndarray) -> np.ndarray:
+        """waveforms [B, T] -> token ids [B, L] (single device dispatch)."""
+        import jax.numpy as jnp
+        return np.asarray(self._transcribe(self.params,
+                                           jnp.asarray(audio_batch)))
+
+    def transcribe_files(self, paths: Sequence[str]) -> List[ASRResult]:
+        """Pad the final partial batch to the static batch size so every
+        dispatch reuses one compiled program."""
+        from ..models.whisper import audio_window_samples
+
+        window = audio_window_samples(self.model.cfg)
+        results: List[ASRResult] = []
+        for start in range(0, len(paths), self.batch_size):
+            chunk = list(paths[start:start + self.batch_size])
+            audios = []
+            kept = []
+            for p in chunk:
+                try:
+                    audios.append(read_wav_mono_16k(p))
+                    kept.append(p)
+                except Exception as e:
+                    logger.error("failed to read %s: %s", p, e)
+                    results.append(ASRResult(path=p, tokens=[], text=""))
+            if not kept:
+                continue
+            batch = np.zeros((self.batch_size, window), np.float32)
+            for i, a in enumerate(audios):
+                batch[i, :min(len(a), window)] = a[:window]
+            tokens = self.transcribe_audio(batch)
+            for i, p in enumerate(kept):
+                toks = self.strip_special(tokens[i])
+                text = self.detokenize(toks) if self.detokenize else ""
+                results.append(ASRResult(path=p, tokens=toks, text=text))
+        return results

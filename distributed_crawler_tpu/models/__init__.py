@@ -8,9 +8,10 @@ sharding rules in `parallel.sharding` match their parameter paths:
   expert parallelism.
 - :mod:`train` — training/fine-tune step (optax) used by the multi-chip
   dry-run and classifier fine-tuning.
-
-Whisper-small ASR for Telegram voice/video media (BASELINE config #4) is the
-next family on the roadmap and will land as :mod:`whisper`.
+- :mod:`whisper` — Whisper-family ASR (tiny/base/small) for Telegram
+  voice/video media (BASELINE config #4): log-mel frontend, audio encoder,
+  KV-cached greedy decoder.
+- :mod:`clustering` — TPU k-means over embeddings (BASELINE config #5).
 """
 
 from .encoder import (
@@ -23,6 +24,17 @@ from .encoder import (
     XLMR_BASE,
     TINY_TEST,
 )
+from .whisper import (
+    WHISPER_BASE,
+    WHISPER_SMALL,
+    WHISPER_TEST,
+    WHISPER_TINY,
+    Whisper,
+    WhisperConfig,
+    greedy_decode,
+    log_mel_spectrogram,
+    transcribe_features,
+)
 
 __all__ = [
     "EncoderConfig",
@@ -33,4 +45,13 @@ __all__ = [
     "E5_LARGE",
     "XLMR_BASE",
     "TINY_TEST",
+    "WHISPER_BASE",
+    "WHISPER_SMALL",
+    "WHISPER_TEST",
+    "WHISPER_TINY",
+    "Whisper",
+    "WhisperConfig",
+    "greedy_decode",
+    "log_mel_spectrogram",
+    "transcribe_features",
 ]

@@ -1,0 +1,118 @@
+"""TPU k-means over embeddings (BASELINE.md config #5: snowball crawl ->
+E5-large embed -> clustering on a v5e-8).
+
+TPU-first shape: the assignment step is one [N, D] x [D, K] matmul on the
+MXU (||x - c||^2 = ||x||^2 - 2 x.c + ||c||^2, argmin over K drops the x^2
+term); the update step is a one-hot einsum (segment-sum as matmul).  The
+whole fit is a `lax.fori_loop` of those two ops — jit once, no host round
+trips.
+
+Data parallelism: `fit` is written against global arrays; under `jit` with
+the embeddings sharded on a dp mesh axis XLA turns the per-cluster sums and
+counts into `psum`s over ICI automatically.  k-means++-style seeding uses
+distance-weighted sampling with a fixed number of rounds (static shapes).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class KMeansResult(NamedTuple):
+    centroids: jax.Array      # [K, D] f32
+    assignments: jax.Array    # [N] int32
+    inertia: jax.Array        # scalar f32 — sum of squared distances
+
+
+def _pairwise_neg_scores(x: jax.Array, centroids: jax.Array) -> jax.Array:
+    """-2 x.c + ||c||^2 for argmin distance (x^2 constant per row).
+    x [N, D], centroids [K, D] -> [N, K] f32."""
+    x = x.astype(jnp.float32)
+    c = centroids.astype(jnp.float32)
+    return -2.0 * (x @ c.T) + jnp.sum(c * c, axis=1)[None, :]
+
+
+def assign(x: jax.Array, centroids: jax.Array) -> jax.Array:
+    """Nearest-centroid assignment [N] int32."""
+    return jnp.argmin(_pairwise_neg_scores(x, centroids),
+                      axis=1).astype(jnp.int32)
+
+
+def update(x: jax.Array, assignments: jax.Array, k: int) -> Tuple[jax.Array,
+                                                                  jax.Array]:
+    """New centroids + counts via one-hot matmul (MXU-friendly segment sum)."""
+    onehot = jax.nn.one_hot(assignments, k, dtype=jnp.float32)  # [N, K]
+    sums = onehot.T @ x.astype(jnp.float32)                     # [K, D]
+    counts = jnp.sum(onehot, axis=0)                            # [K]
+    return sums, counts
+
+
+def kmeans_plus_plus_init(x: jax.Array, k: int,
+                          rng: jax.Array) -> jax.Array:
+    """Distance-weighted seeding, one new center per round (static K rounds)."""
+    n = x.shape[0]
+    first = jax.random.randint(rng, (), 0, n)
+    centroids = jnp.tile(x[first][None, :], (k, 1)).astype(jnp.float32)
+
+    def body(i, carry):
+        centroids, rng = carry
+        rng, sub = jax.random.split(rng)
+        d2 = jnp.min(
+            jnp.maximum(_pairwise_neg_scores(x, centroids)
+                        + jnp.sum(x.astype(jnp.float32) ** 2, axis=1,
+                                  keepdims=True), 0.0), axis=1)
+        probs = d2 / jnp.maximum(jnp.sum(d2), 1e-12)
+        idx = jax.random.choice(sub, n, p=probs)
+        centroids = centroids.at[i].set(x[idx].astype(jnp.float32))
+        return centroids, rng
+
+    centroids, _ = jax.lax.fori_loop(1, k, body, (centroids, rng))
+    return centroids
+
+
+@partial(jax.jit, static_argnames=("k", "iters", "init"))
+def fit(x: jax.Array, k: int, iters: int = 25,
+        rng: Optional[jax.Array] = None,
+        init: str = "kmeans++") -> KMeansResult:
+    """Lloyd's algorithm, fully on device.
+
+    x [N, D] (any float dtype; accumulation in f32), returns KMeansResult.
+    Empty clusters keep their previous centroid (counts clamped to >= 1 in
+    the division only when empty)."""
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    if init == "kmeans++":
+        centroids = kmeans_plus_plus_init(x, k, rng)
+    else:
+        idx = jax.random.choice(rng, x.shape[0], (k,), replace=False)
+        centroids = x[idx].astype(jnp.float32)
+
+    def body(_, centroids):
+        assignments = assign(x, centroids)
+        sums, counts = update(x, assignments, k)
+        fresh = sums / jnp.maximum(counts, 1.0)[:, None]
+        return jnp.where((counts > 0)[:, None], fresh, centroids)
+
+    centroids = jax.lax.fori_loop(0, iters, body, centroids)
+    assignments = assign(x, centroids)
+    diff = x.astype(jnp.float32) - centroids[assignments]
+    inertia = jnp.sum(diff * diff)
+    return KMeansResult(centroids=centroids, assignments=assignments,
+                        inertia=inertia)
+
+
+def fit_sharded(x: jax.Array, k: int, mesh, iters: int = 25,
+                rng: Optional[jax.Array] = None) -> KMeansResult:
+    """Data-parallel fit: shard the embeddings over the mesh's dp axis and
+    jit with replicated centroids — XLA inserts the cross-chip psums for the
+    one-hot sums/counts (the scaling-book recipe: annotate, don't hand-write
+    collectives)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import AXIS_DP
+
+    x = jax.device_put(x, NamedSharding(mesh, P(AXIS_DP, None)))
+    return fit(x, k, iters=iters, rng=rng)

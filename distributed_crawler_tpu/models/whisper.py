@@ -1,0 +1,487 @@
+"""Whisper-family ASR: log-mel frontend, audio encoder, KV-cached decoder.
+
+BASELINE.md config #4 runs Whisper-small over Telegram voice/video media.
+TPU-first choices, consistent with `models/encoder.py`:
+
+- bf16 activations / f32 params; layernorm + softmax in f32;
+- static shapes only: audio is padded/trimmed to 30 s (3000 mel frames),
+  decoding runs a fixed-length `lax.scan` with an explicit KV cache carried
+  as a pytree (no dynamic shapes, no Python control flow in the loop);
+- the mel filterbank and sinusoidal positions are precomputed as numpy
+  constants, baked into the jaxpr at trace time;
+- cross-attention K/V are computed once per utterance before the decode
+  loop (encoder output is static), so each decode step is pure MXU matmuls
+  against cached tensors;
+- greedy decode early-exits logically via a `finished` flag (tokens after
+  EOT are overwritten with EOT) — the scan length is static, which XLA
+  prefers over a data-dependent while_loop on TPU.
+
+Parameter naming follows the same q/k/v/attn_out/mlp_up/mlp_down contract as
+the text encoder so `parallel.sharding.ENCODER_PARAM_RULES` shard rules
+apply unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Configs (sizes mirror the published Whisper checkpoints)
+# ---------------------------------------------------------------------------
+
+SAMPLE_RATE = 16_000
+N_FFT = 400
+HOP_LENGTH = 160
+CHUNK_SECONDS = 30
+N_SAMPLES = SAMPLE_RATE * CHUNK_SECONDS          # 480_000
+N_FRAMES = N_SAMPLES // HOP_LENGTH               # 3000
+
+
+@dataclass(frozen=True)
+class WhisperConfig:
+    n_mels: int = 80
+    n_vocab: int = 51_865
+    n_audio_ctx: int = 1500          # mel frames / 2 (conv stride)
+    n_audio_state: int = 768
+    n_audio_head: int = 12
+    n_audio_layer: int = 12
+    n_text_ctx: int = 448
+    n_text_state: int = 768
+    n_text_head: int = 12
+    n_text_layer: int = 12
+    dtype: str = "bfloat16"
+    # Special tokens (multilingual vocab layout).
+    sot_token: int = 50_258          # <|startoftranscript|>
+    eot_token: int = 50_257          # <|endoftext|>
+    no_timestamps_token: int = 50_363
+    transcribe_token: int = 50_359
+
+    @property
+    def adtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def audio_head_dim(self) -> int:
+        return self.n_audio_state // self.n_audio_head
+
+    @property
+    def text_head_dim(self) -> int:
+        return self.n_text_state // self.n_text_head
+
+
+WHISPER_TINY = WhisperConfig(n_audio_state=384, n_audio_head=6,
+                             n_audio_layer=4, n_text_state=384,
+                             n_text_head=6, n_text_layer=4)
+WHISPER_BASE = WhisperConfig(n_audio_state=512, n_audio_head=8,
+                             n_audio_layer=6, n_text_state=512,
+                             n_text_head=8, n_text_layer=6)
+WHISPER_SMALL = WhisperConfig()  # 768/12/12 — BASELINE config #4
+# Test config: tiny everything, short audio context, f32 on CPU.
+WHISPER_TEST = WhisperConfig(n_mels=8, n_vocab=128, n_audio_ctx=16,
+                             n_audio_state=32, n_audio_head=4,
+                             n_audio_layer=2, n_text_ctx=12, n_text_state=32,
+                             n_text_head=4, n_text_layer=2, dtype="float32",
+                             sot_token=1, eot_token=2, no_timestamps_token=3,
+                             transcribe_token=4)
+
+
+# ---------------------------------------------------------------------------
+# Log-mel frontend
+# ---------------------------------------------------------------------------
+
+def _mel_filterbank(n_mels: int, n_fft: int = N_FFT,
+                    sample_rate: int = SAMPLE_RATE) -> np.ndarray:
+    """Slaney-style triangular mel filterbank [n_mels, n_fft//2+1] (numpy:
+    computed once at trace time, a compile-time constant on device)."""
+    def hz_to_mel(f):
+        return 2595.0 * np.log10(1.0 + f / 700.0)
+
+    def mel_to_hz(m):
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+
+    n_freqs = n_fft // 2 + 1
+    freqs = np.linspace(0, sample_rate / 2, n_freqs)
+    mel_pts = np.linspace(hz_to_mel(0.0), hz_to_mel(sample_rate / 2),
+                          n_mels + 2)
+    hz_pts = mel_to_hz(mel_pts)
+    bank = np.zeros((n_mels, n_freqs), dtype=np.float32)
+    for i in range(n_mels):
+        lo, ctr, hi = hz_pts[i], hz_pts[i + 1], hz_pts[i + 2]
+        up = (freqs - lo) / max(ctr - lo, 1e-10)
+        down = (hi - freqs) / max(hi - ctr, 1e-10)
+        bank[i] = np.maximum(0.0, np.minimum(up, down))
+    # Slaney area normalization.
+    enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])
+    bank *= enorm[:, None]
+    return bank
+
+
+def pad_or_trim(audio: jax.Array, n_samples: int = N_SAMPLES) -> jax.Array:
+    """Fixed 30 s window: trim or zero-pad (static output shape)."""
+    length = audio.shape[-1]
+    if length > n_samples:
+        return audio[..., :n_samples]
+    if length < n_samples:
+        pad = [(0, 0)] * (audio.ndim - 1) + [(0, n_samples - length)]
+        return jnp.pad(audio, pad)
+    return audio
+
+
+def log_mel_spectrogram(audio: jax.Array, n_mels: int = 80,
+                        n_fft: int = N_FFT,
+                        hop: int = HOP_LENGTH) -> jax.Array:
+    """waveform [.., T] (f32, 16 kHz) -> log-mel [.., n_frames, n_mels].
+
+    Hann STFT -> power -> mel -> log10 with Whisper's dynamic-range
+    compression.  All ops are XLA-friendly (rfft + matmul on the MXU)."""
+    audio = audio.astype(jnp.float32)
+    window = jnp.asarray(np.hanning(n_fft + 1)[:-1].astype(np.float32))
+    # Reflect-pad so frame centers align with hops (Whisper/librosa layout).
+    pad = n_fft // 2
+    x = jnp.pad(audio, [(0, 0)] * (audio.ndim - 1) + [(pad, pad)],
+                mode="reflect")
+    n_frames = audio.shape[-1] // hop
+    starts = np.arange(n_frames) * hop
+    idx = starts[:, None] + np.arange(n_fft)[None, :]
+    frames = x[..., idx] * window                       # [.., F, n_fft]
+    spec = jnp.fft.rfft(frames, axis=-1)
+    power = jnp.abs(spec) ** 2                          # [.., F, n_fft/2+1]
+    mel = jnp.asarray(_mel_filterbank(n_mels, n_fft))
+    mspec = jnp.einsum("...fk,mk->...fm", power, mel)
+    log_spec = jnp.log10(jnp.maximum(mspec, 1e-10))
+    log_spec = jnp.maximum(log_spec,
+                           jnp.max(log_spec, axis=(-2, -1), keepdims=True)
+                           - 8.0)
+    return (log_spec + 4.0) / 4.0
+
+
+# ---------------------------------------------------------------------------
+# Attention building blocks
+# ---------------------------------------------------------------------------
+
+def _sinusoids(length: int, channels: int) -> np.ndarray:
+    """Whisper's fixed sinusoidal positions [length, channels]."""
+    log_timescale = np.log(10_000) / (channels // 2 - 1)
+    inv = np.exp(-log_timescale * np.arange(channels // 2))
+    scaled = np.arange(length)[:, None] * inv[None, :]
+    return np.concatenate([np.sin(scaled), np.cos(scaled)],
+                          axis=1).astype(np.float32)
+
+
+def _attend(q, k, v, mask=None):
+    """Softmax attention, f32 accumulation.  q [B,Tq,H,D], k/v [B,Tk,H,D];
+    mask broadcastable to [B,H,Tq,Tk] (True = attend)."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class _MHA(nn.Module):
+    """Projection block; Whisper has no bias on the key projection."""
+
+    n_state: int
+    n_head: int
+    dtype: Any
+
+    def setup(self):
+        d = partial(nn.Dense, dtype=self.dtype, param_dtype=jnp.float32)
+        self.q = d(self.n_state, name="q")
+        self.k = d(self.n_state, use_bias=False, name="k")
+        self.v = d(self.n_state, name="v")
+        self.out = d(self.n_state, name="attn_out")
+
+    def _split(self, x):
+        b, t, _ = x.shape
+        return x.reshape(b, t, self.n_head, self.n_state // self.n_head)
+
+    def __call__(self, x, xa=None, mask=None):
+        """Full-sequence attention (self if xa None, else cross)."""
+        src = x if xa is None else xa
+        q = self._split(self.q(x))
+        k = self._split(self.k(src))
+        v = self._split(self.v(src))
+        o = _attend(q, k, v, mask)
+        return self.out(o.reshape(x.shape))
+
+    def project_kv(self, xa):
+        """Precompute cross-attention K/V once per utterance."""
+        return self._split(self.k(xa)), self._split(self.v(xa))
+
+    def step(self, x_t, cache_k, cache_v, pos, cross_kv=None):
+        """One decode step.  x_t [B,1,S]; self-attn K/V live in fixed-size
+        cache buffers updated at `pos` via dynamic_update_slice."""
+        q = self._split(self.q(x_t))
+        if cross_kv is not None:
+            k, v = cross_kv
+            o = _attend(q, k, v)
+            return self.out(o.reshape(x_t.shape)), cache_k, cache_v
+        k_t = self._split(self.k(x_t))
+        v_t = self._split(self.v(x_t))
+        cache_k = jax.lax.dynamic_update_slice(cache_k, k_t, (0, pos, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(cache_v, v_t, (0, pos, 0, 0))
+        # Causal: only positions <= pos are valid.
+        t = cache_k.shape[1]
+        mask = (jnp.arange(t) <= pos)[None, None, None, :]
+        o = _attend(q, cache_k, cache_v, mask)
+        return self.out(o.reshape(x_t.shape)), cache_k, cache_v
+
+
+class _MLP(nn.Module):
+    n_state: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(4 * self.n_state, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="mlp_up")(x)
+        h = nn.gelu(h, approximate=True)
+        return nn.Dense(self.n_state, dtype=self.dtype,
+                        param_dtype=jnp.float32, name="mlp_down")(h)
+
+
+def _ln(name):
+    return nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name=name)
+
+
+# ---------------------------------------------------------------------------
+# Audio encoder
+# ---------------------------------------------------------------------------
+
+class AudioEncoderLayer(nn.Module):
+    cfg: WhisperConfig
+
+    def setup(self):
+        c = self.cfg
+        self.attn = _MHA(c.n_audio_state, c.n_audio_head, c.adtype,
+                         name="attn")
+        self.mlp = _MLP(c.n_audio_state, c.adtype, name="mlp")
+        self.ln_attn = _ln("ln_attn")
+        self.ln_mlp = _ln("ln_mlp")
+
+    def __call__(self, x):
+        # Pre-LN (Whisper layout); residual adds in f32.
+        a = self.attn(self.ln_attn(x.astype(jnp.float32))
+                      .astype(self.cfg.adtype))
+        x = (x.astype(jnp.float32) + a.astype(jnp.float32))
+        m = self.mlp(self.ln_mlp(x).astype(self.cfg.adtype))
+        return (x + m.astype(jnp.float32)).astype(self.cfg.adtype)
+
+
+class AudioEncoder(nn.Module):
+    """mel [B, n_frames, n_mels] -> audio features [B, n_audio_ctx, S]."""
+
+    cfg: WhisperConfig
+
+    @nn.compact
+    def __call__(self, mel):
+        c = self.cfg
+        conv = partial(nn.Conv, features=c.n_audio_state, kernel_size=(3,),
+                       dtype=c.adtype, param_dtype=jnp.float32)
+        x = nn.gelu(conv(strides=(1,), name="conv1")(mel.astype(c.adtype)),
+                    approximate=True)
+        x = nn.gelu(conv(strides=(2,), name="conv2")(x), approximate=True)
+        pos = jnp.asarray(_sinusoids(c.n_audio_ctx, c.n_audio_state))
+        x = x + pos[None, :x.shape[1], :].astype(c.adtype)
+        for i in range(c.n_audio_layer):
+            x = AudioEncoderLayer(c, name=f"layers_{i}")(x)
+        x = _ln("ln_post")(x.astype(jnp.float32))
+        return x.astype(c.adtype)
+
+
+# ---------------------------------------------------------------------------
+# Text decoder with explicit KV cache
+# ---------------------------------------------------------------------------
+
+class DecoderLayer(nn.Module):
+    cfg: WhisperConfig
+
+    def setup(self):
+        c = self.cfg
+        self.self_attn = _MHA(c.n_text_state, c.n_text_head, c.adtype,
+                              name="attn")
+        self.cross_attn = _MHA(c.n_text_state, c.n_text_head, c.adtype,
+                               name="cross_attn")
+        self.mlp = _MLP(c.n_text_state, c.adtype, name="mlp")
+        self.ln_attn = _ln("ln_attn")
+        self.ln_cross = _ln("ln_cross")
+        self.ln_mlp = _ln("ln_mlp")
+
+    def _adt(self, x):
+        return x.astype(self.cfg.adtype)
+
+    def __call__(self, x, xa, causal_mask):
+        """Teacher-forcing full-sequence pass (training / scoring)."""
+        a = self.self_attn(self._adt(self.ln_attn(x.astype(jnp.float32))),
+                           mask=causal_mask)
+        x = x.astype(jnp.float32) + a.astype(jnp.float32)
+        ca = self.cross_attn(self._adt(self.ln_cross(x)), xa=xa)
+        x = x + ca.astype(jnp.float32)
+        m = self.mlp(self._adt(self.ln_mlp(x)))
+        return self._adt(x + m.astype(jnp.float32))
+
+    def step(self, x_t, cache, pos, cross_kv):
+        a, ck, cv = self.self_attn.step(
+            self._adt(self.ln_attn(x_t.astype(jnp.float32))),
+            cache["k"], cache["v"], pos)
+        x = x_t.astype(jnp.float32) + a.astype(jnp.float32)
+        ca, _, _ = self.cross_attn.step(self._adt(self.ln_cross(x)),
+                                        None, None, pos, cross_kv=cross_kv)
+        x = x + ca.astype(jnp.float32)
+        m = self.mlp(self._adt(self.ln_mlp(x)))
+        return self._adt(x + m.astype(jnp.float32)), {"k": ck, "v": cv}
+
+    def project_cross_kv(self, xa):
+        return self.cross_attn.project_kv(xa)
+
+
+class TextDecoder(nn.Module):
+    cfg: WhisperConfig
+
+    def setup(self):
+        c = self.cfg
+        self.embed_tokens = self.param("embed_tokens",
+                                       nn.initializers.normal(0.02),
+                                       (c.n_vocab, c.n_text_state),
+                                       jnp.float32)
+        self.embed_positions = self.param("embed_positions",
+                                          nn.initializers.normal(0.02),
+                                          (c.n_text_ctx, c.n_text_state),
+                                          jnp.float32)
+        self.layers = [DecoderLayer(c, name=f"layers_{i}")
+                       for i in range(c.n_text_layer)]
+        self.ln_post = _ln("ln_post")
+
+    def _logits(self, x):
+        # Tied embedding projection, f32.
+        x = self.ln_post(x.astype(jnp.float32))
+        return jnp.einsum("btd,vd->btv", x, self.embed_tokens)
+
+    def __call__(self, tokens, xa):
+        """Teacher forcing: tokens [B, T] -> logits [B, T, V]."""
+        c = self.cfg
+        t = tokens.shape[1]
+        x = self.embed_tokens[tokens] + self.embed_positions[:t][None]
+        x = x.astype(c.adtype)
+        causal = jnp.tril(jnp.ones((t, t), bool))[None, None]
+        for layer in self.layers:
+            x = layer(x, xa, causal)
+        return self._logits(x)
+
+    def init_cache(self, batch: int) -> Any:
+        c = self.cfg
+        shape = (batch, c.n_text_ctx, c.n_text_head, c.text_head_dim)
+        return [{"k": jnp.zeros(shape, c.adtype),
+                 "v": jnp.zeros(shape, c.adtype)}
+                for _ in range(c.n_text_layer)]
+
+    def cross_kv(self, xa):
+        return [layer.project_cross_kv(xa) for layer in self.layers]
+
+    def step(self, token_t, pos, cache, cross_kvs):
+        """token_t [B, 1] at position pos -> (logits [B, V], new cache)."""
+        c = self.cfg
+        x = (self.embed_tokens[token_t]
+             + jax.lax.dynamic_slice_in_dim(self.embed_positions, pos, 1,
+                                            axis=0)[None])
+        x = x.astype(c.adtype)
+        new_cache = []
+        for layer, layer_cache, ckv in zip(self.layers, cache, cross_kvs):
+            x, updated = layer.step(x, layer_cache, pos, ckv)
+            new_cache.append(updated)
+        return self._logits(x)[:, 0, :], new_cache
+
+
+class Whisper(nn.Module):
+    """Encoder-decoder; `__call__` is the teacher-forcing pass (training),
+    `encode`/`decode_*` power greedy inference."""
+
+    cfg: WhisperConfig
+
+    def setup(self):
+        self.encoder = AudioEncoder(self.cfg, name="encoder")
+        self.decoder = TextDecoder(self.cfg, name="decoder")
+
+    def __call__(self, mel, tokens):
+        return self.decoder(tokens, self.encoder(mel))
+
+    def encode(self, mel):
+        return self.encoder(mel)
+
+    def decode_teacher(self, tokens, xa):
+        return self.decoder(tokens, xa)
+
+    def decode_init(self, batch, xa):
+        return self.decoder.init_cache(batch), self.decoder.cross_kv(xa)
+
+    def decode_step(self, token_t, pos, cache, cross_kvs):
+        return self.decoder.step(token_t, pos, cache, cross_kvs)
+
+
+# ---------------------------------------------------------------------------
+# Greedy decoding (static-length scan)
+# ---------------------------------------------------------------------------
+
+def greedy_decode(model: Whisper, params, mel: jax.Array,
+                  max_len: Optional[int] = None) -> jax.Array:
+    """mel [B, F, M] -> token ids [B, max_len] (eot-padded).
+
+    Jit-able end to end; the decode loop is a fixed-length `lax.scan` whose
+    carry is (current token, cache, finished-flags)."""
+    cfg = model.cfg
+    max_len = max_len or cfg.n_text_ctx
+    batch = mel.shape[0]
+
+    xa = model.apply(params, mel, method=Whisper.encode)
+    cache, cross_kvs = model.apply(params, batch, xa,
+                                   method=Whisper.decode_init)
+
+    prompt = jnp.array([cfg.sot_token, cfg.transcribe_token,
+                        cfg.no_timestamps_token], jnp.int32)
+    n_prompt = prompt.shape[0]
+
+    def step(carry, pos):
+        token, cache, finished = carry
+        logits, cache = model.apply(params, token[:, None], pos, cache,
+                                    cross_kvs, method=Whisper.decode_step)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # While still in the prompt, force the next prompt token.
+        in_prompt = pos + 1 < n_prompt
+        forced = jnp.where(in_prompt, prompt[jnp.minimum(pos + 1,
+                                                         n_prompt - 1)],
+                           nxt)
+        nxt = jnp.where(finished, cfg.eot_token, forced)
+        finished = finished | (nxt == cfg.eot_token)
+        return (nxt, cache, finished), nxt
+
+    token0 = jnp.full((batch,), cfg.sot_token, jnp.int32)
+    finished0 = jnp.zeros((batch,), bool)
+    (_, _, _), tokens = jax.lax.scan(
+        step, (token0, cache, finished0), jnp.arange(max_len - 1))
+    tokens = jnp.concatenate([token0[None], tokens], axis=0)  # [T, B]
+    return tokens.T                                            # [B, T]
+
+
+def audio_window_samples(cfg: WhisperConfig) -> int:
+    """The fixed waveform window implied by the audio context: n_audio_ctx
+    encoder positions x conv stride 2 x hop (30 s for the real configs)."""
+    return cfg.n_audio_ctx * 2 * HOP_LENGTH
+
+
+def transcribe_features(model: Whisper, params, audio: jax.Array,
+                        max_len: Optional[int] = None) -> jax.Array:
+    """waveform [B, T] -> token ids [B, L]: frontend + encode + greedy."""
+    cfg = model.cfg
+    audio = pad_or_trim(audio, audio_window_samples(cfg))
+    mel = log_mel_spectrogram(audio, n_mels=cfg.n_mels)
+    return greedy_decode(model, params, mel, max_len=max_len)

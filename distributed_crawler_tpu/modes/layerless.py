@@ -57,6 +57,10 @@ def run_random_walk_layerless(sm, cfg: CrawlerConfig,
     sem = threading.Semaphore(max_workers)
     in_flight: dict = {}
     in_flight_lock = threading.Lock()
+    # Pages parked for a future *restart* (walkback exhausted / retired
+    # connection): they stay in the page_buffer but this run must not
+    # re-dispatch them, or the poll loop would spin on them forever.
+    parked: set = set()
     threads: list = []
     validator_wait_since: Optional[float] = None
 
@@ -73,9 +77,11 @@ def run_random_walk_layerless(sm, cfg: CrawlerConfig,
                 # Leave page in buffer — re-processed on restart.
                 logger.error("walkback exhausted, page left in buffer",
                              extra={"url": page.url, "error": str(e)})
+                parked.add(page.id)
             except FloodWaitRetireError:
                 logger.warning("connection retired due to FLOOD_WAIT, "
                                "page left in buffer", extra={"url": page.url})
+                parked.add(page.id)
                 if crawl_runner.pool_is_empty():
                     logger.error("all connections retired due to FLOOD_WAIT, "
                                  "aborting crawl")
@@ -122,11 +128,17 @@ def run_random_walk_layerless(sm, cfg: CrawlerConfig,
             continue
 
         try:
-            pages = sm.get_pages_from_page_buffer(max_workers)
+            pages = sm.get_pages_from_page_buffer(max_workers + len(parked))
         except Exception as e:
             logger.error("failed to get pages from page buffer: %s", e)
             sleep(poll)
             continue
+        pages = [p for p in pages if p.id not in parked]
+        if not pages and parked and in_flight_count() == 0 \
+                and not cfg.tandem_crawl:
+            logger.info("only parked pages remain in buffer; leaving them "
+                        "for the next run", extra={"parked": len(parked)})
+            break
 
         if not pages:
             if cfg.tandem_crawl:

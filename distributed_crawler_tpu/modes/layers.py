@@ -233,7 +233,7 @@ def process_layers_iteratively(sm, cfg: CrawlerConfig,
             logger.info("processed all layers up to maximum depth %d",
                         max_depth)
             break
-        if cfg.max_depth >= 0 and cfg.max_depth and depth > cfg.max_depth:
+        if cfg.max_depth > 0 and depth > cfg.max_depth:
             logger.info("processed all layers up to max configured depth %d",
                         cfg.max_depth)
             break

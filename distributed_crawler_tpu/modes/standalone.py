@@ -90,8 +90,9 @@ def start_standalone_mode(seed_urls: List[str], cfg: CrawlerConfig,
                           yt_transport=None) -> int:
     """`standalone/runner.go:37,206-319`: resume-or-new execution, init,
     sequential walk, completion metadata."""
-    temp_sm = sm or create_state_manager(cfg)
-    if sm is None:
+    owns_sm = sm is None
+    if owns_sm:
+        temp_sm = create_state_manager(cfg)
         crawl_exec_id, is_resuming = determine_crawl_id(temp_sm, cfg)
         sm = create_state_manager(cfg, crawl_exec_id)
     else:
@@ -116,7 +117,8 @@ def start_standalone_mode(seed_urls: List[str], cfg: CrawlerConfig,
         "previousCrawlID": crawl_exec_id,
         "pages_processed": processed,
     })
-    sm.close()
+    if owns_sm:
+        sm.close()
     logger.info("standalone crawl completed", extra={
         "pages_processed": processed})
     return processed

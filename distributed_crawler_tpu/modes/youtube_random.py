@@ -92,8 +92,9 @@ def run_random_youtube_sample(sm, cfg: CrawlerConfig,
                     err = e
                     logger.warning("fetch_messages failed, retrying", extra={
                         "attempt": attempt + 1, "error": str(e)})
-                    sleep(backoff)
-                    backoff *= 2
+                    if attempt < MAX_FETCH_ATTEMPTS - 1:
+                        sleep(backoff)
+                        backoff *= 2
             if err is not None or result is None:
                 logger.error("failed to fetch messages after retries: %s", err)
                 break

@@ -1,0 +1,662 @@
+// dct native Telegram-class client core.
+//
+// The reference's one native component is TDLib (C++, built in
+// Dockerfile.tdlib, linked via cgo; Go binding zelenin/go-tdlib).  This is
+// the TPU build's equivalent native boundary: a C++ client engine exposing
+// TDLib's td_json_client-style C ABI —
+//
+//   void*  dct_client_create(const char* config_json);
+//   void   dct_client_send(void* client, const char* request_json);
+//   const char* dct_client_receive(void* client, double timeout_s);
+//   const char* dct_client_execute(void* client, const char* request_json);
+//   void   dct_client_destroy(void* client);
+//
+// Requests carry "@type" (the 16 methods of crawler.TDLibClient,
+// crawler/crawler.go:109-126) and an optional "@extra" echoed on the
+// response for correlation, exactly like TDLib.  Internally: an actor-style
+// worker thread drains a request queue and posts responses/updates to a
+// response queue (receive() blocks with a timeout); a chat/message store
+// (the client database) loads from a JSON seed file — the analog of the
+// reference's pre-seeded TDLib DB tarballs (telegramhelper/client.go:232-260)
+// — and a file manager materializes downloads on the local filesystem.
+// The network backend is pluggable at the store layer; this build ships the
+// offline store (no egress in the build environment) with the ABI shaped so
+// an MTProto transport can replace it without touching the Python side.
+//
+// Error model matches the crawl engine's taxonomy: {"@type":"error",
+// "code":400,"message":"USERNAME_NOT_OCCUPIED"} for missing channels,
+// FLOOD_WAIT via {"code":429,"message":"Too Many Requests: retry after N"}
+// injectable per method through the seed config ("flood_wait" rules).
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "json.h"
+
+using dctjson::Array;
+using dctjson::Object;
+using dctjson::Value;
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Store: channels, messages, files (the client database)
+// ---------------------------------------------------------------------------
+
+struct StoredMessage {
+  int64_t id = 0;
+  int64_t chat_id = 0;
+  int64_t date = 0;
+  Value content;  // tagged content object, passed through verbatim
+  int64_t view_count = 0;
+  int64_t forward_count = 0;
+  int64_t reply_count = 0;
+  Object reactions;
+  int64_t message_thread_id = 0;
+  int64_t reply_to_message_id = 0;
+  int64_t sender_id = 0;
+  std::string sender_username;
+};
+
+struct StoredChannel {
+  int64_t chat_id = 0;
+  int64_t supergroup_id = 0;
+  std::string username;
+  std::string title;
+  std::string type = "supergroup";
+  std::string description;
+  int64_t member_count = 0;
+  bool is_channel = true;
+  bool is_verified = false;
+  int64_t date = 0;
+  std::string photo_remote_id;
+  std::vector<StoredMessage> messages;  // sorted newest-first
+  std::map<int64_t, std::vector<StoredMessage>> comments;  // by thread root
+};
+
+struct StoredFile {
+  int64_t id = 0;
+  std::string remote_id;
+  std::string local_path;
+  int64_t size = 0;
+  bool downloaded = false;
+};
+
+struct FloodRule {
+  std::string method;
+  int64_t seconds = 0;
+  int64_t remaining = 0;  // fire this many times, then stop
+};
+
+class Store {
+ public:
+  std::map<std::string, StoredChannel> by_username;
+  std::map<int64_t, std::string> username_by_chat_id;
+  std::map<int64_t, std::string> username_by_supergroup_id;
+  std::map<std::string, StoredFile> files_by_remote_id;
+  std::map<int64_t, StoredFile> files_by_id;
+  std::vector<FloodRule> flood_rules;
+  std::string files_dir;
+  int64_t me_id = 7700000001;
+  std::string me_username = "dct_native_client";
+  int64_t next_file_id = 1;
+
+  void load_seed(const std::string& path) {
+    std::ifstream in(path);
+    if (!in.good()) throw std::runtime_error("cannot open seed db: " + path);
+    std::string text((std::istreambuf_iterator<char>(in)),
+                     std::istreambuf_iterator<char>());
+    load_seed_text(text);
+  }
+
+  void load_seed_text(const std::string& text) {
+    Value root = dctjson::parse(text);
+    int64_t auto_chat_id = 1000;
+    for (const auto& ch : root.get("channels").as_array()) {
+      StoredChannel c;
+      c.username = ch.get("username").as_string();
+      c.chat_id = ch.get("id").as_int(++auto_chat_id);
+      c.supergroup_id = ch.get("supergroup_id").as_int(c.chat_id + 500000);
+      c.title = ch.get("title").as_string().empty()
+                    ? c.username
+                    : ch.get("title").as_string();
+      c.type = ch.get("type").as_string().empty() ? "supergroup"
+                                                  : ch.get("type").as_string();
+      c.description = ch.get("description").as_string();
+      c.member_count = ch.get("member_count").as_int();
+      c.is_channel = ch.get("is_channel").is_null()
+                         ? true
+                         : ch.get("is_channel").as_bool(true);
+      c.is_verified = ch.get("is_verified").as_bool(false);
+      c.date = ch.get("date").as_int();
+      c.photo_remote_id = ch.get("photo_remote_id").as_string();
+      int64_t auto_msg_id = 0;
+      for (const auto& m : ch.get("messages").as_array()) {
+        StoredMessage sm;
+        // Public message ids shift by 2^20 (telegramhelper/tdutils.go:1005).
+        auto_msg_id += (1 << 20);
+        sm.id = m.get("id").as_int(auto_msg_id);
+        sm.chat_id = c.chat_id;
+        sm.date = m.get("date").as_int();
+        sm.content = m.get("content");
+        sm.view_count = m.get("view_count").as_int();
+        sm.forward_count = m.get("forward_count").as_int();
+        sm.reply_count = m.get("reply_count").as_int();
+        sm.reactions = m.get("reactions").as_object();
+        sm.message_thread_id = m.get("message_thread_id").as_int();
+        sm.reply_to_message_id = m.get("reply_to_message_id").as_int();
+        sm.sender_id = m.get("sender_id").as_int();
+        sm.sender_username = m.get("sender_username").as_string();
+        c.messages.push_back(std::move(sm));
+      }
+      // Newest first, like GetChatHistory returns.
+      std::sort(c.messages.begin(), c.messages.end(),
+                [](const StoredMessage& a, const StoredMessage& b) {
+                  return a.id > b.id;
+                });
+      username_by_chat_id[c.chat_id] = c.username;
+      username_by_supergroup_id[c.supergroup_id] = c.username;
+      by_username[c.username] = std::move(c);
+    }
+    for (const auto& f : root.get("files").as_array()) {
+      StoredFile sf;
+      sf.remote_id = f.get("remote_id").as_string();
+      sf.id = next_file_id++;
+      sf.size = f.get("size").as_int();
+      sf.local_path = f.get("local_path").as_string();
+      files_by_id[sf.id] = sf;
+      files_by_remote_id[sf.remote_id] = sf;
+    }
+    for (const auto& fr : root.get("flood_wait").as_array()) {
+      FloodRule rule;
+      rule.method = fr.get("method").as_string();
+      rule.seconds = fr.get("seconds").as_int();
+      rule.remaining = fr.get("count").as_int(1);
+      flood_rules.push_back(rule);
+    }
+    files_dir = root.get("files_dir").as_string();
+  }
+
+  // Returns >0 retry-after seconds if this call should FLOOD_WAIT.
+  int64_t check_flood(const std::string& method) {
+    for (auto& rule : flood_rules) {
+      if (rule.method == method && rule.remaining > 0) {
+        --rule.remaining;
+        return rule.seconds;
+      }
+    }
+    return 0;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Response/message building
+// ---------------------------------------------------------------------------
+
+Value make_error(int64_t code, const std::string& message) {
+  Object o;
+  o["@type"] = Value("error");
+  o["code"] = Value(code);
+  o["message"] = Value(message);
+  return Value(std::move(o));
+}
+
+Value message_to_json(const StoredMessage& m) {
+  Object o;
+  o["@type"] = Value("message");
+  o["id"] = Value(m.id);
+  o["chat_id"] = Value(m.chat_id);
+  o["date"] = Value(m.date);
+  o["content"] = m.content;
+  o["view_count"] = Value(m.view_count);
+  o["forward_count"] = Value(m.forward_count);
+  o["reply_count"] = Value(m.reply_count);
+  o["reactions"] = Value(m.reactions);
+  o["message_thread_id"] = Value(m.message_thread_id);
+  o["reply_to_message_id"] = Value(m.reply_to_message_id);
+  o["sender_id"] = Value(m.sender_id);
+  o["sender_username"] = Value(m.sender_username);
+  o["is_channel_post"] = Value(true);
+  return Value(std::move(o));
+}
+
+Value messages_to_json(const std::vector<StoredMessage>& msgs,
+                       int64_t total) {
+  Object o;
+  o["@type"] = Value("messages");
+  o["total_count"] = Value(total);
+  Array arr;
+  for (const auto& m : msgs) arr.push_back(message_to_json(m));
+  o["messages"] = Value(std::move(arr));
+  return Value(std::move(o));
+}
+
+Value chat_to_json(const StoredChannel& c) {
+  Object o;
+  o["@type"] = Value("chat");
+  o["id"] = Value(c.chat_id);
+  o["title"] = Value(c.title);
+  o["type"] = Value(c.type);
+  o["supergroup_id"] = Value(c.type == "supergroup" ? c.supergroup_id : 0);
+  o["basic_group_id"] =
+      Value(c.type == "basic_group" ? c.supergroup_id : int64_t(0));
+  o["photo_remote_id"] = Value(c.photo_remote_id);
+  return Value(std::move(o));
+}
+
+Value file_to_json(const StoredFile& f) {
+  Object o;
+  o["@type"] = Value("file");
+  o["id"] = Value(f.id);
+  o["remote_id"] = Value(f.remote_id);
+  o["local_path"] = Value(f.local_path);
+  o["size"] = Value(f.size);
+  o["downloaded"] = Value(f.downloaded);
+  return Value(std::move(o));
+}
+
+// ---------------------------------------------------------------------------
+// The client engine: request router + actor thread + queues
+// ---------------------------------------------------------------------------
+
+class Client {
+ public:
+  explicit Client(const std::string& config_json) {
+    Value cfg = dctjson::parse(
+        config_json.empty() ? std::string("{}") : config_json);
+    const std::string seed_path = cfg.get("seed_db").as_string();
+    const std::string seed_inline = cfg.get("seed_json").as_string();
+    if (!seed_inline.empty()) {
+      store_.load_seed_text(seed_inline);
+    } else if (!seed_path.empty()) {
+      store_.load_seed(seed_path);
+    }
+    running_ = true;
+    worker_ = std::thread([this] { run(); });
+    // authorizationStateReady update, mirroring TDLib's auth flow terminal
+    // state (telegramhelper/client.go:319-377 waits for it).
+    Object upd;
+    upd["@type"] = Value("updateAuthorizationState");
+    Object st;
+    st["@type"] = Value("authorizationStateReady");
+    upd["authorization_state"] = Value(std::move(st));
+    push_response(Value(std::move(upd)));
+  }
+
+  ~Client() {
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      running_ = false;
+      cv_requests_.notify_all();
+    }
+    if (worker_.joinable()) worker_.join();
+  }
+
+  void send(const std::string& request_json) {
+    std::lock_guard<std::mutex> lock(mu_);
+    requests_.push_back(request_json);
+    cv_requests_.notify_one();
+  }
+
+  // Blocking receive with timeout; returns empty string on timeout.
+  std::string receive(double timeout_s) {
+    std::unique_lock<std::mutex> lock(mu_);
+    if (!cv_responses_.wait_for(
+            lock, std::chrono::duration<double>(timeout_s),
+            [this] { return !responses_.empty(); }))
+      return std::string();
+    std::string out = std::move(responses_.front());
+    responses_.pop_front();
+    return out;
+  }
+
+  // Synchronous execute (no queue round trip) for local-only requests.
+  std::string execute(const std::string& request_json) {
+    Value req;
+    try {
+      req = dctjson::parse(request_json);
+    } catch (const std::exception& e) {
+      return dctjson::dump(make_error(400, e.what()));
+    }
+    Value resp = route(req);
+    attach_extra(resp, req);
+    return dctjson::dump(resp);
+  }
+
+ private:
+  Store store_;
+  std::mutex mu_;
+  std::condition_variable cv_requests_;
+  std::condition_variable cv_responses_;
+  std::deque<std::string> requests_;
+  std::deque<std::string> responses_;
+  bool running_ = false;
+  std::thread worker_;
+
+  void push_response(const Value& v) {
+    std::lock_guard<std::mutex> lock(mu_);
+    responses_.push_back(dctjson::dump(v));
+    cv_responses_.notify_one();
+  }
+
+  void run() {
+    while (true) {
+      std::string request_json;
+      {
+        std::unique_lock<std::mutex> lock(mu_);
+        cv_requests_.wait(
+            lock, [this] { return !running_ || !requests_.empty(); });
+        if (!running_ && requests_.empty()) return;
+        request_json = std::move(requests_.front());
+        requests_.pop_front();
+      }
+      Value req;
+      Value resp;
+      try {
+        req = dctjson::parse(request_json);
+        resp = route(req);
+      } catch (const std::exception& e) {
+        resp = make_error(400, e.what());
+      }
+      attach_extra(resp, req);
+      push_response(resp);
+    }
+  }
+
+  static void attach_extra(Value& resp, const Value& req) {
+    const Value& extra = req.get("@extra");
+    if (!extra.is_null() && resp.type() == dctjson::Type::Object)
+      resp.obj()["@extra"] = extra;
+  }
+
+  StoredChannel* channel_by_chat_id(int64_t chat_id) {
+    auto it = store_.username_by_chat_id.find(chat_id);
+    if (it == store_.username_by_chat_id.end()) return nullptr;
+    return &store_.by_username[it->second];
+  }
+
+  Value flood_or_null(const std::string& method) {
+    int64_t secs = store_.check_flood(method);
+    if (secs > 0)
+      return make_error(429,
+                        "Too Many Requests: retry after " +
+                            std::to_string(secs));
+    return Value();
+  }
+
+  // The 16-method router (crawler/crawler.go:109-126 surface).
+  Value route(const Value& req) {
+    const std::string& type = req.get("@type").as_string();
+    Value flood = flood_or_null(type);
+    if (!flood.is_null()) return flood;
+
+    if (type == "searchPublicChat") return search_public_chat(req);
+    if (type == "getChat") return get_chat(req);
+    if (type == "getChatHistory") return get_chat_history(req);
+    if (type == "getMessage") return get_message(req);
+    if (type == "getMessageLink") return get_message_link(req);
+    if (type == "getMessageThread") return get_message_thread(req);
+    if (type == "getMessageThreadHistory") return get_message_thread_history(req);
+    if (type == "getSupergroup") return get_supergroup(req);
+    if (type == "getSupergroupFullInfo") return get_supergroup_full_info(req);
+    if (type == "getBasicGroupFullInfo") return get_basic_group_full_info(req);
+    if (type == "getRemoteFile") return get_remote_file(req);
+    if (type == "downloadFile") return download_file(req);
+    if (type == "deleteFile") return delete_file(req);
+    if (type == "getMe") return get_me();
+    if (type == "getUser") return get_user(req);
+    if (type == "close") {
+      Object o;
+      o["@type"] = Value("ok");
+      return Value(std::move(o));
+    }
+    return make_error(400, "unknown request @type: " + type);
+  }
+
+  Value search_public_chat(const Value& req) {
+    const std::string& username = req.get("username").as_string();
+    auto it = store_.by_username.find(username);
+    if (it == store_.by_username.end())
+      return make_error(400, "USERNAME_NOT_OCCUPIED");
+    return chat_to_json(it->second);
+  }
+
+  Value get_chat(const Value& req) {
+    StoredChannel* c = channel_by_chat_id(req.get("chat_id").as_int());
+    if (!c) return make_error(400, "CHANNEL_INVALID");
+    return chat_to_json(*c);
+  }
+
+  Value get_chat_history(const Value& req) {
+    StoredChannel* c = channel_by_chat_id(req.get("chat_id").as_int());
+    if (!c) return make_error(400, "CHANNEL_INVALID");
+    int64_t from_message_id = req.get("from_message_id").as_int();
+    int64_t limit = req.get("limit").as_int(100);
+    std::vector<StoredMessage> page;
+    for (const auto& m : c->messages) {
+      if (from_message_id != 0 && m.id >= from_message_id) continue;
+      page.push_back(m);
+      if (static_cast<int64_t>(page.size()) >= limit) break;
+    }
+    return messages_to_json(page,
+                            static_cast<int64_t>(c->messages.size()));
+  }
+
+  StoredMessage* find_message(int64_t chat_id, int64_t message_id) {
+    StoredChannel* c = channel_by_chat_id(chat_id);
+    if (!c) return nullptr;
+    for (auto& m : c->messages)
+      if (m.id == message_id) return &m;
+    return nullptr;
+  }
+
+  Value get_message(const Value& req) {
+    StoredMessage* m = find_message(req.get("chat_id").as_int(),
+                                    req.get("message_id").as_int());
+    if (!m) return make_error(400, "MESSAGE_NOT_FOUND");
+    return message_to_json(*m);
+  }
+
+  Value get_message_link(const Value& req) {
+    int64_t chat_id = req.get("chat_id").as_int();
+    int64_t message_id = req.get("message_id").as_int();
+    StoredChannel* c = channel_by_chat_id(chat_id);
+    if (!c || !find_message(chat_id, message_id))
+      return make_error(400, "MESSAGE_NOT_FOUND");
+    Object o;
+    o["@type"] = Value("messageLink");
+    // Public t.me links shift the internal id by 2^20
+    // (telegramhelper/tdutils.go:1005).
+    o["link"] = Value("https://t.me/" + c->username + "/" +
+                      std::to_string(message_id >> 20));
+    o["is_public"] = Value(true);
+    return Value(std::move(o));
+  }
+
+  Value get_message_thread(const Value& req) {
+    int64_t chat_id = req.get("chat_id").as_int();
+    int64_t message_id = req.get("message_id").as_int();
+    StoredChannel* c = channel_by_chat_id(chat_id);
+    if (!c) return make_error(400, "CHANNEL_INVALID");
+    auto it = c->comments.find(message_id);
+    Object o;
+    o["@type"] = Value("messageThreadInfo");
+    o["chat_id"] = Value(chat_id);
+    o["message_thread_id"] = Value(message_id);
+    o["reply_count"] =
+        Value(it == c->comments.end()
+                  ? int64_t(0)
+                  : static_cast<int64_t>(it->second.size()));
+    return Value(std::move(o));
+  }
+
+  Value get_message_thread_history(const Value& req) {
+    int64_t chat_id = req.get("chat_id").as_int();
+    int64_t message_id = req.get("message_id").as_int();
+    StoredChannel* c = channel_by_chat_id(chat_id);
+    if (!c) return make_error(400, "CHANNEL_INVALID");
+    auto it = c->comments.find(message_id);
+    if (it == c->comments.end()) return messages_to_json({}, 0);
+    return messages_to_json(it->second,
+                            static_cast<int64_t>(it->second.size()));
+  }
+
+  Value get_supergroup(const Value& req) {
+    int64_t sg_id = req.get("supergroup_id").as_int();
+    auto it = store_.username_by_supergroup_id.find(sg_id);
+    if (it == store_.username_by_supergroup_id.end())
+      return make_error(400, "SUPERGROUP_INVALID");
+    const StoredChannel& c = store_.by_username[it->second];
+    Object o;
+    o["@type"] = Value("supergroup");
+    o["id"] = Value(c.supergroup_id);
+    o["username"] = Value(c.username);
+    o["member_count"] = Value(c.member_count);
+    o["is_channel"] = Value(c.is_channel);
+    o["date"] = Value(c.date);
+    o["is_verified"] = Value(c.is_verified);
+    return Value(std::move(o));
+  }
+
+  Value get_supergroup_full_info(const Value& req) {
+    int64_t sg_id = req.get("supergroup_id").as_int();
+    auto it = store_.username_by_supergroup_id.find(sg_id);
+    if (it == store_.username_by_supergroup_id.end())
+      return make_error(400, "SUPERGROUP_INVALID");
+    const StoredChannel& c = store_.by_username[it->second];
+    Object o;
+    o["@type"] = Value("supergroupFullInfo");
+    o["description"] = Value(c.description);
+    o["member_count"] = Value(c.member_count);
+    o["photo_remote_id"] = Value(c.photo_remote_id);
+    return Value(std::move(o));
+  }
+
+  Value get_basic_group_full_info(const Value& req) {
+    int64_t bg_id = req.get("basic_group_id").as_int();
+    auto it = store_.username_by_supergroup_id.find(bg_id);
+    if (it == store_.username_by_supergroup_id.end())
+      return make_error(400, "GROUP_INVALID");
+    const StoredChannel& c = store_.by_username[it->second];
+    Object o;
+    o["@type"] = Value("basicGroupFullInfo");
+    o["description"] = Value(c.description);
+    o["members_count"] = Value(c.member_count);
+    return Value(std::move(o));
+  }
+
+  Value get_remote_file(const Value& req) {
+    const std::string& remote_id = req.get("remote_file_id").as_string();
+    auto it = store_.files_by_remote_id.find(remote_id);
+    if (it == store_.files_by_remote_id.end())
+      return make_error(400, "FILE_NOT_FOUND");
+    return file_to_json(it->second);
+  }
+
+  Value download_file(const Value& req) {
+    int64_t file_id = req.get("file_id").as_int();
+    auto it = store_.files_by_id.find(file_id);
+    if (it == store_.files_by_id.end())
+      return make_error(400, "FILE_NOT_FOUND");
+    StoredFile& f = it->second;
+    if (f.local_path.empty()) {
+      // Materialize into files_dir (the download manager leg).
+      f.local_path = (store_.files_dir.empty() ? std::string("/tmp")
+                                               : store_.files_dir) +
+                     "/dct_file_" + std::to_string(f.id) + ".bin";
+      std::ofstream out(f.local_path, std::ios::binary);
+      std::string blob(static_cast<size_t>(f.size > 0 ? f.size : 1), '\0');
+      out.write(blob.data(), static_cast<std::streamsize>(blob.size()));
+    }
+    f.downloaded = true;
+    store_.files_by_remote_id[f.remote_id] = f;
+    return file_to_json(f);
+  }
+
+  Value delete_file(const Value& req) {
+    int64_t file_id = req.get("file_id").as_int();
+    auto it = store_.files_by_id.find(file_id);
+    if (it != store_.files_by_id.end() && !it->second.local_path.empty()) {
+      std::remove(it->second.local_path.c_str());
+      it->second.local_path.clear();
+      it->second.downloaded = false;
+      store_.files_by_remote_id[it->second.remote_id] = it->second;
+    }
+    Object o;
+    o["@type"] = Value("ok");
+    return Value(std::move(o));
+  }
+
+  Value get_me() {
+    Object o;
+    o["@type"] = Value("user");
+    o["id"] = Value(store_.me_id);
+    o["username"] = Value(store_.me_username);
+    o["first_name"] = Value("dct");
+    o["last_name"] = Value("native");
+    return Value(std::move(o));
+  }
+
+  Value get_user(const Value& req) {
+    Object o;
+    o["@type"] = Value("user");
+    o["id"] = req.get("user_id");
+    o["username"] = Value("user" + std::to_string(req.get("user_id").as_int()));
+    o["first_name"] = Value("");
+    o["last_name"] = Value("");
+    return Value(std::move(o));
+  }
+};
+
+// Thread-local receive buffer, exactly like td_json_client_receive's
+// contract: the returned pointer is valid until the next call on the same
+// client from the same thread.
+thread_local std::string g_receive_buffer;
+thread_local std::string g_execute_buffer;
+
+}  // namespace
+
+extern "C" {
+
+void* dct_client_create(const char* config_json) {
+  try {
+    return new Client(config_json ? config_json : "{}");
+  } catch (const std::exception&) {
+    return nullptr;
+  }
+}
+
+void dct_client_send(void* client, const char* request_json) {
+  if (!client || !request_json) return;
+  static_cast<Client*>(client)->send(request_json);
+}
+
+const char* dct_client_receive(void* client, double timeout_s) {
+  if (!client) return nullptr;
+  g_receive_buffer = static_cast<Client*>(client)->receive(timeout_s);
+  return g_receive_buffer.empty() ? nullptr : g_receive_buffer.c_str();
+}
+
+const char* dct_client_execute(void* client, const char* request_json) {
+  if (!client || !request_json) return nullptr;
+  g_execute_buffer = static_cast<Client*>(client)->execute(request_json);
+  return g_execute_buffer.c_str();
+}
+
+void dct_client_destroy(void* client) {
+  delete static_cast<Client*>(client);
+}
+
+}  // extern "C"

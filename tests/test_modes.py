@@ -305,6 +305,30 @@ class TestLayerless:
         with pytest.raises(ValidatorCircuitBreakerError):
             run_random_walk_layerless(StuckSM(), cfg, poll_interval_s=0.01)
 
+    def test_walkback_exhausted_page_parked_not_respun(self, tmp_path,
+                                                       stub_pool):
+        """A page that deterministically exhausts walkback must be parked
+        (left for the next run), not re-dispatched in a hot loop."""
+        sm = make_sm(tmp_path, sampling="random-walk")
+        sm.initialize([])
+        attempts = []
+
+        def fake_run(client, page, prefix, sm_, cfg, processor=None,
+                     rng=None):
+            attempts.append(page.url)
+            raise WalkbackExhaustedError("no discovered channels")
+
+        set_run_for_channel_fn(fake_run)
+        sm.add_page_to_page_buffer(Page(id=new_id(), url="deadend", depth=0,
+                                        sequence_id=new_id()))
+        cfg = make_cfg(sampling_method="random-walk", concurrency=1)
+        run_random_walk_layerless(sm, cfg, poll_interval_s=0.01)
+        # Dispatched exactly once, then parked; page still buffered for
+        # the next run.
+        assert attempts == ["deadend"]
+        assert [p.url for p in sm.get_pages_from_page_buffer(5)] \
+            == ["deadend"]
+
     def test_tandem_completes_when_no_batches(self, tmp_path, stub_pool):
         sm = make_sm(tmp_path, sampling="random-walk")
         sm.initialize([])

@@ -1,0 +1,200 @@
+"""Whisper ASR + k-means clustering tests (BASELINE configs #4 and #5).
+
+Runs the WHISPER_TEST config on the CPU backend: frontend shapes, teacher
+forcing vs KV-cached step equivalence, greedy decode determinism, the ASR
+file pipeline over generated WAVs, and k-means correctness incl. the
+sharded data-parallel path on the virtual 8-device mesh.
+"""
+
+import wave
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from distributed_crawler_tpu.inference.asr import (  # noqa: E402
+    ASRPipeline,
+    read_wav_mono_16k,
+)
+from distributed_crawler_tpu.models import clustering  # noqa: E402
+from distributed_crawler_tpu.models.whisper import (  # noqa: E402
+    N_SAMPLES,
+    WHISPER_TEST,
+    Whisper,
+    greedy_decode,
+    log_mel_spectrogram,
+    pad_or_trim,
+)
+
+
+@pytest.fixture(scope="module")
+def whisper_model():
+    cfg = WHISPER_TEST
+    model = Whisper(cfg)
+    rng = np.random.default_rng(0)
+    mel = jnp.asarray(rng.standard_normal(
+        (1, cfg.n_audio_ctx * 2, cfg.n_mels)), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), mel,
+                        jnp.zeros((1, 4), jnp.int32))
+    return cfg, model, params
+
+
+def make_mel(cfg, batch=2, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal(
+        (batch, cfg.n_audio_ctx * 2, cfg.n_mels)), jnp.float32)
+
+
+class TestFrontend:
+    def test_log_mel_shape_and_range(self):
+        audio = jnp.asarray(np.random.default_rng(0).standard_normal(
+            (2, 16000)), jnp.float32)
+        mel = log_mel_spectrogram(audio, n_mels=8)
+        assert mel.shape == (2, 100, 8)  # 16000 / 160 hop
+        assert np.all(np.isfinite(np.asarray(mel)))
+
+    def test_pad_or_trim(self):
+        short = jnp.ones((1, 100))
+        assert pad_or_trim(short).shape == (1, N_SAMPLES)
+        long = jnp.ones((1, N_SAMPLES + 5))
+        assert pad_or_trim(long).shape == (1, N_SAMPLES)
+
+
+class TestWhisper:
+    def test_teacher_forcing_shapes(self, whisper_model):
+        cfg, model, params = whisper_model
+        mel = make_mel(cfg)
+        tokens = jnp.array([[1, 4, 3, 7], [1, 4, 3, 9]], jnp.int32)
+        logits = model.apply(params, mel, tokens)
+        assert logits.shape == (2, 4, cfg.n_vocab)
+
+    def test_step_matches_teacher_forcing(self, whisper_model):
+        """The KV-cached decode path must produce the same logits as the
+        full-sequence pass — the core correctness property of the cache."""
+        cfg, model, params = whisper_model
+        mel = make_mel(cfg, batch=1)
+        tokens = jnp.array([[1, 4, 3, 7, 9]], jnp.int32)
+        xa = model.apply(params, mel, method=Whisper.encode)
+        full = model.apply(params, tokens, xa,
+                           method=Whisper.decode_teacher)
+
+        cache, cross = model.apply(params, 1, xa,
+                                   method=Whisper.decode_init)
+        step_logits = []
+        for pos in range(tokens.shape[1]):
+            logits, cache = model.apply(
+                params, tokens[:, pos:pos + 1], pos, cache, cross,
+                method=Whisper.decode_step)
+            step_logits.append(np.asarray(logits))
+        stepped = np.stack(step_logits, axis=1)
+        np.testing.assert_allclose(np.asarray(full), stepped,
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_greedy_decode_prompt_and_eot(self, whisper_model):
+        cfg, model, params = whisper_model
+        tokens = np.asarray(greedy_decode(model, params, make_mel(cfg),
+                                          max_len=10))
+        assert tokens.shape == (2, 10)
+        # Forced decoder prompt: sot, transcribe, no_timestamps.
+        assert list(tokens[0][:3]) == [cfg.sot_token, cfg.transcribe_token,
+                                       cfg.no_timestamps_token]
+        # After an EOT everything stays EOT.
+        for row in tokens:
+            seen_eot = False
+            for t in row[3:]:
+                if seen_eot:
+                    assert t == cfg.eot_token
+                seen_eot = seen_eot or t == cfg.eot_token
+
+    def test_greedy_decode_deterministic_and_jittable(self, whisper_model):
+        cfg, model, params = whisper_model
+        mel = make_mel(cfg)
+        f = jax.jit(lambda p, m: greedy_decode(model, p, m, max_len=8))
+        a = np.asarray(f(params, mel))
+        b = np.asarray(f(params, mel))
+        np.testing.assert_array_equal(a, b)
+
+
+class TestASRPipeline:
+    def _write_wav(self, path, seconds=0.2, rate=16000, channels=1):
+        rng = np.random.default_rng(1)
+        samples = (rng.standard_normal(int(rate * seconds) * channels)
+                   * 3000).astype(np.int16)
+        with wave.open(str(path), "wb") as w:
+            w.setnchannels(channels)
+            w.setsampwidth(2)
+            w.setframerate(rate)
+            w.writeframes(samples.tobytes())
+        return str(path)
+
+    def test_read_wav(self, tmp_path):
+        p = self._write_wav(tmp_path / "a.wav")
+        audio = read_wav_mono_16k(p)
+        assert audio.dtype == np.float32
+        assert np.max(np.abs(audio)) <= 1.0
+
+    def test_read_wav_rejects_wrong_rate(self, tmp_path):
+        p = self._write_wav(tmp_path / "b.wav", rate=44100)
+        with pytest.raises(ValueError, match="16 kHz"):
+            read_wav_mono_16k(p)
+
+    def test_stereo_downmix(self, tmp_path):
+        p = self._write_wav(tmp_path / "c.wav", channels=2)
+        audio = read_wav_mono_16k(p)
+        assert audio.ndim == 1
+
+    def test_transcribe_files_contains_failures(self, whisper_model,
+                                                tmp_path):
+        cfg, model, params = whisper_model
+        pipeline = ASRPipeline(model, params, batch_size=2, max_len=6,
+                               detokenize=lambda toks: " ".join(
+                                   str(t) for t in toks))
+        good = self._write_wav(tmp_path / "ok.wav")
+        bad = str(tmp_path / "missing.wav")
+        results = {r.path: r for r in pipeline.transcribe_files([good, bad])}
+        assert results[bad].tokens == []
+        ok = results[good]
+        # Specials stripped; whatever remains is the transcript ids.
+        special = {cfg.sot_token, cfg.eot_token, cfg.no_timestamps_token,
+                   cfg.transcribe_token}
+        assert all(t not in special for t in ok.tokens)
+        assert ok.text == " ".join(str(t) for t in ok.tokens)
+
+
+class TestKMeans:
+    def _blobs(self, n=60, d=6, k=3, seed=0):
+        rng = np.random.default_rng(seed)
+        centers = rng.standard_normal((k, d)) * 12
+        x = np.vstack([rng.standard_normal((n, d)) + c for c in centers])
+        return jnp.asarray(x, jnp.float32), k, n
+
+    def test_recovers_blob_structure(self):
+        x, k, n = self._blobs()
+        res = clustering.fit(x, k=k, iters=20)
+        a = np.asarray(res.assignments)
+        # Each blob maps to exactly one cluster and blobs get distinct ones.
+        blob_labels = [set(a[i * n:(i + 1) * n]) for i in range(k)]
+        assert all(len(s) == 1 for s in blob_labels)
+        assert len(set().union(*blob_labels)) == k
+
+    def test_inertia_decreases_with_iters(self):
+        x, k, _ = self._blobs(seed=2)
+        rough = clustering.fit(x, k=k, iters=1, init="random")
+        tight = clustering.fit(x, k=k, iters=20, init="random")
+        assert float(tight.inertia) <= float(rough.inertia) + 1e-3
+
+    def test_sharded_fit_on_mesh(self):
+        from distributed_crawler_tpu.parallel import (
+            best_mesh_config,
+            make_mesh,
+        )
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device CPU mesh")
+        x, k, n = self._blobs(n=64)
+        mesh = make_mesh(best_mesh_config(8))
+        res = clustering.fit_sharded(x, k, mesh, iters=15)
+        a = np.asarray(res.assignments)
+        assert len({tuple(sorted(set(a[i * n:(i + 1) * n])))
+                    for i in range(k)}) == k
