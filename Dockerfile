@@ -1,0 +1,22 @@
+# distributed_crawler_tpu — one image for every role (mode flag selects).
+# Mirrors the reference's two-stage build (Dockerfile.tdlib -> Dockerfile):
+# stage 1 compiles the native client core, stage 2 is the runtime.
+
+FROM python:3.12-slim AS native-build
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY native/ native/
+RUN make -C native
+
+FROM python:3.12-slim
+WORKDIR /app
+COPY pyproject.toml README.md ./
+COPY distributed_crawler_tpu/ distributed_crawler_tpu/
+COPY --from=native-build /src/native/libdct_client.so /app/native/libdct_client.so
+ENV DCT_NATIVE_LIB=/app/native/libdct_client.so
+# TPU images layer jax[tpu] on top; the base install is CPU-capable.
+RUN pip install --no-cache-dir -e . \
+    && pip install --no-cache-dir jax flax optax orbax-checkpoint \
+       grpcio zstandard
+ENTRYPOINT ["dct"]

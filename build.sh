@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Build gate: tests first, then artifacts (parity with the reference's
+# build.sh which ran `go test ./...` + coverage before any image build).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "==> native core"
+make -C native
+
+echo "==> test suite"
+python -m pytest tests/ -q
+
+echo "==> package"
+pip install -e . -q --no-build-isolation
+
+if command -v docker >/dev/null 2>&1 && [[ "${BUILD_IMAGE:-0}" == "1" ]]; then
+  echo "==> docker image"
+  docker build -t distributed-crawler-tpu:latest .
+fi
+echo "build OK"

@@ -298,11 +298,27 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
                                    "url_count": len(urls)})
     try:
         if mode in ("", "standalone"):
+            from .modes.common import create_state_manager, determine_crawl_id
             from .modes.standalone import start_standalone_mode
-            start_standalone_mode(urls, cfg)
+            temp = create_state_manager(cfg)
+            exec_id, _ = determine_crawl_id(temp, cfg)
+            sm, closer = _maybe_bridge(create_state_manager(cfg, exec_id),
+                                       cfg, r)
+            try:
+                start_standalone_mode(urls, cfg, sm=sm)
+            finally:
+                closer()
         elif mode == "launch":  # the reference's dapr-standalone router
+            from .modes.common import create_state_manager, determine_crawl_id
             from .modes.runner import launch
-            launch(urls, cfg)
+            temp = create_state_manager(cfg)
+            exec_id, _ = determine_crawl_id(temp, cfg)
+            sm, closer = _maybe_bridge(create_state_manager(cfg, exec_id),
+                                       cfg, r)
+            try:
+                launch(urls, cfg, sm=sm)
+            finally:
+                closer()
         elif mode == "orchestrator":
             _run_orchestrator(urls, cfg, r)
         elif mode == "worker":
@@ -318,6 +334,30 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
         logger.info("interrupted, shutting down")
         return 130
     return 0
+
+
+def _maybe_bridge(sm, cfg: CrawlerConfig, r: ConfigResolver):
+    """--infer wraps the state manager with the crawl->TPU InferenceBridge
+    so stored posts ship to `tpu-inference-batches`; returns (sm, closer).
+    The bridge publishes over the gRPC bus when --bus-address is set (a
+    separate tpu-worker process consumes), else in-process."""
+    if not cfg.inference.enabled:
+        return sm, (lambda: None)
+    from .inference.bridge import InferenceBridge
+    bus = _make_bus(r)
+    bridge = InferenceBridge(sm, bus, crawl_id=cfg.crawl_id,
+                             batch_size=cfg.inference.batch_size,
+                             deadline_s=cfg.inference.batch_deadline_ms
+                             / 1000.0)
+
+    def closer():
+        bridge.close()
+        try:
+            bus.close()
+        except Exception:
+            pass
+
+    return bridge, closer
 
 
 def _make_bus(r: ConfigResolver, serve: bool = False):
@@ -367,7 +407,8 @@ def _run_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
     from .modes.common import create_state_manager
     from .worker import CrawlWorker
     bus = _make_bus(r)
-    sm = create_state_manager(cfg, cfg.crawl_id)
+    sm, bridge_closer = _maybe_bridge(
+        create_state_manager(cfg, cfg.crawl_id), cfg, r)
     worker = CrawlWorker(worker_id, cfg, bus, sm)
     worker.start()
     try:
@@ -376,6 +417,7 @@ def _run_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
             _time.sleep(1.0)
     finally:
         worker.stop()
+        bridge_closer()
         bus.close()
 
 
@@ -397,13 +439,18 @@ def _run_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
     """The new TPU inference worker mode (SURVEY.md §7.6)."""
     from .inference.engine import EngineConfig, InferenceEngine
     from .inference.worker import TPUWorker, TPUWorkerConfig
+    from .state.providers import LocalStorageProvider
     bus = _make_bus(r)
     engine = InferenceEngine(EngineConfig(
         model=cfg.inference.embed_model.replace("-", "_"),
         batch_size=cfg.inference.batch_size,
         buckets=tuple(cfg.inference.bucket_sizes)))
-    worker = TPUWorker(bus, engine, cfg=TPUWorkerConfig(
-        metrics_port=r.get_int("observability.metrics_port", 0)))
+    # Results land as JSONL under the same storage root the crawler uses.
+    provider = LocalStorageProvider(cfg.storage_root)
+    worker = TPUWorker(bus, engine, provider=provider,
+                       cfg=TPUWorkerConfig(
+                           metrics_port=r.get_int(
+                               "observability.metrics_port", 0)))
     worker.start()
     try:
         import time as _time

@@ -14,11 +14,13 @@ split mirrors the data path:
 - :mod:`checkpoint` — orbax param save/restore.
 """
 
+from .bridge import InferenceBridge
 from .tokenizer import HashingTokenizer, Tokenizer
 from .engine import EngineConfig, InferenceEngine
 from .worker import TPUWorker, TPUWorkerConfig
 
 __all__ = [
+    "InferenceBridge",
     "Tokenizer",
     "HashingTokenizer",
     "EngineConfig",

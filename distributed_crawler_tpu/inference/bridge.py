@@ -1,0 +1,96 @@
+"""The crawl -> TPU bridge: stored posts become record batches on the bus.
+
+SURVEY.md §2.3(4) maps the reference's tandem crawler⇄validator pipeline to
+crawl -> embed -> classify -> store; this is the coupling point.  The bridge
+decorates any StateManager: every `store_post` still lands in the JSONL sink
+(the crawl side is unchanged), and the post is also fed to a
+`BatchAccumulator` whose completed batches are published to
+`tpu-inference-batches`.  A deadline thread flushes partial batches so a
+bursty crawl stream can't strand records below the batch size (the
+"batching deadline vs p50 latency" tradeoff from SURVEY.md §7 hard part c).
+
+Everything else delegates to the wrapped manager via __getattr__, so the
+bridge composes with Local/Composite managers and the crawl engine is
+unaware of it.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from ..bus.codec import BatchAccumulator, RecordBatch
+from ..bus.messages import TOPIC_INFERENCE_BATCHES
+from ..datamodel import Post
+
+logger = logging.getLogger("dct.inference.bridge")
+
+
+class InferenceBridge:
+    """StateManager decorator publishing record batches as posts arrive."""
+
+    def __init__(self, sm, bus, crawl_id: str = "", batch_size: int = 256,
+                 deadline_s: float = 0.05, topic: str = TOPIC_INFERENCE_BATCHES,
+                 poll_interval_s: float = 0.02):
+        self._sm = sm
+        self._bus = bus
+        self._topic = topic
+        self._acc = BatchAccumulator(batch_size=batch_size,
+                                     deadline_s=deadline_s,
+                                     crawl_id=crawl_id)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.batches_published = 0
+        self.posts_bridged = 0
+        # Deadline flusher: a partial batch older than deadline_s ships even
+        # if the crawl stalls.
+        self._thread = threading.Thread(target=self._poll_loop, daemon=True,
+                                        name="dct-bridge-flush")
+        self._poll_interval_s = poll_interval_s
+        self._thread.start()
+
+    # -- the decorated write path -----------------------------------------
+    def store_post(self, channel_id: str, post: Post) -> None:
+        self._sm.store_post(channel_id, post)
+        now = time.monotonic()
+        with self._lock:
+            self.posts_bridged += 1
+            batch = self._acc.add(post, now)
+        if batch is not None:
+            self._publish(batch)
+
+    # -- lifecycle ---------------------------------------------------------
+    def flush(self) -> None:
+        """Ship whatever is accumulated (end of crawl / shutdown)."""
+        with self._lock:
+            batch = self._acc.flush()
+        if batch is not None:
+            self._publish(batch)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        self.flush()
+        self._sm.close()
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self._poll_interval_s):
+            with self._lock:
+                batch = self._acc.poll(time.monotonic())
+            if batch is not None:
+                self._publish(batch)
+
+    def _publish(self, batch: RecordBatch) -> None:
+        try:
+            self._bus.publish(self._topic, batch.to_dict())
+            self.batches_published += 1
+        except Exception as e:
+            logger.error("failed to publish record batch", extra={
+                "batch_id": batch.batch_id, "records": len(batch),
+                "error": str(e)})
+
+    # -- everything else is the wrapped manager ----------------------------
+    def __getattr__(self, name):
+        return getattr(self._sm, name)

@@ -1,0 +1,139 @@
+"""The north-star pipeline end to end: crawl -> bridge -> TPU worker -> JSONL.
+
+BASELINE.json's graft in miniature: a simulated Telegram crawl stores posts
+through the InferenceBridge, record batches ride the bus to the TPUWorker
+running the TINY_TEST encoder on the CPU backend, and embeddings+labels land
+in the results JSONL via the storage provider — the same sink family the
+crawler writes posts to.
+"""
+
+import json
+import time
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from distributed_crawler_tpu.bus import InMemoryBus  # noqa: E402
+from distributed_crawler_tpu.bus.messages import (  # noqa: E402
+    TOPIC_INFERENCE_RESULTS,
+)
+from distributed_crawler_tpu.clients import (  # noqa: E402
+    SimNetwork,
+    SimTelegramClient,
+)
+from distributed_crawler_tpu.config import CrawlerConfig  # noqa: E402
+from distributed_crawler_tpu.crawl.runner import run_for_channel  # noqa: E402
+from distributed_crawler_tpu.inference import (  # noqa: E402
+    EngineConfig,
+    InferenceBridge,
+    InferenceEngine,
+    TPUWorker,
+    TPUWorkerConfig,
+)
+from distributed_crawler_tpu.state import (  # noqa: E402
+    CompositeStateManager,
+    SqlConfig,
+    StateConfig,
+)
+from distributed_crawler_tpu.state.providers import (  # noqa: E402
+    LocalStorageProvider,
+)
+from tests.test_crawl_engine import text_msg  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return InferenceEngine(EngineConfig(model="tiny", n_labels=4,
+                                        batch_size=8, buckets=(16, 32)))
+
+
+class TestCrawlToTPU:
+    def test_pipeline_end_to_end(self, tmp_path, engine):
+        net = SimNetwork()
+        net.add_channel("pipechan", messages=[
+            text_msg(f"post number {i} with some text", date=1700000000 + i,
+                     view_count=i + 1)
+            for i in range(5)
+        ], member_count=900)
+
+        bus = InMemoryBus()  # sync delivery: deterministic
+        inner_sm = CompositeStateManager(StateConfig(
+            crawl_id="e2e1", crawl_execution_id="x1",
+            storage_root=str(tmp_path / "crawl"),
+            sql=SqlConfig(url=":memory:")))
+        inner_sm.initialize(["pipechan"])
+        sm = InferenceBridge(inner_sm, bus, crawl_id="e2e1", batch_size=3,
+                             deadline_s=0.05)
+
+        provider = LocalStorageProvider(str(tmp_path / "tpu"))
+        worker = TPUWorker(bus, engine, provider=provider,
+                           cfg=TPUWorkerConfig(heartbeat_s=3600))
+        results_seen = []
+        bus.subscribe(TOPIC_INFERENCE_RESULTS, results_seen.append)
+        worker.start()
+        try:
+            page = inner_sm.get_layer_by_depth(0)[0]
+            run_for_channel(SimTelegramClient(net), page, "", sm,
+                            CrawlerConfig(crawl_id="e2e1",
+                                          skip_media_download=True))
+            sm.flush()  # end of crawl ships the partial batch
+            deadline = time.monotonic() + 20
+            while sum(len(r["records"]) for r in results_seen) < 5 and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+            worker.drain()
+        finally:
+            worker.stop()
+
+        # All five crawled posts went through the device.
+        assert sum(len(r["records"]) for r in results_seen) == 5
+        # Every result carries an embedding + label scores.
+        first = results_seen[0]["results"][0]
+        assert "embedding" in first and "label" in first
+
+        # Crawl side: posts JSONL written by the inner manager.
+        posts_file = (tmp_path / "crawl" / "e2e1" / "pipechan" / "posts"
+                      / "posts.jsonl")
+        assert len(posts_file.read_text().splitlines()) == 5
+
+        # TPU side: results JSONL written through the provider.
+        results_file = tmp_path / "tpu" / "inference" / "e2e1" / "results.jsonl"
+        rows = [json.loads(l) for l in results_file.read_text().splitlines()]
+        assert len(rows) == 5
+        assert all("label" in r and r["batch_id"] for r in rows)
+
+    def test_bridge_deadline_flush(self, tmp_path, engine):
+        """A partial batch ships via the deadline poller without flush()."""
+        from distributed_crawler_tpu.datamodel import Post
+
+        bus = InMemoryBus()
+        published = []
+        bus.subscribe("tpu-inference-batches", published.append)
+        inner = CompositeStateManager(StateConfig(
+            crawl_id="d1", crawl_execution_id="x1",
+            storage_root=str(tmp_path / "d"), sql=SqlConfig(url=":memory:")))
+        bridge = InferenceBridge(inner, bus, crawl_id="d1", batch_size=100,
+                                 deadline_s=0.05, poll_interval_s=0.01)
+        try:
+            bridge.store_post("chan", Post(post_uid="p1", channel_id="chan",
+                                           searchable_text="hello"))
+            deadline = time.monotonic() + 3
+            while not published and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert published and len(published[0]["records"]) == 1
+        finally:
+            bridge.close()
+
+    def test_bridge_delegates_everything_else(self, tmp_path):
+        bus = InMemoryBus()
+        inner = CompositeStateManager(StateConfig(
+            crawl_id="d2", crawl_execution_id="x1",
+            storage_root=str(tmp_path / "g"), sql=SqlConfig(url=":memory:")))
+        bridge = InferenceBridge(inner, bus, crawl_id="d2")
+        try:
+            bridge.initialize(["chanx"])  # delegated
+            assert bridge.get_layer_by_depth(0)[0].url == "chanx"
+            assert bridge.get_max_depth() == 0
+        finally:
+            bridge.close()

@@ -127,6 +127,38 @@ class TestMain:
                    "--urls", "a"], env={})
         assert rc == 2
 
+    def test_infer_flag_wraps_state_manager_with_bridge(self, tmp_path):
+        from distributed_crawler_tpu.cli import _maybe_bridge, resolve_config
+        from distributed_crawler_tpu.inference.bridge import InferenceBridge
+        from distributed_crawler_tpu.state import (
+            CompositeStateManager,
+            SqlConfig,
+            StateConfig,
+        )
+
+        cfg, r = resolve(["--urls", "a", "--infer",
+                          "--storage-root", str(tmp_path)])
+        inner = CompositeStateManager(StateConfig(
+            crawl_id="b1", crawl_execution_id="e1",
+            storage_root=str(tmp_path), sql=SqlConfig(url=":memory:")))
+        sm, closer = _maybe_bridge(inner, cfg, r)
+        try:
+            assert isinstance(sm, InferenceBridge)
+            from distributed_crawler_tpu.datamodel import Post
+            sm.store_post("chan", Post(post_uid="p", channel_id="chan",
+                                       searchable_text="t"))
+            assert sm.posts_bridged == 1
+        finally:
+            closer()
+        # Without --infer: passthrough.
+        cfg2, r2 = resolve(["--urls", "a"])
+        inner2 = CompositeStateManager(StateConfig(
+            crawl_id="b2", crawl_execution_id="e1",
+            storage_root=str(tmp_path / "x"), sql=SqlConfig(url=":memory:")))
+        sm2, closer2 = _maybe_bridge(inner2, cfg2, r2)
+        assert sm2 is inner2
+        closer2()
+
     def test_standalone_run_with_stubbed_engine(self, tmp_path, monkeypatch):
         """Full CLI -> standalone mode -> stubbed channel run."""
         from distributed_crawler_tpu.clients import (
