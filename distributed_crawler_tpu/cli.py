@@ -106,6 +106,9 @@ def build_parser() -> argparse.ArgumentParser:
       help="enable the TPU inference stage")
     a("--infer-model", default=None, help="model registry key")
     a("--infer-batch-size", type=int, default=None)
+    a("--generate-code", action="store_true",
+      help="run the Telegram auth bootstrap (TG_* env vars) and write "
+           ".tdlib/credentials.json, then exit")
     a("--version", action="store_true")
     return p
 
@@ -277,6 +280,16 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
     args = build_parser().parse_args(argv)
     if args.version:
         print("distributed_crawler_tpu v0.1.0")
+        return 0
+    if args.generate_code:
+        # Auth bootstrap (`standalone/runner.go:68,77-192`).
+        from .clients.native import generate_pcode
+        try:
+            path = generate_pcode()
+        except Exception as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        print(f"credentials saved to {path}")
         return 0
     try:
         cfg, r = resolve_config(args, env=env)

@@ -27,6 +27,7 @@ from .http_validator import (
 from .native import (
     NativeTelegramClient,
     find_library as find_native_library,
+    generate_pcode,
     native_client_factory,
 )
 from .pool import ConnectionPool, PooledConnection
@@ -61,6 +62,7 @@ from .youtube import (
 
 __all__ = [
     "NativeTelegramClient", "native_client_factory", "find_native_library",
+    "generate_pcode",
     "TelegramClient", "TelegramError", "FloodWaitError",
     "parse_flood_wait_seconds",
     "TLMessage", "TLMessages", "TLChat", "TLSupergroup",

@@ -23,10 +23,13 @@ from __future__ import annotations
 import ctypes
 import itertools
 import json
+import logging
 import os
 import subprocess
 import threading
 from typing import Any, Dict, Optional
+
+logger = logging.getLogger("dct.clients.native")
 
 from .errors import FloodWaitError, TelegramError
 from .telegram import (
@@ -118,7 +121,8 @@ class NativeTelegramClient:
 
     def __init__(self, seed_db: str = "", seed_json: str = "",
                  lib_path: Optional[str] = None,
-                 receive_timeout_s: float = 10.0, conn_id: str = "native0"):
+                 receive_timeout_s: float = 10.0, conn_id: str = "native0",
+                 require_auth: bool = False, expected_code: str = ""):
         self._lib = load_library(lib_path)
         self.conn_id = conn_id
         self.receive_timeout_s = receive_timeout_s
@@ -127,6 +131,10 @@ class NativeTelegramClient:
             config["seed_json"] = seed_json
         elif seed_db:
             config["seed_db"] = seed_db
+        if require_auth:
+            config["require_auth"] = True
+            if expected_code:
+                config["expected_code"] = expected_code
         self._handle = self._lib.dct_client_create(
             json.dumps(config).encode("utf-8"))
         if not self._handle:
@@ -135,7 +143,22 @@ class NativeTelegramClient:
         self._mu = threading.Lock()
         self._pending: Dict[str, Dict[str, Any]] = {}
         self._closed = False
-        self.wait_ready()
+        if not require_auth:
+            self.wait_ready()
+
+    # -- auth (the TDLib ladder, `telegramhelper/client.go:319-377`) -------
+    def authenticate(self, phone_number: str, phone_code: str,
+                     api_id: str = "", api_hash: str = "",
+                     database_directory: str = ".tdlib/database") -> None:
+        """Walk WaitTdlibParameters -> WaitPhoneNumber -> WaitCode -> Ready
+        (the flow the reference's CLI interactor drives)."""
+        self._call({"@type": "setTdlibParameters",
+                    "api_id": api_id, "api_hash": api_hash,
+                    "database_directory": database_directory})
+        self._call({"@type": "setAuthenticationPhoneNumber",
+                    "phone_number": phone_number})
+        self._call({"@type": "checkAuthenticationCode",
+                    "code": phone_code})
 
     # -- plumbing ----------------------------------------------------------
     def wait_ready(self, timeout_s: float = 10.0) -> None:
@@ -338,6 +361,47 @@ class NativeTelegramClient:
             id=int(r.get("id", 0)), username=r.get("username", ""),
             first_name=r.get("first_name", ""),
             last_name=r.get("last_name", ""))
+
+
+def generate_pcode(tdlib_dir: str = ".tdlib",
+                   env: Optional[Dict[str, str]] = None,
+                   client: Optional[NativeTelegramClient] = None) -> str:
+    """Auth bootstrap writing credentials.json
+    (`standalone/runner.go:77-192`): reads TG_API_ID / TG_API_HASH /
+    TG_PHONE_NUMBER / TG_PHONE_CODE, drives the auth ladder on a native
+    client, and persists the credentials with restrictive permissions.
+    Returns the credentials path."""
+    env = env if env is not None else dict(os.environ)
+    api_id = env.get("TG_API_ID", "")
+    api_hash = env.get("TG_API_HASH", "")
+    phone = env.get("TG_PHONE_NUMBER", "")
+    code = env.get("TG_PHONE_CODE", "")
+    if not api_id or not phone:
+        raise ValueError("TG_API_ID and TG_PHONE_NUMBER are required")
+    int(api_id)  # parity with the reference's strconv check
+
+    os.makedirs(tdlib_dir, exist_ok=True)
+    owns_client = client is None
+    if client is None:
+        client = NativeTelegramClient(require_auth=True)
+    try:
+        client.authenticate(
+            phone, code, api_id=api_id, api_hash=api_hash,
+            database_directory=os.path.join(tdlib_dir, "database"))
+        me = client.get_me()
+        logger.info("authenticated", extra={
+            "me": f"{me.first_name} {me.last_name}".strip()})
+    finally:
+        if owns_client:
+            client.close()
+
+    creds_path = os.path.join(tdlib_dir, "credentials.json")
+    with open(creds_path, "w", encoding="utf-8") as f:
+        json.dump({"api_id": api_id, "api_hash": api_hash,
+                   "phone_number": phone, "phone_code": code},
+                  f, indent=2)
+    os.chmod(creds_path, 0o600)
+    return creds_path
 
 
 def native_client_factory(seed_db: str = "", seed_json: str = "",

@@ -283,16 +283,20 @@ class Client {
     } else if (!seed_path.empty()) {
       store_.load_seed(seed_path);
     }
+    require_auth_ = cfg.get("require_auth").as_bool(false);
+    expected_code_ = cfg.get("expected_code").as_string();
     running_ = true;
     worker_ = std::thread([this] { run(); });
-    // authorizationStateReady update, mirroring TDLib's auth flow terminal
-    // state (telegramhelper/client.go:319-377 waits for it).
-    Object upd;
-    upd["@type"] = Value("updateAuthorizationState");
-    Object st;
-    st["@type"] = Value("authorizationStateReady");
-    upd["authorization_state"] = Value(std::move(st));
-    push_response(Value(std::move(upd)));
+    if (require_auth_) {
+      // Full TDLib-style auth ladder: WaitTdlibParameters ->
+      // WaitPhoneNumber -> WaitCode -> Ready (telegramhelper/client.go's
+      // CLI interactor walks exactly these states).
+      auth_state_ = AuthState::WaitTdlibParameters;
+      push_auth_update("authorizationStateWaitTdlibParameters");
+    } else {
+      auth_state_ = AuthState::Ready;
+      push_auth_update("authorizationStateReady");
+    }
   }
 
   ~Client() {
@@ -336,6 +340,9 @@ class Client {
   }
 
  private:
+  enum class AuthState { WaitTdlibParameters, WaitPhoneNumber, WaitCode,
+                         Ready };
+
   Store store_;
   std::mutex mu_;
   std::condition_variable cv_requests_;
@@ -343,7 +350,20 @@ class Client {
   std::deque<std::string> requests_;
   std::deque<std::string> responses_;
   bool running_ = false;
+  bool require_auth_ = false;
+  AuthState auth_state_ = AuthState::Ready;
+  std::string expected_code_;
+  std::string phone_number_;
   std::thread worker_;
+
+  void push_auth_update(const std::string& state) {
+    Object upd;
+    upd["@type"] = Value("updateAuthorizationState");
+    Object st;
+    st["@type"] = Value(state);
+    upd["authorization_state"] = Value(std::move(st));
+    push_response(Value(std::move(upd)));
+  }
 
   void push_response(const Value& v) {
     std::lock_guard<std::mutex> lock(mu_);
@@ -396,9 +416,57 @@ class Client {
     return Value();
   }
 
+  Value ok_value() {
+    Object o;
+    o["@type"] = Value("ok");
+    return Value(std::move(o));
+  }
+
+  // Auth ladder requests, valid only in their matching state.
+  Value route_auth(const std::string& type, const Value& req) {
+    if (type == "setTdlibParameters") {
+      if (auth_state_ != AuthState::WaitTdlibParameters)
+        return make_error(400, "setTdlibParameters not expected now");
+      auth_state_ = AuthState::WaitPhoneNumber;
+      push_auth_update("authorizationStateWaitPhoneNumber");
+      return ok_value();
+    }
+    if (type == "setAuthenticationPhoneNumber") {
+      if (auth_state_ != AuthState::WaitPhoneNumber)
+        return make_error(400, "phone number not expected now");
+      phone_number_ = req.get("phone_number").as_string();
+      if (phone_number_.empty())
+        return make_error(400, "PHONE_NUMBER_INVALID");
+      auth_state_ = AuthState::WaitCode;
+      push_auth_update("authorizationStateWaitCode");
+      return ok_value();
+    }
+    if (type == "checkAuthenticationCode") {
+      if (auth_state_ != AuthState::WaitCode)
+        return make_error(400, "code not expected now");
+      const std::string& code = req.get("code").as_string();
+      if (code.empty() ||
+          (!expected_code_.empty() && code != expected_code_))
+        return make_error(400, "PHONE_CODE_INVALID");
+      auth_state_ = AuthState::Ready;
+      push_auth_update("authorizationStateReady");
+      return ok_value();
+    }
+    return make_error(400, "unknown auth request: " + type);
+  }
+
+  static bool is_auth_request(const std::string& type) {
+    return type == "setTdlibParameters" ||
+           type == "setAuthenticationPhoneNumber" ||
+           type == "checkAuthenticationCode";
+  }
+
   // The 16-method router (crawler/crawler.go:109-126 surface).
   Value route(const Value& req) {
     const std::string& type = req.get("@type").as_string();
+    if (is_auth_request(type)) return route_auth(type, req);
+    if (auth_state_ != AuthState::Ready && type != "close")
+      return make_error(401, "UNAUTHORIZED: complete authorization first");
     Value flood = flood_or_null(type);
     if (!flood.is_null()) return flood;
 

@@ -1,0 +1,81 @@
+// Concurrency stress harness for the native client core.
+//
+// The reference had no race detection at all (SURVEY.md §5.2: no -race, no
+// sanitizers); this build runs the client under TSan/ASan via `make tsan`
+// / `make asan`.  The harness hammers one client from several threads
+// (send/receive/execute interleaved) and exits 0 iff every response parses
+// and the message totals add up.
+
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* dct_client_create(const char* config_json);
+void dct_client_send(void* client, const char* request_json);
+const char* dct_client_receive(void* client, double timeout_s);
+const char* dct_client_execute(void* client, const char* request_json);
+void dct_client_destroy(void* client);
+}
+
+namespace {
+const char* kSeedConfig = R"({"seed_json": "{\"channels\": [{\"username\": \"stress\", \"title\": \"S\", \"member_count\": 9, \"messages\": [{\"date\": 1, \"content\": {\"@type\": \"messageText\", \"text\": {\"text\": \"x\", \"entities\": []}}}]}]}"})";
+}  // namespace
+
+int main() {
+  void* client = dct_client_create(kSeedConfig);
+  if (!client) {
+    fprintf(stderr, "create failed\n");
+    return 1;
+  }
+  // Drain the ready update.
+  dct_client_receive(client, 2.0);
+
+  constexpr int kThreads = 8;
+  constexpr int kIters = 200;
+  std::atomic<int> errors{0};
+  std::atomic<int> responses{0};
+
+  std::vector<std::thread> senders;
+  for (int t = 0; t < kThreads; ++t) {
+    senders.emplace_back([&, t] {
+      for (int i = 0; i < kIters; ++i) {
+        char buf[160];
+        snprintf(buf, sizeof(buf),
+                 "{\"@type\":\"searchPublicChat\",\"username\":\"stress\","
+                 "\"@extra\":\"t%d-%d\"}",
+                 t, i);
+        dct_client_send(client, buf);
+        // Interleave synchronous executes on the same client.
+        const char* out = dct_client_execute(
+            client, "{\"@type\":\"getMe\"}");
+        if (!out || strstr(out, "dct_native_client") == nullptr)
+          errors.fetch_add(1);
+      }
+    });
+  }
+  std::thread receiver([&] {
+    while (responses.load() < kThreads * kIters) {
+      const char* out = dct_client_receive(client, 2.0);
+      if (!out) break;
+      if (strstr(out, "\"@extra\"") != nullptr)
+        responses.fetch_add(1);
+      else if (strstr(out, "updateAuthorizationState") == nullptr)
+        errors.fetch_add(1);
+    }
+  });
+  for (auto& s : senders) s.join();
+  receiver.join();
+  dct_client_destroy(client);
+
+  if (errors.load() != 0 || responses.load() != kThreads * kIters) {
+    fprintf(stderr, "errors=%d responses=%d (want %d)\n", errors.load(),
+            responses.load(), kThreads * kIters);
+    return 1;
+  }
+  printf("stress ok: %d responses, 0 errors\n", responses.load());
+  return 0;
+}

@@ -163,6 +163,60 @@ class TestErrors:
         c.close()
 
 
+class TestAuthLadder:
+    def test_full_ladder_to_ready(self):
+        c = NativeTelegramClient(seed_json=seed(), require_auth=True,
+                                 expected_code="12345")
+        try:
+            # Unauthorized requests are rejected before the ladder completes.
+            with pytest.raises(TelegramError) as e:
+                c.search_public_chat("natchan")
+            assert e.value.code == 401
+            c.authenticate("+15550100", "12345", api_id="94575",
+                           api_hash="abc")
+            assert c.search_public_chat("natchan").title == "Native Chan"
+        finally:
+            c.close()
+
+    def test_wrong_code_rejected(self):
+        c = NativeTelegramClient(seed_json=seed(), require_auth=True,
+                                 expected_code="12345")
+        try:
+            with pytest.raises(TelegramError, match="PHONE_CODE_INVALID"):
+                c.authenticate("+15550100", "99999")
+        finally:
+            c.close()
+
+    def test_out_of_order_auth_rejected(self):
+        c = NativeTelegramClient(seed_json=seed(), require_auth=True)
+        try:
+            with pytest.raises(TelegramError, match="not expected"):
+                c._call({"@type": "checkAuthenticationCode",
+                         "code": "123"})
+        finally:
+            c.close()
+
+    def test_generate_pcode_writes_credentials(self, tmp_path):
+        from distributed_crawler_tpu.clients.native import generate_pcode
+
+        client = NativeTelegramClient(seed_json=seed(), require_auth=True)
+        creds = generate_pcode(
+            tdlib_dir=str(tmp_path / ".tdlib"),
+            env={"TG_API_ID": "94575", "TG_API_HASH": "h",
+                 "TG_PHONE_NUMBER": "+15550100", "TG_PHONE_CODE": "00000"},
+            client=client)
+        client.close()
+        data = json.loads(open(creds).read())
+        assert data["phone_number"] == "+15550100"
+        import os
+        assert oct(os.stat(creds).st_mode & 0o777) == "0o600"
+
+    def test_generate_pcode_requires_env(self, tmp_path):
+        from distributed_crawler_tpu.clients.native import generate_pcode
+        with pytest.raises(ValueError, match="required"):
+            generate_pcode(tdlib_dir=str(tmp_path), env={})
+
+
 class TestCrawlEngineOverNative:
     """The parity proof: run_for_channel + pool over the C++ core."""
 
