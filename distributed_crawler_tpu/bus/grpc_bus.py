@@ -4,11 +4,21 @@ The reference talked to its sidecar over gRPC with 201 MB frames
 (`state/daprstate.go:104-133`); here the bus itself is the service.  Uses
 gRPC generic handlers with raw-bytes (de)serializers — no protoc codegen —
 carrying the same JSON payloads as InMemoryBus plus codec frames for record
-batches.  Two RPCs:
+batches.  Three RPCs:
 
 - Publish (unary): topic + payload -> ack
-- StreamBatches (server-streaming pull): workers pull record-batch frames for
-  a topic, giving backpressure-aware feeding of the TPU worker.
+- Pull (server-streaming): workers pull frames for a topic, giving
+  backpressure-aware feeding of the TPU worker
+- Ack (unary): per-delivery acknowledgement closing the at-least-once loop
+
+Delivery guarantees (parity with `distributed/pubsub.go:157-254`, which
+relied on the broker redelivering on handler error): every pulled frame
+carries a delivery ID and stays "in flight" on the server until acked.
+Unacked frames are requeued when the pulling stream dies, when the client
+nacks (handler exhausted its retries), or when the ack deadline passes —
+so a worker crash mid-handler no longer loses work.  A frame redelivered
+more than ``max_attempts`` times is dead-lettered (logged + dropped),
+bounding poison-message loops.
 
 Tensor traffic never rides this bus: on-slice collectives are XLA/ICI
 (`parallel/`).  This is coordination + record streaming only.
@@ -16,12 +26,16 @@ Tensor traffic never rides this bus: on-slice collectives are XLA/ICI
 
 from __future__ import annotations
 
+import inspect
 import json
 import logging
 import queue
 import threading
+import time
+import uuid
 from concurrent import futures
-from typing import Any, Callable, Dict, Iterator, Optional
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 import grpc
 
@@ -31,6 +45,9 @@ logger = logging.getLogger("dct.bus.grpc")
 
 SERVICE_NAME = "dct.bus.Bus"
 MAX_FRAME_BYTES = 201 * 1024 * 1024  # parity: daprstate.go:108-110
+
+DEFAULT_ACK_TIMEOUT_S = 300.0
+DEFAULT_MAX_ATTEMPTS = 5
 
 _TOPIC_SEP = b"\x00"
 
@@ -48,15 +65,44 @@ def _identity(b: bytes) -> bytes:
     return b
 
 
+@dataclass
+class _QueuedFrame:
+    payload: bytes
+    attempts: int = 0
+
+
+@dataclass
+class _Inflight:
+    payload: bytes
+    attempts: int
+    deadline: float
+    stream_id: int
+
+
+@dataclass
+class _TopicQueue:
+    """Pull queue + in-flight ledger for one topic."""
+
+    q: "queue.Queue[_QueuedFrame]" = field(default_factory=queue.Queue)
+    inflight: Dict[str, _Inflight] = field(default_factory=dict)
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+
 class GrpcBusServer:
     """Hosts topics; local subscribers receive published payloads, and remote
-    pullers stream queued record batches."""
+    pullers stream queued record batches with per-delivery acks."""
 
-    def __init__(self, address: str = "127.0.0.1:50551", max_workers: int = 8):
+    def __init__(self, address: str = "127.0.0.1:50551", max_workers: int = 8,
+                 ack_timeout_s: float = DEFAULT_ACK_TIMEOUT_S,
+                 max_attempts: int = DEFAULT_MAX_ATTEMPTS):
         self.address = address
+        self.ack_timeout_s = ack_timeout_s
+        self.max_attempts = max_attempts
         self._handlers: Dict[str, list] = {}
-        self._pull_queues: Dict[str, "queue.Queue[bytes]"] = {}
+        self._pull_queues: Dict[str, _TopicQueue] = {}
         self._lock = threading.RLock()
+        self._stream_counter = 0
+        self.dead_letters = 0
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
             options=[("grpc.max_receive_message_length", MAX_FRAME_BYTES),
@@ -68,6 +114,9 @@ class GrpcBusServer:
             "Pull": grpc.unary_stream_rpc_method_handler(
                 self._pull_rpc, request_deserializer=_identity,
                 response_serializer=_identity),
+            "Ack": grpc.unary_unary_rpc_method_handler(
+                self._ack_rpc, request_deserializer=_identity,
+                response_serializer=_identity),
         }
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),))
@@ -78,9 +127,9 @@ class GrpcBusServer:
         topic, payload = _decode_envelope(request)
         with self._lock:
             handlers = list(self._handlers.get(topic, []))
-            q = self._pull_queues.get(topic)
-        if q is not None:
-            q.put(payload)
+            tq = self._pull_queues.get(topic)
+        if tq is not None:
+            tq.q.put(_QueuedFrame(payload))
         if handlers:
             try:
                 decoded = json.loads(payload.decode("utf-8"))
@@ -95,22 +144,86 @@ class GrpcBusServer:
                     logger.warning("handler error on %s: %s", topic, e)
         return b"ok"
 
+    def _requeue_or_drop(self, topic: str, tq: _TopicQueue,
+                         delivery_id: str, inf: _Inflight) -> None:
+        """inf has been removed from the inflight map by the caller."""
+        if inf.attempts + 1 >= self.max_attempts:
+            self.dead_letters += 1
+            logger.error(
+                "dead-lettering frame on %s after %d attempts (id=%s)",
+                topic, inf.attempts + 1, delivery_id)
+            return
+        tq.q.put(_QueuedFrame(inf.payload, attempts=inf.attempts + 1))
+
+    def _sweep_expired(self, topic: str, tq: _TopicQueue) -> None:
+        now = time.monotonic()
+        with tq.lock:
+            expired = [(d, i) for d, i in tq.inflight.items()
+                       if i.deadline <= now]
+            for d, _ in expired:
+                del tq.inflight[d]
+        for d, inf in expired:
+            logger.warning("ack timeout on %s (id=%s); requeueing", topic, d)
+            self._requeue_or_drop(topic, tq, d, inf)
+
     def _pull_rpc(self, request: bytes, context) -> Iterator[bytes]:
         topic = request.decode("utf-8")
         with self._lock:
-            q = self._pull_queues.setdefault(topic, queue.Queue())
-        while context.is_active():
-            try:
-                item = q.get(timeout=0.25)
-            except queue.Empty:
-                continue
-            try:
-                yield item
-            except BaseException:
-                # Stream cancelled between pop and consume: requeue so the
-                # batch isn't lost (at-least-once for pulled frames).
-                q.put(item)
-                raise
+            tq = self._pull_queues.setdefault(topic, _TopicQueue())
+            self._stream_counter += 1
+            stream_id = self._stream_counter
+        try:
+            while context.is_active():
+                self._sweep_expired(topic, tq)
+                try:
+                    frame = tq.q.get(timeout=0.25)
+                except queue.Empty:
+                    continue
+                delivery_id = uuid.uuid4().hex
+                with tq.lock:
+                    tq.inflight[delivery_id] = _Inflight(
+                        frame.payload, frame.attempts,
+                        time.monotonic() + self.ack_timeout_s, stream_id)
+                try:
+                    yield delivery_id.encode("ascii") + _TOPIC_SEP + \
+                        frame.payload
+                except BaseException:
+                    # Stream cancelled between pop and consume: requeue so
+                    # the batch isn't lost (at-least-once for pulled frames).
+                    with tq.lock:
+                        inf = tq.inflight.pop(delivery_id, None)
+                    if inf is not None:
+                        tq.q.put(_QueuedFrame(inf.payload, inf.attempts))
+                    raise
+        finally:
+            # Stream gone (worker died / disconnected): everything this
+            # stream delivered but never acked goes back on the queue.
+            with tq.lock:
+                orphaned = [(d, i) for d, i in tq.inflight.items()
+                            if i.stream_id == stream_id]
+                for d, _ in orphaned:
+                    del tq.inflight[d]
+            for d, inf in orphaned:
+                logger.info("stream for %s closed with unacked frame "
+                            "(id=%s); requeueing", topic, d)
+                self._requeue_or_drop(topic, tq, d, inf)
+
+    def _ack_rpc(self, request: bytes, context) -> bytes:
+        topic_b, _, rest = request.partition(_TOPIC_SEP)
+        delivery_b, _, status = rest.partition(_TOPIC_SEP)
+        topic = topic_b.decode("utf-8")
+        delivery_id = delivery_b.decode("ascii")
+        with self._lock:
+            tq = self._pull_queues.get(topic)
+        if tq is None:
+            return b"unknown-topic"
+        with tq.lock:
+            inf = tq.inflight.pop(delivery_id, None)
+        if inf is None:
+            return b"unknown-delivery"  # already requeued/expired
+        if status != b"ok":
+            self._requeue_or_drop(topic, tq, delivery_id, inf)
+        return b"ok"
 
     # --- local wiring -----------------------------------------------------
     def subscribe(self, topic: str, handler: Callable[[Dict[str, Any]], None]) -> None:
@@ -125,7 +238,16 @@ class GrpcBusServer:
 
     def enable_pull(self, topic: str) -> None:
         with self._lock:
-            self._pull_queues.setdefault(topic, queue.Queue())
+            self._pull_queues.setdefault(topic, _TopicQueue())
+
+    def pending_count(self, topic: str) -> int:
+        """Queued + in-flight frames (observability / tests)."""
+        with self._lock:
+            tq = self._pull_queues.get(topic)
+        if tq is None:
+            return 0
+        with tq.lock:
+            return tq.q.qsize() + len(tq.inflight)
 
     def start(self) -> None:
         self._server.start()
@@ -150,6 +272,9 @@ class GrpcBusClient:
         self._pull = self._channel.unary_stream(
             f"/{SERVICE_NAME}/Pull", request_serializer=_identity,
             response_deserializer=_identity)
+        self._ack = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/Ack", request_serializer=_identity,
+            response_deserializer=_identity)
 
     def publish(self, topic: str, payload: Any) -> None:
         self._publish(_encode_envelope(topic, serialize_payload(payload)))
@@ -158,12 +283,41 @@ class GrpcBusClient:
         """Publish an already-encoded codec frame (record batches)."""
         self._publish(_encode_envelope(topic, frame))
 
-    def pull(self, topic: str) -> Iterator[bytes]:
-        """Server-streaming pull of raw payloads for a topic."""
-        return self._pull(topic.encode("utf-8"))
+    def pull(self, topic: str) -> Iterator[Tuple[str, bytes]]:
+        """Server-streaming pull; yields (delivery_id, payload).
+
+        Closing the generator cancels the underlying RPC, which requeues
+        any unacked deliveries server-side.
+        """
+        call = self._pull(topic.encode("utf-8"))
+        try:
+            for framed in call:
+                delivery_b, _, payload = framed.partition(_TOPIC_SEP)
+                yield delivery_b.decode("ascii"), payload
+        finally:
+            call.cancel()
+
+    def ack(self, topic: str, delivery_id: str, ok: bool = True) -> None:
+        self._ack(topic.encode("utf-8") + _TOPIC_SEP +
+                  delivery_id.encode("ascii") + _TOPIC_SEP +
+                  (b"ok" if ok else b"fail"))
 
     def close(self) -> None:
         self._channel.close()
+
+
+def _wants_ack(handler: Callable) -> bool:
+    """True if the handler accepts a second (ack) argument — manual-ack
+    mode, used by consumers that finish work asynchronously (TPU worker)."""
+    try:
+        sig = inspect.signature(handler)
+    except (TypeError, ValueError):
+        return False
+    params = [p for p in sig.parameters.values()
+              if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+    if any(p.kind == p.VAR_POSITIONAL for p in sig.parameters.values()):
+        return True
+    return len(params) >= 2
 
 
 class RemoteBus:
@@ -173,8 +327,16 @@ class RemoteBus:
     thread streaming the topic's queue and dispatching to local handlers
     (competing consumers: multiple workers pulling one topic split the
     stream — exactly the work-queue semantics of the reference's pubsub,
-    `distributed/pubsub.go:149-254`).  Handler errors are retried
-    `max_redeliveries` times, then dropped.
+    `distributed/pubsub.go:149-254`).
+
+    Delivery contract: a one-argument handler is retried inline up to
+    `max_redeliveries` times; success acks the frame, final failure NACKs
+    it so the SERVER requeues it for another worker (`pubsub.go:157-171`'s
+    broker-redelivers semantics) — a failing handler no longer silently
+    loses the work item.  A two-argument handler ``(payload, ack)`` owns
+    the ack itself: call ``ack(True)`` when the work is durably done,
+    ``ack(False)`` to requeue; a worker crash before acking requeues
+    server-side via stream teardown or ack timeout.
     """
 
     def __init__(self, target: str = "127.0.0.1:50551",
@@ -190,9 +352,10 @@ class RemoteBus:
         self._client.publish(topic, payload)
 
     def subscribe(self, topic: str,
-                  handler: Callable[[Dict[str, Any]], None]) -> None:
+                  handler: Callable[..., None]) -> None:
         with self._lock:
-            self._handlers.setdefault(topic, []).append(handler)
+            self._handlers.setdefault(topic, []).append(
+                (handler, _wants_ack(handler)))
             if topic in self._threads:
                 return
             t = threading.Thread(target=self._pull_loop, args=(topic,),
@@ -203,10 +366,10 @@ class RemoteBus:
     def _pull_loop(self, topic: str) -> None:
         while not self._stop.is_set():
             try:
-                for frame in self._client.pull(topic):
+                for delivery_id, frame in self._client.pull(topic):
                     if self._stop.is_set():
                         return
-                    self._dispatch(topic, frame)
+                    self._dispatch(topic, delivery_id, frame)
             except grpc.RpcError as e:
                 if self._stop.is_set():
                     return
@@ -215,23 +378,60 @@ class RemoteBus:
                                if hasattr(e, "code") else e)
                 self._stop.wait(1.0)
 
-    def _dispatch(self, topic: str, frame: bytes) -> None:
+    def _safe_ack(self, topic: str, delivery_id: str, ok: bool) -> None:
+        try:
+            self._client.ack(topic, delivery_id, ok)
+        except grpc.RpcError as e:
+            # Server unreachable: it will requeue via stream teardown or
+            # ack timeout anyway.
+            logger.warning("ack for %s/%s failed: %s", topic, delivery_id, e)
+
+    def _dispatch(self, topic: str, delivery_id: str, frame: bytes) -> None:
         try:
             payload = json.loads(frame.decode("utf-8"))
         except (ValueError, UnicodeDecodeError):
             logger.error("dropping undecodable message on %s", topic)
+            # Parity with the reference: unmarshal errors are never
+            # retried (`pubsub.go:157-171`) — ack so it isn't redelivered.
+            self._safe_ack(topic, delivery_id, True)
             return
         with self._lock:
             handlers = list(self._handlers.get(topic, []))
-        for handler in handlers:
+        manual = [h for h, wants in handlers if wants]
+        if manual:
+            # Manual-ack consumers own the delivery; one handler per topic
+            # (the TPU worker pattern).
+            handler = manual[0]
+            acked = threading.Event()
+
+            def ack(ok: bool = True) -> None:
+                if not acked.is_set():
+                    acked.set()
+                    self._safe_ack(topic, delivery_id, ok)
+
+            try:
+                handler(payload, ack)
+            except Exception as e:
+                logger.warning("handler error on %s: %s", topic, e)
+                ack(False)
+            return
+        ok = True
+        for handler, _ in handlers:
+            delivered = False
             for attempt in range(self.max_redeliveries + 1):
                 try:
                     handler(payload)
+                    delivered = True
                     break
                 except Exception as e:
                     logger.warning("handler error on %s (attempt %d/%d): %s",
                                    topic, attempt + 1,
                                    self.max_redeliveries + 1, e)
+            ok = ok and delivered
+        # NACK on final failure: the server requeues (bumping its attempt
+        # count) so another worker can take the item instead of it being
+        # silently dropped.
+        self._safe_ack(topic, delivery_id, ok)
 
     def start(self) -> None:
         return None  # threads start on subscribe

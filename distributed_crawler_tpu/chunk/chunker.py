@@ -103,8 +103,12 @@ class Chunker:
     def start(self) -> None:
         for d in (self.watch_dir, self.combine_dir, self.temp_dir):
             os.makedirs(d, exist_ok=True)
+        # Recovery runs ONCE before the consumer exists and once after the
+        # drain in shutdown() — never concurrently with the consumer, which
+        # writes into combine_dir (`chunk/main.go` VerifyCleanup :523-536
+        # likewise runs recovery only after the pipeline has drained).
+        self.recover_combine_dir()
         for target, name in ((self._watch_loop, "chunk-watch"),
-                             (self._recovery_loop, "chunk-recovery"),
                              (self._batch_loop, "chunk-batch"),
                              (self._consume_loop, "chunk-consume")):
             t = threading.Thread(target=target, daemon=True, name=name)
@@ -125,6 +129,9 @@ class Chunker:
         for t in self._threads:
             t.join(timeout=max(0.1, deadline - time.monotonic()))
         self._threads.clear()
+        # Post-drain recovery: the consumer is gone, so any combined_* file
+        # still present was stranded by a failed upload this run.
+        self.recover_combine_dir()
 
     # -- stage 1+2: polling watcher (fsnotify + event processor) -----------
     def _scan_once(self) -> int:
@@ -165,19 +172,22 @@ class Chunker:
             self._stop.wait(self.scan_interval_s)
 
     # -- recovery scanner (`chunk/main.go:238-290,542-658`) ----------------
-    def _recovery_loop(self) -> None:
-        while not self._stop.is_set():
-            self.recover_combine_dir()
-            self._stop.wait(self.recovery_interval_s)
-
     def recover_combine_dir(self) -> None:
-        """Re-upload combined files stranded by a crash before upload."""
+        """Re-upload combined files stranded by a crash before upload.
+
+        Only called while no consumer is running (startup / post-drain), and
+        only matches final ``combined_*`` names — in-progress output is
+        written under a ``.tmp`` suffix and renamed on completion, so a
+        half-written blob can never be uploaded.
+        """
         try:
             names = os.listdir(self.combine_dir)
         except OSError:
             return
         for name in names:
-            if not name.startswith("combined_"):
+            # Final names only: .tmp suffixes are in-progress writes.
+            if not name.startswith("combined_") or \
+                    not name.endswith(".jsonl"):
                 continue
             path = os.path.join(self.combine_dir, name)
             try:
@@ -283,23 +293,35 @@ class Chunker:
         """`chunk/main.go:386-421`."""
         out_path = os.path.join(self.combine_dir,
                                 f"combined_{time.time_ns()}.jsonl")
-        with open(out_path, "wb") as out:
-            for entry in batch:
-                try:
-                    current = os.path.getsize(entry.path)
-                    if current != entry.size:
-                        logger.error("file size changed before combining",
-                                     extra={"file": entry.path,
-                                            "initial": entry.size,
-                                            "current": current})
-                except OSError:
-                    pass
-                with open(entry.path, "rb") as f:
-                    while True:
-                        chunk = f.read(1 << 20)
-                        if not chunk:
-                            break
-                        out.write(chunk)
+        # Write under a .tmp suffix and rename only when complete (same dir,
+        # so the rename is atomic): recovery matches combined_* and can never
+        # see a truncated file.
+        tmp_path = out_path + ".tmp"
+        try:
+            with open(tmp_path, "wb") as out:
+                for entry in batch:
+                    try:
+                        current = os.path.getsize(entry.path)
+                        if current != entry.size:
+                            logger.error("file size changed before combining",
+                                         extra={"file": entry.path,
+                                                "initial": entry.size,
+                                                "current": current})
+                    except OSError:
+                        pass
+                    with open(entry.path, "rb") as f:
+                        while True:
+                            chunk = f.read(1 << 20)
+                            if not chunk:
+                                break
+                            out.write(chunk)
+            os.rename(tmp_path, out_path)
+        except Exception:
+            try:
+                os.remove(tmp_path)
+            except OSError:
+                pass
+            raise
         return out_path
 
     def _cleanup_after_upload(self, batch: List[FileEntry],

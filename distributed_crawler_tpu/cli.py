@@ -422,7 +422,13 @@ def _run_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
     bus = _make_bus(r)
     sm, bridge_closer = _maybe_bridge(
         create_state_manager(cfg, cfg.crawl_id), cfg, r)
-    worker = CrawlWorker(worker_id, cfg, bus, sm)
+    youtube_crawler = None
+    if cfg.platform == "youtube":
+        from .modes.youtube_random import initialize_youtube_crawler_components
+        youtube_crawler, _yt_client = \
+            initialize_youtube_crawler_components(sm, cfg)
+    worker = CrawlWorker(worker_id, cfg, bus, sm,
+                         youtube_crawler=youtube_crawler)
     worker.start()
     try:
         import time as _time
@@ -457,7 +463,8 @@ def _run_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
     engine = InferenceEngine(EngineConfig(
         model=cfg.inference.embed_model.replace("-", "_"),
         batch_size=cfg.inference.batch_size,
-        buckets=tuple(cfg.inference.bucket_sizes)))
+        buckets=tuple(cfg.inference.bucket_sizes),
+        pretrained_dir=cfg.inference.pretrained_dir or None))
     # Results land as JSONL under the same storage root the crawler uses.
     provider = LocalStorageProvider(cfg.storage_root)
     worker = TPUWorker(bus, engine, provider=provider,

@@ -57,6 +57,10 @@ class InferenceConfig:
     mesh_shape: Optional[List[int]] = None  # None -> all devices on one data axis
     mesh_axes: List[str] = field(default_factory=lambda: ["data"])
     dtype: str = "bfloat16"
+    # Local HF checkpoint dirs (real weights + vocab; offline only).  Empty
+    # string -> registry config with random init + hashing tokenizer.
+    pretrained_dir: str = ""
+    asr_pretrained_dir: str = ""
 
 
 @dataclass

@@ -50,6 +50,34 @@ class ASRResult:
 class ASRPipeline:
     """Batch transcriber over a Whisper model."""
 
+    @classmethod
+    def from_pretrained(cls, path: str, batch_size: int = 8,
+                        max_len: Optional[int] = None,
+                        dtype: str = "bfloat16") -> "ASRPipeline":
+        """Build from a local HF Whisper checkpoint dir: real weights via
+        `models.hf_convert.load_hf_whisper`, real vocab via tokenizer.json
+        when present (detokenize wired automatically)."""
+        from dataclasses import replace as dc_replace
+
+        from ..models.hf_convert import load_hf_whisper
+        from ..models.whisper import Whisper
+
+        cfg, params = load_hf_whisper(path)
+        cfg = dc_replace(cfg, dtype=dtype)
+        detok = None
+        try:
+            from .tokenizer import from_pretrained_dir
+
+            tok = from_pretrained_dir(path)
+            rust = getattr(tok, "decode", None)
+            if rust is not None:
+                detok = lambda ids: tok.decode(list(ids))  # noqa: E731
+        except Exception:
+            logger.info("no tokenizer assets in %s; token-id output only",
+                        path)
+        return cls(Whisper(cfg), params, batch_size=batch_size,
+                   max_len=max_len, detokenize=detok)
+
     def __init__(self, model, params, batch_size: int = 8,
                  max_len: Optional[int] = None,
                  detokenize: Optional[Callable[[Sequence[int]], str]] = None):

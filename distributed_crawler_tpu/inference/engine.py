@@ -44,6 +44,10 @@ class EngineConfig:
     batch_size: int = 256
     buckets: tuple = (32, 64, 128, 256, 512)
     seed: int = 0
+    # Local HF checkpoint dir (model.safetensors/pytorch_model.bin +
+    # config.json [+ tokenizer.json]): loads REAL weights + vocab instead of
+    # the registry config with random init.  Offline by design.
+    pretrained_dir: Optional[str] = None
 
     def encoder_config(self) -> EncoderConfig:
         try:
@@ -71,7 +75,11 @@ class InferenceEngine:
         import jax
 
         self.cfg = cfg
-        self.ecfg = cfg.encoder_config()
+        if cfg.pretrained_dir:
+            self.ecfg, params, tokenizer = _load_pretrained(
+                cfg, params, tokenizer)
+        else:
+            self.ecfg = cfg.encoder_config()
         self.mesh = mesh
         self.model = EmbedderClassifier(self.ecfg)
         self.tokenizer = tokenizer or HashingTokenizer(self.ecfg.vocab_size)
@@ -165,6 +173,46 @@ class InferenceEngine:
             self.run_tokenized([[1, 2, 3]] * min(2, self.cfg.batch_size)
                                if b == self.bucket_spec.lengths[0]
                                else [[1] * (b - 1)])
+
+
+def _load_pretrained(cfg: EngineConfig, params, tokenizer):
+    """Resolve (ecfg, params, tokenizer) from a local HF checkpoint dir.
+
+    Classification checkpoints load fully; encoder-only checkpoints (E5)
+    get their trained encoder plus a fresh head initialized at ``seed`` —
+    embeddings are real, labels need fine-tuning (`models/train.py`).
+    """
+    from ..models.hf_convert import load_hf_encoder
+
+    path = cfg.pretrained_dir
+    assert path is not None
+    try:
+        # n_labels=None: the checkpoint's own head width wins over the
+        # engine default — a trained 3-way head must not be reshaped to 8.
+        ecfg, loaded = load_hf_encoder(path, arch="embedder_classifier",
+                                       n_labels=None)
+    except ValueError:
+        import jax
+        import jax.numpy as jnp
+
+        ecfg, loaded = load_hf_encoder(path, arch="embedder",
+                                       n_labels=cfg.n_labels)
+        head_model = EmbedderClassifier(ecfg)
+        ids = jnp.zeros((1, 8), jnp.int32)
+        mask = jnp.ones((1, 8), jnp.bool_)
+        init = head_model.init(jax.random.PRNGKey(cfg.seed), ids, mask)
+        loaded = {"params": {**loaded["params"],
+                             "cls_head": init["params"]["cls_head"]}}
+    if params is None:
+        params = loaded
+    if tokenizer is None:
+        from .tokenizer import from_pretrained_dir
+
+        try:
+            tokenizer = from_pretrained_dir(path)
+        except Exception:
+            tokenizer = None  # caller falls back to HashingTokenizer
+    return ecfg, params, tokenizer
 
 
 def _softmax_np(logits: np.ndarray) -> np.ndarray:

@@ -80,9 +80,36 @@ class HashingTokenizer:
 def from_pretrained_dir(path: str):
     """Load a real tokenizer from a local directory (no network).
 
-    Gated import: `transformers` is present in the image but model assets may
-    not be; callers fall back to HashingTokenizer when this raises.
+    Prefers a bare ``tokenizer.json`` via the `tokenizers` runtime (covers
+    XLM-R/E5 fast tokenizers with no sentencepiece dependency); falls back
+    to `transformers.AutoTokenizer`.  Callers fall back to
+    :class:`HashingTokenizer` when both raise.
     """
+    import os
+
+    tj = os.path.join(path, "tokenizer.json")
+    if os.path.exists(tj):
+        from tokenizers import Tokenizer as RustTokenizer
+
+        tok = RustTokenizer.from_file(tj)
+
+        class _FastWrapper:
+            vocab_size = int(tok.get_vocab_size())
+
+            @staticmethod
+            def encode(text: str) -> List[int]:
+                return tok.encode(text).ids
+
+            @staticmethod
+            def encode_batch(texts: Sequence[str]) -> List[List[int]]:
+                return [e.ids for e in tok.encode_batch(list(texts))]
+
+            @staticmethod
+            def decode(ids: Sequence[int]) -> str:
+                return tok.decode(list(ids))
+
+        return _FastWrapper()
+
     from transformers import AutoTokenizer  # local import by design
 
     tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
@@ -97,5 +124,9 @@ def from_pretrained_dir(path: str):
         @staticmethod
         def encode_batch(texts: Sequence[str]) -> List[List[int]]:
             return [tok.encode(t, truncation=False) for t in texts]
+
+        @staticmethod
+        def decode(ids: Sequence[int]) -> str:
+            return tok.decode(list(ids), skip_special_tokens=True)
 
     return _HFWrapper()

@@ -37,6 +37,20 @@ from .engine import InferenceEngine
 logger = logging.getLogger(__name__)
 
 
+def iter_results(provider, crawl_id: str,
+                 storage_prefix: str = "inference"):
+    """Yield result dicts across all per-batch files of a crawl, in
+    batch-file order — the read side of the idempotent writeback."""
+    base = f"{storage_prefix}/{crawl_id}/batches"
+    for name in provider.list_dir(base):
+        if not name.endswith(".jsonl"):
+            continue
+        text = provider.get_text(f"{base}/{name}")
+        for line in (text or "").splitlines():
+            if line:
+                yield json.loads(line)
+
+
 @dataclass
 class TPUWorkerConfig:
     worker_id: str = "tpu-worker-0"
@@ -51,8 +65,10 @@ class TPUWorker:
     """Consume RecordBatches from the bus, run the engine, write results.
 
     ``provider`` is any `state.providers.StorageProvider`; results land as
-    JSONL under `{storage_prefix}/{crawl_id}/results.jsonl` — the same sink
-    family the crawler writes posts to, per the north star.
+    one JSONL file per batch under
+    `{storage_prefix}/{crawl_id}/batches/{batch_id}.jsonl` — the same sink
+    family the crawler writes posts to, per the north star.  Use
+    :func:`iter_results` to read them back as one stream.
     """
 
     def __init__(self, bus, engine: InferenceEngine,
@@ -110,30 +126,46 @@ class TPUWorker:
         return False
 
     # -- bus handler (never blocks on the device) --------------------------
-    def _handle_payload(self, payload: Dict[str, Any]) -> None:
+    def _handle_payload(self, payload: Dict[str, Any], ack=None) -> None:
+        """``ack`` is supplied by manual-ack buses (RemoteBus): the frame is
+        acked only after the batch is processed AND written back, so a
+        worker crash mid-queue requeues it server-side instead of losing
+        it.  Buses without acks (InMemoryBus) call with one argument."""
         batch = RecordBatch.from_dict(payload)
         if not batch.records:
+            if ack is not None:
+                ack(True)
             return
         # Raising into the bus (queue full) triggers redelivery — the bus's
         # retry semantics are the backpressure path, as in the reference's
         # handler-error-means-retry contract (`pubsub.go:157-171`).
-        self._queue.put(batch, timeout=5.0)
+        try:
+            self._queue.put((batch, ack), timeout=5.0)
+        except queue.Full:
+            if ack is not None:
+                ack(False)  # requeue server-side; don't block the stream
+                return
+            raise
         self.m_queue_depth.set(self._queue.qsize())
 
     # -- feed loop ---------------------------------------------------------
     def _feed_loop(self) -> None:
         while not self._stop.is_set():
             try:
-                batch = self._queue.get(timeout=0.1)
+                batch, ack = self._queue.get(timeout=0.1)
             except queue.Empty:
                 continue
             self.m_queue_depth.set(self._queue.qsize())
             try:
                 self._process(batch)
                 self._processed += 1
+                if ack is not None:
+                    ack(True)
             except Exception as e:
                 self._errors += 1
                 logger.exception("batch %s failed: %s", batch.batch_id, e)
+                if ack is not None:
+                    ack(False)
 
     def _process(self, batch: RecordBatch) -> None:
         if batch.created_at is not None:
@@ -153,16 +185,21 @@ class TPUWorker:
             self._writeback(batch)
 
     def _writeback(self, batch: RecordBatch) -> None:
-        rel = f"{self.cfg.storage_prefix}/{batch.crawl_id or 'adhoc'}/results.jsonl"
+        """Idempotent: one atomically-written file per batch_id, so a bus
+        redelivery or worker restart overwrites the same file with the same
+        content instead of duplicating rows (SURVEY.md §7 hard part (d))."""
+        rel = (f"{self.cfg.storage_prefix}/{batch.crawl_id or 'adhoc'}"
+               f"/batches/{batch.batch_id}.jsonl")
+        lines = []
         for record, result in zip(batch.records, batch.results):
-            line = json.dumps({
+            lines.append(json.dumps({
                 "post_uid": record.get("post_uid", ""),
                 "channel_name": record.get("channel_name", ""),
                 "batch_id": batch.batch_id,
                 "trace_id": batch.trace_id,
                 **result,
-            }, ensure_ascii=False)
-            self.provider.append_jsonl(rel, line)
+            }, ensure_ascii=False))
+        self.provider.put_text(rel, "\n".join(lines) + "\n")
 
     # -- heartbeats --------------------------------------------------------
     def _heartbeat_loop(self) -> None:

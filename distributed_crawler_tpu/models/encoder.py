@@ -98,7 +98,9 @@ class DenseMLP(nn.Module):
         cfg = self.cfg
         h = nn.Dense(cfg.mlp_dim, dtype=cfg.adtype, param_dtype=jnp.float32,
                      name="mlp_up")(x)
-        h = nn.gelu(h, approximate=True)
+        # Exact (erf) GELU: parity with published BERT/RoBERTa checkpoints;
+        # XLA fuses erf into the matmul epilogue so tanh-approx buys nothing.
+        h = nn.gelu(h, approximate=False)
         return nn.Dense(cfg.hidden, dtype=cfg.adtype, param_dtype=jnp.float32,
                         name="mlp_down")(h)
 

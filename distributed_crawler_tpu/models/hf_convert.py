@@ -1,0 +1,278 @@
+"""HuggingFace -> Flax parameter conversion for the model zoo.
+
+BASELINE.md's configs name *published* checkpoints (multilingual-E5, XLM-R,
+Whisper); this module maps their HF layouts onto the param trees of
+`models.encoder` / `models.whisper`, entirely offline (local files only —
+the deployment ships checkpoint dirs the same way the reference shipped
+pre-seeded TDLib DBs, `telegramhelper/client.go:232-260`).
+
+Supported sources, auto-detected inside the checkpoint dir:
+- ``model.safetensors`` (read with safetensors.numpy)
+- ``pytorch_model.bin`` (read with torch, CPU map_location)
+
+Layout notes (RoBERTa/XLM-R family — E5 is an XLM-R encoder):
+- torch ``nn.Linear.weight`` is [out, in]; Flax ``Dense.kernel`` is
+  [in, out] -> transpose.
+- RoBERTa position ids start at ``padding_idx + 1 = 2``
+  (`modeling_roberta.create_position_ids_from_input_ids`), so rows 0-1 of
+  the HF position table are dead for right-padded input -> slice them off.
+- token_type embeddings have a single row for these models; every token
+  receives row 0 exactly once -> fold it into the position table.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, Mapping, Optional
+
+import numpy as np
+
+from .encoder import EncoderConfig
+
+_POS_OFFSET = 2  # RoBERTa: padding_idx (1) + 1
+
+
+# ---------------------------------------------------------------------------
+# State-dict loading (offline, format auto-detect)
+# ---------------------------------------------------------------------------
+
+def load_state_dict(path: str) -> Dict[str, np.ndarray]:
+    """Read an HF checkpoint dir (or a single weight file) into numpy."""
+    if os.path.isdir(path):
+        st = os.path.join(path, "model.safetensors")
+        pt = os.path.join(path, "pytorch_model.bin")
+        if os.path.exists(st):
+            path = st
+        elif os.path.exists(pt):
+            path = pt
+        else:
+            raise FileNotFoundError(
+                f"no model.safetensors or pytorch_model.bin under {path}")
+    if path.endswith(".safetensors"):
+        from safetensors.numpy import load_file
+
+        return dict(load_file(path))
+    import torch
+
+    state = torch.load(path, map_location="cpu", weights_only=True)
+    return {k: v.numpy() for k, v in state.items()}
+
+
+def load_hf_config(path: str) -> Dict[str, Any]:
+    with open(os.path.join(path, "config.json"), "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def encoder_config_from_hf(hf_cfg: Mapping[str, Any],
+                           n_labels: int = 2,
+                           dtype: str = "bfloat16") -> EncoderConfig:
+    """EncoderConfig matching an HF RoBERTa/XLM-R/BERT config.json."""
+    return EncoderConfig(
+        vocab_size=int(hf_cfg["vocab_size"]),
+        hidden=int(hf_cfg["hidden_size"]),
+        n_layers=int(hf_cfg["num_hidden_layers"]),
+        n_heads=int(hf_cfg["num_attention_heads"]),
+        mlp_dim=int(hf_cfg["intermediate_size"]),
+        max_len=int(hf_cfg["max_position_embeddings"]) - _POS_OFFSET,
+        layer_norm_eps=float(hf_cfg.get("layer_norm_eps", 1e-5)),
+        n_labels=n_labels,
+        dtype=dtype,
+    )
+
+
+# ---------------------------------------------------------------------------
+# RoBERTa/XLM-R/E5 -> models.encoder
+# ---------------------------------------------------------------------------
+
+def _strip_prefix(state: Mapping[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Drop a leading model-name prefix (``roberta.``, ``bert.``) if every
+    encoder key carries one (classification checkpoints do)."""
+    for prefix in ("roberta.", "bert.", "xlm_roberta.", "model."):
+        if any(k.startswith(prefix + "embeddings.") for k in state):
+            out = {}
+            for k, v in state.items():
+                out[k[len(prefix):] if k.startswith(prefix) else k] = v
+            return out
+    return dict(state)
+
+
+def _dense(state: Mapping[str, np.ndarray], key: str) -> Dict[str, np.ndarray]:
+    return {"kernel": np.ascontiguousarray(state[f"{key}.weight"].T),
+            "bias": state[f"{key}.bias"]}
+
+
+def _ln(state: Mapping[str, np.ndarray], key: str) -> Dict[str, np.ndarray]:
+    return {"scale": state[f"{key}.weight"], "bias": state[f"{key}.bias"]}
+
+
+def convert_roberta_encoder(state: Mapping[str, np.ndarray],
+                            cfg: EncoderConfig) -> Dict[str, Any]:
+    """HF RoBERTa-family state dict -> the `models.encoder.Encoder` subtree
+    (the value of params["params"]["encoder"])."""
+    state = _strip_prefix(state)
+    pos = state["embeddings.position_embeddings.weight"][_POS_OFFSET:]
+    pos = pos[:cfg.max_len].astype(np.float32).copy()
+    type_emb = state.get("embeddings.token_type_embeddings.weight")
+    if type_emb is not None:
+        # Single-type models: every token adds row 0 once -> fold into the
+        # position table so the runtime graph stays two-table.
+        pos += type_emb[0][None, :]
+    tree: Dict[str, Any] = {
+        "embed_tokens": state["embeddings.word_embeddings.weight"].astype(
+            np.float32),
+        "embed_positions": pos,
+        "ln_embed": _ln(state, "embeddings.LayerNorm"),
+    }
+    for i in range(cfg.n_layers):
+        base = f"encoder.layer.{i}"
+        tree[f"layers_{i}"] = {
+            "attn": {
+                "q": _dense(state, f"{base}.attention.self.query"),
+                "k": _dense(state, f"{base}.attention.self.key"),
+                "v": _dense(state, f"{base}.attention.self.value"),
+                "attn_out": _dense(state, f"{base}.attention.output.dense"),
+            },
+            "ln_attn": _ln(state, f"{base}.attention.output.LayerNorm"),
+            "mlp": {
+                "mlp_up": _dense(state, f"{base}.intermediate.dense"),
+                "mlp_down": _dense(state, f"{base}.output.dense"),
+            },
+            "ln_mlp": _ln(state, f"{base}.output.LayerNorm"),
+        }
+    return tree
+
+
+def convert_classification_head(state: Mapping[str, np.ndarray]
+                                ) -> Optional[Dict[str, Any]]:
+    """HF RobertaClassificationHead (classifier.dense + classifier.out_proj)
+    or BERT pooler+classifier -> `ClassificationHead` subtree; None if the
+    checkpoint has no head."""
+    if "classifier.dense.weight" in state:
+        return {"pooler": _dense(state, "classifier.dense"),
+                "head": _dense(state, "classifier.out_proj")}
+    if "pooler.dense.weight" in state and "classifier.weight" in state:
+        return {"pooler": _dense(state, "pooler.dense"),
+                "head": _dense(state, "classifier")}
+    return None
+
+
+def load_hf_encoder(path: str, arch: str = "embedder_classifier",
+                    n_labels: Optional[int] = None,
+                    dtype: str = "bfloat16"):
+    """Load an HF RoBERTa/XLM-R/E5 checkpoint dir into (cfg, params).
+
+    ``arch``: "embedder" (E5 pooling), "classifier", or
+    "embedder_classifier" (the fused flagship).  Returns params shaped for
+    the corresponding `models.encoder` module: ``{"params": {...}}``.
+    """
+    hf_cfg = load_hf_config(path)
+    state = _strip_prefix(load_state_dict(path))
+    head = convert_classification_head(state)
+    if n_labels is None:
+        n_labels = (head["head"]["bias"].shape[0] if head is not None
+                    else int(hf_cfg.get("num_labels", 2)))
+    cfg = encoder_config_from_hf(hf_cfg, n_labels=n_labels, dtype=dtype)
+    encoder = convert_roberta_encoder(state, cfg)
+    if arch == "embedder":
+        params = {"encoder": encoder}
+    else:
+        if head is None:
+            # Encoder-only checkpoint (E5): init-shaped random head is the
+            # caller's job; refuse silently-wrong zeros.
+            raise ValueError(
+                f"checkpoint at {path} has no classification head; "
+                f"load with arch='embedder' or fine-tune a head")
+        params = {"encoder": encoder, "cls_head": head}
+    return cfg, {"params": params}
+
+
+# ---------------------------------------------------------------------------
+# Whisper -> models.whisper
+# ---------------------------------------------------------------------------
+
+def _whisper_attn(state: Mapping[str, np.ndarray],
+                  base: str) -> Dict[str, Any]:
+    """HF WhisperAttention: k_proj has no bias (matches OpenAI layout and
+    `models.whisper._MHA`, whose k Dense is use_bias=False)."""
+    return {
+        "q": _dense(state, f"{base}.q_proj"),
+        "k": {"kernel": np.ascontiguousarray(
+            state[f"{base}.k_proj.weight"].T)},
+        "v": _dense(state, f"{base}.v_proj"),
+        "attn_out": _dense(state, f"{base}.out_proj"),
+    }
+
+
+def whisper_config_from_hf(hf_cfg: Mapping[str, Any]):
+    from .whisper import WhisperConfig
+
+    return WhisperConfig(
+        n_mels=int(hf_cfg["num_mel_bins"]),
+        n_vocab=int(hf_cfg["vocab_size"]),
+        n_audio_ctx=int(hf_cfg["max_source_positions"]),
+        n_audio_state=int(hf_cfg["d_model"]),
+        n_audio_head=int(hf_cfg["encoder_attention_heads"]),
+        n_audio_layer=int(hf_cfg["encoder_layers"]),
+        n_text_ctx=int(hf_cfg["max_target_positions"]),
+        n_text_state=int(hf_cfg["d_model"]),
+        n_text_head=int(hf_cfg["decoder_attention_heads"]),
+        n_text_layer=int(hf_cfg["decoder_layers"]),
+    )
+
+
+def _conv(state: Mapping[str, np.ndarray], key: str) -> Dict[str, np.ndarray]:
+    """torch Conv1d weight [out, in, k] -> flax Conv kernel [k, in, out]."""
+    return {"kernel": np.ascontiguousarray(
+                state[f"{key}.weight"].transpose(2, 1, 0)),
+            "bias": state[f"{key}.bias"]}
+
+
+def convert_whisper(state: Mapping[str, np.ndarray], cfg) -> Dict[str, Any]:
+    """HF WhisperModel/WhisperForConditionalGeneration state dict ->
+    `models.whisper.Whisper` param tree (value of params["params"])."""
+    s = {}
+    for k, v in state.items():
+        k = re.sub(r"^(model\.|proj_out\.)", "", k)
+        s[k] = v
+
+    def block(base: str, cross: bool) -> Dict[str, Any]:
+        out = {
+            "attn": _whisper_attn(s, f"{base}.self_attn"),
+            "ln_attn": _ln(s, f"{base}.self_attn_layer_norm"),
+            "mlp": {"mlp_up": _dense(s, f"{base}.fc1"),
+                    "mlp_down": _dense(s, f"{base}.fc2")},
+            "ln_mlp": _ln(s, f"{base}.final_layer_norm"),
+        }
+        if cross:
+            out["cross_attn"] = _whisper_attn(s, f"{base}.encoder_attn")
+            out["ln_cross"] = _ln(s, f"{base}.encoder_attn_layer_norm")
+        return out
+
+    enc: Dict[str, Any] = {
+        "conv1": _conv(s, "encoder.conv1"),
+        "conv2": _conv(s, "encoder.conv2"),
+        "ln_post": _ln(s, "encoder.layer_norm"),
+    }
+    for i in range(cfg.n_audio_layer):
+        enc[f"layers_{i}"] = block(f"encoder.layers.{i}", cross=False)
+
+    dec: Dict[str, Any] = {
+        "embed_tokens": s["decoder.embed_tokens.weight"].astype(np.float32),
+        "embed_positions": s["decoder.embed_positions.weight"].astype(
+            np.float32)[:cfg.n_text_ctx],
+        "ln_post": _ln(s, "decoder.layer_norm"),
+    }
+    for i in range(cfg.n_text_layer):
+        dec[f"layers_{i}"] = block(f"decoder.layers.{i}", cross=True)
+
+    return {"encoder": enc, "decoder": dec}
+
+
+def load_hf_whisper(path: str):
+    """Load an HF Whisper checkpoint dir into (cfg, params)."""
+    hf_cfg = load_hf_config(path)
+    cfg = whisper_config_from_hf(hf_cfg)
+    params = convert_whisper(load_state_dict(path), cfg)
+    return cfg, {"params": params}

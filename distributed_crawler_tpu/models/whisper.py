@@ -98,13 +98,29 @@ WHISPER_TEST = WhisperConfig(n_mels=8, n_vocab=128, n_audio_ctx=16,
 
 def _mel_filterbank(n_mels: int, n_fft: int = N_FFT,
                     sample_rate: int = SAMPLE_RATE) -> np.ndarray:
-    """Slaney-style triangular mel filterbank [n_mels, n_fft//2+1] (numpy:
-    computed once at trace time, a compile-time constant on device)."""
+    """Slaney-scale triangular mel filterbank [n_mels, n_fft//2+1] (numpy:
+    computed once at trace time, a compile-time constant on device).
+
+    Matches ``librosa.filters.mel`` defaults (htk=False, norm="slaney") —
+    the filterbank published Whisper checkpoints were trained with: the mel
+    scale is LINEAR below 1 kHz and logarithmic above, not the HTK
+    2595·log10(1+f/700) curve.
+    """
+    f_sp = 200.0 / 3.0            # Hz per mel in the linear region
+    min_log_hz = 1000.0           # linear/log crossover
+    min_log_mel = min_log_hz / f_sp
+    logstep = np.log(6.4) / 27.0  # step above the crossover
+
     def hz_to_mel(f):
-        return 2595.0 * np.log10(1.0 + f / 700.0)
+        f = np.asarray(f, dtype=np.float64)
+        return np.where(f < min_log_hz, f / f_sp,
+                        min_log_mel + np.log(np.maximum(f, min_log_hz)
+                                             / min_log_hz) / logstep)
 
     def mel_to_hz(m):
-        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+        m = np.asarray(m, dtype=np.float64)
+        return np.where(m < min_log_mel, m * f_sp,
+                        min_log_hz * np.exp(logstep * (m - min_log_mel)))
 
     n_freqs = n_fft // 2 + 1
     freqs = np.linspace(0, sample_rate / 2, n_freqs)
@@ -244,7 +260,8 @@ class _MLP(nn.Module):
     def __call__(self, x):
         h = nn.Dense(4 * self.n_state, dtype=self.dtype,
                      param_dtype=jnp.float32, name="mlp_up")(x)
-        h = nn.gelu(h, approximate=True)
+        # Exact GELU: parity with published Whisper weights (OpenAI nn.GELU).
+        h = nn.gelu(h, approximate=False)
         return nn.Dense(self.n_state, dtype=self.dtype,
                         param_dtype=jnp.float32, name="mlp_down")(h)
 
@@ -289,8 +306,8 @@ class AudioEncoder(nn.Module):
         conv = partial(nn.Conv, features=c.n_audio_state, kernel_size=(3,),
                        dtype=c.adtype, param_dtype=jnp.float32)
         x = nn.gelu(conv(strides=(1,), name="conv1")(mel.astype(c.adtype)),
-                    approximate=True)
-        x = nn.gelu(conv(strides=(2,), name="conv2")(x), approximate=True)
+                    approximate=False)
+        x = nn.gelu(conv(strides=(2,), name="conv2")(x), approximate=False)
         pos = jnp.asarray(_sinusoids(c.n_audio_ctx, c.n_audio_state))
         x = x + pos[None, :x.shape[1], :].astype(c.adtype)
         for i in range(c.n_audio_layer):

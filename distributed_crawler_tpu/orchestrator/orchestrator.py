@@ -163,6 +163,7 @@ class Orchestrator:
 
     def _health_tick(self) -> None:
         self.check_worker_health()
+        self.requeue_stale_work()
         self.log_progress()
 
     # -- work distribution (`orchestrator.go:182-277`) ---------------------
@@ -366,6 +367,53 @@ class Orchestrator:
         if failed:
             self.reassign_work_from_failed_workers(failed)
         return failed
+
+    def requeue_stale_work(self, now: Optional[datetime] = None) -> int:
+        """Age out active work whose result never arrived within
+        ``work_ttl_s`` even though its worker still heartbeats (lost frame,
+        wedged handler): republish at high priority up to the retry budget,
+        then drop the item and mark its page errored so the crawl can't
+        stall forever on one in-flight entry."""
+        now = now or utcnow()
+        with self._mu:
+            stale = [i for i in self.active_work.values()
+                     if i.created_at is not None and
+                     (now - i.created_at).total_seconds() >
+                     self.ocfg.work_ttl_s]
+        requeued = 0
+        for item in stale:
+            if item.retry_count >= self.ocfg.max_retries:
+                logger.error("abandoning stale work item past retry budget",
+                             extra={"work_item_id": item.id, "url": item.url})
+                with self._mu:
+                    self.active_work.pop(item.id, None)
+                    self.error_items += 1
+                for page in self.sm.get_layer_by_depth(item.depth):
+                    if page.url == item.url:
+                        page.status = PAGE_ERROR
+                        page.error = "work item expired without result"
+                        self._retry_counts[page.id] = self.ocfg.max_retries
+                        try:
+                            self.sm.update_page(page)
+                        except Exception as e:
+                            logger.error("failed to mark expired page: %s", e)
+                        break
+                continue
+            item.retry_count += 1
+            item.assigned_to = ""
+            item.created_at = now
+            try:
+                self.bus.publish(TOPIC_WORK_QUEUE,
+                                 WorkQueueMessage.new(item, PRIORITY_HIGH,
+                                                      self.ocfg.work_ttl_s))
+                requeued += 1
+                logger.warning("requeued stale work item", extra={
+                    "work_item_id": item.id,
+                    "retry_count": item.retry_count})
+            except Exception as e:
+                logger.error("failed to requeue stale work item", extra={
+                    "work_item_id": item.id, "error": str(e)})
+        return requeued
 
     def reassign_work_from_failed_workers(self, failed: List[str]) -> int:
         """`orchestrator.go:520-559`."""

@@ -26,6 +26,10 @@ class StorageProvider(Protocol):
 
     def append_jsonl(self, rel_path: str, line: str) -> None: ...
 
+    def put_text(self, rel_path: str, text: str) -> None: ...
+
+    def get_text(self, rel_path: str) -> Optional[str]: ...
+
     def store_file(self, rel_path: str, source_path: str,
                    delete_source: bool = True) -> str: ...
 
@@ -68,6 +72,24 @@ class LocalStorageProvider:
         with self._lock:
             with open(path, "a", encoding="utf-8") as f:
                 f.write(line.rstrip("\n") + "\n")
+
+    def put_text(self, rel_path: str, text: str) -> None:
+        """Atomic whole-file write (temp + rename): rewriting the same path
+        with the same content is idempotent, the basis of exactly-once-ish
+        result writeback (SURVEY.md §7 hard part (d))."""
+        path = self._abs(rel_path)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(text)
+        os.replace(tmp, path)
+
+    def get_text(self, rel_path: str) -> Optional[str]:
+        path = self._abs(rel_path)
+        if not os.path.exists(path):
+            return None
+        with open(path, "r", encoding="utf-8") as f:
+            return f.read()
 
     def store_file(self, rel_path: str, source_path: str,
                    delete_source: bool = True) -> str:
@@ -120,6 +142,16 @@ class InMemoryStorageProvider:
     def append_jsonl(self, rel_path: str, line: str) -> None:
         self.calls.append(("append_jsonl", rel_path))
         self.jsonl_store.setdefault(rel_path, []).append(line.rstrip("\n"))
+
+    def put_text(self, rel_path: str, text: str) -> None:
+        self.calls.append(("put_text", rel_path))
+        self.jsonl_store[rel_path] = text.rstrip("\n").split("\n")
+
+    def get_text(self, rel_path: str) -> Optional[str]:
+        lines = self.jsonl_store.get(rel_path)
+        if lines is None:
+            return None
+        return "\n".join(lines) + "\n"
 
     def store_file(self, rel_path: str, source_path: str,
                    delete_source: bool = True) -> str:

@@ -52,6 +52,11 @@ _SCHEMA_PATH = os.path.join(os.path.dirname(os.path.dirname(__file__)),
 class SqlBinding(Protocol):
     """Minimal SQL surface the graph store needs."""
 
+    #: Appended inside claim subselects: "" on sqlite (BEGIN IMMEDIATE
+    #: serializes writers), " FOR UPDATE SKIP LOCKED" on PostgreSQL
+    #: (`state/daprstate.go:3944,4016`).
+    for_update_clause: str
+
     def query(self, sql: str, params: Sequence[Any] = ()) -> List[tuple]: ...
 
     def execute(self, sql: str, params: Sequence[Any] = ()) -> int:
@@ -67,7 +72,18 @@ class SqlBinding(Protocol):
 
 
 class SqliteBinding:
-    """sqlite3-backed binding with serialized writers."""
+    """sqlite3-backed binding with serialized writers.
+
+    Cross-process safe on one DB file: WAL + busy_timeout make concurrent
+    readers cheap, and every claim runs as a single BEGIN IMMEDIATE
+    transaction, so two processes (crawler pod + validator pod, the
+    reference's deploy shape, `crawl/validator.go:53`) cannot double-claim —
+    proven by `tests/test_state_multiprocess.py`.  The RLock only serializes
+    threads within one process.
+    """
+
+    for_update_clause = ""
+    dialect = "sqlite"
 
     def __init__(self, url: str = ":memory:"):
         self.url = url or ":memory:"
@@ -123,9 +139,97 @@ class SqliteBinding:
             self._conn.close()
 
 
+class DbApiBinding:
+    """Adapter over any DB-API 2.0 driver — the psycopg path for multi-host
+    deployments (parity: the reference's Dapr `postgres` binding,
+    `state/daprstate.go:3862-3893`).
+
+    ``connection_factory``: zero-arg callable returning a DB-API connection
+    (e.g. ``lambda: psycopg.connect(dsn)``).  The store's SQL is written
+    qmark-style; ``paramstyle`` converts it for the driver ("format" for
+    psycopg/pg8000, "qmark" passthrough).  ``dialect="postgres"`` turns on
+    `FOR UPDATE SKIP LOCKED` in claim subselects — the exact concurrency
+    device the reference used.
+    """
+
+    def __init__(self, connection_factory, paramstyle: str = "format",
+                 dialect: str = "postgres"):
+        self._conn = connection_factory()
+        self._paramstyle = paramstyle
+        self._lock = threading.RLock()
+        self.dialect = dialect
+        self.for_update_clause = (
+            " FOR UPDATE SKIP LOCKED" if dialect == "postgres" else "")
+
+    def _sql(self, sql: str) -> str:
+        # The store's SQL contains no literal '?', so a plain replace is
+        # exact for the format/pyformat drivers.
+        if self._paramstyle in ("format", "pyformat"):
+            return sql.replace("?", "%s")
+        return sql
+
+    def query(self, sql: str, params: Sequence[Any] = ()) -> List[tuple]:
+        with self._lock, self._conn.cursor() as cur:
+            cur.execute(self._sql(sql), tuple(params))
+            rows = cur.fetchall()
+        self._conn.commit()
+        return rows
+
+    def execute(self, sql: str, params: Sequence[Any] = ()) -> int:
+        with self._lock, self._conn.cursor() as cur:
+            cur.execute(self._sql(sql), tuple(params))
+            count = cur.rowcount
+        self._conn.commit()
+        return count
+
+    def executemany(self, sql: str,
+                    seq_params: Sequence[Sequence[Any]]) -> int:
+        with self._lock, self._conn.cursor() as cur:
+            cur.executemany(self._sql(sql),
+                            [tuple(p) for p in seq_params])
+            count = cur.rowcount
+        self._conn.commit()
+        return count
+
+    def execute_returning(self, sql: str,
+                          params: Sequence[Any] = ()) -> List[tuple]:
+        with self._lock:
+            try:
+                with self._conn.cursor() as cur:
+                    cur.execute(self._sql(sql), tuple(params))
+                    rows = cur.fetchall()
+                self._conn.commit()
+                return rows
+            except Exception:
+                self._conn.rollback()
+                raise
+
+    def executescript(self, sql: str) -> None:
+        with self._lock, self._conn.cursor() as cur:
+            cur.execute(sql)
+        self._conn.commit()
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+def schema_for_dialect(dialect: str = "sqlite") -> str:
+    """The graph-store DDL, translated for the target engine.  The source
+    of truth is `sql/schema.sql` (sqlite-compatible); postgres swaps the
+    rowid PKs for BIGSERIAL (`sql/random-walk-schema.sql` analog)."""
+    with open(_SCHEMA_PATH, "r", encoding="utf-8") as f:
+        ddl = f.read()
+    if dialect == "postgres":
+        ddl = ddl.replace("INTEGER PRIMARY KEY AUTOINCREMENT",
+                          "BIGSERIAL PRIMARY KEY")
+    return ddl
+
+
 class RecordingBinding:
     """Test double: records every statement, feeds back canned rows — the
     analog of the reference's fake Dapr client (`state/export_test.go`)."""
+
+    for_update_clause = ""
 
     def __init__(self):
         self.calls: List[Tuple[str, tuple]] = []
@@ -201,8 +305,8 @@ class SqlGraphStore:
         self.crawl_id = crawl_id
 
     def ensure_schema(self) -> None:
-        with open(_SCHEMA_PATH, "r", encoding="utf-8") as f:
-            self.binding.executescript(f.read())
+        self.binding.executescript(
+            schema_for_dialect(getattr(self.binding, "dialect", "sqlite")))
 
     # ------------------------------------------------------------------
     # edge_records (`daprstate.go:3150-3279`)
@@ -252,9 +356,14 @@ class SqlGraphStore:
     # page_buffer (`daprstate.go:3619-3733`)
     # ------------------------------------------------------------------
     def add_page_to_page_buffer(self, page: Page) -> None:
+        # Portable upsert (sqlite >= 3.24 and postgres share this syntax;
+        # INSERT OR REPLACE is sqlite-only).
         self.binding.execute(
-            "INSERT OR REPLACE INTO page_buffer (page_id, parent_id, depth, "
-            "url, crawl_id, sequence_id) VALUES (?, ?, ?, ?, ?, ?)",
+            "INSERT INTO page_buffer (page_id, parent_id, depth, "
+            "url, crawl_id, sequence_id) VALUES (?, ?, ?, ?, ?, ?) "
+            "ON CONFLICT(page_id) DO UPDATE SET parent_id = excluded.parent_id, "
+            "depth = excluded.depth, url = excluded.url, "
+            "crawl_id = excluded.crawl_id, sequence_id = excluded.sequence_id",
             (page.id, page.parent_id, page.depth, page.url,
              page.crawl_id or self.crawl_id, page.sequence_id))
 
@@ -401,12 +510,13 @@ class SqlGraphStore:
     def claim_pending_edges(self, limit: int) -> List[PendingEdge]:
         """Atomically claim up to `limit` pending edges FIFO
         (`state/interface.go:148-152`)."""
+        lock = getattr(self.binding, "for_update_clause", "")
         rows = self.binding.execute_returning(
             f"UPDATE pending_edges SET validation_status = 'validating', "
             f"validated_at = ? WHERE pending_id IN ("
             f"SELECT pending_id FROM pending_edges "
             f"WHERE validation_status = 'pending' "
-            f"ORDER BY discovery_time, pending_id LIMIT ?) "
+            f"ORDER BY discovery_time, pending_id LIMIT ?{lock}) "
             f"RETURNING {_EDGE_COLS}",
             (_ts(None), limit))
         return [_row_to_edge(r) for r in rows]
@@ -424,6 +534,7 @@ class SqlGraphStore:
         (`state/interface.go:158-161`, `daprstate.go:4017-4034`): edges still
         'pending' or 'validating' block the claim, and poison batches
         (attempt_count >= max) are never re-claimed."""
+        lock = getattr(self.binding, "for_update_clause", "")
         rows = self.binding.execute_returning(
             f"UPDATE pending_edge_batches SET status = 'processing', "
             f"attempt_count = attempt_count + 1, claimed_at = ? "
@@ -431,7 +542,7 @@ class SqlGraphStore:
             f"WHERE b.status = 'closed' AND b.attempt_count < ? AND NOT EXISTS ("
             f"SELECT 1 FROM pending_edges e WHERE e.batch_id = b.batch_id "
             f"AND e.validation_status IN ('pending', 'validating')) "
-            f"ORDER BY b.created_at LIMIT 1) "
+            f"ORDER BY b.created_at LIMIT 1{lock}) "
             f"RETURNING {_BATCH_COLS}",
             (_ts(None), MAX_BATCH_ATTEMPTS))
         if not rows:

@@ -97,9 +97,9 @@ class TestCrawlToTPU:
                       / "posts.jsonl")
         assert len(posts_file.read_text().splitlines()) == 5
 
-        # TPU side: results JSONL written through the provider.
-        results_file = tmp_path / "tpu" / "inference" / "e2e1" / "results.jsonl"
-        rows = [json.loads(l) for l in results_file.read_text().splitlines()]
+        # TPU side: per-batch results JSONL written through the provider.
+        from distributed_crawler_tpu.inference.worker import iter_results
+        rows = list(iter_results(provider, "e2e1"))
         assert len(rows) == 5
         assert all("label" in r and r["batch_id"] for r in rows)
 

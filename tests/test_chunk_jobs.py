@@ -130,6 +130,48 @@ class TestChunker:
         assert sm.uploaded[0][0] == "combined_123.jsonl"
         assert not stranded.exists()
 
+    def test_recovery_runs_at_startup_and_skips_tmp(self, tmp_path):
+        """start() recovers stranded files before the consumer exists, and
+        in-progress .tmp output is never uploaded as if complete."""
+        sm = RecordingSM()
+        c = make_chunker(tmp_path, sm)
+        os.makedirs(tmp_path / "combine", exist_ok=True)
+        stranded = tmp_path / "combine" / "combined_9.jsonl"
+        stranded.write_bytes(b"whole\n")
+        half = tmp_path / "combine" / "combined_10.jsonl.tmp"
+        half.write_bytes(b"hal")  # truncated in-progress write
+        c.start()
+        c.shutdown()
+        assert [n for n, _ in sm.uploaded] == ["combined_9.jsonl"]
+        assert half.exists()  # untouched, not uploaded, not deleted
+
+    def test_failed_combine_removes_tmp(self, tmp_path):
+        sm = RecordingSM()
+        c = make_chunker(tmp_path, sm)
+        os.makedirs(tmp_path / "combine", exist_ok=True)
+        with pytest.raises(FileNotFoundError):
+            c.combine_files([FileEntry(path=str(tmp_path / "gone.jsonl"),
+                                       size=4)])
+        leftovers = os.listdir(tmp_path / "combine")
+        assert leftovers == []  # no half-written combined_* or .tmp residue
+
+    def test_shutdown_recovers_failed_upload(self, tmp_path, monkeypatch):
+        """An upload that fails both tries strands the combined file; the
+        post-drain recovery pass in shutdown() re-uploads it."""
+        monkeypatch.setattr(
+            "distributed_crawler_tpu.chunk.chunker.UPLOAD_RETRY_DELAY_S",
+            0.05)
+        sm = RecordingSM(fail_times=2)  # consumer try + inline retry
+        c = make_chunker(tmp_path, sm)
+        write_shard(tmp_path, "s.jsonl", b"x" * 150)
+        c.start()
+        deadline = time.monotonic() + 5
+        while sm.fail_times > 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        c.shutdown()
+        assert len(sm.uploaded) == 1  # recovered post-drain
+        assert os.listdir(tmp_path / "combine") == []
+
 
 class TestFileCleaner:
     def test_removes_only_old_files_in_conn_dirs(self, tmp_path):

@@ -233,6 +233,36 @@ class TestOrchestrator:
         # Second sweep: already offline, not re-reassigned.
         assert orch.check_worker_health() == []
 
+    def test_stale_work_requeued_then_abandoned(self, tmp_path):
+        """A result that never arrives (lost frame, wedged handler) must not
+        stall the crawl even while the worker stays healthy: the item is
+        republished at high priority, and past the retry budget its page is
+        marked errored."""
+        bus = InMemoryBus()
+        republished = []
+        bus.subscribe(TOPIC_WORK_QUEUE, republished.append)
+        orch = Orchestrator("c1", make_cfg(), bus, make_sm(tmp_path),
+                            OrchestratorConfig(work_ttl_s=60, max_retries=1))
+        orch.start(["chana"], background=False)
+        orch.distribute_work()
+        republished.clear()
+        item = next(iter(orch.active_work.values()))
+
+        # Not yet past the TTL: nothing happens.
+        assert orch.requeue_stale_work(utcnow()) == 0
+        # Past the TTL: republished at high priority.
+        assert orch.requeue_stale_work(utcnow() + timedelta(seconds=120)) == 1
+        assert republished[0]["priority"] == PRIORITY_HIGH
+        assert republished[0]["work_item"]["retry_count"] == 1
+        assert item.id in orch.active_work
+
+        # Past the TTL again with the budget exhausted: abandoned.
+        assert orch.requeue_stale_work(utcnow() + timedelta(seconds=240)) == 0
+        assert item.id not in orch.active_work
+        page = orch.sm.get_layer_by_depth(0)[0]
+        assert page.status == "error"
+        assert "expired" in page.error
+
     def test_max_depth_caps_distribution(self, tmp_path):
         bus = InMemoryBus()
         orch = Orchestrator("c1", make_cfg(max_depth=1), bus,

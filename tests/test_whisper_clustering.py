@@ -61,6 +61,59 @@ class TestFrontend:
         long = jnp.ones((1, N_SAMPLES + 5))
         assert pad_or_trim(long).shape == (1, N_SAMPLES)
 
+    def test_mel_filterbank_matches_slaney_reference(self):
+        """The bank must equal librosa.filters.mel(sr=16000, n_fft=400,
+        n_mels=80, htk=False, norm='slaney') — the filterbank published
+        Whisper checkpoints were trained with.  Independent ramps-based
+        reimplementation of librosa's algorithm, compared to 1e-6."""
+        from distributed_crawler_tpu.models.whisper import _mel_filterbank
+
+        sr, n_fft, n_mels = 16000, 400, 80
+
+        # librosa's Slaney mel scale, straight-line transcription.
+        def hz_to_mel(f):
+            f = np.atleast_1d(np.asarray(f, dtype=np.float64))
+            mel = f / (200.0 / 3.0)
+            log_region = f >= 1000.0
+            mel[log_region] = 15.0 + np.log(f[log_region] / 1000.0) / (
+                np.log(6.4) / 27.0)
+            return mel
+
+        def mel_to_hz(m):
+            m = np.atleast_1d(np.asarray(m, dtype=np.float64))
+            hz = m * (200.0 / 3.0)
+            log_region = m >= 15.0
+            hz[log_region] = 1000.0 * np.exp(
+                (np.log(6.4) / 27.0) * (m[log_region] - 15.0))
+            return hz
+
+        fftfreqs = np.linspace(0, sr / 2, 1 + n_fft // 2)
+        mel_f = mel_to_hz(np.linspace(float(hz_to_mel(0.0)[0]),
+                                      float(hz_to_mel(sr / 2)[0]),
+                                      n_mels + 2))
+        fdiff = np.diff(mel_f)
+        ramps = np.subtract.outer(mel_f, fftfreqs)
+        expected = np.zeros((n_mels, 1 + n_fft // 2))
+        for i in range(n_mels):
+            lower = -ramps[i] / fdiff[i]
+            upper = ramps[i + 2] / fdiff[i + 1]
+            expected[i] = np.maximum(0, np.minimum(lower, upper))
+        expected *= (2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels]))[:, None]
+
+        got = _mel_filterbank(n_mels, n_fft, sr)
+        np.testing.assert_allclose(got, expected, atol=1e-6)
+
+        # Slaney-scale structure: crossover at 1 kHz — center frequencies
+        # evenly spaced (~36.9 Hz) below it, geometric above it.
+        centers = mel_f[1:n_mels + 1]
+        linear = centers[centers < 990.0]
+        spacing = np.diff(linear)
+        assert np.allclose(spacing, spacing[0], atol=1e-6)
+        upper = centers[centers > 1100.0]
+        ratios = upper[1:] / upper[:-1]
+        assert np.allclose(ratios, ratios[0], rtol=1e-6)
+        assert ratios[0] > 1.01
+
 
 class TestWhisper:
     def test_teacher_forcing_shapes(self, whisper_model):
