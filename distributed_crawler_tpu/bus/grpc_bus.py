@@ -103,6 +103,17 @@ class GrpcBusServer:
         self._lock = threading.RLock()
         self._stream_counter = 0
         self.dead_letters = 0
+        # Local-subscriber dispatch: per-topic queue + worker thread, so
+        # handlers run OFF the gRPC thread and get the same bounded-retry
+        # treatment as pulled frames (`distributed/pubsub.go:157-171`
+        # retried every subscriber on error; inline-and-swallow was
+        # at-most-once).
+        self._local_queues: Dict[str, "queue.Queue"] = {}
+        self._local_threads: Dict[str, threading.Thread] = {}
+        self._local_idle = threading.Condition()
+        self._local_inflight = 0
+        self._stop = threading.Event()
+        self._sweeper: Optional[threading.Thread] = None
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
             options=[("grpc.max_receive_message_length", MAX_FRAME_BYTES),
@@ -126,23 +137,74 @@ class GrpcBusServer:
     def _publish_rpc(self, request: bytes, context) -> bytes:
         topic, payload = _decode_envelope(request)
         with self._lock:
-            handlers = list(self._handlers.get(topic, []))
+            has_handlers = bool(self._handlers.get(topic))
             tq = self._pull_queues.get(topic)
+            lq = self._local_queues.get(topic) if has_handlers else None
         if tq is not None:
             tq.q.put(_QueuedFrame(payload))
-        if handlers:
+        if lq is not None:
             try:
                 decoded = json.loads(payload.decode("utf-8"))
             except (ValueError, UnicodeDecodeError):
                 # Undecodable payloads are dropped, never retried.
                 logger.error("dropping undecodable message on %s", topic)
                 return b"ok"
-            for handler in handlers:
-                try:
-                    handler(decoded)
-                except Exception as e:
-                    logger.warning("handler error on %s: %s", topic, e)
+            with self._local_idle:
+                self._local_inflight += 1
+            lq.put(decoded)
         return b"ok"
+
+    def _local_dispatch_loop(self, topic: str, lq: "queue.Queue") -> None:
+        while not self._stop.is_set():
+            try:
+                decoded = lq.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            try:
+                with self._lock:
+                    handlers = list(self._handlers.get(topic, []))
+                for handler in handlers:
+                    delivered = False
+                    for attempt in range(self.max_attempts):
+                        try:
+                            handler(decoded)
+                            delivered = True
+                            break
+                        except Exception as e:
+                            logger.warning(
+                                "local handler error on %s "
+                                "(attempt %d/%d): %s", topic, attempt + 1,
+                                self.max_attempts, e)
+                            if attempt + 1 < self.max_attempts:
+                                self._stop.wait(min(0.05 * (2 ** attempt),
+                                                    0.5))
+                    if not delivered:
+                        self.dead_letters += 1
+                        logger.error(
+                            "dead-lettering local delivery on %s after %d "
+                            "attempts", topic, self.max_attempts)
+            finally:
+                with self._local_idle:
+                    self._local_inflight -= 1
+                    if self._local_inflight == 0:
+                        self._local_idle.notify_all()
+
+    def flush_local(self, timeout_s: float = 5.0) -> bool:
+        """Block until every queued local delivery has been dispatched
+        (tests / orderly shutdown).  Returns False on timeout."""
+        with self._local_idle:
+            return self._local_idle.wait_for(
+                lambda: self._local_inflight == 0, timeout=timeout_s)
+
+    def _sweep_loop(self) -> None:
+        # Dedicated sweeper: ack deadlines fire even with no active puller
+        # (a blocked or absent consumer must not pin frames in flight).
+        interval = max(0.05, min(1.0, self.ack_timeout_s / 4.0))
+        while not self._stop.wait(interval):
+            with self._lock:
+                topics = list(self._pull_queues.items())
+            for topic, tq in topics:
+                self._sweep_expired(topic, tq)
 
     def _requeue_or_drop(self, topic: str, tq: _TopicQueue,
                          delivery_id: str, inf: _Inflight) -> None:
@@ -229,6 +291,14 @@ class GrpcBusServer:
     def subscribe(self, topic: str, handler: Callable[[Dict[str, Any]], None]) -> None:
         with self._lock:
             self._handlers.setdefault(topic, []).append(handler)
+            if topic not in self._local_queues:
+                lq: "queue.Queue" = queue.Queue()
+                self._local_queues[topic] = lq
+                t = threading.Thread(
+                    target=self._local_dispatch_loop, args=(topic, lq),
+                    daemon=True, name=f"dct-bus-local-{topic}")
+                self._local_threads[topic] = t
+                t.start()
 
     def publish(self, topic: str, payload: Any) -> None:
         """Local publish: same fan-out as a remote Publish RPC, so the host
@@ -251,10 +321,19 @@ class GrpcBusServer:
 
     def start(self) -> None:
         self._server.start()
+        self._sweeper = threading.Thread(target=self._sweep_loop,
+                                         daemon=True, name="dct-bus-sweeper")
+        self._sweeper.start()
         logger.info("bus server listening on %s", self.address)
 
     def close(self, grace: float = 0.5) -> None:
+        self.flush_local(timeout_s=grace)
+        self._stop.set()
         self._server.stop(grace)
+        if self._sweeper is not None:
+            self._sweeper.join(timeout=2.0)
+        for t in self._local_threads.values():
+            t.join(timeout=2.0)
 
 
 class GrpcBusClient:
@@ -308,15 +387,18 @@ class GrpcBusClient:
 
 def _wants_ack(handler: Callable) -> bool:
     """True if the handler accepts a second (ack) argument — manual-ack
-    mode, used by consumers that finish work asynchronously (TPU worker)."""
+    mode, used by consumers that finish work asynchronously (TPU worker).
+
+    Inference requires two or more NAMED positional parameters; a bare
+    ``*args`` handler is NOT treated as manual-ack (a generic ``lambda *a``
+    would otherwise never ack and cycle every frame to dead-letter).  Pass
+    ``manual_ack=True`` to ``subscribe`` to opt in explicitly."""
     try:
         sig = inspect.signature(handler)
     except (TypeError, ValueError):
         return False
     params = [p for p in sig.parameters.values()
               if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
-    if any(p.kind == p.VAR_POSITIONAL for p in sig.parameters.values()):
-        return True
     return len(params) >= 2
 
 
@@ -351,11 +433,29 @@ class RemoteBus:
     def publish(self, topic: str, payload: Any) -> None:
         self._client.publish(topic, payload)
 
-    def subscribe(self, topic: str,
-                  handler: Callable[..., None]) -> None:
+    def subscribe(self, topic: str, handler: Callable[..., None],
+                  manual_ack: Optional[bool] = None) -> None:
+        """Register ``handler`` for ``topic``.
+
+        ``manual_ack=None`` infers the mode from the signature (two named
+        positional params → ``(payload, ack)``); pass True/False to force.
+        A manual-ack handler OWNS its topic's deliveries, so mixing it with
+        any other handler on the same topic is rejected at subscribe time
+        rather than silently shadowing the others.
+        """
+        wants = _wants_ack(handler) if manual_ack is None else manual_ack
         with self._lock:
-            self._handlers.setdefault(topic, []).append(
-                (handler, _wants_ack(handler)))
+            existing = self._handlers.get(topic, [])
+            if wants and existing:
+                raise ValueError(
+                    f"manual-ack handler on '{topic}' would shadow "
+                    f"{len(existing)} existing subscriber(s); use a "
+                    f"dedicated topic per manual-ack consumer")
+            if existing and any(w for _, w in existing):
+                raise ValueError(
+                    f"topic '{topic}' already has a manual-ack handler; "
+                    f"additional subscribers would never receive frames")
+            self._handlers.setdefault(topic, []).append((handler, wants))
             if topic in self._threads:
                 return
             t = threading.Thread(target=self._pull_loop, args=(topic,),

@@ -19,7 +19,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from datetime import datetime
 from typing import Any, Dict, List, Optional
 
@@ -399,20 +399,31 @@ class Orchestrator:
                             logger.error("failed to mark expired page: %s", e)
                         break
                 continue
-            item.retry_count += 1
-            item.assigned_to = ""
-            item.created_at = now
+            # Rotate the item id on requeue (generation suffix) so a late
+            # result from the stale attempt can't complete the fresh one —
+            # and mutate under the lock so the result handler never sees a
+            # half-updated entry still keyed in active_work.
+            with self._mu:
+                if item.id not in self.active_work:
+                    continue  # result arrived between snapshot and requeue
+                self.active_work.pop(item.id, None)
+                fresh = replace(item,
+                                id=(item.id.rsplit("#", 1)[0] +
+                                    f"#{item.retry_count + 1}"),
+                                retry_count=item.retry_count + 1,
+                                assigned_to="", created_at=now)
+                self.active_work[fresh.id] = fresh
             try:
                 self.bus.publish(TOPIC_WORK_QUEUE,
-                                 WorkQueueMessage.new(item, PRIORITY_HIGH,
+                                 WorkQueueMessage.new(fresh, PRIORITY_HIGH,
                                                       self.ocfg.work_ttl_s))
                 requeued += 1
                 logger.warning("requeued stale work item", extra={
-                    "work_item_id": item.id,
-                    "retry_count": item.retry_count})
+                    "work_item_id": fresh.id,
+                    "retry_count": fresh.retry_count})
             except Exception as e:
                 logger.error("failed to requeue stale work item", extra={
-                    "work_item_id": item.id, "error": str(e)})
+                    "work_item_id": fresh.id, "error": str(e)})
         return requeued
 
     def reassign_work_from_failed_workers(self, failed: List[str]) -> int:
@@ -422,19 +433,26 @@ class Orchestrator:
             items = [i for i in self.active_work.values()
                      if i.assigned_to in failed]
         for item in items:
-            item.assigned_to = ""
-            item.retry_count += 1
-            item.created_at = utcnow()
+            with self._mu:
+                if item.id not in self.active_work:
+                    continue  # result landed before the reassignment
+                self.active_work.pop(item.id, None)
+                fresh = replace(item,
+                                id=(item.id.rsplit("#", 1)[0] +
+                                    f"#{item.retry_count + 1}"),
+                                retry_count=item.retry_count + 1,
+                                assigned_to="", created_at=utcnow())
+                self.active_work[fresh.id] = fresh
             try:
                 self.bus.publish(TOPIC_WORK_QUEUE,
-                                 WorkQueueMessage.new(item, PRIORITY_HIGH,
+                                 WorkQueueMessage.new(fresh, PRIORITY_HIGH,
                                                       self.ocfg.work_ttl_s))
                 reassigned += 1
                 logger.info("reassigned work item from failed worker", extra={
-                    "work_item_id": item.id, "retry_count": item.retry_count})
+                    "work_item_id": fresh.id, "retry_count": fresh.retry_count})
             except Exception as e:
                 logger.error("failed to reassign work item", extra={
-                    "work_item_id": item.id, "error": str(e)})
+                    "work_item_id": fresh.id, "error": str(e)})
         return reassigned
 
     # -- progress / status (`orchestrator.go:562-633`) ---------------------

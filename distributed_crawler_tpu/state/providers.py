@@ -128,6 +128,7 @@ class InMemoryStorageProvider:
     def __init__(self):
         self.json_store: Dict[str, Any] = {}
         self.jsonl_store: Dict[str, List[str]] = {}
+        self.text_store: Dict[str, str] = {}
         self.files: Dict[str, bytes] = {}
         self.calls: List[tuple] = []
 
@@ -141,13 +142,21 @@ class InMemoryStorageProvider:
 
     def append_jsonl(self, rel_path: str, line: str) -> None:
         self.calls.append(("append_jsonl", rel_path))
+        if rel_path in self.text_store:  # appending to a put_text file
+            prior = self.text_store.pop(rel_path)
+            self.jsonl_store[rel_path] = prior.rstrip("\n").split("\n")
         self.jsonl_store.setdefault(rel_path, []).append(line.rstrip("\n"))
 
     def put_text(self, rel_path: str, text: str) -> None:
+        # Byte-exact round trip, matching LocalStorageProvider's atomic
+        # whole-file write (no line normalization).
         self.calls.append(("put_text", rel_path))
-        self.jsonl_store[rel_path] = text.rstrip("\n").split("\n")
+        self.text_store[rel_path] = text
+        self.jsonl_store.pop(rel_path, None)  # put_text overwrites appends
 
     def get_text(self, rel_path: str) -> Optional[str]:
+        if rel_path in self.text_store:
+            return self.text_store[rel_path]
         lines = self.jsonl_store.get(rel_path)
         if lines is None:
             return None
@@ -167,12 +176,13 @@ class InMemoryStorageProvider:
 
     def exists(self, rel_path: str) -> bool:
         return (rel_path in self.json_store or rel_path in self.jsonl_store
-                or rel_path in self.files)
+                or rel_path in self.text_store or rel_path in self.files)
 
     def list_dir(self, rel_path: str) -> List[str]:
         prefix = rel_path.rstrip("/") + "/"
         names = set()
-        for key in list(self.json_store) + list(self.jsonl_store) + list(self.files):
+        for key in (list(self.json_store) + list(self.jsonl_store)
+                    + list(self.text_store) + list(self.files)):
             if key.startswith(prefix):
                 names.add(key[len(prefix):].split("/", 1)[0])
         return sorted(names)
@@ -180,4 +190,5 @@ class InMemoryStorageProvider:
     def delete(self, rel_path: str) -> None:
         self.json_store.pop(rel_path, None)
         self.jsonl_store.pop(rel_path, None)
+        self.text_store.pop(rel_path, None)
         self.files.pop(rel_path, None)

@@ -205,12 +205,66 @@ class DbApiBinding:
                 raise
 
     def executescript(self, sql: str) -> None:
-        with self._lock, self._conn.cursor() as cur:
-            cur.execute(sql)
-        self._conn.commit()
+        # DB-API cursors take one statement per execute() (sqlite3 raises
+        # ProgrammingError on multi-statement strings; psycopg tolerates
+        # them but PREPARE-based drivers do not) — split the DDL first.
+        with self._lock:
+            try:
+                with self._conn.cursor() as cur:
+                    for stmt in split_sql_statements(sql):
+                        cur.execute(stmt)
+                self._conn.commit()
+            except Exception:
+                self._conn.rollback()
+                raise
 
     def close(self) -> None:
         self._conn.close()
+
+
+def split_sql_statements(sql: str) -> List[str]:
+    """Split a DDL/DML script on top-level semicolons, respecting single-
+    and double-quoted literals and ``--`` line comments.  Sufficient for
+    the in-tree schemas (no procedural BEGIN...END bodies)."""
+    statements: List[str] = []
+    buf: List[str] = []
+    i, n = 0, len(sql)
+    while i < n:
+        ch = sql[i]
+        if ch == "-" and sql[i:i + 2] == "--":
+            nl = sql.find("\n", i)
+            i = n if nl == -1 else nl + 1
+            buf.append("\n")
+            continue
+        if ch in ("'", '"'):
+            quote = ch
+            buf.append(ch)
+            i += 1
+            while i < n:
+                buf.append(sql[i])
+                if sql[i] == quote:
+                    # doubled quote = escaped quote inside the literal
+                    if sql[i + 1:i + 2] == quote:
+                        buf.append(quote)
+                        i += 2
+                        continue
+                    i += 1
+                    break
+                i += 1
+            continue
+        if ch == ";":
+            stmt = "".join(buf).strip()
+            if stmt:
+                statements.append(stmt)
+            buf = []
+            i += 1
+            continue
+        buf.append(ch)
+        i += 1
+    tail = "".join(buf).strip()
+    if tail:
+        statements.append(tail)
+    return statements
 
 
 def schema_for_dialect(dialect: str = "sqlite") -> str:

@@ -1,0 +1,51 @@
+"""Smoke tests for bench.py (ADVICE r2 high: cfg field drift killed every
+measurement child).  Runs the real ``_measure`` path in-process on the CPU
+test mesh with tiny iteration counts — any EncoderConfig field rename or
+result-schema regression fails here instead of in the driver's BENCH run.
+"""
+
+import json
+import subprocess
+import sys
+import os
+
+import pytest
+
+import bench
+from distributed_crawler_tpu.models import E5_SMALL
+
+
+def test_encoder_forward_flops_uses_real_config_fields():
+    flops = bench._encoder_forward_flops(E5_SMALL, batch=1, seq=1)
+    # per token: L * (8 d^2 + 4 seq d + 4 d ff), MACs counted as 2 FLOPs
+    d, ff, L = E5_SMALL.hidden, E5_SMALL.mlp_dim, E5_SMALL.n_layers
+    assert flops == L * (8 * d * d + 4 * 1 * d + 4 * d * ff)
+
+
+@pytest.mark.slow
+def test_measure_smoke_cpu():
+    res = bench._measure(batch=8, seq=8, n_short=1, n_long=3,
+                         latency_samples=2)
+    assert res["metric"] == "embed_classify_posts_per_sec"
+    assert res["value"] > 0
+    assert res["unit"] == "posts/sec"
+    assert res["vs_baseline"] > 0
+    assert res["tokens_per_sec"] > 0
+    assert res["batch_latency_p50_ms"] > 0
+    assert res["platform"] == "cpu"
+    assert res["mfu"] is None  # MFU is TPU-only by design
+
+
+def test_probe_subprocess_emits_json():
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("AXON", "PALLAS_AXON", "TPU_"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, bench.__file__, "--probe"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    got = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert got["ok"] is True
+    assert got["platform"] == "cpu"

@@ -254,11 +254,21 @@ class TestOrchestrator:
         assert orch.requeue_stale_work(utcnow() + timedelta(seconds=120)) == 1
         assert republished[0]["priority"] == PRIORITY_HIGH
         assert republished[0]["work_item"]["retry_count"] == 1
-        assert item.id in orch.active_work
+        # The id rotates on requeue (generation suffix) so a late result
+        # from the stale attempt can't complete the fresh one.
+        assert item.id not in orch.active_work
+        fresh_id = republished[0]["work_item"]["id"]
+        assert fresh_id == f"{item.id}#1" and fresh_id in orch.active_work
+
+        # A result addressed to the STALE generation is ignored as unknown.
+        orch.handle_result(ResultMessage.new(WorkResult(
+            work_item_id=item.id, worker_id="w1", status="success")))
+        assert fresh_id in orch.active_work
+        assert orch.completed_items == 0
 
         # Past the TTL again with the budget exhausted: abandoned.
         assert orch.requeue_stale_work(utcnow() + timedelta(seconds=240)) == 0
-        assert item.id not in orch.active_work
+        assert not orch.active_work
         page = orch.sm.get_layer_by_depth(0)[0]
         assert page.status == "error"
         assert "expired" in page.error

@@ -231,3 +231,43 @@ class TestMediaCacheSharding:
                       "new": {"id": "new", "firstSeen": "2026-07-28T00:00:00Z"}}})
         assert not cache.has("old")  # expired (30-day TTL)
         assert cache.has("new")
+
+
+class TestInMemoryProviderTextFidelity:
+    """put_text/get_text must round-trip byte-exact, matching
+    LocalStorageProvider (ADVICE r2: newline normalization diverged)."""
+
+    def test_verbatim_roundtrip(self):
+        from distributed_crawler_tpu.state.providers import (
+            InMemoryStorageProvider,
+        )
+        p = InMemoryStorageProvider()
+        for text in ("", "\n", "a", "a\n", "a\n\nb", "a\nb\n\n"):
+            p.put_text("t.txt", text)
+            assert p.get_text("t.txt") == text, repr(text)
+
+    def test_matches_local_provider(self, tmp_path):
+        from distributed_crawler_tpu.state.providers import (
+            InMemoryStorageProvider,
+            LocalStorageProvider,
+        )
+        mem, disk = InMemoryStorageProvider(), LocalStorageProvider(
+            str(tmp_path))
+        for i, text in enumerate(("", "x", "x\n", "x\n\ny\n")):
+            rel = f"d/f{i}.txt"
+            mem.put_text(rel, text)
+            disk.put_text(rel, text)
+            assert mem.get_text(rel) == disk.get_text(rel)
+        assert mem.exists("d/f0.txt") and mem.list_dir("d") == [
+            "f0.txt", "f1.txt", "f2.txt", "f3.txt"]
+        mem.delete("d/f0.txt")
+        assert not mem.exists("d/f0.txt")
+
+    def test_append_after_put_text(self):
+        from distributed_crawler_tpu.state.providers import (
+            InMemoryStorageProvider,
+        )
+        p = InMemoryStorageProvider()
+        p.put_text("a.jsonl", '{"n": 1}\n')
+        p.append_jsonl("a.jsonl", '{"n": 2}')
+        assert p.get_text("a.jsonl") == '{"n": 1}\n{"n": 2}\n'

@@ -1,0 +1,130 @@
+"""Live-PostgreSQL conformance battery for ``DbApiBinding`` (VERDICT r2 #1).
+
+Opt-in: set ``POSTGRES_DSN`` (e.g. ``postgresql://user:pw@host/db``) and the
+full ``SqlGraphStore`` claim battery — the `FOR UPDATE SKIP LOCKED` path the
+reference relied on (`state/daprstate.go:3944-4034`) — runs against a real
+server.  Unset, every test skips so CI without a socket stays green.
+
+The driver is discovered at runtime (psycopg 3, then psycopg2, then pg8000);
+with a DSN set but no driver installed the tests fail loudly rather than
+skip, so a misconfigured CI job cannot silently pass.
+"""
+
+import concurrent.futures
+import os
+import uuid
+
+import pytest
+
+from distributed_crawler_tpu.state.datamodels import (
+    PendingEdge,
+    PendingEdgeBatch,
+)
+from distributed_crawler_tpu.state.sqlstore import DbApiBinding, SqlGraphStore
+
+DSN = os.environ.get("POSTGRES_DSN", "")
+
+pytestmark = pytest.mark.skipif(
+    not DSN, reason="POSTGRES_DSN not set; live-PG conformance is opt-in")
+
+
+def _connect():
+    try:
+        import psycopg  # psycopg 3
+
+        return psycopg.connect(DSN), "format"
+    except ImportError:
+        pass
+    try:
+        import psycopg2
+
+        return psycopg2.connect(DSN), "format"
+    except ImportError:
+        pass
+    try:
+        import pg8000.dbapi
+
+        return pg8000.dbapi.connect(DSN), "format"
+    except ImportError:
+        raise RuntimeError(
+            "POSTGRES_DSN is set but no PG driver (psycopg/psycopg2/pg8000) "
+            "is importable — install one or unset the DSN")
+
+
+@pytest.fixture
+def store():
+    """A SqlGraphStore on a throwaway PG schema, dropped after the test."""
+    conn, paramstyle = _connect()
+    schema = "dct_test_" + uuid.uuid4().hex[:12]
+    with conn.cursor() as cur:
+        cur.execute(f"CREATE SCHEMA {schema}")
+        cur.execute(f"SET search_path TO {schema}")
+    conn.commit()
+
+    def factory():
+        c, _ = _connect()
+        with c.cursor() as cur:
+            cur.execute(f"SET search_path TO {schema}")
+        c.commit()
+        return c
+
+    binding = DbApiBinding(factory, paramstyle=paramstyle,
+                           dialect="postgres")
+    s = SqlGraphStore(binding, "pg1")
+    s.ensure_schema()
+    yield s
+    binding.close()
+    with conn.cursor() as cur:
+        cur.execute(f"DROP SCHEMA {schema} CASCADE")
+    conn.commit()
+    conn.close()
+
+
+class TestLivePostgresConformance:
+    def test_schema_applies(self, store):
+        # ensure_schema ran in the fixture; idempotency check:
+        store.ensure_schema()
+
+    def test_edge_claim_battery(self, store):
+        for b in range(5):
+            store.create_pending_batch(PendingEdgeBatch(
+                batch_id=f"b{b}", crawl_id="pg1", source_channel="src",
+                sequence_id=f"s{b}"))
+            for e in range(20):
+                store.insert_pending_edge(PendingEdge(
+                    batch_id=f"b{b}", crawl_id="pg1",
+                    destination_channel=f"dst{b}_{e}",
+                    source_channel="src", sequence_id=f"s{b}"))
+
+        def worker():
+            claimed = []
+            while True:
+                edges = store.claim_pending_edges(7)
+                if not edges:
+                    return claimed
+                claimed.extend(e.pending_id for e in edges)
+
+        with concurrent.futures.ThreadPoolExecutor(4) as ex:
+            outs = [f.result() for f in
+                    [ex.submit(worker) for _ in range(4)]]
+        all_claims = [pid for out in outs for pid in out]
+        assert len(all_claims) == 100
+        assert len(set(all_claims)) == 100, "SKIP LOCKED double-claim"
+
+    def test_walkback_batch_claims(self, store):
+        for b in range(8):
+            store.create_pending_batch(PendingEdgeBatch(
+                batch_id=f"wb{b}", crawl_id="pg1", source_channel="src",
+                sequence_id=f"s{b}"))
+            store.close_pending_batch(f"wb{b}")
+        seen = []
+        while True:
+            batch, _ = store.claim_walkback_batch()
+            if batch is None:
+                break
+            seen.append(batch.batch_id)
+        assert sorted(seen) == sorted(f"wb{b}" for b in range(8))
+
+    def test_discovered_channel_single_winner(self, store):
+        assert store.claim_discovered_channel("chanx", "pg1")
+        assert not store.claim_discovered_channel("chanx", "pg1")
