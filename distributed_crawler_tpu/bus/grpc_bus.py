@@ -155,10 +155,15 @@ class GrpcBusServer:
         return b"ok"
 
     def _local_dispatch_loop(self, topic: str, lq: "queue.Queue") -> None:
-        while not self._stop.is_set():
+        # Keeps draining after _stop until the queue is empty: a Publish we
+        # answered b"ok" to must reach local handlers even across close()
+        # (retry backoffs short-circuit once _stop is set).
+        while True:
             try:
                 decoded = lq.get(timeout=0.25)
             except queue.Empty:
+                if self._stop.is_set():
+                    return
                 continue
             try:
                 with self._lock:
@@ -327,9 +332,13 @@ class GrpcBusServer:
         logger.info("bus server listening on %s", self.address)
 
     def close(self, grace: float = 0.5) -> None:
-        self.flush_local(timeout_s=grace)
-        self._stop.set()
-        self._server.stop(grace)
+        self._server.stop(grace)  # stop accepting new publishes first
+        self._stop.set()          # dispatch loops drain, then exit
+        if not self.flush_local(timeout_s=max(grace, 5.0)):
+            with self._local_idle:
+                remaining = self._local_inflight
+            logger.error("bus closed with %d undelivered local "
+                         "message(s)", remaining)
         if self._sweeper is not None:
             self._sweeper.join(timeout=2.0)
         for t in self._local_threads.values():

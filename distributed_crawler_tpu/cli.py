@@ -101,6 +101,9 @@ def build_parser() -> argparse.ArgumentParser:
     # Observability (pprof-analog)
     a("--metrics-port", type=int, default=None,
       help="serve /metrics + /healthz on this port (0 = off)")
+    a("--profiler-port", type=int, default=None,
+      help="serve a jax.profiler trace server on this port (0 = off; "
+           "the reference's :6060 pprof analog)")
     # TPU inference stage
     a("--infer", action="store_const", const=True, default=None,
       help="enable the TPU inference stage")
@@ -165,6 +168,7 @@ _KEY_MAP = {
     "url_file": "crawler.url_file",
     "bus_address": "distributed.bus_address",
     "metrics_port": "observability.metrics_port",
+    "profiler_port": "observability.profiler_port",
     "infer": "inference.enabled",
     "infer_model": "inference.model",
     "infer_batch_size": "inference.batch_size",
@@ -470,7 +474,9 @@ def _run_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
     worker = TPUWorker(bus, engine, provider=provider,
                        cfg=TPUWorkerConfig(
                            metrics_port=r.get_int(
-                               "observability.metrics_port", 0)))
+                               "observability.metrics_port", 0),
+                           profiler_port=r.get_int(
+                               "observability.profiler_port", 0)))
     worker.start()
     try:
         import time as _time

@@ -57,6 +57,7 @@ class TPUWorkerConfig:
     heartbeat_s: float = 30.0
     queue_capacity: int = 64          # decoded batches awaiting the device
     metrics_port: int = 0             # 0 = don't serve; >0 = HTTP port
+    profiler_port: int = 0            # 0 = off; >0 = jax.profiler gRPC port
     storage_prefix: str = "inference"
     write_embeddings: bool = True     # False: labels/scores only (smaller JSONL)
 
@@ -82,6 +83,9 @@ class TPUWorker:
         self._queue: "queue.Queue[RecordBatch]" = queue.Queue(cfg.queue_capacity)
         self._stop = threading.Event()
         self._threads: list = []
+        self._idle = threading.Condition()
+        self._inflight = 0          # batches accepted but not yet finished
+        self._profiler_started = False
         self._started_at = 0.0
         self._processed = 0
         self._errors = 0
@@ -105,6 +109,17 @@ class TPUWorker:
             self._threads.append(t)
         if self.cfg.metrics_port:
             self._metrics_server = serve_metrics(self.cfg.metrics_port)
+        if self.cfg.profiler_port:
+            # The pprof-endpoint analog (`main.go:60-80` served :6060
+            # unconditionally): a jax.profiler gRPC server that
+            # TensorBoard / `jax.profiler.trace` clients attach to for
+            # on-demand device traces.
+            import jax.profiler
+
+            jax.profiler.start_server(self.cfg.profiler_port)
+            self._profiler_started = True
+            logger.info("jax profiler serving", extra={
+                "port": self.cfg.profiler_port})
         logger.info("tpu worker started", extra={
             "worker_id": self.cfg.worker_id,
             "model": self.engine.cfg.model})
@@ -115,15 +130,22 @@ class TPUWorker:
             t.join(timeout=timeout_s)
         if self._metrics_server is not None:
             self._metrics_server.shutdown()
+        if self._profiler_started:
+            import jax.profiler
+
+            try:
+                jax.profiler.stop_server()
+            except Exception as e:  # jax keeps a module-global server
+                logger.warning("profiler server stop failed: %s", e)
+            self._profiler_started = False
 
     def drain(self, timeout_s: float = 30.0) -> bool:
-        """Block until the queue is empty (tests / graceful shutdown)."""
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
-            if self._queue.empty():
-                return True
-            time.sleep(0.01)
-        return False
+        """Block until every accepted batch — queued OR mid-process — has
+        finished, so `drain(); stop()` never cuts off the final
+        writeback/ack.  ``_inflight`` counts from enqueue to completion."""
+        with self._idle:
+            return self._idle.wait_for(
+                lambda: self._inflight == 0, timeout=timeout_s)
 
     # -- bus handler (never blocks on the device) --------------------------
     def _handle_payload(self, payload: Dict[str, Any], ack=None) -> None:
@@ -139,14 +161,25 @@ class TPUWorker:
         # Raising into the bus (queue full) triggers redelivery — the bus's
         # retry semantics are the backpressure path, as in the reference's
         # handler-error-means-retry contract (`pubsub.go:157-171`).
+        # The in-flight count covers enqueue→completion, so drain() sees the
+        # batch the moment it is accepted (no queue-vs-processing gap).
+        with self._idle:
+            self._inflight += 1
         try:
             self._queue.put((batch, ack), timeout=5.0)
         except queue.Full:
+            self._finish_one()
             if ack is not None:
                 ack(False)  # requeue server-side; don't block the stream
                 return
             raise
         self.m_queue_depth.set(self._queue.qsize())
+
+    def _finish_one(self) -> None:
+        with self._idle:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._idle.notify_all()
 
     # -- feed loop ---------------------------------------------------------
     def _feed_loop(self) -> None:
@@ -157,15 +190,18 @@ class TPUWorker:
                 continue
             self.m_queue_depth.set(self._queue.qsize())
             try:
-                self._process(batch)
-                self._processed += 1
-                if ack is not None:
-                    ack(True)
-            except Exception as e:
-                self._errors += 1
-                logger.exception("batch %s failed: %s", batch.batch_id, e)
-                if ack is not None:
-                    ack(False)
+                try:
+                    self._process(batch)
+                    self._processed += 1
+                    if ack is not None:
+                        ack(True)
+                except Exception as e:
+                    self._errors += 1
+                    logger.exception("batch %s failed: %s", batch.batch_id, e)
+                    if ack is not None:
+                        ack(False)
+            finally:
+                self._finish_one()
 
     def _process(self, batch: RecordBatch) -> None:
         if batch.created_at is not None:

@@ -142,9 +142,12 @@ class InMemoryStorageProvider:
 
     def append_jsonl(self, rel_path: str, line: str) -> None:
         self.calls.append(("append_jsonl", rel_path))
-        if rel_path in self.text_store:  # appending to a put_text file
-            prior = self.text_store.pop(rel_path)
-            self.jsonl_store[rel_path] = prior.rstrip("\n").split("\n")
+        if rel_path in self.text_store:
+            # Appending to a put_text file: byte-append exactly as the
+            # filesystem provider would (no line re-normalization of the
+            # prior content).
+            self.text_store[rel_path] += line.rstrip("\n") + "\n"
+            return
         self.jsonl_store.setdefault(rel_path, []).append(line.rstrip("\n"))
 
     def put_text(self, rel_path: str, text: str) -> None:

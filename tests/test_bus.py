@@ -572,3 +572,25 @@ class TestManualAckSubscribe:
             bus.close()
         finally:
             server.close()
+
+
+class TestCloseDrainsLocal:
+    def test_close_delivers_queued_local_messages(self):
+        """An acked Publish must reach local handlers even when close()
+        races the dispatch (review finding on flush-then-stop ordering)."""
+        import time
+
+        from distributed_crawler_tpu.bus.grpc_bus import GrpcBusServer
+        server = GrpcBusServer(address="127.0.0.1:0")
+        server.start()
+        got = []
+
+        def slowish(payload):
+            time.sleep(0.2)
+            got.append(payload)
+
+        server.subscribe("results", slowish)
+        for i in range(3):
+            server.publish("results", {"n": i})
+        server.close()  # must drain all three, not drop the backlog
+        assert got == [{"n": 0}, {"n": 1}, {"n": 2}]

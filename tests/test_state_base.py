@@ -271,3 +271,20 @@ class TestInMemoryProviderTextFidelity:
         p.put_text("a.jsonl", '{"n": 1}\n')
         p.append_jsonl("a.jsonl", '{"n": 2}')
         assert p.get_text("a.jsonl") == '{"n": 1}\n{"n": 2}\n'
+
+    def test_append_after_put_text_matches_local(self, tmp_path):
+        """Byte-append semantics for edge-case priors ('' and no trailing
+        newline) must match the filesystem provider exactly."""
+        from distributed_crawler_tpu.state.providers import (
+            InMemoryStorageProvider,
+            LocalStorageProvider,
+        )
+        for i, prior in enumerate(("", "a", "a\n", "a\n\n")):
+            mem, disk = InMemoryStorageProvider(), LocalStorageProvider(
+                str(tmp_path / str(i)))
+            rel = "f.jsonl"
+            mem.put_text(rel, prior)
+            disk.put_text(rel, prior)
+            mem.append_jsonl(rel, "x")
+            disk.append_jsonl(rel, "x")
+            assert mem.get_text(rel) == disk.get_text(rel), repr(prior)
