@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import sys
 from typing import List, Optional
 
@@ -45,7 +46,8 @@ def build_parser() -> argparse.ArgumentParser:
     a("--log-level", default=None, help="trace|debug|info|warn|error")
     a("--log-json", action="store_const", const=True, default=None)
     a("--mode", default=None,
-      help="standalone | launch | orchestrator | worker | job | tpu-worker")
+      help="standalone | launch | orchestrator | worker | job | "
+           "tpu-worker | train-head")
     a("--worker-id", default=None, help="worker identifier (worker modes)")
     a("--concurrency", type=int, default=None)
     a("--timeout", type=int, default=None, help="HTTP timeout seconds")
@@ -109,6 +111,17 @@ def build_parser() -> argparse.ArgumentParser:
       help="enable the TPU inference stage")
     a("--infer-model", default=None, help="model registry key")
     a("--infer-batch-size", type=int, default=None)
+    # Classifier fine-tune (mode=train-head): crawl JSONL + labels ->
+    # orbax checkpoint the engine reloads via --head-checkpoint.
+    a("--train-posts", default=None,
+      help="crawl posts JSONL (train-head mode)")
+    a("--train-labels", default=None,
+      help='labels JSONL: {"post_uid": ..., "label": int|str} per line')
+    a("--head-checkpoint", default=None,
+      help="orbax checkpoint dir (written by train-head, read by "
+           "tpu-worker)")
+    a("--train-epochs", type=int, default=None)
+    a("--train-lr", type=float, default=None)
     a("--generate-code", action="store_true",
       help="run the Telegram auth bootstrap (TG_* env vars) and write "
            ".tdlib/credentials.json, then exit")
@@ -172,6 +185,11 @@ _KEY_MAP = {
     "infer": "inference.enabled",
     "infer_model": "inference.model",
     "infer_batch_size": "inference.batch_size",
+    "train_posts": "train.posts_file",
+    "train_labels": "train.labels_file",
+    "head_checkpoint": "train.checkpoint_dir",
+    "train_epochs": "train.epochs",
+    "train_lr": "train.learning_rate",
 }
 
 
@@ -260,8 +278,10 @@ def resolve_config(args: argparse.Namespace,
         cfg.validator_timeout_s = parse_duration(vtimeout)
 
     # Sampling-method validity matrix (`main.go` PersistentPreRunE ->
-    # common/sampling_validation.go). Validate-only pods need no URLs.
-    if not cfg.validate_only:
+    # common/sampling_validation.go). Validate-only pods need no URLs, and
+    # neither do the non-crawling service modes (TPU inference / training).
+    if not cfg.validate_only and r.get_str("distributed.mode", "") not in (
+            "tpu-worker", "train-head"):
         validate_sampling_method(SamplingValidationInput(
             platform=cfg.platform, sampling_method=cfg.sampling_method,
             url_list=r.get_list("crawler.urls"),
@@ -344,6 +364,8 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
             _run_job_service(cfg)
         elif mode == "tpu-worker":
             _run_tpu_worker(cfg, r)
+        elif mode == "train-head":
+            return _run_train_head(cfg, r)
         else:
             print(f"error: unknown execution mode: {mode}", file=sys.stderr)
             return 2
@@ -458,6 +480,114 @@ def _run_job_service(cfg: CrawlerConfig) -> None:
         scheduler.stop()
 
 
+def _run_train_head(cfg: CrawlerConfig, r: ConfigResolver) -> int:
+    """mode=train-head: crawl JSONL + labels file → fine-tuned classifier
+    head → orbax checkpoint (+ labels.json vocabulary) that `tpu-worker`
+    reloads via --head-checkpoint — closing BASELINE config #3's loop.
+
+    Labels file: one JSON object per line, {"post_uid": ..., "label": X}
+    where X is an int class id or a string class name (a sorted vocabulary
+    is built and saved for string labels)."""
+    import json as _json
+
+    from .inference.checkpoint import save_params
+    from .inference.engine import EngineConfig, InferenceEngine
+    from .models.train import TrainConfig, finetune_head
+
+    posts_file = r.get_str("train.posts_file")
+    labels_file = r.get_str("train.labels_file")
+    ckpt_dir = r.get_str("train.checkpoint_dir")
+    if not (posts_file and labels_file and ckpt_dir):
+        print("error: train-head needs --train-posts, --train-labels and "
+              "--head-checkpoint", file=sys.stderr)
+        return 2
+
+    texts: dict = {}
+    with open(posts_file, "r", encoding="utf-8") as f:
+        for line in f:
+            if not line.strip():
+                continue
+            row = _json.loads(line)
+            text = row.get("all_text") or row.get("description") or ""
+            if row.get("post_uid") and text:
+                texts[row["post_uid"]] = text
+
+    raw_labels: list = []
+    with open(labels_file, "r", encoding="utf-8") as f:
+        for line in f:
+            if not line.strip():
+                continue
+            row = _json.loads(line)
+            if row.get("post_uid") in texts:
+                raw_labels.append((row["post_uid"], row["label"]))
+    if not raw_labels:
+        print("error: no labelled posts matched the crawl file",
+              file=sys.stderr)
+        return 2
+
+    values = [lbl for _, lbl in raw_labels]
+    str_count = sum(isinstance(v, str) for v in values)
+    if str_count and str_count != len(values):
+        # A single stray string would silently remap every int id through
+        # string-sort order — refuse instead.
+        print("error: labels file mixes string and integer labels; "
+              "use one kind consistently", file=sys.stderr)
+        return 2
+    if str_count:
+        vocab = sorted({str(v) for v in values})
+        index = {name: i for i, name in enumerate(vocab)}
+        pairs = [(uid, index[str(v)]) for uid, v in raw_labels]
+    else:
+        vocab = None
+        pairs = [(uid, int(v)) for uid, v in raw_labels]
+    n_labels = (len(vocab) if vocab is not None
+                else max(lbl for _, lbl in pairs) + 1)
+
+    engine = InferenceEngine(EngineConfig(
+        model=cfg.inference.embed_model.replace("-", "_"),
+        n_labels=n_labels,
+        batch_size=cfg.inference.batch_size,
+        buckets=tuple(cfg.inference.bucket_sizes),
+        pretrained_dir=cfg.inference.pretrained_dir or None))
+
+    token_lists = engine.tokenizer.encode_batch(
+        [texts[uid] for uid, _ in pairs])
+    labels = [lbl for _, lbl in pairs]
+    epochs = r.get_int("train.epochs", 20)
+    if epochs < 1:
+        print("error: --train-epochs must be >= 1", file=sys.stderr)
+        return 2
+    tc = TrainConfig(learning_rate=r.get_float("train.learning_rate", 1e-3),
+                     warmup_steps=10)
+    params, history = finetune_head(
+        engine.ecfg, engine.params, token_lists, labels, tc=tc,
+        epochs=epochs, batch_size=min(32, max(8, len(labels))),
+        buckets=tuple(cfg.inference.bucket_sizes))
+
+    # Monotonic step numbering: retraining into the same dir always
+    # produces the NEW latest step, regardless of epoch counts.
+    from .inference.checkpoint import latest_step_dir
+
+    prior = latest_step_dir(ckpt_dir)
+    next_step = (int(os.path.basename(prior).split("_", 1)[1]) + 1
+                 if prior else 1)
+    step_dir = os.path.join(ckpt_dir, f"step_{next_step}")
+    save_params(step_dir, params)
+    if vocab is not None:
+        with open(os.path.join(ckpt_dir, "labels.json"), "w",
+                  encoding="utf-8") as f:
+            _json.dump({"labels": vocab}, f)
+    print(_json.dumps({
+        "trained_examples": len(labels),
+        "n_labels": n_labels,
+        "epochs": epochs,
+        "final_loss": history[-1]["loss"],
+        "final_accuracy": history[-1]["accuracy"],
+        "checkpoint": step_dir,
+    }))
+    return 0
+
+
 def _run_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
     """The new TPU inference worker mode (SURVEY.md §7.6)."""
     from .inference.engine import EngineConfig, InferenceEngine
@@ -468,7 +598,8 @@ def _run_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
         model=cfg.inference.embed_model.replace("-", "_"),
         batch_size=cfg.inference.batch_size,
         buckets=tuple(cfg.inference.bucket_sizes),
-        pretrained_dir=cfg.inference.pretrained_dir or None))
+        pretrained_dir=cfg.inference.pretrained_dir or None,
+        checkpoint_dir=r.get_str("train.checkpoint_dir") or None))
     # Results land as JSONL under the same storage root the crawler uses.
     provider = LocalStorageProvider(cfg.storage_root)
     worker = TPUWorker(bus, engine, provider=provider,

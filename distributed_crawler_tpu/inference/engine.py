@@ -48,6 +48,11 @@ class EngineConfig:
     # config.json [+ tokenizer.json]): loads REAL weights + vocab instead of
     # the registry config with random init.  Offline by design.
     pretrained_dir: Optional[str] = None
+    # Orbax checkpoint dir written by `dct --mode train-head` (or
+    # checkpoint.save_params): restored OVER whatever params the engine
+    # otherwise starts from, closing the crawl→train→serve loop.  Points at
+    # either a step_N directory or a root containing them (latest wins).
+    checkpoint_dir: Optional[str] = None
 
     def encoder_config(self) -> EncoderConfig:
         try:
@@ -80,6 +85,21 @@ class InferenceEngine:
                 cfg, params, tokenizer)
         else:
             self.ecfg = cfg.encoder_config()
+        self.label_names: Optional[List[str]] = None
+        if cfg.checkpoint_dir:
+            # The checkpoint's own head width wins (a 2-class fine-tune must
+            # not be forced through the engine's default n_labels): restore
+            # shapes from disk, then size the model to match.
+            params = self._restore_checkpoint(cfg.checkpoint_dir)
+            head = params["params"]["cls_head"]
+            pooler_in = int(head["pooler"]["kernel"].shape[0])
+            if pooler_in != self.ecfg.hidden:
+                raise ValueError(
+                    f"checkpoint at {cfg.checkpoint_dir} was trained on a "
+                    f"hidden={pooler_in} encoder but the engine model "
+                    f"{cfg.model!r} has hidden={self.ecfg.hidden}")
+            self.ecfg = replace(
+                self.ecfg, n_labels=int(head["head"]["bias"].shape[0]))
         self.mesh = mesh
         self.model = EmbedderClassifier(self.ecfg)
         self.tokenizer = tokenizer or HashingTokenizer(self.ecfg.vocab_size)
@@ -105,6 +125,24 @@ class InferenceEngine:
 
             params = shard_params(params, mesh)
         self.params = params
+
+    def _restore_checkpoint(self, root: str):
+        """Restore fine-tuned params (and the label vocabulary, if the
+        trainer saved one) with shapes taken from the checkpoint itself."""
+        import json
+        import os
+
+        from .checkpoint import latest_step_dir, load_params
+
+        path = latest_step_dir(root) or root
+        params = load_params(path)
+        for cand in (os.path.join(root, "labels.json"),
+                     os.path.join(path, "labels.json")):
+            if os.path.exists(cand):
+                with open(cand, "r", encoding="utf-8") as f:
+                    self.label_names = json.load(f)["labels"]
+                break
+        return params
 
     # -- device step -------------------------------------------------------
     def _step(self, bucket: int):
@@ -153,11 +191,14 @@ class InferenceEngine:
                 self.m_padding.inc(bs - len(chunk))
                 scores = _softmax_np(logits_np)
                 for row, i in enumerate(chunk):
+                    label = int(np.argmax(logits_np[row]))
                     results[i] = {
                         "embedding": emb_np[row].tolist(),
-                        "label": int(np.argmax(logits_np[row])),
+                        "label": label,
                         "scores": scores[row].tolist(),
                     }
+                    if self.label_names and label < len(self.label_names):
+                        results[i]["label_name"] = self.label_names[label]
         return results  # type: ignore[return-value]
 
     def run(self, texts: Sequence[str]) -> List[Dict[str, Any]]:

@@ -6,19 +6,30 @@ a pure function over (params, opt_state, batch) jitted once over the mesh:
 data parallelism over dp, tensor/expert over tp, sequence over sp, with XLA
 inserting the gradient all-reduces (no hand-written psum — the sharded params
 make XLA emit reduce-scatter/all-gather as needed).
+
+Two entry points:
+
+- :func:`make_train_step` — full-model fine-tune (mesh-shardable).
+- :func:`finetune_head` — head-only fine-tune on a FROZEN encoder: the
+  closing move of the pretrained-load path, where `_load_pretrained`
+  grafts a random head onto an E5-style encoder-only checkpoint
+  (`inference/engine.py`).  The frozen encoder runs ONCE per example to
+  cache CLS features; only the tiny pooler+head trains, so a labelled
+  crawl slice fine-tunes in seconds even on CPU.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
-from .encoder import Classifier, EncoderConfig
+from .encoder import Classifier, ClassificationHead, Encoder, EncoderConfig
 
 
 @dataclass(frozen=True)
@@ -79,3 +90,124 @@ def make_train_step(cfg: EncoderConfig, tc: TrainConfig = TrainConfig()
         return params, opt_state, {"loss": loss, "accuracy": acc}
 
     return init_fn, step_fn, optimizer
+
+
+# ---------------------------------------------------------------------------
+# Head-only fine-tune on a frozen encoder (BASELINE config #3 closing loop)
+# ---------------------------------------------------------------------------
+
+def encode_cls_features(ecfg: EncoderConfig, params: Any,
+                        token_lists: Sequence[Sequence[int]],
+                        batch_size: int = 64,
+                        buckets: Optional[Sequence[int]] = None
+                        ) -> np.ndarray:
+    """Run the FROZEN encoder over tokenized texts, returning the CLS
+    hidden state [N, H] — the exact feature `EmbedderClassifier` feeds its
+    `cls_head` (`encoder.py:236-247`), so a head trained on these features
+    drops back into the fused inference model unchanged.
+
+    Texts are grouped into length ``buckets`` (default: the engine's
+    standard bucket ladder capped at ``ecfg.max_len``) so one long outlier
+    doesn't force every batch to the dataset-wide max length.
+    """
+    from ..ops.padding import BucketSpec, bucket_for, pack_batch
+
+    enc = Encoder(ecfg)
+    enc_params = params["params"]["encoder"]
+    if buckets is None:
+        buckets = (32, 64, 128, 256, 512)
+    lengths = tuple(b for b in sorted(buckets) if b <= ecfg.max_len) \
+        or (ecfg.max_len,)
+    spec = BucketSpec(lengths)
+
+    @jax.jit
+    def step(p, ids, mask):
+        hidden = enc.apply({"params": p}, ids, mask)
+        return hidden[:, 0, :].astype(jnp.float32)
+
+    feats = np.zeros((len(token_lists), ecfg.hidden), np.float32)
+    groups: Dict[int, List[int]] = {}
+    for i, toks in enumerate(token_lists):
+        groups.setdefault(bucket_for(len(toks), spec), []).append(i)
+    for bucket, indices in sorted(groups.items()):
+        for start in range(0, len(indices), batch_size):
+            chunk = indices[start:start + batch_size]
+            ids, mask = pack_batch(
+                [list(token_lists[i]) for i in chunk],
+                BucketSpec((bucket,)), batch_pad_to=batch_size)
+            out = np.asarray(step(enc_params, ids, mask))
+            feats[chunk] = out[:len(chunk)]
+    return feats
+
+
+def finetune_head(ecfg: EncoderConfig, params: Any,
+                  token_lists: Sequence[Sequence[int]],
+                  labels: Sequence[int],
+                  tc: TrainConfig = TrainConfig(learning_rate=1e-3,
+                                                warmup_steps=10),
+                  epochs: int = 20, batch_size: int = 32,
+                  seed: int = 0,
+                  buckets: Optional[Sequence[int]] = None
+                  ) -> Tuple[Any, List[Dict[str, float]]]:
+    """Fine-tune ONLY the classification head on a frozen encoder.
+
+    Returns ``(new_params, history)`` where ``new_params`` is the full
+    pytree with the trained ``cls_head`` swapped in (engine-ready) and
+    ``history`` has one ``{"loss", "accuracy"}`` dict per epoch.
+    """
+    if len(token_lists) != len(labels):
+        raise ValueError(f"{len(token_lists)} texts vs {len(labels)} labels")
+    if not token_lists:
+        raise ValueError("empty training set")
+    if epochs < 1:
+        raise ValueError(f"epochs must be >= 1, got {epochs}")
+    n_labels = int(max(labels)) + 1
+    if n_labels > ecfg.n_labels:
+        raise ValueError(
+            f"label id {n_labels - 1} exceeds head width {ecfg.n_labels}")
+
+    feats = encode_cls_features(ecfg, params, token_lists,
+                                batch_size=batch_size, buckets=buckets)
+    labels_np = np.asarray(labels, np.int32)
+
+    head = ClassificationHead(ecfg)
+    head_params = params["params"]["cls_head"]
+    optimizer = make_optimizer(tc)
+    opt_state = optimizer.init(head_params)
+
+    @jax.jit
+    def step(hp, os_, x, y):
+        def loss_fn(hp):
+            logits = head.apply({"params": hp}, x)
+            loss = cross_entropy(logits, y, tc.label_smoothing)
+            acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+            return loss, acc
+
+        (loss, acc), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(hp)
+        updates, os_ = optimizer.update(grads, os_, hp)
+        return optax.apply_updates(hp, updates), os_, loss, acc
+
+    rng = np.random.default_rng(seed)
+    n = len(feats)
+    history: List[Dict[str, float]] = []
+    for _ in range(epochs):
+        order = rng.permutation(n)
+        losses, accs = [], []
+        for start in range(0, n, batch_size):
+            idx = order[start:start + batch_size]
+            # Pad the tail batch to the static shape (repeat rows; the
+            # repeats only reweight the gradient slightly).
+            if len(idx) < batch_size:
+                idx = np.concatenate(
+                    [idx, order[:batch_size - len(idx)]]) if n >= batch_size \
+                    else np.resize(idx, batch_size)
+            head_params, opt_state, loss, acc = step(
+                head_params, opt_state, feats[idx], labels_np[idx])
+            losses.append(float(loss))
+            accs.append(float(acc))
+        history.append({"loss": float(np.mean(losses)),
+                        "accuracy": float(np.mean(accs))})
+
+    new_params = {"params": {**params["params"], "cls_head": head_params}}
+    return new_params, history
