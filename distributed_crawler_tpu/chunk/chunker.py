@@ -56,6 +56,16 @@ class ProcessedMap:
         with self._lock:
             self.current.add(path)
 
+    def claim(self, path: str) -> bool:
+        """Atomic seen-check + mark: True exactly once per path.  Two
+        threads scanning concurrently (watcher poll vs a forced scan_now)
+        can otherwise both pass seen() and double-enqueue the file."""
+        with self._lock:
+            if path in self.current or path in self.previous:
+                return False
+            self.current.add(path)
+            return True
+
     def rotate(self) -> None:
         with self._lock:
             self.previous = self.current
@@ -118,6 +128,12 @@ class Chunker:
             "trigger_mb": self.trigger_size // (1024 * 1024),
             "hardcap_mb": self.hard_cap // (1024 * 1024)})
 
+    def scan_now(self) -> int:
+        """Force one synchronous watch-dir scan (callers that just wrote
+        final shards use this before shutdown so nothing waits on the
+        polling interval).  Returns newly-enqueued file count."""
+        return self._scan_once()
+
     def shutdown(self, timeout_s: float = 30.0) -> None:
         """Graceful drain: stop watching, flush the partial batch, finish
         uploads (`chunk/main.go:160-167`)."""
@@ -145,13 +161,12 @@ class Chunker:
             if not name.endswith(".jsonl"):
                 continue
             path = os.path.join(self.watch_dir, name)
-            if self.processed.seen(path):
-                continue
             try:
                 size = os.path.getsize(path)
             except OSError:
                 continue
-            self.processed.mark(path)
+            if not self.processed.claim(path):
+                continue
             if len(self.processed) >= ROTATE_THRESHOLD and \
                     self._may_rotate():
                 self.processed.rotate()

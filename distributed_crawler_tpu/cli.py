@@ -92,6 +92,9 @@ def build_parser() -> argparse.ArgumentParser:
     a("--combine-temp-dir", default=None)
     a("--combine-write-dir", default=None)
     a("--combine-trigger-size", type=int, default=None, help="MiB")
+    a("--object-store", default=None,
+      help="remote blob target for combined files "
+           "(memory:// | file:///path; empty = keep local)")
     a("--combine-hard-cap", type=int, default=None, help="MiB")
     # Inputs
     a("--urls", default=None, help="comma-separated URLs to crawl")
@@ -177,6 +180,7 @@ _KEY_MAP = {
     "combine_write_dir": "crawler.combine_write_dir",
     "combine_trigger_size": "crawler.combine_trigger_size",
     "combine_hard_cap": "crawler.combine_hard_cap",
+    "object_store": "crawler.object_store_url",
     "urls": "crawler.urls",
     "url_file": "crawler.url_file",
     "bus_address": "distributed.bus_address",
@@ -247,6 +251,7 @@ def resolve_config(args: argparse.Namespace,
                                          170) * 1024 * 1024
     cfg.combine_hard_cap = r.get_int("crawler.combine_hard_cap",
                                      200) * 1024 * 1024
+    cfg.object_store_url = r.get_str("crawler.object_store_url", "")
     cfg.inference.enabled = r.get_bool("inference.enabled", False)
     model = r.get_str("inference.model")
     if model:
@@ -540,6 +545,11 @@ def _run_train_head(cfg: CrawlerConfig, r: ConfigResolver) -> int:
     else:
         vocab = None
         pairs = [(uid, int(v)) for uid, v in raw_labels]
+        if any(lbl < 0 for _, lbl in pairs):
+            print("error: negative label ids are not valid classes "
+                  "(drop unlabeled rows instead of marking them -1)",
+                  file=sys.stderr)
+            return 2
     n_labels = (len(vocab) if vocab is not None
                 else max(lbl for _, lbl in pairs) + 1)
 
@@ -573,10 +583,14 @@ def _run_train_head(cfg: CrawlerConfig, r: ConfigResolver) -> int:
                  if prior else 1)
     step_dir = os.path.join(ckpt_dir, f"step_{next_step}")
     save_params(step_dir, params)
+    vocab_path = os.path.join(ckpt_dir, "labels.json")
     if vocab is not None:
-        with open(os.path.join(ckpt_dir, "labels.json"), "w",
-                  encoding="utf-8") as f:
+        with open(vocab_path, "w", encoding="utf-8") as f:
             _json.dump({"labels": vocab}, f)
+    elif os.path.exists(vocab_path):
+        # Integer-label retrain into a dir that had a string vocabulary:
+        # the old names no longer describe this head — remove them.
+        os.remove(vocab_path)
     print(_json.dumps({
         "trained_examples": len(labels),
         "n_labels": n_labels,

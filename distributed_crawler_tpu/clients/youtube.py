@@ -162,13 +162,22 @@ class YouTubeDataClient:
 
     # --- channels ---------------------------------------------------------
     def get_channel_info(self, channel_id: str) -> YouTubeChannel:
-        """`youtube_client.go:195`; cached per channel ID."""
+        """`youtube_client.go:195`; cached per channel ID.
+
+        Accepts a UC... id, an ``@handle`` (Data API ``forHandle``), or a
+        legacy ``user/Name`` (``forUsername``)."""
         with self._cache_lock:
             cached = self._channel_cache.get(channel_id)
         if cached is not None:
             return cached
+        if channel_id.startswith("@"):
+            selector = {"forHandle": channel_id}
+        elif channel_id.startswith("user/"):
+            selector = {"forUsername": channel_id[len("user/"):]}
+        else:
+            selector = {"id": channel_id}
         resp = self._call("channels", {
-            "part": "snippet,statistics,contentDetails", "id": channel_id})
+            "part": "snippet,statistics,contentDetails", **selector})
         items = resp.get("items") or []
         if not items:
             raise LookupError(f"channel not found: {channel_id}")
@@ -197,6 +206,9 @@ class YouTubeDataClient:
                                 to_time: Optional[datetime] = None,
                                 limit: int = 50) -> List[YouTubeVideo]:
         """Paged uploads-playlist walk (`youtube_client.go:319-878`)."""
+        if not channel_id.startswith("UC"):
+            # @handle / user/Name: resolve to the canonical UC id first.
+            channel_id = self.get_channel_info(channel_id).id
         uploads = "UU" + channel_id[2:] if channel_id.startswith("UC") else channel_id
         videos: List[YouTubeVideo] = []
         page_token = ""
@@ -345,12 +357,19 @@ class FakeYouTubeTransport:
     def __init__(self):
         self.channels: Dict[str, Dict[str, Any]] = {}
         self.videos: Dict[str, Dict[str, Any]] = {}
+        self.handles: Dict[str, str] = {}    # "@handle" -> channel id
+        self.usernames: Dict[str, str] = {}  # legacy username -> channel id
         self.calls: List[Tuple[str, Dict[str, Any]]] = []
         self.quota_used = 0
 
     def add_channel(self, channel_id: str, title: str = "", video_count: int = 0,
                     subscriber_count: int = 0, description: str = "",
-                    country: str = "") -> None:
+                    country: str = "", handle: str = "",
+                    username: str = "") -> None:
+        if handle:
+            self.handles[handle] = channel_id
+        if username:
+            self.usernames[username] = channel_id
         self.channels[channel_id] = {
             "id": channel_id,
             "snippet": {"title": title or channel_id, "description": description,
@@ -383,7 +402,12 @@ class FakeYouTubeTransport:
         self.calls.append((endpoint, params))
         self.quota_used += 100 if endpoint == "search" else 1
         if endpoint == "channels":
-            item = self.channels.get(params.get("id", ""))
+            cid = params.get("id", "")
+            if not cid and "forHandle" in params:
+                cid = self.handles.get(params["forHandle"], "")
+            if not cid and "forUsername" in params:
+                cid = self.usernames.get(params["forUsername"], "")
+            item = self.channels.get(cid)
             return {"items": [item] if item else []}
         if endpoint == "playlistItems":
             playlist = params.get("playlistId", "")

@@ -111,6 +111,9 @@ class CrawlerConfig:
     combine_write_dir: str = ""
     combine_trigger_size: int = 170 * 1024 * 1024  # 170 MiB, main.go:800
     combine_hard_cap: int = 200 * 1024 * 1024  # 200 MiB, main.go:801
+    # Remote blob target for combined files ("memory://" | "file:///path");
+    # empty = keep combined files local (no output binding configured).
+    object_store_url: str = ""
 
     # Null handling
     null_config: str = ""  # user JSON overriding default rules

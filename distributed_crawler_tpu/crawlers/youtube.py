@@ -13,6 +13,7 @@ Parity with the reference's `crawler/youtube/youtube_crawler.go` (871 LoC):
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import random
 import re
@@ -95,6 +96,39 @@ def _channel_url(channel_id: str) -> str:
     return f"https://www.youtube.com/channel/{channel_id}"
 
 
+def youtube_channel_id(target: str) -> str:
+    """Extract the channel identifier from a seed URL or pass a bare id
+    through unchanged, preserving case (UC... ids are case-sensitive, so
+    the telegram-style lowercasing in `normalize_seed_urls` must never
+    touch YouTube seeds).
+
+    Accepted shapes: ``https://(www.)youtube.com/channel/UC...[/tab]``,
+    ``.../@handle[/tab]``, ``.../user/Name`` (legacy ``forUsername``,
+    returned as ``user/Name``), bare ``UC...``, bare ``@handle``.
+    ``/c/CustomName`` URLs are rejected: the Data API has no lookup for
+    custom URLs — re-seed with the UC id or @handle."""
+    rest = target.strip()
+    for prefix in ("https://www.youtube.com/", "http://www.youtube.com/",
+                   "https://youtube.com/", "http://youtube.com/",
+                   "www.youtube.com/", "youtube.com/"):
+        if rest.startswith(prefix):
+            rest = rest[len(prefix):]
+            break
+    else:
+        return rest  # bare id / handle
+    rest = rest.split("?", 1)[0].strip("/")
+    if rest.startswith("c/"):
+        raise ValueError(
+            f"custom URL {target!r} cannot be resolved through the Data "
+            f"API; seed with the channel's UC id or @handle instead")
+    if rest.startswith("channel/"):
+        rest = rest[len("channel/"):]
+        return rest.split("/", 1)[0]  # drop trailing /videos etc.
+    if rest.startswith("user/"):
+        return "user/" + rest[len("user/"):].split("/", 1)[0]
+    return rest.split("/", 1)[0]  # "@handle[/tab]" or naked segment
+
+
 def _best_thumbnail(thumbnails: Dict[str, str]) -> str:
     for quality in ("maxres", "high", "medium", "default"):
         url = thumbnails.get(quality, "")
@@ -155,6 +189,8 @@ class YouTubeCrawler(Crawler):
         self.validate_target(target)
         if not self.initialized:
             raise RuntimeError("crawler not initialized")
+        target = dataclasses.replace(target,
+                                     id=youtube_channel_id(target.id))
         channel = self.client.get_channel_info(target.id)
         url = _channel_url(target.id)
         return ChannelData(
@@ -191,6 +227,10 @@ class YouTubeCrawler(Crawler):
         self.validate_target(job.target)
         if not self.initialized:
             raise RuntimeError("crawler not initialized")
+        # Seed URLs arrive whole from the layer runner; resolve them to the
+        # bare channel identifier the Data API expects (case preserved).
+        job = dataclasses.replace(job, target=dataclasses.replace(
+            job.target, id=youtube_channel_id(job.target.id)))
 
         if self.sampling_method == SAMPLING_CHANNEL:
             videos = self.client.get_videos_from_channel(

@@ -161,6 +161,9 @@ def finetune_head(ecfg: EncoderConfig, params: Any,
         raise ValueError("empty training set")
     if epochs < 1:
         raise ValueError(f"epochs must be >= 1, got {epochs}")
+    if min(labels) < 0:
+        # one_hot(-1) is an all-zero row: silent loss dilution, not a class.
+        raise ValueError(f"negative label id {min(labels)} is not a class")
     n_labels = int(max(labels)) + 1
     if n_labels > ecfg.n_labels:
         raise ValueError(

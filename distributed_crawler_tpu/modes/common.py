@@ -37,6 +37,7 @@ def state_config_from_crawler_config(cfg: CrawlerConfig,
         combine_files=cfg.combine_files,
         combine_watch_dir=cfg.combine_watch_dir,
         combine_temp_dir=cfg.combine_temp_dir,
+        object_store_url=cfg.object_store_url,
     )
 
 

@@ -22,6 +22,43 @@ from .youtube_random import run_random_youtube_sample
 logger = logging.getLogger("dct.modes.runner")
 
 
+def ship_crawl_output(cfg: CrawlerConfig, crawl_exec_id: str) -> int:
+    """Copy the finished crawl's per-channel post files into the chunker's
+    watch dir as write-once shards — the launch-mode analog of the
+    reference deployment where crawler pods wrote into the chunk service's
+    watched volume (`chunk/main.go:105-150` + localstorage binding).
+
+    Runs after the crawl completes, so each posts.jsonl is final; shards
+    are named uniquely per (crawl, channel) and written via temp+rename so
+    the watcher can't pick up a half-copy.  Returns the shard count."""
+    import os
+    import shutil
+
+    if not cfg.combine_watch_dir:
+        return 0
+    # Post files are keyed by crawl_id (`state/local.py store_post`); fall
+    # back to the execution id for configs where only it is set.
+    candidates = [c for c in (cfg.crawl_id, crawl_exec_id) if c]
+    root = next((os.path.join(cfg.storage_root, c) for c in candidates
+                 if os.path.isdir(os.path.join(cfg.storage_root, c))), None)
+    if root is None:
+        return 0
+    tag = os.path.basename(root)
+    os.makedirs(cfg.combine_watch_dir, exist_ok=True)
+    shipped = 0
+    for channel in sorted(os.listdir(root)):
+        src = os.path.join(root, channel, "posts", "posts.jsonl")
+        if not os.path.isfile(src):
+            continue
+        dest = os.path.join(cfg.combine_watch_dir,
+                            f"{tag}_{channel}_posts.jsonl")
+        tmp = dest + ".partial"  # .tmp/.jsonl suffixes are watcher-visible
+        shutil.copyfile(src, tmp)
+        os.replace(tmp, dest)
+        shipped += 1
+    return shipped
+
+
 def make_yt_pool(sm, cfg: CrawlerConfig, yt_transport=None) -> YtWorkerPool:
     """Rotation pool whose factory builds connected registry crawlers
     (`dapr/standalone.go:446-451`)."""
@@ -137,6 +174,14 @@ def launch(seed_urls: List[str], cfg: CrawlerConfig, sm=None,
             sm.export_pages_to_binding(cfg.crawl_id)
         except Exception as e:
             logger.error("error exporting pages to binding: %s", e)
+        if chunker is not None:
+            try:
+                shipped = ship_crawl_output(cfg, crawl_exec_id)
+                chunker.scan_now()  # don't race shutdown vs poll interval
+                logger.info("shipped %d post shards to the chunker",
+                            shipped)
+            except Exception as e:
+                logger.error("error shipping crawl output to chunker: %s", e)
         logger.info("all items processed successfully")
     finally:
         if chunker is not None:

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import threading
 from datetime import datetime
 from typing import Any, Dict, List, Optional, Tuple
@@ -53,6 +54,7 @@ class BaseStateManager(StateManager):
         self.page_map: Dict[str, Page] = {}
         self.discovered_channels = DiscoveredChannels()
         self.edge_records: List[EdgeRecord] = []
+        self._object_uploader = None  # built lazily from object_store_url
 
     # --- lifecycle -------------------------------------------------------
     def initialize(self, seed_urls: List[str]) -> None:
@@ -311,3 +313,27 @@ class BaseStateManager(StateManager):
 
     def delete_page_buffer_pages(self, page_ids: List[str], page_urls: List[str]) -> None:
         raise NotImplementedError
+
+    # --- combined-file upload (the blob output binding) --------------------
+    def object_uploader(self):
+        """Lazily-built `ObjectStoreUploader` from ``object_store_url``;
+        None when no remote store is configured (combined files then stay
+        local, the pre-binding behavior)."""
+        if self._object_uploader is None and self.config.object_store_url:
+            from .objectstore import ObjectStoreUploader, make_object_client
+
+            self._object_uploader = ObjectStoreUploader(
+                make_object_client(self.config.object_store_url))
+        return self._object_uploader
+
+    def upload_combined_file(self, filename: str) -> None:
+        """Ship a chunker-combined file to the object store under
+        ``combined/<crawl>/<basename>`` (`chunk/main.go:349-421` uploaded
+        through the Dapr blob binding the same way)."""
+        uploader = self.object_uploader()
+        if uploader is None:
+            return  # no remote target configured: keep the local file
+        crawl = (self.config.crawl_execution_id or self.config.crawl_id
+                 or "adhoc")
+        key = f"combined/{crawl}/{os.path.basename(filename)}"
+        uploader.upload_file(filename, key)

@@ -57,6 +57,10 @@ class StateConfig:
     combine_files: bool = False
     combine_watch_dir: str = ""
     combine_temp_dir: str = ""
+    # Remote blob target for combined files / results ("memory://",
+    # "file:///path", or a cloud scheme once an SDK adapter is wired) —
+    # the Dapr output-binding analog (`state/daprstate.go:29-35`).
+    object_store_url: str = ""
     extra: Dict[str, Any] = field(default_factory=dict)
 
 

@@ -1,0 +1,421 @@
+"""Remote object storage: the blob seam behind the chunker and TPU worker.
+
+The reference reached Azure-blob / local-storage through Dapr output
+bindings (`state/daprstate.go:29-35`, `resources/local-storage.yaml`); this
+build keeps the same seam in-tree with an S3-shaped client protocol:
+
+- :class:`ObjectStoreClient` — the low-level blob surface (multipart
+  create/upload/complete, put/get/list/delete).  Real SDK adapters (S3,
+  GCS, Azure) implement this; this repo ships two offline backends:
+  :class:`LocalFSObjectClient` (the ``local-storage.yaml`` binding analog,
+  usable in production single-host deploys) and
+  :class:`InMemoryObjectClient` (test double with fault injection).
+- :class:`ObjectStoreUploader` — the retry+resume engine: files upload in
+  parts with exponential backoff per part, resuming from the last
+  completed part instead of byte 0 — the property the chunker's 170 MiB
+  combined files need on a flaky uplink.
+- :class:`ObjectStorageProvider` — adapts a client to the
+  `providers.StorageProvider` protocol, so state managers and the TPU
+  worker's result writeback can sink straight to the object store.
+
+URL scheme (``make_object_client``): ``memory://`` | ``file:///path``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Protocol, Tuple, runtime_checkable
+
+import json as _json
+import logging
+
+logger = logging.getLogger("dct.objectstore")
+
+DEFAULT_PART_SIZE = 8 * 1024 * 1024
+DEFAULT_MAX_RETRIES = 4
+DEFAULT_BACKOFF_S = 0.2
+
+
+@runtime_checkable
+class ObjectStoreClient(Protocol):
+    """S3-shaped blob surface; all keys are forward-slash paths."""
+
+    def put_object(self, key: str, data: bytes) -> None: ...
+
+    def get_object(self, key: str) -> Optional[bytes]: ...
+
+    def head_object(self, key: str) -> Optional[int]: ...
+
+    def list_objects(self, prefix: str) -> List[str]: ...
+
+    def delete_object(self, key: str) -> None: ...
+
+    def create_multipart(self, key: str) -> str: ...
+
+    def upload_part(self, key: str, upload_id: str, part_no: int,
+                    data: bytes) -> str: ...
+
+    def complete_multipart(self, key: str, upload_id: str,
+                           etags: List[str]) -> None: ...
+
+    def abort_multipart(self, key: str, upload_id: str) -> None: ...
+
+
+class TransientStoreError(Exception):
+    """Retryable failure (network blip, 5xx) — the uploader retries these."""
+
+
+class InMemoryObjectClient:
+    """Test double with injectable faults.
+
+    ``fail(op, times)`` makes the next ``times`` calls of ``op`` raise
+    :class:`TransientStoreError` — the hook the retry/resume tests use.
+    """
+
+    def __init__(self):
+        self.objects: Dict[str, bytes] = {}
+        self._mp: Dict[str, Dict[int, bytes]] = {}
+        self._faults: Dict[str, int] = {}
+        self.calls: List[Tuple[str, str]] = []
+        self._lock = threading.RLock()
+
+    def fail(self, op: str, times: int = 1) -> None:
+        with self._lock:
+            self._faults[op] = self._faults.get(op, 0) + times
+
+    def _maybe_fail(self, op: str) -> None:
+        with self._lock:
+            if self._faults.get(op, 0) > 0:
+                self._faults[op] -= 1
+                raise TransientStoreError(f"injected {op} failure")
+
+    def put_object(self, key: str, data: bytes) -> None:
+        self.calls.append(("put_object", key))
+        self._maybe_fail("put_object")
+        with self._lock:
+            self.objects[key] = bytes(data)
+
+    def get_object(self, key: str) -> Optional[bytes]:
+        self.calls.append(("get_object", key))
+        self._maybe_fail("get_object")
+        with self._lock:
+            return self.objects.get(key)
+
+    def head_object(self, key: str) -> Optional[int]:
+        with self._lock:
+            data = self.objects.get(key)
+        return None if data is None else len(data)
+
+    def list_objects(self, prefix: str) -> List[str]:
+        self._maybe_fail("list_objects")
+        with self._lock:
+            return sorted(k for k in self.objects if k.startswith(prefix))
+
+    def delete_object(self, key: str) -> None:
+        self._maybe_fail("delete_object")
+        with self._lock:
+            self.objects.pop(key, None)
+
+    def create_multipart(self, key: str) -> str:
+        self.calls.append(("create_multipart", key))
+        self._maybe_fail("create_multipart")
+        upload_id = f"mp-{len(self._mp)}-{key}"
+        with self._lock:
+            self._mp[upload_id] = {}
+        return upload_id
+
+    def upload_part(self, key: str, upload_id: str, part_no: int,
+                    data: bytes) -> str:
+        self.calls.append(("upload_part", f"{key}#{part_no}"))
+        self._maybe_fail("upload_part")
+        with self._lock:
+            self._mp[upload_id][part_no] = bytes(data)
+        return f"etag-{part_no}"
+
+    def complete_multipart(self, key: str, upload_id: str,
+                           etags: List[str]) -> None:
+        self.calls.append(("complete_multipart", key))
+        self._maybe_fail("complete_multipart")
+        with self._lock:
+            parts = self._mp.pop(upload_id)
+            self.objects[key] = b"".join(
+                parts[i] for i in sorted(parts))
+
+    def abort_multipart(self, key: str, upload_id: str) -> None:
+        with self._lock:
+            self._mp.pop(upload_id, None)
+
+
+class LocalFSObjectClient:
+    """Object store on a local directory — the `resources/local-storage.yaml`
+    binding analog (`state/daprstate.go:1106-1249` wrote blobs through the
+    same seam).  Objects are files under ``root``; multipart uploads stage
+    parts in a hidden ``.mp-<id>`` directory and concatenate on complete, so
+    a completed object is always whole."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.RLock()
+        self._counter = 0
+
+    def _path(self, key: str) -> str:
+        path = os.path.normpath(os.path.join(self.root, key))
+        if not path.startswith(os.path.normpath(self.root)):
+            raise ValueError(f"key escapes store root: {key}")
+        return path
+
+    def put_object(self, key: str, data: bytes) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def get_object(self, key: str) -> Optional[bytes]:
+        path = self._path(key)
+        if not os.path.isfile(path):
+            return None
+        with open(path, "rb") as f:
+            return f.read()
+
+    def head_object(self, key: str) -> Optional[int]:
+        path = self._path(key)
+        return os.path.getsize(path) if os.path.isfile(path) else None
+
+    def list_objects(self, prefix: str) -> List[str]:
+        keys = []
+        for dirpath, dirnames, filenames in os.walk(self.root):
+            dirnames[:] = [d for d in dirnames if not d.startswith(".mp-")]
+            for name in filenames:
+                if name.endswith(".tmp"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, name), self.root)
+                key = rel.replace(os.sep, "/")
+                if key.startswith(prefix):
+                    keys.append(key)
+        return sorted(keys)
+
+    def delete_object(self, key: str) -> None:
+        path = self._path(key)
+        if os.path.isfile(path):
+            os.remove(path)
+
+    def create_multipart(self, key: str) -> str:
+        with self._lock:
+            self._counter += 1
+            upload_id = f"{self._counter}-{time.time_ns()}"
+        os.makedirs(self._mp_dir(upload_id), exist_ok=True)
+        return upload_id
+
+    def _mp_dir(self, upload_id: str) -> str:
+        return os.path.join(self.root, f".mp-{upload_id}")
+
+    def upload_part(self, key: str, upload_id: str, part_no: int,
+                    data: bytes) -> str:
+        part_path = os.path.join(self._mp_dir(upload_id), f"part_{part_no:06d}")
+        tmp = part_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, part_path)
+        return f"etag-{part_no}"
+
+    def complete_multipart(self, key: str, upload_id: str,
+                           etags: List[str]) -> None:
+        mp_dir = self._mp_dir(upload_id)
+        parts = sorted(n for n in os.listdir(mp_dir)
+                       if n.startswith("part_") and not n.endswith(".tmp"))
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as out:
+            for name in parts:
+                with open(os.path.join(mp_dir, name), "rb") as f:
+                    while True:
+                        chunk = f.read(1 << 20)
+                        if not chunk:
+                            break
+                        out.write(chunk)
+        os.replace(tmp, path)
+        self.abort_multipart(key, upload_id)
+
+    def abort_multipart(self, key: str, upload_id: str) -> None:
+        import shutil
+
+        shutil.rmtree(self._mp_dir(upload_id), ignore_errors=True)
+
+
+class ObjectStoreUploader:
+    """Part-level retry+resume over any :class:`ObjectStoreClient`.
+
+    ``upload_file`` streams the file in ``part_size`` parts.  Each part
+    retries up to ``max_retries`` times with exponential backoff; a
+    mid-file failure resumes from the first unfinished part, never byte 0.
+    Files at or under ``part_size`` use a single ``put_object`` (retried
+    whole — the small-object fast path)."""
+
+    def __init__(self, client: ObjectStoreClient,
+                 part_size: int = DEFAULT_PART_SIZE,
+                 max_retries: int = DEFAULT_MAX_RETRIES,
+                 backoff_s: float = DEFAULT_BACKOFF_S):
+        self.client = client
+        self.part_size = part_size
+        self.max_retries = max_retries
+        self.backoff_s = backoff_s
+
+    def _with_retry(self, op_name: str, fn):
+        last: Optional[Exception] = None
+        for attempt in range(self.max_retries):
+            try:
+                return fn()
+            except Exception as e:  # noqa: BLE001 — classify below
+                last = e
+                logger.warning("%s failed (attempt %d/%d): %s", op_name,
+                               attempt + 1, self.max_retries, e)
+                if attempt + 1 < self.max_retries:
+                    time.sleep(self.backoff_s * (2 ** attempt))
+        assert last is not None
+        raise last
+
+    def upload_bytes(self, key: str, data: bytes) -> None:
+        if len(data) <= self.part_size:
+            self._with_retry(f"put {key}",
+                             lambda: self.client.put_object(key, data))
+            return
+        upload_id = self._with_retry(
+            f"create-multipart {key}",
+            lambda: self.client.create_multipart(key))
+        try:
+            etags: List[str] = []
+            for part_no, start in enumerate(
+                    range(0, len(data), self.part_size)):
+                chunk = data[start:start + self.part_size]
+                etags.append(self._with_retry(
+                    f"part {part_no} of {key}",
+                    lambda c=chunk, n=part_no:
+                    self.client.upload_part(key, upload_id, n, c)))
+            self._with_retry(
+                f"complete {key}",
+                lambda: self.client.complete_multipart(key, upload_id, etags))
+        except Exception:
+            try:
+                self.client.abort_multipart(key, upload_id)
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+            raise
+
+    def upload_file(self, path: str, key: str) -> int:
+        """Upload ``path`` to ``key``; returns bytes uploaded."""
+        size = os.path.getsize(path)
+        if size <= self.part_size:
+            with open(path, "rb") as f:
+                data = f.read()
+            self.upload_bytes(key, data)
+            return size
+        upload_id = self._with_retry(
+            f"create-multipart {key}",
+            lambda: self.client.create_multipart(key))
+        try:
+            etags: List[str] = []
+            with open(path, "rb") as f:
+                part_no = 0
+                while True:
+                    chunk = f.read(self.part_size)
+                    if not chunk:
+                        break
+                    etags.append(self._with_retry(
+                        f"part {part_no} of {key}",
+                        lambda c=chunk, n=part_no:
+                        self.client.upload_part(key, upload_id, n, c)))
+                    part_no += 1
+            self._with_retry(
+                f"complete {key}",
+                lambda: self.client.complete_multipart(key, upload_id, etags))
+            return size
+        except Exception:
+            try:
+                self.client.abort_multipart(key, upload_id)
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+            raise
+
+
+class ObjectStorageProvider:
+    """`providers.StorageProvider` over an object store, so state managers
+    and the TPU worker's writeback can target the remote store directly."""
+
+    def __init__(self, client: ObjectStoreClient,
+                 uploader: Optional[ObjectStoreUploader] = None):
+        self.client = client
+        self.uploader = uploader or ObjectStoreUploader(client)
+        self._lock = threading.Lock()
+
+    def save_json(self, rel_path: str, data: Any) -> None:
+        self.uploader.upload_bytes(
+            rel_path, _json.dumps(data, ensure_ascii=False).encode("utf-8"))
+
+    def load_json(self, rel_path: str) -> Optional[Any]:
+        raw = self.client.get_object(rel_path)
+        return None if raw is None else _json.loads(raw.decode("utf-8"))
+
+    def append_jsonl(self, rel_path: str, line: str) -> None:
+        # Object stores have no append: read-modify-write under a local
+        # lock (single-writer per key is the provider contract here, as
+        # each worker owns its result keys).
+        with self._lock:
+            prior = self.client.get_object(rel_path) or b""
+            self.uploader.upload_bytes(
+                rel_path, prior + line.rstrip("\n").encode("utf-8") + b"\n")
+
+    def put_text(self, rel_path: str, text: str) -> None:
+        self.uploader.upload_bytes(rel_path, text.encode("utf-8"))
+
+    def get_text(self, rel_path: str) -> Optional[str]:
+        raw = self.client.get_object(rel_path)
+        return None if raw is None else raw.decode("utf-8")
+
+    def store_file(self, rel_path: str, source_path: str,
+                   delete_source: bool = True) -> str:
+        self.uploader.upload_file(source_path, rel_path)
+        if delete_source:
+            try:
+                os.remove(source_path)
+            except OSError:
+                pass
+        return rel_path
+
+    def exists(self, rel_path: str) -> bool:
+        return self.client.head_object(rel_path) is not None
+
+    def list_dir(self, rel_path: str) -> List[str]:
+        prefix = rel_path.rstrip("/") + "/"
+        names = set()
+        for key in self.client.list_objects(prefix):
+            names.add(key[len(prefix):].split("/", 1)[0])
+        return sorted(names)
+
+    def delete(self, rel_path: str) -> None:
+        for key in self.client.list_objects(rel_path.rstrip("/") + "/"):
+            self.client.delete_object(key)
+        self.client.delete_object(rel_path)
+
+
+def make_object_client(url: str) -> ObjectStoreClient:
+    """``memory://`` | ``file:///abs/path`` | ``file:relative/path``.
+
+    Cloud schemes (``s3://`` etc.) raise with a pointer to the client
+    protocol — SDK adapters slot in here without touching callers."""
+    if url == "memory://":
+        return InMemoryObjectClient()
+    if url.startswith("file://"):
+        return LocalFSObjectClient(url[len("file://"):] or "/")
+    if url.startswith("file:"):
+        return LocalFSObjectClient(url[len("file:"):])
+    if "://" in url:
+        raise ValueError(
+            f"no client for object-store scheme {url.split('://')[0]!r}; "
+            f"implement ObjectStoreClient and wire it in make_object_client")
+    return LocalFSObjectClient(url)

@@ -136,7 +136,8 @@ class TestYouTubeCrawler:
 
     def test_username_channel_url(self, tmp_path):
         c = self._crawler(tmp_path)
-        c.client.transport.add_channel("@handle", title="H")
+        # Handles resolve via the Data API's forHandle selector.
+        c.client.transport.add_channel("UC_h1", title="H", handle="@handle")
         data = c.get_channel_info(CrawlTarget(id="@handle", type="youtube"))
         assert data.channel_url == "https://www.youtube.com/@handle"
 

@@ -1,0 +1,385 @@
+"""Object-store layer (VERDICT r2 missing #3): S3-shaped client protocol,
+part-level retry+resume uploader, provider adapter, and the chunker →
+"remote" combined-files e2e the reference ran through its Dapr blob binding
+(`state/daprstate.go:29-35`, `chunk/main.go:84-150`).
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from distributed_crawler_tpu.state.interface import LocalConfig, StateConfig
+from distributed_crawler_tpu.state.local import LocalStateManager
+from distributed_crawler_tpu.state.objectstore import (
+    InMemoryObjectClient,
+    LocalFSObjectClient,
+    ObjectStorageProvider,
+    ObjectStoreUploader,
+    TransientStoreError,
+    make_object_client,
+)
+
+
+def _uploader(client, **kw):
+    kw.setdefault("part_size", 64)
+    kw.setdefault("backoff_s", 0.001)
+    return ObjectStoreUploader(client, **kw)
+
+
+class TestUploaderRetryResume:
+    def test_small_object_single_put(self):
+        client = InMemoryObjectClient()
+        _uploader(client).upload_bytes("k/small", b"x" * 10)
+        assert client.objects["k/small"] == b"x" * 10
+        assert [c[0] for c in client.calls] == ["put_object"]
+
+    def test_multipart_roundtrip(self):
+        client = InMemoryObjectClient()
+        data = bytes(range(256)) * 2  # 512 B -> 8 parts of 64
+        _uploader(client).upload_bytes("k/big", data)
+        assert client.objects["k/big"] == data
+        part_calls = [c for c in client.calls if c[0] == "upload_part"]
+        assert len(part_calls) == 8
+
+    def test_mid_file_failure_resumes_not_restarts(self):
+        """Two injected part failures: completed parts are never re-sent —
+        resume-from-part, not restart-from-byte-0."""
+        client = InMemoryObjectClient()
+        data = b"ab" * 256  # 8 parts
+        client.fail("upload_part", 2)  # first two attempts die
+        _uploader(client).upload_bytes("k/big", data)
+        assert client.objects["k/big"] == data
+        sent = [c[1] for c in client.calls if c[0] == "upload_part"]
+        # Part 0 attempted 3x (2 failures + success); every later part once.
+        assert sent.count("k/big#0") == 3
+        for n in range(1, 8):
+            assert sent.count(f"k/big#{n}") == 1
+
+    def test_permanent_failure_aborts_multipart(self):
+        client = InMemoryObjectClient()
+        client.fail("upload_part", 99)
+        with pytest.raises(TransientStoreError):
+            _uploader(client, max_retries=3).upload_bytes("k", b"z" * 512)
+        assert client._mp == {}  # aborted, no leaked upload state
+        assert "k" not in client.objects
+
+    def test_upload_file_streams_parts(self, tmp_path):
+        client = InMemoryObjectClient()
+        path = tmp_path / "combined.jsonl"
+        data = b"line\n" * 100
+        path.write_bytes(data)
+        n = _uploader(client).upload_file(str(path), "combined/c1/x.jsonl")
+        assert n == len(data)
+        assert client.objects["combined/c1/x.jsonl"] == data
+
+
+class TestLocalFSClient:
+    def test_multipart_concat_and_list(self, tmp_path):
+        client = LocalFSObjectClient(str(tmp_path / "store"))
+        data = os.urandom(300)
+        _uploader(client).upload_bytes("a/b/blob.bin", data)
+        assert client.get_object("a/b/blob.bin") == data
+        assert client.head_object("a/b/blob.bin") == 300
+        assert client.list_objects("a/") == ["a/b/blob.bin"]
+        # No leftover multipart staging dirs.
+        assert not [d for d in os.listdir(tmp_path / "store")
+                    if d.startswith(".mp-")]
+        client.delete_object("a/b/blob.bin")
+        assert client.get_object("a/b/blob.bin") is None
+
+    def test_key_escape_rejected(self, tmp_path):
+        client = LocalFSObjectClient(str(tmp_path / "store"))
+        with pytest.raises(ValueError, match="escapes"):
+            client.put_object("../outside", b"x")
+
+    def test_make_object_client_schemes(self, tmp_path):
+        assert isinstance(make_object_client("memory://"),
+                          InMemoryObjectClient)
+        c = make_object_client(f"file://{tmp_path}/s")
+        assert isinstance(c, LocalFSObjectClient)
+        with pytest.raises(ValueError, match="scheme 's3'"):
+            make_object_client("s3://bucket/prefix")
+
+
+class TestObjectStorageProvider:
+    def test_provider_surface(self):
+        p = ObjectStorageProvider(InMemoryObjectClient())
+        p.save_json("m/meta.json", {"a": 1})
+        assert p.load_json("m/meta.json") == {"a": 1}
+        p.put_text("m/t.txt", "hello\n")
+        assert p.get_text("m/t.txt") == "hello\n"
+        p.append_jsonl("m/rows.jsonl", '{"n": 1}')
+        p.append_jsonl("m/rows.jsonl", '{"n": 2}')
+        assert p.get_text("m/rows.jsonl") == '{"n": 1}\n{"n": 2}\n'
+        assert p.exists("m/t.txt") and not p.exists("m/nope")
+        assert p.list_dir("m") == ["meta.json", "rows.jsonl", "t.txt"]
+        p.delete("m/t.txt")
+        assert not p.exists("m/t.txt")
+
+    def test_tpu_worker_results_sink(self):
+        """The TPU worker's idempotent writeback lands in the object store
+        unchanged — the results-sink wiring of VERDICT r2 task 5."""
+        from distributed_crawler_tpu.bus.codec import RecordBatch
+        from distributed_crawler_tpu.bus.inmemory import InMemoryBus
+        from distributed_crawler_tpu.bus.messages import (
+            TOPIC_INFERENCE_BATCHES,
+        )
+        from distributed_crawler_tpu.datamodel import Post
+        from distributed_crawler_tpu.inference import (
+            TPUWorker,
+            TPUWorkerConfig,
+        )
+        from distributed_crawler_tpu.inference.engine import EngineConfig
+        from distributed_crawler_tpu.inference.worker import iter_results
+        from distributed_crawler_tpu.utils.metrics import MetricsRegistry
+
+        class Instant:
+            cfg = EngineConfig()
+
+            def run(self, texts):
+                return [{"label": 1, "score": 0.5} for _ in texts]
+
+        client = InMemoryObjectClient()
+        provider = ObjectStorageProvider(client)
+        bus = InMemoryBus()
+        worker = TPUWorker(bus, Instant(), provider=provider,
+                           cfg=TPUWorkerConfig(heartbeat_s=60.0),
+                           registry=MetricsRegistry())
+        bus.start()
+        worker.start()
+        batch = RecordBatch.from_posts(
+            [Post(post_uid="1", all_text="text")], crawl_id="c9")
+        bus.publish(TOPIC_INFERENCE_BATCHES, batch.to_dict())
+        assert worker.drain(10.0)
+        worker.stop()
+        bus.close()
+        rows = list(iter_results(provider, "c9"))
+        assert rows and rows[0]["label"] == 1
+
+
+class TestChunkerToObjectStore:
+    def test_combine_upload_e2e_with_transient_failures(self, tmp_path):
+        """Shards → chunker combine → object store upload (riding out an
+        injected transient failure) → sources and local combined deleted —
+        the crawl→chunker→remote e2e (`chunk/main.go:349-421`)."""
+        from distributed_crawler_tpu.chunk.chunker import Chunker
+
+        watch = str(tmp_path / "watch")
+        combine = str(tmp_path / "combine")
+        temp = str(tmp_path / "temp")
+        os.makedirs(watch)
+
+        shards = []
+        for i in range(3):
+            path = os.path.join(watch, f"shard{i}.jsonl")
+            with open(path, "w") as f:
+                for j in range(5):
+                    f.write(json.dumps({"shard": i, "row": j}) + "\n")
+            shards.append(path)
+        expected = b"".join(open(p, "rb").read() for p in shards)
+
+        sm = LocalStateManager(StateConfig(
+            storage_root=str(tmp_path / "root"),
+            crawl_id="crawl-e2e",
+            local=LocalConfig(base_path=str(tmp_path / "root")),
+            object_store_url="memory://"))
+        # Swap the lazily-built uploader for one with injected faults.
+        client = InMemoryObjectClient()
+        # Shards are ~90 B each, part_size is 64 B → multipart path; the
+        # first part attempt dies and the uploader rides it out.
+        client.fail("upload_part", 1)
+        sm._object_uploader = _uploader(client)
+
+        chunker = Chunker(sm, temp, watch, combine,
+                          trigger_size=1,  # flush immediately
+                          scan_interval_s=0.05)
+        chunker.start()
+        try:
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and not any(
+                    k.startswith("combined/crawl-e2e/")
+                    for k in client.objects):
+                time.sleep(0.05)
+        finally:
+            chunker.shutdown()
+        keys = [k for k in client.objects
+                if k.startswith("combined/crawl-e2e/")]
+        assert keys, "combined file never reached the object store"
+        got = b"".join(client.objects[k] for k in sorted(keys))
+        assert got == expected
+        assert os.listdir(watch) == []            # sources deleted
+        assert not [n for n in os.listdir(combine)
+                    if n.endswith(".jsonl")]      # local combined cleaned
+
+
+class TestYoutubeChannelId:
+    def test_extraction_shapes(self):
+        from distributed_crawler_tpu.crawlers.youtube import (
+            youtube_channel_id,
+        )
+        assert youtube_channel_id(
+            "https://youtube.com/channel/UCAbC123") == "UCAbC123"
+        assert youtube_channel_id(
+            "https://www.youtube.com/channel/UCAbC123/") == "UCAbC123"
+        assert youtube_channel_id("https://youtube.com/@Handle") == "@Handle"
+        assert youtube_channel_id("youtube.com/user/Legacy") == "user/Legacy"
+        assert youtube_channel_id("UCAbC123") == "UCAbC123"  # case kept
+        assert youtube_channel_id("@handle") == "@handle"
+
+
+class TestLaunchToObjectStore:
+    def test_launch_ships_posts_to_remote_store(self, tmp_path):
+        """Full launch-mode crawl (fake YT transport) → posts → shipped to
+        chunker → combined → object store: the deployment loop the
+        reference ran through crawler pods + chunk service + blob binding."""
+        import json as _json
+
+        from distributed_crawler_tpu.clients.youtube import (
+            FakeYouTubeTransport,
+        )
+        from distributed_crawler_tpu.config.crawler import CrawlerConfig
+        from distributed_crawler_tpu.modes.runner import launch
+
+        t = FakeYouTubeTransport()
+        t.add_channel("UCchanA", title="Chan A", video_count=2)
+        for i in range(2):
+            t.add_video(f"va{i}", "UCchanA", title=f"video {i}",
+                        description="text " * 5)
+
+        cfg = CrawlerConfig()
+        cfg.platform = "youtube"
+        cfg.sampling_method = "channel"
+        cfg.youtube_api_key = "fake"
+        cfg.storage_root = str(tmp_path / "store")
+        cfg.crawl_id = "lch1"
+        cfg.combine_files = True
+        cfg.combine_watch_dir = str(tmp_path / "watch")
+        cfg.combine_temp_dir = str(tmp_path / "temp")
+        cfg.combine_write_dir = str(tmp_path / "cw")
+        cfg.object_store_url = f"file://{tmp_path}/objstore"
+        launch(["https://youtube.com/channel/UCchanA"], cfg, yt_transport=t)
+
+        objstore = tmp_path / "objstore"
+        found = [os.path.join(r, f) for r, _, fs in os.walk(objstore)
+                 for f in fs]
+        assert found, "no combined object reached the remote store"
+        rows = [_json.loads(line) for path in found
+                for line in open(path).read().strip().splitlines()]
+        assert sorted(r["post_uid"] for r in rows) == ["va0", "va1"]
+
+
+class TestReviewFixes:
+    def test_processed_map_claim_atomic(self):
+        from distributed_crawler_tpu.chunk.chunker import ProcessedMap
+
+        pm = ProcessedMap()
+        assert pm.claim("/a") is True
+        assert pm.claim("/a") is False
+        pm.rotate()
+        assert pm.claim("/a") is False  # previous generation still consulted
+
+    def test_scan_now_concurrent_no_double_enqueue(self, tmp_path):
+        """scan_now racing the watcher thread never enqueues a shard twice."""
+        import threading
+
+        from distributed_crawler_tpu.chunk.chunker import Chunker
+
+        watch = str(tmp_path / "w")
+        os.makedirs(watch)
+        for i in range(50):
+            with open(os.path.join(watch, f"s{i}.jsonl"), "w") as f:
+                f.write("{}\n")
+
+        class NullSM:
+            def upload_combined_file(self, path):
+                pass
+
+        chunker = Chunker(NullSM(), str(tmp_path / "t"), watch,
+                          str(tmp_path / "c"), scan_interval_s=999)
+        os.makedirs(chunker.combine_dir, exist_ok=True)
+        # Race two direct scans (the watcher thread isn't running).
+        threads = [threading.Thread(target=chunker.scan_now)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert chunker._file_q.qsize() == 50  # each shard exactly once
+
+    def test_handle_and_username_resolution(self):
+        from distributed_crawler_tpu.clients.youtube import (
+            FakeYouTubeTransport,
+            YouTubeDataClient,
+        )
+
+        t = FakeYouTubeTransport()
+        t.add_channel("UCx1", title="X", video_count=1, handle="@xh",
+                      username="legacyx")
+        t.add_video("vx", "UCx1", title="v")
+        c = YouTubeDataClient("k", t)
+        c.connect()
+        assert c.get_channel_info("@xh").id == "UCx1"
+        assert c.get_channel_info("user/legacyx").id == "UCx1"
+        assert [v.id for v in
+                c.get_videos_from_channel("@xh", None, None, -1)] == ["vx"]
+
+    def test_channel_id_trailing_segment_and_custom_url(self):
+        import pytest as _pytest
+
+        from distributed_crawler_tpu.crawlers.youtube import (
+            youtube_channel_id,
+        )
+        assert youtube_channel_id(
+            "https://youtube.com/channel/UCabc/videos") == "UCabc"
+        assert youtube_channel_id(
+            "https://youtube.com/@Handle/streams") == "@Handle"
+        assert youtube_channel_id(
+            "youtube.com/user/Legacy") == "user/Legacy"
+        with _pytest.raises(ValueError, match="custom URL"):
+            youtube_channel_id("https://youtube.com/c/SomeBrand")
+
+    def test_negative_labels_rejected(self):
+        import pytest as _pytest
+
+        from distributed_crawler_tpu.inference.engine import (
+            EngineConfig,
+            InferenceEngine,
+        )
+        from distributed_crawler_tpu.models.train import finetune_head
+        from distributed_crawler_tpu.utils.metrics import MetricsRegistry
+
+        eng = InferenceEngine(
+            EngineConfig(model="tiny", n_labels=2, batch_size=4,
+                         buckets=(16,)), registry=MetricsRegistry())
+        toks = eng.tokenizer.encode_batch(["a", "b"])
+        with _pytest.raises(ValueError, match="negative label"):
+            finetune_head(eng.ecfg, eng.params, toks, [0, -1])
+
+    def test_int_retrain_clears_stale_vocab(self, tmp_path, capsys):
+        import json as _json
+
+        from distributed_crawler_tpu.cli import main
+
+        posts = tmp_path / "posts.jsonl"
+        str_labels = tmp_path / "sl.jsonl"
+        int_labels = tmp_path / "il.jsonl"
+        with open(posts, "w") as f, open(str_labels, "w") as g, \
+                open(int_labels, "w") as h:
+            for i in range(8):
+                f.write(_json.dumps({"post_uid": f"p{i}",
+                                     "all_text": "word " * 4}) + "\n")
+                g.write(_json.dumps({"post_uid": f"p{i}",
+                                     "label": ["a", "b"][i % 2]}) + "\n")
+                h.write(_json.dumps({"post_uid": f"p{i}",
+                                     "label": i % 2}) + "\n")
+        ckpt = tmp_path / "ckpt"
+        base = ["--mode", "train-head", "--infer-model", "tiny",
+                "--train-posts", str(posts), "--head-checkpoint", str(ckpt),
+                "--train-epochs", "2",
+                "--storage-root", str(tmp_path / "store")]
+        assert main(base + ["--train-labels", str(str_labels)]) == 0
+        assert (ckpt / "labels.json").exists()
+        assert main(base + ["--train-labels", str(int_labels)]) == 0
+        assert not (ckpt / "labels.json").exists()  # stale vocab removed
