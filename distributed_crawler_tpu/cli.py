@@ -86,6 +86,8 @@ def build_parser() -> argparse.ArgumentParser:
     a("--validator-request-jitter-ms", type=int, default=None)
     a("--validator-claim-batch-size", type=int, default=None)
     a("--validator-timeout", default=None, help="e.g. 30m")
+    a("--validator-transport", default=None,
+      help="t.me transport: urllib | chrome (native Chrome-shaped TLS)")
     # Combine files (chunker)
     a("--combine-files", action="store_const", const=True, default=None)
     a("--combine-watch-dir", default=None)
@@ -93,8 +95,9 @@ def build_parser() -> argparse.ArgumentParser:
     a("--combine-write-dir", default=None)
     a("--combine-trigger-size", type=int, default=None, help="MiB")
     a("--object-store", default=None,
-      help="remote blob target for combined files "
-           "(memory:// | file:///path; empty = keep local)")
+      help="remote blob target for combined files (memory:// | "
+           "file:///path; empty = combined files land under "
+           "<storage-root>/combined/)")
     a("--combine-hard-cap", type=int, default=None, help="MiB")
     # Inputs
     a("--urls", default=None, help="comma-separated URLs to crawl")
@@ -174,6 +177,7 @@ _KEY_MAP = {
     "validator_request_jitter_ms": "crawler.validator_request_jitter_ms",
     "validator_claim_batch_size": "crawler.validator_claim_batch_size",
     "validator_timeout": "crawler.validator_timeout",
+    "validator_transport": "crawler.validator_transport",
     "combine_files": "crawler.combine_files",
     "combine_watch_dir": "crawler.combine_watch_dir",
     "combine_temp_dir": "crawler.combine_temp_dir",
@@ -240,6 +244,8 @@ def resolve_config(args: argparse.Namespace,
         "crawler.validator_request_jitter_ms", 200)
     cfg.validator_claim_batch_size = r.get_int(
         "crawler.validator_claim_batch_size", 10)
+    cfg.validator_transport = r.get_str(
+        "crawler.validator_transport", "urllib")
     cfg.combine_files = r.get_bool("crawler.combine_files", False)
     cfg.combine_watch_dir = r.get_str("crawler.combine_watch_dir",
                                       "/tmp/watch-files")

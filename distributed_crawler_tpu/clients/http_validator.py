@@ -7,11 +7,13 @@ Parity with `telegramhelper/channelvalidator.go` + `validator_rate_limiter.go`:
 - token-bucket + jitter request limiter (`validator_rate_limiter.go:23-55`)
 
 Transport note: the reference used uTLS to present a Chrome JA3 fingerprint
-(`utlstransport.go`).  Python's ssl stack can't reshape its ClientHello; the
-fingerprint-matched transport belongs to the C++ native layer (`native/`).
-The `transport` parameter here accepts any callable
-``(url, headers) -> (status_code, body_bytes)`` so production can route
-through the native transport and tests use fixtures.
+(`utlstransport.go:19-57`).  Python's ssl stack can't reshape its
+ClientHello, so the fingerprint-matched transport lives in the C++ native
+layer (`native/net.h`: Chrome cipher ordering, X25519-first groups, SNI) —
+select it with ``make_transport("chrome")`` / config
+``validator_transport: chrome``.  The ``transport`` parameter accepts any
+callable ``(url, headers) -> (status_code, body_bytes)``, so tests use
+fixtures and other stacks can slot in.
 """
 
 from __future__ import annotations
@@ -68,6 +70,51 @@ def urllib_transport(url: str, headers: dict) -> Tuple[int, bytes]:
             return resp.status, resp.read(MAX_READ_BYTES)
     except urllib.error.HTTPError as e:
         return e.code, e.read(MAX_READ_BYTES) if e.fp else b""
+
+
+def chrome_transport(url: str, headers: dict, *,
+                     tls_insecure: bool = False,
+                     port: int = 0,
+                     max_redirects: int = 5) -> Tuple[int, bytes]:
+    """Fingerprint-matched transport: the native Chrome-shaped TLS stack
+    (`native/net.h`), so t.me sees browser-like ciphers/SNI instead of a
+    Python stack — the property the reference's uTLS leg existed for.
+    Follows up to ``max_redirects`` 3xx hops, matching urllib_transport's
+    behavior so the selectable transports classify identically."""
+    from urllib.parse import urljoin, urlsplit
+
+    from .native import native_https_get
+
+    for _ in range(max_redirects + 1):
+        parts = urlsplit(url)
+        host = parts.hostname or ""
+        use_port = port or parts.port or \
+            (80 if parts.scheme == "http" else 443)
+        path = parts.path or "/"
+        if parts.query:
+            path += "?" + parts.query
+        out = native_https_get(
+            host, path=path, port=use_port, headers=headers, sni=host,
+            tls_insecure=tls_insecure, plain=(parts.scheme == "http"),
+            max_body=MAX_READ_BYTES)
+        if out["status"] in (301, 302, 303, 307, 308) and \
+                out.get("location"):
+            url = urljoin(url, out["location"])
+            continue
+        return out["status"], out["body"]
+    raise ValidationHTTPError(TRANSIENT,
+                              f"redirect loop after {max_redirects} hops")
+
+
+def make_transport(kind: str = "urllib", **kw) -> Transport:
+    """Selectable validator transport: ``urllib`` (stdlib, default) or
+    ``chrome`` (native Chrome-shaped TLS)."""
+    if kind in ("", "urllib"):
+        return urllib_transport
+    if kind == "chrome":
+        return lambda url, headers: chrome_transport(url, headers, **kw)
+    raise ValueError(f"unknown validator transport {kind!r}; "
+                     f"expected 'urllib' or 'chrome'")
 
 
 def _extract_title(html: str) -> str:

@@ -20,6 +20,7 @@ engine run unchanged over the native core.
 
 from __future__ import annotations
 
+import collections
 import ctypes
 import itertools
 import json
@@ -94,8 +95,43 @@ def load_library(path: Optional[str] = None) -> ctypes.CDLL:
         lib.dct_client_execute.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.dct_client_destroy.restype = None
         lib.dct_client_destroy.argtypes = [ctypes.c_void_p]
+        lib.dct_https_get.restype = ctypes.c_char_p
+        lib.dct_https_get.argtypes = [ctypes.c_char_p]
         _lib_cache[resolved] = lib
         return lib
+
+
+def native_https_get(host: str, path: str = "/", port: int = 443,
+                     headers: Optional[Dict[str, str]] = None,
+                     sni: str = "", tls_insecure: bool = False,
+                     plain: bool = False, max_body: int = 1 << 20,
+                     lib_path: Optional[str] = None) -> Dict[str, Any]:
+    """One GET through the native Chrome-shaped TLS stack
+    (`native/net.h`; fingerprint parity target `utlstransport.go:19-57`).
+    Returns {"status": int, "body": bytes[, "alpn": str]}; raises
+    NativeClientError on transport failure."""
+    import base64
+
+    lib = load_library(lib_path)
+    cfg = {"host": host, "port": port, "path": path, "max_body": max_body}
+    if headers:
+        cfg["headers"] = dict(headers)
+    if sni:
+        cfg["sni"] = sni
+    if tls_insecure:
+        cfg["tls_insecure"] = True
+    if plain:
+        cfg["plain"] = True
+    raw = lib.dct_https_get(json.dumps(cfg).encode("utf-8"))
+    out = json.loads(raw.decode("utf-8"))
+    if "error" in out:
+        raise NativeClientError(500, out["error"])
+    result = {"status": int(out["status"]),
+              "body": base64.b64decode(out.get("body_b64", "")),
+              "alpn": out.get("alpn", "")}
+    if "location" in out:
+        result["location"] = out["location"]
+    return result
 
 
 class NativeClientError(TelegramError):
@@ -122,36 +158,66 @@ class NativeTelegramClient:
     def __init__(self, seed_db: str = "", seed_json: str = "",
                  lib_path: Optional[str] = None,
                  receive_timeout_s: float = 10.0, conn_id: str = "native0",
-                 require_auth: bool = False, expected_code: str = ""):
+                 require_auth: bool = False, expected_code: str = "",
+                 expected_password: str = "", server_addr: str = "",
+                 tls: bool = False, tls_insecure: bool = False,
+                 sni: str = ""):
+        """Offline mode (default): the C++ engine serves from a seed store.
+
+        Remote mode (``server_addr="host:port"``): every request rides the
+        wire protocol over a real socket — plain TCP or, with ``tls=True``,
+        a TLS stream whose ClientHello is Chrome-shaped (`native/net.h`).
+        The server then owns the store and the auth ladder
+        (``authenticate()`` drives it, as the reference's CLI interactor
+        drove TDLib's, `telegramhelper/client.go:319-377`)."""
         self._lib = load_library(lib_path)
         self.conn_id = conn_id
         self.receive_timeout_s = receive_timeout_s
         config: Dict[str, Any] = {}
-        if seed_json:
+        if server_addr:
+            config["server_addr"] = server_addr
+            if tls:
+                config["tls"] = True
+            if tls_insecure:
+                config["tls_insecure"] = True
+            if sni:
+                config["sni"] = sni
+        elif seed_json:
             config["seed_json"] = seed_json
         elif seed_db:
             config["seed_db"] = seed_db
-        if require_auth:
+        if require_auth and not server_addr:
             config["require_auth"] = True
             if expected_code:
                 config["expected_code"] = expected_code
+            if expected_password:
+                config["expected_password"] = expected_password
         self._handle = self._lib.dct_client_create(
             json.dumps(config).encode("utf-8"))
         if not self._handle:
-            raise NativeClientError(500, "failed to create native client")
+            raise NativeClientError(
+                500, "failed to create native client" +
+                (f" (connect {server_addr} refused?)" if server_addr
+                 else ""))
         self._extra = itertools.count(1)
         self._mu = threading.Lock()
         self._pending: Dict[str, Dict[str, Any]] = {}
+        # Bounded: extra-less frames (auth state, events); a multi-day
+        # remote client must not accumulate these without limit.
+        self.updates: "collections.deque" = collections.deque(maxlen=256)
+        self._transport_error: Optional[Dict[str, Any]] = None
         self._closed = False
-        if not require_auth:
+        if not require_auth and not server_addr:
             self.wait_ready()
 
     # -- auth (the TDLib ladder, `telegramhelper/client.go:319-377`) -------
     def authenticate(self, phone_number: str, phone_code: str,
                      api_id: str = "", api_hash: str = "",
+                     password: str = "",
                      database_directory: str = ".tdlib/database") -> None:
-        """Walk WaitTdlibParameters -> WaitPhoneNumber -> WaitCode -> Ready
-        (the flow the reference's CLI interactor drives)."""
+        """Walk WaitTdlibParameters -> WaitPhoneNumber -> WaitCode
+        [-> WaitPassword] -> Ready (the flow the reference's CLI interactor
+        drives; password is the 2FA leg of `standalone/runner.go:77-192`)."""
         self._call({"@type": "setTdlibParameters",
                     "api_id": api_id, "api_hash": api_hash,
                     "database_directory": database_directory})
@@ -159,17 +225,28 @@ class NativeTelegramClient:
                     "phone_number": phone_number})
         self._call({"@type": "checkAuthenticationCode",
                     "code": phone_code})
+        if password:
+            self._call({"@type": "checkAuthenticationPassword",
+                        "password": password})
 
     # -- plumbing ----------------------------------------------------------
+    @staticmethod
+    def _is_ready_update(resp: Dict[str, Any]) -> bool:
+        return resp.get("@type") == "updateAuthorizationState" and \
+            resp.get("authorization_state", {}).get("@type") == \
+            "authorizationStateReady"
+
     def wait_ready(self, timeout_s: float = 10.0) -> None:
         """Drain updates until authorizationStateReady (the TDLib auth
         terminal state the reference waits for,
-        `telegramhelper/client.go:319-377`)."""
+        `telegramhelper/client.go:319-377`).  Updates already swallowed by
+        an in-flight `_call` are checked first."""
+        if any(self._is_ready_update(u) for u in self.updates):
+            return
         resp = self._receive(timeout_s)
         while resp is not None:
-            if resp.get("@type") == "updateAuthorizationState" and \
-                    resp.get("authorization_state", {}).get("@type") == \
-                    "authorizationStateReady":
+            if self._is_ready_update(resp):
+                self.updates.append(resp)
                 return
             resp = self._receive(timeout_s)
         raise NativeClientError(500, "native client never became ready")
@@ -190,6 +267,8 @@ class NativeTelegramClient:
         with self._mu:
             if self._closed:
                 raise NativeClientError(500, "client is closed")
+            if self._transport_error is not None:
+                _raise_for_error(self._transport_error)
             self._lib.dct_client_send(self._handle,
                                       json.dumps(request).encode("utf-8"))
             deadline_attempts = max(1, int(self.receive_timeout_s / 0.5))
@@ -203,6 +282,14 @@ class NativeTelegramClient:
                         key = got.get("@extra")
                         if key is not None:
                             self._pending[key] = got
+                        elif got.get("@type") == "error" and \
+                                got.get("transport"):
+                            # Connection-level failure: fail THIS call now
+                            # and every later one immediately.
+                            self._transport_error = got
+                            _raise_for_error(got)
+                        else:
+                            self.updates.append(got)  # auth-state etc.
                         continue  # an update or an older response
                     resp = got
                 _raise_for_error(resp)
@@ -280,6 +367,13 @@ class NativeTelegramClient:
             description=r.get("description", ""),
             member_count=int(r.get("member_count", 0)),
             photo_remote_id=r.get("photo_remote_id", ""))
+
+    def execute_raw(self, request_json: str) -> str:
+        """Synchronous local execute on the C++ engine (offline mode only);
+        used by the mock DC server to proxy wire requests."""
+        raw = self._lib.dct_client_execute(
+            self._handle, request_json.encode("utf-8"))
+        return raw.decode("utf-8") if raw else "{}"
 
     def close(self) -> None:
         with self._mu:
@@ -404,14 +498,102 @@ def generate_pcode(tdlib_dir: str = ".tdlib",
     return creds_path
 
 
+def fnv32(s: str) -> int:
+    """FNV-1a 32-bit — the hash the reference used to derive unique
+    per-connection database dirs (`telegramhelper/client.go:252`)."""
+    h = 0x811C9DC5
+    for b in s.encode("utf-8"):
+        h = ((h ^ b) * 0x01000193) & 0xFFFFFFFF
+    return h
+
+
+def acquire_seed_db(source: str, base_dir: str, conn_id: str) -> str:
+    """Materialize a pre-seeded client DB for one connection, parity with
+    the reference's tarball download/extract flow
+    (`telegramhelper/client.go:232-260,433-533`):
+
+    - ``source``: a ``file://`` URL or local path to a ``.tar.gz``/
+      ``.tgz``/``.tar`` archive, a directory, or a bare seed ``.json``;
+    - extracts/copies into ``{base_dir}/conn_{fnv32(conn_id):08x}/`` so
+      concurrent connections never share a database directory;
+    - returns the path to the seed JSON inside (``seed.json`` preferred,
+      else the single ``*.json``); idempotent per connection dir.
+
+    HTTP(S) sources belong to the deployment layer (no egress here); a
+    non-file scheme raises with that guidance."""
+    import shutil
+    import tarfile
+    from urllib.parse import urlsplit
+
+    if "://" in source:
+        parts = urlsplit(source)
+        if parts.scheme != "file":
+            raise NativeClientError(
+                400, f"unsupported seed-db scheme {parts.scheme!r}: "
+                     f"mirror the tarball locally and pass a file:// URL")
+        source = parts.path
+    if not os.path.exists(source):
+        raise NativeClientError(400, f"seed db source not found: {source}")
+
+    conn_dir = os.path.join(base_dir, f"conn_{fnv32(conn_id):08x}")
+
+    def _find_seed(root: str) -> str:
+        preferred = None
+        candidates = []
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for name in filenames:
+                if name == "seed.json":
+                    preferred = os.path.join(dirpath, name)
+                elif name.endswith(".json"):
+                    candidates.append(os.path.join(dirpath, name))
+        if preferred:
+            return preferred
+        if len(candidates) == 1:
+            return candidates[0]
+        raise NativeClientError(
+            400, f"no unambiguous seed JSON under {root}: "
+                 f"{len(candidates)} candidates")
+
+    if os.path.isdir(conn_dir):
+        return _find_seed(conn_dir)  # already acquired for this conn
+
+    staging = conn_dir + ".tmp"
+    shutil.rmtree(staging, ignore_errors=True)
+    os.makedirs(staging, exist_ok=True)
+    try:
+        if os.path.isdir(source):
+            shutil.copytree(source, os.path.join(staging, "db"),
+                            dirs_exist_ok=True)
+        elif source.endswith((".tar.gz", ".tgz", ".tar")):
+            with tarfile.open(source, "r:*") as tar:
+                tar.extractall(staging, filter="data")
+        elif source.endswith(".json"):
+            shutil.copyfile(source, os.path.join(staging, "seed.json"))
+        else:
+            raise NativeClientError(
+                400, f"unrecognized seed db format: {source}")
+        os.replace(staging, conn_dir)  # atomic publish of the conn dir
+    except Exception:
+        shutil.rmtree(staging, ignore_errors=True)
+        raise
+    return _find_seed(conn_dir)
+
+
 def native_client_factory(seed_db: str = "", seed_json: str = "",
-                          lib_path: Optional[str] = None):
+                          lib_path: Optional[str] = None,
+                          db_source: str = "",
+                          db_base_dir: str = ".tdlib/databases"):
     """Pool-compatible factory: returns a callable producing fresh
     authenticated clients (`telegramhelper/connection_pool.go:97-149`
-    preloaded each conn from a DB URL; here each client loads the seed DB)."""
+    preloaded each conn from a DB URL).  With ``db_source`` set, each
+    connection acquires its own extracted copy of the seed tarball under
+    ``{db_base_dir}/conn_<fnv32>`` (`telegramhelper/client.go:232-260`)."""
     def make(conn_id: str) -> NativeTelegramClient:
+        per_conn_db = seed_db
+        if db_source:
+            per_conn_db = acquire_seed_db(db_source, db_base_dir, conn_id)
         return NativeTelegramClient(
-            seed_db=seed_db, seed_json=seed_json, lib_path=lib_path,
+            seed_db=per_conn_db, seed_json=seed_json, lib_path=lib_path,
             conn_id=conn_id)
 
     return make

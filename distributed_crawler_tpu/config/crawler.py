@@ -112,7 +112,8 @@ class CrawlerConfig:
     combine_trigger_size: int = 170 * 1024 * 1024  # 170 MiB, main.go:800
     combine_hard_cap: int = 200 * 1024 * 1024  # 200 MiB, main.go:801
     # Remote blob target for combined files ("memory://" | "file:///path");
-    # empty = keep combined files local (no output binding configured).
+    # empty = combined files are moved to {storage_root}/combined/ (the
+    # localstorage-binding analog).
     object_store_url: str = ""
 
     # Null handling
@@ -127,6 +128,9 @@ class CrawlerConfig:
     tandem_crawl: bool = False
     validate_only: bool = False
     validator_request_rate: float = 6.0  # HTTP calls/min (crawl/validator.go:58)
+    # t.me transport: "urllib" (stdlib) or "chrome" (native Chrome-shaped
+    # TLS via native/net.h — the uTLS analog, utlstransport.go:19-57).
+    validator_transport: str = "urllib"
     validator_request_jitter_ms: int = 200
     validator_claim_batch_size: int = 10
     validator_timeout_s: float = 0.0  # 0 = disabled

@@ -299,8 +299,18 @@ class RunValidationLoop:
         self.sm = sm
         self.cfg = cfg
         self.vcfg = vcfg or ValidatorConfig()
-        self.validate_fn = validate_fn or (
-            lambda username: validate_channel_http(username))
+        if validate_fn is not None:
+            self.validate_fn = validate_fn
+        else:
+            # Transport selectable via config: "urllib" (default) or
+            # "chrome" (native fingerprint-matched TLS, the uTLS analog).
+            from ..clients.http_validator import make_transport
+
+            transport = make_transport(
+                getattr(cfg, "validator_transport", "") or "urllib")
+            self.validate_fn = (
+                lambda username: validate_channel_http(
+                    username, transport=transport))
         self.rate_limiter = rate_limiter or ValidatorRateLimiter(
             cfg.validator_request_rate or 6.0,
             cfg.validator_request_jitter_ms or 200)

@@ -329,11 +329,23 @@ class BaseStateManager(StateManager):
     def upload_combined_file(self, filename: str) -> None:
         """Ship a chunker-combined file to the object store under
         ``combined/<crawl>/<basename>`` (`chunk/main.go:349-421` uploaded
-        through the Dapr blob binding the same way)."""
-        uploader = self.object_uploader()
-        if uploader is None:
-            return  # no remote target configured: keep the local file
+        through the Dapr blob binding the same way).
+
+        Without a remote store configured, the file is MOVED into
+        ``{storage_root}/combined/<crawl>/`` — the localstorage-binding
+        analog (`resources/local-storage.yaml`) — because the chunker
+        deletes its working copy after a successful upload; a plain no-op
+        here would silently destroy every combined file."""
         crawl = (self.config.crawl_execution_id or self.config.crawl_id
                  or "adhoc")
-        key = f"combined/{crawl}/{os.path.basename(filename)}"
-        uploader.upload_file(filename, key)
+        uploader = self.object_uploader()
+        if uploader is not None:
+            key = f"combined/{crawl}/{os.path.basename(filename)}"
+            uploader.upload_file(filename, key)
+            return
+        dest_dir = os.path.join(self.config.storage_root or ".",
+                                "combined", crawl)
+        os.makedirs(dest_dir, exist_ok=True)
+        dest = os.path.join(dest_dir, os.path.basename(filename))
+        if os.path.abspath(dest) != os.path.abspath(filename):
+            os.replace(filename, dest)

@@ -162,8 +162,11 @@ class LocalFSObjectClient:
         self._counter = 0
 
     def _path(self, key: str) -> str:
-        path = os.path.normpath(os.path.join(self.root, key))
-        if not path.startswith(os.path.normpath(self.root)):
+        root = os.path.normpath(self.root)
+        path = os.path.normpath(os.path.join(root, key))
+        # commonpath, not startswith: '../store-evil' shares the string
+        # prefix of root but is a sibling directory.
+        if path != root and os.path.commonpath([root, path]) != root:
             raise ValueError(f"key escapes store root: {key}")
         return path
 
@@ -280,19 +283,15 @@ class ObjectStoreUploader:
         assert last is not None
         raise last
 
-    def upload_bytes(self, key: str, data: bytes) -> None:
-        if len(data) <= self.part_size:
-            self._with_retry(f"put {key}",
-                             lambda: self.client.put_object(key, data))
-            return
+    def _upload_multipart(self, key: str, chunks) -> None:
+        """One multipart state machine for both byte- and file-sourced
+        uploads: per-part retry, complete, abort-on-failure."""
         upload_id = self._with_retry(
             f"create-multipart {key}",
             lambda: self.client.create_multipart(key))
         try:
             etags: List[str] = []
-            for part_no, start in enumerate(
-                    range(0, len(data), self.part_size)):
-                chunk = data[start:start + self.part_size]
+            for part_no, chunk in enumerate(chunks):
                 etags.append(self._with_retry(
                     f"part {part_no} of {key}",
                     lambda c=chunk, n=part_no:
@@ -307,40 +306,33 @@ class ObjectStoreUploader:
                 pass
             raise
 
+    def upload_bytes(self, key: str, data: bytes) -> None:
+        if len(data) <= self.part_size:
+            self._with_retry(f"put {key}",
+                             lambda: self.client.put_object(key, data))
+            return
+        self._upload_multipart(
+            key, (data[start:start + self.part_size]
+                  for start in range(0, len(data), self.part_size)))
+
     def upload_file(self, path: str, key: str) -> int:
         """Upload ``path`` to ``key``; returns bytes uploaded."""
         size = os.path.getsize(path)
         if size <= self.part_size:
             with open(path, "rb") as f:
-                data = f.read()
-            self.upload_bytes(key, data)
+                self.upload_bytes(key, f.read())
             return size
-        upload_id = self._with_retry(
-            f"create-multipart {key}",
-            lambda: self.client.create_multipart(key))
-        try:
-            etags: List[str] = []
+
+        def file_chunks():
             with open(path, "rb") as f:
-                part_no = 0
                 while True:
                     chunk = f.read(self.part_size)
                     if not chunk:
-                        break
-                    etags.append(self._with_retry(
-                        f"part {part_no} of {key}",
-                        lambda c=chunk, n=part_no:
-                        self.client.upload_part(key, upload_id, n, c)))
-                    part_no += 1
-            self._with_retry(
-                f"complete {key}",
-                lambda: self.client.complete_multipart(key, upload_id, etags))
-            return size
-        except Exception:
-            try:
-                self.client.abort_multipart(key, upload_id)
-            except Exception:  # noqa: BLE001 — best-effort cleanup
-                pass
-            raise
+                        return
+                    yield chunk
+
+        self._upload_multipart(key, file_chunks())
+        return size
 
 
 class ObjectStorageProvider:

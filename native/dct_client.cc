@@ -44,6 +44,7 @@
 #include <vector>
 
 #include "json.h"
+#include "net.h"
 
 using dctjson::Array;
 using dctjson::Object;
@@ -213,6 +214,15 @@ Value make_error(int64_t code, const std::string& message) {
   return Value(std::move(o));
 }
 
+// Connection-level failure: no @extra can be attached (the error isn't a
+// reply to one request), so it carries a marker the binding uses to fail
+// ALL in-flight and future calls fast instead of timing out.
+Value make_transport_error(const std::string& message) {
+  Value v = make_error(500, message);
+  v.obj()["transport"] = Value(true);
+  return v;
+}
+
 Value message_to_json(const StoredMessage& m) {
   Object o;
   o["@type"] = Value("message");
@@ -276,6 +286,14 @@ class Client {
   explicit Client(const std::string& config_json) {
     Value cfg = dctjson::parse(
         config_json.empty() ? std::string("{}") : config_json);
+    const std::string server_addr = cfg.get("server_addr").as_string();
+    if (!server_addr.empty()) {
+      // Remote mode: all requests ride the wire protocol to a DC server
+      // (the MTProto-transport seam made real; the server owns the store
+      // and the auth ladder).
+      connect_remote(server_addr, cfg);
+      return;
+    }
     const std::string seed_path = cfg.get("seed_db").as_string();
     const std::string seed_inline = cfg.get("seed_json").as_string();
     if (!seed_inline.empty()) {
@@ -285,12 +303,14 @@ class Client {
     }
     require_auth_ = cfg.get("require_auth").as_bool(false);
     expected_code_ = cfg.get("expected_code").as_string();
+    expected_password_ = cfg.get("expected_password").as_string();
     running_ = true;
     worker_ = std::thread([this] { run(); });
     if (require_auth_) {
       // Full TDLib-style auth ladder: WaitTdlibParameters ->
-      // WaitPhoneNumber -> WaitCode -> Ready (telegramhelper/client.go's
-      // CLI interactor walks exactly these states).
+      // WaitPhoneNumber -> WaitCode [-> WaitPassword] -> Ready
+      // (telegramhelper/client.go's CLI interactor walks exactly these
+      // states; password = the 2FA leg of standalone/runner.go:77-192).
       auth_state_ = AuthState::WaitTdlibParameters;
       push_auth_update("authorizationStateWaitTdlibParameters");
     } else {
@@ -305,10 +325,22 @@ class Client {
       running_ = false;
       cv_requests_.notify_all();
     }
+    reader_stop_.store(true);
+    if (conn_) conn_->shutdown();
+    if (reader_.joinable()) reader_.join();
     if (worker_.joinable()) worker_.join();
   }
 
   void send(const std::string& request_json) {
+    if (conn_) {
+      try {
+        conn_->send_frame(request_json);
+      } catch (const std::exception& e) {
+        push_response(make_transport_error(
+            std::string("transport: ") + e.what()));
+      }
+      return;
+    }
     std::lock_guard<std::mutex> lock(mu_);
     requests_.push_back(request_json);
     cv_requests_.notify_one();
@@ -334,6 +366,9 @@ class Client {
     } catch (const std::exception& e) {
       return dctjson::dump(make_error(400, e.what()));
     }
+    if (conn_)
+      return dctjson::dump(make_error(
+          400, "execute is local-only; remote clients must use send"));
     Value resp = route(req);
     attach_extra(resp, req);
     return dctjson::dump(resp);
@@ -341,7 +376,7 @@ class Client {
 
  private:
   enum class AuthState { WaitTdlibParameters, WaitPhoneNumber, WaitCode,
-                         Ready };
+                         WaitPassword, Ready };
 
   Store store_;
   std::mutex mu_;
@@ -353,8 +388,54 @@ class Client {
   bool require_auth_ = false;
   AuthState auth_state_ = AuthState::Ready;
   std::string expected_code_;
+  std::string expected_password_;
   std::string phone_number_;
   std::thread worker_;
+  // Remote mode: wire connection + its reader thread.
+  std::unique_ptr<dctnet::Connection> conn_;
+  std::thread reader_;
+  std::atomic<bool> reader_stop_{false};
+
+  void connect_remote(const std::string& server_addr, const Value& cfg) {
+    auto colon = server_addr.rfind(':');
+    if (colon == std::string::npos)
+      throw std::runtime_error("server_addr must be host:port");
+    const std::string host = server_addr.substr(0, colon);
+    const int port = std::stoi(server_addr.substr(colon + 1));
+    std::unique_ptr<dctnet::Stream> stream;
+    if (cfg.get("tls").as_bool(false)) {
+      stream.reset(new dctnet::TlsStream(
+          host, port, cfg.get("sni").as_string(),
+          cfg.get("tls_insecure").as_bool(false)));
+    } else {
+      stream.reset(new dctnet::TcpStream(host, port));
+    }
+    conn_.reset(new dctnet::Connection(std::move(stream)));
+    Object hello;
+    hello["@type"] = Value("handshake");
+    hello["transport_version"] = Value(int64_t(1));
+    conn_->send_frame(dctjson::dump(Value(std::move(hello))));
+    reader_ = std::thread([this] { remote_read_loop(); });
+  }
+
+  void remote_read_loop() {
+    try {
+      for (;;) {
+        if (reader_stop_.load()) return;
+        if (!conn_->wait_readable(200)) continue;
+        std::string frame = conn_->recv_frame();
+        if (frame.empty()) break;  // orderly close
+        std::lock_guard<std::mutex> lock(mu_);
+        responses_.push_back(std::move(frame));
+        cv_responses_.notify_one();
+      }
+    } catch (const std::exception& e) {
+      push_response(make_transport_error(
+          std::string("connection lost: ") + e.what()));
+      return;
+    }
+    push_response(make_transport_error("connection closed by server"));
+  }
 
   void push_auth_update(const std::string& state) {
     Object upd;
@@ -448,6 +529,20 @@ class Client {
       if (code.empty() ||
           (!expected_code_.empty() && code != expected_code_))
         return make_error(400, "PHONE_CODE_INVALID");
+      if (!expected_password_.empty()) {
+        auth_state_ = AuthState::WaitPassword;
+        push_auth_update("authorizationStateWaitPassword");
+      } else {
+        auth_state_ = AuthState::Ready;
+        push_auth_update("authorizationStateReady");
+      }
+      return ok_value();
+    }
+    if (type == "checkAuthenticationPassword") {
+      if (auth_state_ != AuthState::WaitPassword)
+        return make_error(400, "password not expected now");
+      if (req.get("password").as_string() != expected_password_)
+        return make_error(400, "PASSWORD_HASH_INVALID");
       auth_state_ = AuthState::Ready;
       push_auth_update("authorizationStateReady");
       return ok_value();
@@ -458,7 +553,8 @@ class Client {
   static bool is_auth_request(const std::string& type) {
     return type == "setTdlibParameters" ||
            type == "setAuthenticationPhoneNumber" ||
-           type == "checkAuthenticationCode";
+           type == "checkAuthenticationCode" ||
+           type == "checkAuthenticationPassword";
   }
 
   // The 16-method router (crawler/crawler.go:109-126 surface).
@@ -688,11 +784,192 @@ class Client {
   }
 };
 
+// ---------------------------------------------------------------------------
+// Native HTTPS GET over the Chrome-shaped TLS stream — the validator's
+// fingerprint-matched transport (`telegramhelper/utlstransport.go:19-57`).
+// HTTP/1.1 with Connection: close; ALPN is restricted to http/1.1 here
+// (we do not speak h2), the one documented delta from Chrome's ALPN.
+// ---------------------------------------------------------------------------
+
+const char kB64[] =
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+
+std::string base64_encode(const std::string& in) {
+  std::string out;
+  out.reserve((in.size() + 2) / 3 * 4);
+  size_t i = 0;
+  while (i + 2 < in.size()) {
+    uint32_t v = (static_cast<unsigned char>(in[i]) << 16) |
+                 (static_cast<unsigned char>(in[i + 1]) << 8) |
+                 static_cast<unsigned char>(in[i + 2]);
+    out += kB64[(v >> 18) & 63];
+    out += kB64[(v >> 12) & 63];
+    out += kB64[(v >> 6) & 63];
+    out += kB64[v & 63];
+    i += 3;
+  }
+  if (i + 1 == in.size()) {
+    uint32_t v = static_cast<unsigned char>(in[i]) << 16;
+    out += kB64[(v >> 18) & 63];
+    out += kB64[(v >> 12) & 63];
+    out += "==";
+  } else if (i + 2 == in.size()) {
+    uint32_t v = (static_cast<unsigned char>(in[i]) << 16) |
+                 (static_cast<unsigned char>(in[i + 1]) << 8);
+    out += kB64[(v >> 18) & 63];
+    out += kB64[(v >> 12) & 63];
+    out += kB64[(v >> 6) & 63];
+    out += '=';
+  }
+  return out;
+}
+
+// RFC 7230 §4.1 de-chunking: hex size line CRLF data CRLF ... 0 CRLF CRLF.
+// Trailers (rare) are ignored; a malformed chunk header stops decoding at
+// what was parsed so far rather than returning framing bytes as content.
+std::string dechunk_body(const std::string& raw) {
+  std::string out;
+  size_t pos = 0;
+  while (pos < raw.size()) {
+    size_t line_end = raw.find("\r\n", pos);
+    if (line_end == std::string::npos) break;
+    const std::string size_line = raw.substr(pos, line_end - pos);
+    char* endp = nullptr;
+    const long long size = std::strtoll(size_line.c_str(), &endp, 16);
+    if (endp == size_line.c_str() || size < 0) break;
+    if (size == 0) break;  // terminal chunk
+    pos = line_end + 2;
+    if (pos + static_cast<size_t>(size) > raw.size()) {
+      out.append(raw, pos, raw.size() - pos);  // truncated final chunk
+      break;
+    }
+    out.append(raw, pos, static_cast<size_t>(size));
+    pos += static_cast<size_t>(size) + 2;  // skip data + CRLF
+  }
+  return out;
+}
+
+std::string https_get_impl(const std::string& config_json) {
+  Value cfg = dctjson::parse(config_json);
+  const std::string host = cfg.get("host").as_string();
+  const int port = static_cast<int>(cfg.get("port").as_int(443));
+  std::string path = cfg.get("path").as_string();
+  if (path.empty()) path = "/";
+  const std::string sni = cfg.get("sni").as_string();
+  const bool insecure = cfg.get("tls_insecure").as_bool(false);
+  const bool plain = cfg.get("plain").as_bool(false);
+  const int64_t max_body = cfg.get("max_body").as_int(1 << 20);
+
+  std::unique_ptr<dctnet::Stream> stream;
+  if (plain) {
+    stream.reset(new dctnet::TcpStream(host, port));
+  } else {
+    stream.reset(new dctnet::TlsStream(host, port, sni, insecure,
+                                       /*http11_only=*/true));
+  }
+
+  std::string req = "GET " + path + " HTTP/1.1\r\n";
+  // Chrome's header ORDER for a navigation fetch; values supplied by the
+  // caller (the validator's rotating UA pool) with sane defaults.
+  req += "Host: " + (sni.empty() ? host : sni) + "\r\n";
+  req += "Connection: close\r\n";
+  const Value& headers = cfg.get("headers");
+  bool has_ua = false, has_accept = false;
+  if (headers.type() == dctjson::Type::Object) {
+    for (const auto& kv : headers.as_object()) {
+      req += kv.first + ": " + kv.second.as_string() + "\r\n";
+      std::string lower = kv.first;
+      std::transform(lower.begin(), lower.end(), lower.begin(), ::tolower);
+      if (lower == "user-agent") has_ua = true;
+      if (lower == "accept") has_accept = true;
+    }
+  }
+  if (!has_ua)
+    req += "User-Agent: Mozilla/5.0 (X11; Linux x86_64) "
+           "AppleWebKit/537.36 (KHTML, like Gecko) Chrome/124.0.0.0 "
+           "Safari/537.36\r\n";
+  if (!has_accept)
+    req += "Accept: text/html,application/xhtml+xml,application/"
+           "xml;q=0.9,image/avif,image/webp,*/*;q=0.8\r\n";
+  req += "Accept-Encoding: identity\r\n\r\n";
+  stream->write_all(req.data(), req.size());
+
+  std::string data;
+  char buf[16384];
+  size_t header_end = std::string::npos;
+  int64_t content_length = -1;
+  bool chunked = false;
+  std::string head_lower;
+  while (static_cast<int64_t>(data.size()) < max_body + 65536) {
+    size_t n = 0;
+    try {
+      n = stream->read_some(buf, sizeof(buf));
+    } catch (const dctnet::NetError&) {
+      // Unclean close (no close_notify) after the response started:
+      // tolerate, like every browser/curl does for Connection: close.
+      if (header_end != std::string::npos) break;
+      throw;
+    }
+    if (n == 0) break;
+    data.append(buf, n);
+    if (header_end == std::string::npos) {
+      header_end = data.find("\r\n\r\n");
+      if (header_end != std::string::npos) {
+        // Scan framing headers so we stop exactly at body end instead of
+        // waiting on a server that keeps the connection open.
+        head_lower = data.substr(0, header_end);
+        std::transform(head_lower.begin(), head_lower.end(),
+                       head_lower.begin(), ::tolower);
+        size_t cl = head_lower.find("content-length:");
+        if (cl != std::string::npos)
+          content_length =
+              std::strtoll(head_lower.c_str() + cl + 15, nullptr, 10);
+        chunked = head_lower.find("transfer-encoding: chunked") !=
+                  std::string::npos;
+      }
+    }
+    if (header_end != std::string::npos) {
+      if (!chunked && content_length >= 0 &&
+          static_cast<int64_t>(data.size() - header_end - 4) >=
+              content_length)
+        break;
+      if (chunked &&
+          data.find("\r\n0\r\n", header_end + 2) != std::string::npos)
+        break;  // last-chunk marker seen
+    }
+  }
+  if (data.size() < 12 || data.compare(0, 5, "HTTP/") != 0 ||
+      header_end == std::string::npos)
+    throw std::runtime_error("malformed HTTP response");
+  const int status = std::stoi(data.substr(9, 3));
+  std::string body = data.substr(header_end + 4);
+  if (chunked) body = dechunk_body(body);
+  if (static_cast<int64_t>(body.size()) > max_body) body.resize(max_body);
+
+  Object out;
+  out["status"] = Value(int64_t(status));
+  out["body_b64"] = Value(base64_encode(body));
+  // Location surfaced so the caller can follow redirects (keeps the
+  // selectable transports behaviorally equivalent: urllib follows 3xx).
+  size_t loc = head_lower.find("\r\nlocation:");
+  if (loc != std::string::npos) {
+    size_t vstart = loc + 11;
+    size_t vend = head_lower.find("\r\n", vstart);
+    std::string value = data.substr(vstart, vend - vstart);
+    value.erase(0, value.find_first_not_of(" \t"));
+    out["location"] = Value(value);
+  }
+  auto* tls = dynamic_cast<dctnet::TlsStream*>(stream.get());
+  if (tls) out["alpn"] = Value(tls->alpn_selected());
+  return dctjson::dump(Value(std::move(out)));
+}
+
 // Thread-local receive buffer, exactly like td_json_client_receive's
 // contract: the returned pointer is valid until the next call on the same
 // client from the same thread.
 thread_local std::string g_receive_buffer;
 thread_local std::string g_execute_buffer;
+thread_local std::string g_https_buffer;
 
 }  // namespace
 
@@ -725,6 +1002,20 @@ const char* dct_client_execute(void* client, const char* request_json) {
 
 void dct_client_destroy(void* client) {
   delete static_cast<Client*>(client);
+}
+
+// Fingerprint-matched HTTP fetch (see https_get_impl above).  Returns a
+// JSON string {"status": N, "body_b64": "..."} or {"error": "..."};
+// thread-local buffer, same lifetime contract as receive().
+const char* dct_https_get(const char* config_json) {
+  try {
+    g_https_buffer = https_get_impl(config_json ? config_json : "{}");
+  } catch (const std::exception& e) {
+    Object o;
+    o["error"] = Value(std::string(e.what()));
+    g_https_buffer = dctjson::dump(Value(std::move(o)));
+  }
+  return g_https_buffer.c_str();
 }
 
 }  // extern "C"

