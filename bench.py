@@ -339,7 +339,10 @@ def main() -> None:
 
     _log("measuring dp scaling on virtual CPU mesh")
     eff = _dp_scaling()
-    result["dp_scaling_8dev_efficiency"] = (
+    # Explicitly CPU-virtual: 8 "devices" share one host's cores, so this
+    # validates the dp sharding path compiles+runs, NOT real ICI scaling —
+    # the efficiency number is bounded by core oversubscription.
+    result["dp_scaling_8dev_cpu_virtual_efficiency"] = (
         round(eff, 3) if eff is not None else None)
     print(json.dumps(result))
 
