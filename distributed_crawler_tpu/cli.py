@@ -612,7 +612,12 @@ def _run_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
     """The new TPU inference worker mode (SURVEY.md §7.6)."""
     from .inference.engine import EngineConfig, InferenceEngine
     from .inference.worker import TPUWorker, TPUWorkerConfig
+    from .parallel.multihost import initialize_multihost
     from .state.providers import LocalStorageProvider
+
+    # Pod-scale bring-up from DCT_COORDINATOR / DCT_NUM_PROCESSES /
+    # DCT_PROCESS_ID env vars; single-host runs are a no-op.
+    initialize_multihost()
     bus = _make_bus(r)
     engine = InferenceEngine(EngineConfig(
         model=cfg.inference.embed_model.replace("-", "_"),

@@ -15,6 +15,12 @@ here is mesh-shape agnostic: tests run on a virtual 8-device CPU mesh
 """
 
 from .mesh import MeshConfig, make_mesh, best_mesh_config, local_mesh
+from .multihost import (
+    MultihostConfig,
+    device_mesh_hostmajor,
+    initialize_multihost,
+    make_global_mesh,
+)
 from .sharding import (
     batch_sharding,
     named_sharding,
@@ -26,6 +32,7 @@ from .ring import ring_attention
 
 __all__ = [
     "MeshConfig",
+    "MultihostConfig",
     "make_mesh",
     "best_mesh_config",
     "local_mesh",
@@ -35,4 +42,7 @@ __all__ = [
     "shard_batch",
     "shard_params",
     "ring_attention",
+    "initialize_multihost",
+    "device_mesh_hostmajor",
+    "make_global_mesh",
 ]

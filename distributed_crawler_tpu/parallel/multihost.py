@@ -1,0 +1,153 @@
+"""Multi-host runtime: the NCCL/MPI-backend analog, the JAX way.
+
+The reference scaled across machines with Dapr pubsub for coordination and
+left tensor traffic to one process.  A TPU pod slice is different: every
+host runs the SAME program, `jax.distributed.initialize` forms the global
+runtime, and XLA lays collectives over ICI within a slice and DCN between
+slices.  This module owns that bring-up plus the mesh-shape rule that makes
+it fast (the scaling-book recipe):
+
+- **inner axes ride ICI**: tensor/sequence parallel groups must live inside
+  one host's chips, where per-hop bandwidth is highest;
+- **outer axis rides DCN**: data parallelism is the only axis that crosses
+  hosts — its all-reduce is per-step, amortized, and latency-tolerant.
+
+`device_mesh_hostmajor` encodes exactly that: devices ordered host-major so
+a (dp, sp, tp) reshape puts tp/sp within a host and dp across hosts.
+
+Config comes from `DCT_*` env vars so the same image works single-host and
+pod-scale (parity with the reference's env-driven worker config):
+
+    DCT_COORDINATOR=10.0.0.1:8476  DCT_NUM_PROCESSES=4  DCT_PROCESS_ID=0
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .mesh import MeshConfig
+
+logger = logging.getLogger("dct.parallel.multihost")
+
+
+@dataclass(frozen=True)
+class MultihostConfig:
+    """jax.distributed bring-up parameters."""
+
+    coordinator_address: str = ""   # "host:port"; empty = single process
+    num_processes: int = 1
+    process_id: int = 0
+
+    @classmethod
+    def from_env(cls, env=None) -> "MultihostConfig":
+        env = env if env is not None else os.environ
+
+        def intvar(name: str, default: int) -> int:
+            raw = (env.get(name, "") or "").strip()
+            if not raw:
+                return default
+            try:
+                return int(raw)
+            except ValueError:
+                raise ValueError(
+                    f"{name} must be an integer, got {raw!r}") from None
+
+        return cls(
+            coordinator_address=env.get("DCT_COORDINATOR", ""),
+            num_processes=intvar("DCT_NUM_PROCESSES", 1),
+            process_id=intvar("DCT_PROCESS_ID", 0),
+        )
+
+    def validate(self) -> None:
+        if self.num_processes < 1:
+            raise ValueError(
+                f"num_processes must be >= 1, got {self.num_processes}")
+        if not 0 <= self.process_id < self.num_processes:
+            raise ValueError(
+                f"process_id {self.process_id} out of range for "
+                f"{self.num_processes} processes")
+        if self.num_processes > 1 and not self.coordinator_address:
+            raise ValueError(
+                "multi-process runs need DCT_COORDINATOR (host:port)")
+
+
+_initialized = False
+
+
+def initialize_multihost(cfg: Optional[MultihostConfig] = None) -> bool:
+    """Bring up the global JAX runtime; no-op for single-process runs.
+
+    Returns True when `jax.distributed.initialize` was called.  Idempotent:
+    a second call is a no-op (jax rejects re-initialization)."""
+    global _initialized
+    cfg = cfg or MultihostConfig.from_env()
+    cfg.validate()
+    if cfg.num_processes <= 1:
+        logger.debug("single-process run; skipping jax.distributed")
+        return False
+    if _initialized:
+        return False
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator_address,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )
+    _initialized = True
+    logger.info("jax.distributed initialized", extra={
+        "coordinator": cfg.coordinator_address,
+        "process_id": cfg.process_id,
+        "num_processes": cfg.num_processes})
+    return True
+
+
+def device_mesh_hostmajor(devices: Sequence, mesh_cfg: MeshConfig,
+                          host_of: Optional[Sequence[int]] = None
+                          ) -> np.ndarray:
+    """Arrange global devices into a (dp, sp, tp) ndarray such that the
+    inner (sp, tp) axes stay within one host and dp spans hosts.
+
+    ``host_of[i]`` is the host index of ``devices[i]`` (defaults to each
+    device's ``process_index``).  Requires sp*tp to divide the per-host
+    device count, so no tp/sp collective ever crosses DCN."""
+    mesh_cfg.validate()
+    n = len(devices)
+    if n != mesh_cfg.n_devices:
+        raise ValueError(
+            f"{n} devices cannot fill mesh {mesh_cfg}")
+    if host_of is None:
+        host_of = [getattr(d, "process_index", 0) for d in devices]
+    order = sorted(range(n), key=lambda i: (host_of[i], i))
+    counts = collections.Counter(host_of)
+    inner = mesh_cfg.sp * mesh_cfg.tp
+    for host, count in counts.items():
+        if count % inner != 0:
+            raise ValueError(
+                f"host {host} has {count} devices, not divisible by "
+                f"sp*tp={inner}: a tensor/sequence group would straddle "
+                f"DCN — shrink tp/sp or rebalance hosts")
+    arranged = np.asarray([devices[i] for i in order], dtype=object)
+    return arranged.reshape(mesh_cfg.dp, mesh_cfg.sp, mesh_cfg.tp)
+
+
+def make_global_mesh(mesh_cfg: Optional[MeshConfig] = None):
+    """Global (all-process) mesh with host-major device placement.
+
+    With no ``mesh_cfg``, all global devices go to dp — the crawl-inference
+    default (embarrassingly batch-parallel)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from .mesh import MESH_AXES, best_mesh_config
+
+    devices = jax.devices()  # global across processes after initialize
+    if mesh_cfg is None:
+        mesh_cfg = best_mesh_config(len(devices))
+    return Mesh(device_mesh_hostmajor(devices, mesh_cfg), MESH_AXES)
