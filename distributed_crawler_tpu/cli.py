@@ -334,12 +334,25 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
     setup_logging(r.get_str("logging.level", "info"),
                   json_output=r.get_bool("logging.json", False))
 
-    metrics_port = r.get_int("observability.metrics_port", 0)
-    if metrics_port:
-        from .utils.metrics import serve_metrics
-        serve_metrics(metrics_port)
-
     mode = r.get_str("distributed.mode", "")
+    # Observability servers for every mode (`main.go:60-80` ran pprof
+    # unconditionally) — EXCEPT tpu-worker, where TPUWorker.start() owns
+    # both (binding here too would EADDRINUSE its startup).
+    if mode != "tpu-worker":
+        metrics_port = r.get_int("observability.metrics_port", 0)
+        if metrics_port:
+            from .utils.metrics import serve_metrics
+            serve_metrics(metrics_port)
+        profiler_port = r.get_int("observability.profiler_port", 0)
+        if profiler_port:
+            try:
+                import jax.profiler
+
+                jax.profiler.start_server(profiler_port)
+                logger.info("jax profiler serving",
+                            extra={"port": profiler_port})
+            except Exception as e:  # profiling is never fatal to the crawl
+                logger.warning("profiler server failed to start: %s", e)
     urls = collect_urls(r)
     logger.info("starting", extra={"mode": mode or "standalone",
                                    "platform": cfg.platform,
