@@ -113,13 +113,17 @@ class TPUWorker:
             # The pprof-endpoint analog (`main.go:60-80` served :6060
             # unconditionally): a jax.profiler gRPC server that
             # TensorBoard / `jax.profiler.trace` clients attach to for
-            # on-demand device traces.
-            import jax.profiler
+            # on-demand device traces.  Best-effort, like every other
+            # mode's profiler: a stale port must not kill the worker.
+            try:
+                import jax.profiler
 
-            jax.profiler.start_server(self.cfg.profiler_port)
-            self._profiler_started = True
-            logger.info("jax profiler serving", extra={
-                "port": self.cfg.profiler_port})
+                jax.profiler.start_server(self.cfg.profiler_port)
+                self._profiler_started = True
+                logger.info("jax profiler serving", extra={
+                    "port": self.cfg.profiler_port})
+            except Exception as e:
+                logger.warning("profiler server failed to start: %s", e)
         logger.info("tpu worker started", extra={
             "worker_id": self.cfg.worker_id,
             "model": self.engine.cfg.model})

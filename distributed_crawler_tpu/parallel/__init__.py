@@ -21,6 +21,11 @@ from .multihost import (
     initialize_multihost,
     make_global_mesh,
 )
+from .pipeline import (
+    make_pp_mesh,
+    pipeline_apply,
+    stack_stage_params,
+)
 from .sharding import (
     batch_sharding,
     named_sharding,
@@ -45,4 +50,7 @@ __all__ = [
     "initialize_multihost",
     "device_mesh_hostmajor",
     "make_global_mesh",
+    "make_pp_mesh",
+    "pipeline_apply",
+    "stack_stage_params",
 ]

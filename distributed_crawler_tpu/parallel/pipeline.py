@@ -1,0 +1,116 @@
+"""Pipeline (pp) parallelism: GPipe-style microbatch pipelining over a
+``pp`` mesh axis.
+
+The reference's pipeline parallelism is task-level — the tandem
+crawler⇄validator queue and the chunker's 5-stage channel pipeline
+(SURVEY.md §2.3.4-5).  On a TPU mesh the same shape applies to the MODEL:
+layers are partitioned into ``pp`` contiguous stages, one stage per device
+group, and microbatches stream through — device g computes microbatch t-g
+at tick t while activations hop one ICI step per tick via `lax.ppermute`.
+Wall-clock for M microbatches over P stages is (M + P - 1) stage-times
+instead of M·P, the classic GPipe schedule.
+
+Everything is a pure function under `jit`: the tick loop is a `lax.scan`
+(no Python control flow inside the trace), stages exchange activations
+with ppermute (XLA collective over ICI), and bubble ticks compute on junk
+that is masked out of the result — compiler-friendly, no dynamic shapes.
+
+Entry points:
+  - :func:`stack_stage_params` — stack per-stage param pytrees for
+    sharding over the pp axis.
+  - :func:`pipeline_apply` — run [n_micro, mb, ...] inputs through a
+    stage function over a 1-D pp mesh; returns [n_micro, mb, ...].
+  - :func:`make_pp_mesh` — a 1-D mesh over the pp axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXIS_PP = "pp"
+
+
+def _pvary(x):
+    """Mark ``x`` as device-varying over pp (API moved pvary -> pcast)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, (AXIS_PP,), to="varying")
+    return jax.lax.pvary(x, (AXIS_PP,))
+
+
+def make_pp_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over the pipeline axis (one stage per device)."""
+    devices = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices, dtype=object), (AXIS_PP,))
+
+
+def stack_stage_params(stage_params: Sequence[Any]) -> Any:
+    """Stack P per-stage pytrees into one pytree with leading axis P —
+    the layout `pipeline_apply` shards over pp (stage g's slice lands on
+    device g, so no parameter ever crosses a stage boundary)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves, axis=0), *stage_params)
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   stacked_params: Any,
+                   x: jax.Array,
+                   mesh: Mesh) -> jax.Array:
+    """Run microbatches through the stage pipeline.
+
+    ``stage_fn(params_g, h) -> h`` applies ONE stage (shapes preserved);
+    ``stacked_params`` has leading axis P (see :func:`stack_stage_params`);
+    ``x`` is [n_micro, mb, ...].  Returns [n_micro, mb, ...] after all P
+    stages.  ``n_micro`` should be >= P to keep the bubble fraction
+    (P-1)/(M+P-1) small."""
+    n_stages = mesh.shape[AXIS_PP]
+    n_micro = x.shape[0]
+    n_ticks = n_micro + n_stages - 1
+
+    def per_stage(params_leading1, x_full):
+        # Inside shard_map: this device holds stage g's params (leading
+        # axis sliced to 1) and the FULL microbatch stream (replicated).
+        params_g = jax.tree_util.tree_map(
+            lambda a: jnp.squeeze(a, axis=0), params_leading1)
+        stage = jax.lax.axis_index(AXIS_PP)
+        # pvary: the carry is device-varying over pp (each stage holds a
+        # different activation), while the replicated input stream is not —
+        # scan requires the carry type to be consistent across ticks.
+        zero = _pvary(jnp.zeros_like(x_full[0]))
+
+        def tick(carry, t):
+            incoming = carry
+            # Stage 0 injects microbatch t from the stream.  Drain ticks
+            # (t >= n_micro) REPLAY the final microbatch (index clamp) —
+            # their outputs are safe not because they are zeros but
+            # because a replay started at tick t finishes at tick
+            # t + P - 1 >= n_ticks, outside the collected window; only
+            # `finished[...]` on the last stage reaches the result.
+            inject = _pvary(x_full[jnp.minimum(t, n_micro - 1)])
+            h_in = jnp.where(stage == 0, inject, incoming)
+            h_out = stage_fn(params_g, h_in)
+            # Rotate activations one hop down the ring: stage g -> g+1.
+            shifted = jax.lax.ppermute(
+                h_out, AXIS_PP,
+                perm=[(g, (g + 1) % n_stages) for g in range(n_stages)])
+            return shifted, h_out
+
+        _, outs = jax.lax.scan(tick, zero, jnp.arange(n_ticks))
+        # outs: [n_ticks, mb, ...] — on the LAST stage, tick t carries the
+        # finished microbatch t - (P-1).  Every other stage contributes
+        # zeros so a psum over pp reconstructs the result everywhere.
+        finished = outs[n_stages - 1:]
+        is_last = (stage == n_stages - 1).astype(finished.dtype)
+        return jax.lax.psum(finished * is_last, AXIS_PP)
+
+    spec_params = jax.tree_util.tree_map(lambda _: P(AXIS_PP), stacked_params)
+    out = jax.shard_map(
+        per_stage, mesh=mesh,
+        in_specs=(spec_params, P()),  # params split by stage; stream replicated
+        out_specs=P(),
+    )(stacked_params, x)
+    return out
