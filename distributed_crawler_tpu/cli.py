@@ -47,7 +47,7 @@ def build_parser() -> argparse.ArgumentParser:
     a("--log-json", action="store_const", const=True, default=None)
     a("--mode", default=None,
       help="standalone | launch | orchestrator | worker | job | "
-           "tpu-worker | train-head")
+           "tpu-worker | train-head | cluster")
     a("--worker-id", default=None, help="worker identifier (worker modes)")
     a("--concurrency", type=int, default=None)
     a("--timeout", type=int, default=None, help="HTTP timeout seconds")
@@ -128,6 +128,14 @@ def build_parser() -> argparse.ArgumentParser:
            "tpu-worker)")
     a("--train-epochs", type=int, default=None)
     a("--train-lr", type=float, default=None)
+    # Embedding clustering (mode=cluster): BASELINE config #5's closing
+    # move — crawl/inference JSONL -> TPU k-means -> cluster assignments.
+    a("--cluster-input", default=None,
+      help="JSONL rows with an 'embedding' field (TPU worker results) or "
+           "text fields (embedded on the fly)")
+    a("--cluster-k", type=int, default=None)
+    a("--cluster-iters", type=int, default=None)
+    a("--cluster-output", default=None, help="output JSON path")
     a("--generate-code", action="store_true",
       help="run the Telegram auth bootstrap (TG_* env vars) and write "
            ".tdlib/credentials.json, then exit")
@@ -198,6 +206,10 @@ _KEY_MAP = {
     "head_checkpoint": "train.checkpoint_dir",
     "train_epochs": "train.epochs",
     "train_lr": "train.learning_rate",
+    "cluster_input": "cluster.input_file",
+    "cluster_k": "cluster.k",
+    "cluster_iters": "cluster.iters",
+    "cluster_output": "cluster.output_file",
 }
 
 
@@ -290,9 +302,10 @@ def resolve_config(args: argparse.Namespace,
 
     # Sampling-method validity matrix (`main.go` PersistentPreRunE ->
     # common/sampling_validation.go). Validate-only pods need no URLs, and
-    # neither do the non-crawling service modes (TPU inference / training).
+    # neither do the non-crawling service modes (TPU inference / training /
+    # clustering).
     if not cfg.validate_only and r.get_str("distributed.mode", "") not in (
-            "tpu-worker", "train-head"):
+            "tpu-worker", "train-head", "cluster"):
         validate_sampling_method(SamplingValidationInput(
             platform=cfg.platform, sampling_method=cfg.sampling_method,
             url_list=r.get_list("crawler.urls"),
@@ -390,6 +403,8 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
             _run_tpu_worker(cfg, r)
         elif mode == "train-head":
             return _run_train_head(cfg, r)
+        elif mode == "cluster":
+            return _run_cluster(cfg, r)
         else:
             print(f"error: unknown execution mode: {mode}", file=sys.stderr)
             return 2
@@ -515,7 +530,6 @@ def _run_train_head(cfg: CrawlerConfig, r: ConfigResolver) -> int:
     import json as _json
 
     from .inference.checkpoint import save_params
-    from .inference.engine import EngineConfig, InferenceEngine
     from .models.train import TrainConfig, finetune_head
 
     posts_file = r.get_str("train.posts_file")
@@ -572,12 +586,7 @@ def _run_train_head(cfg: CrawlerConfig, r: ConfigResolver) -> int:
     n_labels = (len(vocab) if vocab is not None
                 else max(lbl for _, lbl in pairs) + 1)
 
-    engine = InferenceEngine(EngineConfig(
-        model=cfg.inference.embed_model.replace("-", "_"),
-        n_labels=n_labels,
-        batch_size=cfg.inference.batch_size,
-        buckets=tuple(cfg.inference.bucket_sizes),
-        pretrained_dir=cfg.inference.pretrained_dir or None))
+    engine = _make_engine(cfg, r, n_labels=n_labels)
 
     token_lists = engine.tokenizer.encode_batch(
         [texts[uid] for uid, _ in pairs])
@@ -621,9 +630,113 @@ def _run_train_head(cfg: CrawlerConfig, r: ConfigResolver) -> int:
     return 0
 
 
+def _make_engine(cfg: CrawlerConfig, r: ConfigResolver,
+                 n_labels: Optional[int] = None,
+                 with_checkpoint: bool = False):
+    """One engine-wiring path for tpu-worker / train-head / cluster."""
+    from .inference.engine import EngineConfig, InferenceEngine
+
+    kw = dict(
+        model=cfg.inference.embed_model.replace("-", "_"),
+        batch_size=cfg.inference.batch_size,
+        buckets=tuple(cfg.inference.bucket_sizes),
+        pretrained_dir=cfg.inference.pretrained_dir or None)
+    if n_labels is not None:
+        kw["n_labels"] = n_labels
+    if with_checkpoint:
+        kw["checkpoint_dir"] = r.get_str("train.checkpoint_dir") or None
+    return InferenceEngine(EngineConfig(**kw))
+
+
+def _run_cluster(cfg: CrawlerConfig, r: ConfigResolver) -> int:
+    """mode=cluster: embeddings (or text, embedded on the fly) → TPU
+    k-means → cluster assignments — BASELINE config #5's closing move
+    (snowball crawl + embed + clustering)."""
+    import json as _json
+
+    import numpy as np
+
+    input_file = r.get_str("cluster.input_file")
+    output_file = r.get_str("cluster.output_file")
+    k = r.get_int("cluster.k", 8)
+    iters = r.get_int("cluster.iters", 25)
+    if not input_file or not output_file:
+        print("error: cluster mode needs --cluster-input and "
+              "--cluster-output", file=sys.stderr)
+        return 2
+    if k < 2:
+        print("error: --cluster-k must be >= 2", file=sys.stderr)
+        return 2
+    if iters < 1:
+        print("error: --cluster-iters must be >= 1", file=sys.stderr)
+        return 2
+
+    uids: list = []
+    embeddings: list = []
+    texts: list = []
+    with open(input_file, "r", encoding="utf-8") as f:
+        for line in f:
+            if not line.strip():
+                continue
+            row = _json.loads(line)
+            uid = row.get("post_uid") or row.get("id") or str(len(uids))
+            if isinstance(row.get("embedding"), list):
+                uids.append(uid)
+                embeddings.append(row["embedding"])
+            else:
+                text = row.get("all_text") or row.get("description") or ""
+                if text:
+                    uids.append(uid)
+                    texts.append(text)
+    if embeddings and texts:
+        print("error: input mixes 'embedding' rows with text rows; "
+              "cluster one kind at a time", file=sys.stderr)
+        return 2
+    if texts:
+        x = _make_engine(cfg, r).embed(texts)
+    else:
+        widths = {len(e) for e in embeddings}
+        if len(widths) != 1 or 0 in widths:
+            print(f"error: embedding rows have inconsistent widths "
+                  f"{sorted(widths)}; cluster one embedding space at a "
+                  f"time", file=sys.stderr)
+            return 2
+        x = np.asarray(embeddings, np.float32)
+    if len(x) < k:
+        print(f"error: {len(x)} rows cannot form {k} clusters",
+              file=sys.stderr)
+        return 2
+
+    import jax.numpy as jnp
+
+    from .models.clustering import fit
+
+    result = fit(jnp.asarray(x), k, iters=iters)
+    assignments = np.asarray(result.assignments)
+    sizes = np.bincount(assignments, minlength=k).tolist()
+    with open(output_file, "w", encoding="utf-8") as f:
+        _json.dump({
+            "k": k,
+            "iters": iters,
+            "inertia": float(result.inertia),
+            "cluster_sizes": sizes,
+            "centroids": np.asarray(result.centroids).tolist(),
+            "assignments": [
+                {"post_uid": uid, "cluster": int(c)}
+                for uid, c in zip(uids, assignments)],
+        }, f)
+    print(_json.dumps({
+        "clustered": len(uids),
+        "k": k,
+        "inertia": round(float(result.inertia), 4),
+        "cluster_sizes": sizes,
+        "output": output_file,
+    }))
+    return 0
+
+
 def _run_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
     """The new TPU inference worker mode (SURVEY.md §7.6)."""
-    from .inference.engine import EngineConfig, InferenceEngine
     from .inference.worker import TPUWorker, TPUWorkerConfig
     from .parallel.multihost import initialize_multihost
     from .state.providers import LocalStorageProvider
@@ -632,12 +745,7 @@ def _run_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
     # DCT_PROCESS_ID env vars; single-host runs are a no-op.
     initialize_multihost()
     bus = _make_bus(r)
-    engine = InferenceEngine(EngineConfig(
-        model=cfg.inference.embed_model.replace("-", "_"),
-        batch_size=cfg.inference.batch_size,
-        buckets=tuple(cfg.inference.bucket_sizes),
-        pretrained_dir=cfg.inference.pretrained_dir or None,
-        checkpoint_dir=r.get_str("train.checkpoint_dir") or None))
+    engine = _make_engine(cfg, r, with_checkpoint=True)
     # Results land as JSONL under the same storage root the crawler uses.
     provider = LocalStorageProvider(cfg.storage_root)
     worker = TPUWorker(bus, engine, provider=provider,

@@ -112,6 +112,7 @@ class MockDcServer:
         self._stop = threading.Event()
         self._threads: list = []
         self._live_conns: list = []
+        self._stats_mu = threading.Lock()
         self.connections = 0
         self.auth_successes = 0
         self._accept_thread = threading.Thread(
@@ -193,7 +194,8 @@ class MockDcServer:
                             seed_json=self.seed_json,
                             lib_path=self._lib_path,
                             conn_id=f"dc-{addr[1]}")
-                        self.auth_successes += 1
+                        with self._stats_mu:
+                            self.auth_successes += 1
                     continue
                 if rtype == "close":
                     self._reply(conn, req, {"@type": "ok"})

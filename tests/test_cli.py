@@ -186,3 +186,118 @@ class TestMain:
         finally:
             crawl_runner.shutdown_connection_pool()
             set_run_for_channel_fn(None)
+
+
+class TestClusterMode:
+    """BASELINE config #5's closing move: embeddings -> k-means -> clusters."""
+
+    def test_cluster_embeddings_e2e(self, tmp_path, capsys):
+        import json
+
+        import numpy as np
+
+        from distributed_crawler_tpu.cli import main
+
+        rng = np.random.default_rng(0)
+        rows = []
+        # Three well-separated blobs in 8-D.
+        for c, center in enumerate(([5, 0], [0, 5], [-5, -5])):
+            for i in range(20):
+                vec = rng.standard_normal(8) * 0.1
+                vec[0] += center[0]
+                vec[1] += center[1]
+                rows.append({"post_uid": f"p{c}_{i}",
+                             "embedding": vec.tolist()})
+        inp = tmp_path / "emb.jsonl"
+        with open(inp, "w") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+        out = tmp_path / "clusters.json"
+
+        rc = main(["--mode", "cluster", "--cluster-input", str(inp),
+                   "--cluster-k", "3", "--cluster-output", str(out),
+                   "--storage-root", str(tmp_path / "store")])
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert summary["clustered"] == 60
+        assert sorted(summary["cluster_sizes"]) == [20, 20, 20]
+
+        result = json.load(open(out))
+        # Every blob lands in exactly one cluster.
+        by_blob = {}
+        for a in result["assignments"]:
+            blob = a["post_uid"].split("_")[0]
+            by_blob.setdefault(blob, set()).add(a["cluster"])
+        assert all(len(cs) == 1 for cs in by_blob.values())
+
+    def test_cluster_text_rows_embedded_on_the_fly(self, tmp_path, capsys):
+        import json
+
+        from distributed_crawler_tpu.cli import main
+
+        inp = tmp_path / "posts.jsonl"
+        with open(inp, "w") as f:
+            for i in range(12):
+                words = ["alpha beta", "omega sigma"][i % 2]
+                f.write(json.dumps({"post_uid": f"p{i}",
+                                    "all_text": words * 3}) + "\n")
+        out = tmp_path / "clusters.json"
+        rc = main(["--mode", "cluster", "--infer-model", "tiny",
+                   "--cluster-input", str(inp), "--cluster-k", "2",
+                   "--cluster-output", str(out),
+                   "--storage-root", str(tmp_path / "store")])
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert summary["clustered"] == 12 and summary["k"] == 2
+
+    def test_too_few_rows_rejected(self, tmp_path, capsys):
+        import json
+
+        from distributed_crawler_tpu.cli import main
+
+        inp = tmp_path / "emb.jsonl"
+        with open(inp, "w") as f:
+            f.write(json.dumps({"post_uid": "p0",
+                                "embedding": [1.0, 2.0]}) + "\n")
+        rc = main(["--mode", "cluster", "--cluster-input", str(inp),
+                   "--cluster-k", "3",
+                   "--cluster-output", str(tmp_path / "o.json"),
+                   "--storage-root", str(tmp_path / "store")])
+        assert rc == 2
+        assert "cannot form" in capsys.readouterr().err
+
+    def test_ragged_embeddings_rejected(self, tmp_path, capsys):
+        import json
+
+        from distributed_crawler_tpu.cli import main
+
+        inp = tmp_path / "emb.jsonl"
+        with open(inp, "w") as f:
+            f.write(json.dumps({"post_uid": "a",
+                                "embedding": [1.0, 2.0]}) + "\n")
+            f.write(json.dumps({"post_uid": "b",
+                                "embedding": [1.0, 2.0, 3.0]}) + "\n")
+            f.write(json.dumps({"post_uid": "c", "embedding": []}) + "\n")
+        rc = main(["--mode", "cluster", "--cluster-input", str(inp),
+                   "--cluster-k", "2",
+                   "--cluster-output", str(tmp_path / "o.json"),
+                   "--storage-root", str(tmp_path / "store")])
+        assert rc == 2
+        assert "inconsistent widths" in capsys.readouterr().err
+
+    def test_zero_iters_rejected(self, tmp_path, capsys):
+        import json
+
+        from distributed_crawler_tpu.cli import main
+
+        inp = tmp_path / "emb.jsonl"
+        with open(inp, "w") as f:
+            for i in range(4):
+                f.write(json.dumps({"post_uid": str(i),
+                                    "embedding": [float(i), 0.0]}) + "\n")
+        rc = main(["--mode", "cluster", "--cluster-input", str(inp),
+                   "--cluster-k", "2", "--cluster-iters", "0",
+                   "--cluster-output", str(tmp_path / "o.json"),
+                   "--storage-root", str(tmp_path / "store")])
+        assert rc == 2
+        assert "cluster-iters" in capsys.readouterr().err

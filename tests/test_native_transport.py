@@ -516,3 +516,33 @@ class TestTransportErrorFastFail:
             assert t1 - t0 < client.receive_timeout_s
         finally:
             client.close()
+
+
+class TestConcurrentConnections:
+    def test_parallel_sessions_isolated(self, server):
+        """Multiple clients authenticate and fetch concurrently; each
+        session owns its state (per-connection engine isolation)."""
+        import threading
+
+        results = {}
+
+        def session(n):
+            c = NativeTelegramClient(server_addr=server.address,
+                                     conn_id=f"cc{n}")
+            try:
+                c.authenticate(f"+1555000{n}", "24680")
+                c.wait_ready(5.0)
+                chat = c.search_public_chat("wirechan")
+                msgs = c.get_chat_history(chat.id, limit=5)
+                results[n] = len(msgs.messages)
+            finally:
+                c.close()
+
+        threads = [threading.Thread(target=session, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert results == {0: 5, 1: 5, 2: 5, 3: 5}
+        assert server.auth_successes == 4
