@@ -388,3 +388,44 @@ class TestProfilerEndpoint:
         finally:
             worker.stop()
             bus.close()
+
+
+class TestOrchestratorSeesTpuWorker:
+    def test_tpu_worker_heartbeats_register_with_orchestrator(self, tmp_path):
+        """Crawl orchestrator and TPU worker share one bus: the TPU
+        worker's heartbeats land in the orchestrator's worker registry
+        (SURVEY §2.3.3's co-scheduling-on-one-slice story)."""
+        import time
+
+        from distributed_crawler_tpu.config.crawler import CrawlerConfig
+        from distributed_crawler_tpu.orchestrator.orchestrator import (
+            Orchestrator,
+        )
+        from distributed_crawler_tpu.state.interface import (
+            LocalConfig,
+            StateConfig,
+        )
+        from distributed_crawler_tpu.state.local import LocalStateManager
+
+        bus = InMemoryBus()
+        sm = LocalStateManager(StateConfig(
+            storage_root=str(tmp_path), crawl_id="co1",
+            local=LocalConfig(base_path=str(tmp_path))))
+        cfg = CrawlerConfig()
+        cfg.platform = "telegram"
+        orch = Orchestrator("co1", cfg, bus, sm)
+        orch.start(["chana"], background=False)
+
+        worker = TPUWorker(bus, _engine(),
+                           cfg=TPUWorkerConfig(worker_id="tpu-w7",
+                                               heartbeat_s=0.05),
+                           registry=MetricsRegistry())
+        bus.start()
+        worker.start()
+        deadline = time.monotonic() + 10
+        while "tpu-w7" not in orch.workers and time.monotonic() < deadline:
+            time.sleep(0.02)
+        worker.stop()
+        bus.close()
+        assert "tpu-w7" in orch.workers
+        assert orch.workers["tpu-w7"].status in ("idle", "busy")
