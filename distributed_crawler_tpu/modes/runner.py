@@ -23,16 +23,27 @@ logger = logging.getLogger("dct.modes.runner")
 
 
 def ship_crawl_output(cfg: CrawlerConfig, crawl_exec_id: str) -> int:
-    """Copy the finished crawl's per-channel post files into the chunker's
+    """MOVE the finished crawl's per-channel post files into the chunker's
     watch dir as write-once shards — the launch-mode analog of the
     reference deployment where crawler pods wrote into the chunk service's
-    watched volume (`chunk/main.go:105-150` + localstorage binding).
+    watched volume and the chunker consumed the files
+    (`chunk/main.go:105-150` + localstorage binding).
 
-    Runs after the crawl completes, so each posts.jsonl is final; shards
-    are named uniquely per (crawl, channel) and written via temp+rename so
-    the watcher can't pick up a half-copy.  Returns the shard count."""
+    Move, not copy: the canonical record becomes the combined object in
+    the (local or remote) store, and a RESUMED crawl appends into a fresh
+    posts.jsonl whose next shipment carries only the new rows — re-running
+    a crawl never re-uploads already-combined posts.  Runs after the crawl
+    completes, so each posts.jsonl is final; shards are named uniquely per
+    (crawl, channel, timestamp) and published via temp+rename+fsync before
+    the source is removed, so a crash never persists the unlink without
+    the shard's data.  The shard then survives in the watch dir until the
+    chunker's post-upload cleanup — durability therefore requires
+    ``combine_watch_dir`` to be a durable volume, exactly as the
+    reference's chunk service required of its watched volume.  Returns
+    the shard count."""
     import os
     import shutil
+    import time as _time
 
     if not cfg.combine_watch_dir:
         return 0
@@ -50,11 +61,26 @@ def ship_crawl_output(cfg: CrawlerConfig, crawl_exec_id: str) -> int:
         src = os.path.join(root, channel, "posts", "posts.jsonl")
         if not os.path.isfile(src):
             continue
-        dest = os.path.join(cfg.combine_watch_dir,
-                            f"{tag}_{channel}_posts.jsonl")
+        # Nanosecond stamp (like the chunker's combined_* names): each
+        # shipment is a distinct shard even across rapid resumes.
+        dest = os.path.join(
+            cfg.combine_watch_dir,
+            f"{tag}_{channel}_{_time.time_ns()}_posts.jsonl")
         tmp = dest + ".partial"  # .tmp/.jsonl suffixes are watcher-visible
-        shutil.copyfile(src, tmp)
-        os.replace(tmp, dest)
+        with open(tmp, "wb") as out, open(src, "rb") as inp:
+            shutil.copyfileobj(inp, out)
+            out.flush()
+            os.fsync(out.fileno())  # shard data durable BEFORE the unlink
+        os.replace(tmp, dest)        # atomic publish for the watcher
+        try:
+            dfd = os.open(cfg.combine_watch_dir, os.O_RDONLY)
+            try:
+                os.fsync(dfd)        # persist the rename itself
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
+        os.remove(src)               # consume the source (move)
         shipped += 1
     return shipped
 

@@ -394,3 +394,37 @@ class TestPathEscape:
         with pytest.raises(ValueError, match="escapes"):
             client.put_object("../store-evil/f", b"x")
         assert not (tmp_path / "store-evil").exists()
+
+
+class TestResumeNoDuplicateShip:
+    def test_second_launch_ships_only_new_rows(self, tmp_path):
+        """ship_crawl_output MOVES post files: a re-run of the same crawl
+        re-ships nothing unless new posts were written (no duplicate rows
+        in the store across resumes)."""
+        import json as _json
+
+        from distributed_crawler_tpu.config.crawler import CrawlerConfig
+        from distributed_crawler_tpu.modes.runner import ship_crawl_output
+
+        cfg = CrawlerConfig()
+        cfg.storage_root = str(tmp_path / "store")
+        cfg.crawl_id = "rs1"
+        cfg.combine_watch_dir = str(tmp_path / "watch")
+        posts_dir = tmp_path / "store" / "rs1" / "chanA" / "posts"
+        posts_dir.mkdir(parents=True)
+        with open(posts_dir / "posts.jsonl", "w") as f:
+            f.write(_json.dumps({"post_uid": "1"}) + "\n")
+
+        assert ship_crawl_output(cfg, "rs1") == 1
+        assert not (posts_dir / "posts.jsonl").exists()  # consumed
+        # Re-ship with nothing new: zero shards.
+        assert ship_crawl_output(cfg, "rs1") == 0
+        # Resume appends fresh rows -> only they ship.
+        with open(posts_dir / "posts.jsonl", "w") as f:
+            f.write(_json.dumps({"post_uid": "2"}) + "\n")
+        assert ship_crawl_output(cfg, "rs1") == 1
+        shards = sorted((tmp_path / "watch").iterdir())
+        assert len(shards) == 2
+        rows = [_json.loads(line) for p in shards
+                for line in open(p).read().strip().splitlines()]
+        assert sorted(r["post_uid"] for r in rows) == ["1", "2"]
