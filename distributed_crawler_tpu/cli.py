@@ -707,11 +707,20 @@ def _run_cluster(cfg: CrawlerConfig, r: ConfigResolver) -> int:
               file=sys.stderr)
         return 2
 
+    import jax
     import jax.numpy as jnp
 
-    from .models.clustering import fit
+    from .models.clustering import fit, fit_sharded
 
-    result = fit(jnp.asarray(x), k, iters=iters)
+    n_dev = len(jax.devices())
+    if n_dev > 1 and len(x) % n_dev == 0:
+        # Multi-chip deployment: shard rows over dp, XLA psums the one-hot
+        # sums/counts across chips (BASELINE config #5's v5e-8 shape).
+        from .parallel import make_mesh
+
+        result = fit_sharded(jnp.asarray(x), k, make_mesh(), iters=iters)
+    else:
+        result = fit(jnp.asarray(x), k, iters=iters)
     assignments = np.asarray(result.assignments)
     sizes = np.bincount(assignments, minlength=k).tolist()
     with open(output_file, "w", encoding="utf-8") as f:

@@ -31,11 +31,13 @@ def ship_crawl_output(cfg: CrawlerConfig, crawl_exec_id: str) -> int:
 
     Move, not copy: the canonical record becomes the combined object in
     the (local or remote) store, and a RESUMED crawl appends into a fresh
-    posts.jsonl whose next shipment carries only the new rows — re-running
-    a crawl never re-uploads already-combined posts.  Runs after the crawl
-    completes, so each posts.jsonl is final; shards are named uniquely per
-    (crawl, channel, timestamp) and published via temp+rename+fsync before
-    the source is removed, so a crash never persists the unlink without
+    posts.jsonl whose next shipment carries only the new rows.  Semantics
+    are AT-LEAST-ONCE: publish happens before the source unlink, so a
+    crash exactly between the two re-ships that channel's rows once on the
+    next run (never silently loses them — the safe side of the fence;
+    consumers dedup on post_uid).  Shards are named uniquely per (crawl,
+    channel, timestamp) and published via temp+rename+fsync before the
+    source is removed, so a power loss never persists the unlink without
     the shard's data.  The shard then survives in the watch dir until the
     chunker's post-upload cleanup — durability therefore requires
     ``combine_watch_dir`` to be a durable volume, exactly as the
@@ -56,6 +58,14 @@ def ship_crawl_output(cfg: CrawlerConfig, crawl_exec_id: str) -> int:
         return 0
     tag = os.path.basename(root)
     os.makedirs(cfg.combine_watch_dir, exist_ok=True)
+    # Sweep temps stranded by a mid-copy crash: the names embed a
+    # nanosecond stamp, so retries would otherwise accumulate garbage.
+    for name in os.listdir(cfg.combine_watch_dir):
+        if name.endswith(".partial"):
+            try:
+                os.remove(os.path.join(cfg.combine_watch_dir, name))
+            except OSError:
+                pass
     shipped = 0
     for channel in sorted(os.listdir(root)):
         src = os.path.join(root, channel, "posts", "posts.jsonl")

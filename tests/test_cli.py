@@ -301,3 +301,30 @@ class TestClusterMode:
                    "--storage-root", str(tmp_path / "store")])
         assert rc == 2
         assert "cluster-iters" in capsys.readouterr().err
+
+    def test_sharded_path_on_virtual_mesh(self, tmp_path, capsys):
+        """Row count divisible by the 8-device CPU mesh exercises
+        fit_sharded (the v5e-8 data-parallel shape) through the CLI."""
+        import json
+
+        import numpy as np
+
+        from distributed_crawler_tpu.cli import main
+
+        rng = np.random.default_rng(1)
+        inp = tmp_path / "emb.jsonl"
+        with open(inp, "w") as f:
+            for c in range(2):
+                for i in range(32):  # 64 rows over 8 devices
+                    vec = rng.standard_normal(4) * 0.1
+                    vec[0] += (c * 2 - 1) * 6
+                    f.write(json.dumps({
+                        "post_uid": f"b{c}_{i}",
+                        "embedding": vec.tolist()}) + "\n")
+        out = tmp_path / "clusters.json"
+        rc = main(["--mode", "cluster", "--cluster-input", str(inp),
+                   "--cluster-k", "2", "--cluster-output", str(out),
+                   "--storage-root", str(tmp_path / "store")])
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert sorted(summary["cluster_sizes"]) == [32, 32]
