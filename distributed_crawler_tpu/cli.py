@@ -744,25 +744,45 @@ def _run_cluster(cfg: CrawlerConfig, r: ConfigResolver) -> int:
     return 0
 
 
+def _build_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver):
+    """Construct the TPU worker (engine + results sink + config) — split
+    from the serve loop so the wiring is testable."""
+    from .inference.worker import TPUWorker, TPUWorkerConfig
+    from .state.providers import LocalStorageProvider
+
+    bus = _make_bus(r)
+    engine = _make_engine(cfg, r, with_checkpoint=True)
+    # Results sink: the object store when configured (--object-store),
+    # else JSONL under the same storage root the crawler uses.
+    if cfg.object_store_url:
+        from .state.objectstore import (
+            ObjectStorageProvider,
+            make_object_client,
+        )
+
+        provider = ObjectStorageProvider(
+            make_object_client(cfg.object_store_url))
+    else:
+        provider = LocalStorageProvider(cfg.storage_root)
+    return TPUWorker(bus, engine, provider=provider,
+                     cfg=TPUWorkerConfig(
+                         metrics_port=r.get_int(
+                             "observability.metrics_port", 0),
+                         profiler_port=r.get_int(
+                             "observability.profiler_port", 0)))
+
+
 def _run_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
     """The new TPU inference worker mode (SURVEY.md §7.6)."""
-    from .inference.worker import TPUWorker, TPUWorkerConfig
     from .parallel.multihost import initialize_multihost
-    from .state.providers import LocalStorageProvider
 
     # Pod-scale bring-up from DCT_COORDINATOR / DCT_NUM_PROCESSES /
     # DCT_PROCESS_ID env vars; single-host runs are a no-op.
     initialize_multihost()
-    bus = _make_bus(r)
-    engine = _make_engine(cfg, r, with_checkpoint=True)
-    # Results land as JSONL under the same storage root the crawler uses.
-    provider = LocalStorageProvider(cfg.storage_root)
-    worker = TPUWorker(bus, engine, provider=provider,
-                       cfg=TPUWorkerConfig(
-                           metrics_port=r.get_int(
-                               "observability.metrics_port", 0),
-                           profiler_port=r.get_int(
-                               "observability.profiler_port", 0)))
+    worker = _build_tpu_worker(cfg, r)
+    # Pre-compile the (bucket, batch) programs so the first crawl batches
+    # don't pay XLA compile latency mid-stream.
+    worker.engine.warmup()
     worker.start()
     try:
         import time as _time

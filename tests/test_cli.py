@@ -328,3 +328,42 @@ class TestClusterMode:
         assert rc == 0
         summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
         assert sorted(summary["cluster_sizes"]) == [32, 32]
+
+
+class TestTpuWorkerWiring:
+    def _resolver(self, extra=None):
+        from distributed_crawler_tpu.cli import build_parser, resolve_config
+
+        argv = ["--mode", "tpu-worker", "--infer-model", "tiny"]
+        if extra:
+            argv += extra
+        args = build_parser().parse_args(argv)
+        return resolve_config(args, env={})
+
+    def test_object_store_results_sink(self, tmp_path):
+        from distributed_crawler_tpu.cli import _build_tpu_worker
+        from distributed_crawler_tpu.state.objectstore import (
+            ObjectStorageProvider,
+        )
+
+        cfg, r = self._resolver(["--object-store",
+                                 f"file://{tmp_path}/objstore",
+                                 "--storage-root", str(tmp_path / "store")])
+        worker = _build_tpu_worker(cfg, r)
+        try:
+            assert isinstance(worker.provider, ObjectStorageProvider)
+        finally:
+            worker.bus.close()
+
+    def test_local_results_sink_default(self, tmp_path):
+        from distributed_crawler_tpu.cli import _build_tpu_worker
+        from distributed_crawler_tpu.state.providers import (
+            LocalStorageProvider,
+        )
+
+        cfg, r = self._resolver(["--storage-root", str(tmp_path / "store")])
+        worker = _build_tpu_worker(cfg, r)
+        try:
+            assert isinstance(worker.provider, LocalStorageProvider)
+        finally:
+            worker.bus.close()
