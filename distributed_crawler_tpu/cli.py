@@ -277,6 +277,13 @@ def resolve_config(args: argparse.Namespace,
     batch = r.get_int("inference.batch_size", 0)
     if batch:
         cfg.inference.batch_size = batch
+    buckets = r.get_list("inference.bucket_sizes")
+    if buckets:
+        cfg.inference.bucket_sizes = [int(b) for b in buckets]
+    cfg.inference.pretrained_dir = r.get_str(
+        "inference.pretrained_dir", cfg.inference.pretrained_dir)
+    cfg.inference.asr_pretrained_dir = r.get_str(
+        "inference.asr_pretrained_dir", cfg.inference.asr_pretrained_dir)
 
     # Date windows (`main.go:432-471`): date-between wins over time-ago wins
     # over min-post-date.

@@ -202,3 +202,25 @@ class TestDistributedConfig:
             DistributedConfig(max_workers_per_node=0).validate()
         with pytest.raises(ValueError):
             DistributedConfig(heartbeat_interval_s=0).validate()
+
+
+class TestInferenceYamlKeys:
+    def test_bucket_sizes_and_pretrained_from_yaml(self, tmp_path):
+        """inference.* yaml keys reach the resolved config (they drive the
+        engine wiring in all three inference-bearing modes)."""
+        import yaml
+
+        from distributed_crawler_tpu.cli import build_parser, resolve_config
+
+        path = tmp_path / "config.yaml"
+        with open(path, "w") as f:
+            yaml.safe_dump({"inference": {
+                "bucket_sizes": [32, 64],
+                "pretrained_dir": "/models/e5",
+                "asr_pretrained_dir": "/models/whisper"}}, f)
+        args = build_parser().parse_args(["--config", str(path),
+                                          "--urls", "chan"])
+        cfg, _ = resolve_config(args, env={})
+        assert cfg.inference.bucket_sizes == [32, 64]
+        assert cfg.inference.pretrained_dir == "/models/e5"
+        assert cfg.inference.asr_pretrained_dir == "/models/whisper"
