@@ -2,9 +2,19 @@
 //
 // The reference had no race detection at all (SURVEY.md §5.2: no -race, no
 // sanitizers); this build runs the client under TSan/ASan via `make tsan`
-// / `make asan`.  The harness hammers one client from several threads
-// (send/receive/execute interleaved) and exits 0 iff every response parses
-// and the message totals add up.
+// / `make asan`.  Two phases:
+//   1. offline: hammer one client from several threads
+//      (send/receive/execute interleaved);
+//   2. remote: an in-process wire-protocol echo server (C++ sockets) with
+//      a remote-mode client — concurrent senders racing the reader thread
+//      over one TCP connection, the exact interleaving `net.h`'s
+//      Connection must survive.
+// Exits 0 iff every response parses and the totals add up.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
 
 #include <atomic>
 #include <cstdio>
@@ -23,6 +33,143 @@ void dct_client_destroy(void* client);
 
 namespace {
 const char* kSeedConfig = R"({"seed_json": "{\"channels\": [{\"username\": \"stress\", \"title\": \"S\", \"member_count\": 9, \"messages\": [{\"date\": 1, \"content\": {\"@type\": \"messageText\", \"text\": {\"text\": \"x\", \"entities\": []}}}]}]}"})";
+
+// --- minimal wire-protocol echo server (frames: u32 BE length + JSON) ----
+
+bool read_exact(int fd, char* buf, size_t len) {
+  size_t off = 0;
+  while (off < len) {
+    ssize_t n = ::recv(fd, buf + off, len - off, 0);
+    if (n <= 0) return false;
+    off += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool write_all(int fd, const char* buf, size_t len) {
+  size_t off = 0;
+  while (off < len) {
+    ssize_t n = ::send(fd, buf + off, len - off, 0);
+    if (n <= 0) return false;
+    off += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool send_frame(int fd, const std::string& payload) {
+  char header[4];
+  uint32_t n = static_cast<uint32_t>(payload.size());
+  header[0] = static_cast<char>((n >> 24) & 0xff);
+  header[1] = static_cast<char>((n >> 16) & 0xff);
+  header[2] = static_cast<char>((n >> 8) & 0xff);
+  header[3] = static_cast<char>(n & 0xff);
+  return write_all(fd, header, 4) &&
+         write_all(fd, payload.data(), payload.size());
+}
+
+bool recv_frame(int fd, std::string* out) {
+  char header[4];
+  if (!read_exact(fd, header, 4)) return false;
+  uint32_t n = (static_cast<uint32_t>(
+                    static_cast<unsigned char>(header[0])) << 24) |
+               (static_cast<uint32_t>(
+                    static_cast<unsigned char>(header[1])) << 16) |
+               (static_cast<uint32_t>(
+                    static_cast<unsigned char>(header[2])) << 8) |
+               static_cast<uint32_t>(static_cast<unsigned char>(header[3]));
+  out->assign(n, '\0');
+  return n == 0 || read_exact(fd, &(*out)[0], n);
+}
+
+// Serve one connection: ack the handshake, then echo each request back
+// with "echo" stamped in (the @extra survives verbatim inside the JSON).
+void serve_conn(int fd, std::atomic<int>* served) {
+  std::string frame;
+  if (!recv_frame(fd, &frame)) {
+    ::close(fd);
+    return;
+  }
+  send_frame(fd, "{\"@type\":\"handshake_ack\",\"transport_version\":1}");
+  while (recv_frame(fd, &frame)) {
+    // Wrap: {"@type":"echo", ...original fields...}
+    std::string resp = "{\"@type\":\"echo\"," + frame.substr(1);
+    if (!send_frame(fd, resp)) break;
+    served->fetch_add(1);
+  }
+  ::close(fd);
+}
+
+int remote_stress() {
+  int lis = ::socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = 0;
+  if (::bind(lis, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(lis, 4) != 0) {
+    fprintf(stderr, "remote: bind/listen failed\n");
+    return 1;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(lis, reinterpret_cast<sockaddr*>(&addr), &alen);
+  const int port = ntohs(addr.sin_port);
+
+  std::atomic<int> served{0};
+  std::thread acceptor([&] {
+    int fd = ::accept(lis, nullptr, nullptr);
+    if (fd >= 0) serve_conn(fd, &served);
+  });
+
+  char cfg[128];
+  snprintf(cfg, sizeof(cfg), "{\"server_addr\": \"127.0.0.1:%d\"}", port);
+  void* client = dct_client_create(cfg);
+  if (!client) {
+    fprintf(stderr, "remote: client create failed\n");
+    return 1;
+  }
+
+  constexpr int kThreads = 6;
+  constexpr int kIters = 150;
+  std::vector<std::thread> senders;
+  for (int t = 0; t < kThreads; ++t) {
+    senders.emplace_back([&, t] {
+      for (int i = 0; i < kIters; ++i) {
+        char buf[128];
+        snprintf(buf, sizeof(buf),
+                 "{\"@type\":\"ping\",\"@extra\":\"r%d-%d\"}", t, i);
+        dct_client_send(client, buf);
+      }
+    });
+  }
+  std::atomic<int> echoed{0};
+  std::atomic<int> errors{0};
+  std::thread receiver([&] {
+    while (echoed.load() < kThreads * kIters) {
+      const char* out = dct_client_receive(client, 3.0);
+      if (!out) break;
+      if (strstr(out, "\"@type\":\"echo\"") != nullptr &&
+          strstr(out, "\"@extra\"") != nullptr)
+        echoed.fetch_add(1);
+      else if (strstr(out, "handshake_ack") == nullptr)
+        errors.fetch_add(1);
+    }
+  });
+  for (auto& s : senders) s.join();
+  receiver.join();
+  dct_client_destroy(client);
+  ::close(lis);
+  acceptor.join();
+
+  if (errors.load() != 0 || echoed.load() != kThreads * kIters) {
+    fprintf(stderr, "remote: errors=%d echoed=%d (want %d)\n",
+            errors.load(), echoed.load(), kThreads * kIters);
+    return 1;
+  }
+  printf("remote stress ok: %d echoes over one socket, 0 errors\n",
+         echoed.load());
+  return 0;
+}
 }  // namespace
 
 int main() {
@@ -77,5 +224,5 @@ int main() {
     return 1;
   }
   printf("stress ok: %d responses, 0 errors\n", responses.load());
-  return 0;
+  return remote_stress();
 }
