@@ -150,9 +150,18 @@ def _measure(scale_devices: int | None = None,
         float(chained(params, ids, mask, n).sum())
         return time.perf_counter() - t0
 
-    t_short = min(timed(n_short) for _ in range(repeats))
-    t_long = min(timed(n_long) for _ in range(repeats))
-    t_iter = (t_long - t_short) / (n_long - n_short)
+    t_iter = 0.0
+    for _ in range(3):  # scheduler noise can invert the two-point fit
+        t_short = min(timed(n_short) for _ in range(repeats))
+        t_long = min(timed(n_long) for _ in range(repeats))
+        t_iter = (t_long - t_short) / (n_long - n_short)
+        if t_iter > 0:
+            break
+        _log("two-point fit inverted (noise); re-measuring")
+    if t_iter <= 0:
+        raise RuntimeError(
+            f"timing fit stayed non-positive (t_short={t_short:.4f}s, "
+            f"t_long={t_long:.4f}s): host too noisy for a measurement")
     posts_per_sec = batch / t_iter
     _log(f"throughput: {posts_per_sec:.1f} posts/sec (t_iter={t_iter*1e3:.2f}ms)")
 

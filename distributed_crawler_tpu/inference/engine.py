@@ -135,7 +135,7 @@ class InferenceEngine:
         from .checkpoint import latest_step_dir, load_params
 
         path = latest_step_dir(root) or root
-        params = load_params(path)
+        params = _migrate_split_qkv(load_params(path))
         for cand in (os.path.join(root, "labels.json"),
                      os.path.join(path, "labels.json")):
             if os.path.exists(cand):
@@ -254,6 +254,32 @@ def _load_pretrained(cfg: EngineConfig, params, tokenizer):
         except Exception:
             tokenizer = None  # caller falls back to HashingTokenizer
     return ecfg, params, tokenizer
+
+
+def _migrate_split_qkv(params):
+    """Fuse legacy per-projection attention params on checkpoint load.
+
+    Checkpoints written before the fused-QKV encoder carry separate
+    ``attn/{q,k,v}`` trees; the model now expects one ``qkv/kernel``
+    [h, 3, h] + ``qkv/bias`` [3, h].  Stacking on load keeps the
+    'a deployment resumes exactly' guarantee across the layout change."""
+    enc = params.get("params", {}).get("encoder")
+    if not isinstance(enc, dict):
+        return params
+    for name, layer in enc.items():
+        if not name.startswith("layers_") or "attn" not in layer:
+            continue
+        attn = layer["attn"]
+        if "qkv/kernel" in attn or "q" not in attn:
+            continue
+        q, k, v = attn.pop("q"), attn.pop("k"), attn.pop("v")
+        attn["qkv/kernel"] = np.stack(
+            [np.asarray(q["kernel"]), np.asarray(k["kernel"]),
+             np.asarray(v["kernel"])], axis=1)
+        attn["qkv/bias"] = np.stack(
+            [np.asarray(q["bias"]), np.asarray(k["bias"]),
+             np.asarray(v["bias"])], axis=0)
+    return params
 
 
 def _softmax_np(logits: np.ndarray) -> np.ndarray:

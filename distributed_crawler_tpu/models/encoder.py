@@ -12,8 +12,11 @@ crawl stream).  TPU-first choices:
 - no dynamic shapes anywhere — padding masks, not ragged lengths;
 - optional mixture-of-experts MLP (top-1 switch routing) whose expert dim the
   sharding rules place on the tp axis (expert parallelism);
-- parameter names (q/k/v/attn_out/mlp_up/mlp_down/embed) are the contract
-  with `parallel.sharding.ENCODER_PARAM_RULES`.
+- parameter names (qkv/attn_out/mlp_up/mlp_down/embed) are the contract
+  with `parallel.sharding.ENCODER_PARAM_RULES` — a new projection must get
+  a rule there or it silently falls back to replicate-everything.  The
+  attention projection is FUSED: one ``qkv/kernel`` [h, 3, h] GEMM (q/k/v
+  on the middle axis, heads on the last so tp sharding stays head-aligned).
 """
 
 from __future__ import annotations
@@ -79,15 +82,29 @@ class SelfAttention(nn.Module):
     def __call__(self, x, mask):
         cfg = self.cfg
         b, l, _ = x.shape
-        dense = lambda name, feats: nn.Dense(
-            feats, dtype=cfg.adtype, param_dtype=jnp.float32, name=name)
-        q = dense("q", cfg.hidden)(x).reshape(b, l, cfg.n_heads, cfg.head_dim)
-        k = dense("k", cfg.hidden)(x).reshape(b, l, cfg.n_heads, cfg.head_dim)
-        v = dense("v", cfg.hidden)(x).reshape(b, l, cfg.n_heads, cfg.head_dim)
+        # Fused QKV: one [h, 3, h] GEMM instead of three [h, h] GEMMs — at
+        # encoder widths (384-1024) the separate projections underfill the
+        # 128x128 MXU tiles; the kernel keeps q/k/v on a dedicated axis so
+        # tp-sharding the LAST axis stays head-aligned (no projection is
+        # ever split across devices).
+        w = self.param(
+            "qkv/kernel",
+            nn.initializers.variance_scaling(1.0, "fan_in",
+                                             "truncated_normal",
+                                             in_axis=0, out_axis=(1, 2)),
+            (cfg.hidden, 3, cfg.hidden), jnp.float32)
+        bias = self.param("qkv/bias", nn.initializers.zeros,
+                          (3, cfg.hidden), jnp.float32)
+        proj = jnp.einsum("blh,hto->blto", x.astype(cfg.adtype),
+                          w.astype(cfg.adtype)) + bias.astype(cfg.adtype)
+        q = proj[:, :, 0].reshape(b, l, cfg.n_heads, cfg.head_dim)
+        k = proj[:, :, 1].reshape(b, l, cfg.n_heads, cfg.head_dim)
+        v = proj[:, :, 2].reshape(b, l, cfg.n_heads, cfg.head_dim)
         use_flash = {"auto": None, "xla": False, "flash": True}[cfg.attention]
         o = mha(q, k, v, kv_mask=mask, use_flash=use_flash)
         o = o.reshape(b, l, cfg.hidden)
-        return dense("attn_out", cfg.hidden)(o)
+        return nn.Dense(cfg.hidden, dtype=cfg.adtype,
+                        param_dtype=jnp.float32, name="attn_out")(o)
 
 
 class DenseMLP(nn.Module):

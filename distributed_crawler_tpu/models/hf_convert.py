@@ -127,11 +127,18 @@ def convert_roberta_encoder(state: Mapping[str, np.ndarray],
     }
     for i in range(cfg.n_layers):
         base = f"encoder.layer.{i}"
+        # The model's attention projection is FUSED (encoder.py
+        # SelfAttention: one [h, 3, h] kernel); stack HF's separate
+        # query/key/value weights onto the middle axis.
+        q = _dense(state, f"{base}.attention.self.query")
+        k = _dense(state, f"{base}.attention.self.key")
+        v = _dense(state, f"{base}.attention.self.value")
         tree[f"layers_{i}"] = {
             "attn": {
-                "q": _dense(state, f"{base}.attention.self.query"),
-                "k": _dense(state, f"{base}.attention.self.key"),
-                "v": _dense(state, f"{base}.attention.self.value"),
+                "qkv/kernel": np.stack(
+                    [q["kernel"], k["kernel"], v["kernel"]], axis=1),
+                "qkv/bias": np.stack(
+                    [q["bias"], k["bias"], v["bias"]], axis=0),
                 "attn_out": _dense(state, f"{base}.attention.output.dense"),
             },
             "ln_attn": _ln(state, f"{base}.attention.output.LayerNorm"),

@@ -30,6 +30,10 @@ ParamRule = Tuple[str, P]
 
 # Column-parallel: output dim sharded. Row-parallel: input dim sharded.
 ENCODER_PARAM_RULES: List[ParamRule] = [
+    # Fused QKV [h, 3, h]: shard the head (last) axis so every device
+    # holds all three projections for its head slice.
+    (r".*/qkv/kernel$", P(None, None, AXIS_TP)),
+    (r".*/qkv/bias$", P(None, AXIS_TP)),
     (r".*/(q|k|v)/kernel$", P(None, AXIS_TP)),
     (r".*/(q|k|v)/bias$", P(AXIS_TP)),
     (r".*/attn_out/kernel$", P(AXIS_TP, None)),

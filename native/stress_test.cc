@@ -126,6 +126,8 @@ int remote_stress() {
   void* client = dct_client_create(cfg);
   if (!client) {
     fprintf(stderr, "remote: client create failed\n");
+    ::close(lis);     // unblock accept() so the thread is joinable...
+    acceptor.join();  // ...never destroy a joinable std::thread
     return 1;
   }
 

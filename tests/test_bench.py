@@ -24,7 +24,10 @@ def test_encoder_forward_flops_uses_real_config_fields():
 
 @pytest.mark.slow
 def test_measure_smoke_cpu():
-    res = bench._measure(batch=8, seq=8, n_short=1, n_long=3,
+    # _measure itself re-times on an inverted two-point fit and raises if
+    # the host stays too noisy — a raise here still catches the field-drift
+    # regression this smoke exists for (dead child, missing keys).
+    res = bench._measure(batch=8, seq=8, n_short=1, n_long=6,
                          latency_samples=2)
     assert res["metric"] == "embed_classify_posts_per_sec"
     assert res["value"] > 0

@@ -234,3 +234,34 @@ class TestTrainHeadCli:
             latest_step_dir,
         )
         assert latest_step_dir(ckpt).endswith("step_2")
+
+
+class TestLegacyCheckpointMigration:
+    def test_split_qkv_checkpoint_loads_into_fused_engine(self, tmp_path):
+        """Checkpoints written by the pre-fusion encoder (separate attn
+        q/k/v trees) still restore: the engine fuses them on load."""
+        import jax
+        import numpy as np
+
+        from distributed_crawler_tpu.inference.checkpoint import save_params
+
+        engine = _tiny_engine()
+        # Rewrite the modern params into the LEGACY split layout.
+        params = jax.tree_util.tree_map(np.asarray, engine.params)
+        for name, layer in params["params"]["encoder"].items():
+            if not name.startswith("layers_"):
+                continue
+            attn = layer["attn"]
+            fused_k = attn.pop("qkv/kernel")
+            fused_b = attn.pop("qkv/bias")
+            for i, proj in enumerate(("q", "k", "v")):
+                attn[proj] = {"kernel": fused_k[:, i, :],
+                              "bias": fused_b[i]}
+        root = str(tmp_path / "legacy")
+        save_params(root + "/step_1", params)
+
+        eng2 = _tiny_engine(checkpoint_dir=root)
+        out_new = eng2.run(["hello world"])
+        out_ref = engine.run(["hello world"])
+        assert np.allclose(out_new[0]["scores"], out_ref[0]["scores"],
+                           atol=1e-5)
