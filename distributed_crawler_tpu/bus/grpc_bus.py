@@ -184,7 +184,7 @@ class GrpcBusServer:
                                 self._stop.wait(min(0.05 * (2 ** attempt),
                                                     0.5))
                     if not delivered:
-                        self.dead_letters += 1
+                        self._count_dead_letter()
                         logger.error(
                             "dead-lettering local delivery on %s after %d "
                             "attempts", topic, self.max_attempts)
@@ -211,11 +211,17 @@ class GrpcBusServer:
             for topic, tq in topics:
                 self._sweep_expired(topic, tq)
 
+    def _count_dead_letter(self) -> None:
+        # Called from pull-stream threads, the sweeper, and local dispatch
+        # threads concurrently — += on an int is not atomic.
+        with self._lock:
+            self.dead_letters += 1
+
     def _requeue_or_drop(self, topic: str, tq: _TopicQueue,
                          delivery_id: str, inf: _Inflight) -> None:
         """inf has been removed from the inflight map by the caller."""
         if inf.attempts + 1 >= self.max_attempts:
-            self.dead_letters += 1
+            self._count_dead_letter()
             logger.error(
                 "dead-lettering frame on %s after %d attempts (id=%s)",
                 topic, inf.attempts + 1, delivery_id)
