@@ -303,3 +303,26 @@ class TestWalkbackProcessing:
         # Batch completed; leftover edges are orphans swept at startup.
         assert sm.count_incomplete_batches("c1") == 0
         assert sm.recover_orphan_edges() == 1
+
+
+class TestValidatorTransportConfig:
+    def test_bogus_transport_rejected_at_construction(self, tmp_path):
+        """cfg.validator_transport reaches make_transport — a bad value
+        fails fast when the loop is built, not on the first request."""
+        import pytest as _pytest
+
+        from distributed_crawler_tpu.config import CrawlerConfig
+        from distributed_crawler_tpu.crawl.validator import RunValidationLoop
+
+        cfg = CrawlerConfig()
+        cfg.validator_transport = "carrier-pigeon"
+        with _pytest.raises(ValueError, match="unknown validator transport"):
+            RunValidationLoop(sm=None, cfg=cfg)
+
+    def test_default_transport_urllib(self):
+        from distributed_crawler_tpu.config import CrawlerConfig
+        from distributed_crawler_tpu.crawl.validator import RunValidationLoop
+
+        cfg = CrawlerConfig()
+        loop = RunValidationLoop(sm=None, cfg=cfg)
+        assert loop.validate_fn is not None
