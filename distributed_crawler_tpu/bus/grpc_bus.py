@@ -338,7 +338,11 @@ class GrpcBusServer:
         logger.info("bus server listening on %s", self.address)
 
     def close(self, grace: float = 0.5) -> None:
-        self._server.stop(grace)  # stop accepting new publishes first
+        # stop() returns immediately; in-flight Publish RPCs keep running
+        # for up to `grace`.  Wait for full termination BEFORE setting
+        # _stop, or a dispatch thread could exit on an empty queue while an
+        # in-flight RPC is about to enqueue a frame we already acked b"ok".
+        self._server.stop(grace).wait(grace + 5.0)
         self._stop.set()          # dispatch loops drain, then exit
         if not self.flush_local(timeout_s=max(grace, 5.0)):
             with self._local_idle:

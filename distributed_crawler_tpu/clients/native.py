@@ -566,7 +566,29 @@ def acquire_seed_db(source: str, base_dir: str, conn_id: str) -> str:
                             dirs_exist_ok=True)
         elif source.endswith((".tar.gz", ".tgz", ".tar")):
             with tarfile.open(source, "r:*") as tar:
-                tar.extractall(staging, filter="data")
+                try:
+                    tar.extractall(staging, filter="data")
+                except TypeError:
+                    # Python <3.10.12/<3.11.4 lack the filter kwarg
+                    # backport; fall back after rejecting absolute or
+                    # traversal paths — and link members entirely, since a
+                    # symlink/hardlink could point outside the staging dir
+                    # (seed DB tarballs never legitimately contain links).
+                    members = tar.getmembers()
+                    for m in members:
+                        p = m.name
+                        if p.startswith(("/", "..")) or "/../" in p:
+                            raise NativeClientError(
+                                400, f"unsafe path in seed tarball: {p}")
+                        if m.issym() or m.islnk():
+                            raise NativeClientError(
+                                400, f"link member in seed tarball: {p}")
+                        if not (m.isfile() or m.isdir()):
+                            # FIFOs/devices — filter="data" raises
+                            # SpecialFileError for these; match it.
+                            raise NativeClientError(
+                                400, f"special member in seed tarball: {p}")
+                    tar.extractall(staging, members=members)
         elif source.endswith(".json"):
             shutil.copyfile(source, os.path.join(staging, "seed.json"))
         else:

@@ -192,9 +192,14 @@ class YouTubeCrawler(Crawler):
         target = dataclasses.replace(target,
                                      id=youtube_channel_id(target.id))
         channel = self.client.get_channel_info(target.id)
-        url = _channel_url(target.id)
+        # Identity is the canonical UC… id the API resolved, not the seed's
+        # @handle/user-Name form — otherwise the same channel discovered
+        # later via its UC id gets a second identity and the built
+        # /channel/<id> URL is a non-existent shape for handles.
+        canonical_id = channel.id or target.id
+        url = _channel_url(canonical_id)
         return ChannelData(
-            channel_id=target.id,
+            channel_id=canonical_id,
             channel_name=channel.title,
             channel_description=channel.description,
             channel_url=url,

@@ -9,8 +9,10 @@ detection, and the in-memory discovered-channels set.
 from __future__ import annotations
 
 import dataclasses
+import errno
 import logging
 import os
+import shutil
 import threading
 from datetime import datetime
 from typing import Any, Dict, List, Optional, Tuple
@@ -347,5 +349,19 @@ class BaseStateManager(StateManager):
                                 "combined", crawl)
         os.makedirs(dest_dir, exist_ok=True)
         dest = os.path.join(dest_dir, os.path.basename(filename))
-        if os.path.abspath(dest) != os.path.abspath(filename):
-            os.replace(filename, dest)
+        if os.path.abspath(dest) == os.path.abspath(filename):
+            return
+        try:
+            os.replace(filename, dest)  # same-fs: one atomic rename
+        except OSError as e:
+            if e.errno != errno.EXDEV:
+                raise
+            # The chunker's write dir (often /tmp) and storage_root may be
+            # different filesystems.  Keep the all-or-nothing contract:
+            # copy to a same-fs temp name, atomically publish, THEN drop
+            # the source — a crash mid-copy never leaves a truncated file
+            # under the final name.
+            tmp = dest + ".tmp"
+            shutil.copy2(filename, tmp)
+            os.replace(tmp, dest)
+            os.unlink(filename)

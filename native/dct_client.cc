@@ -955,6 +955,9 @@ std::string https_get_impl(const std::string& config_json) {
   if (loc != std::string::npos) {
     size_t vstart = loc + 11;
     size_t vend = head_lower.find("\r\n", vstart);
+    // Location as the LAST header has no trailing CRLF inside head_lower;
+    // clamp to the header block so the substr never swallows the body.
+    if (vend == std::string::npos) vend = header_end;
     std::string value = data.substr(vstart, vend - vstart);
     value.erase(0, value.find_first_not_of(" \t"));
     out["location"] = Value(value);

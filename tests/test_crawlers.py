@@ -136,10 +136,14 @@ class TestYouTubeCrawler:
 
     def test_username_channel_url(self, tmp_path):
         c = self._crawler(tmp_path)
-        # Handles resolve via the Data API's forHandle selector.
+        # Handles resolve via the Data API's forHandle selector.  The
+        # emitted identity/URL is the CANONICAL UC… id the API resolved —
+        # not the seed's @handle form — so a channel seeded by handle and
+        # later discovered by UC id dedups to one record.
         c.client.transport.add_channel("UC_h1", title="H", handle="@handle")
         data = c.get_channel_info(CrawlTarget(id="@handle", type="youtube"))
-        assert data.channel_url == "https://www.youtube.com/@handle"
+        assert data.channel_id == "UC_h1"
+        assert data.channel_url == "https://www.youtube.com/channel/UC_h1"
 
     def test_channel_crawl_converts_and_stores(self, tmp_path):
         c = self._crawler(tmp_path)

@@ -391,6 +391,77 @@ class TestSeedDbAcquisition:
             acquire_seed_db("https://example.com/dbs.tgz",
                             str(tmp_path), "c1")
 
+    def test_extract_without_filter_kwarg(self, tmp_path, monkeypatch):
+        """Pythons without the `filter=` backport (<3.10.12/<3.11.4) still
+        extract — via the manual path-safety fallback."""
+        import tarfile as tarfile_mod
+
+        from distributed_crawler_tpu.clients.native import acquire_seed_db
+
+        orig = tarfile_mod.TarFile.extractall
+
+        def no_filter(self, path=".", members=None, **kw):
+            if "filter" in kw:
+                raise TypeError("extractall() got an unexpected keyword "
+                                "argument 'filter'")
+            return orig(self, path=path, members=members)
+
+        monkeypatch.setattr(tarfile_mod.TarFile, "extractall", no_filter)
+        tar = self._tarball(tmp_path)
+        seed = acquire_seed_db(tar, str(tmp_path / "dbs"), "conn-old-py")
+        assert json.loads(open(seed).read())["channels"][0][
+            "username"] == "wirechan"
+
+    def test_traversal_tarball_rejected_without_filter(self, tmp_path,
+                                                       monkeypatch):
+        import tarfile as tarfile_mod
+
+        from distributed_crawler_tpu.clients.native import (
+            NativeClientError,
+            acquire_seed_db,
+        )
+
+        def no_filter(self, path=".", members=None, **kw):
+            if "filter" in kw:
+                raise TypeError("no filter kwarg")
+            raise AssertionError("unsafe tarball must not be extracted")
+
+        monkeypatch.setattr(tarfile_mod.TarFile, "extractall", no_filter)
+        evil = tmp_path / "evil.tar.gz"
+        (tmp_path / "payload").write_text("x")
+        with tarfile_mod.open(evil, "w:gz") as tar:
+            tar.add(tmp_path / "payload", arcname="../escape.json")
+        with pytest.raises(NativeClientError, match="unsafe path"):
+            acquire_seed_db(str(evil), str(tmp_path / "dbs"), "conn-evil")
+
+    def test_symlink_tarball_rejected_without_filter(self, tmp_path,
+                                                     monkeypatch):
+        """Symlink members can escape the staging dir on Pythons without
+        `filter=`; the fallback refuses them outright."""
+        import tarfile as tarfile_mod
+
+        from distributed_crawler_tpu.clients.native import (
+            NativeClientError,
+            acquire_seed_db,
+        )
+
+        orig = tarfile_mod.TarFile.extractall
+
+        def no_filter(self, path=".", members=None, **kw):
+            if "filter" in kw:
+                raise TypeError("no filter kwarg")
+            return orig(self, path=path, members=members)
+
+        monkeypatch.setattr(tarfile_mod.TarFile, "extractall", no_filter)
+        evil = tmp_path / "links.tar.gz"
+        with tarfile_mod.open(evil, "w:gz") as tar:
+            link = tarfile_mod.TarInfo("db")
+            link.type = tarfile_mod.SYMTYPE
+            link.linkname = "/"
+            tar.addfile(link)
+        with pytest.raises(NativeClientError, match="link member"):
+            acquire_seed_db(str(evil), str(tmp_path / "dbs"), "conn-sym")
+
 
 @pytest.mark.skipif(shutil.which("openssl") is None,
                     reason="openssl binary needed to mint the test cert")
@@ -450,6 +521,51 @@ class TestHttpEdgeCases:
             assert status == 200
             assert body.decode() == html  # no chunk-size lines embedded
             assert parse_channel_html(body.decode()).status == "valid"
+        finally:
+            srv.shutdown()
+
+    def test_redirect_location_last_header_with_body(self, tmp_path):
+        """Location as the FINAL header of a redirect that also carries a
+        body: the extracted value must stop at the header block, not
+        swallow the blank line + body into the redirect URL."""
+        import http.server
+
+        html = ('<html><head><title>Telegram: View @wirechan</title>'
+                '</head><body>ok</body></html>')
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path == "/wirechan":
+                    stub = b"<html>moved</html>"
+                    self.send_response(301)
+                    self.send_header("Content-Length", str(len(stub)))
+                    self.send_header("Location", "/s/wirechan")  # last header
+                    self.end_headers()
+                    self.wfile.write(stub)
+                    return
+                if self.path != "/s/wirechan":
+                    self.send_error(404)
+                    return
+                body = html.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        from distributed_crawler_tpu.clients.http_validator import (
+            chrome_transport,
+        )
+
+        srv = self._serve(tmp_path, Handler)
+        try:
+            status, body = chrome_transport(
+                f"https://127.0.0.1:{srv.server_address[1]}/wirechan",
+                {}, tls_insecure=True)
+            assert status == 200
+            assert b"View @wirechan" in body
         finally:
             srv.shutdown()
 

@@ -113,6 +113,34 @@ class TestBaseStateManager:
         assert not found
 
 
+class TestCombinedUploadLocalFallback:
+    def test_cross_filesystem_move(self, tmp_path, monkeypatch):
+        """The local fallback survives the chunker write dir and
+        storage_root living on different filesystems (rename(2) EXDEV)."""
+        import errno
+        import os as os_mod
+
+        sm = BaseStateManager(cfg(storage_root=str(tmp_path / "store")))
+        src = tmp_path / "combine" / "combined_1.jsonl"
+        src.parent.mkdir()
+        src.write_text('{"row": 1}\n')
+
+        real_replace = os_mod.replace
+
+        def exdev_replace(a, b, *aa, **kw):
+            # Only the direct src→dest rename crosses the "filesystem"
+            # boundary; the fallback's same-fs tmp→dest publish must work.
+            if str(a).startswith(str(tmp_path / "combine")):
+                raise OSError(errno.EXDEV, "Invalid cross-device link")
+            return real_replace(a, b, *aa, **kw)
+
+        monkeypatch.setattr(os_mod, "replace", exdev_replace)
+        sm.upload_combined_file(str(src))
+        dest = tmp_path / "store" / "combined" / "e1" / "combined_1.jsonl"
+        assert dest.read_text() == '{"row": 1}\n'
+        assert not src.exists()  # chunker contract: source consumed
+
+
 class TestLocalStateManager:
     def _sm(self, tmp_path, **kw):
         return LocalStateManager(cfg(local=LocalConfig(base_path=str(tmp_path)), **kw))
