@@ -117,6 +117,9 @@ def build_parser() -> argparse.ArgumentParser:
       help="enable the TPU inference stage")
     a("--infer-model", default=None, help="model registry key")
     a("--infer-batch-size", type=int, default=None)
+    a("--infer-param-dtype", default=None,
+      help="cast float params at engine startup (e.g. bfloat16) — halves "
+           "weight HBM traffic when serving; empty keeps the f32 layout")
     # Classifier fine-tune (mode=train-head): crawl JSONL + labels ->
     # orbax checkpoint the engine reloads via --head-checkpoint.
     a("--train-posts", default=None,
@@ -201,6 +204,7 @@ _KEY_MAP = {
     "infer": "inference.enabled",
     "infer_model": "inference.model",
     "infer_batch_size": "inference.batch_size",
+    "infer_param_dtype": "inference.param_dtype",
     "train_posts": "train.posts_file",
     "train_labels": "train.labels_file",
     "head_checkpoint": "train.checkpoint_dir",
@@ -280,6 +284,7 @@ def resolve_config(args: argparse.Namespace,
     buckets = r.get_list("inference.bucket_sizes")
     if buckets:
         cfg.inference.bucket_sizes = [int(b) for b in buckets]
+    cfg.inference.param_dtype = r.get_str("inference.param_dtype", "")
     cfg.inference.pretrained_dir = r.get_str(
         "inference.pretrained_dir", cfg.inference.pretrained_dir)
     cfg.inference.asr_pretrained_dir = r.get_str(
@@ -593,7 +598,7 @@ def _run_train_head(cfg: CrawlerConfig, r: ConfigResolver) -> int:
     n_labels = (len(vocab) if vocab is not None
                 else max(lbl for _, lbl in pairs) + 1)
 
-    engine = _make_engine(cfg, r, n_labels=n_labels)
+    engine = _make_engine(cfg, r, n_labels=n_labels, cast_params=False)
 
     token_lists = engine.tokenizer.encode_batch(
         [texts[uid] for uid, _ in pairs])
@@ -639,15 +644,22 @@ def _run_train_head(cfg: CrawlerConfig, r: ConfigResolver) -> int:
 
 def _make_engine(cfg: CrawlerConfig, r: ConfigResolver,
                  n_labels: Optional[int] = None,
-                 with_checkpoint: bool = False):
-    """One engine-wiring path for tpu-worker / train-head / cluster."""
+                 with_checkpoint: bool = False,
+                 cast_params: bool = True):
+    """One engine-wiring path for tpu-worker / train-head / cluster.
+
+    ``cast_params=False`` keeps the f32 layout regardless of
+    ``inference.param_dtype`` — train-head must fine-tune on (and persist)
+    full-precision weights even when the same config file serves bf16."""
     from .inference.engine import EngineConfig, InferenceEngine
 
     kw = dict(
         model=cfg.inference.embed_model.replace("-", "_"),
         batch_size=cfg.inference.batch_size,
         buckets=tuple(cfg.inference.bucket_sizes),
-        pretrained_dir=cfg.inference.pretrained_dir or None)
+        pretrained_dir=cfg.inference.pretrained_dir or None,
+        param_dtype=(cfg.inference.param_dtype or None)
+        if cast_params else None)
     if n_labels is not None:
         kw["n_labels"] = n_labels
     if with_checkpoint:

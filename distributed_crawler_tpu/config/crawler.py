@@ -57,6 +57,9 @@ class InferenceConfig:
     mesh_shape: Optional[List[int]] = None  # None -> all devices on one data axis
     mesh_axes: List[str] = field(default_factory=lambda: ["data"])
     dtype: str = "bfloat16"
+    # Serving-time parameter cast ("" keeps f32; "bfloat16" halves weight
+    # HBM traffic — see EngineConfig.param_dtype).
+    param_dtype: str = ""
     # Local HF checkpoint dirs (real weights + vocab; offline only).  Empty
     # string -> registry config with random init + hashing tokenizer.
     pretrained_dir: str = ""

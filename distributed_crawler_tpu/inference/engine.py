@@ -53,6 +53,11 @@ class EngineConfig:
     # otherwise starts from, closing the crawl→train→serve loop.  Points at
     # either a step_N directory or a root containing them (latest wins).
     checkpoint_dir: Optional[str] = None
+    # Inference-time parameter dtype. None keeps params as loaded (f32 —
+    # the training layout); "bfloat16" casts float params once at startup,
+    # halving weight HBM traffic per step.  Serving-only: never persist
+    # bf16-cast params back into a training checkpoint.
+    param_dtype: Optional[str] = None
 
     def encoder_config(self) -> EncoderConfig:
         try:
@@ -120,6 +125,14 @@ class InferenceEngine:
             ids = jnp.zeros((1, probe), jnp.int32)
             mask = jnp.ones((1, probe), jnp.bool_)
             params = self.model.init(jax.random.PRNGKey(cfg.seed), ids, mask)
+        if cfg.param_dtype:
+            import jax.numpy as jnp
+
+            target = jnp.dtype(cfg.param_dtype)
+            params = jax.tree.map(
+                lambda x: x.astype(target)
+                if hasattr(x, "dtype") and x.dtype == jnp.float32 else x,
+                params)
         if mesh is not None:
             from ..parallel.sharding import shard_params
 

@@ -59,7 +59,7 @@ def _flash_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, *, scale):
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
-    mask = mask_ref[:]  # [1, L] bool, broadcasts over q rows
+    mask = mask_ref[0] != 0  # [1, L], broadcasts over q rows
     s = jnp.where(mask, s, _NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
@@ -95,11 +95,16 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
     grid = (b * h, l // block_q)
 
+    # Mask rides as [B, 1, L] int32: TPU lowering requires a block's last
+    # two dims be (8-divisible, 128-divisible) OR equal to the array dims —
+    # a [B, L] block of (1, L) satisfies neither for the leading dim.
+    mask_i32 = kv_mask.astype(jnp.int32)[:, None, :]
+
     out = pl.pallas_call(
         functools.partial(_flash_kernel, scale=scale),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, l), lambda i, j: (i // h, 0)),       # mask [B, L]
+            pl.BlockSpec((1, 1, l), lambda i, j: (i // h, 0, 0)),   # mask
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),  # q
             pl.BlockSpec((1, l, d), lambda i, j: (i, 0, 0)),        # k
             pl.BlockSpec((1, l, d), lambda i, j: (i, 0, 0)),        # v
@@ -107,7 +112,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, l, d), q.dtype),
         interpret=interpret,
-    )(kv_mask, qb, kb, vb)
+    )(mask_i32, qb, kb, vb)
     return out.reshape(b, h, l, d).transpose(0, 2, 1, 3)
 
 

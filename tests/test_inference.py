@@ -109,6 +109,23 @@ class TestInferenceEngine:
             InferenceEngine(EngineConfig(model="nope"),
                             registry=MetricsRegistry())
 
+    def test_param_dtype_cast_matches_f32(self):
+        """param_dtype='bfloat16' halves weight bytes without changing
+        predictions meaningfully (serving-time cast, engine.py)."""
+        import jax
+        import jax.numpy as jnp
+
+        f32 = _engine()
+        bf16 = _engine(param_dtype="bfloat16")
+        leaves = jax.tree.leaves(bf16.params)
+        assert all(leaf.dtype != jnp.float32 for leaf in leaves)
+        texts = ["hello world", "a longer piece of text " * 2]
+        out32, out16 = f32.run(texts), bf16.run(texts)
+        for a, b in zip(out32, out16):
+            np.testing.assert_allclose(a["embedding"], b["embedding"],
+                                       atol=0.05)
+            assert a["label"] == b["label"]
+
     def test_mesh_sharded_run(self):
         from distributed_crawler_tpu.parallel import best_mesh_config, make_mesh
 

@@ -161,6 +161,45 @@ class TestTrainHeadCli:
         acc = np.mean([r["label"] == y for r, y in zip(out, held_labels)])
         assert acc >= 0.8, f"reloaded engine accuracy {acc}"
 
+    def test_param_dtype_config_never_degrades_checkpoint(self, tmp_path,
+                                                          capsys):
+        """A config that serves bf16 (`--infer-param-dtype bfloat16`) must
+        NOT make train-head fine-tune on — or persist — bf16-cast weights:
+        the saved checkpoint stays f32."""
+        import jax
+        import jax.numpy as jnp
+
+        from distributed_crawler_tpu.cli import main
+        from distributed_crawler_tpu.inference.checkpoint import (
+            latest_step_dir,
+            load_params,
+        )
+
+        texts, labels = _dataset()
+        posts = tmp_path / "posts.jsonl"
+        with open(posts, "w", encoding="utf-8") as f:
+            for i, text in enumerate(texts):
+                f.write(json.dumps({"post_uid": f"p{i}", "all_text": text})
+                        + "\n")
+        labels_file = tmp_path / "labels.jsonl"
+        with open(labels_file, "w", encoding="utf-8") as f:
+            for i, y in enumerate(labels):
+                f.write(json.dumps({"post_uid": f"p{i}", "label": int(y)})
+                        + "\n")
+        ckpt = str(tmp_path / "ckpt")
+        rc = main(["--mode", "train-head", "--infer-model", "tiny",
+                   "--infer-param-dtype", "bfloat16",
+                   "--train-posts", str(posts),
+                   "--train-labels", str(labels_file),
+                   "--head-checkpoint", ckpt,
+                   "--train-epochs", "2",
+                   "--storage-root", str(tmp_path / "store")])
+        assert rc == 0
+        saved = load_params(latest_step_dir(ckpt) or ckpt)
+        dtypes = {leaf.dtype for leaf in jax.tree.leaves(saved)
+                  if hasattr(leaf, "dtype")}
+        assert jnp.bfloat16 not in dtypes, dtypes
+
     def test_mixed_label_kinds_rejected(self, tmp_path, capsys):
         from distributed_crawler_tpu.cli import main
 
