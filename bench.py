@@ -65,6 +65,36 @@ def _log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+# The TPU behind the tunnel wedges intermittently (a bare matmul can hang
+# minutes, then recover).  Every successful TPU measurement is cached here
+# so a run that samples a wedged window still carries the most recent REAL
+# TPU number — clearly labelled as a prior measurement, never as the live
+# headline.
+TPU_CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "bench_tpu_cache.json")
+
+
+def _cache_tpu_result(result: dict) -> None:
+    if result.get("platform") != "tpu":
+        return
+    try:
+        entry = dict(result)
+        entry["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                             time.gmtime())
+        with open(TPU_CACHE_PATH, "w", encoding="utf-8") as f:
+            json.dump(entry, f)
+    except OSError as exc:
+        _log(f"could not write TPU cache: {exc}")
+
+
+def _load_tpu_cache() -> dict | None:
+    try:
+        with open(TPU_CACHE_PATH, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
 def _encoder_forward_flops(cfg, batch: int, seq: int) -> float:
     """Analytic forward FLOPs for one embed+classify batch.
 
@@ -342,6 +372,11 @@ def main() -> None:
             result["platform"] = "cpu"
             result["mfu"] = None
             result["wedge_diagnostic"] = wedge or err
+            cached = _load_tpu_cache()
+            if cached is not None:
+                # A prior successful TPU run from this environment; the
+                # live headline above stays the CPU fallback.
+                result["last_measured_tpu"] = cached
         else:
             err = f"{wedge or err}; cpu fallback: {cerr}"
 
@@ -355,6 +390,7 @@ def main() -> None:
         }))
         return
 
+    _cache_tpu_result(result)
     _log("measuring dp scaling on virtual CPU mesh")
     eff = _dp_scaling()
     # Explicitly CPU-virtual: 8 "devices" share one host's cores, so this

@@ -423,6 +423,13 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
     except KeyboardInterrupt:
         logger.info("interrupted, shutting down")
         return 130
+    finally:
+        # A pool installed by setup_pool_from_config is process-wide; for
+        # in-process callers running main() repeatedly (tests, embedders)
+        # it must be torn down here or the next run would silently crawl
+        # the previous run's databases.
+        from .crawl import shutdown_connection_pool
+        shutdown_connection_pool()
     return 0
 
 
@@ -432,7 +439,11 @@ def _maybe_bridge(sm, cfg: CrawlerConfig, r: ConfigResolver):
     The bridge publishes over the gRPC bus when --bus-address is set (a
     separate tpu-worker process consumes), else in-process."""
     if not cfg.inference.enabled:
-        return sm, (lambda: None)
+        # The closer owns the final sm.close() either way: modes receiving a
+        # prebuilt sm never close it themselves (owns_sm=False), so without
+        # this the completed-status metadata written after the last layer
+        # would never be flushed to disk.
+        return sm, sm.close
     from .inference.bridge import InferenceBridge
     bus = _make_bus(r)
     bridge = InferenceBridge(sm, bus, crawl_id=cfg.crawl_id,
@@ -504,6 +515,9 @@ def _run_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
         from .modes.youtube_random import initialize_youtube_crawler_components
         youtube_crawler, _yt_client = \
             initialize_youtube_crawler_components(sm, cfg)
+    else:
+        from .crawl import setup_pool_from_config
+        setup_pool_from_config(cfg)  # `worker.go:96-133` pool init
     worker = CrawlWorker(worker_id, cfg, bus, sm,
                          youtube_crawler=youtube_crawler)
     worker.start()

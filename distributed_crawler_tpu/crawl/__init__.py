@@ -25,6 +25,7 @@ from .runner import (
     run_for_channel,
     run_for_channel_with_pool,
     set_run_for_channel_fn,
+    setup_pool_from_config,
     shutdown_connection_pool,
 )
 from .validator import BlockedState, RunValidationLoop, ValidatorConfig
@@ -38,6 +39,7 @@ __all__ = [
     "pick_walkback_channel",
     "init_connection_pool",
     "get_connection_from_pool",
+    "setup_pool_from_config",
     "shutdown_connection_pool",
     "set_run_for_channel_fn",
     "handle_400_replacement",

@@ -59,6 +59,9 @@ MAX_WALKBACK_ATTEMPTS = 25
 
 _pool: Optional[ConnectionPool] = None
 _pool_lock = threading.Lock()
+# Serializes setup_pool_from_config: without it two concurrent entry paths
+# could both build pools and the loser's native clients would leak unclosed.
+_setup_lock = threading.Lock()
 
 
 def init_connection_pool(pool: ConnectionPool) -> None:
@@ -67,6 +70,58 @@ def init_connection_pool(pool: ConnectionPool) -> None:
     with _pool_lock:
         if _pool is None:
             _pool = pool
+
+
+def setup_pool_from_config(cfg: CrawlerConfig) -> bool:
+    """Build + install the process-wide pool from config — the production
+    analog of `crawl.InitConnectionPool` called by every telegram entry
+    path in the reference (`standalone/runner.go:478`, `worker.go:96-133`,
+    `dapr/job.go:616-659`).
+
+    One connection per entry of ``tdlib_database_urls`` (fallback: the
+    single ``tdlib_database_url``); each connection seeds the native client
+    from its own extracted copy of the URL's tarball/JSON
+    (`telegramhelper/client.go:232-260`).  No-op when a pool is already
+    installed (tests and embedders install their own) or when no URLs are
+    configured (YouTube runs and hermetic tests need none).  Returns True
+    when a pool with at least one live connection is installed.
+    """
+    import os
+
+    with _setup_lock:
+        with _pool_lock:
+            if _pool is not None:
+                # Process-wide pool, first installer wins (the reference's
+                # global pool has the same contract, `runner.go:287-306`).
+                return True
+        urls = list(cfg.tdlib_database_urls) or (
+            [cfg.tdlib_database_url] if cfg.tdlib_database_url else [])
+        if not urls:
+            return False
+        from ..clients.native import native_client_factory
+
+        base_dir = os.path.join(cfg.storage_root or ".",
+                                ".tdlib", "databases")
+        factories = [native_client_factory(db_source=u, db_base_dir=base_dir)
+                     for u in urls]
+
+        def make(conn_id: str) -> TelegramClient:
+            # conn ids are "conn_<i>" (pool.initialize / recreate keep them
+            # stable), so each connection deterministically maps to its URL.
+            try:
+                idx = int(conn_id.rsplit("_", 1)[-1])
+            except ValueError:
+                idx = 0
+            return factories[idx % len(factories)](conn_id)
+
+        pool = ConnectionPool(make, database_urls=urls,
+                              rate_limit=cfg.rate_limit)
+        if pool.initialize() == 0:
+            raise PoolEmptyError(
+                f"no connections could be created from {len(urls)} "
+                f"tdlib database url(s)")
+        init_connection_pool(pool)
+        return True
 
 
 def get_connection_from_pool(timeout_s: float = 30.0) -> PooledConnection:

@@ -170,6 +170,10 @@ def launch(seed_urls: List[str], cfg: CrawlerConfig, sm=None,
                           trigger_size=cfg.combine_trigger_size,
                           hard_cap=cfg.combine_hard_cap)
 
+    if cfg.platform == "telegram" and not cfg.validate_only:
+        from ..crawl import setup_pool_from_config
+        setup_pool_from_config(cfg)  # no-op if a pool is already installed
+
     if chunker is not None:
         chunker.start()
     try:

@@ -99,6 +99,10 @@ def start_standalone_mode(seed_urls: List[str], cfg: CrawlerConfig,
         crawl_exec_id, is_resuming = cfg.crawl_id, False
     sm.initialize(seed_urls)
 
+    if cfg.platform == "telegram":
+        from ..crawl import setup_pool_from_config
+        setup_pool_from_config(cfg)  # `standalone/runner.go:478`
+
     owns_yt_pool = False
     if cfg.platform == "youtube" and yt_pool is None:
         from .runner import make_yt_pool

@@ -149,6 +149,13 @@ class Store {
         // Public message ids shift by 2^20 (telegramhelper/tdutils.go:1005).
         auto_msg_id += (1 << 20);
         sm.id = m.get("id").as_int(auto_msg_id);
+        // Hand-written seeds often number messages 1, 2, 3…; real TDLib
+        // channel ids are always n·2^20, and the crawl engine estimates a
+        // channel's post count as max_id >> 20 — a raw small id would read
+        // as zero posts and deadend the channel.  Normalize into the
+        // public form (reply/thread references below get the same shift so
+        // intra-seed message links stay consistent).
+        if (sm.id > 0 && sm.id < (1 << 20)) sm.id <<= 20;
         sm.chat_id = c.chat_id;
         sm.date = m.get("date").as_int();
         sm.content = m.get("content");
@@ -158,6 +165,10 @@ class Store {
         sm.reactions = m.get("reactions").as_object();
         sm.message_thread_id = m.get("message_thread_id").as_int();
         sm.reply_to_message_id = m.get("reply_to_message_id").as_int();
+        if (sm.message_thread_id > 0 && sm.message_thread_id < (1 << 20))
+          sm.message_thread_id <<= 20;
+        if (sm.reply_to_message_id > 0 && sm.reply_to_message_id < (1 << 20))
+          sm.reply_to_message_id <<= 20;
         sm.sender_id = m.get("sender_id").as_int();
         sm.sender_username = m.get("sender_username").as_string();
         c.messages.push_back(std::move(sm));

@@ -52,3 +52,20 @@ def test_probe_subprocess_emits_json():
     got = json.loads(proc.stdout.strip().splitlines()[-1])
     assert got["ok"] is True
     assert got["platform"] == "cpu"
+
+
+def test_tpu_cache_roundtrip(tmp_path, monkeypatch):
+    """A successful TPU result is cached; a CPU result never overwrites it
+    (the cache exists so a wedged-chip run still carries the last REAL TPU
+    number, clearly labelled)."""
+    monkeypatch.setattr(bench, "TPU_CACHE_PATH",
+                        str(tmp_path / "cache.json"))
+    bench._cache_tpu_result({"platform": "cpu", "value": 1.0})
+    assert bench._load_tpu_cache() is None
+    bench._cache_tpu_result({"platform": "tpu", "value": 9000.0,
+                             "metric": "embed_classify_posts_per_sec"})
+    cached = bench._load_tpu_cache()
+    assert cached["value"] == 9000.0
+    assert "measured_at" in cached
+    bench._cache_tpu_result({"platform": "cpu", "value": 2.0})
+    assert bench._load_tpu_cache()["value"] == 9000.0

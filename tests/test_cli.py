@@ -4,6 +4,9 @@ Reference analogs: main_test.go (sampling validation matrix, time parsing)
 and the viper precedence wiring of main.go:185-520.
 """
 
+import json
+import shutil
+
 import pytest
 
 from distributed_crawler_tpu.cli import (
@@ -110,6 +113,54 @@ class TestUrls:
         f.write_text("one\n# comment\n\ntwo\n")
         _, r = resolve(["--urls", "zero", "--url-file", str(f)])
         assert collect_urls(r) == ["zero", "one", "two"]
+
+
+class TestStandaloneTelegramE2E:
+    """The full production wiring through `main()`: seed tarball →
+    setup_pool_from_config → native client → crawl → JSONL posts +
+    completed metadata.  Regression for three coupled bugs: no production
+    pool init, raw small seed ids reading as zero posts (deadend), and the
+    CLI-owned state manager never being closed (completed status lost)."""
+
+    @pytest.mark.skipif(shutil.which("g++") is None,
+                        reason="no C++ toolchain")
+    def test_crawl_from_seed_tarball(self, tmp_path):
+        import tarfile
+
+        from distributed_crawler_tpu.cli import main
+        from distributed_crawler_tpu.crawl import shutdown_connection_pool
+
+        seed = {"channels": [{
+            "username": "clichan", "id": 99, "title": "CLI Chan",
+            "member_count": 250,
+            "messages": [{"id": i, "date": 1785300000 + i,
+                          "content": {"@type": "messageText",
+                                      "text": {"text": f"cli post {i}"}},
+                          "view_count": i}
+                         for i in range(1, 4)]}]}
+        src = tmp_path / "seed.json"
+        src.write_text(json.dumps(seed))
+        tar = tmp_path / "dbs.tar.gz"
+        with tarfile.open(tar, "w:gz") as t:
+            t.add(src, arcname="db/seed.json")
+
+        store = tmp_path / "store"
+        try:
+            rc = main(["--mode", "standalone", "--urls", "clichan",
+                       "--tdlib-database-urls", str(tar),
+                       "--storage-root", str(store),
+                       "--skip-media", "--max-depth", "0",
+                       "--log-level", "warn"], env={})
+        finally:
+            shutdown_connection_pool()
+        assert rc == 0
+        posts = list(store.glob("*/clichan/posts/posts.jsonl"))
+        assert len(posts) == 1
+        rows = [json.loads(l) for l in posts[0].read_text().splitlines()]
+        assert len(rows) == 3
+        assert any("cli post" in r.get("description", "") for r in rows)
+        meta = json.loads(next(store.glob("*/metadata.json")).read_text())
+        assert meta["status"] == "completed"
 
 
 class TestMain:

@@ -478,6 +478,82 @@ class TestPoolFacade:
             shutdown_connection_pool()
 
 
+class TestSetupPoolFromConfig:
+    """Production pool wiring: `crawl.InitConnectionPool` analog that every
+    telegram entry path calls (`standalone/runner.go:478`,
+    `worker.go:96-133`)."""
+
+    def _seed_tarball(self, tmp_path, name="dbs.tar.gz"):
+        import json
+        import tarfile
+
+        seed = {"channels": [{"username": "poolchan", "chat_id": 71,
+                              "title": "Pool Chan", "member_count": 10,
+                              "messages": []}]}
+        src = tmp_path / f"src-{name}"
+        src.mkdir()
+        (src / "seed.json").write_text(json.dumps(seed))
+        path = tmp_path / name
+        with tarfile.open(path, "w:gz") as tar:
+            tar.add(src / "seed.json", arcname="db/seed.json")
+        return str(path)
+
+    def test_builds_pool_from_database_urls(self, tmp_path):
+        from distributed_crawler_tpu.crawl import (
+            get_connection_from_pool,
+            setup_pool_from_config,
+            shutdown_connection_pool,
+        )
+        from distributed_crawler_tpu.crawl.runner import (
+            release_connection_to_pool,
+        )
+
+        shutdown_connection_pool()
+        tar1 = self._seed_tarball(tmp_path, "one.tar.gz")
+        tar2 = self._seed_tarball(tmp_path, "two.tar.gz")
+        cfg = make_cfg(tdlib_database_urls=[tar1, tar2],
+                       storage_root=str(tmp_path / "store"))
+        try:
+            assert setup_pool_from_config(cfg) is True
+            conn = get_connection_from_pool(timeout_s=2)
+            try:
+                chat = conn.client.search_public_chat("poolchan")
+                assert chat.title == "Pool Chan"
+            finally:
+                release_connection_to_pool(conn)
+            # One extracted conn dir per connection, under storage_root.
+            import os as os_mod
+            dbs = tmp_path / "store" / ".tdlib" / "databases"
+            assert len([d for d in os_mod.listdir(dbs)
+                        if d.startswith("conn_")]) == 2
+        finally:
+            shutdown_connection_pool()
+
+    def test_noop_without_urls_or_with_existing_pool(self, tmp_path):
+        from distributed_crawler_tpu.clients import ConnectionPool
+        from distributed_crawler_tpu.crawl import (
+            init_connection_pool,
+            setup_pool_from_config,
+            shutdown_connection_pool,
+        )
+
+        shutdown_connection_pool()
+        assert setup_pool_from_config(make_cfg()) is False  # no URLs
+        net, _ = build_channel_network()
+        pool = ConnectionPool(factory=lambda cid: SimTelegramClient(net, cid))
+        pool.initialize()
+        init_connection_pool(pool)
+        try:
+            # Already-installed pool (the sim/test seam) is left alone.
+            tar = self._seed_tarball(tmp_path)
+            assert setup_pool_from_config(
+                make_cfg(tdlib_database_urls=[tar])) is True
+            from distributed_crawler_tpu.crawl.runner import _pool
+            assert _pool is pool
+        finally:
+            shutdown_connection_pool()
+
+
 class TestWalkbackPicker:
     def test_excludes_source_and_excluded(self, tmp_path):
         sm = make_sm(tmp_path, sampling="random-walk")
