@@ -55,8 +55,11 @@ def validate_sampling_method(inp: SamplingValidationInput) -> None:
     if inp.sampling_method == "random":
         return  # YouTube random sampling needs no URLs
 
-    # channel / snowball: URLs required unless job mode supplies them per-job.
-    if not has_url_source and inp.mode != "job":
+    # channel / snowball: URLs required unless the mode supplies them later —
+    # job mode from the per-job payload, worker mode from work items off the
+    # bus.  Orchestrator intentionally still requires URLs: it seeds the
+    # crawl with them (`orchestrator.start(seed_urls)`).
+    if not has_url_source and inp.mode not in ("job", "worker"):
         raise ValueError(
             f"{inp.sampling_method} sampling requires URLs to be provided. "
             "Use --urls or --url-file to specify them"

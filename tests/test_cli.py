@@ -102,6 +102,12 @@ class TestValidation:
         cfg, _ = resolve(["--validate-only", "--sampling", "random-walk"])
         assert cfg.validate_only
 
+    def test_worker_mode_defers_urls(self):
+        # Work items arrive over the bus, so worker mode needs no seed URLs
+        # (orchestrator mode still does — it seeds the crawl with them).
+        cfg = resolve(["--mode", "worker", "--worker-id", "w1"])[0]
+        assert cfg.platform == "telegram"
+
     def test_job_mode_defers_urls(self):
         cfg, _ = resolve(["--mode", "job"])
         assert cfg is not None
