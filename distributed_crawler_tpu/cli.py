@@ -19,7 +19,7 @@ import argparse
 import logging
 import os
 import sys
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 from .config.crawler import (
     CrawlerConfig,
@@ -47,7 +47,7 @@ def build_parser() -> argparse.ArgumentParser:
     a("--log-json", action="store_const", const=True, default=None)
     a("--mode", default=None,
       help="standalone | launch | orchestrator | worker | job | "
-           "tpu-worker | train-head | cluster")
+           "tpu-worker | train-head | cluster | bus")
     a("--worker-id", default=None, help="worker identifier (worker modes)")
     a("--concurrency", type=int, default=None)
     a("--timeout", type=int, default=None, help="HTTP timeout seconds")
@@ -113,6 +113,9 @@ def build_parser() -> argparse.ArgumentParser:
       help="serve a jax.profiler trace server on this port (0 = off; "
            "the reference's :6060 pprof analog)")
     # TPU inference stage
+    a("--bus-serve", action="store_const", const=True, default=None,
+      help="also HOST the gRPC bus broker at --bus-address (tpu-worker "
+           "mode; orchestrator mode always hosts)")
     a("--infer", action="store_const", const=True, default=None,
       help="enable the TPU inference stage")
     a("--infer-model", default=None, help="model registry key")
@@ -199,6 +202,7 @@ _KEY_MAP = {
     "urls": "crawler.urls",
     "url_file": "crawler.url_file",
     "bus_address": "distributed.bus_address",
+    "bus_serve": "distributed.bus_serve",
     "metrics_port": "observability.metrics_port",
     "profiler_port": "observability.profiler_port",
     "infer": "inference.enabled",
@@ -317,7 +321,7 @@ def resolve_config(args: argparse.Namespace,
     # neither do the non-crawling service modes (TPU inference / training /
     # clustering).
     if not cfg.validate_only and r.get_str("distributed.mode", "") not in (
-            "tpu-worker", "train-head", "cluster"):
+            "tpu-worker", "train-head", "cluster", "bus"):
         validate_sampling_method(SamplingValidationInput(
             platform=cfg.platform, sampling_method=cfg.sampling_method,
             url_list=r.get_list("crawler.urls"),
@@ -413,6 +417,18 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
             _run_job_service(cfg)
         elif mode == "tpu-worker":
             _run_tpu_worker(cfg, r)
+        elif mode == "bus":
+            # Dedicated broker process — the in-tree analog of the
+            # reference's always-on Dapr sidecar (`daprstate.go:119-133`).
+            if not r.get_str("distributed.bus_address"):
+                print("error: bus mode requires --bus-address",
+                      file=sys.stderr)
+                return 2
+            bus = _make_bus(r, serve=True)
+            try:
+                _serve_forever()
+            finally:
+                bus.close()
         elif mode == "train-head":
             return _run_train_head(cfg, r)
         elif mode == "cluster":
@@ -420,6 +436,12 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
         else:
             print(f"error: unknown execution mode: {mode}", file=sys.stderr)
             return 2
+    except CliConfigError as e:
+        # Config-shaped errors raised by mode runners (missing --worker-id,
+        # --bus-serve without --bus-address, …) — report like the
+        # resolve_config errors above instead of a traceback.
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     except KeyboardInterrupt:
         logger.info("interrupted, shutting down")
         return 130
@@ -461,6 +483,23 @@ def _maybe_bridge(sm, cfg: CrawlerConfig, r: ConfigResolver):
     return bridge, closer
 
 
+class CliConfigError(ValueError):
+    """A user-fixable configuration error raised by a mode runner; main()
+    reports it as `error: …` (exit 2) instead of a traceback.  Keep this
+    distinct from ValueError so genuine programming errors deep in the
+    crawl/inference stack still surface with their tracebacks."""
+
+
+def _serve_forever(poll_s: float = 1.0,
+                   running: Optional[Callable[[], bool]] = None) -> None:
+    """Block the main thread while a service's worker threads run; an
+    optional ``running`` predicate ends the loop when it turns False."""
+    import time as _time
+
+    while running is None or running():
+        _time.sleep(poll_s)
+
+
 def _make_bus(r: ConfigResolver, serve: bool = False):
     """Bus selection: --bus-address set -> gRPC DCN transport (orchestrator
     hosts a GrpcBusServer with the work queue pull-enabled; workers dial a
@@ -473,13 +512,43 @@ def _make_bus(r: ConfigResolver, serve: bool = False):
         return bus
     if serve:
         from .bus.grpc_bus import GrpcBusServer
-        from .bus.messages import TOPIC_WORK_QUEUE
+        from .bus.messages import TOPIC_INFERENCE_BATCHES, TOPIC_WORK_QUEUE
         server = GrpcBusServer(address)
+        # Pre-enable the pull (competing-consumer) topics so frames
+        # published before the first consumer connects are queued, not
+        # dropped.  Fan-out topics (results/status/commands) stay local-
+        # dispatch only — pull-enabling them on a broker nobody drains
+        # would accumulate frames without bound.
         server.enable_pull(TOPIC_WORK_QUEUE)
+        server.enable_pull(TOPIC_INFERENCE_BATCHES)
         server.start()
         return server
     from .bus.grpc_bus import RemoteBus
     return RemoteBus(address)
+
+
+class _ServingBus:
+    """A GrpcBusServer plus a loopback RemoteBus client: lets one process
+    both HOST the broker and CONSUME from it (``--bus-serve`` on the TPU
+    worker — the standalone analog of the reference's always-on Dapr
+    sidecar).  The bus API delegates to the client; close() tears down
+    client then server."""
+
+    def __init__(self, server, client):
+        self._server = server
+        self._client = client
+
+    def publish(self, topic, payload):
+        self._client.publish(topic, payload)
+
+    def subscribe(self, topic, handler, **kw):
+        self._client.subscribe(topic, handler, **kw)
+
+    def close(self):
+        try:
+            self._client.close()
+        finally:
+            self._server.close()
 
 
 def _run_orchestrator(urls: List[str], cfg: CrawlerConfig,
@@ -492,9 +561,8 @@ def _run_orchestrator(urls: List[str], cfg: CrawlerConfig,
     orch = Orchestrator(cfg.crawl_id, cfg, bus, sm)
     orch.start(urls)
     try:
-        import time as _time
-        while orch.is_running and not orch.crawl_completed:
-            _time.sleep(1.0)
+        _serve_forever(
+            running=lambda: orch.is_running and not orch.crawl_completed)
     finally:
         orch.stop()
         bus.close()
@@ -504,7 +572,7 @@ def _run_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
     """`main.go:708-750`."""
     worker_id = r.get_str("distributed.worker_id")
     if not worker_id:
-        raise ValueError("worker mode requires --worker-id")
+        raise CliConfigError("worker mode requires --worker-id")
     from .modes.common import create_state_manager
     from .worker import CrawlWorker
     bus = _make_bus(r)
@@ -522,9 +590,7 @@ def _run_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
                          youtube_crawler=youtube_crawler)
     worker.start()
     try:
-        import time as _time
-        while worker.is_running:
-            _time.sleep(1.0)
+        _serve_forever(running=lambda: worker.is_running)
     finally:
         worker.stop()
         bridge_closer()
@@ -538,9 +604,7 @@ def _run_job_service(cfg: CrawlerConfig) -> None:
     scheduler = JobScheduler(service)
     scheduler.start()
     try:
-        import time as _time
-        while True:
-            _time.sleep(1.0)
+        _serve_forever()
     finally:
         scheduler.stop()
 
@@ -783,7 +847,12 @@ def _build_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver):
     from .inference.worker import TPUWorker, TPUWorkerConfig
     from .state.providers import LocalStorageProvider
 
-    bus = _make_bus(r)
+    serve = r.get_bool("distributed.bus_serve", False)
+    if serve and not r.get_str("distributed.bus_address"):
+        raise CliConfigError("--bus-serve requires --bus-address")
+    # Engine and sink before the bus: if either raises (bad model key,
+    # unreachable object store), no server port has been bound and no
+    # threads need tearing down.
     engine = _make_engine(cfg, r, with_checkpoint=True)
     # Results sink: the object store when configured (--object-store),
     # else JSONL under the same storage root the crawler uses.
@@ -797,6 +866,16 @@ def _build_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver):
             make_object_client(cfg.object_store_url))
     else:
         provider = LocalStorageProvider(cfg.storage_root)
+    if serve:
+        # Host the broker AND consume from it over loopback — the
+        # single-service deployment of BASELINE configs #2/#3 (crawl
+        # process publishes, this process brokers + infers).
+        from .bus.grpc_bus import RemoteBus
+        server = _make_bus(r, serve=True)
+        bus = _ServingBus(server, RemoteBus(
+            r.get_str("distributed.bus_address")))
+    else:
+        bus = _make_bus(r)
     return TPUWorker(bus, engine, provider=provider,
                      cfg=TPUWorkerConfig(
                          metrics_port=r.get_int(
@@ -818,11 +897,13 @@ def _run_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
     worker.engine.warmup()
     worker.start()
     try:
-        import time as _time
-        while True:
-            _time.sleep(1.0)
+        _serve_forever()
     finally:
         worker.stop()
+        try:
+            worker.bus.close()  # serve-mode: broker + loopback client too
+        except Exception as e:
+            logger.warning("bus close failed: %s", e)
 
 
 if __name__ == "__main__":

@@ -169,6 +169,51 @@ class TestStandaloneTelegramE2E:
         assert meta["status"] == "completed"
 
 
+class TestBusServe:
+    def test_tpu_worker_hosts_broker_and_consumes(self, tmp_path):
+        """--bus-serve: one process brokers AND infers (BASELINE #2/#3 as
+        a two-command deployment).  A separate RemoteBus client publishes
+        an inference batch; results land in the worker's sink."""
+        import socket
+        import time
+
+        from distributed_crawler_tpu.bus.grpc_bus import RemoteBus
+        from distributed_crawler_tpu.bus.messages import (
+            TOPIC_INFERENCE_BATCHES,
+        )
+        from distributed_crawler_tpu.cli import _build_tpu_worker
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        cfg, r = resolve(
+            ["--mode", "tpu-worker", "--infer-model", "tiny",
+             "--bus-serve", "--bus-address", f"127.0.0.1:{port}",
+             "--infer-batch-size", "4",
+             "--storage-root", str(tmp_path / "results")])
+        worker = _build_tpu_worker(cfg, r)
+        worker.start()
+        producer = RemoteBus(f"127.0.0.1:{port}")
+        try:
+            producer.publish(TOPIC_INFERENCE_BATCHES, {
+                "batch_id": "b1", "crawl_id": "c1",
+                "records": [{"post_uid": f"p{i}", "text": f"text {i}"}
+                            for i in range(3)]})
+            deadline = time.time() + 30
+            files = []
+            while time.time() < deadline and not files:
+                files = list((tmp_path / "results").rglob("*.jsonl"))
+                time.sleep(0.2)
+            assert files, "no inference results written"
+            rows = [json.loads(l)
+                    for l in files[0].read_text().splitlines()]
+            assert {r_["post_uid"] for r_ in rows} == {"p0", "p1", "p2"}
+        finally:
+            producer.close()
+            worker.stop()
+            worker.bus.close()
+
+
 class TestMain:
     def test_version(self, capsys):
         assert main(["--version"]) == 0
