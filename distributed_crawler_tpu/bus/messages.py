@@ -57,6 +57,10 @@ TOPIC_WORKER_STATUS = "worker-status"
 TOPIC_ORCHESTRATOR = "orchestrator-commands"
 TOPIC_INFERENCE_BATCHES = "tpu-inference-batches"
 TOPIC_INFERENCE_RESULTS = "tpu-inference-results"
+# Job scheduling commands (schedule/delete) to a `--mode job` service — the
+# bus transport replacing the reference's Dapr service-invocation handlers
+# (`dapr/job.go:81-95`).
+TOPIC_JOBS = "job-commands"
 
 VALID_PLATFORMS = ("telegram", "youtube")
 
@@ -80,7 +84,8 @@ def new_work_item_id() -> str:
 def pubsub_topics() -> List[str]:
     """`messages.go:169-176` + TPU topics."""
     return [TOPIC_WORK_QUEUE, TOPIC_RESULTS, TOPIC_WORKER_STATUS,
-            TOPIC_ORCHESTRATOR, TOPIC_INFERENCE_BATCHES, TOPIC_INFERENCE_RESULTS]
+            TOPIC_ORCHESTRATOR, TOPIC_INFERENCE_BATCHES,
+            TOPIC_INFERENCE_RESULTS, TOPIC_JOBS]
 
 
 def _opt_time(value: Any) -> Optional[str]:

@@ -47,7 +47,7 @@ def build_parser() -> argparse.ArgumentParser:
     a("--log-json", action="store_const", const=True, default=None)
     a("--mode", default=None,
       help="standalone | launch | orchestrator | worker | job | "
-           "tpu-worker | train-head | cluster | bus")
+           "job-submit | tpu-worker | train-head | cluster | bus")
     a("--worker-id", default=None, help="worker identifier (worker modes)")
     a("--concurrency", type=int, default=None)
     a("--timeout", type=int, default=None, help="HTTP timeout seconds")
@@ -115,7 +115,17 @@ def build_parser() -> argparse.ArgumentParser:
     # TPU inference stage
     a("--bus-serve", action="store_const", const=True, default=None,
       help="also HOST the gRPC bus broker at --bus-address (tpu-worker "
-           "mode; orchestrator mode always hosts)")
+           "and job modes; orchestrator mode always hosts)")
+    # Job submission (mode=job-submit -> a running `--mode job` service)
+    a("--job-name", default=None,
+      help="job name; the prefix routes it (telegram-crawl*, "
+           "youtube-crawl*, scheduled-crawl*, maintenance-job*)")
+    a("--job-due-s", type=float, default=None,
+      help="seconds until the job fires (default 0 = now)")
+    a("--job-data", default=None,
+      help="job payload: inline JSON object or @path/to/file.json")
+    a("--job-delete", action="store_const", const=True, default=None,
+      help="delete the named job instead of scheduling")
     a("--infer", action="store_const", const=True, default=None,
       help="enable the TPU inference stage")
     a("--infer-model", default=None, help="model registry key")
@@ -203,6 +213,10 @@ _KEY_MAP = {
     "url_file": "crawler.url_file",
     "bus_address": "distributed.bus_address",
     "bus_serve": "distributed.bus_serve",
+    "job_name": "job.name",
+    "job_due_s": "job.due_s",
+    "job_data": "job.data",
+    "job_delete": "job.delete",
     "metrics_port": "observability.metrics_port",
     "profiler_port": "observability.profiler_port",
     "infer": "inference.enabled",
@@ -321,7 +335,7 @@ def resolve_config(args: argparse.Namespace,
     # neither do the non-crawling service modes (TPU inference / training /
     # clustering).
     if not cfg.validate_only and r.get_str("distributed.mode", "") not in (
-            "tpu-worker", "train-head", "cluster", "bus"):
+            "tpu-worker", "train-head", "cluster", "bus", "job-submit"):
         validate_sampling_method(SamplingValidationInput(
             platform=cfg.platform, sampling_method=cfg.sampling_method,
             url_list=r.get_list("crawler.urls"),
@@ -414,7 +428,9 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
         elif mode == "worker":
             _run_worker(cfg, r)
         elif mode == "job":  # the reference's dapr-job scheduled mode
-            _run_job_service(cfg)
+            _run_job_service(cfg, r)
+        elif mode == "job-submit":
+            return _run_job_submit(r)
         elif mode == "tpu-worker":
             _run_tpu_worker(cfg, r)
         elif mode == "bus":
@@ -512,7 +528,11 @@ def _make_bus(r: ConfigResolver, serve: bool = False):
         return bus
     if serve:
         from .bus.grpc_bus import GrpcBusServer
-        from .bus.messages import TOPIC_INFERENCE_BATCHES, TOPIC_WORK_QUEUE
+        from .bus.messages import (
+            TOPIC_INFERENCE_BATCHES,
+            TOPIC_JOBS,
+            TOPIC_WORK_QUEUE,
+        )
         server = GrpcBusServer(address)
         # Pre-enable the pull (competing-consumer) topics so frames
         # published before the first consumer connects are queued, not
@@ -521,10 +541,23 @@ def _make_bus(r: ConfigResolver, serve: bool = False):
         # would accumulate frames without bound.
         server.enable_pull(TOPIC_WORK_QUEUE)
         server.enable_pull(TOPIC_INFERENCE_BATCHES)
+        server.enable_pull(TOPIC_JOBS)
         server.start()
         return server
     from .bus.grpc_bus import RemoteBus
     return RemoteBus(address)
+
+
+def _make_serving_bus(r: ConfigResolver) -> "_ServingBus":
+    """Broker + loopback consumer for a --bus-serve process; raises
+    CliConfigError when --bus-address is missing."""
+    from .bus.grpc_bus import RemoteBus
+
+    address = r.get_str("distributed.bus_address")
+    if not address:
+        raise CliConfigError("--bus-serve requires --bus-address")
+    server = _make_bus(r, serve=True)
+    return _ServingBus(server, RemoteBus(address))
 
 
 class _ServingBus:
@@ -597,16 +630,71 @@ def _run_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
         bus.close()
 
 
-def _run_job_service(cfg: CrawlerConfig) -> None:
+def _run_job_service(cfg: CrawlerConfig, r: ConfigResolver) -> None:
     """`main.go:602` -> dapr.StartDaprMode."""
     from .modes.jobs import JobScheduler, JobService
     service = JobService(cfg)
     scheduler = JobScheduler(service)
+    bus = None
+    if r.get_bool("distributed.bus_serve", False) \
+            or r.get_str("distributed.bus_address"):
+        # Accept schedule/delete commands over the bus — the transport
+        # replacing the reference's Dapr invocation handlers.
+        from .bus.messages import TOPIC_JOBS
+        if r.get_bool("distributed.bus_serve", False):
+            bus = _make_serving_bus(r)  # raises without --bus-address
+        else:
+            bus = _make_bus(r)
+        bus.subscribe(TOPIC_JOBS, scheduler.handle_command)
     scheduler.start()
     try:
         _serve_forever()
     finally:
         scheduler.stop()
+        if bus is not None:
+            try:
+                bus.close()
+            except Exception as e:
+                logger.warning("bus close failed: %s", e)
+
+
+def _run_job_submit(r: ConfigResolver) -> int:
+    """mode=job-submit: publish a schedule/delete command to a running
+    `--mode job` service over the bus (the client half of the reference's
+    scheduleJob/deleteJob invocation API, `dapr/job.go:212-267`)."""
+    import json as _json
+
+    name = r.get_str("job.name")
+    if not name:
+        raise CliConfigError("job-submit requires --job-name")
+    if not r.get_str("distributed.bus_address"):
+        raise CliConfigError("job-submit requires --bus-address")
+    if r.get_bool("job.delete", False):
+        command = {"action": "delete", "name": name}
+    else:
+        raw = r.get_str("job.data", "")
+        if raw.startswith("@"):
+            try:
+                with open(raw[1:], "r", encoding="utf-8") as f:
+                    raw = f.read()
+            except OSError as e:
+                raise CliConfigError(f"cannot read --job-data file: {e}")
+        try:
+            data = _json.loads(raw) if raw else {}
+        except ValueError as e:
+            raise CliConfigError(f"--job-data is not valid JSON: {e}")
+        if not isinstance(data, dict):
+            raise CliConfigError("--job-data must be a JSON object")
+        command = {"action": "schedule", "name": name,
+                   "due_in_s": r.get_float("job.due_s", 0.0), "data": data}
+    from .bus.messages import TOPIC_JOBS
+    bus = _make_bus(r)
+    try:
+        bus.publish(TOPIC_JOBS, command)
+    finally:
+        bus.close()
+    print(_json.dumps({"submitted": command["action"], "job": name}))
+    return 0
 
 
 def _run_train_head(cfg: CrawlerConfig, r: ConfigResolver) -> int:
@@ -849,7 +937,7 @@ def _build_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver):
 
     serve = r.get_bool("distributed.bus_serve", False)
     if serve and not r.get_str("distributed.bus_address"):
-        raise CliConfigError("--bus-serve requires --bus-address")
+        raise CliConfigError("--bus-serve requires --bus-address")  # early
     # Engine and sink before the bus: if either raises (bad model key,
     # unreachable object store), no server port has been bound and no
     # threads need tearing down.
@@ -870,10 +958,7 @@ def _build_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver):
         # Host the broker AND consume from it over loopback — the
         # single-service deployment of BASELINE configs #2/#3 (crawl
         # process publishes, this process brokers + infers).
-        from .bus.grpc_bus import RemoteBus
-        server = _make_bus(r, serve=True)
-        bus = _ServingBus(server, RemoteBus(
-            r.get_str("distributed.bus_address")))
+        bus = _make_serving_bus(r)
     else:
         bus = _make_bus(r)
     return TPUWorker(bus, engine, provider=provider,

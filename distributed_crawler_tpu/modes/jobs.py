@@ -302,6 +302,28 @@ class JobScheduler:
         with self._lock:
             return self._jobs.pop(name, None) is not None
 
+    def handle_command(self, payload: Dict[str, Any]) -> None:
+        """Bus-transported job command (`job-commands` topic) — the in-tree
+        replacement for the reference's Dapr service-invocation handlers
+        (`dapr/job.go:81-95,852-895`).
+
+        Payload: ``{"action": "schedule"|"delete", "name": ...,
+        "due_in_s": N, "data": {...}}``.  Raises ValueError on a malformed
+        command (the bus logs + dead-letters after retries)."""
+        action = payload.get("action")
+        name = payload.get("name") or ""
+        if not name:
+            raise ValueError("job command requires a name")
+        if action == "schedule":
+            self.schedule_job(name, float(payload.get("due_in_s") or 0.0),
+                              dict(payload.get("data") or {}))
+            logger.info("scheduled job %s via bus", name)
+        elif action == "delete":
+            existed = self.delete_job(name)
+            logger.info("deleted job %s via bus (existed=%s)", name, existed)
+        else:
+            raise ValueError(f"unknown job command action: {action!r}")
+
     # -- dispatch ----------------------------------------------------------
     def run_due_jobs(self) -> int:
         """Dispatch everything due now; returns count (test-friendly tick)."""

@@ -338,6 +338,34 @@ class TestJobScheduler:
         assert sched.run_due_jobs() == 0
         assert sched.get_job("telegram-crawl-2") is None
 
+    def test_handle_command_bus_transport(self):
+        """schedule/delete arriving as bus payloads (`job-commands`) —
+        the Dapr-invocation-handler replacement (`dapr/job.go:81-95`)."""
+        import pytest as _pytest
+
+        launches = []
+        svc = JobService(CrawlerConfig(platform="telegram"),
+                         launch_fn=lambda urls, cfg: launches.append(urls),
+                         file_cleaner_factory=FakeCleaner)
+        now = [1000.0]
+        sched = JobScheduler(svc, clock=lambda: now[0])
+        sched.handle_command({
+            "action": "schedule", "name": "telegram-crawl-bus", "due_in_s": 5,
+            "data": JobData(job_name="telegram-crawl-bus",
+                            urls=["buschan"]).to_dict()})
+        assert sched.get_job("telegram-crawl-bus") is not None
+        now[0] = 1006.0
+        assert sched.run_due_jobs() == 1
+        assert launches == [["buschan"]]
+        sched.handle_command({"action": "schedule", "name": "gone",
+                              "due_in_s": 99, "data": {}})
+        sched.handle_command({"action": "delete", "name": "gone"})
+        assert sched.get_job("gone") is None
+        with _pytest.raises(ValueError, match="name"):
+            sched.handle_command({"action": "schedule"})
+        with _pytest.raises(ValueError, match="action"):
+            sched.handle_command({"action": "pause", "name": "x"})
+
     def test_background_dispatch(self):
         fired = []
         svc = JobService(CrawlerConfig(platform="telegram"),

@@ -169,6 +169,74 @@ class TestStandaloneTelegramE2E:
         assert meta["status"] == "completed"
 
 
+class TestJobSubmit:
+    def test_requires_name_and_bus(self, capsys):
+        from distributed_crawler_tpu.cli import main
+
+        assert main(["--mode", "job-submit"], env={}) == 2
+        assert "--job-name" in capsys.readouterr().err
+        assert main(["--mode", "job-submit", "--job-name", "j1"],
+                    env={}) == 2
+        assert "--bus-address" in capsys.readouterr().err
+        rc = main(["--mode", "job-submit", "--job-name", "j1",
+                   "--bus-address", "127.0.0.1:1", "--job-data", "notjson"],
+                  env={})
+        assert rc == 2
+        assert "not valid JSON" in capsys.readouterr().err
+
+    def test_submit_reaches_scheduler_over_grpc(self, tmp_path, capsys):
+        """job-submit → gRPC bus → a job service's scheduler."""
+        import socket
+        import time
+
+        from distributed_crawler_tpu.bus.grpc_bus import RemoteBus
+        from distributed_crawler_tpu.bus.messages import TOPIC_JOBS
+        from distributed_crawler_tpu.cli import _make_bus, main
+        from distributed_crawler_tpu.config.crawler import CrawlerConfig
+        from distributed_crawler_tpu.modes.jobs import (
+            JobScheduler,
+            JobService,
+        )
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+        class _R:
+            def get_str(self, key, default=""):
+                return f"127.0.0.1:{port}" \
+                    if key == "distributed.bus_address" else default
+
+        server = _make_bus(_R(), serve=True)
+        consumer = RemoteBus(f"127.0.0.1:{port}")
+        class _StubCleaner:
+            def __init__(self, *a, **kw): ...
+            def start(self): ...
+            def stop(self): ...
+
+        launches = []
+        svc = JobService(CrawlerConfig(platform="telegram"),
+                         launch_fn=lambda urls, cfg: launches.append(urls),
+                         file_cleaner_factory=_StubCleaner)
+        sched = JobScheduler(svc)
+        consumer.subscribe(TOPIC_JOBS, sched.handle_command)
+        sched.start()
+        try:
+            rc = main(["--mode", "job-submit", "--job-name",
+                       "telegram-crawl-t", "--bus-address",
+                       f"127.0.0.1:{port}",
+                       "--job-data", '{"urls": ["grpcchan"]}'], env={})
+            assert rc == 0
+            deadline = time.monotonic() + 10
+            while not launches and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert launches == [["grpcchan"]]
+        finally:
+            sched.stop()
+            consumer.close()
+            server.close()
+
+
 class TestBusServe:
     def test_tpu_worker_hosts_broker_and_consumes(self, tmp_path):
         """--bus-serve: one process brokers AND infers (BASELINE #2/#3 as
