@@ -24,6 +24,7 @@ from .messages import (
     PRIORITY_MEDIUM,
     TOPIC_INFERENCE_BATCHES,
     TOPIC_INFERENCE_RESULTS,
+    TOPIC_JOBS,
     TOPIC_ORCHESTRATOR,
     TOPIC_RESULTS,
     TOPIC_WORK_QUEUE,
@@ -64,4 +65,19 @@ __all__ = [
     "TOPIC_ORCHESTRATOR",
     "TOPIC_INFERENCE_BATCHES",
     "TOPIC_INFERENCE_RESULTS",
+    "TOPIC_JOBS",
+    "GrpcBusServer",
+    "GrpcBusClient",
+    "RemoteBus",
 ]
+
+
+def __getattr__(name):
+    # The gRPC transport re-exports resolve lazily so the bus package (and
+    # the InMemoryBus everything hermetic uses) stays importable without
+    # grpcio installed.
+    if name in ("GrpcBusServer", "GrpcBusClient", "RemoteBus"):
+        from . import grpc_bus
+
+        return getattr(grpc_bus, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

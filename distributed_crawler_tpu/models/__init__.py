@@ -17,6 +17,7 @@ sharding rules in `parallel.sharding` match their parameter paths:
 from .encoder import (
     Classifier,
     Embedder,
+    EmbedderClassifier,
     EncoderConfig,
     E5_SMALL,
     E5_BASE,
@@ -38,6 +39,7 @@ from .whisper import (
 
 __all__ = [
     "EncoderConfig",
+    "EmbedderClassifier",
     "Embedder",
     "Classifier",
     "E5_SMALL",

@@ -1,0 +1,143 @@
+"""Whole-system topology test: real OS processes over the real gRPC bus.
+
+Spawns the orchestrator (hosting the broker), a crawl worker feeding the
+inference bridge, and a TPU worker — the co-scheduled deployment of
+SURVEY.md §7.7 — and asserts the crawl completes, posts land, and
+inference results are written.  This is the regression net for the
+production wiring this repo keeps proving out by hand: pool setup from
+config, bus brokering, pre-enabled pull topics, worker URL exemption.
+
+The reference tested multi-node only against in-memory mocks
+(`distributed/integration_test.go`); this goes further — three separate
+interpreters, real sockets, real seed-DB tarballs.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import socket
+import tarfile
+import time
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+SEED = {
+    "channels": [
+        {"username": "topoa", "id": 301, "title": "Topo A",
+         "member_count": 500,
+         "messages": [
+             {"date": 1785300000 + i,
+              "content": {"@type": "messageText",
+                          "text": {"text": f"alpha {i} see t.me/topob"}},
+              "view_count": i} for i in range(1, 4)]},
+        {"username": "topob", "id": 302, "title": "Topo B",
+         "member_count": 400,
+         "messages": [
+             {"date": 1785300100 + i,
+              "content": {"@type": "messageText",
+                          "text": {"text": f"beta {i}"}},
+              "view_count": i} for i in range(1, 3)]},
+    ]
+}
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _cpu_env() -> dict:
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("AXON", "PALLAS_AXON", "TPU_"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _spawn(args, log_path, env=None):
+    log = open(log_path, "w")
+    return subprocess.Popen(
+        [sys.executable, "-m", "distributed_crawler_tpu.cli"] + args,
+        stdout=log, stderr=subprocess.STDOUT, env=env or dict(os.environ),
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_orchestrator_worker_tpu_worker_processes(tmp_path):
+    src = tmp_path / "seed.json"
+    src.write_text(json.dumps(SEED))
+    tar = tmp_path / "dbs.tar.gz"
+    with tarfile.open(tar, "w:gz") as t:
+        t.add(src, arcname="db/seed.json")
+
+    port = _free_port()
+    addr = f"127.0.0.1:{port}"
+    procs = []
+    try:
+        procs.append(_spawn(
+            ["--mode", "orchestrator", "--urls", "topoa",
+             "--bus-address", addr, "--crawl-id", "topo1",
+             "--storage-root", str(tmp_path / "ostore"),
+             "--max-depth", "1", "--skip-media", "--log-level", "info"],
+            tmp_path / "orch.log"))
+        # TPU worker on CPU jax so CI needs no chip; 'tiny' model keeps
+        # warmup fast.
+        procs.append(_spawn(
+            ["--mode", "tpu-worker", "--infer-model", "tiny",
+             "--bus-address", addr,
+             "--storage-root", str(tmp_path / "tpustore"),
+             "--log-level", "info"],
+            tmp_path / "tpu.log", env=_cpu_env()))
+        procs.append(_spawn(
+            ["--mode", "worker", "--worker-id", "w1",
+             "--bus-address", addr, "--crawl-id", "topo1",
+             "--tdlib-database-urls", str(tar),
+             "--storage-root", str(tmp_path / "wstore"),
+             "--skip-media", "--infer", "--log-level", "info"],
+            tmp_path / "worker.log", env=_cpu_env()))
+
+        deadline = time.time() + 150
+        done = False
+        while time.time() < deadline and not done:
+            if procs[0].poll() is not None:
+                break  # orchestrator exits once the crawl completes
+            done = "crawl marked as completed" in \
+                (tmp_path / "orch.log").read_text(errors="replace")
+            time.sleep(1.0)
+        orch_log = (tmp_path / "orch.log").read_text(errors="replace")
+        assert "crawl marked as completed" in orch_log, orch_log[-2000:]
+
+        # Crawl output: both channels' posts stored by the worker.
+        posts = sorted(p.parent.parent.name
+                       for p in (tmp_path / "wstore").rglob("posts.jsonl"))
+        assert posts == ["topoa", "topob"], posts
+
+        # Inference output: the bridge shipped post batches, the TPU
+        # worker embedded+classified them.  Batches land one file at a
+        # time, so poll until ALL 5 uids appear (not merely "some rows"),
+        # and skip a partial trailing line from a file mid-append.
+        deadline = time.time() + 60
+        rows = []
+        while time.time() < deadline:
+            rows = []
+            for f in (tmp_path / "tpustore").rglob("*.jsonl"):
+                for line in f.read_text(errors="replace").splitlines():
+                    try:
+                        rows.append(json.loads(line))
+                    except ValueError:
+                        pass  # mid-append tail
+            if len({r_["post_uid"] for r_ in rows}) >= 5:
+                break
+            time.sleep(1.0)
+        assert rows, (tmp_path / "tpu.log").read_text(
+            errors="replace")[-2000:]
+        assert all("embedding" in r_ and "label" in r_ for r_ in rows)
+        # 3 posts from topoa + 2 from topob
+        assert len({r_["post_uid"] for r_ in rows}) == 5
+    finally:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait(timeout=10)
