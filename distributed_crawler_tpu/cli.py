@@ -86,6 +86,9 @@ def build_parser() -> argparse.ArgumentParser:
     a("--validator-request-jitter-ms", type=int, default=None)
     a("--validator-claim-batch-size", type=int, default=None)
     a("--validator-timeout", default=None, help="e.g. 30m")
+    a("--validator-base-url", default=None,
+      help="validation endpoint base (default https://t.me); point at a "
+           "mirror/forward proxy")
     a("--validator-transport", default=None,
       help="t.me transport: urllib | chrome (native Chrome-shaped TLS)")
     # Combine files (chunker)
@@ -202,6 +205,7 @@ _KEY_MAP = {
     "validator_claim_batch_size": "crawler.validator_claim_batch_size",
     "validator_timeout": "crawler.validator_timeout",
     "validator_transport": "crawler.validator_transport",
+    "validator_base_url": "crawler.validator_base_url",
     "combine_files": "crawler.combine_files",
     "combine_watch_dir": "crawler.combine_watch_dir",
     "combine_temp_dir": "crawler.combine_temp_dir",
@@ -280,6 +284,8 @@ def resolve_config(args: argparse.Namespace,
         "crawler.validator_claim_batch_size", 10)
     cfg.validator_transport = r.get_str(
         "crawler.validator_transport", "urllib")
+    cfg.validator_base_url = r.get_str(
+        "crawler.validator_base_url", "https://t.me")
     cfg.combine_files = r.get_bool("crawler.combine_files", False)
     cfg.combine_watch_dir = r.get_str("crawler.combine_watch_dir",
                                       "/tmp/watch-files")
@@ -397,6 +403,11 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
             except Exception as e:  # profiling is never fatal to the crawl
                 logger.warning("profiler server failed to start: %s", e)
     urls = collect_urls(r)
+    if cfg.validate_only and mode in ("", "standalone", "launch"):
+        # The validator pod is a launch-router branch
+        # (`dapr/standalone.go:276-314`); a bare `--validate-only` must
+        # not fall through to a sequential crawl of nothing.
+        mode = "launch"
     logger.info("starting", extra={"mode": mode or "standalone",
                                    "platform": cfg.platform,
                                    "url_count": len(urls)})

@@ -169,11 +169,16 @@ def parse_channel_html(html: str) -> ChannelValidationResult:
 
 def validate_channel_http(username: str,
                           transport: Transport = urllib_transport,
-                          rng: Optional[random.Random] = None
+                          rng: Optional[random.Random] = None,
+                          base_url: str = "https://t.me"
                           ) -> ChannelValidationResult:
-    """Fetch https://t.me/<username> and classify (`channelvalidator.go:64-127`)."""
+    """Fetch {base_url}/<username> and classify (`channelvalidator.go:64-127`).
+
+    ``base_url`` defaults to the real t.me; operators can point it at a
+    mirror/forward proxy (config ``validator_base_url``), and tests drive
+    the full pod against an in-tree HTTPS server."""
     rng = rng or random
-    url = f"https://t.me/{username}"
+    url = f"{base_url.rstrip('/')}/{username}"
     headers = {
         "User-Agent": rng.choice(BROWSER_USER_AGENTS),
         "Accept": "text/html,application/xhtml+xml,application/xml;q=0.9,"

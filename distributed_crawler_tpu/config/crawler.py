@@ -134,6 +134,9 @@ class CrawlerConfig:
     # t.me transport: "urllib" (stdlib) or "chrome" (native Chrome-shaped
     # TLS via native/net.h — the uTLS analog, utlstransport.go:19-57).
     validator_transport: str = "urllib"
+    # Validation endpoint base; point at a mirror/forward proxy when the
+    # egress IP rotates through one (default: the real t.me).
+    validator_base_url: str = "https://t.me"
     validator_request_jitter_ms: int = 200
     validator_claim_batch_size: int = 10
     validator_timeout_s: float = 0.0  # 0 = disabled

@@ -308,9 +308,11 @@ class RunValidationLoop:
 
             transport = make_transport(
                 getattr(cfg, "validator_transport", "") or "urllib")
+            base_url = getattr(cfg, "validator_base_url", "") \
+                or "https://t.me"
             self.validate_fn = (
                 lambda username: validate_channel_http(
-                    username, transport=transport))
+                    username, transport=transport, base_url=base_url))
         self.rate_limiter = rate_limiter or ValidatorRateLimiter(
             cfg.validator_request_rate or 6.0,
             cfg.validator_request_jitter_ms or 200)

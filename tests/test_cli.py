@@ -108,6 +108,25 @@ class TestValidation:
         cfg = resolve(["--mode", "worker", "--worker-id", "w1"])[0]
         assert cfg.platform == "telegram"
 
+    def test_validate_only_routes_to_validator(self, tmp_path, monkeypatch):
+        """Bare `--validate-only` must run the validator pod, not a
+        sequential crawl of zero URLs."""
+        from distributed_crawler_tpu import cli as cli_mod
+        from distributed_crawler_tpu.cli import main
+
+        ran = []
+        import distributed_crawler_tpu.modes.runner as runner_mod
+
+        def fake_validate_only(sm, cfg, validate_fn=None, **kw):
+            ran.append(cfg.validate_only)
+
+        monkeypatch.setattr(runner_mod, "run_validate_only",
+                            fake_validate_only)
+        rc = main(["--validate-only", "--storage-root",
+                   str(tmp_path / "s"), "--log-level", "error"], env={})
+        assert rc == 0
+        assert ran == [True]
+
     def test_job_mode_defers_urls(self):
         cfg, _ = resolve(["--mode", "job"])
         assert cfg is not None
