@@ -603,6 +603,8 @@ def _run_orchestrator(urls: List[str], cfg: CrawlerConfig,
     bus = _make_bus(r, serve=True)
     sm = create_state_manager(cfg, cfg.crawl_id)
     orch = Orchestrator(cfg.crawl_id, cfg, bus, sm)
+    from .utils.metrics import set_status_provider
+    set_status_provider(orch.get_status)  # /status (`orchestrator.go:596`)
     orch.start(urls)
     try:
         _serve_forever(
@@ -632,6 +634,8 @@ def _run_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
         setup_pool_from_config(cfg)  # `worker.go:96-133` pool init
     worker = CrawlWorker(worker_id, cfg, bus, sm,
                          youtube_crawler=youtube_crawler)
+    from .utils.metrics import set_status_provider
+    set_status_provider(worker.get_status)  # /status (`worker.go:459`)
     worker.start()
     try:
         _serve_forever(running=lambda: worker.is_running)

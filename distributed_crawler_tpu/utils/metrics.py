@@ -155,22 +155,50 @@ class MetricsRegistry:
 
 REGISTRY = MetricsRegistry()
 
+# Late-bound /status provider: the metrics server starts before the
+# orchestrator/worker exists, so the service registers its `get_status`
+# here once constructed.
+_status_provider = None
+
+
+def set_status_provider(fn) -> None:
+    """Register the zero-arg dict provider served at /status (pass None to
+    clear)."""
+    global _status_provider
+    _status_provider = fn
+
 
 class _Handler(BaseHTTPRequestHandler):
     registry: MetricsRegistry = REGISTRY
 
     def do_GET(self):  # noqa: N802 (http.server API)
-        if self.path.rstrip("/") in ("", "/health", "/healthz"):
+        path = self.path.split("?", 1)[0].rstrip("/")
+        code = 200
+        if path in ("", "/health", "/healthz"):
             body = b"ok\n"
             ctype = "text/plain"
-        elif self.path.startswith("/metrics"):
+        elif path == "/metrics":
             body = self.registry.expose().encode("utf-8")
             ctype = "text/plain; version=0.0.4"
+        elif path == "/status" and _status_provider is not None:
+            # The orchestrator/worker `get_status()` map
+            # (`orchestrator.go:596`, `worker.go:459`) served as JSON.
+            import json as _json
+
+            try:
+                body = _json.dumps(_status_provider(),
+                                   default=str).encode("utf-8")
+            except Exception as e:
+                # Visible to status-code monitors, one response per
+                # request (no retry loop server-side).
+                code = 500
+                body = _json.dumps({"error": str(e)}).encode("utf-8")
+            ctype = "application/json"
         else:
             self.send_response(404)
             self.end_headers()
             return
-        self.send_response(200)
+        self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
@@ -182,9 +210,10 @@ class _Handler(BaseHTTPRequestHandler):
 
 def serve_metrics(port: int, registry: MetricsRegistry = REGISTRY
                   ) -> ThreadingHTTPServer:
-    """Start the /metrics + /healthz endpoint on a daemon thread.
-    Returns the server (call .shutdown() to stop). Port 0 picks a free port
-    (server.server_address[1])."""
+    """Start the /metrics + /healthz (+ /status once a provider is
+    registered via ``set_status_provider``) endpoint on a daemon thread.
+    Returns the server (call .shutdown() to stop). Port 0 picks a free
+    port (server.server_address[1])."""
     handler = type("Handler", (_Handler,), {"registry": registry})
     server = ThreadingHTTPServer(("127.0.0.1", port), handler)
     t = threading.Thread(target=server.serve_forever, daemon=True,

@@ -287,6 +287,45 @@ class TestMetricsEndpoint:
         finally:
             server.shutdown()
 
+    def test_status_endpoint(self):
+        """/status serves the registered get_status map as JSON — the
+        orchestrator/worker status surface (`orchestrator.go:596`)."""
+        import json as _json
+
+        from distributed_crawler_tpu.utils.metrics import (
+            set_status_provider,
+        )
+
+        reg = MetricsRegistry()
+        server = serve_metrics(0, reg)
+        port = server.server_address[1]
+        try:
+            # No provider: 404.
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/status", timeout=5)
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+            set_status_provider(lambda: {"workers": 3, "depth": 1})
+            got = _json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/status?pretty=1",
+                timeout=5).read())
+            assert got == {"workers": 3, "depth": 1}
+            # A raising provider surfaces as a 500 with the error body so
+            # status-code monitors see the breakage.
+            set_status_provider(lambda: 1 / 0)
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/status", timeout=5)
+                assert False, "expected 500"
+            except urllib.error.HTTPError as e:
+                assert e.code == 500
+                assert "error" in _json.loads(e.read())
+        finally:
+            set_status_provider(None)
+            server.shutdown()
+
     def test_quantiles(self):
         reg = MetricsRegistry()
         h = reg.histogram("q_seconds", "")
