@@ -31,7 +31,12 @@ from ..bus.messages import (
     WORKER_BUSY,
     WORKER_IDLE,
 )
-from ..utils.metrics import REGISTRY, MetricsRegistry, serve_metrics
+from ..utils.metrics import (
+    REGISTRY,
+    MetricsRegistry,
+    serve_metrics,
+    set_status_provider,
+)
 from .engine import InferenceEngine
 
 logger = logging.getLogger(__name__)
@@ -98,9 +103,25 @@ class TPUWorker:
             "tpu_worker_batch_age_seconds",
             "bus transit + queue wait per batch")
 
+    def get_status(self) -> dict:
+        """Status map for the /status endpoint (the `GetStatus()` analog
+        the crawl orchestrator/worker expose, `worker.go:459`)."""
+        return {
+            "worker_id": self.cfg.worker_id,
+            "model": self.engine.cfg.model,
+            "is_running": not self._stop.is_set() and bool(self._threads),
+            "queue_depth": self._queue.qsize(),
+            "inflight": self._inflight,
+            "processed_batches": self._processed,
+            "error_batches": self._errors,
+            "uptime_s": (time.monotonic() - self._started_at)
+            if self._started_at else 0.0,
+        }
+
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         self._started_at = time.monotonic()
+        set_status_provider(self.get_status)
         self.bus.subscribe(TOPIC_INFERENCE_BATCHES, self._handle_payload)
         for target, name in ((self._feed_loop, "tpu-feed"),
                              (self._heartbeat_loop, "tpu-heartbeat")):
@@ -130,6 +151,10 @@ class TPUWorker:
 
     def stop(self, timeout_s: float = 10.0) -> None:
         self._stop.set()
+        # Unregister the process-global /status provider so a later
+        # server in this process 404s instead of serving a dead worker's
+        # map (and this worker's object graph can be collected).
+        set_status_provider(None)
         for t in self._threads:
             t.join(timeout=timeout_s)
         if self._metrics_server is not None:

@@ -297,6 +297,7 @@ class TestMetricsEndpoint:
         )
 
         reg = MetricsRegistry()
+        set_status_provider(None)  # a prior test's worker may have left one
         server = serve_metrics(0, reg)
         port = server.server_address[1]
         try:
