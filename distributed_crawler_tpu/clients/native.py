@@ -537,6 +537,30 @@ def acquire_seed_db(source: str, base_dir: str, conn_id: str) -> str:
 
     conn_dir = os.path.join(base_dir, f"conn_{fnv32(conn_id):08x}")
 
+    # Reuse is keyed on the SOURCE's identity too: a replaced/updated
+    # tarball (same path, new content) or a different --tdlib-database-urls
+    # entry must re-extract, not silently serve the stale copy.  Directory
+    # sources fingerprint their CONTENTS (POSIX dir mtime doesn't change
+    # when a contained file is edited in place).
+    if os.path.isdir(source):
+        entries = []
+        for dirpath, _dn, filenames in os.walk(source):
+            for name in sorted(filenames):
+                fst = os.stat(os.path.join(dirpath, name))
+                entries.append((os.path.relpath(
+                    os.path.join(dirpath, name), source),
+                    getattr(fst, "st_mtime_ns", int(fst.st_mtime * 1e9)),
+                    fst.st_size))
+        ident = {"entries": sorted(entries)}
+    else:
+        st = os.stat(source)
+        ident = {"mtime_ns": getattr(st, "st_mtime_ns",
+                                     int(st.st_mtime * 1e9)),
+                 "size": st.st_size}
+    source_tag = json.dumps({"source": os.path.abspath(source), **ident},
+                            sort_keys=True)
+    tag_path = os.path.join(conn_dir, ".seed_source.json")
+
     def _find_seed(root: str) -> str:
         preferred = None
         candidates = []
@@ -544,7 +568,8 @@ def acquire_seed_db(source: str, base_dir: str, conn_id: str) -> str:
             for name in filenames:
                 if name == "seed.json":
                     preferred = os.path.join(dirpath, name)
-                elif name.endswith(".json"):
+                elif name.endswith(".json") and not name.startswith("."):
+                    # dotfiles (.seed_source.json marker) are metadata
                     candidates.append(os.path.join(dirpath, name))
         if preferred:
             return preferred
@@ -555,7 +580,15 @@ def acquire_seed_db(source: str, base_dir: str, conn_id: str) -> str:
                  f"{len(candidates)} candidates")
 
     if os.path.isdir(conn_dir):
-        return _find_seed(conn_dir)  # already acquired for this conn
+        try:
+            with open(tag_path, "r", encoding="utf-8") as f:
+                fresh = f.read() == source_tag
+        except OSError:
+            fresh = False  # pre-tag extraction or tampered dir: re-extract
+        if fresh:
+            return _find_seed(conn_dir)  # already acquired for this conn
+        logger.info("seed db source changed for %s; re-extracting", conn_id)
+        shutil.rmtree(conn_dir, ignore_errors=True)
 
     staging = conn_dir + ".tmp"
     shutil.rmtree(staging, ignore_errors=True)
@@ -594,6 +627,9 @@ def acquire_seed_db(source: str, base_dir: str, conn_id: str) -> str:
         else:
             raise NativeClientError(
                 400, f"unrecognized seed db format: {source}")
+        with open(os.path.join(staging, ".seed_source.json"), "w",
+                  encoding="utf-8") as f:
+            f.write(source_tag)
         os.replace(staging, conn_dir)  # atomic publish of the conn dir
     except Exception:
         shutil.rmtree(staging, ignore_errors=True)

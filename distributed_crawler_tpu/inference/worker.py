@@ -34,6 +34,7 @@ from ..bus.messages import (
 from ..utils.metrics import (
     REGISTRY,
     MetricsRegistry,
+    clear_status_provider,
     serve_metrics,
     set_status_provider,
 )
@@ -151,12 +152,16 @@ class TPUWorker:
 
     def stop(self, timeout_s: float = 10.0) -> None:
         self._stop.set()
-        # Unregister the process-global /status provider so a later
-        # server in this process 404s instead of serving a dead worker's
-        # map (and this worker's object graph can be collected).
-        set_status_provider(None)
+        # Unregister OUR /status provider (only if still active — another
+        # component may have registered since) so a later server in this
+        # process 404s instead of serving a dead worker's map.
+        clear_status_provider(self.get_status)
         for t in self._threads:
             t.join(timeout=timeout_s)
+        if self.provider is not None:
+            flush = getattr(self.provider, "flush", None)
+            if callable(flush):
+                flush()  # push any provider-side write buffering
         if self._metrics_server is not None:
             self._metrics_server.shutdown()
         if self._profiler_started:

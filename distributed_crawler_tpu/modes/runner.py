@@ -58,14 +58,25 @@ def ship_crawl_output(cfg: CrawlerConfig, crawl_exec_id: str) -> int:
         return 0
     tag = os.path.basename(root)
     os.makedirs(cfg.combine_watch_dir, exist_ok=True)
-    # Sweep temps stranded by a mid-copy crash: the names embed a
-    # nanosecond stamp, so retries would otherwise accumulate garbage.
+    # Sweep temps stranded by a mid-copy crash.  Partial names embed a
+    # host+pid writer id (tags are user-chosen and can prefix-collide,
+    # e.g. "run" vs "run_eu"; bare PIDs collide across containers that
+    # all run as pid 1 on a shared volume), so "ours" is exact: strands
+    # from an earlier exception in THIS process.  Foreign strands —
+    # another live shipper may be mid-copy in this shared dir — are
+    # reaped only once clearly abandoned (aged).
+    import socket as _socket
+    own_marker = f".{_socket.gethostname()}-{os.getpid()}.partial"
     for name in os.listdir(cfg.combine_watch_dir):
-        if name.endswith(".partial"):
-            try:
-                os.remove(os.path.join(cfg.combine_watch_dir, name))
-            except OSError:
-                pass
+        if not name.endswith(".partial"):
+            continue
+        path = os.path.join(cfg.combine_watch_dir, name)
+        try:
+            aged = (_time.time() - os.path.getmtime(path)) > 3600
+            if name.endswith(own_marker) or aged:
+                os.remove(path)
+        except OSError:
+            pass
     shipped = 0
     for channel in sorted(os.listdir(root)):
         src = os.path.join(root, channel, "posts", "posts.jsonl")
@@ -76,7 +87,9 @@ def ship_crawl_output(cfg: CrawlerConfig, crawl_exec_id: str) -> int:
         dest = os.path.join(
             cfg.combine_watch_dir,
             f"{tag}_{channel}_{_time.time_ns()}_posts.jsonl")
-        tmp = dest + ".partial"  # .tmp/.jsonl suffixes are watcher-visible
+        # PID-scoped temp (see sweep above); .jsonl-suffixed names are the
+        # watcher-visible ones, so any .partial suffix stays invisible.
+        tmp = dest + own_marker
         with open(tmp, "wb") as out, open(src, "rb") as inp:
             shutil.copyfileobj(inp, out)
             out.flush()

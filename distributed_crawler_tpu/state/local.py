@@ -74,6 +74,11 @@ class LocalStateManager(BaseStateManager):
         # in determine_crawl_id) must not overwrite state on close.
         if self._initialized:
             self.save_state()
+        # Push any provider-side write buffering (the object store batches
+        # appends; local FS is a no-op).
+        flush = getattr(self.provider, "flush", None)
+        if callable(flush):
+            flush()
 
     # --- posts/files ------------------------------------------------------
     def store_post(self, channel_id: str, post: Post) -> None:

@@ -339,11 +339,17 @@ class ObjectStorageProvider:
     """`providers.StorageProvider` over an object store, so state managers
     and the TPU worker's writeback can target the remote store directly."""
 
+    # Appended lines buffer client-side until this many bytes per key —
+    # an object store has no append, so per-line read-modify-write would
+    # be O(n²) total traffic over a large file.
+    APPEND_FLUSH_BYTES = 256 * 1024
+
     def __init__(self, client: ObjectStoreClient,
                  uploader: Optional[ObjectStoreUploader] = None):
         self.client = client
         self.uploader = uploader or ObjectStoreUploader(client)
         self._lock = threading.Lock()
+        self._append_buf: dict = {}  # key -> bytearray of pending lines
 
     def save_json(self, rel_path: str, data: Any) -> None:
         self.uploader.upload_bytes(
@@ -354,18 +360,58 @@ class ObjectStorageProvider:
         return None if raw is None else _json.loads(raw.decode("utf-8"))
 
     def append_jsonl(self, rel_path: str, line: str) -> None:
-        # Object stores have no append: read-modify-write under a local
-        # lock (single-writer per key is the provider contract here, as
-        # each worker owns its result keys).
+        # Buffered append (single-writer per key is the provider
+        # contract; each worker owns its result keys).  The read-modify-
+        # write against the store happens once per APPEND_FLUSH_BYTES —
+        # not once per line — and on flush()/close()/read-back.
         with self._lock:
-            prior = self.client.get_object(rel_path) or b""
-            self.uploader.upload_bytes(
-                rel_path, prior + line.rstrip("\n").encode("utf-8") + b"\n")
+            buf = self._append_buf.setdefault(rel_path, bytearray())
+            buf += line.rstrip("\n").encode("utf-8") + b"\n"
+            if len(buf) >= self.APPEND_FLUSH_BYTES:
+                self._flush_key_locked(rel_path)
+
+    def _flush_key_locked(self, rel_path: str) -> bytes:
+        """Upload buffered appends for ``rel_path``; returns the merged
+        object bytes (so readers need no second GET).  On upload failure
+        the buffer is REINSTATED before re-raising — accepted lines are
+        never dropped; the next flush retries them."""
+        buf = self._append_buf.pop(rel_path, None)
+        if not buf:
+            return self.client.get_object(rel_path) or b""
+        prior = self.client.get_object(rel_path) or b""
+        merged = prior + bytes(buf)
+        try:
+            self.uploader.upload_bytes(rel_path, merged)
+        except Exception:
+            existing = self._append_buf.get(rel_path)
+            if existing:  # appends that raced in during the upload
+                self._append_buf[rel_path] = buf + existing
+            else:
+                self._append_buf[rel_path] = buf
+            raise
+        return merged
+
+    def flush(self) -> None:
+        """Push all buffered appends to the store (call before handing
+        keys to another reader, and on shutdown)."""
+        with self._lock:
+            for key in list(self._append_buf):
+                self._flush_key_locked(key)
+
+    def close(self) -> None:
+        self.flush()
 
     def put_text(self, rel_path: str, text: str) -> None:
+        with self._lock:
+            self._append_buf.pop(rel_path, None)  # overwrite semantics
         self.uploader.upload_bytes(rel_path, text.encode("utf-8"))
 
     def get_text(self, rel_path: str) -> Optional[str]:
+        with self._lock:
+            if self._append_buf.get(rel_path):
+                # Flush returns the merged bytes: readers see appended
+                # rows without a second GET.
+                return self._flush_key_locked(rel_path).decode("utf-8")
         raw = self.client.get_object(rel_path)
         return None if raw is None else raw.decode("utf-8")
 
@@ -380,17 +426,32 @@ class ObjectStorageProvider:
         return rel_path
 
     def exists(self, rel_path: str) -> bool:
+        with self._lock:
+            if self._append_buf.get(rel_path):
+                return True  # buffered-but-unflushed rows still count
         return self.client.head_object(rel_path) is not None
 
     def list_dir(self, rel_path: str) -> List[str]:
         prefix = rel_path.rstrip("/") + "/"
         names = set()
+        with self._lock:
+            for key, buf in self._append_buf.items():
+                if buf and key.startswith(prefix):
+                    names.add(key[len(prefix):].split("/", 1)[0])
         for key in self.client.list_objects(prefix):
             names.add(key[len(prefix):].split("/", 1)[0])
         return sorted(names)
 
     def delete(self, rel_path: str) -> None:
-        for key in self.client.list_objects(rel_path.rstrip("/") + "/"):
+        prefix = rel_path.rstrip("/") + "/"
+        with self._lock:
+            # Drop the exact key AND any buffered keys under the prefix,
+            # or a later flush would resurrect "deleted" objects.
+            self._append_buf.pop(rel_path, None)
+            for key in [k for k in self._append_buf
+                        if k.startswith(prefix)]:
+                self._append_buf.pop(key, None)
+        for key in self.client.list_objects(prefix):
             self.client.delete_object(key)
         self.client.delete_object(rel_path)
 

@@ -39,6 +39,12 @@ class StorageProvider(Protocol):
 
     def delete(self, rel_path: str) -> None: ...
 
+    def flush(self) -> None:
+        """Push any client-side write buffering to durable storage.
+        A no-op for providers that write through (local FS); the object
+        store batches appends and relies on this at shutdown."""
+        ...
+
 
 class LocalStorageProvider:
     """Filesystem provider (`state/storageproviders.go:17-72`)."""
@@ -47,6 +53,9 @@ class LocalStorageProvider:
         self.base_path = base_path
         os.makedirs(base_path, exist_ok=True)
         self._lock = threading.Lock()
+
+    def flush(self) -> None:  # writes go straight to disk
+        pass
 
     def _abs(self, rel_path: str) -> str:
         return os.path.join(self.base_path, rel_path)
@@ -128,9 +137,14 @@ class InMemoryStorageProvider:
     def __init__(self):
         self.json_store: Dict[str, Any] = {}
         self.jsonl_store: Dict[str, List[str]] = {}
+        self.flushes = 0
         self.text_store: Dict[str, str] = {}
         self.files: Dict[str, bytes] = {}
         self.calls: List[tuple] = []
+
+    def flush(self) -> None:
+        self.calls.append(("flush", ""))
+        self.flushes += 1
 
     def save_json(self, rel_path: str, data: Any) -> None:
         self.calls.append(("save_json", rel_path))

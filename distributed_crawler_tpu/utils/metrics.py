@@ -168,6 +168,15 @@ def set_status_provider(fn) -> None:
     _status_provider = fn
 
 
+def clear_status_provider(fn) -> None:
+    """Unregister ``fn`` only if it is still the active provider — a
+    component stopping must not yank a provider someone else registered
+    after it (bound methods compare by (instance, function))."""
+    global _status_provider
+    if _status_provider == fn:
+        _status_provider = None
+
+
 class _Handler(BaseHTTPRequestHandler):
     registry: MetricsRegistry = REGISTRY
 

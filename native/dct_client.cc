@@ -838,25 +838,37 @@ std::string base64_encode(const std::string& in) {
 // RFC 7230 §4.1 de-chunking: hex size line CRLF data CRLF ... 0 CRLF CRLF.
 // Trailers (rare) are ignored; a malformed chunk header stops decoding at
 // what was parsed so far rather than returning framing bytes as content.
-std::string dechunk_body(const std::string& raw) {
-  std::string out;
-  size_t pos = 0;
+// Walk RFC 7230 chunk framing from `start` in place (no copies).  The
+// single walker serves both the completion check in the read loop and the
+// decoder, so the two can never disagree.  When `out` is non-null the
+// chunk DATA is appended to it (a truncated final chunk is appended as-is,
+// matching a Connection: close cutoff).  Returns true once the terminal
+// 0-size chunk has been seen — determined by walking the framing, not by
+// substring search (chunk DATA may legitimately contain "\r\n0\r\n").
+bool walk_chunks(const std::string& raw, size_t start, std::string* out) {
+  size_t pos = start;
   while (pos < raw.size()) {
     size_t line_end = raw.find("\r\n", pos);
-    if (line_end == std::string::npos) break;
+    if (line_end == std::string::npos) return false;  // size line cut off
     const std::string size_line = raw.substr(pos, line_end - pos);
     char* endp = nullptr;
     const long long size = std::strtoll(size_line.c_str(), &endp, 16);
-    if (endp == size_line.c_str() || size < 0) break;
-    if (size == 0) break;  // terminal chunk
+    if (endp == size_line.c_str() || size < 0) return false;  // malformed
+    if (size == 0) return true;  // terminal chunk reached
     pos = line_end + 2;
     if (pos + static_cast<size_t>(size) > raw.size()) {
-      out.append(raw, pos, raw.size() - pos);  // truncated final chunk
-      break;
+      if (out) out->append(raw, pos, raw.size() - pos);  // truncated tail
+      return false;
     }
-    out.append(raw, pos, static_cast<size_t>(size));
+    if (out) out->append(raw, pos, static_cast<size_t>(size));
     pos += static_cast<size_t>(size) + 2;  // skip data + CRLF
   }
+  return false;
+}
+
+std::string dechunk_body(const std::string& raw) {
+  std::string out;
+  walk_chunks(raw, 0, &out);
   return out;
 }
 
@@ -931,11 +943,14 @@ std::string https_get_impl(const std::string& config_json) {
         head_lower = data.substr(0, header_end);
         std::transform(head_lower.begin(), head_lower.end(),
                        head_lower.begin(), ::tolower);
-        size_t cl = head_lower.find("content-length:");
+        // Anchor on the preceding CRLF so e.g. "x-content-length:" can
+        // never mis-frame the body (every real header follows one — the
+        // status line ends with CRLF).
+        size_t cl = head_lower.find("\r\ncontent-length:");
         if (cl != std::string::npos)
           content_length =
-              std::strtoll(head_lower.c_str() + cl + 15, nullptr, 10);
-        chunked = head_lower.find("transfer-encoding: chunked") !=
+              std::strtoll(head_lower.c_str() + cl + 17, nullptr, 10);
+        chunked = head_lower.find("\r\ntransfer-encoding: chunked") !=
                   std::string::npos;
       }
     }
@@ -944,9 +959,14 @@ std::string https_get_impl(const std::string& config_json) {
           static_cast<int64_t>(data.size() - header_end - 4) >=
               content_length)
         break;
-      if (chunked &&
-          data.find("\r\n0\r\n", header_end + 2) != std::string::npos)
-        break;  // last-chunk marker seen
+      // Cheap gate first: a complete chunked message always ends with
+      // "\r\n" after the 0-chunk (+ optional trailers), so most mid-
+      // stream segments skip the framing walk entirely — and the walk
+      // itself is in-place (no body copy per recv).
+      if (chunked && data.size() >= 2 &&
+          data.compare(data.size() - 2, 2, "\r\n") == 0 &&
+          walk_chunks(data, header_end + 4, nullptr))
+        break;  // terminal chunk reached (framing-walked, not substring)
     }
   }
   if (data.size() < 12 || data.compare(0, 5, "HTTP/") != 0 ||

@@ -424,6 +424,31 @@ class TestSeedDbAcquisition:
             acquire_seed_db("https://example.com/dbs.tgz",
                             str(tmp_path), "c1")
 
+    def test_changed_source_reextracts(self, tmp_path):
+        """A replaced/updated tarball at the same path must re-extract —
+        stale conn dirs silently serving old seed data is the failure."""
+        import time as time_mod
+
+        from distributed_crawler_tpu.clients.native import acquire_seed_db
+
+        tar = self._tarball(tmp_path)
+        base = str(tmp_path / "dbs")
+        seed1 = acquire_seed_db(tar, base, "conn-s")
+        v1 = open(seed1).read()
+        # Same source untouched: reuse (no re-extract).
+        assert acquire_seed_db(tar, base, "conn-s") == seed1
+        # Replace the tarball content (ensure a different mtime).
+        time_mod.sleep(0.01)
+        src = tmp_path / "src"
+        (src / "seed.json").write_text(SEED.replace("wirechan", "newchan"))
+        import tarfile as tarfile_mod
+        with tarfile_mod.open(tar, "w:gz") as t:
+            t.add(src / "seed.json", arcname="db/seed.json")
+        os.utime(tar)
+        seed2 = acquire_seed_db(tar, base, "conn-s")
+        assert "newchan" in open(seed2).read()
+        assert "newchan" not in v1
+
     def test_extract_without_filter_kwarg(self, tmp_path, monkeypatch):
         """Pythons without the `filter=` backport (<3.10.12/<3.11.4) still
         extract — via the manual path-safety fallback."""
@@ -554,6 +579,76 @@ class TestHttpEdgeCases:
             assert status == 200
             assert body.decode() == html  # no chunk-size lines embedded
             assert parse_channel_html(body.decode()).status == "valid"
+        finally:
+            srv.shutdown()
+
+    def test_chunked_body_containing_bare_zero_line(self, tmp_path):
+        """Chunk DATA containing a lone '0' line must not be mistaken for
+        the terminal chunk — completion is framing-walked."""
+        import http.server
+
+        html = ('<html><head><title>Telegram: View @wirechan</title>'
+                '</head><body>count:\r\n0\r\nmore text after zero'
+                '</body></html>')
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_GET(self):
+                self.send_response(200)
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                data = html.encode()
+                self.wfile.write(f"{len(data):x}\r\n".encode() + data +
+                                 b"\r\n0\r\n\r\n")
+
+            def log_message(self, *a):
+                pass
+
+        from distributed_crawler_tpu.clients.http_validator import (
+            chrome_transport,
+        )
+
+        srv = self._serve(tmp_path, Handler)
+        try:
+            status, body = chrome_transport(
+                f"https://127.0.0.1:{srv.server_address[1]}/wirechan",
+                {}, tls_insecure=True)
+            assert status == 200
+            assert body.decode() == html  # nothing truncated at the '0'
+        finally:
+            srv.shutdown()
+
+    def test_x_content_length_header_ignored(self, tmp_path):
+        """Only the real Content-Length header frames the body."""
+        import http.server
+
+        html = ('<html><head><title>Telegram: View @wirechan</title>'
+                '</head><body>long enough body text here</body></html>')
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = html.encode()
+                self.send_response(200)
+                self.send_header("X-Content-Length", "5")  # red herring
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        from distributed_crawler_tpu.clients.http_validator import (
+            chrome_transport,
+        )
+
+        srv = self._serve(tmp_path, Handler)
+        try:
+            status, body = chrome_transport(
+                f"https://127.0.0.1:{srv.server_address[1]}/wirechan",
+                {}, tls_insecure=True)
+            assert status == 200
+            assert body.decode() == html  # not truncated to 5 bytes
         finally:
             srv.shutdown()
 

@@ -159,6 +159,37 @@ class TestObjectStorageProvider:
         assert rows and rows[0]["label"] == 1
 
 
+class TestBufferedAppend:
+    def test_appends_batch_until_flush_threshold(self):
+        """append_jsonl buffers client-side: the store sees one
+        read-modify-write per flush, not per line — O(n), not O(n^2)."""
+        from distributed_crawler_tpu.state.objectstore import (
+            InMemoryObjectClient,
+            ObjectStorageProvider,
+        )
+
+        client = InMemoryObjectClient()
+        puts = []
+        orig = client.put_object
+
+        def counting_put(key, data, *a, **kw):
+            puts.append(key)
+            return orig(key, data, *a, **kw)
+
+        client.put_object = counting_put
+        p = ObjectStorageProvider(client)
+        for i in range(100):
+            p.append_jsonl("r/x.jsonl", f'{{"i": {i}}}')
+        assert len(puts) == 0  # under the threshold: nothing uploaded yet
+        # Reading flushes first so consumers see every appended row.
+        text = p.get_text("r/x.jsonl")
+        assert len(text.splitlines()) == 100
+        assert len(puts) == 1  # exactly one upload for 100 lines
+        p.append_jsonl("r/x.jsonl", '{"i": 100}')
+        p.flush()
+        assert len(p.get_text("r/x.jsonl").splitlines()) == 101
+
+
 class TestChunkerToObjectStore:
     def test_combine_upload_e2e_with_transient_failures(self, tmp_path):
         """Shards → chunker combine → object store upload (riding out an
