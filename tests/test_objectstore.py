@@ -427,6 +427,44 @@ class TestPathEscape:
         assert not (tmp_path / "store-evil").exists()
 
 
+class TestPartialSweepScoping:
+    def test_foreign_fresh_partials_survive_the_sweep(self, tmp_path):
+        """Another live shipper's in-flight .partial on the shared watch
+        volume must NOT be reaped; our own strands and clearly aged
+        foreign ones are."""
+        import json as _json
+        import os as _os
+        import socket as _socket
+
+        from distributed_crawler_tpu.config.crawler import CrawlerConfig
+        from distributed_crawler_tpu.modes.runner import ship_crawl_output
+
+        cfg = CrawlerConfig()
+        cfg.storage_root = str(tmp_path / "store")
+        cfg.crawl_id = "sw1"
+        cfg.combine_watch_dir = str(tmp_path / "watch")
+        posts_dir = tmp_path / "store" / "sw1" / "chanA" / "posts"
+        posts_dir.mkdir(parents=True)
+        (posts_dir / "posts.jsonl").write_text(
+            _json.dumps({"post_uid": "1"}) + "\n")
+        watch = tmp_path / "watch"
+        watch.mkdir()
+        own = f".{_socket.gethostname()}-{_os.getpid()}.partial"
+        stranded_own = watch / f"old_x_1{own}"
+        stranded_own.write_text("ours")
+        foreign_fresh = watch / "other_y_2.otherhost-1.partial"
+        foreign_fresh.write_text("theirs, mid-copy")
+        foreign_aged = watch / "other_z_3.otherhost-9.partial"
+        foreign_aged.write_text("theirs, abandoned")
+        old = _os.path.getmtime(foreign_aged) - 7200
+        _os.utime(foreign_aged, (old, old))
+
+        assert ship_crawl_output(cfg, "sw1") == 1
+        assert not stranded_own.exists()      # ours: reaped
+        assert foreign_fresh.exists()         # live peer: untouched
+        assert not foreign_aged.exists()      # abandoned: reaped
+
+
 class TestResumeNoDuplicateShip:
     def test_second_launch_ships_only_new_rows(self, tmp_path):
         """ship_crawl_output MOVES post files: a re-run of the same crawl
