@@ -163,40 +163,69 @@ def _measure(scale_devices: int | None = None,
         ids, mask = placed["ids"], placed["mask"]
         _log(f"sharded over mesh {dict(mesh.shape)}")
 
-    @jax.jit
-    def chained(p, ids, mask, n):
-        def body(_, ids):
-            emb, _logits = model.apply(p, ids, mask)
-            delta = (emb[:, :1] * 1000).astype(jnp.int32) % cfg.vocab_size
-            return (ids + delta) % cfg.vocab_size
-        return jax.lax.fori_loop(0, n, body, ids)
+    def make_chained(m):
+        @jax.jit
+        def chained(p, ids, mask, n):
+            def body(_, ids):
+                emb, _logits = m.apply(p, ids, mask)
+                delta = (emb[:, :1] * 1000).astype(jnp.int32) % cfg.vocab_size
+                return (ids + delta) % cfg.vocab_size
+            return jax.lax.fori_loop(0, n, body, ids)
+        return chained
+
+    chained = make_chained(model)
 
     t0 = time.perf_counter()
     float(chained(params, ids, mask, 1).sum())  # warmup + compile
     _log(f"compile+warmup done in {time.perf_counter() - t0:.1f}s")
 
-    def timed(n: int) -> float:
-        t0 = time.perf_counter()
-        float(chained(params, ids, mask, n).sum())
-        return time.perf_counter() - t0
+    def fit_t_iter(step_fn, p) -> float:
+        def timed(n: int) -> float:
+            t0 = time.perf_counter()
+            float(step_fn(p, ids, mask, n).sum())
+            return time.perf_counter() - t0
 
-    t_iter = 0.0
-    for _ in range(3):  # scheduler noise can invert the two-point fit
-        t_short = min(timed(n_short) for _ in range(repeats))
-        t_long = min(timed(n_long) for _ in range(repeats))
-        t_iter = (t_long - t_short) / (n_long - n_short)
-        if t_iter > 0:
-            break
-        _log("two-point fit inverted (noise); re-measuring")
-    if t_iter <= 0:
+        t_iter = 0.0
+        for _ in range(3):  # scheduler noise can invert the two-point fit
+            t_short = min(timed(n_short) for _ in range(repeats))
+            t_long = min(timed(n_long) for _ in range(repeats))
+            t_iter = (t_long - t_short) / (n_long - n_short)
+            if t_iter > 0:
+                return t_iter
+            _log("two-point fit inverted (noise); re-measuring")
         raise RuntimeError(
             f"timing fit stayed non-positive (t_short={t_short:.4f}s, "
             f"t_long={t_long:.4f}s): host too noisy for a measurement")
+
+    t_iter = fit_t_iter(chained, params)
     posts_per_sec = batch / t_iter
     _log(f"throughput: {posts_per_sec:.1f} posts/sec (t_iter={t_iter*1e3:.2f}ms)")
 
     if scale_devices is not None:
         return {"posts_per_sec": posts_per_sec}
+
+    # Int8 serving path (ops/quant.py): same chained methodology over the
+    # quantized model.  Best-effort — a failure here never costs the bf16
+    # headline, which stays the reported `value`.
+    int8_pps = None
+    try:
+        from distributed_crawler_tpu.models.quant import (
+            quantize_encoder_params,
+        )
+
+        qmodel = EmbedderClassifier(replace(cfg, quant="int8"))
+        qparams = quantize_encoder_params(params)
+        chained_q = make_chained(qmodel)
+
+        t0 = time.perf_counter()
+        float(chained_q(qparams, ids, mask, 1).sum())
+        _log(f"int8 compile+warmup done in {time.perf_counter() - t0:.1f}s")
+        t_iter_q = fit_t_iter(chained_q, qparams)
+        int8_pps = batch / t_iter_q
+        _log(f"int8 throughput: {int8_pps:.1f} posts/sec "
+             f"(speedup {int8_pps / posts_per_sec:.2f}x)")
+    except Exception as exc:  # noqa: BLE001 — int8 row is best-effort
+        _log(f"int8 measurement skipped: {exc}")
 
     # Per-batch latency: one step closed with a scalar readback each time —
     # the latency a TPUWorker batch actually experiences (includes RPC).
@@ -234,6 +263,9 @@ def _measure(scale_devices: int | None = None,
         "batch_latency_p50_ms": round(p50, 2),
         "batch_latency_p99_ms": round(p99, 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "int8_posts_per_sec": round(int8_pps, 1) if int8_pps else None,
+        "int8_speedup": round(int8_pps / posts_per_sec, 2) if int8_pps
+        else None,
         "platform": jax.default_backend(),
         "device_kind": jax.devices()[0].device_kind,
         "n_devices": use_dev,

@@ -136,6 +136,10 @@ def build_parser() -> argparse.ArgumentParser:
     a("--infer-param-dtype", default=None,
       help="cast float params at engine startup (e.g. bfloat16) — halves "
            "weight HBM traffic when serving; empty keeps the f32 layout")
+    a("--infer-quantize", default=None,
+      help="quantize the projection GEMMs at engine startup ('int8' runs "
+           "them int8*int8->int32 on the MXU at 2x bf16 peak; empty keeps "
+           "the float path; train-head always ignores this)")
     # Classifier fine-tune (mode=train-head): crawl JSONL + labels ->
     # orbax checkpoint the engine reloads via --head-checkpoint.
     a("--train-posts", default=None,
@@ -227,6 +231,7 @@ _KEY_MAP = {
     "infer_model": "inference.model",
     "infer_batch_size": "inference.batch_size",
     "infer_param_dtype": "inference.param_dtype",
+    "infer_quantize": "inference.quantize",
     "train_posts": "train.posts_file",
     "train_labels": "train.labels_file",
     "head_checkpoint": "train.checkpoint_dir",
@@ -309,6 +314,7 @@ def resolve_config(args: argparse.Namespace,
     if buckets:
         cfg.inference.bucket_sizes = [int(b) for b in buckets]
     cfg.inference.param_dtype = r.get_str("inference.param_dtype", "")
+    cfg.inference.quantize = r.get_str("inference.quantize", "")
     cfg.inference.pretrained_dir = r.get_str(
         "inference.pretrained_dir", cfg.inference.pretrained_dir)
     cfg.inference.asr_pretrained_dir = r.get_str(
@@ -830,8 +836,9 @@ def _make_engine(cfg: CrawlerConfig, r: ConfigResolver,
     """One engine-wiring path for tpu-worker / train-head / cluster.
 
     ``cast_params=False`` keeps the f32 layout regardless of
-    ``inference.param_dtype`` — train-head must fine-tune on (and persist)
-    full-precision weights even when the same config file serves bf16."""
+    ``inference.param_dtype`` / ``inference.quantize`` — train-head must
+    fine-tune on (and persist) full-precision weights even when the same
+    config file serves bf16 or int8."""
     from .inference.engine import EngineConfig, InferenceEngine
 
     kw = dict(
@@ -840,7 +847,8 @@ def _make_engine(cfg: CrawlerConfig, r: ConfigResolver,
         buckets=tuple(cfg.inference.bucket_sizes),
         pretrained_dir=cfg.inference.pretrained_dir or None,
         param_dtype=(cfg.inference.param_dtype or None)
-        if cast_params else None)
+        if cast_params else None,
+        quantize=(cfg.inference.quantize or None) if cast_params else None)
     if n_labels is not None:
         kw["n_labels"] = n_labels
     if with_checkpoint:

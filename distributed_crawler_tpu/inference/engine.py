@@ -58,6 +58,11 @@ class EngineConfig:
     # halving weight HBM traffic per step.  Serving-only: never persist
     # bf16-cast params back into a training checkpoint.
     param_dtype: Optional[str] = None
+    # "int8": quantize the projection GEMMs at startup and run them
+    # int8×int8→int32 on the MXU (2× bf16 peak on v5e; see ops/quant.py).
+    # Applies over whatever params were loaded (random / pretrained /
+    # checkpoint); the float source tree is discarded after conversion.
+    quantize: Optional[str] = None
 
     def encoder_config(self) -> EncoderConfig:
         try:
@@ -133,6 +138,15 @@ class InferenceEngine:
                 lambda x: x.astype(target)
                 if hasattr(x, "dtype") and x.dtype == jnp.float32 else x,
                 params)
+        if cfg.quantize:
+            if cfg.quantize != "int8":
+                raise ValueError(f"unknown quantize mode {cfg.quantize!r}")
+            from ..models.quant import quantize_encoder_params
+
+            params = quantize_encoder_params(params)
+            self.ecfg = replace(self.ecfg, quant="int8")
+            self.ecfg.validate()
+            self.model = EmbedderClassifier(self.ecfg)
         if mesh is not None:
             from ..parallel.sharding import shard_params
 

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import mha
+from ..ops.quant import int8_dense, int8_qkv
 
 
 @dataclass(frozen=True)
@@ -46,6 +47,11 @@ class EncoderConfig:
     dtype: str = "bfloat16"           # activation dtype
     attention: str = "auto"           # auto | xla | flash
     remat: bool = False               # jax.checkpoint each layer (training)
+    # "int8": the four projection GEMMs per layer run int8×int8→int32 on
+    # the MXU (2× bf16 peak on v5e, half the weight HBM traffic).  Params
+    # must be in the quantized layout (`models/quant.quantize_encoder_params`
+    # converts a float checkpoint); serving-only — training always "none".
+    quant: str = "none"
 
     @property
     def head_dim(self) -> int:
@@ -59,6 +65,11 @@ class EncoderConfig:
         if self.hidden % self.n_heads != 0:
             raise ValueError(
                 f"hidden {self.hidden} not divisible by heads {self.n_heads}")
+        if self.quant not in ("none", "int8"):
+            raise ValueError(f"unknown quant mode {self.quant!r}")
+        if self.quant != "none" and self.n_experts:
+            raise ValueError("int8 quantization does not cover the MoE "
+                             "expert GEMMs; use a dense MLP config")
 
 
 # Published configs (sizes match the HF checkpoints these mirror).
@@ -75,6 +86,39 @@ TINY_TEST = EncoderConfig(vocab_size=1024, hidden=64, n_layers=2, n_heads=4,
                           mlp_dim=128, max_len=128, dtype="float32")
 
 
+class QuantDense(nn.Module):
+    """Int8 drop-in for the projection `nn.Dense`s (serving only).
+
+    Param layout: ``kernel_q`` int8 [in, out] + ``scale`` f32 [out] +
+    ``bias`` f32 [out] — produced from a float checkpoint by
+    `models/quant.quantize_encoder_params`, never trained directly (the
+    zeros/ones initializers only exist so `.init()` yields the right
+    shapes for shape-driven code paths)."""
+
+    features: int
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x):
+        in_dim = x.shape[-1]
+        w_q = self.param("kernel_q", nn.initializers.zeros,
+                         (in_dim, self.features), jnp.int8)
+        scale = self.param("scale", nn.initializers.ones,
+                           (self.features,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.features,), jnp.float32)
+        return int8_dense(x, w_q, scale, bias, out_dtype=self.cfg.adtype)
+
+
+def _proj(cfg: EncoderConfig, features: int, name: str):
+    """Projection layer: bf16 `nn.Dense` or its int8 twin, same name so
+    the sharding rules and checkpoint paths stay stable."""
+    if cfg.quant == "int8":
+        return QuantDense(features, cfg, name=name)
+    return nn.Dense(features, dtype=cfg.adtype, param_dtype=jnp.float32,
+                    name=name)
+
+
 class SelfAttention(nn.Module):
     cfg: EncoderConfig
 
@@ -87,24 +131,32 @@ class SelfAttention(nn.Module):
         # 128x128 MXU tiles; the kernel keeps q/k/v on a dedicated axis so
         # tp-sharding the LAST axis stays head-aligned (no projection is
         # ever split across devices).
-        w = self.param(
-            "qkv/kernel",
-            nn.initializers.variance_scaling(1.0, "fan_in",
-                                             "truncated_normal",
-                                             in_axis=0, out_axis=(1, 2)),
-            (cfg.hidden, 3, cfg.hidden), jnp.float32)
-        bias = self.param("qkv/bias", nn.initializers.zeros,
-                          (3, cfg.hidden), jnp.float32)
-        proj = jnp.einsum("blh,hto->blto", x.astype(cfg.adtype),
-                          w.astype(cfg.adtype)) + bias.astype(cfg.adtype)
+        if cfg.quant == "int8":
+            w_q = self.param("qkv/kernel_q", nn.initializers.zeros,
+                             (cfg.hidden, 3, cfg.hidden), jnp.int8)
+            scale = self.param("qkv/scale", nn.initializers.ones,
+                               (3, cfg.hidden), jnp.float32)
+            bias = self.param("qkv/bias", nn.initializers.zeros,
+                              (3, cfg.hidden), jnp.float32)
+            proj = int8_qkv(x, w_q, scale, bias, out_dtype=cfg.adtype)
+        else:
+            w = self.param(
+                "qkv/kernel",
+                nn.initializers.variance_scaling(1.0, "fan_in",
+                                                 "truncated_normal",
+                                                 in_axis=0, out_axis=(1, 2)),
+                (cfg.hidden, 3, cfg.hidden), jnp.float32)
+            bias = self.param("qkv/bias", nn.initializers.zeros,
+                              (3, cfg.hidden), jnp.float32)
+            proj = jnp.einsum("blh,hto->blto", x.astype(cfg.adtype),
+                              w.astype(cfg.adtype)) + bias.astype(cfg.adtype)
         q = proj[:, :, 0].reshape(b, l, cfg.n_heads, cfg.head_dim)
         k = proj[:, :, 1].reshape(b, l, cfg.n_heads, cfg.head_dim)
         v = proj[:, :, 2].reshape(b, l, cfg.n_heads, cfg.head_dim)
         use_flash = {"auto": None, "xla": False, "flash": True}[cfg.attention]
         o = mha(q, k, v, kv_mask=mask, use_flash=use_flash)
         o = o.reshape(b, l, cfg.hidden)
-        return nn.Dense(cfg.hidden, dtype=cfg.adtype,
-                        param_dtype=jnp.float32, name="attn_out")(o)
+        return _proj(cfg, cfg.hidden, "attn_out")(o)
 
 
 class DenseMLP(nn.Module):
@@ -113,13 +165,11 @@ class DenseMLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        h = nn.Dense(cfg.mlp_dim, dtype=cfg.adtype, param_dtype=jnp.float32,
-                     name="mlp_up")(x)
+        h = _proj(cfg, cfg.mlp_dim, "mlp_up")(x)
         # Exact (erf) GELU: parity with published BERT/RoBERTa checkpoints;
         # XLA fuses erf into the matmul epilogue so tanh-approx buys nothing.
         h = nn.gelu(h, approximate=False)
-        return nn.Dense(cfg.hidden, dtype=cfg.adtype, param_dtype=jnp.float32,
-                        name="mlp_down")(h)
+        return _proj(cfg, cfg.hidden, "mlp_down")(h)
 
 
 class SwitchMoE(nn.Module):

@@ -1,0 +1,93 @@
+"""Float→int8 conversion of encoder checkpoints (serving-time, one-shot).
+
+`quantize_encoder_params` rewrites a float `EmbedderClassifier`/`Encoder`
+param tree into the layout `models/encoder.QuantDense` + the int8 fused-QKV
+branch expect:
+
+    layers_i/attn/qkv/kernel   [h,3,h] f32  →  qkv/kernel_q int8 + qkv/scale [3,h]
+    layers_i/attn/attn_out/kernel          →  kernel_q + scale (+ bias kept f32)
+    layers_i/{mlp/mlp_up, mlp/mlp_down}/kernel → likewise
+
+Everything else (embeddings, layernorms, pooler, head) passes through
+unchanged — those stay in the float path by design (`ops/quant.py`
+module docstring).  The conversion is lossy and one-way: never write the
+result back over a training checkpoint.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.quant import quantize_weights
+
+# Dense projections quantized per layer: flax module name → present under
+# layers_i/<attn|mlp>/.  (MoE experts are rejected upstream by
+# EncoderConfig.validate.)
+_PROJ_MODULES = ("attn_out", "mlp_up", "mlp_down")
+
+
+def _quantize_dense(mod: Dict[str, Any]) -> Dict[str, Any]:
+    w_q, scale = quantize_weights(jnp.asarray(mod["kernel"], jnp.float32),
+                                  contract_axis=0)
+    out = {"kernel_q": w_q, "scale": scale}
+    if "bias" in mod:
+        out["bias"] = jnp.asarray(mod["bias"], jnp.float32)
+    return out
+
+
+def quantize_encoder_params(params: Any) -> Any:
+    """Return a new param tree with the projection GEMMs int8-quantized.
+
+    Accepts the usual ``{"params": {...}}`` wrapper or a bare tree; the
+    encoder may sit at top level or under ``encoder`` (Embedder/Classifier
+    wrappers).  Idempotent on already-quantized trees.
+    """
+    from flax.core import unfreeze
+
+    params = unfreeze(params)  # no-op on plain dicts
+    wrapped = isinstance(params, dict) and set(params) == {"params"}
+    tree = params["params"] if wrapped else params
+    tree = dict(tree)
+    enc_key = "encoder" if "encoder" in tree else None
+    enc = dict(tree[enc_key]) if enc_key else tree
+
+    for name, layer in list(enc.items()):
+        if not name.startswith("layers_"):
+            continue
+        layer = {k: dict(v) if isinstance(v, dict) else v
+                 for k, v in layer.items()}
+        attn = layer.get("attn")
+        if isinstance(attn, dict) and "qkv/kernel" in attn:
+            w_q, scale = quantize_weights(
+                jnp.asarray(attn.pop("qkv/kernel"), jnp.float32),
+                contract_axis=0)
+            attn["qkv/kernel_q"] = w_q          # [h, 3, h] int8
+            attn["qkv/scale"] = scale           # [3, h] f32
+            attn["qkv/bias"] = jnp.asarray(attn["qkv/bias"], jnp.float32)
+        for holder_name in ("attn", "mlp"):
+            holder = layer.get(holder_name)
+            if not isinstance(holder, dict):
+                continue
+            for mod_name in _PROJ_MODULES:
+                mod = holder.get(mod_name)
+                if isinstance(mod, dict) and "kernel" in mod:
+                    holder[mod_name] = _quantize_dense(mod)
+        enc[name] = layer
+
+    if enc_key:
+        tree[enc_key] = enc
+    else:
+        tree = enc
+    return {"params": tree} if wrapped else tree
+
+
+def quantized_size_bytes(params: Any) -> int:
+    """Total param bytes (diagnostic: int8 trees should be ~4× smaller on
+    the projection kernels than their f32 source)."""
+    import jax
+
+    return sum(int(np.prod(x.shape)) * jnp.dtype(x.dtype).itemsize
+               for x in jax.tree.leaves(params) if hasattr(x, "shape"))

@@ -1,0 +1,113 @@
+"""Int8 quantized matmul primitives for TPU serving.
+
+The v5e MXU runs int8×int8→int32 at twice the bf16 FLOP rate, and int8
+weights halve HBM traffic — the two resources that bound the encoder's
+serving throughput (SURVEY.md §6 north-star metric).  The scheme here is
+the standard accuracy-preserving one:
+
+- **weights**: per-output-channel symmetric int8, quantized once at engine
+  startup (`models/quant.quantize_encoder_params`);
+- **activations**: per-token dynamic symmetric int8, computed inside the
+  jitted step (one abs-max reduction — XLA fuses it into the preceding
+  elementwise epilogue);
+- **accumulation**: int32 via `lax.dot_general(preferred_element_type)`,
+  dequantized in f32: ``out = acc * a_scale[token] * w_scale[channel]``.
+
+Only the four projection GEMMs per layer go through this path (qkv,
+attn_out, mlp_up, mlp_down).  Embeddings, layernorms, softmax, pooling and
+the classifier head stay f32/bf16 — they are bandwidth-trivial and
+precision-critical.
+
+No reference analog (the reference is a crawler, not an ML framework);
+this exists to push the BASELINE.md headline (≥50k posts/sec on v5e-8)
+past what bf16 alone reaches.
+
+Measured honestly (bench.py `int8_speedup`): at E5-small width on a single
+v5e the dynamic-requant overhead outweighs the MXU gain (~0.79× vs bf16),
+so int8 stays OPT-IN (`inference.quantize: int8`) — it is aimed at the
+wider E5-large/XLM-R configs where the projection GEMMs dominate.  bf16 is
+the serving default either way.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# 127 (not 128) so the grid is symmetric: -127..127 both representable,
+# and the MXU's int8 range is never saturated by the quantization itself.
+_QMAX = 127.0
+
+
+def quantize_weights(w: jax.Array, contract_axis: int = 0
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """Per-output-channel symmetric int8 quantization of a kernel.
+
+    ``contract_axis`` is the axis that the matmul sums over; every OTHER
+    axis gets its own scale (for a 2-D [in, out] kernel that's one scale
+    per output column; for the fused QKV [h, 3, h] kernel it's a [3, h]
+    scale grid).
+
+    Returns ``(w_q int8, scale f32)`` with ``w ≈ w_q * scale`` (scale
+    broadcast over the contracted axis).
+    """
+    w = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=contract_axis, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / _QMAX
+    w_q = jnp.clip(jnp.round(w / scale), -_QMAX, _QMAX).astype(jnp.int8)
+    return w_q, jnp.squeeze(scale, axis=contract_axis)
+
+
+def quantize_activations(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-token (last-axis) dynamic symmetric int8 quantization.
+
+    Returns ``(x_q int8, a_scale f32)`` where ``a_scale`` keeps the
+    trailing axis as size 1 so it broadcasts against the dequantized
+    accumulator.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    a_scale = jnp.maximum(amax, 1e-8) / _QMAX
+    x_q = jnp.clip(jnp.round(x / a_scale), -_QMAX, _QMAX).astype(jnp.int8)
+    return x_q, a_scale
+
+
+def int8_dense(x: jax.Array, w_q: jax.Array, w_scale: jax.Array,
+               bias: Optional[jax.Array] = None,
+               out_dtype: jnp.dtype = jnp.bfloat16) -> jax.Array:
+    """``x @ w`` with both sides int8, int32 accumulation, f32 dequant.
+
+    x: [..., in] float; w_q: [in, out] int8; w_scale: [out] f32;
+    bias: [out] f32 or None.  Returns [..., out] in ``out_dtype``.
+    """
+    x_q, a_scale = quantize_activations(x)
+    acc = jax.lax.dot_general(
+        x_q, w_q,
+        dimension_numbers=(((x_q.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * a_scale * w_scale
+    if bias is not None:
+        out = out + bias
+    return out.astype(out_dtype)
+
+
+def int8_qkv(x: jax.Array, w_q: jax.Array, w_scale: jax.Array,
+             bias: Optional[jax.Array] = None,
+             out_dtype: jnp.dtype = jnp.bfloat16) -> jax.Array:
+    """Fused QKV projection, int8: [..., h] × [h, 3, h] → [..., 3, h].
+
+    Mirrors the bf16 einsum ``blh,hto->blto`` in
+    `models/encoder.SelfAttention` — q/k/v on the middle output axis so
+    tp-sharding the last axis stays head-aligned.  w_scale/bias: [3, h].
+    """
+    x_q, a_scale = quantize_activations(x)
+    acc = jax.lax.dot_general(
+        x_q, w_q,
+        dimension_numbers=(((x_q.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)        # [..., 3, h] int32
+    out = acc.astype(jnp.float32) * a_scale[..., None] * w_scale
+    if bias is not None:
+        out = out + bias
+    return out.astype(out_dtype)

@@ -33,15 +33,19 @@ ENCODER_PARAM_RULES: List[ParamRule] = [
     # Fused QKV [h, 3, h]: shard the head (last) axis so every device
     # holds all three projections for its head slice.
     (r".*/qkv/kernel$", P(None, None, AXIS_TP)),
+    # Int8 serving layout (models/quant.py): kernel_q shards exactly like
+    # its float source; per-output-channel scales follow the bias layout.
+    (r".*/qkv/kernel_q$", P(None, None, AXIS_TP)),
+    (r".*/qkv/scale$", P(None, AXIS_TP)),
     (r".*/qkv/bias$", P(None, AXIS_TP)),
     (r".*/(q|k|v)/kernel$", P(None, AXIS_TP)),
     (r".*/(q|k|v)/bias$", P(AXIS_TP)),
-    (r".*/attn_out/kernel$", P(AXIS_TP, None)),
-    (r".*/attn_out/bias$", P()),
-    (r".*/mlp_up/kernel$", P(None, AXIS_TP)),
-    (r".*/mlp_up/bias$", P(AXIS_TP)),
-    (r".*/mlp_down/kernel$", P(AXIS_TP, None)),
-    (r".*/mlp_down/bias$", P()),
+    (r".*/attn_out/kernel(_q)?$", P(AXIS_TP, None)),
+    (r".*/attn_out/(bias|scale)$", P()),
+    (r".*/mlp_up/kernel(_q)?$", P(None, AXIS_TP)),
+    (r".*/mlp_up/(bias|scale)$", P(AXIS_TP)),
+    (r".*/mlp_down/kernel(_q)?$", P(AXIS_TP, None)),
+    (r".*/mlp_down/(bias|scale)$", P()),
     # MoE experts: expert dim sharded over tp (expert parallelism rides the
     # same axis; a dedicated 'ep' axis would be overkill at inference scale).
     (r".*/experts_up/kernel$", P(AXIS_TP, None, None)),

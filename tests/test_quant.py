@@ -1,0 +1,228 @@
+"""Int8 quantized serving path (ops/quant.py + models/quant.py).
+
+Strategy per SURVEY.md §4: pure-function accuracy bounds on the
+primitives, float-vs-int8 parity on the full model (the property that
+matters: embeddings and logits from the quantized encoder track the f32
+encoder), engine e2e, and the mesh path on the 8-device virtual CPU
+backend."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_crawler_tpu.models.encoder import (
+    TINY_TEST,
+    EmbedderClassifier,
+    EncoderConfig,
+)
+from distributed_crawler_tpu.models.quant import (
+    quantize_encoder_params,
+    quantized_size_bytes,
+)
+from distributed_crawler_tpu.ops.quant import (
+    int8_dense,
+    int8_qkv,
+    quantize_activations,
+    quantize_weights,
+)
+
+
+def _cos(a, b):
+    a = np.asarray(a, np.float64).ravel()
+    b = np.asarray(b, np.float64).ravel()
+    return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+
+
+class TestPrimitives:
+    def test_weight_roundtrip_error_bounded(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (64, 32))
+        w_q, scale = quantize_weights(w)
+        deq = w_q.astype(jnp.float32) * scale
+        # Symmetric per-channel: error ≤ half a quantization step per column.
+        step = np.asarray(scale)
+        err = np.abs(np.asarray(deq) - np.asarray(w))
+        assert (err <= 0.5 * step[None, :] + 1e-6).all()
+
+    def test_weight_scale_per_output_channel(self):
+        w = jnp.ones((16, 4)) * jnp.asarray([1.0, 2.0, 4.0, 8.0])
+        w_q, scale = quantize_weights(w)
+        np.testing.assert_allclose(np.asarray(scale) * 127.0,
+                                   [1.0, 2.0, 4.0, 8.0], rtol=1e-6)
+
+    def test_activation_scale_per_token(self):
+        x = jnp.stack([jnp.ones(8), 10.0 * jnp.ones(8)])
+        x_q, a_scale = quantize_activations(x)
+        assert a_scale.shape == (2, 1)
+        assert np.asarray(x_q).max() == 127
+
+    def test_int8_dense_tracks_f32_matmul(self):
+        k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+        x = jax.random.normal(k1, (8, 64))
+        w = jax.random.normal(k2, (64, 32))
+        w_q, scale = quantize_weights(w)
+        got = int8_dense(x, w_q, scale, out_dtype=jnp.float32)
+        want = x @ w
+        assert _cos(got, want) > 0.999
+
+    def test_int8_qkv_tracks_f32_einsum(self):
+        k1, k2 = jax.random.split(jax.random.PRNGKey(2))
+        x = jax.random.normal(k1, (2, 4, 32))
+        w = jax.random.normal(k2, (32, 3, 32))
+        w_q, scale = quantize_weights(w)
+        assert w_q.shape == (32, 3, 32) and scale.shape == (3, 32)
+        got = int8_qkv(x, w_q, scale, out_dtype=jnp.float32)
+        want = jnp.einsum("blh,hto->blto", x, w)
+        assert got.shape == want.shape
+        assert _cos(got, want) > 0.999
+
+
+class TestModelParity:
+    @pytest.fixture(scope="class")
+    def float_setup(self):
+        cfg = TINY_TEST
+        model = EmbedderClassifier(cfg)
+        ids = jax.random.randint(jax.random.PRNGKey(3), (4, 16), 0,
+                                 cfg.vocab_size)
+        mask = jnp.ones((4, 16), jnp.bool_)
+        params = model.init(jax.random.PRNGKey(0), ids, mask)
+        emb, logits = model.apply(params, ids, mask)
+        return cfg, params, ids, mask, emb, logits
+
+    def test_quantized_model_tracks_float(self, float_setup):
+        from dataclasses import replace
+
+        cfg, params, ids, mask, emb_f, logits_f = float_setup
+        qparams = quantize_encoder_params(params)
+        qmodel = EmbedderClassifier(replace(cfg, quant="int8"))
+        emb_q, logits_q = qmodel.apply(qparams, ids, mask)
+        assert emb_q.shape == emb_f.shape
+        # Embeddings are unit vectors: per-row cosine is the right metric.
+        for r in range(emb_f.shape[0]):
+            assert _cos(emb_q[r], emb_f[r]) > 0.98
+        assert _cos(logits_q, logits_f) > 0.95
+
+    def test_converter_shapes_match_quant_init(self, float_setup):
+        """The converted tree must be shape/dtype-identical to what the
+        quantized model would init — else apply() breaks on real loads."""
+        from dataclasses import replace
+
+        cfg, params, ids, mask, _, _ = float_setup
+        qparams = quantize_encoder_params(params)
+        qinit = EmbedderClassifier(replace(cfg, quant="int8")).init(
+            jax.random.PRNGKey(0), ids, mask)
+        flat_got = jax.tree_util.tree_flatten_with_path(qparams)[0]
+        flat_want = jax.tree_util.tree_flatten_with_path(qinit)[0]
+        assert [p for p, _ in flat_got] == [p for p, _ in flat_want]
+        for (p, got), (_, want) in zip(flat_got, flat_want):
+            assert got.shape == want.shape, p
+            assert got.dtype == want.dtype, p
+
+    def test_converter_idempotent(self, float_setup):
+        _, params, _, _, _, _ = float_setup
+        once = quantize_encoder_params(params)
+        twice = quantize_encoder_params(once)
+        for a, b in zip(jax.tree.leaves(once), jax.tree.leaves(twice)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_projection_kernels_shrink_4x(self, float_setup):
+        _, params, _, _, _, _ = float_setup
+        qparams = quantize_encoder_params(params)
+        assert quantized_size_bytes(qparams) < quantized_size_bytes(params)
+        enc = qparams["params"]["encoder"]["layers_0"]
+        assert enc["attn"]["qkv/kernel_q"].dtype == jnp.int8
+        assert enc["mlp"]["mlp_up"]["kernel_q"].dtype == jnp.int8
+
+    def test_moe_config_rejected(self):
+        cfg = EncoderConfig(n_experts=4, quant="int8")
+        with pytest.raises(ValueError, match="MoE"):
+            cfg.validate()
+
+
+class TestEngine:
+    def test_engine_int8_end_to_end(self):
+        from distributed_crawler_tpu.inference.engine import (
+            EngineConfig,
+            InferenceEngine,
+        )
+        from distributed_crawler_tpu.utils.metrics import MetricsRegistry
+
+        cfg = EngineConfig(model="tiny", batch_size=4, buckets=(32,),
+                           quantize="int8")
+        eng = InferenceEngine(cfg, registry=MetricsRegistry())
+        assert eng.ecfg.quant == "int8"
+        out = eng.run(["hello world", "quantized serving"])
+        assert len(out) == 2
+        for r in out:
+            n = np.linalg.norm(r["embedding"])
+            assert abs(n - 1.0) < 1e-3
+            assert 0 <= r["label"] < eng.ecfg.n_labels
+
+    def test_engine_int8_matches_float_embeddings(self):
+        from distributed_crawler_tpu.inference.engine import (
+            EngineConfig,
+            InferenceEngine,
+        )
+        from distributed_crawler_tpu.utils.metrics import MetricsRegistry
+
+        texts = ["a post about cats", "completely different text"]
+        base = EngineConfig(model="tiny", batch_size=4, buckets=(32,))
+        e_f = InferenceEngine(base, registry=MetricsRegistry())
+        from dataclasses import replace as dreplace
+
+        e_q = InferenceEngine(dreplace(base, quantize="int8"),
+                              registry=MetricsRegistry())
+        emb_f = e_f.embed(texts)
+        emb_q = e_q.embed(texts)
+        for r in range(len(texts)):
+            assert _cos(emb_f[r], emb_q[r]) > 0.98
+
+    def test_engine_rejects_unknown_mode(self):
+        from distributed_crawler_tpu.inference.engine import (
+            EngineConfig,
+            InferenceEngine,
+        )
+        from distributed_crawler_tpu.utils.metrics import MetricsRegistry
+
+        with pytest.raises(ValueError, match="quantize"):
+            InferenceEngine(EngineConfig(model="tiny", quantize="int4"),
+                            registry=MetricsRegistry())
+
+    def test_cli_quantize_flag_reaches_engine(self):
+        from distributed_crawler_tpu.cli import (
+            _make_engine,
+            build_parser,
+            resolve_config,
+        )
+
+        args = build_parser().parse_args(
+            ["--urls", "a", "--infer-model", "tiny",
+             "--infer-quantize", "int8"])
+        cfg, r = resolve_config(args, env={})
+        assert cfg.inference.quantize == "int8"
+        eng = _make_engine(cfg, r)
+        assert eng.ecfg.quant == "int8"
+        # train-head's path (cast_params=False) must stay float: fine-tuning
+        # on — or persisting — int8 weights would destroy the checkpoint.
+        eng_train = _make_engine(cfg, r, cast_params=False)
+        assert eng_train.ecfg.quant == "none"
+
+    def test_engine_int8_on_mesh(self):
+        from distributed_crawler_tpu.inference.engine import (
+            EngineConfig,
+            InferenceEngine,
+        )
+        from distributed_crawler_tpu.parallel import best_mesh_config, make_mesh
+        from distributed_crawler_tpu.utils.metrics import MetricsRegistry
+
+        mesh = make_mesh(best_mesh_config(8, tp=2))
+        cfg = EngineConfig(model="tiny", batch_size=8, buckets=(32,),
+                           quantize="int8")
+        eng = InferenceEngine(cfg, mesh=mesh, registry=MetricsRegistry())
+        out = eng.run(["sharded int8 serving"] * 8)
+        assert len(out) == 8
+        # The quantized kernels must actually be sharded over tp, not
+        # silently replicated by the catch-all rule.
+        enc = eng.params["params"]["encoder"]["layers_0"]
+        spec = enc["mlp"]["mlp_up"]["kernel_q"].sharding.spec
+        assert "tp" in str(spec)
