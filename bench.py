@@ -48,10 +48,11 @@ PEAK_BF16_FLOPS = [
     ("v6 lite", 918e12), ("v6e", 918e12), ("v4", 275e12), ("v3", 123e12),
 ]
 
-# A healthy chip finishes the whole measurement in <3 min (compile ~10 s,
-# timing ~90 s); the chip has been observed to wedge BETWEEN a passing
-# probe and the main child, so the budget is sized to cut over to the CPU
-# fallback while the driver's patience lasts, not to wait out a wedge.
+# A healthy chip finishes the whole measurement in <5 min (two compiles —
+# bf16 + int8 — at ~10-30 s each plus ~90 s of timing per model); the chip
+# has been observed to wedge BETWEEN a passing probe and the main child,
+# so the budget is sized to cut over to the CPU fallback while the
+# driver's patience lasts, not to wait out a wedge.
 CHILD_TIMEOUT_S = int(os.environ.get("BENCH_TIMEOUT_S", "480"))
 SCALE_TIMEOUT_S = int(os.environ.get("BENCH_SCALE_TIMEOUT_S", "240"))
 # Pre-flight probe: one tiny jitted matmul on the default backend.  A wedged
@@ -59,6 +60,7 @@ SCALE_TIMEOUT_S = int(os.environ.get("BENCH_SCALE_TIMEOUT_S", "240"))
 # child budget, and the headline falls back to a CPU-labelled measurement.
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "75"))
 CPU_FALLBACK_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT_S", "300"))
+ASR_TIMEOUT_S = int(os.environ.get("BENCH_ASR_TIMEOUT_S", "240"))
 
 
 def _log(msg: str) -> None:
@@ -124,7 +126,7 @@ def _measure(scale_devices: int | None = None,
              batch: int | None = None, seq: int = SEQ,
              n_short: int = N_SHORT, n_long: int = N_LONG,
              latency_samples: int = LATENCY_SAMPLES,
-             repeats: int = 3) -> dict:
+             repeats: int = 3, with_int8: bool = True) -> dict:
     """Run the measurement in-process; returns the result dict."""
     import jax
     import jax.numpy as jnp
@@ -205,27 +207,31 @@ def _measure(scale_devices: int | None = None,
         return {"posts_per_sec": posts_per_sec}
 
     # Int8 serving path (ops/quant.py): same chained methodology over the
-    # quantized model.  Best-effort — a failure here never costs the bf16
-    # headline, which stays the reported `value`.
+    # quantized model.  Best-effort — an exception here never costs the
+    # bf16 headline — and skipped entirely in the CPU fallback
+    # (``with_int8=False``), whose timeout budget is sized for ONE
+    # compile+fit; only the TPU child pays for the second model.
     int8_pps = None
-    try:
-        from distributed_crawler_tpu.models.quant import (
-            quantize_encoder_params,
-        )
+    if with_int8:
+        try:
+            from distributed_crawler_tpu.models.quant import (
+                quantize_encoder_params,
+            )
 
-        qmodel = EmbedderClassifier(replace(cfg, quant="int8"))
-        qparams = quantize_encoder_params(params)
-        chained_q = make_chained(qmodel)
+            qmodel = EmbedderClassifier(replace(cfg, quant="int8"))
+            qparams = quantize_encoder_params(params)
+            chained_q = make_chained(qmodel)
 
-        t0 = time.perf_counter()
-        float(chained_q(qparams, ids, mask, 1).sum())
-        _log(f"int8 compile+warmup done in {time.perf_counter() - t0:.1f}s")
-        t_iter_q = fit_t_iter(chained_q, qparams)
-        int8_pps = batch / t_iter_q
-        _log(f"int8 throughput: {int8_pps:.1f} posts/sec "
-             f"(speedup {int8_pps / posts_per_sec:.2f}x)")
-    except Exception as exc:  # noqa: BLE001 — int8 row is best-effort
-        _log(f"int8 measurement skipped: {exc}")
+            t0 = time.perf_counter()
+            float(chained_q(qparams, ids, mask, 1).sum())
+            _log(f"int8 compile+warmup done in "
+                 f"{time.perf_counter() - t0:.1f}s")
+            t_iter_q = fit_t_iter(chained_q, qparams)
+            int8_pps = batch / t_iter_q
+            _log(f"int8 throughput: {int8_pps:.1f} posts/sec "
+                 f"(speedup {int8_pps / posts_per_sec:.2f}x)")
+        except Exception as exc:  # noqa: BLE001 — int8 row is best-effort
+            _log(f"int8 measurement skipped: {exc}")
 
     # Per-batch latency: one step closed with a scalar readback each time —
     # the latency a TPUWorker batch actually experiences (includes RPC).
@@ -271,6 +277,64 @@ def _measure(scale_devices: int | None = None,
         "n_devices": use_dev,
         "batch": batch,
         "seq": seq,
+    }
+
+
+def _measure_asr(batch: int = 8, decode_len: int = 48,
+                 samples: int = 5, model_cfg=None) -> dict:
+    """BASELINE config #4: Whisper ASR throughput on the default backend.
+
+    Synthetic weights + noise audio (throughput does not depend on weight
+    values) and a FIXED ``decode_len``-token greedy decode — random weights
+    never emit EOT, so every run times the identical worst-case workload.
+    Reported as RTFx: seconds of audio transcribed per wall-clock second
+    (each 30 s window counts fully; the per-call host readback is included,
+    matching what a media-transcription worker experiences).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_crawler_tpu.models.whisper import (
+        WHISPER_SMALL,
+        Whisper,
+        audio_window_samples,
+        transcribe_features,
+    )
+
+    cfg = model_cfg or WHISPER_SMALL
+    model = Whisper(cfg)
+    win = audio_window_samples(cfg)
+    rng = np.random.default_rng(0)
+    mel_probe = jnp.asarray(
+        rng.standard_normal((1, cfg.n_audio_ctx * 2, cfg.n_mels)),
+        jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), mel_probe,
+                        jnp.zeros((1, 4), jnp.int32))
+    _log(f"asr params initialized ({cfg.n_audio_state}-wide)")
+    audio = jnp.asarray(rng.standard_normal((batch, win)) * 0.1, jnp.float32)
+    step = jax.jit(lambda p, a: transcribe_features(model, p, a,
+                                                    max_len=decode_len))
+    t0 = time.perf_counter()
+    np.asarray(step(params, audio))
+    _log(f"asr compile+warmup done in {time.perf_counter() - t0:.1f}s")
+    times = []
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        np.asarray(step(params, audio))  # host readback closes the call
+        times.append(time.perf_counter() - t0)
+    t_call = sorted(times)[len(times) // 2]
+    audio_sec = batch * (win / 16000.0)
+    _log(f"asr: {audio_sec / t_call:.1f}x realtime "
+         f"(t_call={t_call * 1e3:.1f}ms)")
+    # greedy_decode scans max_len-1 steps (the SOT token is free), so
+    # decode_len-1 decoder forwards actually ran.
+    return {
+        "asr_rtfx": round(audio_sec / t_call, 1),
+        "asr_decode_tokens_per_sec": round(
+            batch * (decode_len - 1) / t_call, 1),
+        "asr_batch": batch,
+        "asr_decode_len": decode_len,
     }
 
 
@@ -354,12 +418,16 @@ def main() -> None:
             # batch/iteration counts so the number lands inside the fallback
             # timeout on a laptop-class host.
             print(json.dumps(_measure(batch=64, n_short=2, n_long=6,
-                                      latency_samples=5)), flush=True)
+                                      latency_samples=5,
+                                      with_int8=False)), flush=True)
         else:
             print(json.dumps(_measure()), flush=True)
         return
     if "--probe" in sys.argv:
         print(json.dumps(_probe()), flush=True)
+        return
+    if "--asr" in sys.argv:
+        print(json.dumps(_measure_asr()), flush=True)
         return
     if "--scale" in sys.argv:
         # dp-scaling rows run on virtual CPU devices — keep them light so
@@ -421,6 +489,16 @@ def main() -> None:
             "error": err or "unknown failure",
         }))
         return
+
+    if result.get("platform") == "tpu":
+        # BASELINE config #4 row — TPU only (whisper-small greedy decode on
+        # a CPU host would blow the fallback budget for no signal).
+        _log(f"measuring ASR row (timeout {ASR_TIMEOUT_S}s)")
+        asr, aerr = _try_child(["--asr"], dict(os.environ), ASR_TIMEOUT_S)
+        if asr is not None:
+            result.update(asr)
+        else:
+            _log(f"asr row skipped: {aerr}")
 
     _cache_tpu_result(result)
     _log("measuring dp scaling on virtual CPU mesh")

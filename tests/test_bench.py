@@ -39,6 +39,19 @@ def test_measure_smoke_cpu():
     assert res["mfu"] is None  # MFU is TPU-only by design
 
 
+def test_measure_asr_smoke_cpu():
+    # Tiny Whisper config so the fixed-length greedy decode runs in
+    # milliseconds on CPU; catches field drift against the real model APIs.
+    from distributed_crawler_tpu.models.whisper import WHISPER_TEST
+
+    res = bench._measure_asr(batch=2, decode_len=4, samples=2,
+                             model_cfg=WHISPER_TEST)
+    assert res["asr_rtfx"] > 0
+    assert res["asr_decode_tokens_per_sec"] > 0
+    assert res["asr_batch"] == 2
+    assert res["asr_decode_len"] == 4
+
+
 def test_probe_subprocess_emits_json():
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("AXON", "PALLAS_AXON", "TPU_"))}
