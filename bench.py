@@ -296,6 +296,7 @@ def _measure_asr(batch: int = 8, decode_len: int = 48,
     import numpy as np
 
     from distributed_crawler_tpu.models.whisper import (
+        SAMPLE_RATE,
         WHISPER_SMALL,
         Whisper,
         audio_window_samples,
@@ -324,7 +325,7 @@ def _measure_asr(batch: int = 8, decode_len: int = 48,
         np.asarray(step(params, audio))  # host readback closes the call
         times.append(time.perf_counter() - t0)
     t_call = sorted(times)[len(times) // 2]
-    audio_sec = batch * (win / 16000.0)
+    audio_sec = batch * (win / float(SAMPLE_RATE))
     _log(f"asr: {audio_sec / t_call:.1f}x realtime "
          f"(t_call={t_call * 1e3:.1f}ms)")
     # greedy_decode scans max_len-1 steps (the SOT token is free), so

@@ -29,7 +29,12 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import mha
-from ..ops.quant import int8_dense, int8_qkv
+from ..ops.quant import (
+    int8_dense,
+    int8_experts_down,
+    int8_experts_up,
+    int8_qkv,
+)
 
 
 @dataclass(frozen=True)
@@ -47,10 +52,11 @@ class EncoderConfig:
     dtype: str = "bfloat16"           # activation dtype
     attention: str = "auto"           # auto | xla | flash
     remat: bool = False               # jax.checkpoint each layer (training)
-    # "int8": the four projection GEMMs per layer run int8×int8→int32 on
-    # the MXU (2× bf16 peak on v5e, half the weight HBM traffic).  Params
-    # must be in the quantized layout (`models/quant.quantize_encoder_params`
-    # converts a float checkpoint); serving-only — training always "none".
+    # "int8": the projection GEMMs per layer (qkv/attn_out/mlp, or the MoE
+    # expert GEMMs) run int8×int8→int32 on the MXU (2× bf16 peak on v5e,
+    # half the weight HBM traffic).  Params must be in the quantized layout
+    # (`models/quant.quantize_encoder_params` converts a float checkpoint);
+    # serving-only — training always "none".
     quant: str = "none"
 
     @property
@@ -67,9 +73,6 @@ class EncoderConfig:
                 f"hidden {self.hidden} not divisible by heads {self.n_heads}")
         if self.quant not in ("none", "int8"):
             raise ValueError(f"unknown quant mode {self.quant!r}")
-        if self.quant != "none" and self.n_experts:
-            raise ValueError("int8 quantization does not cover the MoE "
-                             "expert GEMMs; use a dense MLP config")
 
 
 # Published configs (sizes match the HF checkpoints these mirror).
@@ -188,13 +191,28 @@ class SwitchMoE(nn.Module):
         probs = jax.nn.softmax(gate, axis=-1)           # [B, L, E]
         top = jnp.argmax(probs, axis=-1)                # [B, L]
         onehot = jax.nn.one_hot(top, e, dtype=cfg.adtype)
-        w_up = self.param("experts_up/kernel", nn.initializers.lecun_normal(),
-                          (e, h, m), jnp.float32)
-        w_dn = self.param("experts_down/kernel", nn.initializers.lecun_normal(),
-                          (e, m, h), jnp.float32)
-        hid = jnp.einsum("blh,ehm->blem", x, w_up.astype(cfg.adtype))
-        hid = nn.gelu(hid, approximate=True)
-        out = jnp.einsum("blem,emh->bleh", hid, w_dn.astype(cfg.adtype))
+        if cfg.quant == "int8":
+            w_up_q = self.param("experts_up/kernel_q", nn.initializers.zeros,
+                                (e, h, m), jnp.int8)
+            s_up = self.param("experts_up/scale", nn.initializers.ones,
+                              (e, m), jnp.float32)
+            w_dn_q = self.param("experts_down/kernel_q",
+                                nn.initializers.zeros, (e, m, h), jnp.int8)
+            s_dn = self.param("experts_down/scale", nn.initializers.ones,
+                              (e, h), jnp.float32)
+            hid = int8_experts_up(x, w_up_q, s_up, out_dtype=cfg.adtype)
+            hid = nn.gelu(hid, approximate=True)
+            out = int8_experts_down(hid, w_dn_q, s_dn, out_dtype=cfg.adtype)
+        else:
+            w_up = self.param("experts_up/kernel",
+                              nn.initializers.lecun_normal(),
+                              (e, h, m), jnp.float32)
+            w_dn = self.param("experts_down/kernel",
+                              nn.initializers.lecun_normal(),
+                              (e, m, h), jnp.float32)
+            hid = jnp.einsum("blh,ehm->blem", x, w_up.astype(cfg.adtype))
+            hid = nn.gelu(hid, approximate=True)
+            out = jnp.einsum("blem,emh->bleh", hid, w_dn.astype(cfg.adtype))
         out = jnp.einsum("bleh,ble->blh", out, onehot)
         # Scale by the (f32) router prob of the chosen expert so the router
         # receives gradient during fine-tuning.

@@ -7,8 +7,10 @@ branch expect:
     layers_i/attn/qkv/kernel   [h,3,h] f32  →  qkv/kernel_q int8 + qkv/scale [3,h]
     layers_i/attn/attn_out/kernel          →  kernel_q + scale (+ bias kept f32)
     layers_i/{mlp/mlp_up, mlp/mlp_down}/kernel → likewise
+    layers_i/moe/experts_{up,down}/kernel [e,in,out] → kernel_q + scale [e,out]
 
-Everything else (embeddings, layernorms, pooler, head) passes through
+Everything else (embeddings, layernorms, the MoE router, pooler, head)
+passes through
 unchanged — those stay in the float path by design (`ops/quant.py`
 module docstring).  The conversion is lossy and one-way: never write the
 result back over a training checkpoint.
@@ -24,8 +26,8 @@ import numpy as np
 from ..ops.quant import quantize_weights
 
 # Dense projections quantized per layer: flax module name → present under
-# layers_i/<attn|mlp>/.  (MoE experts are rejected upstream by
-# EncoderConfig.validate.)
+# layers_i/<attn|mlp>/.  (MoE expert kernels are 3-D and handled
+# separately below.)
 _PROJ_MODULES = ("attn_out", "mlp_up", "mlp_down")
 
 
@@ -75,6 +77,18 @@ def quantize_encoder_params(params: Any) -> Any:
                 mod = holder.get(mod_name)
                 if isinstance(mod, dict) and "kernel" in mod:
                     holder[mod_name] = _quantize_dense(mod)
+        moe = layer.get("moe")
+        if isinstance(moe, dict):
+            # Expert kernels [e, in, out] contract their MIDDLE axis, so
+            # scales come out per (expert, output channel); the f32 router
+            # passes through untouched (it is precision-critical and tiny).
+            for kname in ("experts_up/kernel", "experts_down/kernel"):
+                if kname in moe:
+                    w_q, scale = quantize_weights(
+                        jnp.asarray(moe.pop(kname), jnp.float32),
+                        contract_axis=1)
+                    moe[kname + "_q"] = w_q
+                    moe[kname.replace("/kernel", "/scale")] = scale
         enc[name] = layer
 
     if enc_key:

@@ -13,10 +13,10 @@ the standard accuracy-preserving one:
 - **accumulation**: int32 via `lax.dot_general(preferred_element_type)`,
   dequantized in f32: ``out = acc * a_scale[token] * w_scale[channel]``.
 
-Only the four projection GEMMs per layer go through this path (qkv,
-attn_out, mlp_up, mlp_down).  Embeddings, layernorms, softmax, pooling and
-the classifier head stay f32/bf16 — they are bandwidth-trivial and
-precision-critical.
+Only the projection GEMMs go through this path (qkv, attn_out, mlp_up,
+mlp_down — or the MoE expert GEMMs in switch configs).  Embeddings,
+layernorms, the MoE router, softmax, pooling and the classifier head stay
+f32/bf16 — they are bandwidth-trivial and precision-critical.
 
 No reference analog (the reference is a crawler, not an ML framework);
 this exists to push the BASELINE.md headline (≥50k posts/sec on v5e-8)
@@ -90,6 +90,33 @@ def int8_dense(x: jax.Array, w_q: jax.Array, w_scale: jax.Array,
     out = acc.astype(jnp.float32) * a_scale * w_scale
     if bias is not None:
         out = out + bias
+    return out.astype(out_dtype)
+
+
+def int8_experts_up(x: jax.Array, w_q: jax.Array, w_scale: jax.Array,
+                    out_dtype: jnp.dtype = jnp.bfloat16) -> jax.Array:
+    """Switch-MoE up projection, int8: [..., h] × [e, h, m] → [..., e, m].
+
+    Mirrors the dense ``blh,ehm->blem`` dispatch einsum in
+    `models/encoder.SwitchMoE` (every expert computed, one-hot combined —
+    exact, static shapes).  w_scale: [e, m] (per expert × output channel).
+    """
+    x_q, a_scale = quantize_activations(x)
+    acc = jnp.einsum("blh,ehm->blem", x_q, w_q,
+                     preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * a_scale[..., None] * w_scale
+    return out.astype(out_dtype)
+
+
+def int8_experts_down(h: jax.Array, w_q: jax.Array, w_scale: jax.Array,
+                      out_dtype: jnp.dtype = jnp.bfloat16) -> jax.Array:
+    """Switch-MoE down projection, int8: [b, l, e, m] × [e, m, h] →
+    [b, l, e, h].  Activations re-quantize per (token, expert); the expert
+    axis rides dot_general's batch dims.  w_scale: [e, h]."""
+    h_q, h_scale = quantize_activations(h)      # h_scale [b, l, e, 1]
+    acc = jnp.einsum("blem,emh->bleh", h_q, w_q,
+                     preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * h_scale * w_scale
     return out.astype(out_dtype)
 
 

@@ -48,8 +48,9 @@ ENCODER_PARAM_RULES: List[ParamRule] = [
     (r".*/mlp_down/(bias|scale)$", P()),
     # MoE experts: expert dim sharded over tp (expert parallelism rides the
     # same axis; a dedicated 'ep' axis would be overkill at inference scale).
-    (r".*/experts_up/kernel$", P(AXIS_TP, None, None)),
-    (r".*/experts_down/kernel$", P(AXIS_TP, None, None)),
+    (r".*/experts_up/kernel(_q)?$", P(AXIS_TP, None, None)),
+    (r".*/experts_down/kernel(_q)?$", P(AXIS_TP, None, None)),
+    (r".*/experts_(up|down)/scale$", P(AXIS_TP, None)),
     (r".*/embed.*", P()),
     (r".*", P()),  # default: replicate (layernorms, heads, scalars)
 ]

@@ -133,10 +133,56 @@ class TestModelParity:
         assert enc["attn"]["qkv/kernel_q"].dtype == jnp.int8
         assert enc["mlp"]["mlp_up"]["kernel_q"].dtype == jnp.int8
 
-    def test_moe_config_rejected(self):
-        cfg = EncoderConfig(n_experts=4, quant="int8")
-        with pytest.raises(ValueError, match="MoE"):
-            cfg.validate()
+    def test_moe_quantized_model_tracks_float(self):
+        from dataclasses import replace
+
+        cfg = replace(TINY_TEST, n_experts=4)
+        model = EmbedderClassifier(cfg)
+        ids = jax.random.randint(jax.random.PRNGKey(5), (4, 16), 0,
+                                 cfg.vocab_size)
+        mask = jnp.ones((4, 16), jnp.bool_)
+        params = model.init(jax.random.PRNGKey(1), ids, mask)
+        emb_f, logits_f = model.apply(params, ids, mask)
+        qparams = quantize_encoder_params(params)
+        moe = qparams["params"]["encoder"]["layers_0"]["moe"]
+        assert moe["experts_up/kernel_q"].dtype == jnp.int8
+        assert moe["experts_up/scale"].shape == (4, cfg.mlp_dim)
+        assert moe["experts_down/scale"].shape == (4, cfg.hidden)
+        assert "router" in moe  # the f32 router must pass through
+        qmodel = EmbedderClassifier(replace(cfg, quant="int8"))
+        emb_q, logits_q = qmodel.apply(qparams, ids, mask)
+        for r in range(emb_f.shape[0]):
+            assert _cos(emb_q[r], emb_f[r]) > 0.98
+        assert _cos(logits_q, logits_f) > 0.95
+
+    def test_moe_converter_shapes_match_quant_init(self):
+        from dataclasses import replace
+
+        cfg = replace(TINY_TEST, n_experts=4)
+        ids = jnp.zeros((1, 8), jnp.int32)
+        mask = jnp.ones((1, 8), jnp.bool_)
+        params = EmbedderClassifier(cfg).init(jax.random.PRNGKey(0), ids,
+                                              mask)
+        qparams = quantize_encoder_params(params)
+        qinit = EmbedderClassifier(replace(cfg, quant="int8")).init(
+            jax.random.PRNGKey(0), ids, mask)
+        flat_got = jax.tree_util.tree_flatten_with_path(qparams)[0]
+        flat_want = jax.tree_util.tree_flatten_with_path(qinit)[0]
+        assert [p for p, _ in flat_got] == [p for p, _ in flat_want]
+        for (p, got), (_, want) in zip(flat_got, flat_want):
+            assert got.shape == want.shape, p
+            assert got.dtype == want.dtype, p
+
+    def test_moe_expert_kernels_sharded_over_tp(self):
+        from distributed_crawler_tpu.parallel.sharding import (
+            ENCODER_PARAM_RULES,
+            spec_for_path,
+        )
+
+        assert "tp" in str(spec_for_path(
+            "encoder/layers_0/moe/experts_up/kernel_q", ENCODER_PARAM_RULES))
+        assert "tp" in str(spec_for_path(
+            "encoder/layers_0/moe/experts_up/scale", ENCODER_PARAM_RULES))
 
 
 class TestEngine:
