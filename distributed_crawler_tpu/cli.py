@@ -144,6 +144,12 @@ def build_parser() -> argparse.ArgumentParser:
     # orbax checkpoint the engine reloads via --head-checkpoint.
     a("--train-posts", default=None,
       help="crawl posts JSONL (train-head mode)")
+    a("--train-lora-rank", type=int, default=None,
+      help="0 (default) fine-tunes only the classifier head on the frozen "
+           "encoder; >0 additionally trains rank-N LoRA adapters on the "
+           "projection GEMMs and saves the merged float checkpoint "
+           "(use when the pretrained embedding space can't separate the "
+           "classes)")
     a("--train-labels", default=None,
       help='labels JSONL: {"post_uid": ..., "label": int|str} per line')
     a("--head-checkpoint", default=None,
@@ -234,6 +240,7 @@ _KEY_MAP = {
     "infer_quantize": "inference.quantize",
     "train_posts": "train.posts_file",
     "train_labels": "train.labels_file",
+    "train_lora_rank": "train.lora_rank",
     "head_checkpoint": "train.checkpoint_dir",
     "train_epochs": "train.epochs",
     "train_lr": "train.learning_rate",
@@ -794,12 +801,29 @@ def _run_train_head(cfg: CrawlerConfig, r: ConfigResolver) -> int:
     if epochs < 1:
         print("error: --train-epochs must be >= 1", file=sys.stderr)
         return 2
-    tc = TrainConfig(learning_rate=r.get_float("train.learning_rate", 1e-3),
-                     warmup_steps=10)
-    params, history = finetune_head(
-        engine.ecfg, engine.params, token_lists, labels, tc=tc,
-        epochs=epochs, batch_size=min(32, max(8, len(labels))),
-        buckets=tuple(cfg.inference.bucket_sizes))
+    lora_rank = r.get_int("train.lora_rank", 0)
+    if lora_rank < 0:
+        print(f"error: --train-lora-rank must be >= 0, got {lora_rank}",
+              file=sys.stderr)
+        return 2
+    if lora_rank > 0:
+        from .models.lora import finetune_lora
+
+        tc = TrainConfig(
+            learning_rate=r.get_float("train.learning_rate", 1e-4),
+            warmup_steps=10)
+        params, history = finetune_lora(
+            engine.ecfg, engine.params, token_lists, labels,
+            rank=lora_rank, tc=tc, epochs=epochs,
+            batch_size=min(16, max(4, len(labels))))
+    else:
+        tc = TrainConfig(
+            learning_rate=r.get_float("train.learning_rate", 1e-3),
+            warmup_steps=10)
+        params, history = finetune_head(
+            engine.ecfg, engine.params, token_lists, labels, tc=tc,
+            epochs=epochs, batch_size=min(32, max(8, len(labels))),
+            buckets=tuple(cfg.inference.bucket_sizes))
 
     # Monotonic step numbering: retraining into the same dir always
     # produces the NEW latest step, regardless of epoch counts.
@@ -822,6 +846,7 @@ def _run_train_head(cfg: CrawlerConfig, r: ConfigResolver) -> int:
         "trained_examples": len(labels),
         "n_labels": n_labels,
         "epochs": epochs,
+        "lora_rank": lora_rank,
         "final_loss": history[-1]["loss"],
         "final_accuracy": history[-1]["accuracy"],
         "checkpoint": step_dir,

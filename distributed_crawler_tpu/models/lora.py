@@ -1,0 +1,231 @@
+"""LoRA fine-tuning for the encoder projections (serving-compatible).
+
+`finetune_head` (train.py) adapts only the classifier head on frozen CLS
+features — enough when the pretrained embedding space already separates
+the classes.  When it doesn't, this module trains low-rank adapters on the
+four projection GEMMs per layer (qkv, attn_out, mlp_up, mlp_down) jointly
+with the head: ``W_eff = W + (alpha/rank) * A @ B`` with ``B`` zero-init,
+so step 0 is exactly the pretrained model.
+
+TPU-first by construction: the adapters are merged into the dense kernels
+functionally INSIDE the jitted step (two small GEMMs per projection —
+negligible next to the forward), so the training graph keeps the same
+fused-QKV MXU layout as serving, and the returned tree is a plain float
+param tree — orbax-checkpointable, engine-loadable (`checkpoint_dir`) and
+int8-quantizable (`models/quant.py`) with zero serving-side changes.
+
+The reference has no training surface at all; this extends the ⟨NEW⟩
+train stage (SURVEY.md §7.6) the same way `models/train.py` does.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .encoder import Classifier, EncoderConfig
+from .train import TrainConfig, cross_entropy, epoch_batches, make_optimizer
+
+# Dense projection kernels that get adapters, as key paths into a layer
+# dict.  Note the flax layout: the fused QKV is a flat "qkv/kernel" leaf
+# on the attn module, while attn_out/mlp_up/mlp_down are nn.Dense
+# submodules holding {"kernel", "bias"}.  (MoE expert kernels are
+# deliberately excluded: adapting a per-expert 3-D kernel is
+# rank-deficient per expert; adapt attention and train the router instead
+# if MoE fine-tuning is ever needed.)
+_TARGETS = (("attn", "qkv/kernel"), ("attn", "attn_out", "kernel"),
+            ("mlp", "mlp_up", "kernel"), ("mlp", "mlp_down", "kernel"))
+# Adapter dicts are keyed by the joined path; resolve back through this
+# table ("qkv/kernel" itself contains a slash, so split() would be wrong).
+_TARGET_BY_KEY = {"/".join(p): p for p in _TARGETS}
+
+
+def _get_path(tree: Any, path: Tuple[str, ...]) -> Any:
+    for key in path:
+        if not isinstance(tree, dict) or key not in tree:
+            return None
+        tree = tree[key]
+    return tree
+
+
+def _copy_and_set(tree: Dict, path: Tuple[str, ...], value: Any) -> Dict:
+    """Return a copy of ``tree`` with ``path`` replaced (containers along
+    the path are shallow-copied; everything else is shared)."""
+    out = dict(tree)
+    if len(path) == 1:
+        out[path[0]] = value
+    else:
+        out[path[0]] = _copy_and_set(out[path[0]], path[1:], value)
+    return out
+
+
+def init_lora_params(rng: jax.Array, params: Any, rank: int) -> Dict:
+    """Adapters for every target kernel present in ``params``.
+
+    Layout: ``{layers_i: {"attn/qkv/kernel": {"a": [in, r], "b": [r, ...out]},
+    ...}}``.  ``a`` is scaled-normal, ``b`` zeros — the standard init that
+    makes the adapted model exactly the base model before step 1.
+    """
+    enc = params["params"]["encoder"]
+    lora: Dict[str, Dict[str, Dict[str, jax.Array]]] = {}
+    for lname, layer in enc.items():
+        if not lname.startswith("layers_"):
+            continue
+        adapters: Dict[str, Dict[str, jax.Array]] = {}
+        for path in _TARGETS:
+            kern = _get_path(layer, path)
+            if kern is None:
+                continue
+            in_dim, out_shape = kern.shape[0], kern.shape[1:]
+            rng, sub = jax.random.split(rng)
+            adapters["/".join(path)] = {
+                "a": (jax.random.normal(sub, (in_dim, rank), jnp.float32)
+                      / np.sqrt(in_dim)),
+                "b": jnp.zeros((rank,) + tuple(out_shape), jnp.float32),
+            }
+        if adapters:
+            lora[lname] = adapters
+    if not lora:
+        raise ValueError("no LoRA target kernels found in params")
+    return lora
+
+
+def _delta(a: jax.Array, b: jax.Array) -> jax.Array:
+    """A @ B for 2-D ([r, out]) or fused-QKV 3-D ([r, 3, h]) b."""
+    return jnp.tensordot(a, b, axes=([1], [0]))
+
+
+def lora_rank_of(lora: Dict) -> int:
+    """The rank the adapters were initialized with (the ``a`` column dim)."""
+    first_layer = next(iter(lora.values()))
+    first = next(iter(first_layer.values()))
+    return int(first["a"].shape[1])
+
+
+def _merge_encoder(enc: Dict, lora: Dict, scale: float) -> Dict:
+    """Fold adapters into a COPY of an encoder subtree — the one merge
+    implementation, used by both the jitted training step and the
+    checkpoint writer so they can never drift apart."""
+    enc = dict(enc)
+    for lname, adapters in lora.items():
+        layer = enc[lname]
+        for key, ab in adapters.items():
+            path = _TARGET_BY_KEY[key]
+            kern = _get_path(layer, path)
+            layer = _copy_and_set(
+                layer, path,
+                kern.astype(jnp.float32) + scale * _delta(ab["a"], ab["b"]))
+        enc[lname] = layer
+    return enc
+
+
+def merge_lora(params: Any, lora: Dict, rank: Optional[int] = None,
+               alpha: float = 16.0) -> Any:
+    """Fold the adapters into a NEW plain float param tree (base untouched).
+
+    ``rank`` defaults to the adapters' own rank; passing a different value
+    is rejected rather than silently mis-scaling every merged kernel.
+    """
+    actual = lora_rank_of(lora)
+    if rank is not None and rank != actual:
+        raise ValueError(f"rank {rank} does not match the adapters' "
+                         f"rank {actual}")
+    tree = jax.tree.map(lambda x: x, params)  # rebuild every container
+    tree["params"]["encoder"] = _merge_encoder(
+        tree["params"]["encoder"], lora, alpha / float(actual))
+    return tree
+
+
+def finetune_lora(ecfg: EncoderConfig, params: Any,
+                  token_lists: Sequence[Sequence[int]],
+                  labels: Sequence[int],
+                  rank: int = 8, alpha: float = 16.0,
+                  tc: TrainConfig = TrainConfig(learning_rate=1e-4,
+                                                warmup_steps=10),
+                  epochs: int = 10, batch_size: int = 16,
+                  seed: int = 0,
+                  max_len: Optional[int] = None
+                  ) -> Tuple[Any, List[Dict[str, float]]]:
+    """LoRA + head fine-tune; returns ``(merged_params, history)``.
+
+    ``merged_params`` is a plain float tree — save it with
+    `inference.checkpoint.save_params` and the engine's ``checkpoint_dir``
+    path loads it like any full fine-tune.  Full forward/backward per step
+    (unlike `finetune_head`'s frozen-feature shortcut), so use it when the
+    head alone can't separate the classes.
+    """
+    if len(token_lists) != len(labels):
+        raise ValueError(f"{len(token_lists)} texts vs {len(labels)} labels")
+    if not token_lists:
+        raise ValueError("empty training set")
+    if epochs < 1:
+        raise ValueError(f"epochs must be >= 1, got {epochs}")
+    if min(labels) < 0:
+        raise ValueError(f"negative label id {min(labels)} is not a class")
+    if rank < 1:
+        raise ValueError(f"rank must be >= 1, got {rank}")
+    n_labels = int(max(labels)) + 1
+    if n_labels > ecfg.n_labels:
+        raise ValueError(
+            f"label id {n_labels - 1} exceeds head width {ecfg.n_labels}")
+
+    # One static [batch, L] shape for the whole run: L = longest sequence
+    # rounded up to a multiple of 32, capped at the encoder context.
+    seq = max(len(t) for t in token_lists)
+    seq = min(ecfg.max_len, max_len or ecfg.max_len, ((seq + 31) // 32) * 32)
+    ids_np = np.zeros((len(token_lists), seq), np.int32)
+    mask_np = np.zeros((len(token_lists), seq), bool)
+    for i, toks in enumerate(token_lists):
+        toks = list(toks)[:seq]
+        ids_np[i, :len(toks)] = toks
+        mask_np[i, :len(toks)] = True
+    labels_np = np.asarray(labels, np.int32)
+
+    model = Classifier(ecfg)
+    base_enc = params["params"]["encoder"]
+    lora = init_lora_params(jax.random.PRNGKey(seed), params, rank)
+    head = params["params"]["cls_head"]
+    optimizer = make_optimizer(tc)
+    opt_state = optimizer.init((lora, head))
+    scale = alpha / float(rank)
+
+    def apply_merged(base, lp, hp, ids, mask):
+        return model.apply(
+            {"params": {"encoder": _merge_encoder(base, lp, scale),
+                        "cls_head": hp}}, ids, mask)
+
+    @jax.jit
+    def step(base, lp, hp, os_, ids, mask, y):
+        def loss_fn(trainable):
+            lp_, hp_ = trainable
+            logits = apply_merged(base, lp_, hp_, ids, mask)
+            loss = cross_entropy(logits, y, tc.label_smoothing)
+            acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+            return loss, acc
+
+        (loss, acc), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)((lp, hp))
+        updates, os_ = optimizer.update(grads, os_, (lp, hp))
+        (lp, hp) = optax.apply_updates((lp, hp), updates)
+        return lp, hp, os_, loss, acc
+
+    rng = np.random.default_rng(seed)
+    history: List[Dict[str, float]] = []
+    for _ in range(epochs):
+        losses, accs = [], []
+        for idx in epoch_batches(rng, len(token_lists), batch_size):
+            lora, head, opt_state, loss, acc = step(
+                base_enc, lora, head, opt_state,
+                ids_np[idx], mask_np[idx], labels_np[idx])
+            losses.append(float(loss))
+            accs.append(float(acc))
+        history.append({"loss": float(np.mean(losses)),
+                        "accuracy": float(np.mean(accs))})
+
+    merged = merge_lora(params, lora, rank, alpha)
+    merged = {"params": {**merged["params"], "cls_head": head}}
+    return merged, history

@@ -140,6 +140,20 @@ def encode_cls_features(ecfg: EncoderConfig, params: Any,
     return feats
 
 
+def epoch_batches(rng: np.random.Generator, n: int, batch_size: int):
+    """Shuffled minibatch index arrays for one epoch, every batch padded to
+    the static ``batch_size`` (tail batches repeat earlier rows — the
+    repeats only reweight the gradient slightly).  Shared by every
+    fine-tune loop so the padding edge cases stay identical."""
+    order = rng.permutation(n)
+    for start in range(0, n, batch_size):
+        idx = order[start:start + batch_size]
+        if len(idx) < batch_size:
+            idx = (np.concatenate([idx, order[:batch_size - len(idx)]])
+                   if n >= batch_size else np.resize(idx, batch_size))
+        yield idx
+
+
 def finetune_head(ecfg: EncoderConfig, params: Any,
                   token_lists: Sequence[Sequence[int]],
                   labels: Sequence[int],
@@ -192,19 +206,10 @@ def finetune_head(ecfg: EncoderConfig, params: Any,
         return optax.apply_updates(hp, updates), os_, loss, acc
 
     rng = np.random.default_rng(seed)
-    n = len(feats)
     history: List[Dict[str, float]] = []
     for _ in range(epochs):
-        order = rng.permutation(n)
         losses, accs = [], []
-        for start in range(0, n, batch_size):
-            idx = order[start:start + batch_size]
-            # Pad the tail batch to the static shape (repeat rows; the
-            # repeats only reweight the gradient slightly).
-            if len(idx) < batch_size:
-                idx = np.concatenate(
-                    [idx, order[:batch_size - len(idx)]]) if n >= batch_size \
-                    else np.resize(idx, batch_size)
+        for idx in epoch_batches(rng, len(feats), batch_size):
             head_params, opt_state, loss, acc = step(
                 head_params, opt_state, feats[idx], labels_np[idx])
             losses.append(float(loss))

@@ -1,0 +1,175 @@
+"""LoRA fine-tune (models/lora.py): adapters on the projection GEMMs,
+merged into a plain float tree that the engine and the int8 converter
+consume unchanged."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_crawler_tpu.inference.engine import (
+    EngineConfig,
+    InferenceEngine,
+)
+from distributed_crawler_tpu.models.encoder import TINY_TEST, EmbedderClassifier
+from distributed_crawler_tpu.models.lora import (
+    finetune_lora,
+    init_lora_params,
+    merge_lora,
+)
+from distributed_crawler_tpu.models.train import TrainConfig
+from distributed_crawler_tpu.utils.metrics import MetricsRegistry
+from tests.test_train_head import _dataset, _tiny_engine
+
+
+def _params():
+    model = EmbedderClassifier(TINY_TEST)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    mask = jnp.ones((1, 8), jnp.bool_)
+    return model.init(jax.random.PRNGKey(0), ids, mask)
+
+
+class TestAdapters:
+    def test_init_covers_all_four_projections(self):
+        lora = init_lora_params(jax.random.PRNGKey(0), _params(), rank=4)
+        layer = lora["layers_0"]
+        assert set(layer) == {"attn/qkv/kernel", "attn/attn_out/kernel",
+                              "mlp/mlp_up/kernel", "mlp/mlp_down/kernel"}
+        qkv = layer["attn/qkv/kernel"]
+        h = TINY_TEST.hidden
+        assert qkv["a"].shape == (h, 4)
+        assert qkv["b"].shape == (4, 3, h)          # fused-QKV layout kept
+        assert float(jnp.abs(qkv["b"]).max()) == 0  # zero-init b
+
+    def test_merge_with_zero_b_is_identity(self):
+        params = _params()
+        lora = init_lora_params(jax.random.PRNGKey(0), params, rank=4)
+        merged = merge_lora(params, lora, rank=4)
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(merged)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+    def test_merge_does_not_mutate_base(self):
+        params = _params()
+        lora = init_lora_params(jax.random.PRNGKey(0), params, rank=2)
+        lora["layers_0"]["attn/qkv/kernel"]["b"] = jnp.ones_like(
+            lora["layers_0"]["attn/qkv/kernel"]["b"])
+        before = np.asarray(
+            params["params"]["encoder"]["layers_0"]["attn"]["qkv/kernel"])
+        merged = merge_lora(params, lora, rank=2)
+        after = np.asarray(
+            params["params"]["encoder"]["layers_0"]["attn"]["qkv/kernel"])
+        np.testing.assert_array_equal(before, after)
+        changed = np.asarray(
+            merged["params"]["encoder"]["layers_0"]["attn"]["qkv/kernel"])
+        assert not np.allclose(before, changed)
+
+    def test_merge_rank_mismatch_rejected(self):
+        params = _params()
+        lora = init_lora_params(jax.random.PRNGKey(0), params, rank=4)
+        with pytest.raises(ValueError, match="does not match"):
+            merge_lora(params, lora, rank=2)
+
+    def test_rank_and_label_validation(self):
+        params = _params()
+        with pytest.raises(ValueError, match="rank"):
+            finetune_lora(TINY_TEST, params, [[1, 2]], [0], rank=0)
+        with pytest.raises(ValueError, match="negative"):
+            finetune_lora(TINY_TEST, params, [[1, 2]], [-1], rank=2)
+
+
+class TestFinetuneLora:
+    def test_loss_drops_and_adapters_move_encoder(self):
+        engine = _tiny_engine()
+        texts, labels = _dataset()
+        toks = engine.tokenizer.encode_batch(texts)
+        merged, history = finetune_lora(
+            engine.ecfg, engine.params, toks, labels, rank=4,
+            tc=TrainConfig(learning_rate=5e-3, warmup_steps=5),
+            epochs=8, batch_size=16)
+        assert history[-1]["loss"] < history[0]["loss"] * 0.8
+        # The encoder itself moved (not just the head) ...
+        k0 = np.asarray(engine.params["params"]["encoder"]["layers_0"]
+                        ["attn"]["qkv/kernel"])
+        k1 = np.asarray(merged["params"]["encoder"]["layers_0"]
+                        ["attn"]["qkv/kernel"])
+        assert not np.allclose(k0, k1)
+        # ... and the merged tree serves: held-out accuracy beats random.
+        engine.params = merged
+        held_texts, held_labels = _dataset(n_per_class=10, seed=7)
+        out = engine.run(held_texts)
+        acc = np.mean([r["label"] == y for r, y in zip(out, held_labels)])
+        assert acc >= 0.8, f"held-out accuracy {acc} not above random"
+
+    def test_merged_tree_quantizes(self):
+        from distributed_crawler_tpu.models.quant import (
+            quantize_encoder_params,
+        )
+
+        engine = _tiny_engine()
+        texts, labels = _dataset(n_per_class=8)
+        toks = engine.tokenizer.encode_batch(texts)
+        merged, _ = finetune_lora(engine.ecfg, engine.params, toks, labels,
+                                  rank=2, epochs=1, batch_size=8)
+        q = quantize_encoder_params(merged)
+        assert (q["params"]["encoder"]["layers_0"]["attn"]
+                ["qkv/kernel_q"].dtype == jnp.int8)
+
+
+class TestCli:
+    def test_negative_lora_rank_rejected(self, tmp_path, capsys):
+        from distributed_crawler_tpu.cli import main
+
+        posts = tmp_path / "posts.jsonl"
+        posts.write_text(json.dumps({"post_uid": "p0", "all_text": "x"})
+                         + "\n")
+        labels = tmp_path / "labels.jsonl"
+        labels.write_text(json.dumps({"post_uid": "p0", "label": 0}) + "\n")
+        rc = main(["--mode", "train-head", "--infer-model", "tiny",
+                   "--train-posts", str(posts),
+                   "--train-labels", str(labels),
+                   "--head-checkpoint", str(tmp_path / "ckpt"),
+                   "--train-lora-rank", "-8",
+                   "--storage-root", str(tmp_path / "store")])
+        assert rc == 2
+
+    def test_train_head_mode_with_lora_rank(self, tmp_path):
+        from distributed_crawler_tpu.cli import main
+        from distributed_crawler_tpu.inference.checkpoint import (
+            latest_step_dir,
+            load_params,
+        )
+
+        texts, labels = _dataset(n_per_class=12)
+        posts = tmp_path / "posts.jsonl"
+        with open(posts, "w", encoding="utf-8") as f:
+            for i, text in enumerate(texts):
+                f.write(json.dumps({"post_uid": f"p{i}", "all_text": text})
+                        + "\n")
+        labels_file = tmp_path / "labels.jsonl"
+        with open(labels_file, "w", encoding="utf-8") as f:
+            for i, y in enumerate(labels):
+                f.write(json.dumps({"post_uid": f"p{i}", "label": int(y)})
+                        + "\n")
+        ckpt = str(tmp_path / "ckpt")
+        rc = main(["--mode", "train-head", "--infer-model", "tiny",
+                   "--train-posts", str(posts),
+                   "--train-labels", str(labels_file),
+                   "--head-checkpoint", ckpt,
+                   "--train-epochs", "2",
+                   "--train-lora-rank", "4",
+                   "--train-lr", "0.005",
+                   "--storage-root", str(tmp_path / "store")])
+        assert rc == 0
+        saved = load_params(latest_step_dir(ckpt) or ckpt)
+        # The merged checkpoint must be full-precision and engine-loadable.
+        dtypes = {leaf.dtype for leaf in jax.tree.leaves(saved)
+                  if hasattr(leaf, "dtype")}
+        assert dtypes == {np.dtype("float32")}
+        eng = InferenceEngine(
+            EngineConfig(model="tiny", batch_size=8, buckets=(16,),
+                         checkpoint_dir=ckpt),
+            registry=MetricsRegistry())
+        out = eng.run(["alpha beta gamma"])
+        assert out[0]["label"] in (0, 1)
