@@ -1014,7 +1014,11 @@ def _build_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver):
                          metrics_port=r.get_int(
                              "observability.metrics_port", 0),
                          profiler_port=r.get_int(
-                             "observability.profiler_port", 0)))
+                             "observability.profiler_port", 0),
+                         stall_warn_s=r.get_float(
+                             "inference.stall_warn_s", 120.0),
+                         stall_exit_s=r.get_float(
+                             "inference.stall_exit_s", 0.0)))
 
 
 def _run_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:

@@ -66,6 +66,17 @@ class TPUWorkerConfig:
     profiler_port: int = 0            # 0 = off; >0 = jax.profiler gRPC port
     storage_prefix: str = "inference"
     write_embeddings: bool = True     # False: labels/scores only (smaller JSONL)
+    # Device-stall watchdog.  Shared/tunneled TPUs have been observed to
+    # wedge for minutes (a jitted call that normally takes ~100 ms never
+    # returns); the bus's ack-timeout requeues the frame, but the worker
+    # thread itself stays stuck.  After ``stall_warn_s`` mid-step the
+    # watchdog logs + counts the stall and flags /status; after
+    # ``stall_exit_s`` (0 = never) it hard-exits the process so a
+    # supervisor restarts it — safe by design: un-acked frames requeue and
+    # the per-batch writeback is idempotent.  Size stall_warn_s above the
+    # first-compile time of the largest bucket (or warmup() first).
+    stall_warn_s: float = 120.0       # 0 disables the watchdog
+    stall_exit_s: float = 0.0         # 0 = warn only, never exit
 
 
 class TPUWorker:
@@ -96,8 +107,14 @@ class TPUWorker:
         self._processed = 0
         self._errors = 0
         self._metrics_server = None
+        self._step_started: Optional[float] = None   # monotonic, while in-step
+        self._stall_warned = False
+        self._exit_fn = None          # test seam; None -> os._exit
         self.m_queue_depth = registry.gauge(
             "tpu_worker_queue_depth", "decoded batches awaiting device")
+        self.m_stalls = registry.counter(
+            "tpu_worker_device_stalls_total",
+            "device steps exceeding stall_warn_s")
         self.m_batches = registry.counter(
             "tpu_worker_batches_total", "record batches processed")
         self.m_batch_age = registry.histogram(
@@ -107,6 +124,9 @@ class TPUWorker:
     def get_status(self) -> dict:
         """Status map for the /status endpoint (the `GetStatus()` analog
         the crawl orchestrator/worker expose, `worker.go:459`)."""
+        started = self._step_started
+        step_age = (time.monotonic() - started) if started is not None else 0.0
+        threshold = self._stall_threshold()
         return {
             "worker_id": self.cfg.worker_id,
             "model": self.engine.cfg.model,
@@ -115,6 +135,8 @@ class TPUWorker:
             "inflight": self._inflight,
             "processed_batches": self._processed,
             "error_batches": self._errors,
+            "device_step_age_s": round(step_age, 1),
+            "device_stalled": bool(threshold and step_age >= threshold),
             "uptime_s": (time.monotonic() - self._started_at)
             if self._started_at else 0.0,
         }
@@ -124,8 +146,11 @@ class TPUWorker:
         self._started_at = time.monotonic()
         set_status_provider(self.get_status)
         self.bus.subscribe(TOPIC_INFERENCE_BATCHES, self._handle_payload)
-        for target, name in ((self._feed_loop, "tpu-feed"),
-                             (self._heartbeat_loop, "tpu-heartbeat")):
+        loops = [(self._feed_loop, "tpu-feed"),
+                 (self._heartbeat_loop, "tpu-heartbeat")]
+        if self._stall_threshold() > 0:
+            loops.append((self._watchdog_loop, "tpu-watchdog"))
+        for target, name in loops:
             t = threading.Thread(target=target, daemon=True, name=name)
             t.start()
             self._threads.append(t)
@@ -244,7 +269,12 @@ class TPUWorker:
             age = (utcnow() - batch.created_at).total_seconds()
             if age >= 0:
                 self.m_batch_age.observe(age)
-        results = self.engine.run(batch.texts())
+        self._step_started = time.monotonic()
+        try:
+            results = self.engine.run(batch.texts())
+        finally:
+            self._step_started = None
+            self._stall_warned = False
         if not self.cfg.write_embeddings:
             results = [{k: v for k, v in r.items() if k != "embedding"}
                        for r in results]
@@ -270,6 +300,45 @@ class TPUWorker:
                 **result,
             }, ensure_ascii=False))
         self.provider.put_text(rel, "\n".join(lines) + "\n")
+
+    # -- device-stall watchdog ---------------------------------------------
+    def _stall_threshold(self) -> float:
+        """Smallest positive stall threshold; 0 when both are disabled.
+        An exit-only config (warn 0, exit > 0) still runs the watchdog —
+        the hard-exit safety must never silently depend on warnings being
+        enabled."""
+        positive = [t for t in (self.cfg.stall_warn_s, self.cfg.stall_exit_s)
+                    if t > 0]
+        return min(positive) if positive else 0.0
+
+    def _watchdog_loop(self) -> None:
+        poll = min(5.0, max(0.01, self._stall_threshold() / 10.0))
+        while not self._stop.is_set():
+            started = self._step_started
+            if started is not None:
+                age = time.monotonic() - started
+                if (self.cfg.stall_warn_s > 0
+                        and age >= self.cfg.stall_warn_s
+                        and not self._stall_warned):
+                    self._stall_warned = True
+                    self.m_stalls.inc()
+                    logger.warning(
+                        "device step stalled %.0fs (warn threshold %.0fs); "
+                        "chip wedged or compile outsized stall_warn_s",
+                        age, self.cfg.stall_warn_s,
+                        extra={"worker_id": self.cfg.worker_id})
+                if self.cfg.stall_exit_s and age >= self.cfg.stall_exit_s:
+                    logger.critical(
+                        "device step stalled %.0fs >= stall_exit_s %.0fs; "
+                        "exiting so the supervisor restarts this worker "
+                        "(un-acked frames requeue; writeback is idempotent)",
+                        age, self.cfg.stall_exit_s,
+                        extra={"worker_id": self.cfg.worker_id})
+                    import os as _os
+
+                    (self._exit_fn or _os._exit)(17)
+                    return  # unreachable in prod; ends the loop under test
+            self._stop.wait(poll)
 
     # -- heartbeats --------------------------------------------------------
     def _heartbeat_loop(self) -> None:
