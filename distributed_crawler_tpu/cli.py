@@ -1028,10 +1028,19 @@ def _run_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
     # Pod-scale bring-up from DCT_COORDINATOR / DCT_NUM_PROCESSES /
     # DCT_PROCESS_ID env vars; single-host runs are a no-op.
     initialize_multihost()
+    cache_dir = r.get_str("inference.compilation_cache_dir", "")
+    if cache_dir:
+        # Restarts (watchdog stall-exit, redeploys) reload each bucket's
+        # program from disk instead of recompiling, so warmup() below is
+        # near-instant on every start after the first.
+        from .inference.engine import enable_compilation_cache
+
+        enable_compilation_cache(cache_dir)
     worker = _build_tpu_worker(cfg, r)
     # Pre-compile the (bucket, batch) programs so the first crawl batches
-    # don't pay XLA compile latency mid-stream.
-    worker.engine.warmup()
+    # don't pay XLA compile latency mid-stream — under the stall watchdog,
+    # since bring-up is the longest on-chip window.
+    worker.warmup()
     worker.start()
     try:
         _serve_forever()

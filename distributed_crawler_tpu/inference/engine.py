@@ -9,6 +9,7 @@ embed+classify pass (one encoder traversal for both outputs).
 
 from __future__ import annotations
 
+import logging
 import time
 from dataclasses import dataclass, replace
 from typing import Any, Dict, List, Optional, Sequence
@@ -72,6 +73,34 @@ class EngineConfig:
                 f"unknown model {self.model!r}; "
                 f"one of {sorted(MODEL_REGISTRY)}") from None
         return replace(base, n_labels=self.n_labels)
+
+
+def enable_compilation_cache(cache_dir: str,
+                             min_compile_time_s: float = 1.0) -> bool:
+    """Turn on jax's persistent compilation cache rooted at ``cache_dir``.
+
+    Serving restarts — including the stall watchdog's hard-exit/restart
+    cycle (`worker.py`) and rolling redeploys — then reload each
+    (bucket, batch) program from disk instead of paying the 20-40 s XLA
+    compile per bucket.  Programs below ``min_compile_time_s`` are not
+    persisted (they recompile faster than they deserialize).  Best-effort:
+    returns False (with a log line) on jax versions without the config
+    knobs rather than failing startup.
+    """
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_compile_time_s)
+        # Cache every hit regardless of entry size — serving programs are
+        # few and the directory is operator-owned.
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        return True
+    except Exception as e:  # pragma: no cover - version-dependent
+        logging.getLogger(__name__).warning(
+            "persistent compilation cache unavailable: %s", e)
+        return False
 
 
 class InferenceEngine:

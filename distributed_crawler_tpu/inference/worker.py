@@ -73,8 +73,10 @@ class TPUWorkerConfig:
     # watchdog logs + counts the stall and flags /status; after
     # ``stall_exit_s`` (0 = never) it hard-exits the process so a
     # supervisor restarts it — safe by design: un-acked frames requeue and
-    # the per-batch writeback is idempotent.  Size stall_warn_s above the
-    # first-compile time of the largest bucket (or warmup() first).
+    # the per-batch writeback is idempotent.  Warmup compiles run under
+    # the same watchdog (TPUWorker.warmup), so size stall_warn_s above
+    # the first-compile time of the largest bucket, or configure
+    # `enable_compilation_cache` to make restart warmups near-instant.
     stall_warn_s: float = 120.0       # 0 disables the watchdog
     stall_exit_s: float = 0.0         # 0 = warn only, never exit
 
@@ -109,6 +111,7 @@ class TPUWorker:
         self._metrics_server = None
         self._step_started: Optional[float] = None   # monotonic, while in-step
         self._stall_warned = False
+        self._watchdog_started = False
         self._exit_fn = None          # test seam; None -> os._exit
         self.m_queue_depth = registry.gauge(
             "tpu_worker_queue_depth", "decoded batches awaiting device")
@@ -146,11 +149,9 @@ class TPUWorker:
         self._started_at = time.monotonic()
         set_status_provider(self.get_status)
         self.bus.subscribe(TOPIC_INFERENCE_BATCHES, self._handle_payload)
-        loops = [(self._feed_loop, "tpu-feed"),
-                 (self._heartbeat_loop, "tpu-heartbeat")]
-        if self._stall_threshold() > 0:
-            loops.append((self._watchdog_loop, "tpu-watchdog"))
-        for target, name in loops:
+        self._start_watchdog()
+        for target, name in ((self._feed_loop, "tpu-feed"),
+                             (self._heartbeat_loop, "tpu-heartbeat")):
             t = threading.Thread(target=target, daemon=True, name=name)
             t.start()
             self._threads.append(t)
@@ -301,7 +302,31 @@ class TPUWorker:
             }, ensure_ascii=False))
         self.provider.put_text(rel, "\n".join(lines) + "\n")
 
+    def warmup(self) -> None:
+        """`engine.warmup()` under the stall watchdog.  Bring-up compiles
+        are the LONGEST on-chip window (every bucket back-to-back), so a
+        chip that wedges here must still hit stall_warn/exit — callers use
+        this, not `engine.warmup()`, before serving.  With
+        `enable_compilation_cache` configured, restart warmups reload from
+        disk and finish in seconds."""
+        self._start_watchdog()
+        self._step_started = time.monotonic()
+        try:
+            self.engine.warmup()
+        finally:
+            self._step_started = None
+            self._stall_warned = False
+
     # -- device-stall watchdog ---------------------------------------------
+    def _start_watchdog(self) -> None:
+        if self._watchdog_started or self._stall_threshold() <= 0:
+            return
+        self._watchdog_started = True
+        t = threading.Thread(target=self._watchdog_loop, daemon=True,
+                             name="tpu-watchdog")
+        t.start()
+        self._threads.append(t)
+
     def _stall_threshold(self) -> float:
         """Smallest positive stall threshold; 0 when both are disabled.
         An exit-only config (warn 0, exit > 0) still runs the watchdog —
@@ -327,7 +352,8 @@ class TPUWorker:
                         "chip wedged or compile outsized stall_warn_s",
                         age, self.cfg.stall_warn_s,
                         extra={"worker_id": self.cfg.worker_id})
-                if self.cfg.stall_exit_s and age >= self.cfg.stall_exit_s:
+                if self.cfg.stall_exit_s > 0 \
+                        and age >= self.cfg.stall_exit_s:
                     logger.critical(
                         "device step stalled %.0fs >= stall_exit_s %.0fs; "
                         "exiting so the supervisor restarts this worker "

@@ -365,6 +365,27 @@ class TestCheckpoint:
         assert latest_step_dir(str(tmp_path / "missing")) is None
 
 
+class TestCompilationCache:
+    def test_programs_persist_to_cache_dir(self, tmp_path):
+        import jax
+
+        from distributed_crawler_tpu.inference.engine import (
+            enable_compilation_cache,
+        )
+
+        cache = str(tmp_path / "xla-cache")
+        assert enable_compilation_cache(cache, min_compile_time_s=0.0)
+        try:
+            eng = _engine()
+            eng.run(["persist me"])
+            import os
+
+            entries = os.listdir(cache) if os.path.isdir(cache) else []
+            assert entries, "no compiled programs persisted"
+        finally:
+            jax.config.update("jax_compilation_cache_dir", None)
+
+
 class TestStallWatchdog:
     """A wedged device step must surface (warn + counter + /status flag),
     and optionally hard-exit so a supervisor restarts the worker — shared
@@ -379,6 +400,9 @@ class TestStallWatchdog:
         def run(self, texts):
             time.sleep(self.delay_s)
             return [{"label": 0, "score": 1.0} for _ in texts]
+
+        def warmup(self):
+            self.run(["w"])
 
     def _run_with(self, stall_warn_s, stall_exit_s, delay_s):
         reg = MetricsRegistry()
@@ -437,6 +461,35 @@ class TestStallWatchdog:
         worker.stop()
         bus.close()
         assert exits and exits[0] == 17
+
+    def test_negative_exit_threshold_means_disabled(self):
+        # -1 is a common "off" convention; it must not exit on every poll.
+        bus, worker, exits = self._run_with(
+            stall_warn_s=0.05, stall_exit_s=-1.0, delay_s=0.3)
+        assert worker.drain(timeout_s=10.0)
+        worker.stop()
+        bus.close()
+        assert not exits
+
+    def test_warmup_is_guarded_by_watchdog(self):
+        # Bring-up compiles are the longest on-chip window: a wedge inside
+        # warmup() must still fire the exit path (pre-start()).
+        reg = MetricsRegistry()
+        worker = TPUWorker(InMemoryBus(), self._SlowEngine(0.8),
+                           cfg=TPUWorkerConfig(worker_id="w1",
+                                               heartbeat_s=60.0,
+                                               stall_warn_s=0.05,
+                                               stall_exit_s=0.15),
+                           registry=reg)
+        exits = []
+        worker._exit_fn = exits.append
+        t = threading.Thread(target=worker.warmup, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not exits:
+            time.sleep(0.02)
+        t.join(timeout=5)
+        assert exits and exits[0] == 17, "warmup wedge did not trigger exit"
 
     def test_fast_steps_never_stall(self):
         bus, worker, exits = self._run_with(
