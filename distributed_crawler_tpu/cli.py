@@ -125,6 +125,9 @@ def build_parser() -> argparse.ArgumentParser:
            "youtube-crawl*, scheduled-crawl*, maintenance-job*)")
     a("--job-due-s", type=float, default=None,
       help="seconds until the job fires (default 0 = now)")
+    a("--job-repeat-s", type=float, default=None,
+      help="re-fire the job every N seconds after the first run "
+           "(default 0 = one-shot; e.g. 86400 for a nightly crawl)")
     a("--job-data", default=None,
       help="job payload: inline JSON object or @path/to/file.json")
     a("--job-delete", action="store_const", const=True, default=None,
@@ -229,6 +232,7 @@ _KEY_MAP = {
     "bus_serve": "distributed.bus_serve",
     "job_name": "job.name",
     "job_due_s": "job.due_s",
+    "job_repeat_s": "job.repeat_s",
     "job_data": "job.data",
     "job_delete": "job.delete",
     "metrics_port": "observability.metrics_port",
@@ -714,7 +718,9 @@ def _run_job_submit(r: ConfigResolver) -> int:
         if not isinstance(data, dict):
             raise CliConfigError("--job-data must be a JSON object")
         command = {"action": "schedule", "name": name,
-                   "due_in_s": r.get_float("job.due_s", 0.0), "data": data}
+                   "due_in_s": r.get_float("job.due_s", 0.0),
+                   "repeat_every_s": r.get_float("job.repeat_s", 0.0),
+                   "data": data}
     from .bus.messages import TOPIC_JOBS
     bus = _make_bus(r)
     try:

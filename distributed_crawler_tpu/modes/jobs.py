@@ -262,6 +262,7 @@ class _ScheduledJob:
     name: str = field(compare=False)
     job_type: str = field(compare=False)
     data: Dict[str, Any] = field(compare=False)
+    repeat_every_s: float = field(default=0.0, compare=False)
 
 
 class JobScheduler:
@@ -281,10 +282,16 @@ class JobScheduler:
 
     # -- the three invocation handlers ------------------------------------
     def schedule_job(self, name: str, due_in_s: float,
-                     data: Dict[str, Any]) -> None:
+                     data: Dict[str, Any],
+                     repeat_every_s: float = 0.0) -> None:
+        """One-shot at ``due_in_s`` (the reference's DueTime semantics,
+        `dapr/job.go:366,874`), or recurring every ``repeat_every_s``
+        thereafter — the in-tree stand-in for the Dapr Jobs API's cron
+        ``Schedule`` the nightly-crawl deployments used the sidecar for."""
         job = _ScheduledJob(due_at=self.clock() + max(0.0, due_in_s),
                             name=name, job_type=extract_base_job_type(name),
-                            data=dict(data))
+                            data=dict(data),
+                            repeat_every_s=max(0.0, repeat_every_s))
         with self._lock:
             self._jobs[name] = job
             heapq.heappush(self._heap, job)
@@ -296,6 +303,7 @@ class JobScheduler:
             if job is None:
                 return None
             return {"name": job.name, "due_at": job.due_at,
+                    "repeat_every_s": job.repeat_every_s,
                     "data": dict(job.data)}
 
     def delete_job(self, name: str) -> bool:
@@ -308,15 +316,18 @@ class JobScheduler:
         (`dapr/job.go:81-95,852-895`).
 
         Payload: ``{"action": "schedule"|"delete", "name": ...,
-        "due_in_s": N, "data": {...}}``.  Raises ValueError on a malformed
-        command (the bus logs + dead-letters after retries)."""
+        "due_in_s": N, "repeat_every_s": N, "data": {...}}``.  Raises
+        ValueError on a malformed command (the bus logs + dead-letters
+        after retries)."""
         action = payload.get("action")
         name = payload.get("name") or ""
         if not name:
             raise ValueError("job command requires a name")
         if action == "schedule":
             self.schedule_job(name, float(payload.get("due_in_s") or 0.0),
-                              dict(payload.get("data") or {}))
+                              dict(payload.get("data") or {}),
+                              repeat_every_s=float(
+                                  payload.get("repeat_every_s") or 0.0))
             logger.info("scheduled job %s via bus", name)
         elif action == "delete":
             existed = self.delete_job(name)
@@ -326,9 +337,12 @@ class JobScheduler:
 
     # -- dispatch ----------------------------------------------------------
     def run_due_jobs(self) -> int:
-        """Dispatch everything due now; returns count (test-friendly tick)."""
+        """Dispatch everything due now; returns count (test-friendly tick).
+        Checks ``_stop`` each iteration: a recurring job whose handler
+        outruns its period keeps the heap head permanently due, and
+        ``stop()`` must still terminate the dispatch thread."""
         fired = 0
-        while True:
+        while not self._stop.is_set():
             with self._lock:
                 if not self._heap or self._heap[0].due_at > self.clock():
                     return fired
@@ -336,12 +350,46 @@ class JobScheduler:
                 # Deleted or replaced entries are stale in the heap.
                 if self._jobs.get(job.name) is not job:
                     continue
-                del self._jobs[job.name]
+                if job.repeat_every_s > 0:
+                    # Re-arm BEFORE dispatch so delete_job() mid-run still
+                    # cancels the series, and a crash between fire and
+                    # re-arm can't silently end the recurrence.  A series
+                    # that fell far behind (host slept) skips ahead to the
+                    # next FUTURE slot — one late fire, no catch-up burst
+                    # of heavyweight crawls.
+                    due = job.due_at + job.repeat_every_s
+                    if due <= self.clock():
+                        due = self.clock() + job.repeat_every_s
+                    nxt = _ScheduledJob(
+                        due_at=due,
+                        name=job.name, job_type=job.job_type,
+                        data=dict(job.data),
+                        repeat_every_s=job.repeat_every_s)
+                    self._jobs[job.name] = nxt
+                    heapq.heappush(self._heap, nxt)
+                else:
+                    del self._jobs[job.name]
             try:
                 self.service.handle_job(job.job_type, job.data)
             except Exception as e:
                 logger.error("job %s failed: %s", job.name, e)
             fired += 1
+            if job.repeat_every_s > 0:
+                # A handler that outran its period leaves the re-armed slot
+                # already due — that would refire back-to-back forever.
+                # Push the series one full period out from NOW instead.
+                with self._lock:
+                    cur = self._jobs.get(job.name)
+                    if cur is not None and cur.repeat_every_s > 0 \
+                            and cur.due_at <= self.clock():
+                        bumped = _ScheduledJob(
+                            due_at=self.clock() + cur.repeat_every_s,
+                            name=cur.name, job_type=cur.job_type,
+                            data=dict(cur.data),
+                            repeat_every_s=cur.repeat_every_s)
+                        self._jobs[cur.name] = bumped
+                        heapq.heappush(self._heap, bumped)
+        return fired
 
     def start(self) -> None:
         if self._thread is not None:

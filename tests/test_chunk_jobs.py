@@ -338,6 +338,89 @@ class TestJobScheduler:
         assert sched.run_due_jobs() == 0
         assert sched.get_job("telegram-crawl-2") is None
 
+    def test_recurring_job_refires_and_cancels(self):
+        launches = []
+        svc = JobService(CrawlerConfig(platform="telegram"),
+                         launch_fn=lambda urls, cfg: launches.append(urls),
+                         file_cleaner_factory=FakeCleaner)
+        now = [1000.0]
+        sched = JobScheduler(svc, clock=lambda: now[0])
+        sched.schedule_job("telegram-crawl-nightly", 10.0,
+                           JobData(job_name="telegram-crawl-nightly",
+                                   urls=["a"]).to_dict(),
+                           repeat_every_s=100.0)
+        now[0] = 1011.0
+        assert sched.run_due_jobs() == 1
+        # Still registered: the series re-armed for the next slot.
+        assert sched.get_job("telegram-crawl-nightly") is not None
+        now[0] = 1111.0
+        assert sched.run_due_jobs() == 1
+        assert launches == [["a"], ["a"]]
+        # delete_job cancels the whole series.
+        assert sched.delete_job("telegram-crawl-nightly")
+        now[0] = 2000.0
+        assert sched.run_due_jobs() == 0
+
+    def test_recurring_job_skips_catchup_burst(self):
+        launches = []
+        svc = JobService(CrawlerConfig(platform="telegram"),
+                         launch_fn=lambda urls, cfg: launches.append(urls),
+                         file_cleaner_factory=FakeCleaner)
+        now = [1000.0]
+        sched = JobScheduler(svc, clock=lambda: now[0])
+        sched.schedule_job("telegram-crawl-n", 0.0,
+                           JobData(job_name="telegram-crawl-n",
+                                   urls=["a"]).to_dict(),
+                           repeat_every_s=10.0)
+        # Host "slept" through ~50 missed slots: exactly ONE late fire,
+        # then the next slot is in the future — no burst.
+        now[0] = 1500.0
+        assert sched.run_due_jobs() == 1
+        job = sched.get_job("telegram-crawl-n")
+        assert job is not None and job["due_at"] == 1510.0
+        assert sched.run_due_jobs() == 0
+
+    def test_recurring_slow_handler_never_spins(self):
+        """A handler slower than its period must not refire back-to-back
+        (and stop() must still terminate dispatch)."""
+        now = [1000.0]
+        launches = []
+
+        def slow_launch(urls, cfg):
+            launches.append(urls)
+            now[0] += 25.0  # handler takes 25s; period is 10s
+
+        svc = JobService(CrawlerConfig(platform="telegram"),
+                         launch_fn=slow_launch,
+                         file_cleaner_factory=FakeCleaner)
+        sched = JobScheduler(svc, clock=lambda: now[0])
+        sched.schedule_job("telegram-crawl-slow", 0.0,
+                           JobData(job_name="telegram-crawl-slow",
+                                   urls=["a"]).to_dict(),
+                           repeat_every_s=10.0)
+        assert sched.run_due_jobs() == 1   # one fire, then future slot
+        assert len(launches) == 1
+        job = sched.get_job("telegram-crawl-slow")
+        assert job is not None
+        assert job["due_at"] > now[0]      # bumped past 'now'
+        assert job["repeat_every_s"] == 10.0
+
+    def test_recurring_via_bus_command(self):
+        launches = []
+        svc = JobService(CrawlerConfig(platform="telegram"),
+                         launch_fn=lambda urls, cfg: launches.append(urls),
+                         file_cleaner_factory=FakeCleaner)
+        now = [0.0]
+        sched = JobScheduler(svc, clock=lambda: now[0])
+        sched.handle_command({"action": "schedule",
+                              "name": "telegram-crawl-r",
+                              "due_in_s": 1.0, "repeat_every_s": 5.0,
+                              "data": JobData(job_name="telegram-crawl-r",
+                                              urls=["x"]).to_dict()})
+        now[0] = 2.0
+        assert sched.run_due_jobs() == 1
+        assert sched.get_job("telegram-crawl-r") is not None
+
     def test_handle_command_bus_transport(self):
         """schedule/delete arriving as bus payloads (`job-commands`) —
         the Dapr-invocation-handler replacement (`dapr/job.go:81-95`)."""
