@@ -343,6 +343,7 @@ class JobScheduler:
         ``stop()`` must still terminate the dispatch thread."""
         fired = 0
         while not self._stop.is_set():
+            rearmed = None
             with self._lock:
                 if not self._heap or self._heap[0].due_at > self.clock():
                     return fired
@@ -360,13 +361,7 @@ class JobScheduler:
                     due = job.due_at + job.repeat_every_s
                     if due <= self.clock():
                         due = self.clock() + job.repeat_every_s
-                    nxt = _ScheduledJob(
-                        due_at=due,
-                        name=job.name, job_type=job.job_type,
-                        data=dict(job.data),
-                        repeat_every_s=job.repeat_every_s)
-                    self._jobs[job.name] = nxt
-                    heapq.heappush(self._heap, nxt)
+                    rearmed = self._rearm(job, due)
                 else:
                     del self._jobs[job.name]
             try:
@@ -374,22 +369,28 @@ class JobScheduler:
             except Exception as e:
                 logger.error("job %s failed: %s", job.name, e)
             fired += 1
-            if job.repeat_every_s > 0:
+            if rearmed is not None:
                 # A handler that outran its period leaves the re-armed slot
                 # already due — that would refire back-to-back forever.
                 # Push the series one full period out from NOW instead.
+                # Identity check: if the operator re-scheduled this name
+                # mid-dispatch (e.g. a forced due-now run), their entry
+                # wins untouched.
                 with self._lock:
                     cur = self._jobs.get(job.name)
-                    if cur is not None and cur.repeat_every_s > 0 \
-                            and cur.due_at <= self.clock():
-                        bumped = _ScheduledJob(
-                            due_at=self.clock() + cur.repeat_every_s,
-                            name=cur.name, job_type=cur.job_type,
-                            data=dict(cur.data),
-                            repeat_every_s=cur.repeat_every_s)
-                        self._jobs[cur.name] = bumped
-                        heapq.heappush(self._heap, bumped)
+                    if cur is rearmed and cur.due_at <= self.clock():
+                        self._rearm(cur, self.clock() + cur.repeat_every_s)
         return fired
+
+    def _rearm(self, job: _ScheduledJob, due_at: float) -> _ScheduledJob:
+        """Register a fresh series entry at ``due_at`` (caller holds the
+        lock).  The ONE construction site for re-armed entries, so data
+        copying and field propagation can't drift between the re-arm and
+        bump paths."""
+        nxt = dataclasses.replace(job, due_at=due_at, data=dict(job.data))
+        self._jobs[job.name] = nxt
+        heapq.heappush(self._heap, nxt)
+        return nxt
 
     def start(self) -> None:
         if self._thread is not None:

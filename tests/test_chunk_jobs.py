@@ -405,6 +405,37 @@ class TestJobScheduler:
         assert job["due_at"] > now[0]      # bumped past 'now'
         assert job["repeat_every_s"] == 10.0
 
+    def test_operator_reschedule_mid_dispatch_wins(self):
+        """A due-now reschedule landing while the handler runs must fire
+        immediately — the anti-spin bump may only touch ITS OWN re-armed
+        entry, never an operator's replacement."""
+        now = [1000.0]
+        launches = []
+        sched_box = []
+
+        def launch(urls, cfg):
+            launches.append(urls)
+            now[0] += 25.0  # slow handler outruns the 10s period
+            if len(launches) == 1:
+                # Concurrent operator command: force an immediate re-run.
+                sched_box[0].schedule_job(
+                    "telegram-crawl-slow", 0.0,
+                    JobData(job_name="telegram-crawl-slow",
+                            urls=["forced"]).to_dict(),
+                    repeat_every_s=10.0)
+
+        svc = JobService(CrawlerConfig(platform="telegram"),
+                         launch_fn=launch,
+                         file_cleaner_factory=FakeCleaner)
+        sched = JobScheduler(svc, clock=lambda: now[0])
+        sched_box.append(sched)
+        sched.schedule_job("telegram-crawl-slow", 0.0,
+                           JobData(job_name="telegram-crawl-slow",
+                                   urls=["a"]).to_dict(),
+                           repeat_every_s=10.0)
+        assert sched.run_due_jobs() == 2  # original + the forced re-run
+        assert launches == [["a"], ["forced"]]
+
     def test_recurring_via_bus_command(self):
         launches = []
         svc = JobService(CrawlerConfig(platform="telegram"),

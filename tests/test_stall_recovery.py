@@ -1,0 +1,113 @@
+"""End-to-end wedge recovery: the failure mode this framework's watchdog +
+at-least-once bus + idempotent writeback were designed around, exercised
+together.  A TPU worker whose device step hangs forever stall-exits (via
+the test seam standing in for os._exit) and its bus connection dies with
+it; the un-acked frame requeues server-side; a replacement worker pulls
+it and lands the writeback.  Zero batches lost — the full story behind
+the `docs/operations.md` runbook row."""
+
+import threading
+import time
+
+from distributed_crawler_tpu.bus.codec import RecordBatch
+from distributed_crawler_tpu.bus.grpc_bus import GrpcBusServer, RemoteBus
+from distributed_crawler_tpu.bus.messages import TOPIC_INFERENCE_BATCHES
+from distributed_crawler_tpu.datamodel.post import Post
+from distributed_crawler_tpu.inference.engine import EngineConfig
+from distributed_crawler_tpu.inference.worker import TPUWorker, TPUWorkerConfig
+from distributed_crawler_tpu.state.providers import InMemoryStorageProvider
+from distributed_crawler_tpu.utils.metrics import MetricsRegistry
+
+
+class WedgedEngine:
+    """First call hangs until released — a tunneled chip mid-wedge."""
+
+    cfg = EngineConfig()
+
+    def __init__(self):
+        self.release = threading.Event()
+
+    def run(self, texts):
+        self.release.wait(timeout=30.0)
+        return [{"label": 0, "score": 1.0} for _ in texts]
+
+
+class GoodEngine:
+    cfg = EngineConfig()
+
+    def run(self, texts):
+        return [{"label": 1, "score": 0.9} for _ in texts]
+
+
+def _wait(pred, timeout_s=15.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return pred()
+
+
+def test_stalled_worker_exits_and_replacement_finishes_the_batch():
+    server = GrpcBusServer(address="127.0.0.1:0", ack_timeout_s=0.5)
+    server.start()
+    addr = f"127.0.0.1:{server.bound_port}"
+    wedged = WedgedEngine()
+    worker_b = None
+    bus_a = bus_b = producer = None
+    try:
+        # Worker A: wedged device, watchdog armed to exit fast.
+        bus_a = RemoteBus(addr)
+        worker_a = TPUWorker(bus_a, wedged,
+                             cfg=TPUWorkerConfig(worker_id="wedged",
+                                                 heartbeat_s=60.0,
+                                                 stall_warn_s=0.1,
+                                                 stall_exit_s=0.3),
+                             registry=MetricsRegistry())
+        exits = []
+        worker_a._exit_fn = exits.append
+        worker_a.start()
+
+        producer = RemoteBus(addr)
+        batch = RecordBatch.from_posts(
+            [Post(post_uid="p0", channel_name="chan",
+                  description="the batch a wedged worker must not lose")],
+            crawl_id="c1")
+        producer.publish(TOPIC_INFERENCE_BATCHES, batch.to_dict())
+
+        # The watchdog detects the wedge and "kills the process".
+        assert _wait(lambda: bool(exits)), "watchdog never fired exit"
+        assert exits[0] == 17
+        # Death of the process == death of its bus connection: the stream
+        # teardown (or the 0.5 s ack timeout) requeues the un-acked frame.
+        bus_a.close()
+        assert _wait(
+            lambda: server.pending_count(TOPIC_INFERENCE_BATCHES) >= 1), \
+            "frame was not requeued after the stalled worker died"
+
+        # Replacement worker with a healthy device picks it up.
+        provider = InMemoryStorageProvider()
+        bus_b = RemoteBus(addr)
+        worker_b = TPUWorker(bus_b, GoodEngine(), provider=provider,
+                             cfg=TPUWorkerConfig(worker_id="fresh",
+                                                 heartbeat_s=60.0),
+                             registry=MetricsRegistry())
+        worker_b.start()
+        rel = f"inference/c1/batches/{batch.batch_id}.jsonl"
+        assert _wait(lambda: provider.exists(rel)), \
+            "replacement worker never landed the writeback"
+        text = provider.get_text(rel)
+        assert '"label": 1' in text  # processed by the HEALTHY engine
+        assert worker_b.drain(timeout_s=10.0)
+        assert server.pending_count(TOPIC_INFERENCE_BATCHES) == 0
+    finally:
+        wedged.release.set()  # unstick worker A's feed thread
+        if worker_b is not None:
+            worker_b.stop(timeout_s=5.0)
+        for b in (bus_b, producer):
+            if b is not None:
+                try:
+                    b.close()
+                except Exception:
+                    pass
+        server.close()
