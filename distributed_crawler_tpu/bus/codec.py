@@ -55,13 +55,39 @@ def _compress(data: bytes, method: str) -> bytes:
     return data
 
 
+# Decompression-bomb bound: a few-KB adversarial body must not be able to
+# allocate unbounded memory in the worker.  Sized above any legitimate
+# payload (the transport's own frame cap is 201 MB compressed; crawl-text
+# batches expand ~3-5x).
+MAX_DECOMPRESSED_BYTES = 1 << 30
+
+
 def _decompress(data: bytes, method: str) -> bytes:
     if method == COMPRESSION_ZSTD:
         if _zstd is None:
             raise ValueError("zstd frame received but zstandard unavailable")
-        return _ZSTD_D.decompress(data)
+        try:
+            # A declared content size wins over max_output_size inside the
+            # library, so the bomb check must read it explicitly.
+            declared = _zstd.frame_content_size(data)
+            if declared > MAX_DECOMPRESSED_BYTES:
+                raise ValueError(
+                    f"zstd frame declares {declared} bytes "
+                    f"(limit {MAX_DECOMPRESSED_BYTES})")
+            return _ZSTD_D.decompress(
+                data, max_output_size=MAX_DECOMPRESSED_BYTES)
+        except _zstd.ZstdError as e:  # corrupted body off the wire
+            raise ValueError(f"zstd frame corrupt: {e}") from e
     if method == COMPRESSION_ZLIB:
-        return zlib.decompress(data)
+        d = zlib.decompressobj()
+        try:
+            out = d.decompress(data, MAX_DECOMPRESSED_BYTES)
+        except zlib.error as e:
+            raise ValueError(f"zlib frame corrupt: {e}") from e
+        if d.unconsumed_tail:
+            raise ValueError(
+                f"zlib frame exceeds {MAX_DECOMPRESSED_BYTES} bytes")
+        return out
     return data
 
 
@@ -166,7 +192,16 @@ def decode_frame(data: bytes) -> Tuple[Dict[str, Any], bytes]:
     if len(data) < end:
         raise ValueError("truncated frame body")
     raw = _decompress(data[_HEADER.size:end], _COMP_NAMES[comp_id])
-    return json.loads(raw.decode("utf-8")), data[end:]
+    try:
+        payload = json.loads(raw.decode("utf-8"))
+    except RecursionError as e:
+        # Adversarially deep nesting ('['*N) must still surface as the
+        # drop/dead-letter signal, not crash the handler thread.
+        raise ValueError("frame JSON nests too deeply") from e
+    if not isinstance(payload, dict):
+        raise ValueError(
+            f"frame payload is {type(payload).__name__}, expected object")
+    return payload, data[end:]
 
 
 def decode_frames(data: bytes) -> Iterator[Dict[str, Any]]:
