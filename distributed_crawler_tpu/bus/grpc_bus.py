@@ -330,6 +330,30 @@ class GrpcBusServer:
         with tq.lock:
             return tq.q.qsize() + len(tq.inflight)
 
+    def drain(self, timeout_s: float = 30.0,
+              poll_s: float = 0.2) -> bool:
+        """Block until every pull topic is empty (queued AND in-flight),
+        or the timeout expires; returns True when fully drained.
+
+        A broker-hosting process that exits the moment ITS work is done
+        (the orchestrator after crawl completion) takes every undelivered
+        frame down with it — consumers that were still warming up lose
+        their batches.  Call this before close().
+        """
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._lock:
+                topics = list(self._pull_queues)
+            remaining = {t: self.pending_count(t) for t in topics}
+            remaining = {t: n for t, n in remaining.items() if n}
+            if not remaining:
+                return True
+            if time.monotonic() >= deadline:
+                logger.warning(
+                    "bus drain timed out with frames pending: %s", remaining)
+                return False
+            time.sleep(poll_s)
+
     def start(self) -> None:
         self._server.start()
         self._sweeper = threading.Thread(target=self._sweep_loop,

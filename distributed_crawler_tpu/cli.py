@@ -472,6 +472,11 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
             try:
                 _serve_forever()
             finally:
+                # Shutdown grace: REMOTE consumers can keep pulling while
+                # the broker drains (unlike --bus-serve hosts, whose only
+                # consumer is themselves and already exiting).
+                bus.drain(timeout_s=r.get_float(
+                    "distributed.shutdown_drain_s", 30.0))
                 bus.close()
         elif mode == "train-head":
             return _run_train_head(cfg, r)
@@ -628,6 +633,15 @@ def _run_orchestrator(urls: List[str], cfg: CrawlerConfig,
             running=lambda: orch.is_running and not orch.crawl_completed)
     finally:
         orch.stop()
+        # This process hosts the broker: exiting the moment the crawl
+        # completes would take undelivered frames (e.g. inference batches
+        # a TPU worker hasn't pulled yet) down with it.  COMPLETED crawls
+        # only — an interrupted/aborted run must exit promptly, not stall
+        # on frames nobody will ever consume.
+        drain = getattr(bus, "drain", None)
+        if callable(drain) and orch.crawl_completed:
+            drain(timeout_s=r.get_float("distributed.shutdown_drain_s",
+                                        30.0))
         bus.close()
 
 

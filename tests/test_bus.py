@@ -307,6 +307,26 @@ class TestGrpcBusAcks:
         finally:
             server.close()
 
+    def test_drain_waits_for_pull_consumers(self):
+        """drain() holds the broker open until queued+in-flight frames are
+        consumed — the orchestrator calls it before tearing down the bus
+        so late-starting workers don't lose batches."""
+        from distributed_crawler_tpu.bus.grpc_bus import GrpcBusClient
+        server = self._server()
+        try:
+            client = GrpcBusClient(f"127.0.0.1:{server.bound_port}")
+            client.publish("work", {"n": 1})
+            assert server.drain(timeout_s=0.3, poll_s=0.05) is False
+            stream = client.pull("work")
+            for delivery_id, _frame in stream:
+                client.ack("work", delivery_id, ok=True)
+                break
+            stream.close()
+            assert server.drain(timeout_s=5.0, poll_s=0.05) is True
+            client.close()
+        finally:
+            server.close()
+
     def test_worker_crash_requeues_unacked(self):
         """Kill-a-worker: frames pulled but never acked are redelivered to
         the next worker — zero lost, zero duplicated."""
