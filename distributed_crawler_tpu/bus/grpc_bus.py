@@ -248,15 +248,24 @@ class GrpcBusServer:
         try:
             while context.is_active():
                 self._sweep_expired(topic, tq)
-                try:
-                    frame = tq.q.get(timeout=0.25)
-                except queue.Empty:
-                    continue
-                delivery_id = uuid.uuid4().hex
+                # Pop and register in-flight ATOMICALLY under tq.lock: a
+                # frame popped but not yet registered would be invisible
+                # to pending_count(), letting drain() declare the broker
+                # empty while a frame is mid-handoff.
                 with tq.lock:
-                    tq.inflight[delivery_id] = _Inflight(
-                        frame.payload, frame.attempts,
-                        time.monotonic() + self.ack_timeout_s, stream_id)
+                    try:
+                        frame = tq.q.get_nowait()
+                    except queue.Empty:
+                        frame = None
+                    else:
+                        delivery_id = uuid.uuid4().hex
+                        tq.inflight[delivery_id] = _Inflight(
+                            frame.payload, frame.attempts,
+                            time.monotonic() + self.ack_timeout_s,
+                            stream_id)
+                if frame is None:
+                    time.sleep(0.05)
+                    continue
                 try:
                     yield delivery_id.encode("ascii") + _TOPIC_SEP + \
                         frame.payload

@@ -474,10 +474,13 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
             finally:
                 # Shutdown grace: REMOTE consumers can keep pulling while
                 # the broker drains (unlike --bus-serve hosts, whose only
-                # consumer is themselves and already exiting).
-                bus.drain(timeout_s=r.get_float(
-                    "distributed.shutdown_drain_s", 30.0))
-                bus.close()
+                # consumer is themselves and already exiting).  close()
+                # must run even if the drain is interrupted (second ^C).
+                try:
+                    bus.drain(timeout_s=r.get_float(
+                        "distributed.shutdown_drain_s", 30.0))
+                finally:
+                    bus.close()
         elif mode == "train-head":
             return _run_train_head(cfg, r)
         elif mode == "cluster":
@@ -638,11 +641,13 @@ def _run_orchestrator(urls: List[str], cfg: CrawlerConfig,
         # a TPU worker hasn't pulled yet) down with it.  COMPLETED crawls
         # only — an interrupted/aborted run must exit promptly, not stall
         # on frames nobody will ever consume.
-        drain = getattr(bus, "drain", None)
-        if callable(drain) and orch.crawl_completed:
-            drain(timeout_s=r.get_float("distributed.shutdown_drain_s",
-                                        30.0))
-        bus.close()
+        try:
+            drain = getattr(bus, "drain", None)
+            if callable(drain) and orch.crawl_completed:
+                drain(timeout_s=r.get_float("distributed.shutdown_drain_s",
+                                            30.0))
+        finally:
+            bus.close()
 
 
 def _run_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:

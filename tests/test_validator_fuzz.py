@@ -1,0 +1,97 @@
+"""Fuzz the t.me HTML classifier (clients/http_validator.py).
+
+The validator runs against responses an adversary partially controls (a
+channel's title/description is attacker-supplied text inside the page),
+and against arbitrarily mangled bytes when t.me is behind interfering
+middleboxes.  Contract: `parse_channel_html` returns a classification or
+raises ValueError (the caller's soft-block signal) — never any other
+exception — and page-BODY text must not be able to spoof a valid
+classification (only the <title> element decides)."""
+
+import os
+import random
+
+import pytest
+
+from distributed_crawler_tpu.clients.http_validator import (
+    parse_channel_html,
+)
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures", "telegram-html")
+FIXTURES = [os.path.join(FIXDIR, n) for n in sorted(os.listdir(FIXDIR))]
+SEEDS = range(25)
+
+
+def _load(path):
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        return f.read()
+
+
+class TestMutationRobustness:
+    @pytest.mark.parametrize("seed", SEEDS)
+    @pytest.mark.parametrize("path", FIXTURES)
+    def test_mutated_fixture_never_crashes(self, path, seed):
+        rng = random.Random(seed)
+        html = list(_load(path))
+        for _ in range(rng.randrange(1, 30)):
+            op = rng.randrange(3)
+            pos = rng.randrange(len(html)) if html else 0
+            if op == 0 and html:
+                html[pos] = chr(rng.randrange(32, 0x300))
+            elif op == 1 and html:
+                del html[pos]
+            else:
+                html.insert(pos, rng.choice("<>/=\"' &;\x00abct"))
+        try:
+            result = parse_channel_html("".join(html))
+        except ValueError:
+            return  # soft-block: the documented failure mode
+        assert result.status in ("valid", "invalid", "not_channel")
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_random_garbage_is_valueerror_or_classified(self, seed):
+        rng = random.Random(500 + seed)
+        junk = "".join(chr(rng.randrange(1, 0x500))
+                       for _ in range(rng.randrange(0, 3000)))
+        try:
+            result = parse_channel_html(junk)
+        except ValueError:
+            return
+        assert result.status in ("valid", "invalid", "not_channel")
+
+    def test_truncations_of_every_fixture(self):
+        for path in FIXTURES:
+            html = _load(path)
+            for cut in range(0, len(html), max(1, len(html) // 40)):
+                try:
+                    parse_channel_html(html[:cut])
+                except ValueError:
+                    pass
+
+
+class TestSpoofResistance:
+    def test_body_text_cannot_spoof_valid(self):
+        """Attacker-controlled page TEXT containing the valid-title marker
+        must not classify as valid — only the <title> element decides."""
+        html = ("<html><head><title>Telegram Messenger</title></head>"
+                "<body><p>Telegram: View @evil_channel</p></body></html>")
+        assert parse_channel_html(html).status == "invalid"
+
+    def test_spoofed_marker_in_description_meta(self):
+        html = ('<html><head><title>Telegram: Contact @someone</title>'
+                '<meta property="og:description" '
+                'content="Telegram: View @fake"></head><body></body></html>')
+        assert parse_channel_html(html).status == "not_channel"
+
+    def test_second_title_does_not_override_first(self):
+        html = ("<html><head><title>Telegram Messenger</title>"
+                "<title>Telegram: View @injected</title></head></html>")
+        assert parse_channel_html(html).status == "invalid"
+
+    def test_robots_noindex_only_counts_inside_its_own_tag(self):
+        # 'noindex' appearing in body text far from the robots meta must
+        # not flip a contact page to not_found.
+        html = ('<html><head><title>Telegram: Contact @user</title>'
+                '<meta name="robots" content="all"></head>'
+                "<body>noindex</body></html>")
+        assert parse_channel_html(html).status == "not_channel"
