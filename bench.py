@@ -126,7 +126,8 @@ def _measure(scale_devices: int | None = None,
              batch: int | None = None, seq: int = SEQ,
              n_short: int = N_SHORT, n_long: int = N_LONG,
              latency_samples: int = LATENCY_SAMPLES,
-             repeats: int = 3, with_int8: bool = True) -> dict:
+             repeats: int = 3, with_int8: bool = True,
+             with_serving: bool = True) -> dict:
     """Run the measurement in-process; returns the result dict."""
     import jax
     import jax.numpy as jnp
@@ -153,6 +154,7 @@ def _measure(scale_devices: int | None = None,
 
     n_dev = len(jax.devices())
     use_dev = scale_devices or n_dev
+    mesh = None
     if use_dev > 1:
         from distributed_crawler_tpu.parallel import (
             best_mesh_config, make_mesh, shard_batch, shard_params,
@@ -233,6 +235,37 @@ def _measure(scale_devices: int | None = None,
         except Exception as exc:  # noqa: BLE001 — int8 row is best-effort
             _log(f"int8 measurement skipped: {exc}")
 
+    # Serving-path throughput: the ACTUAL InferenceEngine.run_tokenized
+    # loop (bucketing, one-deep dispatch/readback pipeline, softmax,
+    # result dicts) — what a TPUWorker batch stream achieves end to end,
+    # as opposed to the chained pure-device number above.  Best-effort.
+    serving_pps = None
+    if with_serving:
+        try:
+            from distributed_crawler_tpu.inference.engine import (
+                EngineConfig,
+                InferenceEngine,
+            )
+            from distributed_crawler_tpu.utils.metrics import MetricsRegistry
+
+            # Same mesh as the chained baseline (None single-device), so
+            # the "x of chained" ratio compares like for like.
+            eng = InferenceEngine(
+                EngineConfig(model="e5_small", n_labels=8, batch_size=batch,
+                             buckets=(seq,)),
+                mesh=mesh, params=params, registry=MetricsRegistry())
+            toks = [[7] * (seq - 2)] * (batch * 8)
+            eng.run_tokenized(toks[:batch])  # compile+warm
+            t0 = time.perf_counter()
+            out = eng.run_tokenized(toks)
+            dt = time.perf_counter() - t0
+            assert len(out) == len(toks)
+            serving_pps = len(toks) / dt
+            _log(f"serving path: {serving_pps:.1f} posts/sec "
+                 f"({serving_pps / posts_per_sec:.2f}x of chained)")
+        except Exception as exc:  # noqa: BLE001 — best-effort row
+            _log(f"serving-path measurement skipped: {exc}")
+
     # Per-batch latency: one step closed with a scalar readback each time —
     # the latency a TPUWorker batch actually experiences (includes RPC).
     @jax.jit
@@ -271,6 +304,8 @@ def _measure(scale_devices: int | None = None,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "int8_posts_per_sec": round(int8_pps, 1) if int8_pps else None,
         "int8_speedup": round(int8_pps / posts_per_sec, 2) if int8_pps
+        else None,
+        "serving_posts_per_sec": round(serving_pps, 1) if serving_pps
         else None,
         "platform": jax.default_backend(),
         "device_kind": jax.devices()[0].device_kind,
@@ -431,8 +466,8 @@ def main() -> None:
             # batch/iteration counts so the number lands inside the fallback
             # timeout on a laptop-class host.
             print(json.dumps(_measure(batch=64, n_short=2, n_long=6,
-                                      latency_samples=5,
-                                      with_int8=False)), flush=True)
+                                      latency_samples=5, with_int8=False,
+                                      with_serving=False)), flush=True)
         else:
             print(json.dumps(_measure()), flush=True)
         return

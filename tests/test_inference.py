@@ -97,6 +97,22 @@ class TestInferenceEngine:
         out = eng.run([f"text {i}" for i in range(11)])
         assert len(out) == 11
 
+    def test_pipelined_chunks_keep_order_across_buckets(self):
+        """The one-deep dispatch/readback pipeline must not reorder or
+        drop results when inputs span several buckets and ragged chunk
+        boundaries."""
+        from dataclasses import replace as dc_replace
+
+        eng = _engine()
+        eng.cfg = dc_replace(eng.cfg, batch_size=3)
+        texts = [f"w{i} " * (3 if i % 3 == 0 else 20) for i in range(11)]
+        out = eng.run(texts)
+        assert len(out) == 11
+        assert all(r is not None and "embedding" in r for r in out)
+        # Same inputs twice -> identical labels in identical positions.
+        again = eng.run(texts)
+        assert [r["label"] for r in out] == [r["label"] for r in again]
+
     def test_metrics_recorded(self):
         reg = MetricsRegistry()
         eng = _engine(registry=reg)
