@@ -47,7 +47,8 @@ def build_parser() -> argparse.ArgumentParser:
     a("--log-json", action="store_const", const=True, default=None)
     a("--mode", default=None,
       help="standalone | launch | orchestrator | worker | job | "
-           "job-submit | tpu-worker | train-head | cluster | bus")
+           "job-submit | tpu-worker | train-head | cluster | bus | "
+           "transcribe")
     a("--worker-id", default=None, help="worker identifier (worker modes)")
     a("--concurrency", type=int, default=None)
     a("--timeout", type=int, default=None, help="HTTP timeout seconds")
@@ -135,6 +136,18 @@ def build_parser() -> argparse.ArgumentParser:
     a("--infer", action="store_const", const=True, default=None,
       help="enable the TPU inference stage")
     a("--infer-model", default=None, help="model registry key")
+    # Media transcription (mode=transcribe): BASELINE config #4 — Whisper
+    # over a crawl's media tree.
+    a("--asr-pretrained-dir", default=None,
+      help="local HF Whisper checkpoint dir (weights + optional "
+           "tokenizer.json for text output)")
+    a("--transcribe-input", default=None,
+      help="dir scanned recursively for .wav media (e.g. a crawl's "
+           "media/ tree), or a single file")
+    a("--transcribe-output", default=None,
+      help="transcripts JSONL path (default <input>/transcripts.jsonl)")
+    a("--asr-batch-size", type=int, default=None,
+      help="waveform batch per device dispatch (default 8)")
     a("--infer-batch-size", type=int, default=None)
     a("--infer-param-dtype", default=None,
       help="cast float params at engine startup (e.g. bfloat16) — halves "
@@ -242,6 +255,10 @@ _KEY_MAP = {
     "infer_batch_size": "inference.batch_size",
     "infer_param_dtype": "inference.param_dtype",
     "infer_quantize": "inference.quantize",
+    "asr_pretrained_dir": "inference.asr_pretrained_dir",
+    "transcribe_input": "transcribe.input",
+    "transcribe_output": "transcribe.output",
+    "asr_batch_size": "inference.asr_batch_size",
     "train_posts": "train.posts_file",
     "train_labels": "train.labels_file",
     "train_lora_rank": "train.lora_rank",
@@ -358,7 +375,8 @@ def resolve_config(args: argparse.Namespace,
     # neither do the non-crawling service modes (TPU inference / training /
     # clustering).
     if not cfg.validate_only and r.get_str("distributed.mode", "") not in (
-            "tpu-worker", "train-head", "cluster", "bus", "job-submit"):
+            "tpu-worker", "train-head", "cluster", "bus", "job-submit",
+            "transcribe"):
         validate_sampling_method(SamplingValidationInput(
             platform=cfg.platform, sampling_method=cfg.sampling_method,
             url_list=r.get_list("crawler.urls"),
@@ -483,6 +501,8 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
                     bus.close()
         elif mode == "train-head":
             return _run_train_head(cfg, r)
+        elif mode == "transcribe":
+            return _run_transcribe(cfg, r)
         elif mode == "cluster":
             return _run_cluster(cfg, r)
         else:
@@ -877,6 +897,95 @@ def _run_train_head(cfg: CrawlerConfig, r: ConfigResolver) -> int:
         "checkpoint": step_dir,
     }))
     return 0
+
+
+def _run_transcribe(cfg: CrawlerConfig, r: ConfigResolver) -> int:
+    """mode=transcribe: BASELINE config #4 — Whisper ASR over crawled media.
+
+    Scans ``--transcribe-input`` recursively for 16 kHz PCM ``.wav`` files
+    (a crawl's ``media/`` tree; other containers belong to an upstream
+    ffmpeg step), batch-transcribes them on the device, and writes one
+    JSONL row per file: ``{"path", "tokens", "text"}`` (text only when
+    the checkpoint dir ships tokenizer assets).  With ``--bus-address``
+    and ``--infer``, transcripts also publish to the inference topic as a
+    RecordBatch so they flow through embed+classify — media → text →
+    embedding end to end."""
+    import json as _json
+
+    src = r.get_str("transcribe.input")
+    asr_dir = cfg.inference.asr_pretrained_dir
+    if not src or not asr_dir:
+        print("error: transcribe mode needs --transcribe-input and "
+              "--asr-pretrained-dir", file=sys.stderr)
+        return 2
+    if os.path.isfile(src):
+        paths = [src]
+        base = os.path.dirname(src) or "."
+    else:
+        paths = sorted(
+            os.path.join(root, name)
+            for root, _dirs, files in os.walk(src)
+            for name in files if name.lower().endswith(".wav"))
+        base = src
+    if not paths:
+        print(f"error: no .wav files under {src}", file=sys.stderr)
+        return 2
+
+    from .inference.asr import ASRPipeline
+
+    pipeline = ASRPipeline.from_pretrained(
+        asr_dir, batch_size=r.get_int("inference.asr_batch_size", 8))
+    results = pipeline.transcribe_files(paths)
+
+    out_path = r.get_str("transcribe.output") or os.path.join(
+        base, "transcripts.jsonl")
+    failed = 0
+    with open(out_path, "w", encoding="utf-8") as f:
+        for res in results:
+            if not res.tokens and not res.text:
+                failed += 1
+            f.write(_json.dumps({
+                "path": os.path.relpath(res.path, base),
+                "tokens": res.tokens,
+                "text": res.text,
+            }, ensure_ascii=False) + "\n")
+
+    if cfg.inference.enabled and r.get_str("distributed.bus_address"):
+        # Transcripts onto the inference topic: the TPU worker embeds and
+        # classifies them like any crawled post.  channel_name groups by
+        # the media file's directory (the per-channel layout the crawler
+        # writes media under).
+        from .bus.codec import RecordBatch
+        from .bus.messages import TOPIC_INFERENCE_BATCHES
+        from .datamodel.post import Post
+
+        posts = []
+        for res in results:
+            if not (res.tokens or res.text):
+                continue
+            rel = os.path.relpath(res.path, base)
+            posts.append(Post(
+                post_uid=f"media:{rel}",
+                channel_name=os.path.dirname(rel) or "transcripts",
+                description=res.text or " ".join(str(t)
+                                                 for t in res.tokens)))
+        if posts:
+            bus = _make_bus(r)
+            try:
+                bus.publish(TOPIC_INFERENCE_BATCHES,
+                            RecordBatch.from_posts(
+                                posts, crawl_id=cfg.crawl_id).to_dict())
+            finally:
+                bus.close()
+
+    print(_json.dumps({
+        "transcribed": len(results) - failed,
+        "failed": failed,
+        "output": out_path,
+    }))
+    # Every file failing is a failed RUN (a gating script must not ship
+    # an all-empty transcripts file as success).
+    return 0 if len(results) > failed else 1
 
 
 def _make_engine(cfg: CrawlerConfig, r: ConfigResolver,

@@ -51,6 +51,9 @@ def _wait(pred, timeout_s=15.0):
 def test_stalled_worker_exits_and_replacement_finishes_the_batch():
     server = GrpcBusServer(address="127.0.0.1:0", ack_timeout_s=0.5)
     server.start()
+    # Queue frames even before the first worker's pull stream is up —
+    # otherwise a loaded host can publish into a topic nobody pulls yet.
+    server.enable_pull(TOPIC_INFERENCE_BATCHES)
     addr = f"127.0.0.1:{server.bound_port}"
     wedged = WedgedEngine()
     worker_b = None

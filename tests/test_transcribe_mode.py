@@ -1,0 +1,142 @@
+"""mode=transcribe e2e (BASELINE config #4): a crawl's media tree of
+16 kHz wavs → Whisper batch transcription → transcripts JSONL, plus the
+optional hop onto the inference bus so transcripts flow through
+embed+classify.  Uses the synthetic tiny HF Whisper checkpoint from
+test_hf_convert (real converter path, millisecond-scale decode)."""
+
+import json
+import os
+import wave
+
+import numpy as np
+import pytest
+
+from distributed_crawler_tpu.cli import main
+from tests.test_hf_convert import WH_CFG, make_whisper_state
+
+
+@pytest.fixture()
+def whisper_ckpt(tmp_path):
+    from safetensors.numpy import save_file
+
+    path = str(tmp_path / "whisper")
+    os.makedirs(path)
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(WH_CFG, f)
+    save_file(make_whisper_state(), os.path.join(path, "model.safetensors"))
+    return path
+
+
+def _write_wav(path, seconds=0.3, rate=16_000, freq=440.0):
+    t = np.arange(int(seconds * rate)) / rate
+    pcm = (np.sin(2 * np.pi * freq * t) * 0.3 * 32767).astype(np.int16)
+    with wave.open(str(path), "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(rate)
+        w.writeframes(pcm.tobytes())
+
+
+class TestTranscribeMode:
+    def test_media_tree_to_transcripts_jsonl(self, tmp_path, whisper_ckpt,
+                                             capsys):
+        media = tmp_path / "media"
+        (media / "chan_a").mkdir(parents=True)
+        _write_wav(media / "chan_a" / "voice1.wav")
+        _write_wav(media / "chan_a" / "voice2.wav", freq=880.0)
+        (media / "notes.txt").write_text("not audio")          # ignored
+        (media / "bad.wav").write_bytes(b"RIFFgarbage")        # failed row
+
+        rc = main(["--mode", "transcribe",
+                   "--transcribe-input", str(media),
+                   "--asr-pretrained-dir", whisper_ckpt,
+                   "--asr-batch-size", "2",
+                   "--storage-root", str(tmp_path / "store")])
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert summary["transcribed"] == 2
+        assert summary["failed"] == 1
+        rows = [json.loads(l) for l in
+                open(summary["output"], encoding="utf-8")]
+        by_path = {r["path"]: r for r in rows}
+        assert set(by_path) == {"chan_a/voice1.wav", "chan_a/voice2.wav",
+                                "bad.wav"}
+        # Random weights decode arbitrary ids, but the pipeline must emit
+        # SOME tokens for readable wavs and none for the corrupt one.
+        assert by_path["chan_a/voice1.wav"]["tokens"]
+        assert by_path["bad.wav"]["tokens"] == []
+
+    def test_missing_args_rejected(self, tmp_path, whisper_ckpt):
+        rc = main(["--mode", "transcribe",
+                   "--asr-pretrained-dir", whisper_ckpt,
+                   "--storage-root", str(tmp_path / "s")])
+        assert rc == 2
+        rc = main(["--mode", "transcribe",
+                   "--transcribe-input", str(tmp_path),
+                   "--storage-root", str(tmp_path / "s")])
+        assert rc == 2
+
+    def test_all_failed_run_exits_nonzero(self, tmp_path, whisper_ckpt,
+                                          capsys):
+        media = tmp_path / "media"
+        media.mkdir()
+        (media / "bad.wav").write_bytes(b"RIFFgarbage")
+        rc = main(["--mode", "transcribe",
+                   "--transcribe-input", str(media),
+                   "--asr-pretrained-dir", whisper_ckpt,
+                   "--storage-root", str(tmp_path / "s")])
+        assert rc == 1  # gating scripts must not treat this as success
+        summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert summary["transcribed"] == 0 and summary["failed"] == 1
+
+    def test_empty_tree_rejected(self, tmp_path, whisper_ckpt):
+        (tmp_path / "media").mkdir()
+        rc = main(["--mode", "transcribe",
+                   "--transcribe-input", str(tmp_path / "media"),
+                   "--asr-pretrained-dir", whisper_ckpt,
+                   "--storage-root", str(tmp_path / "s")])
+        assert rc == 2
+
+    def test_transcripts_publish_to_inference_bus(self, tmp_path,
+                                                  whisper_ckpt):
+        from distributed_crawler_tpu.bus.codec import RecordBatch
+        from distributed_crawler_tpu.bus.grpc_bus import (
+            GrpcBusClient,
+            GrpcBusServer,
+        )
+        from distributed_crawler_tpu.bus.messages import (
+            TOPIC_INFERENCE_BATCHES,
+        )
+
+        media = tmp_path / "media"
+        media.mkdir()
+        _write_wav(media / "clip.wav")
+
+        server = GrpcBusServer("127.0.0.1:0")
+        server.start()
+        server.enable_pull(TOPIC_INFERENCE_BATCHES)
+        try:
+            rc = main(["--mode", "transcribe",
+                       "--transcribe-input", str(media),
+                       "--asr-pretrained-dir", whisper_ckpt,
+                       "--infer",
+                       "--bus-address", f"127.0.0.1:{server.bound_port}",
+                       "--crawl-id", "asr1",
+                       "--storage-root", str(tmp_path / "s")])
+            assert rc == 0
+            client = GrpcBusClient(f"127.0.0.1:{server.bound_port}")
+            stream = client.pull(TOPIC_INFERENCE_BATCHES)
+            batch = None
+            for delivery_id, frame in stream:
+                batch = RecordBatch.from_dict(json.loads(frame))
+                client.ack(TOPIC_INFERENCE_BATCHES, delivery_id, ok=True)
+                break
+            stream.close()
+            client.close()
+            assert batch is not None
+            assert batch.crawl_id == "asr1"
+            assert batch.records[0]["post_uid"] == "media:clip.wav"
+            assert batch.records[0]["channel_name"] == "transcripts"
+            assert batch.texts()[0]  # token-id text from random weights
+        finally:
+            server.close()
