@@ -2,10 +2,12 @@
 
 The reference crawls Telegram voice/video media to local files
 (`telegramhelper/tdutils.go:226-358`); this stage transcribes them with the
-Whisper family.  Host side: WAV decode (PCM16, stdlib `wave` — media
-transcoding to 16 kHz mono WAV is an upstream concern), fixed 30 s windows;
-device side: one jitted `transcribe_features` call per batch, padded to a
-static batch size so there is exactly one compiled program.
+Whisper family.  Host side: WAV decode (PCM16, stdlib `wave`; non-16 kHz
+rates are box-filtered + linearly resampled in-process — see
+`read_wav_mono_16k` — while codec handling, OGG/Opus/video, stays an
+upstream ffmpeg concern), fixed 30 s windows; device side: one jitted
+`transcribe_features` call per batch, padded to a static batch size so
+there is exactly one compiled program.
 
 Transcripts come back as token-id arrays; `detokenize` is a pluggable hook
 (a sentencepiece/BPE vocab is deployment data, not framework code — wire the
@@ -25,19 +27,40 @@ logger = logging.getLogger("dct.inference.asr")
 
 
 def read_wav_mono_16k(path: str) -> np.ndarray:
-    """PCM16 WAV -> float32 waveform in [-1, 1].  Raises on sample rates
-    other than 16 kHz (resampling belongs to the media pipeline)."""
+    """PCM16 WAV -> float32 mono waveform in [-1, 1] at 16 kHz.
+
+    Other sample rates are resampled in-process so a stray 48 kHz export
+    doesn't fail a whole transcription run: a box low-pass sized to the
+    decimation ratio first (knocks down energy above the new Nyquist that
+    would otherwise alias INTO the speech band), then linear
+    interpolation.  Good enough for speech ASR; bit-exact resampling and
+    codec handling (OGG/Opus voice notes, video audio) belong to an
+    upstream ffmpeg step."""
     with wave.open(path, "rb") as w:
         rate = w.getframerate()
-        if rate != 16_000:
-            raise ValueError(f"{path}: expected 16 kHz audio, got {rate}")
         n = w.getnframes()
         raw = w.readframes(n)
         audio = np.frombuffer(raw, dtype=np.int16).astype(np.float32)
         channels = w.getnchannels()
     if channels > 1:
         audio = audio.reshape(-1, channels).mean(axis=1)
-    return audio / 32768.0
+    audio = audio / 32768.0
+    if rate != 16_000 and len(audio):
+        if rate <= 0:
+            raise ValueError(f"{path}: invalid sample rate {rate}")
+        if rate > 16_000:
+            k = int(round(rate / 16_000))
+            if k > 1:  # anti-alias before downsampling
+                audio = np.convolve(audio, np.ones(k, np.float32) / k,
+                                    mode="same")
+        n_out = max(1, int(round(len(audio) * 16_000 / rate)))
+        audio = np.interp(
+            np.linspace(0.0, len(audio) - 1.0, n_out),
+            np.arange(len(audio), dtype=np.float64),
+            audio).astype(np.float32)
+        logger.debug("resampled %s: %d Hz -> 16 kHz (%d samples)",
+                     path, rate, n_out)
+    return audio
 
 
 @dataclass

@@ -188,10 +188,43 @@ class TestASRPipeline:
         assert audio.dtype == np.float32
         assert np.max(np.abs(audio)) <= 1.0
 
-    def test_read_wav_rejects_wrong_rate(self, tmp_path):
-        p = self._write_wav(tmp_path / "b.wav", rate=44100)
-        with pytest.raises(ValueError, match="16 kHz"):
-            read_wav_mono_16k(p)
+    def test_read_wav_resamples_other_rates(self, tmp_path):
+        # A 48 kHz export must load at 16 kHz with 1/3 the samples — a
+        # stray high-rate wav must not fail a whole transcription run.
+        rate, seconds = 48_000, 0.5
+        t = np.arange(int(rate * seconds)) / rate
+        pcm = (np.sin(2 * np.pi * 440.0 * t) * 0.5 * 32767).astype(np.int16)
+        p = tmp_path / "b.wav"
+        with wave.open(str(p), "wb") as w:
+            w.setnchannels(1)
+            w.setsampwidth(2)
+            w.setframerate(rate)
+            w.writeframes(pcm.tobytes())
+        audio = read_wav_mono_16k(str(p))
+        assert abs(len(audio) - int(16_000 * seconds)) <= 2
+        # The tone survives resampling: dominant frequency stays ~440 Hz.
+        spec = np.abs(np.fft.rfft(audio))
+        peak_hz = np.argmax(spec) / seconds
+        assert 420 < peak_hz < 460
+
+    def test_downsampling_attenuates_out_of_band_energy(self, tmp_path):
+        """A 15 kHz tone in a 48 kHz file would alias into the speech band
+        under naive interpolation; the box pre-filter must knock it down."""
+        rate, seconds = 48_000, 0.5
+        t = np.arange(int(rate * seconds)) / rate
+        pcm = (np.sin(2 * np.pi * 15_000.0 * t) * 0.5
+               * 32767).astype(np.int16)
+        p = tmp_path / "hiss.wav"
+        with wave.open(str(p), "wb") as w:
+            w.setnchannels(1)
+            w.setsampwidth(2)
+            w.setframerate(rate)
+            w.writeframes(pcm.tobytes())
+        audio = read_wav_mono_16k(str(p))
+        # Original tone RMS ~0.35; surviving (aliased) energy must be
+        # strongly attenuated by the anti-alias pre-filter.
+        rms = float(np.sqrt(np.mean(audio ** 2)))
+        assert rms < 0.1, f"aliased energy too high: rms={rms:.3f}"
 
     def test_stereo_downmix(self, tmp_path):
         p = self._write_wav(tmp_path / "c.wav", channels=2)
