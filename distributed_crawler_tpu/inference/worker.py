@@ -384,10 +384,12 @@ class TPUWorker:
             self._stop.wait(self.cfg.heartbeat_s)
 
     def status(self) -> Dict[str, Any]:
+        """Back-compat alias over get_status() (older key names kept)."""
+        full = self.get_status()
         return {
-            "worker_id": self.cfg.worker_id,
-            "queue_depth": self._queue.qsize(),
-            "processed": self._processed,
-            "errors": self._errors,
-            "uptime_s": time.monotonic() - self._started_at,
+            "worker_id": full["worker_id"],
+            "queue_depth": full["queue_depth"],
+            "processed": full["processed_batches"],
+            "errors": full["error_batches"],
+            "uptime_s": full["uptime_s"],
         }
