@@ -531,12 +531,22 @@ class RemoteBus:
                 self._stop.wait(1.0)
 
     def _safe_ack(self, topic: str, delivery_id: str, ok: bool) -> None:
+        if self._stop.is_set():
+            # Shutting down: the channel may already be closed.  The server
+            # requeues the unacked delivery via stream teardown.
+            return
         try:
             self._client.ack(topic, delivery_id, ok)
         except grpc.RpcError as e:
             # Server unreachable: it will requeue via stream teardown or
             # ack timeout anyway.
             logger.warning("ack for %s/%s failed: %s", topic, delivery_id, e)
+        except ValueError:
+            # grpc raises bare ValueError ("Cannot invoke RPC on closed
+            # channel!") when close() won the race against a dispatching
+            # pull thread; same requeue guarantee applies.
+            logger.warning("ack for %s/%s skipped: channel closed",
+                           topic, delivery_id)
 
     def _dispatch(self, topic: str, delivery_id: str, frame: bytes) -> None:
         try:
