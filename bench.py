@@ -59,6 +59,10 @@ SCALE_TIMEOUT_S = int(os.environ.get("BENCH_SCALE_TIMEOUT_S", "240"))
 # chip is discovered here in ≤PROBE_TIMEOUT_S instead of burning the full
 # child budget, and the headline falls back to a CPU-labelled measurement.
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "75"))
+# A wedged chip sometimes recovers within a minute or two; one retry after
+# a cooldown buys a second shot at a LIVE headline before surrendering the
+# window to the CPU fallback (VERDICT r03: 2 of 3 rounds fell back).
+PROBE_RETRY_COOLDOWN_S = int(os.environ.get("BENCH_PROBE_RETRY_S", "60"))
 CPU_FALLBACK_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT_S", "300"))
 ASR_TIMEOUT_S = int(os.environ.get("BENCH_ASR_TIMEOUT_S", "240"))
 
@@ -80,9 +84,18 @@ def _cache_tpu_result(result: dict) -> None:
     if result.get("platform") != "tpu":
         return
     try:
-        entry = dict(result)
-        entry["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                             time.gmtime())
+        # Merge over the prior entry: a run whose ASR (or int8) leg hit a
+        # wedge keeps the last good values for those rows instead of
+        # erasing them — every cached field is still a real TPU
+        # measurement, just possibly from an earlier healthy window.  The
+        # ASR leg keeps its OWN timestamp so a carried-forward row never
+        # wears a fresher run's measured_at.
+        now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        entry = _load_tpu_cache() or {}
+        entry.update({k: v for k, v in result.items() if v is not None})
+        entry["measured_at"] = now
+        if result.get("asr_rtfx") is not None:
+            entry["asr_measured_at"] = now
         with open(TPU_CACHE_PATH, "w", encoding="utf-8") as f:
             json.dump(entry, f)
     except OSError as exc:
@@ -407,20 +420,29 @@ def _last_json_line(text: str):
     return None
 
 
-def _dp_scaling() -> float | None:
-    """Scaling efficiency posts/sec(8 cpu dev) / (8 × posts/sec(1 cpu dev))."""
+def _dp_sharding_overhead() -> float | None:
+    """Work-normalized dp-sharding efficiency on virtual CPU devices.
+
+    Both runs push the SAME total batch (128) through the SAME host cores —
+    once unsharded on 1 virtual device, once dp-sharded over 8 — so host
+    core contention cancels and the ratio isolates what sharding itself
+    costs (partitioning + collectives).  ~1.0 = free; this intentionally
+    says NOTHING about real multi-chip scaling (that needs ICI), unlike the
+    naive 8-dev/1-dev throughput ratio it replaces, which mostly measured
+    core oversubscription (r03's misleading 0.107).
+    """
     try:
-        per_dev = {}
+        per_mode = {}
         for n in (1, 8):
-            proc = _run_child(["--scale", str(n)], _cpu_env(n),
-                              SCALE_TIMEOUT_S)
+            proc = _run_child(["--scale", str(n), "--scale-batch", "128"],
+                              _cpu_env(n), SCALE_TIMEOUT_S)
             sys.stderr.write(proc.stderr)
             got = _last_json_line(proc.stdout)
             if proc.returncode != 0 or not got:
                 _log(f"scale run n={n} failed rc={proc.returncode}")
                 return None
-            per_dev[n] = got["posts_per_sec"]
-        return per_dev[8] / (8.0 * per_dev[1])
+            per_mode[n] = got["posts_per_sec"]
+        return per_mode[8] / per_mode[1]
     except Exception as exc:  # noqa: BLE001 — scaling row is best-effort
         _log(f"dp scaling skipped: {exc}")
         return None
@@ -481,22 +503,35 @@ def main() -> None:
         # dp-scaling rows run on virtual CPU devices — keep them light so
         # the pair of runs fits SCALE_TIMEOUT_S on a laptop-class host.
         n = int(sys.argv[sys.argv.index("--scale") + 1])
-        print(json.dumps(_measure(scale_devices=n, batch=16 * n,
+        b = (int(sys.argv[sys.argv.index("--scale-batch") + 1])
+             if "--scale-batch" in sys.argv else 16 * n)
+        print(json.dumps(_measure(scale_devices=n, batch=b,
                                   n_short=1, n_long=5, repeats=1)),
               flush=True)
         return
 
     # 1. Pre-flight: is the default backend answering at all?  A wedged TPU
-    #    costs PROBE_TIMEOUT_S here instead of the whole child budget.
+    #    costs PROBE_TIMEOUT_S here instead of the whole child budget; a
+    #    failed probe gets ONE retry after a cooldown (the wedge sometimes
+    #    clears in under a couple of minutes) before the window is
+    #    surrendered to the CPU fallback.
     wedge = None
-    _log(f"pre-flight probe (timeout {PROBE_TIMEOUT_S}s)")
-    probe, perr = _try_child(["--probe"], dict(os.environ), PROBE_TIMEOUT_S)
-    if probe is None:
+    for attempt in range(2):
+        _log(f"pre-flight probe (timeout {PROBE_TIMEOUT_S}s, "
+             f"attempt {attempt + 1}/2)")
+        probe, perr = _try_child(["--probe"], dict(os.environ),
+                                 PROBE_TIMEOUT_S)
+        if probe is not None:
+            wedge = None
+            _log(f"probe ok: {probe['platform']} ({probe['device_kind']}) "
+                 f"in {probe['probe_s']}s")
+            break
         wedge = f"backend probe failed: {perr}"
         _log(wedge)
-    else:
-        _log(f"probe ok: {probe['platform']} ({probe['device_kind']}) "
-             f"in {probe['probe_s']}s")
+        if attempt == 0:
+            _log(f"cooling down {PROBE_RETRY_COOLDOWN_S}s before "
+                 f"probe retry")
+            time.sleep(PROBE_RETRY_COOLDOWN_S)
 
     # 2. Headline measurement: real backend when the probe passed, else a
     #    CPU-labelled fallback so the line still carries a real number.
@@ -549,12 +584,23 @@ def main() -> None:
             _log(f"asr row skipped: {aerr}")
 
     _cache_tpu_result(result)
-    _log("measuring dp scaling on virtual CPU mesh")
-    eff = _dp_scaling()
-    # Explicitly CPU-virtual: 8 "devices" share one host's cores, so this
-    # validates the dp sharding path compiles+runs, NOT real ICI scaling —
-    # the efficiency number is bounded by core oversubscription.
-    result["dp_scaling_8dev_cpu_virtual_efficiency"] = (
+    if "asr_rtfx" not in result:
+        # The ASR leg missed its window (wedge mid-run, or CPU fallback):
+        # surface the last REAL TPU ASR measurement, clearly labelled.
+        cached = _load_tpu_cache() or {}
+        if "asr_rtfx" in cached:
+            for k in ("asr_rtfx", "asr_decode_tokens_per_sec", "asr_batch",
+                      "asr_decode_len"):
+                if k in cached:
+                    result[k] = cached[k]
+            result["asr_from_cache_measured_at"] = cached.get(
+                "asr_measured_at", cached.get("measured_at"))
+    _log("measuring dp sharding overhead on virtual CPU mesh")
+    eff = _dp_sharding_overhead()
+    # Work-normalized (same batch, same host cores, 1 vs 8 virtual CPU
+    # devices): isolates dp-sharding overhead; deliberately NOT a claim
+    # about multi-chip scaling, which needs real ICI.
+    result["dp_sharding_efficiency_same_host_work_normalized"] = (
         round(eff, 3) if eff is not None else None)
     print(json.dumps(result))
 

@@ -1,0 +1,114 @@
+"""One-off TPU experiment: bf16 vs int8 serving throughput across widths.
+
+VERDICT r03 #1: int8 loses at E5-small width (0.79x); `ops/quant.py` claims
+it pays off at XLM-R-base/E5-large width — this measures that claim on the
+real chip.  Prints one JSON line per (config, quant) cell.
+
+Run under an external timeout (the chip wedges):
+    timeout 900 python tools/exp_int8.py || echo "rc=$?"
+Exit 3 = backend is not TPU (don't waste a CPU measurement).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from distributed_crawler_tpu.models.encoder import (  # noqa: E402
+    E5_LARGE,
+    E5_SMALL,
+    XLMR_BASE,
+    EmbedderClassifier,
+)
+from distributed_crawler_tpu.models.quant import (  # noqa: E402
+    quantize_encoder_params,
+)
+
+SEQ = 128
+# Small vocab: embedding-table size doesn't affect the per-token gather or
+# any projection GEMM, and it cuts init time ~20x for the sweep.
+VOCAB = 32768
+
+
+def log(msg):
+    print(f"[exp] {msg}", file=sys.stderr, flush=True)
+
+
+def probe():
+    x = jnp.ones((128, 128), jnp.bfloat16)
+    float(jax.jit(lambda a: (a @ a).sum())(x))
+
+
+def t_iter_chained(model, params, ids, mask, vocab, n_short=3, n_long=12,
+                   repeats=3):
+    @jax.jit
+    def chained(p, ids, mask, n):
+        def body(_, ids):
+            emb, _ = model.apply(p, ids, mask)
+            delta = (emb[:, :1] * 1000).astype(jnp.int32) % vocab
+            return (ids + delta) % vocab
+        return jax.lax.fori_loop(0, n, body, ids)
+
+    t0 = time.perf_counter()
+    float(chained(params, ids, mask, 1).sum())
+    log(f"  compile+warmup {time.perf_counter() - t0:.1f}s")
+
+    def timed(n):
+        t0 = time.perf_counter()
+        float(chained(params, ids, mask, n).sum())
+        return time.perf_counter() - t0
+
+    for _ in range(3):
+        ts = min(timed(n_short) for _ in range(repeats))
+        tl = min(timed(n_long) for _ in range(repeats))
+        ti = (tl - ts) / (n_long - n_short)
+        if ti > 0:
+            return ti
+    raise RuntimeError("two-point fit stayed non-positive")
+
+
+def main():
+    t0 = time.perf_counter()
+    probe()
+    log(f"probe ok in {time.perf_counter() - t0:.1f}s "
+        f"backend={jax.default_backend()}")
+    if jax.default_backend() != "tpu":
+        sys.exit(3)
+
+    cells = [
+        ("e5_small", E5_SMALL, 256),
+        ("xlmr_base", XLMR_BASE, 256),
+        ("e5_large", E5_LARGE, 128),
+    ]
+    rng = np.random.default_rng(0)
+    for name, base_cfg, batch in cells:
+        cfg = replace(base_cfg, vocab_size=VOCAB, n_labels=8)
+        ids = jnp.asarray(rng.integers(0, VOCAB, size=(batch, SEQ)), jnp.int32)
+        mask = jnp.ones((batch, SEQ), jnp.bool_)
+        model = EmbedderClassifier(cfg)
+        params = model.init(jax.random.PRNGKey(0), ids, mask)
+        log(f"{name}: params ready")
+        ti = t_iter_chained(model, params, ids, mask, VOCAB)
+        pps = batch / ti
+        print(json.dumps({"cfg": name, "quant": "bf16", "batch": batch,
+                          "t_iter_ms": round(ti * 1e3, 2),
+                          "posts_per_sec": round(pps, 1)}), flush=True)
+        qmodel = EmbedderClassifier(replace(cfg, quant="int8"))
+        qparams = quantize_encoder_params(params)
+        tq = t_iter_chained(qmodel, qparams, ids, mask, VOCAB)
+        print(json.dumps({"cfg": name, "quant": "int8", "batch": batch,
+                          "t_iter_ms": round(tq * 1e3, 2),
+                          "posts_per_sec": round(batch / tq, 1),
+                          "speedup_vs_bf16": round(ti / tq, 3)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
