@@ -48,7 +48,7 @@ def build_parser() -> argparse.ArgumentParser:
     a("--mode", default=None,
       help="standalone | launch | orchestrator | worker | job | "
            "job-submit | tpu-worker | train-head | cluster | bus | "
-           "transcribe")
+           "transcribe | dc-gateway | gen-code")
     a("--worker-id", default=None, help="worker identifier (worker modes)")
     a("--concurrency", type=int, default=None)
     a("--timeout", type=int, default=None, help="HTTP timeout seconds")
@@ -63,6 +63,16 @@ def build_parser() -> argparse.ArgumentParser:
     a("--tdlib-database-url", default=None)
     a("--tdlib-database-urls", default=None, help="comma-separated")
     a("--tdlib-verbosity", type=int, default=None)
+    # Client side of the DC gateway seam (pool dials instead of embedding
+    # an offline store; credentials from --tdlib-dir / TG_* env).
+    a("--dc-address", default=None,
+      help="host:port of a dc-gateway; pool connections dial it over the "
+           "wire protocol (empty = offline embedded store)")
+    a("--dc-tls", action="store_const", const=True, default=None,
+      help="dial the gateway over TLS (Chrome-shaped ClientHello)")
+    a("--dc-tls-insecure", action="store_const", const=True, default=None,
+      help="skip cert verification (self-signed gateway bootstrap)")
+    a("--dc-sni", default=None, help="TLS SNI override")
     a("--min-users", type=int, default=None)
     a("--crawl-id", default=None)
     a("--crawl-label", default=None)
@@ -183,7 +193,34 @@ def build_parser() -> argparse.ArgumentParser:
     a("--cluster-output", default=None, help="output JSON path")
     a("--generate-code", action="store_true",
       help="run the Telegram auth bootstrap (TG_* env vars) and write "
-           ".tdlib/credentials.json, then exit")
+           "credentials.json under --tdlib-dir, then exit (alias: "
+           "--mode gen-code)")
+    a("--tdlib-dir", default=None,
+      help="client-side auth/credentials dir (default .tdlib) — gen-code "
+           "writes credentials.json here, pools read it back")
+    # DC gateway (mode=dc-gateway): the deployable server side of the
+    # native wire protocol (`clients/dc_gateway.py`; the reference's
+    # Telegram-DC seam, `telegramhelper/client.go:319-377`).
+    a("--gateway-listen", default=None,
+      help="host:port the gateway binds (default 127.0.0.1:8443; "
+           "port 0 = kernel-assigned, see --gateway-address-file)")
+    a("--gateway-tls", action="store_const", const=True, default=None,
+      help="serve TLS; without --gateway-tls-cert a self-signed pair is "
+           "minted under <storage-root>/tls")
+    a("--gateway-tls-cert", default=None, help="PEM cert chain path")
+    a("--gateway-tls-key", default=None, help="PEM private key path")
+    a("--gateway-accounts", default=None,
+      help="accounts JSON ({'accounts': [{phone_number, code, password}]});"
+           " empty = single-tenant via --gateway-expected-code")
+    a("--gateway-expected-code", default=None,
+      help="auth code accepted for any phone when no accounts file is set")
+    a("--gateway-expected-password", default=None,
+      help="2FA password leg for the single-tenant configuration")
+    a("--gateway-seed-json", default=None,
+      help="inline store JSON or @path/to/store.json (tiny deployments; "
+           "--tdlib-database-url supplies a tarball/dir store instead)")
+    a("--gateway-address-file", default=None,
+      help="write host:port here once bound (discovery for port 0)")
     a("--version", action="store_true")
     return p
 
@@ -269,6 +306,20 @@ _KEY_MAP = {
     "cluster_k": "cluster.k",
     "cluster_iters": "cluster.iters",
     "cluster_output": "cluster.output_file",
+    "tdlib_dir": "tdlib.dir",
+    "dc_address": "tdlib.dc_address",
+    "dc_tls": "tdlib.dc_tls",
+    "dc_tls_insecure": "tdlib.dc_tls_insecure",
+    "dc_sni": "tdlib.dc_sni",
+    "gateway_listen": "gateway.listen",
+    "gateway_tls": "gateway.tls",
+    "gateway_tls_cert": "gateway.tls_cert",
+    "gateway_tls_key": "gateway.tls_key",
+    "gateway_accounts": "gateway.accounts",
+    "gateway_expected_code": "gateway.expected_code",
+    "gateway_expected_password": "gateway.expected_password",
+    "gateway_seed_json": "gateway.seed_json",
+    "gateway_address_file": "gateway.address_file",
 }
 
 
@@ -289,6 +340,11 @@ def resolve_config(args: argparse.Namespace,
     cfg.tdlib_database_url = r.get_str("tdlib.database_url")
     cfg.tdlib_database_urls = r.get_list("tdlib.database_urls")
     cfg.tdlib_verbosity = r.get_int("tdlib.verbosity", 1)
+    cfg.tdlib_dir = r.get_str("tdlib.dir", ".tdlib")
+    cfg.dc_address = r.get_str("tdlib.dc_address")
+    cfg.dc_tls = r.get_bool("tdlib.dc_tls", False)
+    cfg.dc_tls_insecure = r.get_bool("tdlib.dc_tls_insecure", False)
+    cfg.dc_sni = r.get_str("tdlib.dc_sni")
     cfg.min_users = r.get_int("crawler.minusers", 100)
     cfg.crawl_id = r.get_str("crawler.crawlid") or generate_crawl_id()
     cfg.crawl_label = r.get_str("crawler.crawllabel")
@@ -376,7 +432,7 @@ def resolve_config(args: argparse.Namespace,
     # clustering).
     if not cfg.validate_only and r.get_str("distributed.mode", "") not in (
             "tpu-worker", "train-head", "cluster", "bus", "job-submit",
-            "transcribe"):
+            "transcribe", "dc-gateway", "gen-code"):
         validate_sampling_method(SamplingValidationInput(
             platform=cfg.platform, sampling_method=cfg.sampling_method,
             url_list=r.get_list("crawler.urls"),
@@ -401,15 +457,10 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
         print("distributed_crawler_tpu v0.1.0")
         return 0
     if args.generate_code:
-        # Auth bootstrap (`standalone/runner.go:68,77-192`).
-        from .clients.native import generate_pcode
-        try:
-            path = generate_pcode()
-        except Exception as e:
-            print(f"error: {e}", file=sys.stderr)
-            return 2
-        print(f"credentials saved to {path}")
-        return 0
+        # Auth bootstrap (`standalone/runner.go:68,77-192`); full version
+        # with gateway dialing lives behind --mode gen-code.
+        return _gen_code(tdlib_dir=args.tdlib_dir or ".tdlib",
+                         env=env)
     try:
         cfg, r = resolve_config(args, env=env)
     except (ValueError, FileNotFoundError) as e:
@@ -505,6 +556,10 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
             return _run_transcribe(cfg, r)
         elif mode == "cluster":
             return _run_cluster(cfg, r)
+        elif mode == "dc-gateway":
+            _run_dc_gateway(cfg, r)
+        elif mode == "gen-code":
+            return _run_gen_code(r)
         else:
             print(f"error: unknown execution mode: {mode}", file=sys.stderr)
             return 2
@@ -570,6 +625,83 @@ def _serve_forever(poll_s: float = 1.0,
 
     while running is None or running():
         _time.sleep(poll_s)
+
+
+def _gen_code(tdlib_dir: str = ".tdlib", env=None, server_addr: str = "",
+              tls: bool = False, tls_insecure: bool = False,
+              sni: str = "") -> int:
+    """Auth bootstrap (`standalone/runner.go:77-192`): drive the ladder
+    from TG_* env — against a remote dc-gateway when --dc-address is set,
+    else the embedded auth-enabled engine — and write credentials.json
+    under ``tdlib_dir`` for pools to consume."""
+    from .clients.native import NativeTelegramClient, generate_pcode
+
+    client = None
+    try:
+        if server_addr:
+            client = NativeTelegramClient(
+                server_addr=server_addr, tls=tls,
+                tls_insecure=tls_insecure, sni=sni, conn_id="gen-code")
+        path = generate_pcode(tdlib_dir=tdlib_dir, env=env, client=client)
+    except Exception as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    finally:
+        if client is not None:
+            client.close()
+    print(f"credentials saved to {path}")
+    return 0
+
+
+def _run_gen_code(r: ConfigResolver) -> int:
+    return _gen_code(
+        tdlib_dir=r.get_str("tdlib.dir", ".tdlib"),
+        env=dict(r._env),
+        server_addr=r.get_str("tdlib.dc_address"),
+        tls=r.get_bool("tdlib.dc_tls", False),
+        tls_insecure=r.get_bool("tdlib.dc_tls_insecure", False),
+        sni=r.get_str("tdlib.dc_sni"))
+
+
+def _run_dc_gateway(cfg: CrawlerConfig, r: ConfigResolver) -> None:
+    """mode=dc-gateway: host the deployable wire-protocol server
+    (`clients/dc_gateway.py`) — the production counterpart of the C++
+    client's remote mode (the reference's Telegram-DC seam)."""
+    from .clients.dc_gateway import DcGateway, load_accounts
+    from .utils.metrics import clear_status_provider, set_status_provider
+
+    listen = r.get_str("gateway.listen", "127.0.0.1:8443")
+    host, _, port_s = listen.rpartition(":")
+    if not host or not port_s.isdigit():
+        raise CliConfigError(
+            f"--gateway-listen must be host:port, got {listen!r}")
+    accounts = None
+    accounts_path = r.get_str("gateway.accounts")
+    if accounts_path:
+        accounts = load_accounts(accounts_path)
+    seed_json = r.get_str("gateway.seed_json")
+    if seed_json.startswith("@"):
+        with open(seed_json[1:], "r", encoding="utf-8") as f:
+            seed_json = f.read()
+    gw = DcGateway(
+        host=host, port=int(port_s),
+        tls=r.get_bool("gateway.tls", False),
+        tls_cert=r.get_str("gateway.tls_cert"),
+        tls_key=r.get_str("gateway.tls_key"),
+        accounts=accounts,
+        expected_code=r.get_str("gateway.expected_code", "13579"),
+        expected_password=r.get_str("gateway.expected_password"),
+        seed_json=seed_json,
+        seed_source=cfg.tdlib_database_url,
+        store_root=os.path.join(cfg.storage_root or ".", "dc-gateway"),
+        address_file=r.get_str("gateway.address_file"),
+    ).start()
+    set_status_provider(gw.status)
+    try:
+        _serve_forever()
+    finally:
+        clear_status_provider(gw.status)
+        gw.close()
 
 
 def _make_bus(r: ConfigResolver, serve: bool = False):

@@ -24,10 +24,12 @@ from .http_validator import (
     parse_channel_html,
     validate_channel_http,
 )
+from .dc_gateway import DcGateway, load_accounts
 from .native import (
     NativeTelegramClient,
     find_library as find_native_library,
     generate_pcode,
+    load_credentials,
     native_client_factory,
 )
 from .pool import ConnectionPool, PooledConnection
@@ -62,7 +64,8 @@ from .youtube import (
 
 __all__ = [
     "NativeTelegramClient", "native_client_factory", "find_native_library",
-    "generate_pcode",
+    "generate_pcode", "load_credentials",
+    "DcGateway", "load_accounts",
     "TelegramClient", "TelegramError", "FloodWaitError",
     "parse_flood_wait_seconds",
     "TLMessage", "TLMessages", "TLChat", "TLSupergroup",

@@ -1,272 +1,30 @@
 """In-tree mock DC server: the test peer for the native transport seam.
 
-The reference authenticated TDLib against real Telegram data centers with a
-30 s init timeout (`telegramhelper/client.go:319-377`) and bootstrapped auth
-codes via GenCode (`standalone/runner.go:77-192`).  This server lets the
-C++ client exercise the SAME lifecycle — TCP (or TLS) connect, handshake,
-TDLib-style auth ladder, then the 16-method surface — over a real socket
-without egress:
+Since round 4 the protocol/server core lives in `clients/dc_gateway.py`
+(the DEPLOYABLE `dct --mode dc-gateway` process); this module keeps the
+test-facing name and defaults.  `MockDcServer` IS a `DcGateway` — tests
+exercising the mock exercise the production wire path (TLS, auth ladder,
+engine proxying) byte for byte.
 
-- speaks the DCT wire protocol v1 (4-byte big-endian length ‖ JSON frame,
-  `native/net.h`),
-- drives the auth ladder per connection: handshake → WaitTdlibParameters →
-  WaitPhoneNumber → WaitCode [→ WaitPassword] → Ready, validating the
-  configured code/password,
-- once Ready, proxies every request to an embedded OFFLINE native engine
-  (`dct_client_execute` on a seed-loaded client), so all 16 methods work
-  over the wire with zero duplicated routing logic,
-- optional TLS: a self-signed cert is minted at start via the `openssl`
-  binary, exercising the client's Chrome-shaped TLS leg end to end.
+Reference parity context: the reference authenticated TDLib against real
+Telegram data centers with a 30 s init timeout
+(`telegramhelper/client.go:319-377`) and bootstrapped auth codes via
+GenCode (`standalone/runner.go:77-192`); the gateway is this build's
+server side of that seam.
 """
 
 from __future__ import annotations
 
-import json
-import logging
-import os
-import socket
-import ssl
-import struct
-import subprocess
-import tempfile
-import threading
-from typing import Any, Dict, Optional
-
-from .native import NativeTelegramClient, load_library
-
-logger = logging.getLogger("dct.mockdc")
-
-_HEADER = struct.Struct(">I")
-MAX_FRAME = 64 * 1024 * 1024
+from .dc_gateway import (  # noqa: F401  (re-exported test helpers)
+    MAX_FRAME,
+    DcGateway,
+    make_self_signed_cert,
+    recv_frame,
+    send_frame,
+)
 
 
-def send_frame(sock, payload: bytes) -> None:
-    sock.sendall(_HEADER.pack(len(payload)) + payload)
-
-
-def recv_frame(sock) -> Optional[bytes]:
-    header = _recv_exact(sock, 4)
-    if header is None:
-        return None
-    (n,) = _HEADER.unpack(header)
-    if n > MAX_FRAME:
-        raise ValueError("oversized frame")
-    return _recv_exact(sock, n)
-
-
-def _recv_exact(sock, n: int) -> Optional[bytes]:
-    buf = b""
-    while len(buf) < n:
-        try:
-            chunk = sock.recv(n - len(buf))
-        except (ConnectionResetError, OSError):
-            return None
-        if not chunk:
-            return None
-        buf += chunk
-    return buf
-
-
-def make_self_signed_cert(directory: str, cn: str = "localhost") -> tuple:
-    """Mint a throwaway self-signed cert with the system openssl binary
-    (no key material is committed to the repo)."""
-    cert = os.path.join(directory, "dc.crt")
-    key = os.path.join(directory, "dc.key")
-    subprocess.run(
-        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
-         "-keyout", key, "-out", cert, "-days", "2", "-subj",
-         f"/CN={cn}", "-addext", f"subjectAltName=DNS:{cn},IP:127.0.0.1"],
-        check=True, capture_output=True)
-    return cert, key
-
-
-class MockDcServer:
-    """Socket server speaking the wire protocol; one thread per connection.
-
-    ``expected_code`` / ``expected_password`` configure the auth ladder
-    (password = the 2FA leg).  ``tls=True`` wraps every connection in TLS
-    with a freshly minted self-signed cert (clients connect with
-    ``tls_insecure``)."""
-
-    def __init__(self, seed_json: str = "", expected_code: str = "13579",
-                 expected_password: str = "", tls: bool = False,
-                 host: str = "127.0.0.1", port: int = 0,
-                 lib_path: Optional[str] = None):
-        self.seed_json = seed_json or '{"channels": []}'
-        self.expected_code = expected_code
-        self.expected_password = expected_password
-        self._lib_path = lib_path
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host, port))
-        self._sock.listen(8)
-        self.port = self._sock.getsockname()[1]
-        self.host = host
-        self._ssl_ctx = None
-        self._tmpdir = None
-        if tls:
-            self._tmpdir = tempfile.TemporaryDirectory(prefix="dct-dc-")
-            cert, key = make_self_signed_cert(self._tmpdir.name)
-            self._ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-            self._ssl_ctx.load_cert_chain(cert, key)
-        self._stop = threading.Event()
-        self._threads: list = []
-        self._live_conns: list = []
-        self._stats_mu = threading.Lock()
-        self.connections = 0
-        self.auth_successes = 0
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, daemon=True, name="dct-mockdc-accept")
-
-    @property
-    def address(self) -> str:
-        return f"{self.host}:{self.port}"
-
-    def start(self) -> "MockDcServer":
-        self._accept_thread.start()
-        return self
-
-    def close(self) -> None:
-        self._stop.set()
-        try:
-            self._sock.close()
-        except OSError:
-            pass
-        for conn in self._live_conns:  # kill live sessions, not just accept
-            try:
-                conn.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-        for t in self._threads:
-            t.join(timeout=2.0)
-        if self._tmpdir is not None:
-            self._tmpdir.cleanup()
-
-    # -- internals ---------------------------------------------------------
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                conn, addr = self._sock.accept()
-            except OSError:
-                return  # socket closed
-            self.connections += 1
-            self._live_conns.append(conn)
-            t = threading.Thread(target=self._serve_conn,
-                                 args=(conn, addr), daemon=True,
-                                 name=f"dct-mockdc-{addr[1]}")
-            t.start()
-            self._threads.append(t)
-
-    def _serve_conn(self, conn: socket.socket, addr) -> None:
-        engine = None
-        try:
-            if self._ssl_ctx is not None:
-                conn = self._ssl_ctx.wrap_socket(conn, server_side=True)
-            # 1. Handshake frame first, always.
-            first = recv_frame(conn)
-            if first is None:
-                return
-            hello = json.loads(first.decode("utf-8"))
-            if hello.get("@type") != "handshake":
-                send_frame(conn, self._err(400, "handshake expected"))
-                return
-            send_frame(conn, json.dumps({
-                "@type": "handshake_ack",
-                "session_id": f"sess-{addr[1]}",
-                "transport_version": 1}).encode("utf-8"))
-
-            # 2. Auth ladder, server-driven via updates.
-            state = "waitTdlibParameters"
-            self._push_auth(conn, "authorizationStateWaitTdlibParameters")
-            while not self._stop.is_set():
-                raw = recv_frame(conn)
-                if raw is None:
-                    return
-                req = json.loads(raw.decode("utf-8"))
-                rtype = req.get("@type", "")
-                if state != "ready":
-                    state = self._auth_step(conn, state, rtype, req)
-                    if state == "ready":
-                        # 3. Ready: spin the offline engine for this
-                        # session (per-connection store isolation, like
-                        # per-connection TDLib databases).
-                        engine = NativeTelegramClient(
-                            seed_json=self.seed_json,
-                            lib_path=self._lib_path,
-                            conn_id=f"dc-{addr[1]}")
-                        with self._stats_mu:
-                            self.auth_successes += 1
-                    continue
-                if rtype == "close":
-                    self._reply(conn, req, {"@type": "ok"})
-                    return
-                resp = json.loads(engine.execute_raw(json.dumps(req)))
-                send_frame(conn,
-                           json.dumps(resp).encode("utf-8"))
-        except (ValueError, ssl.SSLError, OSError) as e:
-            logger.info("mock dc connection %s dropped: %s", addr, e)
-        finally:
-            if engine is not None:
-                engine.close()
-            try:
-                conn.close()
-            except OSError:
-                pass
-
-    def _auth_step(self, conn, state: str, rtype: str,
-                   req: Dict[str, Any]) -> str:
-        if rtype == "setTdlibParameters" and state == "waitTdlibParameters":
-            self._reply(conn, req, {"@type": "ok"})
-            self._push_auth(conn, "authorizationStateWaitPhoneNumber")
-            return "waitPhoneNumber"
-        if rtype == "setAuthenticationPhoneNumber" and \
-                state == "waitPhoneNumber":
-            if not req.get("phone_number"):
-                self._reply(conn, req,
-                            self._err_obj(400, "PHONE_NUMBER_INVALID"))
-                return state
-            self._reply(conn, req, {"@type": "ok"})
-            self._push_auth(conn, "authorizationStateWaitCode")
-            return "waitCode"
-        if rtype == "checkAuthenticationCode" and state == "waitCode":
-            if req.get("code") != self.expected_code:
-                self._reply(conn, req,
-                            self._err_obj(400, "PHONE_CODE_INVALID"))
-                return state
-            self._reply(conn, req, {"@type": "ok"})
-            if self.expected_password:
-                self._push_auth(conn, "authorizationStateWaitPassword")
-                return "waitPassword"
-            self._push_auth(conn, "authorizationStateReady")
-            return "ready"
-        if rtype == "checkAuthenticationPassword" and \
-                state == "waitPassword":
-            if req.get("password") != self.expected_password:
-                self._reply(conn, req,
-                            self._err_obj(400, "PASSWORD_HASH_INVALID"))
-                return state
-            self._reply(conn, req, {"@type": "ok"})
-            self._push_auth(conn, "authorizationStateReady")
-            return "ready"
-        self._reply(conn, req, self._err_obj(
-            401, f"UNAUTHORIZED: {rtype} not valid in state {state}"))
-        return state
-
-    def _push_auth(self, conn, state: str) -> None:
-        send_frame(conn, json.dumps({
-            "@type": "updateAuthorizationState",
-            "authorization_state": {"@type": state}}).encode("utf-8"))
-
-    @staticmethod
-    def _err_obj(code: int, message: str) -> Dict[str, Any]:
-        return {"@type": "error", "code": code, "message": message}
-
-    def _err(self, code: int, message: str) -> bytes:
-        return json.dumps(self._err_obj(code, message)).encode("utf-8")
-
-    @staticmethod
-    def _reply(conn, req: Dict[str, Any], body: Dict[str, Any]) -> None:
-        if "@extra" in req:
-            body = dict(body)
-            body["@extra"] = req["@extra"]
-        send_frame(conn, json.dumps(body).encode("utf-8"))
+class MockDcServer(DcGateway):
+    """Test-configured gateway: one global expected code/password, inline
+    seed JSON, ephemeral self-signed TLS.  Kept as a distinct name so test
+    intent stays readable; all behavior is `DcGateway`'s."""

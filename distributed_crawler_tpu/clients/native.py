@@ -470,6 +470,7 @@ def generate_pcode(tdlib_dir: str = ".tdlib",
     api_hash = env.get("TG_API_HASH", "")
     phone = env.get("TG_PHONE_NUMBER", "")
     code = env.get("TG_PHONE_CODE", "")
+    password = env.get("TG_PASSWORD", "")  # the 2FA leg
     if not api_id or not phone:
         raise ValueError("TG_API_ID and TG_PHONE_NUMBER are required")
     int(api_id)  # parity with the reference's strconv check
@@ -481,6 +482,7 @@ def generate_pcode(tdlib_dir: str = ".tdlib",
     try:
         client.authenticate(
             phone, code, api_id=api_id, api_hash=api_hash,
+            password=password,
             database_directory=os.path.join(tdlib_dir, "database"))
         me = client.get_me()
         logger.info("authenticated", extra={
@@ -490,12 +492,42 @@ def generate_pcode(tdlib_dir: str = ".tdlib",
             client.close()
 
     creds_path = os.path.join(tdlib_dir, "credentials.json")
+    creds = {"api_id": api_id, "api_hash": api_hash,
+             "phone_number": phone, "phone_code": code}
+    if password:
+        creds["password"] = password  # pools replay the 2FA leg too
     with open(creds_path, "w", encoding="utf-8") as f:
-        json.dump({"api_id": api_id, "api_hash": api_hash,
-                   "phone_number": phone, "phone_code": code},
-                  f, indent=2)
+        json.dump(creds, f, indent=2)
     os.chmod(creds_path, 0o600)
     return creds_path
+
+
+def load_credentials(tdlib_dir: str = ".tdlib",
+                     env: Optional[Dict[str, str]] = None
+                     ) -> Optional[Dict[str, str]]:
+    """Credentials for the auth ladder: ``{tdlib_dir}/credentials.json``
+    (written by `generate_pcode` / `dct --mode gen-code`) first, TG_* env
+    fallback — the same two sources, same order, the reference's client
+    used (`telegramhelper/client.go:121-142,278-298`).  Returns None when
+    neither is present (offline stores need no auth)."""
+    path = os.path.join(tdlib_dir, "credentials.json")
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            creds = json.load(f)
+        if creds.get("phone_number"):
+            return {k: str(creds.get(k, "")) for k in
+                    ("api_id", "api_hash", "phone_number", "phone_code",
+                     "password")}
+    except (OSError, ValueError):
+        pass
+    env = env if env is not None else dict(os.environ)
+    if env.get("TG_PHONE_NUMBER"):
+        return {"api_id": env.get("TG_API_ID", ""),
+                "api_hash": env.get("TG_API_HASH", ""),
+                "phone_number": env.get("TG_PHONE_NUMBER", ""),
+                "phone_code": env.get("TG_PHONE_CODE", ""),
+                "password": env.get("TG_PASSWORD", "")}
+    return None
 
 
 def fnv32(s: str) -> int:
@@ -640,13 +672,45 @@ def acquire_seed_db(source: str, base_dir: str, conn_id: str) -> str:
 def native_client_factory(seed_db: str = "", seed_json: str = "",
                           lib_path: Optional[str] = None,
                           db_source: str = "",
-                          db_base_dir: str = ".tdlib/databases"):
+                          db_base_dir: str = ".tdlib/databases",
+                          server_addr: str = "", tls: bool = False,
+                          tls_insecure: bool = False, sni: str = "",
+                          credentials: Optional[Dict[str, str]] = None,
+                          tdlib_dir: str = ".tdlib"):
     """Pool-compatible factory: returns a callable producing fresh
     authenticated clients (`telegramhelper/connection_pool.go:97-149`
     preloaded each conn from a DB URL).  With ``db_source`` set, each
     connection acquires its own extracted copy of the seed tarball under
-    ``{db_base_dir}/conn_<fnv32>`` (`telegramhelper/client.go:232-260`)."""
+    ``{db_base_dir}/conn_<fnv32>`` (`telegramhelper/client.go:232-260`).
+
+    With ``server_addr`` set the pool runs in REMOTE mode: each client
+    dials the DC gateway (`clients/dc_gateway.py`) over TCP/TLS and walks
+    the auth ladder with ``credentials`` (a `load_credentials` dict) before
+    it is handed out — the pool-side half of the reference's
+    login-once-per-connection flow (`telegramhelper/client.go:319-377`)."""
     def make(conn_id: str) -> NativeTelegramClient:
+        if server_addr:
+            client = NativeTelegramClient(
+                server_addr=server_addr, tls=tls,
+                tls_insecure=tls_insecure, sni=sni,
+                lib_path=lib_path, conn_id=conn_id)
+            creds = credentials or load_credentials(tdlib_dir)
+            if creds is None:
+                client.close()
+                raise NativeClientError(
+                    401, "remote mode needs credentials: run `dct --mode "
+                         "gen-code` or set TG_PHONE_NUMBER/TG_PHONE_CODE")
+            try:
+                client.authenticate(
+                    creds["phone_number"], creds.get("phone_code", ""),
+                    api_id=creds.get("api_id", ""),
+                    api_hash=creds.get("api_hash", ""),
+                    password=creds.get("password", ""))
+                client.wait_ready()
+            except Exception:
+                client.close()
+                raise
+            return client
         per_conn_db = seed_db
         if db_source:
             per_conn_db = acquire_seed_db(db_source, db_base_dir, conn_id)

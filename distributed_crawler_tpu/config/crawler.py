@@ -87,6 +87,16 @@ class CrawlerConfig:
     tdlib_database_url: str = ""
     tdlib_database_urls: List[str] = field(default_factory=list)
     tdlib_verbosity: int = 1
+    # Client-side auth dir: gen-code writes credentials.json here, remote
+    # pools read it back (`telegramhelper/client.go:121-142` parity).
+    tdlib_dir: str = ".tdlib"
+    # Remote DC gateway (`clients/dc_gateway.py`): when set, pool
+    # connections dial this address over the wire protocol instead of
+    # embedding an offline store (the reference's real-Telegram seam).
+    dc_address: str = ""
+    dc_tls: bool = False
+    dc_tls_insecure: bool = False  # self-signed gateway bootstrap
+    dc_sni: str = ""
 
     # Date windows / sampling
     min_post_date: Optional[datetime] = None

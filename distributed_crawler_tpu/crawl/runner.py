@@ -94,12 +94,33 @@ def setup_pool_from_config(cfg: CrawlerConfig) -> bool:
                 # Process-wide pool, first installer wins (the reference's
                 # global pool has the same contract, `runner.go:287-306`).
                 return True
+        from ..clients.native import load_credentials, native_client_factory
+
+        if getattr(cfg, "dc_address", ""):
+            # Remote mode: N wire connections to the DC gateway, each
+            # authenticated from credentials.json / TG_* env — the
+            # reference's login-per-connection against real DCs
+            # (`telegramhelper/client.go:319-377`).
+            n_conns = max(1, cfg.concurrency)
+            tdlib_dir = getattr(cfg, "tdlib_dir", ".tdlib")
+            factory = native_client_factory(
+                server_addr=cfg.dc_address, tls=cfg.dc_tls,
+                tls_insecure=cfg.dc_tls_insecure, sni=cfg.dc_sni,
+                credentials=load_credentials(tdlib_dir),
+                tdlib_dir=tdlib_dir)
+            pool = ConnectionPool(
+                factory, database_urls=[cfg.dc_address] * n_conns,
+                rate_limit=cfg.rate_limit)
+            if pool.initialize() == 0:
+                raise PoolEmptyError(
+                    f"no wire connections to gateway {cfg.dc_address}")
+            init_connection_pool(pool)
+            return True
+
         urls = list(cfg.tdlib_database_urls) or (
             [cfg.tdlib_database_url] if cfg.tdlib_database_url else [])
         if not urls:
             return False
-        from ..clients.native import native_client_factory
-
         base_dir = os.path.join(cfg.storage_root or ".",
                                 ".tdlib", "databases")
         factories = [native_client_factory(db_source=u, db_base_dir=base_dir)

@@ -1,0 +1,419 @@
+"""The deployable DCT gateway (`dct --mode dc-gateway`) — VERDICT r03 #3:
+the production counterpart of the C++ client's remote mode, plus the
+gen-code → credentials.json → pool-consumes bootstrap (VERDICT r03 #8;
+reference parity: `standalone/runner.go:77-192`,
+`telegramhelper/client.go:121-142,319-377`).
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from distributed_crawler_tpu.clients.dc_gateway import (
+    DcGateway,
+    load_accounts,
+)
+from distributed_crawler_tpu.clients.native import (
+    NativeTelegramClient,
+    TelegramError,
+    find_library,
+    load_credentials,
+    native_client_factory,
+)
+
+SEED = json.dumps({
+    "channels": [{
+        "username": "gwchan",
+        "id": 777,
+        "title": "Gateway Channel",
+        "member_count": 1200,
+        "messages": [
+            {"content": {"@type": "messageText",
+                         "text": {"text": f"gw message {i}"}},
+             "date": 1700000000 + i, "view_count": i}
+            for i in range(4)
+        ],
+    }],
+})
+
+ACCOUNTS = {
+    "+15550001111": {"code": "24680", "password": ""},
+    "+15550002222": {"code": "13579", "password": "hunter2"},
+}
+
+
+def _lib_available() -> bool:
+    try:
+        find_library()
+        return True
+    except Exception:
+        return False
+
+
+pytestmark = pytest.mark.skipif(
+    not _lib_available(), reason="libdct_client.so not built")
+
+
+class TestAccountsTable:
+    def test_load_accounts_file(self, tmp_path):
+        p = tmp_path / "accounts.json"
+        p.write_text(json.dumps({"accounts": [
+            {"phone_number": "+1555", "code": "1", "password": "pw"},
+            {"phone_number": "+1666", "code": "2"},
+        ]}))
+        acc = load_accounts(str(p))
+        assert acc == {"+1555": {"code": "1", "password": "pw"},
+                       "+1666": {"code": "2", "password": ""}}
+
+    def test_bare_list_accepted(self, tmp_path):
+        p = tmp_path / "accounts.json"
+        p.write_text(json.dumps(
+            [{"phone_number": "+1777", "code": "9"}]))
+        assert load_accounts(str(p))["+1777"]["code"] == "9"
+
+    def test_missing_phone_rejected(self, tmp_path):
+        p = tmp_path / "accounts.json"
+        p.write_text(json.dumps([{"code": "9"}]))
+        with pytest.raises(ValueError, match="phone_number"):
+            load_accounts(str(p))
+
+    def test_per_account_auth(self):
+        gw = DcGateway(seed_json=SEED, accounts=ACCOUNTS).start()
+        try:
+            c = NativeTelegramClient(server_addr=gw.address, conn_id="a1")
+            try:
+                c.authenticate("+15550001111", "24680")
+                c.wait_ready(5.0)
+                assert c.search_public_chat("gwchan").id == 777
+            finally:
+                c.close()
+            # Second account requires ITS code and password.
+            c = NativeTelegramClient(server_addr=gw.address, conn_id="a2")
+            try:
+                with pytest.raises(TelegramError,
+                                   match="PHONE_CODE_INVALID"):
+                    c.authenticate("+15550002222", "24680")
+                c._call({"@type": "checkAuthenticationCode",
+                         "code": "13579"})
+                c._call({"@type": "checkAuthenticationPassword",
+                         "password": "hunter2"})
+                c.wait_ready(5.0)
+                assert c.search_public_chat("gwchan").id == 777
+            finally:
+                c.close()
+        finally:
+            gw.close()
+        assert gw.auth_successes == 2
+        assert gw.auth_failures == 1
+
+    def test_unknown_phone_rejected(self):
+        gw = DcGateway(seed_json=SEED, accounts=ACCOUNTS).start()
+        try:
+            c = NativeTelegramClient(server_addr=gw.address, conn_id="u1")
+            try:
+                with pytest.raises(TelegramError,
+                                   match="PHONE_NUMBER_INVALID"):
+                    c.authenticate("+19990000000", "24680")
+            finally:
+                c.close()
+        finally:
+            gw.close()
+        assert gw.auth_failures == 1
+        assert gw.auth_successes == 0
+
+
+class TestStatusAndStore:
+    def test_status_map(self):
+        gw = DcGateway(seed_json=SEED, expected_code="1").start()
+        try:
+            c = NativeTelegramClient(server_addr=gw.address, conn_id="s1")
+            try:
+                c.authenticate("+1555", "1")
+                c.wait_ready(5.0)
+                c.search_public_chat("gwchan")
+                st = gw.status()
+                assert st["component"] == "dc-gateway"
+                assert st["connections_total"] == 1
+                assert st["active_sessions"] == 1
+                assert st["auth_successes"] == 1
+                assert st["requests_served"] >= 1
+            finally:
+                c.close()
+        finally:
+            gw.close()
+
+    def test_seed_source_store_root(self, tmp_path):
+        """Tarball/dir/json store materialized per session under the
+        persistent store root (server-side `acquire_seed_db` flow)."""
+        seed_path = tmp_path / "store.json"
+        seed_path.write_text(SEED)
+        store_root = tmp_path / "stores"
+        gw = DcGateway(seed_source=str(seed_path),
+                       store_root=str(store_root),
+                       expected_code="1").start()
+        try:
+            c = NativeTelegramClient(server_addr=gw.address, conn_id="st1")
+            try:
+                c.authenticate("+1555", "1")
+                c.wait_ready(5.0)
+                assert c.search_public_chat("gwchan").title == \
+                    "Gateway Channel"
+            finally:
+                c.close()
+        finally:
+            gw.close()
+        assert any(d.startswith("conn_") for d in os.listdir(store_root))
+
+    def test_address_file(self, tmp_path):
+        addr_file = tmp_path / "gw.addr"
+        gw = DcGateway(seed_json=SEED, port=0,
+                       address_file=str(addr_file))
+        try:
+            assert addr_file.read_text() == gw.address
+        finally:
+            gw.close()
+
+
+class TestGenCodeBootstrap:
+    """`dct --mode gen-code` against the gateway mints credentials.json;
+    the pool consumes it (VERDICT r03 #8 'Done' criterion)."""
+
+    def test_gen_code_against_gateway_then_pool(self, tmp_path):
+        from distributed_crawler_tpu.cli import main
+        from distributed_crawler_tpu.clients.pool import ConnectionPool
+
+        gw = DcGateway(seed_json=SEED, accounts=ACCOUNTS).start()
+        tdlib_dir = tmp_path / "tdlib"
+        try:
+            rc = main(["--mode", "gen-code",
+                       "--dc-address", gw.address,
+                       "--tdlib-dir", str(tdlib_dir)],
+                      env={"TG_API_ID": "12345", "TG_API_HASH": "h",
+                           "TG_PHONE_NUMBER": "+15550001111",
+                           "TG_PHONE_CODE": "24680"})
+            assert rc == 0
+            creds_path = tdlib_dir / "credentials.json"
+            assert creds_path.exists()
+            assert (os.stat(creds_path).st_mode & 0o777) == 0o600
+            creds = load_credentials(str(tdlib_dir))
+            assert creds["phone_number"] == "+15550001111"
+
+            # The pool consumes the minted credentials: every connection
+            # dials the gateway and walks the ladder before handout.
+            factory = native_client_factory(
+                server_addr=gw.address, credentials=creds)
+            pool = ConnectionPool(factory,
+                                  database_urls=[gw.address] * 2)
+            assert pool.initialize() == 2
+            conn = pool.acquire()
+            try:
+                assert conn.client.search_public_chat("gwchan").id == 777
+            finally:
+                pool.release(conn)
+            pool.close_all()
+            # gen-code session + 2 pool sessions all authenticated.
+            assert gw.auth_successes == 3
+        finally:
+            gw.close()
+
+    def test_gen_code_2fa_account(self, tmp_path):
+        """TG_PASSWORD drives the 2FA leg and is persisted so pools can
+        replay it (the gap the r04 review caught)."""
+        from distributed_crawler_tpu.cli import main
+        from distributed_crawler_tpu.clients.pool import ConnectionPool
+
+        gw = DcGateway(seed_json=SEED, accounts=ACCOUNTS).start()
+        tdlib_dir = tmp_path / "td2fa"
+        try:
+            rc = main(["--mode", "gen-code",
+                       "--dc-address", gw.address,
+                       "--tdlib-dir", str(tdlib_dir)],
+                      env={"TG_API_ID": "1", "TG_API_HASH": "h",
+                           "TG_PHONE_NUMBER": "+15550002222",
+                           "TG_PHONE_CODE": "13579",
+                           "TG_PASSWORD": "hunter2"})
+            assert rc == 0
+            creds = load_credentials(str(tdlib_dir))
+            assert creds["password"] == "hunter2"
+            factory = native_client_factory(
+                server_addr=gw.address, credentials=creds)
+            pool = ConnectionPool(factory, database_urls=[gw.address])
+            assert pool.initialize() == 1
+            conn = pool.acquire()
+            try:
+                assert conn.client.search_public_chat("gwchan").id == 777
+            finally:
+                pool.release(conn)
+            pool.close_all()
+        finally:
+            gw.close()
+
+    def test_gen_code_wrong_code_fails(self, tmp_path):
+        from distributed_crawler_tpu.cli import main
+
+        gw = DcGateway(seed_json=SEED, accounts=ACCOUNTS).start()
+        try:
+            rc = main(["--mode", "gen-code",
+                       "--dc-address", gw.address,
+                       "--tdlib-dir", str(tmp_path / "t")],
+                      env={"TG_API_ID": "12345",
+                           "TG_PHONE_NUMBER": "+15550001111",
+                           "TG_PHONE_CODE": "99999"})
+            assert rc == 2
+            assert not (tmp_path / "t" / "credentials.json").exists()
+        finally:
+            gw.close()
+
+    def test_gen_code_offline_engine(self, tmp_path, monkeypatch):
+        """Without --dc-address the embedded auth-enabled engine drives
+        the ladder (the original --generate-code path)."""
+        from distributed_crawler_tpu.cli import main
+
+        monkeypatch.chdir(tmp_path)
+        rc = main(["--mode", "gen-code",
+                   "--tdlib-dir", str(tmp_path / "td")],
+                  env={"TG_API_ID": "777", "TG_PHONE_NUMBER": "+1555",
+                       "TG_PHONE_CODE": "1"})
+        assert rc == 0
+        assert (tmp_path / "td" / "credentials.json").exists()
+
+
+class TestRemotePoolFromConfig:
+    def test_setup_pool_remote_mode(self, tmp_path):
+        """setup_pool_from_config with dc_address dials the gateway using
+        stored credentials (the full config-driven remote pool path)."""
+        from distributed_crawler_tpu.clients.native import generate_pcode
+        from distributed_crawler_tpu.config import CrawlerConfig
+        from distributed_crawler_tpu.crawl import (
+            get_connection_from_pool,
+            setup_pool_from_config,
+            shutdown_connection_pool,
+        )
+
+        gw = DcGateway(seed_json=SEED, expected_code="555").start()
+        tdlib_dir = str(tmp_path / "td")
+        try:
+            generate_pcode(
+                tdlib_dir=tdlib_dir,
+                env={"TG_API_ID": "1", "TG_PHONE_NUMBER": "+1555",
+                     "TG_PHONE_CODE": "555"},
+                client=NativeTelegramClient(server_addr=gw.address,
+                                            conn_id="boot"))
+            cfg = CrawlerConfig(dc_address=gw.address, concurrency=2,
+                                tdlib_dir=tdlib_dir)
+            assert setup_pool_from_config(cfg)
+            conn = get_connection_from_pool()
+            try:
+                assert conn.client.search_public_chat("gwchan").id == 777
+            finally:
+                from distributed_crawler_tpu.crawl.runner import (
+                    release_connection_to_pool,
+                )
+                release_connection_to_pool(conn)
+        finally:
+            shutdown_connection_pool()
+            gw.close()
+
+
+@pytest.mark.skipif(shutil.which("openssl") is None,
+                    reason="openssl binary needed for the TLS leg")
+class TestTwoProcessE2E:
+    """VERDICT r03 #3 'Done' criterion: a SEPARATE gateway process, real
+    TLS sockets, a full crawl through it."""
+
+    CRAWL_SEED = json.dumps({
+        "channels": [
+            {"username": "gwroot", "title": "Root", "member_count": 800,
+             "messages": [
+                 {"date": 1700000000, "view_count": 5,
+                  "content": {"@type": "messageText",
+                              "text": {"text": "hi @gwleaf",
+                                       "entities": [
+                                           {"type": {"@type":
+                                                     "textEntityTypeMention"},
+                                            "offset": 3, "length": 7}]}}},
+             ]},
+            {"username": "gwleaf", "title": "Leaf", "member_count": 50,
+             "messages": [
+                 {"date": 1700000050, "view_count": 1,
+                  "content": {"@type": "messageText",
+                              "text": {"text": "leaf", "entities": []}}},
+             ]},
+        ],
+    })
+
+    def test_crawl_through_gateway_process_over_tls(self, tmp_path):
+        from distributed_crawler_tpu.config import CrawlerConfig
+        from distributed_crawler_tpu.crawl.runner import run_for_channel
+        from distributed_crawler_tpu.state import (
+            CompositeStateManager,
+            SqlConfig,
+            StateConfig,
+        )
+
+        seed_file = tmp_path / "seed.json"
+        seed_file.write_text(self.CRAWL_SEED)
+        addr_file = tmp_path / "gw.addr"
+        accounts_file = tmp_path / "accounts.json"
+        accounts_file.write_text(json.dumps({"accounts": [
+            {"phone_number": "+15559990000", "code": "424242"}]}))
+
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "distributed_crawler_tpu.cli",
+             "--mode", "dc-gateway",
+             "--gateway-listen", "127.0.0.1:0",
+             "--gateway-address-file", str(addr_file),
+             "--gateway-tls",
+             "--gateway-accounts", str(accounts_file),
+             "--gateway-seed-json", f"@{seed_file}",
+             "--storage-root", str(tmp_path / "gwroot")],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        try:
+            deadline = time.time() + 30
+            while not addr_file.exists() and time.time() < deadline:
+                assert proc.poll() is None, (
+                    f"gateway died: {proc.stderr.read().decode()[-2000:]}")
+                time.sleep(0.1)
+            assert addr_file.exists(), "gateway never wrote address file"
+            address = addr_file.read_text()
+
+            client = NativeTelegramClient(
+                server_addr=address, tls=True, tls_insecure=True,
+                sni="localhost", conn_id="e2e")
+            try:
+                client.authenticate("+15559990000", "424242")
+                client.wait_ready(5.0)
+
+                sm = CompositeStateManager(StateConfig(
+                    crawl_id="gwe2e", crawl_execution_id="x1",
+                    storage_root=str(tmp_path / "out"),
+                    sql=SqlConfig(url=":memory:")))
+                sm.initialize(["gwroot"])
+                cfg = CrawlerConfig(crawl_id="gwe2e",
+                                    skip_media_download=True)
+                page = sm.get_layer_by_depth(0)[0]
+                discovered = run_for_channel(client, page, "", sm, cfg)
+                assert page.status == "fetched"
+                assert {p.url for p in discovered} == {"gwleaf"}
+                jsonl = (tmp_path / "out" / "gwe2e" / "gwroot" / "posts"
+                         / "posts.jsonl")
+                posts = [json.loads(line)
+                         for line in jsonl.read_text().splitlines()]
+                assert len(posts) == 1
+                sm.close()
+            finally:
+                client.close()
+        finally:
+            proc.send_signal(signal.SIGINT)
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5)
