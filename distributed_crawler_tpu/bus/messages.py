@@ -419,6 +419,10 @@ class StatusMessage:
     message_type: str = MSG_HEARTBEAT
     worker_id: str = ""
     status: str = WORKER_IDLE
+    # "crawl" (default) or "tpu": the orchestrator's registry and the
+    # co-scheduling backpressure valve key off this (north-star: crawl and
+    # inference shards share one orchestrator).
+    worker_type: str = "crawl"
     current_work: Optional[str] = None
     queue_length: int = 0
     resource_usage: Dict[str, Any] = field(default_factory=dict)
@@ -432,9 +436,11 @@ class StatusMessage:
     @classmethod
     def new(cls, worker_id: str, message_type: str, status: str,
             tasks_processed: int = 0, tasks_success: int = 0,
-            tasks_error: int = 0, uptime_s: float = 0.0) -> "StatusMessage":
+            tasks_error: int = 0, uptime_s: float = 0.0,
+            worker_type: str = "crawl") -> "StatusMessage":
         """`messages.go:207-219`."""
         return cls(message_type=message_type, worker_id=worker_id, status=status,
+                   worker_type=worker_type,
                    tasks_processed=tasks_processed, tasks_success=tasks_success,
                    tasks_error=tasks_error, timestamp=utcnow(),
                    uptime_s=uptime_s, trace_id=new_trace_id())
@@ -455,6 +461,7 @@ class StatusMessage:
             "message_type": self.message_type,
             "worker_id": self.worker_id,
             "status": self.status,
+            "worker_type": self.worker_type,
             "current_work": self.current_work,
             "queue_length": self.queue_length,
             "resource_usage": self.resource_usage,
@@ -472,6 +479,7 @@ class StatusMessage:
             message_type=d.get("message_type", MSG_HEARTBEAT),
             worker_id=d.get("worker_id", "") or "",
             status=d.get("status", WORKER_IDLE) or WORKER_IDLE,
+            worker_type=d.get("worker_type", "crawl") or "crawl",
             current_work=d.get("current_work"),
             queue_length=int(d.get("queue_length") or 0),
             resource_usage=dict(d.get("resource_usage") or {}),

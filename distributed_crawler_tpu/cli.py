@@ -146,6 +146,12 @@ def build_parser() -> argparse.ArgumentParser:
     a("--infer", action="store_const", const=True, default=None,
       help="enable the TPU inference stage")
     a("--infer-model", default=None, help="model registry key")
+    a("--infer-backpressure-high", type=int, default=None,
+      help="orchestrator pauses crawl distribution when live TPU workers' "
+           "summed queue depth crosses this (0 = valve off; default 64)")
+    a("--infer-backpressure-low", type=int, default=None,
+      help="distribution resumes once the backlog drains below this "
+           "(default 32)")
     # Media transcription (mode=transcribe): BASELINE config #4 — Whisper
     # over a crawl's media tree.
     a("--asr-pretrained-dir", default=None,
@@ -289,6 +295,8 @@ _KEY_MAP = {
     "profiler_port": "observability.profiler_port",
     "infer": "inference.enabled",
     "infer_model": "inference.model",
+    "infer_backpressure_high": "distributed.inference_backpressure_high",
+    "infer_backpressure_low": "distributed.inference_backpressure_low",
     "infer_batch_size": "inference.batch_size",
     "infer_param_dtype": "inference.param_dtype",
     "infer_quantize": "inference.quantize",
@@ -777,9 +785,15 @@ def _run_orchestrator(urls: List[str], cfg: CrawlerConfig,
     """`main.go:647-706`."""
     from .modes.common import create_state_manager
     from .orchestrator import Orchestrator
+    from .orchestrator.orchestrator import OrchestratorConfig
     bus = _make_bus(r, serve=True)
     sm = create_state_manager(cfg, cfg.crawl_id)
-    orch = Orchestrator(cfg.crawl_id, cfg, bus, sm)
+    ocfg = OrchestratorConfig(
+        inference_backpressure_high=r.get_int(
+            "distributed.inference_backpressure_high", 64),
+        inference_backpressure_low=r.get_int(
+            "distributed.inference_backpressure_low", 32))
+    orch = Orchestrator(cfg.crawl_id, cfg, bus, sm, ocfg=ocfg)
     from .utils.metrics import set_status_provider
     set_status_provider(orch.get_status)  # /status (`orchestrator.go:596`)
     orch.start(urls)

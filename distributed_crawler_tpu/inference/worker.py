@@ -375,7 +375,8 @@ class TPUWorker:
                 tasks_processed=self._processed,
                 tasks_success=self._processed - self._errors,
                 tasks_error=self._errors,
-                uptime_s=time.monotonic() - self._started_at)
+                uptime_s=time.monotonic() - self._started_at,
+                worker_type="tpu")
             msg.queue_length = self._queue.qsize()
             try:
                 self.bus.publish(TOPIC_WORKER_STATUS, msg.to_dict())

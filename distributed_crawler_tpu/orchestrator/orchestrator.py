@@ -64,6 +64,13 @@ class OrchestratorConfig:
     worker_timeout_s: float = 300.0  # 5 min (`orchestrator.go:498`)
     max_retries: int = 3
     work_ttl_s: int = 3600
+    # Co-scheduling backpressure (north star: crawl + inference shards on
+    # one slice): when the summed queue_length of live TPU workers crosses
+    # the HIGH watermark, crawl work distribution pauses; it resumes once
+    # the backlog drains below LOW (hysteresis so the valve doesn't
+    # chatter).  high=0 disables the valve.
+    inference_backpressure_high: int = 64
+    inference_backpressure_low: int = 32
 
 
 @dataclass
@@ -72,8 +79,10 @@ class WorkerInfo:
 
     id: str = ""
     status: str = WORKER_IDLE
+    worker_type: str = "crawl"  # "crawl" | "tpu" (StatusMessage.worker_type)
     last_seen: Optional[datetime] = None
     current_work: Optional[str] = None
+    queue_length: int = 0  # TPU workers: pending inference batches
     tasks_total: int = 0
     tasks_success: int = 0
     tasks_error: int = 0
@@ -103,6 +112,7 @@ class Orchestrator:
         self.discovered_pages = 0
         self.crawl_completed = False
         self._retry_counts: Dict[str, int] = {}  # page id -> retries
+        self._backpressure_active = False
 
         self._mu = threading.RLock()
         self._running = False
@@ -166,6 +176,43 @@ class Orchestrator:
         self.requeue_stale_work()
         self.log_progress()
 
+    # -- co-scheduling backpressure ----------------------------------------
+    def inference_backlog(self, now: Optional[datetime] = None) -> int:
+        """Summed queue_length of live TPU workers — the inference-side
+        backlog the crawl must not outrun.  Offline workers AND workers
+        whose heartbeat is older than worker_timeout_s are excluded: a
+        stale queue_length (worker died between health sweeps) must not
+        hold the valve shut."""
+        now = now or utcnow()
+        with self._mu:
+            return sum(
+                w.queue_length for w in self.workers.values()
+                if w.worker_type == "tpu" and w.status != WORKER_OFFLINE
+                and w.last_seen is not None
+                and (now - w.last_seen).total_seconds()
+                <= self.ocfg.worker_timeout_s)
+
+    def _backpressure_engaged(self) -> bool:
+        """Hysteresis valve: engage at HIGH, release below LOW.  A LOW at
+        or above HIGH would invert the hysteresis into per-tick chatter,
+        so it is clamped to HIGH (degenerating to a plain threshold)."""
+        high = self.ocfg.inference_backpressure_high
+        if high <= 0:
+            return False
+        low = min(self.ocfg.inference_backpressure_low, high)
+        backlog = self.inference_backlog()
+        if self._backpressure_active:
+            if backlog < low:
+                self._backpressure_active = False
+                logger.info("inference backlog drained; resuming crawl "
+                            "distribution", extra={"backlog": backlog})
+        elif backlog >= high:
+            self._backpressure_active = True
+            logger.warning("inference backlog high; pausing crawl "
+                           "distribution", extra={
+                               "backlog": backlog, "high_watermark": high})
+        return self._backpressure_active
+
     # -- work distribution (`orchestrator.go:182-277`) ---------------------
     def distribute_work(self) -> int:
         """One distribution pass; returns the number of items published.
@@ -173,7 +220,12 @@ class Orchestrator:
         The reference only advanced depth on an *empty* layer
         (`orchestrator.go:189-210`), which stalls once a layer is fully
         fetched; here a layer with no pending and no in-flight pages also
-        advances."""
+        advances.  A backed-up inference stage (TPU worker queue_length
+        over the high watermark) pauses PUBLISHING — crawl admission
+        follows the slowest co-scheduled stage — but never
+        completion/depth bookkeeping: a crawl whose pages are all fetched
+        still completes while the valve is closed."""
+        throttled = self._backpressure_engaged()
         if self.config.max_depth > 0 and \
                 self.current_depth > self.config.max_depth:
             with self._mu:
@@ -203,6 +255,8 @@ class Orchestrator:
             if active == 0 and not self.crawl_completed:
                 self._mark_crawl_completed()
             return 0
+        if throttled:
+            return 0  # pending work exists but inference must drain first
         published = 0
         for page in pending:
             item = self.create_work_item(page)
@@ -333,7 +387,9 @@ class Orchestrator:
                 worker = WorkerInfo(id=message.worker_id)
                 self.workers[message.worker_id] = worker
             worker.status = message.status
+            worker.worker_type = message.worker_type or "crawl"
             worker.last_seen = message.timestamp or utcnow()
+            worker.queue_length = message.queue_length
             worker.tasks_total = message.tasks_processed
             worker.tasks_success = message.tasks_success
             worker.tasks_error = message.tasks_error
@@ -493,13 +549,20 @@ class Orchestrator:
 
     def get_status(self) -> Dict[str, Any]:
         """`orchestrator.go:596-633`."""
+        backlog = self.inference_backlog()
         with self._mu:
+            tpu = {k: w for k, w in self.workers.items()
+                   if w.worker_type == "tpu"}
             return {
                 "crawl_id": self.crawl_id,
                 "is_running": self._running,
                 "platform": self.config.platform,
                 "current_depth": self.current_depth,
                 "worker_count": len(self.workers),
+                "crawl_worker_count": len(self.workers) - len(tpu),
+                "tpu_worker_count": len(tpu),
+                "inference_backlog": backlog,
+                "backpressure_active": self._backpressure_active,
                 "workers": {k: vars(v).copy()
                             for k, v in self.workers.items()},
                 "work_stats": {

@@ -207,6 +207,114 @@ class TestOrchestrator:
             tasks_success=4, tasks_error=1))
         assert orch.workers["w1"].status == WORKER_IDLE
         assert orch.workers["w1"].tasks_total == 5
+        assert orch.workers["w1"].worker_type == "crawl"
+
+    def test_status_distinguishes_worker_types(self, tmp_path):
+        """VERDICT r03 #4: /status separates crawl vs tpu workers and
+        carries the inference backlog (`orchestrator.go:419-449` registry
+        + the north star's co-scheduling)."""
+        orch = Orchestrator("c1", make_cfg(), InMemoryBus(),
+                            make_sm(tmp_path))
+        orch.handle_status(StatusMessage.new(
+            "crawl-1", MSG_HEARTBEAT, WORKER_IDLE))
+        tpu = StatusMessage.new("tpu-1", MSG_HEARTBEAT, WORKER_BUSY,
+                                worker_type="tpu")
+        tpu.queue_length = 17
+        orch.handle_status(tpu)
+        st = orch.get_status()
+        assert st["worker_count"] == 2
+        assert st["crawl_worker_count"] == 1
+        assert st["tpu_worker_count"] == 1
+        assert st["inference_backlog"] == 17
+        assert st["workers"]["tpu-1"]["worker_type"] == "tpu"
+        assert st["backpressure_active"] is False
+
+    def test_inference_backpressure_pauses_distribution(self, tmp_path):
+        """A backed-up TPU worker measurably pauses work-item publishing;
+        distribution resumes once the backlog drains below the low
+        watermark (hysteresis)."""
+        bus = InMemoryBus()
+        published = []
+        bus.subscribe(TOPIC_WORK_QUEUE, published.append)
+        orch = Orchestrator(
+            "c1", make_cfg(), bus, make_sm(tmp_path),
+            OrchestratorConfig(inference_backpressure_high=10,
+                               inference_backpressure_low=5))
+        orch.start(["chana", "chanb"], background=False)
+        # Slow TPU worker: backlog over the high watermark.
+        slow = StatusMessage.new("tpu-1", MSG_HEARTBEAT, WORKER_BUSY,
+                                 worker_type="tpu")
+        slow.queue_length = 12
+        orch.handle_status(slow)
+        assert orch.distribute_work() == 0
+        assert published == []
+        assert orch.get_status()["backpressure_active"] is True
+        # Backlog drains but stays above LOW: valve stays closed.
+        slow.queue_length = 7
+        orch.handle_status(slow)
+        assert orch.distribute_work() == 0
+        # Below LOW: valve opens, the two seed pages publish.
+        slow.queue_length = 2
+        orch.handle_status(slow)
+        assert orch.distribute_work() == 2
+        assert len(published) == 2
+        assert orch.get_status()["backpressure_active"] is False
+
+    def test_offline_tpu_worker_releases_backpressure(self, tmp_path):
+        """A dead TPU worker's stale queue_length must not wedge the crawl
+        shut forever: offline workers leave the backlog sum."""
+        bus = InMemoryBus()
+        orch = Orchestrator(
+            "c1", make_cfg(), bus, make_sm(tmp_path),
+            OrchestratorConfig(inference_backpressure_high=10,
+                               inference_backpressure_low=5))
+        orch.start(["chana"], background=False)
+        slow = StatusMessage.new("tpu-1", MSG_HEARTBEAT, WORKER_BUSY,
+                                 worker_type="tpu")
+        slow.queue_length = 50
+        orch.handle_status(slow)
+        assert orch.distribute_work() == 0  # fresh heartbeat: valve shut
+        # The worker dies silently: before any health sweep, its stale
+        # heartbeat already stops counting toward the backlog...
+        orch.workers["tpu-1"].last_seen = utcnow() - timedelta(minutes=10)
+        assert orch.inference_backlog() == 0
+        # ...and the health sweep then marks it offline outright.
+        orch.check_worker_health()
+        assert orch.workers["tpu-1"].status == WORKER_OFFLINE
+        assert orch.distribute_work() == 1
+
+    def test_backpressure_never_blocks_completion(self, tmp_path):
+        """A closed valve must not suppress crawl-completion bookkeeping:
+        all pages fetched + backlog high still completes the crawl."""
+        bus = InMemoryBus()
+        orch = Orchestrator(
+            "c1", make_cfg(), bus, make_sm(tmp_path),
+            OrchestratorConfig(inference_backpressure_high=10,
+                               inference_backpressure_low=5))
+        orch.start(["chana"], background=False)
+        orch.distribute_work()
+        item = next(iter(orch.active_work.values()))
+        orch.handle_result(ResultMessage.new(WorkResult(
+            work_item_id=item.id, worker_id="w1", status=STATUS_SUCCESS,
+            processed_url=item.url, completed_at=utcnow())))
+        slow = StatusMessage.new("tpu-1", MSG_HEARTBEAT, WORKER_BUSY,
+                                 worker_type="tpu")
+        slow.queue_length = 99
+        orch.handle_status(slow)
+        assert orch.distribute_work() == 0
+        assert orch.crawl_completed  # valve closed, crawl still completed
+
+    def test_backpressure_disabled_with_zero_watermark(self, tmp_path):
+        bus = InMemoryBus()
+        orch = Orchestrator(
+            "c1", make_cfg(), bus, make_sm(tmp_path),
+            OrchestratorConfig(inference_backpressure_high=0))
+        orch.start(["chana"], background=False)
+        slow = StatusMessage.new("tpu-1", MSG_HEARTBEAT, WORKER_BUSY,
+                                 worker_type="tpu")
+        slow.queue_length = 10_000
+        orch.handle_status(slow)
+        assert orch.distribute_work() == 1
 
     def test_health_monitor_reassigns_work(self, tmp_path):
         bus = InMemoryBus()
