@@ -457,16 +457,20 @@ class ObjectStorageProvider:
 
 
 def make_object_client(url: str) -> ObjectStoreClient:
-    """``memory://`` | ``file:///abs/path`` | ``file:relative/path``.
-
-    Cloud schemes (``s3://`` etc.) raise with a pointer to the client
-    protocol — SDK adapters slot in here without touching callers."""
+    """``memory://`` | ``file:///abs/path`` | ``file:relative/path`` |
+    ``s3://bucket/prefix?endpoint=...`` (any S3-compatible store,
+    `state/s3store.py` — the reference's cloud-blob binding analog,
+    `state/daprstate.go:29-35`)."""
     if url == "memory://":
         return InMemoryObjectClient()
     if url.startswith("file://"):
         return LocalFSObjectClient(url[len("file://"):] or "/")
     if url.startswith("file:"):
         return LocalFSObjectClient(url[len("file:"):])
+    if url.startswith("s3://"):
+        from .s3store import parse_s3_url
+
+        return parse_s3_url(url)
     if "://" in url:
         raise ValueError(
             f"no client for object-store scheme {url.split('://')[0]!r}; "

@@ -1,0 +1,353 @@
+"""S3 object-store adapter: real cloud blob storage behind the chunker seam.
+
+The reference shipped crawl output to Azure blob through its storage binding
+(`state/daprstate.go:29-35`); this build's equivalent seam is
+`state/objectstore.ObjectStoreClient`, and this module is its first real
+cloud adapter.  No SDK is vendored (and none is installed in the image), so
+the client speaks the S3 REST API directly over stdlib HTTP with AWS
+Signature Version 4 request signing — which also makes it portable across
+every S3-compatible store (AWS, GCS interop, MinIO, Ceph RGW) via the
+``endpoint`` parameter.
+
+Surface (the full :class:`~.objectstore.ObjectStoreClient` protocol):
+put/get/head/list/delete plus multipart create/upload/complete/abort — the
+part-level operations `ObjectStoreUploader` needs for retry+resume of the
+chunker's 170 MiB combined files.
+
+URL form (``make_object_client``):
+
+    s3://bucket/optional/prefix?endpoint=http://127.0.0.1:9000&region=us-east-1
+
+Credentials come from ``AWS_ACCESS_KEY_ID`` / ``AWS_SECRET_ACCESS_KEY``
+(query-string overrides exist for hermetic tests only).  Custom endpoints
+use path-style addressing (bucket in the path), the convention every
+S3-compatible emulator expects; bare ``s3://bucket`` targets AWS with
+virtual-host-style addressing.
+
+Error taxonomy: 5xx / connection errors raise
+:class:`~.objectstore.TransientStoreError` (the uploader retries those);
+4xx raise ``ValueError`` (mis-signed, missing bucket — retrying can't fix
+it); 404 on get/head returns ``None`` per the protocol.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import hashlib
+import hmac
+import http.client
+import os
+import socket
+import threading
+import urllib.parse
+import xml.etree.ElementTree as ET
+from typing import Dict, List, Optional, Tuple
+
+from .objectstore import TransientStoreError
+
+_EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
+
+def _uri_encode(s: str, encode_slash: bool) -> str:
+    """AWS SigV4 URI encoding: RFC 3986 with '~' unreserved."""
+    safe = "-._~" + ("" if encode_slash else "/")
+    return urllib.parse.quote(s, safe=safe)
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode("utf-8"), hashlib.sha256).digest()
+
+
+class SigV4Signer:
+    """AWS Signature Version 4 for S3 (single-chunk payloads)."""
+
+    def __init__(self, access_key: str, secret_key: str, region: str,
+                 service: str = "s3"):
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.service = service
+
+    def sign(self, method: str, host: str, path: str,
+             query: List[Tuple[str, str]], payload_sha256: str,
+             now: Optional[_dt.datetime] = None) -> Dict[str, str]:
+        """Returns the headers to attach (Host excluded — http.client sets
+        it; it IS part of the signature)."""
+        now = now or _dt.datetime.now(_dt.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        datestamp = now.strftime("%Y%m%d")
+        canonical_query = "&".join(
+            f"{_uri_encode(k, True)}={_uri_encode(v, True)}"
+            for k, v in sorted(query))
+        headers = {"host": host, "x-amz-content-sha256": payload_sha256,
+                   "x-amz-date": amz_date}
+        signed_names = ";".join(sorted(headers))
+        canonical_headers = "".join(
+            f"{k}:{headers[k].strip()}\n" for k in sorted(headers))
+        canonical_request = "\n".join([
+            method, _uri_encode(path, False) or "/", canonical_query,
+            canonical_headers, signed_names, payload_sha256])
+        scope = f"{datestamp}/{self.region}/{self.service}/aws4_request"
+        string_to_sign = "\n".join([
+            "AWS4-HMAC-SHA256", amz_date, scope,
+            hashlib.sha256(canonical_request.encode("utf-8")).hexdigest()])
+        key = _hmac(_hmac(_hmac(_hmac(
+            ("AWS4" + self.secret_key).encode("utf-8"), datestamp),
+            self.region), self.service), "aws4_request")
+        signature = hmac.new(key, string_to_sign.encode("utf-8"),
+                             hashlib.sha256).hexdigest()
+        return {
+            "x-amz-content-sha256": payload_sha256,
+            "x-amz-date": amz_date,
+            "Authorization": (
+                f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
+                f"SignedHeaders={signed_names}, Signature={signature}"),
+        }
+
+
+class S3ObjectClient:
+    """`ObjectStoreClient` over the S3 REST API (stdlib HTTP + SigV4)."""
+
+    def __init__(self, bucket: str, prefix: str = "",
+                 endpoint: str = "", region: str = "us-east-1",
+                 access_key: str = "", secret_key: str = "",
+                 timeout_s: float = 30.0):
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        self.region = region
+        self.timeout_s = timeout_s
+        access_key = access_key or os.environ.get("AWS_ACCESS_KEY_ID", "")
+        secret_key = secret_key or os.environ.get("AWS_SECRET_ACCESS_KEY", "")
+        if not access_key or not secret_key:
+            raise ValueError(
+                "s3:// needs credentials: set AWS_ACCESS_KEY_ID / "
+                "AWS_SECRET_ACCESS_KEY")
+        self._signer = SigV4Signer(access_key, secret_key, region)
+        if endpoint:
+            u = urllib.parse.urlsplit(endpoint)
+            self._tls = u.scheme == "https"
+            self._host = u.netloc
+            self._path_style = True  # emulators/MinIO convention
+        else:
+            self._tls = True
+            self._host = f"{bucket}.s3.{region}.amazonaws.com"
+            self._path_style = False
+        self._lock = threading.Lock()
+        self._conn: Optional[http.client.HTTPConnection] = None
+
+    # -- transport ---------------------------------------------------------
+    def _object_path(self, key: str) -> str:
+        full = f"{self.prefix}/{key}" if self.prefix else key
+        base = f"/{self.bucket}" if self._path_style else ""
+        return f"{base}/{full}"
+
+    def _bucket_path(self) -> str:
+        return f"/{self.bucket}" if self._path_style else "/"
+
+    def _connect(self):
+        conn_cls = (http.client.HTTPSConnection if self._tls
+                    else http.client.HTTPConnection)
+        return conn_cls(self._host, timeout=self.timeout_s)
+
+    def _request(self, method: str, path: str,
+                 query: Optional[List[Tuple[str, str]]] = None,
+                 body: bytes = b"") -> Tuple[int, Dict[str, str], bytes]:
+        query = query or []
+        payload_hash = (hashlib.sha256(body).hexdigest() if body
+                        else _EMPTY_SHA256)
+        headers = self._signer.sign(method, self._host, path, query,
+                                    payload_hash)
+        if body:
+            headers["Content-Length"] = str(len(body))
+        # The wire path/query must byte-match the canonical forms that were
+        # signed (sorted query, SigV4 percent-encoding), or the server's
+        # recomputed signature won't agree.
+        qs = "&".join(f"{_uri_encode(k, True)}={_uri_encode(v, True)}"
+                      for k, v in sorted(query))
+        url = _uri_encode(path, False) + (f"?{qs}" if qs else "")
+        # One persistent keep-alive connection per client, serialized by
+        # the lock: a 170 MiB multipart upload is ~34 parts, and a TLS
+        # handshake per part would dominate the upload hot path.  Any
+        # transport error drops the connection; the uploader's retry gets
+        # a fresh one.
+        with self._lock:
+            if self._conn is None:
+                self._conn = self._connect()
+            conn = self._conn
+            try:
+                conn.request(method, url, body=body or None,
+                             headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                return resp.status, dict(resp.getheaders()), data
+            except (OSError, socket.timeout,
+                    http.client.HTTPException) as e:
+                self._conn = None
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                raise TransientStoreError(
+                    f"s3 {method} {path}: {e}") from e
+
+    def close(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                try:
+                    self._conn.close()
+                except OSError:
+                    pass
+                self._conn = None
+
+    @staticmethod
+    def _raise_for(status: int, method: str, path: str,
+                   body: bytes) -> None:
+        if status >= 500:
+            raise TransientStoreError(
+                f"s3 {method} {path}: HTTP {status}")
+        if status >= 400:
+            raise ValueError(
+                f"s3 {method} {path}: HTTP {status}: "
+                f"{body[:300].decode('utf-8', 'replace')}")
+        if status >= 300:
+            # Wrong-region PermanentRedirect and friends: following the
+            # redirect would break the signature (host is signed), and
+            # treating it as success would hand redirect XML back as
+            # object data.  Surface it as a config error.
+            raise ValueError(
+                f"s3 {method} {path}: HTTP {status} redirect — point "
+                f"endpoint/region at the bucket's actual region: "
+                f"{body[:300].decode('utf-8', 'replace')}")
+
+    # -- ObjectStoreClient protocol ---------------------------------------
+    def put_object(self, key: str, data: bytes) -> None:
+        status, _, body = self._request("PUT", self._object_path(key),
+                                        body=data)
+        self._raise_for(status, "PUT", key, body)
+
+    def get_object(self, key: str) -> Optional[bytes]:
+        status, _, body = self._request("GET", self._object_path(key))
+        if status == 404:
+            return None
+        self._raise_for(status, "GET", key, body)
+        return body
+
+    def head_object(self, key: str) -> Optional[int]:
+        status, headers, body = self._request("HEAD", self._object_path(key))
+        if status == 404:
+            return None
+        self._raise_for(status, "HEAD", key, body)
+        cl = {k.lower(): v for k, v in headers.items()}.get(
+            "content-length")
+        return int(cl) if cl is not None else 0
+
+    def list_objects(self, prefix: str) -> List[str]:
+        full_prefix = (f"{self.prefix}/{prefix}" if self.prefix
+                       else prefix)
+        keys: List[str] = []
+        token = ""
+        while True:
+            query = [("list-type", "2"), ("prefix", full_prefix)]
+            if token:
+                query.append(("continuation-token", token))
+            status, _, body = self._request("GET", self._bucket_path(),
+                                            query=query)
+            self._raise_for(status, "LIST", prefix, body)
+            root = ET.fromstring(body)
+            ns = ""
+            if root.tag.startswith("{"):
+                ns = root.tag[:root.tag.index("}") + 1]
+            for el in root.iter(f"{ns}Key"):
+                k = el.text or ""
+                if self.prefix and k.startswith(self.prefix + "/"):
+                    k = k[len(self.prefix) + 1:]
+                keys.append(k)
+            truncated = root.find(f"{ns}IsTruncated")
+            if truncated is None or truncated.text != "true":
+                break
+            nxt = root.find(f"{ns}NextContinuationToken")
+            if nxt is None or not nxt.text:
+                break
+            token = nxt.text
+        return sorted(keys)
+
+    def delete_object(self, key: str) -> None:
+        status, _, body = self._request("DELETE", self._object_path(key))
+        if status == 404:
+            return
+        self._raise_for(status, "DELETE", key, body)
+
+    # -- multipart (the uploader's retry/resume surface) -------------------
+    def create_multipart(self, key: str) -> str:
+        status, _, body = self._request("POST", self._object_path(key),
+                                        query=[("uploads", "")])
+        self._raise_for(status, "POST?uploads", key, body)
+        root = ET.fromstring(body)
+        ns = root.tag[:root.tag.index("}") + 1] if \
+            root.tag.startswith("{") else ""
+        el = root.find(f"{ns}UploadId")
+        if el is None or not el.text:
+            raise TransientStoreError(
+                f"s3 create_multipart {key}: no UploadId in response")
+        return el.text
+
+    def upload_part(self, key: str, upload_id: str, part_no: int,
+                    data: bytes) -> str:
+        # The protocol's part_no is 0-based; S3 part numbers start at 1.
+        status, headers, body = self._request(
+            "PUT", self._object_path(key),
+            query=[("partNumber", str(part_no + 1)),
+                   ("uploadId", upload_id)], body=data)
+        self._raise_for(status, "PUT?partNumber", key, body)
+        etag = {k.lower(): v for k, v in headers.items()}.get("etag", "")
+        if not etag:
+            raise TransientStoreError(
+                f"s3 upload_part {key}#{part_no}: no ETag returned")
+        return etag
+
+    def complete_multipart(self, key: str, upload_id: str,
+                           etags: List[str]) -> None:
+        parts_xml = "".join(
+            f"<Part><PartNumber>{i + 1}</PartNumber>"
+            f"<ETag>{etag}</ETag></Part>"
+            for i, etag in enumerate(etags))
+        payload = (f"<CompleteMultipartUpload>{parts_xml}"
+                   f"</CompleteMultipartUpload>").encode("utf-8")
+        status, _, body = self._request(
+            "POST", self._object_path(key),
+            query=[("uploadId", upload_id)], body=payload)
+        self._raise_for(status, "POST?uploadId", key, body)
+        # S3 can return 200 with an <Error> body for a failed complete.
+        if b"<Error>" in body:
+            raise TransientStoreError(
+                f"s3 complete_multipart {key}: "
+                f"{body[:300].decode('utf-8', 'replace')}")
+
+    def abort_multipart(self, key: str, upload_id: str) -> None:
+        status, _, body = self._request(
+            "DELETE", self._object_path(key),
+            query=[("uploadId", upload_id)])
+        if status == 404:
+            return
+        self._raise_for(status, "DELETE?uploadId", key, body)
+
+
+def parse_s3_url(url: str) -> S3ObjectClient:
+    """``s3://bucket[/prefix]?endpoint=...&region=...`` → client.
+
+    Query params: ``endpoint`` (S3-compatible base URL; empty = AWS),
+    ``region``, and — FOR TESTS ONLY — ``access_key``/``secret_key``
+    (production credentials belong in the environment, never in a URL that
+    lands in logs and config files)."""
+    u = urllib.parse.urlsplit(url)
+    if u.scheme != "s3" or not u.netloc:
+        raise ValueError(f"not an s3 URL: {url}")
+    q = dict(urllib.parse.parse_qsl(u.query))
+    return S3ObjectClient(
+        bucket=u.netloc,
+        prefix=u.path.strip("/"),
+        endpoint=q.get("endpoint", ""),
+        region=q.get("region", "us-east-1"),
+        access_key=q.get("access_key", ""),
+        secret_key=q.get("secret_key", ""),
+    )

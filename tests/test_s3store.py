@@ -1,0 +1,414 @@
+"""S3 object-store adapter (VERDICT r03 #5) against an in-tree S3 REST
+emulator over real HTTP sockets.
+
+The emulator implements the slice of the S3 API the adapter speaks
+(put/get/head/delete/ListObjectsV2 + multipart) and — crucially —
+RECOMPUTES the AWS SigV4 signature of every request with the shared
+secret, rejecting mismatches with 403: the tests prove the signing
+implementation, not just the happy path.  Reference parity: the Azure
+blob output binding seam, `state/daprstate.go:29-35`.
+"""
+
+import hashlib
+import hmac
+import http.server
+import json
+import os
+import re
+import threading
+import time
+import urllib.parse
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from distributed_crawler_tpu.state.objectstore import (
+    ObjectStoreUploader,
+    TransientStoreError,
+    make_object_client,
+)
+from distributed_crawler_tpu.state.s3store import S3ObjectClient
+
+ACCESS, SECRET = "AKIATEST12345", "s3cr3t-key-for-tests"
+
+
+class S3Emulator:
+    """Minimal S3-compatible server: in-memory, path-style, SigV4-checked."""
+
+    PAGE_SIZE = 3  # small: exercises ListObjectsV2 continuation
+
+    def __init__(self):
+        self.objects = {}
+        self.uploads = {}  # upload_id -> {"key": str, "parts": {n: bytes}}
+        self.request_log = []  # (method, path-with-query)
+        self.fail_next = []  # list of (regex, count) -> 500
+        self._uid = 0
+        emu = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _fail_injected(self) -> bool:
+                target = f"{self.command} {self.path}"
+                for i, (rx, count) in enumerate(emu.fail_next):
+                    if count > 0 and re.search(rx, target):
+                        emu.fail_next[i] = (rx, count - 1)
+                        self._respond(500, b"<Error>injected</Error>")
+                        return True
+                return False
+
+            def _check_sig(self, body: bytes) -> bool:
+                auth = self.headers.get("Authorization", "")
+                m = re.match(
+                    r"AWS4-HMAC-SHA256 Credential=([^/]+)/(\d+)/([^/]+)/"
+                    r"([^/]+)/aws4_request, SignedHeaders=([^,]+), "
+                    r"Signature=([0-9a-f]+)", auth)
+                if not m or m.group(1) != ACCESS:
+                    self._respond(403, b"<Error>bad credential</Error>")
+                    return False
+                datestamp, region, service = m.group(2), m.group(3), \
+                    m.group(4)
+                signed_names, got_sig = m.group(5), m.group(6)
+                payload_hash = self.headers.get("x-amz-content-sha256", "")
+                if hashlib.sha256(body).hexdigest() != payload_hash:
+                    self._respond(403, b"<Error>payload hash</Error>")
+                    return False
+                path, _, query = self.path.partition("?")
+                canonical_headers = "".join(
+                    f"{name}:{self.headers.get(name, '').strip()}\n"
+                    for name in signed_names.split(";"))
+                canonical_request = "\n".join([
+                    self.command, path or "/", query, canonical_headers,
+                    signed_names, payload_hash])
+                scope = f"{datestamp}/{region}/{service}/aws4_request"
+                string_to_sign = "\n".join([
+                    "AWS4-HMAC-SHA256",
+                    self.headers.get("x-amz-date", ""), scope,
+                    hashlib.sha256(
+                        canonical_request.encode()).hexdigest()])
+
+                def h(key, msg):
+                    return hmac.new(key, msg.encode(),
+                                    hashlib.sha256).digest()
+
+                key = h(h(h(h(("AWS4" + SECRET).encode(), datestamp),
+                            region), service), "aws4_request")
+                want = hmac.new(key, string_to_sign.encode(),
+                                hashlib.sha256).hexdigest()
+                if want != got_sig:
+                    self._respond(403, b"<Error>SignatureDoesNotMatch"
+                                       b"</Error>")
+                    return False
+                return True
+
+            def _respond(self, status, body=b"", headers=None):
+                self.send_response(status)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if self.command != "HEAD":
+                    self.wfile.write(body)
+
+            def _parse(self):
+                path, _, query = self.path.partition("?")
+                q = dict(urllib.parse.parse_qsl(query,
+                                                keep_blank_values=True))
+                # path-style: /bucket/key...
+                parts = urllib.parse.unquote(path).lstrip("/").split("/", 1)
+                bucket = parts[0]
+                key = parts[1] if len(parts) > 1 else ""
+                return bucket, key, q
+
+            def _handle(self):
+                body = b""
+                n = int(self.headers.get("Content-Length") or 0)
+                if n:
+                    body = self.rfile.read(n)
+                emu.request_log.append((self.command, self.path))
+                if self._fail_injected():
+                    return
+                if not self._check_sig(body):
+                    return
+                _bucket, key, q = self._parse()
+                cmd = self.command
+                if cmd == "POST" and "uploads" in q:
+                    emu._uid += 1
+                    uid = f"up-{emu._uid}"
+                    emu.uploads[uid] = {"key": key, "parts": {},
+                                        "etags": {}}
+                    self._respond(200, (
+                        "<InitiateMultipartUploadResult>"
+                        f"<UploadId>{uid}</UploadId>"
+                        "</InitiateMultipartUploadResult>").encode())
+                    return
+                if cmd == "PUT" and "partNumber" in q:
+                    up = emu.uploads.get(q.get("uploadId", ""))
+                    if up is None:
+                        self._respond(404, b"<Error>NoSuchUpload</Error>")
+                        return
+                    pn = int(q["partNumber"])
+                    up["parts"][pn] = body
+                    etag = '"%s"' % hashlib.md5(body).hexdigest()
+                    up["etags"][pn] = etag
+                    self._respond(200, headers={"ETag": etag})
+                    return
+                if cmd == "POST" and "uploadId" in q:
+                    up = emu.uploads.pop(q["uploadId"], None)
+                    if up is None:
+                        self._respond(404, b"<Error>NoSuchUpload</Error>")
+                        return
+                    root = ET.fromstring(body)
+                    joined = b""
+                    for part in root.iter("Part"):
+                        pn = int(part.find("PartNumber").text)
+                        etag = part.find("ETag").text
+                        if up["etags"].get(pn) != etag:
+                            self._respond(400,
+                                          b"<Error>InvalidPart</Error>")
+                            return
+                        joined += up["parts"][pn]
+                    emu.objects[up["key"]] = joined
+                    self._respond(200, b"<CompleteMultipartUploadResult/>")
+                    return
+                if cmd == "DELETE" and "uploadId" in q:
+                    emu.uploads.pop(q["uploadId"], None)
+                    self._respond(204)
+                    return
+                if cmd == "GET" and q.get("list-type") == "2":
+                    prefix = q.get("prefix", "")
+                    keys = sorted(k for k in emu.objects
+                                  if k.startswith(prefix))
+                    start = 0
+                    token = q.get("continuation-token", "")
+                    if token:
+                        start = int(token)
+                    page = keys[start:start + emu.PAGE_SIZE]
+                    truncated = start + emu.PAGE_SIZE < len(keys)
+                    xml = ["<ListBucketResult>"]
+                    for k in page:
+                        xml.append(f"<Contents><Key>{k}</Key></Contents>")
+                    xml.append(f"<IsTruncated>{str(truncated).lower()}"
+                               f"</IsTruncated>")
+                    if truncated:
+                        xml.append(f"<NextContinuationToken>"
+                                   f"{start + emu.PAGE_SIZE}"
+                                   f"</NextContinuationToken>")
+                    xml.append("</ListBucketResult>")
+                    self._respond(200, "".join(xml).encode())
+                    return
+                if cmd == "PUT":
+                    emu.objects[key] = body
+                    self._respond(200)
+                    return
+                if cmd in ("GET", "HEAD"):
+                    data = emu.objects.get(key)
+                    if data is None:
+                        self._respond(404, b"<Error>NoSuchKey</Error>")
+                        return
+                    self._respond(200, data)
+                    return
+                if cmd == "DELETE":
+                    emu.objects.pop(key, None)
+                    self._respond(204)
+                    return
+                self._respond(400, b"<Error>unsupported</Error>")
+
+            do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _handle
+
+        self._srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                    Handler)
+        self.port = self._srv.server_address[1]
+        self.endpoint = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+@pytest.fixture
+def emu():
+    e = S3Emulator().start()
+    yield e
+    e.close()
+
+
+def make_client(emu, prefix="") -> S3ObjectClient:
+    return S3ObjectClient(bucket="crawl", prefix=prefix,
+                          endpoint=emu.endpoint,
+                          access_key=ACCESS, secret_key=SECRET)
+
+
+class TestSignedRoundTrip:
+    def test_put_get_head_delete(self, emu):
+        c = make_client(emu)
+        c.put_object("a/b.jsonl", b"hello s3")
+        assert c.get_object("a/b.jsonl") == b"hello s3"
+        assert c.head_object("a/b.jsonl") == 8
+        assert c.get_object("missing") is None
+        assert c.head_object("missing") is None
+        c.delete_object("a/b.jsonl")
+        assert c.get_object("a/b.jsonl") is None
+
+    def test_bad_secret_rejected(self, emu):
+        c = S3ObjectClient(bucket="crawl", endpoint=emu.endpoint,
+                           access_key=ACCESS, secret_key="wrong-secret")
+        with pytest.raises(ValueError, match="403"):
+            c.put_object("k", b"x")
+
+    def test_special_chars_in_key_sign_correctly(self, emu):
+        c = make_client(emu)
+        key = "dir with space/post+plus=eq~tilde.jsonl"
+        c.put_object(key, b"data")
+        assert c.get_object(key) == b"data"
+
+    def test_prefix_scoping(self, emu):
+        c = make_client(emu, prefix="crawls/c1")
+        c.put_object("combined/a.jsonl", b"x")
+        assert "crawls/c1/combined/a.jsonl" in emu.objects
+        assert c.list_objects("combined/") == ["combined/a.jsonl"]
+        assert c.get_object("combined/a.jsonl") == b"x"
+
+    def test_list_paginates_through_continuation(self, emu):
+        c = make_client(emu)
+        for i in range(8):  # PAGE_SIZE=3 -> 3 pages
+            c.put_object(f"p/k{i}", b"v")
+        assert c.list_objects("p/") == [f"p/k{i}" for i in range(8)]
+
+    def test_5xx_is_transient(self, emu):
+        c = make_client(emu)
+        emu.fail_next.append((r"PUT /crawl/t5", 1))
+        with pytest.raises(TransientStoreError):
+            c.put_object("t5", b"x")
+
+    def test_connection_refused_is_transient(self):
+        c = S3ObjectClient(bucket="b", endpoint="http://127.0.0.1:1",
+                           access_key=ACCESS, secret_key=SECRET,
+                           timeout_s=2.0)
+        with pytest.raises(TransientStoreError):
+            c.get_object("k")
+
+
+class TestMultipartRetryResume:
+    def test_multipart_roundtrip(self, emu):
+        c = make_client(emu)
+        up = ObjectStoreUploader(c, part_size=8, backoff_s=0.01)
+        data = b"0123456789" * 5  # 50 B -> 7 parts of 8
+        up.upload_bytes("mp/big.bin", data)
+        assert emu.objects["mp/big.bin"] == data
+
+    def test_mid_upload_fault_resumes_from_last_part(self, emu):
+        """The VERDICT 'Done' criterion: a part-level 500 mid-upload is
+        retried at THAT part — earlier parts are never re-sent."""
+        c = make_client(emu)
+        up = ObjectStoreUploader(c, part_size=8, backoff_s=0.01)
+        # partNumber=3 (0-based part 2) fails twice, then succeeds.
+        emu.fail_next.append((r"PUT /crawl/mp/fault\.bin\?partNumber=3&", 2))
+        data = bytes(range(40))  # 5 parts
+        up.upload_bytes("mp/fault.bin", data)
+        assert emu.objects["mp/fault.bin"] == data
+        sends = [p for m, p in emu.request_log
+                 if m == "PUT" and "partNumber=" in p
+                 and "fault.bin" in p]
+        by_part = {}
+        for p in sends:
+            n = int(re.search(r"partNumber=(\d+)", p).group(1))
+            by_part[n] = by_part.get(n, 0) + 1
+        assert by_part[3] == 3          # two failures + one success
+        assert by_part[1] == by_part[2] == 1  # never resent from byte 0
+        assert by_part[4] == by_part[5] == 1
+
+    def test_complete_with_wrong_etag_rejected(self, emu):
+        c = make_client(emu)
+        uid = c.create_multipart("mp/etag.bin")
+        c.upload_part("mp/etag.bin", uid, 0, b"part0")
+        with pytest.raises(ValueError, match="400"):
+            c.complete_multipart("mp/etag.bin", uid, ['"bogus-etag"'])
+
+
+class TestMakeObjectClientUrl:
+    def test_s3_url_parses(self, emu):
+        url = (f"s3://crawl/pfx?endpoint={emu.endpoint}"
+               f"&access_key={ACCESS}&secret_key={SECRET}")
+        c = make_object_client(url)
+        c.put_object("k.jsonl", b"via-url")
+        assert emu.objects["pfx/k.jsonl"] == b"via-url"
+
+    def test_missing_credentials_rejected(self, monkeypatch):
+        monkeypatch.delenv("AWS_ACCESS_KEY_ID", raising=False)
+        monkeypatch.delenv("AWS_SECRET_ACCESS_KEY", raising=False)
+        with pytest.raises(ValueError, match="credentials"):
+            make_object_client("s3://bucket/p?endpoint=http://x")
+
+    def test_env_credentials_used(self, emu, monkeypatch):
+        monkeypatch.setenv("AWS_ACCESS_KEY_ID", ACCESS)
+        monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", SECRET)
+        c = make_object_client(f"s3://crawl?endpoint={emu.endpoint}")
+        c.put_object("envkey", b"ok")
+        assert emu.objects["envkey"] == b"ok"
+
+
+class TestChunkerToS3:
+    def test_chunker_combined_file_lands_in_emulator(self, emu, tmp_path):
+        """Chunker e2e → S3: shards combine, the multipart upload rides
+        out an injected mid-upload fault, and the combined object lands in
+        the emulator (`chunk/main.go:349-421` shipped to the blob binding
+        the same way)."""
+        from distributed_crawler_tpu.chunk.chunker import Chunker
+        from distributed_crawler_tpu.state import LocalStateManager
+        from distributed_crawler_tpu.state.interface import (
+            LocalConfig,
+            StateConfig,
+        )
+
+        watch = str(tmp_path / "watch")
+        combine = str(tmp_path / "combine")
+        os.makedirs(watch)
+        for i in range(3):
+            with open(os.path.join(watch, f"s{i}.jsonl"), "w") as f:
+                for j in range(20):
+                    f.write(json.dumps({"s": i, "r": j, "pad": "x" * 64})
+                            + "\n")
+        expected_rows = 60
+
+        url = (f"s3://crawl/combined-store?endpoint={emu.endpoint}"
+               f"&access_key={ACCESS}&secret_key={SECRET}")
+        sm = LocalStateManager(StateConfig(
+            storage_root=str(tmp_path / "root"), crawl_id="s3e2e",
+            local=LocalConfig(base_path=str(tmp_path / "root")),
+            object_store_url=url))
+        # Small parts force the multipart path; one injected part fault.
+        from distributed_crawler_tpu.state.s3store import parse_s3_url
+        sm._object_uploader = ObjectStoreUploader(
+            parse_s3_url(url), part_size=1024, backoff_s=0.01)
+        emu.fail_next.append((r"partNumber=2&", 1))
+
+        chunker = Chunker(sm, str(tmp_path / "temp"), watch, combine,
+                          trigger_size=1, scan_interval_s=0.05)
+        chunker.start()
+        try:
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and not any(
+                    k.startswith("combined-store/combined/s3e2e/")
+                    for k in emu.objects):
+                time.sleep(0.05)
+        finally:
+            chunker.shutdown()
+        keys = [k for k in emu.objects
+                if k.startswith("combined-store/combined/s3e2e/")]
+        assert keys, "combined file never landed in the S3 emulator"
+        rows = b"".join(emu.objects[k] for k in sorted(keys))
+        assert rows.count(b"\n") == expected_rows
+        # The injected fault really happened and was ridden out.
+        part2 = [p for m, p in emu.request_log
+                 if m == "PUT" and "partNumber=2&" in p]
+        assert len(part2) >= 2
