@@ -1,0 +1,136 @@
+"""One-off TPU experiment: where does the other 69% go? (VERDICT r03 #2)
+
+Measures the bench config (E5-small fused embed+classify, seq 128) under
+controlled variants to find the MFU levers:
+
+  base-b256      current bench config (r03 measured MFU 0.3144)
+  b512           bigger batch (more M per GEMM)
+  flash-b256     Pallas flash attention at seq 128 (XLA path materializes
+                 the f32 [b,h,q,k] score tensor in HBM: ~200 MB/layer)
+  flash-b512     both
+  bf16p-b512     params cast to bf16 at load (half the weight HBM traffic)
+  flash+bf16-b512  everything
+
+Prints one JSON line per variant.  Run under an external timeout:
+    timeout 1200 python tools/exp_mfu.py
+Exit 3 = backend is not TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from distributed_crawler_tpu.models.encoder import (  # noqa: E402
+    E5_SMALL,
+    EmbedderClassifier,
+)
+
+SEQ = 128
+PEAK = 197e12  # v5e bf16
+
+
+def log(msg):
+    print(f"[exp] {msg}", file=sys.stderr, flush=True)
+
+
+def fwd_flops(cfg, batch, seq):
+    d, ff, L = cfg.hidden, cfg.mlp_dim, cfg.n_layers
+    return float(batch * seq * L * (8 * d * d + 4 * seq * d + 4 * d * ff))
+
+
+def t_iter_chained(model, params, ids, mask, vocab, n_short=5, n_long=25,
+                   repeats=3):
+    @jax.jit
+    def chained(p, ids, mask, n):
+        def body(_, ids):
+            emb, _ = model.apply(p, ids, mask)
+            delta = (emb[:, :1] * 1000).astype(jnp.int32) % vocab
+            return (ids + delta) % vocab
+        return jax.lax.fori_loop(0, n, body, ids)
+
+    t0 = time.perf_counter()
+    float(chained(params, ids, mask, 1).sum())
+    log(f"  compile+warmup {time.perf_counter() - t0:.1f}s")
+
+    def timed(n):
+        t0 = time.perf_counter()
+        float(chained(params, ids, mask, n).sum())
+        return time.perf_counter() - t0
+
+    for _ in range(3):
+        ts = min(timed(n_short) for _ in range(repeats))
+        tl = min(timed(n_long) for _ in range(repeats))
+        ti = (tl - ts) / (n_long - n_short)
+        if ti > 0:
+            return ti
+    raise RuntimeError("two-point fit stayed non-positive")
+
+
+def cast_params_bf16(params):
+    return jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16)
+        if hasattr(x, "dtype") and x.dtype == jnp.float32 else x, params)
+
+
+def main():
+    t0 = time.perf_counter()
+    x = jnp.ones((128, 128), jnp.bfloat16)
+    float(jax.jit(lambda a: (a @ a).sum())(x))
+    log(f"probe ok in {time.perf_counter() - t0:.1f}s "
+        f"backend={jax.default_backend()}")
+    if jax.default_backend() != "tpu":
+        sys.exit(3)
+
+    vocab = 250037  # real E5 vocab: keep the gather honest
+    base = replace(E5_SMALL, n_labels=8)
+    rng = np.random.default_rng(0)
+
+    variants = [
+        ("base-b256", base, 256, False),
+        ("b512", base, 512, False),
+        ("flash-b256", replace(base, attention="flash"), 256, False),
+        ("flash-b512", replace(base, attention="flash"), 512, False),
+        ("bf16p-b512", base, 512, True),
+        ("flash+bf16-b512", replace(base, attention="flash"), 512, True),
+        ("b1024", base, 1024, False),
+        ("flash+bf16-b1024", replace(base, attention="flash"), 1024, True),
+    ]
+    params_cache = {}
+    for name, cfg, batch, bf16p in variants:
+        log(f"{name}: building")
+        ids = jnp.asarray(rng.integers(0, vocab, size=(batch, SEQ)),
+                          jnp.int32)
+        mask = jnp.ones((batch, SEQ), jnp.bool_)
+        model = EmbedderClassifier(cfg)
+        key = (cfg.attention,)
+        if key not in params_cache:
+            params_cache[key] = EmbedderClassifier(base).init(
+                jax.random.PRNGKey(0), ids[:8], mask[:8])
+        params = params_cache[key]
+        if bf16p:
+            params = cast_params_bf16(params)
+        try:
+            ti = t_iter_chained(model, params, ids, mask, vocab)
+        except Exception as e:  # noqa: BLE001 — keep sweeping
+            print(json.dumps({"variant": name, "error": str(e)[:300]}),
+                  flush=True)
+            continue
+        mfu = fwd_flops(cfg, batch, SEQ) / ti / PEAK
+        print(json.dumps({
+            "variant": name, "batch": batch,
+            "t_iter_ms": round(ti * 1e3, 2),
+            "posts_per_sec": round(batch / ti, 1),
+            "mfu": round(mfu, 4)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
