@@ -65,6 +65,7 @@ PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "75"))
 PROBE_RETRY_COOLDOWN_S = int(os.environ.get("BENCH_PROBE_RETRY_S", "60"))
 CPU_FALLBACK_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT_S", "300"))
 ASR_TIMEOUT_S = int(os.environ.get("BENCH_ASR_TIMEOUT_S", "240"))
+XLMR_TIMEOUT_S = int(os.environ.get("BENCH_XLMR_TIMEOUT_S", "300"))
 
 
 def _log(msg: str) -> None:
@@ -96,6 +97,8 @@ def _cache_tpu_result(result: dict) -> None:
         entry["measured_at"] = now
         if result.get("asr_rtfx") is not None:
             entry["asr_measured_at"] = now
+        if result.get("xlmr_base_posts_per_sec") is not None:
+            entry["xlmr_measured_at"] = now
         with open(TPU_CACHE_PATH, "w", encoding="utf-8") as f:
             json.dump(entry, f)
     except OSError as exc:
@@ -108,6 +111,52 @@ def _load_tpu_cache() -> dict | None:
             return json.load(f)
     except (OSError, json.JSONDecodeError):
         return None
+
+
+def _chained_t_iter(model, params, ids, mask, vocab: int,
+                    n_short: int, n_long: int, repeats: int,
+                    label: str = "") -> float:
+    """Per-iteration time of the fused embed+classify step.
+
+    Two-point fit: total(N) = overhead + N * t_iter, so t_iter comes from
+    the difference and the RPC/readback overhead cancels.  Iterations are
+    chained through a data dependency (next ids derived from the previous
+    output) and closed with a host readback — plain block_until_ready can
+    return early through remote-execution relays, which would overstate
+    throughput ~100x.  The ONE timing methodology every bench leg uses.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def chained(p, ids, mask, n):
+        def body(_, ids):
+            emb, _logits = model.apply(p, ids, mask)
+            delta = (emb[:, :1] * 1000).astype(jnp.int32) % vocab
+            return (ids + delta) % vocab
+        return jax.lax.fori_loop(0, n, body, ids)
+
+    t0 = time.perf_counter()
+    float(chained(params, ids, mask, 1).sum())  # warmup + compile
+    _log(f"{label or 'model'} compile+warmup done in "
+         f"{time.perf_counter() - t0:.1f}s")
+
+    def timed(n: int) -> float:
+        t0 = time.perf_counter()
+        float(chained(params, ids, mask, n).sum())
+        return time.perf_counter() - t0
+
+    t_short = t_long = 0.0
+    for _ in range(3):  # scheduler noise can invert the two-point fit
+        t_short = min(timed(n_short) for _ in range(repeats))
+        t_long = min(timed(n_long) for _ in range(repeats))
+        t_iter = (t_long - t_short) / (n_long - n_short)
+        if t_iter > 0:
+            return t_iter
+        _log("two-point fit inverted (noise); re-measuring")
+    raise RuntimeError(
+        f"timing fit stayed non-positive (t_short={t_short:.4f}s, "
+        f"t_long={t_long:.4f}s): host too noisy for a measurement")
 
 
 def _encoder_forward_flops(cfg, batch: int, seq: int) -> float:
@@ -180,41 +229,8 @@ def _measure(scale_devices: int | None = None,
         ids, mask = placed["ids"], placed["mask"]
         _log(f"sharded over mesh {dict(mesh.shape)}")
 
-    def make_chained(m):
-        @jax.jit
-        def chained(p, ids, mask, n):
-            def body(_, ids):
-                emb, _logits = m.apply(p, ids, mask)
-                delta = (emb[:, :1] * 1000).astype(jnp.int32) % cfg.vocab_size
-                return (ids + delta) % cfg.vocab_size
-            return jax.lax.fori_loop(0, n, body, ids)
-        return chained
-
-    chained = make_chained(model)
-
-    t0 = time.perf_counter()
-    float(chained(params, ids, mask, 1).sum())  # warmup + compile
-    _log(f"compile+warmup done in {time.perf_counter() - t0:.1f}s")
-
-    def fit_t_iter(step_fn, p) -> float:
-        def timed(n: int) -> float:
-            t0 = time.perf_counter()
-            float(step_fn(p, ids, mask, n).sum())
-            return time.perf_counter() - t0
-
-        t_iter = 0.0
-        for _ in range(3):  # scheduler noise can invert the two-point fit
-            t_short = min(timed(n_short) for _ in range(repeats))
-            t_long = min(timed(n_long) for _ in range(repeats))
-            t_iter = (t_long - t_short) / (n_long - n_short)
-            if t_iter > 0:
-                return t_iter
-            _log("two-point fit inverted (noise); re-measuring")
-        raise RuntimeError(
-            f"timing fit stayed non-positive (t_short={t_short:.4f}s, "
-            f"t_long={t_long:.4f}s): host too noisy for a measurement")
-
-    t_iter = fit_t_iter(chained, params)
+    t_iter = _chained_t_iter(model, params, ids, mask, cfg.vocab_size,
+                             n_short, n_long, repeats, label="bf16")
     posts_per_sec = batch / t_iter
     _log(f"throughput: {posts_per_sec:.1f} posts/sec (t_iter={t_iter*1e3:.2f}ms)")
 
@@ -235,13 +251,9 @@ def _measure(scale_devices: int | None = None,
 
             qmodel = EmbedderClassifier(replace(cfg, quant="int8"))
             qparams = quantize_encoder_params(params)
-            chained_q = make_chained(qmodel)
-
-            t0 = time.perf_counter()
-            float(chained_q(qparams, ids, mask, 1).sum())
-            _log(f"int8 compile+warmup done in "
-                 f"{time.perf_counter() - t0:.1f}s")
-            t_iter_q = fit_t_iter(chained_q, qparams)
+            t_iter_q = _chained_t_iter(qmodel, qparams, ids, mask,
+                                       cfg.vocab_size, n_short, n_long,
+                                       repeats, label="int8")
             int8_pps = batch / t_iter_q
             _log(f"int8 throughput: {int8_pps:.1f} posts/sec "
                  f"(speedup {int8_pps / posts_per_sec:.2f}x)")
@@ -326,6 +338,76 @@ def _measure(scale_devices: int | None = None,
         "batch": batch,
         "seq": seq,
     }
+
+
+def _measure_xlmr_int8(batch: int = 256, seq: int = SEQ,
+                       n_short: int = 3, n_long: int = 12,
+                       repeats: int = 3) -> dict:
+    """BASELINE config #3 width: bf16 vs int8 at XLM-R-base.
+
+    `ops/quant.py` predicts int8 pays off once the projection GEMMs
+    dominate (hidden 768 vs E5-small's 384); this leg measures that claim
+    where BASELINE cares about it (VERDICT r03 #1).  Small vocab: the
+    embedding gather is width-independent and a 250k-row table adds ~20x
+    init time for zero timing signal.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from dataclasses import replace
+
+    from distributed_crawler_tpu.models.encoder import (
+        XLMR_BASE,
+        EmbedderClassifier,
+    )
+    from distributed_crawler_tpu.models.quant import quantize_encoder_params
+
+    vocab = 32768
+    cfg = replace(XLMR_BASE, vocab_size=vocab, n_labels=8)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, vocab, size=(batch, seq)), jnp.int32)
+    mask = jnp.ones((batch, seq), jnp.bool_)
+    model = EmbedderClassifier(cfg)
+    params = model.init(jax.random.PRNGKey(0), ids, mask)
+    _log("xlmr params initialized")
+
+    def fit(m, p, label):
+        return _chained_t_iter(m, p, ids, mask, vocab, n_short, n_long,
+                               repeats, label=f"xlmr {label}")
+
+    t_bf16 = fit(model, params, "bf16")
+    qmodel = EmbedderClassifier(replace(cfg, quant="int8"))
+    qparams = quantize_encoder_params(params)
+    t_int8 = fit(qmodel, qparams, "int8")
+    out = {
+        "xlmr_base_posts_per_sec": round(batch / t_bf16, 1),
+        "xlmr_base_int8_posts_per_sec": round(batch / t_int8, 1),
+        "xlmr_base_int8_speedup": round(t_bf16 / t_int8, 2),
+        "xlmr_batch": batch,
+    }
+    _log(f"xlmr: bf16 {batch / t_bf16:.1f} posts/s, "
+         f"int8 {batch / t_int8:.1f} posts/s "
+         f"(speedup {t_bf16 / t_int8:.2f}x)")
+    try:
+        # Static-scale variant (fused quantize): best-effort third cell.
+        from distributed_crawler_tpu.models.quant import (
+            calibrate_activation_scales,
+        )
+
+        calib_model = EmbedderClassifier(replace(cfg, calibrate=True))
+        scales = calibrate_activation_scales(calib_model, params,
+                                             ids[:64], mask[:64])
+        smodel = EmbedderClassifier(replace(cfg, quant="int8_static"))
+        sparams = quantize_encoder_params(params, act_scales=scales)
+        t_static = fit(smodel, sparams, "int8_static")
+        out["xlmr_base_int8_static_posts_per_sec"] = round(
+            batch / t_static, 1)
+        out["xlmr_base_int8_static_speedup"] = round(t_bf16 / t_static, 2)
+        _log(f"xlmr int8_static: {batch / t_static:.1f} posts/s "
+             f"(speedup {t_bf16 / t_static:.2f}x)")
+    except Exception as exc:  # noqa: BLE001 — best-effort row
+        _log(f"xlmr int8_static skipped: {exc}")
+    return out
 
 
 def _measure_asr(batch: int = 8, decode_len: int = 48,
@@ -470,7 +552,8 @@ def _try_child(argv: list, env: dict, timeout: int):
 
 
 def main() -> None:
-    if any(f in sys.argv for f in ("--child", "--asr", "--scale")):
+    if any(f in sys.argv for f in ("--child", "--asr", "--scale",
+                                   "--xlmr")):
         # Persistent XLA cache: repeat benches skip the 10-30 s compiles,
         # shrinking each child's time-on-chip (less exposure to the
         # intermittent wedge).  Compile time is excluded from the timing
@@ -498,6 +581,9 @@ def main() -> None:
         return
     if "--asr" in sys.argv:
         print(json.dumps(_measure_asr()), flush=True)
+        return
+    if "--xlmr" in sys.argv:
+        print(json.dumps(_measure_xlmr_int8()), flush=True)
         return
     if "--scale" in sys.argv:
         # dp-scaling rows run on virtual CPU devices — keep them light so
@@ -582,6 +668,15 @@ def main() -> None:
             result.update(asr)
         else:
             _log(f"asr row skipped: {aerr}")
+        # BASELINE config #3 width: int8-vs-bf16 at XLM-R-base (VERDICT
+        # r03 #1's done-criterion) — own child, own budget.
+        _log(f"measuring XLM-R int8 row (timeout {XLMR_TIMEOUT_S}s)")
+        xlmr, xerr = _try_child(["--xlmr"], dict(os.environ),
+                                XLMR_TIMEOUT_S)
+        if xlmr is not None:
+            result.update(xlmr)
+        else:
+            _log(f"xlmr row skipped: {xerr}")
 
     _cache_tpu_result(result)
     if "asr_rtfx" not in result:
@@ -595,6 +690,16 @@ def main() -> None:
                     result[k] = cached[k]
             result["asr_from_cache_measured_at"] = cached.get(
                 "asr_measured_at", cached.get("measured_at"))
+    if "xlmr_base_posts_per_sec" not in result:
+        cached = _load_tpu_cache() or {}
+        if "xlmr_base_posts_per_sec" in cached:
+            for k in ("xlmr_base_posts_per_sec",
+                      "xlmr_base_int8_posts_per_sec",
+                      "xlmr_base_int8_speedup", "xlmr_batch"):
+                if k in cached:
+                    result[k] = cached[k]
+            result["xlmr_from_cache_measured_at"] = cached.get(
+                "xlmr_measured_at", cached.get("measured_at"))
     _log("measuring dp sharding overhead on virtual CPU mesh")
     eff = _dp_sharding_overhead()
     # Work-normalized (same batch, same host cores, 1 vs 8 virtual CPU

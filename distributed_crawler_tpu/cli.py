@@ -169,9 +169,11 @@ def build_parser() -> argparse.ArgumentParser:
       help="cast float params at engine startup (e.g. bfloat16) — halves "
            "weight HBM traffic when serving; empty keeps the f32 layout")
     a("--infer-quantize", default=None,
-      help="quantize the projection GEMMs at engine startup ('int8' runs "
-           "them int8*int8->int32 on the MXU at 2x bf16 peak; empty keeps "
-           "the float path; train-head always ignores this)")
+      help="quantize the projection GEMMs at engine startup ('int8' = "
+           "dynamic per-token activation scales; 'int8_static' = "
+           "calibrated per-tensor scales that fuse the quantize into the "
+           "producer epilogue; empty keeps the float path; train-head "
+           "always ignores this)")
     # Classifier fine-tune (mode=train-head): crawl JSONL + labels ->
     # orbax checkpoint the engine reloads via --head-checkpoint.
     a("--train-posts", default=None,

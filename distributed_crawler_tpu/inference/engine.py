@@ -171,12 +171,35 @@ class InferenceEngine:
                 if hasattr(x, "dtype") and x.dtype == jnp.float32 else x,
                 params)
         if cfg.quantize:
-            if cfg.quantize != "int8":
+            if cfg.quantize not in ("int8", "int8_static"):
                 raise ValueError(f"unknown quantize mode {cfg.quantize!r}")
             from ..models.quant import quantize_encoder_params
 
-            params = quantize_encoder_params(params)
-            self.ecfg = replace(self.ecfg, quant="int8")
+            act_scales = None
+            if cfg.quantize == "int8_static":
+                # Calibrate per-projection activation scales on one float
+                # forward over a token batch drawn from the tokenizer's id
+                # range (operators wanting text-matched scales can warm the
+                # float engine first and pass a checkpoint; abs-max over a
+                # wide random batch is a serviceable default because the
+                # encoder's LN-bounded activations vary little with input).
+                import jax as _jax
+                import jax.numpy as jnp
+
+                from ..models.quant import calibrate_activation_scales
+
+                probe_len = self.bucket_spec.lengths[-1]
+                probe_ids = _jax.random.randint(
+                    _jax.random.PRNGKey(cfg.seed + 1),
+                    (min(cfg.batch_size, 64), probe_len), 0,
+                    self.ecfg.vocab_size)
+                probe_mask = jnp.ones_like(probe_ids, dtype=jnp.bool_)
+                calib_model = EmbedderClassifier(
+                    replace(self.ecfg, calibrate=True))
+                act_scales = calibrate_activation_scales(
+                    calib_model, params, probe_ids, probe_mask)
+            params = quantize_encoder_params(params, act_scales=act_scales)
+            self.ecfg = replace(self.ecfg, quant=cfg.quantize)
             self.ecfg.validate()
             self.model = EmbedderClassifier(self.ecfg)
         if mesh is not None:

@@ -57,7 +57,15 @@ class EncoderConfig:
     # half the weight HBM traffic).  Params must be in the quantized layout
     # (`models/quant.quantize_encoder_params` converts a float checkpoint);
     # serving-only — training always "none".
+    # "int8_static": same, with CALIBRATED per-tensor activation scales
+    # (`models/quant.calibrate_activation_scales`) instead of dynamic
+    # per-token abs-max — the quantize fuses into the producer epilogue,
+    # removing one full activation HBM round-trip per projection
+    # (`ops/quant.quantize_activations_static`).  MoE experts stay dynamic.
     quant: str = "none"
+    # True (with quant="none"): sow per-projection input abs-max into the
+    # "calib" collection so `calibrate_activation_scales` can read them.
+    calibrate: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -71,8 +79,11 @@ class EncoderConfig:
         if self.hidden % self.n_heads != 0:
             raise ValueError(
                 f"hidden {self.hidden} not divisible by heads {self.n_heads}")
-        if self.quant not in ("none", "int8"):
+        if self.quant not in ("none", "int8", "int8_static"):
             raise ValueError(f"unknown quant mode {self.quant!r}")
+        if self.calibrate and self.quant != "none":
+            raise ValueError("calibrate requires the float path "
+                             "(quant='none')")
 
 
 # Published configs (sizes match the HF checkpoints these mirror).
@@ -96,7 +107,8 @@ class QuantDense(nn.Module):
     ``bias`` f32 [out] — produced from a float checkpoint by
     `models/quant.quantize_encoder_params`, never trained directly (the
     zeros/ones initializers only exist so `.init()` yields the right
-    shapes for shape-driven code paths)."""
+    shapes for shape-driven code paths).  In ``int8_static`` configs an
+    ``a_scale`` scalar (calibrated activation scale) joins the layout."""
 
     features: int
     cfg: EncoderConfig
@@ -110,16 +122,33 @@ class QuantDense(nn.Module):
                            (self.features,), jnp.float32)
         bias = self.param("bias", nn.initializers.zeros,
                           (self.features,), jnp.float32)
-        return int8_dense(x, w_q, scale, bias, out_dtype=self.cfg.adtype)
+        a_scale = None
+        if self.cfg.quant == "int8_static":
+            a_scale = self.param("a_scale", nn.initializers.ones,
+                                 (), jnp.float32)
+        return int8_dense(x, w_q, scale, bias, out_dtype=self.cfg.adtype,
+                          a_scale=a_scale)
 
 
 def _proj(cfg: EncoderConfig, features: int, name: str):
     """Projection layer: bf16 `nn.Dense` or its int8 twin, same name so
     the sharding rules and checkpoint paths stay stable."""
-    if cfg.quant == "int8":
+    if cfg.quant in ("int8", "int8_static"):
         return QuantDense(features, cfg, name=name)
     return nn.Dense(features, dtype=cfg.adtype, param_dtype=jnp.float32,
                     name=name)
+
+
+def _sow_absmax(module: nn.Module, cfg: EncoderConfig, name: str, x):
+    """Calibration hook: record the projection input's abs-max in the
+    "calib" collection (reduced with max across calls/batches) under
+    ``<projection>_in`` — suffixed because a sow name may not collide
+    with a submodule name in flax's namespace."""
+    if cfg.calibrate:
+        module.sow("calib", f"{name}_in",
+                   jnp.max(jnp.abs(x.astype(jnp.float32))),
+                   reduce_fn=jnp.maximum,
+                   init_fn=lambda: jnp.float32(0))
 
 
 class SelfAttention(nn.Module):
@@ -134,15 +163,21 @@ class SelfAttention(nn.Module):
         # 128x128 MXU tiles; the kernel keeps q/k/v on a dedicated axis so
         # tp-sharding the LAST axis stays head-aligned (no projection is
         # ever split across devices).
-        if cfg.quant == "int8":
+        if cfg.quant in ("int8", "int8_static"):
             w_q = self.param("qkv/kernel_q", nn.initializers.zeros,
                              (cfg.hidden, 3, cfg.hidden), jnp.int8)
             scale = self.param("qkv/scale", nn.initializers.ones,
                                (3, cfg.hidden), jnp.float32)
             bias = self.param("qkv/bias", nn.initializers.zeros,
                               (3, cfg.hidden), jnp.float32)
-            proj = int8_qkv(x, w_q, scale, bias, out_dtype=cfg.adtype)
+            a_scale = None
+            if cfg.quant == "int8_static":
+                a_scale = self.param("qkv/a_scale", nn.initializers.ones,
+                                     (), jnp.float32)
+            proj = int8_qkv(x, w_q, scale, bias, out_dtype=cfg.adtype,
+                            a_scale=a_scale)
         else:
+            _sow_absmax(self, cfg, "qkv", x)
             w = self.param(
                 "qkv/kernel",
                 nn.initializers.variance_scaling(1.0, "fan_in",
@@ -159,6 +194,7 @@ class SelfAttention(nn.Module):
         use_flash = {"auto": None, "xla": False, "flash": True}[cfg.attention]
         o = mha(q, k, v, kv_mask=mask, use_flash=use_flash)
         o = o.reshape(b, l, cfg.hidden)
+        _sow_absmax(self, cfg, "attn_out", o)
         return _proj(cfg, cfg.hidden, "attn_out")(o)
 
 
@@ -168,10 +204,12 @@ class DenseMLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
+        _sow_absmax(self, cfg, "mlp_up", x)
         h = _proj(cfg, cfg.mlp_dim, "mlp_up")(x)
         # Exact (erf) GELU: parity with published BERT/RoBERTa checkpoints;
         # XLA fuses erf into the matmul epilogue so tanh-approx buys nothing.
         h = nn.gelu(h, approximate=False)
+        _sow_absmax(self, cfg, "mlp_down", h)
         return _proj(cfg, cfg.hidden, "mlp_down")(h)
 
 
@@ -191,7 +229,11 @@ class SwitchMoE(nn.Module):
         probs = jax.nn.softmax(gate, axis=-1)           # [B, L, E]
         top = jnp.argmax(probs, axis=-1)                # [B, L]
         onehot = jax.nn.one_hot(top, e, dtype=cfg.adtype)
-        if cfg.quant == "int8":
+        # int8_static uses the DYNAMIC expert path: per-(token, expert)
+        # activation stats vary too much for one static scale, and the
+        # expert GEMMs' dispatch einsum can't host the fused quantize
+        # anyway.
+        if cfg.quant in ("int8", "int8_static"):
             w_up_q = self.param("experts_up/kernel_q", nn.initializers.zeros,
                                 (e, h, m), jnp.int8)
             s_up = self.param("experts_up/scale", nn.initializers.ones,

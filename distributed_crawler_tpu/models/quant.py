@@ -18,7 +18,7 @@ result back over a training checkpoint.
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -40,12 +40,57 @@ def _quantize_dense(mod: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
-def quantize_encoder_params(params: Any) -> Any:
+def calibrate_activation_scales(model, params, ids, mask) -> Dict[str, Any]:
+    """Run one float forward with calibration sows enabled and return the
+    "calib" collection: per-projection input abs-max values, shaped like
+    the module tree (``layers_i/attn/qkv`` → ``(absmax,)``).
+
+    ``model`` must be built from a config with ``calibrate=True`` (and
+    ``quant="none"``); feed a REPRESENTATIVE batch — the scales clip
+    whatever exceeds them at serving time.
+    """
+    _out, state = model.apply(params, ids, mask, mutable=["calib"])
+    return state["calib"]
+
+
+def _calib_value(calib: Optional[Dict[str, Any]], layer: str, holder: str,
+                 name: str) -> Any:
+    """Fish one sown abs-max out of the calib tree; None when absent."""
+    if calib is None:
+        return None
+    node = calib
+    for key in ("encoder", layer, holder):
+        if not isinstance(node, dict) or key not in node:
+            # Bare-encoder trees have no "encoder" level.
+            if key == "encoder":
+                continue
+            return None
+        node = node[key]
+    val = node.get(f"{name}_in") if isinstance(node, dict) else None
+    if val is None:
+        return None
+    if isinstance(val, (tuple, list)):  # sow reduce keeps a 1-tuple
+        val = val[0]
+    return val
+
+
+def _act_scale(absmax) -> jnp.ndarray:
+    """Calibrated abs-max → static activation scale (x ≈ x_q * scale)."""
+    return jnp.maximum(jnp.asarray(absmax, jnp.float32), 1e-8) / 127.0
+
+
+def quantize_encoder_params(params: Any,
+                            act_scales: Optional[Dict[str, Any]] = None
+                            ) -> Any:
     """Return a new param tree with the projection GEMMs int8-quantized.
 
     Accepts the usual ``{"params": {...}}`` wrapper or a bare tree; the
     encoder may sit at top level or under ``encoder`` (Embedder/Classifier
     wrappers).  Idempotent on already-quantized trees.
+
+    ``act_scales`` (a `calibrate_activation_scales` result) switches the
+    layout to ``int8_static``: each projection additionally carries its
+    calibrated scalar ``a_scale``.
     """
     from flax.core import unfreeze
 
@@ -55,6 +100,9 @@ def quantize_encoder_params(params: Any) -> Any:
     tree = dict(tree)
     enc_key = "encoder" if "encoder" in tree else None
     enc = dict(tree[enc_key]) if enc_key else tree
+    calib = None
+    if act_scales is not None:
+        calib = unfreeze(act_scales)
 
     for name, layer in list(enc.items()):
         if not name.startswith("layers_"):
@@ -69,6 +117,9 @@ def quantize_encoder_params(params: Any) -> Any:
             attn["qkv/kernel_q"] = w_q          # [h, 3, h] int8
             attn["qkv/scale"] = scale           # [3, h] f32
             attn["qkv/bias"] = jnp.asarray(attn["qkv/bias"], jnp.float32)
+            absmax = _calib_value(calib, name, "attn", "qkv")
+            if absmax is not None:
+                attn["qkv/a_scale"] = _act_scale(absmax)
         for holder_name in ("attn", "mlp"):
             holder = layer.get(holder_name)
             if not isinstance(holder, dict):
@@ -77,6 +128,10 @@ def quantize_encoder_params(params: Any) -> Any:
                 mod = holder.get(mod_name)
                 if isinstance(mod, dict) and "kernel" in mod:
                     holder[mod_name] = _quantize_dense(mod)
+                    absmax = _calib_value(calib, name, holder_name,
+                                          mod_name)
+                    if absmax is not None:
+                        holder[mod_name]["a_scale"] = _act_scale(absmax)
         moe = layer.get("moe")
         if isinstance(moe, dict):
             # Expert kernels [e, in, out] contract their MIDDLE axis, so

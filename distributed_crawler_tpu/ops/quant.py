@@ -74,15 +74,39 @@ def quantize_activations(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return x_q, a_scale
 
 
+def quantize_activations_static(x: jax.Array, a_scale: jax.Array
+                                ) -> jax.Array:
+    """Static symmetric int8 quantization with a calibrated per-tensor
+    scale (``x ≈ x_q * a_scale``).
+
+    The point vs the dynamic path is FUSION, not arithmetic: a dynamic
+    scale depends on a full abs-max reduction of ``x``, so XLA must
+    materialize ``x`` to HBM, reduce it, then read it again to quantize —
+    one extra round-trip per projection.  A static scale is data-
+    independent, so the multiply/round/clip fuses into the producer's
+    epilogue and the GEMM reads int8 straight away.  Calibrate with
+    `models/quant.calibrate_activation_scales`.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    return jnp.clip(jnp.round(x / a_scale), -_QMAX, _QMAX).astype(jnp.int8)
+
+
 def int8_dense(x: jax.Array, w_q: jax.Array, w_scale: jax.Array,
                bias: Optional[jax.Array] = None,
-               out_dtype: jnp.dtype = jnp.bfloat16) -> jax.Array:
+               out_dtype: jnp.dtype = jnp.bfloat16,
+               a_scale: Optional[jax.Array] = None) -> jax.Array:
     """``x @ w`` with both sides int8, int32 accumulation, f32 dequant.
 
     x: [..., in] float; w_q: [in, out] int8; w_scale: [out] f32;
     bias: [out] f32 or None.  Returns [..., out] in ``out_dtype``.
+    ``a_scale``: a calibrated scalar switches activation quantization
+    from dynamic per-token to static per-tensor (fuses into the producer;
+    see `quantize_activations_static`).
     """
-    x_q, a_scale = quantize_activations(x)
+    if a_scale is not None:
+        x_q = quantize_activations_static(x, a_scale)
+    else:
+        x_q, a_scale = quantize_activations(x)
     acc = jax.lax.dot_general(
         x_q, w_q,
         dimension_numbers=(((x_q.ndim - 1,), (0,)), ((), ())),
@@ -122,19 +146,26 @@ def int8_experts_down(h: jax.Array, w_q: jax.Array, w_scale: jax.Array,
 
 def int8_qkv(x: jax.Array, w_q: jax.Array, w_scale: jax.Array,
              bias: Optional[jax.Array] = None,
-             out_dtype: jnp.dtype = jnp.bfloat16) -> jax.Array:
+             out_dtype: jnp.dtype = jnp.bfloat16,
+             a_scale: Optional[jax.Array] = None) -> jax.Array:
     """Fused QKV projection, int8: [..., h] × [h, 3, h] → [..., 3, h].
 
     Mirrors the bf16 einsum ``blh,hto->blto`` in
     `models/encoder.SelfAttention` — q/k/v on the middle output axis so
     tp-sharding the last axis stays head-aligned.  w_scale/bias: [3, h].
+    ``a_scale``: calibrated scalar → static activation quantization.
     """
-    x_q, a_scale = quantize_activations(x)
+    if a_scale is not None:
+        x_q = quantize_activations_static(x, a_scale)
+        dequant = a_scale
+    else:
+        x_q, a_scale_dyn = quantize_activations(x)
+        dequant = a_scale_dyn[..., None]
     acc = jax.lax.dot_general(
         x_q, w_q,
         dimension_numbers=(((x_q.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)        # [..., 3, h] int32
-    out = acc.astype(jnp.float32) * a_scale[..., None] * w_scale
+    out = acc.astype(jnp.float32) * dequant * w_scale
     if bias is not None:
         out = out + bias
     return out.astype(out_dtype)

@@ -55,6 +55,30 @@ class TestAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=1e-5, rtol=1e-5)
 
+    def test_flash_matches_reference_bench_shape(self):
+        """The serving-bench geometry (seq 128, head_dim 32, bf16): parity
+        within bf16 tolerance so the short-seq flash policy is safe."""
+        q, k, v, mask = _inputs(b=3, l=128, h=4, d=32, seed=7)
+        q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
+        ref = attend(q, k, v, mask)
+        out = flash_attention(q, k, v, mask, block_q=128, interpret=True)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            atol=2e-2, rtol=2e-2)
+
+    def test_flash_fully_masked_row_zeros(self):
+        """A fully-padded sequence must come out all-zero (matching
+        attend's masked-softmax convention), not NaN."""
+        q, k, v, mask = _inputs(b=2, l=64, h=2, d=16)
+        mask = mask.at[1, :].set(False)
+        out = flash_attention(q, k, v, mask, block_q=32, interpret=True)
+        got = np.asarray(out)
+        assert np.isfinite(got).all()
+        np.testing.assert_allclose(got[1], 0.0, atol=1e-6)
+        ref = np.asarray(attend(q, k, v, mask))
+        np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
+
     def test_flash_indivisible_block_raises(self):
         q, k, v, mask = _inputs(l=48)
         with pytest.raises(ValueError):

@@ -133,6 +133,7 @@ class TestModelParity:
         assert enc["attn"]["qkv/kernel_q"].dtype == jnp.int8
         assert enc["mlp"]["mlp_up"]["kernel_q"].dtype == jnp.int8
 
+
     def test_moe_quantized_model_tracks_float(self):
         from dataclasses import replace
 
@@ -185,6 +186,96 @@ class TestModelParity:
             "encoder/layers_0/moe/experts_up/scale", ENCODER_PARAM_RULES))
 
 
+class TestStaticActivationScales:
+    """int8_static: calibrated per-tensor activation scales (VERDICT r03
+    #1's prescribed attack on the dynamic-requant overhead)."""
+
+    @pytest.fixture(scope="class")
+    def setup(self):
+        from dataclasses import replace
+
+        from distributed_crawler_tpu.models.quant import (
+            calibrate_activation_scales,
+        )
+
+        cfg = TINY_TEST
+        model = EmbedderClassifier(cfg)
+        ids = jax.random.randint(jax.random.PRNGKey(3), (4, 16), 0,
+                                 cfg.vocab_size)
+        mask = jnp.ones((4, 16), jnp.bool_)
+        params = model.init(jax.random.PRNGKey(0), ids, mask)
+        emb_f, logits_f = model.apply(params, ids, mask)
+        calib_model = EmbedderClassifier(replace(cfg, calibrate=True))
+        scales = calibrate_activation_scales(calib_model, params, ids, mask)
+        sparams = quantize_encoder_params(params, act_scales=scales)
+        return cfg, params, sparams, ids, mask, emb_f, logits_f
+
+    def test_calibration_collects_all_projections(self, setup):
+        cfg, params, _, ids, mask, _, _ = setup
+        from dataclasses import replace
+
+        from distributed_crawler_tpu.models.quant import (
+            calibrate_activation_scales,
+        )
+
+        calib_model = EmbedderClassifier(replace(cfg, calibrate=True))
+        scales = calibrate_activation_scales(calib_model, params, ids, mask)
+        layer0 = scales["encoder"]["layers_0"]
+        assert set(layer0["attn"]) == {"qkv_in", "attn_out_in"}
+        assert set(layer0["mlp"]) == {"mlp_up_in", "mlp_down_in"}
+        val = layer0["attn"]["qkv_in"]
+        val = val[0] if isinstance(val, (tuple, list)) else val
+        assert float(val) > 0
+
+    def test_static_params_carry_a_scale(self, setup):
+        _, _, sparams, _, _, _, _ = setup
+        enc = sparams["params"]["encoder"]["layers_0"]
+        assert enc["attn"]["qkv/a_scale"].shape == ()
+        assert enc["attn"]["attn_out"]["a_scale"].shape == ()
+        assert enc["mlp"]["mlp_up"]["a_scale"].shape == ()
+        assert enc["mlp"]["mlp_down"]["a_scale"].shape == ()
+
+    def test_static_model_tracks_float(self, setup):
+        from dataclasses import replace
+
+        cfg, _, sparams, ids, mask, emb_f, logits_f = setup
+        smodel = EmbedderClassifier(replace(cfg, quant="int8_static"))
+        emb_s, logits_s = smodel.apply(sparams, ids, mask)
+        for r in range(emb_f.shape[0]):
+            assert _cos(emb_s[r], emb_f[r]) > 0.97
+        assert _cos(logits_s, logits_f) > 0.93
+
+    def test_static_shapes_match_static_init(self, setup):
+        from dataclasses import replace
+
+        cfg, _, sparams, ids, mask, _, _ = setup
+        sinit = EmbedderClassifier(replace(cfg, quant="int8_static")).init(
+            jax.random.PRNGKey(0), ids, mask)
+        flat_got = jax.tree_util.tree_flatten_with_path(sparams)[0]
+        flat_want = jax.tree_util.tree_flatten_with_path(sinit)[0]
+        assert [p for p, _ in flat_got] == [p for p, _ in flat_want]
+        for (p, got), (_, want) in zip(flat_got, flat_want):
+            assert got.shape == want.shape, p
+            assert got.dtype == want.dtype, p
+
+    def test_calibrate_requires_float_path(self):
+        from dataclasses import replace
+
+        with pytest.raises(ValueError, match="calibrate"):
+            replace(TINY_TEST, calibrate=True, quant="int8").validate()
+
+    def test_static_primitive_matches_dynamic_closely(self):
+        from distributed_crawler_tpu.ops.quant import (
+            quantize_activations_static,
+        )
+
+        x = jax.random.normal(jax.random.PRNGKey(5), (16, 64))
+        a_scale = jnp.max(jnp.abs(x)) / 127.0
+        x_q = quantize_activations_static(x, a_scale)
+        deq = x_q.astype(jnp.float32) * a_scale
+        assert float(jnp.max(jnp.abs(deq - x))) <= float(a_scale) * 0.5 + 1e-6
+
+
 class TestEngine:
     def test_engine_int8_end_to_end(self):
         from distributed_crawler_tpu.inference.engine import (
@@ -222,6 +313,46 @@ class TestEngine:
         emb_q = e_q.embed(texts)
         for r in range(len(texts)):
             assert _cos(emb_f[r], emb_q[r]) > 0.98
+
+    def test_engine_int8_static_end_to_end(self):
+        """int8_static: the engine calibrates at startup and serves with
+        fused static activation quantization."""
+        from distributed_crawler_tpu.inference.engine import (
+            EngineConfig,
+            InferenceEngine,
+        )
+        from distributed_crawler_tpu.utils.metrics import MetricsRegistry
+
+        cfg = EngineConfig(model="tiny", batch_size=4, buckets=(32,),
+                           quantize="int8_static")
+        eng = InferenceEngine(cfg, registry=MetricsRegistry())
+        assert eng.ecfg.quant == "int8_static"
+        enc = eng.params["params"]["encoder"]["layers_0"]
+        assert enc["attn"]["qkv/a_scale"].shape == ()
+        assert float(enc["mlp"]["mlp_up"]["a_scale"]) > 0
+        out = eng.run(["static scales", "fused quantize"])
+        assert len(out) == 2
+        for r in out:
+            assert abs(np.linalg.norm(r["embedding"]) - 1.0) < 1e-3
+
+    def test_engine_int8_static_matches_float(self):
+        from dataclasses import replace as dreplace
+
+        from distributed_crawler_tpu.inference.engine import (
+            EngineConfig,
+            InferenceEngine,
+        )
+        from distributed_crawler_tpu.utils.metrics import MetricsRegistry
+
+        texts = ["a post about cats", "completely different text"]
+        base = EngineConfig(model="tiny", batch_size=4, buckets=(32,))
+        e_f = InferenceEngine(base, registry=MetricsRegistry())
+        e_s = InferenceEngine(dreplace(base, quantize="int8_static"),
+                              registry=MetricsRegistry())
+        emb_f = e_f.embed(texts)
+        emb_s = e_s.embed(texts)
+        for r in range(len(texts)):
+            assert _cos(emb_f[r], emb_s[r]) > 0.97
 
     def test_engine_rejects_unknown_mode(self):
         from distributed_crawler_tpu.inference.engine import (

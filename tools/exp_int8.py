@@ -29,6 +29,7 @@ from distributed_crawler_tpu.models.encoder import (  # noqa: E402
     EmbedderClassifier,
 )
 from distributed_crawler_tpu.models.quant import (  # noqa: E402
+    calibrate_activation_scales,
     quantize_encoder_params,
 )
 
@@ -108,6 +109,19 @@ def main():
                           "t_iter_ms": round(tq * 1e3, 2),
                           "posts_per_sec": round(batch / tq, 1),
                           "speedup_vs_bf16": round(ti / tq, 3)}), flush=True)
+        # Static activation scales: the fused-quantize variant.
+        calib_model = EmbedderClassifier(replace(cfg, calibrate=True))
+        scales = calibrate_activation_scales(calib_model, params,
+                                             ids[:64], mask[:64])
+        smodel = EmbedderClassifier(replace(cfg, quant="int8_static"))
+        sparams = quantize_encoder_params(params, act_scales=scales)
+        ts = t_iter_chained(smodel, sparams, ids, mask, VOCAB)
+        print(json.dumps({"cfg": name, "quant": "int8_static",
+                          "batch": batch,
+                          "t_iter_ms": round(ts * 1e3, 2),
+                          "posts_per_sec": round(batch / ts, 1),
+                          "speedup_vs_bf16": round(ti / ts, 3)}),
+              flush=True)
 
 
 if __name__ == "__main__":
