@@ -695,7 +695,9 @@ def main() -> None:
         if "xlmr_base_posts_per_sec" in cached:
             for k in ("xlmr_base_posts_per_sec",
                       "xlmr_base_int8_posts_per_sec",
-                      "xlmr_base_int8_speedup", "xlmr_batch"):
+                      "xlmr_base_int8_speedup",
+                      "xlmr_base_int8_static_posts_per_sec",
+                      "xlmr_base_int8_static_speedup", "xlmr_batch"):
                 if k in cached:
                     result[k] = cached[k]
             result["xlmr_from_cache_measured_at"] = cached.get(

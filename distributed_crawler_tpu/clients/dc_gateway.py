@@ -207,10 +207,20 @@ class DcGateway:
 
     def close(self) -> None:
         self._stop.set()
+        # shutdown() BEFORE close(): a close alone doesn't wake a thread
+        # blocked in accept() — the in-flight syscall pins the open file
+        # description and the port stays in LISTEN forever (no rebind on
+        # restart).  shutdown aborts the accept immediately.
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass  # not listening yet / already closed
         try:
             self._sock.close()
         except OSError:
             pass
+        if self._accept_thread.is_alive():
+            self._accept_thread.join(timeout=2.0)
         for conn in self._live_conns:  # kill live sessions, not just accept
             try:
                 conn.shutdown(socket.SHUT_RDWR)

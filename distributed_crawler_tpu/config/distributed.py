@@ -32,8 +32,10 @@ class BusConfig:
     max_frame_bytes: int = 201 * 1024 * 1024  # daprstate.go:108-110 parity
 
 
-VALID_MODES = ("", "standalone", "distributed-standalone", "orchestrator", "worker",
-               "tpu-worker", "job")
+VALID_MODES = ("", "standalone", "distributed-standalone", "launch",
+               "orchestrator", "worker", "tpu-worker", "job", "job-submit",
+               "bus", "train-head", "cluster", "transcribe", "dc-gateway",
+               "gen-code")
 
 
 @dataclass

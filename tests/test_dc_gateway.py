@@ -321,6 +321,58 @@ class TestRemotePoolFromConfig:
             gw.close()
 
 
+class TestGatewayRestartResilience:
+    def test_pool_recreates_after_gateway_restart(self, tmp_path):
+        """Gateway dies mid-session → calls fail fast; after it returns on
+        the same port, pool.recreate() dials and re-authenticates (the
+        reference's connection error-recreate path,
+        `connection_pool.go:346-413`, at the wire level)."""
+        from distributed_crawler_tpu.clients.pool import ConnectionPool
+
+        gw = DcGateway(seed_json=SEED, expected_code="11").start()
+        port = gw.port
+        creds = {"api_id": "1", "api_hash": "", "phone_number": "+1555",
+                 "phone_code": "11", "password": ""}
+        factory = native_client_factory(
+            server_addr=gw.address, credentials=creds)
+        pool = ConnectionPool(factory, database_urls=[gw.address])
+        assert pool.initialize() == 1
+        conn = pool.acquire()
+        try:
+            assert conn.client.search_public_chat("gwchan").id == 777
+        finally:
+            pool.release(conn)
+        gw.close()  # yank the server
+        conn = pool.acquire()
+        with pytest.raises(TelegramError):
+            conn.client.search_public_chat("gwchan")
+        # Close the dead client so its half-open socket finishes the TCP
+        # teardown — otherwise the server port sits in FIN_WAIT2 for
+        # tcp_fin_timeout and the restart below can't bind.
+        conn.client.close()
+        # Gateway returns on the SAME port (bind retries while the dead
+        # server's sockets drain); recreate dials + re-auths.
+        deadline = time.time() + 15
+        while True:
+            try:
+                gw2 = DcGateway(seed_json=SEED, expected_code="11",
+                                port=port).start()
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.3)
+        try:
+            # Still holding the broken conn from above: recreate in place.
+            fresh = pool.recreate(conn)
+            assert fresh.client.search_public_chat("gwchan").id == 777
+            pool.release(fresh)
+            assert gw2.auth_successes == 1
+        finally:
+            pool.close_all()
+            gw2.close()
+
+
 @pytest.mark.skipif(shutil.which("openssl") is None,
                     reason="openssl binary needed for the TLS leg")
 class TestTwoProcessE2E:

@@ -49,30 +49,11 @@ def fwd_flops(cfg, batch, seq):
 
 def t_iter_chained(model, params, ids, mask, vocab, n_short=5, n_long=25,
                    repeats=3):
-    @jax.jit
-    def chained(p, ids, mask, n):
-        def body(_, ids):
-            emb, _ = model.apply(p, ids, mask)
-            delta = (emb[:, :1] * 1000).astype(jnp.int32) % vocab
-            return (ids + delta) % vocab
-        return jax.lax.fori_loop(0, n, body, ids)
+    # The bench's single timing methodology — imported, not copied.
+    from bench import _chained_t_iter
 
-    t0 = time.perf_counter()
-    float(chained(params, ids, mask, 1).sum())
-    log(f"  compile+warmup {time.perf_counter() - t0:.1f}s")
-
-    def timed(n):
-        t0 = time.perf_counter()
-        float(chained(params, ids, mask, n).sum())
-        return time.perf_counter() - t0
-
-    for _ in range(3):
-        ts = min(timed(n_short) for _ in range(repeats))
-        tl = min(timed(n_long) for _ in range(repeats))
-        ti = (tl - ts) / (n_long - n_short)
-        if ti > 0:
-            return ti
-    raise RuntimeError("two-point fit stayed non-positive")
+    return _chained_t_iter(model, params, ids, mask, vocab,
+                           n_short, n_long, repeats, label="exp")
 
 
 def cast_params_bf16(params):
