@@ -67,6 +67,77 @@ class TransientStoreError(Exception):
     """Retryable failure (network blip, 5xx) — the uploader retries these."""
 
 
+class KeepAliveHttpTransport:
+    """Shared HTTP plumbing for the cloud adapters (s3store, azurestore).
+
+    One persistent keep-alive connection per client, serialized by a lock:
+    a 170 MiB multipart upload is ~34 parts and a TLS handshake per part
+    would dominate the upload hot path.  Any transport error drops the
+    connection (the uploader's retry gets a fresh one) and surfaces as
+    :class:`TransientStoreError`.
+    """
+
+    def __init__(self, host: str, tls: bool, timeout_s: float,
+                 scheme_name: str):
+        self._host = host
+        self._tls = tls
+        self._timeout_s = timeout_s
+        self._scheme_name = scheme_name
+        self._lock = threading.Lock()
+        self._conn = None
+
+    def http_request(self, method: str, url: str, body: bytes,
+                     headers: Dict[str, str]):
+        """Returns ``(status, headers_dict, body_bytes)``."""
+        import http.client
+        import socket
+
+        with self._lock:
+            if self._conn is None:
+                conn_cls = (http.client.HTTPSConnection if self._tls
+                            else http.client.HTTPConnection)
+                self._conn = conn_cls(self._host, timeout=self._timeout_s)
+            conn = self._conn
+            try:
+                conn.request(method, url, body=body or None,
+                             headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                return resp.status, dict(resp.getheaders()), data
+            except (OSError, socket.timeout,
+                    http.client.HTTPException) as e:
+                self._conn = None
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                raise TransientStoreError(
+                    f"{self._scheme_name} {method} {url.split('?')[0]}: "
+                    f"{e}") from e
+
+    def close(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                try:
+                    self._conn.close()
+                except OSError:
+                    pass
+                self._conn = None
+
+    def raise_for(self, status: int, method: str, what: str,
+                  body: bytes) -> None:
+        """Shared status taxonomy: 5xx transient, 3xx/4xx config errors
+        (a redirect would break the signed Host, and handing redirect XML
+        back as object data would be silent corruption)."""
+        if status >= 500:
+            raise TransientStoreError(
+                f"{self._scheme_name} {method} {what}: HTTP {status}")
+        if status >= 300:
+            raise ValueError(
+                f"{self._scheme_name} {method} {what}: HTTP {status}: "
+                f"{body[:300].decode('utf-8', 'replace')}")
+
+
 class InMemoryObjectClient:
     """Test double with injectable faults.
 
@@ -471,6 +542,10 @@ def make_object_client(url: str) -> ObjectStoreClient:
         from .s3store import parse_s3_url
 
         return parse_s3_url(url)
+    if url.startswith("azure://"):
+        from .azurestore import parse_azure_url
+
+        return parse_azure_url(url)
     if "://" in url:
         raise ValueError(
             f"no client for object-store scheme {url.split('://')[0]!r}; "

@@ -35,15 +35,12 @@ from __future__ import annotations
 import datetime as _dt
 import hashlib
 import hmac
-import http.client
 import os
-import socket
-import threading
 import urllib.parse
 import xml.etree.ElementTree as ET
 from typing import Dict, List, Optional, Tuple
 
-from .objectstore import TransientStoreError
+from .objectstore import KeepAliveHttpTransport, TransientStoreError
 
 _EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
 
@@ -125,15 +122,15 @@ class S3ObjectClient:
         self._signer = SigV4Signer(access_key, secret_key, region)
         if endpoint:
             u = urllib.parse.urlsplit(endpoint)
-            self._tls = u.scheme == "https"
-            self._host = u.netloc
+            tls = u.scheme == "https"
+            host = u.netloc
             self._path_style = True  # emulators/MinIO convention
         else:
-            self._tls = True
-            self._host = f"{bucket}.s3.{region}.amazonaws.com"
+            tls = True
+            host = f"{bucket}.s3.{region}.amazonaws.com"
             self._path_style = False
-        self._lock = threading.Lock()
-        self._conn: Optional[http.client.HTTPConnection] = None
+        self._host = host
+        self._http = KeepAliveHttpTransport(host, tls, timeout_s, "s3")
 
     # -- transport ---------------------------------------------------------
     def _object_path(self, key: str) -> str:
@@ -143,11 +140,6 @@ class S3ObjectClient:
 
     def _bucket_path(self) -> str:
         return f"/{self.bucket}" if self._path_style else "/"
-
-    def _connect(self):
-        conn_cls = (http.client.HTTPSConnection if self._tls
-                    else http.client.HTTPConnection)
-        return conn_cls(self._host, timeout=self.timeout_s)
 
     def _request(self, method: str, path: str,
                  query: Optional[List[Tuple[str, str]]] = None,
@@ -165,59 +157,14 @@ class S3ObjectClient:
         qs = "&".join(f"{_uri_encode(k, True)}={_uri_encode(v, True)}"
                       for k, v in sorted(query))
         url = _uri_encode(path, False) + (f"?{qs}" if qs else "")
-        # One persistent keep-alive connection per client, serialized by
-        # the lock: a 170 MiB multipart upload is ~34 parts, and a TLS
-        # handshake per part would dominate the upload hot path.  Any
-        # transport error drops the connection; the uploader's retry gets
-        # a fresh one.
-        with self._lock:
-            if self._conn is None:
-                self._conn = self._connect()
-            conn = self._conn
-            try:
-                conn.request(method, url, body=body or None,
-                             headers=headers)
-                resp = conn.getresponse()
-                data = resp.read()
-                return resp.status, dict(resp.getheaders()), data
-            except (OSError, socket.timeout,
-                    http.client.HTTPException) as e:
-                self._conn = None
-                try:
-                    conn.close()
-                except OSError:
-                    pass
-                raise TransientStoreError(
-                    f"s3 {method} {path}: {e}") from e
+        return self._http.http_request(method, url, body, headers)
 
     def close(self) -> None:
-        with self._lock:
-            if self._conn is not None:
-                try:
-                    self._conn.close()
-                except OSError:
-                    pass
-                self._conn = None
+        self._http.close()
 
-    @staticmethod
-    def _raise_for(status: int, method: str, path: str,
+    def _raise_for(self, status: int, method: str, path: str,
                    body: bytes) -> None:
-        if status >= 500:
-            raise TransientStoreError(
-                f"s3 {method} {path}: HTTP {status}")
-        if status >= 400:
-            raise ValueError(
-                f"s3 {method} {path}: HTTP {status}: "
-                f"{body[:300].decode('utf-8', 'replace')}")
-        if status >= 300:
-            # Wrong-region PermanentRedirect and friends: following the
-            # redirect would break the signature (host is signed), and
-            # treating it as success would hand redirect XML back as
-            # object data.  Surface it as a config error.
-            raise ValueError(
-                f"s3 {method} {path}: HTTP {status} redirect — point "
-                f"endpoint/region at the bucket's actual region: "
-                f"{body[:300].decode('utf-8', 'replace')}")
+        self._http.raise_for(status, method, path, body)
 
     # -- ObjectStoreClient protocol ---------------------------------------
     def put_object(self, key: str, data: bytes) -> None:

@@ -321,6 +321,47 @@ class TestRemotePoolFromConfig:
             gw.close()
 
 
+class TestCliCrawlThroughGateway:
+    def test_standalone_crawl_via_dc_address(self, tmp_path):
+        """The full config path: `dct --urls … --dc-address …` builds a
+        REMOTE pool from stored credentials and runs the standalone crawl
+        through the gateway — no code injection anywhere."""
+        from distributed_crawler_tpu.cli import main
+        from distributed_crawler_tpu.clients.native import generate_pcode
+
+        gw = DcGateway(
+            seed_json=TestTwoProcessE2E.CRAWL_SEED,
+            accounts={"+15557770000": {"code": "321", "password": ""}},
+        ).start()
+        tdlib_dir = str(tmp_path / "td")
+        out_root = str(tmp_path / "out")
+        try:
+            generate_pcode(
+                tdlib_dir=tdlib_dir,
+                env={"TG_API_ID": "9", "TG_PHONE_NUMBER": "+15557770000",
+                     "TG_PHONE_CODE": "321"},
+                client=NativeTelegramClient(server_addr=gw.address,
+                                            conn_id="cli-boot"))
+            rc = main(["--urls", "gwroot", "--storage-root", out_root,
+                       "--dc-address", gw.address,
+                       "--tdlib-dir", tdlib_dir,
+                       "--crawl-id", "cli-gw", "--skip-media",
+                       "--max-depth", "1"])
+            assert rc == 0
+            posts = []
+            for dirpath, _dn, files in os.walk(out_root):
+                for f in files:
+                    if f == "posts.jsonl":
+                        with open(os.path.join(dirpath, f)) as fh:
+                            posts += [json.loads(x) for x in fh]
+            # The root channel's post crawled through the wire.
+            assert [p["description"] for p in posts] == ["hi @gwleaf"]
+            assert posts[0]["channel_name"] == "Root"
+            assert gw.auth_successes >= 2  # gen-code + pool connection(s)
+        finally:
+            gw.close()
+
+
 class TestGatewayRestartResilience:
     def test_pool_recreates_after_gateway_restart(self, tmp_path):
         """Gateway dies mid-session → calls fail fast; after it returns on
