@@ -165,6 +165,9 @@ def build_parser() -> argparse.ArgumentParser:
     a("--asr-batch-size", type=int, default=None,
       help="waveform batch per device dispatch (default 8)")
     a("--infer-batch-size", type=int, default=None)
+    a("--infer-attention", default=None,
+      help="attention dispatch: auto (flash past the length threshold on "
+           "TPU) | xla | flash")
     a("--infer-param-dtype", default=None,
       help="cast float params at engine startup (e.g. bfloat16) — halves "
            "weight HBM traffic when serving; empty keeps the f32 layout")
@@ -300,6 +303,7 @@ _KEY_MAP = {
     "infer_backpressure_high": "distributed.inference_backpressure_high",
     "infer_backpressure_low": "distributed.inference_backpressure_low",
     "infer_batch_size": "inference.batch_size",
+    "infer_attention": "inference.attention",
     "infer_param_dtype": "inference.param_dtype",
     "infer_quantize": "inference.quantize",
     "asr_pretrained_dir": "inference.asr_pretrained_dir",
@@ -409,6 +413,7 @@ def resolve_config(args: argparse.Namespace,
         cfg.inference.bucket_sizes = [int(b) for b in buckets]
     cfg.inference.param_dtype = r.get_str("inference.param_dtype", "")
     cfg.inference.quantize = r.get_str("inference.quantize", "")
+    cfg.inference.attention = r.get_str("inference.attention", "")
     cfg.inference.pretrained_dir = r.get_str(
         "inference.pretrained_dir", cfg.inference.pretrained_dir)
     cfg.inference.asr_pretrained_dir = r.get_str(
@@ -1155,7 +1160,8 @@ def _make_engine(cfg: CrawlerConfig, r: ConfigResolver,
         pretrained_dir=cfg.inference.pretrained_dir or None,
         param_dtype=(cfg.inference.param_dtype or None)
         if cast_params else None,
-        quantize=(cfg.inference.quantize or None) if cast_params else None)
+        quantize=(cfg.inference.quantize or None) if cast_params else None,
+        attention=cfg.inference.attention or None)
     if n_labels is not None:
         kw["n_labels"] = n_labels
     if with_checkpoint:

@@ -60,10 +60,14 @@ class InferenceConfig:
     # Serving-time parameter cast ("" keeps f32; "bfloat16" halves weight
     # HBM traffic — see EngineConfig.param_dtype).
     param_dtype: str = ""
-    # Serving-time projection-GEMM quantization ("" off; "int8" runs the
-    # per-layer projections int8×int8→int32 on the MXU — 2× bf16 peak on
-    # v5e.  See ops/quant.py; never applies to train-head).
+    # Serving-time projection-GEMM quantization ("" off; "int8" dynamic
+    # per-token scales; "int8_static" calibrated per-tensor scales with
+    # the quantize fused into the producer.  See ops/quant.py; never
+    # applies to train-head).
     quantize: str = ""
+    # Attention dispatch ("" = engine default "auto": Pallas flash past
+    # the length threshold on TPU; "xla" | "flash" force a path).
+    attention: str = ""
     # Local HF checkpoint dirs (real weights + vocab; offline only).  Empty
     # string -> registry config with random init + hashing tokenizer.
     pretrained_dir: str = ""

@@ -64,6 +64,9 @@ class EngineConfig:
     # Applies over whatever params were loaded (random / pretrained /
     # checkpoint); the float source tree is discarded after conversion.
     quantize: Optional[str] = None
+    # Attention dispatch: "auto" (ops/attention.py policy: Pallas flash
+    # past FLASH_MIN_SEQ on TPU, XLA otherwise) | "xla" | "flash".
+    attention: Optional[str] = None
 
     def encoder_config(self) -> EncoderConfig:
         try:
@@ -72,7 +75,13 @@ class EngineConfig:
             raise ValueError(
                 f"unknown model {self.model!r}; "
                 f"one of {sorted(MODEL_REGISTRY)}") from None
-        return replace(base, n_labels=self.n_labels)
+        cfg = replace(base, n_labels=self.n_labels)
+        if self.attention:
+            if self.attention not in ("auto", "xla", "flash"):
+                raise ValueError(
+                    f"unknown attention mode {self.attention!r}")
+            cfg = replace(cfg, attention=self.attention)
+        return cfg
 
 
 def enable_compilation_cache(cache_dir: str,

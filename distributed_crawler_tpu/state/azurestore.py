@@ -30,7 +30,6 @@ import base64
 import hashlib
 import hmac
 import os
-import threading
 import urllib.parse
 import uuid
 import xml.etree.ElementTree as ET
@@ -108,8 +107,6 @@ class AzureBlobObjectClient:
             host = f"{account}.blob.core.windows.net"
             self._base = ""
         self._http = KeepAliveHttpTransport(host, tls, timeout_s, "azure")
-        self._mp_lock = threading.Lock()
-        self._uid = 0
 
     # -- transport ---------------------------------------------------------
     def _blob_path(self, key: str) -> str:
@@ -137,13 +134,12 @@ class AzureBlobObjectClient:
             headers.update(extra_headers)
         if body:
             headers["Content-Length"] = str(len(body))
-        # Signature path excludes the endpoint base only when the account
-        # is addressed virtually; Azurite-style paths include /account.
-        sign_path = path
-        if self._base and sign_path.startswith(self._base):
-            sign_path = sign_path[len(self._base):]
+        # CanonicalizedResource is "/" + account + FULL request URI path —
+        # so for an Azurite-style endpoint (account as the first path
+        # segment) the account name legitimately appears twice
+        # ("/acct/acct/container/blob"); do NOT strip the base.
         headers["Authorization"] = self._signer.sign(
-            method, urllib.parse.unquote(sign_path), query, headers,
+            method, urllib.parse.unquote(path), query, headers,
             len(body))
         qs = urllib.parse.urlencode(sorted(query))
         url = path + (f"?{qs}" if qs else "")
@@ -213,14 +209,13 @@ class AzureBlobObjectClient:
     # -- multipart (block-blob mapping) ------------------------------------
     def create_multipart(self, key: str) -> str:
         # The id carries real entropy: block ids are namespaced by it, and
-        # a deterministic counter would let a retired-but-alive writer and
-        # its replacement stage IDENTICAL block ids against the same blob
-        # — last-write-wins per block id, silently interleaving the two
-        # uploads.  Fixed width keeps every block id the same length
-        # (an Azure block-list requirement).
-        with self._mp_lock:
-            self._uid += 1
-            return f"up{uuid.uuid4().hex[:12]}{self._uid:04d}"
+        # a deterministic id would let a retired-but-alive writer and its
+        # replacement stage IDENTICAL block ids against the same blob —
+        # last-write-wins per block id, silently interleaving the two
+        # uploads.  uuid4 alone (no counter) keeps the width FIXED forever:
+        # Azure requires equal-length block ids per blob, including stale
+        # uncommitted blocks from crashed writers.
+        return f"up{uuid.uuid4().hex[:16]}"
 
     @staticmethod
     def _block_id(upload_id: str, part_no: int) -> str:

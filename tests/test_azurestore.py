@@ -31,11 +31,17 @@ KEY_B64 = base64.b64encode(b"azure-test-key-32-bytes-long!!__").decode()
 
 
 class AzureEmulator:
-    """Minimal Blob-service server: in-memory, Shared Key-checked."""
+    """Minimal Blob-service server: in-memory, Shared Key-checked.
+
+    ``account_in_path=True`` emulates Azurite's addressing
+    (http://host:port/account/container/blob) — the account segment rides
+    the URI path AND appears a second time in CanonicalizedResource.
+    """
 
     PAGE_SIZE = 3  # exercises NextMarker pagination
 
-    def __init__(self):
+    def __init__(self, account_in_path: bool = False):
+        self.account_in_path = account_in_path
         self.blobs = {}
         self.blocks = {}  # (container, blob) -> {block_id: bytes}
         self.request_log = []
@@ -114,7 +120,14 @@ class AzureEmulator:
                 path, _, qs = self.path.partition("?")
                 q = dict(urllib.parse.parse_qsl(qs,
                                                 keep_blank_values=True))
-                parts = urllib.parse.unquote(path).lstrip("/").split("/", 2)
+                decoded = urllib.parse.unquote(path).lstrip("/")
+                if emu.account_in_path:
+                    # Azurite addressing: strip the leading /account.
+                    acct, _, decoded = decoded.partition("/")
+                    if acct != ACCOUNT:
+                        self._respond(400, b"wrong account segment")
+                        return
+                parts = decoded.split("/", 2)
                 # path-style: /container[/blob...]
                 container = parts[0]
                 blob = parts[1] if len(parts) > 1 else ""
@@ -278,6 +291,30 @@ class TestBlockBlobMultipart:
         c.upload_part("mp/bad.bin", uid, 0, b"part0")
         with pytest.raises(ValueError, match="400"):
             c.complete_multipart("mp/bad.bin", uid, ["Ym9ndXM="])
+
+
+class TestAzuriteStyleEndpoint:
+    def test_account_in_path_signing(self):
+        """Azurite addressing: the account rides the URI path AND appears
+        twice in CanonicalizedResource (/acct/acct/container/blob) — the
+        r04 review caught the stripped-base variant 403ing on real
+        Azurite."""
+        emu = AzureEmulator(account_in_path=True).start()
+        try:
+            c = AzureBlobObjectClient(
+                account=ACCOUNT, container="crawls", prefix="p",
+                endpoint=f"{emu.endpoint}/{ACCOUNT}",
+                account_key=KEY_B64)
+            c.put_object("a.jsonl", b"azurite-style")
+            assert emu.blobs[("crawls", "p/a.jsonl")] == b"azurite-style"
+            assert c.get_object("a.jsonl") == b"azurite-style"
+            assert c.list_objects("") == ["a.jsonl"]
+            up = ObjectStoreUploader(c, part_size=8, backoff_s=0.01)
+            data = bytes(range(24))
+            up.upload_bytes("mp.bin", data)
+            assert emu.blobs[("crawls", "p/mp.bin")] == data
+        finally:
+            emu.close()
 
 
 class TestMakeObjectClientAzureUrl:

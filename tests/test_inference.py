@@ -97,6 +97,31 @@ class TestInferenceEngine:
         out = eng.run([f"text {i}" for i in range(11)])
         assert len(out) == 11
 
+    def test_attention_mode_plumbs_to_encoder(self):
+        from distributed_crawler_tpu.inference.engine import EngineConfig
+
+        cfg = EngineConfig(model="tiny", attention="flash")
+        assert cfg.encoder_config().attention == "flash"
+        assert EngineConfig(model="tiny").encoder_config().attention == \
+            "auto"
+        with pytest.raises(ValueError, match="attention"):
+            EngineConfig(model="tiny",
+                         attention="paged").encoder_config()
+
+    def test_cli_attention_flag_reaches_engine(self):
+        from distributed_crawler_tpu.cli import (
+            _make_engine,
+            build_parser,
+            resolve_config,
+        )
+
+        args = build_parser().parse_args(
+            ["--urls", "a", "--infer-model", "tiny",
+             "--infer-attention", "xla"])
+        cfg, r = resolve_config(args, env={})
+        eng = _make_engine(cfg, r)
+        assert eng.ecfg.attention == "xla"
+
     def test_pipelined_chunks_keep_order_across_buckets(self):
         """The one-deep dispatch/readback pipeline must not reorder or
         drop results when inputs span several buckets and ragged chunk

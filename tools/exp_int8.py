@@ -59,18 +59,29 @@ def t_iter_chained(model, params, ids, mask, vocab, n_short=3, n_long=12,
 
 
 def main():
+    from distributed_crawler_tpu.inference.engine import (
+        enable_compilation_cache,
+    )
+
+    smoke = "--smoke" in sys.argv  # CPU validation run: tiny cells
+    enable_compilation_cache(".xla_bench_cache", min_compile_time_s=5.0)
     t0 = time.perf_counter()
     probe()
     log(f"probe ok in {time.perf_counter() - t0:.1f}s "
         f"backend={jax.default_backend()}")
-    if jax.default_backend() != "tpu":
+    if jax.default_backend() != "tpu" and not smoke:
         sys.exit(3)
 
-    cells = [
-        ("e5_small", E5_SMALL, 256),
-        ("xlmr_base", XLMR_BASE, 256),
-        ("e5_large", E5_LARGE, 128),
-    ]
+    if smoke:
+        from distributed_crawler_tpu.models.encoder import TINY_TEST
+
+        cells = [("tiny", TINY_TEST, 8)]
+    else:
+        cells = [
+            ("e5_small", E5_SMALL, 256),
+            ("xlmr_base", XLMR_BASE, 256),
+            ("e5_large", E5_LARGE, 128),
+        ]
     rng = np.random.default_rng(0)
     for name, base_cfg, batch in cells:
         cfg = replace(base_cfg, vocab_size=VOCAB, n_labels=8)

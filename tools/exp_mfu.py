@@ -63,28 +63,39 @@ def cast_params_bf16(params):
 
 
 def main():
+    from distributed_crawler_tpu.inference.engine import (
+        enable_compilation_cache,
+    )
+
+    smoke = "--smoke" in sys.argv  # CPU validation run: tiny, xla-only
+    enable_compilation_cache(".xla_bench_cache", min_compile_time_s=5.0)
     t0 = time.perf_counter()
     x = jnp.ones((128, 128), jnp.bfloat16)
     float(jax.jit(lambda a: (a @ a).sum())(x))
     log(f"probe ok in {time.perf_counter() - t0:.1f}s "
         f"backend={jax.default_backend()}")
-    if jax.default_backend() != "tpu":
+    if jax.default_backend() != "tpu" and not smoke:
         sys.exit(3)
 
-    vocab = 250037  # real E5 vocab: keep the gather honest
-    base = replace(E5_SMALL, n_labels=8)
+    vocab = 4096 if smoke else 250037  # real E5 vocab keeps gather honest
+    base = replace(E5_SMALL, n_labels=8, vocab_size=vocab)
+    if smoke:
+        base = replace(base, hidden=96, n_layers=2, n_heads=4, mlp_dim=192,
+                       dtype="float32")
     rng = np.random.default_rng(0)
 
     variants = [
-        ("base-b256", base, 256, False),
-        ("b512", base, 512, False),
+        ("base-b256", base, 8 if smoke else 256, False),
+        ("b512", base, 16 if smoke else 512, False),
         ("flash-b256", replace(base, attention="flash"), 256, False),
         ("flash-b512", replace(base, attention="flash"), 512, False),
-        ("bf16p-b512", base, 512, True),
+        ("bf16p-b512", base, 16 if smoke else 512, True),
         ("flash+bf16-b512", replace(base, attention="flash"), 512, True),
-        ("b1024", base, 1024, False),
+        ("b1024", base, 32 if smoke else 1024, False),
         ("flash+bf16-b1024", replace(base, attention="flash"), 1024, True),
     ]
+    if smoke:  # pallas won't lower on CPU without interpret mode
+        variants = [v for v in variants if "flash" not in v[0]]
     params_cache = {}
     for name, cfg, batch, bf16p in variants:
         log(f"{name}: building")
