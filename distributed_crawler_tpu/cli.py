@@ -1161,7 +1161,10 @@ def _make_engine(cfg: CrawlerConfig, r: ConfigResolver,
         param_dtype=(cfg.inference.param_dtype or None)
         if cast_params else None,
         quantize=(cfg.inference.quantize or None) if cast_params else None,
-        attention=cfg.inference.attention or None)
+        # Serving-only like its siblings: train-head must never build the
+        # flash kernel (no custom_vjp) into the model it differentiates.
+        attention=(cfg.inference.attention or None) if cast_params
+        else None)
     if n_labels is not None:
         kw["n_labels"] = n_labels
     if with_checkpoint:

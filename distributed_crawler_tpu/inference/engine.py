@@ -75,13 +75,7 @@ class EngineConfig:
             raise ValueError(
                 f"unknown model {self.model!r}; "
                 f"one of {sorted(MODEL_REGISTRY)}") from None
-        cfg = replace(base, n_labels=self.n_labels)
-        if self.attention:
-            if self.attention not in ("auto", "xla", "flash"):
-                raise ValueError(
-                    f"unknown attention mode {self.attention!r}")
-            cfg = replace(cfg, attention=self.attention)
-        return cfg
+        return replace(base, n_labels=self.n_labels)
 
 
 def enable_compilation_cache(cache_dir: str,
@@ -133,6 +127,13 @@ class InferenceEngine:
                 cfg, params, tokenizer)
         else:
             self.ecfg = cfg.encoder_config()
+        if cfg.attention:
+            # Applied (and validated) HERE so every param source —
+            # registry, pretrained checkpoint, restored head — honors it.
+            if cfg.attention not in ("auto", "xla", "flash"):
+                raise ValueError(
+                    f"unknown attention mode {cfg.attention!r}")
+            self.ecfg = replace(self.ecfg, attention=cfg.attention)
         self.label_names: Optional[List[str]] = None
         if cfg.checkpoint_dir:
             # The checkpoint's own head width wins (a 2-class fine-tune must

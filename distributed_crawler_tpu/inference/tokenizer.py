@@ -49,15 +49,30 @@ class HashingTokenizer:
     sentencepiece vocab will, with zero model-asset dependencies.
     """
 
+    # Word-level hash memo: natural text is Zipfian, so a bounded cache
+    # turns the per-byte Python FNV loop (measured ~12k posts/sec, i.e.
+    # AT the single-chip device rate — a real serving bottleneck) into a
+    # dict hit for the overwhelming majority of words.  Ids are unchanged.
+    _CACHE_MAX = 1 << 20
+
     def __init__(self, vocab_size: int, max_word_len: int = 12):
         if vocab_size <= _RESERVED:
             raise ValueError(f"vocab_size must exceed {_RESERVED}")
         self.vocab_size = vocab_size
         self.max_word_len = max_word_len
+        self._memo: dict = {}
 
     def _hash(self, piece: str) -> int:
-        h = _fnv1a(piece.encode("utf-8"))
-        return _RESERVED + h % (self.vocab_size - _RESERVED)
+        memo = self._memo
+        hit = memo.get(piece)
+        if hit is not None:
+            return hit
+        h = _RESERVED + _fnv1a(piece.encode("utf-8")) % \
+            (self.vocab_size - _RESERVED)
+        if len(memo) >= self._CACHE_MAX:
+            memo.clear()  # crude but O(1) amortized; Zipf refills fast
+        memo[piece] = h
+        return h
 
     def encode(self, text: str) -> List[int]:
         text = unicodedata.normalize("NFKC", text or "").lower()

@@ -98,15 +98,22 @@ class TestInferenceEngine:
         assert len(out) == 11
 
     def test_attention_mode_plumbs_to_encoder(self):
-        from distributed_crawler_tpu.inference.engine import EngineConfig
+        from distributed_crawler_tpu.inference.engine import (
+            EngineConfig,
+            InferenceEngine,
+        )
+        from distributed_crawler_tpu.utils.metrics import MetricsRegistry
 
-        cfg = EngineConfig(model="tiny", attention="flash")
-        assert cfg.encoder_config().attention == "flash"
-        assert EngineConfig(model="tiny").encoder_config().attention == \
-            "auto"
+        eng = InferenceEngine(
+            EngineConfig(model="tiny", batch_size=4, buckets=(32,),
+                         attention="xla"),
+            registry=MetricsRegistry())
+        assert eng.ecfg.attention == "xla"
+        assert _engine().ecfg.attention == "auto"  # default untouched
         with pytest.raises(ValueError, match="attention"):
-            EngineConfig(model="tiny",
-                         attention="paged").encoder_config()
+            InferenceEngine(
+                EngineConfig(model="tiny", attention="paged"),
+                registry=MetricsRegistry())
 
     def test_cli_attention_flag_reaches_engine(self):
         from distributed_crawler_tpu.cli import (

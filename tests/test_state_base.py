@@ -223,12 +223,17 @@ class TestMediaCacheSharding:
         assert cache2.has("m0") and cache2.has("m7")
 
     def test_legacy_migration(self, tmp_path):
+        from datetime import datetime, timedelta, timezone
+
         from distributed_crawler_tpu.state import ShardedMediaCache
         from distributed_crawler_tpu.state.providers import LocalStorageProvider
         provider = LocalStorageProvider(str(tmp_path))
+        # Relative date: a hardcoded firstSeen silently crosses the 30-day
+        # expiry as the calendar advances (this test was a time bomb).
+        seen = (datetime.now(timezone.utc) - timedelta(days=5)).strftime(
+            "%Y-%m-%dT%H:%M:%SZ")
         provider.save_json("c1/media-cache.json", {
-            "items": {"legacy1": {"id": "legacy1",
-                                  "firstSeen": "2026-07-01T00:00:00Z"}}})
+            "items": {"legacy1": {"id": "legacy1", "firstSeen": seen}}})
         cache = ShardedMediaCache(provider, "c1")
         assert cache.has("legacy1")
 
