@@ -1161,10 +1161,12 @@ def _make_engine(cfg: CrawlerConfig, r: ConfigResolver,
         param_dtype=(cfg.inference.param_dtype or None)
         if cast_params else None,
         quantize=(cfg.inference.quantize or None) if cast_params else None,
-        # Serving-only like its siblings: train-head must never build the
-        # flash kernel (no custom_vjp) into the model it differentiates.
+        # train-head differentiates the model, and the Pallas flash kernel
+        # has no custom_vjp — so training is PINNED to the XLA path
+        # (unlike param_dtype/quantize, where None is already the safe
+        # default, 'auto' here could still dispatch flash at long buckets).
         attention=(cfg.inference.attention or None) if cast_params
-        else None)
+        else "xla")
     if n_labels is not None:
         kw["n_labels"] = n_labels
     if with_checkpoint:

@@ -122,17 +122,18 @@ class InferenceEngine:
         import jax
 
         self.cfg = cfg
+        if cfg.attention and cfg.attention not in ("auto", "xla", "flash"):
+            # Validate BEFORE any checkpoint I/O: a typo must not cost a
+            # multi-GB pretrained load first.
+            raise ValueError(f"unknown attention mode {cfg.attention!r}")
         if cfg.pretrained_dir:
             self.ecfg, params, tokenizer = _load_pretrained(
                 cfg, params, tokenizer)
         else:
             self.ecfg = cfg.encoder_config()
         if cfg.attention:
-            # Applied (and validated) HERE so every param source —
-            # registry, pretrained checkpoint, restored head — honors it.
-            if cfg.attention not in ("auto", "xla", "flash"):
-                raise ValueError(
-                    f"unknown attention mode {cfg.attention!r}")
+            # Applied HERE so every param source — registry, pretrained
+            # checkpoint, restored head — honors it.
             self.ecfg = replace(self.ecfg, attention=cfg.attention)
         self.label_names: Optional[List[str]] = None
         if cfg.checkpoint_dir:

@@ -141,3 +141,116 @@ def test_orchestrator_worker_tpu_worker_processes(tmp_path):
             p.kill()
         for p in procs:
             p.wait(timeout=10)
+
+
+def test_full_production_shape_with_dc_gateway(tmp_path):
+    """The complete deployment: a dc-gateway process owning the store, an
+    orchestrator hosting the broker, a crawl worker whose pool DIALS the
+    gateway over the wire protocol (credentials minted by gen-code), and
+    a TPU worker embedding the stream — every round-4 seam composed in
+    one run."""
+    from distributed_crawler_tpu.clients.native import (
+        NativeTelegramClient,
+        generate_pcode,
+    )
+
+    bus_port = _free_port()
+    bus_addr = f"127.0.0.1:{bus_port}"
+    seed_file = tmp_path / "gwseed.json"
+    seed_file.write_text(json.dumps(SEED))
+    accounts = tmp_path / "accounts.json"
+    accounts.write_text(json.dumps({"accounts": [
+        {"phone_number": "+15550004444", "code": "6060"}]}))
+    gw_addr_file = tmp_path / "gw.addr"
+    tdlib_dir = tmp_path / "td"
+
+    procs = []
+    try:
+        procs.append(_spawn(
+            ["--mode", "dc-gateway", "--gateway-listen", "127.0.0.1:0",
+             "--gateway-address-file", str(gw_addr_file),
+             "--gateway-accounts", str(accounts),
+             "--gateway-seed-json", f"@{seed_file}",
+             "--storage-root", str(tmp_path / "gwstore"),
+             "--log-level", "info"],
+            tmp_path / "gw.log", env=_cpu_env()))
+        deadline = time.time() + 30
+        while not gw_addr_file.exists() and time.time() < deadline:
+            assert procs[0].poll() is None, (
+                tmp_path / "gw.log").read_text(errors="replace")[-2000:]
+            time.sleep(0.1)
+        assert gw_addr_file.exists(), (
+            "gateway never bound: " +
+            (tmp_path / "gw.log").read_text(errors="replace")[-2000:])
+        gw_addr = gw_addr_file.read_text()
+
+        # Mint credentials against the live gateway (the gen-code flow).
+        boot = NativeTelegramClient(server_addr=gw_addr,
+                                    conn_id="topo-boot")
+        try:
+            generate_pcode(
+                tdlib_dir=str(tdlib_dir),
+                env={"TG_API_ID": "7", "TG_PHONE_NUMBER": "+15550004444",
+                     "TG_PHONE_CODE": "6060"},
+                client=boot)
+        finally:
+            boot.close()
+
+        procs.append(_spawn(
+            ["--mode", "orchestrator", "--urls", "topoa",
+             "--bus-address", bus_addr, "--crawl-id", "topo2",
+             "--storage-root", str(tmp_path / "ostore"),
+             "--max-depth", "1", "--skip-media", "--log-level", "info"],
+            tmp_path / "orch.log"))
+        procs.append(_spawn(
+            ["--mode", "tpu-worker", "--infer-model", "tiny",
+             "--bus-address", bus_addr,
+             "--storage-root", str(tmp_path / "tpustore"),
+             "--log-level", "info"],
+            tmp_path / "tpu.log", env=_cpu_env()))
+        procs.append(_spawn(
+            ["--mode", "worker", "--worker-id", "w1",
+             "--bus-address", bus_addr, "--crawl-id", "topo2",
+             "--dc-address", gw_addr, "--tdlib-dir", str(tdlib_dir),
+             "--storage-root", str(tmp_path / "wstore"),
+             "--skip-media", "--infer", "--log-level", "info"],
+            tmp_path / "worker.log", env=_cpu_env()))
+
+        deadline = time.time() + 150
+        done = False
+        while time.time() < deadline and not done:
+            if procs[1].poll() is not None:
+                break
+            done = "crawl marked as completed" in \
+                (tmp_path / "orch.log").read_text(errors="replace")
+            time.sleep(1.0)
+        orch_log = (tmp_path / "orch.log").read_text(errors="replace")
+        worker_log = (tmp_path / "worker.log").read_text(errors="replace")
+        assert "crawl marked as completed" in orch_log, (
+            orch_log[-1500:] + "\n--- worker ---\n" + worker_log[-1500:])
+
+        posts = sorted(p.parent.parent.name
+                       for p in (tmp_path / "wstore").rglob("posts.jsonl"))
+        assert posts == ["topoa", "topob"], posts
+
+        # Inference results flowed end to end too.
+        deadline = time.time() + 60
+        uids = set()
+        while time.time() < deadline:
+            uids = set()
+            for f in (tmp_path / "tpustore").rglob("*.jsonl"):
+                for line in f.read_text(errors="replace").splitlines():
+                    try:
+                        uids.add(json.loads(line)["post_uid"])
+                    except (ValueError, KeyError):
+                        pass
+            if len(uids) >= 5:
+                break
+            time.sleep(1.0)
+        assert len(uids) == 5, (tmp_path / "tpu.log").read_text(
+            errors="replace")[-2000:]
+    finally:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait(timeout=10)
