@@ -48,12 +48,12 @@ PEAK_BF16_FLOPS = [
     ("v6 lite", 918e12), ("v6e", 918e12), ("v4", 275e12), ("v3", 123e12),
 ]
 
-# A healthy chip finishes the whole measurement in <5 min (two compiles —
-# bf16 + int8 — at ~10-30 s each plus ~90 s of timing per model); the chip
-# has been observed to wedge BETWEEN a passing probe and the main child,
-# so the budget is sized to cut over to the CPU fallback while the
-# driver's patience lasts, not to wait out a wedge.
-CHILD_TIMEOUT_S = int(os.environ.get("BENCH_TIMEOUT_S", "480"))
+# A healthy chip finishes the whole measurement in <6 min (three compiles
+# — bf16 + int8 + int8_static — at ~10-30 s each plus ~60-90 s of timing
+# per model); the chip has been observed to wedge BETWEEN a passing probe
+# and the main child, so the budget is sized to cut over to the CPU
+# fallback while the driver's patience lasts, not to wait out a wedge.
+CHILD_TIMEOUT_S = int(os.environ.get("BENCH_TIMEOUT_S", "560"))
 SCALE_TIMEOUT_S = int(os.environ.get("BENCH_SCALE_TIMEOUT_S", "240"))
 # Pre-flight probe: one tiny jitted matmul on the default backend.  A wedged
 # chip is discovered here in ≤PROBE_TIMEOUT_S instead of burning the full
@@ -111,6 +111,27 @@ def _load_tpu_cache() -> dict | None:
             return json.load(f)
     except (OSError, json.JSONDecodeError):
         return None
+
+
+def _fit_int8_static(cfg, params, ids, mask, fit):
+    """Calibrate static activation scales, build the int8_static model,
+    return (posts/sec numerator is the caller's) its fitted t_iter — the
+    ONE static-leg recipe shared by the E5-small and XLM-R bench legs."""
+    from dataclasses import replace
+
+    from distributed_crawler_tpu.models.encoder import EmbedderClassifier
+    from distributed_crawler_tpu.models.quant import (
+        calibrate_activation_scales,
+        quantize_encoder_params,
+    )
+
+    calib_model = EmbedderClassifier(replace(cfg, calibrate=True))
+    scales = calibrate_activation_scales(
+        calib_model, params, ids[:min(64, ids.shape[0])],
+        mask[:min(64, mask.shape[0])])
+    smodel = EmbedderClassifier(replace(cfg, quant="int8_static"))
+    sparams = quantize_encoder_params(params, act_scales=scales)
+    return fit(smodel, sparams)
 
 
 def _chained_t_iter(model, params, ids, mask, vocab: int,
@@ -243,6 +264,7 @@ def _measure(scale_devices: int | None = None,
     # (``with_int8=False``), whose timeout budget is sized for ONE
     # compile+fit; only the TPU child pays for the second model.
     int8_pps = None
+    int8_static_pps = None
     if with_int8:
         try:
             from distributed_crawler_tpu.models.quant import (
@@ -259,6 +281,20 @@ def _measure(scale_devices: int | None = None,
                  f"(speedup {int8_pps / posts_per_sec:.2f}x)")
         except Exception as exc:  # noqa: BLE001 — int8 row is best-effort
             _log(f"int8 measurement skipped: {exc}")
+        try:
+            # Static activation scales (fused quantize — the attack on the
+            # dynamic path's 0.79x at this width; ops/quant.py).
+            t_iter_s = _fit_int8_static(
+                cfg, params, ids, mask,
+                lambda m, p: _chained_t_iter(m, p, ids, mask,
+                                             cfg.vocab_size, n_short,
+                                             n_long, repeats,
+                                             label="int8_static"))
+            int8_static_pps = batch / t_iter_s
+            _log(f"int8_static throughput: {int8_static_pps:.1f} posts/sec"
+                 f" (speedup {int8_static_pps / posts_per_sec:.2f}x)")
+        except Exception as exc:  # noqa: BLE001 — best-effort row
+            _log(f"int8_static measurement skipped: {exc}")
 
     # Serving-path throughput: the ACTUAL InferenceEngine.run_tokenized
     # loop (bucketing, one-deep dispatch/readback pipeline, softmax,
@@ -330,6 +366,10 @@ def _measure(scale_devices: int | None = None,
         "int8_posts_per_sec": round(int8_pps, 1) if int8_pps else None,
         "int8_speedup": round(int8_pps / posts_per_sec, 2) if int8_pps
         else None,
+        "int8_static_posts_per_sec": round(int8_static_pps, 1)
+        if int8_static_pps else None,
+        "int8_static_speedup": round(int8_static_pps / posts_per_sec, 2)
+        if int8_static_pps else None,
         "serving_posts_per_sec": round(serving_pps, 1) if serving_pps
         else None,
         "platform": jax.default_backend(),
@@ -390,16 +430,9 @@ def _measure_xlmr_int8(batch: int = 256, seq: int = SEQ,
          f"(speedup {t_bf16 / t_int8:.2f}x)")
     try:
         # Static-scale variant (fused quantize): best-effort third cell.
-        from distributed_crawler_tpu.models.quant import (
-            calibrate_activation_scales,
-        )
-
-        calib_model = EmbedderClassifier(replace(cfg, calibrate=True))
-        scales = calibrate_activation_scales(calib_model, params,
-                                             ids[:64], mask[:64])
-        smodel = EmbedderClassifier(replace(cfg, quant="int8_static"))
-        sparams = quantize_encoder_params(params, act_scales=scales)
-        t_static = fit(smodel, sparams, "int8_static")
+        t_static = _fit_int8_static(
+            cfg, params, ids, mask,
+            lambda m, p: fit(m, p, "int8_static"))
         out["xlmr_base_int8_static_posts_per_sec"] = round(
             batch / t_static, 1)
         out["xlmr_base_int8_static_speedup"] = round(t_bf16 / t_static, 2)
