@@ -635,11 +635,31 @@ class CliConfigError(ValueError):
 def _serve_forever(poll_s: float = 1.0,
                    running: Optional[Callable[[], bool]] = None) -> None:
     """Block the main thread while a service's worker threads run; an
-    optional ``running`` predicate ends the loop when it turns False."""
+    optional ``running`` predicate ends the loop when it turns False.
+
+    SIGTERM is mapped to KeyboardInterrupt for the duration, so a
+    supervisor's stop (docker stop, kubelet) takes the same graceful
+    close/drain path as ^C instead of killing mid-write."""
+    import signal as _signal
     import time as _time
 
-    while running is None or running():
-        _time.sleep(poll_s)
+    def _term(_sig, _frm):
+        raise KeyboardInterrupt
+
+    prev = None
+    try:
+        prev = _signal.signal(_signal.SIGTERM, _term)
+    except ValueError:
+        pass  # not the main thread (tests drive this inline)
+    try:
+        while running is None or running():
+            _time.sleep(poll_s)
+    finally:
+        if prev is not None:
+            try:
+                _signal.signal(_signal.SIGTERM, prev)
+            except ValueError:
+                pass
 
 
 def _gen_code(tdlib_dir: str = ".tdlib", env=None, server_addr: str = "",

@@ -504,9 +504,12 @@ class TestTwoProcessE2E:
             finally:
                 client.close()
         finally:
-            proc.send_signal(signal.SIGINT)
+            # SIGTERM (the supervisor's stop signal) takes the graceful
+            # close path via _serve_forever's handler.
+            proc.terminate()
             try:
-                proc.wait(timeout=10)
+                rc = proc.wait(timeout=10)
+                assert rc == 130  # KeyboardInterrupt exit path
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait(timeout=5)
