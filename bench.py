@@ -301,6 +301,7 @@ def _measure(scale_devices: int | None = None,
     # result dicts) — what a TPUWorker batch stream achieves end to end,
     # as opposed to the chained pure-device number above.  Best-effort.
     serving_pps = None
+    serving_e2e_pps = None
     if with_serving:
         try:
             from distributed_crawler_tpu.inference.engine import (
@@ -324,6 +325,22 @@ def _measure(scale_devices: int | None = None,
             serving_pps = len(toks) / dt
             _log(f"serving path: {serving_pps:.1f} posts/sec "
                  f"({serving_pps / posts_per_sec:.2f}x of chained)")
+            # End-to-end variant: raw TEXT in (tokenize included) — what
+            # a worker consuming post bodies actually sustains.  A 997-word
+            # vocabulary with per-text phase gives Zipf-ish repeats (real
+            # text re-uses words; the memo helps but isn't handed an
+            # all-identical best case).  Lengths land in the same bucket.
+            n_words = (seq - 2) // 2
+            texts = [" ".join(f"w{(i * 31 + j * 7) % 997}"
+                              for j in range(n_words))
+                     for i in range(batch * 4)]
+            eng.run(texts[:batch])  # warm the tokenizer memo
+            t0 = time.perf_counter()
+            out = eng.run(texts)
+            dt = time.perf_counter() - t0
+            assert len(out) == len(texts)
+            serving_e2e_pps = len(texts) / dt
+            _log(f"serving e2e (text in): {serving_e2e_pps:.1f} posts/sec")
         except Exception as exc:  # noqa: BLE001 — best-effort row
             _log(f"serving-path measurement skipped: {exc}")
 
@@ -370,6 +387,8 @@ def _measure(scale_devices: int | None = None,
         if int8_static_pps else None,
         "int8_static_speedup": round(int8_static_pps / posts_per_sec, 2)
         if int8_static_pps else None,
+        "serving_e2e_posts_per_sec": round(serving_e2e_pps, 1)
+        if serving_e2e_pps else None,
         "serving_posts_per_sec": round(serving_pps, 1) if serving_pps
         else None,
         "platform": jax.default_backend(),

@@ -647,17 +647,22 @@ def _serve_forever(poll_s: float = 1.0,
         raise KeyboardInterrupt
 
     prev = None
+    installed = False
     try:
         prev = _signal.signal(_signal.SIGTERM, _term)
+        installed = True  # prev may be None (non-Python disposition):
+        # restore is keyed on INSTALLATION, not on prev's truthiness.
     except ValueError:
         pass  # not the main thread (tests drive this inline)
     try:
         while running is None or running():
             _time.sleep(poll_s)
     finally:
-        if prev is not None:
+        if installed:
             try:
-                _signal.signal(_signal.SIGTERM, prev)
+                _signal.signal(_signal.SIGTERM,
+                               prev if prev is not None
+                               else _signal.SIG_DFL)
             except ValueError:
                 pass
 

@@ -8,7 +8,6 @@ reference parity: `standalone/runner.go:77-192`,
 import json
 import os
 import shutil
-import signal
 import subprocess
 import sys
 import time
