@@ -643,6 +643,9 @@ def main() -> None:
         n = int(sys.argv[sys.argv.index("--scale") + 1])
         b = (int(sys.argv[sys.argv.index("--scale-batch") + 1])
              if "--scale-batch" in sys.argv else 16 * n)
+        # (no int8/serving flags needed: _measure returns right after the
+        # bf16 fit when scale_devices is set — the dp row is the only
+        # thing a scale child computes)
         print(json.dumps(_measure(scale_devices=n, batch=b,
                                   n_short=1, n_long=5, repeats=1)),
               flush=True)

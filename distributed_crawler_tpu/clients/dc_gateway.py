@@ -43,6 +43,7 @@ import ssl
 import struct
 import subprocess
 import threading
+import time
 from typing import Any, Dict, Optional
 
 from .native import NativeTelegramClient, acquire_seed_db
@@ -290,12 +291,42 @@ class DcGateway:
     def _serve_conn(self, conn: socket.socket, addr, seq: int) -> None:
         engine = None
         in_session = False
+        # The auth deadline is ABSOLUTE over TLS handshake + the whole
+        # ladder.  Per-recv timeouts alone cannot bound it — a client can
+        # drip junk frames (each recv resets the idle window), drip bytes
+        # WITHIN one frame, or drip the TLS handshake itself — so a
+        # per-connection watchdog timer hard-stops the socket at the
+        # deadline.  shutdown() (not close()) unblocks any in-flight recv
+        # without freeing the fd, which could otherwise race a reused fd
+        # number on another thread.
+        holder = {"sock": conn, "ready": False}
+
+        def _auth_kill():
+            if not holder["ready"]:
+                try:
+                    holder["sock"].shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+        watchdog = threading.Timer(self.auth_timeout_s, _auth_kill)
+        watchdog.daemon = True
+        watchdog.start()
+        deadline = time.monotonic() + self.auth_timeout_s
         try:
-            # The auth deadline covers TLS handshake + the whole ladder.
             conn.settimeout(self.auth_timeout_s)
             if self._ssl_ctx is not None:
                 conn = self._ssl_ctx.wrap_socket(conn, server_side=True)
+                # wrap_socket() detaches the raw socket (fileno -1): track
+                # the wrapped one or close()/the watchdog can't reach this
+                # session.  If the watchdog fired mid-wrap it only saw the
+                # detached raw socket — honor the deadline here instead.
+                holder["sock"] = conn
+                with self._stats_mu:
+                    self._live_conns.append(conn)
+                if time.monotonic() >= deadline:
+                    raise socket.timeout("auth deadline")
             # 1. Handshake frame first, always.
+            conn.settimeout(max(0.001, deadline - time.monotonic()))
             first = recv_frame(conn)
             if first is None:
                 return
@@ -313,6 +344,11 @@ class DcGateway:
             account: Optional[Dict[str, str]] = None
             self._push_auth(conn, "authorizationStateWaitTdlibParameters")
             while not self._stop.is_set():
+                if state != "ready":
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise socket.timeout("auth deadline")
+                    conn.settimeout(remaining)
                 raw = recv_frame(conn)
                 if raw is None:
                     return
@@ -324,6 +360,8 @@ class DcGateway:
                     if state == "ready":
                         # 3. Ready: the session owns an engine; auth no
                         # longer bounds the read timeout.
+                        holder["ready"] = True
+                        watchdog.cancel()
                         conn.settimeout(None)
                         try:
                             engine = self._make_engine(seq)
@@ -351,6 +389,7 @@ class DcGateway:
         except (ValueError, ssl.SSLError, OSError) as e:
             logger.info("gateway connection %s dropped: %s", addr, e)
         finally:
+            watchdog.cancel()
             if engine is not None:
                 engine.close()
             if in_session:

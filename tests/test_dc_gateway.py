@@ -512,3 +512,77 @@ class TestTwoProcessE2E:
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait(timeout=5)
+
+
+class TestAuthDeadlineAndTlsShutdown:
+    """Round-4 review regressions: (a) close() must reach TLS sessions
+    (wrap_socket detaches the raw socket the accept loop tracked); (b) the
+    auth deadline is ABSOLUTE over the ladder — dripping junk frames (or
+    bytes) must not keep resetting an idle window."""
+
+    def test_close_terminates_live_tls_session(self):
+        gw = DcGateway(seed_json=SEED, expected_code="13579",
+                       tls=True).start()
+        c = NativeTelegramClient(server_addr=gw.address, conn_id="tc1",
+                                 tls=True, tls_insecure=True)
+        try:
+            c.authenticate("+15550001111", "13579")
+            # The server bumps active_sessions just AFTER replying to the
+            # final ladder step — poll briefly instead of racing it.
+            deadline = time.time() + 3.0
+            while (time.time() < deadline
+                   and gw.status()["active_sessions"] != 1):
+                time.sleep(0.05)
+            assert gw.status()["active_sessions"] == 1
+            gw.close()
+            deadline = time.time() + 3.0
+            while (time.time() < deadline
+                   and gw.status()["active_sessions"] != 0):
+                time.sleep(0.05)
+            assert gw.status()["active_sessions"] == 0, \
+                "TLS session survived gateway close()"
+        finally:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+    def test_auth_deadline_is_absolute_under_frame_drip(self):
+        import socket as socket_mod
+        import ssl as ssl_mod
+        import struct
+
+        gw = DcGateway(seed_json=SEED, expected_code="13579", tls=True,
+                       auth_timeout_s=1.5).start()
+        try:
+            ctx = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_CLIENT)
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl_mod.CERT_NONE
+            raw = socket_mod.create_connection((gw.host, gw.port),
+                                               timeout=10)
+            s = ctx.wrap_socket(raw)
+
+            def frame(payload: bytes) -> bytes:
+                return struct.pack(">I", len(payload)) + payload
+
+            s.sendall(frame(json.dumps({"@type": "handshake"}).encode()))
+            s.settimeout(1.0)
+            t0 = time.time()
+            dropped_at = None
+            # Drip a junk frame every 0.5 s: each recv under the pre-fix
+            # per-recv timeout opened a fresh 1.5 s idle window, so the
+            # connection would live indefinitely.
+            for i in range(14):
+                try:
+                    s.sendall(frame(json.dumps({"@type": "junk"}).encode()))
+                    s.recv(65536)
+                except (OSError, ssl_mod.SSLError):
+                    dropped_at = time.time() - t0
+                    break
+                time.sleep(0.5)
+            assert dropped_at is not None, (
+                "unauthenticated dripper survived 7s against a 1.5s "
+                "auth deadline")
+            assert dropped_at < 5.0, f"dropped too late: {dropped_at:.1f}s"
+        finally:
+            gw.close()

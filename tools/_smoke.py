@@ -1,0 +1,26 @@
+"""Shared --smoke bootstrap for the tools/ measurement harnesses.
+
+Importing this module (BEFORE jax) forces the CPU backend when --smoke is
+on the command line: the env var must land before jax reads it, and —
+because the host sitecustomize pre-imports jax with the accelerator-tunnel
+platform, freezing the env snapshot — the config must be forced again
+after import (same dance as tests/conftest.py).  Usage:
+
+    import _smoke            # pre-jax: env var
+    import jax
+    _smoke.apply(jax)        # post-jax: config override
+"""
+
+import os
+import sys
+
+SMOKE = "--smoke" in sys.argv
+
+if SMOKE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def apply(jax_module) -> None:
+    """Post-import half: pin the already-imported jax to CPU under --smoke."""
+    if SMOKE:
+        jax_module.config.update("jax_platforms", "cpu")

@@ -16,7 +16,11 @@ import sys
 import time
 from dataclasses import replace
 
+import _smoke  # noqa: F401 — pre-jax half of the --smoke CPU forcing
+
 import jax
+
+_smoke.apply(jax)
 import jax.numpy as jnp
 import numpy as np
 
@@ -29,7 +33,6 @@ from distributed_crawler_tpu.models.encoder import (  # noqa: E402
     EmbedderClassifier,
 )
 from distributed_crawler_tpu.models.quant import (  # noqa: E402
-    calibrate_activation_scales,
     quantize_encoder_params,
 )
 
@@ -102,13 +105,14 @@ def main():
                           "t_iter_ms": round(tq * 1e3, 2),
                           "posts_per_sec": round(batch / tq, 1),
                           "speedup_vs_bf16": round(ti / tq, 3)}), flush=True)
-        # Static activation scales: the fused-quantize variant.
-        calib_model = EmbedderClassifier(replace(cfg, calibrate=True))
-        scales = calibrate_activation_scales(calib_model, params,
-                                             ids[:64], mask[:64])
-        smodel = EmbedderClassifier(replace(cfg, quant="int8_static"))
-        sparams = quantize_encoder_params(params, act_scales=scales)
-        ts = t_iter_chained(smodel, sparams, ids, mask, VOCAB)
+        # Static activation scales: bench's ONE shared static-leg recipe,
+        # imported so the experiment and the shipped benchmark can never
+        # measure different int8_static configurations.
+        from bench import _fit_int8_static
+
+        ts = _fit_int8_static(
+            cfg, params, ids, mask,
+            lambda m, p: t_iter_chained(m, p, ids, mask, VOCAB))
         print(json.dumps({"cfg": name, "quant": "int8_static",
                           "batch": batch,
                           "t_iter_ms": round(ts * 1e3, 2),

@@ -23,7 +23,11 @@ import sys
 import time
 from dataclasses import replace
 
+import _smoke  # noqa: F401 — pre-jax half of the --smoke CPU forcing
+
 import jax
+
+_smoke.apply(jax)
 import jax.numpy as jnp
 import numpy as np
 
