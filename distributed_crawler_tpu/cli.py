@@ -73,6 +73,12 @@ def build_parser() -> argparse.ArgumentParser:
     a("--dc-tls-insecure", action="store_const", const=True, default=None,
       help="skip cert verification (self-signed gateway bootstrap)")
     a("--dc-sni", default=None, help="TLS SNI override")
+    a("--dc-wire", default=None, choices=["dct", "mtproto"],
+      help="client wire protocol (must match the gateway's --gateway-wire)")
+    a("--dc-pubkey-file", default=None,
+      help="gateway RSA public key JSON ({n, e}; written by a "
+           "--gateway-wire mtproto gateway as <address-file>.pubkey) — "
+           "required with --dc-wire mtproto")
     a("--min-users", type=int, default=None)
     a("--crawl-id", default=None)
     a("--crawl-label", default=None)
@@ -232,6 +238,11 @@ def build_parser() -> argparse.ArgumentParser:
            "--tdlib-database-url supplies a tarball/dir store instead)")
     a("--gateway-address-file", default=None,
       help="write host:port here once bound (discovery for port 0)")
+    a("--gateway-wire", default=None, choices=["dct", "mtproto"],
+      help="wire protocol: dct (DCT-v1 frames, default) or mtproto "
+           "(MTProto 2.0: auth-key handshake + AES-IGE messages, "
+           "`native/mtproto.h`); mtproto writes the server public key to "
+           "<address-file>.pubkey for clients (--dc-pubkey-file)")
     a("--version", action="store_true")
     return p
 
@@ -325,7 +336,10 @@ _KEY_MAP = {
     "dc_tls": "tdlib.dc_tls",
     "dc_tls_insecure": "tdlib.dc_tls_insecure",
     "dc_sni": "tdlib.dc_sni",
+    "dc_wire": "tdlib.dc_wire",
+    "dc_pubkey_file": "tdlib.dc_pubkey_file",
     "gateway_listen": "gateway.listen",
+    "gateway_wire": "gateway.wire",
     "gateway_tls": "gateway.tls",
     "gateway_tls_cert": "gateway.tls_cert",
     "gateway_tls_key": "gateway.tls_key",
@@ -359,6 +373,8 @@ def resolve_config(args: argparse.Namespace,
     cfg.dc_tls = r.get_bool("tdlib.dc_tls", False)
     cfg.dc_tls_insecure = r.get_bool("tdlib.dc_tls_insecure", False)
     cfg.dc_sni = r.get_str("tdlib.dc_sni")
+    cfg.dc_wire = r.get_str("tdlib.dc_wire")
+    cfg.dc_pubkey_file = r.get_str("tdlib.dc_pubkey_file")
     cfg.min_users = r.get_int("crawler.minusers", 100)
     cfg.crawl_id = r.get_str("crawler.crawlid") or generate_crawl_id()
     cfg.crawl_label = r.get_str("crawler.crawllabel")
@@ -669,7 +685,8 @@ def _serve_forever(poll_s: float = 1.0,
 
 def _gen_code(tdlib_dir: str = ".tdlib", env=None, server_addr: str = "",
               tls: bool = False, tls_insecure: bool = False,
-              sni: str = "") -> int:
+              sni: str = "", wire: str = "",
+              server_pubkey_file: str = "") -> int:
     """Auth bootstrap (`standalone/runner.go:77-192`): drive the ladder
     from TG_* env — against a remote dc-gateway when --dc-address is set,
     else the embedded auth-enabled engine — and write credentials.json
@@ -681,7 +698,8 @@ def _gen_code(tdlib_dir: str = ".tdlib", env=None, server_addr: str = "",
         if server_addr:
             client = NativeTelegramClient(
                 server_addr=server_addr, tls=tls,
-                tls_insecure=tls_insecure, sni=sni, conn_id="gen-code")
+                tls_insecure=tls_insecure, sni=sni, wire=wire,
+                server_pubkey_file=server_pubkey_file, conn_id="gen-code")
         path = generate_pcode(tdlib_dir=tdlib_dir, env=env, client=client)
     except Exception as e:
         print(f"error: {e}", file=sys.stderr)
@@ -700,7 +718,9 @@ def _run_gen_code(r: ConfigResolver) -> int:
         server_addr=r.get_str("tdlib.dc_address"),
         tls=r.get_bool("tdlib.dc_tls", False),
         tls_insecure=r.get_bool("tdlib.dc_tls_insecure", False),
-        sni=r.get_str("tdlib.dc_sni"))
+        sni=r.get_str("tdlib.dc_sni"),
+        wire=r.get_str("tdlib.dc_wire"),
+        server_pubkey_file=r.get_str("tdlib.dc_pubkey_file"))
 
 
 def _run_dc_gateway(cfg: CrawlerConfig, r: ConfigResolver) -> None:
@@ -735,6 +755,7 @@ def _run_dc_gateway(cfg: CrawlerConfig, r: ConfigResolver) -> None:
         seed_source=cfg.tdlib_database_url,
         store_root=os.path.join(cfg.storage_root or ".", "dc-gateway"),
         address_file=r.get_str("gateway.address_file"),
+        wire=r.get_str("gateway.wire", "dct") or "dct",
     ).start()
     set_status_provider(gw.status)
     try:

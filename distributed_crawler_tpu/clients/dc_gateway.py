@@ -59,10 +59,15 @@ DEFAULT_AUTH_TIMEOUT_S = 30.0
 
 
 def send_frame(sock, payload: bytes) -> None:
+    if hasattr(sock, "send_payload"):  # wire adapter (e.g. MTProto)
+        sock.send_payload(payload)
+        return
     sock.sendall(_HEADER.pack(len(payload)) + payload)
 
 
 def recv_frame(sock) -> Optional[bytes]:
+    if hasattr(sock, "recv_payload"):  # wire adapter (e.g. MTProto)
+        return sock.recv_payload()
     header = _recv_exact(sock, 4)
     if header is None:
         return None
@@ -98,6 +103,38 @@ def make_self_signed_cert(directory: str, cn: str = "localhost") -> tuple:
          f"/CN={cn}", "-addext", f"subjectAltName=DNS:{cn},IP:127.0.0.1"],
         check=True, capture_output=True)
     return cert, key
+
+
+class _MtprotoConn:
+    """Wire adapter: the DCT JSON session rides MTProto 2.0 encrypted
+    messages (`mtproto_wire`) instead of DCT-v1 length-prefixed frames.
+    Duck-types the socket surface the session loop / watchdog touch."""
+
+    def __init__(self, sock, rsa):
+        from .mtproto_wire import MtprotoServerSession
+
+        self._sock = sock
+        # Constructor runs the full auth-key handshake; the caller's auth
+        # deadline (socket timeout + watchdog) bounds it.
+        self._sess = MtprotoServerSession(sock, rsa)
+
+    def send_payload(self, payload: bytes) -> None:
+        self._sess.send(payload)
+
+    def recv_payload(self) -> Optional[bytes]:
+        return self._sess.recv()
+
+    def settimeout(self, t) -> None:
+        self._sock.settimeout(t)
+
+    def shutdown(self, how) -> None:
+        self._sock.shutdown(how)
+
+    def close(self) -> None:
+        self._sock.close()
+
+    def fileno(self) -> int:
+        return self._sock.fileno()
 
 
 def load_accounts(path: str) -> Dict[str, Dict[str, str]]:
@@ -141,7 +178,7 @@ class DcGateway:
                  seed_source: str = "", store_root: str = "",
                  tls_cert: str = "", tls_key: str = "",
                  auth_timeout_s: float = DEFAULT_AUTH_TIMEOUT_S,
-                 address_file: str = ""):
+                 address_file: str = "", wire: str = "dct"):
         self.seed_json = seed_json or '{"channels": []}'
         self.expected_code = expected_code
         self.expected_password = expected_password
@@ -177,6 +214,41 @@ class DcGateway:
             self._ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             self._ssl_ctx.load_cert_chain(tls_cert, tls_key)
         self.tls_cert = tls_cert
+        if wire not in ("dct", "mtproto"):
+            raise ValueError(f"unknown gateway wire {wire!r}")
+        self.wire = wire
+        self._rsa = None
+        self.pubkey_file = ""
+        if wire == "mtproto":
+            # The gateway's RSA key plays the role of Telegram's DC keys:
+            # clients load the public half {n, e} (written next to the
+            # address file / store root), the private half stays here.
+            from . import mtproto_wire as mtp
+
+            key_path = (os.path.join(store_root, "mtproto_rsa.json")
+                        if store_root else "")
+            if key_path and os.path.exists(key_path):
+                with open(key_path, "r", encoding="utf-8") as f:
+                    d = json.load(f)
+                self._rsa = mtp.RsaKey(n=int(d["n"], 16), e=int(d["e"]),
+                                       d=int(d["d"], 16))
+            else:
+                self._rsa = mtp.generate_rsa_key()
+                if key_path:
+                    os.makedirs(store_root, exist_ok=True)
+                    tmp = key_path + ".tmp"
+                    # 0600 from birth — the private exponent must never
+                    # be world-readable, even transiently.
+                    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                                 0o600)
+                    with os.fdopen(fd, "w", encoding="utf-8") as f:
+                        json.dump({"n": hex(self._rsa.n), "e": self._rsa.e,
+                                   "d": hex(self._rsa.d)}, f)
+                    os.replace(tmp, key_path)
+            self.pubkey_file = (address_file + ".pubkey" if address_file
+                                else os.path.join(store_root or ".",
+                                                  "mtproto.pubkey.json"))
+            mtp.save_pubkey(self.pubkey_file, self._rsa)
         self._stop = threading.Event()
         self._threads: list = []
         self._live_conns: list = []
@@ -241,6 +313,7 @@ class DcGateway:
             return {
                 "component": "dc-gateway",
                 "address": self.address,
+                "wire": self.wire,
                 "tls": self._ssl_ctx is not None,
                 "accounts": len(self.accounts),
                 "connections_total": self.connections,
@@ -325,6 +398,11 @@ class DcGateway:
                     self._live_conns.append(conn)
                 if time.monotonic() >= deadline:
                     raise socket.timeout("auth deadline")
+            if self.wire == "mtproto":
+                # MTProto 2.0 envelope: auth-key handshake now (bounded by
+                # the same watchdog/deadline), JSON session inside
+                # encrypted messages after.
+                conn = _MtprotoConn(conn, self._rsa)
             # 1. Handshake frame first, always.
             conn.settimeout(max(0.001, deadline - time.monotonic()))
             first = recv_frame(conn)

@@ -1,0 +1,666 @@
+"""MTProto 2.0 wire protocol — the reference's TDLib transport, in-tree.
+
+The reference links TDLib, whose transport to Telegram's data centers is
+MTProto: an auth-key DH handshake in plaintext TL messages, then
+AES-256-IGE-encrypted messages keyed per-message from the shared
+``auth_key`` (reference boundary: `Dockerfile.tdlib:19-36`,
+`telegramhelper/client.go:319-377` drives the ladder over it).  This
+module implements the protocol faithfully at the transport and crypto
+layers so the framework's native client can speak real MTProto to the
+in-tree DC gateway:
+
+- **intermediate transport framing** (``0xeeeeeeee`` init, 4-byte LE
+  length prefix);
+- **the creating-an-auth-key handshake** with the published TL schema
+  constructors (req_pq_multi/resPQ/req_DH_params/server_DH_params_ok/
+  set_client_DH_params/dh_gen_ok), RSA(SHA1+data+pad) for
+  p_q_inner_data, SHA1-derived tmp AES-IGE keys for the DH answer, and
+  a 2048-bit DH over the RFC 3526 MODP group;
+- **MTProto 2.0 message encryption**: msg_key = middle 16 bytes of
+  SHA256(auth_key[88+x:120+x] ‖ padded_plaintext), SHA256-based key/iv
+  derivation (x=0 client→server, x=8 server→client), AES-256-IGE.
+
+Honest delta vs the reference, by design: the payload riding INSIDE the
+encrypted envelope is the framework's JSON API schema (wrapped in one
+TL ``bytes`` value), not Telegram's full TL API layer — TDLib's ~3000
+generated constructors serve its client database, which this framework
+replaces with the gateway-side store.  The transport, handshake, and
+per-message crypto are the MTProto 2.0 spec.
+
+Both sides live here (client for tests/parity, server for the gateway);
+`native/mtproto.h` is the C++ client twin — the cross-implementation
+handshake in tests/test_mtproto.py is the parity proof.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import secrets
+import socket
+import struct
+import time
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+# -- TL constructor ids (public MTProto schema) -----------------------------
+REQ_PQ_MULTI = 0xBE7E8EF1
+RES_PQ = 0x05162463
+P_Q_INNER_DATA = 0x83C95AEC
+REQ_DH_PARAMS = 0xD712E4BE
+SERVER_DH_PARAMS_OK = 0xD0E8075C
+SERVER_DH_INNER_DATA = 0xB5890DBA
+CLIENT_DH_INNER_DATA = 0x6643B654
+SET_CLIENT_DH_PARAMS = 0xF5045F1F
+DH_GEN_OK = 0x3BCBF734
+VECTOR = 0x1CB5C415
+
+# RFC 3526 MODP-2048 safe prime (the DH group the gateway serves; Telegram
+# production uses its own 2048-bit safe prime of identical shape).
+DH_PRIME = int(
+    "FFFFFFFFFFFFFFFFC90FDAA22168C234C4C6628B80DC1CD129024E088A67CC74"
+    "020BBEA63B139B22514A08798E3404DDEF9519B3CD3A431B302B0A6DF25F1437"
+    "4FE1356D6D51C245E485B576625E7EC6F44C42E9A637ED6B0BFF5CB6F406B7ED"
+    "EE386BFB5A899FA5AE9F24117C4B1FE649286651ECE45B3DC2007CB8A163BF05"
+    "98DA48361C55D39A69163FA8FD24CF5F83655D23DCA3AD961C62F356208552BB"
+    "9ED529077096966D670C354E4ABC9804F1746C08CA18217C32905E462E36CE3B"
+    "E39E772C180E86039B2783A2EC07A28FB5C55DF06F4C52C9DE2BCBF695581718"
+    "3995497CEA956AE515D2261898FA051015728E5A8AACAA68FFFFFFFFFFFFFFFF",
+    16)
+DH_G = 2
+
+INTERMEDIATE_INIT = b"\xee\xee\xee\xee"
+MAX_PACKET = 64 * 1024 * 1024
+
+
+# -- small helpers ----------------------------------------------------------
+def sha1(b: bytes) -> bytes:
+    return hashlib.sha1(b).digest()
+
+
+def sha256(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()
+
+
+def xor(a: bytes, b: bytes) -> bytes:
+    return bytes(x ^ y for x, y in zip(a, b))
+
+
+def ige_encrypt(key: bytes, iv: bytes, data: bytes) -> bytes:
+    """AES-256-IGE (key 32B; iv 32B = iv1‖iv2; len(data) % 16 == 0)."""
+    if len(data) % 16:
+        raise ValueError("IGE needs 16-byte-aligned input")
+    enc = Cipher(algorithms.AES(key), modes.ECB()).encryptor()
+    iv1, iv2 = iv[:16], iv[16:32]
+    out = bytearray()
+    for i in range(0, len(data), 16):
+        blk = data[i:i + 16]
+        c = xor(enc.update(xor(blk, iv1)), iv2)
+        out += c
+        iv1, iv2 = c, blk
+    return bytes(out)
+
+
+def ige_decrypt(key: bytes, iv: bytes, data: bytes) -> bytes:
+    if len(data) % 16:
+        raise ValueError("IGE needs 16-byte-aligned input")
+    dec = Cipher(algorithms.AES(key), modes.ECB()).decryptor()
+    iv1, iv2 = iv[:16], iv[16:32]
+    out = bytearray()
+    for i in range(0, len(data), 16):
+        blk = data[i:i + 16]
+        p = xor(dec.update(xor(blk, iv2)), iv1)
+        out += p
+        iv1, iv2 = blk, p
+    return bytes(out)
+
+
+def tl_bytes(b: bytes) -> bytes:
+    """TL `bytes`/`string` serialization (1- or 4-byte length, pad to 4)."""
+    if len(b) < 254:
+        out = bytes([len(b)]) + b
+    else:
+        out = b"\xfe" + len(b).to_bytes(3, "little") + b
+    pad = (-len(out)) % 4
+    return out + b"\x00" * pad
+
+
+class TlReader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.off = 0
+
+    def _take(self, n: int) -> bytes:
+        if self.off + n > len(self.data):
+            raise ValueError("TL underrun")
+        b = self.data[self.off:self.off + n]
+        self.off += n
+        return b
+
+    def uint32(self) -> int:
+        return struct.unpack("<I", self._take(4))[0]
+
+    def int64(self) -> int:
+        return struct.unpack("<q", self._take(8))[0]
+
+    def raw(self, n: int) -> bytes:
+        return self._take(n)
+
+    def tl_bytes(self) -> bytes:
+        n = self._take(1)[0]
+        if n == 254:
+            n = int.from_bytes(self._take(3), "little")
+            b = self._take(n)
+            self._take((-n) % 4)
+        else:
+            b = self._take(n)
+            self._take((-(n + 1)) % 4)
+        return b
+
+
+def u32(v: int) -> bytes:
+    return struct.pack("<I", v & 0xFFFFFFFF)
+
+
+def i32(v: int) -> bytes:
+    return struct.pack("<i", v)
+
+
+def i64(v: int) -> bytes:
+    return struct.pack("<q", v)
+
+
+def int_to_bytes(v: int) -> bytes:
+    return v.to_bytes((v.bit_length() + 7) // 8 or 1, "big")
+
+
+# -- RSA (the gateway's "server public key") --------------------------------
+@dataclass
+class RsaKey:
+    """Raw-RSA key in the MTProto style.  The PUBLIC half {n, e} is what
+    clients load (Telegram bakes its DC keys into clients; the gateway
+    writes ``<address_file>.pubkey.json`` for the same role)."""
+
+    n: int
+    e: int
+    d: Optional[int] = None  # server side only
+
+    @property
+    def fingerprint(self) -> int:
+        """Lower 8 bytes of SHA1 over the TL-serialized public key — the
+        exact fingerprint rule of the MTProto spec."""
+        data = tl_bytes(int_to_bytes(self.n)) + tl_bytes(int_to_bytes(self.e))
+        return int.from_bytes(sha1(data)[-8:], "little", signed=True)
+
+    def encrypt_with_hash(self, data: bytes) -> bytes:
+        """data_with_hash = SHA1(data) ‖ data ‖ random pad to 255; raw RSA."""
+        if len(data) > 255 - 20:
+            raise ValueError("RSA payload too large")
+        dwh = sha1(data) + data
+        dwh += secrets.token_bytes(255 - len(dwh))
+        c = pow(int.from_bytes(dwh, "big"), self.e, self.n)
+        return c.to_bytes(256, "big")
+
+    def decrypt_with_hash(self, cipher: bytes) -> bytes:
+        assert self.d is not None, "no private exponent"
+        m = pow(int.from_bytes(cipher, "big"), self.d, self.n)
+        try:
+            dwh = m.to_bytes(255, "big")
+        except OverflowError:
+            # Adversarial/garbage ciphertext decrypts to ~n-sized values;
+            # surface it as the protocol error the session loop handles.
+            raise ValueError("RSA decryption out of range") from None
+        digest, rest = dwh[:20], dwh[20:]
+        # Caller re-parses TL and knows the true length; verify the SHA1
+        # prefix against every feasible split is wasteful — instead TL
+        # parse first, then verify (see server handshake).
+        return digest, rest  # type: ignore[return-value]
+
+
+def generate_rsa_key(bits: int = 2048) -> RsaKey:
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    k = rsa.generate_private_key(public_exponent=65537, key_size=bits)
+    pub = k.public_key().public_numbers()
+    return RsaKey(n=pub.n, e=pub.e, d=k.private_numbers().d)
+
+
+# -- pq ---------------------------------------------------------------------
+def _small_prime(bits: int = 31) -> int:
+    """Random prime around 2^bits (pq must fit 63 bits as a TL bytes)."""
+    while True:
+        c = secrets.randbits(bits) | (1 << (bits - 1)) | 1
+        if _is_prime(c):
+            return c
+
+
+def _is_prime(n: int) -> bool:
+    if n < 2:
+        return False
+    for p in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37):
+        if n % p == 0:
+            return n == p
+    d, r = n - 1, 0
+    while d % 2 == 0:
+        d //= 2
+        r += 1
+    for a in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37):
+        x = pow(a, d, n)
+        if x in (1, n - 1):
+            continue
+        for _ in range(r - 1):
+            x = x * x % n
+            if x == n - 1:
+                break
+        else:
+            return False
+    return True
+
+
+def factor_pq(pq: int) -> Tuple[int, int]:
+    """Pollard's rho — the client-side factorization step (also used by
+    tests to cross-check the C++ implementation)."""
+    if pq % 2 == 0:
+        return 2, pq // 2
+    import math
+    import random
+
+    rnd = random.Random(0xDC7)
+    while True:
+        x = rnd.randrange(2, pq)
+        y, c, d = x, rnd.randrange(1, pq), 1
+        while d == 1:
+            x = (x * x + c) % pq
+            y = (y * y + c) % pq
+            y = (y * y + c) % pq
+            d = math.gcd(abs(x - y), pq)
+        if d != pq:
+            p, q = sorted((d, pq // d))
+            return p, q
+
+
+# -- MTProto 2.0 message crypto --------------------------------------------
+def kdf(auth_key: bytes, msg_key: bytes, to_server: bool) -> Tuple[bytes,
+                                                                   bytes]:
+    """MTProto 2.0 key derivation (x=0 client→server, x=8 server→client)."""
+    x = 0 if to_server else 8
+    a = sha256(msg_key + auth_key[x:x + 36])
+    b = sha256(auth_key[40 + x:76 + x] + msg_key)
+    aes_key = a[0:8] + b[8:24] + a[24:32]
+    aes_iv = b[0:8] + a[8:24] + b[24:32]
+    return aes_key, aes_iv
+
+
+def compute_msg_key(auth_key: bytes, padded_plain: bytes,
+                    to_server: bool) -> bytes:
+    x = 0 if to_server else 8
+    return sha256(auth_key[88 + x:120 + x] + padded_plain)[8:24]
+
+
+@dataclass
+class Session:
+    """One side of an established MTProto session: encrypt/decrypt the
+    framework's payloads as MTProto 2.0 encrypted messages."""
+
+    auth_key: bytes
+    server_salt: bytes
+    session_id: bytes
+    is_client: bool
+    seq: int = 0
+    _last_msg_id: int = 0
+
+    @property
+    def auth_key_id(self) -> bytes:
+        return sha1(self.auth_key)[12:20]
+
+    def _next_msg_id(self) -> int:
+        # unixtime<<32, low 2 bits 0 for client originals, 3 for server
+        # originals/pushes (per spec); strictly increasing.
+        mid = (int(time.time()) << 32) | secrets.randbits(22) << 2
+        mid |= 0 if self.is_client else 3
+        if mid <= self._last_msg_id:
+            mid = self._last_msg_id + 4
+        self._last_msg_id = mid
+        return mid
+
+    def encrypt(self, payload: bytes) -> bytes:
+        self.seq += 1
+        inner = (self.server_salt + self.session_id +
+                 i64(self._next_msg_id()) + u32(self.seq * 2 + 1) +
+                 u32(len(payload)) + payload)
+        # Padding: 12..1024 random bytes, total length % 16 == 0 (spec).
+        pad = 16 - (len(inner) + 12) % 16
+        inner += secrets.token_bytes(12 + (pad % 16))
+        to_server = self.is_client
+        msg_key = compute_msg_key(self.auth_key, inner, to_server)
+        key, iv = kdf(self.auth_key, msg_key, to_server)
+        return self.auth_key_id + msg_key + ige_encrypt(key, iv, inner)
+
+    def decrypt(self, packet: bytes) -> bytes:
+        if len(packet) < 24 + 32:
+            raise ValueError("short encrypted message")
+        if packet[:8] != self.auth_key_id:
+            raise ValueError("unknown auth_key_id")
+        msg_key = packet[8:24]
+        to_server = not self.is_client  # we decrypt what the peer sent
+        key, iv = kdf(self.auth_key, msg_key, to_server)
+        inner = ige_decrypt(key, iv, packet[24:])
+        # msg_key check BEFORE trusting any field (2.0 requires the check
+        # over the padded plaintext; a mismatch is a forged/corrupt frame).
+        if compute_msg_key(self.auth_key, inner, to_server) != msg_key:
+            raise ValueError("msg_key mismatch")
+        r = TlReader(inner)
+        r.raw(8)  # salt
+        sid = r.raw(8)
+        if not self.is_client and not self.session_id:
+            # The client mints the session id (per spec); the server
+            # adopts it from the first VALIDATED message.
+            self.session_id = sid
+        r.int64()  # msg_id
+        r.uint32()  # seq_no
+        n = r.uint32()
+        if n > len(inner) - 32:
+            raise ValueError("bad inner length")
+        return r.raw(n)
+
+
+# -- intermediate transport -------------------------------------------------
+class Transport:
+    """MTProto intermediate framing over a socket (0xeeeeeeee init from
+    the client, then 4-byte LE length-prefixed packets)."""
+
+    def __init__(self, sock: socket.socket, is_server: bool):
+        self.sock = sock
+        if is_server:
+            init = self._recv_exact(4)
+            if init != INTERMEDIATE_INIT:
+                raise ValueError("not an intermediate-transport client")
+        else:
+            sock.sendall(INTERMEDIATE_INIT)
+
+    def send(self, payload: bytes) -> None:
+        self.sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+    def recv(self) -> bytes:
+        n = struct.unpack("<I", self._recv_exact(4))[0]
+        if n > MAX_PACKET:
+            raise ValueError("oversized packet")
+        return self._recv_exact(n)
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            buf += chunk
+        return buf
+
+
+def plain_message(body: bytes, msg_id: int) -> bytes:
+    return b"\x00" * 8 + i64(msg_id) + u32(len(body)) + body
+
+
+def parse_plain(packet: bytes) -> bytes:
+    r = TlReader(packet)
+    if r.int64() != 0:
+        raise ValueError("expected plain message (auth_key_id=0)")
+    r.int64()  # msg_id
+    n = r.uint32()
+    return r.raw(n)
+
+
+# -- handshake: server side -------------------------------------------------
+@dataclass
+class ServerHandshake:
+    """Drives the creating-an-auth-key exchange from the gateway side."""
+
+    rsa: RsaKey
+    dh_prime: int = DH_PRIME
+    g: int = DH_G
+    nonce: bytes = b""
+    server_nonce: bytes = b""
+    new_nonce: bytes = b""
+    _p: int = 0
+    _q: int = 0
+    _a: int = 0
+    auth_key: bytes = b""
+    server_salt: bytes = b""
+
+    def handle(self, packet: bytes) -> Tuple[Optional[bytes], bool]:
+        """Feed one plain packet; returns (reply, done)."""
+        body = parse_plain(packet)
+        r = TlReader(body)
+        ctor = r.uint32()
+        if ctor == REQ_PQ_MULTI:
+            return self._on_req_pq(r), False
+        if ctor == REQ_DH_PARAMS:
+            return self._on_req_dh(r), False
+        if ctor == SET_CLIENT_DH_PARAMS:
+            return self._on_set_dh(r), True
+        raise ValueError(f"unexpected handshake ctor {ctor:#x}")
+
+    def _reply(self, body: bytes) -> bytes:
+        # Server handshake replies carry msg_id = unixtime<<32 | 1.
+        return plain_message(body, (int(time.time()) << 32) | 1)
+
+    def _on_req_pq(self, r: TlReader) -> bytes:
+        self.nonce = r.raw(16)
+        self.server_nonce = secrets.token_bytes(16)
+        self._p, self._q = sorted((_small_prime(), _small_prime()))
+        pq = self._p * self._q
+        body = (u32(RES_PQ) + self.nonce + self.server_nonce +
+                tl_bytes(int_to_bytes(pq)) + u32(VECTOR) + u32(1) +
+                i64(self.rsa.fingerprint))
+        return self._reply(body)
+
+    def _on_req_dh(self, r: TlReader) -> bytes:
+        nonce = r.raw(16)
+        server_nonce = r.raw(16)
+        if nonce != self.nonce or server_nonce != self.server_nonce:
+            raise ValueError("nonce mismatch in req_DH_params")
+        p = int.from_bytes(r.tl_bytes(), "big")
+        q = int.from_bytes(r.tl_bytes(), "big")
+        if (p, q) != (self._p, self._q):
+            raise ValueError("wrong factorization")
+        fp = r.int64()
+        if fp != self.rsa.fingerprint:
+            raise ValueError("unknown RSA fingerprint")
+        encrypted = r.tl_bytes()
+        digest, rest = self.rsa.decrypt_with_hash(encrypted)
+        ir = TlReader(rest)
+        if ir.uint32() != P_Q_INNER_DATA:
+            raise ValueError("bad p_q_inner_data")
+        inner_pq = ir.tl_bytes()
+        ir.tl_bytes()  # p
+        ir.tl_bytes()  # q
+        if ir.raw(16) != self.nonce:
+            raise ValueError("inner nonce mismatch")
+        if ir.raw(16) != self.server_nonce:
+            raise ValueError("inner server_nonce mismatch")
+        self.new_nonce = ir.raw(32)
+        if sha1(rest[:ir.off]) != digest:
+            raise ValueError("inner data SHA1 mismatch")
+        if int.from_bytes(inner_pq, "big") != self._p * self._q:
+            raise ValueError("inner pq mismatch")
+        # DH answer, encrypted with the SHA1-derived tmp key/iv.
+        self._a = secrets.randbits(2048) % self.dh_prime
+        g_a = pow(self.g, self._a, self.dh_prime)
+        answer = (u32(SERVER_DH_INNER_DATA) + self.nonce +
+                  self.server_nonce + i32(self.g) +
+                  tl_bytes(self.dh_prime.to_bytes(256, "big")) +
+                  tl_bytes(int_to_bytes(g_a)) + i32(int(time.time())))
+        key, iv = dh_tmp_key_iv(self.new_nonce, self.server_nonce)
+        awh = sha1(answer) + answer
+        awh += secrets.token_bytes((-len(awh)) % 16)
+        body = (u32(SERVER_DH_PARAMS_OK) + self.nonce + self.server_nonce +
+                tl_bytes(ige_encrypt(key, iv, awh)))
+        return self._reply(body)
+
+    def _on_set_dh(self, r: TlReader) -> bytes:
+        nonce = r.raw(16)
+        server_nonce = r.raw(16)
+        if nonce != self.nonce or server_nonce != self.server_nonce:
+            raise ValueError("nonce mismatch in set_client_DH_params")
+        encrypted = r.tl_bytes()
+        key, iv = dh_tmp_key_iv(self.new_nonce, self.server_nonce)
+        plain = ige_decrypt(key, iv, encrypted)
+        digest, inner = plain[:20], plain[20:]
+        ir = TlReader(inner)
+        if ir.uint32() != CLIENT_DH_INNER_DATA:
+            raise ValueError("bad client_DH_inner_data")
+        if ir.raw(16) != self.nonce or ir.raw(16) != self.server_nonce:
+            raise ValueError("client_DH nonce mismatch")
+        ir.int64()  # retry_id
+        g_b = int.from_bytes(ir.tl_bytes(), "big")
+        if sha1(inner[:ir.off]) != digest:
+            raise ValueError("client_DH SHA1 mismatch")
+        if not 1 < g_b < self.dh_prime - 1:
+            raise ValueError("g_b out of range")
+        auth_key_int = pow(g_b, self._a, self.dh_prime)
+        self.auth_key = auth_key_int.to_bytes(256, "big")
+        self.server_salt = xor(self.new_nonce[:8], self.server_nonce[:8])
+        aux = sha1(self.auth_key)[:8]
+        nnh1 = sha1(self.new_nonce + b"\x01" + aux)[-16:]
+        body = (u32(DH_GEN_OK) + self.nonce + self.server_nonce + nnh1)
+        return self._reply(body)
+
+
+def dh_tmp_key_iv(new_nonce: bytes, server_nonce: bytes) -> Tuple[bytes,
+                                                                  bytes]:
+    """SHA1-derived tmp AES key/iv protecting the DH answer (spec rule)."""
+    k = sha1(new_nonce + server_nonce) + sha1(server_nonce + new_nonce)[:12]
+    iv = (sha1(server_nonce + new_nonce)[12:20] +
+          sha1(new_nonce + new_nonce) + new_nonce[:4])
+    return k, iv
+
+
+# -- handshake: client side (tests / parity with native/mtproto.h) ----------
+def client_handshake(transport: Transport, pub: RsaKey) -> Session:
+    nonce = secrets.token_bytes(16)
+    transport.send(plain_message(u32(REQ_PQ_MULTI) + nonce,
+                                 _client_msg_id()))
+    r = TlReader(parse_plain(transport.recv()))
+    if r.uint32() != RES_PQ:
+        raise ValueError("expected resPQ")
+    if r.raw(16) != nonce:
+        raise ValueError("resPQ nonce mismatch")
+    server_nonce = r.raw(16)
+    pq = int.from_bytes(r.tl_bytes(), "big")
+    if r.uint32() != VECTOR:
+        raise ValueError("expected fingerprint vector")
+    fps = [r.int64() for _ in range(r.uint32())]
+    if pub.fingerprint not in fps:
+        raise ValueError("server offered no known RSA fingerprint")
+    p, q = factor_pq(pq)
+    new_nonce = secrets.token_bytes(32)
+    inner = (u32(P_Q_INNER_DATA) + tl_bytes(int_to_bytes(pq)) +
+             tl_bytes(int_to_bytes(p)) + tl_bytes(int_to_bytes(q)) +
+             nonce + server_nonce + new_nonce)
+    req = (u32(REQ_DH_PARAMS) + nonce + server_nonce +
+           tl_bytes(int_to_bytes(p)) + tl_bytes(int_to_bytes(q)) +
+           i64(pub.fingerprint) + tl_bytes(pub.encrypt_with_hash(inner)))
+    transport.send(plain_message(req, _client_msg_id()))
+    r = TlReader(parse_plain(transport.recv()))
+    if r.uint32() != SERVER_DH_PARAMS_OK:
+        raise ValueError("expected server_DH_params_ok")
+    if r.raw(16) != nonce or r.raw(16) != server_nonce:
+        raise ValueError("DH params nonce mismatch")
+    key, iv = dh_tmp_key_iv(new_nonce, server_nonce)
+    awh = ige_decrypt(key, iv, r.tl_bytes())
+    digest, answer = awh[:20], awh[20:]
+    ar = TlReader(answer)
+    if ar.uint32() != SERVER_DH_INNER_DATA:
+        raise ValueError("bad server_DH_inner_data")
+    if ar.raw(16) != nonce or ar.raw(16) != server_nonce:
+        raise ValueError("server_DH nonce mismatch")
+    g = struct.unpack("<i", ar.raw(4))[0]
+    dh_prime = int.from_bytes(ar.tl_bytes(), "big")
+    g_a = int.from_bytes(ar.tl_bytes(), "big")
+    ar.raw(4)  # server_time
+    if sha1(answer[:ar.off]) != digest:
+        raise ValueError("server_DH SHA1 mismatch")
+    if dh_prime.bit_length() != 2048 or not 1 < g_a < dh_prime - 1:
+        raise ValueError("bad DH group")
+    b = secrets.randbits(2048) % dh_prime
+    g_b = pow(g, b, dh_prime)
+    auth_key_int = pow(g_a, b, dh_prime)
+    auth_key = auth_key_int.to_bytes(256, "big")
+    inner = (u32(CLIENT_DH_INNER_DATA) + nonce + server_nonce + i64(0) +
+             tl_bytes(int_to_bytes(g_b)))
+    iwh = sha1(inner) + inner
+    iwh += secrets.token_bytes((-len(iwh)) % 16)
+    transport.send(plain_message(
+        u32(SET_CLIENT_DH_PARAMS) + nonce + server_nonce +
+        tl_bytes(ige_encrypt(key, iv, iwh)), _client_msg_id()))
+    r = TlReader(parse_plain(transport.recv()))
+    if r.uint32() != DH_GEN_OK:
+        raise ValueError("expected dh_gen_ok")
+    if r.raw(16) != nonce or r.raw(16) != server_nonce:
+        raise ValueError("dh_gen nonce mismatch")
+    aux = sha1(auth_key)[:8]
+    if r.raw(16) != sha1(new_nonce + b"\x01" + aux)[-16:]:
+        raise ValueError("new_nonce_hash1 mismatch")
+    return Session(auth_key=auth_key,
+                   server_salt=xor(new_nonce[:8], server_nonce[:8]),
+                   session_id=secrets.token_bytes(8), is_client=True)
+
+
+def _client_msg_id() -> int:
+    return (int(time.time()) << 32) | (secrets.randbits(20) << 2)
+
+
+# -- server session over a socket ------------------------------------------
+class MtprotoServerSession:
+    """Gateway-side wire session: intermediate transport + server handshake,
+    then encrypted payload exchange with the same recv()/send() shape the
+    DCT-v1 session loop uses."""
+
+    def __init__(self, sock: socket.socket, rsa: RsaKey):
+        self.transport = Transport(sock, is_server=True)
+        hs = ServerHandshake(rsa=rsa)
+        done = False
+        while not done:
+            reply, done = hs.handle(self.transport.recv())
+            if reply:
+                self.transport.send(reply)
+        self.session = Session(auth_key=hs.auth_key,
+                               server_salt=hs.server_salt,
+                               session_id=b"", is_client=False)
+
+    def recv(self) -> Optional[bytes]:
+        try:
+            packet = self.transport.recv()
+        except TimeoutError:
+            raise  # the session loop's auth deadline relies on this
+        except ConnectionError:
+            return None
+        # Session.decrypt adopts the client's session_id from the first
+        # validated message (the client mints it, per spec).
+        body = self.session.decrypt(packet)
+        # The API payload rides as one TL bytes value inside the envelope
+        # (see module docstring / native/mtproto.h send_frame).
+        return TlReader(body).tl_bytes()
+
+    def send(self, payload: bytes) -> None:
+        self.transport.send(self.session.encrypt(tl_bytes(payload)))
+
+
+def save_pubkey(path: str, key: RsaKey) -> None:
+    import json
+
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"n": hex(key.n), "e": key.e,
+                   "fingerprint": key.fingerprint}, f)
+    os.replace(tmp, path)
+
+
+def load_pubkey(path: str) -> RsaKey:
+    import json
+
+    with open(path, "r", encoding="utf-8") as f:
+        d = json.load(f)
+    return RsaKey(n=int(d["n"], 16), e=int(d["e"]))

@@ -161,7 +161,8 @@ class NativeTelegramClient:
                  require_auth: bool = False, expected_code: str = "",
                  expected_password: str = "", server_addr: str = "",
                  tls: bool = False, tls_insecure: bool = False,
-                 sni: str = ""):
+                 sni: str = "", wire: str = "",
+                 server_pubkey_file: str = ""):
         """Offline mode (default): the C++ engine serves from a seed store.
 
         Remote mode (``server_addr="host:port"``): every request rides the
@@ -169,7 +170,14 @@ class NativeTelegramClient:
         a TLS stream whose ClientHello is Chrome-shaped (`native/net.h`).
         The server then owns the store and the auth ladder
         (``authenticate()`` drives it, as the reference's CLI interactor
-        drove TDLib's, `telegramhelper/client.go:319-377`)."""
+        drove TDLib's, `telegramhelper/client.go:319-377`).
+
+        ``wire="mtproto"`` selects the MTProto 2.0 envelope
+        (`native/mtproto.h`): auth-key DH handshake on connect, AES-IGE
+        message encryption after — the reference's TDLib↔DC protocol.
+        Requires the server's RSA public key: ``server_pubkey_file``
+        points at the ``{n, e}`` JSON the gateway writes
+        (`mtproto_wire.save_pubkey`)."""
         self._lib = load_library(lib_path)
         self.conn_id = conn_id
         self.receive_timeout_s = receive_timeout_s
@@ -182,6 +190,12 @@ class NativeTelegramClient:
                 config["tls_insecure"] = True
             if sni:
                 config["sni"] = sni
+            if wire:
+                config["wire"] = wire
+            if server_pubkey_file:
+                with open(server_pubkey_file, "r", encoding="utf-8") as f:
+                    pk = json.load(f)
+                config["server_pubkey"] = {"n": pk["n"], "e": int(pk["e"])}
         elif seed_json:
             config["seed_json"] = seed_json
         elif seed_db:
@@ -676,7 +690,8 @@ def native_client_factory(seed_db: str = "", seed_json: str = "",
                           server_addr: str = "", tls: bool = False,
                           tls_insecure: bool = False, sni: str = "",
                           credentials: Optional[Dict[str, str]] = None,
-                          tdlib_dir: str = ".tdlib"):
+                          tdlib_dir: str = ".tdlib", wire: str = "",
+                          server_pubkey_file: str = ""):
     """Pool-compatible factory: returns a callable producing fresh
     authenticated clients (`telegramhelper/connection_pool.go:97-149`
     preloaded each conn from a DB URL).  With ``db_source`` set, each
@@ -692,7 +707,8 @@ def native_client_factory(seed_db: str = "", seed_json: str = "",
         if server_addr:
             client = NativeTelegramClient(
                 server_addr=server_addr, tls=tls,
-                tls_insecure=tls_insecure, sni=sni,
+                tls_insecure=tls_insecure, sni=sni, wire=wire,
+                server_pubkey_file=server_pubkey_file,
                 lib_path=lib_path, conn_id=conn_id)
             creds = credentials or load_credentials(tdlib_dir)
             if creds is None:

@@ -101,6 +101,11 @@ class CrawlerConfig:
     dc_tls: bool = False
     dc_tls_insecure: bool = False  # self-signed gateway bootstrap
     dc_sni: str = ""
+    # Wire protocol to the gateway: "" / "dct" = DCT-v1 frames;
+    # "mtproto" = MTProto 2.0 (`native/mtproto.h`) — needs the gateway's
+    # RSA public key JSON in dc_pubkey_file.
+    dc_wire: str = ""
+    dc_pubkey_file: str = ""
 
     # Date windows / sampling
     min_post_date: Optional[datetime] = None

@@ -106,6 +106,8 @@ def setup_pool_from_config(cfg: CrawlerConfig) -> bool:
             factory = native_client_factory(
                 server_addr=cfg.dc_address, tls=cfg.dc_tls,
                 tls_insecure=cfg.dc_tls_insecure, sni=cfg.dc_sni,
+                wire=getattr(cfg, "dc_wire", ""),
+                server_pubkey_file=getattr(cfg, "dc_pubkey_file", ""),
                 credentials=load_credentials(tdlib_dir),
                 tdlib_dir=tdlib_dir)
             pool = ConnectionPool(

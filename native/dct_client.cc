@@ -44,6 +44,7 @@
 #include <vector>
 
 #include "json.h"
+#include "mtproto.h"
 #include "net.h"
 
 using dctjson::Array;
@@ -51,6 +52,41 @@ using dctjson::Object;
 using dctjson::Value;
 
 namespace {
+
+// ---------------------------------------------------------------------------
+// Wire connections: DCT-v1 JSON frames, or MTProto 2.0 (mtproto.h) — the
+// reference's TDLib↔DC protocol.  One interface so the client core doesn't
+// care which envelope its JSON rides in.
+// ---------------------------------------------------------------------------
+
+struct WireConn {
+  virtual ~WireConn() = default;
+  virtual void send_frame(const std::string& payload) = 0;
+  virtual std::string recv_frame() = 0;
+  virtual void shutdown() = 0;
+  virtual bool wait_readable(int timeout_ms) = 0;
+};
+
+struct DctWire : WireConn {
+  explicit DctWire(std::unique_ptr<dctnet::Stream> stream)
+      : conn(std::move(stream)) {}
+  void send_frame(const std::string& p) override { conn.send_frame(p); }
+  std::string recv_frame() override { return conn.recv_frame(); }
+  void shutdown() override { conn.shutdown(); }
+  bool wait_readable(int ms) override { return conn.wait_readable(ms); }
+  dctnet::Connection conn;
+};
+
+struct MtprotoWire : WireConn {
+  MtprotoWire(std::unique_ptr<dctnet::Stream> stream,
+              const dctmtp::RsaPub& key)
+      : conn(std::move(stream), key) {}
+  void send_frame(const std::string& p) override { conn.send_frame(p); }
+  std::string recv_frame() override { return conn.recv_frame(); }
+  void shutdown() override { conn.shutdown(); }
+  bool wait_readable(int ms) override { return conn.wait_readable(ms); }
+  dctmtp::MtprotoConnection conn;
+};
 
 // ---------------------------------------------------------------------------
 // Store: channels, messages, files (the client database)
@@ -403,7 +439,7 @@ class Client {
   std::string phone_number_;
   std::thread worker_;
   // Remote mode: wire connection + its reader thread.
-  std::unique_ptr<dctnet::Connection> conn_;
+  std::unique_ptr<WireConn> conn_;
   std::thread reader_;
   std::atomic<bool> reader_stop_{false};
 
@@ -421,7 +457,22 @@ class Client {
     } else {
       stream.reset(new dctnet::TcpStream(host, port));
     }
-    conn_.reset(new dctnet::Connection(std::move(stream)));
+    if (cfg.get("wire").as_string() == "mtproto") {
+      // MTProto 2.0 envelope (mtproto.h): auth-key handshake on connect,
+      // AES-IGE-encrypted messages after — the reference's TDLib↔DC wire.
+      // The server public key rides in config ({n, e} hex/int), the same
+      // role as the DC keys baked into Telegram clients.
+      dctmtp::RsaPub key;
+      const Value& pk = cfg.get("server_pubkey");
+      if (pk.is_null())
+        throw std::runtime_error("wire=mtproto needs server_pubkey {n,e}");
+      key.n = dctmtp::hex_to_bytes(pk.get("n").as_string());
+      int64_t e = pk.get("e").as_int(65537);
+      key.e = dctmtp::be_bytes_u64(static_cast<uint64_t>(e));
+      conn_.reset(new MtprotoWire(std::move(stream), key));
+    } else {
+      conn_.reset(new DctWire(std::move(stream)));
+    }
     Object hello;
     hello["@type"] = Value("handshake");
     hello["transport_version"] = Value(int64_t(1));

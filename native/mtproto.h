@@ -1,0 +1,779 @@
+// MTProto 2.0 client transport for the dct native client.
+//
+// The reference's native boundary is TDLib, whose wire protocol to
+// Telegram's data centers is MTProto (built in Dockerfile.tdlib:19-36 and
+// driven through the auth ladder by telegramhelper/client.go:319-377).
+// This header implements the client side of that protocol faithfully at
+// the transport + crypto layers:
+//
+//   - intermediate transport framing (0xeeeeeeee init, 4-byte LE length);
+//   - the creating-an-auth-key handshake with the published TL schema
+//     constructors (req_pq_multi/resPQ/req_DH_params/server_DH_params_ok/
+//     set_client_DH_params/dh_gen_ok), RSA(SHA1 ‖ data ‖ pad) for
+//     p_q_inner_data, Pollard-rho pq factorization, SHA1-derived tmp
+//     AES-IGE keys for the DH answer, 2048-bit DH;
+//   - MTProto 2.0 message encryption: msg_key = SHA256(auth_key[88+x..]
+//     ‖ padded plaintext)[8:24], SHA256-based key/iv derivation (x=0
+//     client→server, 8 server→client), AES-256-IGE.
+//
+// Honest delta, by design: the payload inside the encrypted envelope is
+// the framework's JSON API (one TL bytes value), not Telegram's ~3000-
+// constructor TL API layer — TDLib's generated schema feeds its client
+// database, which this framework replaces with the gateway-side store.
+// The Python twin (clients/mtproto_wire.py) implements both sides; the
+// cross-implementation handshake in tests/test_mtproto.py is the parity
+// proof.
+//
+// Crypto comes from libcrypto.so.3 via dlopen (no dev headers in the
+// image), mirroring net.h's OpenSSL loading pattern.
+
+#ifndef DCT_NATIVE_MTPROTO_H_
+#define DCT_NATIVE_MTPROTO_H_
+
+#include <dlfcn.h>
+
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <memory>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "net.h"
+
+namespace dctmtp {
+
+class MtprotoError : public std::runtime_error {
+ public:
+  explicit MtprotoError(const std::string& what)
+      : std::runtime_error(what) {}
+};
+
+// ---------------------------------------------------------------------------
+// libcrypto via dlopen (SHA/AES/BN/RAND) — same degradation policy as
+// net.h: a missing libcrypto fails MTProto connects with a clear error;
+// the plain DCT-v1 wire never touches this.
+// ---------------------------------------------------------------------------
+
+// Layout-compatible with OpenSSL's aes_key_st (AES_MAXNR = 14).
+struct AesKey {
+  unsigned int rd_key[60];
+  int rounds;
+};
+
+struct BnCtx;   // opaque
+struct BigNum;  // opaque
+
+struct CryptoLib {
+  unsigned char* (*SHA1)(const unsigned char*, size_t, unsigned char*);
+  unsigned char* (*SHA256)(const unsigned char*, size_t, unsigned char*);
+  int (*AES_set_encrypt_key)(const unsigned char*, int, AesKey*);
+  int (*AES_set_decrypt_key)(const unsigned char*, int, AesKey*);
+  void (*AES_ige_encrypt)(const unsigned char*, unsigned char*, size_t,
+                          const AesKey*, unsigned char*, int);
+  int (*RAND_bytes)(unsigned char*, int);
+  BigNum* (*BN_new)();
+  void (*BN_free)(BigNum*);
+  BigNum* (*BN_bin2bn)(const unsigned char*, int, BigNum*);
+  int (*BN_bn2bin)(const BigNum*, unsigned char*);
+  int (*BN_num_bits)(const BigNum*);
+  BnCtx* (*BN_CTX_new)();
+  void (*BN_CTX_free)(BnCtx*);
+  int (*BN_mod_exp)(BigNum*, const BigNum*, const BigNum*, const BigNum*,
+                    BnCtx*);
+
+  static CryptoLib& get() {
+    static CryptoLib instance;
+    return instance;
+  }
+
+  bool ok() const { return err_.empty(); }
+  const std::string& error() const { return err_; }
+
+ private:
+  CryptoLib() {
+    void* crypto = nullptr;
+    for (const char* name : {"libcrypto.so.3", "libcrypto.so"}) {
+      crypto = ::dlopen(name, RTLD_NOW | RTLD_GLOBAL);
+      if (crypto) break;
+    }
+    if (!crypto) {
+      err_ = "libcrypto not found for MTProto transport";
+      return;
+    }
+    auto need = [this, crypto](const char* sym) -> void* {
+      void* fn = ::dlsym(crypto, sym);
+      if (!fn && err_.empty())
+        err_ = std::string("missing libcrypto symbol: ") + sym;
+      return fn;
+    };
+#define DCT_SYM(name) \
+  name = reinterpret_cast<decltype(name)>(need(#name))
+    DCT_SYM(SHA1);
+    DCT_SYM(SHA256);
+    DCT_SYM(AES_set_encrypt_key);
+    DCT_SYM(AES_set_decrypt_key);
+    DCT_SYM(AES_ige_encrypt);
+    DCT_SYM(RAND_bytes);
+    DCT_SYM(BN_new);
+    DCT_SYM(BN_free);
+    DCT_SYM(BN_bin2bn);
+    DCT_SYM(BN_bn2bin);
+    DCT_SYM(BN_num_bits);
+    DCT_SYM(BN_CTX_new);
+    DCT_SYM(BN_CTX_free);
+    DCT_SYM(BN_mod_exp);
+#undef DCT_SYM
+  }
+
+  std::string err_;
+};
+
+using Bytes = std::string;  // byte strings throughout (match json.h style)
+
+inline CryptoLib& crypto() {
+  CryptoLib& c = CryptoLib::get();
+  if (!c.ok()) throw MtprotoError(c.error());
+  return c;
+}
+
+inline Bytes sha1(const Bytes& in) {
+  unsigned char out[20];
+  crypto().SHA1(reinterpret_cast<const unsigned char*>(in.data()),
+                in.size(), out);
+  return Bytes(reinterpret_cast<char*>(out), 20);
+}
+
+inline Bytes sha256(const Bytes& in) {
+  unsigned char out[32];
+  crypto().SHA256(reinterpret_cast<const unsigned char*>(in.data()),
+                  in.size(), out);
+  return Bytes(reinterpret_cast<char*>(out), 32);
+}
+
+inline Bytes random_bytes(size_t n) {
+  Bytes out(n, '\0');
+  if (crypto().RAND_bytes(reinterpret_cast<unsigned char*>(&out[0]),
+                          static_cast<int>(n)) != 1)
+    throw MtprotoError("RAND_bytes failed");
+  return out;
+}
+
+inline Bytes ige(const Bytes& key32, const Bytes& iv32, const Bytes& data,
+                 bool encrypt) {
+  if (data.size() % 16) throw MtprotoError("IGE needs 16-byte alignment");
+  AesKey k;
+  std::memset(&k, 0, sizeof(k));
+  const unsigned char* kp =
+      reinterpret_cast<const unsigned char*>(key32.data());
+  if (encrypt)
+    crypto().AES_set_encrypt_key(kp, 256, &k);
+  else
+    crypto().AES_set_decrypt_key(kp, 256, &k);
+  Bytes iv = iv32;  // AES_ige_encrypt mutates the iv buffer
+  Bytes out(data.size(), '\0');
+  crypto().AES_ige_encrypt(
+      reinterpret_cast<const unsigned char*>(data.data()),
+      reinterpret_cast<unsigned char*>(&out[0]), data.size(), &k,
+      reinterpret_cast<unsigned char*>(&iv[0]), encrypt ? 1 : 0);
+  return out;
+}
+
+// mod_exp over big-endian byte strings: base^exp mod mod.
+inline Bytes bn_mod_exp(const Bytes& base, const Bytes& exp,
+                        const Bytes& mod, size_t out_len = 0) {
+  CryptoLib& c = crypto();
+  auto mk = [&c](const Bytes& b) {
+    return c.BN_bin2bn(reinterpret_cast<const unsigned char*>(b.data()),
+                       static_cast<int>(b.size()), nullptr);
+  };
+  BigNum* bb = mk(base);
+  BigNum* be = mk(exp);
+  BigNum* bm = mk(mod);
+  BigNum* br = c.BN_new();
+  BnCtx* ctx = c.BN_CTX_new();
+  int ok = c.BN_mod_exp(br, bb, be, bm, ctx);
+  Bytes out;
+  if (ok == 1) {
+    int nbytes = (c.BN_num_bits(br) + 7) / 8;
+    Bytes raw(nbytes > 0 ? nbytes : 1, '\0');
+    c.BN_bn2bin(br, reinterpret_cast<unsigned char*>(&raw[0]));
+    if (out_len > raw.size())
+      out = Bytes(out_len - raw.size(), '\0') + raw;  // left-pad
+    else
+      out = raw;
+  }
+  c.BN_CTX_free(ctx);
+  c.BN_free(br);
+  c.BN_free(bm);
+  c.BN_free(be);
+  c.BN_free(bb);
+  if (ok != 1) throw MtprotoError("BN_mod_exp failed");
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// TL serialization (the handful of primitives the handshake uses)
+// ---------------------------------------------------------------------------
+
+inline void tl_u32(Bytes* out, uint32_t v) {
+  char b[4] = {static_cast<char>(v & 0xff),
+               static_cast<char>((v >> 8) & 0xff),
+               static_cast<char>((v >> 16) & 0xff),
+               static_cast<char>((v >> 24) & 0xff)};
+  out->append(b, 4);
+}
+
+inline void tl_i64(Bytes* out, int64_t v) {
+  for (int i = 0; i < 8; ++i)
+    out->push_back(static_cast<char>((static_cast<uint64_t>(v) >> (8 * i)) &
+                                     0xff));
+}
+
+inline void tl_bytes(Bytes* out, const Bytes& b) {
+  size_t head;
+  if (b.size() < 254) {
+    out->push_back(static_cast<char>(b.size()));
+    head = 1;
+  } else {
+    out->push_back(static_cast<char>(0xfe));
+    out->push_back(static_cast<char>(b.size() & 0xff));
+    out->push_back(static_cast<char>((b.size() >> 8) & 0xff));
+    out->push_back(static_cast<char>((b.size() >> 16) & 0xff));
+    head = 4;
+  }
+  out->append(b);
+  size_t pad = (4 - (head + b.size()) % 4) % 4;
+  out->append(pad, '\0');
+}
+
+class TlReader {
+ public:
+  explicit TlReader(const Bytes& data) : data_(data) {}
+
+  uint32_t u32() {
+    const unsigned char* p = take(4);
+    return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+           (static_cast<uint32_t>(p[2]) << 16) |
+           (static_cast<uint32_t>(p[3]) << 24);
+  }
+
+  int64_t i64() {
+    const unsigned char* p = take(8);
+    uint64_t v = 0;
+    for (int i = 7; i >= 0; --i) v = (v << 8) | p[i];
+    return static_cast<int64_t>(v);
+  }
+
+  Bytes raw(size_t n) {
+    const unsigned char* p = take(n);
+    return Bytes(reinterpret_cast<const char*>(p), n);
+  }
+
+  Bytes bytes() {
+    size_t n = take(1)[0];
+    size_t head = 1;
+    if (n == 254) {
+      const unsigned char* p = take(3);
+      n = static_cast<size_t>(p[0]) | (static_cast<size_t>(p[1]) << 8) |
+          (static_cast<size_t>(p[2]) << 16);
+      head = 4;
+    }
+    Bytes b = raw(n);
+    take((4 - (head + n) % 4) % 4);
+    return b;
+  }
+
+  size_t offset() const { return off_; }
+
+ private:
+  const unsigned char* take(size_t n) {
+    if (off_ + n > data_.size()) throw MtprotoError("TL underrun");
+    const unsigned char* p =
+        reinterpret_cast<const unsigned char*>(data_.data()) + off_;
+    off_ += n;
+    return p;
+  }
+
+  const Bytes& data_;
+  size_t off_ = 0;
+};
+
+// TL constructor ids (public MTProto schema).
+constexpr uint32_t kReqPqMulti = 0xBE7E8EF1u;
+constexpr uint32_t kResPQ = 0x05162463u;
+constexpr uint32_t kPQInnerData = 0x83C95AECu;
+constexpr uint32_t kReqDHParams = 0xD712E4BEu;
+constexpr uint32_t kServerDHParamsOk = 0xD0E8075Cu;
+constexpr uint32_t kServerDHInnerData = 0xB5890DBAu;
+constexpr uint32_t kClientDHInnerData = 0x6643B654u;
+constexpr uint32_t kSetClientDHParams = 0xF5045F1Fu;
+constexpr uint32_t kDhGenOk = 0x3BCBF734u;
+constexpr uint32_t kVector = 0x1CB5C415u;
+
+// ---------------------------------------------------------------------------
+// Pollard's rho (pq fits 63 bits; __int128 keeps mulmod exact)
+// ---------------------------------------------------------------------------
+
+inline uint64_t mulmod_u64(uint64_t a, uint64_t b, uint64_t m) {
+  return static_cast<uint64_t>(
+      (static_cast<unsigned __int128>(a) * b) % m);
+}
+
+inline uint64_t gcd_u64(uint64_t a, uint64_t b) {
+  while (b) {
+    uint64_t t = a % b;
+    a = b;
+    b = t;
+  }
+  return a;
+}
+
+inline void factor_pq(uint64_t pq, uint64_t* p_out, uint64_t* q_out) {
+  if (pq % 2 == 0) {
+    *p_out = 2;
+    *q_out = pq / 2;
+    return;
+  }
+  uint64_t seed = 0xDC7DC7DC7ull;
+  for (int attempt = 0; attempt < 64; ++attempt) {
+    uint64_t x = 2 + (seed = seed * 6364136223846793005ull + 1442695040888963407ull) % (pq - 3);
+    uint64_t c = 1 + (seed = seed * 6364136223846793005ull + 1442695040888963407ull) % (pq - 1);
+    uint64_t y = x, d = 1;
+    while (d == 1) {
+      x = (mulmod_u64(x, x, pq) + c) % pq;
+      y = (mulmod_u64(y, y, pq) + c) % pq;
+      y = (mulmod_u64(y, y, pq) + c) % pq;
+      d = gcd_u64(x > y ? x - y : y - x, pq);
+    }
+    if (d != pq) {
+      uint64_t p = d, q = pq / d;
+      if (p > q) std::swap(p, q);
+      *p_out = p;
+      *q_out = q;
+      return;
+    }
+  }
+  throw MtprotoError("pq factorization failed");
+}
+
+inline Bytes be_bytes_u64(uint64_t v) {
+  Bytes out;
+  bool started = false;
+  for (int i = 7; i >= 0; --i) {
+    unsigned char b = (v >> (8 * i)) & 0xff;
+    if (b || started || i == 0) {
+      out.push_back(static_cast<char>(b));
+      started = true;
+    }
+  }
+  return out;
+}
+
+inline uint64_t u64_from_be(const Bytes& b) {
+  if (b.size() > 8) throw MtprotoError("big-endian value exceeds 64 bits");
+  uint64_t v = 0;
+  for (unsigned char c : b) v = (v << 8) | c;
+  return v;
+}
+
+// Strip leading zero bytes (big-endian canonical form).
+inline Bytes be_strip(const Bytes& b) {
+  size_t i = 0;
+  while (i + 1 < b.size() && b[i] == '\0') ++i;
+  return b.substr(i);
+}
+
+// Compare big-endian byte strings as unsigned integers: -1/0/+1.
+inline int be_cmp(const Bytes& a_raw, const Bytes& b_raw) {
+  Bytes a = be_strip(a_raw), b = be_strip(b_raw);
+  if (a.size() != b.size()) return a.size() < b.size() ? -1 : 1;
+  for (size_t i = 0; i < a.size(); ++i) {
+    unsigned char ca = static_cast<unsigned char>(a[i]);
+    unsigned char cb = static_cast<unsigned char>(b[i]);
+    if (ca != cb) return ca < cb ? -1 : 1;
+  }
+  return 0;
+}
+
+// Big-endian minus one (input > 0).
+inline Bytes be_minus_one(const Bytes& in) {
+  Bytes out = in;
+  for (size_t i = out.size(); i-- > 0;) {
+    unsigned char c = static_cast<unsigned char>(out[i]);
+    if (c != 0) {
+      out[i] = static_cast<char>(c - 1);
+      break;
+    }
+    out[i] = '\xff';
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// MTProto 2.0 message crypto
+// ---------------------------------------------------------------------------
+
+inline void kdf2(const Bytes& auth_key, const Bytes& msg_key, bool to_server,
+                 Bytes* key, Bytes* iv) {
+  size_t x = to_server ? 0 : 8;
+  Bytes a = sha256(msg_key + auth_key.substr(x, 36));
+  Bytes b = sha256(auth_key.substr(40 + x, 36) + msg_key);
+  *key = a.substr(0, 8) + b.substr(8, 16) + a.substr(24, 8);
+  *iv = b.substr(0, 8) + a.substr(8, 16) + b.substr(24, 8);
+}
+
+inline Bytes msg_key_for(const Bytes& auth_key, const Bytes& padded,
+                         bool to_server) {
+  size_t x = to_server ? 0 : 8;
+  return sha256(auth_key.substr(88 + x, 32) + padded).substr(8, 16);
+}
+
+// SHA1-derived tmp key/iv protecting the DH answer (spec rule).
+inline void dh_tmp_key_iv(const Bytes& new_nonce, const Bytes& server_nonce,
+                          Bytes* key, Bytes* iv) {
+  *key = sha1(new_nonce + server_nonce) +
+         sha1(server_nonce + new_nonce).substr(0, 12);
+  *iv = sha1(server_nonce + new_nonce).substr(12, 8) +
+        sha1(new_nonce + new_nonce) + new_nonce.substr(0, 4);
+}
+
+// ---------------------------------------------------------------------------
+// RSA public key ({n, e} as big-endian byte strings)
+// ---------------------------------------------------------------------------
+
+struct RsaPub {
+  Bytes n;  // big-endian modulus
+  Bytes e;  // big-endian exponent
+
+  int64_t fingerprint() const {
+    Bytes ser;
+    tl_bytes(&ser, be_strip(n));
+    tl_bytes(&ser, be_strip(e));
+    Bytes h = sha1(ser);
+    uint64_t v = 0;
+    for (int i = 19; i >= 12; --i)
+      v = (v << 8) | static_cast<unsigned char>(h[i]);
+    return static_cast<int64_t>(v);
+  }
+
+  // data_with_hash = SHA1(data) ‖ data ‖ random pad to 255; raw RSA.
+  Bytes encrypt_with_hash(const Bytes& data) const {
+    if (data.size() > 255 - 20)
+      throw MtprotoError("RSA payload too large");
+    Bytes dwh = sha1(data) + data;
+    dwh += random_bytes(255 - dwh.size());
+    return bn_mod_exp(dwh, e, n, 256);
+  }
+};
+
+inline Bytes hex_to_bytes(const std::string& hex) {
+  std::string h = hex;
+  if (h.rfind("0x", 0) == 0 || h.rfind("0X", 0) == 0) h = h.substr(2);
+  if (h.size() % 2) h = "0" + h;
+  Bytes out;
+  auto nib = [](char c) -> int {
+    if (c >= '0' && c <= '9') return c - '0';
+    if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+    if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+    throw MtprotoError("bad hex digit");
+  };
+  for (size_t i = 0; i < h.size(); i += 2)
+    out.push_back(static_cast<char>((nib(h[i]) << 4) | nib(h[i + 1])));
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Intermediate transport over a dctnet::Stream
+// ---------------------------------------------------------------------------
+
+class Transport {
+ public:
+  static constexpr size_t kMaxPacket = 64 * 1024 * 1024;
+
+  explicit Transport(dctnet::Stream* stream) : stream_(stream) {
+    static const char init[4] = {'\xee', '\xee', '\xee', '\xee'};
+    stream_->write_all(init, 4);
+  }
+
+  void send(const Bytes& payload) {
+    if (payload.size() > kMaxPacket) throw MtprotoError("packet too large");
+    char header[4];
+    uint32_t n = static_cast<uint32_t>(payload.size());
+    header[0] = static_cast<char>(n & 0xff);
+    header[1] = static_cast<char>((n >> 8) & 0xff);
+    header[2] = static_cast<char>((n >> 16) & 0xff);
+    header[3] = static_cast<char>((n >> 24) & 0xff);
+    std::lock_guard<std::mutex> lock(write_mu_);
+    stream_->write_all(header, 4);
+    stream_->write_all(payload.data(), payload.size());
+  }
+
+  // Blocking read of one packet; empty on orderly close.
+  Bytes recv() {
+    char header[4];
+    if (!read_exact(header, 4)) return Bytes();
+    uint32_t n = static_cast<uint32_t>(static_cast<unsigned char>(header[0])) |
+                 (static_cast<uint32_t>(
+                      static_cast<unsigned char>(header[1])) << 8) |
+                 (static_cast<uint32_t>(
+                      static_cast<unsigned char>(header[2])) << 16) |
+                 (static_cast<uint32_t>(
+                      static_cast<unsigned char>(header[3])) << 24);
+    if (n > kMaxPacket) throw MtprotoError("oversized packet");
+    Bytes payload(n, '\0');
+    if (n > 0 && !read_exact(&payload[0], n))
+      throw MtprotoError("truncated packet");
+    return payload;
+  }
+
+  bool wait_readable(int timeout_ms) {
+    return stream_->wait_readable(timeout_ms);
+  }
+
+ private:
+  bool read_exact(char* buf, size_t len) {
+    size_t off = 0;
+    while (off < len) {
+      size_t n = stream_->read_some(buf + off, len - off);
+      if (n == 0) return false;
+      off += n;
+    }
+    return true;
+  }
+
+  dctnet::Stream* stream_;
+  std::mutex write_mu_;
+};
+
+// ---------------------------------------------------------------------------
+// The client handshake + session (creating an auth key, then 2.0 messages)
+// ---------------------------------------------------------------------------
+
+inline int64_t client_msg_id(int64_t* last) {
+  int64_t mid = (static_cast<int64_t>(::time(nullptr)) << 32);
+  Bytes r = random_bytes(3);
+  mid |= (static_cast<int64_t>(static_cast<unsigned char>(r[0])) << 16 |
+          static_cast<int64_t>(static_cast<unsigned char>(r[1])) << 8 |
+          static_cast<int64_t>(static_cast<unsigned char>(r[2]))) &
+         ~0x3ll;
+  if (mid <= *last) mid = *last + 4;
+  *last = mid;
+  return mid;
+}
+
+inline Bytes plain_message(const Bytes& body, int64_t msg_id) {
+  Bytes out(8, '\0');  // auth_key_id = 0
+  tl_i64(&out, msg_id);
+  tl_u32(&out, static_cast<uint32_t>(body.size()));
+  out += body;
+  return out;
+}
+
+inline Bytes parse_plain(const Bytes& packet) {
+  TlReader r(packet);
+  if (r.i64() != 0) throw MtprotoError("expected plain message");
+  r.i64();  // msg_id
+  uint32_t n = r.u32();
+  return r.raw(n);
+}
+
+class MtprotoConnection {
+ public:
+  // Performs the full auth-key handshake on construction.
+  MtprotoConnection(std::unique_ptr<dctnet::Stream> stream,
+                    const RsaPub& server_key)
+      : stream_(std::move(stream)), transport_(stream_.get()) {
+    handshake(server_key);
+  }
+
+  void send_frame(const std::string& payload) {
+    Bytes body;
+    tl_bytes(&body, payload);  // one TL bytes value wraps the JSON API
+    transport_.send(encrypt(body));
+  }
+
+  // Blocking read of one frame; empty string on orderly close.
+  std::string recv_frame() {
+    Bytes packet = transport_.recv();
+    if (packet.empty()) return std::string();
+    Bytes body = decrypt(packet);
+    TlReader r(body);
+    return r.bytes();
+  }
+
+  void shutdown() { stream_->shutdown(); }
+
+  bool wait_readable(int timeout_ms) {
+    return transport_.wait_readable(timeout_ms);
+  }
+
+  const Bytes& auth_key() const { return auth_key_; }
+
+ private:
+  void handshake(const RsaPub& server_key) {
+    // 1. req_pq_multi
+    Bytes nonce = random_bytes(16);
+    Bytes req;
+    tl_u32(&req, kReqPqMulti);
+    req += nonce;
+    transport_.send(plain_message(req, client_msg_id(&last_msg_id_)));
+
+    Bytes res = parse_plain(transport_.recv());
+    TlReader r(res);
+    if (r.u32() != kResPQ) throw MtprotoError("expected resPQ");
+    if (r.raw(16) != nonce) throw MtprotoError("resPQ nonce mismatch");
+    Bytes server_nonce = r.raw(16);
+    uint64_t pq = u64_from_be(r.bytes());
+    if (r.u32() != kVector) throw MtprotoError("expected Vector<long>");
+    uint32_t n_fp = r.u32();
+    bool fp_ok = false;
+    int64_t want_fp = server_key.fingerprint();
+    for (uint32_t i = 0; i < n_fp; ++i)
+      if (r.i64() == want_fp) fp_ok = true;
+    if (!fp_ok) throw MtprotoError("server offered no known fingerprint");
+
+    // 2. factor pq, req_DH_params with RSA-encrypted p_q_inner_data
+    uint64_t p, q;
+    factor_pq(pq, &p, &q);
+    Bytes new_nonce = random_bytes(32);
+    Bytes inner;
+    tl_u32(&inner, kPQInnerData);
+    tl_bytes(&inner, be_bytes_u64(pq));
+    tl_bytes(&inner, be_bytes_u64(p));
+    tl_bytes(&inner, be_bytes_u64(q));
+    inner += nonce + server_nonce + new_nonce;
+    Bytes dh_req;
+    tl_u32(&dh_req, kReqDHParams);
+    dh_req += nonce + server_nonce;
+    tl_bytes(&dh_req, be_bytes_u64(p));
+    tl_bytes(&dh_req, be_bytes_u64(q));
+    tl_i64(&dh_req, want_fp);
+    tl_bytes(&dh_req, server_key.encrypt_with_hash(inner));
+    transport_.send(plain_message(dh_req, client_msg_id(&last_msg_id_)));
+
+    // 3. server_DH_params_ok -> decrypt DH answer with SHA1 tmp key/iv
+    Bytes dh_res = parse_plain(transport_.recv());
+    TlReader dr(dh_res);
+    if (dr.u32() != kServerDHParamsOk)
+      throw MtprotoError("expected server_DH_params_ok");
+    if (dr.raw(16) != nonce || dr.raw(16) != server_nonce)
+      throw MtprotoError("DH params nonce mismatch");
+    Bytes tmp_key, tmp_iv;
+    dh_tmp_key_iv(new_nonce, server_nonce, &tmp_key, &tmp_iv);
+    Bytes awh = ige(tmp_key, tmp_iv, dr.bytes(), /*encrypt=*/false);
+    Bytes digest = awh.substr(0, 20);
+    Bytes answer = awh.substr(20);
+    TlReader ar(answer);
+    if (ar.u32() != kServerDHInnerData)
+      throw MtprotoError("bad server_DH_inner_data");
+    if (ar.raw(16) != nonce || ar.raw(16) != server_nonce)
+      throw MtprotoError("server_DH nonce mismatch");
+    uint32_t g = ar.u32();
+    Bytes dh_prime = ar.bytes();
+    Bytes g_a = ar.bytes();
+    ar.u32();  // server_time
+    if (sha1(answer.substr(0, ar.offset())) != digest)
+      throw MtprotoError("server_DH SHA1 mismatch");
+    // DH group sanity (spec-mandated, parity with the Python twin): the
+    // prime must be a full 2048-bit value and 1 < g_a < dh_prime - 1 —
+    // a degenerate g_a would yield a constant auth_key any passive
+    // observer can derive.
+    if (dh_prime.size() != 256 ||
+        (static_cast<unsigned char>(dh_prime[0]) & 0x80) == 0)
+      throw MtprotoError("bad DH prime (not 2048-bit)");
+    Bytes one(1, '\x01');
+    if (be_cmp(g_a, one) <= 0 ||
+        be_cmp(g_a, be_minus_one(dh_prime)) >= 0)
+      throw MtprotoError("g_a out of range");
+
+    // 4. client DH: b random, g_b, auth_key = g_a^b mod p
+    Bytes b = random_bytes(256);
+    Bytes g_bytes(1, static_cast<char>(g));
+    Bytes g_b = bn_mod_exp(g_bytes, b, dh_prime);
+    auth_key_ = bn_mod_exp(g_a, b, dh_prime, 256);
+    Bytes cinner;
+    tl_u32(&cinner, kClientDHInnerData);
+    cinner += nonce + server_nonce;
+    tl_i64(&cinner, 0);  // retry_id
+    tl_bytes(&cinner, be_strip(g_b));
+    Bytes iwh = sha1(cinner) + cinner;
+    size_t pad = (16 - iwh.size() % 16) % 16;
+    iwh += random_bytes(pad);
+    Bytes set_req;
+    tl_u32(&set_req, kSetClientDHParams);
+    set_req += nonce + server_nonce;
+    tl_bytes(&set_req, ige(tmp_key, tmp_iv, iwh, /*encrypt=*/true));
+    transport_.send(plain_message(set_req, client_msg_id(&last_msg_id_)));
+
+    // 5. dh_gen_ok, verify new_nonce_hash1
+    Bytes ok_res = parse_plain(transport_.recv());
+    TlReader okr(ok_res);
+    if (okr.u32() != kDhGenOk) throw MtprotoError("expected dh_gen_ok");
+    if (okr.raw(16) != nonce || okr.raw(16) != server_nonce)
+      throw MtprotoError("dh_gen nonce mismatch");
+    Bytes aux = sha1(auth_key_).substr(0, 8);
+    Bytes expect = sha1(new_nonce + Bytes(1, '\x01') + aux).substr(4, 16);
+    if (okr.raw(16) != expect)
+      throw MtprotoError("new_nonce_hash1 mismatch");
+
+    auth_key_id_ = sha1(auth_key_).substr(12, 8);
+    server_salt_ = Bytes(8, '\0');
+    for (int i = 0; i < 8; ++i)
+      server_salt_[i] = new_nonce[i] ^ server_nonce[i];
+    session_id_ = random_bytes(8);
+  }
+
+  Bytes encrypt(const Bytes& payload) {
+    std::lock_guard<std::mutex> lock(enc_mu_);
+    seq_ += 1;
+    Bytes inner = server_salt_ + session_id_;
+    tl_i64(&inner, client_msg_id(&last_msg_id_));
+    tl_u32(&inner, seq_ * 2 + 1);
+    tl_u32(&inner, static_cast<uint32_t>(payload.size()));
+    inner += payload;
+    size_t pad = 16 - (inner.size() + 12) % 16;
+    inner += random_bytes(12 + (pad % 16));
+    Bytes mk = msg_key_for(auth_key_, inner, /*to_server=*/true);
+    Bytes key, iv;
+    kdf2(auth_key_, mk, /*to_server=*/true, &key, &iv);
+    return auth_key_id_ + mk + ige(key, iv, inner, /*encrypt=*/true);
+  }
+
+  Bytes decrypt(const Bytes& packet) {
+    if (packet.size() < 24 + 32) throw MtprotoError("short message");
+    if (packet.substr(0, 8) != auth_key_id_)
+      throw MtprotoError("unknown auth_key_id");
+    Bytes mk = packet.substr(8, 16);
+    Bytes key, iv;
+    kdf2(auth_key_, mk, /*to_server=*/false, &key, &iv);
+    Bytes inner = ige(key, iv, packet.substr(24), /*encrypt=*/false);
+    // msg_key check before trusting any field (MTProto 2.0 mandate).
+    if (msg_key_for(auth_key_, inner, /*to_server=*/false) != mk)
+      throw MtprotoError("msg_key mismatch");
+    TlReader r(inner);
+    r.raw(8);   // salt
+    r.raw(8);   // session_id
+    r.i64();    // msg_id
+    r.u32();    // seq_no
+    uint32_t n = r.u32();
+    if (n > inner.size() - 32) throw MtprotoError("bad inner length");
+    return r.raw(n);
+  }
+
+  std::unique_ptr<dctnet::Stream> stream_;
+  Transport transport_;
+  Bytes auth_key_;
+  Bytes auth_key_id_;
+  Bytes server_salt_;
+  Bytes session_id_;
+  uint32_t seq_ = 0;
+  int64_t last_msg_id_ = 0;
+  std::mutex enc_mu_;
+};
+
+}  // namespace dctmtp
+
+#endif  // DCT_NATIVE_MTPROTO_H_

@@ -1,0 +1,428 @@
+"""MTProto 2.0 wire protocol (`clients/mtproto_wire.py` + its C++ twin
+`native/mtproto.h`) — the reference's TDLib↔Telegram-DC transport
+(`Dockerfile.tdlib:19-36`, `telegramhelper/client.go:319-377`), in-tree.
+
+Layers tested:
+- crypto primitives against published vectors (AES-IGE known answer);
+- TL serialization roundtrips;
+- the creating-an-auth-key handshake Python↔Python over a socketpair;
+- MTProto 2.0 message encryption: roundtrip, tamper detection (the
+  mandatory msg_key check), wrong-key rejection;
+- cross-implementation parity: the C++ client (`native/mtproto.h`) against
+  the Python gateway over a real socket — auth ladder + API calls riding
+  AES-IGE encrypted messages end to end, plus a full crawl.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from distributed_crawler_tpu.clients.mtproto_wire import (
+    DH_PRIME,
+    RsaKey,
+    ServerHandshake,
+    Session,
+    TlReader,
+    Transport,
+    client_handshake,
+    factor_pq,
+    generate_rsa_key,
+    ige_decrypt,
+    ige_encrypt,
+    kdf,
+    _small_prime,
+    tl_bytes,
+)
+
+# One RSA keypair for the whole module (2048-bit generation isn't free).
+RSA = generate_rsa_key()
+
+
+class TestPrimitives:
+    def test_ige_known_answer_vector(self):
+        # Published AES-128-IGE test vector (OpenSSL's IGE example set).
+        key = bytes.fromhex("000102030405060708090A0B0C0D0E0F")
+        iv = bytes.fromhex("000102030405060708090A0B0C0D0E0F"
+                           "101112131415161718191A1B1C1D1E1F")
+        plain = bytes(32)
+        cipher = ige_encrypt(key, iv, plain)
+        assert cipher.hex().upper() == (
+            "1A8519A6557BE652E9DA8E43DA4EF445"
+            "3CF456B4CA488AA383C79C98B34797CB")
+        assert ige_decrypt(key, iv, cipher) == plain
+
+    def test_ige_roundtrip_aes256(self):
+        key = bytes(range(32))
+        iv = bytes(range(32, 64))
+        data = bytes(range(256)) * 2
+        assert ige_decrypt(key, iv, ige_encrypt(key, iv, data)) == data
+
+    def test_ige_rejects_unaligned(self):
+        with pytest.raises(ValueError):
+            ige_encrypt(bytes(32), bytes(32), b"short")
+
+    def test_tl_bytes_roundtrip(self):
+        for payload in (b"", b"x", b"abc", b"\x00" * 253, b"y" * 254,
+                        b"z" * 100_000):
+            ser = tl_bytes(payload)
+            assert len(ser) % 4 == 0
+            assert TlReader(ser).tl_bytes() == payload
+
+    def test_factor_pq(self):
+        p, q = _small_prime(), _small_prime()
+        lo, hi = sorted((p, q))
+        assert factor_pq(p * q) == (lo, hi)
+
+    def test_fingerprint_is_stable_and_key_dependent(self):
+        pub = RsaKey(n=RSA.n, e=RSA.e)
+        assert pub.fingerprint == RSA.fingerprint
+        other = RsaKey(n=RSA.n + 2, e=RSA.e)
+        assert other.fingerprint != pub.fingerprint
+
+    def test_kdf_directions_differ(self):
+        auth_key = bytes(range(256))
+        msg_key = bytes(range(16))
+        k1, iv1 = kdf(auth_key, msg_key, True)
+        k2, iv2 = kdf(auth_key, msg_key, False)
+        assert len(k1) == 32 and len(iv1) == 32
+        assert (k1, iv1) != (k2, iv2)  # x=0 vs x=8
+
+
+class TestSession:
+    def _pair(self):
+        auth_key = bytes((i * 37 + 5) % 256 for i in range(256))
+        client = Session(auth_key=auth_key, server_salt=b"SALTSALT",
+                         session_id=b"SESSIONi", is_client=True)
+        server = Session(auth_key=auth_key, server_salt=b"SALTSALT",
+                         session_id=b"SESSIONi", is_client=False)
+        return client, server
+
+    def test_roundtrip_both_directions(self):
+        client, server = self._pair()
+        for payload in (b"", b"x", b"hello world" * 100):
+            assert server.decrypt(client.encrypt(payload)) == payload
+            assert client.decrypt(server.encrypt(payload)) == payload
+
+    def test_tamper_detected_by_msg_key_check(self):
+        client, server = self._pair()
+        packet = bytearray(client.encrypt(b"payload"))
+        packet[-1] ^= 0x01
+        with pytest.raises(ValueError, match="msg_key"):
+            server.decrypt(bytes(packet))
+
+    def test_wrong_auth_key_rejected(self):
+        client, _ = self._pair()
+        stranger = Session(auth_key=bytes(256), server_salt=b"SALTSALT",
+                           session_id=b"SESSIONi", is_client=False)
+        with pytest.raises(ValueError):
+            stranger.decrypt(client.encrypt(b"payload"))
+
+    def test_padding_and_alignment(self):
+        client, _ = self._pair()
+        packet = client.encrypt(b"q")
+        # header(8+16) + ciphertext; ciphertext 16-aligned with >=12 pad.
+        assert (len(packet) - 24) % 16 == 0
+        assert len(packet) - 24 >= 8 + 8 + 8 + 4 + 4 + 1 + 12
+
+
+class TestHandshake:
+    def test_python_loopback_handshake_and_traffic(self):
+        a, b = socket.socketpair()
+        server_result = {}
+
+        def serve():
+            transport = Transport(a, is_server=True)
+            hs = ServerHandshake(rsa=RSA)
+            done = False
+            while not done:
+                reply, done = hs.handle(transport.recv())
+                if reply:
+                    transport.send(reply)
+            sess = Session(auth_key=hs.auth_key,
+                           server_salt=hs.server_salt,
+                           session_id=b"", is_client=False)
+            # decrypt() adopts the client's session_id from the first
+            # validated message.
+            msg = sess.decrypt(transport.recv())
+            server_result["got"] = msg
+            transport.send(sess.encrypt(b"pong:" + msg))
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        transport = Transport(b, is_server=False)
+        sess = client_handshake(transport, RsaKey(n=RSA.n, e=RSA.e))
+        assert len(sess.auth_key) == 256
+        # auth_key must be a real DH value, not degenerate.
+        assert int.from_bytes(sess.auth_key, "big") > 1
+        assert int.from_bytes(sess.auth_key, "big") < DH_PRIME
+        transport.send(sess.encrypt(b"ping"))
+        reply = sess.decrypt(transport.recv())
+        t.join(10)
+        assert server_result["got"] == b"ping"
+        assert reply == b"pong:ping"
+
+    def test_adversarial_rsa_ciphertext_is_a_protocol_error(self):
+        """Garbage encrypted_data must surface as ValueError (the class
+        the session loop catches), not OverflowError from the raw-RSA
+        range — a remote crash/log-spam vector otherwise."""
+        import secrets
+
+        from distributed_crawler_tpu.clients.mtproto_wire import (
+            REQ_DH_PARAMS,
+            REQ_PQ_MULTI,
+            i64,
+            int_to_bytes,
+            plain_message,
+            u32,
+        )
+
+        hs = ServerHandshake(rsa=RSA)
+        nonce = secrets.token_bytes(16)
+        reply, _ = hs.handle(plain_message(u32(REQ_PQ_MULTI) + nonce, 4))
+        r = TlReader(reply)
+        r.int64(); r.int64(); r.uint32()  # plain header
+        rr = TlReader(r.raw(len(reply) - r.off))
+        rr.uint32()
+        rr.raw(16)
+        server_nonce = rr.raw(16)
+        pq = int.from_bytes(rr.tl_bytes(), "big")
+        p, q = factor_pq(pq)
+        req = (u32(REQ_DH_PARAMS) + nonce + server_nonce +
+               tl_bytes(int_to_bytes(p)) + tl_bytes(int_to_bytes(q)) +
+               i64(RSA.fingerprint) + tl_bytes(secrets.token_bytes(256)))
+        with pytest.raises(ValueError):
+            hs.handle(plain_message(req, 8))
+
+    def test_wrong_pubkey_rejected_by_client(self):
+        a, b = socket.socketpair()
+
+        def serve():
+            try:
+                transport = Transport(a, is_server=True)
+                hs = ServerHandshake(rsa=RSA)
+                done = False
+                while not done:
+                    reply, done = hs.handle(transport.recv())
+                    if reply:
+                        transport.send(reply)
+            except Exception:
+                pass  # client aborts mid-handshake
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        transport = Transport(b, is_server=False)
+        stranger = generate_rsa_key(1024)
+        with pytest.raises(ValueError, match="fingerprint"):
+            client_handshake(transport,
+                             RsaKey(n=stranger.n, e=stranger.e))
+        b.close()
+        t.join(5)
+
+
+# -- cross-implementation: the C++ client against the Python gateway --------
+
+def _lib_available() -> bool:
+    from distributed_crawler_tpu.clients.native import find_library
+
+    try:
+        find_library()
+        return True
+    except Exception:
+        return False
+
+
+SEED = json.dumps({
+    "channels": [
+        {"username": "mtroot", "id": 4242, "title": "MTProto Root",
+         "member_count": 900,
+         "messages": [
+             {"date": 1700000000, "view_count": 5,
+              "content": {"@type": "messageText",
+                          "text": {"text": "go see @mtleaf",
+                                   "entities": [
+                                       {"type": {"@type":
+                                                 "textEntityTypeMention"},
+                                        "offset": 7, "length": 7}]}}},
+         ]},
+        {"username": "mtleaf", "id": 4243, "title": "Leaf",
+         "member_count": 40,
+         "messages": [
+             {"date": 1700000050, "view_count": 1,
+              "content": {"@type": "messageText",
+                          "text": {"text": "leaf", "entities": []}}},
+         ]},
+    ],
+})
+
+
+@pytest.mark.skipif(not _lib_available(),
+                    reason="libdct_client.so not built")
+class TestCppClientAgainstPythonGateway:
+    def test_auth_and_api_over_mtproto(self, tmp_path):
+        from distributed_crawler_tpu.clients.dc_gateway import DcGateway
+        from distributed_crawler_tpu.clients.native import (
+            NativeTelegramClient,
+        )
+
+        gw = DcGateway(seed_json=SEED, expected_code="13579",
+                       wire="mtproto", store_root=str(tmp_path)).start()
+        try:
+            c = NativeTelegramClient(server_addr=gw.address, wire="mtproto",
+                                     server_pubkey_file=gw.pubkey_file,
+                                     conn_id="mt-e2e")
+            try:
+                c.authenticate("+15550001111", "13579")
+                c.wait_ready(5.0)
+                chat = c.search_public_chat("mtroot")
+                assert chat.id == 4242
+                assert chat.title == "MTProto Root"
+                hist = c.get_chat_history(chat.id, limit=10)
+                msgs = getattr(hist, "messages", hist)
+                assert len(msgs) == 1
+            finally:
+                c.close()
+            st = gw.status()
+            assert st["wire"] == "mtproto"
+            assert st["auth_successes"] == 1
+            assert st["requests_served"] >= 2
+        finally:
+            gw.close()
+
+    def test_persistent_rsa_key_across_restart(self, tmp_path):
+        from distributed_crawler_tpu.clients.dc_gateway import DcGateway
+        from distributed_crawler_tpu.clients.mtproto_wire import load_pubkey
+
+        gw1 = DcGateway(seed_json=SEED, wire="mtproto",
+                        store_root=str(tmp_path)).start()
+        fp1 = load_pubkey(gw1.pubkey_file).fingerprint
+        gw1.close()
+        gw2 = DcGateway(seed_json=SEED, wire="mtproto",
+                        store_root=str(tmp_path)).start()
+        fp2 = load_pubkey(gw2.pubkey_file).fingerprint
+        gw2.close()
+        # A restarted gateway serves the SAME key (clients keep their
+        # pinned pubkey working), like Telegram's long-lived DC keys.
+        assert fp1 == fp2
+
+    def test_crawl_through_mtproto_gateway(self, tmp_path):
+        from distributed_crawler_tpu.clients.dc_gateway import DcGateway
+        from distributed_crawler_tpu.clients.native import (
+            NativeTelegramClient,
+        )
+        from distributed_crawler_tpu.config import CrawlerConfig
+        from distributed_crawler_tpu.crawl.runner import run_for_channel
+        from distributed_crawler_tpu.state import (
+            CompositeStateManager,
+            SqlConfig,
+            StateConfig,
+        )
+
+        gw = DcGateway(seed_json=SEED, expected_code="13579",
+                       wire="mtproto", store_root=str(tmp_path)).start()
+        try:
+            client = NativeTelegramClient(
+                server_addr=gw.address, wire="mtproto",
+                server_pubkey_file=gw.pubkey_file, conn_id="mt-crawl")
+            try:
+                client.authenticate("+15550001111", "13579")
+                client.wait_ready(5.0)
+                sm = CompositeStateManager(StateConfig(
+                    crawl_id="mtcrawl", crawl_execution_id="x1",
+                    storage_root=str(tmp_path / "out"),
+                    sql=SqlConfig(url=":memory:")))
+                sm.initialize(["mtroot"])
+                cfg = CrawlerConfig(crawl_id="mtcrawl",
+                                    skip_media_download=True)
+                page = sm.get_layer_by_depth(0)[0]
+                discovered = run_for_channel(client, page, "", sm, cfg)
+                assert page.status == "fetched"
+                assert {p.url for p in discovered} == {"mtleaf"}
+                posts_file = (tmp_path / "out" / "mtcrawl" / "mtroot"
+                              / "posts" / "posts.jsonl")
+                posts = [json.loads(line) for line
+                         in posts_file.read_text().splitlines()]
+                assert len(posts) == 1
+                sm.close()
+            finally:
+                client.close()
+        finally:
+            gw.close()
+
+    def test_auth_deadline_covers_mtproto_handshake(self, tmp_path):
+        """A client that opens the intermediate transport but never
+        finishes the auth-key handshake is dropped at the deadline."""
+        from distributed_crawler_tpu.clients.dc_gateway import DcGateway
+
+        gw = DcGateway(seed_json=SEED, wire="mtproto",
+                       store_root=str(tmp_path), auth_timeout_s=1.0).start()
+        try:
+            s = socket.create_connection((gw.host, gw.port), timeout=5)
+            s.sendall(b"\xee\xee\xee\xee")  # transport init, then stall
+            t0 = time.time()
+            s.settimeout(5.0)
+            try:
+                data = s.recv(4096)
+            except (OSError, socket.timeout):
+                data = b"err"
+            # Orderly close (b"") or reset, well before the recv timeout.
+            assert data in (b"", b"err")
+            assert time.time() - t0 < 4.0
+            s.close()
+        finally:
+            gw.close()
+
+
+@pytest.mark.skipif(not _lib_available(),
+                    reason="libdct_client.so not built")
+class TestCliMtprotoPath:
+    def test_standalone_crawl_via_mtproto_wire(self, tmp_path):
+        """The full config path over MTProto: `dct --urls … --dc-address …
+        --dc-wire mtproto --dc-pubkey-file …` builds a remote pool whose
+        connections complete the auth-key handshake and crawl through
+        encrypted messages — no code injection anywhere."""
+        import os
+
+        from distributed_crawler_tpu.cli import main
+        from distributed_crawler_tpu.clients.dc_gateway import DcGateway
+        from distributed_crawler_tpu.clients.native import (
+            NativeTelegramClient,
+            generate_pcode,
+        )
+
+        gw = DcGateway(
+            seed_json=SEED,
+            accounts={"+15557770000": {"code": "321", "password": ""}},
+            wire="mtproto", store_root=str(tmp_path / "gw"),
+        ).start()
+        tdlib_dir = str(tmp_path / "td")
+        out_root = str(tmp_path / "out")
+        try:
+            generate_pcode(
+                tdlib_dir=tdlib_dir,
+                env={"TG_API_ID": "9", "TG_PHONE_NUMBER": "+15557770000",
+                     "TG_PHONE_CODE": "321"},
+                client=NativeTelegramClient(
+                    server_addr=gw.address, wire="mtproto",
+                    server_pubkey_file=gw.pubkey_file, conn_id="cli-boot"))
+            rc = main(["--urls", "mtroot", "--storage-root", out_root,
+                       "--dc-address", gw.address,
+                       "--dc-wire", "mtproto",
+                       "--dc-pubkey-file", gw.pubkey_file,
+                       "--tdlib-dir", tdlib_dir,
+                       "--crawl-id", "cli-mt", "--skip-media",
+                       "--max-depth", "1"])
+            assert rc == 0
+            posts = []
+            for dirpath, _dn, files in os.walk(out_root):
+                for f in files:
+                    if f.endswith(".jsonl"):
+                        with open(os.path.join(dirpath, f)) as fh:
+                            posts += [json.loads(x) for x in fh]
+            assert [p["channel_name"] for p in posts] == ["MTProto Root"]
+            assert posts[0]["description"] == "go see @mtleaf"
+            assert gw.status()["auth_successes"] >= 2
+        finally:
+            gw.close()
