@@ -1,0 +1,23 @@
+#!/bin/bash
+# Poll the tunneled chip; on recovery run the two measurement harnesses.
+cd /root/repo
+for i in $(seq 1 120); do
+  if timeout 90 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((128,128), jnp.bfloat16)
+float(jax.jit(lambda a:(a@a).sum())(x))
+assert jax.default_backend() == 'tpu'
+" >/dev/null 2>&1; then
+    echo "RECOVERED at $(date +%H:%M:%S) (attempt $i)"
+    echo "--- exp_mfu ---"
+    timeout 1500 python tools/exp_mfu.py 2>/tmp/exp_mfu.err
+    echo "exp_mfu rc=$?"
+    echo "--- exp_int8 ---"
+    timeout 1500 python tools/exp_int8.py 2>/tmp/exp_int8.err
+    echo "exp_int8 rc=$?"
+    exit 0
+  fi
+  echo "wedged at $(date +%H:%M:%S) (attempt $i)"
+  sleep 240
+done
+echo "never recovered"
