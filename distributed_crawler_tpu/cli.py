@@ -238,6 +238,9 @@ def build_parser() -> argparse.ArgumentParser:
            "--tdlib-database-url supplies a tarball/dir store instead)")
     a("--gateway-address-file", default=None,
       help="write host:port here once bound (discovery for port 0)")
+    a("--gateway-max-connections", type=int, default=None,
+      help="cap on concurrent connection threads (default 256, 0 = "
+           "unlimited); beyond it new connects are closed immediately")
     a("--gateway-wire", default=None, choices=["dct", "mtproto"],
       help="wire protocol: dct (DCT-v1 frames, default) or mtproto "
            "(MTProto 2.0: auth-key handshake + AES-IGE messages, "
@@ -348,6 +351,7 @@ _KEY_MAP = {
     "gateway_expected_password": "gateway.expected_password",
     "gateway_seed_json": "gateway.seed_json",
     "gateway_address_file": "gateway.address_file",
+    "gateway_max_connections": "gateway.max_connections",
 }
 
 
@@ -727,7 +731,11 @@ def _run_dc_gateway(cfg: CrawlerConfig, r: ConfigResolver) -> None:
     """mode=dc-gateway: host the deployable wire-protocol server
     (`clients/dc_gateway.py`) — the production counterpart of the C++
     client's remote mode (the reference's Telegram-DC seam)."""
-    from .clients.dc_gateway import DcGateway, load_accounts
+    from .clients.dc_gateway import (
+        DEFAULT_MAX_CONNECTIONS,
+        DcGateway,
+        load_accounts,
+    )
     from .utils.metrics import clear_status_provider, set_status_provider
 
     listen = r.get_str("gateway.listen", "127.0.0.1:8443")
@@ -756,6 +764,8 @@ def _run_dc_gateway(cfg: CrawlerConfig, r: ConfigResolver) -> None:
         store_root=os.path.join(cfg.storage_root or ".", "dc-gateway"),
         address_file=r.get_str("gateway.address_file"),
         wire=r.get_str("gateway.wire", "dct") or "dct",
+        max_connections=r.get_int("gateway.max_connections",
+                                  DEFAULT_MAX_CONNECTIONS),
     ).start()
     set_status_provider(gw.status)
     try:

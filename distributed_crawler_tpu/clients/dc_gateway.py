@@ -56,6 +56,10 @@ MAX_FRAME = 64 * 1024 * 1024
 # (`telegramhelper/client.go:319-377`): a connection that hasn't reached
 # Ready within this window is dropped.
 DEFAULT_AUTH_TIMEOUT_S = 30.0
+# Concurrent-connection-thread cap (0 = unlimited): the auth watchdog
+# bounds each unauthenticated thread's lifetime, the cap bounds their
+# count.
+DEFAULT_MAX_CONNECTIONS = 256
 
 
 def send_frame(sock, payload: bytes) -> None:
@@ -178,7 +182,8 @@ class DcGateway:
                  seed_source: str = "", store_root: str = "",
                  tls_cert: str = "", tls_key: str = "",
                  auth_timeout_s: float = DEFAULT_AUTH_TIMEOUT_S,
-                 address_file: str = "", wire: str = "dct"):
+                 address_file: str = "", wire: str = "dct",
+                 max_connections: int = DEFAULT_MAX_CONNECTIONS):
         self.seed_json = seed_json or '{"channels": []}'
         self.expected_code = expected_code
         self.expected_password = expected_password
@@ -252,8 +257,12 @@ class DcGateway:
         self._stop = threading.Event()
         self._threads: list = []
         self._live_conns: list = []
+        if max_connections < 0:
+            raise ValueError("max_connections must be >= 0 (0 = unlimited)")
+        self.max_connections = max_connections
         self._stats_mu = threading.Lock()
         self.connections = 0
+        self.rejected_connections = 0
         self.auth_successes = 0
         self.auth_failures = 0
         self.requests_served = 0
@@ -317,6 +326,7 @@ class DcGateway:
                 "tls": self._ssl_ctx is not None,
                 "accounts": len(self.accounts),
                 "connections_total": self.connections,
+                "rejected_connections": self.rejected_connections,
                 "active_sessions": self.active_sessions,
                 "auth_successes": self.auth_successes,
                 "auth_failures": self.auth_failures,
@@ -339,6 +349,18 @@ class DcGateway:
                 self._threads = [t for t in self._threads if t.is_alive()]
                 self._live_conns = [c for c in self._live_conns
                                     if c.fileno() != -1]
+                # Connection cap: the auth watchdog bounds each thread's
+                # LIFETIME, this bounds their COUNT — without it a connect
+                # flood pins max_connections*auth_timeout thread-seconds
+                # of unauthenticated work per wave.
+                if (self.max_connections > 0
+                        and len(self._threads) >= self.max_connections):
+                    self.rejected_connections += 1
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    continue
                 self._live_conns.append(conn)
             t = threading.Thread(target=self._serve_conn,
                                  args=(conn, addr, seq), daemon=True,
