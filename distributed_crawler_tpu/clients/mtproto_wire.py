@@ -310,6 +310,7 @@ class Session:
     is_client: bool
     seq: int = 0
     _last_msg_id: int = 0
+    _peer_last_msg_id: int = 0
 
     @property
     def auth_key_id(self) -> bytes:
@@ -358,7 +359,15 @@ class Session:
             # The client mints the session id (per spec); the server
             # adopts it from the first VALIDATED message.
             self.session_id = sid
-        r.int64()  # msg_id
+        elif sid != self.session_id:
+            raise ValueError("session_id mismatch")
+        msg_id = r.int64()
+        # Replay protection (spec rule): peer msg_ids must be strictly
+        # increasing within a session — a recorded encrypted request
+        # replayed verbatim fails here instead of re-executing.
+        if msg_id <= self._peer_last_msg_id:
+            raise ValueError("msg_id not increasing (replay?)")
+        self._peer_last_msg_id = msg_id
         r.uint32()  # seq_no
         n = r.uint32()
         if n > len(inner) - 32:

@@ -754,10 +754,18 @@ class MtprotoConnection {
     if (msg_key_for(auth_key_, inner, /*to_server=*/false) != mk)
       throw MtprotoError("msg_key mismatch");
     TlReader r(inner);
-    r.raw(8);   // salt
-    r.raw(8);   // session_id
-    r.i64();    // msg_id
-    r.u32();    // seq_no
+    r.raw(8);  // salt
+    if (r.raw(8) != session_id_)
+      throw MtprotoError("session_id mismatch");
+    int64_t msg_id = r.i64();
+    // Replay protection (spec rule, parity with the Python twin): peer
+    // msg_ids are strictly increasing — a recorded server frame
+    // re-injected on this connection fails here instead of being
+    // re-processed.
+    if (msg_id <= peer_last_msg_id_)
+      throw MtprotoError("msg_id not increasing (replay?)");
+    peer_last_msg_id_ = msg_id;
+    r.u32();  // seq_no
     uint32_t n = r.u32();
     if (n > inner.size() - 32) throw MtprotoError("bad inner length");
     return r.raw(n);
@@ -771,6 +779,7 @@ class MtprotoConnection {
   Bytes session_id_;
   uint32_t seq_ = 0;
   int64_t last_msg_id_ = 0;
+  int64_t peer_last_msg_id_ = 0;
   std::mutex enc_mu_;
 };
 

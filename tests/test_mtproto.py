@@ -120,6 +120,27 @@ class TestSession:
         with pytest.raises(ValueError):
             stranger.decrypt(client.encrypt(b"payload"))
 
+    def test_replay_rejected(self):
+        """A recorded encrypted request replayed verbatim must not
+        re-execute: peer msg_ids are strictly increasing (spec rule)."""
+        client, server = self._pair()
+        packet = client.encrypt(b"transfer-money")
+        assert server.decrypt(packet) == b"transfer-money"
+        with pytest.raises(ValueError, match="replay"):
+            server.decrypt(packet)
+        # The session keeps working for fresh messages.
+        assert server.decrypt(client.encrypt(b"next")) == b"next"
+
+    def test_session_id_switch_rejected(self):
+        client, server = self._pair()
+        server.decrypt(client.encrypt(b"a"))
+        intruder = Session(auth_key=client.auth_key,
+                           server_salt=client.server_salt,
+                           session_id=b"EVILSESS", is_client=True)
+        intruder._last_msg_id = client._last_msg_id  # fresh msg_id
+        with pytest.raises(ValueError, match="session_id"):
+            server.decrypt(intruder.encrypt(b"b"))
+
     def test_padding_and_alignment(self):
         client, _ = self._pair()
         packet = client.encrypt(b"q")
