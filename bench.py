@@ -73,10 +73,12 @@ def _log(msg: str) -> None:
 
 
 # The TPU behind the tunnel wedges intermittently (a bare matmul can hang
-# minutes, then recover).  Every successful TPU measurement is cached here
+# HOURS, then recover).  Every successful TPU measurement is cached here
 # so a run that samples a wedged window still carries the most recent REAL
-# TPU number — clearly labelled as a prior measurement, never as the live
-# headline.
+# TPU number — clearly labelled as a prior measurement (measured_at), never
+# as the live headline.  The file is git-tracked: the measurement is of the
+# same tunneled chip class and must survive container rotation, where a
+# wedged day would otherwise erase the only real number.
 TPU_CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "bench_tpu_cache.json")
 
