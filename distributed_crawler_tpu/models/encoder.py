@@ -47,6 +47,21 @@ class EncoderConfig:
     max_len: int = 512
     n_labels: int = 2                 # classifier head width
     n_experts: int = 0                # 0 = dense MLP; >0 = switch MoE
+    # Switch-MoE dispatch strategy:
+    #   "dense"    — one-hot einsum computes EVERY expert for EVERY token
+    #                then selects: exact, no drops, but n_experts× the
+    #                MLP FLOPs — right for tiny expert counts and as the
+    #                reference semantics for tests;
+    #   "capacity" — tokens are routed to at most
+    #                ceil(tokens/E * moe_capacity_factor) slots per
+    #                expert via static-shape dispatch matmuls (the
+    #                Switch-Transformer scheme): ~capacity_factor× the
+    #                MLP FLOPs regardless of E; tokens beyond a full
+    #                expert's capacity are dropped (contribute zero),
+    #                standard switch behavior.  Exact equality with
+    #                "dense" whenever nothing overflows.
+    moe_dispatch: str = "dense"
+    moe_capacity_factor: float = 1.25
     dropout: float = 0.0              # inference-first; training may override
     layer_norm_eps: float = 1e-5
     dtype: str = "bfloat16"           # activation dtype
@@ -81,6 +96,14 @@ class EncoderConfig:
                 f"hidden {self.hidden} not divisible by heads {self.n_heads}")
         if self.quant not in ("none", "int8", "int8_static"):
             raise ValueError(f"unknown quant mode {self.quant!r}")
+        if self.moe_dispatch not in ("dense", "capacity"):
+            raise ValueError(
+                f"unknown moe_dispatch {self.moe_dispatch!r}")
+        if self.moe_dispatch == "capacity" and self.quant != "none":
+            raise ValueError(
+                "moe_dispatch='capacity' requires quant='none' — the "
+                "int8 expert GEMMs' per-expert quantized layout can't "
+                "host the pack/unpack matmuls; use dense dispatch")
         if self.calibrate and self.quant != "none":
             raise ValueError("calibrate requires the float path "
                              "(quant='none')")
@@ -214,26 +237,73 @@ class DenseMLP(nn.Module):
 
 
 class SwitchMoE(nn.Module):
-    """Top-1 switch MLP. Dispatch is dense one-hot einsum — exact, static
-    shapes, and XLA shards the expert dim over tp per the param rules; at
-    inference scale that beats gather/scatter routing on TPU."""
+    """Top-1 switch MLP with selectable dispatch (cfg.moe_dispatch).
+
+    "dense": one-hot einsum computes every expert for every token then
+    selects — exact, no drops, n_experts× the MLP FLOPs; the reference
+    semantics for tests and the int8 expert path.
+
+    "capacity": the Switch-Transformer scheme — tokens are packed into
+    ceil(group/E * capacity_factor) static slots per expert with
+    dispatch/combine matmuls, grouped along the token axis so the
+    [group, E, capacity] dispatch tensor's HBM footprint is bounded per
+    group instead of scaling with the whole batch.  ~capacity_factor×
+    the MLP FLOPs regardless of E; overflow tokens are dropped
+    (contribute zero); attention-padding tokens are excluded from
+    routing so they can't evict real tokens from capacity.
+
+    Either way XLA shards the expert dim over tp per the param rules.
+    """
 
     cfg: EncoderConfig
 
+    # Token-group size for capacity dispatch: grouping bounds the
+    # [group, E, capacity] dispatch tensor's HBM footprint (and the
+    # pack/unpack matmul tile sizes) at ~group²·cf elements — ~42 MB in
+    # bf16 at 4096 — instead of letting it scale with the whole batch.
+    _GROUP = 4096
+
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, mask=None):
         cfg = self.cfg
-        e, h, m = cfg.n_experts, cfg.hidden, cfg.mlp_dim
+        e = cfg.n_experts
         gate = nn.Dense(e, dtype=jnp.float32, param_dtype=jnp.float32,
                         name="router")(x.astype(jnp.float32))
         probs = jax.nn.softmax(gate, axis=-1)           # [B, L, E]
         top = jnp.argmax(probs, axis=-1)                # [B, L]
+        if cfg.moe_dispatch == "capacity":
+            # validate() guarantees quant == "none" here; int8 expert
+            # GEMMs ride the dense dispatch (their per-expert quantized
+            # layout can't host the pack/unpack matmuls).
+            out = self._capacity_experts(x, top, mask)
+        else:
+            out = self._dense_experts(x, top)
+        # Scale by the (f32) router prob of the chosen expert so the router
+        # receives gradient during fine-tuning.
+        chosen = jnp.sum(probs * jax.nn.one_hot(top, e), axis=-1)
+        return out * chosen[..., None].astype(cfg.adtype)
+
+    def _expert_params(self):
+        cfg = self.cfg
+        e, h, m = cfg.n_experts, cfg.hidden, cfg.mlp_dim
+        w_up = self.param("experts_up/kernel",
+                          nn.initializers.lecun_normal(),
+                          (e, h, m), jnp.float32)
+        w_dn = self.param("experts_down/kernel",
+                          nn.initializers.lecun_normal(),
+                          (e, m, h), jnp.float32)
+        return w_up, w_dn
+
+    def _dense_experts(self, x, top):
+        cfg = self.cfg
+        e = cfg.n_experts
         onehot = jax.nn.one_hot(top, e, dtype=cfg.adtype)
         # int8_static uses the DYNAMIC expert path: per-(token, expert)
         # activation stats vary too much for one static scale, and the
         # expert GEMMs' dispatch einsum can't host the fused quantize
         # anyway.
         if cfg.quant in ("int8", "int8_static"):
+            h, m = cfg.hidden, cfg.mlp_dim
             w_up_q = self.param("experts_up/kernel_q", nn.initializers.zeros,
                                 (e, h, m), jnp.int8)
             s_up = self.param("experts_up/scale", nn.initializers.ones,
@@ -246,20 +316,51 @@ class SwitchMoE(nn.Module):
             hid = nn.gelu(hid, approximate=True)
             out = int8_experts_down(hid, w_dn_q, s_dn, out_dtype=cfg.adtype)
         else:
-            w_up = self.param("experts_up/kernel",
-                              nn.initializers.lecun_normal(),
-                              (e, h, m), jnp.float32)
-            w_dn = self.param("experts_down/kernel",
-                              nn.initializers.lecun_normal(),
-                              (e, m, h), jnp.float32)
+            w_up, w_dn = self._expert_params()
             hid = jnp.einsum("blh,ehm->blem", x, w_up.astype(cfg.adtype))
             hid = nn.gelu(hid, approximate=True)
             out = jnp.einsum("blem,emh->bleh", hid, w_dn.astype(cfg.adtype))
-        out = jnp.einsum("bleh,ble->blh", out, onehot)
-        # Scale by the (f32) router prob of the chosen expert so the router
-        # receives gradient during fine-tuning.
-        chosen = jnp.sum(probs * jax.nn.one_hot(top, e), axis=-1)
-        return out * chosen[..., None].astype(cfg.adtype)
+        return jnp.einsum("bleh,ble->blh", out, onehot)
+
+    def _capacity_experts(self, x, top, mask):
+        import math
+
+        cfg = self.cfg
+        e, h = cfg.n_experts, cfg.hidden
+        w_up, w_dn = self._expert_params()
+        b, l, _ = x.shape
+        n = b * l
+        g = min(n, self._GROUP)
+        n_pad = int(math.ceil(n / g)) * g
+        cap = max(1, int(math.ceil(g / e * cfg.moe_capacity_factor)))
+        xf = x.reshape(n, h)
+        topf = top.reshape(n)
+        # Attention-padding tokens must not route: they'd consume
+        # capacity and evict REAL tokens arriving later in the group
+        # (their MLP output is masked out downstream anyway).
+        valid = (jnp.ones(n, bool) if mask is None
+                 else mask.reshape(n).astype(bool))
+        if n_pad != n:
+            xf = jnp.pad(xf, ((0, n_pad - n), (0, 0)))
+            topf = jnp.pad(topf, (0, n_pad - n))
+            valid = jnp.pad(valid, (0, n_pad - n))
+        onehot = (jax.nn.one_hot(topf, e, dtype=jnp.int32)
+                  * valid[:, None].astype(jnp.int32))       # [N, E]
+        k = n_pad // g
+        oh_g = onehot.reshape(k, g, e)
+        # 0-based arrival position of each token within its expert's
+        # per-group queue; >= cap beyond capacity (dropped).
+        pos = jnp.cumsum(oh_g, axis=1) * oh_g - oh_g        # [K, G, E]
+        keep = ((pos < cap) & (oh_g > 0)).astype(cfg.adtype)
+        disp = (jax.nn.one_hot(pos, cap, dtype=cfg.adtype)
+                * keep[..., None])                          # [K, G, E, C]
+        xg = xf.reshape(k, g, h).astype(cfg.adtype)
+        x_e = jnp.einsum("kgec,kgh->kech", disp, xg)        # pack
+        hid = jnp.einsum("kech,ehm->kecm", x_e, w_up.astype(cfg.adtype))
+        hid = nn.gelu(hid, approximate=True)
+        out_e = jnp.einsum("kecm,emh->kech", hid, w_dn.astype(cfg.adtype))
+        y = jnp.einsum("kgec,kech->kgh", disp, out_e)       # unpack
+        return y.reshape(n_pad, h)[:n].reshape(b, l, h)
 
 
 class EncoderLayer(nn.Module):
@@ -274,9 +375,10 @@ class EncoderLayer(nn.Module):
         a = SelfAttention(cfg, name="attn")(x, mask)
         x = ln("ln_attn")(x.astype(jnp.float32)
                           + a.astype(jnp.float32)).astype(cfg.adtype)
-        mlp = (SwitchMoE(cfg, name="moe") if cfg.n_experts
-               else DenseMLP(cfg, name="mlp"))
-        m = mlp(x)
+        if cfg.n_experts:
+            m = SwitchMoE(cfg, name="moe")(x, mask=mask)
+        else:
+            m = DenseMLP(cfg, name="mlp")(x)
         x = ln("ln_mlp")(x.astype(jnp.float32)
                          + m.astype(jnp.float32)).astype(cfg.adtype)
         return x

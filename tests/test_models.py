@@ -96,6 +96,86 @@ class TestMoE:
         assert layer["experts_up/kernel"].shape == (4, cfg.hidden, cfg.mlp_dim)
 
 
+class TestMoECapacityDispatch:
+    """capacity dispatch (the Switch-Transformer scheme) vs the dense
+    reference path: exact when nothing overflows, standard drop-to-zero
+    beyond capacity, same params either way."""
+
+    def _model_pair(self, capacity_factor=8.0):
+        from distributed_crawler_tpu.models.encoder import EmbedderClassifier
+        dense = replace(TINY_TEST, n_experts=4, n_labels=3)
+        cap = replace(dense, moe_dispatch="capacity",
+                      moe_capacity_factor=capacity_factor)
+        return EmbedderClassifier(dense), EmbedderClassifier(cap)
+
+    def test_exact_match_when_capacity_suffices(self):
+        ids, mask = _batch()
+        dense_m, cap_m = self._model_pair(capacity_factor=8.0)
+        params = dense_m.init(jax.random.PRNGKey(0), ids, mask)
+        demb, dlog = dense_m.apply(params, ids, mask)
+        cemb, clog = cap_m.apply(params, ids, mask)  # SAME params
+        np.testing.assert_allclose(np.asarray(demb), np.asarray(cemb),
+                                   rtol=0, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(dlog), np.asarray(clog),
+                                   rtol=0, atol=1e-4)
+
+    def test_overflow_drops_not_crashes(self):
+        from distributed_crawler_tpu.models.encoder import SwitchMoE
+        cfg = replace(TINY_TEST, n_experts=4, moe_dispatch="capacity",
+                      moe_capacity_factor=0.25)  # guaranteed overflow
+        moe = SwitchMoE(cfg)
+        x = jnp.asarray(
+            np.random.default_rng(0).normal(size=(2, 16, cfg.hidden)),
+            jnp.float32)
+        params = moe.init(jax.random.PRNGKey(1), x)
+        out = moe.apply(params, x)
+        assert out.shape == x.shape
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_jit_and_grouping_padding(self):
+        """Token count not divisible by the group size still works under
+        jit (static pad inside the module)."""
+        from distributed_crawler_tpu.models.encoder import SwitchMoE
+        cfg = replace(TINY_TEST, n_experts=4, moe_dispatch="capacity")
+        moe = SwitchMoE(cfg)
+        x = jnp.asarray(
+            np.random.default_rng(1).normal(size=(3, 24, cfg.hidden)),
+            jnp.float32)  # 72 tokens
+        params = moe.init(jax.random.PRNGKey(2), x)
+        out = jax.jit(lambda p, v: moe.apply(p, v))(params, x)
+        assert out.shape == x.shape
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_padding_tokens_cannot_evict_real_ones(self):
+        """With a tight capacity, attention-padding tokens must be
+        excluded from routing: real positions match dense dispatch even
+        though pads outnumber them."""
+        from distributed_crawler_tpu.models.encoder import SwitchMoE
+        dense_cfg = replace(TINY_TEST, n_experts=4)
+        cap_cfg = replace(dense_cfg, moe_dispatch="capacity",
+                          moe_capacity_factor=1.0)
+        rng = np.random.default_rng(3)
+        b, l, real = 2, 32, 6  # 26/32 positions are padding
+        x = jnp.asarray(rng.normal(size=(b, l, dense_cfg.hidden)),
+                        jnp.float32)
+        mask = jnp.asarray(np.arange(l) < real)[None, :].repeat(b, axis=0)
+        dense_moe, cap_moe = SwitchMoE(dense_cfg), SwitchMoE(cap_cfg)
+        params = dense_moe.init(jax.random.PRNGKey(0), x)
+        dout = dense_moe.apply(params, x, mask=mask)
+        cout = cap_moe.apply(params, x, mask=mask)
+        # cap = ceil(64/4 * 1.0) = 16 slots/expert >= 12 real tokens:
+        # every real token fits IF pads don't route; they'd overflow it
+        # 64-tokens-deep otherwise.
+        np.testing.assert_allclose(
+            np.asarray(dout)[:, :real], np.asarray(cout)[:, :real],
+            rtol=0, atol=1e-5)
+
+    def test_bad_dispatch_rejected(self):
+        cfg = replace(TINY_TEST, n_experts=4, moe_dispatch="nope")
+        with pytest.raises(ValueError, match="moe_dispatch"):
+            cfg.validate()
+
+
 class TestConfig:
     def test_indivisible_heads_raises(self):
         cfg = replace(TINY_TEST, hidden=65)
