@@ -174,6 +174,10 @@ def build_parser() -> argparse.ArgumentParser:
     a("--infer-attention", default=None,
       help="attention dispatch: auto (flash past the length threshold on "
            "TPU) | xla | flash")
+    a("--infer-moe-dispatch", default=None, choices=["dense", "capacity"],
+      help="Switch-MoE dispatch for MoE checkpoints: dense (exact, "
+           "n_experts× MLP FLOPs) | capacity (Switch static-slot packing,"
+           " ~1.25× FLOPs)")
     a("--infer-param-dtype", default=None,
       help="cast float params at engine startup (e.g. bfloat16) — halves "
            "weight HBM traffic when serving; empty keeps the f32 layout")
@@ -318,6 +322,7 @@ _KEY_MAP = {
     "infer_backpressure_low": "distributed.inference_backpressure_low",
     "infer_batch_size": "inference.batch_size",
     "infer_attention": "inference.attention",
+    "infer_moe_dispatch": "inference.moe_dispatch",
     "infer_param_dtype": "inference.param_dtype",
     "infer_quantize": "inference.quantize",
     "asr_pretrained_dir": "inference.asr_pretrained_dir",
@@ -434,6 +439,7 @@ def resolve_config(args: argparse.Namespace,
     cfg.inference.param_dtype = r.get_str("inference.param_dtype", "")
     cfg.inference.quantize = r.get_str("inference.quantize", "")
     cfg.inference.attention = r.get_str("inference.attention", "")
+    cfg.inference.moe_dispatch = r.get_str("inference.moe_dispatch", "")
     cfg.inference.pretrained_dir = r.get_str(
         "inference.pretrained_dir", cfg.inference.pretrained_dir)
     cfg.inference.asr_pretrained_dir = r.get_str(
@@ -1222,7 +1228,11 @@ def _make_engine(cfg: CrawlerConfig, r: ConfigResolver,
         # (unlike param_dtype/quantize, where None is already the safe
         # default, 'auto' here could still dispatch flash at long buckets).
         attention=(cfg.inference.attention or None) if cast_params
-        else "xla")
+        else "xla",
+        # Same reasoning for MoE: serving may pick capacity dispatch;
+        # train-head keeps the model's exact dense default.
+        moe_dispatch=(cfg.inference.moe_dispatch or None) if cast_params
+        else None)
     if n_labels is not None:
         kw["n_labels"] = n_labels
     if with_checkpoint:

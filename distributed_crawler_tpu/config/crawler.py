@@ -68,6 +68,10 @@ class InferenceConfig:
     # Attention dispatch ("" = engine default "auto": Pallas flash past
     # the length threshold on TPU; "xla" | "flash" force a path).
     attention: str = ""
+    # Switch-MoE dispatch for MoE checkpoints ("" keeps the model's
+    # default "dense"; "capacity" serves with Switch static-slot packing
+    # — ~capacity_factor× MLP FLOPs instead of n_experts×).
+    moe_dispatch: str = ""
     # Local HF checkpoint dirs (real weights + vocab; offline only).  Empty
     # string -> registry config with random init + hashing tokenizer.
     pretrained_dir: str = ""

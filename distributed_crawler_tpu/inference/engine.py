@@ -67,6 +67,11 @@ class EngineConfig:
     # Attention dispatch: "auto" (ops/attention.py policy: Pallas flash
     # past FLASH_MIN_SEQ on TPU, XLA otherwise) | "xla" | "flash".
     attention: Optional[str] = None
+    # Switch-MoE dispatch override for MoE models: None keeps the
+    # model's own setting; "dense" | "capacity" force a path (capacity =
+    # Switch static-slot packing, ~cf× MLP FLOPs instead of n_experts×;
+    # rejected with int8 quantize by EncoderConfig.validate()).
+    moe_dispatch: Optional[str] = None
 
     def encoder_config(self) -> EncoderConfig:
         try:
@@ -126,6 +131,15 @@ class InferenceEngine:
             # Validate BEFORE any checkpoint I/O: a typo must not cost a
             # multi-GB pretrained load first.
             raise ValueError(f"unknown attention mode {cfg.attention!r}")
+        if cfg.moe_dispatch and cfg.moe_dispatch not in ("dense",
+                                                         "capacity"):
+            raise ValueError(f"unknown moe_dispatch {cfg.moe_dispatch!r}")
+        if cfg.moe_dispatch == "capacity" and cfg.quantize:
+            # Decidable from the config alone — don't pay checkpoint load
+            # + calibration + quantization before the conflict surfaces.
+            raise ValueError(
+                "moe_dispatch='capacity' requires quantize unset — the "
+                "int8 expert GEMMs ride dense dispatch")
         if cfg.pretrained_dir:
             self.ecfg, params, tokenizer = _load_pretrained(
                 cfg, params, tokenizer)
@@ -135,6 +149,8 @@ class InferenceEngine:
             # Applied HERE so every param source — registry, pretrained
             # checkpoint, restored head — honors it.
             self.ecfg = replace(self.ecfg, attention=cfg.attention)
+        if cfg.moe_dispatch:
+            self.ecfg = replace(self.ecfg, moe_dispatch=cfg.moe_dispatch)
         self.label_names: Optional[List[str]] = None
         if cfg.checkpoint_dir:
             # The checkpoint's own head width wins (a 2-class fine-tune must

@@ -129,6 +129,33 @@ class TestInferenceEngine:
         eng = _make_engine(cfg, r)
         assert eng.ecfg.attention == "xla"
 
+    def test_moe_dispatch_override_plumbs_to_encoder(self):
+        from distributed_crawler_tpu.cli import (
+            _make_engine,
+            build_parser,
+            resolve_config,
+        )
+        from distributed_crawler_tpu.inference.engine import (
+            EngineConfig,
+            InferenceEngine,
+        )
+        from distributed_crawler_tpu.utils.metrics import MetricsRegistry
+
+        eng = InferenceEngine(
+            EngineConfig(model="tiny", batch_size=4, buckets=(32,),
+                         moe_dispatch="capacity"),
+            registry=MetricsRegistry())
+        assert eng.ecfg.moe_dispatch == "capacity"
+        with pytest.raises(ValueError, match="moe_dispatch"):
+            InferenceEngine(
+                EngineConfig(model="tiny", moe_dispatch="scatter"),
+                registry=MetricsRegistry())
+        args = build_parser().parse_args(
+            ["--urls", "a", "--infer-model", "tiny",
+             "--infer-moe-dispatch", "capacity"])
+        cfg, r = resolve_config(args, env={})
+        assert _make_engine(cfg, r).ecfg.moe_dispatch == "capacity"
+
     def test_pipelined_chunks_keep_order_across_buckets(self):
         """The one-deep dispatch/readback pipeline must not reorder or
         drop results when inputs span several buckets and ragged chunk
