@@ -203,7 +203,12 @@ class RsaKey:
         c = pow(int.from_bytes(dwh, "big"), self.e, self.n)
         return c.to_bytes(256, "big")
 
-    def decrypt_with_hash(self, cipher: bytes) -> bytes:
+    def decrypt_with_hash(self, cipher: bytes) -> Tuple[bytes, bytes]:
+        """Raw-RSA decrypt → (sha1_digest, payload_with_padding).
+
+        The caller TL-parses the payload (which knows its true length)
+        and THEN verifies the SHA1 prefix — cheaper than testing every
+        feasible split here (see the server handshake)."""
         assert self.d is not None, "no private exponent"
         m = pow(int.from_bytes(cipher, "big"), self.d, self.n)
         try:
@@ -212,11 +217,7 @@ class RsaKey:
             # Adversarial/garbage ciphertext decrypts to ~n-sized values;
             # surface it as the protocol error the session loop handles.
             raise ValueError("RSA decryption out of range") from None
-        digest, rest = dwh[:20], dwh[20:]
-        # Caller re-parses TL and knows the true length; verify the SHA1
-        # prefix against every feasible split is wasteful — instead TL
-        # parse first, then verify (see server handshake).
-        return digest, rest  # type: ignore[return-value]
+        return dwh[:20], dwh[20:]
 
 
 def generate_rsa_key(bits: int = 2048) -> RsaKey:
@@ -331,9 +332,8 @@ class Session:
         inner = (self.server_salt + self.session_id +
                  i64(self._next_msg_id()) + u32(self.seq * 2 + 1) +
                  u32(len(payload)) + payload)
-        # Padding: 12..1024 random bytes, total length % 16 == 0 (spec).
-        pad = 16 - (len(inner) + 12) % 16
-        inner += secrets.token_bytes(12 + (pad % 16))
+        # Padding: ≥12 random bytes, total length % 16 == 0 (spec).
+        inner += secrets.token_bytes(12 + (-(len(inner) + 12)) % 16)
         to_server = self.is_client
         msg_key = compute_msg_key(self.auth_key, inner, to_server)
         key, iv = kdf(self.auth_key, msg_key, to_server)

@@ -21,7 +21,8 @@ crawl stream).  TPU-first choices:
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
+import math
+from dataclasses import dataclass
 from typing import Optional
 
 import flax.linen as nn
@@ -323,8 +324,6 @@ class SwitchMoE(nn.Module):
         return jnp.einsum("bleh,ble->blh", out, onehot)
 
     def _capacity_experts(self, x, top, mask):
-        import math
-
         cfg = self.cfg
         e, h = cfg.n_experts, cfg.hidden
         w_up, w_dn = self._expert_params()

@@ -734,8 +734,8 @@ class MtprotoConnection {
     tl_u32(&inner, seq_ * 2 + 1);
     tl_u32(&inner, static_cast<uint32_t>(payload.size()));
     inner += payload;
-    size_t pad = 16 - (inner.size() + 12) % 16;
-    inner += random_bytes(12 + (pad % 16));
+    // Padding: ≥12 random bytes, total length % 16 == 0 (spec).
+    inner += random_bytes(12 + (16 - (inner.size() + 12) % 16) % 16);
     Bytes mk = msg_key_for(auth_key_, inner, /*to_server=*/true);
     Bytes key, iv;
     kdf2(auth_key_, mk, /*to_server=*/true, &key, &iv);
