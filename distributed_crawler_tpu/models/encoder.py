@@ -272,6 +272,24 @@ class SwitchMoE(nn.Module):
                         name="router")(x.astype(jnp.float32))
         probs = jax.nn.softmax(gate, axis=-1)           # [B, L, E]
         top = jnp.argmax(probs, axis=-1)                # [B, L]
+        # Switch load-balancing auxiliary loss (sowed into the "losses"
+        # collection; a no-op unless the caller makes it mutable — the
+        # full-fine-tune train step does, inference never):
+        # aux = E · Σ_e f_e·P_e with f_e the dispatched-token fraction
+        # and P_e the mean router prob, over REAL tokens only.  ≈1 when
+        # balanced, →E when the router collapses onto one expert.
+        if not self.is_initializing():
+            # Guarded: init() runs with every collection mutable, and an
+            # init-time sow would bake a stale value into the variables
+            # dict that later applies reduce ONTO.
+            w = (jnp.ones(top.shape, jnp.float32) if mask is None
+                 else mask.astype(jnp.float32))
+            denom = jnp.maximum(jnp.sum(w), 1.0)
+            p_e = jnp.sum(probs * w[..., None], axis=(0, 1)) / denom
+            f_e = jnp.sum(jax.nn.one_hot(top, e) * w[..., None],
+                          axis=(0, 1)) / denom
+            self.sow("losses", "moe_aux", e * jnp.sum(f_e * p_e),
+                     reduce_fn=jnp.add, init_fn=lambda: jnp.float32(0))
         if cfg.moe_dispatch == "capacity":
             # validate() guarantees quant == "none" here; int8 expert
             # GEMMs ride the dense dispatch (their per-expert quantized

@@ -39,6 +39,10 @@ class TrainConfig:
     max_grad_norm: float = 1.0
     warmup_steps: int = 100
     label_smoothing: float = 0.0
+    # Switch load-balancing aux-loss weight (the Switch-Transformer
+    # default): keeps the router from collapsing onto one expert during
+    # full fine-tuning of MoE configs.  No effect on dense models.
+    moe_aux_weight: float = 0.01
 
 
 def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
@@ -77,17 +81,24 @@ def make_train_step(cfg: EncoderConfig, tc: TrainConfig = TrainConfig()
         return params, optimizer.init(params)
 
     def loss_fn(params, ids, mask, labels):
-        logits = model.apply({"params": params}, ids, mask)
+        logits, mods = model.apply({"params": params}, ids, mask,
+                                   mutable=["losses"])
         loss = cross_entropy(logits, labels, tc.label_smoothing)
+        # Switch load-balancing aux (sowed per MoE layer, summed here);
+        # zero for dense configs — the tree is empty.
+        aux = jax.tree_util.tree_reduce(
+            jnp.add, mods.get("losses", {}), jnp.float32(0))
+        loss = loss + tc.moe_aux_weight * aux
         acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
-        return loss, acc
+        return loss, (acc, aux)
 
     def step_fn(params, opt_state, ids, mask, labels):
-        (loss, acc), grads = jax.value_and_grad(
+        (loss, (acc, aux)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params, ids, mask, labels)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        return params, opt_state, {"loss": loss, "accuracy": acc}
+        return params, opt_state, {"loss": loss, "accuracy": acc,
+                                   "moe_aux": aux}
 
     return init_fn, step_fn, optimizer
 

@@ -146,6 +146,50 @@ class TestMoECapacityDispatch:
         assert out.shape == x.shape
         assert np.isfinite(np.asarray(out)).all()
 
+    def test_switch_aux_loss_sowed_and_bounded(self):
+        """Load-balancing aux: ~1 when balanced, == E on router collapse,
+        absent for dense configs."""
+        from distributed_crawler_tpu.models.encoder import SwitchMoE
+        cfg = replace(TINY_TEST, n_experts=4)
+        moe = SwitchMoE(cfg)
+        rng = np.random.default_rng(5)
+        x = jnp.asarray(rng.normal(size=(2, 16, cfg.hidden)), jnp.float32)
+        params = moe.init(jax.random.PRNGKey(0), x)
+        _, mods = moe.apply(params, x, mutable=["losses"])
+        aux = jax.tree_util.tree_reduce(
+            jnp.add, mods["losses"], jnp.float32(0))
+        assert 1.0 - 1e-3 <= float(aux) <= 4.0 + 1e-3
+        # Collapse the router onto expert 0: aux must hit E exactly.
+        # Deep-copy the tree: a shallow dict() would alias the nested
+        # router leaves and silently mutate the balanced params above.
+        p2 = jax.tree_util.tree_map(lambda v: v, params)
+        router = p2["params"]["router"]
+        router["kernel"] = jnp.zeros_like(router["kernel"])
+        router["bias"] = jnp.asarray([50.0, 0.0, 0.0, 0.0], jnp.float32)
+        _, mods = moe.apply(p2, x, mutable=["losses"])
+        aux = jax.tree_util.tree_reduce(
+            jnp.add, mods["losses"], jnp.float32(0))
+        np.testing.assert_allclose(float(aux), 4.0, rtol=1e-5)
+
+    def test_train_step_carries_moe_aux(self):
+        from distributed_crawler_tpu.models.train import (
+            TrainConfig,
+            make_train_step,
+        )
+        ids, mask = _batch()
+        labels = jnp.asarray(np.arange(ids.shape[0]) % 3, jnp.int32)
+        for n_experts, expect_aux in ((4, True), (0, False)):
+            cfg = replace(TINY_TEST, n_experts=n_experts, n_labels=3)
+            init_fn, step_fn, _ = make_train_step(
+                cfg, TrainConfig(warmup_steps=1))
+            params, opt_state = init_fn(jax.random.PRNGKey(0), ids, mask)
+            _, _, metrics = step_fn(params, opt_state, ids, mask, labels)
+            assert np.isfinite(float(metrics["loss"]))
+            if expect_aux:
+                assert float(metrics["moe_aux"]) >= 1.0 - 1e-3
+            else:
+                assert float(metrics["moe_aux"]) == 0.0
+
     def test_padding_tokens_cannot_evict_real_ones(self):
         """With a tight capacity, attention-padding tokens must be
         excluded from routing: real positions match dense dispatch even
