@@ -102,6 +102,34 @@ class TestFinetuneLora:
         acc = np.mean([r["label"] == y for r, y in zip(out, held_labels)])
         assert acc >= 0.8, f"held-out accuracy {acc} not above random"
 
+    def test_lora_on_moe_config(self):
+        """LoRA adapters compose with switch-MoE encoders: projections
+        get adapters, router/expert weights stay frozen, and the aux-loss
+        sow in SwitchMoE is a no-op under LoRA's non-mutable apply."""
+        from dataclasses import replace
+
+        from distributed_crawler_tpu.models.encoder import Classifier
+
+        cfg = replace(TINY_TEST, n_experts=4, n_labels=2)
+        model = Classifier(cfg)
+        ids = jnp.zeros((1, 8), jnp.int32)
+        mask = jnp.ones((1, 8), jnp.bool_)
+        params = model.init(jax.random.PRNGKey(0), ids, mask)
+        rng = np.random.default_rng(0)
+        toks = [[1 + int(rng.integers(0, 50))] * 12 for _ in range(16)]
+        labels = [i % 2 for i in range(16)]
+        merged, history = finetune_lora(
+            cfg, params, toks, labels, rank=2,
+            tc=TrainConfig(learning_rate=5e-3, warmup_steps=2),
+            epochs=3, batch_size=8)
+        assert history[-1]["loss"] < history[0]["loss"]
+        # Expert weights were NOT touched (LoRA targets projections only).
+        e0 = np.asarray(params["params"]["encoder"]["layers_0"]["moe"]
+                        ["experts_up/kernel"])
+        e1 = np.asarray(merged["params"]["encoder"]["layers_0"]["moe"]
+                        ["experts_up/kernel"])
+        np.testing.assert_array_equal(e0, e1)
+
     def test_merged_tree_quantizes(self):
         from distributed_crawler_tpu.models.quant import (
             quantize_encoder_params,
