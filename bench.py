@@ -90,17 +90,27 @@ def _cache_tpu_result(result: dict) -> None:
         # Merge over the prior entry: a run whose ASR (or int8) leg hit a
         # wedge keeps the last good values for those rows instead of
         # erasing them — every cached field is still a real TPU
-        # measurement, just possibly from an earlier healthy window.  The
-        # ASR leg keeps its OWN timestamp so a carried-forward row never
-        # wears a fresher run's measured_at.
+        # measurement, just possibly from an earlier healthy window.
+        # EVERY optional leg keeps its OWN timestamp so a carried-forward
+        # row never wears a fresher run's measured_at (measured_at itself
+        # covers only the always-fresh headline keys).
         now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         entry = _load_tpu_cache() or {}
         entry.update({k: v for k, v in result.items() if v is not None})
         entry["measured_at"] = now
-        if result.get("asr_rtfx") is not None:
-            entry["asr_measured_at"] = now
-        if result.get("xlmr_base_posts_per_sec") is not None:
-            entry["xlmr_measured_at"] = now
+        for probe_key, stamp in (
+                ("asr_rtfx", "asr_measured_at"),
+                ("xlmr_base_posts_per_sec", "xlmr_measured_at"),
+                # The xlmr static sub-cell is best-effort within its leg
+                # and can lag the rest of it — its own stamp keeps a
+                # carried-forward cell honest.
+                ("xlmr_base_int8_static_posts_per_sec",
+                 "xlmr_static_measured_at"),
+                ("int8_posts_per_sec", "int8_measured_at"),
+                ("int8_static_posts_per_sec", "int8_static_measured_at"),
+                ("serving_posts_per_sec", "serving_measured_at")):
+            if result.get(probe_key) is not None:
+                entry[stamp] = now
         with open(TPU_CACHE_PATH, "w", encoding="utf-8") as f:
             json.dump(entry, f)
     except OSError as exc:
@@ -759,6 +769,10 @@ def main() -> None:
                     result[k] = cached[k]
             result["xlmr_from_cache_measured_at"] = cached.get(
                 "xlmr_measured_at", cached.get("measured_at"))
+            if "xlmr_base_int8_static_posts_per_sec" in cached:
+                result["xlmr_static_from_cache_measured_at"] = cached.get(
+                    "xlmr_static_measured_at",
+                    result["xlmr_from_cache_measured_at"])
     _log("measuring dp sharding overhead on virtual CPU mesh")
     eff = _dp_sharding_overhead()
     # Work-normalized (same batch, same host cores, 1 vs 8 virtual CPU

@@ -498,10 +498,12 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
         print("distributed_crawler_tpu v0.1.0")
         return 0
     if args.generate_code:
-        # Auth bootstrap (`standalone/runner.go:68,77-192`); full version
-        # with gateway dialing lives behind --mode gen-code.
-        return _gen_code(tdlib_dir=args.tdlib_dir or ".tdlib",
-                         env=env)
+        # Auth bootstrap (`standalone/runner.go:68,77-192`): the alias IS
+        # --mode gen-code — routed through the same resolver so gateway
+        # settings from flags, env (CRAWLER_*), or config file all apply
+        # (a raw-flag shortcut here silently minted against the embedded
+        # engine whenever the gateway was configured via env/file).
+        args.mode = "gen-code"
     try:
         cfg, r = resolve_config(args, env=env)
     except (ValueError, FileNotFoundError) as e:

@@ -250,9 +250,22 @@ class DcGateway:
                         json.dump({"n": hex(self._rsa.n), "e": self._rsa.e,
                                    "d": hex(self._rsa.d)}, f)
                     os.replace(tmp, key_path)
-            self.pubkey_file = (address_file + ".pubkey" if address_file
-                                else os.path.join(store_root or ".",
-                                                  "mtproto.pubkey.json"))
+            if address_file:
+                self.pubkey_file = address_file + ".pubkey"
+            elif store_root:
+                self.pubkey_file = os.path.join(store_root,
+                                                "mtproto.pubkey.json")
+            else:
+                # No operator-chosen location: own a tempdir (cleaned up
+                # in close(), like the ephemeral-TLS certs) instead of
+                # dropping an artifact into the process CWD.
+                import tempfile
+
+                if self._owned_cert_dir is None:
+                    self._owned_cert_dir = tempfile.mkdtemp(
+                        prefix="dct-dc-")
+                self.pubkey_file = os.path.join(self._owned_cert_dir,
+                                                "mtproto.pubkey.json")
             mtp.save_pubkey(self.pubkey_file, self._rsa)
         self._stop = threading.Event()
         self._threads: list = []

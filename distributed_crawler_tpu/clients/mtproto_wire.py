@@ -118,7 +118,18 @@ def ige_decrypt(key: bytes, iv: bytes, data: bytes) -> bytes:
 
 
 def tl_bytes(b: bytes) -> bytes:
-    """TL `bytes`/`string` serialization (1- or 4-byte length, pad to 4)."""
+    """TL `bytes`/`string` serialization (1- or 4-byte length, pad to 4).
+
+    The TL long form carries a 3-byte length — payloads must stay under
+    2**24 (the format's own limit; real MTProto moves bigger blobs via
+    chunked file methods).  Raise loudly rather than let int.to_bytes
+    OverflowError (or a silent wrap) corrupt the frame; >=16 MiB
+    payloads belong on the DCT-v1 wire, whose 4-byte frames carry 64 MiB
+    (documented wire-choice delta)."""
+    if len(b) >= 1 << 24:
+        raise ValueError(
+            f"payload of {len(b)} bytes exceeds the TL bytes limit "
+            f"(2^24-1); use the dct wire for >=16 MiB frames")
     if len(b) < 254:
         out = bytes([len(b)]) + b
     else:

@@ -233,6 +233,10 @@ inline void tl_i64(Bytes* out, int64_t v) {
 }
 
 inline void tl_bytes(Bytes* out, const Bytes& b) {
+  if (b.size() >= (size_t(1) << 24))
+    // The TL long form carries a 3-byte length; a silent wrap would
+    // corrupt the frame.  >=16 MiB payloads belong on the DCT-v1 wire.
+    throw MtprotoError("payload exceeds the TL bytes limit (2^24-1)");
   size_t head;
   if (b.size() < 254) {
     out->push_back(static_cast<char>(b.size()));

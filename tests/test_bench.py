@@ -82,3 +82,30 @@ def test_tpu_cache_roundtrip(tmp_path, monkeypatch):
     assert "measured_at" in cached
     bench._cache_tpu_result({"platform": "cpu", "value": 2.0})
     assert bench._load_tpu_cache()["value"] == 9000.0
+
+
+def test_tpu_cache_per_leg_timestamps(tmp_path, monkeypatch):
+    """Carried-forward optional legs keep their OWN measured_at: a later
+    run whose int8/serving leg wedged must not re-stamp the old rows."""
+    monkeypatch.setattr(bench, "TPU_CACHE_PATH",
+                        str(tmp_path / "cache.json"))
+    bench._cache_tpu_result({"platform": "tpu", "value": 9000.0,
+                             "int8_posts_per_sec": 8000.0,
+                             "serving_posts_per_sec": 7000.0})
+    first = bench._load_tpu_cache()
+    assert first["int8_measured_at"] == first["measured_at"]
+    assert first["serving_measured_at"] == first["measured_at"]
+    # Force a distinct wall-clock stamp for the second run.
+    stamps = iter(["2099-01-01T00:00:00Z"])
+    monkeypatch.setattr(bench.time, "strftime",
+                        lambda *a, **k: next(stamps))
+    bench._cache_tpu_result({"platform": "tpu", "value": 9100.0,
+                             "int8_posts_per_sec": None,
+                             "serving_posts_per_sec": None})
+    second = bench._load_tpu_cache()
+    assert second["value"] == 9100.0
+    assert second["measured_at"] == "2099-01-01T00:00:00Z"
+    # The carried-forward legs keep the FIRST run's stamp and values.
+    assert second["int8_posts_per_sec"] == 8000.0
+    assert second["int8_measured_at"] == first["int8_measured_at"]
+    assert second["serving_measured_at"] == first["serving_measured_at"]

@@ -591,3 +591,73 @@ class TestProperties:
             assert client.decrypt(server.encrypt(payload)) == payload
 
         check()
+
+
+class TestTlLimit:
+    def test_tl_bytes_rejects_16mib(self):
+        """The TL long form carries a 3-byte length: >=2^24 payloads must
+        raise loudly (a silent wrap corrupts the frame); big frames
+        belong on the DCT-v1 wire."""
+        with pytest.raises(ValueError, match="TL bytes limit"):
+            tl_bytes(b"\x00" * (1 << 24))
+        # Just under the limit still serializes.
+        ser = tl_bytes(b"\x00" * ((1 << 24) - 1))
+        assert TlReader(ser).tl_bytes() == b"\x00" * ((1 << 24) - 1)
+
+
+@pytest.mark.skipif(not _lib_available(),
+                    reason="libdct_client.so not built")
+class TestGatewayArtifacts:
+    def test_default_pubkey_lands_in_owned_tempdir(self):
+        """No address_file/store_root: the pubkey must go to a gateway-
+        owned tempdir (removed on close), never the process CWD."""
+        import os
+
+        from distributed_crawler_tpu.clients.dc_gateway import DcGateway
+
+        cwd_before = set(os.listdir("."))
+        gw = DcGateway(seed_json=SEED, wire="mtproto").start()
+        pub = gw.pubkey_file
+        assert os.path.exists(pub)
+        assert os.path.dirname(os.path.abspath(pub)) != os.path.abspath(".")
+        gw.close()
+        assert not os.path.exists(pub)  # owned tempdir cleaned up
+        assert set(os.listdir(".")) == cwd_before
+
+    def test_generate_code_alias_honors_gateway_flags(self, tmp_path):
+        """`--generate-code` (the legacy alias) must dial the gateway the
+        dc_* flags point at — not silently mint against the embedded
+        engine."""
+        from distributed_crawler_tpu.cli import main
+        from distributed_crawler_tpu.clients.dc_gateway import DcGateway
+
+        gw = DcGateway(
+            seed_json=SEED, wire="mtproto", store_root=str(tmp_path / "gw"),
+            accounts={"+15551112222": {"code": "99", "password": ""}},
+        ).start()
+        try:
+            rc = main(["--generate-code",
+                       "--dc-address", gw.address,
+                       "--dc-wire", "mtproto",
+                       "--dc-pubkey-file", gw.pubkey_file,
+                       "--tdlib-dir", str(tmp_path / "td")],
+                      env={"TG_API_ID": "1",
+                           "TG_PHONE_NUMBER": "+15551112222",
+                           "TG_PHONE_CODE": "99"})
+            assert rc == 0
+            assert (tmp_path / "td" / "credentials.json").exists()
+            assert gw.status()["auth_successes"] == 1  # really dialed it
+            # Wrong code against the gateway's account table must FAIL
+            # (the embedded engine would have accepted anything).
+            rc = main(["--generate-code",
+                       "--dc-address", gw.address,
+                       "--dc-wire", "mtproto",
+                       "--dc-pubkey-file", gw.pubkey_file,
+                       "--tdlib-dir", str(tmp_path / "td2")],
+                      env={"TG_API_ID": "1",
+                           "TG_PHONE_NUMBER": "+15551112222",
+                           "TG_PHONE_CODE": "31337"})
+            assert rc != 0
+            assert not (tmp_path / "td2" / "credentials.json").exists()
+        finally:
+            gw.close()
