@@ -1,0 +1,88 @@
+"""chip_watch.sh recovery path: proves it refreshes AND commits the bench
+TPU cache (VERDICT r04 weak #2 — the old script ran the sweeps but never
+bench.py, so a healthy window between driver rounds still left
+bench_tpu_cache.json absent).
+
+Drives `chip_watch.sh --dry-run` in a throwaway git repo with a stub
+"python" that emulates the three harnesses — in particular, the bench stub
+writes bench_tpu_cache.json the way the real bench.py does on a live TPU
+measurement — then asserts the cache file exists and was committed.
+"""
+
+import json
+import os
+import stat
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "tools", "chip_watch.sh")
+
+STUB = """#!/bin/bash
+# Stub harness runner: last arg names the harness (or bench.py).
+case "${@: -1}" in
+  *exp_mfu.py)  echo '{"variant": "base-b256", "mfu": 0.31}' ;;
+  *exp_int8.py) echo '{"cfg": "e5_small", "quant": "int8"}' ;;
+  *bench.py)
+    echo '{"platform": "tpu", "posts_per_sec": 10793.0}' > bench_tpu_cache.json
+    echo '{"metric": "posts_per_sec", "value": 10793.0, "unit": "posts/sec"}'
+    ;;
+  *) exit 9 ;;
+esac
+"""
+
+
+@pytest.fixture
+def watch_repo(tmp_path):
+    repo = tmp_path / "repo"
+    (repo / "tools").mkdir(parents=True)
+    stub = tmp_path / "stubpython"
+    stub.write_text(STUB)
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    for cmd in (["git", "init", "-q"],
+                ["git", "config", "user.email", "t@t"],
+                ["git", "config", "user.name", "t"]):
+        subprocess.run(cmd, cwd=repo, check=True)
+    (repo / "README").write_text("x")
+    subprocess.run(["git", "add", "."], cwd=repo, check=True)
+    subprocess.run(["git", "commit", "-qm", "init"], cwd=repo, check=True)
+    return repo, stub
+
+
+def _run_dry(repo, stub, commit="1"):
+    env = dict(os.environ,
+               CHIP_WATCH_REPO=str(repo),
+               CHIP_WATCH_PY=str(stub),
+               CHIP_WATCH_OUT="docs/sweeps",
+               CHIP_WATCH_COMMIT=commit)
+    return subprocess.run(["bash", SCRIPT, "--dry-run"], env=env,
+                          capture_output=True, text=True, timeout=60)
+
+
+def test_dry_run_writes_and_commits_cache(watch_repo):
+    repo, stub = watch_repo
+    proc = _run_dry(repo, stub)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    cache = repo / "bench_tpu_cache.json"
+    assert cache.exists(), "recovery path must refresh the bench cache"
+    assert json.loads(cache.read_text())["platform"] == "tpu"
+    # Sweep outputs land in the tracked sweeps dir.
+    sweeps = list((repo / "docs" / "sweeps").iterdir())
+    names = sorted(p.name.split("_2")[0] for p in sweeps)
+    assert names == ["bench", "exp_int8", "exp_mfu"]
+    # The capture was committed: a fresh clone keeps the TPU number.
+    log = subprocess.run(["git", "log", "--oneline", "--name-only"],
+                         cwd=repo, capture_output=True, text=True).stdout
+    assert "chip-watch: TPU measurement capture" in log
+    assert "bench_tpu_cache.json" in log
+
+
+def test_dry_run_commit_disabled(watch_repo):
+    repo, stub = watch_repo
+    proc = _run_dry(repo, stub, commit="0")
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert (repo / "bench_tpu_cache.json").exists()
+    log = subprocess.run(["git", "log", "--oneline"], cwd=repo,
+                         capture_output=True, text=True).stdout
+    assert "chip-watch" not in log

@@ -1,23 +1,74 @@
 #!/bin/bash
-# Poll the tunneled chip; on recovery run the two measurement harnesses.
-cd /root/repo
-for i in $(seq 1 120); do
-  if timeout 90 python -c "
+# Poll the tunneled chip; on recovery run the measurement harnesses AND
+# refresh + git-commit the bench TPU cache (VERDICT r04 #2), so a healthy
+# window at ANY time of day permanently secures the round's TPU numbers even
+# if the driver's own bench window samples another wedge.
+#
+# Parametrized via env so tests can drive the recovery path with stubs:
+#   CHIP_WATCH_REPO      repo root (default /root/repo)
+#   CHIP_WATCH_PY        python executable (default python)
+#   CHIP_WATCH_OUT       sweep-output dir, relative to repo (default docs/sweeps)
+#   CHIP_WATCH_ATTEMPTS  poll attempts (default 170 ~= 12h at 240s+probe)
+#   CHIP_WATCH_SLEEP     seconds between attempts (default 240)
+#   CHIP_WATCH_COMMIT    1 = git-commit artifacts on capture (default 1)
+# Flags:
+#   --dry-run   skip the probe loop (treat the chip as already recovered)
+set -u
+REPO=${CHIP_WATCH_REPO:-/root/repo}
+PY=${CHIP_WATCH_PY:-python}
+OUT=${CHIP_WATCH_OUT:-docs/sweeps}
+ATTEMPTS=${CHIP_WATCH_ATTEMPTS:-170}
+SLEEP=${CHIP_WATCH_SLEEP:-240}
+COMMIT=${CHIP_WATCH_COMMIT:-1}
+cd "$REPO" || exit 2
+mkdir -p "$OUT"
+STAMP=$(date -u +%Y%m%dT%H%M%SZ)
+
+probe() {
+  timeout 90 "$PY" -c "
 import jax, jax.numpy as jnp
 x = jnp.ones((128,128), jnp.bfloat16)
 float(jax.jit(lambda a:(a@a).sum())(x))
 assert jax.default_backend() == 'tpu'
-" >/dev/null 2>&1; then
+" >/dev/null 2>&1
+}
+
+capture() {
+  echo "--- exp_mfu ---"
+  timeout 1800 "$PY" tools/exp_mfu.py 2>/tmp/exp_mfu.err \
+    | tee "$OUT/exp_mfu_$STAMP.jsonl"
+  echo "exp_mfu rc=$?"
+  echo "--- exp_int8 ---"
+  timeout 1800 "$PY" tools/exp_int8.py 2>/tmp/exp_int8.err \
+    | tee "$OUT/exp_int8_$STAMP.jsonl"
+  echo "exp_int8 rc=$?"
+  # bench.py writes bench_tpu_cache.json itself on a live TPU measurement;
+  # running it here is what makes the capture survive a wedged driver window.
+  echo "--- bench ---"
+  timeout 2400 "$PY" bench.py 2>/tmp/bench_watch.err \
+    | tee "$OUT/bench_$STAMP.json"
+  echo "bench rc=$?"
+  if [ "$COMMIT" = "1" ]; then
+    git add -f bench_tpu_cache.json "$OUT" 2>/dev/null
+    git commit -m "chip-watch: TPU measurement capture $STAMP" \
+      -- bench_tpu_cache.json "$OUT" \
+      && echo "committed capture $STAMP" \
+      || echo "nothing to commit"
+  fi
+}
+
+if [ "${1:-}" = "--dry-run" ]; then
+  capture
+  exit 0
+fi
+
+for i in $(seq 1 "$ATTEMPTS"); do
+  if probe; then
     echo "RECOVERED at $(date +%H:%M:%S) (attempt $i)"
-    echo "--- exp_mfu ---"
-    timeout 1500 python tools/exp_mfu.py 2>/tmp/exp_mfu.err
-    echo "exp_mfu rc=$?"
-    echo "--- exp_int8 ---"
-    timeout 1500 python tools/exp_int8.py 2>/tmp/exp_int8.err
-    echo "exp_int8 rc=$?"
+    capture
     exit 0
   fi
   echo "wedged at $(date +%H:%M:%S) (attempt $i)"
-  sleep 240
+  sleep "$SLEEP"
 done
 echo "never recovered"
