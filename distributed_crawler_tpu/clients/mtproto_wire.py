@@ -35,6 +35,7 @@ handshake in tests/test_mtproto.py is the parity proof.
 from __future__ import annotations
 
 import hashlib
+import hmac
 import os
 import secrets
 import socket
@@ -339,9 +340,12 @@ class Session:
         return mid
 
     def encrypt(self, payload: bytes) -> bytes:
+        # seq_no = 2*count_of_content_messages_before + 1 (spec): the FIRST
+        # content-related message carries 1, so read seq before bumping it.
+        seq_no = self.seq * 2 + 1
         self.seq += 1
         inner = (self.server_salt + self.session_id +
-                 i64(self._next_msg_id()) + u32(self.seq * 2 + 1) +
+                 i64(self._next_msg_id()) + u32(seq_no) +
                  u32(len(payload)) + payload)
         # Padding: ≥12 random bytes, total length % 16 == 0 (spec).
         inner += secrets.token_bytes(12 + (-(len(inner) + 12)) % 16)
@@ -361,7 +365,10 @@ class Session:
         inner = ige_decrypt(key, iv, packet[24:])
         # msg_key check BEFORE trusting any field (2.0 requires the check
         # over the padded plaintext; a mismatch is a forged/corrupt frame).
-        if compute_msg_key(self.auth_key, inner, to_server) != msg_key:
+        # compare_digest: a forged frame's rejection time must not leak how
+        # many MAC bytes matched.
+        if not hmac.compare_digest(
+                compute_msg_key(self.auth_key, inner, to_server), msg_key):
             raise ValueError("msg_key mismatch")
         r = TlReader(inner)
         r.raw(8)  # salt
@@ -600,9 +607,15 @@ def client_handshake(transport: Transport, pub: RsaKey) -> Session:
     dh_prime = int.from_bytes(ar.tl_bytes(), "big")
     g_a = int.from_bytes(ar.tl_bytes(), "big")
     ar.raw(4)  # server_time
-    if sha1(answer[:ar.off]) != digest:
+    if not hmac.compare_digest(sha1(answer[:ar.off]), digest):
         raise ValueError("server_DH SHA1 mismatch")
-    if dh_prime.bit_length() != 2048 or not 1 < g_a < dh_prime - 1:
+    # The spec mandates verifying dh_prime is a known safe prime (primality
+    # checks are too slow to run per-handshake, so production clients pin a
+    # cached set).  We pin the one group the gateway serves — RFC 3526
+    # MODP-2048 — which also subsumes the 2048-bit length check.
+    if dh_prime != DH_PRIME:
+        raise ValueError("dh_prime is not the pinned RFC 3526 group")
+    if not 1 < g_a < dh_prime - 1:
         raise ValueError("bad DH group")
     b = secrets.randbits(2048) % dh_prime
     g_b = pow(g, b, dh_prime)

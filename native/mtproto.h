@@ -402,6 +402,17 @@ inline int be_cmp(const Bytes& a_raw, const Bytes& b_raw) {
   return 0;
 }
 
+// Constant-time equality for MACs/digests: a forged frame's rejection time
+// must not leak how many bytes matched (parity: hmac.compare_digest in the
+// Python twin).
+inline bool ct_eq(const Bytes& a, const Bytes& b) {
+  if (a.size() != b.size()) return false;
+  volatile unsigned char acc = 0;
+  for (size_t i = 0; i < a.size(); ++i)
+    acc |= static_cast<unsigned char>(a[i]) ^ static_cast<unsigned char>(b[i]);
+  return acc == 0;
+}
+
 // Big-endian minus one (input > 0).
 inline Bytes be_minus_one(const Bytes& in) {
   Bytes out = in;
@@ -487,6 +498,23 @@ inline Bytes hex_to_bytes(const std::string& hex) {
   for (size_t i = 0; i < h.size(); i += 2)
     out.push_back(static_cast<char>((nib(h[i]) << 4) | nib(h[i + 1])));
   return out;
+}
+
+// RFC 3526 MODP-2048 safe prime — the one DH group the gateway serves.
+// The spec mandates verifying dh_prime is a known safe prime; per-handshake
+// primality checks are too slow, so (like production clients) we pin the
+// cached known group.  Parity: DH_PRIME in clients/mtproto_wire.py.
+inline const Bytes& dh_prime_pinned() {
+  static const Bytes prime = hex_to_bytes(
+      "FFFFFFFFFFFFFFFFC90FDAA22168C234C4C6628B80DC1CD129024E088A67CC74"
+      "020BBEA63B139B22514A08798E3404DDEF9519B3CD3A431B302B0A6DF25F1437"
+      "4FE1356D6D51C245E485B576625E7EC6F44C42E9A637ED6B0BFF5CB6F406B7ED"
+      "EE386BFB5A899FA5AE9F24117C4B1FE649286651ECE45B3DC2007CB8A163BF05"
+      "98DA48361C55D39A69163FA8FD24CF5F83655D23DCA3AD961C62F356208552BB"
+      "9ED529077096966D670C354E4ABC9804F1746C08CA18217C32905E462E36CE3B"
+      "E39E772C180E86039B2783A2EC07A28FB5C55DF06F4C52C9DE2BCBF695581718"
+      "3995497CEA956AE515D2261898FA051015728E5A8AACAA68FFFFFFFFFFFFFFFF");
+  return prime;
 }
 
 // ---------------------------------------------------------------------------
@@ -596,7 +624,12 @@ class MtprotoConnection {
   void send_frame(const std::string& payload) {
     Bytes body;
     tl_bytes(&body, payload);  // one TL bytes value wraps the JSON API
-    transport_.send(encrypt(body));
+    // One lock across msg_id assignment + encryption + the wire write:
+    // Client::send is called from arbitrary caller threads, and with
+    // separate locks a later msg_id could reach the wire first, tripping
+    // the peer's strictly-increasing replay check and killing the session.
+    std::lock_guard<std::mutex> lock(enc_mu_);
+    transport_.send(encrypt_locked(body));
   }
 
   // Blocking read of one frame; empty string on orderly close.
@@ -679,15 +712,14 @@ class MtprotoConnection {
     Bytes dh_prime = ar.bytes();
     Bytes g_a = ar.bytes();
     ar.u32();  // server_time
-    if (sha1(answer.substr(0, ar.offset())) != digest)
+    if (!ct_eq(sha1(answer.substr(0, ar.offset())), digest))
       throw MtprotoError("server_DH SHA1 mismatch");
-    // DH group sanity (spec-mandated, parity with the Python twin): the
-    // prime must be a full 2048-bit value and 1 < g_a < dh_prime - 1 —
-    // a degenerate g_a would yield a constant auth_key any passive
-    // observer can derive.
-    if (dh_prime.size() != 256 ||
-        (static_cast<unsigned char>(dh_prime[0]) & 0x80) == 0)
-      throw MtprotoError("bad DH prime (not 2048-bit)");
+    // DH group checks (spec-mandated, parity with the Python twin): the
+    // prime must be the pinned known safe prime (subsumes the 2048-bit
+    // length check) and 1 < g_a < dh_prime - 1 — a degenerate g_a would
+    // yield a constant auth_key any passive observer can derive.
+    if (dh_prime != dh_prime_pinned())
+      throw MtprotoError("dh_prime is not the pinned RFC 3526 group");
     Bytes one(1, '\x01');
     if (be_cmp(g_a, one) <= 0 ||
         be_cmp(g_a, be_minus_one(dh_prime)) >= 0)
@@ -695,8 +727,9 @@ class MtprotoConnection {
 
     // 4. client DH: b random, g_b, auth_key = g_a^b mod p
     Bytes b = random_bytes(256);
-    Bytes g_bytes(1, static_cast<char>(g));
-    Bytes g_b = bn_mod_exp(g_bytes, b, dh_prime);
+    // g as canonical big-endian bytes: one truncated byte would silently
+    // compute g_b from the wrong base for any g >= 256.
+    Bytes g_b = bn_mod_exp(be_bytes_u64(g), b, dh_prime);
     auth_key_ = bn_mod_exp(g_a, b, dh_prime, 256);
     Bytes cinner;
     tl_u32(&cinner, kClientDHInnerData);
@@ -730,12 +763,15 @@ class MtprotoConnection {
     session_id_ = random_bytes(8);
   }
 
-  Bytes encrypt(const Bytes& payload) {
-    std::lock_guard<std::mutex> lock(enc_mu_);
+  // Caller must hold enc_mu_ (send_frame keeps it through the wire write).
+  Bytes encrypt_locked(const Bytes& payload) {
+    // seq_no = 2*count_of_content_messages_before + 1 (spec): the FIRST
+    // content-related message carries 1, so read seq_ before bumping it.
+    uint32_t seq_no = seq_ * 2 + 1;
     seq_ += 1;
     Bytes inner = server_salt_ + session_id_;
     tl_i64(&inner, client_msg_id(&last_msg_id_));
-    tl_u32(&inner, seq_ * 2 + 1);
+    tl_u32(&inner, seq_no);
     tl_u32(&inner, static_cast<uint32_t>(payload.size()));
     inner += payload;
     // Padding: ≥12 random bytes, total length % 16 == 0 (spec).
@@ -754,8 +790,9 @@ class MtprotoConnection {
     Bytes key, iv;
     kdf2(auth_key_, mk, /*to_server=*/false, &key, &iv);
     Bytes inner = ige(key, iv, packet.substr(24), /*encrypt=*/false);
-    // msg_key check before trusting any field (MTProto 2.0 mandate).
-    if (msg_key_for(auth_key_, inner, /*to_server=*/false) != mk)
+    // msg_key check before trusting any field (MTProto 2.0 mandate);
+    // constant-time so rejection latency can't leak matched-byte count.
+    if (!ct_eq(msg_key_for(auth_key_, inner, /*to_server=*/false), mk))
       throw MtprotoError("msg_key mismatch");
     TlReader r(inner);
     r.raw(8);  // salt

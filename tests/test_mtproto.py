@@ -312,6 +312,56 @@ class TestCppClientAgainstPythonGateway:
         finally:
             gw.close()
 
+    def test_concurrent_senders_over_mtproto(self, tmp_path):
+        """ADVICE r04 (medium): msg_id assignment and the wire write must be
+        ordered under ONE lock — with separate locks a later msg_id can reach
+        the wire first, tripping the gateway's strictly-increasing replay
+        check (`mtproto_wire.py` Session.decrypt) and killing the whole
+        connection.  Six caller threads hammering one mtproto connection
+        reproduce the race reliably when the ordering is broken."""
+        import threading
+
+        from distributed_crawler_tpu.clients.dc_gateway import DcGateway
+        from distributed_crawler_tpu.clients.native import (
+            NativeTelegramClient,
+        )
+
+        gw = DcGateway(seed_json=SEED, expected_code="13579",
+                       wire="mtproto", store_root=str(tmp_path)).start()
+        try:
+            c = NativeTelegramClient(server_addr=gw.address, wire="mtproto",
+                                     server_pubkey_file=gw.pubkey_file,
+                                     conn_id="mt-stress")
+            try:
+                c.authenticate("+15550001111", "13579")
+                c.wait_ready(5.0)
+                n_threads, n_iters = 6, 25
+                errors = []
+
+                def hammer():
+                    try:
+                        for _ in range(n_iters):
+                            assert c.search_public_chat("mtroot").id == 4242
+                    except Exception as exc:  # noqa: BLE001 — collected
+                        errors.append(exc)
+
+                threads = [threading.Thread(target=hammer)
+                           for _ in range(n_threads)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=60)
+                assert not errors, errors[:3]
+                st = gw.status()
+                # Every request was served over the ONE surviving session —
+                # no replay-check connection kill, no reconnect.
+                assert st["requests_served"] >= n_threads * n_iters
+                assert st["auth_successes"] == 1
+            finally:
+                c.close()
+        finally:
+            gw.close()
+
     def test_persistent_rsa_key_across_restart(self, tmp_path):
         from distributed_crawler_tpu.clients.dc_gateway import DcGateway
         from distributed_crawler_tpu.clients.mtproto_wire import load_pubkey
