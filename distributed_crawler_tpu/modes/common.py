@@ -94,3 +94,38 @@ def calculate_date_filters(cfg: CrawlerConfig
     if cfg.post_recency is not None:
         return cfg.post_recency, utcnow()
     return cfg.min_post_date, utcnow()
+
+
+def persist_discoveries(sm: StateManager, discovered, next_depth: int,
+                        save: bool = True) -> int:
+    """Add pages discovered while processing a layer as the next layer,
+    deduped by URL within the batch (`standalone/runner.go:834-847`,
+    `dapr/standalone.go:645-688`).  Shared by the sequential and parallel
+    layer drivers; returns the number of pages handed to the state layer
+    (state-level URL dedup may drop more).  ``save=False`` skips the
+    save_state for callers that persist right after anyway (the sequential
+    driver's per-page save)."""
+    from ..state.datamodels import PAGE_UNFETCHED, Page, new_id
+
+    if not discovered:
+        return 0
+    seen: set = set()
+    new_pages = []
+    for ch in discovered:
+        if ch.url in seen:
+            continue
+        seen.add(ch.url)
+        new_pages.append(Page(
+            id=new_id(), url=ch.url, depth=next_depth,
+            status=PAGE_UNFETCHED, timestamp=utcnow(),
+            parent_id=ch.parent_id))
+    try:
+        sm.add_layer(new_pages)
+        if save:
+            sm.save_state()
+        logger.info("added new channels to be processed",
+                    extra={"count": len(new_pages)})
+    except Exception as e:
+        logger.error("failed to add discovered channels as new layer: %s", e)
+        return 0
+    return len(new_pages)

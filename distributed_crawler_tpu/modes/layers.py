@@ -25,13 +25,11 @@ from ..crawlers.base import Crawler, CrawlJob, CrawlTarget
 from ..state.datamodels import (
     PAGE_ERROR,
     PAGE_FETCHED,
-    PAGE_UNFETCHED,
     Layer,
     Page,
-    new_id,
     utcnow,
 )
-from .common import calculate_date_filters
+from .common import calculate_date_filters, persist_discoveries
 
 logger = logging.getLogger("dct.modes.layers")
 
@@ -186,25 +184,7 @@ def process_layer_in_parallel(layer: Layer, max_workers: int, sm,
         wait(futures)
 
     # Build the next layer from discoveries, deduped (`:645-688`).
-    if discovered_all:
-        seen: set = set()
-        new_pages = []
-        for ch in discovered_all:
-            if ch.url in seen:
-                continue
-            seen.add(ch.url)
-            new_pages.append(Page(
-                id=new_id(), url=ch.url, depth=layer.depth + 1,
-                status=PAGE_UNFETCHED, timestamp=utcnow(),
-                parent_id=ch.parent_id))
-        try:
-            sm.add_layer(new_pages)
-            sm.save_state()
-            logger.info("added new channels to be processed",
-                        extra={"count": len(new_pages)})
-        except Exception as e:
-            logger.error("failed to add discovered channels as new layer: %s",
-                         e)
+    persist_discoveries(sm, discovered_all, layer.depth + 1)
     return processed
 
 

@@ -19,7 +19,11 @@ from ..state.datamodels import (
     PAGE_FETCHED,
     utcnow,
 )
-from .common import create_state_manager, determine_crawl_id
+from .common import (
+    create_state_manager,
+    determine_crawl_id,
+    persist_discoveries,
+)
 from .layers import YtWorkerPool, fetch_youtube_page
 
 logger = logging.getLogger("dct.modes.standalone")
@@ -53,6 +57,7 @@ def run_sequential_layers(sm, cfg: CrawlerConfig,
                 return total
             total += 1
             # Self-contained per-page processing (`runner.go:697-711`).
+            discovered = []
             try:
                 page.timestamp = utcnow()
                 if cfg.platform == "youtube":
@@ -61,11 +66,12 @@ def run_sequential_layers(sm, cfg: CrawlerConfig,
                             "youtube processing needs a YtWorkerPool")
                     worker = yt_pool.acquire()
                     try:
-                        fetch_youtube_page(worker.crawler, cfg, page)
+                        discovered = fetch_youtube_page(
+                            worker.crawler, cfg, page)
                     finally:
                         yt_pool.release(worker)
                 else:
-                    crawl_runner.run_for_channel_with_pool(
+                    discovered = crawl_runner.run_for_channel_with_pool(
                         page, cfg.storage_root, sm, cfg)
             except Exception as e:
                 logger.error("recovered from failure while processing item",
@@ -74,6 +80,11 @@ def run_sequential_layers(sm, cfg: CrawlerConfig,
                 page.error = str(e)
             else:
                 page.status = PAGE_FETCHED
+            # Persist discoveries as the next layer, per page like the
+            # reference (`standalone/runner.go:834-847`) — state-level URL
+            # dedup makes re-discoveries no-ops in BFS modes.  save=False:
+            # the per-page save_state below covers the new layer too.
+            persist_discoveries(sm, discovered, page.depth + 1, save=False)
             # Persist after EVERY page (`runner.go:716-720,855`).
             try:
                 sm.update_page(page)

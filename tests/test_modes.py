@@ -177,6 +177,29 @@ class TestLayerDrivers:
         assert calls == ["a", "ax", "axx"]
         assert total == 3
 
+    def test_sequential_walk_follows_discoveries(self, tmp_path, stub_pool):
+        """Standalone BFS must persist discovered pages as the next layer
+        (`standalone/runner.go:834-847`) — regression: discoveries were
+        returned but dropped, so every standalone crawl stopped at the
+        seed layer."""
+        sm = make_sm(tmp_path)
+        self._seed(sm, ["a"])
+        calls = []
+
+        def fake_run(client, page, prefix, sm_, cfg, processor=None,
+                     rng=None):
+            calls.append(page.url)
+            if page.depth < 2:
+                return [Page(id=new_id(), url=page.url + "x",
+                             depth=page.depth + 1, parent_id=page.id)]
+            return []
+
+        set_run_for_channel_fn(fake_run)
+        total = run_sequential_layers(sm, make_cfg(), True)
+        assert calls == ["a", "ax", "axx"]
+        assert total == 3
+        assert [p.url for p in sm.get_layer_by_depth(2)] == ["axx"]
+
     def test_sequential_walk_skips_fetched_on_resume(self, tmp_path,
                                                      stub_pool):
         sm = make_sm(tmp_path)
