@@ -78,6 +78,27 @@ def test_dry_run_writes_and_commits_cache(watch_repo):
     assert "bench_tpu_cache.json" in log
 
 
+def test_sweeps_commit_even_if_bench_leg_wedges(watch_repo, tmp_path):
+    """A bench leg that re-wedges (no cache written) must not cost the
+    completed sweeps their commit — the pathspec list is built dynamically."""
+    repo, stub = watch_repo
+    wedged = tmp_path / "wedgedpython"
+    wedged.write_text(STUB.replace(
+        "echo '{\"platform\": \"tpu\", \"posts_per_sec\": 10793.0}' "
+        "> bench_tpu_cache.json\n", "exit 124\n"))
+    wedged.chmod(wedged.stat().st_mode | stat.S_IEXEC)
+    proc = _run_dry(repo, wedged)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert not (repo / "bench_tpu_cache.json").exists()
+    log = subprocess.run(["git", "log", "--oneline", "--name-only"],
+                         cwd=repo, capture_output=True, text=True).stdout
+    assert "chip-watch: TPU measurement capture" in log
+    assert "exp_mfu" in log and "exp_int8" in log
+    # The wedged leg's zero-byte tee artifact is pruned, not committed.
+    assert "bench_2" not in log
+    assert not list((repo / "docs" / "sweeps").glob("bench_*"))
+
+
 def test_dry_run_commit_disabled(watch_repo):
     repo, stub = watch_repo
     proc = _run_dry(repo, stub, commit="0")

@@ -22,7 +22,6 @@ SLEEP=${CHIP_WATCH_SLEEP:-240}
 COMMIT=${CHIP_WATCH_COMMIT:-1}
 cd "$REPO" || exit 2
 mkdir -p "$OUT"
-STAMP=$(date -u +%Y%m%dT%H%M%SZ)
 
 probe() {
   timeout 90 "$PY" -c "
@@ -34,24 +33,36 @@ assert jax.default_backend() == 'tpu'
 }
 
 capture() {
+  # Stamped at capture time, not script start: the artifact names record
+  # WHEN the measurement window actually occurred.
+  STAMP=$(date -u +%Y%m%dT%H%M%SZ)
   echo "--- exp_mfu ---"
   timeout 1800 "$PY" tools/exp_mfu.py 2>/tmp/exp_mfu.err \
     | tee "$OUT/exp_mfu_$STAMP.jsonl"
-  echo "exp_mfu rc=$?"
+  echo "exp_mfu rc=${PIPESTATUS[0]}"
   echo "--- exp_int8 ---"
   timeout 1800 "$PY" tools/exp_int8.py 2>/tmp/exp_int8.err \
     | tee "$OUT/exp_int8_$STAMP.jsonl"
-  echo "exp_int8 rc=$?"
+  echo "exp_int8 rc=${PIPESTATUS[0]}"
   # bench.py writes bench_tpu_cache.json itself on a live TPU measurement;
   # running it here is what makes the capture survive a wedged driver window.
   echo "--- bench ---"
   timeout 2400 "$PY" bench.py 2>/tmp/bench_watch.err \
     | tee "$OUT/bench_$STAMP.json"
-  echo "bench rc=$?"
+  echo "bench rc=${PIPESTATUS[0]}"
+  # A leg that wedged produced a zero-byte artifact via tee — drop those so
+  # the permanent record never contains empty JSON a consumer would choke on.
+  find "$OUT" -maxdepth 1 -name "*_$STAMP*" -size 0 -delete
   if [ "$COMMIT" = "1" ]; then
-    git add -f bench_tpu_cache.json "$OUT" 2>/dev/null
+    # Build the pathspec list dynamically: a bench leg that re-wedged must
+    # not cost the sweeps their commit (a missing pathspec aborts git add),
+    # and the commit stays scoped to OUR paths so a concurrently-staged
+    # working tree is never swept into the capture commit.
+    paths=("$OUT")
+    [ -f bench_tpu_cache.json ] && paths+=(bench_tpu_cache.json)
+    git add -f "${paths[@]}"
     git commit -m "chip-watch: TPU measurement capture $STAMP" \
-      -- bench_tpu_cache.json "$OUT" \
+      -- "${paths[@]}" \
       && echo "committed capture $STAMP" \
       || echo "nothing to commit"
   fi
