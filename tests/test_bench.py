@@ -52,6 +52,24 @@ def test_measure_asr_smoke_cpu():
     assert res["asr_decode_len"] == 4
 
 
+def test_measure_moe_smoke_cpu():
+    # Tiny switch-MoE config: the dense-vs-capacity dispatch cells must
+    # both fit and emit the full result schema — catches EncoderConfig
+    # field drift in the MoE leg before the driver's BENCH run.
+    from dataclasses import replace
+
+    from distributed_crawler_tpu.models.encoder import TINY_TEST
+
+    res = bench._measure_moe(batch=8, seq=16, n_experts=4,
+                             n_short=1, n_long=4, repeats=2,
+                             base_cfg=replace(TINY_TEST, vocab_size=512))
+    assert res["moe_dense_posts_per_sec"] > 0
+    assert res["moe_capacity_posts_per_sec"] > 0
+    assert res["moe_capacity_speedup"] > 0
+    assert res["moe_experts"] == 4
+    assert res["moe_batch"] == 8
+
+
 def test_probe_subprocess_emits_json():
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("AXON", "PALLAS_AXON", "TPU_"))}
