@@ -183,7 +183,8 @@ class DcGateway:
                  tls_cert: str = "", tls_key: str = "",
                  auth_timeout_s: float = DEFAULT_AUTH_TIMEOUT_S,
                  address_file: str = "", wire: str = "dct",
-                 max_connections: int = DEFAULT_MAX_CONNECTIONS):
+                 max_connections: int = DEFAULT_MAX_CONNECTIONS,
+                 flood: Optional[Dict[str, Dict[str, Any]]] = None):
         self.seed_json = seed_json or '{"channels": []}'
         self.expected_code = expected_code
         self.expected_password = expected_password
@@ -281,6 +282,13 @@ class DcGateway:
         self.requests_served = 0
         self.active_sessions = 0
         self._conn_seq = 0
+        # Per-account FLOOD_WAIT emulation (Telegram's rate discipline,
+        # `crawl/runner.go:55-97`): phone -> {wait_s, after_requests,
+        # methods}.  Counted per ACCOUNT across connections, like Telegram.
+        self._flood_mu = threading.Lock()
+        self._flood: Dict[str, Dict[str, Any]] = {
+            p: dict(rule) for p, rule in (flood or {}).items()}
+        self.flood_rejections = 0
         if address_file:
             tmp = address_file + ".tmp"
             with open(tmp, "w", encoding="utf-8") as f:
@@ -344,6 +352,7 @@ class DcGateway:
                 "auth_successes": self.auth_successes,
                 "auth_failures": self.auth_failures,
                 "requests_served": self.requests_served,
+                "flood_rejections": self.flood_rejections,
             }
 
     # -- internals ---------------------------------------------------------
@@ -492,6 +501,11 @@ class DcGateway:
                 if rtype == "close":
                     self._reply(conn, req, {"@type": "ok"})
                     return
+                flooded = self._flood_check(
+                    (account or {}).get("_phone", ""), rtype)
+                if flooded is not None:
+                    self._reply(conn, req, flooded)
+                    continue
                 resp = json.loads(engine.execute_raw(json.dumps(req)))
                 with self._stats_mu:
                     self.requests_served += 1
@@ -512,6 +526,47 @@ class DcGateway:
                 conn.close()
             except OSError:
                 pass
+
+    def inject_flood(self, phone: str, wait_s: int,
+                     after_requests: int = 0,
+                     methods: Optional[list] = None) -> None:
+        """Arm (or re-arm) Telegram-style rate discipline for one account:
+        after ``after_requests`` more MATCHING requests, matching requests
+        get ``429 Too Many Requests: retry after wait_s`` instead of the
+        engine.  ``methods`` limits the rule to specific @type values
+        (Telegram rate-limits per method; SearchPublicChat is the
+        flood-prone one the reference retires on,
+        `crawl/runner.go:1333-1337`); None floods every request."""
+        with self._flood_mu:
+            self._flood[phone] = {
+                "wait_s": int(wait_s),
+                "after_requests": max(0, int(after_requests)),
+                "methods": list(methods) if methods else None,
+                "_count": 0,
+            }
+
+    def _flood_check(self, phone: str,
+                     rtype: str) -> Optional[Dict[str, Any]]:
+        """Count the request against the account's rule; return the
+        FLOOD_WAIT error body when this request is over quota.  The wording
+        matches what `clients/errors.py` / the native client parse into
+        FloodWaitError."""
+        if not phone:
+            return None
+        with self._flood_mu:
+            rule = self._flood.get(phone)
+            if rule is None:
+                return None
+            methods = rule.get("methods")
+            if methods and rtype not in methods:
+                return None
+            rule["_count"] = rule.get("_count", 0) + 1
+            if rule["_count"] <= int(rule.get("after_requests", 0)):
+                return None
+            with self._stats_mu:
+                self.flood_rejections += 1
+            return self._err_obj(
+                429, f"Too Many Requests: retry after {rule['wait_s']}")
 
     def _credentials_for(self, phone: str) -> Optional[Dict[str, str]]:
         """Resolve the account a phone number authenticates against; None
@@ -536,6 +591,10 @@ class DcGateway:
                 self._reply(conn, req,
                             self._err_obj(400, "PHONE_NUMBER_INVALID"))
                 return state, None
+            # Carry the phone with the session (copy — never mutate the
+            # accounts table): the flood emulation is per-account.
+            account = dict(account)
+            account["_phone"] = phone
             self._reply(conn, req, {"@type": "ok"})
             self._push_auth(conn, "authorizationStateWaitCode")
             return "waitCode", account

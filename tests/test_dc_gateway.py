@@ -641,3 +641,100 @@ class TestConnectionCap:
             for s in held:
                 s.close()
             gw.close()
+
+
+class TestFloodWaitOverWire:
+    """VERDICT r04 #8: Telegram's rate discipline emulated AT THE GATEWAY —
+    a pooled connection dialing the real wire gets a >=300 s FLOOD_WAIT on
+    SearchPublicChat and is retired (`crawl/runner.go:1333-1337` +
+    `connection_pool.go:421-439`), while the crawl continues on the
+    remaining connections.  Until now flood injection existed only
+    in-process (`clients/sim.py`); this drives it through the socket."""
+
+    RW_SEED = json.dumps({
+        "channels": [
+            {"username": "rwroot", "id": 9100, "title": "RW Root",
+             "member_count": 5000,
+             "messages": [
+                 {"date": 1700000100, "view_count": 7,
+                  "content": {"@type": "messageText",
+                              "text": {"text": "go see @rwnext",
+                                       "entities": [
+                                           {"type": {"@type":
+                                                     "textEntityTypeMention"},
+                                            "offset": 7, "length": 7}]}}},
+             ]},
+            {"username": "rwnext", "id": 9101, "title": "RW Next",
+             "member_count": 4000,
+             "messages": [
+                 {"date": 1700000200, "view_count": 1,
+                  "content": {"@type": "messageText",
+                              "text": {"text": "next", "entities": []}}},
+             ]},
+        ],
+    })
+
+    ACCOUNTS = {"+15551110001": {"code": "111", "password": ""},
+                "+15551110002": {"code": "222", "password": ""}}
+
+    def test_pooled_connection_retired_crawl_continues(self, tmp_path):
+        from distributed_crawler_tpu.clients.pool import ConnectionPool
+        from distributed_crawler_tpu.config import CrawlerConfig
+        from distributed_crawler_tpu.crawl import runner as crawl_runner
+        from distributed_crawler_tpu.crawl.errors import (
+            FloodWaitRetireError,
+        )
+        from distributed_crawler_tpu.state import (
+            CompositeStateManager,
+            SqlConfig,
+            StateConfig,
+        )
+        from distributed_crawler_tpu.state.datamodels import Page, new_id
+
+        gw = DcGateway(
+            seed_json=self.RW_SEED, accounts=self.ACCOUNTS,
+            store_root=str(tmp_path / "gw"),
+            # Account 1's SECOND SearchPublicChat is over quota (the first
+            # resolves the page's own channel): 400 s > the 300 s retire
+            # threshold, so the outlink-validation search trips the retire.
+            flood={"+15551110001": {"wait_s": 400, "after_requests": 1,
+                                    "methods": ["searchPublicChat"]}},
+        ).start()
+        clients = {}
+        try:
+            for i, (phone, acc) in enumerate(sorted(self.ACCOUNTS.items())):
+                c = NativeTelegramClient(server_addr=gw.address,
+                                         conn_id=f"fw{i}")
+                c.authenticate(phone, acc["code"])
+                c.wait_ready(5.0)
+                clients[f"fw{i}"] = c
+            pool = ConnectionPool.for_testing(clients)
+            crawl_runner.init_connection_pool(pool)
+            sm = CompositeStateManager(StateConfig(
+                crawl_id="fwwire", crawl_execution_id="x1",
+                storage_root=str(tmp_path / "out"),
+                sampling_method="random-walk",
+                sql=SqlConfig(url=":memory:")))
+            sm.initialize(["rwroot"])
+            cfg = CrawlerConfig(crawl_id="fwwire", skip_media_download=True,
+                                sampling_method="random-walk")
+            page = sm.get_layer_by_depth(0)[0]
+            # fw0 (the flooded account) is handed out first and hits the
+            # 400 s FLOOD_WAIT on the wire during outlink validation.
+            with pytest.raises(FloodWaitRetireError):
+                crawl_runner.run_for_channel_with_pool(
+                    page, str(tmp_path / "out"), sm, cfg)
+            stats = pool.stats()
+            assert stats["retired"] == 1 and stats["live"] == 1
+            assert gw.status()["flood_rejections"] >= 1
+            # The crawl continues on the remaining connection: a retry of
+            # the same channel succeeds end to end (search included).
+            page2 = Page(id=new_id(), url="rwroot", depth=0,
+                         sequence_id=new_id())
+            crawl_runner.run_for_channel_with_pool(
+                page2, str(tmp_path / "out"), sm, cfg)
+            assert page2.status == "fetched"
+            assert sm.is_discovered_channel("rwnext")
+        finally:
+            crawl_runner.shutdown_connection_pool()
+            gw.close()
