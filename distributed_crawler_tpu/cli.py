@@ -79,6 +79,10 @@ def build_parser() -> argparse.ArgumentParser:
       help="gateway RSA public key JSON ({n, e}; written by a "
            "--gateway-wire mtproto gateway as <address-file>.pubkey) — "
            "required with --dc-wire mtproto")
+    a("--dc-table-file", default=None,
+      help="DC table JSON ({dc_id: {address, pubkey_file}}; Telegram's "
+           "dcOptions analog) — lets connections follow PHONE_MIGRATE_X "
+           "redirects to an account's home DC")
     a("--min-users", type=int, default=None)
     a("--crawl-id", default=None)
     a("--crawl-label", default=None)
@@ -245,6 +249,9 @@ def build_parser() -> argparse.ArgumentParser:
     a("--gateway-max-connections", type=int, default=None,
       help="cap on concurrent connection threads (default 256, 0 = "
            "unlimited); beyond it new connects are closed immediately")
+    a("--gateway-dc-id", type=int, default=None,
+      help="this gateway's DC id (default 1); accounts whose dc_id "
+           "differs get 303 PHONE_MIGRATE_<home> at the phone step")
     a("--gateway-wire", default=None, choices=["dct", "mtproto"],
       help="wire protocol: dct (DCT-v1 frames, default) or mtproto "
            "(MTProto 2.0: auth-key handshake + AES-IGE messages, "
@@ -346,7 +353,9 @@ _KEY_MAP = {
     "dc_sni": "tdlib.dc_sni",
     "dc_wire": "tdlib.dc_wire",
     "dc_pubkey_file": "tdlib.dc_pubkey_file",
+    "dc_table_file": "tdlib.dc_table_file",
     "gateway_listen": "gateway.listen",
+    "gateway_dc_id": "gateway.dc_id",
     "gateway_wire": "gateway.wire",
     "gateway_tls": "gateway.tls",
     "gateway_tls_cert": "gateway.tls_cert",
@@ -384,6 +393,7 @@ def resolve_config(args: argparse.Namespace,
     cfg.dc_sni = r.get_str("tdlib.dc_sni")
     cfg.dc_wire = r.get_str("tdlib.dc_wire")
     cfg.dc_pubkey_file = r.get_str("tdlib.dc_pubkey_file")
+    cfg.dc_table_file = r.get_str("tdlib.dc_table_file")
     cfg.min_users = r.get_int("crawler.minusers", 100)
     cfg.crawl_id = r.get_str("crawler.crawlid") or generate_crawl_id()
     cfg.crawl_label = r.get_str("crawler.crawllabel")
@@ -774,6 +784,7 @@ def _run_dc_gateway(cfg: CrawlerConfig, r: ConfigResolver) -> None:
         wire=r.get_str("gateway.wire", "dct") or "dct",
         max_connections=r.get_int("gateway.max_connections",
                                   DEFAULT_MAX_CONNECTIONS),
+        dc_id=r.get_int("gateway.dc_id", 1),
     ).start()
     set_status_provider(gw.status)
     try:

@@ -141,7 +141,7 @@ class _MtprotoConn:
         return self._sock.fileno()
 
 
-def load_accounts(path: str) -> Dict[str, Dict[str, str]]:
+def load_accounts(path: str) -> Dict[str, Dict[str, Any]]:
     """Accounts JSON → {phone_number: {"code": ..., "password": ...}}.
 
     Accepts ``{"accounts": [{"phone_number","code","password"}...]}`` or a
@@ -161,6 +161,10 @@ def load_accounts(path: str) -> Dict[str, Dict[str, str]]:
                              f"phone_number: {e}")
         out[phone] = {"code": str(e.get("code", "")),
                       "password": str(e.get("password", ""))}
+        if "dc_id" in e:
+            # Home DC: a gateway with a different dc_id answers this
+            # account's phone step with 303 PHONE_MIGRATE_<dc_id>.
+            out[phone]["dc_id"] = int(e["dc_id"])
     return out
 
 
@@ -178,13 +182,14 @@ class DcGateway:
                  expected_password: str = "", tls: bool = False,
                  host: str = "127.0.0.1", port: int = 0,
                  lib_path: Optional[str] = None,
-                 accounts: Optional[Dict[str, Dict[str, str]]] = None,
+                 accounts: Optional[Dict[str, Dict[str, Any]]] = None,
                  seed_source: str = "", store_root: str = "",
                  tls_cert: str = "", tls_key: str = "",
                  auth_timeout_s: float = DEFAULT_AUTH_TIMEOUT_S,
                  address_file: str = "", wire: str = "dct",
                  max_connections: int = DEFAULT_MAX_CONNECTIONS,
-                 flood: Optional[Dict[str, Dict[str, Any]]] = None):
+                 flood: Optional[Dict[str, Dict[str, Any]]] = None,
+                 dc_id: int = 1):
         self.seed_json = seed_json or '{"channels": []}'
         self.expected_code = expected_code
         self.expected_password = expected_password
@@ -289,6 +294,11 @@ class DcGateway:
         self._flood: Dict[str, Dict[str, Any]] = {
             p: dict(rule) for p, rule in (flood or {}).items()}
         self.flood_rejections = 0
+        # This gateway's DC id: accounts homed elsewhere (an account entry
+        # with a different "dc_id") get Telegram's 303 PHONE_MIGRATE_X
+        # redirect at the phone-number step instead of service here.
+        self.dc_id = int(dc_id)
+        self.migrations_issued = 0
         if address_file:
             tmp = address_file + ".tmp"
             with open(tmp, "w", encoding="utf-8") as f:
@@ -353,6 +363,8 @@ class DcGateway:
                 "auth_failures": self.auth_failures,
                 "requests_served": self.requests_served,
                 "flood_rejections": self.flood_rejections,
+                "dc_id": self.dc_id,
+                "migrations_issued": self.migrations_issued,
             }
 
     # -- internals ---------------------------------------------------------
@@ -590,6 +602,16 @@ class DcGateway:
                 self._count_auth_failure()
                 self._reply(conn, req,
                             self._err_obj(400, "PHONE_NUMBER_INVALID"))
+                return state, None
+            home_dc = int(account.get("dc_id", self.dc_id))
+            if home_dc != self.dc_id:
+                # Telegram's DC redirect: the account lives on another DC —
+                # 303 PHONE_MIGRATE_X; the client reconnects there and
+                # restarts the ladder (TDLib does this internally).
+                with self._stats_mu:
+                    self.migrations_issued += 1
+                self._reply(conn, req, self._err_obj(
+                    303, f"PHONE_MIGRATE_{home_dc}"))
                 return state, None
             # Carry the phone with the session (copy — never mutate the
             # accounts table): the flood emulation is per-account.

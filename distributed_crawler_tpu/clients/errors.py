@@ -56,6 +56,20 @@ def parse_flood_wait_seconds(err: Optional[BaseException]) -> Tuple[int, bool]:
     return 0, False
 
 
+_MIGRATE_RE = re.compile(r"(?:PHONE|NETWORK|USER)_MIGRATE_(\d+)")
+
+
+def parse_migrate_dc(err: Optional[BaseException]) -> Optional[int]:
+    """Telegram's 303 DC-redirect family (PHONE/NETWORK/USER_MIGRATE_X):
+    returns the target DC id, or None if this isn't a migrate error.
+    TDLib consumes these internally; this framework's client surfaces them
+    through the same taxonomy (`clients/native.py` follows the redirect)."""
+    if err is None:
+        return None
+    m = _MIGRATE_RE.search(str(err))
+    return int(m.group(1)) if m else None
+
+
 def is_telegram_400(err: Optional[BaseException]) -> bool:
     """Permanently-invalid channel detection (`crawl/runner.go:104-113`)."""
     if err is None:

@@ -565,7 +565,13 @@ def dh_tmp_key_iv(new_nonce: bytes, server_nonce: bytes) -> Tuple[bytes,
 
 
 # -- handshake: client side (tests / parity with native/mtproto.h) ----------
-def client_handshake(transport: Transport, pub: RsaKey) -> Session:
+def client_handshake(transport: Transport, pub) -> Session:
+    """``pub`` is one RsaKey or a keyring (sequence of RsaKey): real
+    Telegram clients ship several pinned DC keys and select whichever
+    fingerprint the server offers in resPQ — same rule here."""
+    pubs = [pub] if isinstance(pub, RsaKey) else list(pub)
+    if not pubs:
+        raise ValueError("empty RSA keyring")
     nonce = secrets.token_bytes(16)
     transport.send(plain_message(u32(REQ_PQ_MULTI) + nonce,
                                  _client_msg_id()))
@@ -579,7 +585,8 @@ def client_handshake(transport: Transport, pub: RsaKey) -> Session:
     if r.uint32() != VECTOR:
         raise ValueError("expected fingerprint vector")
     fps = [r.int64() for _ in range(r.uint32())]
-    if pub.fingerprint not in fps:
+    pub = next((k for k in pubs if k.fingerprint in fps), None)
+    if pub is None:
         raise ValueError("server offered no known RSA fingerprint")
     p, q = factor_pq(pq)
     new_nonce = secrets.token_bytes(32)
@@ -689,6 +696,27 @@ def save_pubkey(path: str, key: RsaKey) -> None:
         json.dump({"n": hex(key.n), "e": key.e,
                    "fingerprint": key.fingerprint}, f)
     os.replace(tmp, path)
+
+
+def load_keyring(path: str) -> list:
+    """Load one-or-many pinned server keys: accepts the single-key
+    `save_pubkey` format, a bare list, or ``{"keys": [...]}`` — the
+    client-side analog of the several long-lived DC public keys a real
+    Telegram client ships."""
+    import json
+
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data, dict) and "keys" in data:
+        entries = data["keys"]
+    elif isinstance(data, list):
+        entries = data
+    else:
+        entries = [data]
+    keys = [RsaKey(n=int(d["n"], 16), e=int(d["e"])) for d in entries]
+    if not keys:
+        raise ValueError(f"no keys in keyring {path}")
+    return keys
 
 
 def load_pubkey(path: str) -> RsaKey:

@@ -32,7 +32,7 @@ from typing import Any, Dict, Optional
 
 logger = logging.getLogger("dct.clients.native")
 
-from .errors import FloodWaitError, TelegramError
+from .errors import FloodWaitError, TelegramError, parse_migrate_dc
 from .telegram import (
     TLBasicGroupFullInfo,
     TLChat,
@@ -162,7 +162,8 @@ class NativeTelegramClient:
                  expected_password: str = "", server_addr: str = "",
                  tls: bool = False, tls_insecure: bool = False,
                  sni: str = "", wire: str = "",
-                 server_pubkey_file: str = ""):
+                 server_pubkey_file: str = "",
+                 dc_table: Optional[Dict[Any, Dict[str, str]]] = None):
         """Offline mode (default): the C++ engine serves from a seed store.
 
         Remote mode (``server_addr="host:port"``): every request rides the
@@ -175,27 +176,29 @@ class NativeTelegramClient:
         ``wire="mtproto"`` selects the MTProto 2.0 envelope
         (`native/mtproto.h`): auth-key DH handshake on connect, AES-IGE
         message encryption after — the reference's TDLib↔DC protocol.
-        Requires the server's RSA public key: ``server_pubkey_file``
-        points at the ``{n, e}`` JSON the gateway writes
-        (`mtproto_wire.save_pubkey`)."""
+        Requires the server's RSA public key(s): ``server_pubkey_file``
+        points at the JSON the gateway writes (`mtproto_wire.save_pubkey`)
+        or a keyring (`mtproto_wire.load_keyring`).
+
+        ``dc_table`` maps DC id -> ``{"address": "host:port",
+        "pubkey_file": "..."}`` — the analog of Telegram's config
+        dcOptions.  With it set, ``authenticate()`` follows
+        ``PHONE_MIGRATE_X`` redirects to the account's home DC the way
+        TDLib does internally."""
         self._lib = load_library(lib_path)
         self.conn_id = conn_id
         self.receive_timeout_s = receive_timeout_s
+        self.dc_table = {str(k): dict(v)
+                         for k, v in (dc_table or {}).items()}
+        self.current_dc: Optional[int] = None
+        self._remote_opts: Optional[Dict[str, Any]] = None
         config: Dict[str, Any] = {}
         if server_addr:
-            config["server_addr"] = server_addr
-            if tls:
-                config["tls"] = True
-            if tls_insecure:
-                config["tls_insecure"] = True
-            if sni:
-                config["sni"] = sni
-            if wire:
-                config["wire"] = wire
-            if server_pubkey_file:
-                with open(server_pubkey_file, "r", encoding="utf-8") as f:
-                    pk = json.load(f)
-                config["server_pubkey"] = {"n": pk["n"], "e": int(pk["e"])}
+            self._remote_opts = dict(
+                server_addr=server_addr, tls=tls,
+                tls_insecure=tls_insecure, sni=sni, wire=wire,
+                server_pubkey_file=server_pubkey_file)
+            config = self._build_remote_config(self._remote_opts)
         elif seed_json:
             config["seed_json"] = seed_json
         elif seed_db:
@@ -224,6 +227,28 @@ class NativeTelegramClient:
         if not require_auth and not server_addr:
             self.wait_ready()
 
+    @staticmethod
+    def _build_remote_config(opts: Dict[str, Any]) -> Dict[str, Any]:
+        config: Dict[str, Any] = {"server_addr": opts["server_addr"]}
+        if opts.get("tls"):
+            config["tls"] = True
+        if opts.get("tls_insecure"):
+            config["tls_insecure"] = True
+        if opts.get("sni"):
+            config["sni"] = opts["sni"]
+        if opts.get("wire"):
+            config["wire"] = opts["wire"]
+        if opts.get("server_pubkey_file"):
+            # Keyring semantics (real clients pin several DC keys and
+            # select by the resPQ fingerprint): the file may hold one
+            # key, a list, or {"keys": [...]}.
+            from .mtproto_wire import load_keyring
+
+            config["server_pubkeys"] = [
+                {"n": hex(k.n), "e": k.e}
+                for k in load_keyring(opts["server_pubkey_file"])]
+        return config
+
     # -- auth (the TDLib ladder, `telegramhelper/client.go:319-377`) -------
     def authenticate(self, phone_number: str, phone_code: str,
                      api_id: str = "", api_hash: str = "",
@@ -231,17 +256,66 @@ class NativeTelegramClient:
                      database_directory: str = ".tdlib/database") -> None:
         """Walk WaitTdlibParameters -> WaitPhoneNumber -> WaitCode
         [-> WaitPassword] -> Ready (the flow the reference's CLI interactor
-        drives; password is the 2FA leg of `standalone/runner.go:77-192`)."""
-        self._call({"@type": "setTdlibParameters",
-                    "api_id": api_id, "api_hash": api_hash,
-                    "database_directory": database_directory})
-        self._call({"@type": "setAuthenticationPhoneNumber",
-                    "phone_number": phone_number})
+        drives; password is the 2FA leg of `standalone/runner.go:77-192`).
+
+        DC migration: a ``PHONE_MIGRATE_X`` (Telegram's 303 redirect to the
+        account's home DC) reconnects to ``dc_table[X]`` and restarts the
+        ladder there — the behavior TDLib performs internally, surfaced here
+        because this client owns the connection."""
+        max_hops = 3  # bound redirect chains (cyclic tables misconfigure)
+        for hop in range(max_hops):
+            self._call({"@type": "setTdlibParameters",
+                        "api_id": api_id, "api_hash": api_hash,
+                        "database_directory": database_directory})
+            try:
+                self._call({"@type": "setAuthenticationPhoneNumber",
+                            "phone_number": phone_number})
+                break
+            except TelegramError as e:
+                dc = parse_migrate_dc(e)
+                if dc is None or str(dc) not in self.dc_table:
+                    raise
+                if hop == max_hops - 1:
+                    # Budget exhausted: don't tear down a live connection
+                    # for a DC we'd never actually try.
+                    raise NativeClientError(
+                        500, f"too many DC migrations (last: {e.message})"
+                    ) from e
+                logger.info("DC migration: %s -> dc %d", e.message, dc,
+                            extra={"conn_id": self.conn_id})
+                self._reconnect_to_dc(dc)
         self._call({"@type": "checkAuthenticationCode",
                     "code": phone_code})
         if password:
             self._call({"@type": "checkAuthenticationPassword",
                         "password": password})
+
+    def _reconnect_to_dc(self, dc: int) -> None:
+        """Tear down the wire connection and rebuild it against the DC-table
+        entry (address + that DC's pinned pubkey), resetting session state —
+        the client half of Telegram's migrate flow."""
+        if self._remote_opts is None:
+            raise NativeClientError(500, "DC migration needs remote mode")
+        entry = self.dc_table[str(dc)]
+        opts = dict(self._remote_opts)
+        opts["server_addr"] = entry["address"]
+        if entry.get("pubkey_file"):
+            opts["server_pubkey_file"] = entry["pubkey_file"]
+        config = self._build_remote_config(opts)
+        with self._mu:
+            handle = self._lib.dct_client_create(
+                json.dumps(config).encode("utf-8"))
+            if not handle:
+                raise NativeClientError(
+                    500, f"failed to connect to dc {dc} "
+                         f"({entry['address']} refused?)")
+            self._lib.dct_client_destroy(self._handle)
+            self._handle = handle
+            self._pending.clear()
+            self.updates.clear()
+            self._transport_error = None
+            self._remote_opts = opts
+            self.current_dc = dc
 
     # -- plumbing ----------------------------------------------------------
     @staticmethod
@@ -683,6 +757,24 @@ def acquire_seed_db(source: str, base_dir: str, conn_id: str) -> str:
     return _find_seed(conn_dir)
 
 
+def load_dc_table(path: str) -> Dict[str, Dict[str, str]]:
+    """DC table JSON -> {dc_id: {"address", "pubkey_file"}} — the analog of
+    Telegram's config dcOptions.  Accepts ``{"dcs": {...}}`` or the flat
+    map."""
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    table = doc.get("dcs", doc) if isinstance(doc, dict) else None
+    if not isinstance(table, dict):
+        raise ValueError(f"dc table {path}: expected a {{dc_id: ...}} map")
+    out: Dict[str, Dict[str, str]] = {}
+    for dc, entry in table.items():
+        if not isinstance(entry, dict) or not entry.get("address"):
+            raise ValueError(f"dc table {path}: dc {dc} needs an address")
+        out[str(dc)] = {"address": str(entry["address"]),
+                        "pubkey_file": str(entry.get("pubkey_file", ""))}
+    return out
+
+
 def native_client_factory(seed_db: str = "", seed_json: str = "",
                           lib_path: Optional[str] = None,
                           db_source: str = "",
@@ -691,7 +783,9 @@ def native_client_factory(seed_db: str = "", seed_json: str = "",
                           tls_insecure: bool = False, sni: str = "",
                           credentials: Optional[Dict[str, str]] = None,
                           tdlib_dir: str = ".tdlib", wire: str = "",
-                          server_pubkey_file: str = ""):
+                          server_pubkey_file: str = "",
+                          dc_table: Optional[Dict[Any, Dict[str,
+                                                            str]]] = None):
     """Pool-compatible factory: returns a callable producing fresh
     authenticated clients (`telegramhelper/connection_pool.go:97-149`
     preloaded each conn from a DB URL).  With ``db_source`` set, each
@@ -709,6 +803,7 @@ def native_client_factory(seed_db: str = "", seed_json: str = "",
                 server_addr=server_addr, tls=tls,
                 tls_insecure=tls_insecure, sni=sni, wire=wire,
                 server_pubkey_file=server_pubkey_file,
+                dc_table=dc_table,
                 lib_path=lib_path, conn_id=conn_id)
             creds = credentials or load_credentials(tdlib_dir)
             if creds is None:

@@ -110,6 +110,10 @@ class CrawlerConfig:
     # RSA public key JSON in dc_pubkey_file.
     dc_wire: str = ""
     dc_pubkey_file: str = ""
+    # DC table JSON ({dc_id: {address, pubkey_file}}) — the analog of
+    # Telegram's config dcOptions: clients follow PHONE_MIGRATE_X
+    # redirects to the account's home DC using this table.
+    dc_table_file: str = ""
 
     # Date windows / sampling
     min_post_date: Optional[datetime] = None

@@ -94,7 +94,11 @@ def setup_pool_from_config(cfg: CrawlerConfig) -> bool:
                 # Process-wide pool, first installer wins (the reference's
                 # global pool has the same contract, `runner.go:287-306`).
                 return True
-        from ..clients.native import load_credentials, native_client_factory
+        from ..clients.native import (
+            load_credentials,
+            load_dc_table,
+            native_client_factory,
+        )
 
         if getattr(cfg, "dc_address", ""):
             # Remote mode: N wire connections to the DC gateway, each
@@ -103,11 +107,15 @@ def setup_pool_from_config(cfg: CrawlerConfig) -> bool:
             # (`telegramhelper/client.go:319-377`).
             n_conns = max(1, cfg.concurrency)
             tdlib_dir = getattr(cfg, "tdlib_dir", ".tdlib")
+            dc_table = None
+            if getattr(cfg, "dc_table_file", ""):
+                dc_table = load_dc_table(cfg.dc_table_file)
             factory = native_client_factory(
                 server_addr=cfg.dc_address, tls=cfg.dc_tls,
                 tls_insecure=cfg.dc_tls_insecure, sni=cfg.dc_sni,
                 wire=getattr(cfg, "dc_wire", ""),
                 server_pubkey_file=getattr(cfg, "dc_pubkey_file", ""),
+                dc_table=dc_table,
                 credentials=load_credentials(tdlib_dir),
                 tdlib_dir=tdlib_dir)
             pool = ConnectionPool(

@@ -79,8 +79,8 @@ struct DctWire : WireConn {
 
 struct MtprotoWire : WireConn {
   MtprotoWire(std::unique_ptr<dctnet::Stream> stream,
-              const dctmtp::RsaPub& key)
-      : conn(std::move(stream), key) {}
+              std::vector<dctmtp::RsaPub> keys)
+      : conn(std::move(stream), std::move(keys)) {}
   void send_frame(const std::string& p) override { conn.send_frame(p); }
   std::string recv_frame() override { return conn.recv_frame(); }
   void shutdown() override { conn.shutdown(); }
@@ -460,16 +460,29 @@ class Client {
     if (cfg.get("wire").as_string() == "mtproto") {
       // MTProto 2.0 envelope (mtproto.h): auth-key handshake on connect,
       // AES-IGE-encrypted messages after — the reference's TDLib↔DC wire.
-      // The server public key rides in config ({n, e} hex/int), the same
-      // role as the DC keys baked into Telegram clients.
-      dctmtp::RsaPub key;
-      const Value& pk = cfg.get("server_pubkey");
-      if (pk.is_null())
-        throw std::runtime_error("wire=mtproto needs server_pubkey {n,e}");
-      key.n = dctmtp::hex_to_bytes(pk.get("n").as_string());
-      int64_t e = pk.get("e").as_int(65537);
-      key.e = dctmtp::be_bytes_u64(static_cast<uint64_t>(e));
-      conn_.reset(new MtprotoWire(std::move(stream), key));
+      // Keys ride in config as a keyring ("server_pubkeys": [{n,e},…]) or
+      // a single "server_pubkey" — the same role as the several long-lived
+      // DC keys baked into Telegram clients; the handshake selects by the
+      // fingerprint the server offers in resPQ.
+      auto parse_key = [](const Value& pk) {
+        dctmtp::RsaPub key;
+        key.n = dctmtp::hex_to_bytes(pk.get("n").as_string());
+        int64_t e = pk.get("e").as_int(65537);
+        key.e = dctmtp::be_bytes_u64(static_cast<uint64_t>(e));
+        return key;
+      };
+      std::vector<dctmtp::RsaPub> keys;
+      const Value& ring = cfg.get("server_pubkeys");
+      if (!ring.is_null()) {
+        for (const auto& pk : ring.as_array()) keys.push_back(parse_key(pk));
+      } else {
+        const Value& pk = cfg.get("server_pubkey");
+        if (pk.is_null())
+          throw std::runtime_error(
+              "wire=mtproto needs server_pubkey {n,e} or server_pubkeys");
+        keys.push_back(parse_key(pk));
+      }
+      conn_.reset(new MtprotoWire(std::move(stream), std::move(keys)));
     } else {
       conn_.reset(new DctWire(std::move(stream)));
     }

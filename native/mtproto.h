@@ -614,12 +614,21 @@ inline Bytes parse_plain(const Bytes& packet) {
 
 class MtprotoConnection {
  public:
-  // Performs the full auth-key handshake on construction.
+  // Performs the full auth-key handshake on construction.  The keyring
+  // mirrors real Telegram clients: several pinned DC public keys, the one
+  // whose fingerprint the server offers in resPQ gets used.
+  MtprotoConnection(std::unique_ptr<dctnet::Stream> stream,
+                    std::vector<RsaPub> server_keys)
+      : stream_(std::move(stream)), transport_(stream_.get()) {
+    if (server_keys.empty())
+      throw MtprotoError("empty RSA keyring");
+    handshake(server_keys);
+  }
+
   MtprotoConnection(std::unique_ptr<dctnet::Stream> stream,
                     const RsaPub& server_key)
-      : stream_(std::move(stream)), transport_(stream_.get()) {
-    handshake(server_key);
-  }
+      : MtprotoConnection(std::move(stream),
+                          std::vector<RsaPub>{server_key}) {}
 
   void send_frame(const std::string& payload) {
     Bytes body;
@@ -650,7 +659,7 @@ class MtprotoConnection {
   const Bytes& auth_key() const { return auth_key_; }
 
  private:
-  void handshake(const RsaPub& server_key) {
+  void handshake(const std::vector<RsaPub>& server_keys) {
     // 1. req_pq_multi
     Bytes nonce = random_bytes(16);
     Bytes req;
@@ -666,11 +675,17 @@ class MtprotoConnection {
     uint64_t pq = u64_from_be(r.bytes());
     if (r.u32() != kVector) throw MtprotoError("expected Vector<long>");
     uint32_t n_fp = r.u32();
-    bool fp_ok = false;
-    int64_t want_fp = server_key.fingerprint();
-    for (uint32_t i = 0; i < n_fp; ++i)
-      if (r.i64() == want_fp) fp_ok = true;
-    if (!fp_ok) throw MtprotoError("server offered no known fingerprint");
+    std::vector<int64_t> offered(n_fp);
+    for (uint32_t i = 0; i < n_fp; ++i) offered[i] = r.i64();
+    const RsaPub* server_key = nullptr;
+    int64_t want_fp = 0;
+    for (const RsaPub& k : server_keys) {
+      int64_t fp = k.fingerprint();
+      for (int64_t got : offered)
+        if (got == fp) { server_key = &k; want_fp = fp; break; }
+      if (server_key) break;
+    }
+    if (!server_key) throw MtprotoError("server offered no known fingerprint");
 
     // 2. factor pq, req_DH_params with RSA-encrypted p_q_inner_data
     uint64_t p, q;
@@ -688,7 +703,7 @@ class MtprotoConnection {
     tl_bytes(&dh_req, be_bytes_u64(p));
     tl_bytes(&dh_req, be_bytes_u64(q));
     tl_i64(&dh_req, want_fp);
-    tl_bytes(&dh_req, server_key.encrypt_with_hash(inner));
+    tl_bytes(&dh_req, server_key->encrypt_with_hash(inner));
     transport_.send(plain_message(dh_req, client_msg_id(&last_msg_id_)));
 
     // 3. server_DH_params_ok -> decrypt DH answer with SHA1 tmp key/iv

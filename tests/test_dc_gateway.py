@@ -738,3 +738,79 @@ class TestFloodWaitOverWire:
         finally:
             crawl_runner.shutdown_connection_pool()
             gw.close()
+
+
+class TestDcMigration:
+    """Telegram's DC topology: accounts live on a home DC; dialing the
+    wrong one gets 303 PHONE_MIGRATE_X at the phone step and the client
+    reconnects via its DC table (Telegram's config dcOptions analog) —
+    the flow TDLib performs internally for the reference
+    (`telegramhelper/client.go:319-377` drives the ladder over it)."""
+
+    SEED2 = json.dumps({"channels": [{
+        "username": "dc2chan", "id": 2200, "title": "DC2 Channel",
+        "member_count": 300,
+        "messages": [{"content": {"@type": "messageText",
+                                  "text": {"text": "hello from dc2"}},
+                      "date": 1700000000, "view_count": 2}],
+    }]})
+
+    def test_phone_migrate_followed_via_dc_table(self, tmp_path):
+        # DC1 knows the account but homes it on DC2; DC2 serves it.
+        acct = {"+15559990000": {"code": "777", "password": "",
+                                 "dc_id": 2}}
+        acct_home = {"+15559990000": {"code": "777", "password": ""}}
+        gw1 = DcGateway(seed_json=SEED, accounts=acct, dc_id=1,
+                        wire="mtproto",
+                        store_root=str(tmp_path / "dc1")).start()
+        gw2 = DcGateway(seed_json=self.SEED2, accounts=acct_home, dc_id=2,
+                        wire="mtproto",
+                        store_root=str(tmp_path / "dc2")).start()
+        try:
+            table = {"2": {"address": gw2.address,
+                           "pubkey_file": gw2.pubkey_file}}
+            c = NativeTelegramClient(
+                server_addr=gw1.address, wire="mtproto",
+                server_pubkey_file=gw1.pubkey_file,
+                dc_table=table, conn_id="mig1")
+            try:
+                c.authenticate("+15559990000", "777")
+                c.wait_ready(5.0)
+                assert c.current_dc == 2
+                # Service comes from DC2's store now.
+                assert c.search_public_chat("dc2chan").id == 2200
+            finally:
+                c.close()
+            assert gw1.status()["migrations_issued"] == 1
+            assert gw1.status()["auth_successes"] == 0
+            assert gw2.status()["auth_successes"] == 1
+        finally:
+            gw1.close()
+            gw2.close()
+
+    def test_migrate_without_table_surfaces_error(self, tmp_path):
+        acct = {"+15559990000": {"code": "777", "password": "",
+                                 "dc_id": 2}}
+        gw1 = DcGateway(seed_json=SEED, accounts=acct, dc_id=1,
+                        store_root=str(tmp_path / "dc1")).start()
+        try:
+            c = NativeTelegramClient(server_addr=gw1.address,
+                                     conn_id="mig2")
+            try:
+                with pytest.raises(TelegramError,
+                                   match="PHONE_MIGRATE_2"):
+                    c.authenticate("+15559990000", "777")
+            finally:
+                c.close()
+        finally:
+            gw1.close()
+
+    def test_accounts_file_carries_dc_id(self, tmp_path):
+        p = tmp_path / "accounts.json"
+        p.write_text(json.dumps([
+            {"phone_number": "+1555", "code": "1", "dc_id": 3},
+            {"phone_number": "+1666", "code": "2"},
+        ]))
+        acc = load_accounts(str(p))
+        assert acc["+1555"]["dc_id"] == 3
+        assert "dc_id" not in acc["+1666"]

@@ -217,6 +217,50 @@ class TestHandshake:
         with pytest.raises(ValueError):
             hs.handle(plain_message(req, 8))
 
+    def test_keyring_selects_offered_fingerprint(self):
+        """Real clients pin SEVERAL DC keys and pick whichever fingerprint
+        the server offers in resPQ — a ring with a stale key first must
+        still handshake via the matching one."""
+        a, b = socket.socketpair()
+
+        def serve():
+            transport = Transport(a, is_server=True)
+            hs = ServerHandshake(rsa=RSA)
+            done = False
+            while not done:
+                reply, done = hs.handle(transport.recv())
+                if reply:
+                    transport.send(reply)
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        transport = Transport(b, is_server=False)
+        stale = generate_rsa_key(1024)
+        sess = client_handshake(transport, [
+            RsaKey(n=stale.n, e=stale.e),       # stale pinned key
+            RsaKey(n=RSA.n, e=RSA.e),           # the server's actual key
+        ])
+        assert len(sess.auth_key) == 256
+        t.join(10)
+
+    def test_load_keyring_formats(self, tmp_path):
+        from distributed_crawler_tpu.clients.mtproto_wire import (
+            load_keyring,
+            save_pubkey,
+        )
+
+        single = tmp_path / "one.json"
+        save_pubkey(str(single), RSA)
+        assert [k.fingerprint for k in load_keyring(str(single))] == \
+            [RSA.fingerprint]
+        other = generate_rsa_key(1024)
+        ring = tmp_path / "ring.json"
+        ring.write_text(json.dumps({"keys": [
+            {"n": hex(other.n), "e": other.e},
+            {"n": hex(RSA.n), "e": RSA.e}]}))
+        assert [k.fingerprint for k in load_keyring(str(ring))] == \
+            [other.fingerprint, RSA.fingerprint]
+
     def test_wrong_pubkey_rejected_by_client(self):
         a, b = socket.socketpair()
 
@@ -309,6 +353,40 @@ class TestCppClientAgainstPythonGateway:
             assert st["wire"] == "mtproto"
             assert st["auth_successes"] == 1
             assert st["requests_served"] >= 2
+        finally:
+            gw.close()
+
+    def test_cpp_client_keyring_selects_gateway_key(self, tmp_path):
+        """The C++ twin of the keyring rule: a pubkey FILE holding a stale
+        key first plus the gateway's real key handshakes fine — the native
+        handshake selects by the offered resPQ fingerprint."""
+        from distributed_crawler_tpu.clients.dc_gateway import DcGateway
+        from distributed_crawler_tpu.clients.mtproto_wire import (
+            generate_rsa_key,
+            load_pubkey,
+        )
+        from distributed_crawler_tpu.clients.native import (
+            NativeTelegramClient,
+        )
+
+        gw = DcGateway(seed_json=SEED, expected_code="13579",
+                       wire="mtproto", store_root=str(tmp_path)).start()
+        try:
+            real = load_pubkey(gw.pubkey_file)
+            stale = generate_rsa_key(1024)
+            ring = tmp_path / "keyring.json"
+            ring.write_text(json.dumps({"keys": [
+                {"n": hex(stale.n), "e": stale.e},
+                {"n": hex(real.n), "e": real.e}]}))
+            c = NativeTelegramClient(server_addr=gw.address, wire="mtproto",
+                                     server_pubkey_file=str(ring),
+                                     conn_id="mt-ring")
+            try:
+                c.authenticate("+15550001111", "13579")
+                c.wait_ready(5.0)
+                assert c.search_public_chat("mtroot").id == 4242
+            finally:
+                c.close()
         finally:
             gw.close()
 
