@@ -110,9 +110,19 @@ def make_self_signed_cert(directory: str, cn: str = "localhost") -> tuple:
 
 
 class _MtprotoConn:
-    """Wire adapter: the DCT JSON session rides MTProto 2.0 encrypted
-    messages (`mtproto_wire`) instead of DCT-v1 length-prefixed frames.
-    Duck-types the socket surface the session loop / watchdog touch."""
+    """Wire adapter: the DCT session rides MTProto 2.0 encrypted messages
+    (`mtproto_wire`) carrying TL API constructor frames (`tl_api`) instead
+    of DCT-v1 length-prefixed JSON.  Duck-types the socket surface the
+    session loop / watchdog touch; the loop keeps speaking JSON — this
+    adapter translates at the wire:
+
+    - inbound: TL function frame -> JSON request (typed constructors or
+      the declared dct.rawRequest fallback), remembering the MTProto
+      msg_id;
+    - outbound: the FIRST send after a recv answers that request as
+      ``rpc_result(req_msg_id, ...)`` (real MTProto's correlation);
+      subsequent sends are unsolicited ``dct.update`` pushes — exactly
+      the reply-then-push order the auth ladder emits."""
 
     def __init__(self, sock, rsa):
         from .mtproto_wire import MtprotoServerSession
@@ -121,12 +131,30 @@ class _MtprotoConn:
         # Constructor runs the full auth-key handshake; the caller's auth
         # deadline (socket timeout + watchdog) bounds it.
         self._sess = MtprotoServerSession(sock, rsa)
+        self._last_req_msg_id: Optional[int] = None
+        self._replied = True
 
     def send_payload(self, payload: bytes) -> None:
-        self._sess.send(payload)
+        from . import tl_api
+
+        obj = json.loads(payload.decode("utf-8"))
+        if not self._replied and self._last_req_msg_id is not None:
+            frame = tl_api.serialize_result(obj, self._last_req_msg_id)
+            self._replied = True
+        else:
+            frame = tl_api.serialize_update(obj)
+        self._sess.send(frame)
 
     def recv_payload(self) -> Optional[bytes]:
-        return self._sess.recv()
+        from . import tl_api
+
+        raw = self._sess.recv()
+        if raw is None:
+            return None
+        req = tl_api.deserialize_request(raw)
+        self._last_req_msg_id = self._sess.session.last_recv_msg_id
+        self._replied = False
+        return json.dumps(req).encode("utf-8")
 
     def settimeout(self, t) -> None:
         self._sock.settimeout(t)

@@ -20,12 +20,19 @@ in-tree DC gateway:
   SHA256(auth_key[88+x:120+x] ‖ padded_plaintext), SHA256-based key/iv
   derivation (x=0 client→server, x=8 server→client), AES-256-IGE.
 
-Honest delta vs the reference, by design: the payload riding INSIDE the
-encrypted envelope is the framework's JSON API schema (wrapped in one
-TL ``bytes`` value), not Telegram's full TL API layer — TDLib's ~3000
-generated constructors serve its client database, which this framework
-replaces with the gateway-side store.  The transport, handshake, and
-per-message crypto are the MTProto 2.0 spec.
+The payload riding INSIDE the encrypted envelope is a TL API constructor
+layer (`tl_api.py` / `native/tl_api.h`): typed TL functions for the hot
+crawl RPCs, a schema-declared DataJSON-style fallback for the long tail,
+and responses in the published ``rpc_result#f35c6d01`` envelope
+correlated by MTProto msg_id.  The schema covers the framework's
+16-method surface rather than Telegram's ~3000 TDLib constructors —
+those serve TDLib's client database, which this framework replaces with
+the gateway-side store.  The transport, handshake, and per-message
+crypto are the MTProto 2.0 spec.
+
+Remaining honest delta: the wire terminates at the in-tree DC gateway
+(with its own long-lived RSA keys, DC table, and PHONE_MIGRATE_X
+redirects), not at Telegram's production DCs.
 
 Both sides live here (client for tests/parity, server for the gateway);
 `native/mtproto.h` is the C++ client twin — the cross-implementation
@@ -324,6 +331,11 @@ class Session:
     seq: int = 0
     _last_msg_id: int = 0
     _peer_last_msg_id: int = 0
+    # Correlation handles for the TL API layer (tl_api.py): the msg_id the
+    # last encrypt() assigned / the last decrypt() validated — rpc_result's
+    # req_msg_id, exactly real MTProto's request/response correlation.
+    last_sent_msg_id: int = 0
+    last_recv_msg_id: int = 0
 
     @property
     def auth_key_id(self) -> bytes:
@@ -344,8 +356,9 @@ class Session:
         # content-related message carries 1, so read seq before bumping it.
         seq_no = self.seq * 2 + 1
         self.seq += 1
+        self.last_sent_msg_id = self._next_msg_id()
         inner = (self.server_salt + self.session_id +
-                 i64(self._next_msg_id()) + u32(seq_no) +
+                 i64(self.last_sent_msg_id) + u32(seq_no) +
                  u32(len(payload)) + payload)
         # Padding: ≥12 random bytes, total length % 16 == 0 (spec).
         inner += secrets.token_bytes(12 + (-(len(inner) + 12)) % 16)
@@ -386,6 +399,7 @@ class Session:
         if msg_id <= self._peer_last_msg_id:
             raise ValueError("msg_id not increasing (replay?)")
         self._peer_last_msg_id = msg_id
+        self.last_recv_msg_id = msg_id
         r.uint32()  # seq_no
         n = r.uint32()
         if n > len(inner) - 32:
@@ -671,6 +685,9 @@ class MtprotoServerSession:
                                session_id=b"", is_client=False)
 
     def recv(self) -> Optional[bytes]:
+        """One decrypted raw TL payload (a tl_api constructor frame);
+        ``session.last_recv_msg_id`` then identifies it for rpc_result
+        correlation."""
         try:
             packet = self.transport.recv()
         except TimeoutError:
@@ -679,13 +696,10 @@ class MtprotoServerSession:
             return None
         # Session.decrypt adopts the client's session_id from the first
         # validated message (the client mints it, per spec).
-        body = self.session.decrypt(packet)
-        # The API payload rides as one TL bytes value inside the envelope
-        # (see module docstring / native/mtproto.h send_frame).
-        return TlReader(body).tl_bytes()
+        return self.session.decrypt(packet)
 
     def send(self, payload: bytes) -> None:
-        self.transport.send(self.session.encrypt(tl_bytes(payload)))
+        self.transport.send(self.session.encrypt(payload))
 
 
 def save_pubkey(path: str, key: RsaKey) -> None:

@@ -46,6 +46,7 @@
 #include "json.h"
 #include "mtproto.h"
 #include "net.h"
+#include "tl_api.h"
 
 using dctjson::Array;
 using dctjson::Object;
@@ -77,15 +78,59 @@ struct DctWire : WireConn {
   dctnet::Connection conn;
 };
 
+// MTProto wire with the TL API layer (native/tl_api.h): JSON requests are
+// serialized as TL constructor frames (typed for the hot crawl RPCs,
+// dct.rawRequest for the tail), @extra stays CLIENT-LOCAL — correlation
+// rides rpc_result's req_msg_id exactly as in real MTProto, and this
+// adapter reattaches the stored @extra when the result returns.
 struct MtprotoWire : WireConn {
   MtprotoWire(std::unique_ptr<dctnet::Stream> stream,
               std::vector<dctmtp::RsaPub> keys)
       : conn(std::move(stream), std::move(keys)) {}
-  void send_frame(const std::string& p) override { conn.send_frame(p); }
-  std::string recv_frame() override { return conn.recv_frame(); }
+
+  void send_frame(const std::string& p) override {
+    Value req = dctjson::parse(p);
+    std::string extra;
+    const Value& ev = req.get("@extra");
+    if (!ev.is_null()) {
+      extra = ev.as_string();
+      req.obj().erase("@extra");
+    }
+    dctmtp::Bytes payload = dcttl::serialize_request(req);
+    // The extra must be registered under the SAME lock window as the
+    // send: two racing senders must not cross-file their msg_ids.
+    std::lock_guard<std::mutex> lock(extra_mu_);
+    int64_t msg_id = conn.send_payload(payload);
+    if (!extra.empty()) {
+      extra_by_msg_id_[msg_id] = extra;
+      if (extra_by_msg_id_.size() > 4096)  // dropped-request hygiene
+        extra_by_msg_id_.erase(extra_by_msg_id_.begin());
+    }
+  }
+
+  std::string recv_frame() override {
+    dctmtp::Bytes payload = conn.recv_payload();
+    if (payload.empty()) return std::string();
+    bool has_req = false;
+    int64_t req_msg_id = 0;
+    Value obj = dcttl::deserialize_frame(payload, &has_req, &req_msg_id);
+    if (has_req) {
+      std::lock_guard<std::mutex> lock(extra_mu_);
+      auto it = extra_by_msg_id_.find(req_msg_id);
+      if (it != extra_by_msg_id_.end()) {
+        obj.obj()["@extra"] = Value(it->second);
+        extra_by_msg_id_.erase(it);
+      }
+    }
+    return dctjson::dump(obj);
+  }
+
   void shutdown() override { conn.shutdown(); }
   bool wait_readable(int ms) override { return conn.wait_readable(ms); }
+
   dctmtp::MtprotoConnection conn;
+  std::mutex extra_mu_;
+  std::map<int64_t, std::string> extra_by_msg_id_;
 };
 
 // ---------------------------------------------------------------------------

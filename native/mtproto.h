@@ -16,13 +16,16 @@
 //     ‖ padded plaintext)[8:24], SHA256-based key/iv derivation (x=0
 //     client→server, 8 server→client), AES-256-IGE.
 //
-// Honest delta, by design: the payload inside the encrypted envelope is
-// the framework's JSON API (one TL bytes value), not Telegram's ~3000-
-// constructor TL API layer — TDLib's generated schema feeds its client
-// database, which this framework replaces with the gateway-side store.
-// The Python twin (clients/mtproto_wire.py) implements both sides; the
-// cross-implementation handshake in tests/test_mtproto.py is the parity
-// proof.
+// The payload inside the encrypted envelope is a TL API constructor
+// layer (tl_api.h): typed TL functions for the hot crawl RPCs, a
+// schema-declared raw fallback for the tail, rpc_result#f35c6d01
+// correlation by msg_id.  The schema covers the framework's 16-method
+// surface rather than Telegram's ~3000 TDLib constructors — those feed
+// TDLib's client database, which this framework replaces with the
+// gateway-side store.  The Python twin (clients/mtproto_wire.py +
+// clients/tl_api.py) implements both sides; the cross-implementation
+// handshake + typed-constructor e2es in tests/test_mtproto.py and
+// tests/test_tl_api.py are the parity proof.
 //
 // Crypto comes from libcrypto.so.3 via dlopen (no dev headers in the
 // image), mirroring net.h's OpenSSL loading pattern.
@@ -630,25 +633,29 @@ class MtprotoConnection {
       : MtprotoConnection(std::move(stream),
                           std::vector<RsaPub>{server_key}) {}
 
-  void send_frame(const std::string& payload) {
-    Bytes body;
-    tl_bytes(&body, payload);  // one TL bytes value wraps the JSON API
-    // One lock across msg_id assignment + encryption + the wire write:
-    // Client::send is called from arbitrary caller threads, and with
-    // separate locks a later msg_id could reach the wire first, tripping
-    // the peer's strictly-increasing replay check and killing the session.
+  // Send one raw TL payload (a tl_api.h constructor frame); returns the
+  // MTProto msg_id assigned to it — the rpc_result correlation handle.
+  // One lock across msg_id assignment + encryption + the wire write:
+  // Client::send is called from arbitrary caller threads, and with
+  // separate locks a later msg_id could reach the wire first, tripping
+  // the peer's strictly-increasing replay check and killing the session.
+  int64_t send_payload(const Bytes& payload) {
     std::lock_guard<std::mutex> lock(enc_mu_);
-    transport_.send(encrypt_locked(body));
+    Bytes packet = encrypt_locked(payload);
+    transport_.send(packet);
+    return last_sent_msg_id_;
   }
 
-  // Blocking read of one frame; empty string on orderly close.
-  std::string recv_frame() {
+  // Blocking read of one decrypted payload; empty on orderly close.
+  // last_recv_msg_id() then identifies the peer frame (server side uses
+  // it as rpc_result's req_msg_id).
+  Bytes recv_payload() {
     Bytes packet = transport_.recv();
-    if (packet.empty()) return std::string();
-    Bytes body = decrypt(packet);
-    TlReader r(body);
-    return r.bytes();
+    if (packet.empty()) return Bytes();
+    return decrypt(packet);
   }
+
+  int64_t last_recv_msg_id() const { return peer_last_msg_id_; }
 
   void shutdown() { stream_->shutdown(); }
 
@@ -778,14 +785,15 @@ class MtprotoConnection {
     session_id_ = random_bytes(8);
   }
 
-  // Caller must hold enc_mu_ (send_frame keeps it through the wire write).
+  // Caller must hold enc_mu_ (send_payload keeps it through the write).
   Bytes encrypt_locked(const Bytes& payload) {
     // seq_no = 2*count_of_content_messages_before + 1 (spec): the FIRST
     // content-related message carries 1, so read seq_ before bumping it.
     uint32_t seq_no = seq_ * 2 + 1;
     seq_ += 1;
     Bytes inner = server_salt_ + session_id_;
-    tl_i64(&inner, client_msg_id(&last_msg_id_));
+    last_sent_msg_id_ = client_msg_id(&last_msg_id_);
+    tl_i64(&inner, last_sent_msg_id_);
     tl_u32(&inner, seq_no);
     tl_u32(&inner, static_cast<uint32_t>(payload.size()));
     inner += payload;
@@ -835,6 +843,7 @@ class MtprotoConnection {
   Bytes session_id_;
   uint32_t seq_ = 0;
   int64_t last_msg_id_ = 0;
+  int64_t last_sent_msg_id_ = 0;
   int64_t peer_last_msg_id_ = 0;
   std::mutex enc_mu_;
 };

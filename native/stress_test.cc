@@ -24,6 +24,11 @@
 #include <vector>
 
 #include "mtproto.h"
+#include "tl_api.h"
+
+using dctjson::Array;
+using dctjson::Object;
+using dctjson::Value;
 
 extern "C" {
 void* dct_client_create(const char* config_json);
@@ -251,6 +256,79 @@ int mtproto_crypto_phase() try {
   fprintf(stderr, "mtproto: %s\n", e.what());
   return 1;
 }
+
+// --- TL API layer under the sanitizers -------------------------------------
+// tl_api.h's generic codec does a lot of byte slicing; roundtrips of the
+// typed constructors (incl. the Vector<dct.message> path and the raw
+// fallback) are where ASan/UBSan would catch offset bugs.
+
+int tl_api_phase() try {
+  using dcttl::deserialize_frame;
+  using dcttl::registry;
+  using dcttl::serialize_request;
+
+  // Typed function roundtrip: binary TL, no JSON inside.
+  Object req;
+  req["@type"] = Value("getChatHistory");
+  req["chat_id"] = Value(int64_t(4242));
+  req["from_message_id"] = Value(int64_t(9));
+  req["offset"] = Value(int64_t(-1));
+  req["limit"] = Value(int64_t(100));
+  dctmtp::Bytes frame = serialize_request(Value(req));
+  if (frame.find("getChatHistory") != std::string::npos ||
+      frame.find('{') != std::string::npos) {
+    fprintf(stderr, "tl: typed frame leaked JSON\n");
+    return 1;
+  }
+  // Result roundtrip through rpc_result, incl. a message vector with a
+  // DataJSON content payload.
+  Object msg;
+  msg["@type"] = Value("message");
+  msg["id"] = Value(int64_t(1) << 20);
+  msg["chat_id"] = Value(int64_t(4242));
+  msg["date"] = Value(int64_t(1700000000));
+  msg["view_count"] = Value(int64_t(5));
+  msg["sender_username"] = Value("u");
+  msg["is_channel_post"] = Value(true);
+  msg["content"] = dctjson::parse(
+      "{\"@type\":\"messageText\",\"text\":{\"text\":\"hi\"}}");
+  Object msgs;
+  msgs["@type"] = Value("messages");
+  msgs["total_count"] = Value(int64_t(1));
+  Array arr;
+  arr.push_back(Value(msg));
+  msgs["messages"] = Value(std::move(arr));
+  dctmtp::Bytes res;
+  dcttl::w_u32(&res, dcttl::kRpcResult);
+  dcttl::w_i64(&res, 123456789);
+  dcttl::serialize_fields(registry().by_name.at("dct.messages"),
+                          Value(msgs), &res);
+  bool has_req = false;
+  int64_t req_msg_id = 0;
+  Value back = deserialize_frame(res, &has_req, &req_msg_id);
+  if (!has_req || req_msg_id != 123456789 ||
+      back.get("messages").as_array().size() != 1 ||
+      back.get("messages").as_array()[0].get("content").get("text")
+              .get("text").as_string() != "hi") {
+    fprintf(stderr, "tl: rpc_result roundtrip failed\n");
+    return 1;
+  }
+  // Raw fallback roundtrip for an unlisted @type.
+  Object tail;
+  tail["@type"] = Value("setAuthenticationPhoneNumber");
+  tail["phone_number"] = Value("+1555");
+  dctmtp::Bytes raw_frame = serialize_request(Value(tail));
+  dctmtp::TlReader rr(raw_frame);
+  if (rr.u32() != registry().by_name.at("dct.rawRequest").cid) {
+    fprintf(stderr, "tl: tail request not on the raw fallback\n");
+    return 1;
+  }
+  printf("tl api ok: typed/vector/rpc_result/raw roundtrips\n");
+  return 0;
+} catch (const std::exception& e) {
+  fprintf(stderr, "tl: %s\n", e.what());
+  return 1;
+}
 }  // namespace
 
 int main() {
@@ -307,5 +385,7 @@ int main() {
   printf("stress ok: %d responses, 0 errors\n", responses.load());
   int rc = remote_stress();
   if (rc != 0) return rc;
-  return mtproto_crypto_phase();
+  rc = mtproto_crypto_phase();
+  if (rc != 0) return rc;
+  return tl_api_phase();
 }

@@ -1,0 +1,311 @@
+// TL API constructor layer — the C++ twin of clients/tl_api.py.
+//
+// Every payload inside the MTProto 2.0 envelope is a TL constructor from
+// the schema below: typed functions for the hot crawl RPCs, a declared
+// dct.rawRequest/dct.rawResult fallback (one DataJSON-style string) for
+// the long tail, responses in the published rpc_result#f35c6d01 envelope
+// correlated by MTProto msg_id, and unsolicited server pushes as
+// dct.update frames.  Constructor ids are CRC32 of the canonical
+// declaration line (the TL standard); the Python side embeds IDENTICAL
+// strings, so both derive identical ids by construction — the
+// cross-implementation e2e in tests/test_mtproto.py is the parity proof.
+//
+// Reference boundary: Dockerfile.tdlib:19-36 (TDLib's generated TL layer);
+// clients/tl_api.py holds the schema-design rationale.
+
+#ifndef DCT_NATIVE_TL_API_H_
+#define DCT_NATIVE_TL_API_H_
+
+#include <cstdint>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "json.h"
+#include "mtproto.h"  // Bytes, tl_bytes, TlReader, kVector
+
+namespace dcttl {
+
+using dctjson::Array;
+using dctjson::Object;
+using dctjson::Value;
+using dctmtp::Bytes;
+
+constexpr uint32_t kRpcResult = 0xF35C6D01u;
+constexpr uint32_t kBoolTrue = 0x997275B5u;
+constexpr uint32_t kBoolFalse = 0xBC799737u;
+constexpr uint32_t kVector = 0x1CB5C415u;
+
+// zlib-compatible CRC32 (IEEE, reflected) — the TL constructor-id rule.
+inline uint32_t crc32_ieee(const std::string& s) {
+  uint32_t crc = 0xFFFFFFFFu;
+  for (unsigned char ch : s) {
+    crc ^= ch;
+    for (int i = 0; i < 8; ++i)
+      crc = (crc >> 1) ^
+            (0xEDB88320u & static_cast<uint32_t>(
+                               -static_cast<int32_t>(crc & 1u)));
+  }
+  return ~crc;
+}
+
+struct Field {
+  std::string name;
+  std::string type;
+};
+
+struct Constructor {
+  std::string name;       // e.g. "dct.chat"
+  std::string json_type;  // e.g. "chat" (the JSON @type)
+  uint32_t cid = 0;
+  std::vector<Field> fields;
+  bool is_function = false;
+};
+
+// Canonical schema lines — MUST byte-match clients/tl_api.py.
+inline const std::vector<std::string>& schema_types() {
+  static const std::vector<std::string> lines = {
+      "dct.error code:int message:string = dct.Object",
+      "dct.ok = dct.Object",
+      "dct.chat id:long title:string type:string supergroup_id:long"
+      " basic_group_id:long photo_remote_id:string = dct.Object",
+      "dct.message id:long chat_id:long date:long view_count:long"
+      " forward_count:long reply_count:long message_thread_id:long"
+      " reply_to_message_id:long sender_id:long sender_username:string"
+      " is_channel_post:Bool content:DataJSON reactions:DataJSON"
+      " = dct.Object",
+      "dct.messages total_count:long messages:Vector<dct.message>"
+      " = dct.Object",
+      "dct.messageLink link:string is_public:Bool = dct.Object",
+      "dct.messageThreadInfo chat_id:long message_thread_id:long"
+      " reply_count:long = dct.Object",
+      "dct.supergroup id:long username:string member_count:long"
+      " is_channel:Bool date:long is_verified:Bool = dct.Object",
+      "dct.supergroupFullInfo description:string member_count:long"
+      " photo_remote_id:string = dct.Object",
+      "dct.basicGroupFullInfo description:string members_count:long"
+      " = dct.Object",
+      "dct.file id:long remote_id:string local_path:string size:long"
+      " downloaded:Bool = dct.Object",
+      "dct.rawResult data:string = dct.Object",
+      "dct.update data:string = dct.Update",
+  };
+  return lines;
+}
+
+inline const std::vector<std::string>& schema_functions() {
+  static const std::vector<std::string> lines = {
+      "dct.searchPublicChat username:string = dct.Object",
+      "dct.getChat chat_id:long = dct.Object",
+      "dct.getChatHistory chat_id:long from_message_id:long offset:int"
+      " limit:int = dct.Object",
+      "dct.getMessage chat_id:long message_id:long = dct.Object",
+      "dct.getMessageLink chat_id:long message_id:long = dct.Object",
+      "dct.getMessageThread chat_id:long message_id:long = dct.Object",
+      "dct.getMessageThreadHistory chat_id:long message_id:long"
+      " from_message_id:long limit:int = dct.Object",
+      "dct.getSupergroup supergroup_id:long = dct.Object",
+      "dct.getSupergroupFullInfo supergroup_id:long = dct.Object",
+      "dct.getBasicGroupFullInfo basic_group_id:long = dct.Object",
+      "dct.getRemoteFile remote_file_id:string = dct.Object",
+      "dct.downloadFile file_id:long = dct.Object",
+      "dct.rawRequest data:string = dct.Object",
+  };
+  return lines;
+}
+
+struct Registry {
+  std::map<std::string, Constructor> by_name;
+  std::map<uint32_t, Constructor> by_id;
+  std::map<std::string, Constructor> func_by_json_type;
+  std::map<std::string, Constructor> type_by_json_type;
+};
+
+inline Constructor parse_line(const std::string& line, bool is_function) {
+  Constructor c;
+  c.cid = crc32_ieee(line);
+  c.is_function = is_function;
+  std::string decl = line.substr(0, line.find(" = "));
+  size_t pos = 0;
+  bool first = true;
+  while (pos < decl.size()) {
+    size_t sp = decl.find(' ', pos);
+    std::string tok = decl.substr(pos, sp == std::string::npos
+                                           ? std::string::npos
+                                           : sp - pos);
+    if (first) {
+      c.name = tok;
+      first = false;
+    } else if (!tok.empty()) {
+      size_t colon = tok.find(':');
+      c.fields.push_back({tok.substr(0, colon), tok.substr(colon + 1)});
+    }
+    if (sp == std::string::npos) break;
+    pos = sp + 1;
+  }
+  size_t dot = c.name.find('.');
+  c.json_type = c.name.substr(dot + 1);
+  return c;
+}
+
+inline const Registry& registry() {
+  static const Registry reg = [] {
+    Registry r;
+    for (const auto& line : schema_types()) {
+      Constructor c = parse_line(line, false);
+      r.by_name[c.name] = c;
+      r.by_id[c.cid] = c;
+      r.type_by_json_type[c.json_type] = c;
+    }
+    for (const auto& line : schema_functions()) {
+      Constructor c = parse_line(line, true);
+      r.by_name[c.name] = c;
+      r.by_id[c.cid] = c;
+      r.func_by_json_type[c.json_type] = c;
+    }
+    return r;
+  }();
+  return reg;
+}
+
+// -- TL binary primitives ---------------------------------------------------
+inline void w_u32(Bytes* out, uint32_t v) {
+  for (int i = 0; i < 4; ++i)
+    out->push_back(static_cast<char>((v >> (8 * i)) & 0xFF));
+}
+
+inline void w_i64(Bytes* out, int64_t v) {
+  for (int i = 0; i < 8; ++i)
+    out->push_back(static_cast<char>(
+        (static_cast<uint64_t>(v) >> (8 * i)) & 0xFF));
+}
+
+inline void w_string(Bytes* out, const std::string& s) {
+  dctmtp::tl_bytes(out, s);  // TL string framing == TL bytes framing
+}
+
+inline void w_bool(Bytes* out, bool v) {
+  w_u32(out, v ? kBoolTrue : kBoolFalse);
+}
+
+// -- generic constructor <-> JSON codec -------------------------------------
+inline void serialize_fields(const Constructor& c, const Value& obj,
+                             Bytes* out) {
+  w_u32(out, c.cid);
+  for (const Field& f : c.fields) {
+    const Value& v = obj.get(f.name);
+    if (f.type == "int") {
+      w_u32(out, static_cast<uint32_t>(
+                     static_cast<int32_t>(v.as_int(0))));
+    } else if (f.type == "long") {
+      w_i64(out, v.as_int(0));
+    } else if (f.type == "string") {
+      w_string(out, v.as_string());
+    } else if (f.type == "Bool") {
+      w_bool(out, v.as_bool(false));
+    } else if (f.type == "DataJSON") {
+      w_string(out, v.is_null() ? std::string("null") : dctjson::dump(v));
+    } else if (f.type.rfind("Vector<", 0) == 0) {
+      const std::string inner_name =
+          f.type.substr(7, f.type.size() - 8);
+      const Constructor& inner = registry().by_name.at(inner_name);
+      const Array& items = v.as_array();
+      w_u32(out, kVector);
+      w_u32(out, static_cast<uint32_t>(items.size()));
+      for (const Value& item : items) serialize_fields(inner, item, out);
+    } else {
+      throw std::runtime_error("unknown TL field type " + f.type);
+    }
+  }
+}
+
+inline Value deserialize_fields(const Constructor& c,
+                                dctmtp::TlReader* r) {
+  Object obj;
+  obj["@type"] = Value(c.json_type);
+  for (const Field& f : c.fields) {
+    if (f.type == "int") {
+      obj[f.name] = Value(static_cast<int64_t>(
+          static_cast<int32_t>(r->u32())));
+    } else if (f.type == "long") {
+      obj[f.name] = Value(r->i64());
+    } else if (f.type == "string") {
+      obj[f.name] = Value(r->bytes());
+    } else if (f.type == "Bool") {
+      uint32_t b = r->u32();
+      if (b != kBoolTrue && b != kBoolFalse)
+        throw std::runtime_error("bad Bool constructor");
+      obj[f.name] = Value(b == kBoolTrue);
+    } else if (f.type == "DataJSON") {
+      obj[f.name] = dctjson::parse(r->bytes());
+    } else if (f.type.rfind("Vector<", 0) == 0) {
+      const std::string inner_name =
+          f.type.substr(7, f.type.size() - 8);
+      const Constructor& inner = registry().by_name.at(inner_name);
+      if (r->u32() != kVector)
+        throw std::runtime_error("expected Vector");
+      uint32_t n = r->u32();
+      Array items;
+      for (uint32_t i = 0; i < n; ++i) {
+        if (r->u32() != inner.cid)
+          throw std::runtime_error("vector element type mismatch");
+        items.push_back(deserialize_fields(inner, r));
+      }
+      obj[f.name] = Value(std::move(items));
+    } else {
+      throw std::runtime_error("unknown TL field type " + f.type);
+    }
+  }
+  return Value(std::move(obj));
+}
+
+// JSON request (no @extra — that is client-local) -> TL function frame.
+inline Bytes serialize_request(const Value& req) {
+  const Registry& reg = registry();
+  const std::string& rtype = req.get("@type").as_string();
+  auto it = reg.func_by_json_type.find(rtype);
+  Bytes out;
+  if (it != reg.func_by_json_type.end() && rtype != "rawRequest") {
+    serialize_fields(it->second, req, &out);
+    return out;
+  }
+  Object raw;
+  raw["data"] = Value(dctjson::dump(req));
+  serialize_fields(reg.by_name.at("dct.rawRequest"), Value(std::move(raw)),
+                   &out);
+  return out;
+}
+
+// Wire frame -> (has_req_msg_id, req_msg_id, JSON object).
+inline Value deserialize_frame(const Bytes& data, bool* has_req_msg_id,
+                               int64_t* req_msg_id) {
+  dctmtp::TlReader r(data);
+  uint32_t cid = r.u32();
+  *has_req_msg_id = false;
+  *req_msg_id = 0;
+  const Registry& reg = registry();
+  if (cid == kRpcResult) {
+    *has_req_msg_id = true;
+    *req_msg_id = r.i64();
+    uint32_t inner_cid = r.u32();
+    auto it = reg.by_id.find(inner_cid);
+    if (it == reg.by_id.end() || it->second.is_function)
+      throw std::runtime_error("unknown TL result constructor");
+    Value obj = deserialize_fields(it->second, &r);
+    if (it->second.name == "dct.rawResult")
+      return dctjson::parse(obj.get("data").as_string());
+    return obj;
+  }
+  auto it = reg.by_id.find(cid);
+  if (it == reg.by_id.end())
+    throw std::runtime_error("unknown TL frame constructor");
+  Value obj = deserialize_fields(it->second, &r);
+  if (it->second.name == "dct.update" || it->second.name == "dct.rawResult")
+    return dctjson::parse(obj.get("data").as_string());
+  return obj;
+}
+
+}  // namespace dcttl
+
+#endif  // DCT_NATIVE_TL_API_H_
