@@ -59,6 +59,41 @@ class TestHashingTokenizer:
         with pytest.raises(ValueError):
             HashingTokenizer(3)
 
+    def test_token_memo_matches_whole_text_regex(self):
+        """The whitespace-token memo fast path must produce ids IDENTICAL
+        to running the word regex over the whole text (the memo is an
+        optimization, never a semantic change) — incl. punctuation glued
+        to words, long-token splitting, unicode, and repeat calls that
+        hit the warm path."""
+        import re
+        import unicodedata
+
+        word_re = re.compile(r"\w+|[^\w\s]", re.UNICODE)
+        tok = HashingTokenizer(50_000, max_word_len=6)
+
+        def reference(text):
+            text = unicodedata.normalize("NFKC", text or "").lower()
+            ids = [CLS_ID]
+            for w in word_re.findall(text):
+                if len(w) <= tok.max_word_len:
+                    ids.append(tok._fnv_id(w))
+                else:
+                    ids += [tok._fnv_id(w[i:i + tok.max_word_len])
+                            for i in range(0, len(w), tok.max_word_len)]
+            return ids + [SEP_ID]
+
+        samples = [
+            "Hello, WORLD! visit https://t.me/chan/12345",
+            "glued,punct...and--dashes (parens) [brackets]",
+            "  spaces\ttabs\nnewlines  ",
+            "",
+            "İstanbul Über straße \U0001F600",
+            "x" * 50 + " short " + "y" * 50,
+        ]
+        for s in samples:
+            assert tok.encode(s) == reference(s), repr(s)
+            assert tok.encode(s) == reference(s), f"warm path: {s!r}"
+
 
 def _engine(registry=None, **kw):
     cfg = EngineConfig(model="tiny", n_labels=3, batch_size=4,

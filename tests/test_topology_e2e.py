@@ -146,9 +146,10 @@ def test_orchestrator_worker_tpu_worker_processes(tmp_path):
 def test_full_production_shape_with_dc_gateway(tmp_path):
     """The complete deployment: a dc-gateway process owning the store, an
     orchestrator hosting the broker, a crawl worker whose pool DIALS the
-    gateway over the wire protocol (credentials minted by gen-code), and
-    a TPU worker embedding the stream — every round-4 seam composed in
-    one run."""
+    gateway over the FULL MTProto 2.0 wire (auth-key handshake, AES-IGE
+    envelope, TL API constructors; credentials minted by gen-code over
+    the same wire), and a TPU worker embedding the stream — every seam
+    composed in one run."""
     from distributed_crawler_tpu.clients.native import (
         NativeTelegramClient,
         generate_pcode,
@@ -171,6 +172,7 @@ def test_full_production_shape_with_dc_gateway(tmp_path):
              "--gateway-address-file", str(gw_addr_file),
              "--gateway-accounts", str(accounts),
              "--gateway-seed-json", f"@{seed_file}",
+             "--gateway-wire", "mtproto",
              "--storage-root", str(tmp_path / "gwstore"),
              "--log-level", "info"],
             tmp_path / "gw.log", env=_cpu_env()))
@@ -183,9 +185,12 @@ def test_full_production_shape_with_dc_gateway(tmp_path):
             "gateway never bound: " +
             (tmp_path / "gw.log").read_text(errors="replace")[-2000:])
         gw_addr = gw_addr_file.read_text()
+        gw_pubkey = str(gw_addr_file) + ".pubkey"
 
-        # Mint credentials against the live gateway (the gen-code flow).
-        boot = NativeTelegramClient(server_addr=gw_addr,
+        # Mint credentials against the live gateway (the gen-code flow),
+        # over the same encrypted wire the pool will use.
+        boot = NativeTelegramClient(server_addr=gw_addr, wire="mtproto",
+                                    server_pubkey_file=gw_pubkey,
                                     conn_id="topo-boot")
         try:
             generate_pcode(
@@ -211,7 +216,9 @@ def test_full_production_shape_with_dc_gateway(tmp_path):
         procs.append(_spawn(
             ["--mode", "worker", "--worker-id", "w1",
              "--bus-address", bus_addr, "--crawl-id", "topo2",
-             "--dc-address", gw_addr, "--tdlib-dir", str(tdlib_dir),
+             "--dc-address", gw_addr, "--dc-wire", "mtproto",
+             "--dc-pubkey-file", gw_pubkey,
+             "--tdlib-dir", str(tdlib_dir),
              "--storage-root", str(tmp_path / "wstore"),
              "--skip-media", "--infer", "--log-level", "info"],
             tmp_path / "worker.log", env=_cpu_env()))
