@@ -43,6 +43,13 @@ class TrainConfig:
     # default): keeps the router from collapsing onto one expert during
     # full fine-tuning of MoE configs.  No effect on dense models.
     moe_aux_weight: float = 0.01
+    # Gradient accumulation: split each step's batch into this many
+    # microbatches, run them through a lax.scan (ONE compiled program,
+    # static shapes — the XLA-friendly loop), average the grads, apply
+    # ONE optimizer update.  Trades step latency for effective batch
+    # sizes that exceed a chip's activation memory; composes with remat
+    # and with dp sharding (the microbatch slice keeps the dp layout).
+    grad_accum_steps: int = 1
 
 
 def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
@@ -92,9 +99,38 @@ def make_train_step(cfg: EncoderConfig, tc: TrainConfig = TrainConfig()
         acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
         return loss, (acc, aux)
 
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
     def step_fn(params, opt_state, ids, mask, labels):
-        (loss, (acc, aux)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params, ids, mask, labels)
+        a = tc.grad_accum_steps
+        if a <= 1:
+            (loss, (acc, aux)), grads = grad_fn(params, ids, mask, labels)
+        else:
+            b = ids.shape[0]
+            if b % a != 0:
+                raise ValueError(
+                    f"batch {b} not divisible by grad_accum_steps {a}")
+            m = b // a
+            micro = (ids.reshape(a, m, *ids.shape[1:]),
+                     mask.reshape(a, m, *mask.shape[1:]),
+                     labels.reshape(a, m, *labels.shape[1:]))
+
+            def body(carry, xs):
+                g_sum, l_sum, acc_sum, aux_sum = carry
+                mids, mmask, mlabels = xs
+                (mloss, (macc, maux)), g = grad_fn(params, mids, mmask,
+                                                   mlabels)
+                return (jax.tree.map(jnp.add, g_sum, g), l_sum + mloss,
+                        acc_sum + macc, aux_sum + maux), None
+
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            (g_sum, l_sum, acc_sum, aux_sum), _ = jax.lax.scan(
+                body, (zeros, jnp.float32(0), jnp.float32(0),
+                       jnp.float32(0)), micro)
+            inv = 1.0 / a
+            grads = jax.tree.map(lambda g: g * inv, g_sum)
+            loss, acc, aux = l_sum * inv, acc_sum * inv, aux_sum * inv
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, {"loss": loss, "accuracy": acc,
