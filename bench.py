@@ -148,6 +148,14 @@ def _fit_int8_static(cfg, params, ids, mask, fit):
     return fit(smodel, sparams)
 
 
+def _zipf_text(i: int, n_words: int) -> str:
+    """Zipf-ish synthetic post text: a 997-word vocabulary with per-text
+    phase — real text re-uses words (the memo helps) but no two texts are
+    identical (no all-same best case).  Shared by the serving-e2e and
+    bus-codec legs so both measure the same text distribution."""
+    return " ".join(f"w{(i * 31 + j * 7) % 997}" for j in range(n_words))
+
+
 def _chained_t_iter(model, params, ids, mask, vocab: int,
                     n_short: int, n_long: int, repeats: int,
                     label: str = "") -> float:
@@ -345,9 +353,7 @@ def _measure(scale_devices: int | None = None,
             # text re-uses words; the memo helps but isn't handed an
             # all-identical best case).  Lengths land in the same bucket.
             n_words = (seq - 2) // 2
-            texts = [" ".join(f"w{(i * 31 + j * 7) % 997}"
-                              for j in range(n_words))
-                     for i in range(batch * 4)]
+            texts = [_zipf_text(i, n_words) for i in range(batch * 4)]
             eng.run(texts[:batch])  # warm the tokenizer memo
             t0 = time.perf_counter()
             out = eng.run(texts)
@@ -527,6 +533,54 @@ def _measure_moe(batch: int = 256, seq: int = SEQ, n_experts: int = 8,
         "moe_experts": n_experts,
         "moe_capacity_factor": cfg.moe_capacity_factor,
         "moe_batch": batch,
+    }
+
+
+def _measure_bus_codec(batch: int = 256, n_batches: int = 40,
+                       text_words: int = 60) -> dict:
+    """Distributed-path codec throughput: Post -> record-batch frame
+    (zstd/gzip) -> wire bytes -> back, on the host CPU.
+
+    The reference ships crawl output through Dapr pubsub with no framing
+    of its own; this framework's gRPC bus rides `bus/codec.py` record
+    batches, so codec posts/sec is the distributed pipeline's host-side
+    ceiling per worker.  CPU-only by nature — measured on every bench run
+    (wedged chip or not) and reported next to the device rows.
+    """
+    from distributed_crawler_tpu.bus.codec import (
+        RecordBatch,
+        decode_frame,
+        default_compression,
+        encode_frame,
+    )
+    from distributed_crawler_tpu.datamodel.post import Post
+
+    # Zipf-ish DISTINCT texts per post: identical (or cross-record
+    # repeated) texts would let zstd dedup across records and report
+    # fantasy bytes/post — disjoint phase ranges keep every text unique.
+    posts = [Post(post_uid=f"p{i}", channel_id="c1",
+                  post_link=f"https://t.me/c1/{i}",
+                  description=_zipf_text(i, text_words),
+                  searchable_text=_zipf_text(i + batch, text_words))
+             for i in range(batch)]
+    rb = RecordBatch.from_posts(posts, crawl_id="bench")
+    payload = rb.to_dict()
+    comp = default_compression()
+    # Warm once (zstd context, dict caches), then time the loop.
+    buf = encode_frame(payload, comp)
+    decode_frame(buf)
+    t0 = time.perf_counter()
+    for _ in range(n_batches):
+        buf = encode_frame(payload, comp)
+        decode_frame(buf)
+    dt = time.perf_counter() - t0
+    pps = batch * n_batches / dt
+    _log(f"bus codec ({comp}): {pps:.0f} posts/sec roundtrip, "
+         f"{len(buf)} B/frame ({len(buf) / batch:.0f} B/post)")
+    return {
+        "bus_codec_posts_per_sec": round(pps, 1),
+        "bus_codec_compression": comp,
+        "bus_codec_bytes_per_post": round(len(buf) / batch, 1),
     }
 
 
@@ -850,6 +904,11 @@ def main() -> None:
                     result[k] = cached[k]
             result["moe_from_cache_measured_at"] = cached.get(
                 "moe_measured_at", cached.get("measured_at"))
+    # Host-side distributed-path ceiling: CPU-only, measured every run.
+    try:
+        result.update(_measure_bus_codec())
+    except Exception as exc:  # noqa: BLE001 — best-effort row
+        _log(f"bus codec row skipped: {exc}")
     _log("measuring dp sharding overhead on virtual CPU mesh")
     eff = _dp_sharding_overhead()
     # Work-normalized (same batch, same host cores, 1 vs 8 virtual CPU

@@ -70,6 +70,13 @@ def test_measure_moe_smoke_cpu():
     assert res["moe_batch"] == 8
 
 
+def test_measure_bus_codec_smoke():
+    res = bench._measure_bus_codec(batch=16, n_batches=3, text_words=10)
+    assert res["bus_codec_posts_per_sec"] > 0
+    assert res["bus_codec_bytes_per_post"] > 0
+    assert res["bus_codec_compression"]
+
+
 def test_probe_subprocess_emits_json():
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("AXON", "PALLAS_AXON", "TPU_"))}
