@@ -201,6 +201,14 @@ def build_parser() -> argparse.ArgumentParser:
            "projection GEMMs and saves the merged float checkpoint "
            "(use when the pretrained embedding space can't separate the "
            "classes)")
+    a("--train-scope", default=None, choices=["head", "lora", "full"],
+      help="what to train: head (frozen-encoder features, default), "
+           "lora (rank from --train-lora-rank), or full (every encoder "
+           "weight through make_train_step: AdamW+warmup+clipping, MoE "
+           "aux loss, --train-grad-accum microbatching)")
+    a("--train-grad-accum", type=int, default=None,
+      help="gradient-accumulation microbatch count for --train-scope "
+           "full (1 = off)")
     a("--train-labels", default=None,
       help='labels JSONL: {"post_uid": ..., "label": int|str} per line')
     a("--head-checkpoint", default=None,
@@ -339,6 +347,8 @@ _KEY_MAP = {
     "train_posts": "train.posts_file",
     "train_labels": "train.labels_file",
     "train_lora_rank": "train.lora_rank",
+    "train_scope": "train.scope",
+    "train_grad_accum": "train.grad_accum_steps",
     "head_checkpoint": "train.checkpoint_dir",
     "train_epochs": "train.epochs",
     "train_lr": "train.learning_rate",
@@ -1079,7 +1089,35 @@ def _run_train_head(cfg: CrawlerConfig, r: ConfigResolver) -> int:
         print(f"error: --train-lora-rank must be >= 0, got {lora_rank}",
               file=sys.stderr)
         return 2
-    if lora_rank > 0:
+    # Scope: explicit --train-scope wins; otherwise a positive lora rank
+    # implies lora (the historical behavior), else head.
+    scope = r.get_str("train.scope") or ("lora" if lora_rank > 0
+                                         else "head")
+    if scope not in ("head", "lora", "full"):
+        # The flag has argparse choices; the YAML path must reject typos
+        # too — a silent fall-through would head-train when the user
+        # asked for a full fine-tune.
+        print(f"error: train.scope must be head|lora|full, got {scope!r}",
+              file=sys.stderr)
+        return 2
+    if scope == "lora" and lora_rank <= 0:
+        print("error: --train-scope lora needs --train-lora-rank > 0",
+              file=sys.stderr)
+        return 2
+    if scope != "lora" and lora_rank > 0:
+        print(f"error: --train-lora-rank conflicts with --train-scope "
+              f"{scope}", file=sys.stderr)
+        return 2
+    grad_accum = r.get_int("train.grad_accum_steps", 1)
+    if grad_accum < 1:
+        print(f"error: --train-grad-accum must be >= 1, got {grad_accum}",
+              file=sys.stderr)
+        return 2
+    if grad_accum > 1 and scope != "full":
+        print(f"error: --train-grad-accum applies to --train-scope full "
+              f"only (scope is {scope})", file=sys.stderr)
+        return 2
+    if scope == "lora":
         from .models.lora import finetune_lora
 
         tc = TrainConfig(
@@ -1089,6 +1127,19 @@ def _run_train_head(cfg: CrawlerConfig, r: ConfigResolver) -> int:
             engine.ecfg, engine.params, token_lists, labels,
             rank=lora_rank, tc=tc, epochs=epochs,
             batch_size=min(16, max(4, len(labels))))
+    elif scope == "full":
+        from .models.train import finetune_full
+
+        batch = min(16, max(4, len(labels)))
+        # Accumulation splits each batch; keep microbatches non-empty.
+        grad_accum = min(grad_accum, batch)
+        batch -= batch % grad_accum
+        tc = TrainConfig(
+            learning_rate=r.get_float("train.learning_rate", 2e-5),
+            warmup_steps=10, grad_accum_steps=grad_accum)
+        params, history = finetune_full(
+            engine.ecfg, engine.params, token_lists, labels, tc=tc,
+            epochs=epochs, batch_size=batch)
     else:
         tc = TrainConfig(
             learning_rate=r.get_float("train.learning_rate", 1e-3),

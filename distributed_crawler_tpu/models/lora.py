@@ -28,7 +28,13 @@ import numpy as np
 import optax
 
 from .encoder import Classifier, EncoderConfig
-from .train import TrainConfig, cross_entropy, epoch_batches, make_optimizer
+from .train import (
+    TrainConfig,
+    cross_entropy,
+    epoch_batches,
+    make_optimizer,
+    prepare_finetune_arrays,
+)
 
 # Dense projection kernels that get adapters, as key paths into a layer
 # dict.  Note the flax layout: the fused QKV is a flat "qkv/kernel" leaf
@@ -158,32 +164,10 @@ def finetune_lora(ecfg: EncoderConfig, params: Any,
     (unlike `finetune_head`'s frozen-feature shortcut), so use it when the
     head alone can't separate the classes.
     """
-    if len(token_lists) != len(labels):
-        raise ValueError(f"{len(token_lists)} texts vs {len(labels)} labels")
-    if not token_lists:
-        raise ValueError("empty training set")
-    if epochs < 1:
-        raise ValueError(f"epochs must be >= 1, got {epochs}")
-    if min(labels) < 0:
-        raise ValueError(f"negative label id {min(labels)} is not a class")
     if rank < 1:
         raise ValueError(f"rank must be >= 1, got {rank}")
-    n_labels = int(max(labels)) + 1
-    if n_labels > ecfg.n_labels:
-        raise ValueError(
-            f"label id {n_labels - 1} exceeds head width {ecfg.n_labels}")
-
-    # One static [batch, L] shape for the whole run: L = longest sequence
-    # rounded up to a multiple of 32, capped at the encoder context.
-    seq = max(len(t) for t in token_lists)
-    seq = min(ecfg.max_len, max_len or ecfg.max_len, ((seq + 31) // 32) * 32)
-    ids_np = np.zeros((len(token_lists), seq), np.int32)
-    mask_np = np.zeros((len(token_lists), seq), bool)
-    for i, toks in enumerate(token_lists):
-        toks = list(toks)[:seq]
-        ids_np[i, :len(toks)] = toks
-        mask_np[i, :len(toks)] = True
-    labels_np = np.asarray(labels, np.int32)
+    ids_np, mask_np, labels_np = prepare_finetune_arrays(
+        ecfg, token_lists, labels, epochs, max_len)
 
     model = Classifier(ecfg)
     base_enc = params["params"]["encoder"]

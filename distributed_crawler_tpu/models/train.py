@@ -187,6 +187,39 @@ def encode_cls_features(ecfg: EncoderConfig, params: Any,
     return feats
 
 
+def prepare_finetune_arrays(ecfg: EncoderConfig,
+                            token_lists: Sequence[Sequence[int]],
+                            labels: Sequence[int], epochs: int,
+                            max_len: Optional[int] = None
+                            ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Shared fine-tune front door (full + LoRA loops): validate the
+    dataset, then pack tokens into ONE static ``[N, L]`` shape for the
+    whole run — L = longest sequence rounded up to a multiple of 32,
+    capped at the encoder context.  Returns ``(ids, mask, labels)``."""
+    if len(token_lists) != len(labels):
+        raise ValueError(f"{len(token_lists)} texts vs {len(labels)} labels")
+    if not token_lists:
+        raise ValueError("empty training set")
+    if epochs < 1:
+        raise ValueError(f"epochs must be >= 1, got {epochs}")
+    if min(labels) < 0:
+        raise ValueError(f"negative label id {min(labels)} is not a class")
+    n_labels = int(max(labels)) + 1
+    if n_labels > ecfg.n_labels:
+        raise ValueError(
+            f"label id {n_labels - 1} exceeds head width {ecfg.n_labels}")
+
+    seq = max(len(t) for t in token_lists)
+    seq = min(ecfg.max_len, max_len or ecfg.max_len, ((seq + 31) // 32) * 32)
+    ids_np = np.zeros((len(token_lists), seq), np.int32)
+    mask_np = np.zeros((len(token_lists), seq), bool)
+    for i, toks in enumerate(token_lists):
+        toks = list(toks)[:seq]
+        ids_np[i, :len(toks)] = toks
+        mask_np[i, :len(toks)] = True
+    return ids_np, mask_np, np.asarray(labels, np.int32)
+
+
 def epoch_batches(rng: np.random.Generator, n: int, batch_size: int):
     """Shuffled minibatch index arrays for one epoch, every batch padded to
     the static ``batch_size`` (tail batches repeat earlier rows — the
@@ -266,3 +299,48 @@ def finetune_head(ecfg: EncoderConfig, params: Any,
 
     new_params = {"params": {**params["params"], "cls_head": head_params}}
     return new_params, history
+
+
+def finetune_full(ecfg: EncoderConfig, params: Any,
+                  token_lists: Sequence[Sequence[int]],
+                  labels: Sequence[int],
+                  tc: TrainConfig = TrainConfig(warmup_steps=10),
+                  epochs: int = 10, batch_size: int = 16,
+                  seed: int = 0,
+                  max_len: Optional[int] = None
+                  ) -> Tuple[Any, List[Dict[str, float]]]:
+    """FULL fine-tune: every encoder weight plus the head, through
+    `make_train_step` (AdamW + warmup + clipping, Switch aux loss for MoE
+    configs, optional lax.scan gradient accumulation via
+    ``tc.grad_accum_steps``).  The heavyweight member of the fine-tune
+    family — `finetune_head` trains on frozen features, `lora.finetune_lora`
+    trains low-rank deltas; this one moves everything.
+
+    Returns ``(new_params, history)`` where ``new_params`` is the full
+    engine-ready pytree and ``history`` has one
+    ``{"loss", "accuracy", "moe_aux"}`` dict per epoch.
+    """
+    ids_np, mask_np, labels_np = prepare_finetune_arrays(
+        ecfg, token_lists, labels, epochs, max_len)
+
+    _, step_fn, optimizer = make_train_step(ecfg, tc)
+    train_params = params["params"]
+    opt_state = optimizer.init(train_params)
+    step = jax.jit(step_fn)
+
+    rng = np.random.default_rng(seed)
+    history: List[Dict[str, float]] = []
+    for _ in range(epochs):
+        losses, accs, auxes = [], [], []
+        for idx in epoch_batches(rng, len(token_lists), batch_size):
+            train_params, opt_state, metrics = step(
+                train_params, opt_state,
+                ids_np[idx], mask_np[idx], labels_np[idx])
+            losses.append(float(metrics["loss"]))
+            accs.append(float(metrics["accuracy"]))
+            auxes.append(float(metrics["moe_aux"]))
+        history.append({"loss": float(np.mean(losses)),
+                        "accuracy": float(np.mean(accs)),
+                        "moe_aux": float(np.mean(auxes))})
+
+    return {"params": train_params}, history
