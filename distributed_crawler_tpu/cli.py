@@ -209,6 +209,9 @@ def build_parser() -> argparse.ArgumentParser:
     a("--train-grad-accum", type=int, default=None,
       help="gradient-accumulation microbatch count for --train-scope "
            "full (1 = off)")
+    a("--train-state-dir", default=None,
+      help="--train-scope full: checkpoint params+optimizer state per "
+           "epoch here and RESUME from the newest epoch on restart")
     a("--train-labels", default=None,
       help='labels JSONL: {"post_uid": ..., "label": int|str} per line')
     a("--head-checkpoint", default=None,
@@ -349,6 +352,7 @@ _KEY_MAP = {
     "train_lora_rank": "train.lora_rank",
     "train_scope": "train.scope",
     "train_grad_accum": "train.grad_accum_steps",
+    "train_state_dir": "train.state_dir",
     "head_checkpoint": "train.checkpoint_dir",
     "train_epochs": "train.epochs",
     "train_lr": "train.learning_rate",
@@ -1117,6 +1121,11 @@ def _run_train_head(cfg: CrawlerConfig, r: ConfigResolver) -> int:
         print(f"error: --train-grad-accum applies to --train-scope full "
               f"only (scope is {scope})", file=sys.stderr)
         return 2
+    state_dir = r.get_str("train.state_dir")
+    if state_dir and scope != "full":
+        print(f"error: --train-state-dir applies to --train-scope full "
+              f"only (scope is {scope})", file=sys.stderr)
+        return 2
     if scope == "lora":
         from .models.lora import finetune_lora
 
@@ -1139,7 +1148,8 @@ def _run_train_head(cfg: CrawlerConfig, r: ConfigResolver) -> int:
             warmup_steps=10, grad_accum_steps=grad_accum)
         params, history = finetune_full(
             engine.ecfg, engine.params, token_lists, labels, tc=tc,
-            epochs=epochs, batch_size=batch)
+            epochs=epochs, batch_size=batch,
+            state_dir=state_dir or None)
     else:
         tc = TrainConfig(
             learning_rate=r.get_float("train.learning_rate", 1e-3),

@@ -32,17 +32,85 @@ def load_params(path: str, like: Optional[Any] = None) -> Any:
         return ckptr.restore(os.path.abspath(path))
 
 
-def latest_step_dir(root: str) -> Optional[str]:
-    """Newest step_N subdirectory under a checkpoint root, or None."""
+def _indexed_dirs(root: str, prefix: str) -> list:
+    """All ``{prefix}N`` subdirectories of ``root`` as (N, path), sorted."""
     if not os.path.isdir(root):
-        return None
-    steps = []
+        return []
+    out = []
     for name in os.listdir(root):
-        if name.startswith("step_"):
+        if name.startswith(prefix):
             try:
-                steps.append((int(name.split("_", 1)[1]), name))
+                out.append((int(name[len(prefix):]),
+                            os.path.join(root, name)))
             except ValueError:
                 continue
-    if not steps:
-        return None
-    return os.path.join(root, max(steps)[1])
+    return sorted(out)
+
+
+def latest_step_dir(root: str) -> Optional[str]:
+    """Newest step_N subdirectory under a checkpoint root, or None."""
+    dirs = _indexed_dirs(root, "step_")
+    return dirs[-1][1] if dirs else None
+
+
+def save_train_state(root: str, epoch: int, params: Any, opt_state: Any,
+                     history: Any) -> str:
+    """Persist a full TRAINING state (params + optimizer state + history)
+    as ``{root}/epoch_N`` — what a resumable fine-tune needs beyond the
+    serving checkpoint's bare params.  Returns the written directory.
+
+    ``history.json`` is written LAST and doubles as the completion
+    marker: a crash mid-save leaves a dir `latest_train_state` skips.
+    Older complete epochs are pruned after a successful save (only the
+    newest is ever read; a 10-epoch encoder fine-tune would otherwise
+    hold 10 copies of params + AdamW moments)."""
+    import json
+    import shutil
+
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(os.path.join(root, f"epoch_{epoch}"))
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, {"params": params, "opt_state": opt_state},
+                   force=True)
+    # History is tiny host-side JSON; sidecar file keeps the orbax tree
+    # purely numeric.
+    tmp = os.path.join(path, "history.json.tmp")
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"epoch": epoch, "history": history}, f)
+    os.replace(tmp, os.path.join(path, "history.json"))
+    for n, older in _indexed_dirs(os.path.abspath(root), "epoch_"):
+        if n < epoch:
+            shutil.rmtree(older, ignore_errors=True)
+    return path
+
+
+def latest_train_state(root: str) -> Optional[str]:
+    """Newest COMPLETE epoch_N directory under a train-state root, or
+    None.  Dirs without the history.json completion marker (a crash
+    between the orbax commit and the marker write) are skipped, falling
+    back to the previous complete epoch."""
+    for _, path in reversed(_indexed_dirs(root, "epoch_")):
+        if os.path.exists(os.path.join(path, "history.json")):
+            return path
+    return None
+
+
+def load_train_state(path: str, like_params: Any, like_opt_state: Any
+                     ) -> tuple:
+    """Restore ``(epoch, params, opt_state, history)`` from an epoch dir
+    written by `save_train_state`; the ``like_*`` trees drive structure/
+    dtype restoration (optax states are namedtuple pytrees orbax cannot
+    rebuild without a donor)."""
+    import json
+
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        tree = ckptr.restore(os.path.abspath(path),
+                             {"params": like_params,
+                              "opt_state": like_opt_state})
+    with open(os.path.join(path, "history.json"), encoding="utf-8") as f:
+        meta = json.load(f)
+    return (int(meta["epoch"]), tree["params"], tree["opt_state"],
+            list(meta["history"]))

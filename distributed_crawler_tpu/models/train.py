@@ -307,7 +307,8 @@ def finetune_full(ecfg: EncoderConfig, params: Any,
                   tc: TrainConfig = TrainConfig(warmup_steps=10),
                   epochs: int = 10, batch_size: int = 16,
                   seed: int = 0,
-                  max_len: Optional[int] = None
+                  max_len: Optional[int] = None,
+                  state_dir: Optional[str] = None
                   ) -> Tuple[Any, List[Dict[str, float]]]:
     """FULL fine-tune: every encoder weight plus the head, through
     `make_train_step` (AdamW + warmup + clipping, Switch aux loss for MoE
@@ -315,6 +316,12 @@ def finetune_full(ecfg: EncoderConfig, params: Any,
     ``tc.grad_accum_steps``).  The heavyweight member of the fine-tune
     family — `finetune_head` trains on frozen features, `lora.finetune_lora`
     trains low-rank deltas; this one moves everything.
+
+    ``state_dir`` makes the run RESUMABLE at epoch granularity: params +
+    optimizer state + history checkpoint to ``{state_dir}/epoch_N`` after
+    every epoch, and a restart picks up from the newest one.  Per-epoch
+    rng seeding (``seed + epoch``) keeps the batch order identical to an
+    uninterrupted run, so resume reproduces it exactly.
 
     Returns ``(new_params, history)`` where ``new_params`` is the full
     engine-ready pytree and ``history`` has one
@@ -328,9 +335,27 @@ def finetune_full(ecfg: EncoderConfig, params: Any,
     opt_state = optimizer.init(train_params)
     step = jax.jit(step_fn)
 
-    rng = np.random.default_rng(seed)
+    start_epoch = 0
     history: List[Dict[str, float]] = []
-    for _ in range(epochs):
+    if state_dir:
+        from ..inference.checkpoint import (
+            latest_train_state,
+            load_train_state,
+        )
+
+        prior = latest_train_state(state_dir)
+        if prior is not None:
+            done_epoch, train_params, opt_state, history = \
+                load_train_state(prior, train_params, opt_state)
+            start_epoch = done_epoch + 1
+            if start_epoch > epochs:
+                raise ValueError(
+                    f"state_dir holds {start_epoch} completed epochs but "
+                    f"only {epochs} were requested — raise epochs to "
+                    f"continue or point state_dir elsewhere")
+
+    for epoch in range(start_epoch, epochs):
+        rng = np.random.default_rng(seed + epoch)
         losses, accs, auxes = [], [], []
         for idx in epoch_batches(rng, len(token_lists), batch_size):
             train_params, opt_state, metrics = step(
@@ -342,5 +367,10 @@ def finetune_full(ecfg: EncoderConfig, params: Any,
         history.append({"loss": float(np.mean(losses)),
                         "accuracy": float(np.mean(accs)),
                         "moe_aux": float(np.mean(auxes))})
+        if state_dir:
+            from ..inference.checkpoint import save_train_state
+
+            save_train_state(state_dir, epoch, train_params, opt_state,
+                             history)
 
     return {"params": train_params}, history

@@ -454,3 +454,114 @@ class TestFullFinetune:
         assert main(base + ["--train-grad-accum", "0"]) == 2
         # Accumulation outside scope=full is an error, not a silent no-op.
         assert main(base + ["--train-grad-accum", "2"]) == 2
+
+
+class TestFullFinetuneResume:
+    """state_dir checkpoint/resume: a run killed mid-way and restarted
+    must reproduce the uninterrupted run exactly (per-epoch rng seeding
+    keeps batch order identical)."""
+
+    def test_resume_matches_uninterrupted(self, tmp_path):
+        import jax
+
+        from distributed_crawler_tpu.models.train import (
+            TrainConfig,
+            finetune_full,
+        )
+
+        eng = _tiny_engine(n_labels=2)
+        texts, labels = _dataset(n_per_class=12)
+        toks = eng.tokenizer.encode_batch(texts)
+        tc = TrainConfig(learning_rate=5e-4, warmup_steps=3)
+
+        # One-shot reference: 4 epochs, no state dir.
+        ref_params, ref_hist = finetune_full(
+            eng.ecfg, eng.params, toks, labels, tc=tc,
+            epochs=4, batch_size=8)
+
+        # Interrupted run: 2 epochs checkpointed, then "restart" asking
+        # for 4 — must resume at epoch 2, not retrain from scratch.
+        sd = str(tmp_path / "state")
+        finetune_full(eng.ecfg, eng.params, toks, labels, tc=tc,
+                      epochs=2, batch_size=8, state_dir=sd)
+        resumed_params, resumed_hist = finetune_full(
+            eng.ecfg, eng.params, toks, labels, tc=tc,
+            epochs=4, batch_size=8, state_dir=sd)
+
+        assert len(resumed_hist) == 4
+        for a, b in zip(ref_hist, resumed_hist):
+            assert np.isclose(a["loss"], b["loss"], atol=1e-6), (a, b)
+        for x, y in zip(jax.tree_util.tree_leaves(ref_params),
+                        jax.tree_util.tree_leaves(resumed_params)):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       atol=1e-6, rtol=1e-5)
+
+    def test_completed_run_is_a_noop_on_restart(self, tmp_path):
+        from distributed_crawler_tpu.models.train import (
+            TrainConfig,
+            finetune_full,
+        )
+
+        eng = _tiny_engine(n_labels=2)
+        texts, labels = _dataset(n_per_class=8)
+        toks = eng.tokenizer.encode_batch(texts)
+        sd = str(tmp_path / "state")
+        tc = TrainConfig(learning_rate=5e-4, warmup_steps=3)
+        _, h1 = finetune_full(eng.ecfg, eng.params, toks, labels, tc=tc,
+                              epochs=2, batch_size=8, state_dir=sd)
+        _, h2 = finetune_full(eng.ecfg, eng.params, toks, labels, tc=tc,
+                              epochs=2, batch_size=8, state_dir=sd)
+        assert h2 == h1  # restored history, zero additional epochs
+
+    def test_incomplete_checkpoint_skipped_and_pruning(self, tmp_path):
+        """A crash between the orbax commit and the completion marker must
+        not wedge resume: the incomplete dir is skipped in favor of the
+        previous complete epoch.  Also: older complete epochs are pruned
+        (only the newest is ever read)."""
+        import os
+
+        from distributed_crawler_tpu.inference.checkpoint import (
+            latest_train_state,
+        )
+        from distributed_crawler_tpu.models.train import (
+            TrainConfig,
+            finetune_full,
+        )
+
+        eng = _tiny_engine(n_labels=2)
+        texts, labels = _dataset(n_per_class=8)
+        toks = eng.tokenizer.encode_batch(texts)
+        sd = str(tmp_path / "state")
+        finetune_full(eng.ecfg, eng.params, toks, labels,
+                      tc=TrainConfig(learning_rate=5e-4, warmup_steps=3),
+                      epochs=2, batch_size=8, state_dir=sd)
+        # Pruning: only the newest epoch dir remains.
+        assert sorted(d for d in os.listdir(sd)
+                      if d.startswith("epoch_")) == ["epoch_1"]
+        # Emulate a crash: epoch_5 exists but has no completion marker.
+        os.makedirs(os.path.join(sd, "epoch_5"))
+        assert latest_train_state(sd).endswith("epoch_1")
+        # Asking for fewer epochs than are already done is an error, not
+        # a silent longer-trained model.
+        with pytest.raises(ValueError, match="completed epochs"):
+            finetune_full(eng.ecfg, eng.params, toks, labels,
+                          tc=TrainConfig(learning_rate=5e-4,
+                                         warmup_steps=3),
+                          epochs=1, batch_size=8, state_dir=sd)
+
+    def test_cli_state_dir_requires_full_scope(self, tmp_path):
+        from distributed_crawler_tpu.cli import main
+
+        posts = tmp_path / "posts.jsonl"
+        posts.write_text(json.dumps(
+            {"post_uid": "p0", "all_text": "alpha beta"}) + "\n")
+        labels_file = tmp_path / "labels.jsonl"
+        labels_file.write_text(json.dumps(
+            {"post_uid": "p0", "label": 0}) + "\n")
+        rc = main(["--mode", "train-head", "--infer-model", "tiny",
+                   "--train-posts", str(posts),
+                   "--train-labels", str(labels_file),
+                   "--head-checkpoint", str(tmp_path / "ckpt"),
+                   "--train-state-dir", str(tmp_path / "state"),
+                   "--storage-root", str(tmp_path / "store")])
+        assert rc == 2
