@@ -16,11 +16,8 @@ server side of that seam.
 from __future__ import annotations
 
 from .dc_gateway import (  # noqa: F401  (re-exported test helpers)
-    MAX_FRAME,
     DcGateway,
     make_self_signed_cert,
-    recv_frame,
-    send_frame,
 )
 
 

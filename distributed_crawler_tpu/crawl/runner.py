@@ -18,7 +18,7 @@ import dataclasses
 import logging
 import random
 import threading
-from typing import Callable, Dict, List, Optional, Protocol, Set, Tuple
+from typing import Callable, Dict, List, Optional, Protocol, Set
 
 from ..clients.errors import FLOOD_WAIT_RETIRE_THRESHOLD_S
 from ..clients.pool import ConnectionPool, PooledConnection, PoolEmptyError

@@ -21,7 +21,7 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
-from ..datamodel import ChannelData, EngagementData, NullValidator, Post
+from ..datamodel import ChannelData, EngagementData, Post
 from ..datamodel.post import MediaData, OCRData, PerformanceScores
 from ..datamodel.youtube import YouTubeChannel, YouTubeVideo
 from ..state.datamodels import utcnow

@@ -19,7 +19,6 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Optional
 
 from ..bus.codec import BatchAccumulator, RecordBatch
 from ..bus.messages import TOPIC_INFERENCE_BATCHES

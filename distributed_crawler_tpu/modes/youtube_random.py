@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Callable, Optional, Tuple
+from typing import Optional, Tuple
 
 from ..clients.youtube import YouTubeDataClient, YouTubeTransport
 from ..config.crawler import CrawlerConfig

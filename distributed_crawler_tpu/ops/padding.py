@@ -13,7 +13,7 @@ of 8 (f32) / 16 (bf16) sublanes avoid relayout, hence the power-of-two grid.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Sequence, Tuple
 
 import numpy as np

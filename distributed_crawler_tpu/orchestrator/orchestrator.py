@@ -24,7 +24,6 @@ from datetime import datetime
 from typing import Any, Dict, List, Optional
 
 from ..bus.messages import (
-    MSG_WORK_ITEM,
     PRIORITY_HIGH,
     PRIORITY_MEDIUM,
     STATUS_SUCCESS,

@@ -15,7 +15,7 @@ import os
 import shutil
 import threading
 from datetime import datetime
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Tuple
 
 from ..datamodel import ChannelData, Post
 from .datamodels import (

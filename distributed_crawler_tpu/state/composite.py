@@ -25,8 +25,7 @@ import threading
 from datetime import datetime
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..datamodel import ChannelData, Post
-from .base import BaseStateManager
+from ..datamodel import ChannelData
 from .datamodels import (
     EdgeRecord,
     Page,
@@ -35,12 +34,10 @@ from .datamodels import (
     PendingEdgeUpdate,
     State,
     new_id,
-    utcnow,
 )
 from .interface import StateConfig
 from .local import LocalStateManager
-from .media_cache import ShardedMediaCache
-from .providers import LocalStorageProvider, StorageProvider
+from .providers import StorageProvider
 from .sqlstore import SqlGraphStore, SqliteBinding
 
 logger = logging.getLogger("dct.state.composite")

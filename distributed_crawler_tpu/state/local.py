@@ -15,11 +15,11 @@ from __future__ import annotations
 
 import json
 import logging
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, List, Optional, Tuple
 
 from ..datamodel import Post
 from .base import BaseStateManager
-from .datamodels import Page, State, new_id, utcnow
+from .datamodels import Page, State, utcnow
 from .interface import StateConfig
 from .media_cache import ShardedMediaCache
 from .providers import LocalStorageProvider, StorageProvider

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import threading
 from datetime import timedelta
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from .datamodels import MediaCacheItem, utcnow
 from .providers import StorageProvider

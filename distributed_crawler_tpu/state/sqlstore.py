@@ -21,16 +21,11 @@ import os
 import sqlite3
 import threading
 from datetime import datetime, timedelta
-from typing import Any, Dict, Iterable, List, Optional, Protocol, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Protocol, Sequence, Tuple
 
 from ..datamodel.post import format_time, parse_time
 from .datamodels import (
-    BATCH_CLOSED,
-    BATCH_COMPLETED,
     BATCH_OPEN,
-    BATCH_PROCESSING,
-    EDGE_PENDING,
-    EDGE_VALIDATING,
     EdgeRecord,
     Page,
     PendingEdge,
