@@ -549,6 +549,60 @@ class TestFullFinetuneResume:
                                          warmup_steps=3),
                           epochs=1, batch_size=8, state_dir=sd)
 
+    def test_restore_into_sharded_training(self, tmp_path):
+        """Elastic-topology restart: a train state saved from unsharded
+        single-process training restores into dp-sharded training on the
+        8-device mesh (orbax handles the relayout) and the step runs."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from distributed_crawler_tpu.inference.checkpoint import (
+            latest_train_state,
+            load_train_state,
+        )
+        from distributed_crawler_tpu.models.encoder import TINY_TEST
+        from distributed_crawler_tpu.models.train import (
+            TrainConfig,
+            finetune_full,
+            make_train_step,
+        )
+        from distributed_crawler_tpu.parallel import (
+            best_mesh_config, make_mesh, shard_batch, shard_params,
+        )
+        from dataclasses import replace
+
+        eng = _tiny_engine(n_labels=2)
+        texts, labels = _dataset(n_per_class=8)
+        toks = eng.tokenizer.encode_batch(texts)
+        sd = str(tmp_path / "state")
+        tc = TrainConfig(learning_rate=5e-4, warmup_steps=3)
+        finetune_full(eng.ecfg, eng.params, toks, labels, tc=tc,
+                      epochs=1, batch_size=8, state_dir=sd)
+
+        cfg = replace(TINY_TEST, n_labels=2)
+        init_fn, step_fn, optimizer = make_train_step(cfg, tc)
+        batch = 16
+        ids = jnp.zeros((batch, 16), jnp.int32)
+        mask = jnp.ones((batch, 16), jnp.bool_)
+        lab = jnp.asarray(np.arange(batch) % 2, jnp.int32)
+        params, opt_state = init_fn(jax.random.PRNGKey(0), ids, mask)
+        _, params, opt_state, _hist = load_train_state(
+            latest_train_state(sd), params, opt_state)
+
+        mesh = make_mesh(best_mesh_config(8))
+        params = shard_params(params, mesh)
+        # Optimizer moments follow the params' mesh; replicating is the
+        # simplest valid layout for the tiny test (XLA reshards in-step).
+        opt_state = jax.tree.map(
+            lambda x: jax.device_put(x, NamedSharding(mesh, P())),
+            opt_state)
+        placed = shard_batch({"ids": ids, "mask": mask}, mesh)
+        lab = jax.device_put(lab, NamedSharding(mesh, P("dp")))
+        _, _, metrics = jax.jit(step_fn)(
+            params, opt_state, placed["ids"], placed["mask"], lab)
+        assert np.isfinite(float(metrics["loss"]))
+
     def test_cli_state_dir_requires_full_scope(self, tmp_path):
         from distributed_crawler_tpu.cli import main
 
