@@ -642,6 +642,74 @@ class TestFuzz:
             with pytest.raises(ValueError):
                 sess.decrypt(blob)
 
+    def test_live_gateway_survives_tl_garbage_after_handshake(self,
+                                                              tmp_path):
+        """An AUTHENTICATED-transport attacker (valid auth-key handshake,
+        then validly-encrypted garbage TL frames) must cost only their own
+        connection: the session thread catches the codec's ValueError,
+        drops the connection, and the gateway keeps serving others."""
+        import random
+        import socket as socket_mod
+        import struct as struct_mod
+
+        from distributed_crawler_tpu.clients.dc_gateway import DcGateway
+        from distributed_crawler_tpu.clients.mtproto_wire import (
+            Transport as WireTransport,
+        )
+        from distributed_crawler_tpu.clients.mtproto_wire import (
+            client_handshake,
+            load_pubkey,
+        )
+        from distributed_crawler_tpu.clients.tl_api import BY_NAME
+
+        gw = DcGateway(seed_json=SEED, expected_code="13579",
+                       wire="mtproto", store_root=str(tmp_path)).start()
+        rnd = random.Random(0xD00D)
+        try:
+            pub = load_pubkey(gw.pubkey_file)
+            host, port = gw.address.rsplit(":", 1)
+            cases = []
+            # Truncations of a real typed function at several cut points,
+            # an unknown constructor id, and pure noise.
+            whole = struct_mod.pack(
+                "<I", BY_NAME["dct.getChat"].cid) + b"\x01\x02"
+            cases += [whole[:n] for n in (4, 5)]
+            cases.append(struct_mod.pack("<I", 0xDEADBEEF))
+            cases += [bytes(rnd.getrandbits(8) for _ in range(n))
+                      for n in (0, 3, 17, 64)]
+            for payload in cases:
+                s = socket_mod.create_connection((host, int(port)), 5)
+                try:
+                    transport = WireTransport(s, is_server=False)
+                    sess = client_handshake(transport, pub)
+                    transport.send(sess.encrypt(payload))
+                    # The gateway drops us (clean close or reset) without
+                    # dying; either is a pass as long as it ANSWERS the
+                    # next handshake below.
+                    s.settimeout(5)
+                    try:
+                        s.recv(64)
+                    except (socket_mod.timeout, OSError):
+                        pass
+                finally:
+                    s.close()
+            # The gateway is still alive and serves a well-behaved client.
+            from distributed_crawler_tpu.clients.native import (
+                NativeTelegramClient,
+            )
+
+            c = NativeTelegramClient(server_addr=gw.address, wire="mtproto",
+                                     server_pubkey_file=gw.pubkey_file,
+                                     conn_id="post-fuzz")
+            try:
+                c.authenticate("+15550001111", "13579")
+                c.wait_ready(5.0)
+                assert c.search_public_chat("mtroot").id == 4242
+            finally:
+                c.close()
+        finally:
+            gw.close()
+
     def test_transport_oversized_and_truncated(self):
         import struct as struct_mod
 
