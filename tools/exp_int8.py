@@ -119,6 +119,36 @@ def main():
                           "posts_per_sec": round(batch / ts, 1),
                           "speedup_vs_bf16": round(ti / ts, 3)}),
               flush=True)
+        if name == "xlmr_base" and not smoke:
+            # Combo cell: every lever at once at the BASELINE config #3
+            # width — int8_static + Pallas flash + double batch.  If any
+            # config beats bf16 here, this is the one; measured against
+            # its own bf16-flash-b512 base so the ratio isolates quant.
+            try:
+                big = jnp.concatenate([ids, ids], axis=0)
+                bigm = jnp.ones_like(big, dtype=jnp.bool_)
+                fcfg = replace(cfg, attention="flash")
+                fmodel = EmbedderClassifier(fcfg)
+                tf = t_iter_chained(fmodel, params, big, bigm, VOCAB)
+                print(json.dumps({
+                    "cfg": "xlmr_combo", "quant": "bf16+flash",
+                    "batch": 2 * batch,
+                    "t_iter_ms": round(tf * 1e3, 2),
+                    "posts_per_sec": round(2 * batch / tf, 1)}),
+                    flush=True)
+                tc = _fit_int8_static(
+                    fcfg, params, big, bigm,
+                    lambda m, p: t_iter_chained(m, p, big, bigm, VOCAB))
+                print(json.dumps({
+                    "cfg": "xlmr_combo", "quant": "int8_static+flash",
+                    "batch": 2 * batch,
+                    "t_iter_ms": round(tc * 1e3, 2),
+                    "posts_per_sec": round(2 * batch / tc, 1),
+                    "speedup_vs_bf16_flash": round(tf / tc, 3)}),
+                    flush=True)
+            except Exception as e:  # noqa: BLE001 — keep sweeping
+                print(json.dumps({"cfg": "xlmr_combo",
+                                  "error": str(e)[:300]}), flush=True)
 
 
 if __name__ == "__main__":
