@@ -94,6 +94,38 @@ class TestHashingTokenizer:
             assert tok.encode(s) == reference(s), repr(s)
             assert tok.encode(s) == reference(s), f"warm path: {s!r}"
 
+    def test_token_memo_equivalence_property(self):
+        """Property form of the equivalence: arbitrary unicode (exotic
+        whitespace, astral chars, control chars) must tokenize identically
+        on the memoized fast path and the whole-text regex."""
+        hypothesis = pytest.importorskip("hypothesis")
+        import re
+        import unicodedata
+
+        from hypothesis import given, settings
+        from hypothesis import strategies as st
+
+        word_re = re.compile(r"\w+|[^\w\s]", re.UNICODE)
+        tok = HashingTokenizer(50_000, max_word_len=5)
+
+        def reference(text):
+            text = unicodedata.normalize("NFKC", text or "").lower()
+            ids = [CLS_ID]
+            for w in word_re.findall(text):
+                if len(w) <= tok.max_word_len:
+                    ids.append(tok._fnv_id(w))
+                else:
+                    ids += [tok._fnv_id(w[i:i + tok.max_word_len])
+                            for i in range(0, len(w), tok.max_word_len)]
+            return ids + [SEP_ID]
+
+        @settings(max_examples=500, deadline=None)
+        @given(st.text(max_size=80))
+        def check(s):
+            assert tok.encode(s) == reference(s)
+
+        check()
+
 
 def _engine(registry=None, **kw):
     cfg = EngineConfig(model="tiny", n_labels=3, batch_size=4,
