@@ -633,6 +633,23 @@ class MtprotoConnection {
       : MtprotoConnection(std::move(stream),
                           std::vector<RsaPub>{server_key}) {}
 
+  // Session-material seam: a connection with CALLER-SUPPLIED key/salt/id,
+  // skipping the network handshake — lets the sanitizer stress harness
+  // drive the concurrent encrypt+send path (the msg_id-ordering lock)
+  // against a peer that only drains bytes.
+  MtprotoConnection(std::unique_ptr<dctnet::Stream> stream,
+                    Bytes auth_key, Bytes server_salt, Bytes session_id)
+      : stream_(std::move(stream)), transport_(stream_.get()),
+        auth_key_(std::move(auth_key)),
+        server_salt_(std::move(server_salt)),
+        session_id_(std::move(session_id)) {
+    if (auth_key_.size() != 256)
+      throw MtprotoError("auth_key must be 256 bytes");
+    if (server_salt_.size() != 8 || session_id_.size() != 8)
+      throw MtprotoError("salt/session_id must be 8 bytes");
+    auth_key_id_ = sha1(auth_key_).substr(12, 8);
+  }
+
   // Send one raw TL payload (a tl_api.h constructor frame); returns the
   // MTProto msg_id assigned to it — the rpc_result correlation handle.
   // One lock across msg_id assignment + encryption + the wire write:
