@@ -440,6 +440,44 @@ class TestCppClientAgainstPythonGateway:
         finally:
             gw.close()
 
+    def test_file_lifecycle_over_typed_tl(self, tmp_path):
+        """The dct.file constructor family (getRemoteFile/downloadFile —
+        the media path transcription consumes) round-trips over the
+        encrypted wire as TYPED TL, server-side store materializing the
+        download."""
+        import os
+
+        from distributed_crawler_tpu.clients import tl_api
+        from distributed_crawler_tpu.clients.dc_gateway import DcGateway
+        from distributed_crawler_tpu.clients.native import (
+            NativeTelegramClient,
+        )
+
+        seed_with_file = json.loads(SEED)
+        seed_with_file["files"] = [{"remote_id": "media-1", "size": 256}]
+        before = dict(tl_api.STATS)
+        gw = DcGateway(seed_json=json.dumps(seed_with_file),
+                       expected_code="13579", wire="mtproto",
+                       store_root=str(tmp_path)).start()
+        try:
+            c = NativeTelegramClient(server_addr=gw.address, wire="mtproto",
+                                     server_pubkey_file=gw.pubkey_file,
+                                     conn_id="mt-file")
+            try:
+                c.authenticate("+15550001111", "13579")
+                c.wait_ready(5.0)
+                f = c.get_remote_file("media-1")
+                assert not f.downloaded
+                got = c.download_file(f.id)
+                assert got.downloaded and got.local_path
+                assert os.path.exists(got.local_path)  # same host
+            finally:
+                c.close()
+        finally:
+            gw.close()
+        # Both file RPCs rode typed constructors, not the raw fallback.
+        assert tl_api.STATS["typed_requests"] - before["typed_requests"] >= 2
+
     def test_persistent_rsa_key_across_restart(self, tmp_path):
         from distributed_crawler_tpu.clients.dc_gateway import DcGateway
         from distributed_crawler_tpu.clients.mtproto_wire import load_pubkey
