@@ -584,6 +584,29 @@ def _measure_bus_codec(batch: int = 256, n_batches: int = 40,
     }
 
 
+def _measure_tokenizer(batch: int = 1024, text_words: int = 63,
+                       trials: int = 4) -> dict:
+    """Host-side tokenize throughput: the serving pipeline's text-in front
+    door (`inference/tokenizer.py`), warm memo, Zipf-varied texts — the
+    rate the host must sustain so text-in serving doesn't bottleneck
+    before the chip does.  CPU-only by nature; measured on every run."""
+    from distributed_crawler_tpu.inference.tokenizer import HashingTokenizer
+
+    tok = HashingTokenizer(vocab_size=250037)
+    texts = [_zipf_text(i, text_words) for i in range(batch)]
+    tok.encode_batch(texts)  # warm the memo
+    best = 0.0
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        out = tok.encode_batch(texts)
+        dt = time.perf_counter() - t0
+        best = max(best, len(out) / dt)
+    _log(f"tokenizer: {best:.0f} posts/sec warm "
+         f"({text_words}-word Zipf posts)")
+    return {"tokenizer_posts_per_sec": round(best, 1),
+            "tokenizer_text_words": text_words}
+
+
 def _measure_asr(batch: int = 8, decode_len: int = 48,
                  samples: int = 5, model_cfg=None) -> dict:
     """BASELINE config #4: Whisper ASR throughput on the default backend.
@@ -904,11 +927,16 @@ def main() -> None:
                     result[k] = cached[k]
             result["moe_from_cache_measured_at"] = cached.get(
                 "moe_measured_at", cached.get("measured_at"))
-    # Host-side distributed-path ceiling: CPU-only, measured every run.
+    # Host-side rows (CPU-only by nature, measured every run): the
+    # distributed-path codec ceiling and the text-in tokenize rate.
     try:
         result.update(_measure_bus_codec())
     except Exception as exc:  # noqa: BLE001 — best-effort row
         _log(f"bus codec row skipped: {exc}")
+    try:
+        result.update(_measure_tokenizer())
+    except Exception as exc:  # noqa: BLE001 — best-effort row
+        _log(f"tokenizer row skipped: {exc}")
     _log("measuring dp sharding overhead on virtual CPU mesh")
     eff = _dp_sharding_overhead()
     # Work-normalized (same batch, same host cores, 1 vs 8 virtual CPU

@@ -77,6 +77,12 @@ def test_measure_bus_codec_smoke():
     assert res["bus_codec_compression"]
 
 
+def test_measure_tokenizer_smoke():
+    res = bench._measure_tokenizer(batch=32, text_words=8, trials=1)
+    assert res["tokenizer_posts_per_sec"] > 0
+    assert res["tokenizer_text_words"] == 8
+
+
 def test_probe_subprocess_emits_json():
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("AXON", "PALLAS_AXON", "TPU_"))}
