@@ -584,6 +584,60 @@ def _measure_bus_codec(batch: int = 256, n_batches: int = 40,
     }
 
 
+def _measure_padding_efficiency(n_texts: int = 2048, batch: int = 256,
+                                max_segments: int = 8) -> dict:
+    """Padding efficiency: real tokens / total slot tokens, packed vs
+    unpacked, on a Zipf-LENGTH workload (most posts far below their
+    bucket — the distribution the tentpole attacks).
+
+    Pure host arithmetic over the REAL packer (`ops/padding.pack_rows`)
+    and the real bucket ladder, so the row lands on every run (wedged chip
+    or not).  Slot tokens = bucket rows x bucket length, at the coalesced
+    steady state (`worker.coalesce_batches` keeps the row stream full, so
+    partial final device batches amortize to nothing and are excluded —
+    they would charge both modes the same constant); the gain is the
+    fraction of MXU/HBM work `run_tokenized(..., pack=True)` stops
+    spending on pad tokens.
+    """
+    import numpy as np
+
+    from distributed_crawler_tpu.inference.tokenizer import HashingTokenizer
+    from distributed_crawler_tpu.ops.padding import (
+        BucketSpec,
+        bucket_for,
+        pack_rows,
+    )
+
+    rng = np.random.default_rng(0)
+    # Zipf-ish post lengths in words (mean ~12, long tail to the ladder's
+    # reach) — the reference's crawl stream is short-message-dominated.
+    words = np.minimum(rng.zipf(1.7, size=n_texts), 500)
+    tok = HashingTokenizer(vocab_size=250037)
+    toks = tok.encode_batch([_zipf_text(i, int(w))
+                             for i, w in enumerate(words)])
+    spec = BucketSpec()
+    groups: dict = {}
+    for i, t in enumerate(toks):
+        groups.setdefault(bucket_for(len(t), spec), []).append(i)
+    real = unpacked_slots = packed_slots = 0
+    for bucket, idx in sorted(groups.items()):
+        real += sum(min(len(toks[i]), bucket) for i in idx)
+        packed = pack_rows([toks[i] for i in idx], bucket,
+                           max_segments=max_segments)
+        unpacked_slots += len(idx) * bucket
+        packed_slots += packed.n_rows * bucket
+    d_unpacked = real / unpacked_slots
+    d_packed = real / packed_slots
+    _log(f"padding efficiency: unpacked {d_unpacked:.3f}, "
+         f"packed {d_packed:.3f} ({d_packed / d_unpacked:.2f}x density)")
+    return {
+        "padding_density_unpacked": round(d_unpacked, 4),
+        "padding_density_packed": round(d_packed, 4),
+        "padding_packed_density_gain": round(d_packed / d_unpacked, 2),
+        "padding_pack_max_segments": max_segments,
+    }
+
+
 def _measure_tokenizer(batch: int = 1024, text_words: int = 63,
                        trials: int = 4) -> dict:
     """Host-side tokenize throughput: the serving pipeline's text-in front
@@ -937,6 +991,10 @@ def main() -> None:
         result.update(_measure_tokenizer())
     except Exception as exc:  # noqa: BLE001 — best-effort row
         _log(f"tokenizer row skipped: {exc}")
+    try:
+        result.update(_measure_padding_efficiency())
+    except Exception as exc:  # noqa: BLE001 — best-effort row
+        _log(f"padding efficiency row skipped: {exc}")
     _log("measuring dp sharding overhead on virtual CPU mesh")
     eff = _dp_sharding_overhead()
     # Work-normalized (same batch, same host cores, 1 vs 8 virtual CPU

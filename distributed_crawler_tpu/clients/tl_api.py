@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import json
 import struct
+import threading
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -202,6 +203,10 @@ def _deserialize_fields(c: Constructor, r: TlReader) -> Dict[str, Any]:
             if r.uint32() != VECTOR:
                 raise ValueError("expected Vector")
             n = _r_i32(r)
+            if n < 0:
+                # A forged negative count must fail loudly, not parse as an
+                # empty vector and leave the element bytes as garbage.
+                raise ValueError(f"negative TL vector count {n}")
             items = []
             for _ in range(n):
                 cid = r.uint32()
@@ -228,7 +233,24 @@ def serialize_request(req: Dict[str, Any]) -> bytes:
 
 # Observability: how much of the traffic rides typed constructors vs the
 # declared raw fallback (tests assert the hot RPCs are TYPED on the wire).
+# Guarded by a lock: concurrent gateway sessions share this dict, and the
+# bare read-modify-write undercounts under contention.
 STATS = {"typed_requests": 0, "raw_requests": 0}
+_STATS_LOCK = threading.Lock()
+
+
+def _count(key: str) -> None:
+    with _STATS_LOCK:
+        STATS[key] += 1
+
+
+def _expect_consumed(r: TlReader) -> None:
+    """A well-formed frame is EXACTLY its constructor: trailing bytes mean
+    a forged or corrupted frame and must raise (ValueError is the class
+    the gateway session loop catches), never parse silently."""
+    if r.off != len(r.data):
+        raise ValueError(
+            f"{len(r.data) - r.off} trailing bytes after TL frame")
 
 
 def deserialize_request(data: bytes) -> Dict[str, Any]:
@@ -239,10 +261,11 @@ def deserialize_request(data: bytes) -> Dict[str, Any]:
     if c is None or not c.is_function:
         raise ValueError(f"unknown TL function {cid:#x}")
     obj = _deserialize_fields(c, r)
+    _expect_consumed(r)
     if c.name == "dct.rawRequest":
-        STATS["raw_requests"] += 1
+        _count("raw_requests")
         return json.loads(obj["data"])
-    STATS["typed_requests"] += 1
+    _count("typed_requests")
     return obj
 
 
@@ -280,6 +303,7 @@ def deserialize_frame(data: bytes) -> Tuple[Optional[int], Dict[str, Any]]:
         if c is None or c.is_function:
             raise ValueError(f"unknown TL result {inner_cid:#x}")
         obj = _deserialize_fields(c, r)
+        _expect_consumed(r)
         if c.name == "dct.rawResult":
             obj = json.loads(obj["data"])
         return req_msg_id, obj
@@ -287,6 +311,7 @@ def deserialize_frame(data: bytes) -> Tuple[Optional[int], Dict[str, Any]]:
     if c is None:
         raise ValueError(f"unknown TL frame {cid:#x}")
     obj = _deserialize_fields(c, r)
+    _expect_consumed(r)
     if c.name in ("dct.update", "dct.rawResult"):
         obj = json.loads(obj["data"])
     return None, obj

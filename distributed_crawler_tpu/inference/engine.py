@@ -25,7 +25,13 @@ from ..models.encoder import (
     TINY_TEST,
     XLMR_BASE,
 )
-from ..ops.padding import BucketSpec, bucket_for, pack_batch
+from ..ops.padding import (
+    DEFAULT_MAX_SEGMENTS_PER_ROW,
+    BucketSpec,
+    bucket_for,
+    pack_batch,
+    pack_rows,
+)
 from ..utils.metrics import REGISTRY, MetricsRegistry
 from .tokenizer import HashingTokenizer, Tokenizer
 
@@ -72,6 +78,11 @@ class EngineConfig:
     # Switch static-slot packing, ~cf× MLP FLOPs instead of n_experts×;
     # rejected with int8 quantize by EncoderConfig.validate()).
     moe_dispatch: Optional[str] = None
+    # Per-row segment bound for `run_tokenized(..., pack=True)`: packed
+    # results come back as a static [batch, pack_max_segments] block, so
+    # this is a compiled shape, not a heuristic.  One packed program per
+    # bucket (the segment-id/position operands), never per fill level.
+    pack_max_segments: int = DEFAULT_MAX_SEGMENTS_PER_ROW
 
     def encoder_config(self) -> EncoderConfig:
         try:
@@ -172,6 +183,7 @@ class InferenceEngine:
         self.bucket_spec = BucketSpec(
             tuple(b for b in cfg.buckets if b <= self.ecfg.max_len))
         self._steps: Dict[int, Any] = {}  # bucket -> jitted fn
+        self._packed_steps: Dict[int, Any] = {}  # bucket -> jitted packed fn
         self.m_latency = registry.histogram(
             "tpu_inference_batch_seconds",
             "batch dispatch->results-on-host latency (pipelined: the "
@@ -181,6 +193,9 @@ class InferenceEngine:
             "tpu_inference_posts_total", "posts through embed+classify")
         self.m_padding = registry.counter(
             "tpu_inference_pad_slots_total", "wasted pad slots")
+        self.m_packed = registry.counter(
+            "tpu_inference_packed_segments_total",
+            "sequences served through packed bucket rows")
 
         if params is None:
             import jax.numpy as jnp
@@ -263,20 +278,33 @@ class InferenceEngine:
             self._steps[bucket] = fn
         return fn
 
-    def _place(self, ids: np.ndarray, mask: np.ndarray):
+    def _packed_step(self, bucket: int):
+        import jax
+
+        fn = self._packed_steps.get(bucket)
+        if fn is None:
+            n_seg = self.cfg.pack_max_segments
+            # n_seg closes over as a static: the only new program per
+            # bucket is this one (the segment-id/position operands); every
+            # fill level reuses it because the shapes never change.
+            fn = jax.jit(lambda p, i, m, seg, pos: self.model.apply(
+                p, i, m, segment_ids=seg, positions=pos, n_segments=n_seg))
+            self._packed_steps[bucket] = fn
+        return fn
+
+    def _place(self, ids: np.ndarray, mask: np.ndarray, *extra: np.ndarray):
         import jax.numpy as jnp
 
-        ids_j, mask_j = jnp.asarray(ids), jnp.asarray(mask)
+        arrs = tuple(jnp.asarray(a) for a in (ids, mask) + extra)
         if self.mesh is not None:
             from ..parallel.sharding import shard_batch
 
-            placed = shard_batch({"ids": ids_j, "mask": mask_j}, self.mesh)
-            return placed["ids"], placed["mask"]
-        return ids_j, mask_j
+            arrs = shard_batch(arrs, self.mesh)  # tree-maps the tuple
+        return arrs
 
     # -- public API --------------------------------------------------------
-    def run_tokenized(self, token_lists: Sequence[List[int]]
-                      ) -> List[Dict[str, Any]]:
+    def run_tokenized(self, token_lists: Sequence[List[int]],
+                      pack: bool = False) -> List[Dict[str, Any]]:
         """Embed+classify pre-tokenized sequences; results in input order.
 
         One-deep software pipeline: jax dispatch is async, so batch i+1 is
@@ -285,7 +313,17 @@ class InferenceEngine:
         the per-batch RPC readback latency (the dominant cost through a
         tunneled chip: ~90 ms vs ~24 ms of compute at batch 256) overlaps
         compute instead of serializing with it.
+
+        ``pack=True`` routes through the packed path: several short
+        sequences share one bucket row behind segment-aware attention
+        masks, so short-text streams stop paying MXU/HBM for pad tokens.
+        Prefer ``pack=False`` for long-sequence-dominated streams (rows
+        near their bucket length pack 1:1 and only pay the extra operand).
         """
+        if any(not t for t in token_lists):
+            return self._run_with_empties(token_lists, pack)
+        if pack:
+            return self._run_packed(token_lists)
         results: List[Optional[Dict[str, Any]]] = [None] * len(token_lists)
         groups: Dict[int, List[int]] = {}
         for i, toks in enumerate(token_lists):
@@ -331,19 +369,122 @@ class InferenceEngine:
             materialize(*pending)
         return results  # type: ignore[return-value]
 
-    def run(self, texts: Sequence[str]) -> List[Dict[str, Any]]:
-        return self.run_tokenized(self.tokenizer.encode_batch(texts))
+    def _run_with_empties(self, token_lists: Sequence[List[int]],
+                          pack: bool) -> List[Dict[str, Any]]:
+        """Canonical host-side result for EMPTY token lists, identical in
+        both paths: zero embedding, uniform scores, label 0.  Classifying
+        nothing on device was never meaningful (the unpacked path used to
+        classify a pad row's position-0 state; the packed path's empty
+        segment pools to zero) — pinning one answer here keeps the
+        packed-equals-unpacked contract total."""
+        sub = [t for t in token_lists if t]
+        it = iter(self.run_tokenized(sub, pack=pack) if sub else [])
+        uniform = [1.0 / self.ecfg.n_labels] * self.ecfg.n_labels
+        out: List[Dict[str, Any]] = []
+        for t in token_lists:
+            if t:
+                out.append(next(it))
+            else:
+                r: Dict[str, Any] = {
+                    "embedding": [0.0] * self.ecfg.hidden,
+                    "label": 0, "scores": list(uniform)}
+                if self.label_names:
+                    r["label_name"] = self.label_names[0]
+                out.append(r)
+        return out
+
+    def _run_packed(self, token_lists: Sequence[List[int]]
+                    ) -> List[Dict[str, Any]]:
+        """Packed twin of the dispatch loop: per bucket, first-fit-pack the
+        sequences into shared rows (`ops/padding.pack_rows`), run the same
+        static [batch, bucket] shapes (plus segment-id/position operands)
+        through the one-deep pipeline, and fan per-segment results back to
+        input order via the packer's (row, slot) assignments."""
+        results: List[Optional[Dict[str, Any]]] = [None] * len(token_lists)
+        groups: Dict[int, List[int]] = {}
+        for i, toks in enumerate(token_lists):
+            groups.setdefault(
+                bucket_for(len(toks), self.bucket_spec), []).append(i)
+
+        bs = self.cfg.batch_size
+        pending: Optional[tuple] = None  # (slots, used, emb, logits, t0)
+
+        def materialize(slots, used_rows, emb, logits, t0):
+            emb_np = np.asarray(emb)        # device->host sync
+            logits_np = np.asarray(logits)  # [bs, S, n_labels]
+            self.m_latency.observe(time.perf_counter() - t0)
+            self.m_posts.inc(len(slots))
+            self.m_packed.inc(len(slots))
+            self.m_padding.inc(bs - used_rows)
+            flat = logits_np.reshape(-1, logits_np.shape[-1])
+            scores = _softmax_np(flat).reshape(logits_np.shape)
+            for row, slot, i in slots:
+                label = int(np.argmax(logits_np[row, slot]))
+                results[i] = {
+                    "embedding": emb_np[row, slot].tolist(),
+                    "label": label,
+                    "scores": scores[row, slot].tolist(),
+                }
+                if self.label_names and label < len(self.label_names):
+                    results[i]["label_name"] = self.label_names[label]
+
+        for bucket, indices in sorted(groups.items()):
+            packed = pack_rows([token_lists[i] for i in indices], bucket,
+                               max_segments=self.cfg.pack_max_segments,
+                               indices=indices)
+            for start in range(0, packed.n_rows, bs):
+                end = min(start + bs, packed.n_rows)
+                used = end - start
+                ids = packed.ids[start:end]
+                mask = packed.mask[start:end]
+                seg = packed.segment_ids[start:end]
+                pos = packed.positions[start:end]
+                if used < bs:
+                    # All-pad filler rows (segment id 0 everywhere) keep
+                    # the batch shape static; no slot maps to them.
+                    pad = ((0, bs - used), (0, 0))
+                    ids = np.pad(ids, pad)
+                    mask = np.pad(mask, pad)
+                    seg = np.pad(seg, pad)
+                    pos = np.pad(pos, pad)
+                slots = [(r - start, s, orig)
+                         for r in range(start, end)
+                         for s, orig in enumerate(packed.assignments[r])]
+                t0 = time.perf_counter()
+                emb, logits = self._packed_step(bucket)(
+                    self.params, *self._place(ids, mask, seg, pos))
+                if pending is not None:
+                    materialize(*pending)
+                pending = (slots, used, emb, logits, t0)
+        if pending is not None:
+            materialize(*pending)
+        return results  # type: ignore[return-value]
+
+    def run(self, texts: Sequence[str],
+            pack: bool = False) -> List[Dict[str, Any]]:
+        return self.run_tokenized(self.tokenizer.encode_batch(texts),
+                                  pack=pack)
 
     def embed(self, texts: Sequence[str]) -> np.ndarray:
         out = self.run(texts)
         return np.asarray([r["embedding"] for r in out], dtype=np.float32)
 
-    def warmup(self, buckets: Optional[Sequence[int]] = None) -> None:
-        """Pre-compile the (bucket, batch) programs before serving."""
+    def warmup(self, buckets: Optional[Sequence[int]] = None,
+               pack: Optional[bool] = None) -> None:
+        """Pre-compile the (bucket, batch) programs before serving.
+
+        ``pack`` picks which path to warm: True = the packed programs
+        (what a pack-serving worker actually dispatches), False = the
+        unpacked ones, None = both.  A pack-serving deployment that only
+        warmed the unpacked path would pay its first XLA compiles inside
+        live batches — under the stall watchdog."""
+        modes = (False, True) if pack is None else (bool(pack),)
         for b in buckets or self.bucket_spec.lengths:
-            self.run_tokenized([[1, 2, 3]] * min(2, self.cfg.batch_size)
-                               if b == self.bucket_spec.lengths[0]
-                               else [[1] * (b - 1)])
+            toks = ([[1, 2, 3]] * min(2, self.cfg.batch_size)
+                    if b == self.bucket_spec.lengths[0]
+                    else [[1] * (b - 1)])
+            for m in modes:
+                self.run_tokenized(toks, pack=m)
 
 
 def _load_pretrained(cfg: EngineConfig, params, tokenizer):

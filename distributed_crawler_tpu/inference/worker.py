@@ -13,13 +13,14 @@ chip busy without the handler ever blocking on the device.
 
 from __future__ import annotations
 
+import inspect
 import json
 import logging
 import queue
 import threading
 import time
 from dataclasses import dataclass
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..bus.codec import RecordBatch
 from ..bus.messages import (
@@ -79,6 +80,16 @@ class TPUWorkerConfig:
     # `enable_compilation_cache` to make restart warmups near-instant.
     stall_warn_s: float = 120.0       # 0 disables the watchdog
     stall_exit_s: float = 0.0         # 0 = warn only, never exit
+    # Coalescing feed: one dequeue drains up to this many queued batches and
+    # runs them through the engine as ONE token stream (packed when ``pack``
+    # is on), then fans results back so every RecordBatch still gets its own
+    # ack and idempotent writeback.  1 = process one batch per dispatch (the
+    # pre-coalescing behavior).
+    coalesce_batches: int = 4
+    # Sequence packing (`engine.run_tokenized(..., pack=True)`): short
+    # sequences share bucket rows behind segment masks.  Turn off for
+    # long-sequence-dominated streams, where rows pack 1:1 anyway.
+    pack: bool = True
 
 
 class TPUWorker:
@@ -123,6 +134,26 @@ class TPUWorker:
         self.m_batch_age = registry.histogram(
             "tpu_worker_batch_age_seconds",
             "bus transit + queue wait per batch")
+        self.m_coalesce = registry.histogram(
+            "tpu_worker_coalesced_group_batches",
+            "record batches coalesced into one device stream")
+        # Capability probes, not flags: test doubles and older engines that
+        # predate pack/coalescing keep working through the one-batch path.
+        self._engine_coalesces = (
+            callable(getattr(getattr(engine, "tokenizer", None),
+                             "encode_batch", None))
+            and callable(getattr(engine, "run_tokenized", None))
+            and self._accepts_pack(getattr(engine, "run_tokenized", None)))
+        self._engine_run_packs = self._accepts_pack(
+            getattr(engine, "run", None))
+
+    @staticmethod
+    def _accepts_pack(fn) -> bool:
+        try:
+            return fn is not None and \
+                "pack" in inspect.signature(fn).parameters
+        except (TypeError, ValueError):
+            return False
 
     def get_status(self) -> dict:
         """Status map for the /status endpoint (the `GetStatus()` analog
@@ -241,41 +272,128 @@ class TPUWorker:
             if self._inflight == 0:
                 self._idle.notify_all()
 
-    # -- feed loop ---------------------------------------------------------
+    # -- feed loop (coalescing) --------------------------------------------
     def _feed_loop(self) -> None:
+        """Drain up to ``coalesce_batches`` queued batches per device
+        dispatch and run them as one (packed) stream — a bursty crawl
+        stream fills bucket rows across RecordBatch boundaries instead of
+        padding each partial batch up to batch_size on its own."""
         while not self._stop.is_set():
             try:
-                batch, ack = self._queue.get(timeout=0.1)
+                items = [self._queue.get(timeout=0.1)]
             except queue.Empty:
                 continue
+            while len(items) < max(1, self.cfg.coalesce_batches):
+                try:
+                    items.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
             self.m_queue_depth.set(self._queue.qsize())
             try:
-                try:
-                    self._process(batch)
-                    self._processed += 1
-                    if ack is not None:
-                        ack(True)
-                except Exception as e:
-                    self._errors += 1
-                    logger.exception("batch %s failed: %s", batch.batch_id, e)
-                    if ack is not None:
-                        ack(False)
+                self._process_group(items)
             finally:
-                self._finish_one()
+                for _ in items:
+                    self._finish_one()
 
-    def _process(self, batch: RecordBatch) -> None:
+    def _process_group(self, items: List[Tuple[RecordBatch, Any]]) -> None:
+        if len(items) == 1 or not self._engine_coalesces:
+            for batch, ack in items:
+                self._process_one(batch, ack)
+            return
+        self.m_coalesce.observe(len(items))
+        # Tokenize per batch FIRST: a record whose text cannot tokenize
+        # fails its own batch here, before any neighbor joins it on device.
+        good: List[Tuple[RecordBatch, Any, List[List[int]]]] = []
+        for batch, ack in items:
+            try:
+                toks = self.engine.tokenizer.encode_batch(batch.texts())
+                self._observe_age(batch)
+                good.append((batch, ack, toks))
+            except Exception as e:
+                self._errors += 1
+                logger.exception("batch %s failed to tokenize: %s",
+                                 batch.batch_id, e)
+                if ack is not None:
+                    ack(False)
+        if not good:
+            return
+        all_toks = [t for _, _, toks in good for t in toks]
+        self._step_started = time.monotonic()
+        try:
+            results = self.engine.run_tokenized(all_toks,
+                                                pack=self.cfg.pack)
+        except Exception as e:
+            # The combined step failed; fall back to per-batch execution so
+            # one poisoned batch cannot take its coalesced neighbors down.
+            logger.exception(
+                "coalesced step over %d batches failed (%s); isolating "
+                "per batch", len(good), e)
+            results = None
+        finally:
+            self._step_started = None
+            self._stall_warned = False
+        if results is None:
+            for batch, ack, toks in good:
+                self._process_tokenized(batch, ack, toks)
+            return
+        # Fan results back to each originating batch: every batch keeps its
+        # OWN publish + idempotent writeback + ack, and a failure in one
+        # batch's commit nacks only that batch.
+        off = 0
+        for batch, ack, toks in good:
+            rs = results[off:off + len(toks)]
+            off += len(toks)
+            self._finish_batch(batch, ack, lambda rs=rs: rs)
+
+    def _finish_batch(self, batch: RecordBatch, ack, produce) -> None:
+        """The ONE copy of the commit/ack/error accounting every path
+        shares; ``produce`` yields the batch's results (or raises)."""
+        try:
+            self._commit(batch, produce())
+            self._processed += 1
+            if ack is not None:
+                ack(True)
+        except Exception as e:
+            self._errors += 1
+            logger.exception("batch %s failed: %s", batch.batch_id, e)
+            if ack is not None:
+                ack(False)
+
+    def _run_step(self, fn):
+        """Run a device step under the stall-watchdog bookkeeping."""
+        self._step_started = time.monotonic()
+        try:
+            return fn()
+        finally:
+            self._step_started = None
+            self._stall_warned = False
+
+    def _process_one(self, batch: RecordBatch, ack) -> None:
+        def produce():
+            self._observe_age(batch)
+            if self.cfg.pack and self._engine_run_packs:
+                return self._run_step(
+                    lambda: self.engine.run(batch.texts(), pack=True))
+            return self._run_step(lambda: self.engine.run(batch.texts()))
+
+        self._finish_batch(batch, ack, produce)
+
+    def _process_tokenized(self, batch: RecordBatch, ack, toks) -> None:
+        """Per-batch fallback after a failed coalesced step: the batch was
+        already tokenized and age-observed when the group formed, so reuse
+        the token lists instead of re-running the text front door."""
+        self._finish_batch(batch, ack, lambda: self._run_step(
+            lambda: self.engine.run_tokenized(toks, pack=self.cfg.pack)))
+
+    def _observe_age(self, batch: RecordBatch) -> None:
         if batch.created_at is not None:
             from ..state.datamodels import utcnow
 
             age = (utcnow() - batch.created_at).total_seconds()
             if age >= 0:
                 self.m_batch_age.observe(age)
-        self._step_started = time.monotonic()
-        try:
-            results = self.engine.run(batch.texts())
-        finally:
-            self._step_started = None
-            self._stall_warned = False
+
+    def _commit(self, batch: RecordBatch, results) -> None:
         if not self.cfg.write_embeddings:
             results = [{k: v for k, v in r.items() if k != "embedding"}
                        for r in results]
@@ -312,7 +430,12 @@ class TPUWorker:
         self._start_watchdog()
         self._step_started = time.monotonic()
         try:
-            self.engine.warmup()
+            if self._accepts_pack(getattr(self.engine, "warmup", None)):
+                # Warm the path this worker actually serves: with pack on,
+                # the packed programs are what live batches dispatch.
+                self.engine.warmup(pack=self.cfg.pack)
+            else:
+                self.engine.warmup()
         finally:
             self._step_started = None
             self._stall_warned = False

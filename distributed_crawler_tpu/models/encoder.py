@@ -178,7 +178,7 @@ class SelfAttention(nn.Module):
     cfg: EncoderConfig
 
     @nn.compact
-    def __call__(self, x, mask):
+    def __call__(self, x, mask, segment_ids=None):
         cfg = self.cfg
         b, l, _ = x.shape
         # Fused QKV: one [h, 3, h] GEMM instead of three [h, h] GEMMs — at
@@ -215,7 +215,8 @@ class SelfAttention(nn.Module):
         k = proj[:, :, 1].reshape(b, l, cfg.n_heads, cfg.head_dim)
         v = proj[:, :, 2].reshape(b, l, cfg.n_heads, cfg.head_dim)
         use_flash = {"auto": None, "xla": False, "flash": True}[cfg.attention]
-        o = mha(q, k, v, kv_mask=mask, use_flash=use_flash)
+        o = mha(q, k, v, kv_mask=mask, use_flash=use_flash,
+                segment_ids=segment_ids)
         o = o.reshape(b, l, cfg.hidden)
         _sow_absmax(self, cfg, "attn_out", o)
         return _proj(cfg, cfg.hidden, "attn_out")(o)
@@ -383,12 +384,12 @@ class EncoderLayer(nn.Module):
     cfg: EncoderConfig
 
     @nn.compact
-    def __call__(self, x, mask):
+    def __call__(self, x, mask, segment_ids=None):
         cfg = self.cfg
         ln = lambda name: nn.LayerNorm(
             epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
             param_dtype=jnp.float32, name=name)
-        a = SelfAttention(cfg, name="attn")(x, mask)
+        a = SelfAttention(cfg, name="attn")(x, mask, segment_ids)
         x = ln("ln_attn")(x.astype(jnp.float32)
                           + a.astype(jnp.float32)).astype(cfg.adtype)
         if cfg.n_experts:
@@ -401,12 +402,17 @@ class EncoderLayer(nn.Module):
 
 
 class Encoder(nn.Module):
-    """ids [B, L] int32, mask [B, L] bool -> hidden [B, L, H] (cfg dtype)."""
+    """ids [B, L] int32, mask [B, L] bool -> hidden [B, L, H] (cfg dtype).
+
+    Packed rows (`ops/padding.pack_rows`) additionally pass ``segment_ids``
+    [B, L] int32 (attention is confined per segment) and ``positions``
+    [B, L] int32 (within-segment offsets, so every packed sequence sees the
+    same absolute position embeddings as its unpacked twin)."""
 
     cfg: EncoderConfig
 
     @nn.compact
-    def __call__(self, ids, mask):
+    def __call__(self, ids, mask, segment_ids=None, positions=None):
         cfg = self.cfg
         cfg.validate()
         emb = self.param("embed_tokens", nn.initializers.normal(0.02),
@@ -414,7 +420,10 @@ class Encoder(nn.Module):
         pos = self.param("embed_positions", nn.initializers.normal(0.02),
                          (cfg.max_len, cfg.hidden), jnp.float32)
         l = ids.shape[1]
-        x = emb[ids] + pos[:l][None, :, :]
+        if positions is not None:
+            x = emb[ids] + pos[positions]
+        else:
+            x = emb[ids] + pos[:l][None, :, :]
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
                          param_dtype=jnp.float32, name="ln_embed")(x)
         x = x.astype(cfg.adtype)
@@ -422,7 +431,7 @@ class Encoder(nn.Module):
         if cfg.remat:
             layer_cls = nn.remat(EncoderLayer, static_argnums=())
         for i in range(cfg.n_layers):
-            x = layer_cls(cfg, name=f"layers_{i}")(x, mask)
+            x = layer_cls(cfg, name=f"layers_{i}")(x, mask, segment_ids)
         return x
 
 
@@ -436,6 +445,38 @@ def mean_pool(hidden: jax.Array, mask: jax.Array) -> jax.Array:
 
 def l2_normalize(x: jax.Array, eps: float = 1e-12) -> jax.Array:
     return x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), eps)
+
+
+def _segment_onehot(mask: jax.Array, segment_ids: jax.Array,
+                    n_segments: int) -> jax.Array:
+    """[B, L, S] f32 membership: token l of row b belongs to segment s+1."""
+    sel = (segment_ids[:, :, None] ==
+           jnp.arange(1, n_segments + 1, dtype=segment_ids.dtype)[None, None])
+    return (sel & mask[:, :, None]).astype(jnp.float32)
+
+
+def segment_mean_pool(hidden: jax.Array, mask: jax.Array,
+                      segment_ids: jax.Array, n_segments: int) -> jax.Array:
+    """Per-segment masked mean over packed rows: [B, L, H] -> [B, S, H].
+
+    Tokens outside a segment enter its sum with an exactly-zero weight, so
+    a segment's pooled vector is bit-for-bit independent of its packed
+    neighbors; empty slots pool to zero (count clamped to 1)."""
+    sel = _segment_onehot(mask, segment_ids, n_segments)
+    summed = jnp.einsum("blh,bls->bsh", hidden.astype(jnp.float32), sel)
+    count = jnp.maximum(jnp.sum(sel, axis=1), 1.0)
+    return summed / count[..., None]
+
+
+def segment_first_token(hidden: jax.Array, mask: jax.Array,
+                        segment_ids: jax.Array,
+                        n_segments: int) -> jax.Array:
+    """Each segment's first-token state: [B, L, H] -> [B, S, H] — the
+    per-segment CLS analog (the packer lays every sequence down CLS-first).
+    Empty slots select nothing and come out zero."""
+    sel = _segment_onehot(mask, segment_ids, n_segments)
+    first = sel * (jnp.cumsum(sel, axis=1) == 1.0)
+    return jnp.einsum("blh,bls->bsh", hidden.astype(jnp.float32), first)
 
 
 class ClassificationHead(nn.Module):
@@ -480,13 +521,32 @@ class Classifier(nn.Module):
 
 class EmbedderClassifier(nn.Module):
     """Fused single-pass embed+classify — the BASELINE headline op runs one
-    encoder, not two, when both outputs are wanted on the same text."""
+    encoder, not two, when both outputs are wanted on the same text.
+
+    Packed mode (``segment_ids``/``positions`` from `ops/padding.pack_rows`,
+    ``n_segments`` static): one bucket row carries several sequences, and
+    the outputs become per-SEGMENT — emb [B, S, H], logits [B, S, n_labels]
+    — each segment mean-pooled over its own tokens and classified from its
+    own first (CLS) token, never blended with packed neighbors.  The param
+    tree is identical in both modes, so one checkpoint serves both."""
 
     cfg: EncoderConfig
 
     @nn.compact
-    def __call__(self, ids, mask):
-        hidden = Encoder(self.cfg, name="encoder")(ids, mask)
-        emb = l2_normalize(mean_pool(hidden, mask))
-        logits = ClassificationHead(self.cfg, name="cls_head")(hidden[:, 0, :])
+    def __call__(self, ids, mask, segment_ids=None, positions=None,
+                 n_segments: int = 0):
+        hidden = Encoder(self.cfg, name="encoder")(ids, mask,
+                                                   segment_ids, positions)
+        if segment_ids is None:
+            emb = l2_normalize(mean_pool(hidden, mask))
+            logits = ClassificationHead(self.cfg, name="cls_head")(
+                hidden[:, 0, :])
+            return emb, logits
+        if n_segments <= 0:
+            raise ValueError("packed mode requires n_segments > 0")
+        emb = l2_normalize(
+            segment_mean_pool(hidden, mask, segment_ids, n_segments))
+        cls_states = segment_first_token(hidden, mask, segment_ids,
+                                         n_segments)
+        logits = ClassificationHead(self.cfg, name="cls_head")(cls_states)
         return emb, logits

@@ -8,14 +8,23 @@ the CPU test mesh always runs the XLA path.
 """
 
 from .attention import attend, flash_attention, mha
-from .padding import BucketSpec, bucket_for, pad_to_bucket, pack_batch
+from .padding import (
+    BucketSpec,
+    PackedRows,
+    bucket_for,
+    pack_batch,
+    pack_rows,
+    pad_to_bucket,
+)
 
 __all__ = [
     "attend",
     "mha",
     "flash_attention",
     "BucketSpec",
+    "PackedRows",
     "bucket_for",
     "pad_to_bucket",
     "pack_batch",
+    "pack_rows",
 ]

@@ -22,37 +22,66 @@ FLASH_MIN_SEQ = 1024
 _FLASH_BLOCK_Q = 256
 
 
+def _allowed_mask(kv_mask: Optional[jax.Array],
+                  segment_ids: Optional[jax.Array]) -> Optional[jax.Array]:
+    """[B, 1, Q?, K] boolean allow-mask from padding + segment identity.
+
+    With ``segment_ids`` (packed rows, `ops/padding.pack_rows`), a query may
+    only attend keys of ITS OWN segment: packed neighbors sharing a bucket
+    row are invisible to each other, so packing changes FLOPs spent, never
+    attention semantics.
+    """
+    allowed = None
+    if kv_mask is not None:
+        allowed = kv_mask[:, None, None, :]
+    if segment_ids is not None:
+        same = (segment_ids[:, None, :, None] ==
+                segment_ids[:, None, None, :])
+        allowed = same if allowed is None else (allowed & same)
+    return allowed
+
+
 def attend(q: jax.Array, k: jax.Array, v: jax.Array,
            kv_mask: Optional[jax.Array] = None,
-           scale: Optional[float] = None) -> jax.Array:
+           scale: Optional[float] = None,
+           segment_ids: Optional[jax.Array] = None) -> jax.Array:
     """Reference bidirectional attention, BLHD in/out. XLA fuses this into
-    two MXU matmuls + a VPU softmax; it is the default for encoder lengths."""
+    two MXU matmuls + a VPU softmax; it is the default for encoder lengths.
+    ``segment_ids`` [B, L] (packed rows) confines attention per segment."""
     d = q.shape[-1]
     scale = scale if scale is not None else d ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
-    if kv_mask is not None:
-        s = jnp.where(kv_mask[:, None, None, :], s, _NEG_INF)
+    allowed = _allowed_mask(kv_mask, segment_ids)
+    if allowed is not None:
+        s = jnp.where(allowed, s, _NEG_INF)
     # Explicit masked softmax (not jax.nn.softmax): fully-masked rows must
     # yield zeros, matching the flash kernel and ring attention, instead of
     # the uniform average softmax would produce from all-equal -inf scores.
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
-    if kv_mask is not None:
-        p = jnp.where(kv_mask[:, None, None, :], p, 0.0)
+    if allowed is not None:
+        p = jnp.where(allowed, p, 0.0)
     p = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
     out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
     return out.astype(q.dtype)
 
 
-def _flash_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, *, scale):
+def _flash_kernel(*refs, scale, has_seg):
     """One (batch*head, q-block) program: q block vs the full kv sequence.
 
     Block over q only: scores are [block_q, L] f32 in VMEM (1 MB at L=2k),
     small enough that blocking kv as well would only add loop overhead; truly
-    long sequences go through ring attention over sp instead.
+    long sequences go through ring attention over sp instead.  With
+    ``has_seg`` two extra int32 operands ride in — the kv segment row and
+    the q block's segment slice — and scores are additionally masked where
+    seg_q != seg_kv (packed rows never attend across segments).
     """
+    if has_seg:
+        mask_ref, segkv_ref, segq_ref, q_ref, k_ref, v_ref, o_ref = refs
+    else:
+        mask_ref, q_ref, k_ref, v_ref, o_ref = refs
     q = q_ref[0].astype(jnp.float32)   # [block_q, D]
     k = k_ref[0].astype(jnp.float32)   # [L, D]
     v = v_ref[0]
@@ -60,6 +89,9 @@ def _flash_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, *, scale):
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
     mask = mask_ref[0] != 0  # [1, L], broadcasts over q rows
+    if has_seg:
+        # [block_q, 1] vs [1, L] -> [block_q, L] same-segment mask.
+        mask = mask & (segq_ref[0].reshape(-1, 1) == segkv_ref[0])
     s = jnp.where(mask, s, _NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
@@ -76,7 +108,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     kv_mask: Optional[jax.Array] = None,
                     scale: Optional[float] = None,
                     block_q: int = _FLASH_BLOCK_Q,
-                    interpret: bool = False) -> jax.Array:
+                    interpret: bool = False,
+                    segment_ids: Optional[jax.Array] = None) -> jax.Array:
     """Pallas flash attention, BLHD in/out, grid (batch*heads, q-blocks)."""
     from jax.experimental import pallas as pl
 
@@ -99,31 +132,47 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # two dims be (8-divisible, 128-divisible) OR equal to the array dims —
     # a [B, L] block of (1, L) satisfies neither for the leading dim.
     mask_i32 = kv_mask.astype(jnp.int32)[:, None, :]
+    in_specs = [
+        pl.BlockSpec((1, 1, l), lambda i, j: (i // h, 0, 0)),       # mask
+    ]
+    operands = [mask_i32]
+    has_seg = segment_ids is not None
+    if has_seg:
+        seg_i32 = segment_ids.astype(jnp.int32)[:, None, :]
+        in_specs += [
+            pl.BlockSpec((1, 1, l), lambda i, j: (i // h, 0, 0)),    # seg kv
+            pl.BlockSpec((1, 1, block_q),
+                         lambda i, j: (i // h, 0, j)),               # seg q
+        ]
+        operands += [seg_i32, seg_i32]
+    in_specs += [
+        pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),      # q
+        pl.BlockSpec((1, l, d), lambda i, j: (i, 0, 0)),            # k
+        pl.BlockSpec((1, l, d), lambda i, j: (i, 0, 0)),            # v
+    ]
+    operands += [qb, kb, vb]
 
     out = pl.pallas_call(
-        functools.partial(_flash_kernel, scale=scale),
+        functools.partial(_flash_kernel, scale=scale, has_seg=has_seg),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, l), lambda i, j: (i // h, 0, 0)),   # mask
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),  # q
-            pl.BlockSpec((1, l, d), lambda i, j: (i, 0, 0)),        # k
-            pl.BlockSpec((1, l, d), lambda i, j: (i, 0, 0)),        # v
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, l, d), q.dtype),
         interpret=interpret,
-    )(mask_i32, qb, kb, vb)
+    )(*operands)
     return out.reshape(b, h, l, d).transpose(0, 2, 1, 3)
 
 
 def mha(q: jax.Array, k: jax.Array, v: jax.Array,
         kv_mask: Optional[jax.Array] = None,
         scale: Optional[float] = None,
-        use_flash: Optional[bool] = None) -> jax.Array:
+        use_flash: Optional[bool] = None,
+        segment_ids: Optional[jax.Array] = None) -> jax.Array:
     """Dispatch: Pallas flash on TPU past FLASH_MIN_SEQ, XLA otherwise."""
     if use_flash is None:
         use_flash = (q.shape[1] >= FLASH_MIN_SEQ
                      and jax.default_backend() == "tpu")
     if use_flash:
-        return flash_attention(q, k, v, kv_mask, scale)
-    return attend(q, k, v, kv_mask, scale)
+        return flash_attention(q, k, v, kv_mask, scale,
+                               segment_ids=segment_ids)
+    return attend(q, k, v, kv_mask, scale, segment_ids=segment_ids)

@@ -13,12 +13,18 @@ of 8 (f32) / 16 (bf16) sublanes avoid relayout, hence the power-of-two grid.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Dict, List, Sequence, Tuple
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 DEFAULT_BUCKETS = (32, 64, 128, 256, 512)
+
+# Per-row segment bound for the packer: unpacking indexes a static
+# [rows, MAX_SEGMENTS_PER_ROW] result block, so the bound is a shape, not a
+# heuristic.  8 segments fill a 32-bucket with 4-token posts; longer buckets
+# are length-bound before they are slot-bound.
+DEFAULT_MAX_SEGMENTS_PER_ROW = 8
 
 
 @dataclass(frozen=True)
@@ -78,6 +84,85 @@ def pack_batch(sequences: Sequence[Sequence[int]],
             [ids, np.full((pad_rows, bucket), pad_id, dtype=np.int32)])
         mask = np.concatenate([mask, np.zeros((pad_rows, bucket), dtype=bool)])
     return ids, mask
+
+
+@dataclass
+class PackedRows:
+    """Several short sequences packed into each fixed-length bucket row.
+
+    ``segment_ids`` is 0 at padding and 1..S at packed tokens; segment s of
+    row r is the caller's sequence ``assignments[r][s - 1]``.  ``positions``
+    restarts at 0 for every segment so absolute position embeddings see each
+    packed sequence exactly as its unpacked twin would.
+    """
+
+    bucket: int
+    ids: np.ndarray          # [R, L] int32
+    mask: np.ndarray         # [R, L] bool (True = real token)
+    segment_ids: np.ndarray  # [R, L] int32 (0 = padding)
+    positions: np.ndarray    # [R, L] int32 (within-segment offsets)
+    assignments: List[List[int]] = field(default_factory=list)
+
+    @property
+    def n_rows(self) -> int:
+        return int(self.ids.shape[0])
+
+
+def pack_rows(sequences: Sequence[Sequence[int]], bucket: int,
+              max_segments: int = DEFAULT_MAX_SEGMENTS_PER_ROW,
+              pad_id: int = 0,
+              indices: Optional[Sequence[int]] = None) -> PackedRows:
+    """Greedy first-fit-decreasing packer: many sequences -> few [L] rows.
+
+    Every sequence lands in exactly one (row, segment) slot; a row takes a
+    sequence only while it has both token room and a free segment slot, so
+    per-row occupancy is bounded by ``max_segments`` and unpacking is a
+    static [R, max_segments] index.  Over-long sequences truncate to the
+    bucket (same rule as ``pad_to_bucket``).  ``indices`` relabels the
+    assignment entries with the caller's own sequence numbering.
+    """
+    if bucket <= 0:
+        raise ValueError(f"bucket must be positive, got {bucket}")
+    if max_segments <= 0:
+        raise ValueError(f"max_segments must be positive, got {max_segments}")
+    idx = list(indices) if indices is not None else list(range(len(sequences)))
+    if len(idx) != len(sequences):
+        raise ValueError("indices must match sequences 1:1")
+    # First-fit-decreasing: sorting by length keeps long sequences from
+    # stranding token room behind earlier short placements (sort is stable,
+    # so equal lengths keep input order and results stay deterministic).
+    order = sorted(range(len(sequences)),
+                   key=lambda j: -min(len(sequences[j]), bucket))
+    rows: List[Tuple[int, List[int]]] = []  # (tokens used, [seq position])
+    for j in order:
+        n = min(len(sequences[j]), bucket)
+        for r, (used, members) in enumerate(rows):
+            if used + n <= bucket and len(members) < max_segments:
+                rows[r] = (used + n, members + [j])
+                break
+        else:
+            rows.append((n, [j]))
+    R = len(rows)
+    ids = np.full((R, bucket), pad_id, dtype=np.int32)
+    mask = np.zeros((R, bucket), dtype=bool)
+    segment_ids = np.zeros((R, bucket), dtype=np.int32)
+    positions = np.zeros((R, bucket), dtype=np.int32)
+    assignments: List[List[int]] = []
+    for r, (_, members) in enumerate(rows):
+        off = 0
+        slots: List[int] = []
+        for s, j in enumerate(members, start=1):
+            n = min(len(sequences[j]), bucket)
+            ids[r, off:off + n] = np.asarray(sequences[j][:n], dtype=np.int32)
+            mask[r, off:off + n] = True
+            segment_ids[r, off:off + n] = s
+            positions[r, off:off + n] = np.arange(n, dtype=np.int32)
+            off += n
+            slots.append(idx[j])
+        assignments.append(slots)
+    return PackedRows(bucket=bucket, ids=ids, mask=mask,
+                      segment_ids=segment_ids, positions=positions,
+                      assignments=assignments)
 
 
 def group_by_bucket(sequences: Sequence[Sequence[int]],

@@ -246,6 +246,8 @@ inline Value deserialize_fields(const Constructor& c,
       if (r->u32() != kVector)
         throw std::runtime_error("expected Vector");
       uint32_t n = r->u32();
+      if (n > 0x7FFFFFFFu)  // i32-negative on the wire: forged count
+        throw std::runtime_error("negative TL vector count");
       Array items;
       for (uint32_t i = 0; i < n; ++i) {
         if (r->u32() != inner.cid)
@@ -277,6 +279,13 @@ inline Bytes serialize_request(const Value& req) {
   return out;
 }
 
+// A well-formed frame is EXACTLY its constructor; trailing bytes mean a
+// forged or corrupted frame and must throw, never parse silently.
+inline void expect_consumed(const dctmtp::TlReader& r, size_t size) {
+  if (r.offset() != size)
+    throw std::runtime_error("trailing bytes after TL frame");
+}
+
 // Wire frame -> (has_req_msg_id, req_msg_id, JSON object).
 inline Value deserialize_frame(const Bytes& data, bool* has_req_msg_id,
                                int64_t* req_msg_id) {
@@ -293,6 +302,7 @@ inline Value deserialize_frame(const Bytes& data, bool* has_req_msg_id,
     if (it == reg.by_id.end() || it->second.is_function)
       throw std::runtime_error("unknown TL result constructor");
     Value obj = deserialize_fields(it->second, &r);
+    expect_consumed(r, data.size());
     if (it->second.name == "dct.rawResult")
       return dctjson::parse(obj.get("data").as_string());
     return obj;
@@ -301,6 +311,7 @@ inline Value deserialize_frame(const Bytes& data, bool* has_req_msg_id,
   if (it == reg.by_id.end())
     throw std::runtime_error("unknown TL frame constructor");
   Value obj = deserialize_fields(it->second, &r);
+  expect_consumed(r, data.size());
   if (it->second.name == "dct.update" || it->second.name == "dct.rawResult")
     return dctjson::parse(obj.get("data").as_string());
   return obj;

@@ -83,6 +83,16 @@ def test_measure_tokenizer_smoke():
     assert res["tokenizer_text_words"] == 8
 
 
+def test_measure_padding_efficiency():
+    """The tentpole's acceptance bound: packed real-token density >= 1.5x
+    unpacked on the Zipf-length workload (host-side, runs every bench)."""
+    res = bench._measure_padding_efficiency(n_texts=1024)
+    assert 0 < res["padding_density_unpacked"] < 1
+    assert res["padding_density_unpacked"] < \
+        res["padding_density_packed"] <= 1
+    assert res["padding_packed_density_gain"] >= 1.5
+
+
 def test_probe_subprocess_emits_json():
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("AXON", "PALLAS_AXON", "TPU_"))}

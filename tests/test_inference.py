@@ -279,6 +279,135 @@ class TestInferenceEngine:
         assert len(out) == 5
 
 
+class TestPackedEngine:
+    """run_tokenized(..., pack=True): several short sequences share one
+    bucket row behind segment masks; results must match the unpacked path
+    (tiny config is f32 — tolerances far under bf16) in input order, with
+    no extra compiled programs beyond one packed step per bucket."""
+
+    def test_packed_matches_unpacked(self):
+        eng = _engine()
+        texts = ["hello world", "a much longer piece of text " * 3,
+                 "third", "x", "y z w", "more words in this one now"]
+        u = eng.run(texts)
+        p = eng.run(texts, pack=True)
+        for a, b in zip(u, p):
+            np.testing.assert_allclose(a["embedding"], b["embedding"],
+                                       atol=2e-5)
+            assert a["label"] == b["label"]
+            np.testing.assert_allclose(a["scores"], b["scores"], atol=2e-5)
+
+    def test_packed_run_tokenized_order_and_chunking(self):
+        eng = _engine()  # batch_size=4, buckets (16, 32)
+        toks = [[3 + i] * (2 + i % 9) for i in range(23)]
+        u = eng.run_tokenized(toks)
+        p = eng.run_tokenized(toks, pack=True)
+        assert len(p) == 23 and all(r is not None for r in p)
+        for a, b in zip(u, p):
+            np.testing.assert_allclose(a["embedding"], b["embedding"],
+                                       atol=2e-5)
+
+    def test_one_packed_program_per_bucket(self):
+        """Different fill levels (3 vs 23 sequences, partial final rows)
+        must reuse ONE compiled packed program per bucket — packing adds
+        the segment-id/position operands, never a new (bucket, batch)
+        shape."""
+        eng = _engine()
+        eng.run_tokenized([[5] * 3] * 3, pack=True)
+        eng.run_tokenized([[5 + i % 7] * (2 + i % 11) for i in range(23)],
+                          pack=True)
+        assert eng._packed_steps, "packed path compiled nothing"
+        for bucket, fn in eng._packed_steps.items():
+            assert fn._cache_size() == 1, \
+                f"bucket {bucket} compiled {fn._cache_size()} variants"
+
+    def test_packed_fewer_dispatches_for_short_texts(self):
+        """32 two-token sequences at batch_size=4: unpacked needs 8 device
+        batches; packed (8 segments per 16-bucket row -> 4 rows) needs 1 —
+        the pad-token FLOPs the tentpole removes."""
+        reg = MetricsRegistry()
+        eng = _engine(registry=reg)
+        toks = [[7, 8] for _ in range(32)]
+        eng.run_tokenized(toks, pack=True)
+        assert eng.m_packed.value == 32
+        # 32 seqs / 8-per-row = 4 rows = exactly one batch of 4.
+        assert eng.m_latency.count == 1
+
+    def test_packed_metrics_recorded(self):
+        reg = MetricsRegistry()
+        eng = _engine(registry=reg)
+        eng.run(["a", "b", "c"], pack=True)
+        assert eng.m_posts.value == 3
+        assert eng.m_packed.value == 3
+
+    def test_packed_matches_unpacked_property(self):
+        """Property form: arbitrary ragged length mixes (1..40 tokens,
+        spanning both buckets and chunk boundaries) produce identical
+        embeddings/labels packed vs unpacked."""
+        pytest.importorskip("hypothesis")
+        from hypothesis import given, settings
+        from hypothesis import strategies as st
+
+        eng = _engine()
+
+        @settings(max_examples=15, deadline=None)
+        @given(lengths=st.lists(st.integers(1, 40), min_size=1,
+                                max_size=24))
+        def check(lengths):
+            toks = [[(7 * i + j) % 500 + 3 for j in range(n)]
+                    for i, n in enumerate(lengths)]
+            u = eng.run_tokenized(toks)
+            p = eng.run_tokenized(toks, pack=True)
+            for a, b in zip(u, p):
+                np.testing.assert_allclose(a["embedding"], b["embedding"],
+                                           atol=2e-5)
+                np.testing.assert_allclose(a["scores"], b["scores"],
+                                           atol=2e-5)
+
+        check()
+
+    def test_empty_token_lists_identical_both_paths(self):
+        """Empty inputs (media-only posts) get ONE canonical result —
+        zero embedding, uniform scores — identical packed and unpacked,
+        so a fallback path switch can never flip a stored label."""
+        eng = _engine()
+        toks = [[5, 6, 7], [], [8, 9], []]
+        u = eng.run_tokenized(toks)
+        p = eng.run_tokenized(toks, pack=True)
+        for i in (1, 3):
+            assert u[i] == p[i]
+            assert u[i]["embedding"] == [0.0] * 64
+            np.testing.assert_allclose(u[i]["scores"], 1.0 / 3, atol=1e-9)
+        np.testing.assert_allclose(u[0]["embedding"], p[0]["embedding"],
+                                   atol=2e-5)
+        np.testing.assert_allclose(u[2]["embedding"], p[2]["embedding"],
+                                   atol=2e-5)
+
+    def test_warmup_compiles_the_packed_path(self):
+        eng = _engine()
+        eng.warmup(pack=True)
+        assert set(eng._packed_steps) == set(eng.bucket_spec.lengths)
+        assert not eng._steps  # unpacked programs not paid for
+        eng2 = _engine()
+        eng2.warmup()  # default warms BOTH paths
+        assert set(eng2._steps) == set(eng2.bucket_spec.lengths)
+        assert set(eng2._packed_steps) == set(eng2.bucket_spec.lengths)
+
+    def test_packed_mesh_sharded_run(self):
+        from distributed_crawler_tpu.parallel import (
+            best_mesh_config,
+            make_mesh,
+        )
+
+        mesh = make_mesh(best_mesh_config(8, tp=2))
+        cfg = EngineConfig(model="tiny", n_labels=3, batch_size=8,
+                           buckets=(16,))
+        eng = InferenceEngine(cfg, mesh=mesh, registry=MetricsRegistry())
+        out = eng.run(["hello"] * 5, pack=True)
+        assert len(out) == 5
+        assert all(r is not None for r in out)
+
+
 def _posts(n):
     return [Post(post_uid=f"p{i}", channel_name="chan",
                  description=f"message text {i}") for i in range(n)]
@@ -408,6 +537,184 @@ class TestTPUWorker:
         assert worker.status()["processed"] == 0
         worker.stop()
         bus.close()
+
+
+class _GateTokenizer:
+    """Tokenizer that raises on a poison marker — the per-record failure
+    front door the coalescing feed must isolate per batch."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def encode_batch(self, texts):
+        if any("POISON" in t for t in texts):
+            raise ValueError("poisoned record")
+        return self.inner.encode_batch(texts)
+
+    def encode(self, text):
+        return self.inner.encode(text)
+
+
+class TestCoalescingFeed:
+    """The feed loop drains up to coalesce_batches queued RecordBatches
+    into ONE (packed) engine stream, then fans results back so each batch
+    keeps its own ack + idempotent writeback, and a poisoned batch fails
+    only its own ack."""
+
+    def _make(self, provider=None, coalesce=4, pack=True, engine=None):
+        bus = InMemoryBus()
+        eng = engine or _engine()
+        worker = TPUWorker(bus, eng, provider=provider,
+                           cfg=TPUWorkerConfig(worker_id="w1",
+                                               heartbeat_s=3600,
+                                               coalesce_batches=coalesce,
+                                               pack=pack),
+                           registry=MetricsRegistry())
+        return bus, worker, []
+
+    def _run_batches(self, bus, worker, acks, batches, n_expected):
+        """Enqueue all batches (RemoteBus-style manual acks) BEFORE the
+        feed thread starts, so one dequeue coalesces them into a single
+        group deterministically."""
+        bus.start()
+        for b in batches:
+            worker._handle_payload(
+                b.to_dict(),
+                (lambda bid: lambda ok=True: acks.append((bid, ok)))(
+                    b.batch_id))
+        worker.start()
+        deadline = time.monotonic() + 10
+        while len(acks) < n_expected and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert worker.drain(timeout_s=10.0)
+        worker.stop()
+        bus.close()
+
+    def test_coalesced_group_acks_and_writes_per_batch(self):
+        provider = InMemoryStorageProvider()
+        bus, worker, acks = self._make(provider=provider)
+        batches = [RecordBatch.from_posts(_posts(3), crawl_id=f"co{i}")
+                   for i in range(3)]
+        self._run_batches(bus, worker, acks, batches, n_expected=3)
+        assert sorted(acks) == sorted(
+            [(b.batch_id, True) for b in batches])
+        assert worker.m_coalesce.count >= 1  # the group actually coalesced
+        from distributed_crawler_tpu.inference.worker import iter_results
+        for i, b in enumerate(batches):
+            lines = list(iter_results(provider, f"co{i}"))
+            assert len(lines) == 3, f"batch {i} writeback missing"
+            assert {l["batch_id"] for l in lines} == {b.batch_id}
+
+    def test_coalesced_results_match_solo_run(self):
+        """Fan-out must hand each batch ITS rows: labels equal a
+        non-coalesced run of the same posts."""
+        eng = _engine()
+        solo = eng.run([f"message text {i}" for i in range(3)])
+        provider = InMemoryStorageProvider()
+        bus, worker, acks = self._make(provider=provider,
+                                       engine=_engine())
+        batches = [RecordBatch.from_posts(_posts(3), crawl_id=f"cm{i}")
+                   for i in range(2)]
+        self._run_batches(bus, worker, acks, batches, n_expected=2)
+        from distributed_crawler_tpu.inference.worker import iter_results
+        for i in range(2):
+            lines = list(iter_results(provider, f"cm{i}"))
+            assert [l["label"] for l in lines] == \
+                [r["label"] for r in solo]
+
+    def test_poisoned_batch_fails_only_its_own_ack(self):
+        provider = InMemoryStorageProvider()
+        eng = _engine()
+        eng.tokenizer = _GateTokenizer(eng.tokenizer)
+        bus, worker, acks = self._make(provider=provider, engine=eng)
+        good1 = RecordBatch.from_posts(_posts(2), crawl_id="g1")
+        bad = RecordBatch.from_posts(
+            [Post(post_uid="px", channel_name="chan",
+                  description="POISON pill")], crawl_id="bad")
+        good2 = RecordBatch.from_posts(_posts(2), crawl_id="g2")
+        self._run_batches(bus, worker, acks, [good1, bad, good2],
+                          n_expected=3)
+        by_id = dict(acks)
+        assert by_id[good1.batch_id] is True
+        assert by_id[bad.batch_id] is False
+        assert by_id[good2.batch_id] is True
+        from distributed_crawler_tpu.inference.worker import iter_results
+        assert len(list(iter_results(provider, "g1"))) == 2
+        assert len(list(iter_results(provider, "g2"))) == 2
+        assert len(list(iter_results(provider, "bad"))) == 0
+        assert worker.status()["errors"] == 1
+
+    def test_coalesce_disabled_processes_singly(self):
+        provider = InMemoryStorageProvider()
+        bus, worker, acks = self._make(provider=provider, coalesce=1)
+        batches = [RecordBatch.from_posts(_posts(2), crawl_id=f"s{i}")
+                   for i in range(2)]
+        self._run_batches(bus, worker, acks, batches, n_expected=2)
+        assert all(ok for _, ok in acks) and len(acks) == 2
+        assert worker.m_coalesce.count == 0  # never grouped
+
+    def test_coalesced_step_failure_isolates_per_batch(self):
+        """If the COMBINED device step fails, each batch re-runs alone on
+        its already-tokenized ids: all good batches still succeed, no
+        batch's age is double-counted, nothing re-tokenizes."""
+
+        class FlakyEngine(InferenceEngine):
+            tokenize_calls = 0
+
+            def run_tokenized(self, toks, pack=False):
+                if len(toks) > 4:  # the 2x3-text coalesced stream only
+                    raise RuntimeError("combined step wedged")
+                return super().run_tokenized(toks, pack=pack)
+
+        eng = FlakyEngine(
+            EngineConfig(model="tiny", n_labels=3, batch_size=4,
+                         buckets=(16, 32)), registry=MetricsRegistry())
+        inner = eng.tokenizer
+        calls = []
+
+        class CountingTokenizer:
+            def encode_batch(self, texts):
+                calls.append(len(texts))
+                return inner.encode_batch(texts)
+
+        eng.tokenizer = CountingTokenizer()
+        provider = InMemoryStorageProvider()
+        bus, worker, acks = self._make(provider=provider, engine=eng)
+        batches = [RecordBatch.from_posts(_posts(3), crawl_id=f"fl{i}")
+                   for i in range(2)]
+        self._run_batches(bus, worker, acks, batches, n_expected=2)
+        assert sorted(acks) == sorted(
+            [(b.batch_id, True) for b in batches])
+        from distributed_crawler_tpu.inference.worker import iter_results
+        for i in range(2):
+            assert len(list(iter_results(provider, f"fl{i}"))) == 3
+        assert len(calls) == 2  # once per batch at group time; no re-tokenize
+        assert worker.m_batch_age.count <= 2  # never double-observed
+
+    def test_worker_warmup_warms_served_path(self):
+        bus, worker, _ = self._make()
+        worker.warmup()
+        eng = worker.engine
+        assert set(eng._packed_steps) == set(eng.bucket_spec.lengths)
+        assert not eng._steps  # pack=True serves ONLY packed programs
+
+    def test_engine_without_coalesce_support_falls_back(self):
+        """Engines predating run_tokenized/pack (test doubles, older
+        deployments) must still work through the one-batch path."""
+
+        class MinimalEngine:
+            cfg = EngineConfig()
+
+            def run(self, texts):
+                return [{"label": 0, "scores": [1.0]} for _ in texts]
+
+        bus, worker, acks = self._make(engine=MinimalEngine())
+        assert worker._engine_coalesces is False
+        batches = [RecordBatch.from_posts(_posts(2), crawl_id=f"f{i}")
+                   for i in range(3)]
+        self._run_batches(bus, worker, acks, batches, n_expected=3)
+        assert all(ok for _, ok in acks) and len(acks) == 3
+        assert worker.status()["processed"] == 3
 
 
 class TestMetricsEndpoint:

@@ -77,6 +77,81 @@ class TestClassifier:
         assert logits.shape == (4, 5)
 
 
+def _packed_inputs(toks, bucket, max_segments):
+    from distributed_crawler_tpu.ops.padding import pack_rows
+
+    p = pack_rows(toks, bucket, max_segments=max_segments)
+    return p, (jnp.asarray(p.ids), jnp.asarray(p.mask),
+               jnp.asarray(p.segment_ids), jnp.asarray(p.positions))
+
+
+class TestPackedExecution:
+    """The packed path (segment_ids/positions + n_segments) is a FLOPs
+    optimization, never a semantic change: per-segment outputs must match
+    each sequence's unpacked run, and one segment's tokens must not be able
+    to influence another's output at all."""
+
+    TOKS = [[3, 4, 5, 6], [7, 8, 9], [10, 11, 12, 13, 14, 15],
+            [16, 17], [18, 19, 20, 21, 22]]
+
+    def _model_params(self, n_labels=3):
+        model = EmbedderClassifier(replace(TINY_TEST, n_labels=n_labels))
+        ids, mask = _batch()
+        params = model.init(jax.random.PRNGKey(0), ids, mask)
+        return model, params
+
+    def test_packed_matches_unpacked(self):
+        model, params = self._model_params()
+        bucket = 16
+        ids0 = np.zeros((len(self.TOKS), bucket), np.int32)
+        m0 = np.zeros((len(self.TOKS), bucket), bool)
+        for i, t in enumerate(self.TOKS):
+            ids0[i, :len(t)] = t
+            m0[i, :len(t)] = True
+        emb_u, log_u = model.apply(params, jnp.asarray(ids0),
+                                   jnp.asarray(m0))
+        p, arrs = _packed_inputs(self.TOKS, bucket, max_segments=4)
+        emb_p, log_p = model.apply(params, *arrs, 4)
+        assert emb_p.shape[1:] == (4, TINY_TEST.hidden)
+        emb_p, log_p = np.asarray(emb_p), np.asarray(log_p)
+        for r, row in enumerate(p.assignments):
+            for s, orig in enumerate(row):
+                np.testing.assert_allclose(
+                    emb_p[r, s], np.asarray(emb_u)[orig], atol=2e-5)
+                np.testing.assert_allclose(
+                    log_p[r, s], np.asarray(log_u)[orig], atol=2e-4)
+
+    def test_segment_isolation_bit_identical(self):
+        """Perturb every token of one packed segment: every OTHER segment's
+        embedding and logits must be bit-identical (f32 tiny config — the
+        masking is exact, not approximate)."""
+        model, params = self._model_params()
+        p, arrs = _packed_inputs(self.TOKS, 16, max_segments=4)
+        row0 = p.assignments[0]
+        assert len(row0) >= 2, "fixture must pack >= 2 segments in row 0"
+        emb_a, log_a = model.apply(params, *arrs, 4)
+        # Replace segment 1's tokens in row 0 with different ids.
+        ids2 = np.array(p.ids)
+        ids2[0][np.array(p.segment_ids[0]) == 1] = 999
+        emb_b, log_b = model.apply(params, jnp.asarray(ids2), arrs[1],
+                                   arrs[2], arrs[3], 4)
+        emb_a, emb_b = np.asarray(emb_a), np.asarray(emb_b)
+        log_a, log_b = np.asarray(log_a), np.asarray(log_b)
+        # Segment 1 itself did change...
+        assert not np.array_equal(emb_a[0, 0], emb_b[0, 0])
+        # ...every other slot of the row, and every other row, did not.
+        assert np.array_equal(emb_a[0, 1:], emb_b[0, 1:])
+        assert np.array_equal(log_a[0, 1:], log_b[0, 1:])
+        assert np.array_equal(emb_a[1:], emb_b[1:])
+        assert np.array_equal(log_a[1:], log_b[1:])
+
+    def test_packed_requires_n_segments(self):
+        model, params = self._model_params()
+        _, arrs = _packed_inputs(self.TOKS, 16, max_segments=4)
+        with pytest.raises(ValueError, match="n_segments"):
+            model.apply(params, *arrs, 0)
+
+
 class TestMoE:
     def test_moe_forward(self):
         ids, mask = _batch()

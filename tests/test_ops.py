@@ -11,6 +11,7 @@ from distributed_crawler_tpu.ops import (
     flash_attention,
     mha,
     pack_batch,
+    pack_rows,
     pad_to_bucket,
 )
 from distributed_crawler_tpu.ops.padding import group_by_bucket
@@ -136,3 +137,128 @@ class TestPadding:
                                  BucketSpec((32, 64)))
         assert groups[32] == [0, 2]
         assert groups[64] == [1]
+
+
+class TestPackRows:
+    def test_every_sequence_placed_exactly_once(self):
+        seqs = [[i] * n for i, n in enumerate([3, 5, 10, 2, 7, 4, 6, 1])]
+        p = pack_rows(seqs, 16, max_segments=4)
+        placed = sorted(i for row in p.assignments for i in row)
+        assert placed == list(range(len(seqs)))
+
+    def test_row_arrays_match_assignments(self):
+        seqs = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [10]]
+        p = pack_rows(seqs, 8, max_segments=4)
+        for r, row in enumerate(p.assignments):
+            off = 0
+            for s, orig in enumerate(row, start=1):
+                n = len(seqs[orig])
+                assert p.ids[r, off:off + n].tolist() == seqs[orig]
+                assert p.mask[r, off:off + n].all()
+                assert (p.segment_ids[r, off:off + n] == s).all()
+                # Positions restart at 0 per segment: packed sequences see
+                # the same absolute position embeddings as unpacked ones.
+                assert p.positions[r, off:off + n].tolist() == list(range(n))
+                off += n
+            assert not p.mask[r, off:].any()
+            assert (p.segment_ids[r, off:] == 0).all()
+
+    def test_occupancy_bounds(self):
+        seqs = [[1]] * 40  # 40 one-token sequences
+        p = pack_rows(seqs, 16, max_segments=8)
+        assert max(len(row) for row in p.assignments) <= 8
+        assert p.n_rows == 5  # 40 / 8 slots per row
+        assert (p.mask.sum(axis=1) <= 16).all()
+
+    def test_token_capacity_respected(self):
+        seqs = [[1] * 10, [2] * 10, [3] * 10]
+        p = pack_rows(seqs, 16, max_segments=8)
+        # 10+10 > 16: each row holds one sequence despite free slots.
+        assert p.n_rows == 3
+
+    def test_overlong_truncates_to_bucket(self):
+        p = pack_rows([list(range(20))], 8)
+        assert p.ids[0].tolist() == list(range(8))
+        assert p.mask[0].all()
+
+    def test_denser_than_one_row_each(self):
+        seqs = [[1] * 4 for _ in range(32)]
+        p = pack_rows(seqs, 32, max_segments=8)
+        assert p.n_rows == 4  # 8 x 4 tokens per 32-row, vs 32 unpacked rows
+
+    def test_bad_args_raise(self):
+        with pytest.raises(ValueError):
+            pack_rows([[1]], 0)
+        with pytest.raises(ValueError):
+            pack_rows([[1]], 8, max_segments=0)
+        with pytest.raises(ValueError):
+            pack_rows([[1], [2]], 8, indices=[5])
+
+
+def _packed_attention_fixture(seed=3):
+    """Two packed rows: row 0 = segments 1 (6 tok) + 2 (6 tok) + padding,
+    row 1 = one segment of 10 + padding."""
+    rng = np.random.default_rng(seed)
+    b, l, h, d = 2, 16, 2, 8
+    q = jnp.asarray(rng.normal(size=(b, l, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, l, h, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, l, h, d)), jnp.float32)
+    seg = np.zeros((b, l), np.int32)
+    seg[0, :6] = 1
+    seg[0, 6:12] = 2
+    seg[1, :10] = 1
+    mask = jnp.asarray(seg > 0)
+    return q, k, v, mask, jnp.asarray(seg)
+
+
+class TestSegmentAttention:
+    def test_segments_isolated_bit_identical(self):
+        """Perturbing every tensor of segment 1 leaves segment 2's output
+        BIT-identical: masked scores are replaced by a constant before the
+        softmax and re-zeroed after, so neighbor values never reach it."""
+        q, k, v, mask, seg = _packed_attention_fixture()
+        base = np.asarray(attend(q, k, v, mask, segment_ids=seg))
+        q2 = q.at[0, :6].set(77.0)
+        k2 = k.at[0, :6].set(99.0)
+        v2 = v.at[0, :6].set(-55.0)
+        out = np.asarray(attend(q2, k2, v2, mask, segment_ids=seg))
+        assert np.array_equal(base[0, 6:12], out[0, 6:12])
+        assert np.array_equal(base[1], out[1])  # other row untouched
+
+    def test_packed_matches_each_segment_alone(self):
+        """A packed segment's output equals running that segment through
+        attention on its own (the packing-changes-nothing contract)."""
+        q, k, v, mask, seg = _packed_attention_fixture()
+        packed = np.asarray(attend(q, k, v, mask, segment_ids=seg))
+        for row, sl in ((0, slice(0, 6)), (0, slice(6, 12)),
+                        (1, slice(0, 10))):
+            alone = attend(q[row:row + 1, sl], k[row:row + 1, sl],
+                           v[row:row + 1, sl])
+            np.testing.assert_allclose(packed[row, sl],
+                                       np.asarray(alone)[0], atol=1e-6)
+
+    def test_flash_matches_attend_with_segments(self):
+        q, k, v, mask, seg = _packed_attention_fixture()
+        ref = attend(q, k, v, mask, segment_ids=seg)
+        out = flash_attention(q, k, v, mask, block_q=8, interpret=True,
+                              segment_ids=seg)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_flash_segments_isolated(self):
+        q, k, v, mask, seg = _packed_attention_fixture()
+        base = np.asarray(flash_attention(q, k, v, mask, block_q=8,
+                                          interpret=True, segment_ids=seg))
+        k2 = k.at[0, :6].set(99.0)
+        v2 = v.at[0, :6].set(-55.0)
+        out = np.asarray(flash_attention(q, k2, v2, mask, block_q=8,
+                                         interpret=True, segment_ids=seg))
+        assert np.array_equal(base[0, 6:12], out[0, 6:12])
+        assert np.array_equal(base[1], out[1])
+
+    def test_mha_threads_segment_ids(self):
+        q, k, v, mask, seg = _packed_attention_fixture()
+        out = mha(q, k, v, mask, segment_ids=seg)  # CPU -> XLA path
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.asarray(attend(q, k, v, mask, segment_ids=seg)), atol=1e-6)

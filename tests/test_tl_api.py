@@ -77,6 +77,39 @@ class TestRequestCodec:
         with pytest.raises(ValueError, match="unknown TL function"):
             deserialize_request(struct.pack("<I", 0xDEADBEEF))
 
+    def test_trailing_garbage_rejected(self):
+        """A frame followed by extra bytes is forged/corrupt and must raise
+        ValueError, not silently parse the prefix."""
+        whole = serialize_request({"@type": "getChat", "chat_id": 7})
+        for junk in (b"\x00", b"\x00\x00\x00\x00", b"garbage!"):
+            with pytest.raises(ValueError, match="trailing"):
+                deserialize_request(whole + junk)
+
+    def test_stats_counters_thread_safe(self):
+        """Concurrent gateway sessions share STATS; N threads x M frames
+        must count exactly N*M (the lock-free read-modify-write undercounts
+        under contention)."""
+        import threading
+
+        frame = serialize_request({"@type": "getChat", "chat_id": 1})
+        n_threads, n_frames = 8, 250
+        before = tl_api.STATS["typed_requests"]
+        barrier = threading.Barrier(n_threads)
+
+        def worker():
+            barrier.wait()
+            for _ in range(n_frames):
+                deserialize_request(frame)
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert tl_api.STATS["typed_requests"] - before == \
+            n_threads * n_frames
+
     def test_truncated_frames_raise_valueerror(self):
         """Adversarial truncation must surface as ValueError — the class
         the gateway session loop catches — never struct.error/IndexError
@@ -142,6 +175,33 @@ class TestResultCodec:
         assert req_msg_id == 9
         assert obj == resp
 
+    def test_result_trailing_garbage_rejected(self):
+        frame = serialize_result({"@type": "ok"}, 5)
+        with pytest.raises(ValueError, match="trailing"):
+            deserialize_frame(frame + b"\x00\x00\x00\x00")
+        upd = serialize_update({"@type": "updateAuthorizationState"})
+        with pytest.raises(ValueError, match="trailing"):
+            deserialize_frame(upd + b"x")
+
+    def test_negative_vector_count_rejected(self):
+        """Forge the messages vector's count to -1: the old code ranged
+        over nothing and returned an empty vector with the element bytes
+        left as garbage; now it must raise."""
+        msgs = {"@type": "messages", "total_count": 1, "messages": [
+            {"@type": "message", "id": 1, "chat_id": 2, "date": 3,
+             "view_count": 0, "forward_count": 0, "reply_count": 0,
+             "message_thread_id": 0, "reply_to_message_id": 0,
+             "sender_id": 0, "sender_username": "u",
+             "is_channel_post": True, "content": None,
+             "reactions": None}]}
+        frame = bytearray(serialize_result(dict(msgs), 42))
+        # rpc_result(4) + req_msg_id(8) + messages cid(4) + total_count(8)
+        # + Vector cid(4) -> count lives at bytes [28:32).
+        assert frame[24:28] == struct.pack("<I", tl_api.VECTOR)
+        frame[28:32] = struct.pack("<i", -1)
+        with pytest.raises(ValueError, match="negative TL vector count"):
+            deserialize_frame(bytes(frame))
+
     def test_update_frame_has_no_correlation(self):
         upd = {"@type": "updateAuthorizationState",
                "authorization_state": {"@type": "authorizationStateReady"}}
@@ -205,11 +265,15 @@ class TestCppClientSendsTypedTl:
 class TestProperties:
     """Property-based coverage (hypothesis): the TL codec must roundtrip
     arbitrary field values — unicode, astral chars, negative ints, 64-bit
-    extremes, arbitrary JSON content — byte-exactly."""
+    extremes, arbitrary JSON content — byte-exactly.
 
-    hypothesis = pytest.importorskip("hypothesis")
+    importorskip runs INSIDE each test (the test_inference.py pattern): a
+    class-body skip executes at import time and would skip this whole
+    module — including the schema/codec tests above — on hosts without
+    hypothesis."""
 
     def test_typed_function_roundtrip_property(self):
+        pytest.importorskip("hypothesis")
         from hypothesis import given, settings
         from hypothesis import strategies as st
 
@@ -227,6 +291,7 @@ class TestProperties:
         check()
 
     def test_string_field_roundtrip_property(self):
+        pytest.importorskip("hypothesis")
         from hypothesis import given, settings
         from hypothesis import strategies as st
 
@@ -239,6 +304,7 @@ class TestProperties:
         check()
 
     def test_raw_fallback_roundtrip_property(self):
+        pytest.importorskip("hypothesis")
         from hypothesis import given, settings
         from hypothesis import strategies as st
 
@@ -260,6 +326,7 @@ class TestProperties:
         check()
 
     def test_result_datajson_roundtrip_property(self):
+        pytest.importorskip("hypothesis")
         from hypothesis import given, settings
         from hypothesis import strategies as st
 
