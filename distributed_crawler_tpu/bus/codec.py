@@ -34,6 +34,7 @@ except Exception:  # pragma: no cover - zstd is present in the target image
 from ..datamodel import Post
 from ..datamodel.post import format_time, parse_time
 from ..state.datamodels import new_id, utcnow
+from .messages import new_trace_id
 
 CODEC_VERSION = 1
 COMPRESSION_ZSTD = "zstd"
@@ -118,8 +119,11 @@ class RecordBatch:
     @classmethod
     def from_posts(cls, posts: List[Post], crawl_id: str = "",
                    trace_id: str = "") -> "RecordBatch":
+        # Every batch gets a trace id at birth: the TPU worker's queue-wait
+        # / coalesce / engine-stage spans hang off it, so a batch with no
+        # id would be invisible to /traces.
         return cls(batch_id=new_id(), crawl_id=crawl_id, created_at=utcnow(),
-                   trace_id=trace_id,
+                   trace_id=trace_id or new_trace_id(),
                    records=[p.to_dict() for p in posts])
 
     def posts(self) -> List[Post]:

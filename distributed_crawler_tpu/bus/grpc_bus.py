@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 import grpc
 
+from ..utils import trace
 from .payload import serialize_payload
 
 logger = logging.getLogger("dct.bus.grpc")
@@ -168,26 +169,28 @@ class GrpcBusServer:
             try:
                 with self._lock:
                     handlers = list(self._handlers.get(topic, []))
-                for handler in handlers:
-                    delivered = False
-                    for attempt in range(self.max_attempts):
-                        try:
-                            handler(decoded)
-                            delivered = True
-                            break
-                        except Exception as e:
-                            logger.warning(
-                                "local handler error on %s "
-                                "(attempt %d/%d): %s", topic, attempt + 1,
-                                self.max_attempts, e)
-                            if attempt + 1 < self.max_attempts:
-                                self._stop.wait(min(0.05 * (2 ** attempt),
-                                                    0.5))
-                    if not delivered:
-                        self._count_dead_letter()
-                        logger.error(
-                            "dead-lettering local delivery on %s after %d "
-                            "attempts", topic, self.max_attempts)
+                with trace.payload_span("bus.deliver", decoded, topic=topic,
+                                        transport="grpc-local"):
+                    for handler in handlers:
+                        delivered = False
+                        for attempt in range(self.max_attempts):
+                            try:
+                                handler(decoded)
+                                delivered = True
+                                break
+                            except Exception as e:
+                                logger.warning(
+                                    "local handler error on %s "
+                                    "(attempt %d/%d): %s", topic, attempt + 1,
+                                    self.max_attempts, e)
+                                if attempt + 1 < self.max_attempts:
+                                    self._stop.wait(min(0.05 * (2 ** attempt),
+                                                        0.5))
+                        if not delivered:
+                            self._count_dead_letter()
+                            logger.error(
+                                "dead-lettering local delivery on %s after "
+                                "%d attempts", topic, self.max_attempts)
             finally:
                 with self._local_idle:
                     self._local_inflight -= 1
@@ -323,6 +326,7 @@ class GrpcBusServer:
     def publish(self, topic: str, payload: Any) -> None:
         """Local publish: same fan-out as a remote Publish RPC, so the host
         process (e.g. the orchestrator) can use the server as its bus."""
+        payload = trace.inject(payload)
         self._publish_rpc(_encode_envelope(topic, serialize_payload(payload)),
                           None)
 
@@ -408,6 +412,10 @@ class GrpcBusClient:
             response_deserializer=_identity)
 
     def publish(self, topic: str, payload: Any) -> None:
+        # Same propagation seam as InMemoryBus.publish: the envelope
+        # crosses a process boundary here, which is exactly the hop the
+        # parent_span stamp exists for.
+        payload = trace.inject(payload)
         self._publish(_encode_envelope(topic, serialize_payload(payload)))
 
     def publish_frame(self, topic: str, frame: bytes) -> None:
@@ -571,25 +579,30 @@ class RemoteBus:
                     acked.set()
                     self._safe_ack(topic, delivery_id, ok)
 
-            try:
-                handler(payload, ack)
-            except Exception as e:
-                logger.warning("handler error on %s: %s", topic, e)
-                ack(False)
+            with trace.payload_span("bus.deliver", payload, topic=topic,
+                                    transport="grpc", manual_ack=True):
+                try:
+                    handler(payload, ack)
+                except Exception as e:
+                    logger.warning("handler error on %s: %s", topic, e)
+                    ack(False)
             return
         ok = True
-        for handler, _ in handlers:
-            delivered = False
-            for attempt in range(self.max_redeliveries + 1):
-                try:
-                    handler(payload)
-                    delivered = True
-                    break
-                except Exception as e:
-                    logger.warning("handler error on %s (attempt %d/%d): %s",
-                                   topic, attempt + 1,
-                                   self.max_redeliveries + 1, e)
-            ok = ok and delivered
+        with trace.payload_span("bus.deliver", payload, topic=topic,
+                                transport="grpc"):
+            for handler, _ in handlers:
+                delivered = False
+                for attempt in range(self.max_redeliveries + 1):
+                    try:
+                        handler(payload)
+                        delivered = True
+                        break
+                    except Exception as e:
+                        logger.warning(
+                            "handler error on %s (attempt %d/%d): %s",
+                            topic, attempt + 1,
+                            self.max_redeliveries + 1, e)
+                ok = ok and delivered
         # NACK on final failure: the server requeues (bumping its attempt
         # count) so another worker can take the item instead of it being
         # silently dropped.

@@ -21,6 +21,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..utils import trace
 from .payload import serialize_payload
 
 logger = logging.getLogger("dct.bus")
@@ -76,7 +77,13 @@ class InMemoryBus:
 
     # --- publish ----------------------------------------------------------
     def publish(self, topic: str, payload: Any) -> None:
-        """Publish a dict (JSON-serialized) or raw bytes to a topic."""
+        """Publish a dict (JSON-serialized) or raw bytes to a topic.
+
+        Trace propagation: a dict payload carrying a ``trace_id`` is
+        stamped with the publisher's open span as ``parent_span``
+        (`utils/trace.inject`), so the delivery span on the consumer side
+        links back to the publish site across the hop."""
+        payload = trace.inject(payload)
         data = serialize_payload(payload)
         with self._lock:
             self._published_count[topic] = self._published_count.get(topic, 0) + 1
@@ -103,27 +110,32 @@ class InMemoryBus:
             return
         with self._lock:
             handlers = list(self._handlers.get(topic, []))
-        for handler in handlers:
-            delivered = False
-            last_err = ""
-            for attempt in range(self.max_redeliveries + 1):
-                try:
-                    handler(payload)
-                    delivered = True
-                    break
-                except Exception as e:  # handler error -> retry (`pubsub.go:166-171`)
-                    last_err = str(e)
-                    logger.warning("handler error on %s (attempt %d/%d): %s",
-                                   topic, attempt + 1,
-                                   self.max_redeliveries + 1, e)
-                    if self.retry_delay_s > 0:
-                        time.sleep(self.retry_delay_s)
-            with self._lock:
-                if delivered:
-                    self._delivered_count[topic] = \
-                        self._delivered_count.get(topic, 0) + 1
-                else:
-                    self._dead_letters.append((topic, payload, last_err))
+        # The delivery hop is a span of the envelope's trace (no-op for
+        # untraced payloads): handler spans nest under it, so one trace
+        # walks publish -> deliver -> handler stages.
+        with trace.payload_span("bus.deliver", payload, topic=topic,
+                                transport="inmemory"):
+            for handler in handlers:
+                delivered = False
+                last_err = ""
+                for attempt in range(self.max_redeliveries + 1):
+                    try:
+                        handler(payload)
+                        delivered = True
+                        break
+                    except Exception as e:  # handler error -> retry (`pubsub.go:166-171`)
+                        last_err = str(e)
+                        logger.warning("handler error on %s (attempt %d/%d): %s",
+                                       topic, attempt + 1,
+                                       self.max_redeliveries + 1, e)
+                        if self.retry_delay_s > 0:
+                            time.sleep(self.retry_delay_s)
+                with self._lock:
+                    if delivered:
+                        self._delivered_count[topic] = \
+                            self._delivered_count.get(topic, 0) + 1
+                    else:
+                        self._dead_letters.append((topic, payload, last_err))
 
     # --- introspection (tests + metrics) ----------------------------------
     @property

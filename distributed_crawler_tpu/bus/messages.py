@@ -251,10 +251,15 @@ class WorkQueueMessage:
     @classmethod
     def new(cls, item: WorkItem, priority: int = PRIORITY_MEDIUM,
             ttl_seconds: int = 3600) -> "WorkQueueMessage":
-        """`messages.go:195-204`."""
+        """`messages.go:195-204` — except the envelope INHERITS the work
+        item's trace id instead of minting a fresh one, so the dispatch
+        span, the delivery span, and the worker's processing spans all
+        correlate to one trace (the reference generated an id per envelope
+        that nothing ever joined)."""
         return cls(message_type=MSG_WORK_ITEM, work_item=item,
                    priority=priority, timestamp=utcnow(),
-                   ttl_seconds=ttl_seconds, trace_id=new_trace_id())
+                   ttl_seconds=ttl_seconds,
+                   trace_id=item.trace_id or new_trace_id())
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -385,11 +390,14 @@ class ResultMessage:
 
     @classmethod
     def new(cls, result: WorkResult,
-            discovered_pages: Optional[List[DiscoveredPage]] = None) -> "ResultMessage":
-        """`messages.go:222-230`."""
+            discovered_pages: Optional[List[DiscoveredPage]] = None,
+            trace_id: str = "") -> "ResultMessage":
+        """`messages.go:222-230`; pass the originating work item's
+        ``trace_id`` so the result leg joins the dispatch leg's trace
+        (a fresh id is minted only for untraced callers)."""
         return cls(message_type=MSG_WORK_RESULT, work_result=result,
                    discovered_pages=list(discovered_pages or []),
-                   timestamp=utcnow(), trace_id=new_trace_id())
+                   timestamp=utcnow(), trace_id=trace_id or new_trace_id())
 
     def to_dict(self) -> Dict[str, Any]:
         return {

@@ -136,6 +136,12 @@ def build_parser() -> argparse.ArgumentParser:
     a("--profiler-port", type=int, default=None,
       help="serve a jax.profiler trace server on this port (0 = off; "
            "the reference's :6060 pprof analog)")
+    a("--trace-buffer", type=int, default=None,
+      help="completed spans kept in memory for the /traces endpoint "
+           "(0 disables span recording; default 2048)")
+    a("--slow-trace-ms", type=float, default=None,
+      help="log any span slower than this many milliseconds "
+           "(0 = off, the default)")
     # TPU inference stage
     a("--bus-serve", action="store_const", const=True, default=None,
       help="also HOST the gRPC bus broker at --bus-address (tpu-worker "
@@ -334,6 +340,8 @@ _KEY_MAP = {
     "job_delete": "job.delete",
     "metrics_port": "observability.metrics_port",
     "profiler_port": "observability.profiler_port",
+    "trace_buffer": "observability.trace_buffer",
+    "slow_trace_ms": "observability.slow_trace_ms",
     "infer": "inference.enabled",
     "infer_model": "inference.model",
     "infer_backpressure_high": "distributed.inference_backpressure_high",
@@ -535,6 +543,13 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
         return 2
     setup_logging(r.get_str("logging.level", "info"),
                   json_output=r.get_bool("logging.json", False))
+    # Tracer knobs apply to EVERY mode (the tracer is process-global and
+    # the tpu-worker's own metrics server serves /traces from it too).
+    from .utils import trace as _trace
+
+    _trace.configure(
+        capacity=r.get_int("observability.trace_buffer", 2048),
+        slow_span_s=r.get_float("observability.slow_trace_ms", 0.0) / 1000.0)
 
     mode = r.get_str("distributed.mode", "")
     # Observability servers for every mode (`main.go:60-80` ran pprof

@@ -23,6 +23,7 @@ import time
 from ..bus.codec import BatchAccumulator, RecordBatch
 from ..bus.messages import TOPIC_INFERENCE_BATCHES
 from ..datamodel import Post
+from ..utils import trace
 
 logger = logging.getLogger("dct.inference.bridge")
 
@@ -83,7 +84,13 @@ class InferenceBridge:
 
     def _publish(self, batch: RecordBatch) -> None:
         try:
-            self._bus.publish(self._topic, batch.to_dict())
+            # Root span of the batch's trace (the orchestrator-process
+            # dispatch of inference work): queue wait, coalesce, and the
+            # engine stages downstream all share batch.trace_id.
+            with trace.span("orchestrator.dispatch",
+                            trace_id=batch.trace_id, batch=batch.batch_id,
+                            records=len(batch), crawl_id=batch.crawl_id):
+                self._bus.publish(self._topic, batch.to_dict())
             self.batches_published += 1
         except Exception as e:
             logger.error("failed to publish record batch", extra={

@@ -32,6 +32,7 @@ from ..ops.padding import (
     pack_batch,
     pack_rows,
 )
+from ..utils import trace
 from ..utils.metrics import REGISTRY, MetricsRegistry
 from .tokenizer import HashingTokenizer, Tokenizer
 
@@ -196,6 +197,20 @@ class InferenceEngine:
         self.m_packed = registry.counter(
             "tpu_inference_packed_segments_total",
             "sequences served through packed bucket rows")
+        # Labeled by padding bucket: the per-bucket split of m_posts, so a
+        # stream drifting into oversized buckets is visible on /metrics
+        # instead of only as a padding-counter creep.
+        self.m_bucket_posts = registry.counter(
+            "tpu_inference_bucket_posts_total",
+            "posts through embed+classify per padding bucket")
+        # A miss = first dispatch of a (bucket, path) program in this
+        # process (XLA compiles on that first call).  Serving steady-state
+        # should see this flat after warmup; a moving counter means live
+        # batches are paying compiles.
+        self.m_compile_miss = registry.counter(
+            "tpu_engine_compile_cache_misses_total",
+            "jit program builds by bucket and path (first-dispatch "
+            "compiles)")
 
         if params is None:
             import jax.numpy as jnp
@@ -274,6 +289,8 @@ class InferenceEngine:
 
         fn = self._steps.get(bucket)
         if fn is None:
+            self.m_compile_miss.labels(bucket=str(bucket),
+                                       path="unpacked").inc()
             fn = jax.jit(lambda p, i, m: self.model.apply(p, i, m))
             self._steps[bucket] = fn
         return fn
@@ -283,6 +300,8 @@ class InferenceEngine:
 
         fn = self._packed_steps.get(bucket)
         if fn is None:
+            self.m_compile_miss.labels(bucket=str(bucket),
+                                       path="packed").inc()
             n_seg = self.cfg.pack_max_segments
             # n_seg closes over as a static: the only new program per
             # bucket is this one (the segment-id/position operands); every
@@ -319,11 +338,26 @@ class InferenceEngine:
         masks, so short-text streams stop paying MXU/HBM for pad tokens.
         Prefer ``pack=False`` for long-sequence-dominated streams (rows
         near their bucket length pack 1:1 and only pay the extra operand).
+
+        Every call runs under an ``engine.run_tokenized`` span with
+        per-stage children (pack / device_put / compute / unpack) — inside
+        an ambient trace (the TPU worker's) they join it; standalone calls
+        root a fresh trace so /traces still shows the stage breakdown.
+        Note the pipeline when reading spans: ``engine.compute`` is the
+        async dispatch, and the device time it starts overlaps the NEXT
+        chunk's pack/dispatch; the blocking device→host readback is
+        ``engine.unpack``.
         """
-        if any(not t for t in token_lists):
-            return self._run_with_empties(token_lists, pack)
-        if pack:
-            return self._run_packed(token_lists)
+        with trace.span("engine.run_tokenized",
+                        sequences=len(token_lists), pack=bool(pack)):
+            if any(not t for t in token_lists):
+                return self._run_with_empties(token_lists, pack)
+            if pack:
+                return self._run_packed(token_lists)
+            return self._run_unpacked(token_lists)
+
+    def _run_unpacked(self, token_lists: Sequence[List[int]]
+                      ) -> List[Dict[str, Any]]:
         results: List[Optional[Dict[str, Any]]] = [None] * len(token_lists)
         groups: Dict[int, List[int]] = {}
         for i, toks in enumerate(token_lists):
@@ -334,34 +368,42 @@ class InferenceEngine:
         pending: Optional[tuple] = None  # (chunk, emb_dev, logits_dev, t0)
 
         def materialize(chunk, emb, logits, t0):
-            emb_np = np.asarray(emb)         # device->host sync
-            logits_np = np.asarray(logits)
-            # Histogram semantics: dispatch→results-on-host per batch.
-            # Under the pipeline this window ALSO contains the next
-            # batch's host-side pack+dispatch (which overlapped this
-            # batch's device time) — see the metric's help text.
-            self.m_latency.observe(time.perf_counter() - t0)
-            self.m_posts.inc(len(chunk))
-            self.m_padding.inc(bs - len(chunk))
-            scores = _softmax_np(logits_np)
-            for row, i in enumerate(chunk):
-                label = int(np.argmax(logits_np[row]))
-                results[i] = {
-                    "embedding": emb_np[row].tolist(),
-                    "label": label,
-                    "scores": scores[row].tolist(),
-                }
-                if self.label_names and label < len(self.label_names):
-                    results[i]["label_name"] = self.label_names[label]
+            with trace.span("engine.unpack", rows=len(chunk)):
+                emb_np = np.asarray(emb)         # device->host sync
+                logits_np = np.asarray(logits)
+                # Histogram semantics: dispatch→results-on-host per batch.
+                # Under the pipeline this window ALSO contains the next
+                # batch's host-side pack+dispatch (which overlapped this
+                # batch's device time) — see the metric's help text.
+                self.m_latency.observe(time.perf_counter() - t0)
+                self.m_posts.inc(len(chunk))
+                self.m_padding.inc(bs - len(chunk))
+                scores = _softmax_np(logits_np)
+                for row, i in enumerate(chunk):
+                    label = int(np.argmax(logits_np[row]))
+                    results[i] = {
+                        "embedding": emb_np[row].tolist(),
+                        "label": label,
+                        "scores": scores[row].tolist(),
+                    }
+                    if self.label_names and label < len(self.label_names):
+                        results[i]["label_name"] = self.label_names[label]
 
         for bucket, indices in sorted(groups.items()):
             for start in range(0, len(indices), bs):
                 chunk = indices[start:start + bs]
-                ids, mask = pack_batch([token_lists[i] for i in chunk],
-                                       BucketSpec((bucket,)), batch_pad_to=bs)
+                self.m_bucket_posts.labels(bucket=str(bucket)).inc(len(chunk))
+                with trace.span("engine.pack", bucket=bucket,
+                                rows=len(chunk)):
+                    ids, mask = pack_batch(
+                        [token_lists[i] for i in chunk],
+                        BucketSpec((bucket,)), batch_pad_to=bs)
+                with trace.span("engine.device_put", bucket=bucket):
+                    placed = self._place(ids, mask)
                 t0 = time.perf_counter()
-                emb, logits = self._step(bucket)(
-                    self.params, *self._place(ids, mask))
+                with trace.span("engine.compute", bucket=bucket, batch=bs,
+                                sequences=len(chunk)):
+                    emb, logits = self._step(bucket)(self.params, *placed)
                 if pending is not None:
                     materialize(*pending)
                 pending = (chunk, emb, logits, t0)
@@ -410,28 +452,33 @@ class InferenceEngine:
         pending: Optional[tuple] = None  # (slots, used, emb, logits, t0)
 
         def materialize(slots, used_rows, emb, logits, t0):
-            emb_np = np.asarray(emb)        # device->host sync
-            logits_np = np.asarray(logits)  # [bs, S, n_labels]
-            self.m_latency.observe(time.perf_counter() - t0)
-            self.m_posts.inc(len(slots))
-            self.m_packed.inc(len(slots))
-            self.m_padding.inc(bs - used_rows)
-            flat = logits_np.reshape(-1, logits_np.shape[-1])
-            scores = _softmax_np(flat).reshape(logits_np.shape)
-            for row, slot, i in slots:
-                label = int(np.argmax(logits_np[row, slot]))
-                results[i] = {
-                    "embedding": emb_np[row, slot].tolist(),
-                    "label": label,
-                    "scores": scores[row, slot].tolist(),
-                }
-                if self.label_names and label < len(self.label_names):
-                    results[i]["label_name"] = self.label_names[label]
+            with trace.span("engine.unpack", segments=len(slots),
+                            rows=used_rows):
+                emb_np = np.asarray(emb)        # device->host sync
+                logits_np = np.asarray(logits)  # [bs, S, n_labels]
+                self.m_latency.observe(time.perf_counter() - t0)
+                self.m_posts.inc(len(slots))
+                self.m_packed.inc(len(slots))
+                self.m_padding.inc(bs - used_rows)
+                flat = logits_np.reshape(-1, logits_np.shape[-1])
+                scores = _softmax_np(flat).reshape(logits_np.shape)
+                for row, slot, i in slots:
+                    label = int(np.argmax(logits_np[row, slot]))
+                    results[i] = {
+                        "embedding": emb_np[row, slot].tolist(),
+                        "label": label,
+                        "scores": scores[row, slot].tolist(),
+                    }
+                    if self.label_names and label < len(self.label_names):
+                        results[i]["label_name"] = self.label_names[label]
 
         for bucket, indices in sorted(groups.items()):
-            packed = pack_rows([token_lists[i] for i in indices], bucket,
-                               max_segments=self.cfg.pack_max_segments,
-                               indices=indices)
+            self.m_bucket_posts.labels(bucket=str(bucket)).inc(len(indices))
+            with trace.span("engine.pack", bucket=bucket,
+                            sequences=len(indices), packed=True):
+                packed = pack_rows([token_lists[i] for i in indices], bucket,
+                                   max_segments=self.cfg.pack_max_segments,
+                                   indices=indices)
             for start in range(0, packed.n_rows, bs):
                 end = min(start + bs, packed.n_rows)
                 used = end - start
@@ -450,9 +497,14 @@ class InferenceEngine:
                 slots = [(r - start, s, orig)
                          for r in range(start, end)
                          for s, orig in enumerate(packed.assignments[r])]
+                with trace.span("engine.device_put", bucket=bucket,
+                                packed=True):
+                    placed = self._place(ids, mask, seg, pos)
                 t0 = time.perf_counter()
-                emb, logits = self._packed_step(bucket)(
-                    self.params, *self._place(ids, mask, seg, pos))
+                with trace.span("engine.compute", bucket=bucket, batch=bs,
+                                segments=len(slots), packed=True):
+                    emb, logits = self._packed_step(bucket)(
+                        self.params, *placed)
                 if pending is not None:
                     materialize(*pending)
                 pending = (slots, used, emb, logits, t0)
@@ -462,8 +514,10 @@ class InferenceEngine:
 
     def run(self, texts: Sequence[str],
             pack: bool = False) -> List[Dict[str, Any]]:
-        return self.run_tokenized(self.tokenizer.encode_batch(texts),
-                                  pack=pack)
+        with trace.span("engine.run", texts=len(texts), pack=bool(pack)):
+            with trace.span("engine.tokenize", texts=len(texts)):
+                toks = self.tokenizer.encode_batch(texts)
+            return self.run_tokenized(toks, pack=pack)
 
     def embed(self, texts: Sequence[str]) -> np.ndarray:
         out = self.run(texts)

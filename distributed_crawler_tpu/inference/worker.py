@@ -32,6 +32,7 @@ from ..bus.messages import (
     WORKER_BUSY,
     WORKER_IDLE,
 )
+from ..utils import trace
 from ..utils.metrics import (
     REGISTRY,
     MetricsRegistry,
@@ -110,7 +111,10 @@ class TPUWorker:
         self.engine = engine
         self.provider = provider
         self.cfg = cfg
-        self._queue: "queue.Queue[RecordBatch]" = queue.Queue(cfg.queue_capacity)
+        # Entries are (batch, ack, enqueue_monotonic): the third field is
+        # what turns queue wait from a guess into a span.
+        self._queue: "queue.Queue[Tuple[RecordBatch, Any, float]]" = \
+            queue.Queue(cfg.queue_capacity)
         self._stop = threading.Event()
         self._threads: list = []
         self._idle = threading.Condition()
@@ -137,6 +141,11 @@ class TPUWorker:
         self.m_coalesce = registry.histogram(
             "tpu_worker_coalesced_group_batches",
             "record batches coalesced into one device stream")
+        # Outcome-labeled twin of m_batches: the ok/error split that the
+        # single total hides (use .labels(outcome=...)).
+        self.m_outcomes = registry.counter(
+            "tpu_worker_batch_outcomes_total",
+            "record batches by final commit outcome")
         # Capability probes, not flags: test doubles and older engines that
         # predate pack/coalescing keep working through the one-batch path.
         self._engine_coalesces = (
@@ -257,10 +266,11 @@ class TPUWorker:
         with self._idle:
             self._inflight += 1
         try:
-            self._queue.put((batch, ack), timeout=5.0)
+            self._queue.put((batch, ack, time.monotonic()), timeout=5.0)
         except queue.Full:
             self._finish_one()
             if ack is not None:
+                self.m_outcomes.labels(outcome="requeued").inc()
                 ack(False)  # requeue server-side; don't block the stream
                 return
             raise
@@ -295,22 +305,35 @@ class TPUWorker:
                 for _ in items:
                     self._finish_one()
 
-    def _process_group(self, items: List[Tuple[RecordBatch, Any]]) -> None:
+    def _process_group(self,
+                       items: List[Tuple[RecordBatch, Any, float]]) -> None:
+        now = time.monotonic()
+        for batch, _, enq_t in items:
+            # Queue wait as a span of each batch's own trace: the time
+            # between the bus handler's enqueue and this dequeue, i.e.
+            # what the batch spent behind its neighbors.
+            trace.record("tpu_worker.queue_wait", now - enq_t,
+                         trace_id=batch.trace_id, batch=batch.batch_id,
+                         worker=self.cfg.worker_id)
         if len(items) == 1 or not self._engine_coalesces:
-            for batch, ack in items:
+            for batch, ack, _ in items:
                 self._process_one(batch, ack)
             return
         self.m_coalesce.observe(len(items))
         # Tokenize per batch FIRST: a record whose text cannot tokenize
         # fails its own batch here, before any neighbor joins it on device.
         good: List[Tuple[RecordBatch, Any, List[List[int]]]] = []
-        for batch, ack in items:
+        for batch, ack, _ in items:
             try:
-                toks = self.engine.tokenizer.encode_batch(batch.texts())
+                with trace.span("engine.tokenize",
+                                trace_id=batch.trace_id,
+                                records=len(batch.records)):
+                    toks = self.engine.tokenizer.encode_batch(batch.texts())
                 self._observe_age(batch)
                 good.append((batch, ack, toks))
             except Exception as e:
                 self._errors += 1
+                self.m_outcomes.labels(outcome="error").inc()
                 logger.exception("batch %s failed to tokenize: %s",
                                  batch.batch_id, e)
                 if ack is not None:
@@ -320,8 +343,17 @@ class TPUWorker:
         all_toks = [t for _, _, toks in good for t in toks]
         self._step_started = time.monotonic()
         try:
-            results = self.engine.run_tokenized(all_toks,
-                                                pack=self.cfg.pack)
+            # The coalesce span runs under the FIRST batch's trace (one
+            # device stream has one ambient context); the engine's stage
+            # spans nest below it, and the co-batched ids are attrs so the
+            # other batches' traces point here.
+            with trace.span("tpu_worker.coalesce",
+                            trace_id=good[0][0].trace_id,
+                            batches=len(good),
+                            batch_ids=[b.batch_id for b, _, _ in good],
+                            sequences=len(all_toks)):
+                results = self.engine.run_tokenized(all_toks,
+                                                    pack=self.cfg.pack)
         except Exception as e:
             # The combined step failed; fall back to per-batch execution so
             # one poisoned batch cannot take its coalesced neighbors down.
@@ -349,15 +381,30 @@ class TPUWorker:
         """The ONE copy of the commit/ack/error accounting every path
         shares; ``produce`` yields the batch's results (or raises)."""
         try:
-            self._commit(batch, produce())
+            results = produce()
+            with trace.span("tpu_worker.commit", trace_id=batch.trace_id,
+                            batch=batch.batch_id,
+                            records=len(batch.records)):
+                self._commit(batch, results)
             self._processed += 1
-            if ack is not None:
-                ack(True)
+            self.m_outcomes.labels(outcome="ok").inc()
+            self._ack(batch, ack, True)
         except Exception as e:
             self._errors += 1
+            self.m_outcomes.labels(outcome="error").inc()
             logger.exception("batch %s failed: %s", batch.batch_id, e)
-            if ack is not None:
-                ack(False)
+            self._ack(batch, ack, False)
+
+    def _ack(self, batch: RecordBatch, ack, ok: bool) -> None:
+        if ack is None:
+            return
+        t0 = time.perf_counter()
+        ack(ok)
+        # Retroactive span: on RemoteBus this is the Ack RPC round trip
+        # closing the at-least-once loop, and it is the LAST hop of the
+        # batch's trace.
+        trace.record("tpu_worker.ack", time.perf_counter() - t0,
+                     trace_id=batch.trace_id, batch=batch.batch_id, ok=ok)
 
     def _run_step(self, fn):
         """Run a device step under the stall-watchdog bookkeeping."""
@@ -371,10 +418,15 @@ class TPUWorker:
     def _process_one(self, batch: RecordBatch, ack) -> None:
         def produce():
             self._observe_age(batch)
-            if self.cfg.pack and self._engine_run_packs:
-                return self._run_step(
-                    lambda: self.engine.run(batch.texts(), pack=True))
-            return self._run_step(lambda: self.engine.run(batch.texts()))
+            # Rooted at the batch's own trace: engine.run's tokenize and
+            # stage spans nest under this.
+            with trace.span("tpu_worker.process", trace_id=batch.trace_id,
+                            batch=batch.batch_id,
+                            records=len(batch.records)):
+                if self.cfg.pack and self._engine_run_packs:
+                    return self._run_step(
+                        lambda: self.engine.run(batch.texts(), pack=True))
+                return self._run_step(lambda: self.engine.run(batch.texts()))
 
         self._finish_batch(batch, ack, produce)
 
@@ -382,8 +434,14 @@ class TPUWorker:
         """Per-batch fallback after a failed coalesced step: the batch was
         already tokenized and age-observed when the group formed, so reuse
         the token lists instead of re-running the text front door."""
-        self._finish_batch(batch, ack, lambda: self._run_step(
-            lambda: self.engine.run_tokenized(toks, pack=self.cfg.pack)))
+        def produce():
+            with trace.span("tpu_worker.process", trace_id=batch.trace_id,
+                            batch=batch.batch_id, isolated=True):
+                return self._run_step(
+                    lambda: self.engine.run_tokenized(toks,
+                                                      pack=self.cfg.pack))
+
+        self._finish_batch(batch, ack, produce)
 
     def _observe_age(self, batch: RecordBatch) -> None:
         if batch.created_at is not None:

@@ -42,6 +42,7 @@ from ..bus.messages import (
     WorkResult,
 )
 from ..config.crawler import CrawlerConfig
+from ..utils import trace
 from ..state.datamodels import (
     PAGE_ERROR,
     PAGE_FETCHED,
@@ -270,9 +271,17 @@ class Orchestrator:
                 logger.error("failed to update page status", extra={
                     "page_url": page.url, "error": str(e)})
             try:
-                self.bus.publish(TOPIC_WORK_QUEUE,
-                                 WorkQueueMessage.new(item, PRIORITY_MEDIUM,
-                                                      self.ocfg.work_ttl_s))
+                # The root span of the work item's trace: everything
+                # downstream (bus delivery, worker processing, the result
+                # leg) shares item.trace_id, so /traces shows dispatch ->
+                # crawl -> result as one timeline.
+                with trace.span("orchestrator.dispatch",
+                                trace_id=item.trace_id, work_item=item.id,
+                                depth=item.depth, platform=item.platform):
+                    self.bus.publish(TOPIC_WORK_QUEUE,
+                                     WorkQueueMessage.new(
+                                         item, PRIORITY_MEDIUM,
+                                         self.ocfg.work_ttl_s))
                 published += 1
             except Exception as e:
                 # Revert on publish failure (`orchestrator.go:255-268`).
@@ -329,7 +338,14 @@ class Orchestrator:
             logger.warning("result for unknown work item", extra={
                 "work_item_id": result.work_item_id})
             return
+        with trace.span("orchestrator.handle_result",
+                        trace_id=item.trace_id or message.trace_id,
+                        work_item=result.work_item_id, status=result.status,
+                        worker=result.worker_id):
+            self._apply_result(item, message, result)
 
+    def _apply_result(self, item: WorkItem, message: ResultMessage,
+                      result: WorkResult) -> None:
         for page in self.sm.get_layer_by_depth(item.depth):
             if page.url != item.url:
                 continue
@@ -469,9 +485,13 @@ class Orchestrator:
                                 assigned_to="", created_at=now)
                 self.active_work[fresh.id] = fresh
             try:
-                self.bus.publish(TOPIC_WORK_QUEUE,
-                                 WorkQueueMessage.new(fresh, PRIORITY_HIGH,
-                                                      self.ocfg.work_ttl_s))
+                with trace.span("orchestrator.requeue",
+                                trace_id=fresh.trace_id, work_item=fresh.id,
+                                retry=fresh.retry_count):
+                    self.bus.publish(TOPIC_WORK_QUEUE,
+                                     WorkQueueMessage.new(
+                                         fresh, PRIORITY_HIGH,
+                                         self.ocfg.work_ttl_s))
                 requeued += 1
                 logger.warning("requeued stale work item", extra={
                     "work_item_id": fresh.id,
@@ -499,9 +519,13 @@ class Orchestrator:
                                 assigned_to="", created_at=utcnow())
                 self.active_work[fresh.id] = fresh
             try:
-                self.bus.publish(TOPIC_WORK_QUEUE,
-                                 WorkQueueMessage.new(fresh, PRIORITY_HIGH,
-                                                      self.ocfg.work_ttl_s))
+                with trace.span("orchestrator.reassign",
+                                trace_id=fresh.trace_id, work_item=fresh.id,
+                                retry=fresh.retry_count):
+                    self.bus.publish(TOPIC_WORK_QUEUE,
+                                     WorkQueueMessage.new(
+                                         fresh, PRIORITY_HIGH,
+                                         self.ocfg.work_ttl_s))
                 reassigned += 1
                 logger.info("reassigned work item from failed worker", extra={
                     "work_item_id": fresh.id, "retry_count": fresh.retry_count})

@@ -1,4 +1,5 @@
-"""Shared utilities: structured logging, time parsing, metrics, file janitor."""
+"""Shared utilities: structured logging, time parsing, metrics, span
+tracing, file janitor."""
 
 from .timeparse import parse_date_between, parse_duration, parse_time_ago
 

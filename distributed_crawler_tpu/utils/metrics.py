@@ -20,12 +20,75 @@ from typing import Dict, List, Optional, Tuple
 DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                    0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
+LabelKey = Tuple[Tuple[str, str], ...]
 
-class Counter:
+
+def _escape_help(help_: str) -> str:
+    """Prometheus text-format HELP escaping: a literal backslash or newline
+    in the help string would corrupt the exposition."""
+    return help_.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label_value(value: str) -> str:
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _label_key(kv: Dict[str, object]) -> LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in kv.items()))
+
+
+def _label_str(items: LabelKey,
+               extra: Optional[Tuple[str, str]] = None) -> str:
+    parts = [f'{k}="{_escape_label_value(v)}"' for k, v in items]
+    if extra is not None:
+        parts.append(f'{extra[0]}="{_escape_label_value(extra[1])}"')
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _LabeledMixin:
+    """`.labels(platform="telegram")`-style child metrics.
+
+    The parent owns the name/help/TYPE header and an (always-exposed)
+    unlabeled series; each distinct label set gets one child instance of
+    the same class, exposed as additional `name{k="v"} value` series.
+    Children are created once and cached, so hot paths can call
+    ``labels(...)`` per observation without allocation churn.
+    """
+
+    _label_items: LabelKey = ()
+
+    def labels(self, **kv: object):
+        if self._label_items:
+            raise ValueError(
+                f"labels() on an already-labeled child of {self.name}")
+        if not kv:
+            return self
+        key = _label_key(kv)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._make_child()
+                child._label_items = key
+                self._children[key] = child
+        return child
+
+    def _child_snapshot(self) -> list:
+        """Children in deterministic (sorted label) order, snapshotted
+        under the parent lock."""
+        with self._lock:
+            return [c for _, c in sorted(self._children.items())]
+
+
+class Counter(_LabeledMixin):
     def __init__(self, name: str, help_: str = ""):
         self.name, self.help = name, help_
         self._value = 0.0
         self._lock = threading.Lock()
+        self._children: Dict[LabelKey, "Counter"] = {}
+
+    def _make_child(self) -> "Counter":
+        return Counter(self.name, self.help)
 
     def inc(self, amount: float = 1.0) -> None:
         with self._lock:
@@ -33,19 +96,28 @@ class Counter:
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
     def expose(self) -> str:
-        return (f"# HELP {self.name} {self.help}\n"
-                f"# TYPE {self.name} counter\n"
-                f"{self.name} {self._value}\n")
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
+                 f"# TYPE {self.name} counter"]
+        for m in [self] + self._child_snapshot():
+            with m._lock:
+                value = m._value
+            lines.append(f"{self.name}{_label_str(m._label_items)} {value}")
+        return "\n".join(lines) + "\n"
 
 
-class Gauge:
+class Gauge(_LabeledMixin):
     def __init__(self, name: str, help_: str = ""):
         self.name, self.help = name, help_
         self._value = 0.0
         self._lock = threading.Lock()
+        self._children: Dict[LabelKey, "Gauge"] = {}
+
+    def _make_child(self) -> "Gauge":
+        return Gauge(self.name, self.help)
 
     def set(self, value: float) -> None:
         with self._lock:
@@ -57,15 +129,20 @@ class Gauge:
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
     def expose(self) -> str:
-        return (f"# HELP {self.name} {self.help}\n"
-                f"# TYPE {self.name} gauge\n"
-                f"{self.name} {self._value}\n")
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
+                 f"# TYPE {self.name} gauge"]
+        for m in [self] + self._child_snapshot():
+            with m._lock:
+                value = m._value
+            lines.append(f"{self.name}{_label_str(m._label_items)} {value}")
+        return "\n".join(lines) + "\n"
 
 
-class Histogram:
+class Histogram(_LabeledMixin):
     """Bucketed histogram with exact quantiles over a bounded sample window."""
 
     def __init__(self, name: str, help_: str = "",
@@ -79,6 +156,11 @@ class Histogram:
         self._window: List[float] = []
         self._window_cap = window
         self._lock = threading.Lock()
+        self._children: Dict[LabelKey, "Histogram"] = {}
+
+    def _make_child(self) -> "Histogram":
+        return Histogram(self.name, self.help, self.buckets,
+                         self._window_cap)
 
     def observe(self, value: float) -> None:
         with self._lock:
@@ -101,23 +183,39 @@ class Histogram:
 
     @property
     def count(self) -> int:
-        return self._n
+        with self._lock:
+            return self._n
 
     @property
     def sum(self) -> float:
-        return self._sum
+        with self._lock:
+            return self._sum
+
+    def _series_lines(self, items: LabelKey) -> List[str]:
+        # Snapshot counts/sum/count ATOMICALLY under the lock: a concurrent
+        # observe() between the bucket walk and the _count line would
+        # otherwise expose cumulative buckets that disagree with _count.
+        with self._lock:
+            counts = list(self._counts)
+            total, n = self._sum, self._n
+        lines = []
+        cum = 0
+        for bound, c in zip(self.buckets, counts):
+            cum += c
+            lines.append(f"{self.name}_bucket"
+                         f"{_label_str(items, ('le', str(bound)))} {cum}")
+        cum += counts[-1]
+        lines.append(f"{self.name}_bucket"
+                     f"{_label_str(items, ('le', '+Inf'))} {cum}")
+        lines.append(f"{self.name}_sum{_label_str(items)} {total}")
+        lines.append(f"{self.name}_count{_label_str(items)} {n}")
+        return lines
 
     def expose(self) -> str:
-        lines = [f"# HELP {self.name} {self.help}",
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
                  f"# TYPE {self.name} histogram"]
-        cum = 0
-        for bound, c in zip(self.buckets, self._counts):
-            cum += c
-            lines.append(f'{self.name}_bucket{{le="{bound}"}} {cum}')
-        cum += self._counts[-1]
-        lines.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
-        lines.append(f"{self.name}_sum {self._sum}")
-        lines.append(f"{self.name}_count {self._n}")
+        for m in [self] + self._child_snapshot():
+            lines.extend(m._series_lines(m._label_items))
         return "\n".join(lines) + "\n"
 
 
@@ -189,6 +287,23 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/metrics":
             body = self.registry.expose().encode("utf-8")
             ctype = "text/plain; version=0.0.4"
+        elif path == "/traces":
+            # Completed traces (spans grouped by trace_id, newest first)
+            # from the process-wide tracer — the JSON export half of
+            # utils/trace.py; ?limit=N caps the trace count.
+            import json as _json
+            from urllib.parse import parse_qs as _parse_qs
+
+            from . import trace as _trace
+
+            query = self.path.partition("?")[2]
+            try:
+                limit = int(_parse_qs(query).get("limit", ["0"])[0])
+            except (ValueError, TypeError):
+                limit = 0
+            body = _json.dumps(_trace.TRACER.export(limit=limit),
+                               default=str).encode("utf-8")
+            ctype = "application/json"
         elif path == "/status" and _status_provider is not None:
             # The orchestrator/worker `get_status()` map
             # (`orchestrator.go:596`, `worker.go:459`) served as JSON.

@@ -1,0 +1,278 @@
+"""End-to-end span tracing: see where every batch's millisecond went.
+
+Bus envelopes have carried ``trace_id``s since the first message type
+(`bus/messages.py:new_trace_id`) but nothing ever correlated them; the
+north-star metrics (posts/sec/chip, p50 batch latency) are totals with no
+attribution.  This module is the missing layer, shaped like Dapr-style
+distributed tracing scaled down to in-process cost:
+
+- :func:`span` — a ``perf_counter`` context manager recording one named,
+  attributed span.  Spans nest: a span opened inside another inherits its
+  trace id and parent span via a contextvar, so the orchestrator's dispatch
+  span, the bus delivery span, and the engine's per-stage spans all land in
+  one trace without any plumbing through call signatures.
+- :func:`record` — a retroactive span for durations measured elsewhere
+  (queue-wait age, ack round trips).
+- :func:`inject` / :func:`payload_span` — the propagation seam both bus
+  transports use: publish stamps the current span id into the envelope as
+  ``parent_span``; delivery re-roots the consumer's context from the
+  envelope's ``trace_id``/``parent_span``.
+- a bounded ring buffer of completed spans, grouped into traces and served
+  as JSON at the metrics server's ``/traces`` endpoint
+  (`utils/metrics.py`), plus slow-span threshold logging.
+
+Tracing never invents trace ids for untraced messages: a payload without a
+``trace_id`` passes through both buses untouched, and ``payload_span`` is a
+no-op for it — only envelopes that opted into tracing pay for it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import logging
+import secrets
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+logger = logging.getLogger("dct.trace")
+
+DEFAULT_CAPACITY = 2048  # completed spans kept for /traces
+
+# (trace_id, span_id) of the innermost open span on this thread/task.
+_CTX: contextvars.ContextVar = contextvars.ContextVar(
+    "dct_trace_ctx", default=None)
+
+
+def _new_trace_id() -> str:
+    """Same shape as `bus/messages.py:new_trace_id` (kept local: utils must
+    not import the bus layer it instruments)."""
+    return ("trace_" + time.strftime("%Y%m%d%H%M%S", time.gmtime())
+            + "_" + secrets.token_hex(4))
+
+
+def _new_span_id() -> str:
+    return "sp_" + secrets.token_hex(6)
+
+
+@dataclass
+class Span:
+    """One completed, named timing with attribution."""
+
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: str = ""
+    start_wall: float = 0.0        # epoch seconds at span open
+    duration_s: float = 0.0
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_wall": self.start_wall,
+            "duration_ms": round(self.duration_s * 1000.0, 3),
+            "attrs": self.attrs,
+        }
+
+
+class _OpenSpan:
+    """Handle yielded by :meth:`Tracer.span`; ``set`` adds attrs late
+    (e.g. an outcome only known at the end of the block)."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "attrs")
+
+    def __init__(self, name: str, trace_id: str, span_id: str,
+                 parent_id: str, attrs: Dict[str, Any]):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.attrs = attrs
+
+    def set(self, **attrs: Any) -> None:
+        self.attrs.update(attrs)
+
+
+class Tracer:
+    """Bounded in-process span collector with slow-span logging."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 slow_span_s: float = 0.0):
+        self._lock = threading.Lock()
+        self._spans: "deque[Span]" = deque(maxlen=max(1, capacity))
+        self._enabled = capacity > 0
+        self.capacity = capacity
+        self.slow_span_s = slow_span_s
+
+    # -- configuration ------------------------------------------------------
+    def configure(self, capacity: Optional[int] = None,
+                  slow_span_s: Optional[float] = None) -> None:
+        """Resize the ring / set the slow threshold (CLI flags).  A
+        capacity of 0 disables span recording entirely (context propagation
+        still works, so downstream hops that kept tracing on still
+        correlate)."""
+        with self._lock:
+            if capacity is not None:
+                self.capacity = capacity
+                self._enabled = capacity > 0
+                self._spans = deque(self._spans, maxlen=max(1, capacity))
+            if slow_span_s is not None:
+                self.slow_span_s = slow_span_s
+
+    # -- recording ----------------------------------------------------------
+    @contextlib.contextmanager
+    def span(self, name: str, trace_id: str = "",
+             parent_id: Optional[str] = None,
+             **attrs: Any) -> Iterator[_OpenSpan]:
+        """Record a named span around the block.
+
+        ``trace_id`` wins when given (a bus hop re-rooting from an
+        envelope); otherwise the ambient context's trace continues, and a
+        fresh trace starts if there is none.  The ambient parent is used
+        unless ``parent_id`` overrides it (an envelope's ``parent_span``).
+        """
+        ambient = _CTX.get()
+        if not trace_id:
+            trace_id = ambient[0] if ambient else _new_trace_id()
+        if parent_id is None:
+            # Only inherit the ambient span as parent when it belongs to
+            # the SAME trace — a bus hop with an explicit trace_id must not
+            # claim the publisher thread's unrelated span as its parent.
+            parent_id = ambient[1] if ambient and ambient[0] == trace_id \
+                else ""
+        span_id = _new_span_id()
+        handle = _OpenSpan(name, trace_id, span_id, parent_id, dict(attrs))
+        token = _CTX.set((trace_id, span_id))
+        start_wall = time.time()
+        t0 = time.perf_counter()
+        try:
+            yield handle
+        except BaseException:
+            handle.attrs.setdefault("error", True)
+            raise
+        finally:
+            _CTX.reset(token)
+            self._finish(Span(name=handle.name, trace_id=trace_id,
+                              span_id=span_id, parent_id=parent_id,
+                              start_wall=start_wall,
+                              duration_s=time.perf_counter() - t0,
+                              attrs=handle.attrs))
+
+    def record(self, name: str, duration_s: float, trace_id: str = "",
+               parent_id: str = "", **attrs: Any) -> None:
+        """Retroactive span: the duration was measured elsewhere (queue-wait
+        age computed at dequeue, an ack round trip)."""
+        ambient = _CTX.get()
+        if not trace_id:
+            if ambient is None:
+                return  # nothing to attach to; don't invent a trace
+            trace_id = ambient[0]
+        if not parent_id and ambient and ambient[0] == trace_id:
+            parent_id = ambient[1]
+        self._finish(Span(name=name, trace_id=trace_id,
+                          span_id=_new_span_id(), parent_id=parent_id,
+                          start_wall=time.time() - duration_s,
+                          duration_s=duration_s, attrs=dict(attrs)))
+
+    def _finish(self, s: Span) -> None:
+        if self._enabled:
+            with self._lock:
+                self._spans.append(s)
+        if self.slow_span_s > 0 and s.duration_s >= self.slow_span_s:
+            # The slow-trace log line (docs/operations.md "Observability"):
+            # span name, trace id for /traces correlation, duration, attrs.
+            logger.warning(
+                "slow span %s %.1fms (threshold %.0fms) trace=%s attrs=%s",
+                s.name, s.duration_s * 1000.0, self.slow_span_s * 1000.0,
+                s.trace_id, s.attrs)
+
+    # -- introspection / export --------------------------------------------
+    def spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def export(self, limit: int = 0) -> Dict[str, Any]:
+        """Spans grouped into traces, most recently completed trace first —
+        the JSON body of the ``/traces`` endpoint."""
+        spans = self.spans()
+        by_trace: Dict[str, List[Span]] = {}
+        last_seen: Dict[str, int] = {}
+        for idx, s in enumerate(spans):  # ring order == completion order
+            by_trace.setdefault(s.trace_id, []).append(s)
+            # Recency is a trace's LAST completed span, not its first — a
+            # long-lived trace whose result leg just landed must sort
+            # ahead of short traces that finished in between.
+            last_seen[s.trace_id] = idx
+        traces = []
+        for tid in sorted(last_seen, key=last_seen.__getitem__,
+                          reverse=True):
+            group = by_trace[tid]
+            start = min(s.start_wall for s in group)
+            end = max(s.start_wall + s.duration_s for s in group)
+            traces.append({
+                "trace_id": tid,
+                "span_count": len(group),
+                "duration_ms": round((end - start) * 1000.0, 3),
+                "spans": [s.to_dict() for s in group],
+            })
+            if limit and len(traces) >= limit:
+                break
+        return {"traces": traces, "capacity": self.capacity,
+                "slow_span_ms": self.slow_span_s * 1000.0}
+
+
+TRACER = Tracer()
+
+# Module-level conveniences bound to the process-wide tracer.
+span = TRACER.span
+record = TRACER.record
+configure = TRACER.configure
+
+
+def current_trace_id() -> str:
+    ctx = _CTX.get()
+    return ctx[0] if ctx else ""
+
+
+def current_span_id() -> str:
+    ctx = _CTX.get()
+    return ctx[1] if ctx else ""
+
+
+def inject(payload: Any) -> Any:
+    """Stamp the current span into an outbound envelope (publish side).
+
+    Returns ``payload`` augmented with ``parent_span`` (a shallow copy —
+    the caller's dict is never mutated) when ALL of: a span is open on this
+    thread, the payload is a dict that carries a truthy ``trace_id``, and
+    no ``parent_span`` is set yet.  Everything else passes through
+    untouched, so untraced messages stay byte-identical.
+    """
+    ctx = _CTX.get()
+    if (ctx is None or not isinstance(payload, dict)
+            or not payload.get("trace_id") or payload.get("parent_span")):
+        return payload
+    return {**payload, "parent_span": ctx[1]}
+
+
+def payload_span(name: str, payload: Any, **attrs: Any):
+    """Delivery-side twin of :func:`inject`: a span re-rooted from the
+    envelope's ``trace_id``/``parent_span``; a no-op context manager when
+    the payload carries no trace id."""
+    tid = payload.get("trace_id") if isinstance(payload, dict) else None
+    if not tid:
+        return contextlib.nullcontext()
+    return TRACER.span(name, trace_id=tid,
+                       parent_id=payload.get("parent_span", "") or "",
+                       **attrs)

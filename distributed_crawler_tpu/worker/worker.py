@@ -44,6 +44,7 @@ from ..bus.messages import (
 )
 from ..config.crawler import CrawlerConfig
 from ..crawl import runner as crawl_runner
+from ..utils import trace
 from ..state.datamodels import PAGE_PROCESSING, Page, new_id, utcnow
 
 logger = logging.getLogger("dct.worker")
@@ -189,14 +190,24 @@ class CrawlWorker:
         start = time.monotonic()
         self.send_status_update(MSG_HEARTBEAT, WORKER_BUSY)
         try:
-            result = self.process_work_item(item)
+            # Same trace as the orchestrator's dispatch span: the item
+            # carried its trace_id across the bus hop.
+            with trace.span("worker.process", trace_id=item.trace_id,
+                            work_item=item.id, worker=self.id,
+                            platform=item.platform) as sp:
+                result = self.process_work_item(item)
+                sp.set(status=result.status,
+                       message_count=result.message_count)
         finally:
             with self._mu:
                 self.current_work = None
         try:
-            self.bus.publish(TOPIC_RESULTS,
-                             ResultMessage.new(result,
-                                               result.discovered_pages))
+            with trace.span("worker.publish_result", trace_id=item.trace_id,
+                            work_item=item.id, status=result.status):
+                self.bus.publish(TOPIC_RESULTS,
+                                 ResultMessage.new(result,
+                                                   result.discovered_pages,
+                                                   trace_id=item.trace_id))
         except Exception as e:
             # Re-raise so the bus redelivers the work item (the reference
             # returns the error for pubsub retry, `worker.go:210-214`).

@@ -1,0 +1,491 @@
+"""Labeled metrics, the span tracer, and the /traces surface.
+
+Covers the observability layer end to end: label-child exposition in valid
+Prometheus text format, the expose-vs-observe race fix (snapshot under the
+lock), HELP/label escaping, the metrics HTTP server's edge paths (port-0
+auto-bind, /status 500, 404, concurrent scrape-under-load), span nesting +
+propagation through bus envelopes, and the acceptance path: one batch on
+the bus -> one trace covering dispatch, queue wait, coalesce, and every
+engine stage, retrievable as JSON from /traces.
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from distributed_crawler_tpu.bus.codec import RecordBatch
+from distributed_crawler_tpu.bus.inmemory import InMemoryBus
+from distributed_crawler_tpu.bus.messages import (
+    TOPIC_INFERENCE_BATCHES,
+    WorkItem,
+    WorkItemConfig,
+    WorkQueueMessage,
+)
+from distributed_crawler_tpu.datamodel import Post
+from distributed_crawler_tpu.utils import trace
+from distributed_crawler_tpu.utils.metrics import (
+    MetricsRegistry,
+    serve_metrics,
+    set_status_provider,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    """Every test starts with an empty, default-configured tracer (it is
+    process-global by design — the /traces endpoint serves it)."""
+    trace.TRACER.configure(capacity=trace.DEFAULT_CAPACITY, slow_span_s=0.0)
+    trace.TRACER.reset()
+    yield
+    trace.TRACER.configure(capacity=trace.DEFAULT_CAPACITY, slow_span_s=0.0)
+    trace.TRACER.reset()
+
+
+# ---------------------------------------------------------------------------
+# labels
+# ---------------------------------------------------------------------------
+class TestLabeledMetrics:
+    def test_counter_children_exposed(self):
+        reg = MetricsRegistry()
+        c = reg.counter("posts_total", "posts")
+        c.inc(2)
+        c.labels(platform="telegram").inc(3)
+        c.labels(platform="youtube").inc()
+        body = c.expose()
+        assert "posts_total 2.0" in body
+        assert 'posts_total{platform="telegram"} 3.0' in body
+        assert 'posts_total{platform="youtube"} 1.0' in body
+        # One HELP/TYPE header for the whole family.
+        assert body.count("# HELP") == 1 and body.count("# TYPE") == 1
+
+    def test_same_labels_return_same_child(self):
+        reg = MetricsRegistry()
+        c = reg.counter("x_total", "")
+        assert c.labels(a="1", b="2") is c.labels(b="2", a="1")
+        assert c.labels(a="1", b="2") is not c.labels(a="1", b="3")
+
+    def test_labels_on_child_rejected(self):
+        c = MetricsRegistry().counter("x_total", "")
+        with pytest.raises(ValueError):
+            c.labels(a="1").labels(b="2")
+
+    def test_no_labels_returns_parent(self):
+        c = MetricsRegistry().counter("x_total", "")
+        assert c.labels() is c
+
+    def test_gauge_labels(self):
+        g = MetricsRegistry().gauge("depth", "")
+        g.labels(topic="work").set(4)
+        g.labels(topic="results").set(7)
+        body = g.expose()
+        assert 'depth{topic="work"} 4' in body
+        assert 'depth{topic="results"} 7' in body
+
+    def test_histogram_labels_merge_le(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", "", buckets=(0.1, 1.0))
+        h.labels(bucket="16").observe(0.05)
+        h.labels(bucket="32").observe(0.5)
+        body = h.expose()
+        assert 'lat_seconds_bucket{bucket="16",le="0.1"} 1' in body
+        assert 'lat_seconds_bucket{bucket="32",le="0.1"} 0' in body
+        assert 'lat_seconds_bucket{bucket="32",le="+Inf"} 1' in body
+        assert 'lat_seconds_sum{bucket="32"} 0.5' in body
+        assert 'lat_seconds_count{bucket="16"} 1' in body
+
+    def test_label_value_escaping(self):
+        c = MetricsRegistry().counter("x_total", "")
+        c.labels(q='a"b\\c\nd').inc()
+        body = c.expose()
+        assert 'x_total{q="a\\"b\\\\c\\nd"} 1.0' in body
+
+    def test_help_escaping(self):
+        c = MetricsRegistry().counter("x_total", "line one\nline \\two")
+        body = c.expose()
+        # Multi-line HELP must not corrupt the text format: the escaped
+        # help stays on ONE line.
+        assert "# HELP x_total line one\\nline \\\\two\n" in body
+        for line in body.splitlines():
+            assert line.startswith(("# HELP", "# TYPE", "x_total"))
+
+    def test_registry_exposes_children(self):
+        reg = MetricsRegistry()
+        reg.counter("a_total", "").labels(k="v").inc()
+        reg.histogram("b_seconds", "", buckets=(1.0,)).labels(k="v").observe(0.5)
+        body = reg.expose()
+        assert 'a_total{k="v"} 1.0' in body
+        assert 'b_seconds_count{k="v"} 1' in body
+
+
+class TestExposeConsistency:
+    def test_histogram_expose_atomic_under_observe(self):
+        """The satellite race: cumulative +Inf bucket must equal _count in
+        EVERY scrape, even with four writers hammering observe()."""
+        reg = MetricsRegistry()
+        h = reg.histogram("race_seconds", "", buckets=(0.01, 0.1, 1.0))
+        stop = threading.Event()
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                h.observe(0.005 * (i % 50))
+                i += 1
+
+        threads = [threading.Thread(target=writer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(300):
+                body = h.expose()
+                inf = int(re.search(
+                    r'race_seconds_bucket\{le="\+Inf"\} (\d+)', body).group(1))
+                cnt = int(re.search(
+                    r"race_seconds_count (\d+)", body).group(1))
+                assert inf == cnt, body
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+
+
+# ---------------------------------------------------------------------------
+# metrics HTTP server
+# ---------------------------------------------------------------------------
+class TestMetricsServer:
+    def _serve(self, reg=None):
+        server = serve_metrics(0, reg or MetricsRegistry())
+        return server, server.server_address[1]
+
+    def test_port_zero_autobinds(self):
+        server, port = self._serve()
+        try:
+            assert port > 0
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5).read()
+            assert body == b"ok\n"
+        finally:
+            server.shutdown()
+
+    def test_unknown_path_404(self):
+        server, port = self._serve()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/nope", timeout=5)
+            assert e.value.code == 404
+        finally:
+            server.shutdown()
+
+    def test_status_provider_raises_500(self):
+        server, port = self._serve()
+        set_status_provider(lambda: 1 / 0)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/status", timeout=5)
+            assert e.value.code == 500
+            assert "error" in json.loads(e.value.read())
+        finally:
+            set_status_provider(None)
+            server.shutdown()
+
+    def test_traces_endpoint_json(self):
+        server, port = self._serve()
+        try:
+            with trace.span("outer", kind="test"):
+                with trace.span("inner"):
+                    pass
+            got = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/traces", timeout=5).read())
+            names = {s["name"] for t in got["traces"] for s in t["spans"]}
+            assert {"outer", "inner"} <= names
+            limited = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/traces?limit=1", timeout=5).read())
+            assert len(limited["traces"]) <= 1
+        finally:
+            server.shutdown()
+
+    def test_scrape_while_observing(self):
+        """Threaded stress: /metrics scrapes stay internally consistent
+        while writers observe concurrently (the HTTP face of the expose
+        race fix)."""
+        reg = MetricsRegistry()
+        h = reg.histogram("srv_seconds", "", buckets=(0.01, 1.0))
+        server, port = self._serve(reg)
+        stop = threading.Event()
+
+        def writer():
+            while not stop.is_set():
+                h.observe(0.005)
+                h.labels(outcome="ok").observe(0.005)
+
+        threads = [threading.Thread(target=writer) for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(50):
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=5).read().decode()
+                inf = int(re.search(
+                    r'srv_seconds_bucket\{le="\+Inf"\} (\d+)', body).group(1))
+                cnt = int(re.search(
+                    r"srv_seconds_count (\d+)", body).group(1))
+                assert inf == cnt
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# tracer core
+# ---------------------------------------------------------------------------
+class TestTracer:
+    def test_nesting_inherits_trace_and_parent(self):
+        with trace.span("parent") as p:
+            with trace.span("child"):
+                pass
+        spans = {s.name: s for s in trace.TRACER.spans()}
+        assert spans["child"].trace_id == spans["parent"].trace_id
+        assert spans["child"].parent_id == p.span_id
+        assert spans["parent"].parent_id == ""
+
+    def test_explicit_trace_id_reroots(self):
+        with trace.span("publisher"):
+            with trace.span("deliver", trace_id="trace_X", parent_id="sp_Y"):
+                pass
+        spans = {s.name: s for s in trace.TRACER.spans()}
+        assert spans["deliver"].trace_id == "trace_X"
+        # The publisher thread's unrelated span must NOT become the parent.
+        assert spans["deliver"].parent_id == "sp_Y"
+
+    def test_record_retroactive(self):
+        trace.record("queue_wait", 0.25, trace_id="trace_Q", batch="b1")
+        (s,) = trace.TRACER.spans()
+        assert s.name == "queue_wait" and s.trace_id == "trace_Q"
+        assert s.duration_s == pytest.approx(0.25)
+        assert s.attrs["batch"] == "b1"
+
+    def test_record_without_context_or_id_drops(self):
+        trace.record("orphan", 0.1)
+        assert trace.TRACER.spans() == []
+
+    def test_ring_bounded(self):
+        trace.TRACER.configure(capacity=4)
+        for i in range(10):
+            trace.record("s", 0.001, trace_id="trace_ring", i=i)
+        spans = trace.TRACER.spans()
+        assert len(spans) == 4
+        assert [s.attrs["i"] for s in spans] == [6, 7, 8, 9]
+
+    def test_capacity_zero_disables(self):
+        trace.TRACER.configure(capacity=0)
+        with trace.span("nothing"):
+            pass
+        assert trace.TRACER.spans() == []
+
+    def test_slow_span_logged(self):
+        # Attach a handler directly: caplog listens on the root logger, but
+        # setup_logging (run by CLI tests in the same session) sets
+        # propagate=False on the 'dct' tree, so records never reach root.
+        import logging
+
+        trace.TRACER.configure(slow_span_s=0.01)
+        records = []
+        handler = logging.Handler()
+        handler.emit = records.append
+        lg = logging.getLogger("dct.trace")
+        old_level = lg.level
+        lg.addHandler(handler)
+        lg.setLevel(logging.WARNING)
+        try:
+            trace.record("slow_stage", 0.05, trace_id="trace_slow")
+            trace.record("fast_stage", 0.001, trace_id="trace_slow")
+        finally:
+            lg.removeHandler(handler)
+            lg.setLevel(old_level)
+        msgs = [r.getMessage() for r in records]
+        assert any("slow span slow_stage" in m for m in msgs), msgs
+        assert not any("fast_stage" in m for m in msgs)
+
+    def test_error_attr_on_exception(self):
+        with pytest.raises(RuntimeError):
+            with trace.span("boom"):
+                raise RuntimeError("x")
+        (s,) = trace.TRACER.spans()
+        assert s.attrs["error"] is True
+
+    def test_export_groups_by_trace_newest_first(self):
+        with trace.span("a", trace_id="trace_1"):
+            pass
+        with trace.span("b", trace_id="trace_2"):
+            pass
+        out = trace.TRACER.export()
+        assert [t["trace_id"] for t in out["traces"]] == \
+            ["trace_2", "trace_1"]
+        assert out["traces"][0]["spans"][0]["name"] == "b"
+
+    def test_export_recency_is_last_span_not_first(self):
+        """A long-lived trace whose final leg just completed outranks a
+        short trace that finished in between (its dispatch span being old
+        must not bury it)."""
+        trace.record("dispatch", 0.001, trace_id="trace_long")
+        trace.record("whole", 0.001, trace_id="trace_short")
+        trace.record("handle_result", 0.001, trace_id="trace_long")
+        out = trace.TRACER.export(limit=1)
+        assert [t["trace_id"] for t in out["traces"]] == ["trace_long"]
+
+
+class TestPropagation:
+    def test_inject_stamps_parent_span(self):
+        with trace.span("pub") as p:
+            out = trace.inject({"trace_id": "trace_A", "x": 1})
+        assert out["parent_span"] == p.span_id
+        assert out["x"] == 1
+
+    def test_inject_leaves_untraced_payloads_alone(self):
+        payload = {"x": 1}
+        with trace.span("pub"):
+            assert trace.inject(payload) is payload  # no trace_id -> as-is
+        assert trace.inject({"trace_id": "t"}) == {"trace_id": "t"}  # no ctx
+        assert trace.inject(b"raw") == b"raw"
+
+    def test_inmemory_bus_carries_parent_span(self):
+        bus = InMemoryBus()
+        seen = []
+        bus.subscribe("topic", seen.append)
+        with trace.span("publisher") as p:
+            bus.publish("topic", {"trace_id": "trace_B", "v": 7})
+        assert seen[0]["parent_span"] == p.span_id
+        deliver = [s for s in trace.TRACER.spans() if s.name == "bus.deliver"]
+        assert deliver and deliver[0].trace_id == "trace_B"
+        assert deliver[0].parent_id == p.span_id
+        assert deliver[0].attrs["topic"] == "topic"
+
+    def test_untraced_payload_passes_byte_identical(self):
+        bus = InMemoryBus()
+        seen = []
+        bus.subscribe("topic", seen.append)
+        bus.publish("topic", {"v": 7})
+        assert seen == [{"v": 7}]
+        assert all(s.name != "bus.deliver" for s in trace.TRACER.spans())
+
+    def test_work_queue_message_inherits_item_trace(self):
+        item = WorkItem.new("https://t.me/x", 0, "", "c1", "telegram",
+                            WorkItemConfig())
+        msg = WorkQueueMessage.new(item)
+        assert msg.trace_id == item.trace_id
+
+    def test_record_batch_gets_trace_id(self):
+        batch = RecordBatch.from_posts(
+            [Post(post_uid="p", channel_name="c", description="t")])
+        assert batch.trace_id.startswith("trace_")
+
+
+# ---------------------------------------------------------------------------
+# acceptance: one batch -> one trace across the whole pipeline
+# ---------------------------------------------------------------------------
+class TestEndToEndTrace:
+    ENGINE_STAGES = {"engine.tokenize", "engine.pack", "engine.device_put",
+                     "engine.compute", "engine.unpack"}
+
+    def test_batch_trace_covers_every_stage(self):
+        from distributed_crawler_tpu.inference import (
+            EngineConfig,
+            InferenceEngine,
+            TPUWorker,
+            TPUWorkerConfig,
+        )
+        from distributed_crawler_tpu.inference.bridge import InferenceBridge
+        from distributed_crawler_tpu.state.providers import (
+            InMemoryStorageProvider,
+        )
+
+        class _NullSM:
+            def store_post(self, channel_id, post):
+                pass
+
+            def close(self):
+                pass
+
+        reg = MetricsRegistry()
+        bus = InMemoryBus()
+        engine = InferenceEngine(
+            EngineConfig(model="tiny", n_labels=3, batch_size=4,
+                         buckets=(16, 32)), registry=reg)
+        worker = TPUWorker(bus, engine, provider=InMemoryStorageProvider(),
+                           cfg=TPUWorkerConfig(worker_id="w1",
+                                               heartbeat_s=3600,
+                                               coalesce_batches=2, pack=True),
+                           registry=reg)
+        published = []
+        bus.subscribe(TOPIC_INFERENCE_BATCHES, published.append)
+        # Subscribe the worker BEFORE starting its feed thread so both
+        # bridge batches queue up and coalesce into one device stream.
+        bus.subscribe(TOPIC_INFERENCE_BATCHES, worker._handle_payload)
+        bus.start()
+        bridge = InferenceBridge(_NullSM(), bus, crawl_id="c1", batch_size=3,
+                                 deadline_s=3600)
+        try:
+            for i in range(6):  # two full batches of 3
+                bridge.store_post("chan", Post(
+                    post_uid=f"p{i}", channel_name="chan",
+                    description=f"trace me {i}"))
+            assert len(published) == 2
+            # start() subscribes _handle_payload a second time — harmless
+            # here, nothing publishes after this point.
+            worker.start()
+            assert worker.drain(timeout_s=30.0)
+        finally:
+            worker.stop()
+            bridge.close()
+            bus.close()
+
+        tid = published[0]["trace_id"]
+        spans = [s for s in trace.TRACER.spans() if s.trace_id == tid]
+        names = {s.name for s in spans}
+        assert {"orchestrator.dispatch", "bus.deliver",
+                "tpu_worker.queue_wait", "tpu_worker.coalesce",
+                "tpu_worker.commit"} <= names, names
+        assert self.ENGINE_STAGES <= names, names
+        # The second batch correlates too: its own queue-wait and commit,
+        # and the coalesce span points at it via batch_ids.
+        tid2 = published[1]["trace_id"]
+        names2 = {s.name for s in trace.TRACER.spans()
+                  if s.trace_id == tid2}
+        assert {"tpu_worker.queue_wait", "tpu_worker.commit"} <= names2
+        coalesce = next(s for s in spans if s.name == "tpu_worker.coalesce")
+        assert published[1]["batch_id"] in coalesce.attrs["batch_ids"]
+
+        # Retrievable as JSON from /traces, and /metrics carries the
+        # labeled splits (by bucket and by outcome) in valid text format.
+        server = serve_metrics(0, reg)
+        port = server.server_address[1]
+        try:
+            got = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/traces", timeout=5).read())
+            ours = [t for t in got["traces"] if t["trace_id"] == tid]
+            assert ours, "trace missing from /traces"
+            assert self.ENGINE_STAGES <= {s["name"]
+                                          for s in ours[0]["spans"]}
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics",
+                timeout=5).read().decode()
+            assert re.search(
+                r'tpu_inference_bucket_posts_total\{bucket="\d+"\} \d', body)
+            assert 'tpu_worker_batch_outcomes_total{outcome="ok"} 2.0' \
+                in body
+            for line in body.splitlines():
+                assert re.match(
+                    r'^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .*'
+                    r'|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? \S+)$', line), \
+                    f"invalid exposition line: {line!r}"
+        finally:
+            server.shutdown()

@@ -24,3 +24,27 @@ def apply(jax_module) -> None:
     """Post-import half: pin the already-imported jax to CPU under --smoke."""
     if SMOKE:
         jax_module.config.update("jax_platforms", "cpu")
+
+
+def selfcheck() -> int:
+    """`python tools/_smoke.py`: the cheap pre-bench sanity gate — byte-
+    compile the whole package (catches syntax/indentation rot in modules no
+    test imports), then run the metrics + tracing unit tests the other
+    tools' /metrics and /traces reads depend on."""
+    import compileall
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg = os.path.join(repo, "distributed_crawler_tpu")
+    if not compileall.compile_dir(pkg, quiet=1):
+        print("compileall FAILED", file=sys.stderr)
+        return 1
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    return subprocess.call(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         os.path.join(repo, "tests", "test_metrics_trace.py")],
+        env=env, cwd=repo)
+
+
+if __name__ == "__main__":
+    sys.exit(selfcheck())

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Fetch /traces from a running service and print per-stage latency tables.
+
+Usage:
+    python tools/trace_dump.py http://127.0.0.1:9102          # live service
+    python tools/trace_dump.py traces.json                    # saved export
+    python tools/trace_dump.py http://host:port --trace trace_2026...
+    python tools/trace_dump.py http://host:port --limit 20
+
+Two views:
+- per-stage aggregate: for every span name, count / p50 / max / total ms —
+  the "where did the milliseconds go" table the tracing layer exists for;
+- per-trace tree (with --trace, or --last for the newest): spans indented
+  by parent link, in start order, with durations and attrs.
+
+Stdlib only; works against the metrics server's /traces endpoint
+(`utils/metrics.py`) or a JSON file saved from it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from typing import Any, Dict, List
+
+
+def load(source: str, limit: int = 0) -> Dict[str, Any]:
+    if source.startswith(("http://", "https://")):
+        url = source.rstrip("/")
+        if not url.endswith("/traces"):
+            url += "/traces"
+        if limit:
+            url += f"?limit={limit}"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return json.load(resp)
+    with open(source, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def stage_table(traces: List[Dict[str, Any]]) -> str:
+    by_name: Dict[str, List[float]] = {}
+    for t in traces:
+        for s in t.get("spans", []):
+            by_name.setdefault(s["name"], []).append(
+                float(s.get("duration_ms", 0.0)))
+    if not by_name:
+        return "(no spans)"
+    rows = []
+    for name, vals in by_name.items():
+        vals.sort()
+        rows.append((name, len(vals), _quantile(vals, 0.5),
+                     vals[-1], sum(vals)))
+    rows.sort(key=lambda r: -r[4])  # biggest total cost first
+    w = max(len(r[0]) for r in rows)
+    lines = [f"{'stage':<{w}}  {'count':>6}  {'p50 ms':>9}  "
+             f"{'max ms':>9}  {'total ms':>10}"]
+    for name, n, p50, mx, total in rows:
+        lines.append(f"{name:<{w}}  {n:>6}  {p50:>9.2f}  "
+                     f"{mx:>9.2f}  {total:>10.2f}")
+    return "\n".join(lines)
+
+
+def trace_tree(t: Dict[str, Any]) -> str:
+    spans = sorted(t.get("spans", []), key=lambda s: s.get("start_wall", 0.0))
+    children: Dict[str, list] = {}
+    ids = {s["span_id"] for s in spans}
+    roots = []
+    for s in spans:
+        parent = s.get("parent_id", "")
+        if parent and parent in ids:
+            children.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+    lines = [f"trace {t['trace_id']}  "
+             f"({t.get('span_count', len(spans))} spans, "
+             f"{t.get('duration_ms', 0.0):.2f} ms)"]
+
+    def walk(s, depth):
+        attrs = s.get("attrs") or {}
+        attr_s = " ".join(f"{k}={v}" for k, v in attrs.items())
+        lines.append(f"  {'  ' * depth}{s['name']:<28} "
+                     f"{s.get('duration_ms', 0.0):>9.2f} ms  {attr_s}")
+        for c in children.get(s["span_id"], []):
+            walk(c, depth + 1)
+
+    for r in roots:
+        walk(r, 0)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="per-stage latency tables from a /traces export")
+    p.add_argument("source", help="service base URL (or /traces URL) "
+                                  "or a saved JSON file")
+    p.add_argument("--trace", default="",
+                   help="print the span tree of this trace id")
+    p.add_argument("--last", action="store_true",
+                   help="print the span tree of the newest trace")
+    p.add_argument("--limit", type=int, default=0,
+                   help="cap the number of traces fetched")
+    args = p.parse_args(argv)
+
+    try:
+        data = load(args.source, limit=args.limit)
+    except Exception as e:
+        print(f"error: failed to load {args.source}: {e}", file=sys.stderr)
+        return 2
+    traces = data.get("traces", [])
+    if not traces:
+        print("no traces recorded (is --trace-buffer > 0 and has any "
+              "traced message flowed?)")
+        return 0
+
+    if args.trace or args.last:
+        wanted = [t for t in traces if t["trace_id"] == args.trace] \
+            if args.trace else traces[:1]
+        if not wanted:
+            print(f"error: trace {args.trace!r} not in the buffer "
+                  f"({len(traces)} traces held)", file=sys.stderr)
+            return 1
+        print(trace_tree(wanted[0]))
+        return 0
+
+    print(f"{len(traces)} traces in buffer "
+          f"(capacity {data.get('capacity', '?')} spans)\n")
+    print(stage_table(traces))
+    print("\nuse --trace <id> (or --last) for one trace's span tree")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
