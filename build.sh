@@ -7,6 +7,13 @@ cd "$(dirname "$0")"
 echo "==> native core"
 make -C native
 
+# Repo-native static analysis (crawlint): ~1 s, so it runs before the
+# test suite for failure locality.  `tests/test_analyze.py` re-runs it
+# inside the suite; docs/static-analysis.md has the checker catalogue.
+# CI dashboards can consume `python -m tools.analyze --json`.
+echo "==> crawlint"
+python -m tools.analyze
+
 echo "==> test suite"
 python -m pytest tests/ -q
 

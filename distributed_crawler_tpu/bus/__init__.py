@@ -16,7 +16,13 @@ On-slice tensor communication is NOT this bus's job: that rides XLA
 collectives over ICI (see `parallel/`).
 """
 
-from .codec import RecordBatch, decode_frames, encode_frame
+from .codec import (
+    MESSAGE_REGISTRY,
+    RecordBatch,
+    decode_frames,
+    decode_message,
+    encode_frame,
+)
 from .inmemory import InMemoryBus
 from .messages import (
     PRIORITY_HIGH,
@@ -55,6 +61,8 @@ __all__ = [
     "RecordBatch",
     "encode_frame",
     "decode_frames",
+    "decode_message",
+    "MESSAGE_REGISTRY",
     "InMemoryBus",
     "PRIORITY_HIGH",
     "PRIORITY_MEDIUM",

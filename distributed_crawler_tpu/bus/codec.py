@@ -34,7 +34,23 @@ except Exception:  # pragma: no cover - zstd is present in the target image
 from ..datamodel import Post
 from ..datamodel.post import format_time, parse_time
 from ..state.datamodels import new_id, utcnow
-from .messages import new_trace_id
+from .messages import (
+    MSG_DISCOVERED_PAGES,
+    MSG_HEARTBEAT,
+    MSG_PAUSE,
+    MSG_POISON_PILL,
+    MSG_RESUME,
+    MSG_STOP,
+    MSG_WORK_ITEM,
+    MSG_WORK_RESULT,
+    MSG_WORKER_STARTED,
+    MSG_WORKER_STOPPING,
+    ControlMessage,
+    ResultMessage,
+    StatusMessage,
+    WorkQueueMessage,
+    new_trace_id,
+)
 
 CODEC_VERSION = 1
 COMPRESSION_ZSTD = "zstd"
@@ -98,6 +114,41 @@ _COMP_NAMES = {v: k for k, v in _COMP_IDS.items()}
 
 def default_compression() -> str:
     return COMPRESSION_ZSTD if _zstd is not None else COMPRESSION_ZLIB
+
+
+# --- typed envelope registry ------------------------------------------------
+# The ONE table mapping every wire `message_type` to the dataclass that
+# decodes it.  Handlers that today re-dispatch by hand (`from_dict` on a
+# guessed class) can use `decode_message`; crawlint's BUS checker
+# (`tools/analyze/busreg.py`) statically enforces that every envelope
+# dataclass in `bus/messages.py` appears here and carries a trace_id, so
+# adding a message type without wiring its decode path fails the tier-1
+# gate instead of surfacing as a dropped message in production.
+MESSAGE_REGISTRY: Dict[str, type] = {
+    MSG_WORK_ITEM: WorkQueueMessage,
+    MSG_POISON_PILL: WorkQueueMessage,
+    MSG_WORK_RESULT: ResultMessage,
+    MSG_DISCOVERED_PAGES: ResultMessage,
+    MSG_HEARTBEAT: StatusMessage,
+    MSG_WORKER_STARTED: StatusMessage,
+    MSG_WORKER_STOPPING: StatusMessage,
+    MSG_PAUSE: ControlMessage,
+    MSG_RESUME: ControlMessage,
+    MSG_STOP: ControlMessage,
+}
+
+
+def decode_message(payload: Dict[str, Any]):
+    """Typed decode of a bus envelope dict by its ``message_type``.
+
+    RecordBatch payloads have no message_type (they are identified by
+    their dedicated topics) and decode via `RecordBatch.from_dict`.
+    """
+    mtype = payload.get("message_type")
+    cls = MESSAGE_REGISTRY.get(mtype)
+    if cls is None:
+        raise ValueError(f"unknown message_type: {mtype!r}")
+    return cls.from_dict(payload)
 
 
 @dataclass

@@ -302,7 +302,9 @@ class YouTubeCrawler(Crawler):
     def _channel_video_count(self, channel_id: str) -> int:
         try:
             return self.client.get_channel_info(channel_id).video_count
-        except Exception:
+        except Exception as e:
+            logger.debug("channel video-count probe failed; treating as 0",
+                         extra={"channel_id": channel_id, "error": str(e)})
             return 0
 
     # -- video -> Post (`youtube_crawler.go:530-838`) ----------------------

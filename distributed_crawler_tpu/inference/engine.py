@@ -576,8 +576,13 @@ def _load_pretrained(cfg: EngineConfig, params, tokenizer):
 
         try:
             tokenizer = from_pretrained_dir(path)
-        except Exception:
-            tokenizer = None  # caller falls back to HashingTokenizer
+        except Exception as e:
+            # Falling back to HashingTokenizer silently would serve real
+            # weights over garbage token ids — make the downgrade visible.
+            logging.getLogger(__name__).warning(
+                "no usable tokenizer in %s (%s); falling back to "
+                "HashingTokenizer", path, e)
+            tokenizer = None
     return ecfg, params, tokenizer
 
 

@@ -78,8 +78,11 @@ class LocalStorageProvider:
     def append_jsonl(self, rel_path: str, line: str) -> None:
         path = self._abs(rel_path)
         os.makedirs(os.path.dirname(path), exist_ok=True)
+        # The lock's PURPOSE is to serialize this append: interleaved
+        # writers would corrupt the JSONL stream, so the file I/O is the
+        # critical section (not incidental work done under it).
         with self._lock:
-            with open(path, "a", encoding="utf-8") as f:
+            with open(path, "a", encoding="utf-8") as f:  # crawlint: disable=LCK002
                 f.write(line.rstrip("\n") + "\n")
 
     def put_text(self, rel_path: str, text: str) -> None:

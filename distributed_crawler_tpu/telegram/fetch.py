@@ -92,8 +92,10 @@ def get_channel_member_count(client: TelegramClient, username: str) -> int:
             info = client.get_supergroup_full_info(chat.supergroup_id)
             if info.member_count:
                 return info.member_count
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("full-info member count unavailable; falling "
+                         "back to get_supergroup", extra={
+                             "username": username, "error": str(e)})
         sg = client.get_supergroup(chat.supergroup_id)
         return sg.member_count
     return 0

@@ -1,0 +1,646 @@
+"""crawlint (tools/analyze) tests: each checker against fixture snippets
+with known positives/negatives, the edge cases from the satellite list
+(aliased imports, functools.partial jit wrapping, acquire()/release(),
+decorated nested functions), suppression comments, the baseline ratchet,
+and the tier-1 gate itself — the full-tree run must stay green.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.analyze.core import (  # noqa: E402
+    Finding,
+    load_baseline,
+    run_paths,
+    write_baseline,
+)
+
+
+def analyze(tmp_path, sources, select=None):
+    """Write {relpath: source} under tmp_path, run all checkers, return
+    findings."""
+    for rel, src in sources.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src), encoding="utf-8")
+    report = run_paths([str(tmp_path)], str(tmp_path), select=select,
+                       baseline=set())
+    return report
+
+
+def codes(report):
+    return [f.code for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
+# TRC — trace safety
+# ---------------------------------------------------------------------------
+
+class TestTRC:
+    def test_print_inside_jit_decorated(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            import jax
+
+            @jax.jit
+            def f(x):
+                print(x)
+                return x
+        """})
+        assert codes(rep) == ["TRC001"]
+        assert rep.findings[0].context == "f"
+
+    def test_aliased_time_inside_jit_lambda(self, tmp_path):
+        # aliased import edge case: `import time as _time` must still
+        # resolve to time.* inside a jit-wrapped lambda.
+        rep = analyze(tmp_path, {"a.py": """
+            import time as _time
+
+            import jax
+
+            g = jax.jit(lambda x: x * _time.time())
+        """})
+        assert codes(rep) == ["TRC002"]
+
+    def test_from_import_jit_alias_detected(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            from jax import jit as J
+
+            @J
+            def f(x):
+                print("traced!")
+                return x
+        """})
+        assert codes(rep) == ["TRC001"]
+
+    def test_partial_wrapped_nested_function_materializes(self, tmp_path):
+        # functools.partial(jax.jit, ...) wrapping + decorated function
+        # NESTED inside an undecorated outer function.
+        rep = analyze(tmp_path, {"a.py": """
+            import functools
+
+            import jax
+
+            def outer():
+                @functools.partial(jax.jit, static_argnames=("k",))
+                def inner(x, k):
+                    return float(x)
+                return inner
+        """})
+        assert codes(rep) == ["TRC003"]
+        assert rep.findings[0].context == "outer.inner"
+
+    def test_item_on_traced_value(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x.sum().item()
+        """})
+        assert codes(rep) == ["TRC003"]
+
+    def test_branch_on_traced_arg(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """})
+        assert codes(rep) == ["TRC004"]
+
+    def test_scalar_literal_to_jit_without_statics(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            import jax
+
+            def run(fn, xs):
+                step = jax.jit(fn)
+                return step(xs, 3)
+        """})
+        assert codes(rep) == ["TRC005"]
+
+    def test_rebinding_with_statics_wins(self, tmp_path):
+        # a later statics-carrying rebinding governs the call sites: the
+        # stale no-statics entry must not keep flagging TRC005
+        rep = analyze(tmp_path, {"a.py": """
+            import jax
+
+            def run(fn, xs):
+                step = jax.jit(fn)
+                step = jax.jit(fn, static_argnums=(1,))
+                return step(xs, 3)
+        """})
+        assert codes(rep) == []
+
+    def test_negative_static_args_and_noneness(self, tmp_path):
+        # static_argnames exempts the branch; `is None` tests and .shape
+        # tests are static under tracing; scalar literals are fine when
+        # statics were declared.
+        rep = analyze(tmp_path, {"a.py": """
+            import functools
+
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("mode",))
+            def f(x, mode, y=None):
+                if mode == "fast":
+                    return x
+                if y is None:
+                    return x
+                if x.shape[0] > 2:
+                    return x + y
+                return x - y
+
+            g = jax.jit(f, static_argnums=(1,))
+            out = g(1.0, 3)
+        """})
+        assert codes(rep) == []
+
+    def test_jit_decorated_inside_if_block(self, tmp_path):
+        # regions nested in compound statements (version-gated defs etc.)
+        # share the enclosing scope and must still be detected
+        rep = analyze(tmp_path, {"a.py": """
+            import jax
+
+            FLAG = True
+            if FLAG:
+                @jax.jit
+                def f(x):
+                    print(x)
+                    return x
+        """})
+        assert codes(rep) == ["TRC001"]
+
+    def test_negative_host_code_untouched(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            import time
+
+            def host(x):
+                print(x)
+                time.sleep(0.1)
+                return float(x)
+        """})
+        assert codes(rep) == []
+
+
+# ---------------------------------------------------------------------------
+# LCK — lock discipline
+# ---------------------------------------------------------------------------
+
+class TestLCK:
+    def test_mixed_locked_unlocked_writes(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def a(self):
+                    with self._lock:
+                        self.n += 1
+
+                def b(self):
+                    self.n = 5
+        """})
+        assert codes(rep) == ["LCK001"]
+        assert rep.findings[0].context == "C.n"
+        assert rep.findings[0].line == 14  # the unlocked write in b()
+
+    def test_sleep_while_holding_lock(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def a(self):
+                    with self._lock:
+                        time.sleep(1)
+        """})
+        assert codes(rep) == ["LCK002"]
+
+    def test_acquire_release_region_with_aliased_time(self, tmp_path):
+        # acquire()/release() instead of `with`, plus `import time as _t`.
+        rep = analyze(tmp_path, {"a.py": """
+            import threading
+            import time as _t
+
+            class C:
+                def __init__(self):
+                    self._mu = threading.RLock()
+                    self.v = 0
+
+                def a(self):
+                    self._mu.acquire()
+                    _t.sleep(0.1)
+                    self.v = 1
+                    self._mu.release()
+
+                def b(self):
+                    self.v = 2
+        """})
+        assert sorted(codes(rep)) == ["LCK001", "LCK002"]
+
+    def test_release_in_finally_clears_held_lock(self, tmp_path):
+        # the canonical acquire/try/finally-release idiom: the release in
+        # the nested finally body must clear the lock for the statements
+        # AFTER the try, or correct code gets a bogus LCK002
+        rep = analyze(tmp_path, {"a.py": """
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def a(self):
+                    self._lock.acquire()
+                    try:
+                        self.n = 1
+                    finally:
+                        self._lock.release()
+                    time.sleep(0.1)
+        """})
+        assert codes(rep) == []
+
+    def test_release_ends_held_region(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def a(self):
+                    self._lock.acquire()
+                    self._lock.release()
+                    time.sleep(0.1)
+        """})
+        assert codes(rep) == []
+
+    def test_negative_disciplined_class(self, tmp_path):
+        # all writes under the lock, blocking work outside it, condition
+        # wait on the HELD lock (the normal CV pattern).
+        rep = analyze(tmp_path, {"a.py": """
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cv = threading.Condition()
+                    self.n = 0
+
+                def a(self):
+                    with self._lock:
+                        self.n += 1
+                    time.sleep(0.01)
+
+                def w(self):
+                    with self._cv:
+                        self._cv.wait_for(lambda: True)
+        """})
+        assert codes(rep) == []
+
+    def test_wait_on_other_object_under_lock(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._stop = threading.Event()
+
+                def a(self):
+                    with self._lock:
+                        self._stop.wait(1.0)
+        """})
+        assert codes(rep) == ["LCK002"]
+
+
+# ---------------------------------------------------------------------------
+# BUS — registry + propagation seam
+# ---------------------------------------------------------------------------
+
+class TestBUS:
+    def test_unregistered_envelope_and_missing_trace_id(self, tmp_path):
+        rep = analyze(tmp_path, {
+            "bus/messages.py": """
+                from dataclasses import dataclass
+
+                @dataclass
+                class GoodMessage:
+                    message_type: str = "good"
+                    trace_id: str = ""
+
+                @dataclass
+                class BadMessage:
+                    message_type: str = "bad"
+            """,
+            "bus/codec.py": """
+                MESSAGE_REGISTRY = {"good": GoodMessage}
+            """,
+        }, select=["BUS"])
+        got = sorted((f.code, f.context) for f in rep.findings)
+        assert got == [("BUS001", "BadMessage"), ("BUS002", "BadMessage")]
+
+    def test_missing_registry_entirely(self, tmp_path):
+        rep = analyze(tmp_path, {
+            "bus/messages.py": """
+                from dataclasses import dataclass
+
+                @dataclass
+                class M:
+                    message_type: str = "m"
+                    trace_id: str = ""
+            """,
+            "bus/codec.py": """
+                CODEC_VERSION = 1
+            """,
+        }, select=["BUS"])
+        assert codes(rep) == ["BUS001"]
+
+    def test_publish_without_inject(self, tmp_path):
+        rep = analyze(tmp_path, {"bus/mybus.py": """
+            class B:
+                def publish(self, topic, payload):
+                    self._send(topic, payload)
+        """}, select=["BUS"])
+        assert codes(rep) == ["BUS003"]
+
+    def test_dispatch_without_payload_span(self, tmp_path):
+        rep = analyze(tmp_path, {"bus/mybus.py": """
+            class B:
+                def _deliver(self, payload):
+                    for handler in self._handlers:
+                        handler(payload)
+        """}, select=["BUS"])
+        assert codes(rep) == ["BUS004"]
+
+    def test_negative_proper_transport(self, tmp_path):
+        rep = analyze(tmp_path, {"bus/mybus.py": """
+            from ..utils import trace
+
+            class B:
+                def publish(self, topic, payload):
+                    payload = trace.inject(payload)
+                    self._send(topic, payload)
+
+                def _deliver(self, topic, payload):
+                    with trace.payload_span("bus.deliver", payload,
+                                            topic=topic):
+                        for handler in self._handlers:
+                            handler(payload)
+
+            class Facade:
+                def publish(self, topic, payload):
+                    self._client.publish(topic, payload)
+        """}, select=["BUS"])
+        assert codes(rep) == []
+
+
+# ---------------------------------------------------------------------------
+# EXC — exception swallowing
+# ---------------------------------------------------------------------------
+
+class TestEXC:
+    def test_pass_swallow(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            def work(item):
+                try:
+                    item.process()
+                except Exception:
+                    pass
+        """}, select=["EXC"])
+        assert codes(rep) == ["EXC001"]
+        assert rep.findings[0].context == "work"
+
+    def test_silent_fallback_assignment(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            def load(parse, path):
+                try:
+                    return parse(path)
+                except Exception:
+                    result = None
+                return result
+        """}, select=["EXC"])
+        assert codes(rep) == ["EXC001"]
+
+    def test_bare_except_swallow(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            def work(item):
+                try:
+                    item.process()
+                except:
+                    pass
+        """}, select=["EXC"])
+        assert codes(rep) == ["EXC001"]
+
+    def test_negative_logged_cleanup_captured_and_del(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            import logging
+
+            logger = logging.getLogger(__name__)
+
+            def logged(item):
+                try:
+                    item.process()
+                except Exception as e:
+                    logger.warning("failed: %s", e)
+
+            def cleanup(conn):
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+
+            def optional_dep():
+                try:
+                    import zstandard
+                except Exception:
+                    zstandard = None
+                return zstandard
+
+            def captured(item):
+                error = None
+                try:
+                    item.process()
+                except BaseException as e:
+                    error = e
+                if error is not None:
+                    raise error
+
+            class C:
+                def __del__(self):
+                    try:
+                        self.close()
+                    except Exception:
+                        pass
+        """}, select=["EXC"])
+        assert codes(rep) == []
+
+    def test_import_next_to_real_work_not_exempt(self, tmp_path):
+        # an import sitting next to real work must not exempt the handler:
+        # swallowing the work's failure is the bug class
+        rep = analyze(tmp_path, {"a.py": """
+            def decode(blob, process):
+                try:
+                    import zstd
+                    data = zstd.decompress(blob)
+                    process(data)
+                except Exception:
+                    pass
+        """}, select=["EXC"])
+        assert codes(rep) == ["EXC001"]
+
+    def test_import_guard_with_setup_and_alias_fallback(self, tmp_path):
+        # the bus/codec.py shape: import + compressor setup in the try,
+        # handler zeroes the import alias — a legit optional-dep guard
+        rep = analyze(tmp_path, {"a.py": """
+            try:
+                import zstandard as _zstd
+                _C = _zstd.ZstdCompressor(level=3)
+            except Exception:
+                _zstd = None
+        """}, select=["EXC"])
+        assert codes(rep) == []
+
+    def test_narrow_except_not_flagged(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            import os
+
+            def rm(path):
+                try:
+                    os.stat(path)
+                except OSError:
+                    pass
+        """}, select=["EXC"])
+        assert codes(rep) == []
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline + runner plumbing
+# ---------------------------------------------------------------------------
+
+class TestSuppressionAndBaseline:
+    def test_inline_suppression_comment(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            def work(item):
+                try:
+                    item.process()
+                except Exception:  # crawlint: disable=EXC001
+                    pass
+        """}, select=["EXC"])
+        assert codes(rep) == []
+        assert rep.suppressed == 1
+
+    def test_suppression_of_other_code_does_not_apply(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            def work(item):
+                try:
+                    item.process()
+                except Exception:  # crawlint: disable=TRC001
+                    pass
+        """}, select=["EXC"])
+        assert codes(rep) == ["EXC001"]
+
+    def test_baseline_grandfathers_then_ratchets(self, tmp_path):
+        src = {"a.py": """
+            def work(item):
+                try:
+                    item.process()
+                except Exception:
+                    pass
+        """}
+        rep = analyze(tmp_path, src)
+        assert codes(rep) == ["EXC001"]
+
+        baseline_file = tmp_path / "baseline.txt"
+        write_baseline(str(baseline_file), rep.findings)
+        baseline = load_baseline(str(baseline_file))
+        assert baseline == {f.key() for f in rep.findings}
+
+        rep2 = run_paths([str(tmp_path)], str(tmp_path), baseline=baseline)
+        assert rep2.findings == []
+        assert rep2.baselined == 1
+
+        # the baseline key is line-number-free: edits above the finding
+        # must not un-baseline it
+        (tmp_path / "a.py").write_text(
+            "import os\n\n\n" + textwrap.dedent(src["a.py"]),
+            encoding="utf-8")
+        rep3 = run_paths([str(tmp_path)], str(tmp_path), baseline=baseline)
+        assert rep3.findings == []
+
+    def test_write_baseline_refuses_select(self, tmp_path):
+        # a partial --select run must not rewrite (and so erase) the
+        # other checker families' baseline keys
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analyze", "--select", "TRC",
+             "--write-baseline", "--baseline",
+             str(tmp_path / "b.txt"), str(tmp_path)],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 2
+        assert "cannot be combined with --select" in proc.stderr
+        assert not (tmp_path / "b.txt").exists()
+
+    def test_unknown_checker_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="unknown checker"):
+            run_paths([str(tmp_path)], str(tmp_path), select=["NOPE"])
+
+    def test_finding_render_has_path_line_code_hint(self):
+        f = Finding(path="x/y.py", line=7, code="LCK002", message="boom",
+                    context="C.m")
+        out = f.render()
+        assert out.startswith("x/y.py:7: LCK002 boom")
+        assert "hint:" in out
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: the real tree stays green, fast, via the module CLI
+# ---------------------------------------------------------------------------
+
+class TestFullTree:
+    def test_full_tree_zero_new_findings(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analyze", "--json"],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        rep = json.loads(proc.stdout)
+        assert rep["findings"] == []
+        assert rep["files"] > 80          # the whole package was scanned
+        # ISSUE budget: analysis itself stays under 5 s on the full tree.
+        assert rep["elapsed_s"] < 5.0
+
+    def test_cli_select_and_nonzero_exit(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent("""
+            def work(item):
+                try:
+                    item.process()
+                except Exception:
+                    pass
+        """), encoding="utf-8")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analyze", "--select", "EXC",
+             "--no-baseline", str(bad)],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 1
+        assert "EXC001" in proc.stdout

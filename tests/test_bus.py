@@ -104,6 +104,56 @@ class TestMessageValidation:
         assert "tpu-inference-batches" in topics
 
 
+class TestMessageRegistry:
+    """`bus.codec.MESSAGE_REGISTRY` + `decode_message`: the typed-decode
+    table the crawlint BUS checker statically enforces."""
+
+    def test_every_registered_type_roundtrips(self):
+        from distributed_crawler_tpu.bus import MESSAGE_REGISTRY, decode_message
+
+        samples = {
+            WorkQueueMessage: WorkQueueMessage.new(
+                WorkItem.new("u", 0, "", "c", "telegram", WorkItemConfig())),
+            ResultMessage: ResultMessage.new(
+                WorkResult(work_item_id="w", worker_id="k",
+                           status="success")),
+            StatusMessage: StatusMessage.new("w1", "heartbeat", "idle"),
+            ControlMessage: ControlMessage(message_type="pause",
+                                           trace_id="trace_x"),
+        }
+        assert set(MESSAGE_REGISTRY.values()) == set(samples)
+        for cls, msg in samples.items():
+            payload = json.loads(json.dumps(msg.to_dict()))
+            decoded = decode_message(payload)
+            assert type(decoded) is cls
+            assert decoded.message_type == msg.message_type
+
+    def test_registry_covers_every_declared_message_type(self):
+        from distributed_crawler_tpu.bus import MESSAGE_REGISTRY
+        from distributed_crawler_tpu.bus import messages as m
+
+        declared = {v for k, v in vars(m).items()
+                    if k.startswith("MSG_")
+                    and k not in ("MSG_RECORD_BATCH", "MSG_INFERENCE_RESULT")}
+        assert declared == set(MESSAGE_REGISTRY)
+
+    def test_unknown_message_type_rejected(self):
+        from distributed_crawler_tpu.bus import decode_message
+
+        with pytest.raises(ValueError, match="unknown message_type"):
+            decode_message({"message_type": "nope"})
+        with pytest.raises(ValueError, match="unknown message_type"):
+            decode_message({})
+
+    def test_decoded_envelope_keeps_trace_id(self):
+        from distributed_crawler_tpu.bus import decode_message
+
+        item = WorkItem.new("u", 0, "", "c", "telegram", WorkItemConfig())
+        msg = WorkQueueMessage.new(item)
+        decoded = decode_message(msg.to_dict())
+        assert decoded.trace_id == item.trace_id
+
+
 def make_posts(n):
     return [Post(post_link=f"l{i}", channel_id="c", post_uid=str(i),
                  url=f"l{i}", platform_name="telegram",

@@ -29,8 +29,10 @@ def apply(jax_module) -> None:
 def selfcheck() -> int:
     """`python tools/_smoke.py`: the cheap pre-bench sanity gate — byte-
     compile the whole package (catches syntax/indentation rot in modules no
-    test imports), then run the metrics + tracing unit tests the other
-    tools' /metrics and /traces reads depend on."""
+    test imports), run crawlint (`python -m tools.analyze`; the
+    repo-native static checkers, docs/static-analysis.md), then run the
+    metrics + tracing unit tests the other tools' /metrics and /traces
+    reads depend on."""
     import compileall
     import subprocess
 
@@ -39,6 +41,10 @@ def selfcheck() -> int:
     if not compileall.compile_dir(pkg, quiet=1):
         print("compileall FAILED", file=sys.stderr)
         return 1
+    rc = subprocess.call([sys.executable, "-m", "tools.analyze"], cwd=repo)
+    if rc != 0:
+        print("crawlint FAILED (python -m tools.analyze)", file=sys.stderr)
+        return rc
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     return subprocess.call(
         [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",

@@ -1,0 +1,30 @@
+"""crawlint — repo-native static analysis for distributed_crawler_tpu.
+
+The Go reference leaned on `go vet` + the race detector; the TPU-native
+Python port has invariant classes a generic linter cannot see.  Four
+AST-based checkers (stdlib-only, no third-party deps) encode them:
+
+- **TRC** trace-safety: host side effects inside `jax.jit` / `jax.pmap` /
+  `shard_map`-traced regions, and jitted call sites passing raw Python
+  scalars that belong in ``static_argnums`` (the recompile hazards behind
+  the ``tpu_engine_compile_cache_misses_total`` metric).
+- **LCK** lock-discipline: instance attributes written both inside and
+  outside a lock in the same class, and blocking calls made while a lock
+  is held.
+- **BUS** bus-registry: every envelope dataclass in `bus/messages.py`
+  registered in `bus/codec.py`'s ``MESSAGE_REGISTRY``, carrying a
+  ``trace_id`` field, with both transports using the PR-2
+  ``trace.inject`` / ``trace.payload_span`` propagation seam.
+- **EXC** exception-swallowing: broad handlers in worker/orchestrator
+  loops that drop the error with no log, metric, or re-raise.
+
+Entry points: ``python -m tools.analyze`` (see `__main__.py`) or
+:func:`tools.analyze.core.run_paths` programmatically.  A checked-in
+``baseline.txt`` grandfathers accepted findings so the gate starts green
+and ratchets; `tests/test_analyze.py` makes the zero-new-findings run
+part of tier-1.  Checker catalogue and workflow: `docs/static-analysis.md`.
+"""
+
+from .core import Finding, run_paths  # noqa: F401
+
+CHECKER_CODES = ("TRC", "LCK", "BUS", "EXC")

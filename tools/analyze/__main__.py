@@ -1,0 +1,83 @@
+"""`python -m tools.analyze` — run crawlint over the tree.
+
+Exit codes: 0 = no non-baselined findings, 1 = new findings, 2 = usage
+error.  See docs/static-analysis.md for the checker catalogue and the
+baseline/ratchet workflow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import all_findings, load_baseline, run_paths, write_baseline
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_TARGET = os.path.join(REPO, "distributed_crawler_tpu")
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.txt")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="crawlint: repo-native static analysis "
+                    "(TRC trace-safety, LCK lock-discipline, "
+                    "BUS bus-registry, EXC exception-swallowing)")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/dirs to analyze "
+                        "(default: distributed_crawler_tpu/)")
+    p.add_argument("--select", default=None, metavar="TRC,LCK,...",
+                   help="comma-separated checker families to run "
+                        "(default: all four)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable report on stdout")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline file of grandfathered finding keys")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline (report everything)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="snapshot current findings into --baseline and "
+                        "exit 0 (ratchet tool — review the diff!)")
+    args = p.parse_args(argv)
+
+    paths = args.paths or [DEFAULT_TARGET]
+    select = [s for s in (args.select or "").split(",") if s] or None
+    if args.write_baseline and select:
+        # A partial run must not rewrite the whole-baseline file: it would
+        # silently drop every other family's grandfathered keys.
+        print("error: --write-baseline cannot be combined with --select "
+              "(it would erase the other checkers' baseline keys)",
+              file=sys.stderr)
+        return 2
+    try:
+        if args.write_baseline:
+            findings = all_findings(paths, REPO, select=select)
+            write_baseline(args.baseline, findings)
+            print(f"wrote {len({f.key() for f in findings})} baseline "
+                  f"key(s) to {args.baseline}")
+            return 0
+        baseline = set() if args.no_baseline \
+            else load_baseline(args.baseline)
+        report = run_paths(paths, REPO, select=select, baseline=baseline)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        for f in report.findings:
+            print(f.render())
+        print(f"crawlint: {len(report.findings)} new finding(s), "
+              f"{report.baselined} baselined, {report.suppressed} "
+              f"suppressed, {report.files} files in "
+              f"{report.elapsed_s:.2f}s")
+    return 1 if report.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,170 @@
+"""BUS — bus-registry and trace-propagation invariants (cross-file).
+
+The message layer's contract has three legs crawlint can see statically:
+
+- BUS001 every envelope dataclass in `bus/messages.py` (a dataclass with
+  a ``message_type`` field) is registered in `bus/codec.py`'s
+  ``MESSAGE_REGISTRY`` so `decode_message` can give it a typed decode.
+- BUS002 every envelope dataclass carries a ``trace_id`` field — the
+  handle the PR-2 span tracing correlates across bus hops.
+- BUS003 every transport's ``publish`` method routes through the
+  ``trace.inject`` propagation seam (or delegates to one that does).
+- BUS004 every handler-dispatch loop in `bus/` wraps delivery in
+  ``trace.payload_span`` so the hop lands in the envelope's trace.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import Finding, ModuleInfo, dotted_name
+
+REGISTRY_NAME = "MESSAGE_REGISTRY"
+
+
+def _is_dataclass(cls: ast.ClassDef, imports: Dict[str, str]) -> bool:
+    for dec in cls.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        dotted = dotted_name(target, imports)
+        if dotted in ("dataclass", "dataclasses.dataclass"):
+            return True
+    return False
+
+
+def _field_names(cls: ast.ClassDef) -> Set[str]:
+    out: Set[str] = set()
+    for stmt in cls.body:
+        if isinstance(stmt, ast.AnnAssign) and \
+                isinstance(stmt.target, ast.Name):
+            out.add(stmt.target.id)
+        elif isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _registry_class_names(codec: ModuleInfo) -> Optional[Set[str]]:
+    """Class names appearing as values of codec.py's MESSAGE_REGISTRY
+    dict; None when the registry doesn't exist at all."""
+    for node in ast.walk(codec.tree):
+        targets: List[ast.expr] = []
+        value: Optional[ast.expr] = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        if not any(isinstance(t, ast.Name) and t.id == REGISTRY_NAME
+                   for t in targets):
+            continue
+        if not isinstance(value, ast.Dict):
+            return set()
+        names: Set[str] = set()
+        for v in value.values:
+            if isinstance(v, ast.Name):
+                names.add(v.id)
+            elif isinstance(v, ast.Attribute):
+                names.add(v.attr)
+        return names
+    return None
+
+
+def _calls_in(fn: ast.AST, imports: Dict[str, str]) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            dotted = dotted_name(node.func, imports)
+            if dotted:
+                out.add(dotted)
+            if isinstance(node.func, ast.Attribute):
+                out.add(node.func.attr)
+    return out
+
+
+def _check_messages_and_registry(messages: ModuleInfo,
+                                 codec: Optional[ModuleInfo]
+                                 ) -> List[Finding]:
+    findings: List[Finding] = []
+    envelopes: List[ast.ClassDef] = []
+    for node in messages.tree.body:
+        if isinstance(node, ast.ClassDef) \
+                and _is_dataclass(node, messages.imports) \
+                and "message_type" in _field_names(node):
+            envelopes.append(node)
+
+    registered = _registry_class_names(codec) if codec is not None else None
+    for cls in envelopes:
+        fields = _field_names(cls)
+        if "trace_id" not in fields:
+            findings.append(Finding(
+                path=messages.path, line=cls.lineno, code="BUS002",
+                message=f"envelope dataclass {cls.name} has no trace_id "
+                        "field", context=cls.name))
+        if codec is None:
+            continue
+        if registered is None:
+            findings.append(Finding(
+                path=codec.path, line=1, code="BUS001",
+                message=f"bus/codec.py defines no {REGISTRY_NAME}; "
+                        f"envelope {cls.name} cannot be decoded by type",
+                context=cls.name))
+        elif cls.name not in registered:
+            findings.append(Finding(
+                path=codec.path, line=1, code="BUS001",
+                message=f"envelope dataclass {cls.name} missing from "
+                        f"{REGISTRY_NAME}", context=cls.name))
+    return findings
+
+
+def _check_transport(mod: ModuleInfo) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        calls = _calls_in(node, mod.imports)
+        if node.name == "publish":
+            injected = any(c.endswith("trace.inject") or c == "inject"
+                           for c in calls)
+            delegates = any("publish" in c for c in calls
+                            if c != "publish")
+            if not injected and not delegates:
+                findings.append(Finding(
+                    path=mod.path, line=node.lineno, code="BUS003",
+                    message="publish() neither calls trace.inject nor "
+                            "delegates to a publishing transport",
+                    context=node.name))
+        if self_dispatches_handlers(node):
+            spanned = any(c.endswith("payload_span") for c in calls)
+            if not spanned:
+                findings.append(Finding(
+                    path=mod.path, line=node.lineno, code="BUS004",
+                    message=f"{node.name}() dispatches handlers outside "
+                            "trace.payload_span", context=node.name))
+    return findings
+
+
+def self_dispatches_handlers(fn: ast.AST) -> bool:
+    """True for functions that invoke a subscriber callback — a call to a
+    bare name ``handler`` (the repo-wide dispatch-loop idiom)."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id == "handler":
+            return True
+    return False
+
+
+def check_tree(modules: List[ModuleInfo]) -> List[Finding]:
+    by_path = {m.path: m for m in modules}
+    messages = next((m for p, m in by_path.items()
+                     if p.endswith("bus/messages.py")), None)
+    codec = next((m for p, m in by_path.items()
+                  if p.endswith("bus/codec.py")), None)
+    findings: List[Finding] = []
+    if messages is not None:
+        findings.extend(_check_messages_and_registry(messages, codec))
+    for mod in modules:
+        if "/bus/" in mod.path or mod.path.startswith("bus/"):
+            findings.extend(_check_transport(mod))
+    return findings

@@ -1,0 +1,325 @@
+"""crawlint core: findings, shared AST helpers, suppression, baseline, runner.
+
+Checkers are plain functions ``check(module: ModuleInfo) -> List[Finding]``
+(plus tree-level checkers that see every module at once, e.g. the BUS
+registry cross-file check).  The runner parses each file exactly once and
+hands the same tree to every checker, which is what keeps the full-tree
+run under the 5 s budget.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# findings
+# ---------------------------------------------------------------------------
+
+#: code -> one-line fix hint shown with every finding of that code.
+HINTS: Dict[str, str] = {
+    "TRC001": "remove the print (or use jax.debug.print / host_callback)",
+    "TRC002": "move host clocks out of the traced function; time around "
+              "the dispatch site instead",
+    "TRC003": "materialize on host AFTER the jitted call returns, or mark "
+              "the argument static",
+    "TRC004": "Python control flow on traced values retraces per branch; "
+              "use lax.cond/select, or list the arg in static_argnums",
+    "TRC005": "a raw Python scalar re-traces per distinct value; pass via "
+              "static_argnums/static_argnames or wrap in jnp.asarray",
+    "LCK001": "take the class lock around every write to this attribute "
+              "(or document why construction-time writes are safe)",
+    "LCK002": "move the blocking call outside the critical section; hold "
+              "the lock only to snapshot/commit state",
+    "BUS001": "register the envelope class in bus/codec.py "
+              "MESSAGE_REGISTRY for every message_type it carries",
+    "BUS002": "add a trace_id field so the envelope joins the span trace "
+              "across bus hops (see utils/trace.py)",
+    "BUS003": "call trace.inject(payload) before serializing (the PR-2 "
+              "propagation seam), or delegate to a transport that does",
+    "BUS004": "wrap handler dispatch in trace.payload_span(...) so the "
+              "delivery hop lands in the envelope's trace",
+    "EXC001": "log (or count) the swallowed exception — a silent handler "
+              "in a worker loop erases the failure",
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One defect: ``path:line``, checker code, message, fix hint."""
+
+    path: str          # repo-relative, posix separators
+    line: int
+    code: str          # e.g. "TRC001"
+    message: str
+    context: str = ""  # enclosing qualname (baseline key component)
+
+    @property
+    def hint(self) -> str:
+        return HINTS.get(self.code, "")
+
+    def key(self) -> str:
+        """Line-number-free baseline key: survives unrelated edits above
+        the finding."""
+        return f"{self.path}:{self.code}:{self.context or '<module>'}"
+
+    def render(self) -> str:
+        hint = f"  [hint: {self.hint}]" if self.hint else ""
+        return f"{self.path}:{self.line}: {self.code} {self.message}{hint}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"path": self.path, "line": self.line, "code": self.code,
+                "message": self.message, "context": self.context,
+                "hint": self.hint}
+
+
+# ---------------------------------------------------------------------------
+# per-module parse product
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*crawlint:\s*disable(?:=([A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*))?")
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed source file plus everything checkers share."""
+
+    path: str                  # repo-relative posix path
+    tree: ast.Module
+    source_lines: List[str]
+    imports: Dict[str, str] = field(default_factory=dict)
+    # line -> set of suppressed codes (empty set = all codes suppressed)
+    suppressions: Dict[int, set] = field(default_factory=dict)
+
+    def suppressed(self, finding: Finding) -> bool:
+        codes = self.suppressions.get(finding.line)
+        if codes is None:
+            return False
+        return not codes or finding.code in codes
+
+
+def build_import_map(tree: ast.Module) -> Dict[str, str]:
+    """Local name -> canonical dotted path, covering aliased imports
+    (``import time as _time``, ``from jax import jit as J``)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+                if a.asname is None and "." in a.name:
+                    # `import jax.numpy` binds `jax`; the dotted use
+                    # resolves through attribute chains.
+                    out[a.name.split(".")[0]] = a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:      # relative import: keep the tail as-is
+                base = node.module or ""
+            else:
+                base = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                dotted = f"{base}.{a.name}" if base else a.name
+                out[a.asname or a.name] = dotted
+    return out
+
+
+def dotted_name(node: ast.AST, imports: Dict[str, str]) -> Optional[str]:
+    """Resolve a Name/Attribute chain to its canonical dotted path using
+    the module's import aliases; None for anything dynamic."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    root = imports.get(node.id, node.id)
+    parts.append(root)
+    return ".".join(reversed(parts))
+
+
+def header_exprs(stmt: ast.stmt) -> List[ast.AST]:
+    """A statement's own expressions, excluding nested statement bodies
+    (``body``/``orelse``/``finalbody``/``handlers``) — lets callers walk
+    statements recursively without double-visiting expressions."""
+    out: List[ast.AST] = []
+    for name, value in ast.iter_fields(stmt):
+        if name in ("body", "orelse", "finalbody", "handlers"):
+            continue
+        if isinstance(value, ast.AST):
+            out.append(value)
+        elif isinstance(value, list):
+            out.extend(v for v in value if isinstance(v, ast.AST))
+    return out
+
+
+def iter_scope_stmts(stmts: Sequence[ast.stmt]):
+    """Every statement in a scope at any compound-statement nesting depth,
+    WITHOUT descending into nested function/class scopes."""
+    for s in stmts:
+        yield s
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue
+        for fname in ("body", "orelse", "finalbody"):
+            sub = getattr(s, fname, None)
+            if isinstance(sub, list):
+                yield from iter_scope_stmts(
+                    [c for c in sub if isinstance(c, ast.stmt)])
+        for h in getattr(s, "handlers", None) or []:
+            yield from iter_scope_stmts(h.body)
+
+
+def scan_suppressions(source_lines: Sequence[str]) -> Dict[int, set]:
+    out: Dict[int, set] = {}
+    for i, line in enumerate(source_lines, start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        codes = m.group(1)
+        out[i] = set() if codes is None else \
+            {c.strip() for c in codes.split(",")}
+    return out
+
+
+def parse_module(abspath: str, relpath: str) -> Optional[ModuleInfo]:
+    try:
+        with open(abspath, "r", encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=relpath)
+    except (OSError, SyntaxError, ValueError):
+        # Unparseable files are compileall's problem, not crawlint's.
+        return None
+    lines = source.splitlines()
+    return ModuleInfo(path=relpath, tree=tree, source_lines=lines,
+                      imports=build_import_map(tree),
+                      suppressions=scan_suppressions(lines))
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: str) -> set:
+    keys = set()
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line and not line.startswith("#"):
+                    keys.add(line)
+    except OSError:
+        pass
+    return keys
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    keys = sorted({f.key() for f in findings})
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("# crawlint baseline: grandfathered findings "
+                "(`python -m tools.analyze --write-baseline`).\n"
+                "# One `path:CODE:context` key per line; the gate fails "
+                "only on findings NOT listed here.\n"
+                "# Ratchet: only ever shrink this file.\n")
+        for k in keys:
+            f.write(k + "\n")
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+def iter_py_files(paths: Sequence[str], root: str) -> List[Tuple[str, str]]:
+    """(abspath, relpath) for every .py under ``paths`` (files or dirs)."""
+    out: List[Tuple[str, str]] = []
+    for p in paths:
+        ap = os.path.abspath(p)
+        if os.path.isfile(ap):
+            out.append((ap, os.path.relpath(ap, root).replace(os.sep, "/")))
+            continue
+        for dirpath, dirnames, filenames in os.walk(ap):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    fp = os.path.join(dirpath, fn)
+                    out.append((fp,
+                                os.path.relpath(fp, root).replace(os.sep,
+                                                                  "/")))
+    return sorted(set(out))
+
+
+@dataclass
+class Report:
+    findings: List[Finding]          # new (non-baselined, non-suppressed)
+    baselined: int
+    suppressed: int
+    files: int
+    elapsed_s: float
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "findings": [f.to_dict() for f in self.findings],
+            "baselined": self.baselined,
+            "suppressed": self.suppressed,
+            "files": self.files,
+            "elapsed_s": round(self.elapsed_s, 3),
+        }
+
+
+def run_paths(paths: Sequence[str], root: str,
+              select: Optional[Sequence[str]] = None,
+              baseline: Optional[set] = None) -> Report:
+    """Parse every file once, run the selected checkers, apply suppression
+    comments and the baseline, and return the report."""
+    from . import busreg, exc, lck, trc
+
+    t0 = time.perf_counter()
+    per_module = {"TRC": trc.check, "LCK": lck.check, "EXC": exc.check}
+    selected = {s.upper() for s in (select or ("TRC", "LCK", "BUS", "EXC"))}
+    unknown = selected - {"TRC", "LCK", "BUS", "EXC"}
+    if unknown:
+        raise ValueError(f"unknown checker(s): {sorted(unknown)}")
+
+    modules: List[ModuleInfo] = []
+    for abspath, relpath in iter_py_files(paths, root):
+        mod = parse_module(abspath, relpath)
+        if mod is not None:
+            modules.append(mod)
+
+    raw: List[Tuple[ModuleInfo, Finding]] = []
+    for mod in modules:
+        for code, fn in per_module.items():
+            if code in selected:
+                for f in fn(mod):
+                    raw.append((mod, f))
+    if "BUS" in selected:
+        for f in busreg.check_tree(modules):
+            mod = next((m for m in modules if m.path == f.path), None)
+            raw.append((mod, f))
+
+    suppressed = 0
+    visible: List[Finding] = []
+    for mod, f in raw:
+        if mod is not None and mod.suppressed(f):
+            suppressed += 1
+        else:
+            visible.append(f)
+    visible.sort(key=lambda f: (f.path, f.line, f.code))
+
+    baseline = baseline or set()
+    new = [f for f in visible if f.key() not in baseline]
+    return Report(findings=new, baselined=len(visible) - len(new),
+                  suppressed=suppressed, files=len(modules),
+                  elapsed_s=time.perf_counter() - t0)
+
+
+def all_findings(paths: Sequence[str], root: str,
+                 select: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Baseline-free run (what --write-baseline snapshots)."""
+    return run_paths(paths, root, select=select, baseline=set()).findings

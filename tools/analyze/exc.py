@@ -1,0 +1,145 @@
+"""EXC — exception-swallowing handlers that erase failures.
+
+- EXC001 a handler catching ``Exception`` / ``BaseException`` / bare
+  ``except:`` whose body contains no call, no ``raise``, and no metric —
+  in a worker/orchestrator loop this silently drops the work item's
+  failure.
+
+Deliberate idioms are exempt, because the point is signal, not ritual:
+- cleanup suppression: the ``try`` body only makes teardown-ish calls
+  (``close``/``shutdown``/``stop``/``cancel``/``join``/``terminate``/
+  ``kill``/``unlink``/``remove``/``delete*``/``flush``/``disconnect``);
+- optional-dependency guards: the ``try`` body is imports only, or the
+  handler binds a fallback to an imported alias (``except: zstd = None``);
+- ``__del__`` (interpreter teardown may have dismantled anything).
+
+Everything else either logs/counts, re-raises, or carries an explicit
+``# crawlint: disable=EXC001`` with its justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Finding, ModuleInfo
+
+_BROAD = {"Exception", "BaseException"}
+_CLEANUP_PREFIXES = ("close", "shutdown", "stop", "cancel", "join",
+                     "terminate", "kill", "unlink", "remove", "delete",
+                     "flush", "disconnect", "release", "abort")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Attribute):
+        return t.attr in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(_is_broad(ast.ExceptHandler(type=e, name=None, body=[]))
+                   for e in t.elts)
+    return False
+
+
+def _body_is_silent(handler: ast.ExceptHandler) -> bool:
+    """No call, no raise, no assert — and no capture of the bound
+    exception (``except E as e: error = e`` stores it for a later
+    re-raise, which IS propagation)."""
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Call, ast.Raise, ast.Assert)):
+                return False
+            if handler.name and isinstance(node, ast.Name) \
+                    and node.id == handler.name \
+                    and isinstance(node.ctx, ast.Load):
+                return False
+    return True
+
+
+def _call_name(call: ast.Call) -> str:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return ""
+
+
+def _try_body_is_cleanup(body: List[ast.stmt]) -> bool:
+    """Every statement is a cleanup-ish call (or an import guard)."""
+    if not body:
+        return False
+    for stmt in body:
+        if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            name = _call_name(stmt.value).lower().lstrip("_")
+            if name.startswith(_CLEANUP_PREFIXES):
+                continue
+        return False
+    return True
+
+
+def _is_import_guard(node: ast.Try, handler: ast.ExceptHandler) -> bool:
+    """Optional-dependency guard: either the whole try body is imports, or
+    the handler binds a fallback to one of the imported aliases
+    (``except Exception: zstd = None``).  A try body that merely CONTAINS
+    an import next to real work is NOT exempt — swallowing the work's
+    failure is exactly what EXC001 exists to catch."""
+    aliases = set()
+    only_imports = True
+    for stmt in node.body:
+        if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            for a in stmt.names:
+                aliases.add(a.asname or a.name.split(".")[0])
+        else:
+            only_imports = False
+    if not aliases:
+        return False
+    if only_imports:
+        return True
+    for stmt in handler.body:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store) \
+                    and sub.id in aliases:
+                return True
+    return False
+
+
+def check(mod: ModuleInfo) -> List[Finding]:
+    findings: List[Finding] = []
+    # enclosing-function map for the __del__ exemption and context names
+    qual_of: dict = {}
+
+    def _index(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                _index(child, stack + [child.name])
+            else:
+                if isinstance(child, ast.Try):
+                    qual_of[id(child)] = ".".join(stack)
+                _index(child, stack)
+
+    _index(mod.tree, [])
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        qual = qual_of.get(id(node), "")
+        if qual.split(".")[-1] == "__del__":
+            continue
+        if _try_body_is_cleanup(node.body):
+            continue
+        for handler in node.handlers:
+            if _is_import_guard(node, handler):
+                continue
+            if _is_broad(handler) and _body_is_silent(handler):
+                findings.append(Finding(
+                    path=mod.path, line=handler.lineno, code="EXC001",
+                    message="broad except swallows the error with no "
+                            "log, metric, or re-raise",
+                    context=qual or "<module>"))
+    return findings

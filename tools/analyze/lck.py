@@ -1,0 +1,232 @@
+"""LCK — lock-discipline across classes that own threading locks.
+
+Lock attributes are discovered by construction (``self._lock =
+threading.Lock()/RLock()/Condition()/Semaphore()``, any import alias);
+``with self._lock:`` blocks and paired ``self._lock.acquire()`` /
+``release()`` calls both count as held regions.  ``with <obj>.lock:``
+(a lock field on a helper object, e.g. a per-topic queue) also counts.
+
+Codes:
+- LCK001 an instance attribute written BOTH inside and outside held-lock
+  regions in the same class (``__init__`` is exempt: construction
+  happens-before publication).  Emitted at each unlocked write site.
+- LCK002 a blocking call made while a lock is held (``time.sleep``, file
+  ``open``, socket/subprocess/urllib/requests work, or ``.wait()`` /
+  ``.wait_for()`` on an object other than the held lock) — the critical
+  section should only snapshot/commit state.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, ModuleInfo, dotted_name, header_exprs
+
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+}
+_LOCKISH_ATTRS = ("lock", "mutex", "mu")
+_BLOCKING_PREFIXES = ("socket.", "subprocess.", "requests.",
+                      "urllib.request.")
+_BLOCKING_EXACT = {"time.sleep", "open", "io.open"}
+
+
+def _lock_attr_name(expr: ast.AST) -> Optional[str]:
+    """``self._lock`` -> "_lock"; ``tq.lock`` -> "tq.lock" (held-lock key
+    for non-self lock fields whose attr name looks lock-ish)."""
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        if expr.value.id == "self":
+            return expr.attr
+        low = expr.attr.lower().lstrip("_")
+        if low in _LOCKISH_ATTRS:
+            return f"{expr.value.id}.{expr.attr}"
+    return None
+
+
+def _self_attr(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self":
+        return expr.attr
+    return None
+
+
+class _ClassScan:
+    def __init__(self, mod: ModuleInfo, cls: ast.ClassDef):
+        self.mod = mod
+        self.cls = cls
+        self.lock_attrs: Set[str] = set()
+        # attr -> [(locked?, line, method)]
+        self.writes: Dict[str, List[Tuple[bool, int, str]]] = {}
+        self.findings: List[Finding] = []
+
+    def run(self) -> List[Finding]:
+        self._find_lock_attrs()
+        if not self.lock_attrs:
+            return []
+        for stmt in self.cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if stmt.name in ("__init__", "__new__"):
+                    continue
+                self._scan_block(stmt.body, set(), stmt.name)
+        self._report_mixed_writes()
+        return self.findings
+
+    def _find_lock_attrs(self) -> None:
+        for node in ast.walk(self.cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            dotted = dotted_name(node.value.func, self.mod.imports) \
+                if isinstance(node.value, ast.Call) else None
+            if dotted not in _LOCK_CTORS:
+                continue
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr:
+                    self.lock_attrs.add(attr)
+
+    # -- held-region statement walk ----------------------------------------
+    def _scan_block(self, stmts: List[ast.stmt], held: Set[str],
+                    method: str) -> None:
+        # NOTE: ``held`` is shared with the caller on purpose — a
+        # release() inside a nested block (the acquire/try/finally-release
+        # idiom) must clear the lock for the statements that follow the
+        # compound statement.  `with` blocks scope their own additions via
+        # the copy in _scan_stmt.
+        for stmt in stmts:
+            # acquire()/release() outside a `with`: linear, per-block.
+            acq = self._acquire_release(stmt)
+            if acq is not None:
+                name, is_acquire = acq
+                if is_acquire:
+                    held.add(name)
+                else:
+                    held.discard(name)
+                continue
+            self._scan_stmt(stmt, held, method)
+
+    def _acquire_release(self, stmt: ast.stmt
+                         ) -> Optional[Tuple[str, bool]]:
+        if not (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Call)
+                and isinstance(stmt.value.func, ast.Attribute)):
+            return None
+        call = stmt.value
+        if call.func.attr not in ("acquire", "release"):
+            return None
+        name = _lock_attr_name(call.func.value)
+        if name is None or (name not in self.lock_attrs
+                            and "." not in name):
+            return None
+        return name, call.func.attr == "acquire"
+
+    def _scan_stmt(self, stmt: ast.stmt, held: Set[str],
+                   method: str) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # A nested def runs later, not under this lock.
+            self._scan_block(stmt.body, set(), f"{method}.{stmt.name}")
+            return
+        if isinstance(stmt, ast.With):
+            inner = set(held)
+            for item in stmt.items:
+                name = _lock_attr_name(item.context_expr)
+                if name and (name in self.lock_attrs or "." in name):
+                    inner.add(name)
+            self._record_exprs(stmt, held, method)
+            self._scan_block(stmt.body, inner, method)
+            return
+        # Record writes/calls in this statement's own header expressions,
+        # then recurse into compound bodies with the same held set.
+        self._record_exprs(stmt, held, method)
+        if isinstance(stmt, ast.Try):
+            self._scan_block(stmt.body, held, method)
+            for h in stmt.handlers:
+                self._scan_block(h.body, held, method)
+            self._scan_block(stmt.orelse, held, method)
+            self._scan_block(stmt.finalbody, held, method)
+            return
+        for fname in ("body", "orelse"):
+            sub = getattr(stmt, fname, None)
+            if isinstance(sub, list) and sub \
+                    and all(isinstance(c, ast.stmt) for c in sub):
+                self._scan_block(sub, held, method)
+
+    def _record_exprs(self, stmt: ast.stmt, held: Set[str],
+                      method: str) -> None:
+        """Record attribute writes and blocking calls on the statement's
+        header expressions (not its nested statement bodies — those are
+        walked with their own held set)."""
+        for node in header_exprs(stmt):
+            for sub in self._iter_nonlambda(node):
+                if isinstance(sub, ast.Call):
+                    self._check_blocking(sub, held, method)
+        targets: List[ast.expr] = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        for t in targets:
+            attr = _self_attr(t)
+            if attr and attr not in self.lock_attrs:
+                self.writes.setdefault(attr, []).append(
+                    (bool(held), stmt.lineno, method))
+
+    @staticmethod
+    def _iter_nonlambda(node: ast.AST):
+        """Walk an expression tree, skipping Lambda bodies (they run
+        later, not while this lock is held)."""
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, ast.Lambda):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _check_blocking(self, call: ast.Call, held: Set[str],
+                        method: str) -> None:
+        if not held:
+            return
+        dotted = dotted_name(call.func, self.mod.imports)
+        blocking = None
+        if dotted in _BLOCKING_EXACT:
+            blocking = dotted
+        elif dotted is not None and \
+                dotted.startswith(_BLOCKING_PREFIXES):
+            blocking = dotted
+        elif isinstance(call.func, ast.Attribute) \
+                and call.func.attr in ("wait", "wait_for"):
+            # Condition.wait on the HELD lock is the normal CV pattern;
+            # waiting on anything else while holding a lock is not.
+            waited = _lock_attr_name(call.func.value)
+            if waited is None or waited not in held:
+                blocking = f"{ast.unparse(call.func)}"
+        if blocking:
+            self.findings.append(Finding(
+                path=self.mod.path, line=call.lineno, code="LCK002",
+                message=f"blocking call {blocking} while holding "
+                        f"{'/'.join(sorted(held))}",
+                context=f"{self.cls.name}.{method}"))
+
+    def _report_mixed_writes(self) -> None:
+        for attr, sites in self.writes.items():
+            locked = [s for s in sites if s[0]]
+            unlocked = [s for s in sites if not s[0]]
+            if not locked or not unlocked:
+                continue
+            lock_lines = ",".join(str(line) for _, line, _ in locked[:3])
+            for _, line, method in unlocked:
+                self.findings.append(Finding(
+                    path=self.mod.path, line=line, code="LCK001",
+                    message=f"self.{attr} written without the lock here "
+                            f"but under it at line(s) {lock_lines}",
+                    context=f"{self.cls.name}.{attr}"))
+
+
+def check(mod: ModuleInfo) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            findings.extend(_ClassScan(mod, node).run())
+    return findings

@@ -1,0 +1,337 @@
+"""TRC — trace-safety inside `jax.jit` / `jax.pmap` / `shard_map` regions.
+
+What counts as a traced region:
+- a function decorated with ``jax.jit`` / ``jax.pmap`` / ``shard_map``
+  (any import alias), including ``functools.partial(jax.jit, ...)``
+  wrappers and decorated functions nested inside undecorated ones;
+- a lambda or locally-defined function wrapped at a call site
+  (``step = jax.jit(step_fn)``, ``jax.shard_map(per_stage, ...)``).
+
+Codes:
+- TRC001 ``print`` inside a traced region (fires at trace time only, then
+  silently never again — and pins a host callback if converted naively).
+- TRC002 ``time.*`` host clocks inside a traced region (reads the clock
+  once at trace time; every later dispatch replays the stale constant).
+- TRC003 host materialization of a traced value (``.item()``,
+  ``.tolist()``, ``float()/int()/bool()``, ``np.asarray``): forces a
+  device sync inside the trace or fails outright.
+- TRC004 Python ``if``/``while`` branching on a traced argument: each
+  branch is a separate trace -> recompile per truth value.  ``is None``
+  checks and ``.shape``/``.ndim``/``.dtype`` tests are exempt (static
+  under tracing), as are args listed in static_argnums/static_argnames.
+- TRC005 calling a jit-wrapped function (built with NO static args) with
+  a raw Python scalar literal: weak-typed scalars hash by value, so every
+  distinct constant is a fresh compile — the hazard behind
+  ``tpu_engine_compile_cache_misses_total``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (
+    Finding,
+    ModuleInfo,
+    dotted_name,
+    header_exprs,
+    iter_scope_stmts,
+)
+
+_JIT_NAMES = {"jax.jit", "jax.pmap"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+_STATIC_KWARGS = ("static_argnames", "static_argnums",
+                  "static_broadcasted_argnums")
+_SHAPE_ATTRS = ("shape", "ndim", "dtype", "size")
+_MATERIALIZERS = {"float", "int", "bool"}
+_NP_MATERIALIZERS = {"numpy.asarray", "numpy.array"}
+
+
+def _is_jit_callable(node: ast.AST, imports: Dict[str, str]) -> bool:
+    dotted = dotted_name(node, imports)
+    if dotted is None:
+        return False
+    return dotted in _JIT_NAMES or dotted == "shard_map" \
+        or dotted.endswith(".shard_map")
+
+
+def _static_values(call: ast.Call) -> List[ast.expr]:
+    out = []
+    for kw in call.keywords:
+        if kw.arg in _STATIC_KWARGS:
+            out.append(kw.value)
+    return out
+
+
+def _const_strs_ints(node: ast.expr) -> Tuple[Set[str], Set[int]]:
+    names: Set[str] = set()
+    nums: Set[int] = set()
+    elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    for e in elts:
+        if isinstance(e, ast.Constant):
+            if isinstance(e.value, str):
+                names.add(e.value)
+            elif isinstance(e.value, int):
+                nums.add(e.value)
+    return names, nums
+
+
+def _jit_wrap(node: ast.AST, imports: Dict[str, str]
+              ) -> Optional[Tuple[Set[str], Set[int], bool]]:
+    """If ``node`` (a decorator expression or a call-site func) denotes a
+    jit-family wrapper, return (static_names, static_nums, has_statics)."""
+    if _is_jit_callable(node, imports):
+        return set(), set(), False
+    if isinstance(node, ast.Call):
+        fn_dotted = dotted_name(node.func, imports)
+        # functools.partial(jax.jit, static_argnames=...)
+        if fn_dotted in _PARTIAL_NAMES and node.args \
+                and _is_jit_callable(node.args[0], imports):
+            pass
+        # jax.jit(..., static_argnums=...) used as decorator factory, or
+        # @partial(shard_map, mesh=...)
+        elif _is_jit_callable(node.func, imports):
+            pass
+        else:
+            return None
+        names: Set[str] = set()
+        nums: Set[int] = set()
+        for v in _static_values(node):
+            n, i = _const_strs_ints(v)
+            names |= n
+            nums |= i
+        return names, nums, bool(names or nums)
+    return None
+
+
+def _params(fn: ast.AST) -> List[str]:
+    if isinstance(fn, ast.Lambda):
+        a = fn.args
+    elif isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        a = fn.args
+    else:
+        return []
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _traced_params(fn: ast.AST, static_names: Set[str],
+                   static_nums: Set[int]) -> Set[str]:
+    params = _params(fn)
+    traced = set(params) - static_names
+    for i in static_nums:
+        if 0 <= i < len(params):
+            traced.discard(params[i])
+    return traced
+
+
+def _refs_traced(node: ast.AST, traced: Set[str]) -> bool:
+    """True if the expression reads a traced name OUTSIDE shape-like
+    attribute access (``x.shape`` is static under tracing)."""
+    if isinstance(node, ast.Attribute) and node.attr in _SHAPE_ATTRS:
+        return False
+    if isinstance(node, ast.Name):
+        return node.id in traced
+    return any(_refs_traced(c, traced) for c in ast.iter_child_nodes(node))
+
+
+def _is_noneness_test(test: ast.expr) -> bool:
+    if isinstance(test, ast.Compare):
+        return all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops)
+    if isinstance(test, ast.BoolOp):
+        return all(_is_noneness_test(v) for v in test.values)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _is_noneness_test(test.operand)
+    return False
+
+
+class _Scanner:
+    """One pass over a module: collects traced regions (with qualnames),
+    jit-bound local names, and then scans each region's body."""
+
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self.findings: List[Finding] = []
+        # name bound via `x = jax.jit(f)` with no statics -> binding line
+        self.jit_bound_no_statics: Dict[str, int] = {}
+        self.jit_bound_static: Set[str] = set()
+        # [(fn node, traced param names, qualname)]
+        self.regions: List[Tuple[ast.AST, Set[str], str]] = []
+        self._region_nodes: Set[int] = set()
+
+    # -- region discovery ---------------------------------------------------
+    def collect(self) -> None:
+        self._walk_scope(self.mod.tree.body, [], {})
+        for node, traced, qual in self.regions:
+            body = node.body if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)) else [node.body]
+            for stmt in body:
+                self._scan(stmt, traced, qual)
+
+    def _walk_scope(self, stmts, stack: List[str],
+                    local_defs: Dict[str, ast.AST]) -> None:
+        # Flatten compound statements (if/try/with/for bodies share the
+        # enclosing scope) and index the scope's function defs first, so
+        # `jax.jit(name)` resolves forward or backward references.
+        flat = list(iter_scope_stmts(stmts))
+        for s in flat:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local_defs[s.name] = s
+        for s in flat:
+            self._visit_stmt(s, stack, local_defs)
+
+    def _visit_stmt(self, node: ast.stmt, stack: List[str],
+                    local_defs: Dict[str, ast.AST]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                wrap = _jit_wrap(dec, self.mod.imports)
+                if wrap is not None:
+                    names, nums, _ = wrap
+                    self._add_region(node, _traced_params(node, names, nums),
+                                     stack + [node.name])
+                    break
+            self._walk_scope(node.body, stack + [node.name], dict(local_defs))
+            return
+        if isinstance(node, ast.ClassDef):
+            self._walk_scope(node.body, stack + [node.name], dict(local_defs))
+            return
+        # Header expressions only: nested statement bodies are visited by
+        # the flattened scope walk itself.
+        for header in header_exprs(node):
+            for expr in ast.walk(header):
+                if isinstance(expr, ast.Call):
+                    self._visit_call(expr, node, stack, local_defs)
+
+    def _visit_call(self, call: ast.Call, stmt: ast.stmt, stack: List[str],
+                    local_defs: Dict[str, ast.AST]) -> None:
+        wrap = _jit_wrap(call.func, self.mod.imports)
+        if wrap is None:
+            return
+        names, nums, has_statics = wrap
+        # Statics may ride on the wrapping call itself: jax.jit(f, static_argnums=(1,))
+        for v in _static_values(call):
+            n, i = _const_strs_ints(v)
+            names |= n
+            nums |= i
+        has_statics = has_statics or bool(names or nums)
+        if not call.args:
+            return
+        target = call.args[0]
+        region: Optional[ast.AST] = None
+        region_name = "<lambda>"
+        if isinstance(target, ast.Lambda):
+            region = target
+        elif isinstance(target, ast.Name) and target.id in local_defs:
+            region = local_defs[target.id]
+            region_name = target.id
+        if region is not None:
+            self._add_region(region, _traced_params(region, names, nums),
+                             stack + [region_name])
+        # Record the bound name for TRC005 call-site checking.
+        if isinstance(stmt, ast.Assign) and stmt.value is call:
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    # Latest binding wins: drop the name from the other
+                    # collection so a rebinding that adds (or removes)
+                    # statics governs its call sites.
+                    if has_statics:
+                        self.jit_bound_static.add(t.id)
+                        self.jit_bound_no_statics.pop(t.id, None)
+                    else:
+                        self.jit_bound_no_statics[t.id] = call.lineno
+                        self.jit_bound_static.discard(t.id)
+
+    def _add_region(self, node: ast.AST, traced: Set[str],
+                    qual: List[str]) -> None:
+        if id(node) in self._region_nodes:
+            return
+        self._region_nodes.add(id(node))
+        self.regions.append((node, traced, ".".join(qual)))
+
+    # -- in-region scanning --------------------------------------------------
+    def _emit(self, node: ast.AST, code: str, message: str,
+              qual: str) -> None:
+        self.findings.append(Finding(
+            path=self.mod.path, line=getattr(node, "lineno", 1), code=code,
+            message=message, context=qual))
+
+    def _scan(self, node: ast.AST, traced: Set[str], qual: str) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # Nested defs run when called from the traced region: scan
+            # them as part of it (shadowed params accepted as-is).
+            inner_qual = qual + "." + getattr(node, "name", "<lambda>")
+            body = node.body if isinstance(node.body, list) else [node.body]
+            for c in body:
+                self._scan(c, traced, inner_qual)
+            return
+        if isinstance(node, (ast.If, ast.While)) or \
+                isinstance(node, ast.IfExp):
+            test = node.test
+            if _refs_traced(test, traced) and not _is_noneness_test(test):
+                self._emit(test, "TRC004",
+                           "Python branch on traced value "
+                           f"({ast.unparse(test)!s:.60})", qual)
+        if isinstance(node, ast.Call):
+            self._scan_call(node, traced, qual)
+        for child in ast.iter_child_nodes(node):
+            self._scan(child, traced, qual)
+
+    def _scan_call(self, call: ast.Call, traced: Set[str],
+                   qual: str) -> None:
+        dotted = dotted_name(call.func, self.mod.imports)
+        if dotted in ("print", "builtins.print"):
+            self._emit(call, "TRC001", "print() inside a traced region",
+                       qual)
+            return
+        if dotted is not None and (dotted.startswith("time.")):
+            self._emit(call, "TRC002",
+                       f"host clock {dotted}() inside a traced region",
+                       qual)
+            return
+        args_ref_traced = any(_refs_traced(a, traced) for a in call.args)
+        if dotted in _MATERIALIZERS and args_ref_traced:
+            self._emit(call, "TRC003",
+                       f"{dotted}() materializes a traced value", qual)
+            return
+        if dotted in _NP_MATERIALIZERS and args_ref_traced:
+            self._emit(call, "TRC003",
+                       f"{dotted}() pulls a traced value to host", qual)
+            return
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in ("item", "tolist") \
+                and _refs_traced(call.func.value, traced):
+            self._emit(call, "TRC003",
+                       f".{call.func.attr}() materializes a traced value",
+                       qual)
+
+    # -- TRC005 ---------------------------------------------------------------
+    def scan_call_sites(self) -> None:
+        if not self.jit_bound_no_statics:
+            return
+        for node in ast.walk(self.mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (isinstance(node.func, ast.Name)
+                    and node.func.id in self.jit_bound_no_statics):
+                continue
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(a, ast.Constant) \
+                        and isinstance(a.value, (bool, int, float)):
+                    self._emit(
+                        node, "TRC005",
+                        f"raw Python scalar {a.value!r} passed to "
+                        f"jit-wrapped {node.func.id!r} (no static_argnums "
+                        "declared)", node.func.id)
+                    break
+
+
+def check(mod: ModuleInfo) -> List[Finding]:
+    s = _Scanner(mod)
+    s.collect()
+    s.scan_call_sites()
+    return s.findings
