@@ -477,6 +477,11 @@ class StatusMessage:
             "tasks_success": self.tasks_success,
             "tasks_error": self.tasks_error,
             "timestamp": _opt_time(self.timestamp),
+            # Canonical key matches the field name; "uptime" stays as a
+            # compat alias so decoders from before the rename still parse
+            # (the asymmetry used to drop uptime on any path that decoded
+            # with the field name).
+            "uptime_s": self.uptime_s,
             "uptime": self.uptime_s,
             "trace_id": self.trace_id,
         }
@@ -495,7 +500,8 @@ class StatusMessage:
             tasks_success=int(d.get("tasks_success") or 0),
             tasks_error=int(d.get("tasks_error") or 0),
             timestamp=parse_time(d.get("timestamp")),
-            uptime_s=float(d.get("uptime") or 0.0),
+            # Accept both the canonical key and the legacy alias.
+            uptime_s=float(d.get("uptime_s", d.get("uptime")) or 0.0),
             trace_id=d.get("trace_id", "") or "",
         )
 

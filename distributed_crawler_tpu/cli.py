@@ -142,6 +142,17 @@ def build_parser() -> argparse.ArgumentParser:
     a("--slow-trace-ms", type=float, default=None,
       help="log any span slower than this many milliseconds "
            "(0 = off, the default)")
+    a("--dump-dir", default=None,
+      help="write postmortem bundles (flight ring + traces + metrics + "
+           "config fingerprint) here on SIGTERM, unhandled exception, or "
+           "fatal signal; empty (default) = no dumps")
+    a("--flight-buffer", type=int, default=None,
+      help="flight-recorder events kept in memory for postmortem bundles "
+           "(0 disables recording; default 512)")
+    a("--telemetry-interval", type=float, default=None,
+      help="seconds between telemetry-rich heartbeats in the worker "
+           "modes (default 30; clamped to 90 so heartbeats always beat "
+           "the orchestrator's 300 s liveness timeout)")
     # TPU inference stage
     a("--bus-serve", action="store_const", const=True, default=None,
       help="also HOST the gRPC bus broker at --bus-address (tpu-worker "
@@ -342,6 +353,9 @@ _KEY_MAP = {
     "profiler_port": "observability.profiler_port",
     "trace_buffer": "observability.trace_buffer",
     "slow_trace_ms": "observability.slow_trace_ms",
+    "dump_dir": "observability.dump_dir",
+    "flight_buffer": "observability.flight_buffer",
+    "telemetry_interval": "observability.telemetry_interval_s",
     "infer": "inference.enabled",
     "infer_model": "inference.model",
     "infer_backpressure_high": "distributed.inference_backpressure_high",
@@ -552,6 +566,21 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
         slow_span_s=r.get_float("observability.slow_trace_ms", 0.0) / 1000.0)
 
     mode = r.get_str("distributed.mode", "")
+    # Flight recorder: ring size + config fingerprint always; the crash
+    # hooks (excepthooks + faulthandler) arm only when a dump dir is
+    # configured — without one a dump is a no-op and nothing is hooked.
+    from .utils import flight as _flight
+
+    _flight.configure(
+        capacity=r.get_int("observability.flight_buffer", 512),
+        fingerprint={"mode": mode or "standalone",
+                     "worker_id": r.get_str("distributed.worker_id"),
+                     "platform": cfg.platform,
+                     "crawl_id": cfg.crawl_id,
+                     "bus_address": r.get_str("distributed.bus_address")})
+    dump_dir = r.get_str("observability.dump_dir", "")
+    if dump_dir:
+        _flight.install(dump_dir)
     # Observability servers for every mode (`main.go:60-80` ran pprof
     # unconditionally) — EXCEPT tpu-worker, where TPUWorker.start() owns
     # both (binding here too would EADDRINUSE its startup).
@@ -692,6 +721,22 @@ def _maybe_bridge(sm, cfg: CrawlerConfig, r: ConfigResolver):
     return bridge, closer
 
 
+def _heartbeat_interval(r: "ConfigResolver") -> float:
+    """The telemetry-heartbeat period, clamped so it can never exceed a
+    third of the orchestrator's default liveness timeout (300 s): the
+    heartbeat doubles as the liveness signal, and a period above the
+    timeout would make `check_worker_health` flap healthy workers
+    offline and re-queue their in-flight work forever."""
+    interval = r.get_float("observability.telemetry_interval_s", 30.0)
+    clamped = min(max(interval, 1.0), 90.0)
+    if clamped != interval:
+        logger.warning(
+            "telemetry interval %.0fs clamped to %.0fs (heartbeats are "
+            "the liveness signal; the orchestrator offlines workers "
+            "silent past worker_timeout_s)", interval, clamped)
+    return clamped
+
+
 class CliConfigError(ValueError):
     """A user-fixable configuration error raised by a mode runner; main()
     reports it as `error: …` (exit 2) instead of a traceback.  Keep this
@@ -706,11 +751,17 @@ def _serve_forever(poll_s: float = 1.0,
 
     SIGTERM is mapped to KeyboardInterrupt for the duration, so a
     supervisor's stop (docker stop, kubelet) takes the same graceful
-    close/drain path as ^C instead of killing mid-write."""
+    close/drain path as ^C instead of killing mid-write; when a
+    ``--dump-dir`` is configured the flight recorder writes its
+    postmortem bundle FIRST (the graceful teardown may hang — the black
+    box must already be on disk)."""
     import signal as _signal
     import time as _time
 
+    from .utils import flight as _flight
+
     def _term(_sig, _frm):
+        _flight.dump("sigterm")  # no-op without a configured dump dir
         raise KeyboardInterrupt
 
     prev = None
@@ -905,8 +956,9 @@ def _run_orchestrator(urls: List[str], cfg: CrawlerConfig,
         inference_backpressure_low=r.get_int(
             "distributed.inference_backpressure_low", 32))
     orch = Orchestrator(cfg.crawl_id, cfg, bus, sm, ocfg=ocfg)
-    from .utils.metrics import set_status_provider
+    from .utils.metrics import set_cluster_provider, set_status_provider
     set_status_provider(orch.get_status)  # /status (`orchestrator.go:596`)
+    set_cluster_provider(orch.get_cluster)  # /cluster fleet view
     orch.start(urls)
     try:
         _serve_forever(
@@ -945,7 +997,11 @@ def _run_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
     else:
         from .crawl import setup_pool_from_config
         setup_pool_from_config(cfg)  # `worker.go:96-133` pool init
+    from .worker.worker import WorkerConfig
     worker = CrawlWorker(worker_id, cfg, bus, sm,
+                         wcfg=WorkerConfig(
+                             worker_id=worker_id,
+                             heartbeat_s=_heartbeat_interval(r)),
                          youtube_crawler=youtube_crawler)
     from .utils.metrics import set_status_provider
     set_status_provider(worker.get_status)  # /status (`worker.go:459`)
@@ -1459,6 +1515,9 @@ def _build_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver):
         bus = _make_bus(r)
     return TPUWorker(bus, engine, provider=provider,
                      cfg=TPUWorkerConfig(
+                         worker_id=r.get_str("distributed.worker_id")
+                         or "tpu-worker-0",
+                         heartbeat_s=_heartbeat_interval(r),
                          metrics_port=r.get_int(
                              "observability.metrics_port", 0),
                          profiler_port=r.get_int(

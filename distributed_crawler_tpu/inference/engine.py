@@ -311,6 +311,40 @@ class InferenceEngine:
             self._packed_steps[bucket] = fn
         return fn
 
+    def compile_cache_stats(self) -> Dict[str, Any]:
+        """Compile-cache state for telemetry heartbeats
+        (`utils/telemetry.py`): which (bucket, path) programs exist and the
+        cumulative first-dispatch miss count.  The emitter turns
+        ``misses_total`` into per-heartbeat deltas — steady-state serving
+        should report delta 0; anything else means live batches paid XLA
+        compiles."""
+        misses: Dict[str, float] = {}
+        total = 0.0
+        for labels, value in self.m_compile_miss.series():
+            if not labels:
+                continue  # unlabeled parent: never incremented
+            misses[f"{labels.get('path', '?')}:"
+                   f"{labels.get('bucket', '?')}"] = value
+            total += value
+
+        def keys(d: Dict[int, Any]) -> list:
+            # The heartbeat thread reads while the feed thread inserts a
+            # freshly-compiled bucket; retry the rare mid-insert snapshot
+            # instead of degrading the whole telemetry beat.
+            for _ in range(3):
+                try:
+                    return sorted(d)
+                except RuntimeError:
+                    continue
+            return []
+
+        return {
+            "programs_unpacked": keys(self._steps),
+            "programs_packed": keys(self._packed_steps),
+            "misses_total": total,
+            "misses": misses,
+        }
+
     def _place(self, ids: np.ndarray, mask: np.ndarray, *extra: np.ndarray):
         import jax.numpy as jnp
 

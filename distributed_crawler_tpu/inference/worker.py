@@ -32,7 +32,7 @@ from ..bus.messages import (
     WORKER_BUSY,
     WORKER_IDLE,
 )
-from ..utils import trace
+from ..utils import flight, trace
 from ..utils.metrics import (
     REGISTRY,
     MetricsRegistry,
@@ -40,6 +40,7 @@ from ..utils.metrics import (
     serve_metrics,
     set_status_provider,
 )
+from ..utils.telemetry import TelemetryEmitter
 from .engine import InferenceEngine
 
 logger = logging.getLogger(__name__)
@@ -146,6 +147,11 @@ class TPUWorker:
         self.m_outcomes = registry.counter(
             "tpu_worker_batch_outcomes_total",
             "record batches by final commit outcome")
+        # Telemetry-rich heartbeats: device memory, compile-cache deltas,
+        # batch outcomes, per-stage latency digest — the fleet-view feed.
+        self._telemetry = TelemetryEmitter(
+            engine=engine, include_device=True,
+            counters={"batch_outcomes": self.m_outcomes})
         # Capability probes, not flags: test doubles and older engines that
         # predate pack/coalescing keep working through the one-batch path.
         self._engine_coalesces = (
@@ -271,6 +277,8 @@ class TPUWorker:
             self._finish_one()
             if ack is not None:
                 self.m_outcomes.labels(outcome="requeued").inc()
+                flight.record("batch", batch=batch.batch_id,
+                              outcome="requeued", reason="queue_full")
                 ack(False)  # requeue server-side; don't block the stream
                 return
             raise
@@ -388,10 +396,14 @@ class TPUWorker:
                 self._commit(batch, results)
             self._processed += 1
             self.m_outcomes.labels(outcome="ok").inc()
+            flight.record("batch", batch=batch.batch_id, outcome="ok",
+                          records=len(batch.records))
             self._ack(batch, ack, True)
         except Exception as e:
             self._errors += 1
             self.m_outcomes.labels(outcome="error").inc()
+            flight.record("batch", batch=batch.batch_id, outcome="error",
+                          error=str(e))
             logger.exception("batch %s failed: %s", batch.batch_id, e)
             self._ack(batch, ack, False)
 
@@ -528,6 +540,9 @@ class TPUWorker:
                         and not self._stall_warned):
                     self._stall_warned = True
                     self.m_stalls.inc()
+                    flight.record("device_stall",
+                                  worker=self.cfg.worker_id,
+                                  age_s=round(age, 1))
                     logger.warning(
                         "device step stalled %.0fs (warn threshold %.0fs); "
                         "chip wedged or compile outsized stall_warn_s",
@@ -541,6 +556,10 @@ class TPUWorker:
                         "(un-acked frames requeue; writeback is idempotent)",
                         age, self.cfg.stall_exit_s,
                         extra={"worker_id": self.cfg.worker_id})
+                    # The black-box moment: os._exit skips atexit AND
+                    # excepthooks, so the bundle must be written here.
+                    flight.dump("stall_exit",
+                                error=f"device step stalled {age:.0f}s")
                     import os as _os
 
                     (self._exit_fn or _os._exit)(17)
@@ -559,6 +578,7 @@ class TPUWorker:
                 uptime_s=time.monotonic() - self._started_at,
                 worker_type="tpu")
             msg.queue_length = self._queue.qsize()
+            msg.resource_usage = self._telemetry.snapshot()
             try:
                 self.bus.publish(TOPIC_WORKER_STATUS, msg.to_dict())
             except Exception as e:  # bus outage must not kill the worker

@@ -1,5 +1,7 @@
 """Central coordinator for distributed crawls (reference `orchestrator/`)."""
 
+from .fleet import FleetView, WorkerTrack
 from .orchestrator import Orchestrator, OrchestratorConfig, WorkerInfo
 
-__all__ = ["Orchestrator", "OrchestratorConfig", "WorkerInfo"]
+__all__ = ["FleetView", "Orchestrator", "OrchestratorConfig", "WorkerInfo",
+           "WorkerTrack"]

@@ -41,8 +41,9 @@ from ..bus.messages import (
     WorkQueueMessage,
     WorkResult,
 )
+from .fleet import FleetView
 from ..config.crawler import CrawlerConfig
-from ..utils import trace
+from ..utils import flight, trace
 from ..state.datamodels import (
     PAGE_ERROR,
     PAGE_FETCHED,
@@ -113,6 +114,9 @@ class Orchestrator:
         self.crawl_completed = False
         self._retry_counts: Dict[str, int] = {}  # page id -> retries
         self._backpressure_active = False
+        # Telemetry-rich per-worker fold behind /cluster; its staleness
+        # rule tracks the same timeout check_worker_health enforces.
+        self.fleet = FleetView(stale_after_s=self.ocfg.worker_timeout_s)
 
         self._mu = threading.RLock()
         self._running = False
@@ -173,6 +177,7 @@ class Orchestrator:
 
     def _health_tick(self) -> None:
         self.check_worker_health()
+        self.fleet.refresh_staleness()  # keep the gauge live for /metrics
         self.requeue_stale_work()
         self.log_progress()
 
@@ -283,6 +288,8 @@ class Orchestrator:
                                          item, PRIORITY_MEDIUM,
                                          self.ocfg.work_ttl_s))
                 published += 1
+                flight.record("dispatch", work_item=item.id, url=item.url,
+                              depth=item.depth)
             except Exception as e:
                 # Revert on publish failure (`orchestrator.go:255-268`).
                 logger.error("failed to publish work item", extra={
@@ -338,6 +345,9 @@ class Orchestrator:
             logger.warning("result for unknown work item", extra={
                 "work_item_id": result.work_item_id})
             return
+        flight.record("result", work_item=result.work_item_id,
+                      status=result.status, worker=result.worker_id,
+                      error=result.error or None)
         with trace.span("orchestrator.handle_result",
                         trace_id=item.trace_id or message.trace_id,
                         work_item=result.work_item_id, status=result.status,
@@ -396,6 +406,7 @@ class Orchestrator:
         self.handle_status(StatusMessage.from_dict(payload))
 
     def handle_status(self, message: StatusMessage) -> None:
+        self.fleet.observe(message)
         with self._mu:
             worker = self.workers.get(message.worker_id)
             if worker is None:
@@ -435,6 +446,8 @@ class Orchestrator:
                         "last_seen": str(worker.last_seen)})
                     worker.status = WORKER_OFFLINE
                     failed.append(worker_id)
+                    flight.record("worker_offline", worker=worker_id,
+                                  silence_s=round(silence, 1))
         if failed:
             self.reassign_work_from_failed_workers(failed)
         return failed
@@ -493,6 +506,8 @@ class Orchestrator:
                                          fresh, PRIORITY_HIGH,
                                          self.ocfg.work_ttl_s))
                 requeued += 1
+                flight.record("requeue", work_item=fresh.id,
+                              retry=fresh.retry_count)
                 logger.warning("requeued stale work item", extra={
                     "work_item_id": fresh.id,
                     "retry_count": fresh.retry_count})
@@ -527,6 +542,8 @@ class Orchestrator:
                                          fresh, PRIORITY_HIGH,
                                          self.ocfg.work_ttl_s))
                 reassigned += 1
+                flight.record("reassign", work_item=fresh.id,
+                              retry=fresh.retry_count)
                 logger.info("reassigned work item from failed worker", extra={
                     "work_item_id": fresh.id, "retry_count": fresh.retry_count})
             except Exception as e:
@@ -552,6 +569,9 @@ class Orchestrator:
         except Exception as e:
             logger.error("failed to update crawl completion metadata",
                          extra={"error": str(e)})
+        flight.record("crawl_completed", crawl_id=self.crawl_id,
+                      completed=self.completed_items,
+                      errors=self.error_items)
         logger.info("crawl marked as completed", extra={"stats": metadata})
 
     def log_progress(self) -> None:
@@ -599,3 +619,22 @@ class Orchestrator:
                 "uptime_s": self.clock() - self._started_at,
                 "crawl_completed": self.crawl_completed,
             }
+
+    def get_cluster(self) -> Dict[str, Any]:
+        """The ``/cluster`` JSON body: the FleetView's per-worker fold
+        (telemetry, rates, history, staleness) plus the orchestrator-side
+        work summary — one page answering "what is the fleet doing".
+        Registered via `utils.metrics.set_cluster_provider` by the CLI."""
+        out = self.fleet.export()
+        with self._mu:
+            out["orchestrator"] = {
+                "crawl_id": self.crawl_id,
+                "is_running": self._running,
+                "current_depth": self.current_depth,
+                "active_work": len(self.active_work),
+                "completed_items": self.completed_items,
+                "error_items": self.error_items,
+                "backpressure_active": self._backpressure_active,
+                "uptime_s": self.clock() - self._started_at,
+            }
+        return out

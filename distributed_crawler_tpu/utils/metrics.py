@@ -79,6 +79,24 @@ class _LabeledMixin:
         with self._lock:
             return [c for _, c in sorted(self._children.items())]
 
+    def series(self) -> list:
+        """[(labels_dict, value)] for the parent and every labeled child —
+        the programmatic read the telemetry snapshots use (exposition is
+        for scrapers; this is for heartbeats).  Value-bearing metrics only
+        (Counter/Gauge)."""
+        out = []
+        for m in [self] + self._child_snapshot():
+            with m._lock:
+                out.append((dict(m._label_items), m._value))
+        return out
+
+    def remove_labels(self, **kv: object) -> None:
+        """Drop the child for this exact label set (no-op if absent) —
+        eviction support so per-worker series don't accumulate forever
+        as workers come and go."""
+        with self._lock:
+            self._children.pop(_label_key(kv), None)
+
 
 class Counter(_LabeledMixin):
     def __init__(self, name: str, help_: str = ""):
@@ -275,6 +293,26 @@ def clear_status_provider(fn) -> None:
         _status_provider = None
 
 
+# Late-bound /cluster provider: same seam as /status, but for the
+# orchestrator's fleet view (`orchestrator/fleet.py`) — one JSON map of
+# every worker's last heartbeat, telemetry, rates, and staleness.
+_cluster_provider = None
+
+
+def set_cluster_provider(fn) -> None:
+    """Register the zero-arg dict provider served at /cluster (pass None
+    to clear)."""
+    global _cluster_provider
+    _cluster_provider = fn
+
+
+def clear_cluster_provider(fn) -> None:
+    """Unregister ``fn`` only if it is still the active provider."""
+    global _cluster_provider
+    if _cluster_provider == fn:
+        _cluster_provider = None
+
+
 class _Handler(BaseHTTPRequestHandler):
     registry: MetricsRegistry = REGISTRY
 
@@ -315,6 +353,19 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception as e:
                 # Visible to status-code monitors, one response per
                 # request (no retry loop server-side).
+                code = 500
+                body = _json.dumps({"error": str(e)}).encode("utf-8")
+            ctype = "application/json"
+        elif path == "/cluster" and _cluster_provider is not None:
+            # The orchestrator's fleet view: per-worker last-seen, status
+            # history, heartbeat telemetry, task rates, staleness rollup
+            # (`orchestrator/fleet.py`; rendered by tools/postmortem.py).
+            import json as _json
+
+            try:
+                body = _json.dumps(_cluster_provider(),
+                                   default=str).encode("utf-8")
+            except Exception as e:
                 code = 500
                 body = _json.dumps({"error": str(e)}).encode("utf-8")
             ctype = "application/json"

@@ -13,7 +13,23 @@ import sys
 import time
 from typing import Any, Dict
 
+from . import trace as _trace
+
 _RESERVED = set(logging.LogRecord("", 0, "", 0, "", (), None).__dict__) | {"message", "asctime"}
+
+
+def _trace_fields() -> Dict[str, str]:
+    """trace_id/span of the innermost open span on this thread, if any —
+    log lines emitted inside a span join the /traces timeline without
+    callers threading ids by hand.  Explicit extras win (setdefault)."""
+    tid = _trace.current_trace_id()
+    if not tid:
+        return {}
+    out = {"trace_id": tid}
+    name = _trace.current_span_name()
+    if name:
+        out["span"] = name
+    return out
 
 
 class JsonFormatter(logging.Formatter):
@@ -29,6 +45,8 @@ class JsonFormatter(logging.Formatter):
         for k, v in record.__dict__.items():
             if k not in _RESERVED and not k.startswith("_"):
                 out[k] = v
+        for k, v in _trace_fields().items():
+            out.setdefault(k, v)
         if record.exc_info and record.exc_info[0] is not None:
             out["error"] = self.formatException(record.exc_info)
         return json.dumps(out, ensure_ascii=False, default=str)
@@ -38,10 +56,11 @@ class ConsoleFormatter(logging.Formatter):
     """Human console writer with inline key=value extras."""
 
     def format(self, record: logging.LogRecord) -> str:
-        extras = " ".join(
-            f"{k}={v}" for k, v in record.__dict__.items()
-            if k not in _RESERVED and not k.startswith("_")
-        )
+        fields = {k: v for k, v in record.__dict__.items()
+                  if k not in _RESERVED and not k.startswith("_")}
+        for k, v in _trace_fields().items():
+            fields.setdefault(k, v)
+        extras = " ".join(f"{k}={v}" for k, v in fields.items())
         base = f"{self.formatTime(record, '%H:%M:%S')} {record.levelname:<5} {record.name}: {record.getMessage()}"
         return f"{base} {extras}" if extras else base
 

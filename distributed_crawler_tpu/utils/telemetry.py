@@ -1,0 +1,154 @@
+"""Telemetry snapshots: what a heartbeat carries beyond "alive".
+
+`StatusMessage.resource_usage` has existed since the first heartbeat but
+was minted empty everywhere (the reference never filled it either), so the
+only fleet-wide questions the orchestrator could answer were "alive?" and
+"queue length?".  This module is the fill: a cheap, never-raising snapshot
+of the process and device state that matters at TPU-serving scale —
+
+- process RSS (``/proc/self/statm``; peak-RSS fallback off Linux),
+- JAX per-device memory stats (``device.memory_stats()``, guarded: the CPU
+  backend returns None/raises, and jax is only queried when the process
+  already imported it — a crawl worker never pays the import),
+- compile-cache activity deltas (engine ``compile_cache_stats()``): a
+  nonzero delta between heartbeats means live batches paid XLA compiles,
+- labeled-counter counts (e.g. batch outcomes by ok/error/requeued),
+- a per-stage latency digest (p50/p95/max per span name) over the spans
+  completed since the previous snapshot, computed from the PR-2 trace ring.
+
+The snapshot is a plain nested dict of JSON-safe scalars, so it round-trips
+through both bus transports unchanged and lands in the orchestrator's
+FleetView (`orchestrator/fleet.py`) / the `/cluster` endpoint verbatim.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from . import trace as _trace
+
+logger = logging.getLogger("dct.telemetry")
+
+_PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def process_rss_bytes() -> int:
+    """Resident set size of this process; 0 when unknowable."""
+    try:
+        with open("/proc/self/statm", "r", encoding="ascii") as f:
+            return int(f.read().split()[1]) * _PAGE_SIZE
+    except (OSError, ValueError, IndexError):
+        pass
+    try:  # macOS/BSD fallback: peak RSS (bytes on mac, KiB elsewhere)
+        import resource
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return peak if sys.platform == "darwin" else peak * 1024
+    except (ImportError, OSError, AttributeError, ValueError):
+        return 0
+
+
+def device_memory_stats() -> List[Dict[str, Any]]:
+    """Per-device memory stats from an ALREADY-IMPORTED jax; [] otherwise.
+
+    Importing jax here would make every crawl worker's heartbeat pay the
+    multi-second import, so only processes that already run device code
+    (the TPU worker imported jax long before the first heartbeat) report
+    device memory.  The CPU backend's ``memory_stats()`` returns None (or
+    the attribute is missing entirely) — both degrade to [].
+    """
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return []
+    out: List[Dict[str, Any]] = []
+    try:
+        for dev in jax.devices():
+            stats_fn = getattr(dev, "memory_stats", None)
+            stats = stats_fn() if callable(stats_fn) else None
+            if not stats:
+                continue
+            out.append({
+                "device": f"{dev.platform}:{dev.id}",
+                "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                "bytes_limit": int(stats.get("bytes_limit", 0)),
+                "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0)),
+            })
+    except Exception as e:  # backends without stats must not break beats
+        logger.debug("device memory stats unavailable: %s", e)
+        return []
+    return out
+
+
+class TelemetryEmitter:
+    """Stateful snapshot source: one per heartbeat loop.
+
+    Statefulness is what turns cumulative counters into the *deltas* the
+    fleet view wants ("did compiles happen since the last heartbeat?"),
+    and bounds the latency digest to spans completed since the previous
+    snapshot instead of re-digesting the whole ring forever.
+    """
+
+    def __init__(self, engine=None, counters: Optional[Dict[str, Any]] = None,
+                 include_device: bool = False, tracer=None):
+        """``engine`` is anything with ``compile_cache_stats()``;
+        ``counters`` maps a telemetry key to a labeled
+        `utils.metrics.Counter` whose per-label values are reported (e.g.
+        ``{"batch_outcomes": worker.m_outcomes}``)."""
+        self.engine = engine
+        self.counters = dict(counters or {})
+        self.include_device = include_device
+        self.tracer = tracer or _trace.TRACER
+        self._lock = threading.Lock()
+        self._last_wall = 0.0
+        self._last_compile_misses: Optional[float] = None
+
+    def snapshot(self) -> Dict[str, Any]:
+        """One heartbeat's worth of telemetry; never raises."""
+        try:
+            return self._snapshot()
+        except Exception as e:  # telemetry must never break a heartbeat
+            logger.debug("telemetry snapshot degraded: %s", e)
+            return {"rss_bytes": process_rss_bytes()}
+
+    def _snapshot(self) -> Dict[str, Any]:
+        now = time.time()
+        with self._lock:
+            since, self._last_wall = self._last_wall, now
+        out: Dict[str, Any] = {
+            "rss_bytes": process_rss_bytes(),
+            "py_threads": threading.active_count(),
+        }
+        if self.include_device:
+            mem = device_memory_stats()
+            if mem:
+                out["device_memory"] = mem
+        if self.engine is not None:
+            stats_fn = getattr(self.engine, "compile_cache_stats", None)
+            if callable(stats_fn):
+                stats = dict(stats_fn())
+                misses = float(stats.get("misses_total", 0.0))
+                with self._lock:
+                    prev = self._last_compile_misses
+                    self._last_compile_misses = misses
+                stats["misses_delta"] = \
+                    misses - prev if prev is not None else misses
+                out["compile_cache"] = stats
+        for key, counter in self.counters.items():
+            series = getattr(counter, "series", None)
+            if not callable(series):
+                continue
+            values: Dict[str, float] = {}
+            for labels, value in series():
+                if not labels:
+                    continue  # the unlabeled parent is the redundant total
+                values["|".join(str(v) for v in labels.values())] = value
+            out[key] = values
+        digest = _trace.latency_digest(self.tracer.spans(), since_wall=since)
+        if digest:
+            out["latency_ms"] = digest
+        return out

@@ -31,6 +31,7 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import logging
+import math
 import secrets
 import threading
 import time
@@ -42,7 +43,10 @@ logger = logging.getLogger("dct.trace")
 
 DEFAULT_CAPACITY = 2048  # completed spans kept for /traces
 
-# (trace_id, span_id) of the innermost open span on this thread/task.
+# (trace_id, span_id, span_name) of the innermost open span on this
+# thread/task.  Only the first two participate in propagation; the name
+# rides along so log formatters (`utils/structlog.py`) can stamp records
+# with the stage they were emitted from.
 _CTX: contextvars.ContextVar = contextvars.ContextVar(
     "dct_trace_ctx", default=None)
 
@@ -149,7 +153,7 @@ class Tracer:
                 else ""
         span_id = _new_span_id()
         handle = _OpenSpan(name, trace_id, span_id, parent_id, dict(attrs))
-        token = _CTX.set((trace_id, span_id))
+        token = _CTX.set((trace_id, span_id, name))
         start_wall = time.time()
         t0 = time.perf_counter()
         try:
@@ -248,6 +252,41 @@ def current_trace_id() -> str:
 def current_span_id() -> str:
     ctx = _CTX.get()
     return ctx[1] if ctx else ""
+
+
+def current_span_name() -> str:
+    ctx = _CTX.get()
+    return ctx[2] if ctx and len(ctx) > 2 else ""
+
+
+def latency_digest(spans: List[Span],
+                   since_wall: float = 0.0) -> Dict[str, Dict[str, float]]:
+    """Per-span-name p50/p95/max/count over ``spans`` (optionally only
+    those that COMPLETED after ``since_wall``) — the compact shape
+    heartbeats carry fleet-wide instead of shipping whole span rings."""
+    by_name: Dict[str, List[float]] = {}
+    for s in spans:
+        if since_wall and (s.start_wall + s.duration_s) <= since_wall:
+            continue
+        by_name.setdefault(s.name, []).append(s.duration_s * 1000.0)
+    out: Dict[str, Dict[str, float]] = {}
+    for name, vals in by_name.items():
+        vals.sort()
+        n = len(vals)
+
+        def rank(q: float) -> float:
+            # Nearest-rank (ceil), not floor interpolation: with few
+            # samples a floor index collapses p95 onto the MINIMUM —
+            # e.g. [1ms, 1000ms] must report p95=1000, not 1.
+            return vals[min(n - 1, max(0, math.ceil(q * n) - 1))]
+
+        out[name] = {
+            "count": n,
+            "p50_ms": round(rank(0.5), 3),
+            "p95_ms": round(rank(0.95), 3),
+            "max_ms": round(vals[-1], 3),
+        }
+    return out
 
 
 def inject(payload: Any) -> Any:

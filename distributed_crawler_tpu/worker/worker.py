@@ -44,7 +44,8 @@ from ..bus.messages import (
 )
 from ..config.crawler import CrawlerConfig
 from ..crawl import runner as crawl_runner
-from ..utils import trace
+from ..utils import flight, trace
+from ..utils.telemetry import TelemetryEmitter
 from ..state.datamodels import PAGE_PROCESSING, Page, new_id, utcnow
 
 logger = logging.getLogger("dct.worker")
@@ -103,6 +104,9 @@ class CrawlWorker:
         self.tasks_success = 0
         self.tasks_error = 0
         self.current_work: Optional[WorkItem] = None
+        # Telemetry-rich heartbeats (RSS, latency digest; device stats only
+        # if this process already runs jax — the emitter never imports it).
+        self._telemetry = TelemetryEmitter()
         self._mu = threading.RLock()
         self._running = False
         self._threads: List[threading.Thread] = []
@@ -121,7 +125,8 @@ class CrawlWorker:
                                  name=f"worker-heartbeat-{self.id}")
             t.start()
             self._threads.append(t)
-        self.send_status_update(MSG_WORKER_STARTED, WORKER_ACTIVE)
+        self.send_status_update(MSG_WORKER_STARTED, WORKER_ACTIVE,
+                                telemetry=True)
         logger.info("worker started", extra={"worker_id": self.id})
 
     def stop(self) -> None:
@@ -147,7 +152,8 @@ class CrawlWorker:
                 time.sleep(0.05)
             if not self.is_running:
                 return
-            self.send_status_update(MSG_HEARTBEAT, self.determine_status())
+            self.send_status_update(MSG_HEARTBEAT, self.determine_status(),
+                                    telemetry=True)
 
     def determine_status(self) -> str:
         if not self.is_running:
@@ -155,8 +161,14 @@ class CrawlWorker:
         with self._mu:
             return WORKER_BUSY if self.current_work is not None else WORKER_IDLE
 
-    def send_status_update(self, message_type: str, status: str) -> None:
-        """`worker.go:255-295`."""
+    def send_status_update(self, message_type: str, status: str,
+                           telemetry: bool = False) -> None:
+        """`worker.go:255-295`.  ``telemetry=True`` (the interval
+        heartbeat and the started announcement) attaches the
+        `utils/telemetry.py` snapshot; per-item busy/idle transitions
+        stay light — snapshotting there would both pay an O(trace-ring)
+        digest per work item and reset the digest window the interval
+        beat is supposed to cover."""
         with self._mu:
             current = self.current_work.id if self.current_work else None
         msg = StatusMessage.new(
@@ -165,6 +177,8 @@ class CrawlWorker:
             tasks_success=self.tasks_success, tasks_error=self.tasks_error,
             uptime_s=time.monotonic() - self._started_at)
         msg.current_work = current
+        if telemetry:
+            msg.resource_usage = self._telemetry.snapshot()
         try:
             self.bus.publish(TOPIC_WORKER_STATUS, msg)
         except Exception as e:
@@ -188,6 +202,8 @@ class CrawlWorker:
         with self._mu:
             self.current_work = item
         start = time.monotonic()
+        flight.record("work_start", work_item=item.id, worker=self.id,
+                      url=item.url)
         self.send_status_update(MSG_HEARTBEAT, WORKER_BUSY)
         try:
             # Same trace as the orchestrator's dispatch span: the item
@@ -222,6 +238,8 @@ class CrawlWorker:
             else:
                 self.tasks_error += 1
             self.tasks_processed += 1
+        flight.record("work_done", work_item=item.id, worker=self.id,
+                      status=result.status, error=result.error or None)
         self.send_status_update(MSG_HEARTBEAT, WORKER_IDLE)
         logger.info("work item processed and result sent", extra={
             "work_item_id": item.id, "status": result.status,

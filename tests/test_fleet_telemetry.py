@@ -1,0 +1,581 @@
+"""Fleet telemetry + flight recorder tests.
+
+Covers the PR-4 observability layer end to end: telemetry-rich heartbeats
+(`utils/telemetry.py`), the codec round-trip of nested ``resource_usage``
+maps, the orchestrator's FleetView fold (out-of-order heartbeats, rates,
+staleness), the ``/cluster`` endpoint over real HTTP, the flight recorder's
+bounded ring + postmortem bundles (`utils/flight.py`), and the acceptance
+scenario: orchestrator + one crawl worker + one TPU worker on the in-memory
+bus, with a worker killed mid-batch leaving a bundle `tools/postmortem.py`
+renders.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+from datetime import timedelta
+from types import SimpleNamespace
+
+import pytest
+
+from distributed_crawler_tpu.bus import InMemoryBus
+from distributed_crawler_tpu.bus.codec import (
+    RecordBatch,
+    decode_frame,
+    encode_frame,
+)
+from distributed_crawler_tpu.bus.messages import (
+    MSG_HEARTBEAT,
+    MSG_WORKER_STOPPING,
+    TOPIC_INFERENCE_BATCHES,
+    TOPIC_WORKER_STATUS,
+    WORKER_BUSY,
+    WORKER_IDLE,
+    WORKER_OFFLINE,
+    StatusMessage,
+)
+from distributed_crawler_tpu.datamodel.post import Post
+from distributed_crawler_tpu.inference.worker import (
+    TPUWorker,
+    TPUWorkerConfig,
+)
+from distributed_crawler_tpu.orchestrator import Orchestrator
+from distributed_crawler_tpu.orchestrator.fleet import FleetView
+from distributed_crawler_tpu.state.datamodels import utcnow
+from distributed_crawler_tpu.utils import flight, trace
+from distributed_crawler_tpu.utils.flight import FlightRecorder
+from distributed_crawler_tpu.utils.metrics import (
+    MetricsRegistry,
+    clear_cluster_provider,
+    serve_metrics,
+    set_cluster_provider,
+)
+from distributed_crawler_tpu.utils.telemetry import (
+    TelemetryEmitter,
+    device_memory_stats,
+    process_rss_bytes,
+)
+
+import tools.postmortem as postmortem
+
+
+def hb(worker_id="w1", status=WORKER_IDLE, worker_type="crawl", ts=None,
+       queue_length=0, processed=0, success=0, error=0, usage=None,
+       message_type=MSG_HEARTBEAT):
+    msg = StatusMessage.new(worker_id, message_type, status,
+                            tasks_processed=processed, tasks_success=success,
+                            tasks_error=error, worker_type=worker_type)
+    msg.timestamp = ts or utcnow()
+    msg.queue_length = queue_length
+    if usage is not None:
+        msg.resource_usage = usage
+    return msg
+
+
+class FakeEngine:
+    """Engine double: enough surface for TPUWorker + telemetry, no jax."""
+
+    def __init__(self):
+        self.cfg = SimpleNamespace(model="fake-tiny")
+        self.fail = None  # exception instance to raise mid-batch
+        self.misses = 1.0
+
+    def run(self, texts):
+        if self.fail is not None:
+            raise self.fail
+        return [{"label": 0, "score": 1.0} for _ in texts]
+
+    def compile_cache_stats(self):
+        return {"programs_unpacked": [16], "programs_packed": [],
+                "misses_total": self.misses, "misses": {"unpacked:16": self.misses}}
+
+
+def make_batch(n=3, crawl_id="c1"):
+    return RecordBatch.from_posts(
+        [Post(post_uid=f"p{i}", channel_name="chan",
+              description=f"text {i}") for i in range(n)],
+        crawl_id=crawl_id)
+
+
+# ---------------------------------------------------------------------------
+class TestTelemetrySnapshot:
+    def test_process_stats_present(self):
+        snap = TelemetryEmitter().snapshot()
+        assert snap["rss_bytes"] > 0
+        assert snap["py_threads"] >= 1
+
+    def test_rss_helper_positive(self):
+        assert process_rss_bytes() > 0
+
+    def test_device_memory_guarded_on_cpu(self):
+        # CPU backend has no memory_stats — must degrade to [], not raise.
+        assert isinstance(device_memory_stats(), list)
+
+    def test_latency_digest_covers_spans_since_last_snapshot(self):
+        tracer = trace.Tracer(capacity=64)
+        em = TelemetryEmitter(tracer=tracer)
+        em.snapshot()  # establish the window start
+        with tracer.span("stage.a"):
+            pass
+        snap = em.snapshot()
+        assert "stage.a" in snap["latency_ms"]
+        d = snap["latency_ms"]["stage.a"]
+        assert d["count"] == 1
+        assert d["max_ms"] >= d["p50_ms"] >= 0.0
+        # The NEXT snapshot starts a fresh window: stage.a is not re-digested.
+        assert "stage.a" not in em.snapshot().get("latency_ms", {})
+
+    def test_digest_p95_is_nearest_rank_not_floor(self):
+        # [1ms, 1000ms]: a floor-index quantile collapses p95 onto the
+        # minimum; nearest-rank must report the tail.
+        spans = [trace.Span(name="s", trace_id="t", span_id=f"sp{i}",
+                            start_wall=1.0, duration_s=d)
+                 for i, d in enumerate((0.001, 1.0))]
+        d = trace.latency_digest(spans)["s"]
+        assert d["p50_ms"] == 1.0
+        assert d["p95_ms"] == 1000.0
+        assert d["max_ms"] == 1000.0
+
+    def test_compile_cache_deltas(self):
+        eng = FakeEngine()
+        em = TelemetryEmitter(engine=eng, tracer=trace.Tracer(capacity=1))
+        first = em.snapshot()["compile_cache"]
+        assert first["misses_delta"] == 1.0  # first snapshot: all history
+        eng.misses = 4.0
+        assert em.snapshot()["compile_cache"]["misses_delta"] == 3.0
+        assert em.snapshot()["compile_cache"]["misses_delta"] == 0.0
+
+    def test_counter_series_by_label(self):
+        reg = MetricsRegistry()
+        c = reg.counter("outcomes_total", "t")
+        c.labels(outcome="ok").inc(3)
+        c.labels(outcome="error").inc()
+        em = TelemetryEmitter(counters={"batch_outcomes": c},
+                              tracer=trace.Tracer(capacity=1))
+        snap = em.snapshot()
+        assert snap["batch_outcomes"] == {"ok": 3.0, "error": 1.0}
+
+    def test_crawl_worker_transitions_stay_light(self):
+        # Per-item busy/idle updates carry no telemetry (and so don't
+        # reset the interval digest window); heartbeat/started beats do.
+        from distributed_crawler_tpu.worker import CrawlWorker
+        from distributed_crawler_tpu.config import CrawlerConfig
+
+        bus = InMemoryBus()
+        seen = []
+        bus.subscribe(TOPIC_WORKER_STATUS, seen.append)
+        worker = CrawlWorker(
+            "w-light", CrawlerConfig(crawl_id="c1", platform="telegram"),
+            bus, SimpleNamespace(close=lambda: None))
+        worker.send_status_update(MSG_HEARTBEAT, WORKER_BUSY)
+        worker.send_status_update(MSG_HEARTBEAT, WORKER_IDLE,
+                                  telemetry=True)
+        assert StatusMessage.from_dict(seen[0]).resource_usage == {}
+        assert StatusMessage.from_dict(
+            seen[1]).resource_usage["rss_bytes"] > 0
+
+    def test_snapshot_never_raises(self):
+        class Broken:
+            def compile_cache_stats(self):
+                raise RuntimeError("boom")
+
+        snap = TelemetryEmitter(engine=Broken()).snapshot()
+        assert snap["rss_bytes"] > 0  # degraded, not dead
+
+
+# ---------------------------------------------------------------------------
+class TestHeartbeatIntervalClamp:
+    def _resolve(self, *extra):
+        from distributed_crawler_tpu.cli import build_parser, resolve_config
+        args = build_parser().parse_args(
+            ["--mode", "tpu-worker", *extra])
+        return resolve_config(args, env={})[1]
+
+    def test_oversized_interval_clamped_below_liveness_timeout(self):
+        from distributed_crawler_tpu.cli import _heartbeat_interval
+        # 600 s beats would trip the orchestrator's 300 s offline sweep.
+        assert _heartbeat_interval(
+            self._resolve("--telemetry-interval", "600")) == 90.0
+        assert _heartbeat_interval(
+            self._resolve("--telemetry-interval", "0.01")) == 1.0
+
+    def test_default_and_sane_values_pass_through(self):
+        from distributed_crawler_tpu.cli import _heartbeat_interval
+        assert _heartbeat_interval(self._resolve()) == 30.0
+        assert _heartbeat_interval(
+            self._resolve("--telemetry-interval", "5")) == 5.0
+
+
+# ---------------------------------------------------------------------------
+class TestStatusMessageRoundTrip:
+    def test_uptime_key_round_trips(self):
+        msg = StatusMessage.new("w1", MSG_HEARTBEAT, WORKER_IDLE,
+                                uptime_s=12.5)
+        d = msg.to_dict()
+        assert d["uptime_s"] == 12.5
+        assert d["uptime"] == 12.5  # compat alias for old decoders
+        assert StatusMessage.from_dict(d).uptime_s == 12.5
+
+    def test_legacy_frame_still_parses(self):
+        d = StatusMessage.new("w1", MSG_HEARTBEAT, WORKER_IDLE,
+                              uptime_s=7.0).to_dict()
+        del d["uptime_s"]  # an old publisher only wrote "uptime"
+        assert StatusMessage.from_dict(d).uptime_s == 7.0
+
+    def test_nested_resource_usage_survives_codec_frame(self):
+        usage = {
+            "rss_bytes": 123456,
+            "device_memory": [{"device": "tpu:0", "bytes_in_use": 10,
+                               "bytes_limit": 100, "peak_bytes_in_use": 20}],
+            "compile_cache": {"misses_total": 2.0,
+                              "misses": {"packed:128": 2.0}},
+            "latency_ms": {"worker.process": {"count": 3, "p50_ms": 1.5,
+                                              "p95_ms": 2.0, "max_ms": 9.9}},
+            "batch_outcomes": {"ok": 5.0},
+        }
+        msg = hb(usage=usage, processed=5, success=5)
+        payload, rest = decode_frame(encode_frame(msg.to_dict()))
+        assert rest == b""
+        assert StatusMessage.from_dict(payload).resource_usage == usage
+
+    def test_nested_resource_usage_survives_inmemory_bus(self):
+        usage = {"latency_ms": {"s": {"count": 1, "p50_ms": 0.1,
+                                      "p95_ms": 0.1, "max_ms": 0.1}}}
+        bus = InMemoryBus()
+        got = []
+        bus.subscribe(TOPIC_WORKER_STATUS, got.append)
+        bus.publish(TOPIC_WORKER_STATUS, hb(usage=usage))
+        assert StatusMessage.from_dict(got[0]).resource_usage == usage
+
+
+# ---------------------------------------------------------------------------
+class TestFleetView:
+    def test_fold_and_rates_from_counter_deltas(self):
+        fv = FleetView(registry=MetricsRegistry())
+        t0 = utcnow()
+        assert fv.observe(hb(ts=t0, processed=0))
+        assert fv.observe(hb(ts=t0 + timedelta(seconds=10), processed=5,
+                             error=1))
+        w = fv.export(now=t0 + timedelta(seconds=10))["workers"]["w1"]
+        assert w["rates"]["tasks_per_s"] == 0.5
+        assert w["rates"]["errors_per_s"] == 0.1
+        assert w["heartbeats"] == 2
+
+    def test_restart_counter_reset_never_yields_negative_rates(self):
+        # Same worker_id restarts with fresh counters: the fresh counts
+        # are the delta since restart, not a -500-task rate.
+        fv = FleetView(registry=MetricsRegistry())
+        t0 = utcnow()
+        fv.observe(hb(ts=t0, processed=500, error=10))
+        fv.observe(hb(ts=t0 + timedelta(seconds=10), processed=3, error=0))
+        w = fv.export(now=t0 + timedelta(seconds=10))["workers"]["w1"]
+        assert w["rates"]["tasks_per_s"] == 0.3
+        assert w["rates"]["errors_per_s"] == 0.0
+
+    def test_out_of_order_heartbeat_dropped_not_folded(self):
+        fv = FleetView(registry=MetricsRegistry())
+        t0 = utcnow()
+        fv.observe(hb(ts=t0, status=WORKER_BUSY, processed=9))
+        # A late frame from before the newest accepted beat: counted, but
+        # last_seen/status/counters must not regress.
+        assert not fv.observe(hb(ts=t0 - timedelta(seconds=30),
+                                 status=WORKER_IDLE, processed=2))
+        w = fv.export(now=t0)["workers"]["w1"]
+        assert w["status"] == WORKER_BUSY
+        assert w["tasks"]["processed"] == 9
+        assert w["stale_heartbeats_dropped"] == 1
+
+    def test_staleness_rollup_mirrors_health_timeout(self):
+        fv = FleetView(stale_after_s=300.0, registry=MetricsRegistry())
+        t0 = utcnow()
+        fv.observe(hb(worker_id="fresh", ts=t0))
+        fv.observe(hb(worker_id="dead", ts=t0 - timedelta(seconds=301)))
+        out = fv.export(now=t0)
+        assert out["fleet"]["stale_workers"] == ["dead"]
+        assert out["workers"]["dead"]["stale"]
+        assert not out["workers"]["fresh"]["stale"]
+
+    def test_stopping_message_marks_offline_and_history_on_change(self):
+        fv = FleetView(registry=MetricsRegistry())
+        t0 = utcnow()
+        fv.observe(hb(ts=t0))
+        fv.observe(hb(ts=t0 + timedelta(seconds=1)))  # no change: no entry
+        fv.observe(hb(ts=t0 + timedelta(seconds=2), status=WORKER_BUSY,
+                      queue_length=3))
+        fv.observe(hb(ts=t0 + timedelta(seconds=3),
+                      message_type=MSG_WORKER_STOPPING,
+                      status=WORKER_OFFLINE))
+        w = fv.export()["workers"]["w1"]
+        assert w["status"] == WORKER_OFFLINE
+        assert [h[1] for h in w["history"]] == [
+            WORKER_IDLE, WORKER_BUSY, WORKER_OFFLINE]
+
+    def test_fleet_gauges_labeled_per_worker(self):
+        reg = MetricsRegistry()
+        fv = FleetView(registry=reg)
+        fv.observe(hb(worker_id="tpu-1", worker_type="tpu", queue_length=7,
+                      usage={"rss_bytes": 2048, "device_memory": [
+                          {"device": "tpu:0", "bytes_in_use": 100,
+                           "bytes_limit": 1000, "peak_bytes_in_use": 150},
+                          {"device": "tpu:1", "bytes_in_use": 50,
+                           "bytes_limit": 1000,
+                           "peak_bytes_in_use": 60}]}))
+        text = reg.expose()
+        assert 'fleet_worker_queue_length{worker_id="tpu-1"} 7.0' in text
+        assert ('fleet_worker_device_mem_bytes'
+                '{kind="in_use",worker_id="tpu-1"} 150.0') in text
+        assert 'fleet_worker_rss_bytes{worker_id="tpu-1"} 2048.0' in text
+
+    def test_refresh_staleness_moves_gauge_without_export(self):
+        # A dead worker never observes again; the gauge must still move
+        # on a plain /metrics scrape, driven by the health tick.
+        reg = MetricsRegistry()
+        fv = FleetView(stale_after_s=300.0, registry=reg)
+        t0 = utcnow()
+        fv.observe(hb(worker_id="dead", ts=t0 - timedelta(seconds=400)))
+        assert fv.refresh_staleness(now=t0) == 1
+        assert "fleet_stale_workers 1.0" in reg.expose()
+
+    def test_long_gone_workers_evicted_with_their_gauge_series(self):
+        reg = MetricsRegistry()
+        fv = FleetView(stale_after_s=300.0, registry=reg)
+        t0 = utcnow()
+        fv.observe(hb(worker_id="gone", queue_length=5,
+                      usage={"rss_bytes": 1},
+                      ts=t0 - timedelta(seconds=3001)))  # > 10x timeout
+        fv.observe(hb(worker_id="alive", ts=t0))
+        fv.refresh_staleness(now=t0)
+        out = fv.export(now=t0)
+        assert set(out["workers"]) == {"alive"}
+        text = reg.expose()
+        assert 'worker_id="gone"' not in text
+        assert 'worker_id="alive"' in text
+
+    def test_telemetry_kept_verbatim(self):
+        fv = FleetView(registry=MetricsRegistry())
+        usage = {"compile_cache": {"misses_delta": 0.0},
+                 "latency_ms": {"engine.compute": {"count": 2, "p50_ms": 1.0,
+                                                   "p95_ms": 2.0,
+                                                   "max_ms": 2.0}}}
+        fv.observe(hb(usage=usage))
+        assert fv.export()["workers"]["w1"]["telemetry"] == usage
+
+
+# ---------------------------------------------------------------------------
+class TestClusterEndpoint:
+    def test_cluster_served_over_http(self):
+        fv = FleetView(registry=MetricsRegistry())
+        fv.observe(hb(worker_id="w-http", usage={"rss_bytes": 1}))
+        server = serve_metrics(0, MetricsRegistry())
+        port = server.server_address[1]
+        set_cluster_provider(fv.export)
+        try:
+            got = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/cluster", timeout=5).read())
+            assert "w-http" in got["workers"]
+            assert got["fleet"]["worker_count"] == 1
+        finally:
+            clear_cluster_provider(fv.export)
+            server.shutdown()
+
+    def test_cluster_404_without_provider(self):
+        server = serve_metrics(0, MetricsRegistry())
+        port = server.server_address[1]
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/cluster", timeout=5)
+            assert e.value.code == 404
+        finally:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+class TestFlightRecorder:
+    def test_ring_is_bounded(self):
+        rec = FlightRecorder(capacity=4)
+        for i in range(10):
+            rec.record("tick", i=i)
+        events = rec.events()
+        assert len(events) == 4
+        assert [e["i"] for e in events] == [6, 7, 8, 9]
+
+    def test_capacity_zero_disables(self):
+        rec = FlightRecorder(capacity=0)
+        rec.record("tick")
+        assert rec.events() == []
+
+    def test_dump_writes_parseable_bundle(self, tmp_path):
+        rec = FlightRecorder(capacity=8)
+        rec.configure(dump_dir=str(tmp_path),
+                      fingerprint={"mode": "worker", "worker_id": "w1"})
+        rec.record("dispatch", work_item="wi1")
+        path = rec.dump("test_reason", error="synthetic failure")
+        assert path is not None
+        bundle = json.loads(open(path, encoding="utf-8").read())
+        assert bundle["schema"] == "dct-postmortem-v1"
+        assert bundle["reason"] == "test_reason"
+        assert bundle["error"] == "synthetic failure"
+        assert bundle["config"]["worker_id"] == "w1"
+        assert bundle["flight"][0]["kind"] == "dispatch"
+        assert "traces" in bundle and "metrics" in bundle
+
+    def test_dump_without_dir_is_noop(self):
+        assert FlightRecorder().dump("x") is None
+
+    def test_dump_dedups_per_reason(self, tmp_path):
+        rec = FlightRecorder()
+        rec.configure(dump_dir=str(tmp_path))
+        assert rec.dump("r") is not None
+        assert rec.dump("r") is None  # one bundle per reason per life
+        assert rec.dump("other") is not None
+
+    def test_renderer_accepts_bundle(self, tmp_path):
+        rec = FlightRecorder(capacity=8)
+        rec.configure(dump_dir=str(tmp_path))
+        rec.record("batch", batch="b1", outcome="error", error="boom")
+        path = rec.dump("unhandled_exception", error="ValueError: boom")
+        assert postmortem.main([path]) == 0
+
+    def test_renderer_selfcheck(self):
+        assert postmortem.selfcheck() == 0
+
+
+# ---------------------------------------------------------------------------
+class TestEndToEndFleet:
+    """The acceptance scenario: orchestrator + crawl worker + TPU worker on
+    one in-memory bus; /cluster shows both with telemetry; a worker killed
+    mid-batch leaves a bundle the postmortem tool renders."""
+
+    def _start_stack(self, tmp_path):
+        from distributed_crawler_tpu.clients import (
+            SimNetwork,
+            SimTelegramClient,
+        )
+        from distributed_crawler_tpu.clients.pool import ConnectionPool
+        from distributed_crawler_tpu.config import CrawlerConfig
+        from distributed_crawler_tpu.crawl import runner as crawl_runner
+        from distributed_crawler_tpu.state import (
+            CompositeStateManager,
+            SqlConfig,
+            StateConfig,
+        )
+        from distributed_crawler_tpu.worker import CrawlWorker, WorkerConfig
+        from tests.test_crawl_engine import text_msg
+
+        net = SimNetwork()
+        net.add_channel("chana", messages=[
+            text_msg("hello fleet", date=1700000000, view_count=5)],
+            member_count=100)
+        crawl_runner.shutdown_connection_pool()
+        crawl_runner.init_connection_pool(ConnectionPool.for_testing(
+            {"conn0": SimTelegramClient(net, conn_id="conn0")}))
+
+        trace.configure(capacity=2048)  # a prior test may have disabled it
+        bus = InMemoryBus()  # sync: deterministic inline delivery
+        cfg = CrawlerConfig(crawl_id="c1", platform="telegram",
+                            skip_media_download=True,
+                            sampling_method="channel")
+
+        def sm(sub):
+            return CompositeStateManager(StateConfig(
+                crawl_id="c1", crawl_execution_id="e1",
+                storage_root=str(tmp_path / sub),
+                sql=SqlConfig(url=":memory:")))
+
+        orch = Orchestrator("c1", cfg, bus, sm("orch"))
+        orch.start(["chana"], background=False)
+        worker = CrawlWorker("crawl-1", cfg, bus, sm("worker"),
+                             wcfg=WorkerConfig(worker_id="crawl-1",
+                                               heartbeat_s=3600))
+        worker.start(background=False)
+
+        engine = FakeEngine()
+        tpu = TPUWorker(bus, engine,
+                        cfg=TPUWorkerConfig(worker_id="tpu-1",
+                                            heartbeat_s=3600,
+                                            stall_warn_s=0))
+        tpu.start()
+        return bus, orch, worker, tpu, engine, crawl_runner
+
+    def _beat_tpu(self, tpu):
+        """One TPU heartbeat without waiting for the loop's interval."""
+        msg = StatusMessage.new(
+            tpu.cfg.worker_id, MSG_HEARTBEAT, WORKER_IDLE,
+            tasks_processed=tpu._processed,
+            tasks_error=tpu._errors, worker_type="tpu")
+        msg.queue_length = tpu._queue.qsize()
+        msg.resource_usage = tpu._telemetry.snapshot()
+        tpu.bus.publish(TOPIC_WORKER_STATUS, msg.to_dict())
+
+    # The kill below deliberately unwinds the tpu-feed thread; the
+    # unhandled-thread warning IS the scenario here, not a bug.
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_cluster_reports_both_workers_and_postmortem_on_kill(
+            self, tmp_path):
+        bus, orch, worker, tpu, engine, crawl_runner = \
+            self._start_stack(tmp_path)
+        dump_dir = tmp_path / "dumps"
+        flight.RECORDER.reset()
+        flight.install(str(dump_dir))
+        server = serve_metrics(0, MetricsRegistry())
+        port = server.server_address[1]
+        set_cluster_provider(orch.get_cluster)
+        try:
+            # Crawl leg: distribute -> worker processes inline -> result +
+            # heartbeats fold into the fleet view.
+            assert orch.distribute_work() == 1
+            # TPU leg: one record batch through the fake engine.
+            bus.publish(TOPIC_INFERENCE_BATCHES, make_batch().to_dict())
+            assert tpu.drain(timeout_s=10)
+            assert tpu._processed == 1
+            self._beat_tpu(tpu)
+
+            got = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/cluster", timeout=5).read())
+            workers = got["workers"]
+            assert {"crawl-1", "tpu-1"} <= set(workers)
+            assert workers["crawl-1"]["worker_type"] == "crawl"
+            assert workers["tpu-1"]["worker_type"] == "tpu"
+            for wid in ("crawl-1", "tpu-1"):
+                tele = workers[wid]["telemetry"]
+                assert tele.get("rss_bytes", 0) > 0 \
+                    or tele.get("device_memory")
+            # The TPU worker's telemetry carries the latency digest and
+            # batch outcomes of the batch it just served.
+            tele = workers["tpu-1"]["telemetry"]
+            assert "tpu_worker.process" in tele["latency_ms"]
+            assert tele["batch_outcomes"].get("ok", 0) >= 1
+            assert tele["compile_cache"]["misses_total"] == 1.0
+            assert workers["crawl-1"]["tasks"]["processed"] == 1
+            assert got["orchestrator"]["completed_items"] == 1
+
+            # Kill the TPU worker mid-batch: a non-Exception unwinds the
+            # feed thread (the in-process analog of a SIGKILL'd step);
+            # threading.excepthook writes the black box.
+            engine.fail = KeyboardInterrupt("simulated kill mid-batch")
+            bus.publish(TOPIC_INFERENCE_BATCHES, make_batch().to_dict())
+            deadline = time.monotonic() + 10
+            bundles = []
+            while time.monotonic() < deadline and not bundles:
+                bundles = list(dump_dir.glob("postmortem_*.json"))
+                time.sleep(0.05)
+            assert bundles, "no postmortem bundle written on kill"
+            bundle = json.loads(bundles[0].read_text(encoding="utf-8"))
+            assert bundle["reason"] == "unhandled_exception"
+            assert "KeyboardInterrupt" in bundle["error"]
+            kinds = [e["kind"] for e in bundle["flight"]]
+            assert "dispatch" in kinds and "batch" in kinds
+            assert postmortem.main([str(bundles[0])]) == 0
+        finally:
+            clear_cluster_provider(orch.get_cluster)
+            server.shutdown()
+            flight.RECORDER.configure(dump_dir="")
+            flight.RECORDER.reset()
+            tpu.stop(timeout_s=2)
+            worker.stop()
+            orch.stop()
+            bus.close()
+            crawl_runner.shutdown_connection_pool()

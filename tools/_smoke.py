@@ -30,9 +30,10 @@ def selfcheck() -> int:
     """`python tools/_smoke.py`: the cheap pre-bench sanity gate — byte-
     compile the whole package (catches syntax/indentation rot in modules no
     test imports), run crawlint (`python -m tools.analyze`; the
-    repo-native static checkers, docs/static-analysis.md), then run the
-    metrics + tracing unit tests the other tools' /metrics and /traces
-    reads depend on."""
+    repo-native static checkers, docs/static-analysis.md), the
+    postmortem renderer's selfcheck, then the metrics + tracing + fleet
+    unit tests the other tools' /metrics, /traces, and /cluster reads
+    depend on."""
     import compileall
     import subprocess
 
@@ -45,10 +46,17 @@ def selfcheck() -> int:
     if rc != 0:
         print("crawlint FAILED (python -m tools.analyze)", file=sys.stderr)
         return rc
+    rc = subprocess.call(
+        [sys.executable, os.path.join(repo, "tools", "postmortem.py"),
+         "--selfcheck"], cwd=repo)
+    if rc != 0:
+        print("postmortem selfcheck FAILED", file=sys.stderr)
+        return rc
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     return subprocess.call(
         [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
-         os.path.join(repo, "tests", "test_metrics_trace.py")],
+         os.path.join(repo, "tests", "test_metrics_trace.py"),
+         os.path.join(repo, "tests", "test_fleet_telemetry.py")],
         env=env, cwd=repo)
 
 

@@ -1,0 +1,243 @@
+#!/usr/bin/env python
+"""Render a postmortem bundle (or a live /cluster view) as a timeline.
+
+Usage:
+    python tools/postmortem.py /dumps/postmortem_..._sigterm.json
+    python tools/postmortem.py http://127.0.0.1:9102        # live /cluster
+    python tools/postmortem.py --selfcheck                  # CI smoke
+
+Bundle mode (a JSON file written by `utils/flight.py` on SIGTERM,
+unhandled exception, or watchdog stall-exit) prints:
+- the header: reason, error, pid, written-at, config fingerprint;
+- the flight-event timeline (relative seconds, kind, fields) — the last
+  N decisions the process made before dying;
+- a per-stage latency digest from the bundled trace export;
+- the metric series that moved (non-zero samples only).
+
+Live mode fetches `/cluster` from a running orchestrator's metrics port
+and prints the fleet table: worker, type, status, age, queue, rates, RSS,
+device memory — the "is anything about to die" view.
+
+Stdlib only, like tools/trace_dump.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+from typing import Any, Dict, List
+
+
+def _fmt_ts(epoch: float) -> str:
+    return time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime(epoch)) + "Z"
+
+
+def _fmt_bytes(n: Any) -> str:
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return "-"
+
+
+# --- bundle rendering --------------------------------------------------------
+
+def render_bundle(bundle: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    lines.append(f"postmortem: {bundle.get('reason', '?')}"
+                 + (f" — {bundle['error']}" if bundle.get("error") else ""))
+    if bundle.get("written_at"):
+        lines.append(f"written:    {_fmt_ts(float(bundle['written_at']))}"
+                     f"  pid={bundle.get('pid', '?')}")
+    config = bundle.get("config") or {}
+    if config:
+        lines.append("config:     " + " ".join(
+            f"{k}={v}" for k, v in sorted(config.items()) if v))
+    events = bundle.get("flight") or []
+    lines.append("")
+    lines.append(f"flight ring ({len(events)} events, oldest first):")
+    if events:
+        t_end = max(float(e.get("ts", 0.0)) for e in events)
+        for e in events:
+            rel = float(e.get("ts", 0.0)) - t_end
+            fields = " ".join(
+                f"{k}={v}" for k, v in e.items()
+                if k not in ("ts", "kind") and v is not None)
+            lines.append(f"  {rel:>9.3f}s  {e.get('kind', '?'):<16} {fields}")
+    else:
+        lines.append("  (empty — was --flight-buffer 0?)")
+    digest = _stage_digest(bundle.get("traces") or {})
+    if digest:
+        lines.append("")
+        lines.append("per-stage latency (from the bundled trace ring):")
+        lines.extend(digest)
+    moved = _moving_metrics(bundle.get("metrics") or "")
+    if moved:
+        lines.append("")
+        lines.append("metrics that moved (non-zero samples):")
+        lines.extend(f"  {m}" for m in moved)
+    return "\n".join(lines)
+
+
+def _stage_digest(traces: Dict[str, Any]) -> List[str]:
+    by_name: Dict[str, List[float]] = {}
+    for t in traces.get("traces", []):
+        for s in t.get("spans", []):
+            by_name.setdefault(s.get("name", "?"), []).append(
+                float(s.get("duration_ms", 0.0)))
+    if not by_name:
+        return []
+    rows = []
+    for name, vals in by_name.items():
+        vals.sort()
+        # Nearest-rank p50, matching utils/trace.latency_digest.
+        p50 = vals[max(0, -(-len(vals) // 2) - 1)]
+        rows.append((name, len(vals), p50, vals[-1]))
+    rows.sort(key=lambda r: -r[3])
+    w = max(len(r[0]) for r in rows)
+    out = [f"  {'stage':<{w}}  {'count':>6}  {'p50 ms':>9}  {'max ms':>9}"]
+    for name, n, p50, mx in rows:
+        out.append(f"  {name:<{w}}  {n:>6}  {p50:>9.2f}  {mx:>9.2f}")
+    return out
+
+
+def _moving_metrics(exposition: str) -> List[str]:
+    out = []
+    for line in exposition.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        try:
+            value = float(line.rsplit(None, 1)[1])
+        except (IndexError, ValueError):
+            continue
+        if value != 0.0:
+            out.append(line)
+    return out
+
+
+# --- live /cluster rendering -------------------------------------------------
+
+def render_cluster(view: Dict[str, Any]) -> str:
+    fleet = view.get("fleet") or {}
+    orch = view.get("orchestrator") or {}
+    lines = [
+        f"fleet: {fleet.get('worker_count', 0)} workers "
+        f"({fleet.get('crawl_workers', 0)} crawl, "
+        f"{fleet.get('tpu_workers', 0)} tpu)"
+        + (f", STALE: {', '.join(fleet['stale_workers'])}"
+           if fleet.get("stale_workers") else "")]
+    if orch:
+        lines.append(
+            f"orchestrator: depth={orch.get('current_depth')} "
+            f"active={orch.get('active_work')} "
+            f"completed={orch.get('completed_items')} "
+            f"errors={orch.get('error_items')} "
+            f"backpressure={orch.get('backpressure_active')}")
+    workers = view.get("workers") or {}
+    if not workers:
+        lines.append("(no heartbeats folded yet)")
+        return "\n".join(lines)
+    header = (f"{'worker':<20} {'type':<6} {'status':<8} {'age s':>7} "
+              f"{'queue':>5} {'tasks/s':>8} {'rss':>9} {'dev mem':>9}")
+    lines.append("")
+    lines.append(header)
+    for wid in sorted(workers):
+        w = workers[wid]
+        tele = w.get("telemetry") or {}
+        dev = tele.get("device_memory") or []
+        in_use = sum(d.get("bytes_in_use", 0) for d in dev
+                     if isinstance(d, dict))
+        age = w.get("last_seen_age_s")
+        lines.append(
+            f"{wid:<20} {w.get('worker_type', '?'):<6} "
+            f"{w.get('status', '?'):<8} "
+            f"{age if age is not None else '-':>7} "
+            f"{w.get('queue_length', 0):>5} "
+            f"{w.get('rates', {}).get('tasks_per_s', 0.0):>8} "
+            f"{_fmt_bytes(tele.get('rss_bytes')):>9} "
+            f"{_fmt_bytes(in_use) if dev else '-':>9}")
+        for name, d in sorted((tele.get("latency_ms") or {}).items()):
+            lines.append(f"    {name:<28} p50={d.get('p50_ms')}ms "
+                         f"p95={d.get('p95_ms')}ms max={d.get('max_ms')}ms "
+                         f"n={d.get('count')}")
+    return "\n".join(lines)
+
+
+# --- entry -------------------------------------------------------------------
+
+def load(source: str) -> Dict[str, Any]:
+    if source.startswith(("http://", "https://")):
+        url = source.rstrip("/")
+        if not url.endswith("/cluster"):
+            url += "/cluster"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return json.load(resp)
+    with open(source, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def selfcheck() -> int:
+    """Render a synthetic bundle + cluster view; non-zero on any error.
+    Keeps `python tools/_smoke.py` honest about this tool without needing
+    a dead worker to autopsy."""
+    from distributed_crawler_tpu.utils.flight import FlightRecorder
+
+    rec = FlightRecorder(capacity=8)
+    rec.record("dispatch", work_item="w1", url="chana")
+    rec.record("batch", batch="b1", outcome="ok", records=3)
+    rec.record("worker_offline", worker="crawl-1", silence_s=301.0)
+    bundle = rec.bundle("selfcheck", error="synthetic")
+    out = render_bundle(bundle)
+    assert "selfcheck" in out and "worker_offline" in out, out
+    cluster = {
+        "fleet": {"worker_count": 1, "crawl_workers": 1, "tpu_workers": 0,
+                  "stale_workers": []},
+        "workers": {"crawl-1": {
+            "worker_type": "crawl", "status": "idle", "last_seen_age_s": 2.0,
+            "queue_length": 0, "rates": {"tasks_per_s": 0.5},
+            "telemetry": {"rss_bytes": 1 << 20,
+                          "latency_ms": {"worker.process": {
+                              "count": 4, "p50_ms": 1.0, "p95_ms": 2.0,
+                              "max_ms": 3.0}}}}},
+    }
+    out = render_cluster(cluster)
+    assert "crawl-1" in out and "worker.process" in out, out
+    print("postmortem selfcheck ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="render a postmortem bundle or a live /cluster view")
+    p.add_argument("source", nargs="?", default="",
+                   help="bundle JSON path, or a metrics-server base URL "
+                        "(its /cluster endpoint is fetched)")
+    p.add_argument("--selfcheck", action="store_true",
+                   help="render synthetic data and exit (CI smoke)")
+    args = p.parse_args(argv)
+
+    if args.selfcheck:
+        return selfcheck()
+    if not args.source:
+        p.error("source required (bundle path or service URL)")
+    try:
+        data = load(args.source)
+    except Exception as e:
+        print(f"error: failed to load {args.source}: {e}", file=sys.stderr)
+        return 2
+    if data.get("schema") == "dct-postmortem-v1" or "flight" in data:
+        print(render_bundle(data))
+    else:
+        print(render_cluster(data))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
