@@ -153,6 +153,18 @@ def build_parser() -> argparse.ArgumentParser:
       help="seconds between telemetry-rich heartbeats in the worker "
            "modes (default 30; clamped to 90 so heartbeats always beat "
            "the orchestrator's 300 s liveness timeout)")
+    a("--slo-batch-p95-ms", type=float, default=None,
+      help="SLO budget on the per-batch processing span's p95 in ms, "
+           "evaluated each heartbeat (breach -> slo_breach_total{slo} + "
+           "WARNING with the offending trace_id + flight event; 0 = off)")
+    a("--slo-queue-wait-ms", type=float, default=None,
+      help="SLO budget on the TPU worker's queue-wait p95 in ms "
+           "(0 = off, the default)")
+    a("--profile-on-slow-ms", type=float, default=None,
+      help="auto-capture a bounded jax.profiler trace to --dump-dir when "
+           "a device batch exceeds this many ms (one capture at a time; "
+           "0 = off); /profile?seconds=N on the metrics port does the "
+           "same on demand")
     # TPU inference stage
     a("--bus-serve", action="store_const", const=True, default=None,
       help="also HOST the gRPC bus broker at --bus-address (tpu-worker "
@@ -356,6 +368,9 @@ _KEY_MAP = {
     "dump_dir": "observability.dump_dir",
     "flight_buffer": "observability.flight_buffer",
     "telemetry_interval": "observability.telemetry_interval_s",
+    "slo_batch_p95_ms": "observability.slo_batch_p95_ms",
+    "slo_queue_wait_ms": "observability.slo_queue_wait_ms",
+    "profile_on_slow_ms": "observability.profile_on_slow_ms",
     "infer": "inference.enabled",
     "infer_model": "inference.model",
     "infer_backpressure_high": "distributed.inference_backpressure_high",
@@ -581,6 +596,13 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
     dump_dir = r.get_str("observability.dump_dir", "")
     if dump_dir:
         _flight.install(dump_dir)
+    # The on-demand /profile capture endpoint (`utils/profiling.py`)
+    # writes its trace bundles next to the postmortem bundles; without a
+    # dump dir it answers 503 with a clear error instead of capturing
+    # into nowhere.
+    from .utils import profiling as _profiling
+
+    _profiling.configure(dump_dir=dump_dir)
     # Observability servers for every mode (`main.go:60-80` ran pprof
     # unconditionally) — EXCEPT tpu-worker, where TPUWorker.start() owns
     # both (binding here too would EADDRINUSE its startup).
@@ -591,14 +613,10 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
             serve_metrics(metrics_port)
         profiler_port = r.get_int("observability.profiler_port", 0)
         if profiler_port:
-            try:
-                import jax.profiler
-
-                jax.profiler.start_server(profiler_port)
-                logger.info("jax profiler serving",
-                            extra={"port": profiler_port})
-            except Exception as e:  # profiling is never fatal to the crawl
-                logger.warning("profiler server failed to start: %s", e)
+            # Guarded: unavailable/duplicate profiler logs a WARNING
+            # instead of killing startup; shares jax's single profiler
+            # session with the /profile capture endpoint.
+            _profiling.start_profiler_server(profiler_port)
     urls = collect_urls(r)
     if cfg.validate_only and mode in ("", "standalone", "launch"):
         # The validator pod is a launch-router branch
@@ -1001,7 +1019,9 @@ def _run_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
     worker = CrawlWorker(worker_id, cfg, bus, sm,
                          wcfg=WorkerConfig(
                              worker_id=worker_id,
-                             heartbeat_s=_heartbeat_interval(r)),
+                             heartbeat_s=_heartbeat_interval(r),
+                             slo_batch_p95_ms=r.get_float(
+                                 "observability.slo_batch_p95_ms", 0.0)),
                          youtube_crawler=youtube_crawler)
     from .utils.metrics import set_status_provider
     set_status_provider(worker.get_status)  # /status (`worker.go:459`)
@@ -1525,7 +1545,13 @@ def _build_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver):
                          stall_warn_s=r.get_float(
                              "inference.stall_warn_s", 120.0),
                          stall_exit_s=r.get_float(
-                             "inference.stall_exit_s", 0.0)))
+                             "inference.stall_exit_s", 0.0),
+                         slo_batch_p95_ms=r.get_float(
+                             "observability.slo_batch_p95_ms", 0.0),
+                         slo_queue_wait_ms=r.get_float(
+                             "observability.slo_queue_wait_ms", 0.0),
+                         profile_on_slow_ms=r.get_float(
+                             "observability.profile_on_slow_ms", 0.0)))
 
 
 def _run_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:

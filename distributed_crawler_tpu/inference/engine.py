@@ -33,6 +33,11 @@ from ..ops.padding import (
     pack_rows,
 )
 from ..utils import trace
+from ..utils.costmodel import (
+    CostModel,
+    EfficiencyMeter,
+    encoder_forward_flops,
+)
 from ..utils.metrics import REGISTRY, MetricsRegistry
 from .tokenizer import HashingTokenizer, Tokenizer
 
@@ -211,6 +216,13 @@ class InferenceEngine:
             "tpu_engine_compile_cache_misses_total",
             "jit program builds by bucket and path (first-dispatch "
             "compiles)")
+        # Hardware-efficiency accounting (`utils/costmodel.py`): per-bucket
+        # compiled cost captured at each program's first dispatch, and a
+        # rolling goodput/MFU meter fed per device batch.  Both serve the
+        # /costs endpoint via cost_snapshot(); the meter also rides
+        # telemetry heartbeats into the orchestrator's /cluster view.
+        self.costs = CostModel(registry=registry)
+        self.meter = EfficiencyMeter(registry=registry)
 
         if params is None:
             import jax.numpy as jnp
@@ -345,6 +357,41 @@ class InferenceEngine:
             "misses": misses,
         }
 
+    def _capture_cost(self, bucket: int, path: str, step, placed) -> None:
+        """Cost-model capture on a program's FIRST dispatch: the call that
+        just ran paid the XLA compile, so ``step.lower(...)`` here is
+        tracing-only and ``cost_analysis()`` reads the program the worker
+        actually serves.  Idempotent and never raises (`CostModel`)."""
+        if self.costs.has(bucket, path):
+            return
+        bs = self.cfg.batch_size
+        self.costs.capture(
+            bucket, path, lambda: step.lower(self.params, *placed),
+            encoder_forward_flops(self.ecfg, bs, bucket),
+            batch=bs, seq=bucket)
+
+    def _batch_flops(self, bucket: int, path: str) -> float:
+        return self.costs.flops_for(
+            bucket, path,
+            default=encoder_forward_flops(self.ecfg, self.cfg.batch_size,
+                                          bucket))
+
+    def cost_snapshot(self) -> Dict[str, Any]:
+        """The /costs body: per-(bucket, path) compiled cost + the rolling
+        efficiency window (`utils/metrics.set_costs_provider` seam)."""
+        return {
+            "model": self.cfg.model,
+            "batch_size": self.cfg.batch_size,
+            "buckets": list(self.bucket_spec.lengths),
+            "costs": self.costs.snapshot(),
+            "efficiency": self.meter.snapshot(),
+        }
+
+    def efficiency_snapshot(self) -> Dict[str, Any]:
+        """Rolling MFU/goodput map for telemetry heartbeats
+        (`utils/telemetry.py`); {} until the first batch lands."""
+        return self.meter.snapshot()
+
     def _place(self, ids: np.ndarray, mask: np.ndarray, *extra: np.ndarray):
         import jax.numpy as jnp
 
@@ -399,9 +446,10 @@ class InferenceEngine:
                 bucket_for(len(toks), self.bucket_spec), []).append(i)
 
         bs = self.cfg.batch_size
-        pending: Optional[tuple] = None  # (chunk, emb_dev, logits_dev, t0)
+        pending: Optional[tuple] = None  # (chunk, emb_dev, logits_dev, t0,
+        #                                  bucket, real_tokens)
 
-        def materialize(chunk, emb, logits, t0):
+        def materialize(chunk, emb, logits, t0, bucket, real_tokens):
             with trace.span("engine.unpack", rows=len(chunk)):
                 emb_np = np.asarray(emb)         # device->host sync
                 logits_np = np.asarray(logits)
@@ -409,7 +457,10 @@ class InferenceEngine:
                 # Under the pipeline this window ALSO contains the next
                 # batch's host-side pack+dispatch (which overlapped this
                 # batch's device time) — see the metric's help text.
-                self.m_latency.observe(time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                self.m_latency.observe(dt)
+                self.meter.record(dt, self._batch_flops(bucket, "unpacked"),
+                                  real_tokens, bs * bucket)
                 self.m_posts.inc(len(chunk))
                 self.m_padding.inc(bs - len(chunk))
                 scores = _softmax_np(logits_np)
@@ -432,15 +483,18 @@ class InferenceEngine:
                     ids, mask = pack_batch(
                         [token_lists[i] for i in chunk],
                         BucketSpec((bucket,)), batch_pad_to=bs)
+                real_tokens = int(mask.sum())
                 with trace.span("engine.device_put", bucket=bucket):
                     placed = self._place(ids, mask)
+                step = self._step(bucket)
                 t0 = time.perf_counter()
                 with trace.span("engine.compute", bucket=bucket, batch=bs,
                                 sequences=len(chunk)):
-                    emb, logits = self._step(bucket)(self.params, *placed)
+                    emb, logits = step(self.params, *placed)
+                self._capture_cost(bucket, "unpacked", step, placed)
                 if pending is not None:
                     materialize(*pending)
-                pending = (chunk, emb, logits, t0)
+                pending = (chunk, emb, logits, t0, bucket, real_tokens)
         if pending is not None:
             materialize(*pending)
         return results  # type: ignore[return-value]
@@ -483,14 +537,19 @@ class InferenceEngine:
                 bucket_for(len(toks), self.bucket_spec), []).append(i)
 
         bs = self.cfg.batch_size
-        pending: Optional[tuple] = None  # (slots, used, emb, logits, t0)
+        pending: Optional[tuple] = None  # (slots, used, emb, logits, t0,
+        #                                  bucket, real_tokens)
 
-        def materialize(slots, used_rows, emb, logits, t0):
+        def materialize(slots, used_rows, emb, logits, t0, bucket,
+                        real_tokens):
             with trace.span("engine.unpack", segments=len(slots),
                             rows=used_rows):
                 emb_np = np.asarray(emb)        # device->host sync
                 logits_np = np.asarray(logits)  # [bs, S, n_labels]
-                self.m_latency.observe(time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                self.m_latency.observe(dt)
+                self.meter.record(dt, self._batch_flops(bucket, "packed"),
+                                  real_tokens, bs * bucket)
                 self.m_posts.inc(len(slots))
                 self.m_packed.inc(len(slots))
                 self.m_padding.inc(bs - used_rows)
@@ -531,17 +590,20 @@ class InferenceEngine:
                 slots = [(r - start, s, orig)
                          for r in range(start, end)
                          for s, orig in enumerate(packed.assignments[r])]
+                real_tokens = int(mask.sum())
                 with trace.span("engine.device_put", bucket=bucket,
                                 packed=True):
                     placed = self._place(ids, mask, seg, pos)
+                step = self._packed_step(bucket)
                 t0 = time.perf_counter()
                 with trace.span("engine.compute", bucket=bucket, batch=bs,
                                 segments=len(slots), packed=True):
-                    emb, logits = self._packed_step(bucket)(
-                        self.params, *placed)
+                    emb, logits = step(self.params, *placed)
+                self._capture_cost(bucket, "packed", step, placed)
                 if pending is not None:
                     materialize(*pending)
-                pending = (slots, used, emb, logits, t0)
+                pending = (slots, used, emb, logits, t0, bucket,
+                           real_tokens)
         if pending is not None:
             materialize(*pending)
         return results  # type: ignore[return-value]

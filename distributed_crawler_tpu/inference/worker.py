@@ -32,14 +32,17 @@ from ..bus.messages import (
     WORKER_BUSY,
     WORKER_IDLE,
 )
-from ..utils import flight, trace
+from ..utils import flight, profiling, trace
 from ..utils.metrics import (
     REGISTRY,
     MetricsRegistry,
+    clear_costs_provider,
     clear_status_provider,
     serve_metrics,
+    set_costs_provider,
     set_status_provider,
 )
+from ..utils.slo import SLOWatchdog, standard_slos
 from ..utils.telemetry import TelemetryEmitter
 from .engine import InferenceEngine
 
@@ -92,6 +95,16 @@ class TPUWorkerConfig:
     # sequences share bucket rows behind segment masks.  Turn off for
     # long-sequence-dominated streams, where rows pack 1:1 anyway.
     pack: bool = True
+    # SLO budgets (`utils/slo.py`), evaluated once per heartbeat over the
+    # spans completed since the previous beat; 0 = no budget declared.
+    # Breaches count in slo_breach_total{slo}, WARN-log the offending
+    # trace_id, and land in the flight-recorder ring.
+    slo_batch_p95_ms: float = 0.0     # p95 of tpu_worker.process/coalesce
+    slo_queue_wait_ms: float = 0.0    # p95 of tpu_worker.queue_wait
+    # Auto profiler capture: a device batch slower than this many ms
+    # triggers one bounded jax.profiler capture to --dump-dir (one at a
+    # time; `utils/profiling.py`).  0 = off.
+    profile_on_slow_ms: float = 0.0
 
 
 class TPUWorker:
@@ -152,6 +165,14 @@ class TPUWorker:
         self._telemetry = TelemetryEmitter(
             engine=engine, include_device=True,
             counters={"batch_outcomes": self.m_outcomes})
+        # SLO watchdog: evaluated once per heartbeat over the spans since
+        # the last beat.  Constructed even with no budgets declared (an
+        # empty budget list evaluates to nothing) so /costs always shows
+        # the slo map.
+        self._slo = SLOWatchdog(
+            standard_slos(batch_p95_ms=cfg.slo_batch_p95_ms,
+                          queue_wait_ms=cfg.slo_queue_wait_ms),
+            registry=registry)
         # Capability probes, not flags: test doubles and older engines that
         # predate pack/coalescing keep working through the one-batch path.
         self._engine_coalesces = (
@@ -190,10 +211,21 @@ class TPUWorker:
             if self._started_at else 0.0,
         }
 
+    def get_costs(self) -> dict:
+        """The /costs body: the engine's cost/efficiency snapshot plus the
+        worker's SLO state and profiler-capture status."""
+        snap_fn = getattr(self.engine, "cost_snapshot", None)
+        out = dict(snap_fn()) if callable(snap_fn) else {}
+        out["worker_id"] = self.cfg.worker_id
+        out["slo"] = self._slo.snapshot()
+        out["profiler"] = profiling.PROFILER.snapshot()
+        return out
+
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         self._started_at = time.monotonic()
         set_status_provider(self.get_status)
+        set_costs_provider(self.get_costs)
         self.bus.subscribe(TOPIC_INFERENCE_BATCHES, self._handle_payload)
         self._start_watchdog()
         for target, name in ((self._feed_loop, "tpu-feed"),
@@ -207,27 +239,23 @@ class TPUWorker:
             # The pprof-endpoint analog (`main.go:60-80` served :6060
             # unconditionally): a jax.profiler gRPC server that
             # TensorBoard / `jax.profiler.trace` clients attach to for
-            # on-demand device traces.  Best-effort, like every other
-            # mode's profiler: a stale port must not kill the worker.
-            try:
-                import jax.profiler
-
-                jax.profiler.start_server(self.cfg.profiler_port)
-                self._profiler_started = True
-                logger.info("jax profiler serving", extra={
-                    "port": self.cfg.profiler_port})
-            except Exception as e:
-                logger.warning("profiler server failed to start: %s", e)
+            # on-demand device traces.  Guarded (`utils/profiling.py`):
+            # an unavailable or already-started profiler logs a WARNING
+            # instead of killing worker startup, and the same module's
+            # /profile capture shares jax's one profiler session.
+            self._profiler_started = profiling.start_profiler_server(
+                self.cfg.profiler_port)
         logger.info("tpu worker started", extra={
             "worker_id": self.cfg.worker_id,
             "model": self.engine.cfg.model})
 
     def stop(self, timeout_s: float = 10.0) -> None:
         self._stop.set()
-        # Unregister OUR /status provider (only if still active — another
+        # Unregister OUR providers (only if still active — another
         # component may have registered since) so a later server in this
-        # process 404s instead of serving a dead worker's map.
+        # process 404s instead of serving a dead worker's maps.
         clear_status_provider(self.get_status)
+        clear_costs_provider(self.get_costs)
         for t in self._threads:
             t.join(timeout=timeout_s)
         if self.provider is not None:
@@ -237,12 +265,7 @@ class TPUWorker:
         if self._metrics_server is not None:
             self._metrics_server.shutdown()
         if self._profiler_started:
-            import jax.profiler
-
-            try:
-                jax.profiler.stop_server()
-            except Exception as e:  # jax keeps a module-global server
-                logger.warning("profiler server stop failed: %s", e)
+            profiling.stop_profiler_server()
             self._profiler_started = False
 
     def drain(self, timeout_s: float = 30.0) -> bool:
@@ -349,7 +372,7 @@ class TPUWorker:
         if not good:
             return
         all_toks = [t for _, _, toks in good for t in toks]
-        self._step_started = time.monotonic()
+        started = self._step_started = time.monotonic()
         try:
             # The coalesce span runs under the FIRST batch's trace (one
             # device stream has one ambient context); the engine's stage
@@ -372,6 +395,8 @@ class TPUWorker:
         finally:
             self._step_started = None
             self._stall_warned = False
+            self._after_step(time.monotonic() - started,
+                             good[0][0].trace_id)
         if results is None:
             for batch, ack, toks in good:
                 self._process_tokenized(batch, ack, toks)
@@ -418,14 +443,48 @@ class TPUWorker:
         trace.record("tpu_worker.ack", time.perf_counter() - t0,
                      trace_id=batch.trace_id, batch=batch.batch_id, ok=ok)
 
-    def _run_step(self, fn):
+    def _run_step(self, fn, trace_id: str = ""):
         """Run a device step under the stall-watchdog bookkeeping."""
-        self._step_started = time.monotonic()
+        started = self._step_started = time.monotonic()
         try:
             return fn()
         finally:
             self._step_started = None
             self._stall_warned = False
+            self._after_step(time.monotonic() - started, trace_id)
+
+    def _after_step(self, elapsed_s: float, trace_id: str) -> None:
+        """Slow-batch hook (``--profile-on-slow-ms``): a device step past
+        the threshold fires ONE bounded auto profiler capture to
+        --dump-dir (skipped while a capture runs) and a flight event, so
+        the trace that explains the slowness exists before anyone asks.
+
+        Never raises: this runs in the serving path's ``finally`` — an
+        observability failure (e.g. thread exhaustion in capture_async)
+        must not nack an already-computed batch, nor mask the engine's
+        own exception in the coalesce path."""
+        try:
+            self._slow_batch_hook(elapsed_s, trace_id)
+        except Exception as e:
+            logger.warning("slow-batch hook failed: %s", e)
+
+    def _slow_batch_hook(self, elapsed_s: float, trace_id: str) -> None:
+        threshold = self.cfg.profile_on_slow_ms
+        elapsed_ms = elapsed_s * 1000.0
+        if threshold <= 0 or elapsed_ms < threshold:
+            return
+        fired = profiling.capture_async(
+            reason=f"slow batch {elapsed_ms:.0f}ms")
+        flight.record("slow_batch", worker=self.cfg.worker_id,
+                      elapsed_ms=round(elapsed_ms, 1),
+                      threshold_ms=threshold, trace_id=trace_id,
+                      profile_capture=fired)
+        logger.warning(
+            "device batch took %.0fms >= profile_on_slow_ms %.0fms "
+            "(trace=%s); auto profiler capture %s",
+            elapsed_ms, threshold, trace_id,
+            "started" if fired else "skipped (one already running)",
+            extra={"worker_id": self.cfg.worker_id})
 
     def _process_one(self, batch: RecordBatch, ack) -> None:
         def produce():
@@ -437,8 +496,11 @@ class TPUWorker:
                             records=len(batch.records)):
                 if self.cfg.pack and self._engine_run_packs:
                     return self._run_step(
-                        lambda: self.engine.run(batch.texts(), pack=True))
-                return self._run_step(lambda: self.engine.run(batch.texts()))
+                        lambda: self.engine.run(batch.texts(), pack=True),
+                        trace_id=batch.trace_id)
+                return self._run_step(
+                    lambda: self.engine.run(batch.texts()),
+                    trace_id=batch.trace_id)
 
         self._finish_batch(batch, ack, produce)
 
@@ -451,7 +513,8 @@ class TPUWorker:
                             batch=batch.batch_id, isolated=True):
                 return self._run_step(
                     lambda: self.engine.run_tokenized(toks,
-                                                      pack=self.cfg.pack))
+                                                      pack=self.cfg.pack),
+                    trace_id=batch.trace_id)
 
         self._finish_batch(batch, ack, produce)
 
@@ -569,6 +632,13 @@ class TPUWorker:
     # -- heartbeats --------------------------------------------------------
     def _heartbeat_loop(self) -> None:
         while not self._stop.is_set():
+            # SLO tick: digest the spans completed since the last beat
+            # against the declared budgets (WARN + counter + flight event
+            # per breach; no-op with no budgets declared).
+            try:
+                self._slo.evaluate()
+            except Exception as e:  # budget math must never kill the beat
+                logger.warning("slo evaluation failed: %s", e)
             status = WORKER_BUSY if not self._queue.empty() else WORKER_IDLE
             msg = StatusMessage.new(
                 self.cfg.worker_id, MSG_HEARTBEAT, status,

@@ -89,6 +89,14 @@ class FleetView:
             "over the worker's devices)")
         self.m_rss = registry.gauge(
             "fleet_worker_rss_bytes", "per-worker process RSS")
+        self.m_mfu = registry.gauge(
+            "fleet_worker_mfu",
+            "per-worker rolling MFU from the last heartbeat's efficiency "
+            "telemetry (utils/costmodel.py)")
+        self.m_goodput = registry.gauge(
+            "fleet_worker_goodput_tokens_per_s",
+            "per-worker rolling real-token throughput from the last "
+            "heartbeat")
         self.m_stale = registry.gauge(
             "fleet_stale_workers",
             "workers whose last heartbeat is older than the timeout")
@@ -159,6 +167,14 @@ class FleetView:
         rss = usage.get("rss_bytes")
         if isinstance(rss, (int, float)):
             self.m_rss.labels(worker_id=wid).set(float(rss))
+        efficiency = usage.get("efficiency")
+        if isinstance(efficiency, dict):
+            mfu = efficiency.get("mfu")
+            if isinstance(mfu, (int, float)):
+                self.m_mfu.labels(worker_id=wid).set(float(mfu))
+            goodput = efficiency.get("goodput_tokens_per_s")
+            if isinstance(goodput, (int, float)):
+                self.m_goodput.labels(worker_id=wid).set(float(goodput))
         devices = usage.get("device_memory")
         if isinstance(devices, list):
             sums = {"in_use": 0.0, "limit": 0.0, "peak": 0.0}
@@ -197,7 +213,8 @@ class FleetView:
                 elif t.status != WORKER_OFFLINE and age > self.stale_after_s:
                     stale += 1
         for wid in evicted:
-            for gauge in (self.m_queue, self.m_rss):
+            for gauge in (self.m_queue, self.m_rss, self.m_mfu,
+                          self.m_goodput):
                 gauge.remove_labels(worker_id=wid)
             for kind in ("in_use", "limit", "peak"):
                 self.m_devmem.remove_labels(worker_id=wid, kind=kind)

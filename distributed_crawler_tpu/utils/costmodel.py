@@ -1,0 +1,330 @@
+# crawlint: disable-file=TRC — every jax touch in this module is a
+# HOST-SIDE compile-time hook by design: it lowers/inspects programs
+# (`Lowered.cost_analysis()`), it never runs inside a traced region.
+"""Hardware-efficiency cost accounting: what a batch costs vs what the
+chip could do.
+
+The north star says "as fast as the hardware allows", but until now the
+only process that knew a batch's FLOPs was `bench.py` — and only while a
+bench was running.  This module makes cost a first-class serving signal:
+
+- :func:`encoder_forward_flops` — the analytic forward-FLOP count for one
+  embed+classify batch, promoted out of `bench.py` so the bench and every
+  running worker share ONE formula.
+- :class:`CostModel` — per-(bucket, path) compiled cost captured at the
+  engine's first dispatch of each program (`inference/engine.py`
+  `_step`/`_packed_step` call sites): XLA's own numbers via
+  ``lowered.cost_analysis()`` (tracing-cheap — no second XLA compile;
+  ``lowered.compile().cost_analysis()`` is tried only as a fallback,
+  where jax's executable caches make it near-free because the dispatch
+  that triggered the capture just paid the compile) with the analytic
+  count as the final fallback.  Exposed as ``tpu_engine_bucket_flops``
+  gauges and the ``/costs`` endpoint (`utils/metrics.py`).
+- :func:`peak_flops` — the per-device dense-bf16 peak table (promoted
+  from `bench.py`), with a conservative CPU estimate so the MFU pipeline
+  stays exercised end to end in CPU tests and deployments.
+- :class:`EfficiencyMeter` — rolling-window goodput/MFU accounting over
+  dispatched batches: real vs pad tokens, achieved FLOP/s vs peak,
+  exported as ``tpu_engine_mfu`` / ``tpu_engine_goodput_tokens_per_s`` /
+  ``tpu_engine_padding_density`` gauges and carried in telemetry
+  heartbeats so the orchestrator's `/cluster` view shows per-worker
+  efficiency.
+
+Everything here is guarded: a backend without cost analysis, a missing
+jax, or a wedged chip degrades to analytic numbers — never to a raise in
+the serving path.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from .metrics import REGISTRY, MetricsRegistry
+
+logger = logging.getLogger("dct.costmodel")
+
+# Dense bf16 peak per chip, by jax device_kind substring — ONE table for
+# bench.py and the serving meters (it used to live in bench.py where no
+# running worker could see it).
+PEAK_BF16_FLOPS: List[Tuple[str, float]] = [
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5p", 459e12),
+    ("v6 lite", 918e12), ("v6e", 918e12), ("v4", 275e12), ("v3", 123e12),
+]
+
+# Conservative per-host CPU peak (a few AVX cores' worth of f32 FMA).
+# Deliberately low-precision: its job is to keep the MFU path exercised
+# (and roughly comparable run-to-run) on CPU backends, clearly labelled
+# ``peak_source: "cpu_estimate"`` — never to claim a real utilisation.
+CPU_PEAK_FLOPS_ESTIMATE = 5e11
+
+
+def encoder_forward_flops(cfg, batch: int, seq: int) -> float:
+    """Analytic forward FLOPs for one embed+classify batch.
+
+    Per token per layer: QKV+out projections (8·d²), attention score+value
+    matmuls (4·seq·d), MLP up+down (4·d·ff); multiply-accumulate counted as
+    2 FLOPs.  Embedding lookup and the d×n_labels head are negligible.
+    """
+    d, ff, L = cfg.hidden, cfg.mlp_dim, cfg.n_layers
+    per_token = L * (8 * d * d + 4 * seq * d + 4 * d * ff)
+    return float(batch * seq * per_token)
+
+
+def peak_flops(device_kind: str = "", platform: str = "",
+               n_devices: int = 1) -> Tuple[float, str]:
+    """(aggregate peak FLOP/s over ``n_devices``, source tag).
+
+    TPU kinds resolve through :data:`PEAK_BF16_FLOPS`; CPU gets the
+    conservative estimate; anything else returns (0, "unknown") so MFU is
+    omitted rather than invented.
+    """
+    kind = (device_kind or "").lower()
+    n = max(1, int(n_devices))
+    if platform == "tpu":
+        for sub, peak in PEAK_BF16_FLOPS:
+            if sub in kind:
+                return peak * n, f"tpu:{sub}"
+        return 0.0, "unknown"
+    if platform == "cpu":
+        return CPU_PEAK_FLOPS_ESTIMATE, "cpu_estimate"
+    return 0.0, "unknown"
+
+
+def default_peak_flops() -> Tuple[float, str]:
+    """Peak for the ALREADY-IMPORTED jax's default backend; (0, "unknown")
+    when jax isn't loaded — same never-import rule as
+    `utils/telemetry.py:device_memory_stats` (a crawl worker's heartbeat
+    must not pay the jax import)."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return 0.0, "unknown"
+    try:
+        devices = jax.devices()
+        return peak_flops(devices[0].device_kind, jax.default_backend(),
+                          len(devices))
+    except Exception as e:  # a wedged backend must not kill telemetry
+        logger.debug("peak-FLOPs resolution failed: %s", e)
+        return 0.0, "unknown"
+
+
+def _analysis_dict(analysis: Any) -> Optional[Dict[str, Any]]:
+    """`cost_analysis()` has returned both a dict and a 1-element list of
+    dicts across jax versions; normalize to the dict (or None)."""
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    return analysis if isinstance(analysis, dict) else None
+
+
+class CostModel:
+    """Per-(bucket, path) compiled cost, captured once at first dispatch.
+
+    ``capture()`` is called from the engine's dispatch loop right after
+    the program's first call (which paid the XLA compile); it is
+    idempotent, thread-safe, and never raises into serving.
+    """
+
+    def __init__(self, registry: MetricsRegistry = REGISTRY):
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self.m_bucket_flops = registry.gauge(
+            "tpu_engine_bucket_flops",
+            "forward FLOPs of one compiled (bucket, path) batch program "
+            "(XLA cost_analysis when available, analytic fallback)")
+
+    def has(self, bucket: int, path: str) -> bool:
+        with self._lock:
+            return (str(bucket), path) in self._entries
+
+    def capture(self, bucket: int, path: str, lower_fn,
+                fallback_flops: float, batch: int = 0,
+                seq: int = 0) -> Dict[str, Any]:
+        """Record the (bucket, path) program's cost.
+
+        ``lower_fn`` is a zero-arg callable returning the program's
+        ``jax.stages.Lowered`` (e.g. ``lambda: fn.lower(params, *args)``
+        — tracing only, the compile was already paid by the dispatch that
+        triggered this capture).  Any failure anywhere degrades to the
+        analytic ``fallback_flops``.
+        """
+        key = (str(bucket), path)
+        with self._lock:
+            got = self._entries.get(key)
+            if got is not None:
+                return got
+        entry: Dict[str, Any] = {
+            "bucket": int(bucket), "path": path,
+            "batch": int(batch), "seq": int(seq or bucket),
+            "flops": float(fallback_flops), "bytes_accessed": None,
+            "source": "analytic", "captured_at": time.time(),
+        }
+        try:
+            lowered = lower_fn()
+            analysis = _analysis_dict(lowered.cost_analysis())
+            if analysis is None:
+                # Unoptimized-HLO analysis unavailable on this backend;
+                # the executable variant hits jax's compile caches (the
+                # live program just compiled) so this is near-free.
+                analysis = _analysis_dict(lowered.compile().cost_analysis())
+            if analysis is not None:
+                flops = analysis.get("flops")
+                if isinstance(flops, (int, float)) and flops > 0:
+                    entry["flops"] = float(flops)
+                    entry["source"] = "xla"
+                ba = analysis.get("bytes accessed")
+                if isinstance(ba, (int, float)) and ba > 0:
+                    entry["bytes_accessed"] = float(ba)
+        except Exception as e:
+            logger.debug("cost_analysis unavailable for bucket=%s path=%s: "
+                         "%s (using analytic count)", bucket, path, e)
+        with self._lock:
+            entry = self._entries.setdefault(key, entry)
+        self.m_bucket_flops.labels(bucket=str(bucket),
+                                   path=path).set(entry["flops"])
+        return entry
+
+    def flops_for(self, bucket: int, path: str,
+                  default: float = 0.0) -> float:
+        with self._lock:
+            entry = self._entries.get((str(bucket), path))
+        return float(entry["flops"]) if entry else default
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """Entries sorted by (path, bucket) — the /costs body's core."""
+        with self._lock:
+            entries = list(self._entries.values())
+        return sorted((dict(e) for e in entries),
+                      key=lambda e: (e["path"], e["bucket"]))
+
+
+class EfficiencyMeter:
+    """Rolling-window goodput/MFU over dispatched batches.
+
+    One record per device batch: wall time, dispatch→host duration, the
+    program's FLOPs, and the real-vs-slot token split.  The window is
+    time-bounded (``window_s``) so the gauges answer "how efficient is
+    serving NOW", not "since process start".
+
+    MFU here is *achieved FLOP/s over the wall window* vs peak — it
+    includes idle gaps between batches, which is the serving-utilisation
+    number an operator wants (a chip that computes at 60% MFU for 1 s
+    out of every 10 is a 6% chip).  ``mfu_busy`` (over summed batch
+    durations only) is also reported for kernel-efficiency reads.
+    """
+
+    def __init__(self, registry: MetricsRegistry = REGISTRY,
+                 window_s: float = 60.0, max_records: int = 1024,
+                 peak: Optional[float] = None, peak_source: str = ""):
+        self.window_s = window_s
+        self._records: "deque[Tuple[float, float, float, int, int]]" = \
+            deque(maxlen=max_records)
+        self._ever_recorded = False
+        self._lock = threading.Lock()
+        # Peak injected for tests; resolved lazily from the live backend
+        # otherwise (the engine imports jax long before the first batch).
+        self._peak = peak
+        self._peak_source = peak_source
+        self.m_mfu = registry.gauge(
+            "tpu_engine_mfu",
+            "rolling-window achieved FLOP/s over peak (wall-clock window "
+            "incl. idle; 0 when peak is unknown)")
+        self.m_goodput = registry.gauge(
+            "tpu_engine_goodput_tokens_per_s",
+            "rolling-window REAL (non-pad) tokens per second")
+        self.m_density = registry.gauge(
+            "tpu_engine_padding_density",
+            "rolling-window real tokens / dispatched slot tokens")
+
+    def _resolve_peak(self) -> Tuple[float, str]:
+        if self._peak is None:
+            self._peak, self._peak_source = default_peak_flops()
+        return self._peak, self._peak_source
+
+    def record(self, duration_s: float, flops: float,
+               real_tokens: int, slot_tokens: int) -> None:
+        """Account one device batch; updates the three gauges."""
+        now = time.monotonic()
+        with self._lock:
+            self._ever_recorded = True
+            self._records.append((now, float(duration_s), float(flops),
+                                  int(real_tokens), int(slot_tokens)))
+            self._prune(now)
+        self.snapshot()  # refreshes the gauges as a side effect
+
+    def _prune(self, now: float) -> None:
+        cutoff = now - self.window_s
+        while self._records and self._records[0][0] < cutoff:
+            self._records.popleft()
+
+    def _window_totals(self) -> Tuple[int, float, float, float, int, int]:
+        """(batches, span_s, busy_s, flops, real, slot) under the lock."""
+        now = time.monotonic()
+        with self._lock:
+            self._prune(now)
+            records = list(self._records)
+        if not records:
+            return 0, 0.0, 0.0, 0.0, 0, 0
+        busy = sum(r[1] for r in records)
+        flops = sum(r[2] for r in records)
+        real = sum(r[3] for r in records)
+        slot = sum(r[4] for r in records)
+        # Window span: oldest dispatch start to now, floored by busy time
+        # (a single just-landed batch must not divide by ~0 wall).
+        span = max(now - (records[0][0] - records[0][1]), busy, 1e-9)
+        return len(records), span, busy, flops, real, slot
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The telemetry-heartbeat / /costs ``efficiency`` map, refreshing
+        the gauges as a side effect (heartbeats call this every beat, so
+        the gauges DECAY to 0 when the batch stream stops instead of
+        freezing at the last busy window's value).  {} until the first
+        batch ever lands, so never-fed workers don't report fantasy 0s —
+        but a worker that went idle genuinely IS at MFU 0."""
+        n, span, busy, flops, real, slot = self._window_totals()
+        with self._lock:
+            ever = self._ever_recorded
+        if n == 0:
+            if not ever:
+                return {}
+            idle = {
+                "window_s": self.window_s, "batches": 0,
+                "achieved_flops_per_s": 0.0,
+                "goodput_tokens_per_s": 0.0,
+                "real_tokens": 0, "slot_tokens": 0,
+                "padding_density": None,
+                "mfu": 0.0 if self._resolve_peak()[0] else None,
+                "mfu_busy": None,
+                "peak_flops_per_s": self._resolve_peak()[0] or None,
+                "peak_source": self._resolve_peak()[1],
+            }
+            self._set_gauges(idle)
+            return idle
+        peak, source = self._resolve_peak()
+        achieved = flops / span
+        out: Dict[str, Any] = {
+            "window_s": round(span, 3),
+            "batches": n,
+            "achieved_flops_per_s": round(achieved, 1),
+            "goodput_tokens_per_s": round(real / span, 1),
+            "real_tokens": real,
+            "slot_tokens": slot,
+            "padding_density": round(real / slot, 4) if slot else None,
+            "peak_flops_per_s": peak or None,
+            "peak_source": source,
+            # 6 decimals: a tiny-model CPU window has a REAL mfu of ~1e-5
+            # and must not round to a dead-chip-looking 0.0.
+            "mfu": round(achieved / peak, 6) if peak else None,
+            "mfu_busy": round(flops / busy / peak, 6)
+            if peak and busy > 0 else None,
+        }
+        self._set_gauges(out)
+        return out
+
+    def _set_gauges(self, snap: Dict[str, Any]) -> None:
+        self.m_mfu.set(snap.get("mfu") or 0.0)
+        self.m_goodput.set(snap.get("goodput_tokens_per_s") or 0.0)
+        self.m_density.set(snap.get("padding_density") or 0.0)

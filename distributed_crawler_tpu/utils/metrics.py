@@ -313,6 +313,26 @@ def clear_cluster_provider(fn) -> None:
         _cluster_provider = None
 
 
+# Late-bound /costs provider: the engine's hardware-efficiency view
+# (`utils/costmodel.py`) — per-bucket compiled FLOPs/bytes, rolling
+# MFU/goodput, SLO budgets + breach counts.
+_costs_provider = None
+
+
+def set_costs_provider(fn) -> None:
+    """Register the zero-arg dict provider served at /costs (pass None
+    to clear)."""
+    global _costs_provider
+    _costs_provider = fn
+
+
+def clear_costs_provider(fn) -> None:
+    """Unregister ``fn`` only if it is still the active provider."""
+    global _costs_provider
+    if _costs_provider == fn:
+        _costs_provider = None
+
+
 class _Handler(BaseHTTPRequestHandler):
     registry: MetricsRegistry = REGISTRY
 
@@ -355,6 +375,35 @@ class _Handler(BaseHTTPRequestHandler):
                 # request (no retry loop server-side).
                 code = 500
                 body = _json.dumps({"error": str(e)}).encode("utf-8")
+            ctype = "application/json"
+        elif path == "/costs" and _costs_provider is not None:
+            # The engine's cost/efficiency view (`utils/costmodel.py`):
+            # per-bucket compiled FLOPs, rolling MFU/goodput, SLO state —
+            # rendered by tools/perfreport.py.
+            import json as _json
+
+            try:
+                body = _json.dumps(_costs_provider(),
+                                   default=str).encode("utf-8")
+            except Exception as e:
+                code = 500
+                body = _json.dumps({"error": str(e)}).encode("utf-8")
+            ctype = "application/json"
+        elif path == "/profile":
+            # Guarded on-demand jax.profiler capture
+            # (`utils/profiling.py`): blocks THIS request thread for the
+            # bounded window, one capture at a time process-wide; the
+            # trace bundle lands under --dump-dir.
+            import json as _json
+            from urllib.parse import parse_qs as _parse_qs
+
+            from . import profiling as _profiling
+
+            query = self.path.partition("?")[2]
+            seconds = _parse_qs(query).get("seconds", ["1"])[0]
+            result = _profiling.capture(seconds)
+            code = int(result.pop("code", 200 if result.get("ok") else 500))
+            body = _json.dumps(result).encode("utf-8")
             ctype = "application/json"
         elif path == "/cluster" and _cluster_provider is not None:
             # The orchestrator's fleet view: per-worker last-seen, status

@@ -1,0 +1,241 @@
+"""On-demand jax.profiler capture + guarded trace-server startup.
+
+Two complementary profiler surfaces, one module so their interplay is in
+one place:
+
+- **Trace server** (``--profiler-port``): the long-lived
+  ``jax.profiler.start_server`` that TensorBoard / remote
+  ``jax.profiler.trace`` clients ATTACH to — the reference's always-on
+  ``:6060`` pprof analog.  :func:`start_profiler_server` wraps it so an
+  unavailable or already-started profiler logs a WARNING instead of
+  crashing worker startup (jax keeps one module-global server; a second
+  start in the same process raises).
+- **On-demand capture** (``/profile?seconds=N`` on the metrics port, and
+  ``--profile-on-slow-ms`` auto-capture): :class:`ProfileCapture` runs
+  ``jax.profiler.start_trace``/``stop_trace`` around a bounded sleep and
+  writes the trace bundle under ``--dump-dir`` — no TensorBoard client
+  needed, the bundle lands on disk next to the postmortem bundles.
+
+The two share jax's single profiler session: a ``/profile`` capture while
+a remote trace-server client is mid-capture (or vice versa) fails with
+jax's "Only one profile may be run at a time" — surfaced here as a clear
+409/error instead of an exception in the serving path.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger("dct.profiling")
+
+DEFAULT_MAX_SECONDS = 60.0   # bound on one /profile capture
+DEFAULT_SECONDS = 3.0        # auto-capture window for --profile-on-slow-ms
+DEFAULT_MAX_KEEP = 8         # trace bundles retained under dump_dir
+
+
+class ProfileCapture:
+    """Guarded one-at-a-time jax.profiler trace capture to a dump dir."""
+
+    def __init__(self, dump_dir: str = "",
+                 max_seconds: float = DEFAULT_MAX_SECONDS,
+                 max_keep: int = DEFAULT_MAX_KEEP):
+        self._lock = threading.Lock()
+        self._active = False
+        self.dump_dir = dump_dir
+        self.max_seconds = max_seconds
+        self.max_keep = max_keep
+        self.captures = 0          # completed captures (for /costs + tests)
+        self.last_path = ""
+
+    def configure(self, dump_dir: Optional[str] = None,
+                  max_seconds: Optional[float] = None) -> None:
+        with self._lock:
+            if dump_dir is not None:
+                self.dump_dir = dump_dir
+            if max_seconds is not None:
+                self.max_seconds = max_seconds
+
+    @property
+    def active(self) -> bool:
+        with self._lock:
+            return self._active
+
+    def capture(self, seconds: float) -> Dict[str, Any]:
+        """Run one bounded capture; returns a JSON-safe result map with an
+        HTTP-shaped ``code`` (200 ok / 400 bad request / 409 already
+        running / 503 profiler unavailable).  Never raises."""
+        try:
+            seconds = float(seconds)
+        except (TypeError, ValueError):
+            return {"ok": False, "code": 400,
+                    "error": "seconds must be a number"}
+        if not seconds > 0:  # also rejects NaN
+            return {"ok": False, "code": 400,
+                    "error": "seconds must be > 0"}
+        seconds = min(seconds, self.max_seconds)
+        if not self.dump_dir:
+            return {"ok": False, "code": 503,
+                    "error": "no --dump-dir configured (profile bundles "
+                             "need somewhere to land)"}
+        with self._lock:
+            if self._active:
+                return {"ok": False, "code": 409,
+                        "error": "a profiler capture is already running "
+                                 "(one at a time)"}
+            self._active = True
+        path = os.path.join(
+            self.dump_dir,
+            f"profile_{time.strftime('%Y%m%d%H%M%S', time.gmtime())}"
+            f"_{os.getpid()}")
+        started = False
+        try:
+            import jax.profiler
+
+            os.makedirs(path, exist_ok=True)
+            jax.profiler.start_trace(path)
+            started = True
+            time.sleep(seconds)
+        except Exception as e:
+            # Covers: no jax, a backend that can't profile, AND jax's
+            # module-global "Only one profile may be run at a time" when a
+            # remote trace-server client holds the session.
+            return {"ok": False, "code": 503,
+                    "error": f"profiler capture failed to start: {e}"}
+        finally:
+            if started:
+                try:
+                    import jax.profiler
+
+                    jax.profiler.stop_trace()
+                except Exception as e:  # half-open session: report, move on
+                    logger.warning("profiler stop_trace failed: %s", e)
+            with self._lock:
+                self._active = False
+        with self._lock:
+            self.captures += 1
+            self.last_path = path
+        self._prune_old()
+        logger.info("profiler capture written", extra={
+            "path": path, "seconds": seconds})
+        from . import flight
+
+        flight.record("profile_capture", path=path, seconds=seconds)
+        return {"ok": True, "code": 200, "path": path, "seconds": seconds}
+
+    def capture_async(self, seconds: float = DEFAULT_SECONDS,
+                      reason: str = "") -> bool:
+        """Fire-and-forget capture (the ``--profile-on-slow-ms`` path);
+        returns False without spawning when one is already running — a
+        stream of slow batches must produce one bundle, not a thread
+        storm — or when no dump dir is configured (a capture that can
+        never land must not report 'started' to the slow-batch log and
+        flight events, nor spawn a doomed thread per slow batch)."""
+        with self._lock:
+            if self._active or not self.dump_dir:
+                return False
+        def run():
+            result = self.capture(seconds)
+            if not result.get("ok"):
+                logger.warning("auto profiler capture (%s) failed: %s",
+                               reason or "slow batch", result.get("error"))
+        threading.Thread(target=run, daemon=True,
+                         name="profile-capture").start()
+        return True
+
+    def _prune_old(self) -> None:
+        """Keep only the newest ``max_keep`` trace bundles: /profile is
+        side-effectful, and a dashboard probing it every scrape would
+        otherwise fill the dump dir (shared with the crash postmortems)
+        with multi-MB bundles until the host degrades.  Best-effort."""
+        if self.max_keep <= 0 or not self.dump_dir:
+            return
+        try:
+            import shutil
+
+            bundles = sorted(
+                e for e in os.listdir(self.dump_dir)
+                if e.startswith("profile_")
+                and os.path.isdir(os.path.join(self.dump_dir, e)))
+            for stale in bundles[:-self.max_keep]:
+                shutil.rmtree(os.path.join(self.dump_dir, stale),
+                              ignore_errors=True)
+        except OSError as e:
+            logger.debug("profile-bundle pruning skipped: %s", e)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"active": self._active, "captures": self.captures,
+                    "last_path": self.last_path,
+                    "dump_dir": self.dump_dir,
+                    "max_seconds": self.max_seconds,
+                    "max_keep": self.max_keep}
+
+
+PROFILER = ProfileCapture()
+
+
+# Module-level conveniences delegating to the process-wide capture guard
+# at CALL time (not bound at import), so tests can swap PROFILER.
+def configure(dump_dir: Optional[str] = None,
+              max_seconds: Optional[float] = None) -> None:
+    PROFILER.configure(dump_dir=dump_dir, max_seconds=max_seconds)
+
+
+def capture(seconds: float) -> Dict[str, Any]:
+    return PROFILER.capture(seconds)
+
+
+def capture_async(seconds: float = DEFAULT_SECONDS,
+                  reason: str = "") -> bool:
+    return PROFILER.capture_async(seconds, reason=reason)
+
+
+_server_lock = threading.Lock()
+_server_port: Optional[int] = None
+
+
+def start_profiler_server(port: int) -> bool:
+    """Start the long-lived jax.profiler trace server; best-effort.
+
+    Guards the two startup hazards that must never kill a worker: jax (or
+    its profiler) being unavailable, and a DUPLICATE start — jax keeps one
+    module-global server, so a second ``start_server`` in the same
+    process raises.  Both log a WARNING and return False.
+    """
+    global _server_port
+    with _server_lock:
+        if _server_port is not None:
+            logger.warning(
+                "profiler server already running on port %d; ignoring "
+                "second start on port %d (jax keeps one per process)",
+                _server_port, port)
+            return False
+        try:
+            import jax.profiler
+
+            jax.profiler.start_server(port)
+        except Exception as e:
+            logger.warning("profiler server failed to start: %s", e)
+            return False
+        _server_port = port
+    logger.info("jax profiler serving", extra={"port": port})
+    return True
+
+
+def stop_profiler_server() -> None:
+    """Stop the trace server if this process started one; best-effort."""
+    global _server_port
+    with _server_lock:
+        if _server_port is None:
+            return
+        try:
+            import jax.profiler
+
+            jax.profiler.stop_server()
+        except Exception as e:  # jax keeps a module-global server
+            logger.warning("profiler server stop failed: %s", e)
+        _server_port = None

@@ -12,6 +12,8 @@ of the process and device state that matters at TPU-serving scale —
   already imported it — a crawl worker never pays the import),
 - compile-cache activity deltas (engine ``compile_cache_stats()``): a
   nonzero delta between heartbeats means live batches paid XLA compiles,
+- the engine's rolling efficiency meters (MFU, goodput tokens/s, padding
+  density — `utils/costmodel.py`) when the engine exposes them,
 - labeled-counter counts (e.g. batch outcomes by ok/error/requeued),
 - a per-stage latency digest (p50/p95/max per span name) over the spans
   completed since the previous snapshot, computed from the PR-2 trace ring.
@@ -138,6 +140,14 @@ class TelemetryEmitter:
                 stats["misses_delta"] = \
                     misses - prev if prev is not None else misses
                 out["compile_cache"] = stats
+            eff_fn = getattr(self.engine, "efficiency_snapshot", None)
+            if callable(eff_fn):
+                # Rolling MFU/goodput/padding-density from the engine's
+                # EfficiencyMeter (`utils/costmodel.py`) — {} until the
+                # first batch, so idle workers don't heartbeat zeros.
+                eff = eff_fn()
+                if eff:
+                    out["efficiency"] = eff
         for key, counter in self.counters.items():
             series = getattr(counter, "series", None)
             if not callable(series):

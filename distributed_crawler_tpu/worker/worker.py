@@ -45,6 +45,7 @@ from ..bus.messages import (
 from ..config.crawler import CrawlerConfig
 from ..crawl import runner as crawl_runner
 from ..utils import flight, trace
+from ..utils.slo import SLOWatchdog, standard_slos
 from ..utils.telemetry import TelemetryEmitter
 from ..state.datamodels import PAGE_PROCESSING, Page, new_id, utcnow
 
@@ -83,6 +84,11 @@ def work_item_config_to_crawler_config(config: WorkItemConfig,
 class WorkerConfig:
     worker_id: str = ""
     heartbeat_s: float = 30.0  # `worker.go:237`
+    # SLO budget on the worker.process span's p95 (`utils/slo.py`),
+    # evaluated once per heartbeat; 0 = no budget declared.  The crawl
+    # worker's unit of work is a crawl item, so this is the crawl-latency
+    # twin of the TPU worker's batch budget.
+    slo_batch_p95_ms: float = 0.0
 
 
 class CrawlWorker:
@@ -107,6 +113,9 @@ class CrawlWorker:
         # Telemetry-rich heartbeats (RSS, latency digest; device stats only
         # if this process already runs jax — the emitter never imports it).
         self._telemetry = TelemetryEmitter()
+        # SLO watchdog over worker.process p95; empty with no budget.
+        self._slo = SLOWatchdog(standard_slos(
+            batch_p95_ms=self.wcfg.slo_batch_p95_ms))
         self._mu = threading.RLock()
         self._running = False
         self._threads: List[threading.Thread] = []
@@ -152,6 +161,12 @@ class CrawlWorker:
                 time.sleep(0.05)
             if not self.is_running:
                 return
+            try:
+                # SLO tick: spans completed since the last beat vs the
+                # declared budget (no-op without one).
+                self._slo.evaluate()
+            except Exception as e:
+                logger.warning("slo evaluation failed: %s", e)
             self.send_status_update(MSG_HEARTBEAT, self.determine_status(),
                                     telemetry=True)
 

@@ -561,6 +561,64 @@ class TestSuppressionAndBaseline:
         """}, select=["EXC"])
         assert codes(rep) == ["EXC001"]
 
+    def test_file_pragma_exempts_whole_checker_family(self, tmp_path):
+        # `disable-file=TRC` (a checker prefix): every TRC finding in the
+        # module is suppressed, wherever it is — the exemption
+        # utils/costmodel.py declares for its host-side compile hooks.
+        rep = analyze(tmp_path, {"a.py": """
+            # crawlint: disable-file=TRC
+            import jax
+
+            @jax.jit
+            def f(x):
+                print(x)
+                return x
+
+            @jax.jit
+            def g(x):
+                print(x)
+                return x
+        """}, select=["TRC"])
+        assert codes(rep) == []
+        assert rep.suppressed == 2
+
+    def test_file_pragma_specific_code_only(self, tmp_path):
+        # `disable-file=TRC001`: other codes in the family still fire.
+        rep = analyze(tmp_path, {"a.py": """
+            # crawlint: disable-file=TRC001
+            import time
+
+            import jax
+
+            @jax.jit
+            def f(x):
+                print(x)
+                time.time()
+                return x
+        """}, select=["TRC"])
+        assert codes(rep) == ["TRC002"]
+        assert rep.suppressed == 1
+
+    def test_file_pragma_other_family_unaffected(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            # crawlint: disable-file=TRC
+            def work(item):
+                try:
+                    item.process()
+                except Exception:
+                    pass
+        """}, select=["EXC"])
+        assert codes(rep) == ["EXC001"]
+
+    def test_file_pragma_line_does_not_line_suppress(self, tmp_path):
+        # The disable-file marker must not double as a bare line-level
+        # `disable` (which would silently suppress every code on its own
+        # line).
+        from tools.analyze.core import scan_suppressions
+
+        assert scan_suppressions(
+            ["x = 1  # crawlint: disable-file=TRC"]) == {}
+
     def test_baseline_grandfathers_then_ratchets(self, tmp_path):
         src = {"a.py": """
             def work(item):

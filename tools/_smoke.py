@@ -31,9 +31,9 @@ def selfcheck() -> int:
     compile the whole package (catches syntax/indentation rot in modules no
     test imports), run crawlint (`python -m tools.analyze`; the
     repo-native static checkers, docs/static-analysis.md), the
-    postmortem renderer's selfcheck, then the metrics + tracing + fleet
-    unit tests the other tools' /metrics, /traces, and /cluster reads
-    depend on."""
+    postmortem + perfreport renderers' selfchecks, then the metrics +
+    tracing + fleet + perf-observability unit tests the other tools'
+    /metrics, /traces, /cluster, and /costs reads depend on."""
     import compileall
     import subprocess
 
@@ -52,11 +52,18 @@ def selfcheck() -> int:
     if rc != 0:
         print("postmortem selfcheck FAILED", file=sys.stderr)
         return rc
+    rc = subprocess.call(
+        [sys.executable, os.path.join(repo, "tools", "perfreport.py"),
+         "--selfcheck"], cwd=repo)
+    if rc != 0:
+        print("perfreport selfcheck FAILED", file=sys.stderr)
+        return rc
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     return subprocess.call(
         [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
          os.path.join(repo, "tests", "test_metrics_trace.py"),
-         os.path.join(repo, "tests", "test_fleet_telemetry.py")],
+         os.path.join(repo, "tests", "test_fleet_telemetry.py"),
+         os.path.join(repo, "tests", "test_perf_observability.py")],
         env=env, cwd=repo)
 
 

@@ -82,7 +82,19 @@ class Finding:
 # ---------------------------------------------------------------------------
 
 _SUPPRESS_RE = re.compile(
-    r"#\s*crawlint:\s*disable(?:=([A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*))?")
+    r"#\s*crawlint:\s*disable(?!-file)"
+    r"(?:=([A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*))?")
+
+# Module-wide exemption: `# crawlint: disable-file=TRC` (a checker
+# prefix) or `=TRC003,LCK002` (specific codes) anywhere in the file —
+# for modules whose whole PURPOSE trips a checker (e.g.
+# `utils/costmodel.py`, whose compile-time lowering hooks are host-side
+# by design and must never grow TRC findings as they evolve).  Scoped
+# pragmas stay preferred; a file pragma is a declared property of the
+# module, and suppressions are still counted in the report.
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*crawlint:\s*disable-file="
+    r"([A-Z]{3}(?:\d{3})?(?:\s*,\s*[A-Z]{3}(?:\d{3})?)*)")
 
 
 @dataclass
@@ -95,8 +107,13 @@ class ModuleInfo:
     imports: Dict[str, str] = field(default_factory=dict)
     # line -> set of suppressed codes (empty set = all codes suppressed)
     suppressions: Dict[int, set] = field(default_factory=dict)
+    # codes/checker-prefixes exempted module-wide (`disable-file=`)
+    file_suppressions: set = field(default_factory=set)
 
     def suppressed(self, finding: Finding) -> bool:
+        if finding.code in self.file_suppressions \
+                or finding.code[:3] in self.file_suppressions:
+            return True
         codes = self.suppressions.get(finding.line)
         if codes is None:
             return False
@@ -187,6 +204,16 @@ def scan_suppressions(source_lines: Sequence[str]) -> Dict[int, set]:
     return out
 
 
+def scan_file_suppressions(source_lines: Sequence[str]) -> set:
+    """Codes / checker prefixes from every ``disable-file=`` pragma."""
+    out: set = set()
+    for line in source_lines:
+        m = _SUPPRESS_FILE_RE.search(line)
+        if m:
+            out |= {c.strip() for c in m.group(1).split(",")}
+    return out
+
+
 def parse_module(abspath: str, relpath: str) -> Optional[ModuleInfo]:
     try:
         with open(abspath, "r", encoding="utf-8") as f:
@@ -198,7 +225,8 @@ def parse_module(abspath: str, relpath: str) -> Optional[ModuleInfo]:
     lines = source.splitlines()
     return ModuleInfo(path=relpath, tree=tree, source_lines=lines,
                       imports=build_import_map(tree),
-                      suppressions=scan_suppressions(lines))
+                      suppressions=scan_suppressions(lines),
+                      file_suppressions=scan_file_suppressions(lines))
 
 
 # ---------------------------------------------------------------------------

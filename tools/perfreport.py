@@ -1,0 +1,242 @@
+#!/usr/bin/env python
+"""Render a one-page hardware-efficiency report from a live worker.
+
+Usage:
+    python tools/perfreport.py http://127.0.0.1:9102   # live worker
+    python tools/perfreport.py --selfcheck             # CI smoke
+
+Fetches the three observability surfaces a serving worker exports —
+``/costs`` (per-bucket compiled FLOPs + rolling MFU/goodput + SLO state,
+`utils/costmodel.py`), ``/metrics`` (the Prometheus exposition), and
+``/traces`` (the span ring) — and prints the efficiency story on one
+page: what fraction of the chip the stream is using, where the pad
+tokens go, which buckets cost what, whether the declared budgets held,
+and where the milliseconds went per stage.
+
+Stdlib only, like tools/postmortem.py and tools/trace_dump.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+try:  # script mode (`python tools/perfreport.py`): tools/ is on sys.path
+    from postmortem import _stage_digest
+except ImportError:  # module mode (`import tools.perfreport`)
+    from tools.postmortem import _stage_digest
+
+
+def _fmt_flops(n: Any) -> str:
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return "-"
+    for unit in ("", "K", "M", "G", "T", "P"):
+        if abs(n) < 1000.0 or unit == "P":
+            return f"{n:.2f}{unit}"
+        n /= 1000.0
+    return "-"
+
+
+def _metric_samples(exposition: str, name: str) -> List[Tuple[str, float]]:
+    """[(labels_str, value)] for every sample of ``name`` in a Prometheus
+    text exposition (exact name match, labeled or not)."""
+    out: List[Tuple[str, float]] = []
+    pat = re.compile(r"^" + re.escape(name) + r"(\{[^}]*\})?\s+(\S+)$")
+    for line in exposition.splitlines():
+        m = pat.match(line)
+        if m:
+            try:
+                out.append((m.group(1) or "", float(m.group(2))))
+            except ValueError:
+                continue
+    return out
+
+
+def render_report(costs: Dict[str, Any], metrics_text: str = "",
+                  traces: Optional[Dict[str, Any]] = None) -> str:
+    lines: List[str] = []
+    lines.append(
+        f"perf report: {costs.get('worker_id', '?')} "
+        f"(model {costs.get('model', '?')}, "
+        f"batch {costs.get('batch_size', '?')}, "
+        f"buckets {costs.get('buckets', [])})")
+
+    eff = costs.get("efficiency") or {}
+    lines.append("")
+    lines.append("efficiency (rolling window):")
+    if eff:
+        peak = eff.get("peak_flops_per_s")
+        mfu = eff.get("mfu")
+        lines.append(
+            f"  MFU            "
+            f"{mfu if mfu is not None else '- (peak unknown)'}"
+            + (f"  (busy-only {eff['mfu_busy']})"
+               if eff.get("mfu_busy") is not None else ""))
+        lines.append(f"  achieved       "
+                     f"{_fmt_flops(eff.get('achieved_flops_per_s'))}FLOP/s"
+                     f" of {_fmt_flops(peak)}FLOP/s peak "
+                     f"({eff.get('peak_source', '?')})")
+        lines.append(f"  goodput        "
+                     f"{eff.get('goodput_tokens_per_s', '-')} real tokens/s")
+        lines.append(f"  pad density    {eff.get('padding_density', '-')} "
+                     f"({eff.get('real_tokens', 0)} real / "
+                     f"{eff.get('slot_tokens', 0)} slot tokens, "
+                     f"{eff.get('batches', 0)} batches in "
+                     f"{eff.get('window_s', 0)}s)")
+    else:
+        lines.append("  (no batches in the window yet)")
+
+    entries = costs.get("costs") or []
+    lines.append("")
+    lines.append(f"per-bucket compiled cost ({len(entries)} programs):")
+    if entries:
+        lines.append(f"  {'bucket':>6}  {'path':<9}  {'flops':>10}  "
+                     f"{'bytes':>10}  source")
+        for e in entries:
+            lines.append(
+                f"  {e.get('bucket', '?'):>6}  {e.get('path', '?'):<9}  "
+                f"{_fmt_flops(e.get('flops')):>10}  "
+                f"{_fmt_flops(e.get('bytes_accessed')):>10}  "
+                f"{e.get('source', '?')}")
+    else:
+        lines.append("  (nothing compiled yet — pre-warmup?)")
+
+    slo = costs.get("slo") or {}
+    budgets = slo.get("budgets") or []
+    lines.append("")
+    lines.append("SLOs:")
+    if budgets:
+        breaches = slo.get("breaches") or {}
+        for b in budgets:
+            name = b.get("slo", "?")
+            lines.append(f"  {name:<12} budget {b.get('budget_ms')}ms  "
+                         f"breaches {breaches.get(name, 0)}")
+    else:
+        lines.append("  (no budgets declared — --slo-batch-p95-ms / "
+                     "--slo-queue-wait-ms)")
+    for labels, value in _metric_samples(metrics_text, "slo_breach_total"):
+        if labels:
+            lines.append(f"  slo_breach_total{labels} {value}")
+
+    prof = costs.get("profiler") or {}
+    if prof:
+        lines.append("")
+        lines.append(
+            f"profiler: {'CAPTURING' if prof.get('active') else 'idle'}, "
+            f"{prof.get('captures', 0)} captures"
+            + (f", last {prof.get('last_path')}"
+               if prof.get("last_path") else ""))
+
+    digest = _stage_digest(traces or {})
+    if digest:
+        lines.append("")
+        lines.append("per-stage latency (from /traces):")
+        lines.extend(digest)
+    return "\n".join(lines)
+
+
+def _fetch(url: str, as_json: bool = True):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.load(resp) if as_json else \
+            resp.read().decode("utf-8", "replace")
+
+
+def load_live(base_url: str) -> Tuple[Dict[str, Any], str, Dict[str, Any]]:
+    """(costs, metrics_text, traces) from a worker's metrics port; the
+    metrics/traces halves are best-effort (a worker serving only /costs
+    still renders)."""
+    base = base_url.rstrip("/")
+    costs = _fetch(base + "/costs")
+    try:
+        metrics_text = _fetch(base + "/metrics", as_json=False)
+    except Exception:
+        metrics_text = ""
+    try:
+        traces = _fetch(base + "/traces?limit=50")
+    except Exception:
+        traces = {}
+    return costs, metrics_text, traces
+
+
+def selfcheck() -> int:
+    """Render synthetic inputs end to end; non-zero on any error — keeps
+    `python tools/_smoke.py` honest about this tool without a live
+    worker to report on."""
+    costs = {
+        "worker_id": "tpu-worker-0", "model": "e5_small",
+        "batch_size": 256, "buckets": [64, 128],
+        "costs": [
+            {"bucket": 128, "path": "packed", "batch": 256, "seq": 128,
+             "flops": 1.47e12, "bytes_accessed": 2.1e9, "source": "xla"},
+            {"bucket": 64, "path": "unpacked", "batch": 256, "seq": 64,
+             "flops": 6.9e11, "bytes_accessed": None,
+             "source": "analytic"},
+        ],
+        "efficiency": {
+            "window_s": 60.0, "batches": 42, "mfu": 0.31,
+            "mfu_busy": 0.38, "achieved_flops_per_s": 6.1e13,
+            "goodput_tokens_per_s": 123456.0, "padding_density": 0.82,
+            "real_tokens": 7_400_000, "slot_tokens": 9_000_000,
+            "peak_flops_per_s": 1.97e14, "peak_source": "tpu:v5e",
+        },
+        "slo": {"budgets": [{"slo": "batch_p95", "budget_ms": 250.0,
+                             "spans": ["tpu_worker.process"]}],
+                "breaches": {"batch_p95": 3}},
+        "profiler": {"active": False, "captures": 1,
+                     "last_path": "/dumps/profile_x"},
+    }
+    metrics = ('# TYPE slo_breach_total counter\n'
+               'slo_breach_total 3.0\n'
+               'slo_breach_total{slo="batch_p95"} 3.0\n'
+               '# TYPE tpu_engine_mfu gauge\ntpu_engine_mfu 0.31\n')
+    traces = {"traces": [{"trace_id": "t1", "spans": [
+        {"name": "engine.compute", "duration_ms": 24.0},
+        {"name": "engine.unpack", "duration_ms": 90.0}]}]}
+    out = render_report(costs, metrics, traces)
+    assert "MFU" in out and "0.31" in out, out
+    assert "batch_p95" in out and "breaches 3" in out, out
+    assert "engine.unpack" in out, out
+    assert "tpu:v5e" in out, out
+    empty = render_report({"worker_id": "w", "costs": [],
+                           "efficiency": {}, "slo": {}})
+    assert "no batches" in empty and "pre-warmup" in empty, empty
+    print("perfreport selfcheck ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="one-page hardware-efficiency report from a live "
+                    "worker's /costs + /metrics + /traces")
+    p.add_argument("source", nargs="?", default="",
+                   help="metrics-server base URL (e.g. "
+                        "http://127.0.0.1:9102), or a /costs JSON path")
+    p.add_argument("--selfcheck", action="store_true",
+                   help="render synthetic data and exit (CI smoke)")
+    args = p.parse_args(argv)
+
+    if args.selfcheck:
+        return selfcheck()
+    if not args.source:
+        p.error("source required (worker base URL or /costs JSON path)")
+    try:
+        if args.source.startswith(("http://", "https://")):
+            costs, metrics_text, traces = load_live(args.source)
+        else:
+            with open(args.source, "r", encoding="utf-8") as f:
+                costs, metrics_text, traces = json.load(f), "", {}
+    except Exception as e:
+        print(f"error: failed to load {args.source}: {e}", file=sys.stderr)
+        return 2
+    print(render_report(costs, metrics_text, traces))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

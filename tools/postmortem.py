@@ -87,6 +87,7 @@ def render_bundle(bundle: Dict[str, Any]) -> str:
 
 
 def _stage_digest(traces: Dict[str, Any]) -> List[str]:
+    # Shared with tools/perfreport.py — the ONE per-stage table renderer.
     by_name: Dict[str, List[float]] = {}
     for t in traces.get("traces", []):
         for s in t.get("spans", []):
