@@ -28,6 +28,7 @@ from .messages import (
     PRIORITY_HIGH,
     PRIORITY_LOW,
     PRIORITY_MEDIUM,
+    TOPIC_CHAOS,
     TOPIC_INFERENCE_BATCHES,
     TOPIC_INFERENCE_RESULTS,
     TOPIC_JOBS,
@@ -35,6 +36,7 @@ from .messages import (
     TOPIC_RESULTS,
     TOPIC_WORK_QUEUE,
     TOPIC_WORKER_STATUS,
+    ChaosMessage,
     ControlMessage,
     DiscoveredPage,
     ResultMessage,
@@ -56,6 +58,7 @@ __all__ = [
     "DiscoveredPage",
     "StatusMessage",
     "ControlMessage",
+    "ChaosMessage",
     "new_trace_id",
     "pubsub_topics",
     "RecordBatch",
@@ -74,6 +77,7 @@ __all__ = [
     "TOPIC_INFERENCE_BATCHES",
     "TOPIC_INFERENCE_RESULTS",
     "TOPIC_JOBS",
+    "TOPIC_CHAOS",
     "GrpcBusServer",
     "GrpcBusClient",
     "RemoteBus",

@@ -35,6 +35,7 @@ from ..datamodel import Post
 from ..datamodel.post import format_time, parse_time
 from ..state.datamodels import new_id, utcnow
 from .messages import (
+    MSG_CHAOS_FAULT,
     MSG_DISCOVERED_PAGES,
     MSG_HEARTBEAT,
     MSG_PAUSE,
@@ -45,6 +46,7 @@ from .messages import (
     MSG_WORK_RESULT,
     MSG_WORKER_STARTED,
     MSG_WORKER_STOPPING,
+    ChaosMessage,
     ControlMessage,
     ResultMessage,
     StatusMessage,
@@ -135,6 +137,7 @@ MESSAGE_REGISTRY: Dict[str, type] = {
     MSG_PAUSE: ControlMessage,
     MSG_RESUME: ControlMessage,
     MSG_STOP: ControlMessage,
+    MSG_CHAOS_FAULT: ChaosMessage,
 }
 
 

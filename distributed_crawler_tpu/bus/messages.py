@@ -32,6 +32,9 @@ MSG_STOP = "stop"
 # New in the TPU build: record batches for the inference worker.
 MSG_RECORD_BATCH = "record_batch"
 MSG_INFERENCE_RESULT = "inference_result"
+# Chaos injection (`loadgen/chaos.py`): a fault the load harness is about
+# to apply (kill/stall/wedge a worker, delay/drop/poison bus traffic).
+MSG_CHAOS_FAULT = "chaos_fault"
 
 # --- status values (`messages.go:32-43`) -----------------------------------
 STATUS_SUCCESS = "success"
@@ -61,6 +64,10 @@ TOPIC_INFERENCE_RESULTS = "tpu-inference-results"
 # bus transport replacing the reference's Dapr service-invocation handlers
 # (`dapr/job.go:81-95`).
 TOPIC_JOBS = "job-commands"
+# Chaos-injection announcements from the load harness (`loadgen/chaos.py`):
+# every applied fault is published here so distributed targets (and the
+# flight recorder on each) can see cause next to effect.
+TOPIC_CHAOS = "chaos-commands"
 
 VALID_PLATFORMS = ("telegram", "youtube")
 
@@ -85,7 +92,7 @@ def pubsub_topics() -> List[str]:
     """`messages.go:169-176` + TPU topics."""
     return [TOPIC_WORK_QUEUE, TOPIC_RESULTS, TOPIC_WORKER_STATUS,
             TOPIC_ORCHESTRATOR, TOPIC_INFERENCE_BATCHES,
-            TOPIC_INFERENCE_RESULTS, TOPIC_JOBS]
+            TOPIC_INFERENCE_RESULTS, TOPIC_JOBS, TOPIC_CHAOS]
 
 
 def _opt_time(value: Any) -> Optional[str]:
@@ -537,6 +544,75 @@ class ControlMessage:
             message_type=d.get("message_type", MSG_PAUSE),
             command=d.get("command", "") or "",
             target_id=d.get("target_id", "") or "",
+            parameters=dict(d.get("parameters") or {}),
+            timestamp=parse_time(d.get("timestamp")),
+            trace_id=d.get("trace_id", "") or "",
+        )
+
+
+# Fault actions the chaos controller knows how to apply
+# (`loadgen/chaos.py`); `validate()` rejects anything else at decode time
+# so a typo'd scenario line fails loudly instead of silently no-opping.
+CHAOS_ACTIONS = ("kill", "restart", "stall", "wedge", "delay", "drop",
+                 "poison")
+
+
+@dataclass
+class ChaosMessage:
+    """One injected fault, announced on ``TOPIC_CHAOS`` the moment the
+    chaos controller applies it (`loadgen/chaos.py`).
+
+    ``at_s``/``until_s`` are offsets from scenario start; point faults
+    carry ``until_s=0``.  The envelope exists so distributed targets can
+    react to faults they cannot observe locally and so every postmortem
+    bundle shows the injected cause next to its effect."""
+
+    message_type: str = MSG_CHAOS_FAULT
+    action: str = ""                 # one of CHAOS_ACTIONS
+    target_id: str = ""              # worker id or "bus"/"batch"
+    at_s: float = 0.0
+    until_s: float = 0.0             # 0 = point fault (no window)
+    parameters: Dict[str, Any] = field(default_factory=dict)
+    timestamp: Optional[datetime] = None
+    trace_id: str = ""
+
+    @classmethod
+    def new(cls, action: str, target_id: str, at_s: float,
+            until_s: float = 0.0,
+            parameters: Optional[Dict[str, Any]] = None) -> "ChaosMessage":
+        return cls(action=action, target_id=target_id, at_s=at_s,
+                   until_s=until_s, parameters=dict(parameters or {}),
+                   timestamp=utcnow(), trace_id=new_trace_id())
+
+    def validate(self) -> None:
+        if self.message_type != MSG_CHAOS_FAULT:
+            raise ValueError(
+                f"invalid chaos message type: {self.message_type}")
+        if self.action not in CHAOS_ACTIONS:
+            raise ValueError(f"unknown chaos action: {self.action}")
+        if not self.target_id:
+            raise ValueError("chaos message target cannot be empty")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "message_type": self.message_type,
+            "action": self.action,
+            "target_id": self.target_id,
+            "at_s": self.at_s,
+            "until_s": self.until_s,
+            "parameters": self.parameters,
+            "timestamp": _opt_time(self.timestamp),
+            "trace_id": self.trace_id,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ChaosMessage":
+        return cls(
+            message_type=d.get("message_type", MSG_CHAOS_FAULT),
+            action=d.get("action", "") or "",
+            target_id=d.get("target_id", "") or "",
+            at_s=float(d.get("at_s") or 0.0),
+            until_s=float(d.get("until_s") or 0.0),
             parameters=dict(d.get("parameters") or {}),
             timestamp=parse_time(d.get("timestamp")),
             trace_id=d.get("trace_id", "") or "",

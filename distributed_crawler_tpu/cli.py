@@ -160,11 +160,39 @@ def build_parser() -> argparse.ArgumentParser:
     a("--slo-queue-wait-ms", type=float, default=None,
       help="SLO budget on the TPU worker's queue-wait p95 in ms "
            "(0 = off, the default)")
+    a("--slo-batch-age-ms", type=float, default=None,
+      help="SLO budget on whole-pipeline batch age p95 in ms "
+           "(RecordBatch creation -> device; covers the broker leg "
+           "queue-wait can't see, so it fires on a dead worker's "
+           "stranded backlog; 0 = off, the default)")
     a("--profile-on-slow-ms", type=float, default=None,
       help="auto-capture a bounded jax.profiler trace to --dump-dir when "
            "a device batch exceeds this many ms (one capture at a time; "
            "0 = off); /profile?seconds=N on the metrics port does the "
            "same on demand")
+    # Load harness (`python -m tools.loadtest`; loadgen/).  These keys
+    # configure the synthetic workload + SLO gate; the crawl/worker modes
+    # ignore them, but they resolve through the same precedence chain so
+    # a config file can pin a site's load-test defaults.
+    a("--loadgen-scenario", default=None,
+      help="loadgen scenario: checked-in name (steady-state, "
+           "kill-worker, backend-wedge) or a JSON scenario file path "
+           "(tools/loadtest.py; docs/operations.md)")
+    a("--loadgen-seed", type=int, default=None,
+      help="loadgen workload seed (same seed -> identical batch shapes "
+           "and arrival schedule)")
+    a("--loadgen-duration-s", type=float, default=None,
+      help="loadgen load-phase duration in seconds")
+    a("--loadgen-arrival", default=None, choices=["poisson", "ramp"],
+      help="loadgen arrival process: open-loop poisson or closed-loop "
+           "concurrency ramp")
+    a("--loadgen-rate", type=float, default=None,
+      help="loadgen offered load in batches/s (poisson arrivals)")
+    a("--loadgen-platform-mix", default=None,
+      help='loadgen platform weights, e.g. "telegram=0.8,youtube=0.2"')
+    a("--loadgen-gate", default=None,
+      help="loadgen gate-envelope overrides: inline JSON object or "
+           "@path/to/gate.json (merged over the scenario's gate block)")
     # TPU inference stage
     a("--bus-serve", action="store_const", const=True, default=None,
       help="also HOST the gRPC bus broker at --bus-address (tpu-worker "
@@ -370,7 +398,15 @@ _KEY_MAP = {
     "telemetry_interval": "observability.telemetry_interval_s",
     "slo_batch_p95_ms": "observability.slo_batch_p95_ms",
     "slo_queue_wait_ms": "observability.slo_queue_wait_ms",
+    "slo_batch_age_ms": "observability.slo_batch_age_ms",
     "profile_on_slow_ms": "observability.profile_on_slow_ms",
+    "loadgen_scenario": "loadgen.scenario",
+    "loadgen_seed": "loadgen.seed",
+    "loadgen_duration_s": "loadgen.duration_s",
+    "loadgen_arrival": "loadgen.arrival",
+    "loadgen_rate": "loadgen.rate_batches_per_s",
+    "loadgen_platform_mix": "loadgen.platform_mix",
+    "loadgen_gate": "loadgen.gate",
     "infer": "inference.enabled",
     "infer_model": "inference.model",
     "infer_backpressure_high": "distributed.inference_backpressure_high",
@@ -1550,6 +1586,8 @@ def _build_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver):
                              "observability.slo_batch_p95_ms", 0.0),
                          slo_queue_wait_ms=r.get_float(
                              "observability.slo_queue_wait_ms", 0.0),
+                         slo_batch_age_ms=r.get_float(
+                             "observability.slo_batch_age_ms", 0.0),
                          profile_on_slow_ms=r.get_float(
                              "observability.profile_on_slow_ms", 0.0)))
 

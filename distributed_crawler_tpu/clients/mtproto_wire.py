@@ -51,7 +51,18 @@ import time
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+try:
+    from cryptography.hazmat.primitives.ciphers import (
+        Cipher,
+        algorithms,
+        modes,
+    )
+    _CRYPTO_IMPORT_ERROR = None
+except ImportError as _exc:  # gated dep: TL parsing stays importable —
+    # only the AES-IGE paths (ige_encrypt/ige_decrypt, i.e. the actual
+    # MTProto transport) need the cryptography package.
+    Cipher = algorithms = modes = None  # type: ignore[assignment]
+    _CRYPTO_IMPORT_ERROR = _exc
 
 # -- TL constructor ids (public MTProto schema) -----------------------------
 REQ_PQ_MULTI = 0xBE7E8EF1
@@ -98,6 +109,10 @@ def xor(a: bytes, b: bytes) -> bytes:
 
 def ige_encrypt(key: bytes, iv: bytes, data: bytes) -> bytes:
     """AES-256-IGE (key 32B; iv 32B = iv1‖iv2; len(data) % 16 == 0)."""
+    if Cipher is None:
+        raise ImportError(
+            "MTProto AES-IGE needs the 'cryptography' package"
+        ) from _CRYPTO_IMPORT_ERROR
     if len(data) % 16:
         raise ValueError("IGE needs 16-byte-aligned input")
     enc = Cipher(algorithms.AES(key), modes.ECB()).encryptor()
@@ -112,6 +127,10 @@ def ige_encrypt(key: bytes, iv: bytes, data: bytes) -> bytes:
 
 
 def ige_decrypt(key: bytes, iv: bytes, data: bytes) -> bytes:
+    if Cipher is None:
+        raise ImportError(
+            "MTProto AES-IGE needs the 'cryptography' package"
+        ) from _CRYPTO_IMPORT_ERROR
     if len(data) % 16:
         raise ValueError("IGE needs 16-byte-aligned input")
     dec = Cipher(algorithms.AES(key), modes.ECB()).decryptor()

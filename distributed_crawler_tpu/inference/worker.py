@@ -101,6 +101,10 @@ class TPUWorkerConfig:
     # trace_id, and land in the flight-recorder ring.
     slo_batch_p95_ms: float = 0.0     # p95 of tpu_worker.process/coalesce
     slo_queue_wait_ms: float = 0.0    # p95 of tpu_worker.queue_wait
+    # Whole-pipeline batch age (RecordBatch.created_at -> device), the
+    # budget that catches frames stranded on the broker while this worker
+    # was down/restarting — queue_wait can't see that leg.
+    slo_batch_age_ms: float = 0.0     # p95 of tpu_worker.batch_age
     # Auto profiler capture: a device batch slower than this many ms
     # triggers one bounded jax.profiler capture to --dump-dir (one at a
     # time; `utils/profiling.py`).  0 = off.
@@ -171,7 +175,8 @@ class TPUWorker:
         # the slo map.
         self._slo = SLOWatchdog(
             standard_slos(batch_p95_ms=cfg.slo_batch_p95_ms,
-                          queue_wait_ms=cfg.slo_queue_wait_ms),
+                          queue_wait_ms=cfg.slo_queue_wait_ms,
+                          batch_age_ms=cfg.slo_batch_age_ms),
             registry=registry)
         # Capability probes, not flags: test doubles and older engines that
         # predate pack/coalescing keep working through the one-batch path.
@@ -267,6 +272,31 @@ class TPUWorker:
         if self._profiler_started:
             profiling.stop_profiler_server()
             self._profiler_started = False
+
+    def kill(self) -> None:
+        """Abrupt-death simulation (the chaos/`loadgen` seam): halt the
+        feed/heartbeat/watchdog threads WITHOUT draining, flushing the
+        provider, sending a stopping status, or acking queued batches —
+        the in-process analog of SIGKILL.  Un-acked frames requeue
+        server-side on manual-ack buses (the caller closes this worker's
+        RemoteBus to tear the pull stream down); the /status and /costs
+        providers are left registered, exactly as a dead process leaves
+        its endpoints unreachable rather than deregistered."""
+        self._stop.set()
+        flight.record("worker_kill", worker=self.cfg.worker_id,
+                      queue_depth=self._queue.qsize(),
+                      inflight=self._inflight)
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads.clear()
+
+    def evaluate_slos(self) -> list:
+        """One SLO evaluation tick on demand (the heartbeat loop's twin):
+        digests spans completed since the previous tick against the
+        declared budgets and returns the breach records.  The loadgen
+        gate calls this at phase boundaries so breach attribution is
+        deterministic instead of riding heartbeat timing."""
+        return self._slo.evaluate()
 
     def drain(self, timeout_s: float = 30.0) -> bool:
         """Block until every accepted batch — queued OR mid-process — has
@@ -525,6 +555,14 @@ class TPUWorker:
             age = (utcnow() - batch.created_at).total_seconds()
             if age >= 0:
                 self.m_batch_age.observe(age)
+                # Retroactive span so the whole-pipeline age is SLO-
+                # evaluable (`--slo-batch-age-ms`): it covers the broker
+                # leg queue_wait can't see — the signal that fires when a
+                # killed worker's backlog finally lands.
+                trace.record("tpu_worker.batch_age", age,
+                             trace_id=batch.trace_id,
+                             batch=batch.batch_id,
+                             worker=self.cfg.worker_id)
 
     def _commit(self, batch: RecordBatch, results) -> None:
         if not self.cfg.write_embeddings:

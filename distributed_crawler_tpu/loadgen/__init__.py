@@ -1,0 +1,49 @@
+"""Synthetic load, chaos injection, and the SLO regression gate.
+
+PRs 2-5 built deep observability — span traces, the `/cluster` fleet
+view, the flight recorder, MFU/goodput meters, the SLO watchdog — but
+nothing *drove* that machinery at production shape, so perf claims were
+unreproducible at system scope and wedges were found by accident.  This
+subsystem closes the loop:
+
+- `generator`: a fully-seeded synthetic workload source (Zipf post
+  lengths, telegram/youtube platform mix, open-loop Poisson or
+  closed-loop ramp arrivals) injected through the real bus, plus replay
+  of flight-recorder bundles so every postmortem becomes a reproducible
+  test case;
+- `chaos`: a scenario-driven fault injector (kill/stall/wedge a worker,
+  delay/drop/poison bus deliveries) expressed as declarative timelines,
+  every fault flight-recorded and announced on ``TOPIC_CHAOS``;
+- `gate`: runs a named scenario end-to-end in-process, scrapes
+  `/metrics`, `/costs`, and `/cluster` at the end, and asserts a
+  declared envelope (p95 budgets, breach-and-recovery, zero
+  lost/duplicated items, goodput floor), emitting ONE parseable JSON
+  verdict line — the bench.py contract.
+
+Entry point: ``python -m tools.loadtest --scenario kill-worker``.
+Scenario files live under `loadgen/scenarios/`; the format is documented
+in docs/operations.md "Load testing & chaos".
+"""
+
+from .chaos import ChaosBus, ChaosController, Fault, parse_timeline
+from .generator import (
+    LoadGenConfig,
+    ReplayWorkload,
+    SyntheticWorkload,
+    workload_from_bundle,
+)
+from .gate import load_scenario, run_scenario, scenario_names
+
+__all__ = [
+    "LoadGenConfig",
+    "SyntheticWorkload",
+    "ReplayWorkload",
+    "workload_from_bundle",
+    "Fault",
+    "parse_timeline",
+    "ChaosController",
+    "ChaosBus",
+    "load_scenario",
+    "run_scenario",
+    "scenario_names",
+]

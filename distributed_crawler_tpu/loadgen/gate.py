@@ -1,0 +1,616 @@
+"""The SLO regression gate: run a scenario end-to-end, judge the system.
+
+A *scenario* is a JSON file (see `loadgen/scenarios/`) naming the
+workload, the bus transport, the chaos timeline, and the envelope the
+run must stay inside.  :func:`run_scenario` assembles the REAL stack in
+one process — orchestrator (+ optional SimNetwork crawl leg through the
+`InferenceBridge`), a TPU worker on a real `InferenceEngine`, the
+generator, and the chaos controller — drives it through three phases
+(baseline → load+chaos → recovery tail), scrapes ``/metrics``,
+``/costs``, and ``/cluster`` over real HTTP at the end, and returns a
+verdict dict asserting:
+
+- **zero lost / duplicated items**: every post_uid the chaos bus let
+  through must appear exactly once in the writeback sink (dropped and
+  poisoned batches are excluded by the ledger);
+- **breach-and-recovery**: the SLOs named in ``gate.require_breach``
+  must have fired during the fault window, and those in
+  ``gate.forbid_tail_breach`` must NOT fire in the recovery tail;
+- **tail latency**: queue-wait / batch p95 over tail-phase spans under
+  the declared budgets;
+- **goodput**: records through the device per active second above the
+  configured floor.
+
+`tools/loadtest.py` wraps this in the bench.py contract: ONE parseable
+JSON verdict line, whatever happens.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import shutil
+import tempfile
+import threading
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from ..bus.messages import TOPIC_INFERENCE_BATCHES
+from ..utils import flight, trace
+from ..utils.slo import BATCH_AGE_SPANS, BATCH_SPANS, QUEUE_WAIT_SPANS
+from .chaos import ChaosBus, ChaosController, ChaosEngine, parse_timeline
+from .generator import (
+    LoadGenConfig,
+    PlannedBatch,
+    PlannedRecord,
+    SyntheticWorkload,
+    zipf_text,
+)
+
+logger = logging.getLogger("dct.loadgen.gate")
+
+SCENARIO_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "scenarios")
+
+# TPUWorkerConfig fields a scenario's "worker" block may set.
+_WORKER_KEYS = ("worker_id", "heartbeat_s", "queue_capacity",
+                "coalesce_batches", "pack", "stall_warn_s", "stall_exit_s",
+                "slo_batch_p95_ms", "slo_queue_wait_ms", "slo_batch_age_ms",
+                "write_embeddings")
+_LOAD_KEYS = ("seed", "duration_s", "arrival", "rate_batches_per_s",
+              "ramp_from", "ramp_to", "ramp_batches", "records_per_batch",
+              "zipf_a", "max_words", "platform_mix", "crawl_id")
+
+
+def scenario_names() -> List[str]:
+    """Checked-in scenario names (without .json)."""
+    if not os.path.isdir(SCENARIO_DIR):
+        return []
+    return sorted(f[:-5] for f in os.listdir(SCENARIO_DIR)
+                  if f.endswith(".json"))
+
+
+def load_scenario(name_or_path: str) -> Dict[str, Any]:
+    """Resolve a scenario by checked-in name or filesystem path."""
+    path = name_or_path
+    if not os.path.exists(path):
+        path = os.path.join(SCENARIO_DIR, f"{name_or_path}.json")
+    if not os.path.exists(path):
+        raise ValueError(
+            f"unknown scenario {name_or_path!r}; checked-in scenarios: "
+            f"{', '.join(scenario_names()) or '(none)'}")
+    with open(path, "r", encoding="utf-8") as f:
+        scenario = json.load(f)
+    scenario.setdefault("name", os.path.basename(path)[:-5])
+    return scenario
+
+
+def merge_overrides(scenario: Dict[str, Any],
+                    overrides: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Deep-merge ``overrides`` into a copy of ``scenario`` (dicts merge
+    recursively, everything else replaces)."""
+    out = json.loads(json.dumps(scenario))  # deep copy, JSON-safe
+
+    def _merge(dst, src):
+        for k, v in src.items():
+            if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                _merge(dst[k], v)
+            else:
+                dst[k] = v
+
+    if overrides:
+        _merge(out, overrides)
+    return out
+
+
+def _p95_ms(spans, names, since_wall: float) -> Optional[float]:
+    vals = sorted(s.duration_s * 1000.0 for s in spans
+                  if s.name in names
+                  and (s.start_wall + s.duration_s) >= since_wall)
+    if not vals:
+        return None
+    n = len(vals)
+    return vals[min(n - 1, max(0, math.ceil(0.95 * n) - 1))]
+
+
+def _breach_counts(registry) -> Dict[str, float]:
+    """slo_breach_total children by label value, from the run registry."""
+    counter = registry.counter("slo_breach_total")
+    out: Dict[str, float] = {}
+    for labels, value in counter.series():
+        if "slo" in labels:
+            out[labels["slo"]] = value
+    return out
+
+
+def _delta(after: Dict[str, float],
+           before: Dict[str, float]) -> Dict[str, float]:
+    return {k: v - before.get(k, 0.0)
+            for k, v in after.items() if v - before.get(k, 0.0) > 0}
+
+
+class WorkerHandle:
+    """The chaos controller's view of the TPU worker: kill / restart /
+    stall, with the current live instance behind one name.  Each start
+    gets a FRESH bus connection (gRPC: its own pull stream, so kill's
+    stream teardown requeues un-acked frames server-side, exactly like a
+    crashed process)."""
+
+    def __init__(self, name: str, make_bus, engine: ChaosEngine,
+                 provider, worker_cfg_kw: Dict[str, Any], registry):
+        from ..inference.worker import TPUWorkerConfig
+
+        self.name = name
+        self._make_bus = make_bus
+        self._engine = engine
+        self._provider = provider
+        self._registry = registry
+        self._cfg = TPUWorkerConfig(worker_id=name, **worker_cfg_kw)
+        self.worker = None
+        self.bus = None
+        self.generation = 0
+
+    def start(self) -> None:
+        from ..inference.worker import TPUWorker
+
+        self.bus = self._make_bus()
+        self.worker = TPUWorker(self.bus, self._engine,
+                                provider=self._provider, cfg=self._cfg,
+                                registry=self._registry)
+        self.worker.start()
+        self.generation += 1
+
+    def kill(self) -> None:
+        if self.worker is None:
+            return
+        self.worker.kill()
+        close = getattr(self.bus, "close", None)
+        if callable(close):
+            close()  # gRPC: tear the pull stream; un-acked frames requeue
+
+    def restart(self) -> None:
+        self.start()
+
+    def stall(self, seconds: float) -> None:
+        self._engine.block_for(seconds)
+
+    def stop(self) -> None:
+        if self.worker is not None:
+            self.worker.stop(timeout_s=5.0)
+        close = getattr(self.bus, "close", None)
+        if callable(close):
+            try:
+                close()
+            except Exception:
+                pass
+
+
+def _scrape(port: int, path: str, as_json: bool):
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5).read()
+        return json.loads(body) if as_json else body.decode("utf-8")
+    except Exception as e:
+        logger.warning("scrape of %s failed: %s", path, e)
+        return None
+
+
+def _written_uids(provider, crawl_ids: List[str],
+                  storage_prefix: str = "inference") -> Dict[str, int]:
+    """post_uid -> occurrence count across every batch writeback file of
+    the given crawl ids (the id-reconciliation read side)."""
+    from ..inference.worker import iter_results
+
+    counts: Dict[str, int] = {}
+    for crawl_id in crawl_ids:
+        for row in iter_results(provider, crawl_id, storage_prefix):
+            uid = row.get("post_uid", "")
+            if uid:
+                counts[uid] = counts.get(uid, 0) + 1
+    return counts
+
+
+def _seed_sim_network(crawl_cfg: Dict[str, Any], seed: int):
+    """A deterministic SimNetwork for the crawl leg: ``channels``
+    channels of ``posts_per_channel`` Zipf-length messages."""
+    import random as _random
+
+    from ..clients import SimNetwork
+    from ..clients.telegram import TLMessage
+
+    rng = _random.Random(seed)
+    net = SimNetwork()
+    names = []
+    for c in range(int(crawl_cfg.get("channels", 2))):
+        name = f"loadchan{c}"
+        msgs = []
+        for i in range(int(crawl_cfg.get("posts_per_channel", 4))):
+            u = max(1e-9, 1.0 - rng.random())
+            words = max(1, min(80, int(u ** (-1.0 / 0.6))))
+            msgs.append(TLMessage(
+                content={"@type": "messageText",
+                         "text": {"text": zipf_text(c * 100 + i, words),
+                                  "entities": []}},
+                date=1700000000 + i, view_count=rng.randrange(1000)))
+        net.add_channel(name, messages=msgs, member_count=500)
+        names.append(name)
+    return net, names
+
+
+def run_scenario(scenario: Dict[str, Any],
+                 overrides: Optional[Dict[str, Any]] = None,
+                 workload=None) -> Dict[str, Any]:
+    """Run one scenario end-to-end in-process; returns the verdict dict.
+
+    ``workload`` overrides the synthetic generator (replay mode passes a
+    `ReplayWorkload` built by `generator.workload_from_bundle`).
+    Raises only on setup/config errors; a run that finishes always
+    returns a verdict (status "pass" or "fail" per the envelope).
+    """
+    from ..bus.inmemory import InMemoryBus
+    from ..config.crawler import CrawlerConfig
+    from ..inference.engine import EngineConfig, InferenceEngine
+    from ..orchestrator import Orchestrator
+    from ..orchestrator.orchestrator import OrchestratorConfig
+    from ..state import CompositeStateManager, SqlConfig, StateConfig
+    from ..state.providers import InMemoryStorageProvider
+    from ..utils.metrics import (
+        MetricsRegistry,
+        clear_cluster_provider,
+        serve_metrics,
+        set_cluster_provider,
+    )
+
+    scenario = merge_overrides(scenario, overrides)
+    name = scenario.get("name", "unnamed")
+    bus_kind = scenario.get("bus", "inmemory")
+    if bus_kind not in ("inmemory", "grpc"):
+        raise ValueError(f"scenario bus must be inmemory|grpc, "
+                         f"got {bus_kind!r}")
+    timeline = parse_timeline(scenario.get("chaos", []))
+    if bus_kind != "grpc" and any(f.action in ("kill", "restart")
+                                  for f in timeline):
+        raise ValueError(
+            "kill/restart faults need bus='grpc' (the in-memory bus has "
+            "no competing-consumer requeue, so a killed worker's frames "
+            "would be lost by construction)")
+
+    load_cfg = LoadGenConfig(**{k: v
+                                for k, v in scenario.get("load", {}).items()
+                                if k in _LOAD_KEYS})
+    if workload is None:
+        workload = SyntheticWorkload(load_cfg)
+    worker_kw = {k: v for k, v in scenario.get("worker", {}).items()
+                 if k in _WORKER_KEYS}
+    worker_name = worker_kw.pop("worker_id", "tpu-1")
+    gate_cfg = scenario.get("gate", {})
+    drain_timeout_s = float(scenario.get("drain_timeout_s", 30.0))
+
+    # Process-wide observability: the gate owns the span ring and the
+    # flight ring for the duration of the run (the run IS the test).
+    trace.configure(capacity=int(scenario.get("trace_buffer", 8192)))
+    flight.configure(capacity=int(scenario.get("flight_buffer", 4096)))
+    # Only events recorded by THIS run count toward require_flight (an
+    # embedding process may carry unrelated history in the ring).  A
+    # marker event — not a ring index — survives the bounded deque's
+    # evictions: if even the marker was evicted, the ring rolled over
+    # entirely within this run and every surviving event is ours.
+    run_mark = f"run-{time.monotonic_ns()}"
+    flight.record("loadgen_run_start", mark=run_mark)
+    registry = MetricsRegistry()
+
+    t_run0 = time.monotonic()
+    engine = ChaosEngine(InferenceEngine(
+        EngineConfig(**scenario.get("engine", {"model": "tiny"})),
+        registry=registry))
+    provider = InMemoryStorageProvider()
+    tmpdir = tempfile.mkdtemp(prefix="dct-loadgen-")
+
+    server = None
+    inner_bus = None
+    orch = None
+    crawl_worker = None
+    pool_installed = False
+    handle = None
+    http_server = None
+    controller = None
+    cluster_provider = None
+    verdict: Dict[str, Any] = {"scenario": name, "bus": bus_kind}
+    try:
+        # --- bus fabric ---------------------------------------------------
+        if bus_kind == "grpc":
+            from ..bus.grpc_bus import GrpcBusServer, RemoteBus
+
+            server = GrpcBusServer("127.0.0.1:0")
+            server.enable_pull(TOPIC_INFERENCE_BATCHES)
+            server.start()
+            addr = f"127.0.0.1:{server.bound_port}"
+            local_bus = server            # orchestrator + generator side
+            make_worker_bus = lambda: RemoteBus(addr)  # noqa: E731
+        else:
+            inner_bus = InMemoryBus(sync=True)
+            local_bus = inner_bus
+            make_worker_bus = lambda: inner_bus  # noqa: E731
+        chaos_bus = ChaosBus(local_bus)
+
+        # --- orchestrator (fleet fold + /cluster; real code path) ---------
+        def _sm(sub: str):
+            return CompositeStateManager(StateConfig(
+                crawl_id=scenario.get("crawl_id", "c1"),
+                crawl_execution_id="e1",
+                storage_root=os.path.join(tmpdir, sub),
+                sql=SqlConfig(url=":memory:")))
+
+        crawl_leg = scenario.get("crawl")
+        crawler_cfg = CrawlerConfig(
+            crawl_id=scenario.get("crawl_id", "c1"), platform="telegram",
+            skip_media_download=True, sampling_method="channel")
+        seeds: List[str] = []
+        if crawl_leg:
+            from ..clients import SimTelegramClient
+            from ..clients.pool import ConnectionPool
+            from ..crawl import runner as crawl_runner
+
+            net, seeds = _seed_sim_network(crawl_leg, load_cfg.seed)
+            crawl_runner.shutdown_connection_pool()
+            crawl_runner.init_connection_pool(ConnectionPool.for_testing(
+                {"conn0": SimTelegramClient(net, conn_id="conn0")}))
+            pool_installed = True
+        orch = Orchestrator(
+            crawler_cfg.crawl_id, crawler_cfg, local_bus, _sm("orch"),
+            ocfg=OrchestratorConfig(
+                worker_timeout_s=float(scenario.get("worker_timeout_s",
+                                                    10.0))))
+        orch.start(seeds, background=False)
+        cluster_provider = orch.get_cluster
+        set_cluster_provider(cluster_provider)
+
+        if crawl_leg:
+            from ..inference.bridge import InferenceBridge
+            from ..worker import CrawlWorker
+            from ..worker.worker import WorkerConfig
+
+            bridge = InferenceBridge(
+                _sm("crawl"), chaos_bus, crawl_id=crawler_cfg.crawl_id,
+                batch_size=int(crawl_leg.get("batch_size", 4)),
+                deadline_s=0.05)
+            crawl_worker = CrawlWorker(
+                "crawl-1", crawler_cfg, local_bus, bridge,
+                wcfg=WorkerConfig(worker_id="crawl-1", heartbeat_s=0.5))
+            crawl_worker.start()
+
+        # --- TPU worker ----------------------------------------------------
+        handle = WorkerHandle(worker_name, make_worker_bus, engine,
+                              provider, worker_kw, registry)
+        handle.start()
+        handle.worker.warmup()  # compile outside the measured phases
+
+        http_server = serve_metrics(0, registry)
+        port = http_server.server_address[1]
+
+        targets = {worker_name: handle}
+        if crawl_worker is not None:
+            targets["crawl-1"] = crawl_worker
+        controller = ChaosController(timeline, targets=targets,
+                                     bus=chaos_bus, publish_bus=local_bus)
+
+        # --- phase A: baseline (flush the SLO window) ----------------------
+        handle.worker.evaluate_slos()
+        breaches_0 = _breach_counts(registry)
+
+        # --- phase B: load + chaos ----------------------------------------
+        logger.info("loadgen %s: load phase starting (%s arrivals)",
+                    name, load_cfg.arrival)
+        t_b0 = time.monotonic()
+        stop = threading.Event()
+        stats_box: Dict[str, Any] = {}
+
+        def _pending() -> int:
+            status = handle.worker.get_status() if handle.worker else {}
+            n = int(status.get("queue_depth", 0)) \
+                + int(status.get("inflight", 0))
+            if server is not None:
+                n += server.pending_count(TOPIC_INFERENCE_BATCHES)
+            return n
+
+        def _gen():
+            stats_box["stats"] = workload.run(
+                chaos_bus, stop=stop, pending_fn=_pending)
+
+        gen_thread = threading.Thread(target=_gen, daemon=True,
+                                      name="dct-loadgen")
+        controller.start()
+        gen_thread.start()
+        while gen_thread.is_alive():
+            if crawl_leg:
+                orch.distribute_work()
+            time.sleep(0.02)
+        gen_thread.join()
+        # Let the timeline finish (e.g. a restart scheduled after the
+        # last arrival) before draining.
+        deadline = time.monotonic() + drain_timeout_s
+        while not controller.done() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        controller.stop()
+        if server is not None:
+            server.drain(timeout_s=drain_timeout_s)
+        drained = handle.worker.drain(timeout_s=drain_timeout_s)
+        handle.worker.evaluate_slos()
+        orch.check_worker_health()
+        breaches_fault = _delta(_breach_counts(registry), breaches_0)
+        t_b1 = time.monotonic()
+
+        # --- phase C: recovery tail ---------------------------------------
+        tail_cfg = scenario.get("tail", {})
+        tail_n = int(tail_cfg.get("batches", 8))
+        tail_gap = float(tail_cfg.get("gap_s", 0.05))
+        tail_records = int(tail_cfg.get("records_per_batch",
+                                        load_cfg.records_per_batch))
+        t_tail_wall = time.time()
+        breaches_mid = _breach_counts(registry)
+        base = workload if hasattr(workload, "build_batch") else \
+            SyntheticWorkload(load_cfg)
+        for i in range(tail_n):
+            pb = PlannedBatch(10_000 + i, None, tuple(
+                PlannedRecord("telegram", 10)
+                for _ in range(tail_records)))
+            chaos_bus.publish(TOPIC_INFERENCE_BATCHES,
+                              base.build_batch(pb).to_dict())
+            time.sleep(tail_gap)
+        if server is not None:
+            server.drain(timeout_s=drain_timeout_s)
+        tail_drained = handle.worker.drain(timeout_s=drain_timeout_s)
+        handle.worker.evaluate_slos()
+        breaches_tail = _delta(_breach_counts(registry), breaches_mid)
+        t_end = time.monotonic()
+
+        # --- measurement ---------------------------------------------------
+        spans = trace.TRACER.spans()
+        tail_queue_p95 = _p95_ms(spans, QUEUE_WAIT_SPANS, t_tail_wall)
+        tail_batch_p95 = _p95_ms(spans, BATCH_SPANS, t_tail_wall)
+        tail_age_p95 = _p95_ms(spans, BATCH_AGE_SPANS, t_tail_wall)
+
+        endpoints = {
+            "metrics": _scrape(port, "/metrics", as_json=False),
+            "costs": _scrape(port, "/costs", as_json=True),
+            "cluster": _scrape(port, "/cluster", as_json=True),
+        }
+
+        expected = chaos_bus.expected_uids()
+        crawl_ids = {load_cfg.crawl_id, crawler_cfg.crawl_id}
+        wcfg = getattr(workload, "cfg", None)
+        if wcfg is not None:
+            # Replay workloads write back under THEIR crawl_id, not the
+            # scenario's — reconcile over both or every replayed item
+            # counts as lost.
+            crawl_ids.add(wcfg.crawl_id)
+        written = _written_uids(provider, sorted(crawl_ids))
+        expected_set = set(expected)
+        lost = [u for u in expected if u not in written]
+        duplicates = [u for u, c in written.items() if c > 1]
+        processed = sum(min(c, 1) for u, c in written.items()
+                        if u in expected_set)
+        active_s = max(1e-6, t_end - t_b0)
+        goodput = processed / active_s
+
+        # --- the envelope --------------------------------------------------
+        checks: Dict[str, Dict[str, Any]] = {}
+
+        def check(key: str, ok: bool, value, budget) -> None:
+            checks[key] = {"ok": bool(ok), "value": value, "budget": budget}
+
+        check("drained", drained and tail_drained,
+              {"fault": drained, "tail": tail_drained}, True)
+        check("lost", len(lost) <= int(gate_cfg.get("max_lost", 0)),
+              len(lost), int(gate_cfg.get("max_lost", 0)))
+        check("duplicates",
+              len(duplicates) <= int(gate_cfg.get("max_duplicates", 0)),
+              len(duplicates), int(gate_cfg.get("max_duplicates", 0)))
+        for slo in gate_cfg.get("require_breach", []):
+            check(f"breach_{slo}", breaches_fault.get(slo, 0) > 0,
+                  breaches_fault.get(slo, 0), "> 0 during fault window")
+        for slo in gate_cfg.get("forbid_tail_breach", []):
+            check(f"tail_no_breach_{slo}",
+                  breaches_tail.get(slo, 0) == 0,
+                  breaches_tail.get(slo, 0), "0 in recovery tail")
+        if gate_cfg.get("queue_wait_p95_ms") is not None:
+            budget = float(gate_cfg["queue_wait_p95_ms"])
+            check("tail_queue_wait_p95_ms",
+                  tail_queue_p95 is not None and tail_queue_p95 <= budget,
+                  round(tail_queue_p95, 2) if tail_queue_p95 is not None
+                  else None, budget)
+        if gate_cfg.get("batch_p95_ms") is not None:
+            budget = float(gate_cfg["batch_p95_ms"])
+            check("tail_batch_p95_ms",
+                  tail_batch_p95 is not None and tail_batch_p95 <= budget,
+                  round(tail_batch_p95, 2) if tail_batch_p95 is not None
+                  else None, budget)
+        if gate_cfg.get("goodput_min_posts_per_s") is not None:
+            floor = float(gate_cfg["goodput_min_posts_per_s"])
+            check("goodput_posts_per_s", goodput >= floor,
+                  round(goodput, 2), f">= {floor}")
+        if gate_cfg.get("require_flight"):
+            events = flight.RECORDER.events()
+            start = 0
+            for i in range(len(events) - 1, -1, -1):
+                if events[i].get("kind") == "loadgen_run_start" \
+                        and events[i].get("mark") == run_mark:
+                    start = i
+                    break
+            kinds = {e.get("kind") for e in events[start:]}
+            for kind in gate_cfg["require_flight"]:
+                check(f"flight_{kind}", kind in kinds, kind in kinds, True)
+        for key in ("metrics", "costs", "cluster"):
+            check(f"endpoint_{key}", endpoints[key] is not None,
+                  endpoints[key] is not None, True)
+
+        stats = stats_box.get("stats")
+        verdict.update({
+            "status": "pass" if all(c["ok"] for c in checks.values())
+            else "fail",
+            "duration_s": round(time.monotonic() - t_run0, 2),
+            "published": {
+                **(stats.to_dict() if stats is not None else {}),
+                "dropped_batches": len(chaos_bus.dropped),
+                "poisoned_batches": len(chaos_bus.poisoned),
+            },
+            "expected_records": len(expected),
+            "processed_records": processed,
+            "lost": len(lost),
+            "duplicates": len(duplicates),
+            "goodput_posts_per_s": round(goodput, 2),
+            "fault_breaches": breaches_fault,
+            "tail_breaches": breaches_tail,
+            "tail_queue_wait_p95_ms": round(tail_queue_p95, 2)
+            if tail_queue_p95 is not None else None,
+            "tail_batch_p95_ms": round(tail_batch_p95, 2)
+            if tail_batch_p95 is not None else None,
+            "tail_batch_age_p95_ms": round(tail_age_p95, 2)
+            if tail_age_p95 is not None else None,
+            "fault_window_s": round(t_b1 - t_b0, 2),
+            "chaos_events": len(controller.events),
+            "worker_generations": handle.generation,
+            "cluster_workers": sorted(
+                (endpoints["cluster"] or {}).get("workers", {})),
+            "checks": checks,
+        })
+        if lost[:5]:
+            verdict["lost_sample"] = lost[:5]
+        return verdict
+    finally:
+        # Per-step isolation: one failing close (e.g. a killed worker's
+        # RemoteBus) must not leak the orchestrator threads, the HTTP/
+        # gRPC servers, or process-global seams into the next run in
+        # this process — and must never mask the verdict.
+        def _teardown(label: str, fn) -> None:
+            try:
+                fn()
+            except Exception as e:
+                logger.warning("loadgen teardown (%s) error: %s", label, e)
+
+        if controller is not None:
+            _teardown("controller", controller.stop)
+        if handle is not None:
+            _teardown("tpu-worker", handle.stop)
+        if crawl_worker is not None:
+            _teardown("crawl-worker", crawl_worker.stop)
+        if orch is not None:
+            _teardown("orchestrator", orch.stop)
+        if cluster_provider is not None:
+            _teardown("cluster-provider",
+                      lambda: clear_cluster_provider(cluster_provider))
+        if http_server is not None:
+            _teardown("http-server", http_server.shutdown)
+        if pool_installed:
+            from ..crawl import runner as crawl_runner
+
+            _teardown("connection-pool",
+                      crawl_runner.shutdown_connection_pool)
+        if inner_bus is not None:
+            _teardown("inmemory-bus", inner_bus.close)
+        if server is not None:
+            _teardown("grpc-bus", server.close)
+        shutil.rmtree(tmpdir, ignore_errors=True)

@@ -1,0 +1,354 @@
+"""Seeded synthetic workload source + flight-bundle replay.
+
+The generator is the "millions of users" stand-in: it emits
+`RecordBatch` work through the real bus so the orchestrator, crawl
+worker, and TPU worker run their production code paths against traffic
+with production shape — Zipf-distributed post lengths (crawl streams are
+short-message dominated with a long tail), a telegram/youtube platform
+mix, and a configurable arrival process:
+
+- ``poisson``: open-loop Poisson arrivals at ``rate_batches_per_s`` —
+  offered load does NOT slow down when the service backs up, which is
+  what makes queue growth visible;
+- ``ramp``: closed-loop concurrency ramp — at most ``window`` batches
+  outstanding (per a caller-supplied ``pending_fn``), the window ramping
+  linearly from ``ramp_from`` to ``ramp_to`` over the run.
+
+Everything derives from ``seed`` through one `random.Random`, so the
+same seed reproduces identical batch shapes and arrival schedules
+(asserted by tests/test_loadgen.py).
+
+Replay: :func:`workload_from_bundle` rebuilds a workload from a
+flight-recorder/postmortem bundle — batch count, per-batch record
+counts, total token (word) volume, and arrival gaps — turning every
+postmortem under ``--dump-dir`` into a reproducible load test.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..bus.codec import RecordBatch
+from ..bus.messages import TOPIC_INFERENCE_BATCHES, VALID_PLATFORMS
+from ..datamodel.post import Post
+from ..utils import flight
+
+logger = logging.getLogger("dct.loadgen")
+
+# Same 997-word synthetic vocabulary as bench.py's `_zipf_text`: words
+# repeat (compression and tokenizer memos see realistic reuse) but no two
+# texts are identical.
+_VOCAB = 997
+
+
+def zipf_text(phase: int, n_words: int) -> str:
+    """Deterministic Zipf-ish text: ``n_words`` words from a 997-word
+    vocabulary with per-text phase."""
+    return " ".join(f"w{(phase * 31 + j * 7) % _VOCAB}"
+                    for j in range(max(1, n_words)))
+
+
+@dataclass(frozen=True)
+class PlannedRecord:
+    """Shape of one synthetic post before it is materialized."""
+
+    platform: str
+    words: int
+
+
+@dataclass(frozen=True)
+class PlannedBatch:
+    """Shape + arrival slot of one batch; ``offset_s`` is None for
+    closed-loop arrivals (the completion feedback sets the time)."""
+
+    index: int
+    offset_s: Optional[float]
+    records: tuple  # of PlannedRecord
+
+
+@dataclass
+class LoadGenConfig:
+    seed: int = 0
+    duration_s: float = 5.0
+    arrival: str = "poisson"            # poisson | ramp
+    rate_batches_per_s: float = 10.0    # poisson
+    ramp_from: int = 1                  # ramp: starting concurrency window
+    ramp_to: int = 8                    # ramp: final concurrency window
+    ramp_batches: int = 50              # ramp: total batches to offer
+    records_per_batch: int = 8
+    zipf_a: float = 1.6                 # post-length tail exponent
+    max_words: int = 120
+    platform_mix: Dict[str, float] = field(
+        default_factory=lambda: {"telegram": 0.8, "youtube": 0.2})
+    crawl_id: str = "loadgen"
+
+    def validate(self) -> None:
+        if self.arrival not in ("poisson", "ramp"):
+            raise ValueError(f"unknown arrival process {self.arrival!r}")
+        if self.duration_s <= 0:
+            raise ValueError("duration_s must be positive")
+        if self.arrival == "poisson" and self.rate_batches_per_s <= 0:
+            raise ValueError("rate_batches_per_s must be positive")
+        bad = set(self.platform_mix) - set(VALID_PLATFORMS)
+        if bad:
+            raise ValueError(f"platform_mix names unknown platforms: "
+                             f"{sorted(bad)}")
+        if not self.platform_mix or \
+                sum(self.platform_mix.values()) <= 0:
+            raise ValueError("platform_mix must have positive weight")
+
+
+@dataclass
+class RunStats:
+    """What actually went onto the bus (the reconciliation source of
+    truth lives in the chaos bus ledger; these are the generator-side
+    totals)."""
+
+    batches: int = 0
+    records: int = 0
+    words: int = 0
+    first_at: float = 0.0
+    last_at: float = 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"batches": self.batches, "records": self.records,
+                "words": self.words,
+                "span_s": round(max(0.0, self.last_at - self.first_at), 3)}
+
+
+class _WorkloadBase:
+    """Shared publish loop over a precomputed plan."""
+
+    cfg: LoadGenConfig
+
+    def plan(self) -> List[PlannedBatch]:
+        raise NotImplementedError
+
+    # -- materialization ----------------------------------------------------
+    def build_batch(self, pb: PlannedBatch) -> RecordBatch:
+        posts = []
+        for j, rec in enumerate(pb.records):
+            uid = f"lg{self.cfg.seed}-{pb.index}-{j}"
+            posts.append(Post(
+                post_uid=uid,
+                channel_id=f"lgchan{pb.index % 7}",
+                channel_name=f"lgchan{pb.index % 7}",
+                post_link=f"https://sim/{uid}",
+                platform_name=rec.platform,
+                description=zipf_text(pb.index * 131 + j, rec.words)))
+        return RecordBatch.from_posts(posts, crawl_id=self.cfg.crawl_id)
+
+    # -- publishing ---------------------------------------------------------
+    def run(self, bus, topic: str = TOPIC_INFERENCE_BATCHES,
+            stop: Optional[threading.Event] = None,
+            pending_fn: Optional[Callable[[], int]] = None,
+            record_flight: bool = True) -> RunStats:
+        """Publish the planned workload through ``bus`` in real time.
+
+        Open-loop plans honor each batch's ``offset_s`` against a
+        monotonic clock (a slow consumer does NOT slow the offered
+        load); closed-loop plans publish whenever ``pending_fn()`` is
+        below the ramping window.  Each published batch is flight-
+        recorded as a ``loadgen_batch`` event (records + words), which
+        is what :func:`workload_from_bundle` replays from.
+        """
+        stats = RunStats()
+        stop = stop or threading.Event()
+        t0 = time.monotonic()
+        deadline = t0 + self.cfg.duration_s
+
+        def publish(pb: PlannedBatch) -> None:
+            batch = self.build_batch(pb)
+            words = sum(r.words for r in pb.records)
+            bus.publish(topic, batch.to_dict())
+            now = time.monotonic()
+            if stats.batches == 0:
+                stats.first_at = now
+            stats.last_at = now
+            stats.batches += 1
+            stats.records += len(pb.records)
+            stats.words += words
+            if record_flight:
+                flight.record("loadgen_batch", batch=batch.batch_id,
+                              records=len(pb.records), words=words,
+                              offset_s=round(now - t0, 4))
+
+        plan = self.plan()
+        closed = any(pb.offset_s is None for pb in plan)
+        if closed and pending_fn is None:
+            raise ValueError(
+                "closed-loop (ramp) workloads need a pending_fn for "
+                "completion feedback")
+        for pb in plan:
+            if stop.is_set():
+                break
+            if pb.offset_s is not None:
+                target = t0 + pb.offset_s
+                while not stop.is_set():
+                    now = time.monotonic()
+                    if now >= target:
+                        break
+                    stop.wait(min(0.02, target - now))
+                if stop.is_set():
+                    break
+            else:
+                window = self._ramp_window(time.monotonic() - t0)
+                while not stop.is_set() and time.monotonic() < deadline \
+                        and pending_fn() >= window:
+                    stop.wait(0.005)
+                    window = self._ramp_window(time.monotonic() - t0)
+                if stop.is_set() or time.monotonic() >= deadline:
+                    break
+            publish(pb)
+        return stats
+
+    def _ramp_window(self, elapsed_s: float) -> int:
+        frac = min(1.0, max(0.0, elapsed_s / self.cfg.duration_s))
+        return max(1, round(self.cfg.ramp_from
+                            + frac * (self.cfg.ramp_to
+                                      - self.cfg.ramp_from)))
+
+
+class SyntheticWorkload(_WorkloadBase):
+    """The fully-seeded synthetic source (see module docstring)."""
+
+    def __init__(self, cfg: LoadGenConfig):
+        cfg.validate()
+        self.cfg = cfg
+        self._plan: Optional[List[PlannedBatch]] = None
+
+    def plan(self) -> List[PlannedBatch]:
+        """Deterministic batch shapes + arrival slots from the seed."""
+        if self._plan is not None:
+            return self._plan
+        rng = random.Random(self.cfg.seed)
+        out: List[PlannedBatch] = []
+        if self.cfg.arrival == "poisson":
+            t = 0.0
+            i = 0
+            while True:
+                t += rng.expovariate(self.cfg.rate_batches_per_s)
+                if t >= self.cfg.duration_s:
+                    break
+                out.append(PlannedBatch(i, round(t, 6),
+                                        self._records(rng)))
+                i += 1
+        else:  # ramp: shapes only; completion feedback paces them
+            for i in range(self.cfg.ramp_batches):
+                out.append(PlannedBatch(i, None, self._records(rng)))
+        self._plan = out
+        return out
+
+    def _records(self, rng: random.Random) -> tuple:
+        platforms = sorted(self.cfg.platform_mix)
+        weights = [self.cfg.platform_mix[p] for p in platforms]
+        recs = []
+        for _ in range(self.cfg.records_per_batch):
+            platform = rng.choices(platforms, weights=weights)[0]
+            # Bounded Pareto: mostly-short posts with a heavy tail —
+            # the inverse-CDF form keeps it a pure function of the rng.
+            u = max(1e-9, 1.0 - rng.random())
+            words = int(u ** (-1.0 / max(0.1, self.cfg.zipf_a - 1.0)))
+            recs.append(PlannedRecord(platform,
+                                      max(1, min(self.cfg.max_words,
+                                                 words))))
+        return tuple(recs)
+
+
+class ReplayWorkload(_WorkloadBase):
+    """A workload reconstructed from a recorded run (see
+    :func:`workload_from_bundle`): same batch count, record counts,
+    token volume, and arrival gaps as the original."""
+
+    def __init__(self, batches: List[PlannedBatch],
+                 cfg: Optional[LoadGenConfig] = None,
+                 source: str = ""):
+        self.cfg = cfg or LoadGenConfig(crawl_id="replay")
+        if batches:
+            last = max((pb.offset_s or 0.0) for pb in batches)
+            self.cfg.duration_s = max(self.cfg.duration_s, last + 1.0)
+        self._batches = batches
+        self.source = source
+
+    def plan(self) -> List[PlannedBatch]:
+        return self._batches
+
+    def totals(self) -> Dict[str, int]:
+        return {
+            "batches": len(self._batches),
+            "records": sum(len(pb.records) for pb in self._batches),
+            "words": sum(r.words for pb in self._batches
+                         for r in pb.records),
+        }
+
+
+def _spread_words(total: int, n: int) -> List[int]:
+    """Split ``total`` words over ``n`` records exactly (no drift: the
+    replay's token volume must match the recording within rounding)."""
+    if n <= 0:
+        return []
+    base = max(1, total // n)
+    words = [base] * n
+    words[-1] = max(1, total - base * (n - 1))
+    return words
+
+
+def workload_from_bundle(path: str,
+                         mean_words: int = 12) -> ReplayWorkload:
+    """Rebuild a workload from a postmortem/flight bundle JSON file.
+
+    Two sources, best first:
+
+    - ``loadgen_batch`` flight events (runs driven by this module):
+      exact record counts, word totals, and arrival offsets;
+    - ``orchestrator.dispatch`` spans in the bundle's trace export
+      (organic runs): record counts + arrival times, with
+      ``mean_words`` standing in for the unrecorded text volume.
+
+    Raises ``ValueError`` when the bundle carries neither — an empty
+    replay would silently "pass" any gate.
+    """
+    with open(path, "r", encoding="utf-8") as f:
+        bundle = json.load(f)
+    events = [e for e in bundle.get("flight", [])
+              if e.get("kind") == "loadgen_batch"]
+    batches: List[PlannedBatch] = []
+    if events:
+        events.sort(key=lambda e: e.get("ts", 0.0))
+        t0 = events[0].get("ts", 0.0)
+        for i, e in enumerate(events):
+            n = int(e.get("records") or 0)
+            words = _spread_words(int(e.get("words") or n * mean_words), n)
+            offset = e.get("offset_s")
+            if offset is None:
+                offset = max(0.0, e.get("ts", t0) - t0)
+            batches.append(PlannedBatch(
+                i, round(float(offset), 6),
+                tuple(PlannedRecord("telegram", w) for w in words)))
+        return ReplayWorkload(batches, source=f"{path}:flight")
+    # Organic runs: the dispatch spans that rooted each batch's trace.
+    spans = []
+    for tr in bundle.get("traces", {}).get("traces", []):
+        for s in tr.get("spans", []):
+            if s.get("name") == "orchestrator.dispatch" \
+                    and s.get("attrs", {}).get("records"):
+                spans.append(s)
+    if not spans:
+        raise ValueError(
+            f"bundle {path} carries no loadgen_batch flight events and "
+            f"no orchestrator.dispatch batch spans; nothing to replay")
+    spans.sort(key=lambda s: s.get("start_wall", 0.0))
+    t0 = spans[0].get("start_wall", 0.0)
+    for i, s in enumerate(spans):
+        n = int(s["attrs"]["records"])
+        words = _spread_words(n * mean_words, n)
+        batches.append(PlannedBatch(
+            i, round(max(0.0, s.get("start_wall", t0) - t0), 6),
+            tuple(PlannedRecord("telegram", w) for w in words)))
+    return ReplayWorkload(batches, source=f"{path}:traces")

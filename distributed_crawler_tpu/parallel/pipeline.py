@@ -36,10 +36,15 @@ AXIS_PP = "pp"
 
 
 def _pvary(x):
-    """Mark ``x`` as device-varying over pp (API moved pvary -> pcast)."""
+    """Mark ``x`` as device-varying over pp (API moved pvary -> pcast);
+    identity on jax versions that predate varying-type tracking — their
+    shard_map runs with replication checking off instead (see
+    `pipeline_apply`)."""
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, (AXIS_PP,), to="varying")
-    return jax.lax.pvary(x, (AXIS_PP,))
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, (AXIS_PP,))
+    return x
 
 
 def make_pp_mesh(devices: Optional[Sequence] = None) -> Mesh:
@@ -108,9 +113,15 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
         return jax.lax.psum(finished * is_last, AXIS_PP)
 
     spec_params = jax.tree_util.tree_map(lambda _: P(AXIS_PP), stacked_params)
-    out = jax.shard_map(
+    try:
+        from jax import shard_map
+        check_kw = {}  # varying-ness is tracked via _pvary
+    except ImportError:  # pragma: no cover - older jax (ring.py's twin)
+        from jax.experimental.shard_map import shard_map
+        check_kw = {"check_rep": False}
+    out = shard_map(
         per_stage, mesh=mesh,
         in_specs=(spec_params, P()),  # params split by stage; stream replicated
-        out_specs=P(),
+        out_specs=P(), **check_kw,
     )(stacked_params, x)
     return out

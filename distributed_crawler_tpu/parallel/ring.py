@@ -55,14 +55,24 @@ def _block_attend(q, k, v, kv_mask, scale):
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    kv_mask: Optional[jax.Array] = None,
                    axis_name: str = AXIS_SP,
-                   scale: Optional[float] = None) -> jax.Array:
+                   scale: Optional[float] = None,
+                   axis_size: Optional[int] = None) -> jax.Array:
     """Bidirectional ring attention; call inside shard_map with ``axis_name``.
 
     Shapes are per-shard: q/k/v [B, L_local, H, D], kv_mask [B, L_local].
     The kv block (and its mask) rotates around the ring; the online-softmax
     carry (o, m, l) stays local.  ``axis_size`` steps, one ppermute each.
+    ``axis_size`` may be passed explicitly (`make_ring_attention` threads
+    the mesh's); on jax versions without `lax.axis_size` it is required —
+    `lax.psum(1, axis)` is NOT a substitute (inside shard_map on those
+    versions it misses the axis env and returns 1).
     """
-    axis_size = jax.lax.axis_size(axis_name)
+    if axis_size is None:
+        if not hasattr(jax.lax, "axis_size"):
+            raise TypeError(
+                "this jax has no lax.axis_size; pass axis_size= (the mesh "
+                "axis size) explicitly or use make_ring_attention(mesh)")
+        axis_size = jax.lax.axis_size(axis_name)
     d = q.shape[-1]
     scale = scale if scale is not None else d ** -0.5
 
@@ -119,6 +129,7 @@ def make_ring_attention(mesh, scale: Optional[float] = None):
              in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
              out_specs=qkv_spec, **_check_kw)
     def _ring(q, k, v, kv_mask):
-        return ring_attention(q, k, v, kv_mask, axis_name=AXIS_SP, scale=scale)
+        return ring_attention(q, k, v, kv_mask, axis_name=AXIS_SP,
+                              scale=scale, axis_size=mesh.shape[AXIS_SP])
 
     return _ring

@@ -39,6 +39,13 @@ logger = logging.getLogger("dct.slo")
 BATCH_SPANS = ("tpu_worker.process", "tpu_worker.coalesce",
                "worker.process")
 QUEUE_WAIT_SPANS = ("tpu_worker.queue_wait",)
+# Whole-pipeline age of a record batch (creation -> device), recorded by
+# the TPU worker from ``RecordBatch.created_at``.  Unlike queue_wait —
+# which only sees time inside THIS worker's queue — batch age covers the
+# bus/broker leg, so it is the budget that catches a dead worker's
+# backlog: frames stranded on the broker while the worker was down come
+# back old, even though they clear the local queue instantly.
+BATCH_AGE_SPANS = ("tpu_worker.batch_age",)
 
 
 @dataclass(frozen=True)
@@ -52,13 +59,16 @@ class SLO:
 
 
 def standard_slos(batch_p95_ms: float = 0.0,
-                  queue_wait_ms: float = 0.0) -> List[SLO]:
-    """The CLI's budget pair; zero/negative budgets are simply absent."""
+                  queue_wait_ms: float = 0.0,
+                  batch_age_ms: float = 0.0) -> List[SLO]:
+    """The CLI's budget set; zero/negative budgets are simply absent."""
     out: List[SLO] = []
     if batch_p95_ms > 0:
         out.append(SLO("batch_p95", BATCH_SPANS, batch_p95_ms))
     if queue_wait_ms > 0:
         out.append(SLO("queue_wait", QUEUE_WAIT_SPANS, queue_wait_ms))
+    if batch_age_ms > 0:
+        out.append(SLO("batch_age", BATCH_AGE_SPANS, batch_age_ms))
     return out
 
 

@@ -148,6 +148,27 @@ class CrawlWorker:
         self.sm.close()
         logger.info("worker stopped", extra={"worker_id": self.id})
 
+    def kill(self) -> None:
+        """Abrupt-death simulation (the chaos/`loadgen` seam): stop the
+        heartbeat loop WITHOUT the stopping status message or the state-
+        manager close — the in-process analog of SIGKILL.  The orchestrator
+        discovers the death the production way: heartbeats go silent until
+        `check_worker_health` marks the worker offline and reassigns its
+        in-flight items."""
+        with self._mu:
+            self._running = False
+        flight.record("worker_kill", worker=self.id,
+                      current_work=(self.current_work.id
+                                    if self.current_work else None))
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads.clear()
+
+    def evaluate_slos(self) -> list:
+        """One on-demand SLO tick (the heartbeat loop's twin) — see
+        `TPUWorker.evaluate_slos`."""
+        return self._slo.evaluate()
+
     @property
     def is_running(self) -> bool:
         with self._mu:

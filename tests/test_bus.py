@@ -7,6 +7,7 @@ import json
 import pytest
 
 from distributed_crawler_tpu.bus import (
+    ChaosMessage,
     ControlMessage,
     DiscoveredPage,
     InMemoryBus,
@@ -120,6 +121,7 @@ class TestMessageRegistry:
             StatusMessage: StatusMessage.new("w1", "heartbeat", "idle"),
             ControlMessage: ControlMessage(message_type="pause",
                                            trace_id="trace_x"),
+            ChaosMessage: ChaosMessage.new("kill", "tpu-1", at_s=1.5),
         }
         assert set(MESSAGE_REGISTRY.values()) == set(samples)
         for cls, msg in samples.items():
@@ -152,6 +154,40 @@ class TestMessageRegistry:
         msg = WorkQueueMessage.new(item)
         decoded = decode_message(msg.to_dict())
         assert decoded.trace_id == item.trace_id
+
+    def test_chaos_message_roundtrip_and_fields(self):
+        from distributed_crawler_tpu.bus import decode_message
+
+        msg = ChaosMessage.new("delay", "bus", at_s=5.0, until_s=6.0,
+                               parameters={"arg_s": 0.2})
+        msg.validate()
+        assert msg.trace_id.startswith("trace_")
+        decoded = decode_message(json.loads(json.dumps(msg.to_dict())))
+        assert type(decoded) is ChaosMessage
+        assert decoded.action == "delay"
+        assert decoded.target_id == "bus"
+        assert decoded.at_s == 5.0 and decoded.until_s == 6.0
+        assert decoded.parameters == {"arg_s": 0.2}
+        assert decoded.trace_id == msg.trace_id
+        assert decoded.timestamp == msg.timestamp
+
+    def test_chaos_message_validation_errors(self):
+        with pytest.raises(ValueError, match="unknown chaos action"):
+            ChaosMessage.new("explode", "tpu-1", at_s=0.0).validate()
+        with pytest.raises(ValueError, match="target cannot be empty"):
+            ChaosMessage.new("kill", "", at_s=0.0).validate()
+        bad = ChaosMessage.new("kill", "tpu-1", at_s=0.0)
+        bad.message_type = "bogus"
+        with pytest.raises(ValueError, match="invalid chaos message type"):
+            bad.validate()
+
+    def test_chaos_actions_match_timeline_parser(self):
+        """The envelope's action vocabulary IS the chaos controller's —
+        a scenario line that parses must announce as a valid message."""
+        from distributed_crawler_tpu.bus.messages import CHAOS_ACTIONS
+        from distributed_crawler_tpu.loadgen.chaos import _ACTIONS
+
+        assert set(CHAOS_ACTIONS) == set(_ACTIONS)
 
 
 def make_posts(n):

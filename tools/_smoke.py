@@ -30,10 +30,11 @@ def selfcheck() -> int:
     """`python tools/_smoke.py`: the cheap pre-bench sanity gate — byte-
     compile the whole package (catches syntax/indentation rot in modules no
     test imports), run crawlint (`python -m tools.analyze`; the
-    repo-native static checkers, docs/static-analysis.md), the
-    postmortem + perfreport renderers' selfchecks, then the metrics +
-    tracing + fleet + perf-observability unit tests the other tools'
-    /metrics, /traces, /cluster, and /costs reads depend on."""
+    repo-native static checkers, docs/static-analysis.md), the loadtest
+    harness smoke (every checked-in loadgen scenario parses end to end),
+    the postmortem + perfreport renderers' selfchecks, then the metrics +
+    tracing + fleet + perf-observability + loadgen unit tests the other
+    tools' /metrics, /traces, /cluster, and /costs reads depend on."""
     import compileall
     import subprocess
 
@@ -45,6 +46,12 @@ def selfcheck() -> int:
     rc = subprocess.call([sys.executable, "-m", "tools.analyze"], cwd=repo)
     if rc != 0:
         print("crawlint FAILED (python -m tools.analyze)", file=sys.stderr)
+        return rc
+    rc = subprocess.call(
+        [sys.executable, "-m", "tools.loadtest", "--smoke"], cwd=repo)
+    if rc != 0:
+        print("loadtest smoke FAILED (python -m tools.loadtest --smoke)",
+              file=sys.stderr)
         return rc
     rc = subprocess.call(
         [sys.executable, os.path.join(repo, "tools", "postmortem.py"),
@@ -63,7 +70,8 @@ def selfcheck() -> int:
         [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
          os.path.join(repo, "tests", "test_metrics_trace.py"),
          os.path.join(repo, "tests", "test_fleet_telemetry.py"),
-         os.path.join(repo, "tests", "test_perf_observability.py")],
+         os.path.join(repo, "tests", "test_perf_observability.py"),
+         os.path.join(repo, "tests", "test_loadgen.py")],
         env=env, cwd=repo)
 
 
