@@ -39,7 +39,7 @@ from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 import grpc
 
-from ..utils import trace
+from ..utils import resilience, trace
 from .payload import serialize_payload
 
 logger = logging.getLogger("dct.bus.grpc")
@@ -99,6 +99,14 @@ class GrpcBusServer:
         self.address = address
         self.ack_timeout_s = ack_timeout_s
         self.max_attempts = max_attempts
+        # Local-handler delivery policy: the backoff/attempt schedule is
+        # declared ONCE (utils/resilience.py) instead of hand-rolled per
+        # loop; a handler raising a FLOOD_WAIT-style error (carrying
+        # ``retry_after_s``) gets its server-directed backoff honoured,
+        # capped so one hostile hint can't park a topic's dispatch thread.
+        self._local_retry = resilience.RetryPolicy(
+            max_attempts=max_attempts, base_delay_s=0.05, max_delay_s=0.5,
+            jitter=0.0, retry_after_cap_s=2.0)
         self._handlers: Dict[str, list] = {}
         self._pull_queues: Dict[str, _TopicQueue] = {}
         self._lock = threading.RLock()
@@ -172,21 +180,13 @@ class GrpcBusServer:
                 with trace.payload_span("bus.deliver", decoded, topic=topic,
                                         transport="grpc-local"):
                     for handler in handlers:
-                        delivered = False
-                        for attempt in range(self.max_attempts):
-                            try:
-                                handler(decoded)
-                                delivered = True
-                                break
-                            except Exception as e:
-                                logger.warning(
-                                    "local handler error on %s "
-                                    "(attempt %d/%d): %s", topic, attempt + 1,
-                                    self.max_attempts, e)
-                                if attempt + 1 < self.max_attempts:
-                                    self._stop.wait(min(0.05 * (2 ** attempt),
-                                                        0.5))
-                        if not delivered:
+                        try:
+                            # Stop-event-aware waits: close() never blocks
+                            # on a backoff mid-drain.
+                            resilience.retry_call(
+                                handler, decoded, retry=self._local_retry,
+                                op=f"bus.local.{topic}", stop=self._stop)
+                        except Exception:
                             self._count_dead_letter()
                             logger.error(
                                 "dead-lettering local delivery on %s after "
@@ -485,6 +485,13 @@ class RemoteBus:
                  max_redeliveries: int = 3):
         self._client = GrpcBusClient(target)
         self.max_redeliveries = max_redeliveries
+        # Inline-redelivery policy (shared utils/resilience.py schedule):
+        # base delay 0 preserves the historical immediate retries, but a
+        # server-directed ``retry_after_s`` hint (FLOOD_WAIT taxonomy) is
+        # honoured, capped to keep the pull thread responsive.
+        self._retry = resilience.RetryPolicy(
+            max_attempts=max_redeliveries + 1, base_delay_s=0.0,
+            jitter=0.0, retry_after_cap_s=2.0)
         self._handlers: Dict[str, list] = {}
         self._threads: Dict[str, threading.Thread] = {}
         self._lock = threading.RLock()
@@ -591,18 +598,14 @@ class RemoteBus:
         with trace.payload_span("bus.deliver", payload, topic=topic,
                                 transport="grpc"):
             for handler, _ in handlers:
-                delivered = False
-                for attempt in range(self.max_redeliveries + 1):
-                    try:
-                        handler(payload)
-                        delivered = True
-                        break
-                    except Exception as e:
-                        logger.warning(
-                            "handler error on %s (attempt %d/%d): %s",
-                            topic, attempt + 1,
-                            self.max_redeliveries + 1, e)
-                ok = ok and delivered
+                try:
+                    resilience.retry_call(
+                        handler, payload, retry=self._retry,
+                        op=f"bus.remote.{topic}", stop=self._stop)
+                except Exception as e:
+                    logger.error("handler exhausted redeliveries on %s: %s",
+                                 topic, e)
+                    ok = False
         # NACK on final failure: the server requeues (bumping its attempt
         # count) so another worker can take the item instead of it being
         # silently dropped.

@@ -21,7 +21,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ..utils import trace
+from ..utils import resilience, trace
 from .payload import serialize_payload
 
 logger = logging.getLogger("dct.bus")
@@ -39,6 +39,14 @@ class InMemoryBus:
         self.max_redeliveries = max_redeliveries
         self.retry_delay_s = retry_delay_s
         self.sync = sync
+        # Redelivery schedule declared through the shared policy layer
+        # (utils/resilience.py): fixed delay (multiplier 1) preserves the
+        # historical behavior; FLOOD_WAIT-style ``retry_after_s`` hints
+        # on handler errors are honoured, capped.
+        self._retry = resilience.RetryPolicy(
+            max_attempts=max_redeliveries + 1, base_delay_s=retry_delay_s,
+            max_delay_s=max(retry_delay_s, 1.0), multiplier=1.0,
+            jitter=0.0, retry_after_cap_s=2.0)
         self._handlers: Dict[str, List[Handler]] = {}
         self._lock = threading.RLock()
         self._queue: "queue.Queue[Tuple[str, bytes]]" = queue.Queue()
@@ -116,20 +124,15 @@ class InMemoryBus:
         with trace.payload_span("bus.deliver", payload, topic=topic,
                                 transport="inmemory"):
             for handler in handlers:
-                delivered = False
-                last_err = ""
-                for attempt in range(self.max_redeliveries + 1):
-                    try:
-                        handler(payload)
-                        delivered = True
-                        break
-                    except Exception as e:  # handler error -> retry (`pubsub.go:166-171`)
-                        last_err = str(e)
-                        logger.warning("handler error on %s (attempt %d/%d): %s",
-                                       topic, attempt + 1,
-                                       self.max_redeliveries + 1, e)
-                        if self.retry_delay_s > 0:
-                            time.sleep(self.retry_delay_s)
+                delivered, last_err = True, ""
+                try:
+                    # Handler error -> retry (`pubsub.go:166-171`), via
+                    # the shared policy layer.
+                    resilience.retry_call(handler, payload,
+                                          retry=self._retry,
+                                          op=f"bus.inmemory.{topic}")
+                except Exception as e:
+                    delivered, last_err = False, str(e)
                 with self._lock:
                     if delivered:
                         self._delivered_count[topic] = \

@@ -553,8 +553,8 @@ class ControlMessage:
 # Fault actions the chaos controller knows how to apply
 # (`loadgen/chaos.py`); `validate()` rejects anything else at decode time
 # so a typo'd scenario line fails loudly instead of silently no-opping.
-CHAOS_ACTIONS = ("kill", "restart", "stall", "wedge", "delay", "drop",
-                 "poison")
+CHAOS_ACTIONS = ("kill", "restart", "down", "stall", "wedge", "delay",
+                 "drop", "poison")
 
 
 @dataclass

@@ -219,6 +219,16 @@ def build_parser() -> argparse.ArgumentParser:
     a("--infer-backpressure-low", type=int, default=None,
       help="distribution resumes once the backlog drains below this "
            "(default 32)")
+    # Crash recovery (orchestrator mode): the crawl journal + resume.
+    a("--journal-dir", default=None,
+      help="orchestrator crash-recovery journal directory (default: "
+           "<dump-dir>/orch-journal/<crawl-id> when --dump-dir is set, "
+           "else <storage-root>/<crawl-id>/orch-journal); an existing "
+           "journal or persisted crawl is RESUMED, not re-seeded")
+    a("--fresh", action="store_const", const=True, default=None,
+      help="discard any existing crawl state + journal and re-seed "
+           "(without this, orchestrator mode refuses to clobber an "
+           "existing crawl)")
     # Media transcription (mode=transcribe): BASELINE config #4 — Whisper
     # over a crawl's media tree.
     a("--asr-pretrained-dir", default=None,
@@ -411,6 +421,8 @@ _KEY_MAP = {
     "infer_model": "inference.model",
     "infer_backpressure_high": "distributed.inference_backpressure_high",
     "infer_backpressure_low": "distributed.inference_backpressure_low",
+    "journal_dir": "orchestrator.journal_dir",
+    "fresh": "orchestrator.fresh",
     "infer_batch_size": "inference.batch_size",
     "infer_attention": "inference.attention",
     "infer_moe_dispatch": "inference.moe_dispatch",
@@ -1000,7 +1012,7 @@ def _run_orchestrator(urls: List[str], cfg: CrawlerConfig,
                       r: ConfigResolver) -> None:
     """`main.go:647-706`."""
     from .modes.common import create_state_manager
-    from .orchestrator import Orchestrator
+    from .orchestrator import CrawlJournal, Orchestrator
     from .orchestrator.orchestrator import OrchestratorConfig
     bus = _make_bus(r, serve=True)
     sm = create_state_manager(cfg, cfg.crawl_id)
@@ -1008,12 +1020,34 @@ def _run_orchestrator(urls: List[str], cfg: CrawlerConfig,
         inference_backpressure_high=r.get_int(
             "distributed.inference_backpressure_high", 64),
         inference_backpressure_low=r.get_int(
-            "distributed.inference_backpressure_low", 32))
-    orch = Orchestrator(cfg.crawl_id, cfg, bus, sm, ocfg=ocfg)
+            "distributed.inference_backpressure_low", 32),
+        state_retry_attempts=r.get_int("resilience.state_retry_attempts", 2),
+        state_breaker_threshold=r.get_int(
+            "resilience.state_breaker_threshold", 5),
+        state_breaker_recovery_s=r.get_float(
+            "resilience.state_breaker_recovery_s", 15.0),
+        publish_retry_attempts=r.get_int(
+            "resilience.publish_retry_attempts", 3))
+    # Crash-recovery journal (docs/operations.md "Crash recovery &
+    # resiliency policies"): default location follows --dump-dir, falling
+    # back to the crawl's storage root.
+    journal_dir = r.get_str("orchestrator.journal_dir", "")
+    if not journal_dir:
+        # Default paths are keyed by crawl id so a shared dump dir never
+        # hands one crawl another crawl's journal (the orchestrator also
+        # verifies the journal's recorded crawl id before resuming).
+        dump_dir = r.get_str("observability.dump_dir", "")
+        crawl = cfg.crawl_id or "crawl"
+        journal_dir = (
+            os.path.join(dump_dir, "orch-journal", crawl) if dump_dir
+            else os.path.join(cfg.storage_root or "/tmp/crawl", crawl,
+                              "orch-journal"))
+    orch = Orchestrator(cfg.crawl_id, cfg, bus, sm, ocfg=ocfg,
+                        journal=CrawlJournal(journal_dir))
     from .utils.metrics import set_cluster_provider, set_status_provider
     set_status_provider(orch.get_status)  # /status (`orchestrator.go:596`)
     set_cluster_provider(orch.get_cluster)  # /cluster fleet view
-    orch.start(urls)
+    orch.start(urls, fresh=r.get_bool("orchestrator.fresh", False))
     try:
         _serve_forever(
             running=lambda: orch.is_running and not orch.crawl_completed)

@@ -19,6 +19,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import OrderedDict
 
 from ..bus.codec import BatchAccumulator, RecordBatch
 from ..bus.messages import TOPIC_INFERENCE_BATCHES
@@ -33,7 +34,7 @@ class InferenceBridge:
 
     def __init__(self, sm, bus, crawl_id: str = "", batch_size: int = 256,
                  deadline_s: float = 0.05, topic: str = TOPIC_INFERENCE_BATCHES,
-                 poll_interval_s: float = 0.02):
+                 poll_interval_s: float = 0.02, dedupe_window: int = 65536):
         self._sm = sm
         self._bus = bus
         self._topic = topic
@@ -44,6 +45,14 @@ class InferenceBridge:
         self._stop = threading.Event()
         self.batches_published = 0
         self.posts_bridged = 0
+        self.posts_deduped = 0
+        # At-least-once crawling (worker reassignment, stale-work requeue,
+        # orchestrator crash-resume) can legitimately re-crawl a page whose
+        # posts already shipped; post_uid is deterministic (chat_id +
+        # message_id), so a bounded recently-seen window keeps re-crawled
+        # posts from double-counting downstream.  0 disables.
+        self._dedupe_window = max(0, dedupe_window)
+        self._seen_uids: "OrderedDict[str, None]" = OrderedDict()
         # Deadline flusher: a partial batch older than deadline_s ships even
         # if the crawl stalls.
         self._thread = threading.Thread(target=self._poll_loop, daemon=True,
@@ -56,6 +65,15 @@ class InferenceBridge:
         self._sm.store_post(channel_id, post)
         now = time.monotonic()
         with self._lock:
+            uid = post.post_uid
+            if uid and self._dedupe_window:
+                if uid in self._seen_uids:
+                    self._seen_uids.move_to_end(uid)
+                    self.posts_deduped += 1
+                    return  # already shipped to inference once
+                self._seen_uids[uid] = None
+                while len(self._seen_uids) > self._dedupe_window:
+                    self._seen_uids.popitem(last=False)
             self.posts_bridged += 1
             batch = self._acc.add(post, now)
         if batch is not None:

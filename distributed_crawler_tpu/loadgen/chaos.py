@@ -5,12 +5,20 @@ to an offset from scenario start:
 
     at=2s kill tpu-1              # abrupt worker death (no drain, no ack)
     at=4s restart tpu-1           # supervisor-style restart
+    from=1s..2s down tpu-1        # kill at window start, restart at end
     at=3s stall tpu-1 1.5s        # device call blocks 1.5s mid-step
     from=1s..2.5s wedge tpu-1     # backend wedged for the window
                                   # (the BENCH_r01 failure mode)
     from=5s..6s delay bus 200ms   # every inference publish +200ms
     from=5s..6s drop bus          # inference publishes dropped
     at=2s poison batch            # next batch's records undecodable
+
+Kill/restart/down apply to ANY registered target with ``kill()`` /
+``restart()`` — including the ``orchestrator`` handle the gate registers,
+so a timeline can take the coordinator itself down mid-crawl and assert
+the journal-based resume (`orchestrator/journal.py`):
+
+    from=1.2s..2.2s down orchestrator
 
 Point faults fire once; window faults apply at ``from`` and unwind at
 the window end.  Every application and unwind is recorded as a
@@ -45,6 +53,7 @@ _DUR_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s)?$")
 _ACTIONS = {
     "kill": (False, True, False),
     "restart": (False, True, False),
+    "down": (True, True, False),     # kill at window start, restart at end
     "stall": (False, True, True),
     "wedge": (True, True, False),
     "delay": (True, True, True),     # target is the literal word "bus"
@@ -287,7 +296,7 @@ class ChaosController:
         self._stop = threading.Event()
         self._lock = threading.Lock()
         for f in self.timeline:
-            if f.action in ("kill", "restart", "stall", "wedge") \
+            if f.action in ("kill", "restart", "down", "stall", "wedge") \
                     and targets is not None and f.target not in self.targets:
                 raise ValueError(f"chaos fault {f.raw!r} names unknown "
                                  f"target {f.target!r}")
@@ -364,7 +373,7 @@ class ChaosController:
     def _apply(self, i: int, f: Fault) -> None:
         logger.warning("chaos: applying %s", f.raw)
         try:
-            if f.action == "kill":
+            if f.action in ("kill", "down"):
                 self.targets[f.target].kill()
             elif f.action == "restart":
                 self.targets[f.target].restart()
@@ -394,6 +403,9 @@ class ChaosController:
                 self.bus.set_delay(0.0)
             elif f.action == "drop":
                 self.bus.set_drop(False)
+            elif f.action == "down":
+                # The supervisor brings the target back at window end.
+                self.targets[f.target].restart()
             # wedge unwinds by its own deadline inside ChaosEngine
             self._announce(f, "unwind")
         except Exception as e:

@@ -132,6 +132,87 @@ def _delta(after: Dict[str, float],
             for k, v in after.items() if v - before.get(k, 0.0) > 0}
 
 
+class OrchestratorHandle:
+    """The chaos controller's view of the coordinator itself: ``kill`` /
+    ``restart`` with process-death semantics.  Each generation is a FRESH
+    `Orchestrator` over a FRESH state-manager instance (same storage
+    root) plus the SAME journal directory — recovery must run from
+    durable state (journal + persisted snapshot) alone, exactly like a
+    restarted process.  The dead generation's in-process bus
+    subscriptions become no-ops (`Orchestrator.kill`), the analog of a
+    dead process's subscriptions vanishing with it."""
+
+    def __init__(self, make_orch, seeds, drive: bool = True):
+        self._make = make_orch
+        self.seeds = list(seeds)
+        self.drive = drive
+        self.orch = None
+        self.generation = 0
+
+    def start(self) -> None:
+        self.orch = self._make()
+        self.orch.start(self.seeds, background=False)
+        self.generation += 1
+
+    def kill(self) -> None:
+        o, self.orch = self.orch, None
+        if o is not None:
+            o.kill()
+
+    def restart(self) -> None:
+        # A standalone `restart orchestrator` line must not leave two
+        # live generations double-handling the crawl: retire the old one
+        # first (no-op if a kill already ran).
+        self.kill()
+        self.start()
+
+    def tick(self) -> None:
+        """One distribution pass on the live generation (no-op while the
+        orchestrator is dead — the load keeps flowing without it)."""
+        o = self.orch
+        if o is None or not self.drive or not o.is_running:
+            return
+        try:
+            o.distribute_work()
+        except Exception as e:
+            logger.warning("orchestrator tick error: %s", e)
+
+    def check_worker_health(self) -> None:
+        o = self.orch
+        if o is not None and o.is_running:
+            o.check_worker_health()
+
+    def get_cluster(self):
+        o = self.orch
+        if o is None:
+            return {"workers": {}, "orchestrator": {"down": True}}
+        return o.get_cluster()
+
+    def all_pages(self) -> list:
+        """Every page across every depth of the live generation's state
+        manager (the orchestrator-side reconciliation read)."""
+        o = self.orch
+        if o is None:
+            return []
+        try:
+            max_depth = o.sm.get_max_depth()
+        except Exception as e:
+            logger.warning("page reconciliation read failed: %s", e)
+            return []
+        pages = []
+        for depth in range(max_depth + 1):
+            try:
+                pages.extend(o.sm.get_layer_by_depth(depth))
+            except Exception as e:
+                logger.warning("layer %d read failed: %s", depth, e)
+        return pages
+
+    def stop(self) -> None:
+        o = self.orch
+        if o is not None:
+            o.stop()
+
+
 class WorkerHandle:
     """The chaos controller's view of the TPU worker: kill / restart /
     stall, with the current live instance behind one name.  Each start
@@ -253,7 +334,7 @@ def run_scenario(scenario: Dict[str, Any],
     from ..bus.inmemory import InMemoryBus
     from ..config.crawler import CrawlerConfig
     from ..inference.engine import EngineConfig, InferenceEngine
-    from ..orchestrator import Orchestrator
+    from ..orchestrator import CrawlJournal, Orchestrator
     from ..orchestrator.orchestrator import OrchestratorConfig
     from ..state import CompositeStateManager, SqlConfig, StateConfig
     from ..state.providers import InMemoryStorageProvider
@@ -271,7 +352,7 @@ def run_scenario(scenario: Dict[str, Any],
         raise ValueError(f"scenario bus must be inmemory|grpc, "
                          f"got {bus_kind!r}")
     timeline = parse_timeline(scenario.get("chaos", []))
-    if bus_kind != "grpc" and any(f.action in ("kill", "restart")
+    if bus_kind != "grpc" and any(f.action in ("kill", "restart", "down")
                                   for f in timeline):
         raise ValueError(
             "kill/restart faults need bus='grpc' (the in-memory bus has "
@@ -311,7 +392,7 @@ def run_scenario(scenario: Dict[str, Any],
 
     server = None
     inner_bus = None
-    orch = None
+    orch_handle = None
     crawl_worker = None
     pool_installed = False
     handle = None
@@ -359,13 +440,21 @@ def run_scenario(scenario: Dict[str, Any],
             crawl_runner.init_connection_pool(ConnectionPool.for_testing(
                 {"conn0": SimTelegramClient(net, conn_id="conn0")}))
             pool_installed = True
-        orch = Orchestrator(
-            crawler_cfg.crawl_id, crawler_cfg, local_bus, _sm("orch"),
-            ocfg=OrchestratorConfig(
-                worker_timeout_s=float(scenario.get("worker_timeout_s",
-                                                    10.0))))
-        orch.start(seeds, background=False)
-        cluster_provider = orch.get_cluster
+        def _make_orch():
+            # Fresh Orchestrator + fresh state-manager instance over the
+            # SAME storage root and journal dir: a restart resumes from
+            # durable state only (the kill-orchestrator closure).
+            return Orchestrator(
+                crawler_cfg.crawl_id, crawler_cfg, local_bus, _sm("orch"),
+                ocfg=OrchestratorConfig(
+                    worker_timeout_s=float(scenario.get("worker_timeout_s",
+                                                        10.0))),
+                journal=CrawlJournal(os.path.join(tmpdir, "orch-journal")))
+
+        orch_handle = OrchestratorHandle(_make_orch, seeds,
+                                         drive=bool(crawl_leg))
+        orch_handle.start()
+        cluster_provider = orch_handle.get_cluster
         set_cluster_provider(cluster_provider)
 
         if crawl_leg:
@@ -391,7 +480,7 @@ def run_scenario(scenario: Dict[str, Any],
         http_server = serve_metrics(0, registry)
         port = http_server.server_address[1]
 
-        targets = {worker_name: handle}
+        targets = {worker_name: handle, "orchestrator": orch_handle}
         if crawl_worker is not None:
             targets["crawl-1"] = crawl_worker
         controller = ChaosController(timeline, targets=targets,
@@ -425,21 +514,32 @@ def run_scenario(scenario: Dict[str, Any],
         controller.start()
         gen_thread.start()
         while gen_thread.is_alive():
-            if crawl_leg:
-                orch.distribute_work()
+            orch_handle.tick()
             time.sleep(0.02)
         gen_thread.join()
         # Let the timeline finish (e.g. a restart scheduled after the
-        # last arrival) before draining.
+        # last arrival) before draining; orchestrator ticks keep running
+        # so a resumed generation can finish requeued work.
         deadline = time.monotonic() + drain_timeout_s
         while not controller.done() and time.monotonic() < deadline:
+            orch_handle.tick()
             time.sleep(0.02)
         controller.stop()
+        if crawl_leg:
+            # Drive the (possibly restarted) orchestrator until the crawl
+            # itself completes — resumed in-flight pages included.
+            deadline = time.monotonic() + drain_timeout_s
+            while time.monotonic() < deadline:
+                orch_handle.tick()
+                o = orch_handle.orch
+                if o is not None and o.crawl_completed:
+                    break
+                time.sleep(0.02)
         if server is not None:
             server.drain(timeout_s=drain_timeout_s)
         drained = handle.worker.drain(timeout_s=drain_timeout_s)
         handle.worker.evaluate_slos()
-        orch.check_worker_health()
+        orch_handle.check_worker_health()
         breaches_fault = _delta(_breach_counts(registry), breaches_0)
         t_b1 = time.monotonic()
 
@@ -532,6 +632,39 @@ def run_scenario(scenario: Dict[str, Any],
             floor = float(gate_cfg["goodput_min_posts_per_s"])
             check("goodput_posts_per_s", goodput >= floor,
                   round(goodput, 2), f">= {floor}")
+        orch_detail: Dict[str, Any] = {"generations": orch_handle.generation}
+        if gate_cfg.get("orchestrator_reconcile"):
+            from ..state.datamodels import (
+                PAGE_FETCHED,
+                PAGE_PROCESSING,
+                PAGE_UNFETCHED,
+            )
+
+            o = orch_handle.orch
+            all_pages = orch_handle.all_pages()
+            by_status: Dict[str, int] = {}
+            for p in all_pages:
+                by_status[p.status] = by_status.get(p.status, 0) + 1
+            # Lost = pages whose work vanished (never reached a terminal
+            # state); duplicated = success results applied more than once
+            # for one page (completed_items would outrun fetched pages —
+            # the idempotence set must keep them equal across restarts).
+            stuck = [p.url for p in all_pages
+                     if p.status in (PAGE_UNFETCHED, PAGE_PROCESSING)]
+            fetched = by_status.get(PAGE_FETCHED, 0)
+            completed = o.completed_items if o is not None else -1
+            check("orch_crawl_completed",
+                  o is not None and o.crawl_completed,
+                  bool(o is not None and o.crawl_completed), True)
+            check("orch_pages_lost", not stuck, len(stuck), 0)
+            check("orch_result_duplicates", completed == fetched,
+                  {"completed_items": completed, "fetched_pages": fetched},
+                  "completed_items == fetched pages")
+            orch_detail.update({
+                "resumed": bool(o is not None and o.resumed),
+                "pages_by_status": by_status,
+                "completed_items": completed,
+            })
         if gate_cfg.get("require_flight"):
             events = flight.RECORDER.events()
             start = 0
@@ -573,6 +706,7 @@ def run_scenario(scenario: Dict[str, Any],
             "fault_window_s": round(t_b1 - t_b0, 2),
             "chaos_events": len(controller.events),
             "worker_generations": handle.generation,
+            "orchestrator": orch_detail,
             "cluster_workers": sorted(
                 (endpoints["cluster"] or {}).get("workers", {})),
             "checks": checks,
@@ -597,8 +731,8 @@ def run_scenario(scenario: Dict[str, Any],
             _teardown("tpu-worker", handle.stop)
         if crawl_worker is not None:
             _teardown("crawl-worker", crawl_worker.stop)
-        if orch is not None:
-            _teardown("orchestrator", orch.stop)
+        if orch_handle is not None:
+            _teardown("orchestrator", orch_handle.stop)
         if cluster_provider is not None:
             _teardown("cluster-provider",
                       lambda: clear_cluster_provider(cluster_provider))

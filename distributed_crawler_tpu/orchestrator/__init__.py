@@ -1,7 +1,8 @@
 """Central coordinator for distributed crawls (reference `orchestrator/`)."""
 
 from .fleet import FleetView, WorkerTrack
+from .journal import CrawlJournal, RecoveredCrawl
 from .orchestrator import Orchestrator, OrchestratorConfig, WorkerInfo
 
-__all__ = ["FleetView", "Orchestrator", "OrchestratorConfig", "WorkerInfo",
-           "WorkerTrack"]
+__all__ = ["CrawlJournal", "FleetView", "Orchestrator", "OrchestratorConfig",
+           "RecoveredCrawl", "WorkerInfo", "WorkerTrack"]

@@ -19,6 +19,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field, replace
 from datetime import datetime
 from typing import Any, Dict, List, Optional
@@ -42,9 +43,11 @@ from ..bus.messages import (
     WorkResult,
 )
 from .fleet import FleetView
+from .journal import CrawlJournal, RecoveredCrawl
 from ..config.crawler import CrawlerConfig
-from ..utils import flight, trace
+from ..utils import flight, resilience, trace
 from ..state.datamodels import (
+    PAGE_ABANDONED,
     PAGE_ERROR,
     PAGE_FETCHED,
     PAGE_PROCESSING,
@@ -54,6 +57,26 @@ from ..state.datamodels import (
 )
 
 logger = logging.getLogger("dct.orchestrator")
+
+# Circuit-breaker target name for the orchestrator's state-store ops
+# (the `resilience_circuit_state{target=...}` label value).
+STATE_STORE_TARGET = "state-store"
+
+# Applied-result idempotence window: ids of results already applied,
+# kept so broker redeliveries (incl. across a restart) single-count.
+# Bounded — only ids within the broker's plausible redelivery horizon
+# matter.  Snapshots persist only the newest SNAPSHOT-many ids: the
+# cross-restart redelivery horizon is far smaller than the live window,
+# and compaction fsyncs the list every ~256 events.
+APPLIED_RESULTS_WINDOW = 65536
+APPLIED_RESULTS_SNAPSHOT = 8192
+
+# Work deferred while the state-store circuit is open (discovered layers
+# and result applications) is retried each tick, bounded: beyond the cap
+# the oldest entries drop from memory — their recovery story is the
+# journal (layers are journaled before the store write; an unjournaled
+# result leaves its item in-flight, so a restart requeues it).
+DEFERRED_CAP = 4096
 
 
 @dataclass
@@ -72,6 +95,13 @@ class OrchestratorConfig:
     # chatter).  high=0 disables the valve.
     inference_backpressure_high: int = 64
     inference_backpressure_low: int = 32
+    # Resiliency policy knobs (utils/resilience.py): state-store ops run
+    # behind a retry + circuit breaker; an OPEN circuit engages the
+    # dispatch backpressure valve instead of erroring the tick loop.
+    state_retry_attempts: int = 2
+    state_breaker_threshold: int = 5
+    state_breaker_recovery_s: float = 15.0
+    publish_retry_attempts: int = 3
 
 
 @dataclass
@@ -95,13 +125,15 @@ class Orchestrator:
 
     def __init__(self, crawl_id: str, config: CrawlerConfig, bus, sm,
                  ocfg: Optional[OrchestratorConfig] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 journal: Optional[CrawlJournal] = None):
         self.crawl_id = crawl_id
         self.config = config
         self.bus = bus
         self.sm = sm
         self.ocfg = ocfg or OrchestratorConfig()
         self.clock = clock
+        self.journal = journal
 
         self.workers: Dict[str, WorkerInfo] = {}
         self.active_work: Dict[str, WorkItem] = {}
@@ -112,28 +144,80 @@ class Orchestrator:
         self.error_items = 0
         self.discovered_pages = 0
         self.crawl_completed = False
+        self.resumed = False
         self._retry_counts: Dict[str, int] = {}  # page id -> retries
+        # Work-item ids whose results were applied (insertion-ordered,
+        # bounded to APPLIED_RESULTS_WINDOW): the idempotence window that
+        # makes results replayed across a restart single-count.
+        self._applied_results: "OrderedDict[str, None]" = OrderedDict()
+        # State-store work parked while the circuit is open, retried per
+        # tick (`_flush_deferred`).
+        self._deferred_layers: List[List[Page]] = []
+        self._deferred_results: List[tuple] = []
+        # The circuit's dispatch-pause latch (separate from the
+        # inference-backlog hysteresis valve `_backpressure_active`).
+        self._circuit_backpressure = False
         self._backpressure_active = False
         # Telemetry-rich per-worker fold behind /cluster; its staleness
         # rule tracks the same timeout check_worker_health enforces.
         self.fleet = FleetView(stale_after_s=self.ocfg.worker_timeout_s)
+        # Declarative resiliency (utils/resilience.py): state-store ops
+        # behind retry + circuit breaker (an open circuit engages the
+        # dispatch backpressure), bus publishes behind jittered retry.
+        self._state_policy = resilience.Policy(
+            op="orchestrator.state_store",
+            retry=resilience.RetryPolicy(
+                max_attempts=self.ocfg.state_retry_attempts,
+                base_delay_s=0.05, max_delay_s=0.5, jitter=0.0),
+            breaker=resilience.CircuitBreaker(
+                STATE_STORE_TARGET,
+                failure_threshold=self.ocfg.state_breaker_threshold,
+                recovery_timeout_s=self.ocfg.state_breaker_recovery_s,
+                clock=clock))
+        self._publish_policy = resilience.Policy(
+            op="orchestrator.publish",
+            retry=resilience.RetryPolicy(
+                max_attempts=self.ocfg.publish_retry_attempts,
+                base_delay_s=0.05, max_delay_s=0.5))
 
         self._mu = threading.RLock()
         self._running = False
+        self._killed = False
         self._threads: List[threading.Thread] = []
         self._started_at = 0.0
 
     # -- lifecycle ---------------------------------------------------------
-    def start(self, seed_urls: List[str], background: bool = True) -> None:
-        """`orchestrator.go:106-137`."""
+    def start(self, seed_urls: List[str], background: bool = True,
+              fresh: bool = False) -> None:
+        """`orchestrator.go:106-137`, plus crash recovery.
+
+        An existing crawl (journal or persisted state-manager snapshot)
+        is RESUMED, never clobbered: coordination state is rebuilt from
+        journal + state manager and in-flight pages are requeued.  Pass
+        ``fresh=True`` (the ``--fresh`` flag) to explicitly discard the
+        previous crawl and re-seed."""
         with self._mu:
             if self._running:
                 raise RuntimeError("orchestrator is already running")
             self._running = True
         self._started_at = self.clock()
-        self.sm.initialize(seed_urls)
+        if fresh:
+            self._discard_existing_crawl()
+        else:
+            self._discard_foreign_journal()
+        pending: List[WorkItem] = []
+        if not fresh and self._has_existing_crawl():
+            pending = self._resume_state()
+        else:
+            self.sm.initialize(seed_urls)
+            self._journal_begin()
+        # Subscribe BEFORE republishing in-flight work: on a synchronous
+        # transport a worker can crawl a requeued item and publish its
+        # result inline, which must not race the subscription.
         self.bus.subscribe(TOPIC_RESULTS, self.handle_result_payload)
         self.bus.subscribe(TOPIC_WORKER_STATUS, self.handle_status_payload)
+        if self.resumed:
+            self._resume_requeue(pending)
         if background:
             for target, interval, name in (
                     (self.distribute_work, self.ocfg.distribute_interval_s,
@@ -146,7 +230,8 @@ class Orchestrator:
                 t.start()
                 self._threads.append(t)
         logger.info("orchestrator started", extra={
-            "crawl_id": self.crawl_id, "seed_count": len(seed_urls)})
+            "crawl_id": self.crawl_id, "seed_count": len(seed_urls),
+            "resumed": self.resumed})
 
     def stop(self) -> None:
         with self._mu:
@@ -154,13 +239,321 @@ class Orchestrator:
         for t in self._threads:
             t.join(timeout=2.0)
         self._threads.clear()
+        self._compact_journal(force=True)
+        if self.journal is not None:
+            self.journal.close()
         self.sm.close()
         logger.info("orchestrator stopped", extra={"crawl_id": self.crawl_id})
+
+    def kill(self) -> None:
+        """Abrupt-death simulation (the chaos/`loadgen` seam, the twin of
+        `CrawlWorker.kill`): drop everything in memory WITHOUT a journal
+        snapshot or a state-manager save — the in-process analog of
+        SIGKILL.  Recovery must run from the journal + the last persisted
+        snapshot alone.  Handlers go silent (a dead process's bus
+        subscriptions are gone; in-process buses can't unsubscribe)."""
+        with self._mu:
+            self._running = False
+            self._killed = True
+            active = len(self.active_work)
+        flight.record("orch_kill", crawl_id=self.crawl_id,
+                      active_work=active, depth=self.current_depth)
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads.clear()
+        if self.journal is not None:
+            self.journal.close()
 
     @property
     def is_running(self) -> bool:
         with self._mu:
             return self._running
+
+    # -- crash recovery ----------------------------------------------------
+    def _has_existing_crawl(self) -> bool:
+        """Is there a previous crawl to resume — a non-empty journal, or a
+        persisted state-manager snapshot with layers?"""
+        if self.journal is not None and self.journal.exists():
+            return True
+        provider = getattr(self.sm, "provider", None)
+        path_fn = getattr(self.sm, "_state_path", None)
+        if provider is None or not callable(path_fn):
+            return False
+        try:
+            existing = provider.load_json(path_fn())
+        except Exception as e:
+            logger.warning("existing-crawl probe failed: %s", e)
+            return False
+        return bool(existing and existing.get("layers"))
+
+    def _discard_foreign_journal(self) -> None:
+        """A journal recorded by a DIFFERENT crawl id (shared journal
+        dir, e.g. a common --dump-dir) must not be resumed as ours —
+        discard it loudly instead of silently running someone else's
+        crawl."""
+        if self.journal is None or not self.journal.exists():
+            return
+        recorded = self.journal.recorded_crawl_id()
+        if recorded and recorded != self.crawl_id:
+            logger.warning(
+                "journal at %s belongs to crawl %r, not %r; discarding it",
+                self.journal.journal_dir, recorded, self.crawl_id)
+            self.journal.reset()
+
+    def _discard_existing_crawl(self) -> None:
+        """``--fresh``: drop the journal and blank the persisted state
+        snapshot so ``sm.initialize`` re-seeds instead of resuming."""
+        if self.journal is not None:
+            self.journal.reset()
+        provider = getattr(self.sm, "provider", None)
+        path_fn = getattr(self.sm, "_state_path", None)
+        if provider is not None and callable(path_fn):
+            try:
+                provider.save_json(path_fn(), {})
+            except Exception as e:
+                logger.warning("could not blank persisted state: %s", e)
+        logger.info("fresh start requested; discarded existing crawl state")
+
+    def _journal_begin(self) -> None:
+        """Stamp the crawl identity + the seed layer so a crash before the
+        first state-manager save can still rebuild layer 0."""
+        if self.journal is None:
+            return
+        self._jappend("begin", crawl_id=self.crawl_id)
+        try:
+            seeds = self.sm.get_layer_by_depth(0)
+        except Exception as e:
+            logger.warning("seed-layer journal stamp skipped: %s", e)
+            seeds = []
+        if seeds:
+            self._jappend("layer", depth=0,
+                          pages=[p.to_dict() for p in seeds])
+
+    def _jappend(self, kind: str, **fields) -> None:
+        """Journal append that never takes the crawl down with it."""
+        if self.journal is None:
+            return
+        try:
+            self.journal.append(kind, **fields)
+        except Exception as e:
+            logger.error("journal append failed (%s): %s", kind, e)
+
+    def _snapshot_dict(self) -> Dict[str, Any]:
+        with self._mu:
+            return {
+                "crawl_id": self.crawl_id,
+                "current_depth": self.current_depth,
+                "total_work_items": self.total_work_items,
+                "completed_items": self.completed_items,
+                "error_items": self.error_items,
+                "discovered_pages": self.discovered_pages,
+                "crawl_completed": self.crawl_completed,
+                "active_work": {wid: item.to_dict()
+                                for wid, item in self.active_work.items()},
+                "retry_counts": dict(self._retry_counts),
+                # Insertion order = recency; only the newest slice is
+                # persisted (APPLIED_RESULTS_SNAPSHOT) so compaction
+                # cost stays flat on long crawls.
+                "applied_results":
+                    list(self._applied_results)[-APPLIED_RESULTS_SNAPSHOT:],
+            }
+
+    def _mark_applied_locked(self, work_item_id: str) -> None:
+        """Record an applied (or abandoned) work-item id in the bounded
+        idempotence window; caller holds ``_mu``."""
+        self._applied_results[work_item_id] = None  # crawlint: disable=LCK001
+        while len(self._applied_results) > APPLIED_RESULTS_WINDOW:
+            self._applied_results.popitem(last=False)
+
+    def _compact_journal(self, force: bool = False) -> None:
+        """Snapshot + truncate the event log.  The state manager is saved
+        FIRST: once the journal truncates, the persisted snapshot is the
+        only carrier of page statuses the dropped events described."""
+        if self.journal is None:
+            return
+        if not force and not self.journal.should_compact():
+            return
+        try:
+            self._state_policy.call(self.sm.save_state)
+        except Exception as e:
+            logger.warning("journal compaction skipped; state save "
+                           "failed: %s", e)
+            return
+        try:
+            self.journal.snapshot(self._snapshot_dict())
+        except Exception as e:
+            logger.error("journal snapshot failed: %s", e)
+
+    def _find_page(self, item: WorkItem) -> Optional[Page]:
+        try:
+            return self.sm.get_page(item.parent_id)
+        except KeyError:
+            pass  # fall through to the by-url scan
+        try:
+            for page in self.sm.get_layer_by_depth(item.depth):
+                if page.url == item.url:
+                    return page
+        except Exception as e:
+            logger.warning("page lookup for %s failed: %s", item.id, e)
+        return None
+
+    def _resume_state(self) -> List[WorkItem]:
+        """Rebuild coordination state from journal + state manager; no
+        re-seed — the existing crawl continues where the dead process
+        left it.  Returns the in-flight items to republish once the bus
+        subscriptions are up (`_resume_requeue`)."""
+        rec = self.journal.replay() if self.journal is not None \
+            else RecoveredCrawl()
+        # Load whatever the state manager persisted; empty seed list so a
+        # backend without a persisted snapshot doesn't grow stray pages.
+        self.sm.initialize([])
+        # Re-add journaled pages the persisted snapshot may predate.
+        # Filtered by page ID — not add_layer's URL dedup, which
+        # random-walk crawls disable — so replays never duplicate layer
+        # entries or clobber fresher persisted statuses.
+        for depth, page_dicts in rec.layers:
+            pages = []
+            for d in page_dicts:
+                page = Page.from_dict(d)
+                try:
+                    self.sm.get_page(page.id)
+                except KeyError:
+                    pages.append(page)
+                except Exception as e:
+                    logger.warning("resume: page probe failed (%s); "
+                                   "skipping re-add", e)
+            if not pages:
+                continue
+            try:
+                self.sm.add_layer(pages)
+            except Exception as e:
+                logger.error("resume: failed to re-add layer %d: %s",
+                             depth, e)
+        # Replay journaled page outcomes over the (possibly stale)
+        # persisted statuses.
+        for page_id, (status, error) in rec.page_fixups.items():
+            try:
+                page = self.sm.get_page(page_id)
+            except KeyError:
+                continue  # page's layer event lost with a torn journal
+            page.status = status
+            if error:
+                page.error = error
+            self._update_page(page)
+        with self._mu:
+            self.current_depth = rec.current_depth
+            self.total_work_items = rec.total_work_items
+            self.completed_items = rec.completed_items
+            self.error_items = rec.error_items
+            self.discovered_pages = rec.discovered_pages
+            self.crawl_completed = rec.crawl_completed
+            self._retry_counts = dict(rec.retry_counts)
+            self._applied_results = OrderedDict.fromkeys(
+                sorted(rec.applied_results))
+        # In-flight work: the dispatch happened but no result was
+        # journaled — the result may be lost (worker died with us) or
+        # still in flight.  Rebuild active_work + page state now; the
+        # republish happens in `_resume_requeue` once subscriptions are
+        # live.
+        pending: List[WorkItem] = []
+        for wid, item_dict in sorted(rec.active_work.items()):
+            try:
+                item = WorkItem.from_dict(item_dict)
+            except Exception as e:
+                logger.error("resume: undecodable journaled item %s: %s",
+                             wid, e)
+                continue
+            with self._mu:
+                self.active_work[item.id] = item
+            page = self._find_page(item)
+            if page is not None:
+                page.status = PAGE_PROCESSING
+                page.timestamp = utcnow()
+                self._update_page(page)
+            pending.append(item)
+        # Safety sweep: PROCESSING pages nobody claims (torn dispatch
+        # line, pre-journal crawls) go back to UNFETCHED so the
+        # distributor re-dispatches rather than waiting forever.
+        with self._mu:
+            claimed = {i.parent_id for i in self.active_work.values()}
+            claimed |= {i.url for i in self.active_work.values()}
+        self._swept_on_resume = 0
+        try:
+            max_depth = self.sm.get_max_depth()
+        except LookupError:
+            max_depth = -1  # no layers at all: nothing to sweep
+        for depth in range(max_depth + 1):
+            try:
+                layer = self.sm.get_layer_by_depth(depth)
+            except Exception as e:
+                logger.warning("resume sweep: layer %d unreadable: %s",
+                               depth, e)
+                continue
+            for page in layer:
+                if page.status == PAGE_PROCESSING \
+                        and page.id not in claimed \
+                        and page.url not in claimed:
+                    page.status = PAGE_UNFETCHED
+                    self._update_page(page)
+                    self._swept_on_resume += 1
+        self.resumed = True
+        self._events_replayed = rec.events_replayed
+        return pending
+
+    def _resume_requeue(self, pending: List[WorkItem]) -> None:
+        """Republish the resumed in-flight items at high priority under
+        the SAME item id: a late result from the original delivery and
+        one from the republication reconcile through active_work + the
+        idempotence window.  Runs after the bus subscriptions are live."""
+        requeued = 0
+        for item in pending:
+            with self._mu:
+                if item.id not in self.active_work:
+                    continue  # its result landed already
+            try:
+                with trace.span("orchestrator.resume_requeue",
+                                trace_id=item.trace_id, work_item=item.id):
+                    self._publish_policy.call(
+                        self.bus.publish, TOPIC_WORK_QUEUE,
+                        WorkQueueMessage.new(item, PRIORITY_HIGH,
+                                             self.ocfg.work_ttl_s))
+                requeued += 1
+                flight.record("resume_requeue", work_item=item.id,
+                              url=item.url)
+            except Exception as e:
+                # Leave it to the normal distributor instead.
+                logger.error("resume: failed to requeue %s: %s", item.id, e)
+                with self._mu:
+                    self.active_work.pop(item.id, None)
+                page = self._find_page(item)
+                if page is not None and page.status == PAGE_PROCESSING:
+                    page.status = PAGE_UNFETCHED
+                    self._update_page(page)
+        swept = getattr(self, "_swept_on_resume", 0)
+        flight.record("orch_resume", crawl_id=self.crawl_id,
+                      depth=self.current_depth, requeued=requeued,
+                      swept=swept, completed=self.completed_items,
+                      events_replayed=getattr(self, "_events_replayed", 0),
+                      crawl_completed=self.crawl_completed)
+        logger.info("resumed crawl from journal", extra={
+            "crawl_id": self.crawl_id, "current_depth": self.current_depth,
+            "requeued": requeued, "swept": swept,
+            "completed_items": self.completed_items})
+        # The resume itself is the new durable baseline.
+        self._compact_journal(force=True)
+
+    def _update_page(self, page: Page) -> None:
+        """Policy-guarded page update: retries transient failures, feeds
+        the breaker, and never raises into a tick loop (an OPEN circuit
+        defers the write — the journal still carries the transition)."""
+        try:
+            self._state_policy.call(self.sm.update_page, page)
+        except resilience.CircuitOpenError:
+            logger.warning("state-store circuit open; page %s update "
+                           "deferred", page.id)
+        except Exception as e:
+            logger.error("failed to update page status", extra={
+                "page_url": page.url, "error": str(e)})
 
     def _loop(self, tick, interval_s: float) -> None:
         while self.is_running:
@@ -179,6 +572,8 @@ class Orchestrator:
         self.check_worker_health()
         self.fleet.refresh_staleness()  # keep the gauge live for /metrics
         self.requeue_stale_work()
+        self._flush_deferred()
+        self._compact_journal()
         self.log_progress()
 
     # -- co-scheduling backpressure ----------------------------------------
@@ -200,7 +595,25 @@ class Orchestrator:
     def _backpressure_engaged(self) -> bool:
         """Hysteresis valve: engage at HIGH, release below LOW.  A LOW at
         or above HIGH would invert the hysteresis into per-tick chatter,
-        so it is clamped to HIGH (degenerating to a plain threshold)."""
+        so it is clamped to HIGH (degenerating to a plain threshold).
+
+        An OPEN state-store circuit also engages the valve — a wedged
+        backend must pause dispatch (degrade), not error the loop
+        (cascade) — via its OWN latch, released the moment the breaker
+        allows traffic again (it must not inherit the inference valve's
+        backlog hysteresis, nor survive with that valve disabled)."""
+        if self._state_policy.circuit_open:
+            if not self._circuit_backpressure:
+                self._circuit_backpressure = True
+                flight.record("backpressure", reason="state_circuit_open",
+                              target=STATE_STORE_TARGET)
+                logger.warning("state-store circuit open; pausing crawl "
+                               "distribution")
+            return True
+        if self._circuit_backpressure:
+            self._circuit_backpressure = False
+            logger.info("state-store circuit recovered; resuming crawl "
+                        "distribution")
         high = self.ocfg.inference_backpressure_high
         if high <= 0:
             return False
@@ -229,7 +642,12 @@ class Orchestrator:
         over the high watermark) pauses PUBLISHING — crawl admission
         follows the slowest co-scheduled stage — but never
         completion/depth bookkeeping: a crawl whose pages are all fetched
-        still completes while the valve is closed."""
+        still completes while the valve is closed.  A wedged state store
+        opens the resilience circuit: the tick degrades to a no-op
+        (backpressure) instead of raising."""
+        if self._killed:
+            return 0
+        self._flush_deferred()
         throttled = self._backpressure_engaged()
         if self.config.max_depth > 0 and \
                 self.current_depth > self.config.max_depth:
@@ -240,7 +658,14 @@ class Orchestrator:
                             extra={"max_depth": self.config.max_depth})
                 self._mark_crawl_completed()
             return 0
-        pages = self.sm.get_layer_by_depth(self.current_depth)
+        try:
+            pages = self._state_policy.call(self.sm.get_layer_by_depth,
+                                            self.current_depth)
+        except resilience.CircuitOpenError:
+            return 0  # backpressure engages on the next tick
+        except Exception as e:
+            logger.error("state-store layer read failed: %s", e)
+            return 0
         pending = [p for p in pages
                    if p.status == PAGE_UNFETCHED
                    or (p.status == PAGE_ERROR and self._should_retry(p))]
@@ -251,7 +676,9 @@ class Orchestrator:
                 return 0  # wait for results at this depth
             max_depth = self.sm.get_max_depth()
             if self.current_depth < max_depth:
-                self.current_depth += 1
+                with self._mu:
+                    self.current_depth += 1
+                self._jappend("depth", depth=self.current_depth)
                 logger.info("moving to next depth",
                             extra={"new_depth": self.current_depth})
                 return 0
@@ -270,11 +697,7 @@ class Orchestrator:
                 self.total_work_items += 1
             page.status = PAGE_PROCESSING
             page.timestamp = utcnow()
-            try:
-                self.sm.update_page(page)
-            except Exception as e:
-                logger.error("failed to update page status", extra={
-                    "page_url": page.url, "error": str(e)})
+            self._update_page(page)
             try:
                 # The root span of the work item's trace: everything
                 # downstream (bus delivery, worker processing, the result
@@ -283,11 +706,13 @@ class Orchestrator:
                 with trace.span("orchestrator.dispatch",
                                 trace_id=item.trace_id, work_item=item.id,
                                 depth=item.depth, platform=item.platform):
-                    self.bus.publish(TOPIC_WORK_QUEUE,
-                                     WorkQueueMessage.new(
-                                         item, PRIORITY_MEDIUM,
-                                         self.ocfg.work_ttl_s))
+                    self._publish_policy.call(
+                        self.bus.publish, TOPIC_WORK_QUEUE,
+                        WorkQueueMessage.new(item, PRIORITY_MEDIUM,
+                                             self.ocfg.work_ttl_s))
                 published += 1
+                self._jappend("dispatch", item=item.to_dict(),
+                              page_id=page.id)
                 flight.record("dispatch", work_item=item.id, url=item.url,
                               depth=item.depth)
             except Exception as e:
@@ -295,14 +720,12 @@ class Orchestrator:
                 logger.error("failed to publish work item", extra={
                     "work_item_id": item.id, "error": str(e)})
                 page.status = PAGE_UNFETCHED
-                try:
-                    self.sm.update_page(page)
-                except Exception as revert_err:
-                    logger.error("failed to revert page status", extra={
-                        "page_url": page.url, "error": str(revert_err)})
+                self._update_page(page)
                 with self._mu:
                     self.active_work.pop(item.id, None)
                     self.total_work_items -= 1
+        if published:
+            self._compact_journal()
         return published
 
     def create_work_item(self, page: Page) -> WorkItem:
@@ -332,10 +755,20 @@ class Orchestrator:
         self.handle_result(ResultMessage.from_dict(payload))
 
     def handle_result(self, message: ResultMessage) -> None:
+        if self._killed:
+            return
         result = message.work_result
         with self._mu:
+            if result.work_item_id in self._applied_results:
+                # Idempotent apply: a result replayed across a restart
+                # (bus redelivery of a frame the dead generation already
+                # applied) is single-counted by work-item id.
+                logger.debug("ignoring already-applied result",
+                             extra={"work_item_id": result.work_item_id})
+                return
             item = self.active_work.pop(result.work_item_id, None)
             if item is not None:
+                self._mark_applied_locked(result.work_item_id)
                 self.completed_work[result.work_item_id] = result
                 if result.status == STATUS_SUCCESS:
                     self.completed_items += 1
@@ -356,28 +789,49 @@ class Orchestrator:
 
     def _apply_result(self, item: WorkItem, message: ResultMessage,
                       result: WorkResult) -> None:
-        for page in self.sm.get_layer_by_depth(item.depth):
+        applied_page: Optional[Page] = None
+        try:
+            layer = self._state_policy.call(self.sm.get_layer_by_depth,
+                                            item.depth)
+        except Exception as e:
+            # Wedged store: park the whole application (page transition,
+            # discovery, journal event) for the tick-loop retry.  The
+            # result is NOT journaled yet, so a crash before the retry
+            # leaves the item in-flight and a restart requeues it.
+            logger.warning("deferring result apply for %s; state store "
+                           "unavailable: %s", item.id, e)
+            with self._mu:
+                self._deferred_results.append((item, message, result))
+                del self._deferred_results[:-DEFERRED_CAP]
+            return
+        for page in layer:
             if page.url != item.url:
                 continue
             if result.status == STATUS_SUCCESS:
                 page.status = PAGE_FETCHED
                 self._retry_counts.pop(page.id, None)
             else:
-                page.status = PAGE_ERROR
                 page.error = result.error
                 if result.retry_recommended:
-                    self._retry_counts[page.id] = \
-                        self._retry_counts.get(page.id, 0) + 1
+                    retries = self._retry_counts.get(page.id, 0) + 1
+                    if retries >= self.ocfg.max_retries:
+                        # Budget exhausted: terminal.  The retry counter
+                        # is PRUNED on every terminal transition — the
+                        # page's status is the durable marker, so the
+                        # map stays bounded by in-flight pages.
+                        page.status = PAGE_ABANDONED
+                        self._retry_counts.pop(page.id, None)
+                    else:
+                        page.status = PAGE_ERROR
+                        self._retry_counts[page.id] = retries
                 else:
                     # Worker classified the failure as permanent
-                    # (`worker.go:436-456`): exhaust the retry budget.
-                    self._retry_counts[page.id] = self.ocfg.max_retries
+                    # (`worker.go:436-456`): terminal immediately.
+                    page.status = PAGE_ABANDONED
+                    self._retry_counts.pop(page.id, None)
             page.timestamp = result.completed_at or utcnow()
-            try:
-                self.sm.update_page(page)
-            except Exception as e:
-                logger.error("failed to update page after result", extra={
-                    "url": page.url, "error": str(e)})
+            self._update_page(page)
+            applied_page = page
             break
 
         discovered = message.discovered_pages or result.discovered_pages
@@ -389,6 +843,16 @@ class Orchestrator:
             except Exception as e:
                 logger.error("failed to process discovered pages",
                              extra={"error": str(e)})
+        self._jappend(
+            "result", work_item_id=item.id,
+            page_id=applied_page.id if applied_page is not None else "",
+            status=result.status, error=result.error or "",
+            page_status=applied_page.status if applied_page is not None
+            else "",
+            retries=(self._retry_counts.get(applied_page.id, 0)
+                     if applied_page is not None else 0),
+            discovered=len(discovered) if discovered else 0)
+        self._compact_journal()
 
     def _process_discovered(self, discovered, current_depth: int) -> None:
         """`orchestrator.go:386-416`."""
@@ -397,15 +861,48 @@ class Orchestrator:
                       status=PAGE_UNFETCHED, timestamp=utcnow(),
                       parent_id=dp.parent_id)
                  for dp in discovered]
-        self.sm.add_layer(pages)
+        # Journal BEFORE the store write: if the store is wedged the
+        # pages are still recoverable (live via the deferred retry,
+        # across a crash via the layer event).
+        self._jappend("layer", depth=current_depth + 1,
+                      pages=[p.to_dict() for p in pages])
+        self._add_layer_or_defer(pages)
         logger.info("added discovered pages as new layer", extra={
             "count": len(pages), "new_depth": current_depth + 1})
+
+    def _add_layer_or_defer(self, pages: List[Page]) -> None:
+        try:
+            self._state_policy.call(self.sm.add_layer, pages)
+        except Exception as e:
+            logger.warning("deferring %d discovered pages; state store "
+                           "unavailable: %s", len(pages), e)
+            with self._mu:
+                self._deferred_layers.append(pages)
+                del self._deferred_layers[:-DEFERRED_CAP]
+
+    def _flush_deferred(self) -> None:
+        """Re-attempt state-store work parked while the circuit was open
+        (discovered layers, result applications).  Failures re-defer."""
+        with self._mu:
+            if not self._deferred_layers and not self._deferred_results:
+                return
+        if self._state_policy.circuit_open:
+            return  # still shedding; the valve keeps dispatch paused
+        with self._mu:
+            layers, self._deferred_layers = self._deferred_layers, []
+            results, self._deferred_results = self._deferred_results, []
+        for pages in layers:
+            self._add_layer_or_defer(pages)
+        for item, message, result in results:
+            self._apply_result(item, message, result)
 
     # -- worker registry (`orchestrator.go:419-449`) -----------------------
     def handle_status_payload(self, payload: Dict[str, Any]) -> None:
         self.handle_status(StatusMessage.from_dict(payload))
 
     def handle_status(self, message: StatusMessage) -> None:
+        if self._killed:
+            return
         self.fleet.observe(message)
         with self._mu:
             worker = self.workers.get(message.worker_id)
@@ -459,6 +956,8 @@ class Orchestrator:
         then drop the item and mark its page errored so the crawl can't
         stall forever on one in-flight entry."""
         now = now or utcnow()
+        if self._killed:
+            return 0
         with self._mu:
             stale = [i for i in self.active_work.values()
                      if i.created_at is not None and
@@ -472,16 +971,35 @@ class Orchestrator:
                 with self._mu:
                     self.active_work.pop(item.id, None)
                     self.error_items += 1
-                for page in self.sm.get_layer_by_depth(item.depth):
+                    # Abandons join the idempotence window too: their
+                    # journal fold must also be replay-safe.
+                    self._mark_applied_locked(item.id)
+                abandoned_page_id = ""
+                try:
+                    layer = self._state_policy.call(
+                        self.sm.get_layer_by_depth, item.depth)
+                except Exception as e:
+                    # Wedged store: the journaled abandon below still
+                    # carries the page id, so the terminal status is
+                    # replayed on resume even though the live write
+                    # couldn't land.
+                    logger.warning("abandon: state store unavailable "
+                                   "(%s); page fixup deferred", e)
+                    layer = []
+                for page in layer:
                     if page.url == item.url:
-                        page.status = PAGE_ERROR
+                        # Terminal: abandoned pages carry no live retry
+                        # counter (the status itself blocks re-dispatch).
+                        page.status = PAGE_ABANDONED
                         page.error = "work item expired without result"
-                        self._retry_counts[page.id] = self.ocfg.max_retries
-                        try:
-                            self.sm.update_page(page)
-                        except Exception as e:
-                            logger.error("failed to mark expired page: %s", e)
+                        self._retry_counts.pop(page.id, None)
+                        self._update_page(page)
+                        abandoned_page_id = page.id
                         break
+                self._jappend("abandon", work_item_id=item.id,
+                              page_id=abandoned_page_id or item.parent_id,
+                              page_status=PAGE_ABANDONED,
+                              error="work item expired without result")
                 continue
             # Rotate the item id on requeue (generation suffix) so a late
             # result from the stale attempt can't complete the fresh one —
@@ -501,11 +1019,14 @@ class Orchestrator:
                 with trace.span("orchestrator.requeue",
                                 trace_id=fresh.trace_id, work_item=fresh.id,
                                 retry=fresh.retry_count):
-                    self.bus.publish(TOPIC_WORK_QUEUE,
-                                     WorkQueueMessage.new(
-                                         fresh, PRIORITY_HIGH,
-                                         self.ocfg.work_ttl_s))
+                    self._publish_policy.call(
+                        self.bus.publish, TOPIC_WORK_QUEUE,
+                        WorkQueueMessage.new(fresh, PRIORITY_HIGH,
+                                             self.ocfg.work_ttl_s))
                 requeued += 1
+                self._jappend("requeue", old_id=item.id,
+                              item=fresh.to_dict(),
+                              page_id=fresh.parent_id)
                 flight.record("requeue", work_item=fresh.id,
                               retry=fresh.retry_count)
                 logger.warning("requeued stale work item", extra={
@@ -537,11 +1058,14 @@ class Orchestrator:
                 with trace.span("orchestrator.reassign",
                                 trace_id=fresh.trace_id, work_item=fresh.id,
                                 retry=fresh.retry_count):
-                    self.bus.publish(TOPIC_WORK_QUEUE,
-                                     WorkQueueMessage.new(
-                                         fresh, PRIORITY_HIGH,
-                                         self.ocfg.work_ttl_s))
+                    self._publish_policy.call(
+                        self.bus.publish, TOPIC_WORK_QUEUE,
+                        WorkQueueMessage.new(fresh, PRIORITY_HIGH,
+                                             self.ocfg.work_ttl_s))
                 reassigned += 1
+                self._jappend("reassign", old_id=item.id,
+                              item=fresh.to_dict(),
+                              page_id=fresh.parent_id)
                 flight.record("reassign", work_item=fresh.id,
                               retry=fresh.retry_count)
                 logger.info("reassigned work item from failed worker", extra={
@@ -553,7 +1077,9 @@ class Orchestrator:
 
     # -- progress / status (`orchestrator.go:562-633`) ---------------------
     def _mark_crawl_completed(self) -> None:
-        self.crawl_completed = True
+        with self._mu:
+            self.crawl_completed = True
+        self._jappend("completed")
         metadata = {
             "status": "completed",
             "end_time": utcnow().isoformat(),
@@ -605,7 +1131,9 @@ class Orchestrator:
                 "crawl_worker_count": len(self.workers) - len(tpu),
                 "tpu_worker_count": len(tpu),
                 "inference_backlog": backlog,
-                "backpressure_active": self._backpressure_active,
+                "backpressure_active": (self._backpressure_active or self._circuit_backpressure),
+                "state_circuit": self._state_policy.breaker.state,
+                "resumed": self.resumed,
                 "workers": {k: vars(v).copy()
                             for k, v in self.workers.items()},
                 "work_stats": {
@@ -634,7 +1162,7 @@ class Orchestrator:
                 "active_work": len(self.active_work),
                 "completed_items": self.completed_items,
                 "error_items": self.error_items,
-                "backpressure_active": self._backpressure_active,
+                "backpressure_active": (self._backpressure_active or self._circuit_backpressure),
                 "uptime_s": self.clock() - self._started_at,
             }
         return out

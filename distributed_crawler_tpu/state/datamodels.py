@@ -17,12 +17,17 @@ from typing import Any, Dict, List, Optional
 from ..datamodel.post import format_time, parse_time
 
 # Page status machine (state/datamodels.go:46, §5.4 of SURVEY.md):
-# unfetched -> processing -> fetched | error | deadend
+# unfetched -> processing -> fetched | error | deadend | abandoned.
+# "error" is non-terminal (the orchestrator retries it up to its budget);
+# "abandoned" is the terminal form — permanent failure or an exhausted
+# retry budget — and carries no live retry-counter entry, which is what
+# keeps the orchestrator's per-page retry map bounded.
 PAGE_UNFETCHED = "unfetched"
 PAGE_PROCESSING = "processing"
 PAGE_FETCHED = "fetched"
 PAGE_ERROR = "error"
 PAGE_DEADEND = "deadend"
+PAGE_ABANDONED = "abandoned"
 
 # PendingEdgeBatch statuses (state/datamodels.go:93).
 BATCH_OPEN = "open"

@@ -1,0 +1,347 @@
+"""One resiliency policy layer: retry/backoff, timeouts, circuit breakers.
+
+The reference crawler never hand-rolled a retry loop: every sidecar call
+went through Dapr's *declarative* resiliency spec (retries with
+exponential backoff, per-op timeouts, circuit breakers with half-open
+probes — `resiliency.yaml` in the reference deployment).  Our port had
+grown at least three ad-hoc re-implementations (the gRPC bus's local
+dispatch loop, FLOOD_WAIT sleeps in the crawl runner, the orchestrator's
+per-page retry counters) and no breaker anywhere: a wedged state backend
+turned into an error storm instead of a degraded-but-alive coordinator.
+
+This module is the single place policy lives:
+
+- :class:`RetryPolicy` — declarative jittered exponential backoff with an
+  optional retryable-error predicate and support for **server-directed
+  backoff hints**: an exception carrying a ``retry_after_s`` attribute
+  (e.g. `clients.errors.FloodWaitError`) overrides the computed delay,
+  capped by ``retry_after_cap_s`` so one hostile hint can't park a
+  dispatch thread for minutes.
+- :class:`CircuitBreaker` — closed → open after ``failure_threshold``
+  consecutive failures; open → half-open after ``recovery_timeout_s``;
+  a bounded number of half-open probes decides re-close vs re-open.
+  Every transition updates ``resilience_circuit_state{target}`` and is
+  flight-recorded, so postmortems show the breaker history next to the
+  crash.
+- :class:`Policy` / :func:`with_policy` — retry + breaker + per-attempt
+  timeout composed behind one ``call``; the orchestrator applies it to
+  state-store ops and bus publishes, the crawl worker to fetches.
+- :func:`retry_call` — the functional form the bus transports use in
+  their dispatch loops (stop-event-aware waits, no breaker).
+
+Metrics: ``resilience_retries_total{op}`` counts every retried attempt;
+``resilience_circuit_state{target}`` is 0 closed, 0.5 half-open, 1 open.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _FutureTimeout
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from . import flight
+from .metrics import REGISTRY, MetricsRegistry
+
+logger = logging.getLogger("dct.resilience")
+
+CIRCUIT_CLOSED = "closed"
+CIRCUIT_OPEN = "open"
+CIRCUIT_HALF_OPEN = "half_open"
+
+_STATE_VALUE = {CIRCUIT_CLOSED: 0.0, CIRCUIT_HALF_OPEN: 0.5,
+                CIRCUIT_OPEN: 1.0}
+
+
+class CircuitOpenError(RuntimeError):
+    """Raised instead of attempting an op whose breaker is open."""
+
+    def __init__(self, target: str):
+        super().__init__(f"circuit for {target!r} is open")
+        self.target = target
+
+
+class OperationTimeout(TimeoutError):
+    """A policy-guarded op exceeded its per-attempt ``timeout_s``."""
+
+    def __init__(self, op: str, timeout_s: float):
+        super().__init__(f"{op} exceeded {timeout_s}s timeout")
+        self.op = op
+        self.timeout_s = timeout_s
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Declarative backoff: attempt ``n`` (0-based) waits
+    ``base_delay_s * multiplier**n`` capped at ``max_delay_s``, widened by
+    up to ``jitter`` (a fraction, so 0.1 = ±10%).  ``retryable`` filters
+    which exceptions are worth another attempt (None = all).  A
+    ``retry_after_s`` attribute on the exception (FLOOD_WAIT and
+    HTTP-429 taxonomies) overrides the computed delay, capped at
+    ``retry_after_cap_s``."""
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.1
+    retry_after_cap_s: float = 30.0
+    retryable: Optional[Callable[[BaseException], bool]] = None
+
+    def should_retry(self, exc: BaseException) -> bool:
+        return self.retryable is None or bool(self.retryable(exc))
+
+    def delay_s(self, attempt: int, exc: Optional[BaseException] = None,
+                rng: Callable[[], float] = random.random) -> float:
+        """Wait before retrying after 0-based ``attempt`` failed with
+        ``exc``.  Deterministic with ``jitter=0`` (tests)."""
+        hint = getattr(exc, "retry_after_s", None)
+        if hint is not None:
+            try:
+                return min(float(hint), self.retry_after_cap_s)
+            except (TypeError, ValueError):
+                pass
+        delay = min(self.base_delay_s * (self.multiplier ** attempt),
+                    self.max_delay_s)
+        if self.jitter > 0:
+            delay *= 1.0 + self.jitter * (2.0 * rng() - 1.0)
+        return max(0.0, delay)
+
+
+def retry_call(fn: Callable[..., Any], *args: Any,
+               retry: RetryPolicy,
+               op: str = "op",
+               stop: Optional[threading.Event] = None,
+               sleep: Optional[Callable[[float], None]] = None,
+               registry: MetricsRegistry = REGISTRY,
+               breaker: Optional["CircuitBreaker"] = None,
+               **kwargs: Any) -> Any:
+    """Run ``fn(*args, **kwargs)`` under ``retry``; returns its result or
+    raises the last exception once attempts are exhausted (or the error
+    is classified non-retryable).  This is THE attempt loop — `Policy`
+    delegates here rather than keeping a diverging copy.
+
+    ``stop`` makes the between-attempt waits interruptible (the bus
+    dispatch loops pass their shutdown event so a close() never blocks on
+    a backoff) — a set event short-circuits the *wait*, not the remaining
+    attempts, preserving at-least-once delivery during drain.
+
+    ``breaker`` (if given) is consulted before and fed after every
+    attempt.  A breaker that opens MID-retry re-raises the real
+    underlying error; :class:`CircuitOpenError` surfaces only when the
+    op was shed without a single attempt.
+    """
+    waiter = sleep
+    if waiter is None:
+        waiter = stop.wait if stop is not None else time.sleep
+    retries = registry.counter(
+        "resilience_retries_total",
+        "Retried attempts per operation (utils/resilience.py)")
+    attempts = max(1, retry.max_attempts)
+    last: Optional[BaseException] = None
+    for attempt in range(attempts):
+        if breaker is not None and not breaker.allow():
+            if last is not None:
+                raise last
+            raise CircuitOpenError(breaker.target)
+        try:
+            result = fn(*args, **kwargs)
+        except Exception as e:
+            if breaker is not None:
+                breaker.record_failure()
+            last = e
+            if attempt + 1 >= attempts or not retry.should_retry(e):
+                raise
+            retries.labels(op=op).inc()
+            delay = retry.delay_s(attempt, e)
+            logger.warning("%s failed (attempt %d/%d): %s; retrying in "
+                           "%.3fs", op, attempt + 1, attempts, e, delay)
+            if delay > 0:
+                waiter(delay)
+            continue
+        if breaker is not None:
+            breaker.record_success()
+        return result
+    raise last if last is not None else RuntimeError("unreachable")
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with half-open probes.
+
+    closed: ops flow; ``failure_threshold`` consecutive failures open it.
+    open: ops are rejected (:meth:`allow` returns False) until
+    ``recovery_timeout_s`` passes, then it turns half-open.
+    half-open: up to ``half_open_max_probes`` ops are let through; one
+    success closes the circuit, one failure re-opens it (and restarts the
+    recovery clock).
+
+    Transitions update ``resilience_circuit_state{target}`` and land in
+    the flight ring (kind ``circuit``), so an operator can answer "when
+    did the state store start failing" from a postmortem bundle alone.
+    """
+
+    def __init__(self, target: str, failure_threshold: int = 5,
+                 recovery_timeout_s: float = 30.0,
+                 half_open_max_probes: int = 1,
+                 clock: Callable[[], float] = time.monotonic,
+                 registry: MetricsRegistry = REGISTRY):
+        self.target = target
+        self.failure_threshold = max(1, failure_threshold)
+        self.recovery_timeout_s = recovery_timeout_s
+        self.half_open_max_probes = max(1, half_open_max_probes)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._state = CIRCUIT_CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probes = 0
+        self._gauge = registry.gauge(
+            "resilience_circuit_state",
+            "Circuit state per target: 0 closed, 0.5 half-open, 1 open"
+        ).labels(target=target)
+        self._opens = registry.counter(
+            "resilience_circuit_open_total",
+            "Circuit open transitions per target").labels(target=target)
+        self._gauge.set(0.0)
+
+    # -- state --------------------------------------------------------------
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open_locked()
+            return self._state
+
+    @property
+    def is_open(self) -> bool:
+        """True while ops should be shed (open AND not yet probe-time)."""
+        return not self.allow(consume_probe=False)
+
+    def _transition_locked(self, new_state: str) -> None:
+        if new_state == self._state:
+            return
+        old, self._state = self._state, new_state
+        self._gauge.set(_STATE_VALUE[new_state])
+        if new_state == CIRCUIT_OPEN:
+            self._opens.inc()
+        flight.record("circuit", target=self.target, frm=old, to=new_state,
+                      failures=self._failures)
+        log = logger.warning if new_state == CIRCUIT_OPEN else logger.info
+        log("circuit %s: %s -> %s", self.target, old, new_state)
+
+    def _maybe_half_open_locked(self) -> None:
+        # Caller holds _lock (the `_locked` suffix contract).
+        if self._state == CIRCUIT_OPEN and \
+                self.clock() - self._opened_at >= self.recovery_timeout_s:
+            self._probes = 0  # crawlint: disable=LCK001
+            self._transition_locked(CIRCUIT_HALF_OPEN)
+
+    # -- the op protocol ----------------------------------------------------
+    def allow(self, consume_probe: bool = True) -> bool:
+        """May an op proceed right now?  In half-open state each True
+        consumes one probe slot (unless ``consume_probe=False``, the
+        read-only form status endpoints use)."""
+        with self._lock:
+            self._maybe_half_open_locked()
+            if self._state == CIRCUIT_CLOSED:
+                return True
+            if self._state == CIRCUIT_HALF_OPEN:
+                if self._probes < self.half_open_max_probes:
+                    if consume_probe:
+                        self._probes += 1
+                    return True
+                return False
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            if self._state != CIRCUIT_CLOSED:
+                self._transition_locked(CIRCUIT_CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if self._state == CIRCUIT_HALF_OPEN:
+                # The probe failed: back to open, restart the clock.
+                self._opened_at = self.clock()
+                self._transition_locked(CIRCUIT_OPEN)
+            elif self._state == CIRCUIT_CLOSED and \
+                    self._failures >= self.failure_threshold:
+                self._opened_at = self.clock()
+                self._transition_locked(CIRCUIT_OPEN)
+
+
+class Policy:
+    """Retry + breaker + per-attempt timeout behind one ``call``.
+
+    The per-attempt ``timeout_s`` runs the op on a (lazily built, shared)
+    worker thread and abandons it on expiry — Python can't interrupt a
+    blocked call, so the thread may linger, but the *caller* gets its
+    deadline back (exactly what a wedged state backend needs: the
+    orchestrator loop keeps ticking while the breaker counts the
+    timeouts and opens).
+    """
+
+    def __init__(self, op: str, retry: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 timeout_s: float = 0.0,
+                 registry: MetricsRegistry = REGISTRY):
+        self.op = op
+        self.retry = retry or RetryPolicy()
+        self.breaker = breaker
+        self.timeout_s = timeout_s
+        self.registry = registry
+        self._executor: Optional[ThreadPoolExecutor] = None
+        self._exec_lock = threading.Lock()
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def circuit_open(self) -> bool:
+        return self.breaker is not None and self.breaker.is_open
+
+    # -- execution ----------------------------------------------------------
+    def _run_once(self, fn: Callable[..., Any], args, kwargs) -> Any:
+        if self.timeout_s <= 0:
+            return fn(*args, **kwargs)
+        with self._exec_lock:
+            if self._executor is None:
+                self._executor = ThreadPoolExecutor(
+                    max_workers=2, thread_name_prefix=f"dct-res-{self.op}")
+            executor = self._executor
+        future = executor.submit(fn, *args, **kwargs)
+        try:
+            return future.result(timeout=self.timeout_s)
+        except _FutureTimeout:
+            future.cancel()
+            raise OperationTimeout(self.op, self.timeout_s) from None
+
+    def call(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Any:
+        """Run ``fn`` under the full policy: every attempt checks the
+        breaker (shedding is cheap — no call, no wait), failures feed it,
+        and retries follow the backoff schedule — all via the one shared
+        attempt loop (:func:`retry_call`)."""
+        def attempt_once() -> Any:
+            return self._run_once(fn, args, kwargs)
+
+        return retry_call(attempt_once, retry=self.retry, op=self.op,
+                          registry=self.registry, breaker=self.breaker)
+
+
+def with_policy(policy: Policy) -> Callable[[Callable[..., Any]],
+                                            Callable[..., Any]]:
+    """Decorator form: ``@with_policy(Policy("state_store", ...))``."""
+
+    def deco(fn: Callable[..., Any]) -> Callable[..., Any]:
+        def wrapped(*args: Any, **kwargs: Any) -> Any:
+            return policy.call(fn, *args, **kwargs)
+
+        wrapped.__name__ = getattr(fn, "__name__", "wrapped")
+        wrapped.__doc__ = fn.__doc__
+        wrapped.__wrapped__ = fn
+        return wrapped
+
+    return deco

@@ -44,7 +44,7 @@ from ..bus.messages import (
 )
 from ..config.crawler import CrawlerConfig
 from ..crawl import runner as crawl_runner
-from ..utils import flight, trace
+from ..utils import flight, resilience, trace
 from ..utils.slo import SLOWatchdog, standard_slos
 from ..utils.telemetry import TelemetryEmitter
 from ..state.datamodels import PAGE_PROCESSING, Page, new_id, utcnow
@@ -89,6 +89,12 @@ class WorkerConfig:
     # worker's unit of work is a crawl item, so this is the crawl-latency
     # twin of the TPU worker's batch budget.
     slo_batch_p95_ms: float = 0.0
+    # In-worker fetch attempts per crawl item (utils/resilience.py):
+    # transient errors retry locally with backoff — and FLOOD_WAIT-style
+    # ``retry_after_s`` hints are honoured as server-directed backoff —
+    # before the item is bounced back to the orchestrator's (more
+    # expensive) page-level retry loop.  1 disables local retries.
+    fetch_attempts: int = 2
 
 
 class CrawlWorker:
@@ -116,6 +122,16 @@ class CrawlWorker:
         # SLO watchdog over worker.process p95; empty with no budget.
         self._slo = SLOWatchdog(standard_slos(
             batch_p95_ms=self.wcfg.slo_batch_p95_ms))
+        # Crawl fetches run under the shared resiliency policy: only
+        # errors `should_retry_error` classifies as transient are
+        # retried; permanent failures go straight back as an error
+        # result.
+        self._fetch_policy = resilience.Policy(
+            op="crawl.fetch",
+            retry=resilience.RetryPolicy(
+                max_attempts=max(1, self.wcfg.fetch_attempts),
+                base_delay_s=0.2, max_delay_s=5.0,
+                retryable=should_retry_error))
         self._mu = threading.RLock()
         self._running = False
         self._threads: List[threading.Thread] = []
@@ -327,10 +343,12 @@ class CrawlWorker:
         return result
 
     def _process_telegram(self, page: Page, item: WorkItem) -> List[Page]:
-        """`worker.go:384-401`: pool-backed crawl engine run."""
+        """`worker.go:384-401`: pool-backed crawl engine run, behind the
+        fetch resiliency policy."""
         cfg = work_item_config_to_crawler_config(item.config, "telegram")
         cfg.crawl_id = item.crawl_id or self.config.crawl_id
-        return crawl_runner.run_for_channel_with_pool(
+        return self._fetch_policy.call(
+            crawl_runner.run_for_channel_with_pool,
             page, item.config.storage_root, self.sm, cfg)
 
     def _process_youtube(self, page: Page, item: WorkItem

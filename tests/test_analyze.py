@@ -394,6 +394,53 @@ class TestBUS:
         """}, select=["BUS"])
         assert codes(rep) == ["BUS004"]
 
+    def test_handrolled_handler_retry_loop(self, tmp_path):
+        rep = analyze(tmp_path, {"bus/mybus.py": """
+            from ..utils import trace
+
+            class B:
+                def _deliver(self, payload):
+                    with trace.payload_span("bus.deliver", payload):
+                        for handler in self._handlers:
+                            for attempt in range(3):
+                                try:
+                                    handler(payload)
+                                    break
+                                except Exception:
+                                    continue
+        """}, select=["BUS"])
+        assert "BUS005" in codes(rep)
+
+    def test_handrolled_publish_retry_loop(self, tmp_path):
+        rep = analyze(tmp_path, {"bus/mybus.py": """
+            class B:
+                def send(self, topic, payload):
+                    for attempt in range(5):
+                        try:
+                            self._client.publish(topic, payload)
+                            return
+                        except Exception:
+                            pass
+        """}, select=["BUS"])
+        assert "BUS005" in codes(rep)
+
+    def test_negative_retry_via_resilience(self, tmp_path):
+        rep = analyze(tmp_path, {"bus/mybus.py": """
+            from ..utils import resilience, trace
+
+            class B:
+                def _deliver(self, payload):
+                    with trace.payload_span("bus.deliver", payload):
+                        for handler in self._handlers:
+                            try:
+                                resilience.retry_call(
+                                    handler, payload, retry=self._retry,
+                                    op="bus.local")
+                            except Exception:
+                                self._dead_letter(payload)
+        """}, select=["BUS"])
+        assert "BUS005" not in codes(rep)
+
     def test_negative_proper_transport(self, tmp_path):
         rep = analyze(tmp_path, {"bus/mybus.py": """
             from ..utils import trace

@@ -201,6 +201,13 @@ class TestChaosParsing:
         ])
         assert [f.action for f in faults] == ["drop", "kill"]
 
+    def test_down_is_windowed(self):
+        f = parse_fault("from=1.2s..2.4s down orchestrator")
+        assert f.windowed and f.action == "down"
+        assert (f.target, f.at_s, f.until_s) == ("orchestrator", 1.2, 2.4)
+        with pytest.raises(ValueError, match="needs a window"):
+            parse_fault("at=2s down orchestrator")
+
 
 # ---------------------------------------------------------------------------
 # chaos: controller + bus + engine
@@ -263,6 +270,32 @@ class TestChaosController:
             [f.action for f in timeline]
         for m in msgs:
             m.validate()
+
+    def test_down_window_kills_then_restarts(self):
+        """`down` = kill at window start, supervisor restart at window
+        end — one line for the coordinator-outage pattern."""
+        target = StubTarget()
+        ctl = ChaosController(parse_timeline(["from=1s..2s down orch-x"]),
+                              targets={"orch-x": target})
+        ctl.tick(now_s=0.5)
+        assert target.calls == []
+        ctl.tick(now_s=1.1)
+        assert target.calls == ["kill"]
+        ctl.tick(now_s=2.1)
+        assert target.calls == ["kill", "restart"]
+        assert ctl.done()
+        phases = [(e["action"], e["phase"])
+                  for e in flight.RECORDER.events() if e["kind"] == "chaos"]
+        assert ("down", "apply") in phases and ("down", "unwind") in phases
+
+    def test_stop_mid_window_still_restarts_down_target(self):
+        target = StubTarget()
+        ctl = ChaosController(parse_timeline(["from=1s..50s down orch-x"]),
+                              targets={"orch-x": target})
+        ctl.tick(now_s=1.5)
+        assert target.calls == ["kill"]
+        ctl.stop()  # unwinds open windows: the target must come back
+        assert target.calls == ["kill", "restart"]
 
     def test_unknown_target_rejected_at_construction(self):
         with pytest.raises(ValueError, match="unknown target"):
@@ -523,6 +556,29 @@ class TestGateE2E:
         budget = verdict["checks"]["tail_queue_wait_p95_ms"]
         assert budget["ok"] and budget["value"] <= budget["budget"]
         assert verdict["checks"]["endpoint_cluster"]["ok"]
+
+    def test_kill_orchestrator_scenario_resumes_from_journal(self):
+        """ISSUE 7 acceptance: the kill-orchestrator scenario — the
+        coordinator dies mid-run on the gRPC bus and a fresh generation
+        resumes from its journal.  Zero lost/duplicated records by
+        post_uid reconciliation (the record stream must not depend on
+        coordinator liveness), orchestrator-side id reconciliation
+        (every page terminal exactly once), the kill/resume flight
+        events, and the recovery tail inside its p95 budgets."""
+        verdict = run_scenario(load_scenario("kill-orchestrator"))
+        assert verdict["status"] == "pass", verdict["checks"]
+        assert verdict["lost"] == 0 and verdict["duplicates"] == 0
+        orch = verdict["orchestrator"]
+        assert orch["generations"] == 2 and orch["resumed"]
+        assert orch["pages_by_status"] == {"fetched": 2}
+        assert orch["completed_items"] == 2
+        assert verdict["checks"]["orch_pages_lost"]["ok"]
+        assert verdict["checks"]["orch_result_duplicates"]["ok"]
+        assert verdict["checks"]["flight_orch_kill"]["ok"]
+        assert verdict["checks"]["flight_orch_resume"]["ok"]
+        assert verdict["tail_breaches"] == {}
+        budget = verdict["checks"]["tail_queue_wait_p95_ms"]
+        assert budget["ok"] and budget["value"] <= budget["budget"]
 
     def test_replay_through_gate_loses_nothing(self, tmp_path):
         """The dump-bundle → replay workflow end to end: a recorded run

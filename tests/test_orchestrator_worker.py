@@ -33,7 +33,11 @@ from distributed_crawler_tpu.clients import SimNetwork, SimTelegramClient
 from distributed_crawler_tpu.clients.pool import ConnectionPool
 from distributed_crawler_tpu.config import CrawlerConfig
 from distributed_crawler_tpu.crawl import runner as crawl_runner
-from distributed_crawler_tpu.orchestrator import Orchestrator, OrchestratorConfig
+from distributed_crawler_tpu.orchestrator import (
+    CrawlJournal,
+    Orchestrator,
+    OrchestratorConfig,
+)
 from distributed_crawler_tpu.state import (
     CompositeStateManager,
     SqlConfig,
@@ -374,12 +378,15 @@ class TestOrchestrator:
         assert fresh_id in orch.active_work
         assert orch.completed_items == 0
 
-        # Past the TTL again with the budget exhausted: abandoned.
+        # Past the TTL again with the budget exhausted: abandoned — the
+        # terminal status is the durable marker, so the per-page retry
+        # counter is pruned rather than pinned at max forever.
         assert orch.requeue_stale_work(utcnow() + timedelta(seconds=240)) == 0
         assert not orch.active_work
         page = orch.sm.get_layer_by_depth(0)[0]
-        assert page.status == "error"
+        assert page.status == "abandoned"
         assert "expired" in page.error
+        assert orch._retry_counts == {}
 
     def test_max_depth_caps_distribution(self, tmp_path):
         bus = InMemoryBus()
@@ -403,6 +410,288 @@ class TestOrchestrator:
         for _ in range(4):
             orch.distribute_work()
         assert orch.crawl_completed
+
+
+class TestCrashRecovery:
+    """ISSUE 7 tentpole: journal-backed orchestrator crash recovery —
+    replay determinism, resume (no re-seed, in-flight requeue), idempotent
+    result application across restarts, --fresh, and retry-count pruning."""
+
+    def _journal(self, tmp_path):
+        return CrawlJournal(str(tmp_path / "journal"))
+
+    def _start_crawl(self, tmp_path, bus, seeds=("chana", "chanb")):
+        orch = Orchestrator("c1", make_cfg(), bus, make_sm(tmp_path),
+                            journal=self._journal(tmp_path))
+        orch.start(list(seeds), background=False)
+        return orch
+
+    def test_journal_replay_is_deterministic(self, tmp_path):
+        bus = InMemoryBus()
+        orch = self._start_crawl(tmp_path, bus)
+        orch.distribute_work()
+        item = next(iter(orch.active_work.values()))
+        from distributed_crawler_tpu.bus.messages import DiscoveredPage
+        orch.handle_result(ResultMessage.new(
+            WorkResult(work_item_id=item.id, worker_id="w1",
+                       status=STATUS_SUCCESS, processed_url=item.url,
+                       completed_at=utcnow()),
+            [DiscoveredPage(url="chanc", parent_id=item.parent_id,
+                            depth=1, platform="telegram")]))
+        journal = self._journal(tmp_path)
+        rec1, rec2 = journal.replay(), journal.replay()
+        assert rec1.to_debug_dict() == rec2.to_debug_dict()
+        assert rec1.completed_items == 1
+        assert item.id in rec1.applied_results
+        # The other seed is still in flight; the completed one is not.
+        assert item.id not in rec1.active_work
+        assert len(rec1.active_work) == 1
+        assert [(d, len(p)) for d, p in rec1.layers][0] == (0, 2)
+
+    def test_journal_tolerates_torn_tail_line(self, tmp_path):
+        journal = self._journal(tmp_path)
+        journal.append("begin", crawl_id="c1")
+        journal.append("depth", depth=3)
+        with open(journal.journal_path, "a", encoding="utf-8") as f:
+            f.write('{"kind": "result", "work_item_id": "wx", "stat')
+        rec = journal.replay()
+        assert rec.current_depth == 3
+        assert rec.events_replayed == 2  # torn tail dropped, not fatal
+
+    def test_replay_idempotent_when_event_survives_compaction(
+            self, tmp_path):
+        """An event can land in the journal just after a concurrent
+        compaction truncated it (the append races the snapshot); folding
+        it over a snapshot that already accounts for the item must be a
+        no-op, not a double-count."""
+        journal = self._journal(tmp_path)
+        journal.snapshot({"crawl_id": "c1", "completed_items": 1,
+                          "total_work_items": 2,
+                          "applied_results": ["w1"],
+                          "active_work": {"w2": {"id": "w2", "url": "u2"}}})
+        journal.append("result", work_item_id="w1", status="success",
+                       page_id="p1", page_status="fetched", retries=0)
+        journal.append("dispatch", item={"id": "w2", "url": "u2"},
+                       page_id="p2")
+        rec = journal.replay()
+        assert rec.completed_items == 1   # not 2
+        assert rec.total_work_items == 2  # not 3
+        assert set(rec.active_work) == {"w2"}
+
+    def test_foreign_journal_is_discarded_not_resumed(self, tmp_path):
+        """A shared journal dir must never hand one crawl another
+        crawl's state: a journal recorded under a different crawl_id is
+        discarded (with a warning) and the crawl seeds fresh."""
+        journal = self._journal(tmp_path)
+        journal.append("begin", crawl_id="some-other-crawl")
+        journal.append("dispatch", item={"id": "wx", "url": "ux"},
+                       page_id="px")
+        journal.close()
+        orch = Orchestrator("c1", make_cfg(), InMemoryBus(),
+                            make_sm(tmp_path),
+                            journal=self._journal(tmp_path))
+        orch.start(["chana"], background=False)
+        assert not orch.resumed
+        assert not orch.active_work
+        assert [p.url for p in orch.sm.get_layer_by_depth(0)] == ["chana"]
+        assert self._journal(tmp_path).recorded_crawl_id() == "c1"
+
+    def test_kill_then_resume_requeues_inflight(self, tmp_path):
+        bus = InMemoryBus()
+        orch1 = self._start_crawl(tmp_path, bus)
+        assert orch1.distribute_work() == 2
+        inflight_ids = set(orch1.active_work)
+        orch1.kill()
+
+        republished = []
+        bus.subscribe(TOPIC_WORK_QUEUE, republished.append)
+        orch2 = Orchestrator("c1", make_cfg(), bus, make_sm(tmp_path),
+                             journal=self._journal(tmp_path))
+        orch2.start(["chana", "chanb"], background=False)
+        assert orch2.resumed
+        # No re-seed: still exactly the two original pages at depth 0.
+        assert len(orch2.sm.get_layer_by_depth(0)) == 2
+        # In-flight work rebuilt under the SAME ids and republished HIGH.
+        assert set(orch2.active_work) == inflight_ids
+        assert {m["work_item"]["id"] for m in republished} == inflight_ids
+        assert all(m["priority"] == PRIORITY_HIGH for m in republished)
+        assert all(p.status == "processing"
+                   for p in orch2.sm.get_layer_by_depth(0))
+
+        # A result completes the requeued item; a replay of the same
+        # result is single-counted (idempotence by work-item id).
+        wid = sorted(inflight_ids)[0]
+        msg = ResultMessage.new(WorkResult(
+            work_item_id=wid, worker_id="w1", status=STATUS_SUCCESS,
+            processed_url=orch2.active_work[wid].url, completed_at=utcnow()))
+        orch2.handle_result(msg)
+        assert orch2.completed_items == 1
+        orch2.handle_result(msg)
+        assert orch2.completed_items == 1
+
+    def test_result_applied_before_crash_not_double_counted(self, tmp_path):
+        bus = InMemoryBus()
+        orch1 = self._start_crawl(tmp_path, bus, seeds=("chana",))
+        orch1.distribute_work()
+        item = next(iter(orch1.active_work.values()))
+        msg = ResultMessage.new(WorkResult(
+            work_item_id=item.id, worker_id="w1", status=STATUS_SUCCESS,
+            processed_url=item.url, completed_at=utcnow()))
+        orch1.handle_result(msg)
+        assert orch1.completed_items == 1
+        orch1.kill()
+
+        orch2 = Orchestrator("c1", make_cfg(), bus, make_sm(tmp_path),
+                             journal=self._journal(tmp_path))
+        orch2.start(["chana"], background=False)
+        assert orch2.resumed and orch2.completed_items == 1
+        assert not orch2.active_work
+        page = orch2.sm.get_layer_by_depth(0)[0]
+        assert page.status == "fetched"
+        # The broker redelivers the result the dead generation already
+        # applied: the journaled idempotence set absorbs it.
+        orch2.handle_result(msg)
+        assert orch2.completed_items == 1
+
+    def test_mid_crawl_kill_resume_completes_crawl(self, tmp_path,
+                                                   telegram_net):
+        """End-to-end: orchestrator killed with a work item in flight;
+        the restarted generation resumes from the journal, the requeued
+        item is crawled, discovery continues, and the crawl completes
+        with nothing lost and nothing double-counted."""
+        install_pool(telegram_net)
+        bus = InMemoryBus()
+        orch1 = self._start_crawl(tmp_path, bus, seeds=("chana",))
+        # Dispatch with NO worker attached: the item is in flight and its
+        # delivery dies with the orchestrator's generation.
+        assert orch1.distribute_work() == 1
+        orch1.kill()
+
+        republished = []
+        bus.subscribe(TOPIC_WORK_QUEUE, republished.append)
+        orch2 = Orchestrator("c1", make_cfg(), bus, make_sm(tmp_path),
+                             journal=self._journal(tmp_path))
+        orch2.start(["chana"], background=False)
+        assert orch2.resumed and len(republished) == 1
+        worker = CrawlWorker("w1", make_cfg(), bus,
+                             make_sm(tmp_path, sub="wrk"))
+        worker.start(background=False)
+        # Hand the worker the requeued delivery (it subscribed after the
+        # resume republication on this sync in-memory bus).
+        worker.handle_work_payload(republished[0])
+        for _ in range(8):
+            orch2.distribute_work()
+            if orch2.crawl_completed:
+                break
+        assert orch2.crawl_completed
+        assert orch2.completed_items == 2  # chana + discovered chanb
+        assert orch2.error_items == 0
+        assert all(p.status == "fetched"
+                   for p in orch2.sm.get_layer_by_depth(0))
+        assert [p.url for p in orch2.sm.get_layer_by_depth(1)] == ["chanb"]
+
+    def test_result_apply_deferred_until_store_recovers(self, tmp_path):
+        """A result arriving while the state store is wedged is counted
+        once but its page transition + discovery are PARKED, not lost:
+        the next tick after the circuit closes applies them."""
+        from tests.test_resilience import WedgeableSM
+        from distributed_crawler_tpu.bus.messages import DiscoveredPage
+
+        bus = InMemoryBus()
+        sm = WedgeableSM(make_sm(tmp_path))
+        orch = Orchestrator(
+            "c1", make_cfg(), bus, sm,
+            OrchestratorConfig(state_retry_attempts=1,
+                               state_breaker_threshold=1,
+                               state_breaker_recovery_s=0.0),
+            journal=self._journal(tmp_path))
+        orch.start(["chana"], background=False)
+        orch.distribute_work()
+        item = next(iter(orch.active_work.values()))
+
+        sm.wedged = True
+        orch.handle_result(ResultMessage.new(
+            WorkResult(work_item_id=item.id, worker_id="w1",
+                       status=STATUS_SUCCESS, processed_url=item.url,
+                       completed_at=utcnow()),
+            [DiscoveredPage(url="chanb", parent_id=item.parent_id,
+                            depth=1, platform="telegram")]))
+        assert orch.completed_items == 1  # counted exactly once
+        assert orch._deferred_results     # but application is parked
+        assert sm._inner.get_layer_by_depth(0)[0].status == "processing"
+
+        sm.wedged = False
+        orch.distribute_work()            # tick flushes the deferred work
+        assert not orch._deferred_results
+        assert sm._inner.get_layer_by_depth(0)[0].status == "fetched"
+        assert [p.url for p in sm._inner.get_layer_by_depth(1)] == ["chanb"]
+
+    def test_fresh_flag_discards_existing_crawl(self, tmp_path):
+        bus = InMemoryBus()
+        orch1 = self._start_crawl(tmp_path, bus, seeds=("chana",))
+        orch1.distribute_work()
+        orch1.stop()
+
+        orch2 = Orchestrator("c1", make_cfg(), bus, make_sm(tmp_path),
+                             journal=self._journal(tmp_path))
+        orch2.start(["chana", "chanb"], background=False, fresh=True)
+        assert not orch2.resumed
+        assert orch2.completed_items == 0 and not orch2.active_work
+        pages = orch2.sm.get_layer_by_depth(0)
+        assert sorted(p.url for p in pages) == ["chana", "chanb"]
+        assert all(p.status == "unfetched" for p in pages)
+
+    def test_resume_without_journal_sweeps_processing_pages(self, tmp_path):
+        """Satellite: start() must not clobber a pre-existing crawl even
+        journal-less — persisted state resumes, and orphaned PROCESSING
+        pages go back to unfetched for re-dispatch."""
+        bus = InMemoryBus()
+        orch1 = Orchestrator("c1", make_cfg(), bus, make_sm(tmp_path))
+        orch1.start(["chana"], background=False)
+        orch1.distribute_work()
+        orch1.stop()  # persists state.json with the page PROCESSING
+
+        orch2 = Orchestrator("c1", make_cfg(), bus, make_sm(tmp_path))
+        orch2.start(["chana"], background=False)
+        assert orch2.resumed
+        pages = orch2.sm.get_layer_by_depth(0)
+        assert len(pages) == 1  # not re-seeded on top
+        assert pages[0].status == "unfetched"  # swept for re-dispatch
+        assert orch2.distribute_work() == 1
+
+    def test_retry_counts_pruned_on_terminal_states(self, tmp_path):
+        """Satellite: _retry_counts entries are cleared on every terminal
+        page state (fetched / permanent failure / exhausted budget)."""
+        bus = InMemoryBus()
+        orch = Orchestrator("c1", make_cfg(), bus, make_sm(tmp_path),
+                            OrchestratorConfig(max_retries=2))
+        orch.start(["chana", "chanb"], background=False)
+        orch.distribute_work()
+        items = {i.url: i for i in orch.active_work.values()}
+
+        # chana: transient error then success -> entry created then pruned.
+        orch.handle_result(ResultMessage.new(WorkResult(
+            work_item_id=items["chana"].id, worker_id="w1",
+            status=STATUS_ERROR, error="timeout", processed_url="chana",
+            completed_at=utcnow(), retry_recommended=True)))
+        assert len(orch._retry_counts) == 1
+        orch.distribute_work()
+        retry_item = next(i for i in orch.active_work.values()
+                          if i.url == "chana")
+        orch.handle_result(ResultMessage.new(WorkResult(
+            work_item_id=retry_item.id, worker_id="w1",
+            status=STATUS_SUCCESS, processed_url="chana",
+            completed_at=utcnow())))
+        # chanb: permanent failure -> abandoned, no lingering entry.
+        orch.handle_result(ResultMessage.new(WorkResult(
+            work_item_id=items["chanb"].id, worker_id="w1",
+            status=STATUS_ERROR, error="channel not found",
+            processed_url="chanb", completed_at=utcnow(),
+            retry_recommended=False)))
+        assert orch._retry_counts == {}
+        statuses = {p.url: p.status for p in orch.sm.get_layer_by_depth(0)}
+        assert statuses == {"chana": "fetched", "chanb": "abandoned"}
+        assert orch.distribute_work() == 0  # abandoned page not retried
 
 
 class TestWorker:

@@ -1,0 +1,346 @@
+"""Unit tests for the unified resiliency policy layer (utils/resilience.py):
+backoff math, server-directed backoff hints, retry_call semantics, the
+circuit breaker's closed -> open -> half-open -> closed walk (with metrics
+gauge + flight events), per-attempt timeouts, and the orchestrator-level
+acceptance: a wedged state backend opens the circuit, dispatch pauses via
+backpressure instead of raising, and a half-open probe closes it after
+recovery.
+"""
+
+import threading
+import time
+
+import pytest
+
+from distributed_crawler_tpu.clients.errors import FloodWaitError
+from distributed_crawler_tpu.utils import flight, resilience
+from distributed_crawler_tpu.utils.metrics import MetricsRegistry
+from distributed_crawler_tpu.utils.resilience import (
+    CIRCUIT_CLOSED,
+    CIRCUIT_HALF_OPEN,
+    CIRCUIT_OPEN,
+    CircuitBreaker,
+    CircuitOpenError,
+    OperationTimeout,
+    Policy,
+    RetryPolicy,
+    retry_call,
+    with_policy,
+)
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestRetryPolicyMath:
+    def test_exponential_backoff_with_cap(self):
+        p = RetryPolicy(base_delay_s=0.1, multiplier=2.0, max_delay_s=0.5,
+                        jitter=0.0)
+        assert p.delay_s(0) == pytest.approx(0.1)
+        assert p.delay_s(1) == pytest.approx(0.2)
+        assert p.delay_s(2) == pytest.approx(0.4)
+        assert p.delay_s(3) == pytest.approx(0.5)  # capped
+        assert p.delay_s(10) == pytest.approx(0.5)
+
+    def test_jitter_bounds(self):
+        p = RetryPolicy(base_delay_s=1.0, multiplier=1.0, max_delay_s=1.0,
+                        jitter=0.25)
+        lo = p.delay_s(0, rng=lambda: 0.0)   # widest negative jitter
+        hi = p.delay_s(0, rng=lambda: 1.0)   # widest positive jitter
+        assert lo == pytest.approx(0.75)
+        assert hi == pytest.approx(1.25)
+
+    def test_retry_after_hint_overrides_backoff(self):
+        """A FLOOD_WAIT-style retry_after_s is the server telling us the
+        backoff; the computed schedule is ignored."""
+        p = RetryPolicy(base_delay_s=0.01, max_delay_s=0.1, jitter=0.0)
+        assert p.delay_s(0, FloodWaitError(5)) == pytest.approx(5.0)
+
+    def test_retry_after_hint_is_capped(self):
+        p = RetryPolicy(jitter=0.0, retry_after_cap_s=3.0)
+        assert p.delay_s(0, FloodWaitError(300)) == pytest.approx(3.0)
+
+    def test_non_numeric_hint_falls_back_to_schedule(self):
+        class Weird(Exception):
+            retry_after_s = "soon"
+
+        p = RetryPolicy(base_delay_s=0.2, jitter=0.0)
+        assert p.delay_s(0, Weird()) == pytest.approx(0.2)
+
+
+class TestRetryCall:
+    def test_succeeds_after_transient_failures(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ValueError("transient")
+            return "ok"
+
+        out = retry_call(flaky, retry=RetryPolicy(max_attempts=3,
+                                                  base_delay_s=0.0),
+                         op="t", sleep=lambda s: None)
+        assert out == "ok" and len(calls) == 3
+
+    def test_exhaustion_raises_last_error(self):
+        def always():
+            raise ValueError("nope")
+
+        with pytest.raises(ValueError, match="nope"):
+            retry_call(always, retry=RetryPolicy(max_attempts=2,
+                                                 base_delay_s=0.0),
+                       op="t", sleep=lambda s: None)
+
+    def test_sleep_sequence_follows_policy(self):
+        slept = []
+
+        def always():
+            raise ValueError("x")
+
+        with pytest.raises(ValueError):
+            retry_call(always,
+                       retry=RetryPolicy(max_attempts=3, base_delay_s=0.1,
+                                         multiplier=2.0, jitter=0.0),
+                       op="t", sleep=slept.append)
+        assert slept == [pytest.approx(0.1), pytest.approx(0.2)]
+
+    def test_non_retryable_raises_immediately(self):
+        calls = []
+
+        def permanent():
+            calls.append(1)
+            raise ValueError("channel not found")
+
+        with pytest.raises(ValueError):
+            retry_call(permanent,
+                       retry=RetryPolicy(
+                           max_attempts=5, base_delay_s=0.0,
+                           retryable=lambda e: "not found" not in str(e)),
+                       op="t", sleep=lambda s: None)
+        assert len(calls) == 1
+
+    def test_stop_event_short_circuits_waits(self):
+        stop = threading.Event()
+        stop.set()
+        calls = []
+
+        def always():
+            calls.append(1)
+            raise ValueError("x")
+
+        t0 = time.monotonic()
+        with pytest.raises(ValueError):
+            retry_call(always,
+                       retry=RetryPolicy(max_attempts=3, base_delay_s=5.0,
+                                         jitter=0.0),
+                       op="t", stop=stop)
+        # Attempts still happen (at-least-once drain), but nothing waited.
+        assert len(calls) == 3
+        assert time.monotonic() - t0 < 1.0
+
+    def test_retry_metric_counts_retried_attempts(self):
+        reg = MetricsRegistry()
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ValueError("t")
+            return 1
+
+        retry_call(flaky, retry=RetryPolicy(max_attempts=3,
+                                            base_delay_s=0.0),
+                   op="myop", sleep=lambda s: None, registry=reg)
+        series = dict((tuple(sorted(lbl.items())), v) for lbl, v in
+                      reg.counter("resilience_retries_total").series())
+        assert series[(("op", "myop"),)] == 2
+
+
+class TestCircuitBreaker:
+    def setup_method(self):
+        flight.configure(capacity=128)
+
+    def _events(self, target):
+        return [e for e in flight.RECORDER.events()
+                if e.get("kind") == "circuit" and e.get("target") == target]
+
+    def test_opens_after_threshold_and_gauge_tracks(self):
+        clock = FakeClock()
+        reg = MetricsRegistry()
+        br = CircuitBreaker("t1", failure_threshold=3,
+                            recovery_timeout_s=10.0, clock=clock,
+                            registry=reg)
+        assert br.state == CIRCUIT_CLOSED and br.allow()
+        for _ in range(2):
+            br.record_failure()
+        assert br.state == CIRCUIT_CLOSED
+        br.record_failure()
+        assert br.state == CIRCUIT_OPEN
+        assert not br.allow()
+        gauge = dict((tuple(sorted(lbl.items())), v) for lbl, v in
+                     reg.gauge("resilience_circuit_state").series())
+        assert gauge[(("target", "t1"),)] == 1.0
+        opens = self._events("t1")
+        assert opens and opens[-1]["to"] == "open"
+
+    def test_half_open_probe_closes_on_success(self):
+        clock = FakeClock()
+        br = CircuitBreaker("t2", failure_threshold=1,
+                            recovery_timeout_s=10.0, clock=clock)
+        br.record_failure()
+        assert br.state == CIRCUIT_OPEN
+        clock.advance(10.1)
+        assert br.state == CIRCUIT_HALF_OPEN
+        assert br.allow()          # the single probe slot
+        assert not br.allow()      # no second probe
+        br.record_success()
+        assert br.state == CIRCUIT_CLOSED and br.allow()
+        kinds = [e["to"] for e in self._events("t2")]
+        assert kinds == ["open", "half_open", "closed"]
+
+    def test_half_open_probe_failure_reopens_and_restarts_clock(self):
+        clock = FakeClock()
+        br = CircuitBreaker("t3", failure_threshold=1,
+                            recovery_timeout_s=10.0, clock=clock)
+        br.record_failure()
+        clock.advance(10.1)
+        assert br.allow()
+        br.record_failure()
+        assert br.state == CIRCUIT_OPEN
+        clock.advance(5.0)  # not yet recovered: the clock restarted
+        assert br.state == CIRCUIT_OPEN and not br.allow()
+        clock.advance(5.5)
+        assert br.state == CIRCUIT_HALF_OPEN
+
+    def test_success_resets_consecutive_failures(self):
+        br = CircuitBreaker("t4", failure_threshold=2)
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        assert br.state == CIRCUIT_CLOSED
+
+
+class TestPolicy:
+    def test_open_circuit_sheds_without_calling(self):
+        clock = FakeClock()
+        br = CircuitBreaker("t5", failure_threshold=1,
+                            recovery_timeout_s=60.0, clock=clock)
+        pol = Policy("op5", retry=RetryPolicy(max_attempts=3,
+                                              base_delay_s=0.0),
+                     breaker=br)
+        with pytest.raises(ValueError):
+            pol.call(lambda: (_ for _ in ()).throw(ValueError("boom")))
+        assert br.state == CIRCUIT_OPEN
+        calls = []
+        with pytest.raises(CircuitOpenError):
+            pol.call(lambda: calls.append(1))
+        assert calls == []  # shed, not attempted
+        assert pol.circuit_open
+
+    def test_timeout_counts_as_failure(self):
+        br = CircuitBreaker("t6", failure_threshold=1)
+        pol = Policy("op6", retry=RetryPolicy(max_attempts=1),
+                     breaker=br, timeout_s=0.05)
+        with pytest.raises(OperationTimeout):
+            pol.call(time.sleep, 0.5)
+        assert br.state == CIRCUIT_OPEN
+
+    def test_with_policy_decorator_passes_args(self):
+        pol = Policy("op7", retry=RetryPolicy(max_attempts=2,
+                                              base_delay_s=0.0))
+
+        @with_policy(pol)
+        def add(a, b=0):
+            return a + b
+
+        assert add(2, b=3) == 5
+
+
+class WedgeableSM:
+    """Pass-through state manager whose reads/writes can be wedged."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.wedged = False
+
+    def _guard(self):
+        if self.wedged:
+            raise RuntimeError("backend wedged")
+
+    def get_layer_by_depth(self, depth):
+        self._guard()
+        return self._inner.get_layer_by_depth(depth)
+
+    def update_page(self, page):
+        self._guard()
+        return self._inner.update_page(page)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class TestOrchestratorCircuitEndToEnd:
+    """ISSUE 7 acceptance: a wedged state backend opens the circuit
+    (gauge + flight event), dispatch pauses via backpressure rather than
+    raising, and a half-open probe closes it after recovery."""
+
+    def test_wedge_opens_circuit_backpressure_then_recovery(self, tmp_path):
+        from distributed_crawler_tpu.bus import InMemoryBus
+        from distributed_crawler_tpu.orchestrator import (
+            Orchestrator,
+            OrchestratorConfig,
+        )
+        from tests.test_orchestrator_worker import make_cfg, make_sm
+
+        flight.configure(capacity=256)
+        clock = FakeClock()
+        sm = WedgeableSM(make_sm(tmp_path))
+        bus = InMemoryBus()
+        published = []
+        bus.subscribe("crawl-work-queue", published.append)
+        orch = Orchestrator(
+            "c1", make_cfg(), bus, sm,
+            OrchestratorConfig(state_retry_attempts=1,
+                               state_breaker_threshold=3,
+                               state_breaker_recovery_s=10.0),
+            clock=clock)
+        orch.start(["chana"], background=False)
+
+        sm.wedged = True
+        # Failures accumulate without ever raising out of the tick.
+        for _ in range(3):
+            assert orch.distribute_work() == 0
+        assert orch._state_policy.breaker.state == CIRCUIT_OPEN
+        # Next tick: the open circuit engages the dispatch backpressure.
+        assert orch.distribute_work() == 0
+        st = orch.get_status()
+        assert st["backpressure_active"] is True
+        assert st["state_circuit"] == CIRCUIT_OPEN
+        assert any(e.get("kind") == "backpressure"
+                   and e.get("reason") == "state_circuit_open"
+                   for e in flight.RECORDER.events())
+        assert any(e.get("kind") == "circuit" and e.get("to") == "open"
+                   and e.get("target") == "state-store"
+                   for e in flight.RECORDER.events())
+        assert published == []
+
+        # Backend recovers; after the recovery timeout the next tick IS
+        # the half-open probe, it succeeds, the circuit closes, and the
+        # seed page finally dispatches.
+        sm.wedged = False
+        clock.advance(10.5)
+        assert orch.distribute_work() == 1
+        assert orch._state_policy.breaker.state == CIRCUIT_CLOSED
+        assert orch.get_status()["backpressure_active"] is False
+        assert len(published) == 1
+        assert any(e.get("kind") == "circuit" and e.get("to") == "closed"
+                   for e in flight.RECORDER.events())

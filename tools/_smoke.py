@@ -71,6 +71,9 @@ def selfcheck() -> int:
          os.path.join(repo, "tests", "test_metrics_trace.py"),
          os.path.join(repo, "tests", "test_fleet_telemetry.py"),
          os.path.join(repo, "tests", "test_perf_observability.py"),
+         os.path.join(repo, "tests", "test_resilience.py"),
+         # test_loadgen includes the kill-orchestrator gate acceptance
+         # (the crash-recovery closure) alongside kill-worker.
          os.path.join(repo, "tests", "test_loadgen.py")],
         env=env, cwd=repo)
 

@@ -11,6 +11,12 @@ The message layer's contract has three legs crawlint can see statically:
   ``trace.inject`` propagation seam (or delegates to one that does).
 - BUS004 every handler-dispatch loop in `bus/` wraps delivery in
   ``trace.payload_span`` so the hop lands in the envelope's trace.
+- BUS005 no hand-rolled retry loop around bus delivery/publish: a
+  ``for _ in range(...)`` loop try/excepting a ``handler(...)`` call or a
+  ``*.publish(...)`` call re-implements backoff/attempt policy ad hoc —
+  the schedule must be declared once through ``utils/resilience.py``
+  (``retry_call`` / ``Policy``), which is also where FLOOD_WAIT-style
+  server backoff hints and retry metrics live.
 """
 
 from __future__ import annotations
@@ -144,6 +150,57 @@ def _check_transport(mod: ModuleInfo) -> List[Finding]:
     return findings
 
 
+_RESILIENCE_MARKERS = ("retry_call", "with_policy", "Policy")
+
+
+def _uses_resilience(fn: ast.AST, imports: Dict[str, str]) -> bool:
+    """True when the function routes through utils/resilience.py — a
+    dotted ``resilience.*`` call or one of the module's entry points."""
+    for call in _calls_in(fn, imports):
+        if "resilience" in call:
+            return True
+        if call.split(".")[-1] in _RESILIENCE_MARKERS:
+            return True
+    return False
+
+
+def _check_retry_loops(mod: ModuleInfo) -> List[Finding]:
+    """BUS005: ``for ... in range(...)`` + try/except around a delivery
+    (``handler(...)``) or a ``*.publish(...)`` inside bus/ modules."""
+    findings: List[Finding] = []
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if _uses_resilience(fn, mod.imports):
+            continue
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.For)
+                    and isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"):
+                continue
+            delivers = False
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Try):
+                    continue
+                for call in ast.walk(sub):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    if isinstance(call.func, ast.Name) \
+                            and call.func.id == "handler":
+                        delivers = True
+                    elif isinstance(call.func, ast.Attribute) \
+                            and call.func.attr == "publish":
+                        delivers = True
+            if delivers:
+                findings.append(Finding(
+                    path=mod.path, line=node.lineno, code="BUS005",
+                    message=f"{fn.name}() hand-rolls a retry loop around "
+                            "bus delivery/publish instead of using "
+                            "utils/resilience.py", context=fn.name))
+    return findings
+
+
 def self_dispatches_handlers(fn: ast.AST) -> bool:
     """True for functions that invoke a subscriber callback — a call to a
     bare name ``handler`` (the repo-wide dispatch-loop idiom)."""
@@ -167,4 +224,5 @@ def check_tree(modules: List[ModuleInfo]) -> List[Finding]:
     for mod in modules:
         if "/bus/" in mod.path or mod.path.startswith("bus/"):
             findings.extend(_check_transport(mod))
+            findings.extend(_check_retry_loops(mod))
     return findings

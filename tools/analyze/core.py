@@ -43,6 +43,10 @@ HINTS: Dict[str, str] = {
               "propagation seam), or delegate to a transport that does",
     "BUS004": "wrap handler dispatch in trace.payload_span(...) so the "
               "delivery hop lands in the envelope's trace",
+    "BUS005": "replace the hand-rolled retry loop with "
+              "utils/resilience.py (retry_call / Policy) so the "
+              "backoff schedule, FLOOD_WAIT hints, and retry metrics "
+              "are declared once",
     "EXC001": "log (or count) the swallowed exception — a silent handler "
               "in a worker loop erases the failure",
 }
