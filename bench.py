@@ -64,6 +64,7 @@ PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "75"))
 PROBE_RETRY_COOLDOWN_S = int(os.environ.get("BENCH_PROBE_RETRY_S", "60"))
 CPU_FALLBACK_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT_S", "300"))
 ASR_TIMEOUT_S = int(os.environ.get("BENCH_ASR_TIMEOUT_S", "240"))
+ASR_TINY_TIMEOUT_S = int(os.environ.get("BENCH_ASR_TINY_TIMEOUT_S", "120"))
 XLMR_TIMEOUT_S = int(os.environ.get("BENCH_XLMR_TIMEOUT_S", "300"))
 MOE_TIMEOUT_S = int(os.environ.get("BENCH_MOE_TIMEOUT_S", "420"))
 
@@ -698,7 +699,10 @@ def _measure_asr(batch: int = 8, decode_len: int = 48,
     never emit EOT, so every run times the identical worst-case workload.
     Reported as RTFx: seconds of audio transcribed per wall-clock second
     (each 30 s window counts fully; the per-call host readback is included,
-    matching what a media-transcription worker experiences).
+    matching what a media-transcription worker experiences) — plus
+    ``asr_windows_per_s``, the unit the serving ASR worker's scheduler
+    (`media/chunker.py`) and efficiency meters speak: fixed audio
+    windows through the device per wall-clock second.
     """
     import jax
     import jax.numpy as jnp
@@ -741,11 +745,28 @@ def _measure_asr(batch: int = 8, decode_len: int = 48,
     # decode_len-1 decoder forwards actually ran.
     return {
         "asr_rtfx": round(audio_sec / t_call, 1),
+        "asr_windows_per_s": round(batch / t_call, 2),
         "asr_decode_tokens_per_sec": round(
             batch * (decode_len - 1) / t_call, 1),
         "asr_batch": batch,
         "asr_decode_len": decode_len,
+        "asr_model": "whisper-small" if model_cfg is None else "custom",
+        "asr_window_s": round(win / float(SAMPLE_RATE), 2),
     }
+
+
+def _measure_asr_tiny(batch: int = 4, decode_len: int = 6,
+                      samples: int = 3) -> dict:
+    """Sized-down ASR leg for non-TPU hosts: the WHISPER_TEST config
+    (millisecond-scale decode on CPU) keeps the ``asr_windows_per_s`` /
+    RTFx rows present in every BENCH json — clearly labelled, never
+    comparable to the whisper-small TPU numbers."""
+    from distributed_crawler_tpu.models.whisper import WHISPER_TEST
+
+    out = _measure_asr(batch=batch, decode_len=decode_len,
+                       samples=samples, model_cfg=WHISPER_TEST)
+    out["asr_model"] = "whisper-test-cpu"
+    return out
 
 
 def _cpu_env(n_devices: int) -> dict:
@@ -903,7 +924,10 @@ def _child_main() -> None:
         print(json.dumps(_probe()), flush=True)
         return
     if "--asr" in sys.argv:
-        print(json.dumps(_measure_asr()), flush=True)
+        if "--asr-tiny" in sys.argv:
+            print(json.dumps(_measure_asr_tiny()), flush=True)
+        else:
+            print(json.dumps(_measure_asr()), flush=True)
         return
     if "--xlmr" in sys.argv:
         print(json.dumps(_measure_xlmr_int8()), flush=True)
@@ -1032,12 +1056,25 @@ def _parent() -> None:
         # surface the last REAL TPU ASR measurement, clearly labelled.
         cached = _load_tpu_cache() or {}
         if "asr_rtfx" in cached:
-            for k in ("asr_rtfx", "asr_decode_tokens_per_sec", "asr_batch",
-                      "asr_decode_len"):
+            for k in ("asr_rtfx", "asr_windows_per_s",
+                      "asr_decode_tokens_per_sec", "asr_batch",
+                      "asr_decode_len", "asr_model", "asr_window_s"):
                 if k in cached:
                     result[k] = cached[k]
             result["asr_from_cache_measured_at"] = cached.get(
                 "asr_measured_at", cached.get("measured_at"))
+    if "asr_rtfx" not in result:
+        # Still no ASR row (no cache yet, or it predates the leg): run
+        # the sized-down tiny-config leg on CPU so BENCH json tracks the
+        # ASR workload from this PR onward — guaranteed-JSON like every
+        # other leg (a failed child just logs and skips the row).
+        _log(f"measuring tiny-ASR CPU row (timeout {ASR_TINY_TIMEOUT_S}s)")
+        asr, aerr = _try_child(["--asr", "--asr-tiny"], _cpu_env(1),
+                               ASR_TINY_TIMEOUT_S)
+        if asr is not None:
+            result.update(asr)
+        else:
+            _log(f"tiny asr row skipped: {aerr}")
     if "xlmr_base_posts_per_sec" not in result:
         cached = _load_tpu_cache() or {}
         if "xlmr_base_posts_per_sec" in cached:

@@ -35,6 +35,7 @@ from ..datamodel import Post
 from ..datamodel.post import format_time, parse_time
 from ..state.datamodels import new_id, utcnow
 from .messages import (
+    MSG_AUDIO_BATCH,
     MSG_CHAOS_FAULT,
     MSG_DISCOVERED_PAGES,
     MSG_HEARTBEAT,
@@ -42,14 +43,17 @@ from .messages import (
     MSG_POISON_PILL,
     MSG_RESUME,
     MSG_STOP,
+    MSG_TRANSCRIPT,
     MSG_WORK_ITEM,
     MSG_WORK_RESULT,
     MSG_WORKER_STARTED,
     MSG_WORKER_STOPPING,
+    AudioBatchMessage,
     ChaosMessage,
     ControlMessage,
     ResultMessage,
     StatusMessage,
+    TranscriptMessage,
     WorkQueueMessage,
     new_trace_id,
 )
@@ -138,6 +142,8 @@ MESSAGE_REGISTRY: Dict[str, type] = {
     MSG_RESUME: ControlMessage,
     MSG_STOP: ControlMessage,
     MSG_CHAOS_FAULT: ChaosMessage,
+    MSG_AUDIO_BATCH: AudioBatchMessage,
+    MSG_TRANSCRIPT: TranscriptMessage,
 }
 
 

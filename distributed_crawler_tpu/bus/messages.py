@@ -16,7 +16,7 @@ from datetime import datetime
 from typing import Any, Dict, List, Optional
 
 from ..datamodel.post import format_time, parse_time
-from ..state.datamodels import utcnow
+from ..state.datamodels import new_id, utcnow
 
 # --- message types (`messages.go:11-29`) -----------------------------------
 MSG_WORK_ITEM = "work_item"
@@ -35,6 +35,10 @@ MSG_INFERENCE_RESULT = "inference_result"
 # Chaos injection (`loadgen/chaos.py`): a fault the load harness is about
 # to apply (kill/stall/wedge a worker, delay/drop/poison bus traffic).
 MSG_CHAOS_FAULT = "chaos_fault"
+# Media/ASR serving (`media/`): crawled audio refs bound for the batched
+# Whisper worker, and the transcripts it sends back.
+MSG_AUDIO_BATCH = "audio_batch"
+MSG_TRANSCRIPT = "transcript"
 
 # --- status values (`messages.go:32-43`) -----------------------------------
 STATUS_SUCCESS = "success"
@@ -68,6 +72,13 @@ TOPIC_JOBS = "job-commands"
 # every applied fault is published here so distributed targets (and the
 # flight recorder on each) can see cause next to effect.
 TOPIC_CHAOS = "chaos-commands"
+# Media/ASR serving (`media/`): the crawl-side MediaBridge publishes
+# AudioBatchMessages here (pull-enabled on serving brokers, exactly like
+# the inference topic — a dead ASR worker's frames must requeue), and the
+# ASR worker answers with TranscriptMessages on the transcripts topic
+# (fan-out: the re-entry hop and any observer subscribe).
+TOPIC_MEDIA_BATCHES = "tpu-media-batches"
+TOPIC_TRANSCRIPTS = "tpu-transcripts"
 
 VALID_PLATFORMS = ("telegram", "youtube")
 
@@ -92,7 +103,8 @@ def pubsub_topics() -> List[str]:
     """`messages.go:169-176` + TPU topics."""
     return [TOPIC_WORK_QUEUE, TOPIC_RESULTS, TOPIC_WORKER_STATUS,
             TOPIC_ORCHESTRATOR, TOPIC_INFERENCE_BATCHES,
-            TOPIC_INFERENCE_RESULTS, TOPIC_JOBS, TOPIC_CHAOS]
+            TOPIC_INFERENCE_RESULTS, TOPIC_JOBS, TOPIC_CHAOS,
+            TOPIC_MEDIA_BATCHES, TOPIC_TRANSCRIPTS]
 
 
 def _opt_time(value: Any) -> Optional[str]:
@@ -614,6 +626,188 @@ class ChaosMessage:
             at_s=float(d.get("at_s") or 0.0),
             until_s=float(d.get("until_s") or 0.0),
             parameters=dict(d.get("parameters") or {}),
+            timestamp=parse_time(d.get("timestamp")),
+            trace_id=d.get("trace_id", "") or "",
+        )
+
+
+# --- media / ASR serving (`media/`) ----------------------------------------
+
+@dataclass
+class AudioRef:
+    """One crawled media file bound for transcription.
+
+    ``media_id`` is the platform's stable media identifier (Telegram's
+    remote file id) — the dedup key the `ShardedMediaCache` and the
+    loadgen gate's reconciliation both speak.  ``path`` is where the
+    crawl stored the decoded audio (a 16 kHz PCM wav; codec handling is
+    an upstream ffmpeg concern, as in `inference/asr.py`)."""
+
+    media_id: str = ""
+    path: str = ""
+    channel_name: str = ""
+    post_uid: str = ""          # originating post, when known
+    duration_s: float = 0.0     # 0 = unknown (the chunker measures)
+
+    def validate(self) -> None:
+        if not self.media_id:
+            raise ValueError("audio ref media_id cannot be empty")
+        if not self.path:
+            raise ValueError("audio ref path cannot be empty")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"media_id": self.media_id, "path": self.path,
+                "channel_name": self.channel_name,
+                "post_uid": self.post_uid,
+                "duration_s": self.duration_s}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "AudioRef":
+        return cls(
+            media_id=d.get("media_id", "") or "",
+            path=d.get("path", "") or "",
+            channel_name=d.get("channel_name", "") or "",
+            post_uid=d.get("post_uid", "") or "",
+            duration_s=float(d.get("duration_s") or 0.0),
+        )
+
+
+@dataclass
+class AudioBatchMessage:
+    """A batch of audio refs on ``TOPIC_MEDIA_BATCHES`` — the media twin
+    of the inference topic's `RecordBatch`.  Minted with a trace id at
+    birth so the ASR worker's queue-wait/chunk/decode spans correlate to
+    the crawl-side dispatch from the first frame."""
+
+    message_type: str = MSG_AUDIO_BATCH
+    batch_id: str = ""
+    crawl_id: str = ""
+    refs: List[AudioRef] = field(default_factory=list)
+    created_at: Optional[datetime] = None
+    trace_id: str = ""
+
+    @classmethod
+    def new(cls, refs: List[AudioRef], crawl_id: str = "",
+            trace_id: str = "") -> "AudioBatchMessage":
+        return cls(batch_id=new_id(), crawl_id=crawl_id, refs=list(refs),
+                   created_at=utcnow(), trace_id=trace_id or new_trace_id())
+
+    def validate(self) -> None:
+        if self.message_type != MSG_AUDIO_BATCH:
+            raise ValueError(
+                f"invalid audio batch message type: {self.message_type}")
+        if not self.batch_id:
+            raise ValueError("audio batch ID cannot be empty")
+        if not self.refs:
+            raise ValueError("audio batch carries no refs")
+        for ref in self.refs:
+            ref.validate()
+
+    def __len__(self) -> int:
+        return len(self.refs)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "message_type": self.message_type,
+            "batch_id": self.batch_id,
+            "crawl_id": self.crawl_id,
+            "refs": [r.to_dict() for r in self.refs],
+            "created_at": _opt_time(self.created_at),
+            "trace_id": self.trace_id,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "AudioBatchMessage":
+        return cls(
+            message_type=d.get("message_type", MSG_AUDIO_BATCH),
+            batch_id=d.get("batch_id", "") or "",
+            crawl_id=d.get("crawl_id", "") or "",
+            refs=[AudioRef.from_dict(r) for r in (d.get("refs") or [])
+                  if isinstance(r, dict)],
+            created_at=parse_time(d.get("created_at")),
+            trace_id=d.get("trace_id", "") or "",
+        )
+
+
+@dataclass
+class TranscriptMessage:
+    """One media file's transcript on ``TOPIC_TRANSCRIPTS``.
+
+    ``post_uid`` is DETERMINISTIC (``media:<media_id>``) so the re-entry
+    hop through `InferenceBridge` rides the PR-7 dedupe window: an
+    at-least-once redelivery or a re-crawl of the same media cannot
+    double-count downstream.  ``error`` is non-empty for files that
+    failed to decode — failures are explicit rows, never silent gaps.
+    Inherits the audio batch's trace id, so one trace spans crawl →
+    audio → transcript → embedding."""
+
+    message_type: str = MSG_TRANSCRIPT
+    media_id: str = ""
+    post_uid: str = ""
+    path: str = ""
+    channel_name: str = ""
+    crawl_id: str = ""
+    batch_id: str = ""          # the AudioBatchMessage that carried it
+    worker_id: str = ""
+    text: str = ""
+    tokens: List[int] = field(default_factory=list)
+    windows: int = 0            # 30 s windows transcribed
+    duration_s: float = 0.0
+    error: str = ""
+    timestamp: Optional[datetime] = None
+    trace_id: str = ""
+
+    @classmethod
+    def new(cls, media_id: str, crawl_id: str = "", batch_id: str = "",
+            worker_id: str = "", trace_id: str = "",
+            **kw: Any) -> "TranscriptMessage":
+        return cls(media_id=media_id, post_uid=f"media:{media_id}",
+                   crawl_id=crawl_id, batch_id=batch_id,
+                   worker_id=worker_id, timestamp=utcnow(),
+                   trace_id=trace_id or new_trace_id(), **kw)
+
+    def validate(self) -> None:
+        if self.message_type != MSG_TRANSCRIPT:
+            raise ValueError(
+                f"invalid transcript message type: {self.message_type}")
+        if not self.media_id:
+            raise ValueError("transcript media_id cannot be empty")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "message_type": self.message_type,
+            "media_id": self.media_id,
+            "post_uid": self.post_uid,
+            "path": self.path,
+            "channel_name": self.channel_name,
+            "crawl_id": self.crawl_id,
+            "batch_id": self.batch_id,
+            "worker_id": self.worker_id,
+            "text": self.text,
+            "tokens": list(self.tokens),
+            "windows": self.windows,
+            "duration_s": self.duration_s,
+            "error": self.error,
+            "timestamp": _opt_time(self.timestamp),
+            "trace_id": self.trace_id,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TranscriptMessage":
+        return cls(
+            message_type=d.get("message_type", MSG_TRANSCRIPT),
+            media_id=d.get("media_id", "") or "",
+            post_uid=d.get("post_uid", "") or "",
+            path=d.get("path", "") or "",
+            channel_name=d.get("channel_name", "") or "",
+            crawl_id=d.get("crawl_id", "") or "",
+            batch_id=d.get("batch_id", "") or "",
+            worker_id=d.get("worker_id", "") or "",
+            text=d.get("text", "") or "",
+            tokens=[int(t) for t in (d.get("tokens") or [])],
+            windows=int(d.get("windows") or 0),
+            duration_s=float(d.get("duration_s") or 0.0),
+            error=d.get("error", "") or "",
             timestamp=parse_time(d.get("timestamp")),
             trace_id=d.get("trace_id", "") or "",
         )

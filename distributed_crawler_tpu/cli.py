@@ -47,8 +47,8 @@ def build_parser() -> argparse.ArgumentParser:
     a("--log-json", action="store_const", const=True, default=None)
     a("--mode", default=None,
       help="standalone | launch | orchestrator | worker | job | "
-           "job-submit | tpu-worker | train-head | cluster | bus | "
-           "transcribe | dc-gateway | gen-code")
+           "job-submit | tpu-worker | asr-worker | train-head | cluster | "
+           "bus | transcribe | dc-gateway | gen-code")
     a("--worker-id", default=None, help="worker identifier (worker modes)")
     a("--concurrency", type=int, default=None)
     a("--timeout", type=int, default=None, help="HTTP timeout seconds")
@@ -240,7 +240,29 @@ def build_parser() -> argparse.ArgumentParser:
     a("--transcribe-output", default=None,
       help="transcripts JSONL path (default <input>/transcripts.jsonl)")
     a("--asr-batch-size", type=int, default=None,
-      help="waveform batch per device dispatch (default 8)")
+      help="waveform batch per device dispatch (default 8; also the top "
+           "window-count bucket of the ASR worker)")
+    # Media/ASR serving (`media/`): crawl-side bridge + mode=asr-worker.
+    a("--media-bridge", action="store_const", const=True, default=None,
+      help="publish crawled audio refs to the media topic "
+           "(tpu-media-batches) so a mode=asr-worker transcribes them; "
+           "needs --skip-media false")
+    a("--media-batch-size", type=int, default=None,
+      help="audio refs per AudioBatchMessage (default 8)")
+    a("--media-deadline-ms", type=int, default=None,
+      help="flush a partial audio-ref batch after this long (default 250)")
+    a("--asr-window-buckets", default=None,
+      help="comma-separated window-count buckets the ASR worker compiles "
+           "(one Whisper program per bucket; default: powers of two up "
+           "to --asr-batch-size)")
+    a("--asr-max-windows-per-file", type=int, default=None,
+      help="cap on 30 s windows taken from one media file (0 = "
+           "unbounded); keeps an hour-long video from starving queued "
+           "neighbors")
+    a("--slo-asr-batch-p95-ms", type=float, default=None,
+      help="SLO budget on the ASR worker's per-group processing p95 in "
+           "ms (asr_worker.process/coalesce spans; breach -> "
+           "slo_breach_total{slo=asr_batch}; 0 = off)")
     a("--infer-batch-size", type=int, default=None)
     a("--infer-attention", default=None,
       help="attention dispatch: auto (flash past the length threshold on "
@@ -432,6 +454,12 @@ _KEY_MAP = {
     "transcribe_input": "transcribe.input",
     "transcribe_output": "transcribe.output",
     "asr_batch_size": "inference.asr_batch_size",
+    "media_bridge": "media.enabled",
+    "media_batch_size": "media.batch_size",
+    "media_deadline_ms": "media.batch_deadline_ms",
+    "asr_window_buckets": "media.window_buckets",
+    "asr_max_windows_per_file": "media.max_windows_per_file",
+    "slo_asr_batch_p95_ms": "observability.slo_asr_batch_p95_ms",
     "train_posts": "train.posts_file",
     "train_labels": "train.labels_file",
     "train_lora_rank": "train.lora_rank",
@@ -553,6 +581,17 @@ def resolve_config(args: argparse.Namespace,
         "inference.pretrained_dir", cfg.inference.pretrained_dir)
     cfg.inference.asr_pretrained_dir = r.get_str(
         "inference.asr_pretrained_dir", cfg.inference.asr_pretrained_dir)
+    cfg.media.enabled = r.get_bool("media.enabled", False)
+    cfg.media.batch_size = r.get_int("media.batch_size",
+                                     cfg.media.batch_size)
+    cfg.media.batch_deadline_ms = r.get_int("media.batch_deadline_ms",
+                                            cfg.media.batch_deadline_ms)
+    cfg.media.window_buckets = [int(b) for b in
+                                r.get_list("media.window_buckets")]
+    cfg.media.max_windows_per_file = r.get_int(
+        "media.max_windows_per_file", cfg.media.max_windows_per_file)
+    cfg.media.coalesce_batches = r.get_int("media.coalesce_batches",
+                                           cfg.media.coalesce_batches)
 
     # Date windows (`main.go:432-471`): date-between wins over time-ago wins
     # over min-post-date.
@@ -581,8 +620,8 @@ def resolve_config(args: argparse.Namespace,
     # neither do the non-crawling service modes (TPU inference / training /
     # clustering).
     if not cfg.validate_only and r.get_str("distributed.mode", "") not in (
-            "tpu-worker", "train-head", "cluster", "bus", "job-submit",
-            "transcribe", "dc-gateway", "gen-code"):
+            "tpu-worker", "asr-worker", "train-head", "cluster", "bus",
+            "job-submit", "transcribe", "dc-gateway", "gen-code"):
         validate_sampling_method(SamplingValidationInput(
             platform=cfg.platform, sampling_method=cfg.sampling_method,
             url_list=r.get_list("crawler.urls"),
@@ -652,9 +691,10 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
 
     _profiling.configure(dump_dir=dump_dir)
     # Observability servers for every mode (`main.go:60-80` ran pprof
-    # unconditionally) — EXCEPT tpu-worker, where TPUWorker.start() owns
-    # both (binding here too would EADDRINUSE its startup).
-    if mode != "tpu-worker":
+    # unconditionally) — EXCEPT the serving workers (tpu-worker /
+    # asr-worker), where the worker's own start() owns the metrics port
+    # (binding here too would EADDRINUSE its startup).
+    if mode not in ("tpu-worker", "asr-worker"):
         metrics_port = r.get_int("observability.metrics_port", 0)
         if metrics_port:
             from .utils.metrics import serve_metrics
@@ -707,6 +747,8 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
             return _run_job_submit(r)
         elif mode == "tpu-worker":
             _run_tpu_worker(cfg, r)
+        elif mode == "asr-worker":
+            _run_asr_worker(cfg, r)
         elif mode == "bus":
             # Dedicated broker process — the in-tree analog of the
             # reference's always-on Dapr sidecar (`daprstate.go:119-133`).
@@ -761,30 +803,42 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
 
 def _maybe_bridge(sm, cfg: CrawlerConfig, r: ConfigResolver):
     """--infer wraps the state manager with the crawl->TPU InferenceBridge
-    so stored posts ship to `tpu-inference-batches`; returns (sm, closer).
-    The bridge publishes over the gRPC bus when --bus-address is set (a
-    separate tpu-worker process consumes), else in-process."""
-    if not cfg.inference.enabled:
+    so stored posts ship to `tpu-inference-batches`, and --media-bridge
+    additionally wraps it with the crawl->ASR MediaBridge so stored audio
+    refs ship to `tpu-media-batches`; returns (sm, closer).  The bridges
+    publish over the gRPC bus when --bus-address is set (separate
+    tpu-worker / asr-worker processes consume), else in-process."""
+    if not (cfg.inference.enabled or cfg.media.enabled):
         # The closer owns the final sm.close() either way: modes receiving a
         # prebuilt sm never close it themselves (owns_sm=False), so without
         # this the completed-status metadata written after the last layer
         # would never be flushed to disk.
         return sm, sm.close
-    from .inference.bridge import InferenceBridge
     bus = _make_bus(r)
-    bridge = InferenceBridge(sm, bus, crawl_id=cfg.crawl_id,
-                             batch_size=cfg.inference.batch_size,
-                             deadline_s=cfg.inference.batch_deadline_ms
-                             / 1000.0)
+    wrapped = sm
+    if cfg.inference.enabled:
+        from .inference.bridge import InferenceBridge
+        wrapped = InferenceBridge(wrapped, bus, crawl_id=cfg.crawl_id,
+                                  batch_size=cfg.inference.batch_size,
+                                  deadline_s=cfg.inference.batch_deadline_ms
+                                  / 1000.0)
+    if cfg.media.enabled:
+        # Outermost: the media hook (`notify_media_stored`) lands here,
+        # store_post falls through to the InferenceBridge underneath.
+        from .media.bridge import MediaBridge
+        wrapped = MediaBridge(wrapped, bus, crawl_id=cfg.crawl_id,
+                              batch_size=cfg.media.batch_size,
+                              deadline_s=cfg.media.batch_deadline_ms
+                              / 1000.0)
 
     def closer():
-        bridge.close()
+        wrapped.close()  # each bridge flushes, then closes its inner
         try:
             bus.close()
-        except Exception:
-            pass
+        except Exception as e:
+            logger.warning("bridge bus close failed: %s", e)
 
-    return bridge, closer
+    return wrapped, closer
 
 
 def _heartbeat_interval(r: "ConfigResolver") -> float:
@@ -955,16 +1009,18 @@ def _make_bus(r: ConfigResolver, serve: bool = False):
         from .bus.messages import (
             TOPIC_INFERENCE_BATCHES,
             TOPIC_JOBS,
+            TOPIC_MEDIA_BATCHES,
             TOPIC_WORK_QUEUE,
         )
         server = GrpcBusServer(address)
         # Pre-enable the pull (competing-consumer) topics so frames
         # published before the first consumer connects are queued, not
-        # dropped.  Fan-out topics (results/status/commands) stay local-
-        # dispatch only — pull-enabling them on a broker nobody drains
-        # would accumulate frames without bound.
+        # dropped.  Fan-out topics (results/status/commands/transcripts)
+        # stay local-dispatch only — pull-enabling them on a broker
+        # nobody drains would accumulate frames without bound.
         server.enable_pull(TOPIC_WORK_QUEUE)
         server.enable_pull(TOPIC_INFERENCE_BATCHES)
+        server.enable_pull(TOPIC_MEDIA_BATCHES)
         server.enable_pull(TOPIC_JOBS)
         server.start()
         return server
@@ -1354,12 +1410,16 @@ def _run_transcribe(cfg: CrawlerConfig, r: ConfigResolver) -> int:
 
     Scans ``--transcribe-input`` recursively for 16 kHz PCM ``.wav`` files
     (a crawl's ``media/`` tree; other containers belong to an upstream
-    ffmpeg step), batch-transcribes them on the device, and writes one
-    JSONL row per file: ``{"path", "tokens", "text"}`` (text only when
-    the checkpoint dir ships tokenizer assets).  With ``--bus-address``
-    and ``--infer``, transcripts also publish to the inference topic as a
-    RecordBatch so they flow through embed+classify — media → text →
-    embedding end to end."""
+    ffmpeg step), windows + buckets them through the SAME
+    `media/chunker.py` featurize path the serving ASR worker uses (long
+    files are transcribed across every 30 s window and reassembled, not
+    truncated), and writes one JSONL row per file:
+    ``{"path", "tokens", "text", "windows", "error"}`` (text only when
+    the checkpoint dir ships tokenizer assets; ``error`` non-empty for
+    decode failures).  With ``--bus-address`` and ``--infer``,
+    transcripts also publish to the inference topic as a RecordBatch so
+    they flow through embed+classify — media → text → embedding end to
+    end."""
     import json as _json
 
     src = r.get_str("transcribe.input")
@@ -1384,7 +1444,11 @@ def _run_transcribe(cfg: CrawlerConfig, r: ConfigResolver) -> int:
     from .inference.asr import ASRPipeline
 
     pipeline = ASRPipeline.from_pretrained(
-        asr_dir, batch_size=r.get_int("inference.asr_batch_size", 8))
+        asr_dir, batch_size=r.get_int("inference.asr_batch_size", 8),
+        window_buckets=cfg.media.window_buckets or None)
+    if cfg.media.max_windows_per_file:
+        pipeline.chunker.max_windows_per_file = \
+            cfg.media.max_windows_per_file
     results = pipeline.transcribe_files(paths)
 
     out_path = r.get_str("transcribe.output") or os.path.join(
@@ -1392,12 +1456,14 @@ def _run_transcribe(cfg: CrawlerConfig, r: ConfigResolver) -> int:
     failed = 0
     with open(out_path, "w", encoding="utf-8") as f:
         for res in results:
-            if not res.tokens and not res.text:
+            if res.error:
                 failed += 1
             f.write(_json.dumps({
                 "path": os.path.relpath(res.path, base),
                 "tokens": res.tokens,
                 "text": res.text,
+                "windows": res.windows,
+                "error": res.error,
             }, ensure_ascii=False) + "\n")
 
     if cfg.inference.enabled and r.get_str("distributed.bus_address"):
@@ -1411,7 +1477,7 @@ def _run_transcribe(cfg: CrawlerConfig, r: ConfigResolver) -> int:
 
         posts = []
         for res in results:
-            if not (res.tokens or res.text):
+            if res.error or not (res.tokens or res.text):
                 continue
             rel = os.path.relpath(res.path, base)
             posts.append(Post(
@@ -1624,6 +1690,95 @@ def _build_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver):
                              "observability.slo_batch_age_ms", 0.0),
                          profile_on_slow_ms=r.get_float(
                              "observability.profile_on_slow_ms", 0.0)))
+
+
+def _build_asr_worker(cfg: CrawlerConfig, r: ConfigResolver):
+    """Construct the ASR worker (Whisper pipeline + transcript sink +
+    config) — split from the serve loop so the wiring is testable.
+    Returns (worker, reentry_closer)."""
+    from .inference.asr import ASRPipeline
+    from .media.worker import ASRWorker, ASRWorkerConfig
+    from .state.providers import LocalStorageProvider
+
+    serve = r.get_bool("distributed.bus_serve", False)
+    if serve and not r.get_str("distributed.bus_address"):
+        raise CliConfigError("--bus-serve requires --bus-address")
+    asr_dir = cfg.inference.asr_pretrained_dir
+    if not asr_dir:
+        raise CliConfigError("asr-worker mode requires --asr-pretrained-dir")
+    # Pipeline and sink before the bus: a bad checkpoint dir must fail
+    # before any port is bound (the _build_tpu_worker discipline).
+    pipeline = ASRPipeline.from_pretrained(
+        asr_dir, batch_size=r.get_int("inference.asr_batch_size", 8),
+        window_buckets=cfg.media.window_buckets or None)
+    if cfg.media.max_windows_per_file:
+        pipeline.chunker.max_windows_per_file = \
+            cfg.media.max_windows_per_file
+    if cfg.object_store_url:
+        from .state.objectstore import (
+            ObjectStorageProvider,
+            make_object_client,
+        )
+
+        provider = ObjectStorageProvider(
+            make_object_client(cfg.object_store_url))
+    else:
+        provider = LocalStorageProvider(cfg.storage_root)
+    bus = _make_serving_bus(r) if serve else _make_bus(r)
+    worker = ASRWorker(bus, pipeline, provider=provider,
+                       cfg=ASRWorkerConfig(
+                           worker_id=r.get_str("distributed.worker_id")
+                           or "asr-worker-0",
+                           heartbeat_s=_heartbeat_interval(r),
+                           metrics_port=r.get_int(
+                               "observability.metrics_port", 0),
+                           coalesce_batches=cfg.media.coalesce_batches,
+                           slo_asr_batch_p95_ms=r.get_float(
+                               "observability.slo_asr_batch_p95_ms", 0.0),
+                           slo_queue_wait_ms=r.get_float(
+                               "observability.slo_queue_wait_ms", 0.0),
+                           slo_batch_age_ms=r.get_float(
+                               "observability.slo_batch_age_ms", 0.0)))
+    reentry_closer = None
+    if cfg.inference.enabled:
+        # Close the loop in-process: transcripts re-enter the text
+        # pipeline as synthetic posts through an InferenceBridge over
+        # the crawl's own state sink (post_uid = media:<id> keeps the
+        # dedupe window effective across re-crawls).
+        from .inference.bridge import InferenceBridge
+        from .media.bridge import TranscriptReentry
+        from .modes.common import create_state_manager
+
+        bridge = InferenceBridge(
+            create_state_manager(cfg, cfg.crawl_id), worker.bus,
+            crawl_id=cfg.crawl_id,
+            batch_size=cfg.inference.batch_size,
+            deadline_s=cfg.inference.batch_deadline_ms / 1000.0)
+        TranscriptReentry(bridge, worker.bus)
+        reentry_closer = bridge.close
+    return worker, reentry_closer
+
+
+def _run_asr_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
+    """mode=asr-worker: the media/ASR serving worker (BASELINE config #4
+    live) — AudioBatchMessages in, transcripts out, optional re-entry
+    into the text inference pipeline with --infer."""
+    worker, reentry_closer = _build_asr_worker(cfg, r)
+    worker.warmup()  # compile every window-bucket program before serving
+    worker.start()
+    try:
+        _serve_forever()
+    finally:
+        worker.stop()
+        if reentry_closer is not None:
+            try:
+                reentry_closer()
+            except Exception as e:
+                logger.warning("reentry bridge close failed: %s", e)
+        try:
+            worker.bus.close()
+        except Exception as e:
+            logger.warning("bus close failed: %s", e)
 
 
 def _run_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:

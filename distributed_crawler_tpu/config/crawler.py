@@ -79,6 +79,29 @@ class InferenceConfig:
 
 
 @dataclass
+class MediaConfig:
+    """Media/ASR serving settings (`media/`): the crawl-side MediaBridge
+    and the `mode=asr-worker` service (BASELINE config #4 end to end)."""
+
+    # Wrap the crawl's state manager with a MediaBridge so stored audio
+    # refs ship to TOPIC_MEDIA_BATCHES (requires media NOT skipped:
+    # --skip-media false).
+    enabled: bool = False
+    batch_size: int = 8          # audio refs per AudioBatchMessage
+    batch_deadline_ms: int = 250  # flush a partial ref batch after this
+    # Window-count buckets the ASR worker compiles (one Whisper program
+    # per bucket — `media/chunker.py`); empty = powers of two up to
+    # inference.asr_batch_size.
+    window_buckets: List[int] = field(default_factory=list)
+    # Cap on 30 s windows taken from one file (0 = unbounded); an
+    # hour-long video is 120 windows — a cap keeps one file from
+    # starving every queued neighbor.
+    max_windows_per_file: int = 0
+    # Audio batches coalesced per ASR device group (`ASRWorkerConfig`).
+    coalesce_batches: int = 2
+
+
+@dataclass
 class CrawlerConfig:
     """Main crawl configuration (`common/utils.go:49-99`)."""
 
@@ -174,6 +197,8 @@ class CrawlerConfig:
 
     # TPU inference stage (new)
     inference: InferenceConfig = field(default_factory=InferenceConfig)
+    # Media/ASR serving stage (`media/`)
+    media: MediaConfig = field(default_factory=MediaConfig)
 
 
 def generate_crawl_id(now: Optional[datetime] = None) -> str:

@@ -5,9 +5,23 @@ The reference crawls Telegram voice/video media to local files
 Whisper family.  Host side: WAV decode (PCM16, stdlib `wave`; non-16 kHz
 rates are box-filtered + linearly resampled in-process — see
 `read_wav_mono_16k` — while codec handling, OGG/Opus/video, stays an
-upstream ffmpeg concern), fixed 30 s windows; device side: one jitted
-`transcribe_features` call per batch, padded to a static batch size so
-there is exactly one compiled program.
+upstream ffmpeg concern), then `media/chunker.py` slices every file into
+fixed 30 s windows and buckets them by window count; device side: one
+jitted `transcribe_features` program PER WINDOW-COUNT BUCKET (jit
+re-traces per batch shape, so the bucket set IS the program set — the
+PR-1 bucketing discipline on the batch axis).  Long files are windowed,
+transcribed window by window, and reassembled in order — never truncated
+to the first 30 s.
+
+Both the offline `mode=transcribe` path and the serving `ASRWorker`
+(`media/worker.py`) run through :meth:`ASRPipeline.transcribe_plan`, so
+batch and offline share ONE featurize path.
+
+Cost/efficiency accounting mirrors `inference/engine.py`: each bucket
+program's compiled cost is captured at first dispatch
+(`utils/costmodel.CostModel`, analytic `whisper_forward_flops` fallback)
+and every dispatch feeds the rolling MFU/goodput meter, so `/costs`
+shows honest Whisper rows next to the text programs.
 
 Transcripts come back as token-id arrays; `detokenize` is a pluggable hook
 (a sentencepiece/BPE vocab is deployment data, not framework code — wire the
@@ -17,11 +31,17 @@ real Whisper vocab in production, identity-join in tests).
 from __future__ import annotations
 
 import logging
+import threading
+import time
 import wave
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
+
+from ..utils import trace
+from ..utils.costmodel import CostModel, EfficiencyMeter, whisper_forward_flops
+from ..utils.metrics import REGISTRY, MetricsRegistry
 
 logger = logging.getLogger("dct.inference.asr")
 
@@ -68,15 +88,32 @@ class ASRResult:
     path: str
     tokens: List[int] = field(default_factory=list)
     text: str = ""
+    windows: int = 0     # 30 s windows transcribed (0 on failure)
+    error: str = ""      # non-empty = the file failed to decode
+
+
+def default_window_buckets(batch_size: int) -> tuple:
+    """Powers of two up to ``batch_size`` (plus batch_size itself): the
+    window-count buckets one ASR deployment compiles."""
+    out = []
+    b = 1
+    while b < batch_size:
+        out.append(b)
+        b *= 2
+    out.append(max(1, int(batch_size)))
+    return tuple(sorted(set(out)))
 
 
 class ASRPipeline:
-    """Batch transcriber over a Whisper model."""
+    """Bucketed batch transcriber over a Whisper model."""
 
     @classmethod
     def from_pretrained(cls, path: str, batch_size: int = 8,
                         max_len: Optional[int] = None,
-                        dtype: str = "bfloat16") -> "ASRPipeline":
+                        dtype: str = "bfloat16",
+                        window_buckets: Optional[Sequence[int]] = None,
+                        registry: MetricsRegistry = REGISTRY
+                        ) -> "ASRPipeline":
         """Build from a local HF Whisper checkpoint dir: real weights via
         `models.hf_convert.load_hf_whisper`, real vocab via tokenizer.json
         when present (detokenize wired automatically)."""
@@ -99,23 +136,54 @@ class ASRPipeline:
             logger.info("no tokenizer assets in %s; token-id output only",
                         path)
         return cls(Whisper(cfg), params, batch_size=batch_size,
-                   max_len=max_len, detokenize=detok)
+                   max_len=max_len, detokenize=detok,
+                   window_buckets=window_buckets, registry=registry)
 
     def __init__(self, model, params, batch_size: int = 8,
                  max_len: Optional[int] = None,
-                 detokenize: Optional[Callable[[Sequence[int]], str]] = None):
+                 detokenize: Optional[Callable[[Sequence[int]], str]] = None,
+                 window_buckets: Optional[Sequence[int]] = None,
+                 registry: MetricsRegistry = REGISTRY):
         import jax
 
-        from ..models.whisper import transcribe_features
+        from ..media.chunker import AudioChunker
+        from ..models.whisper import (
+            SAMPLE_RATE,
+            audio_window_samples,
+            transcribe_features,
+        )
 
         self.model = model
         self.params = params
         self.batch_size = batch_size
         self.max_len = max_len or model.cfg.n_text_ctx
         self.detokenize = detokenize
+        self.sample_rate = SAMPLE_RATE
+        self.window_samples = audio_window_samples(model.cfg)
+        self.window_buckets = tuple(window_buckets) if window_buckets \
+            else default_window_buckets(batch_size)
+        self.chunker = AudioChunker(self.window_samples,
+                                    buckets=self.window_buckets)
+        # jit re-traces per input shape, so each window-count bucket gets
+        # its own compiled program through this ONE jitted callable.
         self._transcribe = jax.jit(
             lambda p, audio: transcribe_features(model, p, audio,
                                                  max_len=self.max_len))
+        # Cost/efficiency accounting (shared metric families with the
+        # text engine; ASR rows are distinguished by path="asr" labels).
+        self.costs = CostModel(registry=registry)
+        self.meter = EfficiencyMeter(registry=registry)
+        self.m_windows = registry.counter(
+            "asr_windows_total", "30 s audio windows through Whisper")
+        self.m_pad_windows = registry.counter(
+            "asr_pad_window_slots_total",
+            "wasted window slots (bucket padding)")
+        self.m_compile_miss = registry.counter(
+            "tpu_engine_compile_cache_misses_total",
+            "jit program builds by bucket and path (first-dispatch "
+            "compiles)")
+        self._lock = threading.Lock()
+        self._seen_buckets: set = set()
 
     def strip_special(self, tokens: Sequence[int]) -> List[int]:
         cfg = self.model.cfg
@@ -123,38 +191,127 @@ class ASRPipeline:
                    cfg.transcribe_token}
         return [int(t) for t in tokens if int(t) not in special]
 
-    def transcribe_audio(self, audio_batch: np.ndarray) -> np.ndarray:
-        """waveforms [B, T] -> token ids [B, L] (single device dispatch)."""
+    # -- device dispatch -----------------------------------------------------
+    def transcribe_audio(self, audio_batch: np.ndarray,
+                         real_windows: Optional[int] = None,
+                         record: bool = True) -> np.ndarray:
+        """waveforms [B, T] -> token ids [B, L] (single device dispatch).
+
+        ``B`` should be one of ``window_buckets`` (each distinct B is a
+        compiled program).  ``real_windows`` (default B) drives the
+        efficiency meter's real-vs-slot accounting; ``record=False``
+        (warmup) captures program cost but keeps the compile-dominated
+        dispatch OUT of the MFU/goodput window and padding counters.
+        """
         import jax.numpy as jnp
-        return np.asarray(self._transcribe(self.params,
-                                           jnp.asarray(audio_batch)))
 
+        bucket = int(audio_batch.shape[0])
+        real = bucket if real_windows is None else int(real_windows)
+        with self._lock:
+            first = bucket not in self._seen_buckets
+            self._seen_buckets.add(bucket)
+        if first:
+            self.m_compile_miss.labels(bucket=str(bucket),
+                                       path="asr").inc()
+        placed = jnp.asarray(audio_batch)
+        t0 = time.perf_counter()
+        with trace.span("asr.transcribe", bucket=bucket, windows=real):
+            tokens = np.asarray(self._transcribe(self.params, placed))
+        dt = time.perf_counter() - t0
+        self._account(bucket, placed, dt, real, record)
+        return tokens
+
+    def _account(self, bucket: int, placed, dt: float, real: int,
+                 record: bool) -> None:
+        """Cost capture (first dispatch per bucket) + meter feed; never
+        raises into the transcription path (`CostModel` contract)."""
+        cfg = self.model.cfg
+        analytic = whisper_forward_flops(cfg, bucket, self.max_len)
+        if not self.costs.has(bucket, "asr"):
+            self.costs.capture(
+                bucket, "asr",
+                lambda: self._transcribe.lower(self.params, placed),
+                analytic, batch=bucket, seq=cfg.n_audio_ctx)
+        if not record:
+            return  # warmup: cost captured, no phantom efficiency samples
+        # Goodput unit: encoder positions (the audio-side "tokens") —
+        # real windows vs dispatched slot windows.
+        self.meter.record(dt, self.costs.flops_for(bucket, "asr", analytic),
+                          real * cfg.n_audio_ctx,
+                          bucket * cfg.n_audio_ctx)
+        self.m_windows.inc(real)
+        self.m_pad_windows.inc(bucket - real)
+
+    def transcribe_plan(self, plan) -> List[List[int]]:
+        """A `media.chunker.ChunkPlan` -> special-stripped token lists,
+        one per plan window (the ONE featurize path batch and offline
+        share).  Dispatches one bucketed program per `WindowBatch`."""
+        per_window: List[List[int]] = [[] for _ in range(plan.n_windows)]
+        for wb in self.chunker.batches(plan):
+            tokens = self.transcribe_audio(wb.audio,
+                                           real_windows=wb.real_windows)
+            for row, w in enumerate(wb.window_indices):
+                per_window[w] = self.strip_special(tokens[row])
+        return per_window
+
+    # -- file front door -----------------------------------------------------
     def transcribe_files(self, paths: Sequence[str]) -> List[ASRResult]:
-        """Pad the final partial batch to the static batch size so every
-        dispatch reuses one compiled program."""
-        from ..models.whisper import audio_window_samples
-
-        window = audio_window_samples(self.model.cfg)
+        """Decode, window, transcribe, reassemble — results in INPUT
+        order, failures explicit (``error`` set, empty tokens).  Long
+        files are windowed across as many 30 s windows as they span and
+        reassembled, never truncated to the first window."""
+        plan = self.chunker.chunk_files(paths)
+        per_window = self.transcribe_plan(plan)
+        per_file = self.chunker.reassemble(plan, per_window)
+        counts = plan.windows_per_file()
         results: List[ASRResult] = []
-        for start in range(0, len(paths), self.batch_size):
-            chunk = list(paths[start:start + self.batch_size])
-            audios = []
-            kept = []
-            for p in chunk:
-                try:
-                    audios.append(read_wav_mono_16k(p))
-                    kept.append(p)
-                except Exception as e:
-                    logger.error("failed to read %s: %s", p, e)
-                    results.append(ASRResult(path=p, tokens=[], text=""))
-            if not kept:
+        for i, p in enumerate(paths):
+            if i in plan.errors:
+                results.append(ASRResult(path=p, error=plan.errors[i]))
                 continue
-            batch = np.zeros((self.batch_size, window), np.float32)
-            for i, a in enumerate(audios):
-                batch[i, :min(len(a), window)] = a[:window]
-            tokens = self.transcribe_audio(batch)
-            for i, p in enumerate(kept):
-                toks = self.strip_special(tokens[i])
-                text = self.detokenize(toks) if self.detokenize else ""
-                results.append(ASRResult(path=p, tokens=toks, text=text))
+            toks = per_file[i]
+            text = self.detokenize(toks) if self.detokenize else ""
+            results.append(ASRResult(path=p, tokens=toks, text=text,
+                                     windows=counts[i]))
         return results
+
+    # -- serving support (`media/worker.py`) ---------------------------------
+    def warmup(self, buckets: Optional[Sequence[int]] = None) -> None:
+        """Pre-compile every window-count bucket's program before serving
+        (first decode of the largest bucket is the longest on-chip
+        window; live batches must not pay it)."""
+        for b in buckets or self.window_buckets:
+            audio = np.zeros((int(b), self.window_samples), np.float32)
+            self.transcribe_audio(audio, real_windows=0, record=False)
+
+    def compile_cache_stats(self) -> Dict[str, Any]:
+        """Telemetry-heartbeat shape shared with
+        `InferenceEngine.compile_cache_stats` (the emitter computes
+        per-beat miss deltas from ``misses_total``)."""
+        misses: Dict[str, float] = {}
+        total = 0.0
+        for labels, value in self.m_compile_miss.series():
+            if not labels or labels.get("path") != "asr":
+                continue
+            misses[f"asr:{labels.get('bucket', '?')}"] = value
+            total += value
+        with self._lock:
+            programs = sorted(self._seen_buckets)
+        return {"programs_asr": programs, "misses_total": total,
+                "misses": misses}
+
+    def efficiency_snapshot(self) -> Dict[str, Any]:
+        return self.meter.snapshot()
+
+    def cost_snapshot(self) -> Dict[str, Any]:
+        """The ASR worker's /costs body core: Whisper program rows +
+        the rolling efficiency window."""
+        return {
+            "model": "whisper",
+            "batch_size": self.batch_size,
+            "window_buckets": list(self.window_buckets),
+            "window_samples": self.window_samples,
+            "decode_len": self.max_len,
+            "costs": self.costs.snapshot(),
+            "efficiency": self.meter.snapshot(),
+        }

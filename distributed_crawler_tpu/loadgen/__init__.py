@@ -27,14 +27,23 @@ in docs/operations.md "Load testing & chaos".
 
 from .chaos import ChaosBus, ChaosController, Fault, parse_timeline
 from .generator import (
+    AudioLoadConfig,
+    AudioWorkload,
     LoadGenConfig,
     ReplayWorkload,
     SyntheticWorkload,
     workload_from_bundle,
 )
-from .gate import load_scenario, run_scenario, scenario_names
+from .gate import (
+    load_scenario,
+    run_asr_scenario,
+    run_scenario,
+    scenario_names,
+)
 
 __all__ = [
+    "AudioLoadConfig",
+    "AudioWorkload",
     "LoadGenConfig",
     "SyntheticWorkload",
     "ReplayWorkload",
@@ -45,5 +54,6 @@ __all__ = [
     "ChaosBus",
     "load_scenario",
     "run_scenario",
+    "run_asr_scenario",
     "scenario_names",
 ]

@@ -42,7 +42,12 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
-from ..bus.messages import TOPIC_CHAOS, TOPIC_INFERENCE_BATCHES, ChaosMessage
+from ..bus.messages import (
+    TOPIC_CHAOS,
+    TOPIC_INFERENCE_BATCHES,
+    TOPIC_MEDIA_BATCHES,
+    ChaosMessage,
+)
 from ..utils import flight
 
 logger = logging.getLogger("dct.loadgen.chaos")
@@ -143,16 +148,18 @@ def parse_timeline(lines: List[str]) -> List[Fault]:
 class ChaosBus:
     """Publish-side wrapper over any bus transport.
 
-    Faults apply only to record-batch traffic on ``chaos_topics``
-    (default: the inference topic) — heartbeats, results, and control
-    messages pass through untouched, the way a degraded DCN link hurts
-    the fat record stream first.  Every record batch that goes through
-    (or is dropped/poisoned) lands in the ledger, which is what the gate
+    Faults apply only to record/audio-batch traffic on ``chaos_topics``
+    (default: the inference + media topics) — heartbeats, results, and
+    control messages pass through untouched, the way a degraded DCN link
+    hurts the fat record stream first.  Every batch that goes through
+    (or is dropped/poisoned) lands in the ledger — post_uids for text
+    record batches, media_ids for audio batches — which is what the gate
     reconciles against the writeback sink: published - dropped -
     poisoned must equal written, exactly.
     """
 
-    def __init__(self, inner, chaos_topics=(TOPIC_INFERENCE_BATCHES,)):
+    def __init__(self, inner, chaos_topics=(TOPIC_INFERENCE_BATCHES,
+                                            TOPIC_MEDIA_BATCHES)):
         self._inner = inner
         self._topics = set(chaos_topics)
         self._lock = threading.Lock()
@@ -187,13 +194,20 @@ class ChaosBus:
 
     # -- transport ----------------------------------------------------------
     def publish(self, topic: str, payload: Any) -> None:
-        if topic not in self._topics or not isinstance(payload, dict) \
-                or "records" not in payload:
+        is_text = isinstance(payload, dict) and "records" in payload
+        is_audio = isinstance(payload, dict) and "refs" in payload
+        if topic not in self._topics or not (is_text or is_audio):
             self._inner.publish(topic, payload)
             return
         batch_id = payload.get("batch_id", "")
-        uids = [r.get("post_uid", "") for r in payload.get("records", [])
-                if isinstance(r, dict)]
+        if is_text:
+            uids = [r.get("post_uid", "")
+                    for r in payload.get("records", [])
+                    if isinstance(r, dict)]
+        else:
+            uids = [r.get("media_id", "")
+                    for r in payload.get("refs", [])
+                    if isinstance(r, dict)]
         with self._lock:
             self.published[batch_id] = uids
             delay_s = self._delay_s
@@ -212,10 +226,12 @@ class ChaosBus:
                           records=len(uids))
             return
         if poison:
-            # Records that decode as RecordBatch but break the per-batch
-            # tokenize front door (Post.from_dict on a non-dict) — the
+            # Records/refs that decode as the right envelope but break
+            # the per-batch front door (Post.from_dict on a non-dict;
+            # an audio ref list whose entries are not dicts) — the
             # poisoned-batch isolation path must absorb it.
-            payload = {**payload, "records": [None] * len(uids)}
+            key = "records" if is_text else "refs"
+            payload = {**payload, key: [None] * len(uids)}
             flight.record("chaos_effect", action="poison", batch=batch_id,
                           records=len(uids))
         if delay_s > 0:
@@ -264,6 +280,47 @@ class ChaosEngine:
 
     def warmup(self, buckets=None, pack: bool = False):
         return self._inner.warmup(buckets=buckets, pack=pack)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class ChaosASRPipeline:
+    """`ChaosEngine`'s ASR twin: an `inference.asr.ASRPipeline` proxy
+    whose device calls can be blocked for a window, so `stall`/`wedge`
+    timeline lines work against an ASR worker too.  The block happens
+    inside ``transcribe_plan``/``transcribe_audio`` — mid-step from the
+    `ASRWorker`'s perspective."""
+
+    def __init__(self, inner, clock: Callable[[], float] = time.monotonic):
+        self._inner = inner
+        self._clock = clock
+        self._blocked_until = 0.0
+        self._lock = threading.Lock()
+
+    def block_for(self, seconds: float) -> None:
+        with self._lock:
+            self._blocked_until = max(self._blocked_until,
+                                      self._clock() + seconds)
+
+    def _maybe_block(self) -> None:
+        while True:
+            with self._lock:
+                remaining = self._blocked_until - self._clock()
+            if remaining <= 0:
+                return
+            time.sleep(min(0.02, remaining))
+
+    def transcribe_plan(self, plan):
+        self._maybe_block()
+        return self._inner.transcribe_plan(plan)
+
+    def transcribe_audio(self, audio_batch, real_windows=None,
+                         record=True):
+        self._maybe_block()
+        return self._inner.transcribe_audio(audio_batch,
+                                            real_windows=real_windows,
+                                            record=record)
 
     def __getattr__(self, name):
         return getattr(self._inner, name)

@@ -38,11 +38,24 @@ import time
 import urllib.request
 from typing import Any, Dict, List, Optional
 
-from ..bus.messages import TOPIC_INFERENCE_BATCHES
+from ..bus.messages import TOPIC_INFERENCE_BATCHES, TOPIC_MEDIA_BATCHES
 from ..utils import flight, trace
-from ..utils.slo import BATCH_AGE_SPANS, BATCH_SPANS, QUEUE_WAIT_SPANS
-from .chaos import ChaosBus, ChaosController, ChaosEngine, parse_timeline
+from ..utils.slo import (
+    ASR_BATCH_SPANS,
+    BATCH_AGE_SPANS,
+    BATCH_SPANS,
+    QUEUE_WAIT_SPANS,
+)
+from .chaos import (
+    ChaosASRPipeline,
+    ChaosBus,
+    ChaosController,
+    ChaosEngine,
+    parse_timeline,
+)
 from .generator import (
+    AudioLoadConfig,
+    AudioWorkload,
     LoadGenConfig,
     PlannedBatch,
     PlannedRecord,
@@ -213,60 +226,104 @@ class OrchestratorHandle:
             o.stop()
 
 
-class WorkerHandle:
-    """The chaos controller's view of the TPU worker: kill / restart /
-    stall, with the current live instance behind one name.  Each start
-    gets a FRESH bus connection (gRPC: its own pull stream, so kill's
-    stream teardown requeues un-acked frames server-side, exactly like a
-    crashed process)."""
+class _ServingWorkerHandle:
+    """The chaos controller's view of a serving worker (TPU text or
+    ASR): kill / restart / stall, with the current live instance behind
+    one name.  Each start gets a FRESH bus connection (gRPC: its own
+    pull stream, so kill's stream teardown requeues un-acked frames
+    server-side, exactly like a crashed process).
 
-    def __init__(self, name: str, make_bus, engine: ChaosEngine,
-                 provider, worker_cfg_kw: Dict[str, Any], registry):
-        from ..inference.worker import TPUWorkerConfig
+    ``kill`` is idempotent per generation, and ``restart`` retires the
+    live generation FIRST (the OrchestratorHandle discipline): a bare
+    `restart <worker>` timeline line must not leave two generations
+    competing for frames.  The killed generation stays referenced until
+    the next start so post-kill reads (drain, status) still resolve.
+    """
 
+    def __init__(self, name: str, make_bus, provider,
+                 registry):
         self.name = name
         self._make_bus = make_bus
-        self._engine = engine
         self._provider = provider
         self._registry = registry
-        self._cfg = TPUWorkerConfig(worker_id=name, **worker_cfg_kw)
         self.worker = None
         self.bus = None
         self.generation = 0
+        self._dead = True  # no live generation until start()
+
+    def _make_worker(self, bus):
+        raise NotImplementedError
 
     def start(self) -> None:
-        from ..inference.worker import TPUWorker
-
         self.bus = self._make_bus()
-        self.worker = TPUWorker(self.bus, self._engine,
-                                provider=self._provider, cfg=self._cfg,
-                                registry=self._registry)
+        self.worker = self._make_worker(self.bus)
         self.worker.start()
         self.generation += 1
+        self._dead = False
 
     def kill(self) -> None:
-        if self.worker is None:
+        if self.worker is None or self._dead:
             return
+        self._dead = True
         self.worker.kill()
         close = getattr(self.bus, "close", None)
         if callable(close):
             close()  # gRPC: tear the pull stream; un-acked frames requeue
 
     def restart(self) -> None:
+        self.kill()  # no-op if a kill already ran this generation
         self.start()
 
     def stall(self, seconds: float) -> None:
-        self._engine.block_for(seconds)
+        raise NotImplementedError
 
     def stop(self) -> None:
+        # Unconditional, even for a killed generation: kill() leaves the
+        # process-global status/costs providers registered on purpose
+        # (a dead process's endpoints vanish, they don't deregister),
+        # but the gate's teardown must not leak them into the next run
+        # in this process — worker.stop() clears them.
         if self.worker is not None:
             self.worker.stop(timeout_s=5.0)
         close = getattr(self.bus, "close", None)
         if callable(close):
             try:
                 close()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.warning("handle bus close error: %s", e)
+
+
+class WorkerHandle(_ServingWorkerHandle):
+    """`_ServingWorkerHandle` over the text `TPUWorker`; stall blocks
+    the `ChaosEngine`'s device calls mid-step."""
+
+    def __init__(self, name: str, make_bus, engine: ChaosEngine,
+                 provider, worker_cfg_kw: Dict[str, Any], registry):
+        from ..inference.worker import TPUWorkerConfig
+
+        super().__init__(name, make_bus, provider, registry)
+        self._engine = engine
+        self._cfg = TPUWorkerConfig(worker_id=name, **worker_cfg_kw)
+
+    def _make_worker(self, bus):
+        from ..inference.worker import TPUWorker
+
+        return TPUWorker(bus, self._engine, provider=self._provider,
+                         cfg=self._cfg, registry=self._registry)
+
+    def stall(self, seconds: float) -> None:
+        self._engine.block_for(seconds)
+
+
+def _teardown(label: str, fn) -> None:
+    """Per-step teardown isolation for the gates' finally blocks: one
+    failing close (e.g. a killed worker's RemoteBus) must not leak the
+    remaining servers/threads into the next run in this process — and
+    must never mask the verdict."""
+    try:
+        fn()
+    except Exception as e:
+        logger.warning("loadgen teardown (%s) error: %s", label, e)
 
 
 def _scrape(port: int, path: str, as_json: bool):
@@ -330,7 +387,14 @@ def run_scenario(scenario: Dict[str, Any],
     `ReplayWorkload` built by `generator.workload_from_bundle`).
     Raises only on setup/config errors; a run that finishes always
     returns a verdict (status "pass" or "fail" per the envelope).
+
+    Scenarios with ``"kind": "asr"`` run the media/ASR serving stack
+    instead of the text one (`run_asr_scenario`).
     """
+    if scenario.get("kind") == "asr":
+        if workload is not None:
+            raise ValueError("--replay is not supported for ASR scenarios")
+        return run_asr_scenario(scenario, overrides=overrides)
     from ..bus.inmemory import InMemoryBus
     from ..config.crawler import CrawlerConfig
     from ..inference.engine import EngineConfig, InferenceEngine
@@ -715,16 +779,6 @@ def run_scenario(scenario: Dict[str, Any],
             verdict["lost_sample"] = lost[:5]
         return verdict
     finally:
-        # Per-step isolation: one failing close (e.g. a killed worker's
-        # RemoteBus) must not leak the orchestrator threads, the HTTP/
-        # gRPC servers, or process-global seams into the next run in
-        # this process — and must never mask the verdict.
-        def _teardown(label: str, fn) -> None:
-            try:
-                fn()
-            except Exception as e:
-                logger.warning("loadgen teardown (%s) error: %s", label, e)
-
         if controller is not None:
             _teardown("controller", controller.stop)
         if handle is not None:
@@ -743,6 +797,444 @@ def run_scenario(scenario: Dict[str, Any],
 
             _teardown("connection-pool",
                       crawl_runner.shutdown_connection_pool)
+        if inner_bus is not None:
+            _teardown("inmemory-bus", inner_bus.close)
+        if server is not None:
+            _teardown("grpc-bus", server.close)
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+# --- the ASR serving gate (`media/`; scenarios with "kind": "asr") ----------
+
+class _NullSM:
+    """Minimal StateManager stand-in for the gate's bridges: the runs
+    reconcile over the worker writeback sinks, not the crawl store."""
+
+    def store_post(self, channel_id, post):
+        pass
+
+    def close(self):
+        pass
+
+
+class ASRWorkerHandle(_ServingWorkerHandle):
+    """`_ServingWorkerHandle` over the `ASRWorker`; stall blocks the
+    `ChaosASRPipeline`'s device calls mid-step."""
+
+    def __init__(self, name: str, make_bus, pipeline, provider,
+                 worker_cfg_kw: Dict[str, Any], registry):
+        from ..media.worker import ASRWorkerConfig
+
+        super().__init__(name, make_bus, provider, registry)
+        self._pipeline = pipeline
+        self._cfg = ASRWorkerConfig(worker_id=name, **worker_cfg_kw)
+
+    def _make_worker(self, bus):
+        from ..media.worker import ASRWorker
+
+        return ASRWorker(bus, self._pipeline, provider=self._provider,
+                         cfg=self._cfg, registry=self._registry)
+
+    def stall(self, seconds: float) -> None:
+        self._pipeline.block_for(seconds)
+
+
+def _build_asr_pipeline(asr_cfg: Dict[str, Any], registry):
+    """A tiny-Whisper `ASRPipeline` on random params (throughput and
+    correctness of the serving machinery do not depend on weight
+    values; real checkpoints belong to deployments, not gates)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..inference.asr import ASRPipeline
+    from ..models.whisper import WHISPER_TEST, Whisper
+
+    cfg = WHISPER_TEST
+    model = Whisper(cfg)
+    mel_probe = jnp.asarray(
+        np.zeros((1, cfg.n_audio_ctx * 2, cfg.n_mels)), jnp.float32)
+    params = model.init(jax.random.PRNGKey(int(asr_cfg.get("seed", 0))),
+                        mel_probe, jnp.zeros((1, 4), jnp.int32))
+    return ASRPipeline(
+        model, params,
+        batch_size=int(asr_cfg.get("batch_size", 4)),
+        max_len=int(asr_cfg.get("max_len", 6)),
+        window_buckets=asr_cfg.get("window_buckets"),
+        registry=registry)
+
+
+def run_asr_scenario(scenario: Dict[str, Any],
+                     overrides: Optional[Dict[str, Any]] = None
+                     ) -> Dict[str, Any]:
+    """Run one ASR scenario end-to-end in-process; returns the verdict.
+
+    The assembled stack: synthetic audio workload (seeded durations →
+    generated WAVs) → ChaosBus → ``TOPIC_MEDIA_BATCHES`` → `ASRWorker`
+    on a tiny-Whisper `ASRPipeline` → transcripts on
+    ``TOPIC_TRANSCRIPTS`` → `TranscriptReentry` through an
+    `InferenceBridge` → a real text `TPUWorker` embedding the re-entered
+    posts.  The envelope adds two media-specific checks to the usual
+    ones: every expected media id written exactly once (across worker
+    kills), and `/costs` reporting Whisper (path="asr") program rows
+    with nonzero MFU/goodput.
+    """
+    import os as _os
+    import wave as _wave
+
+    from ..bus.inmemory import InMemoryBus
+    from ..inference.bridge import InferenceBridge
+    from ..inference.engine import EngineConfig, InferenceEngine
+    from ..inference.worker import TPUWorker, TPUWorkerConfig, iter_results
+    from ..media.bridge import TranscriptReentry
+    from ..media.worker import iter_transcripts
+    from ..state.providers import InMemoryStorageProvider
+    from ..utils.metrics import MetricsRegistry, serve_metrics
+
+    scenario = merge_overrides(scenario, overrides)
+    name = scenario.get("name", "unnamed-asr")
+    bus_kind = scenario.get("bus", "inmemory")
+    if bus_kind not in ("inmemory", "grpc"):
+        raise ValueError(f"scenario bus must be inmemory|grpc, "
+                         f"got {bus_kind!r}")
+    timeline = parse_timeline(scenario.get("chaos", []))
+    if bus_kind != "grpc" and any(f.action in ("kill", "restart", "down")
+                                  for f in timeline):
+        raise ValueError(
+            "kill/restart faults need bus='grpc' (the in-memory bus has "
+            "no competing-consumer requeue, so a killed worker's frames "
+            "would be lost by construction)")
+
+    from dataclasses import fields as _dc_fields
+
+    audio_keys = {f.name for f in _dc_fields(AudioLoadConfig)}
+    audio_raw = dict(scenario.get("audio_load", {}))
+    # CLI overrides arrive under "load" (the shared loadtest flag
+    # surface); fold the keys both configs share into the audio config.
+    for key in ("seed", "duration_s", "rate_batches_per_s"):
+        if key in scenario.get("load", {}):
+            audio_raw[key] = scenario["load"][key]
+    audio_cfg = AudioLoadConfig(**{k: v for k, v in audio_raw.items()
+                                   if k in audio_keys})
+    worker_kw = {k: v for k, v in scenario.get("worker", {}).items()
+                 if k in ("worker_id", "heartbeat_s", "queue_capacity",
+                          "coalesce_batches", "write_tokens",
+                          "slo_asr_batch_p95_ms", "slo_queue_wait_ms",
+                          "slo_batch_age_ms")}
+    worker_name = worker_kw.pop("worker_id", "asr-1")
+    gate_cfg = scenario.get("gate", {})
+    drain_timeout_s = float(scenario.get("drain_timeout_s", 30.0))
+
+    trace.configure(capacity=int(scenario.get("trace_buffer", 8192)))
+    flight.configure(capacity=int(scenario.get("flight_buffer", 4096)))
+    run_mark = f"run-{time.monotonic_ns()}"
+    flight.record("loadgen_run_start", mark=run_mark)
+    registry = MetricsRegistry()
+
+    t_run0 = time.monotonic()
+    tmpdir = tempfile.mkdtemp(prefix="dct-loadgen-asr-")
+    pipeline = ChaosASRPipeline(
+        _build_asr_pipeline(scenario.get("asr", {}), registry))
+    provider = InMemoryStorageProvider()
+
+    server = None
+    inner_bus = None
+    handle = None
+    tpu_worker = None
+    ibridge = None
+    http_server = None
+    controller = None
+    verdict: Dict[str, Any] = {"scenario": name, "bus": bus_kind,
+                               "kind": "asr"}
+    try:
+        # --- bus fabric ---------------------------------------------------
+        if bus_kind == "grpc":
+            from ..bus.grpc_bus import GrpcBusServer, RemoteBus
+
+            server = GrpcBusServer("127.0.0.1:0")
+            server.enable_pull(TOPIC_MEDIA_BATCHES)
+            server.start()
+            addr = f"127.0.0.1:{server.bound_port}"
+            local_bus = server
+            make_worker_bus = lambda: RemoteBus(addr)  # noqa: E731
+        else:
+            inner_bus = InMemoryBus(sync=True)
+            local_bus = inner_bus
+            make_worker_bus = lambda: inner_bus  # noqa: E731
+        chaos_bus = ChaosBus(local_bus)
+
+        # --- re-entry leg: transcripts -> embeddings (real text path) -----
+        # Started BEFORE the ASR worker so the ASR worker's /costs
+        # provider registration wins (last registration serves).
+        reentry_crawl = scenario.get("reentry_crawl_id", "asr-reentry")
+        engine = InferenceEngine(
+            EngineConfig(**scenario.get("engine", {"model": "tiny"})),
+            registry=registry)
+        tpu_worker = TPUWorker(
+            local_bus, engine, provider=provider,
+            cfg=TPUWorkerConfig(worker_id="tpu-reentry", heartbeat_s=5.0,
+                                stall_warn_s=0.0),
+            registry=registry)
+        tpu_worker.start()
+        ibridge = InferenceBridge(_NullSM(), local_bus,
+                                  crawl_id=reentry_crawl,
+                                  batch_size=4, deadline_s=0.05)
+        reentry = TranscriptReentry(ibridge, local_bus)
+
+        # --- ASR worker ----------------------------------------------------
+        handle = ASRWorkerHandle(worker_name, make_worker_bus, pipeline,
+                                 provider, worker_kw, registry)
+        handle.start()
+        handle.worker.warmup()  # compile every bucket outside the phases
+
+        http_server = serve_metrics(0, registry)
+        port = http_server.server_address[1]
+
+        controller = ChaosController(timeline,
+                                     targets={worker_name: handle},
+                                     bus=chaos_bus, publish_bus=local_bus)
+
+        workload = AudioWorkload(audio_cfg,
+                                 _os.path.join(tmpdir, "media"))
+        n_wavs = workload.materialize()
+        logger.info("loadgen %s: %d synthetic wavs materialized",
+                    name, n_wavs)
+
+        # --- phase A: baseline (flush the SLO window) ----------------------
+        handle.worker.evaluate_slos()
+        breaches_0 = _breach_counts(registry)
+
+        # --- phase B: load + chaos ----------------------------------------
+        t_b0 = time.monotonic()
+        stop = threading.Event()
+        stats_box: Dict[str, Any] = {}
+
+        def _gen():
+            stats_box["stats"] = workload.run(chaos_bus, stop=stop)
+
+        gen_thread = threading.Thread(target=_gen, daemon=True,
+                                      name="dct-loadgen-asr")
+        controller.start()
+        gen_thread.start()
+        gen_thread.join()
+        deadline = time.monotonic() + drain_timeout_s
+        while not controller.done() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        controller.stop()
+        if server is not None:
+            server.drain(timeout_s=drain_timeout_s)
+        drained = handle.worker.drain(timeout_s=drain_timeout_s)
+        handle.worker.evaluate_slos()
+        breaches_fault = _delta(_breach_counts(registry), breaches_0)
+        t_b1 = time.monotonic()
+
+        # --- phase C: recovery tail ---------------------------------------
+        tail_cfg = scenario.get("tail", {})
+        tail_n = int(tail_cfg.get("batches", 4))
+        tail_gap = float(tail_cfg.get("gap_s", 0.1))
+        tail_refs = int(tail_cfg.get("refs_per_batch", 2))
+        tail_wav = _os.path.join(tmpdir, "media", "tail.wav")
+        with _wave.open(tail_wav, "wb") as w:
+            w.setnchannels(1)
+            w.setsampwidth(2)
+            w.setframerate(audio_cfg.sample_rate)
+            w.writeframes(b"\x00\x00" * int(audio_cfg.sample_rate
+                                            * audio_cfg.min_audio_s))
+        t_tail_wall = time.time()
+        breaches_mid = _breach_counts(registry)
+        from ..bus.messages import AudioBatchMessage, AudioRef
+
+        for i in range(tail_n):
+            refs = [AudioRef(media_id=f"tail{audio_cfg.seed}-{i}-{j}",
+                             path=tail_wav, channel_name="tailchan")
+                    for j in range(tail_refs)]
+            chaos_bus.publish(
+                TOPIC_MEDIA_BATCHES,
+                AudioBatchMessage.new(
+                    refs, crawl_id=audio_cfg.crawl_id).to_dict())
+            time.sleep(tail_gap)
+        if server is not None:
+            server.drain(timeout_s=drain_timeout_s)
+        tail_drained = handle.worker.drain(timeout_s=drain_timeout_s)
+        handle.worker.evaluate_slos()
+        breaches_tail = _delta(_breach_counts(registry), breaches_mid)
+
+        # Let the re-entry leg finish embedding what the tail produced.
+        # Transcripts hop through an async dispatch (bus delivery ->
+        # reentry -> bridge accumulator -> record batch -> TPU worker),
+        # so settle until the embedded set stops growing: every media id
+        # written by the ASR worker must surface as media:<id> in the
+        # inference writeback before measurement reads it.
+        settle_deadline = time.monotonic() + drain_timeout_s
+        while time.monotonic() < settle_deadline:
+            ibridge.flush()
+            tpu_worker.drain(timeout_s=drain_timeout_s)
+            written_now = {row.get("media_id", "")
+                           for row in iter_transcripts(
+                               provider, audio_cfg.crawl_id)
+                           if not row.get("error")}
+            embedded_now = {row.get("post_uid", "")
+                            for row in iter_results(provider,
+                                                    reentry_crawl)}
+            if all(f"media:{m}" in embedded_now for m in written_now):
+                break
+            time.sleep(0.1)
+        t_end = time.monotonic()
+
+        # --- measurement ---------------------------------------------------
+        spans = trace.TRACER.spans()
+        tail_queue_p95 = _p95_ms(spans, QUEUE_WAIT_SPANS, t_tail_wall)
+        tail_asr_p95 = _p95_ms(spans, ASR_BATCH_SPANS, t_tail_wall)
+        tail_age_p95 = _p95_ms(spans, BATCH_AGE_SPANS, t_tail_wall)
+
+        endpoints = {
+            "metrics": _scrape(port, "/metrics", as_json=False),
+            "costs": _scrape(port, "/costs", as_json=True),
+        }
+
+        expected = chaos_bus.expected_uids()
+        expected_set = set(expected)
+        written: Dict[str, int] = {}
+        written_ok: set = set()  # non-error rows: the re-entry candidates
+        error_rows = 0
+        for row in iter_transcripts(provider, audio_cfg.crawl_id):
+            mid = row.get("media_id", "")
+            if mid:
+                written[mid] = written.get(mid, 0) + 1
+            if row.get("error"):
+                error_rows += 1
+            elif mid:
+                written_ok.add(mid)
+        lost = [m for m in expected if m not in written]
+        duplicates = [m for m, c in written.items() if c > 1]
+        processed = sum(min(c, 1) for m, c in written.items()
+                        if m in expected_set)
+        reentered_uids = {row.get("post_uid", "")
+                          for row in iter_results(provider, reentry_crawl)}
+        # Error transcripts are never re-entered by design
+        # (TranscriptReentry skips them), so only successful rows count
+        # toward the re-entry requirement — a decode failure within
+        # max_transcript_errors must not fail the reentered check too.
+        missing_reentry = [m for m in expected
+                           if m in written_ok
+                           and f"media:{m}" not in reentered_uids]
+        active_s = max(1e-6, t_end - t_b0)
+        goodput = processed / active_s
+
+        # --- the envelope --------------------------------------------------
+        checks: Dict[str, Dict[str, Any]] = {}
+
+        def check(key: str, ok: bool, value, budget) -> None:
+            checks[key] = {"ok": bool(ok), "value": value, "budget": budget}
+
+        check("drained", drained and tail_drained,
+              {"fault": drained, "tail": tail_drained}, True)
+        check("lost", len(lost) <= int(gate_cfg.get("max_lost", 0)),
+              len(lost), int(gate_cfg.get("max_lost", 0)))
+        check("duplicates",
+              len(duplicates) <= int(gate_cfg.get("max_duplicates", 0)),
+              len(duplicates), int(gate_cfg.get("max_duplicates", 0)))
+        check("transcript_errors",
+              error_rows <= int(gate_cfg.get("max_transcript_errors", 0)),
+              error_rows, int(gate_cfg.get("max_transcript_errors", 0)))
+        if gate_cfg.get("reentry_required", True):
+            check("reentered", not missing_reentry, len(missing_reentry),
+                  "every written media id embedded (media:<id> in the "
+                  "inference writeback)")
+        for slo in gate_cfg.get("require_breach", []):
+            check(f"breach_{slo}", breaches_fault.get(slo, 0) > 0,
+                  breaches_fault.get(slo, 0), "> 0 during fault window")
+        for slo in gate_cfg.get("forbid_tail_breach", []):
+            check(f"tail_no_breach_{slo}",
+                  breaches_tail.get(slo, 0) == 0,
+                  breaches_tail.get(slo, 0), "0 in recovery tail")
+        if gate_cfg.get("asr_batch_p95_ms") is not None:
+            budget = float(gate_cfg["asr_batch_p95_ms"])
+            check("tail_asr_batch_p95_ms",
+                  tail_asr_p95 is not None and tail_asr_p95 <= budget,
+                  round(tail_asr_p95, 2) if tail_asr_p95 is not None
+                  else None, budget)
+        if gate_cfg.get("queue_wait_p95_ms") is not None:
+            budget = float(gate_cfg["queue_wait_p95_ms"])
+            check("tail_queue_wait_p95_ms",
+                  tail_queue_p95 is not None and tail_queue_p95 <= budget,
+                  round(tail_queue_p95, 2) if tail_queue_p95 is not None
+                  else None, budget)
+        if gate_cfg.get("goodput_min_media_per_s") is not None:
+            floor = float(gate_cfg["goodput_min_media_per_s"])
+            check("goodput_media_per_s", goodput >= floor,
+                  round(goodput, 2), f">= {floor}")
+        if gate_cfg.get("require_whisper_costs", True):
+            costs_body = endpoints["costs"] or {}
+            rows = [c for c in costs_body.get("costs", [])
+                    if c.get("path") == "asr"
+                    and (c.get("flops") or 0) > 0]
+            eff = costs_body.get("efficiency") or {}
+            ok = bool(rows) and (eff.get("mfu") or 0) > 0 \
+                and (eff.get("goodput_tokens_per_s") or 0) > 0
+            check("whisper_costs", ok,
+                  {"asr_rows": len(rows), "mfu": eff.get("mfu"),
+                   "goodput": eff.get("goodput_tokens_per_s")},
+                  "path=asr rows with nonzero flops + nonzero MFU/goodput")
+        if gate_cfg.get("require_flight"):
+            events = flight.RECORDER.events()
+            start = 0
+            for i in range(len(events) - 1, -1, -1):
+                if events[i].get("kind") == "loadgen_run_start" \
+                        and events[i].get("mark") == run_mark:
+                    start = i
+                    break
+            kinds = {e.get("kind") for e in events[start:]}
+            for kind in gate_cfg["require_flight"]:
+                check(f"flight_{kind}", kind in kinds, kind in kinds, True)
+        for key in ("metrics", "costs"):
+            check(f"endpoint_{key}", endpoints[key] is not None,
+                  endpoints[key] is not None, True)
+
+        stats = stats_box.get("stats")
+        verdict.update({
+            "status": "pass" if all(c["ok"] for c in checks.values())
+            else "fail",
+            "duration_s": round(time.monotonic() - t_run0, 2),
+            "published": {
+                **(stats.to_dict() if stats is not None else {}),
+                "dropped_batches": len(chaos_bus.dropped),
+                "poisoned_batches": len(chaos_bus.poisoned),
+            },
+            "expected_media": len(expected),
+            "processed_media": processed,
+            "lost": len(lost),
+            "duplicates": len(duplicates),
+            "transcript_errors": error_rows,
+            "reentered_posts": reentry.posts_reentered,
+            "goodput_media_per_s": round(goodput, 2),
+            "fault_breaches": breaches_fault,
+            "tail_breaches": breaches_tail,
+            "tail_asr_batch_p95_ms": round(tail_asr_p95, 2)
+            if tail_asr_p95 is not None else None,
+            "tail_queue_wait_p95_ms": round(tail_queue_p95, 2)
+            if tail_queue_p95 is not None else None,
+            "tail_batch_age_p95_ms": round(tail_age_p95, 2)
+            if tail_age_p95 is not None else None,
+            "fault_window_s": round(t_b1 - t_b0, 2),
+            "chaos_events": len(controller.events),
+            "worker_generations": handle.generation,
+            "checks": checks,
+        })
+        if lost[:5]:
+            verdict["lost_sample"] = lost[:5]
+        return verdict
+    finally:
+        if controller is not None:
+            _teardown("controller", controller.stop)
+        if handle is not None:
+            _teardown("asr-worker", handle.stop)
+        if tpu_worker is not None:
+            _teardown("tpu-reentry", lambda: tpu_worker.stop(timeout_s=5.0))
+        if ibridge is not None:
+            _teardown("reentry-bridge", ibridge.close)
+        if http_server is not None:
+            _teardown("http-server", http_server.shutdown)
         if inner_bus is not None:
             _teardown("inmemory-bus", inner_bus.close)
         if server is not None:

@@ -35,7 +35,13 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from ..bus.codec import RecordBatch
-from ..bus.messages import TOPIC_INFERENCE_BATCHES, VALID_PLATFORMS
+from ..bus.messages import (
+    TOPIC_INFERENCE_BATCHES,
+    TOPIC_MEDIA_BATCHES,
+    VALID_PLATFORMS,
+    AudioBatchMessage,
+    AudioRef,
+)
 from ..datamodel.post import Post
 from ..utils import flight
 
@@ -286,6 +292,154 @@ class ReplayWorkload(_WorkloadBase):
             "words": sum(r.words for pb in self._batches
                          for r in pb.records),
         }
+
+
+# --- synthetic audio (the ASR workload, `media/`) ---------------------------
+
+@dataclass
+class AudioLoadConfig:
+    """Seeded synthetic media stream for the ASR serving leg: a duration
+    distribution → generated WAV files → `AudioBatchMessage`s through
+    the real bus (`TOPIC_MEDIA_BATCHES`)."""
+
+    seed: int = 0
+    duration_s: float = 5.0             # load-phase length
+    rate_batches_per_s: float = 3.0     # open-loop Poisson arrivals
+    refs_per_batch: int = 3
+    # Bounded-Pareto audio durations: mostly-short voice notes with a
+    # tail of longer clips (multiple 30 s windows on real configs).
+    min_audio_s: float = 0.1
+    max_audio_s: float = 1.0
+    zipf_a: float = 1.6
+    sample_rate: int = 16_000
+    crawl_id: str = "loadgen-asr"
+
+    def validate(self) -> None:
+        if self.duration_s <= 0:
+            raise ValueError("duration_s must be positive")
+        if self.rate_batches_per_s <= 0:
+            raise ValueError("rate_batches_per_s must be positive")
+        if self.refs_per_batch <= 0:
+            raise ValueError("refs_per_batch must be positive")
+        if not 0 < self.min_audio_s <= self.max_audio_s:
+            raise ValueError(
+                f"bad audio duration bounds [{self.min_audio_s}, "
+                f"{self.max_audio_s}]")
+
+
+@dataclass(frozen=True)
+class PlannedAudioBatch:
+    """Arrival slot + per-ref durations of one synthetic audio batch."""
+
+    index: int
+    offset_s: float
+    durations_s: tuple  # seconds per ref
+
+
+class AudioWorkload:
+    """The fully-seeded audio source: same seed → identical WAV bytes,
+    media ids, batch shapes, and arrival schedule."""
+
+    def __init__(self, cfg: AudioLoadConfig, media_dir: str):
+        cfg.validate()
+        self.cfg = cfg
+        self.media_dir = media_dir
+        self._plan: Optional[List[PlannedAudioBatch]] = None
+
+    def plan(self) -> List[PlannedAudioBatch]:
+        if self._plan is not None:
+            return self._plan
+        rng = random.Random(self.cfg.seed)
+        out: List[PlannedAudioBatch] = []
+        t = 0.0
+        i = 0
+        while True:
+            t += rng.expovariate(self.cfg.rate_batches_per_s)
+            if t >= self.cfg.duration_s:
+                break
+            durations = []
+            for _ in range(self.cfg.refs_per_batch):
+                u = max(1e-9, 1.0 - rng.random())
+                span = u ** (-1.0 / max(0.1, self.cfg.zipf_a - 1.0))
+                durations.append(round(min(
+                    self.cfg.max_audio_s,
+                    self.cfg.min_audio_s * span), 4))
+            out.append(PlannedAudioBatch(i, round(t, 6), tuple(durations)))
+            i += 1
+        self._plan = out
+        return out
+
+    def media_id(self, batch_index: int, ref_index: int) -> str:
+        return f"am{self.cfg.seed}-{batch_index}-{ref_index}"
+
+    def materialize(self) -> int:
+        """Write every planned WAV under ``media_dir`` (deterministic
+        sine tones: seeded frequency per ref); returns the file count.
+        Done up front so file I/O never skews the arrival schedule."""
+        import os
+        import wave
+
+        import numpy as np
+
+        os.makedirs(self.media_dir, exist_ok=True)
+        n = 0
+        rate = self.cfg.sample_rate
+        for pb in self.plan():
+            for j, seconds in enumerate(pb.durations_s):
+                freq = 220.0 + ((pb.index * 31 + j * 7) % 24) * 55.0
+                t = np.arange(int(seconds * rate)) / rate
+                pcm = (np.sin(2 * np.pi * freq * t)
+                       * 0.3 * 32767).astype(np.int16)
+                path = os.path.join(self.media_dir,
+                                    f"{self.media_id(pb.index, j)}.wav")
+                with wave.open(path, "wb") as w:
+                    w.setnchannels(1)
+                    w.setsampwidth(2)
+                    w.setframerate(rate)
+                    w.writeframes(pcm.tobytes())
+                n += 1
+        return n
+
+    def run(self, bus, topic: str = TOPIC_MEDIA_BATCHES,
+            stop: Optional[threading.Event] = None,
+            record_flight: bool = True) -> RunStats:
+        """Publish the planned audio batches in real time (open-loop:
+        a slow ASR worker does NOT slow the offered load)."""
+        import os
+
+        stats = RunStats()
+        stop = stop or threading.Event()
+        t0 = time.monotonic()
+        for pb in self.plan():
+            target = t0 + pb.offset_s
+            while not stop.is_set():
+                now = time.monotonic()
+                if now >= target:
+                    break
+                stop.wait(min(0.02, target - now))
+            if stop.is_set():
+                break
+            refs = [AudioRef(
+                media_id=self.media_id(pb.index, j),
+                path=os.path.join(self.media_dir,
+                                  f"{self.media_id(pb.index, j)}.wav"),
+                channel_name=f"lgchan{pb.index % 5}")
+                for j in range(len(pb.durations_s))]
+            msg = AudioBatchMessage.new(refs, crawl_id=self.cfg.crawl_id)
+            bus.publish(topic, msg.to_dict())
+            now = time.monotonic()
+            if stats.batches == 0:
+                stats.first_at = now
+            stats.last_at = now
+            stats.batches += 1
+            stats.records += len(refs)
+            stats.words += int(sum(pb.durations_s) * 1000)  # audio ms
+            if record_flight:
+                flight.record("loadgen_audio_batch", batch=msg.batch_id,
+                              refs=len(refs),
+                              audio_s=round(sum(pb.durations_s), 3),
+                              offset_s=round(now - t0, 4))
+        return stats
 
 
 def _spread_words(total: int, n: int) -> List[int]:

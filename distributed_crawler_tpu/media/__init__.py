@@ -1,0 +1,33 @@
+"""media/: distributed ASR serving — crawled audio to transcripts.
+
+The multi-modal leg of the serving pipeline (BASELINE config #4): the
+crawl-side `MediaBridge` publishes fetched audio refs as typed
+`AudioBatchMessage`s, the `AudioChunker` turns ragged waveforms into
+bucketed fixed-shape window batches (one compiled Whisper program per
+bucket, per the PR-1 packing discipline), the `ASRWorker` serves them
+with the same queue/ack/telemetry/SLO machinery as the text TPU worker,
+and `TranscriptReentry` feeds transcripts back through the
+`InferenceBridge` so they get embedded and classified like any crawled
+post.
+"""
+
+from .bridge import MediaBridge, TranscriptReentry
+from .chunker import (
+    DEFAULT_WINDOW_BUCKETS,
+    AudioChunker,
+    ChunkPlan,
+    bucket_for_windows,
+)
+from .worker import ASRWorker, ASRWorkerConfig, iter_transcripts
+
+__all__ = [
+    "ASRWorker",
+    "ASRWorkerConfig",
+    "AudioChunker",
+    "ChunkPlan",
+    "DEFAULT_WINDOW_BUCKETS",
+    "MediaBridge",
+    "TranscriptReentry",
+    "bucket_for_windows",
+    "iter_transcripts",
+]

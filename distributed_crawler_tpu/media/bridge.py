@@ -1,0 +1,239 @@
+"""The crawl -> ASR bridge and the transcript re-entry hop.
+
+`MediaBridge` is the media twin of `inference/bridge.py:InferenceBridge`:
+it decorates any StateManager, watches the media write path
+(`telegram/parsing.py:fetch_and_upload_media` calls
+``notify_media_stored`` on the manager after a successful store — plain
+managers simply don't implement it), accumulates audio refs, and
+publishes typed `AudioBatchMessage`s on ``TOPIC_MEDIA_BATCHES`` with a
+deadline flush, so a bursty crawl can't strand refs below the batch
+size.  Dedup is two-layered: the `ShardedMediaCache` upstream keeps
+already-processed media from being re-fetched at all, and a bounded
+recently-seen window here keeps at-least-once re-crawls from
+re-publishing a ref that already shipped (same discipline as the
+InferenceBridge's post_uid window).
+
+`TranscriptReentry` closes the loop: it subscribes to
+``TOPIC_TRANSCRIPTS`` and feeds each successful transcript back through
+an `InferenceBridge`-wrapped manager as a synthetic text post whose
+``post_uid`` is the deterministic ``media:<media_id>`` — so the PR-7
+dedupe window holds across re-crawls and redeliveries, and the existing
+text path embeds/classifies transcripts like any crawled post.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import List, Optional
+
+from ..bus.messages import (
+    TOPIC_MEDIA_BATCHES,
+    TOPIC_TRANSCRIPTS,
+    AudioBatchMessage,
+    AudioRef,
+    TranscriptMessage,
+)
+from ..datamodel import Post
+from ..utils import trace
+
+logger = logging.getLogger("dct.media.bridge")
+
+# Containers the ASR stage can decode today (PCM wav; everything else is
+# an upstream ffmpeg concern — see `inference/asr.py`).
+AUDIO_EXTENSIONS = (".wav",)
+
+
+class MediaBridge:
+    """StateManager decorator publishing audio-ref batches as media lands."""
+
+    def __init__(self, sm, bus, crawl_id: str = "", batch_size: int = 8,
+                 deadline_s: float = 0.25, topic: str = TOPIC_MEDIA_BATCHES,
+                 poll_interval_s: float = 0.05, dedupe_window: int = 65536,
+                 extensions: tuple = AUDIO_EXTENSIONS):
+        self._sm = sm
+        self._bus = bus
+        self._topic = topic
+        self._crawl_id = crawl_id
+        self._batch_size = max(1, batch_size)
+        self._deadline_s = deadline_s
+        self._extensions = tuple(e.lower() for e in extensions)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._pending: List[AudioRef] = []
+        self._first_at: Optional[float] = None
+        self.batches_published = 0
+        self.refs_bridged = 0
+        self.refs_deduped = 0
+        self.refs_skipped = 0          # non-audio media
+        self.publish_failures = 0
+        self._retry_at = 0.0           # backoff gate after a failed publish
+        self._fail_streak = 0
+        self._dedupe_window = max(0, dedupe_window)
+        self._seen_ids: "OrderedDict[str, None]" = OrderedDict()
+        self._thread = threading.Thread(target=self._poll_loop, daemon=True,
+                                        name="dct-media-bridge-flush")
+        self._poll_interval_s = poll_interval_s
+        self._thread.start()
+
+    # -- the media write hook ----------------------------------------------
+    def notify_media_stored(self, media_id: str, path: str,
+                            channel_name: str = "",
+                            post_uid: str = "") -> None:
+        """Called by `fetch_and_upload_media` after a successful store
+        (and by tests/loadgen directly).  Non-audio containers are
+        counted and skipped; duplicate media ids inside the window are
+        dropped — the `ShardedMediaCache` already stopped re-fetches,
+        this stops re-publishes on at-least-once re-crawls."""
+        if not media_id or not path:
+            return
+        if not path.lower().endswith(self._extensions):
+            with self._lock:
+                self.refs_skipped += 1
+            return
+        ref = AudioRef(media_id=media_id, path=path,
+                       channel_name=channel_name, post_uid=post_uid)
+        now = time.monotonic()
+        with self._lock:
+            if self._dedupe_window:
+                if media_id in self._seen_ids:
+                    self._seen_ids.move_to_end(media_id)
+                    self.refs_deduped += 1
+                    return
+                self._seen_ids[media_id] = None
+                while len(self._seen_ids) > self._dedupe_window:
+                    self._seen_ids.popitem(last=False)
+            self.refs_bridged += 1
+            if self._first_at is None:
+                self._first_at = now
+            self._pending.append(ref)
+            # The retry-backoff gate applies here too, or a full batch
+            # arriving mid-outage would hammer the dead bus per ref.
+            batch = self._emit() \
+                if (len(self._pending) >= self._batch_size
+                    and now >= self._retry_at) else None
+        if batch is not None:
+            self._publish(batch)
+
+    # -- lifecycle ----------------------------------------------------------
+    def flush(self) -> None:
+        """Ship whatever is accumulated (end of crawl / shutdown)."""
+        with self._lock:
+            batch = self._emit() if self._pending else None
+        if batch is not None:
+            self._publish(batch)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        self.flush()
+        self._sm.close()
+
+    def _emit(self) -> AudioBatchMessage:
+        """Build a batch from pending refs; every caller holds the lock
+        (the crawlint pragma records that contract)."""
+        msg = AudioBatchMessage.new(self._pending, crawl_id=self._crawl_id)
+        self._pending = []  # crawlint: disable=LCK001
+        self._first_at = None  # crawlint: disable=LCK001
+        return msg
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self._poll_interval_s):
+            now = time.monotonic()
+            with self._lock:
+                due = (self._pending and self._first_at is not None
+                       and now >= self._retry_at
+                       and now - self._first_at >= self._deadline_s)
+                batch = self._emit() if due else None
+            if batch is not None:
+                self._publish(batch)
+
+    def _publish(self, msg: AudioBatchMessage) -> None:
+        """Publish one batch; a failure REQUEUES the refs into the
+        accumulator (with backoff) instead of dropping them.
+
+        Dropping here would be permanent loss: the ids are already in
+        the dedupe window and `fetch_and_upload_media` marked them
+        processed in the ShardedMediaCache before notifying, so neither
+        a re-notify nor a re-crawl would ever retry them.  The deadline
+        flusher retries the requeued refs once ``_retry_at`` passes
+        (exponential backoff, capped at 5 s)."""
+        try:
+            # Root span of the media batch's trace — the ASR worker's
+            # queue-wait/decode/transcribe spans and the transcript's
+            # re-entry hop all share msg.trace_id.
+            with trace.span("media.dispatch", trace_id=msg.trace_id,
+                            batch=msg.batch_id, refs=len(msg.refs),
+                            crawl_id=msg.crawl_id):
+                self._bus.publish(self._topic, msg.to_dict())
+            with self._lock:
+                self.batches_published += 1
+                self._fail_streak = 0
+                self._retry_at = 0.0
+        except Exception as e:
+            with self._lock:
+                self.publish_failures += 1
+                self._fail_streak += 1
+                self._retry_at = time.monotonic() + min(
+                    5.0, 0.25 * (2 ** min(self._fail_streak, 5)))
+                # Requeue at the front so retry order stays stable; the
+                # batch id/trace id are reminted on the retry emit.
+                self._pending = list(msg.refs) + self._pending
+                if self._first_at is None:
+                    self._first_at = time.monotonic() - self._deadline_s
+            logger.error("failed to publish audio batch (requeued)",
+                         extra={"batch_id": msg.batch_id,
+                                "refs": len(msg.refs), "error": str(e)})
+
+    # -- everything else is the wrapped manager -----------------------------
+    def __getattr__(self, name):
+        return getattr(self._sm, name)
+
+
+class TranscriptReentry:
+    """TOPIC_TRANSCRIPTS -> synthetic text posts through a bridged manager.
+
+    ``sm`` should be (or wrap) an `InferenceBridge`, so each stored post
+    ships to the inference topic and the text path embeds/classifies it;
+    a plain manager still stores the transcript post in the crawl sink.
+    Error transcripts (decode failures) are counted, not stored — an
+    empty post would just burn an embed slot.
+    """
+
+    def __init__(self, sm, bus=None, topic: str = TOPIC_TRANSCRIPTS):
+        self._sm = sm
+        self.posts_reentered = 0
+        self.errors_skipped = 0
+        if bus is not None:
+            bus.subscribe(topic, self.handle_transcript)
+
+    def handle_transcript(self, payload: dict) -> None:
+        try:
+            msg = TranscriptMessage.from_dict(payload)
+            msg.validate()
+        except Exception as e:
+            logger.warning("undecodable transcript payload dropped: %s", e)
+            return
+        if msg.error or not (msg.text or msg.tokens):
+            self.errors_skipped += 1
+            return
+        text = msg.text or " ".join(str(t) for t in msg.tokens)
+        channel = msg.channel_name or \
+            (os.path.dirname(msg.path) or "transcripts")
+        post = Post(
+            post_uid=msg.post_uid or f"media:{msg.media_id}",
+            channel_id=channel,
+            channel_name=channel,
+            platform_name="telegram",
+            post_type=["audio_transcript"],
+            description=text,
+        )
+        # The re-entry hop joins the transcript's trace (itself the audio
+        # batch's), linking the media leg to the text leg's record batch.
+        with trace.span("media.reentry", trace_id=msg.trace_id,
+                        media_id=msg.media_id, post_uid=post.post_uid):
+            self._sm.store_post(post.channel_id, post)
+        self.posts_reentered += 1

@@ -186,6 +186,18 @@ def fetch_and_upload_media(client: TelegramClient, sm, crawl_id: str,
         file_name = os.path.basename(downloaded.local_path)
         stored_path, stored_name = sm.store_file(channel_name,
                                                  downloaded.local_path, file_name)
+        # Media -> ASR seam (`media/bridge.py:MediaBridge`): a bridged
+        # manager publishes the stored ref to the media topic so the ASR
+        # worker transcribes it; plain managers don't implement the hook.
+        # Notify BEFORE the cache mark: once marked, a re-crawl never
+        # re-fetches this media, so a crash between the two would
+        # otherwise lose the transcript forever — duplicate notifies
+        # from a mark-less retry are absorbed by the bridge's dedupe
+        # window.
+        notify = getattr(sm, "notify_media_stored", None)
+        if callable(notify):
+            notify(media_id=remote_file_id, path=stored_path,
+                   channel_name=channel_name)
         sm.mark_media_as_processed(remote_file_id)
         # Free TDLib-side disk (`tdutils.go` DeleteFile usage).
         try:

@@ -75,6 +75,47 @@ def encoder_forward_flops(cfg, batch: int, seq: int) -> float:
     return float(batch * seq * per_token)
 
 
+def whisper_forward_flops(cfg, batch: int, decode_len: int) -> float:
+    """Analytic forward FLOPs for one greedy ASR batch — the Whisper row
+    of the cost table, so `/costs` and the MFU/goodput gauges stay honest
+    for ASR programs whose backend has no ``cost_analysis()``.
+
+    Encoder (per 30 s window): the two stem convs (3-tap, stride 1 then
+    2) plus ``n_audio_layer`` transformer layers over ``n_audio_ctx``
+    positions — QKV+out projections (8·d²), score+value matmuls
+    (4·ctx·d), MLP up+down (8·d²  since ff = 4d) per position.  Decoder:
+    ``decode_len - 1`` single-token steps (the SOT token is free), each
+    paying self-attention projections + a growing-cache score/value read
+    (bounded by n_text_ctx; we charge the full cache — a <2% overcount
+    that keeps the formula shape-static like the compiled program), the
+    cross-attention read against ``n_audio_ctx`` cached K/V, the MLP,
+    and the tied-embedding logits GEMM (d·n_vocab).  Multiply-accumulate
+    counted as 2 FLOPs throughout, matching `encoder_forward_flops`.
+
+    ``cfg`` is a `models.whisper.WhisperConfig`; this module must stay
+    importable without jax, so the config is duck-typed.
+    """
+    da, dt = cfg.n_audio_state, cfg.n_text_state
+    ctx_a, ctx_t = cfg.n_audio_ctx, cfg.n_text_ctx
+    mel_frames = ctx_a * 2
+    # Stem convs: [frames, n_mels] -> [frames, d] then stride-2 [ctx, d].
+    conv = 2 * (mel_frames * 3 * cfg.n_mels * da
+                + ctx_a * 3 * da * da)
+    enc_layer = ctx_a * (8 * da * da + 4 * ctx_a * da + 16 * da * da)
+    encoder = conv + cfg.n_audio_layer * enc_layer
+    # Cross K/V projection, once per utterance per layer.
+    cross_kv = cfg.n_text_layer * 2 * (2 * ctx_a * dt * dt)
+    steps = max(1, int(decode_len) - 1)
+    dec_step_layer = (8 * dt * dt            # self q/k/v/out projections
+                      + 4 * ctx_t * dt       # self score+value vs cache
+                      + 4 * dt * dt          # cross q + out projections
+                      + 4 * ctx_a * dt       # cross score+value vs audio
+                      + 16 * dt * dt)        # MLP (ff = 4d)
+    logits = 2 * dt * cfg.n_vocab
+    decoder = steps * (cfg.n_text_layer * dec_step_layer + logits)
+    return float(batch) * (encoder + cross_kv + decoder)
+
+
 def peak_flops(device_kind: str = "", platform: str = "",
                n_devices: int = 1) -> Tuple[float, str]:
     """(aggregate peak FLOP/s over ``n_devices``, source tag).

@@ -38,14 +38,19 @@ logger = logging.getLogger("dct.slo")
 # The batch budget reads whichever of these the process emits.
 BATCH_SPANS = ("tpu_worker.process", "tpu_worker.coalesce",
                "worker.process")
-QUEUE_WAIT_SPANS = ("tpu_worker.queue_wait",)
+QUEUE_WAIT_SPANS = ("tpu_worker.queue_wait", "asr_worker.queue_wait")
 # Whole-pipeline age of a record batch (creation -> device), recorded by
 # the TPU worker from ``RecordBatch.created_at``.  Unlike queue_wait —
 # which only sees time inside THIS worker's queue — batch age covers the
 # bus/broker leg, so it is the budget that catches a dead worker's
 # backlog: frames stranded on the broker while the worker was down come
 # back old, even though they clear the local queue instantly.
-BATCH_AGE_SPANS = ("tpu_worker.batch_age",)
+BATCH_AGE_SPANS = ("tpu_worker.batch_age", "asr_worker.batch_age")
+# The ASR worker's unit of work (an audio-batch group through decode →
+# window → bucketed Whisper programs).  A separate budget from the text
+# batch one because the latency regimes differ by orders of magnitude
+# (seconds of greedy decode vs milliseconds of embed+classify).
+ASR_BATCH_SPANS = ("asr_worker.process", "asr_worker.coalesce")
 
 
 @dataclass(frozen=True)
@@ -60,7 +65,8 @@ class SLO:
 
 def standard_slos(batch_p95_ms: float = 0.0,
                   queue_wait_ms: float = 0.0,
-                  batch_age_ms: float = 0.0) -> List[SLO]:
+                  batch_age_ms: float = 0.0,
+                  asr_batch_p95_ms: float = 0.0) -> List[SLO]:
     """The CLI's budget set; zero/negative budgets are simply absent."""
     out: List[SLO] = []
     if batch_p95_ms > 0:
@@ -69,6 +75,8 @@ def standard_slos(batch_p95_ms: float = 0.0,
         out.append(SLO("queue_wait", QUEUE_WAIT_SPANS, queue_wait_ms))
     if batch_age_ms > 0:
         out.append(SLO("batch_age", BATCH_AGE_SPANS, batch_age_ms))
+    if asr_batch_p95_ms > 0:
+        out.append(SLO("asr_batch", ASR_BATCH_SPANS, asr_batch_p95_ms))
     return out
 
 

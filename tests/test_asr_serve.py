@@ -1,0 +1,602 @@
+"""media/: distributed ASR serving — chunker scheduling, bus envelopes,
+ASRWorker ack/poison isolation, and the e2e loop: synthetic WAV →
+MediaBridge → ASRWorker → TranscriptMessage → re-entry → embedding, with
+one trace followed across every hop.
+
+Everything runs the tiny WHISPER_TEST config on CPU (0.32 s windows,
+6-token decode), with one module-scoped pipeline so jit compiles are
+paid once.
+"""
+
+import json
+import os
+import threading
+import time
+import wave
+
+import numpy as np
+import pytest
+
+from distributed_crawler_tpu.bus.codec import decode_message
+from distributed_crawler_tpu.bus.inmemory import InMemoryBus
+from distributed_crawler_tpu.bus.messages import (
+    TOPIC_INFERENCE_BATCHES,
+    TOPIC_MEDIA_BATCHES,
+    TOPIC_TRANSCRIPTS,
+    AudioBatchMessage,
+    AudioRef,
+    TranscriptMessage,
+)
+from distributed_crawler_tpu.media.chunker import (
+    AudioChunker,
+    bucket_for_windows,
+)
+from distributed_crawler_tpu.state.providers import InMemoryStorageProvider
+from distributed_crawler_tpu.utils import trace
+from distributed_crawler_tpu.utils.metrics import MetricsRegistry
+
+
+def _write_wav(path, seconds, rate=16_000, freq=440.0):
+    t = np.arange(int(seconds * rate)) / rate
+    pcm = (np.sin(2 * np.pi * freq * t) * 0.3 * 32767).astype(np.int16)
+    with wave.open(str(path), "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(rate)
+        w.writeframes(pcm.tobytes())
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def asr_pipeline():
+    """One tiny-Whisper pipeline for the whole module (compiles once)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_crawler_tpu.inference.asr import ASRPipeline
+    from distributed_crawler_tpu.models.whisper import WHISPER_TEST, Whisper
+
+    cfg = WHISPER_TEST
+    model = Whisper(cfg)
+    mel_probe = jnp.asarray(
+        np.zeros((1, cfg.n_audio_ctx * 2, cfg.n_mels)), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), mel_probe,
+                        jnp.zeros((1, 4), jnp.int32))
+    pipe = ASRPipeline(model, params, batch_size=2, max_len=6,
+                       detokenize=lambda t: " ".join(str(x) for x in t),
+                       registry=MetricsRegistry())
+    pipe.warmup()
+    return pipe
+
+
+# ---------------------------------------------------------------------------
+# Chunker: bucketing + segment-map determinism
+# ---------------------------------------------------------------------------
+
+class TestChunker:
+    def test_bucket_for_windows(self):
+        assert bucket_for_windows(1, (1, 2, 4)) == 1
+        assert bucket_for_windows(3, (1, 2, 4)) == 4
+        assert bucket_for_windows(9, (1, 2, 4)) == 4  # caller splits first
+
+    def test_windowing_and_segment_map(self):
+        c = AudioChunker(window_samples=100, buckets=(1, 2, 4))
+        audios = [np.ones(250, np.float32), None,
+                  np.ones(50, np.float32), np.ones(400, np.float32)]
+        plan = c.chunk(audios, errors={1: "boom"})
+        assert plan.n_windows == 8
+        assert plan.segment_map == [(0, 0), (0, 1), (0, 2), (2, 0),
+                                    (3, 0), (3, 1), (3, 2), (3, 3)]
+        assert plan.errors == {1: "boom"}
+        assert plan.windows_per_file() == [3, 0, 1, 4]
+        # Tail window of file 0 is zero-padded past sample 50.
+        assert plan.windows[2][49] == 1.0 and plan.windows[2][50] == 0.0
+        # Real-sample accounting: 100+100+50 (file0) + 50 + 400.
+        assert sum(plan.real_samples) == 700
+
+    def test_bucketing_largest_first_then_cover(self):
+        c = AudioChunker(window_samples=10, buckets=(1, 2, 4))
+        plan = c.chunk([np.ones(70, np.float32)])  # 7 windows
+        batches = c.batches(plan)
+        assert [b.bucket for b in batches] == [4, 4]
+        assert [b.real_windows for b in batches] == [4, 3]
+        # Every plan window dispatched exactly once, in order.
+        assert [w for b in batches for w in b.window_indices] == \
+            list(range(7))
+        stats = c.padding_stats(plan, batches)
+        assert stats["slot_windows"] == 8
+        assert stats["real_windows"] == 7
+        assert 0 < stats["window_density"] < 1
+
+    def test_deterministic(self):
+        c = AudioChunker(window_samples=64, buckets=(1, 2))
+        audios = [np.arange(150, dtype=np.float32) / 200.0,
+                  np.ones(64, np.float32)]
+        p1, p2 = c.chunk(audios), c.chunk(audios)
+        assert p1.segment_map == p2.segment_map
+        assert np.array_equal(p1.windows, p2.windows)
+        b1, b2 = c.batches(p1), c.batches(p2)
+        assert [(b.bucket, b.window_indices) for b in b1] == \
+            [(b.bucket, b.window_indices) for b in b2]
+
+    def test_max_windows_per_file_caps(self):
+        c = AudioChunker(window_samples=10, buckets=(1, 2, 4),
+                         max_windows_per_file=2)
+        plan = c.chunk([np.ones(100, np.float32)])
+        assert plan.n_windows == 2
+
+    def test_reassemble_order_and_mismatch(self):
+        c = AudioChunker(window_samples=10, buckets=(4,))
+        plan = c.chunk([np.ones(20, np.float32), None,
+                        np.ones(5, np.float32)], errors={1: "x"})
+        per_window = [[1, 2], [3], [9]]
+        assert c.reassemble(plan, per_window) == [[1, 2, 3], [], [9]]
+        with pytest.raises(ValueError, match="window outputs"):
+            c.reassemble(plan, [[1]])
+
+    def test_chunk_files_errors_explicit(self, tmp_path):
+        c = AudioChunker(window_samples=100, buckets=(1, 2))
+        good = _write_wav(tmp_path / "ok.wav", 0.01)
+        plan = c.chunk_files([str(tmp_path / "missing.wav"), good])
+        assert 0 in plan.errors
+        assert plan.windows_per_file() == [0, 2]
+
+    def test_rejects_bad_config(self):
+        with pytest.raises(ValueError):
+            AudioChunker(window_samples=0)
+        with pytest.raises(ValueError):
+            AudioChunker(window_samples=10, buckets=())
+
+
+# ---------------------------------------------------------------------------
+# Bus envelopes
+# ---------------------------------------------------------------------------
+
+class TestMediaMessages:
+    def test_audio_batch_roundtrip_with_trace(self):
+        msg = AudioBatchMessage.new(
+            [AudioRef(media_id="m1", path="/a.wav", channel_name="c",
+                      post_uid="p1", duration_s=2.5)],
+            crawl_id="c1")
+        msg.validate()
+        decoded = decode_message(json.loads(json.dumps(msg.to_dict())))
+        assert isinstance(decoded, AudioBatchMessage)
+        assert decoded.trace_id == msg.trace_id
+        assert decoded.refs[0].duration_s == 2.5
+        assert len(decoded) == 1
+
+    def test_audio_batch_validation(self):
+        with pytest.raises(ValueError, match="refs"):
+            AudioBatchMessage.new([], crawl_id="c").validate()
+        with pytest.raises(ValueError, match="media_id"):
+            AudioBatchMessage.new([AudioRef(path="/a")]).validate()
+
+    def test_transcript_roundtrip_and_deterministic_uid(self):
+        msg = TranscriptMessage.new("m9", crawl_id="c", batch_id="b",
+                                    text="hello", tokens=[1, 2],
+                                    windows=2, trace_id="trace_x")
+        assert msg.post_uid == "media:m9"
+        assert msg.trace_id == "trace_x"  # inherits the audio batch's
+        decoded = decode_message(json.loads(json.dumps(msg.to_dict())))
+        assert isinstance(decoded, TranscriptMessage)
+        assert decoded.post_uid == "media:m9"
+        assert decoded.tokens == [1, 2]
+
+    def test_transcript_error_row(self):
+        msg = TranscriptMessage.new("m1", error="decode failed")
+        decoded = decode_message(msg.to_dict())
+        assert decoded.error == "decode failed"
+        assert decoded.tokens == []
+
+
+# ---------------------------------------------------------------------------
+# ASRWorker: ack / poison isolation
+# ---------------------------------------------------------------------------
+
+def _make_worker(pipeline, provider=None, **cfg_kw):
+    from distributed_crawler_tpu.media.worker import (
+        ASRWorker,
+        ASRWorkerConfig,
+    )
+
+    bus = InMemoryBus(sync=True)
+    worker = ASRWorker(bus, pipeline,
+                       provider=provider or InMemoryStorageProvider(),
+                       cfg=ASRWorkerConfig(worker_id="asr-t",
+                                           heartbeat_s=60.0, **cfg_kw),
+                       registry=MetricsRegistry())
+    return bus, worker
+
+
+class TestASRWorkerIsolation:
+    def _batch(self, tmp_path, media_ids, seconds=0.1, crawl="c1"):
+        refs = []
+        for i, m in enumerate(media_ids):
+            p = _write_wav(tmp_path / f"{m}.wav", seconds,
+                           freq=300.0 + i * 50)
+            refs.append(AudioRef(media_id=m, path=p, channel_name="ch"))
+        return AudioBatchMessage.new(refs, crawl_id=crawl)
+
+    def test_batch_acked_after_writeback(self, asr_pipeline, tmp_path):
+        from distributed_crawler_tpu.media.worker import iter_transcripts
+
+        provider = InMemoryStorageProvider()
+        bus, worker = _make_worker(asr_pipeline, provider)
+        worker.start()
+        try:
+            acks = []
+            msg = self._batch(tmp_path, ["a", "b"])
+            worker._handle_payload(msg.to_dict(), acks.append)
+            assert worker.drain(timeout_s=30)
+            assert acks == [True]
+            rows = list(iter_transcripts(provider, "c1"))
+            assert {r["media_id"] for r in rows} == {"a", "b"}
+            assert all(r["post_uid"] == f"media:{r['media_id']}"
+                       for r in rows)
+        finally:
+            worker.stop(timeout_s=5)
+            bus.close()
+
+    def test_bad_file_is_error_row_not_batch_failure(self, asr_pipeline,
+                                                     tmp_path):
+        from distributed_crawler_tpu.media.worker import iter_transcripts
+
+        provider = InMemoryStorageProvider()
+        bus, worker = _make_worker(asr_pipeline, provider)
+        worker.start()
+        try:
+            good = _write_wav(tmp_path / "good.wav", 0.1)
+            msg = AudioBatchMessage.new(
+                [AudioRef(media_id="ok", path=good),
+                 AudioRef(media_id="broken",
+                          path=str(tmp_path / "missing.wav"))],
+                crawl_id="c1")
+            acks = []
+            worker._handle_payload(msg.to_dict(), acks.append)
+            assert worker.drain(timeout_s=30)
+            assert acks == [True]  # the batch still commits
+            rows = {r["media_id"]: r
+                    for r in iter_transcripts(provider, "c1")}
+            assert rows["ok"]["error"] == "" and rows["ok"]["windows"] == 1
+            assert rows["broken"]["error"]  # explicit failure row
+        finally:
+            worker.stop(timeout_s=5)
+            bus.close()
+
+    def test_undecodable_payload_nacked(self, asr_pipeline):
+        bus, worker = _make_worker(asr_pipeline)
+        # No threads needed: the handler path is synchronous.
+        acks = []
+        worker._handle_payload({"message_type": "audio_batch",
+                                "refs": "garbage"}, acks.append)
+        # Unparseable refs decode to an empty batch -> trivially acked.
+        assert acks == [True]
+        acks.clear()
+        worker._handle_payload(
+            {"refs": [{"media_id": "m", "path": "/a",
+                       "duration_s": "not-a-float"}], "batch_id": "b"},
+            acks.append)
+        # A ref field of the wrong type raises inside from_dict -> nack.
+        assert acks == [False]
+        bus.close()
+
+    def test_device_failure_nacks_only_that_batch(self, asr_pipeline,
+                                                  tmp_path, monkeypatch):
+        provider = InMemoryStorageProvider()
+        bus, worker = _make_worker(asr_pipeline, provider)
+        # No feed thread: drive _process_group directly for determinism.
+        good = self._batch(tmp_path, ["g1"])
+        bad = self._batch(tmp_path, ["g2"])
+        calls = {"n": 0}
+        real = asr_pipeline.transcribe_plan
+
+        def flaky(plan):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("wedged")
+            return real(plan)
+
+        monkeypatch.setattr(worker.pipeline, "transcribe_plan", flaky,
+                            raising=False)
+        acks_good, acks_bad = [], []
+        worker._process_group([
+            (AudioBatchMessage.from_dict(good.to_dict()),
+             acks_good.append, time.monotonic()),
+            (AudioBatchMessage.from_dict(bad.to_dict()),
+             acks_bad.append, time.monotonic()),
+        ])
+        # The combined step failed once; per-batch isolation re-ran each
+        # batch alone, so both eventually commit.
+        assert acks_good == [True] and acks_bad == [True]
+        monkeypatch.undo()
+        bus.close()
+
+    def test_kill_records_flight_and_halts(self, asr_pipeline):
+        from distributed_crawler_tpu.utils import flight
+
+        flight.configure(capacity=128)
+        bus, worker = _make_worker(asr_pipeline)
+        worker.start()
+        worker.kill()
+        kinds = [e for e in flight.RECORDER.events()
+                 if e.get("kind") == "worker_kill"
+                 and e.get("worker") == "asr-t"]
+        assert kinds
+        assert not worker._threads
+        bus.close()
+
+    def test_evaluate_slos_counts_breach(self, asr_pipeline, tmp_path):
+        trace.configure(capacity=2048)
+        registry = MetricsRegistry()
+        from distributed_crawler_tpu.media.worker import (
+            ASRWorker,
+            ASRWorkerConfig,
+        )
+
+        bus = InMemoryBus(sync=True)
+        worker = ASRWorker(bus, asr_pipeline,
+                           provider=InMemoryStorageProvider(),
+                           cfg=ASRWorkerConfig(
+                               worker_id="asr-slo", heartbeat_s=60.0,
+                               slo_asr_batch_p95_ms=0.0001),
+                           registry=registry)
+        worker.evaluate_slos()  # flush the window
+        msg = self._batch(tmp_path, ["s1"])
+        acks = []
+        worker._process_group([(msg, acks.append, time.monotonic())])
+        assert acks == [True]
+        breaches = worker.evaluate_slos()
+        assert any(b["slo"] == "asr_batch" for b in breaches)
+        bus.close()
+
+
+# ---------------------------------------------------------------------------
+# E2E: wav -> media bridge -> ASR worker -> transcript -> embedding
+# ---------------------------------------------------------------------------
+
+class TestEndToEnd:
+    def test_wav_to_embedding_with_one_trace(self, asr_pipeline, tmp_path):
+        from distributed_crawler_tpu.inference.bridge import InferenceBridge
+        from distributed_crawler_tpu.inference.engine import (
+            EngineConfig,
+            InferenceEngine,
+        )
+        from distributed_crawler_tpu.inference.worker import (
+            TPUWorker,
+            TPUWorkerConfig,
+            iter_results,
+        )
+        from distributed_crawler_tpu.media import (
+            ASRWorker,
+            ASRWorkerConfig,
+            MediaBridge,
+            TranscriptReentry,
+        )
+        from distributed_crawler_tpu.media.worker import iter_transcripts
+
+        trace.configure(capacity=8192)
+        registry = MetricsRegistry()
+
+        class NullSM:
+            def store_post(self, cid, post):
+                pass
+
+            def close(self):
+                pass
+
+        bus = InMemoryBus(sync=True)
+        provider = InMemoryStorageProvider()
+        engine = InferenceEngine(
+            EngineConfig(model="tiny", n_labels=2, batch_size=4,
+                         buckets=(32,)), registry=registry)
+        tpu = TPUWorker(bus, engine, provider=provider,
+                        cfg=TPUWorkerConfig(worker_id="tpu-e2e",
+                                            heartbeat_s=60.0,
+                                            stall_warn_s=0.0),
+                        registry=registry)
+        tpu.start()
+        asr = ASRWorker(bus, asr_pipeline, provider=provider,
+                        cfg=ASRWorkerConfig(worker_id="asr-e2e",
+                                            heartbeat_s=60.0),
+                        registry=registry)
+        asr.start()
+        ibridge = InferenceBridge(NullSM(), bus, crawl_id="e2e",
+                                  batch_size=4, deadline_s=0.05)
+        reentry = TranscriptReentry(ibridge, bus)
+        mbridge = MediaBridge(NullSM(), bus, crawl_id="e2e",
+                              batch_size=2, deadline_s=0.05)
+        transcripts = []
+        bus.subscribe(TOPIC_TRANSCRIPTS,
+                      lambda p: transcripts.append(p))
+        try:
+            # Long enough for 2 windows (window = 0.32 s in WHISPER_TEST).
+            wav_a = _write_wav(tmp_path / "va.wav", 0.5)
+            wav_b = _write_wav(tmp_path / "vb.wav", 0.2, freq=880.0)
+            mbridge.notify_media_stored("med-a", wav_a,
+                                        channel_name="chan")
+            mbridge.notify_media_stored("med-b", wav_b,
+                                        channel_name="chan")
+            # Re-delivery of the same media id must dedupe at the bridge.
+            mbridge.notify_media_stored("med-a", wav_a,
+                                        channel_name="chan")
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                asr.drain(timeout_s=5)
+                ibridge.flush()
+                tpu.drain(timeout_s=5)
+                done = {r["post_uid"]
+                        for r in iter_results(provider, "e2e")}
+                if {"media:med-a", "media:med-b"} <= done:
+                    break
+                time.sleep(0.05)
+
+            rows = {r["media_id"]: r
+                    for r in iter_transcripts(provider, "e2e")}
+            assert set(rows) == {"med-a", "med-b"}
+            assert rows["med-a"]["windows"] == 2  # windowed, not truncated
+            assert rows["med-b"]["windows"] == 1
+            embedded = {r["post_uid"]: r
+                        for r in iter_results(provider, "e2e")}
+            assert {"media:med-a", "media:med-b"} <= set(embedded)
+            assert "embedding" in embedded["media:med-a"]
+            assert mbridge.refs_deduped == 1
+
+            # ONE trace follows the batch across hops: the audio batch's
+            # trace_id appears on the crawl-side dispatch, the worker's
+            # queue-wait/process/commit, the transcript envelope, and the
+            # re-entry span.
+            assert transcripts
+            t0 = TranscriptMessage.from_dict(transcripts[0])
+            span_names = {s.name for s in trace.TRACER.spans()
+                          if s.trace_id == t0.trace_id}
+            assert "media.dispatch" in span_names
+            assert "asr_worker.queue_wait" in span_names
+            assert {"asr_worker.process",
+                    "asr_worker.coalesce"} & span_names
+            assert "asr_worker.commit" in span_names
+            assert "media.reentry" in span_names
+        finally:
+            asr.stop(timeout_s=5)
+            tpu.stop(timeout_s=5)
+            mbridge.close()
+            ibridge.close()
+            bus.close()
+
+
+# ---------------------------------------------------------------------------
+# Loadgen integration: scenarios parse; audio workload is deterministic
+# ---------------------------------------------------------------------------
+
+class TestLoadgenAsr:
+    def test_checked_in_asr_scenarios_parse(self):
+        from distributed_crawler_tpu import loadgen
+
+        names = loadgen.scenario_names()
+        assert "asr-steady" in names and "kill-asr-worker" in names
+        for name in ("asr-steady", "kill-asr-worker"):
+            sc = loadgen.load_scenario(name)
+            assert sc.get("kind") == "asr"
+            loadgen.parse_timeline(sc.get("chaos", []))
+            cfg = loadgen.AudioLoadConfig(**sc.get("audio_load", {}))
+            cfg.validate()
+            assert loadgen.AudioWorkload(cfg, "/nonexistent").plan()
+
+    def test_audio_workload_deterministic(self, tmp_path):
+        from distributed_crawler_tpu.loadgen import (
+            AudioLoadConfig,
+            AudioWorkload,
+        )
+
+        cfg = AudioLoadConfig(seed=3, duration_s=2.0,
+                              rate_batches_per_s=5, refs_per_batch=2)
+        w1 = AudioWorkload(cfg, str(tmp_path / "a"))
+        w2 = AudioWorkload(AudioLoadConfig(seed=3, duration_s=2.0,
+                                           rate_batches_per_s=5,
+                                           refs_per_batch=2),
+                           str(tmp_path / "b"))
+        assert w1.plan() == w2.plan()
+        assert w1.materialize() == w2.materialize()
+        a = sorted(os.listdir(tmp_path / "a"))
+        b = sorted(os.listdir(tmp_path / "b"))
+        assert a == b and a
+        for name in a[:3]:
+            with open(tmp_path / "a" / name, "rb") as fa, \
+                    open(tmp_path / "b" / name, "rb") as fb:
+                assert fa.read() == fb.read()
+
+    def test_media_bridge_requeues_on_publish_failure(self, tmp_path):
+        """A failed audio-batch publish must requeue the refs (the ids
+        are already dedupe-marked and cache-marked — dropping them would
+        be permanent loss)."""
+        from distributed_crawler_tpu.media.bridge import MediaBridge
+
+        class FlakyBus:
+            def __init__(self):
+                self.fail = True
+                self.published = []
+
+            def publish(self, topic, payload):
+                if self.fail:
+                    raise RuntimeError("bus down")
+                self.published.append(payload)
+
+        class NullSM:
+            def close(self):
+                pass
+
+        bus = FlakyBus()
+        bridge = MediaBridge(NullSM(), bus, crawl_id="c",
+                             batch_size=1, deadline_s=0.01,
+                             poll_interval_s=0.01)
+        try:
+            wav = _write_wav(tmp_path / "r.wav", 0.05)
+            bridge.notify_media_stored("rq1", wav)
+            deadline = time.monotonic() + 5
+            while bridge.publish_failures == 0 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert bridge.publish_failures > 0
+            assert not bus.published
+            bus.fail = False  # outage clears; backoff retry must ship it
+            deadline = time.monotonic() + 5
+            while not bus.published and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert bus.published
+            assert bus.published[0]["refs"][0]["media_id"] == "rq1"
+            # The dedupe window still holds: the ref shipped exactly once.
+            bridge.notify_media_stored("rq1", wav)
+            time.sleep(0.1)
+            assert sum(len(p["refs"]) for p in bus.published) == 1
+        finally:
+            bridge._sm = NullSM()
+            bridge.close()
+
+    def test_asr_handle_restart_retires_previous_generation(
+            self, asr_pipeline):
+        """A bare `restart` timeline line must not leave two live worker
+        generations competing for frames."""
+        from distributed_crawler_tpu.loadgen.gate import ASRWorkerHandle
+
+        bus = InMemoryBus(sync=True)
+        handle = ASRWorkerHandle("asr-r", lambda: bus, asr_pipeline,
+                                 InMemoryStorageProvider(),
+                                 {"heartbeat_s": 60.0},
+                                 MetricsRegistry())
+        try:
+            handle.start()
+            gen1 = handle.worker
+            handle.restart()  # no preceding kill
+            assert handle.generation == 2
+            assert handle.worker is not gen1
+            # gen-1 was retired: its stop flag is set and threads joined.
+            assert gen1._stop.is_set()
+            assert not gen1._threads
+        finally:
+            handle.stop()
+            bus.close()
+
+    def test_chaos_bus_ledgers_media_ids(self):
+        from distributed_crawler_tpu.loadgen import ChaosBus
+
+        class Sink:
+            def __init__(self):
+                self.published = []
+
+            def publish(self, topic, payload):
+                self.published.append((topic, payload))
+
+        sink = Sink()
+        cb = ChaosBus(sink)
+        msg = AudioBatchMessage.new(
+            [AudioRef(media_id="x1", path="/x.wav"),
+             AudioRef(media_id="x2", path="/y.wav")])
+        cb.publish(TOPIC_MEDIA_BATCHES, msg.to_dict())
+        assert set(cb.expected_uids()) == {"x1", "x2"}
+        # Poison replaces refs with undecodables and excludes the ids.
+        cb.poison_next()
+        msg2 = AudioBatchMessage.new(
+            [AudioRef(media_id="x3", path="/z.wav")])
+        cb.publish(TOPIC_MEDIA_BATCHES, msg2.to_dict())
+        assert "x3" not in set(cb.expected_uids())
+        _, poisoned = sink.published[-1]
+        assert poisoned["refs"] == [None]

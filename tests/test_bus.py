@@ -112,6 +112,12 @@ class TestMessageRegistry:
     def test_every_registered_type_roundtrips(self):
         from distributed_crawler_tpu.bus import MESSAGE_REGISTRY, decode_message
 
+        from distributed_crawler_tpu.bus.messages import (
+            AudioBatchMessage,
+            AudioRef,
+            TranscriptMessage,
+        )
+
         samples = {
             WorkQueueMessage: WorkQueueMessage.new(
                 WorkItem.new("u", 0, "", "c", "telegram", WorkItemConfig())),
@@ -122,6 +128,12 @@ class TestMessageRegistry:
             ControlMessage: ControlMessage(message_type="pause",
                                            trace_id="trace_x"),
             ChaosMessage: ChaosMessage.new("kill", "tpu-1", at_s=1.5),
+            AudioBatchMessage: AudioBatchMessage.new(
+                [AudioRef(media_id="m1", path="/a.wav",
+                          channel_name="chan")], crawl_id="c1"),
+            TranscriptMessage: TranscriptMessage.new(
+                "m1", crawl_id="c1", batch_id="b1", text="hi",
+                tokens=[1, 2], windows=1),
         }
         assert set(MESSAGE_REGISTRY.values()) == set(samples)
         for cls, msg in samples.items():

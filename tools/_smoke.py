@@ -74,7 +74,10 @@ def selfcheck() -> int:
          os.path.join(repo, "tests", "test_resilience.py"),
          # test_loadgen includes the kill-orchestrator gate acceptance
          # (the crash-recovery closure) alongside kill-worker.
-         os.path.join(repo, "tests", "test_loadgen.py")],
+         os.path.join(repo, "tests", "test_loadgen.py"),
+         # media/: chunker scheduling, ASRWorker isolation, and the
+         # wav -> transcript -> embedding e2e (the ASR serving loop).
+         os.path.join(repo, "tests", "test_asr_serve.py")],
         env=env, cwd=repo)
 
 

@@ -169,10 +169,17 @@ def main(argv=None) -> int:
         if args.smoke:
             # Validate every checked-in scenario parses end to end —
             # load config, chaos timeline, a deterministic plan — without
-            # running any traffic.
+            # running any traffic.  ASR scenarios ("kind": "asr")
+            # validate their audio_load block + plan instead.
             for scenario_name in loadgen.scenario_names():
                 sc = loadgen.load_scenario(scenario_name)
                 loadgen.parse_timeline(sc.get("chaos", []))
+                if sc.get("kind") == "asr":
+                    acfg = loadgen.AudioLoadConfig(
+                        **sc.get("audio_load", {}))
+                    acfg.validate()
+                    assert loadgen.AudioWorkload(acfg, "/nonexistent").plan()
+                    continue
                 cfg = loadgen.LoadGenConfig(**sc.get("load", {}))
                 cfg.validate()
                 assert loadgen.SyntheticWorkload(cfg).plan()
