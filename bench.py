@@ -323,6 +323,9 @@ def _measure(scale_devices: int | None = None,
     # as opposed to the chained pure-device number above.  Best-effort.
     serving_pps = None
     serving_e2e_pps = None
+    serving_busy = None
+    serving_overlap = None
+    serving_bubble_ms = None
     if with_serving:
         try:
             from distributed_crawler_tpu.inference.engine import (
@@ -339,6 +342,7 @@ def _measure(scale_devices: int | None = None,
                 mesh=mesh, params=params, registry=MetricsRegistry())
             toks = [[7] * (seq - 2)] * (batch * 8)
             eng.run_tokenized(toks[:batch])  # compile+warm
+            eng.timeline.reset()  # compile interval isn't pipeline signal
             t0 = time.perf_counter()
             out = eng.run_tokenized(toks)
             dt = time.perf_counter() - t0
@@ -360,6 +364,17 @@ def _measure(scale_devices: int | None = None,
             assert len(out) == len(texts)
             serving_e2e_pps = len(texts) / dt
             _log(f"serving e2e (text in): {serving_e2e_pps:.1f} posts/sec")
+            # Pipeline-efficiency rows from the engine's DeviceTimeline
+            # (utils/occupancy.py): how busy the device envelope was over
+            # the serving runs, how much host/device overlap the one-deep
+            # pipeline achieved, and the bubble cost per batch — the
+            # numbers the continuous-batching rebuild must move.
+            occ = eng.timeline.snapshot() or {}
+            serving_busy = occ.get("busy_fraction")
+            serving_overlap = occ.get("overlap_fraction")
+            serving_bubble_ms = occ.get("bubble_ms_per_batch")
+            _log(f"pipeline: busy={serving_busy} overlap={serving_overlap}"
+                 f" bubble_ms_per_batch={serving_bubble_ms}")
         except Exception as exc:  # noqa: BLE001 — best-effort row
             _log(f"serving-path measurement skipped: {exc}")
 
@@ -417,6 +432,12 @@ def _measure(scale_devices: int | None = None,
         if serving_e2e_pps else None,
         "serving_posts_per_sec": round(serving_pps, 1) if serving_pps
         else None,
+        "device_busy_fraction": round(serving_busy, 6)
+        if serving_busy is not None else None,
+        "overlap_fraction": round(serving_overlap, 6)
+        if serving_overlap is not None else None,
+        "bubble_ms_per_batch": round(serving_bubble_ms, 4)
+        if serving_bubble_ms is not None else None,
         "platform": jax.default_backend(),
         "device_kind": jax.devices()[0].device_kind,
         "n_devices": use_dev,

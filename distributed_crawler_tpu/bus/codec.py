@@ -42,6 +42,7 @@ from .messages import (
     MSG_PAUSE,
     MSG_POISON_PILL,
     MSG_RESUME,
+    MSG_SPAN_BATCH,
     MSG_STOP,
     MSG_TRANSCRIPT,
     MSG_WORK_ITEM,
@@ -52,6 +53,7 @@ from .messages import (
     ChaosMessage,
     ControlMessage,
     ResultMessage,
+    SpanBatchMessage,
     StatusMessage,
     TranscriptMessage,
     WorkQueueMessage,
@@ -144,6 +146,7 @@ MESSAGE_REGISTRY: Dict[str, type] = {
     MSG_CHAOS_FAULT: ChaosMessage,
     MSG_AUDIO_BATCH: AudioBatchMessage,
     MSG_TRANSCRIPT: TranscriptMessage,
+    MSG_SPAN_BATCH: SpanBatchMessage,
 }
 
 

@@ -39,6 +39,10 @@ MSG_CHAOS_FAULT = "chaos_fault"
 # Whisper worker, and the transcripts it sends back.
 MSG_AUDIO_BATCH = "audio_batch"
 MSG_TRANSCRIPT = "transcript"
+# Distributed tracing (`utils/trace.py` SpanExporter -> the
+# orchestrator's TraceCollector): a bounded batch of completed spans one
+# worker ships so cross-process traces can be assembled at /dtraces.
+MSG_SPAN_BATCH = "span_batch"
 
 # --- status values (`messages.go:32-43`) -----------------------------------
 STATUS_SUCCESS = "success"
@@ -79,6 +83,10 @@ TOPIC_CHAOS = "chaos-commands"
 # (fan-out: the re-entry hop and any observer subscribe).
 TOPIC_MEDIA_BATCHES = "tpu-media-batches"
 TOPIC_TRANSCRIPTS = "tpu-transcripts"
+# Span export (`SpanBatchMessage`): fan-out like worker-status — the
+# orchestrator's TraceCollector subscribes; a missed batch degrades one
+# trace's completeness, never correctness, so no pull/ack machinery.
+TOPIC_SPANS = "tpu-spans"
 
 VALID_PLATFORMS = ("telegram", "youtube")
 
@@ -104,7 +112,7 @@ def pubsub_topics() -> List[str]:
     return [TOPIC_WORK_QUEUE, TOPIC_RESULTS, TOPIC_WORKER_STATUS,
             TOPIC_ORCHESTRATOR, TOPIC_INFERENCE_BATCHES,
             TOPIC_INFERENCE_RESULTS, TOPIC_JOBS, TOPIC_CHAOS,
-            TOPIC_MEDIA_BATCHES, TOPIC_TRANSCRIPTS]
+            TOPIC_MEDIA_BATCHES, TOPIC_TRANSCRIPTS, TOPIC_SPANS]
 
 
 def _opt_time(value: Any) -> Optional[str]:
@@ -808,6 +816,83 @@ class TranscriptMessage:
             windows=int(d.get("windows") or 0),
             duration_s=float(d.get("duration_s") or 0.0),
             error=d.get("error", "") or "",
+            timestamp=parse_time(d.get("timestamp")),
+            trace_id=d.get("trace_id", "") or "",
+        )
+
+
+# --- distributed tracing (`utils/trace.py` -> orchestrator) -----------------
+
+@dataclass
+class SpanBatchMessage:
+    """A bounded batch of completed spans on ``TOPIC_SPANS``.
+
+    ``spans`` carries `utils.trace.Span.to_dict()` rows (name, trace_id,
+    span_id, parent_id, start_wall, duration_ms, attrs) — every
+    ``start_wall`` is on the SENDER's wall clock; the collector corrects
+    it with the per-worker offset estimated from heartbeat send/receive
+    walls (``sent_wall`` here is the publish-side fallback estimator for
+    workers that have not heartbeated yet).  ``dropped`` counts spans
+    NOT shipped since the previous batch (ring eviction, sampling, the
+    per-batch bound), so assembled traces can say how lossy they are.
+
+    The envelope's own ``trace_id`` exists for registry uniformity (the
+    crawlint BUS checker's contract); span batches are telemetry about
+    traces, they do not participate in one.
+    """
+
+    message_type: str = MSG_SPAN_BATCH
+    worker_id: str = ""
+    sent_wall: float = 0.0              # sender epoch at publish
+    spans: List[Dict[str, Any]] = field(default_factory=list)
+    dropped: int = 0
+    timestamp: Optional[datetime] = None
+    trace_id: str = ""
+
+    @classmethod
+    def new(cls, worker_id: str, spans: List[Dict[str, Any]],
+            dropped: int = 0) -> "SpanBatchMessage":
+        import time as _time
+
+        return cls(worker_id=worker_id, sent_wall=_time.time(),
+                   spans=list(spans), dropped=int(dropped),
+                   timestamp=utcnow(), trace_id=new_trace_id())
+
+    def validate(self) -> None:
+        if self.message_type != MSG_SPAN_BATCH:
+            raise ValueError(
+                f"invalid span batch message type: {self.message_type}")
+        if not self.worker_id:
+            raise ValueError("span batch worker_id cannot be empty")
+        for s in self.spans:
+            if not isinstance(s, dict) or not s.get("name") \
+                    or not s.get("trace_id"):
+                raise ValueError(
+                    "span batch rows need at least name + trace_id")
+
+    def __len__(self) -> int:
+        return len(self.spans)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "message_type": self.message_type,
+            "worker_id": self.worker_id,
+            "sent_wall": self.sent_wall,
+            "spans": self.spans,
+            "dropped": self.dropped,
+            "timestamp": _opt_time(self.timestamp),
+            "trace_id": self.trace_id,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SpanBatchMessage":
+        return cls(
+            message_type=d.get("message_type", MSG_SPAN_BATCH),
+            worker_id=d.get("worker_id", "") or "",
+            sent_wall=float(d.get("sent_wall") or 0.0),
+            spans=[s for s in (d.get("spans") or [])
+                   if isinstance(s, dict)],
+            dropped=int(d.get("dropped") or 0),
             timestamp=parse_time(d.get("timestamp")),
             trace_id=d.get("trace_id", "") or "",
         )

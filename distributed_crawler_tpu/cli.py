@@ -170,6 +170,18 @@ def build_parser() -> argparse.ArgumentParser:
            "a device batch exceeds this many ms (one capture at a time; "
            "0 = off); /profile?seconds=N on the metrics port does the "
            "same on demand")
+    a("--span-export-interval", type=float, default=None,
+      help="seconds between span exports from the serving workers to the "
+           "orchestrator's distributed-trace collector (SpanBatchMessage "
+           "on the spans topic -> /dtraces; 0 disables export, default "
+           "15)")
+    a("--span-export-max-spans", type=int, default=None,
+      help="max spans shipped per export batch (excess newest-kept, "
+           "counted as dropped; default 512)")
+    a("--span-sample-rate", type=float, default=None,
+      help="fraction of TRACES whose spans are exported (stable per-"
+           "trace hash, so every process ships the same subset and "
+           "cross-process traces stay complete; default 1.0)")
     # Load harness (`python -m tools.loadtest`; loadgen/).  These keys
     # configure the synthetic workload + SLO gate; the crawl/worker modes
     # ignore them, but they resolve through the same precedence chain so
@@ -432,6 +444,9 @@ _KEY_MAP = {
     "slo_queue_wait_ms": "observability.slo_queue_wait_ms",
     "slo_batch_age_ms": "observability.slo_batch_age_ms",
     "profile_on_slow_ms": "observability.profile_on_slow_ms",
+    "span_export_interval": "observability.span_export_interval_s",
+    "span_export_max_spans": "observability.span_export_max_spans",
+    "span_sample_rate": "observability.span_sample_rate",
     "loadgen_scenario": "loadgen.scenario",
     "loadgen_seed": "loadgen.seed",
     "loadgen_duration_s": "loadgen.duration_s",
@@ -1100,9 +1115,14 @@ def _run_orchestrator(urls: List[str], cfg: CrawlerConfig,
                               "orch-journal"))
     orch = Orchestrator(cfg.crawl_id, cfg, bus, sm, ocfg=ocfg,
                         journal=CrawlJournal(journal_dir))
-    from .utils.metrics import set_cluster_provider, set_status_provider
+    from .utils.metrics import (
+        set_cluster_provider,
+        set_dtraces_provider,
+        set_status_provider,
+    )
     set_status_provider(orch.get_status)  # /status (`orchestrator.go:596`)
     set_cluster_provider(orch.get_cluster)  # /cluster fleet view
+    set_dtraces_provider(orch.get_dtraces)  # /dtraces distributed traces
     orch.start(urls, fresh=r.get_bool("orchestrator.fresh", False))
     try:
         _serve_forever(
@@ -1689,7 +1709,13 @@ def _build_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver):
                          slo_batch_age_ms=r.get_float(
                              "observability.slo_batch_age_ms", 0.0),
                          profile_on_slow_ms=r.get_float(
-                             "observability.profile_on_slow_ms", 0.0)))
+                             "observability.profile_on_slow_ms", 0.0),
+                         span_export_interval_s=r.get_float(
+                             "observability.span_export_interval_s", 15.0),
+                         span_export_max_spans=r.get_int(
+                             "observability.span_export_max_spans", 512),
+                         span_sample_rate=r.get_float(
+                             "observability.span_sample_rate", 1.0)))
 
 
 def _build_asr_worker(cfg: CrawlerConfig, r: ConfigResolver):
@@ -1738,7 +1764,14 @@ def _build_asr_worker(cfg: CrawlerConfig, r: ConfigResolver):
                            slo_queue_wait_ms=r.get_float(
                                "observability.slo_queue_wait_ms", 0.0),
                            slo_batch_age_ms=r.get_float(
-                               "observability.slo_batch_age_ms", 0.0)))
+                               "observability.slo_batch_age_ms", 0.0),
+                           span_export_interval_s=r.get_float(
+                               "observability.span_export_interval_s",
+                               15.0),
+                           span_export_max_spans=r.get_int(
+                               "observability.span_export_max_spans", 512),
+                           span_sample_rate=r.get_float(
+                               "observability.span_sample_rate", 1.0)))
     reentry_closer = None
     if cfg.inference.enabled:
         # Close the loop in-process: transcripts re-enter the text

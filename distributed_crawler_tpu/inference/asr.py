@@ -42,6 +42,7 @@ import numpy as np
 from ..utils import trace
 from ..utils.costmodel import CostModel, EfficiencyMeter, whisper_forward_flops
 from ..utils.metrics import REGISTRY, MetricsRegistry
+from ..utils.occupancy import DeviceTimeline
 
 logger = logging.getLogger("dct.inference.asr")
 
@@ -173,6 +174,13 @@ class ASRPipeline:
         # text engine; ASR rows are distinguished by path="asr" labels).
         self.costs = CostModel(registry=registry)
         self.meter = EfficiencyMeter(registry=registry)
+        # Device-occupancy accounting (`utils/occupancy.py`): the ASR
+        # dispatch is synchronous (tokens materialize in the same call),
+        # so overlap stays 0 by construction — the busy-fraction and
+        # bubble numbers are what say whether the decode loop kept the
+        # chip fed between bucketed batches.  The ASR worker's feed loop
+        # marks queue-empty via start_stream(), same as the text worker.
+        self.timeline = DeviceTimeline(registry=registry, path="asr")
         self.m_windows = registry.counter(
             "asr_windows_total", "30 s audio windows through Whisper")
         self.m_pad_windows = registry.counter(
@@ -218,6 +226,8 @@ class ASRPipeline:
         with trace.span("asr.transcribe", bucket=bucket, windows=real):
             tokens = np.asarray(self._transcribe(self.params, placed))
         dt = time.perf_counter() - t0
+        if record:  # warmup compiles must not score as busy time
+            self.timeline.record(t0, t0 + dt)
         self._account(bucket, placed, dt, real, record)
         return tokens
 
@@ -303,6 +313,11 @@ class ASRPipeline:
     def efficiency_snapshot(self) -> Dict[str, Any]:
         return self.meter.snapshot()
 
+    def occupancy_snapshot(self) -> Dict[str, Any]:
+        """Telemetry-heartbeat twin of the engine's; also refreshes the
+        path="asr" busy/overlap gauges."""
+        return self.timeline.snapshot()
+
     def cost_snapshot(self) -> Dict[str, Any]:
         """The ASR worker's /costs body core: Whisper program rows +
         the rolling efficiency window."""
@@ -314,4 +329,5 @@ class ASRPipeline:
             "decode_len": self.max_len,
             "costs": self.costs.snapshot(),
             "efficiency": self.meter.snapshot(),
+            "occupancy": self.timeline.snapshot(),
         }

@@ -39,6 +39,7 @@ from ..utils.costmodel import (
     encoder_forward_flops,
 )
 from ..utils.metrics import REGISTRY, MetricsRegistry
+from ..utils.occupancy import DeviceTimeline
 from .tokenizer import HashingTokenizer, Tokenizer
 
 MODEL_REGISTRY: Dict[str, EncoderConfig] = {
@@ -223,6 +224,18 @@ class InferenceEngine:
         # telemetry heartbeats into the orchestrator's /cluster view.
         self.costs = CostModel(registry=registry)
         self.meter = EfficiencyMeter(registry=registry)
+        # Device-occupancy accounting (`utils/occupancy.py`): one interval
+        # per device batch, [async dispatch, readback-on-host] — the
+        # host-observable envelope of device busy time.  Derives the
+        # busy-fraction / overlap-fraction gauges and the pipeline-bubble
+        # counter the host spans cannot express (the one-deep pipeline
+        # makes every host window contain the NEXT batch's pack).  Within
+        # one run_tokenized call readback i structurally outlasts dispatch
+        # i+1, so bubbles only open BETWEEN calls — the serial
+        # tokenize→dispatch→wait gap per coalesce group; the worker's feed
+        # loop calls `timeline.start_stream()` whenever its queue ran dry
+        # so idle-by-no-work never scores as a bubble.
+        self.timeline = DeviceTimeline(registry=registry, path="text")
 
         if params is None:
             import jax.numpy as jnp
@@ -385,12 +398,19 @@ class InferenceEngine:
             "buckets": list(self.bucket_spec.lengths),
             "costs": self.costs.snapshot(),
             "efficiency": self.meter.snapshot(),
+            "occupancy": self.timeline.snapshot(),
         }
 
     def efficiency_snapshot(self) -> Dict[str, Any]:
         """Rolling MFU/goodput map for telemetry heartbeats
         (`utils/telemetry.py`); {} until the first batch lands."""
         return self.meter.snapshot()
+
+    def occupancy_snapshot(self) -> Dict[str, Any]:
+        """Device-occupancy map for telemetry heartbeats — ALSO the
+        refresh driving the busy/overlap gauges between /costs scrapes
+        (record() stays O(1) on the serving path by design)."""
+        return self.timeline.snapshot()
 
     def _place(self, ids: np.ndarray, mask: np.ndarray, *extra: np.ndarray):
         import jax.numpy as jnp
@@ -458,6 +478,7 @@ class InferenceEngine:
                 # batch's host-side pack+dispatch (which overlapped this
                 # batch's device time) — see the metric's help text.
                 dt = time.perf_counter() - t0
+                self.timeline.record(t0, t0 + dt)
                 self.m_latency.observe(dt)
                 self.meter.record(dt, self._batch_flops(bucket, "unpacked"),
                                   real_tokens, bs * bucket)
@@ -547,6 +568,7 @@ class InferenceEngine:
                 emb_np = np.asarray(emb)        # device->host sync
                 logits_np = np.asarray(logits)  # [bs, S, n_labels]
                 dt = time.perf_counter() - t0
+                self.timeline.record(t0, t0 + dt)
                 self.m_latency.observe(dt)
                 self.meter.record(dt, self._batch_flops(bucket, "packed"),
                                   real_tokens, bs * bucket)
@@ -635,6 +657,9 @@ class InferenceEngine:
                     else [[1] * (b - 1)])
             for m in modes:
                 self.run_tokenized(toks, pack=m)
+        # Warmup intervals are compile-dominated: drop them so the
+        # occupancy window starts clean for live serving.
+        self.timeline.reset()
 
 
 def _load_pretrained(cfg: EngineConfig, params, tokenizer):

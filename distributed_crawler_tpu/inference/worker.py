@@ -27,12 +27,15 @@ from ..bus.messages import (
     MSG_HEARTBEAT,
     TOPIC_INFERENCE_BATCHES,
     TOPIC_INFERENCE_RESULTS,
+    TOPIC_SPANS,
     TOPIC_WORKER_STATUS,
+    SpanBatchMessage,
     StatusMessage,
     WORKER_BUSY,
     WORKER_IDLE,
 )
 from ..utils import flight, profiling, trace
+from ..utils.occupancy import QueueDepthSampler
 from ..utils.metrics import (
     REGISTRY,
     MetricsRegistry,
@@ -109,6 +112,15 @@ class TPUWorkerConfig:
     # triggers one bounded jax.profiler capture to --dump-dir (one at a
     # time; `utils/profiling.py`).  0 = off.
     profile_on_slow_ms: float = 0.0
+    # Span export (`utils/trace.py:SpanExporter` -> SpanBatchMessage on
+    # TOPIC_SPANS): completed spans periodically ship to the
+    # orchestrator's TraceCollector so /dtraces can assemble one
+    # distributed trace per work item.  0 = never ship (local /traces
+    # still works).  The per-batch bound and the whole-trace sample rate
+    # keep a hot worker's export traffic flat.
+    span_export_interval_s: float = 15.0
+    span_export_max_spans: int = 512
+    span_sample_rate: float = 1.0
 
 
 class TPUWorker:
@@ -147,7 +159,13 @@ class TPUWorker:
         self._watchdog_started = False
         self._exit_fn = None          # test seam; None -> os._exit
         self.m_queue_depth = registry.gauge(
-            "tpu_worker_queue_depth", "decoded batches awaiting device")
+            "tpu_worker_queue_depth",
+            "decoded batches awaiting device (time-weighted rolling mean "
+            "— an edge-triggered gauge aliases between scrapes)")
+        # Time-weighted sampler over the gauge: enqueue/dequeue edges
+        # feed it, the heartbeat re-samples it, so scrapes read what the
+        # depth WAS over the window, not the last edge's leftovers.
+        self._depth = QueueDepthSampler(self.m_queue_depth)
         self.m_stalls = registry.counter(
             "tpu_worker_device_stalls_total",
             "device steps exceeding stall_warn_s")
@@ -178,6 +196,15 @@ class TPUWorker:
                           queue_wait_ms=cfg.slo_queue_wait_ms,
                           batch_age_ms=cfg.slo_batch_age_ms),
             registry=registry)
+        # Span export cursor: starts at NOW so a fresh worker never
+        # re-ships whatever history the process-wide ring carries; the
+        # name filter ships only THIS worker's stages (shared-process
+        # deployments must not re-export their neighbors' spans).
+        self._span_exporter = trace.SpanExporter(
+            max_spans=cfg.span_export_max_spans,
+            sample_rate=cfg.span_sample_rate,
+            name_prefixes=("tpu_worker.", "engine."))
+        self._last_span_export = time.monotonic()
         # Capability probes, not flags: test doubles and older engines that
         # predate pack/coalescing keep working through the one-batch path.
         self._engine_coalesces = (
@@ -263,6 +290,10 @@ class TPUWorker:
         clear_costs_provider(self.get_costs)
         for t in self._threads:
             t.join(timeout=timeout_s)
+        if self.cfg.span_export_interval_s > 0:
+            # Graceful stop ships the span tail (kill() deliberately
+            # doesn't — a crashed process exports nothing).
+            self.export_spans()
         if self.provider is not None:
             flush = getattr(self.provider, "flush", None)
             if callable(flush):
@@ -297,6 +328,26 @@ class TPUWorker:
         gate calls this at phase boundaries so breach attribution is
         deterministic instead of riding heartbeat timing."""
         return self._slo.evaluate()
+
+    def export_spans(self) -> int:
+        """Ship spans completed since the last export as one
+        SpanBatchMessage on TOPIC_SPANS; returns the count shipped.
+        The heartbeat loop calls this on ``span_export_interval_s``; the
+        loadgen gate calls it at phase boundaries so trace assembly is
+        deterministic.  Never raises — span telemetry must not take a
+        serving worker down with it."""
+        try:
+            spans, dropped = self._span_exporter.collect()
+            if not spans and not dropped:
+                return 0
+            msg = SpanBatchMessage.new(
+                self.cfg.worker_id, [s.to_dict() for s in spans],
+                dropped=dropped)
+            self.bus.publish(TOPIC_SPANS, msg.to_dict())
+            return len(spans)
+        except Exception as e:
+            logger.warning("span export failed: %s", e)
+            return 0
 
     def drain(self, timeout_s: float = 30.0) -> bool:
         """Block until every accepted batch — queued OR mid-process — has
@@ -335,7 +386,7 @@ class TPUWorker:
                 ack(False)  # requeue server-side; don't block the stream
                 return
             raise
-        self.m_queue_depth.set(self._queue.qsize())
+        self._depth.update(self._queue.qsize())
 
     def _finish_one(self) -> None:
         with self._idle:
@@ -349,17 +400,24 @@ class TPUWorker:
         dispatch and run them as one (packed) stream — a bursty crawl
         stream fills bucket rows across RecordBatch boundaries instead of
         padding each partial batch up to batch_size on its own."""
+        timeline = getattr(self.engine, "timeline", None)
         while not self._stop.is_set():
             try:
                 items = [self._queue.get(timeout=0.1)]
             except queue.Empty:
+                # The queue ran dry: the device is idle because there is
+                # NO work — the next dispatch opens a new stream, so the
+                # wait here never scores as a pipeline bubble
+                # (`utils/occupancy.py`).
+                if timeline is not None:
+                    timeline.start_stream()
                 continue
             while len(items) < max(1, self.cfg.coalesce_batches):
                 try:
                     items.append(self._queue.get_nowait())
                 except queue.Empty:
                     break
-            self.m_queue_depth.set(self._queue.qsize())
+            self._depth.update(self._queue.qsize())
             try:
                 self._process_group(items)
             finally:
@@ -687,11 +745,35 @@ class TPUWorker:
                 worker_type="tpu")
             msg.queue_length = self._queue.qsize()
             msg.resource_usage = self._telemetry.snapshot()
+            # Heartbeat queue depth matches the gauge: the time-weighted
+            # mean over the sampler window, next to the instantaneous
+            # value (the edge-triggered number scrapes used to alias on).
+            msg.resource_usage["queue"] = {
+                "depth": self._queue.qsize(),
+                "depth_time_weighted": round(self._depth.sample(), 4),
+            }
             try:
                 self.bus.publish(TOPIC_WORKER_STATUS, msg.to_dict())
             except Exception as e:  # bus outage must not kill the worker
                 logger.warning("heartbeat publish failed: %s", e)
-            self._stop.wait(self.cfg.heartbeat_s)
+            self._wait_with_span_exports(self.cfg.heartbeat_s)
+
+    def _wait_with_span_exports(self, wait_s: float) -> None:
+        """Sleep until the next heartbeat, firing span exports on their
+        OWN cadence in between — a 30 s heartbeat must not stretch a
+        15 s span_export_interval_s to 30."""
+        deadline = time.monotonic() + wait_s
+        interval = self.cfg.span_export_interval_s
+        while not self._stop.is_set():
+            if interval > 0 and \
+                    time.monotonic() - self._last_span_export >= interval:
+                self._last_span_export = time.monotonic()
+                self.export_spans()
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            self._stop.wait(min(remaining, interval)
+                            if interval > 0 else remaining)
 
     def status(self) -> Dict[str, Any]:
         """Back-compat alias over get_status() (older key names kept)."""

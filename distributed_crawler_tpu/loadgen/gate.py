@@ -72,7 +72,8 @@ SCENARIO_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 _WORKER_KEYS = ("worker_id", "heartbeat_s", "queue_capacity",
                 "coalesce_batches", "pack", "stall_warn_s", "stall_exit_s",
                 "slo_batch_p95_ms", "slo_queue_wait_ms", "slo_batch_age_ms",
-                "write_embeddings")
+                "write_embeddings", "span_export_interval_s",
+                "span_export_max_spans", "span_sample_rate")
 _LOAD_KEYS = ("seed", "duration_s", "arrival", "rate_batches_per_s",
               "ramp_from", "ramp_to", "ramp_batches", "records_per_batch",
               "zipf_a", "max_words", "platform_mix", "crawl_id")
@@ -145,6 +146,56 @@ def _delta(after: Dict[str, float],
             for k, v in after.items() if v - before.get(k, 0.0) > 0}
 
 
+def _occupancy_checks(check, gate_cfg: Dict[str, Any],
+                      costs_body: Optional[Dict[str, Any]]
+                      ) -> Dict[str, Any]:
+    """Device-occupancy envelope over the /costs ``occupancy`` map
+    (`utils/occupancy.py`): busy-fraction floor, host/device-overlap
+    floor, bubble-share cap — the regression surface the upcoming
+    continuous-batching feed will be judged against."""
+    occ = (costs_body or {}).get("occupancy") or {}
+    if gate_cfg.get("min_device_busy_fraction") is not None:
+        floor = float(gate_cfg["min_device_busy_fraction"])
+        val = occ.get("busy_fraction")
+        check("device_busy_fraction", val is not None and val >= floor,
+              val, f">= {floor}")
+    if gate_cfg.get("min_overlap_fraction") is not None:
+        floor = float(gate_cfg["min_overlap_fraction"])
+        val = occ.get("overlap_fraction")
+        check("overlap_fraction", val is not None and val >= floor,
+              val, f">= {floor}")
+    if gate_cfg.get("max_bubble_share") is not None:
+        cap = float(gate_cfg["max_bubble_share"])
+        val = occ.get("bubble_share")
+        check("bubble_share", val is not None and val <= cap,
+              val, f"<= {cap}")
+    return occ
+
+
+def _dtrace_checks(check, gate_cfg: Dict[str, Any],
+                   dtraces_body: Optional[Dict[str, Any]]
+                   ) -> Dict[str, Any]:
+    """Distributed-trace envelope over the /dtraces body: at least one
+    assembled trace spanning enough processes, and every exporting
+    worker's estimated clock offset inside tolerance."""
+    body = dtraces_body or {}
+    traces = body.get("traces") or []
+    multi = sum(1 for t in traces if len(t.get("processes") or []) >= 2)
+    if gate_cfg.get("min_dtrace_processes") is not None:
+        need = int(gate_cfg["min_dtrace_processes"])
+        best = max((len(t.get("processes") or []) for t in traces),
+                   default=0)
+        check("dtrace_processes", best >= need, best,
+              f">= {need} processes in one assembled trace")
+    if gate_cfg.get("max_clock_skew_ms") is not None:
+        cap = float(gate_cfg["max_clock_skew_ms"])
+        offsets = [abs(float(st.get("applied_offset_s") or 0.0)) * 1000.0
+                   for st in (body.get("workers") or {}).values()]
+        worst = max(offsets, default=0.0)
+        check("clock_skew_ms", worst <= cap, round(worst, 3), f"<= {cap}")
+    return {"assembled": len(traces), "multi_process": multi}
+
+
 class OrchestratorHandle:
     """The chaos controller's view of the coordinator itself: ``kill`` /
     ``restart`` with process-death semantics.  Each generation is a FRESH
@@ -200,6 +251,14 @@ class OrchestratorHandle:
         if o is None:
             return {"workers": {}, "orchestrator": {"down": True}}
         return o.get_cluster()
+
+    def get_dtraces(self, limit: int = 0):
+        """The live generation's assembled distributed traces (a dead
+        orchestrator's /dtraces is as gone as its process would be)."""
+        o = self.orch
+        if o is None:
+            return {"traces": [], "workers": {}, "orchestrator_down": True}
+        return o.get_dtraces(limit=limit)
 
     def all_pages(self) -> list:
         """Every page across every depth of the live generation's state
@@ -405,8 +464,10 @@ def run_scenario(scenario: Dict[str, Any],
     from ..utils.metrics import (
         MetricsRegistry,
         clear_cluster_provider,
+        clear_dtraces_provider,
         serve_metrics,
         set_cluster_provider,
+        set_dtraces_provider,
     )
 
     scenario = merge_overrides(scenario, overrides)
@@ -463,6 +524,7 @@ def run_scenario(scenario: Dict[str, Any],
     http_server = None
     controller = None
     cluster_provider = None
+    dtraces_provider = None
     verdict: Dict[str, Any] = {"scenario": name, "bus": bus_kind}
     try:
         # --- bus fabric ---------------------------------------------------
@@ -520,6 +582,8 @@ def run_scenario(scenario: Dict[str, Any],
         orch_handle.start()
         cluster_provider = orch_handle.get_cluster
         set_cluster_provider(cluster_provider)
+        dtraces_provider = orch_handle.get_dtraces
+        set_dtraces_provider(dtraces_provider)
 
         if crawl_leg:
             from ..inference.bridge import InferenceBridge
@@ -632,6 +696,12 @@ def run_scenario(scenario: Dict[str, Any],
         t_end = time.monotonic()
 
         # --- measurement ---------------------------------------------------
+        # Flush the span tail deterministically before reading /dtraces:
+        # the worker's interval-driven export may not have fired since
+        # the last batch landed.
+        export_fn = getattr(handle.worker, "export_spans", None)
+        if callable(export_fn):
+            export_fn()
         spans = trace.TRACER.spans()
         tail_queue_p95 = _p95_ms(spans, QUEUE_WAIT_SPANS, t_tail_wall)
         tail_batch_p95 = _p95_ms(spans, BATCH_SPANS, t_tail_wall)
@@ -641,6 +711,7 @@ def run_scenario(scenario: Dict[str, Any],
             "metrics": _scrape(port, "/metrics", as_json=False),
             "costs": _scrape(port, "/costs", as_json=True),
             "cluster": _scrape(port, "/cluster", as_json=True),
+            "dtraces": _scrape(port, "/dtraces", as_json=True),
         }
 
         expected = chaos_bus.expected_uids()
@@ -729,6 +800,9 @@ def run_scenario(scenario: Dict[str, Any],
                 "pages_by_status": by_status,
                 "completed_items": completed,
             })
+        occupancy = _occupancy_checks(check, gate_cfg, endpoints["costs"])
+        dtrace_summary = _dtrace_checks(check, gate_cfg,
+                                        endpoints["dtraces"])
         if gate_cfg.get("require_flight"):
             events = flight.RECORDER.events()
             start = 0
@@ -740,7 +814,7 @@ def run_scenario(scenario: Dict[str, Any],
             kinds = {e.get("kind") for e in events[start:]}
             for kind in gate_cfg["require_flight"]:
                 check(f"flight_{kind}", kind in kinds, kind in kinds, True)
-        for key in ("metrics", "costs", "cluster"):
+        for key in ("metrics", "costs", "cluster", "dtraces"):
             check(f"endpoint_{key}", endpoints[key] is not None,
                   endpoints[key] is not None, True)
 
@@ -773,6 +847,8 @@ def run_scenario(scenario: Dict[str, Any],
             "orchestrator": orch_detail,
             "cluster_workers": sorted(
                 (endpoints["cluster"] or {}).get("workers", {})),
+            "occupancy": occupancy,
+            "dtraces": dtrace_summary,
             "checks": checks,
         })
         if lost[:5]:
@@ -790,6 +866,9 @@ def run_scenario(scenario: Dict[str, Any],
         if cluster_provider is not None:
             _teardown("cluster-provider",
                       lambda: clear_cluster_provider(cluster_provider))
+        if dtraces_provider is not None:
+            _teardown("dtraces-provider",
+                      lambda: clear_dtraces_provider(dtraces_provider))
         if http_server is not None:
             _teardown("http-server", http_server.shutdown)
         if pool_installed:
@@ -883,13 +962,20 @@ def run_asr_scenario(scenario: Dict[str, Any],
     import wave as _wave
 
     from ..bus.inmemory import InMemoryBus
+    from ..bus.messages import TOPIC_SPANS, SpanBatchMessage
     from ..inference.bridge import InferenceBridge
     from ..inference.engine import EngineConfig, InferenceEngine
     from ..inference.worker import TPUWorker, TPUWorkerConfig, iter_results
     from ..media.bridge import TranscriptReentry
     from ..media.worker import iter_transcripts
+    from ..orchestrator.tracecollect import TraceCollector
     from ..state.providers import InMemoryStorageProvider
-    from ..utils.metrics import MetricsRegistry, serve_metrics
+    from ..utils.metrics import (
+        MetricsRegistry,
+        clear_dtraces_provider,
+        serve_metrics,
+        set_dtraces_provider,
+    )
 
     scenario = merge_overrides(scenario, overrides)
     name = scenario.get("name", "unnamed-asr")
@@ -920,7 +1006,8 @@ def run_asr_scenario(scenario: Dict[str, Any],
                  if k in ("worker_id", "heartbeat_s", "queue_capacity",
                           "coalesce_batches", "write_tokens",
                           "slo_asr_batch_p95_ms", "slo_queue_wait_ms",
-                          "slo_batch_age_ms")}
+                          "slo_batch_age_ms", "span_export_interval_s",
+                          "span_export_max_spans", "span_sample_rate")}
     worker_name = worker_kw.pop("worker_id", "asr-1")
     gate_cfg = scenario.get("gate", {})
     drain_timeout_s = float(scenario.get("drain_timeout_s", 30.0))
@@ -944,6 +1031,7 @@ def run_asr_scenario(scenario: Dict[str, Any],
     ibridge = None
     http_server = None
     controller = None
+    dtraces_provider = None
     verdict: Dict[str, Any] = {"scenario": name, "bus": bus_kind,
                                "kind": "asr"}
     try:
@@ -963,6 +1051,16 @@ def run_asr_scenario(scenario: Dict[str, Any],
             make_worker_bus = lambda: inner_bus  # noqa: E731
         chaos_bus = ChaosBus(local_bus)
 
+        # --- trace collection (no orchestrator in the ASR stack, so the
+        # gate hosts the collector itself, subscribed like one would) ----
+        collector = TraceCollector(process="gate")
+        local_bus.subscribe(
+            TOPIC_SPANS,
+            lambda payload, ack=None:
+            collector.observe(SpanBatchMessage.from_dict(payload)))
+        dtraces_provider = collector.export
+        set_dtraces_provider(dtraces_provider)
+
         # --- re-entry leg: transcripts -> embeddings (real text path) -----
         # Started BEFORE the ASR worker so the ASR worker's /costs
         # provider registration wins (last registration serves).
@@ -973,7 +1071,8 @@ def run_asr_scenario(scenario: Dict[str, Any],
         tpu_worker = TPUWorker(
             local_bus, engine, provider=provider,
             cfg=TPUWorkerConfig(worker_id="tpu-reentry", heartbeat_s=5.0,
-                                stall_warn_s=0.0),
+                                stall_warn_s=0.0,
+                                span_export_interval_s=1.0),
             registry=registry)
         tpu_worker.start()
         ibridge = InferenceBridge(_NullSM(), local_bus,
@@ -1082,6 +1181,12 @@ def run_asr_scenario(scenario: Dict[str, Any],
         t_end = time.monotonic()
 
         # --- measurement ---------------------------------------------------
+        # Flush both serving workers' span tails so /dtraces assembly is
+        # deterministic, not a race with the interval exporters.
+        for w in (handle.worker, tpu_worker):
+            export_fn = getattr(w, "export_spans", None)
+            if callable(export_fn):
+                export_fn()
         spans = trace.TRACER.spans()
         tail_queue_p95 = _p95_ms(spans, QUEUE_WAIT_SPANS, t_tail_wall)
         tail_asr_p95 = _p95_ms(spans, ASR_BATCH_SPANS, t_tail_wall)
@@ -1090,6 +1195,7 @@ def run_asr_scenario(scenario: Dict[str, Any],
         endpoints = {
             "metrics": _scrape(port, "/metrics", as_json=False),
             "costs": _scrape(port, "/costs", as_json=True),
+            "dtraces": _scrape(port, "/dtraces", as_json=True),
         }
 
         expected = chaos_bus.expected_uids()
@@ -1176,6 +1282,9 @@ def run_asr_scenario(scenario: Dict[str, Any],
                   {"asr_rows": len(rows), "mfu": eff.get("mfu"),
                    "goodput": eff.get("goodput_tokens_per_s")},
                   "path=asr rows with nonzero flops + nonzero MFU/goodput")
+        occupancy = _occupancy_checks(check, gate_cfg, endpoints["costs"])
+        dtrace_summary = _dtrace_checks(check, gate_cfg,
+                                        endpoints["dtraces"])
         if gate_cfg.get("require_flight"):
             events = flight.RECORDER.events()
             start = 0
@@ -1187,7 +1296,7 @@ def run_asr_scenario(scenario: Dict[str, Any],
             kinds = {e.get("kind") for e in events[start:]}
             for kind in gate_cfg["require_flight"]:
                 check(f"flight_{kind}", kind in kinds, kind in kinds, True)
-        for key in ("metrics", "costs"):
+        for key in ("metrics", "costs", "dtraces"):
             check(f"endpoint_{key}", endpoints[key] is not None,
                   endpoints[key] is not None, True)
 
@@ -1219,6 +1328,8 @@ def run_asr_scenario(scenario: Dict[str, Any],
             "fault_window_s": round(t_b1 - t_b0, 2),
             "chaos_events": len(controller.events),
             "worker_generations": handle.generation,
+            "occupancy": occupancy,
+            "dtraces": dtrace_summary,
             "checks": checks,
         })
         if lost[:5]:
@@ -1233,6 +1344,9 @@ def run_asr_scenario(scenario: Dict[str, Any],
             _teardown("tpu-reentry", lambda: tpu_worker.stop(timeout_s=5.0))
         if ibridge is not None:
             _teardown("reentry-bridge", ibridge.close)
+        if dtraces_provider is not None:
+            _teardown("dtraces-provider",
+                      lambda: clear_dtraces_provider(dtraces_provider))
         if http_server is not None:
             _teardown("http-server", http_server.shutdown)
         if inner_bus is not None:

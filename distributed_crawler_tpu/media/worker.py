@@ -40,15 +40,18 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..bus.messages import (
     MSG_HEARTBEAT,
     TOPIC_MEDIA_BATCHES,
+    TOPIC_SPANS,
     TOPIC_TRANSCRIPTS,
     TOPIC_WORKER_STATUS,
     AudioBatchMessage,
+    SpanBatchMessage,
     StatusMessage,
     TranscriptMessage,
     WORKER_BUSY,
     WORKER_IDLE,
 )
 from ..utils import flight, trace
+from ..utils.occupancy import QueueDepthSampler
 from ..utils.metrics import (
     REGISTRY,
     MetricsRegistry,
@@ -100,6 +103,11 @@ class ASRWorkerConfig:
     slo_asr_batch_p95_ms: float = 0.0
     slo_queue_wait_ms: float = 0.0
     slo_batch_age_ms: float = 0.0
+    # Span export (the TPU worker's mirror): completed spans ship as
+    # SpanBatchMessages on TOPIC_SPANS for /dtraces assembly.  0 = off.
+    span_export_interval_s: float = 15.0
+    span_export_max_spans: int = 512
+    span_sample_rate: float = 1.0
 
 
 class ASRWorker:
@@ -130,7 +138,11 @@ class ASRWorker:
         self._errors = 0
         self._metrics_server = None
         self.m_queue_depth = registry.gauge(
-            "asr_worker_queue_depth", "decoded audio batches awaiting device")
+            "asr_worker_queue_depth",
+            "decoded audio batches awaiting device (time-weighted "
+            "rolling mean — an edge-triggered gauge aliases between "
+            "scrapes)")
+        self._depth = QueueDepthSampler(self.m_queue_depth)
         self.m_batches = registry.counter(
             "asr_worker_batches_total", "audio batches processed")
         self.m_media = registry.counter(
@@ -153,6 +165,17 @@ class ASRWorker:
                           batch_age_ms=cfg.slo_batch_age_ms,
                           asr_batch_p95_ms=cfg.slo_asr_batch_p95_ms),
             registry=registry)
+        # Ownership-filtered like the TPU worker's: in the ASR + reentry
+        # shared-process topology the text worker ships engine.* spans,
+        # this worker ships the ASR stages PLUS media.reentry — the
+        # TranscriptReentry hop runs in the asr-worker process
+        # (cli._build_asr_worker), so without it the reentry leg would
+        # never reach /dtraces in a real multi-process deployment.
+        self._span_exporter = trace.SpanExporter(
+            max_spans=cfg.span_export_max_spans,
+            sample_rate=cfg.span_sample_rate,
+            name_prefixes=("asr_worker.", "asr.", "media.reentry"))
+        self._last_span_export = time.monotonic()
 
     # -- status/costs --------------------------------------------------------
     def get_status(self) -> dict:
@@ -199,6 +222,10 @@ class ASRWorker:
         clear_costs_provider(self.get_costs)
         for t in self._threads:
             t.join(timeout=timeout_s)
+        if self.cfg.span_export_interval_s > 0:
+            # Graceful stop ships the span tail (kill() deliberately
+            # doesn't — a crashed process exports nothing).
+            self.export_spans()
         if self.provider is not None:
             flush = getattr(self.provider, "flush", None)
             if callable(flush):
@@ -224,6 +251,23 @@ class ASRWorker:
         """One on-demand SLO tick (the loadgen gate calls this at phase
         boundaries so breach attribution is deterministic)."""
         return self._slo.evaluate()
+
+    def export_spans(self) -> int:
+        """Ship spans completed since the last export on TOPIC_SPANS
+        (the TPU worker's mirror); returns the count shipped.  Never
+        raises into the serving path."""
+        try:
+            spans, dropped = self._span_exporter.collect()
+            if not spans and not dropped:
+                return 0
+            msg = SpanBatchMessage.new(
+                self.cfg.worker_id, [s.to_dict() for s in spans],
+                dropped=dropped)
+            self.bus.publish(TOPIC_SPANS, msg.to_dict())
+            return len(spans)
+        except Exception as e:
+            logger.warning("span export failed: %s", e)
+            return 0
 
     def drain(self, timeout_s: float = 30.0) -> bool:
         with self._idle:
@@ -267,7 +311,7 @@ class ASRWorker:
                 ack(False)
                 return
             raise
-        self.m_queue_depth.set(self._queue.qsize())
+        self._depth.update(self._queue.qsize())
 
     def _finish_one(self) -> None:
         with self._idle:
@@ -277,17 +321,22 @@ class ASRWorker:
 
     # -- feed loop (coalescing) ----------------------------------------------
     def _feed_loop(self) -> None:
+        timeline = getattr(self.pipeline, "timeline", None)
         while not self._stop.is_set():
             try:
                 items = [self._queue.get(timeout=0.1)]
             except queue.Empty:
+                # Queue dry = idle-by-no-work: the next dispatch opens a
+                # new occupancy stream, never a pipeline bubble.
+                if timeline is not None:
+                    timeline.start_stream()
                 continue
             while len(items) < max(1, self.cfg.coalesce_batches):
                 try:
                     items.append(self._queue.get_nowait())
                 except queue.Empty:
                     break
-            self.m_queue_depth.set(self._queue.qsize())
+            self._depth.update(self._queue.qsize())
             try:
                 self._process_group(items)
             finally:
@@ -531,8 +580,28 @@ class ASRWorker:
                 worker_type="asr")
             msg.queue_length = self._queue.qsize()
             msg.resource_usage = self._telemetry.snapshot()
+            msg.resource_usage["queue"] = {
+                "depth": self._queue.qsize(),
+                "depth_time_weighted": round(self._depth.sample(), 4),
+            }
             try:
                 self.bus.publish(TOPIC_WORKER_STATUS, msg.to_dict())
             except Exception as e:  # bus outage must not kill the worker
                 logger.warning("heartbeat publish failed: %s", e)
-            self._stop.wait(self.cfg.heartbeat_s)
+            self._wait_with_span_exports(self.cfg.heartbeat_s)
+
+    def _wait_with_span_exports(self, wait_s: float) -> None:
+        """Sleep until the next heartbeat, firing span exports on their
+        OWN cadence in between (the TPU worker's mirror)."""
+        deadline = time.monotonic() + wait_s
+        interval = self.cfg.span_export_interval_s
+        while not self._stop.is_set():
+            if interval > 0 and \
+                    time.monotonic() - self._last_span_export >= interval:
+                self._last_span_export = time.monotonic()
+                self.export_spans()
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            self._stop.wait(min(remaining, interval)
+                            if interval > 0 else remaining)

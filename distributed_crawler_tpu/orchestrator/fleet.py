@@ -61,6 +61,14 @@ class WorkerTrack:
     heartbeats: int = 0
     stale_dropped: int = 0     # out-of-order heartbeats ignored
     telemetry: Dict[str, Any] = field(default_factory=dict)
+    # Estimated sender-clock offset (receiver wall − heartbeat send wall,
+    # seconds): the min-|sample| over recent beats, because bus transit
+    # only ever inflates |recv − send| — the smallest-magnitude sample is
+    # the closest to the true skew.  The TraceCollector adds this to a
+    # worker's span walls to land them on the orchestrator's clock.
+    clock_offset_s: float = 0.0
+    offset_samples: Deque[float] = field(
+        default_factory=lambda: deque(maxlen=16))
     # (iso_ts, status, queue_length) ring — appended on CHANGE, not on
     # every beat, so a stable worker's history is its life story, not noise.
     history: Deque[Tuple[str, str, int]] = field(
@@ -136,6 +144,11 @@ class FleetView:
             track.tasks_error = msg.tasks_error
             track.uptime_s = msg.uptime_s
             track.heartbeats += 1
+            if msg.timestamp is not None:
+                # Clock-offset sample: this beat's receive − send wall.
+                track.offset_samples.append(
+                    (now - msg.timestamp).total_seconds())
+                track.clock_offset_s = min(track.offset_samples, key=abs)
             if msg.resource_usage:
                 track.telemetry = msg.resource_usage
             if prev_seen is not None:
@@ -186,6 +199,15 @@ class FleetView:
                 sums["peak"] += float(dev.get("peak_bytes_in_use") or 0)
             for kind, total in sums.items():
                 self.m_devmem.labels(worker_id=wid, kind=kind).set(total)
+
+    def clock_offsets(self) -> Dict[str, float]:
+        """{worker_id: estimated clock offset in seconds} — what the
+        TraceCollector adds to a worker's span walls (receiver − sender;
+        only workers that have sent a timestamped beat appear)."""
+        with self._mu:
+            return {wid: t.clock_offset_s
+                    for wid, t in self._workers.items()
+                    if t.offset_samples}
 
     def refresh_staleness(self, now: Optional[datetime] = None) -> int:
         """Recompute the ``fleet_stale_workers`` gauge and evict long-gone
@@ -264,6 +286,7 @@ class FleetView:
                               "errors_per_s": t.errors_per_s},
                     "uptime_s": t.uptime_s,
                     "heartbeats": t.heartbeats,
+                    "clock_offset_s": round(t.clock_offset_s, 6),
                     "stale_heartbeats_dropped": t.stale_dropped,
                     "telemetry": t.telemetry,
                     "history": list(t.history),

@@ -29,6 +29,7 @@ from ..bus.messages import (
     PRIORITY_MEDIUM,
     STATUS_SUCCESS,
     TOPIC_RESULTS,
+    TOPIC_SPANS,
     TOPIC_WORK_QUEUE,
     TOPIC_WORKER_STATUS,
     WORKER_ACTIVE,
@@ -36,6 +37,7 @@ from ..bus.messages import (
     WORKER_IDLE,
     WORKER_OFFLINE,
     ResultMessage,
+    SpanBatchMessage,
     StatusMessage,
     WorkItem,
     WorkItemConfig,
@@ -43,6 +45,7 @@ from ..bus.messages import (
     WorkResult,
 )
 from .fleet import FleetView
+from .tracecollect import TraceCollector
 from .journal import CrawlJournal, RecoveredCrawl
 from ..config.crawler import CrawlerConfig
 from ..utils import flight, resilience, trace
@@ -161,6 +164,13 @@ class Orchestrator:
         # Telemetry-rich per-worker fold behind /cluster; its staleness
         # rule tracks the same timeout check_worker_health enforces.
         self.fleet = FleetView(stale_after_s=self.ocfg.worker_timeout_s)
+        # Distributed-trace assembly behind /dtraces: workers ship
+        # completed spans on TOPIC_SPANS; the collector corrects each
+        # worker's span walls by the clock offset the fleet estimates
+        # from heartbeat send/receive walls, and merges this process's
+        # own spans in at export (`orchestrator/tracecollect.py`).
+        self.trace_collector = TraceCollector(
+            offsets_fn=self.fleet.clock_offsets, process="orchestrator")
         # Declarative resiliency (utils/resilience.py): state-store ops
         # behind retry + circuit breaker (an open circuit engages the
         # dispatch backpressure), bus publishes behind jittered retry.
@@ -216,6 +226,7 @@ class Orchestrator:
         # result inline, which must not race the subscription.
         self.bus.subscribe(TOPIC_RESULTS, self.handle_result_payload)
         self.bus.subscribe(TOPIC_WORKER_STATUS, self.handle_status_payload)
+        self.bus.subscribe(TOPIC_SPANS, self.handle_spans_payload)
         if self.resumed:
             self._resume_requeue(pending)
         if background:
@@ -895,6 +906,17 @@ class Orchestrator:
             self._add_layer_or_defer(pages)
         for item, message, result in results:
             self._apply_result(item, message, result)
+
+    # -- distributed-trace fold (`tracecollect.py`) ------------------------
+    def handle_spans_payload(self, payload: Dict[str, Any]) -> None:
+        if self._killed:
+            return
+        self.trace_collector.observe(SpanBatchMessage.from_dict(payload))
+
+    def get_dtraces(self, limit: int = 0) -> Dict[str, Any]:
+        """The ``/dtraces`` JSON body (assembled distributed traces);
+        registered via `utils.metrics.set_dtraces_provider` by the CLI."""
+        return self.trace_collector.export(limit=limit)
 
     # -- worker registry (`orchestrator.go:419-449`) -----------------------
     def handle_status_payload(self, payload: Dict[str, Any]) -> None:

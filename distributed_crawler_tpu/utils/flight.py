@@ -95,7 +95,7 @@ class FlightRecorder:
         """The postmortem payload: everything a dead process can no longer
         serve over HTTP, in one JSON-safe dict."""
         from . import trace as _trace
-        from .metrics import REGISTRY
+        from .metrics import REGISTRY, dtraces_snapshot
 
         try:
             traces = _trace.TRACER.export()
@@ -105,7 +105,7 @@ class FlightRecorder:
             metrics = REGISTRY.expose()
         except Exception as e:
             metrics = f"# exposition failed: {e}"
-        return {
+        bundle = {
             "schema": "dct-postmortem-v1",
             "reason": reason,
             "error": error,
@@ -117,6 +117,14 @@ class FlightRecorder:
             "traces": traces,
             "metrics": metrics,
         }
+        # Assembled distributed traces when this process runs a trace
+        # collector (the orchestrator): the cross-process timeline is the
+        # single most valuable postmortem artifact — a dead coordinator's
+        # /dtraces can no longer be scraped.
+        dtraces = dtraces_snapshot()
+        if dtraces is not None:
+            bundle["dtraces"] = dtraces
+        return bundle
 
     def dump(self, reason: str, error: str = "",
              dump_dir: str = "") -> Optional[str]:

@@ -333,6 +333,39 @@ def clear_costs_provider(fn) -> None:
         _costs_provider = None
 
 
+# Late-bound /dtraces provider: the orchestrator's distributed-trace
+# collector (`orchestrator/tracecollect.py`) — assembled cross-process
+# traces with clock-offset-corrected span walls.
+_dtraces_provider = None
+
+
+def set_dtraces_provider(fn) -> None:
+    """Register the dict provider served at /dtraces (``fn(limit=N)`` or
+    zero-arg; pass None to clear)."""
+    global _dtraces_provider
+    _dtraces_provider = fn
+
+
+def clear_dtraces_provider(fn) -> None:
+    """Unregister ``fn`` only if it is still the active provider."""
+    global _dtraces_provider
+    if _dtraces_provider == fn:
+        _dtraces_provider = None
+
+
+def dtraces_snapshot():
+    """The active /dtraces body, or None without a provider — the
+    flight recorder calls this so postmortem bundles carry the
+    assembled distributed traces a dead process can no longer serve."""
+    fn = _dtraces_provider
+    if fn is None:
+        return None
+    try:
+        return fn()
+    except Exception as e:
+        return {"error": str(e)}
+
+
 class _Handler(BaseHTTPRequestHandler):
     registry: MetricsRegistry = REGISTRY
 
@@ -404,6 +437,29 @@ class _Handler(BaseHTTPRequestHandler):
             result = _profiling.capture(seconds)
             code = int(result.pop("code", 200 if result.get("ok") else 500))
             body = _json.dumps(result).encode("utf-8")
+            ctype = "application/json"
+        elif path == "/dtraces" and _dtraces_provider is not None:
+            # Assembled DISTRIBUTED traces (spans from every process that
+            # exported on TOPIC_SPANS, clock-offset-corrected) from the
+            # trace collector; ?limit=N caps the trace count.  Rendered
+            # by tools/trace_dump.py --collector / tools/critpath.py.
+            import json as _json
+            from urllib.parse import parse_qs as _parse_qs
+
+            query = self.path.partition("?")[2]
+            try:
+                limit = int(_parse_qs(query).get("limit", ["0"])[0])
+            except (ValueError, TypeError):
+                limit = 0
+            try:
+                try:
+                    payload = _dtraces_provider(limit=limit)
+                except TypeError:  # zero-arg providers are fine too
+                    payload = _dtraces_provider()
+                body = _json.dumps(payload, default=str).encode("utf-8")
+            except Exception as e:
+                code = 500
+                body = _json.dumps({"error": str(e)}).encode("utf-8")
             ctype = "application/json"
         elif path == "/cluster" and _cluster_provider is not None:
             # The orchestrator's fleet view: per-worker last-seen, status

@@ -148,6 +148,16 @@ class TelemetryEmitter:
                 eff = eff_fn()
                 if eff:
                     out["efficiency"] = eff
+            occ_fn = getattr(self.engine, "occupancy_snapshot", None)
+            if callable(occ_fn):
+                # Device occupancy (`utils/occupancy.py`): busy/overlap
+                # fractions + bubble accounting.  This per-beat call is
+                # ALSO what keeps the occupancy gauges fresh on plain
+                # /metrics scrapes — the hot path records intervals but
+                # never derives (O(1) by design).
+                occ = occ_fn()
+                if occ:
+                    out["occupancy"] = occ
         for key, counter in self.counters.items():
             series = getattr(counter, "series", None)
             if not callable(series):

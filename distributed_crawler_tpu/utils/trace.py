@@ -112,6 +112,7 @@ class Tracer:
         self._lock = threading.Lock()
         self._spans: "deque[Span]" = deque(maxlen=max(1, capacity))
         self._enabled = capacity > 0
+        self._completed_total = 0  # spans ever appended (export cursor)
         self.capacity = capacity
         self.slow_span_s = slow_span_s
 
@@ -189,6 +190,7 @@ class Tracer:
         if self._enabled:
             with self._lock:
                 self._spans.append(s)
+                self._completed_total += 1
         if self.slow_span_s > 0 and s.duration_s >= self.slow_span_s:
             # The slow-trace log line (docs/operations.md "Observability"):
             # span name, trace id for /traces correlation, duration, attrs.
@@ -201,6 +203,14 @@ class Tracer:
     def spans(self) -> List[Span]:
         with self._lock:
             return list(self._spans)
+
+    def spans_with_total(self) -> Tuple[List[Span], int]:
+        """(ring contents, spans ever completed) in ONE atomic read — the
+        export cursor a `SpanExporter` needs; splitting the two reads
+        would let a span complete in between and be shipped twice or
+        never."""
+        with self._lock:
+            return list(self._spans), self._completed_total
 
     def reset(self) -> None:
         with self._lock:
@@ -287,6 +297,95 @@ def latency_digest(spans: List[Span],
             "max_ms": round(vals[-1], 3),
         }
     return out
+
+
+def span_from_dict(d: Dict[str, Any]) -> Span:
+    """Inverse of :meth:`Span.to_dict` — the decode side of span export
+    (`bus/messages.py:SpanBatchMessage` ships the dict form)."""
+    return Span(
+        name=str(d.get("name", "") or ""),
+        trace_id=str(d.get("trace_id", "") or ""),
+        span_id=str(d.get("span_id", "") or ""),
+        parent_id=str(d.get("parent_id", "") or ""),
+        start_wall=float(d.get("start_wall") or 0.0),
+        duration_s=float(d.get("duration_ms") or 0.0) / 1000.0,
+        attrs=dict(d.get("attrs") or {}),
+    )
+
+
+class SpanExporter:
+    """Bounded, trace-consistent sampling of NEW completed spans.
+
+    Each ``collect()`` returns the spans completed since the previous
+    collect (starting from construction time — a fresh exporter never
+    re-ships a ring full of history), after:
+
+    - **trace-consistent sampling**: ``sample_rate`` keeps or drops
+      whole traces by a stable hash of the trace id (crc32), so every
+      process sampling at the same rate ships the SAME subset of traces
+      and the collector can still assemble complete cross-process
+      traces.  Untraced spans (no trace id) are never shipped.
+    - **bounding**: at most ``max_spans`` per collect, newest kept (the
+      freshest spans are the ones an operator is debugging).
+    - **ownership filtering**: ``name_prefixes`` restricts the export to
+      the spans THIS component produced.  The ring is process-wide; in
+      shared-process deployments (--bus-serve single-service, the
+      loadgen gate, an orchestrator embedding a worker) an unfiltered
+      exporter would ship — and claim authorship of — every other
+      component's spans, and the export publish's own ``bus.deliver``
+      span would feed back into the next export forever.
+
+    The second return value counts spans NOT shipped (ring eviction
+    between collects, sampling, the bound) so the collector can report
+    loss instead of silently under-representing a hot worker.  Spans
+    excluded by the ownership filter are someone else's to ship and are
+    NOT counted as dropped.
+    """
+
+    def __init__(self, tracer: Optional[Tracer] = None,
+                 max_spans: int = 512, sample_rate: float = 1.0,
+                 name_prefixes: Tuple[str, ...] = ()):
+        self.tracer = tracer or TRACER
+        self.max_spans = max(1, int(max_spans))
+        self.sample_rate = min(1.0, max(0.0, float(sample_rate)))
+        self.name_prefixes = tuple(name_prefixes)
+        # Serializes collect(): the heartbeat thread and on-demand
+        # callers (the loadgen gate's phase-boundary flush) may race,
+        # and an unsynchronized cursor would ship one window twice.
+        self._lock = threading.Lock()
+        _, self._cursor = self.tracer.spans_with_total()
+
+    def keeps(self, trace_id: str) -> bool:
+        """Stable per-trace sampling decision (shared across processes)."""
+        if not trace_id:
+            return False
+        if self.sample_rate >= 1.0:
+            return True
+        if self.sample_rate <= 0.0:
+            return False
+        import zlib
+
+        return (zlib.crc32(trace_id.encode("utf-8")) % 10_000) < \
+            self.sample_rate * 10_000
+
+    def collect(self) -> Tuple[List[Span], int]:
+        """(spans to ship, dropped count) since the previous collect."""
+        with self._lock:
+            spans, total = self.tracer.spans_with_total()
+            fresh_n, self._cursor = total - self._cursor, total
+        if fresh_n <= 0:
+            return [], 0
+        fresh = spans[-fresh_n:] if fresh_n <= len(spans) else spans
+        dropped = fresh_n - len(fresh)  # evicted before we got here
+        if self.name_prefixes:
+            fresh = [s for s in fresh
+                     if s.name.startswith(self.name_prefixes)]
+        sampled = [s for s in fresh if self.keeps(s.trace_id)]
+        dropped += len(fresh) - len(sampled)
+        if len(sampled) > self.max_spans:
+            dropped += len(sampled) - self.max_spans
+            sampled = sampled[-self.max_spans:]
+        return sampled, dropped
 
 
 def inject(payload: Any) -> Any:

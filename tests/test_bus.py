@@ -115,6 +115,7 @@ class TestMessageRegistry:
         from distributed_crawler_tpu.bus.messages import (
             AudioBatchMessage,
             AudioRef,
+            SpanBatchMessage,
             TranscriptMessage,
         )
 
@@ -134,6 +135,11 @@ class TestMessageRegistry:
             TranscriptMessage: TranscriptMessage.new(
                 "m1", crawl_id="c1", batch_id="b1", text="hi",
                 tokens=[1, 2], windows=1),
+            SpanBatchMessage: SpanBatchMessage.new(
+                "tpu-1", [{"name": "tpu_worker.process",
+                           "trace_id": "t1", "span_id": "s1",
+                           "parent_id": "", "start_wall": 1.0,
+                           "duration_ms": 2.0, "attrs": {}}]),
         }
         assert set(MESSAGE_REGISTRY.values()) == set(samples)
         for cls, msg in samples.items():

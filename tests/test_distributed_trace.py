@@ -1,0 +1,661 @@
+"""Distributed trace collection, occupancy accounting, and /dtraces.
+
+Covers the PR-9 observability layer: SpanBatchMessage codec/bus
+round-trips (`bus/messages.py`), the SpanExporter's cursor/sampling/
+bounding (`utils/trace.py`), TraceCollector assembly with deliberately
+skewed worker clocks (`orchestrator/tracecollect.py`), DeviceTimeline /
+QueueDepthSampler math on synthetic timelines (`utils/occupancy.py`),
+the ``/dtraces`` endpoint over real HTTP, the critpath/trace-dump
+renderers, and the acceptance scenario: an orchestrator + TPU worker on
+one in-memory bus producing ONE assembled trace whose spans originate
+from both processes.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import pytest
+
+from distributed_crawler_tpu.bus import InMemoryBus
+from distributed_crawler_tpu.bus.codec import (
+    MESSAGE_REGISTRY,
+    RecordBatch,
+    decode_frame,
+    decode_message,
+    encode_frame,
+)
+from distributed_crawler_tpu.bus.messages import (
+    MSG_SPAN_BATCH,
+    TOPIC_INFERENCE_BATCHES,
+    TOPIC_SPANS,
+    SpanBatchMessage,
+    pubsub_topics,
+)
+from distributed_crawler_tpu.datamodel.post import Post
+from distributed_crawler_tpu.inference.worker import (
+    TPUWorker,
+    TPUWorkerConfig,
+)
+from distributed_crawler_tpu.orchestrator.tracecollect import TraceCollector
+from distributed_crawler_tpu.utils import trace
+from distributed_crawler_tpu.utils.metrics import (
+    MetricsRegistry,
+    clear_dtraces_provider,
+    serve_metrics,
+    set_dtraces_provider,
+)
+from distributed_crawler_tpu.utils.occupancy import (
+    DeviceTimeline,
+    QueueDepthSampler,
+    merged_length,
+)
+
+import tools.critpath as critpath
+import tools.trace_dump as trace_dump
+
+
+def span_row(name="tpu_worker.process", trace_id="t1", span_id="s1",
+             parent_id="", start_wall=1000.0, duration_ms=10.0, **attrs):
+    return {"name": name, "trace_id": trace_id, "span_id": span_id,
+            "parent_id": parent_id, "start_wall": start_wall,
+            "duration_ms": duration_ms, "attrs": attrs}
+
+
+def make_batch(n=3, crawl_id="c1"):
+    return RecordBatch.from_posts(
+        [Post(post_uid=f"p{i}", channel_name="chan",
+              description=f"text {i}") for i in range(n)],
+        crawl_id=crawl_id)
+
+
+class FakeEngine:
+    """Engine double: enough surface for TPUWorker, no jax."""
+
+    def __init__(self):
+        self.cfg = SimpleNamespace(model="fake-tiny")
+
+    def run(self, texts):
+        return [{"label": 0, "score": 1.0} for _ in texts]
+
+
+# ---------------------------------------------------------------------------
+class TestSpanBatchMessage:
+    def test_dict_round_trip(self):
+        msg = SpanBatchMessage.new("tpu-1", [span_row()], dropped=2)
+        msg.validate()
+        rt = SpanBatchMessage.from_dict(msg.to_dict())
+        assert rt.worker_id == "tpu-1"
+        assert rt.dropped == 2
+        assert rt.sent_wall == msg.sent_wall
+        assert rt.spans[0]["name"] == "tpu_worker.process"
+        assert rt.trace_id == msg.trace_id
+
+    def test_frame_codec_round_trip(self):
+        msg = SpanBatchMessage.new("tpu-1", [span_row()])
+        payload, rest = decode_frame(encode_frame(msg.to_dict()))
+        assert not rest
+        decoded = decode_message(payload)
+        assert isinstance(decoded, SpanBatchMessage)
+        assert decoded.worker_id == "tpu-1"
+        assert len(decoded) == 1
+
+    def test_registered_and_topic_listed(self):
+        assert MESSAGE_REGISTRY[MSG_SPAN_BATCH] is SpanBatchMessage
+        assert TOPIC_SPANS in pubsub_topics()
+
+    def test_validate_rejects_bad_shapes(self):
+        with pytest.raises(ValueError):
+            SpanBatchMessage.new("", [span_row()]).validate()
+        with pytest.raises(ValueError):
+            SpanBatchMessage.new("w", [{"no_name": True}]).validate()
+        bad = SpanBatchMessage.new("w", [])
+        bad.message_type = "heartbeat"
+        with pytest.raises(ValueError):
+            bad.validate()
+
+    def test_bus_round_trip(self):
+        bus = InMemoryBus()
+        got = []
+        bus.subscribe(TOPIC_SPANS, lambda p: got.append(
+            SpanBatchMessage.from_dict(p)))
+        bus.publish(TOPIC_SPANS,
+                    SpanBatchMessage.new("w9", [span_row()]).to_dict())
+        assert len(got) == 1 and got[0].worker_id == "w9"
+
+
+# ---------------------------------------------------------------------------
+class TestSpanExporter:
+    def test_ships_only_spans_completed_after_construction(self):
+        tracer = trace.Tracer(capacity=64)
+        with tracer.span("old"):
+            pass
+        exp = trace.SpanExporter(tracer=tracer)
+        spans, dropped = exp.collect()
+        assert spans == [] and dropped == 0
+        with tracer.span("fresh"):
+            pass
+        spans, dropped = exp.collect()
+        assert [s.name for s in spans] == ["fresh"] and dropped == 0
+        # Nothing new: the cursor advanced.
+        assert exp.collect() == ([], 0)
+
+    def test_max_spans_bound_keeps_newest_and_counts_dropped(self):
+        tracer = trace.Tracer(capacity=64)
+        exp = trace.SpanExporter(tracer=tracer, max_spans=2)
+        for i in range(5):
+            with tracer.span(f"s{i}"):
+                pass
+        spans, dropped = exp.collect()
+        assert [s.name for s in spans] == ["s3", "s4"]
+        assert dropped == 3
+
+    def test_ring_eviction_counts_as_dropped(self):
+        tracer = trace.Tracer(capacity=2)
+        exp = trace.SpanExporter(tracer=tracer)
+        for i in range(5):
+            with tracer.span(f"s{i}"):
+                pass
+        spans, dropped = exp.collect()
+        assert len(spans) == 2 and dropped == 3
+
+    def test_sampling_is_stable_per_trace_across_exporters(self):
+        tracer = trace.Tracer(capacity=512)
+        a = trace.SpanExporter(tracer=tracer, sample_rate=0.5)
+        b = trace.SpanExporter(tracer=tracer, sample_rate=0.5)
+        decisions_a = [a.keeps(f"trace_{i}") for i in range(200)]
+        decisions_b = [b.keeps(f"trace_{i}") for i in range(200)]
+        assert decisions_a == decisions_b       # shared subset
+        assert any(decisions_a) and not all(decisions_a)  # actually samples
+        assert not a.keeps("")  # untraced spans never ship
+
+    def test_sample_rate_zero_drops_everything(self):
+        tracer = trace.Tracer(capacity=64)
+        exp = trace.SpanExporter(tracer=tracer, sample_rate=0.0)
+        with tracer.span("x"):
+            pass
+        spans, dropped = exp.collect()
+        assert spans == [] and dropped == 1
+
+    def test_ownership_prefix_filter_excludes_foreign_spans(self):
+        tracer = trace.Tracer(capacity=64)
+        exp = trace.SpanExporter(tracer=tracer,
+                                 name_prefixes=("asr_worker.",
+                                                "media.reentry"))
+        for name in ("asr_worker.process", "media.reentry",
+                     "engine.compute", "bus.deliver"):
+            with tracer.span(name):
+                pass
+        spans, dropped = exp.collect()
+        # Foreign spans are someone else's to ship — excluded, NOT
+        # counted as dropped.
+        assert sorted(s.name for s in spans) == \
+            ["asr_worker.process", "media.reentry"]
+        assert dropped == 0
+
+    def test_span_from_dict_inverts_to_dict(self):
+        s = trace.Span(name="n", trace_id="t", span_id="s",
+                       parent_id="p", start_wall=12.5, duration_s=0.25,
+                       attrs={"k": 1})
+        rt = trace.span_from_dict(s.to_dict())
+        assert (rt.name, rt.trace_id, rt.span_id, rt.parent_id) == \
+            ("n", "t", "s", "p")
+        assert rt.start_wall == 12.5
+        assert abs(rt.duration_s - 0.25) < 1e-9
+
+
+# ---------------------------------------------------------------------------
+class TestDeviceTimeline:
+    def _tl(self, clk):
+        return DeviceTimeline(registry=MetricsRegistry(), window_s=60.0,
+                              clock=lambda: clk[0])
+
+    def test_empty_snapshot_is_empty(self):
+        assert self._tl([0.0]).snapshot() == {}
+
+    def test_busy_overlap_bubble_math(self):
+        clk = [0.0]
+        tl = self._tl(clk)
+        clk[0] = 2.0
+        tl.record(0.0, 2.0)
+        clk[0] = 3.0
+        tl.record(1.0, 3.0)      # overlaps [1, 2]
+        clk[0] = 6.0
+        tl.record(5.0, 6.0)      # 2 s gap -> bubble
+        clk[0] = 10.0
+        snap = tl.snapshot()
+        # union [0,3]+[5,6] = 4 s over a 10 s window
+        assert abs(snap["busy_fraction"] - 0.4) < 1e-6
+        # total 5 s, union 4 s -> 1/5 overlapped
+        assert abs(snap["overlap_fraction"] - 0.2) < 1e-6
+        assert abs(snap["bubble_ms_total"] - 2000.0) < 1e-6
+        # bubble 2 s vs active (union 4 + bubble 2)
+        assert abs(snap["bubble_share"] - 2.0 / 6.0) < 1e-6
+        assert snap["batches"] == 3
+
+    def test_stream_boundary_gap_is_not_a_bubble(self):
+        clk = [0.0]
+        tl = self._tl(clk)
+        clk[0] = 1.0
+        tl.record(0.0, 1.0)
+        tl.start_stream()        # queue ran dry
+        clk[0] = 31.0
+        tl.record(30.0, 31.0)    # 29 s idle, zero bubble
+        assert tl.snapshot()["bubble_ms_total"] == 0.0
+
+    def test_reset_clears_everything(self):
+        clk = [1.0]
+        tl = self._tl(clk)
+        tl.record(0.0, 1.0)
+        clk[0] = 3.0
+        tl.record(2.5, 3.0)
+        tl.reset()
+        assert tl.snapshot() == {}
+
+    def test_window_pruning_decays_busy_fraction(self):
+        clk = [1.0]
+        tl = self._tl(clk)
+        tl.record(0.0, 1.0)
+        clk[0] = 120.0           # interval aged out of the 60 s window
+        snap = tl.snapshot()
+        assert snap["batches"] == 0
+        assert snap["busy_fraction"] == 0.0
+
+    def test_merged_length(self):
+        assert merged_length([]) == 0.0
+        assert merged_length([(0, 2), (1, 3), (5, 6)]) == 4.0
+
+    def test_path_labels_keep_two_timelines_distinct(self):
+        # The asr-steady rig runs a text engine AND an ASR pipeline on
+        # one registry: their busy gauges must be separate labeled
+        # children, not one unlabeled series the two clobber.
+        reg = MetricsRegistry()
+        clk = [0.0]
+        text = DeviceTimeline(registry=reg, window_s=60.0,
+                              clock=lambda: clk[0], path="text")
+        asr = DeviceTimeline(registry=reg, window_s=60.0,
+                             clock=lambda: clk[0], path="asr")
+        clk[0] = 1.0
+        text.record(0.0, 1.0)
+        clk[0] = 10.0
+        asr.record(9.0, 10.0)
+        text.snapshot()
+        asr.snapshot()
+        g = reg.gauge("tpu_engine_device_busy_fraction")
+        assert g.labels(path="text").value == pytest.approx(0.1)
+        assert g.labels(path="asr").value == pytest.approx(1.0)
+
+    def test_telemetry_heartbeat_carries_occupancy(self):
+        from distributed_crawler_tpu.utils.telemetry import TelemetryEmitter
+
+        class Eng:
+            def occupancy_snapshot(self):
+                return {"busy_fraction": 0.5}
+
+        snap = TelemetryEmitter(engine=Eng()).snapshot()
+        assert snap["occupancy"] == {"busy_fraction": 0.5}
+
+
+class TestQueueDepthSampler:
+    def test_time_weighted_mean(self):
+        clk = [0.0]
+        reg = MetricsRegistry()
+        g = reg.gauge("qd")
+        s = QueueDepthSampler(g, window_s=10.0, clock=lambda: clk[0])
+        clk[0] = 2.0
+        s.update(4)
+        clk[0] = 4.0
+        s.update(0)
+        clk[0] = 10.0
+        # depth 0 for [0,2], 4 for [2,4], 0 for [4,10] -> 8/10
+        assert abs(s.sample() - 0.8) < 1e-6
+        assert abs(g.value - 0.8) < 1e-6
+
+    def test_no_aliasing_between_edges(self):
+        # The edge-triggered regression: depth spikes to 32 then drains
+        # before the scrape — an edge gauge reads 0, the sampler reads
+        # the window's truth.
+        clk = [0.0]
+        g = MetricsRegistry().gauge("qd")
+        s = QueueDepthSampler(g, window_s=10.0, clock=lambda: clk[0])
+        s.update(32)
+        clk[0] = 5.0
+        s.update(0)
+        clk[0] = 10.0
+        assert s.current() == 0          # the edge value (aliased read)
+        assert s.sample() == pytest.approx(16.0)  # the truth
+
+    def test_constant_depth_before_window(self):
+        clk = [0.0]
+        g = MetricsRegistry().gauge("qd")
+        s = QueueDepthSampler(g, window_s=5.0, clock=lambda: clk[0])
+        s.update(3)
+        clk[0] = 100.0  # the edge aged out entirely
+        assert s.sample() == pytest.approx(3.0)
+
+    def test_update_refreshes_gauge_on_every_edge(self):
+        # The gauge must not wait for the next heartbeat sample(): a
+        # scrape right after an edge reads the current window mean.
+        clk = [0.0]
+        g = MetricsRegistry().gauge("qd")
+        s = QueueDepthSampler(g, window_s=10.0, clock=lambda: clk[0])
+        clk[0] = 5.0
+        s.update(8)       # depth 0 for [0,5], 8 after -> mean so far 0
+        clk[0] = 10.0
+        s.update(8)       # 0 for [0,5], 8 for [5,10] -> mean 4
+        assert g.value == pytest.approx(4.0)
+
+    def test_incremental_integral_matches_across_pruning(self):
+        # Exercise the amortized segment-sum bookkeeping across edge
+        # expiry: after pruning, the mean must stay exact.
+        clk = [0.0]
+        g = MetricsRegistry().gauge("qd")
+        s = QueueDepthSampler(g, window_s=10.0, clock=lambda: clk[0])
+        for t, d in ((1.0, 2), (3.0, 6), (5.0, 0)):
+            clk[0] = t
+            s.update(d)
+        clk[0] = 12.0  # window [2,12]: first edge aged out mid-segment
+        # floor(2)*(3-2) + 6*(5-3) + 0*(12-5) = 14 over 10
+        assert s.sample() == pytest.approx(1.4)
+
+
+# ---------------------------------------------------------------------------
+class TestTraceCollector:
+    def test_skewed_clock_corrected_via_fleet_offsets(self):
+        now = time.time()
+        col = TraceCollector(offsets_fn=lambda: {"w-skew": 120.0},
+                             process="orch", tracer=trace.Tracer(capacity=8),
+                             registry=MetricsRegistry())
+        msg = SpanBatchMessage.new("w-skew", [span_row(
+            start_wall=now - 120.0)])
+        col.observe(msg, now=now)
+        t = col.export()["traces"][0]
+        corrected = t["spans"][0]
+        assert abs(corrected["start_wall"] - now) < 1e-6
+        assert corrected["process"] == "w-skew"
+        assert corrected["clock_offset_s"] == 120.0
+
+    def test_sent_wall_fallback_when_fleet_has_no_offset(self):
+        now = 10_000.0
+        col = TraceCollector(offsets_fn=lambda: {}, process="orch",
+                             tracer=trace.Tracer(capacity=8),
+                             registry=MetricsRegistry())
+        msg = SpanBatchMessage.new("w2", [span_row(start_wall=now - 60.0)])
+        msg.sent_wall = now - 60.0  # sender clock 60 s behind
+        col.observe(msg, now=now)
+        corrected = col.export()["traces"][0]["spans"][0]
+        # Offset estimated from send/receive walls: within transit slack.
+        assert abs(corrected["start_wall"] - now) < 1.0
+
+    def test_local_spans_merge_and_dedup_by_span_id(self):
+        tracer = trace.Tracer(capacity=16)
+        col = TraceCollector(process="orchestrator", tracer=tracer,
+                             registry=MetricsRegistry())
+        with tracer.span("orchestrator.dispatch", trace_id="t1"):
+            pass
+        local = tracer.spans()[0]
+        # The worker also ships a copy of the SAME span (single-process
+        # rigs see every span twice) — dedup must keep the count at 2.
+        rows = [local.to_dict(), span_row(trace_id="t1", span_id="w-span")]
+        col.observe(SpanBatchMessage.new("tpu-1", rows), now=time.time())
+        t = col.export()["traces"][0]
+        assert t["span_count"] == 2
+        assert t["processes"] == ["orchestrator", "tpu-1"]
+
+    def test_trace_lru_bound(self):
+        col = TraceCollector(process="o", tracer=trace.Tracer(capacity=4),
+                             max_traces=3, registry=MetricsRegistry())
+        for i in range(6):
+            col.observe(SpanBatchMessage.new("w", [span_row(
+                trace_id=f"t{i}", span_id=f"s{i}")]), now=float(i))
+        out = col.export()
+        assert len(out["traces"]) == 3
+        assert out["traces"][0]["trace_id"] == "t5"  # newest first
+
+    def test_per_trace_span_bound_counts_dropped(self):
+        col = TraceCollector(process="o", tracer=trace.Tracer(capacity=4),
+                             max_spans_per_trace=2,
+                             registry=MetricsRegistry())
+        rows = [span_row(span_id=f"s{i}") for i in range(5)]
+        col.observe(SpanBatchMessage.new("w", rows), now=1.0)
+        t = col.export()["traces"][0]
+        assert t["span_count"] == 2
+        assert t["dropped_spans"] == 3
+
+    def test_export_spans_sorted_by_corrected_wall(self):
+        col = TraceCollector(process="o", tracer=trace.Tracer(capacity=4),
+                             registry=MetricsRegistry())
+        rows = [span_row(span_id="late", start_wall=50.0),
+                span_row(span_id="early", start_wall=10.0)]
+        col.observe(SpanBatchMessage.new("w", rows), now=60.0)
+        t = col.export()["traces"][0]
+        assert [s["span_id"] for s in t["spans"]] == ["early", "late"]
+
+
+# ---------------------------------------------------------------------------
+class TestDtracesEndpoint:
+    def test_served_over_http_with_limit(self):
+        col = TraceCollector(process="o", tracer=trace.Tracer(capacity=4),
+                             registry=MetricsRegistry())
+        for i in range(3):
+            col.observe(SpanBatchMessage.new("w", [span_row(
+                trace_id=f"t{i}", span_id=f"s{i}")]), now=float(i))
+        server = serve_metrics(0, MetricsRegistry())
+        port = server.server_address[1]
+        set_dtraces_provider(col.export)
+        try:
+            body = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/dtraces", timeout=5).read())
+            assert len(body["traces"]) == 3
+            assert body["collector_process"] == "o"
+            body = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/dtraces?limit=1",
+                timeout=5).read())
+            assert len(body["traces"]) == 1
+        finally:
+            clear_dtraces_provider(col.export)
+            server.shutdown()
+
+    def test_404_without_provider(self):
+        server = serve_metrics(0, MetricsRegistry())
+        port = server.server_address[1]
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/dtraces", timeout=5)
+            assert e.value.code == 404
+        finally:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+class TestWorkerSpanExport:
+    def _worker(self, bus, **cfg_kw):
+        return TPUWorker(bus, FakeEngine(), registry=MetricsRegistry(),
+                         cfg=TPUWorkerConfig(worker_id="tpu-x",
+                                             heartbeat_s=3600,
+                                             stall_warn_s=0, **cfg_kw))
+
+    def test_export_spans_publishes_batch_on_topic(self):
+        trace.configure(capacity=2048)
+        bus = InMemoryBus()
+        got = []
+        bus.subscribe(TOPIC_SPANS, lambda p: got.append(
+            SpanBatchMessage.from_dict(p)))
+        worker = self._worker(bus)
+        worker.start()
+        bus.publish(TOPIC_INFERENCE_BATCHES, make_batch().to_dict())
+        assert worker.drain(timeout_s=10)
+        assert worker.export_spans() > 0
+        assert got and got[0].worker_id == "tpu-x"
+        names = {s["name"] for s in got[0].spans}
+        assert "tpu_worker.process" in names
+        # The cursor advanced: nothing new to ship.
+        assert worker.export_spans() == 0
+        worker.stop(timeout_s=5)
+
+    def test_span_export_cadence_decoupled_from_heartbeat(self):
+        # A 3600 s heartbeat must not stretch a short export interval:
+        # _wait_with_span_exports fires exports on their own cadence.
+        trace.configure(capacity=2048)
+        bus = InMemoryBus()
+        got = []
+        bus.subscribe(TOPIC_SPANS, lambda p: got.append(p))
+        worker = self._worker(bus, span_export_interval_s=0.05)
+        with trace.span("tpu_worker.process", trace_id="t-cadence"):
+            pass
+        worker._last_span_export = time.monotonic() - 1.0  # overdue
+        worker._wait_with_span_exports(0.2)
+        assert got, "export did not fire inside the heartbeat wait"
+
+    def test_queue_gauge_is_time_weighted(self):
+        bus = InMemoryBus()
+        worker = self._worker(bus)
+        worker.start()
+        # A burst through the worker leaves the gauge at the window's
+        # time-weighted mean (>= 0), not pinned to the last edge value —
+        # and the heartbeat's resample keeps it decaying.
+        bus.publish(TOPIC_INFERENCE_BATCHES, make_batch().to_dict())
+        assert worker.drain(timeout_s=10)
+        assert worker._depth.sample() >= 0.0
+        worker.stop(timeout_s=5)
+
+
+# ---------------------------------------------------------------------------
+class TestRenderers:
+    def _dtraces(self):
+        spans = [
+            span_row(name="orchestrator.dispatch", span_id="a",
+                     start_wall=1000.0, duration_ms=5.0),
+            span_row(name="tpu_worker.process", span_id="b", parent_id="a",
+                     start_wall=1000.005, duration_ms=100.0),
+            span_row(name="engine.compute", span_id="c", parent_id="b",
+                     start_wall=1000.010, duration_ms=80.0),
+        ]
+        for s in spans:
+            s["process"] = ("orchestrator" if s["span_id"] == "a"
+                            else "tpu-1")
+            s["clock_offset_s"] = 0.0 if s["span_id"] == "a" else 0.05
+        return {"traces": [{"trace_id": "t1", "span_count": 3,
+                            "processes": ["orchestrator", "tpu-1"],
+                            "duration_ms": 105.0, "spans": spans}],
+                "collector_process": "orchestrator",
+                "workers": {"tpu-1": {"applied_offset_s": 0.05,
+                                      "spans": 2, "dropped": 0}}}
+
+    def test_critpath_attribution_and_render(self, tmp_path):
+        data = self._dtraces()
+        att = critpath.attribute(data)
+        assert att["traces_attributed"] == 1
+        assert max(att["stage_shares"], key=att["stage_shares"].get) == \
+            "device"
+        report = critpath.render(data)
+        assert "engine.compute" in report and "device" in report
+        # File + bundle loading both resolve.
+        p = tmp_path / "dtraces.json"
+        p.write_text(json.dumps(data))
+        assert critpath.load(str(p))["traces"]
+        b = tmp_path / "bundle.json"
+        b.write_text(json.dumps({"schema": "dct-postmortem-v1",
+                                 "dtraces": data}))
+        assert critpath.load(str(b))["traces"]
+
+    def test_critpath_selfcheck_passes(self, capsys):
+        assert critpath.main(["--selfcheck"]) == 0
+        assert "selfcheck ok" in capsys.readouterr().out
+
+    def test_stage_map_covers_serving_span_names(self):
+        for name, stage in (("engine.run_tokenized", "host"),
+                            ("engine.run", "host"),
+                            ("engine.compute", "device"),
+                            ("asr.transcribe", "device"),
+                            ("media.reentry", "reentry"),
+                            ("tpu_worker.queue_wait", "queue_wait")):
+            assert critpath.stage_of(name) == stage, name
+
+    def test_trace_dump_collector_lanes(self, tmp_path, capsys):
+        p = tmp_path / "dtraces.json"
+        p.write_text(json.dumps(self._dtraces()))
+        assert trace_dump.main([str(p), "--collector"]) == 0
+        out = capsys.readouterr().out
+        assert "lane orchestrator" in out
+        assert "lane tpu-1" in out
+        assert "engine.compute" in out
+
+    def test_trace_dump_collector_empty_message(self, tmp_path, capsys):
+        p = tmp_path / "empty.json"
+        p.write_text(json.dumps({"traces": []}))
+        assert trace_dump.main([str(p), "--collector"]) == 0
+        assert "no assembled" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+class TestEndToEndDistributedTrace:
+    """Acceptance: orchestrator + TPU worker on one in-memory bus; the
+    worker ships its spans on TOPIC_SPANS, the orchestrator's collector
+    assembles ONE trace whose spans originate from both processes, and
+    critpath renders a bottleneck attribution for it."""
+
+    def _sm(self, tmp_path, sub):
+        from distributed_crawler_tpu.state import (
+            CompositeStateManager,
+            SqlConfig,
+            StateConfig,
+        )
+
+        return CompositeStateManager(StateConfig(
+            crawl_id="c1", crawl_execution_id="e1",
+            storage_root=str(tmp_path / sub),
+            sql=SqlConfig(url=":memory:")))
+
+    def test_one_trace_spans_both_processes(self, tmp_path):
+        from distributed_crawler_tpu.config import CrawlerConfig
+        from distributed_crawler_tpu.orchestrator import Orchestrator
+
+        trace.configure(capacity=4096)
+        bus = InMemoryBus()
+        cfg = CrawlerConfig(crawl_id="c1", platform="telegram",
+                            skip_media_download=True,
+                            sampling_method="channel")
+        orch = Orchestrator("c1", cfg, bus, self._sm(tmp_path, "orch"))
+        orch.start(["chana"], background=False)
+        worker = TPUWorker(
+            bus, FakeEngine(), registry=MetricsRegistry(),
+            cfg=TPUWorkerConfig(worker_id="tpu-e2e", heartbeat_s=3600,
+                                stall_warn_s=0))
+        worker.start()
+        try:
+            batch = make_batch()
+            # The bridge's dispatch leg: the root span of the batch's
+            # trace opens in the orchestrator process.
+            with trace.span("orchestrator.dispatch",
+                            trace_id=batch.trace_id,
+                            records=len(batch.records)):
+                bus.publish(TOPIC_INFERENCE_BATCHES, batch.to_dict())
+            assert worker.drain(timeout_s=10)
+            assert worker.export_spans() > 0
+            out = orch.get_dtraces()
+            wanted = [t for t in out["traces"]
+                      if t["trace_id"] == batch.trace_id]
+            assert wanted, [t["trace_id"] for t in out["traces"]]
+            t = wanted[0]
+            procs = {s["process"] for s in t["spans"]}
+            assert "tpu-e2e" in procs and "orchestrator" in procs
+            assert set(t["processes"]) >= {"tpu-e2e", "orchestrator"}
+            names = {s["name"] for s in t["spans"]}
+            assert "orchestrator.dispatch" in names
+            assert "tpu_worker.process" in names
+            # Offsets were estimated and applied (in-process: ~0 ms).
+            offsets = [abs(s.get("clock_offset_s", 0.0))
+                       for s in t["spans"] if s["process"] == "tpu-e2e"]
+            assert offsets and max(offsets) < 1.0
+            # critpath renders a bottleneck attribution for the
+            # assembled trace (the acceptance criterion's last leg).
+            report = critpath.render(out, trace_id=batch.trace_id)
+            assert "bottleneck shares" in report
+            assert batch.trace_id in report
+        finally:
+            worker.stop(timeout_s=5)
+            orch.stop()

@@ -65,6 +65,12 @@ def selfcheck() -> int:
     if rc != 0:
         print("perfreport selfcheck FAILED", file=sys.stderr)
         return rc
+    rc = subprocess.call(
+        [sys.executable, os.path.join(repo, "tools", "critpath.py"),
+         "--selfcheck"], cwd=repo)
+    if rc != 0:
+        print("critpath selfcheck FAILED", file=sys.stderr)
+        return rc
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     return subprocess.call(
         [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
@@ -77,7 +83,10 @@ def selfcheck() -> int:
          os.path.join(repo, "tests", "test_loadgen.py"),
          # media/: chunker scheduling, ASRWorker isolation, and the
          # wav -> transcript -> embedding e2e (the ASR serving loop).
-         os.path.join(repo, "tests", "test_asr_serve.py")],
+         os.path.join(repo, "tests", "test_asr_serve.py"),
+         # distributed traces: span export/collection, /dtraces,
+         # occupancy math, and the orch+worker assembly e2e.
+         os.path.join(repo, "tests", "test_distributed_trace.py")],
         env=env, cwd=repo)
 
 

@@ -1,0 +1,340 @@
+#!/usr/bin/env python
+"""Critical-path attribution over assembled distributed traces.
+
+Usage:
+    python tools/critpath.py http://127.0.0.1:9102        # live /dtraces
+    python tools/critpath.py dtraces.json                 # saved export
+    python tools/critpath.py postmortem_....json          # bundle w/ dtraces
+    python tools/critpath.py http://host:port --trace trace_2026...
+    python tools/critpath.py --selfcheck                  # CI smoke
+
+The trace collector (`orchestrator/tracecollect.py`, served at
+``/dtraces``) assembles ONE trace per work item across orchestrator →
+bus → worker processes with clock-offset-corrected walls.  This tool
+turns those trees into a judgement: *which stage is the bottleneck*.
+
+For every trace it:
+
+1. builds the span tree by parent link (spans whose parent was sampled
+   away or lives in an unexported process become roots — attribution
+   degrades, never crashes);
+2. walks the **critical path**: from each root, repeatedly descend into
+   the child whose [start, end] interval ends LAST (the child still
+   running when the parent finished is what the parent was waiting on;
+   ties break to the longer child), accumulating each path node's
+   *exclusive* time — its duration minus the part covered by its
+   children's union;
+3. maps span names onto the pipeline stages (crawl → dispatch → bus →
+   queue_wait → device → host → writeback → reentry) and aggregates
+   each stage's share of summed critical-path time across traces — the
+   one-table answer to "where would a millisecond of optimisation buy
+   the most".
+
+Stdlib only, like tools/trace_dump.py / perfreport.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+# Pipeline-stage map: first matching prefix wins, "other" catches the
+# rest.  Order matters (engine.compute is device, engine.* host).
+STAGE_PREFIXES: List[Tuple[str, Tuple[str, ...]]] = [
+    ("crawl", ("worker.process", "worker.publish_result")),
+    ("dispatch", ("orchestrator.dispatch", "media.dispatch",
+                  "orchestrator.requeue", "orchestrator.reassign",
+                  "orchestrator.resume_requeue")),
+    ("bus", ("bus.deliver",)),
+    ("queue_wait", ("tpu_worker.queue_wait", "asr_worker.queue_wait")),
+    ("device", ("engine.compute", "engine.unpack", "asr.transcribe")),
+    ("host", ("engine.tokenize", "engine.pack", "engine.device_put",
+              "engine.run", "engine.run_tokenized", "asr_worker.chunk",
+              "tpu_worker.coalesce", "tpu_worker.process",
+              "asr_worker.coalesce", "asr_worker.process")),
+    ("writeback", ("tpu_worker.commit", "asr_worker.commit",
+                   "tpu_worker.ack", "asr_worker.ack",
+                   "orchestrator.handle_result")),
+    ("reentry", ("media.reentry",)),
+]
+
+
+def stage_of(name: str) -> str:
+    for stage, prefixes in STAGE_PREFIXES:
+        for p in prefixes:
+            if name == p or name.startswith(p + "."):
+                return stage
+    return "other"
+
+
+def load(source: str, limit: int = 0) -> Dict[str, Any]:
+    """A /dtraces body from a live service URL, a saved export, or a
+    postmortem bundle carrying a ``dtraces`` key."""
+    if source.startswith(("http://", "https://")):
+        url = source.rstrip("/")
+        if not url.endswith("/dtraces"):
+            url += "/dtraces"
+        if limit:
+            url += f"?limit={limit}"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            data = json.load(resp)
+    else:
+        with open(source, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    if isinstance(data, dict) and "dtraces" in data \
+            and "traces" not in data:
+        data = data["dtraces"]  # postmortem bundle
+    if not isinstance(data, dict) or "traces" not in data:
+        raise ValueError("no 'traces' in input (want a /dtraces export "
+                         "or a postmortem bundle with a 'dtraces' key)")
+    return data
+
+
+def _interval(s: Dict[str, Any]) -> Tuple[float, float]:
+    start = float(s.get("start_wall") or 0.0)
+    return start, start + float(s.get("duration_ms") or 0.0) / 1000.0
+
+
+def _union_len(ivals: List[Tuple[float, float]]) -> float:
+    total = 0.0
+    cur_s = cur_e = None
+    for s, e in sorted(ivals):
+        if e <= s:
+            continue
+        if cur_s is None:
+            cur_s, cur_e = s, e
+        elif s <= cur_e:
+            cur_e = max(cur_e, e)
+        else:
+            total += cur_e - cur_s
+            cur_s, cur_e = s, e
+    if cur_s is not None:
+        total += cur_e - cur_s
+    return total
+
+
+def critical_path(spans: List[Dict[str, Any]]
+                  ) -> List[Tuple[Dict[str, Any], float]]:
+    """[(span, exclusive_seconds)] along the critical path of one
+    assembled trace (roots may be multiple when parents were sampled
+    away: the path starts from the root whose subtree ends last)."""
+    ids = {s.get("span_id") for s in spans if s.get("span_id")}
+    children: Dict[str, List[Dict[str, Any]]] = {}
+    roots: List[Dict[str, Any]] = []
+    for s in spans:
+        parent = s.get("parent_id") or ""
+        if parent and parent in ids and parent != s.get("span_id"):
+            children.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+    if not roots:
+        return []
+
+    def exclusive(span: Dict[str, Any]) -> float:
+        s0, e0 = _interval(span)
+        kids = children.get(span.get("span_id"), [])
+        covered = _union_len([
+            (max(s0, ks), min(e0, ke))
+            for ks, ke in (_interval(k) for k in kids)
+            if min(e0, ke) > max(s0, ks)])
+        return max(0.0, (e0 - s0) - covered)
+
+    # Start from the root whose subtree ends last (the one the trace
+    # was waiting on); then always descend into the last-ending child.
+    def subtree_end(span: Dict[str, Any], depth: int = 0) -> float:
+        end = _interval(span)[1]
+        if depth > 64:  # defensive: corrupted parent links
+            return end
+        for k in children.get(span.get("span_id"), []):
+            end = max(end, subtree_end(k, depth + 1))
+        return end
+
+    path: List[Tuple[Dict[str, Any], float]] = []
+    node = max(roots, key=lambda r: (subtree_end(r), _interval(r)[1]))
+    seen = set()
+    while node is not None and id(node) not in seen:
+        seen.add(id(node))
+        path.append((node, exclusive(node)))
+        kids = children.get(node.get("span_id"), [])
+        node = max(kids, key=lambda k: (_interval(k)[1],
+                                        float(k.get("duration_ms") or 0.0))) \
+            if kids else None
+    return path
+
+
+def attribute(data: Dict[str, Any],
+              trace_id: str = "") -> Dict[str, Any]:
+    """Aggregate critical-path attribution across the export's traces
+    (or just ``trace_id``)."""
+    by_stage: Dict[str, float] = {}
+    by_name: Dict[str, float] = {}
+    per_trace: List[Dict[str, Any]] = []
+    for t in data.get("traces", []):
+        if trace_id and t.get("trace_id") != trace_id:
+            continue
+        path = critical_path(t.get("spans", []))
+        if not path:
+            continue
+        total = sum(ex for _, ex in path)
+        steps = []
+        for span, ex in path:
+            name = span.get("name", "?")
+            by_stage[stage_of(name)] = by_stage.get(stage_of(name), 0.0) + ex
+            by_name[name] = by_name.get(name, 0.0) + ex
+            steps.append({
+                "name": name,
+                "process": span.get("process", "?"),
+                "exclusive_ms": round(ex * 1000.0, 3),
+                "duration_ms": span.get("duration_ms", 0.0),
+            })
+        per_trace.append({
+            "trace_id": t.get("trace_id"),
+            "processes": t.get("processes", []),
+            "critical_path_ms": round(total * 1000.0, 3),
+            "trace_duration_ms": t.get("duration_ms", 0.0),
+            "steps": steps,
+        })
+    total_all = sum(by_stage.values()) or 1e-12
+    return {
+        "traces_attributed": len(per_trace),
+        "stage_shares": {k: round(v / total_all, 4)
+                         for k, v in sorted(by_stage.items(),
+                                            key=lambda kv: -kv[1])},
+        "stage_ms": {k: round(v * 1000.0, 3) for k, v in by_stage.items()},
+        "span_ms": {k: round(v * 1000.0, 3)
+                    for k, v in sorted(by_name.items(),
+                                       key=lambda kv: -kv[1])},
+        "per_trace": per_trace,
+    }
+
+
+def render(data: Dict[str, Any], trace_id: str = "",
+           max_traces: int = 3) -> str:
+    """The one-page report."""
+    att = attribute(data, trace_id=trace_id)
+    lines: List[str] = []
+    n_held = len(data.get("traces", []))
+    lines.append(f"critical-path attribution over {att['traces_attributed']}"
+                 f" assembled trace(s) ({n_held} held by the collector)")
+    workers = data.get("workers") or {}
+    if workers:
+        lines.append("")
+        lines.append("exporting workers (clock offsets applied):")
+        for wid, st in sorted(workers.items()):
+            lines.append(
+                f"  {wid:<20} offset {1000.0 * float(st.get('applied_offset_s') or 0.0):+8.1f} ms"
+                f"  spans {st.get('spans', 0):>6}  dropped "
+                f"{st.get('dropped', 0)}")
+    if not att["traces_attributed"]:
+        lines.append("")
+        lines.append("(no attributable traces — have the workers "
+                     "exported spans yet? see span_export_interval_s)")
+        return "\n".join(lines)
+    lines.append("")
+    lines.append("bottleneck shares (exclusive critical-path time):")
+    for stage, share in att["stage_shares"].items():
+        ms = att["stage_ms"].get(stage, 0.0)
+        bar = "#" * max(1, int(share * 40))
+        lines.append(f"  {stage:<12} {share * 100:>6.1f}%  "
+                     f"{ms:>10.2f} ms  {bar}")
+    lines.append("")
+    lines.append("top spans on the critical path:")
+    for name, ms in list(att["span_ms"].items())[:8]:
+        lines.append(f"  {name:<28} {ms:>10.2f} ms")
+    shown = att["per_trace"][:max_traces] if not trace_id \
+        else att["per_trace"]
+    for tr in shown:
+        lines.append("")
+        lines.append(f"trace {tr['trace_id']}  "
+                     f"(critical path {tr['critical_path_ms']:.2f} ms of "
+                     f"{tr['trace_duration_ms']:.2f} ms, processes: "
+                     f"{', '.join(tr['processes']) or '?'})")
+        for step in tr["steps"]:
+            lines.append(f"  -> {step['name']:<26} "
+                         f"[{step['process']:<14}] "
+                         f"excl {step['exclusive_ms']:>9.2f} ms")
+    return "\n".join(lines)
+
+
+# --- selfcheck ---------------------------------------------------------------
+
+def _selfcheck() -> int:
+    """CI smoke: attribution over a hand-built two-process trace must
+    find the device stage dominant and keep every stage share sane."""
+    t0 = 1000.0
+    spans = [
+        {"name": "orchestrator.dispatch", "trace_id": "t1", "span_id": "a",
+         "parent_id": "", "start_wall": t0, "duration_ms": 5.0,
+         "attrs": {}, "process": "orchestrator"},
+        {"name": "tpu_worker.process", "trace_id": "t1", "span_id": "b",
+         "parent_id": "a", "start_wall": t0 + 0.005,
+         "duration_ms": 100.0, "attrs": {}, "process": "tpu-1"},
+        {"name": "engine.compute", "trace_id": "t1", "span_id": "c",
+         "parent_id": "b", "start_wall": t0 + 0.010,
+         "duration_ms": 80.0, "attrs": {}, "process": "tpu-1"},
+        {"name": "tpu_worker.queue_wait", "trace_id": "t1", "span_id": "d",
+         "parent_id": "b", "start_wall": t0 + 0.005,
+         "duration_ms": 5.0, "attrs": {}, "process": "tpu-1"},
+    ]
+    data = {"traces": [{
+        "trace_id": "t1", "span_count": len(spans),
+        "processes": ["orchestrator", "tpu-1"], "duration_ms": 105.0,
+        "spans": spans,
+    }], "workers": {"tpu-1": {"applied_offset_s": 0.12, "spans": 3,
+                              "dropped": 0}}}
+    att = attribute(data)
+    assert att["traces_attributed"] == 1, att
+    shares = att["stage_shares"]
+    assert max(shares, key=shares.get) == "device", shares
+    assert abs(sum(shares.values()) - 1.0) < 0.01, shares
+    path_names = [s["name"] for s in att["per_trace"][0]["steps"]]
+    assert path_names == ["orchestrator.dispatch", "tpu_worker.process",
+                          "engine.compute"], path_names
+    report = render(data)
+    for needle in ("bottleneck shares", "device", "engine.compute",
+                   "clock offsets applied"):
+        assert needle in report, f"missing {needle!r} in report"
+    # An empty export must render, not crash.
+    assert "no attributable traces" in render({"traces": []})
+    print("critpath selfcheck ok")
+    print(report)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="critical-path attribution from a /dtraces export")
+    p.add_argument("source", nargs="?", default="",
+                   help="service base URL (or /dtraces URL), a saved "
+                        "JSON export, or a postmortem bundle")
+    p.add_argument("--trace", default="",
+                   help="attribute only this trace id (full step list)")
+    p.add_argument("--limit", type=int, default=0,
+                   help="cap the number of traces fetched")
+    p.add_argument("--json", action="store_true",
+                   help="emit the attribution as JSON instead of text")
+    p.add_argument("--selfcheck", action="store_true",
+                   help="run the built-in smoke check and exit")
+    args = p.parse_args(argv)
+
+    if args.selfcheck:
+        return _selfcheck()
+    if not args.source:
+        p.error("source required (or --selfcheck)")
+    try:
+        data = load(args.source, limit=args.limit)
+    except Exception as e:
+        print(f"error: failed to load {args.source}: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(attribute(data, trace_id=args.trace)))
+        return 0
+    print(render(data, trace_id=args.trace))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

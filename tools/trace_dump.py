@@ -6,15 +6,21 @@ Usage:
     python tools/trace_dump.py traces.json                    # saved export
     python tools/trace_dump.py http://host:port --trace trace_2026...
     python tools/trace_dump.py http://host:port --limit 20
+    python tools/trace_dump.py http://host:port --collector   # /dtraces
+    python tools/trace_dump.py postmortem_....json --collector
 
-Two views:
+Three views:
 - per-stage aggregate: for every span name, count / p50 / max / total ms —
   the "where did the milliseconds go" table the tracing layer exists for;
 - per-trace tree (with --trace, or --last for the newest): spans indented
-  by parent link, in start order, with durations and attrs.
+  by parent link, in start order, with durations and attrs;
+- collector view (``--collector``): ASSEMBLED distributed traces from the
+  orchestrator's ``/dtraces`` endpoint (or a postmortem bundle carrying a
+  ``dtraces`` key), one lane per process, span walls already corrected
+  onto the collector's clock (`orchestrator/tracecollect.py`).
 
-Stdlib only; works against the metrics server's /traces endpoint
-(`utils/metrics.py`) or a JSON file saved from it.
+Stdlib only; works against the metrics server's /traces + /dtraces
+endpoints (`utils/metrics.py`) or a JSON file saved from them.
 """
 
 from __future__ import annotations
@@ -26,17 +32,22 @@ import urllib.request
 from typing import Any, Dict, List
 
 
-def load(source: str, limit: int = 0) -> Dict[str, Any]:
+def load(source: str, limit: int = 0,
+         endpoint: str = "/traces") -> Dict[str, Any]:
     if source.startswith(("http://", "https://")):
         url = source.rstrip("/")
-        if not url.endswith("/traces"):
-            url += "/traces"
+        if not url.endswith(endpoint):
+            url += endpoint
         if limit:
             url += f"?limit={limit}"
         with urllib.request.urlopen(url, timeout=10) as resp:
             return json.load(resp)
     with open(source, "r", encoding="utf-8") as f:
-        return json.load(f)
+        data = json.load(f)
+    if endpoint == "/dtraces" and isinstance(data, dict) \
+            and "dtraces" in data and "traces" not in data:
+        return data["dtraces"]  # postmortem bundle
+    return data
 
 
 def _quantile(sorted_vals: List[float], q: float) -> float:
@@ -97,6 +108,39 @@ def trace_tree(t: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def collector_tree(t: Dict[str, Any]) -> str:
+    """One assembled distributed trace as per-process lanes: each
+    process's spans rendered through the SAME span-tree walker (spans
+    whose parent lives in another process's lane root that lane — the
+    cross-process link is the lane header's job)."""
+    spans = t.get("spans", [])
+    by_proc: Dict[str, List[Dict[str, Any]]] = {}
+    for s in spans:
+        by_proc.setdefault(s.get("process", "?"), []).append(s)
+    start = min((s.get("start_wall", 0.0) for s in spans), default=0.0)
+    lines = [f"trace {t['trace_id']}  "
+             f"({t.get('span_count', len(spans))} spans over "
+             f"{len(by_proc)} process(es), "
+             f"{t.get('duration_ms', 0.0):.2f} ms"
+             + (f", {t.get('dropped_spans')} dropped"
+                if t.get("dropped_spans") else "") + ")"]
+    for proc in sorted(by_proc):
+        rows = by_proc[proc]
+        first = min(s.get("start_wall", 0.0) for s in rows)
+        offsets = {s.get("clock_offset_s", 0.0) for s in rows}
+        off = next(iter(offsets)) if len(offsets) == 1 else None
+        lines.append("")
+        lines.append(
+            f"  lane {proc}  (+{(first - start) * 1000.0:.2f} ms into "
+            f"trace" + (f", clock offset {off * 1000.0:+.1f} ms"
+                        if off else "") + ")")
+        sub = trace_tree({"trace_id": t["trace_id"], "spans": rows,
+                          "span_count": len(rows),
+                          "duration_ms": t.get("duration_ms", 0.0)})
+        lines.extend("  " + ln for ln in sub.splitlines()[1:])
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="per-stage latency tables from a /traces export")
@@ -108,14 +152,39 @@ def main(argv=None) -> int:
                    help="print the span tree of the newest trace")
     p.add_argument("--limit", type=int, default=0,
                    help="cap the number of traces fetched")
+    p.add_argument("--collector", action="store_true",
+                   help="read ASSEMBLED distributed traces from /dtraces "
+                        "(or a postmortem bundle's dtraces key) and "
+                        "render per-process lanes")
     args = p.parse_args(argv)
 
     try:
-        data = load(args.source, limit=args.limit)
+        data = load(args.source, limit=args.limit,
+                    endpoint="/dtraces" if args.collector else "/traces")
     except Exception as e:
         print(f"error: failed to load {args.source}: {e}", file=sys.stderr)
         return 2
     traces = data.get("traces", [])
+    if args.collector:
+        if not traces:
+            print("no assembled distributed traces (have the workers "
+                  "exported spans yet? see span_export_interval_s)")
+            return 0
+        wanted = traces
+        if args.trace:
+            wanted = [t for t in traces if t["trace_id"] == args.trace]
+            if not wanted:
+                print(f"error: trace {args.trace!r} not held "
+                      f"({len(traces)} assembled)", file=sys.stderr)
+                return 1
+        elif args.last:
+            wanted = traces[:1]
+        print(f"{len(traces)} assembled distributed trace(s) from "
+              f"collector {data.get('collector_process', '?')!r}\n")
+        for t in wanted[:args.limit or len(wanted)]:
+            print(collector_tree(t))
+            print()
+        return 0
     if not traces:
         print("no traces recorded (is --trace-buffer > 0 and has any "
               "traced message flowed?)")
