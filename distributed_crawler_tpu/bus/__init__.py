@@ -11,6 +11,10 @@ Streams, SURVEY.md §2.4); this build brings the bus in-tree:
 - `inmemory`: broker-free bus with the reference's at-least-once semantics
   (decode error -> drop, handler error -> retry; `distributed/pubsub.go:149-254`)
 - `grpc_bus`: DCN transport — a generic gRPC publish/subscribe service
+- `spool`: the broker's durable memory — per-topic WAL + persisted
+  dead-letter queue (`GrpcBusServer(spool_dir=...)` survives its own death)
+- `outbox`: bounded durable publisher outbox — a broker outage buffers
+  and retries instead of raising into the serving path
 
 On-slice tensor communication is NOT this bus's job: that rides XLA
 collectives over ICI (see `parallel/`).
@@ -24,6 +28,8 @@ from .codec import (
     encode_frame,
 )
 from .inmemory import InMemoryBus
+from .outbox import DurableOutbox, OutboxBus, OutboxConfig, OutboxFull
+from .spool import BusSpool, DeadLetter, DeadLetterSpool, TopicSpool
 from .messages import (
     PRIORITY_HIGH,
     PRIORITY_LOW,
@@ -81,6 +87,14 @@ __all__ = [
     "GrpcBusServer",
     "GrpcBusClient",
     "RemoteBus",
+    "BusSpool",
+    "TopicSpool",
+    "DeadLetterSpool",
+    "DeadLetter",
+    "DurableOutbox",
+    "OutboxBus",
+    "OutboxConfig",
+    "OutboxFull",
 ]
 
 

@@ -17,8 +17,20 @@ carries a delivery ID and stays "in flight" on the server until acked.
 Unacked frames are requeued when the pulling stream dies, when the client
 nacks (handler exhausted its retries), or when the ack deadline passes —
 so a worker crash mid-handler no longer loses work.  A frame redelivered
-more than ``max_attempts`` times is dead-lettered (logged + dropped),
-bounding poison-message loops.
+more than ``max_attempts`` times is dead-lettered, bounding poison-message
+loops: with a spool configured it lands in the persisted dead-letter
+queue (`bus/spool.py`; list/inspect/replay via ``tools/dlq.py`` or the
+``/dlq`` endpoint), without one it is logged and dropped — either way
+counted in ``bus_dead_letters_total{topic}`` and flight-recorded.
+
+Broker durability (``spool_dir``): the reference's broker was a Redis
+behind a Dapr sidecar — it survived its own restarts.  Passing
+``spool_dir`` gives this server the same property: every pull-topic frame
+is journaled in a per-topic WAL (enqueue/requeue/ack/dead events,
+`bus/spool.py`), and a NEW server constructed over the same directory
+rebuilds the queued + unacked-in-flight frame set — attempt counts and
+frame ids preserved — so a broker crash redelivers instead of losing.
+The publisher half of the outage story lives in `bus/outbox.py`.
 
 Tensor traffic never rides this bus: on-slice collectives are XLA/ICI
 (`parallel/`).  This is coordination + record streaming only.
@@ -39,8 +51,11 @@ from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 import grpc
 
-from ..utils import resilience, trace
+from ..utils import flight, resilience, trace
+from ..utils.metrics import REGISTRY, MetricsRegistry
+from .outbox import DurableOutbox, OutboxConfig
 from .payload import serialize_payload
+from .spool import BusSpool
 
 logger = logging.getLogger("dct.bus.grpc")
 
@@ -70,6 +85,9 @@ def _identity(b: bytes) -> bytes:
 class _QueuedFrame:
     payload: bytes
     attempts: int = 0
+    # Stable spool frame id (minted at enqueue, kept across requeues AND
+    # broker generations); "" when the server runs without a spool.
+    fid: str = ""
 
 
 @dataclass
@@ -78,6 +96,7 @@ class _Inflight:
     attempts: int
     deadline: float
     stream_id: int
+    fid: str = ""
 
 
 @dataclass
@@ -95,10 +114,38 @@ class GrpcBusServer:
 
     def __init__(self, address: str = "127.0.0.1:50551", max_workers: int = 8,
                  ack_timeout_s: float = DEFAULT_ACK_TIMEOUT_S,
-                 max_attempts: int = DEFAULT_MAX_ATTEMPTS):
+                 max_attempts: int = DEFAULT_MAX_ATTEMPTS,
+                 spool_dir: Optional[str] = None,
+                 registry: MetricsRegistry = REGISTRY):
         self.address = address
         self.ack_timeout_s = ack_timeout_s
         self.max_attempts = max_attempts
+        # Durability (bus/spool.py): with a spool dir every pull-topic
+        # frame is WAL-journaled and dead letters persist; without one
+        # the server keeps the historical RAM-only behavior.
+        self._spool = BusSpool(spool_dir) if spool_dir else None
+        self._killed = False
+        self.m_dead = registry.counter(
+            "bus_dead_letters_total",
+            "frames dead-lettered per topic (exhausted max_attempts or a "
+            "local handler's retry budget)")
+        self.m_redeliveries = registry.counter(
+            "bus_redeliveries_total",
+            "frames requeued for redelivery per topic (nack, ack timeout, "
+            "or pull-stream death)")
+        self.m_unrouted = registry.counter(
+            "bus_dropped_no_route_total",
+            "publishes that reached a topic with no handler and no pull "
+            "queue (held in the DLQ spool when durability is on, dropped "
+            "otherwise)")
+        # WARN once per topic (then debug): a fan-out topic nobody
+        # subscribed must be visible, not a per-frame log storm.
+        self._unrouted_warned: set = set()
+        # Unrouted frames held in the DLQ are capped per topic: a
+        # high-volume announce stream with no consumer must not grow the
+        # spool without bound (the counter keeps the true total).
+        self._unrouted_spooled: Dict[str, int] = {}
+        self.unrouted_spool_cap = 1024
         # Local-handler delivery policy: the backoff/attempt schedule is
         # declared ONCE (utils/resilience.py) instead of hand-rolled per
         # loop; a handler raising a FLOOD_WAIT-style error (carrying
@@ -141,16 +188,73 @@ class GrpcBusServer:
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),))
         self.bound_port = self._server.add_insecure_port(address)
+        if self._spool is not None:
+            self._rebuild_from_spool()
+
+    def _rebuild_from_spool(self) -> None:
+        """Resume path: rebuild every spooled topic's queue (queued AND
+        unacked-in-flight frames of the dead generation, attempt counts
+        preserved) before the first RPC can land."""
+        # The per-topic unrouted-hold cap counts what is already ON DISK,
+        # not just this generation's appends — a supervisor restart loop
+        # must not grow the DLQ by another cap's worth per generation.
+        for topic in self._spool.dlq.topics():
+            held = sum(1 for e in self._spool.dlq.entries(topic)
+                       if e.reason == "no_route" and not e.replayed)
+            if held:
+                self._unrouted_spooled[topic] = held
+        restored: Dict[str, int] = {}
+        for topic in self._spool.existing_topics():
+            tq = self._ensure_topic_queue(topic)
+            restored[topic] = tq.q.qsize()
+        if restored:
+            flight.record("bus_resume", address=self.address,
+                          restored=restored,
+                          frames=sum(restored.values()))
+            logger.info("bus spool resume: %d frame(s) restored across "
+                        "%d topic(s): %s", sum(restored.values()),
+                        len(restored), restored)
+
+    def _ensure_topic_queue(self, topic: str) -> _TopicQueue:
+        """Create a pull queue on first use; with a spool, the topic's
+        live WAL frames are replayed into it exactly once."""
+        with self._lock:
+            tq = self._pull_queues.get(topic)
+            if tq is not None:
+                return tq
+            tq = _TopicQueue()
+            if self._spool is not None:
+                for frame in self._spool.replay(topic):
+                    tq.q.put(_QueuedFrame(frame.payload, frame.attempts,
+                                          frame.fid))
+            self._pull_queues[topic] = tq
+            return tq
 
     # --- service ----------------------------------------------------------
     def _publish_rpc(self, request: bytes, context) -> bytes:
+        if self._killed:
+            raise RuntimeError("bus server killed")
         topic, payload = _decode_envelope(request)
         with self._lock:
             has_handlers = bool(self._handlers.get(topic))
             tq = self._pull_queues.get(topic)
             lq = self._local_queues.get(topic) if has_handlers else None
+        if tq is None and lq is None:
+            # No handler, no pull queue: this used to ack b"ok" and
+            # silently drop the frame.  Always count (+ WARN once per
+            # topic); with durability on, hold it in the dead-letter
+            # spool (reason ``no_route``, capped per topic) so an
+            # operator can `tools/dlq.py --replay` it once a consumer
+            # exists instead of losing it forever.
+            self._record_unrouted(topic, payload)
         if tq is not None:
-            tq.q.put(_QueuedFrame(payload))
+            fid = ""
+            if self._spool is not None:
+                # WAL append BEFORE the in-memory enqueue: a crash
+                # between the two redelivers on restart instead of
+                # acking a frame that never survived.
+                fid = self._spool.enqueue(topic, payload)
+            tq.q.put(_QueuedFrame(payload, 0, fid))
         if lq is not None:
             try:
                 decoded = json.loads(payload.decode("utf-8"))
@@ -168,6 +272,8 @@ class GrpcBusServer:
         # answered b"ok" to must reach local handlers even across close()
         # (retry backoffs short-circuit once _stop is set).
         while True:
+            if self._killed:
+                return  # kill(): RAM state is gone, nothing drains
             try:
                 decoded = lq.get(timeout=0.25)
             except queue.Empty:
@@ -186,11 +292,13 @@ class GrpcBusServer:
                             resilience.retry_call(
                                 handler, decoded, retry=self._local_retry,
                                 op=f"bus.local.{topic}", stop=self._stop)
-                        except Exception:
-                            self._count_dead_letter()
-                            logger.error(
-                                "dead-lettering local delivery on %s after "
-                                "%d attempts", topic, self.max_attempts)
+                        except Exception as e:
+                            self._dead_letter(
+                                topic, "",
+                                json.dumps(decoded,
+                                           default=str).encode("utf-8"),
+                                self.max_attempts,
+                                reason=f"local_handler: {e}")
             finally:
                 with self._local_idle:
                     self._local_inflight -= 1
@@ -214,22 +322,77 @@ class GrpcBusServer:
             for topic, tq in topics:
                 self._sweep_expired(topic, tq)
 
-    def _count_dead_letter(self) -> None:
-        # Called from pull-stream threads, the sweeper, and local dispatch
-        # threads concurrently — += on an int is not atomic.
+    def _spool_op(self, fn, *args) -> None:
+        """Run a spool mutation, tolerating a spool closed by kill():
+        a requeue/ack racing the chaos kill simply doesn't commit — the
+        frame stays journaled in its pre-race state and the next
+        generation redelivers it, exactly like a real SIGKILL landing
+        mid-write.  Any other spool failure still raises."""
+        try:
+            fn(*args)
+        except RuntimeError:
+            if not self._killed:
+                raise
+            logger.debug("spool op skipped: broker killed mid-%s",
+                         getattr(fn, "__name__", "op"))
+
+    def _record_unrouted(self, topic: str, payload: bytes) -> None:
+        self.m_unrouted.labels(topic=topic).inc()
+        spooled = False
+        if self._spool is not None:
+            with self._lock:
+                n = self._unrouted_spooled.get(topic, 0)
+                spooled = n < self.unrouted_spool_cap
+                if spooled:
+                    self._unrouted_spooled[topic] = n + 1
+            if spooled:
+                from .spool import new_frame_id
+
+                self._spool.dlq.append(topic, new_frame_id(), payload,
+                                       attempts=0, reason="no_route")
+        flight.record("bus_unrouted", topic=topic, spooled=spooled)
+        first = topic not in self._unrouted_warned
+        self._unrouted_warned.add(topic)
+        log = logger.warning if first else logger.debug
+        log("no route for message on %s (no handler, no pull queue); %s",
+            topic,
+            "held in the DLQ spool" if spooled else
+            ("DLQ spool cap reached; frame dropped" if self._spool
+             is not None else "frame DROPPED (no spool configured)"))
+
+    def _dead_letter(self, topic: str, fid: str, payload: bytes,
+                     attempts: int, reason: str) -> None:
+        """A frame leaves the delivery loop for good: counted (the
+        ``dead_letters`` int is kept for back-compat; += on an int is not
+        atomic, hence the lock), flight-recorded, and — with a spool —
+        persisted to the per-topic dead-letter queue instead of dropped
+        (``tools/dlq.py`` replays it)."""
         with self._lock:
             self.dead_letters += 1
+        self.m_dead.labels(topic=topic).inc()
+        persisted = self._spool is not None and not self._killed
+        if persisted:
+            fid = self._spool.dead(topic, fid, payload, attempts, reason)
+        flight.record("dead_letter", topic=topic, frame=fid,
+                      attempts=attempts, reason=reason,
+                      persisted=persisted)
+        logger.error(
+            "dead-lettering frame on %s after %d attempts (id=%s; %s): %s",
+            topic, attempts, fid or "-",
+            "persisted to DLQ spool" if persisted else "DROPPED", reason)
 
     def _requeue_or_drop(self, topic: str, tq: _TopicQueue,
                          delivery_id: str, inf: _Inflight) -> None:
         """inf has been removed from the inflight map by the caller."""
         if inf.attempts + 1 >= self.max_attempts:
-            self._count_dead_letter()
-            logger.error(
-                "dead-lettering frame on %s after %d attempts (id=%s)",
-                topic, inf.attempts + 1, delivery_id)
+            self._dead_letter(topic, inf.fid, inf.payload,
+                              inf.attempts + 1, reason="max_attempts")
             return
-        tq.q.put(_QueuedFrame(inf.payload, attempts=inf.attempts + 1))
+        attempts = inf.attempts + 1
+        self.m_redeliveries.labels(topic=topic).inc()
+        if self._spool is not None:
+            self._spool_op(self._spool.requeue, topic, inf.fid, attempts)
+        tq.q.put(_QueuedFrame(inf.payload, attempts=attempts, fid=inf.fid))
 
     def _sweep_expired(self, topic: str, tq: _TopicQueue) -> None:
         now = time.monotonic()
@@ -244,8 +407,8 @@ class GrpcBusServer:
 
     def _pull_rpc(self, request: bytes, context) -> Iterator[bytes]:
         topic = request.decode("utf-8")
+        tq = self._ensure_topic_queue(topic)
         with self._lock:
-            tq = self._pull_queues.setdefault(topic, _TopicQueue())
             self._stream_counter += 1
             stream_id = self._stream_counter
         try:
@@ -265,7 +428,7 @@ class GrpcBusServer:
                         tq.inflight[delivery_id] = _Inflight(
                             frame.payload, frame.attempts,
                             time.monotonic() + self.ack_timeout_s,
-                            stream_id)
+                            stream_id, frame.fid)
                 if frame is None:
                     time.sleep(0.05)
                     continue
@@ -278,7 +441,8 @@ class GrpcBusServer:
                     with tq.lock:
                         inf = tq.inflight.pop(delivery_id, None)
                     if inf is not None:
-                        tq.q.put(_QueuedFrame(inf.payload, inf.attempts))
+                        tq.q.put(_QueuedFrame(inf.payload, inf.attempts,
+                                              inf.fid))
                     raise
         finally:
             # Stream gone (worker died / disconnected): everything this
@@ -308,6 +472,10 @@ class GrpcBusServer:
             return b"unknown-delivery"  # already requeued/expired
         if status != b"ok":
             self._requeue_or_drop(topic, tq, delivery_id, inf)
+        elif self._spool is not None:
+            # Durably done: the WAL forgets the frame (and compacts once
+            # the acked prefix dominates).
+            self._spool_op(self._spool.ack, topic, inf.fid)
         return b"ok"
 
     # --- local wiring -----------------------------------------------------
@@ -325,14 +493,16 @@ class GrpcBusServer:
 
     def publish(self, topic: str, payload: Any) -> None:
         """Local publish: same fan-out as a remote Publish RPC, so the host
-        process (e.g. the orchestrator) can use the server as its bus."""
+        process (e.g. the orchestrator) can use the server as its bus.
+        Raises once the server is killed — a durable publisher (the
+        `bus/outbox.py` outbox) buffers and retries against the next
+        generation."""
         payload = trace.inject(payload)
         self._publish_rpc(_encode_envelope(topic, serialize_payload(payload)),
                           None)
 
     def enable_pull(self, topic: str) -> None:
-        with self._lock:
-            self._pull_queues.setdefault(topic, _TopicQueue())
+        self._ensure_topic_queue(topic)
 
     def pending_count(self, topic: str) -> int:
         """Queued + in-flight frames (observability / tests)."""
@@ -367,6 +537,41 @@ class GrpcBusServer:
                 return False
             time.sleep(poll_s)
 
+    def dlq_snapshot(self, topic: Optional[str] = None,
+                     id: Optional[str] = None) -> Dict[str, Any]:
+        """The ``/dlq`` endpoint body: per-topic dead-letter counts +
+        newest entry metadata (full payload only for an explicit ``id``
+        lookup).  Works — empty — without a spool, so the endpoint never
+        404s on a durability-off broker."""
+        if self._spool is None:
+            return {"enabled": False, "topics": {},
+                    "dead_letters_total": self.dead_letters}
+        body = self._spool.dlq.snapshot(topic=topic or None, fid=id or None)
+        body["enabled"] = True
+        body["dead_letters_total"] = self.dead_letters
+        return body
+
+    def dlq_replay(self, topic: str, fid: str) -> Dict[str, Any]:
+        """Re-drive one dead letter onto its topic (the ``tools/dlq.py``
+        replay verb): the frame re-enters the normal delivery loop with a
+        fresh attempt budget, and the DLQ entry is marked replayed."""
+        if self._spool is None:
+            raise RuntimeError("dead-letter replay needs a spool_dir")
+        entry = self._spool.dlq.get(topic, fid)
+        if entry is None:
+            raise KeyError(f"no dead letter {fid!r} on topic {topic!r}")
+        if entry.reason == "no_route":
+            # Release the hold's cap slot BEFORE re-publishing: if the
+            # topic is STILL unrouted, the replayed frame re-enters the
+            # hold path and must fit inside the cap, not be dropped.
+            with self._lock:
+                if self._unrouted_spooled.get(topic, 0) > 0:
+                    self._unrouted_spooled[topic] -= 1
+        self._publish_rpc(_encode_envelope(topic, entry.payload), None)
+        self._spool.dlq.mark_replayed(topic, fid)
+        flight.record("dlq_replay", topic=topic, frame=fid)
+        return entry.meta()
+
     def start(self) -> None:
         self._server.start()
         self._sweeper = threading.Thread(target=self._sweep_loop,
@@ -374,7 +579,41 @@ class GrpcBusServer:
         self._sweeper.start()
         logger.info("bus server listening on %s", self.address)
 
+    def kill(self) -> None:
+        """Abrupt-death chaos seam (the `loadgen` bus target): hard-stop
+        the gRPC server and drop ALL in-RAM state — queued frames,
+        in-flight ledgers, local dispatch queues — exactly like a
+        SIGKILLed broker process.  No drain, no local flush, no WAL
+        compaction; what survives is what the spool already journaled.
+        A new `GrpcBusServer` over the same ``spool_dir`` is the restart.
+        """
+        if self._killed:
+            return
+        self._killed = True
+        pending = {t: self.pending_count(t)
+                   for t in list(self._pull_queues)}
+        flight.record("bus_kill", address=self.address,
+                      pending={t: n for t, n in pending.items() if n})
+        logger.warning("bus server KILLED (chaos) with pending frames: %s",
+                       {t: n for t, n in pending.items() if n} or "none")
+        self._server.stop(None)   # immediate: in-flight RPCs are aborted
+        self._stop.set()
+        if self._spool is not None:
+            # Late appends from a racing publish must fail loudly (the
+            # publisher's outbox retries against the next generation)
+            # rather than land in a WAL the new generation already read.
+            self._spool.close(compact=False)
+        if self._sweeper is not None:
+            self._sweeper.join(timeout=2.0)
+        for t in self._local_threads.values():
+            t.join(timeout=2.0)
+
     def close(self, grace: float = 0.5) -> None:
+        if self._killed:
+            # Already hard-stopped; there is nothing left to drain.
+            for t in self._local_threads.values():
+                t.join(timeout=1.0)
+            return
         # stop() returns immediately; in-flight Publish RPCs keep running
         # for up to `grace`.  Wait for full termination BEFORE setting
         # _stop, or a dispatch thread could exit on an empty queue while an
@@ -390,6 +629,8 @@ class GrpcBusServer:
             self._sweeper.join(timeout=2.0)
         for t in self._local_threads.values():
             t.join(timeout=2.0)
+        if self._spool is not None:
+            self._spool.close(compact=True)
 
 
 class GrpcBusClient:
@@ -482,7 +723,9 @@ class RemoteBus:
     """
 
     def __init__(self, target: str = "127.0.0.1:50551",
-                 max_redeliveries: int = 3):
+                 max_redeliveries: int = 3,
+                 outbox: Optional[OutboxConfig] = None,
+                 registry: MetricsRegistry = REGISTRY):
         self._client = GrpcBusClient(target)
         self.max_redeliveries = max_redeliveries
         # Inline-redelivery policy (shared utils/resilience.py schedule):
@@ -492,12 +735,29 @@ class RemoteBus:
         self._retry = resilience.RetryPolicy(
             max_attempts=max_redeliveries + 1, base_delay_s=0.0,
             jitter=0.0, retry_after_cap_s=2.0)
+        # Reconnect schedule for a dropped pull stream: jittered
+        # exponential backoff that RESETS on a successful pull, so a
+        # restarting broker under a full fleet sees staggered redials
+        # instead of the old synchronized 1 Hz stampede.
+        self._reconnect = resilience.RetryPolicy(
+            max_attempts=1 << 30, base_delay_s=0.1, max_delay_s=2.0,
+            multiplier=2.0, jitter=0.25)
+        # Durable publisher outbox (bus/outbox.py): with a config, every
+        # publish is buffered-and-retried through the resilience layer
+        # instead of raising a broker outage into the serving path.
+        self.outbox: Optional[DurableOutbox] = None
+        if outbox is not None:
+            self.outbox = DurableOutbox(self._client.publish, outbox,
+                                        registry=registry)
         self._handlers: Dict[str, list] = {}
         self._threads: Dict[str, threading.Thread] = {}
         self._lock = threading.RLock()
         self._stop = threading.Event()
 
     def publish(self, topic: str, payload: Any) -> None:
+        if self.outbox is not None:
+            self.outbox.publish(topic, payload)
+            return
         self._client.publish(topic, payload)
 
     def subscribe(self, topic: str, handler: Callable[..., None],
@@ -531,19 +791,24 @@ class RemoteBus:
             t.start()
 
     def _pull_loop(self, topic: str) -> None:
+        attempt = 0
         while not self._stop.is_set():
             try:
                 for delivery_id, frame in self._client.pull(topic):
                     if self._stop.is_set():
                         return
+                    attempt = 0  # a delivered frame proves the broker is up
                     self._dispatch(topic, delivery_id, frame)
             except grpc.RpcError as e:
                 if self._stop.is_set():
                     return
+                delay = self._reconnect.delay_s(attempt)
+                attempt = min(attempt + 1, 16)  # cap the exponent, not the
+                # retries: the schedule plateaus at max_delay_s forever
                 logger.warning("pull stream for %s dropped (%s); "
-                               "reconnecting", topic, e.code()
-                               if hasattr(e, "code") else e)
-                self._stop.wait(1.0)
+                               "reconnecting in %.2fs", topic,
+                               e.code() if hasattr(e, "code") else e, delay)
+                self._stop.wait(delay)
 
     def _safe_ack(self, topic: str, delivery_id: str, ok: bool) -> None:
         if self._stop.is_set():
@@ -616,6 +881,10 @@ class RemoteBus:
 
     def close(self) -> None:
         self._stop.set()
+        if self.outbox is not None:
+            # Give buffered publishes a brief chance to land; what
+            # doesn't make it stays in the outbox WAL (when configured).
+            self.outbox.close(drain_s=2.0)
         self._client.close()
         for t in self._threads.values():
             t.join(timeout=2.0)

@@ -130,6 +130,25 @@ def build_parser() -> argparse.ArgumentParser:
     a("--bus-address", default=None,
       help="gRPC bus address, e.g. 127.0.0.1:50551 (orchestrator binds it, "
            "workers dial it; empty = in-process bus)")
+    # Bus durability (docs/operations.md "Bus durability & dead letters"):
+    # broker WAL spool + publisher outbox + persisted dead-letter queue.
+    a("--bus-spool-dir", default=None,
+      help="broker WAL spool directory: the hosted GrpcBusServer journals "
+           "every pull-topic frame + dead letters here, so a restarted "
+           "broker generation resumes exactly where the dead one stopped; "
+           "setting this also routes this process's publishes through a "
+           "durable outbox (empty = RAM-only bus, the historical behavior)")
+    a("--bus-outbox-max-frames", type=int, default=None,
+      help="bound on publishes buffered in the durable outbox while the "
+           "broker is unreachable (default 1024; the orchestrator pauses "
+           "crawl dispatch as the buffer nears this bound)")
+    a("--bus-ack-timeout-s", type=float, default=None,
+      help="seconds a pulled frame may stay unacked before the broker "
+           "requeues it for another worker (default 300)")
+    a("--bus-max-attempts", type=int, default=None,
+      help="delivery attempts per frame before it is dead-lettered "
+           "(default 5; with --bus-spool-dir dead letters persist and are "
+           "listable/replayable via tools/dlq.py and /dlq)")
     # Observability (pprof-analog)
     a("--metrics-port", type=int, default=None,
       help="serve /metrics + /healthz on this port (0 = off)")
@@ -428,6 +447,10 @@ _KEY_MAP = {
     "url_file": "crawler.url_file",
     "bus_address": "distributed.bus_address",
     "bus_serve": "distributed.bus_serve",
+    "bus_spool_dir": "bus.spool_dir",
+    "bus_outbox_max_frames": "bus.outbox_max_frames",
+    "bus_ack_timeout_s": "bus.ack_timeout_s",
+    "bus_max_attempts": "bus.max_attempts",
     "job_name": "job.name",
     "job_due_s": "job.due_s",
     "job_repeat_s": "job.repeat_s",
@@ -772,6 +795,11 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
                       file=sys.stderr)
                 return 2
             bus = _make_bus(r, serve=True)
+            if r.get_str("bus.spool_dir", ""):
+                # Durable broker: serve the dead-letter queue on the
+                # metrics port (tools/dlq.py --url reads it).
+                from .utils.metrics import set_dlq_provider
+                set_dlq_provider(bus.dlq_snapshot)
             try:
                 _serve_forever()
             finally:
@@ -1009,12 +1037,37 @@ def _run_dc_gateway(cfg: CrawlerConfig, r: ConfigResolver) -> None:
         gw.close()
 
 
+def _bus_outbox_config(r: ConfigResolver, who: str):
+    """The durable-outbox config for one publisher, or None when bus
+    durability is off (`bus.spool_dir` empty).  The spill WAL lands under
+    ``<spool-dir>/outbox/<who>`` — a path per publisher role, so a
+    co-hosted orchestrator and worker never share a WAL."""
+    spool_dir = r.get_str("bus.spool_dir", "") if r else ""
+    if not spool_dir:
+        return None
+    from .bus.outbox import OutboxConfig
+    return OutboxConfig(
+        dir=os.path.join(spool_dir, "outbox", who or "client"),
+        max_frames=r.get_int("bus.outbox_max_frames", 1024))
+
+
 def _make_bus(r: ConfigResolver, serve: bool = False):
     """Bus selection: --bus-address set -> gRPC DCN transport (orchestrator
     hosts a GrpcBusServer with the work queue pull-enabled; workers dial a
-    RemoteBus with competing-consumer pull).  Unset -> in-process bus."""
+    RemoteBus with competing-consumer pull).  Unset -> in-process bus.
+    With `bus.spool_dir` set, the hosted broker journals pull-topic frames
+    + dead letters in the WAL spool and client publishes ride a durable
+    outbox (docs/operations.md "Bus durability & dead letters")."""
     address = r.get_str("distributed.bus_address") if r else ""
     if not address:
+        if r and r.get_str("bus.spool_dir", ""):
+            # The durability switch only applies to the gRPC broker; an
+            # operator who set it without a bus address must not believe
+            # frames are being journaled when they are not.
+            logger.warning(
+                "bus.spool_dir is set but distributed.bus_address is "
+                "empty: the in-process bus has no spool/outbox/DLQ — "
+                "bus durability is INACTIVE")
         from .bus.inmemory import InMemoryBus
         bus = InMemoryBus(sync=False)
         bus.start()
@@ -1027,7 +1080,11 @@ def _make_bus(r: ConfigResolver, serve: bool = False):
             TOPIC_MEDIA_BATCHES,
             TOPIC_WORK_QUEUE,
         )
-        server = GrpcBusServer(address)
+        server = GrpcBusServer(
+            address,
+            spool_dir=r.get_str("bus.spool_dir", "") or None,
+            ack_timeout_s=r.get_float("bus.ack_timeout_s", 300.0),
+            max_attempts=r.get_int("bus.max_attempts", 5))
         # Pre-enable the pull (competing-consumer) topics so frames
         # published before the first consumer connects are queued, not
         # dropped.  Fan-out topics (results/status/commands/transcripts)
@@ -1040,19 +1097,21 @@ def _make_bus(r: ConfigResolver, serve: bool = False):
         server.start()
         return server
     from .bus.grpc_bus import RemoteBus
-    return RemoteBus(address)
+    who = r.get_str("distributed.worker_id") \
+        or r.get_str("distributed.mode") or "client"
+    return RemoteBus(address, outbox=_bus_outbox_config(r, who))
 
 
 def _make_serving_bus(r: ConfigResolver) -> "_ServingBus":
     """Broker + loopback consumer for a --bus-serve process; raises
     CliConfigError when --bus-address is missing."""
-    from .bus.grpc_bus import RemoteBus
-
     address = r.get_str("distributed.bus_address")
     if not address:
         raise CliConfigError("--bus-serve requires --bus-address")
     server = _make_bus(r, serve=True)
-    return _ServingBus(server, RemoteBus(address))
+    # The loopback client half goes through _make_bus too, so it inherits
+    # the durable-outbox wiring when bus durability is on.
+    return _ServingBus(server, _make_bus(r))
 
 
 class _ServingBus:
@@ -1085,7 +1144,20 @@ def _run_orchestrator(urls: List[str], cfg: CrawlerConfig,
     from .modes.common import create_state_manager
     from .orchestrator import CrawlJournal, Orchestrator
     from .orchestrator.orchestrator import OrchestratorConfig
-    bus = _make_bus(r, serve=True)
+    broker = _make_bus(r, serve=True)
+    bus = broker
+    outbox_cfg = _bus_outbox_config(r, "orchestrator")
+    if outbox_cfg is not None and hasattr(broker, "dlq_snapshot"):
+        # Bus durability on: the orchestrator's LOCAL publishes ride a
+        # durable outbox too (a wedged in-process broker path degrades
+        # to buffered instead of erroring the dispatch tick, and the
+        # dispatch valve watches the buffer depth), and the broker's
+        # dead-letter queue is served at /dlq.
+        from .bus.outbox import OutboxBus
+        from .utils.metrics import set_dlq_provider
+        bus = OutboxBus(broker, outbox_cfg, name="orchestrator",
+                        close_inner=False)
+        set_dlq_provider(broker.dlq_snapshot)
     sm = create_state_manager(cfg, cfg.crawl_id)
     ocfg = OrchestratorConfig(
         inference_backpressure_high=r.get_int(
@@ -1135,12 +1207,26 @@ def _run_orchestrator(urls: List[str], cfg: CrawlerConfig,
         # only — an interrupted/aborted run must exit promptly, not stall
         # on frames nobody will ever consume.
         try:
-            drain = getattr(bus, "drain", None)
+            drain = getattr(broker, "drain", None)
             if callable(drain) and orch.crawl_completed:
-                drain(timeout_s=r.get_float("distributed.shutdown_drain_s",
-                                            30.0))
+                drain_s = r.get_float("distributed.shutdown_drain_s", 30.0)
+                if bus is not broker:
+                    # Flush buffered publishes INTO the broker first, or
+                    # the broker drain below would pass on empty queues
+                    # while frames still sit in the outbox.
+                    bus.outbox.drain(timeout_s=drain_s)
+                drain(timeout_s=drain_s)
         finally:
-            bus.close()
+            try:
+                if bus is not broker:
+                    # The durable-wiring branch ran: stop the outbox
+                    # flusher and unregister OUR /dlq provider (guarded
+                    # by identity, mirroring the setup condition).
+                    bus.close()   # close_inner=False: broker outlives it
+                    from .utils.metrics import clear_dlq_provider
+                    clear_dlq_provider(broker.dlq_snapshot)
+            finally:
+                broker.close()
 
 
 def _run_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:

@@ -20,6 +20,17 @@ the journal-based resume (`orchestrator/journal.py`):
 
     from=1.2s..2.2s down orchestrator
 
+and, on gRPC runs, the ``bus`` handle — the broker itself dies (RAM
+queues and in-flight ledgers dropped) and restarts as a new
+`GrpcBusServer` generation over the same WAL spool dir + port
+(`bus/spool.py`; the kill-broker scenario):
+
+    from=1.5s..2.8s down bus
+
+Note the distinction from ``delay bus`` / ``drop bus``, which degrade
+the publish PATH through the `ChaosBus` wrapper while the broker stays
+up — ``down bus`` kills the broker process-analog itself.
+
 Point faults fire once; window faults apply at ``from`` and unwind at
 the window end.  Every application and unwind is recorded as a
 ``chaos`` flight event (postmortems show cause next to effect) and

@@ -38,7 +38,12 @@ import time
 import urllib.request
 from typing import Any, Dict, List, Optional
 
-from ..bus.messages import TOPIC_INFERENCE_BATCHES, TOPIC_MEDIA_BATCHES
+from ..bus.messages import (
+    TOPIC_CHAOS,
+    TOPIC_INFERENCE_BATCHES,
+    TOPIC_INFERENCE_RESULTS,
+    TOPIC_MEDIA_BATCHES,
+)
 from ..utils import flight, trace
 from ..utils.slo import (
     ASR_BATCH_SPANS,
@@ -196,6 +201,100 @@ def _dtrace_checks(check, gate_cfg: Dict[str, Any],
     return {"assembled": len(traces), "multi_process": multi}
 
 
+class BusHandle:
+    """The chaos controller's view of the broker itself (``down bus``):
+    kill / restart with process-death semantics.  ``kill`` hard-stops the
+    live `GrpcBusServer` and drops ALL its RAM state (queues, in-flight
+    ledgers, local dispatch); ``restart`` builds a FRESH server over the
+    SAME spool directory and the SAME bound port, so the clients that
+    already hold the address reconnect and recovery comes from the WAL
+    spool alone (`bus/spool.py`).  Local subscriptions and pull-topic
+    registrations are replayed onto each generation, the way a restarted
+    broker host re-registers its in-process consumers at boot.
+
+    The handle doubles as the host-side bus facade: ``publish`` raises
+    while the broker is down (exactly what a durable publisher's outbox
+    expects — it buffers and retries), and the read-side helpers
+    (``pending_count``/``drain``/``flush_local``) answer for the live
+    generation or degrade gracefully."""
+
+    def __init__(self, make_server):
+        self._make = make_server   # (address | None) -> un-started server
+        self.server = None
+        self.address: Optional[str] = None
+        self.generation = 0
+        self._subs: List[tuple] = []
+        self._pull: List[str] = []
+
+    def start(self) -> None:
+        server = self._make(self.address)
+        if self.address is not None and not server.bound_port:
+            raise RuntimeError(
+                f"bus restart could not rebind {self.address}")
+        for topic in self._pull:
+            server.enable_pull(topic)
+        for topic, handler in self._subs:
+            server.subscribe(topic, handler)
+        server.start()
+        self.address = f"127.0.0.1:{server.bound_port}"
+        self.server = server
+        self.generation += 1
+
+    def kill(self) -> None:
+        server, self.server = self.server, None
+        if server is not None:
+            server.kill()
+
+    def restart(self) -> None:
+        self.kill()  # no-op if the timeline already killed this generation
+        self.start()
+
+    # -- the bus facade ----------------------------------------------------
+    def publish(self, topic: str, payload) -> None:
+        server = self.server
+        if server is None:
+            raise RuntimeError("bus is down")
+        server.publish(topic, payload)
+
+    def subscribe(self, topic: str, handler) -> None:
+        self._subs.append((topic, handler))
+        server = self.server
+        if server is not None:
+            server.subscribe(topic, handler)
+
+    def enable_pull(self, topic: str) -> None:
+        if topic not in self._pull:
+            self._pull.append(topic)
+        server = self.server
+        if server is not None:
+            server.enable_pull(topic)
+
+    def pending_count(self, topic: str) -> int:
+        server = self.server
+        return server.pending_count(topic) if server is not None else 0
+
+    def flush_local(self, timeout_s: float = 5.0) -> bool:
+        server = self.server
+        return server.flush_local(timeout_s) if server is not None else True
+
+    def drain(self, timeout_s: float = 30.0, poll_s: float = 0.2) -> bool:
+        server = self.server
+        if server is None:
+            return True
+        return server.drain(timeout_s=timeout_s, poll_s=poll_s)
+
+    def dlq_snapshot(self, topic=None, id=None):
+        server = self.server
+        if server is None:
+            return {"enabled": False, "topics": {}, "bus_down": True}
+        return server.dlq_snapshot(topic=topic, id=id)
+
+    def close(self) -> None:
+        server = self.server
+        if server is not None:
+            server.close()
+
+
 class OrchestratorHandle:
     """The chaos controller's view of the coordinator itself: ``kill`` /
     ``restart`` with process-death semantics.  Each generation is a FRESH
@@ -325,6 +424,13 @@ class _ServingWorkerHandle:
             return
         self._dead = True
         self.worker.kill()
+        # SIGKILL fidelity: a durable outbox must NOT gracefully flush a
+        # killed worker's buffered publishes — they stay in the outbox
+        # WAL for the next generation to re-send (the reload path the
+        # gate is supposed to exercise).
+        outbox = getattr(self.bus, "outbox", None)
+        if outbox is not None:
+            outbox.close(drain_s=0.0)
         close = getattr(self.bus, "close", None)
         if callable(close):
             close()  # gRPC: tear the pull stream; un-acked frames requeue
@@ -455,6 +561,7 @@ def run_scenario(scenario: Dict[str, Any],
             raise ValueError("--replay is not supported for ASR scenarios")
         return run_asr_scenario(scenario, overrides=overrides)
     from ..bus.inmemory import InMemoryBus
+    from ..bus.outbox import OutboxBus, OutboxConfig
     from ..config.crawler import CrawlerConfig
     from ..inference.engine import EngineConfig, InferenceEngine
     from ..orchestrator import CrawlJournal, Orchestrator
@@ -464,9 +571,11 @@ def run_scenario(scenario: Dict[str, Any],
     from ..utils.metrics import (
         MetricsRegistry,
         clear_cluster_provider,
+        clear_dlq_provider,
         clear_dtraces_provider,
         serve_metrics,
         set_cluster_provider,
+        set_dlq_provider,
         set_dtraces_provider,
     )
 
@@ -525,23 +634,83 @@ def run_scenario(scenario: Dict[str, Any],
     controller = None
     cluster_provider = None
     dtraces_provider = None
-    verdict: Dict[str, Any] = {"scenario": name, "bus": bus_kind}
+    dlq_provider = None
+    local_outbox = None
+    # Bus durability (docs/operations.md "Bus durability & dead letters"):
+    # a "bus_durability" block gives the broker a WAL spool and routes
+    # every publisher (generator, orchestrator, worker) through a durable
+    # outbox, which is what lets a `down bus` timeline line pass the
+    # zero-loss envelope.
+    durable_cfg = scenario.get("bus_durability") or {}
+    durable = bool(durable_cfg) and bus_kind == "grpc"
+    if any(f.target == "bus" and f.action in ("kill", "restart", "down")
+           for f in timeline) and not durable:
+        # Without a spool + outboxes, the generator's first publish into
+        # the dead broker raises and the run would report phantom "lost
+        # items" instead of a clear config error.
+        raise ValueError(
+            "a kill/restart/down 'bus' timeline line requires a "
+            "\"bus_durability\" block (broker spool + publisher "
+            "outboxes) on a grpc scenario")
+    verdict: Dict[str, Any] = {"scenario": name, "bus": bus_kind,
+                               "bus_durable": durable}
     try:
         # --- bus fabric ---------------------------------------------------
         if bus_kind == "grpc":
             from ..bus.grpc_bus import GrpcBusServer, RemoteBus
 
-            server = GrpcBusServer("127.0.0.1:0")
+            spool_dir = os.path.join(tmpdir, "bus-spool") if durable \
+                else None
+
+            def _make_server(bind_addr):
+                return GrpcBusServer(
+                    bind_addr or "127.0.0.1:0", spool_dir=spool_dir,
+                    ack_timeout_s=float(
+                        durable_cfg.get("ack_timeout_s", 300.0)),
+                    max_attempts=int(durable_cfg.get("max_attempts", 5)),
+                    registry=registry)
+
+            server = BusHandle(_make_server)
             server.enable_pull(TOPIC_INFERENCE_BATCHES)
             server.start()
-            addr = f"127.0.0.1:{server.bound_port}"
-            local_bus = server            # orchestrator + generator side
-            make_worker_bus = lambda: RemoteBus(addr)  # noqa: E731
+            addr = server.address
+            if durable:
+                outbox_frames = int(
+                    durable_cfg.get("outbox_max_frames", 512))
+
+                def _outbox_cfg(sub: str) -> OutboxConfig:
+                    return OutboxConfig(
+                        dir=os.path.join(tmpdir, "outbox", sub),
+                        max_frames=outbox_frames,
+                        breaker_recovery_s=0.25)
+
+                # Orchestrator + generator side: local publishes buffer
+                # through the outbox while the broker is down.
+                local_bus = OutboxBus(server, _outbox_cfg("local"),
+                                      name="local", registry=registry,
+                                      close_inner=False)
+                local_outbox = local_bus
+                worker_outbox = _outbox_cfg("worker")
+                make_worker_bus = lambda: RemoteBus(  # noqa: E731
+                    addr, outbox=worker_outbox, registry=registry)
+                dlq_provider = server.dlq_snapshot
+                set_dlq_provider(dlq_provider)
+            else:
+                local_bus = server    # orchestrator + generator side
+                make_worker_bus = lambda: RemoteBus(addr)  # noqa: E731
         else:
             inner_bus = InMemoryBus(sync=True)
             local_bus = inner_bus
             make_worker_bus = lambda: inner_bus  # noqa: E731
         chaos_bus = ChaosBus(local_bus)
+        # Register every fan-out topic this run publishes on: the worker's
+        # result announcements and the controller's chaos announcements
+        # would otherwise count as unrouted (`bus_dropped_no_route_total`
+        # — the silent-drop fix), and the gate's own envelope asserts
+        # that counter stays at zero.  (Reconciliation reads the
+        # writeback sink, not these streams, so no-op sinks suffice.)
+        local_bus.subscribe(TOPIC_INFERENCE_RESULTS, lambda payload: None)
+        local_bus.subscribe(TOPIC_CHAOS, lambda payload: None)
 
         # --- orchestrator (fleet fold + /cluster; real code path) ---------
         def _sm(sub: str):
@@ -609,6 +778,10 @@ def run_scenario(scenario: Dict[str, Any],
         port = http_server.server_address[1]
 
         targets = {worker_name: handle, "orchestrator": orch_handle}
+        if bus_kind == "grpc":
+            # `down bus` / `kill bus` timeline lines hard-stop the broker
+            # generation; restart rebuilds over the same spool dir + port.
+            targets["bus"] = server
         if crawl_worker is not None:
             targets["crawl-1"] = crawl_worker
         controller = ChaosController(timeline, targets=targets,
@@ -631,7 +804,21 @@ def run_scenario(scenario: Dict[str, Any],
                 + int(status.get("inflight", 0))
             if server is not None:
                 n += server.pending_count(TOPIC_INFERENCE_BATCHES)
+            if local_outbox is not None:
+                # Buffered-but-unflushed publishes are pending work too
+                # (closed-loop arrivals must not overrun a down broker).
+                n += local_outbox.outbox.depth()
             return n
+
+        def _flush_outboxes(timeout_s: float) -> None:
+            """Drain every durable outbox before reading broker pending
+            counts — a buffered publish is invisible to pending_count
+            until the flusher lands it."""
+            if local_outbox is not None:
+                local_outbox.outbox.drain(timeout_s=timeout_s)
+            worker_bus_outbox = getattr(handle.bus, "outbox", None)
+            if worker_bus_outbox is not None:
+                worker_bus_outbox.drain(timeout_s=timeout_s)
 
         def _gen():
             stats_box["stats"] = workload.run(
@@ -663,6 +850,7 @@ def run_scenario(scenario: Dict[str, Any],
                 if o is not None and o.crawl_completed:
                     break
                 time.sleep(0.02)
+        _flush_outboxes(drain_timeout_s)
         if server is not None:
             server.drain(timeout_s=drain_timeout_s)
         drained = handle.worker.drain(timeout_s=drain_timeout_s)
@@ -688,6 +876,7 @@ def run_scenario(scenario: Dict[str, Any],
             chaos_bus.publish(TOPIC_INFERENCE_BATCHES,
                               base.build_batch(pb).to_dict())
             time.sleep(tail_gap)
+        _flush_outboxes(drain_timeout_s)
         if server is not None:
             server.drain(timeout_s=drain_timeout_s)
         tail_drained = handle.worker.drain(timeout_s=drain_timeout_s)
@@ -713,6 +902,8 @@ def run_scenario(scenario: Dict[str, Any],
             "cluster": _scrape(port, "/cluster", as_json=True),
             "dtraces": _scrape(port, "/dtraces", as_json=True),
         }
+        if durable:
+            endpoints["dlq"] = _scrape(port, "/dlq", as_json=True)
 
         expected = chaos_bus.expected_uids()
         crawl_ids = {load_cfg.crawl_id, crawler_cfg.crawl_id}
@@ -803,6 +994,28 @@ def run_scenario(scenario: Dict[str, Any],
         occupancy = _occupancy_checks(check, gate_cfg, endpoints["costs"])
         dtrace_summary = _dtrace_checks(check, gate_cfg,
                                         endpoints["dtraces"])
+        # Unrouted-message accounting (the silent-drop fix): every topic
+        # this run publishes on is registered before load starts, so the
+        # counter must stay at zero — a nonzero value means a frame hit a
+        # topic with no handler and no pull queue.
+        unrouted_total = sum(
+            v for _, v in registry.counter(
+                "bus_dropped_no_route_total").series())
+        check("bus_unrouted", unrouted_total
+              <= int(gate_cfg.get("max_unrouted", 0)),
+              unrouted_total, int(gate_cfg.get("max_unrouted", 0)))
+        bus_detail: Dict[str, Any] = {
+            "generations": server.generation if bus_kind == "grpc" else 1,
+            "durable": durable,
+        }
+        if durable:
+            bus_detail["dead_letters"] = sum(
+                v for _, v in registry.counter(
+                    "bus_dead_letters_total").series())
+            bus_detail["redeliveries"] = sum(
+                v for _, v in registry.counter(
+                    "bus_redeliveries_total").series())
+            bus_detail["outbox_depth_end"] = local_outbox.outbox.depth()
         if gate_cfg.get("require_flight"):
             events = flight.RECORDER.events()
             start = 0
@@ -814,7 +1027,10 @@ def run_scenario(scenario: Dict[str, Any],
             kinds = {e.get("kind") for e in events[start:]}
             for kind in gate_cfg["require_flight"]:
                 check(f"flight_{kind}", kind in kinds, kind in kinds, True)
-        for key in ("metrics", "costs", "cluster", "dtraces"):
+        endpoint_keys = ["metrics", "costs", "cluster", "dtraces"]
+        if durable:
+            endpoint_keys.append("dlq")
+        for key in endpoint_keys:
             check(f"endpoint_{key}", endpoints[key] is not None,
                   endpoints[key] is not None, True)
 
@@ -844,6 +1060,8 @@ def run_scenario(scenario: Dict[str, Any],
             "fault_window_s": round(t_b1 - t_b0, 2),
             "chaos_events": len(controller.events),
             "worker_generations": handle.generation,
+            "bus_generations": bus_detail["generations"],
+            "bus_broker": bus_detail,
             "orchestrator": orch_detail,
             "cluster_workers": sorted(
                 (endpoints["cluster"] or {}).get("workers", {})),
@@ -869,6 +1087,9 @@ def run_scenario(scenario: Dict[str, Any],
         if dtraces_provider is not None:
             _teardown("dtraces-provider",
                       lambda: clear_dtraces_provider(dtraces_provider))
+        if dlq_provider is not None:
+            _teardown("dlq-provider",
+                      lambda: clear_dlq_provider(dlq_provider))
         if http_server is not None:
             _teardown("http-server", http_server.shutdown)
         if pool_installed:
@@ -876,6 +1097,10 @@ def run_scenario(scenario: Dict[str, Any],
 
             _teardown("connection-pool",
                       crawl_runner.shutdown_connection_pool)
+        if local_outbox is not None:
+            # close_inner=False: stops the outbox flusher only — the
+            # broker handle is torn down on its own line below.
+            _teardown("local-outbox", local_outbox.close)
         if inner_bus is not None:
             _teardown("inmemory-bus", inner_bus.close)
         if server is not None:

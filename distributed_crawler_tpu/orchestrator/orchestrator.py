@@ -161,6 +161,10 @@ class Orchestrator:
         # inference-backlog hysteresis valve `_backpressure_active`).
         self._circuit_backpressure = False
         self._backpressure_active = False
+        # Bus-outbox latch: when publishes ride a durable outbox
+        # (`bus/outbox.py`) and the broker outage has it near its bound,
+        # dispatch pauses instead of filling the buffer to OutboxFull.
+        self._outbox_backpressure = False
         # Telemetry-rich per-worker fold behind /cluster; its staleness
         # rule tracks the same timeout check_worker_health enforces.
         self.fleet = FleetView(stale_after_s=self.ocfg.worker_timeout_s)
@@ -625,6 +629,33 @@ class Orchestrator:
             self._circuit_backpressure = False
             logger.info("state-store circuit recovered; resuming crawl "
                         "distribution")
+        # A near-full publish outbox is the broker-outage analog of the
+        # state circuit: the buffered-and-retried degradation only holds
+        # while there is buffer left, so dispatch pauses before the bound
+        # turns publishes into OutboxFull errors.  Own latch, released
+        # the moment the flusher drains back under the high-water mark.
+        outbox = getattr(self.bus, "outbox", None)
+        if outbox is not None:
+            if self._outbox_backpressure:
+                # Hysteresis: release only once the flusher has drained
+                # well below the engage mark (below_low_water), so a
+                # depth hovering at the boundary can't flap the valve —
+                # the same discipline as the inference valve below.
+                low_fn = getattr(outbox, "below_low_water", None)
+                released = low_fn() if callable(low_fn) \
+                    else not outbox.near_full()
+                if not released:
+                    return True
+                self._outbox_backpressure = False
+                logger.info("bus outbox drained below the low-water mark; "
+                            "resuming crawl distribution")
+            elif outbox.near_full():
+                self._outbox_backpressure = True
+                flight.record("backpressure", reason="bus_outbox_near_full",
+                              depth=outbox.depth())
+                logger.warning("bus outbox near its bound (%d buffered); "
+                               "pausing crawl distribution", outbox.depth())
+                return True
         high = self.ocfg.inference_backpressure_high
         if high <= 0:
             return False

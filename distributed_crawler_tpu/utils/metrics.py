@@ -333,6 +333,27 @@ def clear_costs_provider(fn) -> None:
         _costs_provider = None
 
 
+# Late-bound /dlq provider: the broker's dead-letter view
+# (`bus/grpc_bus.py:GrpcBusServer.dlq_snapshot`) — per-topic counts +
+# entry metadata from the persisted dead-letter spool (`bus/spool.py`),
+# full payload for an explicit ?topic=&id= lookup.
+_dlq_provider = None
+
+
+def set_dlq_provider(fn) -> None:
+    """Register the dict provider served at /dlq (``fn(topic=..., id=...)``
+    or zero-arg; pass None to clear)."""
+    global _dlq_provider
+    _dlq_provider = fn
+
+
+def clear_dlq_provider(fn) -> None:
+    """Unregister ``fn`` only if it is still the active provider."""
+    global _dlq_provider
+    if _dlq_provider == fn:
+        _dlq_provider = None
+
+
 # Late-bound /dtraces provider: the orchestrator's distributed-trace
 # collector (`orchestrator/tracecollect.py`) — assembled cross-process
 # traces with clock-offset-corrected span walls.
@@ -456,6 +477,27 @@ class _Handler(BaseHTTPRequestHandler):
                     payload = _dtraces_provider(limit=limit)
                 except TypeError:  # zero-arg providers are fine too
                     payload = _dtraces_provider()
+                body = _json.dumps(payload, default=str).encode("utf-8")
+            except Exception as e:
+                code = 500
+                body = _json.dumps({"error": str(e)}).encode("utf-8")
+            ctype = "application/json"
+        elif path == "/dlq" and _dlq_provider is not None:
+            # The broker's dead-letter queue (`bus/spool.py`): per-topic
+            # counts + newest entries; ?topic=&id= returns one entry's
+            # full payload (base64).  Rendered/replayed by tools/dlq.py.
+            import json as _json
+            from urllib.parse import parse_qs as _parse_qs
+
+            query = _parse_qs(self.path.partition("?")[2])
+            topic = (query.get("topic") or [""])[0]
+            entry_id = (query.get("id") or [""])[0]
+            try:
+                try:
+                    payload = _dlq_provider(topic=topic or None,
+                                            id=entry_id or None)
+                except TypeError:  # zero-arg providers are fine too
+                    payload = _dlq_provider()
                 body = _json.dumps(payload, default=str).encode("utf-8")
             except Exception as e:
                 code = 500

@@ -226,6 +226,12 @@ class TestJobSubmit:
                 return f"127.0.0.1:{port}" \
                     if key == "distributed.bus_address" else default
 
+            def get_int(self, key, default=0):
+                return default
+
+            def get_float(self, key, default=0.0):
+                return default
+
         server = _make_bus(_R(), serve=True)
         consumer = RemoteBus(f"127.0.0.1:{port}")
         class _StubCleaner:

@@ -71,6 +71,13 @@ def selfcheck() -> int:
     if rc != 0:
         print("critpath selfcheck FAILED", file=sys.stderr)
         return rc
+    rc = subprocess.call(
+        [sys.executable, os.path.join(repo, "tools", "dlq.py"),
+         "--selfcheck"], cwd=repo,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    if rc != 0:
+        print("dlq selfcheck FAILED", file=sys.stderr)
+        return rc
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     return subprocess.call(
         [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
@@ -86,7 +93,10 @@ def selfcheck() -> int:
          os.path.join(repo, "tests", "test_asr_serve.py"),
          # distributed traces: span export/collection, /dtraces,
          # occupancy math, and the orch+worker assembly e2e.
-         os.path.join(repo, "tests", "test_distributed_trace.py")],
+         os.path.join(repo, "tests", "test_distributed_trace.py"),
+         # bus durability: spool replay, outbox, DLQ, broker restart,
+         # and the kill-broker gate acceptance (ISSUE 10 closure).
+         os.path.join(repo, "tests", "test_bus_durability.py")],
         env=env, cwd=repo)
 
 
