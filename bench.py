@@ -54,6 +54,7 @@ LATENCY_SAMPLES = 30
 # fallback while the driver's patience lasts, not to wait out a wedge.
 CHILD_TIMEOUT_S = int(os.environ.get("BENCH_TIMEOUT_S", "560"))
 SCALE_TIMEOUT_S = int(os.environ.get("BENCH_SCALE_TIMEOUT_S", "240"))
+MESH_TIMEOUT_S = int(os.environ.get("BENCH_MESH_TIMEOUT_S", "300"))
 # Pre-flight probe: one tiny jitted matmul on the default backend.  A wedged
 # chip is discovered here in ≤PROBE_TIMEOUT_S instead of burning the full
 # child budget, and the headline falls back to a CPU-labelled measurement.
@@ -823,7 +824,7 @@ def _last_json_line(text: str):
     return None
 
 
-def _dp_sharding_overhead() -> float | None:
+def _dp_sharding_overhead(mesh8_pps: "float | None" = None) -> float | None:
     """Work-normalized dp-sharding efficiency on virtual CPU devices.
 
     Both runs push the SAME total batch (128) through the SAME host cores —
@@ -833,10 +834,17 @@ def _dp_sharding_overhead() -> float | None:
     says NOTHING about real multi-chip scaling (that needs ICI), unlike the
     naive 8-dev/1-dev throughput ratio it replaces, which mostly measured
     core oversubscription (r03's misleading 0.107).
+
+    ``mesh8_pps`` seeds the n=8 point when the mesh-scaling leg already
+    measured it: that leg's n=8 child is argv/env-identical (batch
+    16·8 = 128 on 8 forced devices), so re-spawning it would burn up to
+    SCALE_TIMEOUT_S on a byte-for-byte duplicate measurement.
     """
     try:
-        per_mode = {}
+        per_mode = {8: mesh8_pps} if mesh8_pps else {}
         for n in (1, 8):
+            if n in per_mode:
+                continue
             proc = _run_child(["--scale", str(n), "--scale-batch", "128"],
                               _cpu_env(n), SCALE_TIMEOUT_S)
             sys.stderr.write(proc.stderr)
@@ -849,6 +857,43 @@ def _dp_sharding_overhead() -> float | None:
     except Exception as exc:  # noqa: BLE001 — scaling row is best-effort
         _log(f"dp scaling skipped: {exc}")
         return None
+
+
+def _mesh_scaling_rows() -> dict:
+    """The BASELINE north-star trajectory: posts/sec at mesh sizes
+    1/2/4/8 (``posts_per_s_mesh{1,2,4,8}`` rows).
+
+    Each point is its own child on n forced virtual CPU devices — the
+    same dp mesh construction + param/batch sharding a mesh-configured
+    tpu-worker serves with — sized down like every CPU leg (the --scale
+    child's two-point bf16 fit, batch 16·n so per-chip work stays
+    constant across points).  On a real v5e slice the curve IS the
+    headline metric; on CPU the virtual devices share host cores, so
+    these rows carry the trajectory and prove the sharding machinery,
+    never a scaling claim (``mesh_platform`` labels which).
+    Guaranteed-JSON: a failed point degrades to None, never a crash.
+    """
+    out: dict = {"mesh_platform": "cpu_virtual"}
+    for n in (1, 2, 4, 8):
+        key = f"posts_per_s_mesh{n}"
+        try:
+            got, err = _try_child(
+                ["--scale", str(n), "--scale-batch", str(16 * n)],
+                _cpu_env(n), MESH_TIMEOUT_S)
+        except Exception as exc:  # noqa: BLE001 — guaranteed-JSON leg
+            got, err = None, f"{type(exc).__name__}: {exc}"
+        if got is None or "posts_per_sec" not in got:
+            _log(f"mesh scaling point n={n} skipped: {err}")
+            out[key] = None
+        else:
+            out[key] = round(got["posts_per_sec"], 1)
+            _log(f"mesh scaling n={n}: {out[key]} posts/sec")
+    if out.get("posts_per_s_mesh1") and out.get("posts_per_s_mesh8"):
+        out["mesh_scaling_8x"] = round(
+            out["posts_per_s_mesh8"] / out["posts_per_s_mesh1"], 3)
+    else:
+        out["mesh_scaling_8x"] = None
+    return out
 
 
 def _try_child(argv: list, env: dict, timeout: int):
@@ -1141,8 +1186,19 @@ def _parent() -> None:
         result.update(_measure_padding_efficiency())
     except Exception as exc:  # noqa: BLE001 — best-effort row
         _log(f"padding efficiency row skipped: {exc}")
+    _log("measuring mesh scaling curve (1/2/4/8 virtual devices)")
+    try:
+        result.update(_mesh_scaling_rows())
+    except Exception as exc:  # noqa: BLE001 — best-effort rows
+        _log(f"mesh scaling rows skipped: {exc}")
+        # skip→None for EVERY row the leg owns: schema-stable JSON even
+        # when the whole leg (not just one child) fails.
+        result.setdefault("mesh_platform", None)
+        for n in (1, 2, 4, 8):
+            result.setdefault(f"posts_per_s_mesh{n}", None)
+        result.setdefault("mesh_scaling_8x", None)
     _log("measuring dp sharding overhead on virtual CPU mesh")
-    eff = _dp_sharding_overhead()
+    eff = _dp_sharding_overhead(mesh8_pps=result.get("posts_per_s_mesh8"))
     # Work-normalized (same batch, same host cores, 1 vs 8 virtual CPU
     # devices): isolates dp-sharding overhead; deliberately NOT a claim
     # about multi-chip scaling, which needs real ICI.

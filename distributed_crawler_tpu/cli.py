@@ -295,6 +295,25 @@ def build_parser() -> argparse.ArgumentParser:
            "ms (asr_worker.process/coalesce spans; breach -> "
            "slo_breach_total{slo=asr_batch}; 0 = off)")
     a("--infer-batch-size", type=int, default=None)
+    # Serving mesh (`parallel:` config block; docs/tpu.md "Multi-chip
+    # serving").  Defaults = single-device serving; the flags feed
+    # parallel.mesh.best_mesh_config/make_mesh via
+    # inference.worker.build_serving_mesh in the tpu-worker (and
+    # --bus-serve standalone) modes.
+    a("--mesh-data", type=int, default=None,
+      help="data-parallel mesh axis (dp): batches shard across this many "
+           "chips; 0 = auto (devices / (seq*tensor)) once a mesh is on, "
+           "and with every mesh flag at its default serving stays "
+           "single-device")
+    a("--mesh-seq", type=int, default=None,
+      help="sequence-parallel mesh axis (sp); default 1")
+    a("--mesh-tensor", type=int, default=None,
+      help="tensor-parallel mesh axis (tp); default 1")
+    a("--mesh-devices", type=int, default=None,
+      help="devices the serving mesh spans: 0 (default) = off unless an "
+           "axis flag asks for >1, -1 = all visible devices, N = the "
+           "first N visible devices (CPU recipe: XLA_FLAGS="
+           "--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu)")
     a("--infer-attention", default=None,
       help="attention dispatch: auto (flash past the length threshold on "
            "TPU) | xla | flash")
@@ -484,6 +503,10 @@ _KEY_MAP = {
     "journal_dir": "orchestrator.journal_dir",
     "fresh": "orchestrator.fresh",
     "infer_batch_size": "inference.batch_size",
+    "mesh_data": "parallel.data",
+    "mesh_seq": "parallel.seq",
+    "mesh_tensor": "parallel.tensor",
+    "mesh_devices": "parallel.devices",
     "infer_attention": "inference.attention",
     "infer_moe_dispatch": "inference.moe_dispatch",
     "infer_param_dtype": "inference.param_dtype",
@@ -611,6 +634,10 @@ def resolve_config(args: argparse.Namespace,
     buckets = r.get_list("inference.bucket_sizes")
     if buckets:
         cfg.inference.bucket_sizes = [int(b) for b in buckets]
+    cfg.inference.mesh_data = r.get_int("parallel.data", 0)
+    cfg.inference.mesh_seq = r.get_int("parallel.seq", 1)
+    cfg.inference.mesh_tensor = r.get_int("parallel.tensor", 1)
+    cfg.inference.mesh_devices = r.get_int("parallel.devices", 0)
     cfg.inference.param_dtype = r.get_str("inference.param_dtype", "")
     cfg.inference.quantize = r.get_str("inference.quantize", "")
     cfg.inference.attention = r.get_str("inference.attention", "")
@@ -1613,15 +1640,35 @@ def _run_transcribe(cfg: CrawlerConfig, r: ConfigResolver) -> int:
 def _make_engine(cfg: CrawlerConfig, r: ConfigResolver,
                  n_labels: Optional[int] = None,
                  with_checkpoint: bool = False,
-                 cast_params: bool = True):
+                 cast_params: bool = True,
+                 with_mesh: bool = False):
     """One engine-wiring path for tpu-worker / train-head / cluster.
 
     ``cast_params=False`` keeps the f32 layout regardless of
     ``inference.param_dtype`` / ``inference.quantize`` — train-head must
     fine-tune on (and persist) full-precision weights even when the same
-    config file serves bf16 or int8."""
+    config file serves bf16 or int8.
+
+    ``with_mesh=True`` (the serving modes) builds the data-parallel
+    serving mesh from the ``parallel:`` block / --mesh-* flags
+    (`inference.worker.build_serving_mesh`); params shard per
+    `parallel.sharding` and batches shard across dp.  train-head and the
+    cluster text-embed path stay single-device (cluster's k-means builds
+    its own mesh)."""
     from .inference.engine import EngineConfig, InferenceEngine
 
+    mesh = None
+    if with_mesh:
+        from .inference.worker import build_serving_mesh
+
+        try:
+            mesh = build_serving_mesh(
+                data=cfg.inference.mesh_data,
+                seq=cfg.inference.mesh_seq,
+                tensor=cfg.inference.mesh_tensor,
+                devices=cfg.inference.mesh_devices)
+        except ValueError as e:
+            raise CliConfigError(str(e))
     kw = dict(
         model=cfg.inference.embed_model.replace("-", "_"),
         batch_size=cfg.inference.batch_size,
@@ -1644,7 +1691,7 @@ def _make_engine(cfg: CrawlerConfig, r: ConfigResolver,
         kw["n_labels"] = n_labels
     if with_checkpoint:
         kw["checkpoint_dir"] = r.get_str("train.checkpoint_dir") or None
-    return InferenceEngine(EngineConfig(**kw))
+    return InferenceEngine(EngineConfig(**kw), mesh=mesh)
 
 
 def _run_cluster(cfg: CrawlerConfig, r: ConfigResolver) -> int:
@@ -1753,9 +1800,9 @@ def _build_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver):
     if serve and not r.get_str("distributed.bus_address"):
         raise CliConfigError("--bus-serve requires --bus-address")  # early
     # Engine and sink before the bus: if either raises (bad model key,
-    # unreachable object store), no server port has been bound and no
-    # threads need tearing down.
-    engine = _make_engine(cfg, r, with_checkpoint=True)
+    # unreachable object store, too few devices for the mesh), no server
+    # port has been bound and no threads need tearing down.
+    engine = _make_engine(cfg, r, with_checkpoint=True, with_mesh=True)
     # Results sink: the object store when configured (--object-store),
     # else JSONL under the same storage root the crawler uses.
     if cfg.object_store_url:

@@ -54,8 +54,14 @@ class InferenceConfig:
     max_seq_len: int = 512
     bucket_sizes: List[int] = field(default_factory=lambda: [64, 128, 256, 512])
     batch_deadline_ms: int = 50  # flush a partial batch after this long
-    mesh_shape: Optional[List[int]] = None  # None -> all devices on one data axis
-    mesh_axes: List[str] = field(default_factory=lambda: ["data"])
+    # Serving mesh (`parallel:` config block / --mesh-* flags; wired
+    # through inference.worker.build_serving_mesh).  All defaults =
+    # single-device serving (no mesh — the historical path).
+    mesh_data: int = 0     # dp axis; 0 = auto (devices / (seq*tensor))
+    mesh_seq: int = 1      # sp axis (sequence-parallel ring attention)
+    mesh_tensor: int = 1   # tp axis (Megatron-style weight sharding)
+    mesh_devices: int = 0  # 0 = off unless an axis >1; -1 = all visible
+    #                        devices; N = first N visible devices
     dtype: str = "bfloat16"
     # Serving-time parameter cast ("" keeps f32; "bfloat16" halves weight
     # HBM traffic — see EngineConfig.param_dtype).

@@ -185,6 +185,30 @@ class InferenceEngine:
             self.ecfg = replace(
                 self.ecfg, n_labels=int(head["head"]["bias"].shape[0]))
         self.mesh = mesh
+        # Mesh accounting: how many chips one dispatch covers, the data
+        # axis size, and the padded ROW dimension.  Rows round UP to a
+        # multiple of the DATA axis (the only axis sharding the batch
+        # dim; dp == n_devices under the pure-dp serving default) so a
+        # non-divisible batch_size (or a non-divisible coalesced group's
+        # tail chunk) still dispatches ONE program with the batch dim
+        # sharded over dp — without the padding,
+        # `parallel.sharding.shard_batch` would silently fall back to
+        # replicated placement and every chip would run the full batch.
+        # An sp/tp-dominated mesh (dp < n_devices) pads only to dp:
+        # those axes impose no row-divisibility constraint, and padding
+        # further would dispatch pure waste.  Padding rows are all-pad (mask 0): they are excluded
+        # from results, writeback, and the real-token side of the
+        # goodput/density meters, and they COUNT as dispatched slot
+        # tokens — padded work is real waste and must read as such.
+        if mesh is not None:
+            self.n_devices = int(mesh.devices.size)
+            self._dp = int(mesh.shape.get("dp", 1))
+            self._device_labels = [str(d.id) for d in mesh.devices.flat]
+        else:
+            self.n_devices = 1
+            self._dp = 1
+            self._device_labels = ["0"]
+        self._rows = -(-cfg.batch_size // self._dp) * self._dp
         self.model = EmbedderClassifier(self.ecfg)
         self.tokenizer = tokenizer or HashingTokenizer(self.ecfg.vocab_size)
         self.bucket_spec = BucketSpec(
@@ -223,7 +247,12 @@ class InferenceEngine:
         # /costs endpoint via cost_snapshot(); the meter also rides
         # telemetry heartbeats into the orchestrator's /cluster view.
         self.costs = CostModel(registry=registry)
-        self.meter = EfficiencyMeter(registry=registry)
+        # Mesh-aware: peak resolves as the n_devices aggregate (MFU must
+        # not read N× too high when a mesh appears) and per-dispatch
+        # shard masks feed the per-chip goodput rows.
+        self.meter = EfficiencyMeter(registry=registry,
+                                     n_devices=self.n_devices,
+                                     device_labels=self._device_labels)
         # Device-occupancy accounting (`utils/occupancy.py`): one interval
         # per device batch, [async dispatch, readback-on-host] — the
         # host-observable envelope of device busy time.  Derives the
@@ -235,7 +264,8 @@ class InferenceEngine:
         # tokenize→dispatch→wait gap per coalesce group; the worker's feed
         # loop calls `timeline.start_stream()` whenever its queue ran dry
         # so idle-by-no-work never scores as a bubble.
-        self.timeline = DeviceTimeline(registry=registry, path="text")
+        self.timeline = DeviceTimeline(registry=registry, path="text",
+                                       n_devices=self.n_devices)
 
         if params is None:
             import jax.numpy as jnp
@@ -377,17 +407,37 @@ class InferenceEngine:
         actually serves.  Idempotent and never raises (`CostModel`)."""
         if self.costs.has(bucket, path):
             return
-        bs = self.cfg.batch_size
+        rows = self._rows
         self.costs.capture(
             bucket, path, lambda: step.lower(self.params, *placed),
-            encoder_forward_flops(self.ecfg, bs, bucket),
-            batch=bs, seq=bucket)
+            encoder_forward_flops(self.ecfg, rows, bucket),
+            batch=rows, seq=bucket)
 
     def _batch_flops(self, bucket: int, path: str) -> float:
+        # The dispatched program's row dim is `_rows` (batch_size padded
+        # to a mesh multiple), so the analytic fallback prices what the
+        # mesh actually runs, not the logical batch_size.
         return self.costs.flops_for(
             bucket, path,
-            default=encoder_forward_flops(self.ecfg, self.cfg.batch_size,
-                                          bucket))
+            default=encoder_forward_flops(self.ecfg, self._rows, bucket))
+
+    def _per_device_real(self, mask: np.ndarray) -> Optional[List[int]]:
+        """Real (non-pad) tokens per mesh device, from the host-side mask
+        BEFORE device_put: the padded batch dim shards contiguously over
+        dp, so chip i's data shard is one row block — the split that
+        makes per-chip goodput honest (a tail chunk's padding rows land
+        in the high shards and score zero there).  With sp/tp > 1, each
+        device in a dp slice reports its shard's tokens (they all touch
+        that shard).  None single-device or on a replicated fallback."""
+        if self.mesh is None or self.n_devices <= 1:
+            return None
+        rows = mask.shape[0]
+        if rows % self._dp:
+            return None  # shard_batch replicates this shape; no split
+        per_shard = np.asarray(mask, dtype=np.int64).reshape(
+            self._dp, rows // self._dp, -1).sum(axis=(1, 2))
+        spt = self.n_devices // self._dp
+        return [int(per_shard[i // spt]) for i in range(self.n_devices)]
 
     def cost_snapshot(self) -> Dict[str, Any]:
         """The /costs body: per-(bucket, path) compiled cost + the rolling
@@ -395,6 +445,10 @@ class InferenceEngine:
         return {
             "model": self.cfg.model,
             "batch_size": self.cfg.batch_size,
+            "rows_per_dispatch": self._rows,
+            "n_devices": self.n_devices,
+            "mesh": {str(k): int(v) for k, v in self.mesh.shape.items()}
+            if self.mesh is not None else None,
             "buckets": list(self.bucket_spec.lengths),
             "costs": self.costs.snapshot(),
             "efficiency": self.meter.snapshot(),
@@ -465,11 +519,18 @@ class InferenceEngine:
             groups.setdefault(
                 bucket_for(len(toks), self.bucket_spec), []).append(i)
 
-        bs = self.cfg.batch_size
+        # Chunk by the PADDED row dim (batch_size rounded up to a
+        # data-axis multiple): padding rows keep the dp sharding
+        # divisible; they
+        # carry mask 0 and no chunk entry, so they never reach results,
+        # writeback, or the real-token meters — but they DO count as
+        # dispatched slot tokens (honest padding density).
+        rows = self._rows
         pending: Optional[tuple] = None  # (chunk, emb_dev, logits_dev, t0,
-        #                                  bucket, real_tokens)
+        #                                  bucket, real_tokens, per_dev)
 
-        def materialize(chunk, emb, logits, t0, bucket, real_tokens):
+        def materialize(chunk, emb, logits, t0, bucket, real_tokens,
+                        per_dev):
             with trace.span("engine.unpack", rows=len(chunk)):
                 emb_np = np.asarray(emb)         # device->host sync
                 logits_np = np.asarray(logits)
@@ -481,9 +542,10 @@ class InferenceEngine:
                 self.timeline.record(t0, t0 + dt)
                 self.m_latency.observe(dt)
                 self.meter.record(dt, self._batch_flops(bucket, "unpacked"),
-                                  real_tokens, bs * bucket)
+                                  real_tokens, rows * bucket,
+                                  per_device_real_tokens=per_dev)
                 self.m_posts.inc(len(chunk))
-                self.m_padding.inc(bs - len(chunk))
+                self.m_padding.inc(rows - len(chunk))
                 scores = _softmax_np(logits_np)
                 for row, i in enumerate(chunk):
                     label = int(np.argmax(logits_np[row]))
@@ -496,26 +558,28 @@ class InferenceEngine:
                         results[i]["label_name"] = self.label_names[label]
 
         for bucket, indices in sorted(groups.items()):
-            for start in range(0, len(indices), bs):
-                chunk = indices[start:start + bs]
+            for start in range(0, len(indices), rows):
+                chunk = indices[start:start + rows]
                 self.m_bucket_posts.labels(bucket=str(bucket)).inc(len(chunk))
                 with trace.span("engine.pack", bucket=bucket,
                                 rows=len(chunk)):
                     ids, mask = pack_batch(
                         [token_lists[i] for i in chunk],
-                        BucketSpec((bucket,)), batch_pad_to=bs)
+                        BucketSpec((bucket,)), batch_pad_to=rows)
                 real_tokens = int(mask.sum())
+                per_dev = self._per_device_real(mask)
                 with trace.span("engine.device_put", bucket=bucket):
                     placed = self._place(ids, mask)
                 step = self._step(bucket)
                 t0 = time.perf_counter()
-                with trace.span("engine.compute", bucket=bucket, batch=bs,
+                with trace.span("engine.compute", bucket=bucket, batch=rows,
                                 sequences=len(chunk)):
                     emb, logits = step(self.params, *placed)
                 self._capture_cost(bucket, "unpacked", step, placed)
                 if pending is not None:
                     materialize(*pending)
-                pending = (chunk, emb, logits, t0, bucket, real_tokens)
+                pending = (chunk, emb, logits, t0, bucket, real_tokens,
+                           per_dev)
         if pending is not None:
             materialize(*pending)
         return results  # type: ignore[return-value]
@@ -557,24 +621,29 @@ class InferenceEngine:
             groups.setdefault(
                 bucket_for(len(toks), self.bucket_spec), []).append(i)
 
-        bs = self.cfg.batch_size
+        # Padded row dim, as in the unpacked path: a coalesced group
+        # whose packed rows don't divide by the data axis still
+        # dispatches one program (all-pad filler rows, mask 0, no slot),
+        # sharded over dp instead of silently replicated.
+        rows = self._rows
         pending: Optional[tuple] = None  # (slots, used, emb, logits, t0,
-        #                                  bucket, real_tokens)
+        #                                  bucket, real_tokens, per_dev)
 
         def materialize(slots, used_rows, emb, logits, t0, bucket,
-                        real_tokens):
+                        real_tokens, per_dev):
             with trace.span("engine.unpack", segments=len(slots),
                             rows=used_rows):
                 emb_np = np.asarray(emb)        # device->host sync
-                logits_np = np.asarray(logits)  # [bs, S, n_labels]
+                logits_np = np.asarray(logits)  # [rows, S, n_labels]
                 dt = time.perf_counter() - t0
                 self.timeline.record(t0, t0 + dt)
                 self.m_latency.observe(dt)
                 self.meter.record(dt, self._batch_flops(bucket, "packed"),
-                                  real_tokens, bs * bucket)
+                                  real_tokens, rows * bucket,
+                                  per_device_real_tokens=per_dev)
                 self.m_posts.inc(len(slots))
                 self.m_packed.inc(len(slots))
-                self.m_padding.inc(bs - used_rows)
+                self.m_padding.inc(rows - used_rows)
                 flat = logits_np.reshape(-1, logits_np.shape[-1])
                 scores = _softmax_np(flat).reshape(logits_np.shape)
                 for row, slot, i in slots:
@@ -594,17 +663,17 @@ class InferenceEngine:
                 packed = pack_rows([token_lists[i] for i in indices], bucket,
                                    max_segments=self.cfg.pack_max_segments,
                                    indices=indices)
-            for start in range(0, packed.n_rows, bs):
-                end = min(start + bs, packed.n_rows)
+            for start in range(0, packed.n_rows, rows):
+                end = min(start + rows, packed.n_rows)
                 used = end - start
                 ids = packed.ids[start:end]
                 mask = packed.mask[start:end]
                 seg = packed.segment_ids[start:end]
                 pos = packed.positions[start:end]
-                if used < bs:
+                if used < rows:
                     # All-pad filler rows (segment id 0 everywhere) keep
                     # the batch shape static; no slot maps to them.
-                    pad = ((0, bs - used), (0, 0))
+                    pad = ((0, rows - used), (0, 0))
                     ids = np.pad(ids, pad)
                     mask = np.pad(mask, pad)
                     seg = np.pad(seg, pad)
@@ -613,19 +682,20 @@ class InferenceEngine:
                          for r in range(start, end)
                          for s, orig in enumerate(packed.assignments[r])]
                 real_tokens = int(mask.sum())
+                per_dev = self._per_device_real(mask)
                 with trace.span("engine.device_put", bucket=bucket,
                                 packed=True):
                     placed = self._place(ids, mask, seg, pos)
                 step = self._packed_step(bucket)
                 t0 = time.perf_counter()
-                with trace.span("engine.compute", bucket=bucket, batch=bs,
+                with trace.span("engine.compute", bucket=bucket, batch=rows,
                                 segments=len(slots), packed=True):
                     emb, logits = step(self.params, *placed)
                 self._capture_cost(bucket, "packed", step, placed)
                 if pending is not None:
                     materialize(*pending)
                 pending = (slots, used, emb, logits, t0, bucket,
-                           real_tokens)
+                           real_tokens, per_dev)
         if pending is not None:
             materialize(*pending)
         return results  # type: ignore[return-value]

@@ -66,6 +66,68 @@ def iter_results(provider, crawl_id: str,
                 yield json.loads(line)
 
 
+def build_serving_mesh(data: int = 0, seq: int = 1, tensor: int = 1,
+                       devices: int = 0):
+    """Construct the serving mesh from the ``parallel:`` config block
+    (`--mesh-data` / `--mesh-seq` / `--mesh-tensor` / `--mesh-devices`),
+    or return None for the historical single-device path.
+
+    Semantics (docs/tpu.md "Multi-chip serving"):
+
+    - everything at its default (``data=0, seq=1, tensor=1, devices=0``)
+      → **None**: no mesh, the engine serves one device exactly as before;
+    - ``devices=-1`` → span ALL visible devices: dp is whatever remains
+      after seq/tensor (``parallel.mesh.best_mesh_config``);
+    - ``devices=N`` (>0) → span the first N visible devices, dp from the
+      remainder the same way;
+    - ``data=N`` (>0) → explicit dp axis; the device count is then
+      ``data*seq*tensor`` unless ``devices`` pins it (they must agree).
+
+    Raises ValueError on invalid/conflicting flags or when the host has
+    fewer devices than asked — serving on a silently smaller mesh than
+    configured would invalidate every capacity assumption the flag
+    encoded.  The count resolution itself is
+    `parallel.mesh.serving_device_count` (shared with tools/loadtest.py
+    so harness provisioning can't drift from mesh construction).
+    """
+    from ..parallel.mesh import (
+        best_mesh_config,
+        make_mesh,
+        serving_device_count,
+    )
+
+    n = serving_device_count(data=data, seq=seq, tensor=tensor,
+                             devices=devices)
+    if n == 0:
+        return None
+    import jax
+
+    avail = jax.devices()
+    if n == -1:
+        n = len(avail)
+        # serving_device_count defers this conflict to the caller that
+        # knows the visible count: devices=-1 plus an explicit dp that
+        # doesn't match must raise, not silently override the operator's
+        # axis (the same contract as an explicit --mesh-devices N).
+        if int(data) > 0 and n != int(data) * max(1, int(seq)) \
+                * max(1, int(tensor)):
+            raise ValueError(
+                f"mesh axes dp={data} sp={seq} tp={tensor} "
+                f"({int(data) * max(1, int(seq)) * max(1, int(tensor))} "
+                f"devices) conflict with --mesh-devices -1 "
+                f"({n} visible devices)")
+    if n > len(avail):
+        raise ValueError(
+            f"serving mesh wants {n} devices but only {len(avail)} are "
+            f"visible (CPU recipe: XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={n} JAX_PLATFORMS=cpu)")
+    cfg = best_mesh_config(n, tp=max(1, int(tensor)), sp=max(1, int(seq)))
+    mesh = make_mesh(cfg, devices=list(avail[:n]))
+    logger.info("serving mesh: %s over %d %s device(s)",
+                dict(mesh.shape), n, avail[0].platform)
+    return mesh
+
+
 @dataclass
 class TPUWorkerConfig:
     worker_id: str = "tpu-worker-0"
@@ -229,9 +291,13 @@ class TPUWorker:
         started = self._step_started
         step_age = (time.monotonic() - started) if started is not None else 0.0
         threshold = self._stall_threshold()
+        mesh = getattr(self.engine, "mesh", None)
         return {
             "worker_id": self.cfg.worker_id,
             "model": self.engine.cfg.model,
+            "n_devices": getattr(self.engine, "n_devices", 1),
+            "mesh": {str(k): int(v) for k, v in mesh.shape.items()}
+            if mesh is not None else None,
             "is_running": not self._stop.is_set() and bool(self._threads),
             "queue_depth": self._queue.qsize(),
             "inflight": self._inflight,

@@ -177,6 +177,31 @@ def _occupancy_checks(check, gate_cfg: Dict[str, Any],
     return occ
 
 
+def _per_chip_checks(check, gate_cfg: Dict[str, Any],
+                     costs_body: Optional[Dict[str, Any]]
+                     ) -> List[Dict[str, Any]]:
+    """Per-chip efficiency envelope over the /costs ``efficiency.per_chip``
+    rows (`utils/costmodel.EfficiencyMeter`): every mesh device must be
+    present, and every device's goodput must clear the floor — the check
+    that forbids per-chip collapse (a feed whose padded rows starve the
+    high data shards, or a mesh that silently fell back to one device,
+    fails here while aggregate goodput still looks fine)."""
+    eff = (costs_body or {}).get("efficiency") or {}
+    per_chip = eff.get("per_chip") or []
+    if gate_cfg.get("require_per_chip_devices") is not None:
+        need = int(gate_cfg["require_per_chip_devices"])
+        check("per_chip_devices", len(per_chip) >= need, len(per_chip),
+              f">= {need} per-chip efficiency rows")
+    if gate_cfg.get("min_per_chip_goodput_tokens_per_s") is not None:
+        floor = float(gate_cfg["min_per_chip_goodput_tokens_per_s"])
+        worst = min((c.get("goodput_tokens_per_s") or 0.0
+                     for c in per_chip), default=0.0)
+        check("per_chip_goodput_tokens_per_s",
+              bool(per_chip) and worst >= floor, round(worst, 2),
+              f">= {floor} on EVERY chip")
+    return per_chip
+
+
 def _dtrace_checks(check, gate_cfg: Dict[str, Any],
                    dtraces_body: Optional[Dict[str, Any]]
                    ) -> Dict[str, Any]:
@@ -618,9 +643,24 @@ def run_scenario(scenario: Dict[str, Any],
     registry = MetricsRegistry()
 
     t_run0 = time.monotonic()
+    # Serving mesh (scenario "parallel" block, the config-file twin of
+    # --mesh-*): the worker under test shards params + padded batches
+    # across dp, exactly like a mesh-configured tpu-worker.  On CPU the
+    # recipe is XLA_FLAGS=--xla_force_host_platform_device_count=8
+    # JAX_PLATFORMS=cpu (tools/loadtest.py arranges this for checked-in
+    # scenarios before jax initializes).
+    mesh = None
+    par = scenario.get("parallel") or {}
+    if par:
+        from ..inference.worker import build_serving_mesh
+
+        mesh = build_serving_mesh(
+            data=int(par.get("data", 0)), seq=int(par.get("seq", 1)),
+            tensor=int(par.get("tensor", 1)),
+            devices=int(par.get("devices", 0)))
     engine = ChaosEngine(InferenceEngine(
         EngineConfig(**scenario.get("engine", {"model": "tiny"})),
-        registry=registry))
+        mesh=mesh, registry=registry))
     provider = InMemoryStorageProvider()
     tmpdir = tempfile.mkdtemp(prefix="dct-loadgen-")
 
@@ -992,6 +1032,7 @@ def run_scenario(scenario: Dict[str, Any],
                 "completed_items": completed,
             })
         occupancy = _occupancy_checks(check, gate_cfg, endpoints["costs"])
+        per_chip = _per_chip_checks(check, gate_cfg, endpoints["costs"])
         dtrace_summary = _dtrace_checks(check, gate_cfg,
                                         endpoints["dtraces"])
         # Unrouted-message accounting (the silent-drop fix): every topic
@@ -1066,6 +1107,9 @@ def run_scenario(scenario: Dict[str, Any],
             "cluster_workers": sorted(
                 (endpoints["cluster"] or {}).get("workers", {})),
             "occupancy": occupancy,
+            "mesh": {str(k): int(v) for k, v in mesh.shape.items()}
+            if mesh is not None else None,
+            "per_chip": per_chip,
             "dtraces": dtrace_summary,
             "checks": checks,
         })

@@ -65,6 +65,48 @@ def best_mesh_config(n_devices: int, *, tp: int = 1, sp: int = 1) -> MeshConfig:
     return cfg
 
 
+def serving_device_count(data: int = 0, seq: int = 1, tensor: int = 1,
+                         devices: int = 0) -> int:
+    """Resolve the ``parallel:`` block / ``--mesh-*`` flags to a device
+    count: 0 = no mesh (single-device serving), -1 = all visible
+    devices, N = exactly N devices.
+
+    The ONE interpretation of (data, seq, tensor, devices) — shared by
+    `inference.worker.build_serving_mesh` and `tools/loadtest.py`'s
+    virtual-device forcing, so the count a harness provisions can never
+    drift from the count the mesh construction demands.  Invalid or
+    conflicting values raise instead of silently downgrading: a typo'd
+    mesh flag must not serve 1/Nth of the configured capacity.  One
+    conflict is undecidable here: devices=-1 with an explicit dp — the
+    visible count isn't known in this jax-free helper, so the caller
+    that resolves -1 (`build_serving_mesh`) performs that check.
+    """
+    data, seq, tensor, devices = (int(data), int(seq), int(tensor),
+                                  int(devices))
+    if devices < -1:
+        raise ValueError(
+            f"--mesh-devices must be -1 (all), 0 (off) or a positive "
+            f"count, got {devices}")
+    for name, v in (("--mesh-data", data),):
+        if v < 0:
+            raise ValueError(f"{name} must be >= 0 (0 = auto), got {v}")
+    for name, v in (("--mesh-seq", seq), ("--mesh-tensor", tensor)):
+        if v < 1:
+            raise ValueError(f"{name} must be >= 1, got {v}")
+    if data == 0 and seq == 1 and tensor == 1 and devices == 0:
+        return 0
+    if devices == -1:
+        return -1
+    if devices > 0:
+        if data > 0 and devices != data * seq * tensor:
+            raise ValueError(
+                f"mesh axes dp={data} sp={seq} tp={tensor} "
+                f"({data * seq * tensor} devices) conflict with "
+                f"--mesh-devices {devices}")
+        return devices
+    return max(1, data) * seq * tensor
+
+
 def make_mesh(config: Optional[MeshConfig] = None,
               devices: Optional[List] = None):
     """Build a `jax.sharding.Mesh` with axes (dp, sp, tp).

@@ -123,6 +123,15 @@ def peak_flops(device_kind: str = "", platform: str = "",
     TPU kinds resolve through :data:`PEAK_BF16_FLOPS`; CPU gets the
     conservative estimate; anything else returns (0, "unknown") so MFU is
     omitted rather than invented.
+
+    The aggregate ALWAYS scales with ``n_devices`` — CPU included — so an
+    engine serving over an N-chip mesh divides its achieved FLOP/s by N×
+    the single-chip peak.  Without this, MFU silently reads N× too high
+    the moment a mesh appears (same work, same peak denominator).  Virtual
+    CPU devices share host cores, so the scaled CPU figure is even more
+    conservative than the single-device one — acceptable, because its job
+    is keeping the MFU pipeline exercised and mesh-consistent, never
+    claiming a real utilisation (``peak_source: "cpu_estimate"``).
     """
     kind = (device_kind or "").lower()
     n = max(1, int(n_devices))
@@ -132,22 +141,29 @@ def peak_flops(device_kind: str = "", platform: str = "",
                 return peak * n, f"tpu:{sub}"
         return 0.0, "unknown"
     if platform == "cpu":
-        return CPU_PEAK_FLOPS_ESTIMATE, "cpu_estimate"
+        return CPU_PEAK_FLOPS_ESTIMATE * n, "cpu_estimate"
     return 0.0, "unknown"
 
 
-def default_peak_flops() -> Tuple[float, str]:
+def default_peak_flops(n_devices: Optional[int] = None) -> Tuple[float, str]:
     """Peak for the ALREADY-IMPORTED jax's default backend; (0, "unknown")
     when jax isn't loaded — same never-import rule as
     `utils/telemetry.py:device_memory_stats` (a crawl worker's heartbeat
-    must not pay the jax import)."""
+    must not pay the jax import).
+
+    ``n_devices`` is the count the CALLER actually dispatches over (the
+    engine's mesh size, 1 for a single-device engine); ``None`` keeps the
+    historical all-visible-devices behavior for callers with no mesh
+    notion.  An engine on one chip of an 8-chip host must NOT divide by
+    8× peak (MFU would read 1/8 too low), and an 8-chip mesh must not
+    divide by one chip's (N× too high)."""
     jax = sys.modules.get("jax")
     if jax is None:
         return 0.0, "unknown"
     try:
         devices = jax.devices()
-        return peak_flops(devices[0].device_kind, jax.default_backend(),
-                          len(devices))
+        n = len(devices) if n_devices is None else max(1, int(n_devices))
+        return peak_flops(devices[0].device_kind, jax.default_backend(), n)
     except Exception as e:  # a wedged backend must not kill telemetry
         logger.debug("peak-FLOPs resolution failed: %s", e)
         return 0.0, "unknown"
@@ -255,44 +271,76 @@ class EfficiencyMeter:
     number an operator wants (a chip that computes at 60% MFU for 1 s
     out of every 10 is a 6% chip).  ``mfu_busy`` (over summed batch
     durations only) is also reported for kernel-efficiency reads.
+
+    Mesh-aware: ``n_devices`` is how many chips one recorded dispatch
+    covers (the engine's mesh size; 1 single-device).  Peak resolves as
+    the N-chip aggregate — same achieved FLOPs over N× the denominator —
+    and ``per_device_real_tokens`` (one real-token count per chip's data
+    shard, from the host-side mask before device_put) feeds a per-chip
+    goodput split: a feed whose padded rows starve the high shards shows
+    those chips' goodput collapsing while the aggregate still looks
+    healthy.  Under SPMD every chip runs the identical program, so
+    per-chip MFU equals the aggregate MFU; goodput is where per-chip
+    truth lives.
     """
 
     def __init__(self, registry: MetricsRegistry = REGISTRY,
                  window_s: float = 60.0, max_records: int = 1024,
-                 peak: Optional[float] = None, peak_source: str = ""):
+                 peak: Optional[float] = None, peak_source: str = "",
+                 n_devices: int = 1,
+                 device_labels: Optional[List[str]] = None):
         self.window_s = window_s
-        self._records: "deque[Tuple[float, float, float, int, int]]" = \
-            deque(maxlen=max_records)
+        self._records: "deque[Tuple[float, float, float, int, int, Any]]" \
+            = deque(maxlen=max_records)
         self._ever_recorded = False
         self._lock = threading.Lock()
         # Peak injected for tests; resolved lazily from the live backend
         # otherwise (the engine imports jax long before the first batch).
         self._peak = peak
         self._peak_source = peak_source
+        self._n_devices = max(1, int(n_devices))
+        self.device_labels = list(device_labels) if device_labels else [
+            str(i) for i in range(self._n_devices)]
         self.m_mfu = registry.gauge(
             "tpu_engine_mfu",
-            "rolling-window achieved FLOP/s over peak (wall-clock window "
-            "incl. idle; 0 when peak is unknown)")
+            "rolling-window achieved FLOP/s over the MESH-AGGREGATE peak "
+            "(n_devices x one chip; wall-clock window incl. idle; 0 when "
+            "peak is unknown)")
         self.m_goodput = registry.gauge(
             "tpu_engine_goodput_tokens_per_s",
             "rolling-window REAL (non-pad) tokens per second")
         self.m_density = registry.gauge(
             "tpu_engine_padding_density",
             "rolling-window real tokens / dispatched slot tokens")
+        self.m_chip_goodput = registry.gauge(
+            "tpu_engine_per_chip_goodput_tokens_per_s",
+            "rolling-window REAL tokens/s attributed to one chip's data "
+            "shard (uniform split when per-shard masks weren't recorded)")
 
     def _resolve_peak(self) -> Tuple[float, str]:
         if self._peak is None:
-            self._peak, self._peak_source = default_peak_flops()
+            self._peak, self._peak_source = \
+                default_peak_flops(self._n_devices)
         return self._peak, self._peak_source
 
     def record(self, duration_s: float, flops: float,
-               real_tokens: int, slot_tokens: int) -> None:
-        """Account one device batch; updates the three gauges."""
+               real_tokens: int, slot_tokens: int,
+               per_device_real_tokens: Optional[List[int]] = None) -> None:
+        """Account one device batch; updates the gauges.
+
+        ``per_device_real_tokens`` — real (non-pad) tokens per chip's data
+        shard, length ``n_devices`` — lets the per-chip goodput split be
+        exact; omitted, the batch's real tokens attribute uniformly."""
         now = time.monotonic()
+        per_dev = None
+        if per_device_real_tokens is not None \
+                and len(per_device_real_tokens) == self._n_devices:
+            per_dev = tuple(int(v) for v in per_device_real_tokens)
         with self._lock:
             self._ever_recorded = True
             self._records.append((now, float(duration_s), float(flops),
-                                  int(real_tokens), int(slot_tokens)))
+                                  int(real_tokens), int(slot_tokens),
+                                  per_dev))
             self._prune(now)
         self.snapshot()  # refreshes the gauges as a side effect
 
@@ -301,22 +349,33 @@ class EfficiencyMeter:
         while self._records and self._records[0][0] < cutoff:
             self._records.popleft()
 
-    def _window_totals(self) -> Tuple[int, float, float, float, int, int]:
-        """(batches, span_s, busy_s, flops, real, slot) under the lock."""
+    def _window_totals(self) -> Tuple[int, float, float, float, int, int,
+                                      List[float]]:
+        """(batches, span_s, busy_s, flops, real, slot, per_device_real)
+        under the lock."""
         now = time.monotonic()
         with self._lock:
             self._prune(now)
             records = list(self._records)
         if not records:
-            return 0, 0.0, 0.0, 0.0, 0, 0
+            return 0, 0.0, 0.0, 0.0, 0, 0, [0.0] * self._n_devices
         busy = sum(r[1] for r in records)
         flops = sum(r[2] for r in records)
         real = sum(r[3] for r in records)
         slot = sum(r[4] for r in records)
+        per_dev = [0.0] * self._n_devices
+        for r in records:
+            if r[5] is not None:
+                for i, v in enumerate(r[5]):
+                    per_dev[i] += v
+            else:  # no shard detail: uniform attribution
+                share = r[3] / self._n_devices
+                for i in range(self._n_devices):
+                    per_dev[i] += share
         # Window span: oldest dispatch start to now, floored by busy time
         # (a single just-landed batch must not divide by ~0 wall).
         span = max(now - (records[0][0] - records[0][1]), busy, 1e-9)
-        return len(records), span, busy, flops, real, slot
+        return len(records), span, busy, flops, real, slot, per_dev
 
     def snapshot(self) -> Dict[str, Any]:
         """The telemetry-heartbeat / /costs ``efficiency`` map, refreshing
@@ -325,7 +384,7 @@ class EfficiencyMeter:
         freezing at the last busy window's value).  {} until the first
         batch ever lands, so never-fed workers don't report fantasy 0s —
         but a worker that went idle genuinely IS at MFU 0."""
-        n, span, busy, flops, real, slot = self._window_totals()
+        n, span, busy, flops, real, slot, per_dev = self._window_totals()
         with self._lock:
             ever = self._ever_recorded
         if n == 0:
@@ -341,7 +400,14 @@ class EfficiencyMeter:
                 "mfu_busy": None,
                 "peak_flops_per_s": self._resolve_peak()[0] or None,
                 "peak_source": self._resolve_peak()[1],
+                "n_devices": self._n_devices,
             }
+            if self._n_devices > 1:
+                # mfu mirrors the aggregate: 0.0 when idle-but-measured,
+                # None when peak is unknown (0.0 would read as a DEAD
+                # chip on a backend where MFU is simply unmeasurable).
+                idle["per_chip"] = self._per_chip(
+                    [0.0] * self._n_devices, 1.0, idle["mfu"])
             self._set_gauges(idle)
             return idle
         peak, source = self._resolve_peak()
@@ -361,9 +427,28 @@ class EfficiencyMeter:
             "mfu": round(achieved / peak, 6) if peak else None,
             "mfu_busy": round(flops / busy / peak, 6)
             if peak and busy > 0 else None,
+            "n_devices": self._n_devices,
         }
+        if self._n_devices > 1:
+            # Per-chip rows: goodput from each chip's REAL data shard;
+            # MFU is the aggregate number on every row (SPMD — one
+            # program, identical per-chip FLOPs, shared wall window).
+            out["per_chip"] = self._per_chip(per_dev, span,
+                                             out.get("mfu"))
         self._set_gauges(out)
         return out
+
+    def _per_chip(self, per_dev: List[float], span: float,
+                  mfu) -> List[Dict[str, Any]]:
+        rows = []
+        for i, label in enumerate(self.device_labels):
+            goodput = round(per_dev[i] / span, 1)
+            self.m_chip_goodput.labels(device=label).set(goodput)
+            rows.append({"device": label,
+                         "goodput_tokens_per_s": goodput,
+                         "real_tokens": int(per_dev[i]),
+                         "mfu": mfu})
+        return rows
 
     def _set_gauges(self, snap: Dict[str, Any]) -> None:
         self.m_mfu.set(snap.get("mfu") or 0.0)

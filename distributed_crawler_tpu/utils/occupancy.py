@@ -22,6 +22,17 @@ missing primitives:
   Gaps across stream boundaries (no queued work at all) are idle, not
   bubbles — the worker feed loops call ``start_stream()`` whenever
   their queue runs dry, so only gaps with work waiting score.
+  **Mesh semantics**: one recorded interval is one HOST dispatch — on a
+  data-parallel mesh that single dispatch covers ``n_devices`` chips
+  executing the same program in lockstep (SPMD), so busy/overlap/bubble
+  here describe the WHOLE mesh's shared envelope, not any chip alone (a
+  host-bound feed starves all N chips together, and one bubble
+  millisecond costs N chip-milliseconds).  Timelines carry their
+  ``n_devices`` in every snapshot (plus the chip-weighted
+  ``bubble_chip_ms_*`` twins) so the PR-9 occupancy meters stay
+  meaningful as chips are added; per-chip *goodput* differences live in
+  `utils/costmodel.EfficiencyMeter`'s ``per_chip`` rows, which see each
+  chip's real-vs-pad row split.
 - :class:`QueueDepthSampler` — a time-weighted queue-depth gauge.  The
   old edge-triggered ``m_queue_depth.set(qsize)`` only moved when a
   batch was enqueued/dequeued, so a scrape between edges aliased to
@@ -84,12 +95,21 @@ class DeviceTimeline:
 
     def __init__(self, registry: MetricsRegistry = REGISTRY,
                  window_s: float = 60.0, max_intervals: int = 2048,
-                 clock=time.perf_counter, path: str = "text"):
+                 clock=time.perf_counter, path: str = "text",
+                 n_devices: int = 1):
         """``path`` labels this timeline's gauge/counter children
         ("text" for the embed+classify engine, "asr" for Whisper — the
         compile-miss counter's convention), so shared-process rigs with
-        both pipelines never clobber one unlabeled series."""
+        both pipelines never clobber one unlabeled series.
+
+        ``n_devices`` is how many chips one recorded dispatch spans (the
+        engine's mesh size; 1 single-device).  It does not change the
+        fractions — SPMD chips share one envelope — but it labels every
+        snapshot and scales the chip-weighted bubble twins, so a reader
+        comparing occupancy across mesh sizes knows what one host
+        interval covered."""
         self.window_s = window_s
+        self.n_devices = max(1, int(n_devices))
         self._clock = clock
         self._lock = threading.Lock()
         self._intervals: "deque[Tuple[float, float]]" = \
@@ -204,6 +224,14 @@ class DeviceTimeline:
             "bubble_ms_per_batch": round(
                 bubble_total * 1000.0 / batches_total, 4),
             "batches_total": batches_total,
+            # Mesh labeling: one host interval = n_devices chips in
+            # lockstep; the chip-weighted twin prices a bubble in
+            # chip-milliseconds (1 ms of host gap idles N chips).
+            "n_devices": self.n_devices,
+            "bubble_chip_ms_window": round(
+                bubble_window * 1000.0 * self.n_devices, 3),
+            "bubble_chip_ms_total": round(
+                bubble_total * 1000.0 * self.n_devices, 3),
         }
         self.m_busy.set(out["busy_fraction"])
         self.m_overlap.set(out["overlap_fraction"])

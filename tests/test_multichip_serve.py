@@ -1,0 +1,376 @@
+"""Multi-chip data-parallel serving (the 1→8 scaling tentpole) on the
+virtual 8-device CPU mesh (tests/conftest.py).
+
+Covers the mesh-serving contract end to end: row padding to mesh
+multiples (non-divisible batch sizes / coalesced groups still dispatch
+one dp-sharded program, pad rows masked out of results and real-token
+meters), bit-level result parity single-device vs 8-device-sharded on
+both the packed and unpacked paths, the TPU worker serving over a mesh
+with per-chip efficiency rows, the mesh-aware peak-FLOPs/MFU regression
+(a mesh must not inflate ``tpu_engine_mfu``), and the
+`multichip-steady` loadgen scenario's parse + gate acceptance.
+Wired into tools/_smoke.py.
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_crawler_tpu.bus.codec import RecordBatch
+from distributed_crawler_tpu.bus.inmemory import InMemoryBus
+from distributed_crawler_tpu.bus.messages import (
+    TOPIC_INFERENCE_BATCHES,
+    TOPIC_INFERENCE_RESULTS,
+)
+from distributed_crawler_tpu.datamodel.post import Post
+from distributed_crawler_tpu.inference.engine import (
+    EngineConfig,
+    InferenceEngine,
+)
+from distributed_crawler_tpu.inference.worker import (
+    TPUWorker,
+    TPUWorkerConfig,
+    build_serving_mesh,
+    iter_results,
+)
+from distributed_crawler_tpu.state.providers import InMemoryStorageProvider
+from distributed_crawler_tpu.utils.costmodel import (
+    EfficiencyMeter,
+    default_peak_flops,
+    peak_flops,
+)
+from distributed_crawler_tpu.utils.metrics import MetricsRegistry
+from distributed_crawler_tpu.utils.occupancy import DeviceTimeline
+
+TOKS = [[1, 2, 3], [4, 5], [6] * 40, [7] * 10, [8], [9, 10, 11, 12, 13],
+        [3] * 25, [2] * 7, [5, 6, 7], [11] * 50]
+
+
+def _engine(mesh=None, params=None, batch_size=12):
+    return InferenceEngine(
+        EngineConfig(model="tiny", n_labels=4, batch_size=batch_size,
+                     buckets=(32, 64)),
+        mesh=mesh, params=params, registry=MetricsRegistry())
+
+
+class TestBuildServingMesh:
+    def test_defaults_mean_no_mesh(self):
+        assert build_serving_mesh() is None
+        assert build_serving_mesh(data=0, seq=1, tensor=1, devices=0) is None
+
+    def test_data_axis_alone_builds_dp_mesh(self):
+        mesh = build_serving_mesh(data=8)
+        assert dict(mesh.shape) == {"dp": 8, "sp": 1, "tp": 1}
+
+    def test_all_devices(self):
+        mesh = build_serving_mesh(devices=-1)
+        assert mesh.devices.size == len(jax.devices())
+
+    def test_devices_with_tensor_axis(self):
+        mesh = build_serving_mesh(devices=8, tensor=2)
+        assert dict(mesh.shape) == {"dp": 4, "sp": 1, "tp": 2}
+
+    def test_too_many_devices_raises(self):
+        with pytest.raises(ValueError, match="visible"):
+            build_serving_mesh(data=64)
+
+    def test_conflicting_axes_and_devices_raise(self):
+        with pytest.raises(ValueError, match="conflict"):
+            build_serving_mesh(data=2, devices=8)
+
+    def test_all_devices_with_conflicting_data_raises(self):
+        # devices=-1 resolves to 8 here; an explicit dp=2 must raise,
+        # not be silently overridden to dp=8.
+        with pytest.raises(ValueError, match="conflict"):
+            build_serving_mesh(data=2, devices=-1)
+
+    def test_negative_flags_raise_instead_of_downgrading(self):
+        # A typo'd flag must never silently serve a 1-device mesh.
+        with pytest.raises(ValueError, match="mesh-devices"):
+            build_serving_mesh(devices=-8)
+        with pytest.raises(ValueError, match="mesh-data"):
+            build_serving_mesh(data=-1)
+        with pytest.raises(ValueError, match="mesh-tensor"):
+            build_serving_mesh(data=2, tensor=0)
+
+    def test_loadtest_shares_the_count_resolver(self):
+        # tools/loadtest forces virtual devices through the SAME
+        # resolver mesh construction uses — the two cannot drift.
+        from distributed_crawler_tpu.parallel.mesh import (
+            serving_device_count,
+        )
+
+        assert serving_device_count() == 0
+        assert serving_device_count(data=8) == 8
+        assert serving_device_count(devices=-1) == -1
+        assert serving_device_count(devices=8, tensor=2) == 8
+        with pytest.raises(ValueError, match="conflict"):
+            serving_device_count(data=8, devices=4)
+
+
+class TestRowPadding:
+    """Non-divisible batch sizes / coalesced groups: the row dim pads to
+    a multiple of mesh.n_devices and pad rows stay invisible."""
+
+    def test_rows_round_up_to_mesh_multiple(self):
+        mesh = build_serving_mesh(data=8)
+        eng = _engine(mesh=mesh, batch_size=12)
+        assert eng._rows == 16
+        assert eng.n_devices == 8
+        # Single-device engines keep rows == batch_size (no behavior
+        # change on the historical path).
+        assert _engine(batch_size=12)._rows == 12
+
+    def test_non_divisible_group_dispatches_and_masks_padding(self):
+        mesh = build_serving_mesh(data=8)
+        eng = _engine(mesh=mesh, batch_size=8)
+        out = eng.run_tokenized(TOKS[:5])  # 5 seqs -> 8-row programs
+        assert len(out) == 5 and all(r is not None for r in out)
+        # Pad rows counted as wasted slots, never as real tokens: the 5
+        # seqs split buckets 32 (4 seqs) / 64 (one 40-token seq), each
+        # dispatching one 8-row dp-sharded program.
+        eff = eng.meter.snapshot()
+        assert eff["slot_tokens"] == 8 * 32 + 8 * 64
+        assert eff["real_tokens"] == sum(len(t) for t in TOKS[:5])
+
+    def test_batch_dim_sharded_over_dp(self):
+        mesh = build_serving_mesh(data=8)
+        eng = _engine(mesh=mesh, batch_size=8)
+        ids = np.zeros((8, 32), np.int32)
+        mask = np.ones((8, 32), bool)
+        placed = eng._place(ids, mask)
+        spec = placed[0].sharding.spec
+        assert spec and spec[0] == "dp"
+
+    def test_tp_mesh_pads_only_to_data_axis(self):
+        # sp/tp impose no row-divisibility constraint: a dp=1 tensor
+        # mesh must not dispatch all-pad filler rows every batch.
+        mesh = build_serving_mesh(devices=8, tensor=8)
+        assert dict(mesh.shape) == {"dp": 1, "sp": 1, "tp": 8}
+        eng = _engine(mesh=mesh, batch_size=30)
+        assert eng._rows == 30
+        assert eng.n_devices == 8 and eng._dp == 1
+
+    def test_loadtest_device_forcing_replaces_smaller_flag(self):
+        # tools/loadtest._ensure_devices: a pre-set smaller
+        # xla_force_host_platform_device_count is replaced (never
+        # trusted), a larger one kept, other flags preserved.
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        try:
+            import loadtest as lt
+        finally:
+            sys.path.pop(0)
+        prior = os.environ.get("XLA_FLAGS")
+        try:
+            os.environ["XLA_FLAGS"] = \
+                "--xla_foo --xla_force_host_platform_device_count=2"
+            lt._ensure_devices(8)
+            assert "--xla_force_host_platform_device_count=8" \
+                in os.environ["XLA_FLAGS"]
+            assert "--xla_foo" in os.environ["XLA_FLAGS"]
+            lt._ensure_devices(4)  # larger pre-set count is kept
+            assert "--xla_force_host_platform_device_count=8" \
+                in os.environ["XLA_FLAGS"]
+        finally:
+            if prior is None:
+                os.environ.pop("XLA_FLAGS", None)
+            else:
+                os.environ["XLA_FLAGS"] = prior
+
+    def test_per_device_real_token_split(self):
+        mesh = build_serving_mesh(data=8)
+        eng = _engine(mesh=mesh, batch_size=8)
+        mask = np.zeros((8, 32), bool)
+        mask[0, :10] = True   # shard 0
+        mask[7, :3] = True    # shard 7
+        per_dev = eng._per_device_real(mask)
+        assert per_dev == [10, 0, 0, 0, 0, 0, 0, 3]
+
+
+class TestMeshParity:
+    """Bit-level result parity: 8-device dp-sharded serving must return
+    exactly what single-device serving returns on the same corpus."""
+
+    @pytest.mark.parametrize("pack", [False, True])
+    def test_parity_single_vs_8_device(self, pack):
+        e1 = _engine()
+        e8 = _engine(mesh=build_serving_mesh(data=8), params=e1.params)
+        r1 = e1.run_tokenized(TOKS, pack=pack)
+        r8 = e8.run_tokenized(TOKS, pack=pack)
+        assert len(r1) == len(r8) == len(TOKS)
+        for a, b in zip(r1, r8):
+            assert a["label"] == b["label"]
+            assert a["embedding"] == b["embedding"]  # bit-level
+            assert a["scores"] == b["scores"]
+
+    def test_parity_through_text_front_door(self):
+        e1 = _engine()
+        e8 = _engine(mesh=build_serving_mesh(data=8), params=e1.params)
+        texts = [f"post number {i} with some words" * (1 + i % 3)
+                 for i in range(7)]
+        r1 = e1.run(texts, pack=True)
+        r8 = e8.run(texts, pack=True)
+        for a, b in zip(r1, r8):
+            assert a["embedding"] == b["embedding"]
+
+
+class TestMeshMFUAccounting:
+    """Satellite: peak FLOPs scale with mesh device count so MFU never
+    silently inflates (or deflates) the moment a mesh appears."""
+
+    def test_peak_flops_scales_on_tpu_and_cpu(self):
+        one, src1 = peak_flops("TPU v5e", "tpu", 1)
+        eight, src8 = peak_flops("TPU v5e", "tpu", 8)
+        assert eight == 8 * one and src1 == src8
+        cpu1, _ = peak_flops("", "cpu", 1)
+        cpu8, src = peak_flops("", "cpu", 8)
+        assert cpu8 == 8 * cpu1 and src == "cpu_estimate"
+
+    def test_default_peak_respects_engine_device_count(self):
+        # The engine's device count — not the host's visible total —
+        # sets the denominator: a 1-device engine on this 8-device host
+        # must not read 1/8 too low.
+        one, _ = default_peak_flops(1)
+        eight, _ = default_peak_flops(8)
+        assert one > 0 and eight == pytest.approx(8 * one)
+
+    def test_mesh_does_not_inflate_tpu_engine_mfu(self):
+        reg1, reg8 = MetricsRegistry(), MetricsRegistry()
+        m1 = EfficiencyMeter(registry=reg1, peak=1e9, peak_source="test",
+                             n_devices=1)
+        m8 = EfficiencyMeter(registry=reg8, peak=8e9, peak_source="test",
+                             n_devices=8)
+        # Same achieved work through both: the 8-chip meter must report
+        # 1/8 the MFU (8× the peak), never the same or more.
+        for m in (m1, m8):
+            m.record(0.5, 1e8, 800, 1000)
+        s1, s8 = m1.snapshot(), m8.snapshot()
+        # rel tolerance covers the snapshot's 6-decimal rounding and the
+        # sub-ms wall-window skew between the two record() calls.
+        assert s8["mfu"] == pytest.approx(s1["mfu"] / 8, rel=5e-3)
+        assert reg8.gauge("tpu_engine_mfu").value == s8["mfu"]
+
+    def test_engine_meter_uses_aggregate_mesh_peak(self):
+        e8 = _engine(mesh=build_serving_mesh(data=8))
+        e8.run_tokenized(TOKS[:3])
+        snap = e8.meter.snapshot()
+        assert snap["n_devices"] == 8
+        assert snap["peak_source"] == "cpu_estimate"
+        assert snap["peak_flops_per_s"] == peak_flops("", "cpu", 8)[0]
+
+    def test_per_chip_rows_uniform_attribution_without_masks(self):
+        meter = EfficiencyMeter(registry=MetricsRegistry(), peak=8e9,
+                                n_devices=8)
+        meter.record(0.1, 1e6, 800, 1000)  # no per-device split given
+        rows = meter.snapshot()["per_chip"]
+        assert len(rows) == 8
+        assert all(r["real_tokens"] == 100 for r in rows)
+
+    def test_per_chip_rows_use_shard_masks(self):
+        meter = EfficiencyMeter(registry=MetricsRegistry(), peak=8e9,
+                                n_devices=8,
+                                device_labels=[str(i) for i in range(8)])
+        meter.record(0.1, 1e6, 15, 1000,
+                     per_device_real_tokens=[8, 7, 0, 0, 0, 0, 0, 0])
+        rows = meter.snapshot()["per_chip"]
+        assert [r["real_tokens"] for r in rows] == [8, 7, 0, 0, 0, 0, 0, 0]
+        assert rows[2]["goodput_tokens_per_s"] == 0.0
+
+
+class TestOccupancyMeshLabels:
+    def test_timeline_snapshot_carries_mesh_size(self):
+        tl = DeviceTimeline(registry=MetricsRegistry(), path="t8",
+                            n_devices=8, clock=time.perf_counter)
+        t0 = time.perf_counter()
+        tl.record(t0, t0 + 0.010)
+        tl.record(t0 + 0.015, t0 + 0.020)  # 5 ms bubble
+        snap = tl.snapshot()
+        assert snap["n_devices"] == 8
+        assert snap["bubble_chip_ms_total"] == pytest.approx(
+            8 * snap["bubble_ms_total"])
+
+    def test_engine_timeline_inherits_mesh_size(self):
+        e8 = _engine(mesh=build_serving_mesh(data=8))
+        e8.run_tokenized(TOKS[:2])
+        assert e8.timeline.snapshot()["n_devices"] == 8
+
+
+class TestWorkerWithMesh:
+    """Worker-with-mesh e2e on fake CPU devices: the real TPUWorker
+    consuming RecordBatches through an 8-device dp engine."""
+
+    def test_e2e_serving_over_mesh(self):
+        mesh = build_serving_mesh(data=8)
+        eng = _engine(mesh=mesh, batch_size=8)
+        provider = InMemoryStorageProvider()
+        bus = InMemoryBus()
+        worker = TPUWorker(bus, eng, provider=provider,
+                           cfg=TPUWorkerConfig(worker_id="mesh-w1",
+                                               heartbeat_s=0.05,
+                                               coalesce_batches=4),
+                           registry=MetricsRegistry())
+        got = []
+        bus.subscribe(TOPIC_INFERENCE_RESULTS, got.append)
+        bus.start()
+        worker.start()
+        posts = [Post(post_uid=f"p{i}", channel_name="chan",
+                      description=f"mesh serving text {i} " * (1 + i % 4))
+                 for i in range(30)]
+        for start in range(0, 30, 5):
+            batch = RecordBatch.from_posts(posts[start:start + 5],
+                                           crawl_id="c-mesh")
+            bus.publish(TOPIC_INFERENCE_BATCHES, batch.to_dict())
+        assert worker.drain(timeout_s=30)
+        status = worker.get_status()
+        worker.stop()
+        bus.close()
+        # Every post written back exactly once, none lost to pad rows.
+        uids = [r["post_uid"] for r in iter_results(provider, "c-mesh")]
+        assert sorted(uids) == sorted(p.post_uid for p in posts)
+        assert len(got) == 6
+        # The worker's own surfaces carry the mesh.
+        assert status["n_devices"] == 8
+        assert status["mesh"] == {"dp": 8, "sp": 1, "tp": 1}
+        costs = worker.get_costs()
+        assert costs["n_devices"] == 8
+        assert len(costs["efficiency"]["per_chip"]) == 8
+        assert costs["occupancy"]["n_devices"] == 8
+
+
+class TestMultichipScenario:
+    """Scenario parse + gate acceptance for multichip-steady."""
+
+    def test_scenario_parses_and_declares_the_mesh(self):
+        from distributed_crawler_tpu import loadgen
+
+        sc = loadgen.load_scenario("multichip-steady")
+        assert sc["parallel"] == {"data": 8}
+        cfg = loadgen.LoadGenConfig(**sc["load"])
+        cfg.validate()
+        assert loadgen.SyntheticWorkload(cfg).plan()
+        loadgen.parse_timeline(sc.get("chaos", []))
+        gate = sc["gate"]
+        assert gate["require_per_chip_devices"] == 8
+        assert gate["min_per_chip_goodput_tokens_per_s"] > 0
+        assert gate["max_lost"] == 0 and gate["max_duplicates"] == 0
+
+    @pytest.mark.slow
+    def test_gate_passes_on_8_device_mesh(self):
+        from distributed_crawler_tpu import loadgen
+
+        scenario = loadgen.load_scenario("multichip-steady")
+        verdict = loadgen.run_scenario(
+            scenario, overrides={"load": {"duration_s": 2.0}})
+        assert verdict["status"] == "pass", verdict["checks"]
+        assert verdict["mesh"] == {"dp": 8, "sp": 1, "tp": 1}
+        assert len(verdict["per_chip"]) == 8
+        assert all(c["goodput_tokens_per_s"] > 0
+                   for c in verdict["per_chip"])
+        assert verdict["lost"] == 0 and verdict["duplicates"] == 0

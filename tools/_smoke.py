@@ -96,7 +96,11 @@ def selfcheck() -> int:
          os.path.join(repo, "tests", "test_distributed_trace.py"),
          # bus durability: spool replay, outbox, DLQ, broker restart,
          # and the kill-broker gate acceptance (ISSUE 10 closure).
-         os.path.join(repo, "tests", "test_bus_durability.py")],
+         os.path.join(repo, "tests", "test_bus_durability.py"),
+         # multi-chip serving: row padding, 1-vs-8-device parity,
+         # worker-with-mesh e2e, mesh-aware MFU, and the
+         # multichip-steady gate acceptance (the 1->8 scaling tentpole).
+         os.path.join(repo, "tests", "test_multichip_serve.py")],
         env=env, cwd=repo)
 
 

@@ -39,6 +39,57 @@ def _parse_mix(text: str) -> dict:
     return out
 
 
+def _mesh_devices_needed(scenario: dict) -> int:
+    """Device count a scenario's "parallel" block implies (0 = no mesh;
+    -1 = all visible devices, nothing to force).  Delegates to the ONE
+    resolver mesh construction itself uses
+    (`parallel.mesh.serving_device_count`), so the count forced here can
+    never drift from what `build_serving_mesh` demands; invalid blocks
+    raise, landing in the harness's error-JSON contract."""
+    par = scenario.get("parallel") or {}
+    if not par:
+        return 0
+    from distributed_crawler_tpu.parallel.mesh import serving_device_count
+
+    return serving_device_count(
+        data=int(par.get("data", 0)), seq=int(par.get("seq", 1)),
+        tensor=int(par.get("tensor", 1)),
+        devices=int(par.get("devices", 0)))
+
+
+def _ensure_devices(n: int) -> None:
+    """Best-effort: expose >= n virtual CPU devices BEFORE the backend
+    initializes, so mesh scenarios run out of the box (the
+    tests/conftest.py dance: the XLA flag for a fresh process, the
+    jax config knob — where this jax version has it — for a pre-imported
+    jax whose env snapshot froze).  A pre-set
+    xla_force_host_platform_device_count smaller than ``n`` is REPLACED
+    (the bench.py _cpu_env strip-and-replace), never trusted: leaving a
+    =2 flag in place would fail an 8-device scenario despite the
+    automatic-forcing promise.  A larger pre-set count is kept."""
+    prior = os.environ.get("XLA_FLAGS", "").split()
+    kept, have = [], 0
+    for f in prior:
+        if f.startswith("--xla_force_host_platform_device_count"):
+            try:
+                have = int(f.rpartition("=")[2])
+            except ValueError:
+                have = 0
+        else:
+            kept.append(f)
+    count = max(n, have)
+    os.environ["XLA_FLAGS"] = " ".join(
+        kept + [f"--xla_force_host_platform_device_count={count}"]).strip()
+    try:
+        import jax
+
+        jax.config.update("jax_num_cpu_devices", count)
+    except Exception:
+        pass  # backend already initialized, or a jax without the knob
+        # (0.4.x); the gate's own device-count check reports the
+        # actionable error if forcing genuinely couldn't take effect
+
+
 def _parse_gate(text: str) -> dict:
     """Gate-envelope overrides: inline JSON object or @path/to/file.json
     (the job.data convention)."""
@@ -166,6 +217,10 @@ def main(argv=None) -> int:
     try:
         scenario_name, overrides = _resolve(args)
         scenario = loadgen.load_scenario(scenario_name)
+        if not args.device:
+            needed = _mesh_devices_needed(scenario)
+            if needed > 1:
+                _ensure_devices(needed)
         if args.smoke:
             # Validate every checked-in scenario parses end to end —
             # load config, chaos timeline, a deterministic plan — without
