@@ -35,6 +35,7 @@ from ..datamodel import Post
 from ..datamodel.post import format_time, parse_time
 from ..state.datamodels import new_id, utcnow
 from .messages import (
+    MSG_ALERT,
     MSG_AUDIO_BATCH,
     MSG_CHAOS_FAULT,
     MSG_DISCOVERED_PAGES,
@@ -49,6 +50,7 @@ from .messages import (
     MSG_WORK_RESULT,
     MSG_WORKER_STARTED,
     MSG_WORKER_STOPPING,
+    AlertMessage,
     AudioBatchMessage,
     ChaosMessage,
     ControlMessage,
@@ -147,6 +149,7 @@ MESSAGE_REGISTRY: Dict[str, type] = {
     MSG_AUDIO_BATCH: AudioBatchMessage,
     MSG_TRANSCRIPT: TranscriptMessage,
     MSG_SPAN_BATCH: SpanBatchMessage,
+    MSG_ALERT: AlertMessage,
 }
 
 

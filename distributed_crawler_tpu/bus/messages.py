@@ -43,6 +43,9 @@ MSG_TRANSCRIPT = "transcript"
 # orchestrator's TraceCollector): a bounded batch of completed spans one
 # worker ships so cross-process traces can be assembled at /dtraces.
 MSG_SPAN_BATCH = "span_batch"
+# Watchtower alerting (`utils/alerts.py` via `orchestrator/watchtower.py`):
+# a rule's firing/resolved lifecycle transition, announced fleet-wide.
+MSG_ALERT = "alert"
 
 # --- status values (`messages.go:32-43`) -----------------------------------
 STATUS_SUCCESS = "success"
@@ -87,6 +90,12 @@ TOPIC_TRANSCRIPTS = "tpu-transcripts"
 # orchestrator's TraceCollector subscribes; a missed batch degrades one
 # trace's completeness, never correctness, so no pull/ack machinery.
 TOPIC_SPANS = "tpu-spans"
+# Alert announcements (`AlertMessage`): the watchtower publishes every
+# firing/resolved transition here so operators' tools (tools/watch.py, a
+# future autoscaler) can react without scraping /alerts.  Fan-out like
+# chaos/status — a missed announcement degrades promptness, never the
+# /alerts state, so no pull/ack machinery.
+TOPIC_ALERTS = "tpu-alerts"
 
 VALID_PLATFORMS = ("telegram", "youtube")
 
@@ -112,7 +121,8 @@ def pubsub_topics() -> List[str]:
     return [TOPIC_WORK_QUEUE, TOPIC_RESULTS, TOPIC_WORKER_STATUS,
             TOPIC_ORCHESTRATOR, TOPIC_INFERENCE_BATCHES,
             TOPIC_INFERENCE_RESULTS, TOPIC_JOBS, TOPIC_CHAOS,
-            TOPIC_MEDIA_BATCHES, TOPIC_TRANSCRIPTS, TOPIC_SPANS]
+            TOPIC_MEDIA_BATCHES, TOPIC_TRANSCRIPTS, TOPIC_SPANS,
+            TOPIC_ALERTS]
 
 
 def _opt_time(value: Any) -> Optional[str]:
@@ -816,6 +826,95 @@ class TranscriptMessage:
             windows=int(d.get("windows") or 0),
             duration_s=float(d.get("duration_s") or 0.0),
             error=d.get("error", "") or "",
+            timestamp=parse_time(d.get("timestamp")),
+            trace_id=d.get("trace_id", "") or "",
+        )
+
+
+# --- watchtower alerting (`utils/alerts.py`) --------------------------------
+
+ALERT_STATES = ("pending", "firing", "resolved", "inactive")
+
+
+@dataclass
+class AlertMessage:
+    """One alert lifecycle transition on ``TOPIC_ALERTS``.
+
+    Published by the orchestrator's watchtower for ``firing`` and
+    ``resolved`` transitions (pending/inactive churn stays local to
+    ``/alerts``).  ``value`` is the rule's evaluated value at transition
+    time (a burn rate, a slope, an aggregate) and ``detail`` carries the
+    kind-specific context (fast/slow burn, threshold, matched-series
+    count).  The envelope's ``trace_id`` exists for registry uniformity
+    (the crawlint BUS contract); alerts are telemetry about the fleet,
+    they do not participate in a work item's trace."""
+
+    message_type: str = MSG_ALERT
+    rule: str = ""
+    kind: str = ""                   # threshold | trend | burn_rate
+    series: str = ""
+    state: str = ""                  # the state ENTERED (ALERT_STATES)
+    prev_state: str = ""
+    severity: str = "page"
+    value: Optional[float] = None
+    detail: Dict[str, Any] = field(default_factory=dict)
+    at_wall: float = 0.0             # sender epoch of the transition
+    timestamp: Optional[datetime] = None
+    trace_id: str = ""
+
+    @classmethod
+    def new(cls, rule: str, kind: str, series: str, state: str,
+            prev_state: str = "", severity: str = "page",
+            value: Optional[float] = None,
+            detail: Optional[Dict[str, Any]] = None,
+            at_wall: float = 0.0) -> "AlertMessage":
+        import time as _time
+
+        return cls(rule=rule, kind=kind, series=series, state=state,
+                   prev_state=prev_state, severity=severity, value=value,
+                   detail=dict(detail or {}),
+                   at_wall=at_wall or _time.time(),
+                   timestamp=utcnow(), trace_id=new_trace_id())
+
+    def validate(self) -> None:
+        if self.message_type != MSG_ALERT:
+            raise ValueError(
+                f"invalid alert message type: {self.message_type}")
+        if not self.rule:
+            raise ValueError("alert message rule cannot be empty")
+        if self.state not in ALERT_STATES:
+            raise ValueError(f"invalid alert state: {self.state}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "message_type": self.message_type,
+            "rule": self.rule,
+            "kind": self.kind,
+            "series": self.series,
+            "state": self.state,
+            "prev_state": self.prev_state,
+            "severity": self.severity,
+            "value": self.value,
+            "detail": self.detail,
+            "at_wall": self.at_wall,
+            "timestamp": _opt_time(self.timestamp),
+            "trace_id": self.trace_id,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "AlertMessage":
+        value = d.get("value")
+        return cls(
+            message_type=d.get("message_type", MSG_ALERT),
+            rule=d.get("rule", "") or "",
+            kind=d.get("kind", "") or "",
+            series=d.get("series", "") or "",
+            state=d.get("state", "") or "",
+            prev_state=d.get("prev_state", "") or "",
+            severity=d.get("severity", "page") or "page",
+            value=float(value) if value is not None else None,
+            detail=dict(d.get("detail") or {}),
+            at_wall=float(d.get("at_wall") or 0.0),
             timestamp=parse_time(d.get("timestamp")),
             trace_id=d.get("trace_id", "") or "",
         )

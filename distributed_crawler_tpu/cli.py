@@ -201,6 +201,18 @@ def build_parser() -> argparse.ArgumentParser:
       help="fraction of TRACES whose spans are exported (stable per-"
            "trace hash, so every process ships the same subset and "
            "cross-process traces stay complete; default 1.0)")
+    a("--timeseries-window", type=float, default=None,
+      help="rolling time-series retention in seconds for the /timeseries "
+           "store (worker self-samples + the orchestrator's fleet folds; "
+           "default 900)")
+    a("--timeseries-max-samples", type=int, default=None,
+      help="samples kept per time series (O(1)-append ring; default 512)")
+    a("--alert-rules", default=None,
+      help="watchtower alert rules: inline JSON list or @path/to/"
+           "rules.json; each entry replaces the same-named rule of the "
+           "default pack (queue_wait_burn, batch_age_burn, "
+           "per_chip_goodput_collapse, dlq_growth, outbox_near_full, "
+           "stale_worker — docs/operations.md \"Watchtower\")")
     # Load harness (`python -m tools.loadtest`; loadgen/).  These keys
     # configure the synthetic workload + SLO gate; the crawl/worker modes
     # ignore them, but they resolve through the same precedence chain so
@@ -489,6 +501,9 @@ _KEY_MAP = {
     "span_export_interval": "observability.span_export_interval_s",
     "span_export_max_spans": "observability.span_export_max_spans",
     "span_sample_rate": "observability.span_sample_rate",
+    "timeseries_window": "observability.timeseries_window_s",
+    "timeseries_max_samples": "observability.timeseries_max_samples",
+    "alert_rules": "observability.alert_rules",
     "loadgen_scenario": "loadgen.scenario",
     "loadgen_seed": "loadgen.seed",
     "loadgen_duration_s": "loadgen.duration_s",
@@ -748,6 +763,14 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
     dump_dir = r.get_str("observability.dump_dir", "")
     if dump_dir:
         _flight.install(dump_dir)
+    # Rolling time-series store (utils/timeseries.py): retention knobs
+    # apply to every mode — worker self-samples and orchestrator fleet
+    # folds land in the same process-global store behind /timeseries.
+    from .utils import timeseries as _timeseries
+
+    _timeseries.configure(
+        max_samples=r.get_int("observability.timeseries_max_samples", 512),
+        window_s=r.get_float("observability.timeseries_window_s", 900.0))
     # The on-demand /profile capture endpoint (`utils/profiling.py`)
     # writes its trace bundles next to the postmortem bundles; without a
     # dump dir it answers 503 with a clear error instead of capturing
@@ -925,6 +948,34 @@ def _heartbeat_interval(r: "ConfigResolver") -> float:
             "the liveness signal; the orchestrator offlines workers "
             "silent past worker_timeout_s)", interval, clamped)
     return clamped
+
+
+def _alert_rules(r: "ConfigResolver"):
+    """The watchtower rule list from ``observability.alert_rules`` — a
+    YAML list in the config file, or inline JSON / ``@path`` from the
+    ``--alert-rules`` flag.  Configured rules replace their same-named
+    defaults; a malformed rule is a config error (exit 2), not a
+    silently-defaulted watchtower."""
+    import json as _json
+
+    from .utils.alerts import rules_from_config
+
+    raw = r.get("observability.alert_rules")
+    if isinstance(raw, str) and raw:
+        if raw.startswith("@"):
+            try:
+                with open(raw[1:], "r", encoding="utf-8") as f:
+                    raw = f.read()
+            except OSError as e:
+                raise CliConfigError(f"cannot read --alert-rules file: {e}")
+        try:
+            raw = _json.loads(raw)
+        except ValueError as e:
+            raise CliConfigError(f"--alert-rules is not valid JSON: {e}")
+    try:
+        return rules_from_config(raw or None)
+    except ValueError as e:
+        raise CliConfigError(f"bad alert rule: {e}")
 
 
 class CliConfigError(ValueError):
@@ -1213,8 +1264,10 @@ def _run_orchestrator(urls: List[str], cfg: CrawlerConfig,
             else os.path.join(cfg.storage_root or "/tmp/crawl", crawl,
                               "orch-journal"))
     orch = Orchestrator(cfg.crawl_id, cfg, bus, sm, ocfg=ocfg,
-                        journal=CrawlJournal(journal_dir))
+                        journal=CrawlJournal(journal_dir),
+                        alert_rules=_alert_rules(r))
     from .utils.metrics import (
+        set_alerts_provider,
         set_cluster_provider,
         set_dtraces_provider,
         set_status_provider,
@@ -1222,6 +1275,7 @@ def _run_orchestrator(urls: List[str], cfg: CrawlerConfig,
     set_status_provider(orch.get_status)  # /status (`orchestrator.go:596`)
     set_cluster_provider(orch.get_cluster)  # /cluster fleet view
     set_dtraces_provider(orch.get_dtraces)  # /dtraces distributed traces
+    set_alerts_provider(orch.get_alerts)  # /alerts watchtower surface
     orch.start(urls, fresh=r.get_bool("orchestrator.fresh", False))
     try:
         _serve_forever(

@@ -47,6 +47,7 @@ from ..utils.metrics import (
 )
 from ..utils.slo import SLOWatchdog, standard_slos
 from ..utils.telemetry import TelemetryEmitter
+from ..utils.timeseries import RegistrySampler
 from .engine import InferenceEngine
 
 logger = logging.getLogger(__name__)
@@ -258,6 +259,11 @@ class TPUWorker:
                           queue_wait_ms=cfg.slo_queue_wait_ms,
                           batch_age_ms=cfg.slo_batch_age_ms),
             registry=registry)
+        # Watchtower self-sampling (utils/timeseries.py): every metric
+        # in THIS worker's registry becomes a rolling series once per
+        # heartbeat, so the worker's own /timeseries carries history
+        # that survives orchestrator restarts.
+        self._ts_sampler = RegistrySampler(registry)
         # Span export cursor: starts at NOW so a fresh worker never
         # re-ships whatever history the process-wide ring carries; the
         # name filter ships only THIS worker's stages (shared-process
@@ -818,6 +824,14 @@ class TPUWorker:
                 "depth": self._queue.qsize(),
                 "depth_time_weighted": round(self._depth.sample(), 4),
             }
+            # Cumulative per-SLO breach counts ride every beat so the
+            # orchestrator's watchtower can evaluate burn-rate rules
+            # fleet-wide (the fleet_slo_breach_total series).
+            msg.resource_usage["slo_breaches"] = \
+                self._slo.snapshot()["breaches"]
+            # Self-sample the registry into the rolling store on the
+            # same cadence (never raises).
+            self._ts_sampler.sample()
             try:
                 self.bus.publish(TOPIC_WORKER_STATUS, msg.to_dict())
             except Exception as e:  # bus outage must not kill the worker

@@ -26,6 +26,7 @@ in docs/operations.md "Load testing & chaos".
 """
 
 from .chaos import ChaosBus, ChaosController, Fault, parse_timeline
+from .exposition import metric_samples, moving_samples, parse_exposition
 from .generator import (
     AudioLoadConfig,
     AudioWorkload,
@@ -50,6 +51,9 @@ __all__ = [
     "workload_from_bundle",
     "Fault",
     "parse_timeline",
+    "parse_exposition",
+    "metric_samples",
+    "moving_samples",
     "ChaosController",
     "ChaosBus",
     "load_scenario",

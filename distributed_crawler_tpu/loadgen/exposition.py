@@ -1,0 +1,18 @@
+"""Re-export of the shared Prometheus exposition parser.
+
+The implementation lives in `utils/exposition.py` (stdlib-only, so the
+watchtower self-sampler can import it from worker heartbeat threads
+without executing this package's __init__, which drags in the whole
+gate).  The loadgen surface keeps this name because the gate and the
+tools/ renderers are the parser's scraping-side consumers.
+"""
+
+from ..utils.exposition import (  # noqa: F401
+    Sample,
+    metric_samples,
+    moving_samples,
+    parse_exposition,
+)
+
+__all__ = ["Sample", "parse_exposition", "metric_samples",
+           "moving_samples"]

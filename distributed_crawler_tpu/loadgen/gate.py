@@ -39,12 +39,14 @@ import urllib.request
 from typing import Any, Dict, List, Optional
 
 from ..bus.messages import (
+    TOPIC_ALERTS,
     TOPIC_CHAOS,
     TOPIC_INFERENCE_BATCHES,
     TOPIC_INFERENCE_RESULTS,
     TOPIC_MEDIA_BATCHES,
 )
-from ..utils import flight, trace
+from ..utils import flight, timeseries, trace
+from ..utils.alerts import rules_from_config
 from ..utils.slo import (
     ASR_BATCH_SPANS,
     BATCH_AGE_SPANS,
@@ -356,9 +358,16 @@ class OrchestratorHandle:
 
     def tick(self) -> None:
         """One distribution pass on the live generation (no-op while the
-        orchestrator is dead — the load keeps flowing without it)."""
+        orchestrator is dead — the load keeps flowing without it).  The
+        watchtower ticks EVEN on non-driving gates (no crawl leg means
+        distribute_work never runs, but alert evaluation must still ride
+        the gate loop — a fast burn window evaluated only at phase
+        boundaries would slide past its own breach)."""
         o = self.orch
-        if o is None or not self.drive or not o.is_running:
+        if o is None:
+            return
+        self.watchtower_tick()
+        if not self.drive or not o.is_running:
             return
         try:
             o.distribute_work()
@@ -383,6 +392,27 @@ class OrchestratorHandle:
         if o is None:
             return {"traces": [], "workers": {}, "orchestrator_down": True}
         return o.get_dtraces(limit=limit)
+
+    def get_alerts(self):
+        """The live generation's /alerts body (a dead orchestrator's
+        watchtower is as gone as its process would be)."""
+        o = self.orch
+        if o is None:
+            return {"alerts": [], "firing": [], "log": [],
+                    "orchestrator_down": True}
+        return o.get_alerts()
+
+    def watchtower_tick(self, force: bool = False):
+        """One watchtower pass on the live generation (no-op while
+        dead)."""
+        o = self.orch
+        if o is None:
+            return []
+        try:
+            return o.watchtower.tick(force=force)
+        except Exception as e:
+            logger.warning("watchtower tick error: %s", e)
+            return []
 
     def all_pages(self) -> list:
         """Every page across every depth of the live generation's state
@@ -595,10 +625,12 @@ def run_scenario(scenario: Dict[str, Any],
     from ..state.providers import InMemoryStorageProvider
     from ..utils.metrics import (
         MetricsRegistry,
+        clear_alerts_provider,
         clear_cluster_provider,
         clear_dlq_provider,
         clear_dtraces_provider,
         serve_metrics,
+        set_alerts_provider,
         set_cluster_provider,
         set_dlq_provider,
         set_dtraces_provider,
@@ -640,6 +672,11 @@ def run_scenario(scenario: Dict[str, Any],
     # entirely within this run and every surviving event is ours.
     run_mark = f"run-{time.monotonic_ns()}"
     flight.record("loadgen_run_start", mark=run_mark)
+    # The rolling time-series store is process-global (workers
+    # self-sample into it, the watchtower folds into it): a previous
+    # run's series inside the burn/trend windows would pre-fire this
+    # run's alerts, so the gate owns the store like it owns the rings.
+    timeseries.STORE.reset()
     registry = MetricsRegistry()
 
     t_run0 = time.monotonic()
@@ -674,6 +711,7 @@ def run_scenario(scenario: Dict[str, Any],
     controller = None
     cluster_provider = None
     dtraces_provider = None
+    alerts_provider = None
     dlq_provider = None
     local_outbox = None
     # Bus durability (docs/operations.md "Bus durability & dead letters"):
@@ -775,6 +813,12 @@ def run_scenario(scenario: Dict[str, Any],
             crawl_runner.init_connection_pool(ConnectionPool.for_testing(
                 {"conn0": SimTelegramClient(net, conn_id="conn0")}))
             pool_installed = True
+        # Watchtower rules: a scenario "alerts" block (a list of rule
+        # dicts) REPLACES same-named defaults and keeps the rest of the
+        # pack — chaos scenarios shrink the burn windows to their own
+        # timescale.  The evaluation limiter drops to gate cadence.
+        alert_rules = rules_from_config(scenario.get("alerts"))
+
         def _make_orch():
             # Fresh Orchestrator + fresh state-manager instance over the
             # SAME storage root and journal dir: a restart resumes from
@@ -783,8 +827,11 @@ def run_scenario(scenario: Dict[str, Any],
                 crawler_cfg.crawl_id, crawler_cfg, local_bus, _sm("orch"),
                 ocfg=OrchestratorConfig(
                     worker_timeout_s=float(scenario.get("worker_timeout_s",
-                                                        10.0))),
-                journal=CrawlJournal(os.path.join(tmpdir, "orch-journal")))
+                                                        10.0)),
+                    alert_eval_interval_s=float(
+                        scenario.get("alert_eval_interval_s", 0.05))),
+                journal=CrawlJournal(os.path.join(tmpdir, "orch-journal")),
+                registry=registry, alert_rules=alert_rules)
 
         orch_handle = OrchestratorHandle(_make_orch, seeds,
                                          drive=bool(crawl_leg))
@@ -793,6 +840,14 @@ def run_scenario(scenario: Dict[str, Any],
         set_cluster_provider(cluster_provider)
         dtraces_provider = orch_handle.get_dtraces
         set_dtraces_provider(dtraces_provider)
+        alerts_provider = orch_handle.get_alerts
+        set_alerts_provider(alerts_provider)
+        # Alert announcements are fan-out on TOPIC_ALERTS; collect them
+        # so the envelope can assert the publish path works (and so the
+        # topic is routed — the unrouted counter stays zero).
+        alert_msgs: List[Dict[str, Any]] = []
+        local_bus.subscribe(TOPIC_ALERTS,
+                            lambda payload: alert_msgs.append(payload))
 
         if crawl_leg:
             from ..inference.bridge import InferenceBridge
@@ -830,6 +885,11 @@ def run_scenario(scenario: Dict[str, Any],
         # --- phase A: baseline (flush the SLO window) ----------------------
         handle.worker.evaluate_slos()
         breaches_0 = _breach_counts(registry)
+        # Per-rule fired-count baseline: require_alert judges the DELTA
+        # over the load+chaos phase, so an alert carried over from
+        # another source can never pass the chaos assertion vacuously.
+        alerts_0 = {a.get("rule"): a.get("fired_count", 0)
+                    for a in orch_handle.get_alerts().get("alerts", [])}
 
         # --- phase B: load + chaos ----------------------------------------
         logger.info("loadgen %s: load phase starting (%s arrivals)",
@@ -897,6 +957,26 @@ def run_scenario(scenario: Dict[str, Any],
         handle.worker.evaluate_slos()
         orch_handle.check_worker_health()
         breaches_fault = _delta(_breach_counts(registry), breaches_0)
+        # Close the fault window on the ALERT surface deterministically:
+        # breach counts reach the watchtower on worker heartbeats, so
+        # settle (bounded) until every require_alert rule has fired
+        # rather than racing the last beat.
+        require_alert = list(gate_cfg.get("require_alert", []))
+        if require_alert:
+            settle = time.monotonic() + min(5.0, drain_timeout_s)
+            while time.monotonic() < settle:
+                orch_handle.watchtower_tick(force=True)
+                fired_now = {
+                    a["rule"]
+                    for a in orch_handle.get_alerts().get("alerts", [])
+                    if a.get("fired_count", 0)
+                    > alerts_0.get(a.get("rule"), 0)}
+                if all(r in fired_now for r in require_alert):
+                    break
+                time.sleep(0.05)
+        else:
+            orch_handle.watchtower_tick(force=True)
+        alerts_fault = orch_handle.get_alerts()
         t_b1 = time.monotonic()
 
         # --- phase C: recovery tail ---------------------------------------
@@ -922,6 +1002,20 @@ def run_scenario(scenario: Dict[str, Any],
         tail_drained = handle.worker.drain(timeout_s=drain_timeout_s)
         handle.worker.evaluate_slos()
         breaches_tail = _delta(_breach_counts(registry), breaches_mid)
+        # Alert recovery: chaos-fired alerts must RESOLVE once the fault
+        # is gone — tick (bounded by max_firing_after_recovery_s) until
+        # nothing is firing.  Burn-rate rules resolve when their slow
+        # window slides past the last breach sample, so the budget is
+        # part of the scenario's envelope, not a fudge factor.
+        resolve_budget_s = float(
+            gate_cfg.get("max_firing_after_recovery_s", 0.0))
+        t_resolve0 = time.monotonic()
+        orch_handle.watchtower_tick(force=True)
+        while orch_handle.get_alerts().get("firing") and \
+                time.monotonic() - t_resolve0 < resolve_budget_s:
+            time.sleep(0.05)
+            orch_handle.watchtower_tick(force=True)
+        resolve_wait_s = time.monotonic() - t_resolve0
         t_end = time.monotonic()
 
         # --- measurement ---------------------------------------------------
@@ -941,6 +1035,8 @@ def run_scenario(scenario: Dict[str, Any],
             "costs": _scrape(port, "/costs", as_json=True),
             "cluster": _scrape(port, "/cluster", as_json=True),
             "dtraces": _scrape(port, "/dtraces", as_json=True),
+            "alerts": _scrape(port, "/alerts", as_json=True),
+            "timeseries": _scrape(port, "/timeseries", as_json=True),
         }
         if durable:
             endpoints["dlq"] = _scrape(port, "/dlq", as_json=True)
@@ -1035,6 +1131,40 @@ def run_scenario(scenario: Dict[str, Any],
         per_chip = _per_chip_checks(check, gate_cfg, endpoints["costs"])
         dtrace_summary = _dtrace_checks(check, gate_cfg,
                                         endpoints["dtraces"])
+        # Alert envelope: require_alert rules must have fired DURING the
+        # fault window (the post-drain snapshot) and be resolved by
+        # verdict time; forbid_alert rules must never have fired; with a
+        # recovery budget declared, nothing may still be firing.
+        alerts_body = endpoints["alerts"] or orch_handle.get_alerts()
+        by_rule = {a.get("rule"): a
+                   for a in alerts_body.get("alerts", [])}
+        fired_fault = {
+            a.get("rule"):
+                a.get("fired_count", 0) - alerts_0.get(a.get("rule"), 0)
+            for a in alerts_fault.get("alerts", [])}
+        for rule_name in require_alert:
+            final = by_rule.get(rule_name, {})
+            check(f"alert_{rule_name}",
+                  fired_fault.get(rule_name, 0) > 0
+                  and final.get("state") == "resolved",
+                  {"fired_in_fault_window": fired_fault.get(rule_name, 0),
+                   "state_at_verdict": final.get("state")},
+                  "fired during the fault window AND resolved by verdict")
+        for rule_name in gate_cfg.get("forbid_alert", []):
+            fired = by_rule.get(rule_name, {}).get("fired_count", 0)
+            check(f"alert_quiet_{rule_name}", fired == 0, fired,
+                  "never fired")
+        if gate_cfg.get("max_firing_after_recovery_s") is not None:
+            still = alerts_body.get("firing", [])
+            check("alerts_resolved", not still,
+                  {"firing": still,
+                   "resolve_wait_s": round(resolve_wait_s, 2)},
+                  f"zero firing within {resolve_budget_s}s of recovery")
+        if gate_cfg.get("min_timeseries_series") is not None:
+            need = int(gate_cfg["min_timeseries_series"])
+            have = (endpoints["timeseries"] or {}).get("series_count", 0)
+            check("timeseries_series", have >= need, have,
+                  f">= {need} live series at /timeseries")
         # Unrouted-message accounting (the silent-drop fix): every topic
         # this run publishes on is registered before load starts, so the
         # counter must stay at zero — a nonzero value means a frame hit a
@@ -1068,7 +1198,8 @@ def run_scenario(scenario: Dict[str, Any],
             kinds = {e.get("kind") for e in events[start:]}
             for kind in gate_cfg["require_flight"]:
                 check(f"flight_{kind}", kind in kinds, kind in kinds, True)
-        endpoint_keys = ["metrics", "costs", "cluster", "dtraces"]
+        endpoint_keys = ["metrics", "costs", "cluster", "dtraces",
+                         "alerts", "timeseries"]
         if durable:
             endpoint_keys.append("dlq")
         for key in endpoint_keys:
@@ -1106,6 +1237,16 @@ def run_scenario(scenario: Dict[str, Any],
             "orchestrator": orch_detail,
             "cluster_workers": sorted(
                 (endpoints["cluster"] or {}).get("workers", {})),
+            "alerts": {
+                "fired": {a.get("rule"): a.get("fired_count")
+                          for a in alerts_body.get("alerts", [])
+                          if a.get("fired_count")},
+                "firing_at_verdict": alerts_body.get("firing", []),
+                "resolve_wait_s": round(resolve_wait_s, 2),
+                "messages": len(alert_msgs),
+                "timeseries_series": (endpoints["timeseries"] or {})
+                .get("series_count", 0),
+            },
             "occupancy": occupancy,
             "mesh": {str(k): int(v) for k, v in mesh.shape.items()}
             if mesh is not None else None,
@@ -1131,6 +1272,9 @@ def run_scenario(scenario: Dict[str, Any],
         if dtraces_provider is not None:
             _teardown("dtraces-provider",
                       lambda: clear_dtraces_provider(dtraces_provider))
+        if alerts_provider is not None:
+            _teardown("alerts-provider",
+                      lambda: clear_alerts_provider(alerts_provider))
         if dlq_provider is not None:
             _teardown("dlq-provider",
                       lambda: clear_dlq_provider(dlq_provider))
@@ -1285,6 +1429,9 @@ def run_asr_scenario(scenario: Dict[str, Any],
     flight.configure(capacity=int(scenario.get("flight_buffer", 4096)))
     run_mark = f"run-{time.monotonic_ns()}"
     flight.record("loadgen_run_start", mark=run_mark)
+    # The gate owns the process-global rolling store for the run, like
+    # the rings (the ASR workers self-sample into it too).
+    timeseries.STORE.reset()
     registry = MetricsRegistry()
 
     t_run0 = time.monotonic()

@@ -63,6 +63,7 @@ from ..utils.metrics import (
 )
 from ..utils.slo import SLOWatchdog, standard_slos
 from ..utils.telemetry import TelemetryEmitter
+from ..utils.timeseries import RegistrySampler
 
 logger = logging.getLogger(__name__)
 
@@ -165,6 +166,10 @@ class ASRWorker:
                           batch_age_ms=cfg.slo_batch_age_ms,
                           asr_batch_p95_ms=cfg.slo_asr_batch_p95_ms),
             registry=registry)
+        # Watchtower self-sampling (utils/timeseries.py): this worker's
+        # registry becomes rolling series once per heartbeat, so its
+        # /timeseries history survives orchestrator restarts.
+        self._ts_sampler = RegistrySampler(registry)
         # Ownership-filtered like the TPU worker's: in the ASR + reentry
         # shared-process topology the text worker ships engine.* spans,
         # this worker ships the ASR stages PLUS media.reentry — the
@@ -584,6 +589,10 @@ class ASRWorker:
                 "depth": self._queue.qsize(),
                 "depth_time_weighted": round(self._depth.sample(), 4),
             }
+            # Burn-rate feed + self-sample, the TPU worker's mirror.
+            msg.resource_usage["slo_breaches"] = \
+                self._slo.snapshot()["breaches"]
+            self._ts_sampler.sample()
             try:
                 self.bus.publish(TOPIC_WORKER_STATUS, msg.to_dict())
             except Exception as e:  # bus outage must not kill the worker

@@ -108,6 +108,12 @@ class FleetView:
         self.m_stale = registry.gauge(
             "fleet_stale_workers",
             "workers whose last heartbeat is older than the timeout")
+        # Staleness is a function of NOW, not of the last health tick: a
+        # stored gauge refreshed every health_interval_s would let a
+        # /metrics (or /cluster) scrape between ticks report a worker
+        # healthy after its heartbeat deadline had already lapsed.  The
+        # fn-bound gauge recomputes at every read.
+        self.m_stale.set_fn(self.stale_count)
 
     # -- folding -------------------------------------------------------------
     def observe(self, msg: StatusMessage,
@@ -209,12 +215,28 @@ class FleetView:
                     for wid, t in self._workers.items()
                     if t.offset_samples}
 
+    def _is_stale(self, t: WorkerTrack, now: datetime) -> bool:
+        """The ONE staleness rule (mirrors check_worker_health): silent
+        beyond ``stale_after_s`` and not cleanly offline."""
+        return (t.status != WORKER_OFFLINE and t.last_seen is not None
+                and (now - t.last_seen).total_seconds()
+                > self.stale_after_s)
+
+    def stale_count(self, now: Optional[datetime] = None) -> int:
+        """Stale workers computed against ``now`` AT CALL TIME — the
+        fn-bound ``fleet_stale_workers`` read, so every scrape (plain
+        /metrics included) judges staleness live instead of replaying
+        the last health tick's verdict."""
+        now = now or utcnow()
+        with self._mu:
+            return sum(1 for t in self._workers.values()
+                       if self._is_stale(t, now))
+
     def refresh_staleness(self, now: Optional[datetime] = None) -> int:
-        """Recompute the ``fleet_stale_workers`` gauge and evict long-gone
-        workers; returns the stale count.  Driven by the orchestrator's
-        health tick: a dead worker stops heartbeating, so neither
-        observe() nor (absent a /cluster consumer) export() would ever
-        move the gauge on a plain /metrics scrape.
+        """Evict long-gone workers and return the live stale count.
+        Driven by the orchestrator's health tick; the gauge itself no
+        longer depends on this tick (``stale_count`` recomputes at every
+        read), so the tick's remaining job is the bounded-memory sweep.
 
         Eviction keeps the fleet view bounded for long-lived
         orchestrators whose workers restart under fresh ids (pod-name
@@ -232,7 +254,7 @@ class FleetView:
                 if age > 10 * self.stale_after_s:
                     del self._workers[wid]
                     evicted.append(wid)
-                elif t.status != WORKER_OFFLINE and age > self.stale_after_s:
+                elif self._is_stale(t, now):
                     stale += 1
         for wid in evicted:
             for gauge in (self.m_queue, self.m_rss, self.m_mfu,
@@ -240,7 +262,6 @@ class FleetView:
                 gauge.remove_labels(worker_id=wid)
             for kind in ("in_use", "limit", "peak"):
                 self.m_devmem.remove_labels(worker_id=wid, kind=kind)
-        self.m_stale.set(float(stale))
         return stale
 
     # -- export --------------------------------------------------------------
@@ -262,8 +283,7 @@ class FleetView:
             for t in tracks:
                 age = (now - t.last_seen).total_seconds() \
                     if t.last_seen is not None else None
-                is_stale = (t.status != WORKER_OFFLINE and age is not None
-                            and age > self.stale_after_s)
+                is_stale = self._is_stale(t, now)
                 if is_stale:
                     stale.append(t.worker_id)
                 counts[t.worker_type] = counts.get(t.worker_type, 0) + 1
@@ -291,7 +311,6 @@ class FleetView:
                     "telemetry": t.telemetry,
                     "history": list(t.history),
                 }
-        self.m_stale.set(float(len(stale)))
         return {
             "workers": workers,
             "fleet": {

@@ -28,10 +28,12 @@ from ..bus.messages import (
     PRIORITY_HIGH,
     PRIORITY_MEDIUM,
     STATUS_SUCCESS,
+    TOPIC_ALERTS,
     TOPIC_RESULTS,
     TOPIC_SPANS,
     TOPIC_WORK_QUEUE,
     TOPIC_WORKER_STATUS,
+    AlertMessage,
     WORKER_ACTIVE,
     WORKER_BUSY,
     WORKER_IDLE,
@@ -46,6 +48,7 @@ from ..bus.messages import (
 )
 from .fleet import FleetView
 from .tracecollect import TraceCollector
+from .watchtower import Watchtower
 from .journal import CrawlJournal, RecoveredCrawl
 from ..config.crawler import CrawlerConfig
 from ..utils import flight, resilience, trace
@@ -105,6 +108,11 @@ class OrchestratorConfig:
     state_breaker_threshold: int = 5
     state_breaker_recovery_s: float = 15.0
     publish_retry_attempts: int = 3
+    # Watchtower (orchestrator/watchtower.py): how often the alert
+    # engine evaluates its rules over the rolling time-series store.
+    # Both the distribute and health ticks call it; this limiter sets
+    # the effective cadence.
+    alert_eval_interval_s: float = 5.0
 
 
 @dataclass
@@ -129,7 +137,10 @@ class Orchestrator:
     def __init__(self, crawl_id: str, config: CrawlerConfig, bus, sm,
                  ocfg: Optional[OrchestratorConfig] = None,
                  clock=time.monotonic,
-                 journal: Optional[CrawlJournal] = None):
+                 journal: Optional[CrawlJournal] = None,
+                 registry=None,
+                 alert_rules=None):
+        from ..utils.metrics import REGISTRY
         self.crawl_id = crawl_id
         self.config = config
         self.bus = bus
@@ -137,6 +148,7 @@ class Orchestrator:
         self.ocfg = ocfg or OrchestratorConfig()
         self.clock = clock
         self.journal = journal
+        registry = registry if registry is not None else REGISTRY
 
         self.workers: Dict[str, WorkerInfo] = {}
         self.active_work: Dict[str, WorkItem] = {}
@@ -167,7 +179,16 @@ class Orchestrator:
         self._outbox_backpressure = False
         # Telemetry-rich per-worker fold behind /cluster; its staleness
         # rule tracks the same timeout check_worker_health enforces.
-        self.fleet = FleetView(stale_after_s=self.ocfg.worker_timeout_s)
+        self.fleet = FleetView(stale_after_s=self.ocfg.worker_timeout_s,
+                               registry=registry)
+        # The watchtower (orchestrator/watchtower.py): rolling history
+        # for every heartbeat series + the declarative alert engine,
+        # evaluated on the orchestrator tick and served at /alerts.
+        # Wall clock (not self.clock, which is monotonic by default):
+        # the time-series store keys samples by epoch.
+        self.watchtower = Watchtower(
+            self.fleet, rules=alert_rules, registry=registry,
+            bus=bus, eval_interval_s=self.ocfg.alert_eval_interval_s)
         # Distributed-trace assembly behind /dtraces: workers ship
         # completed spans on TOPIC_SPANS; the collector corrects each
         # worker's span walls by the clock offset the fleet estimates
@@ -231,6 +252,10 @@ class Orchestrator:
         self.bus.subscribe(TOPIC_RESULTS, self.handle_result_payload)
         self.bus.subscribe(TOPIC_WORKER_STATUS, self.handle_status_payload)
         self.bus.subscribe(TOPIC_SPANS, self.handle_spans_payload)
+        # Route the watchtower's own announcements: the coordinator logs
+        # them, and a durable broker never holds alert frames as
+        # unrouted dead letters just because no external tool listens.
+        self.bus.subscribe(TOPIC_ALERTS, self.handle_alert_payload)
         if self.resumed:
             self._resume_requeue(pending)
         if background:
@@ -585,7 +610,8 @@ class Orchestrator:
 
     def _health_tick(self) -> None:
         self.check_worker_health()
-        self.fleet.refresh_staleness()  # keep the gauge live for /metrics
+        self.fleet.refresh_staleness()  # bounded-memory eviction sweep
+        self.watchtower.tick()
         self.requeue_stale_work()
         self._flush_deferred()
         self._compact_journal()
@@ -690,6 +716,11 @@ class Orchestrator:
         if self._killed:
             return 0
         self._flush_deferred()
+        # Alert evaluation rides the distribute cadence too (the
+        # watchtower rate-limits itself to alert_eval_interval_s), so
+        # foreground-driven orchestrators — the loadgen gate ticks
+        # distribute_work directly, background=False — still alert.
+        self.watchtower.tick()
         throttled = self._backpressure_engaged()
         if self.config.max_depth > 0 and \
                 self.current_depth > self.config.max_depth:
@@ -949,6 +980,27 @@ class Orchestrator:
         registered via `utils.metrics.set_dtraces_provider` by the CLI."""
         return self.trace_collector.export(limit=limit)
 
+    # -- watchtower (`watchtower.py`) --------------------------------------
+    def handle_alert_payload(self, payload: Dict[str, Any]) -> None:
+        """Log fleet alert announcements at the coordinator (firing at
+        WARNING, the rest at INFO); never raises into the bus."""
+        if self._killed:
+            return
+        try:
+            msg = AlertMessage.from_dict(payload)
+        except Exception as e:
+            logger.debug("undecodable alert announcement: %s", e)
+            return
+        logger.log(
+            logging.WARNING if msg.state == "firing" else logging.INFO,
+            "fleet alert %s: %s -> %s (value=%s)",
+            msg.rule, msg.prev_state, msg.state, msg.value)
+
+    def get_alerts(self) -> Dict[str, Any]:
+        """The ``/alerts`` JSON body (alert lifecycle state + log);
+        registered via `utils.metrics.set_alerts_provider` by the CLI."""
+        return self.watchtower.get_alerts()
+
     # -- worker registry (`orchestrator.go:419-449`) -----------------------
     def handle_status_payload(self, payload: Dict[str, Any]) -> None:
         self.handle_status(StatusMessage.from_dict(payload))
@@ -956,7 +1008,14 @@ class Orchestrator:
     def handle_status(self, message: StatusMessage) -> None:
         if self._killed:
             return
-        self.fleet.observe(message)
+        if self.fleet.observe(message):
+            # Only heartbeats the fleet ACCEPTED reach the time-series
+            # fold: a reordered/redelivered older frame carries lower
+            # cumulative breach counts, which the store's reset-aware
+            # increase() would misread as a counter restart and count
+            # as phantom breaches — enough to fire a zero-budget burn
+            # rule on a healthy fleet.
+            self.watchtower.observe_status(message)
         with self._mu:
             worker = self.workers.get(message.worker_id)
             if worker is None:

@@ -1,0 +1,216 @@
+"""The fleet watchtower: history + rules + alerts over the live fleet.
+
+`FleetView` (fleet.py) folds every telemetry heartbeat into the *current*
+picture of each worker; the watchtower is the part that remembers and
+judges.  It sits beside the fleet view inside the orchestrator and:
+
+- **feeds the rolling time-series store** (`utils/timeseries.py`) from
+  every accepted heartbeat — time-weighted queue depth, MFU, per-chip
+  goodput, device occupancy (busy/overlap/bubble), RSS, and the SLO
+  breach counters the serving workers now carry in
+  ``resource_usage["slo_breaches"]`` — one ``fleet_*`` series per worker
+  (and per chip/SLO where labeled);
+- **self-samples the orchestrator's own metrics registry** each tick
+  through the shared exposition parser (`RegistrySampler`), which is how
+  broker-side series (dead letters, outbox depth) and the fleet gauges
+  gain history without bespoke plumbing, and derives
+  ``watchtower_outbox_utilization{publisher}`` (depth/capacity) for the
+  near-full rule;
+- **evaluates the alert engine** (`utils/alerts.py`) on the
+  orchestrator's tick cadence (rate-limited by ``eval_interval_s``),
+  publishing every firing/resolved transition as a typed `AlertMessage`
+  on ``TOPIC_ALERTS`` and serving the lifecycle state at ``/alerts``
+  (`set_alerts_provider` in cli.py / the loadgen gate).
+
+Worker processes keep their OWN history by self-sampling their registries
+on the telemetry interval (`inference/worker.py`, `media/worker.py`), so
+an orchestrator restart loses only the *fleet-wide* fold — each worker's
+``/timeseries`` still carries its story, and the next orchestrator
+generation re-folds from the first heartbeat.  No sidecar, no external
+TSDB.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..bus.messages import TOPIC_ALERTS, AlertMessage, StatusMessage
+from ..utils.alerts import AlertEngine, AlertRule, default_rules
+from ..utils.metrics import REGISTRY, MetricsRegistry
+from ..utils.timeseries import STORE, RegistrySampler, TimeSeriesStore
+
+logger = logging.getLogger("dct.watchtower")
+
+
+class Watchtower:
+    """History + alerting beside one orchestrator's `FleetView`."""
+
+    def __init__(self, fleet,
+                 rules: Optional[List[AlertRule]] = None,
+                 store: Optional[TimeSeriesStore] = None,
+                 registry: MetricsRegistry = REGISTRY,
+                 bus=None,
+                 clock=time.time,
+                 eval_interval_s: float = 5.0,
+                 sample_registry: bool = True):
+        self.fleet = fleet
+        self.store = store if store is not None else STORE
+        self.bus = bus
+        self.clock = clock
+        self.eval_interval_s = float(eval_interval_s)
+        self.registry = registry
+        self.engine = AlertEngine(
+            rules if rules is not None else default_rules(),
+            store=self.store, registry=registry, clock=clock,
+            publish=self._publish_transition)
+        self._sampler = RegistrySampler(registry, self.store) \
+            if sample_registry else None
+        self._mu = threading.Lock()
+        self._last_eval = 0.0
+        self._ticks = 0
+
+    # -- heartbeat fold ------------------------------------------------------
+    def observe_status(self, msg: StatusMessage,
+                       wall: Optional[float] = None) -> None:
+        """Fold one heartbeat's telemetry into per-worker series.  Called
+        by `Orchestrator.handle_status` right after the FleetView fold;
+        never raises (history must not break the registry path)."""
+        try:
+            self._observe(msg, wall)
+        except Exception as e:
+            logger.debug("watchtower heartbeat fold degraded: %s", e)
+
+    def _observe(self, msg: StatusMessage, wall: Optional[float]) -> None:
+        wall = self.clock() if wall is None else wall
+        wid = msg.worker_id
+        usage = msg.resource_usage or {}
+        labels = {"worker": wid}
+        queue = usage.get("queue") or {}
+        depth = queue.get("depth_time_weighted", queue.get("depth"))
+        if depth is None:
+            depth = msg.queue_length
+        self.store.add("fleet_queue_depth", float(depth), labels,
+                       wall=wall)
+        rss = usage.get("rss_bytes")
+        if isinstance(rss, (int, float)):
+            self.store.add("fleet_rss_bytes", float(rss), labels,
+                           wall=wall)
+        eff = usage.get("efficiency")
+        if isinstance(eff, dict):
+            for key, series in (("mfu", "fleet_mfu"),
+                                ("goodput_tokens_per_s",
+                                 "fleet_goodput_tokens_per_s")):
+                value = eff.get(key)
+                if isinstance(value, (int, float)):
+                    self.store.add(series, float(value), labels, wall=wall)
+            for chip in (eff.get("per_chip") or []):
+                if not isinstance(chip, dict):
+                    continue
+                goodput = chip.get("goodput_tokens_per_s")
+                if isinstance(goodput, (int, float)):
+                    self.store.add(
+                        "fleet_per_chip_goodput_tokens_per_s",
+                        float(goodput),
+                        {"worker": wid,
+                         "device": str(chip.get("device", "?"))},
+                        wall=wall)
+        occ = usage.get("occupancy")
+        if isinstance(occ, dict):
+            for key, series in (
+                    ("busy_fraction", "fleet_occupancy_busy"),
+                    ("overlap_fraction", "fleet_occupancy_overlap"),
+                    ("bubble_share", "fleet_occupancy_bubble_share")):
+                value = occ.get(key)
+                if isinstance(value, (int, float)):
+                    self.store.add(series, float(value), labels, wall=wall)
+        breaches = usage.get("slo_breaches")
+        if isinstance(breaches, dict):
+            # Cumulative per-SLO breach counts from the worker's own
+            # watchdog: the series the default burn-rate rules read.
+            # Counter resets across worker restarts are absorbed by the
+            # store's reset-aware increase().
+            for slo, count in breaches.items():
+                if isinstance(count, (int, float)):
+                    self.store.add("fleet_slo_breach_total", float(count),
+                                   {"worker": wid, "slo": str(slo)},
+                                   wall=wall)
+
+    # -- the tick ------------------------------------------------------------
+    def tick(self, now: Optional[float] = None,
+             force: bool = False) -> List[Dict[str, Any]]:
+        """One watchtower pass: orchestrator-side series + registry
+        self-sample + alert evaluation.  Rate-limited to
+        ``eval_interval_s`` (the orchestrator calls this from both its
+        distribute and health ticks; the gate calls it from its drive
+        loop at 50 Hz) — ``force=True`` bypasses the limiter for
+        deterministic tests/phase boundaries.  Returns the alert
+        transitions this pass produced."""
+        now = self.clock() if now is None else now
+        with self._mu:
+            if not force and now - self._last_eval < self.eval_interval_s:
+                return []
+            self._last_eval = now
+            self._ticks += 1
+        try:
+            if self._sampler is not None:
+                # The registry sample captures fleet_stale_workers via
+                # its fn-bound gauge — an explicit add here would write
+                # the same series twice per tick.
+                self._sampler.sample(now=now)
+            else:
+                self.store.add("fleet_stale_workers",
+                               float(self.fleet.stale_count()), wall=now)
+            self._derive_outbox_utilization(now)
+        except Exception as e:
+            logger.debug("watchtower sampling degraded: %s", e)
+        return self.engine.evaluate(now=now)
+
+    def _derive_outbox_utilization(self, now: float) -> None:
+        """``watchtower_outbox_utilization{publisher}`` = depth/capacity
+        from the outbox gauges (`bus/outbox.py`) — the ratio the
+        near-full rule thresholds on (raw depth would need per-site
+        bounds)."""
+        depth = self.registry.gauge("bus_outbox_depth")
+        capacity = self.registry.gauge("bus_outbox_capacity")
+        caps = {tuple(sorted(labels.items())): value
+                for labels, value in capacity.series() if labels}
+        for labels, value in depth.series():
+            if not labels:
+                continue
+            cap = caps.get(tuple(sorted(labels.items())), 0.0)
+            if cap > 0:
+                self.store.add("watchtower_outbox_utilization",
+                               value / cap, labels, wall=now)
+
+    # -- export --------------------------------------------------------------
+    def get_alerts(self) -> Dict[str, Any]:
+        """The ``/alerts`` JSON body (registered via
+        `utils.metrics.set_alerts_provider`)."""
+        body = self.engine.snapshot()
+        with self._mu:
+            body["watchtower"] = {
+                "ticks": self._ticks,
+                "eval_interval_s": self.eval_interval_s,
+                "series_count": len(self.store.keys()),
+            }
+        return body
+
+    def firing(self) -> List[str]:
+        return self.engine.firing()
+
+    # -- publish seam --------------------------------------------------------
+    def _publish_transition(self, event: Dict[str, Any]) -> None:
+        if self.bus is None:
+            return
+        msg = AlertMessage.new(
+            rule=event["rule"], kind=event["kind"],
+            series=event["series"], state=event["to"],
+            prev_state=event["from"], severity=event["severity"],
+            value=event["value"], detail=event.get("detail"),
+            at_wall=event["at"])
+        # Publish errors are caught by the engine's publish guard — the
+        # bus must never break an evaluation pass.
+        self.bus.publish(TOPIC_ALERTS, msg.to_dict())

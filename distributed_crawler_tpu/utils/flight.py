@@ -124,6 +124,27 @@ class FlightRecorder:
         dtraces = dtraces_snapshot()
         if dtraces is not None:
             bundle["dtraces"] = dtraces
+        # Watchtower surfaces: the alert lifecycle log and the recent
+        # rolling series — "what was trending before the crash" is
+        # exactly the question a postmortem reader asks first
+        # (tools/postmortem.py renders both).
+        from .metrics import alerts_snapshot
+
+        alerts = alerts_snapshot()
+        if alerts is not None:
+            bundle["alerts"] = alerts
+        try:
+            from . import timeseries as _timeseries
+
+            # Bounded like the flight/span rings: only the last few
+            # minutes of history — a long-lived fleet's full store
+            # would balloon the crash-path write, and the renderer
+            # shows the pre-crash trend, not the epoch.
+            ts = _timeseries.STORE.snapshot(since_s=180.0)
+            if ts.get("series"):
+                bundle["timeseries"] = ts
+        except Exception as e:
+            logger.debug("timeseries bundle capture failed: %s", e)
         return bundle
 
     def dump(self, reason: str, error: str = "",

@@ -10,6 +10,7 @@ registry with Prometheus-style text exposition, no external deps.
 from __future__ import annotations
 
 import bisect
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -79,16 +80,17 @@ class _LabeledMixin:
         with self._lock:
             return [c for _, c in sorted(self._children.items())]
 
+    def _read(self) -> float:
+        with self._lock:
+            return self._value
+
     def series(self) -> list:
         """[(labels_dict, value)] for the parent and every labeled child —
         the programmatic read the telemetry snapshots use (exposition is
         for scrapers; this is for heartbeats).  Value-bearing metrics only
         (Counter/Gauge)."""
-        out = []
-        for m in [self] + self._child_snapshot():
-            with m._lock:
-                out.append((dict(m._label_items), m._value))
-        return out
+        return [(dict(m._label_items), m._read())
+                for m in [self] + self._child_snapshot()]
 
     def remove_labels(self, **kv: object) -> None:
         """Drop the child for this exact label set (no-op if absent) —
@@ -131,6 +133,7 @@ class Gauge(_LabeledMixin):
     def __init__(self, name: str, help_: str = ""):
         self.name, self.help = name, help_
         self._value = 0.0
+        self._fn = None
         self._lock = threading.Lock()
         self._children: Dict[LabelKey, "Gauge"] = {}
 
@@ -141,22 +144,52 @@ class Gauge(_LabeledMixin):
         with self._lock:
             self._value = value
 
+    def set_fn(self, fn) -> None:
+        """Bind a zero-arg callable: the gauge's value is computed at
+        READ time (expose/value/series) instead of at the last set().
+        For values that are a function of *now* — staleness counts,
+        ages — a stored value is only as fresh as its last writer's
+        tick, so a scrape between ticks reads stale truth; a callable
+        gauge cannot.  Pass None to unbind.  The callable must not
+        touch this gauge (it runs outside the lock; a set() from inside
+        it would deadlock-free but be overwritten)."""
+        self._fn = fn
+
+    def _read(self) -> float:
+        fn = self._fn
+        if fn is not None:
+            try:
+                value = float(fn())
+            except Exception as e:
+                # Degrade to the last stored value — a scrape must not
+                # 500 because one computed gauge's provider broke.
+                logging.getLogger("dct.metrics").debug(
+                    "gauge %s value fn failed: %s", self.name, e)
+                with self._lock:
+                    return self._value
+            with self._lock:
+                self._value = value
+            return value
+        with self._lock:
+            return self._value
+
     def add(self, amount: float) -> None:
         with self._lock:
             self._value += amount
 
     @property
     def value(self) -> float:
-        with self._lock:
-            return self._value
+        return self._read()
 
     def expose(self) -> str:
         lines = [f"# HELP {self.name} {_escape_help(self.help)}",
                  f"# TYPE {self.name} gauge"]
         for m in [self] + self._child_snapshot():
-            with m._lock:
-                value = m._value
-            lines.append(f"{self.name}{_label_str(m._label_items)} {value}")
+            # _read(), not the stored value: fn-bound gauges (set_fn)
+            # compute at scrape time so /metrics is never staler than
+            # its reader.
+            lines.append(
+                f"{self.name}{_label_str(m._label_items)} {m._read()}")
         return "\n".join(lines) + "\n"
 
 
@@ -387,6 +420,39 @@ def dtraces_snapshot():
         return {"error": str(e)}
 
 
+# Late-bound /alerts provider: the watchtower's alert-engine snapshot
+# (`orchestrator/watchtower.py` over `utils/alerts.py`) — per-rule
+# lifecycle state + the bounded transition log.
+_alerts_provider = None
+
+
+def set_alerts_provider(fn) -> None:
+    """Register the zero-arg dict provider served at /alerts (pass None
+    to clear)."""
+    global _alerts_provider
+    _alerts_provider = fn
+
+
+def clear_alerts_provider(fn) -> None:
+    """Unregister ``fn`` only if it is still the active provider."""
+    global _alerts_provider
+    if _alerts_provider == fn:
+        _alerts_provider = None
+
+
+def alerts_snapshot():
+    """The active /alerts body, or None without a provider — the flight
+    recorder calls this so postmortem bundles carry the alert history a
+    dead process can no longer serve."""
+    fn = _alerts_provider
+    if fn is None:
+        return None
+    try:
+        return fn()
+    except Exception as e:
+        return {"error": str(e)}
+
+
 class _Handler(BaseHTTPRequestHandler):
     registry: MetricsRegistry = REGISTRY
 
@@ -499,6 +565,51 @@ class _Handler(BaseHTTPRequestHandler):
                 except TypeError:  # zero-arg providers are fine too
                     payload = _dlq_provider()
                 body = _json.dumps(payload, default=str).encode("utf-8")
+            except Exception as e:
+                code = 500
+                body = _json.dumps({"error": str(e)}).encode("utf-8")
+            ctype = "application/json"
+        elif path == "/alerts" and _alerts_provider is not None:
+            # The watchtower's alert surface (`utils/alerts.py` via
+            # `orchestrator/watchtower.py`): per-rule lifecycle state
+            # (inactive/pending/firing/resolved), evaluated values, and
+            # the bounded transition log.  Rendered by tools/watch.py.
+            import json as _json
+
+            try:
+                body = _json.dumps(_alerts_provider(),
+                                   default=str).encode("utf-8")
+            except Exception as e:
+                code = 500
+                body = _json.dumps({"error": str(e)}).encode("utf-8")
+            ctype = "application/json"
+        elif path == "/timeseries":
+            # The process-local rolling time-series store
+            # (`utils/timeseries.py:STORE`): worker self-samples and/or
+            # the orchestrator's fleet folds.  ?series= filters by metric
+            # name or exact series key, ?window= downsamples into
+            # epoch-aligned buckets, ?since= bounds history in seconds.
+            # Served unconditionally (the TRACER /traces pattern): an
+            # empty store answers with zero series, not a 404.
+            import json as _json
+            from urllib.parse import parse_qs as _parse_qs
+
+            from . import timeseries as _timeseries
+
+            query = _parse_qs(self.path.partition("?")[2])
+
+            def _qfloat(key: str) -> float:
+                try:
+                    return float((query.get(key) or ["0"])[0])
+                except (ValueError, TypeError):
+                    return 0.0
+
+            try:
+                body = _json.dumps(_timeseries.STORE.snapshot(
+                    series=(query.get("series") or [""])[0] or None,
+                    window_s=_qfloat("window"),
+                    since_s=_qfloat("since")),
+                    default=str).encode("utf-8")
             except Exception as e:
                 code = 500
                 body = _json.dumps({"error": str(e)}).encode("utf-8")

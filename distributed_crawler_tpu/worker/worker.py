@@ -231,6 +231,10 @@ class CrawlWorker:
         msg.current_work = current
         if telemetry:
             msg.resource_usage = self._telemetry.snapshot()
+            # Cumulative breach counts for the watchtower's burn-rate
+            # fold (the serving workers' discipline).
+            msg.resource_usage["slo_breaches"] = \
+                self._slo.snapshot()["breaches"]
         try:
             self.bus.publish(TOPIC_WORKER_STATUS, msg)
         except Exception as e:

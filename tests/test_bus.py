@@ -113,6 +113,7 @@ class TestMessageRegistry:
         from distributed_crawler_tpu.bus import MESSAGE_REGISTRY, decode_message
 
         from distributed_crawler_tpu.bus.messages import (
+            AlertMessage,
             AudioBatchMessage,
             AudioRef,
             SpanBatchMessage,
@@ -140,6 +141,10 @@ class TestMessageRegistry:
                            "trace_id": "t1", "span_id": "s1",
                            "parent_id": "", "start_wall": 1.0,
                            "duration_ms": 2.0, "attrs": {}}]),
+            AlertMessage: AlertMessage.new(
+                "queue_wait_burn", "burn_rate", "fleet_slo_breach_total",
+                "firing", prev_state="pending", value=12.5,
+                detail={"burn_fast": 12.5, "burn_slow": 7.0}),
         }
         assert set(MESSAGE_REGISTRY.values()) == set(samples)
         for cls, msg in samples.items():

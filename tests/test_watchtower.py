@@ -1,0 +1,776 @@
+"""Watchtower tests: time-series store, alert engine, fleet wiring.
+
+Covers the PR-12 observability layer end to end: the rolling
+`TimeSeriesStore` (bounded rings, aligned downsampling, counter-reset-
+aware increase, least-squares slope), the shared exposition parser
+(`loadgen/exposition.py`) and the registry self-sampler built on it, the
+declarative `AlertEngine` lifecycles (threshold/trend/burn-rate;
+pending→firing→resolved with flap suppression), `AlertMessage` bus
+round-trips, the orchestrator's `Watchtower` fold + `/alerts` +
+`/timeseries` over real HTTP, the FleetView staleness-at-read fix, the
+`tools/watch.py` dashboard against a live stack, and the postmortem
+bundle's embedded alert log + series.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from datetime import timedelta
+
+import pytest
+
+from distributed_crawler_tpu.bus.codec import decode_message
+from distributed_crawler_tpu.bus.messages import (
+    MSG_HEARTBEAT,
+    TOPIC_ALERTS,
+    WORKER_IDLE,
+    AlertMessage,
+    StatusMessage,
+)
+from distributed_crawler_tpu.loadgen.exposition import (
+    metric_samples,
+    moving_samples,
+    parse_exposition,
+)
+from distributed_crawler_tpu.orchestrator.fleet import FleetView
+from distributed_crawler_tpu.orchestrator.watchtower import Watchtower
+from distributed_crawler_tpu.state.datamodels import utcnow
+from distributed_crawler_tpu.utils.alerts import (
+    ALERT_FIRING,
+    ALERT_INACTIVE,
+    ALERT_PENDING,
+    ALERT_RESOLVED,
+    AlertEngine,
+    AlertRule,
+    default_rules,
+    rules_from_config,
+)
+from distributed_crawler_tpu.utils.metrics import (
+    MetricsRegistry,
+    clear_alerts_provider,
+    serve_metrics,
+    set_alerts_provider,
+)
+from distributed_crawler_tpu.utils.timeseries import (
+    RegistrySampler,
+    TimeSeriesStore,
+    series_key,
+)
+
+import tools.watch as watch
+
+
+class Clock:
+    """Injectable wall clock."""
+
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+        return self.t
+
+
+def hb(worker_id="tpu-1", usage=None, ts=None, queue_length=0):
+    msg = StatusMessage.new(worker_id, MSG_HEARTBEAT, WORKER_IDLE,
+                            worker_type="tpu")
+    msg.queue_length = queue_length
+    msg.resource_usage = usage or {}
+    if ts is not None:
+        msg.timestamp = ts
+    return msg
+
+
+# --- the store ---------------------------------------------------------------
+
+class TestTimeSeriesStore:
+    def test_ring_is_bounded_per_series(self):
+        store = TimeSeriesStore(max_samples=4, clock=Clock())
+        for i in range(10):
+            store.add("m", float(i), wall=float(i))
+        assert [v for _, v in store.samples("m")] == [6.0, 7.0, 8.0, 9.0]
+
+    def test_series_key_sorted_and_labeled(self):
+        assert series_key("m", {"b": "2", "a": "1"}) == "m{a=1,b=2}"
+        assert series_key("m") == "m"
+
+    def test_max_series_bound_drops_new_series_not_samples(self):
+        store = TimeSeriesStore(max_series=2, clock=Clock())
+        assert store.add("a", 1.0)
+        assert store.add("b", 1.0)
+        assert not store.add("c", 1.0)   # new series rejected
+        assert store.add("a", 2.0)       # existing series still accepts
+        assert store.latest("a") == 2.0
+        assert store.snapshot()["dropped_series"] == 1
+
+    def test_matching_subset_labels(self):
+        store = TimeSeriesStore(clock=Clock())
+        store.add("m", 1.0, {"slo": "qw", "worker": "w1"}, wall=1.0)
+        store.add("m", 2.0, {"slo": "qw", "worker": "w2"}, wall=1.0)
+        store.add("m", 3.0, {"slo": "age", "worker": "w1"}, wall=1.0)
+        got = store.matching("m", {"slo": "qw"})
+        assert sorted(lbl["worker"] for lbl, _ in got) == ["w1", "w2"]
+
+    def test_increase_is_counter_reset_aware_and_summed(self):
+        clock = Clock(100.0)
+        store = TimeSeriesStore(clock=clock)
+        # w1 counts 0 -> 2, restarts (2 -> 0), then 0 -> 1.
+        for wall, value in ((90, 0), (92, 2), (94, 0), (96, 1)):
+            store.add("c", float(value), {"w": "1"}, wall=float(wall))
+        # w2 counts 5 -> 6.
+        store.add("c", 5.0, {"w": "2"}, wall=90.0)
+        store.add("c", 6.0, {"w": "2"}, wall=96.0)
+        # w1: +2, reset contributes the fresh 0, +1 => 3; w2: +1.
+        assert store.increase("c", window_s=20.0) == 4.0
+
+    def test_increase_anchors_on_pre_window_sample(self):
+        clock = Clock(100.0)
+        store = TimeSeriesStore(clock=clock)
+        store.add("c", 5.0, wall=80.0)   # before the window
+        store.add("c", 9.0, wall=95.0)   # only sample inside
+        assert store.increase("c", window_s=10.0) == 4.0
+
+    def test_slope_least_squares_and_degenerate_cases(self):
+        slope = TimeSeriesStore.slope
+        assert slope([]) is None
+        assert slope([(1.0, 5.0)]) is None            # single sample
+        assert slope([(1.0, 5.0), (1.0, 9.0)]) is None  # zero time spread
+        got = slope([(0.0, 0.0), (1.0, 2.0), (2.0, 4.0)])
+        assert got == pytest.approx(2.0)
+
+    def test_downsample_aligned_buckets(self):
+        samples = [(10.2, 1.0), (10.8, 3.0), (12.1, 5.0)]
+        got = TimeSeriesStore.downsample(samples, 2.0)
+        # Buckets align to floor(wall/2)*2: [10,12) and [12,14).
+        assert got == [(10.0, 2.0, 2), (12.0, 5.0, 1)]
+
+    def test_snapshot_filters_and_windows(self):
+        clock = Clock(100.0)
+        store = TimeSeriesStore(clock=clock, window_s=900.0)
+        store.add("a", 1.0, wall=98.0)
+        store.add("a", 3.0, wall=99.0)
+        store.add("b", 9.0, wall=99.0)
+        body = store.snapshot(series="a")
+        assert set(body["series"]) == {"a"}
+        body = store.snapshot(window_s=10.0)
+        pts = body["series"]["a"]["samples"]
+        assert pts == [[90.0, 2.0, 2]]  # aligned mean bucket
+        assert json.dumps(body)  # JSON-safe
+
+    def test_eviction_during_evaluation_walk_is_safe(self):
+        # matching() snapshots under the lock; concurrent adds that
+        # evict ring entries must not corrupt an evaluation in progress.
+        store = TimeSeriesStore(max_samples=8)
+        stop = threading.Event()
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                store.add("hot", float(i), {"w": "1"}, wall=float(i))
+                i += 1
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        try:
+            for _ in range(200):
+                for _, samples in store.matching("hot"):
+                    assert all(isinstance(v, float) for _, v in samples)
+        finally:
+            stop.set()
+            t.join(timeout=5)
+
+
+# --- the shared exposition parser -------------------------------------------
+
+class TestExpositionParser:
+    TEXT = ('# HELP x help\n# TYPE x counter\n'
+            'x 3.0\nx{a="1",b="two words"} 4.5\n'
+            'lat_bucket{le="0.1"} 7\nlat_sum 0.9\nlat_count 9\n'
+            'bad line without value\n'
+            'esc{v="q\\"uote"} 1\n')
+
+    def test_parse_names_labels_values(self):
+        samples = {(s.name, tuple(sorted(s.labels.items()))): s.value
+                   for s in parse_exposition(self.TEXT)}
+        assert samples[("x", ())] == 3.0
+        assert samples[("x", (("a", "1"), ("b", "two words")))] == 4.5
+        assert samples[("esc", (("v", 'q"uote'),))] == 1.0
+
+    def test_metric_samples_exact_name(self):
+        got = metric_samples(self.TEXT, "x")
+        assert ("", 3.0) in got and len(got) == 2
+        assert metric_samples(self.TEXT, "lat") == []
+
+    def test_moving_samples_nonzero_lines(self):
+        moved = moving_samples("a 0.0\nb 2.0\n# c 9\n")
+        assert moved == ["b 2.0"]
+
+    def test_registry_round_trip(self):
+        reg = MetricsRegistry()
+        reg.counter("c", "h").labels(k="v").inc(2)
+        reg.gauge("g").set(1.5)
+        reg.histogram("h").observe(0.01)
+        samples = parse_exposition(reg.expose())
+        names = {s.name for s in samples}
+        assert {"c", "g", "h_sum", "h_count", "h_bucket"} <= names
+
+    def test_registry_sampler_skips_buckets(self):
+        reg = MetricsRegistry()
+        reg.histogram("h").observe(0.01)
+        reg.gauge("g").set(2.0)
+        store = TimeSeriesStore()
+        added = RegistrySampler(reg, store).sample(now=1.0)
+        assert added > 0
+        assert store.latest("g") == 2.0
+        assert not any("_bucket" in k for k in store.keys())
+
+
+# --- the alert engine --------------------------------------------------------
+
+def mk_engine(rules, clock, store=None):
+    store = store or TimeSeriesStore(clock=clock)
+    return store, AlertEngine(rules, store=store,
+                              registry=MetricsRegistry(), clock=clock)
+
+
+class TestAlertEngine:
+    def test_empty_series_stays_inactive(self):
+        clock = Clock()
+        _, eng = mk_engine([AlertRule(name="t", kind="threshold",
+                                      series="missing", op=">",
+                                      value=0.0)], clock)
+        assert eng.evaluate() == []
+        assert eng.snapshot()["alerts"][0]["state"] == ALERT_INACTIVE
+
+    def test_single_sample_trend_has_no_slope(self):
+        clock = Clock()
+        store, eng = mk_engine(
+            [AlertRule(name="tr", kind="trend", series="s", op=">",
+                       slope_per_s=0.0, window_s=60, min_samples=2)],
+            clock)
+        store.add("s", 5.0, wall=clock() - 1)
+        assert eng.evaluate() == []   # one sample: no judgement
+        store.add("s", 9.0, wall=clock())
+        tr = eng.evaluate()
+        assert [e["to"] for e in tr] == [ALERT_PENDING, ALERT_FIRING]
+
+    def test_burn_rate_zero_budget_fires_on_any_breach(self):
+        clock = Clock()
+        store, eng = mk_engine(
+            [AlertRule(name="b", kind="burn_rate", series="c", budget=0.0,
+                       fast_window_s=5.0, slow_window_s=10.0,
+                       factor=1.0)], clock)
+        store.add("c", 0.0, wall=clock() - 1)
+        assert eng.evaluate() == []   # no increase: burn 0, not inf
+        store.add("c", 1.0, wall=clock())
+        tr = eng.evaluate()
+        assert [e["to"] for e in tr] == [ALERT_PENDING, ALERT_FIRING]
+        body = eng.snapshot()
+        assert json.dumps(body)        # inf clamped JSON-safe
+        assert body["alerts"][0]["detail"]["burn_fast"] >= 1e9
+
+    def test_burn_rate_needs_both_windows(self):
+        clock = Clock(1000.0)
+        store, eng = mk_engine(
+            [AlertRule(name="b", kind="burn_rate", series="c",
+                       budget=10.0, budget_window_s=100.0,
+                       fast_window_s=10.0, slow_window_s=100.0,
+                       factor=2.0)], clock)
+        # Slow window: only 3 events over 100s (rate 0.03 < 0.2 target
+        # burn of factor 2 * budget_rate 0.1) — fast spike alone must
+        # not fire.
+        store.add("c", 0.0, wall=905.0)
+        store.add("c", 3.0, wall=998.0)   # fast window: +3 in 10s
+        assert eng.evaluate() == []
+
+    def test_pending_that_never_confirms_returns_inactive(self):
+        clock = Clock()
+        store, eng = mk_engine(
+            [AlertRule(name="t", kind="threshold", series="g", op=">",
+                       value=5.0, agg="last", for_s=10.0)], clock)
+        store.add("g", 9.0, wall=clock())
+        tr = eng.evaluate()
+        assert [e["to"] for e in tr] == [ALERT_PENDING]
+        clock.tick(5.0)
+        store.add("g", 1.0, wall=clock())   # clears before for_s
+        tr = eng.evaluate()
+        assert [e["to"] for e in tr] == [ALERT_INACTIVE]
+        assert eng.snapshot()["alerts"][0]["fired_count"] == 0
+
+    def test_for_s_confirms_then_fires(self):
+        clock = Clock()
+        store, eng = mk_engine(
+            [AlertRule(name="t", kind="threshold", series="g", op=">",
+                       value=5.0, for_s=10.0)], clock)
+        store.add("g", 9.0, wall=clock())
+        assert [e["to"] for e in eng.evaluate()] == [ALERT_PENDING]
+        clock.tick(9.0)
+        store.add("g", 9.0, wall=clock())
+        assert eng.evaluate() == []          # still pending
+        clock.tick(1.0)
+        store.add("g", 9.0, wall=clock())
+        assert [e["to"] for e in eng.evaluate()] == [ALERT_FIRING]
+
+    def test_flap_suppression_resolved_must_reconfirm_for_s(self):
+        clock = Clock()
+        store, eng = mk_engine(
+            [AlertRule(name="t", kind="threshold", series="g", op=">",
+                       value=5.0, agg="last", window_s=0.0,
+                       for_s=10.0)], clock)
+        store.add("g", 9.0, wall=clock())
+        eng.evaluate()
+        clock.tick(10.0)
+        store.add("g", 9.0, wall=clock())
+        eng.evaluate()
+        assert eng.firing() == ["t"]
+        clock.tick(1.0)
+        store.add("g", 1.0, wall=clock())
+        assert [e["to"] for e in eng.evaluate()] == [ALERT_RESOLVED]
+        # The condition returns: a resolved alert must re-confirm
+        # through pending for the full for_s — no instant re-fire.
+        clock.tick(1.0)
+        store.add("g", 9.0, wall=clock())
+        assert [e["to"] for e in eng.evaluate()] == [ALERT_PENDING]
+        assert eng.firing() == []
+        clock.tick(10.0)
+        store.add("g", 9.0, wall=clock())
+        assert [e["to"] for e in eng.evaluate()] == [ALERT_FIRING]
+        assert eng.snapshot()["alerts"][0]["fired_count"] == 2
+
+    def test_clear_for_s_holds_resolution(self):
+        clock = Clock()
+        store, eng = mk_engine(
+            [AlertRule(name="t", kind="threshold", series="g", op=">",
+                       value=5.0, clear_for_s=10.0)], clock)
+        store.add("g", 9.0, wall=clock())
+        eng.evaluate()
+        assert eng.firing() == ["t"]
+        clock.tick(1.0)
+        store.add("g", 1.0, wall=clock())
+        assert eng.evaluate() == []          # clear streak too short
+        clock.tick(10.0)
+        store.add("g", 1.0, wall=clock())
+        assert [e["to"] for e in eng.evaluate()] == [ALERT_RESOLVED]
+
+    def test_transitions_publish_and_flight(self):
+        from distributed_crawler_tpu.utils import flight
+
+        flight.configure(capacity=64)
+        flight.RECORDER.reset()
+        clock = Clock()
+        published = []
+        store = TimeSeriesStore(clock=clock)
+        eng = AlertEngine(
+            [AlertRule(name="t", kind="threshold", series="g", op=">",
+                       value=0.0)],
+            store=store, registry=MetricsRegistry(), clock=clock,
+            publish=published.append)
+        store.add("g", 1.0, wall=clock())
+        eng.evaluate()
+        clock.tick(1.0)
+        store.add("g", -1.0, wall=clock())
+        eng.evaluate()
+        # pending transitions stay local; firing + resolved publish.
+        assert [e["to"] for e in published] == [ALERT_FIRING,
+                                                ALERT_RESOLVED]
+        kinds = [e["kind"] for e in flight.RECORDER.events()]
+        assert kinds.count("alert") == 3  # pending, firing, resolved
+
+    def test_duplicate_rule_names_rejected(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            AlertEngine([AlertRule(name="x", kind="threshold", series="s"),
+                         AlertRule(name="x", kind="threshold", series="s")],
+                        store=TimeSeriesStore(),
+                        registry=MetricsRegistry())
+
+    def test_rules_from_config_replaces_same_named_default(self):
+        rules = rules_from_config([
+            {"name": "queue_wait_burn", "kind": "burn_rate",
+             "series": "fleet_slo_breach_total",
+             "labels": {"slo": "queue_wait"}, "budget": 0,
+             "fast_window_s": 1.0, "slow_window_s": 2.0, "factor": 1.0}])
+        assert len(rules) == len(default_rules())
+        assert rules[0].name == "queue_wait_burn"
+        assert rules[0].fast_window_s == 1.0
+
+    def test_rules_from_config_rejects_unknown_keys(self):
+        with pytest.raises(ValueError, match="bogus"):
+            rules_from_config([{"name": "x", "kind": "threshold",
+                                "series": "s", "bogus": 1}])
+
+
+# --- the bus envelope --------------------------------------------------------
+
+class TestAlertMessage:
+    def test_round_trip_and_registry(self):
+        msg = AlertMessage.new("queue_wait_burn", "burn_rate",
+                               "fleet_slo_breach_total", "firing",
+                               prev_state="pending", value=12.5,
+                               detail={"burn_fast": 12.5})
+        msg.validate()
+        back = decode_message(msg.to_dict())
+        assert isinstance(back, AlertMessage)
+        assert back.rule == "queue_wait_burn" and back.value == 12.5
+        assert back.detail["burn_fast"] == 12.5
+        assert back.state == "firing" and back.prev_state == "pending"
+
+    def test_validate_rejects_bad_state(self):
+        msg = AlertMessage.new("r", "threshold", "s", "exploded")
+        with pytest.raises(ValueError, match="alert state"):
+            msg.validate()
+
+    def test_none_value_survives(self):
+        msg = AlertMessage.new("r", "trend", "s", "resolved", value=None)
+        assert decode_message(msg.to_dict()).value is None
+
+
+# --- FleetView staleness at read time (the PR-12 satellite fix) --------------
+
+class TestStalenessAtReadTime:
+    def test_cluster_judges_staleness_at_snapshot_now(self):
+        fv = FleetView(stale_after_s=300.0, registry=MetricsRegistry())
+        t0 = utcnow()
+        fv.observe(hb(worker_id="w1", ts=t0))
+        # Fresh at t0; no health tick ever runs.  A scrape AFTER the
+        # deadline must judge against its own now, not the last tick.
+        assert fv.export(now=t0)["workers"]["w1"]["stale"] is False
+        later = t0 + timedelta(seconds=301)
+        out = fv.export(now=later)
+        assert out["workers"]["w1"]["stale"] is True
+        assert out["fleet"]["stale_workers"] == ["w1"]
+        assert fv.stale_count(now=later) == 1
+        assert fv.stale_count(now=t0) == 0
+
+    def test_metrics_gauge_is_live_between_ticks(self):
+        # The fn-bound gauge: a plain /metrics scrape between health
+        # ticks reads staleness computed against NOW.
+        reg = MetricsRegistry()
+        fv = FleetView(stale_after_s=0.05, registry=reg)
+        fv.observe(hb(worker_id="w1", ts=utcnow()))
+        assert "fleet_stale_workers 0.0" in reg.expose()
+        time.sleep(0.06)
+        # No refresh_staleness() call in between — the scrape is live.
+        assert "fleet_stale_workers 1.0" in reg.expose()
+
+
+# --- the watchtower ----------------------------------------------------------
+
+class FakeFleet:
+    def __init__(self, stale=0):
+        self.stale = stale
+
+    def stale_count(self, now=None):
+        return self.stale
+
+
+class TestWatchtower:
+    def test_heartbeat_fold_feeds_named_series(self):
+        clock = Clock()
+        store = TimeSeriesStore(clock=clock)
+        wt = Watchtower(FakeFleet(), rules=[], store=store,
+                        registry=MetricsRegistry(), clock=clock,
+                        eval_interval_s=0.0)
+        wt.observe_status(hb(usage={
+            "rss_bytes": 1 << 20,
+            "queue": {"depth": 3, "depth_time_weighted": 2.5},
+            "efficiency": {"mfu": 0.25, "goodput_tokens_per_s": 900.0,
+                           "per_chip": [
+                               {"device": "cpu:0",
+                                "goodput_tokens_per_s": 450.0}]},
+            "occupancy": {"busy_fraction": 0.5, "overlap_fraction": 0.1,
+                          "bubble_share": 0.2},
+            "slo_breaches": {"queue_wait": 2},
+        }))
+        w = {"worker": "tpu-1"}
+        assert store.latest("fleet_queue_depth", w) == 2.5
+        assert store.latest("fleet_rss_bytes", w) == float(1 << 20)
+        assert store.latest("fleet_mfu", w) == 0.25
+        assert store.latest("fleet_per_chip_goodput_tokens_per_s",
+                            {"worker": "tpu-1",
+                             "device": "cpu:0"}) == 450.0
+        assert store.latest("fleet_occupancy_bubble_share", w) == 0.2
+        assert store.latest("fleet_slo_breach_total",
+                            {"worker": "tpu-1",
+                             "slo": "queue_wait"}) == 2.0
+
+    def test_tick_rate_limited_and_forceable(self):
+        clock = Clock()
+        store = TimeSeriesStore(clock=clock)
+        wt = Watchtower(FakeFleet(stale=1), rules=[], store=store,
+                        registry=MetricsRegistry(), clock=clock,
+                        eval_interval_s=10.0, sample_registry=False)
+        wt.tick()
+        assert store.latest("fleet_stale_workers") == 1.0
+        n0 = len(store.samples("fleet_stale_workers"))
+        wt.tick()   # inside the limiter window: no new sample
+        assert len(store.samples("fleet_stale_workers")) == n0
+        wt.tick(force=True)
+        assert len(store.samples("fleet_stale_workers")) == n0 + 1
+
+    def test_burn_alert_fires_from_heartbeats_and_publishes(self):
+        clock = Clock()
+        store = TimeSeriesStore(clock=clock)
+        published = []
+
+        class Bus:
+            def publish(self, topic, payload):
+                published.append((topic, payload))
+
+        rules = [AlertRule(name="qw", kind="burn_rate",
+                           series="fleet_slo_breach_total",
+                           labels={"slo": "queue_wait"}, budget=0.0,
+                           fast_window_s=5.0, slow_window_s=10.0,
+                           factor=1.0)]
+        wt = Watchtower(FakeFleet(), rules=rules, store=store,
+                        registry=MetricsRegistry(), bus=Bus(),
+                        clock=clock, eval_interval_s=0.0,
+                        sample_registry=False)
+        wt.observe_status(hb(usage={"slo_breaches": {"queue_wait": 0}}))
+        wt.tick(force=True)
+        clock.tick(1.0)
+        wt.observe_status(hb(usage={"slo_breaches": {"queue_wait": 3}}))
+        wt.tick(force=True)
+        assert wt.firing() == ["qw"]
+        assert len(published) == 1
+        topic, payload = published[0]
+        assert topic == TOPIC_ALERTS
+        msg = decode_message(payload)
+        assert isinstance(msg, AlertMessage) and msg.state == "firing"
+        # /alerts body carries lifecycle + log + watchtower meta.
+        body = wt.get_alerts()
+        assert body["firing"] == ["qw"]
+        assert body["watchtower"]["ticks"] >= 2
+        assert json.dumps(body)
+
+    def test_out_of_order_heartbeat_not_folded_into_series(self):
+        # A redelivered OLDER heartbeat carries lower cumulative breach
+        # counts; FleetView rejects it and the watchtower must follow —
+        # folding it would look like a counter reset to increase() and
+        # fire zero-budget burn rules on a healthy fleet.
+        from distributed_crawler_tpu.config.crawler import CrawlerConfig
+        from distributed_crawler_tpu.orchestrator import Orchestrator
+        from distributed_crawler_tpu.utils import timeseries as ts_mod
+
+        ts_mod.STORE.reset()
+        try:
+            orch = Orchestrator(
+                "c1", CrawlerConfig(crawl_id="c1", platform="telegram"),
+                None, _NullSM(), registry=MetricsRegistry(),
+                alert_rules=[])
+            t0 = utcnow()
+            fresh = hb(usage={"slo_breaches": {"queue_wait": 5}}, ts=t0)
+            stale = hb(usage={"slo_breaches": {"queue_wait": 3}},
+                       ts=t0 - timedelta(seconds=10))
+            orch.handle_status(fresh)
+            orch.handle_status(stale)  # out-of-order: dropped, not folded
+            samples = ts_mod.STORE.samples(
+                "fleet_slo_breach_total",
+                {"worker": "tpu-1", "slo": "queue_wait"})
+            assert [v for _, v in samples] == [5.0]
+        finally:
+            ts_mod.STORE.reset()
+
+    def test_outbox_utilization_derived_from_gauges(self):
+        clock = Clock()
+        reg = MetricsRegistry()
+        reg.gauge("bus_outbox_depth").labels(publisher="orch").set(90.0)
+        reg.gauge("bus_outbox_capacity").labels(
+            publisher="orch").set(100.0)
+        store = TimeSeriesStore(clock=clock)
+        rules = [AlertRule(name="outbox_near_full", kind="threshold",
+                           series="watchtower_outbox_utilization",
+                           op=">=", value=0.8, agg="last", group="max")]
+        wt = Watchtower(FakeFleet(), rules=rules, store=store,
+                        registry=reg, clock=clock, eval_interval_s=0.0)
+        wt.tick(force=True)
+        assert store.latest("watchtower_outbox_utilization",
+                            {"publisher": "orch"}) == pytest.approx(0.9)
+        assert wt.firing() == ["outbox_near_full"]
+
+
+# --- live surfaces + dashboard + bundle -------------------------------------
+
+class TestLiveSurfaces:
+    def test_alerts_and_timeseries_over_http(self):
+        from distributed_crawler_tpu.utils import timeseries as ts_mod
+
+        clock = Clock()
+        store = TimeSeriesStore(clock=clock)
+        rules = [AlertRule(name="hot", kind="threshold", series="g",
+                           op=">", value=0.5)]
+        wt = Watchtower(FakeFleet(), rules=rules, store=store,
+                        registry=MetricsRegistry(), clock=clock,
+                        eval_interval_s=0.0, sample_registry=False)
+        store.add("g", 1.0, wall=clock())
+        wt.tick(force=True)
+        set_alerts_provider(wt.get_alerts)
+        # /timeseries serves the process-global store: point it at ours
+        # for the duration.
+        old_store = ts_mod.STORE
+        ts_mod.STORE = store
+        server = serve_metrics(0, MetricsRegistry())
+        port = server.server_address[1]
+        try:
+            body = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/alerts", timeout=5))
+            assert body["firing"] == ["hot"]
+            body = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/timeseries?series=g", timeout=5))
+            assert set(body["series"]) == {"g"}
+            # window= downsamples into aligned buckets (3-col points).
+            body = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/timeseries?window=2", timeout=5))
+            assert all(len(p) == 3
+                       for p in body["series"]["g"]["samples"])
+            # The dashboard renders from the same live surfaces.
+            page = watch.render_once(f"http://127.0.0.1:{port}")
+            assert "FIRING" in page and "hot" in page
+        finally:
+            server.shutdown()
+            ts_mod.STORE = old_store
+            clear_alerts_provider(wt.get_alerts)
+
+    def test_bundle_embeds_alert_log_and_series(self):
+        from distributed_crawler_tpu.utils import timeseries as ts_mod
+        from distributed_crawler_tpu.utils.flight import FlightRecorder
+
+        clock = Clock()
+        store = TimeSeriesStore(clock=clock)
+        rules = [AlertRule(name="hot", kind="threshold", series="g",
+                           op=">", value=0.5)]
+        wt = Watchtower(FakeFleet(), rules=rules, store=store,
+                        registry=MetricsRegistry(), clock=clock,
+                        eval_interval_s=0.0, sample_registry=False)
+        store.add("g", 1.0, wall=clock())
+        wt.tick(force=True)
+        set_alerts_provider(wt.get_alerts)
+        old_store = ts_mod.STORE
+        ts_mod.STORE = store
+        try:
+            rec = FlightRecorder(capacity=8)
+            bundle = rec.bundle("test")
+            assert bundle["alerts"]["firing"] == ["hot"]
+            assert "g" in bundle["timeseries"]["series"]
+            # The postmortem renderer shows the trend + the alert log.
+            import tools.postmortem as postmortem
+
+            store.add("g", 5.0, wall=clock() + 1)
+            out = postmortem.render_bundle(rec.bundle("test2"))
+            assert "alert log" in out and "hot" in out
+            assert "trending before the crash" in out
+        finally:
+            ts_mod.STORE = old_store
+            clear_alerts_provider(wt.get_alerts)
+
+
+class TestEndToEndWatchtower:
+    def test_orchestrator_worker_alert_e2e(self, tmp_path):
+        """One real stack on the in-memory bus: TPU worker heartbeats
+        carry breach counts, the orchestrator's watchtower folds them,
+        a zero-budget burn rule fires, /alerts serves it over HTTP, and
+        tools/watch.py --once renders the live dashboard."""
+        from distributed_crawler_tpu.bus import InMemoryBus
+        from distributed_crawler_tpu.config.crawler import CrawlerConfig
+        from distributed_crawler_tpu.inference.engine import (
+            EngineConfig,
+            InferenceEngine,
+        )
+        from distributed_crawler_tpu.inference.worker import (
+            TPUWorker,
+            TPUWorkerConfig,
+        )
+        from distributed_crawler_tpu.orchestrator import Orchestrator
+        from distributed_crawler_tpu.state.providers import (
+            InMemoryStorageProvider,
+        )
+        from distributed_crawler_tpu.utils import timeseries as ts_mod
+        from distributed_crawler_tpu.utils import trace
+
+        trace.configure(capacity=4096)
+        ts_mod.STORE.reset()
+        registry = MetricsRegistry()
+        bus = InMemoryBus(sync=True)
+        rules = [AlertRule(name="queue_wait_burn", kind="burn_rate",
+                           series="fleet_slo_breach_total",
+                           labels={"slo": "queue_wait"}, budget=0.0,
+                           fast_window_s=30.0, slow_window_s=60.0,
+                           factor=1.0)]
+        orch = Orchestrator(
+            "c1", CrawlerConfig(crawl_id="c1", platform="telegram"),
+            bus, _NullSM(), registry=registry, alert_rules=rules)
+        orch.ocfg.alert_eval_interval_s = 0.0
+        bus.subscribe("worker-status", orch.handle_status_payload)
+        bus.subscribe(TOPIC_ALERTS, lambda p: None)
+        engine = InferenceEngine(EngineConfig(model="tiny", batch_size=2,
+                                              buckets=[16]),
+                                 registry=registry)
+        worker = TPUWorker(
+            bus, engine, provider=InMemoryStorageProvider(),
+            cfg=TPUWorkerConfig(worker_id="tpu-1", heartbeat_s=0.1,
+                                stall_warn_s=0.0,
+                                slo_queue_wait_ms=0.001),
+            registry=registry)
+        worker.start()
+        server = serve_metrics(0, registry)
+        port = server.server_address[1]
+        set_alerts_provider(orch.get_alerts)
+        try:
+            from distributed_crawler_tpu.bus.codec import RecordBatch
+            from distributed_crawler_tpu.datamodel.post import Post
+
+            batch = RecordBatch.from_posts(
+                [Post(post_uid="p1", description="hello world")],
+                crawl_id="c1")
+            bus.publish("tpu-inference-batches", batch.to_dict())
+            assert worker.drain(timeout_s=30)
+            deadline = time.monotonic() + 15
+            fired = False
+            while time.monotonic() < deadline and not fired:
+                orch.watchtower.tick(force=True)
+                fired = "queue_wait_burn" in orch.watchtower.firing()
+                time.sleep(0.05)
+            assert fired, orch.get_alerts()
+            body = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/alerts", timeout=5))
+            assert "queue_wait_burn" in body["firing"]
+            # /timeseries carries BOTH the fleet fold and the worker's
+            # own self-samples (one process here, one store).
+            ts_body = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/timeseries", timeout=5))
+            keys = set(ts_body["series"])
+            assert any(k.startswith("fleet_slo_breach_total")
+                       for k in keys)
+            assert any(k.startswith("tpu_worker_batches_total")
+                       for k in keys), sorted(keys)[:20]
+            page = watch.render_once(f"http://127.0.0.1:{port}")
+            assert "queue_wait_burn" in page and "FIRING" in page
+        finally:
+            set_alerts_provider(None)
+            worker.stop(timeout_s=5)
+            server.shutdown()
+            bus.close()
+            ts_mod.STORE.reset()
+
+
+class _NullSM:
+    def initialize(self, seeds):
+        pass
+
+    def save_state(self):
+        pass
+
+    def close(self):
+        pass
+
+    def get_layer_by_depth(self, depth):
+        return []
+
+    def get_max_depth(self):
+        raise LookupError
+
+    def update_page(self, page):
+        pass

@@ -72,6 +72,12 @@ def selfcheck() -> int:
         print("critpath selfcheck FAILED", file=sys.stderr)
         return rc
     rc = subprocess.call(
+        [sys.executable, os.path.join(repo, "tools", "watch.py"),
+         "--selfcheck"], cwd=repo)
+    if rc != 0:
+        print("watch selfcheck FAILED", file=sys.stderr)
+        return rc
+    rc = subprocess.call(
         [sys.executable, os.path.join(repo, "tools", "dlq.py"),
          "--selfcheck"], cwd=repo,
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
@@ -100,7 +106,10 @@ def selfcheck() -> int:
          # multi-chip serving: row padding, 1-vs-8-device parity,
          # worker-with-mesh e2e, mesh-aware MFU, and the
          # multichip-steady gate acceptance (the 1->8 scaling tentpole).
-         os.path.join(repo, "tests", "test_multichip_serve.py")],
+         os.path.join(repo, "tests", "test_multichip_serve.py"),
+         # watchtower: rolling time-series store, alert-engine
+         # lifecycles, /alerts + /timeseries, the live-dashboard e2e.
+         os.path.join(repo, "tests", "test_watchtower.py")],
         env=env, cwd=repo)
 
 

@@ -13,14 +13,14 @@ page: what fraction of the chip the stream is using, where the pad
 tokens go, which buckets cost what, whether the declared budgets held,
 and where the milliseconds went per stage.
 
-Stdlib only, like tools/postmortem.py and tools/trace_dump.py.
+Stdlib plus the in-tree exposition parser (`utils/exposition.py`), like
+tools/postmortem.py.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
-import re
 import sys
 import urllib.request
 from typing import Any, Dict, List, Optional, Tuple
@@ -29,6 +29,13 @@ try:  # script mode (`python tools/perfreport.py`): tools/ is on sys.path
     from postmortem import _stage_digest
 except ImportError:  # module mode (`import tools.perfreport`)
     from tools.postmortem import _stage_digest
+
+# The shared exposition parser — the ad-hoc regex copy this tool used
+# to carry is gone.  Imported from its import-light home (the loadgen
+# re-export would execute the whole gate package for one function).
+from distributed_crawler_tpu.utils.exposition import (
+    metric_samples as _metric_samples,
+)
 
 
 def _fmt_flops(n: Any) -> str:
@@ -41,21 +48,6 @@ def _fmt_flops(n: Any) -> str:
             return f"{n:.2f}{unit}"
         n /= 1000.0
     return "-"
-
-
-def _metric_samples(exposition: str, name: str) -> List[Tuple[str, float]]:
-    """[(labels_str, value)] for every sample of ``name`` in a Prometheus
-    text exposition (exact name match, labeled or not)."""
-    out: List[Tuple[str, float]] = []
-    pat = re.compile(r"^" + re.escape(name) + r"(\{[^}]*\})?\s+(\S+)$")
-    for line in exposition.splitlines():
-        m = pat.match(line)
-        if m:
-            try:
-                out.append((m.group(1) or "", float(m.group(2))))
-            except ValueError:
-                continue
-    return out
 
 
 def render_report(costs: Dict[str, Any], metrics_text: str = "",

@@ -18,7 +18,8 @@ Live mode fetches `/cluster` from a running orchestrator's metrics port
 and prints the fleet table: worker, type, status, age, queue, rates, RSS,
 device memory — the "is anything about to die" view.
 
-Stdlib only, like tools/trace_dump.py.
+Stdlib plus the in-tree exposition parser (`utils/exposition.py`), like
+tools/perfreport.py.
 """
 
 from __future__ import annotations
@@ -28,7 +29,7 @@ import json
 import sys
 import time
 import urllib.request
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Tuple
 
 
 def _fmt_ts(epoch: float) -> str:
@@ -73,6 +74,16 @@ def render_bundle(bundle: Dict[str, Any]) -> str:
             lines.append(f"  {rel:>9.3f}s  {e.get('kind', '?'):<16} {fields}")
     else:
         lines.append("  (empty — was --flight-buffer 0?)")
+    alert_lines = _alert_digest(bundle.get("alerts") or {})
+    if alert_lines:
+        lines.append("")
+        lines.append("alert log (watchtower lifecycle transitions):")
+        lines.extend(alert_lines)
+    trend_lines = _trend_digest(bundle.get("timeseries") or {})
+    if trend_lines:
+        lines.append("")
+        lines.append("trending before the crash (rolling series):")
+        lines.extend(trend_lines)
     digest = _stage_digest(bundle.get("traces") or {})
     if digest:
         lines.append("")
@@ -110,16 +121,83 @@ def _stage_digest(traces: Dict[str, Any]) -> List[str]:
 
 
 def _moving_metrics(exposition: str) -> List[str]:
+    # The shared exposition parser — this tool's ad-hoc split-and-float
+    # copy is gone.  Imported from its import-light home, not the
+    # loadgen re-export (whose package __init__ drags the gate in).
+    from distributed_crawler_tpu.utils.exposition import moving_samples
+
+    return moving_samples(exposition)
+
+
+SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: List[float], width: int = 24) -> str:
+    """Unicode block sparkline over ``values`` (downsampled to ``width``
+    cells, min-max normalized; flat series render mid-blocks).  Shared
+    with tools/watch.py — the ONE trend-cell renderer."""
+    if not values:
+        return ""
+    if len(values) > width:
+        # Mean-pool into `width` cells so the whole window stays visible.
+        step = len(values) / width
+        pooled = []
+        for i in range(width):
+            chunk = values[int(i * step):max(int((i + 1) * step),
+                                             int(i * step) + 1)]
+            pooled.append(sum(chunk) / len(chunk))
+        values = pooled
+    lo, hi = min(values), max(values)
+    if hi <= lo:
+        return SPARK_BLOCKS[3] * len(values)
+    scale = (len(SPARK_BLOCKS) - 1) / (hi - lo)
+    return "".join(SPARK_BLOCKS[int((v - lo) * scale)] for v in values)
+
+
+def _alert_digest(alerts: Dict[str, Any]) -> List[str]:
+    """The bundled /alerts body as log lines (newest last) + the rules
+    still firing at dump time."""
+    out: List[str] = []
+    firing = alerts.get("firing") or []
+    if firing:
+        out.append(f"  FIRING at dump time: {', '.join(firing)}")
+    log = alerts.get("log") or []
+    t_end = max((float(e.get("at", 0.0)) for e in log), default=0.0)
+    for e in log[-20:]:
+        rel = float(e.get("at", 0.0)) - t_end
+        value = e.get("value")
+        out.append(f"  {rel:>9.3f}s  {e.get('rule', '?'):<28} "
+                   f"{e.get('from', '?')} -> {e.get('to', '?')}"
+                   + (f"  value={value}" if value is not None else ""))
+    return out
+
+
+def ranked_movers(series: Dict[str, Any],
+                  limit: int = 12) -> List[Tuple[str, List[float]]]:
+    """(key, values) for the biggest relative movers in a /timeseries
+    ``series`` map, most-moved first — the ONE ranking shared by this
+    renderer and tools/watch.py's dashboard."""
+    rows = []
+    for key, s in (series or {}).items():
+        values = [float(p[1]) for p in (s.get("samples") or [])
+                  if isinstance(p, (list, tuple)) and len(p) >= 2]
+        if len(values) < 2:
+            continue
+        denom = max(abs(values[0]), abs(values[-1]), 1e-9)
+        rows.append((abs(values[-1] - values[0]) / denom, key, values))
+    rows.sort(key=lambda r: (-r[0], r[1]))
+    return [(key, values) for _, key, values in rows[:limit]]
+
+
+def _trend_digest(timeseries: Dict[str, Any],
+                  limit: int = 12) -> List[str]:
+    """Sparkline + first→last per bundled series, biggest relative
+    movers first — "what was trending before the crash" on one screen."""
     out = []
-    for line in exposition.splitlines():
-        if line.startswith("#") or not line.strip():
-            continue
-        try:
-            value = float(line.rsplit(None, 1)[1])
-        except (IndexError, ValueError):
-            continue
-        if value != 0.0:
-            out.append(line)
+    for key, values in ranked_movers(timeseries.get("series") or {},
+                                     limit):
+        out.append(f"  {key:<44} {sparkline(values):<24} "
+                   f"{values[0]:.6g} -> {values[-1]:.6g}")
     return out
 
 
@@ -195,8 +273,23 @@ def selfcheck() -> int:
     rec.record("batch", batch="b1", outcome="ok", records=3)
     rec.record("worker_offline", worker="crawl-1", silence_s=301.0)
     bundle = rec.bundle("selfcheck", error="synthetic")
+    # Watchtower surfaces render when present (the flight recorder
+    # embeds them in real bundles).
+    bundle["alerts"] = {
+        "firing": ["queue_wait_burn"],
+        "log": [{"rule": "queue_wait_burn", "from": "pending",
+                 "to": "firing", "at": 100.0, "value": 12.5}],
+    }
+    bundle["timeseries"] = {"series": {
+        "fleet_queue_depth{worker=tpu-1}": {
+            "name": "fleet_queue_depth", "labels": {"worker": "tpu-1"},
+            "samples": [[90.0, 1.0], [95.0, 8.0], [100.0, 30.0]]}}}
     out = render_bundle(bundle)
     assert "selfcheck" in out and "worker_offline" in out, out
+    assert "queue_wait_burn" in out and "FIRING at dump time" in out, out
+    assert "fleet_queue_depth" in out and "1 -> 30" in out, out
+    assert sparkline([1.0, 2.0, 3.0]) and sparkline([]) == ""
+    assert len(sparkline(list(range(100)))) <= 24
     cluster = {
         "fleet": {"worker_count": 1, "crawl_workers": 1, "tpu_workers": 0,
                   "stale_workers": []},

@@ -1,0 +1,282 @@
+#!/usr/bin/env python
+"""watch — a live one-page fleet dashboard from the watchtower surfaces.
+
+Usage:
+    python tools/watch.py http://127.0.0.1:9102            # live (2s refresh)
+    python tools/watch.py http://127.0.0.1:9102 --once     # one page, exit
+    python tools/watch.py --selfcheck                      # CI smoke
+
+Fetches the three surfaces the orchestrator (or any worker, for the
+``/timeseries`` half) serves — ``/alerts`` (rule lifecycle state,
+`utils/alerts.py`), ``/timeseries`` (rolling series,
+`utils/timeseries.py`), and ``/cluster`` (the fleet fold,
+`orchestrator/fleet.py`) — and renders the ops story on one page:
+
+- firing/pending alerts first (rule, value, age), then the burn-rate
+  columns for every burn rule (fast/slow burn vs factor);
+- a per-worker table with sparkline trend cells (queue depth, MFU,
+  goodput) from the fleet series, next to the instantaneous /cluster
+  numbers;
+- the biggest-moving series overall, so "what changed" needs no grafana.
+
+Endpoints that 404 (e.g. /alerts on a plain worker) degrade to their
+section being skipped — the page renders from whatever the host serves.
+Stdlib only, like the other tools/ renderers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+try:  # script mode (`python tools/watch.py`): tools/ is on sys.path
+    from postmortem import ranked_movers, sparkline
+except ImportError:  # module mode (`import tools.watch`)
+    from tools.postmortem import ranked_movers, sparkline
+
+REFRESH_S = 2.0
+_STATE_ORDER = {"firing": 0, "pending": 1, "resolved": 2, "inactive": 3}
+
+
+def _fetch(base: str, path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with urllib.request.urlopen(base.rstrip("/") + path,
+                                    timeout=5) as resp:
+            return json.load(resp)
+    except Exception:
+        return None  # surface not served here; section degrades
+
+
+def _series_values(tseries: Dict[str, Any], name: str,
+                   worker: Optional[str] = None) -> List[float]:
+    """Sample values of one fleet series (optionally for one worker),
+    oldest first."""
+    for s in (tseries.get("series") or {}).values():
+        if s.get("name") != name:
+            continue
+        labels = s.get("labels") or {}
+        if worker is not None and labels.get("worker") != worker:
+            continue
+        return [float(p[1]) for p in (s.get("samples") or [])
+                if isinstance(p, (list, tuple)) and len(p) >= 2]
+    return []
+
+
+def _fmt_age(since: Any, now: float) -> str:
+    try:
+        age = now - float(since)
+    except (TypeError, ValueError):
+        return "-"
+    if age < 0:
+        return "-"
+    return f"{age:.0f}s" if age < 120 else f"{age / 60.0:.1f}m"
+
+
+def render_dashboard(cluster: Optional[Dict[str, Any]],
+                     alerts: Optional[Dict[str, Any]],
+                     tseries: Optional[Dict[str, Any]],
+                     now: Optional[float] = None) -> str:
+    now = time.time() if now is None else now
+    cluster = cluster or {}
+    alerts = alerts or {}
+    tseries = tseries or {}
+    lines: List[str] = []
+
+    fleet = cluster.get("fleet") or {}
+    orch = cluster.get("orchestrator") or {}
+    firing = alerts.get("firing") or []
+    head = (f"fleet watchtower — {fleet.get('worker_count', 0)} workers "
+            f"({fleet.get('crawl_workers', 0)} crawl, "
+            f"{fleet.get('tpu_workers', 0)} tpu)")
+    if orch:
+        head += (f" · depth={orch.get('current_depth')} "
+                 f"active={orch.get('active_work')} "
+                 f"completed={orch.get('completed_items')}")
+    head += f" · {len(firing)} FIRING" if firing else " · all quiet"
+    lines.append(head)
+
+    # --- alerts ------------------------------------------------------------
+    rows = sorted(alerts.get("alerts") or [],
+                  key=lambda a: (_STATE_ORDER.get(a.get("state"), 9),
+                                 a.get("rule", "")))
+    active = [a for a in rows if a.get("state") in ("firing", "pending")]
+    if active:
+        lines.append("")
+        lines.append("alerts:")
+        for a in active:
+            value = a.get("value")
+            lines.append(
+                f"  {a.get('state', '?').upper():<8} "
+                f"{a.get('rule', '?'):<28} "
+                f"value={value if value is not None else '-'}  "
+                f"for {_fmt_age(a.get('since'), now)}  "
+                f"[{a.get('severity', '?')}]")
+
+    # --- burn-rate columns -------------------------------------------------
+    burns = [a for a in rows if a.get("kind") == "burn_rate"]
+    if burns:
+        lines.append("")
+        lines.append(f"  {'burn rule':<28} {'state':<9} {'fast':>10} "
+                     f"{'slow':>10} {'factor':>7} {'fired':>6}")
+        for a in burns:
+            d = a.get("detail") or {}
+            lines.append(
+                f"  {a.get('rule', '?'):<28} {a.get('state', '?'):<9} "
+                f"{d.get('burn_fast', '-'):>10} "
+                f"{d.get('burn_slow', '-'):>10} "
+                f"{d.get('factor', '-'):>7} "
+                f"{a.get('fired_count', 0):>6}")
+
+    # --- per-worker trend table --------------------------------------------
+    workers = cluster.get("workers") or {}
+    if workers:
+        lines.append("")
+        lines.append(f"  {'worker':<16} {'st':<8} {'age':>5} "
+                     f"{'queue':>6} {'trend':<16} "
+                     f"{'mfu':>7} {'trend':<16} {'goodput':<16}")
+        for wid in sorted(workers):
+            w = workers[wid]
+            queue_trend = sparkline(
+                _series_values(tseries, "fleet_queue_depth", wid), 16)
+            mfu_vals = _series_values(tseries, "fleet_mfu", wid)
+            mfu_trend = sparkline(mfu_vals, 16)
+            goodput_trend = sparkline(
+                _series_values(tseries, "fleet_goodput_tokens_per_s",
+                               wid), 16)
+            age = w.get("last_seen_age_s")
+            stale = " STALE" if w.get("stale") else ""
+            lines.append(
+                f"  {wid:<16} {w.get('status', '?'):<8} "
+                f"{age if age is not None else '-':>5} "
+                f"{w.get('queue_length', 0):>6} {queue_trend:<16} "
+                f"{(round(mfu_vals[-1], 4) if mfu_vals else '-'):>7} "
+                f"{mfu_trend:<16} {goodput_trend:<16}{stale}")
+
+    # --- biggest movers ----------------------------------------------------
+    movers = ranked_movers(tseries.get("series") or {}, 8)
+    if movers:
+        lines.append("")
+        lines.append("biggest movers (/timeseries):")
+        for key, values in movers:
+            lines.append(f"  {key:<44} {sparkline(values, 20):<20} "
+                         f"{values[0]:.6g} -> {values[-1]:.6g}")
+
+    recent = (alerts.get("log") or [])[-5:]
+    if recent:
+        lines.append("")
+        lines.append("recent alert transitions:")
+        for e in recent:
+            lines.append(f"  {_fmt_age(e.get('at'), now):>6} ago  "
+                         f"{e.get('rule', '?'):<28} "
+                         f"{e.get('from', '?')} -> {e.get('to', '?')}")
+    if not (workers or rows or tseries.get("series")):
+        lines.append("(nothing to watch yet — no /cluster, /alerts, or "
+                     "/timeseries data at this address)")
+    return "\n".join(lines)
+
+
+def render_once(base_url: str) -> str:
+    return render_dashboard(_fetch(base_url, "/cluster"),
+                            _fetch(base_url, "/alerts"),
+                            _fetch(base_url, "/timeseries"))
+
+
+def selfcheck() -> int:
+    """Render a synthetic fleet end to end; non-zero on any error —
+    keeps `python tools/_smoke.py` honest without a live fleet."""
+    now = 1000.0
+    cluster = {
+        "fleet": {"worker_count": 2, "crawl_workers": 1, "tpu_workers": 1},
+        "orchestrator": {"current_depth": 1, "active_work": 3,
+                         "completed_items": 40},
+        "workers": {
+            "tpu-1": {"worker_type": "tpu", "status": "busy",
+                      "last_seen_age_s": 1.0, "queue_length": 12},
+            "crawl-1": {"worker_type": "crawl", "status": "idle",
+                        "last_seen_age_s": 2.0, "queue_length": 0,
+                        "stale": True},
+        },
+    }
+    alerts = {
+        "firing": ["queue_wait_burn"],
+        "alerts": [
+            {"rule": "queue_wait_burn", "kind": "burn_rate",
+             "state": "firing", "since": now - 12, "value": 14.2,
+             "severity": "page", "fired_count": 2,
+             "detail": {"burn_fast": 14.2, "burn_slow": 7.1,
+                        "factor": 6.0}},
+            {"rule": "stale_worker", "kind": "threshold",
+             "state": "pending", "since": now - 2, "value": 1.0,
+             "severity": "page", "fired_count": 0, "detail": {}},
+            {"rule": "dlq_growth", "kind": "trend", "state": "inactive",
+             "since": 0, "value": None, "severity": "ticket",
+             "fired_count": 0, "detail": {}},
+        ],
+        "log": [{"rule": "queue_wait_burn", "from": "pending",
+                 "to": "firing", "at": now - 12}],
+    }
+    tseries = {"series": {
+        "fleet_queue_depth{worker=tpu-1}": {
+            "name": "fleet_queue_depth", "labels": {"worker": "tpu-1"},
+            "samples": [[now - 30 + i, float(i)] for i in range(30)]},
+        "fleet_mfu{worker=tpu-1}": {
+            "name": "fleet_mfu", "labels": {"worker": "tpu-1"},
+            "samples": [[now - 10, 0.30], [now - 5, 0.31],
+                        [now, 0.28]]},
+        "fleet_goodput_tokens_per_s{worker=tpu-1}": {
+            "name": "fleet_goodput_tokens_per_s",
+            "labels": {"worker": "tpu-1"},
+            "samples": [[now - 10, 1000.0], [now, 900.0]]},
+    }}
+    out = render_dashboard(cluster, alerts, tseries, now=now)
+    assert "FIRING" in out and "queue_wait_burn" in out, out
+    assert "tpu-1" in out and "crawl-1" in out and "STALE" in out, out
+    assert "burn rule" in out and "14.2" in out, out
+    assert "biggest movers" in out and "fleet_queue_depth" in out, out
+    assert "0.28" in out, out  # latest MFU next to its trend cell
+    empty = render_dashboard(None, None, None, now=now)
+    assert "nothing to watch" in empty, empty
+    print("watch selfcheck ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="live one-page fleet dashboard from /alerts + "
+                    "/timeseries + /cluster")
+    p.add_argument("source", nargs="?", default="",
+                   help="metrics-server base URL (e.g. "
+                        "http://127.0.0.1:9102)")
+    p.add_argument("--once", action="store_true",
+                   help="render one page and exit (no refresh loop)")
+    p.add_argument("--interval", type=float, default=REFRESH_S,
+                   help=f"refresh seconds in live mode "
+                        f"(default {REFRESH_S})")
+    p.add_argument("--selfcheck", action="store_true",
+                   help="render synthetic data and exit (CI smoke)")
+    args = p.parse_args(argv)
+
+    if args.selfcheck:
+        return selfcheck()
+    if not args.source:
+        p.error("source required (metrics-server base URL)")
+    if args.once:
+        print(render_once(args.source))
+        return 0
+    try:
+        while True:
+            page = render_once(args.source)
+            # ANSI clear + home, like `watch(1)`.
+            sys.stdout.write("\x1b[2J\x1b[H" + page + "\n")
+            sys.stdout.flush()
+            time.sleep(max(0.2, args.interval))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
