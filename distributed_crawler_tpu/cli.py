@@ -213,6 +213,40 @@ def build_parser() -> argparse.ArgumentParser:
            "default pack (queue_wait_burn, batch_age_burn, "
            "per_chip_goodput_collapse, dlq_growth, outbox_near_full, "
            "stale_worker — docs/operations.md \"Watchtower\")")
+    # Elastic fleet (orchestrator mode; docs/operations.md "Elastic fleet
+    # & autoscaling"): an alert-actuated autoscaler that spawns/retires
+    # `--mode tpu-worker` child processes against the watchtower's firing
+    # alerts, flight-recorded and served at /autoscaler.
+    a("--autoscaler", action="store_const", const=True, default=None,
+      help="run the elastic-fleet autoscaler beside the orchestrator: "
+           "firing watchtower alerts scale a pool of tpu-worker child "
+           "processes up, sustained headroom scales it back down "
+           "(requires --bus-address so children can dial the broker)")
+    a("--autoscaler-pools", default=None,
+      help="full pool-policy list: inline JSON list or @path/to/"
+           "pools.json (fields: pool, min_workers, max_workers, "
+           "scale_up_alerts, up/down_cooldown_s, stabilization_s, "
+           "trend_series/trend_slope_per_s, headroom_series/"
+           "headroom_below); overrides the single-pool knobs below")
+    a("--autoscaler-min", type=int, default=None,
+      help="single-pool shortcut: minimum tpu-worker children "
+           "(default 1)")
+    a("--autoscaler-max", type=int, default=None,
+      help="single-pool shortcut: maximum tpu-worker children "
+           "(default 4)")
+    a("--autoscaler-up-cooldown", type=float, default=None,
+      help="seconds between scale-up steps (default 30)")
+    a("--autoscaler-down-cooldown", type=float, default=None,
+      help="seconds between scale-down steps (default 60)")
+    a("--autoscaler-stabilization", type=float, default=None,
+      help="seconds of sustained headroom required before any "
+           "scale-down (default 30)")
+    a("--autoscaler-eval-interval", type=float, default=None,
+      help="seconds between autoscaler control passes (default 5)")
+    a("--autoscaler-worker-args", default=None,
+      help="extra CLI args appended to every spawned tpu-worker child, "
+           'e.g. "--infer-model xlmr --metrics-port 0" (the bus address '
+           "and a generated --worker-id are supplied automatically)")
     # Load harness (`python -m tools.loadtest`; loadgen/).  These keys
     # configure the synthetic workload + SLO gate; the crawl/worker modes
     # ignore them, but they resolve through the same precedence chain so
@@ -504,6 +538,15 @@ _KEY_MAP = {
     "timeseries_window": "observability.timeseries_window_s",
     "timeseries_max_samples": "observability.timeseries_max_samples",
     "alert_rules": "observability.alert_rules",
+    "autoscaler": "autoscaler.enabled",
+    "autoscaler_pools": "autoscaler.pools",
+    "autoscaler_min": "autoscaler.min_workers",
+    "autoscaler_max": "autoscaler.max_workers",
+    "autoscaler_up_cooldown": "autoscaler.up_cooldown_s",
+    "autoscaler_down_cooldown": "autoscaler.down_cooldown_s",
+    "autoscaler_stabilization": "autoscaler.stabilization_s",
+    "autoscaler_eval_interval": "autoscaler.eval_interval_s",
+    "autoscaler_worker_args": "autoscaler.worker_args",
     "loadgen_scenario": "loadgen.scenario",
     "loadgen_seed": "loadgen.seed",
     "loadgen_duration_s": "loadgen.duration_s",
@@ -978,6 +1021,80 @@ def _alert_rules(r: "ConfigResolver"):
         raise CliConfigError(f"bad alert rule: {e}")
 
 
+def _build_autoscaler(r: "ConfigResolver", orch, bus):
+    """The elastic-fleet control plane for orchestrator mode
+    (`orchestrator/autoscaler.py`): pool policies from ``autoscaler.pools``
+    (JSON / ``@path``) or the single-pool shortcut knobs, actuated through
+    a `SubprocessSupervisor` spawning ``--mode tpu-worker`` children that
+    dial this orchestrator's broker.  Returns the started-but-not-ticking
+    Autoscaler (caller runs start()/stop()), or None when disabled."""
+    import json as _json
+    import shlex as _shlex
+
+    if not r.get_bool("autoscaler.enabled", False):
+        return None
+    bus_address = r.get_str("distributed.bus_address")
+    if not bus_address:
+        raise CliConfigError(
+            "--autoscaler requires --bus-address (spawned workers must "
+            "be able to dial the broker this orchestrator hosts)")
+    from .orchestrator.autoscaler import (
+        Autoscaler,
+        PoolPolicy,
+        SubprocessSupervisor,
+        default_subprocess_argv,
+        pools_from_config,
+    )
+
+    raw = r.get("autoscaler.pools")
+    if isinstance(raw, str) and raw:
+        if raw.startswith("@"):
+            try:
+                with open(raw[1:], "r", encoding="utf-8") as f:
+                    raw = f.read()
+            except OSError as e:
+                raise CliConfigError(
+                    f"cannot read --autoscaler-pools file: {e}")
+        try:
+            raw = _json.loads(raw)
+        except ValueError as e:
+            raise CliConfigError(
+                f"--autoscaler-pools is not valid JSON: {e}")
+    try:
+        pools = pools_from_config(raw or None)
+        if not pools:
+            pools = [PoolPolicy(
+                pool="tpu",
+                min_workers=r.get_int("autoscaler.min_workers", 1),
+                max_workers=r.get_int("autoscaler.max_workers", 4),
+                up_cooldown_s=r.get_float("autoscaler.up_cooldown_s",
+                                          30.0),
+                down_cooldown_s=r.get_float("autoscaler.down_cooldown_s",
+                                            60.0),
+                stabilization_s=r.get_float("autoscaler.stabilization_s",
+                                            30.0))]
+            pools[0].validate()
+    except ValueError as e:
+        raise CliConfigError(f"bad autoscaler pool: {e}")
+    extra = _shlex.split(r.get_str("autoscaler.worker_args", ""))
+    supervisor = SubprocessSupervisor({
+        p.pool: default_subprocess_argv(p.pool, bus_address,
+                                        extra_args=extra)
+        for p in pools})
+    autoscaler = Autoscaler(
+        supervisor, pools,
+        eval_interval_s=r.get_float("autoscaler.eval_interval_s", 5.0),
+        alerts_fn=orch.get_alerts)
+    # The bus seam too: a remote autoscaler would subscribe exactly like
+    # this (the in-process alerts_fn read stays authoritative).
+    try:
+        autoscaler.attach_bus(bus)
+    except Exception as e:
+        logger.warning("autoscaler TOPIC_ALERTS subscription failed: %s",
+                       e)
+    return autoscaler
+
+
 class CliConfigError(ValueError):
     """A user-fixable configuration error raised by a mode runner; main()
     reports it as `error: …` (exit 2) instead of a traceback.  Keep this
@@ -1268,6 +1385,7 @@ def _run_orchestrator(urls: List[str], cfg: CrawlerConfig,
                         alert_rules=_alert_rules(r))
     from .utils.metrics import (
         set_alerts_provider,
+        set_autoscaler_provider,
         set_cluster_provider,
         set_dtraces_provider,
         set_status_provider,
@@ -1276,11 +1394,29 @@ def _run_orchestrator(urls: List[str], cfg: CrawlerConfig,
     set_cluster_provider(orch.get_cluster)  # /cluster fleet view
     set_dtraces_provider(orch.get_dtraces)  # /dtraces distributed traces
     set_alerts_provider(orch.get_alerts)  # /alerts watchtower surface
+    # Elastic fleet (--autoscaler): alert-actuated tpu-worker children
+    # against this broker, decisions served at /autoscaler.
+    autoscaler = _build_autoscaler(r, orch, bus)
+    if autoscaler is not None:
+        set_autoscaler_provider(autoscaler.snapshot)
     orch.start(urls, fresh=r.get_bool("orchestrator.fresh", False))
+    if autoscaler is not None:
+        autoscaler.start()
     try:
         _serve_forever(
             running=lambda: orch.is_running and not orch.crawl_completed)
     finally:
+        if autoscaler is not None:
+            # Stop the control loop, then retire every child through the
+            # graceful SIGTERM path (their un-acked frames requeue into
+            # the broker's spool/queues before it drains below).
+            autoscaler.stop()
+            try:
+                autoscaler.supervisor.stop_all()
+            except Exception as e:
+                logger.warning("autoscaler child teardown failed: %s", e)
+            from .utils.metrics import clear_autoscaler_provider
+            clear_autoscaler_provider(autoscaler.snapshot)
         orch.stop()
         # This process hosts the broker: exiting the moment the crawl
         # completes would take undelivered frames (e.g. inference batches

@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..bus.codec import RecordBatch
 from ..bus.messages import (
     MSG_HEARTBEAT,
+    MSG_WORKER_STOPPING,
     TOPIC_INFERENCE_BATCHES,
     TOPIC_INFERENCE_RESULTS,
     TOPIC_SPANS,
@@ -33,6 +34,7 @@ from ..bus.messages import (
     StatusMessage,
     WORKER_BUSY,
     WORKER_IDLE,
+    WORKER_OFFLINE,
 )
 from ..utils import flight, profiling, trace
 from ..utils.occupancy import QueueDepthSampler
@@ -217,6 +219,8 @@ class TPUWorker:
         self._processed = 0
         self._errors = 0
         self._metrics_server = None
+        self._killed = False
+        self._stop_announced = False
         self._step_started: Optional[float] = None   # monotonic, while in-step
         self._stall_warned = False
         self._watchdog_started = False
@@ -366,6 +370,12 @@ class TPUWorker:
             # Graceful stop ships the span tail (kill() deliberately
             # doesn't — a crashed process exports nothing).
             self.export_spans()
+        # Announce the clean shutdown so the fleet view marks this worker
+        # OFFLINE instead of letting it age into "stale" (an autoscaler
+        # retiring a worker must not trip the stale_worker alert minutes
+        # later).  Graceful stops only — kill() stays silent, the way a
+        # SIGKILLed process sends nothing.
+        self._announce_stopping()
         if self.provider is not None:
             flush = getattr(self.provider, "flush", None)
             if callable(flush):
@@ -385,6 +395,7 @@ class TPUWorker:
         RemoteBus to tear the pull stream down); the /status and /costs
         providers are left registered, exactly as a dead process leaves
         its endpoints unreachable rather than deregistered."""
+        self._killed = True
         self._stop.set()
         flight.record("worker_kill", worker=self.cfg.worker_id,
                       queue_depth=self._queue.qsize(),
@@ -392,6 +403,26 @@ class TPUWorker:
         for t in self._threads:
             t.join(timeout=2.0)
         self._threads.clear()
+
+    def _announce_stopping(self) -> None:
+        """Best-effort worker_stopping status on graceful stop (the
+        CrawlWorker discipline): the fleet view maps it to OFFLINE, so a
+        retired worker is "cleanly gone", never "stale".  Idempotent —
+        gate teardown may stop a handle twice — and silent after kill()
+        (SIGKILL fidelity)."""
+        if self._killed or self._stop_announced:
+            return
+        self._stop_announced = True
+        try:
+            self.bus.publish(TOPIC_WORKER_STATUS, StatusMessage.new(
+                self.cfg.worker_id, MSG_WORKER_STOPPING, WORKER_OFFLINE,
+                tasks_processed=self._processed,
+                tasks_success=self._processed - self._errors,
+                tasks_error=self._errors,
+                uptime_s=time.monotonic() - self._started_at,
+                worker_type="tpu").to_dict())
+        except Exception as e:  # a dead bus must not break shutdown
+            logger.debug("stopping announcement failed: %s", e)
 
     def evaluate_slos(self) -> list:
         """One SLO evaluation tick on demand (the heartbeat loop's twin):

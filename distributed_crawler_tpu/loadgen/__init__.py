@@ -40,6 +40,7 @@ from .gate import (
     run_asr_scenario,
     run_scenario,
     scenario_names,
+    validate_gate_config,
 )
 
 __all__ = [
@@ -60,4 +61,5 @@ __all__ = [
     "run_scenario",
     "run_asr_scenario",
     "scenario_names",
+    "validate_gate_config",
 ]

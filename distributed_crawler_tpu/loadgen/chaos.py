@@ -12,6 +12,9 @@ to an offset from scenario start:
     from=5s..6s delay bus 200ms   # every inference publish +200ms
     from=5s..6s drop bus          # inference publishes dropped
     at=2s poison batch            # next batch's records undecodable
+    at=1s flood network 1s        # crawl-side FLOOD_WAIT burst (1s
+                                  # retry-after hints; the gate's sim-
+                                  # network handle)
 
 Kill/restart/down apply to ANY registered target with ``kill()`` /
 ``restart()`` — including the ``orchestrator`` handle the gate registers,
@@ -75,7 +78,15 @@ _ACTIONS = {
     "delay": (True, True, True),     # target is the literal word "bus"
     "drop": (True, True, False),     # target is the literal word "bus"
     "poison": (False, True, False),  # target is the literal word "batch"
+    # Crawl-side rate-limit storm: the target handle injects a burst of
+    # FLOOD_WAIT errors (retry_after = the duration arg) into the sim
+    # backend — the reference's defining failure mode, driven through
+    # the resilience layer's server-directed-backoff hints.
+    "flood": (False, True, True),
 }
+
+# Actions resolved against a registered target handle (vs the ChaosBus).
+_TARGET_ACTIONS = ("kill", "restart", "down", "stall", "wedge", "flood")
 
 
 def parse_duration_s(text: str) -> float:
@@ -350,12 +361,14 @@ class ChaosController:
                  targets: Optional[Dict[str, Any]] = None,
                  bus: Optional[ChaosBus] = None,
                  publish_bus=None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 dynamic_targets: bool = False):
         self.timeline = list(timeline)
         self.targets = dict(targets or {})
         self.bus = bus
         self.publish_bus = publish_bus
         self.clock = clock
+        self.dynamic_targets = dynamic_targets
         self.events: List[Dict[str, Any]] = []
         self._applied: set = set()
         self._unwound: set = set()
@@ -364,12 +377,24 @@ class ChaosController:
         self._stop = threading.Event()
         self._lock = threading.Lock()
         for f in self.timeline:
-            if f.action in ("kill", "restart", "down", "stall", "wedge") \
-                    and targets is not None and f.target not in self.targets:
+            if f.action in _TARGET_ACTIONS and targets is not None \
+                    and f.target not in self.targets \
+                    and not dynamic_targets:
+                # With an elastic fleet (``dynamic_targets``) a timeline
+                # may name a worker the autoscaler has not spawned yet —
+                # the fault errors at APPLY time if it still doesn't
+                # exist; static fleets keep the loud config-time check.
                 raise ValueError(f"chaos fault {f.raw!r} names unknown "
                                  f"target {f.target!r}")
             if f.action in ("delay", "drop", "poison") and bus is None:
                 raise ValueError(f"chaos fault {f.raw!r} needs a ChaosBus")
+
+    def register_target(self, name: str, handle: Any) -> None:
+        """Register (or replace) a fault target mid-run — how autoscaler-
+        spawned workers become valid chaos targets the moment they
+        exist."""
+        with self._lock:
+            self.targets[name] = handle
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -441,6 +466,10 @@ class ChaosController:
     def _apply(self, i: int, f: Fault) -> None:
         logger.warning("chaos: applying %s", f.raw)
         try:
+            if f.action in _TARGET_ACTIONS and f.target not in self.targets:
+                raise KeyError(
+                    f"target {f.target!r} does not exist (not spawned "
+                    f"yet, or already retired)")
             if f.action in ("kill", "down"):
                 self.targets[f.target].kill()
             elif f.action == "restart":
@@ -449,6 +478,8 @@ class ChaosController:
                 self.targets[f.target].stall(f.arg_s or 0.0)
             elif f.action == "wedge":
                 self.targets[f.target].stall((f.until_s or 0.0) - f.at_s)
+            elif f.action == "flood":
+                self.targets[f.target].flood(f.arg_s or 1.0)
             elif f.action == "delay":
                 self.bus.set_delay(f.arg_s or 0.0)
             elif f.action == "drop":

@@ -82,8 +82,115 @@ _WORKER_KEYS = ("worker_id", "heartbeat_s", "queue_capacity",
                 "write_embeddings", "span_export_interval_s",
                 "span_export_max_spans", "span_sample_rate")
 _LOAD_KEYS = ("seed", "duration_s", "arrival", "rate_batches_per_s",
-              "ramp_from", "ramp_to", "ramp_batches", "records_per_batch",
-              "zipf_a", "max_words", "platform_mix", "crawl_id")
+              "rate_profile", "ramp_from", "ramp_to", "ramp_batches",
+              "records_per_batch", "zipf_a", "max_words", "platform_mix",
+              "crawl_id")
+
+# Every gate-envelope key either runner reads.  `validate_gate_config`
+# rejects anything else LOUDLY — a typo'd gate key would otherwise turn
+# an assertion into a silent no-op forever (tools/loadtest.py --smoke
+# runs this over EVERY checked-in scenario so new pack files can't
+# bit-rot).
+_GATE_KEYS_SHARED = frozenset({
+    "max_lost", "max_duplicates", "require_breach", "forbid_tail_breach",
+    "queue_wait_p95_ms", "require_flight",
+    "min_device_busy_fraction", "min_overlap_fraction", "max_bubble_share",
+    "min_dtrace_processes", "max_clock_skew_ms",
+})
+_GATE_KEYS_TEXT = _GATE_KEYS_SHARED | {
+    "batch_p95_ms", "goodput_min_posts_per_s", "orchestrator_reconcile",
+    "require_per_chip_devices", "min_per_chip_goodput_tokens_per_s",
+    "require_alert", "forbid_alert", "max_firing_after_recovery_s",
+    "min_timeseries_series", "max_unrouted",
+    # The elastic-fleet envelope (`orchestrator/autoscaler.py`).
+    "require_scale_event", "max_scale_events", "min_fleet_size",
+    "max_fleet_size", "max_time_to_converge_s",
+    "forbid_scale_down_in_fault", "fault_window",
+}
+_GATE_KEYS_ASR = _GATE_KEYS_SHARED | {
+    "max_transcript_errors", "reentry_required", "asr_batch_p95_ms",
+    "goodput_min_media_per_s", "require_whisper_costs",
+}
+
+
+_SCALE_DIRECTIONS = ("up", "down")
+_SCALE_PHASES = ("fault", "recovery", "any")
+
+
+def validate_gate_config(scenario: Dict[str, Any]) -> None:
+    """Reject unknown gate keys (and, transitively, malformed "alerts" /
+    "autoscaler" blocks and scale-event specs) at config time.  Called
+    by both runners and by ``tools/loadtest.py --smoke`` over every
+    checked-in scenario."""
+    name = scenario.get("name", "?")
+    gate_cfg = scenario.get("gate", {}) or {}
+    known = _GATE_KEYS_ASR if scenario.get("kind") == "asr" \
+        else _GATE_KEYS_TEXT
+    unknown = set(gate_cfg) - known
+    if unknown:
+        raise ValueError(
+            f"scenario {name!r}: unknown gate "
+            f"key(s) {', '.join(sorted(unknown))}")
+    # Value-shape checks for the structured elastic-fleet keys: a typo'd
+    # "during" phase would otherwise silently widen the assertion to
+    # "any" — the exact silent-no-op failure mode key validation exists
+    # to prevent.
+    for spec in gate_cfg.get("require_scale_event", []):
+        if isinstance(spec, str):
+            if spec not in _SCALE_DIRECTIONS:
+                raise ValueError(
+                    f"scenario {name!r}: require_scale_event entry "
+                    f"{spec!r} must be one of {_SCALE_DIRECTIONS}")
+            continue
+        if not isinstance(spec, dict):
+            raise ValueError(
+                f"scenario {name!r}: require_scale_event entries must "
+                f"be 'up'/'down' or objects, got {spec!r}")
+        bad = set(spec) - {"pool", "direction", "during"}
+        if bad:
+            raise ValueError(
+                f"scenario {name!r}: unknown require_scale_event "
+                f"key(s) {', '.join(sorted(bad))}")
+        if spec.get("direction", "up") not in _SCALE_DIRECTIONS:
+            raise ValueError(
+                f"scenario {name!r}: require_scale_event direction "
+                f"must be one of {_SCALE_DIRECTIONS}")
+        if spec.get("during", "any") not in _SCALE_PHASES:
+            raise ValueError(
+                f"scenario {name!r}: require_scale_event during must "
+                f"be one of {_SCALE_PHASES}")
+    window = gate_cfg.get("fault_window")
+    if window is not None:
+        if (not isinstance(window, (list, tuple)) or len(window) != 2
+                or not all(isinstance(v, (int, float)) for v in window)
+                or float(window[1]) <= float(window[0])):
+            raise ValueError(
+                f"scenario {name!r}: gate fault_window must be "
+                f"[start_s, end_s] with end > start, got {window!r}")
+    # The blocks the gate consumes alongside the envelope: parse them
+    # through their own loud validators.
+    rules_from_config(scenario.get("alerts"))
+    autoscaler_cfg = scenario.get("autoscaler") or {}
+    if autoscaler_cfg:
+        from ..orchestrator.autoscaler import pools_from_config
+
+        if scenario.get("kind") == "asr":
+            # Accept-and-ignore would break the loud-validation rule:
+            # the ASR runner has no autoscaler wiring (yet).
+            raise ValueError(
+                f"scenario {name!r}: \"autoscaler\" blocks are not "
+                f"supported on kind=asr scenarios (the ASR gate has no "
+                f"elastic-fleet wiring)")
+        extra = set(autoscaler_cfg) - {"pools", "eval_interval_s"}
+        if extra:
+            raise ValueError(
+                f"scenario {name!r}: unknown "
+                f"autoscaler key(s) {', '.join(sorted(extra))}")
+        pools = pools_from_config(autoscaler_cfg.get("pools"))
+        if not pools:
+            raise ValueError(
+                f"scenario {name!r}: an "
+                f"\"autoscaler\" block needs a non-empty pools list")
 
 
 def scenario_names() -> List[str]:
@@ -226,6 +333,121 @@ def _dtrace_checks(check, gate_cfg: Dict[str, Any],
         worst = max(offsets, default=0.0)
         check("clock_skew_ms", worst <= cap, round(worst, 3), f"<= {cap}")
     return {"assembled": len(traces), "multi_process": multi}
+
+
+def _autoscaler_checks(check, gate_cfg: Dict[str, Any],
+                       snapshot: Optional[Dict[str, Any]],
+                       decisions: List[Dict[str, Any]],
+                       fleet_size_0: int,
+                       fault_wall: "tuple[float, float]",
+                       converge_s: Optional[float]) -> Dict[str, Any]:
+    """The elastic-fleet envelope over the /autoscaler body + the
+    decision log (`orchestrator/autoscaler.py`):
+
+    - ``require_scale_event``: each entry — ``"up"``/``"down"`` or
+      ``{"pool":..., "direction":..., "during": "fault"|"recovery"|
+      "any"}`` — must match at least one recorded decision (``fault`` =
+      wall-stamped inside the load+chaos window, ``recovery`` = after);
+    - ``max_scale_events``: total decision cap (0 pins the autoscaler
+      QUIET — the steady-state assertion);
+    - ``min_fleet_size`` / ``max_fleet_size``: bounds on the actual
+      worker count over the run, from the decision log's
+      actual_before/after, the start/end sizes, AND the autoscaler's
+      per-tick ``autoscaler_actual_workers`` samples in the rolling
+      store (which also see dips a chaos kill causes between
+      decisions);
+    - ``forbid_scale_down_in_fault``: no down decision inside the fault
+      window (a fleet must never shrink INTO a breach);
+    - ``max_time_to_converge_s``: first scale-up decision → pools back
+      at their floor with zero alerts firing.
+
+    The fault window defaults to the whole load+chaos phase; a
+    ``fault_window: [start_s, end_s]`` gate key (offsets from load
+    start) narrows it to the actual surge/wedge — without it, a
+    flash-crowd whose spike subsides mid-phase would see its perfectly
+    legitimate post-spike scale-down land "in fault" on a slow host.
+    """
+    body = snapshot or {}
+    pools = body.get("pools") or {}
+    fault_t0, fault_t1 = fault_wall
+    declared = gate_cfg.get("fault_window")
+    if declared:
+        start_s, end_s = float(declared[0]), float(declared[1])
+        if end_s <= start_s:
+            raise ValueError("gate fault_window must be [start_s, end_s] "
+                             "with end > start")
+        fault_t0, fault_t1 = fault_t0 + start_s, fault_wall[0] + end_s
+
+    def _during(d: Dict[str, Any], phase: str) -> bool:
+        if phase == "fault":
+            return fault_t0 <= d["at"] <= fault_t1
+        if phase == "recovery":
+            return d["at"] > fault_t1
+        return True
+
+    for spec in gate_cfg.get("require_scale_event", []):
+        if isinstance(spec, str):
+            spec = {"direction": spec}
+        direction = spec.get("direction", "up")
+        pool = spec.get("pool")
+        during = spec.get("during", "any")
+        matches = [d for d in decisions
+                   if d["direction"] == direction
+                   and (pool is None or d["pool"] == pool)
+                   and _during(d, during)]
+        check(f"scale_event_{pool or 'any'}_{direction}_{during}",
+              bool(matches), len(matches),
+              f">= 1 {direction} decision ({during} window)")
+    if gate_cfg.get("max_scale_events") is not None:
+        cap = int(gate_cfg["max_scale_events"])
+        check("scale_events", len(decisions) <= cap, len(decisions),
+              f"<= {cap} decisions")
+    sizes = [fleet_size_0]
+    for d in decisions:
+        sizes.append(int(d.get("actual_before", fleet_size_0)))
+        if d.get("actual_after") is not None:
+            sizes.append(int(d["actual_after"]))
+    sizes.extend(int(p.get("actual", 0)) for p in pools.values())
+    # Per-tick actual-size samples (the autoscaler writes them into the
+    # run's rolling store every accepted tick): these see a chaos kill's
+    # dip even when no decision brackets it.  Pool-labeled children
+    # only — the registry self-sample also mirrors the bare gauge
+    # PARENT (value 0, no children yet) into the store, which is not a
+    # fleet size.
+    sizes.extend(
+        int(v) for labels, samples in
+        timeseries.STORE.matching("autoscaler_actual_workers")
+        if labels.get("pool") for _, v in samples)
+    if gate_cfg.get("min_fleet_size") is not None:
+        floor = int(gate_cfg["min_fleet_size"])
+        check("min_fleet_size", min(sizes) >= floor, min(sizes),
+              f">= {floor} workers at all times")
+    if gate_cfg.get("max_fleet_size") is not None:
+        cap = int(gate_cfg["max_fleet_size"])
+        check("max_fleet_size", max(sizes) <= cap, max(sizes),
+              f"<= {cap} workers at all times")
+    if gate_cfg.get("forbid_scale_down_in_fault"):
+        downs = [d for d in decisions if d["direction"] == "down"
+                 and _during(d, "fault")]
+        check("no_scale_down_in_fault", not downs, len(downs),
+              "0 down decisions inside the fault window")
+    if gate_cfg.get("max_time_to_converge_s") is not None:
+        budget = float(gate_cfg["max_time_to_converge_s"])
+        check("time_to_converge_s",
+              converge_s is not None and converge_s <= budget,
+              round(converge_s, 2) if converge_s is not None
+              else "never",
+              f"<= {budget}s from first scale-up to floor+quiet")
+    return {
+        "decisions": len(decisions),
+        "fleet_sizes": {"min": min(sizes), "max": max(sizes),
+                        "final": sizes[-1] if sizes else 0},
+        "converge_s": round(converge_s, 2)
+        if converge_s is not None else None,
+        "pools": {name: {k: p.get(k)
+                         for k in ("desired", "actual", "min", "max")}
+                  for name, p in pools.items()},
+    }
 
 
 class BusHandle:
@@ -464,6 +686,13 @@ class _ServingWorkerHandle:
         self.generation = 0
         self._dead = True  # no live generation until start()
 
+    @property
+    def alive(self) -> bool:
+        """Is there a live generation behind this handle — the liveness
+        read the autoscaler's `InProcessSupervisor` counts
+        (`supervisor.actual`)."""
+        return not self._dead and self.worker is not None
+
     def _make_worker(self, bus):
         raise NotImplementedError
 
@@ -533,6 +762,36 @@ class WorkerHandle(_ServingWorkerHandle):
 
     def stall(self, seconds: float) -> None:
         self._engine.block_for(seconds)
+
+
+class _SimNetworkHandle:
+    """The chaos controller's view of the simulated Telegram backend:
+    ``flood`` injects a burst of FLOOD_WAIT errors (with real
+    ``retry_after_s`` hints) into the hot crawl methods, so a
+    ``at=1s flood network 1s`` timeline line reproduces the reference's
+    defining failure mode — the resilience layer's server-directed
+    backoff (`utils/resilience.py`) must ride it out with zero loss."""
+
+    # FLOOD_WAITs injected per flood line: the history page reads take
+    # the brunt (the per-page hot path), the chat resolve a glancing
+    # hit.  Two queued history faults = one fetch exhausts its retry
+    # budget (fetch_attempts 2) and fails over to an orchestrator page
+    # retry — and every retried call pays the proactive rate-limiter
+    # wait again, which is why flood scenarios budget a generous
+    # drain_timeout_s.
+    BURST = (("GetChatHistory", 2), ("SearchPublicChat", 1))
+
+    def __init__(self, net):
+        self.net = net
+        self.floods = 0
+
+    def flood(self, retry_after_s: float) -> None:
+        seconds = max(1, int(round(retry_after_s)))
+        for method, count in self.BURST:
+            self.net.inject_flood_wait(method, seconds, count=count)
+        self.floods += 1
+        flight.record("flood_wait_storm", retry_after_s=seconds,
+                      methods=[m for m, _ in self.BURST])
 
 
 def _teardown(label: str, fn) -> None:
@@ -626,17 +885,22 @@ def run_scenario(scenario: Dict[str, Any],
     from ..utils.metrics import (
         MetricsRegistry,
         clear_alerts_provider,
+        clear_autoscaler_provider,
         clear_cluster_provider,
         clear_dlq_provider,
         clear_dtraces_provider,
         serve_metrics,
         set_alerts_provider,
+        set_autoscaler_provider,
         set_cluster_provider,
+        set_costs_provider,
         set_dlq_provider,
         set_dtraces_provider,
+        set_status_provider,
     )
 
     scenario = merge_overrides(scenario, overrides)
+    validate_gate_config(scenario)
     name = scenario.get("name", "unnamed")
     bus_kind = scenario.get("bus", "inmemory")
     if bus_kind not in ("inmemory", "grpc"):
@@ -649,6 +913,25 @@ def run_scenario(scenario: Dict[str, Any],
             "kill/restart faults need bus='grpc' (the in-memory bus has "
             "no competing-consumer requeue, so a killed worker's frames "
             "would be lost by construction)")
+    # Elastic-fleet block (`orchestrator/autoscaler.py`): the gate
+    # supervises exactly ONE pool — the TPU worker stack under test.
+    from ..orchestrator.autoscaler import (
+        Autoscaler,
+        InProcessSupervisor,
+        pools_from_config,
+    )
+
+    autoscaler_cfg = scenario.get("autoscaler") or {}
+    pool_policies = pools_from_config(autoscaler_cfg.get("pools"))
+    if autoscaler_cfg and len(pool_policies) != 1:
+        raise ValueError("the loadgen gate supervises exactly one "
+                         "autoscaler pool (the TPU worker stack)")
+    if pool_policies and pool_policies[0].max_workers > 1 \
+            and bus_kind != "grpc":
+        raise ValueError(
+            "an autoscaler pool with max_workers > 1 needs bus='grpc' "
+            "(the in-memory bus fans out — two workers would double-"
+            "process every batch)")
 
     load_cfg = LoadGenConfig(**{k: v
                                 for k, v in scenario.get("load", {}).items()
@@ -695,9 +978,10 @@ def run_scenario(scenario: Dict[str, Any],
             data=int(par.get("data", 0)), seq=int(par.get("seq", 1)),
             tensor=int(par.get("tensor", 1)),
             devices=int(par.get("devices", 0)))
-    engine = ChaosEngine(InferenceEngine(
+    base_engine = InferenceEngine(
         EngineConfig(**scenario.get("engine", {"model": "tiny"})),
-        mesh=mesh, registry=registry))
+        mesh=mesh, registry=registry)
+    engine = ChaosEngine(base_engine)
     provider = InMemoryStorageProvider()
     tmpdir = tempfile.mkdtemp(prefix="dct-loadgen-")
 
@@ -707,6 +991,9 @@ def run_scenario(scenario: Dict[str, Any],
     crawl_worker = None
     pool_installed = False
     handle = None
+    supervisor = None
+    autoscaler = None
+    autoscaler_provider = None
     http_server = None
     controller = None
     cluster_provider = None
@@ -771,15 +1058,24 @@ def run_scenario(scenario: Dict[str, Any],
                 worker_outbox = _outbox_cfg("worker")
                 make_worker_bus = lambda: RemoteBus(  # noqa: E731
                     addr, outbox=worker_outbox, registry=registry)
+                # Dynamic (autoscaler-spawned) workers each get their
+                # OWN outbox dir: two live workers sharing one spill WAL
+                # would corrupt each other's reload.
+                make_worker_bus_for = lambda wname: RemoteBus(  # noqa: E731
+                    addr, outbox=_outbox_cfg(f"worker-{wname}"),
+                    registry=registry)
                 dlq_provider = server.dlq_snapshot
                 set_dlq_provider(dlq_provider)
             else:
                 local_bus = server    # orchestrator + generator side
                 make_worker_bus = lambda: RemoteBus(addr)  # noqa: E731
+                make_worker_bus_for = \
+                    lambda wname: RemoteBus(addr)  # noqa: E731
         else:
             inner_bus = InMemoryBus(sync=True)
             local_bus = inner_bus
             make_worker_bus = lambda: inner_bus  # noqa: E731
+            make_worker_bus_for = lambda wname: inner_bus  # noqa: E731
         chaos_bus = ChaosBus(local_bus)
         # Register every fan-out topic this run publishes on: the worker's
         # result announcements and the controller's chaos announcements
@@ -879,12 +1175,90 @@ def run_scenario(scenario: Dict[str, Any],
             targets["bus"] = server
         if crawl_worker is not None:
             targets["crawl-1"] = crawl_worker
+        if crawl_leg:
+            # `flood network <retry_after>` lines reach the sim backend.
+            targets["network"] = _SimNetworkHandle(net)
         controller = ChaosController(timeline, targets=targets,
-                                     bus=chaos_bus, publish_bus=local_bus)
+                                     bus=chaos_bus, publish_bus=local_bus,
+                                     dynamic_targets=bool(pool_policies))
+
+        # --- elastic fleet (scenario "autoscaler" block) -------------------
+        # The supervisor owns EVERY worker handle (the scenario-start one
+        # included) so drains, SLO tick fan-out, chaos-target bookkeeping
+        # and teardown see one fleet, fixed or elastic.
+        pool_name = pool_policies[0].pool if pool_policies else "tpu"
+        spawn_seq = [0]
+
+        def _fleet_changed(pool: str, live_handles) -> None:
+            # A retire clears the retired worker's /status + /costs
+            # registrations; re-point the process-global seams at a
+            # survivor so the verdict's endpoint scrapes stay live.
+            if live_handles:
+                w = live_handles[0].worker
+                set_status_provider(w.get_status)
+                set_costs_provider(w.get_costs)
+
+        supervisor = InProcessSupervisor(
+            drain_timeout_s=min(10.0, drain_timeout_s),
+            on_change=_fleet_changed)
+
+        def _spawn_worker():
+            spawn_seq[0] += 1
+            wname = f"{worker_name}-as{spawn_seq[0]}"
+            # Each spawn gets its OWN chaos wrapper over the one warmed
+            # engine: compiled programs are shared (no mid-run compiles)
+            # but a `wedge tpu-1` brownout pins only tpu-1 — the spawned
+            # workers stay healthy, the way a new host would.
+            h = WorkerHandle(wname, lambda: make_worker_bus_for(wname),
+                             ChaosEngine(base_engine), provider,
+                             dict(worker_kw), registry)
+            h.start()  # shares the warmed engine: no fresh compiles
+            # The mid-run spawned worker is a first-class citizen: a
+            # valid chaos target, and its heartbeats/writebacks join the
+            # same fleet fold + reconciliation every fixed worker uses.
+            controller.register_target(wname, h)
+            return h
+
+        supervisor.add_pool(pool_name, _spawn_worker)
+        supervisor.attach(pool_name, handle)
+        autoscaler = None
+        if pool_policies:
+            autoscaler = Autoscaler(
+                supervisor, pool_policies, store=timeseries.STORE,
+                registry=registry,
+                eval_interval_s=float(
+                    autoscaler_cfg.get("eval_interval_s", 0.1)),
+                alerts_fn=orch_handle.get_alerts)
+            # Exercise the remote-control-plane seam too: firing/resolved
+            # AlertMessages on TOPIC_ALERTS reach observe_alert.
+            autoscaler.attach_bus(local_bus)
+            autoscaler_provider = autoscaler.snapshot
+            set_autoscaler_provider(autoscaler_provider)
+
+        def _fleet_tick(force: bool = False) -> None:
+            if autoscaler is not None:
+                autoscaler.tick(force=force)
+
+        def _fleet_workers():
+            workers = [h.worker for h in supervisor.live(pool_name)]
+            # A chaos-killed fleet (no live handles) still reports the
+            # primary so post-kill reads (drain returns, SLO ticks)
+            # resolve the way the single-worker gate always did.
+            return workers or ([handle.worker]
+                               if handle.worker is not None else [])
+
+        def _fleet_drain(timeout_s: float) -> bool:
+            return all(w.drain(timeout_s=timeout_s)
+                       for w in _fleet_workers())
+
+        def _fleet_evaluate_slos() -> None:
+            for w in _fleet_workers():
+                w.evaluate_slos()
 
         # --- phase A: baseline (flush the SLO window) ----------------------
-        handle.worker.evaluate_slos()
+        _fleet_evaluate_slos()
         breaches_0 = _breach_counts(registry)
+        fleet_size_0 = supervisor.actual(pool_name)
         # Per-rule fired-count baseline: require_alert judges the DELTA
         # over the load+chaos phase, so an alert carried over from
         # another source can never pass the chaos assertion vacuously.
@@ -895,13 +1269,16 @@ def run_scenario(scenario: Dict[str, Any],
         logger.info("loadgen %s: load phase starting (%s arrivals)",
                     name, load_cfg.arrival)
         t_b0 = time.monotonic()
+        t_b0_wall = time.time()
         stop = threading.Event()
         stats_box: Dict[str, Any] = {}
 
         def _pending() -> int:
-            status = handle.worker.get_status() if handle.worker else {}
-            n = int(status.get("queue_depth", 0)) \
-                + int(status.get("inflight", 0))
+            n = 0
+            for w in _fleet_workers():
+                status = w.get_status()
+                n += int(status.get("queue_depth", 0)) \
+                    + int(status.get("inflight", 0))
             if server is not None:
                 n += server.pending_count(TOPIC_INFERENCE_BATCHES)
             if local_outbox is not None:
@@ -916,9 +1293,10 @@ def run_scenario(scenario: Dict[str, Any],
             until the flusher lands it."""
             if local_outbox is not None:
                 local_outbox.outbox.drain(timeout_s=timeout_s)
-            worker_bus_outbox = getattr(handle.bus, "outbox", None)
-            if worker_bus_outbox is not None:
-                worker_bus_outbox.drain(timeout_s=timeout_s)
+            for h in supervisor.handles(pool_name):
+                worker_bus_outbox = getattr(h.bus, "outbox", None)
+                if worker_bus_outbox is not None:
+                    worker_bus_outbox.drain(timeout_s=timeout_s)
 
         def _gen():
             stats_box["stats"] = workload.run(
@@ -930,6 +1308,7 @@ def run_scenario(scenario: Dict[str, Any],
         gen_thread.start()
         while gen_thread.is_alive():
             orch_handle.tick()
+            _fleet_tick()
             time.sleep(0.02)
         gen_thread.join()
         # Let the timeline finish (e.g. a restart scheduled after the
@@ -938,6 +1317,7 @@ def run_scenario(scenario: Dict[str, Any],
         deadline = time.monotonic() + drain_timeout_s
         while not controller.done() and time.monotonic() < deadline:
             orch_handle.tick()
+            _fleet_tick()
             time.sleep(0.02)
         controller.stop()
         if crawl_leg:
@@ -946,6 +1326,7 @@ def run_scenario(scenario: Dict[str, Any],
             deadline = time.monotonic() + drain_timeout_s
             while time.monotonic() < deadline:
                 orch_handle.tick()
+                _fleet_tick()
                 o = orch_handle.orch
                 if o is not None and o.crawl_completed:
                     break
@@ -953,8 +1334,8 @@ def run_scenario(scenario: Dict[str, Any],
         _flush_outboxes(drain_timeout_s)
         if server is not None:
             server.drain(timeout_s=drain_timeout_s)
-        drained = handle.worker.drain(timeout_s=drain_timeout_s)
-        handle.worker.evaluate_slos()
+        drained = _fleet_drain(drain_timeout_s)
+        _fleet_evaluate_slos()
         orch_handle.check_worker_health()
         breaches_fault = _delta(_breach_counts(registry), breaches_0)
         # Close the fault window on the ALERT surface deterministically:
@@ -978,6 +1359,9 @@ def run_scenario(scenario: Dict[str, Any],
             orch_handle.watchtower_tick(force=True)
         alerts_fault = orch_handle.get_alerts()
         t_b1 = time.monotonic()
+        t_b1_wall = time.time()  # fault-window close on the WALL clock
+        # (scale decisions are wall-stamped; the during="fault" checks
+        # and forbid_scale_down_in_fault judge against this window)
 
         # --- phase C: recovery tail ---------------------------------------
         tail_cfg = scenario.get("tail", {})
@@ -999,8 +1383,8 @@ def run_scenario(scenario: Dict[str, Any],
         _flush_outboxes(drain_timeout_s)
         if server is not None:
             server.drain(timeout_s=drain_timeout_s)
-        tail_drained = handle.worker.drain(timeout_s=drain_timeout_s)
-        handle.worker.evaluate_slos()
+        tail_drained = _fleet_drain(drain_timeout_s)
+        _fleet_evaluate_slos()
         breaches_tail = _delta(_breach_counts(registry), breaches_mid)
         # Alert recovery: chaos-fired alerts must RESOLVE once the fault
         # is gone — tick (bounded by max_firing_after_recovery_s) until
@@ -1015,16 +1399,59 @@ def run_scenario(scenario: Dict[str, Any],
                 time.monotonic() - t_resolve0 < resolve_budget_s:
             time.sleep(0.05)
             orch_handle.watchtower_tick(force=True)
+            _fleet_tick(force=True)
         resolve_wait_s = time.monotonic() - t_resolve0
+        # Fleet convergence: with an autoscaler in the loop the run is
+        # not over until the pool has scaled BACK DOWN to its floor with
+        # nothing firing — headroom must hold a full stabilization
+        # window and each step pays its down-cooldown, so this settle is
+        # part of the scenario's envelope (max_time_to_converge_s), not
+        # slack.  Convergence time is measured from the FIRST scale-up
+        # decision (wall clock, like the decisions themselves).
+        converge_s = None
+        if autoscaler is not None:
+            first_up_wall = min(
+                (d["at"] for d in autoscaler.decisions()
+                 if d["direction"] == "up"), default=None)
+
+            def _fleet_converged() -> bool:
+                snap = autoscaler.snapshot()
+                pools_ok = all(
+                    p["actual"] == p["min"] and p["desired"] == p["min"]
+                    for p in snap["pools"].values())
+                return pools_ok \
+                    and not orch_handle.get_alerts().get("firing")
+
+            converge_budget_s = float(
+                gate_cfg.get("max_time_to_converge_s", 0.0)) or 10.0
+            t_converge0 = time.monotonic()
+            while time.monotonic() - t_converge0 < converge_budget_s:
+                orch_handle.watchtower_tick(force=True)
+                _fleet_tick(force=True)
+                if _fleet_converged():
+                    break
+                time.sleep(0.05)
+            # Re-read AFTER the settle: a late-confirming alert can
+            # produce its first scale-up inside the loop above, and that
+            # decision must start the convergence clock — not be waved
+            # through as "nothing ever scaled".
+            first_up_wall = min(
+                (d["at"] for d in autoscaler.decisions()
+                 if d["direction"] == "up"), default=first_up_wall)
+            if first_up_wall is None:
+                converge_s = 0.0  # nothing ever scaled: trivially there
+            elif _fleet_converged():
+                converge_s = time.time() - first_up_wall
         t_end = time.monotonic()
 
         # --- measurement ---------------------------------------------------
         # Flush the span tail deterministically before reading /dtraces:
-        # the worker's interval-driven export may not have fired since
+        # the workers' interval-driven exports may not have fired since
         # the last batch landed.
-        export_fn = getattr(handle.worker, "export_spans", None)
-        if callable(export_fn):
-            export_fn()
+        for w in _fleet_workers():
+            export_fn = getattr(w, "export_spans", None)
+            if callable(export_fn):
+                export_fn()
         spans = trace.TRACER.spans()
         tail_queue_p95 = _p95_ms(spans, QUEUE_WAIT_SPANS, t_tail_wall)
         tail_batch_p95 = _p95_ms(spans, BATCH_SPANS, t_tail_wall)
@@ -1040,6 +1467,9 @@ def run_scenario(scenario: Dict[str, Any],
         }
         if durable:
             endpoints["dlq"] = _scrape(port, "/dlq", as_json=True)
+        if autoscaler is not None:
+            endpoints["autoscaler"] = _scrape(port, "/autoscaler",
+                                              as_json=True)
 
         expected = chaos_bus.expected_uids()
         crawl_ids = {load_cfg.crawl_id, crawler_cfg.crawl_id}
@@ -1131,6 +1561,15 @@ def run_scenario(scenario: Dict[str, Any],
         per_chip = _per_chip_checks(check, gate_cfg, endpoints["costs"])
         dtrace_summary = _dtrace_checks(check, gate_cfg,
                                         endpoints["dtraces"])
+        fleet_summary = None
+        if autoscaler is not None:
+            fleet_summary = _autoscaler_checks(
+                check, gate_cfg,
+                endpoints.get("autoscaler") or autoscaler.snapshot(),
+                autoscaler.decisions(), fleet_size_0,
+                (t_b0_wall, t_b1_wall), converge_s)
+            fleet_summary["spawned"] = dict(supervisor.spawned)
+            fleet_summary["retired"] = dict(supervisor.retired)
         # Alert envelope: require_alert rules must have fired DURING the
         # fault window (the post-drain snapshot) and be resolved by
         # verdict time; forbid_alert rules must never have fired; with a
@@ -1202,6 +1641,8 @@ def run_scenario(scenario: Dict[str, Any],
                          "alerts", "timeseries"]
         if durable:
             endpoint_keys.append("dlq")
+        if autoscaler is not None:
+            endpoint_keys.append("autoscaler")
         for key in endpoint_keys:
             check(f"endpoint_{key}", endpoints[key] is not None,
                   endpoints[key] is not None, True)
@@ -1232,6 +1673,7 @@ def run_scenario(scenario: Dict[str, Any],
             "fault_window_s": round(t_b1 - t_b0, 2),
             "chaos_events": len(controller.events),
             "worker_generations": handle.generation,
+            "autoscaler": fleet_summary,
             "bus_generations": bus_detail["generations"],
             "bus_broker": bus_detail,
             "orchestrator": orch_detail,
@@ -1260,7 +1702,14 @@ def run_scenario(scenario: Dict[str, Any],
     finally:
         if controller is not None:
             _teardown("controller", controller.stop)
-        if handle is not None:
+        if supervisor is not None:
+            # The whole fleet, dynamic spawns included (retired handles
+            # already left the pool at retire time).  Dead (chaos-killed)
+            # handles are stopped too: stop() after kill() clears the
+            # process-global provider seams the kill deliberately left.
+            for h in supervisor.handles():
+                _teardown(h.name, h.stop)
+        elif handle is not None:
             _teardown("tpu-worker", handle.stop)
         if crawl_worker is not None:
             _teardown("crawl-worker", crawl_worker.stop)
@@ -1275,6 +1724,10 @@ def run_scenario(scenario: Dict[str, Any],
         if alerts_provider is not None:
             _teardown("alerts-provider",
                       lambda: clear_alerts_provider(alerts_provider))
+        if autoscaler_provider is not None:
+            _teardown("autoscaler-provider",
+                      lambda: clear_autoscaler_provider(
+                          autoscaler_provider))
         if dlq_provider is not None:
             _teardown("dlq-provider",
                       lambda: clear_dlq_provider(dlq_provider))
@@ -1391,6 +1844,7 @@ def run_asr_scenario(scenario: Dict[str, Any],
     )
 
     scenario = merge_overrides(scenario, overrides)
+    validate_gate_config(scenario)
     name = scenario.get("name", "unnamed-asr")
     bus_kind = scenario.get("bus", "inmemory")
     if bus_kind not in ("inmemory", "grpc"):

@@ -84,6 +84,12 @@ class LoadGenConfig:
     duration_s: float = 5.0
     arrival: str = "poisson"            # poisson | ramp
     rate_batches_per_s: float = 10.0    # poisson
+    # Piecewise-constant Poisson rate: [[t_s, rate], ...] breakpoints
+    # (ascending t; rate_batches_per_s applies before the first one).
+    # This is the hostile-traffic shape source — a flash crowd is a
+    # single 10x step, a diurnal cycle is a staircase up and back down —
+    # still fully seeded: the same seed reproduces the same arrivals.
+    rate_profile: List[Any] = field(default_factory=list)
     ramp_from: int = 1                  # ramp: starting concurrency window
     ramp_to: int = 8                    # ramp: final concurrency window
     ramp_batches: int = 50              # ramp: total batches to offer
@@ -101,6 +107,25 @@ class LoadGenConfig:
             raise ValueError("duration_s must be positive")
         if self.arrival == "poisson" and self.rate_batches_per_s <= 0:
             raise ValueError("rate_batches_per_s must be positive")
+        if self.rate_profile:
+            if self.arrival != "poisson":
+                raise ValueError("rate_profile applies to poisson "
+                                 "arrivals only")
+            prev_t = -1.0
+            for bp in self.rate_profile:
+                if (not isinstance(bp, (list, tuple)) or len(bp) != 2
+                        or not all(isinstance(v, (int, float))
+                                   for v in bp)):
+                    raise ValueError(
+                        f"rate_profile entries must be [t_s, rate] "
+                        f"pairs, got {bp!r}")
+                t, rate = float(bp[0]), float(bp[1])
+                if t < 0 or t <= prev_t:
+                    raise ValueError("rate_profile breakpoints must be "
+                                     "ascending and non-negative")
+                if rate <= 0:
+                    raise ValueError("rate_profile rates must be positive")
+                prev_t = t
         bad = set(self.platform_mix) - set(VALID_PLATFORMS)
         if bad:
             raise ValueError(f"platform_mix names unknown platforms: "
@@ -108,6 +133,17 @@ class LoadGenConfig:
         if not self.platform_mix or \
                 sum(self.platform_mix.values()) <= 0:
             raise ValueError("platform_mix must have positive weight")
+
+    def rate_at(self, t_s: float) -> float:
+        """The offered Poisson rate at offset ``t_s`` (the last
+        breakpoint at or before it; the base rate before the first)."""
+        rate = self.rate_batches_per_s
+        for bp_t, bp_rate in self.rate_profile:
+            if t_s >= float(bp_t):
+                rate = float(bp_rate)
+            else:
+                break
+        return rate
 
 
 @dataclass
@@ -239,7 +275,11 @@ class SyntheticWorkload(_WorkloadBase):
             t = 0.0
             i = 0
             while True:
-                t += rng.expovariate(self.cfg.rate_batches_per_s)
+                # Non-homogeneous Poisson via piecewise-constant rate:
+                # the gap out of ``t`` is drawn at the rate in force AT
+                # ``t`` — a coarse but fully-seeded thinning stand-in
+                # (breakpoint windows are long against the mean gap).
+                t += rng.expovariate(self.cfg.rate_at(t))
                 if t >= self.cfg.duration_s:
                     break
                 out.append(PlannedBatch(i, round(t, 6),

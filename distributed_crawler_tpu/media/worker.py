@@ -39,6 +39,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..bus.messages import (
     MSG_HEARTBEAT,
+    MSG_WORKER_STOPPING,
     TOPIC_MEDIA_BATCHES,
     TOPIC_SPANS,
     TOPIC_TRANSCRIPTS,
@@ -49,6 +50,7 @@ from ..bus.messages import (
     TranscriptMessage,
     WORKER_BUSY,
     WORKER_IDLE,
+    WORKER_OFFLINE,
 )
 from ..utils import flight, trace
 from ..utils.occupancy import QueueDepthSampler
@@ -138,6 +140,8 @@ class ASRWorker:
         self._processed = 0
         self._errors = 0
         self._metrics_server = None
+        self._killed = False
+        self._stop_announced = False
         self.m_queue_depth = registry.gauge(
             "asr_worker_queue_depth",
             "decoded audio batches awaiting device (time-weighted "
@@ -231,6 +235,10 @@ class ASRWorker:
             # Graceful stop ships the span tail (kill() deliberately
             # doesn't — a crashed process exports nothing).
             self.export_spans()
+        # Clean-shutdown announcement (the TPU worker's mirror): the
+        # fleet view marks this worker OFFLINE instead of aging it into
+        # "stale" — what autoscaler retirement relies on.
+        self._announce_stopping()
         if self.provider is not None:
             flush = getattr(self.provider, "flush", None)
             if callable(flush):
@@ -238,12 +246,30 @@ class ASRWorker:
         if self._metrics_server is not None:
             self._metrics_server.shutdown()
 
+    def _announce_stopping(self) -> None:
+        """Best-effort worker_stopping status on graceful stop;
+        idempotent, and silent after kill() (SIGKILL fidelity)."""
+        if self._killed or self._stop_announced:
+            return
+        self._stop_announced = True
+        try:
+            self.bus.publish(TOPIC_WORKER_STATUS, StatusMessage.new(
+                self.cfg.worker_id, MSG_WORKER_STOPPING, WORKER_OFFLINE,
+                tasks_processed=self._processed,
+                tasks_success=self._processed - self._errors,
+                tasks_error=self._errors,
+                uptime_s=time.monotonic() - self._started_at,
+                worker_type="asr").to_dict())
+        except Exception as e:  # a dead bus must not break shutdown
+            logger.debug("stopping announcement failed: %s", e)
+
     def kill(self) -> None:
         """Abrupt-death chaos seam (the TPU worker's `kill()` twin): halt
         the feed/heartbeat threads WITHOUT draining or acking — un-acked
         frames requeue server-side once the caller tears this worker's
         pull stream down; providers stay registered, exactly as a dead
         process leaves its endpoints unreachable, not deregistered."""
+        self._killed = True
         self._stop.set()
         flight.record("worker_kill", worker=self.cfg.worker_id,
                       queue_depth=self._queue.qsize(),

@@ -1,8 +1,17 @@
 """Central coordinator for distributed crawls (reference `orchestrator/`)."""
 
+from .autoscaler import (
+    Autoscaler,
+    InProcessSupervisor,
+    PoolPolicy,
+    SubprocessSupervisor,
+    pools_from_config,
+)
 from .fleet import FleetView, WorkerTrack
 from .journal import CrawlJournal, RecoveredCrawl
 from .orchestrator import Orchestrator, OrchestratorConfig, WorkerInfo
 
-__all__ = ["CrawlJournal", "FleetView", "Orchestrator", "OrchestratorConfig",
-           "RecoveredCrawl", "WorkerInfo", "WorkerTrack"]
+__all__ = ["Autoscaler", "CrawlJournal", "FleetView", "InProcessSupervisor",
+           "Orchestrator", "OrchestratorConfig", "PoolPolicy",
+           "RecoveredCrawl", "SubprocessSupervisor", "WorkerInfo",
+           "WorkerTrack", "pools_from_config"]

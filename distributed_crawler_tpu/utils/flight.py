@@ -133,6 +133,14 @@ class FlightRecorder:
         alerts = alerts_snapshot()
         if alerts is not None:
             bundle["alerts"] = alerts
+        # The autoscaler's decision log: "what did the control plane do
+        # before the crash" — scale decisions next to the alerts that
+        # triggered them (tools/postmortem.py renders the pairing).
+        from .metrics import autoscaler_snapshot
+
+        autoscaler = autoscaler_snapshot()
+        if autoscaler is not None:
+            bundle["autoscaler"] = autoscaler
         try:
             from . import timeseries as _timeseries
 

@@ -453,6 +453,39 @@ def alerts_snapshot():
         return {"error": str(e)}
 
 
+# Late-bound /autoscaler provider: the elastic-fleet control plane's
+# snapshot (`orchestrator/autoscaler.py`) — per-pool desired vs actual,
+# policy bounds, cooldown state, and the bounded decision log.
+_autoscaler_provider = None
+
+
+def set_autoscaler_provider(fn) -> None:
+    """Register the zero-arg dict provider served at /autoscaler (pass
+    None to clear)."""
+    global _autoscaler_provider
+    _autoscaler_provider = fn
+
+
+def clear_autoscaler_provider(fn) -> None:
+    """Unregister ``fn`` only if it is still the active provider."""
+    global _autoscaler_provider
+    if _autoscaler_provider == fn:
+        _autoscaler_provider = None
+
+
+def autoscaler_snapshot():
+    """The active /autoscaler body, or None without a provider — the
+    flight recorder calls this so postmortem bundles carry the decision
+    log ("what the autoscaler did before the crash")."""
+    fn = _autoscaler_provider
+    if fn is None:
+        return None
+    try:
+        return fn()
+    except Exception as e:
+        return {"error": str(e)}
+
+
 class _Handler(BaseHTTPRequestHandler):
     registry: MetricsRegistry = REGISTRY
 
@@ -578,6 +611,20 @@ class _Handler(BaseHTTPRequestHandler):
 
             try:
                 body = _json.dumps(_alerts_provider(),
+                                   default=str).encode("utf-8")
+            except Exception as e:
+                code = 500
+                body = _json.dumps({"error": str(e)}).encode("utf-8")
+            ctype = "application/json"
+        elif path == "/autoscaler" and _autoscaler_provider is not None:
+            # The elastic-fleet control plane (`orchestrator/
+            # autoscaler.py`): per-pool desired vs actual worker counts,
+            # policy bounds + cooldowns, and the recent scale-decision
+            # log.  Rendered by tools/watch.py's autoscaler panel.
+            import json as _json
+
+            try:
+                body = _json.dumps(_autoscaler_provider(),
                                    default=str).encode("utf-8")
             except Exception as e:
                 code = 500

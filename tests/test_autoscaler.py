@@ -1,0 +1,822 @@
+"""The elastic-fleet autoscaler (`orchestrator/autoscaler.py`) + its
+gate/scenario integration.
+
+Covers, with injected clocks throughout: pool-policy validation and
+config parsing; the control loop's hysteresis (per-direction cooldowns,
+headroom stabilization, min/max bounds, flap resistance); trend
+anticipation straight from the rolling store; alert intake via both the
+watchtower read and the TOPIC_ALERTS message seam; decision flight
+events + metrics + /autoscaler over real HTTP; the in-process and
+subprocess supervisors (retire is ALWAYS drain-then-graceful-stop,
+never kill); the serving workers' clean-shutdown announcement (a
+retired worker goes OFFLINE, never "stale"); the loadgen rate_profile
+and flood/dynamic-target chaos extensions; and the flash-crowd e2e gate
+acceptance — breach -> alert -> scale-up -> converge -> scale-down with
+zero lost items.
+"""
+
+import json
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from distributed_crawler_tpu.orchestrator.autoscaler import (
+    Autoscaler,
+    InProcessSupervisor,
+    PoolPolicy,
+    SubprocessSupervisor,
+    WorkerHandleAdapter,
+    default_subprocess_argv,
+    pools_from_config,
+)
+from distributed_crawler_tpu.utils import flight
+from distributed_crawler_tpu.utils.metrics import (
+    MetricsRegistry,
+    clear_autoscaler_provider,
+    serve_metrics,
+    set_autoscaler_provider,
+)
+from distributed_crawler_tpu.utils.timeseries import TimeSeriesStore
+
+
+# --- fixtures ----------------------------------------------------------------
+
+class FakeSupervisor:
+    """Counts spawns/retires; actual() is the net count."""
+
+    def __init__(self, initial=1, pool="tpu"):
+        self.count = {pool: initial}
+        self.events = []
+        self.fail_spawn = False
+
+    def actual(self, pool):
+        return self.count[pool]
+
+    def spawn(self, pool):
+        if self.fail_spawn:
+            raise RuntimeError("no capacity")
+        self.count[pool] += 1
+        self.events.append(("spawn", pool))
+        return f"{pool}-{self.count[pool]}"
+
+    def retire(self, pool):
+        if self.count[pool] <= 0:
+            return None
+        self.count[pool] -= 1
+        self.events.append(("retire", pool))
+        return f"{pool}-retired"
+
+
+class FakeAlerts:
+    """A stand-in for the watchtower's get_alerts read."""
+
+    def __init__(self):
+        self.firing = []
+
+    def __call__(self):
+        return {"alerts": [{"rule": r, "state": "firing",
+                            "fired_at": 1.0} for r in self.firing],
+                "firing": list(self.firing)}
+
+
+def make_autoscaler(clock, policy=None, initial=1, alerts=None,
+                    store=None, registry=None, supervisor=None):
+    policy = policy or PoolPolicy(
+        pool="tpu", min_workers=1, max_workers=3,
+        up_cooldown_s=5.0, down_cooldown_s=5.0,
+        scale_up_alerts=["queue_wait_burn"],
+        headroom_series="fleet_queue_depth", headroom_below=2.0,
+        stabilization_s=10.0)
+    supervisor = supervisor or FakeSupervisor(initial=initial)
+    store = store if store is not None else TimeSeriesStore(clock=clock)
+    return Autoscaler(
+        supervisor, [policy], store=store,
+        registry=registry or MetricsRegistry(), clock=clock,
+        eval_interval_s=1.0, alerts_fn=alerts), supervisor, store
+
+
+# --- policy config -----------------------------------------------------------
+
+class TestPoolPolicyConfig:
+    def test_unknown_key_rejected(self):
+        with pytest.raises(ValueError, match="unknown key"):
+            PoolPolicy.from_dict({"pool": "tpu", "max_wrkers": 3})
+
+    def test_bounds_validated(self):
+        with pytest.raises(ValueError, match="max_workers"):
+            PoolPolicy.from_dict({"pool": "tpu", "min_workers": 4,
+                                  "max_workers": 2})
+        with pytest.raises(ValueError, match="steps"):
+            PoolPolicy.from_dict({"pool": "tpu", "scale_up_step": 0})
+        with pytest.raises(ValueError, match="trend_slope_per_s"):
+            PoolPolicy.from_dict({"pool": "tpu",
+                                  "trend_series": "fleet_queue_depth"})
+
+    def test_pools_from_config_duplicates(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            pools_from_config([{"pool": "tpu"}, {"pool": "tpu"}])
+
+    def test_roundtrip(self):
+        p = PoolPolicy.from_dict({"pool": "asr", "min_workers": 2,
+                                  "max_workers": 5,
+                                  "scale_up_alerts": ["batch_age_burn"]})
+        again = PoolPolicy.from_dict(p.to_dict())
+        assert again == p
+
+
+# --- the control loop --------------------------------------------------------
+
+class TestAutoscalerPolicy:
+    def test_scale_up_on_firing_alert(self):
+        clk = [1000.0]
+        alerts = FakeAlerts()
+        aut, sup, store = make_autoscaler(lambda: clk[0], alerts=alerts)
+        assert aut.tick(force=True) == []          # quiet: no decision
+        alerts.firing = ["queue_wait_burn"]
+        decisions = aut.tick(force=True)
+        assert len(decisions) == 1
+        d = decisions[0]
+        assert (d["direction"], d["from"], d["to"]) == ("up", 1, 2)
+        assert d["reason"] == "queue_wait_burn"
+        assert sup.count["tpu"] == 2
+        assert d["actual_after"] == 2
+
+    def test_up_cooldown_blocks_consecutive_ups(self):
+        clk = [1000.0]
+        alerts = FakeAlerts()
+        alerts.firing = ["queue_wait_burn"]
+        aut, sup, _ = make_autoscaler(lambda: clk[0], alerts=alerts)
+        assert aut.tick(force=True)                # up 1 -> 2
+        assert aut.tick(force=True) == []          # cooldown holds
+        clk[0] += 5.1
+        decisions = aut.tick(force=True)           # cooldown elapsed
+        assert decisions and decisions[0]["to"] == 3
+
+    def test_max_bound(self):
+        clk = [1000.0]
+        alerts = FakeAlerts()
+        alerts.firing = ["queue_wait_burn"]
+        aut, sup, _ = make_autoscaler(lambda: clk[0], alerts=alerts)
+        for _ in range(6):
+            aut.tick(force=True)
+            clk[0] += 6.0
+        assert sup.count["tpu"] == 3               # max_workers cap
+
+    def test_unrelated_alert_is_not_pressure(self):
+        clk = [1000.0]
+        alerts = FakeAlerts()
+        alerts.firing = ["dlq_growth"]
+        aut, sup, _ = make_autoscaler(lambda: clk[0], alerts=alerts)
+        assert aut.tick(force=True) == []
+        assert sup.count["tpu"] == 1
+
+    def _feed_headroom(self, store, clk, value=0.5, span_s=12.0,
+                       step_s=1.0):
+        t = clk[0] - span_s
+        while t <= clk[0]:
+            store.add("fleet_queue_depth", value, {"worker": "tpu-1"},
+                      wall=t)
+            t += step_s
+
+    def test_scale_down_needs_stabilization(self):
+        clk = [1000.0]
+        alerts = FakeAlerts()
+        aut, sup, store = make_autoscaler(lambda: clk[0], alerts=alerts,
+                                          initial=3)
+        self._feed_headroom(store, clk)
+        assert aut.tick(force=True) == []          # headroom_since set NOW
+        clk[0] += 5.0
+        self._feed_headroom(store, clk)
+        assert aut.tick(force=True) == []          # held 5s < 10s
+        clk[0] += 5.1
+        self._feed_headroom(store, clk)
+        decisions = aut.tick(force=True)           # held 10.1s
+        assert decisions and decisions[0]["direction"] == "down"
+        assert decisions[0]["reason"] == "headroom"
+        assert sup.count["tpu"] == 2
+
+    def test_down_cooldown_paces_consecutive_downs(self):
+        clk = [1000.0]
+        aut, sup, store = make_autoscaler(lambda: clk[0],
+                                          alerts=FakeAlerts(), initial=3)
+        self._feed_headroom(store, clk)
+        aut.tick(force=True)
+        clk[0] += 10.1
+        self._feed_headroom(store, clk)
+        assert aut.tick(force=True)[0]["direction"] == "down"
+        clk[0] += 1.0
+        self._feed_headroom(store, clk)
+        assert aut.tick(force=True) == []          # down cooldown holds
+        clk[0] += 4.2
+        self._feed_headroom(store, clk)
+        assert aut.tick(force=True)[0]["to"] == 1
+        # Floor: no further downs ever.
+        clk[0] += 20.0
+        self._feed_headroom(store, clk)
+        assert aut.tick(force=True) == []
+        assert sup.count["tpu"] == 1
+
+    def test_silence_is_not_headroom(self):
+        # An EMPTY headroom series must never scale the fleet down.
+        clk = [1000.0]
+        aut, sup, _ = make_autoscaler(lambda: clk[0],
+                                      alerts=FakeAlerts(), initial=3)
+        for _ in range(5):
+            clk[0] += 11.0
+            assert aut.tick(force=True) == []
+        assert sup.count["tpu"] == 3
+
+    def test_flapping_alert_cannot_thrash(self):
+        """fire/clear alternating every tick: ups are paced by the up
+        cooldown, and downs never happen at all — every pressure tick
+        resets the headroom stabilization window."""
+        clk = [1000.0]
+        alerts = FakeAlerts()
+        aut, sup, store = make_autoscaler(lambda: clk[0], alerts=alerts)
+        for i in range(40):
+            alerts.firing = ["queue_wait_burn"] if i % 2 == 0 else []
+            self._feed_headroom(store, clk)
+            aut.tick(force=True)
+            clk[0] += 1.0
+        ups = [e for e in sup.events if e[0] == "spawn"]
+        downs = [e for e in sup.events if e[0] == "retire"]
+        assert len(downs) == 0
+        # 40s of flapping with a 5s up-cooldown: at most 8 ups possible,
+        # and the max bound caps actual growth at 2 spawns.
+        assert len(ups) <= 2
+        assert sup.count["tpu"] <= 3
+
+    def test_trend_anticipation_scales_before_any_alert(self):
+        clk = [1000.0]
+        policy = PoolPolicy(
+            pool="tpu", min_workers=1, max_workers=3,
+            up_cooldown_s=5.0, scale_up_alerts=["queue_wait_burn"],
+            trend_series="fleet_queue_depth", trend_slope_per_s=1.0,
+            trend_window_s=10.0, stabilization_s=10.0)
+        aut, sup, store = make_autoscaler(lambda: clk[0], policy=policy,
+                                          alerts=FakeAlerts())
+        # Queue depth climbing 2 units/s over the window: slope 2 > 1.
+        for i in range(10):
+            store.add("fleet_queue_depth", 2.0 * i, {"worker": "tpu-1"},
+                      wall=clk[0] - 10.0 + i)
+        decisions = aut.tick(force=True)
+        assert decisions and decisions[0]["direction"] == "up"
+        assert decisions[0]["reason"].startswith("trend:")
+        assert sup.count["tpu"] == 2
+
+    def test_under_min_fleet_grows_to_min(self):
+        clk = [1000.0]
+        policy = PoolPolicy(pool="tpu", min_workers=2, max_workers=4)
+        aut, sup, _ = make_autoscaler(lambda: clk[0], policy=policy,
+                                      initial=0, alerts=FakeAlerts())
+        aut.tick(force=True)
+        assert sup.count["tpu"] == 2
+
+    def test_spawn_failure_reverts_desired(self):
+        clk = [1000.0]
+        alerts = FakeAlerts()
+        alerts.firing = ["queue_wait_burn"]
+        aut, sup, _ = make_autoscaler(lambda: clk[0], alerts=alerts)
+        sup.fail_spawn = True
+        flight.configure(capacity=256)
+        aut.tick(force=True)
+        assert sup.count["tpu"] == 1
+        snap = aut.snapshot()
+        assert snap["pools"]["tpu"]["desired"] == 1   # reverted
+        kinds = [e["kind"] for e in flight.RECORDER.events()]
+        assert "autoscale_error" in kinds
+
+    def test_spawn_churn_backs_off(self):
+        """Spawns that 'succeed' but whose workers die before the next
+        tick (a crash-looping subprocess child) must trip a backoff, not
+        a spawn storm."""
+        clk = [1000.0]
+        alerts = FakeAlerts()
+        alerts.firing = ["queue_wait_burn"]
+
+        class DyingSupervisor(FakeSupervisor):
+            def spawn(self, pool):
+                wid = super().spawn(pool)
+                self.count[pool] -= 1   # the child dies immediately
+                return wid
+
+        sup = DyingSupervisor(initial=1)
+        aut, _, _ = make_autoscaler(lambda: clk[0], alerts=alerts,
+                                    supervisor=sup)
+        flight.configure(capacity=256)
+        for _ in range(30):
+            aut.tick(force=True)
+            clk[0] += 1.0
+        spawns = sum(1 for e in sup.events if e[0] == "spawn")
+        # Without backoff this would be ~guard spawns on EVERY tick
+        # (~180); the churn limit caps the storm at SPAWN_CHURN_LIMIT
+        # passes and flags it.
+        assert spawns <= 36, spawns
+        assert any(e.get("op") == "spawn_churn"
+                   for e in flight.RECORDER.events()
+                   if e.get("kind") == "autoscale_error")
+        snap = aut.snapshot()
+        assert "actuation_backoff_s" in snap["pools"]["tpu"]
+        # Actuation resumes once the backoff expires.
+        clk[0] += 60.0
+        aut.tick(force=True)
+        assert sum(1 for e in sup.events if e[0] == "spawn") > spawns
+
+    def test_eval_interval_rate_limits_unforced_ticks(self):
+        clk = [1000.0]
+        alerts = FakeAlerts()
+        alerts.firing = ["queue_wait_burn"]
+        aut, sup, _ = make_autoscaler(lambda: clk[0], alerts=alerts)
+        aut.tick()
+        assert aut.tick() == []     # limiter: within eval_interval_s
+        clk[0] += 1.1
+        assert sup.count["tpu"] == 2 or aut.tick()  # next window acts
+
+    def test_bus_seam_observe_alert(self):
+        clk = [1000.0]
+        aut, sup, _ = make_autoscaler(lambda: clk[0], alerts=None)
+        aut.observe_alert({"rule": "queue_wait_burn", "state": "firing",
+                           "at_wall": clk[0]})
+        decisions = aut.tick(force=True)
+        assert decisions and decisions[0]["direction"] == "up"
+        aut.observe_alert({"rule": "queue_wait_burn", "state": "resolved"})
+        clk[0] += 6.0
+        assert aut.tick(force=True) == []   # pressure gone, no headroom
+
+    def test_metrics_and_store_series(self):
+        clk = [1000.0]
+        registry = MetricsRegistry()
+        alerts = FakeAlerts()
+        alerts.firing = ["queue_wait_burn"]
+        aut, sup, store = make_autoscaler(lambda: clk[0], alerts=alerts,
+                                          registry=registry)
+        aut.tick(force=True)
+        series = dict()
+        for labels, value in registry.counter(
+                "autoscaler_decisions_total").series():
+            series[(labels.get("pool"), labels.get("direction"))] = value
+        assert series[("tpu", "up")] == 1.0
+        desired = {tuple(sorted(lbl.items())): v for lbl, v in
+                   registry.gauge("autoscaler_desired_workers").series()}
+        assert desired[(("pool", "tpu"),)] == 2.0
+        assert store.latest("autoscaler_actual_workers",
+                            {"pool": "tpu"}) == 2.0
+        assert store.latest("autoscaler_desired_workers",
+                            {"pool": "tpu"}) == 2.0
+
+    def test_snapshot_shape(self):
+        clk = [1000.0]
+        aut, _, _ = make_autoscaler(lambda: clk[0], alerts=FakeAlerts())
+        aut.tick(force=True)
+        snap = aut.snapshot()
+        assert snap["pools"]["tpu"]["min"] == 1
+        assert snap["pools"]["tpu"]["max"] == 3
+        assert snap["pools"]["tpu"]["actual"] == 1
+        assert "up_remaining_s" in snap["pools"]["tpu"]["cooldown"]
+        assert snap["decisions"] == []
+        assert snap["ticks"] == 1
+        json.dumps(snap)  # the /autoscaler body must be JSON-safe
+
+
+# --- supervisors -------------------------------------------------------------
+
+class _FakeWorker:
+    def __init__(self, log, name):
+        self.log = log
+        self.name = name
+
+    def drain(self, timeout_s=10.0):
+        self.log.append(("drain", self.name))
+        return True
+
+    def stop(self, timeout_s=10.0):
+        self.log.append(("stop", self.name))
+
+    def kill(self):  # must NEVER be called by retirement
+        self.log.append(("kill", self.name))
+
+
+class TestInProcessSupervisor:
+    def _sup(self, log):
+        sup = InProcessSupervisor(drain_timeout_s=1.0)
+        seq = [0]
+
+        def spawn():
+            seq[0] += 1
+            return WorkerHandleAdapter(f"w{seq[0]}",
+                                       _FakeWorker(log, f"w{seq[0]}"))
+
+        sup.add_pool("tpu", spawn)
+        return sup
+
+    def test_spawn_retire_drain_then_stop_never_kill(self):
+        log = []
+        sup = self._sup(log)
+        sup.attach("tpu", WorkerHandleAdapter("w0", _FakeWorker(log, "w0")))
+        assert sup.actual("tpu") == 1
+        assert sup.spawn("tpu") == "w1"
+        assert sup.actual("tpu") == 2
+        retired = sup.retire("tpu")
+        assert retired == "w1"                     # newest-first
+        assert ("drain", "w1") in log and ("stop", "w1") in log
+        assert log.index(("drain", "w1")) < log.index(("stop", "w1"))
+        assert not any(op == "kill" for op, _ in log)
+        assert sup.actual("tpu") == 1
+        assert sup.spawned["tpu"] == 1 and sup.retired["tpu"] == 1
+
+    def test_retire_empty_pool_returns_none(self):
+        sup = self._sup([])
+        assert sup.retire("tpu") is None
+
+    def test_on_change_fires(self):
+        log = []
+        changes = []
+        sup = InProcessSupervisor(
+            on_change=lambda pool, live: changes.append((pool, len(live))))
+        sup.add_pool("tpu", lambda: WorkerHandleAdapter(
+            "wX", _FakeWorker(log, "wX")))
+        sup.spawn("tpu")
+        sup.retire("tpu")
+        assert changes == [("tpu", 1), ("tpu", 0)]
+
+    def test_dead_handles_not_counted(self):
+        log = []
+        sup = self._sup(log)
+        h = WorkerHandleAdapter("w0", _FakeWorker(log, "w0"))
+        sup.attach("tpu", h)
+        h.alive = False                            # chaos-killed
+        assert sup.actual("tpu") == 0
+
+    def test_stop_all(self):
+        log = []
+        sup = self._sup(log)
+        sup.spawn("tpu")
+        sup.spawn("tpu")
+        sup.stop_all()
+        assert sum(1 for op, _ in log if op == "stop") == 2
+
+
+class TestSubprocessSupervisor:
+    CHILD = ("import signal, sys, time\n"
+             "signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))\n"
+             "time.sleep(60)\n")
+
+    def test_spawn_and_graceful_retire(self):
+        sup = SubprocessSupervisor(
+            {"tpu": [sys.executable, "-c", self.CHILD]},
+            term_timeout_s=10.0)
+        assert sup.actual("tpu") == 0
+        wid = sup.spawn("tpu")
+        assert wid == "tpu-auto-1"
+        assert sup.actual("tpu") == 1
+        assert sup.children("tpu") == ["tpu-auto-1"]
+        retired = sup.retire("tpu")
+        assert retired == "tpu-auto-1"
+        assert sup.actual("tpu") == 0
+        assert sup.retire("tpu") is None
+
+    def test_worker_id_substitution_and_reap(self):
+        sup = SubprocessSupervisor(
+            {"tpu": [sys.executable, "-c",
+                     "import sys; sys.exit(0)  # {worker_id}"]})
+        sup.spawn("tpu")
+        deadline = time.monotonic() + 10.0
+        while sup.actual("tpu") and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert sup.actual("tpu") == 0              # exited child reaped
+
+    def test_default_argv(self):
+        argv = default_subprocess_argv("tpu", "127.0.0.1:7777",
+                                       extra_args=["--infer-model", "t"])
+        assert "--mode" in argv and "tpu-worker" in argv
+        assert "{worker_id}" in argv
+        assert "127.0.0.1:7777" in argv and "--infer-model" in argv
+        asr = default_subprocess_argv("asr", "127.0.0.1:7777")
+        assert "asr-worker" in asr
+
+
+# --- /autoscaler over HTTP + bundle embedding --------------------------------
+
+class TestAutoscalerSurface:
+    def test_http_endpoint(self):
+        clk = [1000.0]
+        aut, _, _ = make_autoscaler(lambda: clk[0], alerts=FakeAlerts())
+        aut.tick(force=True)
+        server = serve_metrics(0, MetricsRegistry())
+        port = server.server_address[1]
+        set_autoscaler_provider(aut.snapshot)
+        try:
+            body = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/autoscaler", timeout=5).read())
+            assert body["pools"]["tpu"]["actual"] == 1
+            assert body["decision_count"] == 0
+        finally:
+            clear_autoscaler_provider(aut.snapshot)
+            server.shutdown()
+        # Without a provider the route 404s like the other seams.
+        server = serve_metrics(0, MetricsRegistry())
+        port = server.server_address[1]
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/autoscaler", timeout=5)
+            assert err.value.code == 404
+        finally:
+            server.shutdown()
+
+    def test_flight_bundle_embeds_decision_log(self):
+        clk = [1000.0]
+        alerts = FakeAlerts()
+        alerts.firing = ["queue_wait_burn"]
+        aut, _, _ = make_autoscaler(lambda: clk[0], alerts=alerts)
+        aut.tick(force=True)
+        set_autoscaler_provider(aut.snapshot)
+        try:
+            bundle = flight.RECORDER.bundle("test")
+            assert bundle["autoscaler"]["decision_count"] == 1
+            assert bundle["autoscaler"]["decisions"][0]["direction"] == "up"
+        finally:
+            clear_autoscaler_provider(aut.snapshot)
+
+    def test_watch_panel_and_postmortem_digest(self):
+        sys.path.insert(0, "tools")
+        try:
+            from tools.postmortem import _autoscaler_digest
+            from tools.watch import render_dashboard
+        except ImportError:
+            from postmortem import _autoscaler_digest  # script mode
+            from watch import render_dashboard
+        snap = {"pools": {"tpu": {"desired": 2, "actual": 1, "min": 1,
+                                  "max": 3, "pressure": ["queue_wait_burn"],
+                                  "cooldown": {"up_remaining_s": 1.0,
+                                               "down_remaining_s": 0.0}}},
+                "decisions": [{"at": 10.0, "pool": "tpu",
+                               "direction": "up", "from": 1, "to": 2,
+                               "reason": "queue_wait_burn"}]}
+        page = render_dashboard({}, {}, {}, now=20.0, autoscaler=snap)
+        assert "autoscaler pool" in page and "converging" in page
+        assert "1 -> 2" in page and "queue_wait_burn" in page
+        digest = _autoscaler_digest(snap)
+        assert any("desired=2" in line for line in digest)
+        assert any("up" in line and "1 -> 2" in line for line in digest)
+
+
+# --- clean-shutdown announcement ---------------------------------------------
+
+class _CaptureBus:
+    def __init__(self):
+        self.published = []
+
+    def publish(self, topic, payload):
+        self.published.append((topic, payload))
+
+    def subscribe(self, topic, handler):
+        pass
+
+
+class TestStoppingAnnouncement:
+    def _worker(self, bus):
+        from distributed_crawler_tpu.inference.worker import (
+            TPUWorker,
+            TPUWorkerConfig,
+        )
+
+        class _Engine:
+            class cfg:
+                model = "fake"
+
+        return TPUWorker(bus, _Engine(), provider=None,
+                         cfg=TPUWorkerConfig(worker_id="tpu-x",
+                                             span_export_interval_s=0.0),
+                         registry=MetricsRegistry())
+
+    def test_graceful_stop_announces_offline(self):
+        from distributed_crawler_tpu.bus.messages import (
+            MSG_WORKER_STOPPING,
+            TOPIC_WORKER_STATUS,
+            StatusMessage,
+            WORKER_OFFLINE,
+        )
+        from distributed_crawler_tpu.orchestrator.fleet import FleetView
+
+        bus = _CaptureBus()
+        w = self._worker(bus)
+        w.stop()
+        stopping = [p for t, p in bus.published
+                    if t == TOPIC_WORKER_STATUS
+                    and p.get("message_type") == MSG_WORKER_STOPPING]
+        assert len(stopping) == 1
+        msg = StatusMessage.from_dict(stopping[0])
+        assert msg.status == WORKER_OFFLINE
+        assert msg.worker_type == "tpu"
+        # Idempotent: a second stop (gate teardown) announces nothing new.
+        w.stop()
+        assert len([p for t, p in bus.published
+                    if p.get("message_type") == MSG_WORKER_STOPPING]) == 1
+        # The fleet fold marks it cleanly OFFLINE — never stale.
+        fleet = FleetView(stale_after_s=0.0, registry=MetricsRegistry())
+        assert fleet.observe(msg)
+        time.sleep(0.01)
+        assert fleet.stale_count() == 0
+        assert fleet.export()["workers"]["tpu-x"]["status"] == \
+            WORKER_OFFLINE
+
+    def test_kill_stays_silent(self):
+        from distributed_crawler_tpu.bus.messages import MSG_WORKER_STOPPING
+
+        bus = _CaptureBus()
+        w = self._worker(bus)
+        w.kill()
+        w.stop()   # stop-after-kill (gate teardown) must stay silent too
+        assert not any(p.get("message_type") == MSG_WORKER_STOPPING
+                       for _, p in bus.published)
+
+
+# --- loadgen extensions ------------------------------------------------------
+
+class TestRateProfile:
+    def test_validation(self):
+        from distributed_crawler_tpu.loadgen.generator import LoadGenConfig
+
+        with pytest.raises(ValueError, match="pairs"):
+            LoadGenConfig(rate_profile=[[1.0]]).validate()
+        with pytest.raises(ValueError, match="ascending"):
+            LoadGenConfig(rate_profile=[[2.0, 5], [1.0, 9]]).validate()
+        with pytest.raises(ValueError, match="positive"):
+            LoadGenConfig(rate_profile=[[1.0, 0]]).validate()
+        with pytest.raises(ValueError, match="poisson"):
+            LoadGenConfig(arrival="ramp",
+                          rate_profile=[[1.0, 5]]).validate()
+        LoadGenConfig(rate_profile=[[1.0, 5], [2.0, 50]]).validate()
+
+    def test_rate_at_lookup(self):
+        from distributed_crawler_tpu.loadgen.generator import LoadGenConfig
+
+        cfg = LoadGenConfig(rate_batches_per_s=4.0,
+                            rate_profile=[[2.0, 40.0], [4.0, 4.0]])
+        assert cfg.rate_at(0.0) == 4.0
+        assert cfg.rate_at(1.99) == 4.0
+        assert cfg.rate_at(2.0) == 40.0
+        assert cfg.rate_at(3.9) == 40.0
+        assert cfg.rate_at(4.0) == 4.0
+
+    def test_step_plan_is_deterministic_and_denser(self):
+        from distributed_crawler_tpu.loadgen.generator import (
+            LoadGenConfig,
+            SyntheticWorkload,
+        )
+
+        cfg = dict(seed=5, duration_s=6.0, rate_batches_per_s=4.0,
+                   rate_profile=[[2.0, 40.0], [4.0, 4.0]],
+                   records_per_batch=2)
+        plan_a = SyntheticWorkload(LoadGenConfig(**cfg)).plan()
+        plan_b = SyntheticWorkload(LoadGenConfig(**cfg)).plan()
+        assert [p.offset_s for p in plan_a] == [p.offset_s for p in plan_b]
+        in_step = sum(1 for p in plan_a if 2.0 <= p.offset_s < 4.0)
+        outside = sum(1 for p in plan_a if p.offset_s < 2.0
+                      or p.offset_s >= 4.0)
+        assert in_step > 3 * outside   # the 10x step dominates arrivals
+
+
+class TestChaosExtensions:
+    def test_flood_line_parses(self):
+        from distributed_crawler_tpu.loadgen.chaos import parse_fault
+
+        f = parse_fault("at=1s flood network 2s")
+        assert (f.action, f.target, f.at_s, f.arg_s) == \
+            ("flood", "network", 1.0, 2.0)
+        with pytest.raises(ValueError):
+            parse_fault("at=1s flood network")     # duration required
+
+    def test_static_controller_rejects_unknown_target(self):
+        from distributed_crawler_tpu.loadgen.chaos import (
+            ChaosController,
+            parse_timeline,
+        )
+
+        timeline = parse_timeline(["at=0s kill tpu-9"])
+        with pytest.raises(ValueError, match="unknown target"):
+            ChaosController(timeline, targets={})
+
+    def test_dynamic_targets_register_mid_run(self):
+        from distributed_crawler_tpu.loadgen.chaos import (
+            ChaosController,
+            parse_timeline,
+        )
+
+        killed = []
+
+        class H:
+            def kill(self):
+                killed.append(True)
+
+        timeline = parse_timeline(["at=0.5s kill tpu-dyn"])
+        ctl = ChaosController(timeline, targets={}, dynamic_targets=True)
+        ctl.tick(now_s=1.0)            # target missing -> error event
+        assert any(e.get("phase") == "error" for e in ctl.events)
+        ctl2 = ChaosController(timeline, targets={}, dynamic_targets=True)
+        ctl2.register_target("tpu-dyn", H())
+        ctl2.tick(now_s=1.0)
+        assert killed == [True]
+
+    def test_flood_handle_injects(self):
+        from distributed_crawler_tpu.clients import SimNetwork
+        from distributed_crawler_tpu.clients.errors import FloodWaitError
+        from distributed_crawler_tpu.loadgen.gate import _SimNetworkHandle
+
+        net = SimNetwork()
+        handle = _SimNetworkHandle(net)
+        handle.flood(1.0)
+        with pytest.raises(FloodWaitError):
+            net._check_fault("GetChatHistory")
+
+
+class TestGateConfigValidation:
+    def test_unknown_gate_key_rejected(self):
+        from distributed_crawler_tpu.loadgen.gate import (
+            validate_gate_config,
+        )
+
+        with pytest.raises(ValueError, match="unknown gate key"):
+            validate_gate_config({"name": "x",
+                                  "gate": {"max_lsot": 0}})
+
+    def test_unknown_autoscaler_key_rejected(self):
+        from distributed_crawler_tpu.loadgen.gate import (
+            validate_gate_config,
+        )
+
+        with pytest.raises(ValueError, match="unknown autoscaler key"):
+            validate_gate_config({"name": "x", "gate": {},
+                                  "autoscaler": {"poolz": []}})
+        with pytest.raises(ValueError, match="non-empty pools"):
+            validate_gate_config({"name": "x", "gate": {},
+                                  "autoscaler": {"pools": []}})
+
+    def test_asr_scenarios_reject_autoscaler_block(self):
+        from distributed_crawler_tpu.loadgen.gate import (
+            validate_gate_config,
+        )
+
+        with pytest.raises(ValueError, match="kind=asr"):
+            validate_gate_config({
+                "name": "x", "kind": "asr", "gate": {},
+                "autoscaler": {"pools": [{"pool": "asr"}]}})
+
+    def test_scale_event_specs_validated(self):
+        from distributed_crawler_tpu.loadgen.gate import (
+            validate_gate_config,
+        )
+
+        with pytest.raises(ValueError, match="during"):
+            validate_gate_config({"name": "x", "gate": {
+                "require_scale_event": [
+                    {"direction": "up", "during": "recovey"}]}})
+        with pytest.raises(ValueError, match="direction"):
+            validate_gate_config({"name": "x", "gate": {
+                "require_scale_event": [{"direction": "sideways"}]}})
+        with pytest.raises(ValueError, match="require_scale_event"):
+            validate_gate_config({"name": "x", "gate": {
+                "require_scale_event": ["sideways"]}})
+        with pytest.raises(ValueError, match="fault_window"):
+            validate_gate_config({"name": "x", "gate": {
+                "fault_window": [2.0]}})
+        with pytest.raises(ValueError, match="fault_window"):
+            validate_gate_config({"name": "x", "gate": {
+                "fault_window": [3.0, 2.0]}})
+        validate_gate_config({"name": "x", "gate": {
+            "require_scale_event": ["up", {"pool": "tpu",
+                                           "direction": "down",
+                                           "during": "recovery"}],
+            "fault_window": [1.0, 2.5]}})
+
+    def test_checked_in_scenarios_validate(self):
+        from distributed_crawler_tpu import loadgen
+
+        for name in loadgen.scenario_names():
+            loadgen.validate_gate_config(loadgen.load_scenario(name))
+
+
+# --- e2e: the flash-crowd gate acceptance ------------------------------------
+
+class TestFlashCrowdE2E:
+    def test_flash_crowd_scenario_passes(self):
+        """The tentpole loop, end to end on the real stack: the 10x step
+        breaches queue-wait -> the burn alert fires -> the autoscaler
+        spawns workers DURING the fault window -> the fleet drains the
+        surge -> the alert resolves -> sustained headroom scales the
+        pool back to its floor -> converged, with zero lost/duplicated
+        items across the dynamic fleet."""
+        from distributed_crawler_tpu import loadgen
+
+        scenario = loadgen.load_scenario("flash-crowd")
+        verdict = loadgen.run_scenario(scenario)
+        assert verdict["status"] == "pass", json.dumps(verdict, indent=2)
+        fleet = verdict["autoscaler"]
+        assert fleet["fleet_sizes"]["max"] >= 2      # actually scaled up
+        assert fleet["fleet_sizes"]["final"] == 1    # and back down
+        assert fleet["converge_s"] is not None
+        assert verdict["alerts"]["fired"].get("queue_wait_burn")
+        assert verdict["lost"] == 0 and verdict["duplicates"] == 0

@@ -1067,7 +1067,15 @@ class TestOrchestratorSeesTpuWorker:
         deadline = time.monotonic() + 10
         while "tpu-w7" not in orch.workers and time.monotonic() < deadline:
             time.sleep(0.02)
-        worker.stop()
-        bus.close()
         assert "tpu-w7" in orch.workers
         assert orch.workers["tpu-w7"].status in ("idle", "busy")
+        worker.stop()
+        # Graceful stop announces worker_stopping: the registry marks the
+        # worker cleanly OFFLINE (the autoscaler-retirement contract) —
+        # poll briefly, the announcement rides the async bus.
+        deadline = time.monotonic() + 5
+        while orch.workers["tpu-w7"].status != "offline" \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        bus.close()
+        assert orch.workers["tpu-w7"].status == "offline"

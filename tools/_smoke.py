@@ -109,7 +109,11 @@ def selfcheck() -> int:
          os.path.join(repo, "tests", "test_multichip_serve.py"),
          # watchtower: rolling time-series store, alert-engine
          # lifecycles, /alerts + /timeseries, the live-dashboard e2e.
-         os.path.join(repo, "tests", "test_watchtower.py")],
+         os.path.join(repo, "tests", "test_watchtower.py"),
+         # elastic fleet: autoscaler policy hysteresis, supervisors,
+         # /autoscaler, and the flash-crowd gate acceptance
+         # (breach -> alert -> scale-up -> converge -> scale-down).
+         os.path.join(repo, "tests", "test_autoscaler.py")],
         env=env, cwd=repo)
 
 

@@ -208,9 +208,25 @@ def main(argv=None) -> int:
     from distributed_crawler_tpu import loadgen
 
     if args.list:
+        # Operator discovery: name + one-line summary + the chaos
+        # timeline (and fleet bounds for autoscaled scenarios), so the
+        # (now 13-strong) pack is browsable without reading JSON.
         for scenario_name in loadgen.scenario_names():
             sc = loadgen.load_scenario(scenario_name)
-            print(f"{scenario_name}: {sc.get('description', '')[:100]}")
+            summary = (sc.get("description") or "").split(". ")[0]
+            if len(summary) > 110:
+                summary = summary[:107] + "..."
+            kind = sc.get("kind", "text")
+            print(f"{scenario_name}  [{kind}, bus={sc.get('bus', 'inmemory')}]")
+            print(f"    {summary}")
+            chaos = sc.get("chaos") or []
+            if chaos:
+                print(f"    chaos: {'; '.join(chaos)}")
+            pools = (sc.get("autoscaler") or {}).get("pools") or []
+            for pool in pools:
+                print(f"    autoscaler: pool {pool.get('pool')} "
+                      f"{pool.get('min_workers', 1)}.."
+                      f"{pool.get('max_workers', 4)} workers")
         return 0
 
     scenario_name = args.scenario or "steady-state"
@@ -222,13 +238,16 @@ def main(argv=None) -> int:
             if needed > 1:
                 _ensure_devices(needed)
         if args.smoke:
-            # Validate every checked-in scenario parses end to end —
-            # load config, chaos timeline, a deterministic plan — without
-            # running any traffic.  ASR scenarios ("kind": "asr")
-            # validate their audio_load block + plan instead.
+            # Validate EVERY checked-in scenario parses end to end —
+            # load config, chaos timeline, a deterministic plan, the
+            # gate-key envelope, and the "alerts"/"autoscaler" blocks —
+            # without running any traffic, so a pack file nothing
+            # exercises in CI cannot bit-rot.  ASR scenarios
+            # ("kind": "asr") validate their audio_load block + plan.
             for scenario_name in loadgen.scenario_names():
                 sc = loadgen.load_scenario(scenario_name)
                 loadgen.parse_timeline(sc.get("chaos", []))
+                loadgen.validate_gate_config(sc)
                 if sc.get("kind") == "asr":
                     acfg = loadgen.AudioLoadConfig(
                         **sc.get("audio_load", {}))

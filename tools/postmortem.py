@@ -79,6 +79,11 @@ def render_bundle(bundle: Dict[str, Any]) -> str:
         lines.append("")
         lines.append("alert log (watchtower lifecycle transitions):")
         lines.extend(alert_lines)
+    scale_lines = _autoscaler_digest(bundle.get("autoscaler") or {})
+    if scale_lines:
+        lines.append("")
+        lines.append("what the autoscaler did before the crash:")
+        lines.extend(scale_lines)
     trend_lines = _trend_digest(bundle.get("timeseries") or {})
     if trend_lines:
         lines.append("")
@@ -169,6 +174,30 @@ def _alert_digest(alerts: Dict[str, Any]) -> List[str]:
         out.append(f"  {rel:>9.3f}s  {e.get('rule', '?'):<28} "
                    f"{e.get('from', '?')} -> {e.get('to', '?')}"
                    + (f"  value={value}" if value is not None else ""))
+    return out
+
+
+def _autoscaler_digest(autoscaler: Dict[str, Any]) -> List[str]:
+    """The bundled /autoscaler body: per-pool desired-vs-actual at dump
+    time + the decision log (newest last) — the scale decisions that
+    preceded the crash, next to the alerts that triggered them."""
+    out: List[str] = []
+    pools = autoscaler.get("pools") or {}
+    for name in sorted(pools):
+        p = pools[name]
+        out.append(f"  pool {name}: desired={p.get('desired', '?')} "
+                   f"actual={p.get('actual', '?')} "
+                   f"bounds={p.get('min', '?')}..{p.get('max', '?')}"
+                   + (f"  pressure={','.join(p['pressure'])}"
+                      if p.get("pressure") else ""))
+    decisions = autoscaler.get("decisions") or []
+    t_end = max((float(d.get("at", 0.0)) for d in decisions), default=0.0)
+    for d in decisions[-20:]:
+        rel = float(d.get("at", 0.0)) - t_end
+        out.append(f"  {rel:>9.3f}s  {d.get('pool', '?'):<10} "
+                   f"{d.get('direction', '?'):<5} "
+                   f"{d.get('from', '?')} -> {d.get('to', '?')}  "
+                   f"reason={d.get('reason', '?')}")
     return out
 
 
@@ -284,10 +313,22 @@ def selfcheck() -> int:
         "fleet_queue_depth{worker=tpu-1}": {
             "name": "fleet_queue_depth", "labels": {"worker": "tpu-1"},
             "samples": [[90.0, 1.0], [95.0, 8.0], [100.0, 30.0]]}}}
+    bundle["autoscaler"] = {
+        "pools": {"tpu": {"desired": 3, "actual": 2, "min": 1, "max": 3,
+                          "pressure": ["queue_wait_burn"]}},
+        "decisions": [
+            {"at": 98.0, "pool": "tpu", "direction": "up", "from": 1,
+             "to": 2, "reason": "queue_wait_burn"},
+            {"at": 99.5, "pool": "tpu", "direction": "up", "from": 2,
+             "to": 3, "reason": "queue_wait_burn"},
+        ],
+    }
     out = render_bundle(bundle)
     assert "selfcheck" in out and "worker_offline" in out, out
     assert "queue_wait_burn" in out and "FIRING at dump time" in out, out
     assert "fleet_queue_depth" in out and "1 -> 30" in out, out
+    assert "what the autoscaler did before the crash" in out, out
+    assert "2 -> 3" in out and "desired=3" in out, out
     assert sparkline([1.0, 2.0, 3.0]) and sparkline([]) == ""
     assert len(sparkline(list(range(100)))) <= 24
     cluster = {

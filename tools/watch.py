@@ -6,14 +6,19 @@ Usage:
     python tools/watch.py http://127.0.0.1:9102 --once     # one page, exit
     python tools/watch.py --selfcheck                      # CI smoke
 
-Fetches the three surfaces the orchestrator (or any worker, for the
+Fetches the four surfaces the orchestrator (or any worker, for the
 ``/timeseries`` half) serves — ``/alerts`` (rule lifecycle state,
 `utils/alerts.py`), ``/timeseries`` (rolling series,
-`utils/timeseries.py`), and ``/cluster`` (the fleet fold,
-`orchestrator/fleet.py`) — and renders the ops story on one page:
+`utils/timeseries.py`), ``/cluster`` (the fleet fold,
+`orchestrator/fleet.py`), and ``/autoscaler`` (the elastic-fleet
+control plane, `orchestrator/autoscaler.py`) — and renders the ops
+story on one page:
 
 - firing/pending alerts first (rule, value, age), then the burn-rate
   columns for every burn rule (fast/slow burn vs factor);
+- the autoscaler panel: desired-vs-actual fleet size per pool, live
+  pressure/cooldowns, and the recent scale decisions with the alert
+  that triggered each;
 - a per-worker table with sparkline trend cells (queue depth, MFU,
   goodput) from the fleet series, next to the instantaneous /cluster
   numbers;
@@ -79,11 +84,13 @@ def _fmt_age(since: Any, now: float) -> str:
 def render_dashboard(cluster: Optional[Dict[str, Any]],
                      alerts: Optional[Dict[str, Any]],
                      tseries: Optional[Dict[str, Any]],
-                     now: Optional[float] = None) -> str:
+                     now: Optional[float] = None,
+                     autoscaler: Optional[Dict[str, Any]] = None) -> str:
     now = time.time() if now is None else now
     cluster = cluster or {}
     alerts = alerts or {}
     tseries = tseries or {}
+    autoscaler = autoscaler or {}
     lines: List[str] = []
 
     fleet = cluster.get("fleet") or {}
@@ -130,6 +137,37 @@ def render_dashboard(cluster: Optional[Dict[str, Any]],
                 f"{d.get('burn_slow', '-'):>10} "
                 f"{d.get('factor', '-'):>7} "
                 f"{a.get('fired_count', 0):>6}")
+
+    # --- autoscaler panel (/autoscaler; orchestrator/autoscaler.py) --------
+    pools = autoscaler.get("pools") or {}
+    if pools:
+        lines.append("")
+        lines.append(f"  {'autoscaler pool':<16} {'desired':>8} "
+                     f"{'actual':>7} {'bounds':>8} {'pressure':<28} "
+                     f"{'cooldown up/down':<18}")
+        for pname in sorted(pools):
+            p = pools[pname]
+            cd = p.get("cooldown") or {}
+            pressure = ",".join(p.get("pressure") or []) or "-"
+            mismatch = " <-- converging" \
+                if p.get("desired") != p.get("actual") else ""
+            lines.append(
+                f"  {pname:<16} {p.get('desired', '?'):>8} "
+                f"{p.get('actual', '?'):>7} "
+                f"{str(p.get('min', '?')) + '..' + str(p.get('max', '?')):>8} "
+                f"{pressure:<28} "
+                f"{cd.get('up_remaining_s', 0)}/"
+                f"{cd.get('down_remaining_s', 0)}s{mismatch}")
+        decisions = (autoscaler.get("decisions") or [])[-5:]
+        if decisions:
+            lines.append("  recent scale decisions:")
+            for d in decisions:
+                lines.append(
+                    f"    {_fmt_age(d.get('at'), now):>6} ago  "
+                    f"{d.get('pool', '?'):<10} "
+                    f"{d.get('direction', '?'):<5} "
+                    f"{d.get('from', '?')} -> {d.get('to', '?')}  "
+                    f"({d.get('reason', '?')})")
 
     # --- per-worker trend table --------------------------------------------
     workers = cluster.get("workers") or {}
@@ -182,7 +220,8 @@ def render_dashboard(cluster: Optional[Dict[str, Any]],
 def render_once(base_url: str) -> str:
     return render_dashboard(_fetch(base_url, "/cluster"),
                             _fetch(base_url, "/alerts"),
-                            _fetch(base_url, "/timeseries"))
+                            _fetch(base_url, "/timeseries"),
+                            autoscaler=_fetch(base_url, "/autoscaler"))
 
 
 def selfcheck() -> int:
@@ -232,12 +271,28 @@ def selfcheck() -> int:
             "labels": {"worker": "tpu-1"},
             "samples": [[now - 10, 1000.0], [now, 900.0]]},
     }}
-    out = render_dashboard(cluster, alerts, tseries, now=now)
+    autoscaler = {
+        "pools": {"tpu": {
+            "desired": 3, "actual": 2, "min": 1, "max": 3,
+            "pressure": ["queue_wait_burn"],
+            "cooldown": {"up_remaining_s": 0.4, "down_remaining_s": 0.0},
+        }},
+        "decisions": [
+            {"at": now - 8, "pool": "tpu", "direction": "up",
+             "from": 1, "to": 2, "reason": "queue_wait_burn"},
+            {"at": now - 3, "pool": "tpu", "direction": "up",
+             "from": 2, "to": 3, "reason": "queue_wait_burn"},
+        ],
+    }
+    out = render_dashboard(cluster, alerts, tseries, now=now,
+                           autoscaler=autoscaler)
     assert "FIRING" in out and "queue_wait_burn" in out, out
     assert "tpu-1" in out and "crawl-1" in out and "STALE" in out, out
     assert "burn rule" in out and "14.2" in out, out
     assert "biggest movers" in out and "fleet_queue_depth" in out, out
     assert "0.28" in out, out  # latest MFU next to its trend cell
+    assert "autoscaler pool" in out and "converging" in out, out
+    assert "recent scale decisions" in out and "2 -> 3" in out, out
     empty = render_dashboard(None, None, None, now=now)
     assert "nothing to watch" in empty, empty
     print("watch selfcheck ok")
