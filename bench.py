@@ -66,6 +66,9 @@ PROBE_RETRY_COOLDOWN_S = int(os.environ.get("BENCH_PROBE_RETRY_S", "60"))
 CPU_FALLBACK_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT_S", "300"))
 ASR_TIMEOUT_S = int(os.environ.get("BENCH_ASR_TIMEOUT_S", "240"))
 ASR_TINY_TIMEOUT_S = int(os.environ.get("BENCH_ASR_TINY_TIMEOUT_S", "120"))
+CLUSTER_TIMEOUT_S = int(os.environ.get("BENCH_CLUSTER_TIMEOUT_S", "180"))
+CLUSTER_TINY_TIMEOUT_S = int(
+    os.environ.get("BENCH_CLUSTER_TINY_TIMEOUT_S", "120"))
 XLMR_TIMEOUT_S = int(os.environ.get("BENCH_XLMR_TIMEOUT_S", "300"))
 MOE_TIMEOUT_S = int(os.environ.get("BENCH_MOE_TIMEOUT_S", "420"))
 
@@ -111,6 +114,7 @@ def _cache_tpu_result(result: dict) -> None:
                 ("int8_posts_per_sec", "int8_measured_at"),
                 ("int8_static_posts_per_sec", "int8_static_measured_at"),
                 ("moe_capacity_posts_per_sec", "moe_measured_at"),
+                ("cluster_assign_vectors_per_s", "cluster_measured_at"),
                 ("serving_posts_per_sec", "serving_measured_at")):
             if result.get(probe_key) is not None:
                 entry[stamp] = now
@@ -791,6 +795,59 @@ def _measure_asr_tiny(batch: int = 4, decode_len: int = 6,
     return out
 
 
+def _measure_cluster(k: int = 256, dim: int = 1024, rows: int = 4096,
+                     samples: int = 5) -> dict:
+    """Streaming-clustering leg (BASELINE config #5's serving math): one
+    online mini-batch k-means step on the `cluster/engine.py` serving
+    engine — assignment is the [rows, dim] x [dim, k] MXU matmul, the
+    update a one-hot einsum — timed end to end (host padding + dispatch
+    + blocking readback, what the ClusterWorker's feed loop pays).
+    Reported in the units the serving meters speak:
+    ``cluster_assign_vectors_per_s`` (embedding rows through the step per
+    wall-clock second) and ``cluster_step_ms`` (median step wall)."""
+    import numpy as np
+
+    from distributed_crawler_tpu.cluster.engine import (
+        ClusterEngine,
+        ClusterEngineConfig,
+    )
+    from distributed_crawler_tpu.utils.metrics import MetricsRegistry
+
+    eng = ClusterEngine(ClusterEngineConfig(k=k, buckets=(rows,), seed=0),
+                        registry=MetricsRegistry())
+    rng = np.random.default_rng(0)
+    t0 = time.perf_counter()
+    eng.observe(rng.standard_normal((rows, dim)).astype(np.float32))
+    _log(f"cluster seed+compile done in {time.perf_counter() - t0:.1f}s "
+         f"(k={k} dim={dim} rows={rows})")
+    times = []
+    for _ in range(samples):
+        batch = rng.standard_normal((rows, dim)).astype(np.float32)
+        t0 = time.perf_counter()
+        eng.observe(batch)  # block_until_ready inside closes the call
+        times.append(time.perf_counter() - t0)
+    t_step = sorted(times)[len(times) // 2]
+    _log(f"cluster: {rows / t_step:.0f} vectors/s "
+         f"(t_step={t_step * 1e3:.1f}ms)")
+    return {
+        "cluster_assign_vectors_per_s": round(rows / t_step, 1),
+        "cluster_step_ms": round(t_step * 1e3, 2),
+        "cluster_k": k,
+        "cluster_dim": dim,
+        "cluster_rows": rows,
+    }
+
+
+def _measure_cluster_tiny() -> dict:
+    """Sized-down clustering leg for non-TPU hosts: keeps the
+    ``cluster_assign_vectors_per_s`` / ``cluster_step_ms`` rows present
+    in every BENCH json — clearly labelled, never comparable to the
+    full-width TPU numbers."""
+    out = _measure_cluster(k=16, dim=64, rows=256, samples=3)
+    out["cluster_model"] = "kmeans-tiny-cpu"
+    return out
+
+
 def _cpu_env(n_devices: int) -> dict:
     # Strip accelerator-tunnel vars so the host sitecustomize doesn't claim
     # a device session in a CPU-only child (it would block on the tunnel's
@@ -924,7 +981,8 @@ def main() -> None:
     rc=1 with `parsed: null` when the tunneled backend wedged between a
     passing probe and a parent-side jax touch)."""
     if any(f in sys.argv for f in ("--child", "--asr", "--scale",
-                                   "--xlmr", "--moe", "--probe")):
+                                   "--xlmr", "--moe", "--probe",
+                                   "--cluster-bench")):
         _child_main()
         return
     try:
@@ -963,7 +1021,7 @@ def main() -> None:
 
 def _child_main() -> None:
     if any(f in sys.argv for f in ("--child", "--asr", "--scale",
-                                   "--xlmr", "--moe")):
+                                   "--xlmr", "--moe", "--cluster-bench")):
         # Persistent XLA cache: repeat benches skip the 10-30 s compiles,
         # shrinking each child's time-on-chip (less exposure to the
         # intermittent wedge).  Compile time is excluded from the timing
@@ -994,6 +1052,12 @@ def _child_main() -> None:
             print(json.dumps(_measure_asr_tiny()), flush=True)
         else:
             print(json.dumps(_measure_asr()), flush=True)
+        return
+    if "--cluster-bench" in sys.argv:
+        if "--cluster-tiny" in sys.argv:
+            print(json.dumps(_measure_cluster_tiny()), flush=True)
+        else:
+            print(json.dumps(_measure_cluster()), flush=True)
         return
     if "--xlmr" in sys.argv:
         print(json.dumps(_measure_xlmr_int8()), flush=True)
@@ -1115,6 +1179,16 @@ def _parent() -> None:
             result.update(moe)
         else:
             _log(f"moe row skipped: {merr}")
+        # BASELINE config #5 row: streaming-clustering step throughput at
+        # serving width (k=256, 1024-dim embeddings) — own child, own
+        # budget.
+        _log(f"measuring clustering row (timeout {CLUSTER_TIMEOUT_S}s)")
+        clus, cerr2 = _try_child(["--cluster-bench"], dict(os.environ),
+                                 CLUSTER_TIMEOUT_S)
+        if clus is not None:
+            result.update(clus)
+        else:
+            _log(f"cluster row skipped: {cerr2}")
 
     _cache_tpu_result(result)
     if "asr_rtfx" not in result:
@@ -1141,6 +1215,32 @@ def _parent() -> None:
             result.update(asr)
         else:
             _log(f"tiny asr row skipped: {aerr}")
+    if "cluster_assign_vectors_per_s" not in result:
+        # The clustering leg missed its window (wedge mid-run, or CPU
+        # fallback): surface the last REAL TPU measurement first …
+        cached = _load_tpu_cache() or {}
+        if "cluster_assign_vectors_per_s" in cached:
+            for k in ("cluster_assign_vectors_per_s", "cluster_step_ms",
+                      "cluster_k", "cluster_dim", "cluster_rows",
+                      "cluster_model"):
+                if k in cached:
+                    result[k] = cached[k]
+            result["cluster_from_cache_measured_at"] = cached.get(
+                "cluster_measured_at", cached.get("measured_at"))
+    if "cluster_assign_vectors_per_s" not in result:
+        # … else the sized-down tiny leg on CPU, so BENCH json tracks
+        # the clustering workload from this PR onward — guaranteed-JSON
+        # like every other leg (a failed child logs and skips the row).
+        _log(f"measuring tiny-cluster CPU row "
+             f"(timeout {CLUSTER_TINY_TIMEOUT_S}s)")
+        clus, cerr3 = _try_child(["--cluster-bench", "--cluster-tiny"],
+                                 _cpu_env(1), CLUSTER_TINY_TIMEOUT_S)
+        if clus is not None:
+            result.update(clus)
+        else:
+            _log(f"tiny cluster row skipped: {cerr3}")
+            result.setdefault("cluster_assign_vectors_per_s", None)
+            result.setdefault("cluster_step_ms", None)
     if "xlmr_base_posts_per_sec" not in result:
         cached = _load_tpu_cache() or {}
         if "xlmr_base_posts_per_sec" in cached:

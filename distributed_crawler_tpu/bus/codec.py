@@ -38,6 +38,7 @@ from .messages import (
     MSG_ALERT,
     MSG_AUDIO_BATCH,
     MSG_CHAOS_FAULT,
+    MSG_CLUSTER_UPDATE,
     MSG_DISCOVERED_PAGES,
     MSG_HEARTBEAT,
     MSG_PAUSE,
@@ -53,6 +54,7 @@ from .messages import (
     AlertMessage,
     AudioBatchMessage,
     ChaosMessage,
+    ClusterUpdateMessage,
     ControlMessage,
     ResultMessage,
     SpanBatchMessage,
@@ -150,6 +152,7 @@ MESSAGE_REGISTRY: Dict[str, type] = {
     MSG_TRANSCRIPT: TranscriptMessage,
     MSG_SPAN_BATCH: SpanBatchMessage,
     MSG_ALERT: AlertMessage,
+    MSG_CLUSTER_UPDATE: ClusterUpdateMessage,
 }
 
 

@@ -46,6 +46,11 @@ MSG_SPAN_BATCH = "span_batch"
 # Watchtower alerting (`utils/alerts.py` via `orchestrator/watchtower.py`):
 # a rule's firing/resolved lifecycle transition, announced fleet-wide.
 MSG_ALERT = "alert"
+# Streaming clustering (`cluster/`): the ClusterWorker's periodic
+# centroid-state announcement — sizes, inertia trend, under-populated
+# cluster ids, and a bounded channel→cluster map the orchestrator's
+# cluster-guided frontier prioritization consumes.
+MSG_CLUSTER_UPDATE = "cluster_update"
 
 # --- status values (`messages.go:32-43`) -----------------------------------
 STATUS_SUCCESS = "success"
@@ -96,6 +101,13 @@ TOPIC_SPANS = "tpu-spans"
 # chaos/status — a missed announcement degrades promptness, never the
 # /alerts state, so no pull/ack machinery.
 TOPIC_ALERTS = "tpu-alerts"
+# Cluster-state announcements (`ClusterUpdateMessage`): the streaming
+# ClusterWorker publishes its centroid summary here after checkpoints so
+# the orchestrator can prioritize frontier pages whose seed posts landed
+# in under-populated clusters (cluster-guided snowball).  Fan-out like
+# alerts/status — a missed update degrades prioritization freshness,
+# never correctness, so no pull/ack machinery.
+TOPIC_CLUSTERS = "tpu-clusters"
 
 VALID_PLATFORMS = ("telegram", "youtube")
 
@@ -122,7 +134,7 @@ def pubsub_topics() -> List[str]:
             TOPIC_ORCHESTRATOR, TOPIC_INFERENCE_BATCHES,
             TOPIC_INFERENCE_RESULTS, TOPIC_JOBS, TOPIC_CHAOS,
             TOPIC_MEDIA_BATCHES, TOPIC_TRANSCRIPTS, TOPIC_SPANS,
-            TOPIC_ALERTS]
+            TOPIC_ALERTS, TOPIC_CLUSTERS]
 
 
 def _opt_time(value: Any) -> Optional[str]:
@@ -584,7 +596,7 @@ class ControlMessage:
 # (`loadgen/chaos.py`); `validate()` rejects anything else at decode time
 # so a typo'd scenario line fails loudly instead of silently no-opping.
 CHAOS_ACTIONS = ("kill", "restart", "down", "stall", "wedge", "delay",
-                 "drop", "poison")
+                 "drop", "poison", "flood")
 
 
 @dataclass
@@ -915,6 +927,102 @@ class AlertMessage:
             value=float(value) if value is not None else None,
             detail=dict(d.get("detail") or {}),
             at_wall=float(d.get("at_wall") or 0.0),
+            timestamp=parse_time(d.get("timestamp")),
+            trace_id=d.get("trace_id", "") or "",
+        )
+
+
+# --- streaming clustering (`cluster/`) --------------------------------------
+
+@dataclass
+class ClusterUpdateMessage:
+    """The ClusterWorker's periodic centroid-state summary on
+    ``TOPIC_CLUSTERS``.
+
+    ``sizes`` is the per-cluster cumulative assignment count (length
+    ``k``), ``inertia`` the rolling mean per-vector inertia of recent
+    steps, ``underpopulated`` the cluster ids whose share of assignments
+    is below the worker's ``min_cluster_fraction`` threshold, and
+    ``channel_clusters`` a bounded map of recently-seen channel names to
+    the cluster their posts most recently landed in — the join key the
+    orchestrator's cluster-guided frontier prioritization uses (a
+    frontier page whose channel maps to an under-populated cluster
+    dispatches at ``PRIORITY_HIGH``).  The envelope's ``trace_id``
+    exists for registry uniformity (the crawlint BUS contract); cluster
+    updates are telemetry about the stream, not part of one trace."""
+
+    message_type: str = MSG_CLUSTER_UPDATE
+    worker_id: str = ""
+    k: int = 0
+    step: int = 0                    # mini-batch steps applied so far
+    vectors: int = 0                 # embeddings assigned so far
+    sizes: List[int] = field(default_factory=list)
+    inertia: Optional[float] = None
+    underpopulated: List[int] = field(default_factory=list)
+    channel_clusters: Dict[str, int] = field(default_factory=dict)
+    timestamp: Optional[datetime] = None
+    trace_id: str = ""
+
+    @classmethod
+    def new(cls, worker_id: str, k: int, step: int = 0, vectors: int = 0,
+            sizes: Optional[List[int]] = None,
+            inertia: Optional[float] = None,
+            underpopulated: Optional[List[int]] = None,
+            channel_clusters: Optional[Dict[str, int]] = None
+            ) -> "ClusterUpdateMessage":
+        return cls(worker_id=worker_id, k=int(k), step=int(step),
+                   vectors=int(vectors), sizes=list(sizes or []),
+                   inertia=inertia,
+                   underpopulated=list(underpopulated or []),
+                   channel_clusters=dict(channel_clusters or {}),
+                   timestamp=utcnow(), trace_id=new_trace_id())
+
+    def validate(self) -> None:
+        if self.message_type != MSG_CLUSTER_UPDATE:
+            raise ValueError(
+                f"invalid cluster update message type: {self.message_type}")
+        if not self.worker_id:
+            raise ValueError("cluster update worker_id cannot be empty")
+        if self.k <= 0:
+            raise ValueError("cluster update k must be positive")
+        if self.sizes and len(self.sizes) != self.k:
+            raise ValueError(
+                f"cluster update carries {len(self.sizes)} sizes for k="
+                f"{self.k}")
+        for c in self.underpopulated:
+            if not 0 <= int(c) < self.k:
+                raise ValueError(f"underpopulated cluster id {c} out of "
+                                 f"range for k={self.k}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "message_type": self.message_type,
+            "worker_id": self.worker_id,
+            "k": self.k,
+            "step": self.step,
+            "vectors": self.vectors,
+            "sizes": self.sizes,
+            "inertia": self.inertia,
+            "underpopulated": self.underpopulated,
+            "channel_clusters": self.channel_clusters,
+            "timestamp": _opt_time(self.timestamp),
+            "trace_id": self.trace_id,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ClusterUpdateMessage":
+        inertia = d.get("inertia")
+        return cls(
+            message_type=d.get("message_type", MSG_CLUSTER_UPDATE),
+            worker_id=d.get("worker_id", "") or "",
+            k=int(d.get("k") or 0),
+            step=int(d.get("step") or 0),
+            vectors=int(d.get("vectors") or 0),
+            sizes=[int(s) for s in (d.get("sizes") or [])],
+            inertia=float(inertia) if inertia is not None else None,
+            underpopulated=[int(c) for c in (d.get("underpopulated") or [])],
+            channel_clusters={str(ch): int(c) for ch, c in
+                              (d.get("channel_clusters") or {}).items()},
             timestamp=parse_time(d.get("timestamp")),
             trace_id=d.get("trace_id", "") or "",
         )

@@ -47,8 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
     a("--log-json", action="store_const", const=True, default=None)
     a("--mode", default=None,
       help="standalone | launch | orchestrator | worker | job | "
-           "job-submit | tpu-worker | asr-worker | train-head | cluster | "
-           "bus | transcribe | dc-gateway | gen-code")
+           "job-submit | tpu-worker | asr-worker | cluster-worker | "
+           "train-head | cluster | bus | transcribe | dc-gateway | "
+           "gen-code")
     a("--worker-id", default=None, help="worker identifier (worker modes)")
     a("--concurrency", type=int, default=None)
     a("--timeout", type=int, default=None, help="HTTP timeout seconds")
@@ -412,6 +413,33 @@ def build_parser() -> argparse.ArgumentParser:
     a("--cluster-k", type=int, default=None)
     a("--cluster-iters", type=int, default=None)
     a("--cluster-output", default=None, help="output JSON path")
+    # Streaming clustering (mode=cluster-worker, `cluster/`): the online
+    # k-means serving worker consuming embedding-carrying result batches
+    # from TOPIC_INFERENCE_RESULTS (--cluster-k is shared with the
+    # offline mode above).
+    a("--cluster-serve", action="store_const", const=True, default=None,
+      help="declare a clustering stage attached to this deployment: "
+           "serve-mode brokers pull-enable TOPIC_INFERENCE_RESULTS so a "
+           "cluster worker's frames requeue across its restarts, and a "
+           "TPU worker with --no-publish-embeddings is rejected loudly")
+    a("--cluster-buckets", nargs="+", type=int, default=None,
+      help="row-count buckets for the k-means mini-batch step (one "
+           "compiled program per bucket; default 64 256)")
+    a("--cluster-checkpoint-every", type=int, default=None,
+      help="checkpoint centroids atomically every N committed batches "
+           "(default 8; 0 disables the count trigger — graceful stop "
+           "still checkpoints)")
+    a("--cluster-min-fraction", type=float, default=None,
+      help="a cluster is under-populated below this fraction of the "
+           "uniform share (default 0.5) — the frontier-priority signal "
+           "on TOPIC_CLUSTERS")
+    a("--no-publish-embeddings", dest="publish_embeddings",
+      action="store_const", const=False, default=None,
+      help="strip embedding vectors from result batches published on "
+           "TOPIC_INFERENCE_RESULTS (bus bandwidth; the JSONL "
+           "write_embeddings knob is independent).  Rejected when "
+           "clustering is enabled (--cluster-serve): the cluster worker "
+           "consumes those embeddings")
     a("--generate-code", action="store_true",
       help="run the Telegram auth bootstrap (TG_* env vars) and write "
            "credentials.json under --tdlib-dir, then exit (alias: "
@@ -592,6 +620,11 @@ _KEY_MAP = {
     "cluster_k": "cluster.k",
     "cluster_iters": "cluster.iters",
     "cluster_output": "cluster.output_file",
+    "cluster_serve": "cluster.enabled",
+    "cluster_buckets": "cluster.buckets",
+    "cluster_checkpoint_every": "cluster.checkpoint_every_batches",
+    "cluster_min_fraction": "cluster.min_cluster_fraction",
+    "publish_embeddings": "inference.publish_embeddings",
     "tdlib_dir": "tdlib.dir",
     "dc_address": "tdlib.dc_address",
     "dc_tls": "tdlib.dc_tls",
@@ -743,8 +776,9 @@ def resolve_config(args: argparse.Namespace,
     # neither do the non-crawling service modes (TPU inference / training /
     # clustering).
     if not cfg.validate_only and r.get_str("distributed.mode", "") not in (
-            "tpu-worker", "asr-worker", "train-head", "cluster", "bus",
-            "job-submit", "transcribe", "dc-gateway", "gen-code"):
+            "tpu-worker", "asr-worker", "cluster-worker", "train-head",
+            "cluster", "bus", "job-submit", "transcribe", "dc-gateway",
+            "gen-code"):
         validate_sampling_method(SamplingValidationInput(
             platform=cfg.platform, sampling_method=cfg.sampling_method,
             url_list=r.get_list("crawler.urls"),
@@ -825,7 +859,7 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
     # unconditionally) — EXCEPT the serving workers (tpu-worker /
     # asr-worker), where the worker's own start() owns the metrics port
     # (binding here too would EADDRINUSE its startup).
-    if mode not in ("tpu-worker", "asr-worker"):
+    if mode not in ("tpu-worker", "asr-worker", "cluster-worker"):
         metrics_port = r.get_int("observability.metrics_port", 0)
         if metrics_port:
             from .utils.metrics import serve_metrics
@@ -880,6 +914,8 @@ def main(argv: Optional[List[str]] = None, env=None) -> int:
             _run_tpu_worker(cfg, r)
         elif mode == "asr-worker":
             _run_asr_worker(cfg, r)
+        elif mode == "cluster-worker":
+            _run_cluster_worker(cfg, r)
         elif mode == "bus":
             # Dedicated broker process — the in-tree analog of the
             # reference's always-on Dapr sidecar (`daprstate.go:119-133`).
@@ -1289,6 +1325,18 @@ def _make_bus(r: ConfigResolver, serve: bool = False):
         server.enable_pull(TOPIC_INFERENCE_BATCHES)
         server.enable_pull(TOPIC_MEDIA_BATCHES)
         server.enable_pull(TOPIC_JOBS)
+        get_bool = getattr(r, "get_bool", None)  # partial test resolvers
+        if (callable(get_bool) and get_bool("cluster.enabled", False)) \
+                or r.get_str("distributed.mode", "") == "cluster-worker":
+            # A clustering stage is attached (`--cluster-serve` /
+            # `cluster.enabled`, or this IS the cluster worker hosting
+            # its own broker): the result stream becomes a pull topic so
+            # a dead cluster worker's un-acked frames requeue.  Gated,
+            # because pull-enabling it with no consumer would accumulate
+            # every result frame forever.
+            from .bus.messages import TOPIC_INFERENCE_RESULTS
+
+            server.enable_pull(TOPIC_INFERENCE_RESULTS)
         server.start()
         return server
     from .bus.grpc_bus import RemoteBus
@@ -1989,6 +2037,15 @@ def _build_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver):
     serve = r.get_bool("distributed.bus_serve", False)
     if serve and not r.get_str("distributed.bus_address"):
         raise CliConfigError("--bus-serve requires --bus-address")  # early
+    if r.get_bool("cluster.enabled", False) \
+            and not r.get_bool("inference.publish_embeddings", True):
+        # The loud half of the publish_embeddings knob: a clustering
+        # stage is declared but this worker would publish result batches
+        # with the embeddings stripped — the cluster worker downstream
+        # would starve silently, batch after batch.
+        raise CliConfigError(
+            "--cluster-serve (cluster.enabled) requires embedding-"
+            "carrying result batches; drop --no-publish-embeddings")
     # Engine and sink before the bus: if either raises (bad model key,
     # unreachable object store, too few devices for the mesh), no server
     # port has been bound and no threads need tearing down.
@@ -2016,6 +2073,8 @@ def _build_tpu_worker(cfg: CrawlerConfig, r: ConfigResolver):
                      cfg=TPUWorkerConfig(
                          worker_id=r.get_str("distributed.worker_id")
                          or "tpu-worker-0",
+                         publish_embeddings=r.get_bool(
+                             "inference.publish_embeddings", True),
                          heartbeat_s=_heartbeat_interval(r),
                          metrics_port=r.get_int(
                              "observability.metrics_port", 0),
@@ -2133,6 +2192,85 @@ def _run_asr_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
                 logger.warning("reentry bridge close failed: %s", e)
         try:
             worker.bus.close()
+        except Exception as e:
+            logger.warning("bus close failed: %s", e)
+
+
+def _build_cluster_worker(cfg: CrawlerConfig, r: ConfigResolver):
+    """Construct the streaming clustering worker (engine + assignment
+    sink + config) — split from the serve loop so the wiring is
+    testable (the _build_tpu_worker discipline)."""
+    from .cluster.engine import ClusterEngine, ClusterEngineConfig
+    from .cluster.worker import ClusterWorker, ClusterWorkerConfig
+    from .inference.worker import build_serving_mesh
+    from .state.providers import LocalStorageProvider
+
+    serve = r.get_bool("distributed.bus_serve", False)
+    if serve and not r.get_str("distributed.bus_address"):
+        raise CliConfigError("--bus-serve requires --bus-address")
+    # Engine before the bus: a bad mesh/bucket config must fail before
+    # any port is bound.
+    mesh = build_serving_mesh(
+        data=cfg.inference.mesh_data, seq=cfg.inference.mesh_seq,
+        tensor=cfg.inference.mesh_tensor,
+        devices=cfg.inference.mesh_devices)
+    buckets = tuple(int(b) for b in r.get_list("cluster.buckets")) \
+        or (64, 256)
+    engine = ClusterEngine(
+        ClusterEngineConfig(k=r.get_int("cluster.k", 16), buckets=buckets),
+        mesh=mesh)
+    if cfg.object_store_url:
+        from .state.objectstore import (
+            ObjectStorageProvider,
+            make_object_client,
+        )
+
+        provider = ObjectStorageProvider(
+            make_object_client(cfg.object_store_url))
+    else:
+        provider = LocalStorageProvider(cfg.storage_root)
+    bus = _make_serving_bus(r) if serve else _make_bus(r)
+    return ClusterWorker(
+        bus, engine=engine, provider=provider,
+        cfg=ClusterWorkerConfig(
+            worker_id=r.get_str("distributed.worker_id")
+            or "cluster-worker-0",
+            heartbeat_s=_heartbeat_interval(r),
+            metrics_port=r.get_int("observability.metrics_port", 0),
+            k=r.get_int("cluster.k", 16),
+            buckets=buckets,
+            checkpoint_every_batches=r.get_int(
+                "cluster.checkpoint_every_batches", 8),
+            min_cluster_fraction=r.get_float(
+                "cluster.min_cluster_fraction", 0.5),
+            slo_batch_p95_ms=r.get_float(
+                "observability.slo_batch_p95_ms", 0.0),
+            slo_queue_wait_ms=r.get_float(
+                "observability.slo_queue_wait_ms", 0.0),
+            slo_batch_age_ms=r.get_float(
+                "observability.slo_batch_age_ms", 0.0),
+            span_export_interval_s=r.get_float(
+                "observability.span_export_interval_s", 15.0),
+            span_export_max_spans=r.get_int(
+                "observability.span_export_max_spans", 512),
+            span_sample_rate=r.get_float(
+                "observability.span_sample_rate", 1.0)))
+
+
+def _run_cluster_worker(cfg: CrawlerConfig, r: ConfigResolver) -> None:
+    """mode=cluster-worker: the streaming clustering worker (BASELINE
+    config #5 live) — embedding-carrying result batches in, cluster
+    assignments + /clusters + TOPIC_CLUSTERS updates out.  A restart
+    resumes the centroid model from the last atomic checkpoint."""
+    worker = _build_cluster_worker(cfg, r)
+    worker.warmup()  # compile bucket programs when a checkpoint fixed dim
+    worker.start()
+    try:
+        _serve_forever()
+    finally:
+        worker.stop()
+        try:
+            worker.bus.close()  # serve-mode: broker + loopback client too
         except Exception as e:
             logger.warning("bus close failed: %s", e)
 

@@ -1,0 +1,23 @@
+"""cluster/ — streaming distributed clustering (BASELINE config #5).
+
+The pipeline's organize stage: the `ClusterWorker` subscribes to the
+embedding-carrying result batches the TPU worker publishes on
+``TOPIC_INFERENCE_RESULTS``, folds them into an online spherical
+mini-batch k-means model (`ClusterEngine`, reusing the jitted
+MXU-friendly kernels of `models/clustering.py`), writes per-batch
+assignment ledgers idempotently through the state layer, checkpoints
+centroids atomically for crash recovery, serves `/clusters`, and
+announces `ClusterUpdateMessage`s on ``TOPIC_CLUSTERS`` for the
+orchestrator's cluster-guided frontier prioritization.
+"""
+
+from .engine import ClusterEngine, ClusterEngineConfig
+from .worker import ClusterWorker, ClusterWorkerConfig, iter_assignments
+
+__all__ = [
+    "ClusterEngine",
+    "ClusterEngineConfig",
+    "ClusterWorker",
+    "ClusterWorkerConfig",
+    "iter_assignments",
+]

@@ -1,0 +1,467 @@
+"""Online spherical mini-batch k-means as jitted JAX on the serving mesh.
+
+The device half of BASELINE config #5 (snowball crawl -> E5 embed ->
+distributed clustering), in the shape of Sculley's web-scale mini-batch
+k-means (WWW 2010) adapted to the serving stack: embeddings stream in as
+mini-batches, each step is ONE compiled program per row-count bucket —
+assignment is a ``[B, D] x [D, K]`` matmul on the MXU, the update a
+one-hot einsum — and centroids fold with the exact per-center running
+mean (Sculley's 1/n learning rate).  The math reuses
+`models/clustering.py`'s kernels (`assign`/`update`/
+`kmeans_plus_plus_init`), so the online step is provably the batch
+Lloyd update applied to one mini-batch (pinned by
+tests/test_cluster_serve.py's online-vs-batch parity).
+
+Static shapes: mini-batches pad up to a fixed row bucket behind a row
+mask (pad rows assign to the out-of-range id ``k``, whose one-hot is all
+zeros — they touch neither sums nor counts), so serving dispatches one
+compiled step per bucket, never per fill level.  Per-step FLOPs are
+captured into the shared cost model as ``path="cluster"`` rows
+(`utils/costmodel.kmeans_step_flops` analytic fallback) and every
+dispatch feeds a rolling `EfficiencyMeter`, so `/costs` shows
+MFU/goodput for the clustering programs exactly like the text and ASR
+paths.
+
+Mesh: pass the serving mesh (`inference.worker.build_serving_mesh`) and
+each mini-batch's rows shard over the dp axis (`parallel.sharding.
+shard_batch`) with centroids replicated — XLA inserts the cross-chip
+psums for the one-hot sums/counts, the `models/clustering.fit_sharded`
+recipe.  Buckets that don't divide the dp size fall back to replicated
+dispatch (correct, just unsharded).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..models import clustering
+from ..utils.costmodel import (
+    CostModel,
+    EfficiencyMeter,
+    kmeans_step_flops,
+)
+from ..utils.metrics import REGISTRY, MetricsRegistry
+
+logger = logging.getLogger("dct.cluster.engine")
+
+
+@dataclass
+class ClusterEngineConfig:
+    """Knobs for the online k-means engine (the `cluster:` config block)."""
+
+    k: int = 16
+    # Row-count buckets (ascending): a mini-batch pads to the smallest
+    # bucket that fits; oversized groups chunk by the largest.  One
+    # compiled step per bucket — the engine's whole program set.
+    buckets: Tuple[int, ...] = (64, 256)
+    # Spherical k-means: rows and centroids L2-normalize, so assignment
+    # is cosine similarity — the right metric for E5-style embeddings.
+    spherical: bool = True
+    seed: int = 0
+    # Rolling per-step mean-inertia history (the /clusters trend the
+    # gate's max_inertia_growth judges).
+    inertia_window: int = 256
+
+    def validate(self) -> None:
+        if self.k <= 0:
+            raise ValueError("cluster k must be positive")
+        if not self.buckets or any(int(b) <= 0 for b in self.buckets):
+            raise ValueError("cluster buckets must be positive ints")
+
+
+class ClusterEngine:
+    """Streaming mini-batch k-means state + its compiled step programs.
+
+    Thread-safety: ``observe``/``state_dict``/``load_state``/``snapshot``
+    serialize on one lock — the serving worker's feed loop is the only
+    writer, the heartbeat/HTTP threads read.
+    """
+
+    def __init__(self, cfg: ClusterEngineConfig = ClusterEngineConfig(),
+                 mesh=None, registry: MetricsRegistry = REGISTRY):
+        cfg.validate()
+        self.cfg = cfg
+        self.mesh = mesh
+        self.n_devices = getattr(mesh, "size", 1) if mesh is not None else 1
+        self._lock = threading.RLock()
+        self.dim: Optional[int] = None
+        self.centroids = None           # [K, D] f32 device array
+        self.counts = None              # [K] f32 device array
+        self.step = 0
+        self.vectors = 0
+        self.resumed_from_step: Optional[int] = None
+        self._steps: Dict[int, Any] = {}     # bucket -> jitted step fn
+        self._inertia: "deque[float]" = deque(maxlen=cfg.inertia_window)
+        self._buckets = tuple(sorted(int(b) for b in cfg.buckets))
+        # Shared cost plumbing (`utils/costmodel.py`): path="cluster"
+        # rows land next to text/asr on /costs, and the rolling meter
+        # treats one embedding row as one "token" (vectors/s IS the
+        # goodput unit for this path).
+        self.costs = CostModel(registry=registry)
+        # path="cluster": the gauges become labeled children so a text
+        # engine sharing this registry (the gate rig) keeps its own
+        # unlabeled mfu/goodput series instead of flapping between the
+        # two meters' windows.
+        self.meter = EfficiencyMeter(registry=registry,
+                                     n_devices=self.n_devices,
+                                     path="cluster")
+        self.m_compile_miss = registry.counter(
+            "tpu_engine_compile_cache_misses_total",
+            "jit program builds by bucket and path (first-dispatch "
+            "compiles)")
+
+    # -- compiled step -----------------------------------------------------
+    def _step_fn(self, bucket: int):
+        import jax
+
+        fn = self._steps.get(bucket)
+        if fn is None:
+            self.m_compile_miss.labels(bucket=str(bucket),
+                                       path="cluster").inc()
+            k = self.cfg.k
+            spherical = self.cfg.spherical
+
+            def step(centroids, counts, x, mask):
+                import jax.numpy as jnp
+
+                x = x.astype(jnp.float32)
+                if spherical:
+                    x = x / jnp.maximum(
+                        jnp.linalg.norm(x, axis=1, keepdims=True), 1e-12)
+                assigns = clustering.assign(x, centroids)
+                # Pad rows assign out of range: one_hot(k, k) is a zero
+                # row, so they contribute to neither sums nor counts.
+                assigns = jnp.where(mask, assigns, k).astype(jnp.int32)
+                sums, bcounts = clustering.update(x, assigns, k)
+                new_counts = counts + bcounts
+                # Exact per-center running mean — Sculley's 1/n
+                # learning rate: c <- (n*c + sum) / (n + batch_n).
+                fresh = (counts[:, None] * centroids + sums) \
+                    / jnp.maximum(new_counts, 1.0)[:, None]
+                new_centroids = jnp.where((bcounts > 0)[:, None], fresh,
+                                          centroids)
+                if spherical:
+                    new_centroids = new_centroids / jnp.maximum(
+                        jnp.linalg.norm(new_centroids, axis=1,
+                                        keepdims=True), 1e-12)
+                safe = jnp.clip(assigns, 0, k - 1)
+                diff = x - new_centroids[safe]
+                inertia = jnp.sum(
+                    jnp.sum(diff * diff, axis=1) * mask.astype(jnp.float32))
+                return new_centroids, new_counts, assigns, inertia
+
+            fn = jax.jit(step)
+            self._steps[bucket] = fn
+        return fn
+
+    def _bucket_for(self, rows: int) -> int:
+        for b in self._buckets:
+            if rows <= b:
+                return b
+        return self._buckets[-1]
+
+    def _place(self, x, mask):
+        """Shard the padded mini-batch over the mesh's dp axis (centroids
+        stay replicated); single-device and non-divisible buckets pass
+        through unsharded."""
+        import jax.numpy as jnp
+
+        arrs = (jnp.asarray(x), jnp.asarray(mask))
+        if self.mesh is not None and self.n_devices > 1:
+            from ..parallel.sharding import shard_batch
+
+            arrs = shard_batch(arrs, self.mesh)
+        return arrs
+
+    # -- seeding -----------------------------------------------------------
+    def _seed(self, x) -> None:
+        """k-means++ over the first mini-batch's real rows (cycled when
+        fewer than k — duplicates separate as the stream updates them)."""
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.asarray(x, jnp.float32)
+        if self.cfg.spherical:
+            x = x / jnp.maximum(
+                jnp.linalg.norm(x, axis=1, keepdims=True), 1e-12)
+        centroids = clustering.kmeans_plus_plus_init(
+            x, self.cfg.k, jax.random.PRNGKey(self.cfg.seed))
+        if self.cfg.spherical:
+            centroids = centroids / jnp.maximum(
+                jnp.linalg.norm(centroids, axis=1, keepdims=True), 1e-12)
+        with self._lock:  # re-entrant: observe() already holds it
+            self.centroids = centroids
+            self.counts = jnp.zeros((self.cfg.k,), jnp.float32)
+        logger.info("cluster engine seeded: k=%d dim=%d from %d rows",
+                    self.cfg.k, x.shape[1], x.shape[0])
+
+    # -- public API --------------------------------------------------------
+    def observe(self, vectors: Sequence[Sequence[float]]) -> List[int]:
+        """Fold one mini-batch of embeddings into the model; returns the
+        cluster assignment per input row (in input order).
+
+        The first call fixes ``dim`` and seeds the centroids; later
+        mini-batches whose dim differs raise (a mixed-model embedding
+        stream is a deployment error, not something to average away).
+
+        ATOMIC across bucket chunks: an oversized mini-batch dispatches
+        several chunked steps against LOCAL state and commits only when
+        every chunk succeeded — a device failure on chunk 2 leaves the
+        model exactly as it was, so the caller's per-batch isolation
+        retry cannot double-fold chunk 1's rows.  Device dispatch (and
+        any first-call XLA compile) runs OUTSIDE the state lock, so
+        snapshot/HTTP readers never block on a compile; the SINGLE
+        writer contract (one feed loop per engine, `cluster/worker.py`)
+        is what makes the read-modify-commit safe.
+        """
+        import numpy as np
+
+        if not len(vectors):
+            return []
+        x_all = np.asarray(vectors, dtype=np.float32)
+        if x_all.ndim != 2:
+            raise ValueError(
+                f"embeddings must be a [N, D] matrix, got shape "
+                f"{x_all.shape}")
+        with self._lock:
+            if self.dim is None:
+                self.dim = int(x_all.shape[1])
+            elif int(x_all.shape[1]) != self.dim:
+                raise ValueError(
+                    f"embedding dim {x_all.shape[1]} != model dim "
+                    f"{self.dim}")
+            if self.centroids is None:
+                self._seed(x_all)
+            centroids, counts = self.centroids, self.counts
+        out: List[int] = []
+        inertias: List[float] = []
+        steps = 0
+        cap = self._buckets[-1]
+        for off in range(0, x_all.shape[0], cap):
+            chunk = x_all[off:off + cap]
+            centroids, counts, assigns, inertia = self._dispatch_chunk(
+                centroids, counts, chunk)
+            out.extend(assigns)
+            inertias.append(inertia / max(1, len(chunk)))
+            steps += 1
+        with self._lock:  # every chunk succeeded: commit atomically
+            self.centroids, self.counts = centroids, counts
+            self.step += steps
+            self.vectors += int(x_all.shape[0])
+            self._inertia.extend(inertias)
+        return out
+
+    def _dispatch_chunk(self, centroids, counts, x: "Any"):
+        """One padded bucket step over explicit state; returns
+        (new_centroids, new_counts, assignments, inertia) without
+        touching self.* model state (the observe() commit does)."""
+        import jax
+        import numpy as np
+
+        rows = int(x.shape[0])
+        bucket = self._bucket_for(rows)
+        padded = np.zeros((bucket, self.dim), dtype=np.float32)
+        padded[:rows] = x
+        mask = np.zeros((bucket,), dtype=np.float32)
+        mask[:rows] = 1.0
+        fn = self._step_fn(bucket)
+        placed = self._place(padded, mask)
+        t0 = time.perf_counter()
+        new_centroids, new_counts, assigns, inertia = fn(
+            centroids, counts, *placed)
+        jax.block_until_ready(assigns)
+        dt = time.perf_counter() - t0
+        if not self.costs.has(bucket, "cluster"):
+            self.costs.capture(
+                bucket, "cluster",
+                lambda: fn.lower(centroids, counts, *placed),
+                kmeans_step_flops(self.cfg.k, self.dim or 0, bucket),
+                batch=bucket, seq=self.dim or 0)
+        self.meter.record(dt, self.costs.flops_for(
+            bucket, "cluster",
+            default=kmeans_step_flops(self.cfg.k, self.dim, bucket)),
+            real_tokens=rows, slot_tokens=bucket)
+        return (new_centroids, new_counts,
+                [int(a) for a in np.asarray(assigns)[:rows]],
+                float(inertia))
+
+    def assign_only(self, vectors: Sequence[Sequence[float]]) -> List[int]:
+        """Nearest-centroid assignment WITHOUT folding the vectors into
+        the model — the redelivery path: a batch whose embeddings were
+        already folded (the worker's folded-batch window) must still get
+        assignments for its (re-)writeback, but updating the centroids a
+        second time would double-count the rows.  Pure host numpy: this
+        is the rare path, and a per-shape jit here would pay compile
+        churn for nothing."""
+        import numpy as np
+
+        with self._lock:
+            if self.centroids is None:
+                raise ValueError("cluster model not seeded")
+            c = np.asarray(self.centroids, dtype=np.float32)
+        x = np.asarray(vectors, dtype=np.float32)
+        if self.cfg.spherical:
+            x = x / np.maximum(
+                np.linalg.norm(x, axis=1, keepdims=True), 1e-12)
+        scores = -2.0 * (x @ c.T) + np.sum(c * c, axis=1)[None, :]
+        return [int(i) for i in np.argmin(scores, axis=1)]
+
+    def warmup(self, dim: int) -> None:
+        """Compile every bucket's step program against throwaway state so
+        the first live mini-batches don't pay XLA compiles.  Model state
+        is untouched: a warmup must never look like a seed (the
+        crash-recovery gate proves centroids resume, not re-seed)."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            if self.centroids is not None and self.dim is not None:
+                dim = self.dim  # compile against the LIVE shapes
+            dummy_c = jnp.zeros((self.cfg.k, dim), jnp.float32)
+            dummy_n = jnp.zeros((self.cfg.k,), jnp.float32)
+            import jax
+
+            for bucket in self._buckets:
+                x = jnp.zeros((bucket, dim), jnp.float32)
+                mask = jnp.ones((bucket,), jnp.float32)
+                fn = self._step_fn(bucket)
+                placed = self._place(x, mask)
+                out = fn(dummy_c, dummy_n, *placed)
+                jax.block_until_ready(out[2])
+                if not self.costs.has(bucket, "cluster"):
+                    self.costs.capture(
+                        bucket, "cluster",
+                        lambda fn=fn, placed=placed:
+                        fn.lower(dummy_c, dummy_n, *placed),
+                        kmeans_step_flops(self.cfg.k, dim, bucket),
+                        batch=bucket, seq=dim)
+
+    # -- checkpoint state --------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        """JSON-safe model state for atomic checkpointing through the
+        state layer (`state/providers.py` save_json is tmp+rename)."""
+        import numpy as np
+
+        with self._lock:
+            return {
+                "schema": "dct-cluster-v1",
+                "k": self.cfg.k,
+                "dim": self.dim,
+                "spherical": self.cfg.spherical,
+                "step": self.step,
+                "vectors": self.vectors,
+                "centroids": np.asarray(self.centroids).tolist()
+                if self.centroids is not None else None,
+                "counts": np.asarray(self.counts).tolist()
+                if self.counts is not None else None,
+                "inertia_window": list(self._inertia),
+            }
+
+    def load_state(self, state: Dict[str, Any]) -> None:
+        """Resume from a checkpoint written by ``state_dict`` — the
+        crash-recovery path: a restarted worker continues the SAME model
+        (``resumed_from_step``), it never re-seeds."""
+        import jax.numpy as jnp
+
+        if int(state.get("k") or 0) != self.cfg.k:
+            raise ValueError(
+                f"checkpoint k={state.get('k')} != configured k="
+                f"{self.cfg.k}")
+        if "spherical" in state \
+                and bool(state["spherical"]) != self.cfg.spherical:
+            # Geometry mismatch is as incompatible as a different k:
+            # unnormalized euclidean updates against unit-sphere
+            # centroids (or vice versa) degrade silently.
+            raise ValueError(
+                f"checkpoint spherical={state['spherical']} != "
+                f"configured spherical={self.cfg.spherical}")
+        with self._lock:
+            self.dim = int(state["dim"]) if state.get("dim") else None
+            if state.get("centroids") is not None:
+                self.centroids = jnp.asarray(state["centroids"],
+                                             jnp.float32)
+                self.counts = jnp.asarray(state.get("counts") or
+                                          [0.0] * self.cfg.k, jnp.float32)
+            self.step = int(state.get("step") or 0)
+            self.vectors = int(state.get("vectors") or 0)
+            self._inertia.clear()
+            self._inertia.extend(
+                float(v) for v in state.get("inertia_window") or [])
+            self.resumed_from_step = self.step
+
+    # -- observability -----------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """The model half of the /clusters body (JSON-safe)."""
+        import numpy as np
+
+        with self._lock:
+            sizes = [int(c) for c in np.asarray(self.counts)] \
+                if self.counts is not None else []
+            norms = [round(float(n), 6) for n in
+                     np.linalg.norm(np.asarray(self.centroids), axis=1)] \
+                if self.centroids is not None else []
+            inertia = [round(v, 6) for v in self._inertia]
+            return {
+                "k": self.cfg.k,
+                "dim": self.dim,
+                "spherical": self.cfg.spherical,
+                "buckets": list(self._buckets),
+                "n_devices": self.n_devices,
+                "step": self.step,
+                "vectors": self.vectors,
+                "seeded": self.centroids is not None,
+                "sizes": sizes,
+                "nonempty": sum(1 for s in sizes if s > 0),
+                "centroid_norms": norms,
+                "inertia": inertia,
+                "inertia_per_vector": inertia[-1] if inertia else None,
+                "resumed_from_step": self.resumed_from_step,
+            }
+
+    def underpopulated(self, min_fraction: float = 0.5) -> List[int]:
+        """Cluster ids whose assignment share is under ``min_fraction``
+        of the uniform share (1/k) — the "sparse corners of the embedding
+        space" the cluster-guided frontier steers the crawl toward."""
+        import numpy as np
+
+        with self._lock:
+            if self.counts is None or self.vectors <= 0:
+                return []
+            counts = np.asarray(self.counts)
+            floor = min_fraction * self.vectors / self.cfg.k
+            return [int(i) for i in range(self.cfg.k)
+                    if counts[i] < floor]
+
+    def compile_cache_stats(self) -> Dict[str, Any]:
+        """Telemetry-heartbeat hook (`utils/telemetry.py` duck-typing):
+        which bucket programs exist + cumulative first-dispatch misses."""
+        misses: Dict[str, float] = {}
+        total = 0.0
+        for labels, value in self.m_compile_miss.series():
+            if not labels or labels.get("path") != "cluster":
+                continue
+            misses[f"cluster:{labels.get('bucket', '?')}"] = value
+            total += value
+        return {"programs_cluster": sorted(self._steps),
+                "misses_total": total, "misses": misses}
+
+    def efficiency_snapshot(self) -> Dict[str, Any]:
+        """Rolling MFU/goodput map for telemetry heartbeats; {} until the
+        first mini-batch lands."""
+        return self.meter.snapshot()
+
+    def cost_snapshot(self) -> Dict[str, Any]:
+        """The engine half of the /costs body (`set_costs_provider`)."""
+        return {
+            "model": f"kmeans-k{self.cfg.k}",
+            "k": self.cfg.k,
+            "dim": self.dim,
+            "buckets": list(self._buckets),
+            "n_devices": self.n_devices,
+            "costs": self.costs.snapshot(),
+            "efficiency": self.meter.snapshot(),
+        }

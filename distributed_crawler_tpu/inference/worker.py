@@ -140,6 +140,15 @@ class TPUWorkerConfig:
     profiler_port: int = 0            # 0 = off; >0 = jax.profiler gRPC port
     storage_prefix: str = "inference"
     write_embeddings: bool = True     # False: labels/scores only (smaller JSONL)
+    # Bus-bandwidth knob, independent of write_embeddings: whether result
+    # batches published on TOPIC_INFERENCE_RESULTS carry the full
+    # embedding vectors.  Embeddings dominate the result frame size
+    # (~3 KB/post at E5-large width), so a deployment with no downstream
+    # consumer can turn this off — but the streaming clustering stage
+    # (`cluster/`) REQUIRES it on, and config wiring rejects the
+    # combination loudly at startup (`cli.py`) / scenario load
+    # (`loadgen/gate.py`) instead of letting the cluster worker starve.
+    publish_embeddings: bool = True
     # Device-stall watchdog.  Shared/tunneled TPUs have been observed to
     # wedge for minutes (a jitted call that normally takes ~100 ms never
     # returns); the bus's ack-timeout requeues the frame, but the worker
@@ -725,14 +734,24 @@ class TPUWorker:
                              batch=batch.batch_id,
                              worker=self.cfg.worker_id)
 
+    @staticmethod
+    def _strip_embeddings(results):
+        return [{k: v for k, v in r.items() if k != "embedding"}
+                for r in results]
+
     def _commit(self, batch: RecordBatch, results) -> None:
-        if not self.cfg.write_embeddings:
-            results = [{k: v for k, v in r.items() if k != "embedding"}
-                       for r in results]
-        batch.results = results
+        # Two independent sinks, two independent knobs:
+        # publish_embeddings governs the BUS frame (the clustering
+        # stage's feed), write_embeddings the JSONL writeback.  They
+        # used to be one knob — turning off the JSONL embeddings also
+        # silently starved any result-stream consumer.
+        batch.results = results if self.cfg.publish_embeddings \
+            else self._strip_embeddings(results)
         self.m_batches.inc()
         self.bus.publish(TOPIC_INFERENCE_RESULTS, batch.to_dict())
         if self.provider is not None:
+            batch.results = results if self.cfg.write_embeddings \
+                else self._strip_embeddings(results)
             self._writeback(batch)
 
     def _writeback(self, batch: RecordBatch) -> None:

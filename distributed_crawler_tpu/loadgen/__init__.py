@@ -38,6 +38,7 @@ from .generator import (
 from .gate import (
     load_scenario,
     run_asr_scenario,
+    run_cluster_scenario,
     run_scenario,
     scenario_names,
     validate_gate_config,
@@ -60,6 +61,7 @@ __all__ = [
     "load_scenario",
     "run_scenario",
     "run_asr_scenario",
+    "run_cluster_scenario",
     "scenario_names",
     "validate_gate_config",
 ]

@@ -41,6 +41,7 @@ from typing import Any, Dict, List, Optional
 from ..bus.messages import (
     TOPIC_ALERTS,
     TOPIC_CHAOS,
+    TOPIC_CLUSTERS,
     TOPIC_INFERENCE_BATCHES,
     TOPIC_INFERENCE_RESULTS,
     TOPIC_MEDIA_BATCHES,
@@ -79,8 +80,18 @@ SCENARIO_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 _WORKER_KEYS = ("worker_id", "heartbeat_s", "queue_capacity",
                 "coalesce_batches", "pack", "stall_warn_s", "stall_exit_s",
                 "slo_batch_p95_ms", "slo_queue_wait_ms", "slo_batch_age_ms",
-                "write_embeddings", "span_export_interval_s",
+                "write_embeddings", "publish_embeddings",
+                "span_export_interval_s",
                 "span_export_max_spans", "span_sample_rate")
+# ClusterWorkerConfig fields a cluster scenario's "cluster_worker" block
+# may set (`cluster/worker.py`).
+_CLUSTER_WORKER_KEYS = ("worker_id", "heartbeat_s", "queue_capacity",
+                        "coalesce_batches", "k", "buckets", "spherical",
+                        "seed", "checkpoint_every_batches",
+                        "min_cluster_fraction", "channel_map_size",
+                        "slo_batch_p95_ms", "slo_queue_wait_ms",
+                        "slo_batch_age_ms", "span_export_interval_s",
+                        "span_export_max_spans", "span_sample_rate")
 _LOAD_KEYS = ("seed", "duration_s", "arrival", "rate_batches_per_s",
               "rate_profile", "ramp_from", "ramp_to", "ramp_batches",
               "records_per_batch", "zipf_a", "max_words", "platform_mix",
@@ -111,6 +122,19 @@ _GATE_KEYS_ASR = _GATE_KEYS_SHARED | {
     "max_transcript_errors", "reentry_required", "asr_batch_p95_ms",
     "goodput_min_media_per_s", "require_whisper_costs",
 }
+# The cluster runner has no DeviceTimeline (the k-means engine records
+# cost/efficiency, not occupancy), so the occupancy keys are REMOVED
+# rather than inherited: accepting a key the runner never evaluates
+# would violate the 'every gate key is read' contract this validator
+# exists to enforce.
+_GATE_KEYS_CLUSTER = (_GATE_KEYS_SHARED - {
+    "min_device_busy_fraction", "min_overlap_fraction",
+    "max_bubble_share"}) | {
+    # The embedding→assignment ledger + centroid-model envelope
+    # (`run_cluster_scenario`).
+    "min_clusters_nonempty", "max_inertia_growth", "require_cluster_costs",
+    "goodput_min_vectors_per_s", "require_resume", "min_timeseries_series",
+}
 
 
 _SCALE_DIRECTIONS = ("up", "down")
@@ -124,7 +148,9 @@ def validate_gate_config(scenario: Dict[str, Any]) -> None:
     checked-in scenario."""
     name = scenario.get("name", "?")
     gate_cfg = scenario.get("gate", {}) or {}
-    known = _GATE_KEYS_ASR if scenario.get("kind") == "asr" \
+    kind = scenario.get("kind")
+    known = _GATE_KEYS_ASR if kind == "asr" \
+        else _GATE_KEYS_CLUSTER if kind == "cluster" \
         else _GATE_KEYS_TEXT
     unknown = set(gate_cfg) - known
     if unknown:
@@ -174,13 +200,13 @@ def validate_gate_config(scenario: Dict[str, Any]) -> None:
     if autoscaler_cfg:
         from ..orchestrator.autoscaler import pools_from_config
 
-        if scenario.get("kind") == "asr":
+        if scenario.get("kind") in ("asr", "cluster"):
             # Accept-and-ignore would break the loud-validation rule:
-            # the ASR runner has no autoscaler wiring (yet).
+            # only the text runner has elastic-fleet wiring.
             raise ValueError(
                 f"scenario {name!r}: \"autoscaler\" blocks are not "
-                f"supported on kind=asr scenarios (the ASR gate has no "
-                f"elastic-fleet wiring)")
+                f"supported on kind={scenario['kind']} scenarios (only "
+                f"the text gate has elastic-fleet wiring)")
         extra = set(autoscaler_cfg) - {"pools", "eval_interval_s"}
         if extra:
             raise ValueError(
@@ -191,6 +217,24 @@ def validate_gate_config(scenario: Dict[str, Any]) -> None:
             raise ValueError(
                 f"scenario {name!r}: an "
                 f"\"autoscaler\" block needs a non-empty pools list")
+    if kind == "cluster":
+        # The loud half of the publish_embeddings satellite: a cluster
+        # scenario whose TPU worker strips embeddings from the result
+        # stream (or the writeback the ledger reconciles) would starve
+        # the clustering stage silently — reject at config time.
+        worker_cfg = scenario.get("worker", {}) or {}
+        if worker_cfg.get("publish_embeddings") is False:
+            raise ValueError(
+                f"scenario {name!r}: clustering is enabled but the "
+                f"worker block sets publish_embeddings=false — the "
+                f"cluster worker consumes embedding-carrying result "
+                f"batches on TOPIC_INFERENCE_RESULTS")
+        if worker_cfg.get("write_embeddings") is False:
+            raise ValueError(
+                f"scenario {name!r}: cluster scenarios need "
+                f"write_embeddings=true — the embedding→assignment "
+                f"ledger reconciles the inference writeback against the "
+                f"assignment writeback")
 
 
 def scenario_names() -> List[str]:
@@ -868,12 +912,18 @@ def run_scenario(scenario: Dict[str, Any],
     returns a verdict (status "pass" or "fail" per the envelope).
 
     Scenarios with ``"kind": "asr"`` run the media/ASR serving stack
-    instead of the text one (`run_asr_scenario`).
+    instead of the text one (`run_asr_scenario`); ``"kind": "cluster"``
+    runs the streaming clustering stack (`run_cluster_scenario`).
     """
     if scenario.get("kind") == "asr":
         if workload is not None:
             raise ValueError("--replay is not supported for ASR scenarios")
         return run_asr_scenario(scenario, overrides=overrides)
+    if scenario.get("kind") == "cluster":
+        if workload is not None:
+            raise ValueError(
+                "--replay is not supported for cluster scenarios")
+        return run_cluster_scenario(scenario, overrides=overrides)
     from ..bus.inmemory import InMemoryBus
     from ..bus.outbox import OutboxBus, OutboxConfig
     from ..config.crawler import CrawlerConfig
@@ -2224,3 +2274,513 @@ def run_asr_scenario(scenario: Dict[str, Any],
         if server is not None:
             _teardown("grpc-bus", server.close)
         shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+# --- the clustering gate (`cluster/`; scenarios with "kind": "cluster") ------
+
+class ClusterWorkerHandle(_ServingWorkerHandle):
+    """`_ServingWorkerHandle` over the `ClusterWorker`.
+
+    Every generation constructs a FRESH `ClusterWorker` (and with it a
+    fresh `ClusterEngine` — empty centroid memory) over the SAME storage
+    provider: recovery must come from the atomic checkpoint alone,
+    exactly like a restarted process.  A restart that continues with
+    ``resumed_from_step > 0`` (instead of re-seeding) is the
+    kill-cluster-worker scenario's centerpiece."""
+
+    def __init__(self, name: str, make_bus, provider,
+                 worker_cfg_kw: Dict[str, Any], registry):
+        super().__init__(name, make_bus, provider, registry)
+        self._cfg_kw = dict(worker_cfg_kw)
+
+    def _make_worker(self, bus):
+        from ..cluster.worker import ClusterWorker, ClusterWorkerConfig
+
+        kw = dict(self._cfg_kw)
+        if "buckets" in kw:
+            kw["buckets"] = tuple(int(b) for b in kw["buckets"])
+        return ClusterWorker(bus, provider=self._provider,
+                             cfg=ClusterWorkerConfig(worker_id=self.name,
+                                                     **kw),
+                             registry=self._registry)
+
+    def stall(self, seconds: float) -> None:
+        raise NotImplementedError(
+            "stall is not supported for cluster workers (use kill/restart)")
+
+
+def run_cluster_scenario(scenario: Dict[str, Any],
+                         overrides: Optional[Dict[str, Any]] = None
+                         ) -> Dict[str, Any]:
+    """Run one clustering scenario end-to-end in-process; returns the
+    verdict.
+
+    The assembled stack: synthetic text workload → ChaosBus →
+    ``TOPIC_INFERENCE_BATCHES`` → a real text `TPUWorker` (publishing
+    embedding-carrying result batches) → ``TOPIC_INFERENCE_RESULTS``
+    (pull-enabled on gRPC, so a killed cluster worker's un-acked frames
+    requeue) → `ClusterWorker` on a fresh `ClusterEngine` → idempotent
+    assignment writeback + atomic centroid checkpoints + `/clusters`.
+
+    The envelope adds the cluster-specific checks to the usual ones:
+
+    - **embedding→assignment ledger**: every post_uid the TPU worker
+      embedded (its writeback) must appear exactly once in the cluster
+      worker's assignment writeback — zero lost, zero duplicated, across
+      worker kills;
+    - ``min_clusters_nonempty`` / ``max_inertia_growth`` over the
+      `/clusters` body (centroid health);
+    - ``require_cluster_costs`` (default on): `/costs` must carry
+      ``path="cluster"`` program rows with nonzero FLOPs and nonzero
+      rolling MFU/goodput;
+    - ``require_resume``: the (restarted) cluster worker must have
+      resumed from a checkpoint — ``resumed`` true with
+      ``resume_step > 0``, i.e. centroids continued, never re-seeded.
+    """
+    from ..bus.inmemory import InMemoryBus
+    from ..bus.messages import TOPIC_SPANS, SpanBatchMessage
+    from ..cluster.worker import iter_assignments
+    from ..inference.engine import EngineConfig, InferenceEngine
+    from ..orchestrator.tracecollect import TraceCollector
+    from ..state.providers import InMemoryStorageProvider
+    from ..utils.metrics import (
+        MetricsRegistry,
+        clear_dtraces_provider,
+        serve_metrics,
+        set_dtraces_provider,
+    )
+
+    scenario = merge_overrides(scenario, overrides)
+    validate_gate_config(scenario)
+    name = scenario.get("name", "unnamed-cluster")
+    bus_kind = scenario.get("bus", "inmemory")
+    if bus_kind not in ("inmemory", "grpc"):
+        raise ValueError(f"scenario bus must be inmemory|grpc, "
+                         f"got {bus_kind!r}")
+    timeline = parse_timeline(scenario.get("chaos", []))
+    if bus_kind != "grpc" and any(f.action in ("kill", "restart", "down")
+                                  for f in timeline):
+        raise ValueError(
+            "kill/restart faults need bus='grpc' (the in-memory bus has "
+            "no competing-consumer requeue, so a killed worker's frames "
+            "would be lost by construction)")
+
+    load_cfg = LoadGenConfig(**{k: v
+                                for k, v in scenario.get("load", {}).items()
+                                if k in _LOAD_KEYS})
+    workload = SyntheticWorkload(load_cfg)
+    worker_kw = {k: v for k, v in scenario.get("worker", {}).items()
+                 if k in _WORKER_KEYS}
+    tpu_name = worker_kw.pop("worker_id", "tpu-1")
+    cluster_kw = {k: v
+                  for k, v in scenario.get("cluster_worker", {}).items()
+                  if k in _CLUSTER_WORKER_KEYS}
+    cluster_name = cluster_kw.pop("worker_id", "cluster-1")
+    gate_cfg = scenario.get("gate", {})
+    drain_timeout_s = float(scenario.get("drain_timeout_s", 30.0))
+
+    trace.configure(capacity=int(scenario.get("trace_buffer", 8192)))
+    flight.configure(capacity=int(scenario.get("flight_buffer", 4096)))
+    run_mark = f"run-{time.monotonic_ns()}"
+    flight.record("loadgen_run_start", mark=run_mark)
+    timeseries.STORE.reset()
+    registry = MetricsRegistry()
+
+    t_run0 = time.monotonic()
+    base_engine = InferenceEngine(
+        EngineConfig(**scenario.get("engine", {"model": "tiny"})),
+        registry=registry)
+    engine = ChaosEngine(base_engine)
+    provider = InMemoryStorageProvider()
+
+    server = None
+    inner_bus = None
+    tpu_handle = None
+    cluster_handle = None
+    http_server = None
+    controller = None
+    dtraces_provider = None
+    verdict: Dict[str, Any] = {"scenario": name, "bus": bus_kind,
+                               "kind": "cluster"}
+    try:
+        # --- bus fabric ---------------------------------------------------
+        if bus_kind == "grpc":
+            from ..bus.grpc_bus import GrpcBusServer, RemoteBus
+
+            server = GrpcBusServer("127.0.0.1:0")
+            server.enable_pull(TOPIC_INFERENCE_BATCHES)
+            # The clustering feed is a pull topic too: a killed cluster
+            # worker's un-acked result frames must requeue server-side,
+            # exactly like the inference topic for the TPU worker.
+            server.enable_pull(TOPIC_INFERENCE_RESULTS)
+            server.start()
+            addr = f"127.0.0.1:{server.bound_port}"
+            local_bus = server
+            make_worker_bus = lambda: RemoteBus(addr)  # noqa: E731
+        else:
+            inner_bus = InMemoryBus(sync=True)
+            local_bus = inner_bus
+            make_worker_bus = lambda: inner_bus  # noqa: E731
+        chaos_bus = ChaosBus(local_bus)
+        # Route the run's fan-out topics (the unrouted-counter
+        # discipline): chaos announcements and the cluster worker's
+        # periodic ClusterUpdateMessages.
+        local_bus.subscribe(TOPIC_CHAOS, lambda payload: None)
+        cluster_updates: List[Dict[str, Any]] = []
+        local_bus.subscribe(TOPIC_CLUSTERS,
+                            lambda payload: cluster_updates.append(payload))
+
+        # --- trace collection (no orchestrator here; the gate hosts the
+        # collector, subscribed the way one would) ------------------------
+        collector = TraceCollector(process="gate")
+        local_bus.subscribe(
+            TOPIC_SPANS,
+            lambda payload, ack=None:
+            collector.observe(SpanBatchMessage.from_dict(payload)))
+        dtraces_provider = collector.export
+        set_dtraces_provider(dtraces_provider)
+
+        # --- TPU worker (the embedding publisher) -------------------------
+        # Started BEFORE the cluster worker so the cluster worker's
+        # /status + /costs provider registrations win (last wins) and
+        # the verdict's /costs scrape reads the path="cluster" rows.
+        tpu_handle = WorkerHandle(tpu_name, make_worker_bus, engine,
+                                  provider, worker_kw, registry)
+        tpu_handle.start()
+        tpu_handle.worker.warmup()  # compile outside the measured phases
+
+        # --- cluster worker -----------------------------------------------
+        cluster_handle = ClusterWorkerHandle(cluster_name, make_worker_bus,
+                                             provider, cluster_kw, registry)
+        cluster_handle.start()
+
+        http_server = serve_metrics(0, registry)
+        port = http_server.server_address[1]
+
+        controller = ChaosController(
+            timeline,
+            targets={tpu_name: tpu_handle, cluster_name: cluster_handle},
+            bus=chaos_bus, publish_bus=local_bus)
+
+        def _pending() -> int:
+            n = 0
+            for h in (tpu_handle, cluster_handle):
+                w = h.worker
+                if w is None:
+                    continue
+                status = w.get_status()
+                n += int(status.get("queue_depth", 0)) \
+                    + int(status.get("inflight", 0))
+            if server is not None:
+                n += server.pending_count(TOPIC_INFERENCE_BATCHES)
+                n += server.pending_count(TOPIC_INFERENCE_RESULTS)
+            return n
+
+        def _drain_stack(timeout_s: float) -> bool:
+            """Embeddings flow two hops: drain broker → TPU worker →
+            broker again (its published result frames) → cluster
+            worker.  Killed generations resolve True (their pending
+            frames requeue to the next generation)."""
+            if server is not None:
+                server.drain(timeout_s=timeout_s)
+            ok = True
+            if tpu_handle.worker is not None:
+                ok &= tpu_handle.worker.drain(timeout_s=timeout_s)
+            if server is not None:
+                server.drain(timeout_s=timeout_s)
+            if cluster_handle.alive and cluster_handle.worker is not None:
+                ok &= cluster_handle.worker.drain(timeout_s=timeout_s)
+            return ok
+
+        def _evaluate_slos() -> None:
+            for h in (tpu_handle, cluster_handle):
+                if h.worker is not None:
+                    h.worker.evaluate_slos()
+
+        def _embedded_uids() -> Dict[str, int]:
+            return _written_uids(provider, [load_cfg.crawl_id])
+
+        def _assigned_uids() -> Dict[str, int]:
+            counts: Dict[str, int] = {}
+            for row in iter_assignments(provider, load_cfg.crawl_id):
+                uid = row.get("post_uid", "")
+                if uid:
+                    counts[uid] = counts.get(uid, 0) + 1
+            return counts
+
+        def _settle_assignments(timeout_s: float) -> None:
+            """Wait (bounded) until every embedded uid has an
+            assignment — the second hop is async behind the first."""
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                _drain_stack(min(5.0, timeout_s))
+                embedded = set(_embedded_uids())
+                assigned = set(_assigned_uids())
+                if embedded and embedded <= assigned:
+                    return
+                if not embedded and not assigned:
+                    time.sleep(0.05)
+                    continue
+                time.sleep(0.05)
+
+        # --- phase A: baseline (flush the SLO window) ----------------------
+        _evaluate_slos()
+        breaches_0 = _breach_counts(registry)
+
+        # --- phase B: load + chaos ----------------------------------------
+        logger.info("loadgen %s: cluster load phase starting", name)
+        t_b0 = time.monotonic()
+        stop = threading.Event()
+        stats_box: Dict[str, Any] = {}
+
+        def _gen():
+            stats_box["stats"] = workload.run(
+                chaos_bus, stop=stop, pending_fn=_pending)
+
+        gen_thread = threading.Thread(target=_gen, daemon=True,
+                                      name="dct-loadgen-cluster")
+        controller.start()
+        gen_thread.start()
+        gen_thread.join()
+        deadline = time.monotonic() + drain_timeout_s
+        while not controller.done() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        controller.stop()
+        drained = _drain_stack(drain_timeout_s)
+        _settle_assignments(drain_timeout_s)
+        _evaluate_slos()
+        breaches_fault = _delta(_breach_counts(registry), breaches_0)
+        t_b1 = time.monotonic()
+
+        # --- phase C: recovery tail ---------------------------------------
+        tail_cfg = scenario.get("tail", {})
+        tail_n = int(tail_cfg.get("batches", 6))
+        tail_gap = float(tail_cfg.get("gap_s", 0.05))
+        tail_records = int(tail_cfg.get("records_per_batch",
+                                        load_cfg.records_per_batch))
+        t_tail_wall = time.time()
+        breaches_mid = _breach_counts(registry)
+        for i in range(tail_n):
+            pb = PlannedBatch(10_000 + i, None, tuple(
+                PlannedRecord("telegram", 10)
+                for _ in range(tail_records)))
+            chaos_bus.publish(TOPIC_INFERENCE_BATCHES,
+                              workload.build_batch(pb).to_dict())
+            time.sleep(tail_gap)
+        tail_drained = _drain_stack(drain_timeout_s)
+        _settle_assignments(drain_timeout_s)
+        _evaluate_slos()
+        breaches_tail = _delta(_breach_counts(registry), breaches_mid)
+        t_end = time.monotonic()
+
+        # --- measurement ---------------------------------------------------
+        for h in (tpu_handle, cluster_handle):
+            if h.worker is not None:
+                export_fn = getattr(h.worker, "export_spans", None)
+                if callable(export_fn):
+                    export_fn()
+        spans = trace.TRACER.spans()
+        tail_queue_p95 = _p95_ms(spans, QUEUE_WAIT_SPANS, t_tail_wall)
+        tail_batch_p95 = _p95_ms(spans, BATCH_SPANS, t_tail_wall)
+        tail_age_p95 = _p95_ms(spans, BATCH_AGE_SPANS, t_tail_wall)
+
+        endpoints = {
+            "metrics": _scrape(port, "/metrics", as_json=False),
+            "costs": _scrape(port, "/costs", as_json=True),
+            "clusters": _scrape(port, "/clusters", as_json=True),
+            "dtraces": _scrape(port, "/dtraces", as_json=True),
+            "timeseries": _scrape(port, "/timeseries", as_json=True),
+        }
+
+        # --- the embedding→assignment ledger -------------------------------
+        expected = chaos_bus.expected_uids()
+        expected_set = set(expected)
+        embedded = _embedded_uids()
+        assigned = _assigned_uids()
+        lost = [u for u in expected if u not in embedded]
+        duplicates = [u for u, c in embedded.items() if c > 1]
+        # The clustering hop's own ledger: every embedding the TPU
+        # worker wrote must be assigned exactly once — across kills.
+        embedded_once = [u for u in embedded if u in expected_set]
+        cluster_lost = [u for u in embedded_once if u not in assigned]
+        cluster_dups = [u for u, c in assigned.items() if c > 1]
+        processed = sum(min(c, 1) for u, c in assigned.items()
+                        if u in expected_set)
+        active_s = max(1e-6, t_end - t_b0)
+        goodput = processed / active_s
+
+        # --- the envelope --------------------------------------------------
+        checks: Dict[str, Dict[str, Any]] = {}
+
+        def check(key: str, ok: bool, value, budget) -> None:
+            checks[key] = {"ok": bool(ok), "value": value, "budget": budget}
+
+        check("drained", drained and tail_drained,
+              {"fault": drained, "tail": tail_drained}, True)
+        check("lost", len(lost) <= int(gate_cfg.get("max_lost", 0)),
+              len(lost), int(gate_cfg.get("max_lost", 0)))
+        check("duplicates",
+              len(duplicates) <= int(gate_cfg.get("max_duplicates", 0)),
+              len(duplicates), int(gate_cfg.get("max_duplicates", 0)))
+        check("cluster_lost", not cluster_lost, len(cluster_lost),
+              "every embedded uid assigned exactly once")
+        check("cluster_duplicates", not cluster_dups, len(cluster_dups), 0)
+        for slo in gate_cfg.get("require_breach", []):
+            check(f"breach_{slo}", breaches_fault.get(slo, 0) > 0,
+                  breaches_fault.get(slo, 0), "> 0 during fault window")
+        for slo in gate_cfg.get("forbid_tail_breach", []):
+            check(f"tail_no_breach_{slo}",
+                  breaches_tail.get(slo, 0) == 0,
+                  breaches_tail.get(slo, 0), "0 in recovery tail")
+        if gate_cfg.get("queue_wait_p95_ms") is not None:
+            budget = float(gate_cfg["queue_wait_p95_ms"])
+            check("tail_queue_wait_p95_ms",
+                  tail_queue_p95 is not None and tail_queue_p95 <= budget,
+                  round(tail_queue_p95, 2) if tail_queue_p95 is not None
+                  else None, budget)
+        if gate_cfg.get("goodput_min_vectors_per_s") is not None:
+            floor = float(gate_cfg["goodput_min_vectors_per_s"])
+            check("goodput_vectors_per_s", goodput >= floor,
+                  round(goodput, 2), f">= {floor}")
+        # --- centroid-model health over /clusters --------------------------
+        clusters_body = endpoints["clusters"] or {}
+        nonempty = int(clusters_body.get("nonempty") or 0)
+        need_nonempty = int(gate_cfg.get("min_clusters_nonempty", 1))
+        check("clusters_nonempty", nonempty >= need_nonempty, nonempty,
+              f">= {need_nonempty}")
+        inertia_hist = [float(v) for v in
+                        (clusters_body.get("inertia") or [])]
+        inertia_growth = None
+        if gate_cfg.get("max_inertia_growth") is not None:
+            cap = float(gate_cfg["max_inertia_growth"])
+            if len(inertia_hist) >= 12:
+                # Skip the seeding warmup (first quarter): right after
+                # k-means++ the centroids sit ON the first mini-batch's
+                # points, so those steps' inertia is artificially near
+                # zero and ANY stream would measure as growth.  The
+                # baseline is the post-warmup quarter; the judged value
+                # the final quarter — online k-means must organize (or
+                # hold), not drift.
+                q = max(2, len(inertia_hist) // 4)
+                early = sum(inertia_hist[q:2 * q]) / q
+                late = sum(inertia_hist[-q:]) / q
+                if early > 0:
+                    inertia_growth = late / early
+            # Too-short history (or a zero baseline window) cannot judge
+            # a trend — the nonempty/ledger checks carry those runs.
+            check("inertia_growth",
+                  inertia_growth is None or inertia_growth <= cap,
+                  round(inertia_growth, 4)
+                  if inertia_growth is not None else "n/a",
+                  f"late/post-warmup mean <= {cap}")
+        if gate_cfg.get("require_resume"):
+            resumed = bool(clusters_body.get("resumed"))
+            resume_step = clusters_body.get("resume_step")
+            check("cluster_resumed",
+                  resumed and (resume_step or 0) > 0,
+                  {"resumed": resumed, "resume_step": resume_step},
+                  "restarted worker resumed checkpoint (no re-seed)")
+        if gate_cfg.get("require_cluster_costs", True):
+            costs_body = endpoints["costs"] or {}
+            rows = [c for c in costs_body.get("costs", [])
+                    if c.get("path") == "cluster"
+                    and (c.get("flops") or 0) > 0]
+            eff = costs_body.get("efficiency") or {}
+            ok = bool(rows) and (eff.get("mfu") or 0) > 0 \
+                and (eff.get("goodput_tokens_per_s") or 0) > 0
+            check("cluster_costs", ok,
+                  {"cluster_rows": len(rows), "mfu": eff.get("mfu"),
+                   "goodput": eff.get("goodput_tokens_per_s")},
+                  "path=cluster rows with nonzero flops + nonzero "
+                  "MFU/goodput")
+        dtrace_summary = _dtrace_checks(check, gate_cfg,
+                                        endpoints["dtraces"])
+        if gate_cfg.get("min_timeseries_series") is not None:
+            need = int(gate_cfg["min_timeseries_series"])
+            have = (endpoints["timeseries"] or {}).get("series_count", 0)
+            check("timeseries_series", have >= need, have,
+                  f">= {need} live series at /timeseries")
+        if gate_cfg.get("require_flight"):
+            events = flight.RECORDER.events()
+            start = 0
+            for i in range(len(events) - 1, -1, -1):
+                if events[i].get("kind") == "loadgen_run_start" \
+                        and events[i].get("mark") == run_mark:
+                    start = i
+                    break
+            kinds = {e.get("kind") for e in events[start:]}
+            for kind in gate_cfg["require_flight"]:
+                check(f"flight_{kind}", kind in kinds, kind in kinds, True)
+        for key in ("metrics", "costs", "clusters", "dtraces",
+                    "timeseries"):
+            check(f"endpoint_{key}", endpoints[key] is not None,
+                  endpoints[key] is not None, True)
+
+        stats = stats_box.get("stats")
+        verdict.update({
+            "status": "pass" if all(c["ok"] for c in checks.values())
+            else "fail",
+            "duration_s": round(time.monotonic() - t_run0, 2),
+            "published": {
+                **(stats.to_dict() if stats is not None else {}),
+                "dropped_batches": len(chaos_bus.dropped),
+                "poisoned_batches": len(chaos_bus.poisoned),
+            },
+            "expected_records": len(expected),
+            "embedded_records": sum(min(c, 1) for u, c in embedded.items()
+                                    if u in expected_set),
+            "assigned_records": processed,
+            "lost": len(lost),
+            "duplicates": len(duplicates),
+            "cluster_lost": len(cluster_lost),
+            "cluster_duplicates": len(cluster_dups),
+            "goodput_vectors_per_s": round(goodput, 2),
+            "fault_breaches": breaches_fault,
+            "tail_breaches": breaches_tail,
+            "tail_queue_wait_p95_ms": round(tail_queue_p95, 2)
+            if tail_queue_p95 is not None else None,
+            "tail_batch_p95_ms": round(tail_batch_p95, 2)
+            if tail_batch_p95 is not None else None,
+            "tail_batch_age_p95_ms": round(tail_age_p95, 2)
+            if tail_age_p95 is not None else None,
+            "fault_window_s": round(t_b1 - t_b0, 2),
+            "chaos_events": len(controller.events),
+            "worker_generations": cluster_handle.generation,
+            "cluster_updates": len(cluster_updates),
+            "clusters": {
+                "k": clusters_body.get("k"),
+                "nonempty": nonempty,
+                "step": clusters_body.get("step"),
+                "vectors": clusters_body.get("vectors"),
+                "inertia_per_vector":
+                    clusters_body.get("inertia_per_vector"),
+                "inertia_growth": round(inertia_growth, 4)
+                if inertia_growth is not None else None,
+                "resumed": clusters_body.get("resumed"),
+                "resume_step": clusters_body.get("resume_step"),
+                "underpopulated": clusters_body.get("underpopulated"),
+            },
+            "dtraces": dtrace_summary,
+            "checks": checks,
+        })
+        if lost[:5]:
+            verdict["lost_sample"] = lost[:5]
+        if cluster_lost[:5]:
+            verdict["cluster_lost_sample"] = cluster_lost[:5]
+        return verdict
+    finally:
+        if controller is not None:
+            _teardown("controller", controller.stop)
+        if cluster_handle is not None:
+            _teardown("cluster-worker", cluster_handle.stop)
+        if tpu_handle is not None:
+            _teardown("tpu-worker", tpu_handle.stop)
+        if dtraces_provider is not None:
+            _teardown("dtraces-provider",
+                      lambda: clear_dtraces_provider(dtraces_provider))
+        if http_server is not None:
+            _teardown("http-server", http_server.shutdown)
+        if inner_bus is not None:
+            _teardown("inmemory-bus", inner_bus.close)
+        if server is not None:
+            _teardown("grpc-bus", server.close)

@@ -29,11 +29,13 @@ from ..bus.messages import (
     PRIORITY_MEDIUM,
     STATUS_SUCCESS,
     TOPIC_ALERTS,
+    TOPIC_CLUSTERS,
     TOPIC_RESULTS,
     TOPIC_SPANS,
     TOPIC_WORK_QUEUE,
     TOPIC_WORKER_STATUS,
     AlertMessage,
+    ClusterUpdateMessage,
     WORKER_ACTIVE,
     WORKER_BUSY,
     WORKER_IDLE,
@@ -113,6 +115,11 @@ class OrchestratorConfig:
     # Both the distribute and health ticks call it; this limiter sets
     # the effective cadence.
     alert_eval_interval_s: float = 5.0
+    # Cluster-guided frontier prioritization (`cluster/`): how long the
+    # last ClusterUpdateMessage steers dispatch priorities.  Past the
+    # TTL the guide is ignored — a dead cluster worker's final snapshot
+    # must not promote pages forever.  0 disables expiry.
+    cluster_guide_ttl_s: float = 600.0
 
 
 @dataclass
@@ -215,6 +222,15 @@ class Orchestrator:
                 max_attempts=self.ocfg.publish_retry_attempts,
                 base_delay_s=0.05, max_delay_s=0.5))
 
+        # Cluster-guided frontier prioritization (`cluster/`): the
+        # latest ClusterUpdateMessage's under-populated cluster ids and
+        # channel->cluster map.  A frontier page whose channel maps to
+        # an under-populated cluster dispatches at PRIORITY_HIGH — the
+        # snowball steers toward the sparse corners of the embedding
+        # space instead of re-crawling the dense ones.
+        self._cluster_guide: Optional[Dict[str, Any]] = None
+        self._cluster_prioritized = 0
+
         self._mu = threading.RLock()
         self._running = False
         self._killed = False
@@ -256,6 +272,9 @@ class Orchestrator:
         # them, and a durable broker never holds alert frames as
         # unrouted dead letters just because no external tool listens.
         self.bus.subscribe(TOPIC_ALERTS, self.handle_alert_payload)
+        # Cluster-state announcements feed the frontier prioritization
+        # (fan-out: a missed update degrades freshness, never progress).
+        self.bus.subscribe(TOPIC_CLUSTERS, self.handle_cluster_payload)
         if self.resumed:
             self._resume_requeue(pending)
         if background:
@@ -781,7 +800,8 @@ class Orchestrator:
                                 depth=item.depth, platform=item.platform):
                     self._publish_policy.call(
                         self.bus.publish, TOPIC_WORK_QUEUE,
-                        WorkQueueMessage.new(item, PRIORITY_MEDIUM,
+                        WorkQueueMessage.new(item,
+                                             self._frontier_priority(item),
                                              self.ocfg.work_ttl_s))
                 published += 1
                 self._jappend("dispatch", item=item.to_dict(),
@@ -1000,6 +1020,63 @@ class Orchestrator:
         """The ``/alerts`` JSON body (alert lifecycle state + log);
         registered via `utils.metrics.set_alerts_provider` by the CLI."""
         return self.watchtower.get_alerts()
+
+    # -- cluster-guided frontier (`cluster/`) ------------------------------
+    def handle_cluster_payload(self, payload: Dict[str, Any]) -> None:
+        """Fold a ClusterUpdateMessage into the frontier-priority guide;
+        never raises into the bus."""
+        if self._killed:
+            return
+        try:
+            msg = ClusterUpdateMessage.from_dict(payload)
+            msg.validate()
+        except Exception as e:
+            logger.debug("undecodable cluster update: %s", e)
+            return
+        with self._mu:
+            self._cluster_guide = {
+                "worker_id": msg.worker_id,
+                "k": msg.k,
+                "step": msg.step,
+                "vectors": msg.vectors,
+                "underpopulated": set(int(c) for c in msg.underpopulated),
+                "channel_clusters": {
+                    ch.lower(): int(c)
+                    for ch, c in msg.channel_clusters.items()},
+                "inertia": msg.inertia,
+                "received_at": self.clock(),
+            }
+
+    @staticmethod
+    def _channel_of(url: str) -> str:
+        """Channel name from a frontier URL: the last non-empty path
+        segment, lowercased (t.me/<channel>, youtube.com/@<handle>, or a
+        bare channel name all resolve the same way)."""
+        tail = url.rstrip("/").rsplit("/", 1)[-1]
+        return tail.partition("?")[0].lstrip("@").lower()
+
+    def _frontier_priority(self, item: WorkItem) -> int:
+        """PRIORITY_HIGH when the page's channel last landed in an
+        under-populated cluster — the cluster-guided snowball: frontier
+        budget flows to the sparse corners of the embedding space.
+        A guide older than ``cluster_guide_ttl_s`` is ignored: a dead
+        cluster worker's final snapshot must not steer dispatch
+        forever."""
+        with self._mu:
+            guide = self._cluster_guide
+        if not guide or not guide["underpopulated"]:
+            return PRIORITY_MEDIUM
+        ttl = self.ocfg.cluster_guide_ttl_s
+        if ttl > 0 and self.clock() - guide["received_at"] > ttl:
+            return PRIORITY_MEDIUM
+        cluster = guide["channel_clusters"].get(self._channel_of(item.url))
+        if cluster is None or cluster not in guide["underpopulated"]:
+            return PRIORITY_MEDIUM
+        with self._mu:
+            self._cluster_prioritized += 1
+        flight.record("cluster_priority", work_item=item.id, url=item.url,
+                      cluster=int(cluster))
+        return PRIORITY_HIGH
 
     # -- worker registry (`orchestrator.go:419-449`) -----------------------
     def handle_status_payload(self, payload: Dict[str, Any]) -> None:
@@ -1246,6 +1323,15 @@ class Orchestrator:
                 "backpressure_active": (self._backpressure_active or self._circuit_backpressure),
                 "state_circuit": self._state_policy.breaker.state,
                 "resumed": self.resumed,
+                "cluster_guide": {
+                    "step": self._cluster_guide["step"],
+                    "vectors": self._cluster_guide["vectors"],
+                    "underpopulated": sorted(
+                        self._cluster_guide["underpopulated"]),
+                    "channels_mapped": len(
+                        self._cluster_guide["channel_clusters"]),
+                    "prioritized_items": self._cluster_prioritized,
+                } if self._cluster_guide else None,
                 "workers": {k: vars(v).copy()
                             for k, v in self.workers.items()},
                 "work_stats": {

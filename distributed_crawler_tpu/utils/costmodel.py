@@ -116,6 +116,22 @@ def whisper_forward_flops(cfg, batch: int, decode_len: int) -> float:
     return float(batch) * (encoder + cross_kv + decoder)
 
 
+def kmeans_step_flops(k: int, dim: int, rows: int) -> float:
+    """Analytic FLOPs for one online mini-batch k-means step — the
+    ``path="cluster"`` row of the cost table (`cluster/engine.py`), so
+    `/costs` MFU/goodput stay honest for the clustering programs too.
+
+    Assignment: one ``[rows, dim] x [dim, k]`` matmul (2·R·D·K) plus the
+    ``||c||²`` bias row (2·K·D).  Update: the one-hot segment-sum matmul
+    ``[k, rows] x [rows, dim]`` (2·R·D·K) plus the running-mean fold and
+    spherical renormalization over the centroid table (~6·K·D).
+    Normalizing the incoming rows costs ~3·R·D.  Multiply-accumulate
+    counted as 2 FLOPs, matching `encoder_forward_flops`.
+    """
+    r, d, kk = float(rows), float(dim), float(k)
+    return 4.0 * r * d * kk + 3.0 * r * d + 8.0 * kk * d
+
+
 def peak_flops(device_kind: str = "", platform: str = "",
                n_devices: int = 1) -> Tuple[float, str]:
     """(aggregate peak FLOP/s over ``n_devices``, source tag).
@@ -288,7 +304,8 @@ class EfficiencyMeter:
                  window_s: float = 60.0, max_records: int = 1024,
                  peak: Optional[float] = None, peak_source: str = "",
                  n_devices: int = 1,
-                 device_labels: Optional[List[str]] = None):
+                 device_labels: Optional[List[str]] = None,
+                 path: str = ""):
         self.window_s = window_s
         self._records: "deque[Tuple[float, float, float, int, int, Any]]" \
             = deque(maxlen=max_records)
@@ -316,6 +333,17 @@ class EfficiencyMeter:
             "tpu_engine_per_chip_goodput_tokens_per_s",
             "rolling-window REAL tokens/s attributed to one chip's data "
             "shard (uniform split when per-shard masks weren't recorded)")
+        if path:
+            # A second engine kind in the same process (the cluster
+            # engine next to the text engine in one gate registry) must
+            # not clobber the default meter's gauges: a ``path`` scopes
+            # this meter's mfu/goodput/density series to labeled
+            # children.  The per-chip gauge stays shared (its device
+            # label already splits series, and labels() on a labeled
+            # child would raise).
+            self.m_mfu = self.m_mfu.labels(path=path)
+            self.m_goodput = self.m_goodput.labels(path=path)
+            self.m_density = self.m_density.labels(path=path)
 
     def _resolve_peak(self) -> Tuple[float, str]:
         if self._peak is None:
@@ -422,10 +450,11 @@ class EfficiencyMeter:
             "padding_density": round(real / slot, 4) if slot else None,
             "peak_flops_per_s": peak or None,
             "peak_source": source,
-            # 6 decimals: a tiny-model CPU window has a REAL mfu of ~1e-5
-            # and must not round to a dead-chip-looking 0.0.
-            "mfu": round(achieved / peak, 6) if peak else None,
-            "mfu_busy": round(flops / busy / peak, 6)
+            # 9 decimals: a tiny-model CPU window has a REAL mfu of ~1e-5
+            # — and the k-means path's ~1e-7 — which must not round to a
+            # dead-chip-looking 0.0.
+            "mfu": round(achieved / peak, 9) if peak else None,
+            "mfu_busy": round(flops / busy / peak, 9)
             if peak and busy > 0 else None,
             "n_devices": self._n_devices,
         }

@@ -141,6 +141,14 @@ class FlightRecorder:
         autoscaler = autoscaler_snapshot()
         if autoscaler is not None:
             bundle["autoscaler"] = autoscaler
+        # Streaming-clustering state: a dead cluster worker's /clusters
+        # (sizes, inertia trend, resume step) tells the reader whether
+        # the centroid model was healthy when the process died.
+        from .metrics import clusters_snapshot
+
+        clusters = clusters_snapshot()
+        if clusters is not None:
+            bundle["clusters"] = clusters
         try:
             from . import timeseries as _timeseries
 

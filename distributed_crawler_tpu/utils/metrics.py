@@ -453,6 +453,40 @@ def alerts_snapshot():
         return {"error": str(e)}
 
 
+# Late-bound /clusters provider: the streaming clustering worker's
+# centroid-state view (`cluster/worker.py`) — per-cluster sizes,
+# centroid norms, inertia trend, assignment throughput, checkpoint +
+# resume state.
+_clusters_provider = None
+
+
+def set_clusters_provider(fn) -> None:
+    """Register the zero-arg dict provider served at /clusters (pass
+    None to clear)."""
+    global _clusters_provider
+    _clusters_provider = fn
+
+
+def clear_clusters_provider(fn) -> None:
+    """Unregister ``fn`` only if it is still the active provider."""
+    global _clusters_provider
+    if _clusters_provider == fn:
+        _clusters_provider = None
+
+
+def clusters_snapshot():
+    """The active /clusters body, or None without a provider — the
+    flight recorder calls this so postmortem bundles carry the centroid
+    state a dead cluster worker can no longer serve."""
+    fn = _clusters_provider
+    if fn is None:
+        return None
+    try:
+        return fn()
+    except Exception as e:
+        return {"error": str(e)}
+
+
 # Late-bound /autoscaler provider: the elastic-fleet control plane's
 # snapshot (`orchestrator/autoscaler.py`) — per-pool desired vs actual,
 # policy bounds, cooldown state, and the bounded decision log.
@@ -611,6 +645,20 @@ class _Handler(BaseHTTPRequestHandler):
 
             try:
                 body = _json.dumps(_alerts_provider(),
+                                   default=str).encode("utf-8")
+            except Exception as e:
+                code = 500
+                body = _json.dumps({"error": str(e)}).encode("utf-8")
+            ctype = "application/json"
+        elif path == "/clusters" and _clusters_provider is not None:
+            # The streaming clustering view (`cluster/worker.py`):
+            # per-cluster sizes + centroid norms, inertia trend,
+            # assignment throughput, and checkpoint/resume state.
+            # Rendered by tools/watch.py's clusters panel.
+            import json as _json
+
+            try:
+                body = _json.dumps(_clusters_provider(),
                                    default=str).encode("utf-8")
             except Exception as e:
                 code = 500

@@ -37,15 +37,17 @@ logger = logging.getLogger("dct.slo")
 # Span names that measure one unit of work end to end, per worker kind.
 # The batch budget reads whichever of these the process emits.
 BATCH_SPANS = ("tpu_worker.process", "tpu_worker.coalesce",
-               "worker.process")
-QUEUE_WAIT_SPANS = ("tpu_worker.queue_wait", "asr_worker.queue_wait")
+               "worker.process", "cluster_worker.process")
+QUEUE_WAIT_SPANS = ("tpu_worker.queue_wait", "asr_worker.queue_wait",
+                    "cluster_worker.queue_wait")
 # Whole-pipeline age of a record batch (creation -> device), recorded by
 # the TPU worker from ``RecordBatch.created_at``.  Unlike queue_wait —
 # which only sees time inside THIS worker's queue — batch age covers the
 # bus/broker leg, so it is the budget that catches a dead worker's
 # backlog: frames stranded on the broker while the worker was down come
 # back old, even though they clear the local queue instantly.
-BATCH_AGE_SPANS = ("tpu_worker.batch_age", "asr_worker.batch_age")
+BATCH_AGE_SPANS = ("tpu_worker.batch_age", "asr_worker.batch_age",
+                   "cluster_worker.batch_age")
 # The ASR worker's unit of work (an audio-batch group through decode →
 # window → bucketed Whisper programs).  A separate budget from the text
 # batch one because the latency regimes differ by orders of magnitude

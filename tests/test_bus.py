@@ -116,6 +116,7 @@ class TestMessageRegistry:
             AlertMessage,
             AudioBatchMessage,
             AudioRef,
+            ClusterUpdateMessage,
             SpanBatchMessage,
             TranscriptMessage,
         )
@@ -145,6 +146,10 @@ class TestMessageRegistry:
                 "queue_wait_burn", "burn_rate", "fleet_slo_breach_total",
                 "firing", prev_state="pending", value=12.5,
                 detail={"burn_fast": 12.5, "burn_slow": 7.0}),
+            ClusterUpdateMessage: ClusterUpdateMessage.new(
+                "cluster-1", k=4, step=7, vectors=120,
+                sizes=[50, 40, 20, 10], inertia=0.37,
+                underpopulated=[3], channel_clusters={"chan": 3}),
         }
         assert set(MESSAGE_REGISTRY.values()) == set(samples)
         for cls, msg in samples.items():

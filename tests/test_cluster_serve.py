@@ -1,0 +1,870 @@
+"""cluster/: streaming distributed clustering — kernel parity of the
+online step against the batch k-means kernels, checkpoint round-trip +
+resume-after-kill, ClusterUpdateMessage envelopes, the cluster-guided
+frontier hook, the publish_embeddings knob, and the e2e loop: record
+batch → TPUWorker embedding → ClusterWorker assignment with ONE trace
+followed across the hops.  Scenario files parse and the cluster gate
+accepts a sized-down steady run plus a kill/resume run.
+
+Everything runs the tiny engine config on CPU.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_crawler_tpu.bus.codec import decode_message
+from distributed_crawler_tpu.bus.inmemory import InMemoryBus
+from distributed_crawler_tpu.bus.messages import (
+    PRIORITY_HIGH,
+    PRIORITY_MEDIUM,
+    TOPIC_CLUSTERS,
+    TOPIC_INFERENCE_BATCHES,
+    TOPIC_INFERENCE_RESULTS,
+    ClusterUpdateMessage,
+)
+from distributed_crawler_tpu.cluster.engine import (
+    ClusterEngine,
+    ClusterEngineConfig,
+)
+from distributed_crawler_tpu.cluster.worker import (
+    ClusterWorker,
+    ClusterWorkerConfig,
+    iter_assignments,
+)
+from distributed_crawler_tpu.state.providers import InMemoryStorageProvider
+from distributed_crawler_tpu.utils import flight, trace
+from distributed_crawler_tpu.utils.metrics import MetricsRegistry
+
+
+def _blob_data(n=40, dim=16, seed=0):
+    """Two well-separated unit-sphere blobs."""
+    rng = np.random.RandomState(seed)
+    a = rng.randn(n // 2, dim) * 0.05 + np.eye(dim)[0]
+    b = rng.randn(n - n // 2, dim) * 0.05 + np.eye(dim)[1]
+    x = np.concatenate([a, b]).astype(np.float32)
+    return x
+
+
+def _norm(x):
+    x = np.asarray(x, np.float32)
+    return x / np.maximum(np.linalg.norm(x, axis=1, keepdims=True), 1e-12)
+
+
+# ---------------------------------------------------------------------------
+# Engine: online-vs-batch kernel parity, masking, checkpoints
+# ---------------------------------------------------------------------------
+
+class TestClusterEngine:
+    def test_online_step_matches_batch_kernels(self):
+        """ONE observe() == the `models/clustering.py` batch kernels
+        (assign + one-hot update + running mean + spherical renorm)
+        applied to that mini-batch — the online step is provably the
+        Lloyd update on one mini-batch."""
+        import jax
+        import jax.numpy as jnp
+
+        from distributed_crawler_tpu.models import clustering
+
+        k, x = 4, _blob_data(n=32)
+        eng = ClusterEngine(ClusterEngineConfig(k=k, buckets=(32,),
+                                                seed=5),
+                            registry=MetricsRegistry())
+        assigns = eng.observe(x)
+
+        xh = _norm(x)
+        seeded = clustering.kmeans_plus_plus_init(
+            jnp.asarray(xh), k, jax.random.PRNGKey(5))
+        seeded = np.asarray(seeded / jnp.maximum(
+            jnp.linalg.norm(seeded, axis=1, keepdims=True), 1e-12))
+        expected_assigns = np.asarray(clustering.assign(
+            jnp.asarray(xh), jnp.asarray(seeded)))
+        assert assigns == [int(a) for a in expected_assigns]
+        sums, counts = clustering.update(
+            jnp.asarray(xh), jnp.asarray(expected_assigns), k)
+        sums, counts = np.asarray(sums), np.asarray(counts)
+        expected = np.where((counts > 0)[:, None],
+                            sums / np.maximum(counts, 1.0)[:, None],
+                            seeded)
+        expected = _norm(expected)
+        np.testing.assert_allclose(np.asarray(eng.centroids), expected,
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(eng.counts), counts)
+
+    def test_pad_rows_do_not_perturb(self):
+        """The same rows through a padded bucket and an exact-fit bucket
+        produce identical assignments AND identical centroids — pad rows
+        touch neither sums nor counts."""
+        x = _blob_data(n=10)
+        padded = ClusterEngine(ClusterEngineConfig(k=3, buckets=(64,),
+                                                   seed=2),
+                               registry=MetricsRegistry())
+        exact = ClusterEngine(ClusterEngineConfig(k=3, buckets=(10,),
+                                                  seed=2),
+                              registry=MetricsRegistry())
+        a1, a2 = padded.observe(x), exact.observe(x)
+        assert a1 == a2
+        np.testing.assert_allclose(np.asarray(padded.centroids),
+                                   np.asarray(exact.centroids),
+                                   rtol=1e-5, atol=1e-6)
+        assert int(np.asarray(padded.counts).sum()) == 10
+
+    def test_oversized_minibatch_chunks_by_largest_bucket(self):
+        eng = ClusterEngine(ClusterEngineConfig(k=2, buckets=(8,)),
+                            registry=MetricsRegistry())
+        assigns = eng.observe(_blob_data(n=20))
+        assert len(assigns) == 20
+        assert eng.step == 3  # 8 + 8 + 4
+        assert eng.vectors == 20
+
+    def test_dim_mismatch_raises(self):
+        eng = ClusterEngine(ClusterEngineConfig(k=2, buckets=(8,)),
+                            registry=MetricsRegistry())
+        eng.observe(_blob_data(n=4, dim=16))
+        with pytest.raises(ValueError, match="dim"):
+            eng.observe(np.zeros((2, 8), np.float32))
+
+    def test_cost_rows_and_meter(self):
+        reg = MetricsRegistry()
+        eng = ClusterEngine(ClusterEngineConfig(k=4, buckets=(16,)),
+                            registry=reg)
+        eng.observe(_blob_data(n=16))
+        rows = [c for c in eng.costs.snapshot()
+                if c["path"] == "cluster"]
+        assert rows and rows[0]["flops"] > 0
+        snap = eng.meter.snapshot()
+        assert snap["batches"] >= 1
+        assert snap["goodput_tokens_per_s"] > 0
+
+    def test_checkpoint_roundtrip_continues_identically(self):
+        x = _blob_data(n=48)
+        a = ClusterEngine(ClusterEngineConfig(k=4, buckets=(24,), seed=1),
+                          registry=MetricsRegistry())
+        a.observe(x[:24])
+        state = a.state_dict()
+        b = ClusterEngine(ClusterEngineConfig(k=4, buckets=(24,), seed=1),
+                          registry=MetricsRegistry())
+        b.load_state(state)
+        assert b.step == a.step and b.vectors == a.vectors
+        assert b.resumed_from_step == a.step
+        assert a.observe(x[24:]) == b.observe(x[24:])
+        np.testing.assert_allclose(np.asarray(a.centroids),
+                                   np.asarray(b.centroids), rtol=1e-6)
+
+    def test_observe_is_atomic_across_chunks(self):
+        """A device failure on chunk 2 of an oversized mini-batch must
+        leave the model EXACTLY as it was — otherwise the caller's
+        per-batch isolation retry refolds chunk 1's rows."""
+        eng = ClusterEngine(ClusterEngineConfig(k=2, buckets=(8,),
+                                                seed=0),
+                            registry=MetricsRegistry())
+        eng.observe(_blob_data(n=8))  # seed + one committed step
+        step0, vectors0 = eng.step, eng.vectors
+        centroids0 = np.asarray(eng.centroids).copy()
+        real_dispatch = eng._dispatch_chunk
+        calls = {"n": 0}
+
+        def flaky(centroids, counts, x):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("device wedge on chunk 2")
+            return real_dispatch(centroids, counts, x)
+
+        eng._dispatch_chunk = flaky
+        with pytest.raises(RuntimeError, match="chunk 2"):
+            eng.observe(_blob_data(n=16, seed=9))  # 2 chunks of 8
+        assert (eng.step, eng.vectors) == (step0, vectors0)
+        np.testing.assert_array_equal(np.asarray(eng.centroids),
+                                      centroids0)
+        eng._dispatch_chunk = real_dispatch
+        assert len(eng.observe(_blob_data(n=16, seed=9))) == 16  # retry ok
+
+    def test_assign_only_matches_assignment_no_fold(self):
+        eng = ClusterEngine(ClusterEngineConfig(k=3, buckets=(16,),
+                                                seed=4),
+                            registry=MetricsRegistry())
+        x = _blob_data(n=16)
+        eng.observe(x)
+        vectors0 = eng.vectors
+        centroids0 = np.asarray(eng.centroids).copy()
+        from distributed_crawler_tpu.models import clustering
+        import jax.numpy as jnp
+
+        expected = [int(a) for a in np.asarray(clustering.assign(
+            jnp.asarray(_norm(x)), jnp.asarray(centroids0)))]
+        assert eng.assign_only(x) == expected
+        assert eng.vectors == vectors0  # no fold
+        np.testing.assert_array_equal(np.asarray(eng.centroids),
+                                      centroids0)
+
+    def test_checkpoint_wrong_spherical_rejected(self):
+        a = ClusterEngine(ClusterEngineConfig(k=4, buckets=(8,),
+                                              spherical=True),
+                          registry=MetricsRegistry())
+        a.observe(_blob_data(n=8))
+        b = ClusterEngine(ClusterEngineConfig(k=4, buckets=(8,),
+                                              spherical=False),
+                          registry=MetricsRegistry())
+        with pytest.raises(ValueError, match="spherical"):
+            b.load_state(a.state_dict())
+
+    def test_meter_path_label_no_clobber(self):
+        """The cluster meter's gauges are path-labeled children: a text
+        engine's meter sharing the registry keeps its own unlabeled
+        series instead of the two meters flapping one gauge."""
+        from distributed_crawler_tpu.utils.costmodel import EfficiencyMeter
+
+        reg = MetricsRegistry()
+        text = EfficiencyMeter(registry=reg, peak=1e9, peak_source="t")
+        clus = EfficiencyMeter(registry=reg, peak=1e9, peak_source="t",
+                               path="cluster")
+        text.record(0.001, 1e6, 100, 100)
+        clus.record(0.001, 2e6, 50, 50)
+        series = dict((tuple(sorted(labels.items())), v) for labels, v in
+                      reg.gauge("tpu_engine_goodput_tokens_per_s")
+                      .series())
+        assert series[()] > 0
+        assert series[(("path", "cluster"),)] > 0
+        assert series[()] != series[(("path", "cluster"),)]
+
+    def test_checkpoint_wrong_k_rejected(self):
+        a = ClusterEngine(ClusterEngineConfig(k=4, buckets=(8,)),
+                          registry=MetricsRegistry())
+        a.observe(_blob_data(n=8))
+        b = ClusterEngine(ClusterEngineConfig(k=8, buckets=(8,)),
+                          registry=MetricsRegistry())
+        with pytest.raises(ValueError, match="k"):
+            b.load_state(a.state_dict())
+
+    def test_underpopulated(self):
+        eng = ClusterEngine(ClusterEngineConfig(k=2, buckets=(32,),
+                                                seed=0),
+                            registry=MetricsRegistry())
+        # One tight blob: a single cluster soaks everything, the other
+        # starves below half the uniform share.
+        rng = np.random.RandomState(3)
+        x = (rng.randn(32, 8) * 0.01 + np.eye(8)[0]).astype(np.float32)
+        eng.observe(x)
+        under = eng.underpopulated(0.5)
+        assert len(under) in (0, 1)
+        sizes = np.asarray(eng.counts)
+        if len(under) == 1:
+            assert sizes[under[0]] < 0.5 * eng.vectors / 2
+
+
+# ---------------------------------------------------------------------------
+# Bus envelope
+# ---------------------------------------------------------------------------
+
+class TestClusterUpdateMessage:
+    def test_roundtrip_and_registry(self):
+        msg = ClusterUpdateMessage.new(
+            "cluster-1", k=8, step=12, vectors=300,
+            sizes=[40, 30, 50, 60, 30, 40, 30, 20], inertia=0.41,
+            underpopulated=[7], channel_clusters={"chanA": 7, "chanB": 2})
+        msg.validate()
+        back = ClusterUpdateMessage.from_dict(msg.to_dict())
+        assert back.worker_id == "cluster-1"
+        assert back.k == 8 and back.step == 12 and back.vectors == 300
+        assert back.underpopulated == [7]
+        assert back.channel_clusters == {"chanA": 7, "chanB": 2}
+        assert back.inertia == pytest.approx(0.41)
+        assert back.trace_id
+        typed = decode_message(msg.to_dict())
+        assert isinstance(typed, ClusterUpdateMessage)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="worker_id"):
+            ClusterUpdateMessage(k=4).validate()
+        with pytest.raises(ValueError, match="k must be positive"):
+            ClusterUpdateMessage(worker_id="w").validate()
+        with pytest.raises(ValueError, match="sizes"):
+            ClusterUpdateMessage(worker_id="w", k=4,
+                                 sizes=[1, 2]).validate()
+        with pytest.raises(ValueError, match="out of range"):
+            ClusterUpdateMessage(worker_id="w", k=4,
+                                 underpopulated=[4]).validate()
+
+
+# ---------------------------------------------------------------------------
+# Worker: ack/skip/poison isolation, idempotent ledger, kill → resume
+# ---------------------------------------------------------------------------
+
+def _result_batch(n=6, crawl_id="c1", dim=16, seed=0, channel="chanA"):
+    """An embedding-carrying result batch, the shape the TPU worker
+    publishes on TOPIC_INFERENCE_RESULTS."""
+    from distributed_crawler_tpu.bus.codec import RecordBatch
+
+    rng = np.random.RandomState(seed)
+    batch = RecordBatch.from_dict({
+        "batch_id": f"b{seed}", "crawl_id": crawl_id,
+        "records": [{"post_uid": f"p{seed}-{i}", "channel_name": channel,
+                     "description": "t"} for i in range(n)],
+        "results": [{"embedding": rng.randn(dim).tolist(),
+                     "label": "x"} for _ in range(n)],
+    })
+    batch.trace_id = f"trace_test_{seed}"
+    return batch
+
+
+class TestClusterWorker:
+    def _worker(self, provider, bus=None, **kw):
+        bus = bus if bus is not None else InMemoryBus(sync=True)
+        cfg = ClusterWorkerConfig(worker_id="cluster-1", heartbeat_s=30.0,
+                                  k=4, buckets=(8, 32),
+                                  checkpoint_every_batches=1, **kw)
+        return ClusterWorker(bus, provider=provider, cfg=cfg,
+                             registry=MetricsRegistry())
+
+    def test_batch_acked_after_writeback(self):
+        provider = InMemoryStorageProvider()
+        w = self._worker(provider)
+        acks = []
+        w._handle_payload(_result_batch(seed=1).to_dict(),
+                          ack=lambda ok: acks.append(ok))
+        w.start()
+        try:
+            assert w.drain(timeout_s=10)
+        finally:
+            w.stop()
+        assert acks == [True]
+        rows = list(iter_assignments(provider, "c1"))
+        assert len(rows) == 6
+        assert {r["post_uid"] for r in rows} == {f"p1-{i}"
+                                                 for i in range(6)}
+        assert all(0 <= r["cluster"] < 4 for r in rows)
+        assert all(r["trace_id"] == "trace_test_1" for r in rows)
+
+    def test_redelivery_overwrites_not_duplicates(self):
+        provider = InMemoryStorageProvider()
+        w = self._worker(provider)
+        w.start()
+        try:
+            payload = _result_batch(seed=2).to_dict()
+            w._handle_payload(payload, ack=None)
+            assert w.drain(timeout_s=10)
+            w._handle_payload(payload, ack=None)  # broker redelivery
+            assert w.drain(timeout_s=10)
+        finally:
+            w.stop()
+        counts = {}
+        for r in iter_assignments(provider, "c1"):
+            counts[r["post_uid"]] = counts.get(r["post_uid"], 0) + 1
+        assert counts and all(c == 1 for c in counts.values())
+
+    def test_redelivery_does_not_refold_the_model(self):
+        """A redelivered already-folded batch re-writes its (idempotent)
+        ledger file but must NOT update the model a second time — the
+        folded-batch window + assign_only path (a nack after a failed
+        writeback, or an unacked frame requeued across a kill, would
+        otherwise double-count the vectors in counts/vectors and bias
+        the centroids toward the redelivered batch)."""
+        provider = InMemoryStorageProvider()
+        w = self._worker(provider)
+        w.start()
+        try:
+            payload = _result_batch(seed=20).to_dict()
+            w._handle_payload(payload, ack=None)
+            assert w.drain(timeout_s=10)
+            vectors_after_first = w.engine.vectors
+            centroids_after_first = np.asarray(w.engine.centroids).copy()
+            w._handle_payload(payload, ack=None)  # broker redelivery
+            assert w.drain(timeout_s=10)
+        finally:
+            w.stop()
+        assert w.engine.vectors == vectors_after_first
+        np.testing.assert_array_equal(np.asarray(w.engine.centroids),
+                                      centroids_after_first)
+        counts = {}
+        for r in iter_assignments(provider, "c1"):
+            counts[r["post_uid"]] = counts.get(r["post_uid"], 0) + 1
+        assert counts and all(c == 1 for c in counts.values())
+
+    def test_duplicate_in_one_coalesced_group_folds_once(self):
+        """Both copies of one batch draining in the SAME coalesced group
+        (original still queued when the ack-timeout requeue lands) fold
+        once — the intra-group dedupe, not just the _folded window."""
+        provider = InMemoryStorageProvider()
+        w = self._worker(provider)
+        payload = _result_batch(seed=25).to_dict()
+        acks = []
+        # Enqueue BOTH copies before start(): the feed loop drains them
+        # as one coalesced group.
+        w._handle_payload(payload, ack=lambda ok: acks.append(ok))
+        w._handle_payload(payload, ack=lambda ok: acks.append(ok))
+        w.start()
+        try:
+            assert w.drain(timeout_s=10)
+        finally:
+            w.stop()
+        assert acks == [True, True]
+        assert w.engine.vectors == 6  # folded once, not twice
+        counts = {}
+        for r in iter_assignments(provider, "c1"):
+            counts[r["post_uid"]] = counts.get(r["post_uid"], 0) + 1
+        assert counts and all(c == 1 for c in counts.values())
+
+    def test_failed_writeback_nack_then_redelivery_single_fold(self):
+        """The review finding end to end: put_text raises once → the
+        batch nacks → the redelivery folds NOTHING new (it was already
+        folded) yet completes the ledger write and acks."""
+        provider = InMemoryStorageProvider()
+        real_put = provider.put_text
+        fails = {"n": 1}
+
+        def flaky_put(rel, text):
+            if rel.startswith("cluster/") and "batches" in rel \
+                    and fails["n"] > 0:
+                fails["n"] -= 1
+                raise OSError("transient store wedge")
+            real_put(rel, text)
+
+        provider.put_text = flaky_put
+        w = self._worker(provider)
+        acks = []
+        w.start()
+        try:
+            payload = _result_batch(seed=21).to_dict()
+            w._handle_payload(payload, ack=lambda ok: acks.append(ok))
+            assert w.drain(timeout_s=10)
+            assert acks == [False]  # writeback failed -> nack
+            vectors_after = w.engine.vectors
+            w._handle_payload(payload, ack=lambda ok: acks.append(ok))
+            assert w.drain(timeout_s=10)
+        finally:
+            w.stop()
+        assert acks == [False, True]
+        assert w.engine.vectors == vectors_after  # single fold
+        counts = {}
+        for r in iter_assignments(provider, "c1"):
+            counts[r["post_uid"]] = counts.get(r["post_uid"], 0) + 1
+        assert counts and all(c == 1 for c in counts.values())
+
+    def test_folded_window_survives_checkpoint_resume(self):
+        """An unacked-but-folded frame requeued across a kill must not
+        refold on the restarted worker when the checkpoint already
+        carries its fold."""
+        provider = InMemoryStorageProvider()
+        w1 = self._worker(provider)
+        w1.start()
+        payload = _result_batch(seed=22).to_dict()
+        w1._handle_payload(payload, ack=None)
+        assert w1.drain(timeout_s=10)  # checkpoint_every_batches=1
+        w1.kill()
+        w2 = self._worker(provider)
+        assert payload["batch_id"] in w2._folded
+        w2.start()
+        try:
+            vectors_resumed = w2.engine.vectors
+            w2._handle_payload(payload, ack=None)  # requeued frame
+            assert w2.drain(timeout_s=10)
+            assert w2.engine.vectors == vectors_resumed
+        finally:
+            w2.stop()
+        w1.stop()
+
+    def test_checkpoint_failure_retries_next_batch(self):
+        """A failed checkpoint write must keep the cadence counter so
+        the NEXT committed batch retries, instead of deferring a full
+        interval."""
+        provider = InMemoryStorageProvider()
+        real_save = provider.save_json
+        fails = {"n": 1}
+
+        def flaky_save(rel, data):
+            if fails["n"] > 0:
+                fails["n"] -= 1
+                raise OSError("transient store wedge")
+            real_save(rel, data)
+
+        provider.save_json = flaky_save
+        w = self._worker(provider)
+        w.start()
+        try:
+            w._handle_payload(_result_batch(seed=23).to_dict(), ack=None)
+            assert w.drain(timeout_s=10)
+            assert not provider.exists("cluster/centroids.json")
+            assert w._batches_since_ckpt >= 1  # NOT reset by the failure
+            w._handle_payload(_result_batch(seed=24).to_dict(), ack=None)
+            assert w.drain(timeout_s=10)
+            assert provider.exists("cluster/centroids.json")
+        finally:
+            w.stop()
+
+    def test_no_embedding_batch_skipped_and_acked(self):
+        provider = InMemoryStorageProvider()
+        w = self._worker(provider)
+        batch = _result_batch(seed=3)
+        for r in batch.results:
+            r.pop("embedding")
+        acks = []
+        w._handle_payload(batch.to_dict(), ack=lambda ok: acks.append(ok))
+        w.start()
+        try:
+            assert w.drain(timeout_s=10)
+        finally:
+            w.stop()
+        assert acks == [True]
+        assert w.get_status()["skipped_batches"] == 1
+        assert not list(iter_assignments(provider, "c1"))
+
+    def test_malformed_embedding_nacks_only_that_batch(self):
+        provider = InMemoryStorageProvider()
+        w = self._worker(provider)
+        bad = _result_batch(seed=4)
+        bad.results[2]["embedding"] = ["not-a-number"]
+        acks = {}
+        w._handle_payload(bad.to_dict(),
+                          ack=lambda ok: acks.setdefault("bad", ok))
+        w._handle_payload(_result_batch(seed=5).to_dict(),
+                          ack=lambda ok: acks.setdefault("good", ok))
+        w.start()
+        try:
+            assert w.drain(timeout_s=10)
+        finally:
+            w.stop()
+        assert acks["bad"] is False and acks["good"] is True
+        uids = {r["post_uid"] for r in iter_assignments(provider, "c1")}
+        assert uids == {f"p5-{i}" for i in range(6)}
+
+    def test_kill_then_restart_resumes_checkpoint(self):
+        """Process-death semantics: the restarted worker starts with
+        EMPTY centroid memory and must resume the model from the last
+        atomic checkpoint (resumed_from_step > 0), never re-seed."""
+        flight.configure(capacity=512)
+        provider = InMemoryStorageProvider()
+        w1 = self._worker(provider)
+        w1.start()
+        try:
+            w1._handle_payload(_result_batch(seed=6).to_dict(), ack=None)
+            assert w1.drain(timeout_s=10)
+            step_at_kill = w1.engine.step
+            centroids_at_kill = np.asarray(w1.engine.centroids).copy()
+        finally:
+            w1.kill()
+        assert step_at_kill > 0
+        kinds = [e.get("kind") for e in flight.RECORDER.events()]
+        assert "cluster_checkpoint" in kinds and "worker_kill" in kinds
+
+        w2 = self._worker(provider)
+        assert w2.resumed
+        assert w2.engine.resumed_from_step == step_at_kill
+        np.testing.assert_allclose(np.asarray(w2.engine.centroids),
+                                   centroids_at_kill, rtol=1e-6)
+        kinds = [e.get("kind") for e in flight.RECORDER.events()]
+        assert "cluster_resume" in kinds
+        w2.start()
+        try:
+            w2._handle_payload(_result_batch(seed=7).to_dict(), ack=None)
+            assert w2.drain(timeout_s=10)
+            assert w2.engine.step > step_at_kill
+            body = w2.get_clusters()
+            assert body["resumed"] is True
+            assert body["resume_step"] == step_at_kill
+        finally:
+            w2.stop()
+        w1.stop()  # clears any provider seams the kill left registered
+
+    def test_incompatible_checkpoint_rejected_loudly(self):
+        provider = InMemoryStorageProvider()
+        w1 = self._worker(provider)
+        w1.start()
+        w1._handle_payload(_result_batch(seed=8).to_dict(), ack=None)
+        assert w1.drain(timeout_s=10)
+        w1.stop()
+        with pytest.raises(ValueError, match="incompatible"):
+            ClusterWorker(InMemoryBus(sync=True), provider=provider,
+                          cfg=ClusterWorkerConfig(k=16, buckets=(8,)),
+                          registry=MetricsRegistry())
+
+    def test_clusters_body_and_update_messages(self):
+        provider = InMemoryStorageProvider()
+        bus = InMemoryBus(sync=True)
+        updates = []
+        bus.subscribe(TOPIC_CLUSTERS, lambda p: updates.append(p))
+        w = self._worker(provider, bus=bus)
+        w.start()
+        try:
+            w._handle_payload(_result_batch(seed=9).to_dict(), ack=None)
+            assert w.drain(timeout_s=10)
+        finally:
+            w.stop()
+        body = w.get_clusters()
+        assert body["k"] == 4 and body["nonempty"] >= 1
+        assert body["vectors"] == 6
+        assert body["checkpoint"]["written"] >= 1
+        assert isinstance(body["inertia"], list)
+        assert updates, "checkpoint must announce a ClusterUpdateMessage"
+        msg = decode_message(updates[-1])
+        assert isinstance(msg, ClusterUpdateMessage)
+        assert msg.channel_clusters.get("chanA") is not None
+
+
+# ---------------------------------------------------------------------------
+# publish_embeddings knob (TPU worker side)
+# ---------------------------------------------------------------------------
+
+class TestPublishEmbeddingsKnob:
+    class _StubEngine:
+        cfg = type("C", (), {"model": "stub"})()
+
+        def run(self, texts):
+            return [{"embedding": [1.0, 2.0], "label": "x"}
+                    for _ in texts]
+
+    def _run_one(self, publish, write):
+        from distributed_crawler_tpu.bus.codec import RecordBatch
+        from distributed_crawler_tpu.inference.worker import (
+            TPUWorker,
+            TPUWorkerConfig,
+        )
+
+        bus = InMemoryBus(sync=True)
+        published = []
+        bus.subscribe(TOPIC_INFERENCE_RESULTS,
+                      lambda p: published.append(p))
+        provider = InMemoryStorageProvider()
+        w = TPUWorker(bus, self._StubEngine(), provider=provider,
+                      cfg=TPUWorkerConfig(worker_id="t", heartbeat_s=30,
+                                          stall_warn_s=0,
+                                          publish_embeddings=publish,
+                                          write_embeddings=write),
+                      registry=MetricsRegistry())
+        batch = RecordBatch.from_dict({
+            "batch_id": "b1", "crawl_id": "c1",
+            "records": [{"post_uid": "p1", "description": "hello"}]})
+        w.start()
+        try:
+            w._handle_payload(batch.to_dict(), ack=None)
+            assert w.drain(timeout_s=10)
+        finally:
+            w.stop()
+        import json as _json
+
+        wrote = [_json.loads(line) for line in provider.get_text(
+            "inference/c1/batches/b1.jsonl").splitlines()]
+        return published[-1]["results"][0], wrote[0]
+
+    def test_publish_on_write_off(self):
+        pub, wrote = self._run_one(publish=True, write=False)
+        assert "embedding" in pub
+        assert "embedding" not in wrote
+
+    def test_publish_off_write_on(self):
+        pub, wrote = self._run_one(publish=False, write=True)
+        assert "embedding" not in pub
+        assert "embedding" in wrote
+
+
+# ---------------------------------------------------------------------------
+# Cluster-guided frontier prioritization (orchestrator hook)
+# ---------------------------------------------------------------------------
+
+class TestClusterGuidedFrontier:
+    def _orch(self):
+        from distributed_crawler_tpu.config.crawler import CrawlerConfig
+        from distributed_crawler_tpu.orchestrator import Orchestrator
+
+        return Orchestrator("c1", CrawlerConfig(), InMemoryBus(sync=True),
+                            sm=None, registry=MetricsRegistry())
+
+    def _item(self, url):
+        from distributed_crawler_tpu.bus.messages import (
+            WorkItem,
+            WorkItemConfig,
+        )
+
+        return WorkItem.new(url, 1, "parent", "c1", "telegram",
+                            WorkItemConfig())
+
+    def test_underpopulated_channel_gets_high_priority(self):
+        orch = self._orch()
+        msg = ClusterUpdateMessage.new(
+            "cluster-1", k=4, step=5, vectors=100, sizes=[50, 40, 8, 2],
+            underpopulated=[3], channel_clusters={"sparseChan": 3,
+                                                  "denseChan": 0})
+        orch.handle_cluster_payload(msg.to_dict())
+        assert orch._frontier_priority(
+            self._item("https://t.me/sparseChan")) == PRIORITY_HIGH
+        assert orch._frontier_priority(
+            self._item("https://t.me/denseChan")) == PRIORITY_MEDIUM
+        assert orch._frontier_priority(
+            self._item("https://t.me/unknownChan")) == PRIORITY_MEDIUM
+        status = orch.get_status()
+        assert status["cluster_guide"]["underpopulated"] == [3]
+        assert status["cluster_guide"]["prioritized_items"] == 1
+
+    def test_stale_guide_expires(self):
+        """A guide older than cluster_guide_ttl_s stops steering — a
+        dead cluster worker's final snapshot must not promote pages
+        forever."""
+        from distributed_crawler_tpu.config.crawler import CrawlerConfig
+        from distributed_crawler_tpu.orchestrator import Orchestrator
+        from distributed_crawler_tpu.orchestrator.orchestrator import (
+            OrchestratorConfig,
+        )
+
+        now = [1000.0]
+        orch = Orchestrator(
+            "c1", CrawlerConfig(), InMemoryBus(sync=True), sm=None,
+            ocfg=OrchestratorConfig(cluster_guide_ttl_s=60.0),
+            clock=lambda: now[0], registry=MetricsRegistry())
+        orch.handle_cluster_payload(ClusterUpdateMessage.new(
+            "cluster-1", k=2, sizes=[90, 2], underpopulated=[1],
+            channel_clusters={"sparse": 1}).to_dict())
+        item = self._item("https://t.me/sparse")
+        assert orch._frontier_priority(item) == PRIORITY_HIGH
+        now[0] += 61.0
+        assert orch._frontier_priority(item) == PRIORITY_MEDIUM
+
+    def test_no_guide_means_medium(self):
+        orch = self._orch()
+        assert orch._frontier_priority(
+            self._item("https://t.me/x")) == PRIORITY_MEDIUM
+        assert orch.get_status()["cluster_guide"] is None
+
+    def test_undecodable_update_ignored(self):
+        orch = self._orch()
+        orch.handle_cluster_payload({"message_type": "cluster_update"})
+        assert orch._cluster_guide is None
+
+
+# ---------------------------------------------------------------------------
+# e2e: record batch → embed → assign, one trace across the hops
+# ---------------------------------------------------------------------------
+
+class TestEndToEnd:
+    def test_record_to_assignment_one_trace(self):
+        from distributed_crawler_tpu.bus.codec import RecordBatch
+        from distributed_crawler_tpu.inference.engine import (
+            EngineConfig,
+            InferenceEngine,
+        )
+        from distributed_crawler_tpu.inference.worker import (
+            TPUWorker,
+            TPUWorkerConfig,
+            iter_results,
+        )
+
+        trace.configure(capacity=4096)
+        registry = MetricsRegistry()
+        bus = InMemoryBus(sync=True)
+        provider = InMemoryStorageProvider()
+        engine = InferenceEngine(
+            EngineConfig(model="tiny", n_labels=4, batch_size=4,
+                         buckets=[32]), registry=registry)
+        tpu = TPUWorker(bus, engine, provider=provider,
+                        cfg=TPUWorkerConfig(worker_id="tpu-1",
+                                            heartbeat_s=30,
+                                            stall_warn_s=0,
+                                            publish_embeddings=True),
+                        registry=registry)
+        cw = ClusterWorker(
+            bus, provider=provider,
+            cfg=ClusterWorkerConfig(worker_id="cluster-1",
+                                    heartbeat_s=30, k=4, buckets=(8, 32),
+                                    checkpoint_every_batches=1),
+            registry=MetricsRegistry())
+        from distributed_crawler_tpu.datamodel import Post
+
+        posts = [Post(post_uid=f"e2e-{i}", channel_name="e2echan",
+                      description=f"hello world {i}") for i in range(5)]
+        batch = RecordBatch.from_posts(posts, crawl_id="e2e")
+        tpu.start()
+        cw.start()
+        try:
+            bus.publish(TOPIC_INFERENCE_BATCHES, batch.to_dict())
+            assert tpu.drain(timeout_s=30)
+            assert cw.drain(timeout_s=30)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                rows = list(iter_assignments(provider, "e2e"))
+                if len(rows) == 5:
+                    break
+                time.sleep(0.05)
+        finally:
+            cw.stop()
+            tpu.stop()
+        embedded = {r["post_uid"] for r in iter_results(provider, "e2e")}
+        assigned = {r["post_uid"]: r["cluster"]
+                    for r in iter_assignments(provider, "e2e")}
+        assert embedded == set(assigned) == {f"e2e-{i}" for i in range(5)}
+        # ONE trace across the hops: the record batch's trace id carries
+        # through embed (engine/tpu_worker spans) into the cluster
+        # worker's process/commit spans.
+        names = {s.name for s in trace.TRACER.spans()
+                 if s.trace_id == batch.trace_id}
+        assert "cluster_worker.process" in names
+        assert "cluster_worker.commit" in names
+        assert any(n.startswith("tpu_worker.") for n in names)
+
+
+# ---------------------------------------------------------------------------
+# Scenarios: parse + gate acceptance
+# ---------------------------------------------------------------------------
+
+class TestClusterScenarios:
+    def test_checked_in_cluster_scenarios_validate(self):
+        from distributed_crawler_tpu import loadgen
+
+        for name in ("cluster-steady", "kill-cluster-worker"):
+            sc = loadgen.load_scenario(name)
+            assert sc.get("kind") == "cluster"
+            loadgen.parse_timeline(sc.get("chaos", []))
+            loadgen.validate_gate_config(sc)
+
+    def test_unknown_cluster_gate_key_rejected(self):
+        from distributed_crawler_tpu import loadgen
+
+        sc = loadgen.load_scenario("cluster-steady")
+        sc["gate"]["definitely_not_a_key"] = 1
+        with pytest.raises(ValueError, match="unknown gate key"):
+            loadgen.validate_gate_config(sc)
+        # Occupancy keys are TEXT-gate assertions the cluster runner
+        # never evaluates (no DeviceTimeline on the k-means engine) —
+        # accepting them would be a silent no-op, so they reject too.
+        sc = loadgen.load_scenario("cluster-steady")
+        sc["gate"]["min_device_busy_fraction"] = 0.5
+        with pytest.raises(ValueError, match="unknown gate key"):
+            loadgen.validate_gate_config(sc)
+
+    def test_publish_embeddings_off_rejected(self):
+        from distributed_crawler_tpu import loadgen
+
+        sc = loadgen.load_scenario("cluster-steady")
+        sc["worker"]["publish_embeddings"] = False
+        with pytest.raises(ValueError, match="publish_embeddings"):
+            loadgen.validate_gate_config(sc)
+        sc = loadgen.load_scenario("cluster-steady")
+        sc["worker"]["write_embeddings"] = False
+        with pytest.raises(ValueError, match="write_embeddings"):
+            loadgen.validate_gate_config(sc)
+
+    @pytest.mark.slow
+    def test_cluster_steady_gate_accepts(self):
+        from distributed_crawler_tpu import loadgen
+
+        verdict = loadgen.run_cluster_scenario(
+            loadgen.load_scenario("cluster-steady"),
+            overrides={"load": {"duration_s": 1.5,
+                                "rate_batches_per_s": 10},
+                       "tail": {"batches": 3}})
+        assert verdict["status"] == "pass", verdict["checks"]
+        assert verdict["cluster_lost"] == 0
+        assert verdict["clusters"]["nonempty"] >= 2
+
+    @pytest.mark.slow
+    def test_kill_cluster_worker_gate_accepts(self):
+        from distributed_crawler_tpu import loadgen
+
+        verdict = loadgen.run_cluster_scenario(
+            loadgen.load_scenario("kill-cluster-worker"),
+            overrides={"load": {"duration_s": 3.0},
+                       "chaos": ["at=1.0s kill cluster-1",
+                                 "at=2.0s restart cluster-1"],
+                       "tail": {"batches": 3}})
+        assert verdict["status"] == "pass", verdict["checks"]
+        assert verdict["worker_generations"] == 2
+        assert verdict["clusters"]["resumed"] is True

@@ -110,6 +110,10 @@ def selfcheck() -> int:
          # watchtower: rolling time-series store, alert-engine
          # lifecycles, /alerts + /timeseries, the live-dashboard e2e.
          os.path.join(repo, "tests", "test_watchtower.py"),
+         # cluster/: online k-means kernel parity, checkpoint resume
+         # across a kill, the embed->assign e2e, and the cluster-steady
+         # + kill-cluster-worker gate acceptances (ISSUE 14 closure).
+         os.path.join(repo, "tests", "test_cluster_serve.py"),
          # elastic fleet: autoscaler policy hysteresis, supervisors,
          # /autoscaler, and the flash-crowd gate acceptance
          # (breach -> alert -> scale-up -> converge -> scale-down).

@@ -85,12 +85,14 @@ def render_dashboard(cluster: Optional[Dict[str, Any]],
                      alerts: Optional[Dict[str, Any]],
                      tseries: Optional[Dict[str, Any]],
                      now: Optional[float] = None,
-                     autoscaler: Optional[Dict[str, Any]] = None) -> str:
+                     autoscaler: Optional[Dict[str, Any]] = None,
+                     clusters: Optional[Dict[str, Any]] = None) -> str:
     now = time.time() if now is None else now
     cluster = cluster or {}
     alerts = alerts or {}
     tseries = tseries or {}
     autoscaler = autoscaler or {}
+    clusters = clusters or {}
     lines: List[str] = []
 
     fleet = cluster.get("fleet") or {}
@@ -169,6 +171,39 @@ def render_dashboard(cluster: Optional[Dict[str, Any]],
                     f"{d.get('from', '?')} -> {d.get('to', '?')}  "
                     f"({d.get('reason', '?')})")
 
+    # --- clusters panel (/clusters; cluster/worker.py) ---------------------
+    sizes = clusters.get("sizes") or []
+    if sizes:
+        inertia_hist = [float(v) for v in (clusters.get("inertia") or [])]
+        # The rolling store's self-sampled series is the longer history
+        # when the worker serves /timeseries too (the satellite's
+        # "inertia sparkline from the rolling store").
+        store_inertia = _series_values(tseries, "cluster_inertia_per_vector")
+        trend = store_inertia if len(store_inertia) > len(inertia_hist) \
+            else inertia_hist
+        lines.append("")
+        resumed = f" (resumed @ step {clusters.get('resume_step')})" \
+            if clusters.get("resumed") else ""
+        lines.append(
+            f"clusters — k={clusters.get('k')} "
+            f"nonempty={clusters.get('nonempty')} "
+            f"vectors={clusters.get('vectors')} "
+            f"step={clusters.get('step')}{resumed}")
+        total = max(1, sum(int(s) for s in sizes))
+        bar_w = 24
+        under = set(clusters.get("underpopulated") or [])
+        for i, s in enumerate(sizes):
+            share = int(s) / total
+            bar = "#" * max(1 if int(s) else 0, int(share * bar_w))
+            mark = "  <-- under-populated" if i in under else ""
+            lines.append(f"  c{i:<3} {int(s):>7}  {bar:<{bar_w}}"
+                         f" {share * 100:5.1f}%{mark}")
+        if trend:
+            lines.append(
+                f"  inertia/vector {sparkline(trend, 24):<24} "
+                f"{trend[0]:.4g} -> {trend[-1]:.4g}  "
+                f"(assign {clusters.get('assign_vectors_per_s', 0)}/s)")
+
     # --- per-worker trend table --------------------------------------------
     workers = cluster.get("workers") or {}
     if workers:
@@ -221,7 +256,8 @@ def render_once(base_url: str) -> str:
     return render_dashboard(_fetch(base_url, "/cluster"),
                             _fetch(base_url, "/alerts"),
                             _fetch(base_url, "/timeseries"),
-                            autoscaler=_fetch(base_url, "/autoscaler"))
+                            autoscaler=_fetch(base_url, "/autoscaler"),
+                            clusters=_fetch(base_url, "/clusters"))
 
 
 def selfcheck() -> int:
@@ -284,8 +320,15 @@ def selfcheck() -> int:
              "from": 2, "to": 3, "reason": "queue_wait_burn"},
         ],
     }
+    clusters = {
+        "worker_id": "cluster-1", "k": 4, "nonempty": 3, "vectors": 120,
+        "step": 17, "resumed": True, "resume_step": 9,
+        "sizes": [60, 40, 18, 2], "underpopulated": [3],
+        "inertia": [0.41, 0.38, 0.36, 0.35, 0.34],
+        "assign_vectors_per_s": 88.5,
+    }
     out = render_dashboard(cluster, alerts, tseries, now=now,
-                           autoscaler=autoscaler)
+                           autoscaler=autoscaler, clusters=clusters)
     assert "FIRING" in out and "queue_wait_burn" in out, out
     assert "tpu-1" in out and "crawl-1" in out and "STALE" in out, out
     assert "burn rule" in out and "14.2" in out, out
@@ -293,6 +336,8 @@ def selfcheck() -> int:
     assert "0.28" in out, out  # latest MFU next to its trend cell
     assert "autoscaler pool" in out and "converging" in out, out
     assert "recent scale decisions" in out and "2 -> 3" in out, out
+    assert "clusters — k=4" in out and "resumed @ step 9" in out, out
+    assert "under-populated" in out and "inertia/vector" in out, out
     empty = render_dashboard(None, None, None, now=now)
     assert "nothing to watch" in empty, empty
     print("watch selfcheck ok")
