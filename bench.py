@@ -616,6 +616,157 @@ def _measure_bus_codec(batch: int = 256, n_batches: int = 40,
     }
 
 
+# Shard counts the bus-throughput leg measures — ONE constant shared by
+# the measurement and the skip→None fallback so they can't desync.
+BUS_SHARD_COUNTS = (1, 2, 4)
+
+
+def _measure_bus_shards(counts=BUS_SHARD_COUNTS, frames: int = 2400,
+                        leg_timeout_s: float = 240.0) -> dict:
+    """Partitioned-bus throughput scaling: aggregate publish→pull→ack
+    frames/sec through 1, 2, and 4 broker shards.
+
+    Each shard is its OWN OS process (`python -m
+    distributed_crawler_tpu.bus.partition --bench-child`) hosting a
+    stock GrpcBusServer on a loopback port — the deployment shape, one
+    broker per process — publishing its consistent-hash-ring-owned slice
+    of one FIXED seeded uid space (same total work at every shard
+    count) and pulling+acking every frame back over real gRPC.
+
+    Methodology (the `dp_sharding_efficiency_*` discipline — measure
+    honestly, label the same-host caveat): the headline
+    ``bus_frames_per_s_shards{N}`` rows are aggregate CAPACITY — each
+    shard measured in ISOLATION (sequentially) and the rates summed,
+    because production broker shards do not share a host core, while
+    this bench box may have as few as ONE (``bus_shard_host_cores``
+    records it).  The same-host CONCURRENT run of the largest fleet is
+    reported next to it (``bus_shard_concurrent_scaling``) so the pair
+    separates the sharding win (per-broker ceiling × N) from this
+    host's core budget.  CPU-only by nature — measured on every bench
+    run, wedged chip or not.
+    """
+    import subprocess
+    import threading as _threading
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def _read_line(proc, box, key):
+        try:
+            box[key] = proc.stdout.readline()
+        except Exception as exc:  # noqa: BLE001 — reader thread
+            box[key] = ""
+            box[f"{key}_err"] = str(exc)
+
+    def _reap(p) -> None:
+        # kill AND wait: an unreaped child is a zombie for the rest of
+        # the (15-20 min) bench run.
+        try:
+            p.kill()
+        except OSError:
+            pass
+        try:
+            p.wait(timeout=5)
+        except Exception as exc:  # noqa: BLE001 — best-effort reap
+            _log(f"bus shard child reap failed: {exc}")
+
+    def _child(i: int, n: int) -> "subprocess.Popen":
+        return subprocess.Popen(
+            [sys.executable, "-m",
+             "distributed_crawler_tpu.bus.partition",
+             "--bench-child", "--shard-index", str(i),
+             "--shard-count", str(n), "--frames", str(frames),
+             "--seed", "7"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, cwd=repo)
+
+    def _await(procs, box, phase: str, deadline: float) -> None:
+        readers = []
+        for i, p in enumerate(procs):
+            t = _threading.Thread(target=_read_line,
+                                  args=(p, box, f"{phase}{i}"),
+                                  daemon=True)
+            t.start()
+            readers.append(t)
+        for t in readers:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+
+    def _results(procs, box, deadline: float) -> list:
+        _await(procs, box, "result", deadline)
+        results = []
+        for i in range(len(procs)):
+            line = box.get(f"result{i}", "")
+            if not line.strip():
+                raise RuntimeError(
+                    f"shard child {i}/{len(procs)} produced no result")
+            results.append(json.loads(line))
+        if not all(r.get("completed") for r in results):
+            raise RuntimeError(f"shard child timed out: {results}")
+        return results
+
+    def _run_isolated(n: int) -> float:
+        """Sum of per-shard rates, each shard measured alone (the
+        capacity of an n-broker fleet whose brokers don't share a
+        core)."""
+        rate = 0.0
+        for i in range(n):
+            deadline = time.monotonic() + leg_timeout_s
+            p = _child(i, n)
+            try:
+                box: dict = {}
+                _await([p], box, "ready", deadline)
+                if box.get("ready0", "").strip() != "READY":
+                    raise RuntimeError(
+                        f"shard child {i}/{n} not READY: {box}")
+                p.stdin.write("GO\n")
+                p.stdin.flush()
+                r = _results([p], box, deadline)[0]
+                rate += r["frames"] / r["wall_s"]
+            finally:
+                _reap(p)
+        return rate
+
+    def _run_concurrent(n: int) -> float:
+        """Total frames / slowest shard wall with every shard live at
+        once on THIS host — the same-host number."""
+        deadline = time.monotonic() + leg_timeout_s
+        procs = [_child(i, n) for i in range(n)]
+        try:
+            box = {}
+            _await(procs, box, "ready", deadline)
+            if not all(box.get(f"ready{i}", "").strip() == "READY"
+                       for i in range(n)):
+                raise RuntimeError(f"shard children not READY: {box}")
+            for p in procs:
+                p.stdin.write("GO\n")
+                p.stdin.flush()
+            results = _results(procs, box, deadline)
+            return sum(r["frames"] for r in results) \
+                / max(r["wall_s"] for r in results)
+        finally:
+            for p in procs:
+                _reap(p)
+
+    rates = {}
+    for n in counts:
+        rates[n] = _run_isolated(n)
+        _log(f"bus shards x{n}: {rates[n]:.0f} frames/s aggregate "
+             f"capacity ({frames} frames fixed, shards isolated)")
+    biggest = max(counts)
+    concurrent = _run_concurrent(biggest)
+    _log(f"bus shards x{biggest} same-host concurrent: "
+         f"{concurrent:.0f} frames/s")
+    out = {f"bus_frames_per_s_shards{n}": round(r, 1)
+           for n, r in rates.items()}
+    out["bus_shard_frames"] = frames
+    out["bus_shard_host_cores"] = os.cpu_count()
+    if rates.get(1):
+        if rates.get(4):
+            out["bus_shard_scaling_4x"] = round(rates[4] / rates[1], 2)
+        out["bus_shard_concurrent_scaling"] = round(
+            concurrent / rates[1], 2)
+    return out
+
+
 def _measure_padding_efficiency(n_texts: int = 2048, batch: int = 256,
                                 max_segments: int = 8) -> dict:
     """Padding efficiency: real tokens / total slot tokens, packed vs
@@ -1278,6 +1429,18 @@ def _parent() -> None:
         result.update(_measure_bus_codec())
     except Exception as exc:  # noqa: BLE001 — best-effort row
         _log(f"bus codec row skipped: {exc}")
+    _log("measuring partitioned-bus throughput (1/2/4 broker shards)")
+    try:
+        result.update(_measure_bus_shards())
+    except Exception as exc:  # noqa: BLE001 — best-effort rows
+        _log(f"bus shard rows skipped: {exc}")
+        # skip→None for every row the leg owns: schema-stable JSON even
+        # when the whole leg fails.
+        for n in BUS_SHARD_COUNTS:
+            result.setdefault(f"bus_frames_per_s_shards{n}", None)
+        for key in ("bus_shard_scaling_4x", "bus_shard_concurrent_scaling",
+                    "bus_shard_frames", "bus_shard_host_cores"):
+            result.setdefault(key, None)
     try:
         result.update(_measure_tokenizer())
     except Exception as exc:  # noqa: BLE001 — best-effort row

@@ -15,6 +15,12 @@ Streams, SURVEY.md §2.4); this build brings the bus in-tree:
   dead-letter queue (`GrpcBusServer(spool_dir=...)` survives its own death)
 - `outbox`: bounded durable publisher outbox — a broker outage buffers
   and retries instead of raising into the serving path
+- `partition`: the 1→N control plane — a stable consistent-hash
+  `ShardMap` plus `PartitionedBus`, which puts N broker shards (each a
+  stock `GrpcBusServer` with its OWN spool dir) behind this same bus
+  interface: pull topics route by post_uid/work-item key, fan-out
+  topics broadcast with subscriber-side dedupe, and a dead shard's
+  frames park in that shard's outbox WAL until it returns
 
 On-slice tensor communication is NOT this bus's job: that rides XLA
 collectives over ICI (see `parallel/`).
@@ -29,6 +35,16 @@ from .codec import (
 )
 from .inmemory import InMemoryBus
 from .outbox import DurableOutbox, OutboxBus, OutboxConfig, OutboxFull
+from .partition import (
+    BROADCAST_TOPICS,
+    PartitionedBus,
+    ShardMap,
+    channel_of,
+    default_shard_ids,
+    routing_key,
+    shard_spool_dirs,
+    validate_shard_spool_dirs,
+)
 from .spool import BusSpool, DeadLetter, DeadLetterSpool, TopicSpool
 from .messages import (
     PRIORITY_HIGH,
@@ -95,6 +111,14 @@ __all__ = [
     "OutboxBus",
     "OutboxConfig",
     "OutboxFull",
+    "ShardMap",
+    "PartitionedBus",
+    "BROADCAST_TOPICS",
+    "routing_key",
+    "channel_of",
+    "default_shard_ids",
+    "shard_spool_dirs",
+    "validate_shard_spool_dirs",
 ]
 
 

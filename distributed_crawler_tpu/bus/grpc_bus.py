@@ -634,14 +634,50 @@ class GrpcBusServer:
 
 
 class GrpcBusClient:
-    """Publishes payloads / pulls record-batch frames from a GrpcBusServer."""
+    """Publishes payloads / pulls record-batch frames from a GrpcBusServer.
+
+    **Wedged-channel self-healing**: a channel hammered with RPCs while
+    its broker is down can end up permanently stuck in this grpcio's
+    connect machinery ("Failed to connect to remote host: Timeout
+    occurred: FD Shutdown" forever, even once a new broker process is
+    listening on the same address — reproduced live driving a killed
+    partitioned-bus shard; ~50 failed publishes over a 12 s outage were
+    enough).  The app-level retry/outbox layers fail fast against the
+    wedged channel without ever re-dialing, so the client itself now
+    counts consecutive unary transport failures and REBUILDS the
+    channel (rate-limited) once they cross a threshold — a fresh
+    channel dials a restarted broker within its capped backoff instead
+    of trusting wedged subchannel state.
+    """
+
+    # Rebuild after this many consecutive unary RPC failures, at most
+    # once per cooldown window (an outage longer than the window just
+    # pays one cheap channel rebuild per window).
+    REBUILD_AFTER_FAILURES = 8
+    REBUILD_COOLDOWN_S = 2.0
 
     def __init__(self, target: str = "127.0.0.1:50551"):
         self.target = target
+        self._state_lock = threading.Lock()
+        self._consecutive_failures = 0
+        self._last_rebuild = 0.0
+        self.rebuilds = 0
+        self._build_channel()
+
+    def _build_channel(self) -> None:
         self._channel = grpc.insecure_channel(
-            target,
+            self.target,
             options=[("grpc.max_receive_message_length", MAX_FRAME_BYTES),
-                     ("grpc.max_send_message_length", MAX_FRAME_BYTES)])
+                     ("grpc.max_send_message_length", MAX_FRAME_BYTES),
+                     # Cap the CHANNEL's own reconnect backoff: grpc core
+                     # grows it toward 2 minutes after a few failed
+                     # dials, so a broker that restarts after a ~5 s
+                     # outage could sit unreachable for ANOTHER minute+
+                     # while the app-level retry/outbox machinery
+                     # (which fails fast from the backoff state without
+                     # re-dialing) believes it is retrying.
+                     ("grpc.min_reconnect_backoff_ms", 200),
+                     ("grpc.max_reconnect_backoff_ms", 5000)])
         self._publish = self._channel.unary_unary(
             f"/{SERVICE_NAME}/Publish", request_serializer=_identity,
             response_deserializer=_identity)
@@ -652,16 +688,54 @@ class GrpcBusClient:
             f"/{SERVICE_NAME}/Ack", request_serializer=_identity,
             response_deserializer=_identity)
 
+    def _note_ok(self) -> None:
+        with self._state_lock:
+            self._consecutive_failures = 0
+
+    def _note_failure(self) -> None:
+        rebuild = False
+        with self._state_lock:
+            self._consecutive_failures += 1
+            now = time.monotonic()
+            if self._consecutive_failures >= self.REBUILD_AFTER_FAILURES \
+                    and now - self._last_rebuild >= self.REBUILD_COOLDOWN_S:
+                self._last_rebuild = now
+                self._consecutive_failures = 0
+                self.rebuilds += 1
+                old, rebuild = self._channel, True
+                self._build_channel()
+        if rebuild:
+            logger.warning(
+                "bus channel to %s rebuilt after sustained transport "
+                "failure (rebuild #%d); live pull streams on the old "
+                "channel will redial onto the new one", self.target,
+                self.rebuilds)
+            try:
+                old.close()
+            except Exception as e:  # noqa: BLE001 — best-effort close
+                logger.debug("old channel close failed: %s", e)
+
+    def _unary(self, stub_name: str, request: bytes) -> bytes:
+        stub = getattr(self, stub_name)
+        try:
+            response = stub(request)
+        except grpc.RpcError:
+            self._note_failure()
+            raise
+        self._note_ok()
+        return response
+
     def publish(self, topic: str, payload: Any) -> None:
         # Same propagation seam as InMemoryBus.publish: the envelope
         # crosses a process boundary here, which is exactly the hop the
         # parent_span stamp exists for.
         payload = trace.inject(payload)
-        self._publish(_encode_envelope(topic, serialize_payload(payload)))
+        self._unary("_publish",
+                    _encode_envelope(topic, serialize_payload(payload)))
 
     def publish_frame(self, topic: str, frame: bytes) -> None:
         """Publish an already-encoded codec frame (record batches)."""
-        self._publish(_encode_envelope(topic, frame))
+        self._unary("_publish", _encode_envelope(topic, frame))
 
     def pull(self, topic: str) -> Iterator[Tuple[str, bytes]]:
         """Server-streaming pull; yields (delivery_id, payload).
@@ -678,9 +752,9 @@ class GrpcBusClient:
             call.cancel()
 
     def ack(self, topic: str, delivery_id: str, ok: bool = True) -> None:
-        self._ack(topic.encode("utf-8") + _TOPIC_SEP +
-                  delivery_id.encode("ascii") + _TOPIC_SEP +
-                  (b"ok" if ok else b"fail"))
+        self._unary("_ack", topic.encode("utf-8") + _TOPIC_SEP +
+                    delivery_id.encode("ascii") + _TOPIC_SEP +
+                    (b"ok" if ok else b"fail"))
 
     def close(self) -> None:
         self._channel.close()

@@ -93,7 +93,8 @@ class DurableOutbox:
     def __init__(self, send: Callable[[str, Any], None],
                  cfg: OutboxConfig = OutboxConfig(),
                  name: str = OUTBOX_TARGET,
-                 registry: MetricsRegistry = REGISTRY):
+                 registry: MetricsRegistry = REGISTRY,
+                 breaker_target: Optional[str] = None):
         self._send = send
         self.cfg = cfg
         self.name = name
@@ -118,8 +119,13 @@ class DurableOutbox:
         # resilience_circuit_state{target="bus"} series; the depth/flow
         # series are labeled per publisher so co-hosted outboxes (e.g.
         # the gate's local + worker ones) don't clobber each other.
+        # The partitioned bus (`bus/partition.py`) is the exception:
+        # its outboxes each talk to a DIFFERENT broker shard, so it
+        # passes a per-shard ``breaker_target`` — one shard's outage
+        # must not open the circuit for its healthy siblings.
         self._breaker = resilience.CircuitBreaker(
-            OUTBOX_TARGET, failure_threshold=cfg.breaker_threshold,
+            breaker_target or OUTBOX_TARGET,
+            failure_threshold=cfg.breaker_threshold,
             recovery_timeout_s=cfg.breaker_recovery_s, registry=registry)
         self.m_depth = registry.gauge(
             "bus_outbox_depth",

@@ -1,0 +1,695 @@
+"""Consistent-hash partitioned message bus: scale the control plane 1→N.
+
+PR 10 made the single broker durable; at millions-of-users traffic ONE
+`GrpcBusServer` (and the one orchestrator queue feeding it) is the
+throughput and fan-out ceiling the ROADMAP names.  The reference ran the
+sharded shape natively — Dapr pubsub partitions over Redis Streams with
+a PostgreSQL frontier (PAPER.md §1 layers 3/6) — and this module brings
+it in-tree without touching the broker itself: every shard is a stock
+`GrpcBusServer` with its OWN spool directory, so PR 10's kill/resume
+semantics apply per shard unchanged.
+
+Three pieces:
+
+- :class:`ShardMap` — a stable consistent-hash ring over shard ids
+  (`hashlib` points, never Python's salted ``hash()``, so the same key
+  maps to the same shard in every process and across restarts).  Adding
+  or removing one shard moves only ~1/N of the keyspace — the property
+  that makes resharding an incremental migration instead of a full
+  redeal.
+- :func:`routing_key` — the per-frame key for *routed* (pull/work)
+  topics: ``post_uid`` / work-item id / batch id, with the work-queue
+  special case of the page's CHANNEL (the sharded-frontier contract:
+  one channel's pages always ride one dispatch lane).  Redeliveries of
+  one item therefore always land on the same shard, preserving the
+  per-item ordering + idempotence discipline from PRs 7/10.
+- :class:`PartitionedBus` — N bus endpoints (``RemoteBus`` clients or
+  in-process servers/handles) behind the existing bus interface.
+  Routed topics hash to exactly one shard; fan-out topics
+  (:data:`BROADCAST_TOPICS`) broadcast to EVERY shard (a dead shard
+  cannot black-hole telemetry) and subscribers dedupe by a broadcast id
+  stamped at publish time, so each logical frame is delivered once.
+  Every shard gets its own :class:`~.outbox.DurableOutbox` (its own
+  spill WAL when configured) and its own circuit-breaker target
+  (``bus-<i>``): a dead shard's frames PARK in that shard's outbox
+  until its generation returns — never a silent re-hash to a live
+  shard, which would break same-key-same-shard ordering.
+
+The misconfiguration this module makes impossible: two shards sharing
+one WAL directory (spool or outbox spill) would cross-contaminate each
+other's crash recovery — :func:`validate_shard_spool_dirs` rejects it
+loudly at config time, and the derivation helpers only ever produce
+distinct per-shard subdirectories.
+
+``python -m distributed_crawler_tpu.bus.partition --bench-child ...`` is
+the bus-throughput bench leg's per-shard child (one broker + publisher +
+consumer per process over loopback gRPC; `bench.py` aggregates 1/2/4
+shards into the ``bus_frames_per_s_shards*`` rows).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import threading
+import uuid
+from bisect import bisect_right
+from collections import deque
+from typing import Any, Callable, Dict, Iterable, List, Mapping, Optional
+
+from ..utils import trace
+from ..utils.metrics import REGISTRY, MetricsRegistry
+from .messages import (
+    TOPIC_ALERTS,
+    TOPIC_CHAOS,
+    TOPIC_CLUSTERS,
+    TOPIC_ORCHESTRATOR,
+    TOPIC_SPANS,
+    TOPIC_TRANSCRIPTS,
+    TOPIC_WORKER_STATUS,
+)
+from .outbox import DurableOutbox, OutboxConfig
+
+logger = logging.getLogger("dct.bus.partition")
+
+# Fan-out (announce) topics: every subscriber must see every frame, and
+# no frame may depend on one shard's liveness — publish BROADCASTS to
+# all shards, subscribe attaches to all shards, and the per-frame
+# broadcast id dedupes so each logical frame reaches a handler once.
+# Everything else is a routed (work/pull) topic: exactly one shard per
+# frame, chosen by routing_key().
+BROADCAST_TOPICS = frozenset({
+    TOPIC_WORKER_STATUS, TOPIC_ORCHESTRATOR, TOPIC_CHAOS, TOPIC_SPANS,
+    TOPIC_ALERTS, TOPIC_CLUSTERS, TOPIC_TRANSCRIPTS,
+})
+
+# The broadcast-id stamp: follows the trace.inject precedent (typed
+# messages tolerate extra envelope keys); stripped before handlers see
+# the payload.
+_BCAST_KEY = "_pbus_bcast"
+
+DEFAULT_RING_REPLICAS = 64
+
+
+def default_shard_ids(count: int) -> List[str]:
+    """The canonical shard naming (chaos targets, spool subdirs, breaker
+    targets all use these): ``bus-0`` .. ``bus-<n-1>``."""
+    return [f"bus-{i}" for i in range(count)]
+
+
+def channel_of(url: str) -> str:
+    """Channel name from a frontier URL: the last non-empty path segment,
+    lowercased (t.me/<channel>, youtube.com/@<handle>, or a bare channel
+    name all resolve the same way).  The orchestrator's cluster guide and
+    the sharded frontier share this one rule, so 'the same channel' means
+    the same thing to both."""
+    tail = url.rstrip("/").rsplit("/", 1)[-1]
+    return tail.partition("?")[0].lstrip("@").lower()
+
+
+class ShardMap:
+    """Stable consistent-hash ring over shard ids.
+
+    Each shard owns ``replicas`` points on a 64-bit ring derived from
+    ``md5(f"{shard}#{replica}")`` — process-independent and
+    restart-stable by construction.  ``shard_for(key)`` walks clockwise
+    from ``md5(key)`` to the next point.  With one shard added or
+    removed, only the keys between the moved points change owners
+    (~1/N of the keyspace; pinned by tests/test_bus_partition.py).
+    """
+
+    def __init__(self, shard_ids: Iterable[str],
+                 replicas: int = DEFAULT_RING_REPLICAS):
+        self.shard_ids = list(shard_ids)
+        if not self.shard_ids:
+            raise ValueError("ShardMap needs at least one shard id")
+        if len(set(self.shard_ids)) != len(self.shard_ids):
+            raise ValueError(
+                f"duplicate shard ids in {self.shard_ids!r}")
+        self.replicas = max(1, int(replicas))
+        points: List[tuple] = []
+        for sid in self.shard_ids:
+            for r in range(self.replicas):
+                points.append((self._point(f"{sid}#{r}"), sid))
+        points.sort()
+        self._points = [p for p, _ in points]
+        self._owners = [s for _, s in points]
+
+    @staticmethod
+    def _point(key: str) -> int:
+        # hashlib, NOT hash(): Python's str hash is salted per process,
+        # which would re-deal the ring on every restart.
+        return int.from_bytes(
+            hashlib.md5(key.encode("utf-8")).digest()[:8], "big")
+
+    def shard_for(self, key: str) -> str:
+        i = bisect_right(self._points, self._point(str(key)))
+        if i >= len(self._points):
+            i = 0
+        return self._owners[i]
+
+    def spread(self, keys: Iterable[str]) -> Dict[str, int]:
+        """Key count per shard (tests + the /shards ring summary)."""
+        out = {sid: 0 for sid in self.shard_ids}
+        for k in keys:
+            out[self.shard_for(k)] += 1
+        return out
+
+
+def routing_key(topic: str, payload: Any) -> str:
+    """The stable per-frame routing key for a routed topic.
+
+    Work-queue messages route by the page's CHANNEL (the sharded
+    frontier: one channel's pages — and every redelivery of them — ride
+    one shard's dispatch lane); results route by their work-item id;
+    record/audio batches by batch id; single-record frames by
+    ``post_uid``/``media_id``.  Anything unrecognized routes by the
+    TOPIC name: all frames of an unknown topic share one shard, which
+    keeps them ordered rather than scattered.
+    """
+    if hasattr(payload, "to_dict"):
+        payload = payload.to_dict()
+    if isinstance(payload, (bytes, bytearray)):
+        # Pre-encoded codec frames carry no inspectable key; identical
+        # bytes (a redelivered frame) still hash identically.
+        return hashlib.md5(bytes(payload)).hexdigest()
+    if not isinstance(payload, Mapping):
+        return topic
+    item = payload.get("work_item") or payload.get("item")
+    if isinstance(item, Mapping):
+        url = str(item.get("url") or "")
+        if url:
+            return channel_of(url)
+        if item.get("id"):
+            return str(item["id"])
+    result = payload.get("work_result") or payload.get("result")
+    if isinstance(result, Mapping) and result.get("work_item_id"):
+        return str(result["work_item_id"])
+    for key in ("work_item_id", "post_uid", "batch_id", "media_id"):
+        if payload.get(key):
+            return str(payload[key])
+    return topic
+
+
+def shard_spool_dirs(base_dir: str,
+                     shard_ids: Iterable[str]) -> Dict[str, str]:
+    """Derive one spool (or outbox-spill) directory per shard under
+    ``base_dir`` — distinct by construction, validated anyway."""
+    dirs = {sid: os.path.join(base_dir, sid) for sid in shard_ids}
+    validate_shard_spool_dirs(dirs)
+    return dirs
+
+
+def validate_shard_spool_dirs(dirs_by_shard: Mapping[str, str]) -> None:
+    """LOUD config-time rejection of shared per-shard WAL directories.
+
+    One spool dir across two shards would let each generation replay the
+    other's frames (cross-contaminated WAL recovery = duplicate
+    delivery); the rule applies equally to outbox spill WALs.  Empty
+    entries are rejected too: durability that silently isn't is exactly
+    the misconfiguration class the loud-validation rule exists for.
+    """
+    dirs = dict(dirs_by_shard)
+    empty = sorted(sid for sid, d in dirs.items() if not str(d or "").strip())
+    if empty:
+        raise ValueError(
+            f"bus durability is enabled but shard(s) {', '.join(empty)} "
+            f"have no spool directory — every shard needs its OWN WAL dir")
+    normalized: Dict[str, str] = {}
+    for sid, d in dirs.items():
+        key = os.path.normpath(os.path.abspath(str(d)))
+        if key in normalized:
+            raise ValueError(
+                f"bus shards {normalized[key]!r} and {sid!r} share one "
+                f"spool directory {d!r} — a shared WAL cross-contaminates "
+                f"crash recovery; give every shard its own directory")
+        normalized[key] = sid
+
+
+class _BroadcastDedupe:
+    """Bounded seen-set for broadcast ids: N shard copies of one fan-out
+    frame collapse to a single handler delivery."""
+
+    def __init__(self, window: int = 4096):
+        self._window = max(16, int(window))
+        self._seen: set = set()
+        self._order: deque = deque()
+        self._lock = threading.Lock()
+
+    def first_sighting(self, bcast_id: str) -> bool:
+        with self._lock:
+            if bcast_id in self._seen:
+                return False
+            self._seen.add(bcast_id)
+            self._order.append(bcast_id)
+            while len(self._order) > self._window:
+                self._seen.discard(self._order.popleft())
+            return True
+
+
+class PartitionedBus:
+    """N bus endpoints behind the one-bus interface.
+
+    ``endpoints`` maps shard id -> transport (a ``RemoteBus`` dialing
+    that shard's broker, or an in-process server/handle for co-hosted
+    rigs).  Publishes flow through one :class:`DurableOutbox` PER SHARD
+    (head-of-line, bounded, optional spill WAL, per-shard circuit
+    breaker target ``<shard id>``), so a dead shard parks its frames in
+    its own outbox until that shard's generation returns; the ring is
+    never consulted twice for one frame (no failover re-hash).
+
+    Subscribe semantics: routed topics register the handler on EVERY
+    shard (competing consumers per shard queue — work from any shard
+    reaches any worker); broadcast topics register a deduping wrapper on
+    every shard so each logical frame is delivered exactly once even
+    though the publish fanned out N ways.
+    """
+
+    def __init__(self, endpoints: Mapping[str, Any],
+                 shard_map: Optional[ShardMap] = None,
+                 outbox: Optional[Callable[[str], OutboxConfig]] = None,
+                 name: str = "pbus",
+                 registry: MetricsRegistry = REGISTRY,
+                 broadcast_topics: frozenset = BROADCAST_TOPICS,
+                 dedupe_window: int = 4096,
+                 close_endpoints: bool = True):
+        if not endpoints:
+            raise ValueError("PartitionedBus needs at least one endpoint")
+        self._endpoints: Dict[str, Any] = dict(endpoints)
+        self.shard_map = shard_map or ShardMap(list(self._endpoints))
+        extra = set(self.shard_map.shard_ids) ^ set(self._endpoints)
+        if extra:
+            raise ValueError(
+                f"shard map and endpoints disagree on shard ids: "
+                f"{sorted(extra)}")
+        self.name = name
+        self.broadcast_topics = frozenset(broadcast_topics)
+        self._close_endpoints = close_endpoints
+        self._lock = threading.Lock()
+        self._pull_topics: List[str] = []
+        self._routed_counts: Dict[tuple, int] = {}
+        self._broadcast_count = 0
+        self._dedupe_window = dedupe_window
+        self.m_routed = registry.counter(
+            "bus_shard_frames_total",
+            "frames routed to one shard of the partitioned bus "
+            "(bus/partition.py; key = routing_key)")
+        self.m_broadcast = registry.counter(
+            "bus_shard_broadcast_total",
+            "fan-out frames broadcast to every shard of the "
+            "partitioned bus")
+        # One outbox + one breaker target per shard: the failover story.
+        # A shared spill directory across shards is rejected exactly like
+        # a shared broker spool (validate_shard_spool_dirs).
+        cfgs = {sid: (outbox(sid) if callable(outbox) else OutboxConfig())
+                for sid in self._endpoints}
+        spill = {sid: c.dir for sid, c in cfgs.items() if c.dir}
+        if spill:
+            missing = sorted(set(self._endpoints) - set(spill))
+            if missing:
+                raise ValueError(
+                    f"outbox spill WALs configured for only part of the "
+                    f"fleet (shard(s) {', '.join(missing)} have none) — "
+                    f"durability must cover every shard or none")
+            validate_shard_spool_dirs(spill)
+        self._outboxes: Dict[str, DurableOutbox] = {}
+        for sid, ep in self._endpoints.items():
+            self._outboxes[sid] = DurableOutbox(
+                self._sender(ep), cfgs[sid], name=f"{name}-{sid}",
+                registry=registry, breaker_target=sid)
+
+    @staticmethod
+    def _sender(ep) -> Callable[[str, Any], None]:
+        def _send(topic: str, payload: Any) -> None:
+            ep.publish(topic, payload)
+        return _send
+
+    # -- publish side --------------------------------------------------------
+    def publish(self, topic: str, payload: Any) -> None:
+        # Unwrap to the dict form first (the serialize_payload rule),
+        # then stamp the trace parent HERE (the outbox flusher thread
+        # has no span context) — one stamp keeps the N broadcast copies
+        # identical, and the inner transports' inject is a no-op on an
+        # already-stamped payload.
+        if hasattr(payload, "to_dict"):
+            payload = payload.to_dict()
+        payload = trace.inject(payload)
+        if topic in self.broadcast_topics:
+            if isinstance(payload, dict):
+                payload = {**payload, _BCAST_KEY: uuid.uuid4().hex}
+            # Fan-out delivery needs AT LEAST ONE shard copy to land
+            # (subscribers attach to every shard and dedupe), so a
+            # minority of full outboxes degrades the redundancy, never
+            # the publish: raising mid-loop after siblings already
+            # enqueued would make the caller retry a frame that WILL be
+            # delivered — and the retry's fresh broadcast id would
+            # duplicate it.  Only an all-targets rejection raises.
+            #
+            # A shard already known-dead (breaker OPEN) is skipped, not
+            # parked-into: sibling copies deliver NOW, and a copy
+            # parked for minutes outlives the dedupe window and would
+            # replay at restart as a STALE duplicate command/alert —
+            # fan-out frames degrade promptness, never correctness
+            # (bus/messages.py), so redundancy is not worth stale
+            # replay (and parked broadcast copies would crowd routed
+            # frames out of the dead shard's bounded outbox).  A TOTAL
+            # outage (every breaker open) still buffers everywhere:
+            # with no live copy possible, eventual delivery beats loss
+            # — the single-broker durable behavior.
+            open_shards = {sid for sid, ob in self._outboxes.items()
+                           if ob.circuit_state == "open"}
+            targets = [sid for sid in self._endpoints
+                       if sid not in open_shards] \
+                or list(self._endpoints)
+            if open_shards and len(targets) < len(self._endpoints):
+                logger.debug(
+                    "broadcast on %s skipping open-breaker shard(s) %s",
+                    topic, sorted(open_shards))
+            errors: List[tuple] = []
+            for sid in targets:
+                try:
+                    self._outboxes[sid].publish(topic, payload)
+                except Exception as e:  # OutboxFull, closed outbox
+                    errors.append((sid, e))
+            if len(errors) == len(targets):
+                raise errors[0][1]
+            if errors:
+                logger.warning(
+                    "broadcast on %s skipped %d/%d shard outbox(es) "
+                    "(%s); the live copies still deliver", topic,
+                    len(errors), len(targets),
+                    "; ".join(f"{sid}: {e}" for sid, e in errors))
+            with self._lock:
+                self._broadcast_count += 1
+            self.m_broadcast.labels(topic=topic).inc()
+            return
+        key = routing_key(topic, payload)
+        sid = self.shard_map.shard_for(key)
+        self._outboxes[sid].publish(topic, payload)
+        self.m_routed.labels(shard=sid, topic=topic).inc()
+        with self._lock:
+            self._routed_counts[(sid, topic)] = \
+                self._routed_counts.get((sid, topic), 0) + 1
+
+    def shard_for_key(self, key: str) -> str:
+        return self.shard_map.shard_for(key)
+
+    # -- subscribe side ------------------------------------------------------
+    def subscribe(self, topic: str, handler: Callable[..., None],
+                  manual_ack: Optional[bool] = None) -> None:
+        if topic in self.broadcast_topics:
+            if manual_ack:
+                raise ValueError(
+                    f"manual-ack subscription on broadcast topic "
+                    f"{topic!r}: fan-out frames are auto-ack by design")
+            handler = self._dedupe_wrapper(handler)
+            manual_ack = None
+        for ep in self._endpoints.values():
+            self._ep_subscribe(ep, topic, handler, manual_ack)
+
+    @staticmethod
+    def _ep_subscribe(ep, topic, handler, manual_ack) -> None:
+        if manual_ack is None:
+            ep.subscribe(topic, handler)
+            return
+        try:
+            ep.subscribe(topic, handler, manual_ack=manual_ack)
+        except TypeError:
+            # Local servers/handles take (topic, handler) only; their
+            # dispatch has no ack channel, so the kwarg is advisory.
+            ep.subscribe(topic, handler)
+
+    def _dedupe_wrapper(self, handler: Callable[[Any], None]  # crawlint: disable=BUS004
+                        ) -> Callable[[Any], None]:
+        # No payload_span here: this wrapper runs INSIDE the endpoint
+        # transport's own `bus.deliver` span (InMemoryBus/RemoteBus/
+        # GrpcBusServer all wrap dispatch) — a second span would
+        # double-count the delivery hop in every trace.
+        dedupe = _BroadcastDedupe(self._dedupe_window)
+
+        def _deliver(payload: Any) -> None:  # crawlint: disable=BUS004
+            if isinstance(payload, dict):
+                bcast_id = payload.get(_BCAST_KEY)
+                if bcast_id is not None:
+                    if not dedupe.first_sighting(str(bcast_id)):
+                        return  # another shard's copy already delivered
+                    payload = {k: v for k, v in payload.items()
+                               if k != _BCAST_KEY}
+            handler(payload)
+
+        return _deliver
+
+    # -- the rest of the bus interface --------------------------------------
+    def enable_pull(self, topic: str) -> None:
+        with self._lock:
+            if topic not in self._pull_topics:
+                self._pull_topics.append(topic)
+        for ep in self._endpoints.values():
+            fn = getattr(ep, "enable_pull", None)
+            if callable(fn):
+                fn(topic)
+
+    def pending_count(self, topic: str) -> int:
+        total = 0
+        for ep in self._endpoints.values():
+            fn = getattr(ep, "pending_count", None)
+            if callable(fn):
+                total += int(fn(topic))
+        return total
+
+    def flush_local(self, timeout_s: float = 5.0) -> bool:
+        ok = True
+        for ep in self._endpoints.values():
+            fn = getattr(ep, "flush_local", None)
+            if callable(fn):
+                ok = fn(timeout_s) and ok
+        return ok
+
+    def drain(self, timeout_s: float = 30.0, poll_s: float = 0.2) -> bool:
+        """Outboxes first (a parked frame is pending work the brokers
+        can't see yet), then every shard against one shared deadline."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        ok = self.drain_outboxes(timeout_s)
+        for ep in self._endpoints.values():
+            fn = getattr(ep, "drain", None)
+            if callable(fn):
+                left = max(0.1, deadline - _time.monotonic())
+                ok = fn(timeout_s=left, poll_s=poll_s) and ok
+        return ok
+
+    def dlq_snapshot(self, topic: Optional[str] = None,
+                     id: Optional[str] = None) -> Dict[str, Any]:
+        """Merged /dlq body: per-shard bodies under ``shards``, plus a
+        top-level ``topics`` fold (counts summed, newest entries
+        shard-stamped) so `tools/dlq.py`'s live mode reads a sharded
+        broker the same way it reads one."""
+        shards: Dict[str, Any] = {}
+        merged: Dict[str, Any] = {}
+        enabled = False
+        total = 0
+        entry = None
+        for sid, ep in self._endpoints.items():
+            fn = getattr(ep, "dlq_snapshot", None)
+            if not callable(fn):
+                continue
+            body = fn(topic=topic, id=id)
+            shards[sid] = body
+            enabled = enabled or bool(body.get("enabled"))
+            total += int(body.get("dead_letters_total", 0) or 0)
+            if body.get("entry") is not None and entry is None:
+                entry = {**body["entry"], "shard": sid}
+            for t, info in (body.get("topics") or {}).items():
+                agg = merged.setdefault(
+                    t, {"count": 0, "pending": 0, "entries": []})
+                agg["count"] += int(info.get("count", 0) or 0)
+                agg["pending"] += int(info.get("pending", 0) or 0)
+                agg["entries"].extend(
+                    {**e, "shard": sid} if isinstance(e, dict) else e
+                    for e in info.get("entries") or [])
+        out = {"enabled": enabled, "sharded": True,
+               "dead_letters_total": total, "topics": merged,
+               "shards": shards}
+        if entry is not None:
+            out["entry"] = entry
+        return out
+
+    # -- failover / introspection -------------------------------------------
+    def shard_outboxes(self) -> List[DurableOutbox]:
+        return list(self._outboxes.values())
+
+    def outbox_depth(self) -> int:
+        return sum(ob.depth() for ob in self._outboxes.values())
+
+    def drain_outboxes(self, timeout_s: float = 10.0) -> bool:
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        ok = True
+        for ob in self._outboxes.values():
+            left = max(0.1, deadline - _time.monotonic())
+            ok = ob.drain(timeout_s=left) and ok
+        return ok
+
+    def routed_counts(self, topic: Optional[str] = None
+                      ) -> Dict[str, int]:
+        """Frames routed per shard (optionally for one topic) — the
+        routing-skew read the gate's ``max_shard_skew`` check uses."""
+        with self._lock:
+            out = {sid: 0 for sid in self._endpoints}
+            for (sid, t), n in self._routed_counts.items():
+                if topic is None or t == topic:
+                    out[sid] += n
+        return out
+
+    def generations(self) -> Dict[str, Any]:
+        return {sid: getattr(ep, "generation", None)
+                for sid, ep in self._endpoints.items()}
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``/shards`` surface body (tools/watch.py shards panel;
+        embedded in postmortem bundles via ``shards_snapshot``)."""
+        with self._lock:
+            pull_topics = list(self._pull_topics)
+            routed = dict(self._routed_counts)
+            broadcast = self._broadcast_count
+        shards: Dict[str, Any] = {}
+        for sid, ep in self._endpoints.items():
+            ob = self._outboxes[sid]
+            alive: Optional[bool] = None
+            if hasattr(ep, "server"):          # BusHandle-shaped
+                alive = ep.server is not None
+            pending: Dict[str, int] = {}
+            fn = getattr(ep, "pending_count", None)
+            if callable(fn):
+                for t in pull_topics:
+                    try:
+                        pending[t] = int(fn(t))
+                    except Exception as e:
+                        logger.debug("pending_count(%s) on %s failed: %s",
+                                     t, sid, e)
+            shards[sid] = {
+                "address": getattr(ep, "address", None)
+                or getattr(ep, "target", None),
+                "generation": getattr(ep, "generation", None),
+                "alive": alive,
+                "outbox_depth": ob.depth(),
+                "outbox_capacity": ob.cfg.max_frames,
+                "breaker": ob.circuit_state,
+                "routed_frames": {t: n for (s, t), n in routed.items()
+                                  if s == sid},
+                "pending": pending,
+            }
+        return {
+            "name": self.name,
+            "shards": shards,
+            "ring": {"shard_ids": list(self.shard_map.shard_ids),
+                     "replicas": self.shard_map.replicas},
+            "broadcast_frames": broadcast,
+            "pull_topics": pull_topics,
+            "outbox_depth_total": sum(
+                s["outbox_depth"] for s in shards.values()),
+        }
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        for ep in self._endpoints.values():
+            fn = getattr(ep, "start", None)
+            if callable(fn):
+                fn()
+
+    def close(self, drain_s: float = 2.0) -> None:
+        for ob in self._outboxes.values():
+            ob.close(drain_s=drain_s)
+        if not self._close_endpoints:
+            return
+        for sid, ep in self._endpoints.items():
+            fn = getattr(ep, "close", None)
+            if callable(fn):
+                try:
+                    fn()
+                except Exception as e:
+                    logger.warning("shard %s close error: %s", sid, e)
+
+
+# --- bench child (`bench.py` bus-throughput leg) ----------------------------
+
+def _bench_child(argv: List[str]) -> int:
+    """One shard of the bus-throughput bench: hosts a stock GrpcBusServer
+    on a loopback port, publishes this shard's ring-owned slice of a
+    seeded uid space through real Publish RPCs, and pulls+acks every
+    frame back.  A READY/GO stdin handshake lets the parent start all
+    shards' measurement windows together, so the aggregate is a genuine
+    concurrent-brokers number (each child is its own OS process — the
+    deployment shape, one broker per process)."""
+    import argparse
+    import json
+    import sys
+    import time
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--bench-child", action="store_true")
+    p.add_argument("--shard-index", type=int, required=True)
+    p.add_argument("--shard-count", type=int, required=True)
+    p.add_argument("--frames", type=int, default=2400)
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--payload-bytes", type=int, default=256)
+    args = p.parse_args(argv)
+
+    from .grpc_bus import GrpcBusClient, GrpcBusServer
+    from .messages import TOPIC_INFERENCE_BATCHES
+
+    sids = default_shard_ids(args.shard_count)
+    ring = ShardMap(sids)
+    own = sids[args.shard_index]
+    uids = [f"post-{args.seed}-{i:06d}" for i in range(args.frames)]
+    owned = [u for u in uids if ring.shard_for(u) == own]
+
+    server = GrpcBusServer("127.0.0.1:0")
+    server.enable_pull(TOPIC_INFERENCE_BATCHES)
+    server.start()
+    client = GrpcBusClient(f"127.0.0.1:{server.bound_port}")
+    body = "x" * max(0, args.payload_bytes)
+    got = 0
+    done = threading.Event()
+
+    def _consume() -> None:
+        nonlocal got
+        for delivery_id, _frame in client.pull(TOPIC_INFERENCE_BATCHES):
+            client.ack(TOPIC_INFERENCE_BATCHES, delivery_id, True)
+            got += 1
+            if got >= len(owned):
+                done.set()
+                return
+
+    print("READY", flush=True)
+    sys.stdin.readline()  # GO — every child starts its window together
+    consumer = threading.Thread(target=_consume, daemon=True)
+    t0 = time.perf_counter()
+    consumer.start()
+    for u in owned:
+        client.publish(TOPIC_INFERENCE_BATCHES,
+                       {"post_uid": u, "batch_id": u, "body": body})
+    completed = done.wait(timeout=120.0)
+    wall = time.perf_counter() - t0
+    print(json.dumps({
+        "shard": own, "frames": got, "owned": len(owned),
+        "completed": bool(completed), "wall_s": round(wall, 4),
+        "frames_per_s": round(got / wall, 1) if wall > 0 else 0.0,
+    }), flush=True)
+    client.close()
+    server.close(grace=0.1)
+    return 0 if completed else 1
+
+
+if __name__ == "__main__":
+    import sys as _sys
+
+    if "--bench-child" in _sys.argv:
+        _sys.exit(_bench_child(_sys.argv[1:]))
+    _sys.stderr.write(
+        "usage: python -m distributed_crawler_tpu.bus.partition "
+        "--bench-child --shard-index I --shard-count N [--frames F]\n")
+    _sys.exit(2)

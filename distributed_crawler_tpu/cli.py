@@ -143,6 +143,19 @@ def build_parser() -> argparse.ArgumentParser:
       help="bound on publishes buffered in the durable outbox while the "
            "broker is unreachable (default 1024; the orchestrator pauses "
            "crawl dispatch as the buffer nears this bound)")
+    # Partitioned bus (docs/operations.md "Partitioned bus & sharded
+    # frontier"): N broker shards behind one consistent-hash client.
+    a("--bus-shard-addresses", default=None,
+      help="comma-separated gRPC addresses of the bus broker SHARDS "
+           "(one `--mode bus` process per address, each with its OWN "
+           "--bus-spool-dir).  This process routes pull-topic frames by "
+           "post_uid/work-item key across them (bus/partition.py) and "
+           "broadcasts fan-out topics; a dead shard's frames park in "
+           "that shard's outbox until it returns")
+    a("--bus-shards", type=int, default=None,
+      help="expected shard count; validated against "
+           "--bus-shard-addresses so a truncated address list fails "
+           "loudly instead of silently re-dealing the hash ring")
     a("--bus-ack-timeout-s", type=float, default=None,
       help="seconds a pulled frame may stay unacked before the broker "
            "requeues it for another worker (default 300)")
@@ -541,6 +554,8 @@ _KEY_MAP = {
     "bus_address": "distributed.bus_address",
     "bus_serve": "distributed.bus_serve",
     "bus_spool_dir": "bus.spool_dir",
+    "bus_shards": "bus.shards",
+    "bus_shard_addresses": "bus.shard_addresses",
     "bus_outbox_max_frames": "bus.outbox_max_frames",
     "bus_ack_timeout_s": "bus.ack_timeout_s",
     "bus_max_attempts": "bus.max_attempts",
@@ -1070,10 +1085,12 @@ def _build_autoscaler(r: "ConfigResolver", orch, bus):
     if not r.get_bool("autoscaler.enabled", False):
         return None
     bus_address = r.get_str("distributed.bus_address")
-    if not bus_address:
+    shard_addresses = _parse_shard_addresses(r)
+    if not bus_address and not shard_addresses:
         raise CliConfigError(
-            "--autoscaler requires --bus-address (spawned workers must "
-            "be able to dial the broker this orchestrator hosts)")
+            "--autoscaler requires --bus-address (or "
+            "--bus-shard-addresses on a partitioned control plane): "
+            "spawned workers must be able to dial the broker(s)")
     from .orchestrator.autoscaler import (
         Autoscaler,
         PoolPolicy,
@@ -1115,7 +1132,9 @@ def _build_autoscaler(r: "ConfigResolver", orch, bus):
     extra = _shlex.split(r.get_str("autoscaler.worker_args", ""))
     supervisor = SubprocessSupervisor({
         p.pool: default_subprocess_argv(p.pool, bus_address,
-                                        extra_args=extra)
+                                        extra_args=extra,
+                                        shard_addresses=shard_addresses
+                                        or None)
         for p in pools})
     autoscaler = Autoscaler(
         supervisor, pools,
@@ -1282,13 +1301,100 @@ def _bus_outbox_config(r: ConfigResolver, who: str):
         max_frames=r.get_int("bus.outbox_max_frames", 1024))
 
 
+def _parse_shard_addresses(r: ConfigResolver) -> list:
+    """The partitioned-bus shard list from ``bus.shard_addresses``
+    (comma string from --bus-shard-addresses, or a YAML list), validated
+    LOUDLY: a declared ``bus.shards`` count must match (a truncated
+    address list would silently re-deal the consistent-hash ring), and
+    duplicate addresses are rejected (two shards sharing one broker —
+    and therefore one WAL spool — cross-contaminate crash recovery)."""
+    get = getattr(r, "get", None)  # partial test resolvers
+    raw = get("bus.shard_addresses") if callable(get) else None
+    if isinstance(raw, str):
+        addrs = [a.strip() for a in raw.split(",") if a.strip()]
+    elif isinstance(raw, (list, tuple)):
+        addrs = [str(a).strip() for a in raw if str(a).strip()]
+    else:
+        addrs = []
+    declared = r.get_int("bus.shards", 0) if r else 0
+    if declared > 1 and not addrs:
+        raise CliConfigError(
+            "--bus-shards needs --bus-shard-addresses (one gRPC address "
+            "per broker shard)")
+    if not addrs:
+        return []
+    if declared and declared != len(addrs):
+        raise CliConfigError(
+            f"--bus-shards={declared} but --bus-shard-addresses names "
+            f"{len(addrs)} shard(s) — a mismatched list would re-deal "
+            f"the consistent-hash ring; fix one of them")
+    if len(set(addrs)) != len(addrs):
+        raise CliConfigError(
+            f"duplicate addresses in --bus-shard-addresses {addrs!r}: "
+            f"two shards sharing one broker (and its WAL spool) would "
+            f"cross-contaminate each other's crash recovery")
+    return addrs
+
+
 def _make_bus(r: ConfigResolver, serve: bool = False):
     """Bus selection: --bus-address set -> gRPC DCN transport (orchestrator
     hosts a GrpcBusServer with the work queue pull-enabled; workers dial a
     RemoteBus with competing-consumer pull).  Unset -> in-process bus.
     With `bus.spool_dir` set, the hosted broker journals pull-topic frames
     + dead letters in the WAL spool and client publishes ride a durable
-    outbox (docs/operations.md "Bus durability & dead letters")."""
+    outbox (docs/operations.md "Bus durability & dead letters").
+    With `bus.shard_addresses` set, the CLIENT side becomes a
+    `PartitionedBus` over every shard (docs/operations.md "Partitioned
+    bus & sharded frontier") — serving stays one broker per process."""
+    shard_addrs = _parse_shard_addresses(r) if r else []
+    if shard_addrs and serve:
+        raise CliConfigError(
+            "--bus-serve (and --mode bus) host ONE broker shard per "
+            "process: run one --mode bus process per shard address, each "
+            "with its OWN --bus-spool-dir, and point clients at "
+            "--bus-shard-addresses")
+    if shard_addrs and r.get_str("distributed.bus_address"):
+        # Silently preferring one would leave the operator believing
+        # traffic rides the other — the loud-misconfiguration rule.
+        raise CliConfigError(
+            "--bus-address and --bus-shard-addresses are mutually "
+            "exclusive: pass the single broker OR the shard list, "
+            "not both")
+    if shard_addrs:
+        import dataclasses
+
+        from .bus.grpc_bus import RemoteBus
+        from .bus.partition import (
+            PartitionedBus,
+            ShardMap,
+            default_shard_ids,
+        )
+
+        sids = default_shard_ids(len(shard_addrs))
+        who = r.get_str("distributed.worker_id") \
+            or r.get_str("distributed.mode") or "client"
+        base_cfg = _bus_outbox_config(r, who)
+        shard_outbox = None
+        if base_cfg is not None:
+            # Per-shard spill WALs under the publisher's outbox dir —
+            # distinct by construction (PartitionedBus re-validates).
+            def shard_outbox(sid, _base=base_cfg):  # noqa: E731
+                return dataclasses.replace(
+                    _base, dir=os.path.join(_base.dir, sid))
+        endpoints = {sid: RemoteBus(addr)
+                     for sid, addr in zip(sids, shard_addrs)}
+        logger.info("partitioned bus: %d shard(s) %s (durable outboxes: "
+                    "%s)", len(shard_addrs), shard_addrs,
+                    "on" if base_cfg is not None else "off")
+        bus = PartitionedBus(endpoints, ShardMap(sids),
+                             outbox=shard_outbox, name=who)
+        # Any process holding a partitioned client serves the /shards
+        # table on its metrics port (per-shard breaker/outbox/parked
+        # state — the operator's "which shard is limping" read).
+        from .utils.metrics import set_shards_provider
+
+        set_shards_provider(bus.snapshot)
+        return bus
     address = r.get_str("distributed.bus_address") if r else ""
     if not address:
         if r and r.get_str("bus.spool_dir", ""):

@@ -117,6 +117,9 @@ _GATE_KEYS_TEXT = _GATE_KEYS_SHARED | {
     "require_scale_event", "max_scale_events", "min_fleet_size",
     "max_fleet_size", "max_time_to_converge_s",
     "forbid_scale_down_in_fault", "fault_window",
+    # The partitioned-bus envelope (`bus/partition.py`; needs a
+    # "bus_shards" block — validate_gate_config enforces the pairing).
+    "max_shard_skew", "bus_shard_generations",
 }
 _GATE_KEYS_ASR = _GATE_KEYS_SHARED | {
     "max_transcript_errors", "reentry_required", "asr_batch_p95_ms",
@@ -193,6 +196,54 @@ def validate_gate_config(scenario: Dict[str, Any]) -> None:
             raise ValueError(
                 f"scenario {name!r}: gate fault_window must be "
                 f"[start_s, end_s] with end > start, got {window!r}")
+    # Partitioned control plane (`bus/partition.py`): a "bus_shards"
+    # block runs N broker shards behind a PartitionedBus.  Unknown keys
+    # are rejected — in particular there is deliberately NO way to name
+    # a (shared) spool directory here: per-shard spool + outbox dirs are
+    # always derived distinct (one shared WAL across shards would
+    # cross-contaminate crash recovery, the loud-validation rule).
+    shards_cfg = scenario.get("bus_shards") or {}
+    if shards_cfg:
+        if kind in ("asr", "cluster"):
+            raise ValueError(
+                f"scenario {name!r}: \"bus_shards\" blocks are not "
+                f"supported on kind={kind} scenarios (only the text gate "
+                f"has partitioned-bus wiring)")
+        bad = set(shards_cfg) - {"count", "replicas"}
+        if bad:
+            raise ValueError(
+                f"scenario {name!r}: unknown bus_shards key(s) "
+                f"{', '.join(sorted(bad))} (per-shard spool/outbox dirs "
+                f"are always derived — they cannot be shared)")
+        count = int(shards_cfg.get("count", 0))
+        if not 2 <= count <= 16:
+            raise ValueError(
+                f"scenario {name!r}: bus_shards.count must be 2..16, "
+                f"got {shards_cfg.get('count')!r}")
+        if scenario.get("bus") != "grpc":
+            raise ValueError(
+                f"scenario {name!r}: a bus_shards block needs "
+                f"bus='grpc' (each shard is its own GrpcBusServer)")
+    else:
+        for key in ("max_shard_skew", "bus_shard_generations"):
+            if key in gate_cfg:
+                raise ValueError(
+                    f"scenario {name!r}: gate key {key!r} needs a "
+                    f"\"bus_shards\" block (it would otherwise be a "
+                    f"silent no-op)")
+    if gate_cfg.get("bus_shard_generations") is not None:
+        from ..bus.partition import default_shard_ids
+
+        gens = gate_cfg["bus_shard_generations"]
+        count = int(shards_cfg.get("count", 0))
+        expected_ids = set(default_shard_ids(count))
+        if not isinstance(gens, dict) or set(gens) != expected_ids \
+                or not all(isinstance(v, int) and v >= 1
+                           for v in gens.values()):
+            raise ValueError(
+                f"scenario {name!r}: bus_shard_generations must map "
+                f"EVERY shard id ({', '.join(sorted(expected_ids))}) to "
+                f"an int generation >= 1, got {gens!r}")
     # The blocks the gate consumes alongside the envelope: parse them
     # through their own loud validators.
     rules_from_config(scenario.get("alerts"))
@@ -759,6 +810,11 @@ class _ServingWorkerHandle:
         outbox = getattr(self.bus, "outbox", None)
         if outbox is not None:
             outbox.close(drain_s=0.0)
+        shard_outboxes = getattr(self.bus, "shard_outboxes", None)
+        if callable(shard_outboxes):
+            # Partitioned bus: same SIGKILL fidelity per shard outbox.
+            for ob in shard_outboxes():
+                ob.close(drain_s=0.0)
         close = getattr(self.bus, "close", None)
         if callable(close):
             close()  # gRPC: tear the pull stream; un-acked frames requeue
@@ -939,6 +995,7 @@ def run_scenario(scenario: Dict[str, Any],
         clear_cluster_provider,
         clear_dlq_provider,
         clear_dtraces_provider,
+        clear_shards_provider,
         serve_metrics,
         set_alerts_provider,
         set_autoscaler_provider,
@@ -946,6 +1003,7 @@ def run_scenario(scenario: Dict[str, Any],
         set_costs_provider,
         set_dlq_provider,
         set_dtraces_provider,
+        set_shards_provider,
         set_status_provider,
     )
 
@@ -1058,69 +1116,158 @@ def run_scenario(scenario: Dict[str, Any],
     # zero-loss envelope.
     durable_cfg = scenario.get("bus_durability") or {}
     durable = bool(durable_cfg) and bus_kind == "grpc"
-    if any(f.target == "bus" and f.action in ("kill", "restart", "down")
+    # Partitioned control plane (`bus/partition.py`): a "bus_shards"
+    # block replaces the single broker with N GrpcBusServer shards
+    # (chaos targets "bus-0".."bus-<n-1>") behind a PartitionedBus.
+    shards_cfg = scenario.get("bus_shards") or {}
+    n_shards = int(shards_cfg.get("count", 0)) if shards_cfg else 0
+    sharded = n_shards > 1
+    shards_provider = None
+
+    def _is_bus_target(t: str) -> bool:
+        return t == "bus" or (t.startswith("bus-") and t[4:].isdigit())
+
+    if any(_is_bus_target(f.target)
+           and f.action in ("kill", "restart", "down")
            for f in timeline) and not durable:
         # Without a spool + outboxes, the generator's first publish into
         # the dead broker raises and the run would report phantom "lost
         # items" instead of a clear config error.
         raise ValueError(
-            "a kill/restart/down 'bus' timeline line requires a "
+            "a kill/restart/down bus timeline line requires a "
             "\"bus_durability\" block (broker spool + publisher "
             "outboxes) on a grpc scenario")
     verdict: Dict[str, Any] = {"scenario": name, "bus": bus_kind,
-                               "bus_durable": durable}
+                               "bus_durable": durable,
+                               "bus_sharded": sharded}
     try:
         # --- bus fabric ---------------------------------------------------
         if bus_kind == "grpc":
             from ..bus.grpc_bus import GrpcBusServer, RemoteBus
 
-            spool_dir = os.path.join(tmpdir, "bus-spool") if durable \
-                else None
+            outbox_frames = int(durable_cfg.get("outbox_max_frames", 512))
 
-            def _make_server(bind_addr):
-                return GrpcBusServer(
-                    bind_addr or "127.0.0.1:0", spool_dir=spool_dir,
-                    ack_timeout_s=float(
-                        durable_cfg.get("ack_timeout_s", 300.0)),
-                    max_attempts=int(durable_cfg.get("max_attempts", 5)),
-                    registry=registry)
+            def _make_server_for(spool):
+                def _make(bind_addr):
+                    return GrpcBusServer(
+                        bind_addr or "127.0.0.1:0", spool_dir=spool,
+                        ack_timeout_s=float(
+                            durable_cfg.get("ack_timeout_s", 300.0)),
+                        max_attempts=int(
+                            durable_cfg.get("max_attempts", 5)),
+                        registry=registry)
+                return _make
 
-            server = BusHandle(_make_server)
-            server.enable_pull(TOPIC_INFERENCE_BATCHES)
-            server.start()
-            addr = server.address
-            if durable:
-                outbox_frames = int(
-                    durable_cfg.get("outbox_max_frames", 512))
+            if sharded:
+                # Partitioned control plane: N broker shards, each a
+                # stock GrpcBusServer behind its OWN BusHandle (chaos
+                # target "bus-<i>") over its OWN spool dir — PR 10's
+                # kill/resume semantics apply per shard unchanged.  The
+                # PartitionedBus routes pull frames by post_uid/work-
+                # item key, broadcasts fan-out topics, and parks a dead
+                # shard's frames in that shard's outbox (never a
+                # re-hash).
+                from ..bus import partition
 
-                def _outbox_cfg(sub: str) -> OutboxConfig:
-                    return OutboxConfig(
-                        dir=os.path.join(tmpdir, "outbox", sub),
-                        max_frames=outbox_frames,
-                        breaker_recovery_s=0.25)
+                shard_ids = partition.default_shard_ids(n_shards)
+                ring = partition.ShardMap(
+                    shard_ids,
+                    replicas=int(shards_cfg.get("replicas", 64)))
+                spool_dirs = partition.shard_spool_dirs(
+                    os.path.join(tmpdir, "bus-spool"), shard_ids) \
+                    if durable else {sid: None for sid in shard_ids}
+                shard_handles: Dict[str, BusHandle] = {}
+                for sid in shard_ids:
+                    h = BusHandle(_make_server_for(spool_dirs[sid]))
+                    h.enable_pull(TOPIC_INFERENCE_BATCHES)
+                    h.start()
+                    shard_handles[sid] = h
+                addresses = {sid: h.address
+                             for sid, h in shard_handles.items()}
 
-                # Orchestrator + generator side: local publishes buffer
-                # through the outbox while the broker is down.
-                local_bus = OutboxBus(server, _outbox_cfg("local"),
-                                      name="local", registry=registry,
-                                      close_inner=False)
-                local_outbox = local_bus
-                worker_outbox = _outbox_cfg("worker")
-                make_worker_bus = lambda: RemoteBus(  # noqa: E731
-                    addr, outbox=worker_outbox, registry=registry)
-                # Dynamic (autoscaler-spawned) workers each get their
-                # OWN outbox dir: two live workers sharing one spill WAL
-                # would corrupt each other's reload.
-                make_worker_bus_for = lambda wname: RemoteBus(  # noqa: E731
-                    addr, outbox=_outbox_cfg(f"worker-{wname}"),
-                    registry=registry)
-                dlq_provider = server.dlq_snapshot
-                set_dlq_provider(dlq_provider)
+                def _shard_outbox_cfg(role: str):
+                    # Per-shard spill WALs on durable runs (derived
+                    # distinct, validated by the PartitionedBus);
+                    # memory-only parking otherwise.
+                    def _cfg(sid: str) -> OutboxConfig:
+                        return OutboxConfig(
+                            dir=os.path.join(tmpdir, "outbox", role, sid)
+                            if durable else "",
+                            max_frames=outbox_frames,
+                            breaker_recovery_s=0.25)
+                    return _cfg
+
+                server = partition.PartitionedBus(
+                    shard_handles, ring,
+                    outbox=_shard_outbox_cfg("local"),
+                    name="local", registry=registry)
+                # Idempotent re-registration: the handles were pull-
+                # enabled before construction (frames queue from the
+                # first publish), but the PartitionedBus must also KNOW
+                # the topic so /shards reports per-shard queue depths.
+                server.enable_pull(TOPIC_INFERENCE_BATCHES)
+                local_bus = server
+
+                def _worker_pbus(wname: str):
+                    # Each worker dials EVERY shard (competing consumer
+                    # on each shard's queue) with its own per-shard
+                    # outboxes — two workers sharing one spill WAL
+                    # would corrupt each other's reload.
+                    eps = {sid: RemoteBus(addresses[sid],
+                                          registry=registry)
+                           for sid in shard_ids}
+                    return partition.PartitionedBus(
+                        eps, ring,
+                        outbox=_shard_outbox_cfg(f"worker-{wname}"),
+                        name=f"worker-{wname}", registry=registry)
+
+                make_worker_bus = lambda: _worker_pbus(  # noqa: E731
+                    worker_name)
+                make_worker_bus_for = _worker_pbus
+                if durable:
+                    dlq_provider = server.dlq_snapshot
+                    set_dlq_provider(dlq_provider)
+                shards_provider = server.snapshot
+                set_shards_provider(shards_provider)
             else:
-                local_bus = server    # orchestrator + generator side
-                make_worker_bus = lambda: RemoteBus(addr)  # noqa: E731
-                make_worker_bus_for = \
-                    lambda wname: RemoteBus(addr)  # noqa: E731
+                spool_dir = os.path.join(tmpdir, "bus-spool") \
+                    if durable else None
+                server = BusHandle(_make_server_for(spool_dir))
+                server.enable_pull(TOPIC_INFERENCE_BATCHES)
+                server.start()
+                addr = server.address
+                if durable:
+                    def _outbox_cfg(sub: str) -> OutboxConfig:
+                        return OutboxConfig(
+                            dir=os.path.join(tmpdir, "outbox", sub),
+                            max_frames=outbox_frames,
+                            breaker_recovery_s=0.25)
+
+                    # Orchestrator + generator side: local publishes
+                    # buffer through the outbox while the broker is
+                    # down.
+                    local_bus = OutboxBus(server, _outbox_cfg("local"),
+                                          name="local",
+                                          registry=registry,
+                                          close_inner=False)
+                    local_outbox = local_bus
+                    worker_outbox = _outbox_cfg("worker")
+                    make_worker_bus = lambda: RemoteBus(  # noqa: E731
+                        addr, outbox=worker_outbox, registry=registry)
+                    # Dynamic (autoscaler-spawned) workers each get
+                    # their OWN outbox dir: two live workers sharing
+                    # one spill WAL would corrupt each other's reload.
+                    make_worker_bus_for = \
+                        lambda wname: RemoteBus(  # noqa: E731
+                            addr, outbox=_outbox_cfg(f"worker-{wname}"),
+                            registry=registry)
+                    dlq_provider = server.dlq_snapshot
+                    set_dlq_provider(dlq_provider)
+                else:
+                    local_bus = server  # orchestrator + generator side
+                    make_worker_bus = lambda: RemoteBus(addr)  # noqa: E731
+                    make_worker_bus_for = \
+                        lambda wname: RemoteBus(addr)  # noqa: E731
         else:
             inner_bus = InMemoryBus(sync=True)
             local_bus = inner_bus
@@ -1219,7 +1366,12 @@ def run_scenario(scenario: Dict[str, Any],
         port = http_server.server_address[1]
 
         targets = {worker_name: handle, "orchestrator": orch_handle}
-        if bus_kind == "grpc":
+        if bus_kind == "grpc" and sharded:
+            # `down bus-<i>` kills ONE shard's generation; restart
+            # rebuilds it over the same spool dir + port while the other
+            # shards keep flowing (the kill-broker-shard closure).
+            targets.update(shard_handles)
+        elif bus_kind == "grpc":
             # `down bus` / `kill bus` timeline lines hard-stop the broker
             # generation; restart rebuilds over the same spool dir + port.
             targets["bus"] = server
@@ -1335,6 +1487,12 @@ def run_scenario(scenario: Dict[str, Any],
                 # Buffered-but-unflushed publishes are pending work too
                 # (closed-loop arrivals must not overrun a down broker).
                 n += local_outbox.outbox.depth()
+            depth_fn = getattr(server, "outbox_depth", None)
+            if callable(depth_fn):
+                # Sharded: frames parked for a dead shard in its
+                # per-shard outbox are pending work the brokers can't
+                # see yet.
+                n += depth_fn()
             return n
 
         def _flush_outboxes(timeout_s: float) -> None:
@@ -1343,10 +1501,16 @@ def run_scenario(scenario: Dict[str, Any],
             until the flusher lands it."""
             if local_outbox is not None:
                 local_outbox.outbox.drain(timeout_s=timeout_s)
+            drain_shards = getattr(server, "drain_outboxes", None)
+            if callable(drain_shards):
+                drain_shards(timeout_s)
             for h in supervisor.handles(pool_name):
                 worker_bus_outbox = getattr(h.bus, "outbox", None)
                 if worker_bus_outbox is not None:
                     worker_bus_outbox.drain(timeout_s=timeout_s)
+                worker_drain = getattr(h.bus, "drain_outboxes", None)
+                if callable(worker_drain):
+                    worker_drain(timeout_s)
 
         def _gen():
             stats_box["stats"] = workload.run(
@@ -1517,6 +1681,8 @@ def run_scenario(scenario: Dict[str, Any],
         }
         if durable:
             endpoints["dlq"] = _scrape(port, "/dlq", as_json=True)
+        if sharded:
+            endpoints["shards"] = _scrape(port, "/shards", as_json=True)
         if autoscaler is not None:
             endpoints["autoscaler"] = _scrape(port, "/autoscaler",
                                               as_json=True)
@@ -1664,10 +1830,50 @@ def run_scenario(scenario: Dict[str, Any],
         check("bus_unrouted", unrouted_total
               <= int(gate_cfg.get("max_unrouted", 0)),
               unrouted_total, int(gate_cfg.get("max_unrouted", 0)))
+        shard_summary = None
+        if sharded:
+            generations = {sid: h.generation
+                           for sid, h in shard_handles.items()}
+            routed = server.routed_counts(TOPIC_INFERENCE_BATCHES)
+            total_routed = sum(routed.values())
+            shard_summary = {
+                "count": n_shards,
+                "generations": generations,
+                "routed_batches": routed,
+                "outbox_depth_end": server.outbox_depth(),
+            }
+            if gate_cfg.get("max_shard_skew") is not None:
+                # Routing skew over the record-batch topic: the busiest
+                # shard's share vs the uniform ideal.  A skew at the cap
+                # means the ring (or the workload's key space) is
+                # funneling the stream back into one broker — the
+                # single-queue ceiling this subsystem exists to remove.
+                cap = float(gate_cfg["max_shard_skew"])
+                ideal = total_routed / max(1, n_shards)
+                skew = (max(routed.values()) / ideal) if total_routed \
+                    else None
+                shard_summary["skew"] = round(skew, 3) \
+                    if skew is not None else None
+                check("shard_skew", skew is not None and skew <= cap,
+                      shard_summary["skew"],
+                      f"<= {cap} (busiest shard vs uniform share)")
+            if gate_cfg.get("bus_shard_generations") is not None:
+                want = {sid: int(g) for sid, g in
+                        gate_cfg["bus_shard_generations"].items()}
+                # "bus_resume on the restarted shard only": the killed
+                # shard must be on generation 2, the survivors still on
+                # their first — a surviving shard that restarted (or a
+                # killed one that didn't come back) fails here.
+                check("bus_shard_generations", generations == want,
+                      generations, want)
         bus_detail: Dict[str, Any] = {
-            "generations": server.generation if bus_kind == "grpc" else 1,
+            "generations": (max(shard_summary["generations"].values())
+                            if sharded else server.generation)
+            if bus_kind == "grpc" else 1,
             "durable": durable,
         }
+        if shard_summary is not None:
+            bus_detail["shards"] = shard_summary
         if durable:
             bus_detail["dead_letters"] = sum(
                 v for _, v in registry.counter(
@@ -1675,7 +1881,9 @@ def run_scenario(scenario: Dict[str, Any],
             bus_detail["redeliveries"] = sum(
                 v for _, v in registry.counter(
                     "bus_redeliveries_total").series())
-            bus_detail["outbox_depth_end"] = local_outbox.outbox.depth()
+            bus_detail["outbox_depth_end"] = \
+                server.outbox_depth() if sharded \
+                else local_outbox.outbox.depth()
         if gate_cfg.get("require_flight"):
             events = flight.RECORDER.events()
             start = 0
@@ -1691,6 +1899,8 @@ def run_scenario(scenario: Dict[str, Any],
                          "alerts", "timeseries"]
         if durable:
             endpoint_keys.append("dlq")
+        if sharded:
+            endpoint_keys.append("shards")
         if autoscaler is not None:
             endpoint_keys.append("autoscaler")
         for key in endpoint_keys:
@@ -1726,6 +1936,7 @@ def run_scenario(scenario: Dict[str, Any],
             "autoscaler": fleet_summary,
             "bus_generations": bus_detail["generations"],
             "bus_broker": bus_detail,
+            "bus_shards": shard_summary,
             "orchestrator": orch_detail,
             "cluster_workers": sorted(
                 (endpoints["cluster"] or {}).get("workers", {})),
@@ -1781,6 +1992,9 @@ def run_scenario(scenario: Dict[str, Any],
         if dlq_provider is not None:
             _teardown("dlq-provider",
                       lambda: clear_dlq_provider(dlq_provider))
+        if shards_provider is not None:
+            _teardown("shards-provider",
+                      lambda: clear_shards_provider(shards_provider))
         if http_server is not None:
             _teardown("http-server", http_server.shutdown)
         if pool_installed:

@@ -748,11 +748,21 @@ class SubprocessSupervisor:
 
 def default_subprocess_argv(pool: str, bus_address: str,
                             extra_args: Optional[List[str]] = None,
-                            python: Optional[str] = None) -> List[str]:
+                            python: Optional[str] = None,
+                            shard_addresses: Optional[List[str]] = None
+                            ) -> List[str]:
     """The cli.py child command line for one pool: a ``tpu-worker``
     (or ``asr-worker`` for pool names starting with "asr") dialing the
-    orchestrator's broker.  ``{worker_id}`` is substituted per spawn."""
+    orchestrator's broker — or, on a partitioned control plane
+    (``shard_addresses``), EVERY broker shard: a spawned worker that
+    dialed only one shard would never pull the other shards' work
+    queues.  ``{worker_id}`` is substituted per spawn."""
     mode = "asr-worker" if pool.startswith("asr") else "tpu-worker"
+    if shard_addresses:
+        bus_args = ["--bus-shard-addresses", ",".join(shard_addresses),
+                    "--bus-shards", str(len(shard_addresses))]
+    else:
+        bus_args = ["--bus-address", bus_address]
     return [python or sys.executable, "-m", "distributed_crawler_tpu.cli",
-            "--mode", mode, "--worker-id", "{worker_id}",
-            "--bus-address", bus_address] + list(extra_args or [])
+            "--mode", mode, "--worker-id", "{worker_id}"] \
+        + bus_args + list(extra_args or [])

@@ -231,6 +231,12 @@ class Orchestrator:
         self._cluster_guide: Optional[Dict[str, Any]] = None
         self._cluster_prioritized = 0
 
+        # Sharded frontier (`bus/partition.py`): when the bus exposes a
+        # consistent-hash shard map, frontier pages partition by channel
+        # hash into shard-owned dispatch lanes — per-lane counts kept
+        # for /status + the frontier_shards flight event.
+        self._frontier_lane_counts: Dict[str, int] = {}
+
         self._mu = threading.RLock()
         self._running = False
         self._killed = False
@@ -782,7 +788,7 @@ class Orchestrator:
         if throttled:
             return 0  # pending work exists but inference must drain first
         published = 0
-        for page in pending:
+        for page in self._frontier_lanes(pending):
             item = self.create_work_item(page)
             with self._mu:
                 self.active_work[item.id] = item
@@ -820,6 +826,56 @@ class Orchestrator:
         if published:
             self._compact_journal()
         return published
+
+    def _frontier_lanes(self, pending: List[Page]) -> List[Page]:
+        """Partition frontier pages into shard-owned dispatch lanes.
+
+        With a partitioned bus (`bus/partition.py`: the bus — possibly
+        behind an outbox/chaos wrapper — exposes ``shard_map``), pages
+        group by the consistent hash of their CHANNEL (the same key the
+        bus routes the resulting WorkQueueMessages by, so a lane's pages
+        genuinely land on that lane's broker shard) and dispatch
+        round-robin ACROSS lanes: publishes alternate shards instead of
+        draining one channel's run into one queue, and each shard's
+        outbox flushes its lane concurrently — the distribute_work
+        fan-out is no longer serialized through one broker queue.  Page
+        state stays coordinated through the state layer exactly as
+        before (every status write goes through ``sm``); only the
+        dispatch order and the broker each item rides change.  Without
+        a shard map this is the identity.
+        """
+        smap = getattr(self.bus, "shard_map", None)
+        if smap is None:
+            return pending
+        from ..bus.partition import channel_of
+
+        lanes: Dict[str, List[Page]] = {}
+        for page in pending:
+            lanes.setdefault(
+                smap.shard_for(channel_of(page.url)), []).append(page)
+        counts = {sid: len(ps) for sid, ps in sorted(lanes.items())}
+        with self._mu:
+            changed = counts != self._frontier_lane_counts
+            self._frontier_lane_counts = counts
+        if changed:
+            flight.record("frontier_shards", depth=self.current_depth,
+                          lanes=counts)
+            logger.info("frontier partitioned across %d shard lane(s): %s",
+                        len(counts), counts)
+        # O(n) round-robin interleave (a large pending layer re-runs
+        # this every distribute tick — pop(0) shuffling would be
+        # quadratic exactly at the scale this subsystem targets).
+        ordered: List[Page] = []
+        pools = [iter(lanes[sid]) for sid in sorted(lanes)]
+        while pools:
+            alive = []
+            for it in pools:
+                page = next(it, None)
+                if page is not None:
+                    ordered.append(page)
+                    alive.append(it)
+            pools = alive
+        return ordered
 
     def create_work_item(self, page: Page) -> WorkItem:
         """`orchestrator.go:280-303`."""
@@ -1049,11 +1105,13 @@ class Orchestrator:
 
     @staticmethod
     def _channel_of(url: str) -> str:
-        """Channel name from a frontier URL: the last non-empty path
-        segment, lowercased (t.me/<channel>, youtube.com/@<handle>, or a
-        bare channel name all resolve the same way)."""
-        tail = url.rstrip("/").rsplit("/", 1)[-1]
-        return tail.partition("?")[0].lstrip("@").lower()
+        """Channel name from a frontier URL — ONE rule shared with the
+        partitioned bus's routing key (`bus/partition.py:channel_of`),
+        so the cluster guide's channel map and the sharded frontier's
+        lane assignment agree on what 'the same channel' means."""
+        from ..bus.partition import channel_of
+
+        return channel_of(url)
 
     def _frontier_priority(self, item: WorkItem) -> int:
         """PRIORITY_HIGH when the page's channel last landed in an
@@ -1323,6 +1381,7 @@ class Orchestrator:
                 "backpressure_active": (self._backpressure_active or self._circuit_backpressure),
                 "state_circuit": self._state_policy.breaker.state,
                 "resumed": self.resumed,
+                "frontier_lanes": dict(self._frontier_lane_counts) or None,
                 "cluster_guide": {
                     "step": self._cluster_guide["step"],
                     "vectors": self._cluster_guide["vectors"],

@@ -149,6 +149,14 @@ class FlightRecorder:
         clusters = clusters_snapshot()
         if clusters is not None:
             bundle["clusters"] = clusters
+        # Partitioned-bus shard table: which shard was dead/parked (and
+        # how deep its outbox ran) when this process went down — the
+        # first question after a sharded control-plane incident.
+        from .metrics import shards_snapshot
+
+        shards = shards_snapshot()
+        if shards is not None:
+            bundle["bus_shards"] = shards
         try:
             from . import timeseries as _timeseries
 

@@ -487,6 +487,40 @@ def clusters_snapshot():
         return {"error": str(e)}
 
 
+# Late-bound /shards provider: the partitioned bus's per-shard view
+# (`bus/partition.py:PartitionedBus.snapshot`) — per-shard address,
+# generation, queue depth, outbox depth/parked frames, and circuit-
+# breaker state, plus the consistent-hash ring summary.
+_shards_provider = None
+
+
+def set_shards_provider(fn) -> None:
+    """Register the zero-arg dict provider served at /shards (pass None
+    to clear)."""
+    global _shards_provider
+    _shards_provider = fn
+
+
+def clear_shards_provider(fn) -> None:
+    """Unregister ``fn`` only if it is still the active provider."""
+    global _shards_provider
+    if _shards_provider == fn:
+        _shards_provider = None
+
+
+def shards_snapshot():
+    """The active /shards body, or None without a provider — the flight
+    recorder calls this so postmortem bundles carry the per-shard bus
+    state ("which shard was parked/broken before the crash")."""
+    fn = _shards_provider
+    if fn is None:
+        return None
+    try:
+        return fn()
+    except Exception as e:
+        return {"error": str(e)}
+
+
 # Late-bound /autoscaler provider: the elastic-fleet control plane's
 # snapshot (`orchestrator/autoscaler.py`) — per-pool desired vs actual,
 # policy bounds, cooldown state, and the bounded decision log.
@@ -659,6 +693,20 @@ class _Handler(BaseHTTPRequestHandler):
 
             try:
                 body = _json.dumps(_clusters_provider(),
+                                   default=str).encode("utf-8")
+            except Exception as e:
+                code = 500
+                body = _json.dumps({"error": str(e)}).encode("utf-8")
+            ctype = "application/json"
+        elif path == "/shards" and _shards_provider is not None:
+            # The partitioned bus's shard table (`bus/partition.py`):
+            # per-shard address/generation/alive, queue + outbox depth,
+            # breaker state, routed-frame counts, and the ring summary.
+            # Rendered by tools/watch.py's shards panel.
+            import json as _json
+
+            try:
+                body = _json.dumps(_shards_provider(),
                                    default=str).encode("utf-8")
             except Exception as e:
                 code = 500

@@ -103,6 +103,11 @@ def selfcheck() -> int:
          # bus durability: spool replay, outbox, DLQ, broker restart,
          # and the kill-broker gate acceptance (ISSUE 10 closure).
          os.path.join(repo, "tests", "test_bus_durability.py"),
+         # partitioned bus: ring stability, keyed routing, broadcast
+         # dedupe, dead-shard parking, the sharded frontier lanes, and
+         # the partitioned-steady + kill-broker-shard gate acceptances
+         # (ISSUE 15 closure).
+         os.path.join(repo, "tests", "test_bus_partition.py"),
          # multi-chip serving: row padding, 1-vs-8-device parity,
          # worker-with-mesh e2e, mesh-aware MFU, and the
          # multichip-steady gate acceptance (the 1->8 scaling tentpole).

@@ -19,6 +19,9 @@ story on one page:
 - the autoscaler panel: desired-vs-actual fleet size per pool, live
   pressure/cooldowns, and the recent scale decisions with the alert
   that triggered each;
+- the bus shards panel (``/shards``, `bus/partition.py`): per-shard
+  generation, up/DOWN, circuit-breaker state, parked-outbox depth and
+  queue depth — which shard is limping and how much is waiting on it;
 - a per-worker table with sparkline trend cells (queue depth, MFU,
   goodput) from the fleet series, next to the instantaneous /cluster
   numbers;
@@ -86,13 +89,15 @@ def render_dashboard(cluster: Optional[Dict[str, Any]],
                      tseries: Optional[Dict[str, Any]],
                      now: Optional[float] = None,
                      autoscaler: Optional[Dict[str, Any]] = None,
-                     clusters: Optional[Dict[str, Any]] = None) -> str:
+                     clusters: Optional[Dict[str, Any]] = None,
+                     shards: Optional[Dict[str, Any]] = None) -> str:
     now = time.time() if now is None else now
     cluster = cluster or {}
     alerts = alerts or {}
     tseries = tseries or {}
     autoscaler = autoscaler or {}
     clusters = clusters or {}
+    shards = shards or {}
     lines: List[str] = []
 
     fleet = cluster.get("fleet") or {}
@@ -170,6 +175,33 @@ def render_dashboard(cluster: Optional[Dict[str, Any]],
                     f"{d.get('direction', '?'):<5} "
                     f"{d.get('from', '?')} -> {d.get('to', '?')}  "
                     f"({d.get('reason', '?')})")
+
+    # --- bus shards panel (/shards; bus/partition.py) ----------------------
+    shard_rows = shards.get("shards") or {}
+    if shard_rows:
+        ring = shards.get("ring") or {}
+        lines.append("")
+        lines.append(
+            f"bus shards — {len(shard_rows)} shard(s), ring x"
+            f"{ring.get('replicas', '?')} replicas, "
+            f"{shards.get('outbox_depth_total', 0)} frame(s) parked")
+        lines.append(f"  {'shard':<10} {'gen':>4} {'state':<6} "
+                     f"{'breaker':<10} {'outbox':>7} {'queued':>7} "
+                     f"{'routed':>8}  {'address':<22}")
+        for sid in sorted(shard_rows):
+            s = shard_rows[sid]
+            alive = s.get("alive")
+            state = "up" if alive else ("DOWN" if alive is False else "-")
+            queued = sum(int(v) for v in (s.get("pending") or {}).values())
+            routed = sum(int(v)
+                         for v in (s.get("routed_frames") or {}).values())
+            parked = int(s.get("outbox_depth", 0) or 0)
+            mark = "  <-- parked frames" if parked else ""
+            lines.append(
+                f"  {sid:<10} {s.get('generation') or '-':>4} "
+                f"{state:<6} {s.get('breaker', '?'):<10} "
+                f"{parked:>7} {queued:>7} {routed:>8}  "
+                f"{s.get('address') or '-':<22}{mark}")
 
     # --- clusters panel (/clusters; cluster/worker.py) ---------------------
     sizes = clusters.get("sizes") or []
@@ -257,7 +289,8 @@ def render_once(base_url: str) -> str:
                             _fetch(base_url, "/alerts"),
                             _fetch(base_url, "/timeseries"),
                             autoscaler=_fetch(base_url, "/autoscaler"),
-                            clusters=_fetch(base_url, "/clusters"))
+                            clusters=_fetch(base_url, "/clusters"),
+                            shards=_fetch(base_url, "/shards"))
 
 
 def selfcheck() -> int:
@@ -327,8 +360,32 @@ def selfcheck() -> int:
         "inertia": [0.41, 0.38, 0.36, 0.35, 0.34],
         "assign_vectors_per_s": 88.5,
     }
+    shards = {
+        "name": "local",
+        "ring": {"shard_ids": ["bus-0", "bus-1", "bus-2"], "replicas": 64},
+        "outbox_depth_total": 4,
+        "pull_topics": ["tpu-inference-batches"],
+        "shards": {
+            "bus-0": {"address": "127.0.0.1:50551", "generation": 1,
+                      "alive": True, "outbox_depth": 0,
+                      "outbox_capacity": 512, "breaker": "closed",
+                      "routed_frames": {"tpu-inference-batches": 21},
+                      "pending": {"tpu-inference-batches": 2}},
+            "bus-1": {"address": "127.0.0.1:50552", "generation": 2,
+                      "alive": False, "outbox_depth": 4,
+                      "outbox_capacity": 512, "breaker": "open",
+                      "routed_frames": {"tpu-inference-batches": 23},
+                      "pending": {}},
+            "bus-2": {"address": "127.0.0.1:50553", "generation": 1,
+                      "alive": True, "outbox_depth": 0,
+                      "outbox_capacity": 512, "breaker": "closed",
+                      "routed_frames": {"tpu-inference-batches": 16},
+                      "pending": {"tpu-inference-batches": 1}},
+        },
+    }
     out = render_dashboard(cluster, alerts, tseries, now=now,
-                           autoscaler=autoscaler, clusters=clusters)
+                           autoscaler=autoscaler, clusters=clusters,
+                           shards=shards)
     assert "FIRING" in out and "queue_wait_burn" in out, out
     assert "tpu-1" in out and "crawl-1" in out and "STALE" in out, out
     assert "burn rule" in out and "14.2" in out, out
@@ -338,6 +395,9 @@ def selfcheck() -> int:
     assert "recent scale decisions" in out and "2 -> 3" in out, out
     assert "clusters — k=4" in out and "resumed @ step 9" in out, out
     assert "under-populated" in out and "inertia/vector" in out, out
+    assert "bus shards — 3 shard(s)" in out, out
+    assert "DOWN" in out and "open" in out, out
+    assert "<-- parked frames" in out and "4 frame(s) parked" in out, out
     empty = render_dashboard(None, None, None, now=now)
     assert "nothing to watch" in empty, empty
     print("watch selfcheck ok")
