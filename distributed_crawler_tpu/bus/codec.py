@@ -61,7 +61,9 @@ from .messages import (
     StatusMessage,
     TranscriptMessage,
     WorkQueueMessage,
+    DEFAULT_TENANT,
     new_trace_id,
+    normalize_tenant,
 )
 
 CODEC_VERSION = 1
@@ -182,17 +184,22 @@ class RecordBatch:
     source_topic: str = ""
     created_at: Optional[datetime] = None
     trace_id: str = ""
+    # Workload provenance: who this batch's chip-seconds are billed to.
+    # Legacy frames (pre-tenant spools/outboxes) decode to DEFAULT_TENANT.
+    tenant: str = DEFAULT_TENANT
     records: List[Dict[str, Any]] = field(default_factory=list)
     results: List[Dict[str, Any]] = field(default_factory=list)
 
     @classmethod
     def from_posts(cls, posts: List[Post], crawl_id: str = "",
-                   trace_id: str = "") -> "RecordBatch":
+                   trace_id: str = "",
+                   tenant: str = DEFAULT_TENANT) -> "RecordBatch":
         # Every batch gets a trace id at birth: the TPU worker's queue-wait
         # / coalesce / engine-stage spans hang off it, so a batch with no
         # id would be invisible to /traces.
         return cls(batch_id=new_id(), crawl_id=crawl_id, created_at=utcnow(),
                    trace_id=trace_id or new_trace_id(),
+                   tenant=normalize_tenant(tenant),
                    records=[p.to_dict() for p in posts])
 
     def posts(self) -> List[Post]:
@@ -212,6 +219,7 @@ class RecordBatch:
             "source_topic": self.source_topic,
             "created_at": format_time(self.created_at),
             "trace_id": self.trace_id,
+            "tenant": self.tenant,
             "records": self.records,
             "results": self.results,
         }
@@ -224,6 +232,7 @@ class RecordBatch:
             source_topic=d.get("source_topic", "") or "",
             created_at=parse_time(d.get("created_at")),
             trace_id=d.get("trace_id", "") or "",
+            tenant=normalize_tenant(d.get("tenant")),
             records=list(d.get("records") or []),
             results=list(d.get("results") or []),
         )
@@ -293,10 +302,11 @@ class BatchAccumulator:
     """
 
     def __init__(self, batch_size: int = 256, deadline_s: float = 0.05,
-                 crawl_id: str = ""):
+                 crawl_id: str = "", tenant: str = DEFAULT_TENANT):
         self.batch_size = batch_size
         self.deadline_s = deadline_s
         self.crawl_id = crawl_id
+        self.tenant = normalize_tenant(tenant)
         self._pending: List[Post] = []
         self._first_at: Optional[float] = None
 
@@ -320,7 +330,8 @@ class BatchAccumulator:
         return self._emit() if self._pending else None
 
     def _emit(self) -> RecordBatch:
-        batch = RecordBatch.from_posts(self._pending, crawl_id=self.crawl_id)
+        batch = RecordBatch.from_posts(self._pending, crawl_id=self.crawl_id,
+                                       tenant=self.tenant)
         self._pending = []
         self._first_at = None
         return batch

@@ -111,6 +111,21 @@ TOPIC_CLUSTERS = "tpu-clusters"
 
 VALID_PLATFORMS = ("telegram", "youtube")
 
+# Tenant provenance (ISSUE 17): every record batch, audio frame, and
+# transcript carries a ``tenant`` label naming the workload that paid for
+# it.  Frames minted before the label existed (spooled bytes, outbox
+# replays) decode to this documented default, so attribution never breaks
+# decodability — an unlabeled frame is "the default tenant's", loudly
+# visible as such on /tenants and gateable via ``max_unattributed_share``.
+DEFAULT_TENANT = "default"
+
+
+def normalize_tenant(value: Any) -> str:
+    """Fold falsy / non-string tenant values to ``DEFAULT_TENANT``."""
+    if not isinstance(value, str) or not value.strip():
+        return DEFAULT_TENANT
+    return value.strip()
+
 _ALPHANUM = string.ascii_letters + string.digits
 
 
@@ -715,12 +730,15 @@ class AudioBatchMessage:
     refs: List[AudioRef] = field(default_factory=list)
     created_at: Optional[datetime] = None
     trace_id: str = ""
+    tenant: str = DEFAULT_TENANT
 
     @classmethod
     def new(cls, refs: List[AudioRef], crawl_id: str = "",
-            trace_id: str = "") -> "AudioBatchMessage":
+            trace_id: str = "",
+            tenant: str = DEFAULT_TENANT) -> "AudioBatchMessage":
         return cls(batch_id=new_id(), crawl_id=crawl_id, refs=list(refs),
-                   created_at=utcnow(), trace_id=trace_id or new_trace_id())
+                   created_at=utcnow(), trace_id=trace_id or new_trace_id(),
+                   tenant=normalize_tenant(tenant))
 
     def validate(self) -> None:
         if self.message_type != MSG_AUDIO_BATCH:
@@ -744,6 +762,7 @@ class AudioBatchMessage:
             "refs": [r.to_dict() for r in self.refs],
             "created_at": _opt_time(self.created_at),
             "trace_id": self.trace_id,
+            "tenant": self.tenant,
         }
 
     @classmethod
@@ -756,6 +775,7 @@ class AudioBatchMessage:
                   if isinstance(r, dict)],
             created_at=parse_time(d.get("created_at")),
             trace_id=d.get("trace_id", "") or "",
+            tenant=normalize_tenant(d.get("tenant")),
         )
 
 
@@ -786,15 +806,17 @@ class TranscriptMessage:
     error: str = ""
     timestamp: Optional[datetime] = None
     trace_id: str = ""
+    tenant: str = DEFAULT_TENANT
 
     @classmethod
     def new(cls, media_id: str, crawl_id: str = "", batch_id: str = "",
             worker_id: str = "", trace_id: str = "",
-            **kw: Any) -> "TranscriptMessage":
+            tenant: str = DEFAULT_TENANT, **kw: Any) -> "TranscriptMessage":
         return cls(media_id=media_id, post_uid=f"media:{media_id}",
                    crawl_id=crawl_id, batch_id=batch_id,
                    worker_id=worker_id, timestamp=utcnow(),
-                   trace_id=trace_id or new_trace_id(), **kw)
+                   trace_id=trace_id or new_trace_id(),
+                   tenant=normalize_tenant(tenant), **kw)
 
     def validate(self) -> None:
         if self.message_type != MSG_TRANSCRIPT:
@@ -820,6 +842,7 @@ class TranscriptMessage:
             "error": self.error,
             "timestamp": _opt_time(self.timestamp),
             "trace_id": self.trace_id,
+            "tenant": self.tenant,
         }
 
     @classmethod
@@ -840,6 +863,7 @@ class TranscriptMessage:
             error=d.get("error", "") or "",
             timestamp=parse_time(d.get("timestamp")),
             trace_id=d.get("trace_id", "") or "",
+            tenant=normalize_tenant(d.get("tenant")),
         )
 
 

@@ -227,6 +227,18 @@ def build_parser() -> argparse.ArgumentParser:
            "default pack (queue_wait_burn, batch_age_burn, "
            "per_chip_goodput_collapse, dlq_growth, outbox_near_full, "
            "stale_worker — docs/operations.md \"Watchtower\")")
+    a("--tenant", default=None,
+      help="tenant label stamped onto every record batch this crawl's "
+           "ingestion publishes (per-tenant spend + SLO accounting on "
+           "/tenants and /costs; empty = the documented 'default' "
+           "tenant — docs/operations.md \"Tenant attribution\")")
+    a("--tenant-budgets", default=None,
+      help="per-tenant error budgets: inline JSON or @path/to/"
+           "budgets.json with {window_s, budgets: {tenant: {slo: "
+           "allowed_breaches}}}; the orchestrator's /tenants surface "
+           "reports windowed burn, remaining budget, and exhaustion "
+           "projection per (tenant, slo) — docs/operations.md \"Tenant "
+           "attribution & error budgets\")")
     # Elastic fleet (orchestrator mode; docs/operations.md "Elastic fleet
     # & autoscaling"): an alert-actuated autoscaler that spawns/retires
     # `--mode tpu-worker` child processes against the watchtower's firing
@@ -581,6 +593,8 @@ _KEY_MAP = {
     "timeseries_window": "observability.timeseries_window_s",
     "timeseries_max_samples": "observability.timeseries_max_samples",
     "alert_rules": "observability.alert_rules",
+    "tenant": "crawler.tenant",
+    "tenant_budgets": "observability.tenant_budgets",
     "autoscaler": "autoscaler.enabled",
     "autoscaler_pools": "autoscaler.pools",
     "autoscaler_min": "autoscaler.min_workers",
@@ -691,6 +705,7 @@ def resolve_config(args: argparse.Namespace,
     cfg.min_users = r.get_int("crawler.minusers", 100)
     cfg.crawl_id = r.get_str("crawler.crawlid") or generate_crawl_id()
     cfg.crawl_label = r.get_str("crawler.crawllabel")
+    cfg.tenant = r.get_str("crawler.tenant")
     cfg.max_comments = r.get_int("crawler.maxcomments", -1)
     cfg.max_depth = r.get_int("crawler.maxdepth", -1)
     cfg.max_posts = r.get_int("crawler.maxposts", -1)
@@ -1008,7 +1023,8 @@ def _maybe_bridge(sm, cfg: CrawlerConfig, r: ConfigResolver):
         wrapped = InferenceBridge(wrapped, bus, crawl_id=cfg.crawl_id,
                                   batch_size=cfg.inference.batch_size,
                                   deadline_s=cfg.inference.batch_deadline_ms
-                                  / 1000.0)
+                                  / 1000.0,
+                                  tenant=cfg.tenant)
     if cfg.media.enabled:
         # Outermost: the media hook (`notify_media_stored`) lands here,
         # store_post falls through to the InferenceBridge underneath.
@@ -1016,7 +1032,8 @@ def _maybe_bridge(sm, cfg: CrawlerConfig, r: ConfigResolver):
         wrapped = MediaBridge(wrapped, bus, crawl_id=cfg.crawl_id,
                               batch_size=cfg.media.batch_size,
                               deadline_s=cfg.media.batch_deadline_ms
-                              / 1000.0)
+                              / 1000.0,
+                              tenant=cfg.tenant)
 
     def closer():
         wrapped.close()  # each bridge flushes, then closes its inner
@@ -1070,6 +1087,35 @@ def _alert_rules(r: "ConfigResolver"):
         return rules_from_config(raw or None)
     except ValueError as e:
         raise CliConfigError(f"bad alert rule: {e}")
+
+
+def _tenant_budgets(r: "ConfigResolver"):
+    """The per-tenant error budgets from ``observability.tenant_budgets``
+    — a YAML mapping in the config file, or inline JSON / ``@path`` from
+    the ``--tenant-budgets`` flag.  Returns the validated ``(budgets,
+    window_s)`` pair; a malformed block is a config error (exit 2), not
+    a silently-unenforced budget."""
+    import json as _json
+
+    from .orchestrator.tenants import budgets_from_config
+
+    raw = r.get("observability.tenant_budgets")
+    if isinstance(raw, str) and raw:
+        if raw.startswith("@"):
+            try:
+                with open(raw[1:], "r", encoding="utf-8") as f:
+                    raw = f.read()
+            except OSError as e:
+                raise CliConfigError(
+                    f"cannot read --tenant-budgets file: {e}")
+        try:
+            raw = _json.loads(raw)
+        except ValueError as e:
+            raise CliConfigError(f"--tenant-budgets is not valid JSON: {e}")
+    try:
+        return budgets_from_config(raw or None)
+    except ValueError as e:
+        raise CliConfigError(f"bad tenant budget: {e}")
 
 
 def _build_autoscaler(r: "ConfigResolver", orch, bus):
@@ -1543,11 +1589,18 @@ def _run_orchestrator(urls: List[str], cfg: CrawlerConfig,
         set_cluster_provider,
         set_dtraces_provider,
         set_status_provider,
+        set_tenants_provider,
     )
     set_status_provider(orch.get_status)  # /status (`orchestrator.go:596`)
     set_cluster_provider(orch.get_cluster)  # /cluster fleet view
     set_dtraces_provider(orch.get_dtraces)  # /dtraces distributed traces
     set_alerts_provider(orch.get_alerts)  # /alerts watchtower surface
+    # /tenants: per-tenant spend + error budgets over the fleet folds;
+    # budgets validated loudly from config (exit 2 on a typo'd block).
+    budgets, budget_window_s = _tenant_budgets(r)
+    orch.watchtower.tenants.configure(budgets=budgets,
+                                      window_s=budget_window_s)
+    set_tenants_provider(orch.get_tenants)
     # Elastic fleet (--autoscaler): alert-actuated tpu-worker children
     # against this broker, decisions served at /autoscaler.
     autoscaler = _build_autoscaler(r, orch, bus)

@@ -334,11 +334,25 @@ class ClusterWorker:
 
     def get_costs(self) -> dict:
         """The /costs body: the cluster engine's cost/efficiency snapshot
-        (path="cluster" rows) plus the worker's SLO state."""
+        (path="cluster" rows) plus the worker's SLO state and per-tenant
+        spend rows."""
         out = dict(self.engine.cost_snapshot())
         out["worker_id"] = self.cfg.worker_id
         out["slo"] = self._slo.snapshot()
+        ledger = self._tenant_ledger()
+        if ledger is not None:
+            out["tenants"] = ledger.snapshot()
         return out
+
+    # -- tenant attribution (ISSUE 17) --------------------------------------
+    def _tenant_ledger(self):
+        return getattr(getattr(self.engine, "meter", None), "tenants", None)
+
+    def _set_meter_tenants(self, weights) -> None:
+        set_fn = getattr(getattr(self.engine, "meter", None),
+                         "set_tenants", None)
+        if callable(set_fn):
+            set_fn(weights)
 
     def get_clusters(self) -> dict:
         """The /clusters body (`set_clusters_provider` seam): centroid
@@ -547,10 +561,13 @@ class ClusterWorker:
     def _process_group(self,
                        items: List[Tuple[RecordBatch, Any, float]]) -> None:
         now = time.monotonic()
+        ledger = self._tenant_ledger()
         for batch, _, enq_t in items:
             trace.record("cluster_worker.queue_wait", now - enq_t,
                          trace_id=batch.trace_id, batch=batch.batch_id,
-                         worker=self.cfg.worker_id)
+                         worker=self.cfg.worker_id, tenant=batch.tenant)
+            if ledger is not None and batch.tenant:
+                ledger.observe_queue_wait(batch.tenant, now - enq_t)
         # Extract per batch FIRST: a batch whose embeddings are malformed
         # fails alone, before any neighbor joins it in the step.
         good: List[Tuple[RecordBatch, Any, list, list]] = []
@@ -604,6 +621,13 @@ class ClusterWorker:
                     fresh.append(g)
         all_vecs = [v for _, _, vecs, _ in fresh for v in vecs]
         if fresh:
+            # Tenant weights for the combined step = vector counts.
+            weights: Dict[str, float] = {}
+            for batch, _, vecs, _ in fresh:
+                weights[batch.tenant] = weights.get(batch.tenant, 0.0) \
+                    + max(1, len(vecs))
+            self._set_meter_tenants(weights)
+            dominant = max(weights, key=weights.get) if weights else ""
             try:
                 # One mini-batch step for the coalesced group, under the
                 # FIRST batch's trace (one device stream, one ambient
@@ -614,7 +638,8 @@ class ClusterWorker:
                                 batch_ids=[b.batch_id
                                            for b, _, _, _ in fresh],
                                 vectors=len(all_vecs),
-                                worker=self.cfg.worker_id):
+                                worker=self.cfg.worker_id,
+                                tenant=dominant):
                     assigns = self.engine.observe(all_vecs)
             except Exception as e:
                 # The combined step failed; isolate per batch so one
@@ -679,10 +704,12 @@ class ClusterWorker:
     def _process_isolated(self, batch: RecordBatch, ack, vecs,
                           rows) -> None:
         try:
+            self._set_meter_tenants({batch.tenant: max(1, len(vecs))})
             with trace.span("cluster_worker.process",
                             trace_id=batch.trace_id,
                             batch=batch.batch_id, isolated=True,
-                            worker=self.cfg.worker_id):
+                            worker=self.cfg.worker_id,
+                            tenant=batch.tenant):
                 assigns = self.engine.observe(vecs)
         except Exception as e:
             self._errors += 1
@@ -751,7 +778,7 @@ class ClusterWorker:
             self.m_batch_age.observe(age)
             trace.record("cluster_worker.batch_age", age,
                          trace_id=batch.trace_id, batch=batch.batch_id,
-                         worker=self.cfg.worker_id)
+                         worker=self.cfg.worker_id, tenant=batch.tenant)
 
     def _writeback(self, batch: RecordBatch, rows,
                    assigns: List[int]) -> None:
@@ -771,6 +798,7 @@ class ClusterWorker:
                 "cluster": int(cluster),
                 "batch_id": batch.batch_id,
                 "trace_id": batch.trace_id,
+                "tenant": batch.tenant,
             }, ensure_ascii=False))
         self.provider.put_text(rel, "\n".join(lines) + "\n")
 
@@ -806,8 +834,16 @@ class ClusterWorker:
                 "depth": self._queue.qsize(),
                 "depth_time_weighted": round(self._depth.sample(), 4),
             }
-            msg.resource_usage["slo_breaches"] = \
-                self._slo.snapshot()["breaches"]
+            slo_snap = self._slo.snapshot()
+            msg.resource_usage["slo_breaches"] = slo_snap["breaches"]
+            if slo_snap.get("tenant_breaches"):
+                msg.resource_usage["tenant_slo_breaches"] = \
+                    slo_snap["tenant_breaches"]
+            ledger = self._tenant_ledger()
+            if ledger is not None:
+                tenants = ledger.snapshot()
+                if tenants["rows"]:
+                    msg.resource_usage["tenants"] = tenants
             msg.resource_usage["cluster"] = {
                 "step": self.engine.step,
                 "vectors": self.engine.vectors,

@@ -155,6 +155,11 @@ class CrawlerConfig:
     min_users: int = 0
     crawl_id: str = ""
     crawl_label: str = ""
+    # Tenant provenance (ISSUE 17): the workload label stamped onto every
+    # record batch this crawl's ingestion publishes; per-tenant spend and
+    # SLO accounting key on it end to end (/tenants, /costs).  Empty =
+    # the documented "default" tenant (bus/messages.DEFAULT_TENANT).
+    tenant: str = ""
     max_comments: int = -1
     max_posts: int = -1
     max_depth: int = 0

@@ -34,13 +34,18 @@ class InferenceBridge:
 
     def __init__(self, sm, bus, crawl_id: str = "", batch_size: int = 256,
                  deadline_s: float = 0.05, topic: str = TOPIC_INFERENCE_BATCHES,
-                 poll_interval_s: float = 0.02, dedupe_window: int = 65536):
+                 poll_interval_s: float = 0.02, dedupe_window: int = 65536,
+                 tenant: str = ""):
         self._sm = sm
         self._bus = bus
         self._topic = topic
+        # Tenant provenance (ISSUE 17): every batch this ingestion path
+        # publishes carries the crawl's tenant label; empty falls back to
+        # the documented default inside the accumulator.
         self._acc = BatchAccumulator(batch_size=batch_size,
                                      deadline_s=deadline_s,
-                                     crawl_id=crawl_id)
+                                     crawl_id=crawl_id,
+                                     tenant=tenant)
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self.batches_published = 0
@@ -107,7 +112,8 @@ class InferenceBridge:
             # engine stages downstream all share batch.trace_id.
             with trace.span("orchestrator.dispatch",
                             trace_id=batch.trace_id, batch=batch.batch_id,
-                            records=len(batch), crawl_id=batch.crawl_id):
+                            records=len(batch), crawl_id=batch.crawl_id,
+                            tenant=batch.tenant):
                 self._bus.publish(self._topic, batch.to_dict())
             self.batches_published += 1
         except Exception as e:

@@ -330,13 +330,29 @@ class TPUWorker:
 
     def get_costs(self) -> dict:
         """The /costs body: the engine's cost/efficiency snapshot plus the
-        worker's SLO state and profiler-capture status."""
+        worker's SLO state, per-tenant spend rows, and profiler status."""
         snap_fn = getattr(self.engine, "cost_snapshot", None)
         out = dict(snap_fn()) if callable(snap_fn) else {}
         out["worker_id"] = self.cfg.worker_id
         out["slo"] = self._slo.snapshot()
+        ledger = self._tenant_ledger()
+        if ledger is not None:
+            out["tenants"] = ledger.snapshot()
         out["profiler"] = profiling.PROFILER.snapshot()
         return out
+
+    # -- tenant attribution (ISSUE 17) -------------------------------------
+    def _tenant_ledger(self):
+        """The engine meter's TenantLedger, when the engine has one
+        (test doubles and older engines simply don't attribute)."""
+        return getattr(getattr(self.engine, "meter", None), "tenants", None)
+
+    def _set_meter_tenants(self, weights: Dict[str, float]) -> None:
+        """Declare the tenant split for the NEXT engine dispatches."""
+        set_fn = getattr(getattr(self.engine, "meter", None),
+                         "set_tenants", None)
+        if callable(set_fn):
+            set_fn(weights)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -539,13 +555,16 @@ class TPUWorker:
     def _process_group(self,
                        items: List[Tuple[RecordBatch, Any, float]]) -> None:
         now = time.monotonic()
+        ledger = self._tenant_ledger()
         for batch, _, enq_t in items:
             # Queue wait as a span of each batch's own trace: the time
             # between the bus handler's enqueue and this dequeue, i.e.
             # what the batch spent behind its neighbors.
             trace.record("tpu_worker.queue_wait", now - enq_t,
                          trace_id=batch.trace_id, batch=batch.batch_id,
-                         worker=self.cfg.worker_id)
+                         worker=self.cfg.worker_id, tenant=batch.tenant)
+            if ledger is not None and batch.tenant:
+                ledger.observe_queue_wait(batch.tenant, now - enq_t)
         if len(items) == 1 or not self._engine_coalesces:
             for batch, ack, _ in items:
                 self._process_one(batch, ack)
@@ -572,6 +591,14 @@ class TPUWorker:
         if not good:
             return
         all_toks = [t for _, _, toks in good for t in toks]
+        # Per-tenant weight of this device stream = real token counts, so
+        # the meter's ledger charges the coalesced dispatch fairly.
+        weights: Dict[str, float] = {}
+        for batch, _, toks in good:
+            weights[batch.tenant] = weights.get(batch.tenant, 0.0) \
+                + max(1, sum(len(t) for t in toks))
+        self._set_meter_tenants(weights)
+        dominant = max(weights, key=weights.get) if weights else ""
         started = self._step_started = time.monotonic()
         try:
             # The coalesce span runs under the FIRST batch's trace (one
@@ -582,7 +609,8 @@ class TPUWorker:
                             trace_id=good[0][0].trace_id,
                             batches=len(good),
                             batch_ids=[b.batch_id for b, _, _ in good],
-                            sequences=len(all_toks)):
+                            sequences=len(all_toks),
+                            tenant=dominant):
                 results = self.engine.run_tokenized(all_toks,
                                                     pack=self.cfg.pack)
         except Exception as e:
@@ -689,11 +717,14 @@ class TPUWorker:
     def _process_one(self, batch: RecordBatch, ack) -> None:
         def produce():
             self._observe_age(batch)
+            self._set_meter_tenants(
+                {batch.tenant: max(1, len(batch.records))})
             # Rooted at the batch's own trace: engine.run's tokenize and
             # stage spans nest under this.
             with trace.span("tpu_worker.process", trace_id=batch.trace_id,
                             batch=batch.batch_id,
-                            records=len(batch.records)):
+                            records=len(batch.records),
+                            tenant=batch.tenant):
                 if self.cfg.pack and self._engine_run_packs:
                     return self._run_step(
                         lambda: self.engine.run(batch.texts(), pack=True),
@@ -709,8 +740,11 @@ class TPUWorker:
         already tokenized and age-observed when the group formed, so reuse
         the token lists instead of re-running the text front door."""
         def produce():
+            self._set_meter_tenants(
+                {batch.tenant: max(1, sum(len(t) for t in toks))})
             with trace.span("tpu_worker.process", trace_id=batch.trace_id,
-                            batch=batch.batch_id, isolated=True):
+                            batch=batch.batch_id, isolated=True,
+                            tenant=batch.tenant):
                 return self._run_step(
                     lambda: self.engine.run_tokenized(toks,
                                                       pack=self.cfg.pack),
@@ -732,7 +766,8 @@ class TPUWorker:
                 trace.record("tpu_worker.batch_age", age,
                              trace_id=batch.trace_id,
                              batch=batch.batch_id,
-                             worker=self.cfg.worker_id)
+                             worker=self.cfg.worker_id,
+                             tenant=batch.tenant)
 
     @staticmethod
     def _strip_embeddings(results):
@@ -767,6 +802,7 @@ class TPUWorker:
                 "channel_name": record.get("channel_name", ""),
                 "batch_id": batch.batch_id,
                 "trace_id": batch.trace_id,
+                "tenant": batch.tenant,
                 **result,
             }, ensure_ascii=False))
         self.provider.put_text(rel, "\n".join(lines) + "\n")
@@ -877,8 +913,18 @@ class TPUWorker:
             # Cumulative per-SLO breach counts ride every beat so the
             # orchestrator's watchtower can evaluate burn-rate rules
             # fleet-wide (the fleet_slo_breach_total series).
-            msg.resource_usage["slo_breaches"] = \
-                self._slo.snapshot()["breaches"]
+            slo_snap = self._slo.snapshot()
+            msg.resource_usage["slo_breaches"] = slo_snap["breaches"]
+            if slo_snap.get("tenant_breaches"):
+                msg.resource_usage["tenant_slo_breaches"] = \
+                    slo_snap["tenant_breaches"]
+            # Per-tenant spend rows (ISSUE 17): the watchtower folds
+            # these into the fleet_tenant_* series behind /tenants.
+            ledger = self._tenant_ledger()
+            if ledger is not None:
+                tenants = ledger.snapshot()
+                if tenants["rows"]:
+                    msg.resource_usage["tenants"] = tenants
             # Self-sample the registry into the rolling store on the
             # same cadence (never raises).
             self._ts_sampler.sample()

@@ -95,7 +95,7 @@ _CLUSTER_WORKER_KEYS = ("worker_id", "heartbeat_s", "queue_capacity",
 _LOAD_KEYS = ("seed", "duration_s", "arrival", "rate_batches_per_s",
               "rate_profile", "ramp_from", "ramp_to", "ramp_batches",
               "records_per_batch", "zipf_a", "max_words", "platform_mix",
-              "crawl_id")
+              "crawl_id", "tenants")
 
 # Every gate-envelope key either runner reads.  `validate_gate_config`
 # rejects anything else LOUDLY — a typo'd gate key would otherwise turn
@@ -120,6 +120,12 @@ _GATE_KEYS_TEXT = _GATE_KEYS_SHARED | {
     # The partitioned-bus envelope (`bus/partition.py`; needs a
     # "bus_shards" block — validate_gate_config enforces the pairing).
     "max_shard_skew", "bus_shard_generations",
+    # The tenant-attribution envelope (`orchestrator/tenants.py`; the
+    # tenant-naming keys need a "load.tenants" mix —
+    # validate_gate_config enforces the pairing).
+    "require_tenants", "max_unattributed_share",
+    "require_tenant_breach", "forbid_tenant_breach",
+    "require_tenant_conservation",
 }
 _GATE_KEYS_ASR = _GATE_KEYS_SHARED | {
     "max_transcript_errors", "reentry_required", "asr_batch_p95_ms",
@@ -244,6 +250,85 @@ def validate_gate_config(scenario: Dict[str, Any]) -> None:
                 f"scenario {name!r}: bus_shard_generations must map "
                 f"EVERY shard id ({', '.join(sorted(expected_ids))}) to "
                 f"an int generation >= 1, got {gens!r}")
+    # Tenant attribution (ISSUE 17): the "load.tenants" traffic mix, the
+    # "tenant_budgets" block, and the tenant gate keys all validate
+    # loudly here — a typo'd tenant name would otherwise assert against
+    # a workload that never existed.
+    load_block = scenario.get("load", {}) or {}
+    tenant_mix = load_block.get("tenants") or {}
+    if tenant_mix:
+        if not isinstance(tenant_mix, dict):
+            raise ValueError(
+                f"scenario {name!r}: load.tenants must be a mapping of "
+                f"tenant name -> positive weight, got {tenant_mix!r}")
+        for t, w in tenant_mix.items():
+            if not isinstance(t, str) or not t.strip():
+                raise ValueError(
+                    f"scenario {name!r}: load.tenants has a non-string/"
+                    f"empty tenant name: {t!r}")
+            if not isinstance(w, (int, float)) or isinstance(w, bool) \
+                    or float(w) <= 0:
+                raise ValueError(
+                    f"scenario {name!r}: load.tenants[{t!r}] must be a "
+                    f"positive weight, got {w!r}")
+    from ..bus.messages import DEFAULT_TENANT
+    from ..orchestrator.tenants import budgets_from_config
+
+    try:
+        budgets_from_config(scenario.get("tenant_budgets"))
+    except ValueError as e:
+        raise ValueError(f"scenario {name!r}: {e}")
+    known_tenants = set(tenant_mix) | {DEFAULT_TENANT}
+    req_tenants = gate_cfg.get("require_tenants", [])
+    if not isinstance(req_tenants, (list, tuple)):
+        raise ValueError(
+            f"scenario {name!r}: gate require_tenants must be a list of "
+            f"tenant names, got {req_tenants!r}")
+    for key in ("require_tenants", "require_tenant_breach",
+                "forbid_tenant_breach"):
+        if key in gate_cfg and not tenant_mix:
+            raise ValueError(
+                f"scenario {name!r}: gate key {key!r} needs a "
+                f"\"load.tenants\" traffic mix (it would otherwise "
+                f"assert against tenants no workload carries)")
+    for t in req_tenants:
+        if t not in known_tenants:
+            raise ValueError(
+                f"scenario {name!r}: require_tenants names {t!r}, which "
+                f"is not in load.tenants ({sorted(known_tenants)})")
+    for key in ("require_tenant_breach", "forbid_tenant_breach"):
+        spec = gate_cfg.get(key)
+        if spec is None:
+            continue
+        if not isinstance(spec, dict):
+            raise ValueError(
+                f"scenario {name!r}: gate {key} must be a mapping of "
+                f"tenant -> [slo, ...], got {spec!r}")
+        for t, slos in spec.items():
+            if t not in known_tenants:
+                raise ValueError(
+                    f"scenario {name!r}: {key} names tenant {t!r}, which "
+                    f"is not in load.tenants ({sorted(known_tenants)})")
+            if not isinstance(slos, (list, tuple)) or not slos \
+                    or not all(isinstance(s, str) and s for s in slos):
+                raise ValueError(
+                    f"scenario {name!r}: {key}[{t!r}] must be a "
+                    f"non-empty list of SLO names, got {slos!r}")
+    share_cap = gate_cfg.get("max_unattributed_share")
+    if share_cap is not None and (
+            not isinstance(share_cap, (int, float))
+            or isinstance(share_cap, bool)
+            or not 0 <= float(share_cap) <= 1):
+        raise ValueError(
+            f"scenario {name!r}: gate max_unattributed_share must be a "
+            f"number in [0, 1], got {share_cap!r}")
+    conserve = gate_cfg.get("require_tenant_conservation")
+    if conserve is not None and conserve is not True and (
+            not isinstance(conserve, (int, float))
+            or isinstance(conserve, bool) or not 0 < float(conserve) <= 1):
+        raise ValueError(
+            f"scenario {name!r}: gate require_tenant_conservation must "
+            f"be true or a relative tolerance in (0, 1], got {conserve!r}")
     # The blocks the gate consumes alongside the envelope: parse them
     # through their own loud validators.
     rules_from_config(scenario.get("alerts"))
@@ -340,12 +425,26 @@ def _p95_ms(spans, names, since_wall: float) -> Optional[float]:
 
 
 def _breach_counts(registry) -> Dict[str, float]:
-    """slo_breach_total children by label value, from the run registry."""
+    """slo_breach_total children by label value, from the run registry.
+
+    Exact label-set match: tenant-labeled children ({slo, tenant}) live
+    on the same counter family and must not clobber the aggregate
+    per-SLO parents here."""
     counter = registry.counter("slo_breach_total")
     out: Dict[str, float] = {}
     for labels, value in counter.series():
-        if "slo" in labels:
+        if set(labels) == {"slo"}:
             out[labels["slo"]] = value
+    return out
+
+
+def _tenant_breach_counts(registry) -> Dict[str, float]:
+    """Per-tenant slo_breach_total children, keyed ``"{tenant}:{slo}"``."""
+    counter = registry.counter("slo_breach_total")
+    out: Dict[str, float] = {}
+    for labels, value in counter.series():
+        if set(labels) == {"slo", "tenant"}:
+            out[f"{labels['tenant']}:{labels['slo']}"] = value
     return out
 
 
@@ -719,6 +818,14 @@ class OrchestratorHandle:
                     "orchestrator_down": True}
         return o.get_alerts()
 
+    def get_tenants(self):
+        """The live generation's /tenants body (a dead orchestrator's
+        budget ledger is as gone as its process would be)."""
+        o = self.orch
+        if o is None:
+            return {"tenants": {}, "totals": {}, "orchestrator_down": True}
+        return o.get_tenants()
+
     def watchtower_tick(self, force: bool = False):
         """One watchtower pass on the live generation (no-op while
         dead)."""
@@ -996,6 +1103,7 @@ def run_scenario(scenario: Dict[str, Any],
         clear_dlq_provider,
         clear_dtraces_provider,
         clear_shards_provider,
+        clear_tenants_provider,
         serve_metrics,
         set_alerts_provider,
         set_autoscaler_provider,
@@ -1005,7 +1113,9 @@ def run_scenario(scenario: Dict[str, Any],
         set_dtraces_provider,
         set_shards_provider,
         set_status_provider,
+        set_tenants_provider,
     )
+    from ..orchestrator.tenants import budgets_from_config
 
     scenario = merge_overrides(scenario, overrides)
     validate_gate_config(scenario)
@@ -1107,8 +1217,15 @@ def run_scenario(scenario: Dict[str, Any],
     cluster_provider = None
     dtraces_provider = None
     alerts_provider = None
+    tenants_provider = None
     dlq_provider = None
     local_outbox = None
+    # Tenant budgets (ISSUE 17): parsed once, configured onto EVERY
+    # orchestrator generation inside _make_orch — a kill/restart chaos
+    # line rebuilds a fresh Orchestrator, and the budget ledger must
+    # survive it the way a redeployed coordinator re-reads its config.
+    tenant_budgets, budget_window_s = budgets_from_config(
+        scenario.get("tenant_budgets"))
     # Bus durability (docs/operations.md "Bus durability & dead letters"):
     # a "bus_durability" block gives the broker a WAL spool and routes
     # every publisher (generator, orchestrator, worker) through a durable
@@ -1316,7 +1433,7 @@ def run_scenario(scenario: Dict[str, Any],
             # Fresh Orchestrator + fresh state-manager instance over the
             # SAME storage root and journal dir: a restart resumes from
             # durable state only (the kill-orchestrator closure).
-            return Orchestrator(
+            orch = Orchestrator(
                 crawler_cfg.crawl_id, crawler_cfg, local_bus, _sm("orch"),
                 ocfg=OrchestratorConfig(
                     worker_timeout_s=float(scenario.get("worker_timeout_s",
@@ -1325,6 +1442,9 @@ def run_scenario(scenario: Dict[str, Any],
                         scenario.get("alert_eval_interval_s", 0.05))),
                 journal=CrawlJournal(os.path.join(tmpdir, "orch-journal")),
                 registry=registry, alert_rules=alert_rules)
+            orch.watchtower.tenants.configure(budgets=tenant_budgets,
+                                              window_s=budget_window_s)
+            return orch
 
         orch_handle = OrchestratorHandle(_make_orch, seeds,
                                          drive=bool(crawl_leg))
@@ -1335,6 +1455,8 @@ def run_scenario(scenario: Dict[str, Any],
         set_dtraces_provider(dtraces_provider)
         alerts_provider = orch_handle.get_alerts
         set_alerts_provider(alerts_provider)
+        tenants_provider = orch_handle.get_tenants
+        set_tenants_provider(tenants_provider)
         # Alert announcements are fan-out on TOPIC_ALERTS; collect them
         # so the envelope can assert the publish path works (and so the
         # topic is routed — the unrouted counter stays zero).
@@ -1460,6 +1582,7 @@ def run_scenario(scenario: Dict[str, Any],
         # --- phase A: baseline (flush the SLO window) ----------------------
         _fleet_evaluate_slos()
         breaches_0 = _breach_counts(registry)
+        tenant_breaches_0 = _tenant_breach_counts(registry)
         fleet_size_0 = supervisor.actual(pool_name)
         # Per-rule fired-count baseline: require_alert judges the DELTA
         # over the load+chaos phase, so an alert carried over from
@@ -1552,6 +1675,8 @@ def run_scenario(scenario: Dict[str, Any],
         _fleet_evaluate_slos()
         orch_handle.check_worker_health()
         breaches_fault = _delta(_breach_counts(registry), breaches_0)
+        tenant_breaches_fault = _delta(_tenant_breach_counts(registry),
+                                       tenant_breaches_0)
         # Close the fault window on the ALERT surface deterministically:
         # breach counts reach the watchtower on worker heartbeats, so
         # settle (bounded) until every require_alert rule has fired
@@ -1671,12 +1796,32 @@ def run_scenario(scenario: Dict[str, Any],
         tail_batch_p95 = _p95_ms(spans, BATCH_SPANS, t_tail_wall)
         tail_age_p95 = _p95_ms(spans, BATCH_AGE_SPANS, t_tail_wall)
 
+        # Tenant-surface settle: per-tenant spend reaches the watchtower
+        # on worker HEARTBEATS, so settle (bounded) until every tenant
+        # the gate asserts on shows attributed chip-seconds on /tenants
+        # rather than racing the last beat.
+        require_tenants = list(gate_cfg.get("require_tenants", []))
+        tenant_keys = set(require_tenants) \
+            | set(gate_cfg.get("require_tenant_breach") or {}) \
+            | set(gate_cfg.get("forbid_tenant_breach") or {})
+        if tenant_keys:
+            settle = time.monotonic() + min(5.0, drain_timeout_s)
+            while time.monotonic() < settle:
+                orch_handle.watchtower_tick(force=True)
+                rows = orch_handle.get_tenants().get("tenants", {})
+                if all(rows.get(t, {}).get("spend", {})
+                       .get("chip_seconds", 0.0) > 0
+                       for t in tenant_keys):
+                    break
+                time.sleep(0.05)
+
         endpoints = {
             "metrics": _scrape(port, "/metrics", as_json=False),
             "costs": _scrape(port, "/costs", as_json=True),
             "cluster": _scrape(port, "/cluster", as_json=True),
             "dtraces": _scrape(port, "/dtraces", as_json=True),
             "alerts": _scrape(port, "/alerts", as_json=True),
+            "tenants": _scrape(port, "/tenants", as_json=True),
             "timeseries": _scrape(port, "/timeseries", as_json=True),
         }
         if durable:
@@ -1724,6 +1869,57 @@ def run_scenario(scenario: Dict[str, Any],
             check(f"tail_no_breach_{slo}",
                   breaches_tail.get(slo, 0) == 0,
                   breaches_tail.get(slo, 0), "0 in recovery tail")
+        # Tenant-attribution envelope (ISSUE 17): the /tenants surface
+        # must show each asserted tenant's spend, the unattributed share
+        # must stay under its cap, per-tenant breach children must move
+        # (or not) independently of the aggregates, and the per-tenant
+        # ledger rows must CONSERVE — sum back to the fleet totals.
+        tenants_body = endpoints.get("tenants") \
+            if isinstance(endpoints.get("tenants"), dict) else {}
+        tenant_rows = tenants_body.get("tenants", {})
+        for t in require_tenants:
+            spend = tenant_rows.get(t, {}).get("spend", {})
+            check(f"tenant_visible_{t}",
+                  spend.get("chip_seconds", 0.0) > 0,
+                  spend.get("chip_seconds", 0.0),
+                  "> 0 chip-seconds attributed")
+        if gate_cfg.get("max_unattributed_share") is not None:
+            cap = float(gate_cfg["max_unattributed_share"])
+            share = tenants_body.get("unattributed_share")
+            check("unattributed_share",
+                  share is not None and float(share) <= cap + 1e-9,
+                  share, cap)
+        for t, slos in (gate_cfg.get("require_tenant_breach")
+                        or {}).items():
+            for slo in slos:
+                n = tenant_breaches_fault.get(f"{t}:{slo}", 0)
+                check(f"tenant_breach_{t}_{slo}", n > 0, n,
+                      "> 0 during fault window")
+        tenant_breaches_run = _delta(_tenant_breach_counts(registry),
+                                     tenant_breaches_0)
+        for t, slos in (gate_cfg.get("forbid_tenant_breach")
+                        or {}).items():
+            for slo in slos:
+                n = tenant_breaches_run.get(f"{t}:{slo}", 0)
+                check(f"tenant_no_breach_{t}_{slo}", n == 0, n,
+                      "0 over the whole run")
+        conserve_cfg = gate_cfg.get("require_tenant_conservation")
+        if conserve_cfg:
+            tol = 0.01 if conserve_cfg is True else float(conserve_cfg)
+            costs_body = endpoints.get("costs") \
+                if isinstance(endpoints.get("costs"), dict) else {}
+            ledger = costs_body.get("tenants") or {}
+            rows = ledger.get("rows", [])
+            totals = ledger.get("totals", {})
+            worst = 0.0
+            for key in ("chip_seconds", "flops", "real_tokens"):
+                total = float(totals.get(key, 0.0))
+                if total <= 0:
+                    continue
+                attributed = sum(float(r.get(key, 0.0)) for r in rows)
+                worst = max(worst, abs(attributed - total) / total)
+            check("tenant_conservation", bool(rows) and worst <= tol,
+                  round(worst, 6), tol)
         if gate_cfg.get("queue_wait_p95_ms") is not None:
             budget = float(gate_cfg["queue_wait_p95_ms"])
             check("tail_queue_wait_p95_ms",
@@ -1896,7 +2092,7 @@ def run_scenario(scenario: Dict[str, Any],
             for kind in gate_cfg["require_flight"]:
                 check(f"flight_{kind}", kind in kinds, kind in kinds, True)
         endpoint_keys = ["metrics", "costs", "cluster", "dtraces",
-                         "alerts", "timeseries"]
+                         "alerts", "tenants", "timeseries"]
         if durable:
             endpoint_keys.append("dlq")
         if sharded:
@@ -1950,6 +2146,15 @@ def run_scenario(scenario: Dict[str, Any],
                 "timeseries_series": (endpoints["timeseries"] or {})
                 .get("series_count", 0),
             },
+            "tenants": {
+                "spend": {
+                    t: row.get("spend", {})
+                    for t, row in tenant_rows.items()},
+                "unattributed_share":
+                    tenants_body.get("unattributed_share"),
+                "fault_breaches": tenant_breaches_fault,
+                "run_breaches": tenant_breaches_run,
+            } if tenant_rows else None,
             "occupancy": occupancy,
             "mesh": {str(k): int(v) for k, v in mesh.shape.items()}
             if mesh is not None else None,
@@ -1985,6 +2190,9 @@ def run_scenario(scenario: Dict[str, Any],
         if alerts_provider is not None:
             _teardown("alerts-provider",
                       lambda: clear_alerts_provider(alerts_provider))
+        if tenants_provider is not None:
+            _teardown("tenants-provider",
+                      lambda: clear_tenants_provider(tenants_provider))
         if autoscaler_provider is not None:
             _teardown("autoscaler-provider",
                       lambda: clear_autoscaler_provider(

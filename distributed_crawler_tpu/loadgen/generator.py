@@ -36,6 +36,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..bus.codec import RecordBatch
 from ..bus.messages import (
+    DEFAULT_TENANT,
     TOPIC_INFERENCE_BATCHES,
     TOPIC_MEDIA_BATCHES,
     VALID_PLATFORMS,
@@ -71,11 +72,15 @@ class PlannedRecord:
 @dataclass(frozen=True)
 class PlannedBatch:
     """Shape + arrival slot of one batch; ``offset_s`` is None for
-    closed-loop arrivals (the completion feedback sets the time)."""
+    closed-loop arrivals (the completion feedback sets the time).
+    ``tenant`` is empty for batches planned before the tenant mix is
+    consulted (gate tail batches, replays) — `build_batch` then draws a
+    deterministic tenant from the mix by batch index."""
 
     index: int
     offset_s: Optional[float]
     records: tuple  # of PlannedRecord
+    tenant: str = ""
 
 
 @dataclass
@@ -98,6 +103,11 @@ class LoadGenConfig:
     max_words: int = 120
     platform_mix: Dict[str, float] = field(
         default_factory=lambda: {"telegram": 0.8, "youtube": 0.2})
+    # Tenant traffic mix (ISSUE 17): {tenant_name: weight}.  Each planned
+    # batch draws one tenant from this distribution (seeded, so the same
+    # seed reproduces the same per-tenant volumes).  Empty = everything
+    # stamps the documented DEFAULT_TENANT.
+    tenants: Dict[str, float] = field(default_factory=dict)
     crawl_id: str = "loadgen"
 
     def validate(self) -> None:
@@ -133,6 +143,14 @@ class LoadGenConfig:
         if not self.platform_mix or \
                 sum(self.platform_mix.values()) <= 0:
             raise ValueError("platform_mix must have positive weight")
+        for name, weight in self.tenants.items():
+            if not isinstance(name, str) or not name.strip():
+                raise ValueError(
+                    f"tenants keys must be non-empty strings, got {name!r}")
+            if not isinstance(weight, (int, float)) or weight <= 0:
+                raise ValueError(
+                    f"tenant {name!r} weight must be a positive number, "
+                    f"got {weight!r}")
 
     def rate_at(self, t_s: float) -> float:
         """The offered Poisson rate at offset ``t_s`` (the last
@@ -173,6 +191,21 @@ class _WorkloadBase:
         raise NotImplementedError
 
     # -- materialization ----------------------------------------------------
+    def tenant_for(self, index: int) -> str:
+        """Deterministic tenant for batch ``index`` from the configured
+        mix (seeded by (seed, index), so ad-hoc batches — e.g. the
+        gate's tail batches — draw the same tenant for the same slot
+        regardless of plan order).  No mix → DEFAULT_TENANT."""
+        mix = getattr(self.cfg, "tenants", None)
+        if not mix:
+            return DEFAULT_TENANT
+        names = sorted(mix)
+        # String seed, NOT a tuple: tuple seeding hashes its elements,
+        # and str hashes are randomized per process (PYTHONHASHSEED) —
+        # the draw must be identical across processes, replays included.
+        rng = random.Random(f"{self.cfg.seed}:{index}:tenant")
+        return rng.choices(names, weights=[mix[n] for n in names])[0]
+
     def build_batch(self, pb: PlannedBatch) -> RecordBatch:
         posts = []
         for j, rec in enumerate(pb.records):
@@ -184,7 +217,9 @@ class _WorkloadBase:
                 post_link=f"https://sim/{uid}",
                 platform_name=rec.platform,
                 description=zipf_text(pb.index * 131 + j, rec.words)))
-        return RecordBatch.from_posts(posts, crawl_id=self.cfg.crawl_id)
+        tenant = getattr(pb, "tenant", "") or self.tenant_for(pb.index)
+        return RecordBatch.from_posts(posts, crawl_id=self.cfg.crawl_id,
+                                      tenant=tenant)
 
     # -- publishing ---------------------------------------------------------
     def run(self, bus, topic: str = TOPIC_INFERENCE_BATCHES,
@@ -219,6 +254,7 @@ class _WorkloadBase:
             if record_flight:
                 flight.record("loadgen_batch", batch=batch.batch_id,
                               records=len(pb.records), words=words,
+                              tenant=batch.tenant,
                               offset_s=round(now - t0, 4))
 
         plan = self.plan()
@@ -283,11 +319,13 @@ class SyntheticWorkload(_WorkloadBase):
                 if t >= self.cfg.duration_s:
                     break
                 out.append(PlannedBatch(i, round(t, 6),
-                                        self._records(rng)))
+                                        self._records(rng),
+                                        self.tenant_for(i)))
                 i += 1
         else:  # ramp: shapes only; completion feedback paces them
             for i in range(self.cfg.ramp_batches):
-                out.append(PlannedBatch(i, None, self._records(rng)))
+                out.append(PlannedBatch(i, None, self._records(rng),
+                                        self.tenant_for(i)))
         self._plan = out
         return out
 
@@ -353,6 +391,7 @@ class AudioLoadConfig:
     zipf_a: float = 1.6
     sample_rate: int = 16_000
     crawl_id: str = "loadgen-asr"
+    tenant: str = DEFAULT_TENANT        # stamped onto every audio batch
 
     def validate(self) -> None:
         if self.duration_s <= 0:
@@ -465,7 +504,8 @@ class AudioWorkload:
                                   f"{self.media_id(pb.index, j)}.wav"),
                 channel_name=f"lgchan{pb.index % 5}")
                 for j in range(len(pb.durations_s))]
-            msg = AudioBatchMessage.new(refs, crawl_id=self.cfg.crawl_id)
+            msg = AudioBatchMessage.new(refs, crawl_id=self.cfg.crawl_id,
+                                        tenant=self.cfg.tenant)
             bus.publish(topic, msg.to_dict())
             now = time.monotonic()
             if stats.batches == 0:

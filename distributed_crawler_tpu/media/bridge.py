@@ -53,11 +53,14 @@ class MediaBridge:
     def __init__(self, sm, bus, crawl_id: str = "", batch_size: int = 8,
                  deadline_s: float = 0.25, topic: str = TOPIC_MEDIA_BATCHES,
                  poll_interval_s: float = 0.05, dedupe_window: int = 65536,
-                 extensions: tuple = AUDIO_EXTENSIONS):
+                 extensions: tuple = AUDIO_EXTENSIONS, tenant: str = ""):
         self._sm = sm
         self._bus = bus
         self._topic = topic
         self._crawl_id = crawl_id
+        # Tenant provenance (ISSUE 17): stamped onto every published
+        # audio batch; empty folds to the documented default tenant.
+        self._tenant = tenant
         self._batch_size = max(1, batch_size)
         self._deadline_s = deadline_s
         self._extensions = tuple(e.lower() for e in extensions)
@@ -135,7 +138,8 @@ class MediaBridge:
     def _emit(self) -> AudioBatchMessage:
         """Build a batch from pending refs; every caller holds the lock
         (the crawlint pragma records that contract)."""
-        msg = AudioBatchMessage.new(self._pending, crawl_id=self._crawl_id)
+        msg = AudioBatchMessage.new(self._pending, crawl_id=self._crawl_id,
+                                    tenant=self._tenant)
         self._pending = []  # crawlint: disable=LCK001
         self._first_at = None  # crawlint: disable=LCK001
         return msg

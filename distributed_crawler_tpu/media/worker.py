@@ -202,12 +202,26 @@ class ASRWorker:
 
     def get_costs(self) -> dict:
         """The /costs body: Whisper program rows + efficiency window +
-        this worker's SLO state."""
+        this worker's SLO state + per-tenant spend rows."""
         snap_fn = getattr(self.pipeline, "cost_snapshot", None)
         out = dict(snap_fn()) if callable(snap_fn) else {}
         out["worker_id"] = self.cfg.worker_id
         out["slo"] = self._slo.snapshot()
+        ledger = self._tenant_ledger()
+        if ledger is not None:
+            out["tenants"] = ledger.snapshot()
         return out
+
+    # -- tenant attribution (ISSUE 17) ---------------------------------------
+    def _tenant_ledger(self):
+        return getattr(getattr(self.pipeline, "meter", None),
+                       "tenants", None)
+
+    def _set_meter_tenants(self, weights: Dict[str, float]) -> None:
+        set_fn = getattr(getattr(self.pipeline, "meter", None),
+                         "set_tenants", None)
+        if callable(set_fn):
+            set_fn(weights)
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> None:
@@ -378,10 +392,13 @@ class ASRWorker:
             self,
             items: List[Tuple[AudioBatchMessage, Any, float]]) -> None:
         now = time.monotonic()
+        ledger = self._tenant_ledger()
         for msg, _, enq_t in items:
             trace.record("asr_worker.queue_wait", now - enq_t,
                          trace_id=msg.trace_id, batch=msg.batch_id,
-                         worker=self.cfg.worker_id)
+                         worker=self.cfg.worker_id, tenant=msg.tenant)
+            if ledger is not None and msg.tenant:
+                ledger.observe_queue_wait(msg.tenant, now - enq_t)
             self._observe_age(msg)
         if len(items) == 1:
             msg, ack, _ = items[0]
@@ -393,6 +410,14 @@ class ASRWorker:
         plans = []
         for msg, ack, _ in items:
             plans.append(self._chunk(msg))
+        # Tenant weights for the combined dispatch = window counts.
+        weights: Dict[str, float] = {}
+        for (msg, _, _), plan in zip(items, plans):
+            if plan is not None:
+                weights[msg.tenant] = weights.get(msg.tenant, 0.0) \
+                    + max(1, plan.n_windows)
+        self._set_meter_tenants(weights)
+        dominant = max(weights, key=weights.get) if weights else ""
         # One combined window list across the group -> shared bucketed
         # device batches; per-batch window counts fan results back.
         try:
@@ -401,7 +426,8 @@ class ASRWorker:
                             batches=len(items),
                             batch_ids=[m.batch_id for m, _, _ in items],
                             windows=sum(p.n_windows for p in plans
-                                        if p is not None)):
+                                        if p is not None),
+                            tenant=dominant):
                 merged = self._merge_plans([p for p in plans
                                             if p is not None])
                 per_window = self.pipeline.transcribe_plan(merged) \
@@ -471,9 +497,10 @@ class ASRWorker:
             return
 
         def produce():
+            self._set_meter_tenants({msg.tenant: max(1, plan.n_windows)})
             with trace.span("asr_worker.process", trace_id=msg.trace_id,
                             batch=msg.batch_id, refs=len(msg.refs),
-                            windows=plan.n_windows):
+                            windows=plan.n_windows, tenant=msg.tenant):
                 return self.pipeline.transcribe_plan(plan)
 
         self._finish_batch(msg, ack, plan, produce)
@@ -527,7 +554,7 @@ class ASRWorker:
         for i, ref in enumerate(msg.refs):
             common = dict(crawl_id=msg.crawl_id, batch_id=msg.batch_id,
                           worker_id=self.cfg.worker_id,
-                          trace_id=msg.trace_id)
+                          trace_id=msg.trace_id, tenant=msg.tenant)
             if i in plan.errors:
                 out.append(TranscriptMessage.new(
                     ref.media_id, path=ref.path,
@@ -569,6 +596,7 @@ class ASRWorker:
                 "channel_name": t.channel_name,
                 "batch_id": msg.batch_id,
                 "trace_id": msg.trace_id,
+                "tenant": msg.tenant,
                 "text": t.text,
                 "windows": t.windows,
                 "error": t.error,
@@ -592,7 +620,7 @@ class ASRWorker:
             # worker's backlog finally lands.
             trace.record("asr_worker.batch_age", age,
                          trace_id=msg.trace_id, batch=msg.batch_id,
-                         worker=self.cfg.worker_id)
+                         worker=self.cfg.worker_id, tenant=msg.tenant)
 
     # -- heartbeats ----------------------------------------------------------
     def _heartbeat_loop(self) -> None:
@@ -616,8 +644,16 @@ class ASRWorker:
                 "depth_time_weighted": round(self._depth.sample(), 4),
             }
             # Burn-rate feed + self-sample, the TPU worker's mirror.
-            msg.resource_usage["slo_breaches"] = \
-                self._slo.snapshot()["breaches"]
+            slo_snap = self._slo.snapshot()
+            msg.resource_usage["slo_breaches"] = slo_snap["breaches"]
+            if slo_snap.get("tenant_breaches"):
+                msg.resource_usage["tenant_slo_breaches"] = \
+                    slo_snap["tenant_breaches"]
+            ledger = self._tenant_ledger()
+            if ledger is not None:
+                tenants = ledger.snapshot()
+                if tenants["rows"]:
+                    msg.resource_usage["tenants"] = tenants
             self._ts_sampler.sample()
             try:
                 self.bus.publish(TOPIC_WORKER_STATUS, msg.to_dict())

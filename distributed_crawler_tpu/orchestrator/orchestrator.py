@@ -1077,6 +1077,11 @@ class Orchestrator:
         registered via `utils.metrics.set_alerts_provider` by the CLI."""
         return self.watchtower.get_alerts()
 
+    def get_tenants(self) -> Dict[str, Any]:
+        """The ``/tenants`` JSON body (per-tenant spend + error budgets);
+        registered via `utils.metrics.set_tenants_provider` by the CLI."""
+        return self.watchtower.get_tenants()
+
     # -- cluster-guided frontier (`cluster/`) ------------------------------
     def handle_cluster_payload(self, payload: Dict[str, Any]) -> None:
         """Fold a ClusterUpdateMessage into the frontier-priority guide;

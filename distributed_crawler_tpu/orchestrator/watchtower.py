@@ -41,6 +41,7 @@ from ..bus.messages import TOPIC_ALERTS, AlertMessage, StatusMessage
 from ..utils.alerts import AlertEngine, AlertRule, default_rules
 from ..utils.metrics import REGISTRY, MetricsRegistry
 from ..utils.timeseries import STORE, RegistrySampler, TimeSeriesStore
+from .tenants import TenantBudgetLedger
 
 logger = logging.getLogger("dct.watchtower")
 
@@ -68,6 +69,10 @@ class Watchtower:
             publish=self._publish_transition)
         self._sampler = RegistrySampler(registry, self.store) \
             if sample_registry else None
+        # Per-tenant spend + error-budget view over the fleet folds
+        # below (orchestrator/tenants.py); budgets are installed later
+        # via ``tenants.configure`` (CLI config / scenario block).
+        self.tenants = TenantBudgetLedger(store=self.store, clock=clock)
         self._mu = threading.Lock()
         self._last_eval = 0.0
         self._ticks = 0
@@ -137,6 +142,44 @@ class Watchtower:
                     self.store.add("fleet_slo_breach_total", float(count),
                                    {"worker": wid, "slo": str(slo)},
                                    wall=wall)
+        # Per-tenant spend + breach folds (ISSUE 17): the worker's
+        # TenantLedger rows and the watchdog's tenant-labeled breach
+        # split become fleet series — what /tenants and the error-budget
+        # ledger read.  Cumulative counters, so restarts are absorbed by
+        # increase() exactly like the aggregate breach fold above.
+        tenants = usage.get("tenants")
+        if isinstance(tenants, dict):
+            for row in (tenants.get("rows") or []):
+                if not isinstance(row, dict):
+                    continue
+                tenant = str(row.get("tenant") or "")
+                if not tenant:
+                    continue
+                tlabels = {"worker": wid, "tenant": tenant}
+                for key, series in (
+                        ("chip_seconds", "fleet_tenant_chip_seconds_total"),
+                        ("flops", "fleet_tenant_flops_total"),
+                        ("real_tokens", "fleet_tenant_real_tokens_total"),
+                        ("batches", "fleet_tenant_batches_total")):
+                    value = row.get(key)
+                    if isinstance(value, (int, float)):
+                        self.store.add(series, float(value), tlabels,
+                                       wall=wall)
+                p95 = row.get("queue_wait_p95_s")
+                if isinstance(p95, (int, float)):
+                    self.store.add("fleet_tenant_queue_wait_p95_seconds",
+                                   float(p95), tlabels, wall=wall)
+        tenant_breaches = usage.get("tenant_slo_breaches")
+        if isinstance(tenant_breaches, dict):
+            for tenant, slos in tenant_breaches.items():
+                if not isinstance(slos, dict):
+                    continue
+                for slo, count in slos.items():
+                    if isinstance(count, (int, float)):
+                        self.store.add(
+                            "fleet_tenant_slo_breach_total", float(count),
+                            {"worker": wid, "tenant": str(tenant),
+                             "slo": str(slo)}, wall=wall)
 
     # -- the tick ------------------------------------------------------------
     def tick(self, now: Optional[float] = None,
@@ -197,6 +240,11 @@ class Watchtower:
                 "series_count": len(self.store.keys()),
             }
         return body
+
+    def get_tenants(self) -> Dict[str, Any]:
+        """The ``/tenants`` JSON body (registered via
+        `utils.metrics.set_tenants_provider`)."""
+        return self.tenants.view()
 
     def firing(self) -> List[str]:
         return self.engine.firing()

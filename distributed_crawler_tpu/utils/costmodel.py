@@ -274,6 +274,134 @@ class CostModel:
                       key=lambda e: (e["path"], e["bucket"]))
 
 
+class TenantLedger:
+    """Per-tenant spend attribution (ISSUE 17): which workload consumed
+    which chip-seconds/FLOPs/tokens, plus a rolling queue-wait read per
+    tenant.
+
+    The ledger keeps its OWN cumulative rows (registry counters with the
+    same name are shared across every meter in a process, so exposition
+    counters alone cannot answer "this engine's split").  ``totals`` are
+    accumulated independently of the per-tenant rows under the same
+    lock, so the conservation property the gate asserts — per-tenant
+    rows sum to the fleet total — is checkable against this snapshot.
+
+    Charging is proportional: one device batch's duration/FLOPs/tokens
+    split by the caller-supplied weights (the worker weighs by real
+    token counts per tenant in the coalesced group).  Warmup and other
+    unweighted dispatches charge nothing — they predate any tenant, so
+    they must not show up as "unattributed spend"."""
+
+    _QUEUE_WINDOW = 512  # rolling queue-wait samples kept per tenant
+
+    def __init__(self, registry: MetricsRegistry = REGISTRY):
+        self._lock = threading.Lock()
+        self._rows: Dict[str, Dict[str, float]] = {}
+        self._totals = {"chip_seconds": 0.0, "flops": 0.0,
+                        "real_tokens": 0.0, "batches": 0.0}
+        self._queue_waits: Dict[str, "deque[float]"] = {}
+        self.m_chip_seconds = registry.counter(
+            "tenant_chip_seconds_total",
+            "cumulative device-batch seconds attributed to one tenant "
+            "(proportional split of each dispatch by real-token weight)")
+        self.m_flops = registry.counter(
+            "tenant_flops_total",
+            "cumulative forward FLOPs attributed to one tenant")
+        self.m_tokens = registry.counter(
+            "tenant_real_tokens_total",
+            "cumulative REAL (non-pad) tokens attributed to one tenant")
+        self.m_queue_wait = registry.gauge(
+            "tenant_queue_wait_p95_seconds",
+            "p95 queue wait over the last samples observed for one tenant")
+
+    def charge(self, weights: Dict[str, float], duration_s: float,
+               flops: float, real_tokens: float) -> None:
+        """Attribute one dispatch across ``weights`` proportionally."""
+        total_w = sum(w for w in weights.values() if w > 0)
+        if total_w <= 0:
+            return
+        with self._lock:
+            self._totals["chip_seconds"] += float(duration_s)
+            self._totals["flops"] += float(flops)
+            self._totals["real_tokens"] += float(real_tokens)
+            self._totals["batches"] += 1.0
+            for tenant, w in weights.items():
+                if w <= 0:
+                    continue
+                frac = w / total_w
+                row = self._rows.setdefault(tenant, {
+                    "chip_seconds": 0.0, "flops": 0.0,
+                    "real_tokens": 0.0, "batches": 0.0})
+                row["chip_seconds"] += duration_s * frac
+                row["flops"] += flops * frac
+                row["real_tokens"] += real_tokens * frac
+                row["batches"] += frac
+                self.m_chip_seconds.labels(tenant=tenant).inc(
+                    duration_s * frac)
+                self.m_flops.labels(tenant=tenant).inc(flops * frac)
+                self.m_tokens.labels(tenant=tenant).inc(real_tokens * frac)
+
+    def observe_queue_wait(self, tenant: str, seconds: float) -> None:
+        """Feed one batch's queue wait into the tenant's rolling window."""
+        with self._lock:
+            dq = self._queue_waits.setdefault(
+                tenant, deque(maxlen=self._QUEUE_WINDOW))
+            dq.append(float(seconds))
+            samples = sorted(dq)
+        # Nearest-rank p95, same convention as utils/slo.py.
+        p95 = samples[max(0, -(-len(samples) * 95 // 100) - 1)]
+        self.m_queue_wait.labels(tenant=tenant).set(round(p95, 6))
+
+    def snapshot(self) -> Dict[str, Any]:
+        """{"rows": [...], "totals": {...}} — the /costs "tenants" map.
+        Row ``share`` is the tenant's chip-second fraction of the total
+        (the gate's ``max_unattributed_share`` reads the DEFAULT_TENANT
+        row's share)."""
+        with self._lock:
+            totals = dict(self._totals)
+            rows = {t: dict(r) for t, r in self._rows.items()}
+            waits = {t: sorted(dq) for t, dq in self._queue_waits.items()
+                     if dq}
+        out_rows = []
+        denom = totals["chip_seconds"]
+        for tenant in sorted(rows):
+            row = rows[tenant]
+            entry: Dict[str, Any] = {
+                "tenant": tenant,
+                "chip_seconds": round(row["chip_seconds"], 6),
+                "flops": round(row["flops"], 1),
+                "real_tokens": round(row["real_tokens"], 1),
+                "batches": round(row["batches"], 4),
+                "share": round(row["chip_seconds"] / denom, 6)
+                if denom > 0 else 0.0,
+            }
+            samples = waits.get(tenant)
+            if samples:
+                entry["queue_wait_p95_s"] = round(
+                    samples[max(0, -(-len(samples) * 95 // 100) - 1)], 6)
+                entry["queue_wait_samples"] = len(samples)
+            out_rows.append(entry)
+        # Tenants that only ever waited (no spend yet) still get a row.
+        for tenant in sorted(set(waits) - set(rows)):
+            samples = waits[tenant]
+            out_rows.append({
+                "tenant": tenant, "chip_seconds": 0.0, "flops": 0.0,
+                "real_tokens": 0.0, "batches": 0.0, "share": 0.0,
+                "queue_wait_p95_s": round(
+                    samples[max(0, -(-len(samples) * 95 // 100) - 1)], 6),
+                "queue_wait_samples": len(samples),
+            })
+        return {
+            "rows": out_rows,
+            "totals": {
+                "chip_seconds": round(totals["chip_seconds"], 6),
+                "flops": round(totals["flops"], 1),
+                "real_tokens": round(totals["real_tokens"], 1),
+                "batches": round(totals["batches"], 4),
+            },
+        }
+
+
 class EfficiencyMeter:
     """Rolling-window goodput/MFU over dispatched batches.
 
@@ -311,6 +439,12 @@ class EfficiencyMeter:
             = deque(maxlen=max_records)
         self._ever_recorded = False
         self._lock = threading.Lock()
+        # Per-tenant attribution (ISSUE 17): the worker sets the pending
+        # tenant weights before handing the engine a group; every record()
+        # while weights are in force charges the ledger proportionally.
+        # No weights (warmup, organic unlabeled runs) → nothing charged.
+        self.tenants = TenantLedger(registry)
+        self._tenant_weights: Dict[str, float] = {}
         # Peak injected for tests; resolved lazily from the live backend
         # otherwise (the engine imports jax long before the first batch).
         self._peak = peak
@@ -351,6 +485,15 @@ class EfficiencyMeter:
                 default_peak_flops(self._n_devices)
         return self._peak, self._peak_source
 
+    def set_tenants(self, weights: Dict[str, float]) -> None:
+        """Declare which tenants (by positive weight, e.g. real-token
+        counts) the NEXT recorded dispatches belong to.  Weights persist
+        until the next call, so one coalesced group's multiple device
+        batches all charge the same split."""
+        with self._lock:
+            self._tenant_weights = {
+                t: float(w) for t, w in (weights or {}).items() if w > 0}
+
     def record(self, duration_s: float, flops: float,
                real_tokens: int, slot_tokens: int,
                per_device_real_tokens: Optional[List[int]] = None) -> None:
@@ -370,6 +513,10 @@ class EfficiencyMeter:
                                   int(real_tokens), int(slot_tokens),
                                   per_dev))
             self._prune(now)
+            weights = dict(self._tenant_weights)
+        if weights:
+            self.tenants.charge(weights, float(duration_s), float(flops),
+                                float(real_tokens))
         self.snapshot()  # refreshes the gauges as a side effect
 
     def _prune(self, now: float) -> None:

@@ -157,6 +157,22 @@ class FlightRecorder:
         shards = shards_snapshot()
         if shards is not None:
             bundle["bus_shards"] = shards
+        # Tenant accounting + error budgets: who was spending the chips
+        # and whose budget was burning when this process went down — the
+        # attribution question a multi-workload postmortem opens with.
+        from .metrics import tenants_snapshot
+
+        tenants = tenants_snapshot()
+        if tenants is not None:
+            bundle["tenants"] = tenants
+        # The structured-log ring: the last WARNING+ records with their
+        # trace_id correlation — the complaints right before the crash,
+        # even when stderr scrolled away.
+        from .metrics import logs_snapshot
+
+        logs = logs_snapshot()
+        if logs is not None:
+            bundle["logs"] = logs
         try:
             from . import timeseries as _timeseries
 

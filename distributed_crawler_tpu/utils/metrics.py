@@ -554,6 +554,54 @@ def autoscaler_snapshot():
         return {"error": str(e)}
 
 
+# Late-bound /tenants provider: the watchtower's per-tenant accounting
+# view (`orchestrator/tenants.py`) — fleet spend rows folded from worker
+# heartbeats plus the error-budget ledger (windowed burn per tenant per
+# SLO, remaining budget, exhaustion projection).
+_tenants_provider = None
+
+
+def set_tenants_provider(fn) -> None:
+    """Register the zero-arg dict provider served at /tenants (pass
+    None to clear)."""
+    global _tenants_provider
+    _tenants_provider = fn
+
+
+def clear_tenants_provider(fn) -> None:
+    """Unregister ``fn`` only if it is still the active provider."""
+    global _tenants_provider
+    if _tenants_provider == fn:
+        _tenants_provider = None
+
+
+def tenants_snapshot():
+    """The active /tenants body, or None without a provider — the
+    flight recorder calls this so postmortem bundles carry the tenant
+    spend + error-budget state a dead process can no longer serve."""
+    fn = _tenants_provider
+    if fn is None:
+        return None
+    try:
+        return fn()
+    except Exception as e:
+        return {"error": str(e)}
+
+
+def logs_snapshot():
+    """The /logs body (the structured-log ring from utils/structlog.py)
+    — the flight recorder calls this so postmortem bundles carry the
+    last WARNING+ records a dead process can no longer serve.  Returns
+    None when the ring is empty so bundles stay byte-identical for
+    processes that never warned."""
+    from . import structlog as _structlog
+
+    records = _structlog.ring_snapshot()
+    if not records:
+        return None
+    return {"records": records}
+
+
 class _Handler(BaseHTTPRequestHandler):
     registry: MetricsRegistry = REGISTRY
 
@@ -721,6 +769,45 @@ class _Handler(BaseHTTPRequestHandler):
 
             try:
                 body = _json.dumps(_autoscaler_provider(),
+                                   default=str).encode("utf-8")
+            except Exception as e:
+                code = 500
+                body = _json.dumps({"error": str(e)}).encode("utf-8")
+            ctype = "application/json"
+        elif path == "/tenants" and _tenants_provider is not None:
+            # The watchtower's per-tenant accounting surface
+            # (`orchestrator/tenants.py`): fleet spend rows by tenant +
+            # the error-budget ledger (windowed burn per SLO, remaining
+            # budget, exhaustion projection).  Rendered by
+            # tools/watch.py's tenants panel and gated by loadgen.
+            import json as _json
+
+            try:
+                body = _json.dumps(_tenants_provider(),
+                                   default=str).encode("utf-8")
+            except Exception as e:
+                code = 500
+                body = _json.dumps({"error": str(e)}).encode("utf-8")
+            ctype = "application/json"
+        elif path == "/logs":
+            # The bounded structured-log ring (`utils/structlog.py`):
+            # the last N WARNING+ records with trace_id correlation.
+            # Served unconditionally (the /traces pattern): a process
+            # that never warned answers with zero records, not a 404.
+            # ?limit=N caps the record count (newest kept).
+            import json as _json
+            from urllib.parse import parse_qs as _parse_qs
+
+            from . import structlog as _structlog
+
+            query = self.path.partition("?")[2]
+            try:
+                limit = int(_parse_qs(query).get("limit", ["0"])[0])
+            except (ValueError, TypeError):
+                limit = 0
+            try:
+                records = _structlog.ring_snapshot(limit=limit)
+                body = _json.dumps({"records": records},
                                    default=str).encode("utf-8")
             except Exception as e:
                 code = 500

@@ -93,10 +93,16 @@ class SLOWatchdog:
         self._last_eval = time.time()
         self._warned_disabled = False
         self._breach_counts: Dict[str, int] = {s.name: 0 for s in self.slos}
+        # {(tenant, slo): count} — children of the same counter family,
+        # NEVER replacing the aggregate (the tenant-labeled series carry
+        # the extra ``tenant`` label; the parent {slo=} series stays the
+        # fleet truth existing dashboards and gates read).
+        self._tenant_breach_counts: Dict[Tuple[str, str], int] = {}
         self.m_breaches = registry.counter(
             "slo_breach_total",
             "declared latency budgets busted, by SLO name (one per "
-            "evaluation tick the breach spans)")
+            "evaluation tick the breach spans; tenant-labeled children "
+            "split the same events by workload)")
 
     def evaluate(self, now: Optional[float] = None) -> List[Dict[str, Any]]:
         """One tick: digest spans completed since the last tick against
@@ -155,14 +161,45 @@ class SLOWatchdog:
                 "budget_ms": slo.budget_ms, "spans": n,
                 "worst_trace_id": worst.trace_id,
             })
+        # Per-tenant children (ISSUE 17): the same spans, split by their
+        # ``tenant`` attr, each judged against the same budget.  Runs
+        # even when the aggregate stayed green — one hot tenant can bust
+        # its own p95 inside a healthy fleet p95.
+        for slo in self.slos:
+            by_tenant: Dict[str, List[Any]] = {}
+            for s in spans:
+                if s.name not in slo.span_names:
+                    continue
+                tenant = getattr(s, "attrs", {}).get("tenant")
+                if tenant:
+                    by_tenant.setdefault(str(tenant), []).append(s)
+            for tenant, matched in by_tenant.items():
+                matched.sort(key=lambda s: s.duration_s)
+                n = len(matched)
+                p95_span = matched[min(n - 1,
+                                       max(0, math.ceil(0.95 * n) - 1))]
+                if p95_span.duration_s * 1000.0 <= slo.budget_ms:
+                    continue
+                self.m_breaches.labels(slo=slo.name, tenant=tenant).inc()
+                with self._lock:
+                    key = (tenant, slo.name)
+                    self._tenant_breach_counts[key] = \
+                        self._tenant_breach_counts.get(key, 0) + 1
         return breaches
 
     def snapshot(self) -> Dict[str, Any]:
-        """Budgets + cumulative breach counts (the /costs ``slo`` map)."""
+        """Budgets + cumulative breach counts (the /costs ``slo`` map).
+        ``tenant_breaches`` nests {tenant: {slo: count}} so heartbeats
+        can carry the per-tenant split next to the aggregate."""
         with self._lock:
             counts = dict(self._breach_counts)
+            tenant_counts = dict(self._tenant_breach_counts)
+        by_tenant: Dict[str, Dict[str, int]] = {}
+        for (tenant, slo_name), n in sorted(tenant_counts.items()):
+            by_tenant.setdefault(tenant, {})[slo_name] = n
         return {
             "budgets": [{"slo": s.name, "budget_ms": s.budget_ms,
                          "spans": list(s.span_names)} for s in self.slos],
             "breaches": counts,
+            "tenant_breaches": by_tenant,
         }

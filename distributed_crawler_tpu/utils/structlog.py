@@ -10,8 +10,10 @@ from __future__ import annotations
 import json
 import logging
 import sys
+import threading
 import time
-from typing import Any, Dict
+from collections import deque
+from typing import Any, Dict, List, Optional
 
 from . import trace as _trace
 
@@ -65,6 +67,109 @@ class ConsoleFormatter(logging.Formatter):
         return f"{base} {extras}" if extras else base
 
 
+# ---------------------------------------------------------------------------
+# Bounded WARNING+ ring (ISSUE 17): the last N structured records kept
+# in-process and served at /logs on the metrics port.  stderr scrolls
+# away and journald is not always there; the ring answers "what did this
+# process complain about right before the incident" over HTTP and rides
+# along in postmortem bundles.  trace_id correlation comes from the same
+# `_trace_fields()` seam the formatters use, so a ring record links to
+# its /traces timeline.
+
+_RING_CAPACITY = 256
+
+
+class RingHandler(logging.Handler):
+    """Keep the last ``capacity`` WARNING+ records as plain dicts."""
+
+    def __init__(self, capacity: int = _RING_CAPACITY):
+        super().__init__(level=logging.WARNING)
+        self._ring: deque = deque(maxlen=max(1, int(capacity)))
+        self._ring_lock = threading.Lock()
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            entry: Dict[str, Any] = {
+                "level": record.levelname.lower(),
+                "ts": round(record.created, 3),
+                "logger": record.name,
+                "message": record.getMessage(),
+            }
+            for k, v in record.__dict__.items():
+                if k not in _RESERVED and not k.startswith("_"):
+                    entry[k] = v
+            for k, v in _trace_fields().items():
+                entry.setdefault(k, v)
+            if record.exc_info and record.exc_info[0] is not None:
+                entry["error"] = self.format(record) if self.formatter \
+                    else logging.Formatter().formatException(record.exc_info)
+            with self._ring_lock:
+                self._ring.append(entry)
+        except Exception:  # never let telemetry break the caller
+            self.handleError(record)
+
+    def snapshot(self, limit: int = 0) -> List[Dict[str, Any]]:
+        with self._ring_lock:
+            records = list(self._ring)
+        if limit and limit > 0:
+            records = records[-limit:]
+        return records
+
+
+_ring_handler: Optional[RingHandler] = None
+_ring_install_lock = threading.Lock()
+
+
+def install_ring_handler(capacity: int = _RING_CAPACITY) -> RingHandler:
+    """Attach the process-wide WARNING+ ring to the 'dct' logger tree.
+    Idempotent: repeat calls return the existing ring (the buffer
+    survives `setup_logging` re-running on the same process)."""
+    global _ring_handler
+    with _ring_install_lock:
+        if _ring_handler is None:
+            _ring_handler = RingHandler(capacity)
+        logger = logging.getLogger("dct")
+        if _ring_handler not in logger.handlers:
+            logger.addHandler(_ring_handler)
+        return _ring_handler
+
+
+def uninstall_ring_handler() -> Optional[RingHandler]:
+    """Detach the ring from the 'dct' logger tree and forget it; returns
+    the detached handler (None when nothing was installed).  Pair with
+    ``reinstall_ring_handler`` — ``install_ring_handler`` after an
+    uninstall would start a fresh empty ring, dropping the buffer."""
+    global _ring_handler
+    with _ring_install_lock:
+        handler = _ring_handler
+        _ring_handler = None
+        if handler is not None:
+            logging.getLogger("dct").removeHandler(handler)
+        return handler
+
+
+def reinstall_ring_handler(handler: Optional[RingHandler]) -> None:
+    """Reattach a handler returned by ``uninstall_ring_handler``, records
+    intact.  No-op on None, so save/restore composes unconditionally."""
+    if handler is None:
+        return
+    global _ring_handler
+    with _ring_install_lock:
+        _ring_handler = handler
+        logger = logging.getLogger("dct")
+        if handler not in logger.handlers:
+            logger.addHandler(handler)
+
+
+def ring_snapshot(limit: int = 0) -> List[Dict[str, Any]]:
+    """The ring's records oldest-first ([] before install / when quiet);
+    ``limit`` keeps only the newest N.  This is the /logs body."""
+    handler = _ring_handler
+    if handler is None:
+        return []
+    return handler.snapshot(limit=limit)
+
+
 def setup_logging(level: str = "info", json_output: bool = False,
                   stream=None) -> logging.Logger:
     """Configure the 'dct' logger tree; returns the root 'dct' logger."""
@@ -75,6 +180,9 @@ def setup_logging(level: str = "info", json_output: bool = False,
     handler.setFormatter(JsonFormatter() if json_output else ConsoleFormatter())
     logger.addHandler(handler)
     logger.propagate = False
+    # handlers.clear() above dropped the ring; re-attach so the WARNING+
+    # buffer keeps feeding /logs across logging re-configuration.
+    install_ring_handler()
     return logger
 
 

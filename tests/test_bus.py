@@ -183,6 +183,47 @@ class TestMessageRegistry:
         decoded = decode_message(msg.to_dict())
         assert decoded.trace_id == item.trace_id
 
+    def test_tenant_label_roundtrips_and_legacy_frames_default(self):
+        from distributed_crawler_tpu.bus import decode_message
+        from distributed_crawler_tpu.bus.messages import (
+            DEFAULT_TENANT,
+            AudioBatchMessage,
+            AudioRef,
+            TranscriptMessage,
+        )
+
+        audio = AudioBatchMessage.new(
+            [AudioRef(media_id="m1", path="/a.wav")], crawl_id="c1",
+            tenant="interactive")
+        transcript = TranscriptMessage.new(
+            "m1", crawl_id="c1", batch_id="b1", text="hi",
+            tenant="bulk-reembed")
+        batch = RecordBatch.from_posts(
+            [Post(post_uid="p1", channel_id="c", channel_name="c",
+                  platform_name="telegram", description="hello")],
+            crawl_id="c1", tenant="interactive")
+        for msg, want in ((audio, "interactive"),
+                          (transcript, "bulk-reembed")):
+            decoded = decode_message(json.loads(json.dumps(msg.to_dict())))
+            assert decoded.tenant == want
+        assert RecordBatch.from_dict(
+            json.loads(json.dumps(batch.to_dict()))).tenant == "interactive"
+        # Legacy payloads (pre-tenant spools/outboxes/replay bundles)
+        # carry NO tenant key and must decode to the documented default
+        # tenant, not raise — the wire-compat clause of ISSUE 17.
+        for msg in (audio, transcript):
+            legacy = msg.to_dict()
+            legacy.pop("tenant")
+            assert decode_message(
+                json.loads(json.dumps(legacy))).tenant == DEFAULT_TENANT
+        legacy_batch = batch.to_dict()
+        legacy_batch.pop("tenant")
+        assert RecordBatch.from_dict(legacy_batch).tenant == DEFAULT_TENANT
+        # Falsy/garbage labels fold to the default instead of minting
+        # phantom tenants on /tenants.
+        assert AudioBatchMessage.new(
+            [], crawl_id="c", tenant="").tenant == DEFAULT_TENANT
+
     def test_chaos_message_roundtrip_and_fields(self):
         from distributed_crawler_tpu.bus import decode_message
 

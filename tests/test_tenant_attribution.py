@@ -1,0 +1,438 @@
+"""Tenant attribution tests (ISSUE 17): the provenance label's journey
+through the pipeline — loadgen stamping, bus round-trips (legacy
+unlabeled frames included), per-tenant SLO breach children that never
+clobber the aggregate, the cost ledger's proportional split and
+conservation, the watchtower's error-budget ledger (reset-aware burn,
+exhaustion projection), the /tenants + /logs HTTP surfaces, the gate's
+tenant key validation, and the tenant-mix-steady scenario acceptance
+(docs/operations.md "Tenant attribution & error budgets")."""
+
+import json
+import logging
+import urllib.error
+import urllib.request
+
+import pytest
+
+from distributed_crawler_tpu.bus import decode_message
+from distributed_crawler_tpu.bus.codec import RecordBatch
+from distributed_crawler_tpu.bus.messages import (
+    DEFAULT_TENANT,
+    AudioBatchMessage,
+    normalize_tenant,
+)
+from distributed_crawler_tpu.datamodel.post import Post
+from distributed_crawler_tpu.loadgen.gate import (
+    _breach_counts,
+    _tenant_breach_counts,
+    load_scenario,
+    run_scenario,
+    validate_gate_config,
+)
+from distributed_crawler_tpu.loadgen.generator import (
+    LoadGenConfig,
+    SyntheticWorkload,
+)
+from distributed_crawler_tpu.orchestrator.tenants import (
+    TenantBudgetLedger,
+    budgets_from_config,
+)
+from distributed_crawler_tpu.utils import structlog, trace
+from distributed_crawler_tpu.utils.costmodel import TenantLedger
+from distributed_crawler_tpu.utils.metrics import (
+    MetricsRegistry,
+    clear_tenants_provider,
+    serve_metrics,
+    set_tenants_provider,
+)
+from distributed_crawler_tpu.utils.slo import SLOWatchdog, standard_slos
+from distributed_crawler_tpu.utils.timeseries import TimeSeriesStore
+
+MIX = {"interactive": 0.6, "bulk-reembed": 0.4}
+
+
+def get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.read()
+
+
+# ---------------------------------------------------------------------------
+# propagation: loadgen stamping + bus round-trips
+# ---------------------------------------------------------------------------
+class TestTenantPropagation:
+    def test_plan_draws_tenants_deterministically_from_mix(self):
+        cfg = lambda: LoadGenConfig(seed=17, duration_s=4.0,
+                                    rate_batches_per_s=12, tenants=dict(MIX))
+        a = SyntheticWorkload(cfg()).plan()
+        b = SyntheticWorkload(cfg()).plan()
+        assert [pb.tenant for pb in a] == [pb.tenant for pb in b]
+        drawn = {pb.tenant for pb in a}
+        assert drawn == set(MIX)  # both tenants present in ~48 draws
+        # Roughly the configured split (seeded draw, loose bounds).
+        share = sum(pb.tenant == "interactive" for pb in a) / len(a)
+        assert 0.35 <= share <= 0.85
+
+    def test_no_mix_means_default_tenant(self):
+        wl = SyntheticWorkload(LoadGenConfig(seed=1, duration_s=1.0))
+        assert all(pb.tenant == DEFAULT_TENANT for pb in wl.plan())
+        assert wl.tenant_for(0) == DEFAULT_TENANT
+
+    def test_build_batch_stamps_tenant_onto_record_batch(self):
+        wl = SyntheticWorkload(LoadGenConfig(
+            seed=17, duration_s=2.0, tenants=dict(MIX)))
+        pb = wl.plan()[0]
+        rb = wl.build_batch(pb)
+        assert rb.tenant == pb.tenant
+        # Survives a bus round-trip (the wire dict carries the label).
+        assert RecordBatch.from_dict(rb.to_dict()).tenant == pb.tenant
+
+    def test_tail_batches_draw_the_same_tenant_by_index(self):
+        """The gate's tail batches are planned with tenant="" — the
+        deterministic by-index draw must attribute them anyway, or the
+        recovery tail would show up as unattributed spend."""
+        wl = SyntheticWorkload(LoadGenConfig(
+            seed=17, duration_s=1.0, tenants=dict(MIX)))
+        assert wl.tenant_for(10_000) in MIX
+        assert wl.tenant_for(10_000) == wl.tenant_for(10_000)
+
+    def test_legacy_unlabeled_frames_decode_to_default(self):
+        rb = RecordBatch.from_posts(
+            [Post(post_uid="p0", channel_name="c", description="text")],
+            crawl_id="c1", tenant="interactive")
+        legacy = rb.to_dict()
+        legacy.pop("tenant")
+        assert RecordBatch.from_dict(legacy).tenant == DEFAULT_TENANT
+        msg = AudioBatchMessage.new([], crawl_id="c1", tenant="interactive")
+        wire = json.loads(json.dumps(msg.to_dict()))
+        wire.pop("tenant")
+        assert decode_message(wire).tenant == DEFAULT_TENANT
+        assert normalize_tenant("") == DEFAULT_TENANT
+        assert normalize_tenant(None) == DEFAULT_TENANT
+
+
+# ---------------------------------------------------------------------------
+# SLO: per-tenant breach children next to (never instead of) the parent
+# ---------------------------------------------------------------------------
+class TestSLOTenantChildren:
+    def _dog(self, slos):
+        tracer = trace.Tracer(capacity=256)
+        reg = MetricsRegistry()
+        return SLOWatchdog(slos, tracer=tracer, registry=reg), tracer, reg
+
+    def test_children_and_parent_coexist_on_one_counter_family(self):
+        dog, tracer, reg = self._dog(standard_slos(batch_p95_ms=100.0))
+        for i in range(3):
+            tracer.record("tpu_worker.process", 0.5, trace_id=f"t{i}",
+                          tenant="interactive")
+        breaches = dog.evaluate(now=__import__("time").time() + 1)
+        assert len(breaches) == 1  # the aggregate breached too
+        text = reg.expose()
+        assert 'slo_breach_total{slo="batch_p95"} 1' in text
+        assert ('slo_breach_total{slo="batch_p95",tenant="interactive"} 1'
+                in text)
+        # The gate's two readers partition the family by exact label
+        # set: tenant children must not leak into the parent counts.
+        assert _breach_counts(reg) == {"batch_p95": 1.0}
+        assert _tenant_breach_counts(reg) == {"interactive:batch_p95": 1.0}
+        assert dog.snapshot()["tenant_breaches"] == {
+            "interactive": {"batch_p95": 1}}
+
+    def test_hot_tenant_breaches_while_aggregate_stays_green(self):
+        """One tenant busting its own p95 must be visible even when the
+        blended fleet p95 is comfortably under budget."""
+        dog, tracer, reg = self._dog(standard_slos(batch_p95_ms=100.0))
+        for i in range(20):
+            tracer.record("tpu_worker.process", 0.001, trace_id=f"f{i}",
+                          tenant="bulk-reembed")
+        tracer.record("tpu_worker.process", 0.5, trace_id="slow",
+                      tenant="interactive")
+        breaches = dog.evaluate(now=__import__("time").time() + 1)
+        assert breaches == []  # blended p95 is ~1ms
+        assert _breach_counts(reg) == {}
+        assert _tenant_breach_counts(reg) == {"interactive:batch_p95": 1.0}
+
+    def test_spans_without_tenant_attr_stay_aggregate_only(self):
+        dog, tracer, reg = self._dog(standard_slos(batch_p95_ms=100.0))
+        tracer.record("tpu_worker.process", 0.5, trace_id="t0")
+        assert len(dog.evaluate(now=__import__("time").time() + 1)) == 1
+        assert _breach_counts(reg) == {"batch_p95": 1.0}
+        assert _tenant_breach_counts(reg) == {}
+
+
+# ---------------------------------------------------------------------------
+# costmodel: proportional charge + conservation
+# ---------------------------------------------------------------------------
+class TestTenantLedgerCost:
+    def test_charge_splits_proportionally_and_conserves(self):
+        ledger = TenantLedger(MetricsRegistry())
+        ledger.charge({"interactive": 3.0, "bulk-reembed": 1.0},
+                      duration_s=2.0, flops=4e9, real_tokens=400)
+        snap = ledger.snapshot()
+        rows = {r["tenant"]: r for r in snap["rows"]}
+        assert rows["interactive"]["chip_seconds"] == pytest.approx(1.5)
+        assert rows["bulk-reembed"]["chip_seconds"] == pytest.approx(0.5)
+        assert rows["interactive"]["share"] == pytest.approx(0.75)
+        # Conservation: per-tenant rows sum back to the totals (what the
+        # gate's require_tenant_conservation asserts over /costs).
+        for key in ("chip_seconds", "flops", "real_tokens", "batches"):
+            assert sum(r[key] for r in snap["rows"]) == \
+                pytest.approx(snap["totals"][key], rel=1e-6)
+
+    def test_unweighted_dispatch_charges_nothing(self):
+        """Warmup batches predate any tenant — they must not surface as
+        unattributed spend (max_unattributed_share: 0 relies on this)."""
+        ledger = TenantLedger(MetricsRegistry())
+        ledger.charge({}, duration_s=1.0, flops=1e9, real_tokens=10)
+        ledger.charge({"interactive": 0.0}, duration_s=1.0, flops=1e9,
+                      real_tokens=10)
+        snap = ledger.snapshot()
+        assert snap["rows"] == []
+        assert snap["totals"]["chip_seconds"] == 0.0
+
+    def test_wait_only_tenant_still_gets_a_row(self):
+        ledger = TenantLedger(MetricsRegistry())
+        for w in (0.01, 0.02, 0.03):
+            ledger.observe_queue_wait("interactive", w)
+        rows = ledger.snapshot()["rows"]
+        assert rows[0]["tenant"] == "interactive"
+        assert rows[0]["chip_seconds"] == 0.0
+        assert rows[0]["queue_wait_p95_s"] == pytest.approx(0.03)
+        assert rows[0]["queue_wait_samples"] == 3
+
+
+# ---------------------------------------------------------------------------
+# watchtower: the error-budget ledger
+# ---------------------------------------------------------------------------
+class TestBudgetLedger:
+    def test_budgets_from_config_accepts_and_defaults(self):
+        budgets, window = budgets_from_config(None)
+        assert budgets == {} and window == 300.0
+        budgets, window = budgets_from_config({
+            "window_s": 60,
+            "budgets": {"interactive": {"queue_wait": 5, "batch_p95": 2}}})
+        assert window == 60.0
+        assert budgets == {"interactive": {"queue_wait": 5.0,
+                                           "batch_p95": 2.0}}
+
+    def test_budgets_from_config_is_loud_on_typos(self):
+        with pytest.raises(ValueError, match="mapping"):
+            budgets_from_config([1, 2])
+        with pytest.raises(ValueError, match="unknown tenant_budgets key"):
+            budgets_from_config({"budgetz": {}})
+        with pytest.raises(ValueError, match="window_s"):
+            budgets_from_config({"window_s": 0})
+        with pytest.raises(ValueError, match="window_s"):
+            budgets_from_config({"window_s": True})
+        with pytest.raises(ValueError, match="non-empty"):
+            budgets_from_config({"budgets": {"interactive": {}}})
+        with pytest.raises(ValueError, match="non-negative"):
+            budgets_from_config(
+                {"budgets": {"interactive": {"queue_wait": -1}}})
+        with pytest.raises(ValueError, match="non-empty tenant"):
+            budgets_from_config({"budgets": {"": {"queue_wait": 1}}})
+
+    def _seeded_ledger(self):
+        """A fresh store with two workers' spend, a counter that RESETS
+        mid-window, and a steadily-rising counter for the projection."""
+        store = TimeSeriesStore(clock=lambda: 1000.0)
+        for worker, chips in (("tpu-1", 6.0), ("tpu-2", 2.0)):
+            store.add("fleet_tenant_chip_seconds_total", chips,
+                      {"worker": worker, "tenant": "interactive"},
+                      wall=990.0)
+        store.add("fleet_tenant_chip_seconds_total", 2.0,
+                  {"worker": "tpu-1", "tenant": "bulk-reembed"}, wall=990.0)
+        for worker, p95 in (("tpu-1", 0.04), ("tpu-2", 0.09)):
+            store.add("fleet_tenant_queue_wait_p95_seconds", p95,
+                      {"worker": worker, "tenant": "interactive"},
+                      wall=990.0)
+        # interactive/queue_wait: 5 -> 8 -> RESET to 2 -> 4.  Reset-aware
+        # increase = 3 + 2 + 2 = 7 (the restart contributes its new
+        # value, not a negative refund).  Slope over the window is
+        # negative -> burn rate clamps to 0, so no exhaustion estimate.
+        for wall, v in ((930.0, 5.0), (950.0, 8.0), (970.0, 2.0),
+                        (990.0, 4.0)):
+            store.add("fleet_tenant_slo_breach_total", v,
+                      {"worker": "tpu-1", "tenant": "interactive",
+                       "slo": "queue_wait"}, wall=wall)
+        # bulk-reembed/batch_age rises 0 -> 5: burn 5, slope 0.1/s.
+        for wall, v in ((930.0, 0.0), (950.0, 1.0), (970.0, 3.0),
+                        (990.0, 5.0)):
+            store.add("fleet_tenant_slo_breach_total", v,
+                      {"worker": "tpu-1", "tenant": "bulk-reembed",
+                       "slo": "batch_age"}, wall=wall)
+        ledger = TenantBudgetLedger(store=store, clock=lambda: 1000.0)
+        ledger.configure(budgets={"interactive": {"queue_wait": 10},
+                                  "bulk-reembed": {"batch_age": 20}},
+                         window_s=60.0)
+        return ledger
+
+    def test_view_spend_burn_and_exhaustion_math(self):
+        view = self._seeded_ledger().view(now=1000.0)
+        assert view["window_s"] == 60.0
+        inter = view["tenants"]["interactive"]
+        assert inter["spend"]["chip_seconds"] == pytest.approx(8.0)
+        assert inter["spend"]["share"] == pytest.approx(0.8)
+        # Worst worker's p95, not a fleet mean.
+        assert inter["queue_wait_p95_s"] == pytest.approx(0.09)
+        cell = inter["budgets"]["queue_wait"]
+        assert cell["burned"] == pytest.approx(7.0)
+        assert cell["remaining"] == pytest.approx(3.0)
+        assert cell["exhausted"] is False
+        assert "exhaustion_s" not in cell  # negative slope clamped to 0
+        bulk = view["tenants"]["bulk-reembed"]["budgets"]["batch_age"]
+        assert bulk["burned"] == pytest.approx(5.0)
+        assert bulk["remaining"] == pytest.approx(15.0)
+        assert bulk["burn_rate_per_s"] == pytest.approx(0.1, rel=0.05)
+        assert bulk["exhaustion_s"] == pytest.approx(150.0, rel=0.05)
+        assert view["unattributed_share"] == 0.0  # no default-tenant row
+
+    def test_exhausted_budget_projects_zero(self):
+        ledger = self._seeded_ledger()
+        ledger.configure(budgets={"interactive": {"queue_wait": 6}})
+        cell = ledger.view(now=1000.0)["tenants"]["interactive"][
+            "budgets"]["queue_wait"]
+        assert cell["exhausted"] is True
+        assert cell["remaining"] == pytest.approx(-1.0)
+        assert cell["exhaustion_s"] == 0.0
+
+    def test_budget_only_tenant_appears_with_zero_spend(self):
+        store = TimeSeriesStore(clock=lambda: 1000.0)
+        ledger = TenantBudgetLedger(store=store, clock=lambda: 1000.0)
+        ledger.configure(budgets={"interactive": {"queue_wait": 5}})
+        view = ledger.view(now=1000.0)
+        row = view["tenants"]["interactive"]
+        assert row["spend"]["chip_seconds"] == 0.0
+        cell = row["budgets"]["queue_wait"]
+        assert cell["burned"] == 0.0 and cell["remaining"] == 5.0
+        assert cell["exhausted"] is False
+
+
+# ---------------------------------------------------------------------------
+# HTTP: /tenants + /logs on the metrics port
+# ---------------------------------------------------------------------------
+class TestTenantsAndLogsEndpoints:
+    def test_tenants_served_with_provider_404_without(self):
+        server = serve_metrics(0, MetricsRegistry())
+        port = server.server_address[1]
+        provider = lambda: {"tenants": {"interactive": {"spend": {}}},
+                            "unattributed_share": 0.0}
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                get(f"http://127.0.0.1:{port}/tenants")
+            assert e.value.code == 404
+            set_tenants_provider(provider)
+            try:
+                status, body = get(f"http://127.0.0.1:{port}/tenants")
+                assert status == 200
+                assert "interactive" in json.loads(body)["tenants"]
+            finally:
+                clear_tenants_provider(provider)
+            with pytest.raises(urllib.error.HTTPError) as e:
+                get(f"http://127.0.0.1:{port}/tenants")
+            assert e.value.code == 404
+        finally:
+            server.shutdown()
+
+    def test_logs_served_unconditionally_with_ring_records(self):
+        structlog.install_ring_handler()
+        logging.getLogger("dct.tenanttest").warning(
+            "tenant smoke warning %d", 17)
+        server = serve_metrics(0, MetricsRegistry())
+        port = server.server_address[1]
+        try:
+            status, body = get(f"http://127.0.0.1:{port}/logs")
+            assert status == 200
+            records = json.loads(body)["records"]
+            mine = [r for r in records
+                    if r["message"] == "tenant smoke warning 17"]
+            assert mine and mine[0]["level"] == "warning"
+            assert mine[0]["logger"] == "dct.tenanttest"
+            status, body = get(f"http://127.0.0.1:{port}/logs?limit=1")
+            assert len(json.loads(body)["records"]) == 1
+        finally:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# gate: tenant key validation
+# ---------------------------------------------------------------------------
+class TestGateTenantKeyValidation:
+    def test_tenant_keys_require_a_traffic_mix(self):
+        with pytest.raises(ValueError, match="load.tenants"):
+            validate_gate_config({"name": "x", "gate": {
+                "require_tenants": ["interactive"]}})
+        with pytest.raises(ValueError, match="load.tenants"):
+            validate_gate_config({"name": "x", "gate": {
+                "forbid_tenant_breach": {"interactive": ["queue_wait"]}}})
+
+    def test_unknown_tenant_names_rejected(self):
+        base = {"name": "x", "load": {"tenants": dict(MIX)}}
+        with pytest.raises(ValueError, match="require_tenants"):
+            validate_gate_config(
+                base | {"gate": {"require_tenants": ["interactivy"]}})
+        with pytest.raises(ValueError, match="forbid_tenant_breach"):
+            validate_gate_config(base | {"gate": {
+                "forbid_tenant_breach": {"nobody": ["queue_wait"]}}})
+
+    def test_breach_spec_shapes_rejected(self):
+        base = {"name": "x", "load": {"tenants": dict(MIX)}}
+        with pytest.raises(ValueError, match="require_tenant_breach"):
+            validate_gate_config(base | {"gate": {
+                "require_tenant_breach": ["interactive"]}})
+        with pytest.raises(ValueError, match="require_tenant_breach"):
+            validate_gate_config(base | {"gate": {
+                "require_tenant_breach": {"interactive": []}}})
+
+    def test_share_and_conservation_bounds(self):
+        base = {"name": "x", "load": {"tenants": dict(MIX)}}
+        with pytest.raises(ValueError, match="max_unattributed_share"):
+            validate_gate_config(
+                base | {"gate": {"max_unattributed_share": 1.5}})
+        with pytest.raises(ValueError, match="max_unattributed_share"):
+            validate_gate_config(
+                base | {"gate": {"max_unattributed_share": True}})
+        with pytest.raises(ValueError, match="require_tenant_conservation"):
+            validate_gate_config(
+                base | {"gate": {"require_tenant_conservation": 2.0}})
+
+    def test_bad_tenant_mix_and_budgets_are_loud(self):
+        with pytest.raises(ValueError, match="load.tenants"):
+            validate_gate_config({"name": "x", "gate": {},
+                                  "load": {"tenants": {"a": -1}}})
+        with pytest.raises(ValueError, match="x"):
+            validate_gate_config({"name": "x", "gate": {},
+                                  "tenant_budgets": {"budgetz": {}}})
+
+    def test_checked_in_tenant_scenario_validates(self):
+        validate_gate_config(load_scenario("tenant-mix-steady"))
+
+
+# ---------------------------------------------------------------------------
+# gate: end-to-end acceptance
+# ---------------------------------------------------------------------------
+class TestTenantMixSteadyAcceptance:
+    def test_tenant_mix_steady_scenario_passes(self):
+        """ISSUE 17 acceptance: the tenant-mix-steady scenario — two
+        tenants sharing one worker; bulk spend and interactive queue
+        wait separately visible on /tenants, attribution conserved
+        against /costs, nothing unattributed, no interactive
+        queue-wait breach over the whole run."""
+        verdict = run_scenario(load_scenario("tenant-mix-steady"))
+        assert verdict["status"] == "pass", verdict["checks"]
+        assert verdict["lost"] == 0 and verdict["duplicates"] == 0
+        tenants = verdict["tenants"]
+        spend = tenants["spend"]
+        assert set(MIX) <= set(spend)
+        for t in MIX:
+            assert spend[t]["chip_seconds"] > 0
+        shares = {t: spend[t]["share"] for t in MIX}
+        assert sum(shares.values()) == pytest.approx(1.0, abs=1e-6)
+        assert shares["interactive"] > shares["bulk-reembed"]
+        assert tenants["unattributed_share"] == 0.0
+        assert tenants["run_breaches"].get("interactive:queue_wait", 0) == 0
+        for name in ("tenant_conservation", "unattributed_share",
+                     "tenant_visible_interactive",
+                     "tenant_visible_bulk-reembed",
+                     "tenant_no_breach_interactive_queue_wait",
+                     "endpoint_tenants"):
+            assert verdict["checks"][name]["ok"], verdict["checks"][name]

@@ -40,6 +40,13 @@ def selfcheck() -> int:
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     pkg = os.path.join(repo, "distributed_crawler_tpu")
+    # Script-mode children (`python tools/X.py`) get the SCRIPT's dir on
+    # sys.path, not the repo root — the package only resolves with the
+    # repo on PYTHONPATH (module-mode `python -m tools.X` gets it from
+    # cwd, but the selfchecks below run the script paths).
+    script_env = {**os.environ,
+                  "PYTHONPATH": repo + os.pathsep +
+                  os.environ.get("PYTHONPATH", "")}
     if not compileall.compile_dir(pkg, quiet=1):
         print("compileall FAILED", file=sys.stderr)
         return 1
@@ -55,32 +62,32 @@ def selfcheck() -> int:
         return rc
     rc = subprocess.call(
         [sys.executable, os.path.join(repo, "tools", "postmortem.py"),
-         "--selfcheck"], cwd=repo)
+         "--selfcheck"], cwd=repo, env=script_env)
     if rc != 0:
         print("postmortem selfcheck FAILED", file=sys.stderr)
         return rc
     rc = subprocess.call(
         [sys.executable, os.path.join(repo, "tools", "perfreport.py"),
-         "--selfcheck"], cwd=repo)
+         "--selfcheck"], cwd=repo, env=script_env)
     if rc != 0:
         print("perfreport selfcheck FAILED", file=sys.stderr)
         return rc
     rc = subprocess.call(
         [sys.executable, os.path.join(repo, "tools", "critpath.py"),
-         "--selfcheck"], cwd=repo)
+         "--selfcheck"], cwd=repo, env=script_env)
     if rc != 0:
         print("critpath selfcheck FAILED", file=sys.stderr)
         return rc
     rc = subprocess.call(
         [sys.executable, os.path.join(repo, "tools", "watch.py"),
-         "--selfcheck"], cwd=repo)
+         "--selfcheck"], cwd=repo, env=script_env)
     if rc != 0:
         print("watch selfcheck FAILED", file=sys.stderr)
         return rc
     rc = subprocess.call(
         [sys.executable, os.path.join(repo, "tools", "dlq.py"),
          "--selfcheck"], cwd=repo,
-        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        env={**script_env, "JAX_PLATFORMS": "cpu"})
     if rc != 0:
         print("dlq selfcheck FAILED", file=sys.stderr)
         return rc
@@ -122,7 +129,13 @@ def selfcheck() -> int:
          # elastic fleet: autoscaler policy hysteresis, supervisors,
          # /autoscaler, and the flash-crowd gate acceptance
          # (breach -> alert -> scale-up -> converge -> scale-down).
-         os.path.join(repo, "tests", "test_autoscaler.py")],
+         os.path.join(repo, "tests", "test_autoscaler.py"),
+         # tenant attribution: label propagation across bus round-trips
+         # (legacy unlabeled frames included), per-tenant SLO/meter
+         # children, the budget ledger's burn math, /tenants + /logs,
+         # gate-key validation, and the tenant-mix-steady acceptance
+         # (ISSUE 17 closure).
+         os.path.join(repo, "tests", "test_tenant_attribution.py")],
         env=env, cwd=repo)
 
 

@@ -20,7 +20,10 @@ tools/postmortem.py.
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
+import re
 import sys
 import urllib.request
 from typing import Any, Dict, List, Optional, Tuple
@@ -133,6 +136,96 @@ def render_report(costs: Dict[str, Any], metrics_text: str = "",
     return "\n".join(lines)
 
 
+# --- bench trend (--trend) --------------------------------------------------
+# The repo's bench harness appends one BENCH_r<NN>.json per recorded run
+# ({"n", "cmd", "rc", "tail", ...}); the mesh-scaling rows live as
+# "[bench] mesh scaling n=<K>: <X> posts/sec" lines in the captured tail.
+
+_BENCH_ROW = re.compile(
+    r"\[bench\] mesh scaling n=(\d+): ([0-9.]+) posts/sec")
+
+# A row this much below the previous successful run is flagged — the
+# same >10%-down threshold the SLO gate uses for goodput regressions.
+_TREND_REGRESSION_FRACTION = 0.10
+
+
+def parse_bench_run(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """One BENCH_r*.json -> {"n", "rc", "rows": {mesh_size: posts/sec}}.
+    Failed runs (nonzero rc, e.g. a broken toolchain that morning) parse
+    to empty rows rather than aborting the whole trend."""
+    rows: Dict[int, float] = {}
+    if doc.get("rc") == 0:
+        for m in _BENCH_ROW.finditer(doc.get("tail") or ""):
+            rows[int(m.group(1))] = float(m.group(2))
+    return {"n": doc.get("n"), "rc": doc.get("rc"), "rows": rows}
+
+
+def render_trend(runs: List[Dict[str, Any]]) -> str:
+    """Row-by-row trend across bench runs: every mesh size that appears
+    anywhere gets a column, each successive successful run is compared
+    to the previous successful one (absolute delta + percent), and a
+    drop past the regression threshold is flagged loudly."""
+    runs = sorted(runs, key=lambda r: (r.get("n") is None, r.get("n")))
+    sizes = sorted({k for r in runs for k in r["rows"]})
+    lines: List[str] = [f"bench trend ({len(runs)} runs):"]
+    if not runs:
+        return lines[0] + "\n  (no BENCH_r*.json runs found)"
+    header = f"  {'run':>5}  {'rc':>3}"
+    for k in sizes:
+        header += f"  {f'n={k}':>12}"
+    lines.append(header)
+    prev_ok: Optional[Dict[str, Any]] = None
+    regressions: List[str] = []
+    for r in runs:
+        label = f"r{r['n']:02d}" if isinstance(r.get("n"), int) else "r??"
+        line = f"  {label:>5}  {r.get('rc', '?'):>3}"
+        if r.get("rc") != 0:
+            line += "  (failed run — no rows)"
+            lines.append(line)
+            continue
+        for k in sizes:
+            v = r["rows"].get(k)
+            if v is None:
+                line += f"  {'-':>12}"
+                continue
+            cell = f"{v:.1f}"
+            if prev_ok is not None and prev_ok["rows"].get(k):
+                base = prev_ok["rows"][k]
+                pct = (v - base) / base * 100.0
+                cell += f" {pct:+.1f}%"
+                if (base - v) / base > _TREND_REGRESSION_FRACTION:
+                    cell += "!"
+                    regressions.append(
+                        f"  REGRESSION n={k}: {base:.1f} -> {v:.1f} "
+                        f"posts/sec ({pct:+.1f}%) between "
+                        f"r{prev_ok['n']:02d} and {label}")
+            line += f"  {cell:>12}"
+        lines.append(line)
+        prev_ok = r
+    if regressions:
+        lines.append("")
+        lines.extend(regressions)
+    else:
+        lines.append(
+            f"  no row down more than "
+            f"{_TREND_REGRESSION_FRACTION:.0%} vs its previous "
+            f"successful run")
+    return "\n".join(lines)
+
+
+def load_trend(directory: str) -> List[Dict[str, Any]]:
+    runs: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "BENCH_r*.json"))):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                runs.append(parse_bench_run(json.load(f)))
+        except (OSError, ValueError) as e:
+            print(f"warning: skipping unreadable {path}: {e}",
+                  file=sys.stderr)
+    return runs
+
+
 def _fetch(url: str, as_json: bool = True):
     with urllib.request.urlopen(url, timeout=10) as resp:
         return json.load(resp) if as_json else \
@@ -198,6 +291,27 @@ def selfcheck() -> int:
     empty = render_report({"worker_id": "w", "costs": [],
                            "efficiency": {}, "slo": {}})
     assert "no batches" in empty and "pre-warmup" in empty, empty
+    # --trend: a failed run is tolerated (no rows), row-by-row deltas
+    # compare successive SUCCESSFUL runs, and a >10%-down row is flagged.
+    runs = [
+        parse_bench_run({"n": 1, "rc": 1, "tail": "Traceback ..."}),
+        parse_bench_run({"n": 2, "rc": 0, "tail":
+                         "[bench] mesh scaling n=1: 12.8 posts/sec\n"
+                         "[bench] mesh scaling n=2: 11.3 posts/sec\n"}),
+        parse_bench_run({"n": 3, "rc": 0, "tail":
+                         "[bench] mesh scaling n=1: 13.0 posts/sec\n"
+                         "[bench] mesh scaling n=2: 9.1 posts/sec\n"}),
+    ]
+    assert runs[0]["rows"] == {}, runs[0]
+    trend = render_trend(runs)
+    assert "failed run" in trend, trend
+    assert "+1.6%" in trend, trend
+    assert "REGRESSION n=2" in trend and "-19.5%" in trend, trend
+    steady = render_trend(runs[:2])
+    assert "REGRESSION" not in steady, steady
+    assert "no row down more than 10%" in render_trend(runs[:2]), steady
+    assert "(no BENCH_r*.json runs found)" in render_trend([]), \
+        render_trend([])
     print("perfreport selfcheck ok")
     return 0
 
@@ -211,10 +325,19 @@ def main(argv=None) -> int:
                         "http://127.0.0.1:9102), or a /costs JSON path")
     p.add_argument("--selfcheck", action="store_true",
                    help="render synthetic data and exit (CI smoke)")
+    p.add_argument("--trend", nargs="?", const=".", default=None,
+                   metavar="DIR",
+                   help="compare every BENCH_r*.json run in DIR (default "
+                        "cwd) row by row: per-mesh-size delta + percent "
+                        "vs the previous successful run, >10%%-down rows "
+                        "flagged as regressions")
     args = p.parse_args(argv)
 
     if args.selfcheck:
         return selfcheck()
+    if args.trend is not None:
+        print(render_trend(load_trend(args.trend)))
+        return 0
     if not args.source:
         p.error("source required (worker base URL or /costs JSON path)")
     try:

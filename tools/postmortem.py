@@ -84,6 +84,16 @@ def render_bundle(bundle: Dict[str, Any]) -> str:
         lines.append("")
         lines.append("what the autoscaler did before the crash:")
         lines.extend(scale_lines)
+    tenant_lines = _tenants_digest(bundle.get("tenants") or {})
+    if tenant_lines:
+        lines.append("")
+        lines.append("who was spending the chips (per-tenant ledger):")
+        lines.extend(tenant_lines)
+    log_lines = _logs_digest(bundle.get("logs") or {})
+    if log_lines:
+        lines.append("")
+        lines.append("last WARNING+ log records (oldest first):")
+        lines.extend(log_lines)
     trend_lines = _trend_digest(bundle.get("timeseries") or {})
     if trend_lines:
         lines.append("")
@@ -174,6 +184,60 @@ def _alert_digest(alerts: Dict[str, Any]) -> List[str]:
         out.append(f"  {rel:>9.3f}s  {e.get('rule', '?'):<28} "
                    f"{e.get('from', '?')} -> {e.get('to', '?')}"
                    + (f"  value={value}" if value is not None else ""))
+    return out
+
+
+def _tenants_digest(tenants: Dict[str, Any]) -> List[str]:
+    """The bundled /tenants body: spend share per tenant plus the
+    error-budget ledger (burned / remaining / projected exhaustion) —
+    the "which workload was eating the chips, and whose budget was
+    gone" half of a crash autopsy."""
+    rows = tenants.get("tenants") or {}
+    if not rows:
+        return []
+    out: List[str] = []
+    unattrib = tenants.get("unattributed_share")
+    if unattrib:
+        out.append(f"  unattributed share: {unattrib}")
+    for name in sorted(rows):
+        entry = rows[name] or {}
+        spend = entry.get("spend") or {}
+        line = (f"  {name:<20} share={spend.get('share', 0.0):.3f}  "
+                f"chip_s={spend.get('chip_seconds', 0.0):.3f}  "
+                f"batches={spend.get('batches', 0.0):.0f}")
+        qw = entry.get("queue_wait_p95_s")
+        if qw is not None:
+            line += f"  queue_wait_p95={qw * 1000.0:.1f}ms"
+        out.append(line)
+        for slo, cell in sorted((entry.get("budgets") or {}).items()):
+            detail = f"    budget {slo}: burned={cell.get('burned', 0)}"
+            if cell.get("budget") is not None:
+                detail += (f" of {cell['budget']}"
+                           f" (remaining={cell.get('remaining')})")
+            if cell.get("exhausted"):
+                detail += "  EXHAUSTED"
+            elif cell.get("exhaustion_s") is not None:
+                detail += f"  exhausts in ~{cell['exhaustion_s']}s"
+            out.append(detail)
+    return out
+
+
+def _logs_digest(logs: Dict[str, Any], limit: int = 20) -> List[str]:
+    """The bundled /logs ring (last WARNING+ structured records): level,
+    logger, message, and the trace id that stitches a record to the
+    span ring's story."""
+    records = logs.get("records") or []
+    out: List[str] = []
+    t_end = max((float(r.get("ts", 0.0)) for r in records), default=0.0)
+    for r in records[-limit:]:
+        rel = float(r.get("ts", 0.0)) - t_end
+        line = (f"  {rel:>9.3f}s  {r.get('level', '?'):<8} "
+                f"{r.get('logger', '?')}: {r.get('message', '')}")
+        if r.get("trace_id"):
+            line += f"  trace={r['trace_id']}"
+        if r.get("error"):
+            line += f"  error={r['error']}"
+        out.append(line)
     return out
 
 
@@ -323,8 +387,54 @@ def selfcheck() -> int:
              "to": 3, "reason": "queue_wait_burn"},
         ],
     }
+    bundle["tenants"] = {
+        "default_tenant": "default",
+        "unattributed_share": 0.0,
+        "tenants": {
+            "interactive": {
+                "spend": {"chip_seconds": 1.25, "share": 0.625,
+                          "batches": 40.0},
+                "queue_wait_p95_s": 0.012,
+                "budgets": {"queue_wait": {
+                    "burned": 3.0, "budget": 5.0, "remaining": 2.0,
+                    "exhausted": False, "burn_rate_per_s": 0.05,
+                    "exhaustion_s": 40.0}}},
+            "bulk-reembed": {
+                "spend": {"chip_seconds": 0.75, "share": 0.375,
+                          "batches": 24.0},
+                "budgets": {"queue_wait": {
+                    "burned": 9.0, "budget": 5.0, "remaining": -4.0,
+                    "exhausted": True, "exhaustion_s": 0.0}}},
+        },
+    }
+    bundle["logs"] = {"records": [
+        {"level": "WARNING", "ts": 99.0, "logger": "dct.worker",
+         "message": "queue past capacity", "trace_id": "t1"},
+        {"level": "ERROR", "ts": 100.0, "logger": "dct.bus",
+         "message": "publish failed", "error": "ConnectionError"},
+    ]}
     out = render_bundle(bundle)
     assert "selfcheck" in out and "worker_offline" in out, out
+    assert "who was spending the chips" in out, out
+    assert "interactive" in out and "share=0.625" in out, out
+    assert "queue_wait_p95=12.0ms" in out, out
+    assert "EXHAUSTED" in out and "exhausts in ~40.0s" in out, out
+    assert "last WARNING+ log records" in out, out
+    assert "publish failed" in out and "trace=t1" in out, out
+    assert "error=ConnectionError" in out, out
+    # A quiet process bundles NEITHER surface, and neither header leaks.
+    # Detach the process-wide log ring first: inside a long-lived host
+    # (the test suite, an operator REPL) it already holds WARNING+
+    # records from unrelated work, and bundle() would embed them.
+    from distributed_crawler_tpu.utils import structlog as _structlog
+
+    detached = _structlog.uninstall_ring_handler()
+    try:
+        quiet = render_bundle(rec.bundle("quiet"))
+    finally:
+        _structlog.reinstall_ring_handler(detached)
+    assert "spending the chips" not in quiet, quiet
+    assert "WARNING+" not in quiet, quiet
     assert "queue_wait_burn" in out and "FIRING at dump time" in out, out
     assert "fleet_queue_depth" in out and "1 -> 30" in out, out
     assert "what the autoscaler did before the crash" in out, out

@@ -42,9 +42,9 @@ import urllib.request
 from typing import Any, Dict, List, Optional
 
 try:  # script mode (`python tools/watch.py`): tools/ is on sys.path
-    from postmortem import ranked_movers, sparkline
+    from postmortem import SPARK_BLOCKS, ranked_movers, sparkline
 except ImportError:  # module mode (`import tools.watch`)
-    from tools.postmortem import ranked_movers, sparkline
+    from tools.postmortem import SPARK_BLOCKS, ranked_movers, sparkline
 
 REFRESH_S = 2.0
 _STATE_ORDER = {"firing": 0, "pending": 1, "resolved": 2, "inactive": 3}
@@ -74,6 +74,24 @@ def _series_values(tseries: Dict[str, Any], name: str,
     return []
 
 
+def _tenant_series_values(tseries: Dict[str, Any], name: str,
+                          tenant: str) -> List[float]:
+    """Sample values of one tenant-labeled series (the longest-history
+    worker's, when several workers carry the same tenant), oldest
+    first."""
+    best: List[float] = []
+    for s in (tseries.get("series") or {}).values():
+        if s.get("name") != name:
+            continue
+        if (s.get("labels") or {}).get("tenant") != tenant:
+            continue
+        vals = [float(p[1]) for p in (s.get("samples") or [])
+                if isinstance(p, (list, tuple)) and len(p) >= 2]
+        if len(vals) > len(best):
+            best = vals
+    return best
+
+
 def _fmt_age(since: Any, now: float) -> str:
     try:
         age = now - float(since)
@@ -90,7 +108,8 @@ def render_dashboard(cluster: Optional[Dict[str, Any]],
                      now: Optional[float] = None,
                      autoscaler: Optional[Dict[str, Any]] = None,
                      clusters: Optional[Dict[str, Any]] = None,
-                     shards: Optional[Dict[str, Any]] = None) -> str:
+                     shards: Optional[Dict[str, Any]] = None,
+                     tenants: Optional[Dict[str, Any]] = None) -> str:
     now = time.time() if now is None else now
     cluster = cluster or {}
     alerts = alerts or {}
@@ -98,6 +117,7 @@ def render_dashboard(cluster: Optional[Dict[str, Any]],
     autoscaler = autoscaler or {}
     clusters = clusters or {}
     shards = shards or {}
+    tenants = tenants or {}
     lines: List[str] = []
 
     fleet = cluster.get("fleet") or {}
@@ -203,6 +223,48 @@ def render_dashboard(cluster: Optional[Dict[str, Any]],
                 f"{parked:>7} {queued:>7} {routed:>8}  "
                 f"{s.get('address') or '-':<22}{mark}")
 
+    # --- tenants panel (/tenants; orchestrator/tenants.py) -----------------
+    tenant_rows = tenants.get("tenants") or {}
+    if tenant_rows:
+        unattrib = float(tenants.get("unattributed_share") or 0.0)
+        lines.append("")
+        lines.append(
+            f"tenants — {len(tenant_rows)} attributed, "
+            f"unattributed {unattrib * 100:.1f}% "
+            f"(budget window {tenants.get('window_s', '?')}s)")
+        lines.append(f"  {'tenant':<20} {'share':>6} {'chip_s':>8} "
+                     f"{'queue-wait trend':<18} {'p95':>9}")
+        bar_w = 12
+        for tname in sorted(tenant_rows):
+            entry = tenant_rows[tname] or {}
+            spend = entry.get("spend") or {}
+            trend = sparkline(_tenant_series_values(
+                tseries, "fleet_tenant_queue_wait_p95_seconds", tname), 18)
+            qw = entry.get("queue_wait_p95_s")
+            lines.append(
+                f"  {tname:<20} {spend.get('share', 0.0) * 100:>5.1f}% "
+                f"{spend.get('chip_seconds', 0.0):>8.3f} "
+                f"{trend or '-':<18} "
+                f"{f'{qw * 1000.0:.1f}ms' if qw is not None else '-':>9}")
+            for slo, cell in sorted((entry.get("budgets") or {}).items()):
+                budget = cell.get("budget")
+                if budget is None:
+                    lines.append(f"    {slo:<18} burned="
+                                 f"{cell.get('burned', 0)} (no budget)")
+                    continue
+                frac = max(0.0, min(1.0, float(cell.get("remaining", 0.0))
+                                    / budget)) if budget > 0 else 0.0
+                bar = "#" * int(round(frac * bar_w))
+                if cell.get("exhausted"):
+                    mark = "  <-- EXHAUSTED"
+                elif cell.get("exhaustion_s") is not None:
+                    mark = f"  exhausts ~{cell['exhaustion_s']:.0f}s"
+                else:
+                    mark = ""
+                lines.append(
+                    f"    {slo:<18} [{bar:<{bar_w}}] remaining "
+                    f"{cell.get('remaining', 0)}/{budget}{mark}")
+
     # --- clusters panel (/clusters; cluster/worker.py) ---------------------
     sizes = clusters.get("sizes") or []
     if sizes:
@@ -290,7 +352,8 @@ def render_once(base_url: str) -> str:
                             _fetch(base_url, "/timeseries"),
                             autoscaler=_fetch(base_url, "/autoscaler"),
                             clusters=_fetch(base_url, "/clusters"),
-                            shards=_fetch(base_url, "/shards"))
+                            shards=_fetch(base_url, "/shards"),
+                            tenants=_fetch(base_url, "/tenants"))
 
 
 def selfcheck() -> int:
@@ -383,9 +446,44 @@ def selfcheck() -> int:
                       "pending": {"tpu-inference-batches": 1}},
         },
     }
+    tseries["series"]["fleet_tenant_queue_wait_p95_seconds"
+                      "{tenant=interactive,worker=tpu-1}"] = {
+        "name": "fleet_tenant_queue_wait_p95_seconds",
+        "labels": {"tenant": "interactive", "worker": "tpu-1"},
+        "samples": [[now - 30 + i, 0.005 + 0.001 * i]
+                    for i in range(30)]}
+    tenants = {
+        "window_s": 60, "default_tenant": "default",
+        "unattributed_share": 0.05,
+        "tenants": {
+            "interactive": {
+                "spend": {"chip_seconds": 1.25, "share": 0.625,
+                          "batches": 40.0},
+                "queue_wait_p95_s": 0.012,
+                "budgets": {"queue_wait": {
+                    "burned": 3.0, "budget": 5.0, "remaining": 2.0,
+                    "exhausted": False, "exhaustion_s": 40.0}}},
+            "bulk-reembed": {
+                "spend": {"chip_seconds": 0.75, "share": 0.375,
+                          "batches": 24.0},
+                "budgets": {"queue_wait": {
+                    "burned": 9.0, "budget": 5.0, "remaining": -4.0,
+                    "exhausted": True, "exhaustion_s": 0.0}}},
+        },
+    }
     out = render_dashboard(cluster, alerts, tseries, now=now,
                            autoscaler=autoscaler, clusters=clusters,
-                           shards=shards)
+                           shards=shards, tenants=tenants)
+    assert "tenants — 2 attributed" in out, out
+    assert "unattributed 5.0%" in out, out
+    assert "interactive" in out and "62.5%" in out, out
+    assert "12.0ms" in out, out  # per-tenant queue-wait p95 cell
+    assert "remaining 2.0/5.0" in out and "exhausts ~40s" in out, out
+    assert "<-- EXHAUSTED" in out, out
+    # The trend cell pools the rolling store's tenant-labeled series.
+    tenant_line = next(ln for ln in out.splitlines()
+                       if ln.strip().startswith("interactive"))
+    assert any(ch in tenant_line for ch in SPARK_BLOCKS), tenant_line
     assert "FIRING" in out and "queue_wait_burn" in out, out
     assert "tpu-1" in out and "crawl-1" in out and "STALE" in out, out
     assert "burn rule" in out and "14.2" in out, out
