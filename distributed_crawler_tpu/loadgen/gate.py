@@ -107,6 +107,9 @@ _GATE_KEYS_SHARED = frozenset({
     "queue_wait_p95_ms", "require_flight",
     "min_device_busy_fraction", "min_overlap_fraction", "max_bubble_share",
     "min_dtrace_processes", "max_clock_skew_ms",
+    # Runtime lock-order witness (utils/lockwitness.py): the run must
+    # add zero held→acquired cycles to the process lock-order graph.
+    "forbid_lock_cycles",
 })
 _GATE_KEYS_TEXT = _GATE_KEYS_SHARED | {
     "batch_p95_ms", "goodput_min_posts_per_s", "orchestrator_reconcile",
@@ -148,6 +151,50 @@ _GATE_KEYS_CLUSTER = (_GATE_KEYS_SHARED - {
 
 _SCALE_DIRECTIONS = ("up", "down")
 _SCALE_PHASES = ("fault", "recovery", "any")
+
+
+def _lockwitness_begin(gate_cfg: Dict[str, Any]) -> Optional[int]:
+    """Witness-on-chaos-run seam (ISSUE 18).  ``forbid_lock_cycles``
+    turns the runtime lock-order witness on for this run — installing
+    the creation-site interposition if the process hasn't already (every
+    lock the scenario's workers/orchestrator/bus create from here on is
+    graphed) — and snapshots the cycle count so the verdict judges only
+    cycles witnessed DURING the scenario.  Returns that snapshot, or
+    None when the key is absent (zero overhead: nothing is patched)."""
+    if not gate_cfg.get("forbid_lock_cycles"):
+        return None
+    from ..utils import lockwitness
+    lockwitness.install()
+    return lockwitness.WITNESS.cycle_count()
+
+
+def _lockwitness_checks(check, cycles_before: Optional[int]
+                        ) -> Optional[Dict[str, Any]]:
+    """Verdict half of the witness seam: the ``lock_cycles`` gate key
+    plus the summary block for the verdict JSON.  No-op (returns None)
+    when _lockwitness_begin declined to arm."""
+    if cycles_before is None:
+        return None
+    from ..utils import lockwitness
+    rep = lockwitness.WITNESS.report()
+    new_cycles = int(rep["cycle_count"]) - cycles_before
+    check("lock_cycles", new_cycles == 0, new_cycles,
+          "0 new lock-order cycles (lockwitness)")
+    out_path = os.environ.get("CRAWLINT_LOCKWITNESS_OUT", "")
+    if out_path:
+        # Full witness dump (stacks included) for
+        # `tools/analyze --lock-report`; the verdict keeps the summary.
+        lockwitness.WITNESS.dump(out_path)
+    return {
+        "new_cycles": new_cycles,
+        "cycles": rep["cycle_count"],
+        "cycle_sites": [c["sites"] for c in rep["cycles"]],
+        "instrumented_sites": rep["instrumented_sites"],
+        "acquisitions": rep["acquisitions"],
+        "edges": rep["edge_count"],
+        "blocking_under_lock": rep["blocking_count"],
+        "hold_budget_breaches": rep["breach_count"],
+    }
 
 
 def validate_gate_config(scenario: Dict[str, Any]) -> None:
@@ -1160,6 +1207,7 @@ def run_scenario(scenario: Dict[str, Any],
                  if k in _WORKER_KEYS}
     worker_name = worker_kw.pop("worker_id", "tpu-1")
     gate_cfg = scenario.get("gate", {})
+    witness_cycles0 = _lockwitness_begin(gate_cfg)
     drain_timeout_s = float(scenario.get("drain_timeout_s", 30.0))
 
     # Process-wide observability: the gate owns the span ring and the
@@ -2102,6 +2150,7 @@ def run_scenario(scenario: Dict[str, Any],
         for key in endpoint_keys:
             check(f"endpoint_{key}", endpoints[key] is not None,
                   endpoints[key] is not None, True)
+        lockwitness_summary = _lockwitness_checks(check, witness_cycles0)
 
         stats = stats_box.get("stats")
         verdict.update({
@@ -2113,6 +2162,7 @@ def run_scenario(scenario: Dict[str, Any],
                 "dropped_batches": len(chaos_bus.dropped),
                 "poisoned_batches": len(chaos_bus.poisoned),
             },
+            "lockwitness": lockwitness_summary,
             "expected_records": len(expected),
             "processed_records": processed,
             "lost": len(lost),
@@ -2349,6 +2399,7 @@ def run_asr_scenario(scenario: Dict[str, Any],
                           "span_export_max_spans", "span_sample_rate")}
     worker_name = worker_kw.pop("worker_id", "asr-1")
     gate_cfg = scenario.get("gate", {})
+    witness_cycles0 = _lockwitness_begin(gate_cfg)
     drain_timeout_s = float(scenario.get("drain_timeout_s", 30.0))
 
     trace.configure(capacity=int(scenario.get("trace_buffer", 8192)))
@@ -2641,6 +2692,7 @@ def run_asr_scenario(scenario: Dict[str, Any],
         for key in ("metrics", "costs", "dtraces"):
             check(f"endpoint_{key}", endpoints[key] is not None,
                   endpoints[key] is not None, True)
+        lockwitness_summary = _lockwitness_checks(check, witness_cycles0)
 
         stats = stats_box.get("stats")
         verdict.update({
@@ -2652,6 +2704,7 @@ def run_asr_scenario(scenario: Dict[str, Any],
                 "dropped_batches": len(chaos_bus.dropped),
                 "poisoned_batches": len(chaos_bus.poisoned),
             },
+            "lockwitness": lockwitness_summary,
             "expected_media": len(expected),
             "processed_media": processed,
             "lost": len(lost),
@@ -2799,6 +2852,7 @@ def run_cluster_scenario(scenario: Dict[str, Any],
                   if k in _CLUSTER_WORKER_KEYS}
     cluster_name = cluster_kw.pop("worker_id", "cluster-1")
     gate_cfg = scenario.get("gate", {})
+    witness_cycles0 = _lockwitness_begin(gate_cfg)
     drain_timeout_s = float(scenario.get("drain_timeout_s", 30.0))
 
     trace.configure(capacity=int(scenario.get("trace_buffer", 8192)))
@@ -3137,6 +3191,7 @@ def run_cluster_scenario(scenario: Dict[str, Any],
                     "timeseries"):
             check(f"endpoint_{key}", endpoints[key] is not None,
                   endpoints[key] is not None, True)
+        lockwitness_summary = _lockwitness_checks(check, witness_cycles0)
 
         stats = stats_box.get("stats")
         verdict.update({
@@ -3148,6 +3203,7 @@ def run_cluster_scenario(scenario: Dict[str, Any],
                 "dropped_batches": len(chaos_bus.dropped),
                 "poisoned_batches": len(chaos_bus.poisoned),
             },
+            "lockwitness": lockwitness_summary,
             "expected_records": len(expected),
             "embedded_records": sum(min(c, 1) for u, c in embedded.items()
                                     if u in expected_set),

@@ -30,3 +30,58 @@ try:
         pass  # backend already initialized (flag took effect) or old jax
 except ImportError:  # jax-less env: non-TPU tests still collect and run
     pass
+
+# Runtime lock-order witness (ISSUE 18): CRAWLINT_LOCKWITNESS=1 arms the
+# creation-site interposition HERE — at conftest import, before any
+# package module is imported — so every lock the suite's workers,
+# brokers, and registries create is graphed.  The package __init__ chain
+# above this import is docstring-only, so no package lock predates it.
+if os.environ.get("CRAWLINT_LOCKWITNESS", "") == "1":
+    from distributed_crawler_tpu.utils import lockwitness as _lockwitness
+
+    _lockwitness.install()
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--lockwitness", action="store_true", default=False,
+        help="arm the runtime lock-order witness "
+             "(distributed_crawler_tpu/utils/lockwitness.py) for this "
+             "run; equivalent to CRAWLINT_LOCKWITNESS=1 but later — "
+             "module-level locks of already-imported modules are not "
+             "wrapped")
+
+
+def pytest_configure(config):
+    if config.getoption("--lockwitness"):
+        from distributed_crawler_tpu.utils import lockwitness
+
+        lockwitness.install()
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    from distributed_crawler_tpu.utils import lockwitness
+
+    if not lockwitness.enabled():
+        return
+    terminalreporter.write_line(lockwitness.WITNESS.summary_line())
+    out = os.environ.get("CRAWLINT_LOCKWITNESS_OUT", "")
+    if out:
+        lockwitness.WITNESS.dump(out)
+        terminalreporter.write_line(
+            f"lockwitness: report written to {out} "
+            "(render: python -m tools.analyze --lock-report)")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """CRAWLINT_LOCKWITNESS_STRICT=1: a witnessed lock-order cycle fails
+    the session even when every test passed."""
+    if os.environ.get("CRAWLINT_LOCKWITNESS_STRICT", "") != "1":
+        return
+    from distributed_crawler_tpu.utils import lockwitness
+
+    if lockwitness.enabled() and lockwitness.WITNESS.cycle_count() > 0:
+        try:
+            session.exitstatus = 1
+        except Exception:
+            pass

@@ -583,6 +583,283 @@ class TestEXC:
 
 
 # ---------------------------------------------------------------------------
+# ATM — atomic-persistence discipline
+# ---------------------------------------------------------------------------
+
+class TestATM:
+    def test_bare_persistent_write_flagged(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            import json
+
+            def save_state(path, obj):
+                with open(path + ".checkpoint.json", "w") as f:
+                    json.dump(obj, f)
+        """}, select=["ATM"])
+        assert codes(rep) == ["ATM001"]
+        assert rep.findings[0].context == "save_state"
+
+    def test_tmp_plus_replace_is_clean(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            import json
+            import os
+
+            def save_state(path, obj):
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(obj, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+        """}, select=["ATM"])
+        assert codes(rep) == []
+
+    def test_rename_anywhere_in_scope_exempts(self, tmp_path):
+        # The final-path open itself is allowed when the same scope does
+        # the rename dance (naming conventions for the tmp half vary).
+        rep = analyze(tmp_path, {"a.py": """
+            import os
+
+            def rotate(snapshot_path, staged):
+                with open(snapshot_path, "wb") as f:
+                    f.write(staged)
+                os.rename(snapshot_path, snapshot_path + ".done")
+        """}, select=["ATM"])
+        assert codes(rep) == []
+
+    def test_append_mode_wal_is_clean(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            def append_record(wal_path, rec):
+                with open(wal_path, "a") as f:
+                    f.write(rec + "\\n")
+        """}, select=["ATM"])
+        assert codes(rep) == []
+
+    def test_non_persistent_path_is_clean(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            def dump_log(log_path, lines):
+                with open(log_path, "w") as f:
+                    f.writelines(lines)
+        """}, select=["ATM"])
+        assert codes(rep) == []
+
+    def test_atomic_helper_delegation_exempts(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            from mylib import atomic_write
+
+            def save(state_path, blob):
+                atomic_write(state_path, blob)
+        """}, select=["ATM"])
+        assert codes(rep) == []
+
+    def test_mode_keyword_and_dynamic_mode(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            def save(manifest_path, blob, mode):
+                with open(manifest_path, mode=\"wb\") as f:
+                    f.write(blob)
+
+            def save_dyn(manifest_path, blob, mode):
+                with open(manifest_path, mode) as f:   # dynamic: not ours
+                    f.write(blob)
+        """}, select=["ATM"])
+        assert codes(rep) == ["ATM001"]
+        assert rep.findings[0].context == "save"
+
+
+# ---------------------------------------------------------------------------
+# CFG — unknown-key-loud config parsers
+# ---------------------------------------------------------------------------
+
+class TestCFG:
+    def test_accept_and_ignore_parser_flagged(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            def pool_from_config(raw):
+                size = raw.get("size", 1)
+                burst = raw.get("burst", 0)
+                return size + burst
+        """}, select=["CFG"])
+        assert codes(rep) == ["CFG001"]
+        assert rep.findings[0].context == "pool_from_config"
+
+    def test_unknown_key_raise_is_clean(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            def pool_from_config(raw):
+                unknown = set(raw) - {"size"}
+                if unknown:
+                    raise ValueError(f"unknown keys: {unknown}")
+                return raw.get("size", 1)
+        """}, select=["CFG"])
+        assert codes(rep) == []
+
+    def test_delegating_parser_is_clean(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            def rules_from_config(raw):
+                return [Rule.from_dict(r) for r in raw.get("rules", [])]
+        """}, select=["CFG"])
+        assert codes(rep) == []
+
+    def test_subscript_read_without_raise_flagged(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            def limits_from_config(block):
+                return block["burst"], block.get("rate")
+        """}, select=["CFG"])
+        assert codes(rep) == ["CFG001"]
+
+    def test_validator_probing_non_param_dict_is_clean(self, tmp_path):
+        # .get() on a computed map, not on a parameter: out of scope.
+        rep = analyze(tmp_path, {"a.py": """
+            def validate_channel(username):
+                resp = fetch(username)
+                return resp.get("ok", False)
+        """}, select=["CFG"])
+        assert codes(rep) == []
+
+
+# ---------------------------------------------------------------------------
+# MET — cross-file metric-name collisions
+# ---------------------------------------------------------------------------
+
+class TestMET:
+    def test_two_module_bare_writers_collide(self, tmp_path):
+        rep = analyze(tmp_path, {
+            "a.py": """
+                class A:
+                    def __init__(self, registry):
+                        self.depth = registry.gauge("queue_depth", "d")
+
+                    def tick(self):
+                        self.depth.set(1.0)
+            """,
+            "b.py": """
+                class B:
+                    def __init__(self, registry):
+                        self.depth = registry.gauge("queue_depth", "d")
+
+                    def tick(self):
+                        self.depth.set(2.0)
+            """}, select=["MET"])
+        assert codes(rep) == ["MET001", "MET001"]
+        assert {f.context for f in rep.findings} == {"queue_depth"}
+        # each finding names the other construction site
+        assert "b.py" in rep.findings[0].message
+        assert "a.py" in rep.findings[1].message
+
+    def test_labeled_children_are_sanctioned(self, tmp_path):
+        rep = analyze(tmp_path, {
+            "a.py": """
+                class A:
+                    def __init__(self, registry):
+                        self.errs = registry.counter("errors_total", "e")
+
+                    def boom(self):
+                        self.errs.labels(component="a").inc()
+            """,
+            "b.py": """
+                def boom(registry):
+                    registry.counter("errors_total", "e").labels(
+                        component="b").inc()
+            """}, select=["MET"])
+        assert codes(rep) == []
+
+    def test_writer_plus_reader_is_clean(self, tmp_path):
+        rep = analyze(tmp_path, {
+            "a.py": """
+                class A:
+                    def __init__(self, registry):
+                        self.depth = registry.gauge("queue_depth", "d")
+
+                    def tick(self):
+                        self.depth.set(1.0)
+            """,
+            "b.py": """
+                def snapshot(registry):
+                    return registry.gauge("queue_depth", "d").value()
+            """}, select=["MET"])
+        assert codes(rep) == []
+
+    def test_same_module_twice_is_clean(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            class A:
+                def __init__(self, registry):
+                    self.d1 = registry.gauge("queue_depth", "d")
+                    self.d2 = registry.gauge("queue_depth", "d")
+
+                def tick(self):
+                    self.d1.set(1.0)
+                    self.d2.set(2.0)
+        """}, select=["MET"])
+        assert codes(rep) == []
+
+
+# ---------------------------------------------------------------------------
+# ACK — ack-before-writeback ordering
+# ---------------------------------------------------------------------------
+
+class TestACK:
+    def test_ack_then_writeback_flagged(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            class H:
+                def handle(self, batch, ack):
+                    ack(True)
+                    self._write_rows(batch)
+        """}, select=["ACK"])
+        assert codes(rep) == ["ACK001"]
+        assert rep.findings[0].context == "H.handle"
+        assert "_write_rows" in rep.findings[0].message
+
+    def test_commit_then_ack_is_clean(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            class H:
+                def handle(self, batch):
+                    self._commit(batch)
+                    self._ack(batch, True)
+        """}, select=["ACK"])
+        assert codes(rep) == []
+
+    def test_early_ack_empty_batch_idiom_is_clean(self, tmp_path):
+        # The legitimate shape: ack-and-bail inside a branch must not
+        # taint the straight-line path after it.
+        rep = analyze(tmp_path, {"a.py": """
+            class H:
+                def handle(self, batch, ack):
+                    if not batch:
+                        ack(True)
+                        return
+                    self._commit(batch)
+                    ack(True)
+        """}, select=["ACK"])
+        assert codes(rep) == []
+
+    def test_ack_inside_with_body_taints_path(self, tmp_path):
+        # `with` bodies run unconditionally: the path flows through.
+        rep = analyze(tmp_path, {"a.py": """
+            class H:
+                def handle(self, batch, ack):
+                    with self._lock:
+                        ack(True)
+                    self._persist(batch)
+        """}, select=["ACK"])
+        assert codes(rep) == ["ACK001"]
+
+    def test_ack_false_requeue_is_clean(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            class H:
+                def handle(self, batch, ack):
+                    ack(False)
+                    self._write_dlq(batch)
+        """}, select=["ACK"])
+        assert codes(rep) == []
+
+    def test_keyword_ack_true_flagged(self, tmp_path):
+        rep = analyze(tmp_path, {"a.py": """
+            class H:
+                def handle(self, msg):
+                    self._ack(msg, ok=True)
+                    self._checkpoint_offsets(msg)
+        """}, select=["ACK"])
+        assert codes(rep) == ["ACK001"]
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline + runner plumbing
 # ---------------------------------------------------------------------------
 
@@ -729,9 +1006,13 @@ class TestFullTree:
             cwd=REPO, capture_output=True, text=True, timeout=120)
         assert proc.returncode == 0, proc.stdout + proc.stderr
         rep = json.loads(proc.stdout)
+        assert rep["schema_version"] == 2
+        # all eight families ran (TRC/LCK/BUS/EXC + the v2 quartet)
+        assert len(rep["families"]) == 8
         assert rep["findings"] == []
         assert rep["files"] > 80          # the whole package was scanned
-        # ISSUE budget: analysis itself stays under 5 s on the full tree.
+        # ISSUE budget: analysis stays under 5 s on the full tree even
+        # with eight checker families.
         assert rep["elapsed_s"] < 5.0
 
     def test_cli_select_and_nonzero_exit(self, tmp_path):
@@ -749,3 +1030,134 @@ class TestFullTree:
             cwd=REPO, capture_output=True, text=True, timeout=60)
         assert proc.returncode == 1
         assert "EXC001" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# --changed: the git-diff-driven pre-commit loop
+# ---------------------------------------------------------------------------
+
+class TestChangedMode:
+    def test_changed_files_lists_modified_and_untracked(self, tmp_path,
+                                                        monkeypatch):
+        import tools.analyze.__main__ as amain
+
+        repo = tmp_path / "r"
+        repo.mkdir()
+        git = ["git", "-c", "user.email=t@example.com", "-c",
+               "user.name=t"]
+        subprocess.run(["git", "init", "-q"], cwd=repo, check=True)
+        (repo / "committed.py").write_text("x = 1\n", encoding="utf-8")
+        (repo / "stale.py").write_text("y = 1\n", encoding="utf-8")
+        subprocess.run(["git", "add", "."], cwd=repo, check=True)
+        subprocess.run(git + ["commit", "-qm", "seed"], cwd=repo,
+                       check=True)
+        (repo / "committed.py").write_text("x = 2\n", encoding="utf-8")
+        (repo / "new.py").write_text("z = 1\n", encoding="utf-8")
+        (repo / "notes.txt").write_text("prose\n", encoding="utf-8")
+
+        monkeypatch.setattr(amain, "REPO", str(repo))
+        got = amain.changed_files([str(repo)])
+        assert got == sorted([str(repo / "committed.py"),
+                              str(repo / "new.py")])
+
+    def test_changed_files_none_outside_git(self, tmp_path, monkeypatch):
+        import tools.analyze.__main__ as amain
+
+        plain = tmp_path / "nogit"
+        plain.mkdir()
+        monkeypatch.setattr(amain, "REPO", str(plain))
+        # git diff fails outside a repo -> None -> full-tree fallback
+        assert amain.changed_files([str(plain)]) is None
+
+    def test_changed_cli_skips_files_outside_changed_set(self, tmp_path):
+        # bad.py lives outside the repo, so it is never "changed" —
+        # --changed exits 0 without linting it (the same invocation
+        # without --changed exits 1 on EXC001, per
+        # test_cli_select_and_nonzero_exit).
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent("""
+            def work(item):
+                try:
+                    item.process()
+                except Exception:
+                    pass
+        """), encoding="utf-8")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analyze", "--changed",
+             "--no-baseline", "--json", str(bad)],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        rep = json.loads(proc.stdout)
+        assert rep["findings"] == []
+        assert rep["files"] == 0
+        assert rep["schema_version"] == 2
+
+
+# ---------------------------------------------------------------------------
+# --lock-report: rendering a lockwitness dump through the Finding pipeline
+# ---------------------------------------------------------------------------
+
+class TestLockReport:
+    REPORT = {
+        "schema_version": 1,
+        "acquisitions": 42,
+        "edge_count": 2,
+        "cycles": [{
+            "sites": ["pkg/a.py:10", "pkg/b.py:20"],
+            "threads": ["t-one", "t-two"],
+            "edges": [
+                {"held_site": "pkg/a.py:10", "acquire_site": "pkg/b.py:20",
+                 "thread": "t-one",
+                 "held_stack": ["a.py:9 in f"],
+                 "acquire_stack": ["a.py:11 in f"]},
+                {"held_site": "pkg/b.py:20", "acquire_site": "pkg/a.py:10",
+                 "thread": "t-two",
+                 "held_stack": ["b.py:19 in g"],
+                 "acquire_stack": ["b.py:21 in g"]},
+            ],
+        }],
+        "blocking": [{
+            "call": "time.sleep", "held_sites": ["pkg/a.py:10"],
+            "held_s": 0.25, "thread": "t-one",
+            "stack": ["a.py:12 in f"],
+        }],
+        "breaches": [{
+            "site": "pkg/a.py:10", "held_s": 1.5, "budget_s": 0.5,
+            "thread": "t-one",
+        }],
+    }
+
+    def test_text_rendering_and_exit_code(self, tmp_path):
+        rep_path = tmp_path / "lock.json"
+        rep_path.write_text(json.dumps(self.REPORT), encoding="utf-8")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analyze", "--lock-report",
+             str(rep_path), "--no-baseline"],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 1      # new findings -> nonzero
+        assert "LKW001" in proc.stdout
+        assert "LKW002" in proc.stdout
+        assert "LKW003" in proc.stdout
+        # both witness stacks are printed under the cycle finding
+        assert "held:    a.py:9 in f" in proc.stdout
+        assert "acquire: b.py:21 in g" in proc.stdout
+
+    def test_json_rendering(self, tmp_path):
+        rep_path = tmp_path / "lock.json"
+        rep_path.write_text(json.dumps(self.REPORT), encoding="utf-8")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analyze", "--lock-report",
+             str(rep_path), "--no-baseline", "--json"],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 1
+        out = json.loads(proc.stdout)
+        assert [f["code"] for f in out["findings"]] == \
+            ["LKW001", "LKW002", "LKW003"]
+        assert out["acquisitions"] == 42
+
+    def test_unreadable_report_is_usage_error(self, tmp_path):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analyze", "--lock-report",
+             str(tmp_path / "missing.json")],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 2

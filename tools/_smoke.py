@@ -54,6 +54,17 @@ def selfcheck() -> int:
     if rc != 0:
         print("crawlint FAILED (python -m tools.analyze)", file=sys.stderr)
         return rc
+    # The race-detector half: a witness-enabled micro-run proving the
+    # AB/BA cycle detector, blocking-under-lock, and clean-nesting paths
+    # all behave (docs/static-analysis.md "Runtime lock-order witness").
+    rc = subprocess.call(
+        [sys.executable, "-m", "distributed_crawler_tpu.utils.lockwitness",
+         "--selfcheck"], cwd=repo)
+    if rc != 0:
+        print("lockwitness selfcheck FAILED (python -m "
+              "distributed_crawler_tpu.utils.lockwitness --selfcheck)",
+              file=sys.stderr)
+        return rc
     rc = subprocess.call(
         [sys.executable, "-m", "tools.loadtest", "--smoke"], cwd=repo)
     if rc != 0:

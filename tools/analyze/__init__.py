@@ -1,8 +1,9 @@
 """crawlint — repo-native static analysis for distributed_crawler_tpu.
 
 The Go reference leaned on `go vet` + the race detector; the TPU-native
-Python port has invariant classes a generic linter cannot see.  Four
-AST-based checkers (stdlib-only, no third-party deps) encode them:
+Python port has invariant classes a generic linter cannot see.  Eight
+AST-based checker families (stdlib-only, no third-party deps) encode
+them:
 
 - **TRC** trace-safety: host side effects inside `jax.jit` / `jax.pmap` /
   `shard_map`-traced regions, and jitted call sites passing raw Python
@@ -17,14 +18,29 @@ AST-based checkers (stdlib-only, no third-party deps) encode them:
   ``trace.inject`` / ``trace.payload_span`` propagation seam.
 - **EXC** exception-swallowing: broad handlers in worker/orchestrator
   loops that drop the error with no log, metric, or re-raise.
+- **ATM** atomic persistence: durable state written in place instead of
+  tmp + fsync + `os.replace` (the spool/journal/checkpoint idiom).
+- **CFG** unknown-key-loud config parsers: `*_from_config`/`validate_*`
+  readers that accept-and-ignore instead of raising on unknown keys.
+- **MET** metric-name collisions (cross-file): the same metric name
+  written unlabeled from multiple construction sites — the parent
+  clobber bug class (PRs 9/14).
+- **ACK** ack-after-writeback: bus handlers that `ack(True)` before the
+  persist/commit call — a crash in the gap loses the message.
 
-Entry points: ``python -m tools.analyze`` (see `__main__.py`) or
+The race-detector half lives in `utils/lockwitness.py`: an opt-in
+runtime lock-order witness whose JSON reports render through the same
+Finding machinery (`python -m tools.analyze --lock-report <file>`,
+codes LKW001-003).
+
+Entry points: ``python -m tools.analyze`` (see `__main__.py`;
+``--changed`` lints only files differing from HEAD) or
 :func:`tools.analyze.core.run_paths` programmatically.  A checked-in
 ``baseline.txt`` grandfathers accepted findings so the gate starts green
 and ratchets; `tests/test_analyze.py` makes the zero-new-findings run
 part of tier-1.  Checker catalogue and workflow: `docs/static-analysis.md`.
 """
 
-from .core import Finding, run_paths  # noqa: F401
+from .core import ALL_FAMILIES, Finding, run_paths  # noqa: F401
 
-CHECKER_CODES = ("TRC", "LCK", "BUS", "EXC")
+CHECKER_CODES = ALL_FAMILIES

@@ -12,7 +12,8 @@ import json
 import os
 import sys
 
-from .core import all_findings, load_baseline, run_paths, write_baseline
+from .core import (REPORT_SCHEMA_VERSION, Finding, all_findings,
+                   load_baseline, run_paths, write_baseline)
 
 REPO = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
@@ -21,18 +22,145 @@ DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "baseline.txt")
 
 
+def changed_files(targets) -> "list[str] | None":
+    """.py files under ``targets`` differing from HEAD (staged, unstaged,
+    or untracked).  None = git unavailable/not a repo — caller falls back
+    to the full tree."""
+    import subprocess
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD", "--"],
+            cwd=REPO, capture_output=True, text=True, timeout=30)
+        if out.returncode != 0:
+            return None
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=REPO, capture_output=True, text=True, timeout=30)
+        names = out.stdout.splitlines() + (
+            untracked.stdout.splitlines()
+            if untracked.returncode == 0 else [])
+    except (OSError, subprocess.SubprocessError):
+        return None
+    roots = [os.path.abspath(t) for t in targets]
+    picked = []
+    for rel in names:
+        if not rel.endswith(".py"):
+            continue
+        ap = os.path.join(REPO, rel)
+        if not os.path.isfile(ap):
+            continue        # deleted files have nothing to lint
+        if any(ap == r or ap.startswith(r + os.sep) for r in roots):
+            picked.append(ap)
+    return sorted(set(picked))
+
+
+def render_lock_report(path: str, baseline: set, as_json: bool) -> int:
+    """Render a lockwitness JSON dump (utils/lockwitness.py) through the
+    crawlint Finding machinery.  Exit 1 on non-baselined findings."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            rep = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"error: cannot read lock report {path}: {e}",
+              file=sys.stderr)
+        return 2
+
+    def site_loc(site: str):
+        file, _, line = site.rpartition(":")
+        try:
+            return file, int(line)
+        except ValueError:
+            return site, 0
+
+    findings = []
+    stacks = []     # per-finding witness stacks for the text rendering
+    for cyc in rep.get("cycles", []):
+        sites = cyc.get("sites", [])
+        file, line = site_loc(sites[0]) if sites else ("<unknown>", 0)
+        findings.append(Finding(
+            path=file, line=line, code="LKW001",
+            message="lock-order cycle " + " -> ".join(sites) +
+                    f" (threads: {', '.join(cyc.get('threads', []))})",
+            context="cycle:" + "|".join(sites)))
+        stacks.append([
+            (f"edge {e.get('held_site')} -> {e.get('acquire_site')} "
+             f"[{e.get('thread')}]",
+             e.get("held_stack", []), e.get("acquire_stack", []))
+            for e in cyc.get("edges", [])])
+    for b in rep.get("blocking", []):
+        held = b.get("held_sites", [])
+        file, line = site_loc(held[0]) if held else ("<unknown>", 0)
+        findings.append(Finding(
+            path=file, line=line, code="LKW002",
+            message=f"blocking call {b.get('call')} while holding "
+                    f"{'/'.join(held)} ({b.get('held_s', 0):.3f}s held, "
+                    f"thread {b.get('thread')})",
+            context=f"{b.get('call')}:{'|'.join(held)}"))
+        stacks.append([("blocking site", b.get("stack", []), [])])
+    for b in rep.get("breaches", []):
+        file, line = site_loc(b.get("site", ""))
+        findings.append(Finding(
+            path=file, line=line, code="LKW003",
+            message=f"lock held {b.get('held_s', 0):.3f}s > budget "
+                    f"{b.get('budget_s', 0):.3f}s "
+                    f"(thread {b.get('thread')})",
+            context=f"hold:{b.get('site')}"))
+        stacks.append([])
+
+    new = [(f, s) for f, s in zip(findings, stacks)
+           if f.key() not in baseline]
+    if as_json:
+        print(json.dumps({
+            "schema_version": rep.get("schema_version", 1),
+            "source": path,
+            "findings": [f.to_dict() for f, _ in new],
+            "baselined": len(findings) - len(new),
+            "acquisitions": rep.get("acquisitions", 0),
+            "edge_count": rep.get("edge_count", 0),
+        }, indent=2))
+    else:
+        for f, edge_stacks in new:
+            print(f.render())
+            for label, held_stack, acquire_stack in edge_stacks:
+                print(f"    {label}")
+                for ln in held_stack:
+                    for piece in ln.splitlines():
+                        print("      held:    " + piece)
+                for ln in acquire_stack:
+                    for piece in ln.splitlines():
+                        print("      acquire: " + piece)
+        print(f"lockwitness report: {len(new)} finding(s) "
+              f"({len(findings) - len(new)} baselined) from "
+              f"{rep.get('acquisitions', 0)} acquisitions / "
+              f"{rep.get('edge_count', 0)} edges")
+    return 1 if new else 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m tools.analyze",
         description="crawlint: repo-native static analysis "
                     "(TRC trace-safety, LCK lock-discipline, "
-                    "BUS bus-registry, EXC exception-swallowing)")
+                    "BUS bus-registry, EXC exception-swallowing, "
+                    "ATM atomic-persistence, CFG unknown-key-loud "
+                    "parsers, MET metric collisions, ACK "
+                    "ack-after-writeback)")
     p.add_argument("paths", nargs="*", default=None,
                    help="files/dirs to analyze "
                         "(default: distributed_crawler_tpu/)")
     p.add_argument("--select", default=None, metavar="TRC,LCK,...",
                    help="comma-separated checker families to run "
-                        "(default: all four)")
+                        "(default: all eight)")
+    p.add_argument("--changed", action="store_true",
+                   help="lint only .py files differing from HEAD "
+                        "(git-diff driven; falls back to the full tree "
+                        "outside a repo) — the sub-second pre-commit "
+                        "loop")
+    p.add_argument("--lock-report", default=None, metavar="FILE",
+                   help="render a utils/lockwitness.py JSON dump "
+                        "(LKW001 cycles, LKW002 blocking-under-lock, "
+                        "LKW003 hold-budget breaches) instead of "
+                        "running the static checkers")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable report on stdout")
     p.add_argument("--baseline", default=DEFAULT_BASELINE,
@@ -44,7 +172,26 @@ def main(argv=None) -> int:
                         "exit 0 (ratchet tool — review the diff!)")
     args = p.parse_args(argv)
 
+    if args.lock_report:
+        baseline = set() if args.no_baseline \
+            else load_baseline(args.baseline)
+        return render_lock_report(args.lock_report, baseline,
+                                  args.as_json)
+
     paths = args.paths or [DEFAULT_TARGET]
+    if args.changed:
+        diff = changed_files(paths)
+        if diff is not None:
+            if not diff:
+                if not args.as_json:
+                    print("crawlint: no changed .py files under target "
+                          "paths (working tree matches HEAD)")
+                else:
+                    print(json.dumps(
+                        {"schema_version": REPORT_SCHEMA_VERSION,
+                         "findings": [], "files": 0}))
+                return 0
+            paths = diff
     select = [s for s in (args.select or "").split(",") if s] or None
     if args.write_baseline and select:
         # A partial run must not rewrite the whole-baseline file: it would

@@ -1,0 +1,142 @@
+"""ACK — ack-after-writeback ordering in bus handlers.
+
+The bus delivery contract (PRs 7/10): ``ack(True)`` is a *commit* — it
+tells the broker the message's effects are durable and it may drop the
+redelivery copy.  A handler that acks first and persists second turns
+every crash in the gap into silent data loss: the broker forgets the
+message, the writeback never happened.  The whole tree follows
+commit-then-ack (``self._commit(...)`` before ``self._ack(..., True)``
+in inference/worker.py and friends); ``ack(False)`` — requeue — is safe
+at any time.
+
+ACK001 flags the inversion: within one straight-line statement sequence,
+an ``ack``/``_ack`` call carrying a literal ``True`` argument followed
+by a writeback-shaped call (``write*``/``commit*``/``persist*``/
+``checkpoint*``/``save*``/``flush*``, leading underscores ignored).
+
+The walk is deliberately conservative about control flow: an ack inside
+a nested branch (``if not batch: ack(True); continue`` — the legitimate
+empty-batch early-ack) does NOT taint the statements after the branch;
+only ``with`` bodies propagate, because their execution is
+unconditional.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from .core import Finding, ModuleInfo, header_exprs
+
+_ACK_NAMES = {"ack", "_ack"}
+_WRITEBACK_PREFIXES = ("write", "commit", "persist", "checkpoint",
+                       "save", "flush")
+
+
+def _terminal_name(func: ast.expr) -> str:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def _is_ack_true(call: ast.Call) -> bool:
+    if _terminal_name(call.func) not in _ACK_NAMES:
+        return False
+    for arg in call.args:
+        if isinstance(arg, ast.Constant) and arg.value is True:
+            return True
+    for kw in call.keywords:
+        if isinstance(kw.value, ast.Constant) and kw.value.value is True:
+            return True
+    return False
+
+
+def _is_writeback(call: ast.Call) -> bool:
+    name = _terminal_name(call.func).lstrip("_").lower()
+    return name.startswith(_WRITEBACK_PREFIXES)
+
+
+def _header_calls(stmt: ast.stmt) -> List[ast.Call]:
+    """Calls in the statement's own expressions (not nested bodies),
+    skipping late-bound lambda bodies."""
+    out: List[ast.Call] = []
+    stack: List[ast.AST] = list(header_exprs(stmt))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Lambda):
+            continue
+        if isinstance(node, ast.Call):
+            out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+class _FnScan:
+    def __init__(self, mod: ModuleInfo, fn: ast.AST, qualname: str):
+        self.mod = mod
+        self.fn = fn
+        self.qualname = qualname
+        self.findings: List[Finding] = []
+
+    def run(self) -> List[Finding]:
+        self._scan_block(self.fn.body, None)
+        return self.findings
+
+    def _scan_block(self, stmts: List[ast.stmt],
+                    acked: Optional[Tuple[int, str]]
+                    ) -> Optional[Tuple[int, str]]:
+        """Linear scan; ``acked`` is the live (line, repr) of an earlier
+        ack(True) on this straight-line path, or None."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue        # nested defs run later, not on this path
+            calls = _header_calls(stmt)
+            if acked is not None:
+                for call in calls:
+                    if _is_writeback(call):
+                        line, ack_repr = acked
+                        self.findings.append(Finding(
+                            path=self.mod.path, line=line, code="ACK001",
+                            message=f"{ack_repr} at line {line} precedes "
+                                    f"the writeback "
+                                    f"{_terminal_name(call.func)}() at "
+                                    f"line {call.lineno} — a crash in "
+                                    "the gap loses the message",
+                            context=self.qualname))
+                        acked = None
+                        break
+            for call in calls:
+                if _is_ack_true(call):
+                    acked = (call.lineno,
+                             f"{_terminal_name(call.func)}(True)")
+            if isinstance(stmt, ast.With):
+                # Unconditional body: the path continues through it.
+                acked = self._scan_block(stmt.body, acked)
+                continue
+            # Conditional/looping/exception bodies: scan each with a
+            # fresh path (their acks may be early-ack-and-bail idioms;
+            # they don't taint the statements that follow).
+            for fname in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, fname, None)
+                if isinstance(sub, list) and sub \
+                        and all(isinstance(c, ast.stmt) for c in sub):
+                    self._scan_block(sub, None)
+            for h in getattr(stmt, "handlers", None) or []:
+                self._scan_block(h.body, None)
+        return acked
+
+
+def check(mod: ModuleInfo) -> List[Finding]:
+    # Cheap pre-filter: a module with no ack call sites has no ordering
+    # to check (most of the tree).
+    if not any("ack(" in ln for ln in mod.source_lines):
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        findings.extend(_FnScan(mod, node, mod.qualname(node)).run())
+    return findings

@@ -1,0 +1,134 @@
+"""ATM — atomic-persistence discipline for durable state writes.
+
+Durable artifacts in this repo (bus spool/outbox segments, the WAL
+journal, k-means checkpoints, state snapshots) are all written with the
+same dance: write to a ``.tmp`` sibling, ``fsync``, then ``os.replace``
+onto the final path — a crash mid-write leaves either the old file or
+the new one, never a torn half (see bus/spool.py, utils/journal.py,
+cluster/checkpoint.py).
+
+ATM001 flags the shape that breaks it: an ``open(path, "w"/"wb")`` whose
+path expression *names* persistent state (state/checkpoint/ckpt/wal/
+journal/spool/snapshot/manifest/ledger, case-insensitive) inside a scope
+that never performs the rename step (``os.replace``/``os.rename``/
+``shutil.move``) and doesn't delegate to an ``atomic*`` helper — i.e. a
+bare in-place overwrite of a durable file.
+
+Deliberately exempt:
+- append modes (``"a"``): the WAL-append idiom is the *other* legal way
+  to mutate durable state;
+- path expressions spelled tmp/temp/partial/staging/scratch: that IS the
+  safe half of the rename dance;
+- scopes containing the rename: the tmp-name heuristic can't see every
+  naming convention, but a rename in the same function means the write
+  is (at worst reviewably) part of an atomic swap.
+
+The check is name-driven by design — it enforces the *convention* that
+durable paths say so in their expression, which the whole tree already
+follows.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Optional, Tuple
+
+from .core import Finding, ModuleInfo, dotted_name
+
+_PERSIST_RE = re.compile(
+    r"state|checkpoint|ckpt|wal|journal|spool|snapshot|manifest|ledger",
+    re.IGNORECASE)
+_TMP_RE = re.compile(r"tmp|temp|partial|staging|scratch", re.IGNORECASE)
+_OPEN_CALLS = {"open", "io.open"}
+_RENAME_CALLS = {"os.replace", "os.rename", "os.renames", "shutil.move"}
+
+
+def _scope_walk(root: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``root``'s expression/statement tree without descending into
+    nested function/class/lambda scopes (they are their own scopes)."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _scopes(mod: ModuleInfo) -> List[Tuple[str, ast.AST]]:
+    """(qualname, scope_root) for the module and every function."""
+    out: List[Tuple[str, ast.AST]] = [("<module>", mod.tree)]
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append((mod.qualname(node), node))
+    return out
+
+
+def _write_mode(call: ast.Call) -> Optional[str]:
+    """The literal mode of an open() call when it truncate-writes; None
+    for reads, appends, r+/x modes, or dynamic modes."""
+    mode: Optional[ast.expr] = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    else:
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+    if not isinstance(mode, ast.Constant) or not isinstance(mode.value, str):
+        return None
+    return mode.value if mode.value.startswith("w") else None
+
+
+def _path_text(call: ast.Call) -> Optional[str]:
+    target: Optional[ast.expr] = call.args[0] if call.args else None
+    if target is None:
+        for kw in call.keywords:
+            if kw.arg == "file":
+                target = kw.value
+    if target is None:
+        return None
+    try:
+        return ast.unparse(target)
+    except Exception:       # pragma: no cover - unparse is total on 3.9+
+        return None
+
+
+def check(mod: ModuleInfo) -> List[Finding]:
+    if not any("open" in ln for ln in mod.source_lines):
+        return []        # no open() calls at all: skip the scope walks
+    findings: List[Finding] = []
+    for qualname, scope in _scopes(mod):
+        opens: List[Tuple[ast.Call, str, str]] = []
+        atomic = False
+        for node in _scope_walk(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = dotted_name(node.func, mod.imports)
+            if dotted in _RENAME_CALLS:
+                atomic = True
+                continue
+            callee = (dotted or "").split(".")[-1].lower()
+            if not callee and isinstance(node.func, ast.Attribute):
+                callee = node.func.attr.lower()
+            if "atomic" in callee:
+                atomic = True       # delegates to a blessed helper
+                continue
+            if dotted in _OPEN_CALLS:
+                mode = _write_mode(node)
+                text = _path_text(node)
+                if mode and text:
+                    opens.append((node, mode, text))
+        if atomic:
+            continue
+        for call, mode, text in opens:
+            if _TMP_RE.search(text) or not _PERSIST_RE.search(text):
+                continue
+            findings.append(Finding(
+                path=mod.path, line=call.lineno, code="ATM001",
+                message=f"non-atomic write: open({text}, {mode!r}) on a "
+                        "persistent-state path with no tmp+rename in "
+                        "scope",
+                context=qualname))
+    return findings

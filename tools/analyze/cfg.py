@@ -1,0 +1,96 @@
+"""CFG — unknown-key-loud config parsers.
+
+Every block parser in this repo (``validate_gate_config``,
+``pools_from_config``, ``budgets_from_config``, ``rules_from_config``)
+follows one discipline: compute the accepted key set, diff the incoming
+mapping against it, and **raise** on leftovers.  A typo'd scenario or
+config key then fails loudly at parse time instead of silently meaning
+"default forever" — the failure mode the loadgen gate validator was
+built to kill.
+
+CFG001 flags the accept-and-ignore shape: a function named
+``*_from_config`` or ``validate_*`` that reads one of its parameters
+with ``.get()`` / subscripting but contains no ``raise`` anywhere and
+doesn't delegate to another parser/validator (``*from_config*``,
+``*from_dict*``, ``validate*``).  Such a parser can never reject an
+unknown key.
+
+Scope is deliberately tight — the read must be on a *parameter* of the
+flagged function, so validators that probe unrelated dicts (HTTP
+responses, computed maps) don't trip it.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Set
+
+from .core import Finding, ModuleInfo
+
+_NAME_RE = re.compile(r"(_from_config$|^validate_)")
+_DELEGATE_RE = re.compile(r"from_config|from_dict|validate")
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    names = [p.arg for p in
+             (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return {n for n in names if n not in ("self", "cls")}
+
+
+def _reads_param(fn: ast.AST, params: Set[str]) -> bool:
+    """True when the body calls ``<param>.get(...)`` or subscripts a
+    parameter — the mapping-read shapes a block parser uses."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "get" \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in params:
+            return True
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in params:
+            return True
+    return False
+
+
+def _raises_or_delegates(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = ""
+            if isinstance(node.func, ast.Name):
+                name = node.func.id
+            elif isinstance(node.func, ast.Attribute):
+                name = node.func.attr
+            if _DELEGATE_RE.search(name):
+                return True
+    return False
+
+
+def check(mod: ModuleInfo) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _NAME_RE.search(node.name):
+            continue
+        params = _param_names(node)
+        if not params or not _reads_param(node, params):
+            continue
+        if _raises_or_delegates(node):
+            continue
+        findings.append(Finding(
+            path=mod.path, line=node.lineno, code="CFG001",
+            message=f"{node.name}() reads config keys with .get()/[] but "
+                    "never raises: unknown keys are silently accepted "
+                    "(the accept-and-ignore parser shape)",
+            context=node.name))
+    return findings

@@ -49,7 +49,31 @@ HINTS: Dict[str, str] = {
               "are declared once",
     "EXC001": "log (or count) the swallowed exception — a silent handler "
               "in a worker loop erases the failure",
+    "ATM001": "write to a tmp sibling, fsync, then os.replace onto the "
+              "final path (the spool/journal/checkpoint idiom) — or "
+              "append-only",
+    "CFG001": "diff the incoming keys against the accepted set and raise "
+              "on leftovers (see validate_gate_config), or delegate to a "
+              "parser that does",
+    "MET001": "give each writer a distinguishing label and write through "
+              ".labels(...) children; only one component may own the "
+              "unlabeled parent",
+    "ACK001": "ack(True) is the commit: persist/write back FIRST, ack "
+              "after (ack(False) — requeue — is safe anytime)",
+    "LKW001": "pick one global lock order for the cycle's sites and take "
+              "them in that order everywhere (or collapse to one lock)",
+    "LKW002": "move the blocking call outside the critical section; hold "
+              "the lock only to snapshot/commit state",
+    "LKW003": "shrink the critical section or raise "
+              "CRAWLINT_LOCKWITNESS_BUDGET_MS if the hold is justified",
 }
+
+#: --json schema: 2 adds schema_version + families (ISSUE 18).
+REPORT_SCHEMA_VERSION = 2
+
+#: Every checker family, in catalogue order.  Per-module checkers run
+#: file-at-a-time; MET and BUS are tree-level (cross-file).
+ALL_FAMILIES = ("TRC", "LCK", "BUS", "EXC", "ATM", "CFG", "MET", "ACK")
 
 
 @dataclass(frozen=True)
@@ -113,6 +137,35 @@ class ModuleInfo:
     suppressions: Dict[int, set] = field(default_factory=dict)
     # codes/checker-prefixes exempted module-wide (`disable-file=`)
     file_suppressions: set = field(default_factory=set)
+    # lazily-built child -> parent map shared by every checker that
+    # needs enclosing-scope context (one walk per file, not one per
+    # family — the 5 s full-tree budget depends on it)
+    _parents: Optional[Dict[ast.AST, ast.AST]] = \
+        field(default=None, repr=False, compare=False)
+
+    def parent_map(self) -> Dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            parents: Dict[ast.AST, ast.AST] = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    parents[child] = node
+            self._parents = parents
+        return self._parents
+
+    def qualname(self, node: ast.AST) -> str:
+        """Dotted enclosing qualname of a def (``Cls.method.inner``)."""
+        parents = self.parent_map()
+        parts: List[str] = []
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            parts.append(node.name)
+        cur = parents.get(node)
+        while cur is not None and not isinstance(cur, ast.Module):
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = parents.get(cur)
+        return ".".join(reversed(parts))
 
     def suppressed(self, finding: Finding) -> bool:
         if finding.code in self.file_suppressions \
@@ -293,9 +346,12 @@ class Report:
     suppressed: int
     files: int
     elapsed_s: float
+    families: Tuple[str, ...] = ALL_FAMILIES   # families that ran
 
     def to_dict(self) -> Dict[str, object]:
         return {
+            "schema_version": REPORT_SCHEMA_VERSION,
+            "families": list(self.families),
             "findings": [f.to_dict() for f in self.findings],
             "baselined": self.baselined,
             "suppressed": self.suppressed,
@@ -309,12 +365,13 @@ def run_paths(paths: Sequence[str], root: str,
               baseline: Optional[set] = None) -> Report:
     """Parse every file once, run the selected checkers, apply suppression
     comments and the baseline, and return the report."""
-    from . import busreg, exc, lck, trc
+    from . import ack, atm, busreg, cfg, exc, lck, met, trc
 
     t0 = time.perf_counter()
-    per_module = {"TRC": trc.check, "LCK": lck.check, "EXC": exc.check}
-    selected = {s.upper() for s in (select or ("TRC", "LCK", "BUS", "EXC"))}
-    unknown = selected - {"TRC", "LCK", "BUS", "EXC"}
+    per_module = {"TRC": trc.check, "LCK": lck.check, "EXC": exc.check,
+                  "ATM": atm.check, "CFG": cfg.check, "ACK": ack.check}
+    selected = {s.upper() for s in (select or ALL_FAMILIES)}
+    unknown = selected - set(ALL_FAMILIES)
     if unknown:
         raise ValueError(f"unknown checker(s): {sorted(unknown)}")
 
@@ -334,6 +391,10 @@ def run_paths(paths: Sequence[str], root: str,
         for f in busreg.check_tree(modules):
             mod = next((m for m in modules if m.path == f.path), None)
             raw.append((mod, f))
+    if "MET" in selected:
+        for f in met.check_tree(modules):
+            mod = next((m for m in modules if m.path == f.path), None)
+            raw.append((mod, f))
 
     suppressed = 0
     visible: List[Finding] = []
@@ -348,7 +409,9 @@ def run_paths(paths: Sequence[str], root: str,
     new = [f for f in visible if f.key() not in baseline]
     return Report(findings=new, baselined=len(visible) - len(new),
                   suppressed=suppressed, files=len(modules),
-                  elapsed_s=time.perf_counter() - t0)
+                  elapsed_s=time.perf_counter() - t0,
+                  families=tuple(f for f in ALL_FAMILIES
+                                 if f in selected))
 
 
 def all_findings(paths: Sequence[str], root: str,

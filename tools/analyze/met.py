@@ -1,0 +1,128 @@
+"""MET — cross-file metric-name collisions (the parent-clobber class).
+
+`utils/metrics.py` registries are get-or-make: two components asking for
+the same metric name share one object.  That is the *feature* labeled
+children exist for — ``m.labels(path=...).inc()`` gives each writer its
+own series.  The bug class (live twice: DeviceTimeline in PR 9,
+EfficiencyMeter in PR 14) is two construction sites both writing the
+same *unlabeled parent*: a gauge ``set()`` from component A silently
+clobbers component B's value, and a counter loses attribution entirely.
+
+MET001 (tree-level): the same metric name is registered at two or more
+construction sites in two or more modules, and more than one of those
+sites writes the parent directly (``inc``/``dec``/``set``/``add``/
+``observe``/``set_fn`` with no ``.labels()``).  Sites that only read
+(``series``/``value``/``expose``/...) or that always write through
+``.labels(...)`` children are the sanctioned sharing patterns and never
+collide.
+
+Classification follows the registration through a simple local binding
+(``self.m = registry.counter(...)`` / ``m = registry.gauge(...)``) and
+inspects every use of that binding in the module; registrations passed
+straight into other expressions are treated as reads (no guessing).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from .core import Finding, ModuleInfo
+
+_REG_METHODS = {"counter", "gauge", "histogram"}
+_WRITER_ATTRS = {"inc", "dec", "set", "add", "observe", "set_fn"}
+
+
+def _bound_target(parent: ast.AST, call: ast.Call) -> Optional[str]:
+    """'self.X' / 'X' when the registration is assigned to a simple
+    binding; None otherwise."""
+    if not isinstance(parent, ast.Assign) or parent.value is not call:
+        return None
+    if len(parent.targets) != 1:
+        return None
+    t = parent.targets[0]
+    if isinstance(t, ast.Name):
+        return t.id
+    if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+            and t.value.id == "self":
+        return f"self.{t.attr}"
+    return None
+
+
+def _use_index(mod: ModuleInfo) -> Dict[str, set]:
+    """One pass over the module: binding ('X' / 'self.X') -> attribute
+    names accessed on it (``self.X.inc`` / ``X.labels`` / ...)."""
+    idx: Dict[str, set] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        base = node.value
+        if isinstance(base, ast.Name):
+            idx.setdefault(base.id, set()).add(node.attr)
+        elif isinstance(base, ast.Attribute) \
+                and isinstance(base.value, ast.Name) \
+                and base.value.id == "self":
+            idx.setdefault(f"self.{base.attr}", set()).add(node.attr)
+    return idx
+
+
+def _classify(uses: Dict[str, set], parents: Dict[ast.AST, ast.AST],
+              call: ast.Call) -> str:
+    """'writer' (bare parent writes), 'labeled', or 'reader'."""
+    parent = parents.get(call)
+    if isinstance(parent, ast.Attribute):
+        if parent.attr == "labels":
+            return "labeled"
+        if parent.attr in _WRITER_ATTRS:
+            return "writer"
+        return "reader"
+    target = _bound_target(parent, call) if parent is not None else None
+    if target is None:
+        return "reader"
+    attrs = uses.get(target, set())
+    if attrs & _WRITER_ATTRS:
+        return "writer"
+    if "labels" in attrs:
+        return "labeled"
+    return "reader"
+
+
+def check_tree(modules: List[ModuleInfo]) -> List[Finding]:
+    # metric name -> [(mod, line, class)]
+    sites: Dict[str, List[Tuple[ModuleInfo, int, str]]] = {}
+    for mod in modules:
+        regs: List[ast.Call] = []
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _REG_METHODS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                regs.append(node)
+        if not regs:
+            continue
+        parents = mod.parent_map()
+        uses = _use_index(mod)
+        for node in regs:
+            sites.setdefault(node.args[0].value, []).append(
+                (mod, node.lineno, _classify(uses, parents, node)))
+
+    findings: List[Finding] = []
+    for name, entries in sorted(sites.items()):
+        writers = [(m, ln) for m, ln, k in entries if k == "writer"]
+        if len(writers) < 2:
+            continue
+        if len({m.path for m, _ in writers}) < 2:
+            continue        # one module sharing its own metric is fine
+        for mod, line in writers:
+            others = ", ".join(f"{m.path}:{ln}" for m, ln in writers
+                               if (m, ln) != (mod, line))
+            findings.append(Finding(
+                path=mod.path, line=line, code="MET001",
+                message=f"metric {name!r} written unlabeled from multiple "
+                        f"construction sites (also at {others}) — parent "
+                        "values clobber each other; write through "
+                        ".labels(...) children",
+                context=name))
+    return findings
